@@ -1,0 +1,60 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA (compute) with tape-based eager autograd,
+a compiled program path, and a mesh-based hybrid-parallel distributed stack.
+
+Blueprint: /root/repo/SURVEY.md (structural analysis of the reference).
+"""
+from __future__ import annotations
+
+# ---- core ----
+from .core.dtype import (  # noqa: F401
+    DType, bool_ as bool, uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+)
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, Place, set_device, get_device, is_compiled_with_tpu,
+)
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core.autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# ---- tensor ops exported at top level (paddle.add, paddle.matmul, ...) ----
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+# grad API
+from .core import autograd as _autograd_mod
+grad = _autograd_mod.grad
+
+
+def is_grad_enabled_():
+    return _autograd_mod.is_grad_enabled()
+
+
+# ---- subpackages (lazy where heavy) ----
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework import save, load  # noqa: F401,E402
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for the "
+        "compiled path (the analog of static graphs on XLA)")
+
+
+def in_dynamic_mode():
+    return True

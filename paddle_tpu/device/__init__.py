@@ -1,0 +1,19 @@
+"""paddle_tpu.device — device management (analog of python/paddle/device/)."""
+from ..core.place import set_device, get_device, CPUPlace, TPUPlace, Place, is_compiled_with_tpu  # noqa: F401
+import jax as _jax
+
+def device_count():
+    return len(_jax.devices())
+
+def synchronize(device=None):
+    for d in _jax.live_arrays():
+        d.block_until_ready()
+
+def cuda_device_count():  # parity shim
+    return 0
+
+def is_compiled_with_cuda():
+    return False
+
+def is_compiled_with_xpu():
+    return False

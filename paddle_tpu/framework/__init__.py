@@ -1,0 +1,2 @@
+"""paddle_tpu.framework — misc framework-level API (save/load, dtype defaults)."""
+from .io import save, load  # noqa: F401

@@ -1,0 +1,38 @@
+"""Single-process save/load (analog of python/paddle/framework/io.py:773,1020)."""
+from __future__ import annotations
+import pickle
+import numpy as np
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(obj.numpy()),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            t = Tensor(obj["data"], stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", t.name)
+            return t
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **kwargs):
+    with open(path, "rb") as f:
+        return _from_saveable(pickle.load(f))

@@ -1,0 +1,83 @@
+"""paddle_tpu.tensor — the tensor-method API surface.
+
+Analog of python/paddle/tensor/ in the reference. Importing this module also
+monkey-patches arithmetic/method access onto ``Tensor`` (the reference does
+the same from python/paddle/base/dygraph/tensor_patch_methods.py:268).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from . import creation, math, manipulation, linalg, search, stat
+from . import random as random  # noqa: F401
+
+
+def _patch_tensor_methods():
+    import sys
+    mod = sys.modules[__name__]
+
+    # Attach every public op as a Tensor method (paddle exposes x.op(...) for
+    # nearly all tensor ops).
+    _method_sources = [creation, math, manipulation, linalg, search, stat]
+    skip = {"to_tensor", "zeros", "ones", "full", "empty", "arange", "linspace",
+            "logspace", "eye", "meshgrid", "tril_indices", "triu_indices",
+            "rand", "randn", "randint", "randperm", "normal", "uniform", "gaussian",
+            "broadcast_shape", "scatter_nd", "assign"}
+    for src in _method_sources:
+        for name in dir(src):
+            if name.startswith("_") or name in skip:
+                continue
+            fn = getattr(src, name)
+            if callable(fn) and not isinstance(fn, type) and not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    Tensor.einsum = None  # not a method
+    del Tensor.einsum
+
+    # Operator protocol.
+    Tensor.__add__ = lambda s, o: math.add(s, _u(o))
+    Tensor.__radd__ = lambda s, o: math.add(_u(o), s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, _u(o))
+    Tensor.__rsub__ = lambda s, o: math.subtract(_u(o), s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, _u(o))
+    Tensor.__rmul__ = lambda s, o: math.multiply(_u(o), s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, _u(o))
+    Tensor.__rtruediv__ = lambda s, o: math.divide(_u(o), s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _u(o))
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(_u(o), s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, _u(o))
+    Tensor.__rmod__ = lambda s, o: math.mod(_u(o), s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, _u(o))
+    Tensor.__rpow__ = lambda s, o: math.pow(_u(o), s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: math.logical_not(s) if s.dtype.is_bool else math.bitwise_not(s)
+    Tensor.__and__ = lambda s, o: (math.logical_and if s.dtype.is_bool else math.bitwise_and)(s, _u(o))
+    Tensor.__or__ = lambda s, o: (math.logical_or if s.dtype.is_bool else math.bitwise_or)(s, _u(o))
+    Tensor.__xor__ = lambda s, o: (math.logical_xor if s.dtype.is_bool else math.bitwise_xor)(s, _u(o))
+    Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, _u(o))
+    Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, _u(o))
+    Tensor.__eq__ = lambda s, o: math.equal(s, _u(o))
+    Tensor.__ne__ = lambda s, o: math.not_equal(s, _u(o))
+    Tensor.__lt__ = lambda s, o: math.less_than(s, _u(o))
+    Tensor.__le__ = lambda s, o: math.less_equal(s, _u(o))
+    Tensor.__gt__ = lambda s, o: math.greater_than(s, _u(o))
+    Tensor.__ge__ = lambda s, o: math.greater_equal(s, _u(o))
+    Tensor.__hash__ = lambda s: id(s)
+
+
+def _u(o):
+    return o
+
+
+_patch_tensor_methods()

@@ -1,0 +1,200 @@
+"""Tensor creation ops (analog of python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+from ..core.dispatch import primitive, eager_apply
+
+_DEFAULT_FLOAT = "float32"
+
+
+def _dt(dtype, default=_DEFAULT_FLOAT):
+    return to_jax_dtype(dtype if dtype is not None else default)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = jnp.result_type(fill_value) if not isinstance(fill_value, float) else _DEFAULT_FLOAT
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return eager_apply("zeros_like", lambda a: jnp.zeros_like(a, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+
+
+def ones_like(x, dtype=None, name=None):
+    return eager_apply("ones_like", lambda a: jnp.ones_like(a, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return eager_apply("full_like", lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else _DEFAULT_FLOAT
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item()) if isinstance(num, Tensor) else int(num)
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), int(num_columns) if num_columns else None, dtype=_dt(dtype)))
+
+
+@primitive()
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@primitive()
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(_dt(dtype)))
+
+
+@primitive()
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], dtype=bool, k=offset)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+@primitive()
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@primitive()
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    out = jnp.zeros((*x.shape, x.shape[-1] + abs(offset)), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    src = list(range(out.ndim))
+    d1 = dim1 % out.ndim
+    d2 = dim2 % out.ndim
+    rest = [d for d in src if d not in (d1, d2)]
+    return jnp.moveaxis(out, (-2, -1), (d1, d2)) if (d1, d2) != (out.ndim - 2, out.ndim - 1) else out
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = eager_apply("meshgrid", lambda *xs: jnp.meshgrid(*xs, indexing="ij"), tuple(args), {})
+    return list(outs)
+
+
+def assign(x, output=None):
+    val = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output._inplace_update(val)
+        return output
+    return eager_apply("assign", lambda a: a + 0 if jnp.issubdtype(jnp.result_type(a), jnp.inexact) else a, (x,), {}) \
+        if isinstance(x, Tensor) else Tensor(val)
+
+
+def clone(x):
+    return x.clone()
+
+
+def complex(real, imag):
+    return eager_apply("complex", lambda r, i: jax.lax.complex(r, i), (real, imag), {})
+
+
+def polar(abs_t, angle):
+    return eager_apply("polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)), (abs_t, angle), {})
+
+
+def real(x):
+    return eager_apply("real", jnp.real, (x,), {})
+
+
+def imag(x):
+    return eager_apply("imag", jnp.imag, (x,), {})
+
+
+def cauchy_(x, loc=0, scale=1):
+    k = _random.next_key()
+    u = jax.random.uniform(k, x._data.shape, dtype=jnp.float32)
+    vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    return x._inplace_update(vals.astype(x._data.dtype))
+
+
+def geometric_(x, probs):
+    k = _random.next_key()
+    u = jax.random.uniform(k, x._data.shape, dtype=jnp.float32)
+    vals = jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))
+    return x._inplace_update(vals.astype(x._data.dtype))
+
+
+def one_hot(x, num_classes, name=None):
+    return eager_apply("one_hot", lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), (x,), {})
+
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "tril", "triu",
+    "tril_indices", "triu_indices", "diag", "diagflat", "diag_embed", "meshgrid",
+    "assign", "clone", "complex", "polar", "real", "imag", "cauchy_", "geometric_",
+    "one_hot", "to_tensor",
+]

@@ -1,0 +1,15 @@
+"""Einsum (analog of python/paddle/tensor/einsum.py — delegated to XLA)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+
+
+def einsum(equation, *operands, name=None):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return eager_apply("einsum", lambda *xs: jnp.einsum(equation, *xs), operands, {})
+
+
+__all__ = ["einsum"]

@@ -1,0 +1,229 @@
+"""Linear algebra ops (analog of python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import eager_apply
+from .math import matmul, addmm, inverse  # re-export  # noqa: F401
+
+
+def bmm(x, y, name=None):
+    return eager_apply("bmm", lambda a, b: jnp.matmul(a, b), (x, y), {})
+
+
+def mm(x, y, name=None):
+    return eager_apply("mm", lambda a, b: jnp.matmul(a, b), (x, y), {})
+
+
+def mv(x, vec, name=None):
+    return eager_apply("mv", lambda a, v: jnp.matmul(a, v), (x, vec), {})
+
+
+def dot(x, y, name=None):
+    return eager_apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y), {})
+
+
+def t(x, name=None):
+    return eager_apply("t", lambda a: a.T if a.ndim == 2 else a, (x,), {})
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return eager_apply("cross", fn, (x, y), {})
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None:
+            if ax is None or (isinstance(ax, tuple) and len(ax) == 2):
+                return jnp.linalg.norm(a if ax is not None else a.reshape(-1),
+                                       ord="fro" if ax is not None else 2,
+                                       axis=ax, keepdims=keepdim)
+            return jnp.linalg.norm(a, ord=2, axis=ax, keepdims=keepdim)
+        if p in ("fro", "nuc"):
+            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+        if ax is None:
+            a = a.reshape(-1)
+            ax = 0
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+    return eager_apply("norm", fn, (x,), {})
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.linalg.norm(a, ord=p, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+    return eager_apply("vector_norm", fn, (x,), {})
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return eager_apply("matrix_norm",
+                       lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim), (x,), {})
+
+
+def dist(x, y, p=2, name=None):
+    return eager_apply("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), (x, y), {})
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return eager_apply("cdist", fn, (x, y), {})
+
+
+def cond(x, p=None, name=None):
+    return eager_apply("cond", lambda a: jnp.linalg.cond(a, p=p), (x,), {})
+
+
+def cholesky(x, upper=False, name=None):
+    return eager_apply("cholesky", lambda a: jnp.linalg.cholesky(
+        a) if not upper else jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2).conj(), (x,), {})
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return eager_apply("cholesky_solve", fn, (x, y), {})
+
+
+def det(x, name=None):
+    return eager_apply("det", jnp.linalg.det, (x,), {})
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return eager_apply("slogdet", fn, (x,), {})
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return eager_apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,), {})
+
+
+def solve(x, y, name=None):
+    return eager_apply("solve", lambda a, b: jnp.linalg.solve(a, b), (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return eager_apply("triangular_solve", fn, (x, y), {})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return eager_apply("lstsq", fn, (x, y), {})
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
+    return tuple(eager_apply("svd", fn, (x,), {}))
+
+
+def svdvals(x, name=None):
+    return eager_apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,), {})
+
+
+def qr(x, mode="reduced", name=None):
+    outs = eager_apply("qr", lambda a: jnp.linalg.qr(a, mode=mode), (x,), {})
+    return tuple(outs) if mode != "r" else outs
+
+
+def eig(x, name=None):
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._data))  # CPU-only in jax; use numpy (eager op)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = eager_apply("eigh", lambda a: jnp.linalg.eigh(a, symmetrize_input=True), (x,), {})
+    return tuple(outs)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return eager_apply("eigvalsh", jnp.linalg.eigvalsh, (x,), {})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    outs = eager_apply("lu", fn, (x,), {})
+    if get_infos:
+        return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
+    return tuple(outs)
+
+
+def matrix_power(x, n, name=None):
+    return eager_apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,), {})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return eager_apply("matrix_rank",
+                       lambda a: jnp.linalg.matrix_rank(a, rtol=tol), (x,), {})
+
+
+def multi_dot(x, name=None):
+    return eager_apply("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), tuple(x), {})
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return eager_apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,), {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def fn(a):
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fweights._data if isinstance(fweights, Tensor) else fweights,
+                       aweights=aweights._data if isinstance(aweights, Tensor) else aweights)
+    return eager_apply("cov", fn, (x,), {})
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, (*a.shape[:-2], m, m)).copy() if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros((*a.shape[:-2], i), a.dtype),
+                                 jnp.ones((*a.shape[:-2], 1), a.dtype),
+                                 a[..., i + 1:, i]], axis=-1)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i:i + 1, None] * (v[..., :, None] * v[..., None, :])
+            q = q @ h
+        return q[..., :, :n]
+    return eager_apply("householder_product", fn, (x, tau), {})
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def fn(a):
+        k = q if q is not None else min(6, *a.shape[-2:])
+        if center:
+            a = a - a.mean(axis=-2, keepdims=True)
+        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+    return tuple(eager_apply("pca_lowrank", fn, (x,), {}))

@@ -1,0 +1,441 @@
+"""Shape/layout manipulation ops (analog of python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from ..core.dispatch import eager_apply
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(i) for i in v.numpy())
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
+
+
+def cast(x, dtype):
+    return eager_apply("cast", lambda a: a.astype(to_jax_dtype(dtype)), (x,), {})
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return eager_apply("reshape", lambda a: jnp.reshape(a, shape), (x,), {})
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node, x._output_slot, x.stop_gradient = \
+        out._data, out._grad_node, out._output_slot, out.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return eager_apply("flatten", fn, (x,), {})
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = _ints(axis)
+        ax = (ax,) if isinstance(ax, int) else ax
+        ax = tuple(a_ for a_ in ax if a.shape[a_ % a.ndim] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+    return eager_apply("squeeze", fn, (x,), {})
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis)
+    ax = (ax,) if isinstance(ax, int) else ax
+    def fn(a):
+        for i in sorted(ax):
+            a = jnp.expand_dims(a, i)
+        return a
+    return eager_apply("unsqueeze", fn, (x,), {})
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return eager_apply("transpose", lambda a: jnp.transpose(a, perm), (x,), {})
+
+
+def moveaxis(x, source, destination, name=None):
+    return eager_apply("moveaxis", lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)), (x,), {})
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return eager_apply("swapaxes", lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), (x,), {})
+
+
+def roll(x, shifts, axis=None, name=None):
+    return eager_apply("roll", lambda a: jnp.roll(a, _ints(shifts), axis=_ints(axis) if axis is not None else None), (x,), {})
+
+
+def flip(x, axis, name=None):
+    return eager_apply("flip", lambda a: jnp.flip(a, axis=_ints(axis)), (x,), {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return eager_apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), {})
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return eager_apply("concat", lambda *xs: jnp.concatenate(xs, axis=axis), tuple(x), {})
+
+
+def stack(x, axis=0, name=None):
+    return eager_apply("stack", lambda *xs: jnp.stack(xs, axis=int(axis)), tuple(x), {})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def fn(a):
+        dim = a.shape[axis]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [int(s) for s in num_or_sections]
+        n_unknown = builtins.sum(1 for s in secs if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in secs if s >= 0)
+            secs = [s if s >= 0 else dim - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idx, axis=axis))
+
+    return list(eager_apply("split", fn, (x,), {}))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[int(axis)]
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=int(axis)) for s in jnp.split(a, n, axis=int(axis)))
+    return list(eager_apply("unbind", fn, (x,), {}))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    return eager_apply("tile", lambda a: jnp.tile(a, _ints(repeat_times)), (x,), {})
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    def fn(a):
+        tgt = list(shape)
+        src = (1,) * (len(tgt) - a.ndim) + a.shape
+        tgt = [s if t == -1 else t for t, s in zip(tgt, src)]
+        return jnp.broadcast_to(a.reshape(src), tgt)
+    return eager_apply("expand", fn, (x,), {})
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = eager_apply("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), tuple(inputs), {})
+    return list(outs)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+    return eager_apply("slice", fn, (x,), {})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(s, e, st)
+        return a[tuple(idx)]
+    return eager_apply("strided_slice", fn, (x,), {})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else (0,) * len(shape)
+    def fn(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offsets, shape)))
+        return a[idx]
+    return eager_apply("crop", fn, (x,), {})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _ints(pad)
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to last len(pad)//2 spatial dims per data_format
+            width = [(0, 0)] * nd
+            spatial = len(pad) // 2
+            if data_format.endswith("C") and nd >= 3:  # NHWC-like: spatial dims 1..nd-2
+                dims = list(range(1, 1 + spatial))
+            else:  # NCHW-like: spatial dims 2..
+                dims = list(range(nd - spatial, nd))
+            for j, d in enumerate(dims):
+                width[d] = (pad[2 * j], pad[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return eager_apply("pad", fn, (x,), {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return eager_apply("repeat_interleave",
+                       lambda a: jnp.repeat(a, r, axis=axis), (x,), {})
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return eager_apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis), (x, index), {})
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return eager_apply("gather_nd", fn, (x, index), {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return eager_apply("take_along_axis",
+                       lambda a, i: jnp.take_along_axis(a, i, axis=axis), (arr, indices), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def fn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        dims = list(range(a.ndim))
+        onehot_idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in dims])
+                      for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis else jnp.broadcast_to(onehot_idx[d], i.shape)
+                         for d in dims)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("multiply", "mul"):
+            return a.at[full_idx].multiply(v)
+        if reduce == "amax":
+            return a.at[full_idx].max(v)
+        if reduce == "amin":
+            return a.at[full_idx].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return eager_apply("put_along_axis", fn, (arr, indices, values), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u.astype(a.dtype))
+        return a.at[i].set(jnp.zeros_like(u, dtype=a.dtype)).at[i].add(u.astype(a.dtype))
+    return eager_apply("scatter", fn, (x, index, updates), {})
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data, x._grad_node, x._output_slot, x.stop_gradient = \
+        out._data, out._grad_node, out._output_slot, out.stop_gradient
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u.astype(a.dtype))
+    return eager_apply("scatter_nd_add", fn, (x, index, updates), {})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(i, u):
+        zeros = jnp.zeros(_ints(shape), dtype=u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return zeros.at[idx].add(u)
+    return eager_apply("scatter_nd", fn, (index, updates), {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return eager_apply("index_select", lambda a, i: jnp.take(a, i, axis=int(axis)), (x, index), {})
+
+
+def index_sample(x, index, name=None):
+    return eager_apply("index_sample",
+                       lambda a, i: jnp.take_along_axis(a, i, axis=1), (x, index), {})
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[int(axis)] = i
+        return a.at[tuple(idx)].add(v.astype(a.dtype))
+    return eager_apply("index_add", fn, (x, index, value), {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v.astype(a.dtype))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return eager_apply("index_put", fn, (x, value, *indices), {})
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: eager only (like reference's masked_select
+    # which allocates by mask count; reference paddle/phi/kernels/gpu/masked_select_kernel.cu).
+    return Tensor(x._data[np.asarray(mask._data if isinstance(mask, Tensor) else mask)])
+
+
+def masked_fill(x, mask, value, name=None):
+    def fn(a, m):
+        v = value._data if isinstance(value, Tensor) else value
+        return jnp.where(m, jnp.asarray(v, dtype=a.dtype), a)
+    return eager_apply("masked_fill", fn, (x, mask), {})
+
+
+def masked_scatter(x, mask, value, name=None):
+    m = np.asarray(mask._data)
+    v = value._data.reshape(-1)[: int(m.sum())]
+    out = x._data.copy() if hasattr(x._data, "copy") else x._data
+    flat_mask = jnp.broadcast_to(mask._data, x._data.shape)
+    idx = jnp.nonzero(flat_mask.reshape(-1))[0]
+    return Tensor(x._data.reshape(-1).at[idx].set(v.astype(x._data.dtype)).reshape(x._data.shape))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return eager_apply("where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y), {})
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(x._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None])) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    # paddle does not return the index unless asked; np orders [vals, idx?, inv?, counts?]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        vals = arr[change]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+        if return_counts:
+            idx = np.nonzero(change)[0]
+            counts = np.diff(np.append(idx, arr.size))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def as_complex(x, name=None):
+    return eager_apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), {})
+
+
+def as_real(x, name=None):
+    return eager_apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,), {})
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [eager_apply("atleast_1d", jnp.atleast_1d, (x,), {}) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [eager_apply("atleast_2d", jnp.atleast_2d, (x,), {}) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [eager_apply("atleast_3d", jnp.atleast_3d, (x,), {}) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return eager_apply("view_dtype", lambda a: a.view(to_jax_dtype(shape_or_dtype)), (x,), {})
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return eager_apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), (x, y), {})
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_shard = (i >= lo) & (i < hi)
+        return jnp.where(in_shard, i - lo, ignore_value)
+    return eager_apply("shard_index", fn, (input,), {})

@@ -1,0 +1,343 @@
+"""Elementwise + reduction math ops (analog of python/paddle/tensor/math.py, 170 defs)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from ..core.dispatch import primitive, eager_apply
+
+# ---- binary elementwise ----
+
+def _binop(name, fn):
+    def op(x, y, name=None):
+        return eager_apply(name, fn, (x, y), {})
+    op.__name__ = name
+    op.pure = fn
+    return op
+
+
+add = _binop("add", lambda x, y: jnp.add(x, y))
+subtract = _binop("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binop("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _binop("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binop("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+mod = _binop("mod", lambda x, y: jnp.mod(x, y))
+remainder = mod
+floor_mod = mod
+pow = _binop("pow", lambda x, y: jnp.power(x, y))
+maximum = _binop("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binop("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _binop("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binop("fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _binop("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binop("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binop("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+nextafter = _binop("nextafter", lambda x, y: jnp.nextafter(x, y))
+copysign = _binop("copysign", lambda x, y: jnp.copysign(x, y))
+heaviside = _binop("heaviside", lambda x, y: jnp.heaviside(x, y))
+gcd = _binop("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binop("lcm", lambda x, y: jnp.lcm(x, y))
+ldexp = _binop("ldexp", lambda x, y: jnp.ldexp(x, y))
+inner = _binop("inner", lambda x, y: jnp.inner(x, y))
+outer = _binop("outer", lambda x, y: jnp.outer(x, y))
+kron = _binop("kron", lambda x, y: jnp.kron(x, y))
+
+divide_ = divide
+true_divide = divide
+
+# ---- unary elementwise ----
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return eager_apply(name, fn, (x,), {})
+    op.__name__ = name
+    op.pure = fn
+    return op
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lax.rsqrt)
+abs = _unop("abs", jnp.abs)
+sign = _unop("sign", jnp.sign)
+sgn = sign
+neg = _unop("neg", jnp.negative)
+negative = neg
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+ceil = _unop("ceil", jnp.ceil)
+floor = _unop("floor", jnp.floor)
+round = _unop("round", jnp.round)
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+square = _unop("square", jnp.square)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+polygamma_fn = jax.scipy.special.polygamma
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+exponent = _unop("exponent", lambda x: jnp.floor(jnp.log2(jnp.abs(x))))
+isfinite = _unop("isfinite", jnp.isfinite)
+isinf = _unop("isinf", jnp.isinf)
+isnan = _unop("isnan", jnp.isnan)
+isneginf = _unop("isneginf", jnp.isneginf)
+isposinf = _unop("isposinf", jnp.isposinf)
+isreal = _unop("isreal", jnp.isreal)
+
+
+def polygamma(x, n, name=None):
+    return eager_apply("polygamma", lambda a: polygamma_fn(n, a), (x,), {})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out.astype(a.dtype)
+    return eager_apply("scale", fn, (x, scale, bias), {})
+
+
+def clip(x, min=None, max=None, name=None):
+    def fn(a):
+        lo = min._data if isinstance(min, Tensor) else min
+        hi = max._data if isinstance(max, Tensor) else max
+        return jnp.clip(a, lo, hi)
+    return eager_apply("clip", fn, (x,), {})
+
+
+def lerp(x, y, weight, name=None):
+    return eager_apply("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight), {})
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return eager_apply("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (x,), {})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return eager_apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,), {})
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+    return eager_apply("multiplex", fn, (index, *inputs), {})
+
+
+# ---- reductions ----
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn):
+    def op(x, axis=None, keepdim=False, name=None):
+        return eager_apply(name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+    op.__name__ = name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    def fn(a):
+        out = jnp.sum(a, axis=_axis(axis), keepdims=keepdim)
+        if dtype is not None:
+            out = out.astype(to_jax_dtype(dtype))
+        elif jnp.issubdtype(a.dtype, jnp.bool_):
+            out = out.astype(jnp.int32)
+        return out
+    return eager_apply("sum", fn, (x,), {})
+
+
+mean_ = _reduce("mean", jnp.mean)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return mean_(x, axis, keepdim)
+
+
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return eager_apply("count_nonzero",
+                       lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return eager_apply("logsumexp",
+                       lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=to_jax_dtype(dtype) if dtype else None)
+        return jnp.cumsum(a, axis=_axis(axis), dtype=to_jax_dtype(dtype) if dtype else None)
+    return eager_apply("cumsum", fn, (x,), {})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return eager_apply("cumprod",
+                       lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=to_jax_dtype(dtype) if dtype else None), (x,), {})
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(a):
+        ax = _axis(axis) if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = lax.associative_scan(jnp.maximum, arr, axis=ax if axis is not None else 0)
+        idx = jnp.argmax(jnp.where(arr == vals, jnp.arange(arr.shape[ax] if axis is not None else arr.shape[0]).reshape([-1 if i == (ax if axis is not None else 0) else 1 for i in range(arr.ndim)]), -1), axis=ax if axis is not None else 0)
+        return vals, idx
+    return eager_apply("cummax", fn, (x,), {})
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else _axis(axis)
+        return lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return eager_apply("logcumsumexp", fn, (x,), {})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return eager_apply("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), (x,), {})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return eager_apply("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), (x,), {})
+
+
+# ---- logic / comparison (elementwise, return bool tensors) ----
+
+equal = _binop("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binop("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _binop("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _binop("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _binop("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _binop("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _binop("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _binop("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _binop("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+logical_not = _unop("logical_not", jnp.logical_not)
+bitwise_and = _binop("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _binop("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _binop("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+bitwise_not = _unop("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = _binop("bitwise_left_shift", lambda x, y: jnp.left_shift(x, y))
+bitwise_right_shift = _binop("bitwise_right_shift", lambda x, y: jnp.right_shift(x, y))
+
+
+def equal_all(x, y, name=None):
+    return eager_apply("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y), {})
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return eager_apply("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return eager_apply("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+
+
+# ---- matmul family (linalg has the rest) ----
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return eager_apply("matmul", fn, (x, y), {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return eager_apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y), {})
+
+
+def inverse(x, name=None):
+    return eager_apply("inverse", jnp.linalg.inv, (x,), {})
+
+
+# ---- in-place variants (eager only; adopt functional result) ----
+
+def _make_inplace(op):
+    def inplace(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        x._data = out._data
+        x._grad_node = out._grad_node
+        x._output_slot = out._output_slot
+        x.stop_gradient = out.stop_gradient
+        return x
+    inplace.__name__ = op.__name__ + "_"
+    return inplace
+
+
+add_ = _make_inplace(add)
+subtract_ = _make_inplace(subtract)
+multiply_ = _make_inplace(multiply)
+scale_ = _make_inplace(scale)
+clip_ = _make_inplace(clip)
+floor_ = _make_inplace(floor)
+ceil_ = _make_inplace(ceil)
+exp_ = _make_inplace(exp)
+sqrt_ = _make_inplace(sqrt)
+rsqrt_ = _make_inplace(rsqrt)
+reciprocal_ = _make_inplace(reciprocal)
+round_ = _make_inplace(round)
+tanh_ = _make_inplace(tanh)
+
+
+def zero_(x):
+    return x._inplace_update(jnp.zeros_like(x._data))
+
+
+def fill_(x, value):
+    return x._inplace_update(jnp.full_like(x._data, value))
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace_update(x._data + value)

@@ -1,0 +1,127 @@
+"""Random sampling ops (analog of python/paddle/tensor/random.py).
+
+Eager random ops consume keys from the global RNG state
+(paddle_tpu.core.random); under program capture (paddle_tpu.jit) the key is
+threaded as an input so compiled programs stay pure and reproducible.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _rng
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+from .creation import _shape
+
+
+def _key():
+    return _rng.next_key()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(_key(), sh))
+    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape or [1]), jnp.float32))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or "float32"),
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    return x._inplace_update(
+        jax.random.uniform(_key(), x._data.shape, jnp.result_type(x._data), min, max))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._inplace_update(
+        (mean + std * jax.random.normal(_key(), x._data.shape)).astype(jnp.result_type(x._data)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high, to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), x._data.shape, low, high,
+                                     to_jax_dtype(dtype) if dtype else jnp.result_type(x._data)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), int(n)).astype(to_jax_dtype(dtype)))
+
+
+def shuffle(x, name=None):
+    return Tensor(jax.random.permutation(_key(), x._data, axis=0))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1,
+                                     shape=(*logits.shape[:-1], num_samples))
+    else:
+        k = _key()
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, logits.shape)
+        out = jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int32))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(_key(), np.clip(np.asarray(x._data), 0, 1)).astype(jnp.result_type(x._data)))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    return x._inplace_update(jax.random.bernoulli(_key(), p, x._data.shape).astype(jnp.result_type(x._data)))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_key(), x._data).astype(jnp.result_type(x._data)))
+
+
+def binomial(count, prob, name=None):
+    c = count._data if isinstance(count, Tensor) else count
+    p = prob._data if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(_key(), c, p).astype(jnp.int32))
+
+
+def exponential_(x, lam=1.0, name=None):
+    return x._inplace_update(
+        (jax.random.exponential(_key(), x._data.shape) / lam).astype(jnp.result_type(x._data)))
+
+
+def rand_like(x, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_key(), x._data.shape,
+                                     to_jax_dtype(dtype) if dtype else jnp.result_type(x._data)))
+
+
+def randn_like(x, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), x._data.shape,
+                                    to_jax_dtype(dtype) if dtype else jnp.result_type(x._data)))

@@ -1,0 +1,155 @@
+"""Search/sort ops (analog of python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import eager_apply
+
+
+def _ax(axis):
+    return None if axis is None else int(axis)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmax(a.reshape(-1) if axis is None else a, axis=_ax(axis) or 0 if axis is not None else None)
+        if axis is not None and keepdim:
+            out = jnp.expand_dims(out, _ax(axis))
+        return out.astype(jnp.int32)
+    return eager_apply("argmax", fn, (x,), {})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        out = jnp.argmin(a.reshape(-1) if axis is None else a, axis=_ax(axis) if axis is not None else None)
+        if axis is not None and keepdim:
+            out = jnp.expand_dims(out, _ax(axis))
+        return out.astype(jnp.int32)
+    return eager_apply("argmin", fn, (x,), {})
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=_ax(axis), stable=stable, descending=descending)
+        return idx.astype(jnp.int32)
+    return eager_apply("argsort", fn, (x,), {})
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=_ax(axis), stable=stable, descending=descending)
+        return out
+    return eager_apply("sort", fn, (x,), {})
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def fn(a):
+        ax = _ax(axis) if axis is not None else -1
+        a_moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax_topk(a_moved, k)
+        else:
+            vals, idx = jax_topk(-a_moved, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
+
+    return eager_apply("topk", fn, (x,), {})
+
+
+def jax_topk(a, k):
+    import jax.lax as lax
+    return lax.top_k(a, k)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = _ax(axis)
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax, stable=True)
+        vals = jnp.take(srt, k - 1, axis=ax)
+        inds = jnp.take(idx, k - 1, axis=ax).astype(jnp.int32)
+        if keepdim:
+            vals, inds = jnp.expand_dims(vals, ax), jnp.expand_dims(inds, ax)
+        return vals, inds
+    return eager_apply("kthvalue", fn, (x,), {})
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = _ax(axis) % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        srt = jnp.sort(moved, axis=-1)
+        n = srt.shape[-1]
+        # run-length: count occurrences of each sorted value
+        eq = (srt[..., :, None] == srt[..., None, :])
+        counts = eq.sum(-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+        # index of last occurrence in original order
+        match = (moved == vals[..., None])
+        idx = (n - 1) - jnp.argmax(jnp.flip(match, -1), axis=-1)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int32)
+    return eager_apply("mode", fn, (x,), {})
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            import jax
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int32)
+    return eager_apply("searchsorted", fn, (sorted_sequence, values), {})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    def fn(a, s):
+        out = jnp.searchsorted(s, a, side="right" if right else "left")
+        return out.astype(jnp.int32 if out_int32 else jnp.int32)
+    return eager_apply("bucketize", fn, (x, sorted_sequence), {})
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def fn(a):
+        lo, hi = (float(min), float(max))
+        if lo == 0 and hi == 0:
+            lo, hi = float(a.min()), float(a.max())
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
+                             weights=weight._data.reshape(-1) if weight is not None else None,
+                             density=density)
+        return h if density else h.astype(jnp.int32)
+    return eager_apply("histogram", fn, (input,), {})
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    import numpy as np
+    h, edges = np.histogramdd(np.asarray(x._data), bins=bins, range=ranges,
+                              density=density,
+                              weights=np.asarray(weights._data) if weights is not None else None)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    import numpy as np
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(a, i):
+        import builtins
+        idx = [builtins.slice(None)] * a.ndim
+        idx[int(axis)] = i
+        v = value._data if isinstance(value, Tensor) else value
+        return a.at[tuple(idx)].set(v)
+    return eager_apply("index_fill", fn, (x, index), {})

@@ -1,0 +1,61 @@
+"""Statistics ops (analog of python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return eager_apply("std", lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                                keepdims=keepdim), (x,), {})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return eager_apply("var", lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                                keepdims=keepdim), (x,), {})
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+        # mode='min': lower of the two middle values + its index
+        ax = _ax(axis)
+        arr = a.reshape(-1) if ax is None else a
+        ax2 = 0 if ax is None else ax
+        n = arr.shape[ax2]
+        k = (n - 1) // 2
+        srt = jnp.sort(arr, axis=ax2)
+        vals = jnp.take(srt, k, axis=ax2)
+        if keepdim and ax is not None:
+            vals = jnp.expand_dims(vals, ax2)
+        return vals
+    return eager_apply("median", fn, (x,), {})
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return eager_apply("nanmedian",
+                       lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim), (x,), {})
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(a):
+        qs = jnp.asarray(q)
+        return jnp.quantile(a, qs, axis=_ax(axis), keepdims=keepdim, method=interpolation)
+    return eager_apply("quantile", fn, (x,), {})
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    def fn(a):
+        return jnp.nanquantile(a, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim,
+                               method=interpolation)
+    return eager_apply("nanquantile", fn, (x,), {})

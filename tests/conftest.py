@@ -1,0 +1,28 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): "multi-node" testing is
+multi-device single-host; the XLA-CPU 8-device stand-in plays the role the
+reference gives loopback NCCL.
+"""
+import os
+
+# Must be set before jax initializes.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", ""))
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Numeric tests compare against float32 numpy; the default matmul precision on
+# this stack is TPU-like (bf16 passes), so pin highest precision for testing.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,176 @@
+"""Autograd engine tests: analytic grads vs jax.grad and finite differences.
+
+Models the reference's OpTest.check_grad strategy
+(reference: test/legacy_test/op_test.py:3075 numeric-vs-analytic comparison).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn at numpy array x."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f1 = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        f2 = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (f1 - f2) / (2 * eps)
+    return g
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x + 2 * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0, 8.0], rtol=1e-6)
+
+
+def test_matmul_grad_vs_jax():
+    xn = np.random.randn(4, 5).astype(np.float32)
+    wn = np.random.randn(5, 3).astype(np.float32)
+    x = paddle.to_tensor(xn, stop_gradient=False)
+    w = paddle.to_tensor(wn, stop_gradient=False)
+    loss = paddle.matmul(x, w).tanh().mean()
+    loss.backward()
+
+    f = lambda a, b: jnp.tanh(a @ b).mean()
+    ga, gb = jax.grad(f, argnums=(0, 1))(xn, wn)
+    np.testing.assert_allclose(x.grad.numpy(), ga, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w.grad.numpy(), gb, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_shared_leaf():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3 + x * 4  # x used twice
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0], rtol=1e-6)
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(np.random.randn(3).astype(np.float32), stop_gradient=False)
+    a = x * 2
+    b = a.exp()
+    c = a.sin()
+    loss = (b + c).sum()
+    loss.backward()
+    expected = jax.grad(lambda v: (jnp.exp(v * 2) + jnp.sin(v * 2)).sum())(
+        jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(x.grad.numpy(), expected, rtol=1e-5)
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=False)
+    # new graph needed; reusing freed graph raises
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)  # 6 + 6
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x.detach() * 3
+    assert y.stop_gradient
+    z = x * 2 + y
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_paddle_grad_api_leaf_and_intermediate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * x
+    y = (h * 3).sum()
+    (gx,) = paddle.grad(y, x, retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [6.0, 12.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+    (gh,) = paddle.grad(y, h)
+    np.testing.assert_allclose(gh.numpy(), [3.0, 3.0])
+
+
+def test_register_hook_leaf():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_register_hook_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x * 2
+    h.register_hook(lambda g: g * 5)
+    (h * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [30.0])
+
+
+def test_retain_grads_intermediate():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    h = x * 3
+    h.retain_grads()
+    (h * h).sum().backward()
+    np.testing.assert_allclose(h.grad.numpy(), [12.0])
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+def test_numeric_grad_check():
+    xn = np.random.randn(3, 3).astype(np.float64)
+
+    def f(a):
+        return float(np.sum(np.tanh(a @ a.T)))
+
+    x = paddle.to_tensor(xn.astype(np.float32), stop_gradient=False)
+    y = paddle.matmul(x, x.t())
+    # use paddle path
+    loss = y.tanh().sum()
+    loss.backward()
+    ng = numeric_grad(f, xn.copy())
+    np.testing.assert_allclose(x.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2, axis=1)
+    vals.sum().backward()
+    # grad is one at top-2 positions, zero elsewhere
+    g = x.grad.numpy()
+    assert g.sum() == pytest.approx(8.0)
+    assert ((g == 0) | (g == 1)).all()
+
+
+def test_setitem_grad_flow():
+    x = paddle.to_tensor(np.ones((3,), np.float32), stop_gradient=False)
+    y = x * 2
+    y[1] = 7.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])

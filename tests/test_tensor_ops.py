@@ -1,0 +1,261 @@
+"""Tensor op surface tests vs numpy (OpTest.check_output analog,
+reference: test/legacy_test/op_test.py:2143)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int32").numpy().tolist() == [1, 1]
+        np.testing.assert_allclose(paddle.full([2], 3.5).numpy(), [3.5, 3.5])
+        np.testing.assert_allclose(paddle.arange(1, 7, 2).numpy(), [1, 3, 5])
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        assert paddle.eye(3).numpy()[1, 1] == 1
+
+    def test_like(self):
+        x = t(np.random.randn(2, 3).astype(np.float32))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.ones_like(x).numpy().sum() == 6
+        assert paddle.full_like(x, 2).numpy().sum() == 12
+
+    def test_tri(self):
+        x = t(np.ones((3, 3), np.float32))
+        assert paddle.tril(x).numpy().sum() == 6
+        assert paddle.triu(x, 1).numpy().sum() == 3
+
+    def test_one_hot(self):
+        out = paddle.nn_functional_one_hot_check = paddle.tensor.creation.one_hot(t(np.array([0, 2])), 3)
+        np.testing.assert_allclose(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+
+class TestMath:
+    def test_binary(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        x, y = t(a), t(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x - y).numpy(), a - b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x / y).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(x, y).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose((x ** 2).numpy(), a ** 2, rtol=1e-5)
+        np.testing.assert_allclose((2 + x).numpy(), 2 + a, rtol=1e-6)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - a, rtol=1e-6)
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        x = t(a)
+        for pname, nfn in [("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+                           ("abs", np.abs), ("sin", np.sin), ("tanh", np.tanh),
+                           ("floor", np.floor), ("ceil", np.ceil), ("square", np.square)]:
+            np.testing.assert_allclose(getattr(paddle, pname)(x).numpy(), nfn(a),
+                                       rtol=2e-4, atol=1e-5, err_msg=pname)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(paddle.sum(x).numpy(), a.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(x, axis=1).numpy(), a.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(x, axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(paddle.sum(x, axis=-1, keepdim=True).numpy(),
+                                   a.sum(-1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(x).numpy(),
+                                   np.log(np.exp(a).sum()), rtol=1e-4)
+
+    def test_cumulative(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(paddle.cumsum(x, axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.cumprod(x, dim=0).numpy(), a.cumprod(0), rtol=1e-5)
+
+    def test_clip_scale(self):
+        a = np.random.randn(10).astype(np.float32)
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(), a.clip(-0.5, 0.5))
+        np.testing.assert_allclose(paddle.scale(t(a), 2.0, 1.0).numpy(), a * 2 + 1, rtol=1e-6)
+
+    def test_comparison(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert (t(a) < t(b)).numpy().tolist() == [True, False, False]
+        assert (t(a) == t(b)).numpy().tolist() == [False, True, False]
+        assert paddle.equal_all(t(a), t(a)).numpy()
+
+    def test_matmul_variants(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.bmm(t(a), t(b)).numpy(), a @ b, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)), transpose_y=True).numpy(),
+            a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_inplace(self):
+        x = t(np.array([1.0, 2.0], np.float32))
+        x.add_(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+        x.scale_(2.0)
+        np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+
+
+class TestManipulation:
+    def test_reshape_family(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        x = t(a)
+        assert paddle.reshape(x, [4, 6]).shape == [4, 6]
+        assert paddle.reshape(x, [-1, 8]).shape == [3, 8]
+        assert paddle.flatten(x, 1, 2).shape == [2, 12]
+        assert paddle.squeeze(paddle.unsqueeze(x, 0), 0).shape == [2, 3, 4]
+        assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+
+    def test_concat_split(self):
+        a = np.random.randn(4, 6).astype(np.float32)
+        x = t(a)
+        parts = paddle.split(x, 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [4, 2]
+        back = paddle.concat(parts, axis=1)
+        np.testing.assert_allclose(back.numpy(), a)
+        parts2 = paddle.split(x, [2, -1], axis=1)
+        assert parts2[1].shape == [4, 4]
+        st = paddle.stack([x, x], axis=0)
+        assert st.shape == [2, 4, 6]
+        assert len(paddle.unbind(x, 0)) == 4
+
+    def test_tile_expand(self):
+        x = t(np.array([[1.0, 2.0]], np.float32))
+        assert paddle.tile(x, [2, 3]).shape == [2, 6]
+        assert paddle.expand(x, [4, 2]).shape == [4, 2]
+        assert paddle.broadcast_to(x, [3, 2]).shape == [3, 2]
+
+    def test_gather_scatter(self):
+        a = np.random.randn(5, 3).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(paddle.gather(x, t(np.array([0, 2])), axis=0).numpy(), a[[0, 2]])
+        idx = t(np.array([[0, 0], [2, 1]]))
+        np.testing.assert_allclose(paddle.gather_nd(x, idx).numpy(), a[[0, 2], [0, 1]])
+        upd = t(np.ones((2, 3), np.float32))
+        out = paddle.scatter(x, t(np.array([1, 3])), upd)
+        np.testing.assert_allclose(out.numpy()[[1, 3]], np.ones((2, 3)))
+
+    def test_pad(self):
+        x = t(np.ones((1, 1, 2, 2), np.float32))
+        out = paddle.tensor.manipulation.pad(x, [1, 1, 1, 1])
+        assert out.shape == [1, 1, 4, 4]
+        assert out.numpy().sum() == 4
+
+    def test_where_nonzero(self):
+        a = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        x = t(a)
+        out = paddle.where(x > 0, x, paddle.zeros_like(x) - 1)
+        np.testing.assert_allclose(out.numpy(), [[1, -1], [-1, 2]])
+        nz = paddle.nonzero(x)
+        assert nz.numpy().tolist() == [[0, 0], [1, 1]]
+
+    def test_indexing(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        x = t(a)
+        np.testing.assert_allclose(x[1].numpy(), a[1])
+        np.testing.assert_allclose(x[:, 1:3].numpy(), a[:, 1:3])
+        np.testing.assert_allclose(x[t(np.array([0, 2]))].numpy(), a[[0, 2]])
+        x[0, 0] = 99.0
+        assert x.numpy()[0, 0] == 99.0
+
+    def test_take_put_along_axis(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        i = np.argsort(a, axis=1)
+        np.testing.assert_allclose(
+            paddle.take_along_axis(t(a), t(i), 1).numpy(), np.take_along_axis(a, i, 1))
+
+
+class TestLinalgSearch:
+    def test_linalg(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        np.testing.assert_allclose(paddle.tensor.linalg.det(t(spd)).numpy(),
+                                   np.linalg.det(spd), rtol=1e-4)
+        np.testing.assert_allclose(paddle.inverse(t(spd)).numpy(),
+                                   np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+        L = paddle.tensor.linalg.cholesky(t(spd))
+        np.testing.assert_allclose((L.numpy() @ L.numpy().T), spd, rtol=1e-4, atol=1e-4)
+        u, s, v = paddle.tensor.linalg.svd(t(a))
+        np.testing.assert_allclose(u.numpy() @ np.diag(s.numpy()) @ v.numpy().T, a,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_norms(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.tensor.linalg.norm(t(a)).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.tensor.linalg.norm(t(a), p=1, axis=1).numpy(),
+                                   np.abs(a).sum(1), rtol=1e-5)
+
+    def test_sort_search(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        x = t(a)
+        np.testing.assert_allclose(paddle.sort(x, axis=1).numpy(), np.sort(a, 1))
+        np.testing.assert_allclose(paddle.argsort(x, axis=1).numpy(), np.argsort(a, 1))
+        vals, idx = paddle.topk(x, 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-a, 1)[:, :3])
+        assert paddle.argmax(x).numpy() == a.argmax()
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_unique_masked(self):
+        a = np.array([1, 3, 1, 2], np.int32)
+        assert paddle.tensor.manipulation.unique(t(a)).numpy().tolist() == [1, 2, 3]
+        m = np.array([True, False, True, False])
+        out = paddle.masked_select(t(a.astype(np.float32)), t(m))
+        assert out.numpy().tolist() == [1.0, 1.0]
+
+
+class TestRandomStat:
+    def test_random_shapes(self):
+        assert paddle.rand([2, 3]).shape == [2, 3]
+        assert paddle.randn([4]).shape == [4]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.rand([5]).numpy()
+        paddle.seed(7)
+        b = paddle.rand([5]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_stat(self):
+        a = np.random.randn(50).astype(np.float32)
+        np.testing.assert_allclose(paddle.tensor.stat.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.tensor.stat.median(t(a)).numpy(), np.median(a), rtol=1e-5)
+        np.testing.assert_allclose(paddle.tensor.stat.quantile(t(a), 0.3).numpy(),
+                                   np.quantile(a, 0.3), rtol=1e-4)
+
+
+class TestDtypePlace:
+    def test_cast(self):
+        x = t(np.array([1.7, 2.3], np.float32))
+        assert x.astype("int32").numpy().tolist() == [1, 2]
+        assert x.astype(paddle.bool).numpy().tolist() == [True, True]
+        assert x.astype("bfloat16").dtype == paddle.bfloat16
+
+    def test_item_and_shape(self):
+        x = t(np.array(3.5, np.float32))
+        assert x.item() == pytest.approx(3.5)
+        assert x.ndim == 0 and x.size == 1
+
+    def test_save_load(self, tmp_path):
+        x = {"w": t(np.random.randn(3).astype(np.float32)), "step": 5}
+        p = str(tmp_path / "ckpt.pdparams")
+        paddle.save(x, p)
+        y = paddle.load(p)
+        np.testing.assert_allclose(y["w"].numpy(), x["w"].numpy())
+        assert y["step"] == 5
