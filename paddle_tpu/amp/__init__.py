@@ -1,1 +1,11 @@
-"""placeholder — filled in by later milestones"""
+"""paddle_tpu.amp — automatic mixed precision (analog of python/paddle/amp/).
+
+O1 = list-based autocast at op dispatch (the reference injects this into
+generated ad_funcs, eager_gen.py:652; here it lives in core.dispatch).
+O2 = cast the whole model to bf16/fp16 with fp32 master weights in the
+optimizer (our optimizers already keep fp32 moments and do fp32 math).
+On TPU the natural compute dtype is bfloat16 — no loss scaling needed — but
+``GradScaler`` is provided for API parity and for float16.
+"""
+from .auto_cast import auto_cast, amp_guard, decorate, amp_state, WHITE_LIST, BLACK_LIST  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401

@@ -1,0 +1,107 @@
+"""Autocast (analog of python/paddle/amp/auto_cast.py:462 amp_guard and
+amp_lists; the op lists mirror paddle/fluid/imperative/amp_auto_cast.cc).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+
+# MXU-bound ops: always worth computing in low precision.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention", "lstm_scan", "rnn_scan",
+    "lstm_cell", "gru_cell", "simple_rnn_cell",
+}
+
+# Numerically sensitive ops: keep fp32.
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "softmax",
+    "log_softmax", "cross_entropy", "bce", "bce_with_logits", "nll_loss",
+    "mse_loss", "l1_loss", "smooth_l1_loss", "kl_div", "layer_norm",
+    "batch_norm", "group_norm", "instance_norm", "rms_norm", "norm",
+    "logsumexp", "cumsum", "cumprod", "softmax_with_cross_entropy", "pow",
+    "rsqrt", "sqrt", "divide", "ctc_loss", "sigmoid_focal_loss",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+class auto_cast:
+    """``paddle.amp.auto_cast`` context manager."""
+
+    def __init__(self, enable=True, custom_white_list=None, custom_black_list=None,
+                 level="O1", dtype="bfloat16", use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = to_jax_dtype(dtype)
+        self.white = WHITE_LIST | set(custom_white_list or ())
+        self.black = (BLACK_LIST - set(custom_white_list or ())) | set(custom_black_list or ())
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.white = self.white
+        _state.black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level, _state.white, _state.black) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2 decoration: cast model params to the AMP dtype
+    (reference: python/paddle/amp/auto_cast.py amp_decorate). Optimizer state
+    stays fp32 (master weights) by construction in paddle_tpu.optimizer."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_params(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def amp_cast_inputs(op_name, flat_vals):
+    """Called from core.dispatch on every eager op when AMP is on."""
+    if not _state.enabled:
+        return flat_vals
+    if op_name in _state.white:
+        tgt = _state.dtype
+    elif op_name in _state.black:
+        tgt = jnp.float32
+    else:
+        return flat_vals
+    out = []
+    for v in flat_vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(jnp.result_type(v), jnp.floating) \
+                and jnp.result_type(v) != jnp.dtype(tgt):
+            out.append(v.astype(tgt))
+        else:
+            out.append(v)
+    return out

@@ -30,6 +30,13 @@ def _is_diff_array(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 
 
+def _maybe_amp_cast(name, vals):
+    from ..amp.auto_cast import _state as _amp_state, amp_cast_inputs
+    if not _amp_state.enabled:
+        return vals
+    return amp_cast_inputs(name, vals)
+
+
 def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
     """Execute ``pure_fn`` over a mixed Tensor/array argument tree.
 
@@ -44,6 +51,7 @@ def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
 
     if not record:
         vals = [x._data if isinstance(x, Tensor) else x for x in flat]
+        vals = _maybe_amp_cast(name, vals)
         a, kw = jax.tree.unflatten(treedef, vals)
         out = pure_fn(*a, **kw)
         return _wrap_outputs(name, out, stop_gradient=True)
@@ -59,6 +67,9 @@ def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
         vals = list(base_vals)
         for i, p in zip(diff_idx, primals):
             vals[i] = p
+        # AMP cast inside the traced fn so AD differentiates through it
+        # (the reference casts in the generated ad_func, eager_gen.py:652).
+        vals = _maybe_amp_cast(name, vals)
         a, kw = jax.tree.unflatten(treedef, vals)
         return pure_fn(*a, **kw)
 

@@ -19,6 +19,7 @@ class _RNGState(threading.local):
         self.seed = 0
         self.counter = 0
         self.key = jax.random.key(0)
+        self.capture_key = None  # set by paddle_tpu.jit during tracing
 
 
 _state = _RNGState()
@@ -32,9 +33,31 @@ def seed(s: int):
 
 
 def next_key():
-    k = jax.random.fold_in(_state.key, _state.counter)
+    if _state.capture_key is not None:
+        # under program capture: derive from the traced key input so every
+        # compiled invocation gets fresh randomness
+        k = jax.random.fold_in(_state.capture_key, _state.counter)
+    else:
+        k = jax.random.fold_in(_state.key, _state.counter)
     _state.counter += 1
     return k
+
+
+class capture_rng:
+    """Context manager installing a traced base key during jit capture."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        self._saved = (_state.capture_key, _state.counter)
+        _state.capture_key = self.key
+        _state.counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        _state.capture_key, _state.counter = self._saved
+        return False
 
 
 def get_rng_state():

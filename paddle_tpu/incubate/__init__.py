@@ -1,1 +1,1 @@
-"""placeholder — filled in by later milestones"""
+"""paddle_tpu.incubate — staging ground for experimental APIs (analog of python/paddle/incubate/)."""

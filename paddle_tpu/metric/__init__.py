@@ -1,1 +1,151 @@
-"""placeholder — filled in by later milestones"""
+"""paddle_tpu.metric (analog of python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred_np = pred.numpy() if isinstance(pred, Tensor) else np.asarray(pred)
+        label_np = label.numpy() if isinstance(label, Tensor) else np.asarray(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        maxk = max(self.topk)
+        top = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        correct = top == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct):
+        c = correct.numpy() if isinstance(correct, Tensor) else np.asarray(correct)
+        n = c.shape[0] if c.ndim > 0 else 1
+        for i, k in enumerate(self.topk):
+            self.total[i] += c[..., :k].sum()
+            self.count[i] += n
+        return self.accumulate()
+
+    def accumulate(self):
+        acc = np.where(self.count > 0, self.total / np.maximum(self.count, 1), 0.0)
+        return acc[0] if len(self.topk) == 1 else list(acc)
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)).round()
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)).round()
+        l = labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = preds.numpy() if isinstance(preds, Tensor) else np.asarray(preds)
+        l = (labels.numpy() if isinstance(labels, Tensor) else np.asarray(labels)).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        idx = np.clip((p * self.num_thresholds).astype(int), 0, self.num_thresholds)
+        pos_mask = l.astype(bool)
+        self._stat_pos += np.bincount(idx[pos_mask], minlength=self.num_thresholds + 1)
+        self._stat_neg += np.bincount(idx[~pos_mask], minlength=self.num_thresholds + 1)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if not tot_pos or not tot_neg:
+            return 0.0
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = pos + self._stat_pos[i]
+            new_neg = neg + self._stat_neg[i]
+            area += (new_neg - neg) * (pos + new_pos) / 2
+            pos, neg = new_pos, new_neg
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    p = input.numpy()
+    l = label.numpy().reshape(-1)
+    top = np.argsort(-p, axis=-1)[:, :k]
+    c = (top == l[:, None]).any(-1).mean()
+    return Tensor(np.asarray(c, np.float32))

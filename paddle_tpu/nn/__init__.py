@@ -1,1 +1,46 @@
-"""placeholder — filled in by later milestones"""
+"""paddle_tpu.nn (analog of python/paddle/nn/)."""
+from .layer.layers import Layer, Parameter, ParamAttr  # noqa: F401
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding, Flatten,
+    Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
+    PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    CosineSimilarity, PairwiseDistance, Bilinear, Unfold, Fold,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool1D, LPPool2D,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LeakyReLU, ELU, CELU,
+    SELU, Hardtanh, Hardshrink, Softshrink, Hardsigmoid, Hardswish, Swish, Mish,
+    Silu, Softplus, Softsign, Tanhshrink, LogSigmoid, ThresholdedReLU, Maxout,
+    GLU, PReLU, RReLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU,
+)
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+
+from ..optimizer.clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401,E402

@@ -1,0 +1,31 @@
+"""paddle_tpu.nn.functional (analog of python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+    lp_pool1d, lp_pool2d,
+)
+from .norm import (  # noqa: F401
+    layer_norm, rms_norm, batch_norm, group_norm, instance_norm, normalize,
+    local_response_norm, spectral_norm,
+)
+from .loss import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, huber_loss, binary_cross_entropy,
+    binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
+    cosine_embedding_loss, triplet_margin_loss, hinge_embedding_loss,
+    square_error_cost, sigmoid_focal_loss, ctc_loss,
+)
+from .common import (  # noqa: F401
+    linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
+    label_smooth, interpolate, upsample, pixel_shuffle, pixel_unshuffle,
+    channel_shuffle, cosine_similarity, pairwise_distance, unfold, fold,
+    bilinear, zeropad2d, pad,
+)
+from .attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention, sequence_mask,
+)

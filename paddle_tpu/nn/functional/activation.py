@@ -1,0 +1,166 @@
+"""Activation functionals (analog of python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import eager_apply
+from ...core.tensor import Tensor
+
+
+def _un(name, fn):
+    def op(x, name=None):
+        return eager_apply(name, fn, (x,), {})
+    op.__name__ = name
+    op.pure = fn
+    return op
+
+
+relu = _un("relu", jax.nn.relu)
+relu6 = _un("relu6", jax.nn.relu6)
+sigmoid = _un("sigmoid", jax.nn.sigmoid)
+silu = _un("silu", jax.nn.silu)
+tanh = _un("tanh", jnp.tanh)
+softsign = _un("softsign", jax.nn.soft_sign)
+tanhshrink = _un("tanhshrink", lambda x: x - jnp.tanh(x))
+mish = _un("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+log_sigmoid = _un("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return eager_apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,), {})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return eager_apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (x,), {})
+
+
+def elu(x, alpha=1.0, name=None):
+    return eager_apply("elu", lambda a: jax.nn.elu(a, alpha), (x,), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    return eager_apply("celu", lambda a: jax.nn.celu(a, alpha), (x,), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return eager_apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,), {})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return eager_apply("hardtanh", lambda a: jnp.clip(a, min, max), (x,), {})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return eager_apply("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,), {})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return eager_apply("softshrink",
+                       lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), (x,), {})
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return eager_apply("hardsigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), (x,), {})
+
+
+def hardswish(x, name=None):
+    return eager_apply("hardswish", lambda a: a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), (x,), {})
+
+
+def swish(x, name=None):
+    return eager_apply("swish", jax.nn.silu, (x,), {})
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(a):
+        return jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta)
+    return eager_apply("softplus", fn, (x,), {})
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return eager_apply("thresholded_relu",
+                       lambda a: jnp.where(a > threshold, a, value), (x,), {})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+    return eager_apply("prelu", fn, (x, weight), {})
+
+
+def rrelu(x, lower=1 / 8, upper=1 / 3, training=True, name=None):
+    from ...core import random as _rng
+    if training:
+        def fn(a):
+            slope = jax.random.uniform(_rng.next_key(), a.shape, jnp.float32, lower, upper)
+            return jnp.where(a >= 0, a, a * slope.astype(a.dtype))
+        return eager_apply("rrelu", fn, (x,), {})
+    mid = (lower + upper) / 2
+    return eager_apply("rrelu", lambda a: jnp.where(a >= 0, a, a * mid), (x,), {})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core.dtype import to_jax_dtype
+            a = a.astype(to_jax_dtype(dtype))
+        return jax.nn.softmax(a, axis=int(axis))
+    return eager_apply("softmax", fn, (x,), {})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core.dtype import to_jax_dtype
+            a = a.astype(to_jax_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return eager_apply("log_softmax", fn, (x,), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as _rng
+
+    def fn(a):
+        g = jax.random.gumbel(_rng.next_key(), a.shape).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return eager_apply("gumbel_softmax", fn, (x,), {})
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return a.reshape(new_shape).max(axis=ax + 1)
+    return eager_apply("maxout", fn, (x,), {})
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return eager_apply("glu", fn, (x,), {})
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU (reference fused op: python/paddle/incubate/nn/functional/swiglu.py).
+
+    Overridable by the Pallas fused kernel (paddle_tpu/kernels)."""
+    if y is not None:
+        return eager_apply("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y), {})
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a1) * a2
+    return eager_apply("swiglu", fn, (x,), {})

@@ -1,0 +1,81 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` is the reference's
+python/paddle/nn/functional/flash_attention.py surface; the default body is
+the XLA softmax-attention composition (fuses well on TPU), and the Pallas
+flash-attention kernel (paddle_tpu/kernels/flash_attention.py) overrides it
+on TPU for long sequences (reference CUDA kernel:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import eager_apply, OPS
+from ...core.tensor import Tensor
+
+
+def _sdpa_reference(q, k, v, *rest, causal=False, dropout_p=0.0, scale=None,
+                    dropout_key=None):
+    """Pure attention body. q,k,v: [batch, seq, heads, head_dim] (paddle layout)."""
+    attn_mask = rest[0] if rest else None
+    qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)  # back to [b, s, h, d]
+
+
+OPS.setdefault("scaled_dot_product_attention", _sdpa_reference)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Paddle layout: [batch_size, seq_len, num_heads, head_dim]."""
+    from ...core import random as _rng
+    dk = _rng.next_key() if (dropout_p > 0.0 and training) else None
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return eager_apply(
+        "scaled_dot_product_attention",
+        lambda *xs: OPS["scaled_dot_product_attention"](
+            *xs, causal=is_causal, dropout_p=dropout_p if training else 0.0,
+            dropout_key=dk),
+        args, {})
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    """API parity with paddle.nn.functional.flash_attention.flash_attention."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import to_jax_dtype
+
+    def fn(lens):
+        m = maxlen if maxlen is not None else int(lens.max())
+        r = jnp.arange(m)
+        return (r[None, :] < lens[..., None]).astype(to_jax_dtype(dtype))
+    return eager_apply("sequence_mask", fn, (x,), {})

@@ -1,0 +1,234 @@
+"""Common functionals: linear, dropout, embedding, interpolate, etc.
+(analog of python/paddle/nn/functional/common.py + input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _rng
+from ...core.dispatch import eager_apply
+from ...core.tensor import Tensor
+from ...tensor.manipulation import pad as _pad  # re-export paddle.nn.functional.pad
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's weight layout [in_features, out_features]."""
+    if bias is None:
+        return eager_apply("linear", lambda a, w: a @ w, (x, weight), {})
+    return eager_apply("linear", lambda a, w, b: a @ w + b, (x, weight, bias), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _rng.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return eager_apply("dropout", fn, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = _rng.next_key()
+
+    def fn(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return (coef_a * jnp.where(keep, a, alpha_p) + coef_b).astype(a.dtype)
+
+    return eager_apply("alpha_dropout", fn, (x,), {})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup (reference: python/paddle/nn/functional/input.py:219).
+    ``sparse`` is accepted for API parity; on TPU gathers are dense."""
+    def fn(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return eager_apply("embedding", fn, (x, weight), {})
+
+
+def one_hot(x, num_classes, name=None):
+    return eager_apply("one_hot",
+                       lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), (x,), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(lbl, *maybe_prior):
+        n = lbl.shape[-1]
+        if maybe_prior:
+            return (1 - epsilon) * lbl + epsilon * maybe_prior[0]
+        return (1 - epsilon) * lbl + epsilon / n
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return eager_apply("label_smooth", fn, args, {})
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    channel_last = not data_format.startswith("NC")
+
+    def fn(a):
+        nd = a.ndim - 2
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        if size is not None:
+            tgt = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
+                        for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+            tgt = tuple(int(round(s * float(f))) for s, f in zip(spatial, sf))
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if channel_last:
+            new_shape = (a.shape[0],) + tgt + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + tgt
+        return jax.image.resize(a, new_shape, method=jmode)
+
+    return eager_apply("interpolate", fn, (x,), {})
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            a = a.reshape(n, oc, r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        a = a.reshape(n, h, w, r, r, oc)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, oc)
+
+    return eager_apply("pixel_shuffle", fn, (x,), {})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return eager_apply("pixel_unshuffle", fn, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return eager_apply("channel_shuffle", fn, (x,), {})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = (a * b).sum(axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return eager_apply("cosine_similarity", fn, (x1, x2), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        return jnp.linalg.norm(a - b + epsilon, ord=p, axis=-1, keepdims=keepdim)
+    return eager_apply("pairwise_distance", fn, (x, y), {})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: paddle/phi/kernels/impl/unfold_kernel_impl.h)."""
+    from jax import lax
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(a):
+        patches = lax.conv_general_dilated_patches(
+            a, filter_shape=tuple(k), window_strides=tuple(s),
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=tuple(d))
+        n, ckk, oh, ow = patches.shape
+        return patches.reshape(n, ckk, oh * ow)
+
+    return eager_apply("unfold", fn, (x,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im: scatter-add of patches back to the image."""
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    oh, ow = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        nh = (oh + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        nw = (ow + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + nh * s[0]:s[0], wj:wj + nw * s[1]:s[1]].add(a[:, :, i, j])
+        return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+    return eager_apply("fold", fn, (x,), {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *maybe_bias):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return eager_apply("bilinear", fn, tuple(args), {})
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return _pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+# paddle.nn.functional.pad is tensor.manipulation.pad
+pad = _pad

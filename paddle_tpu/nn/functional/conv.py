@@ -1,0 +1,178 @@
+"""Convolution functionals (analog of python/paddle/nn/functional/conv.py).
+
+Convs lower to ``lax.conv_general_dilated`` — XLA tiles them onto the MXU;
+the reference's cuDNN dispatch (paddle/phi/kernels/gpudnn/conv_kernel.cu)
+collapses to this single lowering.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import eager_apply
+
+
+def _pair(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _conv_padding(padding, nd, strides=None):
+    """Normalize paddle padding spec → lax padding list/str."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:  # [before0, after0, before1, after1, ...]
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        flat = [tuple(int(x) for x in p) for p in padding]
+        if len(flat) == nd + 2:  # includes N, C dims
+            flat = flat[2:]
+        return flat
+    raise ValueError(f"cannot parse padding {padding!r}")
+
+
+def _dn(nd, channel_last):
+    spatial = "DHW"[-nd:]
+    if channel_last:
+        lhs = "N" + spatial + "C"
+    else:
+        lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2), (lhs, rhs, lhs))
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _conv_padding(padding, nd)
+
+    def fn(a, w, *maybe_b):
+        dn = lax.conv_dimension_numbers(a.shape, w.shape, _dn_strings(nd, channel_last))
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager_apply(f"conv{nd}d", fn, args, {})
+
+
+def _dn_strings(nd, channel_last):
+    spatial = "DHW"[-nd:] if nd > 1 else "W"
+    if nd == 2:
+        spatial = "HW"
+    lhs = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs = "OI" + spatial
+    return (lhs, rhs, lhs)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1,
+                   "NLC" if data_format == "NLC" else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, nd, data_format, output_size=None):
+    """Transposed conv as an lhs-dilated conv with a flipped, axis-swapped
+    kernel — the exact gradient-of-conv formulation XLA optimizes well.
+    Verified numerically against torch.conv_transpose2d (incl. groups)."""
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    out_pad = _pair(output_padding, nd)
+    pad = _conv_padding(padding, nd)
+    if isinstance(pad, str):
+        if pad == "VALID":
+            pad = [(0, 0)] * nd
+        else:
+            raise NotImplementedError("SAME padding for conv_transpose")
+
+    def fn(a, w, *maybe_b):
+        k = [w.shape[2 + i] for i in range(nd)]
+        eff_pad = [
+            (dilation[i] * (k[i] - 1) - pad[i][0],
+             dilation[i] * (k[i] - 1) - pad[i][1] + out_pad[i])
+            for i in range(nd)
+        ]
+        flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
+        spatial = {1: "W", 2: "HW", 3: "DHW"}[nd]
+        lhs = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+        rhs = "OI" + spatial
+        ch_ax = -1 if channel_last else 1
+
+        def one_group(xi, wi):
+            wi = jnp.swapaxes(wi[flip], 0, 1)  # [in,out,*k] -> flipped [out,in,*k]
+            dn = lax.conv_dimension_numbers(xi.shape, wi.shape, (lhs, rhs, lhs))
+            return lax.conv_general_dilated(
+                xi, wi, window_strides=(1,) * nd, padding=eff_pad,
+                lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
+
+        if groups == 1:
+            out = one_group(a, w)
+        else:
+            xs = jnp.split(a, groups, axis=ch_ax)
+            ws = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate([one_group(xi, wi) for xi, wi in zip(xs, ws)],
+                                  axis=ch_ax)
+        if output_size is not None:
+            tgt = tuple(int(s) for s in output_size)
+            sl = [slice(None)] * out.ndim
+            for i in range(nd):
+                ax = (1 + i) if channel_last else (2 + i)
+                sl[ax] = slice(0, tgt[i])
+            out = out[tuple(sl)]
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[ch_ax] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return eager_apply(f"conv{nd}d_transpose", fn, args, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size)

@@ -1,0 +1,266 @@
+"""Loss functionals (analog of python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import eager_apply
+from ...core.tensor import Tensor
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    """Softmax cross entropy (reference: python/paddle/nn/functional/loss.py
+    cross_entropy; SPMD-parallel variant lives in distributed mp_layers)."""
+
+    def fn(logits, lbl, *maybe_w):
+        ax = axis % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30))
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl
+            if label_smoothing > 0:
+                n = logits.shape[ax]
+                soft = soft * (1 - label_smoothing) + label_smoothing / n
+            loss = -(soft * logp).sum(axis=ax)
+        else:
+            lbl_ = lbl
+            if lbl_.ndim == logits.ndim:  # trailing 1 dim
+                lbl_ = jnp.squeeze(lbl_, axis=ax)
+            n = logits.shape[ax]
+            valid = lbl_ != ignore_index
+            safe = jnp.where(valid, lbl_, 0).astype(jnp.int32)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+            picked = jnp.squeeze(picked, axis=ax)
+            if label_smoothing > 0:
+                smooth_loss = -logp.mean(axis=ax)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if maybe_w:
+                w = maybe_w[0][safe]
+                loss = loss * w
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = (jnp.sum(maybe_w[0][safe] * valid) if maybe_w
+                         else jnp.maximum(valid.sum(), 1))
+                return loss.sum() / denom
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return eager_apply("cross_entropy", fn, tuple(args), {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def fn(logp, lbl, *maybe_w):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else
+                                     jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        if maybe_w:
+            loss = loss * maybe_w[0][safe]
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(maybe_w[0][safe] * valid) if maybe_w else jnp.maximum(valid.sum(), 1)
+            return loss.sum() / denom
+        return _reduce_arr(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return eager_apply("nll_loss", fn, tuple(args), {})
+
+
+def _reduce_arr(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return eager_apply("mse_loss",
+                       lambda a, b: _reduce_arr(jnp.square(a - b), reduction), (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return eager_apply("l1_loss",
+                       lambda a, b: _reduce_arr(jnp.abs(a - b), reduction), (input, label), {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_arr(loss, reduction)
+    return eager_apply("smooth_l1_loss", fn, (input, label), {})
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    return smooth_l1_loss(input, label, reduction, delta)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce_arr(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return eager_apply("bce", fn, tuple(args), {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce_arr(loss, reduction)
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return eager_apply("bce_with_logits", fn, tuple(args), {})
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, q):
+        if log_target:
+            loss = jnp.exp(q) * (q - logp)
+        else:
+            loss = q * (jnp.log(jnp.maximum(q, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return loss.sum() / logp.shape[0]
+        return _reduce_arr(loss, reduction)
+    return eager_apply("kl_div", fn, (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        return _reduce_arr(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return eager_apply("margin_ranking_loss", fn, (input, other, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = (a * b).sum(-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_arr(loss, reduction)
+    return eager_apply("cosine_embedding_loss", fn, (input1, input2, label), {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce_arr(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return eager_apply("triplet_margin_loss", fn, (input, positive, negative), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_arr(loss, reduction)
+    return eager_apply("hinge_embedding_loss", fn, (input, label), {})
+
+
+def square_error_cost(input, label):
+    return eager_apply("square_error_cost", lambda a, b: jnp.square(a - b), (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce_arr(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return eager_apply("sigmoid_focal_loss", fn, tuple(args), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the dynamic-programming forward algorithm in pure lax
+    (reference: paddle/phi/kernels/gpu/warpctc_kernel.cu → here an XLA scan)."""
+    import jax.lax as lax
+
+    def fn(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-probs; lbl: [B, S]
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)  # blank-interleaved
+        L = 2 * S + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same_as_prev2 = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
+                                constant_values=True)
+
+        def step(alpha, lp_t):
+            a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+            a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+            a2 = jnp.where(same_as_prev2, neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new_alpha = merged + emit
+            return new_alpha, new_alpha
+
+        _, alphas = lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+        t_idx = (in_len - 1).astype(jnp.int32)
+        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+        end1 = 2 * lbl_len.astype(jnp.int32)
+        end2 = 2 * lbl_len.astype(jnp.int32) - 1
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0],
+            jnp.take_along_axis(final, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0])
+        loss = -ll
+        if reduction == "mean":
+            return (loss / jnp.maximum(lbl_len, 1)).mean()
+        return _reduce_arr(loss, reduction)
+
+    return eager_apply("ctc_loss", fn, (log_probs, labels, input_lengths, label_lengths), {})

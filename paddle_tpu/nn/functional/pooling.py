@@ -1,0 +1,200 @@
+"""Pooling functionals (analog of python/paddle/nn/functional/pooling.py).
+
+All pooling lowers to ``lax.reduce_window``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import eager_apply
+from .conv import _pair
+
+
+def _window(kernel, stride, padding, nd, channel_last):
+    k = _pair(kernel, nd)
+    s = _pair(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding, nd) if isinstance(padding, (int, list, tuple)) else padding
+        if isinstance(p, tuple) and len(p) == nd and all(isinstance(x, int) for x in p):
+            pad = [(x, x) for x in p]
+        elif isinstance(p, tuple) and len(p) == 2 * nd:
+            pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            pad = [(0, 0)] * nd
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        padding_full = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)] if not isinstance(pad, str) else pad
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        padding_full = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
+    return dims, strides, padding_full, k
+
+
+def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    dims, strides, pad, _ = _window(kernel, stride, padding, nd, channel_last)
+
+    def fn(a):
+        if isinstance(pad, str):
+            return lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min,
+                                     lax.max, dims, strides, pad)
+        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return lax.reduce_window(a, init, lax.max, dims, strides, pad)
+
+    out = eager_apply(f"max_pool{nd}d", fn, (x,), {})
+    if return_mask:
+        if nd != 2 or channel_last:
+            raise NotImplementedError("return_mask supported for NCHW max_pool2d only")
+        k = _pair(kernel, nd)
+        s = _pair(stride if stride is not None else kernel, nd)
+        p = _pair(padding, nd) if not isinstance(padding, str) else (0, 0)
+
+        def mask_fn(a):
+            n, c, h, w = a.shape
+            patches = lax.conv_general_dilated_patches(
+                a, filter_shape=k, window_strides=s,
+                padding=[(p[0], p[0]), (p[1], p[1])],
+                precision=None)  # [N, C*kh*kw, oh, ow]
+            oh, ow = patches.shape[2], patches.shape[3]
+            patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+            local = jnp.argmax(patches, axis=2)  # window-local flat idx
+            lr, lc = local // k[1], local % k[1]
+            oi = jnp.arange(oh).reshape(1, 1, oh, 1)
+            oj = jnp.arange(ow).reshape(1, 1, 1, ow)
+            gr = oi * s[0] - p[0] + lr
+            gc = oj * s[1] - p[1] + lc
+            return (gr * w + gc).astype(jnp.int32)
+
+        mask = eager_apply("max_pool2d_mask", mask_fn, (x,), {})
+        return out, mask
+    return out
+
+
+def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True, ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    dims, strides, pad, k = _window(kernel, stride, padding, nd, channel_last)
+
+    def fn(a):
+        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad)
+        if exclusive and not isinstance(pad, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+            return summed / counts
+        if isinstance(pad, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return eager_apply(f"avg_pool{nd}d", fn, (x,), {})
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 1, data_format, return_mask, ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 2, data_format, return_mask, ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, 3, data_format, return_mask, ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 1, data_format, exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format, exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format, exclusive, ceil_mode)
+
+
+def _adaptive_pool(x, output_size, nd, data_format, op):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sz = _pair(output_size, nd)
+
+    def fn(a):
+        spatial_off = 1 if channel_last else 2
+        res = a
+        for i in range(nd):
+            ax = spatial_off + i
+            in_sz = res.shape[ax]
+            o = out_sz[i] if out_sz[i] is not None else in_sz
+            if in_sz % o == 0:
+                # reshape trick: split axis into (o, in/o) and reduce
+                new_shape = res.shape[:ax] + (o, in_sz // o) + res.shape[ax + 1:]
+                res = res.reshape(new_shape)
+                res = (res.mean(axis=ax + 1) if op == "avg" else res.max(axis=ax + 1))
+            else:
+                # general case: gather per output index (torch-style bounds)
+                starts = (np.arange(o) * in_sz) // o
+                ends = -(-((np.arange(o) + 1) * in_sz) // o)
+                slices = [jnp.take(res, jnp.arange(s, e), axis=ax) for s, e in zip(starts, ends)]
+                red = [s.mean(axis=ax, keepdims=True) if op == "avg" else s.max(axis=ax, keepdims=True)
+                       for s in slices]
+                res = jnp.concatenate(red, axis=ax)
+        return res
+
+    return eager_apply(f"adaptive_{op}_pool{nd}d", fn, (x,), {})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    p = float(norm_type)
+
+    def fn(a):
+        dims, strides, pad, k = _window(kernel_size, stride, padding, 1, False)
+        s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
+        return s ** (1.0 / p)
+    return eager_apply("lp_pool1d", fn, (x,), {})
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def fn(a):
+        dims, strides, pad, k = _window(kernel_size, stride, padding, 2, False)
+        s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
+        return s ** (1.0 / p)
+    return eager_apply("lp_pool2d", fn, (x,), {})

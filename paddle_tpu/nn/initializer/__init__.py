@@ -1,0 +1,169 @@
+"""Weight initializers (analog of python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype, key) -> jnp.ndarray``; layers
+call them through ``Layer.create_parameter``. Fan computation follows the
+reference's XavierInitializer/MSRAInitializer conventions
+(reference: python/paddle/nn/initializer/xavier.py, kaiming.py).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as _rng
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(shape[0]) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+        fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype, key=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype, key=None):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        return (self.mean + self.std * jax.random.normal(key, shape)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        return (self.mean + self.std * jax.random.truncated_normal(
+            key, self.a, self.b, shape)).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        return jax.random.uniform(key, shape, jnp.float32, self.low, self.high).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in, self.slope = fan_in, negative_slope
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="leaky_relu"):
+        self.fan_in, self.slope = fan_in, negative_slope
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.slope ** 2))
+        std = gain / math.sqrt(fi)
+        return (std * jax.random.normal(key, shape)).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype, key=None):
+        from ...core.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        return jnp.asarray(np.asarray(v), dtype).reshape(shape)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype, key=None):
+        key = key if key is not None else _rng.next_key()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype, key=None):
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic * self.groups)):
+            out[(i, i % ic, *centers)] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+# paddle-style lowercase aliases
+constant_init = Constant
+normal_init = Normal
+
+__all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+           "XavierUniform", "XavierNormal", "KaimingUniform", "KaimingNormal",
+           "Assign", "Orthogonal", "Dirac"]

@@ -1,0 +1,351 @@
+"""The Layer base class.
+
+TPU-native analog of the reference's ``paddle.nn.Layer``
+(reference: python/paddle/nn/layer/layers.py:353): parameter/buffer/sublayer
+registries via ``__setattr__`` interception, hooks, state_dict, train/eval,
+dtype/device casting. Parameters are Tensors with ``stop_gradient=False``;
+the compiled path (paddle_tpu.jit) functionalizes a Layer by swapping
+parameter/buffer ``_data`` for tracers.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import to_jax_dtype
+from ...core.tensor import Tensor
+from .. import initializer as I
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (reference: EagerParamBase,
+    python/paddle/base/framework.py)."""
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.is_distributed = False
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"cannot interpret ParamAttr from {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, idx):
+        self._hooks, self._idx = hooks, idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    _global_hook_id = 0
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_non_persistable_buffer_names", set())
+        self.training = True
+        self._dtype = dtype
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._casted_dtype = None
+        self._name_scope = name_scope or type(self).__name__.lower()
+
+    # ---- registration ----
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            for d in (layers, buffers):
+                d and d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            for d in (params, buffers):
+                d and d.pop(name, None)
+            layers[name] = value
+        else:
+            for d in (params, layers, buffers):
+                d and d.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None:
+            self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Create + register-later parameter (caller assigns it to an attr).
+
+        Default init matches the reference: XavierUniform for weights,
+        Constant(0) for bias (python/paddle/nn/layer/layers.py create_parameter
+        + base/param_attr defaults).
+        """
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = to_jax_dtype(dtype or self._dtype)
+        init = attr.initializer or default_initializer or \
+            (I.Constant(0.0) if is_bias else I.XavierUniform())
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_tensor(self, name=None, dtype=None):
+        return Tensor(jnp.zeros([], to_jax_dtype(dtype or self._dtype)), name=name)
+
+    # ---- traversal ----
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None or id(sub) in layers_set:
+                continue
+            layers_set.add(id(sub))
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p, include_self=False, layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in [("", self)] + (
+                list(self.named_sublayers()) if include_sublayers else []):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = ".".join(x for x in (prefix, layer_prefix, name) if x)
+                yield full, p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in [("", self)] + (
+                list(self.named_sublayers()) if include_sublayers else []):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                full = ".".join(x for x in (prefix, layer_prefix, name) if x)
+                yield full, b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ---- modes ----
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ---- hooks ----
+    def register_forward_pre_hook(self, hook):
+        Layer._global_hook_id += 1
+        self._forward_pre_hooks[Layer._global_hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, Layer._global_hook_id)
+
+    def register_forward_post_hook(self, hook):
+        Layer._global_hook_id += 1
+        self._forward_post_hooks[Layer._global_hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, Layer._global_hook_id)
+
+    # ---- call ----
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}({self.extra_repr()}"]
+        subs = list(self._sub_layers.items())
+        if not subs:
+            return lines[0] + ")"
+        for name, sub in subs:
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+    # ---- state dict ----
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True,
+                   keep_vars=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        non_persist = set()
+        for layer_prefix, layer in [("", self)] + list(self.named_sublayers()):
+            for bname in layer._non_persistable_buffer_names:
+                full = ".".join(x for x in (layer_prefix, bname) if x)
+                non_persist.add(full)
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            if name not in non_persist:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                data = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+                target._inplace_update(data.astype(jnp.result_type(target._data)).reshape(target._data.shape))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ---- casting ----
+    def _cast_params(self, dtype=None, device=None, blocking=True, include_buffers=True):
+        dev = device.jax_device() if hasattr(device, "jax_device") else None
+        items = list(self.named_parameters()) + (list(self.named_buffers()) if include_buffers else [])
+        for _, t in items:
+            data = t._data
+            if dtype is not None and jnp.issubdtype(jnp.result_type(data), jnp.floating):
+                data = data.astype(to_jax_dtype(dtype))
+            if dev is not None:
+                data = jax.device_put(data, dev)
+            t._inplace_update(data)
+        if dtype is not None:
+            for l in self.sublayers(include_self=True):
+                l._dtype = dtype if isinstance(dtype, str) else str(jnp.dtype(to_jax_dtype(dtype)))
+        return self
+
+    def to(self, device=None, dtype=None, blocking=True):
+        from ...core.place import Place, _parse
+        if isinstance(device, str) and device is not None:
+            device = _parse(device)
+        return self._cast_params(dtype=dtype, device=device)
+
+    def astype(self, dtype):
+        return self._cast_params(dtype=dtype)
+
+    def float(self):
+        return self._cast_params(dtype="float32")
+
+    def half(self):
+        return self._cast_params(dtype="float16")
+
+    def bfloat16(self):
+        return self._cast_params(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def full_name(self):
+        return self._name_scope

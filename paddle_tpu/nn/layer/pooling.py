@@ -1,0 +1,91 @@
+"""Pooling layers (analog of python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+
+
+def _make_pool(name, fn_name, nd, has_stride=True):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0, **kwargs):
+            super().__init__()
+            self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+            self.kwargs = {k: v for k, v in kwargs.items() if k != "name"}
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, self.kernel_size, self.stride, self.padding,
+                                       **self.kwargs)
+
+        def extra_repr(self):
+            return f"kernel_size={self.kernel_size}, stride={self.stride}"
+
+    _Pool.__name__ = name
+    return _Pool
+
+
+MaxPool1D = _make_pool("MaxPool1D", "max_pool1d", 1)
+MaxPool2D = _make_pool("MaxPool2D", "max_pool2d", 2)
+MaxPool3D = _make_pool("MaxPool3D", "max_pool3d", 3)
+AvgPool1D = _make_pool("AvgPool1D", "avg_pool1d", 1)
+AvgPool2D = _make_pool("AvgPool2D", "avg_pool2d", 2)
+AvgPool3D = _make_pool("AvgPool3D", "avg_pool3d", 3)
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, fn_name, **kwargs):
+        super().__init__()
+        self.output_size = output_size
+        self.fn_name = fn_name
+
+    def forward(self, x):
+        return getattr(F, self.fn_name)(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size, "adaptive_avg_pool1d")
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__(output_size, "adaptive_avg_pool2d")
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, "adaptive_avg_pool3d")
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "adaptive_max_pool1d")
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "adaptive_max_pool2d")
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "adaptive_max_pool3d")
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode, data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)

@@ -1,0 +1,276 @@
+"""RNN layers (analog of python/paddle/nn/layer/rnn.py).
+
+Recurrence runs under ``lax.scan`` — the XLA-friendly control flow the
+reference gets from cuDNN RNN kernels (paddle/phi/kernels/gpu/rnn_kernel.cu);
+on TPU a scan of fused matmuls is the idiomatic lowering.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import eager_apply
+from ...core.tensor import Tensor
+from .layers import Layer
+from .. import initializer as I
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32"):
+        b = batch_ref.shape[0]
+        import numpy as np
+        from ... import tensor as T
+        if isinstance(self, LSTMCell):
+            return (T.zeros([b, self.hidden_size]), T.zeros([b, self.hidden_size]))
+        return T.zeros([b, self.hidden_size])
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        k = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr,
+                                             default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr,
+                                             default_initializer=init, is_bias=True)
+
+    def pure_step(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(x @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = eager_apply("simple_rnn_cell", self.pure_step,
+                          (inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), {})
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr,
+                                             default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr,
+                                             default_initializer=init, is_bias=True)
+
+    def pure_step(self, x, h, c, w_ih, w_hh, b_ih, b_hh):
+        gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h2, c2 = eager_apply("lstm_cell", self.pure_step,
+                             (inputs, h, c, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh), {})
+        return h2, (h2, c2)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = 1.0 / hidden_size ** 0.5
+        init = I.Uniform(-k, k)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               attr=weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr,
+                                             default_initializer=init, is_bias=True)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr,
+                                             default_initializer=init, is_bias=True)
+
+    def pure_step(self, x, h, w_ih, w_hh, b_ih, b_hh):
+        gi = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        return (1 - z) * c + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h2 = eager_apply("gru_cell", self.pure_step,
+                         (inputs, states, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh), {})
+        return h2, h2
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (reference: nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = isinstance(self.cell, LSTMCell)
+        if initial_states is None:
+            ref = inputs if self.time_major else inputs
+            b = ref.shape[1] if self.time_major else ref.shape[0]
+            from ... import tensor as T
+            if is_lstm:
+                initial_states = (T.zeros([b, self.cell.hidden_size], inputs.dtype),
+                                  T.zeros([b, self.cell.hidden_size], inputs.dtype))
+            else:
+                initial_states = T.zeros([b, self.cell.hidden_size], inputs.dtype)
+
+        cell = self.cell
+        time_major = self.time_major
+        reverse = self.is_reverse
+
+        if is_lstm:
+            def fn(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
+                xt = x if time_major else jnp.swapaxes(x, 0, 1)
+                if reverse:
+                    xt = jnp.flip(xt, 0)
+
+                def step(carry, xi):
+                    h, c = carry
+                    h2, c2 = cell.pure_step(xi, h, c, w_ih, w_hh, b_ih, b_hh)
+                    return (h2, c2), h2
+
+                (hT, cT), ys = jax.lax.scan(step, (h0, c0), xt)
+                if reverse:
+                    ys = jnp.flip(ys, 0)
+                if not time_major:
+                    ys = jnp.swapaxes(ys, 0, 1)
+                return ys, hT, cT
+
+            h0, c0 = initial_states
+            ys, hT, cT = eager_apply(
+                "lstm_scan", fn,
+                (inputs, h0, c0, cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                 cell.bias_hh), {})
+            return ys, (hT, cT)
+
+        def fn(x, h0, w_ih, w_hh, b_ih, b_hh):
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)
+            if reverse:
+                xt = jnp.flip(xt, 0)
+
+            def step(h, xi):
+                h2 = cell.pure_step(xi, h, w_ih, w_hh, b_ih, b_hh)
+                return h2, h2
+
+            hT, ys = jax.lax.scan(step, h0, xt)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return ys, hT
+
+        ys, hT = eager_apply(
+            "rnn_scan", fn,
+            (inputs, initial_states, cell.weight_ih, cell.weight_hh, cell.bias_ih,
+             cell.bias_hh), {})
+        return ys, hT
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as T
+        states = initial_states or (None, None)
+        out_f, st_f = self.rnn_fw(inputs, states[0])
+        out_b, st_b = self.rnn_bw(inputs, states[1])
+        return T.concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _MultiLayerRNN(Layer):
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation=None, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 **cell_kwargs):
+        super().__init__()
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        from .container import LayerList
+        self.layers_list = LayerList()
+        kw = dict(cell_kwargs)
+        if activation is not None and self.CELL is SimpleRNNCell:
+            kw["activation"] = activation
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else hidden_size * (2 if self.bidirect else 1)
+            if self.bidirect:
+                self.layers_list.append(BiRNN(self.CELL(in_sz, hidden_size, **kw),
+                                              self.CELL(in_sz, hidden_size, **kw),
+                                              time_major))
+            else:
+                self.layers_list.append(RNN(self.CELL(in_sz, hidden_size, **kw),
+                                            False, time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        out = inputs
+        final_states = []
+        for i, layer in enumerate(self.layers_list):
+            out, st = layer(out)
+            final_states.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

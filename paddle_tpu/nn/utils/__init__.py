@@ -1,0 +1,78 @@
+"""nn.utils (analog of python/paddle/nn/utils/): clip_grad_*, weight_norm, parameter helpers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    from ...nn.layer.layers import Parameter
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._data for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros([]))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.abs(g).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._inplace_update(p.grad._data * clip_coef)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._inplace_update(jnp.clip(p.grad._data, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    data = vec._data if isinstance(vec, Tensor) else vec
+    for p in parameters:
+        n = p.size
+        p._inplace_update(data[offset:offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference: python/paddle/nn/utils/weight_norm_hook.py)."""
+    import numpy as np
+    from ...nn.layer.layers import Parameter
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w._data.ndim) if i != dim)
+    g = jnp.linalg.norm(w._data, axis=axes, keepdims=True)
+    layer.add_parameter(name + "_g", Parameter(g))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        # recompute w from (g, v) through tensor ops so grads flow to both
+        from ...core.dispatch import eager_apply
+        v = getattr(l, name + "_v")
+        g_ = getattr(l, name + "_g")
+        w_new = eager_apply(
+            "weight_norm",
+            lambda gg, vv: gg * vv / jnp.maximum(
+                jnp.linalg.norm(vv, axis=axes, keepdims=True), 1e-12),
+            (g_, v), {})
+        l._parameters.pop(name, None)
+        l._buffers[name] = w_new
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer

@@ -1,1 +1,7 @@
-"""placeholder — filled in by later milestones"""
+"""paddle_tpu.optimizer (analog of python/paddle/optimizer/)."""
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp,
+    Lamb, NAdam, RAdam, ASGD, Rprop,
+)
+from . import lr  # noqa: F401
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

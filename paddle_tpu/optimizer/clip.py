@@ -1,0 +1,54 @@
+"""Gradient clipping (analog of python/paddle/nn/clip.py: ClipGradBy*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _clip_arrays(self, params, grads):
+        raise NotImplementedError
+
+    def __call__(self, params_and_grads):
+        params = [p for p, _ in params_and_grads]
+        grads = [g._data for _, g in params_and_grads]
+        from ..core.tensor import Tensor
+        clipped = self._clip_arrays(params, grads)
+        return [(p, Tensor(g)) for p, g in zip(params, clipped)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_arrays(self, params, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, params, grads):
+        out = []
+        for g in grads:
+            n = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip (reference: ClipGradByGlobalNorm python/paddle/nn/clip.py;
+    hybrid-parallel variants reduce the norm across mesh axes first)."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, grads):
+        return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+
+    def _clip_arrays(self, params, grads):
+        gn = self._global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]

@@ -10,6 +10,10 @@ import os
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests never touch the TPU: clearing PALLAS_AXON_POOL_IPS would skip the axon
+# plugin claim, but sitecustomize has already run by the time conftest loads —
+# so invoke pytest as:  PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q
+# (see .claude/skills/verify/SKILL.md).
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
