@@ -1,0 +1,108 @@
+"""BERT encoder + pretraining heads (stepping-stone config 2, BASELINE.md —
+the data-parallel validation workload).
+
+Reference analog: the reference's transformer stack
+(python/paddle/nn/layer/transformer.py) powers ERNIE/BERT externally; this
+module provides the standard BERT-base architecture on paddle_tpu.nn.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .. import tensor as T
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+
+def bert_tiny_config(**kw):
+    base = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=256,
+                max_position_embeddings=128)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings, c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size, c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = T.arange(s, dtype="int64").unsqueeze(0)
+        e = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            e = e + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(e))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size, nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation="gelu",
+            attn_dropout=config.hidden_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, config.num_hidden_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        h = self.encoder(h, src_mask=attention_mask)
+        pooled = T.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        t = self.mlm_norm(F.gelu(self.mlm_transform(h)))
+        logits = T.matmul(t, self.bert.embeddings.word_embeddings.weight,
+                          transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        mlm_loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100, reduction="mean")
+        loss = mlm_loss
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits,
+                                          next_sentence_labels.reshape([-1]),
+                                          reduction="mean")
+        return logits, nsp_logits, loss

@@ -1,0 +1,194 @@
+"""Llama-2 family — the flagship model (BASELINE.md config 3, the north-star
+TP×PP×Sharding workload).
+
+Reference analogs: the reference has no in-tree Llama, but its fleet stack is
+built for exactly this architecture (fused_rope paddle/phi/kernels/fusion/gpu/
+fused_rope_kernel.cu, fused_rms_norm, swiglu python/paddle/incubate/nn/
+functional/, flash_attn paddle/phi/kernels/gpu/flash_attn_kernel.cu). Here the
+architecture is expressed TPU-first: einsum/matmul shapes that tile onto the
+MXU, bf16-friendly, RoPE/RMSNorm/SwiGLU as fusable jnp compositions that the
+Pallas kernel tier can override (paddle_tpu/ops/).
+
+Weight layout notes (for tensor parallelism): q/k/v/gate/up projections are
+column-sharded, o/down row-sharded — see paddle_tpu/distributed/parallelize.py.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .. import tensor as T
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama2_7b_config():
+    return LlamaConfig()
+
+
+def llama2_13b_config():
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_hidden_layers=40, num_attention_heads=40,
+                       num_key_value_heads=40)
+
+
+def llama_tiny_config(**kw):
+    """Tiny config for tests / dryruns (shapes still MXU-aligned)."""
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, max_position_embeddings=256)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def apply_rotary_pos_emb(q, k, position_ids=None, theta=10000.0, rope_cs=None):
+    """RoPE over paddle-layout [b, s, h, d] q/k.
+
+    TPU-native analog of fused_rotary_position_embedding (reference:
+    paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu); the composition is
+    left to XLA fusion, and the Pallas tier can override op 'rope'.
+    ``rope_cs``: optional precomputed (cos, sin) tables shared across layers.
+    """
+    if rope_cs is not None:
+        return F.rope(q, k, cos=rope_cs[0], sin=rope_cs[1], theta=theta)
+    return F.rope(q, k, position_ids=position_ids, theta=theta)
+
+
+LlamaRMSNorm = nn.RMSNorm
+
+
+class LlamaAttention(nn.Layer):
+    """GQA attention with RoPE; [b, s, h, d] layout end to end."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, hd = config.hidden_size, config.head_dim
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.q_proj = nn.Linear(h, self.num_heads * hd, bias_attr=False)
+        self.k_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
+        self.v_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
+        self.o_proj = nn.Linear(self.num_heads * hd, h, bias_attr=False)
+
+    def forward(self, hidden_states, position_ids=None, attn_mask=None,
+                rope_cs=None):
+        b, s, _ = hidden_states.shape
+        hd = self.config.head_dim
+        q = self.q_proj(hidden_states).reshape([b, s, self.num_heads, hd])
+        k = self.k_proj(hidden_states).reshape([b, s, self.num_kv_heads, hd])
+        v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, hd])
+        q, k = apply_rotary_pos_emb(q, k, position_ids, self.config.rope_theta,
+                                    rope_cs)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = T.repeat_interleave(k, rep, axis=2)
+            v = T.repeat_interleave(v, rep, axis=2)
+        # Causal LM: the causal mask always applies; attn_mask (e.g. padding)
+        # is merged on top, never a replacement for it.
+        if self.config.use_flash_attention and attn_mask is None:
+            out, _ = F.flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                                 is_causal=True)
+        out = out.reshape([b, s, self.num_heads * hd])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP (reference fused kernel: incubate/nn/functional/swiglu)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, i, bias_attr=False)
+        self.up_proj = nn.Linear(h, i, bias_attr=False)
+        self.down_proj = nn.Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, hidden_states, position_ids=None, attn_mask=None,
+                rope_cs=None):
+        h = hidden_states + self.self_attn(
+            self.input_layernorm(hidden_states), position_ids, attn_mask, rope_cs)
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, position_ids=None, attn_mask=None):
+        h = self.embed_tokens(input_ids)
+        # Build the RoPE cos/sin tables once and share across all layers.
+        pos = position_ids if position_ids is not None else input_ids.shape[1]
+        rope_cs = F.rope_tables(pos, self.config.head_dim, self.config.rope_theta)
+        for layer in self.layers:
+            h = layer(h, position_ids, attn_mask, rope_cs)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
+        h = self.model(input_ids, position_ids, attn_mask)
+        if self.lm_head is None:
+            logits = T.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]), reduction="mean")
+        return logits, loss
+
+    def flops_per_token(self, seq_len):
+        """Approximate training FLOPs/token (6N + attention), for MFU."""
+        c = self.config
+        n_params = sum(p.size for p in self.parameters())
+        attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
+        return 6 * n_params + attn

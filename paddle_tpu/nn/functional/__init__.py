@@ -27,5 +27,5 @@ from .common import (  # noqa: F401
     bilinear, zeropad2d, pad,
 )
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention, sequence_mask,
+    scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,
 )

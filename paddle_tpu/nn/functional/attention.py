@@ -71,6 +71,73 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
+def _rope_reference(q, k, *rest, theta=10000.0):
+    """Rotary position embedding over paddle-layout [b, s, h, d] q/k.
+
+    Analog of fused_rotary_position_embedding (reference:
+    paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu); adjacent-pair
+    (interleaved, use_neox_rotary_style=True) convention — even/odd lanes
+    form each rotated 2-vector. Computed in fp32 then cast back
+    (bf16-safe on TPU). When precomputed [b|1, s, d/2] cos/sin tables are
+    passed they are used directly (callers with many layers build them once
+    per forward via rope_tables()).
+    """
+    position_ids = cos = sin = None
+    if len(rest) == 1:
+        position_ids = rest[0]
+    elif len(rest) == 2:
+        cos, sin = rest
+    d = q.shape[-1]
+    if cos is None:
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        if position_ids is None:
+            pos = jnp.arange(q.shape[1], dtype=jnp.float32)[None, :]  # [1, s]
+        else:
+            pos = position_ids.astype(jnp.float32)  # [b, s]
+        freqs = pos[..., None] * inv_freq[None, None, :]  # [b, s, d/2]
+        cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    cos = cos[:, :, None, :]                             # [b, s, 1, d/2]
+    sin = sin[:, :, None, :]
+
+    def rot(x):
+        x1 = x[..., ::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+OPS.setdefault("rope", _rope_reference)
+
+
+def rope(q, k, position_ids=None, cos=None, sin=None, theta=10000.0, name=None):
+    """Apply rotary position embedding to q and k ([b, s, h, d]).
+
+    Either pass ``position_ids`` (tables computed inline) or precomputed
+    ``cos``/``sin`` from :func:`rope_tables` (cheaper across many layers).
+    """
+    if cos is not None:
+        args = (q, k, cos, sin)
+    else:
+        args = (q, k) + ((position_ids,) if position_ids is not None else ())
+    return eager_apply(
+        "rope", lambda *xs: OPS["rope"](*xs, theta=theta), args, {})
+
+
+def rope_tables(seq_len_or_positions, head_dim, theta=10000.0):
+    """Precompute RoPE cos/sin tables of shape [b|1, s, head_dim/2]."""
+    def fn(pos):
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+        freqs = pos.astype(jnp.float32)[..., None] * inv_freq[None, None, :]
+        return jnp.cos(freqs), jnp.sin(freqs)
+    if isinstance(seq_len_or_positions, int):
+        pos = jnp.arange(seq_len_or_positions, dtype=jnp.float32)[None, :]
+        return eager_apply("rope_tables", fn, (Tensor(pos),), {})
+    return eager_apply("rope_tables", fn, (seq_len_or_positions,), {})
+
+
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from ...core.dtype import to_jax_dtype
 
