@@ -1,0 +1,80 @@
+"""Flagship benchmark: Llama decoder pretraining step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/sec through the fused compiled train step (forward + backward
++ AdamW) on a GPT2-small-scale Llama config. ``vs_baseline`` is measured MFU
+relative to the 45% MFU north-star target (BASELINE.md) — >1.0 beats it.
+The reference publishes no in-repo numbers (BASELINE.md), so the MFU target
+is the comparison axis.
+"""
+import json
+import time
+
+import numpy as np
+
+PEAK_FLOPS = {
+    "tpu v5": 197e12,   # v5e bf16
+    "tpu v4": 275e12,
+    "tpu v5p": 459e12,
+    "tpu v6": 918e12,
+    "cpu": 1e12,        # nominal, CI runs only
+}
+
+
+def peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_hidden_layers=12,
+                          num_attention_heads=12, num_key_value_heads=12,
+                          max_position_embeddings=1024)
+        batch, seq, iters = 4, 1024, 30
+    else:
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=4)
+        batch, seq, iters = 4, 128, 5
+
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda ids: model(ids, labels=ids)[1],
+                                opt)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)),
+        dtype="int64")
+
+    step(ids)  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids)
+    _ = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * iters / dt
+    flops_tok = model.flops_per_token(seq)
+    mfu = tok_s * flops_tok / peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": "llama_125m_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

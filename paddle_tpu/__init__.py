@@ -41,6 +41,9 @@ from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 from .framework import save, load  # noqa: F401,E402
 
 __version__ = "0.1.0"

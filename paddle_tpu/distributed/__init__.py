@@ -1,0 +1,26 @@
+"""paddle_tpu.distributed — mesh-based hybrid-parallel stack.
+
+TPU-native redesign of the reference's distributed layer (SURVEY.md §2.2,
+L6): ProcessMesh over the device torus, GSPMD shardings instead of per-op
+SPMD rules + NCCL groups, XLA collectives over ICI/DCN instead of
+ProcessGroupNCCL, jax.distributed's coordination service instead of
+TCPStore.
+"""
+from .placement import Placement, Replicate, Shard, Partial  # noqa: F401
+from .mesh import ProcessMesh, init_mesh, set_mesh, get_mesh  # noqa: F401
+from .api import (  # noqa: F401
+    shard_tensor, reshard, dtensor_from_local, local_value, get_placements,
+    shard_layer, shard_parameter, shard_optimizer,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, all_reduce, all_gather, all_gather_object, all_to_all,
+    reduce_scatter, broadcast, reduce, scatter, send, recv, barrier,
+    get_rank, get_world_size, init_parallel_env, is_initialized, new_group,
+    destroy_process_group,
+)
+from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .sharding import group_sharded_parallel, shard_optimizer_states  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import parallelize, to_static  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401

@@ -1,0 +1,109 @@
+"""Semi-automatic parallelization API.
+
+TPU-native analog of the reference's auto_parallel intermediate API
+(reference: python/paddle/distributed/auto_parallel/intermediate/
+parallelize.py:51 parallelize; api.py:2263 to_static/DistModel). A
+``parallelize_plan`` maps layer-name patterns to parallel styles; applying a
+style = declaring the weight sharding over the named mesh axis (GSPMD does
+the rest — the reference rewrites layers into mpu classes instead).
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+
+from ..api import shard_parameter
+from ..mesh import ProcessMesh
+from ..placement import Replicate, Shard
+
+
+class ParallelStyle:
+    pass
+
+
+class ColWiseParallel(ParallelStyle):
+    """Shard weight [in, out] on out (dim 1); bias on dim 0."""
+
+    def apply(self, layer, mesh, axis_name):
+        idx = mesh.dim_names.index(axis_name)
+        if getattr(layer, "weight", None) is not None:
+            pl = [Replicate()] * mesh.ndim
+            pl[idx] = Shard(1)
+            shard_parameter(layer.weight, mesh, pl)
+        if getattr(layer, "bias", None) is not None:
+            pl = [Replicate()] * mesh.ndim
+            pl[idx] = Shard(0)
+            shard_parameter(layer.bias, mesh, pl)
+
+
+class RowWiseParallel(ParallelStyle):
+    """Shard weight [in, out] on in (dim 0); embeddings on vocab (dim 0)."""
+
+    def apply(self, layer, mesh, axis_name):
+        idx = mesh.dim_names.index(axis_name)
+        if getattr(layer, "weight", None) is not None:
+            pl = [Replicate()] * mesh.ndim
+            pl[idx] = Shard(0)
+            shard_parameter(layer.weight, mesh, pl)
+
+
+class SequenceParallelBegin(ParallelStyle):
+    def apply(self, layer, mesh, axis_name):
+        pass
+
+
+class SequenceParallelEnd(ParallelStyle):
+    def apply(self, layer, mesh, axis_name):
+        pass
+
+
+def _match(pattern, name):
+    if pattern == name:
+        return True
+    if fnmatch.fnmatch(name, pattern):
+        return True
+    # reference allows regex-ish layer indices: model.layers.*.q_proj
+    return re.fullmatch(pattern.replace(".", r"\.").replace(r"\.\*", r"\..*"),
+                        name) is not None
+
+
+def parallelize(model, mesh: ProcessMesh = None, config: dict = None,
+                optimizer=None, axis_name="mp"):
+    """Apply a tensor/sharding/pp plan to a model
+    (reference: parallelize.py:51).
+
+    config = {"mp_config": {"parallelize_plan": {"model.layers.*.q_proj":
+    ColWiseParallel(), ...}}, "dp_config": {...}, "pp_config": {...}}
+    """
+    config = config or {}
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    if mesh is None:
+        from ..mesh import get_mesh
+        mesh = get_mesh()
+    if plan and axis_name not in mesh.dim_names:
+        raise ValueError(f"mesh {mesh} has no '{axis_name}' axis for mp plan")
+    for lname, sub in model.named_sublayers():
+        for pattern, style in plan.items():
+            if _match(pattern, lname):
+                if isinstance(style, type):
+                    style = style()
+                style.apply(sub, mesh, axis_name)
+    if optimizer is not None:
+        return model, optimizer
+    return model
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """Reference api.py:2988 — returns a DistModel-style compiled trainer.
+    On this stack the fused jit.TrainStep *is* the static path."""
+    from ...jit import TrainStep
+
+    if loss is None or optimizer is None:
+        raise ValueError("to_static needs loss and optimizer")
+
+    def loss_fn(*batch):
+        *xs, y = batch
+        return loss(layer(*xs), y)
+
+    return TrainStep(layer, loss_fn, optimizer)

@@ -1,0 +1,262 @@
+"""Functional collectives — the ProcessGroup capability surface.
+
+TPU-native analog of the reference's collective runtime (reference:
+paddle/phi/core/distributed/collective/process_group.h:130-345 — AllGather,
+AllReduce, AllToAll, Barrier, Broadcast, Reduce, ReduceScatter, Scatter,
+Send/Recv; Python wrappers python/paddle/distributed/communication/). Two
+execution regimes, matching how TPU programs are actually written:
+
+1. **Inside a shard_map / pjit-manual region** (an axis name is bound):
+   collectives lower to XLA collective HLOs over ICI — ``lax.psum``,
+   ``all_gather``, ``ppermute``, ``all_to_all``. This is the analog of the
+   reference's device-side NCCL kernels.
+2. **Eager, whole-array** (single controller): tensors are already global
+   values; an all_reduce over replicated data is the identity, a broadcast
+   re-places the source value, etc. This matches the reference's semantics
+   where each rank holds its local value — here the "ranks" are mesh devices
+   and the global value is what the user observes.
+
+Groups are mesh-axis subsets (see fleet/topology.py), not communicator
+handles: a ``Group`` names the mesh axis it spans, the launcher's
+coordination service (jax.distributed) plays TCPStore.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+# ---- reduce ops (process_group.h ReduceOp) ----
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a named mesh axis (or explicit rank list).
+
+    Reference: python/paddle/distributed/communication/group.py:29. On TPU
+    the group's collectives ride the mesh axis; ``axis_name`` is what binds
+    them inside shard_map regions.
+    """
+
+    def __init__(self, ranks, axis_name=None, pg_id=0):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.axis_name = axis_name
+        self.id = pg_id
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_default_group: Group | None = None
+
+
+def _get_axis(group):
+    if group is not None and group.axis_name is not None:
+        return group.axis_name
+    return None
+
+
+def _in_manual_region(axis_name) -> bool:
+    """True when ``axis_name`` is bound by an enclosing shard_map."""
+    if axis_name is None:
+        return False
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def _apply(x, fn):
+    if isinstance(x, Tensor):
+        out = fn(x._data)
+        x._data = out
+        return x
+    return fn(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce (reference: process_group.h AllReduce;
+    python/paddle/distributed/communication/all_reduce.py)."""
+    axis = _get_axis(group)
+
+    def fn(a):
+        if _in_manual_region(axis):
+            if op == ReduceOp.SUM:
+                return lax.psum(a, axis)
+            if op == ReduceOp.MAX:
+                return lax.pmax(a, axis)
+            if op == ReduceOp.MIN:
+                return lax.pmin(a, axis)
+            if op == ReduceOp.AVG:
+                return lax.pmean(a, axis)
+            if op == ReduceOp.PROD:
+                return jnp.exp(lax.psum(jnp.log(a), axis))
+        # eager whole-array: the value is already the global reduction
+        return a
+
+    return _apply(tensor, fn)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Gather shards from every rank (process_group.h AllGather)."""
+    ax = _get_axis(group)
+    if isinstance(tensor, Tensor) and _in_manual_region(ax):
+        out = lax.all_gather(tensor._data, ax, axis=axis, tiled=False)
+        n = out.shape[axis]
+        parts = [Tensor(jnp.take(out, i, axis=axis)) for i in range(n)]
+        tensor_list.extend(parts)
+        return tensor_list
+    # eager: every "rank" holds the same global value
+    n = group.nranks if group is not None else get_world_size()
+    tensor_list.extend(Tensor(tensor._data) for _ in range(max(n, 1)))
+    return tensor_list
+
+
+def all_gather_object(obj_list, obj, group=None):
+    n = group.nranks if group is not None else get_world_size()
+    obj_list.extend(obj for _ in range(max(n, 1)))
+    return obj_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """(process_group.h ReduceScatter)."""
+    ax = _get_axis(group)
+    if _in_manual_region(ax):
+        ins = tensor_or_tensor_list
+        a = ins._data if isinstance(ins, Tensor) else jnp.concatenate(
+            [t._data for t in ins], axis=0)
+        out = lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
+        tensor._data = out
+        return tensor
+    ins = tensor_or_tensor_list
+    if isinstance(ins, (list, tuple)):
+        tensor._data = ins[0]._data
+    else:
+        tensor._data = ins._data
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """(process_group.h AllToAll) — inside shard_map uses lax.all_to_all."""
+    ax = _get_axis(group)
+    if _in_manual_region(ax):
+        stacked = jnp.stack([t._data for t in in_tensor_list], axis=0)
+        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0, tiled=False)
+        for i in range(out.shape[0]):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+    return out_tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """(process_group.h Broadcast) — eager arrays are already consistent."""
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        rank = get_rank()
+        idx = group.get_group_rank(rank) if group is not None else rank
+        tensor._data = tensor_list[max(idx, 0)]._data
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send (process_group.h Send). Inside shard_map: ppermute edge."""
+    ax = _get_axis(group)
+    if _in_manual_region(ax):
+        n = lax.axis_size(ax)
+        tensor._data = lax.ppermute(tensor._data, ax,
+                                    [(i, dst) for i in range(n)])
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                      use_calc_stream=False):
+    """paddle.distributed.communication.stream.* variants collapse to the
+    same XLA collectives (streams are XLA's async domain on TPU)."""
+    return all_reduce(tensor, op, group, sync_op)
+
+
+# ---- environment (python/paddle/distributed/parallel.py ParallelEnv) ----
+
+
+def get_rank(group=None):
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+def is_initialized():
+    return _default_group is not None
+
+
+def init_parallel_env():
+    """Reference: python/paddle/distributed/parallel.py:978. Multi-host TPU
+    rendezvous is jax.distributed (coordination service = the TCPStore role);
+    single-host it simply records the default group."""
+    global _default_group
+    import os
+    if _default_group is not None:
+        return _default_group
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _default_group = Group(list(range(get_world_size())), axis_name=None)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, axis_name=None):
+    return Group(ranks if ranks is not None else list(range(get_world_size())),
+                 axis_name=axis_name, pg_id=np.random.randint(1 << 30))
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None

@@ -1,0 +1,139 @@
+"""Tensor-(model-)parallel layers.
+
+TPU-native analog of the reference's mpu layers (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+VocabParallelEmbedding :49, ColumnParallelLinear :336, RowParallelLinear
+:543, ParallelCrossEntropy :744). The reference implements each with
+explicit identity/allreduce PyLayers (mp_ops.py); here the layer *declares*
+its weight sharding over the 'mp' mesh axis and the math is ordinary
+matmul/embedding — GSPMD inserts the all-reduce/all-gather (riding ICI)
+exactly where the reference hand-places them:
+
+- ColumnParallelLinear: W [in, out] sharded on out → partial-free local
+  matmuls; gather_output resharding is an all-gather on the out dim.
+- RowParallelLinear: W sharded on in; x arrives sharded on its last dim
+  (input_is_parallel) → local matmul yields partial sums, GSPMD emits the
+  all-reduce the reference codes by hand.
+- VocabParallelEmbedding: table sharded on vocab; lookups become a sharded
+  gather + psum of masked partials.
+- ParallelCrossEntropy: logits sharded on the class dim; the log-sum-exp
+  reduction inserts the same pair of collectives as the reference kernel
+  (c_softmax_with_cross_entropy).
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+from ..api import shard_parameter
+from ..placement import Replicate, Shard
+from .topology import get_hybrid_communicate_group
+
+
+def _mp_context():
+    """(mesh, mp_axis_index, degree) or (None, None, 1) when not hybrid."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_model_parallel_world_size() == 1:
+        return None, None, 1
+    mesh = hcg.mesh
+    return mesh, mesh.dim_names.index("mp"), hcg.get_model_parallel_world_size()
+
+
+def _shard_on(p, tensor_dim):
+    """Shard parameter ``p`` on ``tensor_dim`` along the mp mesh axis."""
+    mesh, mp_idx, degree = _mp_context()
+    if mesh is None:
+        return p
+    placements = [Replicate()] * mesh.ndim
+    placements[mp_idx] = Shard(tensor_dim)
+    return shard_parameter(p, mesh, placements)
+
+
+def _replicate(t):
+    mesh, mp_idx, degree = _mp_context()
+    if mesh is None:
+        return t
+    from ..api import reshard
+    return reshard(t, mesh, [Replicate()] * mesh.ndim)
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        _, _, degree = _mp_context()
+        if out_features % degree != 0:
+            raise ValueError(
+                f"out_features={out_features} not divisible by mp degree {degree}")
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _shard_on(self.weight, 1)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            _shard_on(self.bias, 0)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        return _replicate(out) if self.gather_output else out
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        _, _, degree = _mp_context()
+        if in_features % degree != 0:
+            raise ValueError(
+                f"in_features={in_features} not divisible by mp degree {degree}")
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        _shard_on(self.weight, 0)
+        # bias is applied after the (implicit) all-reduce → replicated
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight)
+        out = _replicate(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        _, _, degree = _mp_context()
+        if num_embeddings % degree != 0:
+            raise ValueError(
+                f"vocab {num_embeddings} not divisible by mp degree {degree}")
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.Normal(0.0, 1.0))
+        _shard_on(self.weight, 0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Cross entropy over class-dim-sharded logits (reference mp_layers.py:744,
+    CUDA kernel c_softmax_with_cross_entropy). GSPMD partitions the
+    log-sum-exp over the mp axis; no explicit collective code needed."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,126 @@
+"""PipelineLayer — partition a layer sequence into pipeline stages.
+
+TPU-native analog of the reference's PipelineLayer (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py:258
+— LayerDesc list → stage segments, shared-weight groups). The reference
+materializes only this rank's stage; single-controller TPU materializes all
+stages and *places* each stage's parameters on its stage's devices (the
+submesh of the 'pp' axis) — activations crossing a stage boundary are
+device-to-device ICI transfers, the role of the reference's p2p send/recv
+(pp_utils/p2p_communication.py:573).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ... import nn
+from ..mesh import ProcessMesh
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+
+
+def _segment_uniform(n_layers, n_stages):
+    """Uniform layer→stage split (reference SegmentLayers, pp_layers.py)."""
+    base, extra = divmod(n_layers, n_stages)
+    bounds = [0]
+    for i in range(n_stages):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
+
+
+class PipelineLayer(nn.Layer):
+    def __init__(self, layers, num_stages=None, topology=None, mesh=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        descs = list(layers)
+        self._descs = descs
+        if mesh is None:
+            from .topology import get_hybrid_communicate_group
+            hcg = get_hybrid_communicate_group()
+            mesh = hcg.mesh if hcg is not None else None
+            if num_stages is None and hcg is not None:
+                num_stages = hcg.get_pipe_parallel_world_size()
+        self.mesh = mesh
+        self.num_stages = num_stages or 1
+        built = [d.build() if isinstance(d, LayerDesc) else d for d in descs]
+        self.run_function = nn.LayerList(built)
+        self._bounds = _segment_uniform(len(built), self.num_stages)
+        self._stage_meshes = self._place_stages()
+
+    def _stage_meshes(self):
+        pass
+
+    def _place_stages(self):
+        """Place each stage's params on the stage's slice of the pp axis."""
+        if self.mesh is None or "pp" not in self.mesh.dim_names or self.num_stages == 1:
+            return [None] * self.num_stages
+        stage_meshes = []
+        for s in range(self.num_stages):
+            sub = self.mesh.get_mesh_with_dim("pp", s)  # mesh without pp axis
+            stage_meshes.append(sub)
+            for li in range(self._bounds[s], self._bounds[s + 1]):
+                for p in self.run_function[li].parameters():
+                    if hasattr(p, "_dist_attr"):
+                        # keep mp/dp placements, restrict to stage submesh
+                        _, placements = p._dist_attr
+                        pp_idx = self.mesh.dim_names.index("pp")
+                        pl = [q for i, q in enumerate(placements) if i != pp_idx]
+                        p._data = jax.device_put(
+                            np.asarray(p._data),
+                            sub.sharding_for(pl, max(p.ndim, 1)))
+                        p._dist_attr = (sub, pl)
+                    else:
+                        from ..placement import Replicate
+                        rep = [Replicate()] * sub.ndim
+                        p._data = jax.device_put(
+                            np.asarray(p._data),
+                            sub.sharding_for(rep, max(p.ndim, 1)))
+                        p._dist_attr = (sub, rep)
+        return stage_meshes
+
+    def get_stage_from_index(self, idx):
+        for s in range(self.num_stages):
+            if self._bounds[s] <= idx < self._bounds[s + 1]:
+                return s
+        raise IndexError(idx)
+
+    def stage_layers(self, stage):
+        return self.run_function[self._bounds[stage]:self._bounds[stage + 1]]
+
+    def forward(self, x, stage_range=None):
+        """Run all stages (or a sub-range); cross-stage activation transfer
+        is an op-level device_put so autograd carries cotangents back across
+        the boundary (the reference's p2p send/recv pair)."""
+        from ..api import shard_tensor
+        from ..placement import Replicate
+        stages = range(self.num_stages) if stage_range is None else stage_range
+        h = x
+        for s in stages:
+            sub = self._stage_meshes[s] if hasattr(self, "_stage_meshes") else None
+            if sub is not None and isinstance(sub, ProcessMesh):
+                from ...core.dispatch import eager_apply
+                sharding = sub.sharding_for(
+                    [Replicate()] * sub.ndim, max(h.ndim, 1))
+                h = eager_apply("pp_transfer",
+                                lambda a: jax.device_put(a, sharding), (h,), {})
+            for li in range(self._bounds[s], self._bounds[s + 1]):
+                h = self.run_function[li](h)
+        return h

@@ -1,0 +1,191 @@
+"""Hybrid-parallel topology math.
+
+TPU-native analog of the reference's CommunicateTopology /
+HybridCommunicateGroup (reference: python/paddle/distributed/fleet/base/
+topology.py:70,189). The reference builds an NCCL communicator per axis
+subset (_set_comm_group topology.py:240); here every axis is a named mesh
+axis of one global ProcessMesh over the TPU torus and a "comm group" is a
+``Group`` naming that axis — collectives along it become XLA collectives on
+the ICI ring for that axis.
+
+Axis order (outer→inner) is ["pp", "dp", "sharding", "sep", "mp"], mp
+innermost so model-parallel partners are ICI neighbors (the reference makes
+the same choice for NVLink locality).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..collective import Group, get_rank
+from ..mesh import ProcessMesh
+
+_HYBRID_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or _HYBRID_ORDER)
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return list(self._parallel_names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[n] for n in self._parallel_names)
+        return int(self._world[coords])
+
+    def get_coord(self, rank):
+        idx = np.unravel_index(rank, self._dims)
+        import collections
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*(int(i) for i in idx))
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(int(r) for r in self._world[tuple(sl)].flatten())
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups, one per communicator along ``axis_name``
+        (reference topology.py get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._world, axis, -1).reshape(-1, self._dims[axis])
+        return [list(map(int, row)) for row in moved]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups + the global ProcessMesh (reference topology.py:189).
+
+    The mesh uses only axes with degree > 1 plus always dp/mp for layer code;
+    full 5-d coordinates remain available through the topology object.
+    """
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = get_rank()
+        self._dp_degree = topology.get_dim("dp")
+        self._mp_degree = topology.get_dim("mp")
+        self._pp_degree = topology.get_dim("pp")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        self.mesh = ProcessMesh(np.arange(int(np.prod(dims))).reshape(dims), names)
+
+        coord = self._topo.get_coord(self.global_rank)
+        self._groups = {}
+        for n in names:
+            ranks = self._topo.get_axis_list(
+                n, 0)  # representative; rank list along the axis from this coord
+            # the group this rank belongs to along axis n:
+            my = {k: getattr(coord, k) for k in names if k != n}
+            members = [self._topo.get_rank(**{**my, n: i})
+                       for i in range(self._topo.get_dim(n))]
+            self._groups[n] = Group(members, axis_name=n)
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    # --- degree / rank / group accessors (reference API surface) ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def _coord(self):
+        return self._topo.get_coord(self.global_rank)
+
+    def get_data_parallel_rank(self):
+        return self._coord().dp
+
+    def get_model_parallel_rank(self):
+        return self._coord().mp
+
+    def get_stage_id(self):
+        return self._coord().pp
+
+    def get_sharding_parallel_rank(self):
+        return self._coord().sharding
+
+    def get_sep_parallel_rank(self):
+        return self._coord().sep
+
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["dp"].ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["mp"].ranks[0]
+
+    # pp helpers (p2p neighbors on the pp ICI axis)
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return self._groups["pp"]
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup | None:
+    return _hcg

@@ -1,0 +1,151 @@
+"""ProcessMesh — the device topology object.
+
+TPU-native analog of the reference's ProcessMesh
+(reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34 and
+python/paddle/distributed/auto_parallel/process_mesh.py). Where the reference
+maps logical ranks onto NCCL communicators per mesh axis, here a ProcessMesh
+wraps ``jax.sharding.Mesh``: every axis is a named axis of the physical
+device array, collectives along an axis ride ICI (within slice) / DCN
+(across slices) as XLA chooses from the GSPMD partition.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from .placement import placements_to_spec
+
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, devices=None):
+        """``mesh``: nested list / ndarray of process (device) ids, or an
+        existing jax Mesh. ``dim_names``: one name per mesh dimension."""
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = tuple(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = np.vectorize(lambda d: d.id)(mesh.devices)
+            return
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._shape = tuple(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr
+        pool = devices if devices is not None else jax.devices()
+        by_id = {d.id: d for d in pool}
+        try:
+            dev_arr = np.vectorize(lambda i: by_id[int(i)])(arr)
+        except KeyError as e:
+            raise ValueError(
+                f"mesh references device id {e} but only "
+                f"{sorted(by_id)} are available") from None
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    # ---- reference API surface (process_mesh.py) ----
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._process_ids.flatten()]
+
+    @property
+    def mesh(self):
+        return self._process_ids
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh: move ``dim_name`` first; optionally slice one index out."""
+        order = [self._dim_names.index(dim_name)] + [
+            i for i in range(self.ndim) if self._dim_names[i] != dim_name]
+        arr = np.transpose(self._process_ids, order)
+        names = [self._dim_names[i] for i in order]
+        if index is None:
+            return ProcessMesh(arr, names)
+        return ProcessMesh(arr[index], names[1:])
+
+    def sharding(self, placements) -> NamedSharding:
+        """NamedSharding for a tensor described by per-mesh-dim placements.
+
+        ndim of the target tensor is taken from the max sharded dim; for
+        full fidelity use :func:`sharding_for` with an explicit ndim.
+        """
+        ndim = 1 + max([p.dim for p in placements if hasattr(p, "dim")],
+                       default=-1)
+        return self.sharding_for(placements, max(ndim, 1))
+
+    def sharding_for(self, placements, ndim) -> NamedSharding:
+        spec = placements_to_spec(placements, self._dim_names, ndim)
+        return NamedSharding(self._jax_mesh, spec)
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._dim_names == other._dim_names
+                and np.array_equal(self._process_ids, other._process_ids))
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._dim_names),
+                     self._process_ids.tobytes()))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={list(self._shape)}, "
+                f"dim_names={self._dim_names})")
+
+
+def init_mesh(shape_or_dims, dim_names=None) -> ProcessMesh:
+    """Build a ProcessMesh over all local devices.
+
+    ``init_mesh({'dp': 2, 'mp': 4})`` or ``init_mesh([2, 4], ['dp','mp'])``.
+    A -1 entry is inferred from the device count.
+    """
+    if isinstance(shape_or_dims, dict):
+        dim_names = list(shape_or_dims.keys())
+        shape = list(shape_or_dims.values())
+    else:
+        shape = list(shape_or_dims)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(shape))]
+    n = len(jax.devices())
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh shape {shape} != {n} devices")
+    ids = np.arange(n).reshape(shape)
+    return ProcessMesh(ids, dim_names)
+
+
+def auto_parallel_mesh(*args, **kwargs):
+    return init_mesh(*args, **kwargs)
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh

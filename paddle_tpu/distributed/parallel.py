@@ -1,0 +1,102 @@
+"""DataParallel + environment.
+
+TPU-native analog of the reference's DataParallel wrapper
+(reference: python/paddle/distributed/parallel.py:219; C++ bucketed
+EagerReducer paddle/fluid/distributed/collective/reducer.h:88). The
+reference hooks every grad-ready event and launches bucketed NCCL
+all-reduces overlapping backward. On TPU the same overlap is XLA's job:
+params are replicated over the mesh, the batch is sharded on the 'dp' axis,
+and GSPMD inserts (and schedules/overlaps) the gradient all-reduce inside
+the compiled step — the reducer disappears into the compiler.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from .api import shard_tensor
+from .collective import get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .mesh import ProcessMesh
+from .placement import Replicate, Shard
+
+
+class ParallelEnv:
+    """Reference: parallel.py:1040 — env introspection."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+class DataParallel:
+    """Wrap a Layer for data parallelism over a mesh axis.
+
+    ``model = paddle.DataParallel(model)`` replicates parameters over the
+    mesh; ``scatter_batch`` shards inputs along 'dp'. Gradients of replicated
+    params w.r.t. sharded batches are globally correct by GSPMD semantics —
+    there is no reducer to run (reducer.h:88's job is implicit).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size_mb=25,
+                 last_comm_buffer_size_mb=1, find_unused_parameters=False,
+                 group=None, mesh: ProcessMesh | None = None):
+        self._layers = layers
+        if mesh is None:
+            n = len(jax.devices())
+            mesh = ProcessMesh(np.arange(n).reshape(n, 1), ["dp", "mp"]) \
+                if n > 1 else None
+        self.mesh = mesh
+        if mesh is not None:
+            rep = [Replicate()] * mesh.ndim
+            for p in layers.parameters():
+                if not hasattr(p, "_dist_attr"):  # mp layers already sharded
+                    p._data = jax.device_put(
+                        p._data, mesh.sharding_for(rep, max(p.ndim, 1)))
+                    p._dist_attr = (mesh, rep)
+
+    def scatter_batch(self, x, axis=0):
+        """Shard a batch tensor along the dp mesh axis."""
+        if self.mesh is None:
+            return x if isinstance(x, Tensor) else Tensor(x)
+        pl = [Replicate()] * self.mesh.ndim
+        pl[self.mesh.dim_names.index("dp")] = Shard(axis)
+        return shard_tensor(x, self.mesh, pl)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    __call__ = forward
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    # no-op legacy surface (grad sync is implicit)
+    def apply_collective_grads(self):
+        pass
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)

@@ -1,0 +1,112 @@
+"""Placements: how one tensor dimension relates to one mesh dimension.
+
+TPU-native analog of the reference's auto-parallel placement types
+(reference: paddle/phi/core/distributed/auto_parallel/placement_types.h —
+Shard/Replicate/Partial). A list of placements, one per mesh dimension,
+fully describes a DistTensor layout and converts losslessly to a
+``jax.sharding.PartitionSpec`` (GSPMD annotation) via
+:func:`placements_to_spec`.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    """Pending-reduction state (reference: placement_types.h Partial).
+
+    On this stack Partial exists only as metadata inside shard_map regions /
+    reshard planning — materializing a DistTensor always reduces it first
+    (XLA has no persistent partial arrays).
+    """
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(placements, mesh_axis_names, ndim):
+    """[placement per mesh dim] -> PartitionSpec (entry per tensor dim).
+
+    Multiple mesh dims sharding the same tensor dim become an axis tuple in
+    mesh-dim order (matches GSPMD semantics).
+    """
+    entries = [[] for _ in range(ndim)]
+    for axis_name, p in zip(mesh_axis_names, placements):
+        if isinstance(p, Shard):
+            if p.dim >= ndim:
+                raise ValueError(
+                    f"Shard(dim={p.dim}) out of range for ndim={ndim}")
+            entries[p.dim].append(axis_name)
+    spec = [None if not e else (e[0] if len(e) == 1 else tuple(e))
+            for e in entries]
+    return PartitionSpec(*spec)
+
+
+def spec_to_placements(spec, mesh_axis_names):
+    """PartitionSpec -> [placement per mesh dim]."""
+    placements = [Replicate() for _ in mesh_axis_names]
+    idx = {n: i for i, n in enumerate(mesh_axis_names)}
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for a in axes:
+            placements[idx[a]] = Shard(tdim)
+    return placements
