@@ -6,16 +6,17 @@ reference gives loopback NCCL.
 """
 import os
 
-# Must be set before jax initializes.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", ""))
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Tests never touch the TPU: clearing PALLAS_AXON_POOL_IPS would skip the axon
-# plugin claim, but sitecustomize has already run by the time conftest loads —
-# so invoke pytest as:  PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q
-# (see .claude/skills/verify/SKILL.md).
+# XLA parses XLA_FLAGS at backend-creation time, so setting it here works even
+# though sitecustomize already imported jax at interpreter startup.
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           + os.environ.get("XLA_FLAGS", ""))
 
 import jax  # noqa: E402
+
+# sitecustomize (axon TPU plugin) imports jax before conftest runs, so the
+# JAX_PLATFORMS env var is already baked in — override via config instead.
+# Backends are created lazily, so this lands before any device is claimed.
+jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
