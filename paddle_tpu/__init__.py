@@ -46,6 +46,11 @@ from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework import save, load  # noqa: F401,E402
 
+# Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless
+# PADDLE_TPU_FORCE_PALLAS=1 — the interpret-mode CI path).
+from . import kernels as _kernels  # noqa: E402
+_kernels.install()
+
 __version__ = "0.1.0"
 
 

@@ -1,1 +1,2 @@
 """paddle_tpu.incubate — staging ground for experimental APIs (analog of python/paddle/incubate/)."""
+from . import nn  # noqa: F401

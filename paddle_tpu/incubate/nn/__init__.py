@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate.nn (analog of python/paddle/incubate/nn/)."""
+from . import functional  # noqa: F401

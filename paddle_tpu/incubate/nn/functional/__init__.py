@@ -1,0 +1,192 @@
+"""Fused-op functional API (parity with python/paddle/incubate/nn/functional/).
+
+On TPU these are NOT separate hand-written kernels per op the way the
+reference's CUDA tier is (paddle/phi/kernels/fusion/gpu/): XLA fuses the
+elementwise compositions into neighboring matmuls automatically, and the
+few genuinely hard fusions (flash attention, long-seq rms_norm) live in
+paddle_tpu/kernels as Pallas kernels that override the default bodies.
+This module keeps the reference's *API surface* so user code ports 1:1.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import eager_apply, OPS
+from ....nn import functional as F
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **_):
+    """fused_rms_norm (reference: incubate/nn/functional/fused_rms_norm.py).
+
+    Returns (out, residual_out) like the reference when residual is passed,
+    else out. bias/residual are pre-norm adds fused by XLA.
+    """
+    def fn(a, w, *extra):
+        i = 0
+        b = r = nb = None
+        if bias is not None:
+            b = extra[i]; i += 1
+        if residual is not None:
+            r = extra[i]; i += 1
+        if norm_bias is not None:
+            nb = extra[i]; i += 1
+        if b is not None:
+            a = a + b
+        if r is not None:
+            a = a + r
+        res_out = a
+        var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, res_out
+        return out
+
+    args = [x, norm_weight]
+    for t in (bias, residual, norm_bias):
+        if t is not None:
+            args.append(t)
+    return eager_apply("fused_rms_norm", fn, tuple(args), {})
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **_):
+    """fused_layer_norm (reference: incubate/nn/functional/fused_layer_norm.py)."""
+    def fn(a, *extra):
+        i = 0
+        b = r = w = nb = None
+        if bias is not None:
+            b = extra[i]; i += 1
+        if residual is not None:
+            r = extra[i]; i += 1
+        if norm_weight is not None:
+            w = extra[i]; i += 1
+        if norm_bias is not None:
+            nb = extra[i]; i += 1
+        if b is not None:
+            a = a + b
+        if r is not None:
+            a = a + r
+        res_out = a
+        af = a.astype(jnp.float32)
+        mean = af.mean(axis=-1, keepdims=True)
+        var = jnp.square(af - mean).mean(axis=-1, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w is not None:
+            out = out * w
+        if nb is not None:
+            out = out + nb
+        if residual is not None:
+            return out, res_out
+        return out
+
+    args = [x]
+    for t in (bias, residual, norm_weight, norm_bias):
+        if t is not None:
+            args.append(t)
+    return eager_apply("fused_layer_norm", fn, tuple(args), {})
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py.
+
+    q/k/v: [batch, seq, heads, head_dim]. Applies RoPE to each non-None
+    input; returns a 3-tuple mirroring the reference.
+    """
+    def rope_one(x):
+        if x is None:
+            return None
+        if cos is not None:
+            # reference passes [1, s, 1, d] tables with duplicated halves
+            c2, s2 = cos, sin
+            out = F.rope(x, x, cos=_half_table(c2), sin=_half_table(s2),
+                         theta=rotary_emb_base)[0]
+        else:
+            out = F.rope(x, x, position_ids=position_ids,
+                         theta=rotary_emb_base)[0]
+        return out
+
+    def _half_table(t):
+        # [1, s, 1, d] or [1, s, d] -> [1, s, d/2] (even lanes)
+        tt = t
+        if tt.ndim == 4:
+            tt = tt.reshape(tt.shape[0], tt.shape[1], tt.shape[3])
+        return tt[..., ::2]
+
+    return rope_one(q), rope_one(k), rope_one(v)
+
+
+def swiglu(x, y=None, name=None):
+    """Reference: incubate/nn/functional/swiglu.py."""
+    return F.swiglu(x, y)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", **_):
+    """Reference: incubate/nn/functional/fused_bias_act.py (quant paths
+    descoped; see paddle_tpu.quantization for the quant tier)."""
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+           "swiglu": None}[act_method]
+
+    def fn(a, *b):
+        if b:
+            a = a + b[0]
+        if act_method == "swiglu":
+            u, g = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u) * g
+        return act(a)
+
+    args = (x,) if bias is None else (x, bias)
+    return eager_apply("fused_bias_act", fn, args, {})
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """Reference: incubate/nn/functional/fused_matmul_bias.py (CUDA
+    fused_gemm_epilogue); XLA fuses the bias add into the matmul."""
+    def fn(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if bb:
+            out = out + bb[0]
+        return out
+
+    args = (x, y) if bias is None else (x, y, bias)
+    return eager_apply("fused_matmul_bias", fn, args, {})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: incubate/nn/functional/fused_dropout_add.py."""
+    out = F.dropout(x, p=p, training=training, mode=mode)
+    from ....tensor.math import add
+    return add(out, y)
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                is_causal=False, training=True, **_):
+    """Reference: incubate/nn/functional/fused_dot_product_attention.py
+    (cuDNN fused attention) — routed to the flash/SDPA path."""
+    return F.scaled_dot_product_attention(q, k, v, attn_mask, dropout_p,
+                                          is_causal, training)
+
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "swiglu", "fused_bias_act", "fused_matmul_bias", "fused_linear",
+    "fused_dropout_add", "fused_dot_product_attention",
+]

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply
+from ...core.dispatch import eager_apply, OPS
 from ...core.tensor import Tensor
 
 
@@ -34,16 +34,25 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     return eager_apply("layer_norm", fn, tuple(args), {})
 
 
+def _rms_norm_reference(a, *w, epsilon=1e-6):
+    var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
+    out = (a.astype(jnp.float32) / jnp.sqrt(var + epsilon)).astype(a.dtype)
+    if w:
+        out = out * w[0]
+    return out
+
+
+OPS.setdefault("rms_norm", _rms_norm_reference)
+
+
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """RMSNorm over the last axis (reference: fused_rms_norm)."""
-    def fn(a, *w):
-        var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
-        out = (a.astype(jnp.float32) / jnp.sqrt(var + epsilon)).astype(a.dtype)
-        if w:
-            out = out * w[0]
-        return out
+    """RMSNorm over the last axis (reference: fused_rms_norm).
+
+    Dispatches through the op registry so the Pallas fused kernel
+    (paddle_tpu/kernels/rms_norm.py) can override on TPU."""
     args = (x,) if weight is None else (x, weight)
-    return eager_apply("rms_norm", fn, args, {})
+    return eager_apply(
+        "rms_norm", lambda *xs: OPS["rms_norm"](*xs, epsilon=epsilon), args, {})
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,

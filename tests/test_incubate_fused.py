@@ -1,0 +1,58 @@
+"""Fused-op API surface tests (incubate.nn.functional parity)."""
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def test_fused_rms_norm_with_residual():
+    x = paddle.to_tensor(np.random.randn(2, 8, 64).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.ones(64, np.float32), stop_gradient=False)
+    r = paddle.to_tensor(np.random.randn(2, 8, 64).astype(np.float32))
+    out, res = IF.fused_rms_norm(x, w, residual=r)
+    pre = x.numpy() + r.numpy()
+    np.testing.assert_allclose(res.numpy(), pre, rtol=1e-5)
+    var = (pre ** 2).mean(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), pre / np.sqrt(var + 1e-6),
+                               rtol=1e-4, atol=1e-4)
+    out.sum().backward()
+    assert x.grad is not None and w.grad is not None
+
+
+def test_fused_layer_norm():
+    x = paddle.to_tensor(np.random.randn(4, 32).astype(np.float32))
+    w = paddle.to_tensor(np.random.randn(32).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(32).astype(np.float32))
+    out = IF.fused_layer_norm(x, w, b)
+    xn = x.numpy()
+    mu, var = xn.mean(-1, keepdims=True), xn.var(-1, keepdims=True)
+    ref = (xn - mu) / np.sqrt(var + 1e-5) * w.numpy() + b.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_rotary_position_embedding():
+    q = paddle.to_tensor(np.random.randn(2, 16, 4, 32).astype(np.float32))
+    k = paddle.to_tensor(np.random.randn(2, 16, 4, 32).astype(np.float32))
+    oq, ok, ov = IF.fused_rotary_position_embedding(q, k)
+    assert ov is None
+    assert oq.shape == q.shape and ok.shape == k.shape
+    # norm-preserving per rotated pair
+    np.testing.assert_allclose(
+        np.linalg.norm(oq.numpy(), axis=-1),
+        np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+
+
+def test_fused_bias_act_swiglu_and_matmul_bias():
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(16).astype(np.float32))
+    out = IF.fused_bias_act(x, b, act_method="swiglu")
+    a = x.numpy() + b.numpy()
+    u, g = a[:, :8], a[:, 8:]
+    ref = u / (1 + np.exp(-u)) * g
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    w = paddle.to_tensor(np.random.randn(16, 8).astype(np.float32))
+    bias = paddle.to_tensor(np.random.randn(8).astype(np.float32))
+    y = IF.fused_matmul_bias(x, w, bias)
+    np.testing.assert_allclose(y.numpy(), x.numpy() @ w.numpy() + bias.numpy(),
+                               rtol=1e-4, atol=1e-4)
