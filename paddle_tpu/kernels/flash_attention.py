@@ -227,15 +227,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
-    b, hq, sq, d = q.shape
-    _, hkv, sk, _ = k.shape
-    group = hq // hkv
-    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
-    offset = sk - sq
-
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                                    # [b, hq, sq]
+    return _bwd_impl(q, k, v, do, lse, delta, scale=scale, causal=causal,
+                     block_q=block_q, block_k=block_k, interpret=interpret)
+
+
+def _bwd_impl(q, k, v, do, lse, delta, *, scale, causal, block_q, block_k,
+              interpret):
+    """Flash backward given saved softmax stats (also the per-block engine
+    of ring attention, where ``lse`` is the globally-combined logsumexp)."""
+    b, hq, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    group = hq // hkv
+    block_q, block_k = min(block_q, sq), min(block_k, sk)
+    nq, nk = pl.cdiv(sq, block_q), pl.cdiv(sk, block_k)
+    offset = sk - sq
+
     lse_r = lse.reshape(b, hq, 1, sq)
     delta_r = delta.reshape(b, hq, 1, sq)
 
@@ -329,6 +338,24 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                             interpret=False):
+    """Forward-only flash attention returning (out, logsumexp [b, h, s]).
+
+    The block-level engine of ring attention (distributed/context_parallel);
+    not differentiable by itself — ring attention defines its own VJP over
+    the combined statistics.
+    """
+    b, hq, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    return _fwd(q, k, v, scale=float(scale), causal=bool(causal),
+                block_q=min(block_q, sq), block_k=min(block_k, sk),
+                interpret=bool(interpret))
 
 
 def flash_attention(q, k, v, causal=False, scale=None,

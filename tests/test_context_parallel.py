@@ -1,0 +1,160 @@
+"""Ring attention + Ulysses context parallelism on the 8-device CPU mesh.
+
+Parity target: single-device attention over the full sequence. Mirrors the
+reference test strategy (multi-device single-host stand-in, SURVEY.md §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.context_parallel import (
+    ring_attention_p, ulysses_attention_p,
+)
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+
+def _mk_mesh():
+    return dist.init_mesh({"sep": 8})
+
+
+def _rand_qkv(rng, b=2, s=64, h=8, d=16, dtype=jnp.float32):
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_forward(causal):
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(0)
+    q, k, v = _rand_qkv(rng)
+    out = ring_attention_p(q, k, v, mesh, causal=causal, impl="xla")
+    ref = _sdpa_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_grads(causal):
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(1)
+    q, k, v = _rand_qkv(rng, b=1, s=32, h=4, d=8)
+
+    def f_ring(q, k, v):
+        return (ring_attention_p(q, k, v, mesh, causal=causal,
+                                 impl="xla") ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (_sdpa_reference(q, k, v, causal=causal) ** 2).sum()
+
+    gp = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_gqa():
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(2)
+    b, s, d = 1, 64, 16
+    q = jnp.asarray(rng.normal(size=(b, s, 8, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, 2, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, 2, d)), jnp.float32)
+    out = ring_attention_p(q, k, v, mesh, causal=True, impl="xla")
+    kr = jnp.repeat(k, 4, axis=2)
+    vr = jnp.repeat(v, 4, axis=2)
+    ref = _sdpa_reference(q, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    gq = jax.grad(lambda q, k, v: (ring_attention_p(
+        q, k, v, mesh, causal=True, impl="xla") ** 2).sum(),
+        argnums=(1,))(q, k, v)[0]
+    gr_ = jax.grad(lambda q, k, v: (_sdpa_reference(
+        q, jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2),
+        causal=True) ** 2).sum(), argnums=(1,))(q, k, v)[0]
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(gr_),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_ring_attention_inside_jit_with_sharding():
+    """Ring attention composes with jit + explicit input shardings."""
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(3)
+    q, k, v = _rand_qkv(rng, b=1, s=128, h=4, d=16)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sh = NamedSharding(mesh.jax_mesh, P(None, "sep"))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    f = jax.jit(lambda q, k, v: ring_attention_p(q, k, v, mesh, causal=True,
+                                                 impl="xla"))
+    out = f(q, k, v)
+    ref = _sdpa_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_pallas_interpret_block():
+    """Ring with the Pallas per-block engine (interpret mode), 128-blocks."""
+    mesh = dist.init_mesh({"sep": 2}, None) if False else None
+    # use 2-way ring so each local shard is >= one 128 block
+    import numpy as np
+    mesh = dist.ProcessMesh(np.arange(2).reshape(2), ["sep"])
+    rng = np.random.default_rng(4)
+    q, k, v = _rand_qkv(rng, b=1, s=256, h=2, d=64)
+    out = ring_attention_p(q, k, v, mesh, causal=True,
+                           impl="pallas_interpret")
+    ref = _sdpa_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+    gp = jax.grad(lambda q, k, v: (ring_attention_p(
+        q, k, v, mesh, causal=True, impl="pallas_interpret") ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_sdpa_reference(
+        q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention(causal):
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(5)
+    q, k, v = _rand_qkv(rng, b=2, s=64, h=8, d=16)
+    out = ulysses_attention_p(q, k, v, mesh, causal=causal, impl="xla")
+    ref = _sdpa_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_grads():
+    mesh = _mk_mesh()
+    rng = np.random.default_rng(6)
+    q, k, v = _rand_qkv(rng, b=1, s=32, h=8, d=8)
+    gp = jax.grad(lambda q, k, v: (ulysses_attention_p(
+        q, k, v, mesh, causal=True, impl="xla") ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_sdpa_reference(
+        q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_eager_tensor_surface():
+    mesh = _mk_mesh()
+    dist.set_mesh(mesh)
+    rng = np.random.default_rng(7)
+    q = paddle.to_tensor(rng.normal(size=(1, 64, 4, 16)).astype(np.float32),
+                         stop_gradient=False)
+    out = dist.ring_attention(q, q, q, causal=True, impl="xla")
+    ref = _sdpa_reference(q.numpy(), q.numpy(), q.numpy(), causal=True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+    out.sum().backward()
+    assert q.grad is not None and np.isfinite(q.grad.numpy()).all()
