@@ -208,7 +208,7 @@ def ring_attention_p(q, k, v, mesh, axis_name="sep", causal=True, scale=None,
 
     spec = P(None, axis_name, None, None)
     fn = shard_map(body, mesh=jmesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
@@ -254,7 +254,7 @@ def ulysses_attention_p(q, k, v, mesh, axis_name="sep", causal=True,
 
     spec = P(None, axis_name, None, None)
     fn = shard_map(body, mesh=jmesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
