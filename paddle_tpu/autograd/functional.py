@@ -1,0 +1,141 @@
+"""Functional autograd: jacobian / hessian / vjp / jvp.
+
+TPU-native analog of the reference's functional AD
+(reference: python/paddle/autograd/autograd.py:461 jacobian, :587 hessian;
+python/paddle/incubate/autograd/functional.py vjp/jvp). Where the reference
+builds these from double backward over its eager tape, here they lower to
+JAX's native transforms (jacrev/hessian/vjp/jvp) over a purified version of
+the user function — strictly more capable (arbitrary-order AD) and they
+compose with jit.
+
+Two call forms are accepted for ``jacobian``:
+- ``jacobian(func, xs)`` with a callable — preferred, uses jax.jacrev.
+- ``jacobian(ys, xs)`` with tape tensors — row-by-row tape backward
+  (the reference's Jacobian object semantics, autograd.py:461).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+
+def _purify(func):
+    """Wrap a Tensor->Tensor function as a pure array function.
+
+    Runs the function with tape recording off; JAX tracers flow through the
+    eager ops' jnp bodies directly.
+    """
+
+    def pure(*arrays):
+        with no_grad():
+            tensors = [Tensor(a, stop_gradient=True) for a in arrays]
+            out = func(*tensors)
+        return jax.tree.map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    return pure
+
+
+def _unwrap(xs):
+    if isinstance(xs, Tensor):
+        return [xs._data], True
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs], False
+
+
+def _wrap_tree(tree):
+    return jax.tree.map(lambda a: Tensor(a, stop_gradient=True), tree)
+
+
+def jacobian(func_or_ys, xs, batch_axis=None):
+    if callable(func_or_ys):
+        arrays, single = _unwrap(xs)
+        pure = _purify(func_or_ys)
+        jac = jax.jacrev(lambda *a: pure(*a), argnums=tuple(range(len(arrays))))(*arrays)
+        if single:
+            jac = jax.tree.map(lambda j: j[0] if isinstance(j, tuple) else j, jac,
+                               is_leaf=lambda x: isinstance(x, tuple))
+            jac = jac if not isinstance(jac, tuple) else jac[0]
+        return _wrap_tree(jac)
+
+    # Tape form: ys produced from xs already on the tape.
+    ys = func_or_ys
+    single_y = isinstance(ys, Tensor)
+    ys_list = [ys] if single_y else list(ys)
+    single_x = isinstance(xs, Tensor)
+    xs_list = [xs] if single_x else list(xs)
+
+    rows_per_y = []
+    for y in ys_list:
+        flat_n = int(jnp.size(y._data))
+        rows = [[] for _ in xs_list]
+        for i in range(flat_n):
+            seed = jnp.zeros((flat_n,), y._data.dtype).at[i].set(1.0).reshape(y._data.shape)
+            gs = _ag.grad([y], xs_list, grad_outputs=[Tensor(seed)],
+                          retain_graph=True, allow_unused=True)
+            for k, g in enumerate(gs):
+                arr = (g._data if g is not None
+                       else jnp.zeros(xs_list[k]._data.shape, y._data.dtype))
+                rows[k].append(arr.reshape(-1))
+        mats = [jnp.stack(r) for r in rows]  # (numel_y, numel_x)
+        rows_per_y.append(mats[0] if single_x else mats)
+    out = rows_per_y[0] if single_y else rows_per_y
+    return jax.tree.map(lambda a: Tensor(a, stop_gradient=True), out)
+
+
+def hessian(func, xs, batch_axis=None):
+    """Hessian of a scalar-output function w.r.t. xs (callable form only)."""
+    if not callable(func):
+        raise TypeError(
+            "hessian requires the callable form hessian(func, xs); the tape "
+            "does not support double backward (see SURVEY.md §7 hard part 4)")
+    arrays, single = _unwrap(xs)
+    pure = _purify(func)
+
+    def scalar(*a):
+        out = pure(*a)
+        leaves = jax.tree.flatten(out)[0]
+        return jnp.reshape(leaves[0], ())
+
+    h = jax.hessian(scalar, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        while isinstance(h, tuple):
+            h = h[0]
+    return _wrap_tree(h)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) — cotangent pullback (incubate.autograd.vjp)."""
+    arrays, single = _unwrap(xs)
+    pure = _purify(func)
+    out, f_vjp = jax.vjp(lambda *a: pure(*a), *arrays)
+    if v is None:
+        leaves = jax.tree.flatten(out)[0]
+        v_arr = jax.tree.unflatten(jax.tree.structure(out),
+                                   [jnp.ones_like(l) for l in leaves])
+    else:
+        v_arr = jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t),
+                             v, is_leaf=lambda x: isinstance(x, Tensor))
+    grads = f_vjp(v_arr)
+    grads = grads[0] if single else list(grads)
+    return _wrap_tree(out), _wrap_tree(grads)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — tangent pushforward (incubate.autograd.jvp)."""
+    arrays, single = _unwrap(xs)
+    pure = _purify(func)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+        tangents = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in v_list]
+    out, tang = jax.jvp(lambda *a: pure(*a), tuple(arrays), tuple(tangents))
+    return _wrap_tree(out), _wrap_tree(tang)
+
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]

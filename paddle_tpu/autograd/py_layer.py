@@ -1,0 +1,135 @@
+"""PyLayer: user-defined differentiable ops on the eager tape.
+
+TPU-native analog of the reference's custom PyLayer
+(reference: paddle/fluid/eager/pylayer/, python/paddle/autograd/py_layer.py).
+The user's ``backward`` staticmethod becomes the GradNode's vjp function
+directly — no C++ shim needed because the tape (core/autograd.py) accepts any
+callable as a node kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core.autograd import GradNode, no_grad
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    """Saved-state container passed as ``ctx`` to forward/backward
+    (reference: python/paddle/autograd/py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with ``forward(ctx, *args)`` / ``backward(ctx, *grads)``
+    staticmethods; invoke via ``apply``.
+
+    ``backward`` must return one grad (Tensor or None) per Tensor argument of
+    ``forward``, in order — extras for non-differentiable inputs may be None
+    or omitted from the end.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+
+        flat, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+        record = _ag.is_grad_enabled() and any(
+            not flat[i].stop_gradient for i in tensor_idx)
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        if not record:
+            return out
+
+        diff_idx = [i for i in tensor_idx
+                    if not flat[i].stop_gradient
+                    and jnp.issubdtype(jnp.result_type(flat[i]._data), jnp.inexact)]
+        diff_tensors = [flat[i] for i in diff_idx]
+        # map flat-position -> position among tensor args (backward's output order)
+        tensor_pos = {i: k for k, i in enumerate(tensor_idx)}
+
+        edges = []
+        for t in diff_tensors:
+            if t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._output_slot))
+            else:
+                edges.append(("leaf", t))
+
+        single = isinstance(out, Tensor)
+        out_list = [out] if single else list(jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))[0])
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_avals = [(tuple(o._data.shape), o._data.dtype) for o in out_tensors]
+
+        def vjp_fn(cotangent_struct):
+            cots = jax.tree.flatten(cotangent_struct)[0]
+            grad_in = [Tensor(c, stop_gradient=True) for c in cots]
+            with no_grad():
+                res = cls.backward(ctx, *grad_in)
+            if isinstance(res, (Tensor, type(None))) or not isinstance(res, (tuple, list)):
+                res = (res,)
+            res = list(res)
+            # Align: user returns one grad per *tensor* input of forward.
+            out_grads = []
+            for i, t in zip(diff_idx, diff_tensors):
+                pos = tensor_pos[i]
+                g = res[pos] if pos < len(res) else None
+                if g is None:
+                    out_grads.append(jnp.zeros(t._data.shape, t._data.dtype))
+                else:
+                    g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+                    out_grads.append(g)
+            return out_grads
+
+        # out_treedef: flat list of cotangents arrives; keep as a list treedef
+        _, list_treedef = jax.tree.flatten([0] * len(out_tensors))
+        node = GradNode(f"PyLayer({cls.__name__})", vjp_fn, edges,
+                        out_avals, list_treedef)
+        for slot, o in enumerate(out_tensors):
+            o._grad_node = node
+            o._output_slot = slot
+            o.stop_gradient = False
+        return out
+
+
+def once_differentiable(backward_fn):
+    """Decorator marker (grads produced under no_grad — always true here)."""
+    return backward_fn
+
+
+__all__ = ["PyLayer", "PyLayerContext", "once_differentiable"]

@@ -20,6 +20,7 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from .recompute import recompute, recompute_sequential, recompute_pure  # noqa: F401
 from ..collective import get_rank, get_world_size, init_parallel_env
 
 

@@ -65,8 +65,21 @@ def pipeline_apply(stage_params, x, stage_fn, mesh, axis_name="pp",
     """
     jmesh = getattr(mesh, "jax_mesh", mesh)
     n_stages = jmesh.shape[axis_name]
-    n_micro = x.shape[0] if n_microbatches is None else n_microbatches
-    vpp = jax.tree.leaves(stage_params)[0].shape[0] // n_stages
+    if schedule not in ("fthenb", "1f1b", "interleaved"):
+        raise ValueError(
+            f"unknown schedule {schedule!r}; expected 'fthenb', '1f1b' or "
+            "'interleaved'")
+    if n_microbatches is not None and n_microbatches != x.shape[0]:
+        raise ValueError(
+            f"n_microbatches={n_microbatches} != x.shape[0]={x.shape[0]}; "
+            "the input's leading axis is the microbatch axis")
+    n_micro = x.shape[0]
+    n_chunks = jax.tree.leaves(stage_params)[0].shape[0]
+    if n_chunks % n_stages != 0:
+        raise ValueError(
+            f"stacked stage count {n_chunks} is not a multiple of the pp "
+            f"axis size {n_stages}")
+    vpp = n_chunks // n_stages
     if schedule == "interleaved" and vpp == 1:
         schedule = "1f1b"
 
@@ -86,47 +99,16 @@ def pipeline_apply(stage_params, x, stage_fn, mesh, axis_name="pp",
         order = jnp.asarray([l * n_stages + r for r in range(n_stages)
                              for l in range(vpp)])
         stage_params = jax.tree.map(lambda leaf: leaf[order], stage_params)
-        body = functools.partial(_interleaved_body, fn=fn,
-                                 axis_name=axis_name, n_micro=n_micro,
-                                 n_stages=n_stages, vpp=vpp)
-    else:
-        body = functools.partial(_circular_body, fn=fn, axis_name=axis_name,
-                                 n_micro=n_micro, n_stages=n_stages)
+    # vpp == 1 is the plain circular pipeline — the interleaved body
+    # degenerates to it exactly (single local chunk, injection overwrites
+    # the wrap slot on device 0), so one body serves every schedule.
+    body = functools.partial(_interleaved_body, fn=fn, axis_name=axis_name,
+                             n_micro=n_micro, n_stages=n_stages, vpp=vpp)
 
     out_spec = x_spec
     mapped = shard_map(body, mesh=jmesh, in_specs=(param_spec, x_spec),
                        out_specs=out_spec, check_vma=False)
     return mapped(stage_params, x)
-
-
-def _circular_body(params, x, *, fn, axis_name, n_micro, n_stages):
-    """One physical stage per device; T = n_micro + n_stages - 1 ticks."""
-    r = jax.lax.axis_index(axis_name)
-    params = jax.tree.map(lambda l: l[0], params)   # [1, ...] -> [...]
-    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    T = n_micro + n_stages - 1
-    is_last = r == n_stages - 1
-
-    def tick(carry, t):
-        cur_in, outs = carry
-        x0 = x[jnp.clip(t, 0, n_micro - 1)]
-        xi = jnp.where(r == 0, x0, cur_in)
-        y = fn(params, xi)
-        oidx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
-        take = jnp.logical_and(is_last, t >= n_stages - 1)
-        outs = jax.lax.dynamic_update_index_in_dim(
-            outs,
-            jnp.where(take, y, jax.lax.dynamic_index_in_dim(
-                outs, oidx, 0, keepdims=False)),
-            oidx, 0)
-        nxt = jax.lax.ppermute(y, axis_name, shift)
-        return (nxt, outs), None
-
-    init = (jnp.zeros_like(x[0]), jnp.zeros_like(x))
-    (_, outs), _ = jax.lax.scan(tick, init, jnp.arange(T))
-    # only the last stage holds real outputs; replicate over pp
-    outs = jnp.where(is_last, outs, 0.0)
-    return jax.lax.psum(outs, axis_name)
 
 
 def _interleaved_body(params, x, *, fn, axis_name, n_micro, n_stages, vpp):
