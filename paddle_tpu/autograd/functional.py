@@ -52,14 +52,18 @@ def _wrap_tree(tree):
 
 
 def jacobian(func_or_ys, xs, batch_axis=None):
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis is not supported; vmap the callable form instead")
     if callable(func_or_ys):
         arrays, single = _unwrap(xs)
         pure = _purify(func_or_ys)
-        jac = jax.jacrev(lambda *a: pure(*a), argnums=tuple(range(len(arrays))))(*arrays)
         if single:
-            jac = jax.tree.map(lambda j: j[0] if isinstance(j, tuple) else j, jac,
-                               is_leaf=lambda x: isinstance(x, tuple))
-            jac = jac if not isinstance(jac, tuple) else jac[0]
+            # argnums=0 keeps the output-major structure with plain array
+            # leaves (no per-argument tuples to unwrap)
+            jac = jax.jacrev(pure)(*arrays)
+        else:
+            jac = jax.jacrev(pure, argnums=tuple(range(len(arrays))))(*arrays)
         return _wrap_tree(jac)
 
     # Tape form: ys produced from xs already on the tape.
@@ -89,6 +93,9 @@ def jacobian(func_or_ys, xs, batch_axis=None):
 
 def hessian(func, xs, batch_axis=None):
     """Hessian of a scalar-output function w.r.t. xs (callable form only)."""
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis is not supported; vmap the callable form instead")
     if not callable(func):
         raise TypeError(
             "hessian requires the callable form hessian(func, xs); the tape "

@@ -18,12 +18,16 @@ from ..core.tensor import Tensor
 
 class PyLayerContext:
     """Saved-state container passed as ``ctx`` to forward/backward
-    (reference: python/paddle/autograd/py_layer.py PyLayerContext)."""
+    (reference: python/paddle/autograd/py_layer.py PyLayerContext).
+
+    Deviation from the reference: ``set_materialize_grads(False)`` and
+    ``mark_not_inplace`` are not provided — the engine always materializes
+    zero cotangents for unused outputs, and eager tensors are never
+    aliased in place on this stack.
+    """
 
     def __init__(self):
         self._saved = ()
-        self.materialize_grads = True
-        self.not_inplace_tensors = ()
 
     def save_for_backward(self, *tensors):
         self._saved = tensors
@@ -31,19 +35,8 @@ class PyLayerContext:
     def saved_tensor(self):
         return self._saved
 
-    def mark_not_inplace(self, *tensors):
-        self.not_inplace_tensors = tensors
 
-    def set_materialize_grads(self, value: bool):
-        self.materialize_grads = bool(value)
-
-
-class PyLayerMeta(type):
-    def __init__(cls, name, bases, attrs):
-        super().__init__(name, bases, attrs)
-
-
-class PyLayer(metaclass=PyLayerMeta):
+class PyLayer:
     """Subclass with ``forward(ctx, *args)`` / ``backward(ctx, *grads)``
     staticmethods; invoke via ``apply``.
 
@@ -51,6 +44,11 @@ class PyLayer(metaclass=PyLayerMeta):
     ``forward``, in order — extras for non-differentiable inputs may be None
     or omitted from the end.
     """
+
+    # When True, a grad node is recorded even if no Tensor argument requires
+    # grad — needed by ops whose backward produces grads for tensors closed
+    # over by a callable argument (recompute).
+    _force_record = False
 
     @staticmethod
     def forward(ctx, *args, **kwargs):
@@ -67,8 +65,8 @@ class PyLayer(metaclass=PyLayerMeta):
         flat, treedef = jax.tree.flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
         tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
-        record = _ag.is_grad_enabled() and any(
-            not flat[i].stop_gradient for i in tensor_idx)
+        record = _ag.is_grad_enabled() and (cls._force_record or any(
+            not flat[i].stop_gradient for i in tensor_idx))
 
         with no_grad():
             out = cls.forward(ctx, *args, **kwargs)

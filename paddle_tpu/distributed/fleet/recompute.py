@@ -21,6 +21,10 @@ from ...core.tensor import Tensor
 
 
 class RecomputeFunction(PyLayer):
+    # Always record: fn usually closes over trainable params, so a grad node
+    # is needed even when every explicit tensor arg has stop_gradient=True.
+    _force_record = True
+
     @staticmethod
     def forward(ctx, fn, preserve_rng_state, *args):
         ctx.fn = fn

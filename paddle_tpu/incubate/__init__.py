@@ -1,2 +1,3 @@
 """paddle_tpu.incubate — staging ground for experimental APIs (analog of python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

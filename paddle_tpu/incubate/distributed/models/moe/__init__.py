@@ -1,0 +1,3 @@
+"""MoE (mixture of experts) — analog of python/paddle/incubate/distributed/models/moe/."""
+from .gate import NaiveGate, GShardGate, SwitchGate, BaseGate, topk_gating, capacity_for  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401

@@ -1,0 +1,110 @@
+"""MoE gates: naive top-k, GShard top-2, Switch top-1.
+
+TPU-native analog of the reference's gate zoo
+(reference: python/paddle/incubate/distributed/models/moe/gate/
+{naive,gshard,switch}_gate.py). The reference computes assignment with
+custom CUDA count/sort kernels; here the whole gating decision
+(top-k -> capacity positions -> combine weights) is ONE fused primitive of
+static shape [tokens, experts, capacity] — no sorting, no dynamic shapes,
+so XLA tiles it onto the VPU and the dispatch einsum onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.dispatch import primitive
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import Linear
+
+
+def _positions_in_expert(mask, offset):
+    """mask: [T, E] 0/1 assignment for one choice-slot. Returns per-token
+    queue position within its chosen expert (cumulative arrival order)."""
+    pos = jnp.cumsum(mask, axis=0) - mask + offset[None, :]
+    return (pos * mask).sum(-1), offset + mask.sum(0)
+
+
+@primitive("moe_topk_gating")
+def topk_gating(logits, *, top_k: int, capacity: int, normalize: bool = True,
+                aux: str = "gshard"):
+    """Fused gating: returns (combine_weights [T,E,C], aux_loss []).
+
+    combine_weights is zero for dropped (over-capacity) tokens; the
+    dispatch mask is ``combine_weights > 0``. aux: 'gshard'/'switch' load
+    balancing loss (E * sum(mean_gate_e * frac_tokens_e)) or 'none'.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(gates, top_k)            # [T, k]
+    offset = jnp.zeros((E,), jnp.int32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    denom = jnp.maximum(topv.sum(-1, keepdims=True), 1e-9) if normalize else 1.0
+    for j in range(top_k):
+        m = jax.nn.one_hot(topi[:, j], E, dtype=jnp.int32)   # [T, E]
+        pos, offset = _positions_in_expert(m, offset)        # [T]
+        keep = pos < capacity
+        w = topv[:, j] / (denom[:, 0] if normalize else 1.0)
+        w = jnp.where(keep, w, 0.0)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+        combine = combine + (w[:, None] * m.astype(jnp.float32))[:, :, None] \
+            * slot[:, None, :]
+    if aux == "none":
+        aux_loss = jnp.zeros((), jnp.float32)
+    else:
+        me = gates.mean(0)                                    # [E]
+        top1 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+        ce = top1.mean(0)                                     # [E]
+        aux_loss = E * jnp.sum(me * jax.lax.stop_gradient(ce)) \
+            if aux == "switch" else E * jnp.sum(me * ce)
+    return combine, aux_loss
+
+
+def capacity_for(num_tokens: int, num_experts: int, top_k: int,
+                 capacity_factor: float) -> int:
+    return max(1, int(capacity_factor * top_k * num_tokens / num_experts))
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, top_k, capacity_factor, aux):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.aux = aux
+        self.fc = Linear(d_model, num_experts, bias_attr=False)
+
+    def forward(self, x_flat):
+        """x_flat: [T, M] -> (combine [T,E,C], aux_loss)."""
+        logits = self.fc(x_flat)
+        cap = capacity_for(int(x_flat.shape[0]), self.num_experts,
+                           self.top_k, self.capacity_factor)
+        return topk_gating(logits, top_k=self.top_k, capacity=cap,
+                           normalize=True, aux=self.aux)
+
+
+class NaiveGate(BaseGate):
+    """Top-k gate, no load-balancing loss (reference: naive_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k, capacity_factor, "none")
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with GShard load-balance loss (reference: gshard_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=2.0):
+        super().__init__(d_model, num_experts, top_k, capacity_factor, "gshard")
+
+
+class SwitchGate(BaseGate):
+    """Top-1 Switch-Transformer gate (reference: switch_gate.py)."""
+
+    def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25):
+        super().__init__(d_model, num_experts, top_k, capacity_factor, "switch")
+
+
+GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate", "BaseGate", "GATES",
+           "topk_gating", "capacity_for"]

@@ -1,0 +1,73 @@
+"""MoELayer: gated mixture-of-experts with capacity-based dense dispatch.
+
+TPU-native analog of the reference's MoELayer
+(reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:261).
+The reference routes tokens with custom count/scatter CUDA kernels and an
+explicit NCCL all-to-all over the moe group; here dispatch/combine are
+einsums over a static [tokens, experts, capacity] tensor. Under GSPMD with
+the expert axis of the stacked expert weights sharded over the ``ep`` mesh
+axis, XLA lowers the dispatch einsum to exactly the all-to-all the
+reference codes by hand (see distributed/expert_parallel.py for the
+explicit shard_map form).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+from .....tensor.einsum import einsum
+from .gate import GATES, BaseGate
+
+
+class MoELayer(Layer):
+    """``MoELayer(d_model, experts=[...], gate="gshard")``.
+
+    experts: list of Layers mapping [C, d_model] -> [C, d_model].
+    After forward, ``self.aux_loss`` holds the gate's load-balancing loss —
+    add it to the training loss (the reference accumulates it the same way,
+    moe_layer.py:261 + grad_clip.py).
+    """
+
+    def __init__(self, d_model, experts, gate="gshard", top_k=None,
+                 capacity_factor=None, recompute_interval=0, mp_group=None,
+                 moe_group=None):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = LayerList(experts)
+        self.num_experts = len(self.experts)
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        else:
+            kwargs = {}
+            if top_k is not None:
+                kwargs["top_k"] = top_k
+            if capacity_factor is not None:
+                kwargs["capacity_factor"] = capacity_factor
+            self.gate = GATES[gate](d_model, self.num_experts, **kwargs)
+        self.aux_loss = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        x_flat = x.reshape([-1, self.d_model])          # [T, M]
+        combine, aux_loss = self.gate(x_flat)           # [T, E, C], []
+        self.aux_loss = aux_loss
+        # dispatch with the 0/1 mask (weights apply on combine only)
+        mask = (combine > 0).astype(x_flat.dtype)
+        dispatched = einsum("tec,tm->ecm", mask, x_flat)    # [E, C, M]
+        outs = []
+        for e, expert in enumerate(self.experts):
+            outs.append(expert(dispatched[e]))              # [C, M]
+        expert_out = _stack(outs)                           # [E, C, M]
+        combined = einsum("tec,ecm->tm", combine.astype(x_flat.dtype),
+                          expert_out)
+        return combined.reshape(orig_shape)
+
+
+def _stack(tensors):
+    from .....tensor.manipulation import stack
+    return stack(tensors, axis=0)
+
+
+__all__ = ["MoELayer"]

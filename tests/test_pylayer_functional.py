@@ -112,3 +112,29 @@ def test_recompute_sequential():
     assert x.grad is not None
     for p in model.parameters():
         assert p.grad is not None
+
+
+def test_recompute_closed_over_params_only():
+    # inputs don't require grad; params live in the closure (finding fix)
+    import paddle_tpu.nn as nn
+    paddle.seed(1)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))  # stop_gradient
+    out = recompute(lambda t: paddle.tanh(lin(t)), x)
+    assert not out.stop_gradient
+    out.sum().backward()
+    assert lin.weight.grad is not None
+    assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+def test_jacobian_multi_output():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    j1, j2 = jacobian(lambda t: (t * t, t + 1), x)
+    np.testing.assert_allclose(j1.numpy(), np.diag([2.0, 4.0]), rtol=1e-5)
+    np.testing.assert_allclose(j2.numpy(), np.eye(2), rtol=1e-5)
+
+
+def test_jacobian_batch_axis_rejected():
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with pytest.raises(NotImplementedError):
+        jacobian(lambda t: t, x, batch_axis=0)
