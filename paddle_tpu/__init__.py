@@ -45,6 +45,13 @@ from . import distributed  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from .framework import save, load  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless
 # PADDLE_TPU_FORCE_PALLAS=1 — the interpret-mode CI path).

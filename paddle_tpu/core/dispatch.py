@@ -37,12 +37,29 @@ def _maybe_amp_cast(name, vals):
     return amp_cast_inputs(name, vals)
 
 
+# Set by paddle_tpu.profiler while a Profiler is active: (begin_fn, end_fn)
+# where begin_fn(op_name) -> token and end_fn(token). Kept as one attribute
+# so the disabled-path cost is a single None check per op.
+PROFILE_HOOK = None
+
+
 def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
     """Execute ``pure_fn`` over a mixed Tensor/array argument tree.
 
     Tensors may appear anywhere in args/kwargs (including inside lists).
     Returns Tensors mirroring the output structure.
     """
+    hook = PROFILE_HOOK  # read once: another thread may clear it mid-op
+    if hook is not None:
+        tok = hook[0](name)
+        try:
+            return _eager_apply_inner(name, pure_fn, args, kwargs)
+        finally:
+            hook[1](tok)
+    return _eager_apply_inner(name, pure_fn, args, kwargs)
+
+
+def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
     tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
     record = autograd.is_grad_enabled() and any(

@@ -34,6 +34,14 @@ class _Flag:
         return self.type(s)
 
 
+def _native():
+    try:
+        from . import native
+        return native if native.AVAILABLE else None
+    except Exception:
+        return None
+
+
 class FlagRegistry:
     def __init__(self):
         self._flags: dict[str, _Flag] = {}
@@ -45,6 +53,9 @@ class FlagRegistry:
                 return self._flags[name]
             f = _Flag(name, type_, default, help_)
             self._flags[name] = f
+            nv = _native()
+            if nv is not None:
+                nv.flags.define(name, f.value, help_)
             return f
 
     def get(self, name: str):
@@ -53,6 +64,9 @@ class FlagRegistry:
     def set(self, name: str, value):
         f = self._flags[name]
         f.value = value if isinstance(value, f.type) or f.type is Any else f._parse(str(value))
+        nv = _native()
+        if nv is not None:
+            nv.flags.set(f.name, f.value)
 
     def __contains__(self, name):
         return name in self._flags

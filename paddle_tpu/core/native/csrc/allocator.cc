@@ -1,0 +1,106 @@
+// Caching best-fit host allocator with stats.
+// Reference design: AutoGrowthBestFitAllocator (paddle/phi/core/memory/
+// allocation/auto_growth_best_fit_allocator.h:30 — the default caching
+// allocator) + stats (paddle/phi/core/memory/stats.h). On TPU device HBM
+// is managed by PJRT; this allocator serves host staging buffers (batch
+// collation, checkpoint IO) where malloc/free churn at batch rate would
+// fragment and stall the input pipeline.
+#include "api.h"
+
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+namespace {
+
+constexpr size_t kAlignment = 64;  // cacheline; also good for dma staging
+
+size_t align_up(size_t n) { return (n + kAlignment - 1) & ~(kAlignment - 1); }
+
+struct Stats {
+  size_t allocated = 0;
+  size_t reserved = 0;
+  size_t peak = 0;
+};
+
+std::mutex g_mu;
+// free chunks: size -> ptrs (best-fit = lower_bound on the multimap)
+std::multimap<size_t, void*>& free_chunks() {
+  static std::multimap<size_t, void*> m;
+  return m;
+}
+// live allocations: ptr -> size
+std::unordered_map<void*, size_t>& live() {
+  static std::unordered_map<void*, size_t> m;
+  return m;
+}
+Stats& stats() {
+  static Stats s;
+  return s;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_alloc(size_t nbytes) {
+  size_t sz = align_up(nbytes ? nbytes : 1);
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& fc = free_chunks();
+  // best fit: smallest cached chunk >= sz, but not > 2x (avoid waste)
+  auto it = fc.lower_bound(sz);
+  if (it != fc.end() && it->first <= sz * 2) {
+    void* p = it->second;
+    size_t chunk = it->first;
+    fc.erase(it);
+    live()[p] = chunk;
+    stats().allocated += chunk;
+    if (stats().allocated > stats().peak) stats().peak = stats().allocated;
+    return p;
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, kAlignment, sz) != 0) return nullptr;
+  live()[p] = sz;
+  stats().allocated += sz;
+  stats().reserved += sz;
+  if (stats().allocated > stats().peak) stats().peak = stats().allocated;
+  return p;
+}
+
+void pt_free(void* ptr) {
+  if (!ptr) return;
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = live().find(ptr);
+  if (it == live().end()) return;  // not ours
+  size_t sz = it->second;
+  live().erase(it);
+  stats().allocated -= sz;
+  free_chunks().emplace(sz, ptr);  // cache for reuse
+}
+
+size_t pt_mem_allocated() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return stats().allocated;
+}
+
+size_t pt_mem_reserved() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return stats().reserved;
+}
+
+size_t pt_mem_peak() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return stats().peak;
+}
+
+void pt_mem_release_cached() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : free_chunks()) {
+    std::free(kv.second);
+    stats().reserved -= kv.first;
+  }
+  free_chunks().clear();
+}
+
+}  // extern "C"

@@ -1,0 +1,80 @@
+// C ABI of the paddle_tpu native runtime.
+//
+// TPU-native analog of the reference's C++ core pieces that live below the
+// compute path (SURVEY.md §2.4): flags registry (paddle/common/flags.h:38),
+// host event recorder (paddle/phi/api/profiler/host_event_recorder.h),
+// caching host allocator (paddle/phi/core/memory/allocation/
+// auto_growth_best_fit_allocator.h:30), async work queue
+// (paddle/fluid/framework/new_executor/workqueue/). The TPU compute path is
+// XLA; this layer provides the host-side runtime around it and is bound to
+// Python via ctypes (no pybind11 in this image).
+#pragma once
+#include <cstddef>
+#include <cstdint>
+
+#if defined(_WIN32)
+#define PT_EXPORT __declspec(dllexport)
+#else
+#define PT_EXPORT __attribute__((visibility("default")))
+#endif
+
+extern "C" {
+
+// ---- flags (flags.cc) ----
+PT_EXPORT int pt_flag_define(const char* name, const char* default_value,
+                             const char* help);
+PT_EXPORT int pt_flag_set(const char* name, const char* value);
+// Returns length written (excl. NUL) or -1 if unknown flag.
+PT_EXPORT int pt_flag_get(const char* name, char* out, size_t out_len);
+PT_EXPORT int pt_flag_count();
+PT_EXPORT int pt_flag_name_at(int idx, char* out, size_t out_len);
+// Re-scan environment for FLAGS_<name> overrides.
+PT_EXPORT void pt_flags_bind_env();
+
+// ---- host event recorder (profiler.cc) ----
+PT_EXPORT void pt_prof_enable(int enabled);
+PT_EXPORT int pt_prof_enabled();
+// Begin a span on this thread; returns a correlation id.
+PT_EXPORT uint64_t pt_prof_begin(const char* name, int category);
+PT_EXPORT void pt_prof_end(uint64_t id);
+// Record an instant event.
+PT_EXPORT void pt_prof_instant(const char* name, int category);
+PT_EXPORT void pt_prof_clear();
+PT_EXPORT size_t pt_prof_event_count();
+// Dump chrome://tracing JSON; returns 0 on success.
+PT_EXPORT int pt_prof_dump_chrome(const char* path);
+// Copy events out: per event writes {name_offset, tid, start_ns, dur_ns,
+// category} into the arrays; names go into name_buf NUL-separated.
+PT_EXPORT size_t pt_prof_export(uint64_t* starts_ns, uint64_t* durs_ns,
+                                uint64_t* tids, int32_t* categories,
+                                char* name_buf, size_t name_buf_len,
+                                size_t max_events);
+
+// ---- caching best-fit host allocator (allocator.cc) ----
+PT_EXPORT void* pt_alloc(size_t nbytes);
+PT_EXPORT void pt_free(void* ptr);
+PT_EXPORT size_t pt_mem_allocated();   // live bytes
+PT_EXPORT size_t pt_mem_reserved();    // live + cached bytes
+PT_EXPORT size_t pt_mem_peak();        // high-water mark of live bytes
+PT_EXPORT void pt_mem_release_cached();// return cached chunks to the OS
+
+// ---- async work queue (workqueue.cc) ----
+PT_EXPORT void* pt_wq_create(int num_threads);
+PT_EXPORT void pt_wq_destroy(void* wq);
+// Submit job with dependencies (job ids it must run after). fn is a C
+// callback taking ctx. Returns the new job id.
+typedef void (*pt_job_fn)(void* ctx);
+PT_EXPORT uint64_t pt_wq_submit(void* wq, pt_job_fn fn, void* ctx,
+                                const uint64_t* deps, size_t n_deps);
+PT_EXPORT void pt_wq_wait(void* wq, uint64_t job_id);
+PT_EXPORT void pt_wq_wait_all(void* wq);
+
+// ---- batch collation (collate.cc) ----
+// Gather n_samples sample buffers (each sample_bytes) into dst, parallel
+// across the work queue. Strided variant: copies respecting an
+// interleave for channel-last -> channel-first style repacks are done in
+// numpy; this is the contiguous fast path.
+PT_EXPORT void pt_collate(void* wq, void* dst, const void** srcs,
+                          size_t n_samples, size_t sample_bytes);
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+// Flags registry with FLAGS_* environment binding.
+// Reference design: paddle/common/flags.h:38 PD_DEFINE_* + flags_native.cc
+// (registry, env override, get/set API surfaced to Python via
+// paddle.set_flags/get_flags).
+#include "api.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct FlagEntry {
+  std::string value;
+  std::string default_value;
+  std::string help;
+};
+
+std::mutex g_mu;
+std::map<std::string, FlagEntry>& registry() {
+  static std::map<std::string, FlagEntry> r;
+  return r;
+}
+std::vector<std::string>& order() {
+  static std::vector<std::string> o;
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_flag_define(const char* name, const char* default_value,
+                   const char* help) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& r = registry();
+  if (r.count(name)) return -1;
+  FlagEntry e;
+  e.default_value = default_value ? default_value : "";
+  e.help = help ? help : "";
+  // env override wins at definition time (reference: flags_native.cc
+  // ParseCommandLineFlags + GetValueFromEnv)
+  std::string env_name = std::string("FLAGS_") + name;
+  const char* env = std::getenv(env_name.c_str());
+  e.value = env ? env : e.default_value;
+  r[name] = e;
+  order().push_back(name);
+  return 0;
+}
+
+int pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& r = registry();
+  auto it = r.find(name);
+  if (it == r.end()) return -1;
+  it->second.value = value ? value : "";
+  return 0;
+}
+
+int pt_flag_get(const char* name, char* out, size_t out_len) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& r = registry();
+  auto it = r.find(name);
+  if (it == r.end()) return -1;
+  const std::string& v = it->second.value;
+  size_t n = v.size() < out_len - 1 ? v.size() : out_len - 1;
+  std::memcpy(out, v.data(), n);
+  out[n] = '\0';
+  return static_cast<int>(v.size());
+}
+
+int pt_flag_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return static_cast<int>(order().size());
+}
+
+int pt_flag_name_at(int idx, char* out, size_t out_len) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& o = order();
+  if (idx < 0 || idx >= static_cast<int>(o.size())) return -1;
+  const std::string& v = o[idx];
+  size_t n = v.size() < out_len - 1 ? v.size() : out_len - 1;
+  std::memcpy(out, v.data(), n);
+  out[n] = '\0';
+  return static_cast<int>(v.size());
+}
+
+void pt_flags_bind_env() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : registry()) {
+    std::string env_name = std::string("FLAGS_") + kv.first;
+    const char* env = std::getenv(env_name.c_str());
+    if (env) kv.second.value = env;
+  }
+}
+
+}  // extern "C"

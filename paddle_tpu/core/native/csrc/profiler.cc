@@ -1,0 +1,164 @@
+// Host event recorder: lock-free-ish per-thread span buffers merged on
+// export, chrome://tracing JSON dump.
+// Reference design: paddle/phi/api/profiler/host_event_recorder.h
+// (thread-local event sections), paddle/fluid/platform/profiler/
+// host_tracer.cc + chrometracing_logger.cc. The device half of profiling on
+// TPU comes from xplane via jax.profiler; this recorder covers host spans.
+#include "api.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t tid;
+  uint64_t start_ns;
+  uint64_t dur_ns;  // 0 => instant
+  int32_t category;
+};
+
+struct OpenSpan {
+  std::string name;
+  uint64_t start_ns;
+  int32_t category;
+};
+
+std::atomic<int> g_enabled{0};
+std::atomic<uint64_t> g_next_id{1};
+
+std::mutex g_mu;
+std::vector<Event>& events() {
+  static std::vector<Event> e;
+  return e;
+}
+
+// open spans keyed by correlation id (cross-thread end allowed)
+std::mutex g_open_mu;
+std::vector<std::pair<uint64_t, OpenSpan>>& open_spans() {
+  static std::vector<std::pair<uint64_t, OpenSpan>> s;
+  return s;
+}
+
+uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t this_tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id());
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_prof_enable(int enabled) { g_enabled.store(enabled ? 1 : 0); }
+int pt_prof_enabled() { return g_enabled.load(); }
+
+uint64_t pt_prof_begin(const char* name, int category) {
+  if (!g_enabled.load()) return 0;
+  uint64_t id = g_next_id.fetch_add(1);
+  OpenSpan s{name ? name : "", now_ns(), category};
+  std::lock_guard<std::mutex> lk(g_open_mu);
+  open_spans().emplace_back(id, std::move(s));
+  return id;
+}
+
+void pt_prof_end(uint64_t id) {
+  if (id == 0) return;
+  uint64_t end = now_ns();
+  OpenSpan s;
+  bool found = false;
+  {
+    std::lock_guard<std::mutex> lk(g_open_mu);
+    auto& os = open_spans();
+    for (auto it = os.rbegin(); it != os.rend(); ++it) {
+      if (it->first == id) {
+        s = it->second;
+        os.erase(std::next(it).base());
+        found = true;
+        break;
+      }
+    }
+  }
+  if (!found) return;
+  Event e{s.name, this_tid(), s.start_ns, end - s.start_ns, s.category};
+  std::lock_guard<std::mutex> lk(g_mu);
+  events().push_back(std::move(e));
+}
+
+void pt_prof_instant(const char* name, int category) {
+  if (!g_enabled.load()) return;
+  Event e{name ? name : "", this_tid(), now_ns(), 0, category};
+  std::lock_guard<std::mutex> lk(g_mu);
+  events().push_back(std::move(e));
+}
+
+void pt_prof_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  events().clear();
+}
+
+size_t pt_prof_event_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return events().size();
+}
+
+int pt_prof_dump_chrome(const char* path) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fprintf(f, "{\"traceEvents\":[\n");
+  bool first = true;
+  for (const auto& e : events()) {
+    if (!first) std::fprintf(f, ",\n");
+    first = false;
+    if (e.dur_ns == 0) {
+      std::fprintf(f,
+                   "{\"name\":\"%s\",\"ph\":\"i\",\"pid\":0,\"tid\":%llu,"
+                   "\"ts\":%.3f,\"cat\":\"%d\",\"s\":\"t\"}",
+                   e.name.c_str(), (unsigned long long)(e.tid % 100000),
+                   e.start_ns / 1000.0, e.category);
+    } else {
+      std::fprintf(f,
+                   "{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+                   "\"ts\":%.3f,\"dur\":%.3f,\"cat\":\"%d\"}",
+                   e.name.c_str(), (unsigned long long)(e.tid % 100000),
+                   e.start_ns / 1000.0, e.dur_ns / 1000.0, e.category);
+    }
+  }
+  std::fprintf(f, "\n]}\n");
+  std::fclose(f);
+  return 0;
+}
+
+size_t pt_prof_export(uint64_t* starts_ns, uint64_t* durs_ns, uint64_t* tids,
+                      int32_t* categories, char* name_buf,
+                      size_t name_buf_len, size_t max_events) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& ev = events();
+  size_t n = ev.size() < max_events ? ev.size() : max_events;
+  size_t off = 0;
+  for (size_t i = 0; i < n; ++i) {
+    starts_ns[i] = ev[i].start_ns;
+    durs_ns[i] = ev[i].dur_ns;
+    tids[i] = ev[i].tid;
+    categories[i] = ev[i].category;
+    size_t len = ev[i].name.size() + 1;
+    if (off + len > name_buf_len) return i;  // truncated
+    std::memcpy(name_buf + off, ev[i].name.c_str(), len);
+    off += len;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,142 @@
+// Async work queue: thread pool executing a DAG of jobs by dependency
+// count — the host-side scheduling skeleton of the reference's executor.
+// Reference design: paddle/fluid/framework/new_executor/workqueue/
+// (AsyncWorkQueue) + dependency_builder.cc (in-degree scheduling, SURVEY.md
+// §3.3). On TPU the op graph itself is compiled by XLA, so this queue
+// schedules host work: data loading, collation, checkpoint IO, callbacks.
+#include "api.h"
+
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Job {
+  pt_job_fn fn;
+  void* ctx;
+  size_t pending_deps = 0;
+  std::vector<uint64_t> dependents;
+  bool done = false;
+};
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv;        // workers wait for ready jobs
+  std::condition_variable done_cv;   // waiters wait for completions
+  std::deque<uint64_t> ready;
+  std::unordered_map<uint64_t, Job> jobs;
+  uint64_t next_id = 1;
+  size_t n_unfinished = 0;
+  bool shutdown = false;
+  std::vector<std::thread> threads;
+
+  explicit WorkQueue(int n) {
+    for (int i = 0; i < n; ++i) {
+      threads.emplace_back([this] { worker(); });
+    }
+  }
+
+  void worker() {
+    for (;;) {
+      uint64_t id;
+      pt_job_fn fn;
+      void* ctx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [this] { return shutdown || !ready.empty(); });
+        if (shutdown && ready.empty()) return;
+        id = ready.front();
+        ready.pop_front();
+        fn = jobs[id].fn;
+        ctx = jobs[id].ctx;
+      }
+      fn(ctx);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        Job& j = jobs[id];
+        j.done = true;
+        bool had_deps = !j.dependents.empty();
+        for (uint64_t dep_id : j.dependents) {
+          Job& d = jobs[dep_id];
+          if (--d.pending_deps == 0) ready.push_back(dep_id);
+        }
+        // erase the finished entry — waiters and later dep lookups treat
+        // "missing" as done, and keeping it would grow the map without
+        // bound on long-lived queues (the loader collates for every batch)
+        jobs.erase(id);
+        if (had_deps) cv.notify_all();
+        --n_unfinished;
+        done_cv.notify_all();
+      }
+    }
+  }
+
+  ~WorkQueue() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      shutdown = true;
+    }
+    cv.notify_all();
+    for (auto& t : threads) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_wq_create(int num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  return new WorkQueue(num_threads);
+}
+
+void pt_wq_destroy(void* wq) { delete static_cast<WorkQueue*>(wq); }
+
+uint64_t pt_wq_submit(void* wq_ptr, pt_job_fn fn, void* ctx,
+                      const uint64_t* deps, size_t n_deps) {
+  auto* wq = static_cast<WorkQueue*>(wq_ptr);
+  std::unique_lock<std::mutex> lk(wq->mu);
+  uint64_t id = wq->next_id++;
+  Job j;
+  j.fn = fn;
+  j.ctx = ctx;
+  for (size_t i = 0; i < n_deps; ++i) {
+    auto it = wq->jobs.find(deps[i]);
+    if (it != wq->jobs.end() && !it->second.done) {
+      it->second.dependents.push_back(id);
+      ++j.pending_deps;
+    }
+  }
+  bool runnable = j.pending_deps == 0;
+  wq->jobs[id] = std::move(j);
+  ++wq->n_unfinished;
+  if (runnable) {
+    wq->ready.push_back(id);
+    wq->cv.notify_one();
+  }
+  return id;
+}
+
+void pt_wq_wait(void* wq_ptr, uint64_t job_id) {
+  auto* wq = static_cast<WorkQueue*>(wq_ptr);
+  std::unique_lock<std::mutex> lk(wq->mu);
+  wq->done_cv.wait(lk, [&] {
+    auto it = wq->jobs.find(job_id);
+    return it == wq->jobs.end() || it->second.done;
+  });
+}
+
+void pt_wq_wait_all(void* wq_ptr) {
+  auto* wq = static_cast<WorkQueue*>(wq_ptr);
+  std::unique_lock<std::mutex> lk(wq->mu);
+  wq->done_cv.wait(lk, [&] { return wq->n_unfinished == 0; });
+}
+
+}  // extern "C"

@@ -1,0 +1,126 @@
+"""Elastic training manager: heartbeats, membership watch, scale events.
+
+TPU-native analog of the reference's elastic stack
+(reference: python/paddle/distributed/fleet/elastic/manager.py:125
+ElasticManager — etcd leases :254 heartbeat, :237 host watch, relaunch on
+scale; CollectiveElasticController launch/controllers/collective.py:267).
+ETCD is replaced by the launcher's HTTP KV store (launch/master.py), and
+"restart with new ranks" maps to re-running rendezvous + rebuilding the
+jax.distributed world — on TPU pods membership is slice-shaped, so scale
+events come in units of hosts.
+
+The reference's collective watchdog (CommTaskManager,
+paddle/phi/core/distributed/comm_task_manager.h:37) maps to
+``HealthMonitor``: a barrier-timeout watchdog over the coordination
+service — XLA collectives cannot be async-aborted mid-flight (they are
+inside compiled programs), so detection is at step granularity, which is
+also where the reference's watchdog acts.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .launch.master import Master
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Node-side agent: heartbeat + membership watch.
+
+    ``watch()`` returns an ElasticStatus; the controller reacts by
+    relaunching rendezvous (RESTART) or exiting (reference semantics:
+    manager.py watch loop).
+    """
+
+    def __init__(self, endpoint, node_id=None, job_id="default",
+                 np_target=None, heartbeat_interval=2.0, dead_horizon=15.0):
+        self.master = Master(endpoint, job_id=job_id)
+        self.node_id = node_id or f"{os.uname().nodename}-{os.getpid()}"
+        self.np_target = np_target
+        self.interval = heartbeat_interval
+        self.horizon = dead_horizon
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_alive = set()
+        self.need_sync = False
+
+    # ---- heartbeat (manager.py:254) ----
+    def start(self):
+        self.master.heartbeat(self.node_id)
+
+        def beat():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.master.heartbeat(self.node_id)
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        self._last_alive = set(self.master.alive_nodes(self.horizon))
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # ---- membership watch (manager.py:237) ----
+    def watch(self) -> str:
+        alive = set(self.master.alive_nodes(self.horizon))
+        prev, self._last_alive = self._last_alive, alive
+        # any membership change (join, loss, or equal-size swap) requires a
+        # re-rendezvous — proper-subset comparisons would miss a swap
+        if alive != prev:
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+
+class HealthMonitor:
+    """Step-granularity hang watchdog (CommTaskManager analog).
+
+    Call ``tick()`` every training step; a monitor thread flags a hang if
+    no tick lands within ``timeout`` — the reference's async comm-task
+    timeout dump, at the granularity XLA permits.
+    """
+
+    def __init__(self, timeout=300.0, on_hang=None):
+        self.timeout = timeout
+        self.on_hang = on_hang
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = None
+        self.hang_detected = False
+
+    def start(self):
+        def monitor():
+            while not self._stop.wait(min(self.timeout / 4, 10.0)):
+                if time.monotonic() - self._last > self.timeout:
+                    self.hang_detected = True
+                    if self.on_hang is not None:
+                        self.on_hang()
+                    return
+
+        self._thread = threading.Thread(target=monitor, daemon=True)
+        self._thread.start()
+        return self
+
+    def tick(self):
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+__all__ = ["ElasticManager", "ElasticStatus", "HealthMonitor"]

@@ -1,0 +1,46 @@
+"""paddle_tpu.distributed.launch — distributed job launcher CLI
+(analog of python/paddle/distributed/launch/, main.py:23).
+
+Usage::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=4 train.py
+    # multi-node:
+    python -m paddle_tpu.distributed.launch --master hostA:8765 \
+        --nnodes 2 --nproc_per_node 4 train.py
+"""
+from __future__ import annotations
+
+import argparse
+
+from .controller import CollectiveController
+from .master import KVServer, KVClient, Master  # noqa: F401
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed paddle_tpu job")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint host:port (node 0 hosts it)")
+    p.add_argument("--nnodes", default=1, type=int)
+    p.add_argument("--nproc_per_node", default=1, type=int)
+    p.add_argument("--rank", default=-1, type=int,
+                   help="node rank; -1 = assigned by rendezvous order")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restart", default=3, type=int)
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="accepted for reference-API parity; TPU visibility "
+                        "is managed by the runtime")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv=None):
+    args = build_parser().parse_args(argv)
+    return CollectiveController(args).build_pod().run()
+
+
+__all__ = ["launch", "build_parser", "CollectiveController", "KVServer",
+           "KVClient", "Master"]

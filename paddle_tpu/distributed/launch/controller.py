@@ -1,0 +1,172 @@
+"""Launch controller: build the pod, spawn worker processes, watch, restart.
+
+TPU-native analog of the reference's collective controller
+(reference: python/paddle/distributed/launch/controllers/collective.py:37
+build_pod, :285 run; process spawn launch/job/container.py:138; watch loop
+controllers/controller.py). Worker env mirrors the reference's contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER ...) plus the
+TPU-side coordination variables consumed by ``init_parallel_env``:
+``jax.distributed.initialize(coordinator, num_processes, process_id)``.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from .master import KVServer, Master
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class Container:
+    """One worker process (reference: launch/job/container.py:138)."""
+
+    def __init__(self, cmd, env, log_path=None):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self._log_f = None
+
+    def start(self):
+        out = None
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+            self._log_f = open(self.log_path, "ab")
+            out = self._log_f
+        self.proc = subprocess.Popen(
+            self.cmd, env={**os.environ, **self.env},
+            stdout=out, stderr=subprocess.STDOUT if out else None)
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace=10):
+        if not self.alive():
+            return
+        self.proc.send_signal(signal.SIGTERM)
+        t0 = time.time()
+        while self.alive() and time.time() - t0 < grace:
+            time.sleep(0.1)
+        if self.alive():
+            self.proc.kill()
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+class CollectiveController:
+    """Spawns nproc_per_node workers; optionally rendezvous across nodes.
+
+    Single-node: master runs in-process. Multi-node: pass
+    ``--master host:port`` on every node; node 0 hosts the KV server.
+    """
+
+    def __init__(self, args):
+        self.args = args
+        self.containers: list[Container] = []
+        self.kv = None
+
+    def build_pod(self):
+        a = self.args
+        nnodes = int(a.nnodes)
+        if a.master:
+            host, port = a.master.rsplit(":", 1)
+            my_ip = socket.gethostbyname(socket.gethostname())
+            is_master_node = a.rank == 0 or host in ("127.0.0.1", "localhost",
+                                                     my_ip)
+            if is_master_node and a.rank in (0, -1):
+                try:
+                    self.kv = KVServer(int(port)).start()
+                except OSError:
+                    self.kv = None  # another process already serves
+            master = Master(a.master, job_id=a.job_id)
+            node_id = f"{socket.gethostname()}-{os.getpid()}"
+            master.register(node_id, {"nproc": a.nproc_per_node})
+            peers = master.wait_peers(nnodes)
+            node_rank = list(peers).index(node_id) if a.rank < 0 else a.rank
+            coordinator = f"{host}:{int(port) + 1}"
+        else:
+            node_rank = 0
+            coordinator = f"127.0.0.1:{free_port()}"
+
+        nproc = int(a.nproc_per_node)
+        world = nproc * nnodes
+        endpoints = ",".join(f"127.0.0.1:{free_port()}" for _ in range(nproc))
+        for local_rank in range(nproc):
+            rank = node_rank * nproc + local_rank
+            env = {
+                # reference env contract (container.py:138)
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_MASTER": a.master or coordinator,
+                # TPU coordination (consumed by init_parallel_env)
+                "PADDLE_TPU_COORDINATOR": coordinator,
+                "PADDLE_TPU_NUM_PROCESSES": str(world),
+                "PADDLE_TPU_PROCESS_ID": str(rank),
+            }
+            log = os.path.join(a.log_dir, f"workerlog.{local_rank}") \
+                if a.log_dir else None
+            cmd = [sys.executable] + ([a.training_script]
+                                      if a.training_script.endswith(".py")
+                                      else ["-m", a.training_script]) \
+                + list(a.training_script_args)
+            self.containers.append(Container(cmd, env, log))
+        return self
+
+    def run(self):
+        for c in self.containers:
+            c.start()
+        rc = self.watch()
+        self.stop()
+        return rc
+
+    def watch(self):
+        """Restart-on-failure loop (reference: controller.py watch;
+        max_restart mirrors elastic manager policy)."""
+        restarts = 0
+        while True:
+            time.sleep(0.5)
+            codes = [c.exit_code for c in self.containers]
+            if all(c == 0 for c in codes):
+                return 0
+            bad = [i for i, c in enumerate(codes) if c not in (None, 0)]
+            if bad:
+                if restarts < int(self.args.max_restart):
+                    restarts += 1
+                    sys.stderr.write(
+                        f"[launch] workers {bad} failed; restart "
+                        f"{restarts}/{self.args.max_restart}\n")
+                    for c in self.containers:
+                        c.terminate()
+                    for c in self.containers:
+                        c.start()
+                else:
+                    sys.stderr.write(f"[launch] workers {bad} failed; "
+                                     "giving up\n")
+                    return 1
+
+    def stop(self):
+        for c in self.containers:
+            c.terminate()
+        if self.kv is not None:
+            self.kv.stop()
+
+
+__all__ = ["CollectiveController", "Container", "free_port"]

@@ -1,0 +1,140 @@
+"""Rendezvous master: an HTTP key-value store.
+
+TPU-native analog of the reference's launch master
+(reference: python/paddle/distributed/launch/controllers/master.py:73 HTTP
+KV master, :186 ETCD master; C++ TCPStore paddle/phi/core/distributed/
+store/tcp_store.h:121). Nodes POST their endpoint under a job prefix and
+poll GET until all peers registered — the same allgather-of-endpoints the
+reference does before wiring NCCL; here the gathered peer list seeds
+``jax.distributed.initialize`` (the coordination service that plays
+TCPStore for the XLA runtime).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KVServer:
+    """In-memory KV over HTTP: PUT /k -> set, GET /k -> value,
+    GET /prefix/ -> all pairs under prefix, DELETE /k."""
+
+    def __init__(self, port):
+        self.port = port
+        store: dict[str, bytes] = {}
+        lock = threading.Lock()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                with lock:
+                    store[self.path] = body
+                self.send_response(200)
+                self.end_headers()
+
+            def do_GET(self):
+                with lock:
+                    if self.path.endswith("/"):
+                        sub = {k: v.decode() for k, v in store.items()
+                               if k.startswith(self.path)}
+                        body = json.dumps(sub).encode()
+                    elif self.path in store:
+                        body = store[self.path]
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                with lock:
+                    store.pop(self.path, None)
+                self.send_response(200)
+                self.end_headers()
+
+        self._srv = ThreadingHTTPServer(("", port), Handler)
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+
+
+class KVClient:
+    def __init__(self, endpoint):
+        self.base = f"http://{endpoint}"
+
+    def put(self, key, value: str):
+        req = urllib.request.Request(self.base + key, data=value.encode(),
+                                     method="PUT")
+        urllib.request.urlopen(req, timeout=10).read()
+
+    def get(self, key):
+        try:
+            return urllib.request.urlopen(self.base + key, timeout=10) \
+                .read().decode()
+        except Exception:
+            return None
+
+    def get_prefix(self, prefix) -> dict:
+        body = urllib.request.urlopen(self.base + prefix, timeout=10).read()
+        return json.loads(body)
+
+    def delete(self, key):
+        req = urllib.request.Request(self.base + key, method="DELETE")
+        urllib.request.urlopen(req, timeout=10).read()
+
+
+class Master:
+    """Per-job rendezvous over a KVServer (reference: master.py sync_peers)."""
+
+    def __init__(self, endpoint, job_id="default"):
+        self.client = KVClient(endpoint)
+        self.job = f"/{job_id}"
+
+    def register(self, node_id, payload: dict):
+        self.client.put(f"{self.job}/nodes/{node_id}", json.dumps(payload))
+
+    def wait_peers(self, expected, timeout=600, poll=0.2):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            try:
+                nodes = self.client.get_prefix(f"{self.job}/nodes/")
+            except Exception:
+                nodes = {}
+            if len(nodes) >= expected:
+                out = {k.rsplit("/", 1)[-1]: json.loads(v)
+                       for k, v in nodes.items()}
+                return dict(sorted(out.items()))
+            time.sleep(poll)
+        raise TimeoutError(
+            f"rendezvous: {expected} peers not reached in {timeout}s")
+
+    def heartbeat(self, node_id):
+        self.client.put(f"{self.job}/beat/{node_id}", str(time.time()))
+
+    def alive_nodes(self, horizon=30.0):
+        try:
+            beats = self.client.get_prefix(f"{self.job}/beat/")
+        except Exception:
+            return []
+        now = time.time()
+        return [k.rsplit("/", 1)[-1] for k, v in beats.items()
+                if now - float(v) < horizon]
+
+
+__all__ = ["KVServer", "KVClient", "Master"]

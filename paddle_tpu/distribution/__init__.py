@@ -1,0 +1,13 @@
+"""paddle_tpu.distribution — probability distributions
+(analog of python/paddle/distribution/)."""
+from .distribution import Distribution  # noqa: F401
+from .continuous import (  # noqa: F401
+    Normal, LogNormal, Uniform, Gamma, Beta, Dirichlet, Exponential,
+    Laplace, Gumbel, Cauchy, StudentT, Chi2)
+from .discrete import (  # noqa: F401
+    Bernoulli, Categorical, Multinomial, Geometric, Poisson, Binomial)
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AffineTransform, ExpTransform, PowerTransform,
+    SigmoidTransform, TanhTransform, SoftmaxTransform, AbsTransform,
+    ChainTransform, TransformedDistribution, Independent)

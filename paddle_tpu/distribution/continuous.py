@@ -1,0 +1,466 @@
+"""Continuous distributions.
+
+Analog of the reference's python/paddle/distribution/{normal,uniform,beta,
+gamma,dirichlet,exponential,laplace,lognormal,gumbel,cauchy,student_t,
+chi2}.py. Sampling uses jax.random (implicit reparameterization gradients
+for gamma-family — beyond the reference's capability); densities are fused
+jnp closures on the eager tape.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _apply, broadcast_all, next_key, param
+
+_LOG_2PI = math.log(2 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_all(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _apply("normal_var", lambda s: s * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "normal_rsample",
+            lambda loc, scale: loc + scale * jax.random.normal(
+                key, out_shape, jnp.result_type(loc)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _apply(
+            "normal_log_prob",
+            lambda v, loc, scale: -((v - loc) ** 2) / (2 * scale ** 2)
+            - jnp.log(scale) - 0.5 * _LOG_2PI,
+            param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return _apply("normal_entropy",
+                      lambda s: 0.5 + 0.5 * _LOG_2PI + jnp.log(s), self.scale)
+
+    def cdf(self, value):
+        return _apply(
+            "normal_cdf",
+            lambda v, loc, scale: 0.5 * (1 + jax.scipy.special.erf(
+                (v - loc) / (scale * math.sqrt(2)))),
+            param(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return _apply(
+            "normal_icdf",
+            lambda v, loc, scale: loc + scale * math.sqrt(2)
+            * jax.scipy.special.erfinv(2 * v - 1),
+            param(value), self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_all(loc, scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return _apply("lognormal_mean",
+                      lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _apply(
+            "lognormal_var",
+            lambda l, s: (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        z = self._base.rsample(shape)
+        return _apply("lognormal_exp", lambda z: jnp.exp(z), z)
+
+    def log_prob(self, value):
+        v = param(value)
+        return _apply(
+            "lognormal_log_prob",
+            lambda v, loc, scale: -((jnp.log(v) - loc) ** 2) / (2 * scale ** 2)
+            - jnp.log(v * scale) - 0.5 * _LOG_2PI,
+            v, self.loc, self.scale)
+
+    def entropy(self):
+        return _apply("lognormal_entropy",
+                      lambda l, s: 0.5 + 0.5 * _LOG_2PI + jnp.log(s) + l,
+                      self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low, self.high = broadcast_all(low, high)
+        super().__init__(tuple(self.low.shape))
+
+    @property
+    def mean(self):
+        return _apply("uniform_mean", lambda l, h: (l + h) / 2, self.low, self.high)
+
+    @property
+    def variance(self):
+        return _apply("uniform_var", lambda l, h: (h - l) ** 2 / 12,
+                      self.low, self.high)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "uniform_rsample",
+            lambda l, h: l + (h - l) * jax.random.uniform(
+                key, out_shape, jnp.result_type(l)),
+            self.low, self.high)
+
+    def log_prob(self, value):
+        return _apply(
+            "uniform_log_prob",
+            lambda v, l, h: jnp.where((v >= l) & (v < h), -jnp.log(h - l),
+                                      -jnp.inf),
+            param(value), self.low, self.high)
+
+    def entropy(self):
+        return _apply("uniform_entropy", lambda l, h: jnp.log(h - l),
+                      self.low, self.high)
+
+    def cdf(self, value):
+        return _apply(
+            "uniform_cdf",
+            lambda v, l, h: jnp.clip((v - l) / (h - l), 0.0, 1.0),
+            param(value), self.low, self.high)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration, self.rate = broadcast_all(concentration, rate)
+        super().__init__(tuple(self.concentration.shape))
+
+    @property
+    def mean(self):
+        return _apply("gamma_mean", lambda c, r: c / r,
+                      self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return _apply("gamma_var", lambda c, r: c / (r * r),
+                      self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        # jax.random.gamma provides implicit-reparameterization gradients
+        return _apply(
+            "gamma_rsample",
+            lambda c, r: jax.random.gamma(
+                key, jnp.broadcast_to(c, out_shape)) / r,
+            self.concentration, self.rate)
+
+    def log_prob(self, value):
+        return _apply(
+            "gamma_log_prob",
+            lambda v, c, r: c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+            - jax.scipy.special.gammaln(c),
+            param(value), self.concentration, self.rate)
+
+    def entropy(self):
+        return _apply(
+            "gamma_entropy",
+            lambda c, r: c - jnp.log(r) + jax.scipy.special.gammaln(c)
+            + (1 - c) * jax.scipy.special.digamma(c),
+            self.concentration, self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha, self.beta = broadcast_all(alpha, beta)
+        super().__init__(tuple(self.alpha.shape))
+
+    @property
+    def mean(self):
+        return _apply("beta_mean", lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return _apply(
+            "beta_var",
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+            self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        key1, key2 = next_key(), next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(key1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(key2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        return _apply("beta_rsample", f, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return _apply(
+            "beta_log_prob",
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+               - jax.scipy.special.gammaln(a + b)),
+            param(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            lbeta = jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b) \
+                - jax.scipy.special.gammaln(a + b)
+            dg = jax.scipy.special.digamma
+            return lbeta - (a - 1) * dg(a) - (b - 1) * dg(b) \
+                + (a + b - 2) * dg(a + b)
+        return _apply("beta_entropy", f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = param(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _apply("dirichlet_mean",
+                      lambda c: c / c.sum(-1, keepdims=True), self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = c.sum(-1, keepdims=True)
+            return c * (a0 - c) / (a0 ** 2 * (a0 + 1))
+        return _apply("dirichlet_var", f, self.concentration)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape))
+            return g / g.sum(-1, keepdims=True)
+
+        return _apply("dirichlet_rsample", f, self.concentration)
+
+    def log_prob(self, value):
+        def f(v, c):
+            return ((c - 1) * jnp.log(v)).sum(-1) \
+                + jax.scipy.special.gammaln(c.sum(-1)) \
+                - jax.scipy.special.gammaln(c).sum(-1)
+        return _apply("dirichlet_log_prob", f, param(value), self.concentration)
+
+    def entropy(self):
+        def f(c):
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            dg = jax.scipy.special.digamma
+            lnB = jax.scipy.special.gammaln(c).sum(-1) \
+                - jax.scipy.special.gammaln(a0)
+            return lnB + (a0 - k) * dg(a0) - ((c - 1) * dg(c)).sum(-1)
+        return _apply("dirichlet_entropy", f, self.concentration)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = broadcast_all(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return _apply("expon_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return _apply("expon_var", lambda r: 1.0 / (r * r), self.rate)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "expon_rsample",
+            lambda r: jax.random.exponential(key, out_shape) / r, self.rate)
+
+    def log_prob(self, value):
+        return _apply("expon_log_prob",
+                      lambda v, r: jnp.log(r) - r * v, param(value), self.rate)
+
+    def entropy(self):
+        return _apply("expon_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return _apply("expon_cdf",
+                      lambda v, r: 1 - jnp.exp(-r * v), param(value), self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_all(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _apply("laplace_var", lambda s: 2 * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+
+        def f(loc, scale):
+            u = jax.random.uniform(key, out_shape, jnp.result_type(loc),
+                                   minval=-0.5 + 1e-7, maxval=0.5)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return _apply("laplace_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _apply(
+            "laplace_log_prob",
+            lambda v, loc, s: -jnp.abs(v - loc) / s - jnp.log(2 * s),
+            param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return _apply("laplace_entropy",
+                      lambda s: 1 + jnp.log(2 * s), self.scale)
+
+
+class Gumbel(Distribution):
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_all(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return _apply("gumbel_mean",
+                      lambda l, s: l + self._EULER * s, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _apply("gumbel_var",
+                      lambda s: (math.pi ** 2 / 6) * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "gumbel_rsample",
+            lambda l, s: l + s * jax.random.gumbel(key, out_shape,
+                                                   jnp.result_type(l)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, s):
+            z = (v - loc) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _apply("gumbel_log_prob", f, param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return _apply("gumbel_entropy",
+                      lambda s: jnp.log(s) + 1 + self._EULER, self.scale)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc, self.scale = broadcast_all(loc, scale)
+        super().__init__(tuple(self.loc.shape))
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "cauchy_rsample",
+            lambda l, s: l + s * jax.random.cauchy(key, out_shape,
+                                                   jnp.result_type(l)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return _apply(
+            "cauchy_log_prob",
+            lambda v, l, s: -jnp.log(math.pi * s * (1 + ((v - l) / s) ** 2)),
+            param(value), self.loc, self.scale)
+
+    def entropy(self):
+        return _apply("cauchy_entropy",
+                      lambda s: jnp.log(4 * math.pi * s), self.scale)
+
+    def cdf(self, value):
+        return _apply(
+            "cauchy_cdf",
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            param(value), self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df, self.loc, self.scale = broadcast_all(df, loc, scale)
+        super().__init__(tuple(self.df.shape))
+
+    @property
+    def mean(self):
+        return _apply("studentt_mean",
+                      lambda df, l: jnp.where(df > 1, l, jnp.nan),
+                      self.df, self.loc)
+
+    @property
+    def variance(self):
+        def f(df, s):
+            v = jnp.where(df > 2, s * s * df / (df - 2), jnp.inf)
+            return jnp.where(df > 1, v, jnp.nan)
+        return _apply("studentt_var", f, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        return _apply(
+            "studentt_rsample",
+            lambda df, l, s: l + s * jax.random.t(
+                key, jnp.broadcast_to(df, out_shape)),
+            self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, df, l, s):
+            z = (v - l) / s
+            g = jax.scipy.special.gammaln
+            return g((df + 1) / 2) - g(df / 2) \
+                - 0.5 * jnp.log(df * math.pi) - jnp.log(s) \
+                - (df + 1) / 2 * jnp.log1p(z * z / df)
+        return _apply("studentt_log_prob", f, param(value), self.df,
+                      self.loc, self.scale)
+
+    def entropy(self):
+        def f(df, s):
+            dg = jax.scipy.special.digamma
+            g = jax.scipy.special.gammaln
+            return (df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2)) \
+                + 0.5 * jnp.log(df) \
+                + jax.scipy.special.betaln(df / 2, 0.5) + jnp.log(s)
+        return _apply("studentt_entropy", f, self.df, self.scale)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        self.df = param(df)
+        super().__init__(self.df * 0.5, 0.5)
+
+
+__all__ = ["Normal", "LogNormal", "Uniform", "Gamma", "Beta", "Dirichlet",
+           "Exponential", "Laplace", "Gumbel", "Cauchy", "StudentT", "Chi2"]

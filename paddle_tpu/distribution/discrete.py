@@ -1,0 +1,242 @@
+"""Discrete distributions.
+
+Analog of the reference's python/paddle/distribution/{bernoulli,categorical,
+multinomial,geometric,poisson,binomial}.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _apply, broadcast_all, next_key, param
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = broadcast_all(probs)
+            self.logits = _apply(
+                "bernoulli_logits",
+                lambda p: jnp.log(p) - jnp.log1p(-p), self.probs)
+        else:
+            self.logits = broadcast_all(logits)
+            self.probs = _apply("bernoulli_probs", jax.nn.sigmoid, self.logits)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return _apply("bernoulli_var", lambda p: p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        out_shape = self._extend_shape(shape)
+        from ..core.tensor import Tensor
+        return Tensor(jax.random.bernoulli(
+            key, self.probs._data, out_shape).astype(jnp.float32))
+
+    rsample = sample  # discrete: no reparameterization
+
+    def log_prob(self, value):
+        return _apply(
+            "bernoulli_log_prob",
+            lambda v, logits: v * jax.nn.log_sigmoid(logits)
+            + (1 - v) * jax.nn.log_sigmoid(-logits),
+            param(value), self.logits)
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(p * jnp.log(jnp.clip(p, 1e-12)) +
+                     q * jnp.log(jnp.clip(q, 1e-12)))
+        return _apply("bernoulli_entropy", f, self.probs)
+
+
+class Categorical(Distribution):
+    """Over the last axis of ``logits`` (unnormalized log-probs, matching
+    the reference categorical.py which takes logits)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = broadcast_all(logits)
+        else:
+            self.logits = _apply("categorical_logits",
+                                 lambda p: jnp.log(jnp.clip(p, 1e-12)),
+                                 broadcast_all(probs))
+        self.probs = _apply("categorical_probs",
+                            lambda l: jax.nn.softmax(l, -1), self.logits)
+        shape = tuple(self.logits.shape)
+        super().__init__(shape[:-1])
+        self._n = shape[-1]
+
+    def sample(self, shape=()):
+        key = next_key()
+        from ..core.tensor import Tensor
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(
+            key, self.logits._data, shape=out_shape))
+
+    def log_prob(self, value):
+        def f(v, logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return _apply("categorical_log_prob", f, param(value), self.logits)
+
+    def probs_of(self, value):
+        return _apply("categorical_probs_of",
+                      lambda v, p: jnp.take_along_axis(
+                          p, v.astype(jnp.int32)[..., None], -1)[..., 0],
+                      param(value), self.probs)
+
+    def entropy(self):
+        def f(logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return _apply("categorical_entropy", f, self.logits)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = broadcast_all(probs)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _apply("multinomial_mean",
+                      lambda p: self.total_count * p, self.probs)
+
+    @property
+    def variance(self):
+        return _apply("multinomial_var",
+                      lambda p: self.total_count * p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        from ..core.tensor import Tensor
+        n = self.total_count
+        logits = jnp.log(jnp.clip(self.probs._data, 1e-12))
+        out_shape = tuple(shape) + self._batch_shape
+        draws = jax.random.categorical(
+            key, logits, shape=(n,) + out_shape)          # [n, ...]
+        k = self.probs._data.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def f(v, p):
+            g = jax.scipy.special.gammaln
+            return g(jnp.asarray(self.total_count + 1.0)) - g(v + 1).sum(-1) \
+                + (v * jnp.log(jnp.clip(p, 1e-12))).sum(-1)
+        return _apply("multinomial_log_prob", f, param(value), self.probs)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (number of failures)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = broadcast_all(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _apply("geometric_mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return _apply("geometric_var", lambda p: (1 - p) / (p * p), self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        from ..core.tensor import Tensor
+        out_shape = self._extend_shape(shape)
+        u = jax.random.uniform(key, out_shape, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs._data)))
+
+    def log_prob(self, value):
+        return _apply(
+            "geometric_log_prob",
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            param(value), self.probs)
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(jnp.clip(q, 1e-12))
+                     + p * jnp.log(jnp.clip(p, 1e-12))) / p
+        return _apply("geometric_entropy", f, self.probs)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = broadcast_all(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = next_key()
+        from ..core.tensor import Tensor
+        out_shape = self._extend_shape(shape)
+        return Tensor(jax.random.poisson(key, self.rate._data, out_shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _apply(
+            "poisson_log_prob",
+            lambda v, r: v * jnp.log(r) - r - jax.scipy.special.gammaln(v + 1),
+            param(value), self.rate)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = broadcast_all(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _apply("binomial_mean",
+                      lambda p: self.total_count * p, self.probs)
+
+    @property
+    def variance(self):
+        return _apply("binomial_var",
+                      lambda p: self.total_count * p * (1 - p), self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        from ..core.tensor import Tensor
+        out_shape = self._extend_shape(shape)
+        draws = jax.random.bernoulli(
+            key, self.probs._data,
+            (self.total_count,) + out_shape)
+        return Tensor(draws.sum(0).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, p):
+            g = jax.scipy.special.gammaln
+            n = jnp.asarray(float(self.total_count))
+            return g(n + 1) - g(v + 1) - g(n - v + 1) \
+                + v * jnp.log(jnp.clip(p, 1e-12)) \
+                + (n - v) * jnp.log1p(-jnp.clip(p, None, 1 - 1e-12))
+        return _apply("binomial_log_prob", f, param(value), self.probs)
+
+
+__all__ = ["Bernoulli", "Categorical", "Multinomial", "Geometric", "Poisson",
+           "Binomial"]

@@ -1,0 +1,104 @@
+"""Distribution base class.
+
+TPU-native analog of the reference's probability library
+(reference: python/paddle/distribution/distribution.py Distribution base;
+25+ subclasses under python/paddle/distribution/). Each statistical method
+(log_prob / entropy / rsample ...) executes as ONE fused primitive through
+the eager dispatch (core/dispatch.py eager_apply) — a pure jnp closure —
+instead of a chain of small ops, so a log_prob is a single XLA computation
+and its VJP is JAX-derived (including implicit reparameterization grads for
+gamma/beta/dirichlet sampling, which the reference cannot express at all).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core import random as _rng
+from ..core.tensor import Tensor
+
+
+def _apply(name, fn, *args, **kwargs):
+    """Run a pure jnp closure as a single tape op over Tensor args."""
+    return eager_apply(name, fn, args, kwargs)
+
+
+def param(x, dtype=jnp.float32):
+    """Convert a scalar/array/Tensor parameter to Tensor."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _apply("dist_stddev", lambda v: jnp.sqrt(v), self.variance)
+
+    def sample(self, shape=()):
+        """Non-differentiable draw."""
+        from ..core.autograd import no_grad
+        with no_grad():
+            out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _apply("dist_prob", lambda lp: jnp.exp(lp), self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self._batch_shape})"
+
+
+def broadcast_all(*xs):
+    """Broadcast Tensor/array params to a common shape (as Tensors)."""
+    ts = [param(x) for x in xs]
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    out = [_apply("dist_broadcast", lambda a, shape=shape: jnp.broadcast_to(a, shape), t)
+           for t in ts]
+    return out if len(out) > 1 else out[0]
+
+
+def next_key():
+    return _rng.next_key()
+
+
+__all__ = ["Distribution", "param", "broadcast_all", "next_key", "_apply"]

@@ -1,0 +1,137 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py).
+
+``register_kl(P, Q)`` decorates a function computing KL(p || q); dispatch
+walks the MRO for the most specific registered pair.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _apply
+from .continuous import (Beta, Dirichlet, Exponential, Gamma, Laplace,
+                         LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    best, best_score = None, None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            score = type(p).__mro__.index(pc) + type(q).__mro__.index(qc)
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return best(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return _apply(
+        "kl_normal",
+        lambda pl, ps, ql, qs: jnp.log(qs / ps)
+        + (ps ** 2 + (pl - ql) ** 2) / (2 * qs ** 2) - 0.5,
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _apply(
+        "kl_uniform",
+        lambda pl, ph, ql, qh: jnp.where(
+            (ql <= pl) & (ph <= qh),
+            jnp.log((qh - ql) / (ph - pl)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _apply("kl_expon",
+                  lambda pr, qr: jnp.log(pr / qr) + qr / pr - 1, p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def f(pc, pr, qc, qr):
+        g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        return (pc - qc) * dg(pc) - g(pc) + g(qc) \
+            + qc * (jnp.log(pr) - jnp.log(qr)) + pc * (qr - pr) / pr
+    return _apply("kl_gamma", f, p.concentration, p.rate,
+                  q.concentration, q.rate)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def f(pa, pb, qa, qb):
+        g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        lbeta = lambda a, b: g(a) + g(b) - g(a + b)
+        return lbeta(qa, qb) - lbeta(pa, pb) \
+            + (pa - qa) * dg(pa) + (pb - qb) * dg(pb) \
+            + (qa - pa + qb - pb) * dg(pa + pb)
+    return _apply("kl_beta", f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(pc, qc):
+        g, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        p0 = pc.sum(-1)
+        return g(p0) - g(qc.sum(-1)) - g(pc).sum(-1) + g(qc).sum(-1) \
+            + ((pc - qc) * (dg(pc) - dg(p0)[..., None])).sum(-1)
+    return _apply("kl_dirichlet", f, p.concentration, q.concentration)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return jnp.log(qs / ps) + d / qs \
+            + ps / qs * jnp.exp(-d / ps) - 1
+    return _apply("kl_laplace", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def f(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return pp * jnp.log(pp / qp) + (1 - pp) * jnp.log((1 - pp) / (1 - qp))
+    return _apply("kl_bernoulli", f, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def f(pl, ql):
+        lp = jax.nn.log_softmax(pl, -1)
+        lq = jax.nn.log_softmax(ql, -1)
+        return (jnp.exp(lp) * (lp - lq)).sum(-1)
+    return _apply("kl_categorical", f, p.logits, q.logits)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def f(pp, qp):
+        return (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp)) \
+            + jnp.log(pp) - jnp.log(qp)
+    return _apply("kl_geometric", f, p.probs, q.probs)
+
+
+__all__ = ["kl_divergence", "register_kl"]

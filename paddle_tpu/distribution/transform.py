@@ -1,0 +1,218 @@
+"""Bijective transforms + TransformedDistribution + Independent.
+
+Analog of the reference's python/paddle/distribution/transform.py (13
+transform classes) and transformed_distribution.py / independent.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _apply, param
+
+
+class Transform:
+    def forward(self, x):
+        return _apply(f"{type(self).__name__}_fwd", self._forward, param(x))
+
+    def inverse(self, y):
+        return _apply(f"{type(self).__name__}_inv", self._inverse, param(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _apply(f"{type(self).__name__}_fldj", self._fldj, param(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return _apply(
+            f"{type(self).__name__}_ildj",
+            lambda y: -self._fldj(self._inverse(y)), param(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclass hooks (pure jnp)
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = param(loc)
+        self.scale = param(scale)
+
+    def _forward(self, x):
+        return self.loc._data + self.scale._data * x
+
+    def _inverse(self, y):
+        return (y - self.loc._data) / self.scale._data
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._data)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = param(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._data)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._data)
+
+    def _fldj(self, x):
+        p = self.power._data
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2) = 2(log2 - x - softplus(-2x))
+        return 2 * (jnp.log(2.0) - x - jax.nn.softplus(-2 * x))
+
+
+class SoftmaxTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("softmax is not bijective; no scalar ldj")
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """(reference: transformed_distribution.py) base pushforward through a
+    Transform (or list chained in order)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms) \
+            if len(transforms) != 1 else transforms[0]
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def log_prob(self, value):
+        v = param(value)
+        x = self.transform.inverse(v)
+        base_lp = self.base.log_prob(x)
+        return _apply(
+            "transformed_log_prob",
+            lambda lp, ldj: lp + ldj,
+            base_lp, self.transform.inverse_log_det_jacobian(v))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_ndims`` batch dims as
+    event dims (reference: independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.n = int(reinterpreted_batch_ndims)
+        b = tuple(base.batch_shape)
+        super().__init__(b[:len(b) - self.n],
+                         b[len(b) - self.n:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self.n == 0:
+            return lp
+        return _apply("independent_sum",
+                      lambda l: l.sum(tuple(range(-self.n, 0))), lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self.n == 0:
+            return ent
+        return _apply("independent_ent_sum",
+                      lambda e: e.sum(tuple(range(-self.n, 0))), ent)
+
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
+           "AbsTransform", "ChainTransform", "TransformedDistribution",
+           "Independent"]

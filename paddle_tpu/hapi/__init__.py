@@ -1,0 +1,4 @@
+"""hapi — high-level Keras-style training API (analog of python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,262 @@
+"""hapi Model: Keras-style fit/evaluate/predict over a Layer.
+
+TPU-native analog of the reference's high-level Model
+(reference: python/paddle/hapi/model.py:1472 fit; evaluate/predict below
+it; save/load; summary). The reference keeps dygraph/static dual paths;
+here there is one path — eager train steps, with an optional fused
+``paddle_tpu.jit.TrainStep`` when ``prepare(..., use_jit=True)`` — the
+to_static role on this stack.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.autograd import no_grad
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_loader(data, batch_size, shuffle, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      num_workers=num_workers)
+
+
+def _split_batch(batch, n_labels):
+    batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+    if n_labels == 0:
+        return batch, []
+    return batch[:-n_labels], batch[-n_labels:]
+
+
+class Model:
+    """``Model(network)`` then ``prepare(optimizer, loss, metrics)`` then
+    ``fit/evaluate/predict`` (reference: hapi/model.py:1472)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self.save_dir = None
+        self._train_step = None
+
+    # ---- setup ----
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, use_jit=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = _to_list(metrics)
+        for m in metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
+        self._metrics = metrics
+        self._use_jit = use_jit
+        self._train_step = None
+        return self
+
+    # ---- single-batch ops (reference: model.py train_batch/eval_batch) ----
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = [self._tensorize(x) for x in _to_list(inputs)]
+        labels = [self._tensorize(y) for y in _to_list(labels)]
+        if self._use_jit and self._train_step is None:
+            from ..jit import TrainStep
+            n_in = len(inputs)
+
+            def loss_fn(*flat):
+                outs = self.network(*flat[:n_in])
+                return self._compute_loss(outs, list(flat[n_in:]))
+
+            self._train_step = TrainStep(self.network, loss_fn, self._optimizer)
+        if self._train_step is not None:
+            loss = self._train_step(*inputs, *labels)
+            outputs = None  # fused step doesn't surface intermediate outputs
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return (float(np.asarray(loss.numpy())), metrics)
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        with no_grad():
+            inputs = [self._tensorize(x) for x in _to_list(inputs)]
+            labels = [self._tensorize(y) for y in _to_list(labels)]
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels) if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        return (float(np.asarray(loss.numpy())) if loss is not None else None,
+                metrics)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        with no_grad():
+            inputs = [self._tensorize(x) for x in _to_list(inputs)]
+            out = self.network(*inputs)
+        return [o.numpy() for o in _to_list(out)]
+
+    # ---- loops ----
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        from .callbacks import config_callbacks
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, num_workers)
+        self.save_dir = save_dir
+        self.stop_training = False
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metric_names())
+        cbks.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(loader, cbks, "train")
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                cbks.on_eval_begin()
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_eval_end(eval_logs)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            history.append(logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        from .callbacks import config_callbacks
+        loader = _as_loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, epochs=None,
+                                steps=len(loader) if hasattr(loader, "__len__") else None,
+                                log_freq=log_freq, verbose=verbose,
+                                metrics=self._metric_names(), mode="eval")
+        cbks.on_eval_begin()
+        logs = self._run_one_epoch(loader, cbks, "eval")
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            inputs, _ = _split_batch(batch, 0)
+            outputs.append(self.predict_batch(inputs))
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        per_output = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            per_output = [np.concatenate(o, axis=0) for o in per_output]
+        return per_output
+
+    # ---- persistence (reference: model.py save/load) ----
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path) and hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    # ---- internals ----
+    def _tensorize(self, x):
+        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            out = _to_list(outputs)[0]
+            return out.mean()
+        outs = _to_list(outputs)
+        return self._loss(*(outs + labels))
+
+    def _update_metrics(self, outputs, labels):
+        res = {}
+        if outputs is None:
+            return res
+        outs = _to_list(outputs)
+        for m in self._metrics:
+            inp = m.compute(*(outs + labels))
+            m.update(*[np.asarray(i.numpy() if isinstance(i, Tensor) else i)
+                       for i in _to_list(inp)])
+            res[m.name() if not isinstance(m.name(), list) else m.name()[0]] = \
+                m.accumulate()
+        return res
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    def _run_one_epoch(self, loader, cbks, mode):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        logs = {}
+        for step, batch in enumerate(loader):
+            inputs, labels = _split_batch(batch, max(1, len(self._labels))
+                                          if (self._loss is not None) else 0)
+            if mode == "train":
+                cbks.on_train_batch_begin(step)
+                loss, metrics = self.train_batch(inputs, labels)
+            else:
+                cbks.on_eval_batch_begin(step)
+                loss, metrics = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+            logs = {"loss": loss, **metrics}
+            if mode == "train":
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            else:
+                cbks.on_eval_batch_end(step, logs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        return logs
+
+
+__all__ = ["Model"]

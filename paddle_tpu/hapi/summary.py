@@ -1,0 +1,73 @@
+"""Model summary table (reference: python/paddle/hapi/model_summary.py).
+
+Walks sublayers with forward hooks to record output shapes, then prints a
+Keras-style table with trainable/total parameter counts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dtype import to_jax_dtype
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []        # (name, type, out_shape, n_params)
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "-"
+            n = int(sum(np.prod(p.shape) for p in layer._parameters.values()
+                        if p is not None))
+            rows.append((name, type(layer).__name__, shape, n))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if not layer._sub_layers:  # leaves only, like the reference table
+            hooks.append(layer.register_forward_post_hook(make_hook(name, layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        if input is not None:
+            xs = input if isinstance(input, (list, tuple)) else [input]
+        else:
+            if input_size is None:
+                raise ValueError("summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, list) and \
+                isinstance(input_size[0], (list, tuple)) else [input_size]
+            dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+                [dtypes] * len(sizes)
+            xs = [Tensor(jnp.zeros([s if s is not None else 1 for s in size],
+                                   to_jax_dtype(dt or "float32")))
+                  for size, dt in zip(sizes, dts)]
+        from ..core.autograd import no_grad
+        with no_grad():
+            net(*xs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape) for p in net.parameters()
+                        if not p.stop_gradient))
+    w = max([len(r[0]) for r in rows] + [10])
+    print("-" * (w + 45))
+    print(f"{'Layer':<{w}} {'Type':<16} {'Output Shape':<18} {'Params':>8}")
+    print("=" * (w + 45))
+    for name, typ, shape, n in rows:
+        print(f"{name:<{w}} {typ:<16} {str(shape):<18} {n:>8}")
+    print("=" * (w + 45))
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+__all__ = ["summary"]

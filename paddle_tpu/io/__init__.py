@@ -8,6 +8,7 @@ avoids process-spawn overhead while XLA dispatch releases the GIL.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 
@@ -237,6 +238,33 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
 
+# Native parallel collation: one pooled 64B-aligned host buffer + memcpy
+# fan-out over the C++ work queue (core/native/csrc/collate.cc). Threshold
+# below which plain np.stack wins on dispatch overhead.
+_NATIVE_COLLATE_MIN_BYTES = 1 << 16
+_collate_wq = None
+
+
+def _native_stack(arrs):
+    from ..core import native as _nv
+    global _collate_wq
+    if not _nv.ensure_loaded():
+        return None
+    first = arrs[0]
+    total = first.nbytes * len(arrs)
+    if total < _NATIVE_COLLATE_MIN_BYTES:
+        return None
+    for a in arrs:
+        if a.shape != first.shape or a.dtype != first.dtype \
+                or not a.flags["C_CONTIGUOUS"]:
+            return None
+    if _collate_wq is None:
+        _collate_wq = _nv.WorkQueue(min(8, os.cpu_count() or 4))
+    out = np.empty((len(arrs),) + first.shape, first.dtype)
+    _collate_wq.collate(out, list(arrs))
+    return out
+
+
 def default_collate_fn(batch):
     """Stack samples into batched Tensors (reference:
     python/paddle/io/dataloader/collate.py)."""
@@ -244,7 +272,8 @@ def default_collate_fn(batch):
     if isinstance(sample, Tensor):
         return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        fast = _native_stack(batch)
+        return Tensor(fast if fast is not None else np.stack(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (list, tuple)):
@@ -252,6 +281,14 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     return batch
+
+
+class _WorkerError:
+    """Wraps a producer-thread exception for re-raise in the consumer
+    (a plain tuple sentinel would hit Tensor.__eq__ on tensor batches)."""
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 class DataLoader:
@@ -314,7 +351,7 @@ class DataLoader:
                     q.put(b)
                 q.put(sentinel)
             except BaseException as e:  # propagate into the consumer
-                q.put(("__dataloader_error__", e))
+                q.put(_WorkerError(e))
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -322,8 +359,8 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 break
-            if isinstance(item, tuple) and len(item) == 2 and                     item[0] == "__dataloader_error__":
-                raise item[1]
+            if isinstance(item, _WorkerError):
+                raise item.exc
             yield item
 
 

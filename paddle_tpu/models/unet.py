@@ -1,0 +1,243 @@
+"""Diffusion UNet (Stable-Diffusion style) — BASELINE.md config 4.
+
+The reference runs SD-UNet through fused GPU kernels (GroupNorm
+paddle/phi/kernels/gpu/group_norm_kernel.cu, attention via
+fused_attention / flash_attn C12 kernels). Here the architecture composes
+the framework's GroupNorm layer and scaled_dot_product_attention (which
+routes to the Pallas flash kernel on TPU, paddle_tpu/kernels/
+flash_attention.py); XLA fuses the SiLU/GN/conv chains.
+
+Shapes follow the SD-1.x UNet: 4-ch latent, 320 base width,
+[1,2,4,4] channel multipliers, attention at the lower resolutions,
+cross-attention over a text-context sequence, timestep sinusoidal
+embedding -> MLP.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from .. import nn
+from ..nn import functional as F
+
+
+def timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep embedding [B] -> [B, dim]."""
+    half = dim // 2
+    freqs = paddle.to_tensor(
+        np.exp(-math.log(max_period) * np.arange(half, dtype=np.float32)
+               / half))
+    args = t.astype("float32").unsqueeze(-1) * freqs.unsqueeze(0)
+    return paddle.concat([paddle.cos(args), paddle.sin(args)], axis=-1)
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, c_in, c_out, t_dim, groups=32):
+        super().__init__()
+        g_in = min(groups, c_in)
+        g_out = min(groups, c_out)
+        self.norm1 = nn.GroupNorm(g_in, c_in)
+        self.conv1 = nn.Conv2D(c_in, c_out, 3, padding=1)
+        self.t_proj = nn.Linear(t_dim, c_out)
+        self.norm2 = nn.GroupNorm(g_out, c_out)
+        self.conv2 = nn.Conv2D(c_out, c_out, 3, padding=1)
+        self.skip = nn.Conv2D(c_in, c_out, 1) if c_in != c_out else None
+        self.act = nn.Silu()
+
+    def forward(self, x, t_emb):
+        h = self.conv1(self.act(self.norm1(x)))
+        h = h + self.t_proj(self.act(t_emb)).unsqueeze(-1).unsqueeze(-1)
+        h = self.conv2(self.act(self.norm2(h)))
+        s = self.skip(x) if self.skip is not None else x
+        return s + h
+
+
+class SpatialAttention(nn.Layer):
+    """Self + optional cross attention over flattened spatial positions
+    (the SD Transformer block: attn1(self) -> attn2(cross) -> ff)."""
+
+    def __init__(self, channels, num_heads=8, ctx_dim=None, groups=32):
+        super().__init__()
+        self.norm = nn.GroupNorm(min(groups, channels), channels)
+        self.num_heads = num_heads
+        self.q = nn.Linear(channels, channels)
+        self.kv_self = nn.Linear(channels, 2 * channels)
+        self.ctx_dim = ctx_dim
+        if ctx_dim is not None:
+            self.q2 = nn.Linear(channels, channels)
+            self.kv_cross = nn.Linear(ctx_dim, 2 * channels)
+        self.ff = nn.Sequential(nn.Linear(channels, 4 * channels), nn.GELU(),
+                                nn.Linear(4 * channels, channels))
+        self.proj = nn.Linear(channels, channels)
+
+    def _attend(self, q, k, v):
+        b, s, c = q.shape
+        h = self.num_heads
+        q = q.reshape([b, s, h, c // h])
+        k = k.reshape([b, k.shape[1], h, c // h])
+        v = v.reshape([b, v.shape[1], h, c // h])
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        return o.reshape([b, s, c])
+
+    def forward(self, x, context=None):
+        b, c, hh, ww = x.shape
+        seq = self.norm(x).reshape([b, c, hh * ww]).transpose([0, 2, 1])
+        # self attention
+        kv = self.kv_self(seq)
+        k, v = kv[:, :, :c], kv[:, :, c:]
+        seq = seq + self._attend(self.q(seq), k, v)
+        # cross attention over the text context
+        if self.ctx_dim is not None and context is not None:
+            kv = self.kv_cross(context)
+            k, v = kv[:, :, :c], kv[:, :, c:]
+            seq = seq + self._attend(self.q2(seq), k, v)
+        seq = seq + self.ff(seq)
+        seq = self.proj(seq)
+        return x + seq.transpose([0, 2, 1]).reshape([b, c, hh, ww])
+
+
+class Downsample(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2D(c, c, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample(nn.Layer):
+    def __init__(self, c):
+        super().__init__()
+        self.conv = nn.Conv2D(c, c, 3, padding=1)
+
+    def forward(self, x):
+        x = F.interpolate(x, scale_factor=2, mode="nearest")
+        return self.conv(x)
+
+
+class UNetModel(nn.Layer):
+    """SD-style conditional UNet.
+
+    unet = UNetModel(in_channels=4, model_channels=320,
+                     channel_mult=(1, 2, 4, 4), context_dim=768)
+    eps = unet(latents, timesteps, context)
+    """
+
+    def __init__(self, in_channels=4, out_channels=None, model_channels=320,
+                 channel_mult=(1, 2, 4, 4), num_res_blocks=2,
+                 attention_levels=(1, 2, 3), num_heads=8, context_dim=None,
+                 groups=32):
+        super().__init__()
+        out_channels = out_channels or in_channels
+        self.model_channels = model_channels
+        t_dim = model_channels * 4
+        self.time_mlp = nn.Sequential(
+            nn.Linear(model_channels, t_dim), nn.Silu(),
+            nn.Linear(t_dim, t_dim))
+
+        self.conv_in = nn.Conv2D(in_channels, model_channels, 3, padding=1)
+
+        # encoder
+        self.down_blocks = nn.LayerList()
+        self.downsamples = nn.LayerList()
+        chans = [model_channels]
+        c = model_channels
+        for level, mult in enumerate(channel_mult):
+            blocks = nn.LayerList()
+            for _ in range(num_res_blocks):
+                blk = nn.LayerList([ResBlock(c, model_channels * mult, t_dim,
+                                             groups)])
+                c = model_channels * mult
+                if level in attention_levels:
+                    blk.append(SpatialAttention(c, num_heads, context_dim,
+                                                groups))
+                blocks.append(blk)
+                chans.append(c)
+            self.down_blocks.append(blocks)
+            if level != len(channel_mult) - 1:
+                self.downsamples.append(Downsample(c))
+                chans.append(c)
+            else:
+                self.downsamples.append(None)
+
+        # middle
+        self.mid1 = ResBlock(c, c, t_dim, groups)
+        self.mid_attn = SpatialAttention(c, num_heads, context_dim, groups)
+        self.mid2 = ResBlock(c, c, t_dim, groups)
+
+        # decoder (skip connections from `chans`)
+        self.up_blocks = nn.LayerList()
+        self.upsamples = nn.LayerList()
+        for level, mult in reversed(list(enumerate(channel_mult))):
+            blocks = nn.LayerList()
+            for _ in range(num_res_blocks + 1):
+                skip_c = chans.pop()
+                blk = nn.LayerList([ResBlock(c + skip_c,
+                                             model_channels * mult, t_dim,
+                                             groups)])
+                c = model_channels * mult
+                if level in attention_levels:
+                    blk.append(SpatialAttention(c, num_heads, context_dim,
+                                                groups))
+                blocks.append(blk)
+            self.up_blocks.append(blocks)
+            if level != 0:
+                self.upsamples.append(Upsample(c))
+            else:
+                self.upsamples.append(None)
+
+        self.norm_out = nn.GroupNorm(min(groups, c), c)
+        self.conv_out = nn.Conv2D(c, out_channels, 3, padding=1)
+        self.act = nn.Silu()
+
+    def forward(self, x, timesteps, context=None):
+        t_emb = self.time_mlp(timestep_embedding(timesteps,
+                                                 self.model_channels))
+        h = self.conv_in(x)
+        skips = [h]
+        for blocks, down in zip(self.down_blocks, self.downsamples):
+            for blk in blocks:
+                h = blk[0](h, t_emb)
+                if len(blk) > 1:
+                    h = blk[1](h, context)
+                skips.append(h)
+            if down is not None:
+                h = down(h)
+                skips.append(h)
+
+        h = self.mid2(self.mid_attn(self.mid1(h, t_emb), context), t_emb)
+
+        for blocks, up in zip(self.up_blocks, self.upsamples):
+            for blk in blocks:
+                h = paddle.concat([h, skips.pop()], axis=1)
+                h = blk[0](h, t_emb)
+                if len(blk) > 1:
+                    h = blk[1](h, context)
+            if up is not None:
+                h = up(h)
+
+        return self.conv_out(self.act(self.norm_out(h)))
+
+
+def sd_unet(**kwargs):
+    """Full SD-1.x size (865M params)."""
+    cfg = dict(in_channels=4, model_channels=320, channel_mult=(1, 2, 4, 4),
+               num_res_blocks=2, attention_levels=(1, 2, 3), num_heads=8,
+               context_dim=768)
+    cfg.update(kwargs)
+    return UNetModel(**cfg)
+
+
+def sd_unet_tiny(**kwargs):
+    """Test-scale UNet (same topology, tiny widths)."""
+    cfg = dict(in_channels=4, model_channels=32, channel_mult=(1, 2),
+               num_res_blocks=1, attention_levels=(1,), num_heads=4,
+               context_dim=16, groups=8)
+    cfg.update(kwargs)
+    return UNetModel(**cfg)
+
+
+__all__ = ["UNetModel", "sd_unet", "sd_unet_tiny", "timestep_embedding"]

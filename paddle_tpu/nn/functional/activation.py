@@ -8,10 +8,11 @@ from ...core.dispatch import eager_apply
 from ...core.tensor import Tensor
 
 
-def _un(name, fn):
+def _un(op_name, fn):
+    # paddle-API ``name`` kwarg must not shadow the registry op name
     def op(x, name=None):
-        return eager_apply(name, fn, (x,), {})
-    op.__name__ = name
+        return eager_apply(op_name, fn, (x,), {})
+    op.__name__ = op_name
     op.pure = fn
     return op
 

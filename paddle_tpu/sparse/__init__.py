@@ -1,0 +1,209 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops
+(analog of python/paddle/sparse/, kernels paddle/phi/kernels/sparse/).
+
+TPU-native design: sparse tensors wrap ``jax.experimental.sparse`` BCOO
+(batched-COO, the XLA-lowering-friendly format). The reference's CUDA
+sparse kernels (spmm via cuSPARSE etc.) map to BCOO dot_general lowerings
+that XLA tiles onto the MXU. CSR is kept as a thin view with
+crows/cols/values accessors for API parity; compute routes through BCOO.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..core.dispatch import eager_apply
+from . import nn  # noqa: F401  (after Tensor import to avoid cycles)
+
+
+def _apply(name, fn, *args):
+    return eager_apply(name, fn, args, {})
+
+
+class SparseCooTensor(Tensor):
+    """Eager COO tensor: wraps a BCOO; densifies LAZILY on first dense use.
+
+    (reference: paddle/phi/core/sparse_coo_tensor.h). Shape/dtype queries
+    read BCOO metadata; ``_data`` (and thus any dense op) materializes the
+    dense array once and caches it.
+    """
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        # initialize Tensor metadata WITHOUT materializing the dense array
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._output_slot = 0
+        self.name = f"sparse_coo_{id(self)}"
+        self.persistable = False
+        self._grad_hooks = []
+
+    # lazy dense buffer: the subclass property shadows the Tensor slot
+    @property
+    def _data(self):
+        d = self.__dict__.get("_dense")
+        if d is None:
+            d = self._bcoo.todense()
+            self.__dict__["_dense"] = d
+        return d
+
+    @_data.setter
+    def _data(self, v):
+        self.__dict__["_dense"] = v
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def ndim(self):
+        return len(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import to_paddle_dtype
+        return to_paddle_dtype(self._bcoo.data.dtype)
+
+    @property
+    def indices_tensor(self):
+        return Tensor(self._bcoo.indices.T)
+
+    @property
+    def values_tensor(self):
+        return Tensor(self._bcoo.data)
+
+    def indices(self):
+        return self.indices_tensor
+
+    def values(self):
+        return self.values_tensor
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(), stop_gradient=self.stop_gradient)
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view over BCOO (reference: paddle/phi/core/sparse_csr_tensor.h)."""
+
+    def __init__(self, bcoo, crows, cols, stop_gradient=True):
+        super().__init__(bcoo, stop_gradient=stop_gradient)
+        self._crows = crows
+        self._cols = cols
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build COO from [ndim, nnz] indices + [nnz] values
+    (reference: python/paddle/sparse/creation.py sparse_coo_tensor)."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    val = np.asarray(values.numpy() if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+        val = val.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((jnp.asarray(val), jnp.asarray(idx.T)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Build CSR (2D) (reference: sparse/creation.py sparse_csr_tensor)."""
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    vals_np = np.asarray(values.numpy() if isinstance(values, Tensor) else values)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    bcoo = jsparse.BCOO((jnp.asarray(vals_np), jnp.asarray(idx.T)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcoo, jnp.asarray(crows_np), jnp.asarray(cols_np),
+                           stop_gradient=stop_gradient)
+
+
+def to_sparse_coo(dense, sparse_dim=None):
+    x = dense._data if isinstance(dense, Tensor) else jnp.asarray(dense)
+    bcoo = jsparse.BCOO.fromdense(x)
+    return SparseCooTensor(bcoo, stop_gradient=getattr(dense, "stop_gradient", True))
+
+
+def matmul(a, b):
+    """Sparse @ dense -> dense (reference: sparse/binary.py matmul; the
+    cuSPARSE spmm path). BCOO dot_general gives XLA a gather+MXU plan."""
+    if isinstance(a, SparseCooTensor) and isinstance(b, Tensor) \
+            and not isinstance(b, SparseCooTensor):
+        bcoo = a._bcoo
+        return _apply("sparse_matmul",
+                      lambda bv, dense: jsparse.BCOO(
+                          (bv, bcoo.indices), shape=bcoo.shape) @ dense,
+                      a.values_tensor, b)
+    from ..tensor.linalg import matmul as dense_matmul
+    a_d = a.to_dense() if isinstance(a, SparseCooTensor) else a
+    b_d = b.to_dense() if isinstance(b, SparseCooTensor) else b
+    return dense_matmul(a_d, b_d)
+
+
+def add(a, b):
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        out = a._bcoo + b._bcoo
+        return SparseCooTensor(out.sum_duplicates(nse=out.nse))
+    return a.to_dense() + b.to_dense()
+
+
+def _unary(name, fn):
+    def op(x):
+        if isinstance(x, SparseCooTensor):
+            bcoo = x._bcoo
+        else:
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        new = jsparse.BCOO((fn(bcoo.data), bcoo.indices), shape=bcoo.shape)
+        return SparseCooTensor(new, stop_gradient=x.stop_gradient)
+    op.__name__ = name
+    return op
+
+
+# value-wise ops preserve the sparsity pattern (reference: sparse/unary.py)
+relu = _unary("relu", jax.nn.relu)
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)
+pow = None  # needs a scalar arg
+
+
+def sparse_pow(x, factor):
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+pow = sparse_pow
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "to_sparse_coo", "matmul", "add", "relu",
+           "abs", "sin", "tanh", "sqrt", "square", "neg", "pow", "nn"]

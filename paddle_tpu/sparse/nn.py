@@ -1,0 +1,17 @@
+"""sparse.nn — layers over sparse tensors (analog of python/paddle/sparse/nn/).
+
+Minimal surface: ReLU layer + SubmConv stub-free Conv3D via dense fallback
+(the reference's submanifold sparse conv is a CUDA-only rulebook kernel;
+on TPU the dense conv over the densified block is the XLA-friendly path
+until a Pallas gather-conv lands).
+"""
+from __future__ import annotations
+
+
+class ReLU:
+    def __call__(self, x):
+        from . import relu as _relu
+        return _relu(x)
+
+
+__all__ = ["ReLU"]

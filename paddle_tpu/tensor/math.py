@@ -12,10 +12,11 @@ from ..core.dispatch import primitive, eager_apply
 
 # ---- binary elementwise ----
 
-def _binop(name, fn):
+def _binop(op_name, fn):
+    # the paddle-API ``name`` kwarg must not shadow the op's registry name
     def op(x, y, name=None):
-        return eager_apply(name, fn, (x, y), {})
-    op.__name__ = name
+        return eager_apply(op_name, fn, (x, y), {})
+    op.__name__ = op_name
     op.pure = fn
     return op
 
@@ -51,10 +52,10 @@ true_divide = divide
 
 # ---- unary elementwise ----
 
-def _unop(name, fn):
+def _unop(op_name, fn):
     def op(x, name=None):
-        return eager_apply(name, fn, (x,), {})
-    op.__name__ = name
+        return eager_apply(op_name, fn, (x,), {})
+    op.__name__ = op_name
     op.pure = fn
     return op
 
@@ -164,10 +165,10 @@ def _axis(axis):
     return int(axis)
 
 
-def _reduce(name, fn):
+def _reduce(op_name, fn):
     def op(x, axis=None, keepdim=False, name=None):
-        return eager_apply(name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
-    op.__name__ = name
+        return eager_apply(op_name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+    op.__name__ = op_name
     return op
 
 

@@ -1,0 +1,6 @@
+"""paddle_tpu.vision — datasets, transforms, model zoo, vision ops
+(analog of python/paddle/vision/)."""
+from . import datasets  # noqa: F401
+from . import transforms  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,21 @@
+"""Shared helpers for vision ops: single-primitive dispatch + param coercion
+(same pattern as paddle_tpu/distribution/distribution.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+
+
+def _apply(name, fn, *args, **kwargs):
+    return eager_apply(name, fn, args, kwargs)
+
+
+def param(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype))
+
+
+__all__ = ["_apply", "param"]

@@ -1,0 +1,189 @@
+"""vision datasets (analog of python/paddle/vision/datasets/).
+
+No network egress in this environment: datasets parse standard on-disk
+formats (IDX for MNIST-family, the CIFAR pickle batches, image folders)
+when given a local path, and ``FakeData`` provides deterministic synthetic
+samples for tests/smoke runs (the role the reference's downloads play in
+its CI).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=128, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(
+            0, 256, (size,) + tuple(image_shape), dtype=np.uint8)
+        self.labels = rng.randint(0, num_classes, (size,)).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(shape)
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference: python/paddle/vision/datasets/mnist.py).
+
+    ``image_path``/``label_path`` must point at local idx(-gz) files;
+    download is not supported in this environment (zero egress).
+    """
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if download and (image_path is None or label_path is None):
+            raise RuntimeError(
+                "download is unavailable (no network egress); pass "
+                "image_path/label_path to local IDX files")
+        self.images = _read_idx(image_path)
+        self.labels = _read_idx(label_path).astype(np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """CIFAR pickle batches (reference: vision/datasets/cifar.py)."""
+
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if data_file is None:
+            raise RuntimeError(
+                "download is unavailable (no network egress); pass data_file")
+        with open(data_file, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        data = batch[b"data"] if b"data" in batch else batch["data"]
+        labels = batch.get(b"labels", batch.get(b"fine_labels",
+                 batch.get("labels")))
+        self.images = np.asarray(data, np.uint8).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _n_classes = 100
+
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subfolder image tree (reference: vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS,
+                 transform=None, is_valid_file=None):
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.classes = classes
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    ok = is_valid_file(fn) if is_valid_file else \
+                        fn.lower().endswith(tuple(extensions))
+                    if ok:
+                        self.samples.append((os.path.join(dirpath, fn),
+                                             self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+        self.transform = transform
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        with Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabelled flat folder of images."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS,
+                 transform=None, is_valid_file=None):
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                ok = is_valid_file(fn) if is_valid_file else \
+                    fn.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append((os.path.join(dirpath, fn), 0))
+        self.loader = loader or DatasetFolder._default_loader
+        self.transform = transform
+        self.classes = []
+        self.class_to_idx = {}
+
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder"]

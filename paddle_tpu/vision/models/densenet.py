@@ -1,0 +1,106 @@
+"""DenseNet 121/161/169/201/264 (reference: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+
+_CFGS = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, c_in, growth, bn_size=4, dropout=0.0):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(c_in)
+        self.conv1 = nn.Conv2D(c_in, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return paddle.concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, c_in, c_out):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(c_in)
+        self.conv = nn.Conv2D(c_in, c_out, 1, bias_attr=False)
+        self.relu = nn.ReLU()
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = _CFGS[layers]
+        feats = [nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+                 nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1)]
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size, dropout))
+                c += growth
+            if i != len(blocks) - 1:
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        feats += [nn.BatchNorm2D(c), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _densenet(layers, pretrained, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]

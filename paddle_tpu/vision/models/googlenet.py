@@ -1,0 +1,71 @@
+"""GoogLeNet / Inception-v1 (reference: python/paddle/vision/models/googlenet.py)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+
+
+class _Inception(nn.Layer):
+    def __init__(self, c_in, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        relu = nn.ReLU
+        self.b1 = nn.Sequential(nn.Conv2D(c_in, c1, 1), relu())
+        self.b2 = nn.Sequential(nn.Conv2D(c_in, c3r, 1), relu(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), relu())
+        self.b3 = nn.Sequential(nn.Conv2D(c_in, c5r, 1), relu(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), relu())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(c_in, proj, 1), relu())
+
+    def forward(self, x):
+        return paddle.concat(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        relu = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), relu(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), relu(),
+            nn.Conv2D(64, 192, 3, padding=1), relu(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc3 = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc4 = nn.Sequential(
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.inc5 = nn.Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.2)
+        self.fc = nn.Linear(1024, num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return GoogLeNet(**kw)
+
+
+__all__ = ["GoogLeNet", "googlenet"]

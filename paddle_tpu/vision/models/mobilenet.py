@@ -1,0 +1,226 @@
+"""MobileNet v1/v2/v3 (reference: python/paddle/vision/models/
+mobilenetv1.py, mobilenetv2.py, mobilenetv3.py).
+
+Depthwise convs lower to XLA grouped convolutions (feature_group_count),
+which Mosaic maps onto the MXU without the reference's special depthwise
+CUDA kernels.
+"""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, c_in, c_out, k, stride=1, groups=1, act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(c_in, c_out, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(c_out)
+        self.act = {"relu": nn.ReLU(), "relu6": nn.ReLU6(),
+                    "hardswish": nn.Hardswish(), None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        s = lambda c: int(c * scale)
+        layers = [ConvBNLayer(3, s(32), 3, stride=2)]
+        for c_in, c_out, stride in cfg:
+            layers.append(ConvBNLayer(s(c_in), s(c_in), 3, stride=stride,
+                                      groups=s(c_in)))        # depthwise
+            layers.append(ConvBNLayer(s(c_in), s(c_out), 1))  # pointwise
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(s(1024), num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNLayer(c_in, hidden, 1, act="relu6"))
+        layers += [
+            ConvBNLayer(hidden, hidden, 3, stride=stride, groups=hidden,
+                        act="relu6"),
+            ConvBNLayer(hidden, c_out, 1, act=None),
+        ]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        c_in = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers = [ConvBNLayer(3, c_in, 3, stride=2, act="relu6")]
+        for t, c, n, s in cfg:
+            c_out = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(c_in, c_out,
+                                               s if i == 0 else 1, t))
+                c_in = c_out
+        layers.append(ConvBNLayer(c_in, last, 1, act="relu6"))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                        nn.Linear(last, num_classes)) \
+            if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeExcite(nn.Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, _make_divisible(c // r), 1)
+        self.fc2 = nn.Conv2D(_make_divisible(c // r), c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    def __init__(self, c_in, hidden, c_out, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if hidden != c_in:
+            layers.append(ConvBNLayer(c_in, hidden, 1, act=act))
+        layers.append(ConvBNLayer(hidden, hidden, k, stride=stride,
+                                  groups=hidden, act=act))
+        if use_se:
+            layers.append(SqueezeExcite(hidden))
+        layers.append(ConvBNLayer(hidden, c_out, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_channels, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c_in = _make_divisible(16 * scale)
+        layers = [ConvBNLayer(3, c_in, 3, stride=2, act="hardswish")]
+        for k, exp, c, se, act, s in cfg:
+            c_out = _make_divisible(c * scale)
+            hidden = _make_divisible(exp * scale)
+            layers.append(_V3Block(c_in, hidden, c_out, k, s, se, act))
+            c_in = c_out
+        last_conv = _make_divisible(cfg[-1][1] * scale)
+        layers.append(ConvBNLayer(c_in, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(last_conv, last_channels), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(last_channels, num_classes)) \
+            if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return MobileNetV2(scale=scale, **kw)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return MobileNetV3(_V3_LARGE, 1280, scale=scale, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kw)
+
+
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3", "mobilenet_v1",
+           "mobilenet_v2", "mobilenet_v3_large", "mobilenet_v3_small"]

@@ -1,0 +1,117 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ... import nn
+
+_CFGS = {
+    "x0_25": ([24, 24, 48, 96, 512], [4, 8, 4]),
+    "x0_5": ([24, 48, 96, 192, 1024], [4, 8, 4]),
+    "x1_0": ([24, 116, 232, 464, 1024], [4, 8, 4]),
+    "x1_5": ([24, 176, 352, 704, 1024], [4, 8, 4]),
+    "x2_0": ([24, 244, 488, 976, 2048], [4, 8, 4]),
+}
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, c_in, c_out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = c_out // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(c_in, c_in, 3, stride=2, padding=1, groups=c_in,
+                          bias_attr=False),
+                nn.BatchNorm2D(c_in),
+                nn.Conv2D(c_in, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), nn.ReLU())
+            in2 = c_in
+        else:
+            self.branch1 = None
+            in2 = c_in // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(in2, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                      groups=branch, bias_attr=False),
+            nn.BatchNorm2D(branch),
+            nn.Conv2D(branch, branch, 1, bias_attr=False),
+            nn.BatchNorm2D(branch), nn.ReLU())
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 2:
+            out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = paddle.concat([x1, self.branch2(x2)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale="x1_0", num_classes=1000, with_pool=True,
+                 act="relu"):
+        super().__init__()
+        channels, repeats = _CFGS[scale]
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(channels[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        c_in = channels[0]
+        for c_out, n in zip(channels[1:4], repeats):
+            for i in range(n):
+                stages.append(_ShuffleUnit(c_in, c_out, 2 if i == 0 else 1))
+                c_in = c_out
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(c_in, channels[4], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[4]), nn.ReLU())
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(channels[4], num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x0_25", **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x0_5", **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x1_0", **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x1_5", **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x2_0", **kw)
+
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]

@@ -1,0 +1,73 @@
+"""VGG 11/13/16/19 (reference: python/paddle/vision/models/vgg.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_features(cfg, batch_norm):
+    layers, c_in = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(c_in, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            c_in = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 49, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return self.classifier(x.flatten(1))
+
+
+def _vgg(cfg, batch_norm, pretrained, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return VGG(_make_features(_CFGS[cfg], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    return _vgg("A", batch_norm, pretrained, **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    return _vgg("B", batch_norm, pretrained, **kw)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kw):
+    return _vgg("D", batch_norm, pretrained, **kw)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kw):
+    return _vgg("E", batch_norm, pretrained, **kw)
+
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]

@@ -1,0 +1,135 @@
+"""vision ops: box utilities, NMS, RoI ops (analog of python/paddle/vision/ops.py).
+
+The reference implements these as CUDA kernels (nms_kernel.cu, roi_align
+etc.); here they are fused jnp closures on the eager dispatch — static
+shapes throughout (NMS returns a fixed-size keep mask, the TPU-friendly
+formulation, instead of a dynamic index list).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core_compat import _apply, param
+
+
+def box_area(boxes):
+    return _apply("box_area",
+                  lambda b: (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]),
+                  param(boxes))
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU: [N,4] x [M,4] -> [N,M] (xyxy)."""
+    def f(a, b):
+        area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-9)
+    return _apply("box_iou", f, param(boxes1), param(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns indices of kept boxes sorted by score.
+
+    Static-shape inner loop (lax.fori_loop over N) — the dynamic output
+    gather happens on the host, as the reference does after its CUDA kernel.
+    """
+    import numpy as np
+    from ..core.tensor import Tensor
+
+    b = param(boxes)._data
+    n = b.shape[0]
+    s = param(scores)._data if scores is not None else jnp.arange(
+        n, 0, -1, dtype=jnp.float32)
+
+    def pure(b, s):
+        order = jnp.argsort(-s)
+        bs = b[order]
+        ious = _pairwise_iou(bs)
+        if category_idxs is not None:
+            cats = param(category_idxs)._data[order]
+            ious = jnp.where(cats[:, None] == cats[None, :], ious, 0.0)
+
+        idx = jnp.arange(n)
+
+        def body(i, keep):
+            # suppressed if any kept earlier box overlaps > threshold
+            # (mask formulation — fori_loop forbids traced-bound slices)
+            sup = (ious[i] > iou_threshold) & keep & (idx < i)
+            return keep.at[i].set(jnp.logical_not(sup.any()))
+
+        keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool)) \
+            if n > 0 else jnp.zeros((n,), bool)
+        return keep, order
+
+    keep, order = pure(b, s)
+    keep_np = np.asarray(keep)
+    order_np = np.asarray(order)
+    kept = order_np[keep_np]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept))
+
+
+def _pairwise_iou(b):
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area[:, None] + area[None, :] - inter + 1e-9)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign via bilinear sampling (reference: vision/ops.py roi_align,
+    CUDA roi_align_kernel.cu). x: [N,C,H,W]; boxes: [R,4] xyxy in input
+    coords; boxes_num: rois per image."""
+    import numpy as np
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def f(x, boxes):
+        n, c, h, w = x.shape
+        r = boxes.shape[0]
+        # image index per roi from boxes_num (host-side static)
+        counts = np.asarray(param(boxes_num).numpy() if hasattr(boxes_num, "numpy")
+                            else boxes_num)
+        img_idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+
+        offset = 0.5 if aligned else 0.0
+        x0 = boxes[:, 0] * spatial_scale - offset
+        y0 = boxes[:, 1] * spatial_scale - offset
+        x1 = boxes[:, 2] * spatial_scale - offset
+        y1 = boxes[:, 3] * spatial_scale - offset
+        bw = jnp.maximum(x1 - x0, 1e-4)
+        bh = jnp.maximum(y1 - y0, 1e-4)
+        ys = y0[:, None] + (jnp.arange(out_h) + 0.5) / out_h * bh[:, None]
+        xs = x0[:, None] + (jnp.arange(out_w) + 0.5) / out_w * bw[:, None]
+
+        def sample_one(img_i, yy, xx):
+            img = x[img_i]                               # [C,H,W]
+            yy0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            xx0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            yy1 = jnp.clip(yy0 + 1, 0, h - 1)
+            xx1 = jnp.clip(xx0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - yy0, 0, 1)
+            wx = jnp.clip(xx - xx0, 0, 1)
+            g = lambda yi, xi: img[:, yi][:, :, xi]      # [C,out_h,out_w]
+            val = (g(yy0, xx0) * ((1 - wy)[:, None] * (1 - wx)[None, :])[None]
+                   + g(yy1, xx0) * (wy[:, None] * (1 - wx)[None, :])[None]
+                   + g(yy0, xx1) * ((1 - wy)[:, None] * wx[None, :])[None]
+                   + g(yy1, xx1) * (wy[:, None] * wx[None, :])[None])
+            return val
+
+        return jax.vmap(sample_one)(img_idx, ys, xs)     # [R,C,out_h,out_w]
+
+    return _apply("roi_align", f, param(x), param(boxes))
+
+
+__all__ = ["box_area", "box_iou", "nms", "roi_align"]

@@ -1,0 +1,245 @@
+"""vision transforms (analog of python/paddle/vision/transforms/).
+
+Operate on numpy HWC uint8/float arrays or PIL Images on the host —
+preprocessing stays on CPU so the TPU input pipeline feeds ready tensors
+(the reference applies the same design: transforms run in DataLoader
+workers, python/paddle/vision/transforms/transforms.py).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+try:
+    from PIL import Image
+    _HAS_PIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def _to_numpy(img):
+    if _HAS_PIL and isinstance(img, Image.Image):
+        return np.asarray(img)
+    return np.asarray(img)
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1] (reference: transforms.ToTensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = _size_pair(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = self.size
+        if _HAS_PIL:
+            if not isinstance(img, Image.Image):
+                img = Image.fromarray(np.asarray(img).astype(np.uint8))
+            resample = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                        "bicubic": Image.BICUBIC}[self.interpolation]
+            return np.asarray(img.resize((w, h), resample))
+        # nearest-neighbor fallback
+        arr = _to_numpy(img)
+        ys = (np.arange(h) * arr.shape[0] / h).astype(int)
+        xs = (np.arange(w) * arr.shape[1] / w).astype(int)
+        return arr[ys][:, xs]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = _size_pair(size)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        th, tw = self.size
+        i = max(0, (arr.shape[0] - th) // 2)
+        j = max(0, (arr.shape[1] - tw) // 2)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False):
+        self.size = _size_pair(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else (self.padding,) * 4
+            pad = [(p[1], p[3]), (p[0], p[2])] + \
+                [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        th, tw = self.size
+        i = random.randint(0, max(0, arr.shape[0] - th))
+        j = random.randint(0, max(0, arr.shape[1] - tw))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        return arr[:, ::-1].copy() if random.random() < self.prob else arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        return arr[::-1].copy() if random.random() < self.prob else arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.resize = Resize(size, interpolation)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return self.resize(arr[i:i + ch, j:j + cw])
+        return self.resize(CenterCrop((h, w))._apply_image(arr))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding if isinstance(padding, (tuple, list)) \
+            else (padding,) * 4
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        p = self.padding
+        pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode=self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if arr.ndim == 2:
+            g = arr
+        else:
+            g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        out = np.repeat(g[..., None], self.n, -1)
+        return out
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        return np.clip(arr * f, 0, 255)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img).astype(np.float32)
+        if self.brightness:
+            arr = arr * (1 + random.uniform(-self.brightness, self.brightness))
+        if self.contrast:
+            mean = arr.mean()
+            arr = (arr - mean) * (1 + random.uniform(-self.contrast,
+                                                     self.contrast)) + mean
+        return np.clip(arr, 0, 255)
+
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomResizedCrop", "Transpose", "Pad",
+           "Grayscale", "BrightnessTransform", "ColorJitter"]

@@ -6,6 +6,16 @@ reference gives loopback NCCL.
 """
 import os
 
+# Axon claim discipline: tests are CPU-only; make absolutely sure no axon
+# backend is ever initialized from a test process (a claim through the
+# relay would serialize against — and can wedge — the single TPU pool).
+# sitecustomize has already imported jax by now, so the env var alone
+# doesn't stop registration, but jax.config platforms=cpu below prevents
+# backend init; clearing the var also covers worker subprocesses spawned
+# by tests (launch CLI tests re-exec python).
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+
 # XLA parses XLA_FLAGS at backend-creation time, so setting it here works even
 # though sitecustomize already imported jax at interpreter startup.
 os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "

@@ -1,0 +1,128 @@
+"""Native runtime (flags/profiler/allocator/workqueue) + profiler API.
+
+Mirrors the reference's C++ unit tests (test/cpp/) + python profiler tests
+(test/legacy_test/test_profiler.py) at the Python binding surface.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native as nv
+
+nv.ensure_loaded()
+
+needs_native = pytest.mark.skipif(not nv.AVAILABLE,
+                                  reason="native runtime not built")
+
+
+@needs_native
+def test_flags_mirror_to_native():
+    paddle.set_flags({"check_nan_inf": True})
+    assert nv.flags.get("check_nan_inf") in ("True", "true", "1")
+    paddle.set_flags({"check_nan_inf": False})
+    assert paddle.get_flags("check_nan_inf")["FLAGS_check_nan_inf"] is False
+
+
+@needs_native
+def test_allocator_cache_and_stats():
+    nv.mem_release_cached()
+    base_reserved = nv.mem_reserved()
+    b = nv.HostBuffer(1 << 20)
+    arr = b.as_numpy(np.float32, (256, 1024))
+    arr[:] = 3.0
+    assert nv.mem_allocated() >= (1 << 20)
+    b.free()
+    assert nv.mem_reserved() >= base_reserved + (1 << 20)  # cached
+    b2 = nv.HostBuffer(1 << 20)  # reuse from cache, no growth
+    assert nv.mem_reserved() == nv.mem_reserved()
+    b2.free()
+    nv.mem_release_cached()
+
+
+@needs_native
+def test_workqueue_dependencies():
+    wq = nv.WorkQueue(4)
+    order = []
+    lock = threading.Lock()
+
+    def mk(tag):
+        def f():
+            with lock:
+                order.append(tag)
+        return f
+
+    a = wq.submit(mk("a"))
+    b = wq.submit(mk("b"), deps=[a])
+    c = wq.submit(mk("c"), deps=[b])
+    wq.wait_all()
+    wq.close()
+    assert order == ["a", "b", "c"]
+
+
+@needs_native
+def test_native_collate_matches_stack():
+    wq = nv.WorkQueue(4)
+    srcs = [np.random.randn(32, 32).astype(np.float32) for _ in range(8)]
+    dst = np.empty((8, 32, 32), np.float32)
+    wq.collate(dst, srcs)
+    np.testing.assert_array_equal(dst, np.stack(srcs))
+    wq.close()
+
+
+@needs_native
+def test_dataloader_native_fast_path():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((64, 64), i, np.float32), np.int64(i)
+
+        def __len__(self):
+            return 32
+
+    dl = DataLoader(DS(), batch_size=16)  # 16*16KB > native threshold
+    xb, yb = next(iter(dl))
+    assert list(xb.shape) == [16, 64, 64]
+    np.testing.assert_allclose(xb.numpy()[3], 3.0)
+
+
+@needs_native
+def test_profiler_records_ops_and_exports(tmp_path):
+    from paddle_tpu.profiler import Profiler, RecordEvent, ProfilerTarget
+
+    with Profiler(targets=[ProfilerTarget.CPU]) as prof:
+        with RecordEvent("user_span"):
+            x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+            y = paddle.matmul(x, x)
+            _ = paddle.tanh(y).numpy()
+        prof.step()
+    stats = prof.summary(time_unit="us")
+    assert any("matmul" in k for k in stats)
+    path = prof.export_chrome_tracing(str(tmp_path))
+    trace = json.load(open(path))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "user_span" in names
+    assert any("matmul" in n for n in names)
+
+
+@needs_native
+def test_profiler_scheduler_gates_recording():
+    from paddle_tpu.profiler import Profiler, ProfilerTarget, make_scheduler
+
+    nv.prof_clear()
+    sched = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+    prof = Profiler(targets=[ProfilerTarget.CPU], scheduler=sched)
+    prof.start()           # step 0: closed
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    _ = paddle.matmul(x, x)
+    n_closed = sum(1 for e in nv.prof_export() if e[4] == 1)
+    prof.step()            # step 1: record
+    _ = paddle.matmul(x, x)
+    prof.stop()
+    n_after = sum(1 for e in nv.prof_export() if e[4] == 1)
+    assert n_closed == 0
+    assert n_after >= 1

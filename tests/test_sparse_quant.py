@@ -1,0 +1,92 @@
+"""Sparse tensors + quantization.
+
+Mirrors the reference's test/legacy_test sparse/quant unit tests at the
+public API surface.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+from paddle_tpu.quantization import (
+    AbsmaxObserver, EMAObserver, QAT, QuantConfig, FakeQuanterWithAbsMax,
+    fake_quantize)
+
+
+def test_sparse_coo_roundtrip():
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    val = np.array([1.0, 2.0, 3.0], np.float32)
+    s = sparse.sparse_coo_tensor(idx, val, shape=(3, 3))
+    assert s.nnz() == 3
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[idx[0], idx[1]] = val
+    np.testing.assert_array_equal(dense, expect)
+    # back to sparse
+    s2 = sparse.to_sparse_coo(paddle.to_tensor(expect))
+    np.testing.assert_array_equal(s2.to_dense().numpy(), expect)
+
+
+def test_sparse_csr():
+    crows = np.array([0, 1, 3])
+    cols = np.array([1, 0, 1])
+    vals = np.array([5.0, 1.0, 2.0], np.float32)
+    s = sparse.sparse_csr_tensor(crows, cols, vals, shape=(2, 2))
+    np.testing.assert_array_equal(s.to_dense().numpy(),
+                                  [[0, 5], [1, 2]])
+
+
+def test_sparse_matmul_and_unary():
+    rng = np.random.RandomState(0)
+    dense_np = rng.randn(8, 8).astype(np.float32)
+    dense_np[np.abs(dense_np) < 1.0] = 0.0  # sparsify
+    s = sparse.to_sparse_coo(paddle.to_tensor(dense_np))
+    d = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    out = sparse.matmul(s, d)
+    np.testing.assert_allclose(out.numpy(), dense_np @ d.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    r = sparse.relu(s)
+    np.testing.assert_array_equal(r.to_dense().numpy(),
+                                  np.maximum(dense_np, 0))
+
+
+def test_fake_quantize_ste_grad():
+    x = paddle.to_tensor(np.linspace(-1, 1, 16, dtype=np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(1.0)
+    y = fake_quantize(x, scale, bits=8)
+    err = np.abs(y.numpy() - x.numpy()).max()
+    assert err <= 1.0 / 127 + 1e-6  # quantization error bounded by one step
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.0)  # straight-through
+
+
+def test_observers():
+    ob = AbsmaxObserver()
+    ob(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+    ob(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(ob.scales().numpy()) == 3.0
+    ema = EMAObserver(moving_rate=0.5)
+    ema(paddle.to_tensor(np.array([4.0], np.float32)))
+    ema(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert 2.0 < float(ema.scales().numpy()) < 4.0
+
+
+def test_qat_quantize_and_train():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                      weight=FakeQuanterWithAbsMax)
+    qat = QAT(cfg)
+    qmodel = qat.quantize(model)   # deep-copies: original stays fp
+    from paddle_tpu.quantization import QuantedLayer
+    assert not any(isinstance(l, QuantedLayer) for l in model.sublayers())
+    assert any(isinstance(l, QuantedLayer) for l in qmodel.sublayers())
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    y = qmodel(x)
+    assert y.shape == [4, 4]
+    loss = (y * y).mean()
+    loss.backward()
+    grads = [p.grad for p in qmodel.parameters() if p.grad is not None]
+    assert grads  # STE lets grads reach the fp weights

@@ -1,0 +1,74 @@
+"""Vision: transforms, datasets, model zoo forward shapes, box ops.
+
+Mirrors the reference's test/legacy_test/test_vision_models.py approach:
+tiny-input forward pass per architecture + op-level numeric checks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision.ops import box_iou, nms
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([
+        T.Resize(40), T.RandomCrop(32), T.RandomHorizontalFlip(1.0),
+        T.ToTensor(), T.Normalize([0.5]*3, [0.5]*3),
+    ])
+    img = np.random.randint(0, 256, (48, 64, 3), np.uint8)
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_fake_data_with_loader():
+    ds = FakeData(size=32, image_shape=(3, 16, 16), num_classes=5)
+    from paddle_tpu.io import DataLoader
+    dl = DataLoader(ds, batch_size=8)
+    xb, yb = next(iter(dl))
+    assert list(xb.shape) == [8, 3, 16, 16]
+    assert list(yb.shape) == [8]
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: M.alexnet(num_classes=10), 71),
+    (lambda: M.vgg11(num_classes=10), 32),
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=10), 32),
+    (lambda: M.mobilenet_v2(scale=0.35, num_classes=10), 32),
+    (lambda: M.mobilenet_v3_small(scale=0.35, num_classes=10), 32),
+    (lambda: M.densenet121(num_classes=10), 32),
+    (lambda: M.squeezenet1_1(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), 32),
+    (lambda: M.googlenet(num_classes=10), 64),
+])
+def test_model_forward_shapes(ctor, size):
+    paddle.seed(0)
+    net = ctor()
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(2, 3, size, size).astype(np.float32))
+    with paddle.no_grad():
+        y = net(x)
+    assert y.shape == [2, 10]
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_box_iou_and_nms():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
+    iou = box_iou(boxes, boxes).numpy()
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    assert iou[0, 2] == 0.0
+    assert 0.5 < iou[0, 1] < 0.9
+
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    kept = nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+    assert list(kept) == [0, 2]  # box 1 suppressed by box 0
+
+
+def test_pretrained_flag_raises():
+    with pytest.raises(RuntimeError):
+        M.vgg11(pretrained=True)
