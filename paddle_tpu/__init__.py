@@ -51,6 +51,10 @@ from . import vision  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless

@@ -1,0 +1,158 @@
+"""paddle_tpu.audio — audio features (analog of python/paddle/audio/).
+
+Feature extractors (STFT/Spectrogram/MelSpectrogram/LogMelSpectrogram,
+MFCC) as fused jnp ops: frame+window+rFFT lower to XLA's native FFT,
+so the whole frontend runs on the TPU inside a compiled program — the
+reference's CPU kaldi-style featurizer moves on-device.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _apply(name, fn, *args):
+    return eager_apply(name, fn, args, {})
+
+
+def _hz_to_mel(f):
+    return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+
+
+def _mel_to_hz(m):
+    return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None):
+    """[n_mels, n_fft//2+1] mel filterbank (reference:
+    python/paddle/audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    mels = np.linspace(_hz_to_mel(f_min), _hz_to_mel(f_max), n_mels + 2)
+    hz = _mel_to_hz(mels)
+    bins = np.floor((n_fft + 1) * hz / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
+    for i in range(n_mels):
+        l, c, r = bins[i], bins[i + 1], bins[i + 2]
+        if c > l:
+            fb[i, l:c] = (np.arange(l, c) - l) / max(c - l, 1)
+        if r > c:
+            fb[i, c:r] = (r - np.arange(c, r)) / max(r - c, 1)
+    return fb
+
+
+def get_window(window, win_length):
+    if window in ("hann", "hanning"):
+        return np.hanning(win_length).astype(np.float32)
+    if window in ("hamming",):
+        return np.hamming(win_length).astype(np.float32)
+    if window in ("blackman",):
+        return np.blackman(win_length).astype(np.float32)
+    return np.ones(win_length, np.float32)
+
+
+def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
+         center=True, pad_mode="reflect"):
+    """[.., T] -> complex [.., n_fft//2+1, frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = jnp.asarray(get_window(window, win_length))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def fn(sig):
+        s = sig
+        if center:
+            pads = [(0, 0)] * (s.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            s = jnp.pad(s, pads, mode=pad_mode)
+        n_frames = 1 + (s.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = s[..., idx] * w                       # [.., frames, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1)
+        return jnp.swapaxes(spec, -1, -2)              # [.., bins, frames]
+
+    return _apply("stft", fn, x if isinstance(x, Tensor) else Tensor(x))
+
+
+class Spectrogram(Layer):
+    """|STFT|^power (reference: python/paddle/audio/features/layers.py)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.kw = dict(n_fft=n_fft, hop_length=hop_length,
+                       win_length=win_length, window=window, center=center,
+                       pad_mode=pad_mode)
+        self.power = power
+
+    def forward(self, x):
+        spec = stft(x, **self.kw)
+        return _apply("spec_power",
+                      lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=16000, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power)
+        self.fbank = jnp.asarray(
+            compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        return _apply("mel_project",
+                      lambda s: jnp.einsum("mf,...ft->...mt", self.fbank, s),
+                      spec)
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__(*args, **kw)
+        self.amin = amin
+        self.top_db = top_db
+        self.ref_value = ref_value
+
+    def forward(self, x):
+        mel = super().forward(x)
+
+        def fn(m):
+            db = 10.0 * jnp.log10(jnp.maximum(m, self.amin) / self.ref_value)
+            if self.top_db is not None:
+                db = jnp.maximum(db, db.max() - self.top_db)
+            return db
+
+        return _apply("power_to_db", fn, mel)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=16000, n_mfcc=13, n_fft=512, n_mels=64, **kw):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_fft=n_fft, n_mels=n_mels, **kw)
+        # DCT-II basis [n_mfcc, n_mels]
+        n = np.arange(n_mels)
+        basis = np.cos(np.pi / n_mels * (n + 0.5)[None, :]
+                       * np.arange(n_mfcc)[:, None]) * math.sqrt(2.0 / n_mels)
+        basis[0] /= math.sqrt(2.0)
+        self.basis = jnp.asarray(basis.astype(np.float32))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        return _apply("dct",
+                      lambda m: jnp.einsum("cm,...mt->...ct", self.basis, m),
+                      lm)
+
+
+__all__ = ["stft", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
+           "MFCC", "compute_fbank_matrix", "get_window"]

@@ -1,19 +1,161 @@
-"""paddle_tpu.device — device management (analog of python/paddle/device/)."""
-from ..core.place import set_device, get_device, CPUPlace, TPUPlace, Place, is_compiled_with_tpu  # noqa: F401
+"""paddle_tpu.device — device management (analog of python/paddle/device/).
+
+The reference's Stream/Event classes (python/paddle/device/cuda/streams.py)
+wrap CUDA streams; XLA owns stream scheduling on TPU, so Stream/Event here
+provide ordering semantics at the dispatch level: ``synchronize`` blocks on
+live buffers, Event.record captures the current async frontier.
+"""
+from __future__ import annotations
+
+import time
+
 import jax as _jax
+
+from ..core.place import (  # noqa: F401
+    set_device, get_device, CPUPlace, TPUPlace, Place, is_compiled_with_tpu)
+
 
 def device_count():
     return len(_jax.devices())
+
 
 def synchronize(device=None):
     for d in _jax.live_arrays():
         d.block_until_ready()
 
+
 def cuda_device_count():  # parity shim
     return 0
+
 
 def is_compiled_with_cuda():
     return False
 
+
 def is_compiled_with_xpu():
     return False
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in _jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in _jax.devices()]
+
+
+class Event:
+    """(reference: device/cuda/streams.py Event). record() captures the
+    current dispatch frontier; synchronize() drains it; elapsed_time
+    between two synced events in ms."""
+
+    def __init__(self, device=None, enable_timing=True):
+        self._arrays = []
+        self._time = None
+
+    def record(self, stream=None):
+        self._arrays = list(_jax.live_arrays())
+        self._time = None
+
+    def synchronize(self):
+        for a in self._arrays:
+            a.block_until_ready()
+        if self._time is None:
+            self._time = time.perf_counter()
+
+    def query(self):
+        return all(a.is_ready() for a in self._arrays)
+
+    def elapsed_time(self, end_event):
+        # drain in event order so the start timestamp cannot postdate the
+        # end timestamp; if the caller already synced the end event first,
+        # ordering is unrecoverable — clamp at zero
+        self.synchronize()
+        end_event.synchronize()
+        return max(0.0, (end_event._time - self._time) * 1e3)
+
+
+class Stream:
+    """XLA enqueues on its own streams; this object provides the reference
+    API's ordering handles (wait_event/record_event/synchronize)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _current_stream
+        prev, _current_stream = _current_stream, stream
+        try:
+            yield
+        finally:
+            _current_stream = prev
+
+    return guard()
+
+
+class cuda:
+    """Namespace shim: paddle.device.cuda.* maps onto the TPU runtime."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        from ..core import native as _nv
+        _nv.mem_release_cached()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        from ..core import native as _nv
+        return _nv.mem_peak()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        from ..core import native as _nv
+        return _nv.mem_allocated()
+
+    @staticmethod
+    def memory_reserved(device=None):
+        from ..core import native as _nv
+        return _nv.mem_reserved()
+
+
+__all__ = ["set_device", "get_device", "device_count", "synchronize",
+           "Stream", "Event", "current_stream", "stream_guard", "cuda",
+           "is_compiled_with_tpu", "is_compiled_with_cuda",
+           "is_compiled_with_xpu", "get_all_device_type",
+           "get_available_device"]

@@ -25,6 +25,9 @@ from .auto_parallel import parallelize, to_static  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .expert_parallel import moe_alltoall  # noqa: F401
+from . import auto_tuner  # noqa: F401
+from .elastic import ElasticManager, HealthMonitor  # noqa: F401
+from . import launch  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention, ring_attention_p, ulysses_attention, ulysses_attention_p,
 )

@@ -1,0 +1,175 @@
+"""Automatic parallel-strategy tuner.
+
+TPU-native analog of the reference's black-box auto tuner + cost model
+(reference: python/paddle/distributed/auto_tuner/{tuner,search,prune}.py —
+grid search over dp/mp/pp/sharding with prune rules; cost models
+python/paddle/distributed/auto_parallel/static/cost/). Two tiers:
+
+- ``estimate``: an analytic roofline model (MXU flops vs ICI/HBM bytes) that
+  ranks candidate meshes WITHOUT running them — the reference's
+  cost-model planner role, re-derived for TPU interconnect geometry;
+- ``AutoTuner``: measured search — builds the pruned candidate list, calls
+  a user ``run_fn(cfg) -> metric`` per candidate (OOM-tolerant), returns
+  the best, with history like the reference's tuner.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import time
+
+
+# chip model: (peak bf16 flops, HBM GB/s, per-link ICI GB/s)
+CHIPS = {
+    "v4": (275e12, 1228, 50),
+    "v5e": (197e12, 819, 50),
+    "v5p": (459e12, 2765, 100),
+    "v6e": (918e12, 1640, 100),
+}
+
+
+class Candidate(dict):
+    @property
+    def degree(self):
+        return self["dp"] * self["mp"] * self["pp"] * self.get("sep", 1)
+
+    def __repr__(self):
+        keys = ("dp", "mp", "pp", "sharding", "sep", "micro_batch_size")
+        return "Candidate(" + ", ".join(
+            f"{k}={self[k]}" for k in keys if k in self) + ")"
+
+
+def candidates(num_devices, model_cfg, max_mp=None, max_pp=None,
+               sharding_stages=(1,), micro_batch_sizes=(1, 2, 4)):
+    """Enumerate divisibility-valid (dp, mp, pp, sharding, mbsz) tuples
+    (reference: auto_tuner/search.py grid; prune.py divisibility rules)."""
+    hidden = model_cfg.get("hidden_size", 1024)
+    layers = model_cfg.get("num_layers", 24)
+    heads = model_cfg.get("num_attention_heads", 16)
+    vocab = model_cfg.get("vocab_size", 32000)
+    global_batch = model_cfg.get("global_batch_size", 8)
+
+    out = []
+    mps = [m for m in _divisors(num_devices) if max_mp is None or m <= max_mp]
+    for mp in mps:
+        if hidden % mp or heads % mp or vocab % mp:
+            continue  # tensor-parallel shardability (prune rule)
+        for pp in _divisors(num_devices // mp):
+            if max_pp is not None and pp > max_pp:
+                continue
+            if layers % pp:
+                continue  # stage balance
+            dp = num_devices // (mp * pp)
+            if global_batch % dp:
+                continue
+            for st in sharding_stages:
+                for mbsz in micro_batch_sizes:
+                    if (global_batch // dp) % mbsz:
+                        continue
+                    out.append(Candidate(
+                        dp=dp, mp=mp, pp=pp, sharding=st, sep=1,
+                        micro_batch_size=mbsz,
+                        acc_steps=global_batch // dp // mbsz))
+    return out
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def estimate(cand, model_cfg, chip="v5p", seq_len=2048):
+    """Roofline step-time estimate (seconds) for one candidate.
+
+    compute: 6*P*tokens/dp on the MXU; mp all-reduces: 2 gathers/layer of
+    the activation over ICI; pp bubble: (pp-1)/acc_steps overhead
+    (the classic 1F1B bubble fraction); sharding adds a reduce-scatter +
+    all-gather of params per step.
+    """
+    peak, hbm_gbs, ici_gbs = CHIPS[chip]
+    h = model_cfg.get("hidden_size", 1024)
+    L = model_cfg.get("num_layers", 24)
+    vocab = model_cfg.get("vocab_size", 32000)
+    params = model_cfg.get("n_params", 12 * L * h * h + vocab * h)
+    tokens_per_dp = cand["micro_batch_size"] * cand["acc_steps"] * seq_len
+
+    flops = 6.0 * params * tokens_per_dp / (cand["mp"] * cand["pp"])
+    t_compute = flops / (peak * 0.5)          # 50% attainable
+
+    # mp: 4 all-reduces per layer of [mbsz*seq, h] bf16 over the mp ring
+    act_bytes = cand["micro_batch_size"] * seq_len * h * 2
+    ar_factor = 2 * (cand["mp"] - 1) / max(cand["mp"], 1)
+    t_mp = 0.0 if cand["mp"] == 1 else \
+        4 * L / cand["pp"] * act_bytes * ar_factor * cand["acc_steps"] \
+        / (ici_gbs * 1e9)
+
+    # pp bubble fraction applied to compute
+    bubble = (cand["pp"] - 1) / max(cand["acc_steps"] + cand["pp"] - 1, 1)
+    t_pp = t_compute * bubble
+
+    # sharding: param all-gather + grad reduce-scatter over dp
+    t_shard = 0.0
+    if cand["sharding"] >= 2 and cand["dp"] > 1:
+        pbytes = 2 * params / (cand["mp"] * cand["pp"])
+        t_shard = 2 * pbytes * (cand["dp"] - 1) / cand["dp"] / (ici_gbs * 1e9)
+
+    return t_compute + t_mp + t_pp + t_shard
+
+
+def memory_gb(cand, model_cfg, seq_len=2048, bytes_per_param=2,
+              optimizer_factor=6):
+    """Per-chip memory estimate (prune rule; reference prune.py oom rules)."""
+    h = model_cfg.get("hidden_size", 1024)
+    L = model_cfg.get("num_layers", 24)
+    vocab = model_cfg.get("vocab_size", 32000)
+    params = model_cfg.get("n_params", 12 * L * h * h + vocab * h)
+    p_local = params / (cand["mp"] * cand["pp"])
+    opt_shard = cand["dp"] if cand["sharding"] >= 1 and cand["dp"] > 1 else 1
+    weights = p_local * bytes_per_param
+    opt_state = p_local * optimizer_factor * 2 / opt_shard
+    acts = cand["micro_batch_size"] * seq_len * h * (L / cand["pp"]) * 2 * 8
+    return (weights + opt_state + acts) / 1e9
+
+
+def prune(cands, model_cfg, hbm_gb=95, seq_len=2048):
+    """Drop OOM-estimated candidates (reference: prune.py)."""
+    return [c for c in cands if memory_gb(c, model_cfg, seq_len) < hbm_gb]
+
+
+class AutoTuner:
+    """Measured search over the pruned space (reference: tuner.py Tuner)."""
+
+    def __init__(self, num_devices, model_cfg, chip="v5p", hbm_gb=95,
+                 seq_len=2048, **grid_kwargs):
+        self.model_cfg = model_cfg
+        self.seq_len = seq_len
+        cands = candidates(num_devices, model_cfg, **grid_kwargs)
+        cands = prune(cands, model_cfg, hbm_gb, seq_len)
+        # rank by the analytic model so measurement tries best-first
+        self.candidates = sorted(
+            cands, key=lambda c: estimate(c, model_cfg, chip, seq_len))
+        self.history = []
+
+    def tune(self, run_fn, max_trials=None, higher_is_better=True):
+        """run_fn(candidate) -> metric (throughput); raises on OOM/failure."""
+        best, best_metric = None, None
+        trials = self.candidates[:max_trials] if max_trials else self.candidates
+        for cand in trials:
+            t0 = time.time()
+            try:
+                metric = run_fn(cand)
+                ok = True
+            except Exception as e:
+                metric, ok = None, False
+            self.history.append({"candidate": dict(cand), "metric": metric,
+                                 "ok": ok, "elapsed": time.time() - t0})
+            if not ok or metric is None:
+                continue
+            better = best_metric is None or (
+                metric > best_metric if higher_is_better else metric < best_metric)
+            if better:
+                best, best_metric = cand, metric
+        return best, best_metric
+
+
+__all__ = ["AutoTuner", "Candidate", "candidates", "estimate", "memory_gb",
+           "prune", "CHIPS"]

@@ -1,3 +1,5 @@
 """paddle_tpu.incubate — staging ground for experimental APIs (analog of python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401

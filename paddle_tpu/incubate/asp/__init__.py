@@ -1,0 +1,81 @@
+"""ASP — automatic structured (2:4) sparsity.
+
+Analog of python/paddle/incubate/asp/: mask utilities + pruning entry.
+The reference targets Ampere sparse tensor cores; on TPU 2:4 masks are a
+regularization/compression tool (the MXU has no 2:4 mode), so masks apply
+as elementwise multiplies that XLA fuses into the matmul's producer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+_masks: dict = {}
+
+
+def compute_mask_2d(arr, n=2, m=4):
+    """Keep the n largest-|w| of every m consecutive weights along the last
+    axis (groups never span rows; a ragged tail group keeps its n largest
+    of however many weights it has)."""
+    a = np.asarray(arr)
+    rows = a.reshape(-1, a.shape[-1])
+    cols = rows.shape[1]
+    pad = (-cols) % m
+    padded = np.pad(np.abs(rows), [(0, 0), (0, pad)],
+                    constant_values=-np.inf)
+    groups = padded.reshape(rows.shape[0], -1, m)
+    idx = np.argsort(-groups, axis=2)[:, :, :n]
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=2)
+    mask = mask.reshape(rows.shape[0], -1)[:, :cols]
+    return mask.reshape(a.shape)
+
+
+def check_mask_2d(arr, n=2, m=4):
+    a = np.asarray(arr)
+    rows = (a != 0).reshape(-1, a.shape[-1])
+    cols = rows.shape[1]
+    pad = (-cols) % m
+    rows = np.pad(rows, [(0, 0), (0, pad)])
+    groups = rows.reshape(rows.shape[0], -1, m)
+    return bool((groups.sum(2) <= n).all())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every Linear weight (reference: asp/asp.py prune_model)."""
+    from ...nn.layer.common import Linear
+    for name, layer in model.named_sublayers():
+        if isinstance(layer, Linear):
+            w = layer.weight
+            mask = compute_mask_2d(w.numpy(), n, m)
+            w._data = w._data * jnp.asarray(mask, w._data.dtype)
+            _masks[id(w)] = jnp.asarray(mask, w._data.dtype)
+    return model
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after updates
+    (reference: asp/asp.py decorate)."""
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = p._data * mask
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    _masks.clear()
+
+
+__all__ = ["compute_mask_2d", "check_mask_2d", "prune_model", "decorate",
+           "reset_excluded_layers"]

@@ -1,0 +1,2 @@
+"""incubate.autograd — functional AD (analog of python/paddle/incubate/autograd/)."""
+from ...autograd.functional import jacobian, hessian, vjp, jvp  # noqa: F401

@@ -1,0 +1,150 @@
+"""paddle_tpu.inference — the deployment/serving engine.
+
+TPU-native analog of the reference's inference stack
+(reference: paddle/fluid/inference/api/analysis_predictor.h:101
+AnalysisPredictor; python/paddle/inference/ Config/create_predictor). The
+reference's role split maps as:
+
+- analysis passes / TensorRT subgraphs -> XLA AOT compilation of the saved
+  StableHLO artifact (jit.save): fusion/layout/kernel selection all happen
+  inside XLA at Predictor build, so there is no pass zoo to maintain;
+- zero-copy input/output handles   -> device-resident jax Arrays with
+  ``copy_from_cpu`` / ``copy_to_cpu`` (same names as the reference API);
+- multi-stream serving            -> per-Predictor cloned artifacts (XLA
+  executables are thread-safe for execution).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """(reference: paddle_infer.Config — model paths + runtime toggles)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # the artifact prefix: Config("m") loads m.pdmodel/m.pdiparams
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._memory_pool_mb = 0
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_profile = False
+        self._glog = False
+
+    # reference-API surface (GPU toggles accepted, mapped to the TPU)
+    def enable_use_gpu(self, memory_pool_mb=0, device_id=0):
+        self._memory_pool_mb = memory_pool_mb
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def enable_xpu(self, *a, **kw):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        self._glog = False
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self):
+        pass
+
+
+class PredictorTensor:
+    """I/O handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(np.asarray(arr))
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        pass  # shapes are taken from the data
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    """(reference: analysis_predictor.h:101). Wraps a jit.save artifact;
+    run() executes the AOT-compiled XLA executable."""
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load as jit_load
+        if config.model_prefix is None:
+            raise ValueError("Config has no model path")
+        self._layer = jit_load(config.model_prefix)
+        n = max(len(self._layer.input_metas),
+                self._layer._meta.get("n_inputs", 0)) or 1
+        self._inputs = [PredictorTensor(f"x{i}") for i in range(n)]
+        self._outputs = []
+        self._profile = config._enable_profile
+
+    def get_input_names(self):
+        return [t.name for t in self._inputs]
+
+    def get_input_handle(self, name):
+        for t in self._inputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def get_output_names(self):
+        return [t.name for t in self._outputs]
+
+    def get_output_handle(self, name):
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        """Either feed via handles + run(), or run([np arrays]) directly."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [t._value for t in self._inputs]
+        if self._profile:
+            from ..profiler import RecordEvent
+            with RecordEvent("predictor.run"):
+                out = self._layer(*arrays)
+        else:
+            out = self._layer(*arrays)
+        leaves = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        self._outputs = []
+        results = []
+        for i, leaf in enumerate(leaves):
+            h = PredictorTensor(f"out{i}")
+            h._value = leaf._data if isinstance(leaf, Tensor) else leaf
+            self._outputs.append(h)
+            results.append(np.asarray(h._value))
+        return results
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]

@@ -293,3 +293,5 @@ def enable_to_static(flag=True):
 
 def ignore_module(modules):
     pass
+
+from .save_load import save, load, InputSpec, TranslatedLayer  # noqa: F401,E402

@@ -1,0 +1,186 @@
+"""jit.save / jit.load — deployable compiled artifacts.
+
+TPU-native analog of the reference's saved-inference format
+(reference: python/paddle/jit/api.py jit.save -> TranslatedLayer via
+jit/translated_layer.py; C++ executable container paddle/fluid/jit/
+layer.h). The program format is **serialized StableHLO** via
+``jax.export`` — the portable XLA artifact (the role ProgramDesc/PIR
+serialization plays in the reference) — beside the params saved with
+``paddle_tpu.save``:
+
+    path.pdmodel    serialized StableHLO (versioned, forward-compatible)
+    path.pdiparams  parameter state_dict
+    path.meta.json  input/output tree metadata
+
+``load`` returns a TranslatedLayer: callable, parameters() exposed, usable
+for inference or as a frozen sub-layer.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..core.dtype import to_jax_dtype
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: python/paddle/static/input_spec.py).
+    Use None for dynamic dims — exported as symbolic dimensions."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self, sym_scope=None):
+        if any(s is None or (isinstance(s, int) and s < 0) for s in self.shape):
+            dims = ",".join(
+                (chr(ord("a") + i) if (s is None or s < 0) else str(s))
+                for i, s in enumerate(self.shape))
+            shape = jax_export.symbolic_shape(dims, scope=sym_scope)
+        else:
+            shape = tuple(self.shape)
+        return jax.ShapeDtypeStruct(shape, to_jax_dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _pure_forward(layer):
+    """layer forward as (params_dict, *arrays) -> arrays pytree."""
+    from ..core import autograd as _ag
+
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    state = {**{f"p:{k}": v for k, v in params.items()},
+             **{f"b:{k}": v for k, v in buffers.items()}}
+
+    def pure(state_arrays, *arrays):
+        saved = {k: t._data for k, t in state.items()}
+        try:
+            for k, t in state.items():
+                t._data = state_arrays[k]
+            with _ag.no_grad():
+                out = layer(*[Tensor(a) for a in arrays])
+        finally:
+            for k, t in state.items():
+                t._data = saved[k]
+        return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                            out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    return pure, state
+
+
+def save(layer, path, input_spec=None, **config):
+    """Export ``layer`` at ``path`` (reference: jit.save api.py).
+
+    input_spec: list of InputSpec/Tensor examples. Required unless the
+    layer was called through to_static and has a cached signature.
+    """
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        if input_spec is None:
+            raise ValueError("jit.save requires input_spec")
+        # one shared symbolic scope: jax.export rejects mixing symbolic
+        # dimensions created in different scopes, so every dynamic-dim
+        # InputSpec must resolve its symbols against the same scope
+        sym_scope = jax_export.SymbolicScope()
+        specs = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                specs.append(s.to_sds(sym_scope))
+            elif isinstance(s, Tensor):
+                specs.append(jax.ShapeDtypeStruct(tuple(s.shape),
+                                                  s._data.dtype))
+            else:
+                a = jnp.asarray(s)
+                specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+
+        pure, state = _pure_forward(layer)
+        state_arrays = {k: t._data for k, t in state.items()}
+        state_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for k, v in state_arrays.items()}
+        exp = jax_export.export(jax.jit(pure))(state_specs, *specs)
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exp.serialize())
+        from ..framework import save as fsave
+        fsave({k: Tensor(v) for k, v in state_arrays.items()},
+              path + ".pdiparams")
+        with open(path + ".meta.json", "w") as f:
+            json.dump({
+                "format": "paddle_tpu.stablehlo.v1",
+                "inputs": [{"shape": [None if not isinstance(x, int) else x
+                                      for x in s.shape],
+                            "dtype": str(s.dtype)} for s in specs],
+                "n_inputs": len(specs),
+            }, f)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+    return path
+
+
+class TranslatedLayer:
+    """Loaded artifact (reference: jit/translated_layer.py TranslatedLayer)."""
+
+    def __init__(self, exported, state_arrays, meta):
+        self._exported = exported
+        self._state = state_arrays
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+        out = self._exported.call(self._state, *arrays)
+        return jax.tree.map(lambda a: Tensor(a), out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference artifact cannot be trained; "
+                           "load the raw params with paddle_tpu.load instead")
+
+    def parameters(self):
+        return [Tensor(v) for k, v in self._state.items()
+                if k.startswith("p:")]
+
+    def state_dict(self):
+        return {k.split(":", 1)[1]: Tensor(v) for k, v in self._state.items()}
+
+    @property
+    def input_metas(self):
+        return self._meta.get("inputs", [])
+
+
+def load(path):
+    """Load a jit.save artifact (reference: jit.load api.py)."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    from ..framework import load as fload
+    state = fload(path + ".pdiparams")
+    state_arrays = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in state.items()}
+    meta = {}
+    if os.path.exists(path + ".meta.json"):
+        with open(path + ".meta.json") as f:
+            meta = json.load(f)
+    return TranslatedLayer(exported, state_arrays, meta)
+
+
+__all__ = ["save", "load", "InputSpec", "TranslatedLayer"]

@@ -13,3 +13,4 @@ from .llama import (  # noqa: F401
     llama2_7b_config, llama2_13b_config, llama_tiny_config,
 )
 from .unet import UNetModel, sd_unet, sd_unet_tiny  # noqa: F401
+from .generation import Generator, generate  # noqa: F401

@@ -1,0 +1,25 @@
+"""paddle_tpu.static — static-graph compatibility surface.
+
+The reference's Program/Executor world (python/paddle/static/,
+base/executor.py:812) collapses on this stack: "static graph" IS the jit
+path (trace once, compile once, run many). This module keeps the names
+users reach for — InputSpec, save/load_inference_model — mapped onto the
+jit artifact format.
+"""
+from ..jit.save_load import InputSpec  # noqa: F401
+from ..jit import save_load as _sl
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    raise NotImplementedError(
+        "program-based save is not part of the TPU stack; use "
+        "paddle_tpu.jit.save(layer, path, input_spec=[...]) — same artifact")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    layer = _sl.load(path_prefix)
+    return layer
+
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]

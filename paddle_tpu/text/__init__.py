@@ -1,0 +1,146 @@
+"""paddle_tpu.text — text data utilities (analog of python/paddle/text/).
+
+The reference module is dataset downloads (Imdb, Conll05, WMT14 …) — not
+reachable in this zero-egress environment. Provided instead: the same
+Dataset API over local files, a whitespace/char Vocab builder, and a
+ViterbiDecoder (the one compute op the reference keeps in paddle.text).
+"""
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+from ..io import Dataset
+
+
+class Vocab:
+    """Token <-> id mapping with min_freq/specials (tokenizer building
+    block; the reference keeps vocab logic inside each dataset)."""
+
+    def __init__(self, counter=None, min_freq=1,
+                 specials=("<pad>", "<unk>")):
+        self.itos = list(specials)
+        if counter:
+            for tok, c in sorted(counter.items(), key=lambda kv: (-kv[1], kv[0])):
+                if c >= min_freq and tok not in self.itos:
+                    self.itos.append(tok)
+        self.stoi = {t: i for i, t in enumerate(self.itos)}
+        self.unk_index = self.stoi.get("<unk>", 0)
+
+    @classmethod
+    def build_from_texts(cls, texts, tokenizer=str.split, **kw):
+        counter = collections.Counter()
+        for t in texts:
+            counter.update(tokenizer(t))
+        return cls(counter, **kw)
+
+    def __len__(self):
+        return len(self.itos)
+
+    def __getitem__(self, tok):
+        return self.stoi.get(tok, self.unk_index)
+
+    def to_ids(self, tokens):
+        return [self[t] for t in tokens]
+
+    def to_tokens(self, ids):
+        return [self.itos[i] for i in ids]
+
+
+class TextFileDataset(Dataset):
+    """One example per line: ``label<TAB>text`` or raw text."""
+
+    def __init__(self, path, vocab=None, tokenizer=str.split, max_len=None,
+                 build_vocab=True):
+        self.samples = []
+        with open(path) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                if "\t" in line:
+                    label, text = line.split("\t", 1)
+                else:
+                    label, text = None, line
+                self.samples.append((label, text))
+        self.tokenizer = tokenizer
+        self.max_len = max_len
+        if vocab is None and build_vocab:
+            vocab = Vocab.build_from_texts([t for _, t in self.samples],
+                                           tokenizer)
+        self.vocab = vocab
+        labels = sorted({l for l, _ in self.samples if l is not None})
+        self.label_map = {l: i for i, l in enumerate(labels)}
+
+    def __getitem__(self, idx):
+        label, text = self.samples[idx]
+        ids = self.vocab.to_ids(self.tokenizer(text))
+        if self.max_len:
+            ids = ids[:self.max_len] + [0] * max(0, self.max_len - len(ids))
+        ids = np.asarray(ids, np.int64)
+        if label is None:
+            return (ids,)
+        return ids, np.int64(self.label_map[label])
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ViterbiDecoder:
+    """CRF Viterbi decode (reference: python/paddle/text/viterbi_decode.py,
+    CUDA kernel viterbi_decode_kernel.cu). lax.scan over time steps —
+    static shapes, runs on the MXU-adjacent VPU."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True):
+        self.transitions = (transitions._data if isinstance(transitions, Tensor)
+                            else jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        trans = self.transitions
+
+        def fn(emissions, lens):
+            b, t, n = emissions.shape
+            lens = lens.astype(jnp.int32)
+            eye = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+
+            def step(carry, xs):
+                score = carry                       # [b, n]
+                emit_t, tidx = xs
+                # score[b, i] + trans[i, j] + emit[b, j]
+                cand = score[:, :, None] + trans[None] + emit_t[:, None, :]
+                best = cand.max(1)
+                idx = cand.argmax(1)
+                # freeze sequences already past their length: carry the
+                # score unchanged and point each tag at itself so the
+                # backtrack repeats the final tag through the padding
+                active = (tidx < lens)[:, None]     # step tidx consumes
+                best = jnp.where(active, best, score)
+                idx = jnp.where(active, idx, eye)
+                return best, idx
+
+            init = emissions[:, 0]
+            steps = jnp.arange(1, t)
+            scores, backptrs = jax.lax.scan(
+                step, init, (jnp.swapaxes(emissions[:, 1:], 0, 1), steps))
+            # backtrack (host-side shapes are static: t-1 steps)
+            last_best = scores.argmax(-1)           # [b]
+            path = [last_best]
+            for k in range(backptrs.shape[0] - 1, -1, -1):
+                last_best = jnp.take_along_axis(
+                    backptrs[k], path[-1][:, None], 1)[:, 0]
+                path.append(last_best)
+            path = jnp.stack(path[::-1], 1)         # [b, t]
+            return scores.max(-1), path
+
+        return eager_apply("viterbi_decode", fn,
+                           (potentials, lengths), {})
+
+
+__all__ = ["Vocab", "TextFileDataset", "ViterbiDecoder"]

@@ -1,0 +1,42 @@
+"""ASP 2:4 sparsity (reference: test/asp/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_mask_2of4():
+    w = np.random.randn(8, 8).astype(np.float32)
+    mask = asp.compute_mask_2d(w)
+    assert mask.reshape(-1, 4).sum(1).max() == 2
+    assert asp.check_mask_2d(w * mask)
+    assert not asp.check_mask_2d(np.ones((4, 4)))
+
+
+def test_prune_and_decorate_keeps_sparsity():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 16), nn.Tanh(), nn.Linear(16, 4))
+    asp.prune_model(model)
+    w = model[0].weight.numpy()
+    assert asp.check_mask_2d(w)
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    # masks survive the update
+    assert asp.check_mask_2d(model[0].weight.numpy())
+    asp.reset_excluded_layers()
+
+
+def test_mask_non_divisible_rows():
+    w = np.random.randn(5, 10).astype(np.float32)  # 10 % 4 != 0
+    mask = asp.compute_mask_2d(w)
+    assert mask.shape == w.shape
+    assert asp.check_mask_2d(w * mask)
+    # groups never span rows: each full group of 4 has exactly 2 kept
+    full_groups = mask[:, :8].reshape(5, 2, 4)
+    assert (full_groups.sum(2) == 2).all()

@@ -1,0 +1,87 @@
+"""audio features, text utilities, device API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
+                              MFCC, stft, compute_fbank_matrix)
+from paddle_tpu.text import Vocab, ViterbiDecoder
+
+
+def test_stft_parseval_and_shapes():
+    t = np.linspace(0, 1, 16000, dtype=np.float32)
+    sig = np.sin(2 * np.pi * 440 * t)
+    x = paddle.to_tensor(sig[None])
+    spec = stft(x, n_fft=512, hop_length=128)
+    assert spec.shape[1] == 257  # n_fft//2+1 bins
+    mag = Spectrogram(n_fft=512, hop_length=128)(x)
+    # 440 Hz -> bin ~14: dominant bin
+    m = mag.numpy()[0]
+    assert abs(int(m.mean(-1).argmax()) - round(440 * 512 / 16000)) <= 1
+
+
+def test_mel_pipeline():
+    x = paddle.to_tensor(np.random.randn(2, 8000).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert np.isfinite(logmel.numpy()).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert mfcc.shape[1] == 13
+    fb = compute_fbank_matrix(16000, 512, 40)
+    assert fb.shape == (40, 257) and fb.sum(1).min() > 0
+
+
+def test_vocab_and_dataset(tmp_path):
+    p = tmp_path / "data.tsv"
+    p.write_text("pos\tgood movie great\nneg\tbad terrible movie\n")
+    from paddle_tpu.text import TextFileDataset
+    ds = TextFileDataset(str(p), max_len=4)
+    ids, label = ds[0]
+    assert ids.shape == (4,) and label in (0, 1)
+    v = ds.vocab
+    assert v["movie"] != v.unk_index
+    assert v.to_tokens(v.to_ids(["movie"])) == ["movie"]
+    assert v["zzz_unknown"] == v.unk_index
+
+
+def test_viterbi_decode_simple():
+    # 2 tags; transitions force alternation
+    trans = np.array([[-10.0, 0.0], [0.0, -10.0]], np.float32)
+    emissions = np.zeros((1, 4, 2), np.float32)
+    emissions[0, 0, 0] = 5.0  # start in tag 0
+    dec = ViterbiDecoder(paddle.to_tensor(trans))
+    scores, path = dec(paddle.to_tensor(emissions),
+                       paddle.to_tensor(np.array([4])))
+    assert list(path.numpy()[0]) == [0, 1, 0, 1]
+
+
+def test_device_streams_events():
+    import paddle_tpu.device as device
+    assert device.device_count() >= 1
+    e1, e2 = device.Event(), device.Event()
+    e1.record()
+    x = paddle.to_tensor(np.random.randn(64, 64).astype(np.float32))
+    y = paddle.matmul(x, x)
+    e2.record()
+    dt = e1.elapsed_time(e2)
+    assert dt >= 0
+    s = device.current_stream()
+    s.synchronize()
+    with device.stream_guard(device.Stream()):
+        _ = paddle.matmul(x, x)
+    assert device.cuda.memory_allocated() >= 0
+
+
+def test_viterbi_respects_lengths():
+    trans = np.array([[-10.0, 0.0], [0.0, -10.0]], np.float32)
+    em = np.zeros((2, 6, 2), np.float32)
+    em[:, 0, 0] = 5.0
+    # sequence 1 has huge emissions in the padding region that would flip
+    # the path if (wrongly) decoded
+    em[1, 3:, 1] = 100.0
+    dec = ViterbiDecoder(paddle.to_tensor(trans))
+    _, full = dec(paddle.to_tensor(em), paddle.to_tensor(np.array([6, 3])))
+    assert list(full.numpy()[1][:3]) == [0, 1, 0]  # within true length
+    # frozen tail repeats the final tag instead of chasing padding
+    assert all(t == full.numpy()[1][2] for t in full.numpy()[1][3:])

@@ -1,0 +1,76 @@
+"""KV-cache generation engine: prefill parity with the training forward,
+greedy decode = sliding-window full forward, sampling controls.
+
+Mirrors the reference's decode-kernel tests (masked_multihead_attention
+unit tests compare against a full-attention recompute).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config, Generator
+
+
+def _model():
+    paddle.seed(11)
+    cfg = llama_tiny_config(num_key_value_heads=2)  # exercise GQA
+    return LlamaForCausalLM(cfg), cfg
+
+
+def test_prefill_matches_training_forward():
+    model, cfg = _model()
+    ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 12))
+    gen = Generator(model, max_len=64)
+    logits, _ = gen._prefill(gen.params, ids_np)
+    full = model(paddle.to_tensor(ids_np, dtype="int64")).numpy()
+    np.testing.assert_allclose(np.asarray(logits), full[:, -1], rtol=2e-2,
+                               atol=2e-3)
+
+
+def test_greedy_decode_matches_full_forward():
+    model, cfg = _model()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (1, 6))
+    gen = Generator(model, max_len=64)
+    out = gen.generate(paddle.to_tensor(ids, dtype="int64"),
+                       max_new_tokens=5, temperature=0.0).numpy()
+    assert out.shape == (1, 11)
+
+    # reference: recompute argmax with the full training forward each step
+    cur = ids.copy()
+    for _ in range(5):
+        logits = model(paddle.to_tensor(cur, dtype="int64")).numpy()
+        nxt = logits[:, -1].argmax(-1)
+        cur = np.concatenate([cur, nxt[:, None]], 1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_sampling_controls():
+    model, cfg = _model()
+    ids = paddle.to_tensor(np.array([[1, 2, 3]]), dtype="int64")
+    gen = Generator(model, max_len=32)
+    a = gen.generate(ids, max_new_tokens=4, temperature=1.0, top_k=5,
+                     seed=0).numpy()
+    b = gen.generate(ids, max_new_tokens=4, temperature=1.0, top_k=5,
+                     seed=1).numpy()
+    assert a.shape == b.shape == (1, 7)
+    # top_p path executes
+    c = gen.generate(ids, max_new_tokens=3, temperature=0.8, top_p=0.9).numpy()
+    assert c.shape == (1, 6)
+    with pytest.raises(ValueError):
+        gen.generate(ids, max_new_tokens=100)  # exceeds max_len
+
+
+def test_eos_padding():
+    model, cfg = _model()
+    gen = Generator(model, max_len=32)
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]), dtype="int64")
+    # pick the model's own greedy first tokens as "eos" for row 0 so it
+    # finishes immediately; row 1 keeps generating
+    first = gen.generate(ids, max_new_tokens=1, temperature=0.0).numpy()
+    eos = int(first[0, -1])
+    out = gen.generate(ids, max_new_tokens=6, temperature=0.0,
+                       eos_token_id=eos).numpy()
+    row0_gen = out[0, 2:]
+    after_eos = row0_gen[np.argmax(row0_gen == eos) + 1:]
+    assert (after_eos == eos).all()  # finished row padded with eos

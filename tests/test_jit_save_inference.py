@@ -1,0 +1,95 @@
+"""jit.save/load artifacts + inference Predictor.
+
+Mirrors the reference's inference tests (test/legacy_test/test_inference_*
+save a model and reload through the predictor, comparing outputs).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import save as jit_save, load as jit_load, InputSpec
+from paddle_tpu.inference import Config, create_predictor
+
+
+def _net():
+    paddle.seed(3)
+    return nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = _net()
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    jit_save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    loaded = jit_load(path)
+    out = loaded(x).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert len(loaded.parameters()) == 4
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_jit_save_dynamic_batch(tmp_path):
+    net = _net()
+    path = str(tmp_path / "dyn")
+    jit_save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = jit_load(path)
+    for b in (1, 3, 7):
+        x = paddle.to_tensor(np.random.randn(b, 8).astype(np.float32))
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_api(tmp_path):
+    net = _net()
+    x_np = np.random.randn(4, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x_np)).numpy()
+    path = str(tmp_path / "pred")
+    jit_save(net, path, input_spec=[InputSpec([4, 8], "float32")])
+
+    cfg = Config(path)
+    cfg.enable_use_gpu(100, 0)  # reference-API call, maps to TPU
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    pred.get_input_handle(names[0]).copy_from_cpu(x_np)
+    out = pred.run()
+    np.testing.assert_allclose(out[0], ref, rtol=1e-5, atol=1e-6)
+    # handle-style fetch
+    h = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(h.copy_to_cpu(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_static_compat_load(tmp_path):
+    net = _net()
+    path = str(tmp_path / "static")
+    jit_save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    layer = paddle.static.load_inference_model(path)
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    assert layer(x).shape == [2, 4]
+    with pytest.raises(NotImplementedError):
+        paddle.static.save_inference_model(path, None, None)
+
+
+def test_jit_save_two_dynamic_inputs(tmp_path):
+    import paddle_tpu.nn as nn
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + self.fc(b)
+
+    net = TwoIn()
+    path = str(tmp_path / "two")
+    jit_save(net, path, input_spec=[InputSpec([None, 8], "float32"),
+                                    InputSpec([None, 8], "float32")])
+    loaded = jit_load(path)
+    a = paddle.to_tensor(np.random.randn(3, 8).astype(np.float32))
+    b = paddle.to_tensor(np.random.randn(3, 8).astype(np.float32))
+    np.testing.assert_allclose(loaded(a, b).numpy(), net(a, b).numpy(),
+                               rtol=1e-5, atol=1e-6)
