@@ -28,6 +28,7 @@ from .expert_parallel import moe_alltoall  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from .elastic import ElasticManager, HealthMonitor  # noqa: F401
 from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention, ring_attention_p, ulysses_attention, ulysses_attention_p,
 )

@@ -1,0 +1,123 @@
+"""Minimal RPC (analog of python/paddle/distributed/rpc/ + C++
+paddle/fluid/distributed/rpc/ — a TensorPipe-style point-to-point call
+layer used for control-plane work, not tensor traffic).
+
+TPU-native shape: tensor traffic always rides XLA collectives over ICI;
+RPC is host-side control (evaluation requests, metrics collection,
+orchestration). Implemented over the launcher's HTTP KV store as a
+mailbox: ``rpc_sync/rpc_async`` post a pickled call to the callee's inbox;
+a worker service thread polls, executes, posts the result.
+"""
+from __future__ import annotations
+
+import base64
+import pickle
+import threading
+import time
+import uuid
+
+from .launch.master import KVClient
+
+_state = {"client": None, "name": None, "thread": None, "stop": None,
+          "workers": {}}
+
+
+def _enc(obj) -> str:
+    return base64.b64encode(pickle.dumps(obj)).decode()
+
+
+def _dec(s: str):
+    return pickle.loads(base64.b64decode(s))
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Join the RPC world (reference: rpc/__init__.py init_rpc)."""
+    if master_endpoint is None:
+        raise ValueError("init_rpc requires master_endpoint host:port")
+    client = KVClient(master_endpoint)
+    stop = threading.Event()
+    _state.update(client=client, name=name, stop=stop)
+    client.put(f"/rpc/workers/{name}", _enc({"rank": rank}))
+
+    def serve():
+        while not stop.wait(0.05):
+            try:
+                inbox = client.get_prefix(f"/rpc/inbox/{name}/")
+            except Exception:
+                continue
+            for key, payload in inbox.items():
+                client.delete(key)
+                try:
+                    req = _dec(payload)
+                    fn = req["fn"]
+                    result = ("ok", fn(*req["args"], **req["kwargs"]))
+                except Exception as e:  # deliver the exception to the caller
+                    result = ("err", e)
+                client.put(f"/rpc/result/{req['id']}", _enc(result))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    _state["thread"] = t
+
+
+class FutureWrapper:
+    def __init__(self, call_id, client, timeout):
+        self.call_id = call_id
+        self.client = client
+        self.timeout = timeout
+
+    def wait(self):
+        t0 = time.time()
+        while time.time() - t0 < self.timeout:
+            raw = self.client.get(f"/rpc/result/{self.call_id}")
+            if raw is not None:
+                self.client.delete(f"/rpc/result/{self.call_id}")
+                status, value = _dec(raw)
+                if status == "err":
+                    raise value
+                return value
+            time.sleep(0.02)
+        raise TimeoutError(f"rpc call {self.call_id} timed out")
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=60.0):
+    client = _state["client"]
+    if client is None:
+        raise RuntimeError("call init_rpc first")
+    call_id = uuid.uuid4().hex
+    client.put(f"/rpc/inbox/{to}/{call_id}",
+               _enc({"id": call_id, "fn": fn, "args": tuple(args),
+                     "kwargs": dict(kwargs or {})}))
+    return FutureWrapper(call_id, client, timeout)
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=60.0):
+    return rpc_async(to, fn, args, kwargs, timeout).wait()
+
+
+def get_all_worker_infos():
+    client = _state["client"]
+    if client is None:
+        return []
+    try:
+        infos = client.get_prefix("/rpc/workers/")
+    except Exception:
+        return []
+    return sorted(k.rsplit("/", 1)[-1] for k in infos)
+
+
+def shutdown():
+    if _state["stop"] is not None:
+        _state["stop"].set()
+        if _state["thread"] is not None:
+            _state["thread"].join(timeout=5)
+    if _state["client"] is not None and _state["name"]:
+        try:
+            _state["client"].delete(f"/rpc/workers/{_state['name']}")
+        except Exception:
+            pass
+    _state.update(client=None, name=None, thread=None, stop=None)
+
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_all_worker_infos",
+           "shutdown", "FutureWrapper"]

@@ -1,0 +1,37 @@
+"""RPC over the KV mailbox (reference: test/legacy_test rpc tests spawn
+real workers; here both endpoints live in one process over a local KV)."""
+import numpy as np
+
+from paddle_tpu.distributed.launch.master import KVServer
+from paddle_tpu.distributed.launch.controller import free_port
+from paddle_tpu.distributed import rpc
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom():
+    raise ValueError("remote failure")
+
+
+def test_rpc_roundtrip_and_errors():
+    port = free_port()
+    srv = KVServer(port).start()
+    try:
+        rpc.init_rpc("worker0", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{port}")
+        assert "worker0" in rpc.get_all_worker_infos()
+        # self-call through the mailbox
+        out = rpc.rpc_sync("worker0", _add, args=(2, 3))
+        assert out == 5
+        fut = rpc.rpc_async("worker0", _add, args=(np.arange(3), 10))
+        np.testing.assert_array_equal(fut.wait(), [10, 11, 12])
+        try:
+            rpc.rpc_sync("worker0", _boom)
+            assert False, "expected remote exception"
+        except ValueError as e:
+            assert "remote failure" in str(e)
+    finally:
+        rpc.shutdown()
+        srv.stop()
