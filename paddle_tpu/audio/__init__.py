@@ -47,14 +47,19 @@ def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None):
     return fb
 
 
-def get_window(window, win_length):
+def get_window(window, win_length, fftbins=True):
+    """Periodic (fftbins=True, the STFT default matching the reference /
+    librosa) or symmetric window."""
+    n = win_length + 1 if fftbins else win_length
     if window in ("hann", "hanning"):
-        return np.hanning(win_length).astype(np.float32)
-    if window in ("hamming",):
-        return np.hamming(win_length).astype(np.float32)
-    if window in ("blackman",):
-        return np.blackman(win_length).astype(np.float32)
-    return np.ones(win_length, np.float32)
+        w = np.hanning(n)
+    elif window in ("hamming",):
+        w = np.hamming(n)
+    elif window in ("blackman",):
+        w = np.blackman(n)
+    else:
+        return np.ones(win_length, np.float32)
+    return w[:win_length].astype(np.float32)
 
 
 def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",

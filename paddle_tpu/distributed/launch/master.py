@@ -12,6 +12,7 @@ TCPStore for the XLA runtime).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -20,10 +21,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 class KVServer:
     """In-memory KV over HTTP: PUT /k -> set, GET /k -> value,
-    GET /prefix/ -> all pairs under prefix, DELETE /k."""
+    GET /prefix/ -> all pairs under prefix, DELETE /k.
 
-    def __init__(self, port):
+    Trust model: the rendezvous port accepts writes that eventually drive
+    code execution on workers (distributed/rpc.py), so it must only be
+    reachable from job hosts. ``bind_host`` (or $PADDLE_TPU_RDZV_BIND_HOST)
+    restricts the listening interface, and a shared secret
+    ($PADDLE_TPU_RDZV_TOKEN, checked on every request when set) fences off
+    other tenants on the same network."""
+
+    def __init__(self, port, bind_host=None, token=None):
         self.port = port
+        bind_host = bind_host if bind_host is not None else \
+            os.environ.get("PADDLE_TPU_RDZV_BIND_HOST", "")
+        token = token if token is not None else \
+            os.environ.get("PADDLE_TPU_RDZV_TOKEN", "")
         store: dict[str, bytes] = {}
         lock = threading.Lock()
 
@@ -31,7 +43,18 @@ class KVServer:
             def log_message(self, *a):
                 pass
 
+            def _authed(self):
+                if not token:
+                    return True
+                if self.headers.get("X-Rdzv-Token", "") == token:
+                    return True
+                self.send_response(403)
+                self.end_headers()
+                return False
+
             def do_PUT(self):
+                if not self._authed():
+                    return
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 with lock:
@@ -40,6 +63,8 @@ class KVServer:
                 self.end_headers()
 
             def do_GET(self):
+                if not self._authed():
+                    return
                 with lock:
                     if self.path.endswith("/"):
                         sub = {k: v.decode() for k, v in store.items()
@@ -57,12 +82,14 @@ class KVServer:
                 self.wfile.write(body)
 
             def do_DELETE(self):
+                if not self._authed():
+                    return
                 with lock:
                     store.pop(self.path, None)
                 self.send_response(200)
                 self.end_headers()
 
-        self._srv = ThreadingHTTPServer(("", port), Handler)
+        self._srv = ThreadingHTTPServer((bind_host, port), Handler)
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
@@ -75,28 +102,31 @@ class KVServer:
 
 
 class KVClient:
-    def __init__(self, endpoint):
+    def __init__(self, endpoint, token=None):
         self.base = f"http://{endpoint}"
+        token = token if token is not None else \
+            os.environ.get("PADDLE_TPU_RDZV_TOKEN", "")
+        self._headers = {"X-Rdzv-Token": token} if token else {}
+
+    def _open(self, key, data=None, method=None):
+        req = urllib.request.Request(self.base + key, data=data,
+                                     method=method, headers=self._headers)
+        return urllib.request.urlopen(req, timeout=10).read()
 
     def put(self, key, value: str):
-        req = urllib.request.Request(self.base + key, data=value.encode(),
-                                     method="PUT")
-        urllib.request.urlopen(req, timeout=10).read()
+        self._open(key, data=value.encode(), method="PUT")
 
     def get(self, key):
         try:
-            return urllib.request.urlopen(self.base + key, timeout=10) \
-                .read().decode()
+            return self._open(key).decode()
         except Exception:
             return None
 
     def get_prefix(self, prefix) -> dict:
-        body = urllib.request.urlopen(self.base + prefix, timeout=10).read()
-        return json.loads(body)
+        return json.loads(self._open(prefix))
 
     def delete(self, key):
-        req = urllib.request.Request(self.base + key, method="DELETE")
-        urllib.request.urlopen(req, timeout=10).read()
+        self._open(key, method="DELETE")
 
 
 class Master:

@@ -7,6 +7,12 @@ RPC is host-side control (evaluation requests, metrics collection,
 orchestration). Implemented over the launcher's HTTP KV store as a
 mailbox: ``rpc_sync/rpc_async`` post a pickled call to the callee's inbox;
 a worker service thread polls, executes, posts the result.
+
+Trust model: calls are pickled callables — anyone who can write to the
+rendezvous KV store gets code execution on every worker. The store must
+only be reachable from job hosts; set $PADDLE_TPU_RDZV_TOKEN (and
+optionally $PADDLE_TPU_RDZV_BIND_HOST) so the KV server rejects requests
+from outside the job (see launch/master.py KVServer).
 """
 from __future__ import annotations
 

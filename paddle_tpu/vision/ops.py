@@ -89,10 +89,17 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """RoIAlign via bilinear sampling (reference: vision/ops.py roi_align,
     CUDA roi_align_kernel.cu). x: [N,C,H,W]; boxes: [R,4] xyxy in input
-    coords; boxes_num: rois per image."""
+    coords; boxes_num: rois per image.
+
+    sampling_ratio > 0 averages that many bilinear samples per bin axis,
+    matching the reference. sampling_ratio == -1 in the reference derives a
+    per-roi count ceil(roi_size/out_size), which is data-dependent and
+    incompatible with static XLA shapes — here it uses a fixed 2x2 grid per
+    bin (the common case for FPN-scale rois)."""
     import numpy as np
     out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
                     else (output_size, output_size))
+    ns = int(sampling_ratio) if sampling_ratio and sampling_ratio > 0 else 2
 
     def f(x, boxes):
         n, c, h, w = x.shape
@@ -109,8 +116,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         y1 = boxes[:, 3] * spatial_scale - offset
         bw = jnp.maximum(x1 - x0, 1e-4)
         bh = jnp.maximum(y1 - y0, 1e-4)
-        ys = y0[:, None] + (jnp.arange(out_h) + 0.5) / out_h * bh[:, None]
-        xs = x0[:, None] + (jnp.arange(out_w) + 0.5) / out_w * bw[:, None]
+        # ns sub-samples per bin axis: position (bin + (k+0.5)/ns)/out * size
+        sub_h = (jnp.arange(out_h * ns) + 0.5) / (out_h * ns)   # [out_h*ns]
+        sub_w = (jnp.arange(out_w * ns) + 0.5) / (out_w * ns)
+        ys = y0[:, None] + sub_h[None, :] * bh[:, None]          # [R,out_h*ns]
+        xs = x0[:, None] + sub_w[None, :] * bw[:, None]
 
         def sample_one(img_i, yy, xx):
             img = x[img_i]                               # [C,H,W]
@@ -127,7 +137,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                    + g(yy1, xx1) * (wy[:, None] * wx[None, :])[None])
             return val
 
-        return jax.vmap(sample_one)(img_idx, ys, xs)     # [R,C,out_h,out_w]
+        fine = jax.vmap(sample_one)(img_idx, ys, xs)  # [R,C,out_h*ns,out_w*ns]
+        r_, c_ = fine.shape[:2]
+        return fine.reshape(r_, c_, out_h, ns, out_w, ns).mean(axis=(3, 5))
 
     return _apply("roi_align", f, param(x), param(boxes))
 

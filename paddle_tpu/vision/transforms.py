@@ -56,8 +56,11 @@ class ToTensor(BaseTransform):
         arr = _to_numpy(img)
         if arr.ndim == 2:
             arr = arr[:, :, None]
+        # scale iff the input was an integer image (PIL or uint8 ndarray);
+        # float inputs are assumed already in [0, 1]
+        is_int = np.issubdtype(arr.dtype, np.integer)
         arr = arr.astype(np.float32)
-        if arr.max() > 1.5:
+        if is_int:
             arr = arr / 255.0
         if self.data_format == "CHW":
             arr = arr.transpose(2, 0, 1)
@@ -80,12 +83,25 @@ class Normalize(BaseTransform):
 
 
 class Resize(BaseTransform):
+    """Resize; a single int resizes the shorter edge preserving aspect ratio
+    (reference python/paddle/vision/transforms semantics), a pair is (h, w)."""
+
     def __init__(self, size, interpolation="bilinear"):
-        self.size = _size_pair(size)
+        self.size = int(size) if isinstance(size, numbers.Number) else \
+            (int(size[0]), int(size[1]))
         self.interpolation = interpolation
 
+    def _target_hw(self, arr_h, arr_w):
+        if isinstance(self.size, int):
+            s = self.size
+            if arr_h <= arr_w:
+                return s, max(1, int(round(arr_w * s / arr_h)))
+            return max(1, int(round(arr_h * s / arr_w))), s
+        return self.size
+
     def _apply_image(self, img):
-        h, w = self.size
+        src = _to_numpy(img)
+        h, w = self._target_hw(src.shape[0], src.shape[1])
         if _HAS_PIL:
             if not isinstance(img, Image.Image):
                 img = Image.fromarray(np.asarray(img).astype(np.uint8))
@@ -154,7 +170,7 @@ class RandomResizedCrop(BaseTransform):
         self.size = _size_pair(size)
         self.scale = scale
         self.ratio = ratio
-        self.resize = Resize(size, interpolation)
+        self.resize = Resize(self.size, interpolation)
 
     def _apply_image(self, img):
         arr = _to_numpy(img)
