@@ -32,3 +32,7 @@ from . import rpc  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention, ring_attention_p, ulysses_attention, ulysses_attention_p,
 )
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pipeline_schedule import (  # noqa: F401
+    build_schedule, pipeline_train_step,
+)

@@ -10,17 +10,25 @@ execution regimes, matching how TPU programs are actually written:
    collectives lower to XLA collective HLOs over ICI — ``lax.psum``,
    ``all_gather``, ``ppermute``, ``all_to_all``. This is the analog of the
    reference's device-side NCCL kernels.
-2. **Eager, whole-array** (single controller): tensors are already global
-   values; an all_reduce over replicated data is the identity, a broadcast
-   re-places the source value, etc. This matches the reference's semantics
-   where each rank holds its local value — here the "ranks" are mesh devices
-   and the global value is what the user observes.
+2. **Eager, multi-process** (after ``init_parallel_env`` under the launch
+   CLI): each process holds its own local value; collectives really
+   communicate across processes — reductions/gathers ride a jitted global
+   all-gather over the process-spanning device mesh
+   (jax.experimental.multihost_utils), and p2p send/recv uses the
+   coordination-service key-value store (the TCPStore analog) as a
+   mailbox. This is the regime the reference's ProcessGroup tests exercise
+   (test/legacy_test/test_collective_api_base.py:192).
+3. **Eager, single process**: world size 1 — the identity semantics of
+   every collective are then exact, not a stub.
 
-Groups are mesh-axis subsets (see fleet/topology.py), not communicator
-handles: a ``Group`` names the mesh axis it spans, the launcher's
-coordination service (jax.distributed) plays TCPStore.
+Groups are mesh-axis subsets (see fleet/topology.py) or explicit rank
+lists; a ``Group``'s ``axis_name`` binds collectives inside shard_map
+regions, its ``ranks`` select the subgroup in the multi-process regime.
 """
 from __future__ import annotations
+
+import base64
+import pickle
 
 import numpy as np
 import jax
@@ -93,6 +101,50 @@ def _apply(x, fn):
     return fn(x)
 
 
+def _mp_active() -> bool:
+    """True in the eager multi-process regime (launch CLI + jax.distributed)."""
+    try:
+        return jax.process_count() > 1
+    except RuntimeError:
+        return False
+
+
+def _group_ranks(group):
+    if group is not None and group.ranks:
+        return list(group.ranks)
+    return list(range(get_world_size()))
+
+
+def _group_index(group, rank, what="rank"):
+    ranks = _group_ranks(group)
+    if rank not in ranks:
+        raise ValueError(f"{what} {rank} is not a member of group "
+                         f"ranks={ranks}")
+    return ranks.index(rank)
+
+
+def _gather_rows(a, group):
+    """Host all-gather: rows [r, ...] of every rank's local value, restricted
+    to the group's ranks (rows gathered globally, then selected)."""
+    from jax.experimental import multihost_utils
+    rows = multihost_utils.process_allgather(np.asarray(a))
+    return np.stack([rows[r] for r in _group_ranks(group)])
+
+
+def _np_reduce(rows, op):
+    if op == ReduceOp.SUM:
+        return rows.sum(axis=0)
+    if op == ReduceOp.MAX:
+        return rows.max(axis=0)
+    if op == ReduceOp.MIN:
+        return rows.min(axis=0)
+    if op == ReduceOp.AVG:
+        return rows.mean(axis=0)
+    if op == ReduceOp.PROD:
+        return rows.prod(axis=0)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (reference: process_group.h AllReduce;
     python/paddle/distributed/communication/all_reduce.py)."""
@@ -110,8 +162,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                 return lax.pmean(a, axis)
             if op == ReduceOp.PROD:
                 return jnp.exp(lax.psum(jnp.log(a), axis))
-        # eager whole-array: the value is already the global reduction
-        return a
+        if _mp_active():
+            out = _np_reduce(_gather_rows(a, group), op)
+            return jnp.asarray(out.astype(np.asarray(a).dtype, copy=False))
+        return a  # world size 1: reduction of one value
 
     return _apply(tensor, fn)
 
@@ -125,15 +179,36 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         parts = [Tensor(jnp.take(out, i, axis=axis)) for i in range(n)]
         tensor_list.extend(parts)
         return tensor_list
-    # eager: every "rank" holds the same global value
-    n = group.nranks if group is not None else get_world_size()
-    tensor_list.extend(Tensor(tensor._data) for _ in range(max(n, 1)))
+    if _mp_active():
+        rows = _gather_rows(tensor._data if isinstance(tensor, Tensor)
+                            else tensor, group)
+        tensor_list.extend(Tensor(jnp.asarray(r)) for r in rows)
+        return tensor_list
+    tensor_list.append(Tensor(tensor._data))
     return tensor_list
 
 
+def _allgather_bytes(payload: bytes, group=None) -> list[bytes]:
+    """Gather arbitrary bytes from every rank (length-prefixed, padded)."""
+    from jax.experimental import multihost_utils
+    n = len(payload)
+    lens = multihost_utils.process_allgather(np.asarray([n], np.int32))
+    cap = int(lens.max())
+    buf = np.zeros(cap, np.uint8)
+    buf[:n] = np.frombuffer(payload, np.uint8)
+    rows = multihost_utils.process_allgather(buf)
+    out = []
+    for r in _group_ranks(group):
+        out.append(bytes(rows[r][:int(lens.reshape(-1)[r])]))
+    return out
+
+
 def all_gather_object(obj_list, obj, group=None):
-    n = group.nranks if group is not None else get_world_size()
-    obj_list.extend(obj for _ in range(max(n, 1)))
+    if _mp_active():
+        for blob in _allgather_bytes(pickle.dumps(obj), group):
+            obj_list.append(pickle.loads(blob))
+        return obj_list
+    obj_list.append(obj)
     return obj_list
 
 
@@ -141,18 +216,25 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """(process_group.h ReduceScatter)."""
     ax = _get_axis(group)
+    ins = tensor_or_tensor_list
     if _in_manual_region(ax):
-        ins = tensor_or_tensor_list
         a = ins._data if isinstance(ins, Tensor) else jnp.concatenate(
             [t._data for t in ins], axis=0)
         out = lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
         tensor._data = out
         return tensor
-    ins = tensor_or_tensor_list
-    if isinstance(ins, (list, tuple)):
-        tensor._data = ins[0]._data
-    else:
-        tensor._data = ins._data
+    if _mp_active():
+        a = ins._data if isinstance(ins, Tensor) else jnp.concatenate(
+            [t._data for t in ins], axis=0)
+        rows = _gather_rows(a, group)
+        red = _np_reduce(rows, op)
+        ranks = _group_ranks(group)
+        me = _group_index(group, get_rank())
+        chunk = red.shape[0] // len(ranks)
+        tensor._data = jnp.asarray(red[me * chunk:(me + 1) * chunk])
+        return tensor
+    tensor._data = (ins[0]._data if isinstance(ins, (list, tuple))
+                    else ins._data)
     return tensor
 
 
@@ -161,24 +243,58 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     ax = _get_axis(group)
     if _in_manual_region(ax):
         stacked = jnp.stack([t._data for t in in_tensor_list], axis=0)
-        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0, tiled=False)
+        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                             tiled=False)
         for i in range(out.shape[0]):
             out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    if _mp_active():
+        stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
+        rows = _gather_rows(stacked, group)       # [n, n, ...]
+        ranks = _group_ranks(group)
+        me = _group_index(group, get_rank())
+        out_tensor_list.extend(Tensor(jnp.asarray(rows[j][me]))
+                               for j in range(len(ranks)))
         return out_tensor_list
     out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
     return out_tensor_list
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    """(process_group.h Broadcast) — eager arrays are already consistent."""
-    return tensor
+    """(process_group.h Broadcast)."""
+    if _mp_active():
+        _group_index(group, src, what="src")
+        from jax.experimental import multihost_utils
+        a = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
+        val = jnp.asarray(multihost_utils.broadcast_one_to_all(
+            a, is_source=get_rank() == src))
+        if isinstance(tensor, Tensor):
+            tensor._data = val
+            return tensor
+        return Tensor(val)
+    return tensor  # single process: already consistent
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """(process_group.h Reduce) — every rank computes; only dst's value is
+    contractually meaningful, matching the reference's observable behavior."""
     return all_reduce(tensor, op, group, sync_op)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _mp_active():
+        # src's list is authoritative: broadcast it, pick own chunk
+        # only src's list travels: non-src ranks contribute a tiny None blob
+        payload = pickle.dumps([np.asarray(t._data) for t in tensor_list]
+                               if tensor_list else None)
+        blobs = _allgather_bytes(payload, group)
+        src_idx = _group_index(group, src, what="src")
+        src_list = pickle.loads(blobs[src_idx])
+        if src_list is None:
+            raise ValueError(f"scatter: src rank {src} passed no tensor_list")
+        me = _group_index(group, get_rank())
+        tensor._data = jnp.asarray(src_list[me])
+        return tensor
     if tensor_list:
         rank = get_rank()
         idx = group.get_group_rank(rank) if group is not None else rank
@@ -186,21 +302,69 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+# ---- p2p over the coordination-service KV store (TCPStore analog) ----
+
+_p2p_seq: dict[tuple, int] = {}
+
+
+def _kv_client():
+    from jax._src import distributed as _dist
+    client = getattr(_dist.global_state, "client", None)
+    if client is None:
+        raise RuntimeError(
+            "p2p send/recv needs the multi-process regime "
+            "(init_parallel_env under the launch CLI)")
+    return client
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """P2P send (process_group.h Send). Inside shard_map: ppermute edge."""
+    """P2P send (process_group.h Send). Inside shard_map: ppermute edge;
+    eager multi-process: mailbox on the coordination service."""
     ax = _get_axis(group)
     if _in_manual_region(ax):
         n = lax.axis_size(ax)
         tensor._data = lax.ppermute(tensor._data, ax,
                                     [(i, dst) for i in range(n)])
-    return tensor
+        return tensor
+    if _mp_active():
+        me = get_rank()
+        seq = _p2p_seq.get((me, dst), 0)
+        _p2p_seq[(me, dst)] = seq + 1
+        arr = np.asarray(tensor._data if isinstance(tensor, Tensor)
+                         else tensor)
+        blob = base64.b64encode(pickle.dumps(arr)).decode()
+        _kv_client().key_value_set(f"ptpu_p2p/{me}->{dst}/{seq}", blob)
+        return tensor
+    raise RuntimeError("send() has no peer in a single-process program; use "
+                       "it under the launch CLI or inside shard_map")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    return tensor
+    if _in_manual_region(_get_axis(group)):
+        return tensor  # pair of the ppermute in send()
+    if _mp_active():
+        me = get_rank()
+        seq = _p2p_seq.get((src, me), 0)
+        _p2p_seq[(src, me)] = seq + 1
+        key = f"ptpu_p2p/{src}->{me}/{seq}"
+        client = _kv_client()
+        blob = client.blocking_key_value_get(key, 120_000)
+        try:  # consumed: keep the coordination service's store bounded
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        arr = pickle.loads(base64.b64decode(blob))
+        tensor._data = jnp.asarray(arr)
+        return tensor
+    raise RuntimeError("recv() has no peer in a single-process program; use "
+                       "it under the launch CLI or inside shard_map")
 
 
 def barrier(group=None):
+    if _mp_active():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        return
     jax.block_until_ready(jnp.zeros(()))
 
 
@@ -216,9 +380,12 @@ def stream_all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
 
 def get_rank(group=None):
     try:
-        return jax.process_index()
+        rank = jax.process_index()
     except RuntimeError:
-        return 0
+        rank = 0
+    if group is not None:
+        return group.get_group_rank(rank)
+    return rank
 
 
 def get_world_size(group=None):
@@ -242,9 +409,13 @@ def init_parallel_env():
     import os
     if _default_group is not None:
         return _default_group
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    coord = (os.environ.get("PADDLE_TPU_COORDINATOR")
+             or os.environ.get("PADDLE_MASTER")
+             or os.environ.get("MASTER_ADDR"))
+    nproc = int(os.environ.get("PADDLE_TPU_NUM_PROCESSES")
+                or os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TPU_PROCESS_ID")
+              or os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nproc > 1:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nproc, process_id=pid)

@@ -1,0 +1,210 @@
+"""paddle.fft — discrete Fourier transforms (reference: python/paddle/fft.py,
+kernels paddle/phi/kernels/cpu/fft_kernel.cc / gpu pocketfft/cuFFT paths).
+
+TPU-native shape: every transform is a pure jnp.fft lowering registered as an
+eager primitive, so it is differentiable through the tape and fuses on the
+compiled path. x64 is disabled framework-wide, so outputs are
+complex64/float32 (the reference's complex128/float64 surface maps down).
+
+The Hermitian family without a jnp equivalent (hfft2/hfftn, ihfft2/ihfftn)
+uses the norm-duality identities
+    hfftn(x, s, axes, norm)  == irfftn(conj(x), s, axes, inv(norm))
+    ihfftn(x, s, axes, norm) == conj(rfftn(x, s, axes, inv(norm)))
+with inv(backward) = forward, inv(forward) = backward, inv(ortho) = ortho —
+the same c2r/r2c formulation the reference's fftn_c2r/fftn_r2c kernels use.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dispatch import primitive
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+_INV_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
+def _check_norm(norm):
+    norm = norm or "backward"
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', "
+            f"'backward' or 'ortho'")
+    return norm
+
+
+def _check_n(n):
+    if n is not None and n < 1:
+        raise ValueError(f"Invalid FFT argument n({n}), it should be positive")
+    return n
+
+
+def _check_axes_pair(s, axes, rank_needed=2):
+    if axes is not None and len(axes) != rank_needed:
+        raise ValueError(f"Expected {rank_needed} axes, got {len(axes)}")
+    if s is not None and len(s) != rank_needed:
+        raise ValueError(f"Expected s of length {rank_needed}, got {len(s)}")
+
+
+# ---- primitive bodies -------------------------------------------------------
+
+@primitive("fft_c2c")
+def _fft_c2c(x, s, axes, norm, forward):
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x.astype(jnp.complex64) if not jnp.iscomplexobj(x) else x,
+              s=s, axes=axes, norm=norm)
+
+
+@primitive("fft_r2c")
+def _fft_r2c(x, s, axes, norm):
+    return jnp.fft.rfftn(jnp.real(x), s=s, axes=axes, norm=norm)
+
+
+@primitive("fft_c2r")
+def _fft_c2r(x, s, axes, norm):
+    return jnp.fft.irfftn(
+        x.astype(jnp.complex64) if not jnp.iscomplexobj(x) else x,
+        s=s, axes=axes, norm=norm)
+
+
+@primitive("fftshift")
+def _fftshift_p(x, axes):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@primitive("ifftshift")
+def _ifftshift_p(x, axes):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+# ---- 1-D --------------------------------------------------------------------
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    """1-D complex-to-complex DFT (reference fft.py fft)."""
+    return _fft_c2c(x, None if n is None else (_check_n(n),), (axis,),
+                    _check_norm(norm), True)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_c2c(x, None if n is None else (_check_n(n),), (axis,),
+                    _check_norm(norm), False)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    """Real-to-complex DFT; output length n//2+1 on ``axis``."""
+    return _fft_r2c(x, None if n is None else (_check_n(n),), (axis,),
+                    _check_norm(norm))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_c2r(x, None if n is None else (_check_n(n),), (axis,),
+                    _check_norm(norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    """DFT of a Hermitian-symmetric input → real output."""
+    return hfftn(x, None if n is None else (_check_n(n),), (axis,), norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return ihfftn(x, None if n is None else (_check_n(n),), (axis,), norm)
+
+
+# ---- N-D --------------------------------------------------------------------
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_c2c(x, s, axes, _check_norm(norm), True)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_c2c(x, s, axes, _check_norm(norm), False)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_r2c(x, s, axes, _check_norm(norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_c2r(x, s, axes, _check_norm(norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+    return _fft_c2r(conj_(x), s, axes, _INV_NORM[norm])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+    return conj_(_fft_r2c(x, s, axes, _INV_NORM[norm]))
+
+
+def conj_(x):
+    # local conj that stays on the tape (jnp.conj of a real array is a no-op)
+    from .core.dispatch import eager_apply
+    return eager_apply("conj", jnp.conj, (x,), {})
+
+
+# ---- 2-D --------------------------------------------------------------------
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return fftn(x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return ifftn(x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return rfftn(x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return irfftn(x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_axes_pair(s, axes)
+    return ihfftn(x, s, axes, norm)
+
+
+# ---- helpers ----------------------------------------------------------------
+
+def _freq_dtype(dtype):
+    if dtype is None:
+        return np.float32
+    from .core.dtype import to_jax_dtype
+    return np.dtype(to_jax_dtype(dtype))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """Sample frequencies for fft output (cycles per unit of spacing d)."""
+    return Tensor(jnp.asarray(np.fft.fftfreq(n, d).astype(_freq_dtype(dtype))))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.asarray(np.fft.rfftfreq(n, d).astype(_freq_dtype(dtype))))
+
+
+def fftshift(x, axes=None, name=None):
+    return _fftshift_p(x, tuple(axes) if axes is not None else None)
+
+
+def ifftshift(x, axes=None, name=None):
+    return _ifftshift_p(x, tuple(axes) if axes is not None else None)

@@ -34,6 +34,16 @@ import pytest  # noqa: E402
 # this stack is TPU-like (bf16 passes), so pin highest precision for testing.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent compilation cache: repeated suite runs skip recompiles (the
+# analog of the reference's build-cache CI tier, tools/parallel_UT_rule.py).
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("PADDLE_TPU_TEST_CACHE",
+                                     "/tmp/paddle_tpu_jax_test_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # older jax without the knobs
+    pass
+
 
 @pytest.fixture(autouse=True)
 def _seed():

@@ -25,6 +25,7 @@ def _rand_qkv(rng, b=2, s=64, h=8, d=16, dtype=jnp.float32):
     return mk(), mk(), mk()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_forward(causal):
     mesh = _mk_mesh()
@@ -36,6 +37,7 @@ def test_ring_attention_forward(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_grads(causal):
     mesh = _mk_mesh()
@@ -56,6 +58,7 @@ def test_ring_attention_grads(causal):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_gqa():
     mesh = _mk_mesh()
     rng = np.random.default_rng(2)
@@ -97,6 +100,7 @@ def test_ring_attention_inside_jit_with_sharding():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_pallas_interpret_block():
     """Ring with the Pallas per-block engine (interpret mode), 128-blocks."""
     mesh = dist.init_mesh({"sep": 2}, None) if False else None
@@ -132,6 +136,7 @@ def test_ulysses_attention(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_grads():
     mesh = _mk_mesh()
     rng = np.random.default_rng(6)
@@ -146,6 +151,7 @@ def test_ulysses_grads():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_eager_tensor_surface():
     mesh = _mk_mesh()
     dist.set_mesh(mesh)

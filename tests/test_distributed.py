@@ -152,6 +152,7 @@ def test_group_sharded_parallel_stage3():
         if hasattr(st["moment1"], "_data") else True
 
 
+@pytest.mark.slow
 def test_parallelize_plan():
     from paddle_tpu.distributed.auto_parallel import ColWiseParallel, RowWiseParallel
     from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config

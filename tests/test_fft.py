@@ -1,0 +1,147 @@
+"""paddle.fft parity vs numpy (reference test model: test/fft/test_fft.py —
+numpy is the oracle for every transform / norm / axis combination)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft
+
+RTOL, ATOL = 2e-4, 2e-4
+NORMS = ["backward", "ortho", "forward"]
+
+
+def _np(x):
+    return np.asarray(x.numpy())
+
+
+@pytest.fixture
+def xr():
+    rng = np.random.default_rng(0)
+    return rng.standard_normal((3, 8, 10)).astype(np.float32)
+
+
+@pytest.fixture
+def xc():
+    rng = np.random.default_rng(1)
+    return (rng.standard_normal((3, 8, 10))
+            + 1j * rng.standard_normal((3, 8, 10))).astype(np.complex64)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_fft_ifft_roundtrip_and_parity(xc, norm):
+    t = paddle.to_tensor(xc)
+    out = fft.fft(t, norm=norm)
+    np.testing.assert_allclose(_np(out), np.fft.fft(xc, norm=norm),
+                               rtol=RTOL, atol=ATOL)
+    back = fft.ifft(out, norm=norm)
+    np.testing.assert_allclose(_np(back), xc, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+@pytest.mark.parametrize("n,axis", [(None, -1), (6, 1), (12, 0)])
+def test_rfft_irfft(xr, norm, n, axis):
+    t = paddle.to_tensor(xr)
+    got = fft.rfft(t, n=n, axis=axis, norm=norm)
+    np.testing.assert_allclose(_np(got), np.fft.rfft(xr, n=n, axis=axis,
+                                                     norm=norm).astype(np.complex64),
+                               rtol=RTOL, atol=ATOL)
+    m = n if n is not None else xr.shape[axis]
+    back = fft.irfft(got, n=m, axis=axis, norm=norm)
+    np.testing.assert_allclose(
+        _np(back), np.fft.irfft(np.fft.rfft(xr, n=n, axis=axis, norm=norm),
+                                n=m, axis=axis, norm=norm),
+        rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_hfft_ihfft(xr, xc, norm):
+    t = paddle.to_tensor(xc)
+    np.testing.assert_allclose(_np(fft.hfft(t, norm=norm)),
+                               np.fft.hfft(xc, norm=norm),
+                               rtol=RTOL, atol=ATOL)
+    tr = paddle.to_tensor(xr)
+    np.testing.assert_allclose(_np(fft.ihfft(tr, norm=norm)),
+                               np.fft.ihfft(xr, norm=norm).astype(np.complex64),
+                               rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_fft2_family(xr, xc, norm):
+    tc, tr = paddle.to_tensor(xc), paddle.to_tensor(xr)
+    np.testing.assert_allclose(_np(fft.fft2(tc, norm=norm)),
+                               np.fft.fft2(xc, norm=norm), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(fft.ifft2(tc, norm=norm)),
+                               np.fft.ifft2(xc, norm=norm), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(fft.rfft2(tr, norm=norm)),
+                               np.fft.rfft2(xr, norm=norm).astype(np.complex64),
+                               rtol=RTOL, atol=ATOL)
+    spec = np.fft.rfft2(xr, norm=norm)
+    np.testing.assert_allclose(
+        _np(fft.irfft2(paddle.to_tensor(spec.astype(np.complex64)), norm=norm)),
+        np.fft.irfft2(spec, norm=norm), rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_fftn_family(xr, xc, norm):
+    tc, tr = paddle.to_tensor(xc), paddle.to_tensor(xr)
+    np.testing.assert_allclose(_np(fft.fftn(tc, norm=norm)),
+                               np.fft.fftn(xc, norm=norm), rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(fft.ifftn(tc, axes=(0, 2), norm=norm)),
+                               np.fft.ifftn(xc, axes=(0, 2), norm=norm),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(_np(fft.rfftn(tr, s=(4, 6), axes=(1, 2), norm=norm)),
+                               np.fft.rfftn(xr, s=(4, 6), axes=(1, 2),
+                                            norm=norm).astype(np.complex64),
+                               rtol=RTOL, atol=ATOL)
+
+
+def test_hfftn_matches_1d_composition(xc):
+    # hfft2 over the last axis pair == fft along axis -2 then hfft along -1
+    t = paddle.to_tensor(xc)
+    got = _np(fft.hfft2(t))
+    want = np.fft.hfft(np.fft.fft(xc, axis=-2), axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    # ihfft2 is its inverse-direction dual
+    xr2 = np.real(xc)
+    got2 = _np(fft.ihfft2(paddle.to_tensor(xr2)))
+    want2 = np.fft.ifft(np.fft.ihfft(xr2, axis=-1), axis=-2)
+    np.testing.assert_allclose(got2, want2.astype(np.complex64),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_shift_freq_helpers():
+    x = np.arange(10, dtype=np.float32)
+    np.testing.assert_allclose(_np(fft.fftshift(paddle.to_tensor(x))),
+                               np.fft.fftshift(x))
+    np.testing.assert_allclose(_np(fft.ifftshift(paddle.to_tensor(x))),
+                               np.fft.ifftshift(x))
+    x2 = x.reshape(2, 5)
+    np.testing.assert_allclose(_np(fft.fftshift(paddle.to_tensor(x2), axes=[1])),
+                               np.fft.fftshift(x2, axes=[1]))
+    np.testing.assert_allclose(_np(fft.fftfreq(8, d=0.5)),
+                               np.fft.fftfreq(8, d=0.5).astype(np.float32))
+    np.testing.assert_allclose(_np(fft.rfftfreq(8, d=0.5)),
+                               np.fft.rfftfreq(8, d=0.5).astype(np.float32))
+
+
+def test_norm_validation_and_n_validation():
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    with pytest.raises(ValueError):
+        fft.fft(t, norm="bogus")
+    with pytest.raises(ValueError):
+        fft.rfft(t, n=0)
+    with pytest.raises(ValueError):
+        fft.fft2(paddle.to_tensor(np.ones((4, 4), np.float32)), axes=(0, 1, 2))
+
+
+def test_fft_gradients_flow():
+    # d/dx of sum |rfft(x)|^2 == 2*N*x for real x (Parseval), a strong
+    # correctness check of the c2c/r2c vjp path on the tape
+    x = np.random.default_rng(2).standard_normal(8).astype(np.float32)
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    spec = fft.fft(t)
+    energy = paddle.sum(paddle.real(spec * paddle.conj(spec)))
+    energy.backward()
+    np.testing.assert_allclose(np.asarray(t.grad.numpy()), 2 * 8 * x,
+                               rtol=1e-3, atol=1e-3)

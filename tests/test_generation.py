@@ -27,6 +27,7 @@ def test_prefill_matches_training_forward():
                                atol=2e-3)
 
 
+@pytest.mark.slow
 def test_greedy_decode_matches_full_forward():
     model, cfg = _model()
     rng = np.random.RandomState(1)
@@ -45,6 +46,7 @@ def test_greedy_decode_matches_full_forward():
     np.testing.assert_array_equal(out, cur)
 
 
+@pytest.mark.slow
 def test_sampling_controls():
     model, cfg = _model()
     ids = paddle.to_tensor(np.array([[1, 2, 3]]), dtype="int64")

@@ -49,6 +49,7 @@ def _single_device_loss(cfg, params, ids):
 
 @pytest.mark.parametrize("axes", [{"pp": 2, "dp": 2, "mp": 2},
                                   {"pp": 4, "dp": 2, "mp": 1}])
+@pytest.mark.slow
 def test_hybrid_matches_single_device(axes):
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")
@@ -64,6 +65,7 @@ def test_hybrid_matches_single_device(axes):
     np.testing.assert_allclose(float(loss), ref_loss, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_hybrid_learns():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 devices")

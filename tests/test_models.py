@@ -11,6 +11,7 @@ from paddle_tpu.models import (
 from paddle_tpu.models.bert import bert_tiny_config
 
 
+@pytest.mark.slow
 def test_lenet_forward_backward():
     m = LeNet()
     x = paddle.to_tensor(np.random.randn(2, 1, 28, 28).astype("float32"),
@@ -22,6 +23,7 @@ def test_lenet_forward_backward():
     assert m.features[0].weight.grad is not None
 
 
+@pytest.mark.slow
 def test_lenet_converges():
     m = LeNet()
     opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
@@ -101,6 +103,7 @@ def test_llama_causal_with_padding_mask():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bert_tiny():
     m = BertForPretraining(bert_tiny_config())
     ids = paddle.to_tensor(np.random.randint(0, 512, (2, 16)), dtype="int64")
@@ -111,6 +114,7 @@ def test_bert_tiny():
     assert m.bert.pooler.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_resnet18_forward():
     m = resnet18(num_classes=10)
     m.eval()

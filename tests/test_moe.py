@@ -49,6 +49,7 @@ class _Expert(nn.Layer):
         return self.fc2(nn.functional.gelu(self.fc1(x)))
 
 
+@pytest.mark.slow
 def test_moe_layer_trains():
     paddle.seed(0)
     d = 16
@@ -73,6 +74,7 @@ def test_moe_layer_trains():
     assert layer.gate.fc.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_moe_alltoall_matches_single_device():
     from paddle_tpu.distributed.expert_parallel import moe_alltoall
     from paddle_tpu.distributed.mesh import init_mesh

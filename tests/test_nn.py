@@ -80,6 +80,7 @@ class TestFunctional:
             sum(xa[2 + i, 2 + j] * wa[1 + i, 1 + j] for i in range(-1, 2)
                 for j in range(-1, 2)), rel=1e-4)
 
+    @pytest.mark.slow
     def test_conv_grouped_stride(self):
         x = paddle.randn([2, 4, 8, 8])
         w = paddle.randn([8, 2, 3, 3])
@@ -244,6 +245,7 @@ class TestOptimizers:
 class TestLeNetConvergence:
     """Stage-0 exit test (SURVEY.md §7): LeNet-5 learns synthetic MNIST."""
 
+    @pytest.mark.slow
     def test_lenet_mnist(self):
         paddle.seed(0)
         np.random.seed(0)
@@ -289,6 +291,7 @@ class TestLeNetConvergence:
 
 
 class TestRNN:
+    @pytest.mark.slow
     def test_lstm_learns_sum(self):
         paddle.seed(3)
         np.random.seed(3)

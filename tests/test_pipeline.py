@@ -45,6 +45,7 @@ def test_pipeline_forward_parity(schedule):
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_parity():
     mesh = dist.init_mesh({"pp": 4, "dp": 2})
     rng = np.random.default_rng(1)
@@ -80,6 +81,7 @@ def test_pipeline_interleaved_parity():
                                atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_interleaved_grads():
     mesh = dist.init_mesh({"pp": 2, "dp": 4})
     rng = np.random.default_rng(3)

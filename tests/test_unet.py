@@ -1,10 +1,12 @@
 """SD-UNet (BASELINE.md config 4): forward shape, conditioning, training step."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.models import sd_unet_tiny
 
 
+@pytest.mark.slow
 def test_unet_forward_and_train():
     paddle.seed(0)
     unet = sd_unet_tiny()
@@ -31,6 +33,7 @@ def test_unet_forward_and_train():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_unet_unconditional():
     paddle.seed(0)
     unet = sd_unet_tiny(context_dim=None)

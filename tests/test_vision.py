@@ -45,6 +45,7 @@ def test_fake_data_with_loader():
     (lambda: M.shufflenet_v2_x0_25(num_classes=10), 32),
     (lambda: M.googlenet(num_classes=10), 64),
 ])
+@pytest.mark.slow
 def test_model_forward_shapes(ctor, size):
     paddle.seed(0)
     net = ctor()
