@@ -19,7 +19,9 @@ from .collective import (  # noqa: F401
     destroy_process_group,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
-from .sharding import group_sharded_parallel, shard_optimizer_states  # noqa: F401
+from .sharding import (  # noqa: F401
+    group_sharded_parallel, shard_optimizer_states, stage2_gradient_fn,
+)
 from . import fleet  # noqa: F401
 from .auto_parallel import parallelize, to_static  # noqa: F401
 from . import checkpoint  # noqa: F401

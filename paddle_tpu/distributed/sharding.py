@@ -19,6 +19,8 @@ stage is a *sharding declaration* over the 'sharding' (or 'dp') mesh axis:
 from __future__ import annotations
 
 import jax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
 
 from .mesh import ProcessMesh
 from .placement import Replicate, Shard
@@ -72,6 +74,47 @@ def shard_gradients(model, mesh, axis_name="sharding"):
     for p in model.parameters():
         if not p.stop_gradient:
             p._grad_hooks.append(make_hook(p))
+
+
+def stage2_gradient_fn(loss_fn, mesh, axis_name="sharding", batch_ndims=None):
+    """Build the explicit ZeRO-2 gradient pipeline: data-parallel loss over
+    the ``axis_name`` mesh axis with per-leaf gradients REDUCE-SCATTERED
+    (``lax.psum_scatter`` on dim 0), never all-reduced — each rank leaves the
+    step holding only its 1/degree grad shard, the stage-2 contract
+    (reference: group_sharded_stage2.py:47 reduce-scatter hooks).
+
+    loss_fn(params, *batch) -> scalar (mean over the local batch).
+    Returns grad_fn(params, *batch) -> grads pytree whose dim-0-shardable
+    leaves are sharded over ``axis_name`` (others replicated via psum).
+    Wrap in jax.jit; batch args must have dim 0 divisible by the degree.
+    """
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    n = jmesh.shape[axis_name]
+
+    def grad_fn(params, *batch):
+        def local(params, *local_batch):
+            g = jax.grad(loss_fn)(params, *local_batch)
+
+            def rs(leaf):
+                if leaf.ndim >= 1 and leaf.shape[0] % n == 0 \
+                        and leaf.shape[0] >= n:
+                    return lax.psum_scatter(leaf / n, axis_name,
+                                            scatter_dimension=0, tiled=True)
+                return lax.psum(leaf / n, axis_name)
+
+            return jax.tree.map(rs, g)
+
+        param_spec = jax.tree.map(lambda _: P(), params)
+        batch_specs = tuple(P(axis_name) for _ in batch)
+        out_spec = jax.tree.map(
+            lambda l: P(axis_name) if (l.ndim >= 1 and l.shape[0] % n == 0
+                                       and l.shape[0] >= n) else P(),
+            params)
+        return shard_map(local, mesh=jmesh,
+                         in_specs=(param_spec,) + batch_specs,
+                         out_specs=out_spec, check_vma=False)(params, *batch)
+
+    return grad_fn
 
 
 def shard_parameters(model, mesh, axis_name="sharding"):

@@ -63,11 +63,17 @@ def install():
         forced = os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1"
         use_pallas = forced or _on_tpu()
         interpret = not _on_tpu()
-        # Measured on v5e (chained-dependency timing): at s=8192 the Pallas
-        # backward is 3.4x XLA (122ms vs 417ms per step) and is the only
-        # path whose working set stays O(s); at s<=1024 the XLA composition
-        # wins on dispatch+fusion. Crossover ~2k.
-        thresh = 2048 if not forced else 256
+        # Measured on the v5e pool chip (scan-chained fwd+bwd, readback
+        # sync; b=8 h=12 d=64): XLA composition beats every Pallas kernel
+        # tried (ours, jax flash, splash) up to s=4096 — e.g. s=2048 XLA
+        # 14.4ms vs Pallas 32.7ms; engaging Pallas at s=2048 cost 2.3x
+        # end-to-end train MFU (0.39 -> 0.18). Mosaic kernels run far below
+        # roofline on this part, so the threshold defaults to 8192 — where
+        # the O(s^2) score materialization starts to dominate/ OOM and the
+        # O(s) working set is worth it regardless. Tunable per deployment
+        # via PADDLE_TPU_FLASH_THRESHOLD (re-measure on real v5p/v5e metal).
+        thresh = int(os.environ.get("PADDLE_TPU_FLASH_THRESHOLD",
+                                    "256" if forced else "8192"))
         # Pallas path: no arbitrary mask, no dropout, seq long enough to
         # beat the fused XLA composition.
         if use_pallas and attn_mask is None and dropout_p == 0.0 \

@@ -1,0 +1,157 @@
+"""Paged KV-cache decode attention (Pallas TPU).
+
+Reference capability being matched: paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu (paged KV with per-sequence block
+tables, variable sequence lengths, GQA) and masked_multihead_attention
+(single-token decode against a cache). The TPU shape of the same idea:
+
+- the KV pool is paged ``[num_kv_heads, num_pages, page_size, head_dim]``
+  (head-major so one grid step DMAs exactly one head's page);
+- ``block_tables [batch, pages_per_seq]`` maps each sequence's logical
+  pages to pool pages — scalar-prefetched so the index map can steer the
+  DMA before the kernel body runs (the TPU analog of the CUDA kernel
+  dereferencing the block table per thread block);
+- grid = (batch, kv_head, page): the page axis iterates sequentially, so
+  VMEM scratch carries the online-softmax state (m, l, acc) across pages —
+  only ``ceil(seq_len / page_size)`` pages are read per sequence, which is
+  the entire point of paged decode (HBM reads scale with the sequence's
+  true length, not the pool capacity).
+
+GQA: the query head group of each kv head ``[group, head_dim]`` rides one
+MXU matmul per page.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, page_size, scale):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = len_ref[b]
+    base = p * page_size
+
+    @pl.when(base < seq_len)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)        # [group, d]
+        k = k_ref[0, 0].astype(jnp.float32)        # [ps, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [group, ps]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        m_prev = m_ref[...]                        # [group, 1]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        e = jnp.exp(s - m_new)                     # [group, ps]
+        l_ref[...] = l_prev * alpha + jnp.sum(e, axis=1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            e, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [group, d]
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _fin():
+        o_ref[0, 0] = (acc_ref[...] /
+                       jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
+                    scale=None, interpret=False):
+    """Single-token decode attention over a paged KV cache.
+
+    q:            [batch, num_q_heads, head_dim]
+    k_pages/v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+    block_tables: [batch, pages_per_seq] int32 pool-page ids
+    seq_lens:     [batch] int32 valid KV length per sequence
+    Returns [batch, num_q_heads, head_dim].
+    """
+    b, hq, d = q.shape
+    hkv, _, page_size, dk = k_pages.shape
+    if dk != d:
+        raise ValueError(f"head_dim mismatch: q {d} vs pages {dk}")
+    if hq % hkv != 0:
+        raise ValueError(f"num_q_heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    pages_per_seq = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    qg = q.reshape(b, hkv, group, d)
+
+    def _kv_map(bb, h, p, tbl, lens):
+        last_live = jnp.maximum(lens[bb] - 1, 0) // page_size
+        return (h, tbl[bb, jnp.minimum(p, last_live)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # block_tables, seq_lens
+        grid=(b, hkv, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bb, h, p, tbl, lens: (bb, h, 0, 0)),
+            # dead pages (past the sequence's last live page) clamp to the
+            # last live page: revisiting the same block lets the pipeline
+            # elide the copy, so HBM reads scale with true seq_len — the
+            # point of paged decode
+            pl.BlockSpec((1, 1, page_size, d), _kv_map),
+            pl.BlockSpec((1, 1, page_size, d), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda bb, h, p, tbl, lens: (bb, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),   # m
+            pltpu.VMEM((group, 1), jnp.float32),   # l
+            pltpu.VMEM((group, d), jnp.float32),   # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(b, hq, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                              scale=None):
+    """jnp oracle: gather each sequence's pages densely, masked softmax."""
+    b, hq, d = q.shape
+    hkv, _, ps, _ = k_pages.shape
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    outs = []
+    for i in range(b):
+        tbl = block_tables[i]                     # [pages_per_seq]
+        k = k_pages[:, tbl].reshape(hkv, -1, d)   # [hkv, S, d]
+        v = v_pages[:, tbl].reshape(hkv, -1, d)
+        qi = q[i].reshape(hkv, group, d)
+        s = jnp.einsum("hgd,hsd->hgs", qi, k) * scale
+        pos = jnp.arange(s.shape[-1])
+        s = jnp.where(pos[None, None, :] < seq_lens[i], s, _NEG_INF)
+        w = jax.nn.softmax(s, axis=-1)
+        outs.append(jnp.einsum("hgs,hsd->hgd", w, v).reshape(hq, d))
+    return jnp.stack(outs)
+
+
+__all__ = ["paged_attention", "paged_attention_reference"]

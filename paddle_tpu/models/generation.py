@@ -97,11 +97,17 @@ def extract_params(model):
     return params
 
 
-def _block(pl, h, pos, cfg, kv=None, cache_layer=None, cur_len=None):
+def _block(pl, h, pos, cfg, kv=None, cache_layer=None, cur_len=None,
+           paged=None):
     """One decoder layer. Returns (h, (k_full, v_full)).
 
     Training/prefill: kv is None, attends causally within h.
     Decode: cache_layer = (K, V) [b, max_len, Hkv, d]; h is [b, 1, H].
+    Paged decode: ``paged=(page_size, interpret)`` and cache_layer =
+    (Kp, Vp) [Hkv, b, pages_per_seq, page_size, d] — attention runs through
+    the Pallas paged kernel (kernels/paged_attention.py), reading only the
+    sequence's live pages (reference capability:
+    block_multi_head_attention_kernel.cu).
     """
     H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
@@ -121,6 +127,27 @@ def _block(pl, h, pos, cfg, kv=None, cache_layer=None, cur_len=None):
         p = _attn_scores(q, kr, mask)
         o = jnp.einsum("bhqk,bkhd->bqhd", p, vr)
         new_cache = (k, v)
+    elif paged is not None:
+        from ..kernels.paged_attention import paged_attention
+        page_size, interpret = paged
+        Kp, Vp = cache_layer               # [Hkv, b, pps, ps, d]
+        pps = Kp.shape[2]
+        p_idx = cur_len // page_size
+        off = cur_len % page_size
+        # write the new token into every sequence's current page (identity
+        # block table: sequence i owns pool pages [i*pps, (i+1)*pps))
+        kt = jnp.transpose(k, (2, 0, 1, 3))[:, :, None]   # [Hkv, b, 1, 1, d]
+        vt = jnp.transpose(v, (2, 0, 1, 3))[:, :, None]
+        Kp = jax.lax.dynamic_update_slice(Kp, kt, (0, 0, p_idx, off, 0))
+        Vp = jax.lax.dynamic_update_slice(Vp, vt, (0, 0, p_idx, off, 0))
+        tbl = jnp.arange(b * pps, dtype=jnp.int32).reshape(b, pps)
+        lens = jnp.full((b,), cur_len + 1, jnp.int32)
+        o = paged_attention(q[:, 0],
+                            Kp.reshape(Hkv, b * pps, page_size, d),
+                            Vp.reshape(Hkv, b * pps, page_size, d),
+                            tbl, lens, interpret=interpret)
+        o = o[:, None]                      # [b, 1, H, d]
+        new_cache = (Kp, Vp)
     else:
         K, V = cache_layer                       # [b, max_len, Hkv, d]
         K = jax.lax.dynamic_update_slice(K, k, (0, cur_len, 0, 0))
@@ -166,11 +193,19 @@ def _sample(logits, key, temperature, top_k, top_p):
 class Generator:
     """``Generator(model, max_len).generate(ids, max_new_tokens=...)``."""
 
-    def __init__(self, model, max_len=2048):
+    def __init__(self, model, max_len=2048, paged=False, page_size=128):
         self.cfg = model.config
         self.params = extract_params(model)
         self.max_len = max_len
         cfg = self.cfg
+        paged_opt = None
+        if paged:
+            if max_len % page_size != 0:
+                raise ValueError(f"max_len {max_len} must be a multiple of "
+                                 f"page_size {page_size}")
+            from ..kernels import _on_tpu
+            paged_opt = (page_size, not _on_tpu())   # interpret off-TPU
+        self.paged = paged_opt
 
         @jax.jit
         def prefill(params, ids):
@@ -186,6 +221,14 @@ class Generator:
                 V = jnp.zeros_like(K)
                 K = jax.lax.dynamic_update_slice(K, k, (0, 0, 0, 0))
                 V = jax.lax.dynamic_update_slice(V, v, (0, 0, 0, 0))
+                if paged_opt is not None:
+                    pps = max_len // page_size
+                    hkv, d = cfg.num_key_value_heads, cfg.head_dim
+                    # [b, max_len, Hkv, d] -> [Hkv, b, pps, ps, d]
+                    K = jnp.transpose(
+                        K.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
+                    V = jnp.transpose(
+                        V.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
                 caches.append((K, V))
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             return _logits(params, h[:, -1], cfg), caches
@@ -200,7 +243,7 @@ class Generator:
             new_caches = []
             for pl, cl in zip(params["layers"], caches):
                 h, cl2 = _block(pl, h, pos, cfg, cache_layer=cl,
-                                cur_len=cur_len)
+                                cur_len=cur_len, paged=paged_opt)
                 new_caches.append(cl2)
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             logits = _logits(params, h[:, 0], cfg)

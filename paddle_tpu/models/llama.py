@@ -35,6 +35,9 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    # >0: train-time loss uses the chunked fused matmul+CE head (full
+    # [tokens, vocab] logits never materialized; forward returns (None, loss))
+    loss_chunk_size: int = 0
 
     @property
     def head_dim(self):
@@ -175,6 +178,17 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
         h = self.model(input_ids, position_ids, attn_mask)
+        if labels is not None and self.config.loss_chunk_size:
+            # memory-efficient head: chunked matmul+CE, full logits never
+            # materialized (so no logits are returned on this path)
+            w = (self.model.embed_tokens.weight if self.lm_head is None
+                 else self.lm_head.weight)
+            loss = F.fused_linear_cross_entropy(
+                h.reshape([-1, self.config.hidden_size]), w,
+                labels.reshape([-1]),
+                chunk_size=self.config.loss_chunk_size,
+                transpose_weight=self.lm_head is None)
+            return None, loss
         if self.lm_head is None:
             logits = T.matmul(h, self.model.embed_tokens.weight, transpose_y=True)
         else:
