@@ -84,7 +84,7 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "edges", "out_avals", "out_treedef", "hooks",
-                 "retained", "__weakref__")
+                 "retained", "replay", "__weakref__")
 
     def __init__(self, name, vjp_fn, edges, out_avals, out_treedef):
         self.name = name
@@ -94,6 +94,7 @@ class GradNode:
         self.out_treedef = out_treedef
         self.hooks = []             # fn(list_of_cotangents) -> list_of_cotangents
         self.retained = {}          # slot -> weakref(Tensor)
+        self.replay = None          # (pure_fn, diff_tensors) for create_graph
 
     def __repr__(self):
         return f"GradNode({self.name})"
@@ -113,6 +114,20 @@ _grad_sink: dict | None = None
 def _accumulate(leaf, grad_array):
     from .tensor import Tensor  # local import to avoid cycle
 
+    if isinstance(grad_array, Tensor):
+        # tensor-mode (create_graph): the grad stays ON the tape
+        for hook in leaf._grad_hooks:
+            out = hook(grad_array)
+            if out is not None:
+                grad_array = out if isinstance(out, Tensor) else Tensor(out)
+        if _grad_sink is not None:
+            prev = _grad_sink.get(id(leaf))
+            _grad_sink[id(leaf)] = grad_array if prev is None \
+                else prev + grad_array
+            return
+        leaf.grad = grad_array if leaf.grad is None else leaf.grad + grad_array
+        return
+
     for hook in leaf._grad_hooks:
         out = hook(Tensor(grad_array, stop_gradient=True))
         if out is not None:
@@ -127,13 +142,20 @@ def _accumulate(leaf, grad_array):
         leaf.grad = Tensor(leaf.grad._data + grad_array, stop_gradient=True)
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
+def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None,
+             create_graph=False):
     """Run reverse accumulation from ``tensors``.
 
     Mirrors the reference engine's algorithm (backward.cc:106): seed the
     output-grad buffers, count in-degrees over the reachable node graph, and
     process nodes whose consumers have all fired. ``_capture`` optionally maps
     ``(GradNode, slot) -> Tensor`` to deliver intermediate grads (paddle.grad).
+
+    ``create_graph=True`` runs the pass in tensor mode: each node's vjp is
+    recomputed THROUGH the eager op layer from its replay closure (primal fn
+    + live input tensors), so every produced gradient is itself on the tape
+    and can be differentiated again — the reference's double-grad capability
+    (general_grad.h + generated double-grad ops).
     """
     from .tensor import Tensor
 
@@ -145,13 +167,14 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
         grad_tensors = [grad_tensors]
     _capture = _capture or {}
 
-    # Seed buffers: node -> {slot: grad_array}
+    # Seed buffers: node -> {slot: grad_array (Tensor in create_graph mode)}
     buffers: dict[GradNode, dict[int, jnp.ndarray]] = {}
     roots: list[GradNode] = []
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._grad_node is None:
             raise RuntimeError("backward() on a tensor that requires no grad")
-        seed = g._data if isinstance(g, Tensor) else g
+        seed = g if (create_graph and isinstance(g, Tensor)) else (
+            g._data if isinstance(g, Tensor) else g)
         if seed is None:
             if t.size != 1:
                 raise RuntimeError(
@@ -159,6 +182,8 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
                     f"(shape {t.shape})"
                 )
             seed = jnp.ones(t.shape, t._data.dtype)
+            if create_graph:
+                seed = Tensor(seed, stop_gradient=True)
         node = t._grad_node
         if node is None:
             _accumulate(t, seed)  # backward() on a leaf: grad is the seed
@@ -188,8 +213,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
         node = ready.popleft()
         processed += 1
         grads = buffers.pop(node, {})
+        zero = (lambda s, d: Tensor(jnp.zeros(s, d), stop_gradient=True)
+                if jnp.issubdtype(d, jnp.inexact) else _zero_cotangent(s, d)) \
+            if create_graph else _zero_cotangent
         cotangents = [
-            grads[i] if i in grads else _zero_cotangent(*node.out_avals[i])
+            grads[i] if i in grads else zero(*node.out_avals[i])
             for i in range(len(node.out_avals))
         ]
         for hook in node.hooks:
@@ -201,14 +229,19 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
         for (cap_node, slot), t in _capture.items():
             if cap_node is node:
                 _accumulate(t, cotangents[slot])
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                "trying to backward through the graph a second time: "
-                "set retain_graph=True on the first backward"
-            )
-        in_grads = node.vjp_fn(jax.tree.unflatten(node.out_treedef, cotangents))
-        if not retain_graph:
-            node.vjp_fn = None
+        if create_graph:
+            in_grads = _tape_vjp(node, cotangents)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to backward through the graph a second time: "
+                    "set retain_graph=True on the first backward"
+                )
+            in_grads = node.vjp_fn(
+                jax.tree.unflatten(node.out_treedef, cotangents))
+            if not retain_graph:
+                node.vjp_fn = None
+                node.replay = None  # free the pinned primals too
         for g, edge in zip(in_grads, node.edges):
             if edge[0] == "leaf":
                 _accumulate(edge[1], g)
@@ -222,22 +255,43 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _capture=None):
     return processed
 
 
+def _tape_vjp(node, cotangents):
+    """create_graph node step: re-derive the node's vjp THROUGH the eager op
+    layer from its replay closure, so the returned input-grads are Tensors
+    carrying their own GradNodes (differentiable again)."""
+    from .dispatch import eager_apply
+
+    if node.replay is None:
+        raise RuntimeError(
+            f"op '{node.name}' recorded no replay closure; "
+            "create_graph=True cannot differentiate through it")
+    fn, diff_tensors = node.replay
+    n_p = len(diff_tensors)
+    treedef = node.out_treedef
+
+    def vjp_all(*flat):
+        primals, cots = flat[:n_p], flat[n_p:]
+        cot_tree = jax.tree.unflatten(treedef, list(cots))
+        _, vjp = jax.vjp(fn, *primals)
+        return tuple(vjp(cot_tree))
+
+    outs = eager_apply(f"grad:{node.name}", vjp_all,
+                       tuple(diff_tensors) + tuple(cotangents), {})
+    return list(outs) if isinstance(outs, (tuple, list)) else [outs]
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
          allow_unused=False):
     """``paddle.grad`` analog: grads of outputs w.r.t. an explicit input list.
 
     Implemented with the backward engine's capture mechanism (the reference's
     GeneralGrad partial-graph walk, paddle/fluid/eager/general_grad.h).
-    ``create_graph`` (double backward) is not supported on the eager tape —
-    use the functional ``paddle_tpu.incubate.autograd`` API instead.
+    ``create_graph=True`` returns gradients that are themselves on the tape
+    (double backward — gradient penalties etc.); the first graph is kept
+    intact in that mode.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use the "
-            "functional autograd API (paddle_tpu.incubate.autograd) instead"
-        )
     global _grad_sink
     inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
     capture = {}
@@ -249,7 +303,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     _grad_sink = sink
     try:
         backward(outputs, grad_tensors=grad_outputs,
-                 retain_graph=bool(retain_graph), _capture=capture)
+                 retain_graph=bool(retain_graph) or create_graph,
+                 _capture=capture, create_graph=create_graph)
     finally:
         _grad_sink = prev_sink
     results = []
@@ -259,7 +314,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             raise RuntimeError(
                 "one of the inputs received no gradient; pass allow_unused=True"
             )
-        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
+        if g is None:
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)  # create_graph: already tape-connected
+        else:
+            results.append(Tensor(g, stop_gradient=True))
     return results
 
 

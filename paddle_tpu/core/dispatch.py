@@ -102,6 +102,11 @@ def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
     flat_out, out_treedef = jax.tree.flatten(out)
     out_avals = [(o.shape, o.dtype) for o in flat_out]
     node = autograd.GradNode(name, vjp_fn, edges, out_avals, out_treedef)
+    # replay info for double backward (create_graph=True): the pure primal
+    # fn + the live input tensors, so the backward pass can re-derive the
+    # vjp THROUGH the eager layer and land grads-of-grads on the tape
+    # (the reference's double-grad ops, general_grad.h)
+    node.replay = (g, diff_tensors)
     return _wrap_outputs(name, out, stop_gradient=False, node=node)
 
 
@@ -109,8 +114,13 @@ def _wrap_outputs(name, out, stop_gradient, node=None):
     flat_out, out_treedef = jax.tree.flatten(out)
     if GLOBAL_FLAGS.get("check_nan_inf"):
         for o in flat_out:
-            if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.isfinite(o).all()):
-                raise FloatingPointError(f"NaN/Inf detected in output of op '{name}'")
+            # eager sweep only on concrete arrays; under a trace the
+            # compiled path (TrainStep) carries its own fused finite check
+            if jnp.issubdtype(o.dtype, jnp.inexact) \
+                    and not isinstance(o, jax.core.Tracer) \
+                    and not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}'")
     wrapped = []
     for slot, o in enumerate(flat_out):
         t = Tensor(o, stop_gradient=True)

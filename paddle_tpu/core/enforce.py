@@ -1,0 +1,116 @@
+"""Error types + enforce helpers.
+
+Analog of the reference's enforce/error system (reference:
+paddle/common/enforce.h PADDLE_ENFORCE_* macros + paddle/common/errors.h
+error codes). Each error type subclasses the closest Python builtin so
+user code catches them naturally; ``FLAGS_call_stack_level`` controls how
+much framework context is appended (0 = message only, 1 = op context,
+2 = full python stack), mirroring the reference flag of the same name.
+"""
+from __future__ import annotations
+
+import traceback
+
+from .flags import GLOBAL_FLAGS
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of all enforce failures (reference: enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def _format(msg, ctx=None):
+    level = GLOBAL_FLAGS.get("call_stack_level") or 0
+    parts = [str(msg)]
+    if ctx and level >= 1:
+        parts.append(f"  [operator context: {ctx}]")
+    if level >= 2:
+        stack = "".join(traceback.format_stack(limit=12)[:-2])
+        parts.append("  [python call stack]\n" + stack)
+    return "\n".join(parts)
+
+
+def enforce(cond, msg="enforce failed", error_cls=InvalidArgumentError,
+            ctx=None):
+    """PADDLE_ENFORCE analog: raise ``error_cls`` unless ``cond``."""
+    if not cond:
+        raise error_cls(_format(msg, ctx))
+
+
+def enforce_eq(a, b, msg=None, ctx=None):
+    enforce(a == b, msg or f"expected {a!r} == {b!r}", ctx=ctx)
+
+
+def enforce_ne(a, b, msg=None, ctx=None):
+    enforce(a != b, msg or f"expected {a!r} != {b!r}", ctx=ctx)
+
+
+def enforce_gt(a, b, msg=None, ctx=None):
+    enforce(a > b, msg or f"expected {a!r} > {b!r}", ctx=ctx)
+
+
+def enforce_ge(a, b, msg=None, ctx=None):
+    enforce(a >= b, msg or f"expected {a!r} >= {b!r}", ctx=ctx)
+
+
+def enforce_lt(a, b, msg=None, ctx=None):
+    enforce(a < b, msg or f"expected {a!r} < {b!r}", ctx=ctx)
+
+
+def enforce_le(a, b, msg=None, ctx=None):
+    enforce(a <= b, msg or f"expected {a!r} <= {b!r}", ctx=ctx)
+
+
+def enforce_not_none(x, msg=None, ctx=None):
+    enforce(x is not None, msg or "expected a non-None value",
+            error_cls=NotFoundError, ctx=ctx)
+    return x
+
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+    "UnimplementedError", "UnavailableError", "PreconditionNotMetError",
+    "ResourceExhaustedError", "ExecutionTimeoutError",
+    "enforce", "enforce_eq", "enforce_ne", "enforce_gt", "enforce_ge",
+    "enforce_lt", "enforce_le", "enforce_not_none",
+]

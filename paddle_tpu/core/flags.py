@@ -97,10 +97,20 @@ def get_flags(flags) -> dict[str, Any]:
 # Core flags (subset of the reference's 190 in paddle/common/flags.cc that are
 # meaningful on a TPU/XLA stack).
 define_flag("check_nan_inf", bool, False, "sweep op outputs for NaN/Inf in eager mode")
+define_flag("check_nan_inf_level", int, 0, "0: raise on first non-finite; >0 reserved for report-only levels")
 define_flag("eager_jit_ops", bool, False, "route eager op execution through per-op jitted callables")
 define_flag("benchmark", bool, False, "block on every op for timing")
 define_flag("low_precision_op_list", int, 0, "record ops hit by AMP lists")
 define_flag("tpu_deterministic", bool, False, "prefer deterministic lowerings")
 define_flag("log_level", int, 0, "framework VLOG level")
+define_flag("call_stack_level", int, 1, "error verbosity: 0 message, 1 op context, 2 full python stack (enforce.py)")
+define_flag("allocator_strategy", str, "auto_growth", "host caching-allocator strategy (core/native allocator)")
+define_flag("use_pinned_memory", bool, True, "pin host staging buffers used for device transfers")
+define_flag("fraction_of_tpu_memory_to_use", float, 1.0, "advisory HBM fraction for preallocation (PJRT-managed)")
+define_flag("cudnn_deterministic", bool, False, "reference-name alias of tpu_deterministic")
+define_flag("max_inplace_grad_add", int, 0, "grad accumulation chunking threshold (reference flags.cc)")
+define_flag("pallas_flash_threshold", int, 8192, "min seq len where the Pallas flash-attention kernel engages")
+define_flag("embedding_deterministic", bool, False, "deterministic embedding grad scatter")
+define_flag("distributed_watchdog_timeout_s", float, 600.0, "collective watchdog timeout (distributed/watchdog.py)")
 
 __all__ = ["GLOBAL_FLAGS", "define_flag", "set_flags", "get_flags", "FlagRegistry"]

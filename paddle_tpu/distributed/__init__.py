@@ -38,3 +38,7 @@ from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .pipeline_schedule import (  # noqa: F401
     build_schedule, pipeline_train_step,
 )
+from .hybrid_parallel import build_hybrid_step  # noqa: F401
+from .watchdog import (  # noqa: F401
+    CommWatchdog, enable_comm_watchdog, disable_comm_watchdog,
+)

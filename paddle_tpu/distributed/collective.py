@@ -127,7 +127,10 @@ def _gather_rows(a, group):
     """Host all-gather: rows [r, ...] of every rank's local value, restricted
     to the group's ranks (rows gathered globally, then selected)."""
     from jax.experimental import multihost_utils
-    rows = multihost_utils.process_allgather(np.asarray(a))
+    from .watchdog import maybe_track
+    with maybe_track("process_allgather",
+                     meta={"rank": get_rank(), "shape": np.shape(a)}):
+        rows = multihost_utils.process_allgather(np.asarray(a))
     return np.stack([rows[r] for r in _group_ranks(group)])
 
 
@@ -348,7 +351,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
         _p2p_seq[(src, me)] = seq + 1
         key = f"ptpu_p2p/{src}->{me}/{seq}"
         client = _kv_client()
-        blob = client.blocking_key_value_get(key, 120_000)
+        from .watchdog import maybe_track
+        with maybe_track("recv", meta={"src": src, "dst": me, "seq": seq}):
+            blob = client.blocking_key_value_get(key, 120_000)
         try:  # consumed: keep the coordination service's store bounded
             client.key_value_delete(key)
         except Exception:
@@ -363,7 +368,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
 def barrier(group=None):
     if _mp_active():
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        from .watchdog import maybe_track
+        with maybe_track("barrier", meta={"rank": get_rank()}):
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
         return
     jax.block_until_ready(jnp.zeros(()))
 

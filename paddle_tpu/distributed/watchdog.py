@@ -1,0 +1,137 @@
+"""Collective watchdog — hang detection for distributed communication.
+
+TPU-native analog of the reference's CommTaskManager (reference:
+paddle/phi/core/distributed/comm_task_manager.h:37 + nccl_comm_task.cc,
+enabled by FLAGS_enable_async_trace): background threads track every
+in-flight collective, and a task that exceeds the timeout triggers a
+diagnostic dump and aborts the process group. NCCL needs this because a
+lost rank deadlocks the others inside the kernel; the same failure mode
+exists for a multi-host XLA program waiting on a dead peer's collective
+or a blocking coordination-service read.
+
+Usage::
+
+    wd = enable_comm_watchdog(timeout_s=300)   # or FLAGS default
+    with wd.track("all_reduce", meta={"group": "dp"}):
+        dist.all_reduce(t)
+
+The eager multi-process collectives (collective.py) register themselves
+automatically when a watchdog is enabled.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from ..core.flags import GLOBAL_FLAGS
+
+_active: "CommWatchdog | None" = None
+
+
+class CommWatchdog:
+    def __init__(self, timeout_s=None, on_timeout=None, poll_s=1.0):
+        self.timeout_s = timeout_s if timeout_s is not None else \
+            float(GLOBAL_FLAGS.get("distributed_watchdog_timeout_s") or 600.0)
+        self.poll_s = poll_s
+        self.on_timeout = on_timeout or self._default_timeout
+        self._tasks: dict[int, dict] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.fired = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- task tracking (the CommTask ledger) -------------------------------
+    @contextmanager
+    def track(self, name, meta=None):
+        with self._lock:
+            tid = self._next_id
+            self._next_id += 1
+            self._tasks[tid] = {"name": name, "t0": time.time(),
+                                "meta": meta or {}}
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._tasks.pop(tid, None)
+
+    def in_flight(self):
+        with self._lock:
+            now = time.time()
+            return [{"name": t["name"], "elapsed_s": now - t["t0"],
+                     "meta": t["meta"]} for t in self._tasks.values()]
+
+    # -- monitor -----------------------------------------------------------
+    def _watch(self):
+        while not self._stop.wait(self.poll_s):
+            now = time.time()
+            with self._lock:
+                stuck = [dict(t, elapsed=now - t["t0"])
+                         for t in self._tasks.values()
+                         if now - t["t0"] > self.timeout_s]
+            if stuck:
+                self.fired = True
+                self.on_timeout(stuck)
+                return
+
+    def _default_timeout(self, stuck):
+        import sys
+        lines = [f"[comm watchdog] {len(stuck)} collective(s) exceeded "
+                 f"{self.timeout_s}s:"]
+        for t in stuck:
+            lines.append(f"  - {t['name']}: {t['elapsed']:.1f}s "
+                         f"meta={t['meta']}")
+        lines.append("[comm watchdog] dumping and aborting (the reference "
+                     "CommTaskManager aborts the NCCL communicator here)")
+        sys.stderr.write("\n".join(lines) + "\n")
+        sys.stderr.flush()
+        # abort: a wedged collective cannot be cancelled from Python; match
+        # the reference's process-group abort (unless a test overrides)
+        os._exit(42)
+
+
+def enable_comm_watchdog(timeout_s=None, on_timeout=None) -> CommWatchdog:
+    global _active
+    if _active is not None:
+        _active.stop()
+    _active = CommWatchdog(timeout_s=timeout_s, on_timeout=on_timeout).start()
+    return _active
+
+
+def disable_comm_watchdog():
+    global _active
+    if _active is not None:
+        _active.stop()
+        _active = None
+
+
+def get_comm_watchdog():
+    return _active
+
+
+@contextmanager
+def maybe_track(name, meta=None):
+    """Track under the active watchdog if one is enabled (no-op otherwise)."""
+    wd = _active
+    if wd is None:
+        yield
+        return
+    with wd.track(name, meta=meta):
+        yield
+
+
+__all__ = ["CommWatchdog", "enable_comm_watchdog", "disable_comm_watchdog",
+           "get_comm_watchdog", "maybe_track"]

@@ -67,12 +67,23 @@ def _tree_to_tensors(tree):
 
 class StaticFunction:
     """Compiled forward wrapper (reference: StaticFunction in
-    python/paddle/jit/dy2static/program_translator.py)."""
+    python/paddle/jit/dy2static/program_translator.py).
+
+    Guard/fallback semantics (the SOT graph-break analog, reference
+    jit/sot/translate.py): the cache key guards on every input's
+    shape+dtype and every non-tensor argument's value, so a changed Python
+    argument or shape re-traces rather than reusing a stale program. When
+    the traced function turns out to need concrete tensor VALUES for Python
+    control flow (a data-dependent ``if``/``while``), tracing raises — the
+    wrapper then graph-breaks: it marks the signature and permanently runs
+    it eagerly (one warning), instead of silently baking a single branch.
+    """
 
     def __init__(self, fn, layer=None):
         self._fn = fn
         self._layer = layer
         self._cache = {}
+        self._graph_broken = set()
         functools.update_wrapper(self, fn)
 
     def _key(self, flat_args):
@@ -92,6 +103,8 @@ class StaticFunction:
         arr_in = [x._data if isinstance(x, Tensor) else x for x in flat_in]
         tensor_pos = [i for i, x in enumerate(flat_in) if isinstance(x, Tensor)]
         key = self._key(arr_in)
+        if key in self._graph_broken:
+            return self._fn(*args, **kwargs)
 
         if key not in self._cache:
             installer = _Installed(state)
@@ -120,7 +133,25 @@ class StaticFunction:
 
         state_arrays = {k: t._data for k, t in state.items()}
         dyn = [arr_in[i] for i in tensor_pos]
-        out_arrays, new_state = self._cache[key](state_arrays, _rng.next_key(), *dyn)
+        try:
+            out_arrays, new_state = self._cache[key](
+                state_arrays, _rng.next_key(), *dyn)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError):
+            # data-dependent Python control flow: graph-break to eager for
+            # this signature (the SOT fallback, jit/sot/translate.py)
+            import warnings
+            warnings.warn(
+                f"jit.to_static({getattr(self._fn, '__name__', self._fn)}): "
+                "tensor-dependent Python control flow cannot be captured — "
+                "falling back to eager for this input signature (use "
+                "lax.cond-style ops or paddle.where for a compiled branch)",
+                stacklevel=2)
+            self._graph_broken.add(key)
+            del self._cache[key]
+            return self._fn(*args, **kwargs)
         # commit buffer mutations (running stats etc.); params are read-only here
         for k, t in state.items():
             if k.startswith("b:"):
@@ -197,10 +228,13 @@ class TrainStep:
                 self.optimizer._state[id(p)] = st
 
     def __call__(self, *batch):
+        from ..core.flags import GLOBAL_FLAGS
         _, buffers = _collect_state(self.model)
         batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                              for b in batch)
-        key = tuple((a.shape, str(a.dtype)) for a in batch_arrays)
+        check_finite = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+        key = tuple((a.shape, str(a.dtype)) for a in batch_arrays) \
+            + (check_finite,)
 
         if key not in self._cache:
             # Ensure optimizer state exists with final shapes: run one throwaway
@@ -238,6 +272,19 @@ class TrainStep:
                         new_params = inst_p.current()
                         new_buffers = inst_b.current()
                         new_opt = self._opt_state_arrays()
+                        if check_finite:
+                            # compiled-path numerical sanitizer (reference:
+                            # new_executor/nan_inf_utils.h under
+                            # FLAGS_check_nan_inf): one fused all-finite
+                            # reduction over loss + updated params, checked
+                            # host-side — no per-op sync like the eager sweep
+                            import jax.numpy as _jnp
+                            finite = _jnp.isfinite(loss._data).all()
+                            for v in new_params.values():
+                                if _jnp.issubdtype(v.dtype, _jnp.inexact):
+                                    finite &= _jnp.isfinite(v).all()
+                            return new_params, new_opt, new_buffers, \
+                                loss._data, finite
                         return new_params, new_opt, new_buffers, loss._data
                 finally:
                     opt._state = saved_state
@@ -253,10 +300,18 @@ class TrainStep:
         buffer_arrays = {f"b:{k}": v._data for k, v in buffers.items()}
         lr = self.optimizer.get_lr()
         step_in = self.optimizer._step_count  # inside-trace step() adds 1
-        new_p, new_o, new_b, loss = self._cache[key](
+        out = self._cache[key](
             param_arrays, opt_arrays, buffer_arrays,
             jnp.asarray(step_in, jnp.int32),
             jnp.asarray(lr, jnp.float32), _rng.next_key(), *batch_arrays)
+        if check_finite:
+            new_p, new_o, new_b, loss, finite = out
+            if not bool(finite):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in compiled train step "
+                    f"{self.optimizer._step_count} (FLAGS_check_nan_inf)")
+        else:
+            new_p, new_o, new_b, loss = out
         self.optimizer._step_count += 1
         for k, p in self._params.items():
             p._data = new_p[k]

@@ -72,8 +72,16 @@ def install():
         # the O(s^2) score materialization starts to dominate/ OOM and the
         # O(s) working set is worth it regardless. Tunable per deployment
         # via PADDLE_TPU_FLASH_THRESHOLD (re-measure on real v5p/v5e metal).
-        thresh = int(os.environ.get("PADDLE_TPU_FLASH_THRESHOLD",
-                                    "256" if forced else "8192"))
+        if forced:
+            thresh = int(os.environ.get("PADDLE_TPU_FLASH_THRESHOLD", "256"))
+        else:
+            from ..core.flags import GLOBAL_FLAGS
+            env = os.environ.get("PADDLE_TPU_FLASH_THRESHOLD")
+            if env is not None:
+                thresh = int(env)
+            else:
+                flag = GLOBAL_FLAGS.get("pallas_flash_threshold")
+                thresh = int(flag) if flag is not None else 8192
         # Pallas path: no arbitrary mask, no dropout, seq long enough to
         # beat the fused XLA composition.
         if use_pallas and attn_mask is None and dropout_p == 0.0 \

@@ -282,11 +282,21 @@ def fused_linear_cross_entropy(hidden, weight, label, chunk_size=1024,
     """
     from jax import lax
 
+    if reduction not in ("mean", "sum"):
+        raise ValueError(
+            f"fused_linear_cross_entropy supports reduction='mean'|'sum', "
+            f"got {reduction!r} (use cross_entropy for per-token losses)")
+
     def fn(h, w, lbl):
         n, d = h.shape
         chunk = min(chunk_size, n)
-        while n % chunk:
-            chunk -= 1
+        pad = (-n) % chunk
+        if pad:  # pad to a chunk multiple with ignored labels (no divisor
+            # search: a prime token count must not degrade to chunk=1)
+            h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+            lbl = jnp.concatenate(
+                [lbl, jnp.full((pad,), ignore_index, lbl.dtype)])
+            n = n + pad
 
         def chunk_loss(h_c, l_c):
             logits = (h_c @ w.T if transpose_weight else h_c @ w)

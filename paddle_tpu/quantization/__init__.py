@@ -166,3 +166,88 @@ class PTQ(QAT):
 __all__ = ["fake_quantize", "AbsmaxObserver", "EMAObserver",
            "FakeQuanterWithAbsMax", "QuantConfig", "QuantedLayer", "QAT",
            "PTQ"]
+
+
+def quantize_to_int8(w, axis=0):
+    """Symmetric per-channel int8 quantization: returns (w_int8, scale)."""
+    import jax.numpy as jnp
+    arr = w._data if hasattr(w, "_data") else jnp.asarray(w)
+    reduce_axes = tuple(i for i in range(arr.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(arr), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(arr / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+class Int8Linear(Layer):
+    """Int8 inference Linear (reference capability: the int8 inference tier
+    of paddle/fluid/inference + quantization passes; TPU-native shape —
+    int8 weights live in HBM at 1/4 the bandwidth, and in ``dynamic`` mode
+    the matmul itself runs int8 x int8 -> int32 on the MXU).
+
+    mode="weight_only": per-out-channel int8 weights dequantized on the fly
+    (activation stays float — the serving default for LLM weights).
+    mode="dynamic": activations are quantized per-row at runtime and the
+    dot is a true integer matmul, rescaled by (row_scale x col_scale).
+    """
+
+    def __init__(self, linear, mode="weight_only"):
+        super().__init__()
+        if mode not in ("weight_only", "dynamic"):
+            raise ValueError(f"unknown int8 mode {mode!r}")
+        self.mode = mode
+        # weight [in, out]: quantize per out-channel (axis 1)
+        self.w_int8, self.w_scale = quantize_to_int8(linear.weight, axis=1)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ..core.dispatch import eager_apply
+        from ..core.tensor import Tensor
+
+        w_q, w_s = self.w_int8, self.w_scale
+
+        if self.mode == "weight_only":
+            def fn(x):
+                w = w_q.astype(x.dtype) * w_s.astype(x.dtype)
+                return x @ w
+        else:
+            def fn(x):
+                import jax
+                amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+                x_s = jnp.maximum(amax, 1e-8) / 127.0
+                x_q = jnp.clip(jnp.round(x / x_s), -127, 127).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    x_q, w_q, (((x_q.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                return acc.astype(x.dtype) * x_s.astype(x.dtype) \
+                    * w_s.reshape(1, -1).astype(x.dtype)
+
+        out = eager_apply(f"int8_linear_{self.mode}", fn, (x,), {})
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def quantize_for_inference(model, mode="weight_only", inplace=False):
+    """Swap every Linear for an Int8Linear — the int8 serving path
+    (reference: inference-time quantization passes)."""
+    from ..nn.layer.common import Linear
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+
+    def walk(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            if isinstance(sub, Linear):
+                layer._sub_layers[name] = Int8Linear(sub, mode=mode)
+            else:
+                walk(sub)
+
+    walk(model)
+    return model
+
+
+__all__ += ["Int8Linear", "quantize_for_inference", "quantize_to_int8"]

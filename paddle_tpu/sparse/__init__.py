@@ -147,8 +147,11 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
 
 
 def to_sparse_coo(dense, sparse_dim=None):
+    """sparse_dim < ndim keeps the trailing dims dense (hybrid COO — the
+    point-cloud [N, C] layout the reference's sparse conv/norm layers use)."""
     x = dense._data if isinstance(dense, Tensor) else jnp.asarray(dense)
-    bcoo = jsparse.BCOO.fromdense(x)
+    n_sparse = sparse_dim if sparse_dim is not None else x.ndim
+    bcoo = jsparse.BCOO.fromdense(x, n_dense=x.ndim - n_sparse)
     return SparseCooTensor(bcoo, stop_gradient=getattr(dense, "stop_gradient", True))
 
 
@@ -195,6 +198,36 @@ tanh = _unary("tanh", jnp.tanh)
 sqrt = _unary("sqrt", jnp.sqrt)
 square = _unary("square", jnp.square)
 neg = _unary("neg", jnp.negative)
+
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary("leaky_relu",
+                  lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over STORED values of a 2-D sparse matrix
+    (reference: sparse/nn functional softmax — absent entries are excluded,
+    not treated as zeros)."""
+    if axis != -1:
+        raise ValueError("sparse softmax supports axis=-1")
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.softmax expects a sparse tensor")
+    bcoo = x._bcoo
+    if bcoo.indices.shape[-1] != 2 or bcoo.data.ndim != 1:
+        raise ValueError("sparse softmax supports 2-D COO matrices")
+    n = bcoo.shape[0]
+    rows = bcoo.indices[:, 0]
+    v = bcoo.data
+    m = jax.ops.segment_max(v, rows, num_segments=n)
+    e = jnp.exp(v - m[rows])
+    s = jax.ops.segment_sum(e, rows, num_segments=n)
+    new = jsparse.BCOO((e / s[rows], bcoo.indices), shape=bcoo.shape)
+    return SparseCooTensor(new, stop_gradient=x.stop_gradient)
+
+
 pow = None  # needs a scalar arg
 
 

@@ -1,17 +1,122 @@
 """sparse.nn — layers over sparse tensors (analog of python/paddle/sparse/nn/).
 
-Minimal surface: ReLU layer + SubmConv stub-free Conv3D via dense fallback
-(the reference's submanifold sparse conv is a CUDA-only rulebook kernel;
-on TPU the dense conv over the densified block is the XLA-friendly path
-until a Pallas gather-conv lands).
+The reference's sparse layer zoo (python/paddle/sparse/nn/layer/) wraps the
+CUDA rulebook kernels; the TPU-native shape keeps sparse COO/CSR as the
+STORAGE format and runs layer math through XLA on the (BCOO-backed) values:
+activations apply to ``values`` only (zeros map to zeros), Linear rides the
+sparse @ dense matmul, norms densify per feature — the XLA-friendly paths
+until Pallas gather kernels land for the conv family (documented dense
+fallback, sparse/__init__.py conv notes).
 """
 from __future__ import annotations
 
+import numpy as np
 
-class ReLU:
+
+class _ValueActivation:
+    """Elementwise activation f with f(0)=0: applies to stored values only."""
+
+    _fn_name: str = ""
+
     def __call__(self, x):
-        from . import relu as _relu
-        return _relu(x)
+        from . import __dict__ as sparse_ns
+        return sparse_ns[self._fn_name](x)
 
 
-__all__ = ["ReLU"]
+class ReLU(_ValueActivation):
+    _fn_name = "relu"
+
+
+class ReLU6:
+    def __call__(self, x):
+        from . import relu6
+        return relu6(x)
+
+
+class LeakyReLU:
+    def __init__(self, negative_slope=0.01):
+        self.negative_slope = negative_slope
+
+    def __call__(self, x):
+        from . import leaky_relu
+        return leaky_relu(x, self.negative_slope)
+
+
+class Softmax:
+    """Softmax over the last dense axis of a CSR/COO matrix (reference:
+    sparse/nn/layer/activation.py Softmax — per-row over stored values)."""
+
+    def __init__(self, axis=-1):
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1")
+
+    def __call__(self, x):
+        from . import softmax
+        return softmax(x)
+
+
+class Linear:
+    """y = x @ W + b on a sparse x (reference: sparse matmul kernels)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None):
+        from .. import nn as dense_nn
+        self._inner = dense_nn.Linear(in_features, out_features,
+                                      weight_attr=weight_attr,
+                                      bias_attr=bias_attr)
+        self.weight = self._inner.weight
+        self.bias = self._inner.bias
+
+    def parameters(self):
+        return self._inner.parameters()
+
+    def __call__(self, x):
+        from . import matmul
+        out = matmul(x, self.weight)   # dense Tensor, on the tape
+        if self.bias is not None:
+            out = out + self.bias      # Tensor add keeps the tape intact
+        return out
+
+
+class BatchNorm:
+    """Feature batch-norm over the dense trailing dim of a COO tensor
+    (reference: sparse/nn/layer/norm.py BatchNorm — stats over stored
+    points)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        import jax.numpy as jnp
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.running_mean = jnp.zeros((num_features,))
+        self.running_var = jnp.ones((num_features,))
+        self.training = True
+
+    def __call__(self, x):
+        import jax.numpy as jnp
+        from . import SparseCooTensor
+        vals = x._bcoo.data if hasattr(x, "_bcoo") else None
+        if vals is None:
+            raise ValueError("sparse BatchNorm expects a SparseCooTensor")
+        if vals.ndim < 2 or vals.shape[-1] != self.num_features:
+            raise ValueError(
+                "sparse BatchNorm needs a dense trailing feature dim of "
+                f"size {self.num_features} (build the tensor with "
+                "to_sparse_coo(dense, sparse_dim=ndim-1)); got values shape "
+                f"{vals.shape}")
+        if self.training:
+            mean = vals.mean(axis=0)
+            var = vals.var(axis=0)
+            self.running_mean = (self.momentum * self.running_mean
+                                 + (1 - self.momentum) * mean)
+            self.running_var = (self.momentum * self.running_var
+                                + (1 - self.momentum) * var)
+        else:
+            mean, var = self.running_mean, self.running_var
+        new_vals = (vals - mean) / jnp.sqrt(var + self.epsilon)
+        import jax.experimental.sparse as jsparse
+        bcoo = jsparse.BCOO((new_vals, x._bcoo.indices), shape=x._bcoo.shape)
+        return SparseCooTensor(bcoo, stop_gradient=x.stop_gradient)
+
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Linear", "BatchNorm"]

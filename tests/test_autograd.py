@@ -174,3 +174,49 @@ def test_setitem_grad_flow():
     y[1] = 7.0
     y.sum().backward()
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+
+
+class TestDoubleBackward:
+    """create_graph=True: grads-of-grads on the tape (reference capability:
+    general_grad.h + generated double-grad ops)."""
+
+    def test_second_derivative(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32))
+        x.stop_gradient = False
+        y = x * x * x
+        (g,) = paddle.grad(y.sum(), x, create_graph=True)
+        assert not g.stop_gradient
+        np.testing.assert_allclose(g.numpy(), [12.0, 27.0])
+        (g2,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [12.0, 18.0])  # 6x
+
+    def test_third_derivative(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * x * x * x                                     # x^4
+        (g1,) = paddle.grad(y, x, create_graph=True)          # 4x^3
+        (g2,) = paddle.grad(g1, x, create_graph=True)         # 12x^2
+        (g3,) = paddle.grad(g2, x)                            # 24x
+        np.testing.assert_allclose(g1.numpy(), [32.0])
+        np.testing.assert_allclose(g2.numpy(), [48.0])
+        np.testing.assert_allclose(g3.numpy(), [48.0])
+
+    def test_gradient_penalty_backward(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+        w = paddle.to_tensor(rng.standard_normal((3, 1)).astype(np.float32))
+        x.stop_gradient = False
+        w.stop_gradient = False
+        y = paddle.matmul(x, w).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        penalty = (gx * gx).sum()      # = 4 * ||w||^2
+        penalty.backward()
+        np.testing.assert_allclose(w.grad.numpy(), 8 * w.numpy(), rtol=1e-5)
+
+    def test_mixed_first_order_still_plain(self):
+        x = paddle.to_tensor(np.array([5.0], np.float32))
+        x.stop_gradient = False
+        y = x * x
+        (g,) = paddle.grad(y, x)       # default create_graph=False
+        assert g.stop_gradient
+        np.testing.assert_allclose(g.numpy(), [10.0])

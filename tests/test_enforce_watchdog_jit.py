@@ -1,0 +1,143 @@
+"""Error/enforce system, collective watchdog, jit graph-break fallback, and
+compiled-path NaN/Inf check (reference: paddle/common/enforce.h,
+comm_task_manager.h:37, jit/sot/translate.py graph breaks,
+new_executor/nan_inf_utils.h)."""
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import enforce as E
+from paddle_tpu.distributed.watchdog import CommWatchdog
+
+
+class TestEnforce:
+    def test_error_types_inherit_builtins(self):
+        assert issubclass(E.InvalidArgumentError, ValueError)
+        assert issubclass(E.NotFoundError, KeyError)
+        assert issubclass(E.OutOfRangeError, IndexError)
+        assert issubclass(E.UnimplementedError, NotImplementedError)
+        assert issubclass(E.ResourceExhaustedError, MemoryError)
+        assert issubclass(E.ExecutionTimeoutError, TimeoutError)
+        for c in (E.InvalidArgumentError, E.UnavailableError,
+                  E.PreconditionNotMetError, E.AlreadyExistsError):
+            assert issubclass(c, E.EnforceNotMet)
+
+    def test_enforce_helpers(self):
+        E.enforce(True)
+        with pytest.raises(E.InvalidArgumentError):
+            E.enforce(False, "boom")
+        with pytest.raises(E.InvalidArgumentError, match="expected 1"):
+            E.enforce_eq(1, 2)
+        E.enforce_eq(3, 3)
+        E.enforce_gt(2, 1)
+        E.enforce_le(1, 1)
+        with pytest.raises(E.NotFoundError):
+            E.enforce_not_none(None)
+        assert E.enforce_not_none(5) == 5
+
+    def test_call_stack_level_controls_verbosity(self):
+        paddle.set_flags({"FLAGS_call_stack_level": 2})
+        try:
+            with pytest.raises(E.InvalidArgumentError) as ei:
+                E.enforce(False, "deep message", ctx="op matmul")
+            assert "python call stack" in str(ei.value)
+            assert "op matmul" in str(ei.value)
+        finally:
+            paddle.set_flags({"FLAGS_call_stack_level": 1})
+        with pytest.raises(E.InvalidArgumentError) as ei:
+            E.enforce(False, "plain", ctx="op x")
+        assert "python call stack" not in str(ei.value)
+
+
+class TestWatchdog:
+    def test_fires_on_stuck_task(self):
+        fired = []
+        wd = CommWatchdog(timeout_s=0.3, poll_s=0.05,
+                          on_timeout=lambda stuck: fired.append(stuck))
+        wd.start()
+        try:
+            with wd.track("all_reduce", meta={"group": "dp"}):
+                time.sleep(0.8)
+        finally:
+            wd.stop()
+        assert wd.fired and fired
+        assert fired[0][0]["name"] == "all_reduce"
+        assert fired[0][0]["meta"] == {"group": "dp"}
+
+    def test_quiet_when_tasks_finish(self):
+        fired = []
+        wd = CommWatchdog(timeout_s=0.5, poll_s=0.05,
+                          on_timeout=lambda s: fired.append(s))
+        wd.start()
+        try:
+            for _ in range(3):
+                with wd.track("barrier"):
+                    time.sleep(0.05)
+            time.sleep(0.3)
+        finally:
+            wd.stop()
+        assert not wd.fired and not fired
+        assert wd.in_flight() == []
+
+
+class TestGraphBreak:
+    def test_data_dependent_branch_falls_back(self):
+        @paddle.jit.to_static
+        def f(x):
+            if float(x.sum()) > 0:    # tensor-dependent Python branch
+                return x * 2
+            return x - 1
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+        assert any("falling back to eager" in str(wi.message) for wi in w)
+        np.testing.assert_allclose(out.numpy(), 2 * np.ones(3))
+        # both branches behave correctly after the break
+        out2 = f(paddle.to_tensor(-np.ones(3, np.float32)))
+        np.testing.assert_allclose(out2.numpy(), -2 * np.ones(3))
+
+    def test_capturable_branch_stays_compiled(self):
+        @paddle.jit.to_static
+        def g(x):
+            return paddle.where(x > 0, x * 2, x - 1)
+
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        np.testing.assert_allclose(g(x).numpy(), [2.0, -2.0])
+        assert len(g._cache) == 1 and not g._graph_broken
+
+
+class TestCompiledNanCheck:
+    def test_train_step_raises_on_overflow(self):
+        lin = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                                   learning_rate=1e30)
+        step = paddle.jit.TrainStep(
+            lin, lambda x: (lin(x) ** 2).sum() * 1e30, opt)
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        xb = paddle.to_tensor(np.ones((2, 4), np.float32) * 1e20)
+        try:
+            with pytest.raises(FloatingPointError, match="compiled train"):
+                for _ in range(4):
+                    step(xb)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_healthy_step_unaffected(self):
+        lin = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                                   learning_rate=0.1)
+        step = paddle.jit.TrainStep(
+            lin, lambda x: (lin(x) ** 2).mean(), opt)
+        xb = paddle.to_tensor(np.ones((2, 4), np.float32))
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            l1 = float(step(xb).numpy())
+            l2 = float(step(xb).numpy())
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+        assert np.isfinite(l1) and l2 < l1

@@ -90,3 +90,82 @@ def test_qat_quantize_and_train():
     loss.backward()
     grads = [p.grad for p in qmodel.parameters() if p.grad is not None]
     assert grads  # STE lets grads reach the fp weights
+
+
+def test_sparse_nn_layers():
+    """sparse.nn surface: Linear / activations / Softmax / BatchNorm
+    (reference: python/paddle/sparse/nn/layer/)."""
+    import paddle_tpu.sparse as sparse
+    d = np.array([[0, 2, 0, 1], [3, 0, 0, 0], [0, 0, 0, 0]], np.float32)
+    x = sparse.to_sparse_coo(paddle.to_tensor(d))
+
+    lin = sparse.nn.Linear(4, 5)
+    y = lin(x)
+    ref = d @ np.asarray(lin.weight.numpy()) + np.asarray(lin.bias.numpy())
+    np.testing.assert_allclose(np.asarray(y.numpy()), ref, rtol=1e-5)
+
+    # d - 1.5 has no zeros: every entry is stored, activations apply to all
+    shifted = d - 1.5
+    neg = sparse.to_sparse_coo(paddle.to_tensor(shifted))
+    r = sparse.nn.ReLU()(neg).to_dense().numpy()
+    np.testing.assert_allclose(np.asarray(r), np.maximum(shifted, 0))
+    lr = sparse.nn.LeakyReLU(0.1)(neg).to_dense().numpy()
+    np.testing.assert_allclose(
+        np.asarray(lr), np.where(shifted >= 0, shifted, 0.1 * shifted),
+        rtol=1e-6)
+
+    sm = sparse.nn.Softmax()(x).to_dense().numpy()
+    e = np.exp(np.array([2.0, 1.0]) - 2.0)
+    e = e / e.sum()
+    np.testing.assert_allclose([sm[0, 1], sm[0, 3]], e, rtol=1e-5)
+    np.testing.assert_allclose(sm[1, 0], 1.0, rtol=1e-6)
+
+    # BatchNorm over a dense feature axis (point-cloud layout [N, C])
+    pts = np.array([[1.0, 2.0, 0.5], [3.0, -1.0, 2.5]], np.float32)
+    dense = np.zeros((4, 3), np.float32)
+    dense[[0, 2]] = pts
+    xc = sparse.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=1)
+    bn = sparse.nn.BatchNorm(3)
+    out = bn(xc)
+    vals = np.asarray(out._bcoo.data)
+    np.testing.assert_allclose(vals.mean(axis=0), 0.0, atol=1e-5)
+    with pytest.raises(ValueError, match="feature dim"):
+        sparse.nn.BatchNorm(3)(x)
+
+
+def test_int8_inference_path():
+    """Weight-only + dynamic int8 Linear (reference capability: int8
+    inference quantization passes)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.quantization import (
+        quantize_for_inference, Int8Linear, quantize_to_int8)
+    paddle.seed(0)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(16, 32)
+            self.fc2 = paddle.nn.Linear(32, 8)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32))
+    ref = m(x).numpy()
+    for mode in ("weight_only", "dynamic"):
+        qm = quantize_for_inference(m, mode=mode)
+        assert isinstance(qm.fc1, Int8Linear)
+        assert str(qm.fc1.w_int8.dtype) == "int8"
+        out = qm(x).numpy()
+        rel = np.abs(np.asarray(out) - np.asarray(ref)).max() / \
+            (np.abs(np.asarray(ref)).max() + 1e-9)
+        assert rel < 0.05, (mode, rel)
+    # original model untouched by the copy-quantize
+    np.testing.assert_allclose(np.asarray(m(x).numpy()), np.asarray(ref))
+    # quantizer roundtrip error is bounded by one step
+    w = np.random.default_rng(1).standard_normal((8, 8)).astype(np.float32)
+    q, s = quantize_to_int8(paddle.to_tensor(w), axis=1)
+    np.testing.assert_allclose(np.asarray(q, np.float32) * np.asarray(s), w,
+                               atol=float(np.asarray(s).max()))
