@@ -55,6 +55,8 @@ from . import inference  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 

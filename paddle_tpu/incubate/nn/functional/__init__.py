@@ -190,3 +190,92 @@ __all__ = [
     "swiglu", "fused_bias_act", "fused_matmul_bias", "fused_linear",
     "fused_dropout_add", "fused_dot_product_attention",
 ]
+
+
+def weight_quantize(x, algo="weight_only_int8", name=None):
+    """Quantize a weight matrix for serving (reference: incubate
+    weight_quantize; ops.yaml weight_quantize). Returns (int8_weight,
+    per-out-channel scale)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported weight_quantize algo {algo!r}")
+    from ....quantization import quantize_to_int8
+    from ....core.tensor import Tensor
+    q, s = quantize_to_int8(x, axis=1)
+    return Tensor(q), Tensor(s.reshape(-1))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
+    def fn(q, s):
+        import jax.numpy as jnp
+        return q.astype(jnp.float32) * s.reshape(1, -1)
+    return eager_apply("weight_dequantize", fn, (x, scale), {})
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", name=None):
+    """y = x @ dequant(weight) + bias — the weight-only int8 serving matmul
+    (reference: incubate weight_only_linear; llm_int8_linear)."""
+    if weight_dtype != "int8":
+        raise NotImplementedError(
+            f"weight_only_linear supports weight_dtype='int8'; got "
+            f"{weight_dtype!r} (int4 packing not implemented)")
+    def fn(a, q, s, *b):
+        import jax.numpy as jnp
+        w = q.astype(a.dtype) * s.reshape(1, -1).astype(a.dtype)
+        out = a @ w
+        return out + b[0] if b else out
+    extra = (bias,) if bias is not None else ()
+    return eager_apply("weight_only_linear", fn,
+                       (x, weight, weight_scale) + extra, {})
+
+
+llm_int8_linear = weight_only_linear
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Segment reduction over dim 0 (reference: incubate/tensor/math.py
+    segment_sum; geometric/segment ops)."""
+    return _segment("segment_sum", "sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("segment_mean", "mean", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("segment_max", "max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("segment_min", "min", data, segment_ids)
+
+
+def _segment(op_name, kind, data, segment_ids):
+    def fn(d, ids):
+        import jax
+        import jax.numpy as jnp
+        ids = ids.astype(jnp.int32)
+        # exact segment count when ids are concrete (eager); under a trace
+        # the data length is the static bound and ids must stay below it
+        # (ids >= num_segments would be silently dropped by jax otherwise)
+        try:
+            n = int(ids.max()) + 1 if ids.size else 0
+        except Exception:
+            n = d.shape[0]
+        if kind == "sum":
+            return jax.ops.segment_sum(d, ids, num_segments=n)
+        if kind == "mean":
+            s = jax.ops.segment_sum(d, ids, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (d.ndim - 1))
+        if kind == "max":
+            return jax.ops.segment_max(d, ids, num_segments=n)
+        return jax.ops.segment_min(d, ids, num_segments=n)
+    return eager_apply(op_name, fn, (data, segment_ids), {})
+
+
+__all__ += ["weight_quantize", "weight_dequantize", "weight_only_linear",
+            "llm_int8_linear", "segment_sum", "segment_mean", "segment_max",
+            "segment_min"]

@@ -19,13 +19,14 @@ from .loss import (  # noqa: F401
     binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
     cosine_embedding_loss, triplet_margin_loss, hinge_embedding_loss,
     square_error_cost, sigmoid_focal_loss, ctc_loss,
-    fused_linear_cross_entropy,
+    fused_linear_cross_entropy, margin_cross_entropy,
 )
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
     label_smooth, interpolate, upsample, pixel_shuffle, pixel_unshuffle,
     channel_shuffle, cosine_similarity, pairwise_distance, unfold, fold,
     bilinear, zeropad2d, pad,
+    affine_grid, grid_sample, gather_tree,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,

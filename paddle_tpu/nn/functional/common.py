@@ -232,3 +232,92 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
 
 # paddle.nn.functional.pad is tensor.manipulation.pad
 pad = _pad
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid from batched 2x3 matrices (reference:
+    nn/functional/vision.py affine_grid; the spatial-transformer pair with
+    grid_sample)."""
+    from ...core.dispatch import eager_apply
+
+    def fn(th):
+        n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h,w,3]
+        # sampling coordinates need full precision (TPU matmuls default to
+        # bf16 passes, which visibly shifts the sample positions)
+        return jnp.einsum("hwk,njk->nhwj", base, th,
+                          precision=jax.lax.Precision.HIGHEST)  # [n,h,w,2]
+
+    return eager_apply("affine_grid", fn, (theta,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW input at normalized [-1, 1] grid positions (reference:
+    nn/functional/vision.py grid_sample, CUDA grid_sample_kernel)."""
+    from ...core.dispatch import eager_apply
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]                  # [n, oh, ow]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1)
+            xc = jnp.clip(xi, 0, w - 1)
+            vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(a, yc, xc)
+            if padding_mode == "zeros":
+                ok = (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+                vals = vals * ok[:, None].astype(vals.dtype)
+            return vals                                 # [n, c, oh, ow]
+
+        if mode == "nearest":
+            return gather(jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        wx = (fx - x0).astype(a.dtype)[:, None]
+        wy = (fy - y0).astype(a.dtype)[:, None]
+        return (gather(y0, x0) * (1 - wy) * (1 - wx)
+                + gather(y0, x0 + 1) * (1 - wy) * wx
+                + gather(y0 + 1, x0) * wy * (1 - wx)
+                + gather(y0 + 1, x0 + 1) * wy * wx)
+
+    return eager_apply("grid_sample", fn, (x, grid), {})
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference: nn/functional/extension.py:149
+    gather_tree): walk parent pointers from the last step to recover full
+    beams. ids/parents: [max_time, batch, beam]."""
+    from ...core.dispatch import eager_apply
+
+    def fn(ids_a, par_a):
+        t = ids_a.shape[0]
+
+        def step(beam_idx, i):
+            tok = jnp.take_along_axis(ids_a[i], beam_idx, axis=-1)
+            nxt = jnp.take_along_axis(par_a[i], beam_idx, axis=-1)
+            return nxt, tok
+
+        init = jnp.broadcast_to(jnp.arange(ids_a.shape[-1]), ids_a.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(t - 1, -1, -1))
+        return toks[::-1]
+
+    return eager_apply("gather_tree", fn, (ids, parents), {})

@@ -326,3 +326,32 @@ def fused_linear_cross_entropy(hidden, weight, label, chunk_size=1024,
 
     return eager_apply("fused_linear_cross_entropy", fn,
                        (hidden, weight, label), {})
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """Combined margin softmax (ArcFace family: cos(m1*t + m2) - m3;
+    reference: ops.yaml margin_cross_entropy,
+    margin_cross_entropy_kernel.cu). Expects cosine logits in [-1, 1]."""
+    if group is not None:
+        raise NotImplementedError(
+            "margin_cross_entropy over a model-parallel group (class-dim "
+            "sharded logits) is not implemented; use the local form or "
+            "fleet ParallelCrossEntropy for the sharded softmax")
+    def fn(lg, lbl):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(lbl, lg.shape[-1], dtype=lg.dtype)
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(onehot > 0, target, lg) * scale
+        logp = jax.nn.log_softmax(adjusted.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+        if reduction == "mean":
+            loss = loss.mean()
+        elif reduction == "sum":
+            loss = loss.sum()
+        if return_softmax:
+            return loss, jax.nn.softmax(adjusted.astype(jnp.float32), -1)
+        return loss
+    return eager_apply("margin_cross_entropy", fn, (logits, label), {})

@@ -1,0 +1,151 @@
+"""paddle.signal — frame/overlap_add/stft/istft (reference:
+python/paddle/signal.py; kernels frame_kernel.cc, overlap_add_kernel.cc,
+and the fft c2c/r2c stack).
+
+All four are pure jnp lowerings registered as eager primitives, so they are
+differentiable and fuse on the compiled path. stft/istft satisfy the exact
+reconstruction identity (istft(stft(x)) == x for COLA windows), which the
+tests assert.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.dispatch import eager_apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames: [..., T] -> [..., frame_length, n_frames]
+    (axis=-1; axis=0 puts frames first, matching the reference layout)."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError(f"frame supports axis 0 or -1, got {axis}")
+
+    def fn(a):
+        t = a.shape[-1] if axis == -1 else a.shape[0]
+        if frame_length > t:
+            raise ValueError(
+                f"frame_length {frame_length} > signal length {t}")
+        n = 1 + (t - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+        if axis == -1:
+            return a[..., idx]                    # [..., L, n]
+        return a[idx]                             # [L, n, ...]
+
+    return eager_apply("frame", fn, (x,), {})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, n_frames] -> [..., T]."""
+    if axis not in (0, -1):
+        raise ValueError(f"overlap_add supports axis 0 or -1, got {axis}")
+
+    def fn(a):
+        if axis == -1:
+            length, n = a.shape[-2], a.shape[-1]
+            t = (n - 1) * hop_length + length
+            out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
+            for i in range(n):   # static n: unrolled scatter-adds fuse
+                out = out.at[..., i * hop_length:i * hop_length + length].add(
+                    a[..., :, i])
+            return out
+        length, n = a.shape[0], a.shape[1]
+        t = (n - 1) * hop_length + length
+        out = jnp.zeros((t,) + a.shape[2:], a.dtype)
+        for i in range(n):
+            out = out.at[i * hop_length:i * hop_length + length].add(a[:, i])
+        return out
+
+    return eager_apply("overlap_add", fn, (x,), {})
+
+
+def _window_array(window, n_fft):
+    if window is None:
+        return jnp.ones((n_fft,), jnp.float32)
+    w = window._data if hasattr(window, "_data") else jnp.asarray(window)
+    if w.shape[0] != n_fft:
+        pad = (n_fft - w.shape[0]) // 2
+        w = jnp.pad(w, (pad, n_fft - w.shape[0] - pad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """[.., T] -> complex [.., n_fft//2+1 (or n_fft), n_frames]
+    (reference: signal.py stft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_array(window, n_fft)
+
+    def fn(sig, w):
+        s = sig
+        if center:
+            pads = [(0, 0)] * (s.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            s = jnp.pad(s, pads, mode=pad_mode)
+        t = s.shape[-1]
+        n = 1 + (t - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = s[..., idx] * w                       # [.., n, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)              # [.., freq, n]
+
+    return eager_apply("stft", fn, (x, w), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse stft with window-envelope normalization (COLA reconstruction;
+    reference: signal.py istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_array(window, n_fft)
+
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (a one-sided "
+            "spectrum can only reconstruct a real signal)")
+
+    def fn(spec, w):
+        s = jnp.swapaxes(spec, -1, -2)                 # [.., n, freq]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w                            # synthesis window
+        n = frames.shape[-2]
+        t = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
+        env = jnp.zeros((t,), frames.dtype)
+        wsq = w * w
+        for i in range(n):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            env = env.at[sl].add(wsq)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            # padded[pad + i] = original[i]: trim the leading pad, keep the
+            # tail OLA region (it reconstructs real samples)
+            out = out[..., n_fft // 2:]
+        if length is not None:
+            out = out[..., :length]
+        elif center:
+            out = out[..., :t - n_fft]
+        return out
+
+    return eager_apply("istft", fn, (x, w), {})

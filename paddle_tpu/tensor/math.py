@@ -1,6 +1,8 @@
 """Elementwise + reduction math ops (analog of python/paddle/tensor/math.py, 170 defs)."""
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -225,14 +227,36 @@ def cumprod(x, dim=None, dtype=None, name=None):
                        lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=to_jax_dtype(dtype) if dtype else None), (x,), {})
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
+def _cum_minmax(name, is_max, x, axis, dtype):
+    """Running max/min with cumulative argindices (ties keep the latest
+    position, matching the reference cummax/cummin kernels)."""
     def fn(a):
-        ax = _axis(axis) if axis is not None else 0
         arr = a.reshape(-1) if axis is None else a
-        vals = lax.associative_scan(jnp.maximum, arr, axis=ax if axis is not None else 0)
-        idx = jnp.argmax(jnp.where(arr == vals, jnp.arange(arr.shape[ax] if axis is not None else arr.shape[0]).reshape([-1 if i == (ax if axis is not None else 0) else 1 for i in range(arr.ndim)]), -1), axis=ax if axis is not None else 0)
+        ax = 0 if axis is None else _axis(axis) % arr.ndim
+        shape = [1] * arr.ndim
+        shape[ax] = arr.shape[ax]
+        idx0 = jnp.broadcast_to(
+            jnp.arange(arr.shape[ax], dtype=to_jax_dtype(dtype)).reshape(shape),
+            arr.shape)
+
+        def comb(prev, cur):
+            pv, pi = prev
+            cv, ci = cur
+            take_cur = (cv >= pv) if is_max else (cv <= pv)
+            return jnp.where(take_cur, cv, pv), jnp.where(take_cur, ci, pi)
+
+        vals, idx = lax.associative_scan(comb, (arr, idx0), axis=ax)
         return vals, idx
-    return eager_apply("cummax", fn, (x,), {})
+
+    return eager_apply(name, fn, (x,), {})
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax("cummax", True, x, axis, dtype)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    return _cum_minmax("cummin", False, x, axis, dtype)
 
 
 def logcumsumexp(x, axis=None, name=None):
@@ -342,3 +366,119 @@ def fill_(x, value):
 
 def increment(x, value=1.0, name=None):
     return x._inplace_update(x._data + value)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) batched (reference: ops.yaml baddbmm)."""
+    return eager_apply(
+        "baddbmm",
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        (input, x, y), {})
+
+
+def logit(x, eps=None, name=None):
+    """log(x / (1-x)); eps clamps the input into [eps, 1-eps]."""
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a) - jnp.log1p(-a)
+    return eager_apply("logit", fn, (x,), {})
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice's p-norm along ``axis`` to max_norm (reference:
+    ops.yaml renorm)."""
+    def fn(a):
+        ax = _axis(axis) % a.ndim
+        reduce_axes = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=reduce_axes,
+                        keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return eager_apply("renorm", fn, (x,), {})
+
+
+def _diag_indices(h, w, offset):
+    """Row/col indices of the offset diagonal of an [h, w] matrix."""
+    n = builtins.min(h - builtins.max(-offset, 0),
+                     w - builtins.max(offset, 0))
+    i = jnp.arange(builtins.max(n, 0))
+    return i + builtins.max(-offset, 0), i + builtins.max(offset, 0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """In-place diagonal fill (reference: ops.yaml fill_diagonal)."""
+    def fn(a):
+        r, c = _diag_indices(a.shape[-2], a.shape[-1], offset)
+        return a.at[..., r, c].set(value)
+    return x._inplace_update(fn(x._data))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor ``y`` onto x's (dim1, dim2) diagonal."""
+    def fn(a, b):
+        perm = [i for i in range(a.ndim) if i not in (dim1 % a.ndim,
+                                                      dim2 % a.ndim)]
+        perm += [dim1 % a.ndim, dim2 % a.ndim]
+        at = jnp.transpose(a, perm)
+        r, c = _diag_indices(at.shape[-2], at.shape[-1], offset)
+        at = at.at[..., r, c].set(b)
+        inv = [perm.index(i) for i in range(a.ndim)]
+        return jnp.transpose(at, inv)
+    return eager_apply("fill_diagonal_tensor", fn, (x, y), {})
+
+
+def gammaln(x, name=None):
+    return eager_apply("gammaln",
+                       lambda a: jax.scipy.special.gammaln(a), (x,), {})
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return eager_apply("gammaincc",
+                       lambda a, b: jax.scipy.special.gammaincc(a, b),
+                       (x, y), {})
+
+
+def gammainc(x, y, name=None):
+    return eager_apply("gammainc",
+                       lambda a, b: jax.scipy.special.gammainc(a, b),
+                       (x, y), {})
+
+
+def squared_l2_norm(x, name=None):
+    return eager_apply("squared_l2_norm",
+                       lambda a: jnp.sum(jnp.square(a)), (x,), {})
+
+
+def p_norm(x, p=2.0, axis=None, epsilon=1e-12, keepdim=False, name=None):
+    def fn(a):
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
+        s = jnp.sum(jnp.abs(a) ** p, axis=_axis(axis), keepdims=keepdim)
+        return jnp.maximum(s, epsilon) ** (1.0 / p)
+    return eager_apply("p_norm", fn, (x,), {})
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (the broadcast inverse;
+    reference: ops.yaml reduce_as)."""
+    def fn(a, t):
+        extra = a.ndim - t.ndim
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i in range(a.ndim)
+                     if t.shape[i] == 1 and a.shape[i] != 1)
+        if axes:
+            a = jnp.sum(a, axis=axes, keepdims=True)
+        return a
+    return eager_apply("reduce_as", fn, (x, target), {})
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = _axis(axis) if axis is not None else None
+        return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+    return eager_apply("frobenius_norm", fn, (x,), {})

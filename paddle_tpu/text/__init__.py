@@ -144,3 +144,48 @@ class ViterbiDecoder:
 
 
 __all__ = ["Vocab", "TextFileDataset", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Functional form of ViterbiDecoder (reference:
+    python/paddle/text/viterbi_decode.py:31)."""
+    return ViterbiDecoder(transition_params, include_bos_eos_tag)(
+        potentials, lengths)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance between id sequences (reference: ops.yaml
+    edit_distance, edit_distance_kernel.cc). Host-side DP over the
+    (short) label axis — this is a metric, not a training op."""
+    import numpy as np
+    a = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    b = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    if a.ndim == 1:
+        a, b = a[None], b[None]
+    il = (np.asarray(input_length.numpy() if hasattr(input_length, "numpy")
+                     else input_length) if input_length is not None
+          else np.full(a.shape[0], a.shape[1]))
+    ll = (np.asarray(label_length.numpy() if hasattr(label_length, "numpy")
+                     else label_length) if label_length is not None
+          else np.full(b.shape[0], b.shape[1]))
+    drop = set(ignored_tokens or ())
+    out = np.zeros((a.shape[0], 1), np.float32)
+    seq_num = a.shape[0]
+    for i in range(seq_num):
+        s1 = [t for t in a[i, :il[i]] if t not in drop]
+        s2 = [t for t in b[i, :ll[i]] if t not in drop]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = dp[n]
+        out[i, 0] = d / max(n, 1) if normalized else d
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray([seq_num]))

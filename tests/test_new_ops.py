@@ -1,0 +1,158 @@
+"""Round-2 op additions: cummax/cummin fix, math extras, paddle.signal,
+spatial transformer pair, beam/text utils, incubate segment + weight-only
+int8 ops (reference parity oracles are numpy/scipy compositions)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_cummax_cummin_indices():
+    x = paddle.to_tensor(np.array([1.0, 3.0, 2.0, 5.0, 4.0], np.float32))
+    v, i = paddle.cummax(x, axis=0)
+    np.testing.assert_array_equal(v.numpy(), [1, 3, 3, 5, 5])
+    np.testing.assert_array_equal(i.numpy(), [0, 1, 1, 3, 3])
+    v, i = paddle.cummin(x, axis=0)
+    np.testing.assert_array_equal(v.numpy(), [1, 1, 1, 1, 1])
+    np.testing.assert_array_equal(i.numpy(), [0, 0, 0, 0, 0])
+    # 2-D on axis 1
+    m = paddle.to_tensor(np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]],
+                                  np.float32))
+    v, i = paddle.cummax(m, axis=1)
+    np.testing.assert_array_equal(v.numpy(), [[3, 3, 3], [0, 5, 5]])
+    np.testing.assert_array_equal(i.numpy(), [[0, 0, 0], [0, 1, 1]])
+
+
+def test_math_extras():
+    rng = np.random.default_rng(0)
+    np.testing.assert_allclose(
+        paddle.logit(paddle.to_tensor(np.array([0.25], np.float32))).numpy(),
+        np.log(0.25 / 0.75), rtol=1e-6)
+    a = paddle.to_tensor(rng.standard_normal((2, 3, 4)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal((2, 4, 5)).astype(np.float32))
+    inp = paddle.to_tensor(rng.standard_normal((2, 3, 5)).astype(np.float32))
+    np.testing.assert_allclose(
+        paddle.baddbmm(inp, a, b, beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp.numpy() + 2.0 * np.matmul(a.numpy(), b.numpy()), rtol=1e-5)
+    m = paddle.to_tensor(np.zeros((3, 3), np.float32))
+    paddle.tensor.math.fill_diagonal_(m, 7.0)
+    assert np.trace(m.numpy()) == 21
+    r = paddle.renorm(paddle.to_tensor(np.ones((2, 4), np.float32) * 3),
+                      p=2.0, axis=0, max_norm=1.0)
+    np.testing.assert_allclose(np.linalg.norm(r.numpy()[0]), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.gammaln(paddle.to_tensor(np.array([4.0], np.float32))).numpy(),
+        np.log(6.0), rtol=1e-5)
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    t = paddle.to_tensor(np.zeros((3, 1), np.float32))
+    np.testing.assert_allclose(paddle.reduce_as(x, t).numpy(),
+                               x.numpy().sum(0).sum(-1, keepdims=True))
+    fx = paddle.to_tensor(rng.standard_normal((3, 4)).astype(np.float32))
+    np.testing.assert_allclose(paddle.frobenius_norm(fx).numpy(),
+                               np.linalg.norm(fx.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.p_norm(fx, p=3.0).numpy(),
+        (np.abs(fx.numpy()) ** 3).sum() ** (1 / 3), rtol=1e-5)
+
+
+def test_signal_roundtrip_and_grad():
+    sig = np.random.default_rng(3).standard_normal(400).astype(np.float32)
+    fr = paddle.signal.frame(paddle.to_tensor(sig), 64, 32)
+    assert fr.shape == [64, 11]
+    w = np.hanning(65)[:-1].astype(np.float32)
+    S = paddle.signal.stft(paddle.to_tensor(sig), 64, 32,
+                           window=paddle.to_tensor(w))
+    y = paddle.signal.istft(S, 64, 32, window=paddle.to_tensor(w),
+                            length=400)
+    np.testing.assert_allclose(y.numpy(), sig, atol=1e-4)
+    # batched + differentiable
+    sb = np.random.default_rng(7).standard_normal((2, 256)).astype(np.float32)
+    t = paddle.to_tensor(sb)
+    t.stop_gradient = False
+    Sb = paddle.signal.stft(t, 64, 16, window=paddle.to_tensor(w))
+    mag = paddle.real(Sb * paddle.conj(Sb)).sum()
+    mag.backward()
+    assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+
+
+def test_affine_grid_sample_pair():
+    theta = paddle.to_tensor(
+        np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+    grid = F.affine_grid(theta, (2, 3, 5, 5))
+    img = paddle.to_tensor(
+        np.random.default_rng(4).standard_normal((2, 3, 5, 5))
+        .astype(np.float32))
+    np.testing.assert_allclose(F.grid_sample(img, grid).numpy(), img.numpy(),
+                               atol=1e-5)
+    # horizontal flip via theta
+    flip = paddle.to_tensor(
+        np.tile(np.array([[-1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
+    out = F.grid_sample(img, F.affine_grid(flip, (2, 3, 5, 5)))
+    np.testing.assert_allclose(out.numpy(), img.numpy()[..., ::-1],
+                               atol=1e-5)
+
+
+def test_gather_tree_backtrace():
+    # the reference docstring example (nn/functional/extension.py:149)
+    ids = paddle.to_tensor(np.array(
+        [[[2, 2], [6, 1]], [[3, 9], [5, 1]], [[0, 1], [9, 0]]], np.int64))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]], np.int64))
+    out = F.gather_tree(ids, parents).numpy()
+    np.testing.assert_array_equal(
+        out, [[[2, 2], [1, 6]], [[3, 3], [5, 1]], [[0, 1], [9, 0]]])
+
+
+def test_incubate_segment_and_weight_only():
+    from paddle_tpu.incubate.nn.functional import (
+        segment_sum, segment_mean, segment_max, segment_min,
+        weight_quantize, weight_only_linear)
+    d = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(segment_sum(d, ids).numpy(), [[4, 6], [5, 6]])
+    np.testing.assert_allclose(segment_mean(d, ids).numpy(),
+                               [[2, 3], [5, 6]])
+    np.testing.assert_allclose(segment_max(d, ids).numpy(), [[3, 4], [5, 6]])
+    np.testing.assert_allclose(segment_min(d, ids).numpy(), [[1, 2], [5, 6]])
+
+    rng = np.random.default_rng(5)
+    w = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    wq, ws = weight_quantize(w)
+    assert str(wq.numpy().dtype) == "int8"
+    got = weight_only_linear(x, wq, weight_scale=ws).numpy()
+    ref = x.numpy() @ w.numpy()
+    assert np.abs(np.asarray(got) - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_text_edit_distance_and_viterbi():
+    from paddle_tpu.text import edit_distance, viterbi_decode
+    d, n = edit_distance(
+        paddle.to_tensor(np.array([[1, 2, 3, 0]], np.int64)),
+        paddle.to_tensor(np.array([[1, 3, 3, 9]], np.int64)),
+        normalized=False)
+    assert float(d.numpy()[0, 0]) == 2.0
+    # viterbi on a deterministic chain
+    trans = np.array([[0.0, -10.0], [-10.0, 0.0]], np.float32)
+    emis = np.array([[[5.0, 0.0], [5.0, 0.0], [0.0, 5.0]]], np.float32)
+    scores, path = viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([3])), include_bos_eos_tag=False)
+    assert path.numpy().shape == (1, 3)
+
+
+def test_margin_cross_entropy_zero_margin_matches_ce():
+    rng = np.random.default_rng(0)
+    cos = np.clip(rng.standard_normal((4, 10)) * 0.3, -1, 1).astype(np.float32)
+    lbl = rng.integers(0, 10, (4,))
+    m = float(F.margin_cross_entropy(
+        paddle.to_tensor(cos), paddle.to_tensor(lbl, dtype="int64"),
+        margin1=1.0, margin2=0.0, margin3=0.0, scale=10.0).numpy())
+    ref = float(F.cross_entropy(paddle.to_tensor(cos * 10.0),
+                                paddle.to_tensor(lbl, dtype="int64")).numpy())
+    assert abs(m - ref) < 1e-5
+    m2 = float(F.margin_cross_entropy(
+        paddle.to_tensor(cos), paddle.to_tensor(lbl, dtype="int64"),
+        margin2=0.5, scale=10.0).numpy())
+    assert m2 > m  # the margin makes the target class harder
