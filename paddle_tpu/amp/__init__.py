@@ -9,3 +9,4 @@ On TPU the natural compute dtype is bfloat16 — no loss scaling needed — but
 """
 from .auto_cast import auto_cast, amp_guard, decorate, amp_state, WHITE_LIST, BLACK_LIST  # noqa: F401
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import debugging  # noqa: F401

@@ -76,12 +76,32 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
              master_weight=None, save_dtype=None, master_grad=False):
     """O2 decoration: cast model params to the AMP dtype
     (reference: python/paddle/amp/auto_cast.py amp_decorate). Optimizer state
-    stays fp32 (master weights) by construction in paddle_tpu.optimizer."""
+    stays fp32 (master weights) by construction in paddle_tpu.optimizer.
+
+    master_grad=True upcasts every parameter gradient to fp32 the moment it
+    accumulates (reference: master_grad in amp_decorate + eager_gen hooks),
+    so grad clipping and the optimizer update run in fp32 even though the
+    low-precision parameters produce low-precision cotangents; the final
+    update casts back to the parameter dtype inside the optimizer kernels.
+    """
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
     if level == "O2":
         for m in model_list:
             m._cast_params(dtype=dtype)
+    if master_grad:
+        import jax.numpy as jnp
+
+        def _upcast(g):
+            if g._data.dtype != jnp.float32 and jnp.issubdtype(
+                    g._data.dtype, jnp.floating):
+                g._data = g._data.astype(jnp.float32)
+            return g
+
+        for m in model_list:
+            for p in m.parameters():
+                if not p.stop_gradient:
+                    p._grad_hooks.append(_upcast)
     if optimizers is None:
         return models if single else model_list
     return (models if single else model_list), optimizers

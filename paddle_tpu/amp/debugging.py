@@ -1,0 +1,132 @@
+"""AMP debugging tooling (reference: python/paddle/amp/debugging.py —
+operator stats collection, tensor checking, accuracy compare).
+
+- ``collect_operator_stats()``: context that counts, per op, how many calls
+  ran at each input dtype — the tool for answering "which ops actually hit
+  the bf16 path under this AMP config".
+- ``enable_tensor_checker`` / ``disable_tensor_checker``: the
+  TensorCheckerConfig surface mapped onto the framework's NaN/Inf
+  sanitizers (eager sweep + compiled fused check, FLAGS_check_nan_inf).
+- ``compare_accuracy``: tensor-dict diff report (the reference compares
+  fp32-vs-fp16 run dumps; here any two state/output dicts).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from contextlib import contextmanager
+
+from ..core import dispatch as _dispatch
+from ..core.flags import set_flags
+
+
+class _OpStats:
+    def __init__(self):
+        self.counts: Counter = Counter()
+
+    def record(self, name, dtypes, cast_to=None):
+        shown = "/".join(sorted(set(dtypes))) or "-"
+        if cast_to is not None:
+            import numpy as np
+            shown = f"{shown}->{np.dtype(cast_to).name}"  # the AMP cast
+        self.counts[(name, shown)] += 1
+
+    def summary(self):
+        """[(op, dtypes, count)] sorted by count desc."""
+        return [(op, dt, c) for (op, dt), c in
+                sorted(self.counts.items(), key=lambda kv: -kv[1])]
+
+    def report(self) -> str:
+        lines = ["op".ljust(36) + "input dtypes".ljust(24) + "calls"]
+        for op, dt, c in self.summary():
+            lines.append(op.ljust(36) + dt.ljust(24) + str(c))
+        return "\n".join(lines)
+
+
+@contextmanager
+def collect_operator_stats():
+    """Count per-op, per-dtype executions inside the context (reference:
+    debugging.py collect_operator_stats / enable_operator_stats_collection).
+
+    Usage::
+        with paddle.amp.debugging.collect_operator_stats() as stats:
+            model(x)
+        print(stats.report())
+    """
+    stats = _OpStats()
+    prev = _dispatch.OP_STATS_HOOK
+    _dispatch.OP_STATS_HOOK = stats.record
+    try:
+        yield stats
+    finally:
+        _dispatch.OP_STATS_HOOK = prev
+
+
+def enable_operator_stats_collection():
+    stats = _OpStats()
+    _dispatch.OP_STATS_HOOK = stats.record
+    return stats
+
+
+def disable_operator_stats_collection():
+    stats_hook = _dispatch.OP_STATS_HOOK
+    _dispatch.OP_STATS_HOOK = None
+    return stats_hook
+
+
+class TensorCheckerConfig:
+    """Reference TensorCheckerConfig surface; debug_mode maps onto the
+    framework sanitizers (CHECK_NAN_INF_AND_ABORT is the implemented
+    mode)."""
+
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(config: TensorCheckerConfig | None = None):
+    if config is None or config.enable:
+        set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(run_a: dict, run_b: dict, rtol=1e-3, atol=1e-5,
+                     output_path=None):
+    """Compare two tensor dicts (e.g. an fp32 and an amp run's outputs);
+    returns [(key, max_abs_diff, max_rel_diff, ok)] and optionally writes a
+    text report (reference: debugging.py compare_accuracy over run dumps)."""
+    import numpy as np
+
+    rows = []
+    for k in sorted(set(run_a) & set(run_b)):
+        a = np.asarray(run_a[k].numpy() if hasattr(run_a[k], "numpy")
+                       else run_a[k], dtype=np.float64)
+        b = np.asarray(run_b[k].numpy() if hasattr(run_b[k], "numpy")
+                       else run_b[k], dtype=np.float64)
+        if a.shape != b.shape:
+            rows.append((k, float("inf"), float("inf"), False))
+            continue
+        diff = np.abs(a - b)
+        mad = float(diff.max()) if diff.size else 0.0
+        mrd = float((diff / (np.abs(b) + 1e-12)).max()) if diff.size else 0.0
+        ok = bool(np.allclose(a, b, rtol=rtol, atol=atol))
+        rows.append((k, mad, mrd, ok))
+    missing = sorted(set(run_a) ^ set(run_b))
+    if output_path:
+        with open(output_path, "w") as f:
+            for k, mad, mrd, ok in rows:
+                f.write(f"{k}\t{mad:.3e}\t{mrd:.3e}\t"
+                        f"{'OK' if ok else 'DIFF'}\n")
+            for k in missing:
+                f.write(f"{k}\tMISSING\n")
+    return rows
+
+
+__all__ = ["collect_operator_stats", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "TensorCheckerConfig",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "compare_accuracy"]

@@ -64,27 +64,15 @@ def get_window(window, win_length, fftbins=True):
 
 def stft(x, n_fft=512, hop_length=None, win_length=None, window="hann",
          center=True, pad_mode="reflect"):
-    """[.., T] -> complex [.., n_fft//2+1, frames]."""
-    hop_length = hop_length or n_fft // 4
+    """[.., T] -> complex [.., n_fft//2+1, frames]. One STFT lowering for
+    the whole framework: this resolves the named window and delegates to
+    paddle_tpu.signal.stft."""
+    from ..signal import stft as signal_stft
     win_length = win_length or n_fft
     w = jnp.asarray(get_window(window, win_length))
-    if win_length < n_fft:
-        pad = (n_fft - win_length) // 2
-        w = jnp.pad(w, (pad, n_fft - win_length - pad))
-
-    def fn(sig):
-        s = sig
-        if center:
-            pads = [(0, 0)] * (s.ndim - 1) + [(n_fft // 2, n_fft // 2)]
-            s = jnp.pad(s, pads, mode=pad_mode)
-        n_frames = 1 + (s.shape[-1] - n_fft) // hop_length
-        idx = (jnp.arange(n_frames)[:, None] * hop_length
-               + jnp.arange(n_fft)[None, :])
-        frames = s[..., idx] * w                       # [.., frames, n_fft]
-        spec = jnp.fft.rfft(frames, axis=-1)
-        return jnp.swapaxes(spec, -1, -2)              # [.., bins, frames]
-
-    return _apply("stft", fn, x if isinstance(x, Tensor) else Tensor(x))
+    return signal_stft(x if isinstance(x, Tensor) else Tensor(x), n_fft,
+                       hop_length=hop_length, win_length=win_length,
+                       window=Tensor(w), center=center, pad_mode=pad_mode)
 
 
 class Spectrogram(Layer):

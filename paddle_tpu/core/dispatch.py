@@ -42,6 +42,10 @@ def _maybe_amp_cast(name, vals):
 # so the disabled-path cost is a single None check per op.
 PROFILE_HOOK = None
 
+# Set by paddle_tpu.amp.debugging while operator-stats collection is active:
+# fn(op_name, [input dtype strings]). One None check per op when disabled.
+OP_STATS_HOOK = None
+
 
 def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
     """Execute ``pure_fn`` over a mixed Tensor/array argument tree.
@@ -62,6 +66,17 @@ def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
 def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
     tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    if OP_STATS_HOOK is not None:
+        from ..amp.auto_cast import _state as _amp_s
+        cast_to = None   # the dtype AMP will cast float inputs to, if any
+        if _amp_s.enabled:
+            if name in _amp_s.white:
+                cast_to = _amp_s.dtype
+            elif name in _amp_s.black:
+                cast_to = jnp.float32
+        OP_STATS_HOOK(name,
+                      [str(flat[i]._data.dtype) for i in tensor_idx],
+                      cast_to)
     record = autograd.is_grad_enabled() and any(
         not flat[i].stop_gradient for i in tensor_idx
     )

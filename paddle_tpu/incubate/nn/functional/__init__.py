@@ -219,6 +219,10 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         raise NotImplementedError(
             f"weight_only_linear supports weight_dtype='int8'; got "
             f"{weight_dtype!r} (int4 packing not implemented)")
+    if weight_scale is None:
+        raise ValueError(
+            "weight_only_linear requires weight_scale (the per-out-channel "
+            "scales returned by weight_quantize)")
     def fn(a, q, s, *b):
         import jax.numpy as jnp
         w = q.astype(a.dtype) * s.reshape(1, -1).astype(a.dtype)

@@ -19,8 +19,9 @@ __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Slice overlapping frames: [..., T] -> [..., frame_length, n_frames]
-    (axis=-1; axis=0 puts frames first, matching the reference layout)."""
+    """Slice overlapping frames (reference: signal.py frame):
+    axis=-1 -> [..., frame_length, n_frames];
+    axis=0  -> [n_frames, frame_length, ...]."""
     if frame_length <= 0 or hop_length <= 0:
         raise ValueError("frame_length and hop_length must be positive")
     if axis not in (0, -1):
@@ -33,16 +34,19 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
                 f"frame_length {frame_length} > signal length {t}")
         n = 1 + (t - frame_length) // hop_length
         starts = jnp.arange(n) * hop_length
-        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
         if axis == -1:
+            idx = starts[None, :] + jnp.arange(frame_length)[:, None]
             return a[..., idx]                    # [..., L, n]
-        return a[idx]                             # [L, n, ...]
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return a[idx]                             # [n, L, ...]
 
     return eager_apply("frame", fn, (x,), {})
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame: [..., frame_length, n_frames] -> [..., T]."""
+    """Inverse of frame: [..., frame_length, n_frames] -> [..., T]
+    (axis=-1) or [n_frames, frame_length, ...] -> [T, ...] (axis=0).
+    One scatter-add over the same index matrix frame() gathers with."""
     if axis not in (0, -1):
         raise ValueError(f"overlap_add supports axis 0 or -1, got {axis}")
 
@@ -50,25 +54,28 @@ def overlap_add(x, hop_length, axis=-1, name=None):
         if axis == -1:
             length, n = a.shape[-2], a.shape[-1]
             t = (n - 1) * hop_length + length
+            idx = jnp.arange(length)[:, None] + \
+                (jnp.arange(n) * hop_length)[None, :]      # [L, n]
             out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
-            for i in range(n):   # static n: unrolled scatter-adds fuse
-                out = out.at[..., i * hop_length:i * hop_length + length].add(
-                    a[..., :, i])
-            return out
-        length, n = a.shape[0], a.shape[1]
+            return out.at[..., idx].add(a)
+        length, n = a.shape[1], a.shape[0]
         t = (n - 1) * hop_length + length
+        idx = (jnp.arange(n) * hop_length)[:, None] + \
+            jnp.arange(length)[None, :]                    # [n, L]
         out = jnp.zeros((t,) + a.shape[2:], a.dtype)
-        for i in range(n):
-            out = out.at[i * hop_length:i * hop_length + length].add(a[:, i])
-        return out
+        return out.at[idx].add(a)
 
     return eager_apply("overlap_add", fn, (x,), {})
 
 
-def _window_array(window, n_fft):
+def _window_array(window, n_fft, win_length=None):
+    """Resolve the analysis window: default = rectangular of win_length,
+    centered and zero-padded to n_fft (the reference's semantics)."""
+    win_length = win_length or n_fft
     if window is None:
-        return jnp.ones((n_fft,), jnp.float32)
-    w = window._data if hasattr(window, "_data") else jnp.asarray(window)
+        w = jnp.ones((win_length,), jnp.float32)
+    else:
+        w = window._data if hasattr(window, "_data") else jnp.asarray(window)
     if w.shape[0] != n_fft:
         pad = (n_fft - w.shape[0]) // 2
         w = jnp.pad(w, (pad, n_fft - w.shape[0] - pad))
@@ -82,7 +89,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     (reference: signal.py stft)."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    w = _window_array(window, n_fft)
+    w = _window_array(window, n_fft, win_length)
 
     def fn(sig, w):
         s = sig
@@ -110,7 +117,7 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     reference: signal.py istft)."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    w = _window_array(window, n_fft)
+    w = _window_array(window, n_fft, win_length)
 
     if return_complex and onesided:
         raise ValueError(
@@ -130,13 +137,14 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         frames = frames * w                            # synthesis window
         n = frames.shape[-2]
         t = (n - 1) * hop_length + n_fft
+        idx = (jnp.arange(n) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]                      # [n, n_fft]
         out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
-        env = jnp.zeros((t,), frames.dtype)
-        wsq = w * w
-        for i in range(n):
-            sl = slice(i * hop_length, i * hop_length + n_fft)
-            out = out.at[..., sl].add(frames[..., i, :])
-            env = env.at[sl].add(wsq)
+        out = out.at[..., idx].add(frames)
+        env_dtype = frames.real.dtype if jnp.iscomplexobj(frames) \
+            else frames.dtype
+        env = jnp.zeros((t,), env_dtype).at[idx].add(
+            jnp.broadcast_to(w * w, (n, n_fft)).astype(env_dtype))
         out = out / jnp.maximum(env, 1e-11)
         if center:
             # padded[pad + i] = original[i]: trim the leading pad, keep the

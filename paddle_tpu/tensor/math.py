@@ -407,8 +407,18 @@ def _diag_indices(h, w, offset):
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
-    """In-place diagonal fill (reference: ops.yaml fill_diagonal)."""
+    """In-place diagonal fill (reference: ops.yaml fill_diagonal).
+    wrap=True restarts the diagonal every width+1 rows of a tall matrix
+    (numpy fill_diagonal semantics the reference kernel follows)."""
     def fn(a):
+        if wrap and a.ndim == 2 and offset == 0:
+            h, w = a.shape
+            flat_idx = jnp.arange(0, h * w, w + 1)
+            return a.reshape(-1).at[flat_idx].set(value).reshape(h, w)
+        if wrap and offset != 0:
+            raise NotImplementedError(
+                "fill_diagonal_(wrap=True) with a nonzero offset is not "
+                "supported")
         r, c = _diag_indices(a.shape[-2], a.shape[-1], offset)
         return a.at[..., r, c].set(value)
     return x._inplace_update(fn(x._data))

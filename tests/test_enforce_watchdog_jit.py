@@ -141,3 +141,48 @@ class TestCompiledNanCheck:
         finally:
             paddle.set_flags({"FLAGS_check_nan_inf": False})
         assert np.isfinite(l1) and l2 < l1
+
+
+class TestAmpDebugging:
+    def test_operator_stats_collection(self):
+        import paddle_tpu.amp.debugging as dbg
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        with dbg.collect_operator_stats() as stats:
+            with paddle.amp.auto_cast(enable=True, dtype="bfloat16"):
+                y = paddle.matmul(x, paddle.to_tensor(
+                    np.ones((3, 4), np.float32)))
+            _ = paddle.tanh(y)
+        ops = {op for op, _, _ in stats.summary()}
+        assert "matmul" in ops and "tanh" in ops
+        # the white-listed matmul was cast to bf16 under autocast
+        mm = [dt for op, dt, _ in stats.summary() if op == "matmul"]
+        assert any("->bfloat16" in d for d in mm), mm
+        assert "calls" in stats.report()
+
+    def test_master_grad_upcasts(self):
+        lin = paddle.nn.Linear(4, 4)
+        paddle.amp.decorate(lin, level="O2", dtype="bfloat16",
+                            master_grad=True)
+        assert str(lin.weight._data.dtype) == "bfloat16"
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        assert str(lin.weight.grad._data.dtype) == "float32"
+
+    def test_compare_accuracy(self):
+        import paddle_tpu.amp.debugging as dbg
+        a = {"w": np.ones((3,), np.float32)}
+        b = {"w": np.ones((3,), np.float32) * (1 + 1e-6), "extra": 1}
+        rows = dbg.compare_accuracy(a, b)
+        assert rows[0][0] == "w" and rows[0][3] is True
+        bad = dbg.compare_accuracy(a, {"w": np.zeros((3,), np.float32)})
+        assert bad[0][3] is False
+
+    def test_tensor_checker_maps_to_flags(self):
+        import paddle_tpu.amp.debugging as dbg
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig())
+        try:
+            with pytest.raises(FloatingPointError):
+                paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+        finally:
+            dbg.disable_tensor_checker()
