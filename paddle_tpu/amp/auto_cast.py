@@ -90,12 +90,13 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
         for m in model_list:
             m._cast_params(dtype=dtype)
     if master_grad:
-        import jax.numpy as jnp
-
         def _upcast(g):
+            # cast THROUGH the eager op layer (returns a new tape tensor)
+            # so create_graph double backward sees a recorded cast, not a
+            # mutated buffer with a stale bfloat16 aval
             if g._data.dtype != jnp.float32 and jnp.issubdtype(
                     g._data.dtype, jnp.floating):
-                g._data = g._data.astype(jnp.float32)
+                return g.astype("float32")
             return g
 
         for m in model_list:

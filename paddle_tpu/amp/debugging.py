@@ -68,9 +68,10 @@ def enable_operator_stats_collection():
 
 
 def disable_operator_stats_collection():
+    """Stops collection and returns the _OpStats collected so far."""
     stats_hook = _dispatch.OP_STATS_HOOK
     _dispatch.OP_STATS_HOOK = None
-    return stats_hook
+    return getattr(stats_hook, "__self__", None)
 
 
 class TensorCheckerConfig:

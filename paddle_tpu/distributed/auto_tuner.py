@@ -155,13 +155,15 @@ class AutoTuner:
         trials = self.candidates[:max_trials] if max_trials else self.candidates
         for cand in trials:
             t0 = time.time()
+            err = None
             try:
                 metric = run_fn(cand)
                 ok = True
-            except Exception as e:
-                metric, ok = None, False
+            except Exception as e:  # OOM/compile failure: record, keep going
+                metric, ok, err = None, False, f"{type(e).__name__}: {e}"
             self.history.append({"candidate": dict(cand), "metric": metric,
-                                 "ok": ok, "elapsed": time.time() - t0})
+                                 "ok": ok, "error": err,
+                                 "elapsed": time.time() - t0})
             if not ok or metric is None:
                 continue
             better = best_metric is None or (

@@ -19,7 +19,7 @@ from .loss import (  # noqa: F401
     binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
     cosine_embedding_loss, triplet_margin_loss, hinge_embedding_loss,
     square_error_cost, sigmoid_focal_loss, ctc_loss,
-    fused_linear_cross_entropy, margin_cross_entropy,
+    fused_linear_cross_entropy, margin_cross_entropy, hsigmoid_loss,
 )
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,

@@ -355,3 +355,71 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
             return loss, jax.nn.softmax(adjusted.astype(jnp.float32), -1)
         return loss
     return eager_apply("margin_cross_entropy", fn, (logits, label), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: nn/functional/loss.py
+    hsigmoid_loss, hierarchical_sigmoid kernels). Default tree: the
+    complete binary tree over num_classes whose leaf for class c sits at
+    heap slot c + num_classes - 1; the path to the root visits
+    ceil(log2(C)) internal nodes, walked vectorized in-graph (static depth,
+    data-dependent gathers — TPU-friendly). Custom trees come in as
+    path_table/path_code [N, L] with negative entries masked.
+
+    weight: [num_classes - 1, feature]; bias: [num_classes - 1].
+    Returns [N, 1] per-sample losses (the reference's layout).
+    """
+    if is_sparse:
+        raise NotImplementedError(
+            "is_sparse=True selects the SelectedRows grad kernel in the "
+            "reference; grads are dense here by design")
+
+    def fn(x, lbl, w, *rest):
+        i = 0
+        b = None
+        if bias is not None:
+            b = rest[i]
+            i += 1
+        if path_table is not None:
+            tbl = rest[i]
+            code = rest[i + 1]
+            mask = (tbl >= 0).astype(x.dtype)
+            safe = jnp.maximum(tbl, 0).astype(jnp.int32)
+        else:
+            import math
+            c = lbl.reshape(-1).astype(jnp.int32)
+            n_leaf_base = num_classes - 1
+            depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+            node = c + n_leaf_base          # heap leaf slot
+            tbl_l, code_l, mask_l = [], [], []
+            for _ in range(depth):
+                parent = (node - 1) // 2
+                is_right = (node == 2 * parent + 2)
+                valid = node > 0
+                tbl_l.append(jnp.where(valid, parent, 0))
+                code_l.append(jnp.where(valid, is_right, False))
+                mask_l.append(valid)
+                node = jnp.where(valid, parent, 0)
+            safe = jnp.stack(tbl_l, axis=1)             # [N, L] node ids
+            code = jnp.stack(code_l, axis=1)
+            mask = jnp.stack(mask_l, axis=1).astype(x.dtype)
+
+        wp = w[safe]                                    # [N, L, D]
+        z = jnp.einsum("nd,nld->nl", x, wp)
+        if b is not None:
+            z = z + b.reshape(-1)[safe]
+        y = code.astype(x.dtype)
+        # stable BCE-with-logits on (z, code)
+        per_node = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        return (per_node * mask).sum(axis=1, keepdims=True)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        if path_code is None:
+            raise ValueError("path_table requires path_code")
+        args += [path_table, path_code]
+    return eager_apply("hsigmoid_loss", fn, tuple(args), {})

@@ -11,6 +11,7 @@ from ..core.tensor import Tensor, to_tensor  # noqa: F401
 from .creation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
+from .array import create_array, array_length, array_read, array_write  # noqa: F401
 from .linalg import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403

@@ -1,0 +1,59 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py +
+paddle/phi/core/tensor_array.h).
+
+In dygraph the reference's TensorArray IS a Python list of tensors
+(array.py treats list inputs exactly so); the static-graph LoDTensorArray
+variable has no analog here because jit tracing unrolls Python lists
+directly. ``paddle.tensor.create_array/array_write/array_read/
+array_length`` therefore operate on plain lists, matching the reference's
+dygraph branch semantics (sparse growth pads with empty slots)."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """Reference: array.py create_array — dygraph returns a list."""
+    out = list(initialized_list) if initialized_list is not None else []
+    for t in out:
+        if not isinstance(t, Tensor):
+            raise TypeError(
+                f"create_array initialized_list must hold Tensors, got "
+                f"{type(t).__name__}")
+    return out
+
+
+def _index(i):
+    if isinstance(i, Tensor):
+        return int(i.numpy().reshape(-1)[0])
+    return int(i)
+
+
+def array_length(array):
+    if not isinstance(array, list):
+        raise TypeError("array_length expects a TensorArray (list)")
+    return len(array)
+
+
+def array_read(array, i):
+    if not isinstance(array, list):
+        raise TypeError("array_read expects a TensorArray (list)")
+    idx = _index(i)
+    if idx >= len(array):
+        raise IndexError(f"array_read index {idx} >= length {len(array)}")
+    return array[idx]
+
+
+def array_write(x, i, array=None):
+    """Write ``x`` at slot ``i``; growing writes pad with None slots
+    (the reference's sparse-growth behavior)."""
+    if array is None:
+        array = []
+    idx = _index(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+__all__ = ["create_array", "array_length", "array_read", "array_write"]

@@ -156,3 +156,75 @@ def test_margin_cross_entropy_zero_margin_matches_ce():
         paddle.to_tensor(cos), paddle.to_tensor(lbl, dtype="int64"),
         margin2=0.5, scale=10.0).numpy())
     assert m2 > m  # the margin makes the target class harder
+
+
+def test_tensor_array_ops():
+    """TensorArray surface (reference: python/paddle/tensor/array.py dygraph
+    branch — a list of tensors)."""
+    arr = paddle.create_array()
+    t0 = paddle.to_tensor(np.array([1.0], np.float32))
+    t1 = paddle.to_tensor(np.array([2.0], np.float32))
+    paddle.array_write(t0, 0, arr)
+    paddle.array_write(t1, 3, arr)           # sparse growth pads
+    assert paddle.array_length(arr) == 4
+    assert float(paddle.array_read(arr, 0).numpy()[0]) == 1.0
+    assert float(paddle.array_read(
+        arr, paddle.to_tensor(np.array([3]))).numpy()[0]) == 2.0
+    assert arr[1] is None
+    with pytest.raises(IndexError):
+        paddle.array_read(arr, 7)
+    init = paddle.create_array(initialized_list=[t0, t1])
+    assert paddle.array_length(init) == 2
+
+
+def test_hsigmoid_loss_default_tree():
+    rng = np.random.default_rng(0)
+    N, D, C = 6, 8, 10
+    x = paddle.to_tensor(rng.standard_normal((N, D)).astype(np.float32))
+    x.stop_gradient = False
+    lbl = paddle.to_tensor(rng.integers(0, C, (N,)), dtype="int64")
+    w = paddle.to_tensor(
+        (rng.standard_normal((C - 1, D)) * 0.1).astype(np.float32))
+    w.stop_gradient = False
+    loss = F.hsigmoid_loss(x, lbl, C, w)
+    assert loss.shape == [N, 1]
+    loss.sum().backward()
+    assert x.grad is not None and w.grad is not None
+
+    # oracle: host heap walk for sample 0
+    def path(c):
+        n = c + C - 1
+        out = []
+        while n > 0:
+            p = (n - 1) // 2
+            out.append((p, 1.0 if n == 2 * p + 2 else 0.0))
+            n = p
+        return out
+
+    c0 = int(lbl.numpy()[0])
+    want = 0.0
+    for pnode, code in path(c0):
+        z = float(np.asarray(x.numpy())[0] @ np.asarray(w.numpy())[pnode])
+        want += max(z, 0) - z * code + np.log1p(np.exp(-abs(z)))
+    np.testing.assert_allclose(float(loss.numpy()[0, 0]), want, rtol=1e-5)
+
+
+def test_hsigmoid_loss_custom_path():
+    rng = np.random.default_rng(1)
+    N, D = 3, 4
+    x = paddle.to_tensor(rng.standard_normal((N, D)).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([0, 1, 2]), dtype="int64")
+    w = paddle.to_tensor(
+        (rng.standard_normal((5, D)) * 0.1).astype(np.float32))
+    tbl = paddle.to_tensor(np.array(
+        [[0, 1, -1], [0, 2, 3], [0, 2, 4]], np.int64))
+    code = paddle.to_tensor(np.array(
+        [[0, 1, 0], [1, 0, 1], [1, 1, 0]], np.int64))
+    loss = F.hsigmoid_loss(x, lbl, 3, w, path_table=tbl, path_code=code)
+    assert loss.shape == [N, 1]
+    # masked slot (-1) contributes nothing: recompute row 0 with 2 nodes
+    z0 = float(np.asarray(x.numpy())[0] @ np.asarray(w.numpy())[0])
+    z1 = float(np.asarray(x.numpy())[0] @ np.asarray(w.numpy())[1])
+    want = (max(z0, 0) - 0 + np.log1p(np.exp(-abs(z0)))
+            + max(z1, 0) - z1 + np.log1p(np.exp(-abs(z1))))
+    np.testing.assert_allclose(float(loss.numpy()[0, 0]), want, rtol=1e-5)
