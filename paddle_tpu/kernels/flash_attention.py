@@ -370,6 +370,23 @@ def flash_attention(q, k, v, causal=False, scale=None,
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     assert hq % hkv == 0, f"GQA needs hq % hkv == 0, got {hq}, {hkv}"
+    from .autotune import autotune_enabled, get_autotuner
+    if autotune_enabled():
+        # runtime block-size selection with a per-shape winner cache
+        # (reference: phi/kernels/autotune switch_autotune.h + cache.h)
+        cands = [{"block_q": bq, "block_k": bk}
+                 for bq in sorted({min(b, sq) for b in (128, 256, 512)})
+                 for bk in sorted({min(b, sk) for b in (128, 256, 512)})
+                 if sq % bq == 0 and sk % bk == 0]
+        cfg = get_autotuner().pick(
+            key=("flash_attention", tuple(q.shape), tuple(k.shape),
+                 str(q.dtype), bool(causal), bool(interpret)),
+            candidates=cands,
+            build_fn=lambda c: (lambda: _flash(
+                q, k, v, float(scale or 1.0 / math.sqrt(d)), bool(causal),
+                int(min(c["block_q"], sq)), int(min(c["block_k"], sk)),
+                bool(interpret))))
+        block_q, block_k = cfg["block_q"], cfg["block_k"]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     assert sq % block_q == 0 and sk % block_k == 0, (

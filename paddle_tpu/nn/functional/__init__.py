@@ -26,7 +26,7 @@ from .common import (  # noqa: F401
     label_smooth, interpolate, upsample, pixel_shuffle, pixel_unshuffle,
     channel_shuffle, cosine_similarity, pairwise_distance, unfold, fold,
     bilinear, zeropad2d, pad,
-    affine_grid, grid_sample, gather_tree,
+    affine_grid, grid_sample, gather_tree, class_center_sample,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,

@@ -321,3 +321,46 @@ def gather_tree(ids, parents, name=None):
         return toks[::-1]
 
     return eager_apply("gather_tree", fn, (ids, parents), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """PartialFC class-center sampling (reference:
+    nn/functional/common.py:2372): keep every positive class present in
+    ``label`` plus a uniform unique sample of negatives, num_samples total.
+    Returns (remapped_label, sampled_class_index) — labels remapped into
+    the sampled set's index space, sampled indices sorted ascending.
+    Static shapes: positives are ranked ahead of a random permutation of
+    the remaining classes and the top num_samples win."""
+    if group is not None:
+        raise NotImplementedError(
+            "class_center_sample over a model-parallel group is not "
+            "implemented; sample locally per class shard")
+    if num_samples > num_classes:
+        raise ValueError(
+            f"num_samples {num_samples} > num_classes {num_classes}")
+    key = _rng.next_key()
+
+    def fn(lbl):
+        flat = lbl.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((num_classes,), jnp.int32).at[flat].set(1)
+        try:  # eager (concrete): dropped positives would corrupt the remap
+            npos = int(pos.sum())
+            if npos > num_samples:
+                raise ValueError(
+                    f"label batch holds {npos} distinct classes > "
+                    f"num_samples {num_samples}; every positive class "
+                    "center must be kept (PartialFC contract)")
+        except jax.errors.ConcretizationTypeError:
+            pass  # traced: caller must size num_samples >= batch positives
+        # rank: positives first (score >= num_classes), then a random
+        # permutation of negatives; top-k is unique by construction
+        noise = jax.random.permutation(key, num_classes)
+        score = pos * (2 * num_classes) + noise
+        _, sampled = jax.lax.top_k(score, num_samples)
+        sampled = jnp.sort(sampled)
+        # remap: position of each label in the sorted sampled set
+        remap = jnp.searchsorted(sampled, flat).astype(lbl.dtype)
+        return remap.reshape(lbl.shape), sampled.astype(lbl.dtype)
+
+    return eager_apply("class_center_sample", fn, (label,), {})

@@ -228,3 +228,24 @@ def test_hsigmoid_loss_custom_path():
     want = (max(z0, 0) - 0 + np.log1p(np.exp(-abs(z0)))
             + max(z1, 0) - z1 + np.log1p(np.exp(-abs(z1))))
     np.testing.assert_allclose(float(loss.numpy()[0, 0]), want, rtol=1e-5)
+
+
+def test_class_center_sample():
+    """PartialFC sampling (reference: nn/functional/common.py:2372): all
+    positives kept, unique sample, labels remapped into the sampled
+    index space; over-full positive sets raise instead of corrupting."""
+    paddle.seed(0)
+    lbl = paddle.to_tensor(np.array([2, 7, 2, 31, 15], np.int64))
+    remap, sampled = F.class_center_sample(lbl, 40, 8)
+    s = np.asarray(sampled.numpy())
+    r = np.asarray(remap.numpy())
+    assert len(set(s.tolist())) == 8
+    for c in (2, 7, 31, 15):
+        assert c in s.tolist()
+    for orig, new in zip(np.asarray(lbl.numpy()), r):
+        assert s[new] == orig
+    with pytest.raises(ValueError, match="num_samples"):
+        F.class_center_sample(lbl, 4, 8)
+    with pytest.raises(ValueError, match="distinct classes"):
+        F.class_center_sample(
+            paddle.to_tensor(np.arange(10, dtype=np.int64)), 40, 4)

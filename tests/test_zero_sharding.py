@@ -130,6 +130,10 @@ def test_stage3_param_consumed_without_full_materialization():
 def test_group_sharded_parallel_levels_place_state():
     """API-level: group_sharded_parallel('p_g_os') leaves params/opt states
     sharded over the sharding axis."""
+    # group_sharded_parallel reads the ambient fleet topology; another
+    # test's fleet.init (sharding degree 1) must not leak into this one
+    from paddle_tpu.distributed.fleet import topology as _topo
+    _topo._hcg = None
     mesh = ProcessMesh(np.arange(8), ["sharding"])
     m = paddle.nn.Linear(64, 64)
     opt = paddle.optimizer.AdamW(parameters=m.parameters(),

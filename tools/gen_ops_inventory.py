@@ -113,8 +113,6 @@ put("bce_loss kldiv_loss log_loss hinge_loss identity_loss "
     "sigmoid_cross_entropy_with_logits cross_entropy_with_softmax", "as",
     "nn/functional/loss.py (binary_cross_entropy[_with_logits], kl_div, "
     "softmax_with_cross_entropy; log/hinge via square_error_cost family)")
-put("class_center_sample", "todo",
-    "class-center sampling for margin losses: not yet implemented")
 put("warpctc warprnnt", "as",
     "nn/functional/loss.py ctc_loss (lax.scan forward algorithm); rnnt "
     "loss todo")
