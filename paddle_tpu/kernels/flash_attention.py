@@ -378,6 +378,12 @@ def flash_attention(q, k, v, causal=False, scale=None,
                  for bq in sorted({min(b, sq) for b in (128, 256, 512)})
                  for bk in sorted({min(b, sk) for b in (128, 256, 512)})
                  if sq % bq == 0 and sk % bk == 0]
+        # the caller's explicit (valid) blocks always compete, so enabling
+        # autotune can never break or silently override a working call
+        explicit = {"block_q": min(block_q, sq), "block_k": min(block_k, sk)}
+        if sq % explicit["block_q"] == 0 and sk % explicit["block_k"] == 0 \
+                and explicit not in cands:
+            cands.insert(0, explicit)
         cfg = get_autotuner().pick(
             key=("flash_attention", tuple(q.shape), tuple(k.shape),
                  str(q.dtype), bool(causal), bool(interpret)),

@@ -7,7 +7,7 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
-    lp_pool1d, lp_pool2d,
+    lp_pool1d, lp_pool2d, max_unpool2d,
 )
 from .norm import (  # noqa: F401
     layer_norm, rms_norm, batch_norm, group_norm, instance_norm, normalize,
@@ -30,4 +30,5 @@ from .common import (  # noqa: F401
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,
+    flash_attn_unpadded, flash_attn_varlen_func,
 )

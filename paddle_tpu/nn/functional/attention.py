@@ -146,3 +146,64 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         r = jnp.arange(m)
         return (r[None, :] < lens[..., None]).astype(to_jax_dtype(dtype))
     return eager_apply("sequence_mask", fn, (x,), {})
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        name=None):
+    """Varlen (packed) attention (reference: ops.yaml flash_attn_unpadded,
+    flash_attn_varlen kernels): sequences concatenated on the token axis
+    [total_tokens, heads, head_dim] with boundaries in cu_seqlens — tokens
+    attend only within their own segment (+ causal inside the segment).
+
+    Composed XLA formulation: the segment mask is derived from cu_seqlens
+    via searchsorted, so one masked softmax serves every packing. (The
+    reference's CUDA varlen kernel avoids materializing cross-segment
+    scores; on TPU a Pallas variant can reuse kernels/flash_attention's
+    block engine with a per-block segment check when profiles demand it.)
+    """
+    if dropout:
+        raise NotImplementedError("flash_attn_unpadded: dropout TODO")
+
+    def fn(q, k, v, cu_q, cu_k):
+        tq, h, d = q.shape
+        tk = k.shape[0]
+        hkv = k.shape[1]
+        if h != hkv:
+            rep = h // hkv
+            k2 = jnp.repeat(k, rep, axis=1)
+            v2 = jnp.repeat(v, rep, axis=1)
+        else:
+            k2, v2 = k, v
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right")
+        seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right")
+        logits = jnp.einsum("qhd,khd->hqk", q, k2) * s
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            # end-aligned per-segment causality (the flash-attn varlen
+            # convention): query at in-segment position pq sees keys up to
+            # pq + (len_k - len_q), so a 1-token decode query attends its
+            # whole KV segment even when the q/k packings differ
+            z_q = jnp.zeros((1,), cu_q.dtype)
+            starts_q = jnp.concatenate([z_q, cu_q])
+            starts_k = jnp.concatenate([z_q.astype(cu_k.dtype), cu_k])
+            lens_q = (starts_q[1:] - starts_q[:-1])[seg_q]
+            lens_k = (starts_k[1:] - starts_k[:-1])[seg_k]
+            pos_q = jnp.arange(tq) - starts_q[seg_q]
+            pos_k = jnp.arange(tk) - starts_k[seg_k]
+            limit = pos_q[:, None] + (lens_k[None, :] - lens_q[:, None])
+            mask = mask & (pos_k[None, :] <= limit)
+        logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+        out = jnp.einsum("hqk,khd->qhd", probs, v2)
+        if return_softmax:
+            return out, probs
+        return out
+
+    return eager_apply("flash_attn_unpadded", fn,
+                       (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+
+
+flash_attn_varlen_func = flash_attn_unpadded

@@ -359,8 +359,12 @@ def class_center_sample(label, num_classes, num_samples, group=None,
         score = pos * (2 * num_classes) + noise
         _, sampled = jax.lax.top_k(score, num_samples)
         sampled = jnp.sort(sampled)
-        # remap: position of each label in the sorted sampled set
-        remap = jnp.searchsorted(sampled, flat).astype(lbl.dtype)
+        # remap: position of each label in the sorted sampled set; a label
+        # whose class was dropped (possible only when the eager guard above
+        # was skipped under tracing) maps to -1, never to a wrong class
+        remap = jnp.searchsorted(sampled, flat)
+        hit = sampled[jnp.clip(remap, 0, num_samples - 1)] == flat
+        remap = jnp.where(hit, remap, -1).astype(lbl.dtype)
         return remap.reshape(lbl.shape), sampled.astype(lbl.dtype)
 
     return eager_apply("class_center_sample", fn, (label,), {})

@@ -198,3 +198,32 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
         s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
         return s ** (1.0 / p)
     return eager_apply("lp_pool2d", fn, (x,), {})
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values back
+    to their argmax positions (reference: ops.yaml unpool,
+    unpool_kernel.cc). indices are the global flat positions the pool's
+    mask produced."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW")
+    k = _pair(kernel_size, 2)
+    s = _pair(stride if stride is not None else kernel_size, 2)
+    p = _pair(padding, 2)
+
+    def fn(a, idx):
+        n, c, oh, ow = a.shape
+        if output_size is not None:
+            H, W = int(output_size[-2]), int(output_size[-1])
+        else:
+            H = (oh - 1) * s[0] - 2 * p[0] + k[0]
+            W = (ow - 1) * s[1] - 2 * p[1] + k[1]
+        flat_vals = a.reshape(n * c, oh * ow)
+        flat_idx = idx.reshape(n * c, oh * ow).astype(jnp.int32)
+        out = jnp.zeros((n * c, H * W), a.dtype)
+        rows = jnp.arange(n * c)[:, None]
+        out = out.at[rows, flat_idx].set(flat_vals)
+        return out.reshape(n, c, H, W)
+
+    return eager_apply("max_unpool2d", fn, (x, indices), {})

@@ -249,3 +249,49 @@ def test_class_center_sample():
     with pytest.raises(ValueError, match="distinct classes"):
         F.class_center_sample(
             paddle.to_tensor(np.arange(10, dtype=np.int64)), 40, 4)
+
+
+def test_max_unpool2d_roundtrip():
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
+    pooled, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
+    rec = F.max_unpool2d(pooled, mask, 2, stride=2)
+    assert rec.shape == [1, 2, 4, 4]
+    r = np.asarray(rec.numpy())
+    pm = np.asarray(pooled.numpy())
+    assert np.sum(r != 0) == pm.size
+    p2, _ = F.max_pool2d(rec, 2, stride=2, return_mask=True)
+    np.testing.assert_allclose(np.asarray(p2.numpy()), pm)
+
+
+def test_flash_attn_unpadded_matches_per_sequence():
+    rng = np.random.default_rng(0)
+    lens = [5, 3, 8]
+    T, h, d = sum(lens), 4, 16
+    q = rng.standard_normal((T, h, d)).astype(np.float32)
+    k = rng.standard_normal((T, h, d)).astype(np.float32)
+    v = rng.standard_normal((T, h, d)).astype(np.float32)
+    cu = np.cumsum(lens)
+    out = np.asarray(F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True).numpy())
+    off = 0
+    for L in lens:
+        qs, ks, vs = (t[off:off + L][None].transpose(0, 2, 1, 3)
+                      for t in (q, k, v))
+        lg = np.einsum("bhqd,bhkd->bhqk", qs, ks) / np.sqrt(d)
+        m = np.tril(np.ones((L, L), bool))
+        lg = np.where(m, lg, -1e30)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, vs)[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(out[off:off + L], ref, rtol=2e-4,
+                                   atol=2e-4)
+        off += L
+    # no attention ever crosses a segment boundary: perturbing sequence 0
+    # must not change sequence 1's outputs
+    q2 = q.copy()
+    q2[:lens[0]] += 1.0
+    out2 = np.asarray(F.flash_attn_unpadded(
+        paddle.to_tensor(q2), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True).numpy())
+    np.testing.assert_allclose(out2[lens[0]:], out[lens[0]:], rtol=1e-5)

@@ -116,13 +116,14 @@ put("bce_loss kldiv_loss log_loss hinge_loss identity_loss "
 put("warpctc warprnnt", "as",
     "nn/functional/loss.py ctc_loss (lax.scan forward algorithm); rnnt "
     "loss todo")
-put("flash_attn flash_attn_qkvpacked flash_attn_unpadded "
+put("flash_attn flash_attn_qkvpacked "
     "flash_attn_varlen_qkvpacked flashmask_attention "
     "memory_efficient_attention sparse_attention calc_reduced_attn_scores",
     "as",
-    "F.flash_attention / F.scaled_dot_product_attention + "
-    "kernels/flash_attention.py (Pallas) + kernels/paged_attention.py; "
-    "varlen/qkvpacked variants todo")
+    "F.flash_attention / F.scaled_dot_product_attention / "
+    "F.flash_attn_unpadded (varlen segments) + kernels/flash_attention.py "
+    "(Pallas) + kernels/paged_attention.py; qkvpacked layouts unpack "
+    "trivially")
 put("masked_multihead_attention_", "as",
     "models/generation.py decode step + kernels/paged_attention.py")
 put("fused_batch_norm_act fused_bn_add_activation fused_gemm_epilogue "
@@ -134,8 +135,8 @@ put("bicubic_interp bilinear_interp linear_interp nearest_interp "
     "trilinear_interp", "as", "F.interpolate(mode=...)")
 put("pool2d pool3d max_pool2d_with_index max_pool3d_with_index "
     "fractional_max_pool2d fractional_max_pool3d unpool unpool3d", "as",
-    "nn/functional/pooling.py (avg/max/adaptive; return_mask variant); "
-    "fractional + unpool todo")
+    "nn/functional/pooling.py (avg/max/adaptive; return_mask variant; "
+    "max_unpool2d scatter inverse); fractional + 3-D unpool todo")
 put("depthwise_conv2d depthwise_conv2d_transpose", "as",
     "F.conv2d(groups=in_channels) - XLA lowers grouped conv to the "
     "depthwise path")
