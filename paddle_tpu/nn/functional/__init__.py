@@ -31,4 +31,5 @@ from .common import (  # noqa: F401
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,
     flash_attn_unpadded, flash_attn_varlen_func,
+    flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
 )

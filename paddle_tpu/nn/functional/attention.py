@@ -207,3 +207,60 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 
 flash_attn_varlen_func = flash_attn_unpadded
+
+
+def _unpack_qkv(qkv, token_axes):
+    """Reference packed layout [..., g + 2, num_heads_k, head_dim] where
+    g = num_heads / num_heads_k (flash_attention.py:603): the leading g
+    slices are the grouped query heads, the last two are K and V."""
+    d = qkv.shape[-1]
+    q = qkv[(slice(None),) * token_axes + (slice(None, -2),)]
+    q = q.reshape(list(qkv.shape[:token_axes]) + [-1, d])
+    k = qkv[(slice(None),) * token_axes + (-2,)]
+    v = qkv[(slice(None),) * token_axes + (-1,)]
+    return q, k, v
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         *, fixed_seed_offset=None, rng_name="",
+                         training=True, name=None):
+    """Packed-QKV attention (reference: flash_attention.py:603
+    flash_attn_qkvpacked): qkv [batch, seq, g + 2, num_heads_k, head_dim]
+    (GQA: g query-head groups + K + V) -> (out, softmax|None)."""
+    q, k, v = _unpack_qkv(qkv, token_axes=2)
+    g = qkv.shape[2] - 2
+    if g > 1:
+        # query head j (= group * num_heads_k + kv) attends kv head
+        # j % num_heads_k: tiling the kv heads g times aligns them
+        import paddle_tpu.tensor as _T
+        k = _T.tile(k, [1, 1, g, 1])
+        v = _T.tile(v, [1, 1, g, 1])
+    out = scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                       dropout_p=dropout, training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, *,
+                                fixed_seed_offset=None, rng_name="",
+                                training=True, name=None):
+    """Varlen packed-QKV (reference: flash_attention.py:1011):
+    qkv [total_tokens, g + 2, num_heads_k, head_dim] with the reference's
+    (cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k, scale, ...)
+    signature. Returns (out, softmax|None)."""
+    q, k, v = _unpack_qkv(qkv, token_axes=1)
+    g = qkv.shape[1] - 2
+    if g > 1:
+        # packed flattening pairs query head j with kv head j % num_heads_k
+        # (see flash_attn_qkvpacked); pre-tile so flash_attn_unpadded's
+        # grouped (j // rep) GQA path never engages with the wrong pairing
+        import paddle_tpu.tensor as _T
+        k = _T.tile(k, [1, g, 1])
+        v = _T.tile(v, [1, g, 1])
+    out = flash_attn_unpadded(
+        q, k, v, cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k, scale=scale,
+        dropout=dropout if training else 0.0, causal=causal)
+    return out, None

@@ -295,3 +295,31 @@ def test_flash_attn_unpadded_matches_per_sequence():
         paddle.to_tensor(q2), paddle.to_tensor(k), paddle.to_tensor(v),
         paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True).numpy())
     np.testing.assert_allclose(out2[lens[0]:], out[lens[0]:], rtol=1e-5)
+
+
+def test_qkvpacked_attention_wrappers():
+    """Reference packed layout [.., g + 2, num_heads_k, head_dim]
+    (flash_attention.py:603): g grouped query slices + K + V."""
+    rng = np.random.default_rng(0)
+    # MHA: g=1 -> axis size 3, 4 kv heads
+    qkv = paddle.to_tensor(
+        rng.standard_normal((2, 8, 3, 4, 16)).astype(np.float32))
+    out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+    ref = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                         qkv[:, :, 2], is_causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-5)
+    # GQA: 4 q heads over 2 kv heads -> axis size g+2 = 4
+    gqkv = paddle.to_tensor(
+        rng.standard_normal((2, 8, 4, 2, 16)).astype(np.float32))
+    gout, _ = F.flash_attn_qkvpacked(gqkv, causal=True)
+    assert gout.shape == [2, 8, 4, 16]  # g * num_heads_k query heads
+
+    pk = paddle.to_tensor(
+        rng.standard_normal((12, 3, 2, 16)).astype(np.float32))
+    cu = paddle.to_tensor(np.array([5, 12]))
+    out2, _ = F.flash_attn_varlen_qkvpacked(pk, cu, cu, causal=True)
+    ref2 = F.flash_attn_unpadded(pk[:, 0], pk[:, 1], pk[:, 2], cu, cu,
+                                 causal=True)
+    np.testing.assert_allclose(np.asarray(out2.numpy()),
+                               np.asarray(ref2.numpy()), rtol=1e-5)
