@@ -12,11 +12,13 @@ execution regimes, matching how TPU programs are actually written:
    reference's device-side NCCL kernels.
 2. **Eager, multi-process** (after ``init_parallel_env`` under the launch
    CLI): each process holds its own local value; collectives really
-   communicate across processes — reductions/gathers ride a jitted global
-   all-gather over the process-spanning device mesh
-   (jax.experimental.multihost_utils), and p2p send/recv uses the
-   coordination-service key-value store (the TCPStore analog) as a
-   mailbox. This is the regime the reference's ProcessGroup tests exercise
+   communicate across processes. Global-group reductions/gathers ride a
+   jitted all-gather over the process-spanning device mesh
+   (jax.experimental.multihost_utils); strict-subgroup collectives and p2p
+   send/recv use the coordination-service key-value store (the TCPStore
+   analog) as a mailbox, so — like the reference's ProcessGroup — only the
+   group's member ranks need to enter the call. This is the regime the
+   reference's ProcessGroup tests exercise
    (test/legacy_test/test_collective_api_base.py:192).
 3. **Eager, single process**: world size 1 — the identity semantics of
    every collective are then exact, not a stub.
@@ -123,15 +125,147 @@ def _group_index(group, rank, what="rank"):
     return ranks.index(rank)
 
 
+def _is_global(ranks) -> bool:
+    return set(ranks) == set(range(get_world_size()))
+
+
+def _nonmember_noop(group) -> bool:
+    """Reference semantics (_warn_cur_rank_not_in_group,
+    python/paddle/distributed/communication/group.py): a rank outside the
+    group warns and no-ops the collective instead of raising."""
+    ranks = _group_ranks(group)
+    if get_rank() in ranks:
+        return False
+    import warnings
+    warnings.warn(f"rank {get_rank()} is not in group ranks={ranks}; "
+                  "the collective is a no-op on this rank")
+    return True
+
+
+_coll_seq: dict[tuple, int] = {}
+
+
+def _group_tag(gkey) -> str:
+    """KV prefix distinguishing groups by BOTH id and member ranks —
+    groups that share pg_id (e.g. ad-hoc Group objects with the default
+    id=0) must not collide on coordination-service keys."""
+    import zlib
+    return f"{gkey[0]}-{zlib.crc32(repr(gkey[1]).encode()) & 0xFFFFFFFF:x}"
+
+
+def _subgroup_exchange(payload, group, ranks):
+    """True subgroup all-gather over the coordination-service KV store:
+    ONLY the group's members call (reference ProcessGroup semantics —
+    process_group.h requires just the group's ranks to enter a collective,
+    so an mp-subgroup all_reduce must not block on unrelated ranks).
+
+    Each member publishes its pickled payload under a (group, seq, rank)
+    key, then blocking-reads every peer's key. A member's key from two
+    rounds back is deleted when it publishes round ``seq``: reaching round
+    ``seq`` means every peer finished round ``seq-1``, which required their
+    reads of round ``seq-2`` — so the store stays bounded at 2 rounds.
+    Returns the payloads in group-rank order.
+    """
+    me = get_rank()
+    if me not in ranks:
+        raise ValueError(f"rank {me} called a collective on group "
+                         f"ranks={ranks} it is not a member of")
+    client = _kv_client()
+    gkey = (group.id if group is not None else 0, tuple(ranks))
+    seq = _coll_seq.get(gkey, 0)
+    _coll_seq[gkey] = seq + 1
+    prefix = f"ptpu_coll/{_group_tag(gkey)}"
+    blob = base64.b64encode(pickle.dumps(payload)).decode()
+    client.key_value_set(f"{prefix}/{seq}/{me}", blob)
+    if seq >= 2:
+        try:
+            client.key_value_delete(f"{prefix}/{seq - 2}/{me}")
+        except Exception:
+            pass
+    from .watchdog import maybe_track
+    out = []
+    for r in ranks:
+        if r == me:
+            out.append(payload)
+            continue
+        with maybe_track("subgroup_exchange",
+                         meta={"rank": me, "peer": r, "seq": seq}):
+            raw = client.blocking_key_value_get(f"{prefix}/{seq}/{r}",
+                                                120_000)
+        out.append(pickle.loads(base64.b64decode(raw)))
+    return out
+
+
+_bcast_src_hist: dict[tuple, dict[int, int]] = {}
+
+
+def _subgroup_bcast(payload, group, ranks, src):
+    """Direct subgroup broadcast over the KV store: src publishes once and
+    each member reads only src's key — O(n) coordination-service RPCs
+    instead of routing through the full O(n^2) exchange. Readers ack each
+    round; before publishing round ``seq`` the current src blocking-reads
+    every READER ack from round ``seq-2`` (using that round's recorded src
+    — it may differ) and only then deletes that round's keys, so a slow
+    reader can never find its key already garbage-collected."""
+    me = get_rank()
+    client = _kv_client()
+    gkey = (group.id if group is not None else 0, tuple(ranks))
+    skey = (gkey, "bcast")
+    seq = _coll_seq.get(skey, 0)
+    _coll_seq[skey] = seq + 1
+    hist = _bcast_src_hist.setdefault(skey, {})
+    hist[seq] = src
+    prefix = f"ptpu_coll/{_group_tag(gkey)}/b"
+    from .watchdog import maybe_track
+    if me == src:
+        if seq >= 2:
+            old = seq - 2
+            old_src = hist.pop(old, src)
+            for r in ranks:
+                # readers of round `old` wrote acks; its src did not.
+                # `me` skips its own ack — reaching here means it finished.
+                if r == old_src or r == me:
+                    continue
+                with maybe_track("subgroup_bcast_ack",
+                                 meta={"rank": me, "peer": r, "seq": old}):
+                    client.blocking_key_value_get(
+                        f"{prefix}/{old}/ack{r}", 120_000)
+                try:
+                    client.key_value_delete(f"{prefix}/{old}/ack{r}")
+                except Exception:
+                    pass
+            for k in (f"{prefix}/{old}/{old_src}", f"{prefix}/{old}/ack{me}"):
+                try:
+                    client.key_value_delete(k)
+                except Exception:
+                    pass
+        blob = base64.b64encode(pickle.dumps(payload)).decode()
+        client.key_value_set(f"{prefix}/{seq}/{src}", blob)
+        return payload
+    hist.pop(seq - 2, None)
+    with maybe_track("subgroup_bcast",
+                     meta={"rank": me, "src": src, "seq": seq}):
+        raw = client.blocking_key_value_get(f"{prefix}/{seq}/{src}", 120_000)
+    client.key_value_set(f"{prefix}/{seq}/ack{me}", "1")
+    return pickle.loads(base64.b64decode(raw))
+
+
 def _gather_rows(a, group):
-    """Host all-gather: rows [r, ...] of every rank's local value, restricted
-    to the group's ranks (rows gathered globally, then selected)."""
+    """Host all-gather of every group rank's local value, as rows.
+
+    Global group: one jitted all-gather over the process-spanning mesh
+    (fast path — rides ICI/DCN). Strict-subset group: the KV-mailbox
+    subgroup exchange, so only members participate."""
+    ranks = _group_ranks(group)
+    arr = np.asarray(a)
+    if not _is_global(ranks):
+        return np.stack(_subgroup_exchange(arr, group, ranks))
     from jax.experimental import multihost_utils
     from .watchdog import maybe_track
     with maybe_track("process_allgather",
                      meta={"rank": get_rank(), "shape": np.shape(a)}):
-        rows = multihost_utils.process_allgather(np.asarray(a))
-    return np.stack([rows[r] for r in _group_ranks(group)])
+        rows = multihost_utils.process_allgather(arr)
+    return np.stack([rows[r] for r in ranks])
 
 
 def _np_reduce(rows, op):
@@ -166,6 +300,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             if op == ReduceOp.PROD:
                 return jnp.exp(lax.psum(jnp.log(a), axis))
         if _mp_active():
+            if _nonmember_noop(group):
+                return a
             out = _np_reduce(_gather_rows(a, group), op)
             return jnp.asarray(out.astype(np.asarray(a).dtype, copy=False))
         return a  # world size 1: reduction of one value
@@ -183,6 +319,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         tensor_list.extend(parts)
         return tensor_list
     if _mp_active():
+        if _nonmember_noop(group):
+            return tensor_list
         rows = _gather_rows(tensor._data if isinstance(tensor, Tensor)
                             else tensor, group)
         tensor_list.extend(Tensor(jnp.asarray(r)) for r in rows)
@@ -194,6 +332,9 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 def _allgather_bytes(payload: bytes, group=None) -> list[bytes]:
     """Gather arbitrary bytes from every rank (length-prefixed, padded)."""
     from jax.experimental import multihost_utils
+    ranks = _group_ranks(group)
+    if not _is_global(ranks):
+        return _subgroup_exchange(payload, group, ranks)
     n = len(payload)
     lens = multihost_utils.process_allgather(np.asarray([n], np.int32))
     cap = int(lens.max())
@@ -208,6 +349,8 @@ def _allgather_bytes(payload: bytes, group=None) -> list[bytes]:
 
 def all_gather_object(obj_list, obj, group=None):
     if _mp_active():
+        if _nonmember_noop(group):
+            return obj_list
         for blob in _allgather_bytes(pickle.dumps(obj), group):
             obj_list.append(pickle.loads(blob))
         return obj_list
@@ -227,6 +370,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         tensor._data = out
         return tensor
     if _mp_active():
+        if _nonmember_noop(group):
+            return tensor
         a = ins._data if isinstance(ins, Tensor) else jnp.concatenate(
             [t._data for t in ins], axis=0)
         rows = _gather_rows(a, group)
@@ -252,6 +397,8 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             out_tensor_list.append(Tensor(out[i]))
         return out_tensor_list
     if _mp_active():
+        if _nonmember_noop(group):
+            return out_tensor_list
         stacked = np.stack([np.asarray(t._data) for t in in_tensor_list])
         rows = _gather_rows(stacked, group)       # [n, n, ...]
         ranks = _group_ranks(group)
@@ -266,11 +413,17 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """(process_group.h Broadcast)."""
     if _mp_active():
+        if _nonmember_noop(group):
+            return tensor
         _group_index(group, src, what="src")
-        from jax.experimental import multihost_utils
         a = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
-        val = jnp.asarray(multihost_utils.broadcast_one_to_all(
-            a, is_source=get_rank() == src))
+        ranks = _group_ranks(group)
+        if not _is_global(ranks):
+            val = jnp.asarray(_subgroup_bcast(a, group, ranks, src))
+        else:
+            from jax.experimental import multihost_utils
+            val = jnp.asarray(multihost_utils.broadcast_one_to_all(
+                a, is_source=get_rank() == src))
         if isinstance(tensor, Tensor):
             tensor._data = val
             return tensor
@@ -286,6 +439,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if _mp_active():
+        if _nonmember_noop(group):
+            return tensor
         # src's list is authoritative: broadcast it, pick own chunk
         # only src's list travels: non-src ranks contribute a tiny None blob
         payload = pickle.dumps([np.asarray(t._data) for t in tensor_list]
@@ -367,6 +522,12 @@ def recv(tensor, src=0, group=None, sync_op=True):
 
 def barrier(group=None):
     if _mp_active():
+        if _nonmember_noop(group):
+            return
+        ranks = _group_ranks(group)
+        if not _is_global(ranks):
+            _subgroup_exchange(b"", group, ranks)
+            return
         from jax.experimental import multihost_utils
         from .watchdog import maybe_track
         with maybe_track("barrier", meta={"rank": get_rank()}):
@@ -430,9 +591,21 @@ def init_parallel_env():
     return _default_group
 
 
+_group_counters: dict[tuple, int] = {}
+
+
 def new_group(ranks=None, backend=None, axis_name=None):
-    return Group(ranks if ranks is not None else list(range(get_world_size())),
-                 axis_name=axis_name, pg_id=np.random.randint(1 << 30))
+    """Deterministic pg_id (crc32 of ranks + per-ranks creation counter):
+    every process creating the same sequence of groups derives the same
+    ids, so subgroup KV-mailbox collectives agree on their key prefix
+    across processes (the reference assigns ring ids the same way — all
+    ranks must call new_group in the same order)."""
+    import zlib
+    r = tuple(ranks) if ranks is not None else tuple(range(get_world_size()))
+    n = _group_counters.get(r, 0)
+    _group_counters[r] = n + 1
+    pg_id = zlib.crc32(repr((r, n)).encode()) & 0x7FFFFFFF
+    return Group(list(r), axis_name=axis_name, pg_id=pg_id)
 
 
 def destroy_process_group(group=None):

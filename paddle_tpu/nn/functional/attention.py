@@ -19,8 +19,12 @@ from ...core.tensor import Tensor
 
 
 def _sdpa_reference(q, k, v, *rest, causal=False, dropout_p=0.0, scale=None,
-                    dropout_key=None):
-    """Pure attention body. q,k,v: [batch, seq, heads, head_dim] (paddle layout)."""
+                    dropout_key=None, return_probs=False):
+    """Pure attention body. q,k,v: [batch, seq, heads, head_dim] (paddle layout).
+
+    ``return_probs=True`` additionally returns the [b, h, sq, sk] softmax
+    actually used for the output (post-dropout, like the reference kernels'
+    saved softmax) — the (out, probs) pair is always consistent."""
     attn_mask = rest[0] if rest else None
     qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
     kh = jnp.swapaxes(k, 1, 2)
@@ -42,7 +46,10 @@ def _sdpa_reference(q, k, v, *rest, causal=False, dropout_p=0.0, scale=None,
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
-    return jnp.swapaxes(out, 1, 2)  # back to [b, s, h, d]
+    out = jnp.swapaxes(out, 1, 2)  # back to [b, s, h, d]
+    if return_probs:
+        return out, probs
+    return out
 
 
 OPS.setdefault("scaled_dot_product_attention", _sdpa_reference)
@@ -64,10 +71,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
                     fixed_seed_offset=None, rng_name="", training=True, name=None):
-    """API parity with paddle.nn.functional.flash_attention.flash_attention."""
-    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    """API parity with paddle.nn.functional.flash_attention.flash_attention.
+
+    ``return_softmax=True`` computes out and probs in ONE pass through the
+    reference body (probs are the post-dropout weights the output actually
+    used), bypassing any registered fast-path kernel for this debug mode."""
     if return_softmax:
-        return out, None
+        from ...core import random as _rng
+        p = dropout if training else 0.0
+        dk = _rng.next_key() if p > 0.0 else None
+        return eager_apply(
+            "flash_attention_with_probs",
+            lambda *xs: _sdpa_reference(*xs, causal=causal, dropout_p=p,
+                                        dropout_key=dk, return_probs=True),
+            (query, key, value), {})
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
     return out, None
 
 
@@ -230,11 +248,15 @@ def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
     q, k, v = _unpack_qkv(qkv, token_axes=2)
     g = qkv.shape[2] - 2
     if g > 1:
-        # query head j (= group * num_heads_k + kv) attends kv head
-        # j % num_heads_k: tiling the kv heads g times aligns them
+        # the packed q [g, hk, d] flattens row-major, and the reference FA2
+        # kernel pairs flattened query head j with kv head j // g
+        # (contiguous groups — flash_attn_kernel.cu FlashAttnQKVPackedKernel)
         import paddle_tpu.tensor as _T
-        k = _T.tile(k, [1, 1, g, 1])
-        v = _T.tile(v, [1, 1, g, 1])
+        k = _T.repeat_interleave(k, g, axis=2)
+        v = _T.repeat_interleave(v, g, axis=2)
+    if return_softmax:
+        return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                               return_softmax=True, training=training)
     out = scaled_dot_product_attention(q, k, v, is_causal=causal,
                                        dropout_p=dropout, training=training)
     return out, None
@@ -251,16 +273,14 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
     (cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k, scale, ...)
     signature. Returns (out, softmax|None)."""
     q, k, v = _unpack_qkv(qkv, token_axes=1)
-    g = qkv.shape[1] - 2
-    if g > 1:
-        # packed flattening pairs query head j with kv head j % num_heads_k
-        # (see flash_attn_qkvpacked); pre-tile so flash_attn_unpadded's
-        # grouped (j // rep) GQA path never engages with the wrong pairing
-        import paddle_tpu.tensor as _T
-        k = _T.tile(k, [1, g, 1])
-        v = _T.tile(v, [1, g, 1])
+    # flash_attn_unpadded's native GQA path pairs flattened query head j
+    # with kv head j // g (jnp.repeat, contiguous groups) — the reference
+    # FA2 convention for the row-major packed flattening
     out = flash_attn_unpadded(
         q, k, v, cu_seqlens_q, cu_seqlens_k,
         max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k, scale=scale,
-        dropout=dropout if training else 0.0, causal=causal)
+        dropout=dropout if training else 0.0, causal=causal,
+        return_softmax=return_softmax)
+    if return_softmax:
+        return out
     return out, None

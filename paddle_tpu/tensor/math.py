@@ -242,7 +242,14 @@ def _cum_minmax(name, is_max, x, axis, dtype):
         def comb(prev, cur):
             pv, pi = prev
             cv, ci = cur
-            take_cur = (cv >= pv) if is_max else (cv <= pv)
+            cmp = (cv >= pv) if is_max else (cv <= pv)
+            # NaN-sticky like the reference cum_maxmin kernel: once a NaN
+            # enters the running value it stays (plain >= is False for NaN
+            # and would silently skip it)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                take_cur = jnp.isnan(cv) | (~jnp.isnan(pv) & cmp)
+            else:
+                take_cur = cmp
             return jnp.where(take_cur, cv, pv), jnp.where(take_cur, ci, pi)
 
         vals, idx = lax.associative_scan(comb, (arr, idx0), axis=ax)

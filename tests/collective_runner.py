@@ -97,6 +97,39 @@ def main():
     record("all_gather_object",
            objs == [{"rank": r, "tag": "x" * (r + 1)} for r in range(world)])
 
+    # strict-subgroup collectives: ONLY members enter the call (true
+    # ProcessGroup semantics) while the other ranks do unrelated work
+    sub = dist.new_group(ranks=[0, 1])
+    if rank in (0, 1):
+        t = paddle.to_tensor(base[rank].copy())
+        dist.all_reduce(t, group=sub)
+        record("subgroup_all_reduce",
+               np.allclose(t.numpy(), base[0] + base[1]))
+        t = paddle.to_tensor(base[rank].copy())
+        dist.broadcast(t, src=1, group=sub)
+        record("subgroup_broadcast", np.allclose(t.numpy(), base[1]))
+        # rotating src across >2 rounds exercises the GC path where round
+        # seq-2's src differs from the current src
+        ok = True
+        for i, s in enumerate([0, 1, 0, 1, 0]):
+            t = paddle.to_tensor(base[rank] + float(i))
+            dist.broadcast(t, src=s, group=sub)
+            ok = ok and np.allclose(t.numpy(), base[s] + float(i))
+        record("subgroup_broadcast_rotating_src", ok)
+        got = []
+        dist.all_gather(got, paddle.to_tensor(base[rank].copy()), group=sub)
+        record("subgroup_all_gather", len(got) == 2 and
+               np.allclose(got[0].numpy(), base[0]) and
+               np.allclose(got[1].numpy(), base[1]))
+        dist.barrier(group=sub)
+        record("subgroup_barrier", True)
+    else:
+        # non-member calling the collective: warn + no-op, value unchanged
+        # (reference _warn_cur_rank_not_in_group semantics)
+        t = paddle.to_tensor(base[rank].copy())
+        dist.all_reduce(t, group=sub)
+        record("subgroup_nonmember_noop", np.allclose(t.numpy(), base[rank]))
+
     dist.barrier()
     with open(out_path, "w") as f:
         f.write("\n".join(results) + "\n")

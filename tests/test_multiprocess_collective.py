@@ -30,22 +30,25 @@ def _launch(script, extra_env, nproc=2, timeout=180):
 
 
 @pytest.mark.slow
-def test_collectives_across_two_processes(tmp_path):
+def test_collectives_across_processes(tmp_path):
+    # 3 processes so the [0, 1] group is a STRICT subset: the subgroup
+    # KV-mailbox regime (only members call) is actually exercised
     out = str(tmp_path / "result")
     proc = _launch(os.path.join(TESTS_DIR, "collective_runner.py"),
-                   {"COLLECTIVE_OUT": out})
+                   {"COLLECTIVE_OUT": out}, nproc=3)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    for rank in (0, 1):
+    for rank in (0, 1, 2):
         body = open(f"{out}.{rank}").read().strip().splitlines()
         assert body, f"rank {rank} produced no results"
         bad = [l for l in body if not l.startswith("ok ")]
         assert not bad, f"rank {rank}: {bad}"
     names0 = {l.split()[1] for l in open(f"{out}.0").read().splitlines()}
     assert {"all_reduce_sum", "all_gather", "reduce_scatter", "broadcast",
-            "all_to_all", "scatter", "send",
-            "all_gather_object"} <= names0
+            "all_to_all", "scatter", "send", "all_gather_object",
+            "subgroup_all_reduce", "subgroup_broadcast",
+            "subgroup_all_gather", "subgroup_barrier"} <= names0
     names1 = {l.split()[1] for l in open(f"{out}.1").read().splitlines()}
-    assert "recv" in names1
+    assert "recv" in names1 and "subgroup_all_reduce" in names1
 
 
 @pytest.mark.slow

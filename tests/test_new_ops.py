@@ -323,3 +323,73 @@ def test_qkvpacked_attention_wrappers():
                                  causal=True)
     np.testing.assert_allclose(np.asarray(out2.numpy()),
                                np.asarray(ref2.numpy()), rtol=1e-5)
+
+
+def _gqa_oracle(q, k, v, causal):
+    """Per-head numpy attention; flattened query head j uses kv head j // g
+    (contiguous groups — reference FA2 GQA convention for the row-major
+    flattening of packed q [g, hk, d])."""
+    T, H, d = q.shape
+    hk = k.shape[1]
+    g = H // hk
+    out = np.zeros_like(q)
+    for j in range(H):
+        lg = q[:, j] @ k[:, j // g].T / np.sqrt(d)
+        if causal:
+            lg = np.where(np.tril(np.ones((T, T), bool)), lg, -1e30)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[:, j] = p @ v[:, j // g]
+    return out
+
+
+def test_qkvpacked_gqa_value_parity():
+    """GQA head pairing must match the reference kernel (contiguous groups,
+    j // g), not interleaved tiling (j % hk)."""
+    rng = np.random.default_rng(3)
+    b, s, g, hk, d = 2, 6, 2, 2, 8
+    qkv = rng.standard_normal((b, s, g + 2, hk, d)).astype(np.float32)
+    out, _ = F.flash_attn_qkvpacked(paddle.to_tensor(qkv), causal=True)
+    out = np.asarray(out.numpy())
+    for bi in range(b):
+        q = qkv[bi, :, :g].reshape(s, g * hk, d)
+        ref = _gqa_oracle(q, qkv[bi, :, g], qkv[bi, :, g + 1], causal=True)
+        np.testing.assert_allclose(out[bi], ref, rtol=2e-4, atol=2e-4)
+
+    # varlen wrapper, single segment == dense case
+    pk = qkv[0]  # [s, g+2, hk, d]
+    cu = paddle.to_tensor(np.array([s]))
+    vout, _ = F.flash_attn_varlen_qkvpacked(paddle.to_tensor(pk), cu, cu,
+                                            causal=True)
+    q = pk[:, :g].reshape(s, g * hk, d)
+    ref = _gqa_oracle(q, pk[:, g], pk[:, g + 1], causal=True)
+    np.testing.assert_allclose(np.asarray(vout.numpy()), ref,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_attention_return_softmax():
+    rng = np.random.default_rng(4)
+    qkv = paddle.to_tensor(
+        rng.standard_normal((1, 5, 3, 2, 8)).astype(np.float32))
+    out, probs = F.flash_attn_qkvpacked(qkv, causal=True,
+                                        return_softmax=True)
+    assert probs is not None
+    p = np.asarray(probs.numpy())
+    assert p.shape == (1, 2, 5, 5)
+    np.testing.assert_allclose(p.sum(-1), np.ones((1, 2, 5)), rtol=1e-5)
+
+    pk = paddle.to_tensor(
+        rng.standard_normal((7, 3, 2, 8)).astype(np.float32))
+    cu = paddle.to_tensor(np.array([4, 7]))
+    vout, vprobs = F.flash_attn_varlen_qkvpacked(pk, cu, cu, causal=True,
+                                                 return_softmax=True)
+    assert vprobs is not None and np.asarray(vprobs.numpy()).shape[0] == 2
+
+
+def test_cummax_nan_sticky():
+    x = paddle.to_tensor(np.array([1.0, np.nan, 0.5, 3.0], np.float32))
+    v, i = paddle.cummax(x, axis=0)
+    v = np.asarray(v.numpy())
+    assert v[0] == 1.0 and np.isnan(v[1:]).all()
+    v2, _ = paddle.cummin(x, axis=0)
+    assert np.isnan(np.asarray(v2.numpy())[1:]).all()

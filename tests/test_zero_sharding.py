@@ -92,7 +92,8 @@ def test_stage3_param_memory_shrinks_linearly():
     compiled = lowered.compile()
     assert re.search(r"param.*f32\[128,1024\]", compiled.as_text()) or \
         "f32[128,1024]" in compiled.as_text()
-    assert "f32[1024,1024]" not in compiled.as_text().split("ENTRY")[0] or True
+    # no full-parameter buffer anywhere in the partitioned module
+    assert "f32[1024,1024]" not in compiled.as_text()
 
     mem = compiled.memory_analysis()
     if mem is not None and getattr(mem, "argument_size_in_bytes", 0):
