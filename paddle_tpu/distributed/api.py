@@ -13,6 +13,8 @@ the compiler.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
@@ -24,6 +26,81 @@ from .placement import Placement, Partial, Replicate, Shard, spec_to_placements
 
 def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _put_global(a, sharding):
+    """device_put that is correct in the multi-process regime.
+
+    Single-process (or traced values, or fully-addressable shardings) this
+    IS ``jax.device_put``. When the target sharding spans non-addressable
+    devices (a launch-CLI job: one process per host, one global mesh):
+
+    - a host value / fully-replicated array is distributed by letting each
+      process materialize only its own addressable shards
+      (``make_array_from_callback`` — no process touches remote shards);
+    - an already-global jax.Array is resharded with ``jax.device_put``
+      (XLA emits the cross-host collective), falling back to the host path
+      when the transfer is not expressible.
+
+    This is the whole reference reshard-function registry
+    (paddle/phi/core/distributed/auto_parallel/reshard/) for the eager API:
+    every s_to_r/r_to_s/p_to_r rule collapses to one placed transfer.
+    """
+    if sharding.is_fully_addressable:
+        return jax.device_put(a, sharding)
+    if isinstance(a, jax.core.Tracer) or (
+            isinstance(a, jax.Array) and not a.is_fully_addressable):
+        # compiled identity with out_shardings: XLA emits the cross-host
+        # collective (device_put cannot move bytes between hosts on every
+        # backend, and never under the eager-vjp tape)
+        return _resharder(sharding)(a)
+    host = np.asarray(a)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: np.ascontiguousarray(host[idx]))
+
+
+@functools.lru_cache(maxsize=256)
+def _resharder(sharding):
+    return jax.jit(lambda x: x, out_shardings=sharding)
+
+
+def _eager_reshard(t: Tensor, sharding):
+    """Concrete (non-traced) reshard with a hand-built tape node.
+
+    The generic eager vjp (jax.vjp over the op body) cannot be used here:
+    under the tape's linearize, instantiated zero *tangents* are ordinary
+    single-device arrays, and placing one onto a process-spanning sharding
+    is not a well-formed global program. So forward places concretely and
+    backward reshards the cotangent back to the source sharding — the same
+    pairing the reference's reshard functions register as their grads.
+    """
+    from ..core import autograd as _ag
+    from ..core.dispatch import _is_diff_array
+
+    data = t._data
+    src_sharding = getattr(data, "sharding", None)
+    placed = _put_global(data, sharding)
+    record = (_ag.is_grad_enabled() and not t.stop_gradient
+              and _is_diff_array(data))
+    out = Tensor(placed, stop_gradient=not record)
+    if record:
+        def vjp_fn(ct, _src=src_sharding):
+            cta = ct._data if isinstance(ct, Tensor) else ct
+            if _src is not None and not isinstance(cta, jax.core.Tracer):
+                cta = _put_global(cta, _src)
+            return (cta,)
+
+        edges = [("node", t._grad_node, t._output_slot)
+                 if t._grad_node is not None else ("leaf", t)]
+        node = _ag.GradNode("reshard", vjp_fn, edges,
+                            [(placed.shape, placed.dtype)],
+                            jax.tree.structure(0))
+        # double backward (create_graph=True) re-derives the vjp from this
+        # closure; reshard is linear so replaying the placement suffices
+        node.replay = (lambda a: _put_global(a, sharding), [t])
+        out._grad_node = node
+        out._output_slot = 0
+    return out
 
 
 def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, stop_gradient=None):
@@ -43,7 +120,13 @@ def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, stop_gradient=Non
     # Route the transfer through the op layer: device_put is differentiable
     # (identity vjp), so resharding mid-graph keeps the tape connected — the
     # analog of the reference's reshard ops being autograd-visible ops.
-    out = eager_apply("reshard", lambda a: jax.device_put(a, sharding), (t,), {})
+    if isinstance(t._data, jax.core.Tracer):
+        # traced context (TrainStep / to_static): generic tape vjp is fine —
+        # device_put stays symbolic and GSPMD handles the placement
+        out = eager_apply("reshard",
+                          lambda a: jax.device_put(a, sharding), (t,), {})
+    else:
+        out = _eager_reshard(t, sharding)
     if dtype is not None:
         out = out.astype(dtype)
     if stop_gradient is not None:
@@ -141,7 +224,7 @@ def shard_parameter(p, mesh: ProcessMesh, placements):
     """In-place re-placement of a Parameter (keeps identity for optimizers)."""
     if any(isinstance(pl, Partial) for pl in placements):
         raise ValueError("parameters cannot be Partial")
-    p._data = jax.device_put(p._data, mesh.sharding_for(placements, max(p.ndim, 1)))
+    p._data = _put_global(p._data, mesh.sharding_for(placements, max(p.ndim, 1)))
     p._dist_attr = (mesh, list(placements))
     return p
 

@@ -416,11 +416,16 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         if _nonmember_noop(group):
             return tensor
         _group_index(group, src, what="src")
-        a = np.asarray(tensor._data if isinstance(tensor, Tensor) else tensor)
         ranks = _group_ranks(group)
         if not _is_global(ranks):
+            # only src's bytes travel — readers must not pay a host
+            # materialization of their own (discarded) value
+            a = np.asarray(tensor._data if isinstance(tensor, Tensor)
+                           else tensor) if get_rank() == src else None
             val = jnp.asarray(_subgroup_bcast(a, group, ranks, src))
         else:
+            a = np.asarray(tensor._data if isinstance(tensor, Tensor)
+                           else tensor)
             from jax.experimental import multihost_utils
             val = jnp.asarray(multihost_utils.broadcast_one_to_all(
                 a, is_source=get_rank() == src))

@@ -134,7 +134,10 @@ def init_mesh(shape_or_dims, dim_names=None) -> ProcessMesh:
         shape[shape.index(-1)] = n // known
     if int(np.prod(shape)) != n:
         raise ValueError(f"mesh shape {shape} != {n} devices")
-    ids = np.arange(n).reshape(shape)
+    # real device ids — NOT arange: in the multi-process regime each
+    # process's devices carry non-contiguous global ids (e.g. host 1's CPU
+    # devices start at 2048), and jax.devices() is the canonical order
+    ids = np.asarray([d.id for d in jax.devices()]).reshape(shape)
     return ProcessMesh(ids, dim_names)
 
 

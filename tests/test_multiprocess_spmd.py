@@ -1,0 +1,76 @@
+"""Compiled SPMD programs across real processes (round-2 verdict item #1).
+
+Launches tests/spmd_runner.py through the repo's own launch CLI: 2 worker
+processes x 4 virtual CPU devices each = one global 8-device mesh via
+jax.distributed. Asserts the multi-process run's loss curve and final
+parameters match a single-process run of the SAME code on a local 8-device
+mesh (the reference's parity pattern: test/legacy_test/test_dist_base.py —
+multi-rank trainers vs a single-rank oracle).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def spmd_result(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("spmd")
+    out = str(tmp / "result.json")
+    env = {k: v for k, v in os.environ.items()}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = os.path.dirname(TESTS_DIR) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["SPMD_OUT"] = out
+    env["SPMD_CKPT_DIR"] = str(tmp / "ckpt")
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", "2", "--max_restart", "0",
+           os.path.join(TESTS_DIR, "spmd_runner.py")]
+    proc = subprocess.run(cmd, env=env, timeout=600,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return json.load(open(out))
+
+
+@pytest.mark.slow
+def test_global_mesh_spans_processes(spmd_result):
+    assert spmd_result["n_global_devices"] == 8
+
+
+@pytest.mark.slow
+def test_gspmd_train_step_parity(spmd_result):
+    """dp x mp TrainStep across 2 processes == the same program on one."""
+    from paddle_tpu.distributed.mesh import init_mesh
+    from tests.spmd_runner import build_and_train
+
+    mesh = init_mesh({"dp": 2, "mp": 4})
+    model, ref_losses = build_and_train(mesh)
+
+    np.testing.assert_allclose(spmd_result["A_losses"], ref_losses,
+                               rtol=1e-4, atol=1e-6)
+    assert ref_losses[-1] < ref_losses[0]
+    for name, p in model.named_parameters():
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import Replicate
+        rep = dist.shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+        np.testing.assert_allclose(
+            np.asarray(spmd_result["A_params"][name]),
+            np.asarray(rep.numpy()), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pipeline_step_across_processes(spmd_result):
+    assert np.isfinite(spmd_result["B_loss"])
+    assert spmd_result["B_grads_finite"]
+
+
+@pytest.mark.slow
+def test_sharded_checkpoint_reshard_across_processes(spmd_result):
+    assert spmd_result["C_roundtrip_ok"]
