@@ -88,13 +88,24 @@ def stack_block_params(blocks):
 
 def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
                       n_micro=4, schedule="1f1b", pp_axis="pp",
-                      dp_axis="dp"):
+                      dp_axis="dp", vpp=1):
     """Build the single-program 3-D step for an arbitrary uniform-block model.
 
     blocks: list of nn.Layer, each mapping [mb, ...] -> [mb, ...] (built
     with mp layers for tensor parallelism — their GSPMD shardings ride
     through). embed/head: optional nn.Layer prologue/epilogue (run outside
     the pipeline). loss_fn(y_arrays, labels_arrays) -> scalar.
+
+    Schedules:
+      ``fthenb`` / ``1f1b`` — the circular shard_map pipeline (remat under
+      1f1b), differentiated by outer AD.
+      ``1f1b_zb`` (alias ``zbh1``) / ``zbv`` / ``interleaved`` — the
+      EXPLICIT schedule executor (pipeline_schedule.py): static op tables,
+      true 1F1B/zero-bubble execution with the B_INPUT/B_WEIGHT split, vpp
+      chunks per stage (``interleaved`` needs vpp>1; ``zbv`` forces
+      vpp=2). Constraint: ``head`` must be None on this path (fold the
+      projection into ``loss_fn``); the embedding is differentiated through
+      the executor's input-grad.
 
     Returns (params, step_fn) with step_fn(params, x, labels) ->
     (loss, grads): jit it once; grads match the params tree. x: [B, ...]
@@ -103,19 +114,31 @@ def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
     jmesh = getattr(mesh, "jax_mesh", mesh)
     pp = jmesh.shape.get(pp_axis, 1)
     n_blocks = len(blocks)
-    if n_blocks % pp:
-        raise ValueError(f"{n_blocks} blocks not divisible by pp={pp}")
-    lps = n_blocks // pp
-    if schedule not in ("fthenb", "1f1b"):
-        raise ValueError(f"unknown schedule {schedule!r}")
+    explicit = schedule in ("1f1b_zb", "zbh1", "zbv", "interleaved")
+    if schedule == "zbv":
+        vpp = 2
+    if schedule == "interleaved" and vpp < 2:
+        raise ValueError("schedule='interleaved' needs vpp>=2 "
+                         "(vpp=1 is plain 1F1B)")
+    if explicit:
+        if head is not None:
+            raise ValueError(
+                f"schedule {schedule!r} runs loss_fn on the last stage; "
+                "fold the head into loss_fn (head=None)")
+        if n_blocks % (pp * vpp):
+            raise ValueError(
+                f"{n_blocks} blocks not divisible by pp*vpp={pp * vpp}")
+        lps = n_blocks // (pp * vpp)
+    else:
+        if n_blocks % pp:
+            raise ValueError(f"{n_blocks} blocks not divisible by pp={pp}")
+        lps = n_blocks // pp
+        if schedule not in ("fthenb", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
 
     stacked = stack_block_params(blocks)
-    # two-level stage layout [pp, lps, ...]: shard_map consumes the pp axis,
-    # _interleaved_body the chunk axis, stage_fn loops the lps axis
-    stacked = jax.tree.map(
-        lambda l: l.reshape((pp, lps) + l.shape[1:]), stacked)
     _, block_apply = functionalize(blocks[0])
-    params = {"blocks": stacked}
+    params = {}
     embed_apply = head_apply = None
     if embed is not None:
         params["embed"], embed_apply = functionalize(embed)
@@ -123,13 +146,42 @@ def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
         params["head"], head_apply = functionalize(head)
 
     def stage_fn(stage_arrays, x):
-        # stage_arrays leaves: [lps, ...] (pp axis consumed by shard_map)
+        # stage_arrays leaves: [lps, ...] (stage/chunk axes consumed)
         for i in range(lps):
             x = block_apply(jax.tree.map(lambda l, i=i: l[i], stage_arrays),
                             x)
         return x
 
-    block_specs = jax.tree.map(lambda _: P(pp_axis), stacked)
+    if explicit:
+        # leaves [n_blocks, ...] -> [pp*vpp, lps, ...] in LAYER order; the
+        # executor permutes virtual stages into its (stage, chunk) layout
+        params["blocks"] = jax.tree.map(
+            lambda l: l.reshape((pp * vpp, lps) + l.shape[1:]), stacked)
+        from .pipeline_schedule import scheduled_pipeline_loss
+        kind = {"1f1b_zb": "zbh1", "interleaved": "1f1b"}.get(
+            schedule, schedule)
+
+        def step_fn(params, x, labels):
+            def loss(params):
+                h = embed_apply(params["embed"], x) if embed_apply else x
+                mb = h.shape[0] // n_micro
+                xm = h.reshape((n_micro, mb) + h.shape[1:])
+                lm = labels.reshape((n_micro, mb) + labels.shape[1:])
+                # total = SUM of per-microbatch loss_fn(y_mb, labels_mb)
+                # (divide by n_micro in loss_fn for mean semantics)
+                return scheduled_pipeline_loss(
+                    params["blocks"], xm, lm, stage_fn, loss_fn,
+                    jmesh, axis_name=pp_axis, schedule=kind, vpp=vpp)
+
+            return jax.value_and_grad(loss)(params)
+
+        return params, step_fn
+
+    # two-level stage layout [pp, lps, ...]: shard_map consumes the pp axis,
+    # _interleaved_body the chunk axis, stage_fn loops the lps axis
+    params["blocks"] = jax.tree.map(
+        lambda l: l.reshape((pp, lps) + l.shape[1:]), stacked)
+    block_specs = jax.tree.map(lambda _: P(pp_axis), params["blocks"])
 
     def pipeline(stage_params, xm):
         fn = jax.checkpoint(stage_fn) if schedule == "1f1b" else stage_fn

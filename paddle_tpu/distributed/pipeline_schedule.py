@@ -1,34 +1,42 @@
-"""Explicit pipeline-parallel schedules: GPipe (F-then-B), true 1F1B, and
-zero-bubble ZBH1.
+"""Explicit pipeline-parallel schedules: GPipe (F-then-B), true 1F1B,
+zero-bubble ZBH1, interleaved virtual-pipeline (vpp>1), and ZBV.
 
 Reference: python/paddle/distributed/passes/pipeline_scheduler_pass/
-pipeline_1f1b.py:45 and pipeline_zero_bubble.py:61 build per-rank Job lists
-(F/B/W sub-programs) executed by the multi-Job Plan executor
-(paddle/fluid/framework/new_executor/interpreter/plan.h). The TPU-native
-rebuild keeps that structure but compiles it into ONE program: a
-``build_schedule`` list-scheduler emits a static [tick, stage] op table
-(IDLE / F / B_INPUT / B_WEIGHT), and ``pipeline_train_step`` executes the
-table inside ``shard_map`` over the ``pp`` mesh axis — each tick is a
-``lax.switch`` on the device's opcode, and activations/cotangents hop
-between neighbor stages with ``lax.ppermute`` riding ICI (the p2p
-send/recv of pp_utils/p2p_communication.py:573).
+pipeline_1f1b.py:45, pipeline_zero_bubble.py:61 and the VPP variant
+pipeline_vpp.py build per-rank Job lists (F/B/W sub-programs) executed by
+the multi-Job Plan executor (paddle/fluid/framework/new_executor/
+interpreter/plan.h). The TPU-native rebuild keeps that structure but
+compiles it into ONE program: ``build_schedule`` is a greedy
+dependency-driven list scheduler over VIRTUAL stages (physical stage s,
+chunk c) emitting static [tick, stage] tables (op / microbatch / chunk),
+and ``pipeline_train_step`` executes the tables inside ``shard_map`` over
+the ``pp`` mesh axis — each tick is a ``lax.switch`` on the device's
+opcode, and activations/cotangents hop between neighbor stages with
+``lax.ppermute`` riding ICI (the p2p of pp_utils/p2p_communication.py:573).
 
-Zero-bubble (ZBH1) splits backward into B_INPUT (activation-gradient, on
-the critical inter-stage path) and B_WEIGHT (weight-gradient, freely
+Virtual-stage layouts:
+  interleaved (vpp>=1)  v = c*p + s   — chunk c of stage s is the
+      (c*p+s)-th group of layers; activations always hop +1 on the ring
+      (the reference's VPP layout, pp_layers.py get_stage_from_index).
+  zbv (vpp==2)          v = s for the down chunk, v = 2p-1-s for the up
+      chunk — the "V" shape of the zero-bubble-vertical schedule: chunk 0
+      flows 0→p-1, chunk 1 flows back p-1→0, so stage 0 holds both the
+      first and the LAST virtual stage (loss is computed on stage 0).
+
+Zero-bubble (ZBH1/ZBV) splits backward into B_INPUT (activation-gradient,
+on the critical inter-stage path) and B_WEIGHT (weight-gradient, freely
 deferrable), so cooldown bubbles are filled with deferred weight-gradient
-work — the insight of the zero-bubble-pipeline schedule. The executor
-computes B_INPUT/B_WEIGHT as separate ``jax.vjp`` pulls against the saved
-stage input, so the split is real, not cosmetic.
+work. The executor computes B_INPUT/B_WEIGHT as separate ``jax.vjp`` pulls
+against the saved stage input, so the split is real, not cosmetic.
 
 Tick accounting: every op (F, B_INPUT, B_WEIGHT) is one tick, so a full
 backward costs two ticks — the classic F:B = 1:2 cost model the schedules
-are derived under. ``Schedule.bubble_ticks()`` counts per-stage idle ticks;
-tests assert 1F1B < GPipe (at equal activation memory) and ZBH1 < 1F1B.
+are derived under. ``Schedule.bubble_ticks()`` counts per-stage idle ticks.
 """
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 import jax
@@ -40,21 +48,55 @@ from jax.sharding import PartitionSpec as P
 IDLE, F_OP, BI_OP, W_OP = 0, 1, 2, 3
 _OP_NAMES = {IDLE: "-", F_OP: "F", BI_OP: "Bi", W_OP: "Bw"}
 
+# ring directions for the routing tables
+_DIR_NONE, _DIR_PLUS, _DIR_MINUS, _DIR_LOCAL = 0, 1, 2, 3
+_KIND_ACT, _KIND_COT = 0, 1
+
+
+def _vmap_factory(kind: str, p: int, vpp: int):
+    """(v_of(s, c), phys(v)) for the schedule's virtual-stage layout."""
+    if kind == "zbv":
+        def v_of(s, c):
+            return s if c == 0 else 2 * p - 1 - s
+
+        def phys(v):
+            return (v, 0) if v < p else (2 * p - 1 - v, 1)
+    else:
+        def v_of(s, c):
+            return c * p + s
+
+        def phys(v):
+            return (v % p, v // p)
+    return v_of, phys
+
 
 @dataclass
 class Schedule:
-    """A static pipeline schedule: op/micro tables of shape [n_ticks, p]."""
+    """A static pipeline schedule: [n_ticks, p] tables over virtual stages."""
 
     kind: str
     n_micro: int
     n_stages: int
-    cap: int                 # max in-flight microbatches per stage
+    cap: int                 # max in-flight microbatches per physical stage
     op_table: np.ndarray     # int32 [T, p]
     micro_table: np.ndarray  # int32 [T, p]
+    vpp: int = 1
+    chunk_table: np.ndarray | None = field(default=None)
+
+    def __post_init__(self):
+        if self.chunk_table is None:
+            self.chunk_table = np.zeros_like(self.op_table)
 
     @property
     def n_ticks(self) -> int:
         return int(self.op_table.shape[0])
+
+    @property
+    def n_virtual(self) -> int:
+        return self.n_stages * self.vpp
+
+    def layout(self):
+        return _vmap_factory(self.kind, self.n_stages, self.vpp)
 
     def bubble_ticks(self, stage=None):
         """Idle ticks per stage over the schedule's full span."""
@@ -64,6 +106,9 @@ class Schedule:
     def bubble_total(self) -> int:
         return int((self.op_table == IDLE).sum())
 
+    def bubble_fraction(self) -> float:
+        return self.bubble_total() / float(self.op_table.size)
+
     def draw(self) -> str:
         """ASCII pipeline diagram (stages as rows, ticks as columns)."""
         rows = []
@@ -71,134 +116,174 @@ class Schedule:
             cells = []
             for t in range(self.n_ticks):
                 op, i = self.op_table[t, s], self.micro_table[t, s]
-                cells.append(f"{_OP_NAMES[int(op)]}{int(i) if op else ' '}")
-            rows.append(f"s{s}: " + " ".join(f"{c:>4}" for c in cells))
+                c = int(self.chunk_table[t, s])
+                tag = f"{_OP_NAMES[int(op)]}{int(i) if op else ' '}"
+                if op and self.vpp > 1:
+                    tag += f".{c}"
+                cells.append(tag)
+            rows.append(f"s{s}: " + " ".join(f"{c:>6}" for c in cells))
         return "\n".join(rows)
 
 
 def build_schedule(kind: str, n_micro: int, n_stages: int,
-                   cap: int | None = None) -> Schedule:
-    """Greedy dependency-driven list scheduler.
+                   cap: int | None = None, vpp: int = 1) -> Schedule:
+    """Greedy dependency-driven list scheduler over virtual stages.
 
-    Dependencies (1-tick neighbor-communication latency):
-      F(i,s)  needs F(i,s-1) done a tick earlier, and a free activation slot
-              (in-flight = started F minus completed B_WEIGHT < cap);
-      Bi(i,s) needs F(i,s) and Bi(i,s+1) done a tick earlier;
-      Bw(i,s) needs Bi(i,s) done a tick earlier (frees the slot).
+    Dependencies (1-tick neighbor-communication latency), v = virtual stage:
+      F(i,v)  needs F(i,v-1) done a tick earlier, and a free activation slot
+              on its physical stage (started F minus completed B_WEIGHT
+              across all chunks < cap);
+      Bi(i,v) needs F(i,v) and Bi(i,v+1) done a tick earlier;
+      Bw(i,v) needs Bi(i,v) done a tick earlier (frees the slot).
 
     Policies:
-      fthenb  — per-stage strict F0..Fm-1 then B0..Bm-1 (B = Bi+Bw back to
-                back), the reference's FThenB job order. Default cap is
-                n_micro (GPipe stores every activation); pass cap=n_stages
-                for the equal-memory comparison against 1f1b.
-      1f1b    — backward-priority with atomic B, cap = n_stages: the classic
-                1F1B (warmup forwards fall out of the dependency structure).
+      fthenb  — per-stage strict forwards then backwards (B = Bi+Bw back to
+                back), the reference's FThenB job order.
+      1f1b    — backward-priority with atomic B: classic 1F1B at vpp=1, the
+                interleaved VPP schedule at vpp>1.
       zbh1    — backward-input priority, weight-gradient work deferred into
-                idle ticks, same activation cap as 1f1b.
+                idle ticks (zero-bubble-horizontal).
+      zbv     — the same split on the V-shaped two-chunk layout
+                (zero-bubble-vertical); forces vpp=2.
     """
-    if kind not in ("fthenb", "1f1b", "zbh1"):
+    if kind not in ("fthenb", "1f1b", "zbh1", "zbv"):
         raise ValueError(f"unknown schedule kind {kind!r}")
+    if kind == "zbv":
+        if vpp not in (1, 2):
+            raise ValueError("zbv is a two-chunk (vpp=2) schedule")
+        vpp = 2
     m, p = n_micro, n_stages
+    V = p * vpp
+    v_of, phys = _vmap_factory(kind, p, vpp)
     if cap is None:
-        cap = m if kind == "fthenb" else min(p, m)
-    cap = max(1, min(cap, m))
+        cap = m * vpp if kind == "fthenb" else min(V, m * vpp)
+    cap = max(1, min(cap, m * vpp))
 
-    next_f = [0] * p
-    next_bi = [0] * p
-    next_w = [0] * p
-    f_done = [[None] * m for _ in range(p)]
-    bi_done = [[None] * m for _ in range(p)]
-    forced_w = [None] * p    # micro whose Bw must run next tick (atomic B)
+    next_f = [0] * V
+    next_bi = [0] * V
+    next_w = [0] * V
+    f_done = [[None] * m for _ in range(V)]
+    bi_done = [[None] * m for _ in range(V)]
+    inflight = [0] * p
+    forced_w = [None] * p    # (v, i) whose Bw must run next tick (atomic B)
     ops = [[] for _ in range(p)]
+    chunks_of = [[c for c in range(vpp)] for _ in range(p)]
 
-    def f_ready(s, t):
-        i = next_f[s]
-        if i >= m or next_f[s] - next_w[s] >= cap:
+    def f_ready(v, t, s):
+        i = next_f[v]
+        if i >= m or inflight[s] >= cap:
             return False
-        return s == 0 or (f_done[s - 1][i] is not None
-                          and f_done[s - 1][i] <= t - 1)
+        return v == 0 or (f_done[v - 1][i] is not None
+                          and f_done[v - 1][i] <= t - 1)
 
-    def bi_ready(s, t):
-        i = next_bi[s]
-        if i >= m or f_done[s][i] is None or f_done[s][i] > t - 1:
+    def bi_ready(v, t):
+        i = next_bi[v]
+        if i >= m or f_done[v][i] is None or f_done[v][i] > t - 1:
             return False
-        return s == p - 1 or (bi_done[s + 1][i] is not None
-                              and bi_done[s + 1][i] <= t - 1)
+        return v == V - 1 or (bi_done[v + 1][i] is not None
+                              and bi_done[v + 1][i] <= t - 1)
 
-    def w_ready(s, t):
-        i = next_w[s]
-        return (i < next_bi[s] and bi_done[s][i] is not None
-                and bi_done[s][i] <= t - 1)
+    def w_ready(v, t):
+        i = next_w[v]
+        return (i < next_bi[v] and bi_done[v][i] is not None
+                and bi_done[v][i] <= t - 1)
 
     t = 0
-    while any(next_w[s] < m for s in range(p)):
-        if t > 4 * (m + p) * 3 + 64:  # safety: schedule must terminate
+    while any(next_w[v] < m for v in range(V)):
+        if t > 4 * (m * vpp + V) * 3 + 64:  # safety: must terminate
             raise RuntimeError(f"schedule {kind} did not converge")
         for s in range(p):
-            act = (IDLE, 0)
+            vs = [v_of(s, c) for c in chunks_of[s]]
+            act = (IDLE, 0, 0)
             if forced_w[s] is not None:
-                i = forced_w[s]
-                act = (W_OP, i)
-                next_w[s] += 1
+                v, i = forced_w[s]
+                act = (W_OP, i, v)
+                next_w[v] += 1
+                inflight[s] -= 1
                 forced_w[s] = None
             elif kind == "fthenb":
-                # F runs ahead only within the current activation chunk;
-                # cap < n_micro produces the classic GPipe flush pattern
-                chunk_hi = min(m, (next_bi[s] // cap + 1) * cap)
-                if next_f[s] < chunk_hi:
-                    if f_ready(s, t):
-                        i = next_f[s]
-                        act = (F_OP, i)
-                        f_done[s][i] = t
-                        next_f[s] += 1
-                elif next_bi[s] < m and bi_ready(s, t):
-                    i = next_bi[s]
-                    act = (BI_OP, i)
-                    bi_done[s][i] = t
-                    next_bi[s] += 1
-                    forced_w[s] = i
+                # F runs ahead only within the current activation window
+                # of each virtual stage (the per-window bound below gives
+                # the GPipe flush pattern at small caps); among ready ops
+                # the deepest virtual stage goes first so completed
+                # windows drain before new ones open
+                fs = [v for v in vs if f_ready(v, t, s)
+                      and next_f[v] < min(m, (next_bi[v] // max(cap // vpp, 1)
+                                              + 1) * max(cap // vpp, 1))]
+                bis = [v for v in vs if bi_ready(v, t)]
+                if fs:
+                    v = max(fs)
+                    i = next_f[v]
+                    act = (F_OP, i, v)
+                    f_done[v][i] = t
+                    next_f[v] += 1
+                    inflight[s] += 1
+                elif bis:
+                    v = max(bis)
+                    i = next_bi[v]
+                    act = (BI_OP, i, v)
+                    bi_done[v][i] = t
+                    next_bi[v] += 1
+                    forced_w[s] = (v, i)
             elif kind == "1f1b":
-                if bi_ready(s, t):
-                    i = next_bi[s]
-                    act = (BI_OP, i)
-                    bi_done[s][i] = t
-                    next_bi[s] += 1
-                    forced_w[s] = i
-                elif f_ready(s, t):
-                    i = next_f[s]
-                    act = (F_OP, i)
-                    f_done[s][i] = t
-                    next_f[s] += 1
-            else:  # zbh1
-                if bi_ready(s, t):
-                    i = next_bi[s]
-                    act = (BI_OP, i)
-                    bi_done[s][i] = t
-                    next_bi[s] += 1
-                elif f_ready(s, t):
-                    i = next_f[s]
-                    act = (F_OP, i)
-                    f_done[s][i] = t
-                    next_f[s] += 1
-                elif w_ready(s, t):
-                    act = (W_OP, next_w[s])
-                    next_w[s] += 1
+                bis = [v for v in vs if bi_ready(v, t)]
+                fs = [v for v in vs if f_ready(v, t, s)]
+                if bis:
+                    v = max(bis)   # drain the deepest virtual stage first
+                    i = next_bi[v]
+                    act = (BI_OP, i, v)
+                    bi_done[v][i] = t
+                    next_bi[v] += 1
+                    forced_w[s] = (v, i)
+                elif fs:
+                    v = max(fs)
+                    i = next_f[v]
+                    act = (F_OP, i, v)
+                    f_done[v][i] = t
+                    next_f[v] += 1
+                    inflight[s] += 1
+            else:  # zbh1 / zbv: Bi > F > deferred Bw
+                bis = [v for v in vs if bi_ready(v, t)]
+                fs = [v for v in vs if f_ready(v, t, s)]
+                ws = [v for v in vs if w_ready(v, t)]
+                if bis:
+                    v = max(bis)
+                    i = next_bi[v]
+                    act = (BI_OP, i, v)
+                    bi_done[v][i] = t
+                    next_bi[v] += 1
+                elif fs:
+                    v = max(fs)
+                    i = next_f[v]
+                    act = (F_OP, i, v)
+                    f_done[v][i] = t
+                    next_f[v] += 1
+                    inflight[s] += 1
+                elif ws:
+                    v = min(ws)    # oldest deferred weight-grad work first
+                    act = (W_OP, next_w[v], v)
+                    next_w[v] += 1
+                    inflight[s] -= 1
             ops[s].append(act)
         t += 1
 
     T = t
     op_table = np.zeros((T, p), np.int32)
     micro_table = np.zeros((T, p), np.int32)
+    chunk_table = np.zeros((T, p), np.int32)
     for s in range(p):
-        for tt, (o, i) in enumerate(ops[s]):
+        for tt, (o, i, v) in enumerate(ops[s]):
             op_table[tt, s] = o
             micro_table[tt, s] = i
-    return Schedule(kind, m, p, cap, op_table, micro_table)
+            chunk_table[tt, s] = phys(v)[1] if o else 0
+    return Schedule(kind, m, p, cap, op_table, micro_table, vpp, chunk_table)
 
 
 def validate_schedule(sched: Schedule) -> None:
     """Independent dependency/cap checker (used by tests)."""
-    m, p, cap = sched.n_micro, sched.n_stages, sched.cap
+    m, p, cap, vpp = sched.n_micro, sched.n_stages, sched.cap, sched.vpp
+    V = p * vpp
+    v_of, _ = sched.layout()
     f_at = {}
     bi_at = {}
     w_at = {}
@@ -207,137 +292,309 @@ def validate_schedule(sched: Schedule) -> None:
         for s in range(p):
             op = int(sched.op_table[t, s])
             i = int(sched.micro_table[t, s])
+            if op == IDLE:
+                continue
+            v = v_of(s, int(sched.chunk_table[t, s]))
             if op == F_OP:
-                assert s == 0 or f_at[(i, s - 1)] <= t - 1, (t, s, i)
+                assert v == 0 or f_at[(i, v - 1)] <= t - 1, (t, s, i, v)
                 inflight[s] += 1
                 assert inflight[s] <= cap, (t, s)
-                f_at[(i, s)] = t
+                f_at[(i, v)] = t
             elif op == BI_OP:
-                assert f_at[(i, s)] <= t - 1, (t, s, i)
-                if s < p - 1:
-                    assert bi_at[(i, s + 1)] <= t - 1, (t, s, i)
-                bi_at[(i, s)] = t
+                assert f_at[(i, v)] <= t - 1, (t, s, i, v)
+                if v < V - 1:
+                    assert bi_at[(i, v + 1)] <= t - 1, (t, s, i, v)
+                bi_at[(i, v)] = t
             elif op == W_OP:
-                assert bi_at[(i, s)] <= t - 1, (t, s, i)
+                assert bi_at[(i, v)] <= t - 1, (t, s, i, v)
                 inflight[s] -= 1
-                w_at[(i, s)] = t
-    for s in range(p):
+                w_at[(i, v)] = t
+    for v in range(V):
         for i in range(m):
-            assert (i, s) in f_at and (i, s) in bi_at and (i, s) in w_at
+            assert (i, v) in f_at and (i, v) in bi_at and (i, v) in w_at
+
+
+def _routing_tables(sched: Schedule):
+    """Static per-(tick, stage) send routing derived from the layout.
+
+    act_dir/cot_dir: _DIR_* for the payload an F/Bi op emits; *_rchunk: the
+    chunk index the receiver stores into; is_last/is_first mark the loss-
+    seeding and input-consuming virtual stages.
+    """
+    T, p = sched.op_table.shape
+    v_of, phys = sched.layout()
+    V = sched.n_virtual
+    act_dir = np.zeros((T, p), np.int32)
+    act_rc = np.zeros((T, p), np.int32)
+    cot_dir = np.zeros((T, p), np.int32)
+    cot_rc = np.zeros((T, p), np.int32)
+    is_last = np.zeros((T, p), np.int32)
+    is_first = np.zeros((T, p), np.int32)
+
+    def direction(from_s, to_s):
+        if to_s == from_s:
+            return _DIR_LOCAL
+        if to_s == (from_s + 1) % p:
+            return _DIR_PLUS
+        if to_s == (from_s - 1) % p:
+            return _DIR_MINUS
+        raise ValueError(f"non-neighbor hop {from_s}->{to_s}")
+
+    for t in range(T):
+        for s in range(p):
+            op = int(sched.op_table[t, s])
+            if op == IDLE:
+                continue
+            v = v_of(s, int(sched.chunk_table[t, s]))
+            if op == F_OP:
+                if v == V - 1:
+                    is_last[t, s] = 1
+                else:
+                    ns, nc = phys(v + 1)
+                    act_dir[t, s] = direction(s, ns)
+                    act_rc[t, s] = nc
+                if v == 0:
+                    is_first[t, s] = 1
+            elif op == BI_OP:
+                if v > 0:
+                    ps_, pc = phys(v - 1)
+                    cot_dir[t, s] = direction(s, ps_)
+                    cot_rc[t, s] = pc
+                else:
+                    is_first[t, s] = 1  # Bi at v0: its dx is the input grad
+    return act_dir, act_rc, cot_dir, cot_rc, is_last, is_first
+
+
+def _stage_permutation(sched: Schedule):
+    """[p, vpp] table: entry (s, c) = the layer-order (virtual) index."""
+    v_of, _ = sched.layout()
+    return np.asarray([[v_of(s, c) for c in range(sched.vpp)]
+                       for s in range(sched.n_stages)])
 
 
 def pipeline_train_step(stage_params, x, labels, stage_fn, loss_fn, mesh,
-                        axis_name="pp", schedule="1f1b", cap=None,
-                        x_spec=None, param_spec=None):
+                        axis_name="pp", schedule="1f1b", cap=None, vpp=1,
+                        x_spec=None, param_spec=None, return_dx=False):
     """Run one microbatched fwd+bwd pass under an explicit schedule.
 
-    stage_params: pytree with leaves stacked [n_stages, ...] (axis 0 sharded
-    over ``axis_name``). x/labels: [n_micro, mb, ...] (replicated).
-    stage_fn(params_one_stage, x_mb) -> y_mb (activation shape preserved);
+    stage_params: pytree with leaves stacked [n_stages*vpp, ...] in LAYER
+    order (virtual-stage order). x/labels: [n_micro, mb, ...] (replicated).
+    stage_fn(params_one_chunk, x_mb) -> y_mb (activation shape preserved);
     loss_fn(y_mb, labels_mb) -> scalar.
 
     Returns (loss, grads): loss = sum of per-microbatch losses (replicated);
-    grads shaped/sharded like stage_params. Pair with any optimizer.
+    grads stacked [n_stages*vpp, ...] in layer order, sharded like the
+    input. Pair with any optimizer. ``return_dx=True`` additionally returns
+    d(loss)/d(x) (the input gradient, for an embedding in front).
     """
     jmesh = getattr(mesh, "jax_mesh", mesh)
     p = jmesh.shape[axis_name]
     m = x.shape[0]
     n_chunks = jax.tree.leaves(stage_params)[0].shape[0]
-    if n_chunks != p:
+    if schedule == "zbv":
+        vpp = 2
+    if n_chunks != p * vpp:
         raise ValueError(
-            f"stacked stage count {n_chunks} != pp axis size {p} (explicit "
-            "schedules are vpp=1; use pipeline_apply for interleaved VPP)")
-    sched = build_schedule(schedule, m, p, cap=cap)
-    S = sched.cap  # activation buffer slots (max in-flight)
-    ops_tbl = jnp.asarray(sched.op_table)
-    mic_tbl = jnp.asarray(sched.micro_table)
-    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
-    bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+            f"stacked stage count {n_chunks} != pp({p}) * vpp({vpp})")
+    sched = build_schedule(schedule, m, p, cap=cap, vpp=vpp)
+    S = min(sched.cap, m)    # activation buffer slots per chunk
+    perm = _stage_permutation(sched)             # [p, vpp] -> layer index
+    inv = np.argsort(perm.reshape(-1))           # back to layer order
+    # [V, ...] layer order -> [p, vpp, ...] layout order
+    arranged = jax.tree.map(
+        lambda l: l[perm.reshape(-1)].reshape(
+            (p, vpp) + l.shape[1:]), stage_params)
+
+    tables = tuple(jnp.asarray(a) for a in (
+        (sched.op_table, sched.micro_table, sched.chunk_table)
+        + _routing_tables(sched)))
 
     if x_spec is None:
         x_spec = P(*([None] * x.ndim))
     if param_spec is None:
         param_spec = jax.tree.map(lambda l: P(axis_name), stage_params)
+    # layer-order spec P(pp, *rest) -> arranged [p, vpp, ...] spec
+    # P(pp, None, *rest): trailing-dim shardings (e.g. mp) are preserved
+    arranged_spec = jax.tree.map(
+        lambda sp: P(*((tuple(sp)[:1] or (axis_name,))
+                       + (None,) + tuple(sp)[1:])),
+        param_spec, is_leaf=lambda s: isinstance(s, P))
     label_spec = P(*([None] * labels.ndim))
 
     body = functools.partial(
         _schedule_body, stage_fn=stage_fn, loss_fn=loss_fn,
-        axis_name=axis_name, p=p, S=S, ops_tbl=ops_tbl, mic_tbl=mic_tbl,
-        fwd_perm=fwd_perm, bwd_perm=bwd_perm)
+        axis_name=axis_name, p=p, vpp=vpp, S=S, tables=tables)
+    # partial-manual: only the pp axis is manual; dp/mp stay auto GSPMD
+    # axes (batch sharding and Megatron TP collectives ride through, the
+    # same contract as the circular pipeline path)
     mapped = shard_map(body, mesh=jmesh,
-                       in_specs=(param_spec, x_spec, label_spec),
-                       out_specs=(P(), param_spec), check_vma=False)
-    return mapped(stage_params, x, labels)
+                       in_specs=(arranged_spec, x_spec, label_spec),
+                       out_specs=(P(), arranged_spec, x_spec),
+                       axis_names={axis_name}, check_vma=False)
+    loss, grads_arranged, dx = mapped(arranged, x, labels)
+    # [p, vpp, ...] -> [V, ...] layer order
+    grads = jax.tree.map(
+        lambda g: g.reshape((p * vpp,) + g.shape[2:])[inv], grads_arranged)
+    if return_dx:
+        return loss, grads, dx
+    return loss, grads
 
 
-def _schedule_body(params, x, labels, *, stage_fn, loss_fn, axis_name, p, S,
-                   ops_tbl, mic_tbl, fwd_perm, bwd_perm):
+def _schedule_body(params, x, labels, *, stage_fn, loss_fn, axis_name, p,
+                   vpp, S, tables):
+    (ops_tbl, mic_tbl, chk_tbl,
+     adir_tbl, arc_tbl, cdir_tbl, crc_tbl, last_tbl, first_tbl) = tables
     r = lax.axis_index(axis_name)
-    is_last = r == p - 1
-    local = jax.tree.map(lambda l: l[0], params)   # this device's stage
+    local = jax.tree.map(lambda l: l[0], params)   # [vpp, ...] leaves
     mb_shape = x.shape[1:]
     zero_mb = jnp.zeros(mb_shape, x.dtype)
 
-    act = jnp.zeros((S,) + mb_shape, x.dtype)   # saved stage inputs
-    rcv = jnp.zeros((S,) + mb_shape, x.dtype)   # activations from stage r-1
-    cot = jnp.zeros((S,) + mb_shape, x.dtype)   # cotangents from stage r+1
+    act = jnp.zeros((vpp, S) + mb_shape, x.dtype)  # saved chunk inputs
+    rcv = jnp.zeros((vpp, S) + mb_shape, x.dtype)  # incoming activations
+    cot = jnp.zeros((vpp, S) + mb_shape, x.dtype)  # incoming cotangents
+    dxs0 = jnp.zeros_like(x)                       # input grads (stage of v0)
     grads0 = jax.tree.map(jnp.zeros_like, local)
     loss0 = jnp.zeros((), jnp.float32)
 
+    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+    bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+    no_send = (zero_mb, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+               jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+
     def tick(carry, t):
-        act, rcv, cot, grads, loss = carry
+        act, rcv, cot, dxs, grads, loss = carry
         op = jnp.take(ops_tbl[t], r)
         micro = jnp.take(mic_tbl[t], r)
+        c = jnp.take(chk_tbl[t], r)
+        a_dir = jnp.take(adir_tbl[t], r)
+        a_rc = jnp.take(arc_tbl[t], r)
+        c_dir = jnp.take(cdir_tbl[t], r)
+        c_rc = jnp.take(crc_tbl[t], r)
+        lastf = jnp.take(last_tbl[t], r)
+        firstf = jnp.take(first_tbl[t], r)
         slot = micro % S
-        x_in = jnp.where(r == 0, x[micro], rcv[slot])
-        saved = act[slot]
-        dy = cot[slot]
-        no_send = (zero_mb, jnp.zeros((), jnp.int32))
+        params_c = jax.tree.map(lambda l: jnp.take(l, c, axis=0), local)
+        x_in = jnp.where(firstf > 0, x[micro], rcv[c, slot])
+        saved = act[c, slot]
+        dy = cot[c, slot]
 
-        def do_idle(act, cot, grads, loss):
-            return act, cot, grads, loss, no_send, no_send
+        # send payload: (data, micro, recv_chunk, kind, valid-dir)
+        def do_idle(act, rcv, cot, dxs, grads, loss):
+            return act, rcv, cot, dxs, grads, loss, no_send, no_send
 
-        def do_f(act, cot, grads, loss):
-            y = stage_fn(local, x_in)
-            # last stage computes the per-micro loss and seeds the cotangent
-            l, dy_seed = jax.value_and_grad(
-                lambda yy: loss_fn(yy, labels[micro]))(y)
-            act = act.at[slot].set(x_in)
-            cot = cot.at[slot].set(jnp.where(is_last, dy_seed, cot[slot]))
-            loss = loss + jnp.where(is_last, l, 0.0)
-            valid = jnp.where(is_last, 0, 1).astype(jnp.int32)
-            return act, cot, grads, loss, (y, valid), no_send
+        def do_f(act, rcv, cot, dxs, grads, loss):
+            y = stage_fn(params_c, x_in)
+            # ONLY the last VIRTUAL stage evaluates loss_fn (which may
+            # contain the full head projection) and seeds its cotangent;
+            # lax.cond keeps every other F tick free of that cost
+            l, dy_seed = lax.cond(
+                lastf > 0,
+                lambda yy: jax.value_and_grad(
+                    lambda zz: loss_fn(zz, labels[micro]))(yy),
+                lambda yy: (jnp.zeros((), jnp.float32),
+                            jnp.zeros_like(yy)),
+                y)
+            act = act.at[c, slot].set(x_in)
+            cot = cot.at[c, slot].set(
+                jnp.where(lastf > 0, dy_seed, cot[c, slot]))
+            loss = loss + l
+            # ZBV turn: the next virtual stage lives on THIS device
+            local_tgt = (a_dir == _DIR_LOCAL)
+            rcv = rcv.at[a_rc, slot].set(
+                jnp.where(local_tgt, y, rcv[a_rc, slot]))
+            plus = (y, micro, a_rc,
+                    jnp.full((), _KIND_ACT, jnp.int32),
+                    (a_dir == _DIR_PLUS).astype(jnp.int32))
+            minus = (y, micro, a_rc,
+                     jnp.full((), _KIND_ACT, jnp.int32),
+                     (a_dir == _DIR_MINUS).astype(jnp.int32))
+            return act, rcv, cot, dxs, grads, loss, plus, minus
 
-        def do_bi(act, cot, grads, loss):
-            _, vjp = jax.vjp(lambda xx: stage_fn(local, xx), saved)
+        def do_bi(act, rcv, cot, dxs, grads, loss):
+            _, vjp = jax.vjp(lambda xx: stage_fn(params_c, xx), saved)
             dx = vjp(dy)[0]
-            valid = jnp.where(r == 0, 0, 1).astype(jnp.int32)
-            return act, cot, grads, loss, no_send, (dx, valid)
+            local_tgt = (c_dir == _DIR_LOCAL)
+            cot = cot.at[c_rc, slot].set(
+                jnp.where(local_tgt, dx, cot[c_rc, slot]))
+            # Bi at virtual stage 0: dx IS d(loss)/d(x[micro])
+            dxs = dxs.at[micro].set(
+                jnp.where(firstf > 0, dx.astype(dxs.dtype), dxs[micro]))
+            plus = (dx, micro, c_rc,
+                    jnp.full((), _KIND_COT, jnp.int32),
+                    (c_dir == _DIR_PLUS).astype(jnp.int32))
+            minus = (dx, micro, c_rc,
+                     jnp.full((), _KIND_COT, jnp.int32),
+                     (c_dir == _DIR_MINUS).astype(jnp.int32))
+            return act, rcv, cot, dxs, grads, loss, plus, minus
 
-        def do_w(act, cot, grads, loss):
-            _, vjp = jax.vjp(lambda pp: stage_fn(pp, saved), local)
+        def do_w(act, rcv, cot, dxs, grads, loss):
+            _, vjp = jax.vjp(lambda pp: stage_fn(pp, saved), params_c)
             dw = vjp(dy)[0]
-            grads = jax.tree.map(jnp.add, grads, dw)
-            return act, cot, grads, loss, no_send, no_send
+            grads = jax.tree.map(
+                lambda g, d: g.at[c].add(d.astype(g.dtype)), grads, dw)
+            return act, rcv, cot, dxs, grads, loss, no_send, no_send
 
-        act, cot, grads, loss, (y_s, yv), (dx_s, dv) = lax.switch(
-            op, [do_idle, do_f, do_bi, do_w], act, cot, grads, loss)
+        act, rcv, cot, dxs, grads, loss, plus, minus = lax.switch(
+            op, [do_idle, do_f, do_bi, do_w], act, rcv, cot, dxs, grads,
+            loss)
 
-        # one activation hop (+1 ring) and one cotangent hop (-1 ring) per
-        # tick; wrap-around payloads are dropped via the validity tag
-        ry, rym, ryv = lax.ppermute((y_s, micro, yv), axis_name, fwd_perm)
-        rd, rdm, rdv = lax.ppermute((dx_s, micro, dv), axis_name, bwd_perm)
-        rslot = rym % S
-        rcv = rcv.at[rslot].set(jnp.where(ryv > 0, ry, rcv[rslot]))
-        dslot = rdm % S
-        cot = cot.at[dslot].set(jnp.where(rdv > 0, rd, cot[dslot]))
-        return (act, rcv, cot, grads, loss), None
+        # one +1-ring hop and one -1-ring hop per tick; payloads carry
+        # (data, micro, chunk, kind, valid) and wrap-arounds are dropped
+        # via the validity tag
+        rp = lax.ppermute(plus, axis_name, fwd_perm)
+        rm = lax.ppermute(minus, axis_name, bwd_perm)
+        for (data, m_, rc_, kind, val) in (rp, rm):
+            s_ = m_ % S
+            take_act = (val > 0) & (kind == _KIND_ACT)
+            take_cot = (val > 0) & (kind == _KIND_COT)
+            rcv = rcv.at[rc_, s_].set(jnp.where(take_act, data, rcv[rc_, s_]))
+            cot = cot.at[rc_, s_].set(jnp.where(take_cot, data, cot[rc_, s_]))
+        return (act, rcv, cot, dxs, grads, loss), None
 
-    (_, _, _, grads, loss), _ = lax.scan(
-        tick, (act, rcv, cot, grads0, loss0), jnp.arange(ops_tbl.shape[0]))
-    total = lax.psum(loss, axis_name)  # only the last stage contributes
-    return total, jax.tree.map(lambda g: g[None], grads)
+    (_, _, _, dxs, grads, loss), _ = lax.scan(
+        tick, (act, rcv, cot, dxs0, grads0, loss0),
+        jnp.arange(ops_tbl.shape[0]))
+    total = lax.psum(loss, axis_name)  # only the loss-owning stage adds
+    # dxs is nonzero only on the stage holding virtual stage 0
+    dx_total = lax.psum(dxs, axis_name)
+    return total, jax.tree.map(lambda g: g[None], grads), dx_total
+
+
+def scheduled_pipeline_loss(stage_params, x_embedded, labels, stage_fn,
+                            loss_fn, mesh, axis_name="pp", schedule="zbh1",
+                            cap=None, vpp=1, x_spec=None, param_spec=None):
+    """Differentiable wrapper: composes the fused fwd+bwd executor with
+    OUTER autodiff (an embedding in front of the pipeline, an optimizer
+    jitted around it).
+
+    The executor produces (loss, param-grads, input-grads) in one pass;
+    since every downstream use of a scalar loss is linear in its cotangent,
+    the custom VJP simply scales the stored grads — the same contract the
+    reference's Job-based executor exposes to its optimizer stage.
+    """
+    def _run_all(stage_params, x_embedded):
+        return pipeline_train_step(
+            stage_params, x_embedded, labels, stage_fn, loss_fn, mesh,
+            axis_name=axis_name, schedule=schedule, cap=cap, vpp=vpp,
+            x_spec=x_spec, param_spec=param_spec, return_dx=True)
+
+    @jax.custom_vjp
+    def _run(stage_params, x_embedded):
+        loss, _, _ = _run_all(stage_params, x_embedded)
+        return loss
+
+    def _fwd(stage_params, x_embedded):
+        loss, grads, dx = _run_all(stage_params, x_embedded)
+        return loss, (grads, dx)
+
+    def _bwd(res, ct):
+        grads, dx = res
+        return (jax.tree.map(lambda g: g * ct, grads), dx * ct)
+
+    _run.defvjp(_fwd, _bwd)
+    return _run(stage_params, x_embedded)
 
 
 __all__ = ["build_schedule", "validate_schedule", "pipeline_train_step",
-           "Schedule", "IDLE", "F_OP", "BI_OP", "W_OP"]
+           "scheduled_pipeline_loss", "Schedule", "IDLE", "F_OP", "BI_OP",
+           "W_OP"]
