@@ -58,6 +58,7 @@ from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless

@@ -130,6 +130,11 @@ def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
                 f"{n_blocks} blocks not divisible by pp*vpp={pp * vpp}")
         lps = n_blocks // (pp * vpp)
     else:
+        if vpp != 1:
+            raise ValueError(
+                f"schedule {schedule!r} (circular pipeline) does not take "
+                "vpp>1 — use schedule='interleaved'/'zbv' for virtual "
+                "chunks")
         if n_blocks % pp:
             raise ValueError(f"{n_blocks} blocks not divisible by pp={pp}")
         lps = n_blocks // pp

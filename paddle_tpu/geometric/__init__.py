@@ -1,0 +1,29 @@
+"""paddle.geometric — graph-learning primitives, TPU-style.
+
+Reference package: python/paddle/geometric/ (send_recv.py:55 send_u_recv,
+:210 send_ue_recv, :413 send_uv; math.py segment_*; reindex.py:34
+reindex_graph; sampling/neighbors.py:30 sample_neighbors). Where the
+reference routes these through dedicated CUDA kernels
+(paddle/phi/kernels/gpu/graph_send_recv_kernel.cu), the TPU formulation is
+gather + ``jax.ops.segment_*``: XLA lowers segment reductions onto sorted
+scatter-adds that tile well on the MXU/VPU, and the message ops fuse into
+the gather.
+
+Shape note (XLA static-shape discipline): the segment reductions need the
+output row count at trace time. Eagerly it is inferred from the data
+(``max(dst_index)+1``, the reference's behavior); under ``jit`` pass
+``out_size`` explicitly. Sampling/reindex are data-dependent-size host ops
+(eager-only), mirroring the reference's CPU/GPU kernels that also produce
+data-dependent shapes.
+"""
+from .math import segment_max, segment_mean, segment_min, segment_sum
+from .message_passing import send_u_recv, send_ue_recv, send_uv
+from .reindex import reindex_graph, reindex_heter_graph
+from .sampling import sample_neighbors, weighted_sample_neighbors
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]

@@ -1,0 +1,61 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py).
+
+``segment_ids`` must be sorted non-decreasing in the reference contract;
+``jax.ops.segment_*`` accepts unsorted ids, so this surface is strictly
+more permissive while matching reference outputs on valid inputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size if not isinstance(out_size, Tensor)
+                   else out_size.numpy())
+    ids = segment_ids._data if isinstance(segment_ids, Tensor) else segment_ids
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            "segment reduction under jit needs a static out_size (XLA "
+            "static-shape discipline); pass out_size=<int>")
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _reduce(vals, ids, n, reduce_op):
+    """Shared segment-reduce with the reference's empty-segment semantics:
+    untouched output rows are 0 (not +-inf identities), mean divides by
+    max(count, 1). Used by both segment_* and the send_*_recv family."""
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(vals, ids, num_segments=n)
+    counts = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                                 num_segments=n)
+    shape = (n,) + (1,) * (vals.ndim - 1)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(vals, ids, num_segments=n)
+        return s / jnp.maximum(counts, 1).reshape(shape).astype(s.dtype)
+    jfn = {"min": jax.ops.segment_min, "max": jax.ops.segment_max}[reduce_op]
+    out = jfn(vals, ids, num_segments=n)
+    return jnp.where(counts.reshape(shape) > 0, out, 0)
+
+
+def _segment(op_name, reduce_op):
+    def op(data, segment_ids, out_size=None, name=None):
+        n = _num_segments(segment_ids, out_size)
+        return eager_apply(
+            op_name, lambda d, ids: _reduce(d, ids, n, reduce_op),
+            (data, segment_ids), {})
+
+    op.__name__ = op_name
+    return op
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_min = _segment("segment_min", "min")
+segment_max = _segment("segment_max", "max")
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]

@@ -1,0 +1,75 @@
+"""Graph message passing (reference: geometric/message_passing/send_recv.py).
+
+send_u_recv  — gather source-node features along edges, reduce at the
+               destination (send_recv.py:55; CUDA kernel
+               phi/kernels/gpu/graph_send_recv_kernel.cu).
+send_ue_recv — same, but the gathered features first combine with edge
+               features via add/sub/mul/div (send_recv.py:210).
+send_uv      — edge features from both endpoints (send_recv.py:413).
+
+All three are differentiable through the eager tape (gather/segment ops
+have native JAX VJPs) and trace under jit when ``out_size`` is static.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+from ..core.tensor import Tensor
+from .math import _num_segments, _reduce
+
+_MESSAGE = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+_REDUCE_OPS = ("sum", "mean", "min", "max")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] per edge, reduce into dst rows (send_recv.py:55)."""
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(f"reduce_op must be one of {list(_REDUCE_OPS)}")
+    n = _num_segments(dst_index, out_size)
+
+    def fn(x, src, dst):
+        return _reduce(x[src], dst, n, reduce_op)
+
+    return eager_apply("send_u_recv", fn, (x, src_index, dst_index), {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """x[src] (op) y[edge], reduced into dst rows (send_recv.py:210).
+
+    ``y``: per-edge features broadcastable against the gathered x rows.
+    """
+    if message_op not in _MESSAGE:
+        raise ValueError(f"message_op must be one of {list(_MESSAGE)}")
+    if reduce_op not in _REDUCE_OPS:
+        raise ValueError(f"reduce_op must be one of {list(_REDUCE_OPS)}")
+    n = _num_segments(dst_index, out_size)
+
+    def fn(x, y, src, dst):
+        return _reduce(_MESSAGE[message_op](x[src], y), dst, n, reduce_op)
+
+    return eager_apply("send_ue_recv", fn, (x, y, src_index, dst_index), {})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge features from both endpoints: x[src] (op) y[dst]
+    (send_recv.py:413)."""
+    if message_op not in _MESSAGE:
+        raise ValueError(f"message_op must be one of {list(_MESSAGE)}")
+
+    def fn(x, y, src, dst):
+        return _MESSAGE[message_op](x[src], y[dst])
+
+    return eager_apply("send_uv", fn, (x, y, src_index, dst_index), {})
+
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]

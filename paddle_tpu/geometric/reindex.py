@@ -1,0 +1,60 @@
+"""Graph reindex (reference: python/paddle/geometric/reindex.py:34
+reindex_graph, :120 reindex_heter_graph; CPU kernel
+phi/kernels/cpu/graph_reindex_kernel.cc).
+
+Compacts a sampled subgraph to contiguous local ids: input nodes first (in
+order), then previously-unseen neighbors in first-appearance order. Output
+sizes are data-dependent, so this is an eager host op (the reference's
+value_buffer/index_buffer fast path is a GPU hashtable — irrelevant here).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _reindex(x, neighbor_lists, count_lists):
+    xs = _np(x).ravel()
+    order = {int(n): i for i, n in enumerate(xs)}
+    out_nodes = list(xs)
+    srcs, dsts = [], []
+    for neighbors, counts in zip(neighbor_lists, count_lists):
+        nb = _np(neighbors).ravel()
+        ct = _np(counts).ravel()
+        # dst of edge j is the input node owning that neighbor slot
+        dst_ids = np.repeat(np.arange(len(ct)), ct)
+        for n in nb:
+            n = int(n)
+            if n not in order:
+                order[n] = len(out_nodes)
+                out_nodes.append(n)
+        srcs.append(np.asarray([order[int(n)] for n in nb], np.int64))
+        dsts.append(dst_ids.astype(np.int64))
+    return srcs, dsts, np.asarray(out_nodes, np.int64)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """-> (reindex_src, reindex_dst, out_nodes) (reindex.py:34)."""
+    srcs, dsts, out_nodes = _reindex(x, [neighbors], [count])
+    return (Tensor(jnp.asarray(srcs[0])), Tensor(jnp.asarray(dsts[0])),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: lists of neighbor/count tensors sharing one
+    output id space (reindex.py:120)."""
+    srcs, dsts, out_nodes = _reindex(x, list(neighbors), list(count))
+    return ([Tensor(jnp.asarray(s)) for s in srcs],
+            [Tensor(jnp.asarray(d)) for d in dsts],
+            Tensor(jnp.asarray(out_nodes)))
+
+
+__all__ = ["reindex_graph", "reindex_heter_graph"]

@@ -1,0 +1,84 @@
+"""Neighbor sampling over CSC graphs (reference:
+python/paddle/geometric/sampling/neighbors.py:30 sample_neighbors, :190
+weighted_sample_neighbors; kernels phi/kernels/cpu/
+graph_sample_neighbors_kernel.cc).
+
+Graph layout matches the reference: ``row`` holds the in-neighbors of node
+n at ``row[colptr[n]:colptr[n+1]]``. Sampling is a data-dependent-size
+host op (eager-only); randomness draws from the paddle global RNG so
+``paddle.seed`` reproduces draws.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _rng
+
+
+def _np(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+def _host_rng():
+    import jax
+    k = _rng.next_key()
+    # derive a host seed from the device key deterministically
+    return np.random.default_rng(
+        int(jax.random.randint(k, (), 0, 2**31 - 1)))
+
+
+def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
+            weights=None):
+    rown = _np(row).ravel()
+    cp = _np(colptr).ravel()
+    nodes = _np(input_nodes).ravel()
+    eid = _np(eids).ravel() if eids is not None else None
+    w = _np(weights).ravel() if weights is not None else None
+    rng = _host_rng()
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(cp[int(n)]), int(cp[int(n) + 1])
+        deg = hi - lo
+        idx = np.arange(lo, hi)
+        if 0 < sample_size < deg:
+            if w is not None:
+                p = w[lo:hi].astype(np.float64)
+                p = p / p.sum()
+                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+            else:
+                idx = rng.choice(idx, size=sample_size, replace=False)
+        out_n.append(rown[idx])
+        out_c.append(len(idx))
+        if eid is not None:
+            out_e.append(eid[idx])
+    neighbors = Tensor(jnp.asarray(np.concatenate(out_n)
+                                   if out_n else np.zeros(0, rown.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_c, np.int32)))
+    if return_eids:
+        if eid is None:
+            raise ValueError("return_eids=True needs eids")
+        oe = (np.concatenate(out_e) if out_e
+              else np.zeros(0, eid.dtype))
+        return neighbors, counts, Tensor(jnp.asarray(oe))
+    return neighbors, counts
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform k-neighbor sampling (neighbors.py:30): returns
+    (out_neighbors, out_count[, out_eids])."""
+    return _sample(row, colptr, input_nodes, int(sample_size), eids,
+                   return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased sampling without replacement (neighbors.py:190)."""
+    return _sample(row, colptr, input_nodes, int(sample_size), eids,
+                   return_eids, weights=edge_weight)
+
+
+__all__ = ["sample_neighbors", "weighted_sample_neighbors"]

@@ -1,0 +1,223 @@
+"""paddle.geometric parity tests.
+
+Oracles are the reference's own docstring examples
+(python/paddle/geometric/message_passing/send_recv.py:79-101,240-260,
+442-460; reindex.py:51-55; math.py examples) plus numpy re-derivations.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def I(x):
+    return paddle.to_tensor(np.asarray(x, np.int64))
+
+
+class TestSendRecv:
+    X = [[0.0, 2.0, 3.0], [1.0, 4.0, 5.0], [2.0, 6.0, 7.0]]
+    SRC = [0, 1, 2, 0]
+    DST = [1, 2, 1, 0]
+
+    def test_send_u_recv_sum(self):
+        # reference example (send_recv.py:79): out = [[0,2,3],[2,8,10],[1,4,5]]
+        out = G.send_u_recv(T(self.X), I(self.SRC), I(self.DST), "sum")
+        np.testing.assert_allclose(
+            out.numpy(), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+    def test_send_u_recv_mean_max_min(self):
+        x, s, d = T(self.X), I(self.SRC), I(self.DST)
+        np.testing.assert_allclose(
+            G.send_u_recv(x, s, d, "mean").numpy(),
+            [[0, 2, 3], [1, 4, 5], [1, 4, 5]])
+        np.testing.assert_allclose(
+            G.send_u_recv(x, s, d, "max").numpy(),
+            [[0, 2, 3], [2, 6, 7], [1, 4, 5]])
+        np.testing.assert_allclose(
+            G.send_u_recv(x, s, d, "min").numpy(),
+            [[0, 2, 3], [0, 2, 3], [1, 4, 5]])
+
+    def test_out_size_pads_and_truncates(self):
+        # reference: out_size >= max(dst)+1 zero-pads extra rows
+        out = G.send_u_recv(T(self.X), I(self.SRC), I(self.DST), "sum",
+                            out_size=5)
+        assert tuple(out.shape) == (5, 3)
+        np.testing.assert_allclose(out.numpy()[3:], 0)
+        # max-reduce with out_size: empty rows are 0, not -inf
+        out = G.send_u_recv(T(self.X), I(self.SRC), I(self.DST), "max",
+                            out_size=5)
+        np.testing.assert_allclose(out.numpy()[3:], 0)
+
+    def test_send_ue_recv(self):
+        # reference example (send_recv.py:240): y = [1,1,1,1] broadcasts,
+        # add then sum-reduce: out = [[1,3,4],[4,10,12],[2,5,6]]
+        y = T([1.0, 1.0, 1.0, 1.0]).reshape([4, 1])
+        out = G.send_ue_recv(T(self.X), y, I(self.SRC), I(self.DST),
+                             "add", "sum")
+        np.testing.assert_allclose(
+            out.numpy(), [[1, 3, 4], [4, 10, 12], [2, 5, 6]])
+
+    def test_send_uv(self):
+        # x[src] + y[dst] per edge
+        x = T(self.X)
+        y = T([[1.0, 1.0, 1.0]] * 3)
+        out = G.send_uv(x, y, I(self.SRC), I(self.DST), "add")
+        ref = np.asarray(self.X)[self.SRC] + np.asarray(y.numpy())[self.DST]
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_send_u_recv_grad(self):
+        x = T(self.X)
+        x.stop_gradient = False
+        out = G.send_u_recv(x, I(self.SRC), I(self.DST), "sum")
+        out.sum().backward()
+        # node 0 feeds 2 edges, others 1
+        np.testing.assert_allclose(x.grad.numpy()[:, 0], [2, 1, 1])
+
+    def test_jit_with_static_out_size(self):
+        import paddle_tpu.jit as jit
+
+        @jit.to_static
+        def f(x, s, d):
+            return G.send_u_recv(x, s, d, "sum", out_size=3)
+
+        out = f(T(self.X), I(self.SRC), I(self.DST))
+        np.testing.assert_allclose(
+            out.numpy(), [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+
+class TestSegment:
+    def test_segment_ops(self):
+        data = T([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0], [4.0, 5.0, 6.0]])
+        ids = I([0, 0, 1])
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 4, 4], [4, 5, 6]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 2, 2], [4, 5, 6]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2, 1], [4, 5, 6]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 2, 3], [4, 5, 6]])
+
+    def test_segment_grad(self):
+        data = T([[1.0], [2.0], [3.0]])
+        data.stop_gradient = False
+        G.segment_sum(data, I([0, 1, 1])).sum().backward()
+        np.testing.assert_allclose(data.grad.numpy().ravel(), [1, 1, 1])
+
+
+class TestReindex:
+    def test_reindex_graph(self):
+        # reference example (reindex.py:51-55)
+        x = I([0, 1, 2])
+        neighbors = I([8, 9, 0, 4, 7, 6, 7])
+        count = paddle.to_tensor(np.asarray([2, 3, 2], np.int32))
+        src, dst, out_nodes = G.reindex_graph(x, neighbors, count)
+        np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+        np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+        np.testing.assert_array_equal(out_nodes.numpy(),
+                                      [0, 1, 2, 8, 9, 4, 7, 6])
+
+    def test_reindex_heter_graph(self):
+        x = I([0, 1, 2])
+        n1, c1 = I([8, 9, 0, 4, 7, 6, 7]), I([2, 3, 2])
+        n2, c2 = I([0, 2, 3, 5, 1]), I([1, 3, 1])
+        srcs, dsts, out_nodes = G.reindex_heter_graph(x, [n1, n2], [c1, c2])
+        assert len(srcs) == 2 and len(dsts) == 2
+        # shared id space: node 0/2 map to their input slots
+        np.testing.assert_array_equal(srcs[1].numpy()[:2], [0, 2])
+
+
+class TestSampling:
+    def _csc(self):
+        # 4 nodes; in-neighbors: 0<-{1,2,3}, 1<-{0}, 2<-{0,1}, 3<-{}
+        row = I([1, 2, 3, 0, 0, 1])
+        colptr = I([0, 3, 4, 6, 6])
+        return row, colptr
+
+    def test_full_neighborhood(self):
+        row, colptr = self._csc()
+        nbr, cnt = G.sample_neighbors(row, colptr, I([0, 2, 3]),
+                                      sample_size=-1)
+        np.testing.assert_array_equal(cnt.numpy(), [3, 2, 0])
+        np.testing.assert_array_equal(nbr.numpy(), [1, 2, 3, 0, 1])
+
+    def test_sampled_subset_and_determinism(self):
+        row, colptr = self._csc()
+        paddle.seed(7)
+        nbr1, cnt1 = G.sample_neighbors(row, colptr, I([0]), sample_size=2)
+        assert cnt1.numpy()[0] == 2
+        assert set(np.asarray(nbr1.numpy())) <= {1, 2, 3}
+        paddle.seed(7)
+        nbr2, _ = G.sample_neighbors(row, colptr, I([0]), sample_size=2)
+        np.testing.assert_array_equal(nbr1.numpy(), nbr2.numpy())
+
+    def test_eids_and_weighted(self):
+        row, colptr = self._csc()
+        eids = I([10, 11, 12, 13, 14, 15])
+        nbr, cnt, oe = G.sample_neighbors(row, colptr, I([1, 2]),
+                                          sample_size=-1, eids=eids,
+                                          return_eids=True)
+        np.testing.assert_array_equal(oe.numpy(), [13, 14, 15])
+        w = T([0.0, 0.0, 1.0, 1.0, 1.0, 1.0])
+        paddle.seed(0)
+        nbr, cnt = G.weighted_sample_neighbors(row, colptr, w, I([0]),
+                                               sample_size=1)
+        # weights zero out neighbors 1 and 2 of node 0 -> must pick 3
+        np.testing.assert_array_equal(nbr.numpy(), [3])
+
+
+@pytest.mark.slow
+def test_gcn_trains():
+    """A 2-layer GCN over send_u_recv(mean) learns a toy 2-community node
+    classification — the end-to-end proof the subsystem composes with
+    nn/optimizer/autograd."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    n, d = 20, 8
+    # two communities with dense intra-community edges + self loops
+    edges = [(i, j) for i in range(10) for j in range(10) if i != j]
+    edges += [(i, j) for i in range(10, 20) for j in range(10, 20) if i != j]
+    edges += [(i, i) for i in range(n)]
+    src = I([e[0] for e in edges])
+    dst = I([e[1] for e in edges])
+    x = T(rng.standard_normal((n, d)))
+    labels = paddle.to_tensor(np.asarray([0] * 10 + [1] * 10, np.int64))
+
+    class GCNLayer(nn.Layer):
+        def __init__(self, din, dout):
+            super().__init__()
+            self.lin = nn.Linear(din, dout)
+
+        def forward(self, h):
+            return G.send_u_recv(self.lin(h), src, dst, "mean", out_size=n)
+
+    class GCN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = GCNLayer(d, 16)
+            self.l2 = GCNLayer(16, 2)
+
+        def forward(self, h):
+            return self.l2(paddle.nn.functional.relu(self.l1(h)))
+
+    paddle.seed(0)
+    model = GCN()
+    opt = paddle.optimizer.Adam(learning_rate=5e-2,
+                                parameters=model.parameters())
+    losses = []
+    for _ in range(30):
+        loss = F.cross_entropy(model(x), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.1 * losses[0], losses
+    pred = np.argmax(np.asarray(model(x).numpy()), -1)
+    assert (pred == np.asarray(labels.numpy())).mean() == 1.0
