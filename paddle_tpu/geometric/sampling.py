@@ -45,8 +45,12 @@ def _sample(row, colptr, input_nodes, sample_size, eids, return_eids,
         if 0 < sample_size < deg:
             if w is not None:
                 p = w[lo:hi].astype(np.float64)
-                p = p / p.sum()
-                idx = rng.choice(idx, size=sample_size, replace=False, p=p)
+                tot = p.sum()
+                if tot > 0:
+                    idx = rng.choice(idx, size=sample_size, replace=False,
+                                     p=p / tot)
+                else:  # all-zero weights degrade to uniform sampling
+                    idx = rng.choice(idx, size=sample_size, replace=False)
             else:
                 idx = rng.choice(idx, size=sample_size, replace=False)
         out_n.append(rown[idx])

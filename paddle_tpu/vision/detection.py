@@ -1,0 +1,694 @@
+"""Detection op zoo, TPU-style (reference: python/paddle/vision/ops.py —
+yolo_box:277, prior_box:438, box_coder:584, deform_conv2d:766,
+distribute_fpn_proposals:1175, psroi_pool:1441, roi_pool:1572,
+generate_proposals:2106, matrix_nms:2358; kernels under
+paddle/phi/kernels/{cpu,gpu}/).
+
+Formulation notes (SURVEY §2 static-shape discipline):
+- Dense decoders (yolo_box, prior_box, box_coder, deform_conv2d, roi_pool,
+  psroi_pool) are fully vectorized static-shape jnp — they jit and
+  differentiate where the reference's do.
+- The NMS family (multiclass_nms3, matrix_nms, generate_proposals,
+  distribute_fpn_proposals) computes suppression masks/scores at static
+  shape on device, then compacts the variable-length result on the host —
+  the same split the reference makes after its CUDA kernels return
+  selection masks.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core_compat import _apply, param
+
+
+
+def _np_of(x):
+    return np.asarray(param(x)._data if not isinstance(x, np.ndarray) else x)
+
+
+# ---------------------------------------------------------------- yolo_box
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """YOLOv3 box decoding (ops.py:277; cpu/yolo_box_kernel.cc).
+
+    x: [N, C, H, W] with C = an_num*(5+class_num) (+an_num if iou_aware).
+    Returns (boxes [N, an_num*H*W, 4] xyxy, scores [N, an_num*H*W, cls]).
+    Boxes below conf_thresh are zeroed (the kernel's memset semantics).
+    """
+    anchors = list(anchors)
+    an_num = len(anchors) // 2
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(x, img_size):
+        n, c, h, w = x.shape
+        in_h, in_w = downsample_ratio * h, downsample_ratio * w
+        if iou_aware:
+            iou_pred = jax.nn.sigmoid(
+                x[:, :an_num].reshape(n, an_num, 1, h, w))
+            x = x[:, an_num:]
+        t = x.reshape(n, an_num, 5 + class_num, h, w)
+        img_h = img_size[:, 0].astype(t.dtype)[:, None, None, None]
+        img_w = img_size[:, 1].astype(t.dtype)[:, None, None, None]
+        gx = jnp.arange(w, dtype=t.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=t.dtype)[None, None, :, None]
+        cx = (gx + jax.nn.sigmoid(t[:, :, 0]) * scale + bias) * img_w / w
+        cy = (gy + jax.nn.sigmoid(t[:, :, 1]) * scale + bias) * img_h / h
+        aw = jnp.asarray(anchors[0::2], t.dtype)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], t.dtype)[None, :, None, None]
+        bw = jnp.exp(t[:, :, 2]) * aw * img_w / in_w
+        bh = jnp.exp(t[:, :, 3]) * ah * img_h / in_h
+        conf = jax.nn.sigmoid(t[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                iou_pred[:, :, 0] ** iou_aware_factor
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=-1)
+        if clip_bbox:
+            boxes = jnp.stack([
+                jnp.maximum(boxes[..., 0], 0),
+                jnp.maximum(boxes[..., 1], 0),
+                jnp.minimum(boxes[..., 2], img_w[..., None][..., 0] - 1),
+                jnp.minimum(boxes[..., 3], img_h[..., None][..., 0] - 1),
+            ], axis=-1)
+        scores = conf[:, :, None] * jax.nn.sigmoid(t[:, :, 5:])
+        keep = (conf >= conf_thresh).astype(t.dtype)
+        boxes = boxes * keep[..., None]
+        scores = scores * keep[:, :, None]
+        # layout [N, an, H, W, k] -> [N, an*H*W, k] (kernel's j*HW + k*w + l)
+        return (boxes.reshape(n, an_num * h * w, 4),
+                scores.transpose(0, 1, 3, 4, 2).reshape(
+                    n, an_num * h * w, class_num))
+
+    out = _apply("yolo_box", f, param(x), param(img_size))
+    return out[0], out[1]
+
+
+# --------------------------------------------------------------- prior_box
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (ops.py:438; cpu/prior_box_kernel.cc).
+
+    Returns (boxes [H, W, num_priors, 4], variances same shape).
+    """
+    def as_list(v):
+        return [float(v)] if isinstance(v, (int, float)) else [
+            float(a) for a in v]
+
+    min_sizes = as_list(min_sizes)
+    max_sizes = as_list(max_sizes) if max_sizes else []
+    ars_in = as_list(aspect_ratios)
+    variance = as_list(variance)
+    # ExpandAspectRatios (prior_box_kernel.h:38): dedup + optional flip
+    ars = [1.0]
+    for ar in ars_in:
+        if all(abs(ar - e) >= 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    def f(input, image):
+        fh, fw = input.shape[2], input.shape[3]
+        ih, iw = image.shape[2], image.shape[3]
+        step_w = steps[0] or iw / fw
+        step_h = steps[1] or ih / fh
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+        whs = []     # (w_half, h_half) per prior, kernel order
+        for s, mn in enumerate(min_sizes):
+            ar_whs = [(mn * math.sqrt(a) / 2, mn / math.sqrt(a) / 2)
+                      for a in ars]
+            mx_whs = []
+            if max_sizes:
+                sq = math.sqrt(mn * max_sizes[s]) / 2
+                mx_whs = [(sq, sq)]
+            if min_max_aspect_ratios_order:
+                # [min(ar=1), max, other ars]
+                whs += [ar_whs[0]] + mx_whs + [
+                    wh for a, wh in zip(ars, ar_whs) if abs(a - 1.0) >= 1e-6]
+            else:
+                whs += ar_whs + mx_whs
+        wh = jnp.asarray(whs, jnp.float32)                       # [P, 2]
+        p_ = wh.shape[0]
+        full = (fh, fw, p_)
+        boxes = jnp.stack([
+            jnp.broadcast_to((cx[None, :, None] - wh[None, None, :, 0]) / iw,
+                             full),
+            jnp.broadcast_to((cy[:, None, None] - wh[None, None, :, 1]) / ih,
+                             full),
+            jnp.broadcast_to((cx[None, :, None] + wh[None, None, :, 0]) / iw,
+                             full),
+            jnp.broadcast_to((cy[:, None, None] + wh[None, None, :, 1]) / ih,
+                             full),
+        ], axis=-1)                                              # [H,W,P,4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    out = _apply("prior_box", f, param(input), param(image))
+    return out[0], out[1]
+
+
+# --------------------------------------------------------------- box_coder
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    """Encode/decode boxes against priors (ops.py:584;
+    cpu/box_coder_kernel.cc EncodeCenterSize/DecodeCenterSize)."""
+    norm = 0.0 if box_normalized else 1.0
+    var_list = None
+    var_tensor = None
+    if prior_box_var is None:
+        pass
+    elif isinstance(prior_box_var, (list, tuple)):
+        var_list = [float(v) for v in prior_box_var]
+    else:
+        var_tensor = param(prior_box_var)
+
+    def center(b):
+        w = b[..., 2] - b[..., 0] + norm
+        h = b[..., 3] - b[..., 1] + norm
+        return b[..., 0] + w / 2, b[..., 1] + h / 2, w, h
+
+    if code_type == "encode_center_size":
+        def f(pb, tb, *v):
+            pcx, pcy, pw, ph = center(pb)              # [M]
+            # kernel: target center is the raw midpoint (no norm shift);
+            # only widths/heights carry the +1 un-normalized offset
+            tcx = (tb[..., 2] + tb[..., 0]) / 2
+            tcy = (tb[..., 3] + tb[..., 1]) / 2
+            tw = tb[..., 2] - tb[..., 0] + norm
+            th = tb[..., 3] - tb[..., 1] + norm
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None, :]) / pw[None, :],
+                (tcy[:, None] - pcy[None, :]) / ph[None, :],
+                jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+                jnp.log(jnp.abs(th[:, None] / ph[None, :])),
+            ], axis=-1)                                # [N, M, 4]
+            if v:
+                out = out / v[0][None, :, :]
+            elif var_list is not None:
+                out = out / jnp.asarray(var_list, out.dtype)
+            return out
+
+        args = (param(prior_box), param(target_box)) + (
+            (var_tensor,) if var_tensor is not None else ())
+        return _apply("box_coder", f, *args)
+
+    if code_type != "decode_center_size":
+        raise ValueError(f"unknown code_type {code_type!r}")
+
+    def g(pb, tb, *v):
+        # tb: [N, M, 4]; pb: [M, 4] (axis=0) or [N, 4] (axis=1)
+        pcx, pcy, pw, ph = center(pb)
+        ex = (None, slice(None)) if axis == 0 else (slice(None), None)
+        pcx, pcy, pw, ph = (a[ex] for a in (pcx, pcy, pw, ph))
+        if v:
+            var = v[0][ex[0], ex[1], :] if v[0].ndim == 2 else v[0]
+            vx, vy, vw, vh = (var[..., k] for k in range(4))
+        elif var_list is not None:
+            vx, vy, vw, vh = var_list
+        else:
+            vx = vy = vw = vh = 1.0
+        cx = vx * tb[..., 0] * pw + pcx
+        cy = vy * tb[..., 1] * ph + pcy
+        w = jnp.exp(vw * tb[..., 2]) * pw
+        h = jnp.exp(vh * tb[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+
+    args = (param(prior_box), param(target_box)) + (
+        (var_tensor,) if var_tensor is not None else ())
+    return _apply("box_coder", g, *args)
+
+
+# ------------------------------------------------------------ deform_conv2d
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (ops.py:766; kernels
+    phi/kernels/impl/deformable_conv_kernel_impl.h).
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Ho, Wo] (y/x interleaved per
+    kernel point, the reference layout); weight: [Cout, Cin/g, kh, kw];
+    mask (v2): [N, dg*kh*kw, Ho, Wo]. Fully differentiable.
+    """
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    dg = deformable_groups
+
+    def f(x, offset, weight, *rest):
+        msk = rest[0] if mask is not None else None
+        bia = rest[-1] if bias is not None else None
+        n, cin, h, w = x.shape
+        cout, cin_g, kh, kw = weight.shape
+        ho = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        wo = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        off = offset.reshape(n, dg, kh * kw, 2, ho, wo)
+        base_y = (jnp.arange(ho) * s[0] - p[0])[:, None]        # [Ho,1]
+        base_x = (jnp.arange(wo) * s[1] - p[1])[None, :]        # [1,Wo]
+        ky = (jnp.arange(kh) * d[0])[:, None]                   # [kh,1]
+        kx = (jnp.arange(kw) * d[1])[None, :]
+        kyx = jnp.stack([jnp.broadcast_to(ky, (kh, kw)).reshape(-1),
+                         jnp.broadcast_to(kx, (kh, kw)).reshape(-1)], -1)
+        # sample positions [N, dg, K, Ho, Wo]
+        py = base_y[None, None, None] + kyx[None, None, :, 0, None, None] \
+            + off[:, :, :, 0]
+        px = base_x[None, None, None] + kyx[None, None, :, 1, None, None] \
+            + off[:, :, :, 1]
+
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = py - y0
+        wx = px - x0
+
+        def gather(img_c, yy, xx):
+            """img_c: [Cg,H,W]; yy/xx: [K,Ho,Wo] -> [Cg,K,Ho,Wo]."""
+            valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            out = img_c[:, yi, xi]
+            return out * valid[None].astype(img_c.dtype)
+
+        cg = cin // dg   # channels per deformable group
+
+        def per_image(img, y0, x0, wy, wx, msk_i):
+            # img [Cin,H,W]; y0.. [dg,K,Ho,Wo]
+            def per_dg(img_g, y0g, x0g, wyg, wxg):
+                v = (gather(img_g, y0g, x0g) * ((1 - wyg) * (1 - wxg))[None]
+                     + gather(img_g, y0g + 1, x0g) * (wyg * (1 - wxg))[None]
+                     + gather(img_g, y0g, x0g + 1) * ((1 - wyg) * wxg)[None]
+                     + gather(img_g, y0g + 1, x0g + 1) * (wyg * wxg)[None])
+                return v                                  # [Cg,K,Ho,Wo]
+            cols = jax.vmap(per_dg)(img.reshape(dg, cg, h, w),
+                                    y0, x0, wy, wx)       # [dg,Cg,K,Ho,Wo]
+            if msk_i is not None:
+                cols = cols * msk_i.reshape(dg, 1, kh * kw, ho, wo)
+            return cols.reshape(cin, kh * kw, ho, wo)
+
+        cols = jax.vmap(per_image)(x, y0, x0, wy, wx, msk)  # [N,Cin,K,Ho,Wo]
+        # grouped conv as matmul: [Cout, Cin/g*K] @ [N, g, Cin/g*K, Ho*Wo]
+        wmat = weight.reshape(groups, cout // groups, cin_g * kh * kw)
+        colsg = cols.reshape(n, groups, (cin // groups) * kh * kw, ho * wo)
+        out = jnp.einsum("gok,ngkp->ngop", wmat, colsg).reshape(
+            n, cout, ho, wo)
+        if bia is not None:
+            out = out + bia[None, :, None, None]
+        return out
+
+    args = [param(x), param(offset), param(weight)]
+    if mask is not None:
+        args.append(param(mask))
+    if bias is not None:
+        args.append(param(bias))
+    return _apply("deform_conv2d", f, *args)
+
+
+# ------------------------------------------------------------- roi pooling
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Quantized max RoI pooling (ops.py:1572; cpu/roi_pool_kernel.cc)."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def f(x, boxes):
+        n, c, h, w = x.shape
+        counts = _np_of(boxes_num)
+        img_idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+        # kernel: round coords then quantize bins; bins clipped to feature
+        bx0 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+        by0 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+        bx1 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+        by1 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(bx1 - bx0 + 1, 1)
+        rh = jnp.maximum(by1 - by0 + 1, 1)
+
+        ph = jnp.arange(out_h)
+        pw = jnp.arange(out_w)
+
+        def one(img_i, x0, y0, rw, rh):
+            img = x[img_i]                                   # [C,H,W]
+            hs = jnp.clip(y0 + (ph * rh) // out_h, 0, h - 1)
+            he = jnp.clip(y0 + ((ph + 1) * rh + out_h - 1) // out_h, 0, h)
+            ws = jnp.clip(x0 + (pw * rw) // out_w, 0, w - 1)
+            we = jnp.clip(x0 + ((pw + 1) * rw + out_w - 1) // out_w, 0, w)
+            yy = jnp.arange(h)
+            xx = jnp.arange(w)
+            mask_h = (yy[None, :] >= hs[:, None]) & (yy[None, :] < he[:, None])
+            mask_w = (xx[None, :] >= ws[:, None]) & (xx[None, :] < we[:, None])
+            m = mask_h[:, None, :, None] & mask_w[None, :, None, :]
+            vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+            out = vals.max(axis=(3, 4))
+            empty = ~m.any(axis=(2, 3))
+            return jnp.where(empty[None], 0.0, out)          # [C,oh,ow]
+
+        return jax.vmap(one)(img_idx, bx0, by0, rw, rh)
+
+    return _apply("roi_pool", f, param(x), param(boxes))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pooling (ops.py:1441;
+    cpu/psroi_pool_kernel.cc). x channels = C_out * out_h * out_w."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def f(x, boxes):
+        n, c, h, w = x.shape
+        if c % (out_h * out_w):
+            raise ValueError(f"psroi_pool: {c} channels not divisible by "
+                             f"{out_h}x{out_w}")
+        co = c // (out_h * out_w)
+        counts = _np_of(boxes_num)
+        img_idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+        bx0 = jnp.round(boxes[:, 0] * spatial_scale)
+        by0 = jnp.round(boxes[:, 1] * spatial_scale)
+        bx1 = jnp.round(boxes[:, 2] * spatial_scale)
+        by1 = jnp.round(boxes[:, 3] * spatial_scale)
+        rw = jnp.maximum(bx1 - bx0, 0.1)
+        rh = jnp.maximum(by1 - by0, 0.1)
+
+        def one(img_i, x0, y0, rw, rh):
+            img = x[img_i].reshape(co, out_h, out_w, h, w)
+            bin_h = rh / out_h
+            bin_w = rw / out_w
+            ph = jnp.arange(out_h)
+            pw = jnp.arange(out_w)
+            hs = jnp.floor(y0 + ph * bin_h).astype(jnp.int32)
+            he = jnp.ceil(y0 + (ph + 1) * bin_h).astype(jnp.int32)
+            ws = jnp.floor(x0 + pw * bin_w).astype(jnp.int32)
+            we = jnp.ceil(x0 + (pw + 1) * bin_w).astype(jnp.int32)
+            hs, he = jnp.clip(hs, 0, h), jnp.clip(he, 0, h)
+            ws, we = jnp.clip(ws, 0, w), jnp.clip(we, 0, w)
+            yy = jnp.arange(h)
+            xx = jnp.arange(w)
+            mask_h = (yy[None, :] >= hs[:, None]) & (yy[None, :] < he[:, None])
+            mask_w = (xx[None, :] >= ws[:, None]) & (xx[None, :] < we[:, None])
+            m = (mask_h[:, None, :, None] & mask_w[None, :, None, :])
+            # position-sensitive: bin (i,j) reads channel block (i,j)
+            vals = img.transpose(0, 1, 2, 3, 4)              # [co,oh,ow,h,w]
+            msum = m.sum(axis=(2, 3)).astype(img.dtype)
+            out = (vals * m[None].astype(img.dtype)).sum(axis=(3, 4))
+            return out / jnp.maximum(msum[None], 1.0)
+
+        return jax.vmap(one)(img_idx, bx0, by0, rw, rh)
+
+    return _apply("psroi_pool", f, param(x), param(boxes))
+
+
+# ---------------------------------------------------------------- box_clip
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image boundaries (legacy detection op box_clip;
+    cpu kernel box_clip_kernel.cc). im_info rows: (h, w, scale)."""
+    def f(b, info):
+        h = info[..., 0] / info[..., 2] - 1
+        w = info[..., 1] / info[..., 2] - 1
+        shape = b.shape
+        bb = b.reshape(shape[0], -1, 4) if b.ndim > 2 else b[None]
+        hh = h.reshape(-1, 1) if info.ndim > 1 else h
+        ww = w.reshape(-1, 1) if info.ndim > 1 else w
+        out = jnp.stack([
+            jnp.minimum(jnp.maximum(bb[..., 0], 0), ww),
+            jnp.minimum(jnp.maximum(bb[..., 1], 0), hh),
+            jnp.minimum(jnp.maximum(bb[..., 2], 0), ww),
+            jnp.minimum(jnp.maximum(bb[..., 3], 0), hh),
+        ], axis=-1)
+        return out.reshape(shape)
+
+    return _apply("box_clip", f, param(input), param(im_info))
+
+
+# -------------------------------------------------------------- NMS family
+
+def _host_iou(a, b, norm_off):
+    aw = max(a[2] - a[0] + norm_off, 0.0)
+    ah = max(a[3] - a[1] + norm_off, 0.0)
+    bw = max(b[2] - b[0] + norm_off, 0.0)
+    bh = max(b[3] - b[1] + norm_off, 0.0)
+    iw = max(min(a[2], b[2]) - max(a[0], b[0]) + norm_off, 0.0)
+    ih = max(min(a[3], b[3]) - max(a[1], b[1]) + norm_off, 0.0)
+    inter = iw * ih
+    denom = aw * ah + bw * bh - inter
+    return inter / denom if denom > 0 else 0.0
+
+
+def _nms_fast(boxes, scores, order, nms_threshold, normalized=True,
+              eta=1.0):
+    """Greedy NMS over pre-sorted candidate indices — the kernel's NMSFast
+    loop (cpu/multiclass_nms3_kernel.cc:300): keep when overlap <=
+    adaptive_threshold; eta < 1 shrinks the threshold after each keep."""
+    norm_off = 0.0 if normalized else 1.0
+    thr = nms_threshold
+    kept = []
+    for idx in order:
+        ok = all(_host_iou(boxes[idx], boxes[k], norm_off) <= thr
+                 for k in kept)
+        if ok:
+            kept.append(idx)
+            if eta < 1 and thr > 0.5:
+                thr *= eta
+    return kept
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=-1, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    return_index=True, return_rois_num=True, name=None):
+    """Per-class greedy NMS (ops.yaml:3495 multiclass_nms3; kernel
+    cpu/multiclass_nms3_kernel.cc).
+
+    bboxes: [N, M, 4]; scores: [N, C, M]. Returns (out [No, 6] rows of
+    (label, score, x1, y1, x2, y2), index [No, 1], nms_rois_num [N]).
+    """
+    from ..core.tensor import Tensor
+
+    b = _np_of(bboxes)
+    s = _np_of(scores)
+    n, m, _ = b.shape
+    c = s.shape[1]
+    outs, idxs, nums = [], [], []
+    for i in range(n):
+        dets = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = s[i, cls]
+            valid = sc > score_threshold
+            if not valid.any():
+                continue
+            cand = np.nonzero(valid)[0]
+            cand = cand[np.argsort(-sc[cand])]
+            if 0 < nms_top_k < len(cand):
+                cand = cand[:nms_top_k]
+            for j in _nms_fast(b[i], sc, cand, nms_threshold,
+                               normalized=normalized, eta=nms_eta):
+                dets.append((cls, sc[j], *b[i, j], i * m + j))
+        dets.sort(key=lambda dd: -dd[1])
+        if 0 < keep_top_k < len(dets):
+            dets = dets[:keep_top_k]
+        outs += [d[:6] for d in dets]
+        idxs += [d[6] for d in dets]
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    index = Tensor(jnp.asarray(np.asarray(idxs, np.int64).reshape(-1, 1)))
+    num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    if return_index and return_rois_num:
+        return out, index, num
+    if return_index:
+        return out, index
+    if return_rois_num:
+        return out, num
+    return out
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (ops.py:2358; cpu/matrix_nms_kernel.cc): parallel decayed
+    re-scoring instead of sequential suppression — the TPU-friendly NMS."""
+    from ..core.tensor import Tensor
+
+    b = _np_of(bboxes)
+    s = _np_of(scores)
+    n, m, _ = b.shape
+    c = s.shape[1]
+    outs, idxs, nums = [], [], []
+    for i in range(n):
+        dets = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            sc = s[i, cls].copy()
+            valid = np.nonzero(sc > score_threshold)[0]
+            if valid.size == 0:
+                continue
+            order = valid[np.argsort(-sc[valid])]
+            if 0 < nms_top_k < len(order):
+                order = order[:nms_top_k]
+            k = len(order)
+            norm_off = 0.0 if normalized else 1.0
+            bx = b[i, order]
+            area = (bx[:, 2] - bx[:, 0] + norm_off) * \
+                (bx[:, 3] - bx[:, 1] + norm_off)
+            lt = np.maximum(bx[:, None, :2], bx[None, :, :2])
+            rb = np.minimum(bx[:, None, 2:], bx[None, :, 2:])
+            wh = np.clip(rb - lt + norm_off, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / np.maximum(
+                area[:, None] + area[None, :] - inter, 1e-10)
+            iou = np.triu(iou, 1)                     # iou[j, l] for j < l
+            # iou_max[j] = max IoU of j with any higher-scored box
+            # (column max of the upper-triangular matrix)
+            max_iou = iou.max(axis=0)
+            if use_gaussian:
+                # decay_score<T,true>: exp((max_iou^2 - iou^2) * sigma)
+                dec = np.exp((max_iou[:, None] ** 2 - iou ** 2)
+                             * gaussian_sigma)
+            else:
+                dec = (1 - iou) / np.maximum(1 - max_iou[:, None], 1e-10)
+            dec = np.where(np.triu(np.ones((k, k), bool), 1), dec, np.inf)
+            decayed = np.minimum(dec.min(axis=0), 1.0) if k else np.ones(0)
+            new_sc = sc[order] * decayed
+            for j, ns_ in zip(order, new_sc):
+                if ns_ > post_threshold:
+                    dets.append((cls, ns_, *b[i, j], i * m + j))
+        dets.sort(key=lambda dd: -dd[1])
+        if 0 < keep_top_k < len(dets):
+            dets = dets[:keep_top_k]
+        outs += [d[:6] for d in dets]
+        idxs += [d[6] for d in dets]
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(
+            np.asarray(idxs, np.int64).reshape(-1, 1))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (ops.py:2106; cpu kernel
+    generate_proposals_kernel.cc): decode deltas against anchors, clip,
+    filter by size, NMS per image.
+
+    scores: [N, A, H, W]; bbox_deltas: [N, 4A, H, W]; anchors/variances:
+    [H, W, A, 4]. Returns (rois [sum, 4], roi_probs [sum, 1], rois_num).
+    """
+    from ..core.tensor import Tensor
+
+    sc = _np_of(scores)
+    bd = _np_of(bbox_deltas)
+    isz = _np_of(img_size)
+    an = _np_of(anchors).reshape(-1, 4)
+    vr = _np_of(variances).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+    rois, probs, nums = [], [], []
+    for i in range(n):
+        s_i = sc[i].transpose(1, 2, 0).ravel()                  # HWA order
+        d_i = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s_i)
+        if 0 < pre_nms_top_n < len(order):
+            order = order[:pre_nms_top_n]
+        s_i, d_i = s_i[order], d_i[order]
+        an_i, vr_i = an[order], vr[order]
+        # variance-scaled center-size decode (the reference's box_coder
+        # semantics inside proposal generation)
+        aw = an_i[:, 2] - an_i[:, 0] + offset
+        ah = an_i[:, 3] - an_i[:, 1] + offset
+        acx = an_i[:, 0] + aw / 2
+        acy = an_i[:, 1] + ah / 2
+        cx = vr_i[:, 0] * d_i[:, 0] * aw + acx
+        cy = vr_i[:, 1] * d_i[:, 1] * ah + acy
+        bw = np.exp(np.minimum(vr_i[:, 2] * d_i[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(vr_i[:, 3] * d_i[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - offset, cy + bh / 2 - offset], -1)
+        ih, iw = isz[i, 0], isz[i, 1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - offset)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - offset)
+        ws = boxes[:, 2] - boxes[:, 0] + offset
+        hs = boxes[:, 3] - boxes[:, 1] + offset
+        keep = (ws >= min_size) & (hs >= min_size)
+        boxes, s_i = boxes[keep], s_i[keep]
+        if len(boxes):
+            order = np.argsort(-s_i)
+            sel = _nms_fast(boxes, s_i, order, nms_thresh,
+                            normalized=not pixel_offset, eta=eta)
+            if 0 < post_nms_top_n < len(sel):
+                sel = sel[:post_nms_top_n]
+            boxes, s_i = boxes[sel], s_i[sel]
+        rois.append(boxes)
+        probs.append(s_i)
+        nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(rois).astype(np.float32)
+                              if rois else np.zeros((0, 4), np.float32)))
+    probs = Tensor(jnp.asarray(
+        (np.concatenate(probs) if probs else np.zeros(0))
+        .astype(np.float32).reshape(-1, 1)))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels (ops.py:1175; kernel
+    cpu/distribute_fpn_proposals_kernel.cc): level = floor(log2(
+    sqrt(area)/refer_scale)) + refer_level, clipped to range."""
+    from ..core.tensor import Tensor
+
+    r = _np_of(fpn_rois)
+    offset = 1.0 if pixel_offset else 0.0
+    ws = r[:, 2] - r[:, 0] + offset
+    hs = r[:, 3] - r[:, 1] + offset
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    # kernel: floor(log2(scale/refer + 1e-6) + refer_level), then clip
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois = []
+    restore = np.empty(len(r), np.int64)
+    rois_num_per = []
+    pos = 0
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(
+            r[idx] if len(idx) else np.zeros((0, 4), r.dtype))))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        rois_num_per.append(Tensor(jnp.asarray(
+            np.asarray([len(idx)], np.int32))))
+        pos += len(idx)
+    restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_ind, rois_num_per
+    return multi_rois, restore_ind
+
+
+__all__ = [
+    "yolo_box", "prior_box", "box_coder", "deform_conv2d", "roi_pool",
+    "psroi_pool", "box_clip", "multiclass_nms3", "matrix_nms",
+    "generate_proposals", "distribute_fpn_proposals",
+]

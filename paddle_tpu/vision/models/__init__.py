@@ -18,3 +18,4 @@ from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .ssdlite import SSDLite, ssd_match_targets  # noqa: F401

@@ -1,0 +1,149 @@
+"""SSD-lite: a small single-shot detector proving the detection op zoo
+composes end to end (prior_box -> box_coder encode for training targets,
+head -> box_coder decode -> multiclass_nms3 for inference).
+
+Reference architecture family: SSD (the reference ships the ops —
+vision/ops.py prior_box:438, box_coder:584 — and external repos assemble
+them; this model is the in-repo assembly that proves the parts).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ... import nn
+from ...core.tensor import Tensor
+from ..ops import box_coder, multiclass_nms3, prior_box
+
+
+class _TinyBackbone(nn.Layer):
+    """Two conv stages -> feature maps at strides 8 and 16."""
+
+    def __init__(self, width=32):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, width, 3, stride=2, padding=1), nn.ReLU(),
+            nn.Conv2D(width, width, 3, stride=2, padding=1), nn.ReLU())
+        self.c3 = nn.Sequential(
+            nn.Conv2D(width, width * 2, 3, stride=2, padding=1), nn.ReLU())
+        self.c4 = nn.Sequential(
+            nn.Conv2D(width * 2, width * 4, 3, stride=2, padding=1),
+            nn.ReLU())
+
+    def forward(self, x):
+        x = self.stem(x)
+        f3 = self.c3(x)      # stride 8
+        f4 = self.c4(f3)     # stride 16
+        return f3, f4
+
+
+class SSDLite(nn.Layer):
+    """Anchor-based detector over two feature levels.
+
+    ``forward(images)`` returns per-level (cls_logits, box_deltas) plus the
+    priors; ``decode(images)`` runs the full inference path down to NMS.
+    """
+
+    def __init__(self, num_classes=3, width=32,
+                 min_sizes=(0.1, 0.3), max_sizes=(0.3, 0.6),
+                 aspect_ratios=(2.0,)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.backbone = _TinyBackbone(width)
+        self.min_sizes = min_sizes
+        self.max_sizes = max_sizes
+        self.aspect_ratios = aspect_ratios
+        # priors per location: 1 (ar=1,min) + 1 (sqrt(min*max)) + 2 (ar,1/ar)
+        self.num_priors = 2 + 2 * len(aspect_ratios)
+        chans = [width * 2, width * 4]
+        self.cls_heads = nn.LayerList([
+            nn.Conv2D(ch, self.num_priors * num_classes, 3, padding=1)
+            for ch in chans])
+        self.reg_heads = nn.LayerList([
+            nn.Conv2D(ch, self.num_priors * 4, 3, padding=1)
+            for ch in chans])
+
+    def priors_for(self, feats, images):
+        """[sum_l H_l*W_l*P, 4] normalized priors + matching variances."""
+        boxes, variances = [], []
+        for lvl, f in enumerate(feats):
+            b, v = prior_box(
+                f, images, min_sizes=[self.min_sizes[lvl]],
+                max_sizes=[self.max_sizes[lvl]],
+                aspect_ratios=self.aspect_ratios, flip=True, clip=True)
+            boxes.append(b.reshape([-1, 4]))
+            variances.append(v.reshape([-1, 4]))
+        import paddle_tpu as paddle
+        return paddle.concat(boxes, 0), paddle.concat(variances, 0)
+
+    def forward(self, images):
+        feats = self.backbone(images)
+        cls_out, reg_out = [], []
+        n = images.shape[0]
+        for f, ch, rh in zip(feats, self.cls_heads, self.reg_heads):
+            c = ch(f)   # [N, P*C, H, W]
+            r = rh(f)   # [N, P*4, H, W]
+            hw = c.shape[2] * c.shape[3]
+            cls_out.append(c.transpose([0, 2, 3, 1]).reshape(
+                [n, hw * self.num_priors, self.num_classes]))
+            reg_out.append(r.transpose([0, 2, 3, 1]).reshape(
+                [n, hw * self.num_priors, 4]))
+        import paddle_tpu as paddle
+        return (paddle.concat(cls_out, 1), paddle.concat(reg_out, 1), feats)
+
+    def decode(self, images, score_threshold=0.05, keep_top_k=10,
+               nms_threshold=0.45):
+        """Full inference: heads -> box_coder decode -> multiclass NMS."""
+        import paddle_tpu as paddle
+        cls_logits, deltas, feats = self.forward(images)
+        priors, variances = self.priors_for(feats, images)
+        boxes = box_coder(priors, variances, deltas,
+                          code_type="decode_center_size", axis=0)
+        probs = paddle.nn.functional.softmax(cls_logits, -1)
+        return multiclass_nms3(
+            boxes, probs.transpose([0, 2, 1]),
+            score_threshold=score_threshold, nms_top_k=50,
+            keep_top_k=keep_top_k, nms_threshold=nms_threshold,
+            background_label=0)
+
+
+def ssd_match_targets(priors, variances, gt_boxes, gt_labels,
+                      iou_threshold=0.5):
+    """Per-prior classification/regression targets (the SSD matching rule:
+    best prior per gt is positive, plus any prior with IoU > threshold)."""
+    import paddle_tpu as paddle
+    from ..ops import box_iou
+
+    iou = box_iou(paddle.to_tensor(gt_boxes), priors)      # [G, P]
+    iou_np = np.asarray(iou.numpy())
+    labels = np.zeros(iou_np.shape[1], np.int64)           # 0 = background
+    matched = np.full(iou_np.shape[1], -1)
+    best_prior = iou_np.argmax(1)                          # per gt
+    for g, p in enumerate(best_prior):
+        matched[p] = g
+    above = iou_np.max(0) > iou_threshold
+    matched[above & (matched < 0)] = iou_np.argmax(0)[above & (matched < 0)]
+    pos = matched >= 0
+    labels[pos] = np.asarray(gt_labels)[matched[pos]]
+    tgt = np.asarray(gt_boxes)[np.maximum(matched, 0)]
+    # paired center-size encode (box_coder semantics, O(P) — the full
+    # box_coder computes every target x prior cross term)
+    pr = np.asarray(priors.numpy())
+    vr = np.asarray(variances.numpy())
+    pw = pr[:, 2] - pr[:, 0]
+    ph = pr[:, 3] - pr[:, 1]
+    pcx = pr[:, 0] + pw / 2
+    pcy = pr[:, 1] + ph / 2
+    tw = tgt[:, 2] - tgt[:, 0]
+    th = tgt[:, 3] - tgt[:, 1]
+    tcx = (tgt[:, 2] + tgt[:, 0]) / 2
+    tcy = (tgt[:, 3] + tgt[:, 1]) / 2
+    enc = np.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                    np.log(np.abs(tw / pw)), np.log(np.abs(th / ph))],
+                   -1) / vr
+    return (Tensor(jnp.asarray(labels)),
+            Tensor(jnp.asarray(enc.astype(np.float32))),
+            Tensor(jnp.asarray(pos)))
+
+
+__all__ = ["SSDLite", "ssd_match_targets"]

@@ -1,0 +1,387 @@
+"""Detection op zoo parity vs numpy oracles re-deriving the reference
+kernels (cpu/yolo_box_kernel.cc, cpu/prior_box_kernel.cc,
+cpu/box_coder_kernel.cc, cpu/matrix_nms_kernel.cc, roi_pool, deform conv)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def T(x, dtype=np.float32):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+class TestYoloBox:
+    def _oracle(self, x, img_size, anchors, class_num, conf_thresh,
+                downsample, clip_bbox=True, scale=1.0):
+        """Direct transcription of cpu/yolo_box_kernel.cc loops."""
+        n, c, h, w = x.shape
+        an_num = len(anchors) // 2
+        bias = -0.5 * (scale - 1)
+        in_h, in_w = downsample * h, downsample * w
+        boxes = np.zeros((n, an_num * h * w, 4), np.float32)
+        scores = np.zeros((n, an_num * h * w, class_num), np.float32)
+        t = x.reshape(n, an_num, 5 + class_num, h, w)
+        for i in range(n):
+            img_h, img_w = img_size[i]
+            for j in range(an_num):
+                for k in range(h):
+                    for l in range(w):  # noqa: E741
+                        conf = sigmoid(t[i, j, 4, k, l])
+                        if conf < conf_thresh:
+                            continue
+                        bx = (l + sigmoid(t[i, j, 0, k, l]) * scale + bias) \
+                            * img_w / w
+                        by = (k + sigmoid(t[i, j, 1, k, l]) * scale + bias) \
+                            * img_h / h
+                        bw = np.exp(t[i, j, 2, k, l]) * anchors[2 * j] \
+                            * img_w / in_w
+                        bh = np.exp(t[i, j, 3, k, l]) * anchors[2 * j + 1] \
+                            * img_h / in_h
+                        idx = j * h * w + k * w + l
+                        bb = [bx - bw / 2, by - bh / 2,
+                              bx + bw / 2, by + bh / 2]
+                        if clip_bbox:
+                            bb[0] = max(bb[0], 0)
+                            bb[1] = max(bb[1], 0)
+                            bb[2] = min(bb[2], img_w - 1)
+                            bb[3] = min(bb[3], img_h - 1)
+                        boxes[i, idx] = bb
+                        scores[i, idx] = conf * sigmoid(t[i, j, 5:, k, l])
+        return boxes, scores
+
+    def test_parity(self):
+        rng = np.random.default_rng(0)
+        anchors = [10, 13, 16, 30]
+        x = rng.standard_normal((2, 2 * 7, 4, 4)).astype(np.float32)
+        img = np.asarray([[64, 48], [32, 32]], np.int32)
+        bo, so = self._oracle(x, img, anchors, 2, 0.3, 8)
+        b, s = V.yolo_box(T(x), paddle.to_tensor(img), anchors, 2, 0.3, 8)
+        np.testing.assert_allclose(b.numpy(), bo, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s.numpy(), so, rtol=1e-5, atol=1e-5)
+
+    def test_scale_and_noclip(self):
+        rng = np.random.default_rng(1)
+        anchors = [8, 8]
+        x = rng.standard_normal((1, 7, 3, 3)).astype(np.float32)
+        img = np.asarray([[24, 24]], np.int32)
+        bo, so = self._oracle(x, img, anchors, 2, 0.1, 8, clip_bbox=False,
+                              scale=1.2)
+        b, s = V.yolo_box(T(x), paddle.to_tensor(img), anchors, 2, 0.1, 8,
+                          clip_bbox=False, scale_x_y=1.2)
+        np.testing.assert_allclose(b.numpy(), bo, rtol=1e-5, atol=1e-5)
+
+
+class TestPriorBox:
+    def test_reference_example_shapes(self):
+        inp = T(np.zeros((1, 3, 6, 9)))
+        img = T(np.zeros((1, 3, 9, 12)))
+        box, var = V.prior_box(inp, img, min_sizes=[2.0], clip=True)
+        assert tuple(box.shape) == (6, 9, 1, 4)
+        assert tuple(var.shape) == (6, 9, 1, 4)
+
+    def test_oracle_parity(self):
+        """cpu/prior_box_kernel.cc loop transcription (no-flip branch)."""
+        fh, fw, ih, iw = 2, 3, 8, 12
+        min_sizes, max_sizes, ars = [2.0, 4.0], [3.0, 5.0], [1.0, 2.0]
+        # expanded ratios: [1.0, 2.0]; per min_size: ars then sqrt(min*max)
+        box, var = V.prior_box(
+            T(np.zeros((1, 1, fh, fw))), T(np.zeros((1, 1, ih, iw))),
+            min_sizes=min_sizes, max_sizes=max_sizes, aspect_ratios=ars)
+        step_w, step_h = iw / fw, ih / fh
+        exp = np.zeros((fh, fw, 6, 4), np.float32)
+        for hh in range(fh):
+            for ww in range(fw):
+                cx = (ww + 0.5) * step_w
+                cy = (hh + 0.5) * step_h
+                p = 0
+                for s, mn in enumerate(min_sizes):
+                    for ar in [1.0, 2.0]:
+                        bw = mn * np.sqrt(ar) / 2
+                        bh = mn / np.sqrt(ar) / 2
+                        exp[hh, ww, p] = [(cx - bw) / iw, (cy - bh) / ih,
+                                          (cx + bw) / iw, (cy + bh) / ih]
+                        p += 1
+                    sq = np.sqrt(mn * max_sizes[s]) / 2
+                    exp[hh, ww, p] = [(cx - sq) / iw, (cy - sq) / ih,
+                                      (cx + sq) / iw, (cy + sq) / ih]
+                    p += 1
+        np.testing.assert_allclose(box.numpy(), exp, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+
+class TestBoxCoder:
+    PRIOR = np.asarray([[0, 0, 10, 10], [5, 5, 20, 20]], np.float32)
+    VAR = np.asarray([[0.1, 0.1, 0.2, 0.2], [0.1, 0.1, 0.2, 0.2]],
+                     np.float32)
+    TGT = np.asarray([[2, 2, 12, 12], [4, 4, 16, 18]], np.float32)
+
+    def _encode_oracle(self, normalized=True):
+        norm = 0.0 if normalized else 1.0
+        out = np.zeros((2, 2, 4), np.float32)
+        for i in range(2):
+            for j in range(2):
+                pw = self.PRIOR[j, 2] - self.PRIOR[j, 0] + norm
+                ph = self.PRIOR[j, 3] - self.PRIOR[j, 1] + norm
+                pcx = self.PRIOR[j, 0] + pw / 2
+                pcy = self.PRIOR[j, 1] + ph / 2
+                tw = self.TGT[i, 2] - self.TGT[i, 0] + norm
+                th = self.TGT[i, 3] - self.TGT[i, 1] + norm
+                tcx = (self.TGT[i, 2] + self.TGT[i, 0]) / 2
+                tcy = (self.TGT[i, 3] + self.TGT[i, 1]) / 2
+                out[i, j] = [(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             np.log(abs(tw / pw)), np.log(abs(th / ph))]
+                out[i, j] /= self.VAR[j]
+        return out
+
+    def test_encode(self):
+        got = V.box_coder(T(self.PRIOR), T(self.VAR), T(self.TGT),
+                          code_type="encode_center_size")
+        np.testing.assert_allclose(got.numpy(), self._encode_oracle(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_encode_unnormalized_and_list_var(self):
+        got = V.box_coder(T(self.PRIOR), [0.1, 0.1, 0.2, 0.2], T(self.TGT),
+                          code_type="encode_center_size",
+                          box_normalized=False)
+        np.testing.assert_allclose(got.numpy(),
+                                   self._encode_oracle(normalized=False),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_decode_roundtrip(self):
+        enc = V.box_coder(T(self.PRIOR), T(self.VAR), T(self.TGT),
+                          code_type="encode_center_size")
+        # decode deltas [N, M, 4] against the M priors (axis=0): row i,
+        # column i must reproduce target i
+        dec = V.box_coder(T(self.PRIOR), T(self.VAR), enc,
+                          code_type="decode_center_size", axis=0)
+        dec_np = np.asarray(dec.numpy())
+        for i in range(2):
+            np.testing.assert_allclose(dec_np[i, i], self.TGT[i],
+                                       rtol=1e-4, atol=1e-4)
+
+
+class TestRoiPool:
+    def test_max_semantics(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.asarray([[0, 0, 3, 3]], np.float32)
+        out = V.roi_pool(T(x), T(boxes), [1], output_size=2)
+        # 4x4 -> 2x2 max pooling over quadrants
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   [[5, 7], [13, 15]])
+
+    def test_spatial_scale(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.asarray([[0, 0, 6, 6]], np.float32)
+        out = V.roi_pool(T(x), T(boxes), [1], output_size=2,
+                         spatial_scale=0.5)
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   [[5, 7], [13, 15]])
+
+
+class TestPsRoiPool:
+    def test_position_sensitive_average(self):
+        # 4 channels = 1 out-channel x 2x2 bins; each channel constant
+        x = np.stack([np.full((4, 4), v, np.float32)
+                      for v in (1, 2, 3, 4)])[None]
+        boxes = np.asarray([[0, 0, 4, 4]], np.float32)
+        out = V.psroi_pool(T(x), T(boxes), [1], output_size=2)
+        # bin (i,j) averages channel i*2+j -> [[1,2],[3,4]]
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   [[1, 2], [3, 4]])
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((5, 4, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        got = V.deform_conv2d(T(x), T(off), T(w), padding=1)
+        import jax
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(got.numpy(), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_integer_shift_offset(self):
+        # offset (+1, +1) on every sample == convolving a shifted image
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 1, 5, 5)).astype(np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        off = np.zeros((1, 2, 5, 5), np.float32)
+        off[:, 0] = 1.0   # dy
+        got = np.asarray(V.deform_conv2d(T(x), T(off), T(w)).numpy())
+        # sampling row+1: last row out of range -> zero
+        exp = np.zeros_like(x)
+        exp[0, 0, :4] = x[0, 0, 1:]
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+    def test_mask_modulation_and_grad(self):
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((1, 2, 4, 4))
+                             .astype(np.float32))
+        x.stop_gradient = False
+        w = paddle.to_tensor(rng.standard_normal((3, 2, 3, 3))
+                             .astype(np.float32))
+        off = T(rng.standard_normal((1, 18, 4, 4)) * 0.3)
+        mask = T(np.full((1, 9, 4, 4), 0.5, np.float32))
+        full = V.deform_conv2d(x, off, w, padding=1)
+        half = V.deform_conv2d(x, off, w, padding=1, mask=mask)
+        np.testing.assert_allclose(np.asarray(half.numpy()),
+                                   np.asarray(full.numpy()) * 0.5,
+                                   rtol=1e-4, atol=1e-5)
+        half.sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+
+class TestNmsFamily:
+    def test_multiclass_nms3(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                             [20, 20, 30, 30]]], np.float32)
+        scores = np.asarray([[[0.9, 0.85, 0.3],      # class 0
+                              [0.1, 0.2, 0.8]]], np.float32)  # class 1
+        out, index, num = V.multiclass_nms3(
+            T(boxes), T(scores), score_threshold=0.15, nms_top_k=10,
+            keep_top_k=10, nms_threshold=0.5, background_label=-1)
+        o = np.asarray(out.numpy())
+        # box 1 (class 0) suppressed by box 0; kept: c0/b0, c0/b2, c1/b2, c1/b1
+        assert int(num.numpy()[0]) == 4
+        assert o[0][0] == 0 and o[0][1] == pytest.approx(0.9)
+        labels = o[:, 0].tolist()
+        assert labels.count(0) == 2 and labels.count(1) == 2
+
+    def test_matrix_nms_linear_decay(self):
+        """Against a direct transcription of cpu/matrix_nms_kernel.cc."""
+        boxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                             [20, 20, 30, 30]]], np.float32)
+        scores = np.asarray([[[0.0, 0.0, 0.0],
+                              [0.9, 0.8, 0.6]]], np.float32)
+        out, num = V.matrix_nms(T(boxes), T(scores), score_threshold=0.1,
+                                post_threshold=0.0, nms_top_k=-1,
+                                keep_top_k=-1, background_label=0)
+        o = np.asarray(out.numpy())
+        assert int(num.numpy()[0]) == 3
+        # top box undecayed
+        assert o[0][1] == pytest.approx(0.9)
+        # results are sorted by DECAYED score: far box (0.6, undecayed)
+        # outranks the overlapped box decayed by (1-iou)/(1-0)
+        inter = (10 - 1) ** 2
+        iou = inter / (100 + 100 - inter)
+        assert o[1][1] == pytest.approx(0.6, rel=1e-5)
+        assert o[2][1] == pytest.approx(0.8 * (1 - iou), rel=1e-4)
+
+    def test_matrix_nms_gaussian(self):
+        boxes = np.asarray([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+        scores = np.asarray([[[0.0, 0.0], [0.9, 0.8]]], np.float32)
+        out, num = V.matrix_nms(T(boxes), T(scores), score_threshold=0.1,
+                                post_threshold=0.0, nms_top_k=-1,
+                                keep_top_k=-1, background_label=0,
+                                use_gaussian=True, gaussian_sigma=2.0)
+        o = np.asarray(out.numpy())
+        inter = 81.0
+        iou = inter / (200 - inter)
+        # decay_score<T,true>: exp((max_iou^2 - iou^2) * sigma)
+        assert o[1][1] == pytest.approx(0.8 * np.exp(-(iou ** 2) * 2.0),
+                                        rel=1e-4)
+
+    def test_generate_proposals(self):
+        rng = np.random.default_rng(0)
+        h = w = 4
+        a = 2
+        scores = rng.uniform(0, 1, (1, a, h, w)).astype(np.float32)
+        deltas = (rng.standard_normal((1, 4 * a, h, w)) * 0.1).astype(
+            np.float32)
+        anchors = np.zeros((h, w, a, 4), np.float32)
+        for i in range(h):
+            for j in range(w):
+                anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 16, i * 8 + 16]
+                anchors[i, j, 1] = [j * 8, i * 8, j * 8 + 24, i * 8 + 24]
+        variances = np.full((h, w, a, 4), 1.0, np.float32)
+        rois, probs, num = V.generate_proposals(
+            T(scores), T(deltas), T([[32.0, 32.0]]), T(anchors),
+            T(variances), pre_nms_top_n=12, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=2.0)
+        r = np.asarray(rois.numpy())
+        assert r.shape[1] == 4 and r.shape[0] == int(num.numpy()[0])
+        assert r.shape[0] <= 5
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+        p = np.asarray(probs.numpy()).ravel()
+        assert (np.diff(p) <= 1e-6).all()   # sorted desc
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.asarray([[0, 0, 10, 10],      # small -> clipped to min
+                           [0, 0, 300, 300],    # log2(300/224)+4=4.4 -> 4
+                           [0, 0, 500, 500]], np.float32)  # 5.2 -> 5
+        multi, restore = V.distribute_fpn_proposals(
+            T(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        sizes = [m.shape[0] for m in multi]
+        assert sum(sizes) == 3
+        assert sizes[0] == 1      # level 2: the 10x10 roi
+        assert sizes[2] == 1      # level 4: the 300 roi
+        assert sizes[3] == 1      # level 5: the 500 roi
+        # restore index inverts the concat order
+        order = np.asarray(restore.numpy()).ravel()
+        assert sorted(order.tolist()) == [0, 1, 2]
+
+    def test_box_clip(self):
+        boxes = np.asarray([[-5, -5, 50, 60], [5, 5, 20, 20]], np.float32)
+        im_info = np.asarray([[40.0, 30.0, 1.0]], np.float32)
+        out = V.box_clip(T(boxes), T(im_info))
+        o = np.asarray(out.numpy())
+        np.testing.assert_allclose(o[0], [0, 0, 29, 39])
+        np.testing.assert_allclose(o[1], [5, 5, 20, 20])
+
+
+@pytest.mark.slow
+def test_ssdlite_composes():
+    """SSD-lite end-to-end: forward, target encoding, a few train steps on
+    a synthetic box, then NMS decode produces finite detections."""
+    from paddle_tpu.vision.models import SSDLite, ssd_match_targets
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    model = SSDLite(num_classes=3, width=8)
+    images = T(rng.standard_normal((2, 3, 64, 64)))
+    cls_logits, deltas, feats = model(images)
+    priors, variances = model.priors_for(feats, images)
+    assert cls_logits.shape[1] == priors.shape[0]
+
+    gt_boxes = np.asarray([[0.2, 0.2, 0.6, 0.6]], np.float32)
+    gt_labels = np.asarray([1], np.int64)
+    labels, reg_tgt, pos = ssd_match_targets(priors, variances, gt_boxes,
+                                             gt_labels)
+    assert int(np.asarray(pos.numpy()).sum()) >= 1
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    losses = []
+    for _ in range(5):
+        cls_logits, deltas, _ = model(images)
+        cls_loss = F.cross_entropy(
+            cls_logits.reshape([-1, 3]),
+            paddle.concat([labels, labels], 0))
+        pos_f = paddle.concat([pos, pos], 0).astype("float32")
+        reg = (deltas.reshape([-1, 4])
+               - paddle.concat([reg_tgt, reg_tgt], 0)) ** 2
+        reg_loss = (reg.sum(-1) * pos_f).sum() / (pos_f.sum() + 1)
+        loss = cls_loss + reg_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+    out, index, num = model.decode(images)
+    assert np.isfinite(np.asarray(out.numpy())).all()
+    assert int(np.asarray(num.numpy()).sum()) == out.shape[0]

@@ -71,13 +71,23 @@ put("sequence_conv sequence_pool im2sequence attention_lstm "
     "rank_attention shuffle_batch pyramid_hash tdm_child tdm_sampler "
     "add_position_encoding", "descoped", LEGACY)
 put("dgc dgc_clip_by_norm dgc_momentum", "descoped", PS)
-put("bipartite_match box_clip box_coder collect_fpn_proposals "
-    "distribute_fpn_proposals generate_proposals matrix_nms multiclass_nms3 "
-    "prior_box psroi_pool roi_pool yolo_box yolo_box_head yolo_box_post "
-    "yolo_loss correlation deformable_conv affine_channel temporal_shift",
+DET = ("paddle_tpu.vision.ops / vision/detection.py — static-shape jnp "
+       "decoders + masked-NMS family with host compaction, numpy-oracle "
+       "tests (tests/test_detection_ops.py); SSDLite proves composition")
+put("box_clip box_coder distribute_fpn_proposals generate_proposals "
+    "matrix_nms multiclass_nms3 prior_box psroi_pool roi_pool yolo_box",
+    "as", DET)
+put("deformable_conv", "as",
+    "vision.ops.deform_conv2d (bilinear-gather im2col, v1/v2 mask, "
+    "differentiable)")
+put("bipartite_match collect_fpn_proposals yolo_box_head yolo_box_post "
+    "yolo_loss correlation affine_channel temporal_shift",
     "descoped", DETZOO)
-put("graph_khop_sampler graph_sample_neighbors reindex_graph send_u_recv "
-    "send_ue_recv send_uv weighted_sample_neighbors", "descoped", GRAPHNN)
+GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
+       "reindex, CSC neighbor sampling (tests/test_geometric.py)")
+put("graph_sample_neighbors reindex_graph send_u_recv "
+    "send_ue_recv send_uv weighted_sample_neighbors", "as", GEO)
+put("graph_khop_sampler", "descoped", GRAPHNN)
 put("npu_identity", "descoped", XPUDEV)
 put("nms roi_align", "as",
     "paddle_tpu.vision.ops (nms, roi_align w/ sampling_ratio)")
