@@ -171,11 +171,26 @@ def primitive(name=None):
     return deco
 
 
+def op_call(op_name: str, default_fn, *args, **kwargs):
+    """Registry-routed op execution (the analog of the reference's kernel
+    dispatch, phi/core/kernel_factory.h:58 KernelFactory::SelectKernel).
+
+    Registers ``default_fn`` as the op's default body and resolves the
+    body from ``OPS`` at CALL time, so ``override_kernel(op_name, fn)``
+    reaches this op — eagerly, under jit tracing, and through autograd —
+    with the full call signature (arrays positional, settings as kwargs).
+    """
+    OPS.setdefault(op_name, default_fn)
+    return eager_apply(op_name, OPS[op_name], args, kwargs)
+
+
 def override_kernel(name: str, fn):
-    """Replace an op's body (e.g. with a Pallas kernel). Returns the old body."""
+    """Replace an op's body (e.g. with a Pallas kernel). Returns the old
+    body. The replacement must accept the op's registered signature
+    (``OPS[name]`` shows the default body)."""
     old = OPS.get(name)
     OPS[name] = fn
     return old
 
 
-__all__ = ["primitive", "eager_apply", "override_kernel", "OPS"]
+__all__ = ["primitive", "eager_apply", "op_call", "override_kernel", "OPS"]

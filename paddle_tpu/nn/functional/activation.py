@@ -4,14 +4,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply
+from ...core.dispatch import eager_apply, op_call, OPS
 from ...core.tensor import Tensor
 
 
 def _un(op_name, fn):
-    # paddle-API ``name`` kwarg must not shadow the registry op name
+    # paddle-API ``name`` kwarg must not shadow the registry op name;
+    # op_call = registry-routed (override_kernel reaches these ops)
+    OPS.setdefault(op_name, fn)
+
     def op(x, name=None):
-        return eager_apply(op_name, fn, (x,), {})
+        return op_call(op_name, fn, x)
     op.__name__ = op_name
     op.pure = fn
     return op
@@ -28,32 +31,48 @@ mish = _un("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
 log_sigmoid = _un("log_sigmoid", jax.nn.log_sigmoid)
 
 
+def _gelu_body(a, approximate=False):
+    return jax.nn.gelu(a, approximate=approximate)
+
+
+OPS.setdefault("gelu", _gelu_body)
+
+
 def gelu(x, approximate=False, name=None):
-    return eager_apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,), {})
+    return op_call("gelu", _gelu_body, x, approximate=approximate)
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return eager_apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (x,), {})
+    return op_call("leaky_relu", jax.nn.leaky_relu, x,
+                   negative_slope=negative_slope)
 
 
 def elu(x, alpha=1.0, name=None):
-    return eager_apply("elu", lambda a: jax.nn.elu(a, alpha), (x,), {})
+    return op_call("elu", jax.nn.elu, x, alpha=alpha)
 
 
 def celu(x, alpha=1.0, name=None):
-    return eager_apply("celu", lambda a: jax.nn.celu(a, alpha), (x,), {})
+    return op_call("celu", jax.nn.celu, x, alpha=alpha)
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
-    return eager_apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,), {})
+    return op_call(
+        "selu",
+        lambda a, scale, alpha: scale * jnp.where(
+            a > 0, a, alpha * jnp.expm1(a)),
+        x, scale=scale, alpha=alpha)
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return eager_apply("hardtanh", lambda a: jnp.clip(a, min, max), (x,), {})
+    return op_call("hardtanh", lambda a, lo, hi: jnp.clip(a, lo, hi),
+                   x, lo=min, hi=max)
 
 
 def hardshrink(x, threshold=0.5, name=None):
-    return eager_apply("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,), {})
+    return op_call("hardshrink",
+                   lambda a, threshold: jnp.where(
+                       jnp.abs(a) > threshold, a, 0.0),
+                   x, threshold=threshold)
 
 
 def softshrink(x, threshold=0.5, name=None):
@@ -62,15 +81,19 @@ def softshrink(x, threshold=0.5, name=None):
 
 
 def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
-    return eager_apply("hardsigmoid", lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), (x,), {})
+    return op_call("hardsigmoid",
+                   lambda a, slope, offset: jnp.clip(
+                       a * slope + offset, 0.0, 1.0),
+                   x, slope=slope, offset=offset)
 
 
 def hardswish(x, name=None):
-    return eager_apply("hardswish", lambda a: a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), (x,), {})
+    return op_call("hardswish",
+                   lambda a: a * jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), x)
 
 
 def swish(x, name=None):
-    return eager_apply("swish", jax.nn.silu, (x,), {})
+    return op_call("swish", jax.nn.silu, x)
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
@@ -106,13 +129,18 @@ def rrelu(x, lower=1 / 8, upper=1 / 3, training=True, name=None):
     return eager_apply("rrelu", lambda a: jnp.where(a >= 0, a, a * mid), (x,), {})
 
 
+def _softmax_body(a, axis=-1):
+    return jax.nn.softmax(a, axis=axis)
+
+
+OPS.setdefault("softmax", _softmax_body)
+
+
 def softmax(x, axis=-1, dtype=None, name=None):
-    def fn(a):
-        if dtype is not None:
-            from ...core.dtype import to_jax_dtype
-            a = a.astype(to_jax_dtype(dtype))
-        return jax.nn.softmax(a, axis=int(axis))
-    return eager_apply("softmax", fn, (x,), {})
+    if dtype is not None:
+        from ...core.dtype import to_jax_dtype
+        x = x.astype(to_jax_dtype(dtype))
+    return op_call("softmax", _softmax_body, x, axis=int(axis))
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

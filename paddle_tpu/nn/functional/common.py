@@ -7,16 +7,24 @@ import jax
 import jax.numpy as jnp
 
 from ...core import random as _rng
-from ...core.dispatch import eager_apply
+from ...core.dispatch import eager_apply, op_call, OPS
 from ...core.tensor import Tensor
 from ...tensor.manipulation import pad as _pad  # re-export paddle.nn.functional.pad
+
+
+def _linear_body(a, w, *maybe_b):
+    out = a @ w
+    return out + maybe_b[0] if maybe_b else out
+
+
+OPS.setdefault("linear", _linear_body)
 
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle's weight layout [in_features, out_features]."""
     if bias is None:
-        return eager_apply("linear", lambda a, w: a @ w, (x, weight), {})
-    return eager_apply("linear", lambda a, w, b: a @ w + b, (x, weight, bias), {})
+        return op_call("linear", _linear_body, x, weight)
+    return op_call("linear", _linear_body, x, weight, bias)
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):

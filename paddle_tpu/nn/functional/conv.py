@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...core.dispatch import eager_apply
+from ...core.dispatch import eager_apply, op_call, OPS
 
 
 def _pair(v, n):
@@ -56,22 +56,27 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd, data_format)
     dilation = _pair(dilation, nd)
     pad = _conv_padding(padding, nd)
 
-    def fn(a, w, *maybe_b):
-        dn = lax.conv_dimension_numbers(a.shape, w.shape, _dn_strings(nd, channel_last))
-        out = lax.conv_general_dilated(
-            a, w, window_strides=stride, padding=pad,
-            rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=None)
-        if maybe_b:
-            b = maybe_b[0]
-            shape = [1] * out.ndim
-            shape[-1 if channel_last else 1] = b.shape[0]
-            out = out + b.reshape(shape)
-        return out
-
     args = (x, weight) if bias is None else (x, weight, bias)
-    return eager_apply(f"conv{nd}d", fn, args, {})
+    return op_call(f"conv{nd}d", _conv_body, *args, stride=stride, pad=pad,
+                   dilation=dilation, groups=groups,
+                   channel_last=channel_last, nd=nd)
+
+
+def _conv_body(a, w, *maybe_b, stride, pad, dilation, groups, channel_last,
+               nd):
+    dn = lax.conv_dimension_numbers(a.shape, w.shape,
+                                    _dn_strings(nd, channel_last))
+    out = lax.conv_general_dilated(
+        a, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if maybe_b:
+        b = maybe_b[0]
+        shape = [1] * out.ndim
+        shape[-1 if channel_last else 1] = b.shape[0]
+        out = out + b.reshape(shape)
+    return out
 
 
 def _dn_strings(nd, channel_last):
@@ -176,3 +181,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
                      groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
     return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                            groups, 3, data_format, output_size)
+
+
+for _nd in (1, 2, 3):
+    OPS.setdefault(f"conv{_nd}d", _conv_body)

@@ -9,29 +9,36 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply, OPS
+from ...core.dispatch import eager_apply, op_call, OPS
 from ...core.tensor import Tensor
+
+
+def _layer_norm_body(a, *wb, nd=1, epsilon=1e-5, has_weight=False,
+                     has_bias=False):
+    axes = tuple(range(a.ndim - nd, a.ndim))
+    mean = a.mean(axis=axes, keepdims=True)
+    var = jnp.square(a - mean).mean(axis=axes, keepdims=True)
+    out = (a - mean) / jnp.sqrt(var + epsilon)
+    i = 0
+    if has_weight:
+        out = out * wb[i]
+        i += 1
+    if has_bias:
+        out = out + wb[i]
+    return out
+
+
+OPS.setdefault("layer_norm", _layer_norm_body)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
-    nd = len(tuple(normalized_shape))
-
-    def fn(a, *wb):
-        axes = tuple(range(a.ndim - nd, a.ndim))
-        mean = a.mean(axis=axes, keepdims=True)
-        var = jnp.square(a - mean).mean(axis=axes, keepdims=True)
-        out = (a - mean) / jnp.sqrt(var + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i]; i += 1
-        if bias is not None:
-            out = out + wb[i]
-        return out
-
     args = [x] + [t for t in (weight, bias) if t is not None]
-    return eager_apply("layer_norm", fn, tuple(args), {})
+    return op_call("layer_norm", _layer_norm_body, *args,
+                   nd=len(tuple(normalized_shape)), epsilon=epsilon,
+                   has_weight=weight is not None,
+                   has_bias=bias is not None)
 
 
 def _rms_norm_reference(a, *w, epsilon=1e-6):

@@ -10,14 +10,18 @@ from jax import lax
 
 from ..core.dtype import to_jax_dtype
 from ..core.tensor import Tensor
-from ..core.dispatch import primitive, eager_apply
+from ..core.dispatch import primitive, eager_apply, op_call, OPS
 
 # ---- binary elementwise ----
 
 def _binop(op_name, fn):
-    # the paddle-API ``name`` kwarg must not shadow the op's registry name
+    # the paddle-API ``name`` kwarg must not shadow the op's registry name;
+    # op_call routes through the OPS registry so override_kernel reaches
+    # every op built here (round-2 verdict: the registry was vestigial)
+    OPS.setdefault(op_name, fn)
+
     def op(x, y, name=None):
-        return eager_apply(op_name, fn, (x, y), {})
+        return op_call(op_name, fn, x, y)
     op.__name__ = op_name
     op.pure = fn
     return op
@@ -55,8 +59,10 @@ true_divide = divide
 # ---- unary elementwise ----
 
 def _unop(op_name, fn):
+    OPS.setdefault(op_name, fn)
+
     def op(x, name=None):
-        return eager_apply(op_name, fn, (x,), {})
+        return op_call(op_name, fn, x)
     op.__name__ = op_name
     op.pure = fn
     return op
@@ -168,8 +174,12 @@ def _axis(axis):
 
 
 def _reduce(op_name, fn):
+    def body(a, axis=None, keepdims=False):
+        return fn(a, axis=axis, keepdims=keepdims)
+    OPS.setdefault(op_name, body)
+
     def op(x, axis=None, keepdim=False, name=None):
-        return eager_apply(op_name, lambda a: fn(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+        return op_call(op_name, body, x, axis=_axis(axis), keepdims=keepdim)
     op.__name__ = op_name
     return op
 
@@ -316,14 +326,20 @@ def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 
 # ---- matmul family (linalg has the rest) ----
 
+def _matmul_body(a, b, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.matmul(a, b)
+
+
+OPS.setdefault("matmul", _matmul_body)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
-    def fn(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
-    return eager_apply("matmul", fn, (x, y), {})
+    return op_call("matmul", _matmul_body, x, y,
+                   transpose_x=transpose_x, transpose_y=transpose_y)
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):

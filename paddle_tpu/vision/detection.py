@@ -401,9 +401,8 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             mask_w = (xx[None, :] >= ws[:, None]) & (xx[None, :] < we[:, None])
             m = (mask_h[:, None, :, None] & mask_w[None, :, None, :])
             # position-sensitive: bin (i,j) reads channel block (i,j)
-            vals = img.transpose(0, 1, 2, 3, 4)              # [co,oh,ow,h,w]
             msum = m.sum(axis=(2, 3)).astype(img.dtype)
-            out = (vals * m[None].astype(img.dtype)).sum(axis=(3, 4))
+            out = (img * m[None].astype(img.dtype)).sum(axis=(3, 4))
             return out / jnp.maximum(msum[None], 1.0)
 
         return jax.vmap(one)(img_idx, bx0, by0, rw, rh)
@@ -417,6 +416,12 @@ def box_clip(input, im_info, name=None):
     """Clip boxes to image boundaries (legacy detection op box_clip;
     cpu kernel box_clip_kernel.cc). im_info rows: (h, w, scale)."""
     def f(b, info):
+        if b.ndim == 2 and info.ndim > 1:
+            if info.shape[0] != 1:
+                raise ValueError(
+                    "box_clip: 2-D boxes with multi-image im_info need the "
+                    "LoD batch layout — pass 3-D boxes [N, M, 4]")
+            info = info[0]
         h = info[..., 0] / info[..., 2] - 1
         w = info[..., 1] / info[..., 2] - 1
         shape = b.shape
@@ -631,7 +636,9 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - offset)
         ws = boxes[:, 2] - boxes[:, 0] + offset
         hs = boxes[:, 3] - boxes[:, 1] + offset
-        keep = (ws >= min_size) & (hs >= min_size)
+        # FilterBoxes clamps (generate_proposals kernel): min_size >= 1
+        eff_min = max(float(min_size), 1.0)
+        keep = (ws >= eff_min) & (hs >= eff_min)
         boxes, s_i = boxes[keep], s_i[keep]
         if len(boxes):
             order = np.argsort(-s_i)
@@ -669,6 +676,9 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     # kernel: floor(log2(scale/refer + 1e-6) + refer_level), then clip
     lvl = np.floor(np.log2(scale / refer_scale + 1e-6) + refer_level)
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        counts = _np_of(rois_num).ravel().astype(np.int64)
+        img_of = np.repeat(np.arange(len(counts)), counts)
     multi_rois = []
     restore = np.empty(len(r), np.int64)
     rois_num_per = []
@@ -678,8 +688,11 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         multi_rois.append(Tensor(jnp.asarray(
             r[idx] if len(idx) else np.zeros((0, 4), r.dtype))))
         restore[idx] = np.arange(pos, pos + len(idx))
-        rois_num_per.append(Tensor(jnp.asarray(
-            np.asarray([len(idx)], np.int32))))
+        if rois_num is not None:
+            # per-image roi counts at this level (reference returns [N])
+            per_img = np.bincount(img_of[idx], minlength=len(counts))
+            rois_num_per.append(Tensor(jnp.asarray(
+                per_img.astype(np.int32))))
         pos += len(idx)
     restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1)))
     if rois_num is not None:

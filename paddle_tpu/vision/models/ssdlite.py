@@ -114,6 +114,11 @@ def ssd_match_targets(priors, variances, gt_boxes, gt_labels,
     import paddle_tpu as paddle
     from ..ops import box_iou
 
+    n_priors = priors.shape[0]
+    if len(gt_boxes) == 0:   # background-only image: all negatives
+        return (Tensor(jnp.zeros((n_priors,), jnp.int64)),
+                Tensor(jnp.zeros((n_priors, 4), jnp.float32)),
+                Tensor(jnp.zeros((n_priors,), bool)))
     iou = box_iou(paddle.to_tensor(gt_boxes), priors)      # [G, P]
     iou_np = np.asarray(iou.numpy())
     labels = np.zeros(iou_np.shape[1], np.int64)           # 0 = background

@@ -333,6 +333,18 @@ class TestNmsFamily:
         order = np.asarray(restore.numpy()).ravel()
         assert sorted(order.tolist()) == [0, 1, 2]
 
+    def test_distribute_fpn_rois_num_per_image(self):
+        rois = np.asarray([[0, 0, 10, 10], [0, 0, 500, 500],
+                           [0, 0, 12, 12]], np.float32)
+        multi, restore, nums = V.distribute_fpn_proposals(
+            T(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224, rois_num=paddle.to_tensor(
+                np.asarray([2, 1], np.int32)))
+        # level 2 holds both small rois: one from each image
+        np.testing.assert_array_equal(np.asarray(nums[0].numpy()), [1, 1])
+        # level 5 holds the 500 roi from image 0
+        np.testing.assert_array_equal(np.asarray(nums[3].numpy()), [1, 0])
+
     def test_box_clip(self):
         boxes = np.asarray([[-5, -5, 50, 60], [5, 5, 20, 20]], np.float32)
         im_info = np.asarray([[40.0, 30.0, 1.0]], np.float32)
