@@ -180,8 +180,10 @@ def op_call(op_name: str, default_fn, *args, **kwargs):
     reaches this op — eagerly, under jit tracing, and through autograd —
     with the full call signature (arrays positional, settings as kwargs).
     """
-    OPS.setdefault(op_name, default_fn)
-    return eager_apply(op_name, OPS[op_name], args, kwargs)
+    body = OPS.get(op_name)
+    if body is None:
+        OPS[op_name] = body = default_fn
+    return eager_apply(op_name, body, args, kwargs)
 
 
 def override_kernel(name: str, fn):

@@ -292,22 +292,38 @@ class _WorkerError:
 
 
 class DataLoader:
-    """Prefetching loader (reference: python/paddle/io/reader.py:262).
+    """Prefetching loader (reference: python/paddle/io/reader.py:262;
+    worker processes python/paddle/io/dataloader/worker.py).
 
-    num_workers>0 uses a thread pool feeding a bounded queue (prefetch depth =
-    2*num_workers) — the host-side pipelining role of the reference's worker
-    processes.
+    num_workers>0 spawns REAL worker processes (io/worker.py): each worker
+    runs ``dataset[i]`` + collate and ships numpy over the queue, so
+    Python-bound augmentation scales past the GIL. Batches arrive in
+    sampler order; ``worker_init_fn(worker_id)`` runs in each worker;
+    ``persistent_workers=True`` keeps the pool across epochs.
+
+    ``use_process_workers`` (extra knob, default None = auto): None probes
+    whether dataset/collate/init_fn pickle for spawn and silently falls
+    back to the in-process prefetch thread when they don't (lambdas,
+    closures); True forces processes (spawn errors surface); False forces
+    the thread path.
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_process_workers=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self.use_buffer_reader = use_buffer_reader
+        self.use_process_workers = use_process_workers
+        self._pool = None
         self.iterable_mode = isinstance(dataset, IterableDataset)
         if self.iterable_mode:
             self.batch_sampler = None
@@ -342,6 +358,9 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
+        if self._use_processes():
+            yield from self._iter_multiprocess()
+            return
         q: queue.Queue = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
         sentinel = object()
 
@@ -364,5 +383,54 @@ class DataLoader:
             yield item
 
 
+    def _use_processes(self):
+        if self.use_process_workers is not None:
+            return self.use_process_workers
+        import pickle
+        try:
+            pickle.dumps((self.dataset, self.collate_fn,
+                          self.worker_init_fn))
+            return True
+        except Exception:
+            import warnings
+            warnings.warn(
+                "DataLoader: dataset/collate_fn/worker_init_fn is not "
+                "picklable — falling back to the in-process prefetch "
+                "thread (pass use_process_workers=True to force spawn)",
+                stacklevel=3)
+            self.use_process_workers = False
+            return False
+
+    def _iter_multiprocess(self):
+        from .worker import _ProcessPool, iter_iterable_multiprocess
+
+        if self.iterable_mode:
+            yield from iter_iterable_multiprocess(self, self.timeout)
+            return
+        pool = self._pool
+        if pool is None or not pool.alive():
+            pool = _ProcessPool(self)
+        try:
+            yield from pool.run_epoch(iter(self.batch_sampler), self.timeout)
+        finally:
+            if self.persistent_workers and pool.alive():
+                self._pool = pool
+            else:
+                pool.shutdown()
+                self._pool = None
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
+
+
 def get_worker_info():
-    return None
+    """This worker's (id, num_workers, dataset) inside a DataLoader worker
+    process; None in the main process (reference:
+    python/paddle/io/dataloader/worker.py)."""
+    from .worker import get_worker_info as _gwi
+    return _gwi()

@@ -184,15 +184,21 @@ def _reduce(op_name, fn):
     return op
 
 
+def _sum_body(a, axis=None, keepdims=False, dtype=None):
+    out = jnp.sum(a, axis=axis, keepdims=keepdims)
+    if dtype is not None:
+        out = out.astype(dtype)
+    elif jnp.issubdtype(a.dtype, jnp.bool_):
+        out = out.astype(jnp.int32)
+    return out
+
+
+OPS.setdefault("sum", _sum_body)
+
+
 def sum(x, axis=None, dtype=None, keepdim=False, name=None):
-    def fn(a):
-        out = jnp.sum(a, axis=_axis(axis), keepdims=keepdim)
-        if dtype is not None:
-            out = out.astype(to_jax_dtype(dtype))
-        elif jnp.issubdtype(a.dtype, jnp.bool_):
-            out = out.astype(jnp.int32)
-        return out
-    return eager_apply("sum", fn, (x,), {})
+    return op_call("sum", _sum_body, x, axis=_axis(axis), keepdims=keepdim,
+                   dtype=to_jax_dtype(dtype) if dtype is not None else None)
 
 
 mean_ = _reduce("mean", jnp.mean)

@@ -27,9 +27,8 @@ def restore_ops():
 def test_registry_covers_op_families(restore_ops):
     """The op families converted to registry routing are present."""
     import paddle_tpu.tensor.math  # noqa: F401 — populates at import
-    for name in ("add", "multiply", "exp", "log", "sum" if "sum" in OPS
-                 else "mean", "matmul", "relu", "sigmoid", "softmax",
-                 "gelu", "linear", "conv2d" if "conv2d" in OPS else "mean",
+    for name in ("add", "multiply", "exp", "log", "sum", "mean", "matmul",
+                 "relu", "sigmoid", "softmax", "gelu", "linear", "conv2d",
                  "layer_norm", "rms_norm",
                  "scaled_dot_product_attention"):
         assert name in OPS, name
