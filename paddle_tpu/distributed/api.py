@@ -28,7 +28,7 @@ def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
-def _put_global(a, sharding):
+def _put_global(a, sharding, src_mesh=None):
     """device_put that is correct in the multi-process regime.
 
     Single-process (or traced values, or fully-addressable shardings) this
@@ -46,17 +46,130 @@ def _put_global(a, sharding):
     (paddle/phi/core/distributed/auto_parallel/reshard/) for the eager API:
     every s_to_r/r_to_s/p_to_r rule collapses to one placed transfer.
     """
-    if sharding.is_fully_addressable:
-        return jax.device_put(a, sharding)
-    if isinstance(a, jax.core.Tracer) or (
-            isinstance(a, jax.Array) and not a.is_fully_addressable):
-        # compiled identity with out_shardings: XLA emits the cross-host
-        # collective (device_put cannot move bytes between hosts on every
-        # backend, and never under the eager-vjp tape)
+    if isinstance(a, jax.core.Tracer):
+        if sharding.is_fully_addressable:
+            return jax.device_put(a, sharding)
         return _resharder(sharding)(a)
-    host = np.asarray(a)
+    # NOTE: every branch below must be chosen CONSISTENTLY across
+    # processes — is_fully_addressable is process-local, so branching may
+    # only use process-invariant facts (device sets, process ownership); a
+    # divergent branch deadlocks the job on a collective only some ranks
+    # enter.
+    try:
+        nprocs = jax.process_count()
+    except RuntimeError:
+        nprocs = 1
+    src_sh = getattr(a, "sharding", None) if isinstance(a, jax.Array) \
+        else None
+    # Whether the source is ONE distributed tensor or a per-process local
+    # value cannot be read off the array (on an owner process both look
+    # fully addressable); ``src_mesh`` — the Tensor's _dist_attr mesh,
+    # identical metadata on every process — is the consistent source of
+    # truth. No mesh recorded -> treat as a local/host value.
+    if src_mesh is not None:
+        src_procs = sorted({d.process_index
+                            for d in src_mesh.jax_mesh.devices.flat})
+    elif src_sh is not None and not a.is_fully_addressable:
+        src_procs = sorted({d.process_index for d in src_sh.device_set})
+    else:
+        src_procs = list(range(nprocs))   # local value on every process
+    src_spans_all = set(src_procs) == set(range(nprocs))
+    src_is_local = src_mesh is None and (
+        not isinstance(a, jax.Array) or a.is_fully_addressable)
+    if src_is_local and sharding.is_fully_addressable:
+        # both ends process-local (single process, or a purely local move)
+        return jax.device_put(a, sharding)
+    if src_spans_all and isinstance(a, jax.Array) and src_sh is not None \
+            and not a.is_fully_addressable \
+            and tuple(getattr(src_sh, "_device_assignment", ())) \
+            == tuple(getattr(sharding, "_device_assignment", (None,))):
+        # same mesh in the same device ORDER (possibly different layout):
+        # compiled identity with out_shardings — XLA emits the cross-host
+        # collective (device_put cannot move bytes between hosts on every
+        # backend, and never under the eager-vjp tape). A permuted device
+        # order is a same_status cross-mesh transfer (host path below).
+        return _resharder(sharding)(a)
+    # CROSS-MESH reshard (the reference's same_status / global↔sub-mesh
+    # transfer, same_status_reshard_function.cc): source and target own
+    # different device sets, so no single XLA program expresses the move.
+    # Owner processes replicate on the SOURCE mesh (one compiled
+    # all-gather over its ICI) and read the host view; if the source does
+    # not span every process, the host bytes hop to the others over the
+    # coordination service before each process materializes only its own
+    # target shards.
+    me = jax.process_index() if nprocs > 1 else 0
+    host = None
+    if me in src_procs and isinstance(a, jax.Array) \
+            and not a.is_fully_addressable and not a.is_fully_replicated:
+        if not hasattr(src_sh, "mesh"):
+            raise NotImplementedError(
+                "cross-mesh reshard needs a NamedSharding source")
+        a = _resharder(NamedSharding(src_sh.mesh, PartitionSpec()))(a)
+    if me in src_procs:
+        host = np.asarray(a)
+    if not src_is_local and not src_spans_all:
+        # one distributed source owned by a subset of processes: the host
+        # bytes hop to the rest over the coordination service
+        host = _host_bcast(host, src_procs[0])
     return jax.make_array_from_callback(
-        host.shape, sharding, lambda idx: np.ascontiguousarray(host[idx]))
+        host.shape, sharding, lambda idx: np.ascontiguousarray(host[idx]),
+        dtype=host.dtype)
+
+
+import itertools as _it  # noqa: E402
+
+_xmesh_seq = _it.count()
+_xmesh_src_hist: dict[int, int] = {}
+
+
+def _host_bcast(host_or_none, src_proc):
+    """Host-level value transfer for cross-mesh reshard when the source
+    mesh does not span every process: the owning process publishes the
+    bytes on the coordination-service KV store (the TCPStore analog) and
+    every other process blocking-reads them. Every process must call this
+    in the same order (the store key is a shared sequence number).
+
+    Store stays bounded (the _subgroup_bcast pattern in collective.py):
+    readers ack each round; before publishing round N the current src
+    waits for round N-2's acks (from that round's recorded src) and
+    deletes its payload + acks."""
+    import base64
+    import pickle
+
+    import jax as _jax
+
+    seq = next(_xmesh_seq)
+    _xmesh_src_hist[seq] = src_proc
+    key = f"ptpu_xmesh/{seq}"
+    from .collective import _kv_client
+    client = _kv_client()
+    me = _jax.process_index()
+    nprocs = _jax.process_count()
+    if me == src_proc:
+        old = seq - 2
+        if old >= 0:
+            old_src = _xmesh_src_hist.pop(old, src_proc)
+            for r in range(nprocs):
+                if r == old_src or r == me:
+                    continue
+                client.blocking_key_value_get(
+                    f"ptpu_xmesh/{old}/ack{r}", 120_000)
+                try:
+                    client.key_value_delete(f"ptpu_xmesh/{old}/ack{r}")
+                except Exception:
+                    pass
+            for k in (f"ptpu_xmesh/{old}", f"ptpu_xmesh/{old}/ack{me}"):
+                try:
+                    client.key_value_delete(k)
+                except Exception:
+                    pass
+        client.key_value_set(
+            key, base64.b64encode(pickle.dumps(host_or_none)).decode())
+        return host_or_none
+    _xmesh_src_hist.pop(seq - 2, None)
+    raw = client.blocking_key_value_get(key, 120_000)
+    client.key_value_set(f"{key}/ack{me}", "1")
+    return pickle.loads(base64.b64decode(raw))
 
 
 @functools.lru_cache(maxsize=256)
@@ -64,7 +177,7 @@ def _resharder(sharding):
     return jax.jit(lambda x: x, out_shardings=sharding)
 
 
-def _eager_reshard(t: Tensor, sharding):
+def _eager_reshard(t: Tensor, sharding, src_mesh=None, dst_mesh=None):
     """Concrete (non-traced) reshard with a hand-built tape node.
 
     The generic eager vjp (jax.vjp over the op body) cannot be used here:
@@ -79,15 +192,19 @@ def _eager_reshard(t: Tensor, sharding):
 
     data = t._data
     src_sharding = getattr(data, "sharding", None)
-    placed = _put_global(data, sharding)
+    placed = _put_global(data, sharding, src_mesh)
     record = (_ag.is_grad_enabled() and not t.stop_gradient
               and _is_diff_array(data))
     out = Tensor(placed, stop_gradient=not record)
     if record:
-        def vjp_fn(ct, _src=src_sharding):
+        def vjp_fn(ct, _src=src_sharding, _src_mesh=src_mesh,
+                   _dst_mesh=dst_mesh):
             cta = ct._data if isinstance(ct, Tensor) else ct
             if _src is not None and not isinstance(cta, jax.core.Tracer):
-                cta = _put_global(cta, _src)
+                # the cotangent is placed like the forward OUTPUT: its
+                # source mesh is the forward's destination mesh (keeps
+                # the cross-mesh branch choice process-invariant)
+                cta = _put_global(cta, _src, src_mesh=_dst_mesh)
             return (cta,)
 
         edges = [("node", t._grad_node, t._output_slot)
@@ -120,13 +237,14 @@ def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None, stop_gradient=Non
     # Route the transfer through the op layer: device_put is differentiable
     # (identity vjp), so resharding mid-graph keeps the tape connected — the
     # analog of the reference's reshard ops being autograd-visible ops.
+    src_mesh = t._dist_attr[0] if hasattr(t, "_dist_attr") else None
     if isinstance(t._data, jax.core.Tracer):
         # traced context (TrainStep / to_static): generic tape vjp is fine —
         # device_put stays symbolic and GSPMD handles the placement
         out = eager_apply("reshard",
                           lambda a: jax.device_put(a, sharding), (t,), {})
     else:
-        out = _eager_reshard(t, sharding)
+        out = _eager_reshard(t, sharding, src_mesh, dst_mesh=mesh)
     if dtype is not None:
         out = out.astype(dtype)
     if stop_gradient is not None:
@@ -224,7 +342,9 @@ def shard_parameter(p, mesh: ProcessMesh, placements):
     """In-place re-placement of a Parameter (keeps identity for optimizers)."""
     if any(isinstance(pl, Partial) for pl in placements):
         raise ValueError("parameters cannot be Partial")
-    p._data = _put_global(p._data, mesh.sharding_for(placements, max(p.ndim, 1)))
+    p._data = _put_global(
+        p._data, mesh.sharding_for(placements, max(p.ndim, 1)),
+        p._dist_attr[0] if hasattr(p, "_dist_attr") else None)
     p._dist_attr = (mesh, list(placements))
     return p
 

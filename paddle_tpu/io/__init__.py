@@ -390,6 +390,7 @@ class DataLoader:
         try:
             pickle.dumps((self.dataset, self.collate_fn,
                           self.worker_init_fn))
+            self.use_process_workers = True   # probe once, not per epoch
             return True
         except Exception:
             import warnings

@@ -192,6 +192,7 @@ class _ProcessPool:
         self.epoch += 1
         epoch = self.epoch
         inflight = 0
+        waited = 0.0
         pending = {}
         next_out = 0
         it = iter(enumerate(idx_batches))
@@ -213,20 +214,28 @@ class _ProcessPool:
                 # blocking forever (the reference's _thread_monitor role)
                 ep, seq, payload = self.result_q.get(
                     timeout=min(timeout, 5.0) if timeout else 5.0)
+                waited = 0.0
             except _queue.Empty:
                 if not self.alive():
                     self.shutdown()
                     raise RuntimeError(
                         "DataLoader worker died unexpectedly (killed or "
                         "crashed without reporting)")
+                waited += 5.0
+                if timeout and waited >= timeout:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {timeout}s")
                 continue
-            if ep != epoch:
-                continue   # stale result from an abandoned epoch
-            inflight -= 1
             if isinstance(payload, _Err):
+                # errors surface regardless of epoch tag (an init-fn
+                # failure is tagged -1; dropping it would hide the trace)
                 self.shutdown()
                 raise RuntimeError(
                     f"DataLoader worker failed: {payload.tb}")
+            if ep != epoch:
+                continue   # stale result from an abandoned epoch
+            inflight -= 1
             pending[seq] = payload
             while next_out in pending:
                 yield _decode(pending.pop(next_out))
@@ -265,14 +274,24 @@ def iter_iterable_multiprocess(loader, timeout):
             p.start()
             procs.append(p)
     done = 0
+    waited = 0.0
     try:
         while done < len(procs):
             try:
                 tag, payload = result_q.get(
-                    timeout=timeout if timeout else None)
+                    timeout=min(timeout, 5.0) if timeout else 5.0)
+                waited = 0.0
             except _queue.Empty:
-                raise RuntimeError(
-                    f"DataLoader worker timed out after {timeout}s")
+                dead = sum(not p.is_alive() for p in procs)
+                if dead > done:   # a worker died without its done sentinel
+                    raise RuntimeError(
+                        "DataLoader worker died unexpectedly (killed or "
+                        "crashed without reporting)")
+                waited += 5.0
+                if timeout and waited >= timeout:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {timeout}s")
+                continue
             if tag is None:
                 done += 1
                 continue

@@ -169,6 +169,28 @@ def main():
                                      np.asarray(result["A_params"][n])))
     result["C_roundtrip_ok"] = ok
 
+    # ---- [D] cross-mesh reshard across the process boundary ----
+    # live-tensor analog of [C]: an mp-sharded GLOBAL tensor moves onto a
+    # sub-mesh owned entirely by process 0, then back onto a permuted
+    # global mesh (reference: same_status / global<->sub-mesh reshard)
+    from paddle_tpu.distributed.mesh import ProcessMesh
+
+    devs = [d.id for d in jax.devices()]
+    mesh_g = dist.init_mesh({"dp": 2, "mp": 4})
+    sub = ProcessMesh(np.asarray(devs[:4]), ["mp"])     # process 0 only
+    perm = ProcessMesh(np.asarray(devs[::-1]).reshape(4, 2), ["mp", "dp"])
+    val = np.arange(32, dtype=np.float32).reshape(8, 4)
+    tg = shard_tensor(paddle.to_tensor(val), mesh_g,
+                      [Shard(0), Shard(1)])
+    ts = dist.reshard(tg, sub, [Shard(0)])
+    ok_d = True
+    if rank == 0:   # only process 0 can read the sub-mesh tensor
+        ok_d = bool(np.array_equal(np.asarray(ts.numpy()), val))
+    tb = dist.reshard(ts, perm, [Shard(1), Replicate()])
+    ok_d = ok_d and bool(np.array_equal(np.asarray(
+        dist.reshard(tb, mesh_g, [Replicate(), Replicate()]).numpy()), val))
+    result["D_cross_mesh_ok"] = ok_d
+
     dist.barrier()
     if rank == 0:
         with open(os.environ["SPMD_OUT"], "w") as f:

@@ -74,3 +74,10 @@ def test_pipeline_step_across_processes(spmd_result):
 @pytest.mark.slow
 def test_sharded_checkpoint_reshard_across_processes(spmd_result):
     assert spmd_result["C_roundtrip_ok"]
+
+
+@pytest.mark.slow
+def test_cross_mesh_reshard_across_processes(spmd_result):
+    """Live-tensor cross-mesh transfer (same_status + global<->sub-mesh)
+    with real process boundaries (round-2 verdict item #9)."""
+    assert spmd_result["D_cross_mesh_ok"]
