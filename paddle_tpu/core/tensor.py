@@ -244,6 +244,10 @@ class Tensor:
             yield self[i]
 
     def __bool__(self):
+        from .branch_guards import bool_hook
+        v = bool_hook(self._data)
+        if v is not None:
+            return v
         return bool(self.numpy())
 
     def __int__(self):

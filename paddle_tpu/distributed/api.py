@@ -69,14 +69,23 @@ def _put_global(a, sharding, src_mesh=None):
     if src_mesh is not None:
         src_procs = sorted({d.process_index
                             for d in src_mesh.jax_mesh.devices.flat})
+        src_is_local = False
+    elif src_sh is not None and hasattr(src_sh, "mesh"):
+        # op-produced tensors carry no _dist_attr but their NamedSharding
+        # mesh is identical metadata on every process — another
+        # process-invariant source of truth (a per-process local value has
+        # a SingleDeviceSharding instead)
+        src_procs = sorted({d.process_index
+                            for d in src_sh.mesh.devices.flat})
+        src_is_local = False
     elif src_sh is not None and not a.is_fully_addressable:
         src_procs = sorted({d.process_index for d in src_sh.device_set})
+        src_is_local = False
     else:
         src_procs = list(range(nprocs))   # local value on every process
+        src_is_local = True
     src_spans_all = set(src_procs) == set(range(nprocs))
-    src_is_local = src_mesh is None and (
-        not isinstance(a, jax.Array) or a.is_fully_addressable)
-    if src_is_local and sharding.is_fully_addressable:
+    if (src_is_local or nprocs == 1) and sharding.is_fully_addressable:
         # both ends process-local (single process, or a purely local move)
         return jax.device_put(a, sharding)
     if src_spans_all and isinstance(a, jax.Array) and src_sh is not None \
@@ -116,60 +125,17 @@ def _put_global(a, sharding, src_mesh=None):
         dtype=host.dtype)
 
 
-import itertools as _it  # noqa: E402
-
-_xmesh_seq = _it.count()
-_xmesh_src_hist: dict[int, int] = {}
-
-
 def _host_bcast(host_or_none, src_proc):
     """Host-level value transfer for cross-mesh reshard when the source
-    mesh does not span every process: the owning process publishes the
-    bytes on the coordination-service KV store (the TCPStore analog) and
-    every other process blocking-reads them. Every process must call this
-    in the same order (the store key is a shared sequence number).
-
-    Store stays bounded (the _subgroup_bcast pattern in collective.py):
-    readers ack each round; before publishing round N the current src
-    waits for round N-2's acks (from that round's recorded src) and
-    deletes its payload + acks."""
-    import base64
-    import pickle
-
+    mesh does not span every process: the collective layer's subgroup
+    broadcast (ack-bounded publish/consume over the coordination-service
+    KV store) carries the bytes from the owning process to every other —
+    the same protocol, one implementation (collective._subgroup_bcast)."""
     import jax as _jax
 
-    seq = next(_xmesh_seq)
-    _xmesh_src_hist[seq] = src_proc
-    key = f"ptpu_xmesh/{seq}"
-    from .collective import _kv_client
-    client = _kv_client()
-    me = _jax.process_index()
-    nprocs = _jax.process_count()
-    if me == src_proc:
-        old = seq - 2
-        if old >= 0:
-            old_src = _xmesh_src_hist.pop(old, src_proc)
-            for r in range(nprocs):
-                if r == old_src or r == me:
-                    continue
-                client.blocking_key_value_get(
-                    f"ptpu_xmesh/{old}/ack{r}", 120_000)
-                try:
-                    client.key_value_delete(f"ptpu_xmesh/{old}/ack{r}")
-                except Exception:
-                    pass
-            for k in (f"ptpu_xmesh/{old}", f"ptpu_xmesh/{old}/ack{me}"):
-                try:
-                    client.key_value_delete(k)
-                except Exception:
-                    pass
-        client.key_value_set(
-            key, base64.b64encode(pickle.dumps(host_or_none)).decode())
-        return host_or_none
-    _xmesh_src_hist.pop(seq - 2, None)
-    raw = client.blocking_key_value_get(key, 120_000)
-    client.key_value_set(f"{key}/ack{me}", "1")
-    return pickle.loads(base64.b64decode(raw))
+    from .collective import _subgroup_bcast
+    ranks = list(range(_jax.process_count()))
+    return _subgroup_bcast(host_or_none, None, ranks, src_proc)
 
 
 @functools.lru_cache(maxsize=256)

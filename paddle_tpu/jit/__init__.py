@@ -84,6 +84,9 @@ class StaticFunction:
         self._layer = layer
         self._cache = {}
         self._graph_broken = set()
+        # SOT-lite value guards (core/branch_guards.py): per signature, a
+        # dict of branch-decision-vector -> compiled specialization
+        self._guarded = {}
         functools.update_wrapper(self, fn)
 
     def _key(self, flat_args):
@@ -105,31 +108,13 @@ class StaticFunction:
         key = self._key(arr_in)
         if key in self._graph_broken:
             return self._fn(*args, **kwargs)
+        if key in self._guarded:
+            return self._run_guarded(key, state, flat_in, in_tree,
+                                     tensor_pos, arr_in, args, kwargs)
 
         if key not in self._cache:
-            installer = _Installed(state)
-            # template keeps only non-tensor leaves; tensor slots are filled
-            # from dyn_args each call (so no input batch is pinned in HBM)
-            template = [None if isinstance(x, Tensor) else x for x in flat_in]
-
-            def pure(state_arrays, rng_key, *dyn_args):
-                with installer:
-                    installer.install(state_arrays)
-                    with _rng.capture_rng(rng_key), _ag.no_grad():
-                        vals = list(template)
-                        for i, a in zip(tensor_pos, dyn_args):
-                            vals[i] = a
-                        a_args, a_kwargs = jax.tree.unflatten(in_tree, [
-                            Tensor(v) if i in tensor_pos else v
-                            for i, v in enumerate(vals)])
-                        out = self._fn(*a_args, **a_kwargs)
-                    new_state = installer.current()
-                out_arrays = jax.tree.map(
-                    lambda x: x._data if isinstance(x, Tensor) else x, out,
-                    is_leaf=lambda x: isinstance(x, Tensor))
-                return out_arrays, new_state
-
-            self._cache[key] = jax.jit(pure)
+            self._cache[key] = self._build_pure(state, flat_in, in_tree,
+                                                tensor_pos)
 
         state_arrays = {k: t._data for k, t in state.items()}
         dyn = [arr_in[i] for i in tensor_pos]
@@ -140,23 +125,152 @@ class StaticFunction:
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.TracerArrayConversionError):
-            # data-dependent Python control flow: graph-break to eager for
-            # this signature (the SOT fallback, jit/sot/translate.py)
-            import warnings
-            warnings.warn(
-                f"jit.to_static({getattr(self._fn, '__name__', self._fn)}): "
-                "tensor-dependent Python control flow cannot be captured — "
-                "falling back to eager for this input signature (use "
-                "lax.cond-style ops or paddle.where for a compiled branch)",
-                stacklevel=2)
-            self._graph_broken.add(key)
             del self._cache[key]
-            return self._fn(*args, **kwargs)
+            # SOT-lite: tensor-dependent `if` — record the branch-decision
+            # vector eagerly, then compile a per-branch specialization with
+            # value guards (reference capability: jit/sot re-traces per
+            # guarded branch, translate.py:106). Non-bool concretizations
+            # (int shapes etc.) still graph-break.
+            from ..core import branch_guards as _bg
+            with _bg.record() as rec:
+                out = self._fn(*args, **kwargs)
+            decisions = rec.decisions
+            if not decisions:
+                import warnings
+                warnings.warn(
+                    f"jit.to_static({getattr(self._fn, '__name__', self._fn)}): "
+                    "tensor-dependent Python control flow cannot be "
+                    "captured — falling back to eager for this input "
+                    "signature (use paddle.where or static shapes)",
+                    stacklevel=2)
+                self._graph_broken.add(key)
+                return out
+            entry = {"specs": {}, "last": decisions}
+            entry["specs"][decisions] = self._build_pure(
+                state, flat_in, in_tree, tensor_pos, decisions)
+            self._guarded[key] = entry
+            return out    # eager result this call; compiled from the next
         # commit buffer mutations (running stats etc.); params are read-only here
         for k, t in state.items():
             if k.startswith("b:"):
                 t._data = new_state[k]
         return _tree_to_tensors(out_arrays)
+
+    def _build_pure(self, state, flat_in, in_tree, tensor_pos,
+                    decisions=None):
+        """jit the functionalized eager call. With ``decisions``, the trace
+        replays that branch-decision vector at every tensor bool and the
+        condition values ride along as guard outputs."""
+        from ..core import branch_guards as _bg
+
+        installer = _Installed(state)
+        # template keeps only non-tensor leaves; tensor slots are filled
+        # from dyn_args each call (so no input batch is pinned in HBM)
+        template = [None if isinstance(x, Tensor) else x for x in flat_in]
+
+        def pure(state_arrays, rng_key, *dyn_args):
+            with installer:
+                installer.install(state_arrays)
+                with _rng.capture_rng(rng_key), _ag.no_grad():
+                    vals = list(template)
+                    for i, a in zip(tensor_pos, dyn_args):
+                        vals[i] = a
+                    a_args, a_kwargs = jax.tree.unflatten(in_tree, [
+                        Tensor(v) if i in tensor_pos else v
+                        for i, v in enumerate(vals)])
+                    if decisions is None:
+                        out = self._fn(*a_args, **a_kwargs)
+                        conds = None
+                    else:
+                        with _bg.replay(decisions) as rp:
+                            out = self._fn(*a_args, **a_kwargs)
+                        conds = tuple(
+                            jnp.reshape(jnp.asarray(c), ()).astype(bool)
+                            for c in rp.conds)
+                new_state = installer.current()
+            out_arrays = jax.tree.map(
+                lambda x: x._data if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+            if decisions is None:
+                return out_arrays, new_state
+            return out_arrays, new_state, conds
+
+        return jax.jit(pure)
+
+    def _run_guarded(self, key, state, flat_in, in_tree, tensor_pos,
+                     arr_in, args, kwargs):
+        """Dispatch among branch specializations.
+
+        Run the last-used specialization; its guard outputs are the
+        condition values computed on the CURRENT inputs, so the first
+        guard that disagrees with the specialization's decision vector
+        reveals the true branch — dispatch to (or record+compile) the
+        right specialization instead of permanent eager fallback.
+        """
+        from ..core import branch_guards as _bg
+
+        entry = self._guarded[key]
+        state_arrays = {k: t._data for k, t in state.items()}
+        dyn = [arr_in[i] for i in tensor_pos]
+        vec = entry["last"]
+        tried = set()
+        for _ in range(len(entry["specs"]) + 1):
+            tried.add(vec)
+            try:
+                out_arrays, new_state, conds = entry["specs"][vec](
+                    state_arrays, _rng.next_key(), *dyn)
+            except _bg.GuardOverflow:
+                # the branch STRUCTURE is input-dependent beyond value
+                # specialization — drop the spec and re-record
+                del entry["specs"][vec]
+                break
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError):
+                # a NON-bool concretization inside a guarded branch: value
+                # guards cannot capture it — graph-break like the plain
+                # path (the removed-fallback regression)
+                import warnings
+                warnings.warn(
+                    f"jit.to_static({getattr(self._fn, '__name__', self._fn)}): "
+                    "tensor-dependent Python control flow cannot be "
+                    "captured — falling back to eager for this input "
+                    "signature (use paddle.where or static shapes)",
+                    stacklevel=2)
+                self._graph_broken.add(key)
+                del self._guarded[key]
+                return self._fn(*args, **kwargs)
+            observed = tuple(bool(c) for c in conds)
+            if observed == vec:
+                entry["last"] = vec
+                for k, t in state.items():
+                    if k.startswith("b:"):
+                        t._data = new_state[k]
+                return _tree_to_tensors(out_arrays)
+            # first divergent guard is computed on the shared prefix path,
+            # so its value is the true decision
+            k_div = next((i for i, (o, v) in enumerate(zip(observed, vec))
+                          if o != v), None)
+            if k_div is None:
+                break    # lengths diverged: structure mismatch, re-record
+            prefix = vec[:k_div] + (observed[k_div],)
+            matches = [v for v in entry["specs"]
+                       if v[:k_div + 1] == prefix and v not in tried]
+            if matches:
+                vec = matches[0]   # refine along any consistent candidate
+                continue
+            break
+        # unknown branch path: eager run records it; compile for next time
+        with _bg.record() as rec:
+            out = self._fn(*args, **kwargs)
+        decisions = rec.decisions
+        if decisions and decisions not in entry["specs"]:
+            entry["specs"][decisions] = self._build_pure(
+                state, flat_in, in_tree, tensor_pos, decisions)
+        if decisions:
+            entry["last"] = decisions
+        return out
 
     @property
     def forward(self):
