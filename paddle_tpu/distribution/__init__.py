@@ -11,3 +11,6 @@ from .transform import (  # noqa: F401
     Transform, AffineTransform, ExpTransform, PowerTransform,
     SigmoidTransform, TanhTransform, SoftmaxTransform, AbsTransform,
     ChainTransform, TransformedDistribution, Independent)
+from .multivariate import (  # noqa: F401
+    MultivariateNormal, ContinuousBernoulli, LKJCholesky,
+    ExponentialFamily)

@@ -1,0 +1,246 @@
+"""Multivariate / structured distributions closing the reference tail
+(reference: python/paddle/distribution/multivariate_normal.py,
+continuous_bernoulli.py, lkj_cholesky.py, exponential_family.py)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _apply, next_key, param
+
+_LOG_2PI = math.log(2 * math.pi)
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference:
+    exponential_family.py). Subclasses define natural parameters and the
+    log-normalizer; the Bregman-divergence entropy falls out of autodiff
+    over the log-normalizer — here via ``jax.grad``."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """-E[log p] from the log-normalizer's gradients (the reference's
+        Bregman trick, exponential_family.py entropy)."""
+        from ..core.tensor import Tensor
+
+        nparams = [p._data if isinstance(p, Tensor) else jnp.asarray(p)
+                   for p in self._natural_parameters]
+        lg = self._log_normalizer(*nparams)
+        grads = jax.grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)))(tuple(nparams))
+        ent = lg - self._mean_carrier_measure
+        for np_, g in zip(nparams, grads):
+            ent = ent - np_ * g
+        return Tensor(ent)
+
+
+class MultivariateNormal(Distribution):
+    """N(loc, Sigma) with full covariance (multivariate_normal.py).
+
+    One of ``covariance_matrix`` / ``precision_matrix`` / ``scale_tril``
+    parameterizes the distribution; internally everything routes through
+    the Cholesky factor (TPU-friendly triangular solves).
+    """
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril is required")
+        self.loc = param(loc)
+        d = self.loc._data
+        if scale_tril is not None:
+            self._tril = param(scale_tril)._data
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                param(covariance_matrix)._data)
+        else:
+            prec = param(precision_matrix)._data
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        if d.shape[-1] != self._tril.shape[-1]:
+            raise ValueError("loc / matrix dimension mismatch")
+        super().__init__(tuple(d.shape[:-1]))
+        self._event = d.shape[-1]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        from ..core.tensor import Tensor
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        from ..core.tensor import Tensor
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def rsample(self, shape=()):
+        from ..core.tensor import Tensor
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        key = next_key()
+        loc = self.loc._data
+        out_shape = shape + loc.shape
+        eps = jax.random.normal(key, out_shape, jnp.result_type(loc))
+        return Tensor(loc + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        from ..core.tensor import Tensor
+        v = param(value)._data - self.loc._data
+        # solve L z = (x - mu): z = L^-1 (x-mu); logp = -0.5 z^T z - log|L|
+        if self._tril.ndim == 2:
+            d = v.shape[-1]
+            flat = v.reshape(-1, d).T                      # [d, N]
+            z = jax.scipy.linalg.solve_triangular(
+                self._tril, flat, lower=True).T.reshape(v.shape)
+        else:
+            z = jnp.linalg.solve(self._tril, v[..., None])[..., 0]
+        half_log_det = jnp.sum(jnp.log(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(z ** 2, -1) - half_log_det
+                      - 0.5 * self._event * _LOG_2PI)
+
+    def entropy(self):
+        from ..core.tensor import Tensor
+        half_log_det = jnp.sum(jnp.log(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * self._event * (1.0 + _LOG_2PI) + half_log_det)
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(probs) on [0, 1] (continuous_bernoulli.py; Loaiza-Ganem &
+    Cunningham 2019). Densities use the numerically-stable log-normalizer
+    with a Taylor window around probs=0.5 (lims), as the reference does."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = param(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs._data.shape))
+
+    def _outside(self, p):
+        lo, hi = self._lims
+        return (p < lo) | (p > hi)
+
+    def _log_norm(self, p):
+        # C(p) = log( (2 atanh(1-2p)) / (1-2p) ) outside the window; a
+        # 2nd-order Taylor expansion inside (the reference's approach)
+        p_safe = jnp.where(self._outside(p), p, 0.4)
+        out = jnp.log(2 * jnp.arctanh(1 - 2 * p_safe) / (1 - 2 * p_safe))
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x ** 2) * x ** 2
+        return jnp.where(self._outside(p), out, taylor)
+
+    @property
+    def mean(self):
+        from ..core.tensor import Tensor
+        p = self.probs._data
+        p_safe = jnp.where(self._outside(p), p, 0.4)
+        m = p_safe / (2 * p_safe - 1) + 1 / (
+            2 * jnp.arctanh(1 - 2 * p_safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x ** 2) * x
+        return Tensor(jnp.where(self._outside(p), m, taylor))
+
+    def sample(self, shape=()):
+        from ..core.tensor import Tensor
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        key = next_key()
+        p = self.probs._data
+        u = jax.random.uniform(key, shape + p.shape, jnp.result_type(p))
+        return Tensor(self._icdf(u, p))
+
+    rsample = sample
+
+    def _icdf(self, u, p):
+        p_safe = jnp.where(self._outside(p), p, 0.4)
+        icdf = (jnp.log1p(-p_safe + u * (2 * p_safe - 1))
+                - jnp.log1p(-p_safe)) / (
+            jnp.log(p_safe) - jnp.log1p(-p_safe))
+        return jnp.where(self._outside(p), icdf, u)
+
+    def log_prob(self, value):
+        from ..core.tensor import Tensor
+        v = param(value)._data
+        p = self.probs._data
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_norm(p))
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices
+    (lkj_cholesky.py; Lewandowski-Kurowicka-Joe 2009), sampled via the
+    onion method — static-shape friendly."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError("LKJCholesky needs dim >= 2")
+        self.dim = int(dim)
+        self.concentration = param(concentration)
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method!r}")
+        super().__init__(tuple(self.concentration._data.shape))
+
+    def sample(self, shape=()):
+        from ..core.tensor import Tensor
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        d = self.dim
+        eta = jnp.asarray(self.concentration._data, jnp.float32)
+        batch = shape + tuple(eta.shape)
+        key = next_key()
+        k_beta, k_norm = jax.random.split(key)
+        # onion method: row i ~ scaled spherical sample with Beta radius
+        L = jnp.zeros(batch + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            k_beta, kb = jax.random.split(k_beta)
+            k_norm, kn = jax.random.split(k_norm)
+            beta_conc1 = i / 2.0
+            beta_conc0 = eta + (d - 1 - i) / 2.0
+            y = jax.random.beta(kb, beta_conc1,
+                                jnp.broadcast_to(beta_conc0, batch))
+            u = jax.random.normal(kn, batch + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.clip(1.0 - y, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        from ..core.tensor import Tensor
+        L = param(value)._data
+        d = self.dim
+        eta = jnp.asarray(self.concentration._data, jnp.float32)
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+        unnorm = jnp.sum((d - orders + 2 * eta[..., None] - 2)
+                         * jnp.log(diag), -1)
+        # normalizer (reference lkj_cholesky.py log_normalizer)
+        alpha = eta[..., None] + 0.5 * (d - orders)
+        lognorm = (0.5 * math.log(math.pi) * (orders - 1)
+                   + jax.scipy.special.gammaln(alpha - 0.5 * (orders - 1))
+                   - jax.scipy.special.gammaln(alpha))
+        return Tensor(unnorm - jnp.sum(lognorm, -1))
+
+
+__all__ = ["MultivariateNormal", "ContinuousBernoulli", "LKJCholesky",
+           "ExponentialFamily"]

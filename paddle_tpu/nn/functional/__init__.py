@@ -7,7 +7,8 @@ from .pooling import (  # noqa: F401
     max_pool1d, max_pool2d, max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d,
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
-    lp_pool1d, lp_pool2d, max_unpool2d,
+    lp_pool1d, lp_pool2d, max_unpool1d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d,
 )
 from .norm import (  # noqa: F401
     layer_norm, rms_norm, batch_norm, group_norm, instance_norm, normalize,
@@ -18,7 +19,7 @@ from .loss import (  # noqa: F401
     smooth_l1_loss, huber_loss, binary_cross_entropy,
     binary_cross_entropy_with_logits, kl_div, margin_ranking_loss,
     cosine_embedding_loss, triplet_margin_loss, hinge_embedding_loss,
-    square_error_cost, sigmoid_focal_loss, ctc_loss,
+    square_error_cost, sigmoid_focal_loss, ctc_loss, rnnt_loss,
     fused_linear_cross_entropy, margin_cross_entropy, hsigmoid_loss,
 )
 from .common import (  # noqa: F401

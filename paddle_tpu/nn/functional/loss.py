@@ -423,3 +423,143 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
             raise ValueError("path_table requires path_code")
         args += [path_table, path_code]
     return eager_apply("hsigmoid_loss", fn, tuple(args), {})
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: nn/functional/loss.py:2054, CUDA
+    warprnnt kernel phi/kernels/gpu/warprnnt_kernel.cu).
+
+    input: [B, T, U+1, V] UNNORMALIZED logits (log-softmax applied here,
+    as warprnnt does); label: [B, U] int; lengths per sample. Forward and
+    backward lattice DPs run as lax.scans over T; gradients are the exact
+    alpha/beta occupancies via a custom VJP, with FastEmit (Yu et al.
+    2021) applied the way warp-transducer does: the EMIT-transition
+    gradient at every lattice node is scaled by (1 + lambda) — the loss
+    VALUE itself is the standard transducer NLL.
+    """
+    import jax.lax as lax
+
+    def fn(logits, labels, in_len, lab_len):
+        b, t_max, u1, v = logits.shape
+        u_max = u1 - 1
+        lam = float(fastemit_lambda)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def lattice_terms(logits):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            blank_lp = logp[..., blank]                        # [B,T,U+1]
+            lab = labels.astype(jnp.int32)
+            emit_lp = jnp.take_along_axis(
+                logp[:, :, :u_max, :],
+                lab[:, None, :, None].repeat(t_max, 1), -1)[..., 0]
+            return blank_lp, emit_lp                            # [B,T,U]
+
+        t_idx = in_len.astype(jnp.int32) - 1
+        u_idx = lab_len.astype(jnp.int32)
+        u_range = jnp.arange(u1)[None, :]
+
+        def alpha_scan(blank_lp, emit_lp):
+            def step(alpha_prev, t):
+                from_blank = jnp.where(
+                    t == 0,
+                    jnp.where(u_range == 0, 0.0, neg_inf),
+                    alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+
+                def emit_step(carry, u):
+                    cur = jnp.logaddexp(
+                        from_blank[:, u], carry + emit_lp[:, t, u - 1])
+                    return cur, cur
+
+                a0 = from_blank[:, 0]
+                _, rest = lax.scan(emit_step, a0, jnp.arange(1, u1))
+                alpha_t = jnp.concatenate(
+                    [a0[:, None], jnp.moveaxis(rest, 0, 1)], 1)
+                return alpha_t, alpha_t
+
+            alpha0 = jnp.full((b, u1), neg_inf)
+            _, alphas = lax.scan(step, alpha0, jnp.arange(t_max))
+            return jnp.moveaxis(alphas, 0, 1)                  # [B,T,U+1]
+
+        def beta_scan(blank_lp, emit_lp):
+            # beta(t,u): log-prob of completing from (t,u). Terminal:
+            # beta(t_len-1, u_len) = blank there; outside valid区 -inf.
+            valid_u = u_range <= u_idx[:, None]
+
+            def step(beta_next, t):
+                # t runs T-1 .. 0; beta_next = beta(t+1, :)
+                at_term = (t == t_idx)
+                blank_t = blank_lp[:, t, :]
+                from_blank = jnp.where(
+                    at_term[:, None],
+                    jnp.where(u_range == u_idx[:, None], blank_t, neg_inf),
+                    beta_next + blank_t)
+
+                def emit_step(carry, u):
+                    # carry = beta(t, u+1); emit (t,u) -> (t,u+1)
+                    cur = jnp.logaddexp(
+                        from_blank[:, u],
+                        carry + emit_lp[:, t, u])
+                    return cur, cur
+
+                bU = from_blank[:, u1 - 1]
+                _, rest = lax.scan(emit_step, bU,
+                                   jnp.arange(u1 - 2, -1, -1))
+                beta_t = jnp.concatenate(
+                    [jnp.moveaxis(rest, 0, 1)[:, ::-1], bU[:, None]], 1)
+                beta_t = jnp.where(valid_u, beta_t, neg_inf)
+                return beta_t, beta_t
+
+            beta0 = jnp.full((b, u1), neg_inf)
+            _, betas = lax.scan(step, beta0,
+                                jnp.arange(t_max - 1, -1, -1))
+            return jnp.moveaxis(betas[::-1], 0, 1)             # [B,T,U+1]
+
+        @jax.custom_vjp
+        def nll_from_terms(blank_lp, emit_lp):
+            alphas = alpha_scan(blank_lp, emit_lp)
+            final = jnp.take_along_axis(jnp.take_along_axis(
+                alphas, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
+                u_idx[:, None], 1)[:, 0]
+            final_blank = jnp.take_along_axis(jnp.take_along_axis(
+                blank_lp, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
+                u_idx[:, None], 1)[:, 0]
+            return -(final + final_blank)
+
+        def nll_fwd(blank_lp, emit_lp):
+            alphas = alpha_scan(blank_lp, emit_lp)
+            betas = beta_scan(blank_lp, emit_lp)
+            nll = -betas[:, 0, 0]
+            return nll, (alphas, betas, blank_lp, emit_lp, nll)
+
+        def nll_bwd(res, ct):
+            alphas, betas, blank_lp, emit_lp, nll = res
+            logZ = -nll[:, None, None]
+            t_r = jnp.arange(t_max)[None, :, None]
+            u_r = jnp.arange(u1)[None, None, :]
+            in_t = t_r < in_len.astype(jnp.int32)[:, None, None]
+            # blank occupancy: alpha(t,u) + blank(t,u) + beta(t+1,u)
+            beta_tp1 = jnp.concatenate(
+                [betas[:, 1:, :], jnp.full((b, 1, u1), neg_inf)], 1)
+            at_term = (t_r == t_idx[:, None, None]) & \
+                (u_r == u_idx[:, None, None])
+            blank_next = jnp.where(at_term, 0.0, beta_tp1)
+            occ_blank = jnp.exp(jnp.clip(
+                alphas + blank_lp + blank_next - logZ, -80, 0)) * in_t
+            # emit occupancy: alpha(t,u) + emit(t,u) + beta(t,u+1)
+            occ_emit = jnp.exp(jnp.clip(
+                alphas[:, :, :u_max] + emit_lp + betas[:, :, 1:] - logZ,
+                -80, 0)) * in_t
+            # FastEmit: scale the emit-transition gradient by (1+lambda)
+            occ_emit = occ_emit * (1.0 + lam)
+            return (-occ_blank * ct[:, None, None],
+                    -occ_emit * ct[:, None, None])
+
+        nll_from_terms.defvjp(nll_fwd, nll_bwd)
+
+        blank_lp, emit_lp = lattice_terms(logits)
+        nll = nll_from_terms(blank_lp, emit_lp)
+        return _reduce_arr(nll, reduction)
+
+    return eager_apply("rnnt_loss", fn,
+                       (input, label, input_lengths, label_lengths), {})

@@ -49,29 +49,49 @@ def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ce
 
     out = eager_apply(f"max_pool{nd}d", fn, (x,), {})
     if return_mask:
-        if nd != 2 or channel_last:
-            raise NotImplementedError("return_mask supported for NCHW max_pool2d only")
+        if channel_last:
+            raise NotImplementedError(
+                "return_mask supports channel-first layouts only")
+        if isinstance(padding, str):
+            raise NotImplementedError(
+                "return_mask with string padding is not supported — pass "
+                "explicit pad amounts")
         k = _pair(kernel, nd)
         s = _pair(stride if stride is not None else kernel, nd)
-        p = _pair(padding, nd) if not isinstance(padding, str) else (0, 0)
+        p = _pair(padding, nd)
 
         def mask_fn(a):
-            n, c, h, w = a.shape
+            n, c = a.shape[:2]
+            # pad explicitly with the dtype minimum so argmax can NEVER
+            # select a padded cell (dilated_patches pads with 0, which
+            # outranks all-negative windows)
+            fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            a = jnp.pad(a, [(0, 0), (0, 0)] + [(p[d], p[d])
+                                               for d in range(nd)],
+                        constant_values=fill)
+            spatial = tuple(a.shape[2 + d] - 2 * p[d] for d in range(nd))
             patches = lax.conv_general_dilated_patches(
                 a, filter_shape=k, window_strides=s,
-                padding=[(p[0], p[0]), (p[1], p[1])],
-                precision=None)  # [N, C*kh*kw, oh, ow]
-            oh, ow = patches.shape[2], patches.shape[3]
-            patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
-            local = jnp.argmax(patches, axis=2)  # window-local flat idx
-            lr, lc = local // k[1], local % k[1]
-            oi = jnp.arange(oh).reshape(1, 1, oh, 1)
-            oj = jnp.arange(ow).reshape(1, 1, 1, ow)
-            gr = oi * s[0] - p[0] + lr
-            gc = oj * s[1] - p[1] + lc
-            return (gr * w + gc).astype(jnp.int32)
+                padding=[(0, 0)] * nd,
+                precision=None)          # [N, C*prod(k), *out_spatial]
+            out_sp = patches.shape[2:]
+            ksz = 1
+            for v in k:
+                ksz *= v
+            patches = patches.reshape((n, c, ksz) + out_sp)
+            local = jnp.argmax(patches, axis=2)   # window-local flat idx
+            locals_nd = jnp.unravel_index(local, k)
+            flat = jnp.zeros_like(local)
+            for d in range(nd):
+                shape = [1] * (2 + nd)
+                shape[2 + d] = out_sp[d]
+                oi = jnp.arange(out_sp[d]).reshape(shape)
+                g = oi * s[d] - p[d] + locals_nd[d]
+                flat = flat * spatial[d] + g
+            return flat.astype(jnp.int32)
 
-        mask = eager_apply("max_pool2d_mask", mask_fn, (x,), {})
+        mask = eager_apply(f"max_pool{nd}d_mask", mask_fn, (x,), {})
         return out, mask
     return out
 
@@ -208,22 +228,167 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     mask produced."""
     if data_format != "NCHW":
         raise NotImplementedError("max_unpool2d supports NCHW")
-    k = _pair(kernel_size, 2)
-    s = _pair(stride if stride is not None else kernel_size, 2)
-    p = _pair(padding, 2)
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 2, "max_unpool2d")
+
+
+def _fractional_indices(in_size, out_size, pool, u):
+    """Start/end index sequences (pooling.h FractionalStartIndex/EndIndex +
+    FractionalRationalU; python doc nn/functional/pooling.py:2087)."""
+    import math as _m
+    if pool > 0:
+        alpha = (in_size - pool) / (out_size - 1)
+        u_eff = u
+    else:
+        alpha = in_size / out_size
+        base = in_size // out_size
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_size + 1 - base) / alpha - (out_size - 1)
+        u_eff = u * min(u_max1, u_max2)
+    off = int(u_eff * alpha)
+    starts, ends = [], []
+    for i in range(out_size):
+        st = int((i + u_eff) * alpha) - off
+        en = st + pool if pool > 0 else int((i + 1 + u_eff) * alpha) - off
+        starts.append(st)
+        ends.append(min(en, in_size))
+    return starts, ends
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, return_mask,
+                     nd, op_name):
+    from ...core import random as _rng
+    import jax as _jax
+
+    if random_u is None:
+        u = float(_jax.random.uniform(_rng.next_key(), ()))
+    else:
+        u = float(random_u)
+        if not 0 < u < 1:
+            raise ValueError("random_u must be in (0, 1)")
+    out_sizes = _pair(output_size, nd)
+    pools = _pair(kernel_size, nd) if kernel_size is not None else (0,) * nd
+
+    def fn(a):
+        spatial = a.shape[2:]
+        # per-dim static index grids: starts[i] + arange(max window), with
+        # an in-window validity mask — ONE gather per dim instead of one
+        # slice per output cell, so the HLO stays O(nd) regardless of
+        # output_size
+        idx_grids, masks = [], []
+        for d in range(nd):
+            starts, ends = _fractional_indices(
+                spatial[d], out_sizes[d], pools[d], u)
+            wmax = max(e - s_ for s_, e in zip(starts, ends))
+            base = np.asarray(starts)[:, None] + np.arange(wmax)[None, :]
+            valid = base < np.asarray(ends)[:, None]
+            idx_grids.append(jnp.asarray(np.clip(base, 0, spatial[d] - 1)))
+            masks.append(jnp.asarray(valid))
+        # gather successively along each spatial dim
+        g = a
+        for d in range(nd):
+            g = jnp.take(g, idx_grids[d].reshape(-1), axis=2 + 2 * d)
+            g = g.reshape(g.shape[:2 + 2 * d]
+                          + idx_grids[d].shape + g.shape[3 + 2 * d:])
+        # g: [N, C, o0, w0, o1, w1, ...]; build the joint validity mask
+        m = jnp.ones((), bool)
+        for d in range(nd):
+            shape = [1, 1]
+            for dd in range(nd):
+                shape += ([out_sizes[dd], masks[dd].shape[1]]
+                          if dd == d else [1, 1])
+            m = m & masks[d].reshape(shape)
+        fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+            else jnp.iinfo(a.dtype).min
+        gm = jnp.where(m, g, fill)
+        # flatten the window axes (every odd spatial axis) and reduce
+        perm = [0, 1] + [2 + 2 * d for d in range(nd)] \
+            + [3 + 2 * d for d in range(nd)]
+        gm = gm.transpose(perm)
+        lead = gm.shape[:2 + nd]
+        flat = gm.reshape(lead + (-1,))
+        out = flat.max(-1)
+        if not return_mask:
+            return out
+        am = flat.argmax(-1)                      # joint window-local idx
+        wsizes = [idx_grids[d].shape[1] for d in range(nd)]
+        locals_nd = jnp.unravel_index(am, wsizes)
+        glob = jnp.zeros_like(am)
+        for d in range(nd):
+            # recover the absolute input coordinate from the index grid
+            coord = jnp.take(
+                idx_grids[d].reshape(-1),
+                jnp.arange(out_sizes[d]).reshape(
+                    [1, 1] + [out_sizes[dd] if dd == d else 1
+                              for dd in range(nd)]) * wsizes[d]
+                + locals_nd[d])
+            glob = glob * spatial[d] + coord
+        return out, glob.astype(jnp.int32)
+
+    return eager_apply(op_name, fn, (x,), {})
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference: nn/functional/pooling.py:2087;
+    kernel funcs/pooling.cc:1890 FractionalMaxPool2dFunctor)."""
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """3-D fractional max pooling (pooling.cc:2040)."""
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 3, "fractional_max_pool3d")
+
+
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, output_size,
+                   nd, op_name):
+    k = _pair(kernel_size, nd)
+    s = _pair(stride if stride is not None else kernel_size, nd)
+    p = _pair(padding, nd)
 
     def fn(a, idx):
-        n, c, oh, ow = a.shape
+        n, c = a.shape[:2]
+        o_sp = a.shape[2:]
         if output_size is not None:
-            H, W = int(output_size[-2]), int(output_size[-1])
+            full = tuple(int(v) for v in output_size[-nd:])
         else:
-            H = (oh - 1) * s[0] - 2 * p[0] + k[0]
-            W = (ow - 1) * s[1] - 2 * p[1] + k[1]
-        flat_vals = a.reshape(n * c, oh * ow)
-        flat_idx = idx.reshape(n * c, oh * ow).astype(jnp.int32)
-        out = jnp.zeros((n * c, H * W), a.dtype)
+            full = tuple((o_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                         for d in range(nd))
+        numel_o = 1
+        for v in o_sp:
+            numel_o *= v
+        numel_f = 1
+        for v in full:
+            numel_f *= v
+        flat_vals = a.reshape(n * c, numel_o)
+        flat_idx = idx.reshape(n * c, numel_o).astype(jnp.int32)
+        out = jnp.zeros((n * c, numel_f), a.dtype)
         rows = jnp.arange(n * c)[:, None]
         out = out.at[rows, flat_idx].set(flat_vals)
-        return out.reshape(n, c, H, W)
+        return out.reshape((n, c) + full)
 
-    return eager_apply("max_unpool2d", fn, (x, indices), {})
+    return eager_apply(op_name, fn, (x, indices), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d(return_mask=True) (reference: pooling.py:750,
+    unpool kernel unpool_kernel.cc)."""
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d supports NCL")
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 1, "max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Inverse of max_pool3d(return_mask=True) (reference: pooling.py:1005,
+    unpool3d kernel)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d supports NCDHW")
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 3, "max_unpool3d")
+

@@ -165,7 +165,8 @@ class PTQ(QAT):
 
 __all__ = ["fake_quantize", "AbsmaxObserver", "EMAObserver",
            "FakeQuanterWithAbsMax", "QuantConfig", "QuantedLayer", "QAT",
-           "PTQ"]
+           "PTQ", "quantize_to_int8", "quantize_to_int4", "unpack_int4",
+           "Int8Linear", "Int4Linear", "quantize_for_inference"]
 
 
 def quantize_to_int8(w, axis=0):
@@ -177,6 +178,65 @@ def quantize_to_int8(w, axis=0):
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(arr / scale), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
+
+
+def quantize_to_int4(w, axis=0):
+    """Symmetric per-channel int4 quantization with nibble PACKING: two
+    4-bit values per int8 byte (reference: weight_only_linear int4 packing,
+    phi/kernels/gpu/weight_only_linear_kernel.cu + weight_quantize int4
+    path). Returns (packed [ceil(rows/2), cols] int8, scale)."""
+    import jax.numpy as jnp
+    arr = w._data if hasattr(w, "_data") else jnp.asarray(w)
+    reduce_axes = tuple(i for i in range(arr.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(arr), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(arr / scale), -7, 7).astype(jnp.int8)
+    if q.shape[0] % 2:
+        q = jnp.concatenate([q, jnp.zeros((1,) + q.shape[1:], jnp.int8)], 0)
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def unpack_int4(packed, rows):
+    """Unpack nibble-packed int4 back to int8 values in [-7, 7]."""
+    import jax.numpy as jnp
+    lo = (packed & 0x0F).astype(jnp.int8)
+    hi = ((packed.astype(jnp.uint8) >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend the nibbles
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    full = jnp.stack([lo, hi], 1).reshape((-1,) + packed.shape[1:])
+    return full[:rows]
+
+
+class Int4Linear(Layer):
+    """Weight-only int4 inference Linear: packed nibbles live in HBM at
+    1/8 the fp32 bandwidth and unpack+dequantize fuses into the matmul's
+    prologue under XLA (the weight_only_linear(weight_dtype='int4')
+    capability)."""
+
+    def __init__(self, linear):
+        super().__init__()
+        self.rows = linear.weight.shape[0]
+        self.w_packed, self.w_scale = quantize_to_int4(linear.weight,
+                                                       axis=1)
+        self.bias = linear.bias
+
+    def forward(self, x):
+        from ..core.dispatch import eager_apply
+
+        packed, w_s, rows = self.w_packed, self.w_scale, self.rows
+
+        def fn(x):
+            w = unpack_int4(packed, rows).astype(x.dtype) \
+                * w_s.astype(x.dtype)
+            return x @ w
+
+        out = eager_apply("int4_linear_weight_only", fn, (x,), {})
+        if self.bias is not None:
+            out = out + self.bias
+        return out
 
 
 class Int8Linear(Layer):
@@ -230,8 +290,9 @@ class Int8Linear(Layer):
 
 
 def quantize_for_inference(model, mode="weight_only", inplace=False):
-    """Swap every Linear for an Int8Linear — the int8 serving path
-    (reference: inference-time quantization passes)."""
+    """Swap every Linear for an Int8Linear (or Int4Linear with
+    mode="weight_only_int4") — the low-bit serving path (reference:
+    inference-time quantization passes)."""
     from ..nn.layer.common import Linear
     if not inplace:
         import copy
@@ -242,7 +303,10 @@ def quantize_for_inference(model, mode="weight_only", inplace=False):
             if sub is None:
                 continue
             if isinstance(sub, Linear):
-                layer._sub_layers[name] = Int8Linear(sub, mode=mode)
+                if mode == "weight_only_int4":
+                    layer._sub_layers[name] = Int4Linear(sub)
+                else:
+                    layer._sub_layers[name] = Int8Linear(sub, mode=mode)
             else:
                 walk(sub)
 

@@ -124,8 +124,8 @@ put("bce_loss kldiv_loss log_loss hinge_loss identity_loss "
     "nn/functional/loss.py (binary_cross_entropy[_with_logits], kl_div, "
     "softmax_with_cross_entropy; log/hinge via square_error_cost family)")
 put("warpctc warprnnt", "as",
-    "nn/functional/loss.py ctc_loss (lax.scan forward algorithm); rnnt "
-    "loss todo")
+    "nn/functional/loss.py ctc_loss + rnnt_loss (lax.scan forward "
+    "algorithms with FastEmit; numpy-DP-oracle tests)")
 put("flash_attn flash_attn_qkvpacked "
     "flash_attn_varlen_qkvpacked flashmask_attention "
     "memory_efficient_attention sparse_attention calc_reduced_attn_scores",
@@ -145,8 +145,9 @@ put("bicubic_interp bilinear_interp linear_interp nearest_interp "
     "trilinear_interp", "as", "F.interpolate(mode=...)")
 put("pool2d pool3d max_pool2d_with_index max_pool3d_with_index "
     "fractional_max_pool2d fractional_max_pool3d unpool unpool3d", "as",
-    "nn/functional/pooling.py (avg/max/adaptive; return_mask variant; "
-    "max_unpool2d scatter inverse); fractional + 3-D unpool todo")
+    "nn/functional/pooling.py (avg/max/adaptive + return_mask in 1/2/3-D; "
+    "max_unpool1d/2d/3d scatter inverses; fractional_max_pool2d/3d with "
+    "the kernel's exact index sequences)")
 put("depthwise_conv2d depthwise_conv2d_transpose", "as",
     "F.conv2d(groups=in_channels) - XLA lowers grouped conv to the "
     "depthwise path")
@@ -188,7 +189,8 @@ put("pad3d", "as", "F.pad (NDHWC/NCDHW via data_format)")
 put("viterbi_decode", "as",
     "paddle_tpu.text.viterbi_decode / ViterbiDecoder")
 put("weight_dequantize weight_only_linear weight_quantize", "as",
-    "incubate.nn.functional weight_quantize/weight_only_linear")
+    "incubate.nn.functional weight_quantize/weight_only_linear; int8 + "
+    "nibble-packed int4 tiers (quantization.Int4Linear)")
 put("add_n", "as", "paddle.add_n / chained paddle.add")
 
 
