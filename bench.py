@@ -41,14 +41,31 @@ def peak_flops(dev) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def run_bench():
+def run_bench(config="llama_125m"):
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu", "gpu")
-    if on_tpu:
+    if config == "llama_1b" and on_tpu:
+        # ~1B-param config (TinyLlama-1.1B shape) with remat + bf16: the
+        # arithmetic-intensity regime of the 13B north star, sized to one
+        # v5e chip (fp32 AdamW states ~13 GB; activations remat'd).
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5632, num_hidden_layers=22,
+                          num_attention_heads=32, num_key_value_heads=4,
+                          max_position_embeddings=2048,
+                          loss_chunk_size=2048, remat=True)
+        batch, seq, iters, reps = 1, 2048, 4, 2
+    elif config == "llama_1b":
+        # CPU CI stand-in: same code path (remat + chunked CE), tiny shape
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                          intermediate_size=256, num_hidden_layers=3,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          loss_chunk_size=128, remat=True)
+        batch, seq, iters, reps = 1, 128, 2, 1
+    elif on_tpu:
         # Profiled breakdown (round 2, xplane on the pool chip): the step is
         # near this part's practical ceiling — a pure 4096^3 bf16 matmul
         # measures ~46 TF/s (23% of the 197 TF/s nominal peak used as the
@@ -105,7 +122,7 @@ def run_bench():
     flops_tok = model.flops_per_token(seq)
     mfu = tok_s * flops_tok / peak_flops(dev)
     return {
-        "metric": "llama_125m_train_tokens_per_sec_per_chip",
+        "metric": f"{config}_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -124,8 +141,9 @@ _SENTINEL = "BENCH_RESULT_JSON:"
 
 
 def _child_main():
+    cfg = "llama_1b" if "--config=llama_1b" in sys.argv else "llama_125m"
     try:
-        result = run_bench()
+        result = run_bench(cfg)
         print(_SENTINEL + json.dumps(result))
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — reported via sentinel line
@@ -152,6 +170,9 @@ def main():
             if line.startswith(_SENTINEL):
                 payload = json.loads(line[len(_SENTINEL):])
                 if "error" not in payload:
+                    # opportunistic second config: the >=1B-param point
+                    # (remat + bf16) the round-2 verdict asked for
+                    payload["llama_1b"] = _run_1b_config()
                     print(json.dumps(payload))
                     return
                 last_err = payload["error"]
@@ -168,6 +189,22 @@ def main():
         "vs_baseline": 0.0,
         "error": last_err,
     }))
+
+
+def _run_1b_config():
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_1B_BUDGET", "420"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child",
+             "--config=llama_1b"],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {budget}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith(_SENTINEL):
+            return json.loads(line[len(_SENTINEL):])
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    return {"error": tail[-1] if tail else f"child rc={proc.returncode}"}
 
 
 if __name__ == "__main__":

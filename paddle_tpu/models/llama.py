@@ -38,6 +38,10 @@ class LlamaConfig:
     # >0: train-time loss uses the chunked fused matmul+CE head (full
     # [tokens, vocab] logits never materialized; forward returns (None, loss))
     loss_chunk_size: int = 0
+    # recompute each decoder layer's activations in backward (the 1B+
+    # single-chip memory recipe: trade ~1/3 more FLOPs for O(layers) fewer
+    # live activations)
+    remat: bool = False
 
     @property
     def head_dim(self):
@@ -160,8 +164,13 @@ class LlamaModel(nn.Layer):
         # Build the RoPE cos/sin tables once and share across all layers.
         pos = position_ids if position_ids is not None else input_ids.shape[1]
         rope_cs = F.rope_tables(pos, self.config.head_dim, self.config.rope_theta)
-        for layer in self.layers:
-            h = layer(h, position_ids, attn_mask, rope_cs)
+        if self.config.remat:
+            from ..distributed.fleet.recompute import recompute
+            for layer in self.layers:
+                h = recompute(layer, h, position_ids, attn_mask, rope_cs)
+        else:
+            for layer in self.layers:
+                h = layer(h, position_ids, attn_mask, rope_cs)
         return self.norm(h)
 
 
