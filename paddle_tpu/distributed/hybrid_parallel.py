@@ -23,11 +23,22 @@ Shape of the rebuild — ONE jitted program over a dp x mp x pp mesh using
 - Embedding/head (or any heterogeneous prologue/epilogue layers) run
   OUTSIDE the pipelined region as ordinary GSPMD ops.
 
-Constraints (v1, documented): the pipelined blocks must be architecturally
-uniform (same parameter structure — true of the transformer stacks 3-D
-parallelism targets, and the same assumption the reference's LayerDesc
-lists make in practice), map one activation tensor to one activation
-tensor, and be deterministic (no dropout inside the pipelined region).
+Constraints and capabilities:
+- Blocks must be architecturally uniform (same parameter structure —
+  true of the transformer stacks 3-D parallelism targets, and the same
+  assumption the reference's LayerDesc lists make in practice).
+- Blocks may map a TUPLE of activations to a same-structure tuple
+  (multi-tensor stage boundaries — pp_layers.py multi-output stages);
+  the pipeline buffers/permutes pytrees.
+- Dropout (any RNG op) inside the pipelined region is supported on the
+  circular schedules: pass ``rng_key`` to the step; each (microbatch,
+  stage-application) derives its own fold — the reference's RNG tracker
+  role (meta_parallel get_rng_state_tracker).
+- Tied embeddings: ``loss_takes_params=True`` hands loss_fn the full
+  param tree, so a head can reuse ``params['embed']`` and gradients
+  accumulate from both uses (pp_layers.py:258 shared_weight semantics).
+- The EXPLICIT-schedule path (zbh1/zbv/interleaved) keeps the v1
+  single-tensor deterministic constraints.
 """
 from __future__ import annotations
 
@@ -50,22 +61,33 @@ def _layer_state(layer):
 def functionalize(layer, n_inputs=1):
     """(arrays, apply_fn): pure apply over the layer's extracted params.
 
-    apply_fn(arrays, *inputs) runs the layer's real forward with ``arrays``
-    installed — the TrainStep functionalization (jit/__init__.py) reused at
-    layer granularity.
+    apply_fn(arrs, *inputs, rng=None) runs the layer's real forward with
+    ``arrays`` installed — the TrainStep functionalization
+    (jit/__init__.py) reused at layer granularity. ``rng`` seeds the
+    layer's stateful random ops (dropout) for that application; inputs
+    and outputs may be pytrees (tuples of arrays).
     """
+    import contextlib
+
+    from ..core import random as _rng
     from ..jit import _Installed
 
     tensors = _layer_state(layer)
     arrays = {k: t._data for k, t in tensors.items()}
 
-    def apply_fn(arrs, *inputs):
+    def apply_fn(arrs, *inputs, rng=None):
         inst = _Installed(tensors)
-        with inst:
+        ctx = _rng.capture_rng(rng) if rng is not None \
+            else contextlib.nullcontext()
+        with inst, ctx:
             inst.install(arrs)
-            out = layer(*[Tensor(x) if not isinstance(x, Tensor) else x
-                          for x in inputs])
-        return out._data if isinstance(out, Tensor) else out
+            out = layer(*jax.tree.map(
+                lambda x: Tensor(x) if not isinstance(x, Tensor) else x,
+                tuple(inputs), is_leaf=lambda x: not isinstance(
+                    x, (tuple, list))))
+        return jax.tree.map(
+            lambda o: o._data if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
 
     return arrays, apply_fn
 
@@ -88,7 +110,7 @@ def stack_block_params(blocks):
 
 def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
                       n_micro=4, schedule="1f1b", pp_axis="pp",
-                      dp_axis="dp", vpp=1):
+                      dp_axis="dp", vpp=1, loss_takes_params=False):
     """Build the single-program 3-D step for an arbitrary uniform-block model.
 
     blocks: list of nn.Layer, each mapping [mb, ...] -> [mb, ...] (built
@@ -150,11 +172,15 @@ def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
     if head is not None:
         params["head"], head_apply = functionalize(head)
 
-    def stage_fn(stage_arrays, x):
-        # stage_arrays leaves: [lps, ...] (stage/chunk axes consumed)
+    def stage_fn(stage_arrays, x, rng=None):
+        # stage_arrays leaves: [lps, ...] (stage/chunk axes consumed);
+        # x may be one array or a tuple of arrays (multi-tensor boundary)
         for i in range(lps):
-            x = block_apply(jax.tree.map(lambda l, i=i: l[i], stage_arrays),
-                            x)
+            args = x if isinstance(x, tuple) else (x,)
+            sub = None if rng is None else jax.random.fold_in(rng, i)
+            x = block_apply(
+                jax.tree.map(lambda l, i=i: l[i], stage_arrays),
+                *args, rng=sub)
         return x
 
     if explicit:
@@ -188,26 +214,35 @@ def build_hybrid_step(blocks, loss_fn, mesh, embed=None, head=None,
         lambda l: l.reshape((pp, lps) + l.shape[1:]), stacked)
     block_specs = jax.tree.map(lambda _: P(pp_axis), params["blocks"])
 
-    def pipeline(stage_params, xm):
-        fn = jax.checkpoint(stage_fn) if schedule == "1f1b" else stage_fn
+    def pipeline(stage_params, xm, rng_key):
+        base = jax.checkpoint(stage_fn) if schedule == "1f1b" else stage_fn
         body = functools.partial(
-            _interleaved_body, fn=fn, axis_name=pp_axis,
-            n_micro=xm.shape[0], n_stages=pp, vpp=1)
-        x_spec = P(*([None] * xm.ndim))  # dp stays an auto (GSPMD) axis
+            _interleaved_body, fn=base, axis_name=pp_axis,
+            n_micro=jax.tree.leaves(xm)[0].shape[0], n_stages=pp, vpp=1,
+            rng_key=rng_key)
+        x_spec = jax.tree.map(lambda l: P(*([None] * l.ndim)), xm)
         mapped = shard_map(body, mesh=jmesh,
                            in_specs=(block_specs, x_spec), out_specs=x_spec,
                            axis_names={pp_axis}, check_vma=False)
         return mapped(stage_params, xm)
 
-    def step_fn(params, x, labels):
+    def step_fn(params, x, labels, rng_key=None):
         def loss(params):
             h = embed_apply(params["embed"], x) if embed_apply else x
-            mb = h.shape[0] // n_micro
-            xm = h.reshape((n_micro, mb) + h.shape[1:])
-            ym = pipeline(params["blocks"], xm)
-            y = ym.reshape((h.shape[0],) + ym.shape[2:])
+            # h may be a tuple tree (multi-tensor stage boundary)
+            def to_micro(l):
+                mb = l.shape[0] // n_micro
+                return l.reshape((n_micro, mb) + l.shape[1:])
+            xm = jax.tree.map(to_micro, h)
+            ym = pipeline(params["blocks"], xm, rng_key)
+            y = jax.tree.map(
+                lambda l: l.reshape((l.shape[0] * l.shape[1],)
+                                    + l.shape[2:]), ym)
             if head_apply:
-                y = head_apply(params["head"], y)
+                args = y if isinstance(y, tuple) else (y,)
+                y = head_apply(params["head"], *args)
+            if loss_takes_params:
+                return loss_fn(params, y, labels)
             return loss_fn(y, labels)
 
         return jax.value_and_grad(loss)(params)
