@@ -1,0 +1,47 @@
+"""VLOG-style tiered framework logging (reference: glog VLOG(n) used
+throughout paddle C++; controlled by FLAGS_v / GLOG_v).
+
+``vlog(level, msg)`` emits when ``FLAGS_v >= level`` (set via
+``paddle.set_flags({'FLAGS_v': 3})`` or the ``GLOG_v`` env var, both
+reference spellings). Output routes through the standard ``logging``
+module under the ``paddle_tpu`` logger hierarchy so deployments can
+redirect it; levels map 1->INFO, 2..3->DEBUG, 4+->DEBUG with the level
+tag preserved in the message.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .flags import GLOBAL_FLAGS, define_flag
+
+define_flag("v", int, int(os.environ.get("GLOG_v", "0")),
+            "VLOG verbosity: emit vlog(n, ...) records with n <= FLAGS_v")
+
+_logger = logging.getLogger("paddle_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler()
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s [%(name)s] %(message)s", "%H:%M:%S"))
+    _logger.addHandler(h)
+    _logger.setLevel(logging.DEBUG)
+    _logger.propagate = True   # let pytest caplog and root handlers observe
+
+
+def vlog_is_on(level: int) -> bool:
+    try:
+        return int(GLOBAL_FLAGS.get("v")) >= level
+    except KeyError:
+        return False
+
+
+def vlog(level: int, msg: str, *args, component: str = "core"):
+    """Emit ``msg % args`` when FLAGS_v >= level (glog VLOG semantics)."""
+    if not vlog_is_on(level):
+        return
+    logger = _logger.getChild(component)
+    py_level = logging.INFO if level <= 1 else logging.DEBUG
+    logger.log(py_level, f"V{level} " + (msg % args if args else msg))
+
+
+__all__ = ["vlog", "vlog_is_on"]

@@ -159,3 +159,32 @@ __all__ = ["set_device", "get_device", "device_count", "synchronize",
            "is_compiled_with_tpu", "is_compiled_with_cuda",
            "is_compiled_with_xpu", "get_all_device_type",
            "get_available_device"]
+
+
+def memory_stats(device=None):
+    """Per-device memory statistics (reference: paddle/phi/core/memory/
+    stats.h DEVICE_MEMORY_STAT_* counters; python device.cuda.memory_*).
+
+    Returns a dict with ``bytes_in_use``/``peak_bytes_in_use``/
+    ``bytes_limit`` (whatever the PJRT backend exposes), or None when the
+    backend publishes no stats (XLA-CPU, and some pool configurations).
+    """
+    import jax
+    devs = jax.devices()
+    d = devs[device if isinstance(device, int) else 0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    return dict(stats) if stats else None
+
+
+def max_memory_allocated(device=None):
+    """Peak bytes in use (reference: device/cuda.max_memory_allocated)."""
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_in_use", 0)) if s else 0
+
+
+def memory_allocated(device=None):
+    s = memory_stats(device)
+    return int(s.get("bytes_in_use", 0)) if s else 0

@@ -12,9 +12,28 @@ from ..jit import save_load as _sl
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
+    """Reference: python/paddle/static/io.py save_inference_model.
+
+    On this stack the "inference program" is the jit artifact: pass the
+    model (a Layer or callable) as ``fetch_vars`` and its input specs as
+    ``feed_vars`` — the call produces the same StableHLO artifact as
+    ``paddle_tpu.jit.save``. Program/Variable graphs do not exist here,
+    so passing raw fetch tensors raises with that guidance.
+    """
+    from .. import jit as _jit
+    from ..nn import Layer
+
+    target = fetch_vars
+    if isinstance(target, (list, tuple)) and len(target) == 1:
+        target = target[0]
+    if isinstance(target, Layer) or (callable(target)
+                                     and not isinstance(target, type)):
+        specs = list(feed_vars) if isinstance(feed_vars, (list, tuple))             else [feed_vars]
+        return _jit.save(target, path_prefix, input_spec=specs)
     raise NotImplementedError(
-        "program-based save is not part of the TPU stack; use "
-        "paddle_tpu.jit.save(layer, path, input_spec=[...]) — same artifact")
+        "program-based save is not part of the TPU stack; pass the model "
+        "as fetch_vars (save_inference_model(path, [InputSpec(...)], "
+        "model)) or use paddle_tpu.jit.save — same artifact")
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

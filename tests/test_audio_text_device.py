@@ -85,3 +85,33 @@ def test_viterbi_respects_lengths():
     assert list(full.numpy()[1][:3]) == [0, 1, 0]  # within true length
     # frozen tail repeats the final tag instead of chasing padding
     assert all(t == full.numpy()[1][2] for t in full.numpy()[1][3:])
+
+
+def test_vlog_tiering(capsys, caplog):
+    import logging
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.vlog import vlog, vlog_is_on
+
+    paddle.set_flags({"FLAGS_v": 0})
+    assert not vlog_is_on(1)
+    with caplog.at_level(logging.DEBUG, logger="paddle_tpu"):
+        vlog(1, "hidden %d", 1)
+        assert not caplog.records
+        paddle.set_flags({"FLAGS_v": 3})
+        assert vlog_is_on(3) and not vlog_is_on(4)
+        vlog(3, "visible %s", "msg", component="collective")
+        assert any("V3 visible msg" in r.message for r in caplog.records)
+        assert any(r.name == "paddle_tpu.collective"
+                   for r in caplog.records)
+    paddle.set_flags({"FLAGS_v": 0})
+
+
+def test_device_memory_stats_surface():
+    import paddle_tpu.device as D
+
+    stats = D.memory_stats()
+    # CPU backend publishes no stats -> None; a real chip returns a dict
+    assert stats is None or "bytes_in_use" in stats
+    assert isinstance(D.memory_allocated(), int)
+    assert isinstance(D.max_memory_allocated(), int)

@@ -93,3 +93,23 @@ def test_jit_save_two_dynamic_inputs(tmp_path):
     b = paddle.to_tensor(np.random.randn(3, 8).astype(np.float32))
     np.testing.assert_allclose(loaded(a, b).numpy(), net(a, b).numpy(),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_static_save_inference_model_maps_to_jit_artifact(tmp_path):
+    """paddle.static.save_inference_model / load_inference_model over the
+    jit StableHLO artifact (reference: static/io.py surface)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import (InputSpec, load_inference_model,
+                                   save_inference_model)
+
+    paddle.seed(0)
+    model = paddle.nn.Sequential(paddle.nn.Linear(4, 3), paddle.nn.Tanh())
+    path = str(tmp_path / "static_model")
+    save_inference_model(path, [InputSpec([None, 4], "float32")], model)
+    loaded = load_inference_model(path)
+    x = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(loaded(paddle.to_tensor(x)).numpy()),
+        np.asarray(model(paddle.to_tensor(x)).numpy()),
+        rtol=1e-5, atol=1e-6)
