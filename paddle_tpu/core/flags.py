@@ -103,6 +103,9 @@ define_flag("benchmark", bool, False, "block on every op for timing")
 define_flag("low_precision_op_list", int, 0, "record ops hit by AMP lists")
 define_flag("tpu_deterministic", bool, False, "prefer deterministic lowerings")
 define_flag("log_level", int, 0, "framework VLOG level")
+import os as _os  # noqa: E402
+define_flag("v", int, int(_os.environ.get("GLOG_v", "0") or 0),
+            "glog-style VLOG verbosity (core/vlog.vlog emits n <= FLAGS_v)")
 define_flag("call_stack_level", int, 1, "error verbosity: 0 message, 1 op context, 2 full python stack (enforce.py)")
 define_flag("allocator_strategy", str, "auto_growth", "host caching-allocator strategy (core/native allocator)")
 define_flag("use_pinned_memory", bool, True, "pin host staging buffers used for device transfers")

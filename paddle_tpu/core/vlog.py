@@ -11,21 +11,25 @@ tag preserved in the message.
 from __future__ import annotations
 
 import logging
-import os
 
-from .flags import GLOBAL_FLAGS, define_flag
-
-define_flag("v", int, int(os.environ.get("GLOG_v", "0")),
-            "VLOG verbosity: emit vlog(n, ...) records with n <= FLAGS_v")
+from .flags import GLOBAL_FLAGS  # the "v" flag is registered in flags.py
 
 _logger = logging.getLogger("paddle_tpu")
-if not _logger.handlers:
-    h = logging.StreamHandler()
-    h.setFormatter(logging.Formatter(
+_logger.setLevel(logging.DEBUG)   # gating is FLAGS_v, not logging levels
+_fallback_handler = None
+
+
+def _ensure_visible():
+    """If the application configured no logging at all, attach ONE
+    fallback stderr handler so vlog output is visible; apps with their
+    own handlers keep full control (no duplicates, no level overrides)."""
+    global _fallback_handler
+    if logging.root.handlers or _logger.handlers:
+        return
+    _fallback_handler = logging.StreamHandler()
+    _fallback_handler.setFormatter(logging.Formatter(
         "%(asctime)s [%(name)s] %(message)s", "%H:%M:%S"))
-    _logger.addHandler(h)
-    _logger.setLevel(logging.DEBUG)
-    _logger.propagate = True   # let pytest caplog and root handlers observe
+    _logger.addHandler(_fallback_handler)
 
 
 def vlog_is_on(level: int) -> bool:
@@ -39,6 +43,7 @@ def vlog(level: int, msg: str, *args, component: str = "core"):
     """Emit ``msg % args`` when FLAGS_v >= level (glog VLOG semantics)."""
     if not vlog_is_on(level):
         return
+    _ensure_visible()
     logger = _logger.getChild(component)
     py_level = logging.INFO if level <= 1 else logging.DEBUG
     logger.log(py_level, f"V{level} " + (msg % args if args else msg))

@@ -171,9 +171,16 @@ def memory_stats(device=None):
     """
     import jax
     devs = jax.devices()
-    d = devs[device if isinstance(device, int) else 0]
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and ":" in device:
+        idx = int(device.rsplit(":", 1)[1])
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            f"device index {idx} out of range ({len(devs)} devices)")
     try:
-        stats = d.memory_stats()
+        stats = devs[idx].memory_stats()
     except Exception:
         return None
     return dict(stats) if stats else None

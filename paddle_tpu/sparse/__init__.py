@@ -74,7 +74,14 @@ class SparseCooTensor(Tensor):
 
     @property
     def values_tensor(self):
-        return Tensor(self._bcoo.data)
+        # ONE stable Tensor identity per sparse tensor: ops attach their
+        # tape-tracked output values here, and for leaves the same object
+        # must be returned every time so gradients ACCUMULATE on it
+        vt = getattr(self, "_values_t", None)
+        if vt is None:
+            vt = Tensor(self._bcoo.data, stop_gradient=self.stop_gradient)
+            self._values_t = vt
+        return vt
 
     def indices(self):
         return self.indices_tensor
@@ -138,10 +145,25 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
     crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
     cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
     vals_np = np.asarray(values.numpy() if isinstance(values, Tensor) else values)
-    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    idx = np.stack([rows, cols_np])
+    shape = tuple(shape)
+    if len(shape) == 3:
+        # batched CSR [B, M, N] (reference layout: crows holds B blocks of
+        # length M+1, cols/values concatenated per block)
+        nb, m = shape[0], shape[1]
+        per = m + 1
+        rows_l, batch_l = [], []
+        for g in range(nb):
+            cr = crows_np[g * per:(g + 1) * per]
+            counts = np.diff(cr)
+            rows_l.append(np.repeat(np.arange(m), counts))
+            batch_l.append(np.full(int(counts.sum()), g))
+        idx = np.stack([np.concatenate(batch_l),
+                        np.concatenate(rows_l), cols_np])
+    else:
+        rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+        idx = np.stack([rows, cols_np])
     bcoo = jsparse.BCOO((jnp.asarray(vals_np), jnp.asarray(idx.T)),
-                        shape=tuple(shape))
+                        shape=shape)
     return SparseCsrTensor(bcoo, jnp.asarray(crows_np), jnp.asarray(cols_np),
                            stop_gradient=stop_gradient)
 
@@ -180,12 +202,16 @@ def add(a, b):
 
 def _unary(name, fn):
     def op(x):
-        if isinstance(x, SparseCooTensor):
-            bcoo = x._bcoo
-        else:
+        if not isinstance(x, SparseCooTensor):
             raise TypeError(f"sparse.{name} expects a sparse tensor")
-        new = jsparse.BCOO((fn(bcoo.data), bcoo.indices), shape=bcoo.shape)
-        return SparseCooTensor(new, stop_gradient=x.stop_gradient)
+        # route through the eager op layer so the TAPE survives chains of
+        # sparse ops (conv -> relu -> conv trains every layer)
+        vals_t = _apply(f"sparse_{name}", fn, x.values_tensor)
+        new = jsparse.BCOO((vals_t._data, x._bcoo.indices),
+                           shape=x._bcoo.shape)
+        out = SparseCooTensor(new, stop_gradient=vals_t.stop_gradient)
+        out._values_t = vals_t
+        return out
     op.__name__ = name
     return op
 
@@ -240,3 +266,7 @@ pow = sparse_pow
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
            "sparse_csr_tensor", "to_sparse_coo", "matmul", "add", "relu",
            "abs", "sin", "tanh", "sqrt", "square", "neg", "pow", "nn"]
+
+from . import functional  # noqa: E402,F401 — sparse conv/pool/attention
+from . import nn as _nn_mod  # noqa: E402
+_nn_mod.functional = functional

@@ -1,0 +1,283 @@
+"""Sparse conv / pooling / attention functionals (reference:
+python/paddle/sparse/nn/functional/{conv,pooling,transformer}.py over the
+22.5k-LoC CUDA rulebook kernels, paddle/phi/kernels/sparse/).
+
+TPU formulation: the RULEBOOK (which input site feeds which output site
+through which kernel offset) is data-dependent, so it is built on the
+host from the integer coordinates — the same role the reference's
+rulebook kernels play on GPU — while all FLOPs (per-offset gathers,
+values @ W_k matmuls, segment reductions) run in jnp and are
+differentiable w.r.t. values and weights. Coordinates are static per
+call; training pipelines reuse the rulebook across steps when the
+point cloud is fixed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import eager_apply
+
+
+def _triple(v, nd=3):
+    return (v,) * nd if isinstance(v, int) else tuple(v)
+
+
+def _coords_values(x):
+    bcoo = x._bcoo
+    return (np.asarray(bcoo.indices), bcoo.data,
+            tuple(int(s) for s in bcoo.shape))
+
+
+def _make_coo(indices_np, values_t, shape):
+    """Build a SparseCooTensor whose values stay ON the autograd tape:
+    ``values_t`` is the tracked Tensor an op produced."""
+    import jax.experimental.sparse as jsparse
+
+    from . import SparseCooTensor
+    bcoo = jsparse.BCOO((values_t._data, jnp.asarray(indices_np)),
+                        shape=shape)
+    out = SparseCooTensor(bcoo, stop_gradient=values_t.stop_gradient)
+    out._values_t = values_t
+    return out
+
+
+_rulebook_cache: dict = {}
+
+
+def _build_rulebook(coords, shape, kernel, stride, padding, subm):
+    """(out_coords [m, 1+nd], rules, out_shape) — rules[k] =
+    (in_rows, out_rows): input site i feeds output site o through kernel
+    offset k. Cached on the coordinate bytes: training loops over a fixed
+    point cloud build each layer's rulebook once.
+
+    Reference: phi/kernels/sparse/gpu/conv_kernel.cu rulebook
+    construction; submanifold keeps out_coords == in_coords."""
+    key = (coords.tobytes(), coords.shape, tuple(shape),
+           tuple(_triple(kernel, coords.shape[1] - 1)),
+           tuple(_triple(stride, coords.shape[1] - 1)),
+           tuple(_triple(padding, coords.shape[1] - 1)), subm)
+    hit = _rulebook_cache.get(key)
+    if hit is not None:
+        return hit
+    out = _build_rulebook_impl(coords, shape, kernel, stride, padding,
+                               subm)
+    if len(_rulebook_cache) > 64:   # bounded: drop the oldest entry
+        _rulebook_cache.pop(next(iter(_rulebook_cache)))
+    _rulebook_cache[key] = out
+    return out
+
+
+def _build_rulebook_impl(coords, shape, kernel, stride, padding, subm):
+    nd = coords.shape[1] - 1
+    k = _triple(kernel, nd)
+    s = _triple(stride, nd)
+    p = _triple(padding, nd)
+    sp = shape[1:1 + nd]
+    in_map = {tuple(c): i for i, c in enumerate(coords)}
+
+    rules = {}
+    if subm:
+        out_map = in_map
+        out_sp = sp
+        for i, c in enumerate(coords):
+            b = c[0]
+            for ki, off in enumerate(np.ndindex(*k)):
+                oc = tuple(c[1 + d] + (k[d] // 2) - off[d]
+                           for d in range(nd))
+                if any(not (0 <= oc[d] < sp[d]) for d in range(nd)):
+                    continue
+                o = out_map.get((b, *oc))
+                if o is not None:
+                    rules.setdefault(ki, ([], []))
+                    rules[ki][0].append(i)
+                    rules[ki][1].append(o)
+        out_coords = coords
+    else:
+        # ONE pass: output coordinates materialize as rules reference them
+        out_sp = tuple((sp[d] + 2 * p[d] - k[d]) // s[d] + 1
+                       for d in range(nd))
+        out_map = {}
+        out_list = []
+        for i, c in enumerate(coords):
+            b = c[0]
+            for ki, off in enumerate(np.ndindex(*k)):
+                oc = []
+                ok = True
+                for d in range(nd):
+                    num = c[1 + d] + p[d] - off[d]
+                    if num % s[d] or not (
+                            0 <= num // s[d] < out_sp[d]):
+                        ok = False
+                        break
+                    oc.append(num // s[d])
+                if not ok:
+                    continue
+                key = (b, *oc)
+                o = out_map.get(key)
+                if o is None:
+                    o = out_map[key] = len(out_list)
+                    out_list.append(key)
+                rules.setdefault(ki, ([], []))
+                rules[ki][0].append(i)
+                rules[ki][1].append(o)
+        out_coords = np.asarray(out_list, coords.dtype).reshape(
+            -1, 1 + nd)
+    rules = {ki: (np.asarray(a, np.int32), np.asarray(b_, np.int32))
+             for ki, (a, b_) in rules.items()}
+    full_out_shape = (shape[0],) + out_sp + (shape[-1],)
+    return out_coords, rules, full_out_shape
+
+
+def _sparse_conv(x, weight, bias, stride, padding, subm, op_name):
+    """weight: [*kernel, C_in, C_out] (the reference's sparse conv layout).
+
+    out_vals[o] = sum_k vals[rules_k.in] @ W_k  (segment-sum scatter)."""
+    coords, _, shape = _coords_values(x)
+    wshape = tuple(weight.shape)
+    nd = coords.shape[1] - 1
+    kshape = wshape[:nd]
+    cout = wshape[-1]
+    out_coords, rules, out_shape = _build_rulebook(
+        coords, shape, kshape, stride, padding, subm)
+    m = len(out_coords)
+    # pass TENSORS so eager_apply puts values/weight/bias on the tape
+    args = [x.values_tensor, weight] + ([bias] if bias is not None else [])
+
+    def fn(vals, w, *maybe_bias):
+        w_flat = w.reshape((-1,) + w.shape[nd:])    # [prod(k), Cin, Cout]
+        out = jnp.zeros((m, cout), vals.dtype)
+        for ki, (rin, rout) in rules.items():
+            contrib = vals[jnp.asarray(rin)] @ w_flat[ki]
+            out = out + jax.ops.segment_sum(
+                contrib, jnp.asarray(rout), num_segments=m)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out
+
+    out_vals = eager_apply(op_name, fn, tuple(args), {})
+    new_shape = out_shape[:-1] + (cout,)
+    return _make_coo(out_coords, out_vals, new_shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", key=None, name=None):
+    """Sparse 3-D convolution (reference: sparse/nn/functional/conv.py:362,
+    kernel phi/kernels/sparse/gpu/conv_kernel.cu)."""
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv3d: dilation/groups == 1")
+    return _sparse_conv(x, weight, bias, stride, padding, False,
+                        "sparse_conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold sparse conv: output sites == input sites
+    (conv.py:468 — the backbone op of point-cloud networks)."""
+    if dilation not in (1, (1, 1, 1)) or groups != 1:
+        raise NotImplementedError("sparse subm_conv3d: dilation/groups == 1")
+    return _sparse_conv(x, weight, bias, stride, padding, True,
+                        "sparse_subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", key=None, name=None):
+    if dilation not in (1, (1, 1)) or groups != 1:
+        raise NotImplementedError("sparse conv2d: dilation/groups == 1")
+    return _sparse_conv(x, weight, bias, stride, padding, False,
+                        "sparse_conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if dilation not in (1, (1, 1)) or groups != 1:
+        raise NotImplementedError("sparse subm_conv2d: dilation/groups == 1")
+    return _sparse_conv(x, weight, bias, stride, padding, True,
+                        "sparse_subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over active sites (reference:
+    sparse/nn/functional/pooling.py:36, pool_kernel.cu)."""
+    coords, _, shape = _coords_values(x)
+    stride = stride if stride is not None else kernel_size
+    out_coords, rules, out_shape = _build_rulebook(
+        coords, shape, kernel_size, stride, padding, False)
+    m = len(out_coords)
+    values = x.values_tensor
+
+    def fn(vals):
+        out = jnp.full((m,) + vals.shape[1:], -jnp.inf, vals.dtype)
+        for ki, (rin, rout) in rules.items():
+            out = jnp.maximum(out, jax.ops.segment_max(
+                vals[jnp.asarray(rin)], jnp.asarray(rout),
+                num_segments=m))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out_vals = eager_apply("sparse_max_pool3d", fn, (values,), {})
+    return _make_coo(out_coords, out_vals, out_shape)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """CSR-masked attention (reference: sparse/nn/functional/
+    transformer.py attention + sparse_attention kernel): scores are
+    computed ONLY at the mask's stored positions, softmax runs per row
+    over stored entries, and the weighted sum hits only stored columns.
+
+    query/key/value: dense [B, H, M, D]; sparse_mask: SparseCsrTensor
+    [B*H, M, M] (its crows/cols give the layout; values are ignored).
+    Returns dense [B, H, M, D].
+    """
+    if key_padding_mask is not None or attn_mask is not None:
+        raise NotImplementedError(
+            "sparse attention: key_padding_mask/attn_mask are not "
+            "supported — bake them into the CSR layout")
+    crows = np.asarray(sparse_mask.crows().numpy()).reshape(-1)
+    cols = np.asarray(sparse_mask.cols().numpy()).reshape(-1)
+    q = query._data if hasattr(query, "_data") else jnp.asarray(query)
+    b, h, mrows, d = q.shape
+    bh = b * h
+    # per-(bh) CSR blocks laid out back to back
+    n_per = len(crows) // bh
+    rows_np, cols_np, heads_np = [], [], []
+    pos = 0
+    for g in range(bh):
+        cr = crows[g * n_per:(g + 1) * n_per]
+        for r in range(mrows):
+            for _ in range(int(cr[r + 1] - cr[r])):
+                rows_np.append(r)
+                heads_np.append(g)
+        cnt = int(cr[mrows] - cr[0])
+        cols_np.extend(cols[pos:pos + cnt])
+        pos += cnt
+    rows_np = np.asarray(rows_np, np.int32)
+    cols_np = np.asarray(cols_np, np.int32)
+    heads_np = np.asarray(heads_np, np.int32)
+    nnz = len(rows_np)
+    seg = heads_np.astype(np.int64) * mrows + rows_np   # global row id
+
+    def fn(q, k, v):
+        qf = q.reshape(bh, mrows, d)
+        kf = k.reshape(bh, mrows, d)
+        vf = v.reshape(bh, mrows, d)
+        qi = qf[heads_np, rows_np]                      # [nnz, d]
+        kj = kf[heads_np, cols_np]
+        s = (qi * kj).sum(-1) / jnp.sqrt(jnp.asarray(d, q.dtype))
+        seg_j = jnp.asarray(seg)
+        smax = jax.ops.segment_max(s, seg_j, num_segments=bh * mrows)
+        e = jnp.exp(s - smax[seg_j])
+        z = jax.ops.segment_sum(e, seg_j, num_segments=bh * mrows)
+        p = e / z[seg_j]
+        out = jax.ops.segment_sum(p[:, None] * vf[heads_np, cols_np],
+                                  seg_j, num_segments=bh * mrows)
+        return out.reshape(b, h, mrows, d)
+
+    _ = nnz
+    return eager_apply("sparse_attention", fn, (query, key, value), {})
+
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+           "attention"]

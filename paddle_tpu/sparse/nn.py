@@ -120,3 +120,85 @@ class BatchNorm:
 
 
 __all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Linear", "BatchNorm"]
+
+
+class _SparseConvNd:
+    """Sparse conv layer base (reference: sparse/nn/layer/conv.py _Conv3D).
+    Weight layout [*kernel, C_in, C_out]."""
+
+    _subm = False
+    _nd = 3
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        import numpy as np
+
+        from ..core.tensor import Tensor
+        from ..core import random as _rng
+        import jax
+
+        k = (kernel_size,) * self._nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.kernel_size = k
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        fan_in = in_channels * int(np.prod(k))
+        bound = 1.0 / np.sqrt(fan_in)
+        wkey = _rng.next_key()
+        self.weight = Tensor(jax.random.uniform(
+            wkey, k + (in_channels, out_channels),
+            minval=-bound, maxval=bound), stop_gradient=False)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Tensor(jax.random.uniform(
+                _rng.next_key(), (out_channels,),
+                minval=-bound, maxval=bound), stop_gradient=False)
+
+    def parameters(self):
+        return [self.weight] + ([self.bias] if self.bias is not None
+                                else [])
+
+    def __call__(self, x):
+        from . import functional as F
+        fn = {(3, False): F.conv3d, (3, True): F.subm_conv3d,
+              (2, False): F.conv2d, (2, True): F.subm_conv2d}[
+                  (self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups)
+
+
+class Conv3D(_SparseConvNd):
+    _subm, _nd = False, 3
+
+
+class SubmConv3D(_SparseConvNd):
+    _subm, _nd = True, 3
+
+
+class Conv2D(_SparseConvNd):
+    _subm, _nd = False, 2
+
+
+class SubmConv2D(_SparseConvNd):
+    _subm, _nd = True, 2
+
+
+class MaxPool3D:
+    """Sparse max pooling layer (reference: sparse/nn/layer/pooling.py)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def __call__(self, x):
+        from . import functional as F
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+__all__ += ["Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D"]
