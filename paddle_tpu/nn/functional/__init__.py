@@ -28,6 +28,7 @@ from .common import (  # noqa: F401
     channel_shuffle, cosine_similarity, pairwise_distance, unfold, fold,
     bilinear, zeropad2d, pad,
     affine_grid, grid_sample, gather_tree, class_center_sample,
+    temporal_shift,
 )
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, sequence_mask, rope, rope_tables,

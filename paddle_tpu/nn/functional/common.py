@@ -376,3 +376,34 @@ def class_center_sample(label, num_classes, num_samples, group=None,
         return remap.reshape(lbl.shape), sampled.astype(lbl.dtype)
 
     return eager_apply("class_center_sample", fn, (label,), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """Temporal Shift Module (reference: nn/functional/extension.py:247,
+    kernel temporal_shift_kernel.h; TSM, Lin et al. 2018): shift the
+    first C*ratio channels backward one frame, the next C*ratio forward,
+    keep the rest — one roll along T per channel slab."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError("temporal_shift supports NCHW/NHWC")
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        out = jnp.concatenate([
+            pad[:, :seg_num, :c1],          # shift left (from t+1 view)
+            pad[:, 2:seg_num + 2, c1:c2],   # shift right
+            pad[:, 1:seg_num + 1, c2:],     # untouched
+        ], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    from ...core.dispatch import op_call
+    return op_call("temporal_shift", fn, x)

@@ -705,3 +705,51 @@ __all__ = [
     "psroi_pool", "box_clip", "multiclass_nms3", "matrix_nms",
     "generate_proposals", "distribute_fpn_proposals",
 ]
+
+
+# ----------------------------------------------------------- bipartite
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy bipartite matching (legacy detection op bipartite_match;
+    cpu/bipartite_match_kernel.cc BipartiteMatch): repeatedly pick the
+    globally largest unmatched (row, col) distance > 0; with
+    ``match_type='per_prediction'`` additionally argmax-match remaining
+    columns whose best distance exceeds ``dist_threshold``.
+
+    dist_matrix: [N, M] (one instance). Returns
+    (col_to_row_match_indices [1, M] int32, col_to_row_match_dist [1, M]).
+    """
+    d = _np_of(dist_matrix)
+    if d.ndim != 2:
+        raise ValueError("bipartite_match expects a 2-D distance matrix")
+    rows, cols = d.shape
+    match_idx = np.full(cols, -1, np.int32)
+    match_dist = np.zeros(cols, np.float32)
+    pairs = [(d[i, j], i, j) for i in range(rows) for j in range(cols)]
+    pairs.sort(key=lambda t: -t[0])
+    row_used = np.zeros(rows, bool)
+    matched = 0
+    for dist, i, j in pairs:
+        if matched >= rows:
+            break
+        if dist > 0 and match_idx[j] == -1 and not row_used[i]:
+            match_idx[j] = i
+            row_used[i] = True
+            match_dist[j] = dist
+            matched += 1
+    if match_type == "per_prediction":
+        for j in range(cols):
+            if match_idx[j] == -1:
+                i = int(d[:, j].argmax())
+                if d[i, j] >= dist_threshold:
+                    match_idx[j] = i
+                    match_dist[j] = d[i, j]
+    elif match_type != "bipartite":
+        raise ValueError(f"unknown match_type {match_type!r}")
+    from ..core.tensor import Tensor
+    return (Tensor(jnp.asarray(match_idx[None])),
+            Tensor(jnp.asarray(match_dist[None])))
+
+
+__all__.append("bipartite_match")

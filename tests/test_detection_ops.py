@@ -397,3 +397,42 @@ def test_ssdlite_composes():
     out, index, num = model.decode(images)
     assert np.isfinite(np.asarray(out.numpy())).all()
     assert int(np.asarray(num.numpy()).sum()) == out.shape[0]
+
+
+class TestBipartiteAndTemporal:
+    def test_bipartite_match_kernel_semantics(self):
+        # kernel greedy order: largest distance first, rows/cols unique
+        d = np.asarray([[0.9, 0.2, 0.0],
+                        [0.8, 0.7, 0.1]], np.float32)
+        idx, dist = V.bipartite_match(T(d))
+        np.testing.assert_array_equal(np.asarray(idx.numpy())[0],
+                                      [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(dist.numpy())[0],
+                                   [0.9, 0.7, 0.0])
+
+    def test_bipartite_per_prediction(self):
+        d = np.asarray([[0.9, 0.2, 0.6],
+                        [0.8, 0.7, 0.1]], np.float32)
+        idx, dist = V.bipartite_match(T(d), match_type="per_prediction",
+                                      dist_threshold=0.5)
+        # col 2 unmatched by bipartite; argmax row 0 dist .6 >= .5
+        np.testing.assert_array_equal(np.asarray(idx.numpy())[0],
+                                      [0, 1, 0])
+        np.testing.assert_allclose(np.asarray(dist.numpy())[0],
+                                   [0.9, 0.7, 0.6])
+
+    def test_temporal_shift_doc_semantics(self):
+        import paddle_tpu.nn.functional as F
+        nt, c, h, w = 4, 4, 1, 1   # N=2, T=2
+        x = np.arange(nt * c, dtype=np.float32).reshape(nt, c, h, w)
+        out = np.asarray(F.temporal_shift(T(x), seg_num=2,
+                                          shift_ratio=0.25).numpy())
+        v = x.reshape(2, 2, c)
+        # doc semantics (extension.py:276): channel block 0 reads the
+        # PREVIOUS frame (slice1 = pad[:, :T]), block 1 reads the NEXT
+        # frame (slice2 = pad[:, 2:T+2]), the rest is untouched
+        assert out[0, 0, 0, 0] == 0                   # t-1 pad at start
+        assert out[1, 0, 0, 0] == v[0, 0, 0]          # from previous frame
+        assert out[0, 1, 0, 0] == v[0, 1, 1]          # from next frame
+        assert out[1, 1, 0, 0] == 0                   # t+1 pad at end
+        np.testing.assert_array_equal(out[:, 2:], x[:, 2:])  # untouched

@@ -80,8 +80,12 @@ put("box_clip box_coder distribute_fpn_proposals generate_proposals "
 put("deformable_conv", "as",
     "vision.ops.deform_conv2d (bilinear-gather im2col, v1/v2 mask, "
     "differentiable)")
-put("bipartite_match collect_fpn_proposals yolo_box_head yolo_box_post "
-    "yolo_loss correlation affine_channel temporal_shift",
+put("bipartite_match", "as",
+    "vision.ops.bipartite_match (kernel-greedy + per_prediction argmax)")
+put("temporal_shift", "as",
+    "nn.functional.temporal_shift (TSM pad-and-slice, doc-exact)")
+put("collect_fpn_proposals yolo_box_head yolo_box_post "
+    "yolo_loss correlation affine_channel",
     "descoped", DETZOO)
 GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
        "reindex, CSC neighbor sampling (tests/test_geometric.py)")
