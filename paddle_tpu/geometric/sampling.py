@@ -86,3 +86,62 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
 
 
 __all__ = ["sample_neighbors", "weighted_sample_neighbors"]
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling with subgraph reindex (reference:
+    incubate/operators/graph_khop_sampler.py; kernel
+    phi/kernels/cpu/graph_khop_sampler_kernel.cc).
+
+    Layer l samples ``sample_sizes[l]`` neighbors for the frontier (all
+    previously-reached nodes), collecting edges in reindexed local id
+    space: ``sample_index`` lists original node ids in first-appearance
+    order (input nodes first), and each edge (src, dst) indexes into it.
+
+    Returns (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids]).
+    """
+    rown = _np(row).ravel()
+    cp = _np(colptr).ravel()
+    nodes = _np(input_nodes).ravel()
+    eid = _np(sorted_eids).ravel() if sorted_eids is not None else None
+    if return_eids and eid is None:
+        raise ValueError("return_eids=True needs sorted_eids")
+    rng = _host_rng()
+
+    order = {int(n): i for i, n in enumerate(nodes)}
+    sample_index = [int(n) for n in nodes]
+    edge_src, edge_dst, edge_ids = [], [], []
+    frontier = [int(n) for n in nodes]
+    for size in sample_sizes:
+        next_frontier = []
+        for dst in frontier:
+            lo, hi = int(cp[dst]), int(cp[dst + 1])
+            idx = np.arange(lo, hi)
+            if 0 < size < len(idx):
+                idx = rng.choice(idx, size=size, replace=False)
+            for e in idx:
+                src = int(rown[e])
+                if src not in order:
+                    order[src] = len(sample_index)
+                    sample_index.append(src)
+                    next_frontier.append(src)
+                edge_src.append(order[src])
+                edge_dst.append(order[dst])
+                if eid is not None:
+                    edge_ids.append(int(eid[e]))
+        frontier = next_frontier
+    out = (Tensor(jnp.asarray(np.asarray(edge_src, np.int64)
+                              .reshape(-1, 1))),
+           Tensor(jnp.asarray(np.asarray(edge_dst, np.int64)
+                              .reshape(-1, 1))),
+           Tensor(jnp.asarray(np.asarray(sample_index, np.int64))),
+           Tensor(jnp.asarray(np.asarray(
+               [order[int(n)] for n in nodes], np.int64))))
+    if return_eids:
+        return out + (Tensor(jnp.asarray(
+            np.asarray(edge_ids, np.int64).reshape(-1, 1))),)
+    return out
+
+
+__all__.append("graph_khop_sampler")

@@ -221,3 +221,38 @@ def test_gcn_trains():
     assert losses[-1] < 0.1 * losses[0], losses
     pred = np.argmax(np.asarray(model(x).numpy()), -1)
     assert (pred == np.asarray(labels.numpy())).mean() == 1.0
+
+
+class TestKhopSampler:
+    def test_two_hop_structure(self):
+        # graph from the reference docstring
+        row = I([3, 7, 0, 9, 1, 4, 2, 9, 3, 9, 1, 9, 7])
+        colptr = I([0, 2, 4, 5, 6, 7, 9, 11, 11, 13, 13])
+        nodes = I([0, 8, 1, 2])
+        paddle.seed(0)
+        src, dst, sample_index, reindex = G.graph_khop_sampler(
+            row, colptr, nodes, [2, 2])
+        si = np.asarray(sample_index.numpy())
+        # input nodes lead the id space, in order
+        np.testing.assert_array_equal(si[:4], [0, 8, 1, 2])
+        np.testing.assert_array_equal(np.asarray(reindex.numpy()),
+                                      [0, 1, 2, 3])
+        s = np.asarray(src.numpy()).ravel()
+        d = np.asarray(dst.numpy()).ravel()
+        assert len(s) == len(d) > 0
+        # every edge is a REAL edge of the graph under the reindex map
+        rown = np.asarray(row.numpy())
+        cp = np.asarray(colptr.numpy())
+        for a, b in zip(s, d):
+            src_orig, dst_orig = si[a], si[b]
+            neigh = rown[cp[dst_orig]:cp[dst_orig + 1]]
+            assert src_orig in neigh, (src_orig, dst_orig)
+
+    def test_eids(self):
+        row = I([1, 2, 0])
+        colptr = I([0, 2, 3, 3])
+        eids = I([10, 11, 12])
+        src, dst, si, re, ee = G.graph_khop_sampler(
+            row, colptr, I([0]), [2], sorted_eids=eids, return_eids=True)
+        got = sorted(np.asarray(ee.numpy()).ravel().tolist())
+        assert got == [10, 11]

@@ -91,7 +91,9 @@ GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
        "reindex, CSC neighbor sampling (tests/test_geometric.py)")
 put("graph_sample_neighbors reindex_graph send_u_recv "
     "send_ue_recv send_uv weighted_sample_neighbors", "as", GEO)
-put("graph_khop_sampler", "descoped", GRAPHNN)
+put("graph_khop_sampler", "as",
+    "geometric.graph_khop_sampler (multi-hop frontier sampling + "
+    "first-appearance reindex)")
 put("npu_identity", "descoped", XPUDEV)
 put("nms roi_align", "as",
     "paddle_tpu.vision.ops (nms, roi_align w/ sampling_ratio)")
