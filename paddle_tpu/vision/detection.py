@@ -753,3 +753,63 @@ def bipartite_match(dist_matrix, match_type="bipartite",
 
 
 __all__.append("bipartite_match")
+
+
+# --------------------------------------------------------- fpn collect etc.
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-FPN-level proposals and keep the global top-k by score
+    (legacy detection op collect_fpn_proposals; kernel
+    impl/collect_fpn_proposals_kernel_impl.h): concatenate levels, sort
+    by score descending, truncate to ``post_nms_top_n``, and re-sort the
+    kept rois by (image, insertion order).
+
+    multi_rois: list of [ni, 4]; multi_scores: list of [ni, 1] or [ni].
+    Returns (fpn_rois [k, 4], rois_num [N] when per-level counts given).
+    """
+    from ..core.tensor import Tensor
+
+    rois = [_np_of(r).reshape(-1, 4) for r in multi_rois]
+    scores = [_np_of(s).reshape(-1) for s in multi_scores]
+    if rois_num_per_level is not None:
+        img_of = []
+        for lvl_counts in rois_num_per_level:
+            c = _np_of(lvl_counts).ravel()
+            img_of.append(np.repeat(np.arange(len(c)), c))
+        n_imgs = max(len(_np_of(c).ravel()) for c in rois_num_per_level)
+    else:
+        img_of = [np.zeros(len(r), np.int64) for r in rois]
+        n_imgs = 1
+    all_rois = np.concatenate(rois) if rois else np.zeros((0, 4))
+    all_scores = np.concatenate(scores) if scores else np.zeros(0)
+    all_imgs = np.concatenate(img_of)
+    k = min(int(post_nms_top_n), len(all_rois))
+    keep = np.argsort(-all_scores, kind="stable")[:k]
+    # reference orders the final rois by image id (BatchedSort)
+    keep = keep[np.argsort(all_imgs[keep], kind="stable")]
+    out = Tensor(jnp.asarray(all_rois[keep].astype(np.float32)))
+    counts = np.bincount(all_imgs[keep], minlength=n_imgs).astype(np.int32)
+    if rois_num_per_level is not None:
+        return out, Tensor(jnp.asarray(counts))
+    return out
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """Per-channel affine y = x * scale[c] + bias[c] (legacy op
+    affine_channel; cpu/affine_channel_kernel.cc)."""
+    from .core_compat import _apply, param as _param
+
+    axis = 1 if data_layout == "NCHW" else -1
+
+    def f(x, s, b):
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        return x * s.reshape(shape) + b.reshape(shape)
+
+    return _apply("affine_channel", f, _param(x), _param(scale),
+                  _param(bias))
+
+
+__all__ += ["collect_fpn_proposals", "affine_channel"]

@@ -436,3 +436,38 @@ class TestBipartiteAndTemporal:
         assert out[0, 1, 0, 0] == v[0, 1, 1]          # from next frame
         assert out[1, 1, 0, 0] == 0                   # t+1 pad at end
         np.testing.assert_array_equal(out[:, 2:], x[:, 2:])  # untouched
+
+
+class TestFpnCollectAffine:
+    def test_collect_fpn_proposals(self):
+        r1 = np.asarray([[0, 0, 10, 10], [1, 1, 5, 5]], np.float32)
+        r2 = np.asarray([[2, 2, 8, 8]], np.float32)
+        s1 = np.asarray([0.9, 0.1], np.float32)
+        s2 = np.asarray([0.5], np.float32)
+        n1 = np.asarray([1, 1], np.int32)   # image 0 gets r1[0], img 1 r1[1]
+        n2 = np.asarray([0, 1], np.int32)   # image 1 gets r2[0]
+        out, nums = V.collect_fpn_proposals(
+            [T(r1), T(r2)], [T(s1), T(s2)], 2, 3, post_nms_top_n=2,
+            rois_num_per_level=[paddle.to_tensor(n1),
+                                paddle.to_tensor(n2)])
+        o = np.asarray(out.numpy())
+        # top-2 scores: 0.9 (img0) and 0.5 (img1); ordered by image
+        np.testing.assert_allclose(o[0], r1[0])
+        np.testing.assert_allclose(o[1], r2[0])
+        np.testing.assert_array_equal(np.asarray(nums.numpy()), [1, 1])
+
+    def test_affine_channel(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+        s = np.asarray([1.0, 2.0, 3.0], np.float32)
+        b = np.asarray([0.5, -0.5, 0.0], np.float32)
+        out = V.affine_channel(T(x), T(s), T(b))
+        ref = x * s[None, :, None, None] + b[None, :, None, None]
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-6)
+        # NHWC + grad
+        xt = T(np.transpose(x, (0, 2, 3, 1)))
+        xt.stop_gradient = False
+        out = V.affine_channel(xt, T(s), T(b), data_layout="NHWC")
+        out.sum().backward()
+        np.testing.assert_allclose(
+            np.asarray(xt.grad.numpy())[0, 0, 0], s, rtol=1e-6)

@@ -84,8 +84,10 @@ put("bipartite_match", "as",
     "vision.ops.bipartite_match (kernel-greedy + per_prediction argmax)")
 put("temporal_shift", "as",
     "nn.functional.temporal_shift (TSM pad-and-slice, doc-exact)")
-put("collect_fpn_proposals yolo_box_head yolo_box_post "
-    "yolo_loss correlation affine_channel",
+put("collect_fpn_proposals", "as",
+    "vision.ops.collect_fpn_proposals (global top-k + per-image re-sort)")
+put("affine_channel", "as", "vision.ops.affine_channel")
+put("yolo_box_head yolo_box_post yolo_loss correlation",
     "descoped", DETZOO)
 GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
        "reindex, CSC neighbor sampling (tests/test_geometric.py)")
