@@ -813,3 +813,137 @@ def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
 
 
 __all__ += ["collect_fpn_proposals", "affine_channel"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (vision/ops.py:69; cpu/yolo_loss_kernel.cc).
+
+    x: [N, mask_num*(5+cls), H, W] raw head output; gt_box: [N, B, 4]
+    normalized xywh; gt_label: [N, B] int. Returns per-image loss [N].
+    Fully vectorized jnp (differentiable w.r.t. x): anchor assignment and
+    the ignore mask are computed under stop_gradient, exactly following
+    the kernel — SCE on x/y/objectness/class, L1 on w/h, (2 - w*h)*score
+    location weighting, best-IoU> thresh objectness ignore, label smooth
+    min(1/cls, 1/40).
+    """
+    anchors = list(anchors)
+    anchor_mask = list(anchor_mask)
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def sce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    def fn(x, gtb, gtl, gts):
+        n, _, h, w = x.shape
+        b = gtb.shape[1]
+        input_size = downsample_ratio * h
+        t = x.reshape(n, mask_num, 5 + class_num, h, w)
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)          # [N, B]
+
+        # ---- ignore mask: each predicted box's best IoU vs the gts
+        gx = jnp.arange(w, dtype=t.dtype)[None, None, None, :]
+        gy = jnp.arange(h, dtype=t.dtype)[None, None, :, None]
+        aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                         t.dtype)[None, :, None, None]
+        ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                         t.dtype)[None, :, None, None]
+        px = (gx + jax.nn.sigmoid(t[:, :, 0]) * scale + bias) / w
+        py = (gy + jax.nn.sigmoid(t[:, :, 1]) * scale + bias) / h
+        pw = jnp.exp(t[:, :, 2]) * aw / input_size
+        ph = jnp.exp(t[:, :, 3]) * ah / input_size
+
+        def overlap(c1, w1, c2, w2):
+            left = jnp.maximum(c1 - w1 / 2, c2 - w2 / 2)
+            right = jnp.minimum(c1 + w1 / 2, c2 + w2 / 2)
+            return right - left
+
+        # [N, mask, H, W, B] IoU of every pred vs every gt
+        def iou_all(px, py, pw, ph, gtb):
+            # broadcast gt [N, B] over (mask, H, W): [N, 1, 1, 1, B]
+            gx_ = gtb[..., 0][:, None, None, None, :]
+            gy_ = gtb[..., 1][:, None, None, None, :]
+            gw_ = gtb[..., 2][:, None, None, None, :]
+            gh_ = gtb[..., 3][:, None, None, None, :]
+            ow = overlap(px[..., None], pw[..., None], gx_, gw_)
+            oh = overlap(py[..., None], ph[..., None], gy_, gh_)
+            inter = jnp.where((ow > 0) & (oh > 0), ow * oh, 0.0)
+            union = (pw * ph)[..., None] + gw_ * gh_ - inter
+            return inter / jnp.maximum(union, 1e-10)
+
+        iou = iou_all(px, py, pw, ph, gtb)
+        iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+        best_iou = jax.lax.stop_gradient(iou.max(-1))          # [N,m,H,W]
+        ignore = best_iou > ignore_thresh
+
+        # ---- gt -> anchor assignment (stop-grad, pure box-shape IoU)
+        an_w = jnp.asarray(anchors[0::2], t.dtype) / input_size
+        an_h = jnp.asarray(anchors[1::2], t.dtype) / input_size
+        ow = jnp.minimum(an_w[None, None, :], gtb[..., 2:3])
+        oh = jnp.minimum(an_h[None, None, :], gtb[..., 3:4])
+        inter = ow * oh
+        union = an_w * an_h + (gtb[..., 2] * gtb[..., 3])[..., None] - inter
+        best_n = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N,B]
+        mask_pos = jnp.asarray(
+            [[1 if m == a else 0 for a in range(an_num)]
+             for m in anchor_mask])
+        # mask_idx[t] = position of best_n in anchor_mask, else -1
+        mask_idx = jnp.argmax(mask_pos[:, best_n], 0)          # [N? ...]
+        in_mask = mask_pos[:, best_n].max(0) > 0               # [N, B]
+        mask_idx = jnp.where(in_mask, mask_idx, -1)
+        gi = jnp.clip((gtb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gtb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        pos = valid & in_mask                                   # [N, B]
+
+        # gather predictions at assigned cells: [N, B, 5+cls]
+        bi = jnp.arange(n)[:, None]
+        mi = jnp.clip(mask_idx, 0, mask_num - 1)
+        picked = t[bi, mi, :, gj, gi]                           # [N,B,5+c]
+
+        tx = gtb[..., 0] * w - gi
+        ty = gtb[..., 1] * h - gj
+        a_w = jnp.asarray(anchors[0::2], t.dtype)[best_n]
+        a_h = jnp.asarray(anchors[1::2], t.dtype)[best_n]
+        tw = jnp.log(jnp.maximum(gtb[..., 2] * input_size / a_w, 1e-10))
+        th = jnp.log(jnp.maximum(gtb[..., 3] * input_size / a_h, 1e-10))
+        loc_scale = (2.0 - gtb[..., 2] * gtb[..., 3]) * gts
+        loc = (sce(picked[..., 0], tx) + sce(picked[..., 1], ty)
+               + jnp.abs(picked[..., 2] - tw)
+               + jnp.abs(picked[..., 3] - th)) * loc_scale
+        # class loss with label smoothing
+        smooth = min(1.0 / class_num, 1.0 / 40) if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(gtl, class_num, dtype=t.dtype)
+        labels = onehot * (1.0 - smooth) + (1 - onehot) * smooth
+        cls = (sce(picked[..., 5:], labels).sum(-1)) * gts
+        per_gt = jnp.where(pos, loc + cls, 0.0)
+        loss = per_gt.sum(-1)                                   # [N]
+
+        # ---- objectness: obj_mask 0 default, -1 ignored, score at gts
+        obj_mask = jnp.where(ignore, -1.0, 0.0)                 # [N,m,H,W]
+        flat = obj_mask.reshape(n, -1)
+        lin = (mi * h + gj) * w + gi                            # [N, B]
+        flat = flat.at[bi, lin].set(
+            jnp.where(pos, gts, flat[bi, lin]))
+        obj_mask = flat.reshape(n, mask_num, h, w)
+        obj_logit = t[:, :, 4]
+        obj_loss = jnp.where(
+            obj_mask > 1e-5, sce(obj_logit, 1.0) * obj_mask,
+            jnp.where(obj_mask > -0.5, sce(obj_logit, 0.0), 0.0))
+        return loss + obj_loss.sum((1, 2, 3))
+
+    from ..core.tensor import Tensor
+    if gt_score is None:
+        gb = _np_of(gt_box)
+        score_t = Tensor(jnp.ones(gb.shape[:2], jnp.float32))
+    else:
+        score_t = param(gt_score)
+    return _apply("yolo_loss", fn, param(x), param(gt_box),
+                  param(gt_label), score_t)
+
+
+__all__.append("yolo_loss")

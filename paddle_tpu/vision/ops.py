@@ -145,7 +145,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 
 from .detection import (  # noqa: E402,F401 — the detection op zoo
-    affine_channel, bipartite_match, box_clip, box_coder,
+    affine_channel, bipartite_match, box_clip, box_coder, yolo_loss,
     collect_fpn_proposals, deform_conv2d, distribute_fpn_proposals,
     generate_proposals, matrix_nms, multiclass_nms3, prior_box,
     psroi_pool, roi_pool, yolo_box,

@@ -471,3 +471,110 @@ class TestFpnCollectAffine:
         out.sum().backward()
         np.testing.assert_allclose(
             np.asarray(xt.grad.numpy())[0, 0, 0], s, rtol=1e-6)
+
+
+class TestYoloLoss:
+    def _oracle(self, x, gtb, gtl, anchors, anchor_mask, cls, ign, down,
+                smooth=True, scale=1.0):
+        """Transcription of cpu/yolo_loss_kernel.cc."""
+        def sce(v, lab):
+            return max(v, 0) - v * lab + np.log1p(np.exp(-abs(v)))
+
+        def iou(b1, b2):
+            def ov(c1, w1, c2, w2):
+                return min(c1 + w1 / 2, c2 + w2 / 2) - max(
+                    c1 - w1 / 2, c2 - w2 / 2)
+            w_, h_ = ov(b1[0], b1[2], b2[0], b2[2]), ov(
+                b1[1], b1[3], b2[1], b2[3])
+            inter = 0.0 if (w_ < 0 or h_ < 0) else w_ * h_
+            return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+        n, _, h, w = x.shape
+        m = len(anchor_mask)
+        b = gtb.shape[1]
+        input_size = down * h
+        bias = -0.5 * (scale - 1)
+        t = x.reshape(n, m, 5 + cls, h, w)
+        loss = np.zeros(n)
+        obj_mask = np.zeros((n, m, h, w))
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        for i in range(n):
+            for j in range(m):
+                for k in range(h):
+                    for l in range(w):  # noqa: E741
+                        px = (l + sig(t[i, j, 0, k, l]) * scale + bias) / w
+                        py = (k + sig(t[i, j, 1, k, l]) * scale + bias) / h
+                        pw = np.exp(t[i, j, 2, k, l]) * anchors[
+                            2 * anchor_mask[j]] / input_size
+                        ph = np.exp(t[i, j, 3, k, l]) * anchors[
+                            2 * anchor_mask[j] + 1] / input_size
+                        best = 0.0
+                        for tt in range(b):
+                            if gtb[i, tt, 2] <= 0 or gtb[i, tt, 3] <= 0:
+                                continue
+                            best = max(best, iou((px, py, pw, ph),
+                                                 gtb[i, tt]))
+                        if best > ign:
+                            obj_mask[i, j, k, l] = -1
+            for tt in range(b):
+                if gtb[i, tt, 2] <= 0 or gtb[i, tt, 3] <= 0:
+                    continue
+                gt = gtb[i, tt]
+                gi, gj = int(gt[0] * w), int(gt[1] * h)
+                best_iou, best_n = 0.0, 0
+                for a in range(len(anchors) // 2):
+                    an = (0, 0, anchors[2 * a] / input_size,
+                          anchors[2 * a + 1] / input_size)
+                    v = iou(an, (0, 0, gt[2], gt[3]))
+                    if v > best_iou:
+                        best_iou, best_n = v, a
+                if best_n not in anchor_mask:
+                    continue
+                mi = anchor_mask.index(best_n)
+                tx, ty = gt[0] * w - gi, gt[1] * h - gj
+                tw = np.log(gt[2] * input_size / anchors[2 * best_n])
+                th = np.log(gt[3] * input_size / anchors[2 * best_n + 1])
+                sc = 2.0 - gt[2] * gt[3]
+                loss[i] += sce(t[i, mi, 0, gj, gi], tx) * sc
+                loss[i] += sce(t[i, mi, 1, gj, gi], ty) * sc
+                loss[i] += abs(t[i, mi, 2, gj, gi] - tw) * sc
+                loss[i] += abs(t[i, mi, 3, gj, gi] - th) * sc
+                obj_mask[i, mi, gj, gi] = 1.0
+                sm = min(1.0 / cls, 1.0 / 40) if smooth else 0.0
+                for c in range(cls):
+                    lab = (1 - sm) if c == gtl[i, tt] else sm
+                    loss[i] += sce(t[i, mi, 5 + c, gj, gi], lab)
+            for j in range(m):
+                for k in range(h):
+                    for l in range(w):  # noqa: E741
+                        o = obj_mask[i, j, k, l]
+                        v = t[i, j, 4, k, l]
+                        if o > 1e-5:
+                            loss[i] += sce(v, 1.0) * o
+                        elif o > -0.5:
+                            loss[i] += sce(v, 0.0)
+        return loss
+
+    def test_parity_and_grad(self):
+        rng = np.random.default_rng(0)
+        n, h, w, cls = 2, 4, 4, 3
+        anchors = [10, 14, 24, 30, 50, 60]
+        anchor_mask = [1, 2]
+        x = rng.standard_normal(
+            (n, len(anchor_mask) * (5 + cls), h, w)).astype(np.float32)
+        gtb = np.zeros((n, 3, 4), np.float32)
+        gtb[0, 0] = [0.3, 0.3, 0.2, 0.3]
+        gtb[0, 1] = [0.7, 0.6, 0.6, 0.5]
+        gtb[1, 0] = [0.5, 0.5, 0.4, 0.4]
+        gtl = rng.integers(0, cls, (n, 3)).astype(np.int32)
+        xt = T(x)
+        xt.stop_gradient = False
+        loss = V.yolo_loss(xt, T(gtb), paddle.to_tensor(gtl), anchors,
+                           anchor_mask, cls, ignore_thresh=0.5,
+                           downsample_ratio=8)
+        ref = self._oracle(x.astype(np.float64), gtb, gtl, anchors,
+                           anchor_mask, cls, 0.5, 8)
+        np.testing.assert_allclose(np.asarray(loss.numpy()), ref,
+                                   rtol=1e-4, atol=1e-4)
+        loss.sum().backward()
+        assert np.isfinite(np.asarray(xt.grad.numpy())).all()

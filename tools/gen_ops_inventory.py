@@ -87,8 +87,10 @@ put("temporal_shift", "as",
 put("collect_fpn_proposals", "as",
     "vision.ops.collect_fpn_proposals (global top-k + per-image re-sort)")
 put("affine_channel", "as", "vision.ops.affine_channel")
-put("yolo_box_head yolo_box_post yolo_loss correlation",
-    "descoped", DETZOO)
+put("yolo_loss", "as",
+    "vision.ops.yolo_loss (vectorized kernel-exact loss: SCE/L1 terms, "
+    "anchor assignment, ignore mask, label smooth; oracle-tested)")
+put("yolo_box_head yolo_box_post correlation", "descoped", DETZOO)
 GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
        "reindex, CSC neighbor sampling (tests/test_geometric.py)")
 put("graph_sample_neighbors reindex_graph send_u_recv "
