@@ -947,3 +947,49 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
 
 __all__.append("yolo_loss")
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet correlation layer (incubate/layers/nn.py:1003; kernel
+    gpu/correlation_kernel.cu): for every (2*max_displacement/stride2+1)^2
+    displacement, the mean over a kernel window and channels of
+    x[h1,w1] * y[h1+dj, w1+di] on zero-padded inputs.
+
+    x/y: [N, C, H, W]. Output: [N, D*D, Ho, Wo] with
+    D = 2*(max_displacement//stride2) + 1.
+    """
+    kr = (kernel_size - 1) // 2
+    dr = max_displacement // stride2
+    dsz = 2 * dr + 1
+
+    def fn(a, b):
+        n, c, h, w = a.shape
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        ph, pw = ap.shape[2], ap.shape[3]
+        oh = int(np.ceil((ph - 2 * max_displacement) / stride1))
+        ow = int(np.ceil((pw - 2 * max_displacement) / stride1))
+        h1 = max_displacement + stride1 * jnp.arange(oh)
+        w1 = max_displacement + stride1 * jnp.arange(ow)
+        nelems = kernel_size * kernel_size * c
+        outs = []
+        for tj in range(-dr, dr + 1):
+            for ti in range(-dr, dr + 1):
+                acc = 0.0
+                for j in range(-kr, kr + 1):
+                    for i in range(-kr, kr + 1):
+                        a_sl = ap[:, :, h1 + j][:, :, :, w1 + i]
+                        b_sl = bp[:, :, h1 + j + tj * stride2][
+                            :, :, :, w1 + i + ti * stride2]
+                        acc = acc + (a_sl * b_sl).sum(1)
+                outs.append(acc / nelems)
+        return jnp.stack(outs, 1)            # [N, D*D, Ho, Wo]
+
+    _ = corr_type_multiply, dsz
+    return _apply("correlation", fn, param(x), param(y))
+
+
+__all__.append("correlation")

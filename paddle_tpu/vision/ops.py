@@ -148,7 +148,7 @@ from .detection import (  # noqa: E402,F401 — the detection op zoo
     affine_channel, bipartite_match, box_clip, box_coder, yolo_loss,
     collect_fpn_proposals, deform_conv2d, distribute_fpn_proposals,
     generate_proposals, matrix_nms, multiclass_nms3, prior_box,
-    psroi_pool, roi_pool, yolo_box,
+    psroi_pool, roi_pool, yolo_box, correlation,
 )
 
 __all__ = ["box_area", "box_iou", "nms", "roi_align", "yolo_box",

@@ -578,3 +578,31 @@ class TestYoloLoss:
                                    rtol=1e-4, atol=1e-4)
         loss.sum().backward()
         assert np.isfinite(np.asarray(xt.grad.numpy())).all()
+
+
+def test_correlation_matches_loop_oracle():
+    rng = np.random.default_rng(0)
+    n, c, h, w = 1, 3, 6, 6
+    pad, ks, md, s1, s2 = 2, 1, 2, 1, 1
+    x = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    y = rng.standard_normal((n, c, h, w)).astype(np.float32)
+    out = np.asarray(V.correlation(T(x), T(y), pad, ks, md, s1, s2).numpy())
+    # loop oracle (correlation_kernel.cu)
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    yp = np.pad(y, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = int(np.ceil((ph - 2 * md) / s1))
+    ow = int(np.ceil((pw - 2 * md) / s1))
+    dr = md // s2
+    dsz = 2 * dr + 1
+    ref = np.zeros((n, dsz * dsz, oh, ow), np.float32)
+    for oy in range(oh):
+        for ox in range(ow):
+            h1, w1 = md + oy * s1, md + ox * s1
+            for tj in range(-dr, dr + 1):
+                for ti in range(-dr, dr + 1):
+                    tc = (tj + dr) * dsz + (ti + dr)
+                    ref[0, tc, oy, ox] = (
+                        xp[0, :, h1, w1]
+                        * yp[0, :, h1 + tj * s2, w1 + ti * s2]).sum() / c
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

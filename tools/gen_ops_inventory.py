@@ -90,7 +90,12 @@ put("affine_channel", "as", "vision.ops.affine_channel")
 put("yolo_loss", "as",
     "vision.ops.yolo_loss (vectorized kernel-exact loss: SCE/L1 terms, "
     "anchor assignment, ignore mask, label smooth; oracle-tested)")
-put("yolo_box_head yolo_box_post correlation", "descoped", DETZOO)
+put("correlation", "as",
+    "vision.ops.correlation (FlowNet displacement correlation, "
+    "loop-oracle tested)")
+put("yolo_box_head yolo_box_post", "collapsed",
+    "TensorRT-fusion inference ops; yolo_box + multiclass_nms3 compose "
+    "the same path on this stack")
 GEO = ("paddle_tpu.geometric — gather + jax.ops.segment_* message passing, "
        "reindex, CSC neighbor sampling (tests/test_geometric.py)")
 put("graph_sample_neighbors reindex_graph send_u_recv "
