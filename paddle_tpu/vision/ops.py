@@ -154,4 +154,41 @@ from .detection import (  # noqa: E402,F401 — the detection op zoo
 __all__ = ["box_area", "box_iou", "nms", "roi_align", "yolo_box",
            "prior_box", "box_coder", "deform_conv2d", "roi_pool",
            "psroi_pool", "box_clip", "multiclass_nms3", "matrix_nms",
-           "generate_proposals", "distribute_fpn_proposals"]
+           "generate_proposals", "distribute_fpn_proposals",
+           "read_file", "decode_jpeg"]
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes into a uint8 tensor (reference:
+    vision/ops.py:1345 read_file)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference:
+    vision/ops.py:1388 decode_jpeg — nvjpeg on GPU; PIL on the host
+    here, the image-IO path of the vision datasets)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from ..core.tensor import Tensor
+    raw = bytes(np.asarray(x._data if hasattr(x, "_data") else x,
+                           np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

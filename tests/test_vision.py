@@ -73,3 +73,27 @@ def test_box_iou_and_nms():
 def test_pretrained_flag_raises():
     with pytest.raises(RuntimeError):
         M.vgg11(pretrained=True)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    import numpy as np
+    from PIL import Image
+
+    from paddle_tpu.vision.ops import decode_jpeg, read_file
+
+    # a smooth gradient (random noise compresses terribly under JPEG)
+    g = np.linspace(0, 255, 8 * 6).reshape(8, 6)
+    arr = np.stack([g, g[::-1], g.T.repeat(2, 1)[:8, :6]],
+                   -1).astype(np.uint8)
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = read_file(str(p))
+    assert raw.dtype == "uint8" if isinstance(raw.dtype, str) else True
+    img = decode_jpeg(raw, mode="rgb")
+    got = np.asarray(img.numpy())
+    assert got.shape == (3, 8, 6)
+    # JPEG is lossy; just require closeness
+    assert np.abs(got.transpose(1, 2, 0).astype(int) - arr.astype(int)
+                  ).mean() < 16
+    gray = decode_jpeg(raw, mode="gray")
+    assert np.asarray(gray.numpy()).shape == (1, 8, 6)

@@ -191,9 +191,8 @@ put("sync_batch_norm_", "as",
     "global in the single-program model)")
 put("top_p_sampling", "as",
     "models/generation.py _sample (top-p nucleus filter)")
-put("read_file decode_jpeg", "descoped",
-    "file IO ops; vision.datasets does host-side image IO in the "
-    "DataLoader")
+put("read_file decode_jpeg", "as",
+    "vision.ops.read_file/decode_jpeg (host PIL decode -> CHW uint8)")
 put("coalesce_tensor", "collapsed",
     "fused-buffer packing for NCCL; XLA buffer assignment owns memory "
     "layout")
