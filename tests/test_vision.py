@@ -83,8 +83,7 @@ def test_read_file_decode_jpeg(tmp_path):
 
     # a smooth gradient (random noise compresses terribly under JPEG)
     g = np.linspace(0, 255, 8 * 6).reshape(8, 6)
-    arr = np.stack([g, g[::-1], g.T.repeat(2, 1)[:8, :6]],
-                   -1).astype(np.uint8)
+    arr = np.stack([g, g[::-1], np.flip(g, 1)], -1).astype(np.uint8)
     p = tmp_path / "img.jpg"
     Image.fromarray(arr).save(p, quality=95)
     raw = read_file(str(p))
