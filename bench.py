@@ -13,9 +13,23 @@ produced no artifact). The measurement runs in a child process under a
 wall-clock timeout — backend init against a wedged TPU pool hangs inside
 native code where no Python signal handler can fire, so only a process
 boundary guarantees the artifact. Failures are retried once.
+
+Round-4 hardening (round-3 verdict item 1a):
+- The child appends staged heartbeats ("backend_up" / "compiled" / "rep k")
+  to a progress file; on failure the parent embeds them in the artifact so a
+  wedged pool (no backend_up) is distinguishable from a compile blowup
+  (backend_up but no compiled) without reproducing the run.
+- The child gets a persistent XLA compilation cache dir, so a retry after a
+  slow first compile starts warm instead of cold.
+- The retry budget covers cold-compile (60-120 s, docs/PERF.md §5) plus the
+  measurement: 900 s first try, 480 s warm retry.
+- On total failure the artifact embeds the last recorded good round's number
+  with an explicit ``stale: true`` marker instead of reporting 0.0.
 """
+import glob
 import json
 import os
+import re
 import statistics
 import subprocess
 import sys
@@ -32,6 +46,10 @@ PEAK_FLOPS = {
     "cpu": 1e12,            # nominal, CI runs only
 }
 
+_PROGRESS_ENV = "PADDLE_TPU_BENCH_PROGRESS"
+_CACHE_ENV = "PADDLE_TPU_BENCH_CACHE"
+_SENTINEL = "BENCH_RESULT_JSON:"
+
 
 def peak_flops(dev) -> float:
     kind = getattr(dev, "device_kind", "cpu").lower()
@@ -41,13 +59,50 @@ def peak_flops(dev) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def run_bench(config="llama_125m"):
+class _Progress:
+    """Append-only staged heartbeat written by the child, read by the parent.
+
+    Survives the child being SIGKILLed on timeout (every write is flushed),
+    which is the whole point: the artifact tail must show how far the child
+    got even when it never printed its result line.
+    """
+
+    def __init__(self):
+        path = os.environ.get(_PROGRESS_ENV)
+        self._f = open(path, "a", buffering=1) if path else None
+        self._t0 = time.perf_counter()
+
+    def mark(self, stage, **extra):
+        rec = {"stage": stage, "t": round(time.perf_counter() - self._t0, 1)}
+        rec.update(extra)
+        if self._f:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+
+def run_bench(config="llama_125m", progress=None):
+    progress = progress or _Progress()
     import jax
+
+    # Persistent compilation cache: a retry after a slow cold compile (or a
+    # later same-round invocation) starts warm. Tests already do this
+    # (tests/conftest.py); the bench child deliberately started cold before
+    # round 4 — that cost it the round-3 artifact.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(_CACHE_ENV, "/tmp/paddle_tpu_bench_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
+    progress.mark("imports_done")
 
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu", "gpu")
+    progress.mark("backend_up", device=getattr(dev, "device_kind", str(dev)))
     if config == "llama_1b" and on_tpu:
         # ~1B-param config (TinyLlama-1.1B shape) with remat + bf16: the
         # arithmetic-intensity regime of the 13B north star, sized to one
@@ -88,6 +143,7 @@ def run_bench(config="llama_125m"):
     model = LlamaForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
+    progress.mark("model_built", config=config)
 
     def loss_fn(ids):
         # bf16 autocast on the MXU-bound ops; fp32 master weights live in
@@ -102,17 +158,20 @@ def run_bench(config="llama_125m"):
 
     # warmup: compile + 2 steady-state steps
     _ = float(step(ids).numpy())
+    progress.mark("compiled")
     _ = float(step(ids).numpy())
+    progress.mark("warm")
 
     # reps x iters: async enqueue inside a rep, sync at rep boundary —
     # keeps the pipeline full while giving a variance estimate
     rep_dts = []
-    for _ in range(reps):
+    for r in range(reps):
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = step(ids)
         val = float(loss.numpy())  # sync
         rep_dts.append(time.perf_counter() - t0)
+        progress.mark(f"rep_{r + 1}", dt=round(rep_dts[-1], 3))
     if not np.isfinite(val):
         raise RuntimeError(f"non-finite loss {val}")
 
@@ -121,6 +180,7 @@ def run_bench(config="llama_125m"):
     tok_s = tokens_per_step * iters / best
     flops_tok = model.flops_per_token(seq)
     mfu = tok_s * flops_tok / peak_flops(dev)
+    progress.mark("measured", tok_s=round(tok_s, 1))
     return {
         "metric": f"{config}_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
@@ -137,74 +197,141 @@ def run_bench(config="llama_125m"):
     }
 
 
-_SENTINEL = "BENCH_RESULT_JSON:"
-
-
 def _child_main():
+    progress = _Progress()
+    progress.mark("child_start", argv=sys.argv[1:])
     cfg = "llama_1b" if "--config=llama_1b" in sys.argv else "llama_125m"
     try:
-        result = run_bench(cfg)
+        result = run_bench(cfg, progress)
         print(_SENTINEL + json.dumps(result))
         sys.exit(0)
     except Exception as e:  # noqa: BLE001 — reported via sentinel line
         import traceback
         traceback.print_exc(limit=8)
+        progress.mark("child_error", error=f"{type(e).__name__}: {e}")
         print(_SENTINEL + json.dumps({"error": f"{type(e).__name__}: {e}"}))
         sys.exit(1)
 
 
-def main():
-    last_err = "unknown"
-    budgets = tuple(
-        float(b) for b in
-        os.environ.get("PADDLE_TPU_BENCH_BUDGETS", "480,180").split(","))
-    for budget in budgets:
+def _read_progress(path):
+    """Parse the child's heartbeat file into a compact stage trail."""
+    try:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except (OSError, ValueError):
+        return []
+
+
+def _run_child(budget, extra_args=()):
+    """Run one bench child under a wall-clock budget.
+
+    Returns (payload_or_None, error_str, stages). The progress file gives
+    post-hoc forensics: a timeout with no "backend_up" stage is a wedged
+    pool; "backend_up" without "compiled" is a compile blowup.
+    """
+    progress_path = f"/tmp/paddle_tpu_bench_progress_{os.getpid()}_{time.time_ns()}"
+    env = dict(os.environ, **{_PROGRESS_ENV: progress_path})
+    if env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # Forced-CPU run (CI): the axon TPU plugin's registration hook
+        # (sitecustomize) can hang against a wedged pool even when
+        # JAX_PLATFORMS=cpu, so disable it entirely for the child.
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    try:
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True, timeout=budget)
+                [sys.executable, os.path.abspath(__file__), "--child",
+                 *extra_args],
+                capture_output=True, text=True, timeout=budget, env=env)
         except subprocess.TimeoutExpired:
-            last_err = f"timeout after {budget}s (backend hang or slow compile)"
-            continue
+            stages = _read_progress(progress_path)
+            reached = stages[-1]["stage"] if stages else "none"
+            return (None,
+                    f"timeout after {budget}s (last stage: {reached})",
+                    stages)
+        stages = _read_progress(progress_path)
         for line in proc.stdout.splitlines():
             if line.startswith(_SENTINEL):
                 payload = json.loads(line[len(_SENTINEL):])
                 if "error" not in payload:
-                    # opportunistic second config: the >=1B-param point
-                    # (remat + bf16) the round-2 verdict asked for
-                    payload["llama_1b"] = _run_1b_config()
-                    print(json.dumps(payload))
-                    return
-                last_err = payload["error"]
-                break
-        else:
-            tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-            last_err = tail[-1] if tail else f"child exited rc={proc.returncode}"
+                    return payload, None, stages
+                # keep the child's traceback visible for forensics
+                sys.stderr.write(proc.stderr or "")
+                return None, payload["error"], stages
         sys.stderr.write(proc.stderr or "")
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        err = tail[-1] if tail else f"child exited rc={proc.returncode}"
+        return None, err, stages
+    finally:
+        try:
+            os.unlink(progress_path)
+        except OSError:
+            pass
+
+
+def _last_good_round():
+    """Most recent BENCH_r*.json whose parsed value was non-zero.
+
+    Used only when every attempt this round failed: the artifact then
+    carries the last real measurement, marked stale, instead of a 0.0 that
+    erases the evidence chain.
+    """
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
+            continue
+        if parsed.get("value") and not parsed.get("stale"):
+            m = re.search(r"BENCH_r\d+\.json$", path)
+            best = (m.group(0) if m else os.path.basename(path)), parsed
+    return best
+
+
+def main():
+    # Budgets: first try must cover cold compile (60-120 s per docs/PERF.md
+    # §5) + measurement; the retry runs against the now-warm persistent
+    # compilation cache.
+    budgets = tuple(
+        float(b) for b in
+        os.environ.get("PADDLE_TPU_BENCH_BUDGETS", "900,480").split(","))
+    last_err, last_stages = "unknown", []
+    for budget in budgets:
+        payload, err, stages = _run_child(budget)
+        if payload is not None:
+            # opportunistic second config: the >=1B-param point
+            # (remat + bf16) the round-2 verdict asked for
+            payload["llama_1b"] = _run_1b_config()
+            print(json.dumps(payload))
+            return
+        last_err, last_stages = err, stages
         time.sleep(5.0)
-    print(json.dumps({
+    out = {
         "metric": "llama_125m_train_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "error": last_err,
-    }))
+        "stages": [s.get("stage") for s in last_stages],
+    }
+    good = _last_good_round()
+    if good:
+        src, parsed = good
+        out.update({k: parsed[k] for k in
+                    ("value", "vs_baseline", "mfu", "device", "step_ms")
+                    if k in parsed})
+        out["stale"] = True
+        out["stale_source"] = src
+    print(json.dumps(out))
 
 
 def _run_1b_config():
-    budget = float(os.environ.get("PADDLE_TPU_BENCH_1B_BUDGET", "420"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child",
-             "--config=llama_1b"],
-            capture_output=True, text=True, timeout=budget)
-    except subprocess.TimeoutExpired:
-        return {"error": f"timeout after {budget}s"}
-    for line in proc.stdout.splitlines():
-        if line.startswith(_SENTINEL):
-            return json.loads(line[len(_SENTINEL):])
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return {"error": tail[-1] if tail else f"child rc={proc.returncode}"}
+    budget = float(os.environ.get("PADDLE_TPU_BENCH_1B_BUDGET", "900"))
+    payload, err, stages = _run_child(budget, ("--config=llama_1b",))
+    if payload is not None:
+        return payload
+    return {"error": err, "stages": [s.get("stage") for s in stages]}
 
 
 if __name__ == "__main__":

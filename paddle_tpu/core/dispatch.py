@@ -171,6 +171,23 @@ def primitive(name=None):
     return deco
 
 
+def op_body(name: str):
+    """Register a module-level function as an op's default body at import
+    time (the analog of ``PD_REGISTER_KERNEL``'s static registration,
+    reference paddle/phi/core/kernel_registry.h:196). The body takes arrays
+    positionally and op settings as keyword-only arguments — the signature
+    ``override_kernel`` replacements must match. Pair with ``op_call`` at
+    the public API site so the body is resolved from ``OPS`` per call.
+    """
+
+    def deco(fn):
+        OPS.setdefault(name, fn)
+        fn.op_name = name
+        return fn
+
+    return deco
+
+
 def op_call(op_name: str, default_fn, *args, **kwargs):
     """Registry-routed op execution (the analog of the reference's kernel
     dispatch, phi/core/kernel_factory.h:58 KernelFactory::SelectKernel).
@@ -195,4 +212,5 @@ def override_kernel(name: str, fn):
     return old
 
 
-__all__ = ["primitive", "eager_apply", "op_call", "override_kernel", "OPS"]
+__all__ = ["primitive", "eager_apply", "op_body", "op_call",
+           "override_kernel", "OPS"]

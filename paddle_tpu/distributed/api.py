@@ -28,6 +28,31 @@ def _as_tensor(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
 
+def _same_device_order(src_sh, dst_sh) -> bool:
+    """True when both shardings lay their devices out in the same order.
+
+    Compares the PUBLIC device tuples (mesh.devices.flat) so a JAX upgrade
+    that drops the private ``_device_assignment`` attribute degrades loudly
+    here rather than silently sending every reshard down the host-broadcast
+    slow path."""
+    try:
+        src_devs = tuple(d.id for d in src_sh.mesh.devices.flat)
+        dst_devs = tuple(d.id for d in dst_sh.mesh.devices.flat)
+        return src_devs == dst_devs
+    except AttributeError:
+        # non-NamedSharding (e.g. SingleDeviceSharding): fall back to the
+        # device-assignment view, which every jax.sharding.Sharding has
+        src = getattr(src_sh, "_device_assignment", None)
+        dst = getattr(dst_sh, "_device_assignment", None)
+        if src is None or dst is None:
+            from ..core.vlog import vlog
+            vlog(1, "reshard: cannot compare device orders "
+                    f"({type(src_sh).__name__} vs {type(dst_sh).__name__}); "
+                    "taking the host-broadcast slow path")
+            return False
+        return tuple(src) == tuple(dst)
+
+
 def _put_global(a, sharding, src_mesh=None):
     """device_put that is correct in the multi-process regime.
 
@@ -90,8 +115,7 @@ def _put_global(a, sharding, src_mesh=None):
         return jax.device_put(a, sharding)
     if src_spans_all and isinstance(a, jax.Array) and src_sh is not None \
             and not a.is_fully_addressable \
-            and tuple(getattr(src_sh, "_device_assignment", ())) \
-            == tuple(getattr(sharding, "_device_assignment", (None,))):
+            and _same_device_order(src_sh, sharding):
         # same mesh in the same device ORDER (possibly different layout):
         # compiled identity with out_shardings — XLA emits the cross-host
         # collective (device_put cannot move bytes between hosts on every

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_call, OPS
 from ..core.tensor import Tensor
 
 
@@ -42,12 +42,17 @@ def _reduce(vals, ids, n, reduce_op):
     return jnp.where(counts.reshape(shape) > 0, out, 0)
 
 
+def _segment_op_body(d, ids, *, n, reduce_op):
+    return _reduce(d, ids, n, reduce_op)
+
+
 def _segment(op_name, reduce_op):
+    OPS.setdefault(op_name, _segment_op_body)
+
     def op(data, segment_ids, out_size=None, name=None):
         n = _num_segments(segment_ids, out_size)
-        return eager_apply(
-            op_name, lambda d, ids: _reduce(d, ids, n, reduce_op),
-            (data, segment_ids), {})
+        return op_call(op_name, _segment_op_body, data, segment_ids,
+                       n=n, reduce_op=reduce_op)
 
     op.__name__ = op_name
     return op

@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
 from ..core.tensor import Tensor
 from .math import _num_segments, _reduce
 
@@ -35,11 +35,13 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     if reduce_op not in _REDUCE_OPS:
         raise ValueError(f"reduce_op must be one of {list(_REDUCE_OPS)}")
     n = _num_segments(dst_index, out_size)
+    return op_call("send_u_recv", _send_u_recv, x, src_index, dst_index,
+                   n=n, reduce_op=reduce_op)
 
-    def fn(x, src, dst):
-        return _reduce(x[src], dst, n, reduce_op)
 
-    return eager_apply("send_u_recv", fn, (x, src_index, dst_index), {})
+@op_body("send_u_recv")
+def _send_u_recv(x, src, dst, *, n, reduce_op):
+    return _reduce(x[src], dst, n, reduce_op)
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op="add",
@@ -53,11 +55,14 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     if reduce_op not in _REDUCE_OPS:
         raise ValueError(f"reduce_op must be one of {list(_REDUCE_OPS)}")
     n = _num_segments(dst_index, out_size)
+    return op_call("send_ue_recv", _send_ue_recv, x, y, src_index,
+                   dst_index, n=n, message_op=message_op,
+                   reduce_op=reduce_op)
 
-    def fn(x, y, src, dst):
-        return _reduce(_MESSAGE[message_op](x[src], y), dst, n, reduce_op)
 
-    return eager_apply("send_ue_recv", fn, (x, y, src_index, dst_index), {})
+@op_body("send_ue_recv")
+def _send_ue_recv(x, y, src, dst, *, n, message_op, reduce_op):
+    return _reduce(_MESSAGE[message_op](x[src], y), dst, n, reduce_op)
 
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
@@ -66,10 +71,13 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     if message_op not in _MESSAGE:
         raise ValueError(f"message_op must be one of {list(_MESSAGE)}")
 
-    def fn(x, y, src, dst):
-        return _MESSAGE[message_op](x[src], y[dst])
+    return op_call("send_uv", _send_uv, x, y, src_index, dst_index,
+                   message_op=message_op)
 
-    return eager_apply("send_uv", fn, (x, y, src_index, dst_index), {})
+
+@op_body("send_uv")
+def _send_uv(x, y, src, dst, *, message_op):
+    return _MESSAGE[message_op](x[src], y[dst])
 
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv"]

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ....core.dispatch import eager_apply, OPS
+from ....core.dispatch import op_body, op_call, OPS
 from ....nn import functional as F
 
 
@@ -24,71 +24,84 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     Returns (out, residual_out) like the reference when residual is passed,
     else out. bias/residual are pre-norm adds fused by XLA.
     """
-    def fn(a, w, *extra):
-        i = 0
-        b = r = nb = None
-        if bias is not None:
-            b = extra[i]; i += 1
-        if residual is not None:
-            r = extra[i]; i += 1
-        if norm_bias is not None:
-            nb = extra[i]; i += 1
-        if b is not None:
-            a = a + b
-        if r is not None:
-            a = a + r
-        res_out = a
-        var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
-        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
-        if nb is not None:
-            out = out + nb
-        if residual is not None:
-            return out, res_out
-        return out
-
     args = [x, norm_weight]
     for t in (bias, residual, norm_bias):
         if t is not None:
             args.append(t)
-    return eager_apply("fused_rms_norm", fn, tuple(args), {})
+    return op_call("fused_rms_norm", _fused_rms_norm, *args, epsilon=epsilon,
+                   has_bias=bias is not None,
+                   has_residual=residual is not None,
+                   has_norm_bias=norm_bias is not None)
+
+
+@op_body("fused_rms_norm")
+def _fused_rms_norm(a, w, *extra, epsilon, has_bias, has_residual,
+                    has_norm_bias):
+    i = 0
+    b = r = nb = None
+    if has_bias:
+        b = extra[i]; i += 1
+    if has_residual:
+        r = extra[i]; i += 1
+    if has_norm_bias:
+        nb = extra[i]; i += 1
+    if b is not None:
+        a = a + b
+    if r is not None:
+        a = a + r
+    res_out = a
+    var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
+    out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
+    if nb is not None:
+        out = out + nb
+    if has_residual:
+        return out, res_out
+    return out
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, bias=None, residual=None, **_):
     """fused_layer_norm (reference: incubate/nn/functional/fused_layer_norm.py)."""
-    def fn(a, *extra):
-        i = 0
-        b = r = w = nb = None
-        if bias is not None:
-            b = extra[i]; i += 1
-        if residual is not None:
-            r = extra[i]; i += 1
-        if norm_weight is not None:
-            w = extra[i]; i += 1
-        if norm_bias is not None:
-            nb = extra[i]; i += 1
-        if b is not None:
-            a = a + b
-        if r is not None:
-            a = a + r
-        res_out = a
-        af = a.astype(jnp.float32)
-        mean = af.mean(axis=-1, keepdims=True)
-        var = jnp.square(af - mean).mean(axis=-1, keepdims=True)
-        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
-        if w is not None:
-            out = out * w
-        if nb is not None:
-            out = out + nb
-        if residual is not None:
-            return out, res_out
-        return out
-
     args = [x]
     for t in (bias, residual, norm_weight, norm_bias):
         if t is not None:
             args.append(t)
-    return eager_apply("fused_layer_norm", fn, tuple(args), {})
+    return op_call("fused_layer_norm", _fused_layer_norm, *args,
+                   epsilon=epsilon, has_bias=bias is not None,
+                   has_residual=residual is not None,
+                   has_norm_weight=norm_weight is not None,
+                   has_norm_bias=norm_bias is not None)
+
+
+@op_body("fused_layer_norm")
+def _fused_layer_norm(a, *extra, epsilon, has_bias, has_residual,
+                      has_norm_weight, has_norm_bias):
+    i = 0
+    b = r = w = nb = None
+    if has_bias:
+        b = extra[i]; i += 1
+    if has_residual:
+        r = extra[i]; i += 1
+    if has_norm_weight:
+        w = extra[i]; i += 1
+    if has_norm_bias:
+        nb = extra[i]; i += 1
+    if b is not None:
+        a = a + b
+    if r is not None:
+        a = a + r
+    res_out = a
+    af = a.astype(jnp.float32)
+    mean = af.mean(axis=-1, keepdims=True)
+    var = jnp.square(af - mean).mean(axis=-1, keepdims=True)
+    out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+    if w is not None:
+        out = out * w
+    if nb is not None:
+        out = out + nb
+    if has_residual:
+        return out, res_out
+    return out
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
@@ -132,37 +145,45 @@ def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
                    act_method="gelu", **_):
     """Reference: incubate/nn/functional/fused_bias_act.py (quant paths
     descoped; see paddle_tpu.quantization for the quant tier)."""
-    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
-           "swiglu": None}[act_method]
-
-    def fn(a, *b):
-        if b:
-            a = a + b[0]
-        if act_method == "swiglu":
-            u, g = jnp.split(a, 2, axis=-1)
-            return jax.nn.silu(u) * g
-        return act(a)
-
+    if act_method not in ("gelu", "relu", "silu", "swiglu"):
+        raise KeyError(act_method)
     args = (x,) if bias is None else (x, bias)
-    return eager_apply("fused_bias_act", fn, args, {})
+    return op_call("fused_bias_act", _fused_bias_act, *args,
+                   act_method=act_method)
+
+
+@op_body("fused_bias_act")
+def _fused_bias_act(a, *b, act_method):
+    if b:
+        a = a + b[0]
+    if act_method == "swiglu":
+        u, g = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(u) * g
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "silu": jax.nn.silu}[act_method]
+    return act(a)
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
                       name=None):
     """Reference: incubate/nn/functional/fused_matmul_bias.py (CUDA
     fused_gemm_epilogue); XLA fuses the bias add into the matmul."""
-    def fn(a, b, *bb):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2)
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2)
-        out = a @ b
-        if bb:
-            out = out + bb[0]
-        return out
-
     args = (x, y) if bias is None else (x, y, bias)
-    return eager_apply("fused_matmul_bias", fn, args, {})
+    return op_call("fused_matmul_bias", _fused_matmul_bias, *args,
+                   transpose_x=bool(transpose_x),
+                   transpose_y=bool(transpose_y))
+
+
+@op_body("fused_matmul_bias")
+def _fused_matmul_bias(a, b, *bb, transpose_x, transpose_y):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    out = a @ b
+    if bb:
+        out = out + bb[0]
+    return out
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -204,11 +225,13 @@ def weight_quantize(x, algo="weight_only_int8", name=None):
     return Tensor(q), Tensor(s.reshape(-1))
 
 
+@op_body("weight_dequantize")
+def _weight_dequantize(q, s):
+    return q.astype(jnp.float32) * s.reshape(1, -1)
+
+
 def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
-    def fn(q, s):
-        import jax.numpy as jnp
-        return q.astype(jnp.float32) * s.reshape(1, -1)
-    return eager_apply("weight_dequantize", fn, (x, scale), {})
+    return op_call("weight_dequantize", _weight_dequantize, x, scale)
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
@@ -223,14 +246,16 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         raise ValueError(
             "weight_only_linear requires weight_scale (the per-out-channel "
             "scales returned by weight_quantize)")
-    def fn(a, q, s, *b):
-        import jax.numpy as jnp
-        w = q.astype(a.dtype) * s.reshape(1, -1).astype(a.dtype)
-        out = a @ w
-        return out + b[0] if b else out
     extra = (bias,) if bias is not None else ()
-    return eager_apply("weight_only_linear", fn,
-                       (x, weight, weight_scale) + extra, {})
+    return op_call("weight_only_linear", _weight_only_linear,
+                   x, weight, weight_scale, *extra)
+
+
+@op_body("weight_only_linear")
+def _weight_only_linear(a, q, s, *b):
+    w = q.astype(a.dtype) * s.reshape(1, -1).astype(a.dtype)
+    out = a @ w
+    return out + b[0] if b else out
 
 
 llm_int8_linear = weight_only_linear
@@ -255,29 +280,19 @@ def segment_min(data, segment_ids, name=None):
 
 
 def _segment(op_name, kind, data, segment_ids):
-    def fn(d, ids):
-        import jax
-        import jax.numpy as jnp
-        ids = ids.astype(jnp.int32)
-        # exact segment count when ids are concrete (eager); under a trace
-        # the data length is the static bound and ids must stay below it
-        # (ids >= num_segments would be silently dropped by jax otherwise)
-        try:
-            n = int(ids.max()) + 1 if ids.size else 0
-        except Exception:
-            n = d.shape[0]
-        if kind == "sum":
-            return jax.ops.segment_sum(d, ids, num_segments=n)
-        if kind == "mean":
-            s = jax.ops.segment_sum(d, ids, num_segments=n)
-            c = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
-                                    num_segments=n)
-            return s / jnp.maximum(c, 1).reshape(
-                (-1,) + (1,) * (d.ndim - 1))
-        if kind == "max":
-            return jax.ops.segment_max(d, ids, num_segments=n)
-        return jax.ops.segment_min(d, ids, num_segments=n)
-    return eager_apply(op_name, fn, (data, segment_ids), {})
+    # same public ops as paddle.geometric.segment_* (the reference exposes
+    # both surfaces over one kernel family) — share ONE registry body
+    from ....geometric.math import _segment_op_body, _num_segments
+    OPS.setdefault(op_name, _segment_op_body)
+    try:
+        n = _num_segments(segment_ids, None)
+    except ValueError:
+        # traced ids with no out_size in this API: the data length is the
+        # static bound (ids >= n would be silently dropped by jax)
+        n = (data.shape[0] if not isinstance(data, (list, tuple))
+             else len(data))
+    return op_call(op_name, _segment_op_body, data, segment_ids,
+                   n=n, reduce_op=kind)
 
 
 __all__ += ["weight_quantize", "weight_dequantize", "weight_only_linear",

@@ -410,6 +410,11 @@ class DataLoader:
             return
         pool = self._pool
         if pool is None or not pool.alive():
+            if pool is not None:
+                # a partially-dead pool (alive() False, some workers still
+                # running) must be torn down or its live processes leak
+                pool.shutdown()
+                self._pool = None
             pool = _ProcessPool(self)
         try:
             yield from pool.run_epoch(iter(self.batch_sampler), self.timeout)

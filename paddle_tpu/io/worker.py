@@ -20,6 +20,7 @@ import contextlib
 import multiprocessing as mp
 import os
 import queue as _queue
+import time as _time
 
 import numpy as np
 
@@ -70,10 +71,10 @@ def _encode(obj):
     from ..core.tensor import Tensor
 
     if isinstance(obj, Tensor):
-        return ("__tensor__", np.asarray(obj.numpy()))
+        return _WireTensor(np.asarray(obj.numpy()))
     if type(obj).__module__.startswith("jaxlib") or \
             type(obj).__name__ == "ArrayImpl":
-        return ("__tensor__", np.asarray(obj))
+        return _WireTensor(np.asarray(obj))
     if isinstance(obj, tuple):
         return tuple(_encode(o) for o in obj)
     if isinstance(obj, list):
@@ -83,12 +84,24 @@ def _encode(obj):
     return obj
 
 
+class _WireTensor:
+    """Private wire wrapper for device arrays crossing the worker queue.
+
+    A wrapper class (not a tagged tuple) so a dataset that legitimately
+    yields ("__tensor__", ...) tuples round-trips unchanged."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
 def _decode(obj):
     from ..core.tensor import Tensor
 
+    if isinstance(obj, _WireTensor):
+        return Tensor(obj.array)
     if isinstance(obj, tuple):
-        if len(obj) == 2 and obj[0] == "__tensor__":
-            return Tensor(obj[1])
         return tuple(_decode(o) for o in obj)
     if isinstance(obj, list):
         return [_decode(o) for o in obj]
@@ -192,7 +205,7 @@ class _ProcessPool:
         self.epoch += 1
         epoch = self.epoch
         inflight = 0
-        waited = 0.0
+        last_progress = _time.monotonic()
         pending = {}
         next_out = 0
         it = iter(enumerate(idx_batches))
@@ -209,20 +222,18 @@ class _ProcessPool:
                 inflight += 1
             if inflight == 0:
                 return
+            wait_step = min(timeout, 5.0) if timeout else 5.0
             try:
                 # bounded waits so a dead worker is detected rather than
                 # blocking forever (the reference's _thread_monitor role)
-                ep, seq, payload = self.result_q.get(
-                    timeout=min(timeout, 5.0) if timeout else 5.0)
-                waited = 0.0
+                ep, seq, payload = self.result_q.get(timeout=wait_step)
             except _queue.Empty:
                 if not self.alive():
                     self.shutdown()
                     raise RuntimeError(
                         "DataLoader worker died unexpectedly (killed or "
                         "crashed without reporting)")
-                waited += 5.0
-                if timeout and waited >= timeout:
+                if timeout and _time.monotonic() - last_progress >= timeout:
                     self.shutdown()
                     raise RuntimeError(
                         f"DataLoader worker timed out after {timeout}s")
@@ -233,8 +244,15 @@ class _ProcessPool:
                 self.shutdown()
                 raise RuntimeError(
                     f"DataLoader worker failed: {payload.tb}")
+            if timeout and _time.monotonic() - last_progress >= timeout:
+                # wall-clock deadline (monotonic): stale-epoch results
+                # consume real time and must not postpone the timeout
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timed out after {timeout}s")
             if ep != epoch:
                 continue   # stale result from an abandoned epoch
+            last_progress = _time.monotonic()  # current-epoch progress
             inflight -= 1
             pending[seq] = payload
             while next_out in pending:
@@ -274,21 +292,20 @@ def iter_iterable_multiprocess(loader, timeout):
             p.start()
             procs.append(p)
     done = 0
-    waited = 0.0
+    last_progress = _time.monotonic()
     try:
         while done < len(procs):
             try:
                 tag, payload = result_q.get(
                     timeout=min(timeout, 5.0) if timeout else 5.0)
-                waited = 0.0
+                last_progress = _time.monotonic()
             except _queue.Empty:
                 dead = sum(not p.is_alive() for p in procs)
                 if dead > done:   # a worker died without its done sentinel
                     raise RuntimeError(
                         "DataLoader worker died unexpectedly (killed or "
                         "crashed without reporting)")
-                waited += 5.0
-                if timeout and waited >= timeout:
+                if timeout and _time.monotonic() - last_progress >= timeout:
                     raise RuntimeError(
                         f"DataLoader worker timed out after {timeout}s")
                 continue

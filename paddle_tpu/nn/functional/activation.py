@@ -4,7 +4,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply, op_call, OPS
+from ...core.dispatch import eager_apply, op_body, op_call, OPS
 from ...core.tensor import Tensor
 
 
@@ -76,8 +76,10 @@ def hardshrink(x, threshold=0.5, name=None):
 
 
 def softshrink(x, threshold=0.5, name=None):
-    return eager_apply("softshrink",
-                       lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), (x,), {})
+    return op_call("softshrink",
+                   lambda a, threshold: jnp.sign(a) * jnp.maximum(
+                       jnp.abs(a) - threshold, 0.0),
+                   x, threshold=threshold)
 
 
 def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
@@ -96,37 +98,54 @@ def swish(x, name=None):
     return op_call("swish", jax.nn.silu, x)
 
 
+@op_body("softplus")
+def _softplus(a, *, beta, threshold):
+    return jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta)
+
+
 def softplus(x, beta=1.0, threshold=20.0, name=None):
-    def fn(a):
-        return jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta)
-    return eager_apply("softplus", fn, (x,), {})
+    return op_call("softplus", _softplus, x, beta=beta, threshold=threshold)
+
+
+@op_body("thresholded_relu")
+def _thresholded_relu(a, *, threshold, value):
+    return jnp.where(a > threshold, a, value)
 
 
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
-    return eager_apply("thresholded_relu",
-                       lambda a: jnp.where(a > threshold, a, value), (x,), {})
+    return op_call("thresholded_relu", _thresholded_relu, x,
+                   threshold=threshold, value=value)
+
+
+@op_body("prelu")
+def _prelu(a, w, *, data_format):
+    if w.size > 1:
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        w = w.reshape(shape)
+    return jnp.where(a > 0, a, a * w)
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
-    def fn(a, w):
-        if w.size > 1:
-            shape = [1] * a.ndim
-            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
-            shape[ch_axis] = w.size
-            w = w.reshape(shape)
-        return jnp.where(a > 0, a, a * w)
-    return eager_apply("prelu", fn, (x, weight), {})
+    return op_call("prelu", _prelu, x, weight, data_format=data_format)
+
+
+@op_body("rrelu")
+def _rrelu(a, *maybe_key, lower, upper, training):
+    if training:
+        slope = jax.random.uniform(maybe_key[0], a.shape, jnp.float32,
+                                   lower, upper)
+        return jnp.where(a >= 0, a, a * slope.astype(a.dtype))
+    mid = (lower + upper) / 2
+    return jnp.where(a >= 0, a, a * mid)
 
 
 def rrelu(x, lower=1 / 8, upper=1 / 3, training=True, name=None):
     from ...core import random as _rng
-    if training:
-        def fn(a):
-            slope = jax.random.uniform(_rng.next_key(), a.shape, jnp.float32, lower, upper)
-            return jnp.where(a >= 0, a, a * slope.astype(a.dtype))
-        return eager_apply("rrelu", fn, (x,), {})
-    mid = (lower + upper) / 2
-    return eager_apply("rrelu", lambda a: jnp.where(a >= 0, a, a * mid), (x,), {})
+    args = (x, _rng.next_key()) if training else (x,)
+    return op_call("rrelu", _rrelu, *args, lower=lower, upper=upper,
+                   training=bool(training))
 
 
 def _softmax_body(a, axis=-1):
@@ -143,53 +162,69 @@ def softmax(x, axis=-1, dtype=None, name=None):
     return op_call("softmax", _softmax_body, x, axis=int(axis))
 
 
+@op_body("log_softmax")
+def _log_softmax(a, *, axis):
+    return jax.nn.log_softmax(a, axis=axis)
+
+
 def log_softmax(x, axis=-1, dtype=None, name=None):
-    def fn(a):
-        if dtype is not None:
-            from ...core.dtype import to_jax_dtype
-            a = a.astype(to_jax_dtype(dtype))
-        return jax.nn.log_softmax(a, axis=int(axis))
-    return eager_apply("log_softmax", fn, (x,), {})
+    if dtype is not None:
+        from ...core.dtype import to_jax_dtype
+        x = x.astype(to_jax_dtype(dtype))
+    return op_call("log_softmax", _log_softmax, x, axis=int(axis))
+
+
+@op_body("gumbel_softmax")
+def _gumbel_softmax(a, key, *, temperature, hard, axis):
+    g = jax.random.gumbel(key, a.shape).astype(a.dtype)
+    y = jax.nn.softmax((a + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...core import random as _rng
+    return op_call("gumbel_softmax", _gumbel_softmax, x, _rng.next_key(),
+                   temperature=temperature, hard=bool(hard), axis=axis)
 
-    def fn(a):
-        g = jax.random.gumbel(_rng.next_key(), a.shape).astype(a.dtype)
-        y = jax.nn.softmax((a + g) / temperature, axis=axis)
-        if hard:
-            idx = jnp.argmax(y, axis=axis, keepdims=True)
-            onehot = jnp.zeros_like(y)
-            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
-            y = onehot + y - jax.lax.stop_gradient(y)
-        return y
-    return eager_apply("gumbel_softmax", fn, (x,), {})
+
+@op_body("maxout")
+def _maxout(a, *, groups, axis):
+    ax = axis % a.ndim
+    c = a.shape[ax]
+    new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+    return a.reshape(new_shape).max(axis=ax + 1)
 
 
 def maxout(x, groups, axis=1, name=None):
-    def fn(a):
-        ax = axis % a.ndim
-        c = a.shape[ax]
-        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
-        return a.reshape(new_shape).max(axis=ax + 1)
-    return eager_apply("maxout", fn, (x,), {})
+    return op_call("maxout", _maxout, x, groups=groups, axis=axis)
+
+
+@op_body("glu")
+def _glu(a, *, axis):
+    a1, a2 = jnp.split(a, 2, axis=axis)
+    return a1 * jax.nn.sigmoid(a2)
 
 
 def glu(x, axis=-1, name=None):
-    def fn(a):
-        a1, a2 = jnp.split(a, 2, axis=axis)
-        return a1 * jax.nn.sigmoid(a2)
-    return eager_apply("glu", fn, (x,), {})
+    return op_call("glu", _glu, x, axis=axis)
+
+
+@op_body("swiglu")
+def _swiglu(a, *maybe_b):
+    if maybe_b:
+        return jax.nn.silu(a) * maybe_b[0]
+    a1, a2 = jnp.split(a, 2, axis=-1)
+    return jax.nn.silu(a1) * a2
 
 
 def swiglu(x, y=None, name=None):
     """SwiGLU (reference fused op: python/paddle/incubate/nn/functional/swiglu.py).
 
     Overridable by the Pallas fused kernel (paddle_tpu/kernels)."""
-    if y is not None:
-        return eager_apply("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y), {})
-    def fn(a):
-        a1, a2 = jnp.split(a, 2, axis=-1)
-        return jax.nn.silu(a1) * a2
-    return eager_apply("swiglu", fn, (x,), {})
+    args = (x,) if y is None else (x, y)
+    return op_call("swiglu", _swiglu, *args)

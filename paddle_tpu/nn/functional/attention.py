@@ -14,7 +14,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply, OPS
+from ...core.dispatch import eager_apply, op_body, op_call, OPS
 from ...core.tensor import Tensor
 
 
@@ -61,12 +61,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     from ...core import random as _rng
     dk = _rng.next_key() if (dropout_p > 0.0 and training) else None
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
-    return eager_apply(
-        "scaled_dot_product_attention",
-        lambda *xs: OPS["scaled_dot_product_attention"](
-            *xs, causal=is_causal, dropout_p=dropout_p if training else 0.0,
-            dropout_key=dk),
-        args, {})
+    return op_call(
+        "scaled_dot_product_attention", _sdpa_reference, *args,
+        causal=is_causal, dropout_p=dropout_p if training else 0.0,
+        dropout_key=dk)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
@@ -80,11 +78,10 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
         from ...core import random as _rng
         p = dropout if training else 0.0
         dk = _rng.next_key() if p > 0.0 else None
-        return eager_apply(
-            "flash_attention_with_probs",
-            lambda *xs: _sdpa_reference(*xs, causal=causal, dropout_p=p,
-                                        dropout_key=dk, return_probs=True),
-            (query, key, value), {})
+        return op_call(
+            "flash_attention_with_probs", _sdpa_reference,
+            query, key, value, causal=causal, dropout_p=p,
+            dropout_key=dk, return_probs=True)
     out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
     return out, None
 
@@ -140,30 +137,37 @@ def rope(q, k, position_ids=None, cos=None, sin=None, theta=10000.0, name=None):
         args = (q, k, cos, sin)
     else:
         args = (q, k) + ((position_ids,) if position_ids is not None else ())
-    return eager_apply(
-        "rope", lambda *xs: OPS["rope"](*xs, theta=theta), args, {})
+    return op_call("rope", _rope_reference, *args, theta=theta)
+
+
+@op_body("rope_tables")
+def _rope_tables(pos, *, head_dim, theta):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    freqs = pos.astype(jnp.float32)[..., None] * inv_freq[None, None, :]
+    return jnp.cos(freqs), jnp.sin(freqs)
 
 
 def rope_tables(seq_len_or_positions, head_dim, theta=10000.0):
     """Precompute RoPE cos/sin tables of shape [b|1, s, head_dim/2]."""
-    def fn(pos):
-        inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
-        freqs = pos.astype(jnp.float32)[..., None] * inv_freq[None, None, :]
-        return jnp.cos(freqs), jnp.sin(freqs)
     if isinstance(seq_len_or_positions, int):
-        pos = jnp.arange(seq_len_or_positions, dtype=jnp.float32)[None, :]
-        return eager_apply("rope_tables", fn, (Tensor(pos),), {})
-    return eager_apply("rope_tables", fn, (seq_len_or_positions,), {})
+        pos = Tensor(jnp.arange(seq_len_or_positions, dtype=jnp.float32)[None, :])
+    else:
+        pos = seq_len_or_positions
+    return op_call("rope_tables", _rope_tables, pos, head_dim=head_dim,
+                   theta=theta)
+
+
+@op_body("sequence_mask")
+def _sequence_mask(lens, *, maxlen, dtype):
+    m = maxlen if maxlen is not None else int(lens.max())
+    r = jnp.arange(m)
+    return (r[None, :] < lens[..., None]).astype(dtype)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from ...core.dtype import to_jax_dtype
-
-    def fn(lens):
-        m = maxlen if maxlen is not None else int(lens.max())
-        r = jnp.arange(m)
-        return (r[None, :] < lens[..., None]).astype(to_jax_dtype(dtype))
-    return eager_apply("sequence_mask", fn, (x,), {})
+    return op_call("sequence_mask", _sequence_mask, x, maxlen=maxlen,
+                   dtype=to_jax_dtype(dtype))
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
@@ -183,45 +187,49 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     """
     if dropout:
         raise NotImplementedError("flash_attn_unpadded: dropout TODO")
+    return op_call("flash_attn_unpadded", _flash_attn_unpadded,
+                   query, key, value, cu_seqlens_q, cu_seqlens_k,
+                   scale=scale, causal=bool(causal),
+                   return_softmax=bool(return_softmax))
 
-    def fn(q, k, v, cu_q, cu_k):
-        tq, h, d = q.shape
-        tk = k.shape[0]
-        hkv = k.shape[1]
-        if h != hkv:
-            rep = h // hkv
-            k2 = jnp.repeat(k, rep, axis=1)
-            v2 = jnp.repeat(v, rep, axis=1)
-        else:
-            k2, v2 = k, v
-        s = scale if scale is not None else 1.0 / math.sqrt(d)
-        seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right")
-        seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right")
-        logits = jnp.einsum("qhd,khd->hqk", q, k2) * s
-        mask = seg_q[:, None] == seg_k[None, :]
-        if causal:
-            # end-aligned per-segment causality (the flash-attn varlen
-            # convention): query at in-segment position pq sees keys up to
-            # pq + (len_k - len_q), so a 1-token decode query attends its
-            # whole KV segment even when the q/k packings differ
-            z_q = jnp.zeros((1,), cu_q.dtype)
-            starts_q = jnp.concatenate([z_q, cu_q])
-            starts_k = jnp.concatenate([z_q.astype(cu_k.dtype), cu_k])
-            lens_q = (starts_q[1:] - starts_q[:-1])[seg_q]
-            lens_k = (starts_k[1:] - starts_k[:-1])[seg_k]
-            pos_q = jnp.arange(tq) - starts_q[seg_q]
-            pos_k = jnp.arange(tk) - starts_k[seg_k]
-            limit = pos_q[:, None] + (lens_k[None, :] - lens_q[:, None])
-            mask = mask & (pos_k[None, :] <= limit)
-        logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
-        out = jnp.einsum("hqk,khd->qhd", probs, v2)
-        if return_softmax:
-            return out, probs
-        return out
 
-    return eager_apply("flash_attn_unpadded", fn,
-                       (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+@op_body("flash_attn_unpadded")
+def _flash_attn_unpadded(q, k, v, cu_q, cu_k, *, scale, causal,
+                         return_softmax):
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    hkv = k.shape[1]
+    if h != hkv:
+        rep = h // hkv
+        k2 = jnp.repeat(k, rep, axis=1)
+        v2 = jnp.repeat(v, rep, axis=1)
+    else:
+        k2, v2 = k, v
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    seg_q = jnp.searchsorted(cu_q, jnp.arange(tq), side="right")
+    seg_k = jnp.searchsorted(cu_k, jnp.arange(tk), side="right")
+    logits = jnp.einsum("qhd,khd->hqk", q, k2) * s
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        # end-aligned per-segment causality (the flash-attn varlen
+        # convention): query at in-segment position pq sees keys up to
+        # pq + (len_k - len_q), so a 1-token decode query attends its
+        # whole KV segment even when the q/k packings differ
+        z_q = jnp.zeros((1,), cu_q.dtype)
+        starts_q = jnp.concatenate([z_q, cu_q])
+        starts_k = jnp.concatenate([z_q.astype(cu_k.dtype), cu_k])
+        lens_q = (starts_q[1:] - starts_q[:-1])[seg_q]
+        lens_k = (starts_k[1:] - starts_k[:-1])[seg_k]
+        pos_q = jnp.arange(tq) - starts_q[seg_q]
+        pos_k = jnp.arange(tk) - starts_k[seg_k]
+        limit = pos_q[:, None] + (lens_k[None, :] - lens_q[:, None])
+        mask = mask & (pos_k[None, :] <= limit)
+    logits = jnp.where(mask[None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(q.dtype)
+    out = jnp.einsum("hqk,khd->qhd", probs, v2)
+    if return_softmax:
+        return out, probs
+    return out
 
 
 flash_attn_varlen_func = flash_attn_unpadded

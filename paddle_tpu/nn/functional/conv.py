@@ -104,11 +104,58 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
 
 
-def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
-                    groups, nd, data_format, output_size=None):
+def _conv_transpose_body(a, w, *maybe_b, nd, stride, dilation, out_pad, pad,
+                         groups, channel_last, output_size):
     """Transposed conv as an lhs-dilated conv with a flipped, axis-swapped
     kernel — the exact gradient-of-conv formulation XLA optimizes well.
     Verified numerically against torch.conv_transpose2d (incl. groups)."""
+    k = [w.shape[2 + i] for i in range(nd)]
+    eff_pad = [
+        (dilation[i] * (k[i] - 1) - pad[i][0],
+         dilation[i] * (k[i] - 1) - pad[i][1] + out_pad[i])
+        for i in range(nd)
+    ]
+    flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[nd]
+    lhs = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    rhs = "OI" + spatial
+    ch_ax = -1 if channel_last else 1
+
+    def one_group(xi, wi):
+        wi = jnp.swapaxes(wi[flip], 0, 1)  # [in,out,*k] -> flipped [out,in,*k]
+        dn = lax.conv_dimension_numbers(xi.shape, wi.shape, (lhs, rhs, lhs))
+        return lax.conv_general_dilated(
+            xi, wi, window_strides=(1,) * nd, padding=eff_pad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
+
+    if groups == 1:
+        out = one_group(a, w)
+    else:
+        xs = jnp.split(a, groups, axis=ch_ax)
+        ws = jnp.split(w, groups, axis=0)
+        out = jnp.concatenate([one_group(xi, wi) for xi, wi in zip(xs, ws)],
+                              axis=ch_ax)
+    if output_size is not None:
+        tgt = tuple(int(s) for s in output_size)
+        sl = [slice(None)] * out.ndim
+        for i in range(nd):
+            ax = (1 + i) if channel_last else (2 + i)
+            sl[ax] = slice(0, tgt[i])
+        out = out[tuple(sl)]
+    if maybe_b:
+        b = maybe_b[0]
+        shape = [1] * out.ndim
+        shape[ch_ax] = b.shape[0]
+        out = out + b.reshape(shape)
+    return out
+
+
+for _nd in (1, 2, 3):
+    OPS.setdefault(f"conv{_nd}d_transpose", _conv_transpose_body)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, nd, data_format, output_size=None):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     stride = _pair(stride, nd)
     dilation = _pair(dilation, nd)
@@ -119,50 +166,13 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
             pad = [(0, 0)] * nd
         else:
             raise NotImplementedError("SAME padding for conv_transpose")
-
-    def fn(a, w, *maybe_b):
-        k = [w.shape[2 + i] for i in range(nd)]
-        eff_pad = [
-            (dilation[i] * (k[i] - 1) - pad[i][0],
-             dilation[i] * (k[i] - 1) - pad[i][1] + out_pad[i])
-            for i in range(nd)
-        ]
-        flip = (slice(None), slice(None)) + (slice(None, None, -1),) * nd
-        spatial = {1: "W", 2: "HW", 3: "DHW"}[nd]
-        lhs = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
-        rhs = "OI" + spatial
-        ch_ax = -1 if channel_last else 1
-
-        def one_group(xi, wi):
-            wi = jnp.swapaxes(wi[flip], 0, 1)  # [in,out,*k] -> flipped [out,in,*k]
-            dn = lax.conv_dimension_numbers(xi.shape, wi.shape, (lhs, rhs, lhs))
-            return lax.conv_general_dilated(
-                xi, wi, window_strides=(1,) * nd, padding=eff_pad,
-                lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn)
-
-        if groups == 1:
-            out = one_group(a, w)
-        else:
-            xs = jnp.split(a, groups, axis=ch_ax)
-            ws = jnp.split(w, groups, axis=0)
-            out = jnp.concatenate([one_group(xi, wi) for xi, wi in zip(xs, ws)],
-                                  axis=ch_ax)
-        if output_size is not None:
-            tgt = tuple(int(s) for s in output_size)
-            sl = [slice(None)] * out.ndim
-            for i in range(nd):
-                ax = (1 + i) if channel_last else (2 + i)
-                sl[ax] = slice(0, tgt[i])
-            out = out[tuple(sl)]
-        if maybe_b:
-            b = maybe_b[0]
-            shape = [1] * out.ndim
-            shape[ch_ax] = b.shape[0]
-            out = out + b.reshape(shape)
-        return out
-
     args = (x, weight) if bias is None else (x, weight, bias)
-    return eager_apply(f"conv{nd}d_transpose", fn, args, {})
+    return op_call(
+        f"conv{nd}d_transpose", _conv_transpose_body, *args, nd=nd,
+        stride=stride, dilation=dilation, out_pad=out_pad, pad=tuple(pad),
+        groups=groups, channel_last=channel_last,
+        output_size=tuple(int(s) for s in output_size)
+        if output_size is not None else None)
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,

@@ -1,14 +1,20 @@
-"""Loss functionals (analog of python/paddle/nn/functional/loss.py)."""
+"""Loss functionals (analog of python/paddle/nn/functional/loss.py).
+
+All losses are registry-routed (op_body/op_call, core/dispatch.py) so
+``override_kernel`` reaches them like PD_REGISTER_KERNEL replacements do in
+the reference (paddle/phi/core/kernel_registry.h:196). Optional tensor
+inputs (class weights, normalizers) ride as trailing positional arrays.
+"""
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply
+from ...core.dispatch import op_body, op_call
 from ...core.tensor import Tensor
 
 
-def _reduce(loss, reduction):
+def _reduce_arr(loss, reduction):
     if reduction == "mean":
         return loss.mean()
     if reduction == "sum":
@@ -16,52 +22,52 @@ def _reduce(loss, reduction):
     return loss
 
 
+@op_body("cross_entropy")
+def _cross_entropy(logits, lbl, *maybe_w, axis, ignore_index, reduction,
+                   soft_label, use_softmax, label_smoothing):
+    """Softmax cross entropy (reference: python/paddle/nn/functional/loss.py
+    cross_entropy; SPMD-parallel variant lives in distributed mp_layers)."""
+    ax = axis % logits.ndim
+    logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
+        jnp.maximum(logits, 1e-30))
+    if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+        soft = lbl
+        if label_smoothing > 0:
+            n = logits.shape[ax]
+            soft = soft * (1 - label_smoothing) + label_smoothing / n
+        loss = -(soft * logp).sum(axis=ax)
+    else:
+        lbl_ = lbl
+        if lbl_.ndim == logits.ndim:  # trailing 1 dim
+            lbl_ = jnp.squeeze(lbl_, axis=ax)
+        valid = lbl_ != ignore_index
+        safe = jnp.where(valid, lbl_, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+        picked = jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0:
+            smooth_loss = -logp.mean(axis=ax)
+            loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+        else:
+            loss = -picked
+        if maybe_w:
+            w = maybe_w[0][safe]
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = (jnp.sum(maybe_w[0][safe] * valid) if maybe_w
+                     else jnp.maximum(valid.sum(), 1))
+            return loss.sum() / denom
+    return _reduce_arr(loss, reduction)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
                   name=None):
-    """Softmax cross entropy (reference: python/paddle/nn/functional/loss.py
-    cross_entropy; SPMD-parallel variant lives in distributed mp_layers)."""
-
-    def fn(logits, lbl, *maybe_w):
-        ax = axis % logits.ndim
-        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax else jnp.log(
-            jnp.maximum(logits, 1e-30))
-        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
-            soft = lbl
-            if label_smoothing > 0:
-                n = logits.shape[ax]
-                soft = soft * (1 - label_smoothing) + label_smoothing / n
-            loss = -(soft * logp).sum(axis=ax)
-        else:
-            lbl_ = lbl
-            if lbl_.ndim == logits.ndim:  # trailing 1 dim
-                lbl_ = jnp.squeeze(lbl_, axis=ax)
-            n = logits.shape[ax]
-            valid = lbl_ != ignore_index
-            safe = jnp.where(valid, lbl_, 0).astype(jnp.int32)
-            picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
-            picked = jnp.squeeze(picked, axis=ax)
-            if label_smoothing > 0:
-                smooth_loss = -logp.mean(axis=ax)
-                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
-            else:
-                loss = -picked
-            if maybe_w:
-                w = maybe_w[0][safe]
-                loss = loss * w
-            loss = jnp.where(valid, loss, 0.0)
-            if reduction == "mean":
-                denom = (jnp.sum(maybe_w[0][safe] * valid) if maybe_w
-                         else jnp.maximum(valid.sum(), 1))
-                return loss.sum() / denom
-        if reduction == "mean":
-            return loss.mean()
-        if reduction == "sum":
-            return loss.sum()
-        return loss
-
     args = [input, label] + ([weight] if weight is not None else [])
-    return eager_apply("cross_entropy", fn, tuple(args), {})
+    return op_call("cross_entropy", _cross_entropy, *args, axis=axis,
+                   ignore_index=ignore_index, reduction=reduction,
+                   soft_label=bool(soft_label), use_softmax=bool(use_softmax),
+                   label_smoothing=label_smoothing)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
@@ -74,196 +80,288 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     return loss
 
 
-def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    def fn(logp, lbl, *maybe_w):
-        valid = lbl != ignore_index
-        safe = jnp.where(valid, lbl, 0).astype(jnp.int32)
-        picked = jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else
-                                     jnp.expand_dims(safe, 1), axis=1)
-        loss = -jnp.squeeze(picked, axis=1)
-        if maybe_w:
-            loss = loss * maybe_w[0][safe]
-        loss = jnp.where(valid, loss, 0.0)
-        if reduction == "mean":
-            denom = jnp.sum(maybe_w[0][safe] * valid) if maybe_w else jnp.maximum(valid.sum(), 1)
-            return loss.sum() / denom
-        return _reduce_arr(loss, reduction)
-    args = [input, label] + ([weight] if weight is not None else [])
-    return eager_apply("nll_loss", fn, tuple(args), {})
-
-
-def _reduce_arr(loss, reduction):
+@op_body("nll_loss")
+def _nll_loss(logp, lbl, *maybe_w, ignore_index, reduction):
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else
+                                 jnp.expand_dims(safe, 1), axis=1)
+    loss = -jnp.squeeze(picked, axis=1)
+    if maybe_w:
+        loss = loss * maybe_w[0][safe]
+    loss = jnp.where(valid, loss, 0.0)
     if reduction == "mean":
-        return loss.mean()
-    if reduction == "sum":
-        return loss.sum()
-    return loss
+        denom = jnp.sum(maybe_w[0][safe] * valid) if maybe_w else jnp.maximum(valid.sum(), 1)
+        return loss.sum() / denom
+    return _reduce_arr(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call("nll_loss", _nll_loss, *args, ignore_index=ignore_index,
+                   reduction=reduction)
+
+
+@op_body("mse_loss")
+def _mse_loss(a, b, *, reduction):
+    return _reduce_arr(jnp.square(a - b), reduction)
 
 
 def mse_loss(input, label, reduction="mean", name=None):
-    return eager_apply("mse_loss",
-                       lambda a, b: _reduce_arr(jnp.square(a - b), reduction), (input, label), {})
+    return op_call("mse_loss", _mse_loss, input, label, reduction=reduction)
+
+
+@op_body("l1_loss")
+def _l1_loss(a, b, *, reduction):
+    return _reduce_arr(jnp.abs(a - b), reduction)
 
 
 def l1_loss(input, label, reduction="mean", name=None):
-    return eager_apply("l1_loss",
-                       lambda a, b: _reduce_arr(jnp.abs(a - b), reduction), (input, label), {})
+    return op_call("l1_loss", _l1_loss, input, label, reduction=reduction)
+
+
+@op_body("smooth_l1_loss")
+def _smooth_l1_loss(a, b, *, reduction, delta):
+    d = jnp.abs(a - b)
+    loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce_arr(loss, reduction)
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
-    def fn(a, b):
-        d = jnp.abs(a - b)
-        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
-        return _reduce_arr(loss, reduction)
-    return eager_apply("smooth_l1_loss", fn, (input, label), {})
+    return op_call("smooth_l1_loss", _smooth_l1_loss, input, label,
+                   reduction=reduction, delta=delta)
 
 
 def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
     return smooth_l1_loss(input, label, reduction, delta)
 
 
+@op_body("bce")
+def _bce(p, y, *maybe_w, reduction):
+    p = jnp.clip(p, 1e-12, 1 - 1e-7)
+    loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+    if maybe_w:
+        loss = loss * maybe_w[0]
+    return _reduce_arr(loss, reduction)
+
+
 def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
-    def fn(p, y, *maybe_w):
-        p = jnp.clip(p, 1e-12, 1 - 1e-7)
-        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
-        if maybe_w:
-            loss = loss * maybe_w[0]
-        return _reduce_arr(loss, reduction)
     args = [input, label] + ([weight] if weight is not None else [])
-    return eager_apply("bce", fn, tuple(args), {})
+    return op_call("bce", _bce, *args, reduction=reduction)
+
+
+@op_body("bce_with_logits")
+def _bce_with_logits(z, y, *rest, has_weight, has_pos_weight, reduction):
+    i = 0
+    w = pw = None
+    if has_weight:
+        w = rest[i]
+        i += 1
+    if has_pos_weight:
+        pw = rest[i]
+    # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+    if pw is not None:
+        log_w = (pw - 1) * y + 1
+        loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+    else:
+        loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+    if w is not None:
+        loss = loss * w
+    return _reduce_arr(loss, reduction)
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
                                      pos_weight=None, name=None):
-    def fn(z, y, *rest):
-        i = 0
-        w = pw = None
-        if weight is not None:
-            w = rest[i]; i += 1
-        if pos_weight is not None:
-            pw = rest[i]
-        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
-        if pw is not None:
-            log_w = (pw - 1) * y + 1
-            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
-        else:
-            loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
-        if w is not None:
-            loss = loss * w
-        return _reduce_arr(loss, reduction)
     args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
-    return eager_apply("bce_with_logits", fn, tuple(args), {})
+    return op_call("bce_with_logits", _bce_with_logits, *args,
+                   has_weight=weight is not None,
+                   has_pos_weight=pos_weight is not None, reduction=reduction)
+
+
+@op_body("kl_div")
+def _kl_div(logp, q, *, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(q) * (q - logp)
+    else:
+        loss = q * (jnp.log(jnp.maximum(q, 1e-30)) - logp)
+    if reduction == "batchmean":
+        return loss.sum() / logp.shape[0]
+    return _reduce_arr(loss, reduction)
 
 
 def kl_div(input, label, reduction="mean", log_target=False, name=None):
-    def fn(logp, q):
-        if log_target:
-            loss = jnp.exp(q) * (q - logp)
-        else:
-            loss = q * (jnp.log(jnp.maximum(q, 1e-30)) - logp)
-        if reduction == "batchmean":
-            return loss.sum() / logp.shape[0]
-        return _reduce_arr(loss, reduction)
-    return eager_apply("kl_div", fn, (input, label), {})
+    return op_call("kl_div", _kl_div, input, label, reduction=reduction,
+                   log_target=bool(log_target))
+
+
+@op_body("margin_ranking_loss")
+def _margin_ranking_loss(a, b, y, *, margin, reduction):
+    return _reduce_arr(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
 
 
 def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
-    def fn(a, b, y):
-        return _reduce_arr(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
-    return eager_apply("margin_ranking_loss", fn, (input, other, label), {})
+    return op_call("margin_ranking_loss", _margin_ranking_loss, input, other,
+                   label, margin=margin, reduction=reduction)
+
+
+@op_body("cosine_embedding_loss")
+def _cosine_embedding_loss(a, b, y, *, margin, reduction):
+    cos = (a * b).sum(-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce_arr(loss, reduction)
 
 
 def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
-    def fn(a, b, y):
-        cos = (a * b).sum(-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
-        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
-        return _reduce_arr(loss, reduction)
-    return eager_apply("cosine_embedding_loss", fn, (input1, input2, label), {})
+    return op_call("cosine_embedding_loss", _cosine_embedding_loss, input1,
+                   input2, label, margin=margin, reduction=reduction)
+
+
+@op_body("triplet_margin_loss")
+def _triplet_margin_loss(a, pos, neg, *, margin, p, epsilon, swap, reduction):
+    dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+    dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+    if swap:
+        dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+        dn = jnp.minimum(dn, dn2)
+    return _reduce_arr(jnp.maximum(dp - dn + margin, 0.0), reduction)
 
 
 def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
                         swap=False, reduction="mean", name=None):
-    def fn(a, pos, neg):
-        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
-        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
-        if swap:
-            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
-            dn = jnp.minimum(dn, dn2)
-        return _reduce_arr(jnp.maximum(dp - dn + margin, 0.0), reduction)
-    return eager_apply("triplet_margin_loss", fn, (input, positive, negative), {})
+    return op_call("triplet_margin_loss", _triplet_margin_loss, input,
+                   positive, negative, margin=margin, p=p, epsilon=epsilon,
+                   swap=bool(swap), reduction=reduction)
+
+
+@op_body("hinge_embedding_loss")
+def _hinge_embedding_loss(a, y, *, margin, reduction):
+    loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+    return _reduce_arr(loss, reduction)
 
 
 def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
-    def fn(a, y):
-        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
-        return _reduce_arr(loss, reduction)
-    return eager_apply("hinge_embedding_loss", fn, (input, label), {})
+    return op_call("hinge_embedding_loss", _hinge_embedding_loss, input,
+                   label, margin=margin, reduction=reduction)
+
+
+@op_body("square_error_cost")
+def _square_error_cost(a, b):
+    return jnp.square(a - b)
 
 
 def square_error_cost(input, label):
-    return eager_apply("square_error_cost", lambda a, b: jnp.square(a - b), (input, label), {})
+    return op_call("square_error_cost", _square_error_cost, input, label)
+
+
+@op_body("sigmoid_focal_loss")
+def _sigmoid_focal_loss(z, y, *maybe_n, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(z)
+    ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if maybe_n:
+        loss = loss / maybe_n[0]
+    return _reduce_arr(loss, reduction)
 
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
-    def fn(z, y, *maybe_n):
-        p = jax.nn.sigmoid(z)
-        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
-        p_t = p * y + (1 - p) * (1 - y)
-        a_t = alpha * y + (1 - alpha) * (1 - y)
-        loss = a_t * ((1 - p_t) ** gamma) * ce
-        if maybe_n:
-            loss = loss / maybe_n[0]
-        return _reduce_arr(loss, reduction)
     args = [logit, label] + ([normalizer] if normalizer is not None else [])
-    return eager_apply("sigmoid_focal_loss", fn, tuple(args), {})
+    return op_call("sigmoid_focal_loss", _sigmoid_focal_loss, *args,
+                   alpha=alpha, gamma=gamma, reduction=reduction)
 
 
-def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
-             reduction="mean", norm_by_times=False):
+@op_body("ctc_loss")
+def _ctc_loss(lp, lbl, in_len, lbl_len, *, blank, reduction):
     """CTC via the dynamic-programming forward algorithm in pure lax
     (reference: paddle/phi/kernels/gpu/warpctc_kernel.cu → here an XLA scan)."""
     import jax.lax as lax
 
-    def fn(lp, lbl, in_len, lbl_len):
-        # lp: [T, B, C] log-probs; lbl: [B, S]
-        T, B, C = lp.shape
-        S = lbl.shape[1]
-        ext = jnp.full((B, 2 * S + 1), blank, dtype=lbl.dtype)
-        ext = ext.at[:, 1::2].set(lbl)  # blank-interleaved
-        L = 2 * S + 1
-        neg_inf = -1e30
-        alpha0 = jnp.full((B, L), neg_inf)
-        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
-        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+    # lp: [T, B, C] log-probs; lbl: [B, S]
+    T, B, C = lp.shape
+    S = lbl.shape[1]
+    ext = jnp.full((B, 2 * S + 1), blank, dtype=lbl.dtype)
+    ext = ext.at[:, 1::2].set(lbl)  # blank-interleaved
+    L = 2 * S + 1
+    neg_inf = -1e30
+    alpha0 = jnp.full((B, L), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
 
-        same_as_prev2 = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
-                                constant_values=True)
+    same_as_prev2 = jnp.pad(ext[:, 2:] == ext[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=True)
 
-        def step(alpha, lp_t):
-            a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
-            a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
-            a2 = jnp.where(same_as_prev2, neg_inf, a2)
-            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
-            emit = jnp.take_along_axis(lp_t, ext, axis=1)
-            new_alpha = merged + emit
-            return new_alpha, new_alpha
+    def step(alpha, lp_t):
+        a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=neg_inf)
+        a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=neg_inf)
+        a2 = jnp.where(same_as_prev2, neg_inf, a2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new_alpha = merged + emit
+        return new_alpha, new_alpha
 
-        _, alphas = lax.scan(step, alpha0, lp[1:])
-        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
-        t_idx = (in_len - 1).astype(jnp.int32)
-        final = alphas[t_idx, jnp.arange(B)]  # [B, L]
-        end1 = 2 * lbl_len.astype(jnp.int32)
-        end2 = 2 * lbl_len.astype(jnp.int32) - 1
-        ll = jnp.logaddexp(
-            jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0],
-            jnp.take_along_axis(final, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0])
-        loss = -ll
-        if reduction == "mean":
-            return (loss / jnp.maximum(lbl_len, 1)).mean()
-        return _reduce_arr(loss, reduction)
+    _, alphas = lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, L]
+    t_idx = (in_len - 1).astype(jnp.int32)
+    final = alphas[t_idx, jnp.arange(B)]  # [B, L]
+    end1 = 2 * lbl_len.astype(jnp.int32)
+    end2 = 2 * lbl_len.astype(jnp.int32) - 1
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(final, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0])
+    loss = -ll
+    if reduction == "mean":
+        return (loss / jnp.maximum(lbl_len, 1)).mean()
+    return _reduce_arr(loss, reduction)
 
-    return eager_apply("ctc_loss", fn, (log_probs, labels, input_lengths, label_lengths), {})
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    return op_call("ctc_loss", _ctc_loss, log_probs, labels, input_lengths,
+                   label_lengths, blank=blank, reduction=reduction)
+
+
+@op_body("fused_linear_cross_entropy")
+def _fused_linear_cross_entropy(h, w, lbl, *, chunk_size, transpose_weight,
+                                reduction, ignore_index):
+    from jax import lax
+
+    n, d = h.shape
+    chunk = min(chunk_size, n)
+    pad = (-n) % chunk
+    if pad:  # pad to a chunk multiple with ignored labels (no divisor
+        # search: a prime token count must not degrade to chunk=1)
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        lbl = jnp.concatenate(
+            [lbl, jnp.full((pad,), ignore_index, lbl.dtype)])
+        n = n + pad
+
+    def chunk_loss(h_c, l_c):
+        logits = (h_c @ w.T if transpose_weight else h_c @ w)
+        logits = logits.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = l_c != ignore_index
+        safe = jnp.where(valid, l_c, 0).astype(jnp.int32)
+        gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        tok = jnp.where(valid, lse - gold, 0.0)
+        return tok.sum(), valid.sum()
+
+    h_r = h.reshape(n // chunk, chunk, d)
+    l_r = lbl.reshape(n // chunk, chunk)
+
+    def body(carry, hl):
+        acc, cnt = carry
+        hc, lc = hl
+        s, c = jax.checkpoint(chunk_loss)(hc, lc)
+        return (acc + s, cnt + c), None
+
+    (total, count), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h_r, l_r))
+    if reduction == "mean":
+        return total / jnp.maximum(count, 1)
+    return total
 
 
 def fused_linear_cross_entropy(hidden, weight, label, chunk_size=1024,
@@ -280,52 +378,33 @@ def fused_linear_cross_entropy(hidden, weight, label, chunk_size=1024,
     hidden: [tokens, hidden]; weight: [hidden, vocab] (or [vocab, hidden]
     with transpose_weight=True, the tied-embedding layout); label: [tokens].
     """
-    from jax import lax
-
     if reduction not in ("mean", "sum"):
         raise ValueError(
             f"fused_linear_cross_entropy supports reduction='mean'|'sum', "
             f"got {reduction!r} (use cross_entropy for per-token losses)")
+    return op_call("fused_linear_cross_entropy", _fused_linear_cross_entropy,
+                   hidden, weight, label, chunk_size=chunk_size,
+                   transpose_weight=bool(transpose_weight),
+                   reduction=reduction, ignore_index=ignore_index)
 
-    def fn(h, w, lbl):
-        n, d = h.shape
-        chunk = min(chunk_size, n)
-        pad = (-n) % chunk
-        if pad:  # pad to a chunk multiple with ignored labels (no divisor
-            # search: a prime token count must not degrade to chunk=1)
-            h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
-            lbl = jnp.concatenate(
-                [lbl, jnp.full((pad,), ignore_index, lbl.dtype)])
-            n = n + pad
 
-        def chunk_loss(h_c, l_c):
-            logits = (h_c @ w.T if transpose_weight else h_c @ w)
-            logits = logits.astype(jnp.float32)
-            lse = jax.nn.logsumexp(logits, axis=-1)
-            valid = l_c != ignore_index
-            safe = jnp.where(valid, l_c, 0).astype(jnp.int32)
-            gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
-            tok = jnp.where(valid, lse - gold, 0.0)
-            return tok.sum(), valid.sum()
-
-        h_r = h.reshape(n // chunk, chunk, d)
-        l_r = lbl.reshape(n // chunk, chunk)
-
-        def body(carry, hl):
-            acc, cnt = carry
-            hc, lc = hl
-            s, c = jax.checkpoint(chunk_loss)(hc, lc)
-            return (acc + s, cnt + c), None
-
-        (total, count), _ = lax.scan(
-            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
-            (h_r, l_r))
-        if reduction == "mean":
-            return total / jnp.maximum(count, 1)
-        return total
-
-    return eager_apply("fused_linear_cross_entropy", fn,
-                       (hidden, weight, label), {})
+@op_body("margin_cross_entropy")
+def _margin_cross_entropy(lg, lbl, *, margin1, margin2, margin3, scale,
+                          return_softmax, reduction):
+    lbl = lbl.reshape(-1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(lbl, lg.shape[-1], dtype=lg.dtype)
+    theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, lg) * scale
+    logp = jax.nn.log_softmax(adjusted.astype(jnp.float32), axis=-1)
+    loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted.astype(jnp.float32), -1)
+    return loss
 
 
 def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
@@ -339,22 +418,51 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
             "margin_cross_entropy over a model-parallel group (class-dim "
             "sharded logits) is not implemented; use the local form or "
             "fleet ParallelCrossEntropy for the sharded softmax")
-    def fn(lg, lbl):
-        lbl = lbl.reshape(-1).astype(jnp.int32)
-        onehot = jax.nn.one_hot(lbl, lg.shape[-1], dtype=lg.dtype)
-        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
-        target = jnp.cos(margin1 * theta + margin2) - margin3
-        adjusted = jnp.where(onehot > 0, target, lg) * scale
-        logp = jax.nn.log_softmax(adjusted.astype(jnp.float32), axis=-1)
-        loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
-        if reduction == "mean":
-            loss = loss.mean()
-        elif reduction == "sum":
-            loss = loss.sum()
-        if return_softmax:
-            return loss, jax.nn.softmax(adjusted.astype(jnp.float32), -1)
-        return loss
-    return eager_apply("margin_cross_entropy", fn, (logits, label), {})
+    return op_call("margin_cross_entropy", _margin_cross_entropy, logits,
+                   label, margin1=margin1, margin2=margin2, margin3=margin3,
+                   scale=scale, return_softmax=bool(return_softmax),
+                   reduction=reduction)
+
+
+@op_body("hsigmoid_loss")
+def _hsigmoid_loss(x, lbl, w, *rest, num_classes, has_bias, has_path):
+    i = 0
+    b = None
+    if has_bias:
+        b = rest[i]
+        i += 1
+    if has_path:
+        tbl = rest[i]
+        code = rest[i + 1]
+        mask = (tbl >= 0).astype(x.dtype)
+        safe = jnp.maximum(tbl, 0).astype(jnp.int32)
+    else:
+        import math
+        c = lbl.reshape(-1).astype(jnp.int32)
+        n_leaf_base = num_classes - 1
+        depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+        node = c + n_leaf_base          # heap leaf slot
+        tbl_l, code_l, mask_l = [], [], []
+        for _ in range(depth):
+            parent = (node - 1) // 2
+            is_right = (node == 2 * parent + 2)
+            valid = node > 0
+            tbl_l.append(jnp.where(valid, parent, 0))
+            code_l.append(jnp.where(valid, is_right, False))
+            mask_l.append(valid)
+            node = jnp.where(valid, parent, 0)
+        safe = jnp.stack(tbl_l, axis=1)             # [N, L] node ids
+        code = jnp.stack(code_l, axis=1)
+        mask = jnp.stack(mask_l, axis=1).astype(x.dtype)
+
+    wp = w[safe]                                    # [N, L, D]
+    z = jnp.einsum("nd,nld->nl", x, wp)
+    if b is not None:
+        z = z + b.reshape(-1)[safe]
+    y = code.astype(x.dtype)
+    # stable BCE-with-logits on (z, code)
+    per_node = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+    return (per_node * mask).sum(axis=1, keepdims=True)
 
 
 def hsigmoid_loss(input, label, num_classes, weight, bias=None,
@@ -375,46 +483,6 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         raise NotImplementedError(
             "is_sparse=True selects the SelectedRows grad kernel in the "
             "reference; grads are dense here by design")
-
-    def fn(x, lbl, w, *rest):
-        i = 0
-        b = None
-        if bias is not None:
-            b = rest[i]
-            i += 1
-        if path_table is not None:
-            tbl = rest[i]
-            code = rest[i + 1]
-            mask = (tbl >= 0).astype(x.dtype)
-            safe = jnp.maximum(tbl, 0).astype(jnp.int32)
-        else:
-            import math
-            c = lbl.reshape(-1).astype(jnp.int32)
-            n_leaf_base = num_classes - 1
-            depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
-            node = c + n_leaf_base          # heap leaf slot
-            tbl_l, code_l, mask_l = [], [], []
-            for _ in range(depth):
-                parent = (node - 1) // 2
-                is_right = (node == 2 * parent + 2)
-                valid = node > 0
-                tbl_l.append(jnp.where(valid, parent, 0))
-                code_l.append(jnp.where(valid, is_right, False))
-                mask_l.append(valid)
-                node = jnp.where(valid, parent, 0)
-            safe = jnp.stack(tbl_l, axis=1)             # [N, L] node ids
-            code = jnp.stack(code_l, axis=1)
-            mask = jnp.stack(mask_l, axis=1).astype(x.dtype)
-
-        wp = w[safe]                                    # [N, L, D]
-        z = jnp.einsum("nd,nld->nl", x, wp)
-        if b is not None:
-            z = z + b.reshape(-1)[safe]
-        y = code.astype(x.dtype)
-        # stable BCE-with-logits on (z, code)
-        per_node = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
-        return (per_node * mask).sum(axis=1, keepdims=True)
-
     args = [input, label, weight]
     if bias is not None:
         args.append(bias)
@@ -422,7 +490,135 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         if path_code is None:
             raise ValueError("path_table requires path_code")
         args += [path_table, path_code]
-    return eager_apply("hsigmoid_loss", fn, tuple(args), {})
+    return op_call("hsigmoid_loss", _hsigmoid_loss, *args,
+                   num_classes=num_classes, has_bias=bias is not None,
+                   has_path=path_table is not None)
+
+
+@op_body("rnnt_loss")
+def _rnnt_loss(logits, labels, in_len, lab_len, *, blank, fastemit_lambda,
+               reduction):
+    import jax.lax as lax
+
+    b, t_max, u1, v = logits.shape
+    u_max = u1 - 1
+    lam = float(fastemit_lambda)
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+    def lattice_terms(logits):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        blank_lp = logp[..., blank]                        # [B,T,U+1]
+        lab = labels.astype(jnp.int32)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :u_max, :],
+            lab[:, None, :, None].repeat(t_max, 1), -1)[..., 0]
+        return blank_lp, emit_lp                            # [B,T,U]
+
+    t_idx = in_len.astype(jnp.int32) - 1
+    u_idx = lab_len.astype(jnp.int32)
+    u_range = jnp.arange(u1)[None, :]
+
+    def alpha_scan(blank_lp, emit_lp):
+        def step(alpha_prev, t):
+            from_blank = jnp.where(
+                t == 0,
+                jnp.where(u_range == 0, 0.0, neg_inf),
+                alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+
+            def emit_step(carry, u):
+                cur = jnp.logaddexp(
+                    from_blank[:, u], carry + emit_lp[:, t, u - 1])
+                return cur, cur
+
+            a0 = from_blank[:, 0]
+            _, rest = lax.scan(emit_step, a0, jnp.arange(1, u1))
+            alpha_t = jnp.concatenate(
+                [a0[:, None], jnp.moveaxis(rest, 0, 1)], 1)
+            return alpha_t, alpha_t
+
+        alpha0 = jnp.full((b, u1), neg_inf)
+        _, alphas = lax.scan(step, alpha0, jnp.arange(t_max))
+        return jnp.moveaxis(alphas, 0, 1)                  # [B,T,U+1]
+
+    def beta_scan(blank_lp, emit_lp):
+        # beta(t,u): log-prob of completing from (t,u). Terminal:
+        # beta(t_len-1, u_len) = blank there; outside the valid region -inf.
+        valid_u = u_range <= u_idx[:, None]
+
+        def step(beta_next, t):
+            # t runs T-1 .. 0; beta_next = beta(t+1, :)
+            at_term = (t == t_idx)
+            blank_t = blank_lp[:, t, :]
+            from_blank = jnp.where(
+                at_term[:, None],
+                jnp.where(u_range == u_idx[:, None], blank_t, neg_inf),
+                beta_next + blank_t)
+
+            def emit_step(carry, u):
+                # carry = beta(t, u+1); emit (t,u) -> (t,u+1)
+                cur = jnp.logaddexp(
+                    from_blank[:, u],
+                    carry + emit_lp[:, t, u])
+                return cur, cur
+
+            bU = from_blank[:, u1 - 1]
+            _, rest = lax.scan(emit_step, bU,
+                               jnp.arange(u1 - 2, -1, -1))
+            beta_t = jnp.concatenate(
+                [jnp.moveaxis(rest, 0, 1)[:, ::-1], bU[:, None]], 1)
+            beta_t = jnp.where(valid_u, beta_t, neg_inf)
+            return beta_t, beta_t
+
+        beta0 = jnp.full((b, u1), neg_inf)
+        _, betas = lax.scan(step, beta0,
+                            jnp.arange(t_max - 1, -1, -1))
+        return jnp.moveaxis(betas[::-1], 0, 1)             # [B,T,U+1]
+
+    @jax.custom_vjp
+    def nll_from_terms(blank_lp, emit_lp):
+        alphas = alpha_scan(blank_lp, emit_lp)
+        final = jnp.take_along_axis(jnp.take_along_axis(
+            alphas, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
+            u_idx[:, None], 1)[:, 0]
+        final_blank = jnp.take_along_axis(jnp.take_along_axis(
+            blank_lp, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
+            u_idx[:, None], 1)[:, 0]
+        return -(final + final_blank)
+
+    def nll_fwd(blank_lp, emit_lp):
+        alphas = alpha_scan(blank_lp, emit_lp)
+        betas = beta_scan(blank_lp, emit_lp)
+        nll = -betas[:, 0, 0]
+        return nll, (alphas, betas, blank_lp, emit_lp, nll)
+
+    def nll_bwd(res, ct):
+        alphas, betas, blank_lp, emit_lp, nll = res
+        logZ = -nll[:, None, None]
+        t_r = jnp.arange(t_max)[None, :, None]
+        u_r = jnp.arange(u1)[None, None, :]
+        in_t = t_r < in_len.astype(jnp.int32)[:, None, None]
+        # blank occupancy: alpha(t,u) + blank(t,u) + beta(t+1,u)
+        beta_tp1 = jnp.concatenate(
+            [betas[:, 1:, :], jnp.full((b, 1, u1), neg_inf)], 1)
+        at_term = (t_r == t_idx[:, None, None]) & \
+            (u_r == u_idx[:, None, None])
+        blank_next = jnp.where(at_term, 0.0, beta_tp1)
+        occ_blank = jnp.exp(jnp.clip(
+            alphas + blank_lp + blank_next - logZ, -80, 0)) * in_t
+        # emit occupancy: alpha(t,u) + emit(t,u) + beta(t,u+1)
+        occ_emit = jnp.exp(jnp.clip(
+            alphas[:, :, :u_max] + emit_lp + betas[:, :, 1:] - logZ,
+            -80, 0)) * in_t
+        # FastEmit: scale the emit-transition gradient by (1+lambda)
+        occ_emit = occ_emit * (1.0 + lam)
+        return (-occ_blank * ct[:, None, None],
+                -occ_emit * ct[:, None, None])
+
+    nll_from_terms.defvjp(nll_fwd, nll_bwd)
+
+    blank_lp, emit_lp = lattice_terms(logits)
+    nll = nll_from_terms(blank_lp, emit_lp)
+    return _reduce_arr(nll, reduction)
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
@@ -438,128 +634,6 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     gradient at every lattice node is scaled by (1 + lambda) — the loss
     VALUE itself is the standard transducer NLL.
     """
-    import jax.lax as lax
-
-    def fn(logits, labels, in_len, lab_len):
-        b, t_max, u1, v = logits.shape
-        u_max = u1 - 1
-        lam = float(fastemit_lambda)
-        neg_inf = jnp.asarray(-1e30, jnp.float32)
-
-        def lattice_terms(logits):
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-            blank_lp = logp[..., blank]                        # [B,T,U+1]
-            lab = labels.astype(jnp.int32)
-            emit_lp = jnp.take_along_axis(
-                logp[:, :, :u_max, :],
-                lab[:, None, :, None].repeat(t_max, 1), -1)[..., 0]
-            return blank_lp, emit_lp                            # [B,T,U]
-
-        t_idx = in_len.astype(jnp.int32) - 1
-        u_idx = lab_len.astype(jnp.int32)
-        u_range = jnp.arange(u1)[None, :]
-
-        def alpha_scan(blank_lp, emit_lp):
-            def step(alpha_prev, t):
-                from_blank = jnp.where(
-                    t == 0,
-                    jnp.where(u_range == 0, 0.0, neg_inf),
-                    alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
-
-                def emit_step(carry, u):
-                    cur = jnp.logaddexp(
-                        from_blank[:, u], carry + emit_lp[:, t, u - 1])
-                    return cur, cur
-
-                a0 = from_blank[:, 0]
-                _, rest = lax.scan(emit_step, a0, jnp.arange(1, u1))
-                alpha_t = jnp.concatenate(
-                    [a0[:, None], jnp.moveaxis(rest, 0, 1)], 1)
-                return alpha_t, alpha_t
-
-            alpha0 = jnp.full((b, u1), neg_inf)
-            _, alphas = lax.scan(step, alpha0, jnp.arange(t_max))
-            return jnp.moveaxis(alphas, 0, 1)                  # [B,T,U+1]
-
-        def beta_scan(blank_lp, emit_lp):
-            # beta(t,u): log-prob of completing from (t,u). Terminal:
-            # beta(t_len-1, u_len) = blank there; outside valid区 -inf.
-            valid_u = u_range <= u_idx[:, None]
-
-            def step(beta_next, t):
-                # t runs T-1 .. 0; beta_next = beta(t+1, :)
-                at_term = (t == t_idx)
-                blank_t = blank_lp[:, t, :]
-                from_blank = jnp.where(
-                    at_term[:, None],
-                    jnp.where(u_range == u_idx[:, None], blank_t, neg_inf),
-                    beta_next + blank_t)
-
-                def emit_step(carry, u):
-                    # carry = beta(t, u+1); emit (t,u) -> (t,u+1)
-                    cur = jnp.logaddexp(
-                        from_blank[:, u],
-                        carry + emit_lp[:, t, u])
-                    return cur, cur
-
-                bU = from_blank[:, u1 - 1]
-                _, rest = lax.scan(emit_step, bU,
-                                   jnp.arange(u1 - 2, -1, -1))
-                beta_t = jnp.concatenate(
-                    [jnp.moveaxis(rest, 0, 1)[:, ::-1], bU[:, None]], 1)
-                beta_t = jnp.where(valid_u, beta_t, neg_inf)
-                return beta_t, beta_t
-
-            beta0 = jnp.full((b, u1), neg_inf)
-            _, betas = lax.scan(step, beta0,
-                                jnp.arange(t_max - 1, -1, -1))
-            return jnp.moveaxis(betas[::-1], 0, 1)             # [B,T,U+1]
-
-        @jax.custom_vjp
-        def nll_from_terms(blank_lp, emit_lp):
-            alphas = alpha_scan(blank_lp, emit_lp)
-            final = jnp.take_along_axis(jnp.take_along_axis(
-                alphas, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
-                u_idx[:, None], 1)[:, 0]
-            final_blank = jnp.take_along_axis(jnp.take_along_axis(
-                blank_lp, t_idx[:, None, None].repeat(u1, 2), 1)[:, 0, :],
-                u_idx[:, None], 1)[:, 0]
-            return -(final + final_blank)
-
-        def nll_fwd(blank_lp, emit_lp):
-            alphas = alpha_scan(blank_lp, emit_lp)
-            betas = beta_scan(blank_lp, emit_lp)
-            nll = -betas[:, 0, 0]
-            return nll, (alphas, betas, blank_lp, emit_lp, nll)
-
-        def nll_bwd(res, ct):
-            alphas, betas, blank_lp, emit_lp, nll = res
-            logZ = -nll[:, None, None]
-            t_r = jnp.arange(t_max)[None, :, None]
-            u_r = jnp.arange(u1)[None, None, :]
-            in_t = t_r < in_len.astype(jnp.int32)[:, None, None]
-            # blank occupancy: alpha(t,u) + blank(t,u) + beta(t+1,u)
-            beta_tp1 = jnp.concatenate(
-                [betas[:, 1:, :], jnp.full((b, 1, u1), neg_inf)], 1)
-            at_term = (t_r == t_idx[:, None, None]) & \
-                (u_r == u_idx[:, None, None])
-            blank_next = jnp.where(at_term, 0.0, beta_tp1)
-            occ_blank = jnp.exp(jnp.clip(
-                alphas + blank_lp + blank_next - logZ, -80, 0)) * in_t
-            # emit occupancy: alpha(t,u) + emit(t,u) + beta(t,u+1)
-            occ_emit = jnp.exp(jnp.clip(
-                alphas[:, :, :u_max] + emit_lp + betas[:, :, 1:] - logZ,
-                -80, 0)) * in_t
-            # FastEmit: scale the emit-transition gradient by (1+lambda)
-            occ_emit = occ_emit * (1.0 + lam)
-            return (-occ_blank * ct[:, None, None],
-                    -occ_emit * ct[:, None, None])
-
-        nll_from_terms.defvjp(nll_fwd, nll_bwd)
-
-        blank_lp, emit_lp = lattice_terms(logits)
-        nll = nll_from_terms(blank_lp, emit_lp)
-        return _reduce_arr(nll, reduction)
-
-    return eager_apply("rnnt_loss", fn,
-                       (input, label, input_lengths, label_lengths), {})
+    return op_call("rnnt_loss", _rnnt_loss, input, label, input_lengths,
+                   label_lengths, blank=blank,
+                   fastemit_lambda=fastemit_lambda, reduction=reduction)

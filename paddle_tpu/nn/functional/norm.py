@@ -3,13 +3,14 @@
 XLA fuses these into surrounding ops; the fused rmsnorm Pallas kernel
 (paddle_tpu/kernels) overrides ``rms_norm`` on TPU when profitable
 (reference fused op: paddle/phi/kernels/fusion/gpu/fused_layernorm* and
-python/paddle/incubate/nn/functional/fused_rms_norm.py).
+python/paddle/incubate/nn/functional/fused_rms_norm.py). Every op here is
+registry-routed (op_body/op_call, core/dispatch.py).
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ...core.dispatch import eager_apply, op_call, OPS
+from ...core.dispatch import op_body, op_call, OPS
 from ...core.tensor import Tensor
 
 
@@ -58,8 +59,22 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     Dispatches through the op registry so the Pallas fused kernel
     (paddle_tpu/kernels/rms_norm.py) can override on TPU."""
     args = (x,) if weight is None else (x, weight)
-    return eager_apply(
-        "rms_norm", lambda *xs: OPS["rms_norm"](*xs, epsilon=epsilon), args, {})
+    return op_call("rms_norm", _rms_norm_reference, *args, epsilon=epsilon)
+
+
+@op_body("batch_norm")
+def _batch_norm(a, mean, var, *wb, channel_axis, epsilon, has_weight,
+                has_bias):
+    shape = [1] * a.ndim
+    shape[channel_axis] = a.shape[channel_axis]
+    out = (a - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    i = 0
+    if has_weight:
+        out = out * wb[i].reshape(shape)
+        i += 1
+    if has_bias:
+        out = out + wb[i].reshape(shape)
+    return out
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
@@ -71,125 +86,137 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     channel_axis = 1 if data_format.startswith("NC") else -1
     use_batch_stats = training and not use_global_stats
 
-    def fn(a, *wb):
-        axes = tuple(i for i in range(a.ndim) if i != (channel_axis % a.ndim))
-        if use_batch_stats:
-            mean = a.mean(axis=axes)
-            var = a.var(axis=axes)
-        else:
-            mean = running_mean._data
-            var = running_var._data
-        shape = [1] * a.ndim
-        shape[channel_axis] = a.shape[channel_axis]
-        out = (a - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape); i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out
-
     if use_batch_stats:
-        # update running stats in-place (buffer mutation; jit capture tracks it)
-        data = x._data
-        ca = channel_axis % data.ndim
-        axes = tuple(i for i in range(data.ndim) if i != ca)
-        bm = data.mean(axis=axes)
-        bv = data.var(axis=axes)
+        ca = channel_axis % x.ndim
+        axes = tuple(i for i in range(x.ndim) if i != ca)
+        from ...tensor.math import mean as _mean
+        from ...tensor.stat import var as _var_op
+        batch_mean = _mean(x, axis=list(axes))
+        batch_var = _var_op(x, axis=list(axes), unbiased=False)
+        # update running stats in-place (buffer mutation; jit capture
+        # tracks it); the running update uses the UNBIASED batch variance
         n = 1
         for i in axes:
-            n *= data.shape[i]
-        unbiased = bv * (n / max(n - 1, 1))
-        running_mean._inplace_update(momentum * running_mean._data + (1 - momentum) * bm)
-        running_var._inplace_update(momentum * running_var._data + (1 - momentum) * unbiased)
+            n *= x.shape[i]
+        unbiased = batch_var._data * (n / max(n - 1, 1))
+        running_mean._inplace_update(
+            momentum * running_mean._data + (1 - momentum) * batch_mean._data)
+        running_var._inplace_update(
+            momentum * running_var._data + (1 - momentum) * unbiased)
+        mean_t, var_t = batch_mean, batch_var
+    else:
+        mean_t, var_t = running_mean, running_var
 
-    args = [x] + [t for t in (weight, bias) if t is not None]
-    return eager_apply("batch_norm", fn, tuple(args), {})
+    args = [x, mean_t, var_t] + [t for t in (weight, bias) if t is not None]
+    return op_call("batch_norm", _batch_norm, *args,
+                   channel_axis=channel_axis, epsilon=epsilon,
+                   has_weight=weight is not None, has_bias=bias is not None)
+
+
+@op_body("group_norm")
+def _group_norm(a, *wb, num_groups, epsilon, channel_last, has_weight,
+                has_bias):
+    if channel_last:
+        a_t = jnp.moveaxis(a, -1, 1)
+    else:
+        a_t = a
+    n, c = a_t.shape[0], a_t.shape[1]
+    g = num_groups
+    grouped = a_t.reshape(n, g, c // g, *a_t.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = grouped.mean(axis=axes, keepdims=True)
+    var = grouped.var(axis=axes, keepdims=True)
+    outg = (grouped - mean) / jnp.sqrt(var + epsilon)
+    out = outg.reshape(a_t.shape)
+    shape = [1] * out.ndim
+    shape[1] = c
+    i = 0
+    if has_weight:
+        out = out * wb[i].reshape(shape)
+        i += 1
+    if has_bias:
+        out = out + wb[i].reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
                data_format="NCHW", name=None):
     channel_last = not data_format.startswith("NC")
-
-    def fn(a, *wb):
-        if channel_last:
-            a_t = jnp.moveaxis(a, -1, 1)
-        else:
-            a_t = a
-        n, c = a_t.shape[0], a_t.shape[1]
-        g = num_groups
-        grouped = a_t.reshape(n, g, c // g, *a_t.shape[2:])
-        axes = tuple(range(2, grouped.ndim))
-        mean = grouped.mean(axis=axes, keepdims=True)
-        var = grouped.var(axis=axes, keepdims=True)
-        outg = (grouped - mean) / jnp.sqrt(var + epsilon)
-        out = outg.reshape(a_t.shape)
-        shape = [1] * out.ndim
-        shape[1] = c
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape); i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
-        if channel_last:
-            out = jnp.moveaxis(out, 1, -1)
-        return out
-
     args = [x] + [t for t in (weight, bias) if t is not None]
-    return eager_apply("group_norm", fn, tuple(args), {})
+    return op_call("group_norm", _group_norm, *args, num_groups=num_groups,
+                   epsilon=epsilon, channel_last=channel_last,
+                   has_weight=weight is not None, has_bias=bias is not None)
+
+
+@op_body("instance_norm")
+def _instance_norm(a, *wb, eps, has_weight, has_bias):
+    axes = tuple(range(2, a.ndim))
+    mean = a.mean(axis=axes, keepdims=True)
+    var = a.var(axis=axes, keepdims=True)
+    out = (a - mean) / jnp.sqrt(var + eps)
+    shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+    i = 0
+    if has_weight:
+        out = out * wb[i].reshape(shape)
+        i += 1
+    if has_bias:
+        out = out + wb[i].reshape(shape)
+    return out
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
                   use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
                   name=None):
-    def fn(a, *wb):
-        axes = tuple(range(2, a.ndim))
-        mean = a.mean(axis=axes, keepdims=True)
-        var = a.var(axis=axes, keepdims=True)
-        out = (a - mean) / jnp.sqrt(var + eps)
-        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
-        i = 0
-        if weight is not None:
-            out = out * wb[i].reshape(shape); i += 1
-        if bias is not None:
-            out = out + wb[i].reshape(shape)
-        return out
-
     args = [x] + [t for t in (weight, bias) if t is not None]
-    return eager_apply("instance_norm", fn, tuple(args), {})
+    return op_call("instance_norm", _instance_norm, *args, eps=eps,
+                   has_weight=weight is not None, has_bias=bias is not None)
+
+
+@op_body("normalize")
+def _normalize(a, *, p, axis, epsilon):
+    n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+    return a / jnp.maximum(n, epsilon)
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
-    def fn(a):
-        n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
-        return a / jnp.maximum(n, epsilon)
-    return eager_apply("normalize", fn, (x,), {})
+    return op_call("normalize", _normalize, x, p=p, axis=axis,
+                   epsilon=epsilon)
+
+
+@op_body("local_response_norm")
+def _local_response_norm(a, *, size, alpha, beta, k, data_format):
+    ca = 1 if data_format.startswith("NC") else a.ndim - 1
+    sq = jnp.square(a)
+    moved = jnp.moveaxis(sq, ca, -1)
+    pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+    padded = jnp.pad(moved, pad)
+    csum = jnp.cumsum(padded, axis=-1)
+    csum = jnp.pad(csum, [(0, 0)] * (moved.ndim - 1) + [(1, 0)])
+    win = csum[..., size:] - csum[..., :-size]
+    win = jnp.moveaxis(win, -1, ca)
+    return a / jnp.power(k + alpha * win, beta)
 
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
                         name=None):
-    def fn(a):
-        ca = 1 if data_format.startswith("NC") else a.ndim - 1
-        sq = jnp.square(a)
-        moved = jnp.moveaxis(sq, ca, -1)
-        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
-        padded = jnp.pad(moved, pad)
-        csum = jnp.cumsum(padded, axis=-1)
-        csum = jnp.pad(csum, [(0, 0)] * (moved.ndim - 1) + [(1, 0)])
-        win = csum[..., size:] - csum[..., :-size]
-        win = jnp.moveaxis(win, -1, ca)
-        return a / jnp.power(k + alpha * win, beta)
-    return eager_apply("local_response_norm", fn, (x,), {})
+    return op_call("local_response_norm", _local_response_norm, x, size=size,
+                   alpha=alpha, beta=beta, k=k, data_format=data_format)
+
+
+@op_body("spectral_norm")
+def _spectral_norm(w, u_, v_, *, dim, power_iters, eps):
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(power_iters):
+        v_ = wm.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        u_ = wm @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + eps)
+    sigma = u_ @ wm @ v_
+    return w / sigma
 
 
 def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12, name=None):
-    def fn(w, u_, v_):
-        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
-        for _ in range(power_iters):
-            v_ = wm.T @ u_
-            v_ = v_ / (jnp.linalg.norm(v_) + eps)
-            u_ = wm @ v_
-            u_ = u_ / (jnp.linalg.norm(u_) + eps)
-        sigma = u_ @ wm @ v_
-        return w / sigma
-    return eager_apply("spectral_norm", fn, (weight, u, v), {})
+    return op_call("spectral_norm", _spectral_norm, weight, u, v, dim=dim,
+                   power_iters=power_iters, eps=eps)

@@ -8,8 +8,16 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from ...core.dispatch import eager_apply
+from ...core.dispatch import op_call, OPS
 from .conv import _pair
+
+
+def _register_nd(base, body):
+    """Register one shared body under each of the 1d/2d/3d op names (the
+    per-rank analog of the reference's per-op kernel registrations)."""
+    for nd in (1, 2, 3):
+        OPS.setdefault(f"{base}{nd}d", body)
+    return body
 
 
 def _window(kernel, stride, padding, nd, channel_last):
@@ -36,18 +44,57 @@ def _window(kernel, stride, padding, nd, channel_last):
     return dims, strides, padding_full, k
 
 
+def _max_pool_body(a, *, dims, strides, pad):
+    init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+        else jnp.iinfo(a.dtype).min
+    return lax.reduce_window(a, init, lax.max, dims, strides, pad)
+
+
+_register_nd("max_pool", _max_pool_body)
+
+
+def _max_pool_mask_body(a, *, nd, k, s, p):
+    n, c = a.shape[:2]
+    # pad explicitly with the dtype minimum so argmax can NEVER
+    # select a padded cell (dilated_patches pads with 0, which
+    # outranks all-negative windows)
+    fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+        else jnp.iinfo(a.dtype).min
+    a = jnp.pad(a, [(0, 0), (0, 0)] + [(p[d], p[d]) for d in range(nd)],
+                constant_values=fill)
+    spatial = tuple(a.shape[2 + d] - 2 * p[d] for d in range(nd))
+    patches = lax.conv_general_dilated_patches(
+        a, filter_shape=k, window_strides=s,
+        padding=[(0, 0)] * nd,
+        precision=None)          # [N, C*prod(k), *out_spatial]
+    out_sp = patches.shape[2:]
+    ksz = 1
+    for v in k:
+        ksz *= v
+    patches = patches.reshape((n, c, ksz) + out_sp)
+    local = jnp.argmax(patches, axis=2)   # window-local flat idx
+    locals_nd = jnp.unravel_index(local, k)
+    flat = jnp.zeros_like(local)
+    for d in range(nd):
+        shape = [1] * (2 + nd)
+        shape[2 + d] = out_sp[d]
+        oi = jnp.arange(out_sp[d]).reshape(shape)
+        g = oi * s[d] - p[d] + locals_nd[d]
+        flat = flat * spatial[d] + g
+    return flat.astype(jnp.int32)
+
+
+for _nd in (1, 2, 3):
+    OPS.setdefault(f"max_pool{_nd}d_mask", _max_pool_mask_body)
+
+
 def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ceil_mode=False):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     dims, strides, pad, _ = _window(kernel, stride, padding, nd, channel_last)
 
-    def fn(a):
-        if isinstance(pad, str):
-            return lax.reduce_window(a, -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min,
-                                     lax.max, dims, strides, pad)
-        init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
-        return lax.reduce_window(a, init, lax.max, dims, strides, pad)
-
-    out = eager_apply(f"max_pool{nd}d", fn, (x,), {})
+    out = op_call(f"max_pool{nd}d", _max_pool_body, x, dims=dims,
+                  strides=strides,
+                  pad=pad if isinstance(pad, str) else tuple(pad))
     if return_mask:
         if channel_last:
             raise NotImplementedError(
@@ -59,60 +106,31 @@ def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ce
         k = _pair(kernel, nd)
         s = _pair(stride if stride is not None else kernel, nd)
         p = _pair(padding, nd)
-
-        def mask_fn(a):
-            n, c = a.shape[:2]
-            # pad explicitly with the dtype minimum so argmax can NEVER
-            # select a padded cell (dilated_patches pads with 0, which
-            # outranks all-negative windows)
-            fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
-                else jnp.iinfo(a.dtype).min
-            a = jnp.pad(a, [(0, 0), (0, 0)] + [(p[d], p[d])
-                                               for d in range(nd)],
-                        constant_values=fill)
-            spatial = tuple(a.shape[2 + d] - 2 * p[d] for d in range(nd))
-            patches = lax.conv_general_dilated_patches(
-                a, filter_shape=k, window_strides=s,
-                padding=[(0, 0)] * nd,
-                precision=None)          # [N, C*prod(k), *out_spatial]
-            out_sp = patches.shape[2:]
-            ksz = 1
-            for v in k:
-                ksz *= v
-            patches = patches.reshape((n, c, ksz) + out_sp)
-            local = jnp.argmax(patches, axis=2)   # window-local flat idx
-            locals_nd = jnp.unravel_index(local, k)
-            flat = jnp.zeros_like(local)
-            for d in range(nd):
-                shape = [1] * (2 + nd)
-                shape[2 + d] = out_sp[d]
-                oi = jnp.arange(out_sp[d]).reshape(shape)
-                g = oi * s[d] - p[d] + locals_nd[d]
-                flat = flat * spatial[d] + g
-            return flat.astype(jnp.int32)
-
-        mask = eager_apply(f"max_pool{nd}d_mask", mask_fn, (x,), {})
+        mask = op_call(f"max_pool{nd}d_mask", _max_pool_mask_body, x,
+                       nd=nd, k=k, s=s, p=p)
         return out, mask
     return out
+
+
+def _avg_pool_body(a, *, dims, strides, pad, k, exclusive):
+    summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad)
+    if exclusive or isinstance(pad, str):
+        ones = jnp.ones_like(a)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+_register_nd("avg_pool", _avg_pool_body)
 
 
 def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True, ceil_mode=False):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     dims, strides, pad, k = _window(kernel, stride, padding, nd, channel_last)
-
-    def fn(a):
-        summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad)
-        if exclusive and not isinstance(pad, str):
-            ones = jnp.ones_like(a)
-            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
-            return summed / counts
-        if isinstance(pad, str):
-            ones = jnp.ones_like(a)
-            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
-            return summed / counts
-        return summed / float(np.prod(k))
-
-    return eager_apply(f"avg_pool{nd}d", fn, (x,), {})
+    return op_call(f"avg_pool{nd}d", _avg_pool_body, x, dims=dims,
+                   strides=strides,
+                   pad=pad if isinstance(pad, str) else tuple(pad), k=k,
+                   exclusive=bool(exclusive))
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -145,33 +163,38 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     return _avg_pool(x, kernel_size, stride, padding, 3, data_format, exclusive, ceil_mode)
 
 
+def _adaptive_pool_body(a, *, nd, out_sz, op, channel_last):
+    spatial_off = 1 if channel_last else 2
+    res = a
+    for i in range(nd):
+        ax = spatial_off + i
+        in_sz = res.shape[ax]
+        o = out_sz[i] if out_sz[i] is not None else in_sz
+        if in_sz % o == 0:
+            # reshape trick: split axis into (o, in/o) and reduce
+            new_shape = res.shape[:ax] + (o, in_sz // o) + res.shape[ax + 1:]
+            res = res.reshape(new_shape)
+            res = (res.mean(axis=ax + 1) if op == "avg" else res.max(axis=ax + 1))
+        else:
+            # general case: gather per output index (torch-style bounds)
+            starts = (np.arange(o) * in_sz) // o
+            ends = -(-((np.arange(o) + 1) * in_sz) // o)
+            slices = [jnp.take(res, jnp.arange(s, e), axis=ax) for s, e in zip(starts, ends)]
+            red = [s.mean(axis=ax, keepdims=True) if op == "avg" else s.max(axis=ax, keepdims=True)
+                   for s in slices]
+            res = jnp.concatenate(red, axis=ax)
+    return res
+
+
+_register_nd("adaptive_avg_pool", _adaptive_pool_body)
+_register_nd("adaptive_max_pool", _adaptive_pool_body)
+
+
 def _adaptive_pool(x, output_size, nd, data_format, op):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     out_sz = _pair(output_size, nd)
-
-    def fn(a):
-        spatial_off = 1 if channel_last else 2
-        res = a
-        for i in range(nd):
-            ax = spatial_off + i
-            in_sz = res.shape[ax]
-            o = out_sz[i] if out_sz[i] is not None else in_sz
-            if in_sz % o == 0:
-                # reshape trick: split axis into (o, in/o) and reduce
-                new_shape = res.shape[:ax] + (o, in_sz // o) + res.shape[ax + 1:]
-                res = res.reshape(new_shape)
-                res = (res.mean(axis=ax + 1) if op == "avg" else res.max(axis=ax + 1))
-            else:
-                # general case: gather per output index (torch-style bounds)
-                starts = (np.arange(o) * in_sz) // o
-                ends = -(-((np.arange(o) + 1) * in_sz) // o)
-                slices = [jnp.take(res, jnp.arange(s, e), axis=ax) for s, e in zip(starts, ends)]
-                red = [s.mean(axis=ax, keepdims=True) if op == "avg" else s.max(axis=ax, keepdims=True)
-                       for s in slices]
-                res = jnp.concatenate(red, axis=ax)
-        return res
-
-    return eager_apply(f"adaptive_{op}_pool{nd}d", fn, (x,), {})
+    return op_call(f"adaptive_{op}_pool{nd}d", _adaptive_pool_body, x,
+                   nd=nd, out_sz=out_sz, op=op, channel_last=channel_last)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -198,26 +221,28 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
 
 
+def _lp_pool_body(a, *, p, dims, strides, pad):
+    s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
+    return s ** (1.0 / p)
+
+
+_register_nd("lp_pool", _lp_pool_body)
+
+
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
               data_format="NCL", name=None):
-    p = float(norm_type)
-
-    def fn(a):
-        dims, strides, pad, k = _window(kernel_size, stride, padding, 1, False)
-        s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
-        return s ** (1.0 / p)
-    return eager_apply("lp_pool1d", fn, (x,), {})
+    dims, strides, pad, k = _window(kernel_size, stride, padding, 1, False)
+    return op_call("lp_pool1d", _lp_pool_body, x, p=float(norm_type),
+                   dims=dims, strides=strides,
+                   pad=pad if isinstance(pad, str) else tuple(pad))
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
               data_format="NCHW", name=None):
-    p = float(norm_type)
-
-    def fn(a):
-        dims, strides, pad, k = _window(kernel_size, stride, padding, 2, False)
-        s = lax.reduce_window(jnp.abs(a) ** p, 0.0, lax.add, dims, strides, pad)
-        return s ** (1.0 / p)
-    return eager_apply("lp_pool2d", fn, (x,), {})
+    dims, strides, pad, k = _window(kernel_size, stride, padding, 2, False)
+    return op_call("lp_pool2d", _lp_pool_body, x, p=float(norm_type),
+                   dims=dims, strides=strides,
+                   pad=pad if isinstance(pad, str) else tuple(pad))
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
@@ -255,6 +280,67 @@ def _fractional_indices(in_size, out_size, pool, u):
     return starts, ends
 
 
+def _fractional_pool_body(a, *, nd, out_sizes, pools, u, return_mask):
+    spatial = a.shape[2:]
+    # per-dim static index grids: starts[i] + arange(max window), with
+    # an in-window validity mask — ONE gather per dim instead of one
+    # slice per output cell, so the HLO stays O(nd) regardless of
+    # output_size
+    idx_grids, masks = [], []
+    for d in range(nd):
+        starts, ends = _fractional_indices(
+            spatial[d], out_sizes[d], pools[d], u)
+        wmax = max(e - s_ for s_, e in zip(starts, ends))
+        base = np.asarray(starts)[:, None] + np.arange(wmax)[None, :]
+        valid = base < np.asarray(ends)[:, None]
+        idx_grids.append(jnp.asarray(np.clip(base, 0, spatial[d] - 1)))
+        masks.append(jnp.asarray(valid))
+    # gather successively along each spatial dim
+    g = a
+    for d in range(nd):
+        g = jnp.take(g, idx_grids[d].reshape(-1), axis=2 + 2 * d)
+        g = g.reshape(g.shape[:2 + 2 * d]
+                      + idx_grids[d].shape + g.shape[3 + 2 * d:])
+    # g: [N, C, o0, w0, o1, w1, ...]; build the joint validity mask
+    m = jnp.ones((), bool)
+    for d in range(nd):
+        shape = [1, 1]
+        for dd in range(nd):
+            shape += ([out_sizes[dd], masks[dd].shape[1]]
+                      if dd == d else [1, 1])
+        m = m & masks[d].reshape(shape)
+    fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+        else jnp.iinfo(a.dtype).min
+    gm = jnp.where(m, g, fill)
+    # flatten the window axes (every odd spatial axis) and reduce
+    perm = [0, 1] + [2 + 2 * d for d in range(nd)] \
+        + [3 + 2 * d for d in range(nd)]
+    gm = gm.transpose(perm)
+    lead = gm.shape[:2 + nd]
+    flat = gm.reshape(lead + (-1,))
+    out = flat.max(-1)
+    if not return_mask:
+        return out
+    am = flat.argmax(-1)                      # joint window-local idx
+    wsizes = [idx_grids[d].shape[1] for d in range(nd)]
+    locals_nd = jnp.unravel_index(am, wsizes)
+    glob = jnp.zeros_like(am)
+    for d in range(nd):
+        # recover the absolute input coordinate from the index grid
+        coord = jnp.take(
+            idx_grids[d].reshape(-1),
+            jnp.arange(out_sizes[d]).reshape(
+                [1, 1] + [out_sizes[dd] if dd == d else 1
+                          for dd in range(nd)]) * wsizes[d]
+            + locals_nd[d])
+        glob = glob * spatial[d] + coord
+    return out, glob.astype(jnp.int32)
+
+
+OPS.setdefault("fractional_max_pool2d", _fractional_pool_body)
+OPS.setdefault("fractional_max_pool3d", _fractional_pool_body)
+
+
 def _fractional_pool(x, output_size, kernel_size, random_u, return_mask,
                      nd, op_name):
     from ...core import random as _rng
@@ -268,64 +354,9 @@ def _fractional_pool(x, output_size, kernel_size, random_u, return_mask,
             raise ValueError("random_u must be in (0, 1)")
     out_sizes = _pair(output_size, nd)
     pools = _pair(kernel_size, nd) if kernel_size is not None else (0,) * nd
-
-    def fn(a):
-        spatial = a.shape[2:]
-        # per-dim static index grids: starts[i] + arange(max window), with
-        # an in-window validity mask — ONE gather per dim instead of one
-        # slice per output cell, so the HLO stays O(nd) regardless of
-        # output_size
-        idx_grids, masks = [], []
-        for d in range(nd):
-            starts, ends = _fractional_indices(
-                spatial[d], out_sizes[d], pools[d], u)
-            wmax = max(e - s_ for s_, e in zip(starts, ends))
-            base = np.asarray(starts)[:, None] + np.arange(wmax)[None, :]
-            valid = base < np.asarray(ends)[:, None]
-            idx_grids.append(jnp.asarray(np.clip(base, 0, spatial[d] - 1)))
-            masks.append(jnp.asarray(valid))
-        # gather successively along each spatial dim
-        g = a
-        for d in range(nd):
-            g = jnp.take(g, idx_grids[d].reshape(-1), axis=2 + 2 * d)
-            g = g.reshape(g.shape[:2 + 2 * d]
-                          + idx_grids[d].shape + g.shape[3 + 2 * d:])
-        # g: [N, C, o0, w0, o1, w1, ...]; build the joint validity mask
-        m = jnp.ones((), bool)
-        for d in range(nd):
-            shape = [1, 1]
-            for dd in range(nd):
-                shape += ([out_sizes[dd], masks[dd].shape[1]]
-                          if dd == d else [1, 1])
-            m = m & masks[d].reshape(shape)
-        fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
-            else jnp.iinfo(a.dtype).min
-        gm = jnp.where(m, g, fill)
-        # flatten the window axes (every odd spatial axis) and reduce
-        perm = [0, 1] + [2 + 2 * d for d in range(nd)] \
-            + [3 + 2 * d for d in range(nd)]
-        gm = gm.transpose(perm)
-        lead = gm.shape[:2 + nd]
-        flat = gm.reshape(lead + (-1,))
-        out = flat.max(-1)
-        if not return_mask:
-            return out
-        am = flat.argmax(-1)                      # joint window-local idx
-        wsizes = [idx_grids[d].shape[1] for d in range(nd)]
-        locals_nd = jnp.unravel_index(am, wsizes)
-        glob = jnp.zeros_like(am)
-        for d in range(nd):
-            # recover the absolute input coordinate from the index grid
-            coord = jnp.take(
-                idx_grids[d].reshape(-1),
-                jnp.arange(out_sizes[d]).reshape(
-                    [1, 1] + [out_sizes[dd] if dd == d else 1
-                              for dd in range(nd)]) * wsizes[d]
-                + locals_nd[d])
-            glob = glob * spatial[d] + coord
-        return out, glob.astype(jnp.int32)
-
-    return eager_apply(op_name, fn, (x,), {})
+    return op_call(op_name, _fractional_pool_body, x, nd=nd,
+                   out_sizes=out_sizes, pools=pools, u=u,
+                   return_mask=bool(return_mask))
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
@@ -343,34 +374,39 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                             return_mask, 3, "fractional_max_pool3d")
 
 
+def _max_unpool_body(a, idx, *, nd, k, s, p, output_size):
+    n, c = a.shape[:2]
+    o_sp = a.shape[2:]
+    if output_size is not None:
+        full = output_size
+    else:
+        full = tuple((o_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
+                     for d in range(nd))
+    numel_o = 1
+    for v in o_sp:
+        numel_o *= v
+    numel_f = 1
+    for v in full:
+        numel_f *= v
+    flat_vals = a.reshape(n * c, numel_o)
+    flat_idx = idx.reshape(n * c, numel_o).astype(jnp.int32)
+    out = jnp.zeros((n * c, numel_f), a.dtype)
+    rows = jnp.arange(n * c)[:, None]
+    out = out.at[rows, flat_idx].set(flat_vals)
+    return out.reshape((n, c) + full)
+
+
+_register_nd("max_unpool", _max_unpool_body)
+
+
 def _max_unpool_nd(x, indices, kernel_size, stride, padding, output_size,
                    nd, op_name):
     k = _pair(kernel_size, nd)
     s = _pair(stride if stride is not None else kernel_size, nd)
     p = _pair(padding, nd)
-
-    def fn(a, idx):
-        n, c = a.shape[:2]
-        o_sp = a.shape[2:]
-        if output_size is not None:
-            full = tuple(int(v) for v in output_size[-nd:])
-        else:
-            full = tuple((o_sp[d] - 1) * s[d] - 2 * p[d] + k[d]
-                         for d in range(nd))
-        numel_o = 1
-        for v in o_sp:
-            numel_o *= v
-        numel_f = 1
-        for v in full:
-            numel_f *= v
-        flat_vals = a.reshape(n * c, numel_o)
-        flat_idx = idx.reshape(n * c, numel_o).astype(jnp.int32)
-        out = jnp.zeros((n * c, numel_f), a.dtype)
-        rows = jnp.arange(n * c)[:, None]
-        out = out.at[rows, flat_idx].set(flat_vals)
-        return out.reshape((n, c) + full)
-
-    return eager_apply(op_name, fn, (x, indices), {})
+    return op_call(op_name, _max_unpool_body, x, indices, nd=nd, k=k, s=s,
+                   p=p, output_size=tuple(int(v) for v in output_size[-nd:])
+                   if output_size is not None else None)
 
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,

@@ -13,7 +13,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .core.dispatch import eager_apply
+from .core.dispatch import op_body, op_call
 
 __all__ = ["frame", "overlap_add", "stft", "istft"]
 
@@ -27,20 +27,23 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     if axis not in (0, -1):
         raise ValueError(f"frame supports axis 0 or -1, got {axis}")
 
-    def fn(a):
-        t = a.shape[-1] if axis == -1 else a.shape[0]
-        if frame_length > t:
-            raise ValueError(
-                f"frame_length {frame_length} > signal length {t}")
-        n = 1 + (t - frame_length) // hop_length
-        starts = jnp.arange(n) * hop_length
-        if axis == -1:
-            idx = starts[None, :] + jnp.arange(frame_length)[:, None]
-            return a[..., idx]                    # [..., L, n]
-        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
-        return a[idx]                             # [n, L, ...]
+    return op_call("frame", _frame, x, frame_length=frame_length,
+                   hop_length=hop_length, axis=axis)
 
-    return eager_apply("frame", fn, (x,), {})
+
+@op_body("frame")
+def _frame(a, *, frame_length, hop_length, axis):
+    t = a.shape[-1] if axis == -1 else a.shape[0]
+    if frame_length > t:
+        raise ValueError(
+            f"frame_length {frame_length} > signal length {t}")
+    n = 1 + (t - frame_length) // hop_length
+    starts = jnp.arange(n) * hop_length
+    if axis == -1:
+        idx = starts[None, :] + jnp.arange(frame_length)[:, None]
+        return a[..., idx]                    # [..., L, n]
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return a[idx]                             # [n, L, ...]
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
@@ -50,22 +53,25 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     if axis not in (0, -1):
         raise ValueError(f"overlap_add supports axis 0 or -1, got {axis}")
 
-    def fn(a):
-        if axis == -1:
-            length, n = a.shape[-2], a.shape[-1]
-            t = (n - 1) * hop_length + length
-            idx = jnp.arange(length)[:, None] + \
-                (jnp.arange(n) * hop_length)[None, :]      # [L, n]
-            out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
-            return out.at[..., idx].add(a)
-        length, n = a.shape[1], a.shape[0]
-        t = (n - 1) * hop_length + length
-        idx = (jnp.arange(n) * hop_length)[:, None] + \
-            jnp.arange(length)[None, :]                    # [n, L]
-        out = jnp.zeros((t,) + a.shape[2:], a.dtype)
-        return out.at[idx].add(a)
+    return op_call("overlap_add", _overlap_add, x, hop_length=hop_length,
+                   axis=axis)
 
-    return eager_apply("overlap_add", fn, (x,), {})
+
+@op_body("overlap_add")
+def _overlap_add(a, *, hop_length, axis):
+    if axis == -1:
+        length, n = a.shape[-2], a.shape[-1]
+        t = (n - 1) * hop_length + length
+        idx = jnp.arange(length)[:, None] + \
+            (jnp.arange(n) * hop_length)[None, :]      # [L, n]
+        out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
+        return out.at[..., idx].add(a)
+    length, n = a.shape[1], a.shape[0]
+    t = (n - 1) * hop_length + length
+    idx = (jnp.arange(n) * hop_length)[:, None] + \
+        jnp.arange(length)[None, :]                    # [n, L]
+    out = jnp.zeros((t,) + a.shape[2:], a.dtype)
+    return out.at[idx].add(a)
 
 
 def _window_array(window, n_fft, win_length=None):
@@ -91,23 +97,28 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
     win_length = win_length or n_fft
     w = _window_array(window, n_fft, win_length)
 
-    def fn(sig, w):
-        s = sig
-        if center:
-            pads = [(0, 0)] * (s.ndim - 1) + [(n_fft // 2, n_fft // 2)]
-            s = jnp.pad(s, pads, mode=pad_mode)
-        t = s.shape[-1]
-        n = 1 + (t - n_fft) // hop_length
-        starts = jnp.arange(n) * hop_length
-        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
-        frames = s[..., idx] * w                       # [.., n, n_fft]
-        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
-            else jnp.fft.fft(frames, axis=-1)
-        if normalized:
-            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
-        return jnp.swapaxes(spec, -1, -2)              # [.., freq, n]
+    return op_call("stft", _stft, x, w, n_fft=n_fft, hop_length=hop_length,
+                   center=center, pad_mode=pad_mode, normalized=normalized,
+                   onesided=onesided)
 
-    return eager_apply("stft", fn, (x, w), {})
+
+@op_body("stft")
+def _stft(sig, w, *, n_fft, hop_length, center, pad_mode, normalized,
+          onesided):
+    s = sig
+    if center:
+        pads = [(0, 0)] * (s.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        s = jnp.pad(s, pads, mode=pad_mode)
+    t = s.shape[-1]
+    n = 1 + (t - n_fft) // hop_length
+    starts = jnp.arange(n) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = s[..., idx] * w                       # [.., n, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+        else jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return jnp.swapaxes(spec, -1, -2)              # [.., freq, n]
 
 
 def istft(x, n_fft, hop_length=None, win_length=None, window=None,
@@ -124,36 +135,42 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
             "return_complex=True requires onesided=False (a one-sided "
             "spectrum can only reconstruct a real signal)")
 
-    def fn(spec, w):
-        s = jnp.swapaxes(spec, -1, -2)                 # [.., n, freq]
-        if normalized:
-            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        if onesided:
-            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
-        else:
-            frames = jnp.fft.ifft(s, axis=-1)
-            if not return_complex:
-                frames = frames.real
-        frames = frames * w                            # synthesis window
-        n = frames.shape[-2]
-        t = (n - 1) * hop_length + n_fft
-        idx = (jnp.arange(n) * hop_length)[:, None] + \
-            jnp.arange(n_fft)[None, :]                      # [n, n_fft]
-        out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
-        out = out.at[..., idx].add(frames)
-        env_dtype = frames.real.dtype if jnp.iscomplexobj(frames) \
-            else frames.dtype
-        env = jnp.zeros((t,), env_dtype).at[idx].add(
-            jnp.broadcast_to(w * w, (n, n_fft)).astype(env_dtype))
-        out = out / jnp.maximum(env, 1e-11)
-        if center:
-            # padded[pad + i] = original[i]: trim the leading pad, keep the
-            # tail OLA region (it reconstructs real samples)
-            out = out[..., n_fft // 2:]
-        if length is not None:
-            out = out[..., :length]
-        elif center:
-            out = out[..., :t - n_fft]
-        return out
+    return op_call("istft", _istft, x, w, n_fft=n_fft,
+                   hop_length=hop_length, center=center,
+                   normalized=normalized, onesided=onesided, length=length,
+                   return_complex=return_complex)
 
-    return eager_apply("istft", fn, (x, w), {})
+
+@op_body("istft")
+def _istft(spec, w, *, n_fft, hop_length, center, normalized, onesided,
+           length, return_complex):
+    s = jnp.swapaxes(spec, -1, -2)                 # [.., n, freq]
+    if normalized:
+        s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(s, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * w                            # synthesis window
+    n = frames.shape[-2]
+    t = (n - 1) * hop_length + n_fft
+    idx = (jnp.arange(n) * hop_length)[:, None] + \
+        jnp.arange(n_fft)[None, :]                      # [n, n_fft]
+    out = jnp.zeros(frames.shape[:-2] + (t,), frames.dtype)
+    out = out.at[..., idx].add(frames)
+    env_dtype = frames.real.dtype if jnp.iscomplexobj(frames) \
+        else frames.dtype
+    env = jnp.zeros((t,), env_dtype).at[idx].add(
+        jnp.broadcast_to(w * w, (n, n_fft)).astype(env_dtype))
+    out = out / jnp.maximum(env, 1e-11)
+    if center:
+        # padded[pad + i] = original[i]: trim the leading pad, keep the
+        # tail OLA region (it reconstructs real samples)
+        out = out[..., n_fft // 2:]
+    if length is not None:
+        out = out[..., :length]
+    elif center:
+        out = out[..., :t - n_fft]
+    return out

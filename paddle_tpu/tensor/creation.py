@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from ..core import random as _random
 from ..core.dtype import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
-from ..core.dispatch import primitive, eager_apply
+from ..core.dispatch import primitive, op_body, op_call
 
 _DEFAULT_FLOAT = "float32"
 
@@ -45,16 +45,34 @@ def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
+@op_body("zeros_like")
+def _zeros_like(a, *, dtype):
+    return jnp.zeros_like(a, dtype=dtype)
+
+
 def zeros_like(x, dtype=None, name=None):
-    return eager_apply("zeros_like", lambda a: jnp.zeros_like(a, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+    return op_call("zeros_like", _zeros_like, x,
+                   dtype=_dt(dtype, None) if dtype else None)
+
+
+@op_body("ones_like")
+def _ones_like(a, *, dtype):
+    return jnp.ones_like(a, dtype=dtype)
 
 
 def ones_like(x, dtype=None, name=None):
-    return eager_apply("ones_like", lambda a: jnp.ones_like(a, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+    return op_call("ones_like", _ones_like, x,
+                   dtype=_dt(dtype, None) if dtype else None)
+
+
+@op_body("full_like")
+def _full_like(a, *, fill_value, dtype):
+    return jnp.full_like(a, fill_value, dtype=dtype)
 
 
 def full_like(x, fill_value, dtype=None, name=None):
-    return eager_apply("full_like", lambda a: jnp.full_like(a, fill_value, dtype=_dt(dtype, None) if dtype else None), (x,), {})
+    return op_call("full_like", _full_like, x, fill_value=fill_value,
+                   dtype=_dt(dtype, None) if dtype else None)
 
 
 def empty_like(x, dtype=None, name=None):
@@ -137,11 +155,20 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1):
     return jnp.moveaxis(out, (-2, -1), (d1, d2)) if (d1, d2) != (out.ndim - 2, out.ndim - 1) else out
 
 
+@op_body("meshgrid")
+def _meshgrid(*xs):
+    return jnp.meshgrid(*xs, indexing="ij")
+
+
 def meshgrid(*args, **kwargs):
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = args[0]
-    outs = eager_apply("meshgrid", lambda *xs: jnp.meshgrid(*xs, indexing="ij"), tuple(args), {})
-    return list(outs)
+    return list(op_call("meshgrid", _meshgrid, *args))
+
+
+@op_body("assign")
+def _assign(a):
+    return a + 0 if jnp.issubdtype(jnp.result_type(a), jnp.inexact) else a
 
 
 def assign(x, output=None):
@@ -149,28 +176,48 @@ def assign(x, output=None):
     if output is not None:
         output._inplace_update(val)
         return output
-    return eager_apply("assign", lambda a: a + 0 if jnp.issubdtype(jnp.result_type(a), jnp.inexact) else a, (x,), {}) \
-        if isinstance(x, Tensor) else Tensor(val)
+    return op_call("assign", _assign, x) if isinstance(x, Tensor) \
+        else Tensor(val)
 
 
 def clone(x):
     return x.clone()
 
 
+@op_body("complex")
+def _complex(r, i):
+    return jax.lax.complex(r, i)
+
+
 def complex(real, imag):
-    return eager_apply("complex", lambda r, i: jax.lax.complex(r, i), (real, imag), {})
+    return op_call("complex", _complex, real, imag)
+
+
+@op_body("polar")
+def _polar(a, t):
+    return jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t))
 
 
 def polar(abs_t, angle):
-    return eager_apply("polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)), (abs_t, angle), {})
+    return op_call("polar", _polar, abs_t, angle)
+
+
+@op_body("real")
+def _real(a):
+    return jnp.real(a)
 
 
 def real(x):
-    return eager_apply("real", jnp.real, (x,), {})
+    return op_call("real", _real, x)
+
+
+@op_body("imag")
+def _imag(a):
+    return jnp.imag(a)
 
 
 def imag(x):
-    return eager_apply("imag", jnp.imag, (x,), {})
+    return op_call("imag", _imag, x)
 
 
 def cauchy_(x, loc=0, scale=1):
@@ -188,7 +235,8 @@ def geometric_(x, probs):
 
 
 def one_hot(x, num_classes, name=None):
-    return eager_apply("one_hot", lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), (x,), {})
+    from ..nn.functional.common import _one_hot
+    return op_call("one_hot", _one_hot, x, num_classes=num_classes)
 
 
 __all__ = [

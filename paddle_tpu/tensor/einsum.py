@@ -3,13 +3,18 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
+
+
+@op_body("einsum")
+def _einsum(*xs, equation):
+    return jnp.einsum(equation, *xs)
 
 
 def einsum(equation, *operands, name=None):
     if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
         operands = tuple(operands[0])
-    return eager_apply("einsum", lambda *xs: jnp.einsum(equation, *xs), operands, {})
+    return op_call("einsum", _einsum, *operands, equation=equation)
 
 
 __all__ = ["einsum"]

@@ -1,151 +1,257 @@
-"""Linear algebra ops (analog of python/paddle/tensor/linalg.py)."""
+"""Linear algebra ops (analog of python/paddle/tensor/linalg.py).
+
+All traceable ops are registry-routed (op_body/op_call, core/dispatch.py)
+so ``override_kernel`` reaches them; numpy-only eager fallbacks (eig,
+eigvals — no XLA lowering) stay host-side like the reference's CPU-only
+kernels.
+"""
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
 from .math import matmul, addmm, inverse  # re-export  # noqa: F401
 
 
+@op_body("bmm")
+def _bmm(a, b):
+    return jnp.matmul(a, b)
+
+
 def bmm(x, y, name=None):
-    return eager_apply("bmm", lambda a, b: jnp.matmul(a, b), (x, y), {})
+    return op_call("bmm", _bmm, x, y)
+
+
+@op_body("mm")
+def _mm(a, b):
+    return jnp.matmul(a, b)
 
 
 def mm(x, y, name=None):
-    return eager_apply("mm", lambda a, b: jnp.matmul(a, b), (x, y), {})
+    return op_call("mm", _mm, x, y)
+
+
+@op_body("mv")
+def _mv(a, v):
+    return jnp.matmul(a, v)
 
 
 def mv(x, vec, name=None):
-    return eager_apply("mv", lambda a, v: jnp.matmul(a, v), (x, vec), {})
+    return op_call("mv", _mv, x, vec)
+
+
+@op_body("dot")
+def _dot(a, b):
+    return jnp.sum(a * b, axis=-1)
 
 
 def dot(x, y, name=None):
-    return eager_apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), (x, y), {})
+    return op_call("dot", _dot, x, y)
+
+
+@op_body("t")
+def _t(a):
+    return a.T if a.ndim == 2 else a
 
 
 def t(x, name=None):
-    return eager_apply("t", lambda a: a.T if a.ndim == 2 else a, (x,), {})
+    return op_call("t", _t, x)
+
+
+@op_body("cross")
+def _cross(a, b, *, axis):
+    if axis == 9:  # paddle default: first axis with dim 3
+        axis = next(i for i, s in enumerate(a.shape) if s == 3)
+    return jnp.cross(a, b, axis=axis)
 
 
 def cross(x, y, axis=9, name=None):
-    def fn(a, b):
-        ax = axis
-        if ax == 9:  # paddle default: first axis with dim 3
-            ax = next(i for i, s in enumerate(a.shape) if s == 3)
-        return jnp.cross(a, b, axis=ax)
-    return eager_apply("cross", fn, (x, y), {})
+    return op_call("cross", _cross, x, y, axis=axis)
+
+
+@op_body("norm")
+def _norm(a, *, p, axis, keepdim):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if p is None:
+        if ax is None or (isinstance(ax, tuple) and len(ax) == 2):
+            return jnp.linalg.norm(a if ax is not None else a.reshape(-1),
+                                   ord="fro" if ax is not None else 2,
+                                   axis=ax, keepdims=keepdim)
+        return jnp.linalg.norm(a, ord=2, axis=ax, keepdims=keepdim)
+    if p in ("fro", "nuc"):
+        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+    if ax is None:
+        a = a.reshape(-1)
+        ax = 0
+    return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
 
 
 def norm(x, p=None, axis=None, keepdim=False, name=None):
-    def fn(a):
-        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-        if p is None:
-            if ax is None or (isinstance(ax, tuple) and len(ax) == 2):
-                return jnp.linalg.norm(a if ax is not None else a.reshape(-1),
-                                       ord="fro" if ax is not None else 2,
-                                       axis=ax, keepdims=keepdim)
-            return jnp.linalg.norm(a, ord=2, axis=ax, keepdims=keepdim)
-        if p in ("fro", "nuc"):
-            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
-        if ax is None:
-            a = a.reshape(-1)
-            ax = 0
-        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
-    return eager_apply("norm", fn, (x,), {})
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return op_call("norm", _norm, x, p=p, axis=ax, keepdim=keepdim)
+
+
+@op_body("vector_norm")
+def _vector_norm(a, *, p, axis, keepdim):
+    if axis is None:
+        a = a.reshape(-1)
+        return jnp.linalg.norm(a, ord=p, keepdims=keepdim)
+    return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
 
 
 def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
-    def fn(a):
-        if axis is None:
-            a = a.reshape(-1)
-            return jnp.linalg.norm(a, ord=p, keepdims=keepdim)
-        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-        return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
-    return eager_apply("vector_norm", fn, (x,), {})
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return op_call("vector_norm", _vector_norm, x, p=p, axis=ax,
+                   keepdim=keepdim)
+
+
+@op_body("matrix_norm")
+def _matrix_norm(a, *, p, axis, keepdim):
+    return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
 
 
 def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
-    return eager_apply("matrix_norm",
-                       lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim), (x,), {})
+    return op_call("matrix_norm", _matrix_norm, x, p=p, axis=tuple(axis),
+                   keepdim=keepdim)
+
+
+@op_body("dist")
+def _dist(a, b, *, p):
+    return jnp.linalg.norm((a - b).reshape(-1), ord=p)
 
 
 def dist(x, y, p=2, name=None):
-    return eager_apply("dist", lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), (x, y), {})
+    return op_call("dist", _dist, x, y, p=p)
+
+
+@op_body("cdist")
+def _cdist(a, b, *, p):
+    diff = a[..., :, None, :] - b[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
 
 
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
-    def fn(a, b):
-        diff = a[..., :, None, :] - b[..., None, :, :]
-        if p == 2.0:
-            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
-        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
-    return eager_apply("cdist", fn, (x, y), {})
+    return op_call("cdist", _cdist, x, y, p=p)
+
+
+@op_body("cond")
+def _cond(a, *, p):
+    return jnp.linalg.cond(a, p=p)
 
 
 def cond(x, p=None, name=None):
-    return eager_apply("cond", lambda a: jnp.linalg.cond(a, p=p), (x,), {})
+    return op_call("cond", _cond, x, p=p)
+
+
+@op_body("cholesky")
+def _cholesky(a, *, upper):
+    c = jnp.linalg.cholesky(a)
+    return jnp.swapaxes(c, -1, -2).conj() if upper else c
 
 
 def cholesky(x, upper=False, name=None):
-    return eager_apply("cholesky", lambda a: jnp.linalg.cholesky(
-        a) if not upper else jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2).conj(), (x,), {})
+    return op_call("cholesky", _cholesky, x, upper=bool(upper))
+
+
+@op_body("cholesky_solve")
+def _cholesky_solve(b, L, *, upper):
+    return jax.scipy.linalg.cho_solve((L, not upper), b)
 
 
 def cholesky_solve(x, y, upper=False, name=None):
-    def fn(b, L):
-        return jax.scipy.linalg.cho_solve((L, not upper), b)
-    return eager_apply("cholesky_solve", fn, (x, y), {})
+    return op_call("cholesky_solve", _cholesky_solve, x, y, upper=bool(upper))
+
+
+@op_body("det")
+def _det(a):
+    return jnp.linalg.det(a)
 
 
 def det(x, name=None):
-    return eager_apply("det", jnp.linalg.det, (x,), {})
+    return op_call("det", _det, x)
+
+
+@op_body("slogdet")
+def _slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return jnp.stack([sign, logdet])
 
 
 def slogdet(x, name=None):
-    def fn(a):
-        sign, logdet = jnp.linalg.slogdet(a)
-        return jnp.stack([sign, logdet])
-    return eager_apply("slogdet", fn, (x,), {})
+    return op_call("slogdet", _slogdet, x)
+
+
+@op_body("pinv")
+def _pinv(a, *, rcond, hermitian):
+    return jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian)
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
-    return eager_apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,), {})
+    return op_call("pinv", _pinv, x, rcond=rcond, hermitian=hermitian)
+
+
+@op_body("solve")
+def _solve(a, b):
+    return jnp.linalg.solve(a, b)
 
 
 def solve(x, y, name=None):
-    return eager_apply("solve", lambda a, b: jnp.linalg.solve(a, b), (x, y), {})
+    return op_call("solve", _solve, x, y)
+
+
+@op_body("triangular_solve")
+def _triangular_solve(a, b, *, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
 
 
 def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
-    def fn(a, b):
-        return jax.scipy.linalg.solve_triangular(
-            a, b, lower=not upper, trans=1 if transpose else 0,
-            unit_diagonal=unitriangular)
-    return eager_apply("triangular_solve", fn, (x, y), {})
+    return op_call("triangular_solve", _triangular_solve, x, y,
+                   upper=bool(upper), transpose=bool(transpose),
+                   unitriangular=bool(unitriangular))
+
+
+@op_body("lstsq")
+def _lstsq(a, b, *, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol, res, rank, sv
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
-    def fn(a, b):
-        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
-        return sol, res, rank, sv
-    return eager_apply("lstsq", fn, (x, y), {})
+    return op_call("lstsq", _lstsq, x, y, rcond=rcond)
+
+
+@op_body("svd")
+def _svd(a, *, full_matrices):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
 
 
 def svd(x, full_matrices=False, name=None):
-    def fn(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()  # paddle returns V not V^H
-    return tuple(eager_apply("svd", fn, (x,), {}))
+    return tuple(op_call("svd", _svd, x, full_matrices=bool(full_matrices)))
+
+
+@op_body("svdvals")
+def _svdvals(a):
+    return jnp.linalg.svd(a, compute_uv=False)
 
 
 def svdvals(x, name=None):
-    return eager_apply("svdvals", lambda a: jnp.linalg.svd(a, compute_uv=False), (x,), {})
+    return op_call("svdvals", _svdvals, x)
+
+
+@op_body("qr")
+def _qr(a, *, mode):
+    return jnp.linalg.qr(a, mode=mode)
 
 
 def qr(x, mode="reduced", name=None):
-    outs = eager_apply("qr", lambda a: jnp.linalg.qr(a, mode=mode), (x,), {})
+    outs = op_call("qr", _qr, x, mode=mode)
     return tuple(outs) if mode != "r" else outs
 
 
@@ -155,9 +261,13 @@ def eig(x, name=None):
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
 
+@op_body("eigh")
+def _eigh(a):
+    return jnp.linalg.eigh(a, symmetrize_input=True)
+
+
 def eigh(x, UPLO="L", name=None):
-    outs = eager_apply("eigh", lambda a: jnp.linalg.eigh(a, symmetrize_input=True), (x,), {})
-    return tuple(outs)
+    return tuple(op_call("eigh", _eigh, x))
 
 
 def eigvals(x, name=None):
@@ -165,65 +275,104 @@ def eigvals(x, name=None):
     return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
 
 
+@op_body("eigvalsh")
+def _eigvalsh(a):
+    return jnp.linalg.eigvalsh(a)
+
+
 def eigvalsh(x, UPLO="L", name=None):
-    return eager_apply("eigvalsh", jnp.linalg.eigvalsh, (x,), {})
+    return op_call("eigvalsh", _eigvalsh, x)
+
+
+@op_body("lu")
+def _lu(a):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+    return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
-    def fn(a):
-        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
-        return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
-    outs = eager_apply("lu", fn, (x,), {})
+    outs = op_call("lu", _lu, x)
     if get_infos:
         return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
     return tuple(outs)
 
 
+@op_body("matrix_power")
+def _matrix_power(a, *, n):
+    return jnp.linalg.matrix_power(a, n)
+
+
 def matrix_power(x, n, name=None):
-    return eager_apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,), {})
+    return op_call("matrix_power", _matrix_power, x, n=int(n))
+
+
+@op_body("matrix_rank")
+def _matrix_rank(a, *, tol):
+    return jnp.linalg.matrix_rank(a, rtol=tol)
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return eager_apply("matrix_rank",
-                       lambda a: jnp.linalg.matrix_rank(a, rtol=tol), (x,), {})
+    return op_call("matrix_rank", _matrix_rank, x, tol=tol)
+
+
+@op_body("multi_dot")
+def _multi_dot(*xs):
+    return jnp.linalg.multi_dot(list(xs))
 
 
 def multi_dot(x, name=None):
-    return eager_apply("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), tuple(x), {})
+    return op_call("multi_dot", _multi_dot, *x)
+
+
+@op_body("corrcoef")
+def _corrcoef(a, *, rowvar):
+    return jnp.corrcoef(a, rowvar=rowvar)
 
 
 def corrcoef(x, rowvar=True, name=None):
-    return eager_apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,), {})
+    return op_call("corrcoef", _corrcoef, x, rowvar=bool(rowvar))
+
+
+@op_body("cov")
+def _cov(a, *, rowvar, ddof, fweights, aweights):
+    return jnp.cov(a, rowvar=rowvar, ddof=ddof,
+                   fweights=fweights, aweights=aweights)
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
-    def fn(a):
-        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
-                       fweights=fweights._data if isinstance(fweights, Tensor) else fweights,
-                       aweights=aweights._data if isinstance(aweights, Tensor) else aweights)
-    return eager_apply("cov", fn, (x,), {})
+    return op_call(
+        "cov", _cov, x, rowvar=bool(rowvar), ddof=1 if ddof else 0,
+        fweights=fweights._data if isinstance(fweights, Tensor) else fweights,
+        aweights=aweights._data if isinstance(aweights, Tensor) else aweights)
+
+
+@op_body("householder_product")
+def _householder_product(a, t):
+    m, n = a.shape[-2], a.shape[-1]
+    eye = jnp.eye(m, dtype=a.dtype)
+    q = jnp.broadcast_to(eye, (*a.shape[:-2], m, m)).copy() if a.ndim > 2 else eye
+    for i in range(n):
+        v = jnp.concatenate([jnp.zeros((*a.shape[:-2], i), a.dtype),
+                             jnp.ones((*a.shape[:-2], 1), a.dtype),
+                             a[..., i + 1:, i]], axis=-1)
+        h = jnp.eye(m, dtype=a.dtype) - t[..., i:i + 1, None] * (v[..., :, None] * v[..., None, :])
+        q = q @ h
+    return q[..., :, :n]
 
 
 def householder_product(x, tau, name=None):
-    def fn(a, t):
-        m, n = a.shape[-2], a.shape[-1]
-        eye = jnp.eye(m, dtype=a.dtype)
-        q = jnp.broadcast_to(eye, (*a.shape[:-2], m, m)).copy() if a.ndim > 2 else eye
-        for i in range(n):
-            v = jnp.concatenate([jnp.zeros((*a.shape[:-2], i), a.dtype),
-                                 jnp.ones((*a.shape[:-2], 1), a.dtype),
-                                 a[..., i + 1:, i]], axis=-1)
-            h = jnp.eye(m, dtype=a.dtype) - t[..., i:i + 1, None] * (v[..., :, None] * v[..., None, :])
-            q = q @ h
-        return q[..., :, :n]
-    return eager_apply("householder_product", fn, (x, tau), {})
+    return op_call("householder_product", _householder_product, x, tau)
+
+
+@op_body("pca_lowrank")
+def _pca_lowrank(a, *, q, center):
+    k = q if q is not None else min(6, *a.shape[-2:])
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
-    def fn(a):
-        k = q if q is not None else min(6, *a.shape[-2:])
-        if center:
-            a = a - a.mean(axis=-2, keepdims=True)
-        u, s, vh = jnp.linalg.svd(a, full_matrices=False)
-        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
-    return tuple(eager_apply("pca_lowrank", fn, (x,), {}))
+    return tuple(op_call("pca_lowrank", _pca_lowrank, x, q=q,
+                         center=bool(center)))

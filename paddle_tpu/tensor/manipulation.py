@@ -1,4 +1,11 @@
-"""Shape/layout manipulation ops (analog of python/paddle/tensor/manipulation.py)."""
+"""Shape/layout manipulation ops (analog of python/paddle/tensor/manipulation.py).
+
+Every traceable op routes through the kernel registry (``op_body`` +
+``op_call``, core/dispatch.py) so ``override_kernel`` reaches it — the
+property the reference gets from PD_REGISTER_KERNEL
+(paddle/phi/core/kernel_registry.h:196). Host-side data-dependent-shape ops
+(nonzero, unique, masked_select) stay eager by design.
+"""
 from __future__ import annotations
 
 import builtins
@@ -9,7 +16,7 @@ import jax.numpy as jnp
 
 from ..core.dtype import to_jax_dtype
 from ..core.tensor import Tensor
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
 
 
 def _ints(v):
@@ -20,13 +27,22 @@ def _ints(v):
     return tuple(int(i.item()) if isinstance(i, Tensor) else int(i) for i in v)
 
 
+@op_body("cast")
+def _cast(a, *, dtype):
+    return a.astype(dtype)
+
+
 def cast(x, dtype):
-    return eager_apply("cast", lambda a: a.astype(to_jax_dtype(dtype)), (x,), {})
+    return op_call("cast", _cast, x, dtype=to_jax_dtype(dtype))
+
+
+@op_body("reshape")
+def _reshape(a, *, shape):
+    return jnp.reshape(a, shape)
 
 
 def reshape(x, shape, name=None):
-    shape = _ints(shape)
-    return eager_apply("reshape", lambda a: jnp.reshape(a, shape), (x,), {})
+    return op_call("reshape", _reshape, x, shape=_ints(shape))
 
 
 def reshape_(x, shape, name=None):
@@ -36,116 +52,182 @@ def reshape_(x, shape, name=None):
     return x
 
 
+@op_body("flatten")
+def _flatten(a, *, start_axis, stop_axis):
+    nd = a.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+    return jnp.reshape(a, new_shape)
+
+
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
-    def fn(a):
-        nd = a.ndim
-        s = start_axis % nd if nd else 0
-        e = stop_axis % nd if nd else 0
-        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
-        return jnp.reshape(a, new_shape)
-    return eager_apply("flatten", fn, (x,), {})
+    return op_call("flatten", _flatten, x,
+                   start_axis=start_axis, stop_axis=stop_axis)
+
+
+@op_body("squeeze")
+def _squeeze(a, *, axis):
+    if axis is None:
+        return jnp.squeeze(a)
+    ax = (axis,) if isinstance(axis, int) else axis
+    ax = tuple(a_ for a_ in ax if a.shape[a_ % a.ndim] == 1)
+    return jnp.squeeze(a, axis=ax) if ax else a
 
 
 def squeeze(x, axis=None, name=None):
-    def fn(a):
-        if axis is None:
-            return jnp.squeeze(a)
-        ax = _ints(axis)
-        ax = (ax,) if isinstance(ax, int) else ax
-        ax = tuple(a_ for a_ in ax if a.shape[a_ % a.ndim] == 1)
-        return jnp.squeeze(a, axis=ax) if ax else a
-    return eager_apply("squeeze", fn, (x,), {})
+    return op_call("squeeze", _squeeze, x,
+                   axis=None if axis is None else _ints(axis))
+
+
+@op_body("unsqueeze")
+def _unsqueeze(a, *, axis):
+    for i in sorted(axis):
+        a = jnp.expand_dims(a, i)
+    return a
 
 
 def unsqueeze(x, axis, name=None):
     ax = _ints(axis)
     ax = (ax,) if isinstance(ax, int) else ax
-    def fn(a):
-        for i in sorted(ax):
-            a = jnp.expand_dims(a, i)
-        return a
-    return eager_apply("unsqueeze", fn, (x,), {})
+    return op_call("unsqueeze", _unsqueeze, x, axis=ax)
+
+
+@op_body("transpose")
+def _transpose(a, *, perm):
+    return jnp.transpose(a, perm)
 
 
 def transpose(x, perm, name=None):
-    perm = _ints(perm)
-    return eager_apply("transpose", lambda a: jnp.transpose(a, perm), (x,), {})
+    return op_call("transpose", _transpose, x, perm=_ints(perm))
+
+
+@op_body("moveaxis")
+def _moveaxis(a, *, source, destination):
+    return jnp.moveaxis(a, source, destination)
 
 
 def moveaxis(x, source, destination, name=None):
-    return eager_apply("moveaxis", lambda a: jnp.moveaxis(a, _ints(source), _ints(destination)), (x,), {})
+    return op_call("moveaxis", _moveaxis, x,
+                   source=_ints(source), destination=_ints(destination))
+
+
+@op_body("swapaxes")
+def _swapaxes(a, *, axis1, axis2):
+    return jnp.swapaxes(a, axis1, axis2)
 
 
 def swapaxes(x, axis1, axis2, name=None):
-    return eager_apply("swapaxes", lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), (x,), {})
+    return op_call("swapaxes", _swapaxes, x,
+                   axis1=int(axis1), axis2=int(axis2))
+
+
+@op_body("roll")
+def _roll(a, *, shifts, axis):
+    return jnp.roll(a, shifts, axis=axis)
 
 
 def roll(x, shifts, axis=None, name=None):
-    return eager_apply("roll", lambda a: jnp.roll(a, _ints(shifts), axis=_ints(axis) if axis is not None else None), (x,), {})
+    return op_call("roll", _roll, x, shifts=_ints(shifts),
+                   axis=_ints(axis) if axis is not None else None)
+
+
+@op_body("flip")
+def _flip(a, *, axis):
+    return jnp.flip(a, axis=axis)
 
 
 def flip(x, axis, name=None):
-    return eager_apply("flip", lambda a: jnp.flip(a, axis=_ints(axis)), (x,), {})
+    return op_call("flip", _flip, x, axis=_ints(axis))
+
+
+@op_body("rot90")
+def _rot90(a, *, k, axes):
+    return jnp.rot90(a, k=k, axes=axes)
 
 
 def rot90(x, k=1, axes=(0, 1), name=None):
-    return eager_apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), {})
+    return op_call("rot90", _rot90, x, k=k, axes=tuple(axes))
+
+
+@op_body("concat")
+def _concat(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
 
 
 def concat(x, axis=0, name=None):
     axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-    return eager_apply("concat", lambda *xs: jnp.concatenate(xs, axis=axis), tuple(x), {})
+    return op_call("concat", _concat, *x, axis=axis)
+
+
+@op_body("stack")
+def _stack(*xs, axis):
+    return jnp.stack(xs, axis=axis)
 
 
 def stack(x, axis=0, name=None):
-    return eager_apply("stack", lambda *xs: jnp.stack(xs, axis=int(axis)), tuple(x), {})
+    return op_call("stack", _stack, *x, axis=int(axis))
+
+
+@op_body("split")
+def _split(a, *, num_or_sections, axis):
+    dim = a.shape[axis]
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(a, num_or_sections, axis=axis))
+    secs = list(num_or_sections)
+    n_unknown = builtins.sum(1 for s in secs if s < 0)
+    if n_unknown:
+        known = builtins.sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else dim - known for s in secs]
+    idx = np.cumsum(secs)[:-1].tolist()
+    return tuple(jnp.split(a, idx, axis=axis))
 
 
 def split(x, num_or_sections, axis=0, name=None):
     axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-
-    def fn(a):
-        dim = a.shape[axis]
-        if isinstance(num_or_sections, int):
-            return tuple(jnp.split(a, num_or_sections, axis=axis))
-        secs = [int(s) for s in num_or_sections]
-        n_unknown = builtins.sum(1 for s in secs if s < 0)
-        if n_unknown:
-            known = builtins.sum(s for s in secs if s >= 0)
-            secs = [s if s >= 0 else dim - known for s in secs]
-        idx = np.cumsum(secs)[:-1].tolist()
-        return tuple(jnp.split(a, idx, axis=axis))
-
-    return list(eager_apply("split", fn, (x,), {}))
+    nos = num_or_sections if isinstance(num_or_sections, int) \
+        else tuple(_ints(num_or_sections))
+    return list(op_call("split", _split, x, num_or_sections=nos, axis=axis))
 
 
 def chunk(x, chunks, axis=0, name=None):
     return split(x, int(chunks), axis)
 
 
+@op_body("unbind")
+def _unbind(a, *, axis, num):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(a, num, axis=axis))
+
+
 def unbind(x, axis=0, name=None):
-    n = x.shape[int(axis)]
-    def fn(a):
-        return tuple(jnp.squeeze(s, axis=int(axis)) for s in jnp.split(a, n, axis=int(axis)))
-    return list(eager_apply("unbind", fn, (x,), {}))
+    return list(op_call("unbind", _unbind, x,
+                        axis=int(axis), num=x.shape[int(axis)]))
 
 
 def unstack(x, axis=0, num=None, name=None):
     return unbind(x, axis)
 
 
+@op_body("tile")
+def _tile(a, *, repeat_times):
+    return jnp.tile(a, repeat_times)
+
+
 def tile(x, repeat_times, name=None):
-    return eager_apply("tile", lambda a: jnp.tile(a, _ints(repeat_times)), (x,), {})
+    return op_call("tile", _tile, x, repeat_times=_ints(repeat_times))
+
+
+@op_body("expand")
+def _expand(a, *, shape):
+    tgt = list(shape)
+    src = (1,) * (len(tgt) - a.ndim) + a.shape
+    tgt = [s if t == -1 else t for t, s in zip(tgt, src)]
+    return jnp.broadcast_to(a.reshape(src), tgt)
 
 
 def expand(x, shape, name=None):
-    shape = _ints(shape)
-    def fn(a):
-        tgt = list(shape)
-        src = (1,) * (len(tgt) - a.ndim) + a.shape
-        tgt = [s if t == -1 else t for t, s in zip(tgt, src)]
-        return jnp.broadcast_to(a.reshape(src), tgt)
-    return eager_apply("expand", fn, (x,), {})
+    return op_call("expand", _expand, x, shape=_ints(shape))
 
 
 def expand_as(x, y, name=None):
@@ -156,124 +238,167 @@ def broadcast_to(x, shape, name=None):
     return expand(x, shape)
 
 
+@op_body("broadcast_tensors")
+def _broadcast_tensors(*xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
 def broadcast_tensors(inputs, name=None):
-    outs = eager_apply("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), tuple(inputs), {})
-    return list(outs)
+    return list(op_call("broadcast_tensors", _broadcast_tensors, *inputs))
 
 
 def broadcast_shape(x_shape, y_shape):
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
 
 
+@op_body("slice")
+def _slice(a, *, axes, starts, ends):
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return a[tuple(idx)]
+
+
 def slice(x, axes, starts, ends, name=None):
-    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
-    def fn(a):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, s, e in zip(axes, starts, ends):
-            idx[ax] = builtins.slice(s, e)
-        return a[tuple(idx)]
-    return eager_apply("slice", fn, (x,), {})
+    return op_call("slice", _slice, x, axes=_ints(axes),
+                   starts=_ints(starts), ends=_ints(ends))
+
+
+@op_body("strided_slice")
+def _strided_slice(a, *, axes, starts, ends, strides):
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return a[tuple(idx)]
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
-    axes, starts, ends, strides = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
-    def fn(a):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, s, e, st in zip(axes, starts, ends, strides):
-            idx[ax] = builtins.slice(s, e, st)
-        return a[tuple(idx)]
-    return eager_apply("strided_slice", fn, (x,), {})
+    return op_call("strided_slice", _strided_slice, x, axes=_ints(axes),
+                   starts=_ints(starts), ends=_ints(ends),
+                   strides=_ints(strides))
+
+
+@op_body("crop")
+def _crop(a, *, shape, offsets):
+    idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
+                for i, (o, s) in enumerate(zip(offsets, shape)))
+    return a[idx]
 
 
 def crop(x, shape=None, offsets=None, name=None):
     shape = _ints(shape)
     offsets = _ints(offsets) if offsets is not None else (0,) * len(shape)
-    def fn(a):
-        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[i] - o))
-                    for i, (o, s) in enumerate(zip(offsets, shape)))
-        return a[idx]
-    return eager_apply("crop", fn, (x,), {})
+    return op_call("crop", _crop, x, shape=shape, offsets=offsets)
+
+
+@op_body("pad")
+def _pad(a, *, pad, mode, value, data_format):
+    nd = a.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to last len(pad)//2 spatial dims
+        # per data_format
+        width = [(0, 0)] * nd
+        spatial = len(pad) // 2
+        if data_format.endswith("C") and nd >= 3:  # NHWC-like: dims 1..nd-2
+            dims = list(range(1, 1 + spatial))
+        else:  # NCHW-like: spatial dims 2..
+            dims = list(range(nd - spatial, nd))
+        for j, d in enumerate(dims):
+            width[d] = (pad[2 * j], pad[2 * j + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(a, width, mode="constant", constant_values=value)
+    return jnp.pad(a, width, mode=jmode)
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
-    pad = _ints(pad)
+    return op_call("pad", _pad, x, pad=_ints(pad), mode=mode, value=value,
+                   data_format=data_format)
 
-    def fn(a):
-        nd = a.ndim
-        if len(pad) == 2 * nd:
-            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
-        else:
-            # paddle semantics: pad applies to last len(pad)//2 spatial dims per data_format
-            width = [(0, 0)] * nd
-            spatial = len(pad) // 2
-            if data_format.endswith("C") and nd >= 3:  # NHWC-like: spatial dims 1..nd-2
-                dims = list(range(1, 1 + spatial))
-            else:  # NCHW-like: spatial dims 2..
-                dims = list(range(nd - spatial, nd))
-            for j, d in enumerate(dims):
-                width[d] = (pad[2 * j], pad[2 * j + 1])
-        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
-                 "circular": "wrap"}[mode]
-        if jmode == "constant":
-            return jnp.pad(a, width, mode="constant", constant_values=value)
-        return jnp.pad(a, width, mode=jmode)
 
-    return eager_apply("pad", fn, (x,), {})
+@op_body("repeat_interleave")
+def _repeat_interleave(a, *, repeats, axis):
+    return jnp.repeat(a, repeats, axis=axis)
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
     r = repeats._data if isinstance(repeats, Tensor) else repeats
-    return eager_apply("repeat_interleave",
-                       lambda a: jnp.repeat(a, r, axis=axis), (x,), {})
+    return op_call("repeat_interleave", _repeat_interleave, x,
+                   repeats=r, axis=axis)
+
+
+@op_body("gather")
+def _gather(a, i, *, axis):
+    return jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis)
 
 
 def gather(x, index, axis=0, name=None):
     axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
-    return eager_apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis), (x, index), {})
+    return op_call("gather", _gather, x, index, axis=axis)
+
+
+@op_body("gather_nd")
+def _gather_nd(a, i):
+    idx = tuple(jnp.moveaxis(i, -1, 0))
+    return a[idx]
 
 
 def gather_nd(x, index, name=None):
-    def fn(a, i):
-        idx = tuple(jnp.moveaxis(i, -1, 0))
-        return a[idx]
-    return eager_apply("gather_nd", fn, (x, index), {})
+    return op_call("gather_nd", _gather_nd, x, index)
+
+
+@op_body("take_along_axis")
+def _take_along_axis(a, i, *, axis):
+    return jnp.take_along_axis(a, i, axis=axis)
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
-    return eager_apply("take_along_axis",
-                       lambda a, i: jnp.take_along_axis(a, i, axis=axis), (arr, indices), {})
+    return op_call("take_along_axis", _take_along_axis, arr, indices,
+                   axis=axis)
 
 
-def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
-                   broadcast=True, name=None):
-    def fn(a, i, v):
-        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
-        if reduce == "assign":
-            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
-        dims = list(range(a.ndim))
-        onehot_idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in dims])
-                      for d, s in enumerate(i.shape)]
-        full_idx = tuple(i if d == axis else jnp.broadcast_to(onehot_idx[d], i.shape)
-                         for d in dims)
-        if reduce in ("add", "sum"):
-            return a.at[full_idx].add(v)
-        if reduce in ("multiply", "mul"):
-            return a.at[full_idx].multiply(v)
-        if reduce == "amax":
-            return a.at[full_idx].max(v)
-        if reduce == "amin":
-            return a.at[full_idx].min(v)
-        raise ValueError(f"unknown reduce {reduce}")
-    return eager_apply("put_along_axis", fn, (arr, indices, values), {})
+@op_body("put_along_axis")
+def _put_along_axis(a, i, v, *, axis, reduce):
+    v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+    if reduce == "assign":
+        return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+    dims = list(range(a.ndim))
+    onehot_idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in dims])
+                  for d, s in enumerate(i.shape)]
+    full_idx = tuple(i if d == axis else jnp.broadcast_to(onehot_idx[d], i.shape)
+                     for d in dims)
+    if reduce in ("add", "sum"):
+        return a.at[full_idx].add(v)
+    if reduce in ("multiply", "mul"):
+        return a.at[full_idx].multiply(v)
+    if reduce == "amax":
+        return a.at[full_idx].max(v)
+    if reduce == "amin":
+        return a.at[full_idx].min(v)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    return op_call("put_along_axis", _put_along_axis, arr, indices, values,
+                   axis=axis, reduce=reduce)
+
+
+@op_body("scatter")
+def _scatter(a, i, u, *, overwrite):
+    i = i.reshape(-1)
+    if overwrite:
+        return a.at[i].set(u.astype(a.dtype))
+    return a.at[i].set(jnp.zeros_like(u, dtype=a.dtype)).at[i].add(
+        u.astype(a.dtype))
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
-    def fn(a, i, u):
-        i = i.reshape(-1)
-        if overwrite:
-            return a.at[i].set(u.astype(a.dtype))
-        return a.at[i].set(jnp.zeros_like(u, dtype=a.dtype)).at[i].add(u.astype(a.dtype))
-    return eager_apply("scatter", fn, (x, index, updates), {})
+    return op_call("scatter", _scatter, x, index, updates,
+                   overwrite=bool(overwrite))
 
 
 def scatter_(x, index, updates, overwrite=True, name=None):
@@ -283,44 +408,67 @@ def scatter_(x, index, updates, overwrite=True, name=None):
     return x
 
 
+@op_body("scatter_nd_add")
+def _scatter_nd_add(a, i, u):
+    idx = tuple(jnp.moveaxis(i, -1, 0))
+    return a.at[idx].add(u.astype(a.dtype))
+
+
 def scatter_nd_add(x, index, updates, name=None):
-    def fn(a, i, u):
-        idx = tuple(jnp.moveaxis(i, -1, 0))
-        return a.at[idx].add(u.astype(a.dtype))
-    return eager_apply("scatter_nd_add", fn, (x, index, updates), {})
+    return op_call("scatter_nd_add", _scatter_nd_add, x, index, updates)
+
+
+@op_body("scatter_nd")
+def _scatter_nd(i, u, *, shape):
+    zeros = jnp.zeros(shape, dtype=u.dtype)
+    idx = tuple(jnp.moveaxis(i, -1, 0))
+    return zeros.at[idx].add(u)
 
 
 def scatter_nd(index, updates, shape, name=None):
-    def fn(i, u):
-        zeros = jnp.zeros(_ints(shape), dtype=u.dtype)
-        idx = tuple(jnp.moveaxis(i, -1, 0))
-        return zeros.at[idx].add(u)
-    return eager_apply("scatter_nd", fn, (index, updates), {})
+    return op_call("scatter_nd", _scatter_nd, index, updates,
+                   shape=_ints(shape))
+
+
+@op_body("index_select")
+def _index_select(a, i, *, axis):
+    return jnp.take(a, i, axis=axis)
 
 
 def index_select(x, index, axis=0, name=None):
-    return eager_apply("index_select", lambda a, i: jnp.take(a, i, axis=int(axis)), (x, index), {})
+    return op_call("index_select", _index_select, x, index, axis=int(axis))
+
+
+@op_body("index_sample")
+def _index_sample(a, i):
+    return jnp.take_along_axis(a, i, axis=1)
 
 
 def index_sample(x, index, name=None):
-    return eager_apply("index_sample",
-                       lambda a, i: jnp.take_along_axis(a, i, axis=1), (x, index), {})
+    return op_call("index_sample", _index_sample, x, index)
+
+
+@op_body("index_add")
+def _index_add(a, i, v, *, axis):
+    idx = [builtins.slice(None)] * a.ndim
+    idx[axis] = i
+    return a.at[tuple(idx)].add(v.astype(a.dtype))
 
 
 def index_add(x, index, axis, value, name=None):
-    def fn(a, i, v):
-        idx = [builtins.slice(None)] * a.ndim
-        idx[int(axis)] = i
+    return op_call("index_add", _index_add, x, index, value, axis=int(axis))
+
+
+@op_body("index_put")
+def _index_put(a, v, *idx, accumulate):
+    if accumulate:
         return a.at[tuple(idx)].add(v.astype(a.dtype))
-    return eager_apply("index_add", fn, (x, index, value), {})
+    return a.at[tuple(idx)].set(v.astype(a.dtype))
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
-    def fn(a, v, *idx):
-        if accumulate:
-            return a.at[tuple(idx)].add(v.astype(a.dtype))
-        return a.at[tuple(idx)].set(v.astype(a.dtype))
-    return eager_apply("index_put", fn, (x, value, *indices), {})
+    return op_call("index_put", _index_put, x, value, *indices,
+                   accumulate=bool(accumulate))
 
 
 def masked_select(x, mask, name=None):
@@ -329,26 +477,33 @@ def masked_select(x, mask, name=None):
     return Tensor(x._data[np.asarray(mask._data if isinstance(mask, Tensor) else mask)])
 
 
+@op_body("masked_fill")
+def _masked_fill(a, m, *, value):
+    return jnp.where(m, jnp.asarray(value, dtype=a.dtype), a)
+
+
 def masked_fill(x, mask, value, name=None):
-    def fn(a, m):
-        v = value._data if isinstance(value, Tensor) else value
-        return jnp.where(m, jnp.asarray(v, dtype=a.dtype), a)
-    return eager_apply("masked_fill", fn, (x, mask), {})
+    v = value._data if isinstance(value, Tensor) else value
+    return op_call("masked_fill", _masked_fill, x, mask, value=v)
 
 
 def masked_scatter(x, mask, value, name=None):
     m = np.asarray(mask._data)
     v = value._data.reshape(-1)[: int(m.sum())]
-    out = x._data.copy() if hasattr(x._data, "copy") else x._data
     flat_mask = jnp.broadcast_to(mask._data, x._data.shape)
     idx = jnp.nonzero(flat_mask.reshape(-1))[0]
     return Tensor(x._data.reshape(-1).at[idx].set(v.astype(x._data.dtype)).reshape(x._data.shape))
 
 
+@op_body("where")
+def _where(c, a, b):
+    return jnp.where(c, a, b)
+
+
 def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
-    return eager_apply("where", lambda c, a, b: jnp.where(c, a, b), (condition, x, y), {})
+    return op_call("where", _where, condition, x, y)
 
 
 def nonzero(x, as_tuple=False):
@@ -388,54 +543,97 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
     raise NotImplementedError("unique_consecutive with axis")
 
 
+@op_body("as_complex")
+def _as_complex(a):
+    return jax.lax.complex(a[..., 0], a[..., 1])
+
+
 def as_complex(x, name=None):
-    return eager_apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), {})
+    return op_call("as_complex", _as_complex, x)
+
+
+@op_body("as_real")
+def _as_real(a):
+    return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
 
 
 def as_real(x, name=None):
-    return eager_apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,), {})
+    return op_call("as_real", _as_real, x)
+
+
+@op_body("atleast_1d")
+def _atleast_1d(a):
+    return jnp.atleast_1d(a)
 
 
 def atleast_1d(*inputs, name=None):
-    outs = [eager_apply("atleast_1d", jnp.atleast_1d, (x,), {}) for x in inputs]
+    outs = [op_call("atleast_1d", _atleast_1d, x) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+@op_body("atleast_2d")
+def _atleast_2d(a):
+    return jnp.atleast_2d(a)
 
 
 def atleast_2d(*inputs, name=None):
-    outs = [eager_apply("atleast_2d", jnp.atleast_2d, (x,), {}) for x in inputs]
+    outs = [op_call("atleast_2d", _atleast_2d, x) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+@op_body("atleast_3d")
+def _atleast_3d(a):
+    return jnp.atleast_3d(a)
 
 
 def atleast_3d(*inputs, name=None):
-    outs = [eager_apply("atleast_3d", jnp.atleast_3d, (x,), {}) for x in inputs]
+    outs = [op_call("atleast_3d", _atleast_3d, x) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+@op_body("view_dtype")
+def _view_dtype(a, *, dtype):
+    return a.view(dtype)
 
 
 def view(x, shape_or_dtype, name=None):
     if isinstance(shape_or_dtype, (list, tuple)):
         return reshape(x, shape_or_dtype)
-    return eager_apply("view_dtype", lambda a: a.view(to_jax_dtype(shape_or_dtype)), (x,), {})
+    return op_call("view_dtype", _view_dtype, x,
+                   dtype=to_jax_dtype(shape_or_dtype))
 
 
 def view_as(x, other, name=None):
     return reshape(x, other.shape)
 
 
+@op_body("tensordot")
+def _tensordot(a, b, *, axes):
+    return jnp.tensordot(a, b, axes=axes)
+
+
 def tensordot(x, y, axes=2, name=None):
     ax = axes
     if isinstance(ax, Tensor):
         ax = ax.tolist()
-    return eager_apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), (x, y), {})
+    if isinstance(ax, list):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return op_call("tensordot", _tensordot, x, y, axes=ax)
 
 
 def numel(x, name=None):
     return Tensor(jnp.asarray(x.size, dtype=jnp.int32))
 
 
+@op_body("shard_index")
+def _shard_index(i, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    in_shard = (i >= lo) & (i < hi)
+    return jnp.where(in_shard, i - lo, ignore_value)
+
+
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
-    def fn(i):
-        shard_size = (index_num + nshards - 1) // nshards
-        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
-        in_shard = (i >= lo) & (i < hi)
-        return jnp.where(in_shard, i - lo, ignore_value)
-    return eager_apply("shard_index", fn, (input,), {})
+    return op_call("shard_index", _shard_index, input, index_num=index_num,
+                   nshards=nshards, shard_id=shard_id,
+                   ignore_value=ignore_value)

@@ -10,7 +10,7 @@ from jax import lax
 
 from ..core.dtype import to_jax_dtype
 from ..core.tensor import Tensor
-from ..core.dispatch import primitive, eager_apply, op_call, OPS
+from ..core.dispatch import primitive, eager_apply, op_body, op_call, OPS
 
 # ---- binary elementwise ----
 
@@ -123,42 +123,73 @@ isposinf = _unop("isposinf", jnp.isposinf)
 isreal = _unop("isreal", jnp.isreal)
 
 
+@op_body("polygamma")
+def _polygamma(a, *, n):
+    return polygamma_fn(n, a)
+
+
 def polygamma(x, n, name=None):
-    return eager_apply("polygamma", lambda a: polygamma_fn(n, a), (x,), {})
+    return op_call("polygamma", _polygamma, x, n=n)
+
+
+@op_body("scale")
+def _scale(a, s, b, *, bias_after_scale):
+    out = a * s + b if bias_after_scale else (a + b) * s
+    return out.astype(a.dtype)
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    def fn(a, s, b):
-        out = a * s + b if bias_after_scale else (a + b) * s
-        return out.astype(a.dtype)
-    return eager_apply("scale", fn, (x, scale, bias), {})
+    return op_call("scale", _scale, x, scale, bias,
+                   bias_after_scale=bool(bias_after_scale))
+
+
+@op_body("clip")
+def _clip(a, *, min, max):
+    return jnp.clip(a, min, max)
 
 
 def clip(x, min=None, max=None, name=None):
-    def fn(a):
-        lo = min._data if isinstance(min, Tensor) else min
-        hi = max._data if isinstance(max, Tensor) else max
-        return jnp.clip(a, lo, hi)
-    return eager_apply("clip", fn, (x,), {})
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return op_call("clip", _clip, x, min=lo, max=hi)
+
+
+@op_body("lerp")
+def _lerp(a, b, w):
+    return a + w * (b - a)
 
 
 def lerp(x, y, weight, name=None):
-    return eager_apply("lerp", lambda a, b, w: a + w * (b - a), (x, y, weight), {})
+    return op_call("lerp", _lerp, x, y, weight)
+
+
+@op_body("nan_to_num")
+def _nan_to_num(a, *, nan, posinf, neginf):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
 
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return eager_apply("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (x,), {})
+    return op_call("nan_to_num", _nan_to_num, x, nan=nan, posinf=posinf,
+                   neginf=neginf)
+
+
+@op_body("stanh")
+def _stanh(a, *, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * a)
 
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return eager_apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,), {})
+    return op_call("stanh", _stanh, x, scale_a=scale_a, scale_b=scale_b)
+
+
+@op_body("multiplex")
+def _multiplex(idx, *xs):
+    stacked = jnp.stack(xs, axis=0)
+    return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
 
 
 def multiplex(inputs, index, name=None):
-    def fn(idx, *xs):
-        stacked = jnp.stack(xs, axis=0)
-        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
-    return eager_apply("multiplex", fn, (index, *inputs), {})
+    return op_call("multiplex", _multiplex, index, *inputs)
 
 
 # ---- reductions ----
@@ -185,10 +216,12 @@ def _reduce(op_name, fn):
 
 
 def _sum_body(a, axis=None, keepdims=False, dtype=None):
-    out = jnp.sum(a, axis=axis, keepdims=keepdims)
+    # accumulate in the requested dtype (reference semantics: summing int32
+    # with dtype='int64' must not overflow before the cast)
     if dtype is not None:
-        out = out.astype(dtype)
-    elif jnp.issubdtype(a.dtype, jnp.bool_):
+        return jnp.sum(a.astype(dtype), axis=axis, keepdims=keepdims)
+    out = jnp.sum(a, axis=axis, keepdims=keepdims)
+    if jnp.issubdtype(a.dtype, jnp.bool_):
         out = out.astype(jnp.int32)
     return out
 
@@ -219,83 +252,123 @@ all = _reduce("all", jnp.all)
 any = _reduce("any", jnp.any)
 
 
+@op_body("count_nonzero")
+def _count_nonzero(a, *, axis, keepdims):
+    return jnp.count_nonzero(a, axis=axis, keepdims=keepdims)
+
+
 def count_nonzero(x, axis=None, keepdim=False, name=None):
-    return eager_apply("count_nonzero",
-                       lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+    return op_call("count_nonzero", _count_nonzero, x, axis=_axis(axis),
+                   keepdims=keepdim)
+
+
+@op_body("logsumexp")
+def _logsumexp(a, *, axis, keepdims):
+    return jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims)
 
 
 def logsumexp(x, axis=None, keepdim=False, name=None):
-    return eager_apply("logsumexp",
-                       lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), (x,), {})
+    return op_call("logsumexp", _logsumexp, x, axis=_axis(axis),
+                   keepdims=keepdim)
+
+
+@op_body("cumsum")
+def _cumsum(a, *, axis, dtype):
+    if axis is None:
+        return jnp.cumsum(a.reshape(-1), dtype=dtype)
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
 
 
 def cumsum(x, axis=None, dtype=None, name=None):
-    def fn(a):
-        if axis is None:
-            a = a.reshape(-1)
-            return jnp.cumsum(a, dtype=to_jax_dtype(dtype) if dtype else None)
-        return jnp.cumsum(a, axis=_axis(axis), dtype=to_jax_dtype(dtype) if dtype else None)
-    return eager_apply("cumsum", fn, (x,), {})
+    return op_call("cumsum", _cumsum, x, axis=_axis(axis),
+                   dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+@op_body("cumprod")
+def _cumprod(a, *, axis, dtype):
+    return jnp.cumprod(a, axis=axis, dtype=dtype)
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
-    return eager_apply("cumprod",
-                       lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=to_jax_dtype(dtype) if dtype else None), (x,), {})
+    return op_call("cumprod", _cumprod, x, axis=_axis(dim),
+                   dtype=to_jax_dtype(dtype) if dtype else None)
 
 
-def _cum_minmax(name, is_max, x, axis, dtype):
+def _cum_minmax_body(a, *, axis, dtype, is_max):
     """Running max/min with cumulative argindices (ties keep the latest
     position, matching the reference cummax/cummin kernels)."""
-    def fn(a):
-        arr = a.reshape(-1) if axis is None else a
-        ax = 0 if axis is None else _axis(axis) % arr.ndim
-        shape = [1] * arr.ndim
-        shape[ax] = arr.shape[ax]
-        idx0 = jnp.broadcast_to(
-            jnp.arange(arr.shape[ax], dtype=to_jax_dtype(dtype)).reshape(shape),
-            arr.shape)
+    arr = a.reshape(-1) if axis is None else a
+    ax = 0 if axis is None else axis % arr.ndim
+    shape = [1] * arr.ndim
+    shape[ax] = arr.shape[ax]
+    idx0 = jnp.broadcast_to(
+        jnp.arange(arr.shape[ax], dtype=dtype).reshape(shape), arr.shape)
 
-        def comb(prev, cur):
-            pv, pi = prev
-            cv, ci = cur
-            cmp = (cv >= pv) if is_max else (cv <= pv)
-            # NaN-sticky like the reference cum_maxmin kernel: once a NaN
-            # enters the running value it stays (plain >= is False for NaN
-            # and would silently skip it)
-            if jnp.issubdtype(arr.dtype, jnp.floating):
-                take_cur = jnp.isnan(cv) | (~jnp.isnan(pv) & cmp)
-            else:
-                take_cur = cmp
-            return jnp.where(take_cur, cv, pv), jnp.where(take_cur, ci, pi)
+    def comb(prev, cur):
+        pv, pi = prev
+        cv, ci = cur
+        cmp = (cv >= pv) if is_max else (cv <= pv)
+        # NaN-sticky like the reference cum_maxmin kernel: once a NaN
+        # enters the running value it stays (plain >= is False for NaN
+        # and would silently skip it)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            take_cur = jnp.isnan(cv) | (~jnp.isnan(pv) & cmp)
+        else:
+            take_cur = cmp
+        return jnp.where(take_cur, cv, pv), jnp.where(take_cur, ci, pi)
 
-        vals, idx = lax.associative_scan(comb, (arr, idx0), axis=ax)
-        return vals, idx
+    vals, idx = lax.associative_scan(comb, (arr, idx0), axis=ax)
+    return vals, idx
 
-    return eager_apply(name, fn, (x,), {})
+
+@op_body("cummax")
+def _cummax(a, *, axis, dtype):
+    return _cum_minmax_body(a, axis=axis, dtype=dtype, is_max=True)
+
+
+@op_body("cummin")
+def _cummin(a, *, axis, dtype):
+    return _cum_minmax_body(a, axis=axis, dtype=dtype, is_max=False)
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
-    return _cum_minmax("cummax", True, x, axis, dtype)
+    return op_call("cummax", _cummax, x, axis=_axis(axis),
+                   dtype=to_jax_dtype(dtype))
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    return _cum_minmax("cummin", False, x, axis, dtype)
+    return op_call("cummin", _cummin, x, axis=_axis(axis),
+                   dtype=to_jax_dtype(dtype))
+
+
+@op_body("logcumsumexp")
+def _logcumsumexp(a, *, axis):
+    arr = a.reshape(-1) if axis is None else a
+    ax = 0 if axis is None else axis
+    return lax.associative_scan(jnp.logaddexp, arr, axis=ax)
 
 
 def logcumsumexp(x, axis=None, name=None):
-    def fn(a):
-        arr = a.reshape(-1) if axis is None else a
-        ax = 0 if axis is None else _axis(axis)
-        return lax.associative_scan(jnp.logaddexp, arr, axis=ax)
-    return eager_apply("logcumsumexp", fn, (x,), {})
+    return op_call("logcumsumexp", _logcumsumexp, x, axis=_axis(axis))
+
+
+@op_body("trace")
+def _trace(a, *, offset, axis1, axis2):
+    return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
 
 
 def trace(x, offset=0, axis1=0, axis2=1, name=None):
-    return eager_apply("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), (x,), {})
+    return op_call("trace", _trace, x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op_body("diagonal")
+def _diagonal(a, *, offset, axis1, axis2):
+    return jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2)
 
 
 def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
-    return eager_apply("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), (x,), {})
+    return op_call("diagonal", _diagonal, x, offset=offset, axis1=axis1,
+                   axis2=axis2)
 
 
 # ---- logic / comparison (elementwise, return bool tensors) ----
@@ -318,16 +391,33 @@ bitwise_left_shift = _binop("bitwise_left_shift", lambda x, y: jnp.left_shift(x,
 bitwise_right_shift = _binop("bitwise_right_shift", lambda x, y: jnp.right_shift(x, y))
 
 
+@op_body("equal_all")
+def _equal_all(a, b):
+    return jnp.array_equal(a, b)
+
+
 def equal_all(x, y, name=None):
-    return eager_apply("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y), {})
+    return op_call("equal_all", _equal_all, x, y)
+
+
+@op_body("allclose")
+def _allclose(a, b, *, rtol, atol, equal_nan):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return eager_apply("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+    return op_call("allclose", _allclose, x, y, rtol=rtol, atol=atol,
+                   equal_nan=equal_nan)
+
+
+@op_body("isclose")
+def _isclose(a, b, *, rtol, atol, equal_nan):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return eager_apply("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+    return op_call("isclose", _isclose, x, y, rtol=rtol, atol=atol,
+                   equal_nan=equal_nan)
 
 
 # ---- matmul family (linalg has the rest) ----
@@ -348,12 +438,22 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
                    transpose_x=transpose_x, transpose_y=transpose_y)
 
 
+@op_body("addmm")
+def _addmm(i, a, b, *, beta, alpha):
+    return beta * i + alpha * (a @ b)
+
+
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return eager_apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y), {})
+    return op_call("addmm", _addmm, input, x, y, beta=beta, alpha=alpha)
+
+
+@op_body("inverse")
+def _inverse(a):
+    return jnp.linalg.inv(a)
 
 
 def inverse(x, name=None):
-    return eager_apply("inverse", jnp.linalg.inv, (x,), {})
+    return op_call("inverse", _inverse, x)
 
 
 # ---- in-place variants (eager only; adopt functional result) ----
@@ -397,34 +497,43 @@ def increment(x, value=1.0, name=None):
     return x._inplace_update(x._data + value)
 
 
+@op_body("baddbmm")
+def _baddbmm(i, a, b, *, beta, alpha):
+    return beta * i + alpha * jnp.matmul(a, b)
+
+
 def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     """beta*input + alpha*(x @ y) batched (reference: ops.yaml baddbmm)."""
-    return eager_apply(
-        "baddbmm",
-        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
-        (input, x, y), {})
+    return op_call("baddbmm", _baddbmm, input, x, y, beta=beta, alpha=alpha)
+
+
+@op_body("logit")
+def _logit(a, *, eps):
+    if eps is not None:
+        a = jnp.clip(a, eps, 1.0 - eps)
+    return jnp.log(a) - jnp.log1p(-a)
 
 
 def logit(x, eps=None, name=None):
     """log(x / (1-x)); eps clamps the input into [eps, 1-eps]."""
-    def fn(a):
-        if eps is not None:
-            a = jnp.clip(a, eps, 1.0 - eps)
-        return jnp.log(a) - jnp.log1p(-a)
-    return eager_apply("logit", fn, (x,), {})
+    return op_call("logit", _logit, x, eps=eps)
+
+
+@op_body("renorm")
+def _renorm(a, *, p, axis, max_norm):
+    ax = axis % a.ndim
+    reduce_axes = tuple(i for i in range(a.ndim) if i != ax)
+    norms = jnp.sum(jnp.abs(a) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return a * factor
 
 
 def renorm(x, p, axis, max_norm, name=None):
     """Clamp each slice's p-norm along ``axis`` to max_norm (reference:
     ops.yaml renorm)."""
-    def fn(a):
-        ax = _axis(axis) % a.ndim
-        reduce_axes = tuple(i for i in range(a.ndim) if i != ax)
-        norms = jnp.sum(jnp.abs(a) ** p, axis=reduce_axes,
-                        keepdims=True) ** (1.0 / p)
-        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
-        return a * factor
-    return eager_apply("renorm", fn, (x,), {})
+    return op_call("renorm", _renorm, x, p=p, axis=_axis(axis),
+                   max_norm=max_norm)
 
 
 def _diag_indices(h, w, offset):
@@ -453,71 +562,100 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     return x._inplace_update(fn(x._data))
 
 
+@op_body("fill_diagonal_tensor")
+def _fill_diagonal_tensor(a, b, *, offset, dim1, dim2):
+    perm = [i for i in range(a.ndim) if i not in (dim1 % a.ndim,
+                                                  dim2 % a.ndim)]
+    perm += [dim1 % a.ndim, dim2 % a.ndim]
+    at = jnp.transpose(a, perm)
+    r, c = _diag_indices(at.shape[-2], at.shape[-1], offset)
+    at = at.at[..., r, c].set(b)
+    inv = [perm.index(i) for i in range(a.ndim)]
+    return jnp.transpose(at, inv)
+
+
 def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
     """Write tensor ``y`` onto x's (dim1, dim2) diagonal."""
-    def fn(a, b):
-        perm = [i for i in range(a.ndim) if i not in (dim1 % a.ndim,
-                                                      dim2 % a.ndim)]
-        perm += [dim1 % a.ndim, dim2 % a.ndim]
-        at = jnp.transpose(a, perm)
-        r, c = _diag_indices(at.shape[-2], at.shape[-1], offset)
-        at = at.at[..., r, c].set(b)
-        inv = [perm.index(i) for i in range(a.ndim)]
-        return jnp.transpose(at, inv)
-    return eager_apply("fill_diagonal_tensor", fn, (x, y), {})
+    return op_call("fill_diagonal_tensor", _fill_diagonal_tensor, x, y,
+                   offset=offset, dim1=dim1, dim2=dim2)
+
+
+@op_body("gammaln")
+def _gammaln(a):
+    return jax.scipy.special.gammaln(a)
 
 
 def gammaln(x, name=None):
-    return eager_apply("gammaln",
-                       lambda a: jax.scipy.special.gammaln(a), (x,), {})
+    return op_call("gammaln", _gammaln, x)
+
+
+@op_body("gammaincc")
+def _gammaincc(a, b):
+    return jax.scipy.special.gammaincc(a, b)
 
 
 def gammaincc(x, y, name=None):
     """Regularized upper incomplete gamma Q(x, y)."""
-    return eager_apply("gammaincc",
-                       lambda a, b: jax.scipy.special.gammaincc(a, b),
-                       (x, y), {})
+    return op_call("gammaincc", _gammaincc, x, y)
+
+
+@op_body("gammainc")
+def _gammainc(a, b):
+    return jax.scipy.special.gammainc(a, b)
 
 
 def gammainc(x, y, name=None):
-    return eager_apply("gammainc",
-                       lambda a, b: jax.scipy.special.gammainc(a, b),
-                       (x, y), {})
+    return op_call("gammainc", _gammainc, x, y)
+
+
+@op_body("squared_l2_norm")
+def _squared_l2_norm(a):
+    return jnp.sum(jnp.square(a))
 
 
 def squared_l2_norm(x, name=None):
-    return eager_apply("squared_l2_norm",
-                       lambda a: jnp.sum(jnp.square(a)), (x,), {})
+    return op_call("squared_l2_norm", _squared_l2_norm, x)
+
+
+@op_body("p_norm")
+def _p_norm(a, *, p, axis, epsilon, keepdims):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdims)
+    s = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdims)
+    return jnp.maximum(s, epsilon) ** (1.0 / p)
 
 
 def p_norm(x, p=2.0, axis=None, epsilon=1e-12, keepdim=False, name=None):
-    def fn(a):
-        if p == float("inf"):
-            return jnp.max(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
-        if p == float("-inf"):
-            return jnp.min(jnp.abs(a), axis=_axis(axis), keepdims=keepdim)
-        s = jnp.sum(jnp.abs(a) ** p, axis=_axis(axis), keepdims=keepdim)
-        return jnp.maximum(s, epsilon) ** (1.0 / p)
-    return eager_apply("p_norm", fn, (x,), {})
+    return op_call("p_norm", _p_norm, x, p=p, axis=_axis(axis),
+                   epsilon=epsilon, keepdims=keepdim)
+
+
+@op_body("reduce_as")
+def _reduce_as(a, t):
+    extra = a.ndim - t.ndim
+    if extra:
+        a = jnp.sum(a, axis=tuple(range(extra)))
+    axes = tuple(i for i in range(a.ndim)
+                 if t.shape[i] == 1 and a.shape[i] != 1)
+    if axes:
+        a = jnp.sum(a, axis=axes, keepdims=True)
+    return a
 
 
 def reduce_as(x, target, name=None):
     """Sum-reduce x down to target's shape (the broadcast inverse;
     reference: ops.yaml reduce_as)."""
-    def fn(a, t):
-        extra = a.ndim - t.ndim
-        if extra:
-            a = jnp.sum(a, axis=tuple(range(extra)))
-        axes = tuple(i for i in range(a.ndim)
-                     if t.shape[i] == 1 and a.shape[i] != 1)
-        if axes:
-            a = jnp.sum(a, axis=axes, keepdims=True)
-        return a
-    return eager_apply("reduce_as", fn, (x, target), {})
+    return op_call("reduce_as", _reduce_as, x, target)
+
+
+@op_body("frobenius_norm")
+def _frobenius_norm(a, *, axis, keepdims):
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))
 
 
 def frobenius_norm(x, axis=None, keepdim=False, name=None):
-    def fn(a):
-        ax = _axis(axis) if axis is not None else None
-        return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
-    return eager_apply("frobenius_norm", fn, (x,), {})
+    return op_call("frobenius_norm", _frobenius_norm, x,
+                   axis=_axis(axis) if axis is not None else None,
+                   keepdims=keepdim)

@@ -1,62 +1,83 @@
-"""Search/sort ops (analog of python/paddle/tensor/search.py)."""
+"""Search/sort ops (analog of python/paddle/tensor/search.py).
+
+Registry-routed via op_body/op_call (core/dispatch.py); host-side
+data-dependent-shape ops (histogramdd, bincount) stay eager numpy.
+"""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
 
 
 def _ax(axis):
     return None if axis is None else int(axis)
 
 
+@op_body("argmax")
+def _argmax(a, *, axis, keepdim):
+    out = jnp.argmax(a.reshape(-1) if axis is None else a,
+                     axis=axis if axis is not None else None)
+    if axis is not None and keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int32)
+
+
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    def fn(a):
-        out = jnp.argmax(a.reshape(-1) if axis is None else a, axis=_ax(axis) or 0 if axis is not None else None)
-        if axis is not None and keepdim:
-            out = jnp.expand_dims(out, _ax(axis))
-        return out.astype(jnp.int32)
-    return eager_apply("argmax", fn, (x,), {})
+    return op_call("argmax", _argmax, x, axis=_ax(axis), keepdim=keepdim)
+
+
+@op_body("argmin")
+def _argmin(a, *, axis, keepdim):
+    out = jnp.argmin(a.reshape(-1) if axis is None else a,
+                     axis=axis if axis is not None else None)
+    if axis is not None and keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int32)
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    def fn(a):
-        out = jnp.argmin(a.reshape(-1) if axis is None else a, axis=_ax(axis) if axis is not None else None)
-        if axis is not None and keepdim:
-            out = jnp.expand_dims(out, _ax(axis))
-        return out.astype(jnp.int32)
-    return eager_apply("argmin", fn, (x,), {})
+    return op_call("argmin", _argmin, x, axis=_ax(axis), keepdim=keepdim)
+
+
+@op_body("argsort")
+def _argsort(a, *, axis, descending, stable):
+    idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+    return idx.astype(jnp.int32)
 
 
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
-    def fn(a):
-        idx = jnp.argsort(a, axis=_ax(axis), stable=stable, descending=descending)
-        return idx.astype(jnp.int32)
-    return eager_apply("argsort", fn, (x,), {})
+    return op_call("argsort", _argsort, x, axis=_ax(axis),
+                   descending=bool(descending), stable=bool(stable))
+
+
+@op_body("sort")
+def _sort(a, *, axis, descending, stable):
+    return jnp.sort(a, axis=axis, stable=stable, descending=descending)
 
 
 def sort(x, axis=-1, descending=False, stable=False, name=None):
-    def fn(a):
-        out = jnp.sort(a, axis=_ax(axis), stable=stable, descending=descending)
-        return out
-    return eager_apply("sort", fn, (x,), {})
+    return op_call("sort", _sort, x, axis=_ax(axis),
+                   descending=bool(descending), stable=bool(stable))
+
+
+@op_body("topk")
+def _topk(a, *, k, axis, largest):
+    ax = axis if axis is not None else -1
+    a_moved = jnp.moveaxis(a, ax, -1)
+    if largest:
+        vals, idx = jax_topk(a_moved, k)
+    else:
+        vals, idx = jax_topk(-a_moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     k = int(k.item()) if isinstance(k, Tensor) else int(k)
-
-    def fn(a):
-        ax = _ax(axis) if axis is not None else -1
-        a_moved = jnp.moveaxis(a, ax, -1)
-        if largest:
-            vals, idx = jax_topk(a_moved, k)
-        else:
-            vals, idx = jax_topk(-a_moved, k)
-            vals = -vals
-        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int32), -1, ax)
-
-    return eager_apply("topk", fn, (x,), {})
+    return op_call("topk", _topk, x, k=k, axis=_ax(axis),
+                   largest=bool(largest))
 
 
 def jax_topk(a, k):
@@ -64,70 +85,89 @@ def jax_topk(a, k):
     return lax.top_k(a, k)
 
 
+@op_body("kthvalue")
+def _kthvalue(a, *, k, axis, keepdim):
+    srt = jnp.sort(a, axis=axis)
+    idx = jnp.argsort(a, axis=axis, stable=True)
+    vals = jnp.take(srt, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis).astype(jnp.int32)
+    if keepdim:
+        vals, inds = jnp.expand_dims(vals, axis), jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
-    def fn(a):
-        ax = _ax(axis)
-        srt = jnp.sort(a, axis=ax)
-        idx = jnp.argsort(a, axis=ax, stable=True)
-        vals = jnp.take(srt, k - 1, axis=ax)
-        inds = jnp.take(idx, k - 1, axis=ax).astype(jnp.int32)
-        if keepdim:
-            vals, inds = jnp.expand_dims(vals, ax), jnp.expand_dims(inds, ax)
-        return vals, inds
-    return eager_apply("kthvalue", fn, (x,), {})
+    return op_call("kthvalue", _kthvalue, x, k=int(k), axis=_ax(axis),
+                   keepdim=keepdim)
+
+
+@op_body("mode")
+def _mode(a, *, axis, keepdim):
+    ax = axis % a.ndim
+    moved = jnp.moveaxis(a, ax, -1)
+    srt = jnp.sort(moved, axis=-1)
+    n = srt.shape[-1]
+    # run-length: count occurrences of each sorted value
+    eq = (srt[..., :, None] == srt[..., None, :])
+    counts = eq.sum(-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    # index of last occurrence in original order
+    match = (moved == vals[..., None])
+    idx = (n - 1) - jnp.argmax(jnp.flip(match, -1), axis=-1)
+    if keepdim:
+        vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+    return vals, idx.astype(jnp.int32)
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    def fn(a):
-        ax = _ax(axis) % a.ndim
-        moved = jnp.moveaxis(a, ax, -1)
-        srt = jnp.sort(moved, axis=-1)
-        n = srt.shape[-1]
-        # run-length: count occurrences of each sorted value
-        eq = (srt[..., :, None] == srt[..., None, :])
-        counts = eq.sum(-1)
-        best = jnp.argmax(counts, axis=-1)
-        vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
-        # index of last occurrence in original order
-        match = (moved == vals[..., None])
-        idx = (n - 1) - jnp.argmax(jnp.flip(match, -1), axis=-1)
-        if keepdim:
-            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
-        return vals, idx.astype(jnp.int32)
-    return eager_apply("mode", fn, (x,), {})
+    return op_call("mode", _mode, x, axis=_ax(axis), keepdim=keepdim)
+
+
+@op_body("searchsorted")
+def _searchsorted(s, v, *, right):
+    side = "right" if right else "left"
+    if s.ndim == 1:
+        out = jnp.searchsorted(s, v, side=side)
+    else:
+        import jax
+        out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+        out = out.reshape(v.shape)
+    return out.astype(jnp.int32)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
-    def fn(s, v):
-        side = "right" if right else "left"
-        if s.ndim == 1:
-            out = jnp.searchsorted(s, v, side=side)
-        else:
-            import jax
-            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
-                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
-            out = out.reshape(v.shape)
-        return out.astype(jnp.int32 if out_int32 else jnp.int32)
-    return eager_apply("searchsorted", fn, (sorted_sequence, values), {})
+    return op_call("searchsorted", _searchsorted, sorted_sequence, values,
+                   right=bool(right))
+
+
+@op_body("bucketize")
+def _bucketize(a, s, *, right):
+    out = jnp.searchsorted(s, a, side="right" if right else "left")
+    return out.astype(jnp.int32)
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
-    def fn(a, s):
-        out = jnp.searchsorted(s, a, side="right" if right else "left")
-        return out.astype(jnp.int32 if out_int32 else jnp.int32)
-    return eager_apply("bucketize", fn, (x, sorted_sequence), {})
+    return op_call("bucketize", _bucketize, x, sorted_sequence,
+                   right=bool(right))
+
+
+@op_body("histogram")
+def _histogram(a, *maybe_w, bins, min, max, density):
+    lo, hi = (float(min), float(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(a.min()), float(a.max())
+    h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
+                         weights=maybe_w[0].reshape(-1) if maybe_w else None,
+                         density=density)
+    return h if density else h.astype(jnp.int32)
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
-    def fn(a):
-        lo, hi = (float(min), float(max))
-        if lo == 0 and hi == 0:
-            lo, hi = float(a.min()), float(a.max())
-        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
-                             weights=weight._data.reshape(-1) if weight is not None else None,
-                             density=density)
-        return h if density else h.astype(jnp.int32)
-    return eager_apply("histogram", fn, (input,), {})
+    args = (input,) if weight is None else (input, weight)
+    return op_call("histogram", _histogram, *args, bins=bins, min=min,
+                   max=max, density=bool(density))
 
 
 def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
@@ -145,11 +185,15 @@ def bincount(x, weights=None, minlength=0, name=None):
     return Tensor(jnp.asarray(np.bincount(arr, weights=w, minlength=minlength)))
 
 
+@op_body("index_fill")
+def _index_fill(a, i, *, axis, value):
+    import builtins
+    idx = [builtins.slice(None)] * a.ndim
+    idx[axis] = i
+    return a.at[tuple(idx)].set(value)
+
+
 def index_fill(x, index, axis, value, name=None):
-    def fn(a, i):
-        import builtins
-        idx = [builtins.slice(None)] * a.ndim
-        idx[int(axis)] = i
-        v = value._data if isinstance(value, Tensor) else value
-        return a.at[tuple(idx)].set(v)
-    return eager_apply("index_fill", fn, (x, index), {})
+    v = value._data if isinstance(value, Tensor) else value
+    return op_call("index_fill", _index_fill, x, index, axis=int(axis),
+                   value=v)

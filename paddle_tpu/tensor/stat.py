@@ -1,9 +1,12 @@
-"""Statistics ops (analog of python/paddle/tensor/stat.py)."""
+"""Statistics ops (analog of python/paddle/tensor/stat.py).
+
+Registry-routed via op_body/op_call (core/dispatch.py).
+"""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dispatch import eager_apply
+from ..core.dispatch import op_body, op_call
 
 
 def _ax(axis):
@@ -14,48 +17,72 @@ def _ax(axis):
     return int(axis)
 
 
+@op_body("std")
+def _std(a, *, axis, ddof, keepdims):
+    return jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return eager_apply("std", lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
-                                                keepdims=keepdim), (x,), {})
+    return op_call("std", _std, x, axis=_ax(axis),
+                   ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op_body("var")
+def _var(a, *, axis, ddof, keepdims):
+    return jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdims)
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    return eager_apply("var", lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
-                                                keepdims=keepdim), (x,), {})
+    return op_call("var", _var, x, axis=_ax(axis),
+                   ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@op_body("median")
+def _median(a, *, axis, keepdim, mode):
+    if mode == "avg":
+        return jnp.median(a, axis=axis, keepdims=keepdim)
+    # mode='min': lower of the two middle values + its index
+    arr = a.reshape(-1) if axis is None else a
+    ax2 = 0 if axis is None else axis
+    n = arr.shape[ax2]
+    k = (n - 1) // 2
+    srt = jnp.sort(arr, axis=ax2)
+    vals = jnp.take(srt, k, axis=ax2)
+    if keepdim and axis is not None:
+        vals = jnp.expand_dims(vals, ax2)
+    return vals
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
-    def fn(a):
-        if mode == "avg":
-            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
-        # mode='min': lower of the two middle values + its index
-        ax = _ax(axis)
-        arr = a.reshape(-1) if ax is None else a
-        ax2 = 0 if ax is None else ax
-        n = arr.shape[ax2]
-        k = (n - 1) // 2
-        srt = jnp.sort(arr, axis=ax2)
-        vals = jnp.take(srt, k, axis=ax2)
-        if keepdim and ax is not None:
-            vals = jnp.expand_dims(vals, ax2)
-        return vals
-    return eager_apply("median", fn, (x,), {})
+    return op_call("median", _median, x, axis=_ax(axis), keepdim=keepdim,
+                   mode=mode)
+
+
+@op_body("nanmedian")
+def _nanmedian(a, *, axis, keepdims):
+    return jnp.nanmedian(a, axis=axis, keepdims=keepdims)
 
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
-    return eager_apply("nanmedian",
-                       lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim), (x,), {})
+    return op_call("nanmedian", _nanmedian, x, axis=_ax(axis),
+                   keepdims=keepdim)
+
+
+@op_body("quantile")
+def _quantile(a, q, *, axis, keepdims, method):
+    return jnp.quantile(a, q, axis=axis, keepdims=keepdims, method=method)
 
 
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
-    def fn(a):
-        qs = jnp.asarray(q)
-        return jnp.quantile(a, qs, axis=_ax(axis), keepdims=keepdim, method=interpolation)
-    return eager_apply("quantile", fn, (x,), {})
+    return op_call("quantile", _quantile, x, jnp.asarray(q), axis=_ax(axis),
+                   keepdims=keepdim, method=interpolation)
+
+
+@op_body("nanquantile")
+def _nanquantile(a, q, *, axis, keepdims, method):
+    return jnp.nanquantile(a, q, axis=axis, keepdims=keepdims, method=method)
 
 
 def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
-    def fn(a):
-        return jnp.nanquantile(a, jnp.asarray(q), axis=_ax(axis), keepdims=keepdim,
-                               method=interpolation)
-    return eager_apply("nanquantile", fn, (x,), {})
+    return op_call("nanquantile", _nanquantile, x, jnp.asarray(q),
+                   axis=_ax(axis), keepdims=keepdim, method=interpolation)

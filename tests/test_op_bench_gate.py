@@ -1,0 +1,78 @@
+"""Per-op perf regression gate (round-3 verdict item 4).
+
+Mirrors the reference's CI discipline (tools/ci_op_benchmark.sh +
+tools/check_op_benchmark_result.py): a recorded baseline, a tolerance
+gate, and a hard failure when an op regresses. The e2e case plants a
+deliberate ~4x slowdown in one op body and asserts the gate catches it.
+"""
+import importlib
+import sys
+
+import numpy as np
+import pytest
+
+
+def _op_bench():
+    sys.path.insert(0, "/root/repo")
+    import tools.op_bench as ob
+    return importlib.reload(ob)
+
+
+def test_gate_logic_pass_and_fail():
+    ob = _op_bench()
+    base = {"ops": {"matmul_512": 100.0, "rms_norm_1k": 50.0}}
+
+    ok = {"backend": "cpu", "ops": {"matmul_512": 120.0, "rms_norm_1k": 60.0}}
+    failures, report = ob.gate(ok, base, tolerance=2.0)
+    assert failures == []
+    assert "x1.20" in report
+
+    bad = {"backend": "cpu", "ops": {"matmul_512": 100.0, "rms_norm_1k": 250.0}}
+    failures, _ = ob.gate(bad, base, tolerance=2.0)
+    assert [f[0] for f in failures] == ["rms_norm_1k"]
+
+    # an op that disappeared from the run also fails (silent coverage loss)
+    gone = {"backend": "cpu", "ops": {"matmul_512": 100.0}}
+    failures, report = ob.gate(gone, base, tolerance=2.0)
+    assert [f[0] for f in failures] == ["rms_norm_1k"]
+    assert "MISSING" in report
+
+
+@pytest.mark.slow
+def test_deliberate_slowdown_fails_gate(monkeypatch):
+    """The verdict's 'done' bar: a deliberate slowdown of one op body is
+    caught by the gate against a just-recorded baseline."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import OPS, override_kernel
+
+    ob = _op_bench()
+
+    # restrict the hot set to rms_norm for speed
+    full_cases = ob._cases
+
+    def rms_only():
+        return [c for c in full_cases() if c[0] == "rms_norm_1k"]
+
+    monkeypatch.setattr(ob, "_cases", rms_only)
+
+    baseline = ob.run(include_collective=False)
+    assert "rms_norm_1k" in baseline["ops"]
+
+    default = OPS["rms_norm"]
+
+    def slow_rms(a, *w, epsilon=1e-6):
+        # sequential chain (each call consumes the previous output) so XLA
+        # cannot CSE the repeats away — a real ~7x arithmetic slowdown
+        out = default(a, *w, epsilon=epsilon)
+        for _ in range(6):
+            out = default(out + a * 1e-9, *w, epsilon=epsilon)
+        return out
+
+    old = override_kernel("rms_norm", slow_rms)
+    try:
+        slowed = ob.run(include_collective=False)
+    finally:
+        override_kernel("rms_norm", old)
+
+    failures, report = ob.gate(slowed, baseline, tolerance=2.0)
+    assert [f[0] for f in failures] == ["rms_norm_1k"], report

@@ -1,0 +1,238 @@
+"""Per-op perf regression gate (round-3 verdict item 4).
+
+The reference CI diffs op benchmarks against a recorded baseline
+(/root/reference/tools/ci_op_benchmark.sh + check_op_benchmark_result.py);
+this is the TPU-native equivalent: time the registry's hot set on the
+current backend, diff against a checked-in baseline JSON, fail on
+regressions beyond tolerance.
+
+Usage:
+  python tools/op_bench.py                 # run + gate vs baseline
+  python tools/op_bench.py --record        # re-record the baseline
+  python tools/op_bench.py --json          # print results, no gate
+
+Baselines are per-backend (cpu / tpu-<kind>): timings are only comparable
+on the same part. CI runs the cpu gate; record a tpu baseline when the
+chip profile changes. Gate logic mirrors check_op_benchmark_result.py:
+relative slowdown beyond --tolerance (default 2.0x) on any op fails with
+rc 1. The generous default absorbs CI machine noise while still catching
+the "round N+1 made rms_norm 3x slower" class; tighten per deployment.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "tools", "op_bench_baseline.json")
+
+
+def _timed_chain(fn, x, iters, warmup=3):
+    """Chained same-shape timing: each call consumes the previous output so
+    async dispatch cannot overlap the measured work (tools/perf_audit.py's
+    method)."""
+    import jax
+    y = x
+    for _ in range(warmup):
+        y = fn(y)
+    jax.block_until_ready(y)
+    reps = []
+    for _ in range(3):
+        y = x
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            y = fn(y)
+        jax.block_until_ready(y)
+        reps.append((time.perf_counter() - t0) / iters)
+    return min(reps)
+
+
+def _cases():
+    """The hot set: one representative shape per op family. Each case
+    returns (name, fn: array -> same-shape array, x0, iters)."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.core.dispatch import OPS
+
+    rng = np.random.default_rng(0)
+    cases = []
+
+    # matmul 512^2 (MXU path)
+    w = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+    m0 = jnp.asarray(rng.standard_normal((512, 512)).astype(np.float32))
+    matmul = jax.jit(lambda a: OPS["matmul"](a, w) * 1e-3)
+    cases.append(("matmul_512", matmul, m0, 50))
+
+    # attention (composed SDPA) b=2 s=128 h=4 d=64
+    q0 = jnp.asarray(rng.standard_normal((2, 128, 4, 64)).astype(np.float32))
+    sdpa = jax.jit(lambda q: OPS["scaled_dot_product_attention"](
+        q, q, q, causal=True) * 0.5 + q * 0.5)
+    cases.append(("sdpa_128", sdpa, q0, 20))
+
+    # norm family: rms_norm + layer_norm [1024, 1024]
+    h0 = jnp.asarray(rng.standard_normal((1024, 1024)).astype(np.float32))
+    gamma = jnp.ones((1024,), jnp.float32)
+    rms = jax.jit(lambda a: OPS["rms_norm"](a, gamma) + a * 1e-6)
+    cases.append(("rms_norm_1k", rms, h0, 50))
+    ln = jax.jit(lambda a: OPS["layer_norm"](
+        a, gamma, nd=1, epsilon=1e-5, has_weight=True, has_bias=False)
+        + a * 1e-6)
+    cases.append(("layer_norm_1k", ln, h0, 50))
+
+    # softmax + elementwise chain
+    sm = jax.jit(lambda a: OPS["softmax"](a, axis=-1) + a * 1e-6)
+    cases.append(("softmax_1k", sm, h0, 50))
+
+    # embedding gather [8k vocab, 256] x 4096 ids
+    table = jnp.asarray(rng.standard_normal((8192, 256)).astype(np.float32))
+    ids0 = jnp.asarray(rng.integers(0, 8192, (4096,)).astype(np.int32))
+    emb = jax.jit(lambda i: (OPS["embedding"](
+        i, table, padding_idx=None).sum(-1) * 0).astype(jnp.int32) + i)
+    cases.append(("embedding_4k", emb, ids0, 50))
+
+    # optimizer update: AdamW-style fused update on a 1M-param vector
+    p0 = jnp.asarray(rng.standard_normal((1 << 20,)).astype(np.float32))
+
+    def adamw_like(p):
+        g = p * 1e-4
+        m = 0.9 * p + 0.1 * g
+        v = 0.999 * p * p + 0.001 * g * g
+        return p - 1e-3 * (m / (jnp.sqrt(v) + 1e-8) + 0.01 * p)
+
+    cases.append(("adamw_update_1m", jax.jit(adamw_like), p0, 50))
+
+    # conv2d 64ch 56x56 3x3
+    img0 = jnp.asarray(rng.standard_normal((2, 64, 56, 56)).astype(np.float32))
+    kw = jnp.asarray(rng.standard_normal((64, 64, 3, 3)).astype(np.float32) * 0.01)
+    conv = jax.jit(lambda a: OPS["conv2d"](
+        a, kw, stride=(1, 1), pad=[(1, 1), (1, 1)], dilation=(1, 1),
+        groups=1, channel_last=False, nd=2) * 0.5 + a * 0.5)
+    cases.append(("conv2d_56", conv, img0, 20))
+
+    # reduction
+    red = jax.jit(lambda a: a - a.mean(axis=-1, keepdims=True))
+    cases.append(("mean_center_1k", red, h0, 50))
+
+    return cases
+
+
+def _collective_case():
+    """all_reduce over the virtual CPU mesh (only when >1 device)."""
+    import jax
+    import jax.numpy as jnp
+    if jax.device_count() < 2:
+        return None
+    from jax.sharding import Mesh, PartitionSpec, NamedSharding
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("x",))
+    x0 = jnp.ones((len(devs), 1024, 64), jnp.float32)
+    x0 = jax.device_put(x0, NamedSharding(mesh, PartitionSpec("x")))
+
+    @jax.jit
+    def allreduce_like(a):
+        s = a.sum(axis=0, keepdims=True)  # cross-device reduce under GSPMD
+        return a * 0.999 + s * 1e-6
+
+    return ("allreduce_mesh", allreduce_like, x0, 20)
+
+
+def run(include_collective=True):
+    import jax
+    dev = jax.devices()[0]
+    backend = dev.platform if dev.platform == "cpu" else \
+        getattr(dev, "device_kind", "tpu").replace(" ", "-").lower()
+    results = {}
+    cases = _cases()
+    coll = _collective_case() if include_collective else None
+    if coll is not None:
+        cases.append(coll)
+    for name, fn, x0, iters in cases:
+        results[name] = round(_timed_chain(fn, x0, iters) * 1e6, 2)  # us
+    return {"backend": backend, "unit": "us/op", "ops": results}
+
+
+def gate(current, baseline, tolerance):
+    """Mirror of the reference's check_op_benchmark_result.py comparison:
+    report per-op speedup/slowdown; fail when any op exceeds tolerance."""
+    failures, report = [], []
+    base_ops = baseline.get("ops", {})
+    for name, cur_us in sorted(current["ops"].items()):
+        base_us = base_ops.get(name)
+        if base_us is None:
+            report.append(f"  {name:<20} {cur_us:>10.1f} us   (new, no baseline)")
+            continue
+        ratio = cur_us / base_us if base_us else float("inf")
+        flag = "" if ratio <= tolerance else "  << REGRESSION"
+        report.append(
+            f"  {name:<20} {cur_us:>10.1f} us   baseline {base_us:>10.1f}"
+            f"   x{ratio:.2f}{flag}")
+        if ratio > tolerance:
+            failures.append((name, ratio))
+    for name in sorted(set(base_ops) - set(current["ops"])):
+        report.append(f"  {name:<20} MISSING from current run")
+        failures.append((name, float("nan")))
+    return failures, "\n".join(report)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", action="store_true",
+                    help="write the baseline for this backend")
+    ap.add_argument("--json", action="store_true", help="print JSON only")
+    ap.add_argument("--tolerance", type=float,
+                    default=float(os.environ.get(
+                        "PADDLE_TPU_OP_BENCH_TOLERANCE", "2.0")),
+                    help="max allowed slowdown ratio vs baseline")
+    ap.add_argument("--no-collective", action="store_true")
+    args = ap.parse_args()
+
+    current = run(include_collective=not args.no_collective)
+    if args.json:
+        print(json.dumps(current))
+        return 0
+
+    baselines = {}
+    if os.path.exists(BASELINE_PATH):
+        with open(BASELINE_PATH) as f:
+            baselines = json.load(f)
+
+    if args.record:
+        baselines[current["backend"]] = current
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+        print(f"recorded baseline for backend={current['backend']} "
+              f"({len(current['ops'])} ops) -> {BASELINE_PATH}")
+        return 0
+
+    baseline = baselines.get(current["backend"])
+    if baseline is None:
+        print(f"no baseline for backend={current['backend']}; run "
+              f"`python tools/op_bench.py --record` first", file=sys.stderr)
+        return 2
+
+    failures, report = gate(current, baseline, args.tolerance)
+    print(f"op bench gate  backend={current['backend']} "
+          f"tolerance={args.tolerance}x")
+    print(report)
+    if failures:
+        print(f"FAIL: {len(failures)} op(s) regressed beyond "
+              f"{args.tolerance}x: "
+              + ", ".join(f"{n} (x{r:.2f})" for n, r in failures),
+              file=sys.stderr)
+        return 1
+    print("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
