@@ -95,12 +95,32 @@ def disable_tensor_checker():
     set_flags({"FLAGS_check_nan_inf": False})
 
 
-def compare_accuracy(run_a: dict, run_b: dict, rtol=1e-3, atol=1e-5,
-                     output_path=None):
+def compare_accuracy(run_a: dict, run_b: dict, rtol=None, atol=None,
+                     output_path=None, dtype="float32"):
     """Compare two tensor dicts (e.g. an fp32 and an amp run's outputs);
     returns [(key, max_abs_diff, max_rel_diff, ok)] and optionally writes a
-    text report (reference: debugging.py compare_accuracy over run dumps)."""
+    text report (reference: debugging.py compare_accuracy over run dumps).
+
+    Default tolerances come from the ``FLAGS_accuracy_check_{rtol,atol}_
+    {fp32,fp16,bf16}`` flags keyed by ``dtype`` (reference:
+    paddle/common/flags.cc accuracy_check_*)."""
     import numpy as np
+    from ..core.flags import GLOBAL_FLAGS
+
+    if rtol is None or atol is None:
+        key = str(dtype).removeprefix("paddle.").removeprefix("jnp.")
+        suffix = {"float32": "fp32", "fp32": "fp32", "float16": "fp16",
+                  "fp16": "fp16", "bfloat16": "bf16",
+                  "bf16": "bf16"}.get(key)
+        if suffix is None:
+            raise ValueError(
+                f"compare_accuracy: no default tolerances for dtype "
+                f"{dtype!r}; pass rtol/atol explicitly or use one of "
+                "float32/float16/bfloat16")
+        if rtol is None:
+            rtol = GLOBAL_FLAGS.get(f"accuracy_check_rtol_{suffix}")
+        if atol is None:
+            atol = GLOBAL_FLAGS.get(f"accuracy_check_atol_{suffix}")
 
     rows = []
     for k in sorted(set(run_a) & set(run_b)):

@@ -28,12 +28,24 @@ class _GuardState(threading.local):
     def __init__(self):
         self.mode = None          # None | "record" | "replay"
         self.decisions = []       # bools, in branch-evaluation order
+        self.sites = []           # (filename, lineno) per decision (record)
         self.conds = []           # condition arrays captured during replay
         self.idx = 0
         self.overflow = False     # replay ran out of recorded decisions
 
 
 _state = _GuardState()
+
+
+def _caller_site():
+    """Code location of the ``bool(Tensor)`` — the user frame above
+    Tensor.__bool__ above this hook. A site that repeats in one capture is
+    a tensor-dependent LOOP: value specialization needs one trace per trip
+    count there, so callers surface a rewrite hint
+    (paddle.static.nn.while_loop compiles once for all trip counts)."""
+    import sys
+    f = sys._getframe(3)  # bool_hook <- __bool__ <- user code
+    return (f.f_code.co_filename, f.f_lineno)
 
 
 class GuardOverflow(Exception):
@@ -47,6 +59,10 @@ def bool_hook(data):
     if _state.mode == "record":
         v = bool(data)
         _state.decisions.append(v)
+        try:
+            _state.sites.append(_caller_site())
+        except Exception:
+            _state.sites.append(None)
         return v
     if _state.mode == "replay":
         # EVERY tensor bool consumes one recorded decision and emits one
@@ -69,9 +85,11 @@ class record:
     """Context: run eagerly, collecting the branch-decision vector."""
 
     def __enter__(self):
-        self._saved = (_state.mode, _state.decisions, _state.idx)
+        self._saved = (_state.mode, _state.decisions, _state.sites,
+                       _state.idx)
         _state.mode = "record"
         _state.decisions = []
+        _state.sites = []
         _state.idx = 0
         return self
 
@@ -80,9 +98,21 @@ class record:
         return tuple(_state.decisions if _state.mode == "record"
                      else self._final)
 
+    @property
+    def loop_sites(self):
+        """Sites that produced more than one decision in this capture —
+        tensor-dependent loops (or branches inside Python loops)."""
+        sites = (_state.sites if _state.mode == "record"
+                 else self._final_sites)
+        from collections import Counter
+        counts = Counter(s for s in sites if s is not None)
+        return {s: n for s, n in counts.items() if n > 1}
+
     def __exit__(self, *exc):
         self._final = list(_state.decisions)
-        _state.mode, _state.decisions, _state.idx = self._saved
+        self._final_sites = list(_state.sites)
+        (_state.mode, _state.decisions, _state.sites,
+         _state.idx) = self._saved
         return False
 
 

@@ -64,6 +64,9 @@ def eager_apply(name: str, pure_fn, args: tuple, kwargs: dict):
 
 
 def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
+    if GLOBAL_FLAGS.get("dygraph_debug"):
+        from .vlog import vlog
+        vlog(1, f"eager op dispatch: {name}", component="eager")
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
     tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
     if OP_STATS_HOOK is not None:
@@ -127,6 +130,12 @@ def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
 
 def _wrap_outputs(name, out, stop_gradient, node=None):
     flat_out, out_treedef = jax.tree.flatten(out)
+    if GLOBAL_FLAGS.get("check_kernel_launch"):
+        # surface async execution errors at the op that launched them
+        # (reference FLAGS_check_kernel_launch: sync after every launch)
+        for o in flat_out:
+            if not isinstance(o, jax.core.Tracer):
+                jax.block_until_ready(o)
     if GLOBAL_FLAGS.get("check_nan_inf"):
         for o in flat_out:
             # eager sweep only on concrete arrays; under a trace the
@@ -196,11 +205,21 @@ def op_call(op_name: str, default_fn, *args, **kwargs):
     body from ``OPS`` at CALL time, so ``override_kernel(op_name, fn)``
     reaches this op — eagerly, under jit tracing, and through autograd —
     with the full call signature (arrays positional, settings as kwargs).
+
+    When an OVERRIDDEN body raises NotImplementedError and
+    ``FLAGS_enable_api_kernel_fallback`` is on (default, the reference's
+    kernel-fallback behavior), the call retries with the default body.
     """
     body = OPS.get(op_name)
     if body is None:
         OPS[op_name] = body = default_fn
-    return eager_apply(op_name, body, args, kwargs)
+    try:
+        return eager_apply(op_name, body, args, kwargs)
+    except NotImplementedError:
+        if body is not default_fn \
+                and GLOBAL_FLAGS.get("enable_api_kernel_fallback"):
+            return eager_apply(op_name, default_fn, args, kwargs)
+        raise
 
 
 def override_kernel(name: str, fn):

@@ -17,14 +17,16 @@ from typing import Any
 
 
 class _Flag:
-    __slots__ = ("name", "type", "default", "value", "help", "env_bound")
+    __slots__ = ("name", "type", "default", "value", "help", "env_bound",
+                 "on_set")
 
-    def __init__(self, name, type_, default, help_):
+    def __init__(self, name, type_, default, help_, on_set=None):
         self.name = name
         self.type = type_
         self.default = default
         self.help = help_
         self.env_bound = True
+        self.on_set = on_set     # callback(value): wire to live behavior
         env = os.environ.get(f"FLAGS_{name}")
         self.value = self._parse(env) if env is not None else default
 
@@ -47,11 +49,12 @@ class FlagRegistry:
         self._flags: dict[str, _Flag] = {}
         self._lock = threading.Lock()
 
-    def define(self, name: str, type_, default, help_: str = ""):
+    def define(self, name: str, type_, default, help_: str = "",
+               on_set=None):
         with self._lock:
             if name in self._flags:
                 return self._flags[name]
-            f = _Flag(name, type_, default, help_)
+            f = _Flag(name, type_, default, help_, on_set)
             self._flags[name] = f
             nv = _native()
             if nv is not None:
@@ -67,6 +70,8 @@ class FlagRegistry:
         nv = _native()
         if nv is not None:
             nv.flags.set(f.name, f.value)
+        if f.on_set is not None:
+            f.on_set(f.value)
 
     def __contains__(self, name):
         return name in self._flags
@@ -185,6 +190,129 @@ define_flag("tensor_operants_mode", str, "eager",
             "operator dispatch mode (eager dispatch is the only tier)")
 define_flag("jit_engine_type", str, "xla",
             "compiled-path engine (xla; the reference lists executor/pir)")
+define_flag("sot_specialization_cache_size", int, 32,
+            "max SOT-lite branch specializations kept per input signature "
+            "(LRU eviction; the reference's sot guard-cache bound)")
+
+# ---- round-4 flags tail (reference paddle/common/flags.cc; each is wired
+# to observable behavior and covered by tests/test_flags_behavior.py) ----
+
+# accuracy comparison tolerances (reference: accuracy_check_* — used by
+# amp.debugging.compare_accuracy and auto-parallel align checks)
+define_flag("accuracy_check_atol_fp32", float, 1e-5,
+            "default atol for fp32 accuracy comparison")
+define_flag("accuracy_check_rtol_fp32", float, 1e-3,
+            "default rtol for fp32 accuracy comparison")
+define_flag("accuracy_check_atol_fp16", float, 1e-3,
+            "default atol for fp16 accuracy comparison")
+define_flag("accuracy_check_rtol_fp16", float, 1e-2,
+            "default rtol for fp16 accuracy comparison")
+define_flag("accuracy_check_atol_bf16", float, 1e-2,
+            "default atol for bf16 accuracy comparison")
+define_flag("accuracy_check_rtol_bf16", float, 1e-2,
+            "default rtol for bf16 accuracy comparison")
+
+
+def _wire_alloc_fill(v):
+    from . import native
+    if native.ensure_loaded():
+        native.mem_set_fill(int(v))
+
+
+def _wire_mem_limit(v):
+    from . import native
+    if native.ensure_loaded():
+        native.mem_set_limit(int(v) * (1 << 20) if int(v) > 0 else 0)
+
+
+define_flag("alloc_fill_value", int, -1,
+            "fill fresh host allocations with this byte value "
+            "(uninitialized-read debugging; -1 = off); also fills "
+            "paddle.empty tensors", on_set=_wire_alloc_fill)
+define_flag("gpu_memory_limit_mb", int, 0,
+            "hard cap on live host-allocator MB (0 = unlimited; the "
+            "device side is capped by PJRT)", on_set=_wire_mem_limit)
+define_flag("auto_growth_chunk_size_in_mb", int, 0,
+            "minimum chunk size the caching allocator requests (advisory "
+            "granularity hint; chunks below this round up)")
+define_flag("set_to_1d", bool, False,
+            "0-D tensors convert to 1-element numpy arrays (legacy "
+            "compat; reference set_to_1d)")
+define_flag("dygraph_debug", bool, False,
+            "VLOG every eager op dispatch with its name")
+define_flag("einsum_opt", bool, False,
+            "use optimal contraction-order search in einsum")
+define_flag("enable_api_kernel_fallback", bool, True,
+            "when an overridden kernel raises NotImplementedError, fall "
+            "back to the default body (reference: "
+            "enable_api_kernel_fallback)")
+define_flag("check_kernel_launch", bool, False,
+            "block after every eager op so async errors surface at the "
+            "launch site (reference check_kernel_launch)")
+define_flag("sync_nccl_allreduce", bool, False,
+            "block until each eager collective completes (reference "
+            "sync_nccl_allreduce; TPU: block_until_ready on the result)")
+define_flag("dist_threadpool_size", int, 8,
+            "worker threads for the distributed control-plane (rpc "
+            "server pool)")
+define_flag("get_host_by_name_time", int, 120,
+            "seconds the rendezvous client keeps retrying the master")
+define_flag("tcp_max_syn_backlog", int, 128,
+            "listen backlog for the rendezvous/rpc servers")
+define_flag("enable_exit_when_partial_worker", bool, False,
+            "IterableDataset epoch ends when the FIRST worker is "
+            "exhausted (uneven shards; reference flag of the same name)")
+define_flag("reader_queue_speed_test_mode", bool, False,
+            "DataLoader re-yields the first batch without fetching "
+            "(isolates reader cost; reference flag of the same name)")
+define_flag("cache_inference_while_scope", bool, True,
+            "Predictor reuses donated input buffers between run() calls")
+define_flag("cudnn_exhaustive_search_times", int, -1,
+            "measured iterations per candidate in kernel autotune "
+            "(<=0: default 3)")
+define_flag("search_cache_max_number", int, 1000000,
+            "max entries in the kernel-autotune winner cache (oldest "
+            "evicted)")
+define_flag("gemm_use_half_precision_compute_type", bool, True,
+            "allow low-precision matmul passes; False forces HIGHEST "
+            "precision in the matmul family")
+define_flag("multiple_of_cupti_buffer_size", int, 1,
+            "multiplier on the native host-event ring capacity")
+define_flag("logging_pir_py_code_dir", str, "",
+            "when set, to_static dumps each compiled function's jaxpr "
+            "text into this directory (the PIR py-code dump analog)")
+
+
+def _wire_align_mode(v):
+    if v:
+        GLOBAL_FLAGS.set("tpu_deterministic", True)
+        GLOBAL_FLAGS.set("embedding_deterministic", True)
+
+
+define_flag("enable_auto_parallel_align_mode", bool, False,
+            "align auto-parallel runs for bitwise comparison: forces "
+            "deterministic lowerings + deterministic embedding grads",
+            on_set=_wire_align_mode)
+
+
+def _wire_compile_cache(v):
+    try:
+        import jax
+        if v:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("PADDLE_TPU_COMPILE_CACHE",
+                               "/tmp/paddle_tpu_jax_cache"))
+        else:
+            jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+
+
+define_flag("enable_cinn_compile_cache", bool, False,
+            "persistent XLA compilation cache (the CINN compile-cache "
+            "analog); set True to enable across processes",
+            on_set=_wire_compile_cache)
 define_flag("enable_pir_api", bool, False,
             "advisory: jaxpr/StableHLO is the IR on this stack")
 define_flag("enable_pir_in_executor", bool, False,

@@ -105,6 +105,8 @@ def _load():
     lib.pt_mem_allocated.restype = c.c_size_t
     lib.pt_mem_reserved.restype = c.c_size_t
     lib.pt_mem_peak.restype = c.c_size_t
+    lib.pt_mem_set_limit.argtypes = [c.c_size_t]
+    lib.pt_mem_set_fill.argtypes = [c.c_int]
     lib.pt_wq_create.argtypes = [c.c_int]
     lib.pt_wq_create.restype = c.c_void_p
     lib.pt_wq_destroy.argtypes = [c.c_void_p]
@@ -218,11 +220,25 @@ def prof_dump_chrome(path):
         raise IOError(f"cannot write {path}")
 
 
+_PROF_EXPORT_BASE = 1 << 16   # events per export page at multiplier 1
+
+
 def prof_export():
-    """Return list of (name, tid, start_ns, dur_ns, category)."""
+    """Return list of (name, tid, start_ns, dur_ns, category).
+
+    The export window is ``_PROF_EXPORT_BASE *
+    FLAGS_multiple_of_cupti_buffer_size`` events (the reference's CUPTI
+    buffer-size multiplier applied to the host recorder): a long capture
+    keeps the most recent window rather than an unbounded transfer."""
     if _lib is None:
         return []
     n = prof_event_count()
+    try:
+        from ..flags import GLOBAL_FLAGS
+        mult = max(int(GLOBAL_FLAGS.get("multiple_of_cupti_buffer_size")), 1)
+    except Exception:
+        mult = 1
+    n = min(n, _PROF_EXPORT_BASE * mult)
     if n == 0:
         return []
     c = ctypes
@@ -262,22 +278,49 @@ def mem_release_cached():
         _lib.pt_mem_release_cached()
 
 
+def mem_set_limit(nbytes: int):
+    """Hard cap on live host-allocator bytes (0 = unlimited) —
+    FLAGS_gpu_memory_limit_mb's host-tier analog."""
+    if _lib is not None:
+        _lib.pt_mem_set_limit(int(nbytes))
+
+
+def mem_set_fill(value: int):
+    """Fill fresh allocations with a byte value (-1 = off) —
+    FLAGS_alloc_fill_value."""
+    if _lib is not None:
+        _lib.pt_mem_set_fill(int(value))
+
+
 class HostBuffer:
     """A pooled 64-byte-aligned host buffer exposed as a numpy array."""
 
     def __init__(self, nbytes):
         ensure_loaded()
+        try:
+            from ..flags import GLOBAL_FLAGS
+            chunk_mb = int(GLOBAL_FLAGS.get("auto_growth_chunk_size_in_mb"))
+        except Exception:
+            chunk_mb = 0
+        alloc_bytes = nbytes
+        if chunk_mb > 0:
+            # request in chunk multiples (FLAGS_auto_growth_chunk_size_in_mb
+            # — the reference's auto-growth granularity): small buffers
+            # share pool slots instead of fragmenting it
+            chunk = chunk_mb << 20
+            alloc_bytes = ((nbytes + chunk - 1) // chunk) * chunk
         if _lib is None:
             import numpy as np
-            self._arr = np.empty(nbytes, dtype=np.uint8)
+            self._arr = np.empty(alloc_bytes, dtype=np.uint8)
             self.ptr = self._arr.ctypes.data
             self._native = False
         else:
-            self.ptr = _lib.pt_alloc(nbytes)
+            self.ptr = _lib.pt_alloc(alloc_bytes)
             if not self.ptr:
-                raise MemoryError(nbytes)
+                raise MemoryError(alloc_bytes)
             self._native = True
         self.nbytes = nbytes
+        self.alloc_bytes = alloc_bytes
 
     def as_numpy(self, dtype, shape):
         import numpy as np
@@ -371,4 +414,5 @@ __all__ = ["AVAILABLE", "ensure_loaded", "flags", "NativeFlags", "prof_enable", 
            "prof_begin", "prof_end", "prof_instant", "prof_clear",
            "prof_event_count", "prof_dump_chrome", "prof_export",
            "mem_allocated", "mem_reserved", "mem_peak", "mem_release_cached",
+           "mem_set_limit", "mem_set_fill",
            "HostBuffer", "WorkQueue"]

@@ -8,6 +8,7 @@
 #include "api.h"
 
 #include <cstdlib>
+#include <cstring>
 #include <map>
 #include <mutex>
 #include <unordered_map>
@@ -40,6 +41,12 @@ Stats& stats() {
   return s;
 }
 
+// FLAGS_gpu_memory_limit_mb analog for the host tier: hard cap on live
+// bytes (0 = unlimited). FLAGS_alloc_fill_value: fill fresh allocations
+// with a byte value for uninitialized-read debugging (-1 = off).
+size_t g_limit_bytes = 0;
+int g_fill_value = -1;
+
 }  // namespace
 
 extern "C" {
@@ -47,16 +54,23 @@ extern "C" {
 void* pt_alloc(size_t nbytes) {
   size_t sz = align_up(nbytes ? nbytes : 1);
   std::lock_guard<std::mutex> lk(g_mu);
+  if (g_limit_bytes && stats().allocated + sz > g_limit_bytes) {
+    return nullptr;  // over the configured host-memory cap
+  }
   auto& fc = free_chunks();
-  // best fit: smallest cached chunk >= sz, but not > 2x (avoid waste)
+  // best fit: smallest cached chunk >= sz, but not > 2x (avoid waste).
+  // The cap must hold for the CHUNK actually taken, not just the request
+  // (a cached chunk can be up to 2x the request).
   auto it = fc.lower_bound(sz);
-  if (it != fc.end() && it->first <= sz * 2) {
+  if (it != fc.end() && it->first <= sz * 2 &&
+      !(g_limit_bytes && stats().allocated + it->first > g_limit_bytes)) {
     void* p = it->second;
     size_t chunk = it->first;
     fc.erase(it);
     live()[p] = chunk;
     stats().allocated += chunk;
     if (stats().allocated > stats().peak) stats().peak = stats().allocated;
+    if (g_fill_value >= 0) std::memset(p, g_fill_value, chunk);
     return p;
   }
   void* p = nullptr;
@@ -65,7 +79,18 @@ void* pt_alloc(size_t nbytes) {
   stats().allocated += sz;
   stats().reserved += sz;
   if (stats().allocated > stats().peak) stats().peak = stats().allocated;
+  if (g_fill_value >= 0) std::memset(p, g_fill_value, sz);
   return p;
+}
+
+void pt_mem_set_limit(size_t nbytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_limit_bytes = nbytes;
+}
+
+void pt_mem_set_fill(int value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_fill_value = value;
 }
 
 void pt_free(void* ptr) {

@@ -57,6 +57,8 @@ PT_EXPORT size_t pt_mem_allocated();   // live bytes
 PT_EXPORT size_t pt_mem_reserved();    // live + cached bytes
 PT_EXPORT size_t pt_mem_peak();        // high-water mark of live bytes
 PT_EXPORT void pt_mem_release_cached();// return cached chunks to the OS
+PT_EXPORT void pt_mem_set_limit(size_t nbytes);  // 0 = unlimited (FLAGS_gpu_memory_limit_mb host analog)
+PT_EXPORT void pt_mem_set_fill(int value);       // -1 = off (FLAGS_alloc_fill_value)
 
 // ---- async work queue (workqueue.cc) ----
 PT_EXPORT void* pt_wq_create(int num_threads);

@@ -147,15 +147,19 @@ size_t pt_prof_export(uint64_t* starts_ns, uint64_t* durs_ns, uint64_t* tids,
   std::lock_guard<std::mutex> lk(g_mu);
   auto& ev = events();
   size_t n = ev.size() < max_events ? ev.size() : max_events;
+  // export the MOST RECENT n events (the window the user is profiling is
+  // usually right before the export, not the capture's start)
+  size_t base = ev.size() - n;
   size_t off = 0;
   for (size_t i = 0; i < n; ++i) {
-    starts_ns[i] = ev[i].start_ns;
-    durs_ns[i] = ev[i].dur_ns;
-    tids[i] = ev[i].tid;
-    categories[i] = ev[i].category;
-    size_t len = ev[i].name.size() + 1;
+    const auto& e = ev[base + i];
+    starts_ns[i] = e.start_ns;
+    durs_ns[i] = e.dur_ns;
+    tids[i] = e.tid;
+    categories[i] = e.category;
+    size_t len = e.name.size() + 1;
     if (off + len > name_buf_len) return i;  // truncated
-    std::memcpy(name_buf + off, ev[i].name.c_str(), len);
+    std::memcpy(name_buf + off, e.name.c_str(), len);
     off += len;
   }
   return n;

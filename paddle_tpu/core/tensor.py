@@ -101,7 +101,14 @@ class Tensor:
 
     # ---- conversion ----
     def numpy(self):
-        return np.asarray(self._data)
+        out = np.asarray(self._data)
+        if out.ndim == 0:
+            from .flags import GLOBAL_FLAGS
+            if GLOBAL_FLAGS.get("set_to_1d"):
+                # legacy 0-D compat (reference FLAGS_set_to_1d): scalars
+                # convert as 1-element arrays
+                return out.reshape(1)
+        return out
 
     def item(self, *args):
         return self.numpy().item(*args)

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.flags import GLOBAL_FLAGS
 from ..core.tensor import Tensor
 
 # ---- reduce ops (process_group.h ReduceOp) ----
@@ -98,6 +99,11 @@ def _in_manual_region(axis_name) -> bool:
 def _apply(x, fn):
     if isinstance(x, Tensor):
         out = fn(x._data)
+        if GLOBAL_FLAGS.get("sync_nccl_allreduce") \
+                and not isinstance(out, jax.core.Tracer):
+            # blocking-collective mode (reference FLAGS_sync_nccl_allreduce):
+            # surface comm failures at the call site, not at next readback
+            jax.block_until_ready(out)
         x._data = out
         return x
     return fn(x)

@@ -89,7 +89,16 @@ class KVServer:
                 self.send_response(200)
                 self.end_headers()
 
-        self._srv = ThreadingHTTPServer((bind_host, port), Handler)
+        from ...core.flags import GLOBAL_FLAGS
+
+        # listen backlog: a large pod's simultaneous first contacts must
+        # not get connection-refused (reference FLAGS_tcp_max_syn_backlog).
+        # A local subclass keeps the setting off the stdlib class.
+        class _KVHTTPServer(ThreadingHTTPServer):
+            request_queue_size = max(
+                int(GLOBAL_FLAGS.get("tcp_max_syn_backlog")), 5)
+
+        self._srv = _KVHTTPServer((bind_host, port), Handler)
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
@@ -136,8 +145,23 @@ class Master:
         self.client = KVClient(endpoint)
         self.job = f"/{job_id}"
 
-    def register(self, node_id, payload: dict):
-        self.client.put(f"{self.job}/nodes/{node_id}", json.dumps(payload))
+    def register(self, node_id, payload: dict, retry_window=None):
+        """Publish this node; keeps retrying an unreachable master for
+        FLAGS_get_host_by_name_time seconds (the reference's resolve/
+        connect retry window) before giving up."""
+        if retry_window is None:
+            from ...core.flags import GLOBAL_FLAGS
+            retry_window = float(GLOBAL_FLAGS.get("get_host_by_name_time"))
+        deadline = time.time() + max(retry_window, 0.0)
+        while True:
+            try:
+                self.client.put(f"{self.job}/nodes/{node_id}",
+                                json.dumps(payload))
+                return
+            except Exception:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.5)
 
     def wait_peers(self, expected, timeout=600, poll=0.2):
         t0 = time.time()

@@ -45,6 +45,32 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     _state.update(client=client, name=name, stop=stop)
     client.put(f"/rpc/workers/{name}", _enc({"rank": rank}))
 
+    # request handlers run on a bounded pool so one slow handler cannot
+    # stall the inbox (reference FLAGS_dist_threadpool_size)
+    from concurrent.futures import ThreadPoolExecutor
+    from ..core.flags import GLOBAL_FLAGS
+    pool = ThreadPoolExecutor(
+        max_workers=max(int(GLOBAL_FLAGS.get("dist_threadpool_size")), 1),
+        thread_name_prefix="ptpu-rpc")
+    _state["pool"] = pool
+
+    def _handle(payload):
+        try:
+            req = _dec(payload)
+        except Exception as e:
+            # corrupt payload: no request id to answer — log, don't die
+            # silently in the pool thread
+            from ..core.vlog import vlog
+            vlog(0, f"rpc: dropping undecodable request: "
+                    f"{type(e).__name__}: {e}", component="rpc")
+            return
+        try:
+            fn = req["fn"]
+            result = ("ok", fn(*req["args"], **req["kwargs"]))
+        except Exception as e:  # deliver the exception to the caller
+            result = ("err", e)
+        client.put(f"/rpc/result/{req['id']}", _enc(result))
+
     def serve():
         while not stop.wait(0.05):
             try:
@@ -52,14 +78,10 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
             except Exception:
                 continue
             for key, payload in inbox.items():
+                # delete in the poll loop (not the handler) so the next
+                # poll cannot double-dispatch the same request
                 client.delete(key)
-                try:
-                    req = _dec(payload)
-                    fn = req["fn"]
-                    result = ("ok", fn(*req["args"], **req["kwargs"]))
-                except Exception as e:  # deliver the exception to the caller
-                    result = ("err", e)
-                client.put(f"/rpc/result/{req['id']}", _enc(result))
+                pool.submit(_handle, payload)
 
     t = threading.Thread(target=serve, daemon=True)
     t.start()

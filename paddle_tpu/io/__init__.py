@@ -355,6 +355,32 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        from ..core.flags import GLOBAL_FLAGS
+        if GLOBAL_FLAGS.get("reader_queue_speed_test_mode"):
+            # benchmark-the-trainer mode (reference flag of the same name):
+            # fetch ONE real batch, then re-yield it for the whole epoch so
+            # measured step time excludes the input pipeline
+            it = self._real_iter()
+            try:
+                first = next(it)
+            except StopIteration:
+                return
+            it.close()   # release workers; the epoch re-yields one batch
+            yield first
+            n = None
+            try:
+                n = len(self)
+            except Exception:
+                pass
+            if n is None:
+                while True:
+                    yield first
+            for _ in range(n - 1):
+                yield first
+            return
+        yield from self._real_iter()
+
+    def _real_iter(self):
         if self.num_workers == 0:
             yield from self._iter_batches()
             return

@@ -311,6 +311,13 @@ def iter_iterable_multiprocess(loader, timeout):
                 continue
             if tag is None:
                 done += 1
+                from ..core.flags import GLOBAL_FLAGS
+                if done and GLOBAL_FLAGS.get(
+                        "enable_exit_when_partial_worker"):
+                    # uneven shards: the epoch ends when the FIRST worker
+                    # runs dry, so no rank spins on a longer shard
+                    # (reference FLAGS_enable_exit_when_partial_worker)
+                    return
                 continue
             if isinstance(payload, _Err):
                 raise RuntimeError(

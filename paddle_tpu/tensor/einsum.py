@@ -8,7 +8,9 @@ from ..core.dispatch import op_body, op_call
 
 @op_body("einsum")
 def _einsum(*xs, equation):
-    return jnp.einsum(equation, *xs)
+    from ..core.flags import GLOBAL_FLAGS
+    opt = "optimal" if GLOBAL_FLAGS.get("einsum_opt") else "auto"
+    return jnp.einsum(equation, *xs, optimize=opt)
 
 
 def einsum(equation, *operands, name=None):

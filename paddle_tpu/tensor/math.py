@@ -427,6 +427,11 @@ def _matmul_body(a, b, transpose_x=False, transpose_y=False):
         a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
     if transpose_y:
         b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    from ..core.flags import GLOBAL_FLAGS
+    if not GLOBAL_FLAGS.get("gemm_use_half_precision_compute_type"):
+        # force full-precision accumulation/passes on the MXU (reference
+        # FLAGS_gemm_use_half_precision_compute_type=False)
+        return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
     return jnp.matmul(a, b)
 
 

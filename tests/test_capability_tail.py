@@ -125,6 +125,7 @@ class TestRnntLoss:
         loss.backward()
         return float(loss.numpy()), np.asarray(logits.grad.numpy())
 
+    @pytest.mark.slow
     def test_fastemit_scales_gradients_not_loss(self):
         """warp-transducer FastEmit semantics: the loss VALUE is the plain
         transducer NLL; lambda scales the EMIT-transition gradient."""

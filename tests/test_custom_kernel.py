@@ -153,6 +153,7 @@ def _check_override(op_name, call, expect_marker, grad_input=None):
         override_kernel(op_name, old)
 
 
+@pytest.mark.slow
 def test_override_one_op_per_family(restore_ops):
     """Round-3 verdict item 3's 'done' bar: override one op per family
     (manipulation, embedding, dropout-family, pooling, norm, conv, loss,

@@ -555,6 +555,7 @@ class TestYoloLoss:
                             loss[i] += sce(v, 0.0)
         return loss
 
+    @pytest.mark.slow
     def test_parity_and_grad(self):
         rng = np.random.default_rng(0)
         n, h, w, cls = 2, 4, 4, 3

@@ -17,6 +17,7 @@ def _model():
     return LlamaForCausalLM(cfg), cfg
 
 
+@pytest.mark.slow
 def test_prefill_matches_training_forward():
     model, cfg = _model()
     ids_np = np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 12))

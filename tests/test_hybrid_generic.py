@@ -294,6 +294,7 @@ def test_dropout_inside_pipeline_seeded():
     assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g_a))
 
 
+@pytest.mark.slow
 def test_tied_embedding_grads_accumulate():
     """loss_takes_params: the head reuses the embedding weights; embed
     grads receive BOTH contributions (shared_weight semantics)."""

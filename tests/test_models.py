@@ -39,6 +39,7 @@ def test_lenet_converges():
     assert losses[-1] < losses[0] * 0.5, losses
 
 
+@pytest.mark.slow
 def test_llama_tiny_forward_backward():
     cfg = llama_tiny_config()
     m = LlamaForCausalLM(cfg)
@@ -169,6 +170,7 @@ def test_fused_linear_cross_entropy_parity():
                                float(full_pad.numpy()), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_chunked_loss_path():
     cfg = llama_tiny_config()
     cfg.loss_chunk_size = 16

@@ -8,6 +8,7 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 
 
+@pytest.mark.slow
 def test_cummax_cummin_indices():
     x = paddle.to_tensor(np.array([1.0, 3.0, 2.0, 5.0, 4.0], np.float32))
     v, i = paddle.cummax(x, axis=0)
@@ -56,6 +57,7 @@ def test_math_extras():
         (np.abs(fx.numpy()) ** 3).sum() ** (1 / 3), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_signal_roundtrip_and_grad():
     sig = np.random.default_rng(3).standard_normal(400).astype(np.float32)
     fr = paddle.signal.frame(paddle.to_tensor(sig), 64, 32)
@@ -76,6 +78,7 @@ def test_signal_roundtrip_and_grad():
     assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
 
 
+@pytest.mark.slow
 def test_affine_grid_sample_pair():
     theta = paddle.to_tensor(
         np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32), (2, 1, 1)))
@@ -177,6 +180,7 @@ def test_tensor_array_ops():
     assert paddle.array_length(init) == 2
 
 
+@pytest.mark.slow
 def test_hsigmoid_loss_default_tree():
     rng = np.random.default_rng(0)
     N, D, C = 6, 8, 10
@@ -251,6 +255,7 @@ def test_class_center_sample():
             paddle.to_tensor(np.arange(10, dtype=np.int64)), 40, 4)
 
 
+@pytest.mark.slow
 def test_max_unpool2d_roundtrip():
     x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4))
     pooled, mask = F.max_pool2d(x, 2, stride=2, return_mask=True)
@@ -297,6 +302,7 @@ def test_flash_attn_unpadded_matches_per_sequence():
     np.testing.assert_allclose(out2[lens[0]:], out[lens[0]:], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_qkvpacked_attention_wrappers():
     """Reference packed layout [.., g + 2, num_heads_k, head_dim]
     (flash_attention.py:603): g grouped query slices + K + V."""
@@ -343,6 +349,7 @@ def _gqa_oracle(q, k, v, causal):
     return out
 
 
+@pytest.mark.slow
 def test_qkvpacked_gqa_value_parity():
     """GQA head pairing must match the reference kernel (contiguous groups,
     j // g), not interleaved tiling (j % hk)."""
@@ -367,6 +374,7 @@ def test_qkvpacked_gqa_value_parity():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_attention_return_softmax():
     rng = np.random.default_rng(4)
     qkv = paddle.to_tensor(

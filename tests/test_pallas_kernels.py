@@ -13,6 +13,9 @@ from paddle_tpu.kernels.flash_attention import flash_attention
 from paddle_tpu.kernels.rms_norm import rms_norm
 from paddle_tpu.nn.functional.attention import _sdpa_reference
 
+# compile-heavy: slow tier (fast tier stays < 4 min, pytest.ini contract)
+pytestmark = pytest.mark.slow
+
 
 def _ref_attn(q, k, v, causal):
     """Reference attention in kernel layout [b, h, s, d] (GQA-aware)."""

@@ -10,6 +10,9 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from paddle_tpu import sparse
 
+# compile-heavy: slow tier (fast tier stays < 4 min, pytest.ini contract)
+pytestmark = pytest.mark.slow
+
 
 def _random_sparse(rng, shape_sp, channels, density=0.2):
     """(SparseCooTensor NDHWC-style, dense numpy)."""

@@ -12,6 +12,9 @@ from paddle_tpu.quantization import (
     AbsmaxObserver, EMAObserver, QAT, QuantConfig, FakeQuanterWithAbsMax,
     fake_quantize)
 
+# compile-heavy: slow tier (fast tier stays < 4 min, pytest.ini contract)
+pytestmark = pytest.mark.slow
+
 
 def test_sparse_coo_roundtrip():
     idx = np.array([[0, 1, 2], [1, 0, 2]])
