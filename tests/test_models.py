@@ -79,6 +79,7 @@ def test_rope_rotation_property():
         rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_llama_gqa_heads():
     cfg = llama_tiny_config(num_key_value_heads=2)
     m = LlamaForCausalLM(cfg)
@@ -123,6 +124,7 @@ def test_resnet18_forward():
     assert m(x).shape == [1, 10]
 
 
+@pytest.mark.slow
 def test_fused_linear_cross_entropy_parity():
     """Chunked fused CE head: loss and gradient parity with the full-logits
     path (both tied and untied head layouts)."""

@@ -1,0 +1,123 @@
+"""Serving loop over the jit artifact (round-3 verdict item 10):
+request batching + cached donated step + artifact version header.
+Done-bar: multi-request throughput beats per-call run() by >= 2x.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.inference as infer
+from paddle_tpu.jit.save_load import InputSpec, ARTIFACT_VERSION
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serve")
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 64), paddle.nn.GELU(),
+        paddle.nn.Linear(64, 8))
+    prefix = str(d / "mlp")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, 16], "float32")])
+    return prefix
+
+
+def test_artifact_version_header(artifact):
+    meta = json.load(open(artifact + ".meta.json"))
+    assert meta["artifact_version"] == ARTIFACT_VERSION
+    pred = infer.create_predictor(infer.Config(artifact))
+    sess = infer.ServingSession(pred)
+    assert sess.artifact_version == ARTIFACT_VERSION
+
+
+def test_version_mismatch_rejected(artifact, tmp_path):
+    import shutil
+    prefix = str(tmp_path / "old")
+    for ext in (".pdmodel", ".pdiparams", ".meta.json"):
+        shutil.copy(artifact + ext, prefix + ext)
+    meta = json.load(open(prefix + ".meta.json"))
+    meta["artifact_version"] = [99, 0]
+    json.dump(meta, open(prefix + ".meta.json", "w"))
+    with pytest.raises(ValueError, match="major version"):
+        infer.create_predictor(infer.Config(prefix))
+
+
+def test_batched_results_match_per_call(artifact):
+    pred = infer.create_predictor(infer.Config(artifact))
+    sess = infer.ServingSession(pred)
+    rng = np.random.default_rng(0)
+    reqs = [[rng.standard_normal((1, 16)).astype(np.float32)]
+            for _ in range(5)]
+    batched = sess.run_batch(reqs)
+    for req, out in zip(reqs, batched):
+        ref = pred.run([req[0]])
+        np.testing.assert_allclose(out[0], ref[0], rtol=1e-5, atol=1e-6)
+
+
+def test_submit_result_tickets(artifact):
+    pred = infer.create_predictor(infer.Config(artifact))
+    sess = infer.ServingSession(pred, max_batch_size=4)
+    rng = np.random.default_rng(1)
+    xs = [rng.standard_normal((1, 16)).astype(np.float32) for _ in range(3)]
+    tickets = [sess.submit(x) for x in xs]
+    # results fetchable in any order; flush happens on demand
+    out2 = sess.result(tickets[2])
+    out0 = sess.result(tickets[0])
+    np.testing.assert_allclose(out0[0], pred.run([xs[0]])[0], rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out2[0], pred.run([xs[2]])[0], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_batched_throughput_beats_per_call(artifact):
+    pred = infer.create_predictor(infer.Config(artifact))
+    sess = infer.ServingSession(pred)
+    rng = np.random.default_rng(2)
+    n_req = 32
+    reqs = [[rng.standard_normal((1, 16)).astype(np.float32)]
+            for _ in range(n_req)]
+
+    # warm both paths (compile excluded from both timings); the bucketed
+    # step means the warm 32-request batch compiles the same executable
+    # the timed batch reuses
+    pred.run([reqs[0][0]])
+    sess.run_batch(reqs)
+
+    # best-of-3 on each path: a CI machine under load must not turn a
+    # real >=2x architectural win into a flaky timing assert
+    per_call = min(_time_once(lambda: [pred.run([r[0]]) for r in reqs])
+                   for _ in range(3))
+    batched = min(_time_once(lambda: sess.run_batch(reqs))
+                  for _ in range(3))
+
+    speedup = per_call / batched
+    assert speedup >= 2.0, (
+        f"batched serving {batched:.4f}s vs per-call {per_call:.4f}s "
+        f"(x{speedup:.2f}) — expected >= 2x")
+
+
+def _time_once(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def test_cache_flag_off_still_correct(artifact):
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+    pred = infer.create_predictor(infer.Config(artifact))
+    sess = infer.ServingSession(pred)
+    old = GLOBAL_FLAGS.get("cache_inference_while_scope")
+    GLOBAL_FLAGS.set("cache_inference_while_scope", False)
+    try:
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 16)).astype(np.float32)
+        out = sess.run_batch([[x]])
+        np.testing.assert_allclose(out[0][0], pred.run([x])[0], rtol=1e-5,
+                                   atol=1e-6)
+        assert sess._steps == {}   # no cached step when the flag is off
+    finally:
+        GLOBAL_FLAGS.set("cache_inference_while_scope", old)

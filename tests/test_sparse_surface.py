@@ -1,0 +1,141 @@
+"""paddle.sparse API surface completion (round-3 verdict item 8):
+coalesce/is_coalesced, mask_as, masked_matmul, addmm, the binary family,
+and the unary tail — parity against dense numpy references.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+
+
+def _coo(dense):
+    d = np.asarray(dense, np.float32)
+    idx = np.stack(np.nonzero(d))
+    vals = d[tuple(idx)]
+    return sparse.sparse_coo_tensor(idx, vals, shape=d.shape), d
+
+
+class TestUnaryTail:
+    def test_value_ops_match_dense_on_pattern(self):
+        d = np.zeros((3, 4), np.float32)
+        d[0, 1], d[2, 3], d[1, 0] = 0.3, -0.7, 0.5
+        s, _ = _coo(d)
+        for name in ("asin", "atan", "sinh", "tan", "expm1", "log1p",
+                     "deg2rad", "rad2deg"):
+            out = getattr(sparse, name)(s)
+            ref = getattr(np, {"asin": "arcsin", "atan": "arctan"}.get(
+                name, name))(d[d != 0])
+            np.testing.assert_allclose(out.values().numpy(), ref,
+                                       rtol=1e-5, err_msg=name)
+        assert not bool(np.any(sparse.isnan(s).values().numpy()))
+
+    def test_cast(self):
+        s, _ = _coo(np.eye(3))
+        out = sparse.cast(s, index_dtype="int64", value_dtype="float64")
+        # x64 is disabled on this stack: 64-bit requests map to 32-bit
+        assert out.values().numpy().dtype in (np.float32, np.float64)
+        assert out.nnz() == 3
+
+    def test_coalesce_and_is_coalesced(self):
+        idx = np.asarray([[0, 0, 1], [1, 1, 2]])      # duplicate (0,1)
+        vals = np.asarray([1.0, 2.0, 3.0], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, shape=[2, 3])
+        assert not sparse.is_coalesced(s)
+        c = sparse.coalesce(s)
+        assert sparse.is_coalesced(c)
+        assert c.nnz() == 2
+        dense = c.to_dense().numpy()
+        assert dense[0, 1] == pytest.approx(3.0)      # 1+2 merged
+        assert dense[1, 2] == pytest.approx(3.0)
+
+    def test_reshape_transpose_slice_sum(self):
+        d = np.zeros((2, 6), np.float32)
+        d[0, 1], d[1, 4] = 2.0, 5.0
+        s, _ = _coo(d)
+        r = sparse.reshape(s, [3, 4])
+        np.testing.assert_allclose(r.to_dense().numpy(), d.reshape(3, 4))
+        t = sparse.transpose(s, [1, 0])
+        np.testing.assert_allclose(t.to_dense().numpy(), d.T)
+        sl = sparse.slice(s, axes=[1], starts=[1], ends=[5])
+        np.testing.assert_allclose(sl.to_dense().numpy(), d[:, 1:5])
+        total = sparse.sum(s)
+        assert float(total.numpy()) == pytest.approx(7.0)
+        by_row = sparse.sum(s, axis=1)
+        np.testing.assert_allclose(np.asarray(by_row.numpy()), d.sum(1))
+
+    def test_pca_lowrank_runs(self):
+        d = np.zeros((6, 5), np.float32)
+        d[0, 0], d[2, 3], d[5, 1] = 1.0, 2.0, 3.0
+        s, _ = _coo(d)
+        u, sv, v = sparse.pca_lowrank(s, q=2)
+        assert tuple(u.shape) == (6, 2) and tuple(v.shape) == (5, 2)
+
+
+class TestBinaryFamily:
+    def test_same_pattern_ops(self):
+        d = np.zeros((3, 3), np.float32)
+        d[0, 1], d[2, 2] = 2.0, 4.0
+        a, _ = _coo(d)
+        b, _ = _coo(d * 3)
+        for name, ref in (("add", d + 3 * d), ("subtract", d - 3 * d),
+                          ("multiply", None), ("divide", None)):
+            out = getattr(sparse, name)(a, b)
+            if name == "multiply":
+                # value-wise on the shared pattern (reference semantics)
+                np.testing.assert_allclose(
+                    out.values().numpy(), d[d != 0] * (3 * d)[d != 0])
+            elif name == "divide":
+                np.testing.assert_allclose(
+                    out.values().numpy(), np.full(2, 1 / 3), rtol=1e-6)
+            else:
+                np.testing.assert_allclose(out.to_dense().numpy(), ref)
+
+    def test_is_same_shape_and_mv(self):
+        a, d = _coo(np.eye(3, dtype=np.float32) * 2)
+        b, _ = _coo(np.eye(3, dtype=np.float32))
+        assert sparse.is_same_shape(a, b)
+        v = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        out = sparse.mv(a, v)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2., 4., 6.])
+
+    def test_mask_as(self):
+        mask, dm = _coo(np.tril(np.ones((3, 3), np.float32)))
+        x = paddle.to_tensor(
+            np.arange(9, dtype=np.float32).reshape(3, 3))
+        out = sparse.mask_as(x, mask)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   np.tril(np.arange(9).reshape(3, 3)))
+        # grads flow to the dense source
+        x.stop_gradient = False
+        out = sparse.mask_as(x, mask)
+        out.values().sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.tril(np.ones((3, 3))))
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.default_rng(0)
+        xd = rng.standard_normal((4, 6)).astype(np.float32)
+        yd = rng.standard_normal((6, 5)).astype(np.float32)
+        md = np.zeros((4, 5), np.float32)
+        md[0, 0], md[1, 3], md[3, 4] = 1, 1, 1
+        mask, _ = _coo(md)
+        out = sparse.masked_matmul(paddle.to_tensor(xd),
+                                   paddle.to_tensor(yd), mask)
+        ref = (xd @ yd) * md
+        np.testing.assert_allclose(out.to_dense().numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.default_rng(1)
+        xd = np.zeros((3, 4), np.float32)
+        xd[0, 1], xd[2, 0] = 2.0, -1.0
+        x, _ = _coo(xd)
+        y = paddle.to_tensor(rng.standard_normal((4, 2)).astype(np.float32))
+        inp = paddle.to_tensor(rng.standard_normal((3, 2)).astype(np.float32))
+        out = sparse.addmm(inp, x, y, beta=0.5, alpha=2.0)
+        ref = 0.5 * np.asarray(inp.numpy()) + 2.0 * (xd @ np.asarray(y.numpy()))
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                                   atol=1e-5)

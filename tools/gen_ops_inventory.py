@@ -271,9 +271,90 @@ Counts: {counts['yes']} yes / {counts['as']} as / \
 |---|---|---|
 """
     body = "\n".join(f"| {op} | {s} | {note} |" for op, s, note in rows)
-    open("OPS_INVENTORY.md", "w").write(hdr + body + "\n")
+    sparse_section = _sparse_table()
+    open("OPS_INVENTORY.md", "w").write(hdr + body + "\n" + sparse_section)
     print(counts)
     print("todos:", [op for op, s, _ in rows if s == "todo"])
+
+
+# paddle.sparse ops with a deliberate non-sparse implementation; the note
+# is the audit trail the round-3 verdict asked for (no silent holes)
+SPARSE_NOTES = {
+    "conv3d_implicit_gemm": ("as", "sparse.nn.functional conv3d path "
+                             "(rulebook gather + MXU matmul — the implicit-"
+                             "gemm formulation IS the TPU lowering)"),
+    "sync_batch_norm_": ("as", "sparse.nn.BatchNorm over values + "
+                         "distributed sync via GSPMD (dense stats are "
+                         "tiny; a sparse-specific allreduce buys nothing)"),
+    "batch_norm_": ("as", "sparse.nn.BatchNorm (normalizes stored values)"),
+    "divide_scalar": ("as", "sparse.divide with a scalar operand"),
+    "to_sparse_csr": ("as", "sparse_csr_tensor / SparseCsrTensor view"),
+    "scale": ("as", "sparse values scale via sparse.multiply / dense "
+              "scale on values"),
+    "pca_lowrank": ("as", "sparse.pca_lowrank — densifies then SVDs: at "
+                    "reference-supported sizes (q <= min(m,n)) one dense "
+                    "XLA SVD on the MXU beats serialized sparse matvec "
+                    "iterations; measured dense matmul numbers in "
+                    "docs/PERF.md back the dense-wins call"),
+    "mask_as": ("yes", ""),
+    "masked_matmul": ("yes", "SDDMM at stored coordinates, O(nnz*k)"),
+    "fused_attention": ("as", "sparse.nn.functional.attention (masked "
+                        "softmax-attention over the stored pattern)"),
+    "maxpool": ("as", "sparse.nn.functional.max_pool3d / nn.MaxPool3D"),
+    "indices": ("as", "SparseCooTensor.indices() method"),
+    "values": ("as", "SparseCooTensor.values() method"),
+    "to_dense": ("as", "SparseCooTensor.to_dense() method"),
+}
+
+
+def _sparse_table():
+    """Audit paddle.sparse against the reference sparse surface
+    (sparse_ops.yaml + python/paddle/sparse exports) — round-3 verdict
+    item 8: the table must have no silent holes."""
+    import paddle_tpu.sparse as sp
+    ref_ops = set()
+    for line in open("/root/reference/paddle/phi/ops/yaml/sparse_ops.yaml"):
+        m = re.match(r"- op\s*:\s*([a-z0-9_]+)", line)
+        if m:
+            ref_ops.add(m.group(1))
+    # python-surface exports (binary/creation/multiary/unary __all__)
+    for name in ("add", "divide", "is_same_shape", "mask_as",
+                 "masked_matmul", "matmul", "multiply", "mv", "subtract",
+                 "sparse_coo_tensor", "sparse_csr_tensor", "addmm",
+                 "coalesce", "deg2rad", "rad2deg", "reshape", "slice",
+                 "sum", "transpose", "pca_lowrank", "cast", "isnan",
+                 "expm1", "log1p", "neg", "pow"):
+        ref_ops.add(name)
+    have = {n for n in dir(sp) if not n.startswith("_")}
+    have |= {n for n in dir(sp.nn) if not n.startswith("_")}
+    have |= {n for n in dir(sp.functional) if not n.startswith("_")}
+    rows = []
+    n_yes = n_as = n_todo = 0
+    for op in sorted(ref_ops):
+        if op in SPARSE_NOTES:
+            s, note = SPARSE_NOTES[op]
+            note = note or "same name in paddle_tpu.sparse"
+            n_yes += s == "yes"
+            n_as += s == "as"
+        elif op in have or op.rstrip("_") in have:
+            s, note = "yes", "same name in paddle_tpu.sparse"
+            n_yes += 1
+        else:
+            s, note = "todo", "unmapped"
+            n_todo += 1
+        rows.append(f"| {op} | {s} | {note} |")
+    body = "\n".join(rows)
+    return f"""
+## paddle.sparse surface (reference: sparse_ops.yaml + python/paddle/sparse)
+
+{n_yes} yes / {n_as} as / {n_todo} todo of {len(ref_ops)} sparse ops.
+Rows marked **as** document where a deliberately non-sparse (dense-XLA)
+implementation wins on TPU and why.
+
+| sparse op | status | where / why |
+|---|---|---|
+{body}
+"""
 
 
 if __name__ == "__main__":
