@@ -107,6 +107,12 @@ def _load():
     lib.pt_mem_peak.restype = c.c_size_t
     lib.pt_mem_set_limit.argtypes = [c.c_size_t]
     lib.pt_mem_set_fill.argtypes = [c.c_int]
+    lib.pt_store_start.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                    c.c_char_p]
+    lib.pt_store_start.restype = c.c_void_p
+    lib.pt_store_port.argtypes = [c.c_void_p]
+    lib.pt_store_port.restype = c.c_int
+    lib.pt_store_stop.argtypes = [c.c_void_p]
     lib.pt_wq_create.argtypes = [c.c_int]
     lib.pt_wq_create.restype = c.c_void_p
     lib.pt_wq_destroy.argtypes = [c.c_void_p]
@@ -292,6 +298,33 @@ def mem_set_fill(value: int):
         _lib.pt_mem_set_fill(int(value))
 
 
+# ---------------------------------------------------------------------------
+# TCP key-value store (reference TCPStore, tcp_store.h:121)
+# ---------------------------------------------------------------------------
+
+def store_start(port=0, backlog=None, bind_host="", token=""):
+    """Start the native TCP store server; returns (handle, port)."""
+    ensure_loaded()
+    if _lib is None:
+        raise RuntimeError("native runtime unavailable")
+    if backlog is None:
+        try:
+            from ..flags import GLOBAL_FLAGS
+            backlog = int(GLOBAL_FLAGS.get("tcp_max_syn_backlog"))
+        except Exception:
+            backlog = 128
+    h = _lib.pt_store_start((bind_host or "").encode(), int(port),
+                            int(backlog), (token or "").encode())
+    if not h:
+        raise OSError(f"pt_store_start failed on port {port}")
+    return h, int(_lib.pt_store_port(h))
+
+
+def store_stop(handle):
+    if _lib is not None and handle:
+        _lib.pt_store_stop(handle)
+
+
 class HostBuffer:
     """A pooled 64-byte-aligned host buffer exposed as a numpy array."""
 
@@ -414,5 +447,5 @@ __all__ = ["AVAILABLE", "ensure_loaded", "flags", "NativeFlags", "prof_enable", 
            "prof_begin", "prof_end", "prof_instant", "prof_clear",
            "prof_event_count", "prof_dump_chrome", "prof_export",
            "mem_allocated", "mem_reserved", "mem_peak", "mem_release_cached",
-           "mem_set_limit", "mem_set_fill",
+           "mem_set_limit", "mem_set_fill", "store_start", "store_stop",
            "HostBuffer", "WorkQueue"]

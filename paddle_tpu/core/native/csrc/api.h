@@ -60,6 +60,16 @@ PT_EXPORT void pt_mem_release_cached();// return cached chunks to the OS
 PT_EXPORT void pt_mem_set_limit(size_t nbytes);  // 0 = unlimited (FLAGS_gpu_memory_limit_mb host analog)
 PT_EXPORT void pt_mem_set_fill(int value);       // -1 = off (FLAGS_alloc_fill_value)
 
+// ---- TCP key-value store (tcp_store.cc) ----
+// Reference: TCPStore (paddle/phi/core/distributed/store/tcp_store.h:121).
+// Threaded socket server; clients speak the binary protocol documented in
+// tcp_store.cc over plain sockets (see paddle_tpu/distributed/store.py).
+// bind_host ""/nullptr = all interfaces; token non-empty requires AUTH.
+PT_EXPORT void* pt_store_start(const char* bind_host, int port, int backlog,
+                               const char* token);
+PT_EXPORT int pt_store_port(void* handle);
+PT_EXPORT void pt_store_stop(void* handle);
+
 // ---- async work queue (workqueue.cc) ----
 PT_EXPORT void* pt_wq_create(int num_threads);
 PT_EXPORT void pt_wq_destroy(void* wq);

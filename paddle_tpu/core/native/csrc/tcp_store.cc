@@ -1,0 +1,363 @@
+// Native TCP key-value store — the rendezvous/coordination primitive.
+//
+// Reference design: TCPStore (paddle/phi/core/distributed/store/
+// tcp_store.h:121, tcp_store.cc MasterDaemon/TCPServer): a blocking
+// key-value server every rank dials for rendezvous, barrier counters and
+// small control-plane exchanges. This is the C++ tier of that component
+// for the TPU stack (SURVEY §2.4 C23): a threaded socket server with a
+// length-prefixed binary protocol; Python clients (distributed/store.py)
+// speak it directly over sockets, so worker processes need no ctypes.
+//
+// Protocol (all integers little-endian):
+//   request:  u8 cmd | u32 key_len | key | u32 val_len | val
+//   response: u8 status | u32 payload_len | payload
+//   cmd: 0=AUTH(token in val; must be first when the server has a token)
+//        1=SET 2=GET 3=DELETE 4=ADD(i64 delta in val; returns new value)
+//        5=WAIT(u32 timeout_ms in val; blocks until key exists;
+//               timeout_ms==0 is an immediate existence check)
+//        6=PREFIX(list: repeated u32 klen|key|u32 vlen|val)
+//        7=COUNT(number of keys, u64)
+//   status: 0=ok 1=not_found 2=timeout 3=bad_request 4=auth_required
+//
+// Locking discipline: the store mutex guards MAP ACCESS only — every
+// response is serialized to a local buffer under the lock and sent after
+// it is released, so one stalled client's TCP window can never block the
+// whole store.
+#include "api.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;   // signaled on every SET/ADD
+  std::map<std::string, std::string> kv;
+};
+
+struct Conn {
+  int fd = -1;
+  std::thread th;
+  bool closed = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::string token;            // empty = no auth required
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::mutex conns_mu;
+  std::map<uint64_t, Conn> conns;
+  std::vector<uint64_t> finished;   // conn ids ready to reap
+  uint64_t next_id = 0;
+  Store store;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = ::recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k <= 0) return false;
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
+}
+
+bool send_resp(int fd, uint8_t status, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  std::string out;
+  out.reserve(5 + payload.size());
+  out.push_back(static_cast<char>(status));
+  out.append(reinterpret_cast<const char*>(&len), 4);
+  out += payload;
+  return write_full(fd, out.data(), out.size());
+}
+
+void handle_conn(Server* srv, uint64_t conn_id, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  bool authed = srv->token.empty();
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &cmd, 1) || !read_full(fd, &klen, 4)) break;
+    if (klen > (64u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, key.data(), klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    if (vlen > (256u << 20)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+    if (cmd == 0) {  // AUTH
+      authed = authed || val == srv->token;
+      if (!send_resp(fd, authed ? 0 : 4, "")) break;
+      if (!authed) break;  // wrong token: drop the connection
+      continue;
+    }
+    if (!authed) {
+      send_resp(fd, 4, "");
+      break;
+    }
+
+    Store& st = srv->store;
+    uint8_t status = 0;
+    std::string payload;   // built under the lock, SENT outside it
+    switch (cmd) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          st.kv[key] = val;
+        }
+        st.cv.notify_all();
+        break;
+      }
+      case 2: {  // GET
+        std::lock_guard<std::mutex> lk(st.mu);
+        auto it = st.kv.find(key);
+        if (it == st.kv.end()) {
+          status = 1;
+        } else {
+          payload = it->second;
+        }
+        break;
+      }
+      case 3: {  // DELETE
+        std::lock_guard<std::mutex> lk(st.mu);
+        st.kv.erase(key);
+        break;
+      }
+      case 4: {  // ADD: treat value as decimal int64 delta
+        int64_t delta = 0;
+        try {
+          delta = std::stoll(val.empty() ? "1" : val);
+        } catch (...) {
+          status = 3;
+          break;
+        }
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          int64_t cur = 0;
+          auto it = st.kv.find(key);
+          if (it != st.kv.end()) {
+            try {
+              cur = std::stoll(it->second);
+            } catch (...) {
+              cur = 0;
+            }
+          }
+          payload = std::to_string(cur + delta);
+          st.kv[key] = payload;
+        }
+        st.cv.notify_all();
+        break;
+      }
+      case 5: {  // WAIT with timeout_ms (0 = immediate existence check)
+        uint32_t timeout_ms = 0;
+        if (val.size() == 4) {
+          std::memcpy(&timeout_ms, val.data(), 4);
+        } else {
+          status = 3;
+          break;
+        }
+        std::unique_lock<std::mutex> lk(st.mu);
+        auto pred = [&] {
+          return srv->stop.load() || st.kv.count(key) > 0;
+        };
+        bool found;
+        if (timeout_ms == 0) {
+          found = st.kv.count(key) > 0;
+        } else {
+          found = st.cv.wait_for(
+              lk, std::chrono::milliseconds(timeout_ms), pred) &&
+              st.kv.count(key) > 0;
+        }
+        if (found) {
+          payload = st.kv[key];
+        } else {
+          status = 2;
+        }
+        break;
+      }
+      case 6: {  // PREFIX listing
+        std::lock_guard<std::mutex> lk(st.mu);
+        for (auto it = st.kv.lower_bound(key); it != st.kv.end(); ++it) {
+          if (it->first.compare(0, key.size(), key) != 0) break;
+          uint32_t kl = static_cast<uint32_t>(it->first.size());
+          uint32_t vl = static_cast<uint32_t>(it->second.size());
+          payload.append(reinterpret_cast<const char*>(&kl), 4);
+          payload += it->first;
+          payload.append(reinterpret_cast<const char*>(&vl), 4);
+          payload += it->second;
+        }
+        break;
+      }
+      case 7: {  // COUNT
+        std::lock_guard<std::mutex> lk(st.mu);
+        payload = std::to_string(st.kv.size());
+        break;
+      }
+      default:
+        status = 3;
+    }
+    if (!send_resp(fd, status, payload)) break;
+  }
+  // close + hand this connection to the reaper (never leave a stale fd in
+  // the table: the number may be reused by an unrelated descriptor)
+  std::lock_guard<std::mutex> lk(srv->conns_mu);
+  ::close(fd);
+  auto it = srv->conns.find(conn_id);
+  if (it != srv->conns.end()) {
+    it->second.closed = true;
+    it->second.fd = -1;
+  }
+  srv->finished.push_back(conn_id);
+}
+
+void reap_finished(Server* srv) {
+  std::vector<std::thread> done;
+  {
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    for (uint64_t id : srv->finished) {
+      auto it = srv->conns.find(id);
+      if (it != srv->conns.end()) {
+        done.push_back(std::move(it->second.th));
+        srv->conns.erase(it);
+      }
+    }
+    srv->finished.clear();
+  }
+  for (auto& t : done) {
+    if (t.joinable()) t.join();
+  }
+}
+
+void accept_loop(Server* srv) {
+  while (!srv->stop.load()) {
+    sockaddr_in cli{};
+    socklen_t len = sizeof(cli);
+    int fd = ::accept(srv->listen_fd, reinterpret_cast<sockaddr*>(&cli),
+                      &len);
+    if (fd < 0) {
+      if (srv->stop.load()) break;
+      continue;
+    }
+    reap_finished(srv);   // bounded state across long elastic jobs
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    uint64_t id = srv->next_id++;
+    Conn& c = srv->conns[id];
+    c.fd = fd;
+    c.th = std::thread(handle_conn, srv, id, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a TCP store server. `bind_host` restricts the listening interface
+// (nullptr/"" = all interfaces — only safe on trusted networks; the
+// launch layer passes its rendezvous bind host). `port` 0 = ephemeral.
+// `backlog` is the listen queue (FLAGS_tcp_max_syn_backlog). `token`
+// non-empty requires clients to AUTH first (the KVServer shared-secret
+// convention). Returns an opaque handle, or nullptr on bind failure.
+void* pt_store_start(const char* bind_host, int port, int backlog,
+                     const char* token) {
+  auto* srv = new Server();
+  if (token) srv->token = token;
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind_host && bind_host[0] &&
+      ::inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, backlog > 0 ? backlog : 128) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread(accept_loop, srv);
+  return srv;
+}
+
+int pt_store_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void pt_store_stop(void* handle) {
+  if (!handle) return;
+  auto* srv = static_cast<Server*>(handle);
+  srv->stop.store(true);
+  srv->store.cv.notify_all();      // release blocked WAITs
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    // unblock every LIVE connection's recv (closed ones already removed
+    // themselves or are marked closed with fd=-1)
+    std::lock_guard<std::mutex> lk(srv->conns_mu);
+    for (auto& kv : srv->conns) {
+      if (!kv.second.closed && kv.second.fd >= 0) {
+        ::shutdown(kv.second.fd, SHUT_RDWR);
+      }
+    }
+  }
+  // join everything (handlers exit once their sockets are shut down)
+  for (;;) {
+    std::thread th;
+    {
+      std::lock_guard<std::mutex> lk(srv->conns_mu);
+      if (srv->conns.empty()) break;
+      auto it = srv->conns.begin();
+      th = std::move(it->second.th);
+      srv->conns.erase(it);
+    }
+    if (th.joinable()) th.join();
+  }
+  delete srv;
+}
+
+}  // extern "C"

@@ -31,6 +31,7 @@ from . import auto_tuner  # noqa: F401
 from .elastic import ElasticManager, HealthMonitor  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention, ring_attention_p, ulysses_attention, ulysses_attention_p,
 )

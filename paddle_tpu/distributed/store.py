@@ -1,0 +1,189 @@
+"""TCPStore — the distributed key-value rendezvous store.
+
+Reference: ``paddle.distributed.TCPStore``
+(paddle/phi/core/distributed/store/tcp_store.h:121; Python surface
+python/paddle/distributed/__init__.py TCPStore). The SERVER is the native
+C++ threaded socket daemon (core/native/csrc/tcp_store.cc, SURVEY §2.4
+C23's native tier); clients here speak its length-prefixed binary
+protocol over plain sockets, so worker processes need neither ctypes nor
+the native library.
+
+Trust model matches the launch KVServer: pass ``token`` (or set
+``PADDLE_TPU_RDZV_TOKEN``) and the server rejects un-authenticated
+connections; ``bind_host`` restricts the master's listening interface.
+
+API (reference-shaped): ``set/get/wait/add/delete_key`` plus
+``get_prefix``/``num_keys`` used by the control plane.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+_AUTH, _SET, _GET, _DEL, _ADD, _WAIT, _PREFIX, _COUNT = 0, 1, 2, 3, 4, 5, 6, 7
+_OK, _NOT_FOUND, _TIMEOUT, _BAD, _AUTH_REQ = 0, 1, 2, 3, 4
+
+_U32_MAX = 0xFFFFFFFF
+
+
+class TCPStore:
+    """Client (and, for the master rank, owner) of the native TCP store.
+
+    master rank: ``TCPStore(host, port, is_master=True, world_size=n)``
+    starts the C++ daemon in-process; other ranks connect to it.
+    """
+
+    def __init__(self, host, port, is_master=False, world_size=1,
+                 timeout=900, token=None, bind_host=""):
+        self.host = host
+        self.is_master = bool(is_master)
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+        self._token = token if token is not None else \
+            os.environ.get("PADDLE_TPU_RDZV_TOKEN", "")
+        self._server = None
+        self._lock = threading.Lock()
+        if self.is_master:
+            from ..core import native
+            self._server, port = native.store_start(
+                port, bind_host=bind_host, token=self._token)
+        self.port = int(port)
+        self._sock = self._connect()
+
+    def _connect(self):
+        deadline = time.monotonic() + self.timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"TCPStore: cannot reach {self.host}:{self.port} within "
+                f"{self.timeout}s: {last}")
+        if self._token:
+            self._sock = s
+            status, _ = self._request(_AUTH, b"", self._token.encode())
+            if status != _OK:
+                s.close()
+                raise PermissionError("TCPStore: authentication rejected")
+        return s
+
+    # -- protocol --
+    def _recv_full(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("TCPStore: server closed connection")
+            buf += chunk
+        return buf
+
+    def _request(self, cmd, key: bytes, val: bytes = b"",
+                 rcv_timeout=None):
+        """One request/response exchange. The SOCKET timeout is set per
+        call to strictly exceed any server-side wait, so a blocking WAIT
+        cannot race the transport timeout and desynchronize the stream."""
+        msg = struct.pack("<BI", cmd, len(key)) + key \
+            + struct.pack("<I", len(val)) + val
+        deadline = (self.timeout if rcv_timeout is None
+                    else rcv_timeout) + 5.0
+        with self._lock:
+            self._sock.settimeout(deadline)
+            self._sock.sendall(msg)
+            status, plen = struct.unpack("<BI", self._recv_full(5))
+            payload = self._recv_full(plen) if plen else b""
+        return status, payload
+
+    @staticmethod
+    def _b(v):
+        if isinstance(v, bytes):
+            return v
+        return str(v).encode()
+
+    # -- reference API --
+    def set(self, key, value):
+        status, _ = self._request(_SET, self._b(key), self._b(value))
+        if status != _OK:
+            raise RuntimeError(f"TCPStore.set failed (status {status})")
+
+    def get(self, key):
+        """Blocking get (the reference's semantics): waits for the key up
+        to the store timeout."""
+        return self.wait(key, timeout=self.timeout)
+
+    def try_get(self, key):
+        status, payload = self._request(_GET, self._b(key))
+        return payload if status == _OK else None
+
+    def wait(self, key, timeout=None):
+        t = self.timeout if timeout is None else float(timeout)
+        # timeout == 0 is an immediate existence check (the server's
+        # WAIT treats 0 the same way); cap at the u32 wire limit
+        ms = min(int(t * 1000), _U32_MAX)
+        status, payload = self._request(
+            _WAIT, self._b(key), struct.pack("<I", ms), rcv_timeout=t)
+        if status == _TIMEOUT:
+            raise TimeoutError(f"TCPStore: key {key!r} not set within {t}s")
+        if status != _OK:
+            raise RuntimeError(f"TCPStore.wait failed (status {status})")
+        return payload
+
+    def add(self, key, amount=1) -> int:
+        status, payload = self._request(_ADD, self._b(key),
+                                        str(int(amount)).encode())
+        if status != _OK:
+            raise RuntimeError(f"TCPStore.add failed (status {status})")
+        return int(payload)
+
+    def delete_key(self, key):
+        self._request(_DEL, self._b(key))
+
+    def get_prefix(self, prefix) -> dict:
+        status, payload = self._request(_PREFIX, self._b(prefix))
+        if status != _OK:
+            raise RuntimeError(f"TCPStore.get_prefix failed ({status})")
+        out = {}
+        off = 0
+        while off < len(payload):
+            (klen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            k = payload[off:off + klen].decode()
+            off += klen
+            (vlen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            out[k] = payload[off:off + vlen]
+            off += vlen
+        return out
+
+    def num_keys(self) -> int:
+        status, payload = self._request(_COUNT, b"")
+        return int(payload) if status == _OK else 0
+
+    # -- lifecycle --
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            from ..core import native
+            native.store_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+__all__ = ["TCPStore"]

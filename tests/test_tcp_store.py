@@ -1,0 +1,138 @@
+"""Native TCPStore (reference: paddle.distributed.TCPStore,
+tcp_store.h:121): the C++ socket daemon + Python protocol client —
+set/get/wait/add/prefix across REAL processes, blocking-wait semantics,
+and the barrier-counter pattern rendezvous uses.
+"""
+import multiprocessing as mp
+import time
+
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+
+pytestmark = pytest.mark.skipif(not native.ensure_loaded(),
+                                reason="native runtime unavailable")
+
+
+def test_set_get_add_delete_prefix():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                      timeout=10)
+    try:
+        master.set("k1", "v1")
+        assert master.try_get("k1") == b"v1"
+        assert master.try_get("nope") is None
+        assert master.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+        master.set("pre/a", "1")
+        master.set("pre/b", "2")
+        got = master.get_prefix("pre/")
+        assert got == {"pre/a": b"1", "pre/b": b"2"}
+        master.delete_key("k1")
+        assert master.try_get("k1") is None
+        assert master.num_keys() == 3  # ctr + 2 prefix keys
+    finally:
+        master.close()
+
+
+def test_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    try:
+        client = TCPStore("127.0.0.1", master.port, timeout=10)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.wait("slow", timeout=0.3)
+        assert time.monotonic() - t0 >= 0.25
+
+        import threading
+        def setter():
+            time.sleep(0.2)
+            master.set("slow", "done")
+        threading.Thread(target=setter, daemon=True).start()
+        assert client.wait("slow", timeout=5) == b"done"
+        client.close()
+    finally:
+        master.close()
+
+
+def _worker(port, rank, world, q):
+    try:
+        store = TCPStore("127.0.0.1", port, timeout=20)
+        store.set(f"rank/{rank}", str(rank * 10))
+        n = store.add("barrier", 1)
+        store.wait("all_ready", timeout=20)
+        peers = store.get_prefix("rank/")
+        q.put((rank, n, sorted(peers)))
+        store.close()
+    except Exception as e:  # pragma: no cover
+        q.put((rank, "err", repr(e)))
+
+
+def test_multiprocess_rendezvous():
+    """The rendezvous pattern across REAL processes (SURVEY §4: multi-node
+    is multi-process single-node): every rank publishes, the barrier
+    counter reaches world size, master releases, everyone sees all keys."""
+    world = 3
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world,
+                      timeout=20)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_worker,
+                             args=(master.port, r, world, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        # master releases when the barrier counter shows everyone arrived
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if int(master.try_get("barrier") or 0) >= world:
+                break
+            time.sleep(0.05)
+        master.set("all_ready", "1")
+        results = [q.get(timeout=20) for _ in range(world)]
+        for p in procs:
+            p.join(timeout=10)
+        for rank, n, peers in sorted(results):
+            assert n != "err", peers
+            assert peers == ["rank/0", "rank/1", "rank/2"]
+    finally:
+        master.close()
+
+
+def test_auth_token():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10,
+                      token="s3cret")
+    try:
+        good = TCPStore("127.0.0.1", master.port, timeout=5, token="s3cret")
+        good.set("k", "v")
+        assert good.try_get("k") == b"v"
+        good.close()
+        with pytest.raises(PermissionError):
+            TCPStore("127.0.0.1", master.port, timeout=5, token="wrong")
+    finally:
+        master.close()
+
+
+def test_wait_zero_is_immediate_check():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            master.wait("absent", timeout=0)
+        assert time.monotonic() - t0 < 1.0   # immediate, not forever
+        master.set("present", "1")
+        assert master.wait("present", timeout=0) == b"1"
+    finally:
+        master.close()
+
+
+def test_bind_host_restricts_interface():
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10,
+                      bind_host="127.0.0.1")
+    try:
+        c = TCPStore("127.0.0.1", master.port, timeout=5)
+        c.set("x", "1")
+        c.close()
+    finally:
+        master.close()

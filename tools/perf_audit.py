@@ -71,7 +71,7 @@ def timed_device(fn, x, iters=20, repeats=3):
     return best / iters
 
 
-def matmul_roofline():
+def matmul_roofline(peak_tflops=197.0):
     import jax
     import jax.numpy as jnp
     out = []
@@ -82,8 +82,17 @@ def matmul_roofline():
             b = jnp.asarray(np.random.default_rng(1).standard_normal(
                 (n, n)) * 0.01, jnp.bfloat16)
             # marginal cost between two in-device loop lengths — subtracts
-            # the relay's fixed ~20ms dispatch+sync overhead exactly
-            lo, hi = (5, 55) if n <= 4096 else (5, 25)
+            # the relay's fixed ~20ms dispatch+sync overhead exactly.
+            # Round-3 verdict weak #2: at small n the per-iter time is
+            # ~0.1 ms, so a 50-iteration marginal sat inside timing noise
+            # and reported > nominal peak (202.5 > 197 TF/s, impossible).
+            # Scale the iteration GAP so the marginal work is >= 200 ms of
+            # expected compute at peak — noise then bounds the error at
+            # a few percent.
+            per_iter_at_peak = 2 * n ** 3 / (peak_tflops * 1e12)
+            gap = max(int(0.2 / per_iter_at_peak), 20)
+            gap = min(gap, 2400)   # compile-time guard at tiny n
+            lo, hi = 5, 5 + gap
             # tanh between iterations defeats XLA's reassociation of the
             # matmul chain into log-depth matrix powers (measured: the pure
             # y@b loop reports >2x nominal peak — it is NOT executing k
@@ -93,9 +102,18 @@ def matmul_roofline():
             t45 = timed_device(body, a, iters=hi) * hi
             dt = (t45 - t5) / (hi - lo)
             tf = 2 * n ** 3 / dt / 1e12
-            out.append({"n": n, "ms": round(dt * 1e3, 3),
-                        "tflops": round(tf, 1),
-                        "fixed_dispatch_ms": round((t5 - 5 * dt) * 1e3, 1)})
+            rec = {"n": n, "iters": (lo, hi), "ms": round(dt * 1e3, 3),
+                   "tflops": round(tf, 1),
+                   "fixed_dispatch_ms": round((t5 - lo * dt) * 1e3, 1)}
+            if tf > peak_tflops * 1.02:
+                # still impossible: record the raw numbers but mark the
+                # row invalid rather than publishing a >peak figure
+                rec["valid"] = False
+                rec["note"] = (f"{tf:.1f} TF/s exceeds nominal peak "
+                               f"{peak_tflops}; marginal under-resolved")
+            else:
+                rec["valid"] = True
+            out.append(rec)
         except Exception as e:  # OOM at the largest size is fine
             out.append({"n": n, "error": str(e)[:120]})
     # batched (closer to a transformer step's shape mix); chain via a
