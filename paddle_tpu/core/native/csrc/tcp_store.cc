@@ -34,6 +34,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cerrno>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -72,6 +73,7 @@ bool read_full(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
   while (n) {
     ssize_t k = ::recv(fd, p, n, 0);
+    if (k < 0 && errno == EINTR) continue;  // signal, not a disconnect
     if (k <= 0) return false;
     p += k;
     n -= static_cast<size_t>(k);
@@ -83,6 +85,7 @@ bool write_full(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
   while (n) {
     ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (k < 0 && errno == EINTR) continue;
     if (k <= 0) return false;
     p += k;
     n -= static_cast<size_t>(k);
@@ -267,6 +270,10 @@ void accept_loop(Server* srv) {
                       &len);
     if (fd < 0) {
       if (srv->stop.load()) break;
+      if (errno == EINTR) continue;
+      // persistent failure (EMFILE etc.): back off instead of busy-
+      // spinning a core while the condition clears
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
       continue;
     }
     reap_finished(srv);   // bounded state across long elastic jobs

@@ -45,15 +45,27 @@ class TCPStore:
         self._token = token if token is not None else \
             os.environ.get("PADDLE_TPU_RDZV_TOKEN", "")
         self._server = None
-        self._lock = threading.Lock()
+        # one connection PER THREAD: a long blocking wait() on one thread
+        # must not serialize other threads' heartbeat add()s, and close()
+        # must not race an in-flight request on a shared socket
+        self._tls = threading.local()
+        self._socks = []                 # every live connection (for close)
+        self._socks_mu = threading.Lock()
+        self._closed = False
         if self.is_master:
             from ..core import native
             self._server, port = native.store_start(
                 port, bind_host=bind_host, token=self._token)
         self.port = int(port)
-        self._sock = self._connect()
+        self._connect()                  # fail fast on an unreachable master
 
     def _connect(self):
+        """Connect (and auth) THIS thread's socket; caches it in TLS."""
+        if self._closed:
+            raise ConnectionError("TCPStore is closed")
+        s = getattr(self._tls, "sock", None)
+        if s is not None:
+            return s
         deadline = time.monotonic() + self.timeout
         last = None
         while time.monotonic() < deadline:
@@ -69,19 +81,23 @@ class TCPStore:
             raise TimeoutError(
                 f"TCPStore: cannot reach {self.host}:{self.port} within "
                 f"{self.timeout}s: {last}")
+        self._tls.sock = s
+        with self._socks_mu:
+            self._socks.append(s)
         if self._token:
-            self._sock = s
             status, _ = self._request(_AUTH, b"", self._token.encode())
             if status != _OK:
+                self._tls.sock = None
                 s.close()
                 raise PermissionError("TCPStore: authentication rejected")
         return s
 
     # -- protocol --
-    def _recv_full(self, n):
+    @staticmethod
+    def _recv_full(sock, n):
         buf = b""
         while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+            chunk = sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("TCPStore: server closed connection")
             buf += chunk
@@ -89,18 +105,20 @@ class TCPStore:
 
     def _request(self, cmd, key: bytes, val: bytes = b"",
                  rcv_timeout=None):
-        """One request/response exchange. The SOCKET timeout is set per
-        call to strictly exceed any server-side wait, so a blocking WAIT
-        cannot race the transport timeout and desynchronize the stream."""
+        """One request/response exchange on THIS thread's connection. The
+        SOCKET timeout is set per call to strictly exceed any server-side
+        wait, so a blocking WAIT cannot race the transport timeout and
+        desynchronize the stream; per-thread sockets mean one thread's
+        blocking wait never serializes another thread's requests."""
+        sock = self._connect()
         msg = struct.pack("<BI", cmd, len(key)) + key \
             + struct.pack("<I", len(val)) + val
         deadline = (self.timeout if rcv_timeout is None
                     else rcv_timeout) + 5.0
-        with self._lock:
-            self._sock.settimeout(deadline)
-            self._sock.sendall(msg)
-            status, plen = struct.unpack("<BI", self._recv_full(5))
-            payload = self._recv_full(plen) if plen else b""
+        sock.settimeout(deadline)
+        sock.sendall(msg)
+        status, plen = struct.unpack("<BI", self._recv_full(sock, 5))
+        payload = self._recv_full(sock, plen) if plen else b""
         return status, payload
 
     @staticmethod
@@ -166,14 +184,21 @@ class TCPStore:
 
     def num_keys(self) -> int:
         status, payload = self._request(_COUNT, b"")
-        return int(payload) if status == _OK else 0
+        if status != _OK:
+            # auth failures etc. must surface, not masquerade as empty
+            raise RuntimeError(f"TCPStore.num_keys failed (status {status})")
+        return int(payload)
 
     # -- lifecycle --
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._closed = True
+        with self._socks_mu:
+            socks, self._socks = self._socks, []
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
         if self._server is not None:
             from ..core import native
             native.store_stop(self._server)

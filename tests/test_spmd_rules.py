@@ -1,0 +1,233 @@
+"""Per-op sharding-propagation assertions (SURVEY C20; round-3 verdict
+listed C20 partial: "no per-op sharding-assertion suite").
+
+The reference encodes 121 hand-written SPMD rules
+(paddle/phi/infermeta/spmd_rules/); on this stack GSPMD derives them.
+These tests PIN the derived behavior per op family the LLM stack relies
+on: for sharded inputs, the compiled program must (a) produce the
+expected output sharding and (b) insert exactly the expected collectives
+— e.g. a contracting-dim-sharded matmul must all-reduce, a batch-sharded
+one must not. A jax/XLA upgrade that silently changes a propagation rule
+fails here, the way a broken spmd_rules file fails the reference's
+test/cpp/auto_parallel suite.
+"""
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.dispatch import OPS
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("x",))
+
+
+def _put(arr, spec, mesh):
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _compile(fn, *args):
+    c = jax.jit(fn).lower(*args).compile()
+    return c, c.as_text()
+
+
+def _run_spec(fn, *args):
+    """Execute and return (result, result sharding spec tuple)."""
+    out = jax.jit(fn)(*args)
+    return out, tuple(out.sharding.spec)
+
+
+def _has_allreduce(text):
+    return "all-reduce" in text
+
+
+def _has_any_collective(text):
+    return any(k in text for k in
+               ("all-reduce", "all-gather", "all-to-all",
+                "collective-permute", "reduce-scatter"))
+
+
+class TestMatmulRule:
+    def test_batch_sharded_lhs_no_collective(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 32)), P("x", None), mesh)
+        b = _put(jnp.ones((32, 8)), P(None, None), mesh)
+        c, text = _compile(lambda a, b: OPS["matmul"](a, b), a, b)
+        assert not _has_any_collective(text), "row-sharded matmul is local"
+        out, spec = _run_spec(lambda a, b: OPS["matmul"](a, b), a, b)
+        assert spec[0] == "x" and spec[1] is None, spec
+
+    def test_contracting_sharded_allreduces(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 32)), P(None, "x"), mesh)
+        b = _put(jnp.ones((32, 8)), P("x", None), mesh)
+        _, text = _compile(lambda a, b: OPS["matmul"](a, b), a, b)
+        assert _has_allreduce(text), \
+            "contracting-dim sharding must partial-reduce (all-reduce)"
+        out = jax.jit(lambda a, b: OPS["matmul"](a, b))(a, b)
+        np.testing.assert_allclose(np.asarray(out), 32.0)
+
+    def test_column_parallel_rhs(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 32)), P(None, None), mesh)
+        b = _put(jnp.ones((32, 8)), P(None, "x"), mesh)
+        c, text = _compile(lambda a, b: OPS["matmul"](a, b), a, b)
+        assert not _has_any_collective(text), "col-parallel matmul is local"
+        _, spec = _run_spec(lambda a, b: OPS["matmul"](a, b), a, b)
+        assert spec[-1] == "x", spec
+
+
+class TestElementwiseRule:
+    def test_sharded_plus_replicated_keeps_sharding(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        b = _put(jnp.ones((16, 4)), P(None, None), mesh)
+        _, text = _compile(lambda a, b: OPS["add"](a, b), a, b)
+        assert not _has_any_collective(text)
+        _, spec = _run_spec(lambda a, b: OPS["add"](a, b), a, b)
+        assert spec[0] == "x", spec
+
+
+class TestEmbeddingRule:
+    def test_batch_sharded_ids(self):
+        mesh = _mesh()
+        ids = _put(jnp.zeros((16, 8), jnp.int32), P("x", None), mesh)
+        table = _put(jnp.ones((64, 32)), P(None, None), mesh)
+        fn = lambda i, t: OPS["embedding"](i, t, padding_idx=None)  # noqa: E731
+        _, text = _compile(fn, ids, table)
+        assert not _has_any_collective(text), \
+            "replicated-table embedding gathers locally per batch shard"
+        _, spec = _run_spec(fn, ids, table)
+        assert spec[0] == "x", spec
+
+
+class TestReductionRule:
+    def test_reduce_over_sharded_axis_allreduces(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        _, text = _compile(lambda a: jnp.sum(a, axis=0), a)
+        assert _has_allreduce(text) or "reduce-scatter" in text, \
+            "reducing the sharded axis needs a cross-device reduce"
+
+    def test_reduce_over_local_axis_stays_sharded(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        _, text = _compile(lambda a: jnp.sum(a, axis=1), a)
+        assert not _has_any_collective(text)
+        _, spec = _run_spec(lambda a: jnp.sum(a, axis=1), a)
+        assert spec[0] == "x", spec
+
+
+class TestReshapeRule:
+    def test_split_trailing_dim_keeps_leading_sharding(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 16)), P("x", None), mesh)
+        fn = lambda a: OPS["reshape"](a, shape=(16, 4, 4))  # noqa: E731
+        _, text = _compile(fn, a)
+        assert not _has_any_collective(text)
+        _, spec = _run_spec(fn, a)
+        assert spec[0] == "x", spec
+
+
+class TestTransposeRule:
+    def test_sharding_follows_the_dim(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        fn = lambda a: OPS["transpose"](a, perm=(1, 0))  # noqa: E731
+        _, spec = _run_spec(fn, a)
+        assert spec[-1] == "x", spec
+
+
+class TestSoftmaxRule:
+    def test_batch_sharded_last_axis_softmax_local(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 32)), P("x", None), mesh)
+        fn = lambda a: OPS["softmax"](a, axis=-1)  # noqa: E731
+        _, text = _compile(fn, a)
+        assert not _has_any_collective(text), \
+            "softmax over the local axis must not communicate"
+        _, spec = _run_spec(fn, a)
+        assert spec[0] == "x", spec
+
+    def test_softmax_over_sharded_axis_communicates(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 32)), P(None, "x"), mesh)
+        fn = lambda a: OPS["softmax"](a, axis=-1)  # noqa: E731
+        _, text = _compile(fn, a)
+        assert _has_any_collective(text), \
+            "softmax over the sharded axis needs cross-device terms"
+        out = jax.jit(fn)(a)
+        np.testing.assert_allclose(np.asarray(out), 1.0 / 32, rtol=1e-6)
+
+
+class TestNormRule:
+    def test_rms_norm_batch_sharded_local(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 64)), P("x", None), mesh)
+        g = _put(jnp.ones((64,)), P(None), mesh)
+        fn = lambda a, g: OPS["rms_norm"](a, g, epsilon=1e-6)  # noqa: E731
+        _, text = _compile(fn, a, g)
+        assert not _has_any_collective(text)
+        _, spec = _run_spec(fn, a, g)
+        assert spec[0] == "x", spec
+
+
+class TestAttentionRule:
+    def test_batch_sharded_sdpa_no_cross_batch_collective(self):
+        mesh = _mesh()
+        q = _put(jnp.ones((8, 16, 4, 8)), P("x", None, None, None), mesh)
+        fn = lambda q: OPS["scaled_dot_product_attention"](  # noqa: E731
+            q, q, q, causal=True)
+        _, text = _compile(fn, q)
+        assert not _has_any_collective(text), \
+            "batch-sharded attention is embarrassingly parallel"
+        _, spec = _run_spec(fn, q)
+        assert spec[0] == "x", spec
+
+    def test_head_sharded_sdpa_no_collective(self):
+        mesh = _mesh()
+        q = _put(jnp.ones((2, 16, 8, 8)), P(None, None, "x", None), mesh)
+        fn = lambda q: OPS["scaled_dot_product_attention"](  # noqa: E731
+            q, q, q, causal=True)
+        _, text = _compile(fn, q)
+        assert not _has_any_collective(text), \
+            "head-sharded (TP) attention is local per head shard"
+
+
+class TestCrossEntropyRule:
+    def test_batch_sharded_tokens(self):
+        mesh = _mesh()
+        logits = _put(jnp.ones((16, 32)), P("x", None), mesh)
+        labels = _put(jnp.zeros((16,), jnp.int32), P("x"), mesh)
+
+        def fn(lg, lb):
+            return OPS["cross_entropy"](
+                lg, lb, axis=-1, ignore_index=-100, reduction="mean",
+                soft_label=False, use_softmax=True, label_smoothing=0.0)
+
+        _, text = _compile(fn, logits, labels)
+        # per-token loss is local; the MEAN over the sharded token axis
+        # must cross devices
+        assert _has_allreduce(text) or "reduce-scatter" in text
+        out = jax.jit(fn)(logits, labels)
+        np.testing.assert_allclose(np.asarray(out), np.log(32), rtol=1e-5)
+
+
+class TestConcatRule:
+    def test_concat_along_local_axis_keeps_sharding(self):
+        mesh = _mesh()
+        a = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        b = _put(jnp.ones((16, 4)), P("x", None), mesh)
+        fn = lambda a, b: OPS["concat"](a, b, axis=1)  # noqa: E731
+        _, text = _compile(fn, a, b)
+        assert not _has_any_collective(text)
+        _, spec = _run_spec(fn, a, b)
+        assert spec[0] == "x", spec

@@ -48,8 +48,10 @@ def test_wait_blocks_until_set():
         def setter():
             time.sleep(0.2)
             master.set("slow", "done")
-        threading.Thread(target=setter, daemon=True).start()
+        th = threading.Thread(target=setter, daemon=True)
+        th.start()
         assert client.wait("slow", timeout=5) == b"done"
+        th.join(5)          # the SET response must land before close()
         client.close()
     finally:
         master.close()
@@ -134,5 +136,12 @@ def test_bind_host_restricts_interface():
         c = TCPStore("127.0.0.1", master.port, timeout=5)
         c.set("x", "1")
         c.close()
+        # the listen socket must be bound to loopback, NOT INADDR_ANY:
+        # /proc/net/tcp records loopback as 0100007F, wildcard as 00000000
+        want = f"0100007F:{master.port:04X}"
+        wildcard = f"00000000:{master.port:04X}"
+        table = open("/proc/net/tcp").read()
+        assert want in table, f"expected loopback bind {want}"
+        assert wildcard not in table, "bind_host ignored: bound to ANY"
     finally:
         master.close()

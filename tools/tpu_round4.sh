@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Round-4 TPU evidence runbook — run when the pool chip is reachable.
+# ONE TPU process at a time (axon claim discipline, .claude/skills/verify);
+# each step exits cleanly before the next starts.
+#
+#   bash tools/tpu_round4.sh [audit|bench|opbench|all]
+#
+# Produces:
+#   docs/PERF_AUDIT.json   — regenerated matmul/attention/step sections
+#   bench JSON on stdout   — llama_125m + llama_1b (the driver's format)
+#   tools/op_bench_baseline.json — TPU per-op baseline for the gate
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+what="${1:-all}"
+
+probe() {
+  echo "== probing the chip (120s) =="
+  timeout 120 python -c "import jax; print(jax.devices())" || {
+    echo "chip unreachable; aborting" >&2
+    exit 2
+  }
+}
+
+case "$what" in
+  audit|all)
+    probe
+    echo "== perf audit: matmul (corrected marginal method) =="
+    timeout 900 python tools/perf_audit.py matmul
+    echo "== perf audit: attention =="
+    timeout 900 python tools/perf_audit.py attention
+    echo "== perf audit: step breakdown =="
+    timeout 1200 python tools/perf_audit.py step
+    ;;&
+  bench|all)
+    probe
+    echo "== bench: llama_125m + llama_1b =="
+    timeout 2400 python bench.py
+    ;;&
+  opbench|all)
+    probe
+    echo "== op bench: record the TPU baseline =="
+    timeout 900 python tools/op_bench.py --record --no-collective
+    ;;&
+esac
+echo "done: update docs/PERF.md tables from docs/PERF_AUDIT.json and drop"
+echo "the pending-regeneration banners for sections now backed by raw data."
