@@ -49,7 +49,10 @@ class TCPStore:
         # must not serialize other threads' heartbeat add()s, and close()
         # must not race an in-flight request on a shared socket
         self._tls = threading.local()
-        self._socks = []                 # every live connection (for close)
+        # (owner_thread, sock) pairs: close() closes them all, and
+        # _connect prunes entries whose owner thread has exited so thread
+        # churn cannot leak client fds / server handler threads
+        self._socks = []
         self._socks_mu = threading.Lock()
         self._closed = False
         if self.is_master:
@@ -83,9 +86,32 @@ class TCPStore:
                 f"{self.timeout}s: {last}")
         self._tls.sock = s
         with self._socks_mu:
-            self._socks.append(s)
+            # prune connections whose owner thread has exited
+            dead = [sk for th, sk in self._socks if not th.is_alive()]
+            self._socks = [(th, sk) for th, sk in self._socks
+                           if th.is_alive()]
+            self._socks.append((threading.current_thread(), s))
+            raced_close = self._closed
+        for sk in dead:
+            try:
+                sk.close()
+            except OSError:
+                pass
+        if raced_close:
+            # close() ran between our _closed check and registration:
+            # do not leave a live socket behind
+            self._tls.sock = None
+            s.close()
+            raise ConnectionError("TCPStore is closed")
         if self._token:
-            status, _ = self._request(_AUTH, b"", self._token.encode())
+            try:
+                status, _ = self._request(_AUTH, b"", self._token.encode())
+            except Exception:
+                # ANY auth-exchange failure must drop the cached socket,
+                # or this thread would be stuck half-authenticated
+                self._tls.sock = None
+                s.close()
+                raise
             if status != _OK:
                 self._tls.sock = None
                 s.close()
@@ -194,7 +220,7 @@ class TCPStore:
         self._closed = True
         with self._socks_mu:
             socks, self._socks = self._socks, []
-        for s in socks:
+        for _, s in socks:
             try:
                 s.close()
             except OSError:

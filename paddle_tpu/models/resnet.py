@@ -1,7 +1,9 @@
 """ResNet family (stepping-stone config 1, BASELINE.md).
 
 Reference analog: python/paddle/vision/models/resnet.py (BasicBlock /
-BottleneckBlock / ResNet with depth 18/34/50/101/152).
+BottleneckBlock / ResNet with depth 18/34/50/101/152, plus the ResNeXt
+``groups``/``width_per_group`` parameterization and the wide variants —
+resnext50_32x4d etc. / wide_resnet50_2 etc.).
 """
 from __future__ import annotations
 
@@ -11,8 +13,12 @@ from .. import nn
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
+        if groups != 1 or base_width != 64:
+            raise ValueError("BasicBlock only supports groups=1, "
+                             "base_width=64 (reference resnet.py)")
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
                                bias_attr=False)
         self.bn1 = nn.BatchNorm2D(planes)
@@ -31,14 +37,17 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = nn.Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(planes)
-        self.conv2 = nn.Conv2D(planes, planes, 3, stride=stride, padding=1,
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(width)
+        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=1,
+                               groups=groups, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(width)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
                                bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(planes)
-        self.conv3 = nn.Conv2D(planes, planes * self.expansion, 1, bias_attr=False)
         self.bn3 = nn.BatchNorm2D(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -58,11 +67,14 @@ class ResNet(nn.Layer):
            101: (BottleneckBlock, [3, 4, 23, 3]),
            152: (BottleneckBlock, [3, 8, 36, 3])}
 
-    def __init__(self, depth=50, num_classes=1000, with_pool=True):
+    def __init__(self, depth=50, num_classes=1000, with_pool=True,
+                 groups=1, width_per_group=64):
         super().__init__()
         block, layers = self.cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.groups = groups
+        self.base_width = width_per_group
         self.inplanes = 64
         self.conv1 = nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False)
         self.bn1 = nn.BatchNorm2D(64)
@@ -84,10 +96,12 @@ class ResNet(nn.Layer):
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
                           stride=stride, bias_attr=False),
                 nn.BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        layers = [block(self.inplanes, planes, stride, downsample,
+                        groups=self.groups, base_width=self.base_width)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, groups=self.groups,
+                                base_width=self.base_width))
         return nn.Sequential(*layers)
 
     def forward(self, x):
@@ -119,3 +133,39 @@ def resnet101(**kw):
 
 def resnet152(**kw):
     return ResNet(152, **kw)
+
+
+# ---- ResNeXt variants (reference resnet.py resnext*) ----
+
+def resnext50_32x4d(**kw):
+    return ResNet(50, groups=32, width_per_group=4, **kw)
+
+
+def resnext50_64x4d(**kw):
+    return ResNet(50, groups=64, width_per_group=4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return ResNet(101, groups=32, width_per_group=4, **kw)
+
+
+def resnext101_64x4d(**kw):
+    return ResNet(101, groups=64, width_per_group=4, **kw)
+
+
+def resnext152_32x4d(**kw):
+    return ResNet(152, groups=32, width_per_group=4, **kw)
+
+
+def resnext152_64x4d(**kw):
+    return ResNet(152, groups=64, width_per_group=4, **kw)
+
+
+# ---- wide variants (reference resnet.py wide_resnet*_2) ----
+
+def wide_resnet50_2(**kw):
+    return ResNet(50, width_per_group=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return ResNet(101, width_per_group=128, **kw)

@@ -4,7 +4,9 @@ ResNet and LeNet live in paddle_tpu.models (the framework's primary model
 families) and are re-exported here for reference API parity.
 """
 from ...models.resnet import (  # noqa: F401
-    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152)
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2)
 from ...models.lenet import LeNet  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
@@ -19,3 +21,4 @@ from .shufflenetv2 import (  # noqa: F401
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .ssdlite import SSDLite, ssd_match_targets  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

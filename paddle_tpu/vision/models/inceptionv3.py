@@ -1,0 +1,201 @@
+"""InceptionV3 (reference: python/paddle/vision/models/inceptionv3.py —
+the Szegedy et al. 2015 architecture with the A/B/C/D/E inception blocks).
+
+TPU notes: every branch is convs + pools that XLA fuses and tiles onto
+the MXU; branch outputs concatenate on the channel axis, which is a pure
+layout operation under XLA (no copy when fused). Structure follows the
+paper/reference; weights initialize with the framework defaults.
+"""
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, 64, 1)
+        self.b5_1 = ConvBNAct(cin, 48, 1)
+        self.b5_2 = ConvBNAct(48, 64, 5, padding=2)
+        self.b3_1 = ConvBNAct(cin, 64, 1)
+        self.b3_2 = ConvBNAct(64, 96, 3, padding=1)
+        self.b3_3 = ConvBNAct(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBNAct(cin, pool_features, 1)
+
+    def forward(self, x):
+        from ... import tensor as T
+        return T.concat([
+            self.b1(x),
+            self.b5_2(self.b5_1(x)),
+            self.b3_3(self.b3_2(self.b3_1(x))),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35 -> 17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBNAct(cin, 384, 3, stride=2)
+        self.b3d_1 = ConvBNAct(cin, 64, 1)
+        self.b3d_2 = ConvBNAct(64, 96, 3, padding=1)
+        self.b3d_3 = ConvBNAct(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ... import tensor as T
+        return T.concat([
+            self.b3(x),
+            self.b3d_3(self.b3d_2(self.b3d_1(x))),
+            self.pool(x),
+        ], axis=1)
+
+
+class InceptionC(nn.Layer):
+    """Factorized 7x7 branches at 17x17."""
+
+    def __init__(self, cin, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.b1 = ConvBNAct(cin, 192, 1)
+        self.b7_1 = ConvBNAct(cin, c7, 1)
+        self.b7_2 = ConvBNAct(c7, c7, (1, 7), padding=(0, 3))
+        self.b7_3 = ConvBNAct(c7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = ConvBNAct(cin, c7, 1)
+        self.b7d_2 = ConvBNAct(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_3 = ConvBNAct(c7, c7, (1, 7), padding=(0, 3))
+        self.b7d_4 = ConvBNAct(c7, c7, (7, 1), padding=(3, 0))
+        self.b7d_5 = ConvBNAct(c7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBNAct(cin, 192, 1)
+
+    def forward(self, x):
+        from ... import tensor as T
+        return T.concat([
+            self.b1(x),
+            self.b7_3(self.b7_2(self.b7_1(x))),
+            self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x))))),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17 -> 8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3_1 = ConvBNAct(cin, 192, 1)
+        self.b3_2 = ConvBNAct(192, 320, 3, stride=2)
+        self.b7_1 = ConvBNAct(cin, 192, 1)
+        self.b7_2 = ConvBNAct(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = ConvBNAct(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = ConvBNAct(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ... import tensor as T
+        return T.concat([
+            self.b3_2(self.b3_1(x)),
+            self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+            self.pool(x),
+        ], axis=1)
+
+
+class InceptionE(nn.Layer):
+    """Expanded-filter-bank blocks at 8x8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, 320, 1)
+        self.b3_1 = ConvBNAct(cin, 384, 1)
+        self.b3_2a = ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = ConvBNAct(cin, 448, 1)
+        self.b3d_2 = ConvBNAct(448, 384, 3, padding=1)
+        self.b3d_3a = ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = ConvBNAct(cin, 192, 1)
+
+    def forward(self, x):
+        from ... import tensor as T
+        b3 = self.b3_1(x)
+        b3d = self.b3d_2(self.b3d_1(x))
+        return T.concat([
+            self.b1(x),
+            T.concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1),
+            T.concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1),
+            self.bp(self.pool(x)),
+        ], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """(reference: inceptionv3.py InceptionV3). Input 299x299."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            ConvBNAct(3, 32, 3, stride=2),
+            ConvBNAct(32, 32, 3),
+            ConvBNAct(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            ConvBNAct(64, 80, 1),
+            ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.mixed_a = nn.Sequential(
+            InceptionA(192, pool_features=32),
+            InceptionA(256, pool_features=64),
+            InceptionA(288, pool_features=64),
+        )
+        self.reduction_b = InceptionB(288)
+        self.mixed_c = nn.Sequential(
+            InceptionC(768, channels_7x7=128),
+            InceptionC(768, channels_7x7=160),
+            InceptionC(768, channels_7x7=160),
+            InceptionC(768, channels_7x7=192),
+        )
+        self.reduction_d = InceptionD(768)
+        self.mixed_e = nn.Sequential(
+            InceptionE(1280),
+            InceptionE(2048),
+        )
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.mixed_a(x)
+        x = self.reduction_b(x)
+        x = self.mixed_c(x)
+        x = self.reduction_d(x)
+        x = self.mixed_e(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = nn.Flatten(1)(x)
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

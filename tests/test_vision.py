@@ -96,3 +96,34 @@ def test_read_file_decode_jpeg(tmp_path):
                   ).mean() < 16
     gray = decode_jpeg(raw, mode="gray")
     assert np.asarray(gray.numpy()).shape == (1, 8, 6)
+
+
+@pytest.mark.slow
+def test_resnext_and_wide_resnet_variants():
+    """ResNeXt grouped bottlenecks + wide variants (reference
+    resnet.py resnext50_32x4d / wide_resnet50_2)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnext50_32x4d, wide_resnet50_2
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32))
+    for ctor in (resnext50_32x4d, wide_resnet50_2):
+        m = ctor(num_classes=10)
+        m.eval()
+        out = m(x)
+        assert tuple(out.shape) == (1, 10)
+        assert np.isfinite(out.numpy()).all()
+
+
+@pytest.mark.slow
+def test_inception_v3_forward():
+    """InceptionV3 A->E blocks produce the reference channel plan
+    (192->288->768->1280->2048) and a finite logit row."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import inception_v3
+    m = inception_v3(num_classes=7)
+    m.eval()
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (1, 3, 299, 299)).astype(np.float32))
+    out = m(x)
+    assert tuple(out.shape) == (1, 7)
+    assert np.isfinite(out.numpy()).all()

@@ -42,6 +42,12 @@ case "$what" in
     echo "== op bench: record the TPU baseline =="
     timeout 900 python tools/op_bench.py --record --no-collective
     ;;&
+  audit|bench|opbench|all)
+    : ;;  # recognized
+  *)
+    echo "usage: $0 [audit|bench|opbench|all]" >&2
+    exit 1
+    ;;
 esac
 echo "done: update docs/PERF.md tables from docs/PERF_AUDIT.json and drop"
 echo "the pending-regeneration banners for sections now backed by raw data."
