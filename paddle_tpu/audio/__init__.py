@@ -148,4 +148,6 @@ class MFCC(Layer):
 
 
 __all__ = ["stft", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
-           "MFCC", "compute_fbank_matrix", "get_window"]
+           "MFCC", "compute_fbank_matrix", "get_window", "functional"]
+
+from . import functional  # noqa: E402,F401 — reference-named helpers

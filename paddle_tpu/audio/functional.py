@@ -1,0 +1,131 @@
+"""paddle.audio.functional — the reference-named public feature helpers
+(reference: python/paddle/audio/functional/functional.py + window.py).
+
+These are host-side filterbank/window constructions (numpy in, Tensor
+out) plus small value transforms; the compute-heavy features (STFT, mel
+projection) are the layers in paddle_tpu.audio which lower to XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import get_window as _window_np
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz -> mel (reference functional.py:29). htk=True uses the HTK
+    formula; default is the Slaney/librosa piecewise scale."""
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       out)
+    if np.isscalar(freq) or np.ndim(freq) == 0:
+        return float(out)
+    return Tensor(np.asarray(out, np.float32))
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """mel -> Hz (reference functional.py:83)."""
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    if np.isscalar(mel) or np.ndim(mel) == 0:
+        return float(out)
+    return Tensor(np.asarray(out, np.float32))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """n_mels frequencies evenly spaced on the mel scale
+    (reference functional.py:126)."""
+    lo = hz_to_mel(float(f_min), htk=htk)
+    hi = hz_to_mel(float(f_max), htk=htk)
+    mels = np.linspace(lo, hi, n_mels)
+    hz = np.asarray([mel_to_hz(float(m), htk=htk) for m in mels])
+    return Tensor(hz.astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Center frequencies of rfft bins (reference functional.py:166)."""
+    return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] mel filterbank as a Tensor (reference
+    functional.py:189): triangular filters centered on the chosen mel
+    scale (Slaney by default, HTK with ``htk=True``); ``norm='slaney'``
+    area-normalizes each filter, ``norm=None`` leaves unit peaks."""
+    f_max = f_max if f_max is not None else sr / 2
+    lo = hz_to_mel(float(f_min), htk=htk)
+    hi = hz_to_mel(float(f_max), htk=htk)
+    mel_pts = np.linspace(lo, hi, n_mels + 2)
+    hz_pts = np.asarray([mel_to_hz(float(m), htk=htk) for m in mel_pts])
+    fft_hz = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    fb = np.zeros((n_mels, 1 + n_fft // 2), np.float64)
+    for i in range(n_mels):
+        left, center, right = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (fft_hz - left) / max(center - left, 1e-10)
+        down = (right - fft_hz) / max(right - center, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10*log10(x/ref), numerically stable, optionally floored at
+    top_db below the peak (reference functional.py:262)."""
+    x = spect.numpy() if isinstance(spect, Tensor) else np.asarray(spect)
+    db = 10.0 * np.log10(np.maximum(amin, x))
+    db -= 10.0 * np.log10(np.maximum(amin, ref_value))
+    if top_db is not None:
+        db = np.maximum(db, db.max() - top_db)
+    return Tensor(db.astype(np.float32))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype: str = "float32"):
+    """[n_mels, n_mfcc] DCT-II matrix (reference functional.py:306)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / np.sqrt(n_mels)
+        dct[:, 1:] *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """Window function as a Tensor (reference window.py get_window)."""
+    return Tensor(_window_np(window, win_length, fftbins=fftbins)
+                  .astype(dtype))

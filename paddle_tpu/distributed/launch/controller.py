@@ -17,7 +17,7 @@ import subprocess
 import sys
 import time
 
-from .master import KVServer, Master
+from .master import KVServer, Master, TCPStoreServer, rendezvous_backend
 
 
 def free_port():
@@ -91,7 +91,11 @@ class CollectiveController:
                                                      my_ip)
             if is_master_node and a.rank in (0, -1):
                 try:
-                    self.kv = KVServer(int(port)).start()
+                    if rendezvous_backend() == "tcp":
+                        # native TCPStore daemon (csrc/tcp_store.cc)
+                        self.kv = TCPStoreServer(int(port)).start()
+                    else:
+                        self.kv = KVServer(int(port)).start()
                 except OSError:
                     self.kv = None  # another process already serves
             master = Master(a.master, job_id=a.job_id)

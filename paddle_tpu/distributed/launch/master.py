@@ -138,11 +138,74 @@ class KVClient:
         self._open(key, method="DELETE")
 
 
-class Master:
-    """Per-job rendezvous over a KVServer (reference: master.py sync_peers)."""
+class _TCPKVAdapter:
+    """KVClient-shaped adapter over the native TCPStore (csrc/tcp_store.cc)
+    so ``Master`` runs unchanged on either rendezvous backend
+    (PADDLE_TPU_RDZV_BACKEND=tcp selects it in the launch controller)."""
 
-    def __init__(self, endpoint, job_id="default"):
-        self.client = KVClient(endpoint)
+    def __init__(self, endpoint, token=None):
+        from ..store import TCPStore
+        from ...core.flags import GLOBAL_FLAGS
+        host, port = endpoint.rsplit(":", 1)
+        # connect retries are governed by the same flag as the http
+        # backend's register() retry window
+        window = float(GLOBAL_FLAGS.get("get_host_by_name_time"))
+        self._store = TCPStore(host, int(port), token=token,
+                               timeout=max(window, 1.0))
+
+    def put(self, key, value: str):
+        self._store.set(key, value)
+
+    def get(self, key):
+        v = self._store.try_get(key)
+        return v.decode() if v is not None else None
+
+    def get_prefix(self, prefix) -> dict:
+        return {k: v.decode()
+                for k, v in self._store.get_prefix(prefix).items()}
+
+    def delete(self, key):
+        self._store.delete_key(key)
+
+
+def rendezvous_backend() -> str:
+    """'http' (default, KVServer) or 'tcp' (native TCPStore daemon)."""
+    import os
+    return os.environ.get("PADDLE_TPU_RDZV_BACKEND", "http")
+
+
+class TCPStoreServer:
+    """KVServer-shaped owner of the native store daemon (start/stop)."""
+
+    def __init__(self, port=0, token=None, bind_host=None):
+        from ..store import TCPStore
+        if bind_host is None:
+            # same trust model as KVServer: the rendezvous port accepts
+            # writes that drive worker behavior, so honor the operator's
+            # interface restriction on this backend too
+            bind_host = os.environ.get("PADDLE_TPU_RDZV_BIND_HOST", "")
+        self._store = TCPStore("127.0.0.1", port, is_master=True,
+                               token=token, timeout=120,
+                               bind_host=bind_host)
+        self.port = self._store.port
+
+    def start(self):
+        return self
+
+    def stop(self):
+        self._store.close()
+
+
+class Master:
+    """Per-job rendezvous over a KVServer or the native TCPStore
+    (reference: master.py sync_peers; tcp_store.h:121)."""
+
+    def __init__(self, endpoint, job_id="default", backend=None):
+        backend = backend or rendezvous_backend()
+        if backend == "tcp":
+            self.client = _TCPKVAdapter(endpoint)
+        else:
+            self.client = KVClient(endpoint)
         self.job = f"/{job_id}"
 
     def register(self, node_id, payload: dict, retry_window=None):
@@ -191,4 +254,5 @@ class Master:
                 if now - float(v) < horizon]
 
 
-__all__ = ["KVServer", "KVClient", "Master"]
+__all__ = ["KVServer", "KVClient", "Master", "TCPStoreServer",
+           "rendezvous_backend"]

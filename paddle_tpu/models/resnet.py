@@ -110,8 +110,7 @@ class ResNet(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            x = nn.Flatten(1)(x)
-            x = self.fc(x)
+            x = self.fc(x.flatten(1))
         return x
 
 

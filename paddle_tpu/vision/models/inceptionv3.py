@@ -192,8 +192,7 @@ class InceptionV3(nn.Layer):
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:
-            x = nn.Flatten(1)(x)
-            x = self.fc(self.dropout(x))
+            x = self.fc(self.dropout(x.flatten(1)))
         return x
 
 

@@ -115,3 +115,49 @@ def test_device_memory_stats_surface():
     assert stats is None or "bytes_in_use" in stats
     assert isinstance(D.memory_allocated(), int)
     assert isinstance(D.max_memory_allocated(), int)
+
+
+def test_audio_functional_reference_names():
+    """paddle.audio.functional public helpers (reference:
+    audio/functional/functional.py): slaney mel scale round-trip,
+    filterbank shape, dB conversion, ortho DCT."""
+    import numpy as np
+    import paddle_tpu.audio.functional as AF
+
+    # scalar round-trip on both scales
+    for htk in (False, True):
+        hz = 440.0
+        mel = AF.hz_to_mel(hz, htk=htk)
+        back = AF.mel_to_hz(mel, htk=htk)
+        assert abs(back - hz) < 1e-6, (htk, back)
+
+    freqs = AF.mel_frequencies(n_mels=10, f_min=0.0, f_max=8000.0)
+    f = np.asarray(freqs.numpy())
+    assert f.shape == (10,) and f[0] == 0.0 and np.all(np.diff(f) > 0)
+
+    ff = np.asarray(AF.fft_frequencies(sr=16000, n_fft=512).numpy())
+    assert ff.shape == (257,) and ff[-1] == 8000.0
+
+    fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+    assert tuple(fb.shape) == (40, 257)
+    # htk vs slaney scales place centers differently; norm changes peaks
+    fb_htk = np.asarray(AF.compute_fbank_matrix(
+        16000, 512, n_mels=40, htk=True).numpy())
+    assert not np.allclose(np.asarray(fb.numpy()), fb_htk)
+    fb_nonorm = np.asarray(AF.compute_fbank_matrix(
+        16000, 512, n_mels=40, norm=None).numpy())
+    assert np.isclose(fb_nonorm.max(), 1.0, atol=1e-2)   # ~unit peaks (grid)
+    assert np.asarray(fb.numpy()).max() < 1.0             # area-normed
+
+    db = AF.power_to_db(np.asarray([1.0, 0.1, 1e-12]), top_db=80.0)
+    d = np.asarray(db.numpy())
+    assert abs(d[0] - 0.0) < 1e-5 and abs(d[1] + 10.0) < 1e-4
+
+    dct = np.asarray(AF.create_dct(13, 40).numpy())
+    assert dct.shape == (40, 13)
+    # ortho: columns are orthonormal
+    gram = dct.T @ dct
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-6)
+
+    w = np.asarray(AF.get_window("hann", 400).numpy())
+    assert w.shape == (400,) and w[0] == 0.0

@@ -145,3 +145,25 @@ def test_bind_host_restricts_interface():
         assert wildcard not in table, "bind_host ignored: bound to ANY"
     finally:
         master.close()
+
+
+def test_launch_rendezvous_over_tcp_backend(monkeypatch):
+    """PADDLE_TPU_RDZV_BACKEND=tcp: the launch Master rendezvous rides the
+    native TCPStore daemon instead of the HTTP KVServer."""
+    monkeypatch.setenv("PADDLE_TPU_RDZV_BACKEND", "tcp")
+    from paddle_tpu.distributed.launch.master import (
+        Master, TCPStoreServer, rendezvous_backend)
+    assert rendezvous_backend() == "tcp"
+    srv = TCPStoreServer(0).start()
+    try:
+        m1 = Master(f"127.0.0.1:{srv.port}", job_id="j1")
+        m2 = Master(f"127.0.0.1:{srv.port}", job_id="j1")
+        m1.register("nodeA", {"nproc": 2})
+        m2.register("nodeB", {"nproc": 2})
+        peers = m1.wait_peers(2, timeout=10)
+        assert sorted(peers) == ["nodeA", "nodeB"]
+        assert peers["nodeA"]["nproc"] == 2
+        m1.heartbeat("nodeA")
+        assert "nodeA" in m1.alive_nodes()
+    finally:
+        srv.stop()
