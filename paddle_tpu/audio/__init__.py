@@ -22,29 +22,16 @@ def _apply(name, fn, *args):
     return eager_apply(name, fn, args, {})
 
 
-def _hz_to_mel(f):
-    return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
-
-
-def _mel_to_hz(m):
-    return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
-
-
-def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None):
-    """[n_mels, n_fft//2+1] mel filterbank (reference:
-    python/paddle/audio/functional/functional.py compute_fbank_matrix)."""
-    f_max = f_max or sr / 2
-    mels = np.linspace(_hz_to_mel(f_min), _hz_to_mel(f_max), n_mels + 2)
-    hz = _mel_to_hz(mels)
-    bins = np.floor((n_fft + 1) * hz / sr).astype(int)
-    fb = np.zeros((n_mels, n_fft // 2 + 1), np.float32)
-    for i in range(n_mels):
-        l, c, r = bins[i], bins[i + 1], bins[i + 2]
-        if c > l:
-            fb[i, l:c] = (np.arange(l, c) - l) / max(c - l, 1)
-        if r > c:
-            fb[i, c:r] = (r - np.arange(c, r)) / max(r - c, 1)
-    return fb
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2+1] mel filterbank as a numpy array — ONE
+    construction shared with paddle.audio.functional (reference:
+    python/paddle/audio/functional/functional.py compute_fbank_matrix;
+    Slaney scale + area normalization by default, like the reference
+    feature layers)."""
+    from .functional import fbank_matrix_np
+    return fbank_matrix_np(sr, n_fft, n_mels=n_mels, f_min=f_min,
+                           f_max=f_max, htk=htk, norm=norm)
 
 
 def get_window(window, win_length, fftbins=True):
@@ -96,12 +83,13 @@ class Spectrogram(Layer):
 class MelSpectrogram(Layer):
     def __init__(self, sr=16000, n_fft=512, hop_length=None, win_length=None,
                  window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
-                 dtype="float32"):
+                 htk=False, norm="slaney", dtype="float32"):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
                                        power)
         self.fbank = jnp.asarray(
-            compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max))
+            compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                 htk=htk, norm=norm))
 
     def forward(self, x):
         spec = self.spectrogram(x)

@@ -3,36 +3,56 @@
 
 These are host-side filterbank/window constructions (numpy in, Tensor
 out) plus small value transforms; the compute-heavy features (STFT, mel
-projection) are the layers in paddle_tpu.audio which lower to XLA.
+projection) are the layers in paddle_tpu.audio which lower to XLA — and
+build their filterbanks from THIS module, so layers and functional
+helpers share one definition.
 """
 from __future__ import annotations
 
 import numpy as np
 
 from ..core.tensor import Tensor
-from . import get_window as _window_np
 
 __all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
            "compute_fbank_matrix", "power_to_db", "create_dct",
            "get_window"]
 
 
+def _hz_to_mel_np(f, htk):
+    """Vectorized numpy core shared by the public wrappers and the
+    filterbank construction."""
+    f = np.asarray(f, np.float64)
+    if htk:
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    out = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10)
+                                         / min_log_hz) / logstep,
+                    out)
+
+
+def _mel_to_hz_np(m, htk):
+    m = np.asarray(m, np.float64)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    out = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                    out)
+
+
 def hz_to_mel(freq, htk: bool = False):
     """Hz -> mel (reference functional.py:29). htk=True uses the HTK
     formula; default is the Slaney/librosa piecewise scale."""
-    f = np.asarray(freq, np.float64)
-    if htk:
-        out = 2595.0 * np.log10(1.0 + f / 700.0)
-    else:
-        f_min, f_sp = 0.0, 200.0 / 3
-        out = (f - f_min) / f_sp
-        min_log_hz = 1000.0
-        min_log_mel = (min_log_hz - f_min) / f_sp
-        logstep = np.log(6.4) / 27.0
-        out = np.where(f >= min_log_hz,
-                       min_log_mel + np.log(np.maximum(f, 1e-10)
-                                            / min_log_hz) / logstep,
-                       out)
+    out = _hz_to_mel_np(freq, htk)
     if np.isscalar(freq) or np.ndim(freq) == 0:
         return float(out)
     return Tensor(np.asarray(out, np.float32))
@@ -40,18 +60,7 @@ def hz_to_mel(freq, htk: bool = False):
 
 def mel_to_hz(mel, htk: bool = False):
     """mel -> Hz (reference functional.py:83)."""
-    m = np.asarray(mel, np.float64)
-    if htk:
-        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
-    else:
-        f_min, f_sp = 0.0, 200.0 / 3
-        out = f_min + f_sp * m
-        min_log_hz = 1000.0
-        min_log_mel = (min_log_hz - f_min) / f_sp
-        logstep = np.log(6.4) / 27.0
-        out = np.where(m >= min_log_mel,
-                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
-                       out)
+    out = _mel_to_hz_np(mel, htk)
     if np.isscalar(mel) or np.ndim(mel) == 0:
         return float(out)
     return Tensor(np.asarray(out, np.float32))
@@ -62,16 +71,33 @@ def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
                     dtype: str = "float32"):
     """n_mels frequencies evenly spaced on the mel scale
     (reference functional.py:126)."""
-    lo = hz_to_mel(float(f_min), htk=htk)
-    hi = hz_to_mel(float(f_max), htk=htk)
-    mels = np.linspace(lo, hi, n_mels)
-    hz = np.asarray([mel_to_hz(float(m), htk=htk) for m in mels])
-    return Tensor(hz.astype(dtype))
+    mels = np.linspace(_hz_to_mel_np(f_min, htk), _hz_to_mel_np(f_max, htk),
+                       n_mels)
+    return Tensor(_mel_to_hz_np(mels, htk).astype(dtype))
 
 
 def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
     """Center frequencies of rfft bins (reference functional.py:166)."""
     return Tensor(np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def fbank_matrix_np(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                    htk=False, norm="slaney", dtype="float32"):
+    """Numpy filterbank core (used by the audio feature layers too)."""
+    f_max = f_max if f_max is not None else sr / 2
+    mel_pts = np.linspace(_hz_to_mel_np(f_min, htk),
+                          _hz_to_mel_np(f_max, htk), n_mels + 2)
+    hz_pts = _mel_to_hz_np(mel_pts, htk)
+    fft_hz = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    up = (fft_hz[None, :] - hz_pts[:n_mels, None]) / np.maximum(
+        hz_pts[1:n_mels + 1, None] - hz_pts[:n_mels, None], 1e-10)
+    down = (hz_pts[2:n_mels + 2, None] - fft_hz[None, :]) / np.maximum(
+        hz_pts[2:n_mels + 2, None] - hz_pts[1:n_mels + 1, None], 1e-10)
+    fb = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
+        fb *= enorm[:, None]
+    return fb.astype(dtype)
 
 
 def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
@@ -80,22 +106,9 @@ def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
     functional.py:189): triangular filters centered on the chosen mel
     scale (Slaney by default, HTK with ``htk=True``); ``norm='slaney'``
     area-normalizes each filter, ``norm=None`` leaves unit peaks."""
-    f_max = f_max if f_max is not None else sr / 2
-    lo = hz_to_mel(float(f_min), htk=htk)
-    hi = hz_to_mel(float(f_max), htk=htk)
-    mel_pts = np.linspace(lo, hi, n_mels + 2)
-    hz_pts = np.asarray([mel_to_hz(float(m), htk=htk) for m in mel_pts])
-    fft_hz = np.linspace(0, sr / 2, 1 + n_fft // 2)
-    fb = np.zeros((n_mels, 1 + n_fft // 2), np.float64)
-    for i in range(n_mels):
-        left, center, right = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
-        up = (fft_hz - left) / max(center - left, 1e-10)
-        down = (right - fft_hz) / max(right - center, 1e-10)
-        fb[i] = np.maximum(0.0, np.minimum(up, down))
-    if norm == "slaney":
-        enorm = 2.0 / (hz_pts[2:n_mels + 2] - hz_pts[:n_mels])
-        fb *= enorm[:, None]
-    return Tensor(fb.astype(dtype))
+    return Tensor(fbank_matrix_np(sr, n_fft, n_mels=n_mels, f_min=f_min,
+                                  f_max=f_max, htk=htk, norm=norm,
+                                  dtype=dtype))
 
 
 def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
@@ -127,5 +140,6 @@ def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
 def get_window(window, win_length: int, fftbins: bool = True,
                dtype: str = "float32"):
     """Window function as a Tensor (reference window.py get_window)."""
+    from . import get_window as _window_np   # late: avoids import cycle
     return Tensor(_window_np(window, win_length, fftbins=fftbins)
                   .astype(dtype))

@@ -184,7 +184,11 @@ class TCPStoreServer:
             # writes that drive worker behavior, so honor the operator's
             # interface restriction on this backend too
             bind_host = os.environ.get("PADDLE_TPU_RDZV_BIND_HOST", "")
-        self._store = TCPStore("127.0.0.1", port, is_master=True,
+        # the owner's own client must dial an address the daemon actually
+        # listens on (loopback only works for wildcard/loopback binds)
+        connect_host = bind_host if bind_host not in ("", "0.0.0.0") \
+            else "127.0.0.1"
+        self._store = TCPStore(connect_host, port, is_master=True,
                                token=token, timeout=120,
                                bind_host=bind_host)
         self.port = self._store.port

@@ -1,2 +1,6 @@
 """paddle_tpu.incubate.nn (analog of python/paddle/incubate/nn/)."""
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401,E402
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedMultiTransformer)

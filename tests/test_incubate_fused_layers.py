@@ -1,0 +1,93 @@
+"""incubate.nn fused Layer classes (reference:
+incubate/nn/layer/fused_transformer.py etc.): reference weight layouts,
+pre/post-LN paths, and numeric parity against the unfused composition.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.incubate.nn import (
+    FusedLinear, FusedDropoutAdd, FusedBiasDropoutResidualLayerNorm,
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer, FusedMultiTransformer)
+
+
+def _x(shape, seed=0):
+    return paddle.to_tensor(np.random.default_rng(seed)
+                            .standard_normal(shape).astype(np.float32))
+
+
+def test_fused_linear_matches_linear():
+    paddle.seed(0)
+    fl = FusedLinear(8, 4)
+    x = _x((3, 8))
+    ref = paddle.matmul(x, fl.weight) + fl.bias
+    np.testing.assert_allclose(fl(x).numpy(), ref.numpy(), rtol=1e-6)
+    # transpose_weight keeps the [out, in] layout
+    ft = FusedLinear(8, 4, transpose_weight=True)
+    assert tuple(ft.weight.shape) == (4, 8)
+    assert tuple(ft(x).shape) == (3, 4)
+
+
+def test_fused_dropout_add_eval_is_add():
+    fda = FusedDropoutAdd(p=0.9)
+    fda.eval()
+    x, y = _x((2, 3)), _x((2, 3), 1)
+    np.testing.assert_allclose(fda(x, y).numpy(),
+                               (x + y).numpy(), rtol=1e-6)
+
+
+def test_bias_dropout_residual_ln():
+    m = FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+    x, r = _x((2, 5, 16)), _x((2, 5, 16), 1)
+    out = m(x, r)
+    ref = F.layer_norm(r + x + m.linear_bias, 16, m.ln_scale, m.ln_bias)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("pre_ln", [False, True])
+def test_fused_mha_weight_layout_and_paths(pre_ln):
+    paddle.seed(3)
+    m = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                attn_dropout_rate=0.0,
+                                normalize_before=pre_ln)
+    assert tuple(m.qkv_weight.shape) == (3, 4, 8, 32)   # reference layout
+    assert tuple(m.qkv_bias.shape) == (3, 4, 8)
+    m.eval()
+    x = _x((2, 6, 32))
+    out = m(x)
+    assert tuple(out.shape) == (2, 6, 32)
+    assert np.isfinite(out.numpy()).all()
+    # grads reach the packed weights
+    for p in m.parameters():
+        p.stop_gradient = False
+    m(x).sum().backward()
+    assert m.qkv_weight.grad is not None
+
+
+@pytest.mark.slow
+def test_fused_ffn_and_encoder_layer_train():
+    paddle.seed(4)
+    enc = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    opt = paddle.optimizer.SGD(parameters=enc.parameters(),
+                               learning_rate=0.05)
+    x = _x((2, 6, 32))
+    tgt = _x((2, 6, 32), 9)
+    losses = []
+    for _ in range(4):
+        loss = ((enc(x) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_fused_multi_transformer_stack():
+    m = FusedMultiTransformer(32, 4, 64, num_layers=3)
+    m.eval()
+    out = m(_x((1, 5, 32)))
+    assert tuple(out.shape) == (1, 5, 32)
+    assert len(m.layers) == 3

@@ -70,6 +70,7 @@ def _worker(port, rank, world, q):
         q.put((rank, "err", repr(e)))
 
 
+@pytest.mark.slow
 def test_multiprocess_rendezvous():
     """The rendezvous pattern across REAL processes (SURVEY §4: multi-node
     is multi-process single-node): every rank publishes, the barrier
@@ -147,6 +148,7 @@ def test_bind_host_restricts_interface():
         master.close()
 
 
+@pytest.mark.slow
 def test_launch_rendezvous_over_tcp_backend(monkeypatch):
     """PADDLE_TPU_RDZV_BACKEND=tcp: the launch Master rendezvous rides the
     native TCPStore daemon instead of the HTTP KVServer."""
