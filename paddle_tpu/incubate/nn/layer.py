@@ -129,6 +129,11 @@ class FusedMultiHeadAttention(nn.Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention incremental decode (cache=) is "
+                "not implemented; use kernels/paged_attention for serving "
+                "decode")
         import paddle_tpu as paddle
         residual = query
         x = query
@@ -244,6 +249,9 @@ class FusedTransformerEncoderLayer(nn.Layer):
             linear2_weight_attr=w2[1], linear2_bias_attr=b2[1])
 
     def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer cache= is not implemented")
         return self.ffn(self.fused_attn(src, attn_mask=src_mask))
 
 
@@ -263,6 +271,10 @@ class FusedMultiTransformer(nn.Layer):
                 "FusedMultiTransformer: unsupported arguments "
                 f"{sorted(kw)} (per-layer weight-attr lists / quant "
                 "options are not implemented on this stack)")
+        if epsilon != 1e-5:
+            raise NotImplementedError(
+                "FusedMultiTransformer: non-default epsilon is not "
+                "plumbed through the layer stack yet")
         self.layers = nn.LayerList([
             FusedTransformerEncoderLayer(
                 embed_dim, num_heads, dim_feedforward,

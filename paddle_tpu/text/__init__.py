@@ -143,7 +143,10 @@ class ViterbiDecoder:
                            (potentials, lengths), {})
 
 
-__all__ = ["Vocab", "TextFileDataset", "ViterbiDecoder"]
+from .datasets import UCIHousing, Imikolov, Imdb  # noqa: E402,F401
+
+__all__ = ["Vocab", "TextFileDataset", "ViterbiDecoder", "UCIHousing",
+           "Imikolov", "Imdb"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,

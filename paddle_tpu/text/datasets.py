@@ -1,0 +1,202 @@
+"""paddle.text datasets (reference: python/paddle/text/datasets/ —
+uci_housing.py, imikolov.py, imdb.py).
+
+Zero-egress design: this environment cannot download, so ``download=True``
+raises with the dataset's canonical URL, and every dataset accepts
+``data_file``/``data_dir`` pointing at locally provided data in the SAME
+format the reference downloads (tests build tiny files in those formats).
+Parsing/normalization matches the reference loaders.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+UCI_HOUSING_URL = ("http://paddlemodels.bj.bcebos.com/uci_housing/"
+                   "housing.data")
+IMIKOLOV_URL = ("https://dataset.bj.bcebos.com/imikolov%2F"
+                "simple-examples.tgz")
+IMDB_URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+
+def _no_download(name, url):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(zero egress). Fetch {url} yourself and pass data_file=/"
+        f"data_dir= pointing at it.")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression set (reference: uci_housing.py:51).
+
+    data_file: whitespace-separated floats, 14 numbers per sample (13
+    features + price) — the exact upstream ``housing.data`` layout.
+    Features are average-normalized over the TRAIN split (the first 80%),
+    matching the reference's normalization.
+    """
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        if data_file is None:
+            _no_download("UCIHousing", UCI_HOUSING_URL)
+        self._load(data_file)
+
+    def _load(self, path, feature_num=14, ratio=0.8):
+        data = np.fromfile(path, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        offset = int(data.shape[0] * ratio)
+        # reference normalization: (x - avg) / (max - min), stats over the
+        # TRAIN portion only
+        maxs = data[:offset].max(axis=0)
+        mins = data[:offset].min(axis=0)
+        avgs = data[:offset].mean(axis=0)
+        span = np.where(maxs - mins == 0, 1.0, maxs - mins)
+        feats = (data[:, :-1] - avgs[:-1]) / span[:-1]
+        data = np.concatenate([feats, data[:, -1:]], axis=1)
+        self.data = (data[:offset] if self.mode == "train"
+                     else data[offset:]).astype(np.float32)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (reference: imikolov.py): builds the
+    word dictionary from the train split (frequency-sorted, min word
+    cutoff), yields n-grams ('NGRAM') or full sentences ('SEQ') bounded
+    by <s>/<e>, with <unk> for out-of-vocabulary words.
+
+    data_file: the upstream ``simple-examples.tgz`` (or any tar with
+    ``*/data/ptb.{train,valid}.txt`` members).
+    """
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ"), data_type
+        if data_type.upper() == "NGRAM":
+            assert window_size > 0, "NGRAM needs window_size > 0"
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode
+        self.min_word_freq = min_word_freq
+        if data_file is None:
+            _no_download("Imikolov", IMIKOLOV_URL)
+        self._load(data_file)
+
+    def _member(self, tf, split):
+        pat = re.compile(rf".*/data/ptb\.{split}\.txt$")
+        for m in tf.getmembers():
+            if pat.match(m.name):
+                return m
+        raise FileNotFoundError(f"ptb.{split}.txt not in archive")
+
+    def _build_dict(self, tf):
+        freq = {}
+        with tf.extractfile(self._member(tf, "train")) as f:
+            for line in f:
+                for w in line.decode().strip().split():
+                    freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = [(w, c) for w, c in freq.items() if c >= self.min_word_freq]
+        kept.sort(key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, path):
+        with tarfile.open(path) as tf:
+            self.word_idx = self._build_dict(tf)
+            unk = self.word_idx["<unk>"]
+            split = "train" if self.mode == "train" else "valid"
+            self.data = []
+            with tf.extractfile(self._member(tf, split)) as f:
+                for line in f:
+                    words = line.decode().strip().split()
+                    if self.data_type == "NGRAM":
+                        toks = ["<s>"] + words + ["<e>"]
+                        if len(toks) < self.window_size:
+                            continue
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                    else:
+                        ids = [self.word_idx.get(w, unk)
+                               for w in ["<s>"] + words + ["<e>"]]
+                        self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB movie-review sentiment set (reference: imdb.py): tokenizes
+    reviews from the aclImdb tar layout (``aclImdb/{train,test}/{pos,neg}/
+    *.txt``), builds the frequency-sorted word dict from BOTH train
+    polarity dirs, and yields (ids, label) with label 0=pos, 1=neg (the
+    reference's encoding).
+    """
+
+    _tokenize = staticmethod(
+        lambda s: re.sub(r"[^a-z0-9\s]", "", s.lower()).split())
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        if data_file is None:
+            _no_download("Imdb", IMDB_URL)
+        self._load(data_file, cutoff)
+
+    def _docs(self, tf, split, polarity):
+        pat = re.compile(rf"aclImdb/{split}/{polarity}/.*\.txt$")
+        for m in tf.getmembers():
+            if pat.match(m.name):
+                with tf.extractfile(m) as f:
+                    yield self._tokenize(f.read().decode(errors="replace"))
+
+    def _load(self, path, cutoff):
+        with tarfile.open(path) as tf:
+            freq = {}
+            for pol in ("pos", "neg"):
+                for words in self._docs(tf, "train", pol):
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+            kept = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+            kept = kept[:cutoff] if cutoff else kept
+            self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+            self.word_idx["<unk>"] = len(self.word_idx)
+            unk = self.word_idx["<unk>"]
+            self.docs, self.labels = [], []
+            for label, pol in ((0, "pos"), (1, "neg")):
+                for words in self._docs(tf, self.mode, pol):
+                    self.docs.append(
+                        np.asarray([self.word_idx.get(w, unk)
+                                    for w in words], np.int64))
+                    self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+__all__ = ["UCIHousing", "Imikolov", "Imdb"]
