@@ -187,3 +187,8 @@ class ImageFolder(DatasetFolder):
 
 __all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100",
            "DatasetFolder", "ImageFolder"]
+
+
+from .datasets_voc_flowers import VOC2012, Flowers  # noqa: E402,F401
+
+__all__ += ["VOC2012", "Flowers"]

@@ -1,0 +1,118 @@
+"""VOC2012 + Flowers datasets (reference: python/paddle/vision/datasets/
+voc2012.py, flowers.py).
+
+Zero-egress design like paddle_tpu.text.datasets: ``download=True`` with
+no file raises naming the canonical URL; the loaders parse the SAME
+archive layouts the reference downloads (VOCtrainval tar; 102flowers tgz
++ imagelabels.mat + setid.mat), so locally fetched data drops in.
+"""
+from __future__ import annotations
+
+import io
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+VOC_URL = ("https://dataset.bj.bcebos.com/voc/VOCtrainval_11-May-2012"
+           ".tar")
+FLOWERS_DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+FLOWERS_LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+FLOWERS_SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+
+_VOC_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_VOC_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_VOC_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+# upstream MODE_FLAG_MAP (voc2012.py): train -> trainval (train+val
+# lists concatenated), valid -> val, test -> train
+_VOC_MODE_FLAG = {"train": "trainval", "valid": "val", "test": "train"}
+
+
+def _no_download(name, url):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(zero egress). Fetch {url} yourself and pass the file path.")
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs from the upstream tar layout
+    (reference: voc2012.py:54): JPEG image + PNG class-index mask, split
+    lists under ImageSets/Segmentation. Returns (image HWC uint8 array,
+    label HW uint8 array); pass ``transform`` to post-process."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), mode
+        if data_file is None:
+            _no_download("VOC2012", VOC_URL)
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        set_file = _VOC_SET_FILE.format(_VOC_MODE_FLAG[mode])
+        names = [ln.strip().decode()
+                 for ln in self._tar.extractfile(self._members[set_file])
+                 if ln.strip()]
+        self.data = [_VOC_DATA_FILE.format(n) for n in names]
+        self.labels = [_VOC_LABEL_FILE.format(n) for n in names]
+
+    def _img(self, member_name):
+        from PIL import Image
+        blob = self._tar.extractfile(self._members[member_name]).read()
+        return np.asarray(Image.open(io.BytesIO(blob)))
+
+    def __getitem__(self, idx):
+        image = self._img(self.data[idx])
+        label = self._img(self.labels[idx])
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: flowers.py): images from the
+    102flowers tgz, labels from imagelabels.mat, official split indices
+    from setid.mat (trnid/valid/tstid, 1-based into jpg order)."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        mode = mode.lower()
+        assert mode in ("train", "valid", "test"), mode
+        if data_file is None:
+            _no_download("Flowers", FLOWERS_DATA_URL)
+        if label_file is None:
+            _no_download("Flowers labels", FLOWERS_LABEL_URL)
+        if setid_file is None:
+            _no_download("Flowers setid", FLOWERS_SETID_URL)
+        self.transform = transform
+        import scipy.io as scio
+        self.labels = np.asarray(
+            scio.loadmat(label_file)["labels"]).reshape(-1)
+        self.indexes = np.asarray(
+            scio.loadmat(setid_file)[self._SPLIT_KEY[mode]]).reshape(-1)
+        self._tar = tarfile.open(data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        self._jpgs = sorted(n for n in self._members
+                            if n.endswith(".jpg"))
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        index = int(self.indexes[idx]) - 1          # setid is 1-based
+        blob = self._tar.extractfile(
+            self._members[self._jpgs[index]]).read()
+        image = np.asarray(Image.open(io.BytesIO(blob)))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, int(self.labels[index])
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+__all__ = ["VOC2012", "Flowers"]

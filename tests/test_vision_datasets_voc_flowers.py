@@ -1,0 +1,93 @@
+"""VOC2012 + Flowers over synthetic archives in the upstream layouts
+(reference: vision/datasets/voc2012.py, flowers.py)."""
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import VOC2012, Flowers
+
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _add(tf, name, blob):
+    info = tarfile.TarInfo(name)
+    info.size = len(blob)
+    tf.addfile(info, io.BytesIO(blob))
+
+
+def test_voc2012_layout(tmp_path):
+    rng = np.random.default_rng(0)
+    path = tmp_path / "VOCtrainval.tar"
+    with tarfile.open(path, "w") as tf:
+        # upstream split lists: train mode reads trainval (reference
+        # MODE_FLAG_MAP), test mode reads train
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+             b"img0\nimg1\n")
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+             b"img0\n")
+        _add(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+             b"img1\n")
+        for n in ("img0", "img1"):
+            _add(tf, f"VOCdevkit/VOC2012/JPEGImages/{n}.jpg",
+                 _jpg_bytes(rng.integers(0, 255, (8, 10, 3),
+                                         dtype=np.uint8)))
+            _add(tf, f"VOCdevkit/VOC2012/SegmentationClass/{n}.png",
+                 _png_bytes(rng.integers(0, 20, (8, 10), dtype=np.uint8)))
+    train = VOC2012(data_file=str(path), mode="train")
+    valid = VOC2012(data_file=str(path), mode="valid")
+    test = VOC2012(data_file=str(path), mode="test")
+    assert len(train) == 2 and len(valid) == 1 and len(test) == 1
+    img, label = train[0]
+    assert img.shape == (8, 10, 3) and label.shape == (8, 10)
+    # transform applies to the image only
+    t = VOC2012(data_file=str(path), mode="train",
+                transform=lambda im: im.astype(np.float32) / 255)
+    img, _ = t[0]
+    assert img.dtype == np.float32 and img.max() <= 1.0
+
+
+def test_flowers_split_and_labels(tmp_path):
+    import scipy.io as scio
+    rng = np.random.default_rng(1)
+    data_path = tmp_path / "102flowers.tgz"
+    with tarfile.open(data_path, "w:gz") as tf:
+        for i in range(4):
+            _add(tf, f"jpg/image_{i:05d}.jpg",
+                 _jpg_bytes(np.full((6, 6, 3), i * 40, np.uint8)))
+    labels = np.asarray([[3, 1, 2, 5]])
+    scio.savemat(tmp_path / "imagelabels.mat", {"labels": labels})
+    scio.savemat(tmp_path / "setid.mat",
+                 {"trnid": np.asarray([[1, 3]]),
+                  "valid": np.asarray([[2]]),
+                  "tstid": np.asarray([[4]])})
+    train = Flowers(data_file=str(data_path),
+                    label_file=str(tmp_path / "imagelabels.mat"),
+                    setid_file=str(tmp_path / "setid.mat"), mode="train")
+    assert len(train) == 2
+    img, label = train[0]
+    assert img.shape == (6, 6, 3) and label == 3    # 1-based index 1
+    img2, label2 = train[1]
+    assert label2 == 2                               # index 3 -> label 2
+    test = Flowers(data_file=str(data_path),
+                   label_file=str(tmp_path / "imagelabels.mat"),
+                   setid_file=str(tmp_path / "setid.mat"), mode="test")
+    assert len(test) == 1 and test[0][1] == 5
+
+
+def test_download_disabled():
+    with pytest.raises(RuntimeError, match="zero egress"):
+        VOC2012()
