@@ -111,41 +111,58 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
-class EarlyStopping(Callback):
-    """Stop when a monitored metric stops improving
-    (reference: callbacks.py EarlyStopping)."""
+class _MonitorMixin:
+    """Shared metric-monitoring machinery (mode resolution, improvement
+    test, metric extraction) for EarlyStopping / ReduceLROnPlateau."""
 
-    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
-                 min_delta=0, baseline=None, save_best_model=True):
-        super().__init__()
+    def _init_monitor(self, monitor, mode, min_delta):
         self.monitor = monitor
-        self.patience = patience
-        self.verbose = verbose
         self.min_delta = abs(min_delta)
-        self.baseline = baseline
-        self.save_best_model = save_best_model
         if mode == "auto":
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
-        self.reset()
 
-    def reset(self):
-        self.wait = 0
-        self.stopped_epoch = 0
-        self.best = self.baseline if self.baseline is not None else (
-            float("-inf") if self.mode == "max" else float("inf"))
+    def _best_init(self):
+        return float("-inf") if self.mode == "max" else float("inf")
 
     def _better(self, cur):
         if self.mode == "max":
             return cur > self.best + self.min_delta
         return cur < self.best - self.min_delta
 
-    def on_eval_end(self, logs=None):
+    def _metric(self, logs):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
-            return
+            return None
         if isinstance(cur, (list, tuple)):
             cur = cur[0]
+        return float(cur)
+
+
+class EarlyStopping(_MonitorMixin, Callback):
+    """Stop when a monitored metric stops improving
+    (reference: callbacks.py EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.reset()
+
+    def reset(self):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = (self.baseline if self.baseline is not None
+                     else self._best_init())
+
+    def on_eval_end(self, logs=None):
+        cur = self._metric(logs)
+        if cur is None:
+            return
         if self._better(float(cur)):
             self.best = float(cur)
             self.wait = 0
@@ -202,5 +219,73 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     return cbk_list
 
 
+class ReduceLROnPlateau(_MonitorMixin, Callback):
+    """Scale the LR down when a monitored metric plateaus (reference:
+    callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.reset()
+
+    def reset(self):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = self._best_init()
+
+    def _scale_lr(self):
+        """Multiply the live LR source by ``factor`` (bounded by min_lr).
+        For a scheduler, scale BASE_LR so its own decay composes on the
+        reduced base rather than double-applying (review: writing the
+        decayed last_lr into base_lr compounds the reduction)."""
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return None, None
+        lr = getattr(opt, "_learning_rate", None)
+        if hasattr(lr, "step"):        # an LRScheduler object
+            old = float(getattr(lr, "base_lr", getattr(lr, "last_lr", 0)))
+            new = max(old * self.factor, self.min_lr)
+            if hasattr(lr, "base_lr"):
+                lr.base_lr = new
+            if hasattr(lr, "last_lr"):
+                lr.last_lr = max(float(lr.last_lr) * self.factor,
+                                 self.min_lr)
+            return old, new
+        old = float(lr) if lr is not None else None
+        if old is None or old <= self.min_lr:
+            return old, old
+        new = max(old * self.factor, self.min_lr)
+        opt.set_lr(new)                # optimizer API (optimizer.py:44)
+        return old, new
+
+    def on_eval_end(self, logs=None):
+        cur = self._metric(logs)
+        if cur is None:
+            return
+        if self.cooldown_counter > 0:
+            # in cooldown: no plateau counting at all (upstream if/else)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            old, new = self._scale_lr()
+            if self.verbose and old is not None and new != old:
+                print(f"ReduceLROnPlateau: lr {old:.6g} -> {new:.6g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
-           "EarlyStopping", "LRScheduler", "config_callbacks"]
+           "EarlyStopping", "LRScheduler", "ReduceLROnPlateau",
+           "config_callbacks"]

@@ -1,0 +1,101 @@
+"""paddle.hub — hubconf-based model loading (reference:
+python/paddle/hapi/hub.py list:188 / help:238 / load:286).
+
+A hub repo is a directory with a ``hubconf.py`` whose public callables
+are the entrypoints; ``dependencies = [...]`` in hubconf is validated
+before load. ``source='local'`` is fully supported; github/gitee need a
+network fetch, unavailable in this environment (zero egress) — they
+raise with the reference's repo-spec format so the call site is
+portable.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+VAR_DEPENDENCY = "dependencies"
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _check_dependencies(m):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if not deps:
+        return
+    missing = []
+    for pkg in deps:
+        try:
+            __import__(pkg)
+        except ImportError:
+            missing.append(pkg)
+    if missing:
+        raise RuntimeError("Missing dependencies: " + ", ".join(missing))
+
+
+def _resolve(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed: "github" | "gitee" | '
+            '"local".')
+    if source != "local":
+        raise RuntimeError(
+            f"hub source={source!r} needs a network fetch of "
+            f"{repo_dir!r} (repo_owner/repo_name[:tag]), which this "
+            "environment cannot do (zero egress); clone the repo and use "
+            "source='local'")
+    return repo_dir
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exported by the repo's hubconf (reference:
+    hub.py:188)."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    m = _import_hubconf(repo_dir)
+    return [f for f in dir(m)
+            if callable(getattr(m, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """The entrypoint's docstring (reference: hub.py:238)."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    m = _import_hubconf(repo_dir)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate a hub entrypoint (reference: hub.py:286): validates
+    ``dependencies``, resolves the callable, calls it with kwargs. The
+    repo dir stays on sys.path for the call so entrypoints can lazily
+    import sibling modules (the common hubconf layout)."""
+    repo_dir = _resolve(repo_dir, source, force_reload)
+    m = _import_hubconf(repo_dir)
+    _check_dependencies(m)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    sys.path.insert(0, repo_dir)
+    try:
+        return fn(**kwargs)
+    finally:
+        if repo_dir in sys.path:
+            sys.path.remove(repo_dir)
+
+
+__all__ = ["list", "help", "load"]

@@ -15,6 +15,45 @@ import numpy as np
 
 from ..io import Dataset
 
+
+def _check_backend(backend):
+    if backend not in (None, "pil", "cv2", "numpy"):
+        raise ValueError(
+            f"unsupported backend {backend!r}; use 'pil', 'cv2' or None")
+    return backend
+
+
+class _LazyTar:
+    """Picklable tar accessor: the handle opens per process on first use,
+    so datasets survive the DataLoader's spawn-worker pickling."""
+
+    def __init__(self, path):
+        self.path = path
+        self._tar = None
+        self._members = None
+
+    def _ensure(self):
+        if self._tar is None:
+            self._tar = tarfile.open(self.path)
+            self._members = {m.name: m for m in self._tar.getmembers()}
+
+    @property
+    def members(self):
+        self._ensure()
+        return self._members
+
+    def read(self, name):
+        self._ensure()
+        return self._tar.extractfile(self._members[name]).read()
+
+    def __getstate__(self):
+        return {"path": self.path}
+
+    def __setstate__(self, state):
+        self.path = state["path"]
+        self._tar = None
+        self._members = None
+
 VOC_URL = ("https://dataset.bj.bcebos.com/voc/VOCtrainval_11-May-2012"
            ".tar")
 FLOWERS_DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
@@ -48,22 +87,23 @@ class VOC2012(Dataset):
         if data_file is None:
             _no_download("VOC2012", VOC_URL)
         self.transform = transform
-        self._tar = tarfile.open(data_file)
-        self._members = {m.name: m for m in self._tar.getmembers()}
+        self.backend = _check_backend(backend)
+        self._tar = _LazyTar(data_file)
         set_file = _VOC_SET_FILE.format(_VOC_MODE_FLAG[mode])
         names = [ln.strip().decode()
-                 for ln in self._tar.extractfile(self._members[set_file])
+                 for ln in self._tar.read(set_file).splitlines()
                  if ln.strip()]
         self.data = [_VOC_DATA_FILE.format(n) for n in names]
         self.labels = [_VOC_LABEL_FILE.format(n) for n in names]
 
-    def _img(self, member_name):
+    def _img(self, member_name, as_pil=False):
         from PIL import Image
-        blob = self._tar.extractfile(self._members[member_name]).read()
-        return np.asarray(Image.open(io.BytesIO(blob)))
+        img = Image.open(io.BytesIO(self._tar.read(member_name)))
+        return img if as_pil else np.asarray(img)
 
     def __getitem__(self, idx):
-        image = self._img(self.data[idx])
+        as_pil = self.backend == "pil"
+        image = self._img(self.data[idx], as_pil=as_pil)
         label = self._img(self.labels[idx])
         if self.transform is not None:
             image = self.transform(image)
@@ -91,22 +131,21 @@ class Flowers(Dataset):
         if setid_file is None:
             _no_download("Flowers setid", FLOWERS_SETID_URL)
         self.transform = transform
+        self.backend = _check_backend(backend)
         import scipy.io as scio
         self.labels = np.asarray(
             scio.loadmat(label_file)["labels"]).reshape(-1)
         self.indexes = np.asarray(
             scio.loadmat(setid_file)[self._SPLIT_KEY[mode]]).reshape(-1)
-        self._tar = tarfile.open(data_file)
-        self._members = {m.name: m for m in self._tar.getmembers()}
-        self._jpgs = sorted(n for n in self._members
+        self._tar = _LazyTar(data_file)
+        self._jpgs = sorted(n for n in self._tar.members
                             if n.endswith(".jpg"))
 
     def __getitem__(self, idx):
         from PIL import Image
         index = int(self.indexes[idx]) - 1          # setid is 1-based
-        blob = self._tar.extractfile(
-            self._members[self._jpgs[index]]).read()
-        image = np.asarray(Image.open(io.BytesIO(blob)))
+        img = Image.open(io.BytesIO(self._tar.read(self._jpgs[index])))
+        image = img if self.backend == "pil" else np.asarray(img)
         if self.transform is not None:
             image = self.transform(image)
         return image, int(self.labels[index])

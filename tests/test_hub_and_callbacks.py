@@ -1,0 +1,64 @@
+"""paddle.hub (local hubconf source, reference hapi/hub.py) and the
+ReduceLROnPlateau callback (reference hapi/callbacks.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = ["numpy"]\n'
+        "def tiny_mlp(width=4, **kw):\n"
+        '    """A tiny MLP entrypoint."""\n'
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(width, 2)\n"
+        "def _private():\n"
+        "    pass\n")
+    return str(tmp_path)
+
+
+def test_hub_list_help_load(tmp_path):
+    repo = _hub_repo(tmp_path)
+    names = paddle.hub.list(repo, source="local")
+    assert names == ["tiny_mlp"]
+    assert "tiny MLP" in paddle.hub.help(repo, "tiny_mlp", source="local")
+    m = paddle.hub.load(repo, "tiny_mlp", source="local", width=6)
+    out = m(paddle.to_tensor(np.ones((1, 6), np.float32)))
+    assert tuple(out.shape) == (1, 2)
+    with pytest.raises(RuntimeError, match="zero egress"):
+        paddle.hub.load("owner/repo:main", "tiny_mlp", source="github")
+    with pytest.raises(RuntimeError, match="Cannot find callable"):
+        paddle.hub.load(repo, "nope", source="local")
+
+
+def test_hub_missing_dependency(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = ["definitely_not_installed_pkg"]\n'
+        "def entry():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="Missing dependencies"):
+        paddle.hub.load(str(tmp_path), "entry", source="local")
+
+
+def test_reduce_lr_on_plateau():
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class FakeModel:
+        pass
+
+    model = FakeModel()
+    model._optimizer = paddle.optimizer.SGD(
+        parameters=[paddle.to_tensor(np.ones(2, np.float32))],
+        learning_rate=1.0)
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.model = model
+    cb.on_eval_end({"loss": 1.0})          # best = 1.0
+    cb.on_eval_end({"loss": 1.0})          # wait 1
+    assert float(model._optimizer._learning_rate) == 1.0
+    cb.on_eval_end({"loss": 1.0})          # wait 2 -> reduce
+    assert float(model._optimizer._learning_rate) == 0.5
+    cb.on_eval_end({"loss": 0.5})          # improvement resets
+    cb.on_eval_end({"loss": 0.9})
+    cb.on_eval_end({"loss": 0.9})
+    assert float(model._optimizer._learning_rate) == 0.25
