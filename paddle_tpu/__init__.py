@@ -61,6 +61,7 @@ from . import text  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .hapi import hub  # noqa: F401,E402
+from .hapi.flops import flops  # noqa: F401,E402
 
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless
 # PADDLE_TPU_FORCE_PALLAS=1 — the interpret-mode CI path).

@@ -1,0 +1,146 @@
+"""paddle.flops — per-layer FLOP counting (reference:
+python/paddle/hapi/dynamic_flops.py:40 flops / :237 dynamic_flops).
+
+Forward-post hooks record each LEAF layer's FLOPs from its input/output
+shapes; multiply-accumulate counts follow the reference's counters
+(convNd: out_numel * cin/groups * prod(k); linear: in_f * out_f * rows;
+bn/activations: numel). ``custom_ops`` maps Layer classes to
+``fn(layer, inputs, output) -> flops`` overrides.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+
+
+def _numel(t):
+    n = 1
+    for s in t.shape:
+        n *= int(s)
+    return n
+
+
+def _count_conv(m, inputs, output):
+    """MACs for forward AND transpose convs (the transpose conv's cost is
+    the same product over its per-output-element gather)."""
+    kernel_numel = 1
+    for k in (m._kernel_size if isinstance(m._kernel_size, (list, tuple))
+              else [m._kernel_size]):
+        kernel_numel *= int(k)
+    cin = int(m._in_channels)
+    groups = int(getattr(m, "_groups", 1) or 1)
+    return _numel(output) * (cin // groups) * kernel_numel
+
+
+def _count_linear(m, inputs, output):
+    in_f = int(m.weight.shape[0])
+    return _numel(output) * in_f
+
+
+def _count_numel(m, inputs, output):
+    return _numel(output)
+
+
+def _count_zero(m, inputs, output):
+    return 0
+
+
+def _transpose_convs():
+    return tuple(getattr(nn, n) for n in
+                 ("Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose")
+                 if hasattr(nn, n))
+
+
+_COUNTERS = None
+
+
+def _counters():
+    global _COUNTERS
+    if _COUNTERS is None:
+        _COUNTERS = [
+            ((nn.Conv1D, nn.Conv2D, nn.Conv3D) + _transpose_convs(),
+             _count_conv),
+            ((nn.Linear,), _count_linear),
+            ((nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D, nn.BatchNorm,
+              nn.LayerNorm, nn.GroupNorm, nn.InstanceNorm2D), _count_numel),
+            ((nn.ReLU, nn.ReLU6, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Softmax,
+              nn.Silu, nn.LeakyReLU, nn.Hardswish, nn.Hardsigmoid),
+             _count_numel),
+            ((nn.AvgPool1D, nn.AvgPool2D, nn.AvgPool3D,
+              nn.AdaptiveAvgPool1D, nn.AdaptiveAvgPool2D,
+              nn.AdaptiveAvgPool3D), _count_numel),
+            ((nn.MaxPool1D, nn.MaxPool2D, nn.MaxPool3D, nn.Dropout,
+              nn.Flatten), _count_zero),
+        ]
+    return _COUNTERS
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Total multiply-accumulate FLOPs of one forward at ``input_size``
+    (reference: dynamic_flops.py flops). Returns an int; with
+    ``print_detail`` also prints the per-layer table."""
+    import paddle_tpu as paddle
+
+    custom_ops = custom_ops or {}
+    rows = []
+    handles = []
+    counted = set()
+
+    def make_hook(layer, counter):
+        def hook(m, inputs, output):
+            out = output[0] if isinstance(output, (list, tuple)) else output
+            f = int(counter(m, inputs, out))
+            params = sum(_numel(p) for p in m.parameters())
+            rows.append((type(m).__name__, list(out.shape), params, f))
+            return output
+        return hook
+
+    def resolve(layer):
+        if type(layer) in custom_ops:
+            return custom_ops[type(layer)]
+        for classes, fn in _counters():
+            if isinstance(layer, classes):
+                return fn
+        return None
+
+    for layer in net.sublayers(include_self=True):
+        if layer in counted or list(layer.children()):
+            continue   # leaves only
+        counter = resolve(layer)
+        if counter is None:
+            if any(True for _ in layer.parameters()):
+                import warnings
+                warnings.warn(
+                    f"paddle.flops: no counter for {type(layer).__name__}; "
+                    "its FLOPs are not included (pass custom_ops)")
+            continue
+        counted.add(layer)
+        handles.append(layer.register_forward_post_hook(
+            make_hook(layer, counter)))
+
+    # snapshot PER-LAYER training flags: net.train() would recursively
+    # force training=True onto sublayers the user froze in eval mode
+    modes = [(m, m.training) for m in net.sublayers(include_self=True)]
+    net.eval()
+    try:
+        x = paddle.to_tensor(
+            np.zeros(tuple(input_size), np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        for m, was in modes:
+            m.training = was
+
+    total = sum(r[3] for r in rows)
+    if print_detail:
+        print(f"{'Layer':<24}{'Output shape':<24}{'Params':>12}"
+              f"{'FLOPs':>16}")
+        for name, shape, params, f in rows:
+            print(f"{name:<24}{str(shape):<24}{params:>12}{f:>16}")
+        print(f"Total FLOPs: {total}")
+    return total
+
+
+__all__ = ["flops"]

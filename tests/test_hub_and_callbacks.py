@@ -62,3 +62,24 @@ def test_reduce_lr_on_plateau():
     cb.on_eval_end({"loss": 0.9})
     cb.on_eval_end({"loss": 0.9})
     assert float(model._optimizer._learning_rate) == 0.25
+
+
+def test_paddle_flops_counts_conv_and_linear():
+    """paddle.flops (reference hapi/dynamic_flops.py): per-layer MAC
+    counts for the standard layer set; hand-checked totals."""
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(3, 8, 3, padding=1),   # 32*32*8 * 3*9 = 221184
+        paddle.nn.ReLU(),                        # 8192
+        paddle.nn.Flatten(1),
+        paddle.nn.Linear(8 * 32 * 32, 10),       # 8192*10 = 81920
+    )
+    total = paddle.flops(net, [1, 3, 32, 32])
+    conv = 32 * 32 * 8 * 3 * 9
+    relu = 8 * 32 * 32
+    fc = 8 * 32 * 32 * 10
+    assert total == conv + relu + fc, (total, conv + relu + fc)
+    # custom counter override wins
+    total2 = paddle.flops(
+        net, [1, 3, 32, 32],
+        custom_ops={paddle.nn.ReLU: lambda m, x, y: 7})
+    assert total2 == conv + 7 + fc
