@@ -85,7 +85,6 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     custom_ops = custom_ops or {}
     rows = []
     handles = []
-    counted = set()
 
     def make_hook(layer, counter):
         def hook(m, inputs, output):
@@ -105,8 +104,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         return None
 
     for layer in net.sublayers(include_self=True):
-        if layer in counted or list(layer.children()):
-            continue   # leaves only
+        if list(layer.children()):
+            continue   # leaves only (sublayers() already deduplicates)
         counter = resolve(layer)
         if counter is None:
             if any(True for _ in layer.parameters()):
@@ -115,7 +114,6 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                     f"paddle.flops: no counter for {type(layer).__name__}; "
                     "its FLOPs are not included (pass custom_ops)")
             continue
-        counted.add(layer)
         handles.append(layer.register_forward_post_hook(
             make_hook(layer, counter)))
 

@@ -376,3 +376,121 @@ def _pca_lowrank(a, *, q, center):
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     return tuple(op_call("pca_lowrank", _pca_lowrank, x, q=q,
                          center=bool(center)))
+
+
+@op_body("matrix_exp")
+def _matrix_exp(a):
+    return jax.scipy.linalg.expm(a)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference: tensor/linalg.py matrix_exp)."""
+    return op_call("matrix_exp", _matrix_exp, x)
+
+
+@op_body("cholesky_inverse")
+def _cholesky_inverse(L, *, upper):
+    # inv(A) from A's Cholesky factor: solve L L^T X = I
+    eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+    if upper:
+        L = jnp.swapaxes(L, -1, -2).conj()
+    y = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(L, -1, -2).conj(), y, lower=False)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """inv(A) given A's Cholesky factor (reference: tensor/linalg.py
+    cholesky_inverse)."""
+    return op_call("cholesky_inverse", _cholesky_inverse, x,
+                   upper=bool(upper))
+
+
+def _pivots_to_perm_matrix(pivots, m, dtype):
+    """1-based successive row swaps (LAPACK convention) -> P [m, m],
+    batch-free core (vmapped for batched inputs)."""
+    perm = jnp.arange(m)
+    for i in range(pivots.shape[-1]):
+        j = pivots[i] - 1
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    return jax.nn.one_hot(perm, m, dtype=dtype).T
+
+
+@op_body("lu_unpack")
+def _lu_unpack(lu_mat, pivots, *, unpack_ludata, unpack_pivots):
+    m, n = lu_mat.shape[-2], lu_mat.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_mat[..., :, :k], k=-1) + jnp.eye(m, k,
+                                                         dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+    if unpack_pivots:
+        core = lambda piv: _pivots_to_perm_matrix(  # noqa: E731
+            piv, m, lu_mat.dtype)
+        if pivots.ndim > 1:
+            batch = pivots.reshape((-1, pivots.shape[-1]))
+            P = jax.vmap(core)(batch).reshape(
+                pivots.shape[:-1] + (m, m))
+        else:
+            P = core(pivots)
+    return P, L, U
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack paddle.linalg.lu's packed factor + pivots into (P, L, U)
+    (reference: tensor/linalg.py lu_unpack)."""
+    return op_call("lu_unpack", _lu_unpack, x, y,
+                   unpack_ludata=bool(unpack_ludata),
+                   unpack_pivots=bool(unpack_pivots))
+
+
+@op_body("ormqr")
+def _ormqr(a, tau, other, *, left, transpose):
+    """Multiply ``other`` by the FULL implicit Q [m, m] from the
+    Householder factors a/tau (reference ormqr semantics). Q comes from
+    XLA's fused orgqr primitive (jax.lax.linalg.householder_product) on
+    the factor padded to m columns — one op instead of k unrolled
+    reflector matmuls."""
+    m, n = a.shape[-2], a.shape[-1]
+    k = tau.shape[-1]
+    if n < m:   # pad factor/taus so orgqr yields the FULL m x m Q
+        pad_a = jnp.zeros((*a.shape[:-1], m - n), a.dtype)
+        a = jnp.concatenate([a, pad_a], axis=-1)
+    if k < m:
+        pad_t = jnp.zeros((*tau.shape[:-1], m - k), tau.dtype)
+        tau = jnp.concatenate([tau, pad_t], axis=-1)
+    q = jax.lax.linalg.householder_product(a, tau)
+    q = jnp.swapaxes(q, -1, -2).conj() if transpose else q
+    return q @ other if left else other @ q
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """(reference: tensor/linalg.py ormqr)."""
+    return op_call("ormqr", _ormqr, x, tau, other, left=bool(left),
+                   transpose=bool(transpose))
+
+
+@op_body("histogram_bin_edges")
+def _histogram_bin_edges(a, *, bins, min, max):
+    # fully traced (no float() concretization): works under vjp/jit when
+    # the input carries gradients
+    use_data = (min == 0 and max == 0)
+    if use_data:
+        lo = a.min().astype(jnp.float32)
+        hi = a.max().astype(jnp.float32)
+        same = lo == hi
+        lo = jnp.where(same, lo - 0.5, lo)
+        hi = jnp.where(same, hi + 0.5, hi)
+    else:
+        lo = jnp.asarray(float(min), jnp.float32)
+        hi = jnp.asarray(float(max), jnp.float32)
+    step = (hi - lo) / bins
+    return lo + step * jnp.arange(bins + 1, dtype=jnp.float32)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """(reference: tensor/linalg.py histogram_bin_edges)."""
+    return op_call("histogram_bin_edges", _histogram_bin_edges, input,
+                   bins=int(bins), min=min, max=max)

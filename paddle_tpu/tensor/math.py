@@ -664,3 +664,49 @@ def frobenius_norm(x, axis=None, keepdim=False, name=None):
     return op_call("frobenius_norm", _frobenius_norm, x,
                    axis=_axis(axis) if axis is not None else None,
                    keepdims=keepdim)
+
+
+@op_body("vander")
+def _vander(a, *, n, increasing):
+    return jnp.vander(a, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference: tensor/math.py vander)."""
+    return op_call("vander", _vander, x,
+                   n=int(n) if n is not None else None,
+                   increasing=bool(increasing))
+
+
+@op_body("cartesian_prod")
+def _cartesian_prod(*xs):
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (reference: tensor/math.py
+    cartesian_prod). A single input passes through 1-D (reference
+    docstring behavior)."""
+    if len(x) == 1:
+        return x[0]
+    return op_call("cartesian_prod", _cartesian_prod, *x)
+
+
+@op_body("combinations")
+def _combinations(a, *, r, with_replacement):
+    import itertools as it
+    n = a.shape[0]
+    fn = it.combinations_with_replacement if with_replacement \
+        else it.combinations
+    idx = list(fn(range(n), r))
+    if not idx:
+        return jnp.zeros((0, r), a.dtype)
+    return a[jnp.asarray(idx)]
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """r-length index combinations of a 1-D tensor (reference:
+    tensor/math.py combinations)."""
+    return op_call("combinations", _combinations, x, r=int(r),
+                   with_replacement=bool(with_replacement))

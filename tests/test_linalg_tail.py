@@ -1,0 +1,84 @@
+"""Linalg/math straggler ops added in round 4 (reference:
+tensor/linalg.py matrix_exp/cholesky_inverse/lu_unpack/ormqr/
+histogram_bin_edges; tensor/math.py vander/cartesian_prod/combinations)
+— each pinned against scipy/numpy oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.tensor as T
+
+
+def test_matrix_exp_vs_scipy():
+    import scipy.linalg as sla
+    a = np.random.default_rng(0).standard_normal((4, 4)).astype(np.float32) * 0.3
+    np.testing.assert_allclose(T.matrix_exp(paddle.to_tensor(a)).numpy(),
+                               sla.expm(a), rtol=1e-4, atol=1e-5)
+
+
+def test_cholesky_inverse():
+    a = np.random.default_rng(1).standard_normal((4, 4)).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.linalg.cholesky(spd)
+    np.testing.assert_allclose(
+        T.cholesky_inverse(paddle.to_tensor(L)).numpy(),
+        np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    # upper factor round-trips too
+    np.testing.assert_allclose(
+        T.cholesky_inverse(paddle.to_tensor(L.T.copy()), upper=True).numpy(),
+        np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_lu_unpack_reconstructs():
+    a = np.random.default_rng(2).standard_normal((4, 4)).astype(np.float32)
+    lu_mat, piv = T.lu(paddle.to_tensor(a))
+    P, L, U = T.lu_unpack(lu_mat, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ormqr_vs_lapack():
+    from scipy.linalg import lapack, qr as scipy_qr
+    rng = np.random.default_rng(3)
+    m = rng.standard_normal((4, 3)).astype(np.float64)
+    geqrf, tau, _, _ = lapack.dgeqrf(m)
+    Qfull = scipy_qr(m, mode="full")[0]
+    other = rng.standard_normal((4, 2)).astype(np.float64)
+    out = T.ormqr(paddle.to_tensor(geqrf.astype(np.float32)),
+                  paddle.to_tensor(tau.astype(np.float32)),
+                  paddle.to_tensor(other.astype(np.float32)))
+    np.testing.assert_allclose(out.numpy(),
+                               (Qfull @ other).astype(np.float32),
+                               rtol=1e-3, atol=1e-4)
+    outT = T.ormqr(paddle.to_tensor(geqrf.astype(np.float32)),
+                   paddle.to_tensor(tau.astype(np.float32)),
+                   paddle.to_tensor(other.astype(np.float32)),
+                   transpose=True)
+    np.testing.assert_allclose(outT.numpy(),
+                               (Qfull.T @ other).astype(np.float32),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_vander_cartesian_combinations_binedges():
+    v = T.vander(paddle.to_tensor(np.asarray([1., 2., 3.], np.float32)),
+                 n=3)
+    np.testing.assert_allclose(v.numpy(), np.vander([1., 2., 3.], 3))
+    v_inc = T.vander(paddle.to_tensor(np.asarray([1., 2.], np.float32)),
+                     n=3, increasing=True)
+    np.testing.assert_allclose(v_inc.numpy(),
+                               np.vander([1., 2.], 3, increasing=True))
+    cp = T.cartesian_prod([
+        paddle.to_tensor(np.asarray([1, 2], np.int32)),
+        paddle.to_tensor(np.asarray([3, 4], np.int32))])
+    np.testing.assert_allclose(cp.numpy(),
+                               [[1, 3], [1, 4], [2, 3], [2, 4]])
+    cb = T.combinations(
+        paddle.to_tensor(np.asarray([1., 2., 3.], np.float32)), r=2)
+    np.testing.assert_allclose(cb.numpy(), [[1, 2], [1, 3], [2, 3]])
+    cbr = T.combinations(
+        paddle.to_tensor(np.asarray([1., 2.], np.float32)), r=2,
+        with_replacement=True)
+    np.testing.assert_allclose(cbr.numpy(), [[1, 1], [1, 2], [2, 2]])
+    edges = T.histogram_bin_edges(
+        paddle.to_tensor(np.asarray([0., 4.], np.float32)), bins=4)
+    np.testing.assert_allclose(edges.numpy(), [0, 1, 2, 3, 4])
