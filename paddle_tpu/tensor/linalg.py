@@ -480,17 +480,26 @@ def _histogram_bin_edges(a, *, bins, min, max):
     if use_data:
         lo = a.min().astype(jnp.float32)
         hi = a.max().astype(jnp.float32)
-        same = lo == hi
-        lo = jnp.where(same, lo - 0.5, lo)
-        hi = jnp.where(same, hi + 0.5, hi)
     else:
         lo = jnp.asarray(float(min), jnp.float32)
         hi = jnp.asarray(float(max), jnp.float32)
-    step = (hi - lo) / bins
-    return lo + step * jnp.arange(bins + 1, dtype=jnp.float32)
+    # reference semantics: a degenerate range widens by +-0.5 in BOTH
+    # branches (linalg.py histogram_bin_edges)
+    same = lo == hi
+    lo = jnp.where(same, lo - 0.5, lo)
+    hi = jnp.where(same, hi + 0.5, hi)
+    # linspace pins both endpoints exactly (float32 accumulation drift)
+    return jnp.linspace(lo, hi, bins + 1, dtype=jnp.float32)
+
+
+def _check_histogram_range(min, max):
+    if not (min == 0 and max == 0) and float(max) < float(min):
+        raise ValueError(
+            "max must be larger than min in range parameter")
 
 
 def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
     """(reference: tensor/linalg.py histogram_bin_edges)."""
+    _check_histogram_range(min, max)
     return op_call("histogram_bin_edges", _histogram_bin_edges, input,
                    bins=int(bins), min=min, max=max)

@@ -697,12 +697,15 @@ def cartesian_prod(x, name=None):
 def _combinations(a, *, r, with_replacement):
     import itertools as it
     n = a.shape[0]
+    if r == 0:
+        # reference: r==0 returns an empty tensor (math.py combinations)
+        return jnp.zeros((0,), a.dtype)
     fn = it.combinations_with_replacement if with_replacement \
         else it.combinations
     idx = list(fn(range(n), r))
     if not idx:
         return jnp.zeros((0, r), a.dtype)
-    return a[jnp.asarray(idx)]
+    return a[jnp.asarray(idx, dtype=jnp.int32)]
 
 
 def combinations(x, r=2, with_replacement=False, name=None):

@@ -64,6 +64,7 @@ def test_sampling_controls():
         gen.generate(ids, max_new_tokens=100)  # exceeds max_len
 
 
+@pytest.mark.slow
 def test_eos_padding():
     model, cfg = _model()
     gen = Generator(model, max_len=32)

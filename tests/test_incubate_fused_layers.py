@@ -47,6 +47,7 @@ def test_bias_dropout_residual_ln():
                                atol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("pre_ln", [False, True])
 def test_fused_mha_weight_layout_and_paths(pre_ln):
     paddle.seed(3)
@@ -85,6 +86,7 @@ def test_fused_ffn_and_encoder_layer_train():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_stack():
     m = FusedMultiTransformer(32, 4, 64, num_layers=3)
     m.eval()

@@ -32,6 +32,7 @@ def test_jit_save_load_roundtrip(tmp_path):
         loaded.train()
 
 
+@pytest.mark.slow
 def test_jit_save_dynamic_batch(tmp_path):
     net = _net()
     path = str(tmp_path / "dyn")

@@ -88,6 +88,7 @@ def test_launch_restarts_failed_worker(tmp_path):
     assert ctl.run() == 0
 
 
+@pytest.mark.slow
 def test_elastic_membership_and_watchdog():
     port = free_port()
     srv = KVServer(port).start()

@@ -394,6 +394,7 @@ def test_attention_return_softmax():
     assert vprobs is not None and np.asarray(vprobs.numpy()).shape[0] == 2
 
 
+@pytest.mark.slow
 def test_cummax_nan_sticky():
     x = paddle.to_tensor(np.array([1.0, np.nan, 0.5, 3.0], np.float32))
     v, i = paddle.cummax(x, axis=0)

@@ -310,6 +310,7 @@ class TestRNN:
             opt.clear_grad()
         assert loss.item() < 0.1
 
+    @pytest.mark.slow
     def test_bidirectional_shapes(self):
         gru = nn.GRU(4, 8, num_layers=2, direction="bidirect")
         out, states = gru(paddle.randn([2, 5, 4]))

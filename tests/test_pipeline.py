@@ -32,6 +32,7 @@ def _seq_apply(params_list, x_mb):
     return jnp.stack(ys)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("schedule", ["fthenb", "1f1b"])
 def test_pipeline_forward_parity(schedule):
     mesh = dist.init_mesh({"pp": 8})
