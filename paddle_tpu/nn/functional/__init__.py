@@ -21,6 +21,9 @@ from .loss import (  # noqa: F401
     cosine_embedding_loss, triplet_margin_loss, hinge_embedding_loss,
     square_error_cost, sigmoid_focal_loss, ctc_loss, rnnt_loss,
     fused_linear_cross_entropy, margin_cross_entropy, hsigmoid_loss,
+    soft_margin_loss, multi_label_soft_margin_loss, multi_margin_loss,
+    gaussian_nll_loss, poisson_nll_loss, npair_loss,
+    adaptive_log_softmax_with_loss,
 )
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,

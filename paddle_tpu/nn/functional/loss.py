@@ -637,3 +637,194 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     return op_call("rnnt_loss", _rnnt_loss, input, label, input_lengths,
                    label_lengths, blank=blank,
                    fastemit_lambda=fastemit_lambda, reduction=reduction)
+
+
+@op_body("soft_margin_loss")
+def _soft_margin_loss(z, y, *, reduction):
+    # log(1 + exp(-y*z)) via softplus for stability
+    return _reduce_arr(jax.nn.softplus(-y * z), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """(reference: nn/functional/loss.py soft_margin_loss)."""
+    return op_call("soft_margin_loss", _soft_margin_loss, input, label,
+                   reduction=reduction)
+
+
+@op_body("multi_label_soft_margin_loss")
+def _multi_label_soft_margin_loss(z, y, *maybe_w, reduction):
+    # -(y*log sigmoid(z) + (1-y)*log sigmoid(-z)) averaged over classes
+    per = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+    if maybe_w:
+        per = per * maybe_w[0]
+    loss = per.mean(axis=-1)
+    return _reduce_arr(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """(reference: loss.py multi_label_soft_margin_loss)."""
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call("multi_label_soft_margin_loss",
+                   _multi_label_soft_margin_loss, *args,
+                   reduction=reduction)
+
+
+@op_body("multi_margin_loss")
+def _multi_margin_loss(z, y, *maybe_w, p, margin, reduction):
+    n, c = z.shape
+    y = y.astype(jnp.int32)
+    gold = jnp.take_along_axis(z, y[:, None], axis=1)      # [n, 1]
+    per_class = jnp.maximum(0.0, margin - gold + z) ** p   # [n, c]
+    if maybe_w:
+        per_class = per_class * maybe_w[0][y][:, None]
+    # the gold class itself is excluded from the sum
+    mask = jax.nn.one_hot(y, c, dtype=z.dtype)
+    loss = ((1 - mask) * per_class).sum(axis=1) / c
+    return _reduce_arr(loss, reduction)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """(reference: loss.py multi_margin_loss)."""
+    args = [input, label] + ([weight] if weight is not None else [])
+    return op_call("multi_margin_loss", _multi_margin_loss, *args,
+                   p=p, margin=margin, reduction=reduction)
+
+
+@op_body("gaussian_nll_loss")
+def _gaussian_nll_loss(inp, lbl, var, *, full, epsilon, reduction):
+    var = jnp.maximum(var, epsilon)
+    loss = 0.5 * (jnp.log(var) + (inp - lbl) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, inp.dtype))
+    return _reduce_arr(loss, reduction)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """(reference: loss.py gaussian_nll_loss)."""
+    return op_call("gaussian_nll_loss", _gaussian_nll_loss, input, label,
+                   variance, full=bool(full), epsilon=epsilon,
+                   reduction=reduction)
+
+
+@op_body("poisson_nll_loss")
+def _poisson_nll_loss(inp, lbl, *, log_input, full, epsilon, reduction):
+    if log_input:
+        loss = jnp.exp(inp) - lbl * inp
+    else:
+        # reference formula: log(input + epsilon), not a clamp
+        loss = inp - lbl * jnp.log(inp + epsilon)
+    if full:
+        # Stirling approximation for label! (applied where label > 1)
+        stirling = (lbl * jnp.log(jnp.maximum(lbl, 1.0)) - lbl
+                    + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(lbl, 1.0)))
+        loss = loss + jnp.where(lbl > 1, stirling, 0.0)
+    return _reduce_arr(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """(reference: loss.py poisson_nll_loss)."""
+    return op_call("poisson_nll_loss", _poisson_nll_loss, input, label,
+                   log_input=bool(log_input), full=bool(full),
+                   epsilon=epsilon, reduction=reduction)
+
+
+@op_body("npair_loss")
+def _npair_loss(anchor, positive, labels, *, l2_reg):
+    """(reference: loss.py npair_loss; Sohn 2016): cross-entropy over
+    anchor-positive similarity logits + L2 on the embeddings."""
+    labels = labels.reshape(-1)
+    batch = labels.shape[0]
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    target = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1.0)
+    logits = anchor @ positive.T
+    logp = jax.nn.log_softmax(logits, axis=1)
+    ce = -(target * logp).sum(axis=1).mean()
+    l2 = (jnp.sum(anchor ** 2) + jnp.sum(positive ** 2)) / batch
+    return ce + l2_reg * l2 * 0.25
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """(reference: loss.py npair_loss)."""
+    return op_call("npair_loss", _npair_loss, anchor, positive, labels,
+                   l2_reg=l2_reg)
+
+
+@op_body("adaptive_log_softmax_with_loss")
+def _adaptive_log_softmax(h, lbl, head_w, *rest, cutoffs, has_head_bias,
+                          n_tail):
+    """Adaptive softmax (reference: loss.py adaptive_log_softmax_with_loss;
+    Grave et al. 2017): frequent classes in the head, rare classes in
+    down-projected tail clusters addressed via cluster logits. On TPU the
+    per-cluster projections stay dense matmuls; cluster membership routes
+    through masks (static shapes, no gather-by-partition)."""
+    i = 0
+    head_b = None
+    if has_head_bias:
+        head_b = rest[i]
+        i += 1
+    tails = rest[i:]
+    head_logits = h @ head_w
+    if head_b is not None:
+        head_logits = head_logits + head_b
+    head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+    n_head = head_w.shape[1] - n_tail
+    out = jnp.zeros(h.shape[0], h.dtype)
+    # head tokens: direct log-prob (negative labels are NOT head tokens —
+    # same safe-index discipline as cross_entropy above)
+    in_head = (lbl >= 0) & (lbl < cutoffs[0])
+    safe_head = jnp.where(in_head, lbl, 0).astype(jnp.int32)
+    lp_head = jnp.take_along_axis(head_logp, safe_head[:, None],
+                                  axis=1)[:, 0]
+    out = jnp.where(in_head, lp_head, out)
+    # tail clusters: cluster logit + within-cluster log-prob
+    for c in range(n_tail):
+        lo = cutoffs[c]
+        hi = cutoffs[c + 1]
+        w1, w2 = tails[2 * c], tails[2 * c + 1]
+        in_c = (lbl >= lo) & (lbl < hi)
+        cluster_lp = head_logp[:, n_head + c]
+        tail_logits = (h @ w1) @ w2
+        tail_logp = jax.nn.log_softmax(tail_logits, axis=-1)
+        safe = jnp.where(in_c, lbl - lo, 0).astype(jnp.int32)
+        lp = jnp.take_along_axis(tail_logp, safe[:, None], axis=1)[:, 0]
+        out = jnp.where(in_c, cluster_lp + lp, out)
+    loss = -out.mean()
+    return out, loss
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """(reference: loss.py adaptive_log_softmax_with_loss). Returns
+    (per-token log-prob of the gold class, mean NLL loss). Labels must
+    lie in [0, cutoffs[-1]); out-of-range labels raise eagerly (the
+    reference's ValueError) — under a trace they cannot be checked and
+    would poison the mean.
+
+    head_weight: [hidden, n_head + n_clusters]; tail_weights: list of
+    (proj [hidden, d_c], cls [d_c, cluster_size]) pairs; cutoffs:
+    ascending class boundaries [c0, c1, ..., n_classes]."""
+    import numpy as _np
+    try:
+        lab = _np.asarray(label.numpy() if hasattr(label, "numpy")
+                          else label)
+    except Exception:   # traced labels: the eager check cannot run
+        lab = None
+    if lab is not None and lab.size and (
+            lab.min() < 0 or lab.max() >= int(cutoffs[-1])):
+        raise ValueError(
+            f"adaptive_log_softmax_with_loss: labels must be in "
+            f"[0, {int(cutoffs[-1])}), got "
+            f"[{int(lab.min())}, {int(lab.max())}]")
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for pair in tail_weights:
+        args.extend(pair)
+    return op_call("adaptive_log_softmax_with_loss", _adaptive_log_softmax,
+                   *args, cutoffs=tuple(int(c) for c in cutoffs),
+                   has_head_bias=head_bias is not None,
+                   n_tail=len(tail_weights))

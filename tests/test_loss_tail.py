@@ -1,0 +1,111 @@
+"""Round-4 loss tail (reference: nn/functional/loss.py npair_loss /
+soft_margin_loss / multi_label_soft_margin_loss / multi_margin_loss /
+gaussian_nll_loss / poisson_nll_loss / adaptive_log_softmax_with_loss),
+pinned against torch CPU oracles where torch has the op."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_soft_margin_loss_vs_torch():
+    rng = np.random.default_rng(0)
+    z = rng.standard_normal((4, 5)).astype(np.float32)
+    y = np.where(rng.random((4, 5)) > 0.5, 1.0, -1.0).astype(np.float32)
+    ours = F.soft_margin_loss(_t(z), _t(y))
+    ref = torch.nn.functional.soft_margin_loss(torch.tensor(z),
+                                               torch.tensor(y))
+    np.testing.assert_allclose(float(ours.numpy()), float(ref), rtol=1e-5)
+
+
+def test_multi_label_soft_margin_vs_torch():
+    rng = np.random.default_rng(1)
+    z = rng.standard_normal((3, 6)).astype(np.float32)
+    y = (rng.random((3, 6)) > 0.5).astype(np.float32)
+    ours = F.multi_label_soft_margin_loss(_t(z), _t(y))
+    ref = torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(z), torch.tensor(y))
+    np.testing.assert_allclose(float(ours.numpy()), float(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("p", [1, 2])
+def test_multi_margin_vs_torch(p):
+    rng = np.random.default_rng(2)
+    z = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.integers(0, 5, (4,))
+    ours = F.multi_margin_loss(_t(z), _t(y.astype(np.int64)), p=p)
+    ref = torch.nn.functional.multi_margin_loss(
+        torch.tensor(z), torch.tensor(y), p=p)
+    np.testing.assert_allclose(float(ours.numpy()), float(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("full", [False, True])
+def test_gaussian_nll_vs_torch(full):
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((6,)).astype(np.float32)
+    mu = rng.standard_normal((6,)).astype(np.float32)
+    var = (rng.random((6,)).astype(np.float32) + 0.1)
+    ours = F.gaussian_nll_loss(_t(x), _t(mu), _t(var), full=full)
+    ref = torch.nn.functional.gaussian_nll_loss(
+        torch.tensor(x), torch.tensor(mu), torch.tensor(var), full=full)
+    np.testing.assert_allclose(float(ours.numpy()), float(ref), rtol=1e-4)
+
+
+@pytest.mark.parametrize("log_input,full", [(True, False), (False, False),
+                                            (True, True)])
+def test_poisson_nll_vs_torch(log_input, full):
+    rng = np.random.default_rng(4)
+    x = rng.random((8,)).astype(np.float32) + 0.1
+    y = rng.integers(0, 5, (8,)).astype(np.float32)
+    ours = F.poisson_nll_loss(_t(x), _t(y), log_input=log_input, full=full)
+    ref = torch.nn.functional.poisson_nll_loss(
+        torch.tensor(x), torch.tensor(y), log_input=log_input, full=full)
+    np.testing.assert_allclose(float(ours.numpy()), float(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_npair_loss_grads_and_structure():
+    rng = np.random.default_rng(5)
+    a = _t(rng.standard_normal((6, 8)).astype(np.float32))
+    p = _t(rng.standard_normal((6, 8)).astype(np.float32))
+    lbl = _t(np.asarray([0, 0, 1, 1, 2, 2], np.int64))
+    a.stop_gradient = False
+    loss = F.npair_loss(a, p, lbl)
+    assert np.isfinite(float(loss.numpy()))
+    loss.backward()
+    assert a.grad is not None
+    # l2_reg contributes: zero-reg loss differs
+    l0 = F.npair_loss(a, p, lbl, l2_reg=0.0)
+    assert float(loss.numpy()) > float(l0.numpy())
+
+
+def test_adaptive_log_softmax_vs_torch():
+    rng = np.random.default_rng(6)
+    hidden, n_classes = 16, 20
+    cutoffs = [8, 14, n_classes]
+    tt = torch.nn.AdaptiveLogSoftmaxWithLoss(
+        hidden, n_classes, cutoffs=cutoffs[:-1], div_value=2.0)
+    h = rng.standard_normal((10, hidden)).astype(np.float32)
+    y = rng.integers(0, n_classes, (10,))
+    with torch.no_grad():
+        ref_out, ref_loss = tt(torch.tensor(h), torch.tensor(y))
+    # mirror torch's parameters into our functional form
+    head_w = tt.head.weight.detach().numpy().T          # [h, n_head+2]
+    tails = []
+    for proj in tt.tail:
+        w1 = proj[0].weight.detach().numpy().T          # [h, d_c]
+        w2 = proj[1].weight.detach().numpy().T          # [d_c, csize]
+        tails.append((_t(w1), _t(w2)))
+    out, loss = F.adaptive_log_softmax_with_loss(
+        _t(h), _t(y.astype(np.int64)), _t(head_w), tails, cutoffs)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               ref_out.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(loss.numpy()), float(ref_loss),
+                               rtol=1e-4)
