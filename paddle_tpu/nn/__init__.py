@@ -29,7 +29,9 @@ from .layer.activation import (  # noqa: F401
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     SmoothL1Loss, HuberLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
-    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss,
+    TripletMarginLoss, HingeEmbeddingLoss, CTCLoss, SoftMarginLoss,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, GaussianNLLLoss,
+    PoissonNLLLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,

@@ -703,7 +703,17 @@ def _gaussian_nll_loss(inp, lbl, var, *, full, epsilon, reduction):
 
 def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
                       reduction="mean", name=None):
-    """(reference: loss.py gaussian_nll_loss)."""
+    """(reference: loss.py gaussian_nll_loss). Negative variances raise
+    eagerly (the reference's ValueError); under a trace the check cannot
+    run."""
+    import numpy as _np
+    try:
+        v = _np.asarray(variance.numpy() if hasattr(variance, "numpy")
+                        else variance)
+    except Exception:
+        v = None
+    if v is not None and v.size and v.min() < 0:
+        raise ValueError("gaussian_nll_loss: var has negative entry/entries")
     return op_call("gaussian_nll_loss", _gaussian_nll_loss, input, label,
                    variance, full=bool(full), epsilon=epsilon,
                    reduction=reduction)

@@ -133,3 +133,133 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths, norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           self.blank, self.reduction, norm_by_times)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon = full, epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, self.log_input, self.full,
+                                  self.epsilon, self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """(reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss): OWNS the
+    head + tail projection parameters (cluster c down-projects to
+    in_features / div_value**(c+1)) and applies the functional."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = [int(c) for c in cutoffs]
+        if (not cutoffs or cutoffs != sorted(cutoffs)
+                or len(set(cutoffs)) != len(cutoffs)
+                or cutoffs[0] <= 0 or cutoffs[-1] >= n_classes):
+            raise ValueError("cutoffs must be a non-empty strictly "
+                             "ascending list of ints in (0, n_classes)")
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(cutoffs)
+        n_head = (cutoffs[0] if cutoffs else n_classes) + self.n_clusters
+        self.head_weight = self.create_parameter([in_features, n_head])
+        self.head_bias = (self.create_parameter([n_head], is_bias=True)
+                          if head_bias else None)
+        self.tail_weights = []
+        for c in range(self.n_clusters):
+            d_c = max(1, int(in_features / (div_value ** (c + 1))))
+            csize = self.cutoffs[c + 1] - self.cutoffs[c]
+            w1 = self.create_parameter([in_features, d_c])
+            w2 = self.create_parameter([d_c, csize])
+            setattr(self, f"tail_{c}_proj", w1)
+            setattr(self, f"tail_{c}_cls", w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [n, n_classes] log-probabilities in ONE pass (reference
+        log_prob): head log-softmax once, then per cluster the cluster
+        logit + within-cluster log-softmax — O(n_clusters) matmuls, not
+        O(n_classes) forwards."""
+        import paddle_tpu as paddle
+        import paddle_tpu.tensor as T
+        import paddle_tpu.nn.functional as F_
+        logits = paddle.matmul(input, self.head_weight)
+        if self.head_bias is not None:
+            logits = logits + self.head_bias
+        head_logp = F_.log_softmax(logits, axis=-1)
+        n_head = self.cutoffs[0]
+        pieces = [head_logp[:, :n_head]]
+        for c, (w1, w2) in enumerate(self.tail_weights):
+            cluster_lp = head_logp[:, n_head + c:n_head + c + 1]
+            tail_logp = F_.log_softmax(
+                paddle.matmul(paddle.matmul(input, w1), w2), axis=-1)
+            pieces.append(cluster_lp + tail_logp)
+        return T.concat(pieces, axis=1)
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=1)

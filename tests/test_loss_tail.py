@@ -109,3 +109,31 @@ def test_adaptive_log_softmax_vs_torch():
                                ref_out.numpy(), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(float(loss.numpy()), float(ref_loss),
                                rtol=1e-4)
+
+
+def test_loss_layer_classes():
+    """nn.* Layer wrappers of the new losses + the parameter-owning
+    AdaptiveLogSoftmaxWithLoss (reference nn/layer/loss.py)."""
+    from paddle_tpu import nn
+    rng = np.random.default_rng(7)
+    z = _t(rng.standard_normal((4, 5)).astype(np.float32))
+    y = _t(np.where(rng.random((4, 5)) > 0.5, 1.0, -1.0).astype(np.float32))
+    out = nn.SoftMarginLoss()(z, y)
+    np.testing.assert_allclose(float(out.numpy()),
+                               float(F.soft_margin_loss(z, y).numpy()))
+
+    asm = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[8, 14],
+                                        div_value=2.0)
+    h = _t(rng.standard_normal((6, 16)).astype(np.float32))
+    lbl = _t(rng.integers(0, 20, (6,)).astype(np.int64))
+    lp, loss = asm(h, lbl)
+    assert lp.shape == [6] and np.isfinite(float(loss.numpy()))
+    # log_prob covers every class and normalizes (logsumexp ~ 0)
+    full = asm.log_prob(h)
+    assert tuple(full.shape) == (6, 20)
+    lse = np.log(np.exp(np.asarray(full.numpy())).sum(axis=1))
+    np.testing.assert_allclose(lse, 0.0, atol=1e-4)
+    pred = asm.predict(h)
+    assert pred.shape == [6]
+    with pytest.raises(ValueError, match="cutoffs"):
+        nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[14, 8])
