@@ -18,7 +18,7 @@ from .layer.pooling import (  # noqa: F401
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
     RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
-    LocalResponseNorm,
+    LocalResponseNorm, SpectralNorm,
 )
 from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LeakyReLU, ELU, CELU,

@@ -165,3 +165,32 @@ class LocalResponseNorm(Layer):
 
     def forward(self, x):
         return F.local_response_norm(x, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight parameter (reference:
+    nn/layer/norm.py:1847 SpectralNorm): power-iteration u/v vectors are
+    persistent buffers; forward returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        import numpy as np
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim % len(weight_shape):
+                w *= int(s)
+        rng = np.random.default_rng(0)
+        self.register_buffer("weight_u", __import__("paddle_tpu").to_tensor(
+            (rng.standard_normal(h) * 0.1).astype(np.float32)))
+        self.register_buffer("weight_v", __import__("paddle_tpu").to_tensor(
+            (rng.standard_normal(w) * 0.1).astype(np.float32)))
+
+    def forward(self, x):
+        from ..functional import spectral_norm as F_sn
+        return F_sn(x, self.weight_u, self.weight_v, dim=self._dim,
+                    power_iters=self._power_iters, eps=self._eps)

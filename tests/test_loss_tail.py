@@ -137,3 +137,17 @@ def test_loss_layer_classes():
     assert pred.shape == [6]
     with pytest.raises(ValueError, match="cutoffs"):
         nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[14, 8])
+
+
+def test_spectral_norm_layer():
+    """nn.SpectralNorm (reference nn/layer/norm.py:1847): normalizes the
+    weight's top singular value toward 1 via power iteration."""
+    from paddle_tpu import nn
+    rng = np.random.default_rng(8)
+    w = _t((rng.standard_normal((8, 6)) * 3).astype(np.float32))
+    sn = nn.SpectralNorm([8, 6], dim=0, power_iters=8)
+    out = sn(w)
+    s = np.linalg.svd(np.asarray(out.numpy()), compute_uv=False)
+    assert 0.9 < float(s[0]) < 1.1, s[0]
+    # buffers registered (persist through state_dict)
+    assert "weight_u" in sn.state_dict() and "weight_v" in sn.state_dict()
