@@ -1,6 +1,7 @@
 """Norm layers (analog of python/paddle/nn/layer/norm.py)."""
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
@@ -175,22 +176,24 @@ class SpectralNorm(Layer):
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None, dtype="float32"):
         super().__init__()
-        import numpy as np
+        from ...core.dtype import to_jax_dtype
         self._dim = dim
         self._power_iters = power_iters
         self._eps = eps
+        jdt = to_jax_dtype(dtype)
         h = int(weight_shape[dim])
         w = 1
         for i, s in enumerate(weight_shape):
             if i != dim % len(weight_shape):
                 w *= int(s)
         rng = np.random.default_rng(0)
-        self.register_buffer("weight_u", __import__("paddle_tpu").to_tensor(
-            (rng.standard_normal(h) * 0.1).astype(np.float32)))
-        self.register_buffer("weight_v", __import__("paddle_tpu").to_tensor(
-            (rng.standard_normal(w) * 0.1).astype(np.float32)))
+        self.register_buffer("weight_u", Tensor(jnp.asarray(
+            rng.standard_normal(h) * 0.1, jdt)))
+        self.register_buffer("weight_v", Tensor(jnp.asarray(
+            rng.standard_normal(w) * 0.1, jdt)))
 
     def forward(self, x):
-        from ..functional import spectral_norm as F_sn
-        return F_sn(x, self.weight_u, self.weight_v, dim=self._dim,
-                    power_iters=self._power_iters, eps=self._eps)
+        return F.spectral_norm(x, self.weight_u, self.weight_v,
+                               dim=self._dim,
+                               power_iters=self._power_iters,
+                               eps=self._eps)
