@@ -713,3 +713,109 @@ def combinations(x, r=2, with_replacement=False, name=None):
     tensor/math.py combinations)."""
     return op_call("combinations", _combinations, x, r=int(r),
                    with_replacement=bool(with_replacement))
+
+
+@op_body("diff")
+def _diff(a, *rest, n, axis, has_prepend, has_append):
+    i = 0
+    prepend = append = None
+    if has_prepend:
+        prepend = rest[i]
+        i += 1
+    if has_append:
+        append = rest[i]
+    return jnp.diff(a, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """n-th forward difference along an axis (reference: tensor/math.py
+    diff)."""
+    args = [x] + [t for t in (prepend, append) if t is not None]
+    return op_call("diff", _diff, *args, n=int(n), axis=int(axis),
+                   has_prepend=prepend is not None,
+                   has_append=append is not None)
+
+
+@op_body("trapezoid")
+def _trapezoid(y, *maybe_x, dx, axis):
+    if maybe_x:
+        return jnp.trapezoid(y, x=maybe_x[0], axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral (reference: tensor/math.py trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError(
+            "Not permitted to specify both x and dx input args.")
+    args = [y] + ([x] if x is not None else [])
+    return op_call("trapezoid", _trapezoid, *args, dx=dx, axis=int(axis))
+
+
+@op_body("cumulative_trapezoid")
+def _cumulative_trapezoid(y, *maybe_x, dx, axis):
+    ax = axis % y.ndim
+    n = y.shape[ax]
+    lo = jnp.take(y, jnp.arange(0, n - 1), axis=ax)
+    hi = jnp.take(y, jnp.arange(1, n), axis=ax)
+    avg = (lo + hi) * 0.5
+    if maybe_x:
+        xs = maybe_x[0]
+        w = jnp.diff(xs, axis=ax if xs.ndim == y.ndim else 0)
+        if xs.ndim != y.ndim:
+            shape = [1] * y.ndim
+            shape[ax] = -1
+            w = w.reshape(shape)
+        avg = avg * w
+    else:
+        avg = avg * (1.0 if dx is None else dx)
+    return jnp.cumsum(avg, axis=ax)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoidal integral (reference: tensor/math.py
+    cumulative_trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError(
+            "Not permitted to specify both x and dx input args.")
+    args = [y] + ([x] if x is not None else [])
+    return op_call("cumulative_trapezoid", _cumulative_trapezoid, *args,
+                   dx=dx, axis=int(axis))
+
+
+@op_body("take")
+def _take(a, idx, *, mode):
+    flat = a.reshape(-1)
+    n = flat.shape[0]
+    i = idx.astype(jnp.int32)   # x64 disabled on this stack
+    if mode == "wrap":
+        i = ((i % n) + n) % n
+    elif mode == "clip":
+        # reference (tensor/math.py:7146): clip to [0, n-1] — negative
+        # indexing is DISABLED in clip mode
+        i = jnp.clip(i, 0, n - 1)
+    i = jnp.where(i < 0, i + n, i)
+    return flat[i]
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-index gather (reference: tensor/math.py:7039 take):
+    mode 'raise' validates eagerly; 'wrap'/'clip' adjust out-of-bounds
+    indices."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"take mode must be raise/wrap/clip, got {mode!r}")
+    if mode == "raise":
+        try:
+            idx_np = np.asarray(index.numpy() if hasattr(index, "numpy")
+                                else index)
+        except Exception:
+            idx_np = None
+        if idx_np is not None and idx_np.size:
+            n = 1
+            for s in x.shape:
+                n *= int(s)
+            if idx_np.min() < -n or idx_np.max() >= n:
+                raise IndexError(
+                    f"take index out of range for {n} elements: "
+                    f"[{int(idx_np.min())}, {int(idx_np.max())}]")
+    return op_call("take", _take, x, index, mode=mode)

@@ -197,3 +197,35 @@ def index_fill(x, index, axis, value, name=None):
     v = value._data if isinstance(value, Tensor) else value
     return op_call("index_fill", _index_fill, x, index, axis=int(axis),
                    value=v)
+
+
+@op_body("nanargmax")
+def _nanargmax(a, *, axis, keepdim):
+    out = jnp.nanargmax(a.reshape(-1) if axis is None else a,
+                        axis=axis if axis is not None else None)
+    if axis is not None and keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int32)
+
+
+def nanargmax(x, axis=None, keepdim=False, name=None):
+    """argmax ignoring NaNs (torch-parity companion of argmax; no
+    reference analog — provided for the method-surface scan)."""
+    return op_call("nanargmax", _nanargmax, x, axis=_ax(axis),
+                   keepdim=keepdim)
+
+
+@op_body("nanargmin")
+def _nanargmin(a, *, axis, keepdim):
+    out = jnp.nanargmin(a.reshape(-1) if axis is None else a,
+                        axis=axis if axis is not None else None)
+    if axis is not None and keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.int32)
+
+
+def nanargmin(x, axis=None, keepdim=False, name=None):
+    """argmin ignoring NaNs (torch-parity companion of argmin; no
+    reference analog — provided for the method-surface scan)."""
+    return op_call("nanargmin", _nanargmin, x, axis=_ax(axis),
+                   keepdim=keepdim)

@@ -82,3 +82,44 @@ def test_vander_cartesian_combinations_binedges():
     edges = T.histogram_bin_edges(
         paddle.to_tensor(np.asarray([0., 4.], np.float32)), bins=4)
     np.testing.assert_allclose(edges.numpy(), [0, 1, 2, 3, 4])
+
+
+def test_diff_trapezoid_take_nanarg():
+    """Round-4 tensor-method tail (reference tensor/math.py diff /
+    trapezoid / cumulative_trapezoid / take:7039; search.py nanargmax/
+    nanargmin) vs numpy/scipy oracles."""
+    import scipy.integrate as si
+    x = np.asarray([1., 3., 6., 10.], np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(t.diff().numpy(), np.diff(x))
+    np.testing.assert_allclose(
+        T.diff(t, prepend=paddle.to_tensor(np.asarray([0.], np.float32)))
+        .numpy(), np.diff(x, prepend=[0.]))
+    np.testing.assert_allclose(float(T.trapezoid(t).numpy()),
+                               np.trapezoid(x))
+    xs = np.asarray([0., 1., 3., 6.], np.float32)
+    np.testing.assert_allclose(
+        float(T.trapezoid(t, x=paddle.to_tensor(xs)).numpy()),
+        np.trapezoid(x, x=xs), rtol=1e-6)
+    np.testing.assert_allclose(T.cumulative_trapezoid(t).numpy(),
+                               si.cumulative_trapezoid(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        T.cumulative_trapezoid(t, x=paddle.to_tensor(xs)).numpy(),
+        si.cumulative_trapezoid(x, x=xs), rtol=1e-6)
+
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        T.take(m, paddle.to_tensor(np.asarray([0, 5, -1], np.int64)))
+        .numpy(), [0, 5, 5])
+    np.testing.assert_allclose(
+        T.take(m, paddle.to_tensor(np.asarray([7], np.int64)),
+               mode="wrap").numpy(), [1])
+    np.testing.assert_allclose(
+        T.take(m, paddle.to_tensor(np.asarray([9], np.int64)),
+               mode="clip").numpy(), [5])
+    with pytest.raises(IndexError):
+        T.take(m, paddle.to_tensor(np.asarray([7], np.int64)))
+
+    n = paddle.to_tensor(np.asarray([np.nan, 2., 1.], np.float32))
+    assert int(T.nanargmax(n).numpy()) == 1
+    assert int(T.nanargmin(n).numpy()) == 2
