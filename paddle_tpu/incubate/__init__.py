@@ -3,3 +3,4 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402

@@ -1,0 +1,149 @@
+"""incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py LookAhead, modelaverage.py ModelAverage).
+
+Both WRAP an inner optimizer: LookAhead keeps slow copies of every
+parameter and interpolates toward the fast weights every k steps;
+ModelAverage keeps running sums so evaluation can use averaged weights
+(apply()/restore() context).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class LookAhead:
+    """(reference: lookahead.py:30): slow = slow + alpha*(fast - slow)
+    every k inner steps; fast weights reset to slow after each sync."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {id(p): jnp.array(p._data)
+                      for p in inner_optimizer._parameter_list}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        import numpy as np
+        return {"inner": self.inner_optimizer.state_dict()
+                if hasattr(self.inner_optimizer, "state_dict") else {},
+                "step_count": self._step_count,
+                "slow": {i: np.asarray(self._slow[id(p)])
+                         for i, p in enumerate(
+                             self.inner_optimizer._parameter_list)}}
+
+    def set_state_dict(self, state):
+        if hasattr(self.inner_optimizer, "set_state_dict") \
+                and state.get("inner"):
+            self.inner_optimizer.set_state_dict(state["inner"])
+        self._step_count = int(state.get("step_count", 0))
+        slow = state.get("slow", {})
+        for i, p in enumerate(self.inner_optimizer._parameter_list):
+            if i in slow or str(i) in slow:
+                v = slow.get(i, slow.get(str(i)))
+                self._slow[id(p)] = jnp.asarray(v)
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """(reference: modelaverage.py:36): maintains running parameter sums;
+    ``apply()`` swaps averaged weights in for evaluation, ``restore()``
+    swaps the live weights back. The average window grows until
+    max_average_window, then restarts (the reference's window scheme
+    collapsed to the accumulating form that matters for eval quality)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage requires parameters")
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._params = list(parameters)
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def _window_limit(self):
+        """Reference window law (modelaverage.py): the window may grow to
+        rate * num_updates, at least min_average_window, capped at
+        max_average_window."""
+        return min(max(self.min_average_window,
+                       int(self.average_window_rate * self._num_updates)),
+                   self.max_average_window)
+
+    def step(self):
+        """Accumulate the CURRENT weights into the running average (call
+        after the inner optimizer's step)."""
+        self._num_updates += 1
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+        if self._count > self._window_limit():
+            # restart the window (reference resets via num_accumulates)
+            for p in self._params:
+                self._sum[id(p)] = jnp.array(p._data)
+            self._count = 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        if self._count == 0:
+            return self
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._sum[id(p)] / self._count
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False
+
+
+# reference exports LBFGS from paddle.incubate.optimizer too
+from ..optimizer.lbfgs import LBFGS  # noqa: F401,E402
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS"]

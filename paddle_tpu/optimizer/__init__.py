@@ -3,5 +3,6 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp,
     Lamb, NAdam, RAdam, ASGD, Rprop,
 )
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,203 @@
+"""L-BFGS optimizer (reference: python/paddle/incubate/optimizer/lbfgs.py,
+exported as paddle.optimizer.LBFGS; line search
+line_search_dygraph.py _strong_wolfe).
+
+Closure-based like the reference: ``step(closure)`` re-evaluates the loss
+as the line search probes points. Host-side control flow drives the
+search (the reference does the same in Python); each closure call is one
+compiled forward+backward, so TPU time stays in the model while the
+two-loop recursion runs on a few flat vectors.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+
+def _flat(tensors):
+    return jnp.concatenate([t._data.reshape(-1) for t in tensors])
+
+
+def _assign(params, vec):
+    off = 0
+    for p in params:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._data = vec[off:off + n].reshape(p._data.shape)
+        off += n
+
+
+class LBFGS(Optimizer):
+    """(reference: lbfgs.py LBFGS). step(closure) minimizes the closure's
+    scalar loss; history_size pairs feed the two-loop recursion;
+    line_search_fn='strong_wolfe' enables the Wolfe line search."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate=learning_rate, parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip)
+        if grad_clip is not None:
+            raise NotImplementedError(
+                "LBFGS does not support grad_clip (the search direction "
+                "is built from raw curvature pairs); clip inside the "
+                "closure if needed")
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or "
+                             "'strong_wolfe'")
+        self._weight_decay = float(weight_decay) if weight_decay else 0.0
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._evals = 0
+
+    # -- closure plumbing ------------------------------------------------
+    def _eval(self, closure, x):
+        """Loss and flat gradient at parameter vector ``x``. Every call
+        counts against max_eval (including line-search probes — the
+        reference counts ls_func_evals the same way)."""
+        params = self._parameter_list
+        _assign(params, x)
+        for p in params:
+            p.grad = None
+        loss = closure()
+        self._evals += 1
+        g = jnp.concatenate([
+            (p.grad._data.reshape(-1) if p.grad is not None
+             else jnp.zeros(int(np.prod(p.shape)) or 1, p._data.dtype))
+            for p in params])
+        if self._weight_decay:
+            g = g + self._weight_decay * x
+        return float(loss.numpy()), g
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = flat_grad
+        alphas = []
+        for s, y in reversed(list(zip(self._s_hist, self._y_hist))):
+            rho = 1.0 / float(jnp.dot(y, s))
+            a = rho * float(jnp.dot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y_hist:
+            y = self._y_hist[-1]
+            s = self._s_hist[-1]
+            gamma = float(jnp.dot(s, y)) / float(jnp.dot(y, y))
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.dot(y, q))
+            q = q + (a - b) * s
+        return -q
+
+    def _strong_wolfe(self, closure, x, d, f0, g0, t0, c1=1e-4, c2=0.9,
+                      max_ls=25):
+        """Strong-Wolfe line search (reference _strong_wolfe,
+        line_search_dygraph.py): bracket then zoom by bisection."""
+        dg0 = float(jnp.dot(g0, d))
+        t_prev, f_prev = 0.0, f0
+        t = t0
+        lo = hi = None
+        f_lo = None
+        for _ in range(max_ls):
+            if self._evals >= self.max_eval:
+                f_t, g_t = self._eval(closure, x + t * d)
+                return t, f_t, g_t
+            f_t, g_t = self._eval(closure, x + t * d)
+            dg_t = float(jnp.dot(g_t, d))
+            if f_t > f0 + c1 * t * dg0 or (f_prev < f_t and t_prev > 0):
+                lo, hi, f_lo = t_prev, t, f_prev
+                break
+            if abs(dg_t) <= -c2 * dg0:
+                return t, f_t, g_t
+            if dg_t >= 0:
+                lo, hi, f_lo = t, t_prev, f_t
+                break
+            t_prev, f_prev = t, f_t
+            t = 2.0 * t
+        else:
+            return t, f_t, g_t
+        # zoom
+        for _ in range(max_ls):
+            if self._evals >= self.max_eval:
+                break
+            t = 0.5 * (lo + hi)
+            f_t, g_t = self._eval(closure, x + t * d)
+            dg_t = float(jnp.dot(g_t, d))
+            if f_t > f0 + c1 * t * dg0 or f_t >= f_lo:
+                hi = t
+            else:
+                if abs(dg_t) <= -c2 * dg0:
+                    return t, f_t, g_t
+                if dg_t * (hi - lo) >= 0:
+                    hi = lo
+                lo, f_lo = t, f_t
+            if abs(hi - lo) < 1e-9:
+                break
+        return t, f_t, g_t
+
+    # -- public API ------------------------------------------------------
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+        params = self._parameter_list
+        x = _flat(params)
+        self._evals = 0
+        loss, flat_grad = self._eval(closure, x)
+        lr = float(self.get_lr())
+
+        for it in range(self.max_iter):
+            if float(jnp.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            d = self._direction(flat_grad)
+            # first iteration: scale like the reference (min(1, 1/|g|1)*lr)
+            t = (min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
+                 if it == 0 and not self._s_hist else lr)
+            if self.line_search_fn == "strong_wolfe":
+                t, new_loss, new_grad = self._strong_wolfe(
+                    closure, x, d, loss, flat_grad, t)
+            else:
+                new_loss, new_grad = self._eval(closure, x + t * d)
+            s = t * d
+            y = new_grad - flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            x = x + s
+            if (abs(new_loss - loss) < self.tolerance_change
+                    or self._evals >= self.max_eval):
+                loss, flat_grad = new_loss, new_grad
+                break
+            loss, flat_grad = new_loss, new_grad
+
+        _assign(params, x)
+        return Tensor(jnp.asarray(loss, jnp.float32))
+
+    def clear_grad(self):
+        for p in self._parameter_list:
+            p.grad = None
+
+    def state_dict(self):
+        """Curvature history included so resume keeps the quasi-Newton
+        model (the inherited dict would silently drop it)."""
+        return {"s_hist": [np.asarray(s) for s in self._s_hist],
+                "y_hist": [np.asarray(y) for y in self._y_hist]}
+
+    def set_state_dict(self, state):
+        self._s_hist = [jnp.asarray(s) for s in state.get("s_hist", [])]
+        self._y_hist = [jnp.asarray(y) for y in state.get("y_hist", [])]
+
+
+__all__ = ["LBFGS"]

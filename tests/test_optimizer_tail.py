@@ -1,0 +1,129 @@
+"""LBFGS (reference incubate/optimizer/lbfgs.py, exported
+paddle.optimizer.LBFGS) + incubate LookAhead/ModelAverage wrappers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_lbfgs_rosenbrock():
+    """LBFGS with strong-Wolfe line search minimizes Rosenbrock from a
+    standard start — the classic L-BFGS acceptance test."""
+    xy = paddle.to_tensor(np.asarray([-1.2, 1.0], np.float32))
+    xy.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(learning_rate=1.0, max_iter=60,
+                                 history_size=10,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[xy])
+
+    def closure():
+        x, y = xy[0], xy[1]
+        loss = (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        loss = opt.step(closure)
+    final = np.asarray(xy.numpy())
+    np.testing.assert_allclose(final, [1.0, 1.0], atol=1e-2)
+    assert float(loss.numpy()) < 1e-4
+
+
+def test_lbfgs_least_squares():
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((20, 5)).astype(np.float32)
+    b = rng.standard_normal((20,)).astype(np.float32)
+    w = paddle.to_tensor(np.zeros(5, np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(max_iter=30,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[w])
+
+    def closure():
+        r = paddle.to_tensor(A) @ w - paddle.to_tensor(b)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    w_star = np.linalg.lstsq(A, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(w.numpy()), w_star, atol=1e-3)
+
+
+def test_lookahead_sync_and_training():
+    from paddle_tpu.incubate import LookAhead
+    paddle.seed(0)
+    rng = np.random.default_rng(1)
+    X = paddle.to_tensor(rng.standard_normal((32, 4)).astype(np.float32))
+    Y = paddle.to_tensor(rng.standard_normal((32, 1)).astype(np.float32))
+    m = paddle.nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(parameters=m.parameters(),
+                                 learning_rate=0.05)
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    losses = []
+    for _ in range(12):
+        loss = ((m(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert opt._step_count == 12
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate import ModelAverage
+    p = paddle.to_tensor(np.zeros(2, np.float32))
+    # min window 10 > 3 accumulations: no restart, plain mean
+    ma = ModelAverage(0.15, parameters=[p], min_average_window=10)
+    for v in (1.0, 2.0, 3.0):
+        p._data = p._data * 0 + v
+        ma.step()
+    live = np.asarray(p.numpy()).copy()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), 2.0)   # mean of 1,2,3
+    np.testing.assert_allclose(p.numpy(), live)       # restored
+
+
+def test_lbfgs_state_roundtrip_and_budget():
+    """Curvature history survives state_dict round-trips; max_eval caps
+    closure calls even through the line search."""
+    w = paddle.to_tensor(np.asarray([3.0, -2.0], np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.LBFGS(max_iter=5, max_eval=7,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[w])
+    calls = {"n": 0}
+
+    def closure():
+        calls["n"] += 1
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    assert calls["n"] <= 7 + 1, calls     # budget enforced (+1 slack)
+    state = opt.state_dict()
+    assert len(state["s_hist"]) > 0
+    opt2 = paddle.optimizer.LBFGS(parameters=[w])
+    opt2.set_state_dict(state)
+    assert len(opt2._s_hist) == len(state["s_hist"])
+    # incubate export parity with the reference
+    from paddle_tpu.incubate.optimizer import LBFGS as IncLBFGS
+    assert IncLBFGS is paddle.optimizer.LBFGS
+
+
+def test_model_average_min_window_law():
+    from paddle_tpu.incubate import ModelAverage
+    p = paddle.to_tensor(np.zeros(1, np.float32))
+    # rate tiny + min window 2: window restarts after 2 accumulations
+    ma = ModelAverage(1e-9, parameters=[p], min_average_window=2,
+                      max_average_window=100)
+    for v in (1.0, 2.0, 3.0):
+        p._data = p._data * 0 + v
+        ma.step()
+    # window restarted at v=3 (count exceeded min window of 2)
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), 3.0)
