@@ -10,3 +10,18 @@ On TPU the natural compute dtype is bfloat16 — no loss scaling needed — but
 from .auto_cast import auto_cast, amp_guard, decorate, amp_state, WHITE_LIST, BLACK_LIST  # noqa: F401
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 from . import debugging  # noqa: F401
+
+
+def is_bfloat16_supported(device=None):
+    """Whether the current backend runs bf16 natively (reference:
+    python/paddle/amp/__init__.py is_bfloat16_supported). TPUs are
+    bf16-native; the XLA-CPU stand-in executes bf16 too (emulated)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """Whether fp16 compute is supported (reference:
+    amp/__init__.py is_float16_supported). TPU MXUs are bf16-first; XLA
+    executes fp16 on TPU/CPU, so the capability is present — bf16 remains
+    the recommended half precision on this stack."""
+    return True

@@ -340,3 +340,11 @@ def test_prof_export_window(flag_restorer):
     flag_restorer("multiple_of_cupti_buffer_size", 1)
     assert len(native.prof_export()) == 10
     native.prof_clear()
+
+
+def test_amp_capability_probes():
+    """paddle.amp.is_bfloat16_supported / is_float16_supported (reference
+    amp/__init__.py): bf16 is native on this stack."""
+    import paddle_tpu as paddle
+    assert paddle.amp.is_bfloat16_supported() is True
+    assert paddle.amp.is_float16_supported() is True
