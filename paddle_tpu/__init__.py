@@ -68,7 +68,31 @@ from .hapi.flops import flops  # noqa: F401,E402
 from . import kernels as _kernels  # noqa: E402
 _kernels.install()
 
-__version__ = "0.1.0"
+from . import version  # noqa: E402,F401
+__version__ = version.full_version
+from . import utils  # noqa: E402,F401
+
+
+def is_compiled_with_cuda():
+    """Reference API: always False — this is the TPU-native stack."""
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+from .core.dtype import (  # noqa: E402,F401
+    set_default_dtype, get_default_dtype,
+)
 
 
 def disable_static(place=None):

@@ -105,3 +105,30 @@ __all__ = [
     "bool_", "uint8", "int8", "int16", "int32", "int64",
     "float16", "bfloat16", "float32", "float64", "complex64", "complex128",
 ]
+
+
+# ---- global default float dtype (reference: paddle.set_default_dtype,
+# framework.py) ----
+_default_float = "float32"
+
+
+def set_default_dtype(d):
+    """Set the float dtype used when creating float tensors without an
+    explicit dtype. Accepts names or DType objects; float64 maps to
+    float32 on this x64-disabled stack (the same 64->32 mapping used
+    throughout) and get_default_dtype then reports 'float32'. Accepts
+    strings, DType, numpy/jax dtype objects (normalized via
+    to_paddle_dtype, like the rest of the dtype surface)."""
+    global _default_float
+    if isinstance(d, str):
+        d = d.removeprefix("paddle.").removeprefix("paddle_tpu.")
+    name = to_paddle_dtype(d).name
+    if name == "float64":
+        name = "float32"
+    if name not in ("float16", "bfloat16", "float32"):
+        raise ValueError(f"unsupported default dtype {d!r}")
+    _default_float = name
+
+
+def get_default_dtype() -> str:
+    return _default_float

@@ -38,7 +38,9 @@ class Tensor:
         if not isinstance(data, (jax.Array, jax.core.Tracer)):
             np_data = np.asarray(data)
             if np_data.dtype == np.float64 and dtype is None:
-                np_data = np_data.astype(np.float32)  # paddle default fp32
+                from .dtype import get_default_dtype
+                np_data = np_data.astype(
+                    to_jax_dtype(get_default_dtype()))  # paddle default
             data = jnp.asarray(np_data, dtype=to_jax_dtype(dtype) if dtype else None)
             if place is not None:
                 data = jax.device_put(data, _as_place(place).jax_device())

@@ -10,11 +10,15 @@ from ..core.dtype import to_jax_dtype
 from ..core.tensor import Tensor, to_tensor
 from ..core.dispatch import primitive, op_body, op_call
 
-_DEFAULT_FLOAT = "float32"
+def _default_float():
+    from ..core.dtype import get_default_dtype
+    return get_default_dtype()
 
 
-def _dt(dtype, default=_DEFAULT_FLOAT):
-    return to_jax_dtype(dtype if dtype is not None else default)
+def _dt(dtype, default=None):
+    if dtype is not None:
+        return to_jax_dtype(dtype)
+    return to_jax_dtype(default if default is not None else _default_float())
 
 
 def _shape(shape):
@@ -37,7 +41,7 @@ def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
     if dtype is None:
-        dtype = jnp.result_type(fill_value) if not isinstance(fill_value, float) else _DEFAULT_FLOAT
+        dtype = jnp.result_type(fill_value) if not isinstance(fill_value, float) else _default_float()
     return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
 
 
@@ -96,7 +100,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     if end is None:
         start, end = 0, start
     if dtype is None:
-        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else _DEFAULT_FLOAT
+        dtype = "int64" if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else _default_float()
     return Tensor(jnp.arange(start, end, step, _dt(dtype)))
 
 

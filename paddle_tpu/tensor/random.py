@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import random as _rng
-from ..core.dtype import to_jax_dtype
+from ..core.dtype import get_default_dtype, to_jax_dtype
 from ..core.tensor import Tensor
 from .creation import _shape
 
@@ -21,11 +21,11 @@ def _key():
 
 
 def rand(shape, dtype=None, name=None):
-    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype())))
 
 
 def randn(shape, dtype=None, name=None):
-    return Tensor(jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+    return Tensor(jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype())))
 
 
 def standard_normal(shape, dtype=None, name=None):
@@ -38,15 +38,16 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
         s = std._data if isinstance(std, Tensor) else std
         sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
         return Tensor(m + s * jax.random.normal(_key(), sh))
-    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape or [1]), jnp.float32))
+    return Tensor(mean + std * jax.random.normal(
+        _key(), _shape(shape or [1]), to_jax_dtype(get_default_dtype())))
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
-    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or "float32")))
+    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype())))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or "float32"),
+    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype()),
                                      minval=min, maxval=max))
 
 

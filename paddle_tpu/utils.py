@@ -1,0 +1,109 @@
+"""paddle.utils (real submodule; reference: python/paddle/utils/): the pieces a switching
+user touches — unique_name, deprecated, try_import. The C++ container
+utils (C2) are n/a by design (SURVEY §2)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import threading
+import warnings
+
+
+class _UniqueNameGenerator:
+    """reference: python/paddle/utils/unique_name.py generate/guard."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._prefix = ""
+
+    def generate(self, key="tmp"):
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+            prefix = self._prefix     # read under the same lock as switch
+        return f"{prefix}{key}_{n}"
+
+    def switch(self, prefix=""):
+        with self._lock:
+            old = self._prefix
+            self._prefix = prefix
+        return old
+
+
+_generator = _UniqueNameGenerator()
+_generator_lock = threading.Lock()
+
+
+def _switch_generator(new):
+    """Swap the active generator (reference unique_name.py switch():
+    the guard installs a whole fresh generator, counters included)."""
+    global _generator
+    with _generator_lock:
+        old = _generator
+        _generator = new
+    return old
+
+
+class unique_name:
+    """Namespace mirroring paddle.utils.unique_name."""
+
+    @staticmethod
+    def generate(key="tmp"):
+        return _generator.generate(key)
+
+    class guard:
+        """Scoped fresh-counter namespace for generated names: inside the
+        guard, counters restart at 0 under the new prefix (matching the
+        reference, where checkpoints depend on 'scope/w_0' not
+        'scope/w_1')."""
+
+        def __init__(self, new_prefix=""):
+            self._new = new_prefix
+
+        def __enter__(self):
+            fresh = _UniqueNameGenerator()
+            fresh._prefix = self._new
+            self._old = _switch_generator(fresh)
+            return self
+
+        def __exit__(self, *exc):
+            _switch_generator(self._old)
+            return False
+
+
+def try_import(module_name, err_msg=None):
+    """reference: utils/lazy_import.py try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}; install it to "
+                       "use this feature.")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: utils/deprecated.py — decorator emitting a
+    DeprecationWarning on first call."""
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not warned:
+                warned.append(True)
+                msg = f"API '{fn.__name__}' is deprecated since {since}"
+                if update_to:
+                    msg += f", use '{update_to}' instead"
+                if reason:
+                    msg += f": {reason}"
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+__all__ = ["unique_name", "try_import", "deprecated"]

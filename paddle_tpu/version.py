@@ -1,0 +1,28 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py):
+version components + capability probes."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+cuda_version = "False"      # no CUDA in this stack
+cudnn_version = "False"
+istaged = True
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+    print("cuda: False (TPU-native stack)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

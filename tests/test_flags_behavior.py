@@ -348,3 +348,43 @@ def test_amp_capability_probes():
     import paddle_tpu as paddle
     assert paddle.amp.is_bfloat16_supported() is True
     assert paddle.amp.is_float16_supported() is True
+
+
+def test_infra_surface():
+    """paddle.version / paddle.utils.unique_name / capability probes /
+    default-dtype (reference: version/__init__.py, utils/unique_name.py,
+    framework set_default_dtype)."""
+    import warnings
+    import paddle_tpu as paddle
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.is_compiled_with_cuda() is False
+    assert paddle.is_compiled_with_distribute() is True
+    a = paddle.utils.unique_name.generate("w")
+    b = paddle.utils.unique_name.generate("w")
+    assert a != b and a.startswith("w_")
+    with paddle.utils.unique_name.guard("scope/"):
+        assert paddle.utils.unique_name.generate("w").startswith("scope/")
+    old_d = paddle.get_default_dtype()
+    try:
+        paddle.set_default_dtype("bfloat16")
+        assert paddle.get_default_dtype() == "bfloat16"
+        # the setting takes EFFECT: float creation uses it
+        assert str(paddle.to_tensor([1.0]).dtype).endswith("bfloat16")
+        assert str(paddle.zeros([2]).dtype).endswith("bfloat16")
+        # DType objects accepted; float64 maps to float32 (x64 disabled)
+        paddle.set_default_dtype(paddle.float32)
+        paddle.set_default_dtype("float64")
+        assert paddle.get_default_dtype() == "float32"
+    finally:
+        paddle.set_default_dtype(old_d)
+    with pytest.raises(ValueError):
+        paddle.set_default_dtype("int8")
+
+    @paddle.utils.deprecated(update_to="paddle.x", since="2.0")
+    def legacy():
+        return 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert legacy() == 1
+    assert any(issubclass(x.category, DeprecationWarning) for x in w)
