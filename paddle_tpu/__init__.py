@@ -15,6 +15,7 @@ from .core.place import (  # noqa: F401
     CPUPlace, TPUPlace, Place, set_device, get_device, is_compiled_with_tpu,
 )
 from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .core import place as _place_mod  # noqa: F401
 from .core.autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
@@ -93,6 +94,38 @@ def is_compiled_with_distribute():
 from .core.dtype import (  # noqa: E402,F401
     set_default_dtype, get_default_dtype,
 )
+from .core.dtype import (  # noqa: E402,F401
+    float8_e4m3fn, float8_e5m2, pstring, raw, iinfo, finfo,
+    DType as dtype,
+)
+from .framework.infra import (  # noqa: E402,F401
+    is_tensor, is_complex, is_integer, is_floating_point, is_empty,
+    rank, shape, tolist, create_parameter, batch, check_shape,
+    to_dlpack, from_dlpack, get_cuda_rng_state, set_cuda_rng_state,
+    disable_signal_handler, set_printoptions,
+)
+from .nn.layer.layers import ParamAttr, LazyGuard  # noqa: E402,F401
+from .nn.functional.distance import pdist  # noqa: E402,F401
+
+# numpy-style constants (reference exports these from paddle directly)
+import math as _math  # noqa: E402
+inf = _math.inf
+nan = _math.nan
+pi = _math.pi
+e = _math.e
+newaxis = None
+
+
+class CUDAPlace(_place_mod.TPUPlace):
+    """Accelerator place under the reference's CUDA name: code written for
+    the reference (``paddle.CUDAPlace(0)``) lands on the TPU device here
+    (reference: paddle/phi/common/place.h GPUPlace)."""
+
+
+class CUDAPinnedPlace(_place_mod.CPUPlace):
+    """Host staging place (reference CUDAPinnedPlace); host memory on this
+    stack is ordinary CPU memory — PJRT manages transfer pinning."""
+
 
 
 def disable_static(place=None):

@@ -23,7 +23,8 @@ class DType:
 
     def __init__(self, name: str, np_dtype):
         self.name = name
-        self.np_dtype = np.dtype(np_dtype) if name != "bfloat16" else jnp.bfloat16
+        self.np_dtype = np.dtype(np_dtype) \
+            if not name.startswith(("bfloat16", "float8")) else np_dtype
         kind = jnp.dtype(self.np_dtype)
         self.is_floating = jnp.issubdtype(kind, jnp.floating)
         self.is_complex = jnp.issubdtype(kind, jnp.complexfloating)
@@ -58,6 +59,58 @@ float32 = DType("float32", np.float32)
 float64 = DType("float64", np.float64)
 complex64 = DType("complex64", np.complex64)
 complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+
+
+class _VarTypeSentinel:
+    """Non-numeric framework var types (reference: framework/dtype.py:131
+    pstring=DataType.PSTRING, raw=DataType.ALL_DTYPE). No array storage —
+    they exist so type-dispatch code ported from the reference imports."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+
+pstring = _VarTypeSentinel("pstring")
+raw = _VarTypeSentinel("raw")
+
+
+class iinfo:
+    """Integer dtype limits (reference: paddle.iinfo over np.iinfo)."""
+
+    def __init__(self, d):
+        i = np.iinfo(to_paddle_dtype(d).np_dtype)
+        self.min, self.max, self.bits = int(i.min), int(i.max), int(i.bits)
+        self.dtype = to_paddle_dtype(d).name
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class finfo:
+    """Float dtype limits (reference: paddle.finfo; ml_dtypes backs
+    bfloat16/float8 the same way jnp does)."""
+
+    def __init__(self, d):
+        dt = to_paddle_dtype(d)
+        f = jnp.finfo(dt.np_dtype)
+        self.min, self.max = float(f.min), float(f.max)
+        self.eps, self.tiny = float(f.eps), float(f.tiny)
+        self.smallest_normal = float(f.tiny)
+        self.resolution = float(f.resolution)
+        self.bits = int(f.bits)
+        self.dtype = dt.name
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")
 
 _ALIASES = {"float": "float32", "double": "float64", "half": "float16", "int": "int32", "long": "int64"}
 

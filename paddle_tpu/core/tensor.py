@@ -274,7 +274,18 @@ class Tensor:
     def __repr__(self):
         grad_info = f", stop_gradient={self.stop_gradient}"
         try:
-            vals = np.array2string(np.asarray(self.numpy()), precision=6, separator=", ")
+            from ..framework.infra import PRINT_OPTIONS as _po
+            kw = dict(precision=_po["precision"],
+                      threshold=_po["threshold"],
+                      edgeitems=_po["edgeitems"],
+                      max_line_width=_po["linewidth"], separator=", ")
+            if _po["sci_mode"] is True:
+                prec = _po["precision"]
+                kw["formatter"] = {"float_kind":
+                    lambda v: np.format_float_scientific(v, precision=prec)}
+            elif _po["sci_mode"] is False:
+                kw["suppress_small"] = True
+            vals = np.array2string(np.asarray(self.numpy()), **kw)
         except Exception:
             vals = "<traced>"
         return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "

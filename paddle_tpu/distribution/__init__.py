@@ -10,7 +10,9 @@ from .kl import kl_divergence, register_kl  # noqa: F401
 from .transform import (  # noqa: F401
     Transform, AffineTransform, ExpTransform, PowerTransform,
     SigmoidTransform, TanhTransform, SoftmaxTransform, AbsTransform,
-    ChainTransform, TransformedDistribution, Independent)
+    ChainTransform, IndependentTransform, ReshapeTransform,
+    StackTransform, StickBreakingTransform, TransformedDistribution,
+    Independent)
 from .multivariate import (  # noqa: F401
     MultivariateNormal, ContinuousBernoulli, LKJCholesky,
     ExponentialFamily)

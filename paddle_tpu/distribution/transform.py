@@ -145,6 +145,132 @@ class ChainTransform(Transform):
         return total
 
 
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of
+    the base transform as event dims: values pass through unchanged, the
+    log-det sums over those dims (reference: transform.py:707)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not isinstance(base, Transform):
+            raise TypeError("base must be a Transform")
+        if int(reinterpreted_batch_rank) < 1:
+            raise ValueError("reinterpreted_batch_rank must be >= 1")
+        self.base = base
+        self.n = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        return self.base._fldj(x).sum(tuple(range(-self.n, 0)))
+
+
+class ReshapeTransform(Transform):
+    """Reshape the trailing event dims from ``in_event_shape`` to
+    ``out_event_shape``; volume-preserving so log-det is zero over the
+    batch shape (reference: transform.py:869)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        import numpy as _np
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        if int(_np.prod(self.in_event_shape or (1,))) != \
+                int(_np.prod(self.out_event_shape or (1,))):
+            raise ValueError(
+                f"in_event_shape {self.in_event_shape} and out_event_shape "
+                f"{self.out_event_shape} have different sizes")
+
+    def _batch(self, x, event):
+        n = len(event)
+        if tuple(x.shape[x.ndim - n:]) != event:
+            raise ValueError(
+                f"trailing dims of input shape {tuple(x.shape)} do not "
+                f"match event shape {event}")
+        return x.shape[:x.ndim - n]
+
+    def _forward(self, x):
+        return x.reshape(self._batch(x, self.in_event_shape)
+                         + self.out_event_shape)
+
+    def _inverse(self, y):
+        return y.reshape(self._batch(y, self.out_event_shape)
+                         + self.in_event_shape)
+
+    def _fldj(self, x):
+        return jnp.zeros(self._batch(x, self.in_event_shape), x.dtype)
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to the i-th slice along ``axis`` (reference:
+    transform.py:1095)."""
+
+    def __init__(self, transforms, axis=0):
+        transforms = list(transforms)
+        if not transforms or not all(
+                isinstance(t, Transform) for t in transforms):
+            raise TypeError("transforms must be a non-empty Transform list")
+        self.transforms = transforms
+        self.axis = int(axis)
+
+    def _slices(self, x):
+        n = x.shape[self.axis]
+        if n != len(self.transforms):
+            raise ValueError(
+                f"input has {n} slices along axis {self.axis} but "
+                f"{len(self.transforms)} transforms were given")
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(x, n, axis=self.axis)]
+
+    def _map(self, x, method):
+        return jnp.stack(
+            [getattr(t, method)(s)
+             for t, s in zip(self.transforms, self._slices(x))],
+            axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> interior of the (K+1)-simplex via stick-breaking (reference:
+    transform.py:1215): z_k = sigmoid(x_k - log(K - k)), each y takes
+    z_k of the remaining stick."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        rest = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * \
+            jnp.concatenate([pad, rest], -1)
+
+    def _inverse(self, y):
+        yc = y[..., :-1]
+        k = yc.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        stick = 1 - jnp.cumsum(yc, -1)
+        tiny = jnp.finfo(y.dtype).tiny
+        return jnp.log(yc) - jnp.log(jnp.maximum(stick, tiny)) + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        xs = x - offset
+        y = self._forward(x)
+        return (-xs + jax.nn.log_sigmoid(xs)
+                + jnp.log(y[..., :-1])).sum(-1)
+
+
 class TransformedDistribution(Distribution):
     """(reference: transformed_distribution.py) base pushforward through a
     Transform (or list chained in order)."""
@@ -214,5 +340,6 @@ class Independent(Distribution):
 
 __all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
            "SigmoidTransform", "TanhTransform", "SoftmaxTransform",
-           "AbsTransform", "ChainTransform", "TransformedDistribution",
-           "Independent"]
+           "AbsTransform", "ChainTransform", "IndependentTransform",
+           "ReshapeTransform", "StackTransform", "StickBreakingTransform",
+           "TransformedDistribution", "Independent"]

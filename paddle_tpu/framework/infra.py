@@ -1,0 +1,195 @@
+"""Top-level framework helpers (reference: python/paddle/framework/ and
+python/paddle/base/ misc surface: is_tensor & friends framework.py,
+batch.py batch, utils/layers_utils.py:488 check_shape, dlpack
+utils/dlpack.py, tensor/to_string.py set_printoptions).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import random as _random
+from ..core.dtype import to_paddle_dtype
+
+
+# ---- predicates ----
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return bool(to_paddle_dtype(jnp.result_type(x._data)).is_complex)
+
+
+def is_integer(x):
+    return bool(to_paddle_dtype(jnp.result_type(x._data)).is_integer)
+
+
+def is_floating_point(x):
+    return bool(to_paddle_dtype(jnp.result_type(x._data)).is_floating)
+
+
+def is_empty(x, name=None):
+    """0-D bool tensor: does x have zero elements (reference: paddle.is_empty)."""
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0 if x.shape else False))
+
+
+def rank(input, name=None):
+    """0-D int32 tensor holding ndim (reference: paddle.rank)."""
+    return Tensor(jnp.asarray(input.ndim, jnp.int32))
+
+
+def shape(input, name=None):
+    """1-D int32 tensor holding the shape (reference: paddle.shape)."""
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+# ---- parameter creation ----
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone parameter factory (reference: paddle.create_parameter,
+    base/layers/tensor.py). Delegates to Layer.create_parameter so the
+    init-selection law (Xavier for weights / zeros for bias) and LazyGuard
+    deferral live in exactly one place."""
+    from ..nn.layer.layers import Layer
+    p = Layer().create_parameter(shape, attr=attr, dtype=dtype,
+                                 is_bias=is_bias,
+                                 default_initializer=default_initializer)
+    if p is not None and name is not None:
+        p.name = name
+    return p
+
+
+# ---- reader helpers ----
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample reader into a batch reader (reference: batch.py:26)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be a positive integer")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference: utils/layers_utils.py:488):
+    list/tuple elements must be non-negative ints; a Tensor shape must be
+    integer-typed."""
+    if isinstance(shape, Tensor):
+        if not to_paddle_dtype(jnp.result_type(shape._data)).is_integer:
+            raise TypeError("shape tensor must be int32/int64")
+        return
+    if isinstance(shape, (list, tuple)):
+        for e in shape:
+            if isinstance(e, Tensor):
+                continue
+            if not isinstance(e, (int, np.integer)):
+                raise TypeError(
+                    "All elements in shape must be integers when it's a "
+                    "list or tuple")
+            if e < 0:
+                raise ValueError(
+                    "All elements in shape must be non-negative when it's "
+                    "a list or tuple")
+
+
+# ---- dlpack ----
+
+class _DLPackExport:
+    """DLPack provider wrapping a jax.Array (modern protocol: consumers
+    call ``__dlpack__``/``__dlpack_device__`` themselves; raw capsules are
+    single-consume and unsupported by jax>=0.4 import)."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, **kwargs):
+        return self._arr.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def to_dlpack(x):
+    """Export for DLPack consumers (reference: utils/dlpack.py to_dlpack).
+    Returns a provider object — ``torch.from_dlpack``, ``np.from_dlpack``,
+    and ``jnp.from_dlpack`` all accept it directly."""
+    data = x._data if isinstance(x, Tensor) else x
+    return _DLPackExport(data)
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack provider (torch/numpy/jax array or to_dlpack
+    result) as a Tensor; zero-copy where the producer allows it."""
+    if isinstance(dlpack, Tensor):
+        return Tensor(dlpack._data)
+    return Tensor(jnp.from_dlpack(dlpack))
+
+
+# ---- RNG state (CUDA-named API mapped to the device RNG) ----
+
+def get_cuda_rng_state():
+    """Device RNG state. CUDA-named for reference compatibility
+    (python/paddle/framework/random.py get_cuda_rng_state); on this stack
+    it is the TPU/global threefry state from core.random."""
+    return _random.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    _random.set_rng_state(state)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ fault handlers it must disable for
+    interop (paddle/fluid/platform/init.cc); this runtime installs none."""
+    return None
+
+
+# ---- print options (consumed by Tensor.__repr__) ----
+
+PRINT_OPTIONS = {
+    "precision": 6, "threshold": 1000, "edgeitems": 3, "linewidth": 75,
+    "sci_mode": None,
+}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """(reference: python/paddle/tensor/to_string.py set_printoptions)."""
+    if precision is not None:
+        PRINT_OPTIONS["precision"] = int(precision)
+    if threshold is not None:
+        PRINT_OPTIONS["threshold"] = int(threshold)
+    if edgeitems is not None:
+        PRINT_OPTIONS["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        PRINT_OPTIONS["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        PRINT_OPTIONS["sci_mode"] = bool(sci_mode)
+
+
+__all__ = [
+    "is_tensor", "is_complex", "is_integer", "is_floating_point",
+    "is_empty", "rank", "shape", "tolist", "create_parameter", "batch",
+    "check_shape", "to_dlpack", "from_dlpack", "get_cuda_rng_state",
+    "set_cuda_rng_state", "disable_signal_handler", "set_printoptions",
+]

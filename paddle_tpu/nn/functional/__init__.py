@@ -25,6 +25,7 @@ from .loss import (  # noqa: F401
     gaussian_nll_loss, poisson_nll_loss, npair_loss,
     adaptive_log_softmax_with_loss,
 )
+from .distance import pdist  # noqa: F401
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
     label_smooth, interpolate, upsample, pixel_shuffle, pixel_unshuffle,

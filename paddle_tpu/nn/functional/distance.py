@@ -1,0 +1,37 @@
+"""Distance functionals (analog of python/paddle/nn/functional/distance.py).
+
+``pairwise_distance`` lives in common.py (historical layout); this module
+holds the condensed-distance ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.dispatch import op_body, op_call
+
+
+@op_body("pdist")
+def _pdist(a, *, p):
+    n = a.shape[0]
+    iu = np.triu_indices(n, k=1)
+    d = a[iu[0]] - a[iu[1]]
+    if p == 2.0:
+        return jnp.sqrt((d * d).sum(-1))
+    if p == float("inf"):
+        return jnp.abs(d).max(-1)
+    if p == 0:
+        return (d != 0).sum(-1).astype(a.dtype)
+    return (jnp.abs(d) ** p).sum(-1) ** (1.0 / p)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise p-norm distances of the rows: output length
+    n*(n-1)/2 in row-major upper-triangle order (reference:
+    python/paddle/nn/functional/distance.py:119)."""
+    if x.ndim != 2:
+        raise ValueError("pdist expects a 2-D tensor")
+    return op_call("pdist", _pdist, x, p=float(p))
+
+
+__all__ = ["pdist"]

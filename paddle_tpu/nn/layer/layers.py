@@ -67,6 +67,36 @@ class ParamAttr:
         raise TypeError(f"cannot interpret ParamAttr from {attr!r}")
 
 
+import weakref
+
+# pending lazily-initialized Parameters, keyed by id with a GC callback
+# (a WeakSet would compare Tensors via elementwise __eq__ on discard)
+_LAZY = {"active": False, "params": {}}
+
+
+def _lazy_track(p):
+    key = id(p)
+    _LAZY["params"][key] = weakref.ref(
+        p, lambda _r, key=key: _LAZY["params"].pop(key, None))
+
+
+class LazyGuard:
+    """Defer parameter initializer execution for layers constructed inside
+    the guard (reference: python/paddle/nn/initializer/lazy_init.py:99
+    LazyGuard). Construction is O(1) per parameter (a zero-byte broadcast
+    view holds shape/dtype); initializers run at the layer's first forward,
+    so giant models can be built cheaply and materialized late."""
+
+    def __enter__(self):
+        self._prev = _LAZY["active"]
+        _LAZY["active"] = True
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY["active"] = self._prev
+        return False
+
+
 class HookRemoveHelper:
     def __init__(self, hooks, idx):
         self._hooks, self._idx = hooks, idx
@@ -158,8 +188,28 @@ class Layer:
         dtype = to_jax_dtype(dtype or self._dtype)
         init = attr.initializer or default_initializer or \
             (I.Constant(0.0) if is_bias else I.XavierUniform())
-        data = init(tuple(int(s) for s in shape), dtype)
-        p = Parameter(data, trainable=attr.trainable, name=attr.name)
+        shape = tuple(int(s) for s in shape)
+        if _LAZY["active"]:
+            # zero-byte placeholder with real shape/dtype; materialized at
+            # first forward (see Layer.__call__)
+            import numpy as np
+            p = Parameter.__new__(Parameter)
+            # zero-byte numpy broadcast view: correct shape/dtype metadata,
+            # no device allocation until materialization
+            p._data = np.broadcast_to(np.zeros((), dtype), shape)
+            p.stop_gradient = not attr.trainable
+            p.grad = None
+            p._grad_node = None
+            p._output_slot = 0
+            p.name = attr.name or "lazy_param"
+            p.persistable = True
+            p.is_distributed = False
+            p._grad_hooks = []
+            p._lazy_init = (init, shape, dtype)
+            _lazy_track(p)
+        else:
+            data = init(shape, dtype)
+            p = Parameter(data, trainable=attr.trainable, name=attr.name)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = attr.need_clip
@@ -248,6 +298,8 @@ class Layer:
 
     # ---- call ----
     def __call__(self, *inputs, **kwargs):
+        if _LAZY["params"]:
+            self._materialize_lazy()
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
@@ -258,6 +310,16 @@ class Layer:
             if o is not None:
                 outputs = o
         return outputs
+
+    def _materialize_lazy(self):
+        """Run deferred initializers for params created under LazyGuard."""
+        for p in self.parameters():
+            lazy = getattr(p, "_lazy_init", None)
+            if lazy is not None:
+                init, shape, dtype = lazy
+                p._data = jnp.asarray(init(shape, dtype))
+                del p._lazy_init
+                _LAZY["params"].pop(id(p), None)
 
     def forward(self, *inputs, **kwargs):
         raise NotImplementedError

@@ -21,6 +21,61 @@ from .einsum import einsum  # noqa: F401
 from . import creation, math, manipulation, linalg, search, stat
 from . import random as random  # noqa: F401
 
+# reference-name aliases (python/paddle/__init__.py exports both spellings)
+less = math.less_than
+bitwise_invert = math.bitwise_not
+
+# ---- generated in-place variants (reference exports ~70 ``op_`` names;
+# each adopts the functional result, same law as math._make_inplace) ----
+_INPLACE_BASES = [
+    "addmm", "baddbmm", "t", "cumsum", "cumprod", "logit", "equal",
+    "cos", "tan", "unsqueeze", "logical_and", "less_than",
+    "less", "squeeze", "floor_divide", "remainder", "floor_mod",
+    "logical_or", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "bitwise_invert", "less_equal", "triu", "sin", "mod",
+    "abs", "tril", "pow", "acos", "expm1", "sinh", "neg", "lgamma",
+    "gammaincc", "gammainc", "square", "gammaln", "atan", "gcd", "lcm",
+    "cast", "greater_equal", "erf", "greater_than", "transpose",
+    "flatten", "logical_not", "log", "log2", "log10", "trunc", "frac",
+    "digamma", "renorm", "nan_to_num", "ldexp", "i0", "polygamma",
+    "copysign", "bitwise_left_shift", "bitwise_right_shift",
+    "masked_fill", "masked_scatter", "hypot", "asin", "atanh", "asinh",
+    "acosh", "cosh", "erfinv", "expand", "reshape", "index_put",
+]
+
+
+def _gen_inplace():
+    import sys
+    mod = sys.modules[__name__]
+    for base in _INPLACE_BASES:
+        iname = base + "_"
+        if hasattr(mod, iname):
+            continue
+        fn = getattr(mod, base, None)
+        if fn is None:
+            continue
+        wrapper = math._make_inplace(fn)
+        setattr(mod, iname, wrapper)
+        if not hasattr(Tensor, iname):
+            setattr(Tensor, iname, wrapper)
+
+
+_gen_inplace()
+
+
+def where_(condition, x, y, name=None):
+    """In-place on ``x`` (the reference's paddle.where_ mutates x, not the
+    condition) — the generic _make_inplace would adopt into arg0."""
+    out = manipulation.where(condition, x, y)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._output_slot = out._output_slot
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+Tensor.where_ = lambda self, condition, y: where_(condition, self, y)
+
 
 def _patch_tensor_methods():
     import sys
@@ -42,6 +97,13 @@ def _patch_tensor_methods():
                 setattr(Tensor, name, fn)
     Tensor.einsum = None  # not a method
     del Tensor.einsum
+
+    # random in-place fillers are methods too (x.uniform_(), x.log_normal_())
+    for name in ("uniform_", "normal_", "exponential_", "cauchy_",
+                 "geometric_", "bernoulli_", "log_normal_"):
+        fn = getattr(random, name, None) or getattr(creation, name, None)
+        if fn is not None and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
 
     # Operator protocol.
     Tensor.__add__ = lambda s, o: math.add(s, _u(o))

@@ -503,3 +503,26 @@ def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
     _check_histogram_range(min, max)
     return op_call("histogram_bin_edges", _histogram_bin_edges, input,
                    bins=int(bins), min=min, max=max)
+
+
+@op_body("matrix_transpose")
+def _matrix_transpose(a):
+    return jnp.swapaxes(a, -2, -1)
+
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (reference: linalg.py:191)."""
+    if x.ndim < 2:
+        raise ValueError("matrix_transpose expects ndim >= 2")
+    return op_call("matrix_transpose", _matrix_transpose, x)
+
+
+@op_body("vecdot")
+def _vecdot(a, b, *, axis):
+    return (a * b).sum(axis)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """Vector dot along ``axis`` with broadcasting (reference:
+    linalg.py:1880)."""
+    return op_call("vecdot", _vecdot, x, y, axis=int(axis))

@@ -637,3 +637,222 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     return op_call("shard_index", _shard_index, input, index_num=index_num,
                    nshards=nshards, shard_id=shard_id,
                    ignore_value=ignore_value)
+
+
+# ---- reference parity tail: split/stack family + scatter views ----
+# (reference: python/paddle/tensor/manipulation.py:2917 tensor_split,
+#  :6997 unflatten, :7073 as_strided, :7230 unfold, :7375 diagonal_scatter,
+#  :7431 select_scatter, :7539 slice_scatter, :7651 block_diag)
+
+@op_body("tensor_split")
+def _tensor_split(a, *, indices, axis):
+    return tuple(jnp.split(a, list(indices), axis=axis))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Uneven split allowed (np.array_split law): first ``size % n`` chunks
+    get one extra element; an int list splits at those indices. Routed
+    through op_call so the pieces stay on the autograd tape."""
+    ax = int(axis)
+    if isinstance(num_or_indices, int):
+        parts = np.array_split(np.arange(x.shape[ax]), num_or_indices)
+        idx = np.cumsum([len(p) for p in parts])[:-1].tolist()
+    else:
+        idx = [int(i) for i in num_or_indices]
+    return list(op_call("tensor_split", _tensor_split, x,
+                        indices=tuple(idx), axis=ax))
+
+
+def hsplit(x, num_or_indices, name=None):
+    if x.ndim < 1:
+        raise ValueError("hsplit expects at least a 1-D tensor")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    if x.ndim < 2:
+        raise ValueError("vsplit expects at least a 2-D tensor")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    if x.ndim < 3:
+        raise ValueError("dsplit expects at least a 3-D tensor")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+@op_body("hstack")
+def _hstack(*xs):
+    return jnp.hstack(xs)
+
+
+def hstack(x, name=None):
+    return op_call("hstack", _hstack, *x)
+
+
+@op_body("vstack")
+def _vstack(*xs):
+    return jnp.vstack(xs)
+
+
+def vstack(x, name=None):
+    return op_call("vstack", _vstack, *x)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+@op_body("dstack")
+def _dstack(*xs):
+    return jnp.dstack(xs)
+
+
+def dstack(x, name=None):
+    return op_call("dstack", _dstack, *x)
+
+
+@op_body("column_stack")
+def _column_stack(*xs):
+    return jnp.column_stack(xs)
+
+
+def column_stack(x, name=None):
+    return op_call("column_stack", _column_stack, *x)
+
+
+@op_body("block_diag")
+def _block_diag(*xs):
+    xs = [jnp.atleast_2d(a) for a in xs]
+    rows = sum(a.shape[0] for a in xs)
+    cols = sum(a.shape[1] for a in xs)
+    out = jnp.zeros((rows, cols), jnp.result_type(*xs))
+    r = c = 0
+    for a in xs:
+        out = jax.lax.dynamic_update_slice(out, a.astype(out.dtype), (r, c))
+        r += a.shape[0]
+        c += a.shape[1]
+    return out
+
+
+def block_diag(inputs, name=None):
+    return op_call("block_diag", _block_diag, *inputs)
+
+
+@op_body("unflatten")
+def _unflatten(a, *, axis, shape):
+    ax = axis % a.ndim
+    shape = list(shape)
+    if shape.count(-1) > 1:
+        raise ValueError("unflatten shape may contain at most one -1")
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = a.shape[ax] // known
+    if int(np.prod(shape)) != a.shape[ax]:
+        raise ValueError(
+            f"unflatten shape {tuple(shape)} does not multiply to dim "
+            f"size {a.shape[ax]}")
+    return a.reshape(a.shape[:ax] + tuple(shape) + a.shape[ax + 1:])
+
+
+def unflatten(x, axis, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy()]
+    return op_call("unflatten", _unflatten, x, axis=int(axis),
+                   shape=tuple(int(s) for s in shape))
+
+
+@op_body("tensor_unfold")
+def _unfold(a, *, axis, size, step):
+    ax = axis % a.ndim
+    n = (a.shape[ax] - size) // step + 1
+    if n <= 0:
+        raise ValueError(
+            f"unfold size {size} exceeds dim {a.shape[ax]} along axis {ax}")
+    starts = jnp.arange(n) * step
+    def window(s):
+        return jax.lax.dynamic_slice_in_dim(a, s, size, axis=ax)
+    out = jax.vmap(window)(starts)          # (n, ..., size at ax, ...)
+    # windows dim replaces axis; window content goes last (reference layout)
+    out = jnp.moveaxis(out, 0, ax)          # (..., n, size, ...)
+    return jnp.moveaxis(out, ax + 1, -1)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (Tensor.unfold; distinct from
+    nn.functional.unfold's im2col, which owns the "unfold" registry key —
+    this one registers as "tensor_unfold")."""
+    return op_call("tensor_unfold", _unfold, x, axis=int(axis),
+                   size=int(size), step=int(step))
+
+
+@op_body("as_strided")
+def _as_strided(a, *, shape, stride, offset):
+    flat = a.reshape(-1)
+    idx = jnp.full(shape, offset, jnp.int32)
+    for d, (n, s) in enumerate(zip(shape, stride)):
+        ix = jnp.arange(n, dtype=jnp.int32) * s
+        idx = idx + ix.reshape((n,) + (1,) * (len(shape) - d - 1))
+    return flat[idx]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Gather-based emulation: XLA arrays have no stride metadata, so the
+    strided view is materialized (reference: manipulation.py:7073 returns a
+    true view; semantics match, aliasing does not — writes through the
+    result do not alias x, consistent with this framework's functional
+    in-place story)."""
+    return op_call("as_strided", _as_strided, x,
+                   shape=tuple(int(s) for s in shape),
+                   stride=tuple(int(s) for s in stride), offset=int(offset))
+
+
+@op_body("select_scatter")
+def _select_scatter(a, v, *, axis, index):
+    import builtins
+    ax = axis % a.ndim
+    sl = (builtins.slice(None),) * ax + (index,)
+    return a.at[sl].set(v.astype(a.dtype))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    return op_call("select_scatter", _select_scatter, x, values,
+                   axis=int(axis), index=int(index))
+
+
+@op_body("slice_scatter")
+def _slice_scatter(a, v, *, axes, starts, ends, strides):
+    import builtins
+    sl = [builtins.slice(None)] * a.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax % a.ndim] = builtins.slice(s, e, st)
+    return a.at[tuple(sl)].set(v.astype(a.dtype))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    return op_call("slice_scatter", _slice_scatter, x, value,
+                   axes=tuple(int(a) for a in axes),
+                   starts=tuple(int(s) for s in starts),
+                   ends=tuple(int(e) for e in ends),
+                   strides=tuple(int(s) for s in strides))
+
+
+@op_body("diagonal_scatter")
+def _diagonal_scatter(a, v, *, offset, axis1, axis2):
+    a1, a2 = axis1 % a.ndim, axis2 % a.ndim
+    i = jnp.arange(v.shape[-1])
+    r, c = (i, i + offset) if offset >= 0 else (i - offset, i)
+    # place values along (axis1, axis2) diagonal for every leading index
+    moved = jnp.moveaxis(a, (a1, a2), (-2, -1))
+    upd = moved.at[..., r, c].set(v.astype(a.dtype))
+    return jnp.moveaxis(upd, (-2, -1), (a1, a2))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    return op_call("diagonal_scatter", _diagonal_scatter, x, y,
+                   offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of ``flip`` (reference keeps paddle.reverse exported)."""
+    return flip(x, axis)

@@ -819,3 +819,81 @@ def take(x, index, mode="raise", name=None):
                     f"take index out of range for {n} elements: "
                     f"[{int(idx_np.min())}, {int(idx_np.max())}]")
     return op_call("take", _take, x, index, mode=mode)
+
+
+# ---- reference parity tail (reference: python/paddle/tensor/math.py:2099
+# add_n, :5756 multigammaln, :5845 positive, :7154 frexp, :8397 signbit,
+# :8601 sinc, :8685 isin) ----
+
+@op_body("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for a in xs[1:]:
+        out = out + a
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return op_call("add_n", _add_n, *inputs)
+
+
+@op_body("sinc")
+def _sinc(a):
+    return jnp.sinc(a)
+
+
+def sinc(x, name=None):
+    return op_call("sinc", _sinc, x)
+
+
+@op_body("signbit")
+def _signbit(a):
+    return jnp.signbit(a)
+
+
+def signbit(x, name=None):
+    return op_call("signbit", _signbit, x)
+
+
+def positive(x, name=None):
+    if not jnp.issubdtype(jnp.result_type(x._data), jnp.number):
+        raise TypeError("positive is undefined for bool tensors")
+    return x
+
+
+@op_body("frexp")
+def _frexp(a):
+    m, e = jnp.frexp(a)
+    return m, e.astype(a.dtype)
+
+
+def frexp(x, name=None):
+    return op_call("frexp", _frexp, x)
+
+
+@op_body("multigammaln")
+def _multigammaln(a, *, p):
+    j = jnp.arange(p, dtype=a.dtype)
+    const = 0.25 * p * (p - 1) * jnp.log(jnp.pi).astype(a.dtype)
+    return const + jax.scipy.special.gammaln(
+        a[..., None] - 0.5 * j).sum(-1)
+
+
+def multigammaln(x, p, name=None):
+    return op_call("multigammaln", _multigammaln, x, p=int(p))
+
+
+@op_body("isin")
+def _isin(a, t, *, invert):
+    out = jnp.isin(a, t)
+    return ~out if invert else out
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return op_call("isin", _isin, x, test_x, invert=bool(invert))
+
+
+sinc_ = _make_inplace(sinc)
+multigammaln_ = _make_inplace(multigammaln)

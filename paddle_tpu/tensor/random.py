@@ -126,3 +126,31 @@ def rand_like(x, dtype=None, name=None):
 def randn_like(x, dtype=None, name=None):
     return Tensor(jax.random.normal(_key(), x._data.shape,
                                     to_jax_dtype(dtype) if dtype else jnp.result_type(x._data)))
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, scale=1) elementwise (reference:
+    python/paddle/tensor/random.py:295)."""
+    return Tensor(jax.random.gamma(_key(), x._data.astype(jnp.float32))
+                  .astype(jnp.result_type(x._data)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """exp(Normal(mean, std)) samples (reference: random.py:346 — mean/std
+    parameterize the UNDERLYING normal)."""
+    m = mean._data if isinstance(mean, Tensor) else mean
+    s = std._data if isinstance(std, Tensor) else std
+    if shape is None:
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+    else:
+        sh = _shape(shape)
+    dt = to_jax_dtype(get_default_dtype())
+    return Tensor(jnp.exp(m + s * jax.random.normal(_key(), sh, dt)))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    m = mean._data if isinstance(mean, Tensor) else mean
+    s = std._data if isinstance(std, Tensor) else std
+    vals = jnp.exp(m + s * jax.random.normal(
+        _key(), x._data.shape, jnp.float32))
+    return x._inplace_update(vals.astype(jnp.result_type(x._data)))

@@ -146,3 +146,72 @@ def test_independent():
     lp = d.log_prob(v)
     assert lp.shape == [3]
     np.testing.assert_allclose(lp.numpy(), 4 * -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+
+def test_independent_transform():
+    t = D.IndependentTransform(D.ExpTransform(), 1)
+    x = paddle.to_tensor(np.array([[0.5, -0.2], [0.1, 0.3]], np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(), rtol=1e-5)
+    # ldj sums the base's elementwise ldj over the last dim
+    np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                               x.numpy().sum(-1), rtol=1e-6)
+    with pytest.raises(ValueError):
+        D.IndependentTransform(D.ExpTransform(), 0)
+    with pytest.raises(TypeError):
+        D.IndependentTransform("notatransform", 1)
+
+
+def test_reshape_transform():
+    t = D.ReshapeTransform((2, 3), (6,))
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 2, 3))
+    y = t.forward(x)
+    assert y.shape == [2, 6]
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+    ldj = t.forward_log_det_jacobian(x)
+    assert ldj.shape == [2]
+    np.testing.assert_allclose(ldj.numpy(), 0.0)
+    with pytest.raises(ValueError):
+        D.ReshapeTransform((2, 3), (5,))
+    with pytest.raises(ValueError):
+        t.forward(paddle.to_tensor(np.zeros((2, 3, 2), np.float32)))
+
+
+def test_stack_transform():
+    t = D.StackTransform([D.ExpTransform(), D.AffineTransform(1.0, 2.0)],
+                         axis=1)
+    x = paddle.to_tensor(np.array([[0.5, -0.2], [0.1, 0.3]], np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(y.numpy()[:, 0], np.exp(x.numpy()[:, 0]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(y.numpy()[:, 1], 1 + 2 * x.numpy()[:, 1],
+                               rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(), rtol=1e-5)
+    ldj = t.forward_log_det_jacobian(x)
+    np.testing.assert_allclose(ldj.numpy()[:, 0], x.numpy()[:, 0], rtol=1e-6)
+    np.testing.assert_allclose(ldj.numpy()[:, 1], np.log(2.0), rtol=1e-6)
+    with pytest.raises(ValueError):
+        t.forward(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+    with pytest.raises(TypeError):
+        D.StackTransform([])
+
+
+def test_stick_breaking_transform():
+    import jax
+    import jax.numpy as jnp
+    t = D.StickBreakingTransform()
+    x = paddle.to_tensor(np.array([[0.3, -0.5, 1.2], [0.0, 0.0, 0.0]],
+                                  np.float32))
+    y = t.forward(x)
+    assert y.shape == [2, 4]
+    yn = y.numpy()
+    assert (yn > 0).all()
+    np.testing.assert_allclose(yn.sum(-1), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # ldj vs autodiff log|det J| of the first K output coords
+    ldj = t.forward_log_det_jacobian(x).numpy()
+    for i in range(2):
+        J = jax.jacfwd(lambda v: t._forward(v)[:-1])(jnp.asarray(x.numpy()[i]))
+        _, ref = np.linalg.slogdet(np.asarray(J))
+        np.testing.assert_allclose(ldj[i], ref, rtol=1e-4)

@@ -43,6 +43,7 @@ _SKIP = {
     "sign", "heaviside", "round", "floor", "ceil", "trunc",
     "floor_divide", "mod", "remainder", "maximum", "minimum",
     "isnan", "isinf", "isfinite", "isneginf", "isposinf", "isreal",
+    "signbit", "isin",
     "iscomplex", "exponent", "nextafter", "fmax", "fmin", "copysign",
     "logical_and", "logical_or",
     "logical_not", "logical_xor", "equal", "not_equal", "less_than",
