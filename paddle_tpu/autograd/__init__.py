@@ -1,4 +1,4 @@
 """paddle_tpu.autograd — user-facing autograd API (analog of python/paddle/autograd/)."""
-from ..core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from ..core.autograd import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled, saved_tensors_hooks  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext, once_differentiable  # noqa: F401
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401

@@ -327,3 +327,26 @@ __all__ = [
     "GradNode", "backward", "grad", "no_grad", "enable_grad",
     "is_grad_enabled", "set_grad_enabled",
 ]
+
+
+# ---- saved-tensors hooks (reference: python/paddle/autograd/
+# saved_tensors_hooks.py) ----
+SAVED_TENSOR_HOOKS: list = []
+
+
+class saved_tensors_hooks:
+    """Context manager installing (pack, unpack) hooks over every tensor
+    the tape saves for backward. pack(tensor) -> anything; unpack(obj) ->
+    tensor. Typical use: offload saved activations to host numpy and
+    bring them back at backward time."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pair = (pack_hook, unpack_hook)
+
+    def __enter__(self):
+        SAVED_TENSOR_HOOKS.append(self.pair)
+        return self
+
+    def __exit__(self, *exc):
+        SAVED_TENSOR_HOOKS.remove(self.pair)
+        return False

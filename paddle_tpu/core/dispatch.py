@@ -108,7 +108,43 @@ def _eager_apply_inner(name: str, pure_fn, args: tuple, kwargs: dict):
         a, kw = jax.tree.unflatten(treedef, vals)
         return pure_fn(*a, **kw)
 
-    out, vjp_fn = jax.vjp(g, *diff_arrays)
+    hooks = autograd.SAVED_TENSOR_HOOKS
+    if hooks:
+        # saved_tensors_hooks active (reference: python/paddle/autograd/
+        # saved_tensors_hooks, eager pack/unpack hooks in
+        # paddle/fluid/eager/saved_tensors_hooks.h): apply pack to every
+        # array this node would keep for backward, and defer linearization
+        # to backward time — unpack, then re-derive the vjp (checkpoint
+        # semantics: one extra forward per op, the TPU-idiomatic trade
+        # jax.checkpoint makes).
+        pack, unpack = hooks[-1]
+        out = g(*diff_arrays)
+        packed = [pack(Tensor(a, stop_gradient=True)) for a in diff_arrays]
+        # snapshot the AMP decision NOW: the deferred re-linearization must
+        # differentiate the same (possibly autocast) function the forward
+        # ran, even if backward happens outside the amp.auto_cast context
+        from ..amp.auto_cast import _state as _amp_s
+        amp_snap = (_amp_s.enabled, _amp_s.dtype, _amp_s.level,
+                    _amp_s.white, _amp_s.black)
+
+        def vjp_fn(cts, _g=g, _packed=packed, _unpack=unpack,
+                   _amp=amp_snap):
+            arrays = []
+            for p in _packed:
+                u = _unpack(p)
+                arrays.append(u._data if isinstance(u, Tensor) else
+                              jnp.asarray(u))
+            from ..amp.auto_cast import _state as _s
+            saved = (_s.enabled, _s.dtype, _s.level, _s.white, _s.black)
+            (_s.enabled, _s.dtype, _s.level, _s.white, _s.black) = _amp
+            try:
+                _, inner = jax.vjp(_g, *arrays)
+            finally:
+                (_s.enabled, _s.dtype, _s.level, _s.white,
+                 _s.black) = saved
+            return inner(cts)
+    else:
+        out, vjp_fn = jax.vjp(g, *diff_arrays)
 
     edges = []
     for t in diff_tensors:

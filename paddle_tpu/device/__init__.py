@@ -195,3 +195,62 @@ def max_memory_allocated(device=None):
 def memory_allocated(device=None):
     s = memory_stats(device)
     return int(s.get("bytes_in_use", 0)) if s else 0
+
+
+# ---- reference parity tail (python/paddle/device/__init__.py __all__) ----
+
+def get_cudnn_version():
+    """None: no cuDNN on this stack (reference returns the int version;
+    callers use None/int checks for feature gates)."""
+    return None
+
+
+class XPUPlace:
+    """Accepted for API parity; resolves to the accelerator place
+    (reference: paddle.device.XPUPlace)."""
+
+    def __new__(cls, dev_id=0):
+        from ..core.place import TPUPlace
+        return TPUPlace(dev_id)
+
+
+class IPUPlace:
+    def __new__(cls, dev_id=0):
+        from ..core.place import TPUPlace
+        return TPUPlace(dev_id)
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """XLA fills CINN's role here; the flag answers the reference question
+    'is the graph compiler available' — it is."""
+    return True
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return False
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_custom_device():
+    return []
+
+
+def set_stream(stream=None):
+    """Streams are implicit in the PJRT runtime; returns the current
+    stream object for parity (reference: device/__init__.py set_stream)."""
+    return current_stream()

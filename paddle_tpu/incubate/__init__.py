@@ -4,3 +4,57 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+
+# ---- reference-name re-exports (python/paddle/incubate/__init__.py):
+# the graph/segment ops live in paddle.geometric on this stack; incubate
+# keeps the legacy spellings ----
+from ..geometric import (  # noqa: F401,E402
+    segment_sum, segment_mean, segment_max, segment_min,
+    graph_khop_sampler,
+)
+from ..geometric import send_u_recv as _send_u_recv  # noqa: E402
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401,E402
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from .. import inference  # noqa: F401,E402
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy spelling of geometric.send_u_recv (reference:
+    python/paddle/incubate/operators/graph_send_recv.py)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) as one op (reference: incubate/operators/
+    softmax_mask_fuse.py — a fused CUDA kernel there; XLA fuses the
+    add into the softmax here, same HBM traffic win)."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal (upper-triangle-masked) softmax over the last two dims
+    (reference: incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    from ..nn import functional as F
+    s = x.shape[-1]
+    mask = jnp.triu(jnp.full((s, s), -10000.0, jnp.float32), k=1)
+    return F.softmax(x + Tensor(mask), axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """(reference: incubate/operators/identity_loss.py): marks a loss for
+    the graph compiler; functionally a reduction. Accepts the reference's
+    int codes (0 sum, 1 mean, 2 none) or their names."""
+    codes = {0: "sum", 1: "mean", 2: "none"}
+    reduction = codes.get(reduction, reduction)
+    if reduction == "sum":
+        return x.sum()
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "none":
+        return x
+    raise ValueError(f"invalid reduction {reduction!r}")

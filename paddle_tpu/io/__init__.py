@@ -129,6 +129,23 @@ class SequenceSampler(Sampler):
         return len(self.data_source)
 
 
+class SubsetRandomSampler(Sampler):
+    """Sample from a given index subset without replacement (reference:
+    python/paddle/io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+        if not self.indices:
+            raise ValueError("indices must not be empty")
+
+    def __iter__(self):
+        perm = np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in perm)
+
+    def __len__(self):
+        return len(self.indices)
+
+
 class RandomSampler(Sampler):
     def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
         super().__init__(data_source)

@@ -525,3 +525,21 @@ def ignore_module(modules):
     pass
 
 from .save_load import save, load, InputSpec, TranslatedLayer  # noqa: F401,E402
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transcription verbosity (reference: jit/api.py
+    set_verbosity -> TranslatorLogger): maps onto FLAGS_v so the vlog
+    tier carries SOT diagnostics."""
+    from ..core.flags import GLOBAL_FLAGS
+    GLOBAL_FLAGS.set("v", int(level))
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dump transformed code up to ``level`` (reference: jit/api.py
+    set_code_level). The SOT-lite pipeline has one transform stage, so any
+    level >= 1 turns on specialization-dump logging via
+    FLAGS_logging_pir_py_code_dir default '.' when unset."""
+    from ..core.flags import GLOBAL_FLAGS
+    if int(level) >= 1 and not GLOBAL_FLAGS.get("logging_pir_py_code_dir"):
+        GLOBAL_FLAGS.set("logging_pir_py_code_dir", ".")

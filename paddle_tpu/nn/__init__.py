@@ -1,10 +1,14 @@
 """paddle_tpu.nn (analog of python/paddle/nn/)."""
 from .layer.layers import Layer, Parameter, ParamAttr  # noqa: F401
-from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
+from .layer.container import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList, ParameterDict,
+)
 from .layer.common import (  # noqa: F401
     Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding, Flatten,
     Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
-    PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D, ZeroPad2D,
+    PixelUnshuffle, ChannelShuffle, Pad1D, Pad2D, Pad3D, ZeroPad1D,
+    ZeroPad2D, ZeroPad3D, FeatureAlphaDropout, Unflatten,
     CosineSimilarity, PairwiseDistance, Bilinear, Unfold, Fold,
 )
 from .layer.conv import (  # noqa: F401
@@ -12,6 +16,8 @@ from .layer.conv import (  # noqa: F401
 )
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, FractionalMaxPool2D,
+    FractionalMaxPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool1D, LPPool2D,
 )
@@ -24,6 +30,7 @@ from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LeakyReLU, ELU, CELU,
     SELU, Hardtanh, Hardshrink, Softshrink, Hardsigmoid, Hardswish, Swish, Mish,
     Silu, Softplus, Softsign, Tanhshrink, LogSigmoid, ThresholdedReLU, Maxout,
+    Softmax2D,
     GLU, PReLU, RReLU,
 )
 from .layer.loss import (  # noqa: F401
@@ -32,6 +39,7 @@ from .layer.loss import (  # noqa: F401
     TripletMarginLoss, HingeEmbeddingLoss, CTCLoss, SoftMarginLoss,
     MultiLabelSoftMarginLoss, MultiMarginLoss, GaussianNLLLoss,
     PoissonNLLLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,

@@ -1,0 +1,197 @@
+"""Decoding: BeamSearchDecoder + dynamic_decode.
+
+Analog of python/paddle/nn/decode.py (BeamSearchDecoder:77,
+dynamic_decode:747). TPU-shaped design: every step works on merged
+[batch*beam, ...] tensors so the cell's matmuls stay large and batched on
+the MXU; the backtrace at the end is the gather_tree scan. The drive loop
+is host-side (eager), matching the reference's dynamic while_loop path;
+for a fully-compiled decode loop use paddle_tpu.static.nn.while_loop
+(the O(1)-trace decode path) with the same decoder.step.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import tensor as T
+from . import functional as F
+
+
+class Decoder:
+    """Abstract decoder interface (reference: decode.py:36 Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """(reference: decode.py:77). cell maps (inputs, states) -> (outputs,
+    next_states); beams are flattened into the batch dim for the cell
+    call. ``embedding_fn`` maps token ids to cell inputs."""
+
+    OutputWrapper = namedtuple("OutputWrapper",
+                               ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = namedtuple("StateWrapper",
+                              ("cell_states", "log_probs", "finished",
+                               "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam plumbing ---------------------------------------------------
+    def _merge(self, x):
+        """[batch, beam, ...] -> [batch*beam, ...]"""
+        return T.reshape(x, [-1] + x.shape[2:])
+
+    def _split(self, x):
+        """[batch*beam, ...] -> [batch, beam, ...]"""
+        return T.reshape(x, [-1, self.beam_size] + x.shape[1:])
+
+    def _expand_to_beam_size(self, x):
+        """[batch, ...] -> [batch, beam, ...] by tile."""
+        x = T.unsqueeze(x, 1)
+        tiles = [1, self.beam_size] + [1] * (x.ndim - 2)
+        return T.tile(x, tiles)
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (list, tuple)):
+            return type(states)(self._map_states(s, fn) for s in states)
+        return fn(states)
+
+    def initialize(self, inits):
+        cell_states = self._map_states(inits, self._expand_to_beam_size)
+        probe = cell_states
+        while isinstance(probe, (list, tuple)):
+            probe = probe[0]
+        batch = probe.shape[0]
+        # beam 0 live, others -inf so step 1 expands a single beam
+        lp = np.full((batch, self.beam_size), -1e9, np.float32)
+        lp[:, 0] = 0.0
+        log_probs = Tensor(jnp.asarray(lp))
+        finished = Tensor(jnp.zeros((batch, self.beam_size), bool))
+        lengths = Tensor(jnp.zeros((batch, self.beam_size), jnp.int32))
+        start = Tensor(jnp.full((batch, self.beam_size), self.start_token,
+                                jnp.int32))
+        inputs = self.embedding_fn(start) if self.embedding_fn else start
+        return inputs, self.StateWrapper(cell_states, log_probs, finished,
+                                         lengths), finished
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_in = self._merge(inputs)
+        merged_states = self._map_states(states.cell_states, self._merge)
+        cell_out, next_cell_states = self.cell(merged_in, merged_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = self._split(cell_out)                 # [b, beam, vocab]
+        vocab = logits.shape[-1]
+        step_lp = F.log_softmax(logits, axis=-1)
+        # finished beams only extend with end_token at zero cost
+        fin = states.finished
+        end_mask = np.full((1, 1, vocab), -1e9, np.float32)
+        end_mask[0, 0, self.end_token] = 0.0
+        masked = T.where(T.unsqueeze(fin, -1),
+                         Tensor(jnp.asarray(end_mask)) +
+                         T.zeros_like(step_lp), step_lp)
+        total = T.unsqueeze(states.log_probs, -1) + masked
+        flat = T.reshape(total, [-1, self.beam_size * vocab])
+        top_lp, top_idx = T.topk(flat, self.beam_size, axis=-1)
+        parent = top_idx // vocab                      # [b, beam]
+        token = top_idx % vocab
+        next_fin = T.gather_nd_batched(fin, parent) if hasattr(T, "gather_nd_batched") \
+            else Tensor(jnp.take_along_axis(fin._data, parent._data, 1))
+        next_len = Tensor(jnp.take_along_axis(states.lengths._data,
+                                              parent._data, 1))
+        next_len = next_len + (~next_fin).astype("int32")
+        next_fin = next_fin | (token == self.end_token)
+
+        def regather(s):
+            sp = self._split(s)
+            idx = parent._data.reshape(tuple(parent.shape)
+                                       + (1,) * (sp.ndim - 2))
+            idx = jnp.broadcast_to(idx, idx.shape[:2] + tuple(
+                sp.shape[2:]))
+            return self._merge(Tensor(jnp.take_along_axis(
+                sp._data, idx, 1)))
+
+        next_cell_states = self._map_states(next_cell_states, regather)
+        next_cell_states = self._map_states(next_cell_states, self._split)
+        beam_out = self.OutputWrapper(top_lp, token, parent)
+        next_states = self.StateWrapper(next_cell_states, top_lp, next_fin,
+                                        next_len)
+        next_inputs = self.embedding_fn(token) if self.embedding_fn \
+            else token
+        return beam_out, next_states, next_inputs, next_fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        # outputs.*: [time, batch, beam]
+        preds = F.gather_tree(outputs.predicted_ids, outputs.parent_ids)
+        return self.OutputWrapper(outputs.scores, preds,
+                                  outputs.parent_ids), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive ``decoder`` until every sequence finishes or ``max_step_num``
+    (reference: decode.py:747). Returns (outputs, final_states[, length]).
+    """
+    inputs, states, finished = decoder.initialize(inits)
+    # driver-tracked lengths (reference dynamic_decode does the same), so
+    # custom Decoder subclasses need no 'lengths' field in their states
+    seq_lengths = Tensor(jnp.zeros(tuple(finished.shape), jnp.int32))
+    step_outputs = []
+    time = 0
+    while True:
+        if max_step_num is not None and time >= max_step_num:
+            break
+        if bool(np.asarray(finished.numpy()).all()):
+            break
+        alive = ~finished
+        out, states, inputs, finished = decoder.step(time, inputs, states,
+                                                     **kwargs)
+        seq_lengths = seq_lengths + alive.astype("int32")
+        step_outputs.append(out)
+        time += 1
+
+    if not step_outputs:
+        raise ValueError("decode produced no steps (check max_step_num)")
+    stacked = type(step_outputs[0])(*[
+        T.stack([getattr(o, f) for o in step_outputs], axis=0)
+        for f in step_outputs[0]._fields])
+    lengths = getattr(states, "lengths", seq_lengths)
+    outputs, final_states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        outputs = type(outputs)(*[
+            T.transpose(f, [1, 0] + list(range(2, f.ndim)))
+            for f in outputs])
+    if return_length:
+        return outputs, final_states, getattr(final_states, "lengths",
+                                              seq_lengths)
+    return outputs, final_states
+
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]

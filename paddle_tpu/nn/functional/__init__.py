@@ -26,6 +26,17 @@ from .loss import (  # noqa: F401
     adaptive_log_softmax_with_loss,
 )
 from .distance import pdist  # noqa: F401
+from .loss import (  # noqa: F401
+    dice_loss, log_loss, triplet_margin_with_distance_loss,
+)
+from .common import feature_alpha_dropout  # noqa: F401
+from .activation import (  # noqa: F401
+    relu_, elu_, hardtanh_, leaky_relu_, softmax_, tanh_,
+    thresholded_relu_,
+)
+from .attention import (  # noqa: F401
+    sparse_attention, flashmask_attention,
+)
 from .common import (  # noqa: F401
     linear, dropout, dropout2d, dropout3d, alpha_dropout, embedding, one_hot,
     label_smooth, interpolate, upsample, pixel_shuffle, pixel_unshuffle,

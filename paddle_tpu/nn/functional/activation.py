@@ -228,3 +228,15 @@ def swiglu(x, y=None, name=None):
     Overridable by the Pallas fused kernel (paddle_tpu/kernels)."""
     args = (x,) if y is None else (x, y)
     return op_call("swiglu", _swiglu, *args)
+
+
+# in-place activation variants (reference exports them from nn.functional)
+from ...tensor.math import _make_inplace  # noqa: E402
+
+relu_ = _make_inplace(relu)
+elu_ = _make_inplace(elu)
+hardtanh_ = _make_inplace(hardtanh)
+leaky_relu_ = _make_inplace(leaky_relu)
+softmax_ = _make_inplace(softmax)
+tanh_ = _make_inplace(tanh)
+thresholded_relu_ = _make_inplace(thresholded_relu)

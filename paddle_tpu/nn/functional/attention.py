@@ -292,3 +292,101 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
     if return_softmax:
         return out
     return out, None
+
+
+@op_body("sparse_attention")
+def _sparse_attention(q, k, v, offset, columns, *, key_padding_mask,
+                      attn_mask):
+    # CSR pattern -> dense additive mask. TPU-first design note: the MXU
+    # wants dense tiles, so the sparsity pattern becomes a mask over a
+    # dense SDPA (a Pallas block-sparse kernel is the upgrade path);
+    # reference kernel: paddle/phi/kernels/gpu/sparse_attention_kernel.cu.
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    rows = jnp.repeat(jnp.arange(sq), jnp.diff(offset[0, 0]),
+                      total_repeat_length=columns.shape[-1])
+    dense = jnp.zeros((b, h, sq, sk), bool)
+    bi = jnp.arange(b)[:, None, None]
+    hi = jnp.arange(h)[None, :, None]
+    dense = dense.at[bi, hi, rows[None, None, :], columns].set(True)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    logits = jnp.where(dense, logits, neg)
+    if key_padding_mask is not None:
+        logits = jnp.where(key_padding_mask[:, None, None, :] != 0,
+                           logits, neg)
+    if attn_mask is not None:
+        logits = jnp.where(attn_mask != 0, logits, neg)
+    p = jax.nn.softmax(logits, -1)
+    p = jnp.where(dense.any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Attention restricted to a CSR-described position set (reference:
+    python/paddle/nn/functional/sparse_attention.py). The per-(batch,
+    head) CSR pattern must share row counts (the reference kernel assumes
+    one pattern per call); inputs are [bs, heads, seq, head_dim]."""
+    return op_call("sparse_attention", _sparse_attention, query, key,
+                   value, sparse_csr_offset, sparse_csr_columns,
+                   key_padding_mask=key_padding_mask, attn_mask=attn_mask)
+
+
+@op_body("flashmask_attention")
+def _flashmask_attention(q, k, v, startend, *, causal):
+    # FlashMask column-compressed mask -> dense bool mask -> SDPA.
+    # startend: [bs, kv_heads(1 ok), seq_k, {1, 2, 4}]
+    # causal 1: mask rows >= LTS (below the start, lower triangle)
+    # causal 2: mask LTS <= row < LTE
+    # bidir 2: (LTS, UTE): mask row >= LTS or row < UTE
+    # bidir 4: mask (LTS <= row < LTE) or (UTS <= row < UTE)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nvals = startend.shape[-1]
+    rows = jnp.arange(sq)[:, None]                       # [sq, 1]
+    se = jnp.moveaxis(startend, -1, 0)                   # [nvals, b, hk, sk]
+    se = se[:, :, :, None, :]                            # [nvals,b,hk,1,sk]
+    if causal:
+        if nvals == 1:
+            masked = rows >= se[0]
+        elif nvals == 2:
+            masked = (rows >= se[0]) & (rows < se[1])
+        else:
+            raise ValueError("causal flashmask takes 1 or 2 values")
+        masked = masked | (rows < jnp.arange(sk)[None, :])   # causal upper
+    else:
+        if nvals == 2:
+            masked = (rows >= se[0]) | (rows < se[1])
+        elif nvals == 4:
+            masked = ((rows >= se[0]) & (rows < se[1])) | \
+                     ((rows >= se[2]) & (rows < se[3]))
+        else:
+            raise ValueError("bidirectional flashmask takes 2 or 4 values")
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
+    logits = jnp.where(masked, neg, logits)
+    p = jax.nn.softmax(logits, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def flashmask_attention(query, key, value, startend_row_indices,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (reference: python/paddle/nn/functional/
+    flash_attention.py flashmask_attention): the mask is column-compressed
+    as start/end row indices per key column. Dense-mask expansion over
+    SDPA here; the XLA fusion keeps it on the MXU (a Pallas flash kernel
+    with on-the-fly mask decode is the perf upgrade path). Layout:
+    [batch, seq, heads, head_dim]."""
+    if window_size is not None:
+        raise NotImplementedError("flashmask window_size")
+    if return_softmax_lse or return_seed_offset:
+        raise NotImplementedError("flashmask aux returns")
+    return op_call("flashmask_attention", _flashmask_attention, query, key,
+                   value, startend_row_indices, causal=bool(causal))

@@ -457,3 +457,26 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
         raise ValueError("temporal_shift supports NCHW/NHWC")
     return op_call("temporal_shift", _temporal_shift, x, seg_num=seg_num,
                    shift_ratio=shift_ratio, data_format=data_format)
+
+
+@op_body("feature_alpha_dropout")
+def _feature_alpha_dropout(a, key, *, p):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    # drop whole feature maps: mask over (N, C), broadcast over spatial
+    mask_shape = a.shape[:2] + (1,) * (a.ndim - 2)
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    q = 1.0 - p
+    coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+    coef_b = -coef_a * alpha_p * p
+    return (coef_a * jnp.where(keep, a, alpha_p) + coef_b).astype(a.dtype)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (reference:
+    python/paddle/nn/functional/common.py feature_alpha_dropout)."""
+    if not training or p == 0:
+        return x
+    return op_call("feature_alpha_dropout", _feature_alpha_dropout, x,
+                   _rng.next_key(), p=p)

@@ -838,3 +838,59 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
                    *args, cutoffs=tuple(int(c) for c in cutoffs),
                    has_head_bias=head_bias is not None,
                    n_tail=len(tail_weights))
+
+
+@op_body("dice_loss")
+def _dice_loss(inp, label, *, epsilon):
+    num_classes = inp.shape[-1]
+    lab = jax.nn.one_hot(label.squeeze(-1).astype(jnp.int32), num_classes,
+                         dtype=inp.dtype)
+    rd = tuple(range(1, inp.ndim))
+    inse = (inp * lab).sum(rd)
+    denom = inp.sum(rd) + lab.sum(rd)
+    return (1 - 2 * inse / (denom + epsilon)).mean()
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """(reference: python/paddle/nn/functional/loss.py dice_loss): label
+    holds class ids with trailing singleton dim; scalar mean dice."""
+    return op_call("dice_loss", _dice_loss, input, label, epsilon=epsilon)
+
+
+@op_body("log_loss")
+def _log_loss(inp, label, *, epsilon):
+    return (-label * jnp.log(inp + epsilon)
+            - (1 - label) * jnp.log(1 - inp + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """(reference: loss.py log_loss): elementwise negative log likelihood
+    of binary probabilities."""
+    return op_call("log_loss", _log_loss, input, label, epsilon=epsilon)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """(reference: loss.py triplet_margin_with_distance_loss): like
+    triplet_margin_loss but with a caller-supplied distance callable."""
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError("reduction must be 'mean', 'sum' or 'none'")
+    from ... import tensor as T
+
+    def _l2(a, b):
+        return T.sqrt(((a - b) ** 2).sum(-1) + 1e-12)
+
+    dist = distance_function or _l2
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_neg = T.minimum(d_neg, d_pn)
+    loss = T.clip(d_pos - d_neg + margin, min=0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss

@@ -166,4 +166,67 @@ normal_init = Normal
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierUniform", "XavierNormal", "KaimingUniform", "KaimingNormal",
-           "Assign", "Orthogonal", "Dirac"]
+           "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
+           "set_global_initializer"]
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Recommended init gain per nonlinearity (reference:
+    python/paddle/nn/initializer/initializer.py calculate_gain)."""
+    import math
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None
+                                            else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    python/paddle/nn/initializer/Bilinear): weight[c_out, c_in, kh, kw]
+    gets the separable triangle filter."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        import jax.numpy as jnp
+        if len(shape) != 4:
+            raise ValueError("the length of shape must be 4.")
+        if shape[2] != shape[3]:
+            raise ValueError("shape[2] must be equal to shape[3].")
+        size = shape[3]
+        f = np.ceil(size / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        ax = np.arange(size)
+        # every (c_out, c_in) slice carries the same separable triangle
+        # filter (reference fill law, bilinear.py:117-126). Deliberate
+        # divergence: the reference computes the row index with true
+        # division (`y = (i / size) % size`, a py2-era artifact) which
+        # yields asymmetric non-bilinear kernels; this uses the intended
+        # integer row index so the filter is the symmetric bilinear one.
+        tri = 1 - np.abs(ax / f - c)
+        w = np.broadcast_to(np.outer(tri, tri)[None, None],
+                            shape).astype(np.float32)
+        return jnp.asarray(w, dtype)
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Process-wide default initializers consumed by
+    Layer.create_parameter when neither attr nor the layer supplies one
+    (reference: python/paddle/nn/initializer/set_global_initializer).
+    Pass None to reset."""
+    for v, what in ((weight_init, "weight"), (bias_init, "bias")):
+        if v is not None and not isinstance(v, Initializer):
+            raise TypeError(f"{what} initializer must be an Initializer "
+                            "or None")
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init

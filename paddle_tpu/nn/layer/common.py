@@ -153,8 +153,12 @@ class ChannelShuffle(Layer):
 
 
 class _PadNd(Layer):
+    _nspatial = None   # set by subclasses for int-padding normalization
+
     def __init__(self, padding, mode, value, data_format):
         super().__init__()
+        if isinstance(padding, int) and self._nspatial:
+            padding = [padding] * (2 * self._nspatial)
         self.padding, self.mode, self.value, self.data_format = padding, mode, value, data_format
 
     def forward(self, x):
@@ -163,16 +167,22 @@ class _PadNd(Layer):
 
 
 class Pad1D(_PadNd):
+    _nspatial = 1
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCL", name=None):
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad2D(_PadNd):
+    _nspatial = 2
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW", name=None):
         super().__init__(padding, mode, value, data_format)
 
 
 class Pad3D(_PadNd):
+    _nspatial = 3
+
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCDHW", name=None):
         super().__init__(padding, mode, value, data_format)
 
@@ -231,3 +241,38 @@ class Fold(Layer):
     def forward(self, x):
         o, k, s, p, d = self.args
         return F.fold(x, o, k, s, p, d)
+
+
+class ZeroPad1D(Pad1D):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class ZeroPad3D(Pad3D):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class Unflatten(Layer):
+    """(reference: python/paddle/nn/layer/common.py Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self._shape = axis, shape
+
+    def forward(self, x):
+        return x.unflatten(self.axis, self._shape)
+
+    def extra_repr(self):
+        return f"axis={self.axis}, shape={self._shape}"

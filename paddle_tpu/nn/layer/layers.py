@@ -186,7 +186,9 @@ class Layer:
         if attr is False:
             return None
         dtype = to_jax_dtype(dtype or self._dtype)
+        from ..initializer import _GLOBAL_INIT
         init = attr.initializer or default_initializer or \
+            _GLOBAL_INIT["bias" if is_bias else "weight"] or \
             (I.Constant(0.0) if is_bias else I.XavierUniform())
         shape = tuple(int(s) for s in shape)
         if _LAZY["active"]:

@@ -263,3 +263,49 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
 
     def predict(self, input):
         return self.log_prob(input).argmax(axis=1)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        if reduction not in ("mean", "sum", "none"):
+            raise ValueError("reduction must be 'mean', 'sum' or 'none'")
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function, self.margin,
+            self.swap, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer owning the tree parameters
+    (reference: python/paddle/nn/layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        C = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [C, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([C, 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError("custom tree needs path_table and path_code")
+        bias = self.bias
+        if bias is not None:
+            from ... import tensor as T
+            bias = T.reshape(bias, [-1])
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias, path_table=path_table,
+                               path_code=path_code)

@@ -89,3 +89,68 @@ class LPPool2D(Layer):
 
     def forward(self, x):
         return F.lp_pool2d(x, *self.args)
+
+
+class _MaxUnPoolNd(Layer):
+    def __init__(self, fn_name, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.fn_name = fn_name
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format, self.output_size = data_format, output_size
+
+    def forward(self, x, indices):
+        return getattr(F, self.fn_name)(
+            x, indices, self.kernel_size, self.stride, self.padding,
+            data_format=self.data_format, output_size=self.output_size)
+
+    def extra_repr(self):
+        return f"kernel_size={self.kernel_size}, stride={self.stride}"
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__("max_unpool1d", kernel_size, stride, padding,
+                         data_format, output_size)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__("max_unpool2d", kernel_size, stride, padding,
+                         data_format, output_size)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__("max_unpool3d", kernel_size, stride, padding,
+                         data_format, output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(
+            x, self.output_size, self.kernel_size, self.random_u,
+            self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size, self.kernel_size = output_size, kernel_size
+        self.random_u, self.return_mask = random_u, return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(
+            x, self.output_size, self.kernel_size, self.random_u,
+            self.return_mask)

@@ -106,4 +106,38 @@ def deprecated(update_to="", since="", reason="", level=0):
     return deco
 
 
-__all__ = ["unique_name", "try_import", "deprecated"]
+__all__ = ["unique_name", "try_import", "deprecated",
+           "run_check", "require_version"]
+
+
+def run_check():
+    """Install sanity check (reference: paddle.utils.run_check): runs a
+    tiny matmul fwd/bwd on the current device and prints the verdict."""
+    import numpy as np
+    from . import tensor as T
+    from .core.tensor import Tensor
+    from .core.place import get_default_place
+    a = Tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    b = Tensor(np.ones((3, 2), np.float32))
+    out = T.matmul(a, b).sum()
+    out.backward()
+    assert a.grad is not None
+    print(f"PaddlePaddle (paddle_tpu) works on {get_default_place()}!")
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (reference: utils/install_check.py require_version)."""
+    from . import version
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(version.full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {version.full_version} < required "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {version.full_version} > allowed "
+            f"{max_version}")

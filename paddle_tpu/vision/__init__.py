@@ -4,3 +4,35 @@ from . import datasets  # noqa: F401
 from . import transforms  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_IMAGE_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    """(reference: python/paddle/vision/image.py set_image_backend).
+    'pil' and 'cv2' accepted; cv2 is unavailable in this environment, so
+    selecting it raises at use time in image_load."""
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"invalid backend {backend!r}; expected 'pil' "
+                         "or 'cv2'")
+    global _IMAGE_BACKEND
+    _IMAGE_BACKEND = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image file via the selected backend (reference:
+    image.py image_load)."""
+    backend = backend or _IMAGE_BACKEND
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"invalid backend {backend!r}; expected 'pil' "
+                         "or 'cv2'")
+    if backend == "cv2":
+        raise ImportError("cv2 is not available in this build; "
+                          "set_image_backend('pil')")
+    from PIL import Image
+    return Image.open(path)

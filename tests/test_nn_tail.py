@@ -1,0 +1,287 @@
+"""nn/* parity tail: decode (beam search), attention variants, new layers,
+initializers, saved_tensors_hooks, incubate re-exports, module __all__
+parity for nn / nn.functional / nn.initializer / io / jit / autograd /
+device / vision / incubate / utils."""
+import re
+import importlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+@pytest.mark.parametrize("mod", [
+    "nn", "nn.functional", "nn.initializer", "io", "jit", "autograd",
+    "device", "vision", "incubate", "utils", "amp", "metric", "optimizer",
+    "sparse", "distribution",
+])
+def test_module_all_parity(mod):
+    src = open(f"/root/reference/python/paddle/{mod.replace('.', '/')}"
+               "/__init__.py").read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    if m is None:
+        pytest.skip("no __all__ in reference module")
+    names = re.findall(r"'([^']+)'", m.group(1))
+    mine = importlib.import_module(f"paddle_tpu.{mod}")
+    missing = [n for n in names if not hasattr(mine, n)]
+    assert not missing, f"paddle.{mod} missing: {missing}"
+
+
+def test_beam_search_decodes_planted_sequence():
+    vocab, batch, beam, hidden = 7, 2, 3, 4
+    seq = [3, 5, 1, 2]
+    END = 0
+
+    class ToyCell(nn.Layer):
+        def forward(self, inputs, states, **kw):
+            step = states.astype("int32").numpy()[:, 0]
+            want = np.array([seq[s] if s < len(seq) else END
+                             for s in step])
+            logits = np.full((inputs.shape[0], vocab), -5.0, np.float32)
+            logits[np.arange(len(want)), want] = 5.0
+            return paddle.to_tensor(logits), states + 1.0
+
+    dec = nn.BeamSearchDecoder(ToyCell(), start_token=6, end_token=END,
+                               beam_size=beam,
+                               embedding_fn=nn.Embedding(vocab, hidden))
+    outputs, final_states, lengths = nn.dynamic_decode(
+        dec, inits=paddle.zeros([batch, 1]), max_step_num=10,
+        return_length=True)
+    best = outputs.predicted_ids.numpy()[:, :, 0]
+    for b in range(batch):
+        assert [int(v) for v in best[b]][:len(seq) + 1] == seq + [END]
+    assert lengths.numpy()[:, 0].tolist() == [len(seq) + 1] * batch
+    assert outputs.predicted_ids.shape[1] <= 6  # stopped early
+
+
+def test_sparse_attention_matches_dense():
+    rs = np.random.RandomState(0)
+    b, h, s, d = 2, 2, 8, 4
+    q, k, v = (paddle.to_tensor(rs.randn(b, h, s, d).astype(np.float32))
+               for _ in range(3))
+    offs = [0]
+    cols = []
+    for i in range(s):
+        cols.extend(range(i + 1))
+        offs.append(len(cols))
+    offset = paddle.to_tensor(np.tile(np.array(offs, np.int32), (b, h, 1)))
+    columns = paddle.to_tensor(np.tile(np.array(cols, np.int32), (b, h, 1)))
+    out = F.sparse_attention(q, k, v, offset, columns)
+    tr = lambda t: paddle.to_tensor(np.transpose(t.numpy(), (0, 2, 1, 3)))
+    ref = F.scaled_dot_product_attention(tr(q), tr(k), tr(v), is_causal=True)
+    np.testing.assert_allclose(out.numpy(),
+                               np.transpose(ref.numpy(), (0, 2, 1, 3)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flashmask_attention_matches_causal_sdpa():
+    rs = np.random.RandomState(1)
+    b, s, h, d = 2, 8, 2, 4
+    q, k, v = (paddle.to_tensor(rs.randn(b, s, h, d).astype(np.float32))
+               for _ in range(3))
+    se = paddle.to_tensor(np.full((b, 1, s, 1), s, np.int32))
+    out = F.flashmask_attention(q, k, v, se, causal=True)
+    ref = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
+                               atol=2e-5)
+    # LTS=4: rows >= 4 blocked from all columns except causal-self region
+    se2 = paddle.to_tensor(np.full((b, 1, s, 1), 4, np.int32))
+    out2 = F.flashmask_attention(q, k, v, se2, causal=True)
+    assert not np.allclose(out2.numpy(), ref.numpy())
+
+
+def test_new_losses_and_dropout():
+    inp = paddle.to_tensor(np.array([[0.7, 0.2, 0.1],
+                                     [0.2, 0.5, 0.3]], np.float32))
+    lab = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    d = float(F.dice_loss(inp, lab).numpy())
+    assert 0 < d < 1
+    ll = F.log_loss(paddle.to_tensor(np.array([0.9], np.float32)),
+                    paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(ll.numpy(), -np.log(0.9 + 1e-4), rtol=1e-4)
+    a = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    p = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    n = paddle.to_tensor(np.ones((2, 3), np.float32) * 10)
+    loss = F.triplet_margin_with_distance_loss(a, p, n, margin=1.0)
+    np.testing.assert_allclose(loss.numpy(), 0.0, atol=1e-5)  # easy triplet
+    x = paddle.ones([4, 3, 5, 5])
+    y = F.feature_alpha_dropout(x, 0.5, training=True)
+    yn = y.numpy()
+    per_chan = yn.reshape(4, 3, -1)
+    for img in per_chan:
+        for ch in img:          # whole channel shares one fate
+            assert len(np.unique(np.round(ch, 5))) == 1
+    assert F.feature_alpha_dropout(x, 0.5, training=False) is x
+
+
+def test_new_layers_forward():
+    x = paddle.ones([2, 3, 4, 4])
+    assert nn.Softmax2D()(x).shape == [2, 3, 4, 4]
+    np.testing.assert_allclose(nn.Softmax2D()(x).numpy().sum(1), 1.0,
+                               rtol=1e-5)
+    assert nn.ZeroPad1D(1)(paddle.ones([2, 3, 4])).shape == [2, 3, 6]
+    assert nn.ZeroPad3D(1)(paddle.ones([2, 3, 4, 4, 4])).shape == \
+        [2, 3, 6, 6, 6]
+    assert nn.Unflatten(1, [3, 1])(paddle.ones([2, 3])).shape == [2, 3, 1]
+    pd = nn.ParameterDict({"w": paddle.create_parameter([2], "float32")})
+    pd["b"] = paddle.create_parameter([3], "float32")
+    assert set(pd.keys()) == {"w", "b"} and len(pd) == 2
+    assert len(list(pd.parameters())) == 2
+    # MaxUnPool2D round-trips MaxPool2D(return_mask=True)
+    xin = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 1, 4, 4).astype(np.float32))
+    pooled, idx = F.max_pool2d(xin, 2, 2, return_mask=True)
+    un = nn.MaxUnPool2D(2, 2)(pooled, idx)
+    assert un.shape == [1, 1, 4, 4]
+    np.testing.assert_allclose(un.numpy().max(), xin.numpy().max(),
+                               rtol=1e-6)
+    fr = nn.FractionalMaxPool2D(2)(paddle.ones([1, 1, 6, 6]))
+    assert fr.shape == [1, 1, 2, 2]
+    hs = nn.HSigmoidLoss(8, 6)
+    out = hs(paddle.ones([3, 8]),
+             paddle.to_tensor(np.array([[0], [1], [5]], np.int64)))
+    assert np.isfinite(out.numpy()).all()
+    tl = nn.TripletMarginWithDistanceLoss(margin=1.0)
+    assert float(tl(paddle.zeros([2, 3]), paddle.zeros([2, 3]),
+                    paddle.ones([2, 3]) * 10).numpy()) < 1e-5
+
+
+def test_inplace_activations():
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    assert F.relu_(x) is x
+    np.testing.assert_allclose(x.numpy(), [0.0, 2.0])
+    for name in ("elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+                 "thresholded_relu_"):
+        assert hasattr(F, name)
+
+
+def test_initializer_tail():
+    import math
+    import paddle_tpu.nn.initializer as I
+    assert I.calculate_gain("relu") == math.sqrt(2.0)
+    assert I.calculate_gain("tanh") == 5.0 / 3
+    with pytest.raises(ValueError):
+        I.calculate_gain("nope")
+    import jax.numpy as jnp
+    w = np.asarray(I.Bilinear()((2, 2, 4, 4), jnp.float32))
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T)
+    np.testing.assert_allclose(w[0, 0], w[1, 1])
+    with pytest.raises(ValueError):
+        I.Bilinear()((2, 2, 3, 4), jnp.float32)
+    I.set_global_initializer(I.Constant(0.5), I.Constant(0.25))
+    try:
+        lin = nn.Linear(3, 3)
+        np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+        np.testing.assert_allclose(lin.bias.numpy(), 0.25)
+    finally:
+        I.set_global_initializer(None)
+    assert float(np.std(nn.Linear(3, 3).weight.numpy())) > 0
+    with pytest.raises(TypeError):
+        I.set_global_initializer(lambda s, d: None)
+
+
+def test_saved_tensors_hooks():
+    events = []
+
+    def pack(t):
+        events.append("pack")
+        return t.numpy()
+
+    def unpack(obj):
+        events.append("unpack")
+        return paddle.to_tensor(obj)
+
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(pack, unpack):
+        y = (x * x).sum()
+    n = events.count("pack")
+    assert n >= 1 and events.count("unpack") == 0
+    y.backward()
+    assert events.count("unpack") == n
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0], rtol=1e-6)
+    events.clear()
+    x2 = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    (x2 * x2).sum().backward()
+    assert events == []
+
+
+def test_incubate_tail():
+    import paddle_tpu.incubate as inc
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32))
+    o = inc.softmax_mask_fuse_upper_triangle(x).numpy()
+    np.testing.assert_allclose(o.sum(-1), 1, atol=1e-5)
+    assert (np.triu(np.ones((4, 4)), 1)[None, None] * o < 1e-4).all()
+    assert float(inc.identity_loss(paddle.ones([3]), 0).numpy()) == 3.0
+    assert float(inc.identity_loss(paddle.ones([3]), "mean").numpy()) == 1.0
+    for name in ("graph_send_recv", "graph_khop_sampler",
+                 "graph_sample_neighbors", "graph_reindex", "segment_sum",
+                 "inference"):
+        assert hasattr(inc, name)
+
+
+def test_misc_module_tail():
+    from paddle_tpu.io import SubsetRandomSampler
+    s = SubsetRandomSampler([3, 7, 11])
+    assert sorted(s) == [3, 7, 11] and len(s) == 3
+    with pytest.raises(ValueError):
+        SubsetRandomSampler([])
+    import paddle_tpu.device as D
+    assert D.get_cudnn_version() is None
+    assert D.is_compiled_with_cinn()
+    assert type(D.XPUPlace(0)).__name__ == "TPUPlace"
+    import paddle_tpu.jit as jit
+    jit.set_verbosity(0)
+    from paddle_tpu.utils import require_version
+    require_version("0.0.1")
+    with pytest.raises(Exception):
+        require_version("99.0")
+    import paddle_tpu.vision as V
+    V.set_image_backend("pil")
+    assert V.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        V.set_image_backend("turbo")
+
+
+def test_saved_tensors_hooks_with_amp():
+    from paddle_tpu import amp
+    pack = lambda t: t.numpy()
+    unpack = lambda o: paddle.to_tensor(o)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 4).astype(np.float32),
+        stop_gradient=False)
+    w = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 4).astype(np.float32),
+        stop_gradient=False)
+    with paddle.autograd.saved_tensors_hooks(pack, unpack):
+        with amp.auto_cast(dtype="bfloat16"):
+            y = paddle.matmul(x, w).sum()
+    y.backward()     # backward OUTSIDE the amp context must re-cast
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_dynamic_decode_custom_decoder_states():
+    from collections import namedtuple
+
+    class GreedyDecoder(nn.Decoder):
+        def initialize(self, inits):
+            fin = paddle.to_tensor(np.zeros((inits.shape[0],), bool))
+            return inits, (inits,), fin
+
+        def step(self, time, inputs, states, **kw):
+            O = namedtuple("O", ("ids",))
+            nxt = inputs + 1.0
+            fin = paddle.to_tensor((nxt.numpy()[:, 0] > 3))
+            return O(nxt.astype("int32")[:, 0]), (nxt,), nxt, fin
+
+        def finalize(self, outputs, final_states, sequence_lengths):
+            return outputs, final_states
+
+    out, fs, length = nn.dynamic_decode(
+        GreedyDecoder(), inits=paddle.zeros([2, 1]), max_step_num=10,
+        return_length=True)
+    assert length.numpy().tolist() == [4, 4]
