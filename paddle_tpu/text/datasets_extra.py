@@ -1,0 +1,401 @@
+"""Text datasets tail: Conll05st, Movielens, WMT14, WMT16.
+
+Reference laws: python/paddle/text/datasets/conll05.py:46 (SRL span
+labels -> BIO, context-window features), movielens.py:103 (ml-1m zip,
+MovieInfo/UserInfo value vectors, rating*2-5), wmt14.py:46 and
+wmt16.py:46 (dict files + <s>/<e>/<unk> framing). Zero-egress: the
+upstream archives must be supplied via ``data_file``.
+"""
+from __future__ import annotations
+
+import gzip
+import re
+import tarfile
+import zipfile
+from collections import defaultdict
+
+import numpy as np
+
+from ..io import Dataset
+from .datasets import _no_download
+
+CONLL_DATA_URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+MOVIELENS_URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+WMT14_URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+WMT16_URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test set (reference: conll05.py:46). Each sample is
+    the 9-tuple (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+    pred_id, mark, label_ids), all length-len(sentence) arrays."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        for f, what in ((data_file, "data_file"),
+                        (word_dict_file, "word_dict_file"),
+                        (verb_dict_file, "verb_dict_file"),
+                        (target_dict_file, "target_dict_file")):
+            if f is None:
+                _no_download(f"Conll05st ({what})", CONLL_DATA_URL)
+        self.data_file = data_file
+        self.emb_file = emb_file
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(filename):
+        d = {}
+        with open(filename) as f:
+            for i, line in enumerate(f):
+                d[line.strip()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(filename):
+        """B-/I- expansion of the span tags + O (reference law)."""
+        d = {}
+        index = 0
+        with open(filename) as f:
+            for line in f:
+                tag = line.strip()
+                if tag.startswith("B-"):
+                    tag = tag[2:]
+                    d["B-" + tag] = index
+                    index += 1
+                    d["I-" + tag] = index
+                    index += 1
+            d["O"] = index
+        return d
+
+    def _load_anno(self):
+        tf = tarfile.open(self.data_file)
+        wf = tf.extractfile(
+            "conll05st-release/test.wsj/words/test.wsj.words.gz")
+        pf = tf.extractfile(
+            "conll05st-release/test.wsj/props/test.wsj.props.gz")
+        self.sentences, self.predicates, self.labels = [], [], []
+        with gzip.GzipFile(fileobj=wf) as words_file, \
+                gzip.GzipFile(fileobj=pf) as props_file:
+            sentences, labels, one_seg = [], [], []
+            for word, label in zip(words_file, props_file):
+                word = word.strip().decode()
+                label = label.strip().decode().split()
+                if len(label) == 0:          # sentence boundary
+                    for i in range(len(one_seg[0]) if one_seg else 0):
+                        labels.append([x[i] for x in one_seg])
+                    if len(labels) >= 1:
+                        verb_list = [x for x in labels[0] if x != "-"]
+                        for i, lbl in enumerate(labels[1:]):
+                            self.sentences.append(sentences)
+                            self.predicates.append(verb_list[i])
+                            self.labels.append(self._spans_to_bio(lbl))
+                    sentences, labels, one_seg = [], [], []
+                else:
+                    sentences.append(word)
+                    one_seg.append(label)
+        pf.close(); wf.close(); tf.close()
+
+    @staticmethod
+    def _spans_to_bio(lbl):
+        cur_tag, in_bracket, seq = "O", False, []
+        for l in lbl:
+            if l == "*" and not in_bracket:
+                seq.append("O")
+            elif l == "*" and in_bracket:
+                seq.append("I-" + cur_tag)
+            elif l == "*)":
+                seq.append("I-" + cur_tag)
+                in_bracket = False
+            elif "(" in l and ")" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = False
+            elif "(" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return seq
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        vi = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx_n1 = sentence[vi - 1] if vi > 0 else "bos"
+        if vi > 0:
+            mark[vi - 1] = 1
+        ctx_n2 = sentence[vi - 2] if vi > 1 else "bos"
+        if vi > 1:
+            mark[vi - 2] = 1
+        mark[vi] = 1
+        ctx_0 = sentence[vi]
+        ctx_p1 = sentence[vi + 1] if vi < len(labels) - 1 else "eos"
+        if vi < len(labels) - 1:
+            mark[vi + 1] = 1
+        ctx_p2 = sentence[vi + 2] if vi < len(labels) - 2 else "eos"
+        if vi < len(labels) - 2:
+            mark[vi + 2] = 1
+        wd = self.word_dict
+        word_idx = [wd.get(w, UNK_IDX) for w in sentence]
+        return (np.array(word_idx),
+                np.array([wd.get(ctx_n2, UNK_IDX)] * n),
+                np.array([wd.get(ctx_n1, UNK_IDX)] * n),
+                np.array([wd.get(ctx_0, UNK_IDX)] * n),
+                np.array([wd.get(ctx_p1, UNK_IDX)] * n),
+                np.array([wd.get(ctx_p2, UNK_IDX)] * n),
+                np.array([self.predicate_dict.get(predicate)] * n),
+                np.array(mark),
+                np.array([self.label_dict.get(w) for w in labels]))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        return self.emb_file
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ml-1m ratings (reference: movielens.py:103): sample =
+    usr.value() + mov.value() + [[rating*2-5]]."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test"), mode
+        self.mode = mode
+        if data_file is None:
+            _no_download("Movielens", MOVIELENS_URL)
+        self.data_file = data_file
+        self.test_ratio = test_ratio
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.movie_title_dict = {}, {}
+        self.categories_dict, self.user_info = {}, {}
+        title_word_set, categories_set = set(), set()
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/movies.dat") as movie_file:
+                for line in movie_file:
+                    line = line.decode(encoding="latin")
+                    movie_id, title, categories = line.strip().split("::")
+                    categories = categories.split("|")
+                    categories_set.update(categories)
+                    title = pattern.match(title).group(1)
+                    self.movie_info[int(movie_id)] = MovieInfo(
+                        movie_id, categories, title)
+                    title_word_set.update(
+                        w.lower() for w in title.split())
+            for i, w in enumerate(title_word_set):
+                self.movie_title_dict[w] = i
+            for i, c in enumerate(categories_set):
+                self.categories_dict[c] = i
+            with package.open("ml-1m/users.dat") as user_file:
+                for line in user_file:
+                    line = line.decode(encoding="latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/ratings.dat") as rating_file:
+                for line in rating_file:
+                    line = line.decode(encoding="latin")
+                    if (np.random.random() < self.test_ratio) == is_test:
+                        uid, mov_id, rating, _ = line.strip().split("::")
+                        mov = self.movie_info[int(mov_id)]
+                        usr = self.user_info[int(uid)]
+                        self.data.append(
+                            usr.value()
+                            + mov.value(self.categories_dict,
+                                        self.movie_title_dict)
+                            + [[float(rating) * 2 - 5.0]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(Dataset):
+    """(reference: wmt14.py:46): tarball with */src.dict, */trg.dict and
+    {mode}/{mode} parallel files; <s> ... <e> framing, len>80 train
+    filter."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test", "gen"), mode
+        self.mode = mode
+        if data_file is None:
+            _no_download("WMT14", WMT14_URL)
+        self.data_file = data_file
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            d = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                d[line.strip().decode()] = i
+            return d
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            self.src_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            self.trg_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            suffix = f"{self.mode}/{self.mode}"
+            for name in [m.name for m in f if m.name.endswith(suffix)]:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_ids = [self.src_dict.get(w, UNK_IDX)
+                               for w in [START, *parts[0].split(), END]]
+                    trg = [self.trg_dict.get(w, UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src_ids) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids.append([self.trg_dict[START], *trg])
+                    self.trg_ids_next.append([*trg, self.trg_dict[END]])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """(reference: wmt16.py:46): en<->de from wmt16/{train,test,val};
+    dicts built from the train split by frequency with <s>/<e>/<unk>
+    heads (built in memory — the reference caches them to DATA_HOME)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        mode = mode.lower()
+        assert mode in ("train", "test", "val"), mode
+        assert lang in ("en", "de"), lang
+        if data_file is None:
+            _no_download("WMT16", WMT16_URL)
+        self.data_file = data_file
+        self.mode = mode
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict_size should be set as positive number"
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.src_dict = self._build_dict(lang, src_dict_size)
+        self.trg_dict = self._build_dict("de" if lang == "en" else "en",
+                                         trg_dict_size)
+        self._load_data()
+
+    def _build_dict(self, lang, dict_size):
+        counts = defaultdict(int)
+        col = 0 if lang == "en" else 1
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    counts[w] += 1
+        words = [START, END, UNK] + [
+            w for w, _ in sorted(counts.items(), key=lambda x: x[1],
+                                 reverse=True)[:max(0, dict_size - 3)]]
+        return {w: i for i, w in enumerate(words)}
+
+    def _load_data(self):
+        start_id = self.src_dict[START]
+        end_id = self.src_dict[END]
+        unk_id = self.src_dict[UNK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk_id)
+                       for w in parts[src_col].split()]
+                trg = [self.trg_dict.get(w, unk_id)
+                       for w in parts[trg_col].split()]
+                self.src_ids.append([start_id, *src, end_id])
+                self.trg_ids.append([start_id, *trg])
+                self.trg_ids_next.append([*trg, end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+__all__ = ["Conll05st", "Movielens", "WMT14", "WMT16"]

@@ -11,13 +11,15 @@ from ...models.lenet import LeNet  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (  # noqa: F401
-    MobileNetV1, MobileNetV2, MobileNetV3, mobilenet_v1, mobilenet_v2,
+    MobileNetV1, MobileNetV2, MobileNetV3, MobileNetV3Large,
+    MobileNetV3Small, mobilenet_v1, mobilenet_v2,
     mobilenet_v3_large, mobilenet_v3_small)
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264)
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .shufflenetv2 import (  # noqa: F401
-    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_swish, shufflenet_v2_x0_5,
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .ssdlite import SSDLite, ssd_match_targets  # noqa: F401

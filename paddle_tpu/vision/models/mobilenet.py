@@ -198,6 +198,22 @@ class MobileNetV3(nn.Layer):
         return x
 
 
+class MobileNetV3Large(MobileNetV3):
+    """(reference: vision/models/mobilenetv3.py MobileNetV3Large)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 1280, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    """(reference: vision/models/mobilenetv3.py MobileNetV3Small)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 1024, scale=scale,
+                         num_classes=num_classes, with_pool=with_pool)
+
+
 def mobilenet_v1(pretrained=False, scale=1.0, **kw):
     if pretrained:
         raise RuntimeError("pretrained weights unavailable (no egress)")
@@ -222,5 +238,6 @@ def mobilenet_v3_small(pretrained=False, scale=1.0, **kw):
     return MobileNetV3(_V3_SMALL, 1024, scale=scale, **kw)
 
 
-__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3", "mobilenet_v1",
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3",
+           "MobileNetV3Large", "MobileNetV3Small", "mobilenet_v1",
            "mobilenet_v2", "mobilenet_v3_large", "mobilenet_v3_small"]

@@ -7,6 +7,7 @@ from ... import nn
 
 _CFGS = {
     "x0_25": ([24, 24, 48, 96, 512], [4, 8, 4]),
+    "x0_33": ([24, 32, 64, 128, 512], [4, 8, 4]),
     "x0_5": ([24, 48, 96, 192, 1024], [4, 8, 4]),
     "x1_0": ([24, 116, 232, 464, 1024], [4, 8, 4]),
     "x1_5": ([24, 176, 352, 704, 1024], [4, 8, 4]),
@@ -15,29 +16,30 @@ _CFGS = {
 
 
 class _ShuffleUnit(nn.Layer):
-    def __init__(self, c_in, c_out, stride):
+    def __init__(self, c_in, c_out, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch = c_out // 2
+        Act = nn.Swish if act == "swish" else nn.ReLU
         if stride == 2:
             self.branch1 = nn.Sequential(
                 nn.Conv2D(c_in, c_in, 3, stride=2, padding=1, groups=c_in,
                           bias_attr=False),
                 nn.BatchNorm2D(c_in),
                 nn.Conv2D(c_in, branch, 1, bias_attr=False),
-                nn.BatchNorm2D(branch), nn.ReLU())
+                nn.BatchNorm2D(branch), Act())
             in2 = c_in
         else:
             self.branch1 = None
             in2 = c_in // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(in2, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU(),
+            nn.BatchNorm2D(branch), Act(),
             nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
                       groups=branch, bias_attr=False),
             nn.BatchNorm2D(branch),
             nn.Conv2D(branch, branch, 1, bias_attr=False),
-            nn.BatchNorm2D(branch), nn.ReLU())
+            nn.BatchNorm2D(branch), Act())
         self.shuffle = nn.ChannelShuffle(2)
 
     def forward(self, x):
@@ -55,20 +57,21 @@ class ShuffleNetV2(nn.Layer):
                  act="relu"):
         super().__init__()
         channels, repeats = _CFGS[scale]
+        Act = nn.Swish if act == "swish" else nn.ReLU
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(channels[0]), nn.ReLU())
+            nn.BatchNorm2D(channels[0]), Act())
         self.maxpool = nn.MaxPool2D(3, 2, padding=1)
         stages = []
         c_in = channels[0]
         for c_out, n in zip(channels[1:4], repeats):
             for i in range(n):
-                stages.append(_ShuffleUnit(c_in, c_out, 2 if i == 0 else 1))
+                stages.append(_ShuffleUnit(c_in, c_out, 2 if i == 0 else 1, act))
                 c_in = c_out
         self.stages = nn.Sequential(*stages)
         self.conv_last = nn.Sequential(
             nn.Conv2D(c_in, channels[4], 1, bias_attr=False),
-            nn.BatchNorm2D(channels[4]), nn.ReLU())
+            nn.BatchNorm2D(channels[4]), Act())
         self.with_pool = with_pool
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)
@@ -87,6 +90,12 @@ def shufflenet_v2_x0_25(pretrained=False, **kw):
     if pretrained:
         raise RuntimeError("pretrained weights unavailable (no egress)")
     return ShuffleNetV2("x0_25", **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x0_33", **kw)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
@@ -113,5 +122,11 @@ def shufflenet_v2_x2_0(pretrained=False, **kw):
     return ShuffleNetV2("x2_0", **kw)
 
 
-__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+def shufflenet_v2_swish(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable (no egress)")
+    return ShuffleNetV2("x1_0", act="swish", **kw)
+
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_swish", "shufflenet_v2_x0_5",
            "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]

@@ -255,7 +255,388 @@ class ColorJitter(BaseTransform):
         return np.clip(arr, 0, 255)
 
 
+
+
+# ---- functional API (reference: python/paddle/vision/transforms/
+# functional.py; geometric warps via inverse-map bilinear sampling) ----
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1].copy()
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_numpy(img)
+    return arr[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = img.numpy().astype(np.float32)
+    else:
+        arr = _to_numpy(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        out = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype(np.float32)
+    if arr.ndim == 2:
+        g = arr
+    else:
+        g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    g = g.astype(_to_numpy(img).dtype)
+    if num_output_channels == 3:
+        return np.stack([g, g, g], -1)
+    return g[..., None] if _to_numpy(img).ndim == 3 else g
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_numpy(img)
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0, 255)
+    return out.astype(arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_numpy(img)
+    gray_mean = to_grayscale(arr).mean()
+    out = np.clip(contrast_factor * arr.astype(np.float32)
+                  + (1 - contrast_factor) * gray_mean, 0, 255)
+    return out.astype(arr.dtype)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _to_numpy(img)
+    g = to_grayscale(arr, 3).astype(np.float32)
+    out = np.clip(saturation_factor * arr.astype(np.float32)
+                  + (1 - saturation_factor) * g, 0, 255)
+    return out.astype(arr.dtype)
+
+
+def _rgb_to_hsv(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    mx = rgb.max(-1)
+    mn = rgb.min(-1)
+    d = mx - mn
+    h = np.zeros_like(mx)
+    m = d > 0
+    rm = m & (mx == r)
+    gm = m & (mx == g) & ~rm
+    bm = m & ~rm & ~gm
+    h[rm] = ((g - b)[rm] / d[rm]) % 6
+    h[gm] = (b - r)[gm] / d[gm] + 2
+    h[bm] = (r - g)[bm] / d[bm] + 4
+    h = h / 6.0
+    s = np.where(mx > 0, d / np.maximum(mx, 1e-12), 0)
+    return h, s, mx
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    choices = [np.stack(c, -1) for c in
+               [(v, t, p), (q, v, p), (p, v, t),
+                (p, q, v), (t, p, v), (v, p, q)]]
+    out = np.select([ (i == k)[..., None] for k in range(6)], choices)
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_numpy(img)
+    if hue_factor == 0:
+        return arr
+    f = arr.astype(np.float32) / 255.0
+    h, s, v = _rgb_to_hsv(f)
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v) * 255.0
+    return np.clip(out, 0, 255).astype(arr.dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the region [i:i+h, j:j+w] with value v (reference:
+    functional.erase). Accepts Tensor/ndarray CHW or HWC ndarray/PIL."""
+    from ..core.tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = img._data
+        val = v._data if isinstance(v, Tensor) else v
+        arr = arr.at[..., i:i + h, j:j + w].set(val)
+        if inplace:
+            img._inplace_update(arr)
+            return img
+        return Tensor(arr)
+    arr = _to_numpy(img)
+    out = arr if inplace else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out
+
+
+def _inverse_map(arr, matrix, out_hw, fill, interpolation):
+    """Sample arr at coordinates mapped by the 3x3 inverse matrix."""
+    h, w = out_hw
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(ys)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float64)
+    src = matrix @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    # snap float residue (±1e-16 around integers) so exact rotations do
+    # not leak border pixels to the fill value
+    sx = np.where(np.abs(sx - np.round(sx)) < 1e-6, np.round(sx), sx)
+    sy = np.where(np.abs(sy - np.round(sy)) < 1e-6, np.round(sy), sy)
+    from scipy import ndimage
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}.get(
+        interpolation, 0)
+    chans = arr[..., None] if arr.ndim == 2 else arr
+    out = np.stack([
+        ndimage.map_coordinates(
+            chans[..., c].astype(np.float32), [sy, sx], order=order,
+            cval=float(fill if np.isscalar(fill) else fill[min(
+                c, len(fill) - 1)]), mode="constant").reshape(h, w)
+        for c in range(chans.shape[-1])], -1)
+    out = np.clip(out, 0, 255).astype(arr.dtype)
+    return out[..., 0] if arr.ndim == 2 else out
+
+
+def _affine_inverse_matrix(center, angle, translate, scale, shear):
+    import math
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in shear]
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) T(translate); build inverse
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    fwd = np.array([[a * scale, b * scale, 0.0],
+                    [c * scale, d * scale, 0.0],
+                    [0.0, 0.0, 1.0]])
+    pre = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1.0]])
+    post = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.0]])
+    return np.linalg.inv(pre @ fwd @ post)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """(reference: functional.affine). shear may be a scalar or (sx, sy)
+    degrees."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    c = center if center is not None else ((w - 1) * 0.5, (h - 1) * 0.5)
+    inv = _affine_inverse_matrix(c, angle, tuple(translate), scale, shear)
+    return _inverse_map(arr, inv, (h, w), fill, interpolation)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    c = center if center is not None else ((w - 1) * 0.5, (h - 1) * 0.5)
+    out_hw = (h, w)
+    if expand:
+        import math
+        rad = math.radians(angle)
+        nw = int(np.ceil(abs(w * math.cos(rad)) + abs(h * math.sin(rad))))
+        nh = int(np.ceil(abs(h * math.cos(rad)) + abs(w * math.sin(rad))))
+        out_hw = (nh, nw)
+        inv = _affine_inverse_matrix(
+            ((nw - 1) * 0.5, (nh - 1) * 0.5), angle, (0, 0), 1.0, (0, 0))
+        shift = np.array([[1, 0, c[0] - (nw - 1) * 0.5],
+                          [0, 1, c[1] - (nh - 1) * 0.5], [0, 0, 1.0]])
+        inv = shift @ inv
+        return _inverse_map(arr, inv, out_hw, fill, interpolation)
+    inv = _affine_inverse_matrix(c, angle, (0, 0), 1.0, (0, 0))
+    return _inverse_map(arr, inv, out_hw, fill, interpolation)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    # solve the 8-dof homography mapping endpoints -> startpoints
+    A = []
+    B = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B.extend([sx, sy])
+    coef = np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(B, np.float64))
+    return np.array([[coef[0], coef[1], coef[2]],
+                     [coef[3], coef[4], coef[5]],
+                     [coef[6], coef[7], 1.0]])
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    inv = _perspective_coeffs(startpoints, endpoints)
+    return _inverse_map(arr, inv, (h, w), fill, interpolation)
+
+
+# ---- random transform classes over the functional API ----
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        if self.__class__ is ContrastTransform and value < 0:
+            raise ValueError("contrast value should be non-negative")
+        self.value = value
+
+    def _apply_image(self, img):
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0 or value > 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if np.isscalar(degrees):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if np.isscalar(degrees):
+            degrees = (-degrees, degrees)
+        self.degrees, self.translate = degrees, translate
+        self.scale, self.shear = scale, shear
+        self.interpolation, self.fill, self.center =             interpolation, fill, center
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate is not None:
+            tr = (random.uniform(-self.translate[0], self.translate[0]) * w,
+                  random.uniform(-self.translate[1], self.translate[1]) * h)
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            s = self.shear
+            sh = (random.uniform(-s, s), 0.0) if np.isscalar(s) else                 (random.uniform(s[0], s[1]), 0.0)
+        return affine(arr, angle, tr, sc, sh, self.interpolation,
+                      self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob, self.distortion_scale = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return _to_numpy(img)
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(random.randint(0, hw), random.randint(0, hh)),
+               (w - 1 - random.randint(0, hw), random.randint(0, hh)),
+               (w - 1 - random.randint(0, hw), h - 1 - random.randint(0, hh)),
+               (random.randint(0, hw), h - 1 - random.randint(0, hh))]
+        return perspective(arr, start, end, self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        if scale[0] > scale[1] or ratio[0] > ratio[1]:
+            raise ValueError("scale/ratio ranges must be ordered")
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        import math
+        if random.random() >= self.prob:
+            return img
+        arr = _to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return erase(arr, i, j, eh, ew, self.value, self.inplace)
+        return arr
+
+
 __all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
            "RandomVerticalFlip", "RandomResizedCrop", "Transpose", "Pad",
-           "Grayscale", "BrightnessTransform", "ColorJitter"]
+           "Grayscale", "BrightnessTransform", "ColorJitter",
+           "SaturationTransform", "ContrastTransform", "HueTransform",
+           "RandomAffine", "RandomRotation", "RandomPerspective",
+           "RandomErasing",
+           "to_tensor", "hflip", "vflip", "resize", "pad", "crop",
+           "center_crop", "affine", "rotate", "perspective",
+           "to_grayscale", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "normalize", "erase"]
