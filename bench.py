@@ -107,11 +107,21 @@ def run_bench(config="llama_125m", progress=None):
         # ~1B-param config (TinyLlama-1.1B shape) with remat + bf16: the
         # arithmetic-intensity regime of the 13B north star, sized to one
         # v5e chip (fp32 AdamW states ~13 GB; activations remat'd).
+        # Flash attention is mandatory here, not a perf choice: the fp32
+        # AdamW states leave ~3.5 GB of HBM for program temps, and the
+        # naive composition's [b*h, s, s] scores alone need 7-14 GB
+        # (measured OOM: 26.5G required vs 15.75G). Engage the Pallas
+        # kernel at this seq len unless the caller already tuned it.
+        os.environ.setdefault("PADDLE_TPU_FLASH_THRESHOLD", "2048")
+        # tie_word_embeddings: still ~1.03B params (968M decoder + 66M
+        # embedding) and saves 750 MB of fp32 head param + AdamW moments —
+        # the margin that fits the step on one 16G chip.
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5632, num_hidden_layers=22,
                           num_attention_heads=32, num_key_value_heads=4,
                           max_position_embeddings=2048,
-                          loss_chunk_size=2048, remat=True)
+                          tie_word_embeddings=True,
+                          loss_chunk_size=512, remat=True)
         batch, seq, iters, reps = 1, 2048, 4, 2
     elif config == "llama_1b":
         # CPU CI stand-in: same code path (remat + chunked CE), tiny shape

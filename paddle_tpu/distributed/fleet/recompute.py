@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 
+from ...amp.auto_cast import amp_state as _amp_state
 from ...autograd.py_layer import PyLayer, PyLayerContext
 from ...core import autograd as _ag
 from ...core import random as _rng
@@ -31,6 +32,13 @@ class RecomputeFunction(PyLayer):
         ctx.preserve_rng_state = preserve_rng_state
         if preserve_rng_state:
             ctx.rng_state = _rng.get_rng_state()
+        # Snapshot AMP autocast state: backward() usually runs outside the
+        # user's auto_cast block, so the replay must re-enter the forward's
+        # AMP regime or every remat'd op recomputes in fp32 (the reference
+        # saves amp_level/amp_dtype/amp lists the same way —
+        # recompute.py:128 RecomputeFunction.forward -> amp_state()).
+        st = _amp_state()
+        ctx.amp = (st.enabled, st.dtype, st.level, st.white, st.black)
         ctx.inputs = args
         ctx.tensor_indices = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
         with no_grad():
@@ -50,10 +58,14 @@ class RecomputeFunction(PyLayer):
         if ctx.preserve_rng_state:
             saved = _rng.get_rng_state()
             _rng.set_rng_state(ctx.rng_state)
+        st = _amp_state()
+        saved_amp = (st.enabled, st.dtype, st.level, st.white, st.black)
+        (st.enabled, st.dtype, st.level, st.white, st.black) = ctx.amp
         try:
             with enable_grad():
                 out = ctx.fn(*detached)
         finally:
+            (st.enabled, st.dtype, st.level, st.white, st.black) = saved_amp
             if ctx.preserve_rng_state:
                 _rng.set_rng_state(saved)
         out_list = [out] if isinstance(out, Tensor) else [

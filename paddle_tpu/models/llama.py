@@ -33,6 +33,11 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # std of the N(0, std) weight init applied to every Linear/Embedding
+    # (reference: PaddleNLP LlamaConfig.initializer_range; keeps
+    # tied-embedding logits O(1) at init so the initial loss sits at
+    # ln(vocab))
+    initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     # >0: train-time loss uses the chunked fused matmul+CE head (full
@@ -184,17 +189,35 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+        self._init_weights(config.initializer_range)
+
+    def _init_weights(self, std):
+        """Llama init recipe: every Linear / Embedding weight ~ N(0, std)
+        (norm scales stay at ones). The layer defaults (Xavier / N(0,1))
+        are fine standalone but wrong jointly: a N(0,1) embedding through
+        a tied head produces O(sqrt(hidden)) logits at init."""
+        from ..nn.initializer import Normal
+
+        init = Normal(0.0, std)
+        for layer in self.sublayers(include_self=True):
+            w = getattr(layer, "weight", None)
+            if isinstance(layer, (nn.Linear, nn.Embedding)) and w is not None:
+                w._inplace_update(init(w.shape, w._data.dtype))
 
     def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
         h = self.model(input_ids, position_ids, attn_mask)
         if labels is not None and self.config.loss_chunk_size:
             # memory-efficient head: chunked matmul+CE, full logits never
-            # materialized (so no logits are returned on this path)
+            # materialized (so no logits are returned on this path).
+            # Causal shift (next-token objective, the reference/HF
+            # convention — position i predicts labels[i+1]): without it a
+            # tied-embedding model trivially "predicts" its own input via
+            # the residual stream and the loss collapses to ~0.
             w = (self.model.embed_tokens.weight if self.lm_head is None
                  else self.lm_head.weight)
             loss = F.fused_linear_cross_entropy(
-                h.reshape([-1, self.config.hidden_size]), w,
-                labels.reshape([-1]),
+                h[:, :-1].reshape([-1, self.config.hidden_size]), w,
+                labels[:, 1:].reshape([-1]),
                 chunk_size=self.config.loss_chunk_size,
                 transpose_weight=self.lm_head is None)
             return None, loss
@@ -204,9 +227,10 @@ class LlamaForCausalLM(nn.Layer):
             logits = self.lm_head(h)
         if labels is None:
             return logits
+        # same causal shift as the chunked path
         loss = F.cross_entropy(
-            logits.reshape([-1, self.config.vocab_size]),
-            labels.reshape([-1]), reduction="mean")
+            logits[:, :-1].reshape([-1, self.config.vocab_size]),
+            labels[:, 1:].reshape([-1]), reduction="mean")
         return logits, loss
 
     def flops_per_token(self, seq_len):

@@ -172,6 +172,25 @@ def test_fused_linear_cross_entropy_parity():
                                float(full_pad.numpy()), rtol=1e-5)
 
 
+def test_llama_tied_embeddings_causal_shift():
+    # Without the causal label shift, a tied-embedding model "predicts" its
+    # own input through the residual stream and the loss collapses to ~0
+    # (the bug the first 1B TPU bench run surfaced). At init the shifted
+    # loss must sit near ln(vocab) for tied and untied alike, on both the
+    # chunked and full-logits paths.
+    for chunk in (0, 16):
+        cfg = llama_tiny_config()
+        cfg.tie_word_embeddings = True
+        cfg.loss_chunk_size = chunk
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.default_rng(7).integers(0, cfg.vocab_size, (2, 32)),
+            dtype="int64")
+        _, loss = m(ids, labels=ids)
+        assert abs(float(loss.numpy()) - np.log(cfg.vocab_size)) < 1.0, \
+            (chunk, float(loss.numpy()))
+
+
 @pytest.mark.slow
 def test_llama_chunked_loss_path():
     cfg = llama_tiny_config()

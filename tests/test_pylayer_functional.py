@@ -127,6 +127,37 @@ def test_recompute_closed_over_params_only():
     assert np.isfinite(lin.weight.grad.numpy()).all()
 
 
+def test_recompute_replay_restores_amp_state():
+    # loss.backward() runs outside the user's auto_cast block; the replay
+    # must re-enter the forward's AMP regime or remat'd ops recompute in
+    # fp32 (the exact bug that OOM'd the 1B bench: f32 [b*h, s, s] scores).
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.dispatch import OPS
+
+    seen = []
+    inner = OPS["matmul"]
+
+    def spy(a, b, *rest, **kw):
+        seen.append(jnp.result_type(a))
+        return inner(a, b, *rest, **kw)
+
+    w = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32),
+                         stop_gradient=False)
+    OPS["matmul"] = spy
+    try:
+        with paddle.amp.auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            h = recompute(lambda t: paddle.matmul(t, w).tanh(), x)
+        (h.astype("float32") ** 2).mean().backward()  # replay happens here
+    finally:
+        OPS["matmul"] = inner
+    assert len(seen) == 2, seen  # forward + replay
+    assert all(d == jnp.bfloat16 for d in seen), seen
+    assert w.grad is not None
+
+
 def test_jacobian_multi_output():
     x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
     j1, j2 = jacobian(lambda t: (t * t, t + 1), x)
