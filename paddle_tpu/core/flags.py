@@ -328,5 +328,27 @@ define_flag("executor_log_deps_every_microseconds", int, 0,
             "periodic native work-queue stats logging interval")
 define_flag("print_ir", bool, False,
             "print the StableHLO of compiled programs at compile time")
+
+# ---- round-4 continuation: remaining TPU-meaningful reference flags,
+# each wired to observable behavior (tests/test_flags_behavior.py) ----
+define_flag("enable_fusion_fallback", bool, True,
+            "a failing fused (Pallas) kernel falls back to the composed "
+            "XLA body instead of raising (reference enable_fusion_fallback)")
+define_flag("flash_attn_version", int, 2,
+            "1: pin the composed XLA attention (no flash tier); "
+            "2: allow the Pallas flash kernel tier (default)")
+define_flag("enable_cinn_accuracy_check", bool, False,
+            "after the first compiled TrainStep, recompute the loss "
+            "through the eager engine and compare within the "
+            "accuracy_check_* tolerances (reference "
+            "enable_cinn_accuracy_check)")
+define_flag("enable_collect_shape", bool, False,
+            "inference Predictor records the shape of every input it "
+            "sees (reference collect-shape-range pass input)")
+define_flag("logging_trunc_pir_py_code", bool, True,
+            "truncate oversized jaxpr dump files (64 KB) written under "
+            "FLAGS_logging_pir_py_code_dir")
+define_flag("logging_pir_py_code_int_tensor_element_limit", int, 16,
+            "max tensor elements rendered per constant in jaxpr dumps")
 define_flag("apply_pass_to_program", bool, False,
             "advisory: XLA owns the pass pipeline")

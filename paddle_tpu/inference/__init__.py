@@ -125,6 +125,22 @@ class Predictor:
             arrays = [np.asarray(a) for a in inputs]
         else:
             arrays = [t._value for t in self._inputs]
+        from ..core.flags import GLOBAL_FLAGS
+        if GLOBAL_FLAGS.get("enable_collect_shape"):
+            # FLAGS_enable_collect_shape (the reference's shape-range
+            # collection pass input): record every DISTINCT input-shape
+            # tuple seen so a deployment can derive min/max/opt shapes from
+            # real traffic. Deduplicated (a serving process sees millions
+            # of repeats) and bounded as a backstop.
+            rec = getattr(self, "_collected_shapes", None)
+            if rec is None:
+                rec = self._collected_shapes = []
+                self._collected_shape_set = set()
+            sig = tuple(tuple(a.shape) for a in arrays)
+            if sig not in self._collected_shape_set \
+                    and len(rec) < (1 << 16):
+                self._collected_shape_set.add(sig)
+                rec.append(sig)
         if self._profile:
             from ..profiler import RecordEvent
             with RecordEvent("predictor.run"):
@@ -141,6 +157,11 @@ class Predictor:
             self._outputs.append(h)
             results.append(np.asarray(h._value))
         return results
+
+    def collected_shapes(self):
+        """Input-shape tuples recorded while FLAGS_enable_collect_shape
+        was on (empty list when collection never ran)."""
+        return list(getattr(self, "_collected_shapes", []))
 
 
 class ServingSession:

@@ -309,8 +309,30 @@ class StaticFunction:
             name = getattr(self._fn, "__name__", "fn")
             path = os.path.join(
                 out_dir, f"{name}_{abs(hash(key)) & 0xFFFFFFFF:08x}.jaxpr")
+            # jaxpr text renders constants as names only; append a consts
+            # section so the dump is self-contained, with
+            # FLAGS_logging_pir_py_code_int_tensor_element_limit bounding
+            # how many elements each constant renders.
+            # FLAGS_logging_trunc_pir_py_code caps the dump file itself.
+            import numpy as _np
+            limit = int(GLOBAL_FLAGS.get(
+                "logging_pir_py_code_int_tensor_element_limit"))
+            text = str(jaxpr)
+            if getattr(jaxpr, "consts", None):
+                lines = ["", "consts:"]
+                for i, c in enumerate(jaxpr.consts):
+                    a = _np.asarray(c)
+                    body = _np.array2string(
+                        a, threshold=max(limit, 1),
+                        edgeitems=max(limit // 2, 1))
+                    lines.append(f"  c{i}: {a.dtype}{list(a.shape)} = {body}")
+                text += "\n".join(lines) + "\n"
+            if GLOBAL_FLAGS.get("logging_trunc_pir_py_code") \
+                    and len(text) > 65536:
+                text = text[:65536] + "\n... [truncated by " \
+                    "FLAGS_logging_trunc_pir_py_code]\n"
             with open(path, "w") as f:
-                f.write(str(jaxpr))
+                f.write(text)
         except Exception:
             pass  # a debug dump must never break the compile path
 
@@ -475,10 +497,33 @@ class TrainStep:
         buffer_arrays = {f"b:{k}": v._data for k, v in buffers.items()}
         lr = self.optimizer.get_lr()
         step_in = self.optimizer._step_count  # inside-trace step() adds 1
+        rng_key = _rng.next_key()
+        eager_loss = None
+        if GLOBAL_FLAGS.get("enable_cinn_accuracy_check") \
+                and key not in getattr(self, "_accuracy_checked", set()):
+            # FLAGS_enable_cinn_accuracy_check (reference flags.cc): once
+            # per compiled specialization, recompute the loss through the
+            # EAGER engine on the same params + rng key and compare within
+            # the accuracy_check_* tolerances — catches a compiled-path
+            # lowering that silently diverges from eager. Runs BEFORE the
+            # compiled call: on TPU the compiled step donates the param /
+            # opt-state buffers, so reading them afterwards would hit
+            # deleted arrays. Buffer bindings mutated by the eager forward
+            # (e.g. running stats) are restored — the compiled step's
+            # updates are the ones that count.
+            self._accuracy_checked = getattr(self, "_accuracy_checked", set())
+            self._accuracy_checked.add(key)
+            saved_buf = {k: t._data for k, t in buffers.items()}
+            try:
+                with _rng.capture_rng(rng_key):
+                    eager_loss = float(self.loss_fn(*batch).numpy())
+            finally:
+                for k, t in buffers.items():
+                    t._data = saved_buf[k]
         out = self._cache[key](
             param_arrays, opt_arrays, buffer_arrays,
             jnp.asarray(step_in, jnp.int32),
-            jnp.asarray(lr, jnp.float32), _rng.next_key(), *batch_arrays)
+            jnp.asarray(lr, jnp.float32), rng_key, *batch_arrays)
         if check_finite:
             new_p, new_o, new_b, loss, finite = out
             if not bool(finite):
@@ -487,6 +532,18 @@ class TrainStep:
                     f"{self.optimizer._step_count} (FLAGS_check_nan_inf)")
         else:
             new_p, new_o, new_b, loss = out
+        if eager_loss is not None:
+            compiled_loss = float(jnp.asarray(loss))
+            # no `or`-defaults: an explicit 0 tolerance must stay 0
+            rtol = float(GLOBAL_FLAGS.get("accuracy_check_rtol_fp32"))
+            atol = float(GLOBAL_FLAGS.get("accuracy_check_atol_fp32"))
+            self.last_accuracy_check = {
+                "eager": eager_loss, "compiled": compiled_loss}
+            if abs(eager_loss - compiled_loss) > atol + rtol * abs(eager_loss):
+                raise FloatingPointError(
+                    f"compiled/eager loss mismatch (FLAGS_enable_cinn_"
+                    f"accuracy_check): eager {eager_loss} vs compiled "
+                    f"{compiled_loss} (rtol {rtol}, atol {atol})")
         self.optimizer._step_count += 1
         for k, p in self._params.items():
             p._data = new_p[k]

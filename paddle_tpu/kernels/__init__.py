@@ -84,16 +84,32 @@ def install():
                 thresh = int(flag) if flag is not None else 8192
         # Pallas path: no arbitrary mask, no dropout, seq long enough to
         # beat the fused XLA composition.
-        if use_pallas and attn_mask is None and dropout_p == 0.0 \
-                and q.shape[1] >= thresh \
+        from ..core.flags import GLOBAL_FLAGS
+        # FLAGS_flash_attn_version: 1 pins the composed XLA body (the
+        # reference's FA1/FA2 selector; here "1" = no flash tier), 2 = the
+        # Pallas flash kernel tier (default).
+        _ver = GLOBAL_FLAGS.get("flash_attn_version")
+        version_ok = int(_ver if _ver is not None else 2) >= 2
+        if use_pallas and version_ok and attn_mask is None \
+                and dropout_p == 0.0 and q.shape[1] >= thresh \
                 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0:
             import jax.numpy as jnp
             qh = jnp.swapaxes(q, 1, 2)  # paddle [b,s,h,d] -> kernel [b,h,s,d]
             kh = jnp.swapaxes(k, 1, 2)
             vh = jnp.swapaxes(v, 1, 2)
-            out = pallas_flash_attention(qh, kh, vh, causal=causal,
-                                         scale=scale, interpret=interpret)
-            return jnp.swapaxes(out, 1, 2)
+            try:
+                out = pallas_flash_attention(qh, kh, vh, causal=causal,
+                                             scale=scale, interpret=interpret)
+                return jnp.swapaxes(out, 1, 2)
+            except Exception:
+                # FLAGS_enable_fusion_fallback (reference flags.cc): a
+                # failing fused kernel falls back to the composed body
+                # instead of killing the step; off = surface the error.
+                if not GLOBAL_FLAGS.get("enable_fusion_fallback"):
+                    raise
+                from ..core.vlog import vlog
+                vlog(0, "pallas flash_attention failed; falling back to "
+                        "the XLA composition (FLAGS_enable_fusion_fallback)")
         return _sdpa_reference(q, k, v, *rest, causal=causal,
                                dropout_p=dropout_p, scale=scale,
                                dropout_key=dropout_key)

@@ -111,10 +111,10 @@ class LlamaAttention(nn.Layer):
         v = self.v_proj(hidden_states).reshape([b, s, self.num_kv_heads, hd])
         q, k = apply_rotary_pos_emb(q, k, position_ids, self.config.rope_theta,
                                     rope_cs)
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = T.repeat_interleave(k, rep, axis=2)
-            v = T.repeat_interleave(v, rep, axis=2)
+        # GQA k/v go to attention with their native head count — both the
+        # composed SDPA body and the Pallas flash kernel pair query head j
+        # with kv head j // group internally, so the repeated [b, s, hq, d]
+        # k/v copies never hit HBM.
         # Causal LM: the causal mask always applies; attn_mask (e.g. padding)
         # is merged on top, never a replacement for it.
         if self.config.use_flash_attention and attn_mask is None:

@@ -22,30 +22,50 @@ def _sdpa_reference(q, k, v, *rest, causal=False, dropout_p=0.0, scale=None,
                     dropout_key=None, return_probs=False):
     """Pure attention body. q,k,v: [batch, seq, heads, head_dim] (paddle layout).
 
-    ``return_probs=True`` additionally returns the [b, h, sq, sk] softmax
+    GQA-native: k/v may carry fewer heads (hq % hkv == 0); query head j
+    reads kv head j // (hq // hkv) — the grouped einsum never materializes
+    the repeated k/v (the [b, s, hq, d] copies are 8x the k/v HBM traffic
+    at 32/4 GQA, reference convention: flash_attn_kernel.cu GQA path).
+
+    ``return_probs=True`` additionally returns the [b, hq, sq, sk] softmax
     actually used for the output (post-dropout, like the reference kernels'
     saved softmax) — the (out, probs) pair is always consistent."""
     attn_mask = rest[0] if rest else None
-    qh = jnp.swapaxes(q, 1, 2)  # [b, h, s, d]
-    kh = jnp.swapaxes(k, 1, 2)
+    qh = jnp.swapaxes(q, 1, 2)  # [b, hq, s, d]
+    kh = jnp.swapaxes(k, 1, 2)  # [b, hkv, s, d]
     vh = jnp.swapaxes(v, 1, 2)
-    d = q.shape[-1]
+    b, hq, sq, d = qh.shape
+    hkv, sk = kh.shape[1], kh.shape[2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    grouped = hq != hkv
+    if grouped:
+        g = hq // hkv
+        qh = qh.reshape(b, hkv, g, sq, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", qh, kh) * s
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
     if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     if attn_mask is not None:
-        if attn_mask.dtype == jnp.bool_:
-            logits = jnp.where(attn_mask, logits, jnp.finfo(logits.dtype).min)
+        if grouped:  # mask is [.., hq, sq, sk]-broadcastable; view as groups
+            am = jnp.broadcast_to(
+                attn_mask, (b, hq, sq, sk)).reshape(b, hkv, g, sq, sk)
         else:
-            logits = logits + attn_mask
+            am = attn_mask
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(am, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + am
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and dropout_key is not None:
         keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    if grouped:
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, vh).reshape(b, hq, sq, d)
+        probs = probs.reshape(b, hq, sq, sk)
+    else:
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     out = jnp.swapaxes(out, 1, 2)  # back to [b, s, h, d]
     if return_probs:
         return out, probs

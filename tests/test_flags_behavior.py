@@ -388,3 +388,141 @@ def test_infra_surface():
         warnings.simplefilter("always")
         assert legacy() == 1
     assert any(issubclass(x.category, DeprecationWarning) for x in w)
+
+
+def test_enable_fusion_fallback(flag_restorer, monkeypatch):
+    """A raising Pallas kernel falls back to the composed body when the
+    flag is on, and surfaces the error when it is off."""
+    import paddle_tpu.kernels as K
+    from paddle_tpu.core.dispatch import OPS
+    import paddle_tpu.nn.functional as F
+
+    def boom(*a, **kw):
+        raise RuntimeError("mosaic exploded")
+
+    monkeypatch.setattr(K, "pallas_flash_attention", boom)
+    monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_THRESHOLD", "128")
+    q = paddle.randn([1, 128, 2, 16])
+
+    flag_restorer("enable_fusion_fallback", True)
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 128, 2, 16]  # composed body answered
+
+    flag_restorer("enable_fusion_fallback", False)
+    with pytest.raises(RuntimeError, match="mosaic exploded"):
+        F.scaled_dot_product_attention(q, q, q, is_causal=True)
+
+
+def test_flash_attn_version_pins_composed_body(flag_restorer, monkeypatch):
+    """flash_attn_version=1 keeps attention on the composed XLA body even
+    where the Pallas tier would engage."""
+    import paddle_tpu.kernels as K
+    import paddle_tpu.nn.functional as F
+
+    calls = []
+    real = K.pallas_flash_attention
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(K, "pallas_flash_attention", spy)
+    monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_THRESHOLD", "128")
+    q = paddle.randn([1, 128, 2, 16])
+
+    flag_restorer("flash_attn_version", 1)
+    F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert not calls  # pinned to the composed body
+
+    flag_restorer("flash_attn_version", 2)
+    F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert calls  # Pallas tier engaged (interpret mode on CPU)
+
+
+def test_enable_cinn_accuracy_check(flag_restorer):
+    """The first compiled TrainStep per specialization is cross-checked
+    against the eager engine; a poisoned eager path is caught."""
+    from paddle_tpu.core.dispatch import OPS
+
+    flag_restorer("enable_cinn_accuracy_check", True)
+    net = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = paddle.jit.TrainStep(
+        net, lambda x: (net(x) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    loss = step(x)
+    chk = step.last_accuracy_check
+    assert abs(chk["eager"] - chk["compiled"]) <= 1e-5 + 1e-3 * abs(chk["eager"])
+
+    # compile a second specialization with the check OFF, then poison the
+    # eager path and turn the check on: its first checked call re-derives
+    # the loss eagerly (poisoned) against the already-compiled executable
+    # (clean) -> mismatch must raise
+    flag_restorer("enable_cinn_accuracy_check", False)
+    x2 = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    step(x2)
+    inner = OPS["linear"]
+    OPS["linear"] = lambda *a, **kw: inner(*a, **kw) * 0 + 7.0
+    try:
+        flag_restorer("enable_cinn_accuracy_check", True)
+        with pytest.raises(FloatingPointError, match="accuracy_check"):
+            step(x2)
+    finally:
+        OPS["linear"] = inner
+
+
+def test_enable_collect_shape(flag_restorer, tmp_path):
+    """Predictor records input shapes while the flag is on."""
+    import paddle_tpu.inference as infer
+
+    from paddle_tpu.jit.save_load import InputSpec
+    net = paddle.nn.Linear(3, 2)
+    prefix = str(tmp_path / "lin")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([None, 3], "float32")])
+    pred = infer.create_predictor(infer.Config(prefix))
+    flag_restorer("enable_collect_shape", True)
+    pred.run([np.zeros((2, 3), np.float32)])
+    pred.run([np.zeros((5, 3), np.float32)])
+    assert pred.collected_shapes() == [(((2, 3),)), (((5, 3),))]
+    flag_restorer("enable_collect_shape", False)
+    pred.run([np.zeros((7, 3), np.float32)])
+    assert len(pred.collected_shapes()) == 2
+
+
+def test_logging_pir_py_code_truncation(flag_restorer, tmp_path):
+    """Dump files respect the element limit and the 64KB truncation."""
+    flag_restorer("logging_pir_py_code_dir", str(tmp_path))
+    flag_restorer("logging_trunc_pir_py_code", True)
+    flag_restorer("logging_pir_py_code_int_tensor_element_limit", 4)
+
+    big = paddle.to_tensor(np.arange(4096, dtype=np.float32))
+
+    @paddle.jit.to_static
+    def f(x):
+        return (x * big).sum()
+
+    f(paddle.ones([4096]))
+    dumps = list(tmp_path.glob("*.jaxpr"))
+    assert dumps, "no jaxpr dump written"
+    text = dumps[0].read_text()
+    assert len(text) <= 65536 + 200
+    # consts are dumped, but the 4096-element constant is elided at limit
+    # 4 (summarized head ... tail; a middle element never renders)
+    assert "consts:" in text
+    assert "..." in text.split("consts:")[1]
+    assert "2.000e+03" not in text and "2000." not in text
+
+    # a generous limit renders the tail element — the flag has teeth
+    flag_restorer("logging_pir_py_code_int_tensor_element_limit", 100000)
+
+    @paddle.jit.to_static
+    def g(x):
+        return (x + big).sum()
+
+    g(paddle.ones([4096]))
+    texts = [d.read_text() for d in tmp_path.glob("*.jaxpr")]
+    assert any("2.000e+03" in t or "2000." in t for t in texts)

@@ -163,6 +163,38 @@ class TestFunctional:
         v0 = q.numpy()[:, 0]
         np.testing.assert_allclose(out.numpy()[:, 0], v0, rtol=1e-4, atol=1e-5)
 
+    def test_attention_gqa_native_matches_repeated(self):
+        # grouped-query k/v pass through with their native head count;
+        # parity against explicitly repeated k/v (the pairing convention:
+        # query head j reads kv head j // group), incl. grad and masks
+        rng = np.random.default_rng(3)
+        q = paddle.to_tensor(rng.standard_normal((2, 6, 8, 16)).astype("float32"),
+                             stop_gradient=False)
+        k = paddle.to_tensor(rng.standard_normal((2, 6, 2, 16)).astype("float32"),
+                             stop_gradient=False)
+        v = paddle.to_tensor(rng.standard_normal((2, 6, 2, 16)).astype("float32"),
+                             stop_gradient=False)
+        import paddle_tpu.tensor as T
+        kr = T.repeat_interleave(k.detach(), 4, axis=2)
+        kr.stop_gradient = False
+        vr = T.repeat_interleave(v.detach(), 4, axis=2)
+        vr.stop_gradient = False
+        for mask in (None,
+                     paddle.to_tensor(
+                         rng.standard_normal((2, 1, 6, 6)).astype("float32"))):
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                                 is_causal=True)
+            ref = F.scaled_dot_product_attention(q, kr, vr, attn_mask=mask,
+                                                 is_causal=True)
+            np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+        out.sum().backward()
+        ref.sum().backward()
+        np.testing.assert_allclose(
+            k.grad.numpy(),
+            kr.grad.numpy().reshape(2, 6, 2, 4, 16).sum(3), rtol=1e-4,
+            atol=1e-5)
+
     def test_interpolate(self):
         x = paddle.randn([1, 2, 4, 4])
         assert F.interpolate(x, scale_factor=2, mode="nearest").shape == [1, 2, 8, 8]

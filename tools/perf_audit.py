@@ -170,6 +170,48 @@ def attention_paths():
         except Exception as e:
             row["pallas_error"] = str(e)[:80]
         res.append(row)
+
+    # 1B-config TRAINING shapes (fwd+bwd, GQA-native k/v, b=1 s=2048):
+    # the regime the llama_1b bench runs in. Chained through dq (same
+    # shape as q) so the relay cannot elide the backward.
+    for (h, hkv, d) in ((32, 4, 64), (16, 4, 128)):
+        s = 2048
+        q = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (1, h, s, d)) * 0.1, jnp.bfloat16)
+        kv = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (1, hkv, s, d)) * 0.1, jnp.bfloat16)
+        g = h // hkv
+
+        def gqa_sdpa(q, kv=kv, g=g, s=s, d=d, hkv=hkv):
+            qg = q.reshape(1, hkv, g, s, d)
+            logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kv) / (d ** 0.5)
+            m = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(m, logits, -1e9).astype(jnp.float32)
+            p = jax.nn.softmax(logits, -1).astype(q.dtype)
+            return jnp.einsum("bhgqk,bhkd->bhgqd", p, kv).reshape(q.shape)
+
+        def fwdbwd(fn):
+            return jax.grad(lambda q: jnp.sum(fn(q).astype(jnp.float32)))
+
+        def marginal2(fn):
+            t3 = timed_device(fn, q, iters=3) * 3
+            t13 = timed_device(fn, q, iters=13) * 13
+            return (t13 - t3) / 10
+
+        row = {"train_shape": f"b1 h{h} hkv{hkv} s{s} d{d}"}
+        try:
+            row["xla_fwdbwd_ms"] = round(marginal2(fwdbwd(gqa_sdpa)) * 1e3, 2)
+        except Exception as e:
+            row["xla_error"] = str(e)[:80]
+        for bq, bk in ((128, 128), (256, 512), (512, 512)):
+            try:
+                t = marginal2(fwdbwd(
+                    lambda q, bq=bq, bk=bk: flash_attention(
+                        q, kv, kv, causal=True, block_q=bq, block_k=bk)))
+                row[f"pallas_{bq}x{bk}_fwdbwd_ms"] = round(t * 1e3, 2)
+            except Exception as e:
+                row[f"pallas_{bq}x{bk}_error"] = str(e)[:80]
+        res.append(row)
     return res
 
 
