@@ -129,14 +129,21 @@ class CUDAPinnedPlace(_place_mod.CPUPlace):
 
 
 def disable_static(place=None):
+    """Leave static-graph build mode (reference: paddle.disable_static)."""
+    from .static.program import enable_static_mode
+    enable_static_mode(False)
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for the "
-        "compiled path (the analog of static graphs on XLA)")
+    """Enter static-graph build mode (reference: paddle.enable_static):
+    ops over ``static.data`` Variables record into the current Program;
+    ``static.Executor.run`` replays them with feeds. The compiled perf
+    path remains ``paddle_tpu.jit.to_static`` (trace-once over XLA)."""
+    from .static.program import enable_static_mode
+    enable_static_mode(True)
 
 
 def in_dynamic_mode():
-    return True
+    from .static.program import in_static_mode
+    return not in_static_mode()

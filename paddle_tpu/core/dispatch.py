@@ -233,6 +233,11 @@ def op_body(name: str):
     return deco
 
 
+# Set by static.program.enable_static_mode (avoids an import cycle and
+# keeps the dynamic-mode hot path to one None check).
+_static_state = None
+
+
 def op_call(op_name: str, default_fn, *args, **kwargs):
     """Registry-routed op execution (the analog of the reference's kernel
     dispatch, phi/core/kernel_factory.h:58 KernelFactory::SelectKernel).
@@ -249,6 +254,13 @@ def op_call(op_name: str, default_fn, *args, **kwargs):
     body = OPS.get(op_name)
     if body is None:
         OPS[op_name] = body = default_fn
+    if _static_state is not None and _static_state.static_mode:
+        # static-graph build (paddle.enable_static): ops over symbolic
+        # Variables record into the current Program instead of executing
+        from ..static.program import maybe_record, _NOT_RECORDED
+        rec = maybe_record(op_name, body, default_fn, args, kwargs)
+        if rec is not _NOT_RECORDED:
+            return rec
     try:
         return eager_apply(op_name, body, args, kwargs)
     except NotImplementedError:

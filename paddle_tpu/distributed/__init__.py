@@ -28,6 +28,7 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .expert_parallel import moe_alltoall  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from .spawn import spawn, wait  # noqa: F401
 from .elastic import ElasticManager, HealthMonitor  # noqa: F401
 from . import launch  # noqa: F401
 from . import rpc  # noqa: F401

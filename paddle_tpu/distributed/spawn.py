@@ -1,0 +1,95 @@
+"""paddle.distributed.spawn — multi-process launcher as a Python API.
+
+Reference: python/paddle/distributed/spawn.py:394 (spawn) — launches
+``nprocs`` copies of ``func`` with the distributed env prepared, joins
+them, and surfaces the first failure. On this stack each process becomes
+one JAX distributed process (collective.init_parallel_env reads the same
+env the launch CLI sets): process 0 hosts the coordination service.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import traceback
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_entry(func, args, rank, nprocs, port, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["PADDLE_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["PADDLE_TPU_PROCESS_ID"] = str(rank)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    try:
+        func(*args)
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)
+
+
+class SpawnContext:
+    """Holds the spawned processes (reference returns MultiprocessContext)."""
+
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        failed = [p for p in self.processes if p.exitcode not in (0, None)]
+        if failed:
+            codes = {p.pid: p.exitcode for p in failed}
+            raise RuntimeError(
+                f"spawn: {len(failed)}/{len(self.processes)} processes "
+                f"failed (pid -> exitcode: {codes})")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func(*args)`` in ``nprocs`` distributed processes.
+
+    ``func`` must be picklable (module-level). Extra env for the children
+    can be passed via ``options['env']``; ``options['start_method']``
+    selects the multiprocessing context (default ``spawn``, the only safe
+    choice once a JAX backend may be initialized in the parent).
+    """
+    import multiprocessing as mp
+
+    if nprocs < 1:
+        try:
+            import jax
+            nprocs = max(1, len(jax.devices()))
+        except Exception:
+            nprocs = 1
+    ctx = mp.get_context(options.get("start_method", "spawn"))
+    port = _free_port()
+    env = dict(options.get("env") or {})
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, tuple(args), rank, nprocs, port, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+    return context
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until ``tensor``'s pending work is complete (reference:
+    communication/wait.py — stream sync; PJRT analog: block_until_ready)."""
+    data = getattr(tensor, "_data", tensor)
+    try:
+        data.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor

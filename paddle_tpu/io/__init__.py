@@ -308,6 +308,38 @@ class _WorkerError:
         self.exc = exc
 
 
+def _prefetch_to_device(it, size, device=None):
+    """Async device prefetch over a batch iterator.
+
+    Each Tensor leaf is re-homed with ``jax.device_put`` (an async dispatch
+    under PJRT) and up to ``size`` batches stay in flight, so the H2D copy
+    of batch N+1 runs while the model computes on batch N. Non-Tensor
+    leaves (labels kept as numpy, metadata) pass through untouched.
+
+    With ``device=None`` the transfer targets the default device but the
+    result stays UNCOMMITTED — multi-device programs (sharded params,
+    Layer.to elsewhere) keep their placement freedom; passing an explicit
+    DataLoader ``places`` commits batches there.
+    """
+    import collections
+
+    import jax
+
+    def put(batch):
+        return jax.tree.map(
+            lambda x: Tensor(jax.device_put(x._data, device))
+            if isinstance(x, Tensor) else x,
+            batch, is_leaf=lambda x: isinstance(x, Tensor))
+
+    buf = collections.deque()
+    for b in it:
+        buf.append(put(b))
+        if len(buf) > size:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()
+
+
 class DataLoader:
     """Prefetching loader (reference: python/paddle/io/reader.py:262;
     worker processes python/paddle/io/dataloader/worker.py).
@@ -335,6 +367,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self._places = (list(places) if isinstance(places, (list, tuple))
+                        else ([places] if places is not None else []))
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
@@ -394,6 +428,33 @@ class DataLoader:
                     yield first
             for _ in range(n - 1):
                 yield first
+            return
+        if self.use_buffer_reader:
+            # reference: DataLoader(use_buffer_reader=True) double-buffers
+            # batches onto the device through an async queue
+            # (python/paddle/io/reader.py:170 — buffered reader over
+            # places). TPU-native form: jax.device_put dispatches the H2D
+            # copy asynchronously, so keeping a small deque of in-flight
+            # batches overlaps input transfer with the current step's
+            # compute instead of paying it on the step's critical path.
+            # Without explicit ``places`` the batches stay uncommitted
+            # (multi-device programs keep placement freedom).
+            dev = None
+            if self._places:
+                import jax
+
+                from ..core.tensor import _as_place
+                first = self._places[0]
+                if isinstance(first, jax.Device):
+                    dev = first
+                else:
+                    try:
+                        dev = _as_place(first).jax_device()
+                    except Exception:
+                        dev = None
+            yield from _prefetch_to_device(
+                self._real_iter(), max(2, min(self.prefetch_factor, 4)),
+                device=dev)
             return
         yield from self._real_iter()
 

@@ -112,6 +112,14 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable, current_program, in_static_mode
+        if in_static_mode() and isinstance(loss, Variable):
+            # static-graph training (reference: Optimizer.minimize appends
+            # backward + update ops to the Program): record the intent;
+            # Executor.run replays forward then drives the eager tape
+            # backward and applies this optimizer.
+            current_program()._minimize = (self, loss)
+            return None, None
         loss.backward()
         self.step()
         return None, None

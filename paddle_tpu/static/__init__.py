@@ -45,5 +45,15 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
 # (reference: python/paddle/static/nn/control_flow.py)
 from . import control_flow as nn  # noqa: E402,F401
 
+# Program / Executor world (reference: python/paddle/static/__init__.py)
+from .program import (  # noqa: E402,F401
+    Program, Executor, Variable, program_guard, data,
+    default_main_program, default_startup_program, global_scope,
+    scope_guard, Scope, cpu_places, save, load,
+)
+
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
-           "nn"]
+           "nn", "Program", "Executor", "Variable", "program_guard",
+           "data", "default_main_program", "default_startup_program",
+           "global_scope", "scope_guard", "Scope", "cpu_places", "save",
+           "load"]

@@ -1,0 +1,407 @@
+"""Program / Executor — the reference's static-graph surface.
+
+Reference: python/paddle/static/ (Program `base/framework.py:5940`,
+Executor `base/executor.py:812`, `static.data` `static/input.py:30`,
+program_guard `base/framework.py:7450`). On this stack a Program is a
+recorded op list: under static mode, any op touching a symbolic
+``Variable`` is captured at the dispatch layer (core/dispatch.op_call)
+with its pure body and argument tree instead of executing; shapes/dtypes
+propagate via ``jax.eval_shape``. ``Executor.run`` replays the recording
+through the SAME eager op layer on the fed arrays — so autograd, AMP,
+kernel overrides, and optimizer updates behave exactly as in dygraph —
+and XLA compiles the replayed computation per op (`to_static` remains
+the whole-program-compile path; reference CINN plays that role).
+
+Static TRAINING works through ``Optimizer.minimize(loss)`` recorded on
+the Program: each ``Executor.run`` replays forward, runs the eager tape
+backward from the loss, and applies the optimizer — parameter state
+lives in the concrete Parameter tensors shared with the Layers that
+created them (the reference's scope variables).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+
+
+class Variable(Tensor):
+    """Symbolic graph variable: a Tensor whose ``_data`` is a
+    ``jax.ShapeDtypeStruct`` (shape/dtype flow through every Tensor
+    property; any attempt to compute on it eagerly is intercepted by the
+    recording dispatch)."""
+
+    def __init__(self, name, shape, dtype, stop_gradient=True):
+        from ..core.dtype import to_jax_dtype
+        shape = [0 if s is None else (s if s >= 0 else 0) for s in shape]
+        self._data = jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(dtype))
+        self.name = name
+        self.stop_gradient = stop_gradient
+        # full Tensor attribute contract (core/tensor.py __init__)
+        self.grad = None
+        self._grad_node = None
+        self._output_slot = 0
+        self.persistable = False
+        self._grad_hooks = []
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={list(self._data.shape)}, "
+                f"dtype={self._data.dtype})")
+
+
+class _Node:
+    __slots__ = ("op_name", "fn", "args", "kwargs", "outs")
+
+    def __init__(self, op_name, fn, args, kwargs, outs):
+        self.op_name = op_name
+        self.fn = fn
+        self.args = args        # original tree; Variables mark graph edges
+        self.kwargs = kwargs
+        self.outs = outs        # flat list of output Variables
+
+
+class Program:
+    """A recorded op sequence (reference Program; single global block)."""
+
+    def __init__(self):
+        self._nodes: list[_Node] = []
+        self._feeds: dict[str, Variable] = {}
+        self._minimize = None    # (optimizer, loss Variable)
+        self.random_seed = 0
+
+    # -- reference API ----------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._feeds = dict(self._feeds)
+        if not for_test:
+            p._minimize = self._minimize
+        else:
+            # reference clone(for_test=True) switches train-mode ops to
+            # eval: drop training flags and zero dropout rates
+            rewritten = []
+            for node in p._nodes:
+                kw = dict(node.kwargs)
+                if "training" in kw:
+                    kw["training"] = False
+                if "dropout" in node.op_name and "p" in kw:
+                    kw["p"] = 0.0
+                rewritten.append(_Node(node.op_name, node.fn, node.args,
+                                       kw, node.outs))
+            p._nodes = rewritten
+        return p
+
+    def parameters(self):
+        """Concrete trainable Parameters referenced by recorded nodes."""
+        seen, out = set(), []
+        for node in self._nodes:
+            flat = jax.tree.leaves(
+                (node.args, node.kwargs),
+                is_leaf=lambda x: isinstance(x, Tensor))
+            for t in flat:
+                if isinstance(t, Tensor) and not isinstance(t, Variable) \
+                        and not t.stop_gradient and id(t) not in seen:
+                    seen.add(id(t))
+                    out.append(t)
+        return out
+
+    def state_dict(self, mode="all"):
+        return {getattr(p, "name", f"param_{i}"): p
+                for i, p in enumerate(self.parameters())}
+
+    def __repr__(self):
+        return f"Program(nodes={len(self._nodes)}, feeds={list(self._feeds)})"
+
+
+class _BuilderState(threading.local):
+    def __init__(self):
+        self.static_mode = False
+        self.stack: list[Program] = []
+
+
+_state = _BuilderState()
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+def set_default_main_program(p):
+    global _default_main
+    _default_main = p
+
+
+def enable_static_mode(flag=True):
+    _state.static_mode = flag
+    if flag:
+        # install the dispatch hook once; it stays (one None check is the
+        # dynamic-mode cost, and static_mode gates the rest)
+        _dispatch._static_state = _state
+
+
+def in_static_mode():
+    return _state.static_mode
+
+
+def current_program():
+    if _state.stack:
+        return _state.stack[-1]
+    return _default_main
+
+
+class program_guard:
+    """``with static.program_guard(main, startup):`` — records into
+    ``main`` (startup is accepted for API parity; parameter init runs
+    eagerly at Layer construction on this stack)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        _state.stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """``static.data`` (reference: static/input.py:30): declare a feed."""
+    v = Variable(name, shape, dtype)
+    prog = current_program()
+    prog._feeds[name] = v
+    return v
+
+
+# -- recording dispatch hook ---------------------------------------------
+
+_NOT_RECORDED = object()
+
+
+def maybe_record(op_name, fn, default_fn, args, kwargs):
+    """Called from core.dispatch.op_call when static mode is on: if any
+    input is symbolic, record the op into the current Program and return
+    symbolic outputs (shape/dtype via jax.eval_shape).
+
+    The node stores ``default_fn`` (not the currently-resolved override):
+    Executor.run replays through ``op_call``, which re-resolves overrides
+    from the live registry — preserving the NotImplementedError kernel
+    fallback at replay exactly as in eager mode.
+    """
+    if not _state.static_mode:
+        return _NOT_RECORDED
+    flat, treedef = jax.tree.flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    if not any(isinstance(x, Variable) for x in flat):
+        return _NOT_RECORDED
+    # only the symbolic leaves become eval_shape arguments; settings
+    # (bools/ints) and concrete tensors ride in the closure so python
+    # control flow over them stays concrete
+    sym_idx = [i for i, x in enumerate(flat) if isinstance(x, Variable)]
+    base = [x._data if isinstance(x, Tensor) and not isinstance(x, Variable)
+            else x for x in flat]
+
+    def shape_fn_of(body):
+        def shape_fn(*sym):
+            vals = list(base)
+            for i, s in zip(sym_idx, sym):
+                vals[i] = s
+            a, kw = jax.tree.unflatten(treedef, vals)
+            return body(*a, **kw)
+        return shape_fn
+
+    sym_avals = [flat[i]._data for i in sym_idx]
+    try:
+        out_shapes = jax.eval_shape(shape_fn_of(fn), *sym_avals)
+    except NotImplementedError:
+        # overridden kernel declined these inputs — same fallback rule as
+        # eager dispatch (FLAGS_enable_api_kernel_fallback)
+        from ..core.flags import GLOBAL_FLAGS
+        if fn is default_fn \
+                or not GLOBAL_FLAGS.get("enable_api_kernel_fallback"):
+            raise
+        out_shapes = jax.eval_shape(shape_fn_of(default_fn), *sym_avals)
+    out_flat, out_tree = jax.tree.flatten(out_shapes)
+    prog = current_program()
+    outs = [Variable(f"{op_name}_{len(prog._nodes)}.{i}", s.shape, s.dtype,
+                     stop_gradient=False)
+            for i, s in enumerate(out_flat)]
+    prog._nodes.append(_Node(op_name, default_fn, args, kwargs, outs))
+    wrapped = jax.tree.unflatten(out_tree, outs)
+    return wrapped
+
+
+# -- scope ----------------------------------------------------------------
+
+class _VarHandle:
+    def __init__(self, value):
+        self._value = value
+
+    def get_tensor(self):
+        return np.asarray(self._value)
+
+
+class Scope:
+    """Name -> value map (reference: paddle/fluid/framework/scope.h via
+    global_scope); Executor publishes feeds, fetches, and parameters."""
+
+    def __init__(self):
+        self._vars: dict[str, object] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return _VarHandle(self._vars[name])
+
+    def find_var(self, name):
+        if name not in self._vars:
+            return None
+        return _VarHandle(self._vars[name])
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+
+_global_scope = Scope()
+_scope_stack: list[Scope] = []
+
+
+def global_scope():
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False
+
+
+# -- executor -------------------------------------------------------------
+
+class Executor:
+    """Replays a Program through the eager op layer (reference:
+    base/executor.py:812 — feed/fetch run loop). ``place`` is accepted
+    for parity; arrays live where PJRT puts them."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, scope=None):
+        program = program if program is not None else _default_main
+        if program is _default_startup or not program._nodes:
+            return []  # startup: parameter init already ran eagerly
+        feed = feed or {}
+        scope = scope or global_scope()
+        env: dict[int, Tensor] = {}
+        missing = [n for n in program._feeds if n not in feed]
+        if missing:
+            raise ValueError(f"Executor.run: missing feeds {missing}")
+        for name, var in program._feeds.items():
+            t = Tensor(jax.numpy.asarray(feed[name]))
+            env[id(var)] = t
+            scope.set(name, t._data)
+
+        was_static = _state.static_mode
+        _state.static_mode = False   # replay must EXECUTE, not re-record
+        try:
+            def realize(x):
+                if isinstance(x, Variable):
+                    if id(x) not in env:
+                        raise RuntimeError(
+                            f"Variable {x.name} used before definition")
+                    return env[id(x)]
+                return x
+
+            for node in program._nodes:
+                a, kw = jax.tree.map(
+                    realize, (node.args, node.kwargs),
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                out = _dispatch.op_call(node.op_name, node.fn, *a, **kw)
+                out_flat = out if isinstance(out, (list, tuple)) else [out]
+                out_flat = [o for o in jax.tree.leaves(
+                    out_flat, is_leaf=lambda x: isinstance(x, Tensor))]
+                for var, val in zip(node.outs, out_flat):
+                    env[id(var)] = val
+
+            if program._minimize is not None:
+                opt, loss_var = program._minimize
+                loss = env.get(id(loss_var))
+                if loss is None:
+                    raise RuntimeError("minimize loss not produced by replay")
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+
+            results = []
+            by_name = None
+            for f in (fetch_list or []):
+                if isinstance(f, str):
+                    # reference idiom: fetch by variable name
+                    if by_name is None:
+                        by_name = {v.name: v for node in program._nodes
+                                   for v in node.outs}
+                        by_name.update(program._feeds)
+                    if f not in by_name:
+                        raise ValueError(f"fetch target {f!r}: no variable "
+                                         f"of that name in the program")
+                    f = by_name[f]
+                t = env.get(id(f))
+                if t is None:
+                    raise ValueError(f"fetch target {f!r} was not computed")
+                results.append(np.asarray(t._data) if return_numpy else t)
+            return results
+        finally:
+            _state.static_mode = was_static
+
+    def close(self):
+        return None
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def save(program, model_prefix):
+    """Persist a Program's parameters (reference: static/io.py save)."""
+    from ..framework.io import save as fsave
+    state = {k: v for k, v in program.state_dict().items()}
+    fsave({"state_dict": {k: t for k, t in state.items()},
+           "format": "paddle_tpu.static.v1"}, model_prefix + ".pdparams")
+
+
+def load(program, model_prefix, executor=None, var_list=None):
+    from ..framework.io import load as fload
+    blob = fload(model_prefix + ".pdparams")
+    state = blob.get("state_dict", blob)
+    params = program.state_dict()
+    for name, p in params.items():
+        if name in state:
+            src = state[name]
+            arr = src._data if isinstance(src, Tensor) else jax.numpy.asarray(
+                np.asarray(src))
+            p._inplace_update(arr.astype(p._data.dtype))

@@ -132,3 +132,38 @@ def _record_init(worker_id):
     from paddle_tpu.io import get_worker_info
     info = get_worker_info()
     assert info is not None and info.id == worker_id
+
+
+def test_use_buffer_reader_device_prefetch():
+    """use_buffer_reader double-buffers batches onto the device: values are
+    identical to the unbuffered path and Tensor leaves are committed device
+    arrays (reference: reader.py use_buffer_reader)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return np.full((3,), i, np.float32), i
+
+    buffered = list(DataLoader(DS(), batch_size=2, use_buffer_reader=True))
+    plain = list(DataLoader(DS(), batch_size=2, use_buffer_reader=False))
+    assert len(buffered) == len(plain) == 5
+    for (xb, yb), (xp, yp) in zip(buffered, plain):
+        np.testing.assert_array_equal(np.asarray(xb.numpy()),
+                                      np.asarray(xp.numpy()))
+        np.testing.assert_array_equal(np.asarray(yb.numpy()),
+                                      np.asarray(yp.numpy()))
+        import jax
+        assert isinstance(xb._data, jax.Array)
+        assert not xb._data.committed  # placement freedom by default
+
+    # explicit places commits batches onto that device
+    import jax
+    committed = list(DataLoader(DS(), batch_size=2, use_buffer_reader=True,
+                                places=[jax.devices()[0]]))
+    assert committed[0][0]._data.committed
