@@ -63,6 +63,12 @@ from . import geometric  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 from .hapi import hub  # noqa: F401,E402
 from .hapi.flops import flops  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless
 # PADDLE_TPU_FORCE_PALLAS=1 — the interpret-mode CI path).

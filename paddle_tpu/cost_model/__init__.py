@@ -1,0 +1,61 @@
+"""paddle.cost_model (reference: python/paddle/cost_model/cost_model.py).
+
+The reference ships a V100-era per-op timing table
+(static_op_benchmark.json) consumed by the auto-parallel planner. Here
+the equivalent measured data is this repo's own per-op baseline
+(tools/op_bench_baseline.json, recorded by tools/op_bench.py on the
+actual backend) — ``static_cost_data``/``get_static_op_time`` read it;
+``profile_measure`` points at the measuring tool. The roofline model the
+auto-parallel planner actually uses lives in
+paddle_tpu/distributed/auto_tuner.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["CostModel"]
+
+
+def _baseline_path():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(os.path.dirname(here)),
+                        "tools", "op_bench_baseline.json")
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    def static_cost_data(self):
+        """Load the measured per-op baseline (backend -> op -> ms)."""
+        if self._static_cost_data is None:
+            try:
+                with open(_baseline_path()) as f:
+                    self._static_cost_data = json.load(f)
+            except OSError:
+                self._static_cost_data = {}
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        if not op_name:
+            raise ValueError(
+                "op_name should not be empty when you want to get static "
+                "op time")
+        data = self.static_cost_data()
+        out = {}
+        for backend, entry in data.items():
+            ops = entry.get("ops", {}) if isinstance(entry, dict) else {}
+            for name, us in ops.items():
+                if name == op_name or name.startswith(op_name + "_"):
+                    out.setdefault("op_time", us)
+                    out.setdefault("unit", entry.get("unit", "us/op"))
+                    out.setdefault("backend", backend)
+                    out.setdefault("config", name)
+        return out
+
+    def profile_measure(self, *args, **kwargs):
+        raise NotImplementedError(
+            "measure with tools/op_bench.py --record (writes the baseline "
+            "this CostModel reads); whole-program cost modeling lives in "
+            "paddle_tpu.distributed.auto_tuner")

@@ -1,0 +1,17 @@
+"""paddle.dataset — the legacy reader-creator dataset package.
+
+Reference: python/paddle/dataset/ (mnist.py:102 train/test,
+uci_housing.py:107, imdb.py, imikolov.py, cifar.py, flowers.py,
+voc2012.py, common.py). Each submodule exposes ``train()``/``test()``
+reader creators (zero-arg callables yielding samples). They delegate to
+this repo's modern Dataset classes (paddle_tpu.vision.datasets,
+paddle_tpu.text.datasets), which parse the SAME upstream archive
+formats from local paths — this environment has no network egress, so
+the legacy auto-download becomes explicit path arguments (or the
+``PADDLE_DATASET_HOME`` convention via ``common.DATA_HOME``).
+"""
+from . import common, mnist, cifar, uci_housing, imdb, imikolov  # noqa: F401
+from . import flowers, voc2012  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "flowers", "voc2012"]

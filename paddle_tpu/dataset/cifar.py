@@ -1,0 +1,28 @@
+"""paddle.dataset.cifar (reference: dataset/cifar.py): legacy reader
+creators over the modern Cifar10/Cifar100 Datasets (pickle-batch
+parser). Pass the local archive path."""
+from .common import _reader_over
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _make(cls_name, data_file, mode):
+    from ..vision import datasets as V
+    cls = getattr(V, cls_name)
+    return _reader_over(lambda: cls(data_file=data_file, mode=mode))
+
+
+def train10(data_file=None):
+    return _make("Cifar10", data_file, "train")
+
+
+def test10(data_file=None):
+    return _make("Cifar10", data_file, "test")
+
+
+def train100(data_file=None):
+    return _make("Cifar100", data_file, "train")
+
+
+def test100(data_file=None):
+    return _make("Cifar100", data_file, "test")

@@ -1,0 +1,38 @@
+"""paddle.dataset.common (reference: dataset/common.py): DATA_HOME,
+md5file, and the shared reader plumbing."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["DATA_HOME", "md5file", "dataset_path"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_DATASET_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle", "dataset"))
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def dataset_path(name, filename=None):
+    """Conventional local location for a dataset file (no download —
+    zero-egress environment; place archives under DATA_HOME/<name>/)."""
+    p = os.path.join(DATA_HOME, name)
+    return os.path.join(p, filename) if filename else p
+
+
+def _reader_over(dataset_factory):
+    """Wrap a Dataset-instance factory as a legacy reader creator."""
+
+    def reader():
+        ds = dataset_factory()
+        for i in range(len(ds)):
+            yield ds[i]
+
+    return reader

@@ -1,0 +1,47 @@
+"""paddle.dataset.flowers (reference: dataset/flowers.py): legacy reader
+creators over the modern Flowers Dataset (102flowers tgz + .mat splits).
+``mapper`` is applied per sample; ``use_xmap`` runs it on a thread pool;
+``cycle`` loops forever — the reference's knobs, honored."""
+from .common import _reader_over
+
+__all__ = ["train", "test", "valid"]
+
+
+def _make(mode, data_file, label_file, setid_file, mapper=None,
+          buffered_size=1024, use_xmap=True, cycle=False):
+    from ..vision.datasets_voc_flowers import Flowers
+    base = _reader_over(lambda: Flowers(
+        data_file=data_file, label_file=label_file,
+        setid_file=setid_file, mode=mode))
+    reader = base
+    if cycle:
+        def reader():
+            while True:
+                yield from base()
+    out = reader
+    if mapper is not None:
+        from .. import reader as R
+        if use_xmap:
+            out = R.xmap_readers(mapper, reader, 4, buffered_size)
+        else:
+            def out():
+                return map(mapper, reader())
+    return out
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          data_file=None, label_file=None, setid_file=None):
+    return _make("train", data_file, label_file, setid_file, mapper,
+                 buffered_size, use_xmap, cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+         data_file=None, label_file=None, setid_file=None):
+    return _make("test", data_file, label_file, setid_file, mapper,
+                 buffered_size, use_xmap, cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True, cycle=False,
+          data_file=None, label_file=None, setid_file=None):
+    return _make("valid", data_file, label_file, setid_file, mapper,
+                 buffered_size, use_xmap, cycle)

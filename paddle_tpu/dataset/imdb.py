@@ -1,0 +1,24 @@
+"""paddle.dataset.imdb (reference: dataset/imdb.py): legacy reader
+creators over the modern Imdb Dataset (aclImdb tar parser). The
+caller's ``word_idx`` (from :func:`word_dict`) is the encoding
+vocabulary, per the reference contract."""
+from .common import _reader_over
+
+__all__ = ["train", "test", "word_dict"]
+
+
+def word_dict(data_file=None, cutoff=150):
+    from ..text.datasets import Imdb
+    return Imdb(data_file=data_file, mode="train", cutoff=cutoff).word_idx
+
+
+def train(word_idx=None, data_file=None):
+    from ..text.datasets import Imdb
+    return _reader_over(lambda: Imdb(data_file=data_file, mode="train",
+                                     word_idx=word_idx))
+
+
+def test(word_idx=None, data_file=None):
+    from ..text.datasets import Imdb
+    return _reader_over(lambda: Imdb(data_file=data_file, mode="test",
+                                     word_idx=word_idx))

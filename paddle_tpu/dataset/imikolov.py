@@ -1,0 +1,27 @@
+"""paddle.dataset.imikolov (reference: dataset/imikolov.py): legacy
+reader creators over the modern Imikolov Dataset (PTB tar parser). The
+caller's ``word_idx`` (from :func:`build_dict`) is the encoding
+vocabulary, per the reference contract."""
+from .common import _reader_over
+
+__all__ = ["train", "test", "build_dict"]
+
+
+def build_dict(data_file=None, min_word_freq=50):
+    from ..text.datasets import Imikolov
+    return Imikolov(data_file=data_file, mode="train",
+                    min_word_freq=min_word_freq, data_type="SEQ").word_idx
+
+
+def train(word_idx=None, n=5, data_file=None):
+    from ..text.datasets import Imikolov
+    return _reader_over(lambda: Imikolov(
+        data_file=data_file, data_type="NGRAM", window_size=n,
+        mode="train", word_idx=word_idx))
+
+
+def test(word_idx=None, n=5, data_file=None):
+    from ..text.datasets import Imikolov
+    return _reader_over(lambda: Imikolov(
+        data_file=data_file, data_type="NGRAM", window_size=n,
+        mode="test", word_idx=word_idx))

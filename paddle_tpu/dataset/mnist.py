@@ -1,0 +1,28 @@
+"""paddle.dataset.mnist (reference: dataset/mnist.py:102 train, :129
+test): legacy reader creators over the modern MNIST Dataset (IDX
+parser, paddle_tpu/vision/datasets.py). Pass local IDX(.gz) paths —
+no network egress."""
+from .common import _reader_over
+
+__all__ = ["train", "test"]
+
+
+def _make(image_path, label_path):
+    from ..vision.datasets import MNIST
+    if image_path is None or label_path is None:
+        def raise_no_path():
+            raise RuntimeError(
+                "paddle.dataset.mnist: no network egress — pass local "
+                "IDX(.gz) paths: mnist.train(image_path=..., "
+                "label_path=...)")
+        return _reader_over(raise_no_path)
+    return _reader_over(lambda: MNIST(image_path=image_path,
+                                      label_path=label_path))
+
+
+def train(image_path=None, label_path=None):
+    return _make(image_path, label_path)
+
+
+def test(image_path=None, label_path=None):
+    return _make(image_path, label_path)

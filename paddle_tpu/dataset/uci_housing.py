@@ -1,0 +1,18 @@
+"""paddle.dataset.uci_housing (reference: uci_housing.py:107 train,
+:133 test): legacy reader creators over the modern UCIHousing Dataset
+(housing.data parser + train-split normalization)."""
+from .common import _reader_over
+
+__all__ = ["train", "test"]
+
+
+def train(data_file=None):
+    from ..text.datasets import UCIHousing
+    return _reader_over(lambda: UCIHousing(data_file=data_file,
+                                           mode="train"))
+
+
+def test(data_file=None):
+    from ..text.datasets import UCIHousing
+    return _reader_over(lambda: UCIHousing(data_file=data_file,
+                                           mode="test"))

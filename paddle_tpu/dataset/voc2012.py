@@ -1,0 +1,22 @@
+"""paddle.dataset.voc2012 (reference: dataset/voc2012.py:54): legacy
+reader creators over the modern VOC2012 Dataset (tar layout parser)."""
+from .common import _reader_over
+
+__all__ = ["train", "test", "val"]
+
+
+def _make(mode, data_file):
+    from ..vision.datasets_voc_flowers import VOC2012
+    return _reader_over(lambda: VOC2012(data_file=data_file, mode=mode))
+
+
+def train(data_file=None):
+    return _make("train", data_file)
+
+
+def test(data_file=None):
+    return _make("test", data_file)
+
+
+def val(data_file=None):
+    return _make("valid", data_file)

@@ -1,0 +1,23 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py — delegates to
+the external ``paddle2onnx`` package). Gated here: the ``onnx`` package
+is not in this environment; the supported interchange format for
+compiled programs is the jit artifact (StableHLO via ``jax.export``,
+``paddle_tpu.jit.save``), which is the TPU-native equivalent of an
+exported graph."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "paddle.onnx.export needs the 'onnx' package, which is not "
+            "available in this environment; use paddle_tpu.jit.save for "
+            "the portable compiled artifact (StableHLO via jax.export)"
+        ) from None
+    raise NotImplementedError(
+        "ONNX emission from jaxpr is not implemented; use "
+        "paddle_tpu.jit.save (StableHLO artifact)")

@@ -48,7 +48,8 @@ class LBFGS(Optimizer):
         if line_search_fn not in (None, "strong_wolfe"):
             raise ValueError("line_search_fn must be None or "
                              "'strong_wolfe'")
-        self._weight_decay = float(weight_decay) if weight_decay else 0.0
+        # weight_decay (float or regularizer object) was normalized by the
+        # base __init__; nothing to redo here
         self.max_iter = max_iter
         self.max_eval = max_eval if max_eval is not None \
             else max_iter * 5 // 4

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
+from ..regularizer import L1Decay
 from .lr import LRScheduler
 
 
@@ -28,7 +29,15 @@ class Optimizer:
         if self._parameter_list is None:
             raise ValueError("parameters must be provided in dygraph mode")
         # paddle: weight_decay may be float (L2Decay) or a *Decay object
-        self._weight_decay = getattr(weight_decay, "_coeff", weight_decay) or 0.0
+        # (paddle.regularizer.L1Decay/L2Decay). L2 collapses to the coeff
+        # the update kernels apply; L1 is applied to the grads in step().
+        self._l1_decay = 0.0
+        if isinstance(weight_decay, L1Decay):
+            self._l1_decay = weight_decay._coeff
+            self._weight_decay = 0.0
+        else:
+            self._weight_decay = getattr(weight_decay, "_coeff",
+                                         weight_decay) or 0.0
         self._grad_clip = grad_clip
         self._state: dict[int, dict] = {}
         self._step_count = 0
@@ -98,6 +107,12 @@ class Optimizer:
         grads = [p.grad._data for p in params]
         if self._grad_clip is not None:
             grads = self._grad_clip._clip_arrays(params, grads)
+        if self._l1_decay:
+            # after clipping, like the reference (apply_gradients appends
+            # regularization ops after the clip ops) and like this repo's
+            # L2 path (applied inside the update kernels post-clip)
+            grads = [g + self._l1_decay * jnp.sign(p._data).astype(g.dtype)
+                     for p, g in zip(params, grads)]
         lr = self.get_lr()
         for p, g in zip(params, grads):
             self._apply_one(p, g, lr)

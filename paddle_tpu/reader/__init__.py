@@ -1,0 +1,203 @@
+"""paddle.reader — legacy composable data-reader decorators.
+
+Reference: python/paddle/reader/decorator.py (cache:75, map_readers:161,
+shuffle:202, chain:247, compose:310, buffered:369, firstn:431,
+xmap_readers:476, multiprocess_reader:578). A "reader" is a zero-arg
+callable returning a sample generator; decorators compose them. Pure
+host-side Python — identical semantics here.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache all samples in memory on first pass (reference :75)."""
+    all_data = []
+    loaded = [False]
+
+    def impl():
+        if not loaded[0]:
+            all_data.extend(reader())
+            loaded[0] = True
+        yield from all_data
+
+    return impl
+
+
+def map_readers(func, *readers):
+    """Yield func applied across the zipped readers (reference :161)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference :202)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                np.random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            np.random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers (reference :247)."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples (reference :310).
+
+    check_alignment=True (default) raises if readers drain unevenly.
+    """
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ValueError(
+                        "compose: readers have different lengths")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Producer-thread read-ahead of up to ``size`` samples (reference
+    :369) — the same overlap idea DataLoader's prefetch thread uses."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def read_worker():
+            for d in reader():
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=read_worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First ``n`` samples (reference :431)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Threaded map over a reader (reference :476). ``order=True``
+    preserves input order."""
+
+    def xreader():
+        if order:
+            # sequential mapping preserves order trivially; the win from
+            # threads is IO overlap, which ``buffered`` supplies
+            yield from map(mapper, buffered(reader, buffer_size)())
+            return
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for s in reader():
+                in_q.put(s)
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                s = in_q.get()
+                if s is end:
+                    out_q.put(end)
+                    return
+                out_q.put(mapper(s))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        finished = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            yield item
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers (reference :578). Thread-backed here:
+    sample generators are rarely picklable, and XLA dispatch releases
+    the GIL — the reference's caveats about pipes do not apply."""
+
+    def reader():
+        q: queue.Queue = queue.Queue(queue_size)
+        end = object()
+
+        def work(r):
+            for s in r():
+                q.put(s)
+            q.put(end)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+                continue
+            yield item
+
+    return reader

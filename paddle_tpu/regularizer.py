@@ -1,0 +1,33 @@
+"""paddle.regularizer — weight-decay regularizers.
+
+Reference: python/paddle/regularizer.py (L1Decay:51, L2Decay:169).
+Optimizers consume these through ``weight_decay=``: L2Decay collapses to
+the coefficient the update kernels already apply (decoupled/coupled per
+optimizer, as in the reference); L1Decay adds ``coeff * sign(p)`` to the
+gradient before the update (the reference appends the same sign-op to
+the backward program).
+"""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: loss += coeff/2 * ||w||^2, i.e. grad += coeff*w."""
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: loss += coeff * ||w||_1, i.e. grad += coeff*sign(w)."""
+
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]

@@ -1,0 +1,20 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py): paths to the
+native headers/libraries — here the ctypes-bound C++ runtime tier
+(paddle_tpu/core/native)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing the native runtime's C++ headers."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "core", "native", "csrc")
+
+
+def get_lib():
+    """Directory containing the built native runtime library."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "core", "native", "_build")

@@ -82,7 +82,8 @@ class Imikolov(Dataset):
     """
 
     def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
-                 mode="train", min_word_freq=50, download=True):
+                 mode="train", min_word_freq=50, download=True,
+                 word_idx=None):
         assert data_type.upper() in ("NGRAM", "SEQ"), data_type
         if data_type.upper() == "NGRAM":
             assert window_size > 0, "NGRAM needs window_size > 0"
@@ -92,6 +93,9 @@ class Imikolov(Dataset):
         self.window_size = window_size
         self.mode = mode
         self.min_word_freq = min_word_freq
+        # word_idx: encode with the CALLER's vocabulary (legacy
+        # dataset.imikolov.train(word_idx, n) contract)
+        self._ext_word_idx = word_idx
         if data_file is None:
             _no_download("Imikolov", IMIKOLOV_URL)
         self._load(data_file)
@@ -118,7 +122,11 @@ class Imikolov(Dataset):
 
     def _load(self, path):
         with tarfile.open(path) as tf:
-            self.word_idx = self._build_dict(tf)
+            if self._ext_word_idx is not None:
+                self.word_idx = dict(self._ext_word_idx)
+                self.word_idx.setdefault("<unk>", len(self.word_idx))
+            else:
+                self.word_idx = self._build_dict(tf)
             unk = self.word_idx["<unk>"]
             split = "train" if self.mode == "train" else "valid"
             self.data = []
@@ -157,13 +165,15 @@ class Imdb(Dataset):
         lambda s: re.sub(r"[^a-z0-9\s]", "", s.lower()).split())
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
-                 download=True):
+                 download=True, word_idx=None):
         mode = mode.lower()
         assert mode in ("train", "test"), mode
         self.mode = mode
         if data_file is None:
             _no_download("Imdb", IMDB_URL)
-        self._load(data_file, cutoff)
+        # word_idx: encode with the CALLER's vocabulary (the legacy
+        # dataset.imdb.train(word_dict) contract) instead of rebuilding
+        self._load(data_file, cutoff, word_idx)
 
     def _docs(self, tf, split, polarity):
         pat = re.compile(rf"aclImdb/{split}/{polarity}/.*\.txt$")
@@ -172,17 +182,21 @@ class Imdb(Dataset):
                 with tf.extractfile(m) as f:
                     yield self._tokenize(f.read().decode(errors="replace"))
 
-    def _load(self, path, cutoff):
+    def _load(self, path, cutoff, word_idx=None):
         with tarfile.open(path) as tf:
-            freq = {}
-            for pol in ("pos", "neg"):
-                for words in self._docs(tf, "train", pol):
-                    for w in words:
-                        freq[w] = freq.get(w, 0) + 1
-            kept = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
-            kept = kept[:cutoff] if cutoff else kept
-            self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
-            self.word_idx["<unk>"] = len(self.word_idx)
+            if word_idx is not None:
+                self.word_idx = dict(word_idx)
+                self.word_idx.setdefault("<unk>", len(self.word_idx))
+            else:
+                freq = {}
+                for pol in ("pos", "neg"):
+                    for words in self._docs(tf, "train", pol):
+                        for w in words:
+                            freq[w] = freq.get(w, 0) + 1
+                kept = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                kept = kept[:cutoff] if cutoff else kept
+                self.word_idx = {w: i for i, (w, _) in enumerate(kept)}
+                self.word_idx["<unk>"] = len(self.word_idx)
             unk = self.word_idx["<unk>"]
             self.docs, self.labels = [], []
             for label, pol in ((0, "pos"), (1, "neg")):

@@ -1,0 +1,86 @@
+"""Legacy compatibility surfaces: paddle.reader decorators,
+paddle.dataset reader creators, paddle.regularizer, sysconfig,
+cost_model (reference: python/paddle/{reader,dataset,regularizer,
+sysconfig,cost_model}/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_reader_decorators():
+    r = lambda: iter(range(10))  # noqa: E731
+    assert list(paddle.reader.firstn(r, 3)()) == [0, 1, 2]
+    assert list(paddle.reader.chain(r, r)()) == list(range(10)) * 2
+    assert sorted(paddle.reader.shuffle(r, 4)()) == list(range(10))
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    assert list(paddle.reader.buffered(r, 2)()) == list(range(10))
+    comp = paddle.reader.compose(r, r)
+    assert list(comp())[0] == (0, 0)
+    cached = paddle.reader.cache(r)
+    assert list(cached()) == list(cached())
+    assert sorted(paddle.reader.xmap_readers(
+        lambda x: x * 3, r, 2, 4)()) == [3 * i for i in range(10)]
+    assert list(paddle.reader.xmap_readers(
+        lambda x: x * 3, r, 2, 4, order=True)()) == [3 * i for i in range(10)]
+    assert sorted(paddle.reader.multiprocess_reader([r, r])()) == \
+        sorted(list(range(10)) * 2)
+
+    with pytest.raises(ValueError, match="different lengths"):
+        list(paddle.reader.compose(r, lambda: iter(range(3)))())
+
+
+def test_regularizer_l1_l2(tmp_path):
+    # L2Decay == float coeff; L1Decay adds coeff*sign(p) to the grad
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+
+    def run(reg):
+        p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p],
+                                   weight_decay=reg)
+        (p * 0.0).sum().backward()  # zero data-grad; only decay acts
+        opt.step()
+        return p.numpy()
+
+    l2 = run(paddle.regularizer.L2Decay(0.5))
+    np.testing.assert_allclose(l2, w0 - 0.1 * 0.5 * w0, rtol=1e-5)
+    l1 = run(paddle.regularizer.L1Decay(0.5))
+    np.testing.assert_allclose(l1, w0 - 0.1 * 0.5 * np.sign(w0), rtol=1e-5)
+
+
+def test_dataset_legacy_readers(tmp_path):
+    # uci_housing over a synthetic housing.data file
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((50, 14)).astype(np.float32)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    reader = paddle.dataset.uci_housing.train(data_file=str(f))
+    samples = list(reader())
+    assert len(samples) == 40  # 80% train split
+    x, y = samples[0]
+    assert x.shape == (13,) and np.asarray(y).shape in ((), (1,))
+
+    # no-path raises the explicit no-download guidance
+    with pytest.raises(RuntimeError):
+        list(paddle.dataset.mnist.train()())
+
+
+def test_sysconfig_and_cost_model():
+    import os
+    inc = paddle.sysconfig.get_include()
+    assert os.path.basename(inc) == "csrc" and os.path.isdir(inc)
+    cm = paddle.cost_model.CostModel()
+    data = cm.static_cost_data()
+    assert isinstance(data, dict) and data  # baseline json is checked in
+    t = cm.get_static_op_time("matmul")
+    assert "op_time" in t
+    with pytest.raises(ValueError):
+        cm.get_static_op_time("")
+    with pytest.raises(NotImplementedError):
+        cm.profile_measure()
+
+
+def test_onnx_gated():
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")

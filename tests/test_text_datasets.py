@@ -97,3 +97,19 @@ def test_download_disabled_raises():
         UCIHousing()
     with pytest.raises(RuntimeError, match="zero egress"):
         Imdb()
+
+
+def test_imdb_external_word_idx(tmp_path):
+    # the legacy dataset.imdb.train(word_dict) contract: samples encode
+    # with the CALLER's vocabulary, not a rebuilt one
+    path = _imdb_tar(tmp_path)
+    custom = {"great": 0, "movie": 1}
+    ds = Imdb(data_file=path, mode="train", word_idx=custom)
+    assert ds.word_idx["<unk>"] == 2
+    ids0, _ = ds[0]  # "a great great movie" -> unk, 0, 0, 1
+    assert list(ids0) == [2, 0, 0, 1]
+
+    import paddle_tpu as paddle
+    reader = paddle.dataset.imdb.train(custom, data_file=path)
+    ids, label = next(iter(reader()))
+    assert list(ids) == [2, 0, 0, 1] and label == 0
