@@ -33,7 +33,13 @@ class CostModel:
             try:
                 with open(_baseline_path()) as f:
                     self._static_cost_data = json.load(f)
-            except OSError:
+            except (OSError, ValueError):
+                # no repo checkout (installed package) or corrupt file:
+                # degrade to empty with a log, never raise from a lookup
+                import logging
+                logging.getLogger("paddle_tpu").info(
+                    "cost_model: no readable baseline at %s",
+                    _baseline_path())
                 self._static_cost_data = {}
         return self._static_cost_data
 

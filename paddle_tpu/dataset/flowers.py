@@ -4,6 +4,9 @@ creators over the modern Flowers Dataset (102flowers tgz + .mat splits).
 ``cycle`` loops forever — the reference's knobs, honored."""
 from .common import _reader_over
 
+# reference default: min(4, cpu_count) mapper workers
+_XMAP_THREADS = 4
+
 __all__ = ["train", "test", "valid"]
 
 
@@ -22,10 +25,10 @@ def _make(mode, data_file, label_file, setid_file, mapper=None,
     if mapper is not None:
         from .. import reader as R
         if use_xmap:
-            out = R.xmap_readers(mapper, reader, 4, buffered_size)
+            out = R.xmap_readers(mapper, reader, _XMAP_THREADS,
+                                 buffered_size)
         else:
-            def out():
-                return map(mapper, reader())
+            out = R.map_readers(mapper, reader)
     return out
 
 

@@ -142,3 +142,19 @@ def worker_num():
 def barrier_worker():
     from ..collective import barrier
     barrier()
+
+
+def is_worker():
+    """Collective mode has only workers (the PS role split is a
+    sanctioned descope, SURVEY 7)."""
+    return True
+
+
+def init_worker():
+    """PS-mode worker init is a no-op in collective mode (reference
+    returns immediately for collective role makers)."""
+    return None
+
+
+from . import utils  # noqa: F401,E402
+from . import meta_parallel  # noqa: F401,E402

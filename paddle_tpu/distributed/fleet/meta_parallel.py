@@ -1,0 +1,96 @@
+"""fleet.meta_parallel (reference: distributed/fleet/meta_parallel/):
+the tensor/pipeline-parallel layer namespace + the model-parallel RNG
+tracker (reference: fleet/layers/mpu/random.py:34 RNGStatesTracker).
+
+On this stack RNG states are JAX PRNG keys (core/random): ``add``
+registers a named stream from a seed; ``rng_state(name)`` swaps the
+global stream so ops that consume randomness (dropout) draw from the
+named stream — how TP ranks keep local-vs-global dropout decorrelated
+(local_seed per rank, global_seed shared).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ...core import random as _rng
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+           "RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        saved = _rng.get_rng_state()
+        _rng.seed(seed)
+        self.states_[name] = _rng.get_rng_state()
+        _rng.set_rng_state(saved)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model-parallel-rng"):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        saved = _rng.get_rng_state()
+        _rng.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = _rng.get_rng_state()
+            _rng.set_rng_state(saved)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        for name in states:
+            if name not in self.states_:
+                raise ValueError(f"state {name} does not exist")
+        self.states_.update(states)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Seed the global + model-parallel RNG streams per TP rank
+    (reference: mpu/random.py model_parallel_random_seed): global stream
+    shared across ranks, local stream offset by the mp rank."""
+    import paddle_tpu as paddle
+    from . import get_hybrid_communicate_group
+    try:
+        hcg = get_hybrid_communicate_group()
+        rank = hcg.get_model_parallel_rank()
+    except Exception:
+        rank = 0
+    seed = seed if seed is not None else 1024
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    paddle.seed(global_seed)
+    tracker.add("global_seed", global_seed)
+    tracker.add("local_seed", local_seed)

@@ -1,0 +1,94 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/__init__.py
+— recompute re-export, fs.py LocalFS, log_util.logger)."""
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+logger = logging.getLogger("paddle_tpu.fleet")
+
+__all__ = ["recompute", "recompute_sequential", "LocalFS", "logger"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """Local filesystem client (reference: fleet/utils/fs.py:100 LocalFS
+    — the FS interface checkpoint/elastic paths use; the HDFS client is
+    n/a here)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, f))
+             else files).append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def _rmr(self, fs_path):
+        shutil.rmtree(fs_path)
+
+    def _rm(self, fs_path):
+        os.remove(fs_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            return self._rm(fs_path)
+        return self._rmr(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        os.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [f for f in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, f))]

@@ -4,6 +4,8 @@ from . import distributed  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
+from . import multiprocessing  # noqa: F401,E402
 
 # ---- reference-name re-exports (python/paddle/incubate/__init__.py):
 # the graph/segment ops live in paddle.geometric on this stack; incubate

@@ -1,0 +1,9 @@
+"""paddle.incubate.checkpoint (reference: incubate/checkpoint/__init__.py
+— re-exports the PS-era auto_checkpoint system). The PS stack is a
+sanctioned descope (SURVEY 7); the living equivalents here are
+paddle_tpu.distributed.checkpoint (sharded save/load + reshard-on-load)
+and the elastic controller's crash-restart-resume path. auto_checkpoint
+is kept as a named module whose entry points say exactly that."""
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,15 @@
+"""auto_checkpoint (reference: base/incubate/checkpoint/auto_checkpoint.py)
+— PS-era periodic checkpoint daemon; descoped with the PS stack. The
+supported path: distributed.checkpoint.{save,load}_state_dict +
+distributed.elastic (tested end-to-end crash/restart/resume)."""
+
+
+def _unsupported(*args, **kwargs):
+    raise NotImplementedError(
+        "auto_checkpoint rode the parameter-server stack (sanctioned "
+        "descope); use paddle_tpu.distributed.checkpoint for sharded "
+        "save/load and the elastic launcher for crash-restart-resume")
+
+
+train_epoch_range = _unsupported
+ExeTrainStatus = _unsupported

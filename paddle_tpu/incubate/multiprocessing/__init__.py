@@ -1,0 +1,45 @@
+"""paddle.incubate.multiprocessing (reference:
+python/paddle/incubate/multiprocessing/__init__.py — stdlib
+multiprocessing plus Tensor reduction registration so tensors cross
+process boundaries). Here reductions serialize through host numpy (the
+same wire format io/worker.py uses): jax.Array device buffers are not
+shareable across processes, so the value is copied — correct, not
+zero-copy (the reference's file_system strategy also copies through
+shm)."""
+from __future__ import annotations
+
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing.reduction import ForkingPickler
+
+import numpy as np
+
+__all__ = []
+
+
+def _rebuild_tensor(arr, is_bf16, stop_gradient):
+    from ...core.tensor import Tensor
+    import jax.numpy as jnp
+    if is_bf16:
+        import ml_dtypes
+        arr = arr.view(ml_dtypes.bfloat16)
+    t = Tensor(jnp.asarray(arr))
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t):
+    import jax.numpy as jnp
+    is_bf16 = t._data.dtype == jnp.bfloat16
+    arr = np.asarray(t._data)
+    if is_bf16:
+        arr = arr.view(np.uint16)  # lossless bit view (numpy can't pickle
+        # ml_dtypes scalars portably across spawn on every version)
+    return _rebuild_tensor, (arr, is_bf16, t.stop_gradient)
+
+
+def init_reductions():
+    from ...core.tensor import Tensor
+    ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()

@@ -48,8 +48,14 @@ class LBFGS(Optimizer):
         if line_search_fn not in (None, "strong_wolfe"):
             raise ValueError("line_search_fn must be None or "
                              "'strong_wolfe'")
-        # weight_decay (float or regularizer object) was normalized by the
-        # base __init__; nothing to redo here
+        # weight_decay (float or L2Decay) was normalized by the base
+        # __init__; L1 would need the sign term inside _eval's closure
+        # loss, which LBFGS does not implement — reject loudly rather
+        # than silently training without decay
+        if self._l1_decay:
+            raise NotImplementedError(
+                "LBFGS does not support L1Decay; fold the L1 term into "
+                "the closure loss")
         self.max_iter = max_iter
         self.max_eval = max_eval if max_eval is not None \
             else max_iter * 5 // 4

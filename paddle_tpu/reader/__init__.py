@@ -103,13 +103,20 @@ def buffered(reader, size):
     class _End:
         pass
 
+    class _Err:
+        def __init__(self, exc):
+            self.exc = exc
+
     def data_reader():
         q: queue.Queue = queue.Queue(maxsize=size)
 
         def read_worker():
-            for d in reader():
-                q.put(d)
-            q.put(_End)
+            try:
+                for d in reader():
+                    q.put(d)
+                q.put(_End)
+            except BaseException as e:  # surface in the consumer
+                q.put(_Err(e))
 
         t = threading.Thread(target=read_worker, daemon=True)
         t.start()
@@ -117,6 +124,8 @@ def buffered(reader, size):
             e = q.get()
             if e is _End:
                 break
+            if isinstance(e, _Err):
+                raise e.exc
             yield e
 
     return data_reader
@@ -148,19 +157,29 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q: queue.Queue = queue.Queue(buffer_size)
         end = object()
 
+        class _Err:
+            def __init__(self, exc):
+                self.exc = exc
+
         def feed():
-            for s in reader():
-                in_q.put(s)
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for s in reader():
+                    in_q.put(s)
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as e:
+                out_q.put(_Err(e))
 
         def work():
-            while True:
-                s = in_q.get()
-                if s is end:
-                    out_q.put(end)
-                    return
-                out_q.put(mapper(s))
+            try:
+                while True:
+                    s = in_q.get()
+                    if s is end:
+                        out_q.put(end)
+                        return
+                    out_q.put(mapper(s))
+            except BaseException as e:  # mapper failure -> consumer raises
+                out_q.put(_Err(e))
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -171,6 +190,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             if item is end:
                 finished += 1
                 continue
+            if isinstance(item, _Err):
+                raise item.exc
             yield item
 
     return xreader
@@ -185,10 +206,17 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         q: queue.Queue = queue.Queue(queue_size)
         end = object()
 
+        class _Err:
+            def __init__(self, exc):
+                self.exc = exc
+
         def work(r):
-            for s in r():
-                q.put(s)
-            q.put(end)
+            try:
+                for s in r():
+                    q.put(s)
+                q.put(end)
+            except BaseException as e:  # surface in the consumer
+                q.put(_Err(e))
 
         for r in readers:
             threading.Thread(target=work, args=(r,), daemon=True).start()
@@ -198,6 +226,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if item is end:
                 finished += 1
                 continue
+            if isinstance(item, _Err):
+                raise item.exc
             yield item
 
     return reader

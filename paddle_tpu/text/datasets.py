@@ -124,7 +124,10 @@ class Imikolov(Dataset):
         with tarfile.open(path) as tf:
             if self._ext_word_idx is not None:
                 self.word_idx = dict(self._ext_word_idx)
-                self.word_idx.setdefault("<unk>", len(self.word_idx))
+                if "<unk>" not in self.word_idx:
+                    # sparse caller vocabularies exist; never collide
+                    self.word_idx["<unk>"] = \
+                        max(self.word_idx.values(), default=-1) + 1
             else:
                 self.word_idx = self._build_dict(tf)
             unk = self.word_idx["<unk>"]
@@ -186,7 +189,10 @@ class Imdb(Dataset):
         with tarfile.open(path) as tf:
             if word_idx is not None:
                 self.word_idx = dict(word_idx)
-                self.word_idx.setdefault("<unk>", len(self.word_idx))
+                if "<unk>" not in self.word_idx:
+                    # sparse caller vocabularies exist; never collide
+                    self.word_idx["<unk>"] = \
+                        max(self.word_idx.values(), default=-1) + 1
             else:
                 freq = {}
                 for pol in ("pos", "neg"):

@@ -84,3 +84,84 @@ def test_sysconfig_and_cost_model():
 def test_onnx_gated():
     with pytest.raises(NotImplementedError, match="jit.save"):
         paddle.onnx.export(paddle.nn.Linear(2, 2), "/tmp/x")
+
+
+def test_fleet_utils_and_meta_parallel(tmp_path):
+    """fleet.utils.LocalFS + meta_parallel RNG tracker (reference:
+    fleet/utils/fs.py:100, fleet/layers/mpu/random.py:34)."""
+    fleet = paddle.distributed.fleet
+    fs = fleet.utils.LocalFS()
+    d = str(tmp_path / "ckpt")
+    fs.mkdirs(d)
+    fs.touch(d + "/a.txt")
+    assert fs.is_file(d + "/a.txt") and fs.is_dir(d)
+    fs.mv(d + "/a.txt", d + "/b.txt")
+    dirs, files = fs.ls_dir(d)
+    assert files == ["b.txt"] and dirs == []
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    assert fs.need_upload_download() is False
+
+    tr = fleet.meta_parallel.RNGStatesTracker()
+    tr.add("local_seed", 7)
+    with pytest.raises(ValueError):
+        tr.add("local_seed", 8)       # duplicate name
+    with pytest.raises(ValueError):
+        tr.add("other", 7)            # duplicate seed
+    with tr.rng_state("local_seed"):
+        a = paddle.randn([4]).numpy()
+    tr2 = fleet.meta_parallel.RNGStatesTracker()
+    tr2.add("local_seed", 7)
+    with tr2.rng_state("local_seed"):
+        b = paddle.randn([4]).numpy()
+    np.testing.assert_allclose(a, b)  # same seed, same stream
+    assert fleet.is_worker() and fleet.init_worker() is None
+    # the TP layer namespace resolves
+    assert fleet.meta_parallel.ColumnParallelLinear is not None
+
+
+def test_incubate_multiprocessing_reductions():
+    """Tensor crosses a ForkingPickler boundary losslessly, incl. bf16
+    (reference: incubate/multiprocessing/reductions.py)."""
+    import io as _io
+    import pickle
+
+    from multiprocessing.reduction import ForkingPickler
+
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401 — registers
+
+    for dt in ("float32", "bfloat16", "int32"):
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32)).astype(dt)
+        buf = _io.BytesIO()
+        ForkingPickler(buf).dump(t)
+        t2 = pickle.loads(buf.getvalue())
+        assert str(t2.dtype) == str(t.dtype)
+        np.testing.assert_allclose(t.astype("float32").numpy(),
+                                   t2.astype("float32").numpy())
+
+    with pytest.raises(NotImplementedError, match="distributed.checkpoint"):
+        paddle.incubate.checkpoint.auto_checkpoint.train_epoch_range()
+
+
+def test_reader_error_propagation():
+    """Worker failures surface in the consumer instead of deadlocking
+    (the reference forwards worker exceptions the same way)."""
+    def bad():
+        yield 1
+        raise OSError("corrupt archive")
+
+    with pytest.raises(OSError, match="corrupt"):
+        list(paddle.reader.buffered(bad, 2)())
+    with pytest.raises(ZeroDivisionError):
+        list(paddle.reader.xmap_readers(
+            lambda x: 1 // x, lambda: iter([1, 0, 2]), 2, 4)())
+    with pytest.raises(OSError, match="corrupt"):
+        list(paddle.reader.multiprocess_reader(
+            [bad, lambda: iter(range(3))])())
+
+
+def test_lbfgs_rejects_l1_decay():
+    p = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    with pytest.raises(NotImplementedError, match="L1Decay"):
+        paddle.optimizer.LBFGS(parameters=[p],
+                               weight_decay=paddle.regularizer.L1Decay(0.1))
