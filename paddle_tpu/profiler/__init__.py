@@ -181,9 +181,18 @@ class Profiler:
         return _nv.prof_export()
 
     def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        """Per-op host time table (reference: profiler_statistic.py)."""
-        agg = defaultdict(lambda: [0, 0.0, 0.0])  # name -> [calls, total_ns, max_ns]
+                time_unit="ms", views=None):
+        """Per-op host time table (reference: profiler_statistic.py).
+        ``sorted_by`` accepts a SortedKeys enum or "total"/"avg"/"max";
+        GPU* keys alias CPU* on the host-event tier. ``views`` accepts
+        SummaryView values for API parity (the host tier renders the
+        operator view)."""
+        if hasattr(sorted_by, "name"):  # SortedKeys
+            sorted_by = {"Total": "total", "Avg": "avg", "Max": "max",
+                         "Min": "min"}[
+                sorted_by.name.replace("CPU", "").replace("GPU", "")]
+        # name -> [calls, total_ns, max_ns, min_ns]
+        agg = defaultdict(lambda: [0, 0.0, 0.0, float("inf")])
         for name, tid, start, dur, cat in _nv.prof_export():
             if cat != 1:
                 continue
@@ -191,18 +200,23 @@ class Profiler:
             a[0] += 1
             a[1] += dur
             a[2] = max(a[2], dur)
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+            a[3] = min(a[3], dur)
+        keyfn = {"total": lambda kv: -kv[1][1],
+                 "avg": lambda kv: -kv[1][1] / max(kv[1][0], 1),
+                 "max": lambda kv: -kv[1][2],
+                 "min": lambda kv: kv[1][3]}[sorted_by]
+        rows = sorted(agg.items(), key=keyfn)
         unit = {"ms": 1e6, "us": 1e3, "ns": 1.0, "s": 1e9}[time_unit]
         lines = [f"{'Op':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
                  f"{'Avg':>12}{'Max':>12}"]
         lines.append("-" * 86)
-        for name, (calls, total, mx) in rows:
+        for name, (calls, total, mx, mn) in rows:
             lines.append(f"{name:<40}{calls:>8}{total / unit:>14.3f}"
                          f"{total / unit / max(calls, 1):>12.3f}{mx / unit:>12.3f}")
         table = "\n".join(lines)
         print(table)
-        return {name: {"calls": c, "total_ns": t, "max_ns": m}
-                for name, (c, t, m) in rows}
+        return {name: {"calls": c, "total_ns": t, "max_ns": m, "min_ns": mn}
+                for name, (c, t, m, mn) in rows}
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
@@ -216,3 +230,73 @@ def export_chrome_tracing(dir_name, worker_name=None):
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing"]
+
+
+class SortedKeys(enum.Enum):
+    """Sort order for ``Profiler.summary`` (reference:
+    profiler_statistic.py:49). GPU* keys map to device-view sorting when
+    device events exist; on this host-event tier they alias CPU*."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(enum.Enum):
+    """Summary views (reference: profiler.py:55). The host-event tier
+    renders Operator/Overview; the device timeline lives in the xplane
+    trace (export via jax.profiler, see Profiler device_tracing)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """on_trace_ready factory writing the protobuf artifact (reference:
+    profiler.py:280; schema proto/profiler_result.proto here)."""
+
+    def handler(prof):
+        import socket
+        os.makedirs(dir_name, exist_ok=True)
+        from .proto import profiler_result_pb2 as pb
+        name = worker_name or f"{socket.gethostname()}_{os.getpid()}"
+        result = pb.ProfilerResult(host=socket.gethostname(),
+                                   pid=os.getpid())
+        for ev_name, tid, start, dur, cat in prof.events():
+            e = result.events.add()
+            e.name, e.tid = ev_name, int(tid)
+            e.start_ns, e.dur_ns = int(start), int(dur)
+            e.category = int(cat)
+        path = os.path.join(dir_name, f"{name}.pb")
+        with open(path, "wb") as f:
+            f.write(result.SerializeToString())
+        return path
+
+    return handler
+
+
+def load_profiler_result(filename):
+    """Load an ``export_protobuf`` artifact (reference: utils.py:161).
+    Returns the event tuples in ``Profiler.events()`` order."""
+    from .proto import profiler_result_pb2 as pb
+    result = pb.ProfilerResult()
+    with open(filename, "rb") as f:
+        result.ParseFromString(f.read())
+    return [(e.name, e.tid, e.start_ns, e.dur_ns, e.category)
+            for e in result.events]
+
+
+__all__ += ["SortedKeys", "SummaryView", "export_protobuf",
+            "load_profiler_result"]

@@ -1,0 +1,1 @@
+from . import profiler_result_pb2  # noqa: F401

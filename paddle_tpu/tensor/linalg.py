@@ -526,3 +526,42 @@ def vecdot(x, y, axis=-1, name=None):
     """Vector dot along ``axis`` with broadcasting (reference:
     linalg.py:1880)."""
     return op_call("vecdot", _vecdot, x, y, axis=int(axis))
+
+
+def inv(x, name=None):
+    """Matrix inverse (reference: paddle.linalg.inv = tensor.math.inverse)."""
+    from .math import inverse
+    return inverse(x)
+
+
+@op_body("svd_lowrank")
+def _svd_lowrank(a, key, *, q, niter):
+    # Halko et al. randomized range finder + subspace (power) iteration:
+    # Y = A G; Y <- A (A^H Y) x niter; Q = qr(Y); svd of the small Q^H A.
+    # All dense matmuls + one (q x n) SVD — MXU-friendly at q << min(m,n).
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(q, m, n)
+    g = jax.random.normal(key, a.shape[:-2] + (n, k), jnp.float32) \
+        .astype(a.dtype)
+    y = a @ g
+    ah = jnp.swapaxes(a, -1, -2).conj()
+    for _ in range(niter):
+        y = a @ (ah @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2).conj() @ a   # [..., k, n]
+    ub, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ ub
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+def svd_lowrank(x, q=None, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: tensor/linalg.py:3081
+    svd_lowrank — Halko et al. subspace iteration; ``niter`` power steps
+    sharpen the range estimate). Returns (U, S, V) in column form
+    (X ~= U diag(S) V^H)."""
+    from ..core import random as _prng
+    if M is not None:
+        x = x - M
+    k = q if q is not None else min(6, x.shape[-2], x.shape[-1])
+    return tuple(op_call("svd_lowrank", _svd_lowrank, x, _prng.next_key(),
+                         q=int(k), niter=int(niter)))

@@ -151,11 +151,14 @@ from .detection import (  # noqa: E402,F401 — the detection op zoo
     psroi_pool, roi_pool, yolo_box, correlation,
 )
 
+from .. import nn as _nn  # noqa: E402
+
 __all__ = ["box_area", "box_iou", "nms", "roi_align", "yolo_box",
            "prior_box", "box_coder", "deform_conv2d", "roi_pool",
            "psroi_pool", "box_clip", "multiclass_nms3", "matrix_nms",
            "generate_proposals", "distribute_fpn_proposals",
-           "read_file", "decode_jpeg"]
+           "read_file", "decode_jpeg", "DeformConv2D", "RoIAlign",
+           "RoIPool", "PSRoIPool"]
 
 
 def read_file(filename, name=None):
@@ -192,3 +195,81 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+class DeformConv2D(_nn.Layer):
+    """Layer form of :func:`deform_conv2d` (reference: vision/ops.py:906
+    DeformConv2D): holds the conv weight/bias; offset (and v2 mask) come
+    in through forward."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        from ..nn import initializer as I
+        import math
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)
+
+
+class RoIAlign(_nn.Layer):
+    """Layer form of :func:`roi_align` (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         spatial_scale=self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(_nn.Layer):
+    """Layer form of :func:`roi_pool` (reference: vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        spatial_scale=self._spatial_scale)
+
+
+class PSRoIPool(_nn.Layer):
+    """Layer form of :func:`psroi_pool` (reference: vision/ops.py
+    PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          spatial_scale=self._spatial_scale)

@@ -246,3 +246,49 @@ def test_flag_registry_breadth():
     paddle.set_flags({"FLAGS_use_autotune": True})
     assert "FLAGS_nccl_blocking_wait" in paddle.get_flags(
         "nccl_blocking_wait")
+
+
+def test_vision_layer_wrappers():
+    """DeformConv2D/RoIAlign/RoIPool/PSRoIPool Layer forms (reference:
+    vision/ops.py class forms over the functional zoo)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.vision.ops as vo
+
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((1, 4, 8, 8)).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    assert vo.RoIAlign(2)(x, boxes, bn).shape == [1, 4, 2, 2]
+    assert vo.RoIPool(2)(x, boxes, bn).shape == [1, 4, 2, 2]
+    assert vo.PSRoIPool(2)(x, boxes, bn).shape == [1, 1, 2, 2]
+    dc = vo.DeformConv2D(4, 6, 3, padding=1)
+    off = paddle.zeros([1, 18, 8, 8])
+    out = dc(x, off)
+    assert out.shape == [1, 6, 8, 8]
+    # parity with the functional form at zero offsets
+    ref = vo.deform_conv2d(x, off, dc.weight, dc.bias, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+    out.sum().backward()
+    assert dc.weight.grad is not None
+
+
+def test_linalg_inv_and_svd_lowrank():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    a = np.random.default_rng(0).standard_normal((5, 5)).astype("float32")
+    inv = paddle.linalg.inv(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(inv @ a, np.eye(5), atol=1e-4)
+    x = np.random.default_rng(1).standard_normal((20, 8)).astype("float32")
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(x), q=8)
+    rec = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(rec, x, atol=1e-3)
+    # M subtraction path
+    m = np.ones_like(x)
+    u2, s2, v2 = paddle.linalg.svd_lowrank(paddle.to_tensor(x),
+                                           q=8, M=paddle.to_tensor(m))
+    rec2 = u2.numpy() @ np.diag(s2.numpy()) @ v2.numpy().T
+    np.testing.assert_allclose(rec2, x - m, atol=1e-3)

@@ -126,3 +126,27 @@ def test_profiler_scheduler_gates_recording():
     n_after = sum(1 for e in nv.prof_export() if e[4] == 1)
     assert n_closed == 0
     assert n_after >= 1
+
+
+def test_protobuf_export_and_enums(tmp_path):
+    """export_protobuf / load_profiler_result roundtrip (reference:
+    profiler.py:280, utils.py:161; schema proto/profiler_result.proto)
+    plus SortedKeys-driven summary."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as profiler
+
+    prof = profiler.Profiler(
+        on_trace_ready=profiler.export_protobuf(str(tmp_path)))
+    with prof:
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        for _ in range(2):
+            paddle.matmul(x, x)
+    pbs = list(tmp_path.glob("*.pb"))
+    assert len(pbs) == 1
+    events = profiler.load_profiler_result(str(pbs[0]))
+    assert any(e[0] == "matmul" for e in events)
+    stats = prof.summary(sorted_by=profiler.SortedKeys.CPUAvg)
+    assert "matmul" in stats
+    assert profiler.SummaryView.OperatorView.value == 5
