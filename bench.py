@@ -279,11 +279,14 @@ def _run_child(budget, extra_args=()):
 
 
 def _last_good_round():
-    """Most recent BENCH_r*.json whose parsed value was non-zero.
+    """Most recent real measurement, marked stale when used.
 
-    Used only when every attempt this round failed: the artifact then
-    carries the last real measurement, marked stale, instead of a 0.0 that
-    erases the evidence chain.
+    Sources, newest wins: driver artifacts (BENCH_r*.json) and
+    tools/bench_lastgood.json — in-session measurements recorded while
+    the chip was reachable (the pool can wedge for most of a day; a
+    same-round measurement beats a rounds-old driver artifact). Used only
+    when every attempt this round failed: the artifact then carries the
+    last real number instead of a 0.0 that erases the evidence chain.
     """
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
@@ -296,6 +299,16 @@ def _last_good_round():
         if parsed.get("value") and not parsed.get("stale"):
             m = re.search(r"BENCH_r\d+\.json$", path)
             best = (m.group(0) if m else os.path.basename(path)), parsed
+    lastgood = os.path.join(here, "tools", "bench_lastgood.json")
+    try:
+        with open(lastgood) as f:
+            blob = json.load(f)
+        parsed = blob.get("parsed") or {}
+        if parsed.get("value"):
+            best = (f"tools/bench_lastgood.json "
+                    f"({blob.get('recorded', 'undated')})", parsed)
+    except (OSError, ValueError):
+        pass
     return best
 
 
