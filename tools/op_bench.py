@@ -79,6 +79,14 @@ def _cases():
         q, q, q, causal=True) * 0.5 + q * 0.5)
     cases.append(("sdpa_128", sdpa, q0, 20))
 
+    # GQA attention (native grouped k/v path, the llama regime): q 8 heads,
+    # k/v 2 heads — regressions in the grouped einsum show up here
+    kv0 = jnp.asarray(rng.standard_normal((2, 128, 2, 64)).astype(np.float32))
+    sdpa_gqa = jax.jit(lambda q: OPS["scaled_dot_product_attention"](
+        q, kv0, kv0, causal=True) * 0.5 + q * 0.5)
+    qg = jnp.asarray(rng.standard_normal((2, 128, 8, 64)).astype(np.float32))
+    cases.append(("sdpa_gqa_128", sdpa_gqa, qg, 20))
+
     # norm family: rms_norm + layer_norm [1024, 1024]
     h0 = jnp.asarray(rng.standard_normal((1024, 1024)).astype(np.float32))
     gamma = jnp.ones((1024,), jnp.float32)
