@@ -1,0 +1,100 @@
+"""Attention at 1B train shapes: XLA vs our flash vs jax splash.
+
+Marginal-slope timing (two fori_loop lengths, readback sync) per
+tools/perf_audit.py — cancels the relay's fixed dispatch overhead.
+Internal deadline; exits cleanly (never SIGKILL a claim holder).
+"""
+import math
+import time
+
+T0 = time.time()
+DEADLINE = 480.0
+
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_bench_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.kernels.flash_attention import flash_attention as pflash
+
+
+def timed_device(fn, x, iters, repeats=3):
+    looped = jax.jit(lambda y: jnp.sum(lax.fori_loop(
+        0, iters, lambda i, y: fn(y), y).astype(jnp.float32)))
+    float(looped(x))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(looped(x))
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def marginal(fn, x):
+    t3 = timed_device(fn, x, 3) * 3
+    t13 = timed_device(fn, x, 13) * 13
+    return (t13 - t3) / 10
+
+
+S = 2048
+for H, D in ((32, 64), (16, 128)):
+    if time.time() - T0 > DEADLINE:
+        print("deadline hit, exiting clean", flush=True)
+        break
+    HKV = 4
+    G = H // HKV
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, H, S, D)) * 0.1, jnp.bfloat16)
+    kv = jnp.asarray(rng.standard_normal((1, HKV, S, D)) * 0.1, jnp.bfloat16)
+
+    def gqa_sdpa(q, kv=kv, G=G, HKV=HKV, D=D):
+        qg = q.reshape(1, HKV, G, S, D)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kv) / math.sqrt(D)
+        m = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(m, logits, -1e9).astype(jnp.float32)
+        p = jax.nn.softmax(logits, -1).astype(q.dtype)
+        return jnp.einsum("bhgqk,bhkd->bhgqd", p, kv).reshape(q.shape)
+
+    def fb(fn):
+        return jax.grad(lambda q: jnp.sum(fn(q).astype(jnp.float32)))
+
+    try:
+        print(f"h{H} d{D} xla fwd+bwd: {marginal(fb(gqa_sdpa), q)*1e3:7.2f} ms",
+              flush=True)
+    except Exception as e:
+        print(f"h{H} d{D} xla FAILED {type(e).__name__}: {e}"[:160], flush=True)
+    for bq, bk in ((256, 512), (512, 512)):
+        if time.time() - T0 > DEADLINE:
+            break
+        try:
+            t = marginal(fb(lambda q, bq=bq, bk=bk: pflash(
+                q, kv, kv, causal=True, block_q=bq, block_k=bk)), q)
+            print(f"h{H} d{D} ours bq{bq} bk{bk} fwd+bwd: {t*1e3:7.2f} ms",
+                  flush=True)
+        except Exception as e:
+            print(f"h{H} d{D} ours bq{bq} FAILED {type(e).__name__}: {e}"[:160],
+                  flush=True)
+    # jax splash (production TPU kernel)
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as splash,
+            splash_attention_mask as mask_lib,
+        )
+        mask = mask_lib.MultiHeadMask(
+            [mask_lib.CausalMask((S, S)) for _ in range(H)])
+        kernel = splash.make_splash_mha(
+            mask=mask, head_shards=1, q_seq_shards=1)
+
+        def run_splash(q, kv=kv, kernel=kernel, G=G):
+            k_full = jnp.repeat(kv[0], G, axis=0)
+            v_full = jnp.repeat(kv[0], G, axis=0)
+            return kernel(q[0] * (1.0 / math.sqrt(D)), k_full, v_full)[None]
+
+        t = marginal(fb(run_splash), q)
+        print(f"h{H} d{D} splash fwd+bwd: {t*1e3:7.2f} ms", flush=True)
+    except Exception as e:
+        print(f"h{H} d{D} splash FAILED {type(e).__name__}: {e}"[:200],
+              flush=True)
+print("DONE", flush=True)

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Chip-session queue (round-4 continuation). Run when the pool answers;
+# ONE TPU process at a time, each step exits cleanly (no SIGKILL of
+# claim holders — that wedges the pool for 10+ minutes or hours).
+#
+#   bash tools/tpu_session.sh [bench|sweep|audit|opbench|all]
+#
+# Order matters: bench first (the artifact that counts), then the
+# attention-geometry sweep that decides the next 1B config, then the
+# audit + op baseline.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+what="${1:-all}"
+
+probe() {
+  echo "== probing the chip (100s) =="
+  timeout 100 python -c "import jax; print(jax.devices())" || {
+    echo "chip unreachable; aborting (leave the pool QUIET >=15 min)" >&2
+    exit 2
+  }
+}
+
+case "$what" in
+  bench|all)
+    probe
+    echo "== bench: llama_125m + llama_1b (post-GQA-native) =="
+    timeout 2400 python bench.py
+    echo "==> update tools/bench_lastgood.json with the fresh numbers"
+    ;;&
+  sweep|all)
+    probe
+    echo "== attention geometry sweep: h32/d64 vs h16/d128 vs splash =="
+    # /tmp/exp4_attn.py from the session, or regenerate: it measures
+    # fwd+bwd marginal-slope at the exact 1B shapes
+    PYTHONPATH=. timeout 560 python tools/attn_sweep_1b.py
+    echo "==> if h16/d128 wins materially, flip bench.py llama_1b to"
+    echo "    num_attention_heads=16 and re-run bench"
+    ;;&
+  audit|all)
+    probe
+    echo "== perf audit: matmul / attention (incl. 1B rows) / step =="
+    timeout 900 python tools/perf_audit.py matmul
+    timeout 900 python tools/perf_audit.py attention
+    timeout 1200 python tools/perf_audit.py step
+    echo "==> reconcile docs/PERF.md tables with docs/PERF_AUDIT.json"
+    ;;&
+  opbench|all)
+    probe
+    echo "== op bench: record the TPU baseline =="
+    timeout 900 python tools/op_bench.py --record --no-collective
+    ;;&
+  bench|sweep|audit|opbench|all)
+    : ;;
+  *)
+    echo "usage: $0 [bench|sweep|audit|opbench|all]" >&2
+    exit 1
+    ;;
+esac
+echo done
