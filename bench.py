@@ -116,9 +116,13 @@ def run_bench(config="llama_125m", progress=None):
         # tie_word_embeddings: still ~1.03B params (968M decoder + 66M
         # embedding) and saves 750 MB of fp32 head param + AdamW moments —
         # the margin that fits the step on one 16G chip.
+        # PADDLE_TPU_BENCH_1B_HEADS: head-count A/B (32 -> d=64, the
+        # TinyLlama geometry; 16 -> d=128, the TPU-native geometry that
+        # fills the MXU's 128 contraction lanes — docs/PERF.md 2a).
+        heads = int(os.environ.get("PADDLE_TPU_BENCH_1B_HEADS", "32"))
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5632, num_hidden_layers=22,
-                          num_attention_heads=32, num_key_value_heads=4,
+                          num_attention_heads=heads, num_key_value_heads=4,
                           max_position_embeddings=2048,
                           tie_word_embeddings=True,
                           loss_chunk_size=512, remat=True)
