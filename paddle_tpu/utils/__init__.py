@@ -141,3 +141,8 @@ def require_version(min_version, max_version=None):
         raise Exception(
             f"installed version {version.full_version} > allowed "
             f"{max_version}")
+
+
+from . import dlpack  # noqa: E402,F401
+from . import download  # noqa: E402,F401
+from . import cpp_extension  # noqa: E402,F401

@@ -1,0 +1,143 @@
+"""paddle.utils.cpp_extension (reference: python/paddle/utils/
+cpp_extension/ — load/setup/CppExtension/BuildExtension JIT-build custom
+C++ ops). TPU-native form: ``load`` compiles the sources with g++ into a
+shared library (ctypes-loaded — the same binding discipline as the
+native runtime tier, SURVEY §2.4 amendment); ``register_custom_op``
+turns an exported C symbol into a registry op whose eager/compiled body
+is a ``jax.pure_callback`` host call. The device-resident path for
+custom kernels remains Pallas (kernels/); this is the HOST custom-op ABI
+(reference capability C30: every kernel replaceable without touching the
+core, phi/core/kernel_registry.h:196).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import types
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension",
+           "setup", "register_custom_op", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name, sources, extra_cxx_flags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """JIT-compile C++ sources into a shared library and return a module
+    holding the ``ctypes.CDLL`` (reference: cpp_extension.load)."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out]
+    for inc in (extra_include_paths or []):
+        cmd += ["-I", inc]
+    from ..sysconfig import get_include
+    cmd += ["-I", get_include()]
+    cmd += list(extra_cxx_flags or [])
+    cmd += srcs
+    if verbose:
+        print(" ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build failed:\n{proc.stderr}")
+    mod = types.SimpleNamespace(__name__=name, __file__=out,
+                                lib=ctypes.CDLL(out))
+    return mod
+
+
+def register_custom_op(op_name, lib, symbol, result_shape_fn=None,
+                       arg_ctypes=None):
+    """Register an exported C function as a framework op.
+
+    The symbol must have signature
+    ``void f(const float* in, float* out, int64_t n, ...)``-style —
+    pass ``arg_ctypes`` for extra scalar arguments. The op body wraps
+    the call in ``jax.pure_callback``: it runs host-side, composes with
+    jit (as a host callback), and is visible to ``override_kernel`` like
+    every registry op. ``result_shape_fn(x, **kw) -> ShapeDtypeStruct``
+    defaults to same-shape-as-input."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import OPS, op_call
+
+    fn = getattr(lib.lib if hasattr(lib, "lib") else lib, symbol)
+    fn.restype = None
+
+    def _result_struct(x, *scalars):
+        return (result_shape_fn(x, *scalars) if result_shape_fn
+                else jax.ShapeDtypeStruct(x.shape, jnp.float32))
+
+    def host_call(x, *scalars):
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        # allocate what the declared result struct promises — the C symbol
+        # owns deriving its output size from (n, scalars)
+        struct = _result_struct(x, *scalars)
+        out = np.empty(struct.shape, np.float32)
+        argv = [x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                ctypes.c_int64(x.size)]
+        for ct, v in zip(arg_ctypes or [], scalars):
+            argv.append(ct(v))
+        fn(*argv)
+        return out
+
+    def body(x, *scalars):
+        return jax.pure_callback(host_call, _result_struct(x, *scalars),
+                                 x, *scalars)
+
+    OPS[op_name] = body
+
+    def api(x, *scalars):
+        return op_call(op_name, body, x, *scalars)
+
+    return api
+
+
+class CppExtension:
+    """setup()-style extension description (reference: CppExtension)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDAExtension targets the CUDA toolchain; on this stack the "
+        "device custom-kernel tier is Pallas (paddle_tpu/kernels) and "
+        "host ops build via CppExtension/load")
+
+
+class BuildExtension:
+    """Minimal build_ext stand-in so reference setup.py scripts run."""
+
+    @classmethod
+    def with_options(cls, **options):
+        return cls
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build each CppExtension in place (the JIT ``load`` path is the
+    supported install mechanism here). Each extension gets its own
+    library name so multi-extension setup.py scripts don't overwrite
+    one another's artifacts."""
+    mods = []
+    for i, ext in enumerate(ext_modules or []):
+        srcs = getattr(ext, "sources", ext)
+        base = name or "custom_ext"
+        ext_name = base if len(ext_modules) == 1 else f"{base}_{i}"
+        mods.append(load(ext_name, srcs))
+    return mods

@@ -1,0 +1,9 @@
+"""paddle.utils.dlpack (reference: python/paddle/utils/dlpack.py:66
+to_dlpack, :126 from_dlpack) — delegates to the framework's DLPack
+pair (framework/infra.py:132): the export is a reusable provider object
+(modern ``__dlpack__`` protocol; raw capsules are single-consume and
+rejected by jax>=0.4 import), accepted directly by torch/numpy/jax
+``from_dlpack``."""
+from ..framework.infra import from_dlpack, to_dlpack  # noqa: F401
+
+__all__ = ["to_dlpack", "from_dlpack"]

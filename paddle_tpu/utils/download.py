@@ -1,0 +1,38 @@
+"""paddle.utils.download (reference: python/paddle/utils/download.py
+get_weights_path_from_url / get_path_from_url). Zero-egress environment:
+resolution is local-only — a URL maps to its basename under
+``PADDLE_HOME`` (or an explicit ``root_dir``); a missing file raises
+with the exact path to provide. md5 verification runs when requested."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.join(
+    os.environ.get("PADDLE_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache",
+                                "paddle")), "hapi", "weights")
+
+
+def _md5check(fullname, md5sum=None):
+    from ..dataset.common import md5file
+    return md5sum is None or md5file(fullname) == md5sum
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = os.path.basename(url.split("?")[0])
+    fullname = os.path.join(root_dir, fname)
+    if os.path.isfile(fullname):
+        if not _md5check(fullname, md5sum):
+            raise RuntimeError(
+                f"{fullname} exists but fails its md5 check ({md5sum}); "
+                f"replace it with a good copy")
+        return fullname
+    raise RuntimeError(
+        f"automatic download is unavailable (zero egress); fetch {url} "
+        f"yourself and place it at {fullname}")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,73 @@
+"""paddle.utils.{dlpack,cpp_extension,download} (reference:
+python/paddle/utils/dlpack.py, cpp_extension/, download.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_dlpack_roundtrip_with_torch():
+    torch = pytest.importorskip("torch")
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = paddle.utils.dlpack.to_dlpack(t)
+    tt = torch.utils.dlpack.from_dlpack(cap)
+    assert tt.shape == (2, 3)
+    np.testing.assert_allclose(tt.numpy(), t.numpy())
+    # torch -> paddle
+    src = torch.arange(4, dtype=torch.float32)
+    back = paddle.utils.dlpack.from_dlpack(src)
+    np.testing.assert_allclose(back.numpy(), src.numpy())
+
+
+def test_cpp_extension_builds_and_registers_op(tmp_path):
+    src = tmp_path / "axpy.cc"
+    src.write_text(
+        '#include <cstdint>\n'
+        'extern "C" void axpy(const float* x, float* out, int64_t n,'
+        ' float a) {\n'
+        '  for (int64_t i = 0; i < n; ++i) out[i] = a * x[i] + 1.0f;\n'
+        '}\n')
+    from paddle_tpu.utils import cpp_extension as cpp
+
+    mod = cpp.load("axpy_ext", [str(src)],
+                   build_directory=str(tmp_path))
+    import ctypes
+
+    api = cpp.register_custom_op("custom_axpy", mod, "axpy",
+                                 arg_ctypes=[ctypes.c_float])
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out = api(x, 3.0)
+    np.testing.assert_allclose(out.numpy(), 3.0 * x.numpy() + 1.0)
+
+    # visible to the registry like any op: override and restore
+    from paddle_tpu.core.dispatch import OPS
+    assert "custom_axpy" in OPS
+    # the op works under jit too (pure_callback host call)
+    from paddle_tpu.jit import to_static
+
+    f = to_static(lambda t: api(t, 2.0) * 1.0)
+    np.testing.assert_allclose(f(x).numpy(), 2.0 * x.numpy() + 1.0)
+
+    with pytest.raises(NotImplementedError):
+        cpp.CUDAExtension()
+
+
+def test_download_local_resolution(tmp_path, monkeypatch):
+    from paddle_tpu.utils import download
+
+    f = tmp_path / "weights.pdparams"
+    f.write_bytes(b"abc")
+    got = download.get_path_from_url("http://x/weights.pdparams",
+                                     str(tmp_path))
+    assert got == str(f)
+    import hashlib
+
+    md5 = hashlib.md5(b"abc").hexdigest()
+    assert download.get_path_from_url("http://x/weights.pdparams",
+                                      str(tmp_path), md5sum=md5) == str(f)
+    with pytest.raises(RuntimeError, match="md5"):
+        download.get_path_from_url("http://x/weights.pdparams",
+                                   str(tmp_path), md5sum="0" * 32)
+    with pytest.raises(RuntimeError, match="zero egress"):
+        download.get_path_from_url("http://x/missing.bin", str(tmp_path))
