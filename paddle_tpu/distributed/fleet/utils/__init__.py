@@ -92,3 +92,5 @@ class LocalFS:
             return []
         return [f for f in os.listdir(fs_path)
                 if os.path.isdir(os.path.join(fs_path, f))]
+
+from . import sequence_parallel_utils  # noqa: E402,F401

@@ -1,0 +1,198 @@
+"""Megatron-style sequence-parallel utilities.
+
+Reference: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp:85, GatherOp:97, AllGatherOp:111,
+ReduceScatterOp:127, mark_as_sequence_parallel_parameter:148,
+register_sequence_parallel_allreduce_hooks:192,
+ColumnSequenceParallelLinear:429 / RowSequenceParallelLinear).
+
+These are the EAGER PyLayer forms over the model-parallel group's
+collectives — activations sharded on the sequence axis between the
+norm/dropout region and the TP matmuls. The compiled/long-context tier
+on this stack is distributed/context_parallel.py (ring + Ulysses over
+shard_map), which the reference does not have; this module covers the
+reference's migration surface. Conventions (matching the reference):
+the sequence axis is dim 0 ([s, b, h] layout), scatter splits it across
+the MP group, gather concatenates it back.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....autograd.py_layer import PyLayer
+from ....core.tensor import Tensor
+from ...collective import all_reduce, get_rank, get_world_size
+
+
+def _mp_group_info(group=None):
+    """(rank, world) inside the model-parallel group (the whole world
+    when no hybrid topology is initialized)."""
+    try:
+        from .. import get_hybrid_communicate_group
+        hcg = get_hybrid_communicate_group()
+        return (hcg.get_model_parallel_rank(),
+                hcg.get_model_parallel_world_size(),
+                hcg.get_model_parallel_group())
+    except Exception:
+        return get_rank(), get_world_size(), group
+
+
+def _split_local(x, rank, world):
+    s = x.shape[0]
+    assert s % world == 0, (
+        f"sequence length {s} not divisible by mp world {world}")
+    shard = s // world
+    return x[rank * shard:(rank + 1) * shard]
+
+
+def _all_gather_seq(x, group):
+    from ...collective import all_gather
+    parts: list = []
+    all_gather(parts, x if isinstance(x, Tensor) else Tensor(x),
+               group=group, axis=0)
+    if not parts:
+        return x
+    return Tensor(jnp.concatenate([p._data for p in parts], axis=0),
+                  stop_gradient=True)
+
+
+def _reduce_scatter_seq(x, group):
+    rank, world, _ = _mp_group_info(group)
+    if world == 1:
+        return x
+    red = Tensor(x._data) if isinstance(x, Tensor) else Tensor(x)
+    all_reduce(red, group=group)
+    return Tensor(_split_local(red._data, rank, world), stop_gradient=True)
+
+
+class ScatterOp(PyLayer):
+    """forward: keep this rank's sequence shard; backward: all-gather
+    the grads (reference :85)."""
+
+    @staticmethod
+    def forward(ctx, input, group=None):
+        rank, world, g = _mp_group_info(group)
+        ctx.group = g
+        return Tensor(_split_local(input._data, rank, world),
+                      stop_gradient=True)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return _all_gather_seq(grad, ctx.group)
+
+
+class GatherOp(PyLayer):
+    """forward: all-gather the sequence axis; backward: keep the local
+    shard (reference :97)."""
+
+    @staticmethod
+    def forward(ctx, input, group=None):
+        rank, world, g = _mp_group_info(group)
+        ctx.rank, ctx.world = rank, world
+        return _all_gather_seq(input, g)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return Tensor(_split_local(grad._data, ctx.rank, ctx.world),
+                      stop_gradient=True)
+
+
+class AllGatherOp(PyLayer):
+    """forward: all-gather; backward: reduce-scatter (reference :111)."""
+
+    @staticmethod
+    def forward(ctx, input, group=None):
+        _, _, g = _mp_group_info(group)
+        ctx.group = g
+        return _all_gather_seq(input, g)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return _reduce_scatter_seq(grad, ctx.group)
+
+
+class ReduceScatterOp(PyLayer):
+    """forward: reduce-scatter; backward: all-gather (reference :127)."""
+
+    @staticmethod
+    def forward(ctx, input, group=None):
+        _, _, g = _mp_group_info(group)
+        ctx.group = g
+        return _reduce_scatter_seq(input, g)
+
+    @staticmethod
+    def backward(ctx, grad):
+        return _all_gather_seq(grad, ctx.group)
+
+
+def scatter(input, group=None):
+    return ScatterOp.apply(input, group)
+
+
+def all_gather(input, group=None):
+    return AllGatherOp.apply(input, group)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Mark a replicated parameter living inside the sequence-parallel
+    region (norm scales/biases): its grads are PARTIAL over the mp group
+    and need an all-reduce (reference :148)."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """Attach grad hooks all-reducing marked parameters' gradients over
+    the mp group (reference :192). accumulation_steps: the hook fires on
+    every accumulation but the reduce happens once per step boundary —
+    here each hook reduces immediately (correct for SUM; the reference's
+    deferred variant is a fusion optimization)."""
+    _, world, g = _mp_group_info(None)
+
+    def _hook(grad):
+        if world == 1:
+            return grad
+        t = Tensor(grad._data)
+        all_reduce(t, group=g)
+        return Tensor(t._data, stop_gradient=True)
+
+    n = 0
+    for p in model.parameters():
+        if is_sequence_parallel_parameter(p):
+            p._grad_hooks.append(_hook)
+            n += 1
+    return n
+
+
+from ..mp_layers import ColumnParallelLinear, RowParallelLinear
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Reference :429. On the GSPMD regime these ARE the plain parallel
+    linears: sequence parallelism is the INPUT's sharding annotation
+    (activations sharded on the sequence axis between the norm/dropout
+    region and the matmul), and XLA inserts the all-gather the
+    reference's eager forward performs explicitly — same collective,
+    compiler-scheduled (it overlaps with the matmul, which the
+    reference's SPInnerOverlapLinear hand-builds). The class exists so
+    reference model code ports verbatim; the eager multi-process regime
+    uses the PyLayer ops above directly."""
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Reference RowSequenceParallelLinear: the partial outputs
+    reduce-scatter over the sequence axis. Under GSPMD, annotate the
+    OUTPUT sequence-sharded and XLA lowers the partial-sum resolution to
+    a reduce-scatter instead of the all-reduce (same cost model as the
+    reference's explicit collective)."""
+
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]

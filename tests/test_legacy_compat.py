@@ -165,3 +165,33 @@ def test_lbfgs_rejects_l1_decay():
     with pytest.raises(NotImplementedError, match="L1Decay"):
         paddle.optimizer.LBFGS(parameters=[p],
                                weight_decay=paddle.regularizer.L1Decay(0.1))
+
+
+def test_sequence_parallel_utils_single_process():
+    """Megatron-SP utility surface (reference: fleet/utils/
+    sequence_parallel_utils.py): single-process semantics (world=1 —
+    scatter/gather identity), parameter marking + allreduce hooks."""
+    spu = paddle.distributed.fleet.utils.sequence_parallel_utils
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3),
+                         stop_gradient=False)
+    s = spu.scatter(x)
+    np.testing.assert_allclose(s.numpy(), x.numpy())  # world=1: identity
+    g = spu.GatherOp.apply(s)
+    np.testing.assert_allclose(g.numpy(), x.numpy())
+    out = spu.ReduceScatterOp.apply(spu.AllGatherOp.apply(g))
+    (out * 2.0).sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), np.full((4, 3), 2.0))
+
+    lin = paddle.nn.Linear(3, 3)
+    spu.mark_as_sequence_parallel_parameter(lin.bias)
+    assert spu.is_sequence_parallel_parameter(lin.bias)
+    assert not spu.is_sequence_parallel_parameter(lin.weight)
+    n = spu.register_sequence_parallel_allreduce_hooks(lin)
+    assert n == 1
+    y = lin(x.detach())
+    y.sum().backward()
+    assert lin.bias.grad is not None
+    # the SP linear classes resolve (GSPMD regime: plain parallel linears)
+    assert spu.ColumnSequenceParallelLinear is not None
+    assert spu.RowSequenceParallelLinear is not None
