@@ -94,3 +94,5 @@ class LocalFS:
                 if os.path.isdir(os.path.join(fs_path, f))]
 
 from . import sequence_parallel_utils  # noqa: E402,F401
+from . import hybrid_parallel_util  # noqa: E402,F401
+from . import mix_precision_utils  # noqa: E402,F401

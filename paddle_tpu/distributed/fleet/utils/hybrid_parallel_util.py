@@ -1,0 +1,72 @@
+"""fleet.utils.hybrid_parallel_util (reference: fleet/utils/
+hybrid_parallel_util.py — fused_allreduce_gradients:262,
+broadcast_mp_parameters / broadcast_dp_parameters / broadcast_sharding_
+parameters). The eager multi-process regime's manual grad-sync helpers:
+used with no_sync()-style accumulation, or by models whose layers
+bypass DataParallel's reducer."""
+from __future__ import annotations
+
+from ....core.tensor import Tensor
+from ...collective import all_reduce, broadcast, get_world_size
+
+
+def _group_of(hcg, kind):
+    if hcg is None:
+        return None
+    getter = {
+        "mp": "get_model_parallel_group",
+        "dp": "get_data_parallel_group",
+        "sharding": "get_sharding_parallel_group",
+    }[kind]
+    try:
+        return getattr(hcg, getter)()
+    except Exception:
+        return None
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """All-reduce every parameter's gradient over the data-parallel group
+    (reference :262; the 'fused' in the reference name is its multi-tensor
+    coalescing — one XLA all-reduce per grad is already a single fused
+    collective per buffer here, and PJRT batches the launches)."""
+    group = _group_of(hcg, "dp")
+    world = get_world_size() if group is None else len(
+        getattr(group, "ranks", [])) or get_world_size()
+    if world <= 1:
+        return
+    scale = 1.0 / world
+    for p in parameter_list:
+        if p.grad is None:
+            continue
+        g = Tensor(p.grad._data)
+        all_reduce(g, group=group)
+        p.grad = Tensor(g._data * scale, stop_gradient=True)
+
+
+def broadcast_mp_parameters(model, hcg):
+    _broadcast_params(model, _group_of(hcg, "mp"))
+
+
+def broadcast_dp_parameters(model, hcg):
+    _broadcast_params(model, _group_of(hcg, "dp"))
+
+
+def broadcast_sharding_parameters(model, hcg):
+    _broadcast_params(model, _group_of(hcg, "sharding"))
+
+
+def _broadcast_params(model, group):
+    """Broadcast every parameter from the group's rank-0 (reference:
+    _broadcast_data_help) — the init-time sync that makes replicated
+    ranks bitwise-identical before step 0."""
+    if get_world_size() <= 1:
+        return
+    src = (getattr(group, "ranks", None) or [0])[0]
+    for p in model.parameters():
+        t = Tensor(p._data)
+        broadcast(t, src=src, group=group)
+        p._data = t._data
+
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters", "broadcast_sharding_parameters"]

@@ -1,0 +1,80 @@
+"""fleet.utils.mix_precision_utils (reference: fleet/utils/
+mix_precision_utils.py — MixPrecisionLayer:35 keeps a fp32 ``main_grad``
+per parameter via grad hooks; MixPrecisionOptimizer:97 steps on those
+fp32 grads). On this stack the same capability ships as
+``amp.decorate(..., master_grad=True)`` (amp/auto_cast.py) — these
+classes keep the reference names and the ``main_grad`` attribute
+contract for code that reads it directly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .... import nn
+from ....core.tensor import Tensor
+
+
+class MixPrecisionLayer(nn.Layer):
+    """Wraps ``layers`` so every parameter gradient accumulates into a
+    float32 ``param.main_grad`` the moment it is produced (the low
+    precision grad buffer is dropped — reference :49 param_hook)."""
+
+    def __init__(self, layers, dtype="float16"):
+        super().__init__()
+        assert dtype in ("float16", "bfloat16"), dtype
+        self._layers = layers
+        self._dtype = dtype
+        for param in layers.parameters():
+            if not hasattr(param, "main_grad"):
+                param.main_grad = None
+                param._grad_hooks.append(self._update_main_grad_hook(param))
+
+    def _update_main_grad_hook(self, param):
+        def param_hook(tmp_grad):
+            if tmp_grad is not None:
+                g32 = tmp_grad._data.astype(jnp.float32)
+                if param.main_grad is None:
+                    param.main_grad = Tensor(g32, stop_gradient=True)
+                else:
+                    param.main_grad = Tensor(param.main_grad._data + g32,
+                                             stop_gradient=True)
+            # keep the regular .grad in fp32 too so optimizers that read
+            # .grad step on the accumulated fp32 value
+            return param.main_grad
+
+        return param_hook
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+
+class MixPrecisionOptimizer:
+    """Steps the inner optimizer on the fp32 ``main_grad``s and clears
+    them (reference :97)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        for p in self._inner_opt._parameter_list:
+            mg = getattr(p, "main_grad", None)
+            if mg is not None:
+                p.grad = mg
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._inner_opt._parameter_list:
+            if hasattr(p, "main_grad"):
+                p.main_grad = None
+        self._inner_opt.clear_grad(set_to_zero)
+
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]

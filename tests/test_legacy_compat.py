@@ -195,3 +195,41 @@ def test_sequence_parallel_utils_single_process():
     # the SP linear classes resolve (GSPMD regime: plain parallel linears)
     assert spu.ColumnSequenceParallelLinear is not None
     assert spu.RowSequenceParallelLinear is not None
+
+
+def test_mix_precision_utils_main_grad():
+    """MixPrecisionLayer accumulates fp32 main_grad across backward
+    passes; MixPrecisionOptimizer steps on it (reference: fleet/utils/
+    mix_precision_utils.py:35/:97)."""
+    mpu = paddle.distributed.fleet.utils.mix_precision_utils
+    net = paddle.nn.Linear(3, 1)
+    net.weight._inplace_update(net.weight._data.astype("bfloat16"))
+    net.bias._inplace_update(net.bias._data.astype("bfloat16"))
+    wrapped = mpu.MixPrecisionLayer(net, dtype="bfloat16")
+    opt = mpu.MixPrecisionOptimizer(
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters()))
+    x = paddle.to_tensor(np.ones((4, 3), np.float32)).astype("bfloat16")
+    for _ in range(2):  # grad accumulation: two backwards, one step
+        loss = wrapped(x).sum()
+        loss.backward()
+    assert net.weight.main_grad is not None
+    assert str(net.weight.main_grad.dtype).endswith("float32")
+    np.testing.assert_allclose(net.weight.main_grad.numpy().ravel(),
+                               np.full(3, 8.0), rtol=1e-2)
+    w0 = net.weight.numpy().astype(np.float32).copy()
+    opt.step()
+    opt.clear_grad()
+    assert net.weight.main_grad is None
+    assert not np.allclose(net.weight.numpy().astype(np.float32), w0)
+
+
+def test_hybrid_parallel_util_single_process():
+    hpu = paddle.distributed.fleet.utils.hybrid_parallel_util
+    net = paddle.nn.Linear(3, 1)
+    loss = net(paddle.to_tensor(np.ones((2, 3), np.float32))).sum()
+    loss.backward()
+    g0 = net.weight.grad.numpy().copy()
+    hpu.fused_allreduce_gradients(list(net.parameters()), None)
+    np.testing.assert_allclose(net.weight.grad.numpy(), g0)  # world=1
+    hpu.broadcast_dp_parameters(net, None)  # no-op at world=1
