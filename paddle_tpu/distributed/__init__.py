@@ -44,3 +44,8 @@ from .hybrid_parallel import build_hybrid_step  # noqa: F401
 from .watchdog import (  # noqa: F401
     CommWatchdog, enable_comm_watchdog, disable_comm_watchdog,
 )
+from . import communication  # noqa: F401
+from .communication import (  # noqa: F401
+    isend, irecv, P2POp, batch_isend_irecv, all_to_all_single,
+    get_group, get_backend, stream,
+)

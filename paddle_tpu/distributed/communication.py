@@ -1,0 +1,161 @@
+"""paddle.distributed.communication tail — async P2P, batched P2P,
+all_to_all_single, group queries, and the ``stream`` namespace.
+
+Reference: python/paddle/distributed/communication/
+(batch_isend_irecv.py:36 P2POp, :134 batch_isend_irecv; send.py:68
+isend; recv.py:68 irecv; all_to_all.py all_to_all_single; group.py:213
+get_group, :364 get_backend; stream/ — the use_calc_stream variants).
+
+Async semantics on this stack: PJRT dispatch is already asynchronous,
+and the eager multi-process P2P rides the coordination-service mailbox;
+a ``task`` wraps completion (``wait()``/``is_completed()``) the way the
+reference's task object wraps the NCCL event.
+"""
+from __future__ import annotations
+
+import threading
+
+from .collective import (
+    all_to_all, barrier, get_rank, get_world_size, recv, send,
+)
+
+
+class _Task:
+    """Completion handle (the reference's communication task)."""
+
+    def __init__(self, fn=None):
+        self._done = fn is None
+        self._exc = None
+        if fn is not None:
+            def run():
+                try:
+                    fn()
+                except BaseException as e:  # surfaced on wait()
+                    self._exc = e
+                finally:
+                    self._done = True
+            self._thread = threading.Thread(target=run, daemon=True)
+            self._thread.start()
+
+    def is_completed(self):
+        return self._done
+
+    def wait(self, timeout=None):
+        t = getattr(self, "_thread", None)
+        if t is not None:
+            t.join(timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._done
+
+
+def isend(tensor, dst=0, group=None):
+    """Async send (reference: send.py:68). The mailbox put runs on a
+    worker thread; wait() joins it."""
+    return _Task(lambda: send(tensor, dst=dst, group=group))
+
+
+def irecv(tensor, src=0, group=None):
+    """Async recv (reference: recv.py:68): tensor is filled when the
+    returned task completes."""
+    return _Task(lambda: recv(tensor, src=src, group=group))
+
+
+class P2POp:
+    """One batched P2P operation (reference: batch_isend_irecv.py:36):
+    ``op`` is ``paddle.distributed.isend`` or ``paddle.distributed.irecv``."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError(
+                "P2POp op must be paddle.distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a list of P2POps together (reference :134). Sends are
+    issued before receives block, so symmetric exchange patterns cannot
+    deadlock the mailbox."""
+    if not p2p_op_list:
+        return []
+    tasks = []
+    ordered = ([p for p in p2p_op_list if p.op is isend]
+               + [p for p in p2p_op_list if p.op is irecv])
+    for p in ordered:
+        if p.op is isend:
+            tasks.append(isend(p.tensor, dst=p.peer, group=p.group))
+        else:
+            tasks.append(irecv(p.tensor, src=p.peer, group=p.group))
+    return tasks
+
+
+def all_to_all_single(out_tensor, in_tensor, in_split_sizes=None,
+                      out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference: all_to_all.py
+    all_to_all_single): the first axis splits evenly (or per
+    ``in_split_sizes``) across ranks; rank j's i-th split lands in rank
+    i's j-th output slot."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    world = get_world_size()
+    data = in_tensor._data if isinstance(in_tensor, Tensor) else in_tensor
+    if in_split_sizes:
+        idx, ins = 0, []
+        for s in in_split_sizes:
+            ins.append(Tensor(data[idx:idx + s]))
+            idx += s
+    else:
+        ins = [Tensor(c) for c in jnp.split(data, world, axis=0)]
+    outs: list = []
+    all_to_all(outs, ins, group=group, sync_op=sync_op)
+    res = jnp.concatenate([o._data for o in outs], axis=0)
+    out_tensor._data = res
+    return out_tensor
+
+
+def get_group(id=0):
+    """Look up a communication group by id (reference: group.py:213).
+    id 0 is the default (global) group."""
+    from . import collective as C
+    if id == 0:
+        return C.init_parallel_env()
+    for g in getattr(C, "_group_registry", {}).values():
+        if getattr(g, "id", None) == id:
+            return g
+    raise ValueError(f"no communication group with id {id}")
+
+
+def get_backend(group=None):
+    """The communication backend's name (reference: group.py:364). XLA
+    collectives over ICI/DCN play NCCL's role on this stack."""
+    return "XLA"
+
+
+class _StreamNamespace:
+    """``paddle.distributed.stream`` (reference: communication/stream/):
+    the use_calc_stream variants. XLA schedules collectives on the
+    compute stream already, so these alias the plain collectives with
+    the extra arg accepted."""
+
+    def __getattr__(self, name):
+        from . import collective as C
+        base = getattr(C, name, None)
+        if base is None:
+            raise AttributeError(name)
+
+        def call(*args, use_calc_stream=True, **kwargs):
+            return base(*args, **kwargs)
+
+        call.__name__ = name
+        return call
+
+
+stream = _StreamNamespace()
+
+__all__ = ["isend", "irecv", "P2POp", "batch_isend_irecv",
+           "all_to_all_single", "get_group", "get_backend", "stream"]

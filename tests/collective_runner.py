@@ -130,6 +130,30 @@ def main():
         dist.all_reduce(t, group=sub)
         record("subgroup_nonmember_noop", np.allclose(t.numpy(), base[rank]))
 
+    # batched async P2P: symmetric exchange via batch_isend_irecv
+    # (reference: communication/batch_isend_irecv.py) — rank0 <-> rank1
+    if rank in (0, 1) and world >= 2:
+        peer = 1 - rank
+        mine = paddle.to_tensor(np.full(3, 10.0 + rank, np.float32))
+        theirs = paddle.to_tensor(np.zeros(3, np.float32))
+        ops = [dist.P2POp(dist.isend, mine, peer),
+               dist.P2POp(dist.irecv, theirs, peer)]
+        for t_ in dist.batch_isend_irecv(ops):
+            t_.wait()
+        record("batch_isend_irecv",
+               np.allclose(theirs.numpy(), np.full(3, 10.0 + peer)))
+
+    # all_to_all_single is a COLLECTIVE: every rank participates
+    rows = 2 * world
+    src = paddle.to_tensor(
+        np.arange(rows, dtype=np.float32) + 100 * rank)
+    dst = paddle.to_tensor(np.zeros(rows, np.float32))
+    dist.all_to_all_single(dst, src)
+    want = np.concatenate([
+        (np.arange(rows, dtype=np.float32) + 100 * r)[
+            rank * 2:(rank + 1) * 2] for r in range(world)])
+    record("all_to_all_single", np.allclose(dst.numpy(), want))
+
     dist.barrier()
     with open(out_path, "w") as f:
         f.write("\n".join(results) + "\n")

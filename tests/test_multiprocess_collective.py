@@ -46,7 +46,8 @@ def test_collectives_across_processes(tmp_path):
     assert {"all_reduce_sum", "all_gather", "reduce_scatter", "broadcast",
             "all_to_all", "scatter", "send", "all_gather_object",
             "subgroup_all_reduce", "subgroup_broadcast",
-            "subgroup_all_gather", "subgroup_barrier"} <= names0
+            "subgroup_all_gather", "subgroup_barrier",
+            "batch_isend_irecv", "all_to_all_single"} <= names0
     names1 = {l.split()[1] for l in open(f"{out}.1").read().splitlines()}
     assert "recv" in names1 and "subgroup_all_reduce" in names1
 
