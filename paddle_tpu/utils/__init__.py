@@ -114,9 +114,9 @@ def run_check():
     """Install sanity check (reference: paddle.utils.run_check): runs a
     tiny matmul fwd/bwd on the current device and prints the verdict."""
     import numpy as np
-    from . import tensor as T
-    from .core.tensor import Tensor
-    from .core.place import get_default_place
+    from .. import tensor as T
+    from ..core.tensor import Tensor
+    from ..core.place import get_default_place
     a = Tensor(np.ones((2, 3), np.float32), stop_gradient=False)
     b = Tensor(np.ones((3, 2), np.float32))
     out = T.matmul(a, b).sum()
@@ -127,10 +127,11 @@ def run_check():
 
 def require_version(min_version, max_version=None):
     """Version gate (reference: utils/install_check.py require_version)."""
-    from . import version
+    from .. import version
 
     def parse(v):
-        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))
 
     cur = parse(version.full_version)
     if parse(min_version) > cur:
