@@ -70,6 +70,14 @@ from . import dataset  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
 
+# Late Tensor-method patching for functions living outside paddle_tpu.tensor
+# (reference tensor_method_func parity; see tensor/__init__.py).
+tensor._patch_tensor_method_tail()
+top_p_sampling = tensor.search.top_p_sampling
+set_ = tensor.creation.set_
+resize_ = tensor.creation.resize_
+create_tensor = tensor.creation.create_tensor
+
 # Pallas kernel tier: overrides op bodies on TPU (no-op on CPU unless
 # PADDLE_TPU_FORCE_PALLAS=1 — the interpret-mode CI path).
 from . import kernels as _kernels  # noqa: E402

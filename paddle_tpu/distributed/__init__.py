@@ -49,3 +49,4 @@ from .communication import (  # noqa: F401
     isend, irecv, P2POp, batch_isend_irecv, all_to_all_single,
     get_group, get_backend, stream,
 )
+from . import passes  # noqa: E402,F401

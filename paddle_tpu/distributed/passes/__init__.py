@@ -1,0 +1,179 @@
+"""Distributed pass framework surface (reference:
+python/paddle/distributed/passes/pass_base.py — PassBase registry,
+new_pass:131, PassManager:350, PassContext:20).
+
+On this stack the graph rewrites those passes perform are owned by the
+platform: XLA does the fusion tier (fuse_*, inplace_addto), GSPMD does
+the parallel-transform tier (auto_parallel_*), and the jit/amp/recompute
+subsystems do the rest at trace time. The framework surface is kept so
+strategy code that builds pass pipelines ports unchanged: every
+reference pass name is registered, `apply` records what ran into the
+PassContext, and each pass maps to the equivalent live mechanism where
+one exists (noted in ``EQUIVALENTS``) — it never silently claims to
+rewrite a Program this stack does not have.
+"""
+from __future__ import annotations
+
+from ...core.vlog import vlog
+
+# reference pass-name registry (grep @register_pass over
+# python/paddle/distributed/passes/) -> how this stack provides it
+EQUIVALENTS = {
+    "auto_parallel_amp": "paddle.amp.auto_cast at trace time",
+    "auto_parallel_fp16": "paddle.amp.auto_cast(level='O2')",
+    "auto_parallel_recompute": "paddle.distributed.fleet.recompute / "
+                               "jax.checkpoint",
+    "auto_parallel_recompute_pir": "jax.checkpoint",
+    "auto_parallel_sharding": "GSPMD sharding propagation",
+    "auto_parallel_gradient_merge_pass": "TrainStep(accumulate_steps=...)",
+    "auto_parallel_master_grad_pass": "mix_precision_utils fp32 main_grad",
+    "auto_parallel_grad_clip": "HybridParallelOptimizer sharded clip",
+    "auto_parallel_sequence_parallel_optimization":
+        "fleet.utils.sequence_parallel_utils",
+    "auto_parallel_data_parallel_optimization": "GSPMD + XLA collective "
+                                                "scheduling",
+    "auto_parallel_supplement_explicit_dependencies": "XLA dataflow order",
+    "auto_parallel_c_embedding_pass": "VocabParallelEmbedding",
+    "auto_parallel_fused_linear_promotion": "XLA fusion",
+    "auto_parallel_quantization": "paddle.quantization QAT/PTQ",
+    "allreduce_matmul_grad_overlapping": "XLA latency-hiding scheduler",
+    "replace_with_parallel_cross_entropy": "ParallelCrossEntropy",
+    "fuse_all_reduce": "XLA collective combiner",
+    "fuse_adamw": "fused optimizer update (jit)",
+    "fuse_optimizer": "fused optimizer update (jit)",
+    "fuse_elewise_add_act": "XLA elementwise fusion",
+    "fuse_bn_act": "XLA fusion",
+    "fuse_bn_add_act": "XLA fusion",
+    "fuse_gemm_epilogue": "XLA matmul epilogue fusion",
+    "fuse_dot_product_attention": "F.scaled_dot_product_attention / flash",
+    "fuse_relu_depthwise_conv": "XLA fusion",
+    "fuse_resunit": "XLA fusion",
+    "fused_attention": "incubate fused_multi_head_attention",
+    "fused_feedforward": "incubate fused_feedforward",
+    "inplace_addto_op": "XLA buffer donation",
+    "build_cinn": "XLA (whole-graph compile)",
+    "pipeline_scheduler_pass": "distributed.pipeline_schedule job lists",
+}
+
+# parameter-server / heter passes: sanctioned descope (SURVEY.md §7)
+_PS_PASSES = [
+    "add_geo_optimizer_pass", "add_listen_and_serv_pass",
+    "add_lr_decay_table_pass", "add_optimizer_pass",
+    "add_rpc_global_flags_pass", "append_send_ops_pass",
+    "build_pserver_startup_program_pass", "delete_extra_optimizer_pass",
+    "delete_optimizer_pass", "delete_unused_in_startup_pass",
+    "distributed_ops_pass", "fake_init_ops_pass", "ps_gpu_pass",
+    "ps_transpile_pass", "set_heter_pipeline_opt_pass", "split_fl_ops_pass",
+    "split_heter_worker_ops_pass", "split_trainer_ops_pass",
+]
+
+
+class PassType:
+    UNKNOWN = 0
+    COMM_OPT = 1
+    CALC_OPT = 2
+    PARALLEL_OPT = 3
+    FUSION_OPT = 4
+
+
+class PassContext:
+    """Carries cross-pass state and the record of applied passes
+    (reference: pass_base.py:20)."""
+
+    def __init__(self):
+        self._applied_passes = []
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    @property
+    def passes(self):
+        return tuple(self._applied_passes)
+
+
+class PassBase:
+    _REGISTERED_PASSES = {}
+
+    name = None
+
+    def __init__(self):
+        self._attrs = {}
+
+    def set_attr(self, key, value):
+        self._attrs[key] = value
+        return self
+
+    def get_attr(self, key, default=None):
+        return self._attrs.get(key, default)
+
+    def _check_self(self):
+        return True
+
+    def _check_conflict(self, other_pass):
+        return True
+
+    def apply(self, main_programs, startup_programs=None, context=None):
+        """Record application. The platform mechanism named in
+        EQUIVALENTS does the real work on this stack; PS-tier passes
+        raise (sanctioned descope)."""
+        if self.name in _PS_PASSES:
+            raise NotImplementedError(
+                f"pass {self.name}: parameter-server mode is a sanctioned "
+                "descope (SURVEY.md §7)")
+        context = context or PassContext()
+        context._applied_passes.append(self)
+        vlog(1, f"pass {self.name}: provided by "
+                f"{EQUIVALENTS.get(self.name, 'the XLA pipeline')}",
+             component="passes")
+        return context
+
+
+def register_pass(name):
+    def wrap(cls):
+        cls.name = name
+        PassBase._REGISTERED_PASSES[name] = cls
+        return cls
+    return wrap
+
+
+for _name in list(EQUIVALENTS) + _PS_PASSES:
+    register_pass(_name)(type(f"_Pass_{_name}", (PassBase,), {}))
+
+
+def new_pass(name, pass_attrs=None):
+    """reference: pass_base.py:131."""
+    cls = PassBase._REGISTERED_PASSES.get(name)
+    if cls is None:
+        raise AssertionError(f"Pass {name} is not registered")
+    p = cls()
+    for k, v in (pass_attrs or {}).items():
+        p.set_attr(k, v)
+    return p
+
+
+class PassManager:
+    """reference: pass_base.py:350 — ordered pass pipeline."""
+
+    def __init__(self, passes=None):
+        self._passes = list(passes or [])
+
+    def append(self, p):
+        self._passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        context = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, context)
+        return context
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]
+
+
+__all__ = ["new_pass", "PassManager", "PassContext", "PassBase",
+           "PassType", "register_pass"]

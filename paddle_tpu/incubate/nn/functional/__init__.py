@@ -298,3 +298,15 @@ def _segment(op_name, kind, data, segment_ids):
 __all__ += ["weight_quantize", "weight_dequantize", "weight_only_linear",
             "llm_int8_linear", "segment_sum", "segment_mean", "segment_max",
             "segment_min"]
+
+from .fused_transformer import (  # noqa: E402,F401
+    fused_feedforward, fused_bias_dropout_residual_layer_norm,
+    fused_linear_activation, fused_multi_head_attention, fused_moe,
+    variable_length_memory_efficient_attention, fused_multi_transformer,
+)
+
+__all__ += [
+    "fused_feedforward", "fused_bias_dropout_residual_layer_norm",
+    "fused_linear_activation", "fused_multi_head_attention", "fused_moe",
+    "variable_length_memory_efficient_attention", "fused_multi_transformer",
+]

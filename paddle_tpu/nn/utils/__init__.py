@@ -76,3 +76,66 @@ def weight_norm(layer, name="weight", dim=0):
 
 def remove_weight_norm(layer, name="weight"):
     return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral-norm reparameterization of a Layer's weight (reference:
+    python/paddle/nn/utils/spectral_norm_hook.py): weight is divided by
+    its largest singular value, estimated with ``n_power_iterations`` of
+    power iteration refreshed on every forward (training behavior)."""
+    import numpy as np
+    from ...nn.layer.layers import Parameter
+    from ...core.tensor import Tensor
+    w = getattr(layer, name)
+    if dim is None:
+        # reference default (spectral_norm_hook.py:237-241): dim=1 for
+        # Linear and transposed convs (out-features on axis 1), else 0
+        from ..layer.common import Linear
+        from ..layer import conv as _conv
+        dim1_types = (Linear,) + tuple(
+            t for t in (getattr(_conv, n, None) for n in
+                        ("Conv1DTranspose", "Conv2DTranspose",
+                         "Conv3DTranspose"))
+            if t is not None)
+        dim = 1 if isinstance(layer, dim1_types) else 0
+    mat = jnp.moveaxis(w._data, dim, 0).reshape(w._data.shape[dim], -1)
+    h, wdim = mat.shape
+    rng = np.random.default_rng(0)
+    u = jnp.asarray(rng.normal(size=(h,)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(wdim,)).astype(np.float32))
+    u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+    layer.register_buffer(name + "_u", Tensor(u))
+    layer.register_buffer(name + "_v", Tensor(v))
+    layer.add_parameter(name + "_orig", Parameter(w._data))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        from ...core.dispatch import eager_apply
+        w_orig = getattr(l, name + "_orig")
+        u_t = l._buffers[name + "_u"]
+        v_t = l._buffers[name + "_v"]
+        u_d, v_d = u_t._data, v_t._data
+        m = jnp.moveaxis(w_orig._data, dim, 0).reshape(
+            w_orig._data.shape[dim], -1)
+        for _ in range(max(1, int(n_power_iterations))):
+            v_d = m.T @ u_d
+            v_d = v_d / jnp.maximum(jnp.linalg.norm(v_d), eps)
+            u_d = m @ v_d
+            u_d = u_d / jnp.maximum(jnp.linalg.norm(u_d), eps)
+        u_t._data, v_t._data = u_d, v_d   # persistent power-iter state
+
+        def body(wv, uu, vv):
+            mm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            sigma = uu @ (mm @ vv)
+            return wv / jnp.maximum(sigma, eps)
+
+        w_new = eager_apply("spectral_norm_reparam", body,
+                            (w_orig, Tensor(u_d), Tensor(v_d)), {})
+        l._parameters.pop(name, None)
+        l._buffers[name] = w_new
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

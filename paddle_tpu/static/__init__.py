@@ -50,10 +50,19 @@ from .program import (  # noqa: E402,F401
     Program, Executor, Variable, program_guard, data,
     default_main_program, default_startup_program, global_scope,
     scope_guard, Scope, cpu_places, save, load,
+    append_backward, gradients, py_func, name_scope, Print,
 )
+from .extras import (  # noqa: E402,F401
+    BuildStrategy, CompiledProgram, ExponentialMovingAverage,
+    WeightNormParamAttr, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+)
+from ..nn.layer.layers import ParamAttr  # noqa: E402,F401
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "nn", "Program", "Executor", "Variable", "program_guard",
            "data", "default_main_program", "default_startup_program",
            "global_scope", "scope_guard", "Scope", "cpu_places", "save",
-           "load"]
+           "load", "append_backward", "gradients", "py_func", "name_scope",
+           "Print", "BuildStrategy", "CompiledProgram",
+           "ExponentialMovingAverage", "WeightNormParamAttr", "ParamAttr",
+           "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard"]

@@ -1,0 +1,197 @@
+"""Static-surface extras: compiled-program shims, EMA, weight-norm attr,
+vendor stubs.
+
+Reference: python/paddle/static/__init__.py exports. BuildStrategy /
+CompiledProgram configure the reference's graph-optimization passes —
+on this stack XLA owns those passes, so they are accepted-and-recorded
+config objects whose Program runs unchanged (the one real knob,
+fuse-ops, is always on in XLA). ExponentialMovingAverage is the real
+reference utility (python/paddle/static/nn/metric.py ExponentialMovingAverage
+analog at python/paddle/incubate/... — static/__init__ re-exports it from
+paddle.static); implemented over concrete Parameters.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .program import current_program, default_main_program
+
+
+class BuildStrategy:
+    """Attribute-bag parity shim (reference:
+    paddle/fluid/framework/details/build_strategy.h bound via pybind).
+    Every knob defaults to the reference default and is recorded; XLA's
+    pipeline replaces the pass list, so the knobs do not re-route
+    compilation on this stack."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.enable_addto = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_bn_add_act_ops = True
+        self.fuse_gemm_epilogue = False
+        self.fuse_relu_depthwise_conv = False
+        self.fuse_broadcast_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.memory_optimize = None
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+        self.build_cinn_pass = False
+
+    def __repr__(self):
+        on = [k for k, v in vars(self).items() if v is True]
+        return f"BuildStrategy({', '.join(on) or 'defaults'})"
+
+
+class CompiledProgram:
+    """Wrapper marking a Program for "compiled" execution (reference:
+    python/paddle/static/compiler.py CompiledProgram). Executor.run
+    unwraps it; the replay already executes per-op under XLA, and
+    whole-program compilation is paddle.jit.to_static's job."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        # reference legacy API: multi-card graph replication. Sharding on
+        # this stack is mesh-based (paddle.distributed); accept + record.
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        return self
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference:
+    python/paddle/static/nn/common.py ExponentialMovingAverage):
+    ``update()`` after each optimizer step; ``apply(exe)`` context swaps
+    the shadow values in (and restores on exit unless need_restore=False).
+
+    Applies over the current Program's concrete Parameters (or an
+    explicit ``parameter_list``) — the reference walks the program's
+    parameter variables the same way.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None,
+                 parameter_list=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._params = list(parameter_list) if parameter_list else None
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+
+    def _param_list(self):
+        if self._params is None:
+            prog = current_program() or default_main_program()
+            self._params = prog.parameters()
+        return self._params
+
+    def update(self):
+        """shadow = decay * shadow + (1 - decay) * param, with the
+        reference's thres_steps-style dynamic decay ramp
+        (min(decay, (1+step)/(10+step)))."""
+        self._step += 1
+        decay = min(self._decay, (1.0 + self._step) / (10.0 + self._step)) \
+            if self._thres_steps is not None else self._decay
+        for p in self._param_list():
+            key = id(p)
+            cur = p._data
+            if key not in self._shadow:
+                self._shadow[key] = cur
+            else:
+                self._shadow[key] = (decay * self._shadow[key]
+                                     + (1.0 - decay) * cur)
+
+    class _Apply:
+        def __init__(self, ema, need_restore):
+            self.ema = ema
+            self.need_restore = need_restore
+
+        def __enter__(self):
+            ema = self.ema
+            for p in ema._param_list():
+                if id(p) in ema._shadow:
+                    ema._backup[id(p)] = p._data
+                    p._data = jnp.asarray(ema._shadow[id(p)],
+                                          dtype=p._data.dtype)
+            return ema
+
+        def __exit__(self, *exc):
+            ema = self.ema
+            if self.need_restore:
+                for p in ema._param_list():
+                    if id(p) in ema._backup:
+                        p._data = ema._backup[id(p)]
+            ema._backup = {}
+            return False
+
+    def apply(self, executor=None, need_restore=True):
+        return self._Apply(self, need_restore)
+
+    def restore(self, executor=None):
+        for p in self._param_list():
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+from ..nn.layer.layers import ParamAttr as _ParamAttr  # noqa: E402
+
+
+class WeightNormParamAttr(_ParamAttr):
+    """ParamAttr requesting weight-norm reparameterization (reference:
+    python/paddle/static/param_attr.py WeightNormParamAttr). On this
+    stack the reparameterization itself is applied with
+    ``paddle.nn.utils.weight_norm`` on the constructed Layer; the attr
+    carries ``dim`` so porting code type-checks and documents intent."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.do_model_average = do_model_average
+        self.dim = dim
+
+
+# -- vendor (Graphcore IPU) stubs: sanctioned descope ----------------------
+
+class IpuStrategy:
+    """IPU vendor backend is not part of this stack (SURVEY.md §2.4:
+    single-accelerator TPU build; XPU/IPU/NPU backends are sanctioned
+    descopes). Constructing the strategy object is allowed so configs
+    parse; attaching it to execution raises."""
+
+    def __init__(self):
+        self._config = {}
+
+    def set_graph_config(self, **kwargs):
+        self._config.update(kwargs)
+
+    def set_pipelining_config(self, **kwargs):
+        self._config.update(kwargs)
+
+    def set_precision_config(self, **kwargs):
+        self._config.update(kwargs)
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        raise RuntimeError(
+            "IPU backend is not available on this stack (TPU build; "
+            "sanctioned vendor descope — SURVEY.md §2.4)")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError(
+        "IPU backend is not available on this stack (TPU build; "
+        "sanctioned vendor descope — SURVEY.md §2.4)")

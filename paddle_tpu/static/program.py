@@ -72,6 +72,8 @@ class Program:
         self._nodes: list[_Node] = []
         self._feeds: dict[str, Variable] = {}
         self._minimize = None    # (optimizer, loss Variable)
+        self._backward = None    # (loss Variable, [(param, grad Var)])
+        self._grad_requests = []  # (targets, inputs, grad Vars)
         self.random_seed = 0
 
     # -- reference API ----------------------------------------------------
@@ -82,8 +84,10 @@ class Program:
         p = Program()
         p._nodes = list(self._nodes)
         p._feeds = dict(self._feeds)
+        p._grad_requests = list(self._grad_requests)
         if not for_test:
             p._minimize = self._minimize
+            p._backward = self._backward
         else:
             # reference clone(for_test=True) switches train-mode ops to
             # eval: drop training flags and zero dropout rates
@@ -311,6 +315,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None):
         program = program if program is not None else _default_main
+        if hasattr(program, "_program"):   # CompiledProgram wrapper
+            program = program._program
         if program is _default_startup or not program._nodes:
             return []  # startup: parameter init already ran eagerly
         feed = feed or {}
@@ -319,8 +325,12 @@ class Executor:
         missing = [n for n in program._feeds if n not in feed]
         if missing:
             raise ValueError(f"Executor.run: missing feeds {missing}")
+        grad_inputs = {id(v) for _, ins, _ in program._grad_requests
+                       for v in ins}
         for name, var in program._feeds.items():
             t = Tensor(jax.numpy.asarray(feed[name]))
+            if id(var) in grad_inputs:
+                t.stop_gradient = False
             env[id(var)] = t
             scope.set(name, t._data)
 
@@ -346,14 +356,55 @@ class Executor:
                 for var, val in zip(node.outs, out_flat):
                     env[id(var)] = val
 
+            def _realized(v, role):
+                if not isinstance(v, Variable):
+                    return v          # concrete Tensor (e.g. a Parameter)
+                t = env.get(id(v))
+                if t is None:
+                    raise RuntimeError(
+                        f"gradients(): {role} Variable "
+                        f"{getattr(v, 'name', v)!r} was not produced by "
+                        "this program's replay")
+                return t
+
+            for targets, inputs, grad_vars in program._grad_requests:
+                from ..autograd import grad as _grad
+                tgt = [_realized(v, "target") for v in targets]
+                ins = [_realized(v, "input") for v in inputs]
+                gs = _grad(tgt, ins, retain_graph=True,
+                           allow_unused=True)
+                for gv, g in zip(grad_vars, gs):
+                    env[id(gv)] = g if g is not None else Tensor(
+                        jax.numpy.zeros(gv.shape, gv._data.dtype))
+
+            loss_to_backward = None
             if program._minimize is not None:
                 opt, loss_var = program._minimize
+                loss_to_backward = (loss_var, None)
+            elif program._backward is not None:
+                loss_to_backward = program._backward
+
+            if loss_to_backward is not None:
+                loss_var = loss_to_backward[0]
                 loss = env.get(id(loss_var))
                 if loss is None:
-                    raise RuntimeError("minimize loss not produced by replay")
+                    raise RuntimeError(
+                        "backward loss not produced by replay")
+                # each run() computes THIS run's grads (the reference's
+                # executor scope is fresh per run) — drop any grads left
+                # from a previous run without an optimizer clear
+                for p in program.parameters():
+                    p.grad = None
                 loss.backward()
-                opt.step()
-                opt.clear_grad()
+                if program._backward is not None:
+                    for param, gv in program._backward[1]:
+                        env[id(gv)] = param.grad if param.grad is not None \
+                            else Tensor(jax.numpy.zeros(
+                                param.shape, param._data.dtype))
+                if program._minimize is not None:
+                    opt = program._minimize[0]
+                    opt.step()
+                    opt.clear_grad()
 
             results = []
             by_name = None
@@ -364,6 +415,12 @@ class Executor:
                         by_name = {v.name: v for node in program._nodes
                                    for v in node.outs}
                         by_name.update(program._feeds)
+                        for _, _, gvs in program._grad_requests:
+                            by_name.update({g.name: g for g in gvs})
+                        if program._backward is not None:
+                            by_name.update(
+                                {g.name: g
+                                 for _, g in program._backward[1]})
                     if f not in by_name:
                         raise ValueError(f"fetch target {f!r}: no variable "
                                          f"of that name in the program")
@@ -405,3 +462,125 @@ def load(program, model_prefix, executor=None, var_list=None):
             arr = src._data if isinstance(src, Tensor) else jax.numpy.asarray(
                 np.asarray(src))
             p._inplace_update(arr.astype(p._data.dtype))
+
+
+# -- static autodiff surface ----------------------------------------------
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Record backward-from-``loss`` on the current Program (reference:
+    python/paddle/base/backward.py:1967). Replay runs the eager tape
+    backward after the forward nodes; returns ``[(param, grad_var)]``
+    pairs whose grad Variables are fetchable by name (``<param>@GRAD``).
+    """
+    prog = current_program()
+    params = parameter_list if parameter_list is not None \
+        else prog.parameters()
+    if no_grad_set:
+        drop = {id(p) for p in no_grad_set}
+        params = [p for p in params if id(p) not in drop]
+    pairs = []
+    for i, p in enumerate(params):
+        name = getattr(p, "name", None) or f"param_{i}"
+        gv = Variable(f"{name}@GRAD", list(p.shape), str(p._data.dtype),
+                      stop_gradient=True)
+        pairs.append((p, gv))
+    prog._backward = (loss, pairs)
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """Record grads of ``targets`` w.r.t. ``inputs`` (reference:
+    python/paddle/base/backward.py gradients): replay computes them with
+    ``paddle.autograd.grad`` over the realized tensors. Returns one grad
+    Variable per input, fetchable like any output."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "gradients(target_gradients=...) is not supported; seed grads "
+            "default to ones as in the reference's common path")
+    prog = current_program()
+    gvs = [Variable(f"{getattr(v, 'name', f'x_{i}')}@GRAD",
+                    list(v.shape), str(v._data.dtype), stop_gradient=True)
+           for i, v in enumerate(inputs)]
+    prog._grad_requests.append((list(targets), list(inputs), gvs))
+    return gvs
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Record an arbitrary host-Python op (reference: static/nn/common.py
+    py_func): ``out`` declares the result shapes (the reference requires
+    pre-created out vars for the same reason — no shape inference through
+    host code). ``backward_func`` is unsupported: replay runs through the
+    eager tape, so differentiable host ops belong in a PyLayer."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func(backward_func=...): wrap host code in a PyLayer for "
+            "gradients on this stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    prog = current_program()
+    # ``out`` vars are shape DECLARATIONS (usually made with static.data,
+    # the only public Variable constructor) — they are produced by this
+    # node, not fed, so unregister them from the feed list
+    out_ids = {id(ov) for ov in outs}
+    for name in [n for n, v in prog._feeds.items() if id(v) in out_ids]:
+        del prog._feeds[name]
+
+    def _body(*arrays):
+        res = func(*[Tensor(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        vals = []
+        for r, ov in zip(res, outs):
+            a = r._data if isinstance(r, Tensor) else jax.numpy.asarray(
+                np.asarray(r))
+            vals.append(a.astype(ov._data.dtype))
+        return vals[0] if len(vals) == 1 else tuple(vals)
+
+    node_outs = [Variable(f"py_func_{len(prog._nodes)}.{i}",
+                          list(ov.shape), str(ov._data.dtype),
+                          stop_gradient=True)
+                 for i, ov in enumerate(outs)]
+    prog._nodes.append(_Node("py_func", _body, tuple(xs), {}, node_outs))
+    return node_outs[0] if len(node_outs) == 1 else node_outs
+
+
+class name_scope:
+    """Cosmetic op-name prefix context (reference:
+    base/framework.py name_scope); recorded names are not prefixed on
+    this stack — the context exists for API/indentation parity."""
+
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug-print pass-through op (reference: static/nn/control_flow.py
+    Print). Prints at replay (concrete values); silent while recording
+    (abstract values)."""
+    state = {"n": 0}
+
+    def _body(a):
+        from jax.core import Tracer
+        concrete = not isinstance(a, (jax.ShapeDtypeStruct, Tracer))
+        if concrete and (first_n < 0 or state["n"] < first_n):
+            state["n"] += 1
+            head = message or "Print"
+            body = np.array2string(np.asarray(a), threshold=summarize)
+            print(f"{head}: shape={list(a.shape)} dtype={a.dtype}\n{body}")
+        return a
+
+    from ..core.dispatch import op_call
+    return op_call("print", _body, input)

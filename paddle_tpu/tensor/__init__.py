@@ -41,6 +41,8 @@ _INPLACE_BASES = [
     "copysign", "bitwise_left_shift", "bitwise_right_shift",
     "masked_fill", "masked_scatter", "hypot", "asin", "atanh", "asinh",
     "acosh", "cosh", "erfinv", "expand", "reshape", "index_put",
+    "lerp", "log1p", "logical_xor", "not_equal", "put_along_axis",
+    "index_fill",
 ]
 
 
@@ -130,6 +132,12 @@ def _patch_tensor_methods():
     Tensor.__xor__ = lambda s, o: (math.logical_xor if s.dtype.is_bool else math.bitwise_xor)(s, _u(o))
     Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, _u(o))
     Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, _u(o))
+    Tensor.__pos__ = lambda s: s.clone()
+    Tensor.__rand__ = lambda s, o: Tensor.__and__(s, o)
+    Tensor.__ror__ = lambda s, o: Tensor.__or__(s, o)
+    Tensor.__rxor__ = lambda s, o: Tensor.__xor__(s, o)
+    Tensor.__rlshift__ = lambda s, o: math.bitwise_left_shift(_u(o), s)
+    Tensor.__rrshift__ = lambda s, o: math.bitwise_right_shift(_u(o), s)
     Tensor.__eq__ = lambda s, o: math.equal(s, _u(o))
     Tensor.__ne__ = lambda s, o: math.not_equal(s, _u(o))
     Tensor.__lt__ = lambda s, o: math.less_than(s, _u(o))
@@ -144,3 +152,39 @@ def _u(o):
 
 
 _patch_tensor_methods()
+
+
+def _patch_tensor_method_tail():
+    """Late method patching for functions living outside paddle_tpu.tensor
+    (signal/nn/framework) — called once from paddle_tpu/__init__ after
+    those packages are importable (avoids circular imports here). Closes
+    the tensor_method_func parity gap (reference:
+    python/paddle/tensor/__init__.py tensor_method_func list)."""
+    from ..framework import infra
+    from .. import signal as _signal
+    from ..nn import functional as F
+    from . import random as _rnd
+
+    for name in ("is_tensor", "is_complex", "is_integer",
+                 "is_floating_point", "is_empty", "rank",
+                 "create_parameter"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(infra, name))
+    extras = {
+        "multinomial": _rnd.multinomial,
+        "top_p_sampling": search.top_p_sampling,
+        "set_": creation.set_,
+        "resize_": creation.resize_,
+        "create_tensor": creation.create_tensor,
+        "scatter_nd": manipulation.scatter_nd,
+        "broadcast_shape": manipulation.broadcast_shape,
+        "less": less,
+        "bitwise_invert": bitwise_invert,
+        "stft": _signal.stft,
+        "istft": _signal.istft,
+        "sigmoid": F.sigmoid,
+        "sigmoid_": math._make_inplace(F.sigmoid),
+    }
+    for name, fn in extras.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)

@@ -258,5 +258,76 @@ __all__ = [
     "empty_like", "arange", "linspace", "logspace", "eye", "tril", "triu",
     "tril_indices", "triu_indices", "diag", "diagflat", "diag_embed", "meshgrid",
     "assign", "clone", "complex", "polar", "real", "imag", "cauchy_", "geometric_",
-    "one_hot", "to_tensor",
+    "one_hot", "to_tensor", "create_tensor", "set_", "resize_",
 ]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Empty placeholder tensor of ``dtype`` (reference:
+    python/paddle/tensor/creation.py create_tensor)."""
+    return Tensor(jnp.zeros((0,), dtype=to_jax_dtype(dtype)))
+
+
+def set_(x, source=None, shape=None, stride=None, offset=0, name=None):
+    """Rebind ``x`` to ``source``'s storage viewed through
+    shape/stride/offset (reference: python/paddle/tensor/creation.py:3290).
+
+    JAX arrays are immutable, so the "view" COPIES the strided window at
+    call time instead of aliasing the source buffer — value semantics
+    match the reference; later in-place writes to ``source`` do not
+    propagate into ``x`` (documented deviation; no aliasing exists on
+    this stack).
+    """
+    if source is None:
+        new = jnp.zeros((0,), dtype=x._data.dtype)
+    else:
+        src = source._data if isinstance(source, Tensor) else jnp.asarray(source)
+        storage = jnp.ravel(src)
+        if shape is None:
+            tgt_shape = tuple(int(s) for s in src.shape)
+            tgt_stride = None
+        else:
+            tgt_shape = tuple(int(s) for s in shape)
+            tgt_stride = None if stride is None else tuple(int(s) for s in stride)
+        if tgt_stride is None:
+            acc, rev = 1, []
+            for s in reversed(tgt_shape):
+                rev.append(acc)
+                acc *= max(s, 1)
+            tgt_stride = tuple(reversed(rev))
+        if any(s == 0 for s in tgt_shape):
+            new = jnp.zeros(tgt_shape, dtype=storage.dtype)
+        else:
+            grids = np.indices(tgt_shape)
+            flat = int(offset) + sum(g * st for g, st in zip(grids, tgt_stride))
+            if flat.max() >= storage.shape[0] or flat.min() < 0:
+                raise ValueError(
+                    f"set_: view (shape={tgt_shape}, stride={tgt_stride}, "
+                    f"offset={offset}) reaches outside source storage of "
+                    f"{storage.shape[0]} elements")
+            new = storage[jnp.asarray(flat.reshape(-1))].reshape(tgt_shape)
+    x._data = new
+    x._grad_node = None
+    return x
+
+
+def resize_(x, shape, fill_zero=False, name=None):
+    """Resize ``x`` in place to ``shape`` (reference:
+    python/paddle/tensor/creation.py:3412): existing elements are kept in
+    row-major order, truncated or zero-extended to the new element count
+    (``fill_zero=False`` leaves growth "undetermined" in the reference;
+    here it is always zero-filled).
+    """
+    shape = tuple(int(s) for s in shape)
+    n = 1
+    for s in shape:
+        n *= s
+    flat = jnp.ravel(x._data)
+    if n <= flat.shape[0]:
+        new = flat[:n].reshape(shape)
+    else:
+        pad = jnp.zeros((n - flat.shape[0],), dtype=flat.dtype)
+        new = jnp.concatenate([flat, pad]).reshape(shape)
+    x._data = new
+    x._grad_node = None
+    return x

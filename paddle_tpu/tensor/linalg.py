@@ -565,3 +565,43 @@ def svd_lowrank(x, q=None, niter=2, M=None, name=None):
     k = q if q is not None else min(6, x.shape[-2], x.shape[-1])
     return tuple(op_call("svd_lowrank", _svd_lowrank, x, _prng.next_key(),
                          q=int(k), niter=int(niter)))
+
+
+from .math import diagonal  # noqa: E402,F401  (reference: paddle.linalg.diagonal)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", activation="identity",
+                            name=None):
+    """fp8 x fp8 -> half GEMM (reference: python/paddle/linalg.py export,
+    incubate fp8 cutlass kernel). TPU v5e has no fp8 MXU datapath, so the
+    fp8 operands are computed in bf16 on the MXU and the result cast to
+    ``output_dtype`` — numerics match the reference's fp8-accumulate-in-
+    half contract to within bf16 rounding."""
+    import jax.numpy as jnp
+    from ..core.dtype import to_jax_dtype
+    from ..core.dispatch import op_call, op_body  # noqa: F401
+
+    def _body(a, b, bias_v, *, tx, ty, scale, out_dtype, act):
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+        if tx:
+            a = jnp.swapaxes(a, -1, -2)
+        if ty:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b) * scale
+        if bias_v is not None:
+            out = out + bias_v.astype(out.dtype)
+        if act == "relu":
+            out = jnp.maximum(out, 0)
+        elif act == "gelu":
+            import jax
+            out = jax.nn.gelu(out)
+        return out.astype(out_dtype)
+
+    return op_call("fp8_fp8_half_gemm_fused", _body, x, y, bias,
+                   tx=bool(transpose_x), ty=bool(transpose_y),
+                   scale=float(scale),
+                   out_dtype=to_jax_dtype(output_dtype),
+                   act=str(activation))

@@ -229,3 +229,63 @@ def nanargmin(x, axis=None, keepdim=False, name=None):
     reference analog — provided for the method-surface scan)."""
     return op_call("nanargmin", _nanargmin, x, axis=_ax(axis),
                    keepdim=keepdim)
+
+
+@op_body("top_p_sampling")
+def _top_p_sampling(x, ps, threshold, key, *, mode):
+    import jax
+    probs = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+    if threshold is not None:
+        probs = jnp.where(probs < threshold.reshape(-1, 1), 0.0, probs)
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    # nucleus: smallest prefix whose mass reaches p (>= 1 token kept).
+    keep = (cum - sorted_p) < ps.reshape(-1, 1)
+    kept = jnp.where(keep, sorted_p, 0.0)
+    if mode == "truncated":
+        kept = kept / jnp.sum(kept, axis=-1, keepdims=True)
+    # categorical over the (renormalized) nucleus, one draw per row
+    logits = jnp.log(jnp.maximum(kept, 1e-38))
+    if key.ndim == 2:      # per-row keys (topp_seed): one draw per key
+        pos = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(
+            key, logits)
+    else:
+        pos = jax.random.categorical(key, logits, axis=-1)
+    ids = jnp.take_along_axis(order, pos[:, None], axis=-1)
+    out = jnp.take_along_axis(x, ids, axis=-1)
+    return out, ids.astype(jnp.int64)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus (top-p) sampling over the last axis (reference:
+    python/paddle/tensor/search.py:1402, CUDA kernel semantics: scores in,
+    softmax inside, returns (sampled score, id); renormalizes the nucleus
+    in ``truncated`` mode).
+
+    ``topp_seed`` (per-row int seed tensor) or ``seed`` (>=0) make the draw
+    deterministic; otherwise the global generator advances.
+    """
+    import jax
+    from ..core import random as _random
+    if topp_seed is not None:
+        import numpy as _np
+        base = topp_seed.numpy().ravel() if isinstance(topp_seed, Tensor) \
+            else _np.asarray(topp_seed).ravel()
+        # per-row deterministic keys (the reference's per-query seed)
+        key = jax.vmap(jax.random.PRNGKey)(
+            jnp.asarray(base, dtype=jnp.uint32))
+    elif seed is not None and seed >= 0:
+        key = jax.random.PRNGKey(int(seed))
+    else:
+        key = _random.next_key()
+    if not isinstance(ps, Tensor):
+        ps = Tensor(jnp.asarray(ps, dtype=jnp.float32))
+    out, ids = op_call("top_p_sampling", _top_p_sampling, x, ps, threshold,
+                       key, mode=mode)
+    if return_top:
+        tk_scores, tk_ids = topk(x, k=max(int(k), 1), axis=-1)
+        return out, ids, tk_scores, tk_ids
+    return out, ids

@@ -144,9 +144,12 @@ class ViterbiDecoder:
 
 
 from .datasets import UCIHousing, Imikolov, Imdb  # noqa: E402,F401
+from .datasets_extra import (  # noqa: E402,F401
+    Conll05st, Movielens, WMT14, WMT16,
+)
 
 __all__ = ["Vocab", "TextFileDataset", "ViterbiDecoder", "UCIHousing",
-           "Imikolov", "Imdb"]
+           "Imikolov", "Imdb", "Conll05st", "Movielens", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths,

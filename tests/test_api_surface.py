@@ -266,3 +266,88 @@ def test_sci_mode_true_forces_scientific():
     finally:
         paddle.set_printoptions(sci_mode=False)
         paddle.set_printoptions(precision=6)
+
+
+def test_tensor_method_parity():
+    """Every name in the reference's tensor_method_func list is a Tensor
+    method (python/paddle/tensor/__init__.py tensor_method_func)."""
+    import re
+    src = open("/root/reference/python/paddle/tensor/__init__.py").read()
+    names = set(re.findall(r"'(\w+)'", src.split("tensor_method_func")[1]))
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    have = set(dir(type(t))) | set(dir(t))
+    missing = sorted(n for n in names if n not in have)
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+def test_tensor_method_tail_behavior():
+    # top_p_sampling: deterministic under seed, nucleus excludes the tail
+    x = paddle.to_tensor(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+    ps = paddle.to_tensor(np.array([0.9, 0.9], np.float32))
+    v1, i1 = paddle.top_p_sampling(x, ps, seed=7)
+    v2, i2 = paddle.top_p_sampling(x, ps, seed=7)
+    np.testing.assert_array_equal(i1.numpy(), i2.numpy())
+    assert v1.shape == [2, 1] and i1.numpy().max() <= 2
+    # sampled value is the raw score at the sampled id
+    np.testing.assert_allclose(
+        v1.numpy(), np.take_along_axis(x.numpy(), i1.numpy(), axis=-1))
+    _, _, tks, tki = paddle.top_p_sampling(x, ps, seed=7, k=2, return_top=True)
+    np.testing.assert_array_equal(tki.numpy(), [[2, 1], [2, 1]])
+
+    # resize_ truncate + extend (zero fill), torch oracle for the layout
+    y = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+    assert y.resize_([2, 1]) is y
+    np.testing.assert_array_equal(y.numpy(), [[1.], [2.]])
+    y = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+    y.resize_([2, 3], fill_zero=True)
+    np.testing.assert_array_equal(y.numpy(), [[1., 2., 3.], [0., 0., 0.]])
+
+    # set_: strided window copy, torch.as_strided oracle
+    src = np.arange(12, dtype=np.float32)
+    z = paddle.to_tensor(np.zeros(2, np.float32))
+    z.set_(paddle.to_tensor(src), shape=[2, 3], stride=[6, 1], offset=1)
+    np.testing.assert_array_equal(
+        z.numpy(), torch.as_strided(torch.from_numpy(src), (2, 3), (6, 1), 1))
+    z.set_()
+    assert z.numpy().size == 0
+    with pytest.raises(ValueError):
+        paddle.to_tensor(src).set_(paddle.to_tensor(src), shape=[4, 4])
+    with pytest.raises(ValueError):   # negative offset must not wrap
+        paddle.to_tensor(src).set_(paddle.to_tensor(src), shape=[2, 2],
+                                   stride=[2, 1], offset=-1)
+
+    # per-row topp_seed: deterministic per row, row seeds independent
+    xx = paddle.to_tensor(np.tile(np.array([[1., 2., 3.]], np.float32),
+                                  (2, 1)))
+    pss = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    _, iA = paddle.top_p_sampling(xx, pss, topp_seed=paddle.to_tensor(
+        np.array([3, 7], np.int32)))
+    _, iB = paddle.top_p_sampling(xx, pss, topp_seed=paddle.to_tensor(
+        np.array([3, 7], np.int32)))
+    np.testing.assert_array_equal(iA.numpy(), iB.numpy())
+    _, iC = paddle.top_p_sampling(xx, pss, topp_seed=paddle.to_tensor(
+        np.array([3, 3], np.int32)))
+    assert iC.numpy()[0, 0] == iA.numpy()[0, 0]  # same seed, same row draw
+
+    # reverse dunders / __pos__
+    a = paddle.to_tensor(np.array([1, 2, 4], np.int32))
+    np.testing.assert_array_equal((1 << a).numpy(), [2, 4, 16])
+    np.testing.assert_array_equal((64 >> a).numpy(), [32, 16, 4])
+    np.testing.assert_array_equal((+a).numpy(), a.numpy())
+    b = paddle.to_tensor(np.array([True, False]))
+    np.testing.assert_array_equal((True & b).numpy(), [True, False])
+    np.testing.assert_array_equal((False | b).numpy(), [True, False])
+    np.testing.assert_array_equal((True ^ b).numpy(), [False, True])
+
+    # method forms route to the same functions
+    t = paddle.to_tensor(np.array([[0.5, -0.5]], np.float32))
+    np.testing.assert_allclose(t.sigmoid().numpy(),
+                               torch.sigmoid(torch.from_numpy(t.numpy())),
+                               rtol=1e-6)
+    s = paddle.to_tensor(np.random.default_rng(0).standard_normal(400)
+                         .astype(np.float32))
+    assert list(s.stft(n_fft=64).shape) == [33, 26]
+    assert int(t.rank()) == 2 and t.is_floating_point()
+    l = paddle.to_tensor(np.array([1., 2.], np.float32))
+    l.lerp_(paddle.to_tensor(np.array([3., 4.], np.float32)), 0.5)
+    np.testing.assert_array_equal(l.numpy(), [2., 3.])

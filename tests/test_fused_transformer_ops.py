@@ -1,0 +1,290 @@
+"""incubate.nn.functional fused transformer ops vs numpy/torch oracles.
+
+Reference semantics: python/paddle/incubate/nn/functional/
+fused_transformer.py (pseudo-code blocks), fused_matmul_bias.py:136,
+fused_moe.py:27, variable_length_memory_efficient_attention.py:33.
+Dropout rates are 0 in parity tests (the reference kernels' RNG is not
+reproducible cross-backend); dropout behavior is asserted statistically.
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+RNG = np.random.default_rng(0)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def _ln_np(x, scale=None, bias=None, eps=1e-5):
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    out = (x - mean) / np.sqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def test_fused_feedforward_parity():
+    d, dff = 8, 16
+    x = RNG.normal(size=(2, 3, d)).astype(np.float32)
+    w1 = RNG.normal(size=(d, dff)).astype(np.float32)
+    w2 = RNG.normal(size=(dff, d)).astype(np.float32)
+    b1 = RNG.normal(size=(dff,)).astype(np.float32)
+    b2 = RNG.normal(size=(d,)).astype(np.float32)
+    s1 = np.ones(d, np.float32)
+    bb1 = np.zeros(d, np.float32)
+
+    # pre-LN
+    out = IF.fused_feedforward(t(x), t(w1), t(w2), t(b1), t(b2),
+                               ln1_scale=t(s1), ln1_bias=t(bb1),
+                               dropout1_rate=0.0, dropout2_rate=0.0,
+                               pre_layer_norm=True)
+    ref = x + (np.maximum(_ln_np(x, s1, bb1) @ w1 + b1, 0) @ w2 + b2)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+    # post-LN, no residual
+    out = IF.fused_feedforward(t(x), t(w1), t(w2), t(b1), t(b2),
+                               ln2_scale=t(s1), ln2_bias=t(bb1),
+                               dropout1_rate=0.0, dropout2_rate=0.0,
+                               pre_layer_norm=False, add_residual=False)
+    ref = _ln_np(np.maximum(x @ w1 + b1, 0) @ w2 + b2, s1, bb1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_bias_dropout_residual_layer_norm():
+    d = 8
+    x = RNG.normal(size=(2, 3, d)).astype(np.float32)
+    res = RNG.normal(size=(2, 3, d)).astype(np.float32)
+    bias = RNG.normal(size=(d,)).astype(np.float32)
+    out = IF.fused_bias_dropout_residual_layer_norm(
+        t(x), t(res), t(bias), dropout_rate=0.0)
+    np.testing.assert_allclose(out.numpy(), _ln_np(res + x + bias),
+                               rtol=2e-4, atol=2e-4)
+    # dropout actually drops at high rate (inference passthrough too)
+    out_inf = IF.fused_bias_dropout_residual_layer_norm(
+        t(x), t(res), t(bias), dropout_rate=0.9, training=False)
+    np.testing.assert_allclose(out_inf.numpy(), _ln_np(res + x + bias),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_fused_linear_activation():
+    x = RNG.normal(size=(3, 4)).astype(np.float32)
+    w = RNG.normal(size=(4, 5)).astype(np.float32)
+    b = RNG.normal(size=(5,)).astype(np.float32)
+    out = IF.fused_linear_activation(t(x), t(w), t(b), activation="relu")
+    np.testing.assert_allclose(out.numpy(), np.maximum(x @ w + b, 0),
+                               rtol=1e-5, atol=1e-5)
+    out = IF.fused_linear_activation(t(x.T), t(w), t(b), trans_x=True,
+                                     activation="gelu")
+    ref = torch.nn.functional.gelu(torch.from_numpy(x @ w + b)).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_multi_head_attention_parity_torch():
+    b, s, h, hd = 2, 4, 2, 3
+    d = h * hd
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    qkv_w = RNG.normal(size=(3, h, hd, d)).astype(np.float32)
+    qkv_b = RNG.normal(size=(3, h, hd)).astype(np.float32)
+    lin_w = RNG.normal(size=(d, d)).astype(np.float32)
+    lin_b = RNG.normal(size=(d,)).astype(np.float32)
+
+    out = IF.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), pre_layer_norm=True,
+        pre_ln_scale=t(np.ones(d, np.float32)),
+        pre_ln_bias=t(np.zeros(d, np.float32)),
+        qkv_bias=t(qkv_b), linear_bias=t(lin_b),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+
+    # torch oracle of the documented pseudo-code
+    xn = _ln_np(x)
+    qkv = np.einsum("bsd,thed->tbhse", xn, qkv_w) + \
+        qkv_b[:, None, :, None, :]
+    q, k, v = qkv[0] * hd ** -0.5, qkv[1], qkv[2]
+    probs = torch.softmax(torch.from_numpy(q @ k.transpose(0, 1, 3, 2)), -1)
+    ctx = (probs.numpy() @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    ref = x + (ctx @ lin_w + lin_b)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_multi_head_attention_cache_kv():
+    b, s, h, hd = 1, 2, 2, 4
+    d = h * hd
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    qkv_w = RNG.normal(size=(3, h, hd, d)).astype(np.float32)
+    lin_w = RNG.normal(size=(d, d)).astype(np.float32)
+    cache = RNG.normal(size=(2, b, h, 3, hd)).astype(np.float32)
+    out, new_cache = IF.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), cache_kv=t(cache),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    assert list(out.shape) == [b, s, d]
+    assert list(new_cache.shape) == [2, b, h, 3 + s, hd]
+    np.testing.assert_allclose(new_cache.numpy()[:, :, :, :3], cache,
+                               rtol=1e-6)
+
+
+def test_fused_moe_dense_routing():
+    b, s, d, dff, e = 2, 3, 4, 5, 3
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    gate = RNG.normal(size=(b, s, e)).astype(np.float32)
+    w1 = RNG.normal(size=(e, d, 2 * dff)).astype(np.float32)
+    w2 = RNG.normal(size=(e, dff, d)).astype(np.float32)
+    b1 = RNG.normal(size=(e, 1, 2 * dff)).astype(np.float32)
+    b2 = RNG.normal(size=(e, 1, d)).astype(np.float32)
+    out = IF.fused_moe(t(x), t(gate), t(w1), t(w2), t(b1), None, t(b2),
+                       None, "None", 2, True)
+    assert list(out.shape) == [b, s, d]
+
+    # numpy oracle: top-2 normalized routing, silu-pair expert act
+    tok = x.reshape(-1, d)
+    logits = gate.reshape(-1, e)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.zeros_like(tok)
+    for ti in range(tok.shape[0]):
+        idx = np.argsort(-p[ti])[:2]
+        wsum = p[ti][idx].sum()
+        for ei in idx:
+            hpre = tok[ti] @ w1[ei] + b1[ei, 0]
+            u, g = hpre[:dff], hpre[dff:]
+            hact = (u / (1 + np.exp(-u))) * g
+            ref[ti] += (p[ti][ei] / wsum) * (hact @ w2[ei] + b2[ei, 0])
+    np.testing.assert_allclose(out.numpy().reshape(-1, d), ref,
+                               rtol=2e-3, atol=2e-3)
+    with pytest.raises(NotImplementedError):
+        IF.fused_moe(t(x), t(gate), t(w1), t(w2), quant_method="w8a8")
+
+
+def test_varlen_memory_efficient_attention():
+    b, h, s, hd = 2, 2, 5, 4
+    q = RNG.normal(size=(b, h, s, hd)).astype(np.float32)
+    k = RNG.normal(size=(b, h, s, hd)).astype(np.float32)
+    v = RNG.normal(size=(b, h, s, hd)).astype(np.float32)
+    lens = np.array([[5], [3]], np.int32)
+    out = IF.variable_length_memory_efficient_attention(
+        t(q), t(k), t(v), paddle.to_tensor(lens), paddle.to_tensor(lens))
+    # full-length row 0 matches plain SDPA
+    ref0 = torch.nn.functional.scaled_dot_product_attention(
+        torch.from_numpy(q[0]), torch.from_numpy(k[0]),
+        torch.from_numpy(v[0])).numpy()
+    np.testing.assert_allclose(out.numpy()[0], ref0, rtol=1e-4, atol=1e-4)
+    # row 1: only first 3 kv positions attended; padded queries zeroed
+    ref1 = torch.nn.functional.scaled_dot_product_attention(
+        torch.from_numpy(q[1]), torch.from_numpy(k[1, :, :3]),
+        torch.from_numpy(v[1, :, :3])).numpy()
+    np.testing.assert_allclose(out.numpy()[1][:, :3], ref1[:, :3],
+                               rtol=1e-4, atol=1e-4)
+    assert np.all(out.numpy()[1][:, 3:] == 0)
+    # causal mode respects the triangle
+    outc = IF.variable_length_memory_efficient_attention(
+        t(q), t(k), t(v), paddle.to_tensor(lens), paddle.to_tensor(lens),
+        causal=True)
+    refc = torch.nn.functional.scaled_dot_product_attention(
+        torch.from_numpy(q[0]), torch.from_numpy(k[0]),
+        torch.from_numpy(v[0]), is_causal=True).numpy()
+    np.testing.assert_allclose(outc.numpy()[0], refc, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_multi_transformer_stack():
+    b, s, h, hd, layers = 2, 4, 2, 4, 2
+    d = h * hd
+    dff = 3 * d
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    args = dict(
+        ln_scales=[t(np.ones(d)) for _ in range(layers)],
+        ln_biases=[t(np.zeros(d)) for _ in range(layers)],
+        qkv_weights=[t(RNG.normal(size=(3, h, hd, d)) * 0.2)
+                     for _ in range(layers)],
+        qkv_biases=[t(np.zeros((3, h, hd))) for _ in range(layers)],
+        linear_weights=[t(RNG.normal(size=(d, d)) * 0.2)
+                        for _ in range(layers)],
+        linear_biases=[t(np.zeros(d)) for _ in range(layers)],
+        ffn_ln_scales=[t(np.ones(d)) for _ in range(layers)],
+        ffn_ln_biases=[t(np.zeros(d)) for _ in range(layers)],
+        ffn1_weights=[t(RNG.normal(size=(d, dff)) * 0.2)
+                      for _ in range(layers)],
+        ffn1_biases=[t(np.zeros(dff)) for _ in range(layers)],
+        ffn2_weights=[t(RNG.normal(size=(dff, d)) * 0.2)
+                      for _ in range(layers)],
+        ffn2_biases=[t(np.zeros(d)) for _ in range(layers)],
+    )
+    out = IF.fused_multi_transformer(t(x), **args)
+    assert list(out.shape) == [b, s, d]
+    assert np.isfinite(out.numpy()).all()
+
+    # single layer == fused_multi_head_attention + fused_feedforward
+    one = {k: v[:1] for k, v in args.items()}
+    out1 = IF.fused_multi_transformer(t(x), **one)
+    attn = IF.fused_multi_head_attention(
+        t(x), one["qkv_weights"][0], one["linear_weights"][0],
+        pre_layer_norm=True, pre_ln_scale=one["ln_scales"][0],
+        pre_ln_bias=one["ln_biases"][0], qkv_bias=one["qkv_biases"][0],
+        linear_bias=one["linear_biases"][0], dropout_rate=0.0,
+        attn_dropout_rate=0.0)
+    ffn = IF.fused_feedforward(
+        attn, one["ffn1_weights"][0], one["ffn2_weights"][0],
+        one["ffn1_biases"][0], one["ffn2_biases"][0],
+        ln1_scale=one["ffn_ln_scales"][0], ln1_bias=one["ffn_ln_biases"][0],
+        dropout1_rate=0.0, dropout2_rate=0.0, pre_layer_norm=True,
+        activation="gelu")
+    np.testing.assert_allclose(out1.numpy(), ffn.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+    # decode-style cache update via time_step
+    caches = [t(np.zeros((2, b, h, 8, hd), np.float32))
+              for _ in range(layers)]
+    step_x = RNG.normal(size=(b, 1, d)).astype(np.float32)
+    out_d, new_caches = IF.fused_multi_transformer(
+        t(step_x), **args, cache_kvs=caches,
+        time_step=paddle.to_tensor(np.int32(2)))
+    assert list(out_d.shape) == [b, 1, d]
+    assert len(new_caches) == layers
+    nc = new_caches[0].numpy()
+    assert nc.shape == (2, b, h, 8, hd)
+    assert np.any(nc[:, :, :, 2] != 0) and np.all(nc[:, :, :, 3:] == 0)
+
+    # uninitialized cache slots beyond time_step are masked out: garbage
+    # in the tail must not change the output
+    garbage = [t(np.where(np.arange(8).reshape(1, 1, -1, 1) > 2,
+                          99.0, c.numpy()).astype(np.float32))
+               for c in caches]
+    out_g, _ = IF.fused_multi_transformer(
+        t(step_x), **args, cache_kvs=garbage,
+        time_step=paddle.to_tensor(np.int32(2)))
+    np.testing.assert_allclose(out_d.numpy(), out_g.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_multi_transformer_rmsnorm_rotary():
+    b, s, h, hd = 1, 4, 2, 4
+    d = h * hd
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    pos = np.arange(s)
+    inv = 1.0 / 10000 ** (np.arange(0, hd, 2) / hd)
+    ang = np.einsum("s,f->sf", pos, inv)
+    cos = np.repeat(np.cos(ang), 2, -1).astype(np.float32)[None, None]
+    sin = np.repeat(np.sin(ang), 2, -1).astype(np.float32)[None, None]
+    rotary = t(np.stack([cos, sin]))
+    out = IF.fused_multi_transformer(
+        t(x),
+        ln_scales=[t(np.ones(d))], ln_biases=None,
+        qkv_weights=[t(RNG.normal(size=(3, h, hd, d)) * 0.2)],
+        qkv_biases=None,
+        linear_weights=[t(RNG.normal(size=(d, d)) * 0.2)],
+        linear_biases=None,
+        ffn_ln_scales=[t(np.ones(d))], ffn_ln_biases=None,
+        ffn1_weights=[t(RNG.normal(size=(d, d)) * 0.2)],
+        ffn1_biases=None,
+        ffn2_weights=[t(RNG.normal(size=(d, d)) * 0.2)],
+        ffn2_biases=None,
+        norm_type="rmsnorm", rotary_embs=rotary, rotary_emb_dims=1,
+        activation="silu")
+    assert list(out.shape) == [b, s, d]
+    assert np.isfinite(out.numpy()).all()

@@ -285,3 +285,50 @@ def test_dynamic_decode_custom_decoder_states():
         GreedyDecoder(), inits=paddle.zeros([2, 1]), max_step_num=10,
         return_length=True)
     assert length.numpy().tolist() == [4, 4]
+
+
+def test_nn_utils_spectral_norm_functional():
+    """nn.utils.spectral_norm (reference: nn/utils/spectral_norm_hook.py):
+    the effective weight's top singular value approaches 1."""
+    import paddle_tpu.nn.utils as U
+    lin = paddle.nn.Linear(6, 8)
+    U.spectral_norm(lin, n_power_iterations=5)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(3, 6)).astype("float32"))
+    out = lin(x)
+    w_eff = lin._buffers["weight"].numpy()
+    sigma = np.linalg.svd(w_eff, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.2, sigma
+    # power-iteration state persists and refines across forwards
+    for _ in range(5):
+        lin(x)
+    w_eff = lin._buffers["weight"].numpy()
+    sigma = np.linalg.svd(w_eff, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05, sigma
+    # grads flow to the original parameter
+    lin(x).sum().backward()
+    assert lin.weight_orig.grad is not None
+
+
+def test_distributed_passes_framework():
+    """distributed.passes (reference: passes/pass_base.py): registry,
+    pipeline application, PS-tier descope."""
+    import pytest
+    from paddle_tpu.distributed.passes import (
+        new_pass, PassManager, PassContext, PassBase)
+    pm = PassManager([new_pass("auto_parallel_amp"),
+                      new_pass("fuse_all_reduce",
+                               {"max_memory_size": 32})])
+    ctx = pm.apply([], [])
+    assert [p.name for p in ctx.passes] == ["auto_parallel_amp",
+                                            "fuse_all_reduce"]
+    assert ctx.passes[1].get_attr("max_memory_size") == 32
+    assert pm.names == ["auto_parallel_amp", "fuse_all_reduce"]
+    with pytest.raises(AssertionError):
+        new_pass("not_a_pass")
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        new_pass("ps_transpile_pass").apply([])
+    # every reference auto-parallel/fusion pass name is registered
+    for name in ("auto_parallel_sharding", "auto_parallel_recompute",
+                 "fuse_gemm_epilogue", "fused_attention", "build_cinn"):
+        assert name in PassBase._REGISTERED_PASSES

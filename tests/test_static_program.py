@@ -114,3 +114,109 @@ def test_dynamic_mode_untouched_after_disable(static_mode):
     t = paddle.to_tensor([1.0, 2.0])
     assert float((t * 2).sum().numpy()) == 6.0
     assert paddle.in_dynamic_mode()
+
+
+def test_append_backward_fetch_grads(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        lin = paddle.nn.Linear(3, 1)
+        loss = paddle.mean(lin(x) ** 2)
+        pairs = static.append_backward(loss)
+    assert pairs and all(gv.name.endswith("@GRAD") for _, gv in pairs)
+    xv = np.random.default_rng(1).standard_normal((4, 3)).astype(np.float32)
+    outs = static.Executor().run(main, feed={"x": xv},
+                                 fetch_list=[loss] + [g for _, g in pairs])
+    # numpy oracle: d(mean((xW+b)^2)) = 2/N * x^T (xW+b), sum for b
+    w = pairs[0][0].numpy() if pairs[0][0].numpy().shape == (3, 1) \
+        else pairs[1][0].numpy()
+    b = [p for p, _ in pairs if p.numpy().shape != (3, 1)][0].numpy()
+    y = xv @ w + b
+    gw = (2 / y.size) * xv.T @ y
+    gb = (2 / y.size) * y.sum(0)
+    got = {tuple(p.numpy().shape): g for (p, _), g in zip(pairs, outs[1:])}
+    np.testing.assert_allclose(got[(3, 1)], gw, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got[(1,)], gb, rtol=1e-4, atol=1e-5)
+    # a second run returns the SAME grads (no cross-run accumulation)
+    outs2 = static.Executor().run(main, feed={"x": xv},
+                                  fetch_list=[g for _, g in pairs])
+    got2 = {tuple(p.numpy().shape): g
+            for (p, _), g in zip(pairs, outs2)}
+    np.testing.assert_allclose(got2[(3, 1)], gw, rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_wrt_input(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = paddle.sum(x * x)
+        (gx,) = static.gradients([y], [x])
+    xv = np.array([[1., 2.], [3., 4.]], np.float32)
+    (g,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 2 * xv, rtol=1e-6)
+    # fetch by name works too
+    (g2,) = static.Executor().run(main, feed={"x": xv},
+                                  fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(g2, 2 * xv, rtol=1e-6)
+
+
+def test_py_func_and_print(static_mode, capsys):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        out_decl = static.data("out_decl", [2, 2], "float32")
+        y = static.py_func(lambda t: paddle.to_tensor(t.numpy() * 3.0),
+                           x, out_decl)
+        z = static.Print(y, message="dbg")
+    # out_decl was a shape declaration — py_func unregisters it as a feed
+    assert "out_decl" not in main._feeds
+    xv = np.ones((2, 2), np.float32)
+    (out,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, 3 * xv)
+    assert "dbg" in capsys.readouterr().out
+    with static.name_scope("block"):
+        pass
+    with pytest.raises(NotImplementedError):
+        static.py_func(lambda t: t, x, out_decl, backward_func=lambda g: g)
+
+
+def test_compiled_program_and_build_strategy(static_mode):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = x * 2.0
+    bs = static.BuildStrategy()
+    bs.fuse_elewise_add_act_ops = True
+    cp = static.CompiledProgram(main, build_strategy=bs)
+    cp = cp.with_data_parallel(loss_name=None)
+    xv = np.ones((3, 2), np.float32)
+    (out,) = static.Executor().run(cp, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, 2 * xv)
+    assert "fuse_elewise_add_act_ops" in repr(bs)
+
+
+def test_exponential_moving_average():
+    # dygraph-style params (the EMA utility is backend-agnostic here)
+    p = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    p.stop_gradient = False
+    ema = static.ExponentialMovingAverage(0.5, parameter_list=[p])
+    ema.update()                      # shadow = p = [1, 2]
+    p._inplace_update(p._data * 0 + np.array([3.0, 6.0], np.float32))
+    ema.update()                      # shadow = .5*[1,2] + .5*[3,6] = [2,4]
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(p.numpy(), [3.0, 6.0])  # restored
+    with ema.apply(need_restore=False):
+        pass
+    np.testing.assert_allclose(p.numpy(), [2.0, 4.0])
+
+
+def test_weight_norm_param_attr_and_ipu_stubs():
+    attr = static.WeightNormParamAttr(dim=0, name="w")
+    assert attr.dim == 0 and isinstance(attr, static.ParamAttr)
+    s = static.IpuStrategy()
+    s.set_graph_config(num_ipus=1)
+    with pytest.raises(RuntimeError, match="IPU backend"):
+        static.IpuCompiledProgram(None)
+    with pytest.raises(RuntimeError, match="IPU backend"):
+        static.ipu_shard_guard(0)

@@ -113,3 +113,139 @@ def test_imdb_external_word_idx(tmp_path):
     reader = paddle.dataset.imdb.train(custom, data_file=path)
     ids, label = next(iter(reader()))
     assert list(ids) == [2, 0, 0, 1] and label == 0
+
+
+def test_conll05st_tarball(tmp_path):
+    import gzip
+    from paddle_tpu.text import Conll05st
+    # two sentences; sentence 1 has one predicate column
+    words = ["The", "cat", "sat", "", "Dogs", "run", ""]
+    props = ["-\t(A0*", "-\t*)", "sat\t(V*)", "", "-\t(A0*)", "run\t(V*)",
+             ""]
+    # build words.gz / props.gz inside the release layout
+    wblob = gzip.compress(("\n".join(words) + "\n").encode())
+    # props columns: verb lemma, then per-predicate span tags
+    pblob = gzip.compress(("\n".join(props) + "\n").encode())
+    tar = tmp_path / "conll05st-tests.tar"
+    with tarfile.open(tar, "w") as tf:
+        for name, blob in (
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 wblob),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 pblob)):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    wd = tmp_path / "words.dict"
+    wd.write_text("\n".join(["The", "cat", "sat", "Dogs", "run"]) + "\n")
+    vd = tmp_path / "verbs.dict"
+    vd.write_text("sat\nrun\n")
+    td = tmp_path / "targets.dict"
+    td.write_text("B-A0\nB-V\n")
+    ds = Conll05st(data_file=str(tar), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td),
+                   emb_file="emb.txt")
+    assert len(ds) == 2
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx = sample[0]
+    np.testing.assert_array_equal(word_idx, [0, 1, 2])  # The cat sat
+    mark = sample[7]
+    assert mark[2] == 1                                  # verb position
+    label_ids = sample[8]
+    wdict, vdict, ldict = ds.get_dict()
+    assert vdict == {"sat": 0, "run": 1}
+    assert ldict["O"] == len(ldict) - 1
+    assert label_ids[0] == ldict["B-A0"]
+    assert ds.get_embedding() == "emb.txt"
+
+
+def test_movielens_zip(tmp_path):
+    import zipfile
+    from paddle_tpu.text import Movielens
+    path = tmp_path / "ml-1m.zip"
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Heat (1995)::Action\n")
+    users = "1::M::25::4::90210\n2::F::35::7::10001\n"
+    ratings = ("1::1::5::978300760\n1::2::3::978302109\n"
+               "2::1::4::978301968\n")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    train = Movielens(data_file=str(path), mode="train", test_ratio=0.0)
+    assert len(train) == 3
+    sample = train[0]
+    # (uid, gender, age_idx, job, mov_id, categories, title_words, rating)
+    assert len(sample) == 8
+    uid, gender, age, job = (int(sample[0][0]), int(sample[1][0]),
+                             int(sample[2][0]), int(sample[3][0]))
+    assert uid == 1 and gender == 0 and job == 4
+    rating = float(sample[-1][0])
+    assert rating == 5.0 * 2 - 5.0        # reference rescale *2-5
+    test = Movielens(data_file=str(path), mode="test", test_ratio=1.0)
+    assert len(test) == 3
+
+
+def _wmt14_tar(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    src_dict = "<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = "<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = "hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in (("wmt14/src.dict", src_dict),
+                           ("wmt14/trg.dict", trg_dict),
+                           ("wmt14/train/train", train),
+                           ("wmt14/test/test", train)):
+            blob = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    return str(path)
+
+
+def test_wmt14_framing(tmp_path):
+    from paddle_tpu.text import WMT14
+    ds = WMT14(data_file=_wmt14_tar(tmp_path), mode="train", dict_size=5)
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    sd, td = ds.get_dict()
+    np.testing.assert_array_equal(
+        src, [sd["<s>"], sd["hello"], sd["world"], sd["<e>"]])
+    np.testing.assert_array_equal(
+        trg, [td["<s>"], td["bonjour"], td["monde"]])
+    np.testing.assert_array_equal(
+        trg_next, [td["bonjour"], td["monde"], td["<e>"]])
+    rsd, _ = ds.get_dict(reverse=True)
+    assert rsd[sd["hello"]] == "hello"
+
+
+def test_wmt16_dict_build_and_lang_swap(tmp_path):
+    from paddle_tpu.text import WMT16
+    path = tmp_path / "wmt16.tar"
+    train = ("the cat\tdie katze\n"
+             "the dog\tder hund\n")
+    with tarfile.open(path, "w") as tf:
+        for name, text in (("wmt16/train", train), ("wmt16/val", train),
+                           ("wmt16/test", train)):
+            blob = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    ds = WMT16(data_file=str(path), mode="train", src_dict_size=10,
+               trg_dict_size=10, lang="en")
+    src, trg, trg_next = ds[0]
+    en = ds.get_dict("en")
+    de = ds.get_dict("de")
+    assert en["<s>"] == 0 and en["the"] == 3   # freq-sorted after markers
+    np.testing.assert_array_equal(
+        src, [en["<s>"], en["the"], en["cat"], en["<e>"]])
+    np.testing.assert_array_equal(
+        trg_next, [de["die"], de["katze"], de["<e>"]])
+    # lang="de": source and target swap
+    ds_de = WMT16(data_file=str(path), mode="train", src_dict_size=10,
+                  trg_dict_size=10, lang="de")
+    src_de, _, _ = ds_de[0]
+    de2 = ds_de.get_dict("de")
+    np.testing.assert_array_equal(
+        src_de, [de2["<s>"], de2["die"], de2["katze"], de2["<e>"]])
