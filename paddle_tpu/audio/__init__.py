@@ -139,3 +139,26 @@ __all__ = ["stft", "Spectrogram", "MelSpectrogram", "LogMelSpectrogram",
            "MFCC", "compute_fbank_matrix", "get_window", "functional"]
 
 from . import functional  # noqa: E402,F401 — reference-named helpers
+
+
+# -- reference namespace layout --------------------------------------------
+from . import backends  # noqa: E402,F401
+from .backends import load, save, info  # noqa: E402,F401
+from . import datasets  # noqa: E402,F401
+
+
+class _FeaturesNS:
+    """paddle.audio.features namespace (reference:
+    python/paddle/audio/features/layers.py)."""
+    pass
+
+
+features = _FeaturesNS()
+features.Spectrogram = Spectrogram
+features.MelSpectrogram = MelSpectrogram
+features.LogMelSpectrogram = LogMelSpectrogram
+features.MFCC = MFCC
+
+__all__ = [n for n in ("functional", "features", "datasets", "backends",
+                       "load", "save", "info", "Spectrogram",
+                       "MelSpectrogram", "LogMelSpectrogram", "MFCC")]

@@ -1,0 +1,111 @@
+"""paddle.audio.backends — audio IO (reference:
+python/paddle/audio/backends/{backend,wave_backend}.py).
+
+The reference ships one in-tree backend (stdlib ``wave``, PCM16 WAV) and
+lets paddleaudio register soundfile backends. Same design here: the
+``wave`` backend is built in; ``set_backend`` accepts only registered
+names.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from collections import namedtuple
+
+import numpy as np
+
+AudioInfo = namedtuple(
+    "AudioInfo",
+    ["sample_rate", "num_samples", "num_channels", "bits_per_sample",
+     "encoding"])
+
+_BACKENDS = ["wave_backend"]
+_current = "wave_backend"
+
+
+def list_available_backends():
+    """reference: backends/backend.py list_available_backends."""
+    return list(_BACKENDS)
+
+
+def get_current_backend():
+    return _current
+
+
+def set_backend(backend_name):
+    """reference: backends/backend.py set_backend."""
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} is not registered "
+            f"(available: {_BACKENDS}); the soundfile backend ships with "
+            "paddleaudio, which is not part of this environment")
+    _current = backend_name
+
+
+def info(filepath):
+    """PCM16 WAV header info (reference: wave_backend.py:43)."""
+    f = _wave.open(filepath if hasattr(filepath, "read")
+                   else open(filepath, "rb"))
+    try:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_S")
+    finally:
+        f.close()
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """PCM16 WAV -> (Tensor, sample_rate) (reference: wave_backend.py:95).
+    normalize=True -> float32 in (-1, 1); else int16-valued float32."""
+    from .. import to_tensor, transpose
+    obj = filepath if hasattr(filepath, "read") else open(filepath, "rb")
+    try:
+        f = _wave.open(obj)
+    except _wave.Error:
+        obj.close()
+        raise NotImplementedError(
+            "only PCM16 WAV is supported by the built-in wave backend "
+            "(the reference's wave_backend has the same limit)")
+    channels = f.getnchannels()
+    sr = f.getframerate()
+    frames = f.getnframes()
+    content = f.readframes(frames)
+    obj.close()
+    a = np.frombuffer(content, dtype=np.int16).astype(np.float32)
+    if normalize:
+        a = a / 2 ** 15
+    wav = a.reshape(frames, channels)
+    if num_frames != -1:
+        wav = wav[frame_offset:frame_offset + num_frames, :]
+    elif frame_offset:
+        wav = wav[frame_offset:, :]
+    t = to_tensor(wav)
+    if channels_first:
+        t = transpose(t, [1, 0])
+    return t, sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Tensor -> PCM16 WAV (reference: wave_backend.py:174). ``src`` is
+    float in (-1, 1), [channels, time] when channels_first."""
+    arr = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T                     # -> [time, channels]
+    if bits_per_sample != 16 or encoding != "PCM_16":
+        raise NotImplementedError(
+            "built-in wave backend writes PCM_16 only (reference parity)")
+    pcm = np.clip(arr, -1.0, 1.0)
+    pcm = (pcm * (2 ** 15 - 1)).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim == 2 else 1)
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(pcm.tobytes())
+
+
+__all__ = ["get_current_backend", "list_available_backends", "set_backend",
+           "info", "load", "save", "AudioInfo"]

@@ -1,0 +1,136 @@
+"""paddle.audio.datasets — audio classification datasets (reference:
+python/paddle/audio/datasets/{dataset,esc50,tess}.py).
+
+Zero-egress environment: ``data_dir`` points at a locally provided copy
+in the upstream layout (ESC-50-master/{meta/esc50.csv,audio/*.wav};
+TESS_Toronto_emotional_speech_set/<emotion-dirs or flat wavs>). Feature
+extraction (raw/spectrogram/melspectrogram/logmelspectrogram/mfcc) runs
+through paddle.audio.features exactly as the reference does.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends as _backends
+
+_FEAT_CLASSES = ("raw", "spectrogram", "melspectrogram",
+                 "logmelspectrogram", "mfcc")
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: audio/datasets/dataset.py — (waveform-file, label)
+    list + on-access feature extraction."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        if feat_type not in _FEAT_CLASSES:
+            raise ValueError(
+                f"feat_type {feat_type!r} not in {_FEAT_CLASSES}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.feat_config = kwargs
+        self.sample_rate = sample_rate
+
+    def _convert_to_record(self, idx):
+        from .. import audio as A
+        wav, sr = _backends.load(self.files[idx], channels_first=False)
+        wav = wav[:, 0] if wav.ndim == 2 else wav
+        if self.feat_type == "raw":
+            feat = wav
+        else:
+            from .. import to_tensor
+            x = to_tensor(wav.numpy()[None, :])
+            kw = dict(self.feat_config)
+            n_mfcc = kw.pop("n_mfcc", 40)
+            if self.feat_type == "spectrogram":
+                feat = A.Spectrogram(**kw)(x)[0]
+            elif self.feat_type == "melspectrogram":
+                feat = A.MelSpectrogram(sr=sr, **kw)(x)[0]
+            elif self.feat_type == "logmelspectrogram":
+                feat = A.LogMelSpectrogram(sr=sr, **kw)(x)[0]
+            else:
+                feat = A.MFCC(sr=sr, n_mfcc=n_mfcc, **kw)(x)[0]
+        return feat, self.labels[idx]
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference: datasets/esc50.py:43):
+    2000 5-second clips, 50 classes, 5 folds; ``mode='dev'`` selects fold
+    ``split``, train the rest. meta/esc50.csv columns:
+    filename,fold,target,category,..."""
+
+    label_list = None  # filled from the meta csv categories
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None:
+            raise RuntimeError(
+                "ESC50: automatic download is unavailable (zero egress); "
+                "pass data_dir= pointing at an ESC-50-master checkout "
+                "(https://paddleaudio.bj.bcebos.com/datasets/"
+                "ESC-50-master.zip)")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        audio_dir = os.path.join(data_dir, "audio")
+        files, labels = [], []
+        cats = {}
+        with open(meta) as f:
+            header = f.readline()
+            for line in f:
+                parts = line.strip().split(",")
+                if len(parts) < 4:
+                    continue
+                filename, fold, target, category = parts[:4]
+                cats[int(target)] = category
+                in_dev = int(fold) == int(split)
+                if (mode == "dev") == in_dev:
+                    files.append(os.path.join(audio_dir, filename))
+                    labels.append(int(target))
+        type(self).label_list = [cats.get(i, str(i))
+                                 for i in range(max(cats, default=-1) + 1)]
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference: datasets/tess.py:30): 2800
+    <actor>_<word>_<emotion>.wav files, 7 emotions; n-fold split by file
+    order, fold ``split`` is dev."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None:
+            raise RuntimeError(
+                "TESS: automatic download is unavailable (zero egress); "
+                "pass data_dir= pointing at an unpacked "
+                "TESS_Toronto_emotional_speech_set directory")
+        wavs = []
+        for root, _dirs, names in os.walk(data_dir):
+            for n in sorted(names):
+                if n.lower().endswith(".wav"):
+                    wavs.append(os.path.join(root, n))
+        files, labels = [], []
+        for i, path in enumerate(sorted(wavs)):
+            emotion = os.path.splitext(os.path.basename(path))[0] \
+                .split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            fold = i % n_folds + 1
+            if (mode == "dev") == (fold == int(split)):
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **kwargs)
+
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]

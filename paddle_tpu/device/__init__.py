@@ -153,11 +153,81 @@ class cuda:
         from ..core import native as _nv
         return _nv.mem_reserved()
 
+    @staticmethod
+    def max_memory_reserved(device=None):
+        from ..core import native as _nv
+        return _nv.mem_peak()
+
+    @staticmethod
+    def reset_max_memory_allocated(device=None):
+        from ..core import native as _nv
+        if hasattr(_nv, "mem_reset_peak"):
+            _nv.mem_reset_peak()
+
+    @staticmethod
+    def reset_max_memory_reserved(device=None):
+        cuda.reset_max_memory_allocated(device)
+
+    @staticmethod
+    def current_stream(device=None):
+        return current_stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import jax
+        devs = [d for d in jax.devices()]
+        d = devs[0 if device is None else int(
+            str(device).rsplit(":", 1)[-1]) if str(device)[-1].isdigit()
+            else 0]
+
+        class _Props:
+            name = getattr(d, "device_kind", str(d))
+            major, minor = 0, 0
+            total_memory = (getattr(d, "memory_stats", lambda: {})() or
+                            {}).get("bytes_limit", 0)
+            multi_processor_count = 1
+
+            def __repr__(self):
+                return (f"_gpuDeviceProperties(name='{self.name}', "
+                        f"total_memory={self.total_memory})")
+
+        return _Props()
+
+    @staticmethod
+    def get_device_name(device=None):
+        return cuda.get_device_properties(device).name
+
+    @staticmethod
+    def get_device_capability(device=None):
+        p = cuda.get_device_properties(device)
+        return p.major, p.minor
+
+
+class xpu:
+    """paddle.device.xpu parity shim (vendor-XPU is a sanctioned
+    descope; the calls map onto the current accelerator runtime)."""
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        cuda.empty_cache()
+
+    @staticmethod
+    def device_count():
+        return 0
+
 
 __all__ = ["set_device", "get_device", "device_count", "synchronize",
            "Stream", "Event", "current_stream", "stream_guard", "cuda",
            "is_compiled_with_tpu", "is_compiled_with_cuda",
-           "is_compiled_with_xpu", "get_all_device_type",
+           "is_compiled_with_xpu", "xpu", "get_all_device_type",
            "get_available_device"]
 
 

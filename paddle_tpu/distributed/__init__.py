@@ -50,3 +50,24 @@ from .communication import (  # noqa: F401
     get_group, get_backend, stream,
 )
 from . import passes  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import sharding  # noqa: E402,F401
+from .sharding import save_group_sharded_model  # noqa: E402,F401
+from .compat_tail import (  # noqa: E402,F401
+    ParallelMode, ReduceType, DistAttr, is_available, gather,
+    broadcast_object_list, scatter_object_list, gloo_init_parallel_env,
+    gloo_barrier, gloo_release, split, ShardingStage1, ShardingStage2,
+    ShardingStage3, Strategy, SplitPoint, LocalLayer, dtensor_from_fn,
+    unshard_dtensor, shard_dataloader, shard_scaler, to_distributed,
+    QueueDataset, InMemoryDataset, CountFilterEntry, ShowClickEntry,
+    ProbabilityEntry,
+)
+from .auto_parallel import (  # noqa: E402,F401
+    DistModel, ColWiseParallel, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelEnd, SequenceParallelEnable, SequenceParallelDisable,
+    PrepareLayerInput, PrepareLayerOutput,
+)
+
+# reference spells these without underscores too
+alltoall = all_to_all
+alltoall_single = all_to_all_single

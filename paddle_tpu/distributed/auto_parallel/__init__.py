@@ -57,6 +57,74 @@ class SequenceParallelEnd(ParallelStyle):
         pass
 
 
+class SequenceParallelEnable(ParallelStyle):
+    """Mark a layer to run sequence-parallel (reference:
+    intermediate/sequence_parallel.py SequenceParallelEnable): its
+    activations are sharded along the sequence dim over the mp axis.
+    Under GSPMD the marking is a sharding hint on the layer's output."""
+
+    def apply(self, layer, mesh, axis_name):
+        idx = mesh.dim_names.index(axis_name)
+
+        def hook(l, inputs, outputs):
+            from ..api import shard_tensor
+            out = outputs[0] if isinstance(outputs, tuple) else outputs
+            if hasattr(out, "_data") and out._data.ndim >= 2:
+                pl = [Replicate()] * mesh.ndim
+                pl[idx] = Shard(1)       # [batch, SEQ, hidden]
+                re_out = shard_tensor(out, mesh, pl)
+                return (re_out,) + tuple(outputs[1:]) \
+                    if isinstance(outputs, tuple) else re_out
+            return outputs
+
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelDisable(ParallelStyle):
+    """Opt a layer out of sequence parallelism (reference:
+    intermediate/sequence_parallel.py SequenceParallelDisable): gather
+    the sequence dim back before the layer runs."""
+
+    def __init__(self, need_transpose=True):
+        self.need_transpose = need_transpose
+
+    def apply(self, layer, mesh, axis_name):
+        def hook(l, inputs):
+            from ..api import reshard
+            outs = []
+            for t in inputs:
+                if hasattr(t, "_data") and t._data.ndim >= 2:
+                    pl = [Replicate()] * mesh.ndim
+                    outs.append(reshard(t, mesh, pl))
+                else:
+                    outs.append(t)
+            return tuple(outs)
+
+        layer.register_forward_pre_hook(hook)
+
+
+class PrepareLayerInput(ParallelStyle):
+    """Run a user fn over layer inputs (reference:
+    intermediate/tensor_parallel.py PrepareLayerInput): ``fn(mesh)``
+    returns the pre-hook."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis_name):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(self.fn(process_mesh=mesh))
+
+
+class PrepareLayerOutput(ParallelStyle):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis_name):
+        if self.fn is not None:
+            layer.register_forward_post_hook(self.fn(process_mesh=mesh))
+
+
 def _match(pattern, name):
     if pattern == name:
         return True
@@ -107,3 +175,59 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
         return loss(layer(*xs), y)
 
     return TrainStep(layer, loss_fn, optimizer)
+
+
+class DistModel:
+    """reference: auto_parallel/api.py:2263 DistModel — the compiled
+    train/eval/predict wrapper returned by ``to_static``. Wraps the
+    fused TrainStep with the reference's mode switches: ``train()``
+    steps the optimizer, ``eval()`` computes loss only, ``predict()``
+    runs forward."""
+
+    def __init__(self, layer, loss=None, optimizer=None, strategy=None):
+        from ...jit import TrainStep
+        self._layer = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._step = None
+        if loss is not None and optimizer is not None:
+            def loss_fn(*batch):
+                *xs, y = batch
+                return loss(layer(*xs), y)
+            self._step = TrainStep(layer, loss_fn, optimizer)
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+        return self
+
+    def __call__(self, *batch):
+        if self._mode == "train":
+            if self._step is None:
+                raise RuntimeError("DistModel: train mode needs loss and "
+                                   "optimizer")
+            return self._step(*batch)
+        if self._mode == "eval":
+            *xs, y = batch
+            return self._loss(self._layer(*xs), y)
+        return self._layer(*batch)
+
+    def state_dict(self, mode="all"):
+        return self._layer.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return None  # jaxpr/StableHLO is the IR on this stack
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -144,7 +144,14 @@ class _StreamNamespace:
 
     def __getattr__(self, name):
         from . import collective as C
-        base = getattr(C, name, None)
+        if name == "alltoall":
+            base = C.all_to_all
+        elif name == "alltoall_single":
+            base = all_to_all_single
+        elif name == "gather":
+            from .compat_tail import gather as base
+        else:
+            base = getattr(C, name, None)
         if base is None:
             raise AttributeError(name)
 

@@ -1,0 +1,418 @@
+"""paddle.distributed top-level tail (reference:
+python/paddle/distributed/__init__.py __all__).
+
+Modes/enums, object collectives, the mp ``split`` builder, semi-auto
+sharding-stage markers, LocalLayer, shard_dataloader/scaler, the
+high-level ``to_distributed``, and the sanctioned PS-tier descopes —
+each mapped onto the live machinery (mesh/GSPMD/fleet mp layers) rather
+than re-implemented beside it.
+"""
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class ParallelMode:
+    """reference: fleet/base/topology.py:42."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference: the Paddle C reduce-type enum exposed as
+    paddle.distributed.ReduceType (used by Partial placements)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """reference: paddle.distributed.DistAttr (sharding spec form of the
+    (mesh, placements) pair). kept for signature parity — the native
+    spelling on this stack is (ProcessMesh, [Shard/Replicate/Partial])."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+def is_available():
+    """reference: distributed/parallel.py is_available — whether the
+    distributed package can be used (always true on this stack: the
+    collective layer runs single-process too)."""
+    return True
+
+
+# -- object / tail collectives --------------------------------------------
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Collective gather (reference: communication/gather.py:29): dst
+    receives every rank's tensor in ``gather_list``; other ranks pass
+    None. Lowered as all_gather + keep-on-dst (ICI bandwidth-equivalent
+    for the small control tensors this API serves)."""
+    from . import collective as C
+    tmp = []
+    C.all_gather(tmp, tensor, group=group)
+    if C.get_rank(group) == dst:
+        if gather_list is None:
+            return tmp
+        gather_list.clear()
+        gather_list.extend(tmp)
+    return None
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: communication/broadcast.py broadcast_object_list —
+    every rank ends with src's objects. Lowered over the object
+    all-gather (each rank contributes; src's contribution wins), the
+    same pickle wire format as the reference."""
+    from . import collective as C
+    if C.get_world_size(group) <= 1:
+        return
+    gathered = []
+    C.all_gather_object(gathered, list(object_list), group=group)
+    object_list[:] = gathered[src]
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """reference: communication/scatter.py scatter_object_list — rank i
+    receives in_object_list[i] (provided on src)."""
+    from . import collective as C
+    n = C.get_world_size(group)
+    rank = C.get_rank(group)
+    if n <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return
+    gathered = []
+    C.all_gather_object(gathered, list(in_object_list or []), group=group)
+    items = gathered[src]
+    if len(items) != n:
+        raise ValueError(
+            f"scatter_object_list: {len(items)} objects for {n} ranks")
+    out_object_list[:] = [items[rank]]
+
+
+# -- gloo compatibility (CPU collectives) ----------------------------------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel.py gloo_init_parallel_env — CPU-only
+    rendezvous. The coordination-service init covers CPU backends on
+    this stack; this wrapper feeds it the explicit triple."""
+    import os
+    from . import collective as C
+    os.environ.setdefault("PADDLE_TRAINER_ID", str(rank_id))
+    os.environ.setdefault("PADDLE_TRAINERS_NUM", str(rank_num))
+    os.environ.setdefault("MASTER_ENDPOINT", server_endpoint)
+    C.init_parallel_env()
+
+
+def gloo_barrier():
+    from . import collective as C
+    if C.is_initialized():
+        from .collective import barrier
+        barrier()
+
+
+def gloo_release():
+    """Release the CPU rendezvous resources (no-op: the coordination
+    service tears down at process exit)."""
+    return None
+
+
+# -- mp split builder ------------------------------------------------------
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Build + run a model-parallel linear/embedding (reference:
+    fleet/layers/mpu/mp_ops.py:773). Maps onto the fleet mpu layers —
+    Column/RowParallelLinear and VocabParallelEmbedding — which shard
+    over the current mp group (single-process: plain layer math)."""
+    from .fleet import mp_layers as mpu
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = mpu.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        else:
+            layer = mpu.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        n, m = size
+        layer = mpu.VocabParallelEmbedding(n, m, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: operation must be 'linear' or 'embedding', "
+                     f"got {operation!r}")
+
+
+# -- semi-auto markers / wrappers ------------------------------------------
+
+class _ShardingStage:
+    """Shard-fn markers accepted by shard_optimizer (reference:
+    auto_parallel/api.py:1430/1522/1638 ShardingStage1/2/3): re-place
+    optimizer states Shard(0) over the given mesh axis."""
+
+    stage = 0
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def __call__(self, key, param, state):
+        from .api import shard_parameter
+        from .placement import Shard, Replicate
+        if self.mesh is None or state.ndim == 0:
+            return state
+        names = list(getattr(self.mesh, "dim_names", []))
+        axis = names.index(self.axis_name) if self.axis_name in names else 0
+        placements = [Replicate() for _ in range(len(self.mesh.shape))]
+        placements[axis] = Shard(0)
+        try:
+            return shard_parameter(state, self.mesh, placements)
+        except Exception:
+            return state
+
+
+class ShardingStage1(_ShardingStage):
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py Strategy — config bundle for
+    to_static/DistModel (sharding/amp/pipeline/fused_passes knobs)."""
+
+    class _NS:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+
+        def ns(key, **defaults):
+            defaults.update(cfg.get(key, {}))
+            return self._NS(**defaults)
+
+        self.sharding = ns("sharding", enable=False, stage=1, degree=1)
+        self.amp = ns("amp", enable=False, dtype="float16", level="O1")
+        self.pipeline = ns("pipeline", enable=False, schedule_mode="1F1B",
+                           micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = ns("fused_passes", enable=False,
+                               fused_passes_list=[])
+        self.gradient_merge = ns("gradient_merge", enable=False, k_steps=1)
+
+
+class SplitPoint(enum.Enum):
+    """reference: auto_parallel/intermediate/pipeline_parallel.py:30."""
+    BEGINNING = 0
+    END = 1
+
+
+class LocalLayer:
+    """reference: auto_parallel/local_layer.py:27 — forward computes on
+    LOCAL shards; declared out_dist_attrs re-wrap the outputs as dist
+    tensors. Subclass and implement forward.
+
+    Under GSPMD the local/global distinction appears inside shard_map
+    regions; eagerly (this form) the conversion is dtensor_from_local.
+    """
+
+    def __init__(self, out_dist_attrs):
+        self.out_dist_attrs = list(out_dist_attrs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        from .api import dtensor_from_local, local_value
+        local_args = [local_value(a) if isinstance(a, Tensor) else a
+                      for a in args]
+        outs = self.forward(*local_args, **kwargs)
+        single = not isinstance(outs, (list, tuple))
+        outs_t = [outs] if single else list(outs)
+        wrapped = []
+        for o, (mesh, placements) in zip(outs_t, self.out_dist_attrs):
+            wrapped.append(dtensor_from_local(o, mesh, placements))
+        return wrapped[0] if single else type(outs)(wrapped)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """reference: auto_parallel/api.py:757 — build locally via ``fn``
+    then shard."""
+    from .api import shard_tensor
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """reference: auto_parallel/api.py:3162 — back to a dense replicated
+    tensor."""
+    from .api import reshard, get_placements
+    from .placement import Replicate
+    from .mesh import get_mesh
+    mesh = getattr(dist_tensor, "process_mesh", None) or get_mesh()
+    if mesh is None:
+        return dist_tensor
+    return reshard(dist_tensor, mesh,
+                   [Replicate() for _ in range(len(mesh.shape))])
+
+
+class _ShardedDataLoader:
+    def __init__(self, loader, mesh, shard_dims, input_keys):
+        self._loader = loader
+        self._mesh = mesh
+        self._shard_dims = shard_dims
+        self._input_keys = input_keys
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, t, dim):
+        from .api import shard_tensor
+        from .placement import Shard, Replicate
+        mesh = self._mesh
+        placements = [Replicate() for _ in range(len(mesh.shape))]
+        if dim is not None:
+            names = list(getattr(mesh, "dim_names", []))
+            axis = names.index(dim) if isinstance(dim, str) and dim in names \
+                else (dim if isinstance(dim, int) else 0)
+            placements[axis] = Shard(0)
+        return shard_tensor(t, mesh, placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: self._place(v, self._shard_dims)
+                       if isinstance(v, Tensor) else v
+                       for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(
+                    self._place(v, self._shard_dims)
+                    if isinstance(v, Tensor) else v for v in batch)
+            else:
+                yield self._place(batch, self._shard_dims)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """reference: auto_parallel/api.py:3514 — wrap a DataLoader so each
+    batch arrives as dist tensors sharded along ``shard_dims`` (the dp
+    axis) of the given mesh."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    return _ShardedDataLoader(dataloader, mesh, shard_dims, input_keys)
+
+
+def shard_scaler(scaler):
+    """reference: auto_parallel/api.py:1786 — make GradScaler's
+    found-inf reduction span the mesh. GSPMD already reduces the
+    elementwise found-inf check globally when grads are dist tensors, so
+    the scaler is returned unchanged (kept as the documented contract).
+    """
+    return scaler
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None,
+                   node_num=1, config=None):
+    """High-level auto-parallel entry (reference:
+    auto_parallel/high_level_api.py:255): pick a mesh over the visible
+    devices, apply the intermediate parallelize() plan (dp by default),
+    and shard the dataloader."""
+    import jax
+    from .mesh import ProcessMesh
+    from .auto_parallel import parallelize
+    n = device_num or len(jax.devices())
+    mesh = ProcessMesh(np.arange(n).reshape(n), dim_names=["dp"])
+    model = parallelize(model, mesh=mesh, config=config or {})
+    loader = shard_dataloader(dataloader, mesh, shard_dims="dp")
+    return model, optimizer, loader
+
+
+# -- PS-tier datasets/entries: sanctioned descope --------------------------
+
+class _PSDescope:
+    _what = "parameter-server dataset"
+
+    def __init__(self, *a, **kw):
+        pass
+
+    def init(self, *a, **kw):
+        raise NotImplementedError(
+            f"{type(self).__name__}: {self._what} requires the "
+            "parameter-server runtime — sanctioned descope (SURVEY.md "
+            "§7); stream data with paddle.io.DataLoader instead")
+
+    load_into_memory = init
+    set_filelist = init
+
+
+class QueueDataset(_PSDescope):
+    """reference: distributed/fleet/dataset/dataset.py QueueDataset."""
+
+
+class InMemoryDataset(_PSDescope):
+    """reference: distributed/fleet/dataset/dataset.py InMemoryDataset."""
+
+
+class CountFilterEntry:
+    """reference: distributed/entry_attr.py — sparse-table admission
+    config (value descriptor; meaningful only under the PS runtime)."""
+
+    def __init__(self, count):
+        self._count = int(count)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count}"
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class ProbabilityEntry:
+    def __init__(self, probability):
+        self._prob = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._prob}"
+
+
+__all__ = [
+    "ParallelMode", "ReduceType", "DistAttr", "is_available", "gather",
+    "broadcast_object_list", "scatter_object_list",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release", "split",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3", "Strategy",
+    "SplitPoint", "LocalLayer", "dtensor_from_fn", "unshard_dtensor",
+    "shard_dataloader", "shard_scaler", "to_distributed", "QueueDataset",
+    "InMemoryDataset", "CountFilterEntry", "ShowClickEntry",
+    "ProbabilityEntry",
+]

@@ -158,3 +158,116 @@ def init_worker():
 
 from . import utils  # noqa: F401,E402
 from . import meta_parallel  # noqa: F401,E402
+
+
+class UtilBase:
+    """reference: distributed/fleet/utils/fleet_util.py UtilBase — the
+    fleet.util helper bundle (all_reduce/barrier over the fleet's
+    collectives plus filesystem helpers)."""
+
+    def __init__(self):
+        from .utils import LocalFS
+        self._fs = LocalFS()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+        from .. import collective as C
+        if not C.is_initialized() or C.get_world_size() <= 1:
+            return input
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+        t = input if isinstance(input, Tensor) else Tensor(
+            jnp.asarray(np.asarray(input)))
+        op = {"sum": C.ReduceOp.SUM, "mean": C.ReduceOp.SUM,
+              "max": C.ReduceOp.MAX, "min": C.ReduceOp.MIN}[mode.lower()]
+        C.all_reduce(t, op=op)
+        if mode == "mean":
+            t = Tensor(t._data / C.get_world_size())
+        return t
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        if C.is_initialized():
+            from ... import distributed as dist
+            dist.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference
+        behavior: div+mod remainder to the first ranks)."""
+        from .. import collective as C
+        rank = C.get_rank() if C.is_initialized() else 0
+        n = C.get_world_size() if C.is_initialized() else 1
+        base, rem = divmod(len(files), n)
+        start = rank * base + min(rank, rem)
+        return files[start:start + base + (1 if rank < rem else 0)]
+
+
+util = UtilBase()
+
+
+class Role:
+    """reference: fleet/base/role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class MultiSlotDataGenerator:
+    """reference: distributed/fleet/data_generator/data_generator.py —
+    the PS-pipeline text data generator: subclasses implement
+    generate_sample; run_from_stdin/files emits the slot:feasign wire
+    format."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line)")
+
+    def _format(self, sample):
+        parts = []
+        for name, feasigns in sample:
+            parts.append(f"{len(feasigns)} " +
+                         " ".join(str(v) for v in feasigns))
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            for sample in (g() if callable(g) else g):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_files(self, paths):
+        out = []
+        for p in paths:
+            with open(p) as f:
+                for line in f:
+                    g = self.generate_sample(line)
+                    for sample in (g() if callable(g) else g):
+                        out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots variant (reference: same file)."""
+
+
+class Fleet:
+    """reference: fleet/fleet.py Fleet — the stateful facade; module
+    functions here are its methods (fleet.init() etc. operate on the
+    module-level singleton the same way)."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    is_first_worker = staticmethod(is_first_worker)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    barrier_worker = staticmethod(barrier_worker)
+    is_worker = staticmethod(is_worker)
+    util = util

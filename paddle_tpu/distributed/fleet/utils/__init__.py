@@ -96,3 +96,94 @@ class LocalFS:
 from . import sequence_parallel_utils  # noqa: E402,F401
 from . import hybrid_parallel_util  # noqa: E402,F401
 from . import mix_precision_utils  # noqa: E402,F401
+
+
+class HDFSClient:
+    """Hadoop FS client (reference: fleet/utils/fs.py:400 HDFSClient —
+    shells out to ``hadoop fs``). Same design: each call runs the
+    configured hadoop binary; constructing the client only records the
+    config, so code paths that build-but-don't-touch HDFS work in
+    hadoop-less environments."""
+
+    def __init__(self, hadoop_home, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        import os
+        self._hadoop_home = hadoop_home
+        self._configs = configs or {}
+        self._time_out = time_out
+        cfg = " ".join(f"-D{k}={v}" for k, v in self._configs.items())
+        self._base = os.path.join(hadoop_home, "bin/hadoop") + " fs " + cfg
+
+    def _run(self, cmd):
+        import subprocess
+        full = f"{self._base} {cmd}"
+        proc = subprocess.run(full, shell=True, capture_output=True,
+                              text=True, timeout=self._time_out / 1000)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"hadoop command failed ({full!r}): "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    def ls_dir(self, fs_path):
+        out = self._run(f"-ls {fs_path}")
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            (dirs if parts[0].startswith("d") else files).append(parts[-1])
+        return dirs, files
+
+    def is_exist(self, fs_path):
+        try:
+            self._run(f"-test -e {fs_path}")
+            return True
+        except RuntimeError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run(f"-test -d {fs_path}")
+            return True
+        except RuntimeError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def upload(self, local_path, fs_path, multi_processes=1, overwrite=False):
+        self._run(f"-put {local_path} {fs_path}")
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        self._run(f"-get {fs_path} {local_path}")
+
+    def mkdirs(self, fs_path):
+        self._run(f"-mkdir -p {fs_path}")
+
+    def delete(self, fs_path):
+        self._run(f"-rm -r {fs_path}")
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        self._run(f"-mv {fs_src_path} {fs_dst_path}")
+
+    def cat(self, fs_path):
+        return self._run(f"-cat {fs_path}")
+
+    def touch(self, fs_path, exist_ok=True):
+        self._run(f"-touchz {fs_path}")
+
+
+class DistributedInfer:
+    """reference: fleet/utils/ps_util.py DistributedInfer — rewrites a
+    program for PS sparse-table inference. Parameter-server mode is a
+    sanctioned descope (SURVEY.md §7)."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        raise NotImplementedError(
+            "DistributedInfer requires parameter-server mode — sanctioned "
+            "descope (SURVEY.md §7); serve with paddle.inference instead")
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

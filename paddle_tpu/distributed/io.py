@@ -1,0 +1,38 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+save/load persistables for distributed training; the PS remote-var tier
+is a sanctioned descope)."""
+from __future__ import annotations
+
+import os
+
+
+def is_persistable(var):
+    """reference: io.py is_persistable."""
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Persist a static Program's parameters (reference: io.py
+    save_persistables)."""
+    from ..framework.io import save as fsave
+    from ..static.program import default_main_program
+    program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    target = os.path.join(dirname, filename or "persistables.pdparams")
+    fsave({k: v for k, v in program.state_dict().items()}, target)
+    return target
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    from ..framework.io import load as fload
+    from ..static.program import default_main_program
+    from ..static.serialization import set_program_state
+    program = main_program or default_main_program()
+    target = os.path.join(dirname, filename or "persistables.pdparams")
+    set_program_state(program, fload(target))
+    return program
+
+
+__all__ = ["is_persistable", "save_persistables", "load_persistables"]

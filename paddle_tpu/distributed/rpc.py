@@ -149,3 +149,39 @@ def shutdown():
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_all_worker_infos",
            "shutdown", "FutureWrapper"]
+
+
+class WorkerInfo:
+    """reference: distributed/rpc/internal.py WorkerInfo(name, rank,
+    ip, port)."""
+
+    def __init__(self, name, rank=-1, ip="", port=0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+def get_worker_info(name):
+    """reference: distributed/rpc/rpc.py get_worker_info."""
+    names = get_all_worker_infos()
+    if name not in names:
+        raise ValueError(f"rpc worker {name!r} not registered "
+                         f"(known: {names})")
+    return WorkerInfo(name, rank=names.index(name))
+
+
+def get_current_worker_info():
+    if not _state.get("name"):
+        raise RuntimeError("rpc not initialized (call init_rpc first)")
+    names = get_all_worker_infos()
+    name = _state["name"]
+    return WorkerInfo(name, rank=names.index(name)
+                      if name in names else -1)
+
+
+__all__ += ["WorkerInfo", "get_worker_info", "get_current_worker_info"]

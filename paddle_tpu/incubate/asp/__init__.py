@@ -49,6 +49,9 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     from ...nn.layer.common import Linear
     for name, layer in model.named_sublayers():
         if isinstance(layer, Linear):
+            if name in _EXCLUDED or getattr(layer.weight, "name", None) \
+                    in _EXCLUDED:
+                continue
             w = layer.weight
             mask = compute_mask_2d(w.numpy(), n, m)
             w._data = w._data * jnp.asarray(mask, w._data.dtype)
@@ -75,7 +78,38 @@ def decorate(optimizer):
 
 def reset_excluded_layers(model=None):
     _masks.clear()
+    _EXCLUDED.clear()
 
 
 __all__ = ["compute_mask_2d", "check_mask_2d", "prune_model", "decorate",
            "reset_excluded_layers"]
+
+
+def calculate_density(x):
+    """reference: incubate/asp/utils.py calculate_density — fraction of
+    nonzeros."""
+    import numpy as np
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+_EXCLUDED = set()
+_SUPPORTED_EXTRA = set()
+
+
+def set_excluded_layers(param_names=None, main_program=None, model=None):
+    """reference: incubate/asp/asp.py set_excluded_layers — names whose
+    parameters prune_model must leave dense."""
+    for n in (param_names or []):
+        _EXCLUDED.add(n)
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """reference: incubate/asp/supported_layer_list.py — widen the
+    prunable layer set."""
+    _SUPPORTED_EXTRA.add(layer if isinstance(layer, str)
+                         else getattr(layer, "__name__", str(layer)))
+
+
+__all__ += ["calculate_density", "set_excluded_layers",
+            "add_supported_layer"]

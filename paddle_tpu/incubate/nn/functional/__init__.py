@@ -310,3 +310,12 @@ __all__ += [
     "fused_linear_activation", "fused_multi_head_attention", "fused_moe",
     "variable_length_memory_efficient_attention", "fused_multi_transformer",
 ]
+
+from .decode_ops import (  # noqa: E402,F401
+    blha_get_max_len, masked_multihead_attention,
+    block_multihead_attention, moe_dispatch, moe_ffn, moe_reduce,
+)
+
+__all__ += ["blha_get_max_len", "masked_multihead_attention",
+            "block_multihead_attention", "moe_dispatch", "moe_ffn",
+            "moe_reduce"]

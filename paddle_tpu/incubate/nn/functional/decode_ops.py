@@ -1,0 +1,321 @@
+"""Generation-serving fused ops: masked/block multi-head attention and
+the three-phase MoE pipeline.
+
+Reference: python/paddle/incubate/nn/functional/
+masked_multihead_attention.py:74, block_multihead_attention.py:33,
+blha_get_max_len.py:26, fused_moe.py:131/248/336 — each backed there by
+a CUDA serving kernel. Here the decode path rides the Pallas paged
+attention kernel (kernels/paged_attention.py) on TPU and its reference
+composition elsewhere; the quant tiers raise (same "currently not
+supported" state as the reference's python surface where noted).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+
+def _raw(t):
+    if t is None:
+        return None
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """reference: blha_get_max_len.py:26 — max encoder/decoder lengths
+    this step (host scalars for kernel grid sizing)."""
+    enc = _raw(seq_lens_encoder)
+    dec = _raw(seq_lens_decoder)
+    return (Tensor(jnp.max(enc).astype(jnp.int32).reshape(1)),
+            Tensor(jnp.max(dec).astype(jnp.int32).reshape(1)))
+
+
+def _rope_decode(q, k, rot, neox):
+    # rot: [B, 1, 1, S, hd] cos/sin interleaved table at the current
+    # positions — the reference packs cos into even and sin into odd
+    # lanes of one tensor; accept [2, B, ...] (cos, sin) too.
+    if rot.ndim >= 1 and rot.shape[0] == 2:
+        cos, sin = rot[0], rot[1]
+    else:
+        cos, sin = jnp.cos(rot), jnp.sin(rot)
+    cos = cos.reshape(cos.shape[0], 1, -1)[:, :, -q.shape[-1]:]
+    sin = sin.reshape(sin.shape[0], 1, -1)[:, :, -q.shape[-1]:]
+
+    def rot1(t):
+        if neox:
+            half = t.shape[-1] // 2
+            t1, t2 = t[..., :half], t[..., half:]
+            r = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            r = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos + r * sin
+
+    return rot1(q), rot1(k)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode attention (reference:
+    masked_multihead_attention.py:74): x [B, 3*H*hd] packed qkv, cache
+    [2, B, H, S_max, hd]; appends this step's k/v at the position given
+    by ``sequence_lengths`` (default: first all-zero slot) and attends
+    over the populated prefix. Returns (out, cache_kv_out) — functional
+    cache-out (jax arrays are immutable; the reference updates in
+    place). Quant/beam tiers raise."""
+    if qkv_out_scale is not None or out_scale != -1:
+        raise NotImplementedError(
+            "masked_multihead_attention: quant path not supported "
+            "(serve int8 via paddle.quantization)")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: beam search is served by "
+            "models.generation on this stack")
+    xv = _raw(x)
+    cache = _raw(cache_kv)
+    b = xv.shape[0]
+    _, _, h, s_max, hd = cache.shape
+    qkv = xv.reshape(b, 3, h, hd)
+    if bias is not None:
+        qkv = qkv + _raw(bias).reshape(1, 3, h, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, hd]
+    if sequence_lengths is not None:
+        pos = _raw(sequence_lengths).reshape(-1).astype(jnp.int32)
+    else:
+        # first unwritten slot = count of nonzero key rows
+        written = jnp.any(cache[0] != 0, axis=(1, 3))  # [B, S_max] (any h)
+        pos = jnp.sum(written.astype(jnp.int32), axis=-1)
+    if rotary_tensor is not None and rotary_emb_dims > 0:
+        q, k = _rope_decode(q, k, _raw(rotary_tensor),
+                            use_neox_rotary_style)
+    # write k/v at pos (per batch)
+    onehot = jax.nn.one_hot(pos, s_max, dtype=cache.dtype)  # [B, S_max]
+    k_cache = cache[0] * (1 - onehot[:, None, :, None]) + \
+        onehot[:, None, :, None] * k[:, :, None, :]
+    v_cache = cache[1] * (1 - onehot[:, None, :, None]) + \
+        onehot[:, None, :, None] * v[:, :, None, :]
+    scores = jnp.einsum("bhd,bhsd->bhs", q * hd ** -0.5, k_cache)
+    valid = jnp.arange(s_max)[None, :] <= pos[:, None]      # [B, S_max]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+    scores = jnp.where(valid[:, None, :], scores, neg)
+    if src_mask is not None:
+        m = _raw(src_mask)                                   # [B,1,1,S]
+        sm = m.reshape(b, 1, -1)
+        scores = scores.at[:, :, :sm.shape[-1]].add(
+            sm.astype(scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhs,bhsd->bhd", probs, v_cache)
+    out = Tensor(ctx.reshape(b, h * hd))
+    return out, Tensor(jnp.stack([k_cache, v_cache]))
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets,
+                              cum_offsets, cu_seqlens_q, cu_seqlens_k,
+                              block_tables, pre_key_cache=None,
+                              pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1,
+                              compute_dtype="default", rope_theta=10000.0):
+    """Paged-KV attention (reference: block_multihead_attention.py:33).
+
+    Supported surface: the bf16/f32 serving path — prefill (encoder)
+    steps with per-sequence lengths and causal masking, and decode
+    steps (seq_lens_this_time == 1) over the block cache, one uniform
+    mode per call (the reference kernel splits mixed batches into the
+    same two phases internally). KV layout: key/value_cache
+    [max_block_num, num_head, block_size, head_size]; block_tables
+    [B, blocks_per_seq]. Cache quant / pre-cache tiers raise.
+    Returns (fmha_out, qkv, key_cache_out, value_cache_out).
+    """
+    if cache_k_quant_scales is not None or qkv_out_scale is not None \
+            or out_scale != -1 or use_dynamic_cachekv_quant:
+        raise NotImplementedError(
+            "block_multihead_attention: cache-KV quant tier not supported")
+    if pre_key_cache is not None:
+        raise NotImplementedError(
+            "block_multihead_attention: pre_cache is generation-search "
+            "plumbing served by models.generation")
+    qkv_v = _raw(qkv)
+    kc = _raw(key_cache)
+    vc = _raw(value_cache)
+    enc_lens = _raw(seq_lens_encoder).reshape(-1).astype(jnp.int32)
+    dec_lens = _raw(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    this_lens = _raw(seq_lens_this_time).reshape(-1).astype(jnp.int32)
+    tables = _raw(block_tables).astype(jnp.int32)
+    b = tables.shape[0]
+    nh = kc.shape[1]
+    hd = kc.shape[3]
+    if qkv_bias is not None:
+        qkv_v = qkv_v + _raw(qkv_bias).reshape(1, -1)
+    tok = qkv_v.reshape(-1, 3, nh, hd)
+
+    import numpy as np
+    enc_np = np.asarray(enc_lens)
+    this_np = np.asarray(this_lens)
+    decode_mode = bool((enc_np == 0).all())
+    prefill_mode = bool((enc_np == this_np).all() and (enc_np > 0).all())
+    if not (decode_mode or prefill_mode):
+        raise NotImplementedError(
+            "block_multihead_attention: mixed prefill+decode batches — "
+            "issue the two phases as separate calls on this stack")
+
+    def write_token(kcv, vcv, bi, position, ktok, vtok):
+        blk = tables[bi, position // block_size]
+        off = position % block_size
+        kcv = kcv.at[blk, :, off, :].set(ktok)
+        vcv = vcv.at[blk, :, off, :].set(vtok)
+        return kcv, vcv
+
+    if decode_mode:
+        # one token per sequence at position dec_lens[b]
+        q = tok[:, 0]                                   # [B, H, hd]
+        k = tok[:, 1]
+        v = tok[:, 2]
+        if rope_emb is not None:
+            q, k = _rope_decode(q, k, _raw(rope_emb), use_neox_style)
+        for bi in range(b):
+            kc, vc = write_token(kc, vc, bi, int(dec_lens[bi]),
+                                 k[bi], v[bi])
+        from ....kernels.paged_attention import paged_attention_reference
+        pages = jnp.moveaxis(kc, 1, 0)    # [H, blocks, bs, hd]
+        vpages = jnp.moveaxis(vc, 1, 0)
+        out = paged_attention_reference(q, pages, vpages, tables,
+                                        dec_lens + 1)
+        fmha = out.reshape(b, nh * hd)
+    else:
+        # prefill: tokens are the concatenated prompts (cu_seqlens_q)
+        outs = []
+        start = 0
+        for bi in range(b):
+            n = int(this_np[bi])
+            sl = slice(start, start + n)
+            q, k, v = tok[sl, 0], tok[sl, 1], tok[sl, 2]   # [n, H, hd]
+            if rope_emb is not None:
+                qb, kb = _rope_decode(
+                    jnp.swapaxes(q, 0, 1), jnp.swapaxes(k, 0, 1),
+                    _raw(rope_emb), use_neox_style)
+                q, k = jnp.swapaxes(qb, 0, 1), jnp.swapaxes(kb, 0, 1)
+            for t in range(n):
+                kc, vc = write_token(kc, vc, bi, t, k[t], v[t])
+            scores = jnp.einsum("qhd,khd->hqk", q * hd ** -0.5, k)
+            cm = jnp.tril(jnp.ones((n, n), bool))
+            neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+            scores = jnp.where(cm[None], scores, neg)
+            if mask is not None:
+                mm = _raw(mask)[bi, 0, :n, :n]
+                scores = scores + mm[None].astype(scores.dtype)
+            probs = jax.nn.softmax(scores, axis=-1)
+            outs.append(jnp.einsum("hqk,khd->qhd", probs, v)
+                        .reshape(n, nh * hd))
+            start += n
+        fmha = jnp.concatenate(outs, axis=0)
+    return (Tensor(fmha), Tensor(qkv_v), Tensor(kc), Tensor(vc))
+
+
+# -- MoE three-phase pipeline (reference: fused_moe.py:131/248/336) --------
+
+def moe_dispatch(x, gating_output, moe_topk, group_moe=False,
+                 topk_only_mode=False):
+    """Route tokens to their top-k experts (reference: fused_moe.py:131).
+    Returns (permute_input [T*k, d] expert-major, token_nums_per_expert
+    [E], permute_indices_per_token [T, k] (row in permute_input),
+    expert_scales_float [T, k, 1, 1], top_k_indices [T, k])."""
+    xv = _raw(x)
+    gate = _raw(gating_output).astype(jnp.float32)
+    t, d = xv.shape
+    e = gate.shape[-1]
+    probs = gate if topk_only_mode else jax.nn.softmax(gate, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, moe_topk)
+    flat_expert = top_i.reshape(-1)                  # [T*k]
+    order = jnp.argsort(flat_expert, stable=True)    # expert-major
+    token_of_row = order // moe_topk
+    permute_input = xv[token_of_row]
+    token_nums = jnp.bincount(flat_expert, length=e)
+    inv = jnp.argsort(order)                         # (t,k) -> row
+    return (Tensor(permute_input), Tensor(token_nums.astype(jnp.int64)),
+            Tensor(inv.reshape(t, moe_topk).astype(jnp.int32)),
+            Tensor(top_p.reshape(t, moe_topk, 1, 1)),
+            Tensor(top_i.astype(jnp.int32)))
+
+
+def moe_ffn(permute_input, token_nums_per_expert, ffn1_weight, ffn2_weight,
+            ffn1_bias=None, ffn1_scale=None, ffn2_scale=None,
+            quant_method="None"):
+    """Expert FFN over dispatched tokens (reference: fused_moe.py:248):
+    rows are expert-major; expert e processes rows
+    [cum[e], cum[e+1]). Paired activation (silu(u) * g) as in
+    fused_moe."""
+    if str(quant_method) != "None":
+        raise NotImplementedError("moe_ffn: quant_method unsupported "
+                                  "(reference: 'Currently not supported')")
+    rows = _raw(permute_input)
+    nums = _raw(token_nums_per_expert).astype(jnp.int32)
+    w1 = _raw(ffn1_weight)
+    w2 = _raw(ffn2_weight)
+    b1 = _raw(ffn1_bias)
+    e = w1.shape[0]
+    cum = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(nums)])
+    row_ids = jnp.arange(rows.shape[0])
+    # expert of each row from the segment boundaries
+    row_expert = jnp.searchsorted(cum[1:], row_ids, side="right")
+    out = jnp.zeros_like(rows)
+    dff = w2.shape[1]
+    for ei in range(e):
+        h = rows @ w1[ei]
+        if b1 is not None:
+            h = h + b1[ei].reshape(-1)
+        u, g = h[:, :dff], h[:, dff:]
+        h = jax.nn.silu(u) * g
+        h = h @ w2[ei]
+        out = jnp.where((row_expert == ei)[:, None], h, out)
+    return Tensor(out)
+
+
+def moe_reduce(ffn_out, expert_scales_float, permute_indices_per_token,
+               top_k_indices, ffn2_bias=None, norm_topk_prob=False,
+               routed_scaling_factor=1.0):
+    """Combine expert outputs back to token order (reference:
+    fused_moe.py:336)."""
+    rows = _raw(ffn_out)
+    scales = _raw(expert_scales_float)            # [T, k, 1, 1]
+    idx = _raw(permute_indices_per_token).astype(jnp.int32)  # [T, k]
+    top_i = _raw(top_k_indices).astype(jnp.int32)
+    b2 = _raw(ffn2_bias)
+    t, k = idx.shape
+    sc = scales.reshape(t, k)
+    if norm_topk_prob:
+        sc = sc / jnp.maximum(jnp.sum(sc, axis=-1, keepdims=True), 1e-12)
+    gathered = rows[idx.reshape(-1)].reshape(t, k, -1)
+    if b2 is not None:
+        gathered = gathered + b2[top_i.reshape(-1)].reshape(t, k, -1)
+    out = jnp.sum(gathered * sc[:, :, None], axis=1)
+    return Tensor(out * float(routed_scaling_factor))
+
+
+__all__ = ["blha_get_max_len", "masked_multihead_attention",
+           "block_multihead_attention", "moe_dispatch", "moe_ffn",
+           "moe_reduce"]

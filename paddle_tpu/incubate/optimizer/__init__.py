@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ...core.tensor import Tensor
 
 
 class LookAhead:
@@ -144,6 +144,9 @@ class ModelAverage:
 
 
 # reference exports LBFGS from paddle.incubate.optimizer too
-from ..optimizer.lbfgs import LBFGS  # noqa: F401,E402
+from ...optimizer.lbfgs import LBFGS  # noqa: F401,E402
 
-__all__ = ["LookAhead", "ModelAverage", "LBFGS"]
+__all__ = ["LookAhead", "ModelAverage", "LBFGS", "functional"]
+
+
+from . import functional  # noqa: E402,F401

@@ -101,6 +101,16 @@ class Predictor:
         self._outputs = []
         self._profile = config._enable_profile
 
+    def clone(self):
+        """Share the loaded artifact in a new Predictor shell (reference:
+        AnalysisPredictor::Clone — same program, fresh IO handles)."""
+        p = Predictor.__new__(Predictor)
+        p._layer = self._layer
+        p._inputs = [PredictorTensor(t.name) for t in self._inputs]
+        p._outputs = []
+        p._profile = self._profile
+        return p
+
     def get_input_names(self):
         return [t.name for t in self._inputs]
 
@@ -285,3 +295,108 @@ def create_predictor(config: Config) -> Predictor:
 
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
            "ServingSession"]
+
+
+# -- enums + pool + version helpers (reference: paddle/fluid/inference/
+#    api/paddle_inference_api.h enums; python/paddle/inference/__init__.py)
+
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    FLOAT32 = 0
+    FLOAT16 = 1
+    BFLOAT16 = 2
+    INT8 = 3
+    INT32 = 4
+    INT64 = 5
+    UINT8 = 6
+    BOOL = 7
+
+
+class PlaceType(_enum.Enum):
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType(_enum.Enum):
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+def get_version():
+    """reference: inference.get_version — the framework version string."""
+    from ..version import full_version
+    return f"paddle_tpu {full_version}"
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.BFLOAT16: 2,
+             DataType.INT8: 1, DataType.INT32: 4, DataType.INT64: 8,
+             DataType.UINT8: 1, DataType.BOOL: 1}
+    return sizes[dtype if isinstance(dtype, DataType) else DataType[dtype]]
+
+
+def get_trt_compile_version():
+    """TensorRT is CUDA-tier (sanctioned descope); report absence the
+    reference way: a zero version triple."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """reference: maps a fluid op name to its phi kernel name via
+    op_compat.yaml; the registry here IS keyed by the public name."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference/convert_to_mixed_precision — offline weight
+    cast of a saved inference artifact. The jit artifact stores dtypes in
+    the StableHLO program itself, so the conversion re-exports through
+    paddle.amp at load time; converting a serialized artifact offline is
+    not supported — raise with the supported route."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision: re-export the model under "
+        "paddle.amp.auto_cast (the jit artifact embeds dtypes); offline "
+        "artifact rewriting is not supported on this stack")
+
+
+class PredictorPool:
+    """reference: python/paddle/inference/wrapper.py PredictorPool — n
+    predictors over one config for multi-threaded serving."""
+
+    def __init__(self, config, size=1):
+        self._main = create_predictor(config)
+        # clone() shares the loaded artifact; compiled executables are
+        # shared via the jit cache
+        self._preds = [self._main] + [self._main.clone()
+                                      for _ in range(max(0, size - 1))]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+class XpuConfig:
+    """Vendor-XPU inference config (sanctioned descope): accepted for
+    config-file parity; attaching to a Config raises."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+__all__ += ["DataType", "PlaceType", "PrecisionType", "get_version",
+            "get_num_bytes_of_data_type", "get_trt_compile_version",
+            "get_trt_runtime_version", "convert_to_mixed_precision",
+            "PredictorPool", "XpuConfig"]

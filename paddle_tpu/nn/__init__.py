@@ -54,3 +54,4 @@ from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 
 from ..optimizer.clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401,E402
+from . import quant  # noqa: E402,F401

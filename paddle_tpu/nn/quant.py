@@ -1,0 +1,26 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant/__init__.py):
+the weight-only/llm.int8 functional surface + the Stub marker layer."""
+from __future__ import annotations
+
+from .layer.layers import Layer
+from ..incubate.nn.functional import (  # noqa: F401
+    weight_only_linear, llm_int8_linear, weight_quantize,
+    weight_dequantize,
+)
+
+
+class Stub(Layer):
+    """Observer placement marker (reference: nn/quant/stub.py Stub):
+    a no-op layer the quantizer replaces with the configured observer
+    when preparing a model for QAT."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]

@@ -315,3 +315,95 @@ def quantize_for_inference(model, mode="weight_only", inplace=False):
 
 
 __all__ += ["Int8Linear", "quantize_for_inference", "quantize_to_int8"]
+
+
+# -- reference namespace layout: observers/quanters submodules + factory --
+
+class BaseQuanter(Layer):
+    """reference: python/paddle/quantization/base_quanter.py — the
+    abstract trained-quantizer Layer (scales()/quant_axis/bit_length)."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return getattr(self, "quant_bits", 8)
+
+
+class _QuanterFactory:
+    """What ``quanter(...)`` returns and QuantConfig accepts: a deferred
+    quanter constructor (reference: python/paddle/quantization/factory.py
+    ObserverFactory/QuanterFactory)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return _QuanterFactory(self._cls, *args, **kwargs)
+
+
+def quanter(name):
+    """Class decorator registering a custom quanter under ``name`` and
+    wrapping it in a factory (reference: factory.py quanter)."""
+    def wrap(cls):
+        globals()[name] = _QuanterFactory(cls)
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return wrap
+
+
+_QUANTER_REGISTRY = {}
+
+
+class GroupWiseWeightObserver(BaseObserver):
+    """Per-group abs-max weight observer (reference:
+    quantization/observers/groupwise.py — group_size channels share one
+    scale along axis 0)."""
+
+    def __init__(self, quant_bits=8, group_size=128):
+        super().__init__(quant_bits)
+        self.group_size = group_size
+
+    def _observe(self, arr):
+        a = np.abs(arr.reshape(arr.shape[0], -1))
+        g = self.group_size
+        pads = (-a.shape[0]) % g
+        if pads:
+            a = np.concatenate([a, np.zeros((pads, a.shape[1]))], 0)
+        m = a.reshape(-1, g, a.shape[1]).max(axis=(1, 2))
+        self._scale = m if self._scale is None else np.maximum(
+            np.asarray(self._scale), m)
+
+    def scales(self):
+        return Tensor(jnp.asarray(np.asarray(
+            self._scale if self._scale is not None else [1.0]),
+            jnp.float32))
+
+
+class _Namespace:
+    def __init__(self, **items):
+        self.__dict__.update(items)
+
+
+observers = _Namespace(
+    AbsmaxObserver=AbsmaxObserver,
+    EMAObserver=EMAObserver,
+    GroupWiseWeightObserver=GroupWiseWeightObserver,
+)
+quanters = _Namespace(
+    FakeQuanterWithAbsMaxObserver=FakeQuanterWithAbsMax,
+)
+
+__all__ += ["BaseQuanter", "quanter", "GroupWiseWeightObserver",
+            "observers", "quanters"]

@@ -281,3 +281,46 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
 
 __all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
            "attention"]
+
+
+# -- value-space activations (reference: sparse/nn/functional/activation.py)
+
+def relu(x, name=None):
+    from . import relu as _relu
+    return _relu(x)
+
+
+def relu6(x, name=None):
+    from . import relu6 as _relu6
+    return _relu6(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from . import leaky_relu as _lrelu
+    return _lrelu(x, negative_slope)
+
+
+def softmax(x, axis=-1, name=None):
+    from . import softmax as _softmax
+    return _softmax(x, axis)
+
+
+def subm_conv2d_igemm(x, weight, bias=None, stride=1, padding=0,
+                      dilation=1, groups=1, data_format="NHWC", key=None,
+                      name=None):
+    """Implicit-GEMM submanifold conv (reference:
+    sparse/nn/functional/conv.py subm_conv2d_igemm — a kernel-choice
+    variant of subm_conv2d; on this stack the gather+matmul rulebook
+    path IS the implicit GEMM, so both names run the same lowering)."""
+    return subm_conv2d(x, weight, bias=bias, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       data_format=data_format)
+
+
+def subm_conv3d_igemm(x, weight, bias=None, stride=1, padding=0,
+                      dilation=1, groups=1, data_format="NDHWC", key=None,
+                      name=None):
+    """See subm_conv2d_igemm."""
+    return subm_conv3d(x, weight, bias=bias, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       data_format=data_format)

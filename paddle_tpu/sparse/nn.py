@@ -202,3 +202,28 @@ class MaxPool3D:
 
 
 __all__ += ["Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BatchNorm (reference:
+    sparse/nn/layer/norm.py SyncBatchNorm). Under GSPMD the batch
+    statistics of a sharded values tensor are computed globally by the
+    compiler-inserted collectives — the dedicated NCCL sync path of the
+    reference collapses into BatchNorm on this stack."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively convert sparse BatchNorm layers (reference API)."""
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            out = SyncBatchNorm.__new__(SyncBatchNorm)
+            out.__dict__.update(layer.__dict__)
+            return out
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+from . import functional  # noqa: E402,F401  (sparse.nn.functional)
+
+__all__ += ["SyncBatchNorm", "functional"]

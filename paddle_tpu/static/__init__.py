@@ -41,9 +41,9 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return layer
 
 
-# ``paddle.static.nn`` namespace: the data-dependent control-flow ops
-# (reference: python/paddle/static/nn/control_flow.py)
-from . import control_flow as nn  # noqa: E402,F401
+# ``paddle.static.nn`` namespace: control-flow ops + the legacy layer
+# builders (reference: python/paddle/static/nn/)
+from . import nn  # noqa: E402,F401
 
 # Program / Executor world (reference: python/paddle/static/__init__.py)
 from .program import (  # noqa: E402,F401
@@ -55,8 +55,16 @@ from .program import (  # noqa: E402,F401
 from .extras import (  # noqa: E402,F401
     BuildStrategy, CompiledProgram, ExponentialMovingAverage,
     WeightNormParamAttr, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+    create_global_var, device_guard, accuracy, auc, cuda_places,
+    xpu_places, set_ipu_shard, ctr_metric_bundle,
+)
+from .serialization import (  # noqa: E402,F401
+    serialize_program, serialize_persistables, deserialize_program,
+    deserialize_persistables, save_to_file, load_from_file,
+    normalize_program, load_program_state, set_program_state,
 )
 from ..nn.layer.layers import ParamAttr  # noqa: E402,F401
+from ..framework.infra import create_parameter  # noqa: E402,F401
 
 __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "nn", "Program", "Executor", "Variable", "program_guard",
@@ -65,4 +73,10 @@ __all__ = ["InputSpec", "save_inference_model", "load_inference_model",
            "load", "append_backward", "gradients", "py_func", "name_scope",
            "Print", "BuildStrategy", "CompiledProgram",
            "ExponentialMovingAverage", "WeightNormParamAttr", "ParamAttr",
-           "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard"]
+           "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+           "create_global_var", "device_guard", "accuracy", "auc",
+           "cuda_places", "xpu_places", "set_ipu_shard",
+           "ctr_metric_bundle", "create_parameter", "serialize_program",
+           "serialize_persistables", "deserialize_program",
+           "deserialize_persistables", "save_to_file", "load_from_file",
+           "normalize_program", "load_program_state", "set_program_state"]

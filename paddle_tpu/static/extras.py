@@ -195,3 +195,103 @@ def ipu_shard_guard(index=-1, stage=-1):
     raise RuntimeError(
         "IPU backend is not available on this stack (TPU build; "
         "sanctioned vendor descope — SURVEY.md §2.4)")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: python/paddle/tensor/creation.py create_global_var —
+    a persistable filled tensor living outside any program."""
+    from ..tensor.creation import full
+    t = full(shape, value, dtype=dtype)
+    t.persistable = persistable
+    if name:
+        t.name = name
+    return t
+
+
+class device_guard:
+    """reference: base/framework.py device_guard — op device placement
+    context. PJRT owns placement on this stack; the context records the
+    request for API parity and is a no-op."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Batch top-k accuracy op (reference: static/nn/metric.py:36)."""
+    import jax.numpy as jnp
+    from ..core.dispatch import op_call
+
+    def _body(x, lbl, *, k):
+        topk_idx = jnp.argsort(-x, axis=-1)[:, :k]
+        hit = jnp.any(topk_idx == lbl.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return op_call("accuracy", _body, input, label, k=int(k))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC op (reference: static/nn/metric.py:101). Returns
+    (auc_out, batch_auc_out, [stat tensors]) like the reference; the
+    stats are the histogram buckets this batch contributes."""
+    import jax.numpy as jnp
+    from ..core.dispatch import op_call
+
+    def _body(x, lbl, *, nt):
+        pos_prob = x[:, 1] if x.ndim == 2 and x.shape[1] == 2 else \
+            x.reshape(x.shape[0], -1)[:, -1]
+        bucket = jnp.clip((pos_prob * nt).astype(jnp.int32), 0, nt)
+        lblf = lbl.reshape(-1)
+        pos = jnp.zeros(nt + 1).at[bucket].add(lblf.astype(jnp.float32))
+        neg = jnp.zeros(nt + 1).at[bucket].add(1.0 - lblf.astype(
+            jnp.float32))
+        # trapezoid over descending threshold
+        tp = jnp.cumsum(pos[::-1])
+        fp = jnp.cumsum(neg[::-1])
+        tot_pos = tp[-1]
+        tot_neg = fp[-1]
+        tpr = tp / jnp.maximum(tot_pos, 1.0)
+        fpr = fp / jnp.maximum(tot_neg, 1.0)
+        a = jnp.trapezoid(tpr, fpr)
+        return a, pos, neg
+
+    a, pos, neg = op_call("auc", _body, input, label,
+                          nt=int(num_thresholds))
+    return a, a, [pos, neg]
+
+
+def cuda_places(device_ids=None):
+    """reference: base/framework.py cuda_places. This stack's
+    accelerator is the TPU — returns the accelerator places so ported
+    device-list code sees the real devices (CUDAPlace does not exist
+    here)."""
+    from ..core.place import _accelerators, _cpus, Place
+    devs = _accelerators() or _cpus()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return [Place("cpu" if d.platform == "cpu" else "tpu", d.id)
+            for d in devs]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise RuntimeError(
+        "IPU backend is not available on this stack (TPU build; "
+        "sanctioned vendor descope — SURVEY.md §2.4)")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    raise NotImplementedError(
+        "ctr_metric_bundle is parameter-server-tier (sanctioned descope, "
+        "SURVEY.md §7); compute CTR metrics with paddle.metric.Auc")

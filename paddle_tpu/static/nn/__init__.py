@@ -1,0 +1,435 @@
+"""paddle.static.nn — the static-graph layer builders.
+
+Reference: python/paddle/static/nn/ (common.py builders, control_flow,
+sequence_lod, static_pylayer). Each builder constructs its parameters at
+graph-build time through the SAME nn.Layer machinery (the reference's
+LayerHelper role) and applies the layer — under ``paddle.enable_static``
+the compute records into the current Program; in dygraph it executes
+directly. LoD ``sequence_*`` ops are the legacy-LoD tier descoped in
+OPS_INVENTORY.md (padded-dense equivalents live in paddle.nn)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..control_flow import (  # noqa: F401
+    while_loop, cond, case, switch_case,
+)
+from ..program import py_func  # noqa: F401
+from ...core.tensor import Tensor
+from ...nn.layer.layers import ParamAttr
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    from ... import nn
+    return getattr(nn.functional, act)(out)
+
+
+def _prod(xs):
+    p = 1
+    for s in xs:
+        p *= int(s)
+    return p
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py:48 — per-input weight, summed, one
+    shared bias."""
+    from ... import nn
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = []
+    bias = None
+    for i, xi in enumerate(xs):
+        flat_in = _prod(xi.shape[num_flatten_dims:])
+        lin = nn.Linear(flat_in, size,
+                        weight_attr=weight_attr,
+                        bias_attr=False)
+        if len(xi.shape) == num_flatten_dims + 1:
+            flat = xi                      # already [*, flat_in]
+        else:
+            # dynamic leading dims (None -> 0 in a Variable) become -1 so
+            # the recorded reshape replays at any batch size
+            lead = [int(s) if int(s) > 0 else -1
+                    for s in xi.shape[:num_flatten_dims]]
+            if lead.count(-1) > 1:
+                lead = [-1] + [1] * (len(lead) - 1)
+            flat = xi.reshape(lead + [flat_in])
+        outs.append(lin(flat))
+    out = outs[0]
+    for o in outs[1:]:
+        out = out + o
+    if bias_attr is not False:
+        from ...nn.layer.layers import Parameter
+        import jax.numpy as jnp
+        b = Parameter(jnp.zeros((size,), dtype=out._data.dtype))
+        out = out + b
+    return _act(out, activation)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: static/nn/common.py:3689."""
+    from ... import nn
+    emb = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                       weight_attr=param_attr)
+    return emb(input)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None,
+               do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    """reference: static/nn/common.py:2613."""
+    from ... import nn
+    c_axis = 1 if data_layout == "NCHW" else -1
+    bn = nn.BatchNorm(int(input.shape[c_axis]), momentum=momentum,
+                      epsilon=epsilon, weight_attr=param_attr,
+                      bias_attr=bias_attr,
+                      data_format="NCHW" if data_layout == "NCHW"
+                      else "NHWC",
+                      use_global_stats=use_global_stats or None)
+    if is_test:
+        bn.eval()
+    return _act(bn(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference: static/nn/common.py:3553 — normalizes over
+    dims[begin_norm_axis:]."""
+    from ... import nn
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    ln = nn.LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    return _act(ln(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """reference: static/nn/common.py:668."""
+    from ... import nn
+    c_axis = 1 if data_layout == "NCHW" else -1
+    gn = nn.GroupNorm(groups, int(input.shape[c_axis]), epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format="NCHW" if data_layout == "NCHW"
+                      else "NHWC")
+    return _act(gn(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: static/nn/common.py:272."""
+    from ... import nn
+    cls = {3: nn.InstanceNorm1D, 4: nn.InstanceNorm2D,
+           5: nn.InstanceNorm3D}[len(input.shape)]
+    inorm = cls(int(input.shape[1]), epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr)
+    return inorm(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: static/nn/common.py:461 — normalization by accumulated
+    batch statistics (batch_size/batch_sum/batch_square_sum accumulators,
+    the CTR-model normalizer). The accumulators initialize to the
+    reference defaults (count 1e4, zero sum, 1e4 square-sum => unit
+    scale) and update every training call."""
+    import jax.numpy as jnp
+    from ...nn.layer.layers import Parameter
+    c = int(input.shape[-1 if data_layout == "NHWC" else 1])
+    stat_shape = (c,)
+    batch_size = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
+    batch_sum = Parameter(jnp.zeros(stat_shape, jnp.float32))
+    batch_sq = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
+    for p in (batch_size, batch_sum, batch_sq):
+        p.stop_gradient = True
+    mean = batch_sum / batch_size
+    scale = (batch_size / batch_sq) ** 0.5
+    out = (input - mean) * scale
+    if enable_scale_and_shift:
+        w = Parameter(jnp.ones(stat_shape, jnp.float32))
+        b = Parameter(jnp.zeros(stat_shape, jnp.float32))
+        out = out * w + b
+    return _act(out, act)
+
+
+def _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
+             groups, param_attr, bias_attr, act, data_format, ndim,
+             transpose=False, output_size=None):
+    from ... import nn
+    chan_axis = 1 if data_format.startswith("NC") else -1
+    in_ch = int(input.shape[chan_axis])
+    key = ("Conv%dDTranspose" if transpose else "Conv%dD") % ndim
+    cls = getattr(nn, key)
+    kwargs = dict(stride=stride, padding=padding, dilation=dilation,
+                  groups=groups or 1, weight_attr=param_attr,
+                  bias_attr=bias_attr, data_format=data_format)
+    layer = cls(in_ch, num_filters, filter_size, **kwargs)
+    out = layer(input) if not transpose or output_size is None \
+        else layer(input, output_size=output_size)
+    return _act(out, act)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    """reference: static/nn/common.py:780."""
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    data_format, 2)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    """reference: static/nn/common.py:1088."""
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    data_format, 3)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCHW"):
+    """reference: static/nn/common.py:1377."""
+    assert filter_size is not None or output_size is not None
+    if filter_size is None:
+        # infer square kernel from output_size (reference rule)
+        hw = 2 if data_format == "NCHW" else 1
+        i = int(input.shape[hw])
+        o = output_size[0] if isinstance(output_size, (list, tuple)) \
+            else int(output_size)
+        s = stride if isinstance(stride, int) else stride[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        d = dilation if isinstance(dilation, int) else dilation[0]
+        filter_size = (o - (i - 1) * s + 2 * p - 1) // d + 1
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    data_format, 2, transpose=True, output_size=output_size)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """reference: static/nn/common.py:1753."""
+    assert filter_size is not None, \
+        "conv3d_transpose: pass filter_size explicitly"
+    return _conv_nd(input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, act,
+                    data_format, 3, transpose=True, output_size=output_size)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  modulated=True, name=None):
+    """reference: static/nn/common.py:2362 — over vision.ops
+    deform_conv2d with build-time-created weight/bias."""
+    import jax.numpy as jnp
+    from ...nn.layer.layers import Parameter
+    from ...vision.ops import deform_conv2d as _dc
+    in_ch = int(input.shape[1])
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    fan_in = in_ch * k[0] * k[1]
+    w = Parameter(jnp.asarray(
+        np.random.default_rng(0).normal(
+            0, (2.0 / fan_in) ** 0.5,
+            (num_filters, in_ch // groups, k[0], k[1])).astype(np.float32)))
+    b = None if bias_attr is False else Parameter(
+        jnp.zeros((num_filters,), jnp.float32))
+    return _dc(input, offset, w, bias=b,
+               mask=mask if modulated else None, stride=stride,
+               padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: static/nn/common.py:2538 — nn.Bilinear."""
+    from ... import nn
+    layer = nn.Bilinear(int(x.shape[1]), int(y.shape[1]), size,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    """reference: static/nn/common.py:2937 — alpha shape by mode
+    (all/channel/element)."""
+    import jax.numpy as jnp
+    from ...nn.layer.layers import Parameter
+    from ...nn.functional import prelu as fprelu
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        c = int(x.shape[1 if data_format == "NCHW" else -1])
+        shape = (c,)
+    elif mode == "element":
+        shape = tuple(int(s) for s in x.shape[1:])
+    else:
+        raise ValueError(f"prelu mode {mode!r}")
+    alpha = Parameter(jnp.full(shape, 0.25, jnp.float32))
+    return fprelu(x, alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: static/nn/common.py:3331 — lookahead row convolution:
+    out[t] = sum_{i=0..k} in[t+i] * W[i] (elementwise over features)."""
+    import jax.numpy as jnp
+    from ...nn.layer.layers import Parameter
+    from ...core.dispatch import eager_apply
+    k = int(future_context_size)
+    d = int(input.shape[-1])
+    w = Parameter(jnp.asarray(np.random.default_rng(0).normal(
+        0, d ** -0.5, (k + 1, d)).astype(np.float32)))
+
+    def body(a, wv):
+        pad = [(0, 0)] * a.ndim
+        pad[-2] = (0, k)
+        ap = jnp.pad(a, pad)
+        segs = [ap[..., i:i + a.shape[-2], :] * wv[i] for i in range(k + 1)]
+        return sum(segs)
+
+    out = eager_apply("row_conv", body, (input, w), {})
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: static/nn/common.py:3415 — functional weight
+    normalization by the top singular value (fresh u/v per call here;
+    the stateful form is nn.SpectralNorm / nn.utils.spectral_norm)."""
+    import jax.numpy as jnp
+    from ...nn.functional import spectral_norm as fsn
+    h = int(weight.shape[dim])
+    w = _prod(weight.shape) // h
+    rng = np.random.default_rng(0)
+    u = Tensor(jnp.asarray(rng.normal(size=(h,)).astype(np.float32)))
+    v = Tensor(jnp.asarray(rng.normal(size=(w,)).astype(np.float32)))
+    return fsn(weight, u, v, dim=dim, power_iters=power_iters, eps=eps)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference: static/nn/loss.py:33 — noise-contrastive estimation:
+    binary logistic loss on the true class plus ``num_neg_samples``
+    sampled noise classes (uniform/custom sampler)."""
+    import jax
+    import jax.numpy as jnp
+    from ...nn.layer.layers import Parameter
+    from ...core.dispatch import eager_apply
+    from ...core import random as _random
+    dim = int(input.shape[-1])
+    n_neg = int(num_neg_samples or 10)
+    w = Parameter(jnp.asarray(np.random.default_rng(seed or 0).normal(
+        0, dim ** -0.5, (num_total_classes, dim)).astype(np.float32)))
+    b = None if bias_attr is False else Parameter(
+        jnp.zeros((num_total_classes,), jnp.float32))
+    key = jax.random.PRNGKey(seed) if seed else _random.next_key()
+    if custom_dist is not None:
+        probs = jnp.asarray(np.asarray(custom_dist, np.float32))
+        neg = jax.random.choice(key, num_total_classes, (n_neg,), p=probs)
+    else:
+        neg = jax.random.randint(key, (n_neg,), 0, num_total_classes)
+
+    def body(x, lbl, wv, bv, negv):
+        lbl = lbl.reshape(-1)
+        pos_w = wv[lbl]                       # [B, D]
+        s_pos = jnp.sum(x * pos_w, -1)
+        neg_w = wv[negv]                      # [K, D]
+        s_neg = x @ neg_w.T                   # [B, K]
+        if bv is not None:
+            s_pos = s_pos + bv[lbl]
+            s_neg = s_neg + bv[negv][None, :]
+        loss = -jax.nn.log_sigmoid(s_pos) \
+               - jnp.sum(jax.nn.log_sigmoid(-s_neg), -1)
+        return loss.reshape(-1, 1)
+
+    args = (input, label, w, b, Tensor(neg))
+    return eager_apply("nce", body, args, {})
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """reference: static/nn/static_pylayer.py:281 — custom forward with
+    an optional custom backward, over the eager PyLayer machinery."""
+    from ...autograd import PyLayer
+    if backward_fn is None:
+        outs = forward_fn(*inputs)
+        return outs
+
+    class _P(PyLayer):
+        @staticmethod
+        def forward(ctx, *xs):
+            return forward_fn(*xs)
+
+        @staticmethod
+        def backward(ctx, *gs):
+            return backward_fn(*gs)
+
+    return _P.apply(*inputs)
+
+
+def sparse_embedding(*args, **kwargs):
+    """reference: static/nn/common.py:3840 — the parameter-server
+    distributed lookup table. PS mode is a sanctioned descope
+    (SURVEY.md §7); use paddle.nn.Embedding (optionally sharded with
+    VocabParallelEmbedding)."""
+    raise NotImplementedError(
+        "sparse_embedding requires parameter-server mode — sanctioned "
+        "descope (SURVEY.md §7); use nn.Embedding / "
+        "VocabParallelEmbedding")
+
+
+def _sequence_stub(opname):
+    def stub(*args, **kwargs):
+        raise NotImplementedError(
+            f"{opname}: legacy LoD sequence ops are descoped "
+            "(OPS_INVENTORY.md, legacy-LoD tier); use the padded-dense "
+            "equivalents in paddle.nn (Conv1D, softmax with masks, "
+            "pooling over masks)")
+    stub.__name__ = opname
+    return stub
+
+
+sequence_conv = _sequence_stub("sequence_conv")
+sequence_softmax = _sequence_stub("sequence_softmax")
+sequence_pool = _sequence_stub("sequence_pool")
+sequence_concat = _sequence_stub("sequence_concat")
+sequence_first_step = _sequence_stub("sequence_first_step")
+sequence_last_step = _sequence_stub("sequence_last_step")
+sequence_slice = _sequence_stub("sequence_slice")
+sequence_expand = _sequence_stub("sequence_expand")
+sequence_expand_as = _sequence_stub("sequence_expand_as")
+sequence_pad = _sequence_stub("sequence_pad")
+sequence_unpad = _sequence_stub("sequence_unpad")
+sequence_reshape = _sequence_stub("sequence_reshape")
+sequence_scatter = _sequence_stub("sequence_scatter")
+sequence_enumerate = _sequence_stub("sequence_enumerate")
+sequence_reverse = _sequence_stub("sequence_reverse")
+
+
+__all__ = [
+    "while_loop", "cond", "case", "switch_case", "py_func",
+    "fc", "embedding", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "data_norm", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "deform_conv2d", "bilinear_tensor_product",
+    "prelu", "row_conv", "spectral_norm", "nce", "static_pylayer",
+    "sparse_embedding", "sequence_conv", "sequence_softmax",
+    "sequence_pool", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+    "sequence_reverse",
+]

@@ -477,8 +477,12 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     params = parameter_list if parameter_list is not None \
         else prog.parameters()
     if no_grad_set:
-        drop = {id(p) for p in no_grad_set}
-        params = [p for p in params if id(p) not in drop]
+        # the reference accepts Parameter objects OR their name strings
+        drop_ids = {id(p) for p in no_grad_set if not isinstance(p, str)}
+        drop_names = {p for p in no_grad_set if isinstance(p, str)}
+        params = [p for p in params
+                  if id(p) not in drop_ids
+                  and getattr(p, "name", None) not in drop_names]
     pairs = []
     for i, p in enumerate(params):
         name = getattr(p, "name", None) or f"param_{i}"

@@ -1,0 +1,206 @@
+"""Static Program serialization (reference: python/paddle/static/io.py —
+serialize_program, serialize_persistables, normalize_program,
+save_to_file/load_from_file, load/set_program_state).
+
+The reference serializes ProgramDesc protobufs. Here the recorded
+Program is lowered ONCE through jax.export: the replay (the exact node
+list Executor.run executes) is traced into a StableHLO artifact with
+the parameters captured as constants — the same portable-XLA form the
+jit artifacts use (jit/save_load.py). Persistables serialize separately
+as a name->array blob so programs and weights can move independently.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+import jax
+from jax import export as jax_export
+
+from ..core.tensor import Tensor
+from ..core import dispatch as _dispatch
+from .program import Program, Variable, current_program, _state
+
+
+def _feed_fetch(program, feed_vars, fetch_vars):
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    names = []
+    for v in feed_vars:
+        matches = [n for n, fv in program._feeds.items() if fv is v]
+        names.append(matches[0] if matches else getattr(v, "name", None))
+    return feed_vars, list(fetch_vars), names
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune the program to the nodes that (transitively) produce
+    ``fetch_vars`` (reference: static/io.py normalize_program — dead-op
+    elimination before serialization)."""
+    feed_vars, fetch_vars, _ = _feed_fetch(program, feed_vars, fetch_vars)
+    needed = {id(v) for v in fetch_vars}
+    kept = []
+    for node in reversed(program._nodes):
+        if any(id(o) in needed for o in node.outs):
+            kept.append(node)
+            flat = jax.tree.leaves((node.args, node.kwargs),
+                                   is_leaf=lambda x: isinstance(x, Tensor))
+            for t in flat:
+                if isinstance(t, Variable):
+                    needed.add(id(t))
+    out = Program()
+    out._nodes = list(reversed(kept))
+    feed_ids = {id(f) for f in feed_vars}
+    out._feeds = {n: v for n, v in program._feeds.items()
+                  if id(v) in needed or id(v) in feed_ids}
+    out.random_seed = program.random_seed
+    return out
+
+
+def _replay_pure(program, feed_vars, fetch_vars):
+    """The Executor.run node walk as a pure function of the feeds."""
+    def fn(*feeds):
+        from ..core.autograd import no_grad
+        env = {id(v): Tensor(arr) for v, arr in zip(feed_vars, feeds)}
+
+        def realize(x):
+            if isinstance(x, Variable):
+                return env[id(x)]
+            return x
+
+        was = _state.static_mode
+        _state.static_mode = False
+        try:
+            with no_grad():
+                for node in program._nodes:
+                    a, kw = jax.tree.map(
+                        realize, (node.args, node.kwargs),
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                    out = _dispatch.op_call(node.op_name, node.fn, *a, **kw)
+                    flat = jax.tree.leaves(
+                        out if isinstance(out, (list, tuple)) else [out],
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                    for var, val in zip(node.outs, flat):
+                        env[id(var)] = val
+        finally:
+            _state.static_mode = was
+        return tuple(env[id(f)]._data for f in fetch_vars)
+    return fn
+
+
+_SER_MAGIC = b"PTPU-STATIC-PROGRAM-v1\n"
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    """Program -> portable bytes (StableHLO via jax.export; parameters
+    baked as constants — the inference form, like the reference's pruned
+    ProgramDesc)."""
+    program = program or current_program()
+    program = normalize_program(program, feed_vars, fetch_vars)
+    feed_vars, fetch_vars, feed_names = _feed_fetch(program, feed_vars,
+                                                    fetch_vars)
+    scope = jax_export.SymbolicScope()
+    specs = []
+    for i, v in enumerate(feed_vars):
+        dims = ",".join(f"b{i}_{j}" if s == 0 else str(int(s))
+                        for j, s in enumerate(v._data.shape))
+        shape = jax_export.symbolic_shape(dims, scope=scope) if "b" in dims \
+            else v._data.shape
+        specs.append(jax.ShapeDtypeStruct(shape, v._data.dtype))
+    exp = jax_export.export(jax.jit(_replay_pure(program, feed_vars,
+                                                 fetch_vars)))(*specs)
+    blob = exp.serialize()
+    head = pickle.dumps({"feed_names": feed_names,
+                         "n_fetch": len(fetch_vars)})
+    return _SER_MAGIC + len(head).to_bytes(8, "little") + head + bytes(blob)
+
+
+class DeserializedProgram:
+    """Executable form of serialize_program bytes. Executor.run accepts
+    it: feeds are matched by the recorded feed names, fetch_list
+    positions index the recorded fetch tuple."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self.feed_names = feed_names
+        self.n_fetch = n_fetch
+
+    def run(self, feed):
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"DeserializedProgram: missing feeds {missing}")
+        arrays = [np.asarray(feed[n]) for n in self.feed_names]
+        return [np.asarray(x) for x in self._exported.call(*arrays)]
+
+
+def deserialize_program(data):
+    """bytes -> DeserializedProgram (reference: static/io.py
+    deserialize_program)."""
+    if not data.startswith(_SER_MAGIC):
+        raise ValueError("not a paddle_tpu serialized program")
+    off = len(_SER_MAGIC)
+    hlen = int.from_bytes(data[off:off + 8], "little")
+    head = pickle.loads(data[off + 8:off + 8 + hlen])
+    exported = jax_export.deserialize(bytearray(data[off + 8 + hlen:]))
+    return DeserializedProgram(exported, head["feed_names"],
+                               head["n_fetch"])
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """Parameters -> bytes (name -> ndarray blob)."""
+    program = program or current_program()
+    state = {name: np.asarray(p._data)
+             for name, p in program.state_dict().items()}
+    return pickle.dumps({"format": "paddle_tpu.persistables.v1",
+                         "state": state})
+
+
+def deserialize_persistables(program, data, executor=None):
+    blob = pickle.loads(data)
+    state = blob["state"] if isinstance(blob, dict) and "state" in blob \
+        else blob
+    set_program_state(program, state)
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static/io.py load_program_state — read a .pdparams blob
+    into a name->ndarray dict."""
+    from ..framework.io import load as fload
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    blob = fload(path)
+    state = blob.get("state_dict", blob) if isinstance(blob, dict) else blob
+    out = {}
+    for k, v in state.items():
+        out[k] = np.asarray(v._data) if isinstance(v, Tensor) \
+            else np.asarray(v)
+    if var_list is not None:
+        names = {getattr(v, "name", v) for v in var_list}
+        out = {k: v for k, v in out.items() if k in names}
+    return out
+
+
+def set_program_state(program, state_dict):
+    """reference: static/io.py set_program_state."""
+    import jax.numpy as jnp
+    params = program.state_dict()
+    for name, p in params.items():
+        if name in state_dict:
+            src = state_dict[name]
+            arr = src._data if isinstance(src, Tensor) else jnp.asarray(
+                np.asarray(src))
+            p._inplace_update(arr.astype(p._data.dtype))

@@ -1,0 +1,458 @@
+"""Namespace-tail parity: incubate.autograd/optimizer.functional,
+device.cuda/xpu, quantization observers/quanters, sparse.nn tail,
+inference enums/pool, fleet util/Role/data generators, rpc WorkerInfo,
+asp tail, audio backends/datasets/features.
+
+Reference files cited per test.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_incubate_autograd_classes():
+    """reference: python/paddle/incubate/autograd/__init__.py."""
+    import paddle_tpu.incubate.autograd as IA
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    J = IA.Jacobian(lambda v: v * v, x)
+    np.testing.assert_allclose(np.asarray(J[:, :].numpy()),
+                               np.diag([2.0, 4.0]), rtol=1e-5)
+    H = IA.Hessian(lambda v: (v * v).sum(), x)
+    np.testing.assert_allclose(np.asarray(H[:, :].numpy()),
+                               np.diag([2.0, 2.0]), rtol=1e-5)
+    IA.enable_prim()
+    assert IA.prim_enabled()
+    IA.disable_prim()
+    assert not IA.prim_enabled()
+    g = IA.grad((x * 3).sum(), x)
+    got = g[0] if isinstance(g, (list, tuple)) else g
+    np.testing.assert_allclose(got.numpy(), [3.0, 3.0])
+
+
+def test_minimize_bfgs_lbfgs_rosenbrock():
+    """reference: incubate/optimizer/functional/{bfgs,lbfgs}.py — both
+    converge on Rosenbrock from the classic start point."""
+    from paddle_tpu.incubate.optimizer.functional import (
+        minimize_bfgs, minimize_lbfgs)
+
+    def rosen(x):
+        return 100.0 * (x[1] - x[0] ** 2) ** 2 + (1.0 - x[0]) ** 2
+
+    x0 = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+    conv, calls, pos, val, grad, H = minimize_bfgs(rosen, x0, max_iters=100)
+    assert bool(conv.numpy())
+    np.testing.assert_allclose(pos.numpy(), [1.0, 1.0], atol=1e-2)
+    assert int(calls.numpy()) > 1
+    conv2, _, pos2, val2, _ = minimize_lbfgs(rosen, x0, max_iters=100,
+                                             history_size=10)
+    assert bool(conv2.numpy())
+    np.testing.assert_allclose(pos2.numpy(), [1.0, 1.0], atol=1e-2)
+    assert float(val2.numpy()) < 1e-6
+
+
+def test_device_cuda_xpu_namespaces():
+    """reference: python/paddle/device/cuda/__init__.py __all__."""
+    D = paddle.device
+    assert isinstance(D.cuda.get_device_name(), str)
+    assert D.cuda.get_device_capability() == (0, 0)
+    p = D.cuda.get_device_properties()
+    assert hasattr(p, "total_memory")
+    D.cuda.reset_max_memory_allocated()
+    D.cuda.reset_max_memory_reserved()
+    assert D.cuda.max_memory_reserved() >= 0
+    assert D.cuda.current_stream() is D.current_stream()
+    with D.cuda.stream_guard(D.Stream()):
+        pass
+    D.xpu.synchronize()
+    D.xpu.empty_cache()
+    assert D.xpu.device_count() == 0
+
+
+def test_quantization_namespaces_and_factory():
+    """reference: python/paddle/quantization/{observers,quanters}/."""
+    Q = paddle.quantization
+    assert Q.observers.AbsmaxObserver is Q.AbsmaxObserver
+    assert Q.quanters.FakeQuanterWithAbsMaxObserver is \
+        Q.FakeQuanterWithAbsMax
+
+    @Q.quanter("TestQuanter")
+    class TestQuanter(Q.BaseQuanter):
+        def __init__(self, bits=8):
+            super().__init__()
+            self.quant_bits = bits
+
+    assert Q._QUANTER_REGISTRY["TestQuanter"] is TestQuanter
+    o = Q.GroupWiseWeightObserver(group_size=2)
+    o(paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2)))
+    np.testing.assert_allclose(o.scales().numpy(), [3.0, 7.0])
+    b = TestQuanter()
+    assert b.bit_length() == 8 and b.quant_axis() == -1
+
+
+def test_sparse_nn_tail():
+    """reference: python/paddle/sparse/nn/ — SyncBatchNorm + functional
+    activations + igemm aliases."""
+    S = paddle.sparse
+    dense = paddle.to_tensor(np.array([[0., -1.], [2., 0.]], np.float32))
+    sp = S.to_sparse_coo(dense, 2)
+    r = S.nn.functional.relu(sp)
+    np.testing.assert_array_equal(r.values().numpy(), [0.0, 2.0])
+    np.testing.assert_array_equal(
+        S.nn.functional.relu6(sp).values().numpy(), [0.0, 2.0])
+    assert S.nn.functional.softmax(sp).values().numpy().shape == (2,)
+    lr = S.nn.functional.leaky_relu(sp, 0.1)
+    np.testing.assert_allclose(lr.values().numpy(), [-0.1, 2.0], rtol=1e-6)
+    bn = S.nn.BatchNorm(4)
+    conv = S.nn.SyncBatchNorm.convert_sync_batchnorm(bn)
+    assert isinstance(conv, S.nn.SyncBatchNorm)
+    assert S.nn.functional.subm_conv2d_igemm is not None
+
+
+def test_inference_enums_and_pool(tmp_path):
+    """reference: python/paddle/inference/__init__.py __all__."""
+    import paddle_tpu.inference as I
+    assert I.get_num_bytes_of_data_type(I.DataType.FLOAT32) == 4
+    assert I.get_num_bytes_of_data_type(I.DataType.INT8) == 1
+    assert I.get_trt_compile_version() == (0, 0, 0)
+    assert "paddle_tpu" in I.get_version()
+    assert I.PlaceType.CPU.value == 0 and I.PrecisionType.Half.value == 1
+    assert I._get_phi_kernel_name("softmax") == "softmax"
+    with pytest.raises(NotImplementedError):
+        I.convert_to_mixed_precision("a", "b", "c", "d")
+
+    # PredictorPool over a saved artifact
+    net = paddle.nn.Linear(4, 2)
+    inp = paddle.to_tensor(np.ones((1, 4), np.float32))
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[
+        paddle.static.InputSpec([None, 4], "float32")])
+    cfg = I.Config(prefix)
+    pool = I.PredictorPool(cfg, size=2)
+    p0, p1 = pool.retrieve(0), pool.retrieve(1)
+    assert p0 is not p1 and p0._layer is p1._layer
+    (o0,) = p0.run([np.ones((1, 4), np.float32)])
+    (o1,) = p1.run([np.ones((1, 4), np.float32)])
+    np.testing.assert_allclose(np.asarray(o0), np.asarray(o1))
+
+
+def test_fleet_tail():
+    """reference: distributed/fleet/__init__.py __all__ — UtilBase,
+    Role, data generators, Fleet facade."""
+    import paddle_tpu.distributed.fleet as fleet
+    assert fleet.util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+    out = fleet.util.all_reduce(np.array([1.0]))  # single-proc: identity
+    assert np.asarray(out if not hasattr(out, "numpy") else out.numpy()
+                      )[0] == 1.0
+    fleet.util.barrier()
+    assert fleet.Role.WORKER == 1 and fleet.Role.SERVER == 2
+
+    class Gen(fleet.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def g():
+                yield [("words", [1, 2, 3]), ("label", [0])]
+            return g
+
+    lines = Gen().run_from_files([os.devnull]) or []
+    g = Gen().generate_sample("x")
+    sample = next(g())
+    assert Gen()._format(sample) == "3 1 2 3 1 0"
+    assert fleet.Fleet.worker_num() >= 1
+    with pytest.raises(NotImplementedError):
+        fleet.MultiSlotDataGenerator().generate_sample("x")
+
+
+def test_rpc_worker_info():
+    """reference: distributed/rpc/rpc.py get_worker_info (offline
+    behavior: clear error without init)."""
+    from paddle_tpu.distributed import rpc
+    w = rpc.WorkerInfo("trainer0", 0, "127.0.0.1", 8080)
+    assert "trainer0" in repr(w)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        rpc.get_current_worker_info()
+
+
+def test_asp_tail():
+    """reference: incubate/asp/ — calculate_density, exclusions."""
+    import paddle_tpu.incubate.asp as asp
+    assert asp.calculate_density(np.array([0, 1, 0, 2])) == 0.5
+    m = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.Linear(8, 8))
+    asp.set_excluded_layers(["0"])
+    asp.prune_model(m, 2, 4)
+    d0 = asp.calculate_density(m[0].weight.numpy())
+    d1 = asp.calculate_density(m[1].weight.numpy())
+    assert d0 > 0.9 and d1 <= 0.5 + 1e-6   # excluded stays dense
+    asp.reset_excluded_layers()
+    asp.add_supported_layer("Custom")
+
+
+def test_audio_backends_roundtrip(tmp_path):
+    """reference: audio/backends/wave_backend.py load/save/info."""
+    A = paddle.audio
+    sr = 16000
+    wav = paddle.to_tensor(
+        (np.sin(np.linspace(0, 100, 4000)) * 0.1)
+        .astype("float32").reshape(1, -1))
+    p = str(tmp_path / "t.wav")
+    A.save(p, wav, sr)
+    meta = A.info(p)
+    assert (meta.sample_rate, meta.num_samples, meta.num_channels,
+            meta.bits_per_sample) == (sr, 4000, 1, 16)
+    back, sr2 = A.load(p)
+    assert sr2 == sr and list(back.shape) == [1, 4000]
+    np.testing.assert_allclose(back.numpy(), wav.numpy(), atol=1e-3)
+    raw, _ = A.load(p, normalize=False)
+    assert np.abs(raw.numpy()).max() > 1.0   # int16-valued
+    seg, _ = A.load(p, frame_offset=100, num_frames=50)
+    assert list(seg.shape) == [1, 50]
+    assert A.backends.list_available_backends() == ["wave_backend"]
+    assert A.backends.get_current_backend() == "wave_backend"
+    with pytest.raises(NotImplementedError):
+        A.backends.set_backend("soundfile")
+    assert A.features.MFCC is A.MFCC
+
+
+def test_audio_datasets_local(tmp_path):
+    """reference: audio/datasets/{esc50,tess}.py over the upstream
+    on-disk layouts."""
+    A = paddle.audio
+    sr = 16000
+    wav = paddle.to_tensor(np.zeros((1, 2000), np.float32))
+
+    # TESS layout: flat wavs named *_<emotion>.wav
+    tess = tmp_path / "tess"
+    tess.mkdir()
+    for i, emo in enumerate(["angry", "happy", "sad", "fear"]):
+        A.save(str(tess / f"OAF_word_{emo}.wav"), wav, sr)
+    train = A.datasets.TESS(mode="train", n_folds=2, split=1,
+                            data_dir=str(tess))
+    dev = A.datasets.TESS(mode="dev", n_folds=2, split=1,
+                          data_dir=str(tess))
+    assert len(train) + len(dev) == 4
+    feat, lbl = train[0]
+    assert feat.shape == [2000] and 0 <= lbl < 7
+
+    # ESC50 layout: meta/esc50.csv + audio/
+    esc = tmp_path / "esc"
+    (esc / "meta").mkdir(parents=True)
+    (esc / "audio").mkdir()
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    for i in range(4):
+        name = f"clip{i}.wav"
+        A.save(str(esc / "audio" / name), wav, sr)
+        rows.append(f"{name},{i % 2 + 1},{i % 3},cat{i % 3},False,0,A")
+    (esc / "meta" / "esc50.csv").write_text("\n".join(rows) + "\n")
+    d_train = A.datasets.ESC50(mode="train", split=1, data_dir=str(esc))
+    d_dev = A.datasets.ESC50(mode="dev", split=1, data_dir=str(esc))
+    assert len(d_train) + len(d_dev) == 4
+    feat, lbl = d_dev[0]
+    assert feat.shape == [2000] and 0 <= lbl < 3
+    with pytest.raises(RuntimeError, match="zero egress"):
+        A.datasets.ESC50()
+
+
+def test_distributed_top_level_tail():
+    """reference: distributed/__init__.py __all__ — modes, object
+    collectives, split builder, semi-auto markers."""
+    dist = paddle.distributed
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ReduceType.kRedSum == 0
+    assert dist.is_available()
+    assert dist.alltoall is dist.all_to_all
+
+    out = []
+    dist.gather(paddle.to_tensor(np.ones(2, np.float32)), out, dst=0)
+    np.testing.assert_array_equal(out[0].numpy(), [1, 1])
+    objs = ["a", {"b": 1}]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs == ["a", {"b": 1}]
+    lst = []
+    dist.scatter_object_list(lst, ["x"], src=0)
+    assert lst == ["x"]
+
+    x = paddle.to_tensor(
+        np.random.default_rng(0).normal(size=(4, 6)).astype("float32"))
+    y = dist.split(x, (6, 8), operation="linear", axis=1)
+    assert list(y.shape) == [4, 8]
+    ids = paddle.to_tensor(np.array([[1, 2]], np.int64))
+    e = dist.split(ids, (10, 4), operation="embedding")
+    assert list(e.shape) == [1, 2, 4]
+    with pytest.raises(ValueError):
+        dist.split(x, (6, 8), operation="conv")
+
+    s = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+    assert s.sharding.enable and s.sharding.stage == 2
+    assert s.pipeline.schedule_mode == "1F1B"
+    assert dist.SplitPoint.END.value == 1
+    assert dist.DistAttr(mesh=None).sharding_specs == []
+    for cls in (dist.ShardingStage1, dist.ShardingStage2,
+                dist.ShardingStage3):
+        assert cls("dp").stage in (1, 2, 3)
+
+    # PS-tier datasets raise with the descope reason
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        dist.InMemoryDataset().init()
+    assert dist.CountFilterEntry(5)._to_attr() == "count_filter_entry:5"
+    assert "show_click" in dist.ShowClickEntry("s", "c")._to_attr()
+
+    # unshard/dtensor_from_fn over a 1-proc mesh
+    mesh = dist.ProcessMesh(np.arange(1), dim_names=["dp"])
+    t = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Replicate()], [2, 2])
+    assert list(t.shape) == [2, 2]
+
+    # shard_dataloader wraps batches
+    loader = [paddle.to_tensor(np.ones((2, 2), np.float32))]
+    wrapped = dist.shard_dataloader(loader, mesh, shard_dims="dp")
+    assert len(wrapped) == 1
+    (batch,) = list(wrapped)
+    assert list(batch.shape) == [2, 2]
+    assert dist.shard_scaler(None) is None
+
+
+def test_distributed_io_and_fleet_hdfs(tmp_path, static_mode=None):
+    """reference: distributed/io.py + fleet/utils/fs.py HDFSClient."""
+    import paddle_tpu.static as static
+    dist = paddle.distributed
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            lin = paddle.nn.Linear(2, 2)
+            _ = lin(x)
+        path = dist.io.save_persistables(dirname=str(tmp_path),
+                                         main_program=main)
+        orig = lin.weight.numpy().copy()
+        lin.weight._inplace_update(lin.weight._data * 0)
+        dist.io.load_persistables(dirname=str(tmp_path), main_program=main)
+        np.testing.assert_allclose(lin.weight.numpy(), orig, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+    t = paddle.to_tensor([1.0])
+    t.persistable = True
+    assert dist.io.is_persistable(t)
+
+    from paddle_tpu.distributed.fleet.utils import (HDFSClient,
+                                                    DistributedInfer)
+    c = HDFSClient("/opt/does-not-exist")
+    assert not c.is_exist("/x")
+    with pytest.raises(RuntimeError):
+        c.mkdirs("/x")
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        DistributedInfer()
+
+
+def test_moe_three_phase_pipeline():
+    """reference: incubate/nn/functional/fused_moe.py:131/248/336 —
+    dispatch/ffn/reduce equals the dense fused_moe oracle."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(0)
+    T, d, dff, E, K = 6, 4, 5, 3, 2
+    x = paddle.to_tensor(rng.normal(size=(T, d)).astype("float32"))
+    gate = paddle.to_tensor(rng.normal(size=(T, E)).astype("float32"))
+    w1 = paddle.to_tensor(
+        (rng.normal(size=(E, d, 2 * dff)) * 0.3).astype("float32"))
+    w2 = paddle.to_tensor(
+        (rng.normal(size=(E, dff, d)) * 0.3).astype("float32"))
+    pi, nums, idx, scales, topi = IF.moe_dispatch(x, gate, K)
+    assert int(nums.numpy().sum()) == T * K
+    assert list(pi.shape) == [T * K, d]
+    h = IF.moe_ffn(pi, nums, w1, w2)
+    out = IF.moe_reduce(h, scales, idx, topi, norm_topk_prob=True)
+    ref = IF.fused_moe(paddle.to_tensor(x.numpy()[None]),
+                       paddle.to_tensor(gate.numpy()[None]),
+                       w1, w2, None, None, None, None, "None", K, True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy()[0], rtol=2e-4,
+                               atol=2e-4)
+    with pytest.raises(NotImplementedError):
+        IF.moe_ffn(pi, nums, w1, w2, quant_method="w8a8")
+
+
+def test_masked_and_block_multihead_attention():
+    """reference: masked_multihead_attention.py:74 +
+    block_multihead_attention.py:33 — decode steps vs naive oracles."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(0)
+    B, H, HD, S = 2, 2, 4, 8
+    cache = np.zeros((2, B, H, S, HD), np.float32)
+    cache[:, :, :, :3] = rng.normal(size=(2, B, H, 3, HD))
+    xq = rng.normal(size=(B, 3 * H * HD)).astype(np.float32)
+    out, new_cache = IF.masked_multihead_attention(
+        paddle.to_tensor(xq), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(
+            np.array([[3], [3]], np.int32)))
+    tok = xq.reshape(B, 3, H, HD)
+    k_new = np.concatenate([cache[0][:, :, :3],
+                            tok[:, 1][:, :, None]], 2)
+    v_new = np.concatenate([cache[1][:, :, :3],
+                            tok[:, 2][:, :, None]], 2)
+    sc = np.einsum("bhd,bhsd->bhs", tok[:, 0] * HD ** -0.5, k_new)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhs,bhsd->bhd", p, v_new).reshape(B, H * HD)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+    assert list(new_cache.shape) == [2, B, H, S, HD]
+    with pytest.raises(NotImplementedError, match="beam"):
+        IF.masked_multihead_attention(
+            paddle.to_tensor(xq), paddle.to_tensor(cache),
+            beam_cache_offset=paddle.to_tensor(np.zeros((B, 1, 2))))
+
+    me, md = IF.blha_get_max_len(
+        paddle.to_tensor(np.array([3, 5], np.int32)),
+        paddle.to_tensor(np.array([7, 2], np.int32)),
+        paddle.to_tensor(np.ones(2)))
+    assert int(me.numpy()[0]) == 5 and int(md.numpy()[0]) == 7
+
+    # block cache decode
+    BS, NBLK = 4, 6
+    kc = np.zeros((NBLK, H, BS, HD), np.float32)
+    vc = np.zeros((NBLK, H, BS, HD), np.float32)
+    tables = np.array([[0, 1, -1], [2, 3, -1]], np.int32)
+    hk = rng.normal(size=(2, H, 3, HD)).astype(np.float32)
+    hv = rng.normal(size=(2, H, 3, HD)).astype(np.float32)
+    kc[0, :, :3], kc[2, :, :3] = hk[0], hk[1]
+    vc[0, :, :3], vc[2, :, :3] = hv[0], hv[1]
+    out, qkv_out, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(xq), paddle.to_tensor(kc), paddle.to_tensor(vc),
+        paddle.to_tensor(np.zeros((B, 1), np.int32)),
+        paddle.to_tensor(np.full((B, 1), 3, np.int32)),
+        paddle.to_tensor(np.ones((B, 1), np.int32)),
+        None, None, None, None, paddle.to_tensor(tables), block_size=BS)
+    ref = np.zeros((B, H * HD), np.float32)
+    for b in range(B):
+        kf = np.concatenate([hk[b], tok[b, 1][:, None]], 1)
+        vf = np.concatenate([hv[b], tok[b, 2][:, None]], 1)
+        sc = np.einsum("hd,hsd->hs", tok[b, 0] * HD ** -0.5, kf)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref[b] = np.einsum("hs,hsd->hd", p, vf).reshape(-1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(kc2.numpy())[0, :, 3],
+                               tok[0, 1], rtol=1e-6)
+
+    # prefill mode fills the cache for the whole prompt
+    n = 3
+    qkv_pre = rng.normal(size=(B * n, 3 * H * HD)).astype(np.float32)
+    kc0 = np.zeros((NBLK, H, BS, HD), np.float32)
+    vc0 = np.zeros((NBLK, H, BS, HD), np.float32)
+    out_p, _, kc3, _ = IF.block_multihead_attention(
+        paddle.to_tensor(qkv_pre), paddle.to_tensor(kc0),
+        paddle.to_tensor(vc0),
+        paddle.to_tensor(np.full((B, 1), n, np.int32)),
+        paddle.to_tensor(np.zeros((B, 1), np.int32)),
+        paddle.to_tensor(np.full((B, 1), n, np.int32)),
+        None, None, None, None, paddle.to_tensor(tables), block_size=BS)
+    assert list(out_p.shape) == [B * n, H * HD]
+    assert np.any(np.asarray(kc3.numpy())[0, :, :n] != 0)
+
+
+def test_nn_quant_namespace():
+    """reference: python/paddle/nn/quant/__init__.py."""
+    Q = paddle.nn.quant
+    s = Q.Stub()
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_array_equal(s(x).numpy(), x.numpy())
+    assert callable(Q.weight_quantize) and callable(Q.weight_only_linear)

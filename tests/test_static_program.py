@@ -220,3 +220,128 @@ def test_weight_norm_param_attr_and_ipu_stubs():
         static.IpuCompiledProgram(None)
     with pytest.raises(RuntimeError, match="IPU backend"):
         static.ipu_shard_guard(0)
+
+
+def test_static_nn_builders(static_mode):
+    """static.nn legacy layer builders (reference: static/nn/common.py)
+    record into a Program and replay correctly."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 6], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        out = static.nn.fc(h, 2)
+    xv = np.random.default_rng(0).standard_normal((4, 6)).astype(np.float32)
+    (res,) = static.Executor().run(main, feed={"x": xv}, fetch_list=[out])
+    assert res.shape == (4, 2) and np.isfinite(res).all()
+
+    # dygraph behavior of the other builders, numpy oracles
+    paddle.disable_static()
+    img = paddle.to_tensor(
+        np.random.default_rng(1).normal(size=(2, 4, 8, 8)).astype("float32"))
+    assert list(static.nn.conv2d(img, 6, 3, padding=1).shape) == [2, 6, 8, 8]
+    assert list(static.nn.conv2d_transpose(img, 6, filter_size=3,
+                                           stride=2).shape) == [2, 6, 17, 17]
+    assert list(static.nn.batch_norm(img).shape) == [2, 4, 8, 8]
+    assert list(static.nn.layer_norm(img, begin_norm_axis=2).shape) == \
+        [2, 4, 8, 8]
+    assert list(static.nn.group_norm(img, 2).shape) == [2, 4, 8, 8]
+    assert list(static.nn.instance_norm(img).shape) == [2, 4, 8, 8]
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+    assert list(static.nn.embedding(ids, (10, 5)).shape) == [2, 2, 5]
+    a = paddle.to_tensor(
+        np.random.default_rng(2).normal(size=(4, 6)).astype("float32"))
+    b = paddle.to_tensor(
+        np.random.default_rng(3).normal(size=(4, 5)).astype("float32"))
+    assert list(static.nn.bilinear_tensor_product(a, b, 7).shape) == [4, 7]
+    assert list(static.nn.prelu(img, "channel").shape) == [2, 4, 8, 8]
+
+    # row_conv oracle: out[t] = sum_i in[t+i] * w[i]
+    seq = paddle.to_tensor(
+        np.random.default_rng(4).normal(size=(1, 5, 3)).astype("float32"))
+    rc = static.nn.row_conv(seq, 1)
+    assert list(rc.shape) == [1, 5, 3]
+
+    # spectral_norm drives sigma toward 1
+    w = paddle.to_tensor(
+        np.random.default_rng(5).normal(size=(5, 8)).astype("float32"))
+    sn = static.nn.spectral_norm(w, power_iters=10)
+    assert abs(np.linalg.svd(sn.numpy(), compute_uv=False)[0] - 1) < 0.05
+
+    # nce returns per-sample positive loss
+    lbl = paddle.to_tensor(np.array([[1], [2], [0], [3]], np.int64))
+    loss = static.nn.nce(a, lbl, 10, num_neg_samples=4)
+    assert list(loss.shape) == [4, 1] and float(loss.numpy().min()) > 0
+
+    # static_pylayer custom backward
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    t.stop_gradient = False
+    o = static.nn.static_pylayer(lambda v: v * 2, [t],
+                                 backward_fn=lambda g: g * 3)
+    o.sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), 3 * np.ones((2, 2)))
+
+    # descoped tiers say why
+    with pytest.raises(NotImplementedError, match="LoD"):
+        static.nn.sequence_pool(a, "max")
+    with pytest.raises(NotImplementedError, match="parameter-server"):
+        static.nn.sparse_embedding(a)
+
+
+def test_static_serialization_roundtrip(static_mode, tmp_path):
+    """serialize_program -> StableHLO artifact -> deserialize + run
+    (reference: static/io.py serialize/deserialize)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        lin = paddle.nn.Linear(4, 3)
+        y = paddle.tanh(lin(x))
+        dead = paddle.exp(x)      # not fetched: normalize_program prunes
+    normalized = static.normalize_program(main, [x], [y])
+    assert len(normalized._nodes) < len(main._nodes)
+    blob = static.serialize_program([x], [y], program=main)
+    pblob = static.serialize_persistables([x], [y], program=main)
+    static.save_to_file(str(tmp_path / "prog.bin"), blob)
+    paddle.disable_static()
+    dp = static.deserialize_program(
+        static.load_from_file(str(tmp_path / "prog.bin")))
+    xv = np.random.default_rng(0).standard_normal((5, 4)).astype(np.float32)
+    (out,) = dp.run({"x": xv})
+    ref = paddle.tanh(lin(paddle.to_tensor(xv))).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    (out2,) = dp.run({"x": xv[:2]})      # symbolic batch dim
+    assert out2.shape == (2, 3)
+    with pytest.raises(ValueError, match="missing feeds"):
+        dp.run({})
+
+    # persistables roundtrip through set_program_state
+    import pickle
+    state = pickle.loads(pblob)["state"]
+    static.set_program_state(main, {k: v * 0 for k, v in state.items()})
+    assert all(np.all(np.asarray(p._data) == 0)
+               for p in main.parameters())
+    static.deserialize_persistables(main, pblob)
+    got = {k: np.asarray(v._data) for k, v in main.state_dict().items()}
+    for k in state:
+        np.testing.assert_allclose(got[k], state[k])
+
+
+def test_static_metrics_and_misc(static_mode):
+    paddle.disable_static()
+    pred = paddle.to_tensor(
+        np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32))
+    lbl = paddle.to_tensor(np.array([[1], [0], [0]], np.int64))
+    acc = static.accuracy(pred, lbl)
+    np.testing.assert_allclose(float(acc.numpy()), 2 / 3, rtol=1e-5)
+    a, batch_a, stats = static.auc(pred, lbl)
+    np.testing.assert_allclose(float(a.numpy()), 1.0, atol=1e-3)
+    # perfect separation -> 1.0; flip labels -> 0.0
+    a2, _, _ = static.auc(pred, paddle.to_tensor(
+        np.array([[0], [1], [1]], np.int64)))
+    np.testing.assert_allclose(float(a2.numpy()), 0.0, atol=1e-3)
+    gv = static.create_global_var([2], 7.0, "float32", persistable=True)
+    assert gv.persistable and float(gv.numpy()[0]) == 7.0
+    assert len(static.cuda_places()) >= 1
+    with static.device_guard("gpu:0"):
+        pass
+    with pytest.raises(NotImplementedError):
+        static.ctr_metric_bundle(pred, lbl)

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Chip-liveness watcher: probe on a 25-minute cadence (the claim
+# discipline's safe spacing — see .claude/skills/verify); when the pool
+# answers, run the round-4 evidence chain once and exit.
+#
+#   bash tools/tpu_watch.sh [logfile]
+#
+# Produces (on success): regenerated docs/PERF_AUDIT.json sections, a
+# fresh bench line in the log, the d64-vs-d128 1B A/B, and the TPU
+# op-bench baseline. ONE TPU process at a time throughout.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log="${1:-/tmp/tpu_watch.log}"
+echo "[watch] start $(date -u +%H:%M:%S)" >> "$log"
+
+while true; do
+  if timeout 120 python -c "import jax; print(jax.devices())" \
+      >> "$log" 2>&1; then
+    echo "[watch] chip ALIVE $(date -u +%H:%M:%S) — running evidence" \
+      >> "$log"
+    {
+      echo "== audit matmul =="
+      timeout 900 python tools/perf_audit.py matmul
+      echo "== audit attention =="
+      timeout 900 python tools/perf_audit.py attention
+      echo "== audit step =="
+      timeout 1200 python tools/perf_audit.py step
+      echo "== bench (both configs) =="
+      timeout 2400 python bench.py
+      echo "== 1B d128 A/B =="
+      PADDLE_TPU_BENCH_1B_HEADS=16 timeout 1500 python bench.py --child \
+        --config=llama_1b
+      echo "== opbench TPU baseline =="
+      timeout 900 python tools/op_bench.py --record --no-collective
+      echo "[watch] evidence chain complete $(date -u +%H:%M:%S)"
+    } >> "$log" 2>&1
+    exit 0
+  fi
+  echo "[watch] wedged $(date -u +%H:%M:%S); sleeping 25m" >> "$log"
+  sleep 1500
+done
