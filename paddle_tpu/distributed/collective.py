@@ -309,10 +309,35 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             if _nonmember_noop(group):
                 return a
             out = _np_reduce(_gather_rows(a, group), op)
-            return jnp.asarray(out.astype(np.asarray(a).dtype, copy=False))
+            return jnp.asarray(out.astype(
+                getattr(a, "dtype", np.asarray(a).dtype), copy=False))
         return a  # world size 1: reduction of one value
 
     return _apply(tensor, fn)
+
+
+def raw_all_reduce_sum(a, group=None):
+    """Sum-reduce a RAW jnp array across the group, usable inside an op
+    body (fused ops that must reduce a partial product mid-computation,
+    e.g. fused_multi_head_attention's out-projection). Manual/shard_map
+    regions lower to ``lax.psum`` (differentiable, rides ICI); the eager
+    multi-process regime uses the host exchange; world size 1 is the
+    identity."""
+    axis = _get_axis(group)
+    if _in_manual_region(axis):
+        return lax.psum(a, axis)
+    if _mp_active():
+        if _nonmember_noop(group):
+            return a
+        if isinstance(a, jax.core.Tracer):
+            raise NotImplementedError(
+                "raw_all_reduce_sum: the eager multi-process host exchange "
+                "cannot run under autograd/jit tracing — run tensor-parallel "
+                "training through shard_map/GSPMD (group with a bound "
+                "axis_name), or call the fused op with stop_gradient inputs")
+        out = _np_reduce(_gather_rows(a, group), ReduceOp.SUM)
+        return jnp.asarray(out.astype(a.dtype, copy=False))
+    return a
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):

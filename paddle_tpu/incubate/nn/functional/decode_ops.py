@@ -32,16 +32,32 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
             Tensor(jnp.max(dec).astype(jnp.int32).reshape(1)))
 
 
-def _rope_decode(q, k, rot, neox):
-    # rot: [B, 1, 1, S, hd] cos/sin interleaved table at the current
-    # positions — the reference packs cos into even and sin into odd
-    # lanes of one tensor; accept [2, B, ...] (cos, sin) too.
+def _rope_decode(q, k, rot, neox, positions, batch_index=None):
+    """Apply rotary embedding rows gathered per token position.
+
+    q/k are ``[B, H, hd]`` (decode: one token per sequence, ``positions``
+    is the per-sequence write position ``[B]``) or ``[n, H, hd]``
+    (prefill: one sequence's tokens, ``positions`` is ``arange(n)`` and
+    ``batch_index`` selects the sequence's row of the table). ``rot`` is
+    the reference layout ``[2, B, ..., S, hd]`` (cos, sin split) or
+    ``[B, ..., S, hd]`` angles; singleton middle dims are collapsed so
+    the table reads as ``[B, S, hd]``.
+    """
+    hd = q.shape[-1]
     if rot.ndim >= 1 and rot.shape[0] == 2:
         cos, sin = rot[0], rot[1]
     else:
         cos, sin = jnp.cos(rot), jnp.sin(rot)
-    cos = cos.reshape(cos.shape[0], 1, -1)[:, :, -q.shape[-1]:]
-    sin = sin.reshape(sin.shape[0], 1, -1)[:, :, -q.shape[-1]:]
+    cos = cos.reshape(cos.shape[0], -1, cos.shape[-1])[..., :hd]
+    sin = sin.reshape(sin.shape[0], -1, sin.shape[-1])[..., :hd]
+    positions = jnp.asarray(positions).astype(jnp.int32)
+    if batch_index is None:
+        rows = jnp.arange(q.shape[0])          # decode: own row per seq
+        cos_p = cos[rows, positions][:, None, :]          # [B, 1, hd]
+        sin_p = sin[rows, positions][:, None, :]
+    else:
+        cos_p = cos[batch_index, positions][:, None, :]   # [n, 1, hd]
+        sin_p = sin[batch_index, positions][:, None, :]
 
     def rot1(t):
         if neox:
@@ -52,7 +68,7 @@ def _rope_decode(q, k, rot, neox):
             t1 = t[..., 0::2]
             t2 = t[..., 1::2]
             r = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
-        return t * cos + r * sin
+        return t * cos_p + r * sin_p
 
     return rot1(q), rot1(k)
 
@@ -73,7 +89,13 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     by ``sequence_lengths`` (default: first all-zero slot) and attends
     over the populated prefix. Returns (out, cache_kv_out) — functional
     cache-out (jax arrays are immutable; the reference updates in
-    place). Quant/beam tiers raise."""
+    place). Quant/beam tiers raise.
+
+    When ``sequence_lengths`` is None the write slot is inferred by
+    counting key rows with any nonzero element — this requires a
+    zero-initialized cache and assumes no legitimately all-zero key
+    vector has been written; pass ``sequence_lengths`` explicitly
+    whenever either assumption may not hold."""
     if qkv_out_scale is not None or out_scale != -1:
         raise NotImplementedError(
             "masked_multihead_attention: quant path not supported "
@@ -98,7 +120,7 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         pos = jnp.sum(written.astype(jnp.int32), axis=-1)
     if rotary_tensor is not None and rotary_emb_dims > 0:
         q, k = _rope_decode(q, k, _raw(rotary_tensor),
-                            use_neox_rotary_style)
+                            use_neox_rotary_style, pos)
     # write k/v at pos (per batch)
     onehot = jax.nn.one_hot(pos, s_max, dtype=cache.dtype)  # [B, S_max]
     k_cache = cache[0] * (1 - onehot[:, None, :, None]) + \
@@ -196,7 +218,8 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         k = tok[:, 1]
         v = tok[:, 2]
         if rope_emb is not None:
-            q, k = _rope_decode(q, k, _raw(rope_emb), use_neox_style)
+            q, k = _rope_decode(q, k, _raw(rope_emb), use_neox_style,
+                                dec_lens)
         for bi in range(b):
             kc, vc = write_token(kc, vc, bi, int(dec_lens[bi]),
                                  k[bi], v[bi])
@@ -215,10 +238,8 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             sl = slice(start, start + n)
             q, k, v = tok[sl, 0], tok[sl, 1], tok[sl, 2]   # [n, H, hd]
             if rope_emb is not None:
-                qb, kb = _rope_decode(
-                    jnp.swapaxes(q, 0, 1), jnp.swapaxes(k, 0, 1),
-                    _raw(rope_emb), use_neox_style)
-                q, k = jnp.swapaxes(qb, 0, 1), jnp.swapaxes(kb, 0, 1)
+                q, k = _rope_decode(q, k, _raw(rope_emb), use_neox_style,
+                                    jnp.arange(n), batch_index=bi)
             for t in range(n):
                 kc, vc = write_token(kc, vc, bi, t, k[t], v[t])
             scores = jnp.einsum("qhd,khd->hqk", q * hd ** -0.5, k)

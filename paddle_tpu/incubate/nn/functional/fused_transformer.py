@@ -183,7 +183,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     def _body(x, qkv_w, lin_w, pre_s, pre_b, ln_s, ln_b, qkv_b, lin_b,
               cache, mask, k_attn, k_out, *, pre, e_pre, e_post, p_attn,
-              p_out, training, mode, add_residual, n_heads, trans_wb):
+              p_out, training, mode, add_residual, n_heads, trans_wb,
+              tp_reduce):
         residual = x
         out = _ln(x, pre_s, pre_b, e_pre) if pre else x
         b, s, d = out.shape
@@ -213,6 +214,13 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         ctx = probs @ v                              # [b, h, s, hd]
         ctx = jnp.swapaxes(ctx, 1, 2).reshape(b, s, -1)
         out = ctx @ lin_w
+        if tp_reduce is not None:
+            # tensor-parallel: sum the out-projection PARTIAL product
+            # before bias/dropout/residual/post-LN — the reference's
+            # c_allreduce_sum sits exactly here (fused_attention_op's
+            # row-parallel out_linear), so bias and residual are added
+            # once, not world_size times.
+            out = tp_reduce(out)
         if lin_b is not None:
             out = out + lin_b
         out = _dropout(out, p_out, training, mode, k_out)
@@ -222,22 +230,28 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = _ln(out, ln_s, ln_b, e_post)
         return out if cache is None else (out, cache_out)
 
-    out = op_call("fused_multi_head_attention", _body, x, qkv_weight,
-                  linear_weight, pre_ln_scale, pre_ln_bias, ln_scale,
-                  ln_bias, qkv_bias, linear_bias, cache_kv, attn_mask,
-                  k_attn, k_out, pre=bool(pre_layer_norm),
-                  e_pre=float(pre_ln_epsilon), e_post=float(ln_epsilon),
-                  p_attn=float(attn_dropout_rate), p_out=float(dropout_rate),
-                  training=bool(training), mode=mode,
-                  add_residual=bool(add_residual), n_heads=int(num_heads),
-                  trans_wb=bool(transpose_qkv_wb))
+    tp_reduce = None
     if ring_id >= 0:
         from ....distributed import collective as C
         if C.is_initialized():
-            from .... import distributed as dist
-            main = out[0] if isinstance(out, tuple) else out
-            dist.all_reduce(main)
-    return out
+            # resolve ring_id to its group so a shard_map-bound axis_name
+            # reaches the differentiable lax.psum branch; unknown ids fall
+            # back to the default (global) group
+            try:
+                from ....distributed.communication import get_group
+                grp = get_group(ring_id)
+            except (ValueError, ImportError):
+                grp = None
+            tp_reduce = (lambda a, _g=grp: C.raw_all_reduce_sum(a, _g))
+    return op_call("fused_multi_head_attention", _body, x, qkv_weight,
+                   linear_weight, pre_ln_scale, pre_ln_bias, ln_scale,
+                   ln_bias, qkv_bias, linear_bias, cache_kv, attn_mask,
+                   k_attn, k_out, pre=bool(pre_layer_norm),
+                   e_pre=float(pre_ln_epsilon), e_post=float(ln_epsilon),
+                   p_attn=float(attn_dropout_rate), p_out=float(dropout_rate),
+                   training=bool(training), mode=mode,
+                   add_residual=bool(add_residual), n_heads=int(num_heads),
+                   trans_wb=bool(transpose_qkv_wb), tp_reduce=tp_reduce)
 
 
 # ---------------------------------------------------------------------------
@@ -303,7 +317,14 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
                                                pre_cache_length=0):
     """Per-sequence-length masked attention over padded [B, H, S, D]
     batches. Padding keys (pos >= kv_seq_len) are masked out; padded
-    query rows are zeroed in the output."""
+    query rows are zeroed in the output. When ``sk > sq`` (decode over a
+    cached prefix) query row ``i`` sits at absolute position
+    ``kv_len - q_len + i``, so the causal mask is offset per sequence."""
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: pre_cache_length "
+            "is generation-search plumbing served by models.generation on "
+            "this stack — prepend the cache to key/value instead")
 
     def _body(q, k, v, q_lens, kv_lens, mask, *, scale, causal):
         b, h, sq, d = q.shape
@@ -316,8 +337,11 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens,
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
         scores = jnp.where(kv_valid[:, None, None, :], scores, neg)
         if causal:
-            cm = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
-            scores = jnp.where(cm[None, None], scores, neg)
+            # query i is at absolute position kv_len - q_len + i
+            off = (kv_lens.reshape(-1) - q_lens.reshape(-1))       # [B]
+            cm = jnp.arange(sk)[None, None, :] <= (
+                jnp.arange(sq)[None, :, None] + off[:, None, None])
+            scores = jnp.where(cm[:, None], scores, neg)
         out = jax.nn.softmax(scores, axis=-1) @ v
         q_valid = jnp.arange(sq)[None, :] < q_lens.reshape(-1, 1)
         return jnp.where(q_valid[:, None, :, None], out, 0)

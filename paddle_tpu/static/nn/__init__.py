@@ -143,24 +143,59 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
     batch statistics (batch_size/batch_sum/batch_square_sum accumulators,
     the CTR-model normalizer). The accumulators initialize to the
     reference defaults (count 1e4, zero sum, 1e4 square-sum => unit
-    scale) and update every training call."""
+    scale), normalize with the PRE-update values, then accumulate this
+    batch's count/sum/square-sum — the reference data_norm op's training
+    update. Accumulators persist across calls keyed by ``name`` (the
+    analog of the reference's per-layer persistable variables, which get
+    a unique auto-generated name at build time); UNNAMED calls keep the
+    frozen init stats, since distinct unnamed call sites cannot be told
+    apart here. Under static (record/replay) mode the accumulation is
+    skipped too — the recorded program normalizes with build-time
+    stats."""
+    import jax
     import jax.numpy as jnp
     from ...nn.layer.layers import Parameter
-    c = int(input.shape[-1 if data_layout == "NHWC" else 1])
+    c_axis = -1 if data_layout == "NHWC" else 1
+    c = int(input.shape[c_axis])
     stat_shape = (c,)
-    batch_size = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
-    batch_sum = Parameter(jnp.zeros(stat_shape, jnp.float32))
-    batch_sq = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
-    for p in (batch_size, batch_sum, batch_sq):
-        p.stop_gradient = True
+    key = name or moving_mean_name
+    stats = _DATA_NORM_STATS.get((key, c)) if key else None
+    if stats is None:
+        batch_size = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
+        batch_sum = Parameter(jnp.zeros(stat_shape, jnp.float32))
+        batch_sq = Parameter(jnp.full(stat_shape, 1e4, jnp.float32))
+        for p in (batch_size, batch_sum, batch_sq):
+            p.stop_gradient = True
+        stats = (batch_size, batch_sum, batch_sq)
+        if key:
+            _DATA_NORM_STATS[(key, c)] = stats
+    batch_size, batch_sum, batch_sq = stats
     mean = batch_sum / batch_size
     scale = (batch_size / batch_sq) ** 0.5
     out = (input - mean) * scale
+    # accumulate this batch's stats for subsequent calls — eager named
+    # calls only (concrete arrays; static Variables carry ShapeDtypeStruct)
+    x = getattr(input, "_data", None)
+    if key and isinstance(x, jax.Array):
+        red = tuple(i for i in range(x.ndim) if i != c_axis % x.ndim)
+        n = 1
+        for i in red:
+            n *= int(x.shape[i])
+        batch_size._data = batch_size._data + float(n)
+        batch_sum._data = batch_sum._data + \
+            jnp.sum(x, axis=red).astype(jnp.float32)
+        batch_sq._data = batch_sq._data + \
+            jnp.sum(x * x, axis=red).astype(jnp.float32)
     if enable_scale_and_shift:
         w = Parameter(jnp.ones(stat_shape, jnp.float32))
         b = Parameter(jnp.zeros(stat_shape, jnp.float32))
         out = out * w + b
     return _act(out, act)
+
+
+# data_norm accumulators: persist across calls (the reference keeps them
+# as persistable program variables updated by the op each training step)
+_DATA_NORM_STATS: dict = {}
 
 
 def _conv_nd(input, num_filters, filter_size, stride, padding, dilation,

@@ -288,3 +288,67 @@ def test_fused_multi_transformer_rmsnorm_rotary():
         activation="silu")
     assert list(out.shape) == [b, s, d]
     assert np.isfinite(out.numpy()).all()
+
+
+def test_fused_mha_tp_allreduce_before_bias(monkeypatch):
+    """Round-5 ADVICE fix: the tensor-parallel allreduce must hit the
+    out-projection PARTIAL product, before bias/dropout/residual/post-LN
+    (reference fused_attention: c_allreduce_sum on the row-parallel
+    out_linear output). Simulated with a x2 reducer."""
+    from paddle_tpu.distributed import collective as C
+    monkeypatch.setattr(C, "is_initialized", lambda: True)
+    monkeypatch.setattr(C, "raw_all_reduce_sum",
+                        lambda a, group=None: a * 2)
+    b, s, h, hd = 2, 3, 2, 4
+    d = h * hd
+    x = RNG.normal(size=(b, s, d)).astype(np.float32)
+    qkv_w = RNG.normal(size=(3, h, hd, d)).astype(np.float32)
+    lin_w = RNG.normal(size=(d, d)).astype(np.float32)
+    lin_b = RNG.normal(size=(d,)).astype(np.float32)
+    out = IF.fused_multi_head_attention(
+        t(x), t(qkv_w), t(lin_w), pre_layer_norm=True,
+        linear_bias=t(lin_b), dropout_rate=0.0, attn_dropout_rate=0.0,
+        ring_id=0)
+    xn = _ln_np(x)
+    qkv = np.einsum("bsd,thed->tbhse", xn, qkv_w)
+    q, k, v = qkv[0] * hd ** -0.5, qkv[1], qkv[2]
+    probs = torch.softmax(
+        torch.from_numpy(q @ k.transpose(0, 1, 3, 2)), -1).numpy()
+    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(b, s, d)
+    # partial product doubled BEFORE bias and residual — bias/residual
+    # are added exactly once
+    ref = x + (2 * (ctx @ lin_w) + lin_b)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_varlen_attention_decode_causal_offset():
+    """Round-5 ADVICE fix: with sk > sq (decode over a cached prefix),
+    query row i sits at absolute position kv_len - q_len + i — the
+    causal mask must be offset per sequence, not aligned at 0."""
+    b, h, sq, sk, hd = 2, 2, 2, 5, 4
+    q = RNG.normal(size=(b, h, sq, hd)).astype(np.float32)
+    k = RNG.normal(size=(b, h, sk, hd)).astype(np.float32)
+    v = RNG.normal(size=(b, h, sk, hd)).astype(np.float32)
+    q_lens = np.array([[2], [2]], np.int32)
+    kv_lens = np.array([[5], [4]], np.int32)
+    out = IF.variable_length_memory_efficient_attention(
+        t(q), t(k), t(v), paddle.to_tensor(q_lens),
+        paddle.to_tensor(kv_lens), causal=True)
+
+    def sdpa(qrow, krows, vrows):
+        qt = torch.from_numpy(qrow[:, None])       # [h, 1, hd]
+        return torch.nn.functional.scaled_dot_product_attention(
+            qt, torch.from_numpy(krows),
+            torch.from_numpy(vrows)).numpy()[:, 0]
+
+    for bi in range(b):
+        off = int(kv_lens[bi, 0] - q_lens[bi, 0])
+        for i in range(sq):
+            ref = sdpa(q[bi, :, i], k[bi, :, :off + i + 1],
+                       v[bi, :, :off + i + 1])
+            np.testing.assert_allclose(out.numpy()[bi, :, i], ref,
+                                       rtol=1e-4, atol=1e-4)
+    with pytest.raises(NotImplementedError, match="pre_cache_length"):
+        IF.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), paddle.to_tensor(q_lens),
+            paddle.to_tensor(kv_lens), pre_cache_length=2)

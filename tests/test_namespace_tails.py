@@ -456,3 +456,88 @@ def test_nn_quant_namespace():
     x = paddle.to_tensor(np.ones((2, 2), np.float32))
     np.testing.assert_array_equal(s(x).numpy(), x.numpy())
     assert callable(Q.weight_quantize) and callable(Q.weight_only_linear)
+
+
+def test_masked_multihead_attention_rope_positions():
+    """Round-5 ADVICE fix: the rotary table must be indexed at each
+    sequence's own position (B != H catches the old batch-as-head
+    broadcast bug)."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(1)
+    B, H, HD, S = 2, 3, 4, 8
+    lens = np.array([2, 4], np.int32)
+    cache = np.zeros((2, B, H, S, HD), np.float32)
+    for b in range(B):
+        cache[:, b, :, :lens[b]] = rng.normal(size=(2, H, lens[b], HD))
+    xq = rng.normal(size=(B, 3 * H * HD)).astype(np.float32)
+    ang = rng.normal(size=(B, S, HD // 2)).astype(np.float32)
+    cos = np.repeat(np.cos(ang), 2, axis=-1).reshape(B, 1, S, HD)
+    sin = np.repeat(np.sin(ang), 2, axis=-1).reshape(B, 1, S, HD)
+    rot = np.stack([cos, sin]).astype(np.float32)   # [2, B, 1, S, HD]
+    out, _ = IF.masked_multihead_attention(
+        paddle.to_tensor(xq), paddle.to_tensor(cache),
+        sequence_lengths=paddle.to_tensor(lens.reshape(-1, 1)),
+        rotary_tensor=paddle.to_tensor(rot), rotary_emb_dims=1)
+
+    def rope(tk, b, pos):                          # tk [H, HD]
+        r = np.stack([-tk[:, 1::2], tk[:, 0::2]], -1).reshape(tk.shape)
+        return tk * cos[b, 0, pos] + r * sin[b, 0, pos]
+
+    tok = xq.reshape(B, 3, H, HD)
+    ref = np.zeros((B, H * HD), np.float32)
+    for b in range(B):
+        q = rope(tok[b, 0], b, lens[b])
+        k = rope(tok[b, 1], b, lens[b])
+        kf = np.concatenate([cache[0, b, :, :lens[b]], k[:, None]], 1)
+        vf = np.concatenate([cache[1, b, :, :lens[b]],
+                             tok[b, 2][:, None]], 1)
+        sc = np.einsum("hd,hsd->hs", q * HD ** -0.5, kf)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref[b] = np.einsum("hs,hsd->hd", p, vf).reshape(-1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_block_multihead_attention_prefill_rope():
+    """Prefill rope: token t gets the table's row t (per sequence),
+    with B != H shapes."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.default_rng(2)
+    B, H, HD = 2, 3, 4
+    n, BS, NBLK = 3, 4, 6
+    S = 8
+    qkv_pre = rng.normal(size=(B * n, 3 * H * HD)).astype(np.float32)
+    ang = rng.normal(size=(B, S, HD // 2)).astype(np.float32)
+    cos = np.repeat(np.cos(ang), 2, axis=-1).reshape(B, 1, S, HD)
+    sin = np.repeat(np.sin(ang), 2, axis=-1).reshape(B, 1, S, HD)
+    rot = np.stack([cos, sin]).astype(np.float32)
+    tables = np.array([[0, 1, -1], [2, 3, -1]], np.int32)
+    kc0 = np.zeros((NBLK, H, BS, HD), np.float32)
+    vc0 = np.zeros((NBLK, H, BS, HD), np.float32)
+    out_p, _, _, _ = IF.block_multihead_attention(
+        paddle.to_tensor(qkv_pre), paddle.to_tensor(kc0),
+        paddle.to_tensor(vc0),
+        paddle.to_tensor(np.full((B, 1), n, np.int32)),
+        paddle.to_tensor(np.zeros((B, 1), np.int32)),
+        paddle.to_tensor(np.full((B, 1), n, np.int32)),
+        None, None, None, None, paddle.to_tensor(tables),
+        rope_emb=paddle.to_tensor(rot), block_size=BS)
+
+    def rope(tk, b, pos):                          # tk [H, HD]
+        r = np.stack([-tk[:, 1::2], tk[:, 0::2]], -1).reshape(tk.shape)
+        return tk * cos[b, 0, pos] + r * sin[b, 0, pos]
+
+    tok = qkv_pre.reshape(B, n, 3, H, HD)
+    ref = np.zeros((B, n, H * HD), np.float32)
+    for b in range(B):
+        q = np.stack([rope(tok[b, t, 0], b, t) for t in range(n)])
+        k = np.stack([rope(tok[b, t, 1], b, t) for t in range(n)])
+        v = tok[b, :, 2]                            # [n, H, HD]
+        sc = np.einsum("qhd,khd->hqk", q * HD ** -0.5, k)
+        causal = np.tril(np.ones((n, n), bool))
+        sc = np.where(causal[None], sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref[b] = np.einsum("hqk,khd->qhd", p, v).reshape(n, -1)
+    np.testing.assert_allclose(out_p.numpy().reshape(B, n, -1), ref,
+                               rtol=1e-4, atol=1e-4)

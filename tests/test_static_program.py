@@ -345,3 +345,45 @@ def test_static_metrics_and_misc(static_mode):
         pass
     with pytest.raises(NotImplementedError):
         static.ctr_metric_bundle(pred, lbl)
+
+
+def test_data_norm_accumulates_stats():
+    """Round-5 ADVICE fix: data_norm must update its
+    batch_size/batch_sum/batch_square_sum accumulators each training
+    call (reference static/nn/common.py:461), persisted by name."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(loc=5.0, scale=2.0, size=(64, 4)).astype(np.float32)
+    xt = paddle.to_tensor(x)
+    name = "dn_acc_test"
+    # first call normalizes with the init stats (mean 0, scale 1)
+    out1 = static.nn.data_norm(xt, name=name, data_layout="NHWC")
+    np.testing.assert_allclose(out1.numpy(), x, rtol=1e-5, atol=1e-5)
+    # after many accumulating calls the stats approach the data's
+    # mean/second-moment, so the output is no longer the identity
+    for _ in range(50):
+        static.nn.data_norm(xt, name=name, data_layout="NHWC")
+    out2 = static.nn.data_norm(xt, name=name, data_layout="NHWC")
+    assert not np.allclose(out2.numpy(), x, atol=1e-2)
+    # and the normalized output's mean drifts toward 0
+    assert abs(out2.numpy().mean()) < abs(x.mean())
+
+
+def test_data_norm_static_build(static_mode):
+    """data_norm must still build+run inside a static program (the
+    accumulator update is eager-only; static replay uses frozen
+    stats)."""
+    import numpy as np
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        out = static.nn.data_norm(x, data_layout="NHWC")
+    exe = static.Executor()
+    xv = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    (res,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(res, xv, rtol=1e-5, atol=1e-5)

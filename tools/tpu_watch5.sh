@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Round-5 chip watcher: probe liveness every 20 min; when the pool
+# answers, run tools/tpu_round5.py (the prioritized evidence chain) once
+# and exit. ONE TPU process at a time throughout.
+#
+#   bash tools/tpu_watch5.sh [logfile]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log="${1:-/tmp/tpu_watch5.log}"
+echo "[watch5] start $(date -u +%H:%M:%S)" >> "$log"
+
+while true; do
+  if timeout 120 python -c "import jax; print(jax.devices())" \
+      >> "$log" 2>&1; then
+    echo "[watch5] chip ALIVE $(date -u +%H:%M:%S) — evidence chain" \
+      >> "$log"
+    python tools/tpu_round5.py >> "$log" 2>&1
+    echo "[watch5] done $(date -u +%H:%M:%S)" >> "$log"
+    exit 0
+  fi
+  echo "[watch5] wedged $(date -u +%H:%M:%S); sleeping 20m" >> "$log"
+  sleep 1200
+done
