@@ -100,6 +100,10 @@ def run_bench(config="llama_125m", progress=None):
     from paddle_tpu.models import LlamaForCausalLM, LlamaConfig
     progress.mark("imports_done")
 
+    # Marked BEFORE the first backend touch: a timeout whose last stage is
+    # "backend_probing" conclusively names backend init (wedged pool) as
+    # the stall, instead of leaving it inferred from "imports_done".
+    progress.mark("backend_probing")
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu", "gpu")
     progress.mark("backend_up", device=getattr(dev, "device_kind", str(dev)))

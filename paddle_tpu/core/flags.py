@@ -193,6 +193,10 @@ define_flag("jit_engine_type", str, "xla",
 define_flag("sot_specialization_cache_size", int, 32,
             "max SOT-lite branch specializations kept per input signature "
             "(LRU eviction; the reference's sot guard-cache bound)")
+define_flag("jit_auto_while", bool, True,
+            "to_static: source-rewrite safe tensor-dependent Python while "
+            "loops to lax.while_loop (compile once for all trip counts; "
+            "the SOT loop-transformer capability)")
 
 # ---- round-4 flags tail (reference paddle/common/flags.cc; each is wired
 # to observable behavior and covered by tests/test_flags_behavior.py) ----

@@ -80,6 +80,11 @@ class StaticFunction:
     """
 
     def __init__(self, fn, layer=None):
+        # SOT loop capture (round-5): safe tensor-dependent `while` loops
+        # are source-rewritten to compile ONCE via lax.while_loop instead
+        # of one specialization per trip count (loop_rewrite.py)
+        from .loop_rewrite import rewrite_loops
+        fn = rewrite_loops(fn)
         self._fn = fn
         self._layer = layer
         self._cache = {}

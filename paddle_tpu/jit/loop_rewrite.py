@@ -1,0 +1,307 @@
+"""AST-level auto-rewrite of tensor-dependent Python ``while`` loops.
+
+The reference compiles a plain ``while bool(tensor):`` loop transparently
+through its SOT bytecode VM + loop transformer (reference:
+python/paddle/jit/sot/opcode_translator/executor/opcode_executor.py;
+python/paddle/jit/dy2static/transformers/loop_transformer.py). The
+TPU-native equivalent works at the SOURCE level: ``to_static`` parses the
+function, rewrites each *safe* ``while`` statement into a call to
+:func:`auto_while`, and ``auto_while`` decides at run time:
+
+- condition is a plain Python bool  -> ordinary Python loop (unchanged
+  semantics, zero overhead beyond one call frame);
+- condition is a Tensor, gradients cannot flow, and the loop state is
+  carriable -> ONE ``lax.while_loop`` via ``static.control_flow
+  .while_loop`` — the loop compiles once for every trip count;
+- anything else (shape-variant state, grad-requiring state, un-carriable
+  objects) -> Python loop again, which lands in the existing SOT-lite
+  value-guard machinery (one specialization per trip count) exactly as
+  before the rewrite.
+
+The *safe subset* a ``while`` must satisfy to be rewritten (anything else
+is left verbatim — never a behavior change, only a missed optimization):
+
+- no ``else:`` clause, no ``break``/``continue``/``return``/``yield``
+  inside the body;
+- body statements are assignments to plain names (``x = ...``,
+  ``x, y = ...``, ``x += ...``) and ``if``/``elif``/``else`` blocks of the
+  same shape — no attribute/subscript stores, no bare expression
+  statements (those exist only for side effects), no nested loops, no
+  ``global``/``nonlocal``.
+
+Loop state = every name stored in the body plus every name read by the
+condition. If any of them is unbound when the loop is reached, the
+generated code falls back to the verbatim original loop (kept as a
+sibling branch), preserving NameError/first-iteration-binds semantics.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+_HELPER = "__ptpu_auto_while__"
+
+
+# ---------------------------------------------------------------------------
+# runtime helper
+# ---------------------------------------------------------------------------
+
+def auto_while(cond_fn, body_fn, state):
+    """Run a rewritten while loop; compile-once when safely possible."""
+    from ..core import autograd as _ag
+    from ..core.tensor import Tensor
+
+    c = cond_fn(*state)
+    if isinstance(c, Tensor):
+        grads_flow = _ag.is_grad_enabled() and any(
+            isinstance(v, Tensor) and not v.stop_gradient for v in state)
+        if not grads_flow:
+            carriable = all(
+                isinstance(v, (Tensor, bool, int, float)) for v in state)
+            if carriable:
+                import jax.numpy as jnp
+                canon = [v if isinstance(v, Tensor)
+                         else Tensor(jnp.asarray(v)) for v in state]
+                from ..static.control_flow import while_loop
+                try:
+                    out = while_loop(lambda *s: cond_fn(*s),
+                                     lambda *s: list(body_fn(*s)), canon)
+                    return tuple(out)
+                except (ValueError, TypeError):
+                    # shape/dtype-variant loop state (e.g. a growing
+                    # decode buffer): not lax-compilable — fall through
+                    # to the Python loop, the pre-rewrite behavior
+                    pass
+    # plain-Python semantics: bool(c) routes through the SOT-lite guard
+    # hook under capture, exactly like the original loop did
+    while c:
+        state = tuple(body_fn(*state))
+        c = cond_fn(*state)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# safety analysis
+# ---------------------------------------------------------------------------
+
+def _stored_names(stmts):
+    out = []
+
+    def visit_target(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                visit_target(e)
+        else:
+            raise _Unsafe()
+
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                visit_target(t)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            if not isinstance(s.target, ast.Name):
+                raise _Unsafe()
+            out.append(s.target.id)
+        elif isinstance(s, ast.If):
+            out.extend(_stored_names(s.body))
+            out.extend(_stored_names(s.orelse))
+        else:
+            raise _Unsafe()
+    return out
+
+
+class _Unsafe(Exception):
+    pass
+
+
+class _SafetyCheck(ast.NodeVisitor):
+    """Reject bodies with control-flow escapes or side-effect statements."""
+
+    def check(self, node):
+        try:
+            _stored_names(node.body)      # statement-shape check
+            for stmt in list(node.body) + [node.test]:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, (ast.Break, ast.Continue, ast.Return,
+                                        ast.Yield, ast.YieldFrom, ast.Global,
+                                        ast.Nonlocal, ast.While, ast.For,
+                                        ast.AsyncFor, ast.Try, ast.With,
+                                        ast.NamedExpr)):
+                        return False
+                    if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
+                            isinstance(sub.ctx, (ast.Store, ast.Del)):
+                        return False
+            return not node.orelse
+        except _Unsafe:
+            return False
+
+
+def _loaded_names(expr):
+    return sorted({n.id for n in ast.walk(expr)
+                   if isinstance(n, ast.Name)
+                   and isinstance(n.ctx, ast.Load)})
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class _WhileRewriter(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.rewrote = False
+
+    # do not descend into nested function/class definitions: only the
+    # target function's own loops are rewritten
+    def visit_FunctionDef(self, node):
+        if getattr(self, "_entered", False):
+            return node
+        self._entered = True
+        self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_While(self, node):
+        self.generic_visit(node)     # rewrite inner ifs' loops first
+        if not _SafetyCheck().check(node):
+            return node
+        # loop state = names REBOUND in the body; everything else the
+        # condition/body reads is loop-invariant and resolves through the
+        # nested functions' natural closure over the enclosing frame
+        names = sorted(set(_stored_names(node.body)))
+        if not names:
+            return node
+        n = self.counter
+        self.counter += 1
+        self.rewrote = True
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        state_tuple = ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in names],
+            ctx=ast.Load())
+        cond_def = ast.FunctionDef(
+            name=f"__ptpu_cond_{n}__", args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None, type_comment=None, type_params=[])
+        body_def = ast.FunctionDef(
+            name=f"__ptpu_body_{n}__", args=args,
+            body=list(node.body) + [ast.Return(value=state_tuple)],
+            decorator_list=[], returns=None, type_comment=None,
+            type_params=[])
+        # state snapshot guarded on NameError: an unbound loop var means
+        # the original loop's binding semantics must be kept verbatim
+        snap = ast.Name(id=f"__ptpu_s_{n}__", ctx=ast.Store())
+        try_snap = ast.Try(
+            body=[ast.Assign(targets=[snap], value=state_tuple)],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=f"__ptpu_s_{n}__",
+                                      ctx=ast.Store())],
+                    value=ast.Constant(value=None))])],
+            orelse=[], finalbody=[])
+        call = ast.Call(
+            func=ast.Name(id=_HELPER, ctx=ast.Load()),
+            args=[ast.Name(id=f"__ptpu_cond_{n}__", ctx=ast.Load()),
+                  ast.Name(id=f"__ptpu_body_{n}__", ctx=ast.Load()),
+                  ast.Name(id=f"__ptpu_s_{n}__", ctx=ast.Load())],
+            keywords=[])
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=v, ctx=ast.Store()) for v in names],
+                ctx=ast.Store())],
+            value=call)
+        dispatch = ast.If(
+            test=ast.Compare(
+                left=ast.Name(id=f"__ptpu_s_{n}__", ctx=ast.Load()),
+                ops=[ast.Is()],
+                comparators=[ast.Constant(value=None)]),
+            body=[node],                 # verbatim original loop
+            orelse=[unpack])
+        return [cond_def, body_def, try_snap, dispatch]
+
+
+def rewrite_loops(fn):
+    """Return ``fn`` with safe tensor-dependent whiles auto-rewritten, or
+    ``fn`` unchanged when the source is unavailable / nothing qualifies.
+
+    Controlled by ``FLAGS_jit_auto_while`` (default on)."""
+    from ..core.flags import GLOBAL_FLAGS
+    if not GLOBAL_FLAGS.get("jit_auto_while"):
+        return fn
+    raw_fn = inspect.unwrap(fn)
+    if isinstance(raw_fn, functools.partial):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw_fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    rw = _WhileRewriter()
+    rw.visit(fdef)
+    if not rw.rewrote:
+        return fn
+    fdef.decorator_list = []
+    # strip default expressions (may reference out-of-scope names at exec
+    # time); real default objects are re-attached from the original below
+    fdef.args.defaults = [ast.Constant(value=None)] * \
+        len(fdef.args.defaults)
+    fdef.args.kw_defaults = [ast.Constant(value=None) if d is not None
+                             else None for d in fdef.args.kw_defaults]
+    freevars = raw_fn.__code__.co_freevars
+    if freevars:
+        # factory pattern re-binds the closure by value (snapshot)
+        factory = ast.FunctionDef(
+            name="__ptpu_factory__",
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=v) for v in freevars],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                                  ctx=ast.Load()))],
+            decorator_list=[], returns=None, type_comment=None,
+            type_params=[])
+        mod = ast.Module(body=[factory], type_ignores=[])
+    else:
+        mod = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    glb = raw_fn.__globals__
+    glb.setdefault(_HELPER, auto_while)
+    ns = {}
+    try:
+        exec(compile(mod, f"<ptpu-loop-rewrite {raw_fn.__qualname__}>",
+                     "exec"), glb, ns)
+        if freevars:
+            cells = [c.cell_contents for c in raw_fn.__closure__]
+            new_fn = ns["__ptpu_factory__"](*cells)
+        else:
+            new_fn = ns[fdef.name]
+    except Exception:
+        return fn
+    new_fn.__defaults__ = raw_fn.__defaults__
+    new_fn.__kwdefaults__ = raw_fn.__kwdefaults__
+    functools.update_wrapper(new_fn, raw_fn)
+    new_fn.__ptpu_loop_rewritten__ = True
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn
+
+
+__all__ = ["rewrite_loops", "auto_while"]

@@ -177,3 +177,189 @@ class TestSpecializationCacheBound:
         assert len(rec.loop_sites) == 1
         ((site, count),) = rec.loop_sites.items()
         assert count == 4 and site[0].endswith("test_control_flow.py")
+
+
+_AUTO_TRACES = 0
+
+
+class TestAutoWhileRewrite:
+    """Round-5 (verdict item 3): a PLAIN Python tensor-dependent while
+    loop under to_static compiles once for all trip counts, via the AST
+    loop rewrite (jit/loop_rewrite.py) — no explicit
+    static.nn.while_loop in user code."""
+
+    def test_plain_python_decode_loop_compiles_once(self):
+        global _AUTO_TRACES
+        _AUTO_TRACES = 0
+
+        def decode(buf, n):
+            global _AUTO_TRACES
+            _AUTO_TRACES += 1
+            i = paddle.zeros([], "int32")
+            state = buf
+            while i < n:                       # plain Python while
+                state = state * 2.0 + 1.0
+                i = i + 1
+            return state
+
+        fn = paddle.jit.to_static(decode)
+        buf = paddle.to_tensor(np.ones((2, 3), np.float32))
+
+        out3 = fn(buf, paddle.to_tensor(np.int32(3)))
+        np.testing.assert_allclose(out3.numpy(), np.ones((2, 3)) * 8 + 7,
+                                   rtol=1e-6)
+        out5 = fn(buf, paddle.to_tensor(np.int32(5)))
+        np.testing.assert_allclose(out5.numpy(), np.ones((2, 3)) * 32 + 31,
+                                   rtol=1e-6)
+        out0 = fn(buf, paddle.to_tensor(np.int32(0)))
+        np.testing.assert_allclose(out0.numpy(), np.ones((2, 3)),
+                                   rtol=1e-6)
+        # ONE trace covered every trip count: no graph break, no
+        # per-trip-count value-guard specialization
+        assert _AUTO_TRACES == 1
+        assert not fn._graph_broken
+        assert not fn._guarded
+
+    def test_rewrite_preserves_python_semantics_eagerly(self):
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+        def collatz_steps(x, n):
+            steps = paddle.zeros([], "int32")
+            v = x
+            while v > 1:
+                if int(n) > 0:
+                    pass
+                v = paddle.where(v % 2 == 0, v // 2, 3 * v + 1)
+                steps = steps + 1
+            return steps
+
+        # 'pass' inside if is not in the safe subset -> left verbatim
+        fn = rewrite_loops(collatz_steps)
+        out = fn(paddle.to_tensor(np.int32(6)), paddle.to_tensor(np.int32(1)))
+        assert int(out.numpy()) == 8            # 6 3 10 5 16 8 4 2 1
+
+    def test_break_loop_not_rewritten(self):
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+        def f(x):
+            while x < 100:
+                x = x * 2
+                if x > 10:
+                    break
+            return x
+
+        g = rewrite_loops(f)
+        assert not getattr(g, "__ptpu_loop_rewritten__", False)
+        assert int(g(paddle.to_tensor(np.int32(3))).numpy()) == 12
+
+    def test_closure_function_rewritten(self):
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+        scale = paddle.to_tensor(np.float32(2.0))
+
+        def f(x, n):
+            i = paddle.zeros([], "int32")
+            while i < n:
+                x = x * scale                  # closure read
+                i = i + 1
+            return x
+
+        g = rewrite_loops(f)
+        assert getattr(g, "__ptpu_loop_rewritten__", False)
+        out = g(paddle.to_tensor(np.float32(3.0)),
+                paddle.to_tensor(np.int32(4)))
+        np.testing.assert_allclose(out.numpy(), 48.0, rtol=1e-6)
+
+    def test_grad_requiring_loop_keeps_tape(self):
+        """When gradients flow through the loop state the rewrite must
+        NOT reroute to lax.while_loop (non-differentiable): the Python
+        loop runs and the tape records."""
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+        def f(w, n):
+            i = paddle.zeros([], "int32")
+            y = w
+            while i < n:
+                y = y * 2.0
+                i = i + 1
+            return y
+
+        g = rewrite_loops(f)
+        assert getattr(g, "__ptpu_loop_rewritten__", False)
+        w = paddle.to_tensor(np.float32(1.5))
+        w.stop_gradient = False
+        out = g(w, paddle.to_tensor(np.int32(3)))
+        out.backward()
+        np.testing.assert_allclose(w.grad.numpy(), 8.0, rtol=1e-6)
+
+    def test_shape_variant_loop_falls_back(self):
+        """A growing-buffer loop (concat decode) cannot ride
+        lax.while_loop; the rewrite's runtime falls back to the Python
+        loop, preserving results."""
+
+        def grow(x, n):
+            i = paddle.zeros([], "int32")
+            buf = x
+            while i < n:
+                buf = paddle.concat([buf, x], axis=0)
+                i = i + 1
+            return buf
+
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+        g = rewrite_loops(grow)
+        assert getattr(g, "__ptpu_loop_rewritten__", False)
+        x = paddle.to_tensor(np.ones((1, 2), np.float32))
+        out = g(x, paddle.to_tensor(np.int32(3)))
+        assert list(out.shape) == [4, 2]
+
+    def test_flag_disables_rewrite(self):
+        from paddle_tpu.core.flags import GLOBAL_FLAGS
+        from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+        def f(x, n):
+            i = paddle.zeros([], "int32")
+            while i < n:
+                x = x + 1.0
+                i = i + 1
+            return x
+
+        old = GLOBAL_FLAGS.get("jit_auto_while")
+        try:
+            GLOBAL_FLAGS.set("jit_auto_while", False)
+            assert rewrite_loops(f) is f
+        finally:
+            GLOBAL_FLAGS.set("jit_auto_while", old)
+
+    def test_layer_forward_decode_loop(self):
+        """A Layer whose forward contains the plain loop compiles once
+        through to_static as well."""
+
+        class Decoder(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = paddle.nn.Linear(4, 4)
+
+            def forward(self, x, n):
+                i = paddle.zeros([], "int32")
+                h = x
+                while i < n:
+                    h = paddle.tanh(self.lin(h))
+                    i = i + 1
+                return h
+
+        m = Decoder()
+        m.eval()
+        st = paddle.jit.to_static(m)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        o2 = st(x, paddle.to_tensor(np.int32(2)))
+        o4 = st(x, paddle.to_tensor(np.int32(4)))
+        assert not st.forward._graph_broken and not st.forward._guarded
+        # oracle: eager unrolled
+        ref = x
+        for _ in range(2):
+            ref = paddle.tanh(m.lin(ref))
+        np.testing.assert_allclose(o2.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+        for _ in range(2):
+            ref = paddle.tanh(m.lin(ref))
+        np.testing.assert_allclose(o4.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-5)
