@@ -73,11 +73,14 @@ class Spectrogram(Layer):
                        win_length=win_length, window=window, center=center,
                        pad_mode=pad_mode)
         self.power = power
+        from ..core.dtype import to_jax_dtype
+        self._dtype = to_jax_dtype(dtype)
 
     def forward(self, x):
         spec = stft(x, **self.kw)
         return _apply("spec_power",
-                      lambda s: jnp.abs(s) ** self.power, spec)
+                      lambda s: (jnp.abs(s) ** self.power)
+                      .astype(self._dtype), spec)
 
 
 class MelSpectrogram(Layer):
@@ -87,6 +90,8 @@ class MelSpectrogram(Layer):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
                                        power)
+        from ..core.dtype import to_jax_dtype
+        self._dtype = to_jax_dtype(dtype)
         self.fbank = jnp.asarray(
             compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
                                  htk=htk, norm=norm))
@@ -94,7 +99,8 @@ class MelSpectrogram(Layer):
     def forward(self, x):
         spec = self.spectrogram(x)
         return _apply("mel_project",
-                      lambda s: jnp.einsum("mf,...ft->...mt", self.fbank, s),
+                      lambda s: jnp.einsum("mf,...ft->...mt", self.fbank,
+                                           s).astype(self._dtype),
                       spec)
 
 

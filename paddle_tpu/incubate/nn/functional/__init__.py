@@ -23,7 +23,13 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
 
     Returns (out, residual_out) like the reference when residual is passed,
     else out. bias/residual are pre-norm adds fused by XLA.
+    ``begin_norm_axis`` selects the first normalized dim (the statistic is
+    taken over dims [begin_norm_axis:], like the reference).
     """
+    if quant_scale != -1:
+        raise NotImplementedError(
+            "fused_rms_norm: the fused-quant output tier is served by "
+            "paddle_tpu.quantization on this stack")
     args = [x, norm_weight]
     for t in (bias, residual, norm_bias):
         if t is not None:
@@ -31,12 +37,13 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     return op_call("fused_rms_norm", _fused_rms_norm, *args, epsilon=epsilon,
                    has_bias=bias is not None,
                    has_residual=residual is not None,
-                   has_norm_bias=norm_bias is not None)
+                   has_norm_bias=norm_bias is not None,
+                   begin_norm_axis=int(begin_norm_axis))
 
 
 @op_body("fused_rms_norm")
 def _fused_rms_norm(a, w, *extra, epsilon, has_bias, has_residual,
-                    has_norm_bias):
+                    has_norm_bias, begin_norm_axis=-1):
     i = 0
     b = r = nb = None
     if has_bias:
@@ -50,7 +57,9 @@ def _fused_rms_norm(a, w, *extra, epsilon, has_bias, has_residual,
     if r is not None:
         a = a + r
     res_out = a
-    var = jnp.square(a.astype(jnp.float32)).mean(axis=-1, keepdims=True)
+    bna = begin_norm_axis % a.ndim
+    axes = tuple(range(bna, a.ndim))
+    var = jnp.square(a.astype(jnp.float32)).mean(axis=axes, keepdims=True)
     out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
     if nb is not None:
         out = out + nb
@@ -61,7 +70,9 @@ def _fused_rms_norm(a, w, *extra, epsilon, has_bias, has_residual,
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                      begin_norm_axis=-1, bias=None, residual=None, **_):
-    """fused_layer_norm (reference: incubate/nn/functional/fused_layer_norm.py)."""
+    """fused_layer_norm (reference: incubate/nn/functional/
+    fused_layer_norm.py). ``begin_norm_axis`` selects the first
+    normalized dim (statistics over dims [begin_norm_axis:])."""
     args = [x]
     for t in (bias, residual, norm_weight, norm_bias):
         if t is not None:
@@ -70,12 +81,13 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
                    epsilon=epsilon, has_bias=bias is not None,
                    has_residual=residual is not None,
                    has_norm_weight=norm_weight is not None,
-                   has_norm_bias=norm_bias is not None)
+                   has_norm_bias=norm_bias is not None,
+                   begin_norm_axis=int(begin_norm_axis))
 
 
 @op_body("fused_layer_norm")
 def _fused_layer_norm(a, *extra, epsilon, has_bias, has_residual,
-                      has_norm_weight, has_norm_bias):
+                      has_norm_weight, has_norm_bias, begin_norm_axis=-1):
     i = 0
     b = r = w = nb = None
     if has_bias:
@@ -92,8 +104,9 @@ def _fused_layer_norm(a, *extra, epsilon, has_bias, has_residual,
         a = a + r
     res_out = a
     af = a.astype(jnp.float32)
-    mean = af.mean(axis=-1, keepdims=True)
-    var = jnp.square(af - mean).mean(axis=-1, keepdims=True)
+    axes = tuple(range(begin_norm_axis % a.ndim, a.ndim))
+    mean = af.mean(axis=axes, keepdims=True)
+    var = jnp.square(af - mean).mean(axis=axes, keepdims=True)
     out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
     if w is not None:
         out = out * w
@@ -104,36 +117,107 @@ def _fused_layer_norm(a, *extra, epsilon, has_bias, has_residual,
     return out
 
 
+@op_body("fused_rope_halfstyle")
+def _fused_rope_halfstyle(a, *rest, has_tables, has_pos, base):
+    """use_neox_rotary_style=False: rotate front-half against back-half
+    (the HF-Llama convention; reference fused_rope_kernel.cu's
+    !use_neox branch). a: [b, s, h, d]."""
+    i = 0
+    cos = sin = pos = None
+    if has_tables:
+        cos, sin = rest[0], rest[1]
+        i = 2
+    if has_pos:
+        pos = rest[i]
+    b, s, h, d = a.shape
+    if cos is None:
+        inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        p = (pos.astype(jnp.float32) if pos is not None
+             else jnp.arange(s, dtype=jnp.float32)[None, :])   # [b|1, s]
+        ang = p[..., None] * inv                                # [b|1,s,d/2]
+        cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)
+        sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        if cos.ndim == 2:                                       # [s, d]
+            cos = cos[None, :, None, :]
+            sin = sin[None, :, None, :]
+        if pos is not None:
+            pid = pos.astype(jnp.int32)                         # [b, s]
+            cos = cos[0, :, 0][pid][:, :, None, :]
+            sin = sin[0, :, 0][pid][:, :, None, :]
+    half = d // 2
+    af = a.astype(jnp.float32)
+    rot = jnp.concatenate([-af[..., half:], af[..., :half]], axis=-1)
+    return (af * cos + rot * sin).astype(a.dtype)
+
+
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None,
                                     use_neox_rotary_style=True,
                                     time_major=False, rotary_emb_base=10000.0):
     """Reference: incubate/nn/functional/fused_rotary_position_embedding.py.
 
-    q/k/v: [batch, seq, heads, head_dim]. Applies RoPE to each non-None
-    input; returns a 3-tuple mirroring the reference.
+    q/k/v: [batch, seq, heads, head_dim] (or [seq, batch, ...] with
+    ``time_major=True``). ``use_neox_rotary_style=True`` rotates adjacent
+    lane pairs; ``False`` rotates the front half against the back half.
+    Applies RoPE to each non-None input; returns a 3-tuple mirroring the
+    reference.
     """
+    from ....tensor.manipulation import transpose as _transpose
+
+    def pre(x):
+        if x is None or not time_major:
+            return x
+        return _transpose(x, [1, 0, 2, 3])
+
+    post = pre          # the transpose is its own inverse
+
     def rope_one(x):
         if x is None:
             return None
+        if not use_neox_rotary_style:
+            args = [x]
+            if cos is not None:
+                args += [cos, sin]
+            if position_ids is not None:
+                args.append(position_ids)
+            return op_call("fused_rope_halfstyle", _fused_rope_halfstyle,
+                           *args, has_tables=cos is not None,
+                           has_pos=position_ids is not None,
+                           base=float(rotary_emb_base))
         if cos is not None:
-            # reference passes [1, s, 1, d] tables with duplicated halves
-            c2, s2 = cos, sin
-            out = F.rope(x, x, cos=_half_table(c2), sin=_half_table(s2),
-                         theta=rotary_emb_base)[0]
+            # reference passes [1, s, 1, d] tables with duplicated halves;
+            # gather rows per position_ids when given
+            c2, s2 = _half_table(cos), _half_table(sin)
+            if position_ids is not None:
+                c2 = _gather_rows(c2, position_ids)
+                s2 = _gather_rows(s2, position_ids)
+            out = F.rope(x, x, cos=c2, sin=s2, theta=rotary_emb_base)[0]
         else:
             out = F.rope(x, x, position_ids=position_ids,
                          theta=rotary_emb_base)[0]
         return out
 
     def _half_table(t):
-        # [1, s, 1, d] or [1, s, d] -> [1, s, d/2] (even lanes)
+        # [1, s, 1, d] or [s, d] -> [1, s, d/2] (even lanes)
         tt = t
+        if tt.ndim == 2:
+            tt = tt.reshape((1,) + tuple(tt.shape))
         if tt.ndim == 4:
             tt = tt.reshape(tt.shape[0], tt.shape[1], tt.shape[3])
         return tt[..., ::2]
 
-    return rope_one(q), rope_one(k), rope_one(v)
+    def _gather_rows(tab, pid):
+        # tab [1, s, d/2], pid [b, s'] -> [b, s', d/2]
+        from ....core.tensor import Tensor
+        t = tab._data if isinstance(tab, Tensor) else jnp.asarray(tab)
+        p = pid._data if isinstance(pid, Tensor) else jnp.asarray(pid)
+        return Tensor(t[0][p.astype(jnp.int32)])
+
+    q2, k2, v2 = (pre(t) for t in (q, k, v))
+    return post(rope_one(q2)), post(rope_one(k2)), post(rope_one(v2))
 
 
 def swiglu(x, y=None, name=None):
@@ -145,6 +229,11 @@ def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
                    act_method="gelu", **_):
     """Reference: incubate/nn/functional/fused_bias_act.py (quant paths
     descoped; see paddle_tpu.quantization for the quant tier)."""
+    if dequant_scales is not None or shift is not None or smooth is not None:
+        raise NotImplementedError(
+            "fused_bias_act: dequant/shift/smooth belong to the int8 "
+            "serving tier — served by paddle_tpu.quantization on this "
+            "stack")
     if act_method not in ("gelu", "relu", "silu", "swiglu"):
         raise KeyError(act_method)
     args = (x,) if bias is None else (x, bias)
@@ -231,6 +320,8 @@ def _weight_dequantize(q, s):
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise ValueError(f"unsupported weight_dequantize algo {algo!r}")
     return op_call("weight_dequantize", _weight_dequantize, x, scale)
 
 

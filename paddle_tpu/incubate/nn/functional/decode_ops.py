@@ -25,7 +25,9 @@ def _raw(t):
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
     """reference: blha_get_max_len.py:26 — max encoder/decoder lengths
-    this step (host scalars for kernel grid sizing)."""
+    this step (host scalars for kernel grid sizing). ``batch_size`` is
+    the reference kernel's grid-sizing operand, accepted for parity; the
+    reductions here don't need it."""
     enc = _raw(seq_lens_encoder)
     dec = _raw(seq_lens_decoder)
     return (Tensor(jnp.max(enc).astype(jnp.int32).reshape(1)),
@@ -96,7 +98,8 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     zero-initialized cache and assumes no legitimately all-zero key
     vector has been written; pass ``sequence_lengths`` explicitly
     whenever either assumption may not hold."""
-    if qkv_out_scale is not None or out_scale != -1:
+    if qkv_out_scale is not None or out_scale != -1 \
+            or out_shift is not None or out_smooth is not None:
         raise NotImplementedError(
             "masked_multihead_attention: quant path not supported "
             "(serve int8 via paddle.quantization)")
@@ -265,6 +268,10 @@ def moe_dispatch(x, gating_output, moe_topk, group_moe=False,
     Returns (permute_input [T*k, d] expert-major, token_nums_per_expert
     [E], permute_indices_per_token [T, k] (row in permute_input),
     expert_scales_float [T, k, 1, 1], top_k_indices [T, k])."""
+    if group_moe:
+        raise NotImplementedError(
+            "moe_dispatch: group_moe routing is served by the EP-sharded "
+            "MoELayer (incubate.distributed.models.moe) on this stack")
     xv = _raw(x)
     gate = _raw(gating_output).astype(jnp.float32)
     t, d = xv.shape
@@ -290,7 +297,8 @@ def moe_ffn(permute_input, token_nums_per_expert, ffn1_weight, ffn2_weight,
     rows are expert-major; expert e processes rows
     [cum[e], cum[e+1]). Paired activation (silu(u) * g) as in
     fused_moe."""
-    if str(quant_method) != "None":
+    if str(quant_method) != "None" or ffn1_scale is not None \
+            or ffn2_scale is not None:
         raise NotImplementedError("moe_ffn: quant_method unsupported "
                                   "(reference: 'Currently not supported')")
     rows = _raw(permute_input)

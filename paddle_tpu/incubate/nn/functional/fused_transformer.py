@@ -64,6 +64,24 @@ def _keys(n):
     return jax.random.split(_random.next_key(), n)
 
 
+def _resolve_tp_reduce(ring_id):
+    """Map the reference's ``ring_id`` to a raw-array sum-allreduce over
+    that communication group (None when no parallel env is active). The
+    reducer is applied to row-parallel PARTIAL products inside op bodies —
+    lax.psum under shard_map, host exchange in the eager mp regime."""
+    if ring_id is None or ring_id < 0:
+        return None
+    from ....distributed import collective as C
+    if not C.is_initialized():
+        return None
+    try:
+        from ....distributed.communication import get_group
+        grp = get_group(ring_id)
+    except (ValueError, ImportError):
+        grp = None
+    return lambda a, _g=grp: C.raw_all_reduce_sum(a, _g)
+
+
 # ---------------------------------------------------------------------------
 # fused_feedforward (reference fused_transformer.py:47)
 # ---------------------------------------------------------------------------
@@ -77,11 +95,13 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                       name=None):
     """residual + dropout2(linear2(dropout1(act(linear1(maybe_ln(x))))))
     with post-LN when ``pre_layer_norm`` is False — the reference's exact
-    pseudo-code (fused_transformer.py:73-87)."""
+    pseudo-code (fused_transformer.py:73-87). ``ring_id``: tensor-parallel
+    allreduce of the linear2 PARTIAL product (before bias/dropout/
+    residual/post-LN, the reference's c_allreduce_sum placement)."""
     k1, k2 = _keys(2)
 
     def _body(x, w1, w2, b1, b2, s1, bb1, s2, bb2, k1, k2, *, p1, p2, act,
-              e1, e2, pre, training, mode, add_residual):
+              e1, e2, pre, training, mode, add_residual, tp_reduce):
         residual = x
         out = _ln(x, s1, bb1, e1) if pre else x
         out = out @ w1
@@ -89,6 +109,8 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
             out = out + b1
         out = _dropout(_act(act)(out), p1, training, mode, k1)
         out = out @ w2
+        if tp_reduce is not None:
+            out = tp_reduce(out)
         if b2 is not None:
             out = out + b2
         out = _dropout(out, p2, training, mode, k2)
@@ -105,7 +127,8 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
                    act=activation, e1=float(ln1_epsilon),
                    e2=float(ln2_epsilon), pre=bool(pre_layer_norm),
                    training=bool(training), mode=mode,
-                   add_residual=bool(add_residual))
+                   add_residual=bool(add_residual),
+                   tp_reduce=_resolve_tp_reduce(ring_id))
 
 
 # ---------------------------------------------------------------------------
@@ -230,19 +253,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = _ln(out, ln_s, ln_b, e_post)
         return out if cache is None else (out, cache_out)
 
-    tp_reduce = None
-    if ring_id >= 0:
-        from ....distributed import collective as C
-        if C.is_initialized():
-            # resolve ring_id to its group so a shard_map-bound axis_name
-            # reaches the differentiable lax.psum branch; unknown ids fall
-            # back to the default (global) group
-            try:
-                from ....distributed.communication import get_group
-                grp = get_group(ring_id)
-            except (ValueError, ImportError):
-                grp = None
-            tp_reduce = (lambda a, _g=grp: C.raw_all_reduce_sum(a, _g))
+    tp_reduce = _resolve_tp_reduce(ring_id)
     return op_call("fused_multi_head_attention", _body, x, qkv_weight,
                    linear_weight, pre_ln_scale, pre_ln_bias, ln_scale,
                    ln_bias, qkv_bias, linear_bias, cache_kv, attn_mask,
@@ -274,9 +285,14 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     scale. quant_method != "None" is not supported (matches the
     reference's current state).
     """
-    if str(quant_method) != "None":
+    if str(quant_method) != "None" or ffn1_scale is not None \
+            or ffn2_scale is not None:
         raise NotImplementedError("fused_moe: quant_method is unsupported "
                                   "(reference: 'Currently not supported')")
+    if group_moe:
+        raise NotImplementedError(
+            "fused_moe: group_moe routing is served by the EP-sharded "
+            "MoELayer (incubate.distributed.models.moe) on this stack")
 
     def _body(x, gate, w1, w2, b1, b2, *, topk, norm_prob):
         b, s, d = x.shape
@@ -412,6 +428,7 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
     num_layers = len(qkv_weights)
     keys = _keys(max(2 * num_layers, 1))
     act = _act(activation)
+    tp_reduce = _resolve_tp_reduce(ring_id)
     norm = (lambda t, s, b: _rms(t, s, float(epsilon))) \
         if norm_type == "rmsnorm" else \
         (lambda t, s, b: _ln(t, s, b, float(epsilon)))
@@ -468,6 +485,9 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
         ctx = jax.nn.softmax(scores, axis=-1) @ v
         ctx = jnp.swapaxes(ctx, 1, 2).reshape(b, s, -1)
         out = ctx @ linear_weights[i]
+        if tp_reduce is not None:
+            # TP: reduce the out-projection partial before bias/residual
+            out = tp_reduce(out)
         if linear_biases and _opt(linear_biases, i) is not None:
             out = out + _opt(linear_biases, i)
         out = _dropout(out, float(dropout_rate), training, mode,
@@ -483,6 +503,9 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             out = out + _opt(ffn1_biases, i)
         out = act(out)
         out = out @ ffn2_weights[i]
+        if tp_reduce is not None:
+            # TP: reduce the ffn2 partial before bias/residual
+            out = tp_reduce(out)
         if ffn2_biases and _opt(ffn2_biases, i) is not None:
             out = out + _opt(ffn2_biases, i)
         out = _dropout(out, float(dropout_rate), training, mode,

@@ -29,10 +29,12 @@ is left verbatim — never a behavior change, only a missed optimization):
   statements (those exist only for side effects), no nested loops, no
   ``global``/``nonlocal``.
 
-Loop state = every name stored in the body plus every name read by the
-condition. If any of them is unbound when the loop is reached, the
-generated code falls back to the verbatim original loop (kept as a
-sibling branch), preserving NameError/first-iteration-binds semantics.
+Loop state = every name STORED in the body (condition/body reads of
+other names resolve through the nested functions' closure over the
+enclosing frame). If any state name is unbound when the loop is
+reached, the generated code falls back to the verbatim original loop
+(kept as a sibling branch), preserving NameError/first-iteration-binds
+semantics.
 """
 from __future__ import annotations
 
@@ -49,6 +51,47 @@ _HELPER = "__ptpu_auto_while__"
 # runtime helper
 # ---------------------------------------------------------------------------
 
+def _grads_may_flow(state, cond_fn, body_fn):
+    """True when taking the non-differentiable lax path could sever a
+    gradient: any grad-requiring Tensor in the loop state OR reachable
+    through the cond/body closures (a Layer's parameters, a captured
+    weight). Unknown closure objects count as unsafe — the Python-loop
+    fallback is always semantically correct."""
+    from ..core.tensor import Tensor
+
+    def tensor_unsafe(v):
+        return isinstance(v, Tensor) and not v.stop_gradient
+
+    if any(tensor_unsafe(v) for v in state):
+        return True
+    import types as _types
+    inert = (bool, int, float, complex, str, bytes, type(None),
+             _types.ModuleType, _types.FunctionType,
+             _types.BuiltinFunctionType, type)
+    for fn in (cond_fn, body_fn):
+        for cell in (fn.__closure__ or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, inert):
+                continue
+            if isinstance(v, Tensor):
+                if tensor_unsafe(v):
+                    return True
+                continue
+            params = getattr(v, "parameters", None)
+            if callable(params):
+                try:
+                    if any(tensor_unsafe(p) for p in v.parameters()):
+                        return True
+                    continue
+                except Exception:
+                    return True
+            return True          # opaque closure object: assume unsafe
+    return False
+
+
 def auto_while(cond_fn, body_fn, state):
     """Run a rewritten while loop; compile-once when safely possible."""
     from ..core import autograd as _ag
@@ -56,12 +99,13 @@ def auto_while(cond_fn, body_fn, state):
 
     c = cond_fn(*state)
     if isinstance(c, Tensor):
-        grads_flow = _ag.is_grad_enabled() and any(
-            isinstance(v, Tensor) and not v.stop_gradient for v in state)
+        grads_flow = _ag.is_grad_enabled() and \
+            _grads_may_flow(state, cond_fn, body_fn)
         if not grads_flow:
             carriable = all(
                 isinstance(v, (Tensor, bool, int, float)) for v in state)
             if carriable:
+                import jax
                 import jax.numpy as jnp
                 canon = [v if isinstance(v, Tensor)
                          else Tensor(jnp.asarray(v)) for v in state]
@@ -69,12 +113,26 @@ def auto_while(cond_fn, body_fn, state):
                 try:
                     out = while_loop(lambda *s: cond_fn(*s),
                                      lambda *s: list(body_fn(*s)), canon)
-                    return tuple(out)
                 except (ValueError, TypeError):
                     # shape/dtype-variant loop state (e.g. a growing
                     # decode buffer): not lax-compilable — fall through
                     # to the Python loop, the pre-rewrite behavior
                     pass
+                else:
+                    # restore Python scalar types for state entries we
+                    # canonicalized, when concrete (eager) — the loop
+                    # must not change a local's type; under trace they
+                    # stay Tensors (inherent: the value is now
+                    # data-dependent)
+                    res = []
+                    for orig, o in zip(state, out):
+                        if not isinstance(orig, Tensor) and \
+                                isinstance(o, Tensor) and \
+                                not isinstance(o._data, jax.core.Tracer):
+                            res.append(type(orig)(o._data.item()))
+                        else:
+                            res.append(o)
+                    return tuple(res)
     # plain-Python semantics: bool(c) routes through the SOT-lite guard
     # hook under capture, exactly like the original loop did
     while c:
@@ -139,12 +197,6 @@ class _SafetyCheck(ast.NodeVisitor):
             return not node.orelse
         except _Unsafe:
             return False
-
-
-def _loaded_names(expr):
-    return sorted({n.id for n in ast.walk(expr)
-                   if isinstance(n, ast.Name)
-                   and isinstance(n.ctx, ast.Load)})
 
 
 # ---------------------------------------------------------------------------

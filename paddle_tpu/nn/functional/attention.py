@@ -93,7 +93,9 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
 
     ``return_softmax=True`` computes out and probs in ONE pass through the
     reference body (probs are the post-dropout weights the output actually
-    used), bypassing any registered fast-path kernel for this debug mode."""
+    used), bypassing any registered fast-path kernel for this debug mode.
+    ``fixed_seed_offset``/``rng_name`` are CUDA dropout-RNG plumbing,
+    accepted for parity; dropout keys come from the global JAX stream."""
     if return_softmax:
         from ...core import random as _rng
         p = dropout if training else 0.0
@@ -204,9 +206,23 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     reference's CUDA varlen kernel avoids materializing cross-segment
     scores; on TPU a Pallas variant can reuse kernels/flash_attention's
     block engine with a per-block segment check when profiles demand it.)
+    ``max_seqlen_q``/``max_seqlen_k`` are the reference kernel's grid
+    sizing hints — validated when given, not needed by the XLA lowering.
     """
     if dropout:
         raise NotImplementedError("flash_attn_unpadded: dropout TODO")
+    for nm, mx, cu in (("max_seqlen_q", max_seqlen_q, cu_seqlens_q),
+                       ("max_seqlen_k", max_seqlen_k, cu_seqlens_k)):
+        if mx is not None:
+            cu_arr = cu._data if hasattr(cu, "_data") else cu
+            if isinstance(cu_arr, jax.core.Tracer):
+                continue          # traced lengths: nothing to check
+            import numpy as _np
+            lens = _np.diff(_np.asarray(cu_arr))
+            if lens.size and int(lens.max()) > int(mx):
+                raise ValueError(
+                    f"{nm}={int(mx)} is smaller than the longest packed "
+                    f"sequence ({int(lens.max())})")
     return op_call("flash_attn_unpadded", _flash_attn_unpadded,
                    query, key, value, cu_seqlens_q, cu_seqlens_k,
                    scale=scale, causal=bool(causal),
@@ -356,7 +372,8 @@ def sparse_attention(query, key, value, sparse_csr_offset,
 
 
 @op_body("flashmask_attention")
-def _flashmask_attention(q, k, v, startend, *, causal):
+def _flashmask_attention(q, k, v, startend, *, causal, dropout_p=0.0,
+                         dropout_key=None):
     # FlashMask column-compressed mask -> dense bool mask -> SDPA.
     # startend: [bs, kv_heads(1 ok), seq_k, {1, 2, 4}]
     # causal 1: mask rows >= LTS (below the start, lower triangle)
@@ -390,6 +407,9 @@ def _flashmask_attention(q, k, v, startend, *, causal):
     neg = jnp.asarray(jnp.finfo(jnp.float32).min, logits.dtype)
     logits = jnp.where(masked, neg, logits)
     p = jax.nn.softmax(logits, -1)
+    if dropout_p and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p, 0).astype(p.dtype) / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
@@ -403,10 +423,18 @@ def flashmask_attention(query, key, value, startend_row_indices,
     as start/end row indices per key column. Dense-mask expansion over
     SDPA here; the XLA fusion keeps it on the MXU (a Pallas flash kernel
     with on-the-fly mask decode is the perf upgrade path). Layout:
-    [batch, seq, heads, head_dim]."""
+    [batch, seq, heads, head_dim]. ``fixed_seed_offset``/``rng_name``
+    are CUDA RNG plumbing, accepted for parity; dropout keys come from
+    the global JAX stream here."""
     if window_size is not None:
         raise NotImplementedError("flashmask window_size")
     if return_softmax_lse or return_seed_offset:
         raise NotImplementedError("flashmask aux returns")
+    p = float(dropout) if training else 0.0
+    dk = None
+    if p > 0.0:
+        from ...core import random as _rng
+        dk = _rng.next_key()
     return op_call("flashmask_attention", _flashmask_attention, query, key,
-                   value, startend_row_indices, causal=bool(causal))
+                   value, startend_row_indices, causal=bool(causal),
+                   dropout_p=p, dropout_key=dk)

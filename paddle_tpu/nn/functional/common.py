@@ -119,7 +119,8 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 
 @op_body("interpolate")
-def _interpolate(a, *, size, scale_factor, mode, channel_last):
+def _interpolate(a, *, size, scale_factor, mode, channel_last,
+                 align_corners=False, align_mode=0):
     nd = a.ndim - 2
     spatial = a.shape[1:-1] if channel_last else a.shape[2:]
     if size is not None:
@@ -127,6 +128,32 @@ def _interpolate(a, *, size, scale_factor, mode, channel_last):
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
         tgt = tuple(int(round(s * float(f))) for s, f in zip(spatial, sf))
+    linear_family = mode in ("linear", "bilinear", "trilinear")
+    if (align_corners or align_mode == 1) and linear_family:
+        # reference coordinate maps (interpolate_kernel source-index
+        # functions): align_corners -> src = dst*(in-1)/(out-1);
+        # align_mode 1 (asymmetric) -> src = dst*in/out. jax.image.resize
+        # only speaks half-pixel, so gather per-axis linear directly.
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        for d in range(nd):
+            in_sz, out_sz = a.shape[2 + d], tgt[d]
+            i = jnp.arange(out_sz, dtype=jnp.float32)
+            if align_corners:
+                src = i * ((in_sz - 1) / max(out_sz - 1, 1))
+            else:
+                src = jnp.clip(i * (in_sz / out_sz), 0, in_sz - 1)
+            lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_sz - 1)
+            hi = jnp.clip(lo + 1, 0, in_sz - 1)
+            w = (src - lo).astype(a.dtype)
+            shape = [1] * a.ndim
+            shape[2 + d] = out_sz
+            w = w.reshape(shape)
+            a = jnp.take(a, lo, axis=2 + d) * (1 - w) + \
+                jnp.take(a, hi, axis=2 + d) * w
+        if channel_last:
+            a = jnp.moveaxis(a, 1, -1)
+        return a
     jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
     if channel_last:
@@ -139,6 +166,15 @@ def _interpolate(a, *, size, scale_factor, mode, channel_last):
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None):
     channel_last = not data_format.startswith("NC")
+    if align_corners and mode in ("nearest", "area"):
+        raise ValueError(
+            f"align_corners does not apply to mode={mode!r} (reference "
+            "interpolate rejects this combination)")
+    if align_corners and mode == "bicubic":
+        raise NotImplementedError(
+            "interpolate: bicubic with align_corners=True is not "
+            "implemented on this stack — use align_corners=False "
+            "(half-pixel) or a linear mode")
     if size is not None:
         size = tuple(int(s.item()) if isinstance(s, Tensor) else int(s)
                      for s in (size if isinstance(size, (list, tuple)) else [size]))
@@ -146,7 +182,9 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     if isinstance(sf, (list, tuple)):
         sf = tuple(float(f) for f in sf)
     return op_call("interpolate", _interpolate, x, size=size,
-                   scale_factor=sf, mode=mode, channel_last=channel_last)
+                   scale_factor=sf, mode=mode, channel_last=channel_last,
+                   align_corners=bool(align_corners),
+                   align_mode=int(align_mode))
 
 
 upsample = interpolate
@@ -173,7 +211,12 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
 
 
 @op_body("pixel_unshuffle")
-def _pixel_unshuffle(a, *, r):
+def _pixel_unshuffle(a, *, r, data_format):
+    if data_format == "NHWC":
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, h // r, w // r, c * r * r)
     n, c, h, w = a.shape
     a = a.reshape(n, c, h // r, r, w // r, r)
     a = a.transpose(0, 1, 3, 5, 2, 4)
@@ -181,17 +224,23 @@ def _pixel_unshuffle(a, *, r):
 
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
-    return op_call("pixel_unshuffle", _pixel_unshuffle, x, r=downscale_factor)
+    return op_call("pixel_unshuffle", _pixel_unshuffle, x,
+                   r=downscale_factor, data_format=data_format)
 
 
 @op_body("channel_shuffle")
-def _channel_shuffle(a, *, groups):
+def _channel_shuffle(a, *, groups, data_format):
+    if data_format == "NHWC":
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups) \
+                .swapaxes(-1, -2).reshape(n, h, w, c)
     n, c, h, w = a.shape
     return a.reshape(n, groups, c // groups, h, w).transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
 
 
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
-    return op_call("channel_shuffle", _channel_shuffle, x, groups=groups)
+    return op_call("channel_shuffle", _channel_shuffle, x, groups=groups,
+                   data_format=data_format)
 
 
 @op_body("cosine_similarity")

@@ -72,6 +72,9 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
                                numeric_stable_mode=True, return_softmax=False, axis=-1):
+    """``numeric_stable_mode`` is accepted for parity and has no effect:
+    the lowering is always the stable log-sum-exp form (the reference flag
+    selects between its two CUDA kernels)."""
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none", axis=axis)
     if return_softmax:
@@ -273,7 +276,8 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 
 @op_body("ctc_loss")
-def _ctc_loss(lp, lbl, in_len, lbl_len, *, blank, reduction):
+def _ctc_loss(lp, lbl, in_len, lbl_len, *, blank, reduction,
+              norm_by_times=False):
     """CTC via the dynamic-programming forward algorithm in pure lax
     (reference: paddle/phi/kernels/gpu/warpctc_kernel.cu → here an XLA scan)."""
     import jax.lax as lax
@@ -311,6 +315,10 @@ def _ctc_loss(lp, lbl, in_len, lbl_len, *, blank, reduction):
         jnp.take_along_axis(final, end1[:, None], axis=1)[:, 0],
         jnp.take_along_axis(final, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0])
     loss = -ll
+    if norm_by_times:
+        # reference warpctc norm_by_times: scale each sequence's loss by
+        # its number of time steps
+        loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1)
     if reduction == "mean":
         return (loss / jnp.maximum(lbl_len, 1)).mean()
     return _reduce_arr(loss, reduction)
@@ -319,7 +327,8 @@ def _ctc_loss(lp, lbl, in_len, lbl_len, *, blank, reduction):
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     return op_call("ctc_loss", _ctc_loss, log_probs, labels, input_lengths,
-                   label_lengths, blank=blank, reduction=reduction)
+                   label_lengths, blank=blank, reduction=reduction,
+                   norm_by_times=bool(norm_by_times))
 
 
 @op_body("fused_linear_cross_entropy")

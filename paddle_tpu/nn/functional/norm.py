@@ -151,10 +151,24 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
 
 
 @op_body("instance_norm")
-def _instance_norm(a, *wb, eps, has_weight, has_bias):
-    axes = tuple(range(2, a.ndim))
-    mean = a.mean(axis=axes, keepdims=True)
-    var = a.var(axis=axes, keepdims=True)
+def _instance_norm(a, *wb, eps, has_weight, has_bias, channel_last=False,
+                   has_running=False):
+    if channel_last:
+        a = jnp.moveaxis(a, -1, 1)
+    wb = list(wb)
+    if has_running:
+        # normalize with the provided per-channel running statistics
+        # (use_input_stats=False; reference instance_norm_kernel's
+        # global-stats branch)
+        rm, rv = wb[0], wb[1]
+        wb = wb[2:]
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        mean = rm.reshape(shape)
+        var = rv.reshape(shape)
+    else:
+        axes = tuple(range(2, a.ndim))
+        mean = a.mean(axis=axes, keepdims=True)
+        var = a.var(axis=axes, keepdims=True)
     out = (a - mean) / jnp.sqrt(var + eps)
     shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
     i = 0
@@ -163,15 +177,57 @@ def _instance_norm(a, *wb, eps, has_weight, has_bias):
         i += 1
     if has_bias:
         out = out + wb[i].reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
     return out
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
                   use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
                   name=None):
+    """Instance normalization (reference: nn/functional/norm.py
+    instance_norm). ``use_input_stats=False`` normalizes with the given
+    running statistics; ``True`` with per-instance batch statistics,
+    updating the running buffers in place when provided
+    (running = momentum*running + (1-momentum)*batch, like batch_norm)."""
+    channel_last = not data_format.startswith("NC")
+    if not use_input_stats:
+        if running_mean is None or running_var is None:
+            raise ValueError(
+                "instance_norm: use_input_stats=False requires "
+                "running_mean and running_var")
+        args = [x, running_mean, running_var] + \
+            [t for t in (weight, bias) if t is not None]
+        return op_call("instance_norm", _instance_norm, *args, eps=eps,
+                       has_weight=weight is not None,
+                       has_bias=bias is not None,
+                       channel_last=channel_last, has_running=True)
+    if running_mean is not None and running_var is not None:
+        # running stats are the batch-average of each PER-INSTANCE
+        # mean/variance over the spatial dims (not pooled (N, spatial)
+        # statistics — two offset constant instances must contribute ~0
+        # variance), with the unbiased spatial-count correction the
+        # batch_norm update above applies
+        ca = (x.ndim - 1) if channel_last else 1
+        spatial = tuple(i for i in range(x.ndim) if i not in (0, ca))
+        from ...tensor.math import mean as _mean
+        from ...tensor.stat import var as _var_op
+        inst_mean = _mean(x, axis=list(spatial))          # [N, C]
+        inst_var = _var_op(x, axis=list(spatial), unbiased=False)
+        n_sp = 1
+        for i in spatial:
+            n_sp *= x.shape[i]
+        batch_mean = inst_mean._data.mean(axis=0)
+        batch_var = inst_var._data.mean(axis=0) * \
+            (n_sp / max(n_sp - 1, 1))
+        running_mean._inplace_update(
+            momentum * running_mean._data + (1 - momentum) * batch_mean)
+        running_var._inplace_update(
+            momentum * running_var._data + (1 - momentum) * batch_var)
     args = [x] + [t for t in (weight, bias) if t is not None]
     return op_call("instance_norm", _instance_norm, *args, eps=eps,
-                   has_weight=weight is not None, has_bias=bias is not None)
+                   has_weight=weight is not None, has_bias=bias is not None,
+                   channel_last=channel_last)
 
 
 @op_body("normalize")

@@ -20,7 +20,8 @@ def _register_nd(base, body):
     return body
 
 
-def _window(kernel, stride, padding, nd, channel_last):
+def _window(kernel, stride, padding, nd, channel_last, ceil_mode=False,
+            in_sizes=None):
     k = _pair(kernel, nd)
     s = _pair(stride if stride is not None else kernel, nd)
     if isinstance(padding, str):
@@ -33,6 +34,19 @@ def _window(kernel, stride, padding, nd, channel_last):
             pad = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
         else:
             pad = [(0, 0)] * nd
+        if ceil_mode and in_sizes is not None:
+            # extend the right pad so the window count is
+            # ceil((L + p0 + p1 - k)/s) + 1 (reference ceil_mode=True);
+            # reduce_window pads with the reduction identity, so the
+            # extra cells never win a max and count as zeros in sums
+            new_pad = []
+            for d in range(nd):
+                span = in_sizes[d] + pad[d][0] + pad[d][1] - k[d]
+                out_ceil = -(-span // s[d]) + 1
+                extra = max(0, (out_ceil - 1) * s[d] + k[d]
+                            - (in_sizes[d] + pad[d][0] + pad[d][1]))
+                new_pad.append((pad[d][0], pad[d][1] + extra))
+            pad = new_pad
     if channel_last:
         dims = (1,) + k + (1,)
         strides = (1,) + s + (1,)
@@ -54,15 +68,17 @@ _register_nd("max_pool", _max_pool_body)
 
 
 def _max_pool_mask_body(a, *, nd, k, s, p):
+    # p: per-dim (lo, hi) pad pairs — hi may exceed lo under ceil_mode
     n, c = a.shape[:2]
-    # pad explicitly with the dtype minimum so argmax can NEVER
-    # select a padded cell (dilated_patches pads with 0, which
-    # outranks all-negative windows)
-    fill = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+    spatial = a.shape[2:]
+    # pad explicitly with the FINITE dtype minimum so argmax can never
+    # select a padded cell (dilated_patches pads with 0, which outranks
+    # all-negative windows; -inf would turn the one-hot conv into NaN
+    # via 0 * -inf)
+    fill = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) \
         else jnp.iinfo(a.dtype).min
-    a = jnp.pad(a, [(0, 0), (0, 0)] + [(p[d], p[d]) for d in range(nd)],
+    a = jnp.pad(a, [(0, 0), (0, 0)] + [tuple(p[d]) for d in range(nd)],
                 constant_values=fill)
-    spatial = tuple(a.shape[2 + d] - 2 * p[d] for d in range(nd))
     patches = lax.conv_general_dilated_patches(
         a, filter_shape=k, window_strides=s,
         padding=[(0, 0)] * nd,
@@ -79,7 +95,7 @@ def _max_pool_mask_body(a, *, nd, k, s, p):
         shape = [1] * (2 + nd)
         shape[2 + d] = out_sp[d]
         oi = jnp.arange(out_sp[d]).reshape(shape)
-        g = oi * s[d] - p[d] + locals_nd[d]
+        g = oi * s[d] - p[d][0] + locals_nd[d]
         flat = flat * spatial[d] + g
     return flat.astype(jnp.int32)
 
@@ -88,9 +104,16 @@ for _nd in (1, 2, 3):
     OPS.setdefault(f"max_pool{_nd}d_mask", _max_pool_mask_body)
 
 
+def _spatial_sizes(x, nd, channel_last):
+    shape = x.shape
+    return tuple(int(shape[1 + d] if channel_last else shape[2 + d])
+                 for d in range(nd))
+
+
 def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ceil_mode=False):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    dims, strides, pad, _ = _window(kernel, stride, padding, nd, channel_last)
+    dims, strides, pad, _ = _window(kernel, stride, padding, nd, channel_last,
+                                    ceil_mode, _spatial_sizes(x, nd, channel_last))
 
     out = op_call(f"max_pool{nd}d", _max_pool_body, x, dims=dims,
                   strides=strides,
@@ -105,15 +128,21 @@ def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ce
                 "explicit pad amounts")
         k = _pair(kernel, nd)
         s = _pair(stride if stride is not None else kernel, nd)
-        p = _pair(padding, nd)
+        # the spatial (lo, hi) pairs from _window carry the ceil_mode
+        # right-extension, so out and mask always agree on output shape
+        p_pairs = tuple(tuple(pr) for pr in pad[2:])
         mask = op_call(f"max_pool{nd}d_mask", _max_pool_mask_body, x,
-                       nd=nd, k=k, s=s, p=p)
+                       nd=nd, k=k, s=s, p=p_pairs)
         return out, mask
     return out
 
 
-def _avg_pool_body(a, *, dims, strides, pad, k, exclusive):
+def _avg_pool_body(a, *, dims, strides, pad, k, exclusive, divisor=None):
     summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad)
+    if divisor is not None:
+        # reference avg_pool divisor_override: the fixed divisor replaces
+        # both the window size and the exclusive count
+        return summed / float(divisor)
     if exclusive or isinstance(pad, str):
         ones = jnp.ones_like(a)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
@@ -124,13 +153,19 @@ def _avg_pool_body(a, *, dims, strides, pad, k, exclusive):
 _register_nd("avg_pool", _avg_pool_body)
 
 
-def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True, ceil_mode=False):
+def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True,
+              ceil_mode=False, divisor_override=None):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    dims, strides, pad, k = _window(kernel, stride, padding, nd, channel_last)
+    dims, strides, pad, k = _window(kernel, stride, padding, nd, channel_last,
+                                    ceil_mode, _spatial_sizes(x, nd, channel_last))
+    if divisor_override is not None and float(divisor_override) == 0:
+        raise ValueError("divisor_override must be nonzero")
     return op_call(f"avg_pool{nd}d", _avg_pool_body, x, dims=dims,
                    strides=strides,
                    pad=pad if isinstance(pad, str) else tuple(pad), k=k,
-                   exclusive=bool(exclusive))
+                   exclusive=bool(exclusive),
+                   divisor=None if divisor_override is None
+                   else float(divisor_override))
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -155,12 +190,14 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    return _avg_pool(x, kernel_size, stride, padding, 2, data_format, exclusive, ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 2, data_format,
+                     exclusive, ceil_mode, divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
-    return _avg_pool(x, kernel_size, stride, padding, 3, data_format, exclusive, ceil_mode)
+    return _avg_pool(x, kernel_size, stride, padding, 3, data_format,
+                     exclusive, ceil_mode, divisor_override)
 
 
 def _adaptive_pool_body(a, *, nd, out_sz, op, channel_last):
@@ -190,11 +227,51 @@ _register_nd("adaptive_avg_pool", _adaptive_pool_body)
 _register_nd("adaptive_max_pool", _adaptive_pool_body)
 
 
-def _adaptive_pool(x, output_size, nd, data_format, op):
+def _adaptive_max_mask_body(a, *, nd, out_sz):
+    """Flat spatial argmax index of each adaptive region (channel-first;
+    the pairing of max_pool's return_mask, consumed by max_unpool). The
+    loop is over OUTPUT cells, which are small by construction."""
+    spatial = a.shape[2:]
+    bounds = []
+    for i in range(nd):
+        o = out_sz[i] if out_sz[i] is not None else spatial[i]
+        starts = (np.arange(o) * spatial[i]) // o
+        ends = -(-((np.arange(o) + 1) * spatial[i]) // o)
+        bounds.append(list(zip(starts.tolist(), ends.tolist())))
+    cells = []
+    for cell in np.ndindex(*[len(b) for b in bounds]):
+        idx = tuple(slice(bounds[d][cell[d]][0], bounds[d][cell[d]][1])
+                    for d in range(nd))
+        region = a[(slice(None), slice(None)) + idx]
+        rs = region.shape[2:]
+        local = jnp.argmax(region.reshape(region.shape[:2] + (-1,)), -1)
+        locals_nd = jnp.unravel_index(local, rs)
+        flat = jnp.zeros_like(local)
+        for d in range(nd):
+            flat = flat * spatial[d] + (locals_nd[d]
+                                        + bounds[d][cell[d]][0])
+        cells.append(flat)
+    out_shape = a.shape[:2] + tuple(len(b) for b in bounds)
+    return jnp.stack(cells, -1).reshape(out_shape).astype(jnp.int32)
+
+
+for _nd in (1, 2, 3):
+    OPS.setdefault(f"adaptive_max_pool{_nd}d_mask", _adaptive_max_mask_body)
+
+
+def _adaptive_pool(x, output_size, nd, data_format, op, return_mask=False):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     out_sz = _pair(output_size, nd)
-    return op_call(f"adaptive_{op}_pool{nd}d", _adaptive_pool_body, x,
-                   nd=nd, out_sz=out_sz, op=op, channel_last=channel_last)
+    out = op_call(f"adaptive_{op}_pool{nd}d", _adaptive_pool_body, x,
+                  nd=nd, out_sz=out_sz, op=op, channel_last=channel_last)
+    if return_mask:
+        if channel_last:
+            raise NotImplementedError(
+                "return_mask supports channel-first layouts only")
+        mask = op_call(f"adaptive_max_pool{nd}d_mask",
+                       _adaptive_max_mask_body, x, nd=nd, out_sz=out_sz)
+        return out, mask
+    return out
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -210,15 +287,15 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+    return _adaptive_pool(x, output_size, 1, "NCL", "max", return_mask)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max", return_mask)
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max", return_mask)
 
 
 def _lp_pool_body(a, *, p, dims, strides, pad):
@@ -229,20 +306,27 @@ def _lp_pool_body(a, *, p, dims, strides, pad):
 _register_nd("lp_pool", _lp_pool_body)
 
 
-def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
-              data_format="NCL", name=None):
-    dims, strides, pad, k = _window(kernel_size, stride, padding, 1, False)
-    return op_call("lp_pool1d", _lp_pool_body, x, p=float(norm_type),
+def _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
+             data_format, nd):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    dims, strides, pad, k = _window(kernel_size, stride, padding, nd,
+                                    channel_last, ceil_mode,
+                                    _spatial_sizes(x, nd, channel_last))
+    return op_call(f"lp_pool{nd}d", _lp_pool_body, x, p=float(norm_type),
                    dims=dims, strides=strides,
                    pad=pad if isinstance(pad, str) else tuple(pad))
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                    data_format, 1)
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
               data_format="NCHW", name=None):
-    dims, strides, pad, k = _window(kernel_size, stride, padding, 2, False)
-    return op_call("lp_pool2d", _lp_pool_body, x, p=float(norm_type),
-                   dims=dims, strides=strides,
-                   pad=pad if isinstance(pad, str) else tuple(pad))
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
+                    data_format, 2)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,

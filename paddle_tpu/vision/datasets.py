@@ -59,6 +59,11 @@ class MNIST(Dataset):
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=False, backend="cv2"):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode must be 'train' or 'test', got {mode!r}")
+        if backend not in ("cv2", "pil"):
+            raise ValueError(f"backend must be 'cv2' or 'pil', got "
+                             f"{backend!r} (arrays are returned either way)")
         if download and (image_path is None or label_path is None):
             raise RuntimeError(
                 "download is unavailable (no network egress); pass "
@@ -90,6 +95,11 @@ class Cifar10(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend="cv2"):
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode must be 'train' or 'test', got {mode!r}")
+        if backend not in ("cv2", "pil"):
+            raise ValueError(f"backend must be 'cv2' or 'pil', got "
+                             f"{backend!r} (arrays are returned either way)")
         if data_file is None:
             raise RuntimeError(
                 "download is unavailable (no network egress); pass data_file")

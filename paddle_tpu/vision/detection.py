@@ -478,13 +478,56 @@ def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
     """Per-class greedy NMS (ops.yaml:3495 multiclass_nms3; kernel
     cpu/multiclass_nms3_kernel.cc).
 
-    bboxes: [N, M, 4]; scores: [N, C, M]. Returns (out [No, 6] rows of
+    bboxes: [N, M, 4]; scores: [N, C, M]. With ``rois_num`` (the LoD
+    variant): bboxes [M, C, 4], scores [M, C], and rois_num [N] gives the
+    per-image row counts. Returns (out [No, 6] rows of
     (label, score, x1, y1, x2, y2), index [No, 1], nms_rois_num [N]).
     """
     from ..core.tensor import Tensor
 
     b = _np_of(bboxes)
     s = _np_of(scores)
+    if rois_num is not None:
+        # LoD variant: per-image blocks of per-class boxes
+        counts = _np_of(rois_num).ravel().astype(np.int64)
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        outs, idxs, nums = [], [], []
+        c = s.shape[1]
+        for i, (lo, hi) in enumerate(zip(offsets[:-1], offsets[1:])):
+            dets = []
+            for cls in range(c):
+                if cls == background_label:
+                    continue
+                sc = s[lo:hi, cls]
+                bx = b[lo:hi, cls] if b.ndim == 3 else b[lo:hi]
+                valid = sc > score_threshold
+                if not valid.any():
+                    continue
+                cand = np.nonzero(valid)[0]
+                cand = cand[np.argsort(-sc[cand])]
+                if 0 < nms_top_k < len(cand):
+                    cand = cand[:nms_top_k]
+                for j in _nms_fast(bx, sc, cand, nms_threshold,
+                                   normalized=normalized, eta=nms_eta):
+                    dets.append((cls, sc[j], *bx[j], int(lo) + j))
+            dets.sort(key=lambda dd: -dd[1])
+            if 0 < keep_top_k < len(dets):
+                dets = dets[:keep_top_k]
+            outs += [d[:6] for d in dets]
+            idxs += [d[6] for d in dets]
+            nums.append(len(dets))
+        out = Tensor(jnp.asarray(
+            np.asarray(outs, np.float32).reshape(-1, 6)))
+        index = Tensor(jnp.asarray(
+            np.asarray(idxs, np.int64).reshape(-1, 1)))
+        num = Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+        if return_index and return_rois_num:
+            return out, index, num
+        if return_index:
+            return out, index
+        if return_rois_num:
+            return out, num
+        return out
     n, m, _ = b.shape
     c = s.shape[1]
     outs, idxs, nums = [], [], []
@@ -771,6 +814,12 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     """
     from ..core.tensor import Tensor
 
+    n_levels = max_level - min_level + 1
+    if len(multi_rois) != n_levels or len(multi_scores) != n_levels:
+        raise ValueError(
+            f"collect_fpn_proposals: expected {n_levels} levels "
+            f"(max_level {max_level} - min_level {min_level} + 1), got "
+            f"{len(multi_rois)} rois / {len(multi_scores)} scores lists")
     rois = [_np_of(r).reshape(-1, 4) for r in multi_rois]
     scores = [_np_of(s).reshape(-1) for s in multi_scores]
     if rois_num_per_level is not None:

@@ -40,13 +40,16 @@ class SqueezeNet(nn.Layer):
                 nn.MaxPool2D(3, 2),
                 _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
                 _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
-        self.classifier = nn.Sequential(
-            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
-            nn.AdaptiveAvgPool2D(1))
+        self.with_pool = with_pool
+        layers = [nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1),
+                  nn.ReLU()]
+        if with_pool:
+            layers.append(nn.AdaptiveAvgPool2D(1))
+        self.classifier = nn.Sequential(*layers)
 
     def forward(self, x):
         x = self.classifier(self.features(x))
-        return x.flatten(1)
+        return x.flatten(1) if self.with_pool else x
 
 
 def squeezenet1_0(pretrained=False, **kw):

@@ -38,9 +38,19 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     Static-shape inner loop (lax.fori_loop over N) — the dynamic output
     gather happens on the host, as the reference does after its CUDA kernel.
+    Category-aware suppression masks cross-category IoU, which is
+    equivalent to the reference's per-category iteration over
+    ``categories``; the list itself is validated (required alongside
+    ``category_idxs``, reference vision/ops.py nms contract) but the
+    masked pass needs only the per-box indices.
     """
     import numpy as np
     from ..core.tensor import Tensor
+
+    if category_idxs is not None and categories is None:
+        raise ValueError(
+            "nms: categories must be given when category_idxs is used "
+            "(the reference requires the category value list)")
 
     b = param(boxes)._data
     n = b.shape[0]

@@ -72,12 +72,17 @@ class Normalize(BaseTransform):
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
         self.data_format = data_format
+        self.to_rgb = to_rgb
 
     def _apply_image(self, img):
         arr = _to_numpy(img).astype(np.float32)
         if self.data_format == "CHW":
+            if self.to_rgb:
+                arr = arr[::-1]          # BGR -> RGB on the channel axis
             shape = (-1, 1, 1)
         else:
+            if self.to_rgb:
+                arr = arr[..., ::-1]
             shape = (1, 1, -1)
         return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
 
@@ -131,6 +136,7 @@ class RandomCrop(BaseTransform):
     def __init__(self, size, padding=None, pad_if_needed=False):
         self.size = _size_pair(size)
         self.padding = padding
+        self.pad_if_needed = pad_if_needed
 
     def _apply_image(self, img):
         arr = _to_numpy(img)
@@ -141,6 +147,15 @@ class RandomCrop(BaseTransform):
                 [(0, 0)] * (arr.ndim - 2)
             arr = np.pad(arr, pad)
         th, tw = self.size
+        if self.pad_if_needed:
+            # reference semantics: pad symmetrically up to the crop size
+            # when the (padded) image is still smaller than the target
+            dh = max(0, th - arr.shape[0])
+            dw = max(0, tw - arr.shape[1])
+            if dh or dw:
+                pad = [(dh // 2, dh - dh // 2), (dw // 2, dw - dw // 2)] \
+                    + [(0, 0)] * (arr.ndim - 2)
+                arr = np.pad(arr, pad)
         i = random.randint(0, max(0, arr.shape[0] - th))
         j = random.randint(0, max(0, arr.shape[1] - tw))
         return arr[i:i + th, j:j + tw]
@@ -240,18 +255,34 @@ class BrightnessTransform(BaseTransform):
 
 
 class ColorJitter(BaseTransform):
+    """Randomly jitter brightness, contrast, saturation, and hue — ALL
+    four parameters are honored (reference: vision/transforms/
+    transforms.py ColorJitter applies each factor when nonzero)."""
+
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         self.brightness = brightness
         self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
 
     def _apply_image(self, img):
         arr = _to_numpy(img).astype(np.float32)
         if self.brightness:
-            arr = arr * (1 + random.uniform(-self.brightness, self.brightness))
+            arr = np.clip(
+                arr * (1 + random.uniform(-self.brightness,
+                                          self.brightness)), 0, 255)
         if self.contrast:
             mean = arr.mean()
-            arr = (arr - mean) * (1 + random.uniform(-self.contrast,
-                                                     self.contrast)) + mean
+            arr = np.clip((arr - mean) * (1 + random.uniform(
+                -self.contrast, self.contrast)) + mean, 0, 255)
+        if self.saturation and arr.ndim == 3 and arr.shape[-1] == 3:
+            arr = adjust_saturation(
+                arr, 1 + random.uniform(-self.saturation,
+                                        self.saturation)).astype(np.float32)
+        if self.hue and arr.ndim == 3 and arr.shape[-1] == 3:
+            arr = adjust_hue(
+                arr, random.uniform(-min(self.hue, 0.5),
+                                    min(self.hue, 0.5))).astype(np.float32)
         return np.clip(arr, 0, 255)
 
 

@@ -248,6 +248,7 @@ def test_flag_registry_breadth():
         "nccl_blocking_wait")
 
 
+@pytest.mark.slow
 def test_vision_layer_wrappers():
     """DeformConv2D/RoIAlign/RoIPool/PSRoIPool Layer forms (reference:
     vision/ops.py class forms over the functional zoo)."""

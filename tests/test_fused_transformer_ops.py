@@ -192,6 +192,7 @@ def test_varlen_memory_efficient_attention():
     np.testing.assert_allclose(outc.numpy()[0], refc, rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_stack():
     b, s, h, hd, layers = 2, 4, 2, 4, 2
     d = h * hd

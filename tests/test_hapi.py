@@ -48,6 +48,7 @@ def test_fit_decreases_loss_and_tracks_accuracy():
     assert history[-1]["acc"] > 0.5
 
 
+@pytest.mark.slow
 def test_evaluate_and_predict():
     model = _make_model()
     ds = ToyDataset()

@@ -55,6 +55,7 @@ def test_llama_tiny_forward_backward():
         assert g is not None and np.abs(g.numpy()).sum() > 0
 
 
+@pytest.mark.slow
 def test_llama_train_step_compiled():
     cfg = llama_tiny_config(num_hidden_layers=1)
     m = LlamaForCausalLM(cfg)
@@ -172,6 +173,7 @@ def test_fused_linear_cross_entropy_parity():
                                float(full_pad.numpy()), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_tied_embeddings_causal_shift():
     # Without the causal label shift, a tied-embedding model "predicts" its
     # own input through the residual stream and the loss collapses to ~0

@@ -163,6 +163,7 @@ class TestFunctional:
         v0 = q.numpy()[:, 0]
         np.testing.assert_allclose(out.numpy()[:, 0], v0, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_attention_gqa_native_matches_repeated(self):
         # grouped-query k/v pass through with their native head count;
         # parity against explicitly repeated k/v (the pairing convention:
@@ -235,6 +236,7 @@ class TestOptimizers:
         ("nadam", lambda ps: paddle.optimizer.NAdam(0.1, parameters=ps)),
         ("radam", lambda ps: paddle.optimizer.RAdam(0.1, parameters=ps)),
     ])
+    @pytest.mark.slow
     def test_converges(self, name, fn):
         # slow-start algorithms need more steps on this problem (verified
         # against torch reference implementations — same curves)

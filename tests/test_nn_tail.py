@@ -57,6 +57,7 @@ def test_beam_search_decodes_planted_sequence():
     assert outputs.predicted_ids.shape[1] <= 6  # stopped early
 
 
+@pytest.mark.slow
 def test_sparse_attention_matches_dense():
     rs = np.random.RandomState(0)
     b, h, s, d = 2, 2, 8, 4
@@ -117,6 +118,7 @@ def test_new_losses_and_dropout():
     assert F.feature_alpha_dropout(x, 0.5, training=False) is x
 
 
+@pytest.mark.slow
 def test_new_layers_forward():
     x = paddle.ones([2, 3, 4, 4])
     assert nn.Softmax2D()(x).shape == [2, 3, 4, 4]

@@ -1,0 +1,364 @@
+"""Round-5 signature-honesty sweep (verdict item 6): every public
+parameter either changes behavior or raises — nothing is silently
+ignored. Each test pins one previously-dead parameter to its reference
+semantics (reference: python/paddle/{vision,audio,nn,incubate}/...).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.default_rng(3)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestColorJitter:
+    def _img(self):
+        return RNG.uniform(0, 255, size=(8, 8, 3)).astype(np.float32)
+
+    def test_each_param_changes_output(self):
+        from paddle_tpu.vision.transforms import ColorJitter
+        import random as pyrandom
+        img = self._img()
+        for kw in ({"brightness": 0.9}, {"contrast": 0.9},
+                   {"saturation": 0.9}, {"hue": 0.4}):
+            pyrandom.seed(0)
+            changed = False
+            for _ in range(5):     # random factor may land near identity
+                out = ColorJitter(**kw)(img)
+                if not np.allclose(out, img, atol=1e-3):
+                    changed = True
+                    break
+            assert changed, f"{kw} left the image unchanged"
+        # all-zero jitter is the identity
+        np.testing.assert_allclose(ColorJitter()(img), img)
+
+
+class TestInterpolate:
+    def test_align_corners_bilinear_matches_torch(self):
+        x = RNG.normal(size=(1, 2, 5, 7)).astype(np.float32)
+        out = F.interpolate(t(x), size=(10, 13), mode="bilinear",
+                            align_corners=True)
+        ref = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(10, 13), mode="bilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_align_corners_differs_from_half_pixel(self):
+        x = RNG.normal(size=(1, 1, 4, 4)).astype(np.float32)
+        a = F.interpolate(t(x), size=(9, 9), mode="bilinear",
+                          align_corners=True).numpy()
+        b = F.interpolate(t(x), size=(9, 9), mode="bilinear",
+                          align_corners=False).numpy()
+        assert not np.allclose(a, b)
+
+    def test_align_mode_1_asymmetric(self):
+        x = RNG.normal(size=(1, 1, 6, 6)).astype(np.float32)
+        a = F.interpolate(t(x), size=(4, 4), mode="bilinear", align_mode=1)
+        b = F.interpolate(t(x), size=(4, 4), mode="bilinear", align_mode=0)
+        assert not np.allclose(a.numpy(), b.numpy())
+        # asymmetric src = dst*in/out: row 0 maps exactly to input row 0
+        np.testing.assert_allclose(a.numpy()[..., 0, 0], x[..., 0, 0],
+                                   rtol=1e-5)
+
+    def test_align_corners_rejected_for_nearest(self):
+        x = t(RNG.normal(size=(1, 1, 4, 4)))
+        with pytest.raises(ValueError):
+            F.interpolate(x, size=(8, 8), mode="nearest",
+                          align_corners=True)
+
+
+class TestLayoutParams:
+    def test_pixel_unshuffle_nhwc(self):
+        x = RNG.normal(size=(1, 4, 6, 3)).astype(np.float32)  # NHWC
+        out = F.pixel_unshuffle(t(x), 2, data_format="NHWC")
+        ref = F.pixel_unshuffle(t(x.transpose(0, 3, 1, 2)), 2).numpy()
+        np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-6)
+
+    def test_channel_shuffle_nhwc(self):
+        x = RNG.normal(size=(1, 4, 4, 6)).astype(np.float32)  # NHWC
+        out = F.channel_shuffle(t(x), 3, data_format="NHWC")
+        ref = F.channel_shuffle(t(x.transpose(0, 3, 1, 2)), 3).numpy()
+        np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-6)
+
+
+class TestPooling:
+    def test_avg_pool_divisor_override(self):
+        x = RNG.normal(size=(1, 1, 6, 6)).astype(np.float32)
+        out = F.avg_pool2d(t(x), 2, stride=2, divisor_override=3)
+        ref = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 2, stride=2, divisor_override=3).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_ceil_mode_extends_output(self):
+        x = RNG.normal(size=(1, 1, 7, 7)).astype(np.float32)
+        out = F.max_pool2d(t(x), 3, stride=2, ceil_mode=True)
+        ref = torch.nn.functional.max_pool2d(
+            torch.from_numpy(x), 3, stride=2, ceil_mode=True).numpy()
+        assert out.numpy().shape == ref.shape    # (1, 1, 4, 4), not 3x3
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        out_a = F.avg_pool2d(t(x), 3, stride=2, ceil_mode=True,
+                             exclusive=True)
+        ref_a = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 3, stride=2, ceil_mode=True,
+            count_include_pad=False).numpy()
+        np.testing.assert_allclose(out_a.numpy(), ref_a, rtol=1e-5)
+
+    def test_adaptive_max_pool_return_mask(self):
+        x = RNG.normal(size=(2, 3, 8, 6)).astype(np.float32)
+        out, mask = F.adaptive_max_pool2d(t(x), (4, 3), return_mask=True)
+        assert list(mask.shape) == [2, 3, 4, 3]
+        flat = x.reshape(2, 3, -1)
+        gathered = np.take_along_axis(
+            flat, mask.numpy().reshape(2, 3, -1), axis=2).reshape(2, 3, 4, 3)
+        np.testing.assert_allclose(out.numpy(), gathered, rtol=1e-6)
+
+    def test_lp_pool_nhwc_and_ceil(self):
+        x = RNG.uniform(1, 2, size=(1, 5, 5, 2)).astype(np.float32)
+        out = F.lp_pool2d(t(x), 2, 2, stride=2, ceil_mode=True,
+                          data_format="NHWC")
+        ref = F.lp_pool2d(t(x.transpose(0, 3, 1, 2)), 2, 2, stride=2,
+                          ceil_mode=True).numpy()
+        np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-5)
+
+
+class TestInstanceNorm:
+    def test_use_input_stats_false_uses_running(self):
+        x = RNG.normal(size=(2, 3, 4, 4)).astype(np.float32)
+        rm = paddle.to_tensor(np.full(3, 0.5, np.float32))
+        rv = paddle.to_tensor(np.full(3, 4.0, np.float32))
+        out = F.instance_norm(t(x), rm, rv, use_input_stats=False, eps=0.0)
+        ref = (x - 0.5) / 2.0
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+    def test_running_stats_update(self):
+        x = RNG.normal(loc=2.0, size=(2, 3, 4, 4)).astype(np.float32)
+        rm = paddle.to_tensor(np.zeros(3, np.float32))
+        rv = paddle.to_tensor(np.ones(3, np.float32))
+        F.instance_norm(t(x), rm, rv, use_input_stats=True, momentum=0.5)
+        assert not np.allclose(rm.numpy(), 0.0)   # moved toward batch mean
+
+    def test_nhwc(self):
+        x = RNG.normal(size=(2, 4, 4, 3)).astype(np.float32)
+        out = F.instance_norm(t(x), data_format="NHWC")
+        ref = F.instance_norm(t(x.transpose(0, 3, 1, 2))).numpy()
+        np.testing.assert_allclose(out.numpy().transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_norm_by_times():
+    lp = np.log(np.full((6, 2, 4), 0.25, np.float32))
+    lbl = np.array([[1, 2], [2, 3]], np.int64)
+    in_len = np.array([6, 4], np.int64)
+    lbl_len = np.array([2, 2], np.int64)
+    base = F.ctc_loss(t(lp), paddle.to_tensor(lbl),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lbl_len),
+                      reduction="none")
+    normed = F.ctc_loss(t(lp), paddle.to_tensor(lbl),
+                        paddle.to_tensor(in_len), paddle.to_tensor(lbl_len),
+                        reduction="none", norm_by_times=True)
+    np.testing.assert_allclose(normed.numpy(), base.numpy() / in_len,
+                               rtol=1e-5)
+
+
+class TestFusedOps:
+    def test_fused_norm_begin_norm_axis(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = RNG.normal(size=(2, 3, 4)).astype(np.float32)
+        w = np.ones((3, 4), np.float32)
+        out = IF.fused_rms_norm(t(x), t(w), begin_norm_axis=1)
+        var = np.square(x).reshape(2, -1).mean(-1).reshape(2, 1, 1)
+        ref = x / np.sqrt(var + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+        with pytest.raises(NotImplementedError):
+            IF.fused_rms_norm(t(x), t(np.ones(4, np.float32)),
+                              quant_scale=0.5)
+
+    def test_fused_rope_halfstyle_and_time_major(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        b, s, h, d = 2, 5, 2, 8
+        q = RNG.normal(size=(b, s, h, d)).astype(np.float32)
+        out_q, _, _ = IF.fused_rotary_position_embedding(
+            t(q), use_neox_rotary_style=False)
+        # oracle: half-split rotation with standard tables
+        inv = 1.0 / (10000.0 ** (np.arange(0, d, 2) / d))
+        ang = np.arange(s)[:, None] * inv[None]            # [s, d/2]
+        cos = np.concatenate([np.cos(ang), np.cos(ang)], -1)[None, :, None]
+        sin = np.concatenate([np.sin(ang), np.sin(ang)], -1)[None, :, None]
+        rot = np.concatenate([-q[..., d // 2:], q[..., :d // 2]], -1)
+        ref = q * cos + rot * sin
+        np.testing.assert_allclose(out_q.numpy(), ref, rtol=1e-4,
+                                   atol=1e-4)
+        # differs from the neox (adjacent-pair) style
+        out_neox, _, _ = IF.fused_rotary_position_embedding(
+            t(q), use_neox_rotary_style=True)
+        assert not np.allclose(out_q.numpy(), out_neox.numpy())
+        # time_major roundtrips through the same math
+        out_tm, _, _ = IF.fused_rotary_position_embedding(
+            t(q.transpose(1, 0, 2, 3)), use_neox_rotary_style=False,
+            time_major=True)
+        np.testing.assert_allclose(out_tm.numpy().transpose(1, 0, 2, 3),
+                                   ref, rtol=1e-4, atol=1e-4)
+
+    def test_fused_bias_act_quant_raises(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = t(RNG.normal(size=(2, 4)))
+        with pytest.raises(NotImplementedError):
+            IF.fused_bias_act(x, dequant_scales=t(np.ones(4)))
+        with pytest.raises(ValueError):
+            IF.weight_dequantize(x, t(np.ones(4)), algo="nf4")
+
+    def test_fused_feedforward_ring_id_placement(self, monkeypatch):
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.distributed import collective as C
+        monkeypatch.setattr(C, "is_initialized", lambda: True)
+        monkeypatch.setattr(C, "raw_all_reduce_sum",
+                            lambda a, group=None: a * 2)
+        d, dff = 4, 8
+        x = RNG.normal(size=(2, 3, d)).astype(np.float32)
+        w1 = RNG.normal(size=(d, dff)).astype(np.float32)
+        w2 = RNG.normal(size=(dff, d)).astype(np.float32)
+        b2 = RNG.normal(size=(d,)).astype(np.float32)
+        out = IF.fused_feedforward(t(x), t(w1), t(w2), None, t(b2),
+                                   dropout1_rate=0.0, dropout2_rate=0.0,
+                                   pre_layer_norm=True, ring_id=0)
+        from tests.test_fused_transformer_ops import _ln_np
+        h = np.maximum(_ln_np(x) @ w1, 0)
+        ref = x + (2 * (h @ w2) + b2)   # partial doubled BEFORE bias
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+    def test_flashmask_dropout_active(self):
+        q = RNG.normal(size=(1, 6, 2, 4)).astype(np.float32)
+        startend = np.full((1, 1, 6, 1), 6, np.int32)
+        paddle.seed(7)
+        base = F.flashmask_attention(t(q), t(q), t(q),
+                                     paddle.to_tensor(startend),
+                                     causal=True, training=False,
+                                     dropout=0.9)
+        paddle.seed(7)
+        dropped = F.flashmask_attention(t(q), t(q), t(q),
+                                        paddle.to_tensor(startend),
+                                        causal=True, training=True,
+                                        dropout=0.9)
+        assert not np.allclose(base.numpy(), dropped.numpy())
+
+
+class TestVisionParams:
+    def test_normalize_to_rgb(self):
+        from paddle_tpu.vision import transforms as T
+        img = RNG.uniform(0, 1, size=(3, 4, 4)).astype(np.float32)
+        out = T.Normalize(0.0, 1.0, data_format="CHW", to_rgb=True)(img)
+        np.testing.assert_allclose(out, img[::-1], rtol=1e-6)
+
+    def test_random_crop_pad_if_needed(self):
+        from paddle_tpu.vision import transforms as T
+        img = RNG.uniform(0, 1, size=(4, 4, 3)).astype(np.float32)
+        out = T.RandomCrop(8, pad_if_needed=True)(img)
+        assert out.shape == (8, 8, 3)
+        # without pad_if_needed the undersized image stays undersized
+        assert T.RandomCrop(8)(img).shape != (8, 8, 3)
+
+    def test_nms_categories_required(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = t(np.array([[0, 0, 1, 1], [0, 0, 1, 1]], np.float32))
+        with pytest.raises(ValueError):
+            nms(boxes, 0.5, scores=t(np.array([0.9, 0.8])),
+                category_idxs=paddle.to_tensor(np.array([0, 1])))
+
+    def test_collect_fpn_level_mismatch(self):
+        from paddle_tpu.vision.detection import collect_fpn_proposals
+        r = t(RNG.uniform(0, 10, size=(5, 4)))
+        s = t(RNG.uniform(0, 1, size=(5,)))
+        with pytest.raises(ValueError):
+            collect_fpn_proposals([r], [s], 2, 4, 10)
+
+    def test_squeezenet_with_pool_false(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+        m = squeezenet1_1(num_classes=7, with_pool=False)
+        m.eval()
+        x = t(RNG.normal(size=(1, 3, 64, 64)))
+        out = m(x)
+        assert len(out.shape) == 4 and out.shape[1] == 7   # unpooled map
+
+    def test_multiclass_nms3_rois_num(self):
+        from paddle_tpu.vision.detection import multiclass_nms3
+        m, c = 6, 2
+        boxes = np.tile(np.array([[0, 0, 1, 1]], np.float32), (m, 1))
+        boxes = boxes + np.arange(m, dtype=np.float32)[:, None] * 2
+        bx = np.repeat(boxes[:, None], c, axis=1)          # [M, C, 4]
+        sc = RNG.uniform(0.5, 1, size=(m, c)).astype(np.float32)
+        out, idx, num = multiclass_nms3(
+            t(bx), t(sc), rois_num=paddle.to_tensor(
+                np.array([4, 2], np.int32)))
+        assert int(num.numpy().sum()) == out.shape[0] == idx.shape[0]
+        assert len(num.numpy()) == 2
+
+
+def test_max_pool_ceil_mode_with_mask_shapes_agree():
+    x = RNG.normal(size=(1, 1, 5, 5)).astype(np.float32)
+    out, mask = F.max_pool2d(t(x), 2, stride=2, ceil_mode=True,
+                             return_mask=True)
+    assert out.numpy().shape == mask.numpy().shape == (1, 1, 3, 3)
+    flat = x.reshape(1, 1, -1)
+    gathered = np.take_along_axis(flat, mask.numpy().reshape(1, 1, -1),
+                                  axis=2).reshape(out.numpy().shape)
+    np.testing.assert_allclose(out.numpy(), gathered, rtol=1e-6)
+
+
+def test_instance_norm_running_var_per_instance():
+    # two constant instances at different offsets: per-instance variance
+    # is 0, so the running variance must stay ~untouched toward 0
+    x = np.stack([np.zeros((1, 2, 2), np.float32),
+                  np.full((1, 2, 2), 10, np.float32)])      # [2,1,2,2]
+    rv = paddle.to_tensor(np.ones(1, np.float32))
+    rm = paddle.to_tensor(np.zeros(1, np.float32))
+    F.instance_norm(t(x), rm, rv, use_input_stats=True, momentum=0.5)
+    assert float(rv.numpy()[0]) < 1.0   # decayed toward 0, not toward 25
+    np.testing.assert_allclose(float(rm.numpy()[0]), 2.5, rtol=1e-5)
+
+
+def test_auto_while_closure_param_keeps_grad():
+    """A trainable tensor read via closure must keep the Python loop
+    (lax.while_loop would sever its gradient)."""
+    from paddle_tpu.jit.loop_rewrite import rewrite_loops
+    scale = paddle.to_tensor(np.float32(2.0))
+    scale.stop_gradient = False
+
+    def f(x, n):
+        i = paddle.zeros([], "int32")
+        while i < n:
+            x = x * scale
+            i = i + 1
+        return x
+
+    g = rewrite_loops(f)
+    x = paddle.to_tensor(np.float32(3.0))
+    out = g(x, paddle.to_tensor(np.int32(3)))
+    out.backward()
+    np.testing.assert_allclose(scale.grad.numpy(), 3 * 3 * 4.0, rtol=1e-5)
+
+
+def test_auto_while_restores_python_int_eagerly():
+    from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+    def f(x):
+        count = 0
+        v = x
+        while v > 1.0:
+            v = v / 2.0
+            count = count + 1
+        return count
+
+    g = rewrite_loops(f)
+    with paddle.no_grad():
+        count = g(paddle.to_tensor(np.float32(8.0)))
+    assert isinstance(count, int) and count == 3
+    assert list(range(count)) == [0, 1, 2]

@@ -39,6 +39,7 @@ class TestUnaryTail:
         assert out.values().numpy().dtype in (np.float32, np.float64)
         assert out.nnz() == 3
 
+    @pytest.mark.slow
     def test_coalesce_and_is_coalesced(self):
         idx = np.asarray([[0, 0, 1], [1, 1, 2]])      # duplicate (0,1)
         vals = np.asarray([1.0, 2.0, 3.0], np.float32)

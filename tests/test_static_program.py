@@ -222,6 +222,7 @@ def test_weight_norm_param_attr_and_ipu_stubs():
         static.ipu_shard_guard(0)
 
 
+@pytest.mark.slow
 def test_static_nn_builders(static_mode):
     """static.nn legacy layer builders (reference: static/nn/common.py)
     record into a Program and replay correctly."""

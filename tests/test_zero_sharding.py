@@ -36,6 +36,7 @@ def _params():
     }
 
 
+@pytest.mark.slow
 def test_stage2_grads_reduce_scattered_not_all_reduced():
     """The explicit stage-2 pipeline must carry the cross-device grad
     reduction as reduce-scatter in the compiled program, where the plain DP
