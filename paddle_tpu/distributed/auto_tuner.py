@@ -106,13 +106,22 @@ def estimate(cand, model_cfg, chip="v5p", seq_len=2048):
     bubble = (cand["pp"] - 1) / max(cand["acc_steps"] + cand["pp"] - 1, 1)
     t_pp = t_compute * bubble
 
-    # sharding: param all-gather + grad reduce-scatter over dp
+    # dp gradient synchronization: one bf16 all-reduce of the local
+    # grads per step (ring: 2*(dp-1)/dp of the payload over ICI) — paid
+    # by plain dp and by ZeRO-1 (reduce-scatter + all-gather, same
+    # volume) alike
+    t_dp = 0.0
+    if cand["dp"] > 1:
+        gbytes = 2 * params / (cand["mp"] * cand["pp"])
+        t_dp = 2 * gbytes * (cand["dp"] - 1) / cand["dp"] / (ici_gbs * 1e9)
+
+    # sharding >= 2: ADDITIONALLY all-gather the params each step
     t_shard = 0.0
     if cand["sharding"] >= 2 and cand["dp"] > 1:
         pbytes = 2 * params / (cand["mp"] * cand["pp"])
         t_shard = 2 * pbytes * (cand["dp"] - 1) / cand["dp"] / (ici_gbs * 1e9)
 
-    return t_compute + t_mp + t_pp + t_shard
+    return t_compute + t_mp + t_pp + t_dp + t_shard
 
 
 def memory_gb(cand, model_cfg, seq_len=2048, bytes_per_param=2,

@@ -28,11 +28,14 @@ def test_prune_drops_oom():
 
 def test_cost_model_encodes_tradeoffs():
     big = dict(CFG, hidden_size=8192, num_layers=64)
-    # comm penalty: same per-chip tokens, mp>1 adds ICI all-reduce time
-    base = dict(dp=8, mp=1, pp=1, sharding=1, sep=1,
-                micro_batch_size=1, acc_steps=1)
-    # (acc_steps keeps global batch fixed: 8/dp/mbsz)
-    assert estimate(dict(base, dp=4, mp=2, acc_steps=2), big) > estimate(base, big)
+    # comm penalties: splitting over a parallel axis must cost MORE than
+    # the ideal halving of compute — mp pays the activation all-reduce,
+    # dp pays the gradient all-reduce (round-5: dp sync is priced too)
+    solo = dict(dp=1, mp=1, pp=1, sharding=1, sep=1,
+                micro_batch_size=1, acc_steps=8)
+    assert estimate(dict(solo, mp=2), big) > estimate(solo, big) / 2
+    assert estimate(dict(solo, dp=2, acc_steps=4), big) > \
+        estimate(solo, big) / 2
     # pipeline bubble shrinks as acc_steps grows (1F1B bubble fraction)
     pp2 = dict(dp=4, mp=1, pp=2, sharding=1, sep=1, micro_batch_size=1)
     t_few = estimate(dict(pp2, acc_steps=2), big)
