@@ -356,3 +356,36 @@ define_flag("logging_pir_py_code_int_tensor_element_limit", int, 16,
             "max tensor elements rendered per constant in jaxpr dumps")
 define_flag("apply_pass_to_program", bool, False,
             "advisory: XLA owns the pass pipeline")
+
+# ---- round-5: the last TPU-meaningful reference flags, closing the
+# disposition table (FLAGS_DISPOSITION.md; every other reference flag is
+# dispositioned n/a with a reason there) ----
+
+
+def _wire_mem_fraction(v):
+    # PJRT reads XLA_PYTHON_CLIENT_MEM_FRACTION at backend init — the
+    # same effective-at-allocator-init contract as the reference's flag
+    import os
+    os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(float(v))
+
+
+define_flag("fraction_of_gpu_memory_to_use", float, 0.92,
+            "fraction of accelerator memory the client preallocates "
+            "(wired to XLA_PYTHON_CLIENT_MEM_FRACTION; set before the "
+            "first device touch, like the reference's allocator-init "
+            "contract)", on_set=_wire_mem_fraction)
+
+
+def _wire_selected_devices(v):
+    s = str(v).strip()
+    if not s:
+        return
+    first = int(s.split(",")[0])
+    from .place import set_device
+    set_device(f"tpu:{first}")
+
+
+define_flag("selected_gpus", str, "",
+            "comma-separated accelerator ordinals; the first becomes the "
+            "default place (reference: device visibility selection)",
+            on_set=_wire_selected_devices)

@@ -526,3 +526,53 @@ def test_logging_pir_py_code_truncation(flag_restorer, tmp_path):
     g(paddle.ones([4096]))
     texts = [d.read_text() for d in tmp_path.glob("*.jaxpr")]
     assert any("2.000e+03" in t or "2000." in t for t in texts)
+
+
+def test_fraction_of_gpu_memory_wires_client_env():
+    """round-5: the reference's allocator-fraction flag maps to the PJRT
+    client preallocation fraction (effective at backend init)."""
+    import os
+    import paddle_tpu as paddle
+    old = os.environ.get("XLA_PYTHON_CLIENT_MEM_FRACTION")
+    try:
+        paddle.set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+        assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+    finally:
+        if old is None:
+            os.environ.pop("XLA_PYTHON_CLIENT_MEM_FRACTION", None)
+        else:
+            os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = old
+
+
+def test_selected_gpus_sets_default_place():
+    import paddle_tpu as paddle
+    from paddle_tpu.core import place as P
+    old = P._default_place
+    try:
+        paddle.set_flags({"FLAGS_selected_gpus": "1"})
+        assert paddle.device.get_device().endswith(":1")
+    finally:
+        P._default_place = old
+
+
+def test_flags_disposition_is_complete():
+    """Every reference flag is either registered here or carries an n/a
+    disposition with a reason — no 'remaining' bucket (FLAGS_DISPOSITION
+    .md is generated from the same data)."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "gen_flags_disposition",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools",
+            "gen_flags_disposition.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ref = set(mod.ref_flag_names())
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+    ours = set(GLOBAL_FLAGS._flags)
+    undispositioned = ref - ours - set(mod.NA)
+    assert not undispositioned, undispositioned
+    # and nothing is double-booked: implemented flags need no NA entry
+    assert not (ours & set(mod.NA))
