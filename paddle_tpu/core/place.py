@@ -46,6 +46,36 @@ class TPUPlace(Place):
         super().__init__("tpu", device_id)
 
 
+class CustomPlace(Place):
+    """A registered custom device type (reference: phi CustomPlace /
+    the custom-runtime ABI, paddle/phi/backends/custom/). On this stack
+    a PJRT plugin plays the CustomRuntime role: the type name maps to a
+    JAX platform registered via device.register_custom_device."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_type, device_id)
+
+
+# custom device-type name -> JAX platform name (the pluggable ABI)
+_CUSTOM_DEVICE_TYPES: dict[str, str] = {}
+
+
+def register_custom_device(device_type: str, jax_platform: str | None = None):
+    """Register ``device_type`` as a place class backed by the given JAX
+    platform (default: same name). ``set_device(f"{device_type}:0")``
+    then resolves through jax.devices(platform)."""
+    _CUSTOM_DEVICE_TYPES[device_type] = jax_platform or device_type
+    _custom_devices.cache_clear()
+
+
+@functools.lru_cache(maxsize=None)
+def _custom_devices(platform: str):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
 # jax.devices() on the axon platform reports platform "tpu"-like devices; treat
 # any non-cpu accelerator as the "tpu" device class for Place purposes.
 @functools.lru_cache(maxsize=None)
@@ -64,6 +94,9 @@ def _cpus():
 def _resolve(device_type: str, device_id: int):
     if device_type == "cpu":
         devs = _cpus() or jax.devices()
+    elif device_type in _CUSTOM_DEVICE_TYPES:
+        devs = _custom_devices(_CUSTOM_DEVICE_TYPES[device_type]) \
+            or jax.devices()
     else:
         devs = _accelerators()
         if not devs:  # CPU-only environment: every place maps to host devices
@@ -103,6 +136,8 @@ def _parse(device) -> Place:
             return CPUPlace(idx)
         if name in ("tpu", "gpu", "xpu", "device"):  # accelerator aliases
             return TPUPlace(idx)
+        if name in _CUSTOM_DEVICE_TYPES:
+            return CustomPlace(name, idx)
     raise ValueError(f"cannot parse device: {device!r}")
 
 

@@ -309,15 +309,39 @@ def is_compiled_with_distribute():
 
 
 def is_compiled_with_custom_device(device_type=None):
-    return False
+    """True when a custom device type is registered (reference:
+    framework.core.is_compiled_with_custom_device). PJRT plugins are the
+    custom-runtime ABI here: register with :func:`register_custom_device`."""
+    from ..core.place import _CUSTOM_DEVICE_TYPES
+    if device_type is None:
+        return bool(_CUSTOM_DEVICE_TYPES)
+    return device_type in _CUSTOM_DEVICE_TYPES
+
+
+def register_custom_device(device_type, jax_platform=None):
+    """Register a custom device type backed by a JAX/PJRT platform — the
+    pluggable-backend surface (reference: the CustomDevice runtime ABI,
+    paddle/phi/backends/custom/custom_device.cc; on this stack a PJRT
+    plugin IS the custom runtime, so registration is a name mapping).
+    After registration, ``paddle.set_device(f"{device_type}:0")``,
+    CustomPlace, and tensor placement all resolve through
+    ``jax.devices(jax_platform)``."""
+    from ..core.place import register_custom_device as _reg
+    _reg(device_type, jax_platform)
 
 
 def get_all_custom_device_type():
-    return []
+    from ..core.place import _CUSTOM_DEVICE_TYPES
+    return sorted(_CUSTOM_DEVICE_TYPES)
 
 
 def get_available_custom_device():
-    return []
+    from ..core.place import _CUSTOM_DEVICE_TYPES, _custom_devices
+    out = []
+    for name, plat in sorted(_CUSTOM_DEVICE_TYPES.items()):
+        out.extend(f"{name}:{i}"
+                   for i in range(len(_custom_devices(plat))))
+    return out
 
 
 def set_stream(stream=None):

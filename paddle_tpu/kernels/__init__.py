@@ -31,6 +31,40 @@ from .rms_norm import rms_norm as pallas_rms_norm
 
 _ON_TPU = None  # tri-state cache; resolved on first kernel call, NOT at import
 
+_SPLASH_KERNELS = {}  # (h, sq, sk, causal) -> compiled splash mha kernel
+
+
+def splash_attention(q, k, v, causal=True, scale=None):
+    """jax's production TPU splash-attention kernel over [b, h, s, d]
+    inputs (GQA key/value repeated to the query head count — the
+    kernel's MHA entry; per-shape kernels are cached). Selected by
+    PADDLE_TPU_ATTN_IMPL=splash for the step-level attention A/B."""
+    import math
+
+    import jax.numpy as jnp
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sk,
+        splash_attention_mask as _sm,
+    )
+
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    hkv = k.shape[1]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=1)
+        v = jnp.repeat(v, h // hkv, axis=1)
+    key = (h, sq, skv, bool(causal))
+    kernel = _SPLASH_KERNELS.get(key)
+    if kernel is None:
+        mk = (_sm.CausalMask((sq, skv)) if causal
+              else _sm.FullMask((sq, skv)))
+        mask = _sm.MultiHeadMask([mk for _ in range(h)])
+        kernel = _sk.make_splash_mha(mask=mask, head_shards=1,
+                                     q_seq_shards=1)
+        _SPLASH_KERNELS[key] = kernel
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    return jax.vmap(lambda qq, kk, vv: kernel(qq * s, kk, vv))(q, k, v)
+
 
 def _on_tpu() -> bool:
     # Touching jax.devices() initializes the backend — must never run at
@@ -63,6 +97,36 @@ def install():
         forced = os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1"
         use_pallas = forced or _on_tpu()
         interpret = not _on_tpu()
+        # PADDLE_TPU_ATTN_IMPL: step-level attention A/B selector
+        # (round-5): auto (default tiering) | xla (pin the composition) |
+        # flash (pin our Pallas kernel) | splash (pin jax's production
+        # TPU splash-attention kernel). The chip-window experiment
+        # matrix (tools/tpu_round5.py) flips this per bench run.
+        impl = os.environ.get("PADDLE_TPU_ATTN_IMPL", "auto")
+        if impl == "xla":
+            return _sdpa_reference(q, k, v, *rest, causal=causal,
+                                   dropout_p=dropout_p, scale=scale,
+                                   dropout_key=dropout_key)
+        if impl == "splash" and _on_tpu() and attn_mask is None \
+                and dropout_p == 0.0:
+            import jax.numpy as jnp
+            try:
+                out = splash_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+                return jnp.swapaxes(out, 1, 2)
+            except Exception:
+                from ..core.flags import GLOBAL_FLAGS
+                if not GLOBAL_FLAGS.get("enable_fusion_fallback"):
+                    raise
+                from ..core.vlog import vlog
+                vlog(0, "splash attention failed; falling back to the "
+                        "XLA composition")
+                return _sdpa_reference(q, k, v, *rest, causal=causal,
+                                       dropout_p=dropout_p, scale=scale,
+                                       dropout_key=dropout_key)
+        if impl == "flash":
+            forced = True
         # Measured on the v5e pool chip (scan-chained fwd+bwd, readback
         # sync; b=8 h=12 d=64): XLA composition beats every Pallas kernel
         # tried (ours, jax flash, splash) up to s=4096 — e.g. s=2048 XLA

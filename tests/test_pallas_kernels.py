@@ -250,3 +250,33 @@ class TestKernelAutotune:
         ref = flash_attention(q, k, v, causal=True, interpret=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_attn_impl_selector(monkeypatch):
+    """PADDLE_TPU_ATTN_IMPL (round-5): xla pins the composition, flash
+    pins the Pallas kernel (interpret mode on CPU), splash is TPU-only
+    and quietly degrades elsewhere — all numerically consistent."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    b, s, h, d = 1, 256, 2, 128   # flash path needs s % 128 == 0
+    q = paddle.to_tensor(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    k = paddle.to_tensor(rng.standard_normal((b, s, h, d)).astype(np.float32))
+    v = paddle.to_tensor(rng.standard_normal((b, s, h, d)).astype(np.float32))
+
+    monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "xla")
+    ref = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
+
+    monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "flash")
+    monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_THRESHOLD", "128")
+    out_flash = F.scaled_dot_product_attention(q, k, v,
+                                               is_causal=True).numpy()
+    np.testing.assert_allclose(out_flash, ref, rtol=2e-3, atol=2e-3)
+
+    monkeypatch.delenv("PADDLE_TPU_FORCE_PALLAS")
+    monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "splash")
+    out_sp = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
+    np.testing.assert_allclose(out_sp, ref, rtol=2e-3, atol=2e-3)

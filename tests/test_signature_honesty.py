@@ -362,3 +362,30 @@ def test_auto_while_restores_python_int_eagerly():
         count = g(paddle.to_tensor(np.float32(8.0)))
     assert isinstance(count, int) and count == 3
     assert list(range(count)) == [0, 1, 2]
+
+
+def test_custom_device_registration():
+    """C6 pluggable backend: a custom device type maps to a JAX/PJRT
+    platform (the custom-runtime ABI on this stack); places, set_device,
+    and tensor math resolve through it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import place as P
+
+    assert not paddle.device.is_compiled_with_custom_device("mynpu")
+    paddle.device.register_custom_device("mynpu", "cpu")
+    try:
+        assert paddle.device.is_compiled_with_custom_device("mynpu")
+        assert "mynpu" in paddle.device.get_all_custom_device_type()
+        avail = paddle.device.get_available_custom_device()
+        assert any(a.startswith("mynpu:") for a in avail)
+        old = P._default_place
+        try:
+            paddle.device.set_device("mynpu:0")
+            assert paddle.device.get_device() == "mynpu:0"
+            x = paddle.to_tensor(np.ones((2, 2), np.float32))
+            np.testing.assert_allclose((x + x).numpy(), 2 * np.ones((2, 2)))
+        finally:
+            P._default_place = old
+    finally:
+        P._CUSTOM_DEVICE_TYPES.pop("mynpu", None)
+        P._custom_devices.cache_clear()

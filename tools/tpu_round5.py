@@ -85,10 +85,12 @@ def record_lastgood(config, payload):
 SENTINEL = "BENCH_RESULT_JSON:"
 
 
-def bench_child(config, heads=None, budget=900):
+def bench_child(config, heads=None, budget=900, attn_impl=None):
     env = {"PADDLE_TPU_BENCH_PROGRESS": f"/tmp/r5_prog_{time.time_ns()}"}
     if heads:
         env["PADDLE_TPU_BENCH_1B_HEADS"] = str(heads)
+    if attn_impl:
+        env["PADDLE_TPU_ATTN_IMPL"] = attn_impl
     rc, out = run([sys.executable, os.path.join(REPO, "bench.py"), "--child",
                    f"--config={config}"], budget, env)
     for line in out.splitlines():
@@ -97,6 +99,8 @@ def bench_child(config, heads=None, budget=900):
             if "error" not in payload:
                 if heads:
                     payload["heads"] = heads
+                if attn_impl:
+                    payload["attn_impl"] = attn_impl
                 record_lastgood(config, payload)
                 return payload
     return None
@@ -136,7 +140,15 @@ def main():
     if p:
         log(f"llama_125m: MFU {p.get('mfu')} tok/s {p.get('value')}")
 
-    # Stage 4: 1B other geometry (A/B completeness)
+    # Stage 4: 1B winner geometry with the splash production kernel —
+    # the step-level attention A/B the microbench can't settle
+    p = bench_child("llama_1b", heads=win_heads, budget=1100,
+                    attn_impl="splash")
+    if p:
+        log(f"llama_1b heads={win_heads} splash: MFU {p.get('mfu')} "
+            f"tok/s {p.get('value')}")
+
+    # Stage 4b: 1B other geometry (A/B completeness)
     p = bench_child("llama_1b", heads=lose_heads, budget=1100)
     if p:
         log(f"llama_1b heads={lose_heads}: MFU {p.get('mfu')} "
