@@ -104,6 +104,12 @@ def run_bench(config="llama_125m", progress=None):
     # "backend_probing" conclusively names backend init (wedged pool) as
     # the stall, instead of leaving it inferred from "imports_done".
     progress.mark("backend_probing")
+    if os.environ.get("PADDLE_TPU_BENCH_SIMULATE_HANG") == "backend":
+        # forensics self-test hook: emulate a wedged pool (jax.devices()
+        # blocking in native code) so the harness can assert the artifact
+        # names backend_probing as the stalled stage
+        while True:
+            time.sleep(3600)
     dev = jax.devices()[0]
     on_tpu = dev.platform not in ("cpu", "gpu")
     progress.mark("backend_up", device=getattr(dev, "device_kind", str(dev)))

@@ -327,23 +327,32 @@ def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", name=None):
-    """y = x @ dequant(weight) + bias — the weight-only int8 serving matmul
-    (reference: incubate weight_only_linear; llm_int8_linear)."""
-    if weight_dtype != "int8":
+    """y = x @ dequant(weight) + bias — the weight-only serving matmul
+    (reference: incubate weight_only_linear; llm_int8_linear /
+    weight_only_linear_kernel.cu int4 path). ``int8``: weight is the
+    quantized [in, out] matrix; ``int4``: weight is the nibble-PACKED
+    [ceil(in/2), out] matrix from ``quantize_to_int4`` — unpack +
+    dequantize fuse into the matmul prologue under XLA."""
+    if weight_dtype not in ("int8", "int4"):
         raise NotImplementedError(
-            f"weight_only_linear supports weight_dtype='int8'; got "
-            f"{weight_dtype!r} (int4 packing not implemented)")
+            f"weight_only_linear supports weight_dtype='int8'/'int4'; got "
+            f"{weight_dtype!r}")
     if weight_scale is None:
         raise ValueError(
             "weight_only_linear requires weight_scale (the per-out-channel "
             "scales returned by weight_quantize)")
     extra = (bias,) if bias is not None else ()
     return op_call("weight_only_linear", _weight_only_linear,
-                   x, weight, weight_scale, *extra)
+                   x, weight, weight_scale, *extra,
+                   in_features=int(x.shape[-1]),
+                   packed_int4=(weight_dtype == "int4"))
 
 
 @op_body("weight_only_linear")
-def _weight_only_linear(a, q, s, *b):
+def _weight_only_linear(a, q, s, *b, in_features=None, packed_int4=False):
+    if packed_int4:
+        from ....quantization import unpack_int4
+        q = unpack_int4(q, in_features)
     w = q.astype(a.dtype) * s.reshape(1, -1).astype(a.dtype)
     out = a @ w
     return out + b[0] if b else out

@@ -389,3 +389,25 @@ def test_custom_device_registration():
     finally:
         P._CUSTOM_DEVICE_TYPES.pop("mynpu", None)
         P._custom_devices.cache_clear()
+
+
+def test_weight_only_linear_int4():
+    """int4 weight-only matmul: packed nibbles + per-channel scales give
+    the same result as dequantizing by hand (reference:
+    weight_only_linear weight_dtype='int4')."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.quantization import quantize_to_int4, unpack_int4
+
+    w = RNG.normal(size=(16, 8)).astype(np.float32)
+    x = RNG.normal(size=(3, 16)).astype(np.float32)
+    packed, scale = quantize_to_int4(paddle.to_tensor(w), axis=1)
+    out = IF.weight_only_linear(t(x), paddle.to_tensor(packed),
+                                weight_scale=paddle.to_tensor(
+                                    np.asarray(scale).reshape(-1)),
+                                weight_dtype="int4")
+    deq = np.asarray(unpack_int4(np.asarray(packed), 16)).astype(
+        np.float32) * np.asarray(scale).reshape(1, -1)
+    np.testing.assert_allclose(out.numpy(), x @ deq, rtol=1e-4, atol=1e-4)
+    # int4 quantization error stays small relative to the fp32 matmul
+    rel = np.abs(out.numpy() - x @ w).mean() / np.abs(x @ w).mean()
+    assert rel < 0.2
