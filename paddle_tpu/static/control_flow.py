@@ -41,26 +41,8 @@ def _scalar_pred(p):
     return jnp.reshape(a, ()).astype(bool)
 
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
-    """Run ``body`` while ``cond`` holds (reference:
-    static/nn/control_flow.py:755).
-
-    cond(*loop_vars) -> scalar bool Tensor; body(*loop_vars) -> new
-    loop_vars (same structure, shapes and dtypes). Compiles to ONE
-    ``lax.while_loop`` — the trip count is data-dependent on device, so a
-    decode loop traces once for every sequence. Works eagerly and under
-    ``paddle.jit.to_static``.
-
-    Gradients do not flow through the loop (XLA's while is not
-    reverse-differentiable); matches the reference's is_test usage — for
-    differentiable recurrences use a fixed-length loop (lax.scan via
-    nn.RNN) instead.
-    """
-    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
-        raise TypeError("loop_vars must be a non-empty list/tuple")
-    flat, tree = _flatten(list(loop_vars))
-    init = _to_arrays(flat)
-
+def _loop_fns(cond, body, tree):
+    """(cond, body) over Tensor trees -> (c, b) over flat array lists."""
     def c(arrs):
         vars_ = jax.tree.unflatten(tree, [Tensor(a) for a in arrs])
         with _ag.no_grad():
@@ -72,7 +54,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
             out = body(*vars_)
         if not isinstance(out, (list, tuple)):
             out = [out]
-        flat_o, tree_o = _flatten(list(out))
+        flat_o, _tree_o = _flatten(list(out))
         arrs_o = _to_arrays(flat_o)
         if len(arrs_o) != len(arrs):
             raise ValueError(
@@ -87,6 +69,62 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
                     "shape/dtype-invariant (pad to a static bound)")
         return arrs_o
 
+    return c, b
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
+    """Run ``body`` while ``cond`` holds (reference:
+    static/nn/control_flow.py:755).
+
+    cond(*loop_vars) -> scalar bool Tensor; body(*loop_vars) -> new
+    loop_vars (same structure, shapes and dtypes). Compiles to ONE
+    ``lax.while_loop`` — the trip count is data-dependent on device, so a
+    decode loop traces once for every sequence. Works eagerly and under
+    ``paddle.jit.to_static``.
+
+    Gradient semantics (the reference's while_grad op capability):
+    without a bound, XLA's while is not reverse-differentiable and
+    gradients do not flow. Pass ``maximum_trip_count`` to get the
+    TPU-native differentiable form: a ``lax.scan`` over the bound with
+    predicated carries — iterations past the condition's first False
+    keep the state unchanged (and are dead FLOPs, the price of a static
+    schedule), and the whole loop records on the autograd tape.
+
+    Gradients flow to the LOOP VARS: any tensor that needs a gradient
+    (weights included) must be passed through ``loop_vars`` and returned
+    by ``body`` (unchanged is fine) — a tensor captured in the closures
+    enters the compiled loop as a constant, exactly like the reference's
+    while block, whose differentiable externals become block inputs.
+    """
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list/tuple")
+    flat, tree = _flatten(list(loop_vars))
+    if maximum_trip_count is not None:
+        from ..core.dispatch import op_call
+        n_steps = int(maximum_trip_count)
+
+        def pure(*arrs):
+            c, b = _loop_fns(cond, body, tree)
+
+            def step(carry, _):
+                keep = c(carry)
+                new = b(carry)
+                merged = [jnp.where(keep, n, o)
+                          for n, o in zip(new, carry)]
+                return merged, None
+
+            out, _ = jax.lax.scan(step, list(arrs), None, length=n_steps)
+            return tuple(out)
+
+        tensors = [x if _is_tensor(x) else Tensor(jnp.asarray(x))
+                   for x in flat]
+        res = op_call(f"while_loop_bounded_{n_steps}", pure, *tensors)
+        if not isinstance(res, (list, tuple)):
+            res = (res,)
+        return jax.tree.unflatten(tree, list(res))
+    init = _to_arrays(flat)
+    c, b = _loop_fns(cond, body, tree)
     res = jax.lax.while_loop(c, b, init)
     out = jax.tree.unflatten(tree, [Tensor(r) for r in res])
     return out
