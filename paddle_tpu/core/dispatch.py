@@ -251,9 +251,17 @@ def op_call(op_name: str, default_fn, *args, **kwargs):
     ``FLAGS_enable_api_kernel_fallback`` is on (default, the reference's
     kernel-fallback behavior), the call retries with the default body.
     """
+    transient = kwargs.pop("_transient", False)
     body = OPS.get(op_name)
     if body is None:
-        OPS[op_name] = body = default_fn
+        if transient:
+            # per-call-site closures (bounded while_loop): resolve
+            # overrides by family name but never register the closure —
+            # a registry entry would pin the FIRST call's cond/body for
+            # every later loop sharing the name (and leak them)
+            body = default_fn
+        else:
+            OPS[op_name] = body = default_fn
     if _static_state is not None and _static_state.static_mode:
         # static-graph build (paddle.enable_static): ops over symbolic
         # Variables record into the current Program instead of executing

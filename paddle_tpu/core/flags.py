@@ -29,6 +29,14 @@ class _Flag:
         self.on_set = on_set     # callback(value): wire to live behavior
         env = os.environ.get(f"FLAGS_{name}")
         self.value = self._parse(env) if env is not None else default
+        if on_set is not None and env is not None:
+            # an env-provided value must reach the wiring too — launching
+            # with FLAGS_x=... is the canonical before-first-device-touch
+            # path (a callback failure must not break flag definition)
+            try:
+                on_set(self.value)
+            except Exception:
+                pass
 
     def _parse(self, s: str):
         if self.type is bool:

@@ -321,18 +321,29 @@ class ServingRouter:
       queue growth (classic serving-loop discipline).
     """
 
+    #: bounded per-request bookkeeping: latencies keep a sliding window
+    #: (percentiles reflect recent traffic) and shed tickets that are
+    #: never polled are evicted oldest-first instead of leaking — the
+    #: overload scenario shedding exists for must not grow router state
+    LATENCY_WINDOW = 2048
+    SHED_CAPACITY = 16384
+
     def __init__(self, max_batch_size=32, queue_deadline_ms=None):
+        import collections
         self.max_batch_size = max_batch_size
         self.queue_deadline_ms = queue_deadline_ms
         self._sessions = {}
         self._enqueue_t = {}        # ticket -> monotonic enqueue time
-        self._shed = set()
+        self._shed = collections.OrderedDict()   # ticket -> None (FIFO)
         self._stats = {}
 
     def add_model(self, name, predictor, warm_shapes=None):
+        import collections
         sess = ServingSession(predictor, self.max_batch_size)
         self._sessions[name] = sess
-        self._stats[name] = {"served": 0, "shed": 0, "latency_ms": []}
+        self._stats[name] = {
+            "served": 0, "shed": 0,
+            "latency_ms": collections.deque(maxlen=self.LATENCY_WINDOW)}
         if warm_shapes:
             sess.warm(warm_shapes)
         return sess
@@ -356,12 +367,15 @@ class ServingRouter:
         now = time.monotonic()
         keep = []
         for t, arrays in sess._pending:
-            age_ms = (now - self._enqueue_t.get((model, t), now)) * 1e3
+            age_ms = (now - self._enqueue_t.pop((model, t), now)) * 1e3
             if age_ms > self.queue_deadline_ms:
-                self._shed.add((model, t))
+                self._shed[(model, t)] = None
                 self._stats[model]["shed"] += 1
+                while len(self._shed) > self.SHED_CAPACITY:
+                    self._shed.popitem(last=False)
             else:
                 keep.append((t, arrays))
+                self._enqueue_t[(model, t)] = now - age_ms / 1e3
         sess._pending = keep
 
     def flush(self, model=None):
@@ -373,7 +387,7 @@ class ServingRouter:
         import time
         model, t = ticket
         if ticket in self._shed:
-            self._shed.discard(ticket)
+            del self._shed[ticket]
             self._enqueue_t.pop(ticket, None)
             raise RequestShed(
                 f"request {t} to {model!r} exceeded the "

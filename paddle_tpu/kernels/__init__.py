@@ -95,8 +95,6 @@ def install():
         # Env gates are read per call so tests/fixtures can flip them after
         # import; the backend probe is cached after the first call.
         forced = os.environ.get("PADDLE_TPU_FORCE_PALLAS") == "1"
-        use_pallas = forced or _on_tpu()
-        interpret = not _on_tpu()
         # PADDLE_TPU_ATTN_IMPL: step-level attention A/B selector
         # (round-5): auto (default tiering) | xla (pin the composition) |
         # flash (pin our Pallas kernel) | splash (pin jax's production
@@ -126,7 +124,9 @@ def install():
                                        dropout_p=dropout_p, scale=scale,
                                        dropout_key=dropout_key)
         if impl == "flash":
-            forced = True
+            forced = True        # pin the Pallas kernel (interpret off-TPU)
+        use_pallas = forced or _on_tpu()
+        interpret = not _on_tpu()
         # Measured on the v5e pool chip (scan-chained fwd+bwd, readback
         # sync; b=8 h=12 d=64): XLA composition beats every Pallas kernel
         # tried (ours, jax flash, splash) up to s=4096 — e.g. s=2048 XLA

@@ -119,7 +119,8 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
 
         tensors = [x if _is_tensor(x) else Tensor(jnp.asarray(x))
                    for x in flat]
-        res = op_call(f"while_loop_bounded_{n_steps}", pure, *tensors)
+        res = op_call("while_loop_bounded", pure, *tensors,
+                      _transient=True)
         if not isinstance(res, (list, tuple)):
             res = (res,)
         return jax.tree.unflatten(tree, list(res))

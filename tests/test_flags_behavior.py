@@ -576,3 +576,47 @@ def test_flags_disposition_is_complete():
     assert not undispositioned, undispositioned
     # and nothing is double-booked: implemented flags need no NA entry
     assert not (ours & set(mod.NA))
+
+
+def test_env_provided_wired_flag_fires_on_set():
+    """FLAGS_* provided via the ENVIRONMENT must reach the on_set wiring
+    too (launching with the env var is the canonical before-first-
+    device-touch path)."""
+    import subprocess
+    import sys
+    code = ("import os; import paddle_tpu; "
+            "print(os.environ.get('XLA_PYTHON_CLIENT_MEM_FRACTION'))")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**__import__('os').environ,
+             "FLAGS_fraction_of_gpu_memory_to_use": "0.25",
+             "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        capture_output=True, text=True, timeout=120)
+    assert out.stdout.strip() == "0.25", (out.stdout, out.stderr)
+
+
+def test_bounded_while_ops_do_not_collide():
+    """Two DIFFERENT bounded loops with the same trip bound must each run
+    their own cond/body (the op registry must not pin the first one)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    def mk(factor):
+        def cond(i, y):
+            return i < 3
+
+        def body(i, y):
+            return [i + 1, y * factor]
+        return cond, body
+
+    i0 = paddle.zeros([], "int32")
+    y0 = paddle.to_tensor(np.float32(1.0))
+    c1, b1 = mk(2.0)
+    _, y1 = static.nn.while_loop(c1, b1, [i0, y0], maximum_trip_count=8)
+    c2, b2 = mk(3.0)
+    _, y2 = static.nn.while_loop(c2, b2, [i0, y0], maximum_trip_count=8)
+    np.testing.assert_allclose(y1.numpy(), 8.0, rtol=1e-6)
+    np.testing.assert_allclose(y2.numpy(), 27.0, rtol=1e-6)
+    from paddle_tpu.core.dispatch import OPS
+    assert "while_loop_bounded" not in OPS   # transient: nothing pinned

@@ -269,14 +269,20 @@ def test_attn_impl_selector(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "xla")
     ref = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
 
+    # impl=flash alone must pin the Pallas kernel (interpret mode on
+    # CPU) — no PADDLE_TPU_FORCE_PALLAS needed; count the kernel calls
+    import paddle_tpu.kernels as K
+    calls = []
+    real = K.pallas_flash_attention
+    monkeypatch.setattr(K, "pallas_flash_attention",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
     monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "flash")
-    monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
     monkeypatch.setenv("PADDLE_TPU_FLASH_THRESHOLD", "128")
     out_flash = F.scaled_dot_product_attention(q, k, v,
                                                is_causal=True).numpy()
+    assert calls, "impl=flash did not reach the Pallas kernel"
     np.testing.assert_allclose(out_flash, ref, rtol=2e-3, atol=2e-3)
 
-    monkeypatch.delenv("PADDLE_TPU_FORCE_PALLAS")
     monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "splash")
     out_sp = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
     np.testing.assert_allclose(out_sp, ref, rtol=2e-3, atol=2e-3)
