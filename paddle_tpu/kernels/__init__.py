@@ -31,14 +31,17 @@ from .rms_norm import rms_norm as pallas_rms_norm
 
 _ON_TPU = None  # tri-state cache; resolved on first kernel call, NOT at import
 
-_SPLASH_KERNELS = {}  # (h, sq, sk, causal) -> compiled splash mha kernel
+_SPLASH_KERNELS = {}  # cache key -> compiled splash kernel
 
 
-def splash_attention(q, k, v, causal=True, scale=None):
+def splash_attention(q, k, v, causal=True, scale=None, interpret=False):
     """jax's production TPU splash-attention kernel over [b, h, s, d]
-    inputs (GQA key/value repeated to the query head count — the
-    kernel's MHA entry; per-shape kernels are cached). Selected by
-    PADDLE_TPU_ATTN_IMPL=splash for the step-level attention A/B."""
+    inputs. GQA is NATIVE: grouped key/value ride the MQA kernel vmapped
+    over kv heads — K/V are never repeated, so a 32/4-head model moves
+    8x less K/V HBM than the repeat-to-MHA formulation. Per-shape
+    kernels are cached; ``interpret=True`` runs the Pallas interpreter
+    (CPU numerics tests). Selected by PADDLE_TPU_ATTN_IMPL=splash for
+    the step-level attention A/B."""
     import math
 
     import jax.numpy as jnp
@@ -50,19 +53,31 @@ def splash_attention(q, k, v, causal=True, scale=None):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     hkv = k.shape[1]
-    if hkv != h:
-        k = jnp.repeat(k, h // hkv, axis=1)
-        v = jnp.repeat(v, h // hkv, axis=1)
-    key = (h, sq, skv, bool(causal))
-    kernel = _SPLASH_KERNELS.get(key)
-    if kernel is None:
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def _mask(n_heads):
         mk = (_sm.CausalMask((sq, skv)) if causal
               else _sm.FullMask((sq, skv)))
-        mask = _sm.MultiHeadMask([mk for _ in range(h)])
-        kernel = _sk.make_splash_mha(mask=mask, head_shards=1,
-                                     q_seq_shards=1)
+        return _sm.MultiHeadMask([mk for _ in range(n_heads)])
+
+    if hkv != h:
+        g = h // hkv
+        key = ("mqa", g, sq, skv, bool(causal), interpret)
+        kernel = _SPLASH_KERNELS.get(key)
+        if kernel is None:
+            kernel = _sk.make_splash_mqa_single_device(
+                mask=_mask(g), interpret=interpret)
+            _SPLASH_KERNELS[key] = kernel
+        qg = q.reshape(b, hkv, g, sq, d)
+        out = jax.vmap(jax.vmap(
+            lambda qq, kk, vv: kernel(qq * s, kk, vv)))(qg, k, v)
+        return out.reshape(b, h, sq, d)
+    key = ("mha", h, sq, skv, bool(causal), interpret)
+    kernel = _SPLASH_KERNELS.get(key)
+    if kernel is None:
+        kernel = _sk.make_splash_mha(mask=_mask(h), head_shards=1,
+                                     q_seq_shards=1, interpret=interpret)
         _SPLASH_KERNELS[key] = kernel
-    s = scale if scale is not None else 1.0 / math.sqrt(d)
     return jax.vmap(lambda qq, kk, vv: kernel(qq * s, kk, vv))(q, k, v)
 
 
@@ -105,13 +120,13 @@ def install():
             return _sdpa_reference(q, k, v, *rest, causal=causal,
                                    dropout_p=dropout_p, scale=scale,
                                    dropout_key=dropout_key)
-        if impl == "splash" and _on_tpu() and attn_mask is None \
-                and dropout_p == 0.0:
+        if impl == "splash" and attn_mask is None and dropout_p == 0.0:
             import jax.numpy as jnp
             try:
                 out = splash_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                    jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+                    jnp.swapaxes(v, 1, 2), causal=causal, scale=scale,
+                    interpret=not _on_tpu())
                 return jnp.swapaxes(out, 1, 2)
             except Exception:
                 from ..core.flags import GLOBAL_FLAGS

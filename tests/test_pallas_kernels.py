@@ -286,3 +286,31 @@ def test_attn_impl_selector(monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "splash")
     out_sp = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
     np.testing.assert_allclose(out_sp, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_splash_attention_gqa_native_numerics():
+    """The GQA-native splash path (MQA kernel vmapped over kv heads — no
+    K/V repeat) matches the repeated-K/V oracle, in interpret mode on
+    CPU. This is the production kernel the chip-window A/B engages."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import splash_attention
+
+    b, h, hkv, s, d = 1, 4, 2, 256, 64
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, hkv, s, d)), jnp.float32)
+
+    out = splash_attention(q, k, v, causal=True, interpret=True)
+
+    g = h // hkv
+    kk, vv = jnp.repeat(k, g, 1), jnp.repeat(v, g, 1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q / jnp.sqrt(1.0 * d), kk)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(jnp.where(mask, logits, -1e30), -1),
+                     vv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

@@ -76,24 +76,17 @@ for H, D in ((32, 64), (16, 128)):
         except Exception as e:
             print(f"h{H} d{D} ours bq{bq} FAILED {type(e).__name__}: {e}"[:160],
                   flush=True)
-    # jax splash (production TPU kernel)
+    # jax splash (production TPU kernel) — GQA-NATIVE via the MQA entry
+    # (grouped K/V, no repeat), the same wrapper the step-level
+    # PADDLE_TPU_ATTN_IMPL=splash path uses
     try:
-        from jax.experimental.pallas.ops.tpu.splash_attention import (
-            splash_attention_kernel as splash,
-            splash_attention_mask as mask_lib,
-        )
-        mask = mask_lib.MultiHeadMask(
-            [mask_lib.CausalMask((S, S)) for _ in range(H)])
-        kernel = splash.make_splash_mha(
-            mask=mask, head_shards=1, q_seq_shards=1)
+        from paddle_tpu.kernels import splash_attention
 
-        def run_splash(q, kv=kv, kernel=kernel, G=G):
-            k_full = jnp.repeat(kv[0], G, axis=0)
-            v_full = jnp.repeat(kv[0], G, axis=0)
-            return kernel(q[0] * (1.0 / math.sqrt(D)), k_full, v_full)[None]
+        def run_splash(q, kv=kv):
+            return splash_attention(q, kv, kv, causal=True)
 
         t = marginal(fb(run_splash), q)
-        print(f"h{H} d{D} splash fwd+bwd: {t*1e3:7.2f} ms", flush=True)
+        print(f"h{H} d{D} splash-gqa fwd+bwd: {t*1e3:7.2f} ms", flush=True)
     except Exception as e:
         print(f"h{H} d{D} splash FAILED {type(e).__name__}: {e}"[:200],
               flush=True)
