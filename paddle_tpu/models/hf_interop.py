@@ -1,0 +1,128 @@
+"""HuggingFace checkpoint interop for the flagship model family.
+
+Reference users load pretrained weights; this module converts a
+``transformers`` Llama state dict (torch tensors, HF conventions) into a
+:class:`~paddle_tpu.models.llama.LlamaForCausalLM`:
+
+- torch ``nn.Linear`` stores ``[out, in]``; ours stores ``[in, out]`` —
+  linear weights transpose (embeddings keep ``[vocab, hidden]``).
+- HF rope rotates half-split lane pairs ``(i, i + d/2)``; our rope
+  rotates adjacent pairs ``(2i, 2i+1)``. The two are equivalent under a
+  per-head permutation of the q/k projection output lanes
+  (``new[2i] = old[i]``, ``new[2i+1] = old[i + d/2]``) — attention is
+  invariant because q and k permute identically. The conversion applies
+  that permutation once at load time, so no runtime branch exists.
+
+Verified end to end by logits parity against ``transformers``'
+LlamaForCausalLM (tests/test_hf_interop.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _to_numpy(t):
+    if hasattr(t, "detach"):                 # torch tensor
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _rope_interleave_rows(w, n_heads, head_dim):
+    """Permute [out, in] q/k rows from HF half-split to interleaved."""
+    out_dim = w.shape[0]
+    assert out_dim == n_heads * head_dim, (out_dim, n_heads, head_dim)
+    w = w.reshape(n_heads, head_dim, -1)
+    half = head_dim // 2
+    idx = np.empty(head_dim, np.int64)
+    idx[0::2] = np.arange(half)
+    idx[1::2] = np.arange(half, head_dim)
+    return w[:, idx].reshape(out_dim, -1)
+
+
+def llama_config_from_hf(hf_config):
+    """Map a transformers LlamaConfig onto ours."""
+    from .llama import LlamaConfig
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=getattr(hf_config, "num_key_value_heads",
+                                    hf_config.num_attention_heads),
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                    False),
+    )
+
+
+def load_llama_state_dict(model, state_dict):
+    """Load an HF-convention Llama state dict into ``model`` in place.
+
+    ``state_dict``: name -> torch tensor / ndarray with transformers
+    names (``model.layers.N.self_attn.q_proj.weight`` ...). Missing
+    ``lm_head.weight`` falls back to the tied embedding.
+    """
+    import jax.numpy as jnp
+
+    cfg = model.config if hasattr(model, "config") else None
+    n_heads = cfg.num_attention_heads
+    n_kv = cfg.num_key_value_heads
+    hd = cfg.head_dim
+    sd = {k: v for k, v in state_dict.items()}
+    loaded, missing = [], []
+    for name, param in dict(model.named_parameters()).items():
+        src = sd.get(name)
+        if src is None and name == "lm_head.weight":
+            src = sd.get("model.embed_tokens.weight")
+            if src is not None:
+                # tied head: ours stores [in, out] = [hidden, vocab]
+                arr = _to_numpy(src).T
+                _assign(param, arr, name)
+                loaded.append(name)
+                continue
+        if src is None:
+            missing.append(name)
+            continue
+        arr = _to_numpy(src)
+        if name.endswith("q_proj.weight"):
+            arr = _rope_interleave_rows(arr, n_heads, hd).T
+        elif name.endswith("k_proj.weight"):
+            arr = _rope_interleave_rows(arr, n_kv, hd).T
+        elif arr.ndim == 2 and not name.endswith("embed_tokens.weight"):
+            arr = arr.T                      # torch [out,in] -> [in,out]
+        _assign(param, arr, name)
+        loaded.append(name)
+    if missing:
+        raise KeyError(
+            f"state dict is missing {len(missing)} parameters, e.g. "
+            f"{missing[:4]}")
+    del jnp
+    return loaded
+
+
+def _assign(param, arr, name):
+    import jax.numpy as jnp
+    if tuple(param.shape) != tuple(arr.shape):
+        raise ValueError(
+            f"{name}: checkpoint shape {tuple(arr.shape)} != model shape "
+            f"{tuple(param.shape)}")
+    param._data = jnp.asarray(np.ascontiguousarray(arr),
+                              dtype=param._data.dtype)
+
+
+def llama_from_hf(hf_model):
+    """Build our LlamaForCausalLM from a transformers LlamaForCausalLM
+    instance (or anything with ``.config`` and ``.state_dict()`` in HF
+    Llama conventions) — config mapped, weights converted."""
+    from .llama import LlamaForCausalLM
+    cfg = llama_config_from_hf(hf_model.config)
+    model = LlamaForCausalLM(cfg)
+    load_llama_state_dict(model, hf_model.state_dict())
+    return model
+
+
+__all__ = ["llama_from_hf", "load_llama_state_dict",
+           "llama_config_from_hf"]

@@ -65,7 +65,7 @@ for H, D in ((32, 64), (16, 128)):
               flush=True)
     except Exception as e:
         print(f"h{H} d{D} xla FAILED {type(e).__name__}: {e}"[:160], flush=True)
-    for bq, bk in ((256, 512), (512, 512)):
+    for bq, bk in ((256, 512), (512, 512), (512, 1024)):
         if time.time() - T0 > DEADLINE:
             break
         try:
