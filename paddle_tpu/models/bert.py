@@ -48,8 +48,11 @@ class BertEmbeddings(nn.Layer):
         s = input_ids.shape[1]
         pos = T.arange(s, dtype="int64").unsqueeze(0)
         e = self.word_embeddings(input_ids) + self.position_embeddings(pos)
-        if token_type_ids is not None:
-            e = e + self.token_type_embeddings(token_type_ids)
+        if token_type_ids is None:
+            # segment 0 by default — HF/reference semantics: the type-0
+            # embedding row is ALWAYS added, not skipped
+            token_type_ids = T.zeros_like(input_ids)
+        e = e + self.token_type_embeddings(token_type_ids)
         return self.dropout(self.layer_norm(e))
 
 

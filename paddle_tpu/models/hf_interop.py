@@ -124,5 +124,88 @@ def llama_from_hf(hf_model):
     return model
 
 
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+_BERT_LAYER_MAP = {
+    "attention.self.query": "self_attn.q_proj",
+    "attention.self.key": "self_attn.k_proj",
+    "attention.self.value": "self_attn.v_proj",
+    "attention.output.dense": "self_attn.out_proj",
+    "attention.output.LayerNorm": "norm1",
+    "intermediate.dense": "linear1",
+    "output.dense": "linear2",
+    "output.LayerNorm": "norm2",
+}
+
+
+def _bert_name_map(hf_name):
+    """transformers BertModel name -> our BertModel name."""
+    n = hf_name
+    n = n.replace("embeddings.LayerNorm", "embeddings.layer_norm")
+    if n.startswith("encoder.layer."):
+        rest = n[len("encoder.layer."):]
+        idx, _, tail = rest.partition(".")
+        for hf_part, ours in _BERT_LAYER_MAP.items():
+            if tail.startswith(hf_part + "."):
+                suffix = tail[len(hf_part):]
+                return f"encoder.layers.{idx}.{ours}{suffix}"
+        return None
+    if n.startswith("pooler.dense."):
+        return "pooler." + n[len("pooler.dense."):]
+    return n
+
+
+def bert_config_from_hf(hf_config):
+    from .bert import BertConfig
+    return BertConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        hidden_dropout_prob=hf_config.hidden_dropout_prob,
+    )
+
+
+def load_bert_state_dict(model, state_dict):
+    """Load a transformers BertModel state dict into our BertModel
+    (name map + [out,in]->[in,out] linear transpose)."""
+    mapped = {}
+    for hf_name, v in state_dict.items():
+        ours = _bert_name_map(hf_name)
+        if ours is not None:
+            mapped[ours] = v
+    params = dict(model.named_parameters())
+    missing = []
+    for name, param in params.items():
+        src = mapped.get(name)
+        if src is None:
+            missing.append(name)
+            continue
+        arr = _to_numpy(src)
+        if arr.ndim == 2 and "embeddings." not in name:
+            arr = arr.T
+        _assign(param, arr, name)
+    if missing:
+        raise KeyError(
+            f"state dict is missing {len(missing)} parameters, e.g. "
+            f"{missing[:4]}")
+    return sorted(mapped)
+
+
+def bert_from_hf(hf_model):
+    """Build our BertModel from a transformers BertModel instance."""
+    from .bert import BertModel
+    model = BertModel(bert_config_from_hf(hf_model.config))
+    load_bert_state_dict(model, hf_model.state_dict())
+    return model
+
+
 __all__ = ["llama_from_hf", "load_llama_state_dict",
-           "llama_config_from_hf"]
+           "llama_config_from_hf", "bert_from_hf",
+           "load_bert_state_dict", "bert_config_from_hf"]

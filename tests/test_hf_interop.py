@@ -51,3 +51,28 @@ def test_shape_mismatch_rejected():
     model = LlamaForCausalLM(cfg)
     with pytest.raises(ValueError, match="shape"):
         load_llama_state_dict(model, hf.state_dict())
+
+
+def test_bert_hidden_state_parity_with_transformers():
+    torch = pytest.importorskip("torch")
+    tr = pytest.importorskip("transformers")
+    cfg = tr.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = tr.BertModel(cfg).eval()
+    from paddle_tpu.models import bert_from_hf
+    ours = bert_from_hf(hf)
+    ours.eval()
+
+    ids = np.random.default_rng(1).integers(0, 96, (2, 9))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids))
+    h, pooled = ours(paddle.to_tensor(ids, dtype="int64"))
+    np.testing.assert_allclose(h.numpy(), ref.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(pooled.numpy(), ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
