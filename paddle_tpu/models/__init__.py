@@ -14,6 +14,10 @@ from .llama import (  # noqa: F401
 )
 from .unet import UNetModel, sd_unet, sd_unet_tiny  # noqa: F401
 from .generation import Generator, generate  # noqa: F401
+from .llama_moe import (  # noqa: F401
+    LlamaMoeConfig, LlamaMoeModel, LlamaMoeForCausalLM,
+    llama_moe_tiny_config,
+)
 from .hf_interop import (  # noqa: F401
     llama_from_hf, load_llama_state_dict, llama_config_from_hf,
     bert_from_hf, load_bert_state_dict, bert_config_from_hf,

@@ -1,0 +1,78 @@
+"""MoE Llama family: forward, training convergence with aux loss,
+compiled TrainStep, and EP-sharded execution on the virtual mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaMoeForCausalLM, llama_moe_tiny_config
+
+
+def test_forward_shapes_and_aux_loss():
+    paddle.seed(0)
+    cfg = llama_moe_tiny_config()
+    m = LlamaMoeForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        dtype="int64")
+    logits = m(ids)
+    assert list(logits.shape) == [2, 16, cfg.vocab_size]
+    # gate aux loss exists after a forward and folds into the loss
+    _, loss = m(ids, labels=ids)
+    aux = m.model.aux_loss()
+    assert aux is not None and np.isfinite(float(aux.numpy()))
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_mixed_dense_moe_layers():
+    cfg = llama_moe_tiny_config(moe_layer_interval=2)
+    m = LlamaMoeForCausalLM(cfg)
+    kinds = [hasattr(layer.mlp, "experts") for layer in m.model.layers]
+    assert kinds == [True, False]
+
+
+@pytest.mark.slow
+def test_train_step_converges_compiled():
+    paddle.seed(1)
+    cfg = llama_moe_tiny_config(num_hidden_layers=1, num_experts=2)
+    m = LlamaMoeForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, lambda ids: m(ids, labels=ids)[1], opt)
+    ids = paddle.to_tensor(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 12)),
+        dtype="int64")
+    losses = [float(step(ids).numpy()) for _ in range(12)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses   # memorizes the batch
+
+
+@pytest.mark.slow
+def test_expert_parallel_grads_on_mesh():
+    """The stacked expert weights shard over ep; one fwd+bwd step of the
+    MoE FFN block through the explicit all-to-all path on 8 devices."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.expert_parallel import moe_alltoall
+
+    mesh = dist.init_mesh({"ep": 8})
+    rng = np.random.default_rng(2)
+    T, M, E = 32, 16, 8
+    x = jnp.asarray(rng.standard_normal((T, M), np.float32))
+    gate_w = jnp.asarray(rng.standard_normal((M, E), np.float32))
+    params = {
+        "gate": jnp.asarray(rng.standard_normal((M, 2 * M), np.float32) * .1),
+        "up": jnp.asarray(rng.standard_normal((M, 2 * M), np.float32) * .1),
+        "down": jnp.asarray(rng.standard_normal((2 * M, M), np.float32) * .1)}
+    params = {k: jnp.stack([v] * E) for k, v in params.items()}
+
+    def swiglu_expert(p, h):
+        return (jax.nn.silu(h @ p["gate"]) * (h @ p["up"])) @ p["down"]
+
+    def loss(x, gw, p):
+        y, aux = moe_alltoall(x, gw, p, swiglu_expert, mesh)
+        return (y * y).mean() + 0.01 * aux
+
+    g = jax.jit(jax.grad(loss, argnums=(1, 2)))(x, gate_w, params)
+    assert all(bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(g))
