@@ -172,6 +172,12 @@ def test_sequence_parallel_utils_single_process():
     sequence_parallel_utils.py): single-process semantics (world=1 —
     scatter/gather identity), parameter marking + allreduce hooks."""
     spu = paddle.distributed.fleet.utils.sequence_parallel_utils
+    # the SP ops resolve their mp group from the fleet hcg global — an
+    # earlier fleet-topology test leaving mp>1 behind would change the
+    # semantics this test pins; force the single-process default
+    from paddle_tpu.distributed.fleet import topology as _topo
+    _saved_hcg = _topo.get_hybrid_communicate_group()
+    _topo.set_hybrid_communicate_group(None)
     x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3),
                          stop_gradient=False)
     s = spu.scatter(x)
@@ -195,6 +201,7 @@ def test_sequence_parallel_utils_single_process():
     # the SP linear classes resolve (GSPMD regime: plain parallel linears)
     assert spu.ColumnSequenceParallelLinear is not None
     assert spu.RowSequenceParallelLinear is not None
+    _topo.set_hybrid_communicate_group(_saved_hcg)
 
 
 def test_mix_precision_utils_main_grad():

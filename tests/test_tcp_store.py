@@ -59,10 +59,11 @@ def test_wait_blocks_until_set():
 
 def _worker(port, rank, world, q):
     try:
-        store = TCPStore("127.0.0.1", port, timeout=20)
+        store = TCPStore("127.0.0.1", port, timeout=150)
         store.set(f"rank/{rank}", str(rank * 10))
         n = store.add("barrier", 1)
-        store.wait("all_ready", timeout=20)
+        # generous: the LAST worker to finish importing gates the release
+        store.wait("all_ready", timeout=150)
         peers = store.get_prefix("rank/")
         q.put((rank, n, sorted(peers)))
         store.close()
@@ -77,7 +78,7 @@ def test_multiprocess_rendezvous():
     counter reaches world size, master releases, everyone sees all keys."""
     world = 3
     master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world,
-                      timeout=20)
+                      timeout=180)
     try:
         ctx = mp.get_context("spawn")
         q = ctx.Queue()
@@ -86,16 +87,24 @@ def test_multiprocess_rendezvous():
                  for r in range(world)]
         for p in procs:
             p.start()
-        # master releases when the barrier counter shows everyone arrived
-        deadline = time.monotonic() + 20
+        # master releases ONLY once the barrier counter shows everyone
+        # arrived. The deadline must absorb three spawned interpreters
+        # cold-importing the framework serially on a loaded single-core
+        # box (~20-60 s); releasing early would let workers race their
+        # rank/N publications — the exact bug the barrier prevents.
+        deadline = time.monotonic() + 150
+        arrived = 0
         while time.monotonic() < deadline:
-            if int(master.try_get("barrier") or 0) >= world:
+            arrived = int(master.try_get("barrier") or 0)
+            if arrived >= world:
                 break
             time.sleep(0.05)
+        assert arrived >= world, (
+            f"barrier reached {arrived}/{world} before deadline")
         master.set("all_ready", "1")
-        results = [q.get(timeout=20) for _ in range(world)]
+        results = [q.get(timeout=60) for _ in range(world)]
         for p in procs:
-            p.join(timeout=10)
+            p.join(timeout=30)
         for rank, n, peers in sorted(results):
             assert n != "err", peers
             assert peers == ["rank/0", "rank/1", "rank/2"]
