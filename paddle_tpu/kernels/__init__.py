@@ -120,7 +120,14 @@ def install():
             return _sdpa_reference(q, k, v, *rest, causal=causal,
                                    dropout_p=dropout_p, scale=scale,
                                    dropout_key=dropout_key)
-        if impl == "splash" and attn_mask is None and dropout_p == 0.0:
+        # splash engages on TPU, or off-TPU only under the explicit
+        # interpreter opt-in (numerics tests) — a pinned launch config
+        # carried onto a CPU/GPU dev box must fall through to native-
+        # speed tiers, not silently run interpreter-mode attention
+        splash_ok = _on_tpu() or \
+            os.environ.get("PADDLE_TPU_SPLASH_INTERPRET") == "1"
+        if impl == "splash" and splash_ok and attn_mask is None \
+                and dropout_p == 0.0:
             import jax.numpy as jnp
             try:
                 out = splash_attention(

@@ -65,8 +65,6 @@ def load_llama_state_dict(model, state_dict):
     names (``model.layers.N.self_attn.q_proj.weight`` ...). Missing
     ``lm_head.weight`` falls back to the tied embedding.
     """
-    import jax.numpy as jnp
-
     cfg = model.config if hasattr(model, "config") else None
     n_heads = cfg.num_attention_heads
     n_kv = cfg.num_key_value_heads
@@ -99,7 +97,6 @@ def load_llama_state_dict(model, state_dict):
         raise KeyError(
             f"state dict is missing {len(missing)} parameters, e.g. "
             f"{missing[:4]}")
-    del jnp
     return loaded
 
 

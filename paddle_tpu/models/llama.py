@@ -88,6 +88,21 @@ def apply_rotary_pos_emb(q, k, position_ids=None, theta=10000.0, rope_cs=None):
 LlamaRMSNorm = nn.RMSNorm
 
 
+def init_llama_weights(root_layer, std):
+    """Llama init recipe: every Linear / Embedding weight ~ N(0, std)
+    (norm scales stay at ones). The layer defaults (Xavier / N(0,1)) are
+    fine standalone but wrong jointly: a N(0,1) embedding through a tied
+    head produces O(sqrt(hidden)) logits at init. Shared by the dense
+    and MoE causal-LM families."""
+    from ..nn.initializer import Normal
+
+    init = Normal(0.0, std)
+    for layer in root_layer.sublayers(include_self=True):
+        w = getattr(layer, "weight", None)
+        if isinstance(layer, (nn.Linear, nn.Embedding)) and w is not None:
+            w._inplace_update(init(w.shape, w._data.dtype))
+
+
 class LlamaAttention(nn.Layer):
     """GQA attention with RoPE; [b, s, h, d] layout end to end."""
 
@@ -192,17 +207,7 @@ class LlamaForCausalLM(nn.Layer):
         self._init_weights(config.initializer_range)
 
     def _init_weights(self, std):
-        """Llama init recipe: every Linear / Embedding weight ~ N(0, std)
-        (norm scales stay at ones). The layer defaults (Xavier / N(0,1))
-        are fine standalone but wrong jointly: a N(0,1) embedding through
-        a tied head produces O(sqrt(hidden)) logits at init."""
-        from ..nn.initializer import Normal
-
-        init = Normal(0.0, std)
-        for layer in self.sublayers(include_self=True):
-            w = getattr(layer, "weight", None)
-            if isinstance(layer, (nn.Linear, nn.Embedding)) and w is not None:
-                w._inplace_update(init(w.shape, w._data.dtype))
+        init_llama_weights(self, std)
 
     def forward(self, input_ids, labels=None, position_ids=None, attn_mask=None):
         h = self.model(input_ids, position_ids, attn_mask)

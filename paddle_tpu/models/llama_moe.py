@@ -78,8 +78,13 @@ class LlamaMoeModel(nn.Layer):
             else input_ids.shape[1]
         rope_cs = F.rope_tables(pos, self.config.head_dim,
                                 self.config.rope_theta)
-        for layer in self.layers:
-            h = layer(h, position_ids, attn_mask, rope_cs)
+        if self.config.remat:
+            from ..distributed.fleet.recompute import recompute
+            for layer in self.layers:
+                h = recompute(layer, h, position_ids, attn_mask, rope_cs)
+        else:
+            for layer in self.layers:
+                h = layer(h, position_ids, attn_mask, rope_cs)
         return self.norm(h)
 
     def aux_loss(self):
@@ -107,11 +112,27 @@ class LlamaMoeForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
+        from .llama import init_llama_weights
+        init_llama_weights(self, config.initializer_range)
 
     def forward(self, input_ids, labels=None, position_ids=None,
                 attn_mask=None):
         from .. import tensor as T
         h = self.model(input_ids, position_ids, attn_mask)
+        if labels is not None and self.config.loss_chunk_size:
+            # memory-efficient chunked linear+CE head (dense-family
+            # parity — no full [tokens, vocab] logits on this path)
+            w = (self.model.embed_tokens.weight if self.lm_head is None
+                 else self.lm_head.weight)
+            loss = F.fused_linear_cross_entropy(
+                h[:, :-1].reshape([-1, self.config.hidden_size]), w,
+                labels[:, 1:].reshape([-1]),
+                chunk_size=self.config.loss_chunk_size,
+                transpose_weight=self.lm_head is None)
+            aux = self.model.aux_loss()
+            if aux is not None:
+                loss = loss + self.config.aux_loss_weight * aux
+            return None, loss
         if self.lm_head is None:
             logits = T.matmul(h, self.model.embed_tokens.weight,
                               transpose_y=True)

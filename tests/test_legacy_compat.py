@@ -178,30 +178,32 @@ def test_sequence_parallel_utils_single_process():
     from paddle_tpu.distributed.fleet import topology as _topo
     _saved_hcg = _topo.get_hybrid_communicate_group()
     _topo.set_hybrid_communicate_group(None)
-    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3),
-                         stop_gradient=False)
-    s = spu.scatter(x)
-    np.testing.assert_allclose(s.numpy(), x.numpy())  # world=1: identity
-    g = spu.GatherOp.apply(s)
-    np.testing.assert_allclose(g.numpy(), x.numpy())
-    out = spu.ReduceScatterOp.apply(spu.AllGatherOp.apply(g))
-    (out * 2.0).sum().backward()
-    assert x.grad is not None
-    np.testing.assert_allclose(x.grad.numpy(), np.full((4, 3), 2.0))
+    try:
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3),
+                             stop_gradient=False)
+        s = spu.scatter(x)
+        np.testing.assert_allclose(s.numpy(), x.numpy())  # world=1: identity
+        g = spu.GatherOp.apply(s)
+        np.testing.assert_allclose(g.numpy(), x.numpy())
+        out = spu.ReduceScatterOp.apply(spu.AllGatherOp.apply(g))
+        (out * 2.0).sum().backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(), np.full((4, 3), 2.0))
 
-    lin = paddle.nn.Linear(3, 3)
-    spu.mark_as_sequence_parallel_parameter(lin.bias)
-    assert spu.is_sequence_parallel_parameter(lin.bias)
-    assert not spu.is_sequence_parallel_parameter(lin.weight)
-    n = spu.register_sequence_parallel_allreduce_hooks(lin)
-    assert n == 1
-    y = lin(x.detach())
-    y.sum().backward()
-    assert lin.bias.grad is not None
-    # the SP linear classes resolve (GSPMD regime: plain parallel linears)
-    assert spu.ColumnSequenceParallelLinear is not None
-    assert spu.RowSequenceParallelLinear is not None
-    _topo.set_hybrid_communicate_group(_saved_hcg)
+        lin = paddle.nn.Linear(3, 3)
+        spu.mark_as_sequence_parallel_parameter(lin.bias)
+        assert spu.is_sequence_parallel_parameter(lin.bias)
+        assert not spu.is_sequence_parallel_parameter(lin.weight)
+        n = spu.register_sequence_parallel_allreduce_hooks(lin)
+        assert n == 1
+        y = lin(x.detach())
+        y.sum().backward()
+        assert lin.bias.grad is not None
+        # the SP linear classes resolve (GSPMD regime: plain parallel linears)
+        assert spu.ColumnSequenceParallelLinear is not None
+        assert spu.RowSequenceParallelLinear is not None
+    finally:
+        _topo.set_hybrid_communicate_group(_saved_hcg)
 
 
 def test_mix_precision_utils_main_grad():

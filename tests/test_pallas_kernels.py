@@ -283,9 +283,16 @@ def test_attn_impl_selector(monkeypatch):
     assert calls, "impl=flash did not reach the Pallas kernel"
     np.testing.assert_allclose(out_flash, ref, rtol=2e-3, atol=2e-3)
 
+    # splash off-TPU needs the explicit interpreter opt-in; without it
+    # the pinned config falls through to a native-speed tier
     monkeypatch.setenv("PADDLE_TPU_ATTN_IMPL", "splash")
+    monkeypatch.setenv("PADDLE_TPU_SPLASH_INTERPRET", "1")
     out_sp = F.scaled_dot_product_attention(q, k, v, is_causal=True).numpy()
     np.testing.assert_allclose(out_sp, ref, rtol=2e-3, atol=2e-3)
+    monkeypatch.delenv("PADDLE_TPU_SPLASH_INTERPRET")
+    out_fallthrough = F.scaled_dot_product_attention(
+        q, k, v, is_causal=True).numpy()
+    np.testing.assert_allclose(out_fallthrough, ref, rtol=2e-3, atol=2e-3)
 
 
 def test_splash_attention_gqa_native_numerics():
