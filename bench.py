@@ -129,7 +129,22 @@ def run_bench(config="llama_125m", progress=None):
         # PADDLE_TPU_BENCH_1B_HEADS: head-count A/B (32 -> d=64, the
         # TinyLlama geometry; 16 -> d=128, the TPU-native geometry that
         # fills the MXU's 128 contraction lanes — docs/PERF.md 2a).
-        heads = int(os.environ.get("PADDLE_TPU_BENCH_1B_HEADS", "32"))
+        # Default comes from the last recorded sweep verdict
+        # (tools/attn_geometry.json, written by tools/tpu_round5.py when
+        # the chip-window experiment actually ran) so the driver's bench
+        # adopts measured winners automatically; env overrides.
+        heads, attn_impl = 32, None
+        try:
+            with open(os.path.join(os.path.dirname(os.path.abspath(
+                    __file__)), "tools", "attn_geometry.json")) as f:
+                geo = json.load(f)
+            heads = int(geo.get("heads", heads))
+            attn_impl = geo.get("attn_impl")
+        except (OSError, ValueError):
+            pass
+        heads = int(os.environ.get("PADDLE_TPU_BENCH_1B_HEADS", heads))
+        if attn_impl and "PADDLE_TPU_ATTN_IMPL" not in os.environ:
+            os.environ["PADDLE_TPU_ATTN_IMPL"] = attn_impl
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
                           intermediate_size=5632, num_hidden_layers=22,
                           num_attention_heads=heads, num_key_value_heads=4,

@@ -319,6 +319,10 @@ class ServingRouter:
       time is dropped with :class:`RequestShed` instead of riding a
       batch it can no longer meet — bounded tail latency over unbounded
       queue growth (classic serving-loop discipline).
+
+    Like the reference predictor, a router instance serves ONE driving
+    thread (clone per thread); the per-model compiled-step caches are
+    the only state safely shared through the underlying sessions.
     """
 
     #: bounded per-request bookkeeping: latencies keep a sliding window

@@ -129,11 +129,30 @@ def main():
     log(f"sweep verdict: d64 best {best[64]:.2f} ms ({impl[64]}), "
         f"d128 best {best[128]:.2f} ms ({impl[128]}) -> heads={win_heads}")
 
+    def record_geometry(heads, attn_impl=None, basis=""):
+        """Adopt a MEASURED winner as the bench default (env overrides)."""
+        path = os.path.join(HERE, "attn_geometry.json")
+        blob = {"heads": heads,
+                "recorded": time.strftime("%Y-%m-%d %H:%M UTC",
+                                          time.gmtime()),
+                "basis": basis}
+        if attn_impl:
+            blob["attn_impl"] = attn_impl
+        with open(path, "w") as f:
+            json.dump(blob, f, indent=1)
+        log(f"adopted geometry: {blob}")
+
+    win_key = 128 if win_heads == 16 else 64
+    if best[win_key] != float("inf"):
+        record_geometry(win_heads,
+                        basis=f"attn_sweep_1b: d64 {best[64]:.2f} ms vs "
+                              f"d128 {best[128]:.2f} ms")
+
     # Stage 2: 1B bench, winning geometry — the headline number
-    p = bench_child("llama_1b", heads=win_heads, budget=1100)
-    if p:
-        log(f"HEADLINE llama_1b heads={win_heads}: MFU {p.get('mfu')} "
-            f"tok/s {p.get('value')}")
+    p_auto = bench_child("llama_1b", heads=win_heads, budget=1100)
+    if p_auto:
+        log(f"HEADLINE llama_1b heads={win_heads}: MFU {p_auto.get('mfu')} "
+            f"tok/s {p_auto.get('value')}")
 
     # Stage 3: 125m bench (the lastgood headline config)
     p = bench_child("llama_125m", budget=700)
@@ -147,6 +166,12 @@ def main():
     if p:
         log(f"llama_1b heads={win_heads} splash: MFU {p.get('mfu')} "
             f"tok/s {p.get('value')}")
+        if p_auto and p.get("mfu", 0) > p_auto.get("mfu", 0) * 1.02:
+            # splash beats the auto tier by >2% at the STEP level:
+            # adopt it for the bench default too
+            record_geometry(win_heads, attn_impl="splash",
+                            basis=f"step A/B: splash MFU {p['mfu']} vs "
+                                  f"auto {p_auto['mfu']}")
 
     # Stage 4b: 1B other geometry (A/B completeness)
     p = bench_child("llama_1b", heads=lose_heads, budget=1100)
