@@ -129,6 +129,11 @@ class FusedMultiHeadAttention(nn.Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if key is not None or value is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention is self-attention only (the "
+                "reference fused kernel's contract); pass query alone — "
+                "cross attention is served by nn.MultiHeadAttention")
         if cache is not None:
             raise NotImplementedError(
                 "FusedMultiHeadAttention incremental decode (cache=) is "

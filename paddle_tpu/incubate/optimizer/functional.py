@@ -82,10 +82,17 @@ def minimize_bfgs(objective_func, initial_position, max_iters=50,
     """reference: incubate/optimizer/functional/bfgs.py:30. Returns
     (is_converge, num_func_calls, position, objective_value,
     objective_gradient, inverse_hessian_estimate)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"minimize_bfgs supports line_search_fn='strong_wolfe' "
+            f"(the reference's only implemented search); got "
+            f"{line_search_fn!r}")
+    from ...core.dtype import to_jax_dtype
     fg = _value_and_grad(objective_func)
     x = jnp.asarray(initial_position._data
                     if isinstance(initial_position, Tensor)
-                    else np.asarray(initial_position))
+                    else np.asarray(initial_position)).astype(
+        to_jax_dtype(dtype))
     n = x.size
     H = jnp.eye(n, dtype=x.dtype) \
         if initial_inverse_hessian_estimate is None \
@@ -134,10 +141,21 @@ def minimize_lbfgs(objective_func, initial_position, history_size=100,
     """reference: incubate/optimizer/functional/lbfgs.py:30. Returns
     (is_converge, num_func_calls, position, objective_value,
     objective_gradient)."""
+    if line_search_fn != "strong_wolfe":
+        raise NotImplementedError(
+            f"minimize_lbfgs supports line_search_fn='strong_wolfe'; "
+            f"got {line_search_fn!r}")
+    if initial_inverse_hessian_estimate is not None:
+        raise NotImplementedError(
+            "minimize_lbfgs: a custom initial inverse-Hessian is not "
+            "supported (the two-loop recursion uses the standard gamma "
+            "scaling); use minimize_bfgs for an explicit H0")
+    from ...core.dtype import to_jax_dtype
     fg = _value_and_grad(objective_func)
     x = jnp.asarray(initial_position._data
                     if isinstance(initial_position, Tensor)
-                    else np.asarray(initial_position))
+                    else np.asarray(initial_position)).astype(
+        to_jax_dtype(dtype))
     f, g = fg(x)
     calls = 1
     hist_s, hist_y, hist_rho = [], [], []

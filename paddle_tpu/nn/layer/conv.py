@@ -28,6 +28,14 @@ class _ConvNd(Layer):
         self._nd = nd
         self._transpose = transpose
         self._output_padding = output_padding
+        if padding_mode not in ("zeros", "reflect", "replicate",
+                                "circular"):
+            raise ValueError(f"unknown padding_mode {padding_mode!r}")
+        if padding_mode != "zeros" and transpose:
+            raise ValueError(
+                "conv transpose supports padding_mode='zeros' only "
+                "(reference constraint)")
+        self._padding_mode = padding_mode
         if transpose:
             w_shape = [in_channels, out_channels // groups, *self._kernel_size]
         else:
@@ -38,6 +46,24 @@ class _ConvNd(Layer):
             default_initializer=I.XavierUniform(fan_in=None))
         self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True) \
             if bias_attr is not False else None
+
+    def _pre_pad(self, x):
+        """Non-zeros padding modes (reflect/replicate/circular) pre-pad
+        the input explicitly, then convolve with padding 0 — the
+        reference's padding_mode semantics."""
+        if self._padding_mode == "zeros":
+            return x, self._padding
+        if isinstance(self._padding, str):
+            raise ValueError(
+                "padding_mode != 'zeros' requires numeric padding "
+                f"(got {self._padding!r})")
+        p = _tuple(self._padding, self._nd)
+        pads = []
+        for d in reversed(range(self._nd)):
+            pads += [int(p[d]), int(p[d])]
+        x = F.pad(x, pads, mode=self._padding_mode,
+                  data_format=self._data_format)
+        return x, 0
 
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
@@ -52,7 +78,8 @@ class Conv1D(_ConvNd):
                          dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+        x, pad = self._pre_pad(x)
+        return F.conv1d(x, self.weight, self.bias, self._stride, pad,
                         self._dilation, self._groups, self._data_format)
 
 
@@ -64,7 +91,8 @@ class Conv2D(_ConvNd):
                          dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+        x, pad = self._pre_pad(x)
+        return F.conv2d(x, self.weight, self.bias, self._stride, pad,
                         self._dilation, self._groups, self._data_format)
 
 
@@ -76,7 +104,8 @@ class Conv3D(_ConvNd):
                          dilation, groups, padding_mode, weight_attr, bias_attr, data_format)
 
     def forward(self, x):
-        return F.conv3d(x, self.weight, self.bias, self._stride, self._padding,
+        x, pad = self._pre_pad(x)
+        return F.conv3d(x, self.weight, self.bias, self._stride, pad,
                         self._dilation, self._groups, self._data_format)
 
 

@@ -131,10 +131,15 @@ class GroupNorm(Layer):
 
 
 class InstanceNorm1D(Layer):
+    """``momentum`` is accepted for signature parity: like the reference,
+    InstanceNormND tracks no running statistics (always instance
+    stats)."""
+
     def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
                  bias_attr=None, data_format="NCL", name=None):
         super().__init__()
         self._epsilon = epsilon
+        self._data_format = data_format
         self.weight = self.create_parameter([num_features], attr=weight_attr,
                                             default_initializer=I.Constant(1.0)) \
             if weight_attr is not False else None
@@ -142,7 +147,9 @@ class InstanceNorm1D(Layer):
             if bias_attr is not False else None
 
     def forward(self, x):
-        return F.instance_norm(x, weight=self.weight, bias=self.bias, eps=self._epsilon)
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self._epsilon,
+                               data_format=self._data_format)
 
 
 class InstanceNorm2D(InstanceNorm1D):

@@ -36,9 +36,10 @@ class _AdaptivePool(Layer):
         super().__init__()
         self.output_size = output_size
         self.fn_name = fn_name
+        self.kw = kwargs
 
     def forward(self, x):
-        return getattr(F, self.fn_name)(x, self.output_size)
+        return getattr(F, self.fn_name)(x, self.output_size, **self.kw)
 
 
 class AdaptiveAvgPool1D(_AdaptivePool):
@@ -48,27 +49,32 @@ class AdaptiveAvgPool1D(_AdaptivePool):
 
 class AdaptiveAvgPool2D(_AdaptivePool):
     def __init__(self, output_size, data_format="NCHW", name=None):
-        super().__init__(output_size, "adaptive_avg_pool2d")
+        super().__init__(output_size, "adaptive_avg_pool2d",
+                         data_format=data_format)
 
 
 class AdaptiveAvgPool3D(_AdaptivePool):
     def __init__(self, output_size, data_format="NCDHW", name=None):
-        super().__init__(output_size, "adaptive_avg_pool3d")
+        super().__init__(output_size, "adaptive_avg_pool3d",
+                         data_format=data_format)
 
 
 class AdaptiveMaxPool1D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size, "adaptive_max_pool1d")
+        super().__init__(output_size, "adaptive_max_pool1d",
+                         return_mask=return_mask)
 
 
 class AdaptiveMaxPool2D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size, "adaptive_max_pool2d")
+        super().__init__(output_size, "adaptive_max_pool2d",
+                         return_mask=return_mask)
 
 
 class AdaptiveMaxPool3D(_AdaptivePool):
     def __init__(self, output_size, return_mask=False, name=None):
-        super().__init__(output_size, "adaptive_max_pool3d")
+        super().__init__(output_size, "adaptive_max_pool3d",
+                         return_mask=return_mask)
 
 
 class LPPool1D(Layer):

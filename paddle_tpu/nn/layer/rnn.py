@@ -16,13 +16,14 @@ from .. import initializer as I
 
 
 class RNNCellBase(Layer):
-    def get_initial_states(self, batch_ref, shape=None, dtype="float32"):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None):
         b = batch_ref.shape[0]
-        import numpy as np
         from ... import tensor as T
+        shape = list(shape) if shape is not None             else list(getattr(self, "state_shape", (self.hidden_size,)))
+        dtype = dtype or "float32"
         if isinstance(self, LSTMCell):
-            return (T.zeros([b, self.hidden_size]), T.zeros([b, self.hidden_size]))
-        return T.zeros([b, self.hidden_size])
+            return (T.zeros([b] + shape, dtype), T.zeros([b] + shape, dtype))
+        return T.zeros([b] + shape, dtype)
 
 
 class SimpleRNNCell(RNNCellBase):
@@ -63,6 +64,10 @@ class LSTMCell(RNNCellBase):
     def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
                  bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
         super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTMCell: proj_size (projected LSTM) is not implemented "
+                "on this stack")
         self.input_size, self.hidden_size = input_size, hidden_size
         k = 1.0 / hidden_size ** 0.5
         init = I.Uniform(-k, k)
@@ -149,8 +154,7 @@ class RNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         is_lstm = isinstance(self.cell, LSTMCell)
         if initial_states is None:
-            ref = inputs if self.time_major else inputs
-            b = ref.shape[1] if self.time_major else ref.shape[0]
+            b = inputs.shape[1] if self.time_major else inputs.shape[0]
             from ... import tensor as T
             if is_lstm:
                 initial_states = (T.zeros([b, self.cell.hidden_size], inputs.dtype),
@@ -161,52 +165,104 @@ class RNN(Layer):
         cell = self.cell
         time_major = self.time_major
         reverse = self.is_reverse
+        has_lens = sequence_length is not None
+
+        def _to_tb(x):
+            return x if time_major else jnp.swapaxes(x, 0, 1)
+
+        def _mask_tail(ys, lens):
+            # rows past each sequence's end are zero in the OUTPUT
+            # layout too (the un-reversal gather above clips into row 0
+            # there otherwise)
+            if lens is None:
+                return ys
+            tmask = jnp.arange(ys.shape[0])[:, None] < lens[None, :]
+            tmask = tmask.reshape(tmask.shape + (1,) * (ys.ndim - 2))
+            return jnp.where(tmask, ys, 0)
+
+        def _rev(x_tb, lens):
+            """Reverse each sequence WITHIN its valid length (reference
+            semantics for is_reverse + sequence_length); plain flip when
+            lengths are absent."""
+            if lens is None:
+                return jnp.flip(x_tb, 0)
+            T_ = x_tb.shape[0]
+            idx = lens[None, :] - 1 - jnp.arange(T_)[:, None]     # [T, B]
+            idx = jnp.clip(idx, 0, T_ - 1)
+            idx = idx.reshape(idx.shape + (1,) * (x_tb.ndim - 2))
+            return jnp.take_along_axis(x_tb, idx, axis=0)
 
         if is_lstm:
-            def fn(x, h0, c0, w_ih, w_hh, b_ih, b_hh):
-                xt = x if time_major else jnp.swapaxes(x, 0, 1)
+            def fn(x, h0, c0, w_ih, w_hh, b_ih, b_hh, *maybe_lens):
+                lens = maybe_lens[0].astype(jnp.int32) if maybe_lens else None
+                xt = _to_tb(x)
                 if reverse:
-                    xt = jnp.flip(xt, 0)
+                    xt = _rev(xt, lens)
+                T_ = xt.shape[0]
 
-                def step(carry, xi):
+                def step(carry, x_t):
                     h, c = carry
+                    xi, t = x_t
                     h2, c2 = cell.pure_step(xi, h, c, w_ih, w_hh, b_ih, b_hh)
-                    return (h2, c2), h2
+                    if lens is not None:
+                        # past a sequence's end: carry the state, zero
+                        # the output row (reference RNN masking)
+                        valid = (t < lens)[:, None]
+                        h2 = jnp.where(valid, h2, h)
+                        c2 = jnp.where(valid, c2, c)
+                        y = jnp.where(valid, h2, 0)
+                    else:
+                        y = h2
+                    return (h2, c2), y
 
-                (hT, cT), ys = jax.lax.scan(step, (h0, c0), xt)
+                (hT, cT), ys = jax.lax.scan(
+                    step, (h0, c0), (xt, jnp.arange(T_)))
                 if reverse:
-                    ys = jnp.flip(ys, 0)
+                    ys = _rev(ys, lens)
+                ys = _mask_tail(ys, lens)
                 if not time_major:
                     ys = jnp.swapaxes(ys, 0, 1)
                 return ys, hT, cT
 
-            h0, c0 = initial_states
-            ys, hT, cT = eager_apply(
-                "lstm_scan", fn,
-                (inputs, h0, c0, cell.weight_ih, cell.weight_hh, cell.bias_ih,
-                 cell.bias_hh), {})
+            args = [inputs, initial_states[0], initial_states[1],
+                    cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                    cell.bias_hh]
+            if has_lens:
+                args.append(sequence_length)
+            ys, hT, cT = eager_apply("lstm_scan", fn, tuple(args), {})
             return ys, (hT, cT)
 
-        def fn(x, h0, w_ih, w_hh, b_ih, b_hh):
-            xt = x if time_major else jnp.swapaxes(x, 0, 1)
+        def fn(x, h0, w_ih, w_hh, b_ih, b_hh, *maybe_lens):
+            lens = maybe_lens[0].astype(jnp.int32) if maybe_lens else None
+            xt = _to_tb(x)
             if reverse:
-                xt = jnp.flip(xt, 0)
+                xt = _rev(xt, lens)
+            T_ = xt.shape[0]
 
-            def step(h, xi):
+            def step(h, x_t):
+                xi, t = x_t
                 h2 = cell.pure_step(xi, h, w_ih, w_hh, b_ih, b_hh)
-                return h2, h2
+                if lens is not None:
+                    valid = (t < lens)[:, None]
+                    h2 = jnp.where(valid, h2, h)
+                    y = jnp.where(valid, h2, 0)
+                else:
+                    y = h2
+                return h2, y
 
-            hT, ys = jax.lax.scan(step, h0, xt)
+            hT, ys = jax.lax.scan(step, h0, (xt, jnp.arange(T_)))
             if reverse:
-                ys = jnp.flip(ys, 0)
+                ys = _rev(ys, lens)
+            ys = _mask_tail(ys, lens)
             if not time_major:
                 ys = jnp.swapaxes(ys, 0, 1)
             return ys, hT
 
-        ys, hT = eager_apply(
-            "rnn_scan", fn,
-            (inputs, initial_states, cell.weight_ih, cell.weight_hh, cell.bias_ih,
-             cell.bias_hh), {})
+        args = [inputs, initial_states, cell.weight_ih, cell.weight_hh,
+                cell.bias_ih, cell.bias_hh]
+        if has_lens:
+            args.append(sequence_length)
+        ys, hT = eager_apply("rnn_scan", fn, tuple(args), {})
         return ys, hT
 
 
@@ -219,8 +275,8 @@ class BiRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ... import tensor as T
         states = initial_states or (None, None)
-        out_f, st_f = self.rnn_fw(inputs, states[0])
-        out_b, st_b = self.rnn_bw(inputs, states[1])
+        out_f, st_f = self.rnn_fw(inputs, states[0], sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, states[1], sequence_length)
         return T.concat([out_f, out_b], axis=-1), (st_f, st_b)
 
 
@@ -240,6 +296,8 @@ class _MultiLayerRNN(Layer):
         from .container import LayerList
         self.layers_list = LayerList()
         kw = dict(cell_kwargs)
+        kw.update(weight_ih_attr=weight_ih_attr, weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
         if activation is not None and self.CELL is SimpleRNNCell:
             kw["activation"] = activation
         for i in range(num_layers):
@@ -255,13 +313,47 @@ class _MultiLayerRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from .. import functional as F
         out = inputs
+        per_layer = self._split_states(initial_states)
         final_states = []
         for i, layer in enumerate(self.layers_list):
-            out, st = layer(out)
+            out, st = layer(out, per_layer[i], sequence_length)
             final_states.append(st)
             if self.dropout > 0 and i < self.num_layers - 1:
                 out = F.dropout(out, self.dropout, training=self.training)
         return out, final_states
+
+    def _split_states(self, initial_states):
+        """Normalize reference-layout initial states — SimpleRNN/GRU: h
+        [L*D, B, H]; LSTM: (h, c) each [L*D, B, H] — into per-layer
+        entries (None when absent)."""
+        L = self.num_layers
+        if initial_states is None:
+            return [None] * L
+        D = 2 if self.bidirect else 1
+        is_lstm = self.CELL is LSTMCell
+
+        def rows(t):
+            return [t[i] for i in range(L * D)]
+
+        if is_lstm and isinstance(initial_states, (tuple, list)) and \
+                len(initial_states) == 2 and \
+                not isinstance(initial_states[0], (tuple, list)):
+            hs, cs = rows(initial_states[0]), rows(initial_states[1])
+            per = []
+            for i in range(L):
+                if self.bidirect:
+                    per.append(((hs[2 * i], cs[2 * i]),
+                                (hs[2 * i + 1], cs[2 * i + 1])))
+                else:
+                    per.append((hs[i], cs[i]))
+            return per
+        if not isinstance(initial_states, (tuple, list)):
+            hs = rows(initial_states)
+            if self.bidirect:
+                return [(hs[2 * i], hs[2 * i + 1]) for i in range(L)]
+            return [hs[i] for i in range(L)]
+        # already a per-layer sequence
+        return list(initial_states) + [None] * (L - len(initial_states))
 
 
 class SimpleRNN(_MultiLayerRNN):

@@ -101,7 +101,14 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, attn_mask=src_mask)
+        if cache is not None:
+            # incremental decoding (reference encoder_layer cache path):
+            # the attention appends to / reads the provided KV cache and
+            # the layer returns (out, new_cache)
+            src, new_cache = self.self_attn(src, src, src,
+                                            attn_mask=src_mask, cache=cache)
+        else:
+            src = self.self_attn(src, src, src, attn_mask=src_mask)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -112,7 +119,14 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
+        if cache is not None:
+            return src, new_cache
         return src
+
+    def gen_cache(self, src):
+        """reference: TransformerEncoderLayer.gen_cache — an incremental
+        KV cache for this layer's self attention."""
+        return self.self_attn.gen_cache(src)
 
 
 class TransformerEncoder(Layer):
@@ -124,13 +138,25 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
-    def forward(self, src, src_mask=None):
+    def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, nc = layer(out, src_mask=src_mask, cache=cache[i])
+                new_caches.append(nc)
+            else:
+                out = layer(out, src_mask=src_mask)
         if self.norm is not None:
             out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
         return out
+
+    def gen_cache(self, src):
+        """reference: TransformerEncoder.gen_cache — per-layer
+        incremental KV caches."""
+        return [layer.gen_cache(src) for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):
@@ -157,17 +183,34 @@ class TransformerDecoderLayer(Layer):
         self.activation = _get_activation(activation)
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        # cache (reference decoder_layer): (incremental_self_cache,
+        # static_cross_cache) — self attention appends, cross attention
+        # reuses the precomputed memory K/V
+        self_cache = cross_cache = None
+        if cache is not None:
+            self_cache, cross_cache = cache
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
+        if self_cache is not None:
+            tgt, new_self = self.self_attn(tgt, tgt, tgt,
+                                           attn_mask=tgt_mask,
+                                           cache=self_cache)
+        else:
+            tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        if cross_cache is not None:
+            tgt = self.cross_attn(tgt, memory, memory,
+                                  attn_mask=memory_mask, cache=cross_cache)
+            if isinstance(tgt, tuple):
+                tgt = tgt[0]
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -178,7 +221,17 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
+        if cache is not None:
+            return tgt, (new_self, cross_cache)
         return tgt
+
+    def gen_cache(self, memory):
+        """reference: decoder_layer.gen_cache — (incremental self cache,
+        static cross cache over ``memory``)."""
+        inc = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(
+            memory, memory, type=MultiHeadAttention.StaticCache)
+        return inc, static
 
 
 class TransformerDecoder(Layer):
@@ -191,11 +244,28 @@ class TransformerDecoder(Layer):
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, nc = layer(out, memory, tgt_mask=tgt_mask,
+                                memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(nc)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
         if self.norm is not None:
             out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
         return out
+
+    def gen_cache(self, memory, do_zip=False):
+        """reference: TransformerDecoder.gen_cache — per-layer caches;
+        ``do_zip`` transposes to the beam-search layout."""
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            return list(zip(*caches))
+        return caches
 
 
 class Transformer(Layer):

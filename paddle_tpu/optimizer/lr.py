@@ -190,17 +190,40 @@ class OneCycleLR(LRScheduler):
         self.initial_lr = max_learning_rate / divide_factor
         self.end_lr = end_learning_rate
         self.phase_pct = phase_pct
+        if anneal_strategy not in ("cos", "linear"):
+            raise ValueError(
+                f"anneal_strategy must be 'cos' or 'linear', got "
+                f"{anneal_strategy!r}")
+        self.anneal_strategy = anneal_strategy
+        self.three_phase = bool(three_phase)
         super().__init__(self.initial_lr, last_epoch, verbose)
 
+    def _anneal(self, start, end, pct):
+        if self.anneal_strategy == "linear":
+            return start + (end - start) * pct
+        return end + (start - end) * (1 + math.cos(math.pi * pct)) / 2
+
     def get_lr(self):
-        up = int(self.total_steps * self.phase_pct)
         t = self.last_epoch
+        if self.three_phase:
+            # up, symmetric down, then a final anneal to end_lr
+            up = int(self.total_steps * self.phase_pct)
+            down = up
+            if t <= up:
+                return self._anneal(self.initial_lr, self.max_lr,
+                                    t / max(up, 1))
+            if t <= up + down:
+                return self._anneal(self.max_lr, self.initial_lr,
+                                    (t - up) / max(down, 1))
+            tail = max(self.total_steps - up - down, 1)
+            return self._anneal(self.initial_lr, self.end_lr,
+                                (t - up - down) / tail)
+        up = int(self.total_steps * self.phase_pct)
         if t <= up:
-            pct = t / max(up, 1)
-            return self.initial_lr + (self.max_lr - self.initial_lr) * (
-                1 - math.cos(math.pi * pct)) / 2
-        pct = (t - up) / max(self.total_steps - up, 1)
-        return self.end_lr + (self.max_lr - self.end_lr) * (1 + math.cos(math.pi * pct)) / 2
+            return self._anneal(self.initial_lr, self.max_lr,
+                                t / max(up, 1))
+        return self._anneal(self.max_lr, self.end_lr,
+                            (t - up) / max(self.total_steps - up, 1))
 
 
 class CyclicLR(LRScheduler):
@@ -211,6 +234,10 @@ class CyclicLR(LRScheduler):
         self.up = step_size_up
         self.down = step_size_down or step_size_up
         self.mode, self.exp_gamma = mode, exp_gamma
+        self.scale_fn, self.scale_mode = scale_fn, scale_mode
+        if scale_mode not in ("cycle", "iterations"):
+            raise ValueError(f"scale_mode must be 'cycle' or "
+                             f"'iterations', got {scale_mode!r}")
         super().__init__(base_learning_rate, last_epoch, verbose)
 
     def get_lr(self):
@@ -218,11 +245,17 @@ class CyclicLR(LRScheduler):
         cycle = self.last_epoch // total
         t = self.last_epoch % total
         x = t / self.up if t <= self.up else 1 - (t - self.up) / self.down
-        scale = 1.0
-        if self.mode == "triangular2":
+        if self.scale_fn is not None:
+            # custom scaling overrides the built-in modes (reference
+            # semantics): argument is the cycle count or iteration count
+            arg = cycle + 1 if self.scale_mode == "cycle"                 else self.last_epoch
+            scale = float(self.scale_fn(arg))
+        elif self.mode == "triangular2":
             scale = 1 / (2 ** cycle)
         elif self.mode == "exp_range":
             scale = self.exp_gamma ** self.last_epoch
+        else:
+            scale = 1.0
         return self.base_lr + (self.max_lr - self.base_lr) * x * scale
 
 
@@ -242,7 +275,7 @@ class ReduceOnPlateau(LRScheduler):
         return self.cur_lr
 
     def step(self, metrics=None, epoch=None):
-        self.last_epoch += 1
+        self.last_epoch = int(epoch) if epoch is not None             else self.last_epoch + 1
         if metrics is None:
             self.last_lr = self.cur_lr
             return self.cur_lr

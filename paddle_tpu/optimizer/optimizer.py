@@ -206,6 +206,9 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
                  multi_precision=True, name=None):
+        """``lazy_mode`` (sparse-grad rows) and ``multi_precision`` are
+        accepted for parity: moments are ALWAYS fp32 master state on this
+        stack (the multi_precision=True behavior), and grads are dense."""
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
@@ -232,11 +235,16 @@ class AdamW(Adam):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision, name)
         self._apply_decay_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
 
     def _apply_one(self, p, g, lr):
         wd = self._weight_decay
         if self._apply_decay_fun is not None and not self._apply_decay_fun(p.name):
             wd = 0.0
+        if self._lr_ratio is not None:
+            # layer-wise LR scaling (reference adamw.py lr_ratio — the
+            # ViT/LLRD fine-tuning knob): per-parameter multiplier
+            lr = lr * float(self._lr_ratio(p))
         st = self._param_state(p)
         new_p, st["moment1"], st["moment2"] = _adam_update(
             p._data, g, lr, st["moment1"], st["moment2"], self._beta1, self._beta2,

@@ -135,6 +135,13 @@ def _cdist(a, b, *, p):
 
 
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """``compute_mode`` selects the reference's matmul-vs-direct euclid
+    strategy; XLA owns that choice here, so the value is validated and
+    otherwise advisory."""
+    if compute_mode not in ("use_mm_for_euclid_dist_if_necessary",
+                            "use_mm_for_euclid_dist",
+                            "donot_use_mm_for_euclid_dist"):
+        raise ValueError(f"invalid compute_mode {compute_mode!r}")
     return op_call("cdist", _cdist, x, y, p=p)
 
 
@@ -223,6 +230,12 @@ def _lstsq(a, b, *, rcond):
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
+    """``driver`` picks the LAPACK routine in the reference; the XLA
+    lowering is SVD-based (= 'gelsd'-class), so the value is validated
+    and otherwise advisory."""
+    if driver is not None and driver not in ("gels", "gelsy", "gelsd",
+                                             "gelss"):
+        raise ValueError(f"invalid lstsq driver {driver!r}")
     return op_call("lstsq", _lstsq, x, y, rcond=rcond)
 
 
@@ -262,12 +275,20 @@ def eig(x, name=None):
 
 
 @op_body("eigh")
-def _eigh(a):
-    return jnp.linalg.eigh(a, symmetrize_input=True)
+def _eigh(a, *, uplo="L"):
+    # honor UPLO: only the named triangle is read (the other may hold
+    # garbage — the LAPACK contract the reference follows)
+    if uplo == "U":
+        sym = jnp.triu(a) + jnp.swapaxes(jnp.triu(a, 1), -1, -2).conj()
+    else:
+        sym = jnp.tril(a) + jnp.swapaxes(jnp.tril(a, -1), -1, -2).conj()
+    return jnp.linalg.eigh(sym, symmetrize_input=False)
 
 
 def eigh(x, UPLO="L", name=None):
-    return tuple(op_call("eigh", _eigh, x))
+    if UPLO not in ("L", "U"):
+        raise ValueError(f"UPLO must be 'L' or 'U', got {UPLO!r}")
+    return tuple(op_call("eigh", _eigh, x, uplo=UPLO))
 
 
 def eigvals(x, name=None):
@@ -276,12 +297,18 @@ def eigvals(x, name=None):
 
 
 @op_body("eigvalsh")
-def _eigvalsh(a):
-    return jnp.linalg.eigvalsh(a)
+def _eigvalsh(a, *, uplo="L"):
+    if uplo == "U":
+        sym = jnp.triu(a) + jnp.swapaxes(jnp.triu(a, 1), -1, -2).conj()
+    else:
+        sym = jnp.tril(a) + jnp.swapaxes(jnp.tril(a, -1), -1, -2).conj()
+    return jnp.linalg.eigvalsh(sym)
 
 
 def eigvalsh(x, UPLO="L", name=None):
-    return op_call("eigvalsh", _eigvalsh, x)
+    if UPLO not in ("L", "U"):
+        raise ValueError(f"UPLO must be 'L' or 'U', got {UPLO!r}")
+    return op_call("eigvalsh", _eigvalsh, x, uplo=UPLO)
 
 
 @op_body("lu")
@@ -291,6 +318,10 @@ def _lu(a):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    if not pivot:
+        raise NotImplementedError(
+            "lu: pivot=False is unsupported (the reference supports it "
+            "only on GPU; partial pivoting is the stable path)")
     outs = op_call("lu", _lu, x)
     if get_infos:
         return outs[0], outs[1], Tensor(jnp.zeros((), jnp.int32))
@@ -307,12 +338,23 @@ def matrix_power(x, n, name=None):
 
 
 @op_body("matrix_rank")
-def _matrix_rank(a, *, tol):
+def _matrix_rank(a, *, tol, hermitian=False):
+    if hermitian:
+        # reference semantics: |eigvalsh| instead of singular values; an
+        # EXPLICIT tol is an absolute threshold, the default is relative
+        w = jnp.abs(jnp.linalg.eigvalsh(a))
+        if tol is not None:
+            cutoff = tol
+        else:
+            cutoff = jnp.finfo(a.dtype).eps * a.shape[-1] * \
+                jnp.max(w, axis=-1, keepdims=True)
+        return jnp.sum(w > cutoff, axis=-1)
     return jnp.linalg.matrix_rank(a, rtol=tol)
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return op_call("matrix_rank", _matrix_rank, x, tol=tol)
+    return op_call("matrix_rank", _matrix_rank, x, tol=tol,
+                   hermitian=bool(hermitian))
 
 
 @op_body("multi_dot")
@@ -374,6 +416,9 @@ def _pca_lowrank(a, *, q, center):
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """``niter`` tunes the reference's randomized power iterations; this
+    lowering computes the EXACT truncated SVD (strictly more accurate),
+    so the value is accepted for parity and has no effect."""
     return tuple(op_call("pca_lowrank", _pca_lowrank, x, q=q,
                          center=bool(center)))
 

@@ -206,6 +206,9 @@ def unbind(x, axis=0, name=None):
 
 
 def unstack(x, axis=0, num=None, name=None):
+    if num is not None and int(num) != int(x.shape[int(axis)]):
+        raise ValueError(
+            f"unstack: num={num} != dim size {x.shape[int(axis)]}")
     return unbind(x, axis)
 
 
@@ -297,14 +300,16 @@ def _pad(a, *, pad, mode, value, data_format):
     if len(pad) == 2 * nd:
         width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle semantics: pad applies to last len(pad)//2 spatial dims
-        # per data_format
+        # reference semantics (nn/functional/common.py pad): the pairs
+        # run LAST spatial dim first — 4-D is (left, right, top, bottom)
+        # with left/right on W — applied to the trailing spatial dims of
+        # the data_format
         width = [(0, 0)] * nd
         spatial = len(pad) // 2
         if data_format.endswith("C") and nd >= 3:  # NHWC-like: dims 1..nd-2
-            dims = list(range(1, 1 + spatial))
+            dims = list(range(nd - 2, nd - 2 - spatial, -1))
         else:  # NCHW-like: spatial dims 2..
-            dims = list(range(nd - spatial, nd))
+            dims = list(range(nd - 1, nd - 1 - spatial, -1))
         for j, d in enumerate(dims):
             width[d] = (pad[2 * j], pad[2 * j + 1])
     jmode = {"constant": "constant", "reflect": "reflect",
@@ -356,12 +361,23 @@ def _take_along_axis(a, i, *, axis):
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    if not broadcast:
+        # reference broadcast=False: indices must already match arr's
+        # rank/shape except along axis — no implicit broadcasting
+        ax = axis % len(arr.shape)
+        if len(indices.shape) != len(arr.shape) or any(
+                int(indices.shape[d]) != int(arr.shape[d])
+                for d in range(len(arr.shape)) if d != ax):
+            raise ValueError(
+                f"take_along_axis(broadcast=False): indices shape "
+                f"{tuple(indices.shape)} must match arr "
+                f"{tuple(arr.shape)} except on axis {axis}")
     return op_call("take_along_axis", _take_along_axis, arr, indices,
                    axis=axis)
 
 
 @op_body("put_along_axis")
-def _put_along_axis(a, i, v, *, axis, reduce):
+def _put_along_axis(a, i, v, *, axis, reduce, include_self=True):
     v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
     if reduce == "assign":
         return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
@@ -370,6 +386,21 @@ def _put_along_axis(a, i, v, *, axis, reduce):
                   for d, s in enumerate(i.shape)]
     full_idx = tuple(i if d == axis else jnp.broadcast_to(onehot_idx[d], i.shape)
                      for d in dims)
+    if not include_self:
+        # reference include_self=False: the reduction sees only the
+        # scattered values — reset target cells to the identity first
+        # (set applies once per cell, then the reduce accumulates)
+        ident = {"add": 0, "sum": 0, "multiply": 1, "mul": 1}.get(reduce)
+        if ident is not None:
+            a = a.at[full_idx].set(jnp.full_like(v, ident))
+        elif reduce == "amax":
+            a = a.at[full_idx].set(jnp.full_like(
+                v, jnp.finfo(a.dtype).min if jnp.issubdtype(
+                    a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min))
+        elif reduce == "amin":
+            a = a.at[full_idx].set(jnp.full_like(
+                v, jnp.finfo(a.dtype).max if jnp.issubdtype(
+                    a.dtype, jnp.floating) else jnp.iinfo(a.dtype).max))
     if reduce in ("add", "sum"):
         return a.at[full_idx].add(v)
     if reduce in ("multiply", "mul"):
@@ -383,8 +414,18 @@ def _put_along_axis(a, i, v, *, axis, reduce):
 
 def put_along_axis(arr, indices, values, axis, reduce="assign",
                    include_self=True, broadcast=True, name=None):
+    if not broadcast:
+        ax = axis % len(arr.shape)
+        if len(indices.shape) != len(arr.shape) or any(
+                int(indices.shape[d]) != int(arr.shape[d])
+                for d in range(len(arr.shape)) if d != ax):
+            raise ValueError(
+                f"put_along_axis(broadcast=False): indices shape "
+                f"{tuple(indices.shape)} must match arr "
+                f"{tuple(arr.shape)} except on axis {axis}")
     return op_call("put_along_axis", _put_along_axis, arr, indices, values,
-                   axis=axis, reduce=reduce)
+                   axis=axis, reduce=reduce,
+                   include_self=bool(include_self))
 
 
 @op_body("scatter")

@@ -139,8 +139,16 @@ def _scale(a, s, b, *, bias_after_scale):
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    return op_call("scale", _scale, x, scale, bias,
-                   bias_after_scale=bool(bias_after_scale))
+    out = op_call("scale", _scale, x, scale, bias,
+                  bias_after_scale=bool(bias_after_scale))
+    if act is not None:
+        # legacy fluid surface: an activation applied after the affine
+        from ..nn import functional as _F
+        act_fn = getattr(_F, str(act), None)
+        if act_fn is None:
+            raise ValueError(f"scale: unknown act {act!r}")
+        out = act_fn(out)
+    return out
 
 
 @op_body("clip")
@@ -892,6 +900,9 @@ def _isin(a, t, *, invert):
 
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """``assume_unique`` is the reference's algorithm-selection hint; the
+    broadcast-compare lowering is uniqueness-agnostic, so it is accepted
+    for parity."""
     return op_call("isin", _isin, x, test_x, invert=bool(invert))
 
 

@@ -16,7 +16,11 @@ from ..core.tensor import Tensor
 from .creation import _shape
 
 
-def _key():
+def _key(seed=0):
+    # reference semantics: a nonzero per-op seed pins that op's stream
+    # independently of the global generator
+    if seed:
+        return jax.random.PRNGKey(int(seed))
     return _rng.next_key()
 
 
@@ -43,17 +47,17 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 
 def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
-    return Tensor(mean + std * jax.random.normal(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype())))
+    return Tensor(mean + std * jax.random.normal(_key(seed), _shape(shape), to_jax_dtype(dtype or get_default_dtype())))
 
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
-    return Tensor(jax.random.uniform(_key(), _shape(shape), to_jax_dtype(dtype or get_default_dtype()),
+    return Tensor(jax.random.uniform(_key(seed), _shape(shape), to_jax_dtype(dtype or get_default_dtype()),
                                      minval=min, maxval=max))
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     return x._inplace_update(
-        jax.random.uniform(_key(), x._data.shape, jnp.result_type(x._data), min, max))
+        jax.random.uniform(_key(seed), x._data.shape, jnp.result_type(x._data), min, max))
 
 
 def normal_(x, mean=0.0, std=1.0, name=None):

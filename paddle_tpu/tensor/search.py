@@ -25,6 +25,11 @@ def _argmax(a, *, axis, keepdim):
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    """``dtype`` selects int32/int64 output in the reference; 64-bit ints
+    collapse to int32 on this stack (x64 disabled), so both values yield
+    int32 — validated, then advisory."""
+    if str(dtype).rsplit(".", 1)[-1] not in ("int32", "int64"):
+        raise ValueError(f"argmax dtype must be int32/int64, got {dtype!r}")
     return op_call("argmax", _argmax, x, axis=_ax(axis), keepdim=keepdim)
 
 
@@ -38,6 +43,9 @@ def _argmin(a, *, axis, keepdim):
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    """See :func:`argmax` — ``dtype`` validated, int32 on this stack."""
+    if str(dtype).rsplit(".", 1)[-1] not in ("int32", "int64"):
+        raise ValueError(f"argmin dtype must be int32/int64, got {dtype!r}")
     return op_call("argmin", _argmin, x, axis=_ax(axis), keepdim=keepdim)
 
 
@@ -75,6 +83,9 @@ def _topk(a, *, k, axis, largest):
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    """``sorted=False`` permits unordered results in the reference; this
+    lowering always returns the sorted order (a valid instance of
+    "any order"), so the flag is accepted and has no effect."""
     k = int(k.item()) if isinstance(k, Tensor) else int(k)
     return op_call("topk", _topk, x, k=k, axis=_ax(axis),
                    largest=bool(largest))
@@ -138,6 +149,8 @@ def _searchsorted(s, v, *, right):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    """Indices are int32 either way on this stack (x64 disabled), so
+    ``out_int32`` is accepted for parity."""
     return op_call("searchsorted", _searchsorted, sorted_sequence, values,
                    right=bool(right))
 
@@ -149,6 +162,8 @@ def _bucketize(a, s, *, right):
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Indices are int32 either way on this stack — ``out_int32`` is
+    accepted for parity."""
     return op_call("bucketize", _bucketize, x, sorted_sequence,
                    right=bool(right))
 

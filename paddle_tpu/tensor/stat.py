@@ -59,13 +59,20 @@ def median(x, axis=None, keepdim=False, mode="avg", name=None):
 
 
 @op_body("nanmedian")
-def _nanmedian(a, *, axis, keepdims):
+def _nanmedian(a, *, axis, keepdims, mode="avg"):
+    if mode == "min":
+        # lower-middle element for even counts (reference mode='min')
+        return jnp.nanquantile(a, 0.5, axis=axis, keepdims=keepdims,
+                               method="lower")
     return jnp.nanmedian(a, axis=axis, keepdims=keepdims)
 
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode not in ("avg", "min"):
+        raise ValueError(f"nanmedian mode must be 'avg' or 'min', got "
+                         f"{mode!r}")
     return op_call("nanmedian", _nanmedian, x, axis=_ax(axis),
-                   keepdims=keepdim)
+                   keepdims=keepdim, mode=mode)
 
 
 @op_body("quantile")

@@ -198,6 +198,7 @@ class TestPsRoiPool:
 
 
 class TestDeformConv:
+    @pytest.mark.slow
     def test_zero_offset_equals_conv(self):
         rng = np.random.default_rng(0)
         x = rng.standard_normal((2, 4, 6, 6)).astype(np.float32)
@@ -211,6 +212,7 @@ class TestDeformConv:
         np.testing.assert_allclose(got.numpy(), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_integer_shift_offset(self):
         # offset (+1, +1) on every sample == convolving a shifted image
         rng = np.random.default_rng(1)
@@ -224,6 +226,7 @@ class TestDeformConv:
         exp[0, 0, :4] = x[0, 0, 1:]
         np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_mask_modulation_and_grad(self):
         rng = np.random.default_rng(2)
         x = paddle.to_tensor(rng.standard_normal((1, 2, 4, 4))

@@ -578,6 +578,7 @@ def test_flags_disposition_is_complete():
     assert not (ours & set(mod.NA))
 
 
+@pytest.mark.slow
 def test_env_provided_wired_flag_fires_on_set():
     """FLAGS_* provided via the ENVIRONMENT must reach the on_set wiring
     too (launching with the env var is the canonical before-first-

@@ -8,6 +8,7 @@ import paddle_tpu as paddle
 from paddle_tpu.models import LlamaMoeForCausalLM, llama_moe_tiny_config
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_aux_loss():
     paddle.seed(0)
     cfg = llama_moe_tiny_config()

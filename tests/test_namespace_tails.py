@@ -371,6 +371,7 @@ def test_moe_three_phase_pipeline():
         IF.moe_ffn(pi, nums, w1, w2, quant_method="w8a8")
 
 
+@pytest.mark.slow
 def test_masked_and_block_multihead_attention():
     """reference: masked_multihead_attention.py:74 +
     block_multihead_attention.py:33 — decode steps vs naive oracles."""

@@ -25,6 +25,7 @@ def test_cummax_cummin_indices():
     np.testing.assert_array_equal(i.numpy(), [[0, 0, 0], [0, 1, 1]])
 
 
+@pytest.mark.slow
 def test_math_extras():
     rng = np.random.default_rng(0)
     np.testing.assert_allclose(

@@ -30,6 +30,7 @@ def test_module_all_parity(mod):
     assert not missing, f"paddle.{mod} missing: {missing}"
 
 
+@pytest.mark.slow
 def test_beam_search_decodes_planted_sequence():
     vocab, batch, beam, hidden = 7, 2, 3, 4
     seq = [3, 5, 1, 2]

@@ -6,6 +6,7 @@ import pytest
 import paddle_tpu as paddle
 
 
+@pytest.mark.slow
 def test_lbfgs_rosenbrock():
     """LBFGS with strong-Wolfe line search minimizes Rosenbrock from a
     standard start — the classic L-BFGS acceptance test."""
@@ -29,6 +30,7 @@ def test_lbfgs_rosenbrock():
     assert float(loss.numpy()) < 1e-4
 
 
+@pytest.mark.slow
 def test_lbfgs_least_squares():
     rng = np.random.default_rng(0)
     A = rng.standard_normal((20, 5)).astype(np.float32)

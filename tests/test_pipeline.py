@@ -68,6 +68,7 @@ def test_pipeline_grads_parity():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_interleaved_parity():
     """8 virtual chunks on 4 devices (vpp=2)."""
     mesh = dist.init_mesh({"pp": 4, "dp": 2})

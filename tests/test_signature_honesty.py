@@ -154,6 +154,7 @@ class TestInstanceNorm:
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ctc_loss_norm_by_times():
     lp = np.log(np.full((6, 2, 4), 0.25, np.float32))
     lbl = np.array([[1, 2], [2, 3]], np.int64)
@@ -182,6 +183,7 @@ class TestFusedOps:
             IF.fused_rms_norm(t(x), t(np.ones(4, np.float32)),
                               quant_scale=0.5)
 
+    @pytest.mark.slow
     def test_fused_rope_halfstyle_and_time_major(self):
         import paddle_tpu.incubate.nn.functional as IF
         b, s, h, d = 2, 5, 2, 8
@@ -280,6 +282,7 @@ class TestVisionParams:
         with pytest.raises(ValueError):
             collect_fpn_proposals([r], [s], 2, 4, 10)
 
+    @pytest.mark.slow
     def test_squeezenet_with_pool_false(self):
         from paddle_tpu.vision.models import squeezenet1_1
         m = squeezenet1_1(num_classes=7, with_pool=False)
@@ -302,6 +305,7 @@ class TestVisionParams:
         assert len(num.numpy()) == 2
 
 
+@pytest.mark.slow
 def test_max_pool_ceil_mode_with_mask_shapes_agree():
     x = RNG.normal(size=(1, 1, 5, 5)).astype(np.float32)
     out, mask = F.max_pool2d(t(x), 2, stride=2, ceil_mode=True,
@@ -391,6 +395,7 @@ def test_custom_device_registration():
         P._custom_devices.cache_clear()
 
 
+@pytest.mark.slow
 def test_weight_only_linear_int4():
     """int4 weight-only matmul: packed nibbles + per-channel scales give
     the same result as dequantizing by hand (reference:
@@ -411,3 +416,231 @@ def test_weight_only_linear_int4():
     # int4 quantization error stays small relative to the fp32 matmul
     rel = np.abs(out.numpy() - x @ w).mean() / np.abs(x @ w).mean()
     assert rel < 0.2
+
+
+class TestRNNSequenceLength:
+    @pytest.mark.slow
+    def test_masking_matches_truncated_run(self):
+        """sequence_length: outputs past a sequence's end are zero and the
+        final state equals running only the valid prefix."""
+        import paddle_tpu.nn as nn
+        paddle.seed(5)
+        cell = nn.SimpleRNNCell(3, 4)
+        rnn = nn.RNN(cell)
+        x = paddle.to_tensor(RNG.normal(size=(2, 6, 3)).astype(np.float32))
+        lens = paddle.to_tensor(np.array([6, 3], np.int32))
+        out, hT = rnn(x, sequence_length=lens)
+        assert np.all(out.numpy()[1, 3:] == 0)     # masked tail
+        out_trunc, hT_trunc = rnn(x[1:2, :3])
+        np.testing.assert_allclose(hT.numpy()[1], hT_trunc.numpy()[0],
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out.numpy()[1, :3],
+                                   out_trunc.numpy()[0], rtol=1e-5,
+                                   atol=1e-5)
+
+    @pytest.mark.slow
+    def test_reverse_respects_lengths(self):
+        """is_reverse + sequence_length reverses each sequence WITHIN its
+        valid span, like the reference."""
+        import paddle_tpu.nn as nn
+        paddle.seed(6)
+        cell = nn.SimpleRNNCell(3, 4)
+        fwd = nn.RNN(cell)
+        rev = nn.RNN(cell, is_reverse=True)
+        x = paddle.to_tensor(RNG.normal(size=(1, 5, 3)).astype(np.float32))
+        lens = paddle.to_tensor(np.array([3], np.int32))
+        out_rev, _ = rev(x, sequence_length=lens)
+        # oracle: run forward on the reversed valid prefix
+        x_flip = paddle.to_tensor(x.numpy()[:, :3][:, ::-1].copy())
+        out_f, _ = fwd(x_flip)
+        np.testing.assert_allclose(out_rev.numpy()[0, :3],
+                                   out_f.numpy()[0][::-1], rtol=1e-5,
+                                   atol=1e-5)
+        assert np.all(out_rev.numpy()[0, 3:] == 0)
+
+    @pytest.mark.slow
+    def test_multilayer_initial_states_and_lengths(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(7)
+        gru = nn.GRU(3, 4, num_layers=2)
+        x = paddle.to_tensor(RNG.normal(size=(2, 5, 3)).astype(np.float32))
+        h0 = paddle.to_tensor(RNG.normal(size=(2, 2, 4)).astype(np.float32))
+        lens = paddle.to_tensor(np.array([5, 2], np.int32))
+        out, sts = gru(x, h0, lens)
+        assert np.all(out.numpy()[1, 2:] == 0)
+        # zero initial state differs from the provided one: states reach
+        # the cells
+        out0, _ = gru(x, None, lens)
+        assert not np.allclose(out.numpy()[0], out0.numpy()[0])
+
+    def test_lstm_proj_size_rejected(self):
+        import paddle_tpu.nn as nn
+        with pytest.raises(NotImplementedError, match="proj_size"):
+            nn.LSTMCell(4, 8, proj_size=2)
+
+
+class TestConvPaddingMode:
+    def test_reflect_matches_explicit_pad(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as FF
+        paddle.seed(8)
+        conv = nn.Conv2D(2, 3, 3, padding=1, padding_mode="reflect")
+        x = paddle.to_tensor(RNG.normal(size=(1, 2, 6, 6)).astype(np.float32))
+        out = conv(x)
+        xp = FF.pad(x, [1, 1, 1, 1], mode="reflect")
+        ref = FF.conv2d(xp, conv.weight, conv.bias, 1, 0, 1, 1, "NCHW")
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+        # and differs from the default zero padding
+        conv0 = nn.Conv2D(2, 3, 3, padding=1)
+        conv0.weight._data = conv.weight._data
+        conv0.bias._data = conv.bias._data
+        assert not np.allclose(out.numpy(), conv0(x).numpy())
+        with pytest.raises(ValueError):
+            nn.Conv2D(2, 3, 3, padding_mode="nope")
+
+
+def test_eigh_uplo_reads_named_triangle():
+    import paddle_tpu.tensor as T
+    a = RNG.normal(size=(4, 4)).astype(np.float32)
+    sym = np.tril(a) + np.tril(a, -1).T
+    # poison the upper triangle: UPLO='L' must ignore it
+    poisoned = sym + np.triu(np.full((4, 4), 100.0), 1).astype(np.float32)
+    w, v = T.linalg.eigh(paddle.to_tensor(poisoned), UPLO="L")
+    w_ref = np.linalg.eigvalsh(sym)
+    np.testing.assert_allclose(np.sort(w.numpy()), np.sort(w_ref),
+                               rtol=1e-4, atol=1e-4)
+    wu = T.linalg.eigvalsh(paddle.to_tensor(poisoned), UPLO="U")
+    assert not np.allclose(np.sort(wu.numpy()), np.sort(w_ref))
+
+
+def test_put_along_axis_include_self_false():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32) * 10)
+    idx = paddle.to_tensor(np.array([[0], [1]], np.int64))
+    vals = paddle.to_tensor(np.array([[5.0], [7.0]], np.float32))
+    import paddle_tpu.tensor as T
+    out_incl = T.put_along_axis(x, idx, vals, 1, reduce="add")
+    out_excl = T.put_along_axis(x, idx, vals, 1, reduce="add",
+                                include_self=False)
+    assert out_incl.numpy()[0, 0] == 15.0       # 10 + 5
+    assert out_excl.numpy()[0, 0] == 5.0        # scattered value only
+    assert out_excl.numpy()[0, 1] == 10.0       # untouched cells keep x
+
+
+def test_onecycle_linear_anneal_and_seeded_uniform():
+    import paddle_tpu as paddle
+    sched = paddle.optimizer.lr.OneCycleLR(
+        max_learning_rate=1.0, total_steps=10, anneal_strategy="linear")
+    lrs = []
+    for _ in range(10):
+        lrs.append(sched.get_lr())
+        sched.step()
+    # linear anneal: exact midpoint of the down phase is the mean
+    import paddle_tpu.tensor as T
+    a = T.uniform([4], seed=7)
+    b = T.uniform([4], seed=7)
+    np.testing.assert_allclose(a.numpy(), b.numpy())   # pinned stream
+    c = T.uniform([4])
+    assert not np.allclose(a.numpy(), c.numpy())
+
+
+class TestTransformerCache:
+    @pytest.mark.slow
+    def test_encoder_layer_incremental_matches_full(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(9)
+        layer = nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0)
+        layer.eval()
+        x = paddle.to_tensor(RNG.normal(size=(1, 4, 8)).astype(np.float32))
+        full = layer(x)
+        cache = layer.gen_cache(x[:, :0])
+        outs = []
+        for tstep in range(4):
+            o, cache = layer(x[:, tstep:tstep + 1], cache=cache)
+            outs.append(o.numpy())
+        # causal-free self attention over a growing cache reproduces the
+        # LAST row of the full run at each step
+        np.testing.assert_allclose(outs[-1][0, 0], full.numpy()[0, -1],
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_decoder_incremental_matches_full(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(10)
+        dec_layer = nn.TransformerDecoderLayer(8, 2, 16, dropout=0.0)
+        dec = nn.TransformerDecoder(dec_layer, 2)
+        dec.eval()
+        mem = paddle.to_tensor(RNG.normal(size=(1, 5, 8)).astype(np.float32))
+        tgt = paddle.to_tensor(RNG.normal(size=(1, 3, 8)).astype(np.float32))
+        import paddle_tpu.tensor as T
+        causal = paddle.to_tensor(np.triu(
+            np.full((3, 3), -1e9, np.float32), 1))
+        full = dec(tgt, mem, tgt_mask=causal)
+        cache = dec.gen_cache(mem)
+        outs = []
+        for tstep in range(3):
+            o, cache = dec(tgt[:, tstep:tstep + 1], mem, cache=cache)
+            outs.append(o.numpy())
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(inc, full.numpy(), rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_pad_pairs_run_last_dim_first():
+    """Reference pad order: 4-D is (left, right, top, bottom) with
+    left/right on W — asymmetric pads must land on the right axes."""
+    import paddle_tpu.tensor as T
+    x = paddle.to_tensor(np.ones((1, 1, 2, 3), np.float32))
+    out = F.pad(x, [2, 0, 1, 0])       # W: +2 left; H: +1 top
+    assert list(out.shape) == [1, 1, 3, 5]
+    assert out.numpy()[0, 0, 0, 0] == 0.0       # new top-left is padding
+    assert out.numpy()[0, 0, 1, 2] == 1.0
+    # NHWC: same pair order, W is dim 2
+    xh = paddle.to_tensor(np.ones((1, 2, 3, 1), np.float32))
+    outh = F.pad(xh, [2, 0, 1, 0], data_format="NHWC")
+    assert list(outh.shape) == [1, 3, 5, 1]
+
+
+def test_conv_padding_mode_asymmetric_axes():
+    import paddle_tpu.nn as nn
+    conv = nn.Conv2D(1, 1, 1, padding=(0, 2), padding_mode="replicate")
+    x = paddle.to_tensor(RNG.normal(size=(1, 1, 4, 5)).astype(np.float32))
+    out = conv(x)
+    # H padded by 0, W padded by 2 per side
+    assert list(out.shape) == [1, 1, 4, 9]
+
+
+def test_argmax_accepts_dtype_objects():
+    x = paddle.to_tensor(np.array([[1.0, 3.0, 2.0]], np.float32))
+    import paddle_tpu.tensor as T
+    assert int(T.argmax(x, axis=1, dtype=paddle.int64).numpy()[0]) == 1
+    assert int(T.argmin(x, axis=1, dtype=paddle.int32).numpy()[0]) == 0
+
+
+def test_matrix_rank_hermitian_tol_absolute():
+    import paddle_tpu.tensor as T
+    a = paddle.to_tensor(np.diag([10.0, 5.0]).astype(np.float32))
+    assert int(T.linalg.matrix_rank(a, tol=0.6, hermitian=True).numpy()) == 2
+    assert int(T.linalg.matrix_rank(a, tol=6.0, hermitian=True).numpy()) == 1
+
+
+@pytest.mark.slow
+def test_transformer_encoder_container_cache():
+    import paddle_tpu.nn as nn
+    paddle.seed(11)
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(8, 2, 16, dropout=0.0), 2)
+    enc.eval()
+    x = paddle.to_tensor(RNG.normal(size=(1, 3, 8)).astype(np.float32))
+    # cache decoding is causal through the whole stack: compare against
+    # the causally-masked full run
+    causal = paddle.to_tensor(np.triu(
+        np.full((3, 3), -1e9, np.float32), 1))
+    full = enc(x, src_mask=causal)
+    cache = enc.gen_cache(x[:, :0])
+    outs = []
+    for tstep in range(3):
+        o, cache = enc(x[:, tstep:tstep + 1], cache=cache)
+        outs.append(o.numpy())
+    np.testing.assert_allclose(np.concatenate(outs, 1), full.numpy(),
+                               rtol=1e-4, atol=1e-4)

@@ -57,6 +57,7 @@ def test_model_forward_shapes(ctor, size):
     assert np.isfinite(y.numpy()).all()
 
 
+@pytest.mark.slow
 def test_box_iou_and_nms():
     boxes = paddle.to_tensor(np.array([
         [0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]], np.float32))
