@@ -66,6 +66,9 @@ class AlphaDropout(Layer):
 
 
 class Embedding(Layer):
+    """``sparse`` is accepted for parity: gradients are dense gathers on
+    TPU (GSPMD shards the table instead; the reference's sparse rows are
+    a CPU/GPU memory optimization)."""
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False,
                  weight_attr=None, name=None):
         super().__init__()

@@ -343,6 +343,10 @@ class Layer:
     # ---- state dict ----
     def state_dict(self, destination=None, include_sublayers=True, use_hook=True,
                    keep_vars=True):
+        """``use_hook``/``keep_vars`` are accepted for parity: entries
+        are always the live Tensors (keep_vars=True semantics — jax
+        arrays are immutable, so no detach copy exists to return), and
+        the reference's state-dict hooks are not a surface here."""
         dest = destination if destination is not None else OrderedDict()
         for name, p in self.named_parameters(include_sublayers=include_sublayers):
             dest[name] = p
@@ -390,6 +394,9 @@ class Layer:
         return self
 
     def to(self, device=None, dtype=None, blocking=True):
+        """``blocking`` is accepted for parity: PJRT transfers are
+        scheduled asynchronously and synchronize on first use either
+        way."""
         from ...core.place import Place, _parse
         if isinstance(device, str) and device is not None:
             device = _parse(device)

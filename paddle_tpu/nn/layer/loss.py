@@ -282,7 +282,9 @@ class TripletMarginWithDistanceLoss(Layer):
 
 class HSigmoidLoss(Layer):
     """Hierarchical sigmoid loss layer owning the tree parameters
-    (reference: python/paddle/nn/layer/loss.py HSigmoidLoss)."""
+    (reference: python/paddle/nn/layer/loss.py HSigmoidLoss).
+    ``is_sparse`` is accepted for parity — gradients are dense on TPU
+    (the reference's sparse rows are a lookup-table memory optimization)."""
 
     def __init__(self, feature_size, num_classes, weight_attr=None,
                  bias_attr=None, is_custom=False, is_sparse=False,

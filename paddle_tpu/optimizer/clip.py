@@ -44,6 +44,10 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
         self.clip_norm = float(clip_norm)
+        #: parameters sharing this name share one global norm in the
+        #: reference's multi-group form; one optimizer = one group here
+        self.group_name = group_name
+        self.auto_skip_clip = bool(auto_skip_clip)
 
     def _global_norm(self, grads):
         return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
@@ -51,4 +55,8 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def _clip_arrays(self, params, grads):
         gn = self._global_norm(grads)
         scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        if self.auto_skip_clip:
+            # reference: leave grads EXACTLY untouched when already
+            # inside the norm ball (no ~1.0 rescale)
+            scale = jnp.where(gn <= self.clip_norm, 1.0, scale)
         return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]

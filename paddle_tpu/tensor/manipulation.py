@@ -557,6 +557,8 @@ def nonzero(x, as_tuple=False):
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
            dtype="int64", name=None):
+    """``dtype`` selects the index outputs' int width in the reference;
+    indices are int32 on this stack (x64 disabled) — accepted for parity."""
     arr = np.asarray(x._data)
     res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
@@ -568,6 +570,8 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    """``dtype`` selects the index outputs' int width in the reference;
+    indices are int32 on this stack (x64 disabled) — accepted for parity."""
     arr = np.asarray(x._data)
     if axis is None:
         arr = arr.reshape(-1)
