@@ -177,6 +177,51 @@ class _Unsafe(Exception):
     pass
 
 
+def _expr_loads(e):
+    return {n.id for n in ast.walk(e)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _target_names(t):
+    if isinstance(t, ast.Name):
+        return {t.id}
+    out = set()
+    for e in getattr(t, "elts", ()):
+        out |= _target_names(e)
+    return out
+
+
+def _live_in(stmts):
+    """Names READ before any store in one pass over a safe-subset body —
+    these must be loop-carried; names only written-then-read inside the
+    body are pure temporaries and stay body-locals."""
+    live = set()
+
+    def walk(stmts, defined):
+        for s in stmts:
+            if isinstance(s, ast.Assign):
+                live.update(_expr_loads(s.value) - defined)
+                for t in s.targets:
+                    defined |= _target_names(t)
+            elif isinstance(s, ast.AugAssign):
+                live.update((_expr_loads(s.value) | {s.target.id})
+                            - defined)
+                defined.add(s.target.id)
+            elif isinstance(s, ast.AnnAssign):
+                if s.value is not None:
+                    live.update(_expr_loads(s.value) - defined)
+                defined.add(s.target.id)
+            elif isinstance(s, ast.If):
+                live.update(_expr_loads(s.test) - defined)
+                d1 = walk(s.body, set(defined))
+                d2 = walk(s.orelse, set(defined))
+                defined |= (d1 & d2)   # definitely-assigned on both arms
+        return defined
+
+    walk(stmts, set())
+    return live
+
+
 class _SafetyCheck(ast.NodeVisitor):
     """Reject bodies with control-flow escapes or side-effect statements."""
 
@@ -204,9 +249,12 @@ class _SafetyCheck(ast.NodeVisitor):
 # ---------------------------------------------------------------------------
 
 class _WhileRewriter(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, outside_loads=None):
         self.counter = 0
         self.rewrote = False
+        #: names loaded anywhere in the function OUTSIDE each while —
+        #: a body temp read after the loop must stay loop-carried
+        self.outside_loads = outside_loads or {}
 
     # do not descend into nested function/class definitions: only the
     # target function's own loops are rewritten
@@ -229,10 +277,19 @@ class _WhileRewriter(ast.NodeTransformer):
         self.generic_visit(node)     # rewrite inner ifs' loops first
         if not _SafetyCheck().check(node):
             return node
-        # loop state = names REBOUND in the body; everything else the
-        # condition/body reads is loop-invariant and resolves through the
-        # nested functions' natural closure over the enclosing frame
-        names = sorted(set(_stored_names(node.body)))
+        # loop state = names REBOUND in the body that are also OBSERVED
+        # across iterations or outside the loop: read-before-write in
+        # the body (carried between trips), read by the condition, or
+        # read after the loop. Stored names that fail all three are pure
+        # body temporaries and stay body_fn locals — so a fresh temp
+        # introduced inside the loop does not force the NameError
+        # fallback. Everything else the condition/body reads is
+        # loop-invariant and resolves through the nested functions'
+        # natural closure over the enclosing frame.
+        stored = set(_stored_names(node.body))
+        observed = _live_in(node.body) | _expr_loads(node.test) | \
+            self.outside_loads.get(id(node), set())
+        names = sorted(stored & observed)
         if not names:
             return node
         n = self.counter
@@ -306,7 +363,19 @@ def rewrite_loops(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    rw = _WhileRewriter()
+    from collections import Counter
+    total = Counter(n.id for n in ast.walk(fdef)
+                    if isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load))
+    outside = {}
+    for w in ast.walk(fdef):
+        if isinstance(w, ast.While):
+            inner = Counter(n.id for n in ast.walk(w)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load))
+            outside[id(w)] = {k for k, v in total.items()
+                              if v - inner.get(k, 0) > 0}
+    rw = _WhileRewriter(outside)
     rw.visit(fdef)
     if not rw.rewrote:
         return fn

@@ -430,3 +430,53 @@ class TestBoundedDifferentiableWhile:
         np.testing.assert_allclose(out3.numpy(), 8.0, rtol=1e-6)
         np.testing.assert_allclose(out5.numpy(), 32.0, rtol=1e-6)
         assert not fn._graph_broken and not fn._guarded
+
+
+def test_auto_while_with_branching_body():
+    """A rewritable while whose body contains if/elif/else assignment
+    chains still compiles once (the safe-subset If support)."""
+    import paddle_tpu.jit as jit
+    global _AUTO_TRACES
+
+    def stepper(x, n):
+        i = paddle.zeros([], "int32")
+        y = x
+        while i < n:
+            half = y * 0.5
+            if True:
+                y = half + 1.0
+            else:
+                y = half
+            i = i + 1
+        return y
+
+    from paddle_tpu.jit.loop_rewrite import rewrite_loops
+    g = rewrite_loops(stepper)
+    assert getattr(g, "__ptpu_loop_rewritten__", False)
+    fn = jit.to_static(stepper)
+    x = paddle.to_tensor(np.float32(8.0))
+    out2 = fn(x, paddle.to_tensor(np.int32(2)))
+    out4 = fn(x, paddle.to_tensor(np.int32(4)))
+    np.testing.assert_allclose(out2.numpy(), 8 * 0.25 + 0.5 + 1, rtol=1e-6)
+    assert np.isfinite(out4.numpy())
+    assert not fn._graph_broken and not fn._guarded
+
+
+def test_auto_while_temp_read_after_loop_stays_correct():
+    """A body temporary read AFTER the loop keeps exact Python
+    semantics (it is loop-carried, or the rewrite falls back)."""
+    from paddle_tpu.jit.loop_rewrite import rewrite_loops
+
+    def f(x, n):
+        i = paddle.zeros([], "int32")
+        last = x * 0.0
+        while i < n:
+            last = x + i.astype("float32")
+            i = i + 1
+        return last                       # value from the FINAL trip
+
+    g = rewrite_loops(f)
+    with paddle.no_grad():
+        out = g(paddle.to_tensor(np.float32(10.0)),
+                paddle.to_tensor(np.int32(3)))
+    np.testing.assert_allclose(out.numpy(), 12.0, rtol=1e-6)
