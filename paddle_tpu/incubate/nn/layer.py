@@ -110,6 +110,10 @@ class FusedMultiHeadAttention(nn.Layer):
         self.attn_dropout_rate = attn_dropout_rate
         self.normalize_before = normalize_before
         self.epsilon = epsilon
+        #: tensor-parallel ring: allreduce the out-projection partial
+        #: (nranks is the ring's size, informational here — the group
+        #: resolves from ring_id at call time)
+        self.ring_id = ring_id
         self.qkv_weight = self.create_parameter(
             [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr)
         self.qkv_bias = self.create_parameter(
@@ -162,6 +166,13 @@ class FusedMultiHeadAttention(nn.Layer):
             dropout_p=self.attn_dropout_rate, training=self.training)
         out = out.reshape([b, s, self.embed_dim])
         out = paddle.matmul(out, self.linear_weight)
+        from .functional.fused_transformer import _resolve_tp_reduce
+        tp_reduce = _resolve_tp_reduce(self.ring_id)
+        if tp_reduce is not None:
+            # row-parallel out projection: reduce the PARTIAL product
+            # before bias/residual (reference c_allreduce_sum placement)
+            from ...core.tensor import Tensor
+            out = Tensor(tp_reduce(out._data))
         if self.linear_bias is not None:
             out = out + self.linear_bias
         out = F.dropout(out, p=self.dropout_rate, training=self.training)
@@ -191,6 +202,7 @@ class FusedFeedForward(nn.Layer):
         self.activation = activation
         self.normalize_before = normalize_before
         self.epsilon = epsilon
+        self.ring_id = ring_id
         self.linear1 = nn.Linear(d_model, dim_feedforward,
                                  weight_attr=linear1_weight_attr,
                                  bias_attr=linear1_bias_attr)
@@ -215,7 +227,18 @@ class FusedFeedForward(nn.Layer):
         act = getattr(F, self.activation)
         x = act(self.linear1(x))
         x = F.dropout(x, p=self.act_dropout_rate, training=self.training)
-        x = self.linear2(x)
+        from .functional.fused_transformer import _resolve_tp_reduce
+        tp_reduce = _resolve_tp_reduce(self.ring_id)
+        if tp_reduce is not None:
+            # row-parallel linear2: reduce the partial BEFORE its bias
+            import paddle_tpu as paddle
+            from ...core.tensor import Tensor
+            x = paddle.matmul(x, self.linear2.weight)
+            x = Tensor(tp_reduce(x._data))
+            if self.linear2.bias is not None:
+                x = x + self.linear2.bias
+        else:
+            x = self.linear2(x)
         x = F.dropout(x, p=self.dropout_rate, training=self.training)
         out = residual + x
         if not self.normalize_before:

@@ -644,3 +644,29 @@ def test_transformer_encoder_container_cache():
         outs.append(o.numpy())
     np.testing.assert_allclose(np.concatenate(outs, 1), full.numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fused_layers_honor_ring_id(monkeypatch):
+    """The fused-layer classes apply the TP allreduce on their partial
+    products too (layer-level ring_id parity with the functionals)."""
+    import paddle_tpu.incubate.nn as inn
+    from paddle_tpu.distributed import collective as C
+    monkeypatch.setattr(C, "is_initialized", lambda: True)
+    monkeypatch.setattr(C, "raw_all_reduce_sum",
+                        lambda a, group=None: a * 2)
+    paddle.seed(12)
+    ff = inn.FusedFeedForward(8, 16, dropout_rate=0.0, ring_id=0)
+    ff.eval()
+    x = paddle.to_tensor(RNG.normal(size=(1, 3, 8)).astype(np.float32))
+    out = ff(x)
+    ff0 = inn.FusedFeedForward(8, 16, dropout_rate=0.0)
+    for p0, p1 in zip(ff0.parameters(), ff.parameters()):
+        p0._data = p1._data
+    ff0.eval()
+    base = ff0(x)
+    assert not np.allclose(out.numpy(), base.numpy())
+    mha = inn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0, ring_id=0)
+    mha.eval()
+    out2 = mha(x)
+    assert np.isfinite(out2.numpy()).all()
