@@ -26,11 +26,11 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
-def _as_loader(data, batch_size, shuffle, num_workers):
+def _as_loader(data, batch_size, shuffle, num_workers, drop_last=False):
     if data is None or isinstance(data, DataLoader):
         return data
     return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                      num_workers=num_workers)
+                      num_workers=num_workers, drop_last=drop_last)
 
 
 def _split_batch(batch, n_labels):
@@ -58,6 +58,20 @@ class Model:
     # ---- setup ----
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, use_jit=False):
+        # amp_configs (reference model.py:prepare): "O0"/"O1"/"O2" or a
+        # dict with a "level" key — train/eval forwards run under
+        # amp.auto_cast at that level
+        if amp_configs is None:
+            self._amp_level = None
+        elif isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        else:
+            raise TypeError(f"amp_configs must be a str level or dict, "
+                            f"got {type(amp_configs)}")
+        if self._amp_level == "O0":
+            self._amp_level = None
         self._optimizer = optimizer
         self._loss = loss
         metrics = _to_list(metrics)
@@ -87,8 +101,13 @@ class Model:
             loss = self._train_step(*inputs, *labels)
             outputs = None  # fused step doesn't surface intermediate outputs
         else:
-            outputs = self.network(*inputs)
-            loss = self._compute_loss(outputs, labels)
+            from contextlib import nullcontext
+            from ..amp import auto_cast
+            ctx = auto_cast(level=self._amp_level, dtype="bfloat16") \
+                if getattr(self, "_amp_level", None) else nullcontext()
+            with ctx:
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
             loss.backward()
             if update and self._optimizer is not None:
                 self._optimizer.step()
@@ -119,7 +138,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
         from .callbacks import config_callbacks
-        loader = _as_loader(train_data, batch_size, shuffle, num_workers)
+        loader = _as_loader(train_data, batch_size, shuffle, num_workers,
+                            drop_last)
         eval_loader = _as_loader(eval_data, batch_size, False, num_workers)
         self.save_dir = save_dir
         self.stop_training = False
@@ -160,11 +180,20 @@ class Model:
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 verbose=1, callbacks=None):
+        from .callbacks import config_callbacks
         loader = _as_loader(test_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=None,
+                                steps=steps, verbose=verbose,
+                                metrics=[], mode="predict")
+        cbks.on_predict_begin()
         outputs = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
             inputs, _ = _split_batch(batch, 0)
             outputs.append(self.predict_batch(inputs))
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
         if not outputs:
             return []
         n_out = len(outputs[0])
@@ -187,6 +216,13 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework import load as fload
         state = fload(path + ".pdparams")
+        if skip_mismatch:
+            # reference semantics: drop entries whose name or shape does
+            # not match the network instead of raising
+            own = dict(self.network.state_dict())
+            state = {k: v for k, v in state.items()
+                     if k in own and tuple(np.asarray(v).shape)
+                     == tuple(own[k].shape)}
         self.network.set_state_dict(state)
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None and \

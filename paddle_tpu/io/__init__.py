@@ -97,6 +97,22 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+def _perm(n, generator):
+    """Permutation from a seeded paddle Generator / int seed / None
+    (the module RNG) — reference generator semantics for samplers."""
+    if generator is None:
+        return np.random.permutation(n)
+    seed = getattr(generator, "seed", None)
+    if callable(seed):      # paddle Generator-like: use its current seed
+        try:
+            seed = generator.initial_seed()
+        except Exception:
+            seed = None
+    if seed is None:
+        seed = generator if isinstance(generator, int) else abs(hash(generator)) % (2**31)
+    return np.random.default_rng(int(seed)).permutation(n)
+
+
 def random_split(dataset, lengths, generator=None):
     n = len(dataset)
     if all(isinstance(l, float) for l in lengths):
@@ -105,7 +121,7 @@ def random_split(dataset, lengths, generator=None):
     if sum(lengths) != n:
         raise ValueError(
             f"sum of lengths {sum(lengths)} does not equal dataset size {n}")
-    idx = np.random.permutation(n).tolist()
+    idx = _perm(n, generator).tolist()
     out, off = [], 0
     for l in lengths:
         out.append(Subset(dataset, idx[off:off + l]))
@@ -151,12 +167,18 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self.num_samples = num_samples or len(data_source)
+        self.generator = generator
 
     def __iter__(self):
         n = len(self.data_source)
         if self.replacement:
+            if self.generator is not None:
+                rng = np.random.default_rng(
+                    self.generator if isinstance(self.generator, int)
+                    else abs(hash(self.generator)) % (2**31))
+                return iter(rng.integers(0, n, self.num_samples).tolist())
             return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+        return iter(_perm(n, self.generator)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
