@@ -59,19 +59,30 @@ class Model:
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, use_jit=False):
         # amp_configs (reference model.py:prepare): "O0"/"O1"/"O2" or a
-        # dict with a "level" key — train/eval forwards run under
-        # amp.auto_cast at that level
-        if amp_configs is None:
-            self._amp_level = None
-        elif isinstance(amp_configs, str):
-            self._amp_level = amp_configs
-        elif isinstance(amp_configs, dict):
-            self._amp_level = amp_configs.get("level", "O1")
-        else:
-            raise TypeError(f"amp_configs must be a str level or dict, "
-                            f"got {type(amp_configs)}")
-        if self._amp_level == "O0":
-            self._amp_level = None
+        # dict with level/dtype/custom lists — train, eval, AND the
+        # fused use_jit step all run their forwards under amp.auto_cast
+        self._amp_kwargs = None
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                cfg = {"level": amp_configs}
+            elif isinstance(amp_configs, dict):
+                cfg = dict(amp_configs)
+            else:
+                raise TypeError(f"amp_configs must be a str level or "
+                                f"dict, got {type(amp_configs)}")
+            allowed = {"level", "dtype", "custom_white_list",
+                       "custom_black_list", "use_promote"}
+            unknown = set(cfg) - allowed
+            if unknown:
+                raise ValueError(f"unknown amp_configs keys {unknown}")
+            level = cfg.get("level", "O1")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp level must be 'O0'/'O1'/'O2', got {level!r}")
+            if level != "O0":
+                cfg["level"] = level
+                cfg.setdefault("dtype", "bfloat16")
+                self._amp_kwargs = cfg
         self._optimizer = optimizer
         self._loss = loss
         metrics = _to_list(metrics)
@@ -93,19 +104,16 @@ class Model:
             n_in = len(inputs)
 
             def loss_fn(*flat):
-                outs = self.network(*flat[:n_in])
-                return self._compute_loss(outs, list(flat[n_in:]))
+                with self._amp_ctx():
+                    outs = self.network(*flat[:n_in])
+                    return self._compute_loss(outs, list(flat[n_in:]))
 
             self._train_step = TrainStep(self.network, loss_fn, self._optimizer)
         if self._train_step is not None:
             loss = self._train_step(*inputs, *labels)
             outputs = None  # fused step doesn't surface intermediate outputs
         else:
-            from contextlib import nullcontext
-            from ..amp import auto_cast
-            ctx = auto_cast(level=self._amp_level, dtype="bfloat16") \
-                if getattr(self, "_amp_level", None) else nullcontext()
-            with ctx:
+            with self._amp_ctx():
                 outputs = self.network(*inputs)
                 loss = self._compute_loss(outputs, labels)
             loss.backward()
@@ -115,13 +123,22 @@ class Model:
         metrics = self._update_metrics(outputs, labels)
         return (float(np.asarray(loss.numpy())), metrics)
 
+    def _amp_ctx(self):
+        from contextlib import nullcontext
+        kw = getattr(self, "_amp_kwargs", None)
+        if not kw:
+            return nullcontext()
+        from ..amp import auto_cast
+        return auto_cast(**kw)
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         with no_grad():
             inputs = [self._tensorize(x) for x in _to_list(inputs)]
             labels = [self._tensorize(y) for y in _to_list(labels)]
-            outputs = self.network(*inputs)
-            loss = self._compute_loss(outputs, labels) if self._loss else None
+            with self._amp_ctx():
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels) if self._loss else None
         metrics = self._update_metrics(outputs, labels)
         return (float(np.asarray(loss.numpy())) if loss is not None else None,
                 metrics)

@@ -170,9 +170,12 @@ class FusedMultiHeadAttention(nn.Layer):
         tp_reduce = _resolve_tp_reduce(self.ring_id)
         if tp_reduce is not None:
             # row-parallel out projection: reduce the PARTIAL product
-            # before bias/residual (reference c_allreduce_sum placement)
-            from ...core.tensor import Tensor
-            out = Tensor(tp_reduce(out._data))
+            # before bias/residual (reference c_allreduce_sum placement).
+            # Routed through op_call so the tape differentiates the
+            # reduce (a bare Tensor() rewrap would sever autograd).
+            from ...core.dispatch import op_call
+            out = op_call("tp_allreduce_partial",
+                          lambda a: tp_reduce(a), out, _transient=True)
         if self.linear_bias is not None:
             out = out + self.linear_bias
         out = F.dropout(out, p=self.dropout_rate, training=self.training)
@@ -230,11 +233,13 @@ class FusedFeedForward(nn.Layer):
         from .functional.fused_transformer import _resolve_tp_reduce
         tp_reduce = _resolve_tp_reduce(self.ring_id)
         if tp_reduce is not None:
-            # row-parallel linear2: reduce the partial BEFORE its bias
+            # row-parallel linear2: reduce the partial BEFORE its bias,
+            # through op_call so gradients flow to linear2.weight
             import paddle_tpu as paddle
-            from ...core.tensor import Tensor
+            from ...core.dispatch import op_call
             x = paddle.matmul(x, self.linear2.weight)
-            x = Tensor(tp_reduce(x._data))
+            x = op_call("tp_allreduce_partial",
+                        lambda a: tp_reduce(a), x, _transient=True)
             if self.linear2.bias is not None:
                 x = x + self.linear2.bias
         else:

@@ -97,20 +97,29 @@ class Subset(Dataset):
         return len(self.indices)
 
 
-def _perm(n, generator):
-    """Permutation from a seeded paddle Generator / int seed / None
-    (the module RNG) — reference generator semantics for samplers."""
-    if generator is None:
-        return np.random.permutation(n)
-    seed = getattr(generator, "seed", None)
-    if callable(seed):      # paddle Generator-like: use its current seed
+def _gen_seed(generator):
+    """Base int seed for a paddle-Generator-like / int / arbitrary
+    generator object (shared by every sampler path)."""
+    seed = None
+    if callable(getattr(generator, "initial_seed", None)):
         try:
             seed = generator.initial_seed()
         except Exception:
             seed = None
     if seed is None:
-        seed = generator if isinstance(generator, int) else abs(hash(generator)) % (2**31)
-    return np.random.default_rng(int(seed)).permutation(n)
+        seed = generator if isinstance(generator, int) \
+            else abs(hash(generator)) % (2**31)
+    return int(seed)
+
+
+def _perm(n, generator, epoch=0):
+    """Permutation from a seeded generator: reproducible ACROSS runs but
+    different per epoch (the reference/torch generator advances between
+    epochs — the epoch index folds into the seed here)."""
+    if generator is None:
+        return np.random.permutation(n)
+    return np.random.default_rng(
+        _gen_seed(generator) + int(epoch)).permutation(n)
 
 
 def random_split(dataset, lengths, generator=None):
@@ -171,14 +180,16 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        epoch = getattr(self, "_epoch", 0)
+        self._epoch = epoch + 1
         if self.replacement:
             if self.generator is not None:
-                rng = np.random.default_rng(
-                    self.generator if isinstance(self.generator, int)
-                    else abs(hash(self.generator)) % (2**31))
+                rng = np.random.default_rng(_gen_seed(self.generator)
+                                            + epoch)
                 return iter(rng.integers(0, n, self.num_samples).tolist())
             return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(_perm(n, self.generator)[:self.num_samples].tolist())
+        return iter(_perm(n, self.generator,
+                          epoch)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples

@@ -249,12 +249,16 @@ class _SafetyCheck(ast.NodeVisitor):
 # ---------------------------------------------------------------------------
 
 class _WhileRewriter(ast.NodeTransformer):
-    def __init__(self, outside_loads=None):
+    def __init__(self, outside_loads=None, scope_escapes=None):
         self.counter = 0
         self.rewrote = False
         #: names loaded anywhere in the function OUTSIDE each while —
         #: a body temp read after the loop must stay loop-carried
         self.outside_loads = outside_loads or {}
+        #: names declared global/nonlocal in the function: a loop that
+        #: stores one cannot be rewritten (the store must reach the
+        #: outer scope, which the extracted body_fn cannot do)
+        self.scope_escapes = scope_escapes or set()
 
     # do not descend into nested function/class definitions: only the
     # target function's own loops are rewritten
@@ -287,6 +291,8 @@ class _WhileRewriter(ast.NodeTransformer):
         # loop-invariant and resolves through the nested functions'
         # natural closure over the enclosing frame.
         stored = set(_stored_names(node.body))
+        if stored & self.scope_escapes:
+            return node
         observed = _live_in(node.body) | _expr_loads(node.test) | \
             self.outside_loads.get(id(node), set())
         names = sorted(stored & observed)
@@ -375,7 +381,11 @@ def rewrite_loops(fn):
                             and isinstance(n.ctx, ast.Load))
             outside[id(w)] = {k for k, v in total.items()
                               if v - inner.get(k, 0) > 0}
-    rw = _WhileRewriter(outside)
+    escapes = set()
+    for n in ast.walk(fdef):
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            escapes.update(n.names)
+    rw = _WhileRewriter(outside, escapes)
     rw.visit(fdef)
     if not rw.rewrote:
         return fn
