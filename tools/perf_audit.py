@@ -211,6 +211,16 @@ def attention_paths():
                 row[f"pallas_{bq}x{bk}_fwdbwd_ms"] = round(t * 1e3, 2)
             except Exception as e:
                 row[f"pallas_{bq}x{bk}_error"] = str(e)[:80]
+        # jax's production splash kernel, GQA-NATIVE (the MQA entry —
+        # grouped K/V, no repeat): the same wrapper
+        # PADDLE_TPU_ATTN_IMPL=splash engages at the step level
+        try:
+            from paddle_tpu.kernels import splash_attention
+            t = marginal2(fwdbwd(
+                lambda q: splash_attention(q, kv, kv, causal=True)))
+            row["splash_gqa_fwdbwd_ms"] = round(t * 1e3, 2)
+        except Exception as e:
+            row["splash_error"] = str(e)[:80]
         res.append(row)
     return res
 
