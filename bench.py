@@ -182,7 +182,8 @@ def run_bench(config="llama_125m", progress=None):
     model = LlamaForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
-    progress.mark("model_built", config=config)
+    opt_probe = _probe_opt_dispatches(paddle)
+    progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
         # bf16 autocast on the MXU-bound ops; fp32 master weights live in
@@ -233,7 +234,51 @@ def run_bench(config="llama_125m", progress=None):
             (statistics.stdev(rep_dts) / iters * 1e3) if len(rep_dts) > 1
             else 0.0, 2),
         "loss": round(val, 4),
+        **opt_probe,
     }
+
+
+def _probe_opt_dispatches(paddle, n_params=128):
+    """Measured per-step compiled-dispatch count of the optimizer path.
+
+    One eager AdamW step (global-norm clip, mixed f32/bf16) over a tiny
+    synthetic 128-param set, counted through the optimizer dispatch hook
+    (optimizer/fused.py). Records whether THIS run's configuration takes
+    the fused path — O(#dtype buckets)+1 — or the per-param loop —
+    O(n_params) — so the bench trajectory distinguishes the fused-optimizer
+    win from model-side changes. Cheap by construction (4x4 params), and
+    independent of the benchmark model whose eager step would not fit the
+    1B config's memory budget.
+    """
+    import numpy as _np
+    from paddle_tpu.optimizer import fused as _fused
+    try:
+        params = []
+        for i in range(n_params):
+            t = paddle.to_tensor(_np.zeros((4, 4), _np.float32),
+                                 dtype="bfloat16" if i % 4 == 0 else "float32")
+            t.stop_gradient = False
+            t.grad = paddle.to_tensor(_np.full((4, 4), 0.01, _np.float32),
+                                      dtype="bfloat16" if i % 4 == 0
+                                      else "float32")
+            params.append(t)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-4, parameters=params,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+        before = _fused.dispatch_count()
+        opt.step()
+        n = _fused.dispatch_count() - before
+        eng = opt._fused_engine
+        fused_on = eng is not None and eng.active
+        return {
+            "optimizer_mode": "fused" if fused_on else "per_param",
+            "opt_dispatches_per_step": n,
+            "opt_buckets": len(eng.buckets) if fused_on else 0,
+            "opt_dispatch_probe_params": n_params,
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"optimizer_mode": "unknown",
+                "opt_dispatch_probe_error": f"{type(e).__name__}: {e}"}
 
 
 def _child_main():

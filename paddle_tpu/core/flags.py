@@ -198,6 +198,11 @@ define_flag("tensor_operants_mode", str, "eager",
             "operator dispatch mode (eager dispatch is the only tier)")
 define_flag("jit_engine_type", str, "xla",
             "compiled-path engine (xla; the reference lists executor/pir)")
+define_flag("fused_optimizer", bool, True,
+            "multi-tensor fused optimizer path: dtype-bucketed flat "
+            "updates with buffer donation (optimizer/fused.py) — one "
+            "compiled dispatch per (dtype, device) bucket instead of one "
+            "per parameter; False restores the per-parameter loop")
 define_flag("sot_specialization_cache_size", int, 32,
             "max SOT-lite branch specializations kept per input signature "
             "(LRU eviction; the reference's sot guard-cache bound)")

@@ -1,0 +1,47 @@
+"""``jax.shard_map`` compatibility.
+
+Newer jax exports ``shard_map`` at top level with the ``check_vma`` kwarg;
+older releases (including the 0.4.x line this container bakes in) keep it
+under ``jax.experimental.shard_map`` with the kwarg named ``check_rep``.
+Every shard_map consumer in this package imports from here so the whole
+distributed stack works on both lines.
+"""
+from __future__ import annotations
+
+import types
+
+_impl = None
+_new_api = False
+try:
+    from jax import shard_map as _top  # jax >= 0.6
+    if isinstance(_top, types.ModuleType):
+        _impl = _top.shard_map
+    else:
+        _impl = _top
+    _new_api = True
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _impl  # noqa: F401
+
+
+def axis_size(name):
+    """``jax.lax.axis_size`` compatibility: older jax resolves a bound
+    mesh-axis size through ``jax.core.axis_frame`` instead."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    size = jax.core.axis_frame(name)
+    return size if isinstance(size, int) else size.size
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
+              **kw):
+    if check_vma is not None:
+        kw["check_vma" if _new_api else "check_rep"] = check_vma
+    if not _new_api and "axis_names" in kw:
+        # partial-manual regions: the new API names the MANUAL axes
+        # (axis_names); the old API names the AUTO ones (complement)
+        manual = set(kw.pop("axis_names"))
+        kw["auto"] = frozenset(n for n in mesh.axis_names
+                               if n not in manual)
+    return _impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -30,7 +30,7 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from ._shard_map_compat import shard_map
 
 _BIG = 1e30
 

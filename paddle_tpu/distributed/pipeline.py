@@ -35,7 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._shard_map_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 

@@ -410,7 +410,16 @@ class TrainStep:
         params = [p for p in optimizer._parameter_list if not p.stop_gradient]
         self._params = {f"p{i}": p for i, p in enumerate(params)}
 
+    def _fused_eng(self):
+        eng = getattr(self.optimizer, "_fused_engine", None)
+        return eng if (eng is not None and eng.active) else None
+
     def _opt_state_arrays(self):
+        eng = self._fused_eng()
+        if eng is not None:
+            # fused path: optimizer state IS the engine's flat per-bucket
+            # buffers — O(#dtype buckets) donated inputs, not O(n_params)
+            return eng.state_arrays()
         out = {}
         for i, p in self._params.items():
             st = self.optimizer._state.get(id(p))
@@ -420,6 +429,10 @@ class TrainStep:
         return out
 
     def _install_opt_state(self, arrays):
+        eng = self._fused_eng()
+        if eng is not None:
+            eng.install_state(arrays)
+            return
         for i, p in self._params.items():
             st = {}
             prefix = f"{i}."
@@ -456,6 +469,9 @@ class TrainStep:
                 inst_p = _Installed(param_t)
                 inst_b = _Installed(buffer_t)
                 saved_state = {pid: dict(st) for pid, st in opt._state.items()}
+                eng = getattr(opt, "_fused_engine", None)
+                saved_eng = eng.snapshot() if eng is not None and eng.active \
+                    else None
                 saved_step, saved_lr = opt._step_count, opt._lr
                 saved_grads = {k: p.grad for k, p in param_t.items()}
                 try:
@@ -490,6 +506,8 @@ class TrainStep:
                         return new_params, new_opt, new_buffers, loss._data
                 finally:
                     opt._state = saved_state
+                    if saved_eng is not None:
+                        eng.restore(saved_eng)
                     opt._step_count, opt._lr = saved_step, saved_lr
                     for k, p in param_t.items():
                         p.grad = saved_grads[k]
@@ -558,10 +576,14 @@ class TrainStep:
         return Tensor(loss)
 
     def _prime_state(self):
-        """Create optimizer state (zeros) ahead of tracing so state rides as
-        donated inputs rather than baked constants. Uses each optimizer's
-        _state_schema — the same source _apply_one reads."""
-        for p in self._params.values():
+        """Create optimizer state ahead of tracing so state rides as
+        donated inputs rather than baked constants. Fused optimizers build
+        their dtype buckets instead (flat state, O(#buckets) inputs); the
+        per-param schema priming is the fallback."""
+        params = list(self._params.values())
+        if self.optimizer._prime_fused(params):
+            return
+        for p in params:
             self.optimizer._param_state(p)
 
 

@@ -26,6 +26,7 @@ import os
 import jax
 
 from .flash_attention import flash_attention as pallas_flash_attention
+from .fused_adamw import fused_adamw as pallas_fused_adamw
 from .rms_norm import rms_norm as pallas_rms_norm
 
 
@@ -221,4 +222,5 @@ def install():
     return True
 
 
-__all__ = ["pallas_flash_attention", "pallas_rms_norm", "install"]
+__all__ = ["pallas_flash_attention", "pallas_rms_norm",
+           "pallas_fused_adamw", "install"]

@@ -131,4 +131,24 @@ def autotune_enabled() -> bool:
     return os.environ.get("PADDLE_TPU_AUTOTUNE") == "1"
 
 
-__all__ = ["KernelAutotuner", "get_autotuner", "autotune_enabled"]
+def pick_cached(key, requested, candidates, build_fn, traced=False):
+    """The shared winner-cache discipline every Pallas kernel consumes
+    (flash_attention, rms_norm, fused_adamw): a cached winner always wins;
+    under a trace only the cache is consulted — measurement needs concrete
+    buffers — so ``requested`` rides through unmeasured; otherwise the
+    caller's explicit config competes against ``candidates`` and the
+    measured winner is cached. Returns the chosen config dict."""
+    tuner = get_autotuner()
+    cached = tuner.cache.get(tuner._key(key))
+    if cached is not None:
+        return cached
+    if traced:
+        return requested
+    cands = list(candidates)
+    if requested not in cands:
+        cands.insert(0, requested)
+    return tuner.pick(key=key, candidates=cands, build_fn=build_fn)
+
+
+__all__ = ["KernelAutotuner", "get_autotuner", "autotune_enabled",
+           "pick_cached"]

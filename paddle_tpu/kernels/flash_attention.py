@@ -370,7 +370,7 @@ def flash_attention(q, k, v, causal=False, scale=None,
     b, hq, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     assert hq % hkv == 0, f"GQA needs hq % hkv == 0, got {hq}, {hkv}"
-    from .autotune import autotune_enabled, get_autotuner
+    from .autotune import autotune_enabled, pick_cached
     if autotune_enabled():
         # runtime block-size selection with a per-shape winner cache
         # (reference: phi/kernels/autotune switch_autotune.h + cache.h)
@@ -381,17 +381,19 @@ def flash_attention(q, k, v, causal=False, scale=None,
         # the caller's explicit (valid) blocks always compete, so enabling
         # autotune can never break or silently override a working call
         explicit = {"block_q": min(block_q, sq), "block_k": min(block_k, sk)}
-        if sq % explicit["block_q"] == 0 and sk % explicit["block_k"] == 0 \
-                and explicit not in cands:
-            cands.insert(0, explicit)
-        cfg = get_autotuner().pick(
+        if not (sq % explicit["block_q"] == 0
+                and sk % explicit["block_k"] == 0) and cands:
+            explicit = cands[0]
+        cfg = pick_cached(
             key=("flash_attention", tuple(q.shape), tuple(k.shape),
                  str(q.dtype), bool(causal), bool(interpret)),
+            requested=explicit,
             candidates=cands,
             build_fn=lambda c: (lambda: _flash(
                 q, k, v, float(scale or 1.0 / math.sqrt(d)), bool(causal),
                 int(min(c["block_q"], sq)), int(min(c["block_k"], sk)),
-                bool(interpret))))
+                bool(interpret))),
+            traced=isinstance(q, jax.core.Tracer))
         block_q, block_k = cfg["block_q"], cfg["block_k"]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)

@@ -132,7 +132,34 @@ def _rms_bwd(eps, block_rows, interpret, res, g):
 _rms.defvjp(_rms_fwd, _rms_bwd)
 
 
+def _pick_block_rows(x, weight, epsilon, requested, interpret):
+    """Route block_rows through the measured autotuner
+    (kernels/autotune.py) when PADDLE_TPU_AUTOTUNE=1 — same winner-cache
+    discipline as flash_attention. Under a trace only a cached winner is
+    consulted; measurement needs concrete buffers."""
+    from .autotune import autotune_enabled, pick_cached
+    if not autotune_enabled():
+        return requested
+    f = x.shape[-1]
+    rows = 1
+    for d in x.shape[:-1]:
+        rows *= int(d)
+    cfg = pick_cached(
+        key=("rms_norm", (rows, f), str(x.dtype), bool(interpret)),
+        requested={"block_rows": requested},
+        candidates=[{"block_rows": b} for b in (64, 128, 256, 512, 1024)
+                    if b <= max(rows, 8)],
+        build_fn=lambda c: (lambda: _run_fwd(
+            x.reshape(-1, f), weight, float(epsilon), int(c["block_rows"]),
+            bool(interpret))[0]),
+        traced=isinstance(x, jax.core.Tracer)
+        or isinstance(weight, jax.core.Tracer))
+    return cfg["block_rows"]
+
+
 def rms_norm(x, weight, epsilon=1e-6, block_rows=DEFAULT_BLOCK_ROWS,
              interpret=False):
     """Fused RMSNorm over the last axis. Differentiable (custom VJP)."""
+    block_rows = _pick_block_rows(x, weight, epsilon, int(block_rows),
+                                  bool(interpret))
     return _rms(x, weight, float(epsilon), int(block_rows), bool(interpret))

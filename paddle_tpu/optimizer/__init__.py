@@ -4,5 +4,6 @@ from .optimizer import (  # noqa: F401
     Lamb, NAdam, RAdam, ASGD, Rprop,
 )
 from .lbfgs import LBFGS  # noqa: F401
+from . import fused  # noqa: F401  (multi-tensor fused engine + dispatch counter)
 from . import lr  # noqa: F401
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

@@ -52,11 +52,19 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def _global_norm(self, grads):
         return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
 
-    def _clip_arrays(self, params, grads):
+    def _scale(self, grads):
+        """Scalar rescale factor for this grad set. Shared by the eager
+        per-tensor path below and the fused engine (optimizer/fused.py),
+        which evaluates it as ONE jitted reduction over every bucket's
+        grads and applies it inside the flat bucket updates."""
         gn = self._global_norm(grads)
         scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
         if self.auto_skip_clip:
             # reference: leave grads EXACTLY untouched when already
             # inside the norm ball (no ~1.0 rescale)
             scale = jnp.where(gn <= self.clip_norm, 1.0, scale)
+        return scale
+
+    def _clip_arrays(self, params, grads):
+        scale = self._scale(grads)
         return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]

@@ -41,6 +41,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._state: dict[int, dict] = {}
         self._step_count = 0
+        self._fused_engine = None  # lazy FusedOptimizerEngine (fused.py)
 
     # -- lr --
     def get_lr(self):
@@ -69,12 +70,21 @@ class Optimizer:
 
     def _param_state(self, p):
         st = self._state.get(id(p))
+        eng = self._fused_engine
+        if eng is not None and eng.active \
+                and (st is None or eng.state_dirty):
+            # state lives in the engine's flat buckets; (re)materialize the
+            # per-param views whenever the buffers advanced past them
+            eng.sync_to_param_state()
+            st = self._state.get(id(p))
         if st is None:
             st = {name: init(p._data) for name, init in self._state_schema(p)}
             self._state[id(p)] = st
         return st
 
     def state_dict(self):
+        if self._fused_engine is not None and self._fused_engine.active:
+            self._fused_engine.sync_to_param_state()
         out = {"step": self._step_count}
         for i, p in enumerate(self._parameter_list):
             st = self._state.get(id(p))
@@ -86,6 +96,12 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
+        if self._fused_engine is not None and self._fused_engine.active:
+            # refresh per-param views first so keys ABSENT from `state`
+            # keep their live values, then let the loaded keys overwrite;
+            # buckets rebuild from the merged per-param state next step
+            self._fused_engine.sync_to_param_state()
+            self._fused_engine.invalidate()
         self._step_count = state.get("step", 0)
         for p in self._parameter_list:
             st = {}
@@ -105,6 +121,19 @@ class Optimizer:
         params = [p for p in self._parameter_list
                   if p.grad is not None and not p.stop_gradient]
         grads = [p.grad._data for p in params]
+        lr = self.get_lr()
+        if params and self._fused_enabled():
+            from .fused import FusedOptimizerEngine
+            if self._fused_engine is None:
+                self._fused_engine = FusedOptimizerEngine(self)
+            if self._fused_engine.step(params, grads, lr):
+                return
+        if self._fused_engine is not None and self._fused_engine.active:
+            # handing back to the per-param loop (flag flipped off, params
+            # became sharded): _apply_one must see the live flat state
+            self._fused_engine.sync_to_param_state()
+            self._fused_engine.invalidate()
+        from .fused import record_dispatch
         if self._grad_clip is not None:
             grads = self._grad_clip._clip_arrays(params, grads)
         if self._l1_decay:
@@ -113,12 +142,37 @@ class Optimizer:
             # L2 path (applied inside the update kernels post-clip)
             grads = [g + self._l1_decay * jnp.sign(p._data).astype(g.dtype)
                      for p, g in zip(params, grads)]
-        lr = self.get_lr()
         for p, g in zip(params, grads):
             self._apply_one(p, g, lr)
+            record_dispatch()
 
     def _apply_one(self, p, g, lr):
         raise NotImplementedError
+
+    # -- fused multi-tensor path (fused.py) --
+    def _fused_enabled(self):
+        from ..core.flags import GLOBAL_FLAGS
+        return bool(GLOBAL_FLAGS.get("fused_optimizer")) \
+            and hasattr(self, "_fused_flat_update")
+
+    def _prime_fused(self, params):
+        """Build the fused engine's buckets ahead of jit tracing so flat
+        state rides as donated inputs of the compiled step (jit.TrainStep).
+        True when the fused path will serve the traced ``step()``."""
+        params = [p for p in params if not p.stop_gradient]
+        if not (params and self._fused_enabled()):
+            return False
+        from .fused import FusedOptimizerEngine
+        if self._fused_engine is None:
+            self._fused_engine = FusedOptimizerEngine(self)
+        return self._fused_engine.prime(params)
+
+    def _fused_aux(self, params):
+        """(static, arrays) bucket aux for the fused path: static python
+        scalars plus per-ELEMENT f32 vectors broadcasting per-PARAM
+        hyperparameters (AdamW's apply_decay_param_fun / lr_ratio hooks)
+        over each param's span of the flat buffer."""
+        return {}, {}
 
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:
@@ -152,6 +206,18 @@ class SGD(Optimizer):
     def _apply_one(self, p, g, lr):
         p._inplace_update(_sgd_update(p._data, g, lr, self._weight_decay))
 
+    def _fused_flat_update(self, bucket, allow_kernel=True):
+        """Flat-bucket mirror of ``_sgd_update`` (fused.py contract:
+        ``(flat_p, flat_g, state, aux, lr, t) -> (new_flat_p, new_state)``,
+        traced inside the bucket's single jitted dispatch)."""
+        wd = self._weight_decay
+
+        def upd(flat_p, flat_g, state, aux, lr, t):
+            g = flat_g + wd * flat_p
+            return flat_p - lr * g.astype(flat_p.dtype), state
+
+        return upd
+
 
 @functools.partial(jax.jit, static_argnums=(6,))
 def _momentum_update(p, g, lr, vel, mu, wd, use_nesterov):
@@ -180,6 +246,18 @@ class Momentum(Optimizer):
             p._data, g, lr, st["velocity"], self._momentum, self._weight_decay,
             self._nesterov)
         p._inplace_update(new_p)
+
+    def _fused_flat_update(self, bucket, allow_kernel=True):
+        mu, wd = self._momentum, self._weight_decay
+        nesterov = self._nesterov
+
+        def upd(flat_p, flat_g, state, aux, lr, t):
+            g = flat_g + wd * flat_p
+            v = mu * state["velocity"] + g
+            u = g + mu * v if nesterov else v
+            return flat_p - lr * u.astype(flat_p.dtype), {"velocity": v}
+
+        return upd
 
 
 # ---------------- Adam family ----------------
@@ -223,6 +301,53 @@ class Adam(Optimizer):
             self._eps, self._step_count, self._decoupled, self._weight_decay)
         p._inplace_update(new_p)
 
+    def _fused_flat_update(self, bucket, allow_kernel=True):
+        """Flat-bucket mirror of ``_adam_update``, covering AdamW via
+        ``_decoupled`` and the per-param wd / lr_ratio hooks via bucket aux
+        vectors. Uniform-hyperparameter bf16/f32 buckets route through the
+        Pallas fused-AdamW kernel on TPU (kernels/fused_adamw.py) — one
+        VMEM pass over param + both moments."""
+        beta1, beta2, eps = self._beta1, self._beta2, self._eps
+        decoupled = self._decoupled
+        wd = bucket.static.get("wd", self._weight_decay)
+        wd_vec = "wd" in bucket.aux
+        ratio = bucket.static.get("lr_ratio")
+        ratio_vec = "lr_ratio" in bucket.aux
+        has_wd = wd_vec or bool(wd)
+        pdt = str(jnp.result_type(bucket.params[0]._data))
+        kernel_ok = (allow_kernel and not wd_vec and not ratio_vec
+                     and pdt in ("float32", "bfloat16"))
+
+        def upd(flat_p, flat_g, state, aux, lr, t):
+            lr_eff = lr if ratio is None else lr * ratio
+            if kernel_ok:
+                from ..kernels.fused_adamw import maybe_fused_adamw
+                out = maybe_fused_adamw(
+                    flat_p, flat_g, state["moment1"], state["moment2"],
+                    lr_eff, t, beta1=beta1, beta2=beta2, eps=eps,
+                    weight_decay=wd if has_wd else 0.0, decoupled=decoupled)
+                if out is not None:
+                    new_p, m, v = out
+                    return new_p, {"moment1": m, "moment2": v}
+            g = flat_g.astype(jnp.float32)
+            pf = flat_p.astype(jnp.float32)
+            w = aux["wd"] if wd_vec else wd
+            if not decoupled and has_wd:
+                g = g + w * pf
+            m = beta1 * state["moment1"] + (1 - beta1) * g
+            v = beta2 * state["moment2"] + (1 - beta2) * jnp.square(g)
+            mhat = m / (1 - beta1 ** t)
+            vhat = v / (1 - beta2 ** t)
+            u = mhat / (jnp.sqrt(vhat) + eps)
+            if decoupled and has_wd:
+                u = u + w * pf
+            if ratio_vec:
+                lr_eff = lr * aux["lr_ratio"]
+            return (pf - lr_eff * u).astype(flat_p.dtype), \
+                {"moment1": m, "moment2": v}
+
+        return upd
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
@@ -250,6 +375,26 @@ class AdamW(Adam):
             p._data, g, lr, st["moment1"], st["moment2"], self._beta1, self._beta2,
             self._eps, self._step_count, True, wd)
         p._inplace_update(new_p)
+
+    def _fused_aux(self, params):
+        """Per-param hooks flattened once per bucket build: uniform values
+        stay static scalars; varying ones become per-element f32 vectors."""
+        from .fused import per_element_vector
+        static, arrays = {}, {}
+        wds = [0.0 if (self._apply_decay_fun is not None
+                       and not self._apply_decay_fun(p.name))
+               else self._weight_decay for p in params]
+        if len(set(wds)) > 1:
+            arrays["wd"] = per_element_vector(params, wds)
+        else:
+            static["wd"] = wds[0]
+        if self._lr_ratio is not None:
+            ratios = [float(self._lr_ratio(p)) for p in params]
+            if len(set(ratios)) > 1:
+                arrays["lr_ratio"] = per_element_vector(params, ratios)
+            else:
+                static["lr_ratio"] = ratios[0]
+        return static, arrays
 
 
 class Adamax(Optimizer):
@@ -446,6 +591,8 @@ class ASGD(Optimizer):
 
     def _apply_one(self, p, g, lr):
         p._inplace_update(_sgd_update(p._data, g, lr, self._weight_decay))
+
+    _fused_flat_update = SGD._fused_flat_update  # identical update math
 
 
 class Rprop(Optimizer):

@@ -1,0 +1,99 @@
+"""Dispatch-count regression gate for the fused optimizer path.
+
+The fused engine's headline win is the per-step host dispatch count
+dropping from O(n_params) to O(#dtype buckets). This gate counts jitted
+optimizer-update invocations per eager ``step()`` through the trace hook
+in optimizer/fused.py (``record_dispatch`` / ``dispatch_count``) and hard-
+fails if a >=100-parameter model ever issues more than #buckets + constant
+compiled dispatches again — the launch-count analog of the per-op perf
+gate in test_op_bench_gate.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.optimizer import fused
+
+N_PARAMS = 120
+# one global-norm reduction + slack for future constant-count additions
+DISPATCH_SLACK = 2
+
+
+@pytest.fixture
+def fused_flag():
+    yield
+    GLOBAL_FLAGS.set("fused_optimizer", True)
+
+
+def _model_params(n=N_PARAMS):
+    """>=100 params, mixed f32/bf16 (two dtype buckets)."""
+    rng = np.random.default_rng(0)
+    params = []
+    for i in range(n):
+        dtype = "bfloat16" if i % 3 == 0 else "float32"
+        shape = (4, 4) if i % 2 else (8,)
+        t = paddle.to_tensor(
+            rng.standard_normal(shape).astype(np.float32), dtype=dtype)
+        t.stop_gradient = False
+        t.name = f"p{i}"
+        t.grad = paddle.to_tensor(
+            rng.standard_normal(shape).astype(np.float32), dtype=dtype)
+        params.append(t)
+    return params
+
+
+def _opt(params):
+    return paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=params,
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+
+
+def test_fused_step_dispatches_bounded_by_buckets(fused_flag):
+    GLOBAL_FLAGS.set("fused_optimizer", True)
+    params = _model_params()
+    opt = _opt(params)
+    before = fused.dispatch_count()
+    opt.step()
+    first = fused.dispatch_count() - before
+    eng = opt._fused_engine
+    assert eng is not None and eng.active
+    n_buckets = len(eng.buckets)
+    assert n_buckets == 2, "mixed f32/bf16 set must form 2 dtype buckets"
+    assert first <= n_buckets + DISPATCH_SLACK, (
+        f"eager step() issued {first} compiled dispatches for "
+        f"{N_PARAMS} params ({n_buckets} buckets) — fused-path regression")
+    # steady state: the bound holds without bucket rebuild churn
+    before = fused.dispatch_count()
+    opt.step()
+    steady = fused.dispatch_count() - before
+    assert steady <= n_buckets + DISPATCH_SLACK
+    assert eng.last_dispatch_count == steady
+
+
+def test_per_param_path_scales_with_params(fused_flag):
+    """The gate's denominator is real: the opt-out path pays one dispatch
+    per parameter, which is exactly what the fused path collapses."""
+    GLOBAL_FLAGS.set("fused_optimizer", False)
+    params = _model_params()
+    opt = _opt(params)
+    before = fused.dispatch_count()
+    opt.step()
+    n = fused.dispatch_count() - before
+    assert n >= N_PARAMS
+
+
+def test_masked_subset_step_keeps_the_bound(fused_flag):
+    """Participation flicker (a param losing its grad) must not reopen a
+    per-param dispatch path."""
+    GLOBAL_FLAGS.set("fused_optimizer", True)
+    params = _model_params()
+    opt = _opt(params)
+    opt.step()
+    params[5].grad = None
+    params[10].grad = None
+    n_buckets = len(opt._fused_engine.buckets)
+    before = fused.dispatch_count()
+    opt.step()
+    n = fused.dispatch_count() - before
+    assert n <= n_buckets + DISPATCH_SLACK

@@ -232,6 +232,58 @@ class TestKernelAutotune:
         t2 = KernelAutotuner(cache_path=path)
         assert t2.pick(("flash", (4, 256), "bf16"), [], None) == {"bq": 128}
 
+    def test_autotuned_rms_norm_interpret(self, monkeypatch):
+        """rms_norm routes block_rows through the shared autotuner (same
+        winner-cache discipline as flash_attention): a winner is cached
+        under the "rms_norm" key, the tuned result matches the default
+        config, and a traced call consults the cache without measuring."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import autotune as at
+        from paddle_tpu.kernels.rms_norm import rms_norm
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        at._global = None  # fresh tuner
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((64,)), jnp.float32)
+        out = rms_norm(x, w, interpret=True)
+        tuner = at.get_autotuner()
+        keys = [k for k in tuner.cache if k[0] == "rms_norm"]
+        assert keys and tuner.cache[keys[0]]["block_rows"] >= 8
+        # under jit only the cached winner is consulted (no measurement)
+        traced = jax.jit(lambda x: rms_norm(x, w, interpret=True))(x)
+        np.testing.assert_allclose(np.asarray(traced), np.asarray(out),
+                                   rtol=1e-6, atol=1e-6)
+        monkeypatch.delenv("PADDLE_TPU_AUTOTUNE")
+        at._global = None
+        ref = rms_norm(x, w, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_autotuned_fused_adamw_interpret(self, monkeypatch):
+        """The fused-AdamW bucket kernel consumes the autotuner the same
+        way: measured winner cached per (size, dtype) key, tuned == default."""
+        import jax.numpy as jnp
+        from paddle_tpu.kernels import autotune as at
+        from paddle_tpu.kernels.fused_adamw import fused_adamw
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
+        at._global = None
+        rng = np.random.default_rng(1)
+        n = 4096
+        args = (jnp.asarray(rng.standard_normal(n), jnp.float32),
+                jnp.asarray(rng.standard_normal(n), jnp.float32),
+                jnp.asarray(rng.standard_normal(n), jnp.float32),
+                jnp.asarray(np.abs(rng.standard_normal(n)), jnp.float32))
+        out = fused_adamw(*args, 0.01, 2, weight_decay=0.01, interpret=True)
+        tuner = at.get_autotuner()
+        assert any(k[0] == "fused_adamw" for k in tuner.cache)
+        monkeypatch.delenv("PADDLE_TPU_AUTOTUNE")
+        at._global = None
+        ref = fused_adamw(*args, 0.01, 2, weight_decay=0.01, interpret=True)
+        for a, b in zip(out, ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
     def test_autotuned_flash_attention_interpret(self, monkeypatch):
         """End-to-end: autotune drives the real Pallas kernel (interpret
         mode) and the result matches the default-config kernel."""
