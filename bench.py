@@ -183,6 +183,7 @@ def run_bench(config="llama_125m", progress=None):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     opt_probe = _probe_opt_dispatches(paddle)
+    serving_probe = _probe_serving(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -235,6 +236,7 @@ def run_bench(config="llama_125m", progress=None):
             else 0.0, 2),
         "loss": round(val, 4),
         **opt_probe,
+        **serving_probe,
     }
 
 
@@ -279,6 +281,67 @@ def _probe_opt_dispatches(paddle, n_params=128):
     except Exception as e:  # the probe must never sink the bench artifact
         return {"optimizer_mode": "unknown",
                 "opt_dispatch_probe_error": f"{type(e).__name__}: {e}"}
+
+
+def _probe_serving(paddle, wave=6, max_new=4):
+    """Measured serving-engine fields for the bench trajectory.
+
+    Drives the continuous-batching LLMEngine (paddle_tpu/serving/) over a
+    mixed-length request wave on a micro Llama config: one warmup wave
+    pays the bucketed compiles, a second identical wave measures steady-
+    state serving throughput. Records:
+    - ``serving_tokens_per_s``: generated tokens / wall-clock of wave 2;
+    - ``kv_page_utilization``: peak fraction of pool pages in use;
+    - ``decode_compiles``: decode executables built across BOTH waves —
+      bounded by #shape buckets (tests/test_serving_compile_gate.py), so
+      a trajectory jump here flags per-composition recompilation.
+    Micro-sized by design (1 layer, d=128): the probe measures the
+    engine's batching/dispatch layer, not model FLOPs, and must not eat
+    the bench child's timeout budget.
+    """
+    import time as _time
+    import numpy as _np
+    try:
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=128, intermediate_size=256,
+            num_attention_heads=1, num_key_value_heads=1, vocab_size=256)
+        model = LlamaForCausalLM(cfg)
+        eng = LLMEngine(model, max_len=64, page_size=8,
+                        batch_buckets=(1, 2, 4, 8))
+        rng = _np.random.default_rng(0)
+        lengths = [3, 5, 8, 11, 14, 17][:wave]
+        peak_util = 0.0
+
+        def _wave():
+            nonlocal peak_util
+            for n in lengths:
+                eng.add_request(rng.integers(0, 256, (n,)).tolist(),
+                                max_new_tokens=max_new)
+            steps = 0
+            while eng.has_unfinished():
+                eng.step()
+                peak_util = max(peak_util, eng.pool.utilization)
+                steps += 1
+                assert steps < 500
+
+        _wave()                                   # warmup: compiles
+        tok0 = eng.metrics.tokens_generated.value
+        t0 = _time.perf_counter()
+        _wave()                                   # measured steady state
+        dt = _time.perf_counter() - t0
+        tokens = eng.metrics.tokens_generated.value - tok0
+        return {
+            "serving_tokens_per_s": round(tokens / dt, 1),
+            "kv_page_utilization": round(peak_util, 4),
+            "decode_compiles": eng.decode_cache_size(),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"serving_tokens_per_s": 0.0,
+                "kv_page_utilization": 0.0,
+                "decode_compiles": -1,
+                "serving_probe_error": f"{type(e).__name__}: {e}"}
 
 
 def _child_main():

@@ -69,6 +69,7 @@ from . import sysconfig  # noqa: F401,E402
 from . import dataset  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 
 # Late Tensor-method patching for functions living outside paddle_tpu.tensor
 # (reference tensor_method_func parity; see tensor/__init__.py).

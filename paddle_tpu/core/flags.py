@@ -32,11 +32,18 @@ class _Flag:
         if on_set is not None and env is not None:
             # an env-provided value must reach the wiring too — launching
             # with FLAGS_x=... is the canonical before-first-device-touch
-            # path (a callback failure must not break flag definition)
+            # path (a callback failure must not break flag definition, but
+            # it MUST be diagnosable: this is exactly the launch-time
+            # misconfiguration case)
             try:
                 on_set(self.value)
-            except Exception:
-                pass
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"FLAGS_{name}={env!r}: on_set wiring failed "
+                    f"({type(e).__name__}: {e}); the flag value is "
+                    f"recorded but its behavior did not take effect",
+                    RuntimeWarning, stacklevel=3)
 
     def _parse(self, s: str):
         if self.type is bool:

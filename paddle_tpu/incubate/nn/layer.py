@@ -133,11 +133,16 @@ class FusedMultiHeadAttention(nn.Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        if key is not None or value is not None:
+        # the common self-attention spelling attn(x, x, x) is legal: only
+        # GENUINE cross-attention (key/value a different tensor) is outside
+        # the fused kernel's contract
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
             raise NotImplementedError(
                 "FusedMultiHeadAttention is self-attention only (the "
-                "reference fused kernel's contract); pass query alone — "
-                "cross attention is served by nn.MultiHeadAttention")
+                "reference fused kernel's contract); pass query alone or "
+                "attn(x, x, x) — cross attention is served by "
+                "nn.MultiHeadAttention")
         if cache is not None:
             raise NotImplementedError(
                 "FusedMultiHeadAttention incremental decode (cache=) is "

@@ -17,6 +17,13 @@ python/paddle/generation-style APIs). Design:
   paged/block KV layout is played by the static ring of slots).
 
 Sampling: greedy / temperature / top-k / top-p, computed in-graph.
+
+Serving contract: paddle_tpu/serving/engine.py reuses ``_block`` (prefill
+path), ``_rope``/``_rms_norm``/``_logits`` and ``extract_params`` so the
+continuous-batching engine's math is THIS module's math — the greedy
+token-identity between ``LLMEngine`` and sequential ``Generator.generate``
+(tests/test_serving_engine.py) depends on these bodies staying shared.
+Change them here and the serving decode mirror (_decode_block) together.
 """
 from __future__ import annotations
 

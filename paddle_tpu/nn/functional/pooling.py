@@ -24,6 +24,7 @@ def _window(kernel, stride, padding, nd, channel_last, ceil_mode=False,
             in_sizes=None):
     k = _pair(kernel, nd)
     s = _pair(stride if stride is not None else kernel, nd)
+    extras = [0] * nd           # per-dim ceil_mode right-extension
     if isinstance(padding, str):
         pad = padding.upper()
     else:
@@ -45,17 +46,20 @@ def _window(kernel, stride, padding, nd, channel_last, ceil_mode=False,
                 out_ceil = -(-span // s[d]) + 1
                 extra = max(0, (out_ceil - 1) * s[d] + k[d]
                             - (in_sizes[d] + pad[d][0] + pad[d][1]))
+                extras[d] = extra
                 new_pad.append((pad[d][0], pad[d][1] + extra))
             pad = new_pad
     if channel_last:
         dims = (1,) + k + (1,)
         strides = (1,) + s + (1,)
         padding_full = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)] if not isinstance(pad, str) else pad
+        extras_full = (0,) + tuple(extras) + (0,)
     else:
         dims = (1, 1) + k
         strides = (1, 1) + s
         padding_full = [(0, 0), (0, 0)] + pad if not isinstance(pad, str) else pad
-    return dims, strides, padding_full, k
+        extras_full = (0, 0) + tuple(extras)
+    return dims, strides, padding_full, k, extras_full
 
 
 def _max_pool_body(a, *, dims, strides, pad):
@@ -112,8 +116,8 @@ def _spatial_sizes(x, nd, channel_last):
 
 def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ceil_mode=False):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    dims, strides, pad, _ = _window(kernel, stride, padding, nd, channel_last,
-                                    ceil_mode, _spatial_sizes(x, nd, channel_last))
+    dims, strides, pad, _, _ = _window(kernel, stride, padding, nd, channel_last,
+                                       ceil_mode, _spatial_sizes(x, nd, channel_last))
 
     out = op_call(f"max_pool{nd}d", _max_pool_body, x, dims=dims,
                   strides=strides,
@@ -137,7 +141,8 @@ def _max_pool(x, kernel, stride, padding, nd, data_format, return_mask=False, ce
     return out
 
 
-def _avg_pool_body(a, *, dims, strides, pad, k, exclusive, divisor=None):
+def _avg_pool_body(a, *, dims, strides, pad, k, exclusive, divisor=None,
+                   ceil_extra=None):
     summed = lax.reduce_window(a, 0.0, lax.add, dims, strides, pad)
     if divisor is not None:
         # reference avg_pool divisor_override: the fixed divisor replaces
@@ -146,6 +151,18 @@ def _avg_pool_body(a, *, dims, strides, pad, k, exclusive, divisor=None):
     if exclusive or isinstance(pad, str):
         ones = jnp.ones_like(a)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+        return summed / counts
+    if ceil_extra is not None and any(ceil_extra):
+        # exclusive=False counts real padding cells, but NOT the ceil_mode
+        # right-extension: a window reaching past the padded boundary is
+        # divided by its clamped size (reference pooling.cc AvgPool with
+        # adaptive ends clamped to input+padding). Count by padding ones
+        # over the ORIGINAL padded extent (value 1) and reducing with only
+        # the ceil extension as window padding (identity 0).
+        base_pad = [(lo, hi - e) for (lo, hi), e in zip(pad, ceil_extra)]
+        ones = jnp.pad(jnp.ones_like(a), base_pad, constant_values=1.0)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides,
+                                   [(0, e) for e in ceil_extra])
         return summed / counts
     return summed / float(np.prod(k))
 
@@ -156,8 +173,9 @@ _register_nd("avg_pool", _avg_pool_body)
 def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True,
               ceil_mode=False, divisor_override=None):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    dims, strides, pad, k = _window(kernel, stride, padding, nd, channel_last,
-                                    ceil_mode, _spatial_sizes(x, nd, channel_last))
+    dims, strides, pad, k, extras = _window(
+        kernel, stride, padding, nd, channel_last, ceil_mode,
+        _spatial_sizes(x, nd, channel_last))
     if divisor_override is not None and float(divisor_override) == 0:
         raise ValueError("divisor_override must be nonzero")
     return op_call(f"avg_pool{nd}d", _avg_pool_body, x, dims=dims,
@@ -165,7 +183,8 @@ def _avg_pool(x, kernel, stride, padding, nd, data_format, exclusive=True,
                    pad=pad if isinstance(pad, str) else tuple(pad), k=k,
                    exclusive=bool(exclusive),
                    divisor=None if divisor_override is None
-                   else float(divisor_override))
+                   else float(divisor_override),
+                   ceil_extra=None if isinstance(pad, str) else extras)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -309,9 +328,9 @@ _register_nd("lp_pool", _lp_pool_body)
 def _lp_pool(x, norm_type, kernel_size, stride, padding, ceil_mode,
              data_format, nd):
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    dims, strides, pad, k = _window(kernel_size, stride, padding, nd,
-                                    channel_last, ceil_mode,
-                                    _spatial_sizes(x, nd, channel_last))
+    dims, strides, pad, k, _ = _window(kernel_size, stride, padding, nd,
+                                       channel_last, ceil_mode,
+                                       _spatial_sizes(x, nd, channel_last))
     return op_call(f"lp_pool{nd}d", _lp_pool_body, x, p=float(norm_type),
                    dims=dims, strides=strides,
                    pad=pad if isinstance(pad, str) else tuple(pad))

@@ -301,12 +301,19 @@ class FusedOptimizerEngine:
         self.state_dirty = True  # per-param views in opt._state are stale
         return True
 
+    _MASK_CACHE_MAX = 64
+
     def _bucket_mask(self, b, present):
         mask = b.masks.get(present)
         if mask is None:
             mask = jnp.asarray(np.concatenate(
                 [np.full(sz, ok, bool)
                  for sz, ok in zip(b.sizes, present)]))
+            # bound the cache: flickering participation (MoE routing) can
+            # produce combinatorially many patterns, each mask is a full
+            # bucket-sized array — evict oldest-inserted beyond the cap
+            if len(b.masks) >= self._MASK_CACHE_MAX:
+                b.masks.pop(next(iter(b.masks)))
             b.masks[present] = mask
         return mask
 

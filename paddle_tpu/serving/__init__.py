@@ -1,0 +1,23 @@
+"""paddle_tpu.serving — continuous-batching LLM serving on TPU.
+
+Layers (docs/SERVING.md has the full architecture):
+
+- :mod:`kv_cache` — ``PagedKVPool``: free-list page allocator + per-
+  sequence block tables over the pool layout the Pallas decode kernel
+  (kernels/paged_attention.py) consumes.
+- :mod:`scheduler` — ``Scheduler``: FIFO admission, fixed-shape decode
+  bucket assembly, deadline load shedding, preemption-with-requeue.
+- :mod:`engine` — ``LLMEngine`` + ``Request``/``RequestOutput``: the
+  request lifecycle over bucketed jitted prefill/decode steps.
+- :mod:`metrics` — ``ServingMetrics``: counters/gauges exported to
+  bench.py and the profiler timeline.
+"""
+from .kv_cache import PagedKVPool, PoolExhausted, NULL_PAGE  # noqa: F401
+from .scheduler import (Scheduler, SchedulerConfig, Sequence,  # noqa: F401
+                        SequenceStatus, bucket_for)
+from .engine import LLMEngine, Request, RequestOutput  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+
+__all__ = ["LLMEngine", "Request", "RequestOutput", "PagedKVPool",
+           "PoolExhausted", "NULL_PAGE", "Scheduler", "SchedulerConfig",
+           "Sequence", "SequenceStatus", "ServingMetrics", "bucket_for"]

@@ -1,0 +1,443 @@
+"""LLMEngine — continuous-batching serving over the paged Pallas kernel.
+
+Turns the repo's existing pieces (models/generation.py prefill math,
+kernels/paged_attention.py decode kernel, the PagedKVPool allocator, the
+bucketed Scheduler) into a request-lifecycle engine:
+
+    engine = LLMEngine(model, max_len=256, page_size=16)
+    rid = engine.add_request([1, 2, 3], max_new_tokens=8)
+    while engine.has_unfinished():
+        for out in engine.step():       # incremental token streaming
+            ...
+    tokens = engine.outputs()[rid].token_ids
+
+Compilation contract (the TPU-shaped core of the design): the decode step
+is one jitted function whose input shapes are always a (batch_bucket,
+pages_bucket) pair from the scheduler's closed bucket set, so XLA compiles
+at most ``len(batch_buckets) * len(pages_buckets)`` decode executables no
+matter what request mix arrives (gated by
+tests/test_serving_compile_gate.py). Prefill is likewise bucketed over
+padded prompt lengths. Everything request-specific — block tables, true
+lengths, sampling temperature — is data, not shape.
+
+Greedy outputs are token-identical to sequential ``Generator.generate``:
+prefill reuses ``generation._block`` verbatim, decode mirrors its math
+over the shared pool, and preemption requeues in recompute mode (prefill
+over prompt+generated reproduces the same greedy continuation).
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.generation import (_block, _logits, _rms_norm, _rope,
+                                 extract_params)
+from ..kernels.paged_attention import paged_attention
+from .kv_cache import NULL_PAGE, PagedKVPool
+from .metrics import ServingMetrics
+from .scheduler import (Scheduler, SchedulerConfig, Sequence, SequenceStatus,
+                        bucket_for)
+
+
+@dataclass
+class Request:
+    """What a client submits."""
+    prompt_token_ids: list
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    eos_token_id: int | None = None
+    #: relative SLO in seconds: if the request is still *waiting* this long
+    #: after submission, the scheduler sheds it instead of serving it late
+    deadline_s: float | None = None
+    request_id: str | None = None
+
+
+@dataclass
+class RequestOutput:
+    """Live view of one request; ``token_ids`` grows as tokens stream."""
+    request_id: str
+    prompt_token_ids: list
+    token_ids: list = field(default_factory=list)
+    status: str = "waiting"
+    finish_reason: str | None = None
+    num_preemptions: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.status in ("finished", "shed", "cancelled", "aborted")
+
+
+def _sample_rows(logits, key, temps):
+    """Per-row sampling: temp<=0 rows take argmax (greedy, the parity
+    path), temp>0 rows sample categorically at their own temperature."""
+    greedy = jnp.argmax(logits, -1)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    sampled = jax.random.categorical(
+        key, logits.astype(jnp.float32) / safe_t[:, None], -1)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+def _decode_block(lyr, h, pos, cfg, Kp, Vp, tbls, lens, *, page_size,
+                  interpret):
+    """One decoder layer of the batched single-token decode over the
+    SHARED paged pool (mirrors generation._block's decode math, but with
+    real block tables instead of the Generator's identity mapping).
+
+    h: [B, 1, hidden]; pos/lens: [B] cached length per row (write slot);
+    Kp/Vp: [Hkv, num_pages, ps, d]; tbls: [B, pages_bucket].
+    Padded rows carry all-NULL tables, so their writes and reads land on
+    the null page and never touch live data.
+    """
+    H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    b = h.shape[0]
+    x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
+    q = (x @ lyr["q"]).reshape(b, 1, H, d)
+    k = (x @ lyr["k"]).reshape(b, 1, Hkv, d)
+    v = (x @ lyr["v"]).reshape(b, 1, Hkv, d)
+    q = _rope(q, pos[:, None], cfg.rope_theta, d)
+    k = _rope(k, pos[:, None], cfg.rope_theta, d)
+
+    # scatter the new token's K/V into each row's current page
+    npages = Kp.shape[1]
+    rows = jnp.arange(b)
+    slot = tbls[rows, lens // page_size] * page_size + lens % page_size
+    kt = jnp.transpose(k[:, 0], (1, 0, 2))          # [Hkv, B, d]
+    vt = jnp.transpose(v[:, 0], (1, 0, 2))
+    Kp = Kp.reshape(Hkv, npages * page_size, d).at[:, slot].set(kt) \
+           .reshape(Hkv, npages, page_size, d)
+    Vp = Vp.reshape(Hkv, npages * page_size, d).at[:, slot].set(vt) \
+           .reshape(Hkv, npages, page_size, d)
+
+    o = paged_attention(q[:, 0], Kp, Vp, tbls, lens + 1,
+                        interpret=interpret)        # [B, H, d]
+    h = h + o.reshape(b, 1, H * d) @ lyr["o"]
+    x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
+    h = h + (jax.nn.silu(x @ lyr["gate"]) * (x @ lyr["up"])) @ lyr["down"]
+    return h, (Kp, Vp)
+
+
+class LLMEngine:
+    """Continuous-batching serving engine over a paged KV pool."""
+
+    def __init__(self, model, *, max_len=256, page_size=16, num_pages=None,
+                 batch_buckets=(1, 2, 4, 8), pages_buckets=None,
+                 prefill_buckets=None, max_prefills_per_step=4,
+                 high_watermark=0.90, low_watermark=0.50, seed=0,
+                 stream_cb=None, now_fn=time.monotonic, interpret=None):
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len {max_len} must be a multiple of page_size "
+                f"{page_size}")
+        self.cfg = cfg = model.config
+        self.params = extract_params(model)
+        self.max_len = max_len
+        self.page_size = page_size
+        self.max_pages_per_seq = max_len // page_size
+        if num_pages is None:
+            # default: every batch slot can hold a max_len sequence, so
+            # preemption never fires unless the operator shrinks the pool
+            num_pages = max(batch_buckets) * self.max_pages_per_seq + 1
+        dtype = self.params["embed"].dtype
+        self.pool = PagedKVPool(
+            cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
+            num_pages=num_pages, page_size=page_size, dtype=dtype,
+            high_watermark=high_watermark, low_watermark=low_watermark)
+        self.metrics = ServingMetrics(now_fn=now_fn)
+        self.scheduler = Scheduler(
+            self.pool,
+            SchedulerConfig(batch_buckets=batch_buckets,
+                            pages_buckets=pages_buckets,
+                            max_prefills_per_step=max_prefills_per_step,
+                            now_fn=now_fn),
+            self.max_pages_per_seq, metrics=self.metrics)
+        self.prefill_buckets = tuple(sorted(set(
+            prefill_buckets or self._default_prefill_buckets())))
+        if max(self.prefill_buckets) < max_len:
+            raise ValueError("largest prefill bucket must reach max_len")
+        for s in self.prefill_buckets:
+            if s % page_size != 0:
+                raise ValueError(f"prefill bucket {s} not a multiple of "
+                                 f"page_size {page_size}")
+        if interpret is None:
+            from ..kernels import _on_tpu
+            interpret = not _on_tpu()
+        self._interpret = interpret
+        self._now = now_fn
+        self._stream_cb = stream_cb
+        self._key = jax.random.key(seed)
+        self._ids = itertools.count()
+        self._seqs: dict[str, Sequence] = {}
+        self._outputs: dict[str, RequestOutput] = {}
+        self._prefill_shapes: set[int] = set()
+        self._decode_shapes: set[tuple[int, int]] = set()
+        self._build_steps()
+
+    def _default_prefill_buckets(self):
+        # the pages bucket ladder scaled to token units: one bucket
+        # policy shared with the scheduler, two units
+        return [p * self.page_size for p in
+                SchedulerConfig.default_pages_buckets(
+                    self.max_pages_per_seq)]
+
+    # ------------------------------------------------------------------
+    # jitted steps (fixed shapes per bucket)
+    # ------------------------------------------------------------------
+    def _build_steps(self):
+        cfg = self.cfg
+        ps = self.page_size
+        interpret = self._interpret
+
+        def prefill(params, kv, ids, length, tbl, temp, key):
+            # ids [1, S] padded; tbl [S // ps] page ids (NULL-padded).
+            b, s = ids.shape
+            pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            h = params["embed"][ids]
+            new_kv = []
+            for lyr, (Kp, Vp) in zip(params["layers"], kv):
+                h, (k, v) = _block(lyr, h, pos, cfg)
+                # [1, S, Hkv, d] -> [Hkv, S/ps, ps, d] -> scatter to pool
+                hkv, d = cfg.num_key_value_heads, cfg.head_dim
+                kt = jnp.transpose(
+                    k[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
+                vt = jnp.transpose(
+                    v[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
+                new_kv.append((Kp.at[:, tbl].set(kt), Vp.at[:, tbl].set(vt)))
+            h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
+            last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=1,
+                                                keepdims=False)
+            logits = _logits(params, last, cfg)             # [1, V]
+            tok = _sample_rows(logits, key, temp[None])[0]
+            return tok, new_kv
+
+        def decode(params, kv, tokens, tbls, lens, temps, key):
+            # tokens/lens/temps [B]; tbls [B, P]. lens = cached length per
+            # row = the write slot of this token; attention covers lens+1.
+            h = params["embed"][tokens[:, None]]
+            new_kv = []
+            for lyr, (Kp, Vp) in zip(params["layers"], kv):
+                h, pair = _decode_block(lyr, h, lens, cfg, Kp, Vp, tbls,
+                                        lens, page_size=ps,
+                                        interpret=interpret)
+                new_kv.append(pair)
+            h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
+            logits = _logits(params, h[:, 0], cfg)          # [B, V]
+            return _sample_rows(logits, key, temps), new_kv
+
+        # donate the pool buffers (arg 1) so decode updates in place on
+        # TPU; CPU/PJRT-cpu ignores donation with a warning, so skip there
+        from ..kernels import _on_tpu
+        donate = (1,) if _on_tpu() else ()
+        self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
+        self._decode_jit = jax.jit(decode, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_token_ids, *, max_new_tokens=16,
+                    temperature=0.0, eos_token_id=None, deadline_s=None,
+                    request_id=None):
+        """Queue a request; returns its id. Accepts a Request too."""
+        if isinstance(prompt_token_ids, Request):
+            r = prompt_token_ids
+            return self.add_request(
+                r.prompt_token_ids, max_new_tokens=r.max_new_tokens,
+                temperature=r.temperature, eos_token_id=r.eos_token_id,
+                deadline_s=r.deadline_s, request_id=r.request_id)
+        prompt = [int(t) for t in np.asarray(prompt_token_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_len {self.max_len}")
+        rid = request_id or f"req-{next(self._ids)}"
+        if rid in self._seqs:
+            raise KeyError(f"duplicate request_id {rid!r}")
+        now = self._now()
+        seq = Sequence(
+            seq_id=rid, prompt_ids=prompt, max_new_tokens=max_new_tokens,
+            arrival=now,
+            deadline=None if deadline_s is None else now + deadline_s,
+            temperature=temperature, eos_token_id=eos_token_id)
+        self.scheduler.add(seq)
+        self._seqs[rid] = seq
+        self._outputs[rid] = RequestOutput(rid, prompt)
+        self.metrics.requests_added.inc()
+        return rid
+
+    def cancel(self, request_id) -> bool:
+        """Gracefully cancel: frees pages if running, keeps the tokens
+        streamed so far in the output. Returns False if already done."""
+        seq = self.scheduler.remove(request_id)
+        if seq is None:
+            return False
+        self._finalize(seq, "cancelled")
+        self.metrics.cancelled_requests.inc()
+        return True
+
+    def has_unfinished(self) -> bool:
+        return self.scheduler.has_unfinished()
+
+    def outputs(self) -> dict:
+        return dict(self._outputs)
+
+    def release(self, request_id) -> "RequestOutput":
+        """Drop a RESOLVED request's retained state (the client has
+        consumed its output). A long-running server must call this (or
+        use stream_cb and release on the finished event) — the engine
+        retains finished outputs until released so polling clients can
+        always fetch them."""
+        out = self._outputs.get(request_id)
+        if out is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        if not out.finished:
+            raise ValueError(
+                f"request {request_id!r} is still {out.status}; "
+                f"cancel() it before release()")
+        del self._outputs[request_id]
+        del self._seqs[request_id]
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["decode_cache_size"] = self.decode_cache_size()
+        return snap
+
+    def decode_cache_size(self):
+        """Actual XLA compile count of the decode step (falls back to the
+        bucket-signature count when the jit cache is not introspectable)."""
+        try:
+            return int(self._decode_jit._cache_size())
+        except Exception:
+            return len(self._decode_shapes)
+
+    def step(self):
+        """One scheduler round: shed -> admit+prefill -> decode batch.
+        Returns the RequestOutputs touched this step (token streamed,
+        finished, shed, or preempted)."""
+        touched = {}
+        for seq in self.scheduler.shed_expired():
+            self._finalize(seq, "shed")
+            touched[seq.seq_id] = self._outputs[seq.seq_id]
+        for seq in self.scheduler.admit():
+            tok = self._prefill_seq(seq)
+            self._commit_token(seq, tok)
+            touched[seq.seq_id] = self._outputs[seq.seq_id]
+        plan = self.scheduler.prepare_decode()
+        for t in self.scheduler.last_preempted:
+            self._sync_output(t)           # surface fresh preemptions once
+            touched[t.seq_id] = self._outputs[t.seq_id]
+        if plan is not None:
+            tokens = self._decode_plan(plan)
+            for seq, tok in zip(plan.seqs, tokens):
+                self._commit_token(seq, int(tok))
+                touched[seq.seq_id] = self._outputs[seq.seq_id]
+            self.metrics.decode_steps.inc()
+        self.metrics.record_step(self.scheduler, self.pool)
+        return list(touched.values())
+
+    def run(self, max_steps=None):
+        """Drive step() until every request resolves; returns outputs."""
+        steps = 0
+        while self.has_unfinished():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine did not drain within {max_steps} steps")
+        return self.outputs()
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _prefill_seq(self, seq: Sequence) -> int:
+        ids = seq.prompt_ids + seq.tokens      # recompute mode on requeue
+        L = len(ids)
+        S = bucket_for(L, self.prefill_buckets)
+        if S not in self._prefill_shapes:
+            self._prefill_shapes.add(S)
+            self.metrics.prefill_compiles.inc()
+        padded = np.zeros((1, S), np.int32)
+        padded[0, :L] = ids
+        tbl = np.asarray(
+            self.pool.padded_block_table(seq.seq_id, S // self.page_size),
+            np.int32)
+        tok, new_kv = self._prefill_jit(
+            self.params, self.pool.kv, jnp.asarray(padded),
+            np.int32(L), jnp.asarray(tbl),
+            np.float32(seq.temperature), self._next_key())
+        self.pool.kv = new_kv
+        self.metrics.prefills.inc()
+        return int(tok)
+
+    def _decode_plan(self, plan):
+        B, P = plan.batch_bucket, plan.pages_bucket
+        if (B, P) not in self._decode_shapes:
+            self._decode_shapes.add((B, P))
+            self.metrics.decode_compiles.inc()
+        tokens = np.zeros((B,), np.int32)
+        tbls = np.full((B, P), NULL_PAGE, np.int32)
+        lens = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        for i, seq in enumerate(plan.seqs):
+            tokens[i] = seq.tokens[-1]
+            table = self.pool.padded_block_table(seq.seq_id, P)
+            tbls[i] = table
+            lens[i] = seq.total_len - 1        # cached length = write slot
+            temps[i] = seq.temperature
+        next_toks, new_kv = self._decode_jit(
+            self.params, self.pool.kv, jnp.asarray(tokens),
+            jnp.asarray(tbls), jnp.asarray(lens), jnp.asarray(temps),
+            self._next_key())
+        self.pool.kv = new_kv
+        return np.asarray(next_toks)[:len(plan.seqs)]
+
+    def _commit_token(self, seq: Sequence, tok: int):
+        seq.tokens.append(int(tok))
+        self.metrics.tokens_generated.inc()
+        out = self._sync_output(seq)
+        if seq.eos_token_id is not None and tok == seq.eos_token_id:
+            self._finalize(seq, "finished", reason="eos")
+        elif len(seq.tokens) >= seq.max_new_tokens:
+            self._finalize(seq, "finished", reason="length")
+        elif self._stream_cb is not None:
+            self._stream_cb(seq.seq_id, int(tok), False)
+        return out
+
+    def _finalize(self, seq: Sequence, status: str, reason=None):
+        self.scheduler.finish(seq, {
+            "finished": SequenceStatus.FINISHED,
+            "shed": SequenceStatus.SHED,
+            "cancelled": SequenceStatus.CANCELLED,
+            "aborted": SequenceStatus.ABORTED,
+        }[status])
+        out = self._sync_output(seq)
+        out.finish_reason = reason or status
+        if status == "finished":
+            self.metrics.finished_requests.inc()
+        if self._stream_cb is not None:
+            last = seq.tokens[-1] if seq.tokens else None
+            self._stream_cb(seq.seq_id, last, True)
+        return out
+
+    def _sync_output(self, seq: Sequence) -> RequestOutput:
+        out = self._outputs[seq.seq_id]
+        out.token_ids = list(seq.tokens)
+        out.status = seq.status.value
+        out.num_preemptions = seq.num_preemptions
+        return out
+
+
+__all__ = ["LLMEngine", "Request", "RequestOutput"]

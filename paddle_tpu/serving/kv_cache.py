@@ -1,0 +1,176 @@
+"""Paged KV-pool manager — the allocator under the serving engine.
+
+The Pallas decode kernel (kernels/paged_attention.py) already consumes a
+paged pool ``[num_kv_heads, num_pages, page_size, head_dim]`` plus per-
+sequence block tables; what was missing above it is ownership: which pool
+page belongs to which live sequence, and what happens when the pool runs
+dry. This module is that layer (the TPU analog of vLLM's BlockSpaceManager
+and of the reference's block_multi_head_attention cache manager):
+
+- a free-list allocator over pool pages — page granularity means there is
+  no external fragmentation by construction: any request for n free pages
+  succeeds iff n pages are free;
+- per-sequence block tables (logical page i of a sequence -> pool page),
+  grown one page at a time as decode crosses page boundaries;
+- pool page 0 is reserved as the NULL page: padded batch rows and padded
+  block-table slots all point at it, so fixed-shape bucketed launches have
+  a safe write/read target that never aliases live data;
+- utilization watermarks the scheduler uses for admission control and
+  preemption decisions.
+
+The device arrays themselves live in ``kv`` (one (K, V) pair per layer)
+and are updated *functionally* by the engine's jitted prefill/decode steps
+(the engine reassigns ``kv`` after each donated call); this class tracks
+only the host-side ownership metadata.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class PoolExhausted(RuntimeError):
+    """Raised when an alloc/extend needs more free pages than exist."""
+
+
+NULL_PAGE = 0
+
+
+class PagedKVPool:
+    """Free-list page allocator + per-sequence block tables over the pool.
+
+    capacity = ``num_pages - 1`` allocatable pages (page 0 is the null
+    page). ``seq_lens`` tracks the token count the engine has committed
+    per sequence, so ``pages_needed`` and utilization stay in one place.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
+                 page_size, dtype=jnp.float32, high_watermark=0.90,
+                 low_watermark=0.50):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        if not 0.0 < low_watermark <= high_watermark <= 1.0:
+            raise ValueError("watermarks must satisfy 0 < low <= high <= 1")
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.high_watermark = high_watermark
+        self.low_watermark = low_watermark
+        shape = (num_kv_heads, num_pages, page_size, head_dim)
+        self.kv = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                   for _ in range(num_layers)]
+        # LIFO free list: recently-freed pages are reused first (warm in
+        # whatever cache level holds them)
+        self._free = list(range(num_pages - 1, NULL_PAGE, -1))
+        self._tables: dict[object, list[int]] = {}
+        self._lens: dict[object, int] = {}
+
+    # ---- capacity ----
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_pages / self.capacity
+
+    def above_high_watermark(self, extra_pages=0) -> bool:
+        return (self.used_pages + extra_pages) / self.capacity \
+            > self.high_watermark
+
+    def below_low_watermark(self) -> bool:
+        return self.utilization < self.low_watermark
+
+    def pages_for(self, num_tokens: int) -> int:
+        return -(-max(num_tokens, 0) // self.page_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.pages_for(num_tokens) <= len(self._free)
+
+    # ---- lifecycle ----
+    def allocate(self, seq_id, num_tokens: int) -> list[int]:
+        """Claim pages for a new sequence of ``num_tokens`` tokens."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already has an allocation")
+        n = self.pages_for(num_tokens)
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} pages for {num_tokens} tokens, "
+                f"{len(self._free)} free of {self.capacity}")
+        pages = [self._free.pop() for _ in range(n)]
+        self._tables[seq_id] = pages
+        self._lens[seq_id] = num_tokens
+        return pages
+
+    def extend(self, seq_id, new_len: int) -> list[int]:
+        """Grow ``seq_id``'s table to cover ``new_len`` tokens; returns the
+        newly claimed pages (possibly empty). All-or-nothing on exhaustion.
+        """
+        table = self._tables[seq_id]
+        need = self.pages_for(new_len) - len(table)
+        if need > len(self._free):
+            raise PoolExhausted(
+                f"sequence {seq_id!r} needs {need} more pages, "
+                f"{len(self._free)} free of {self.capacity}")
+        fresh = [self._free.pop() for _ in range(max(need, 0))]
+        table.extend(fresh)
+        self._lens[seq_id] = max(new_len, self._lens[seq_id])
+        return fresh
+
+    def free(self, seq_id) -> int:
+        """Release every page the sequence owns; returns the page count."""
+        pages = self._tables.pop(seq_id)
+        self._lens.pop(seq_id, None)
+        self._free.extend(reversed(pages))
+        return len(pages)
+
+    # ---- queries ----
+    def __contains__(self, seq_id) -> bool:
+        return seq_id in self._tables
+
+    def block_table(self, seq_id) -> list[int]:
+        return list(self._tables[seq_id])
+
+    def seq_len(self, seq_id) -> int:
+        return self._lens[seq_id]
+
+    def set_seq_len(self, seq_id, n: int):
+        if self.pages_for(n) > len(self._tables[seq_id]):
+            raise ValueError(
+                f"length {n} exceeds the {len(self._tables[seq_id])} pages "
+                f"owned by {seq_id!r}; call extend() first")
+        self._lens[seq_id] = n
+
+    def padded_block_table(self, seq_id, pages: int) -> list[int]:
+        """Block table padded with NULL_PAGE to a fixed bucket width."""
+        table = self._tables[seq_id]
+        if len(table) > pages:
+            raise ValueError(
+                f"{seq_id!r} owns {len(table)} pages > bucket {pages}")
+        return table + [NULL_PAGE] * (pages - len(table))
+
+    def live_sequences(self):
+        return list(self._tables)
+
+    def check_invariants(self):
+        """Debug/test hook: every page owned exactly once, free+used=cap."""
+        owned = [p for t in self._tables.values() for p in t]
+        seen = set(owned)
+        assert len(owned) == len(seen), "a pool page is owned twice"
+        assert NULL_PAGE not in seen, "null page leaked into a block table"
+        assert not (seen & set(self._free)), "page both owned and free"
+        assert len(owned) + len(self._free) == self.capacity, \
+            "page accounting leak"
+        return True
+
+
+__all__ = ["PagedKVPool", "PoolExhausted", "NULL_PAGE"]

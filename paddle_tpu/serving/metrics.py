@@ -1,0 +1,89 @@
+"""Serving metrics: counters/gauges for the engine, scheduler, and pool.
+
+Two consumers:
+- ``snapshot()`` — a plain dict for bench.py (``serving_tokens_per_s``,
+  ``kv_page_utilization``, ``decode_compiles`` ride the bench artifact)
+  and for tests/operators polling the engine;
+- the profiler timeline — each ``record_step`` emits instant events
+  through the same native recorder paddle_tpu.profiler drains, so serving
+  gauges land on the chrome-trace/protobuf timeline next to op spans when
+  a Profiler is recording.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..core import native as _nv
+
+
+class Counter:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = v
+
+
+class ServingMetrics:
+    COUNTERS = ("requests_added", "tokens_generated", "prefills",
+                "decode_steps", "preemptions", "shed_requests",
+                "cancelled_requests", "finished_requests",
+                "decode_compiles", "prefill_compiles")
+    GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
+              "page_utilization", "tokens_per_s")
+
+    #: tokens_per_s is the rate over this trailing window, not a lifetime
+    #: average — a lifetime average decays toward zero across idle gaps
+    RATE_WINDOW_S = 60.0
+
+    def __init__(self, now_fn=time.monotonic):
+        self._now = now_fn
+        self._t0 = now_fn()
+        self._rate_samples = deque([(self._t0, 0)])   # (t, tokens_total)
+        for c in self.COUNTERS:
+            setattr(self, c, Counter(c))
+        for g in self.GAUGES:
+            setattr(self, g, Gauge(g))
+
+    def record_step(self, scheduler, pool):
+        """Refresh gauges from live state; emit profiler instants."""
+        self.queue_depth.set(scheduler.queue_depth())
+        self.running_seqs.set(len(scheduler.running))
+        self.waiting_seqs.set(len(scheduler.waiting))
+        self.page_utilization.set(pool.utilization)
+        now = self._now()
+        self._rate_samples.append((now, self.tokens_generated.value))
+        while len(self._rate_samples) > 2 and \
+                now - self._rate_samples[0][0] > self.RATE_WINDOW_S:
+            self._rate_samples.popleft()
+        t_old, tok_old = self._rate_samples[0]
+        self.tokens_per_s.set(
+            (self.tokens_generated.value - tok_old) / max(now - t_old, 1e-9))
+        if _nv.prof_enabled():
+            for g in self.GAUGES:
+                v = getattr(self, g).value
+                _nv.prof_instant(f"serving.{g}={v:.3f}", 3)
+
+    def snapshot(self) -> dict:
+        out = {c: getattr(self, c).value for c in self.COUNTERS}
+        out.update({g: getattr(self, g).value for g in self.GAUGES})
+        out["uptime_s"] = self._now() - self._t0
+        return out
+
+
+__all__ = ["Counter", "Gauge", "ServingMetrics"]

@@ -1,0 +1,293 @@
+"""Continuous-batching scheduler over the paged KV pool.
+
+The TPU-shaped constraint this scheduler exists for: XLA compiles one
+executable per input *shape*, so the decode batch must be assembled into a
+small closed set of **shape buckets** — (batch rows, pages per sequence)
+padded up to the nearest bucket — and never into whatever ragged
+composition the traffic happens to produce. With B batch buckets and P
+page buckets the engine compiles at most B*P decode executables for the
+lifetime of the process (gated by tests/test_serving_compile_gate.py);
+everything dynamic (which request sits in which row, how long it is, which
+pool pages it owns) travels as *data* through block tables and length
+vectors.
+
+Policies (the serving study arxiv 2605.25645 and RPA arxiv 2604.15464
+shapes, vLLM idiom):
+- admission: FIFO queue; a request is admitted when the pool can hold its
+  current tokens and utilization stays under the high watermark (the
+  watermark guard is waived when nothing is running, so a big request
+  cannot deadlock an empty engine). At most ``max_prefills_per_step``
+  admissions per engine step so prefill never starves running decodes.
+- deadline load shedding: a *waiting* request whose deadline has passed is
+  shed at schedule time (it would miss SLO anyway — do not burn pool pages
+  on it). Running requests are never shed.
+- preemption-with-requeue: when a running sequence cannot grow into its
+  next page, victims are preempted latest-arrival-first (freeing whole
+  sequences, not single pages), their generated tokens are kept, and they
+  re-enter the *front* of the queue in recompute mode: on re-admission the
+  engine prefills prompt+generated and decoding resumes — greedy outputs
+  are therefore identical with and without preemption.
+"""
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .kv_cache import PagedKVPool, PoolExhausted
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"   # transiently, while re-queued
+    FINISHED = "finished"
+    SHED = "shed"
+    CANCELLED = "cancelled"
+    ABORTED = "aborted"
+
+
+def bucket_for(n: int, buckets) -> int:
+    """Smallest bucket >= n (buckets need not be sorted)."""
+    best = None
+    for b in buckets:
+        if b >= n and (best is None or b < best):
+            best = b
+    if best is None:
+        raise ValueError(f"{n} exceeds the largest bucket in {buckets}")
+    return best
+
+
+@dataclass
+class Sequence:
+    """Scheduler-side state of one in-flight request."""
+    seq_id: str
+    prompt_ids: list
+    max_new_tokens: int
+    arrival: float
+    deadline: float | None = None
+    temperature: float = 0.0
+    eos_token_id: int | None = None
+    tokens: list = field(default_factory=list)      # generated so far
+    status: SequenceStatus = SequenceStatus.WAITING
+    num_preemptions: int = 0
+
+    @property
+    def total_len(self) -> int:
+        """Tokens committed to the KV cache (prompt + generated)."""
+        return len(self.prompt_ids) + len(self.tokens)
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+
+@dataclass
+class DecodePlan:
+    """One fixed-shape decode launch: ``seqs`` padded to ``batch_bucket``
+    rows, block tables padded to ``pages_bucket`` columns."""
+    seqs: list
+    batch_bucket: int
+    pages_bucket: int
+
+
+class SchedulerConfig:
+    def __init__(self, *, batch_buckets=(1, 2, 4, 8), pages_buckets=None,
+                 max_prefills_per_step=4, now_fn=time.monotonic):
+        self.batch_buckets = tuple(sorted(set(batch_buckets)))
+        self.pages_buckets = (tuple(sorted(set(pages_buckets)))
+                              if pages_buckets is not None else None)
+        self.max_prefills_per_step = max_prefills_per_step
+        self.now_fn = now_fn
+
+    @staticmethod
+    def default_pages_buckets(max_pages_per_seq: int):
+        """Powers of two up to (and always including) the per-seq max.
+        The engine's default prefill buckets are this ladder scaled by
+        page_size — one bucket policy, two units."""
+        out, b = [], 1
+        while b < max_pages_per_seq:
+            out.append(b)
+            b *= 2
+        out.append(max_pages_per_seq)
+        return tuple(sorted(set(out)))
+
+
+class Scheduler:
+    def __init__(self, pool: PagedKVPool, config: SchedulerConfig,
+                 max_pages_per_seq: int, metrics=None):
+        self.pool = pool
+        self.config = config
+        self.max_pages_per_seq = max_pages_per_seq
+        self.pages_buckets = (config.pages_buckets or
+                              SchedulerConfig.default_pages_buckets(
+                                  max_pages_per_seq))
+        if max(self.pages_buckets) > max_pages_per_seq:
+            raise ValueError("pages bucket exceeds max pages per sequence")
+        self.metrics = metrics
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        #: sequences preempted during the LAST prepare_decode round; the
+        #: engine drains this to surface fresh preemptions exactly once
+        self.last_preempted: list[Sequence] = []
+        #: watermark hysteresis: once admission halts above the HIGH
+        #: watermark, it stays halted until utilization falls below LOW —
+        #: prevents admit/preempt thrash right at the high line
+        self._admission_paused = False
+
+    # ---- introspection ----
+    @property
+    def max_num_seqs(self) -> int:
+        return max(self.config.batch_buckets)
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    # ---- admission ----
+    def add(self, seq: Sequence):
+        total_pages = self.pool.pages_for(
+            len(seq.prompt_ids) + seq.max_new_tokens)
+        limit = min(self.pool.capacity, self.max_pages_per_seq,
+                    max(self.pages_buckets))
+        if total_pages > limit:
+            raise ValueError(
+                f"request {seq.seq_id}: prompt+max_new_tokens needs "
+                f"{total_pages} pages, engine limit is {limit}")
+        seq.status = SequenceStatus.WAITING
+        self.waiting.append(seq)
+
+    def remove(self, seq_id: str):
+        """Drop a sequence wherever it sits (cancellation). Frees pages if
+        it was running. Returns the Sequence or None."""
+        for s in self.waiting:
+            if s.seq_id == seq_id:
+                self.waiting.remove(s)
+                return s
+        for s in self.running:
+            if s.seq_id == seq_id:
+                self.running.remove(s)
+                self.pool.free(seq_id)
+                return s
+        return None
+
+    def shed_expired(self, now=None) -> list[Sequence]:
+        """Deadline-based load shedding over the admission queue.
+
+        The deadline is a waiting-before-START SLO: a request that has
+        already produced tokens (i.e. was admitted, then preempted back
+        into the queue) is never shed — shedding it would break the
+        preemption token-identity guarantee for work already under way.
+        """
+        now = self.config.now_fn() if now is None else now
+        shed, keep = [], deque()
+        for s in self.waiting:
+            if s.deadline is not None and now > s.deadline \
+                    and not s.tokens:
+                s.status = SequenceStatus.SHED
+                shed.append(s)
+            else:
+                keep.append(s)
+        self.waiting = keep
+        if shed and self.metrics is not None:
+            self.metrics.shed_requests.inc(len(shed))
+        return shed
+
+    def admit(self) -> list[Sequence]:
+        """Move FIFO-queue heads into the running set; allocates their KV
+        pages. The engine must prefill each returned sequence this step."""
+        admitted = []
+        if self._admission_paused and self.pool.below_low_watermark():
+            self._admission_paused = False
+        while self.waiting:
+            # admitted seqs are already in self.running — count them once
+            if len(self.running) >= self.max_num_seqs:
+                break
+            if len(admitted) >= self.config.max_prefills_per_step:
+                break
+            seq = self.waiting[0]
+            n_pages = self.pool.pages_for(seq.total_len)
+            if n_pages > self.pool.free_pages:
+                break
+            # watermark admission control: above the high watermark stop
+            # taking new work (leave headroom for running seqs to grow),
+            # and stay stopped until utilization recovers below the low
+            # watermark (hysteresis) — unless the engine is idle, where
+            # waiting would deadlock
+            busy = bool(self.running) or bool(admitted)
+            if busy:
+                if self.pool.above_high_watermark(extra_pages=n_pages):
+                    self._admission_paused = True
+                if self._admission_paused:
+                    break
+            self.waiting.popleft()
+            self.pool.allocate(seq.seq_id, seq.total_len)
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            admitted.append(seq)
+        return admitted
+
+    # ---- decode assembly ----
+    def preempt(self, seq: Sequence):
+        """Free the sequence's pages and requeue it (recompute mode) at the
+        FRONT of the queue; generated tokens are preserved."""
+        self.running.remove(seq)
+        self.pool.free(seq.seq_id)
+        seq.status = SequenceStatus.WAITING
+        seq.num_preemptions += 1
+        self.waiting.appendleft(seq)
+        self.last_preempted.append(seq)
+        if self.metrics is not None:
+            self.metrics.preemptions.inc()
+
+    def finish(self, seq: Sequence, status=SequenceStatus.FINISHED):
+        seq.status = status
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq.seq_id in self.pool:
+            self.pool.free(seq.seq_id)
+
+    def prepare_decode(self) -> DecodePlan | None:
+        """Grow each running sequence's table to cover its next token,
+        preempting latest arrivals when the pool runs dry, then assemble
+        the fixed-shape decode plan."""
+        self.last_preempted = []
+        for seq in list(self.running):
+            if seq not in self.running:      # preempted below this round
+                continue
+            while True:
+                try:
+                    # the last generated token is not cached yet: decode
+                    # writes it at slot total_len-1, so pages must cover
+                    # total_len tokens after this step
+                    self.pool.extend(seq.seq_id, seq.total_len)
+                    break
+                except PoolExhausted:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        # nothing else to evict: preempt THIS sequence.
+                        # add() guaranteed prompt+max_new fits the empty
+                        # pool, so its re-admission always converges.
+                        self.preempt(seq)
+                        break
+                    self.preempt(victim)
+        if not self.running:
+            return None
+        bb = bucket_for(len(self.running), self.config.batch_buckets)
+        max_pages = max(self.pool.pages_for(s.total_len)
+                        for s in self.running)
+        pb = bucket_for(max_pages, self.pages_buckets)
+        return DecodePlan(list(self.running), bb, pb)
+
+    def _pick_victim(self, exclude: Sequence) -> Sequence | None:
+        candidates = [s for s in self.running if s is not exclude]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.arrival)
+
+
+__all__ = ["Scheduler", "SchedulerConfig", "Sequence", "SequenceStatus",
+           "DecodePlan", "bucket_for"]

@@ -578,6 +578,41 @@ def test_flags_disposition_is_complete():
     assert not (ours & set(mod.NA))
 
 
+def test_env_flag_on_set_failure_warns_with_flag_name(monkeypatch):
+    """A failing on_set callback for an ENV-provided flag must not be
+    swallowed silently: launch-time misconfiguration has to be
+    diagnosable. The warning names the flag and the exception."""
+    import warnings
+    from paddle_tpu.core.flags import define_flag
+    monkeypatch.setenv("FLAGS_test_onset_boom", "1")
+
+    def boom(v):
+        raise RuntimeError("wiring exploded")
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        f = define_flag("test_onset_boom", bool, False, "test flag",
+                        on_set=boom)
+    assert f.value is True           # the value itself is still recorded
+    msgs = [str(x.message) for x in w
+            if issubclass(x.category, RuntimeWarning)]
+    assert any("FLAGS_test_onset_boom" in m and "wiring exploded" in m
+               and "RuntimeError" in m for m in msgs), msgs
+
+
+def test_env_flag_on_set_success_does_not_warn(monkeypatch):
+    import warnings
+    from paddle_tpu.core.flags import define_flag
+    monkeypatch.setenv("FLAGS_test_onset_fine", "7")
+    seen = []
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        define_flag("test_onset_fine", int, 0, "test flag",
+                    on_set=seen.append)
+    assert seen == [7]
+    assert not [x for x in w if issubclass(x.category, RuntimeWarning)]
+
+
 @pytest.mark.slow
 def test_env_provided_wired_flag_fires_on_set():
     """FLAGS_* provided via the ENVIRONMENT must reach the on_set wiring

@@ -68,6 +68,24 @@ def test_fused_mha_weight_layout_and_paths(pre_ln):
     assert m.qkv_weight.grad is not None
 
 
+def test_fused_mha_accepts_self_attention_triple_call():
+    """attn(x, x, x) — the common self-attention spelling — must work and
+    match attn(x); only GENUINE cross-attention is rejected."""
+    paddle.seed(5)
+    m = FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                attn_dropout_rate=0.0)
+    m.eval()
+    x = _x((2, 5, 32))
+    ref = m(x)
+    np.testing.assert_allclose(m(x, x, x).numpy(), ref.numpy())
+    np.testing.assert_allclose(m(x, x).numpy(), ref.numpy())
+    other = _x((2, 5, 32))
+    with pytest.raises(NotImplementedError, match="cross attention"):
+        m(x, other, other)
+    with pytest.raises(NotImplementedError, match="cross attention"):
+        m(x, x, other)
+
+
 @pytest.mark.slow
 def test_fused_ffn_and_encoder_layer_train():
     paddle.seed(4)

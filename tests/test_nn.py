@@ -106,6 +106,30 @@ class TestFunctional:
         ad3 = F.adaptive_avg_pool2d(x, 3)  # non-divisible path
         assert ad3.shape == [1, 1, 3, 3]
 
+    def test_avg_pool_ceil_mode_inclusive_divisor_clamps(self):
+        """ceil_mode=True, exclusive=False: a window reaching past the
+        padded boundary divides by its CLAMPED size (reference pooling.cc
+        clamp), not the full kernel area — regression: the 6x6/k=3/s=2
+        corner window is (28+29+34+35)/4 = 31.5, not /9 = 14.0."""
+        x = paddle.to_tensor(
+            np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+        out = F.avg_pool2d(x, 3, 2, 0, ceil_mode=True, exclusive=False)
+        assert out.shape == [1, 1, 3, 3]
+        got = out.numpy()[0, 0]
+        assert got[0, 0] == pytest.approx(7.0)     # interior: full /9
+        assert got[2, 2] == pytest.approx(31.5)    # clamped corner: /4
+        assert got[2, 0] == pytest.approx(28.0)    # row-clamped: /6
+        # with REAL padding the pad cells still count (exclusive=False),
+        # only the ceil extension is excluded from the divisor
+        outp = F.avg_pool2d(x, 3, 2, 1, ceil_mode=True, exclusive=False)
+        assert outp.shape == [1, 1, 4, 4]
+        assert outp.numpy()[0, 0, 3, 3] == pytest.approx(35.0 / 4)
+        # 1d spelling of the same clamp
+        x1 = paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(1, 1, 6))
+        o1 = F.avg_pool1d(x1, 3, 2, 0, exclusive=False, ceil_mode=True)
+        assert o1.numpy()[0, 0, -1] == pytest.approx((4.0 + 5.0) / 2)
+
     def test_norms(self):
         x = paddle.randn([4, 6])
         ln = F.layer_norm(x, 6)

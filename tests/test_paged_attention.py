@@ -29,6 +29,48 @@ def test_kernel_parity_variable_lengths(lens):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("lens", [
+    [15, 16, 17, 31, 33],     # straddling every boundary of ps=16 pages
+    [16, 32, 48, 64, 1],      # exact page multiples (last-live-page edge)
+    [63, 2, 18, 47, 64],      # interior + full-pool mix
+])
+def test_kernel_parity_ragged_lengths_cross_page_boundaries(lens):
+    """Off-TPU (interpreter) parity for ragged lengths landing just
+    before, exactly on, and just after page boundaries — the clamp in the
+    kernel's index map and the in-page masking are both load-bearing."""
+    rng = np.random.default_rng(7)
+    b, hq, hkv, d, ps = 5, 4, 2, 32, 16
+    pps = 4                               # covers up to 64 tokens
+    npages = b * pps + 3                  # a few never-referenced pages
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(npages)[:b * pps].reshape(b, pps),
+                      jnp.int32)
+    sl = jnp.asarray(lens, jnp.int32)
+    out = paged_attention(q, kp, vp, tbl, sl, interpret=True)
+    ref = paged_attention_reference(q, kp, vp, tbl, sl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_kernel_parity_jit_wrapped():
+    """The serving decode step calls the kernel from inside jit; the
+    interpreter path must hold parity there too."""
+    rng = np.random.default_rng(9)
+    b, hq, hkv, d, ps, pps = 2, 4, 2, 32, 8, 3
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, b * pps, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, b * pps, ps, d)), jnp.float32)
+    tbl = jnp.arange(b * pps, dtype=jnp.int32).reshape(b, pps)
+    sl = jnp.asarray([17, 9], jnp.int32)
+    out = jax.jit(lambda *a: paged_attention(*a, interpret=True))(
+        q, kp, vp, tbl, sl)
+    ref = paged_attention_reference(q, kp, vp, tbl, sl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_kernel_parity_mha_no_gqa():
     rng = np.random.default_rng(1)
     b, h, d, ps, pps = 2, 4, 32, 8, 3

@@ -1,0 +1,94 @@
+"""Decode-step compile-count gate for the serving engine.
+
+The engine's headline TPU contract: decode launches are assembled into a
+CLOSED set of (batch_bucket, pages_bucket) shapes, so XLA compiles at most
+len(batch_buckets) * len(pages_buckets) decode executables no matter what
+request mix arrives. This gate (the serving analog of
+test_optimizer_dispatch_gate.py) drives a deliberately varied mix of
+request lengths/arrivals through the engine and hard-fails if the decode
+jit ever compiles more than the bucket bound — the regression that would
+mean per-composition recompilation, the exact failure mode paged serving
+exists to avoid (serving/engine.py, serving/scheduler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import LLMEngine, bucket_for
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(13)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=64)
+    return LlamaForCausalLM(cfg)
+
+
+def test_decode_compiles_bounded_by_buckets(tiny_model):
+    batch_buckets = (1, 2, 4)
+    pages_buckets = (2, 4, 8)
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4,
+                    batch_buckets=batch_buckets,
+                    pages_buckets=pages_buckets,
+                    max_prefills_per_step=2)
+    bound = len(batch_buckets) * len(pages_buckets)
+
+    rng = np.random.RandomState(0)
+    # two waves with disjoint length mixes + stragglers arriving mid-run:
+    # the composition (how many running, how long each) varies constantly
+    lengths_wave1 = [2, 3, 5, 7]
+    lengths_wave2 = [9, 11, 13, 4]
+    for n in lengths_wave1:
+        eng.add_request(rng.randint(0, 64, (n,)).tolist(),
+                        max_new_tokens=int(rng.randint(2, 7)))
+    steps = 0
+    stragglers = iter(lengths_wave2)
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+        nxt = next(stragglers, None)
+        if nxt is not None:
+            eng.add_request(rng.randint(0, 64, (nxt,)).tolist(),
+                            max_new_tokens=int(rng.randint(2, 7)))
+        assert steps < 300
+    outs = eng.outputs()
+    assert len(outs) == 8
+    assert all(o.status == "finished" for o in outs.values())
+
+    snap = eng.metrics_snapshot()
+    # the gate: actual XLA decode compiles <= #buckets
+    assert snap["decode_cache_size"] <= bound, (
+        f"decode step compiled {snap['decode_cache_size']} executables for "
+        f"{bound} shape buckets — per-composition recompilation regression")
+    # the bucket-signature counter agrees with the jit cache
+    assert snap["decode_compiles"] == snap["decode_cache_size"]
+    # and the mix genuinely exercised multiple buckets
+    assert snap["decode_compiles"] >= 2
+
+
+def test_repeat_traffic_compiles_nothing_new(tiny_model):
+    """Steady-state: a second identical wave reuses every executable."""
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4,
+                    batch_buckets=(1, 2), pages_buckets=(4, 8))
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 64, (n,)).tolist() for n in (3, 6)]
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=4)
+    eng.run(max_steps=100)
+    first = eng.metrics_snapshot()["decode_cache_size"]
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=4)
+    eng.run(max_steps=100)
+    assert eng.metrics_snapshot()["decode_cache_size"] == first
+    assert eng.metrics_snapshot()["prefill_compiles"] == \
+        len(eng._prefill_shapes)
+
+
+def test_bucket_for_picks_smallest_cover():
+    assert bucket_for(1, (8, 4, 1, 2)) == 1
+    assert bucket_for(3, (1, 2, 4, 8)) == 4
+    assert bucket_for(8, (1, 2, 4, 8)) == 8
+    with pytest.raises(ValueError):
+        bucket_for(9, (1, 2, 4, 8))

@@ -1,0 +1,119 @@
+"""PagedKVPool allocator invariants: ownership accounting, exhaustion,
+extend-on-page-boundary, no fragmentation at page granularity, watermarks,
+and the null-page reservation (serving/kv_cache.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.serving import NULL_PAGE, PagedKVPool, PoolExhausted
+
+
+def _pool(num_pages=9, page_size=4, **kw):
+    return PagedKVPool(2, 2, 8, num_pages=num_pages, page_size=page_size,
+                       **kw)
+
+
+def test_alloc_free_accounting():
+    p = _pool()
+    assert p.capacity == 8 and p.free_pages == 8 and p.used_pages == 0
+    a = p.allocate("a", 10)          # ceil(10/4) = 3 pages
+    assert len(a) == 3 and p.free_pages == 5
+    b = p.allocate("b", 4)
+    assert len(b) == 1 and p.used_pages == 4
+    p.check_invariants()
+    assert p.free("a") == 3
+    assert p.free_pages == 7 and "a" not in p
+    p.check_invariants()
+
+
+def test_null_page_never_allocated():
+    p = _pool()
+    pages = p.allocate("a", 8 * 4)   # the whole capacity
+    assert NULL_PAGE not in pages
+    assert p.free_pages == 0
+    p.check_invariants()
+
+
+def test_double_alloc_and_double_free_raise():
+    p = _pool()
+    p.allocate("a", 4)
+    with pytest.raises(KeyError):
+        p.allocate("a", 4)
+    p.free("a")
+    with pytest.raises(KeyError):
+        p.free("a")
+
+
+def test_exhaustion_is_all_or_nothing():
+    p = _pool(num_pages=5)           # 4 usable
+    p.allocate("a", 12)              # 3 pages
+    free_before = p.free_pages
+    with pytest.raises(PoolExhausted):
+        p.allocate("b", 12)
+    assert p.free_pages == free_before, "failed alloc must not leak pages"
+    with pytest.raises(PoolExhausted):
+        p.extend("a", 12 + 2 * 4 + 1)  # needs 2 more, only 1 free
+    assert p.free_pages == free_before
+    p.check_invariants()
+
+
+def test_extend_crosses_page_boundaries_lazily():
+    p = _pool()
+    p.allocate("a", 4)               # exactly one full page
+    assert p.extend("a", 4) == []    # no growth needed
+    fresh = p.extend("a", 5)         # crosses into page 2
+    assert len(fresh) == 1
+    t = p.block_table("a")
+    t.append(999)                    # returned table is a copy
+    assert len(p.block_table("a")) == 2
+    assert p.seq_len("a") == 5
+    p.check_invariants()
+
+
+def test_no_fragmentation_at_page_granularity():
+    """Interleaved alloc/free: any request for n <= free pages succeeds
+    regardless of the free list's history (pages are the only unit)."""
+    rng = np.random.default_rng(0)
+    p = _pool(num_pages=17, page_size=2)
+    live = {}
+    for i in range(200):
+        if live and (rng.random() < 0.45 or p.free_pages == 0):
+            sid = rng.choice(sorted(live))
+            p.free(sid)
+            del live[sid]
+        else:
+            want = int(rng.integers(1, 4))   # 1..3 pages
+            sid = f"s{i}"
+            if want <= p.free_pages:
+                assert p.can_allocate(want * 2)
+                p.allocate(sid, want * 2)
+                live[sid] = want
+            else:
+                with pytest.raises(PoolExhausted):
+                    p.allocate(sid, want * 2)
+        p.check_invariants()
+    assert p.used_pages == sum(live.values())
+
+
+def test_padded_block_table_and_watermarks():
+    p = _pool(num_pages=11, page_size=4, high_watermark=0.8,
+              low_watermark=0.3)
+    p.allocate("a", 9)               # 3 of 10 pages
+    t = p.padded_block_table("a", 5)
+    assert len(t) == 5 and t[3:] == [NULL_PAGE, NULL_PAGE]
+    with pytest.raises(ValueError):
+        p.padded_block_table("a", 2)
+    assert p.utilization == 0.3
+    assert not p.above_high_watermark()
+    assert p.above_high_watermark(extra_pages=6)   # 9/10 > 0.8
+    assert not p.below_low_watermark()             # 0.3 is not < 0.3
+    p.free("a")
+    assert p.below_low_watermark()
+
+
+def test_set_seq_len_requires_owned_pages():
+    p = _pool()
+    p.allocate("a", 4)
+    p.set_seq_len("a", 3)
+    assert p.seq_len("a") == 3
+    with pytest.raises(ValueError):
+        p.set_seq_len("a", 5)        # page 2 not owned yet
