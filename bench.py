@@ -184,6 +184,7 @@ def run_bench(config="llama_125m", progress=None):
                                  parameters=model.parameters())
     opt_probe = _probe_opt_dispatches(paddle)
     serving_probe = _probe_serving(paddle)
+    pipeline_probe = _probe_input_pipeline(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -237,6 +238,7 @@ def run_bench(config="llama_125m", progress=None):
         "loss": round(val, 4),
         **opt_probe,
         **serving_probe,
+        **pipeline_probe,
     }
 
 
@@ -342,6 +344,71 @@ def _probe_serving(paddle, wave=6, max_new=4):
                 "kv_page_utilization": 0.0,
                 "decode_compiles": -1,
                 "serving_probe_error": f"{type(e).__name__}: {e}"}
+
+
+def _probe_input_pipeline(paddle, steps=16, log_freq=8):
+    """Measured async-input-pipeline fields for the bench trajectory.
+
+    One jitted Model.fit epoch over a device-prefetching DataLoader on a
+    micro regression net, read back through the pipeline metrics
+    (io/prefetch.py) and the host-sync counter (core/async_scalar.py):
+    - ``input_stall_ms``: total time the consumer blocked waiting for a
+      staged batch (a healthy pipeline stays near 0 — staging outruns
+      compute);
+    - ``h2d_bytes_per_s``: staged bytes over the probe's wall clock;
+    - ``steps_in_flight``: peak dispatched-but-unfetched window — >1
+      proves the deferred-sync path is live;
+    - ``host_syncs_per_epoch``: blocking fetch rounds the epoch paid —
+      bounded by steps/min(log_freq, K) + 2 where K is
+      FLAGS_async_inflight_steps (tests/test_async_pipeline.py gate), so
+      a trajectory jump here flags a reintroduced per-step sync.
+    Micro-sized like the serving probe: it measures the pipeline layer,
+    not model FLOPs, and must not eat the bench child's timeout budget.
+    """
+    import numpy as _np
+    try:
+        from paddle_tpu.core import async_scalar as _async
+        from paddle_tpu.io import DataLoader as _DL
+        from paddle_tpu.io.prefetch import PIPELINE_METRICS as _pm
+
+        class _DS(paddle.io.Dataset):
+            def __init__(self, n):
+                rng = _np.random.default_rng(0)
+                self.x = rng.standard_normal((n, 64)).astype(_np.float32)
+                self.y = rng.standard_normal((n, 1)).astype(_np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        batch = 8
+        net = paddle.nn.Sequential(
+            paddle.nn.Linear(64, 64), paddle.nn.ReLU(),
+            paddle.nn.Linear(64, 1))
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=net.parameters()),
+            paddle.nn.MSELoss(), use_jit=True)
+        loader = _DL(_DS(steps * batch), batch_size=batch,
+                     use_buffer_reader=True)
+        model.fit(loader, epochs=1, log_freq=log_freq, verbose=0)  # warmup
+        _pm.reset()
+        s0 = _async.host_sync_count()
+        model.fit(loader, epochs=1, log_freq=log_freq, verbose=0)
+        snap = _pm.snapshot()
+        return {
+            "input_stall_ms": round(snap["input_stall_ms"], 2),
+            "h2d_bytes_per_s": round(snap["h2d_bytes_per_s"], 1),
+            "steps_in_flight": snap["max_steps_in_flight"],
+            "host_syncs_per_epoch": _async.host_sync_count() - s0,
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"input_stall_ms": -1.0, "h2d_bytes_per_s": 0.0,
+                "steps_in_flight": 0, "host_syncs_per_epoch": -1,
+                "input_pipeline_probe_error": f"{type(e).__name__}: {e}"}
 
 
 def _child_main():

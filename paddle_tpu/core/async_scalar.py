@@ -1,0 +1,156 @@
+"""Deferred device scalars: the host-sync point of the async train loop.
+
+``Model.train_batch`` used to end every step with
+``float(np.asarray(loss.numpy()))`` — a full device sync per step, so the
+TPU idled while the host fetched a number it usually only prints every
+``log_freq`` steps. An :class:`AsyncScalar` keeps the loss as the device
+array the dispatched step already produced; ``float()`` (or
+:func:`fetch_all` over a window) is the only blocking fetch.
+
+Every blocking fetch increments a module counter so the sync-count
+regression gate (tests/test_async_pipeline.py, mirroring the optimizer
+dispatch gate) can hard-fail a path that reintroduces per-step syncs.
+One :func:`fetch_all` over N pending scalars counts as ONE sync: it is a
+single ``jax.device_get`` round, which is the quantity that stalls the
+pipeline.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+_sync_count = 0
+_lock = threading.Lock()
+
+
+def host_sync_count() -> int:
+    """Blocking device->host fetch rounds since import (monotonic)."""
+    return _sync_count
+
+
+def _record_sync(n=1):
+    global _sync_count
+    with _lock:
+        _sync_count += n
+
+
+class AsyncScalar:
+    """A scalar still living on the device; converts lazily.
+
+    Accepts a Tensor, a ``jax.Array``, or a plain Python/numpy number
+    (already-resolved — e.g. the synchronous path under
+    ``FLAGS_async_pipeline=False`` wraps nothing and pays no sync).
+    """
+
+    __slots__ = ("_data", "_value")
+
+    def __init__(self, value):
+        data = getattr(value, "_data", value)  # unwrap Tensor
+        if isinstance(data, jax.Array):
+            self._data = data
+            self._value = None
+        else:
+            self._data = None
+            self._value = float(np.asarray(data))
+
+    @property
+    def resolved(self) -> bool:
+        return self._value is not None
+
+    def _resolve(self):
+        if self._value is None:
+            fetch_all([self])
+        return self._value
+
+    def __float__(self):
+        return self._resolve()
+
+    def item(self):
+        return self._resolve()
+
+    def numpy(self):
+        return np.asarray(self._resolve(), dtype=np.float64)
+
+    # comparisons/arithmetic/format sync — they need the value by
+    # definition (train_batch used to return a plain float; anything a
+    # caller could do with that float must keep working)
+    def __lt__(self, other):
+        return self._resolve() < float(other)
+
+    def __gt__(self, other):
+        return self._resolve() > float(other)
+
+    def __le__(self, other):
+        return self._resolve() <= float(other)
+
+    def __ge__(self, other):
+        return self._resolve() >= float(other)
+
+    def __eq__(self, other):
+        try:
+            return self._resolve() == float(other)
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __hash__(self):
+        return hash(self._resolve())
+
+    def __add__(self, other):
+        return self._resolve() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._resolve() - other
+
+    def __rsub__(self, other):
+        return other - self._resolve()
+
+    def __mul__(self, other):
+        return self._resolve() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._resolve() / other
+
+    def __rtruediv__(self, other):
+        return other / self._resolve()
+
+    def __neg__(self):
+        return -self._resolve()
+
+    def __format__(self, spec):
+        return format(self._resolve(), spec)
+
+    def __repr__(self):
+        # must NOT sync: logs dicts holding pending scalars get repr'd
+        if self._value is not None:
+            return repr(self._value)
+        return "AsyncScalar(pending)"
+
+
+def fetch_all(scalars):
+    """Resolve every pending scalar in one blocking fetch round.
+
+    Returns the float values in input order. N pending scalars cost one
+    ``jax.device_get`` over the batch — one sync, not N.
+    """
+    pending = [s for s in scalars
+               if isinstance(s, AsyncScalar) and s._value is None]
+    if pending:
+        vals = jax.device_get([s._data for s in pending])
+        _record_sync(1)
+        for s, v in zip(pending, vals):
+            s._value = float(np.asarray(v))
+            s._data = None
+    return [float(s) for s in scalars]
+
+
+__all__ = ["AsyncScalar", "fetch_all", "host_sync_count"]

@@ -210,6 +210,17 @@ define_flag("fused_optimizer", bool, True,
             "updates with buffer donation (optimizer/fused.py) — one "
             "compiled dispatch per (dtype, device) bucket instead of one "
             "per parameter; False restores the per-parameter loop")
+define_flag("async_pipeline", bool, True,
+            "async training pipeline: DataLoader(use_buffer_reader=True) "
+            "stages batches onto the device in a background thread "
+            "(io/prefetch.py) and Model.fit defers loss fetches to "
+            "log_freq boundaries behind AsyncScalar (core/async_scalar.py)"
+            " — False restores the fully synchronous per-step path "
+            "(bit-identical losses, one blocking fetch per step)")
+define_flag("async_inflight_steps", int, 8,
+            "max dispatched-but-unfetched train steps Model.fit keeps in "
+            "flight before forcing a blocking loss fetch (the bounded "
+            "window K; bounds how far the host runs ahead of the device)")
 define_flag("sot_specialization_cache_size", int, 32,
             "max SOT-lite branch specializations kept per input signature "
             "(LRU eviction; the reference's sot guard-cache bound)")

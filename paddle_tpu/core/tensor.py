@@ -103,6 +103,13 @@ class Tensor:
 
     # ---- conversion ----
     def numpy(self):
+        if getattr(self, "_donated", False):
+            raise RuntimeError(
+                "this Tensor's buffer was donated to a compiled train "
+                "step (it was a staged input batch, consumed in place on "
+                "the device); read or copy it BEFORE the step, or set "
+                "DataLoader(use_buffer_reader=False) to keep batches "
+                "caller-owned")
         out = np.asarray(self._data)
         if out.ndim == 0:
             from .flags import GLOBAL_FLAGS

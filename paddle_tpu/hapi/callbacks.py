@@ -75,8 +75,16 @@ class ProgBarLogger(Callback):
             print(f"Epoch {epoch + 1}/{self.params['epochs']}")
 
     def _fmt(self, logs):
-        return " - ".join(f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
-                          for k, v in (logs or {}).items())
+        from ..core.async_scalar import AsyncScalar
+
+        def one(k, v):
+            if isinstance(v, AsyncScalar):
+                # printing IS a sync boundary: resolve (Model.fit already
+                # fetched the window at log_freq steps, so this is free)
+                v = float(v)
+            return f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+
+        return " - ".join(one(k, v) for k, v in (logs or {}).items())
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose > 1 and step % self.log_freq == 0:

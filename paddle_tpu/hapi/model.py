@@ -16,6 +16,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..core.autograd import no_grad
+from ..core.async_scalar import AsyncScalar, fetch_all
+from ..core.flags import GLOBAL_FLAGS
 from ..io import DataLoader, Dataset
 from ..metric import Metric
 
@@ -121,7 +123,17 @@ class Model:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
         metrics = self._update_metrics(outputs, labels)
-        return (float(np.asarray(loss.numpy())), metrics)
+        return (self._loss_out(loss), metrics)
+
+    def _loss_out(self, loss):
+        """Deferred loss: the dispatched step's device scalar rides back as
+        an AsyncScalar whose ``float()`` is the only sync point, so the
+        device never idles for a number the host prints every ``log_freq``
+        steps. ``FLAGS_async_pipeline=False`` restores the per-step
+        blocking fetch (bit-identical values)."""
+        if GLOBAL_FLAGS.get("async_pipeline"):
+            return AsyncScalar(loss)
+        return float(np.asarray(loss.numpy()))
 
     def _amp_ctx(self):
         from contextlib import nullcontext
@@ -140,8 +152,7 @@ class Model:
                 outputs = self.network(*inputs)
                 loss = self._compute_loss(outputs, labels) if self._loss else None
         metrics = self._update_metrics(outputs, labels)
-        return (float(np.asarray(loss.numpy())) if loss is not None else None,
-                metrics)
+        return (self._loss_out(loss) if loss is not None else None, metrics)
 
     def predict_batch(self, inputs):
         self.network.eval()
@@ -169,11 +180,12 @@ class Model:
         history = []
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(loader, cbks, "train")
+            logs = self._run_one_epoch(loader, cbks, "train", log_freq)
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 cbks.on_eval_begin()
-                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval",
+                                                log_freq)
                 cbks.on_eval_end(eval_logs)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             history.append(logs)
@@ -191,7 +203,7 @@ class Model:
                                 log_freq=log_freq, verbose=verbose,
                                 metrics=self._metric_names(), mode="eval")
         cbks.on_eval_begin()
-        logs = self._run_one_epoch(loader, cbks, "eval")
+        logs = self._run_one_epoch(loader, cbks, "eval", log_freq)
         cbks.on_eval_end(logs)
         return logs
 
@@ -255,7 +267,10 @@ class Model:
 
     # ---- internals ----
     def _tensorize(self, x):
-        return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+        # Tensor() already normalizes host data (np.asarray + dtype
+        # defaulting); the extra np.asarray wrapper forced an eager host
+        # copy for list inputs before Tensor staged them again
+        return x if isinstance(x, Tensor) else Tensor(x)
 
     def _compute_loss(self, outputs, labels):
         if self._loss is None:
@@ -284,10 +299,20 @@ class Model:
             names += n if isinstance(n, list) else [n]
         return names
 
-    def _run_one_epoch(self, loader, cbks, mode):
+    def _run_one_epoch(self, loader, cbks, mode, log_freq=10):
+        from ..io.prefetch import PIPELINE_METRICS as _pm
         for m in self._metrics:
             m.reset()
         losses = []
+        pending = []   # dispatched-but-unfetched AsyncScalar losses
+        window = max(1, int(GLOBAL_FLAGS.get("async_inflight_steps")))
+        # fetch cadence = min(log_freq, window), via exactly ONE trigger:
+        # log_freq boundaries when they are at least as frequent as the
+        # window (aligned with ProgBarLogger prints), else the window
+        # alone — running both would interleave phases (fetches at 0, 8,
+        # 10, 18, 20, ... for log_freq=10/K=8) and break the
+        # steps/min(log_freq, K) + 2 sync bound the gate enforces
+        boundary_mode = bool(log_freq) and log_freq <= window
         logs = {}
         for step, batch in enumerate(loader):
             inputs, labels = _split_batch(batch, max(1, len(self._labels))
@@ -300,15 +325,32 @@ class Model:
                 loss, metrics = self.eval_batch(inputs, labels)
             if loss is not None:
                 losses.append(loss)
-            logs = {"loss": loss, **metrics}
+            if isinstance(loss, AsyncScalar) and not loss.resolved:
+                # bounded in-flight window: the host may run up to
+                # ``window`` dispatched steps ahead, fetching the whole
+                # window in ONE blocking device_get per cadence point
+                pending.append(loss)
+                _pm.set_in_flight(len(pending))
+                if (step % log_freq == 0) if boundary_mode \
+                        else (len(pending) >= window):
+                    fetch_all(pending)
+                    pending.clear()
+                    _pm.set_in_flight(0)
+            logs = {"loss": float(loss)
+                    if isinstance(loss, AsyncScalar) and loss.resolved
+                    else loss, **metrics}
             if mode == "train":
                 cbks.on_train_batch_end(step, logs)
                 if self.stop_training:
                     break
             else:
                 cbks.on_eval_batch_end(step, logs)
+        if pending:
+            fetch_all(pending)
+            pending.clear()
+            _pm.set_in_flight(0)
         if losses:
-            logs["loss"] = float(np.mean(losses))
+            logs["loss"] = float(np.mean([float(l) for l in losses]))
         return logs
 
 

@@ -196,15 +196,35 @@ class RandomSampler(Sampler):
 
 
 class WeightedRandomSampler(Sampler):
-    def __init__(self, weights, num_samples, replacement=True):
+    def __init__(self, weights, num_samples, replacement=True,
+                 generator=None):
         self.weights = np.asarray([float(w) for w in weights])
+        if self.weights.ndim != 1 or len(self.weights) == 0:
+            raise ValueError("weights must be a non-empty 1-D sequence")
+        if np.any(self.weights < 0) or not np.all(np.isfinite(self.weights)):
+            raise ValueError("weights must be finite and non-negative")
+        if self.weights.sum() == 0:
+            raise ValueError("weights must not be all zero")
+        if num_samples <= 0:
+            raise ValueError(f"num_samples must be positive, got {num_samples}")
+        if not replacement and num_samples > np.count_nonzero(self.weights):
+            raise ValueError(
+                "num_samples exceeds the nonzero-weight population when "
+                "sampling without replacement")
         self.num_samples = num_samples
         self.replacement = replacement
+        self.generator = generator
 
     def __iter__(self):
+        # seeded like RandomSampler._perm: reproducible across runs,
+        # different per epoch (the epoch index folds into the seed)
+        epoch = getattr(self, "_epoch", 0)
+        self._epoch = epoch + 1
         p = self.weights / self.weights.sum()
-        return iter(np.random.choice(len(self.weights), self.num_samples,
-                                     replace=self.replacement, p=p).tolist())
+        rng = np.random.default_rng(_gen_seed(self.generator) + epoch) \
+            if self.generator is not None else np.random
+        return iter(rng.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p).tolist())
 
     def __len__(self):
         return self.num_samples
@@ -320,7 +340,23 @@ def default_collate_fn(batch):
     python/paddle/io/dataloader/collate.py)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+        # one batched device_get instead of a per-sample .numpy() round
+        # trip, then the same native memcpy fan-out the ndarray branch
+        # uses; 0-dim samples keep Tensor.numpy()'s FLAGS_set_to_1d
+        # legacy reshape, and a donated buffer gets numpy()'s
+        # descriptive error instead of jax's opaque one
+        import jax
+
+        from ..core.flags import GLOBAL_FLAGS
+        if sample.ndim == 0 and GLOBAL_FLAGS.get("set_to_1d"):
+            return Tensor(np.stack([s.numpy() for s in batch]))
+        for s in batch:
+            if getattr(s, "_donated", False):
+                s.numpy()   # raises the donated-buffer RuntimeError
+        arrs = [np.asarray(a) for a in
+                jax.device_get([s._data for s in batch])]
+        fast = _native_stack(arrs)
+        return Tensor(fast if fast is not None else np.stack(arrs))
     if isinstance(sample, np.ndarray):
         fast = _native_stack(batch)
         return Tensor(fast if fast is not None else np.stack(batch))
@@ -331,46 +367,6 @@ def default_collate_fn(batch):
     if isinstance(sample, dict):
         return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
     return batch
-
-
-class _WorkerError:
-    """Wraps a producer-thread exception for re-raise in the consumer
-    (a plain tuple sentinel would hit Tensor.__eq__ on tensor batches)."""
-
-    def __init__(self, exc):
-        self.exc = exc
-
-
-def _prefetch_to_device(it, size, device=None):
-    """Async device prefetch over a batch iterator.
-
-    Each Tensor leaf is re-homed with ``jax.device_put`` (an async dispatch
-    under PJRT) and up to ``size`` batches stay in flight, so the H2D copy
-    of batch N+1 runs while the model computes on batch N. Non-Tensor
-    leaves (labels kept as numpy, metadata) pass through untouched.
-
-    With ``device=None`` the transfer targets the default device but the
-    result stays UNCOMMITTED — multi-device programs (sharded params,
-    Layer.to elsewhere) keep their placement freedom; passing an explicit
-    DataLoader ``places`` commits batches there.
-    """
-    import collections
-
-    import jax
-
-    def put(batch):
-        return jax.tree.map(
-            lambda x: Tensor(jax.device_put(x._data, device))
-            if isinstance(x, Tensor) else x,
-            batch, is_leaf=lambda x: isinstance(x, Tensor))
-
-    buf = collections.deque()
-    for b in it:
-        buf.append(put(b))
-        if len(buf) > size:
-            yield buf.popleft()
-    while buf:
-        yield buf.popleft()
 
 
 class DataLoader:
@@ -466,12 +462,12 @@ class DataLoader:
             # reference: DataLoader(use_buffer_reader=True) double-buffers
             # batches onto the device through an async queue
             # (python/paddle/io/reader.py:170 — buffered reader over
-            # places). TPU-native form: jax.device_put dispatches the H2D
-            # copy asynchronously, so keeping a small deque of in-flight
-            # batches overlaps input transfer with the current step's
-            # compute instead of paying it on the step's critical path.
-            # Without explicit ``places`` the batches stay uncommitted
-            # (multi-device programs keep placement freedom).
+            # places). TPU-native form (io/prefetch.py): a background
+            # thread stages the next prefetch_factor batches with
+            # jax.device_put, so the H2D copy of batch N+1 overlaps the
+            # current step's compute instead of paying it on the step's
+            # critical path. Without explicit ``places`` the batches stay
+            # uncommitted (multi-device programs keep placement freedom).
             dev = None
             if self._places:
                 import jax
@@ -485,9 +481,13 @@ class DataLoader:
                         dev = _as_place(first).jax_device()
                     except Exception:
                         dev = None
-            yield from _prefetch_to_device(
+            pf = DevicePrefetchIterator(
                 self._real_iter(), max(2, min(self.prefetch_factor, 4)),
                 device=dev)
+            try:
+                yield from pf
+            finally:
+                pf.close()
             return
         yield from self._real_iter()
 
@@ -569,6 +569,10 @@ class DataLoader:
                 pool.shutdown()
             except Exception:
                 pass
+
+
+from .prefetch import (DevicePrefetchIterator, PipelineMetrics,  # noqa: E402
+                       PIPELINE_METRICS, _WorkerError)
 
 
 def get_worker_info():

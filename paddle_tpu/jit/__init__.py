@@ -444,12 +444,30 @@ class TrainStep:
 
     def __call__(self, *batch):
         from ..core.flags import GLOBAL_FLAGS
+        from ..io.prefetch import PIPELINE_METRICS
         _, buffers = _collect_state(self.model)
+        for b in batch:
+            if isinstance(b, Tensor) and getattr(b, "_donated", False):
+                raise RuntimeError(
+                    "TrainStep received a batch tensor whose buffer was "
+                    "already donated to a previous compiled step. Staged "
+                    "batches (DataLoader(use_buffer_reader=True)) are "
+                    "single-use on TPU; to reuse a batch across steps, "
+                    "pass your own tensor or set use_buffer_reader=False.")
         batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                              for b in batch)
         check_finite = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+        # Staged-batch donation: batches the prefetch pipeline put on the
+        # device (io/prefetch.py marks them _staged_h2d) are consumed
+        # exactly once, so their buffers can be given back to XLA — the
+        # step reuses the HBM instead of allocating fresh activations next
+        # to a dead input copy. A caller-owned tensor (e.g. the bench
+        # reusing one batch) is never donated.
+        donate_batch = bool(batch) and jax.default_backend() != "cpu" and \
+            all(isinstance(b, Tensor) and getattr(b, "_staged_h2d", False)
+                for b in batch)
         key = tuple((a.shape, str(a.dtype)) for a in batch_arrays) \
-            + (check_finite,)
+            + (check_finite, donate_batch)
 
         if key not in self._cache:
             # Ensure optimizer state exists with final shapes: run one throwaway
@@ -513,6 +531,9 @@ class TrainStep:
                         p.grad = saved_grads[k]
 
             donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            if donate_batch:
+                # b_arrays start after the 6 fixed args of pure_step
+                donate = donate + tuple(range(6, 6 + len(batch_arrays)))
             self._cache[key] = jax.jit(pure_step, donate_argnums=donate)
 
         param_arrays = {k: p._data for k, p in self._params.items()}
@@ -543,10 +564,17 @@ class TrainStep:
             finally:
                 for k, t in buffers.items():
                     t._data = saved_buf[k]
+        PIPELINE_METRICS.record_dispatch()
         out = self._cache[key](
             param_arrays, opt_arrays, buffer_arrays,
             jnp.asarray(step_in, jnp.int32),
             jnp.asarray(lr, jnp.float32), rng_key, *batch_arrays)
+        if donate_batch:
+            for b in batch:
+                # buffer handed to XLA: mark so a reuse raises our error
+                # above instead of jax's opaque "Array has been deleted"
+                b._staged_h2d = False
+                b._donated = True
         if check_finite:
             new_p, new_o, new_b, loss, finite = out
             if not bool(finite):

@@ -119,6 +119,18 @@ class Profiler:
     def step(self, num_samples=None):
         self.step_num += 1
         _nv.prof_instant(f"profiler_step#{self.step_num}", 3)
+        if _nv.prof_enabled():
+            # async-pipeline gauges land next to op spans and serving
+            # gauges at each step mark (io/prefetch.py; docs/PERF.md §8)
+            from ..io.prefetch import PIPELINE_METRICS as _pm
+            # _total: the running accumulator — per-stall deltas go out
+            # as pipeline.input_stall_ms from record_stall, a different
+            # quantity that must not share the label
+            _nv.prof_instant(
+                f"pipeline.input_stall_ms_total={_pm.input_stall_ms:.3f}",
+                3)
+            _nv.prof_instant(
+                f"pipeline.steps_in_flight={_pm.steps_in_flight}", 3)
         self._apply_state(self.scheduler(self.step_num))
 
     def __enter__(self):

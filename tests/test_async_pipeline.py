@@ -1,0 +1,315 @@
+"""Async training pipeline gates (io/prefetch.py, core/async_scalar.py).
+
+Mirrors test_optimizer_dispatch_gate.py: the pipeline's headline win is the
+per-step host sync count dropping from one-per-step to one-per-log_freq
+window, counted through the blocking-fetch hook in core/async_scalar.py.
+The gate hard-fails if a jitted ``Model.fit`` epoch over the prefetching
+loader ever pays more than ``steps/log_freq + slack`` blocking fetches
+again, and checks the in-flight window stays bounded by K. Satellites:
+prefetch ordering/determinism, staged-batch marking, the Tensor collate
+fast path, and WeightedRandomSampler seeding/validation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import async_scalar
+from paddle_tpu.core.async_scalar import AsyncScalar, fetch_all
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DevicePrefetchIterator, RandomSampler,
+                           WeightedRandomSampler, default_collate_fn)
+from paddle_tpu.io.prefetch import PIPELINE_METRICS
+
+STEPS = 32
+LOG_FREQ = 8
+# one fetch per log_freq window + first-step fetch + epoch-end drain
+SYNC_SLACK = 2
+
+
+@pytest.fixture(autouse=True)
+def _restore_pipeline_flags():
+    yield
+    GLOBAL_FLAGS.set("async_pipeline", True)
+    GLOBAL_FLAGS.set("async_inflight_steps", 8)
+
+
+class _ArrayDataset(Dataset):
+    def __init__(self, n=STEPS * 8, d=8, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, d).astype(np.float32)
+        self.y = rng.randn(n, 1).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _jit_model(seed=3):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(parameters=net.parameters(),
+                                learning_rate=1e-2)
+    model.prepare(opt, nn.MSELoss(), use_jit=True)
+    return model
+
+
+# ---- the sync-count gate ----
+
+def test_fit_syncs_bounded_per_log_freq_window():
+    GLOBAL_FLAGS.set("async_pipeline", True)
+    model = _jit_model()
+    loader = DataLoader(_ArrayDataset(), batch_size=8,
+                        use_buffer_reader=True)
+    model.fit(loader, epochs=1, log_freq=LOG_FREQ, verbose=0)  # compile
+    PIPELINE_METRICS.reset()
+    before = async_scalar.host_sync_count()
+    model.fit(loader, epochs=1, log_freq=LOG_FREQ, verbose=0)
+    syncs = async_scalar.host_sync_count() - before
+    assert syncs <= STEPS // LOG_FREQ + SYNC_SLACK, (
+        f"jitted fit paid {syncs} blocking fetches for {STEPS} steps "
+        f"(log_freq={LOG_FREQ}) — deferred-sync regression")
+    snap = PIPELINE_METRICS.snapshot()
+    k = int(GLOBAL_FLAGS.get("async_inflight_steps"))
+    assert 2 <= snap["max_steps_in_flight"] <= k
+    assert snap["step_dispatches"] == STEPS
+    assert snap["batches_staged"] == STEPS
+
+
+def test_sync_path_pays_one_fetch_per_step():
+    """The gate's denominator is real: FLAGS_async_pipeline=False restores
+    the per-step blocking fetch the async path collapses."""
+    GLOBAL_FLAGS.set("async_pipeline", False)
+    model = _jit_model()
+    loader = DataLoader(_ArrayDataset(), batch_size=8,
+                        use_buffer_reader=True)
+    losses = []
+    for batch in loader:
+        loss, _ = model.train_batch([batch[0]], [batch[1]])
+        assert isinstance(loss, float)
+        losses.append(loss)
+    assert len(losses) == STEPS
+
+
+def test_async_losses_bit_identical_to_sync_path():
+    histories = {}
+    for flag in (True, False):
+        GLOBAL_FLAGS.set("async_pipeline", flag)
+        model = _jit_model(seed=11)
+        loader = DataLoader(_ArrayDataset(seed=1), batch_size=8,
+                            use_buffer_reader=True)
+        histories[flag] = [e["loss"] for e in
+                           model.fit(loader, epochs=2, log_freq=LOG_FREQ,
+                                     verbose=0)]
+    assert histories[True] == histories[False]
+
+
+def test_sync_bound_holds_when_log_freq_exceeds_window():
+    """log_freq > K: the window must be the ONLY fetch trigger — mixing
+    it with the modulo-boundary trigger interleaves phases (fetches at
+    0, 8, 10, 18, 20, ...) and blows the steps/min(log_freq, K) bound."""
+    GLOBAL_FLAGS.set("async_pipeline", True)
+    GLOBAL_FLAGS.set("async_inflight_steps", 8)
+    model = _jit_model()
+    loader = DataLoader(_ArrayDataset(n=40 * 8), batch_size=8,
+                        use_buffer_reader=True)
+    model.fit(loader, epochs=1, log_freq=10, verbose=0)  # compile
+    before = async_scalar.host_sync_count()
+    model.fit(loader, epochs=1, log_freq=10, verbose=0)
+    syncs = async_scalar.host_sync_count() - before
+    assert syncs <= 40 // 8 + SYNC_SLACK, (
+        f"{syncs} fetch rounds for 40 steps with K=8/log_freq=10 — "
+        "the two fetch triggers are interleaving again")
+
+
+def test_inflight_window_never_exceeds_k():
+    GLOBAL_FLAGS.set("async_pipeline", True)
+    GLOBAL_FLAGS.set("async_inflight_steps", 4)
+    model = _jit_model()
+    loader = DataLoader(_ArrayDataset(), batch_size=8,
+                        use_buffer_reader=True)
+    model.fit(loader, epochs=1, log_freq=10_000, verbose=0)  # compile
+    PIPELINE_METRICS.reset()
+    before = async_scalar.host_sync_count()
+    # log_freq >> steps: the window bound is the only fetch trigger
+    model.fit(loader, epochs=1, log_freq=10_000, verbose=0)
+    assert PIPELINE_METRICS.max_steps_in_flight <= 4
+    syncs = async_scalar.host_sync_count() - before
+    assert syncs <= STEPS // 4 + SYNC_SLACK
+
+
+# ---- AsyncScalar ----
+
+def test_async_scalar_lazy_and_batched_fetch():
+    import jax.numpy as jnp
+    vals = [AsyncScalar(jnp.float32(i) * 1.5) for i in range(5)]
+    assert all(not v.resolved for v in vals)
+    before = async_scalar.host_sync_count()
+    out = fetch_all(vals)
+    assert async_scalar.host_sync_count() - before == 1, \
+        "N pending scalars must resolve in ONE device_get round"
+    assert out == [0.0, 1.5, 3.0, 4.5, 6.0]
+    # resolved: float() is free (no further syncs)
+    before = async_scalar.host_sync_count()
+    assert float(vals[3]) == 4.5
+    assert f"{vals[2]:.1f}" == "3.0"
+    assert async_scalar.host_sync_count() == before
+    # plain numbers wrap already-resolved
+    assert AsyncScalar(2.5).resolved and float(AsyncScalar(2.5)) == 2.5
+    assert "pending" not in repr(AsyncScalar(1.0))
+    # everything a caller could do with the float train_batch used to
+    # return keeps working: equality, arithmetic, ordering
+    s = AsyncScalar(1.5)
+    assert s == 1.5 and not (s != 1.5) and s != 2.0
+    assert s + 0.5 == 2.0 and 0.5 + s == 2.0 and s * 2 == 3.0
+    assert 3.0 - s == 1.5 and s / 3 == 0.5 and -s == -1.5
+    assert s < 2 and s >= 1.5 and np.mean([AsyncScalar(1.0), 3.0]) == 2.0
+
+
+def test_fit_log_freq_zero_does_not_crash():
+    GLOBAL_FLAGS.set("async_pipeline", True)
+    model = _jit_model()
+    loader = DataLoader(_ArrayDataset(n=32), batch_size=8,
+                        use_buffer_reader=True)
+    h = model.fit(loader, epochs=1, log_freq=0, verbose=0)
+    assert np.isfinite(h[0]["loss"])
+
+
+def test_abandoned_prefetch_iterator_does_not_leak_stager():
+    import gc
+    import threading
+    import time as _time
+    before = {t.name for t in threading.enumerate()}
+    it = DevicePrefetchIterator(
+        iter([Tensor(np.zeros((2,), np.float32)) for _ in range(20)]),
+        prefetch_factor=2)
+    next(it)
+    del it          # no close(): the weakref-held stager must still exit
+    gc.collect()
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        left = [t for t in threading.enumerate()
+                if t.name == "paddle_tpu-device-prefetch"
+                and t.name not in before and t.is_alive()]
+        if not left:
+            break
+        _time.sleep(0.05)
+    assert not left, "stager thread leaked after iterator abandonment"
+
+
+# ---- prefetch iterator ----
+
+def test_prefetch_preserves_sampler_order():
+    ds = _ArrayDataset(n=40)
+    loader = DataLoader(ds, batch_size=4, use_buffer_reader=True)
+    xs = np.concatenate([np.asarray(b[0].numpy()) for b in loader])
+    np.testing.assert_array_equal(xs, ds.x)
+
+
+def test_prefetch_deterministic_under_seeded_generator():
+    def epoch(seed):
+        ds = _ArrayDataset(n=40)
+        bs = BatchSampler(sampler=RandomSampler(ds, generator=seed),
+                          batch_size=4)
+        loader = DataLoader(ds, batch_sampler=bs, use_buffer_reader=True)
+        return np.concatenate([np.asarray(b[1].numpy()) for b in loader])
+
+    a, b = epoch(123), epoch(123)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, epoch(456))
+
+
+def test_prefetch_iterator_stages_and_marks_batches():
+    batches = [(Tensor(np.full((2, 2), float(i), np.float32)), i)
+               for i in range(6)]
+    it = DevicePrefetchIterator(iter(batches), prefetch_factor=2)
+    out = list(it)
+    assert len(out) == 6
+    for i, (t, tag) in enumerate(out):
+        assert tag == i                       # non-Tensor leaves untouched
+        assert getattr(t, "_staged_h2d", False) is True
+        np.testing.assert_array_equal(np.asarray(t.numpy()),
+                                      np.full((2, 2), float(i)))
+
+
+def test_sync_flag_disarms_donation_marking():
+    """FLAGS_async_pipeline=False is the bisect switch for the WHOLE
+    feature: the passthrough must not mark batches donatable."""
+    GLOBAL_FLAGS.set("async_pipeline", False)
+    it = DevicePrefetchIterator(
+        iter([Tensor(np.zeros((2,), np.float32))]), prefetch_factor=2)
+    (t,) = list(it)
+    assert not getattr(t, "_staged_h2d", False)
+
+
+def test_donated_tensor_read_raises_descriptive_error():
+    t = Tensor(np.zeros((2,), np.float32))
+    t._donated = True
+    with pytest.raises(RuntimeError, match="donated"):
+        t.numpy()
+
+
+def test_prefetch_iterator_propagates_worker_errors():
+    def gen():
+        yield Tensor(np.zeros((2,), np.float32))
+        raise RuntimeError("boom in producer")
+
+    it = DevicePrefetchIterator(gen(), prefetch_factor=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom in producer"):
+        next(it)
+
+
+# ---- satellites ----
+
+def test_collate_tensor_batch_single_fetch_fast_path():
+    arrs = [np.random.default_rng(i).standard_normal((3, 4)).astype(
+        np.float32) for i in range(5)]
+    out = default_collate_fn([Tensor(a) for a in arrs])
+    assert isinstance(out, Tensor) and out.shape == [5, 3, 4]
+    np.testing.assert_array_equal(np.asarray(out.numpy()), np.stack(arrs))
+    # dtype survives the round trip (int64 inputs land as int32 at Tensor
+    # construction on this stack — collate must preserve THAT dtype)
+    ints = [Tensor(np.arange(4, dtype=np.int64)) for _ in range(3)]
+    assert default_collate_fn(ints).dtype == ints[0].dtype
+
+
+def test_weighted_sampler_seeded_epoch_offset():
+    w = [0.1, 0.2, 0.3, 0.4]
+    s1 = WeightedRandomSampler(w, 32, generator=9)
+    s2 = WeightedRandomSampler(w, 32, generator=9)
+    e1a, e1b = list(s1), list(s1)   # epochs 0, 1 of the same sampler
+    assert list(s2) == e1a, "same generator must reproduce epoch 0"
+    assert e1a != e1b, "epoch index must fold into the seed"
+    assert list(s2) == e1b, "epoch sequences must align across instances"
+    # unseeded stays legal
+    assert len(list(WeightedRandomSampler(w, 8))) == 8
+
+
+def test_weighted_sampler_validates_weights():
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([0.5, -0.1], 4)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([0.0, 0.0], 4)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([], 4)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([1.0, float("inf")], 4)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([1.0, 1.0], 0)
+    with pytest.raises(ValueError):
+        WeightedRandomSampler([1.0, 0.0, 1.0], 3, replacement=False)
+
+
+def test_tensorize_is_zero_copy_for_tensors():
+    model = paddle.Model(nn.Linear(4, 4))
+    t = Tensor(np.ones((2, 4), np.float32))
+    assert model._tensorize(t) is t
+    out = model._tensorize(np.full((2, 4), 3.0, np.float32))
+    assert isinstance(out, Tensor)
+    np.testing.assert_array_equal(np.asarray(out.numpy()), 3.0)
