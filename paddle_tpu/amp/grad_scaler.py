@@ -3,12 +3,46 @@
 Dynamic loss scaling for float16; for bfloat16 (the TPU default) scaling is
 a no-op numerically but the API contract (scale → backward → step → update)
 is preserved.
+
+The unscale+finiteness check is ONE fused compiled dispatch for the whole
+model: grads are grouped into the same (dtype) buckets the fused optimizer
+flattens (optimizer/fused.py bucket order when the engine is live, so the
+concatenated views line up with the bucket buffers XLA already holds), each
+bucket reduces to a single ``isfinite().all()``, and every grad is unscaled
+inside the same program. Before this fusion the check issued one
+``jnp.isfinite(g).all()`` per parameter — O(n_params) dispatches per step.
+
+The finiteness VERDICT resolves lazily: ``unscale_`` stores the device
+scalar without syncing, and the host blocks only where control flow
+actually needs the answer (``step``'s skip decision, ``_update``'s scale
+adjustment) — so an async training pipeline that unscales every step pays
+no extra per-step host sync.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+
+
+def _unscale_and_check_body(grads, inv):
+    """Pure fused body: unscale every grad and AND per-dtype-bucket
+    finiteness reductions into one device scalar."""
+    finite = jnp.asarray(True)
+    by_dtype: dict = {}
+    for g in grads:
+        by_dtype.setdefault(str(g.dtype), []).append(jnp.ravel(g))
+    for parts in by_dtype.values():
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        finite = jnp.logical_and(finite, jnp.isfinite(flat).all())
+    # unscale in each grad's own dtype (inv rounds to the grad dtype like
+    # the former python-float multiply), preserving pre-fusion numerics
+    new = tuple(g * inv.astype(g.dtype) for g in grads)
+    return finite, new
+
+
+_unscale_jit = jax.jit(_unscale_and_check_body)
 
 
 class AmpScaler:
@@ -24,36 +58,86 @@ class AmpScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf_value = False
+        self._pending_finite = None  # device scalar awaiting a host read
+        self._unscaled = False       # grads already unscaled this step
 
     def scale(self, var):
         if not self._enable:
             return var
         return var * self._scale
 
+    @property
+    def _found_inf(self):
+        """Lazily-resolved verdict of the last fused check: reading it is
+        the host sync point."""
+        if self._pending_finite is not None:
+            self._found_inf_value = not bool(self._pending_finite)
+            self._pending_finite = None
+        return self._found_inf_value
+
+    @_found_inf.setter
+    def _found_inf(self, v):
+        self._pending_finite = None
+        self._found_inf_value = bool(v)
+
+    def _grads_in_bucket_order(self, optimizer):
+        """Params with grads, ordered by the fused engine's bucket layout
+        when it is live (so the per-dtype concat mirrors the flat bucket
+        views), else declaration order."""
+        with_grad = [p for p in optimizer._parameter_list
+                     if p.grad is not None]
+        eng = getattr(optimizer, "_fused_engine", None)
+        if eng is None or not eng.active:
+            return with_grad
+        seen = set()
+        ordered = []
+        for b in eng.buckets:
+            for p in b.params:
+                if p.grad is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    ordered.append(p)
+        ordered += [p for p in with_grad if id(p) not in seen]
+        return ordered
+
     def _unscale_and_check(self, optimizer):
-        params = [p for p in optimizer._parameter_list if p.grad is not None]
-        inv = 1.0 / self._scale
-        finite_flags = []
-        for p in params:
-            g = p.grad._data
-            finite_flags.append(jnp.isfinite(g).all())
-            p.grad._inplace_update(g * inv)
-        # one fused reduction + a single host sync for the whole model
-        self._found_inf = bool(params) and not bool(
-            jnp.all(jnp.stack(finite_flags)))
-        return not self._found_inf
+        """Dispatch the fused unscale+check; does NOT read the verdict —
+        callers that need the decision read ``_found_inf`` (the sync)."""
+        params = self._grads_in_bucket_order(optimizer)
+        if not params:
+            self._found_inf = False
+            return
+        from ..optimizer.fused import record_dispatch
+        grads = tuple(p.grad._data for p in params)
+        record_dispatch()  # one compiled dispatch for the whole model
+        finite, new = _unscale_jit(grads, jnp.float32(1.0 / self._scale))
+        for p, g in zip(params, new):
+            p.grad._inplace_update(g)
+        self._pending_finite = finite  # verdict resolves lazily
+        self._unscaled = True
 
     def unscale_(self, optimizer):
-        if self._enable:
-            self._unscale_and_check(optimizer)
+        """Unscale grads now; the finiteness verdict stays on-device
+        until something reads it (no host sync here). Calling it twice
+        before ``update()`` would divide the grads by the scale twice —
+        raise instead (the reference/torch contract)."""
+        if not self._enable:
+            return
+        if self._unscaled:
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        self._unscale_and_check(optimizer)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if self._unscale_and_check(optimizer):
+        if not self._unscaled:  # an explicit unscale_() already ran
+            self._unscale_and_check(optimizer)
+        if not self._found_inf:  # the skip decision is the sync point
             optimizer.step()
+        self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
@@ -61,6 +145,7 @@ class AmpScaler:
 
     def update(self):
         if self._enable:
+            self._unscaled = False  # next step's grads are fresh
             self._update()
 
     def _update(self):

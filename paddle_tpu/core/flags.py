@@ -81,12 +81,21 @@ class FlagRegistry:
 
     def set(self, name: str, value):
         f = self._flags[name]
+        old = f.value
         f.value = value if isinstance(value, f.type) or f.type is Any else f._parse(str(value))
         nv = _native()
         if nv is not None:
             nv.flags.set(f.name, f.value)
         if f.on_set is not None:
-            f.on_set(f.value)
+            try:
+                f.on_set(f.value)
+            except Exception:
+                # a rejecting on_set (validating flags like remat_policy)
+                # must not leave the invalid value behind
+                f.value = old
+                if nv is not None:
+                    nv.flags.set(f.name, old)
+                raise
 
     def __contains__(self, name):
         return name in self._flags

@@ -59,7 +59,7 @@ class Model:
 
     # ---- setup ----
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None, use_jit=False):
+                amp_configs=None, use_jit=False, accumulate_steps=1):
         # amp_configs (reference model.py:prepare): "O0"/"O1"/"O2" or a
         # dict with level/dtype/custom lists — train, eval, AND the
         # fused use_jit step all run their forwards under amp.auto_cast
@@ -93,6 +93,19 @@ class Model:
                 raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
         self._metrics = metrics
         self._use_jit = use_jit
+        # micro-batch gradient accumulation inside the compiled step
+        # (jit.TrainStep(accumulate_steps=K)): each train batch splits
+        # into K micro-batches and one optimizer update applies the mean
+        # grads — K× effective batch at batch/K activation memory.
+        # Requires use_jit; the eager path raises to avoid silently
+        # training with a different effective batch than asked.
+        self._accumulate_steps = int(accumulate_steps)
+        if self._accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        if self._accumulate_steps > 1 and not use_jit:
+            raise ValueError(
+                "prepare(accumulate_steps>1) requires use_jit=True — "
+                "gradient accumulation runs inside the compiled TrainStep")
         self._train_step = None
         return self
 
@@ -110,7 +123,9 @@ class Model:
                     outs = self.network(*flat[:n_in])
                     return self._compute_loss(outs, list(flat[n_in:]))
 
-            self._train_step = TrainStep(self.network, loss_fn, self._optimizer)
+            self._train_step = TrainStep(
+                self.network, loss_fn, self._optimizer,
+                accumulate_steps=getattr(self, "_accumulate_steps", 1))
         if self._train_step is not None:
             loss = self._train_step(*inputs, *labels)
             outputs = None  # fused step doesn't surface intermediate outputs

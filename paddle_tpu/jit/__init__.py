@@ -396,16 +396,47 @@ class TrainStep:
     updates are in-place in HBM (the reference needs fused multi-tensor
     kernels + interpreter scheduling for the same effect, SURVEY.md §3.3).
 
+    ``accumulate_steps=K`` runs micro-batch gradient accumulation INSIDE
+    the compiled step: the batch splits into K equal micro-batches along
+    axis 0 and a ``lax.scan`` threads a dtype-bucketed flat gradient
+    accumulator through K forward+backward replays (the body is traced
+    once — HLO stays O(1) in K), then applies ONE optimizer update from
+    the mean gradients. The accumulator never leaves the device and the
+    host still issues exactly one dispatch per optimizer step, so a K×
+    effective batch fits in the activation memory of a batch/K step.
+    Numerically the update equals a single K×-batch step for mean-shaped
+    losses (micro means averaged over K).
+
+    ``remat_policy`` pins the activation rematerialization policy
+    ('none' / 'dots_saveable' / 'full', see FLAGS_remat_policy) for this
+    step's traces; None defers to the flag.
+
     Usage::
         step = TrainStep(model, lambda x, y: F.cross_entropy(model(x), y), opt)
         loss = step(x_batch, y_batch)
     """
 
-    def __init__(self, model, loss_fn, optimizer):
+    def __init__(self, model, loss_fn, optimizer, accumulate_steps=1,
+                 remat_policy=None):
+        from ..nn.scan_stack import REMAT_POLICIES
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.accumulate_steps = int(accumulate_steps)
+        if self.accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        if remat_policy is not None and remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat_policy must be one of {REMAT_POLICIES} or None, "
+                f"got {remat_policy!r}")
+        self.remat_policy = remat_policy
+        # compile forensics: wall-ms of the most recent first-call
+        # trace+lower+build, and the running total across re-specializes
+        # (shape changes, flag flips). Mirrored into bench.py artifacts.
+        self.last_compile_ms = None
+        self.compile_ms_total = 0.0
         self._cache = {}
+        self._compiled_keys = set()
         # materialize optimizer state now so it traces as inputs
         params = [p for p in optimizer._parameter_list if not p.stop_gradient]
         self._params = {f"p{i}": p for i, p in enumerate(params)}
@@ -445,6 +476,7 @@ class TrainStep:
     def __call__(self, *batch):
         from ..core.flags import GLOBAL_FLAGS
         from ..io.prefetch import PIPELINE_METRICS
+        from ..nn.scan_stack import remat_policy_scope, effective_remat_policy
         _, buffers = _collect_state(self.model)
         for b in batch:
             if isinstance(b, Tensor) and getattr(b, "_donated", False):
@@ -456,7 +488,43 @@ class TrainStep:
                     "pass your own tensor or set use_buffer_reader=False.")
         batch_arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                              for b in batch)
+        K = self.accumulate_steps
+        if K > 1:
+            if buffers:
+                raise RuntimeError(
+                    "TrainStep(accumulate_steps>1) cannot scan a model "
+                    "with registered buffers: per-micro-batch buffer "
+                    "mutations cannot be committed from a scan body. Use "
+                    "accumulate_steps=1 (or an outer accumulation loop) "
+                    "for buffer-mutating models.")
+            if any(not a.shape or a.shape[0] % K for a in batch_arrays):
+                # ragged tail batch (drop_last=False loaders): process it
+                # as ONE micro-batch — the mean-grad update is identical
+                # to accumulating it in smaller pieces, and the odd shape
+                # re-specializes the step anyway. Warn once so a loader
+                # that NEVER divides doesn't silently disable
+                # accumulation for the whole run.
+                if not getattr(self, "_warned_ragged", False):
+                    import warnings
+                    self._warned_ragged = True
+                    warnings.warn(
+                        f"TrainStep(accumulate_steps={K}): batch axis 0 "
+                        f"{[tuple(a.shape) for a in batch_arrays]} is not "
+                        f"divisible by {K}; running this batch without "
+                        "accumulation (expected for a drop_last=False "
+                        "tail batch — if every batch hits this, fix the "
+                        "batch size)", stacklevel=2)
+                K = 1
         check_finite = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+        # remat enters the cache key: the policy is baked into the traced
+        # program (jax.checkpoint over the scanned body), so a flag flip
+        # must re-specialize rather than reuse a stale executable. The
+        # explicit TrainStep override pins a scope for the trace; without
+        # one the model resolves the flag (and its own config.remat).
+        remat = self.remat_policy or effective_remat_policy()
+        from contextlib import nullcontext
+        policy_ctx = (remat_policy_scope(self.remat_policy)
+                      if self.remat_policy else nullcontext())
         # Staged-batch donation: batches the prefetch pipeline put on the
         # device (io/prefetch.py marks them _staged_h2d) are consumed
         # exactly once, so their buffers can be given back to XLA — the
@@ -467,7 +535,7 @@ class TrainStep:
             all(isinstance(b, Tensor) and getattr(b, "_staged_h2d", False)
                 for b in batch)
         key = tuple((a.shape, str(a.dtype)) for a in batch_arrays) \
-            + (check_finite, donate_batch)
+            + (check_finite, donate_batch, K, remat)
 
         if key not in self._cache:
             # Ensure optimizer state exists with final shapes: run one throwaway
@@ -501,9 +569,14 @@ class TrainStep:
                         opt._lr = lr
                         for p in param_t.values():
                             p.grad = None
-                        batch_tensors = [Tensor(a) for a in b_arrays]
-                        loss = loss_fn(*batch_tensors)
-                        loss.backward()
+                        if K == 1:
+                            batch_tensors = [Tensor(a) for a in b_arrays]
+                            loss = loss_fn(*batch_tensors)
+                            loss.backward()
+                            loss_arr = loss._data
+                        else:
+                            loss_arr = self._accumulate_grads(
+                                loss_fn, param_t, b_arrays, K, rng)
                         opt.step()
                         new_params = inst_p.current()
                         new_buffers = inst_b.current()
@@ -515,13 +588,13 @@ class TrainStep:
                             # reduction over loss + updated params, checked
                             # host-side — no per-op sync like the eager sweep
                             import jax.numpy as _jnp
-                            finite = _jnp.isfinite(loss._data).all()
+                            finite = _jnp.isfinite(loss_arr).all()
                             for v in new_params.values():
                                 if _jnp.issubdtype(v.dtype, _jnp.inexact):
                                     finite &= _jnp.isfinite(v).all()
                             return new_params, new_opt, new_buffers, \
-                                loss._data, finite
-                        return new_params, new_opt, new_buffers, loss._data
+                                loss_arr, finite
+                        return new_params, new_opt, new_buffers, loss_arr
                 finally:
                     opt._state = saved_state
                     if saved_eng is not None:
@@ -565,10 +638,25 @@ class TrainStep:
                 for k, t in buffers.items():
                     t._data = saved_buf[k]
         PIPELINE_METRICS.record_dispatch()
-        out = self._cache[key](
-            param_arrays, opt_arrays, buffer_arrays,
-            jnp.asarray(step_in, jnp.int32),
-            jnp.asarray(lr, jnp.float32), rng_key, *batch_arrays)
+        first_run = key not in self._compiled_keys
+        args = (param_arrays, opt_arrays, buffer_arrays,
+                jnp.asarray(step_in, jnp.int32),
+                jnp.asarray(lr, jnp.float32), rng_key, *batch_arrays)
+        if first_run:
+            # first call of this specialization = trace + lower + build:
+            # record a `compile` span on the profiler timeline so a
+            # recompile (shape change, remat/flag flip) is visible next
+            # to the pipeline gauges instead of reading as one slow step.
+            from ..profiler import compile_event
+            with policy_ctx, compile_event(
+                    f"TrainStep(K={K},remat={remat})") as ev:
+                out = self._cache[key](*args)
+            self._compiled_keys.add(key)
+            self.last_compile_ms = ev.ms
+            self.compile_ms_total += ev.ms
+        else:
+            with policy_ctx:
+                out = self._cache[key](*args)
         if donate_batch:
             for b in batch:
                 # buffer handed to XLA: mark so a reuse raises our error
@@ -602,6 +690,101 @@ class TrainStep:
         for k, t in buffers.items():
             t._data = new_b[f"b:{k}"]
         return Tensor(loss)
+
+    def _accumulate_grads(self, loss_fn, param_t, b_arrays, K, rng):
+        """Micro-batch gradient accumulation inside the traced step.
+
+        Splits each batch array into K equal micro-batches along axis 0
+        and ``lax.scan``s one forward+backward per micro-batch — the tape
+        replay is traced ONCE, so HLO stays O(1) in K. The carry is a
+        dtype-bucketed FLAT gradient accumulator (one buffer per param
+        dtype, the layout the fused optimizer's buckets consume), plus
+        the running loss; XLA double-buffers the carry in place across
+        iterations, so the accumulator never leaves the device. On exit
+        the mean grads are sliced back onto ``p.grad`` and the caller
+        runs ONE optimizer update — host dispatches per optimizer step
+        are unchanged from K=1.
+
+        Participation mirrors the K=1 path: an abstract probe
+        (``jax.eval_shape`` of one micro-batch's forward+backward, no
+        FLOPs) discovers which params actually receive a gradient and
+        with what dtype; non-participating params keep ``grad=None`` so
+        the optimizer skips them exactly like a single K×-batch step
+        would (no fabricated zero grads feeding weight decay / moments).
+        Each micro-batch re-seeds the captured RNG stream with its scan
+        index so stateful randomness (dropout) would not replay one
+        traced key K times.
+        """
+        import numpy as _np
+
+        order = [(k, p) for k, p in param_t.items()
+                 if jnp.issubdtype(jnp.result_type(p._data), jnp.inexact)]
+        micro = tuple(
+            a.reshape((K, a.shape[0] // K) + tuple(a.shape[1:]))
+            for a in b_arrays)
+
+        def _probe(mbs):
+            for _, p in order:
+                p.grad = None
+            try:
+                with _rng.capture_rng(jax.random.fold_in(rng, 0)):
+                    loss = loss_fn(*[Tensor(a) for a in mbs])
+                    loss.backward()
+                return {name: p.grad._data for name, p in order
+                        if p.grad is not None}
+            finally:
+                for _, p in order:
+                    p.grad = None
+
+        grad_shapes = jax.eval_shape(
+            _probe, tuple(jax.ShapeDtypeStruct(m.shape[1:], m.dtype)
+                          for m in micro))
+        groups: dict = {}
+        for name, p in order:
+            if name not in grad_shapes:
+                continue  # never receives a grad: optimizer skips it
+            aval = grad_shapes[name]
+            shape = tuple(aval.shape)
+            groups.setdefault(str(aval.dtype), []).append(
+                (name, int(_np.prod(shape)) if shape else 1, shape,
+                 aval.dtype))
+        init = ({dts: jnp.zeros(sum(e[1] for e in g), jnp.dtype(dts))
+                 for dts, g in groups.items()},
+                jnp.zeros((), jnp.float32))
+
+        def body(carry, xs):
+            acc, loss_acc = carry
+            idx, mbs = xs[0], xs[1:]
+            for _, p in order:
+                p.grad = None
+            with _rng.capture_rng(jax.random.fold_in(rng, idx)):
+                loss = loss_fn(*[Tensor(a) for a in mbs])
+                loss.backward()
+            new_acc = {}
+            for dts, g in groups.items():
+                parts = []
+                for name, sz, _, dt in g:
+                    grad = param_t[name].grad
+                    parts.append(jnp.ravel(grad._data).astype(dt)
+                                 if grad is not None else jnp.zeros(sz, dt))
+                flat = parts[0] if len(parts) == 1 \
+                    else jnp.concatenate(parts)
+                new_acc[dts] = acc[dts] + flat
+            for _, p in order:
+                p.grad = None
+            return (new_acc, loss_acc + loss._data.astype(jnp.float32)), None
+
+        (acc, loss_sum), _ = jax.lax.scan(
+            body, init, (jnp.arange(K),) + micro)
+        for dts, g in groups.items():
+            flat = acc[dts] / K
+            off = 0
+            for name, sz, shape, _ in g:
+                param_t[name].grad = Tensor(
+                    jax.lax.slice_in_dim(flat, off, off + sz).reshape(shape),
+                    stop_gradient=True)
+                off += sz
+        return loss_sum / K
 
     def _prime_state(self):
         """Create optimizer state ahead of tracing so state rides as

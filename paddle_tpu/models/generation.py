@@ -77,23 +77,43 @@ def _repeat_kv(x, rep):
     return jnp.repeat(x, rep, axis=2)
 
 
+_STACKED_LAYER_KEYS = {
+    "ln1": "input_layernorm.weight",
+    "q": "self_attn.q_proj.weight",
+    "k": "self_attn.k_proj.weight",
+    "v": "self_attn.v_proj.weight",
+    "o": "self_attn.o_proj.weight",
+    "ln2": "post_attention_layernorm.weight",
+    "gate": "mlp.gate_proj.weight",
+    "up": "mlp.up_proj.weight",
+    "down": "mlp.down_proj.weight",
+}
+
+
 def extract_params(model):
-    """Pull the LlamaForCausalLM weights into a pure pytree."""
+    """Pull the LlamaForCausalLM weights into a pure pytree. Scanned
+    models (FLAGS_scan_layers: ``m.layers`` is an nn.LayerStack) unstack
+    the leading axis back into the per-layer dicts the decode/prefill
+    bodies index."""
+    from ..nn.scan_stack import LayerStack
     cfg = model.config
     m = model.model if hasattr(model, "model") else model
     layers = []
-    for l in m.layers:
-        layers.append({
-            "ln1": l.input_layernorm.weight._data,
-            "q": l.self_attn.q_proj.weight._data,
-            "k": l.self_attn.k_proj.weight._data,
-            "v": l.self_attn.v_proj.weight._data,
-            "o": l.self_attn.o_proj.weight._data,
-            "ln2": l.post_attention_layernorm.weight._data,
-            "gate": l.mlp.gate_proj.weight._data,
-            "up": l.mlp.up_proj.weight._data,
-            "down": l.mlp.down_proj.weight._data,
-        })
+    if isinstance(m.layers, LayerStack):
+        stacked = {k: m.layers.stacked_parameter(n)._data
+                   for k, n in _STACKED_LAYER_KEYS.items()}
+        for i in range(m.layers.num_layers):
+            layers.append({k: v[i] for k, v in stacked.items()})
+    else:
+        def _resolve(layer, dotted):
+            obj = layer
+            for part in dotted.split("."):
+                obj = getattr(obj, part)
+            return obj
+
+        for l in m.layers:
+            layers.append({k: _resolve(l, n)._data
+                           for k, n in _STACKED_LAYER_KEYS.items()})
     params = {
         "embed": m.embed_tokens.weight._data,
         "norm": m.norm.weight._data,

@@ -45,8 +45,26 @@ class LlamaConfig:
     loss_chunk_size: int = 0
     # recompute each decoder layer's activations in backward (the 1B+
     # single-chip memory recipe: trade ~1/3 more FLOPs for O(layers) fewer
-    # live activations)
+    # live activations). Superseded by FLAGS_remat_policy (none /
+    # dots_saveable / full); kept as the legacy spelling of "full".
     remat: bool = False
+
+    def __post_init__(self):
+        if self.num_attention_heads <= 0 or \
+                self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"LlamaConfig: hidden_size ({self.hidden_size}) must be "
+                f"divisible by num_attention_heads "
+                f"({self.num_attention_heads}) — head_dim would be "
+                f"fractional and the attention reshape would fail deep "
+                f"inside the first forward")
+        if self.num_key_value_heads <= 0 or \
+                self.num_attention_heads % self.num_key_value_heads != 0:
+            raise ValueError(
+                f"LlamaConfig: num_attention_heads "
+                f"({self.num_attention_heads}) must be divisible by "
+                f"num_key_value_heads ({self.num_key_value_heads}) for "
+                f"GQA head pairing")
 
     @property
     def head_dim(self):
@@ -93,14 +111,23 @@ def init_llama_weights(root_layer, std):
     (norm scales stay at ones). The layer defaults (Xavier / N(0,1)) are
     fine standalone but wrong jointly: a N(0,1) embedding through a tied
     head produces O(sqrt(hidden)) logits at init. Shared by the dense
-    and MoE causal-LM families."""
+    and MoE causal-LM families. Scanned stacks (nn.LayerStack) hold the
+    per-layer Linears only as an unregistered template, so the recipe
+    re-draws their leading-axis-stacked weights keyed off the template
+    owner's type."""
     from ..nn.initializer import Normal
+    from ..nn.scan_stack import LayerStack
 
     init = Normal(0.0, std)
     for layer in root_layer.sublayers(include_self=True):
         w = getattr(layer, "weight", None)
         if isinstance(layer, (nn.Linear, nn.Embedding)) and w is not None:
             w._inplace_update(init(w.shape, w._data.dtype))
+        if isinstance(layer, LayerStack):
+            for _, p, owner, leaf in layer.stacked_entries():
+                if isinstance(owner, (nn.Linear, nn.Embedding)) \
+                        and leaf == "weight":
+                    p._inplace_update(init(p.shape, p._data.dtype))
 
 
 class LlamaAttention(nn.Layer):
@@ -173,18 +200,35 @@ class LlamaDecoderLayer(nn.Layer):
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
+        from ..core.flags import GLOBAL_FLAGS
         self.config = config
         self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
-        self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        layers = [LlamaDecoderLayer(config)
+                  for _ in range(config.num_hidden_layers)]
+        if GLOBAL_FLAGS.get("scan_layers"):
+            # one lax.scan over leading-axis-stacked decoder weights: HLO
+            # and trace time O(1) in depth (nn/scan_stack.py); state_dict
+            # keeps the per-layer "layers.{i}.*" names either way
+            self.layers = nn.LayerStack(layers)
+        else:
+            self.layers = nn.LayerList(layers)
         self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
+        from ..nn.scan_stack import LayerStack, effective_remat_policy
         h = self.embed_tokens(input_ids)
         # Build the RoPE cos/sin tables once and share across all layers.
         pos = position_ids if position_ids is not None else input_ids.shape[1]
         rope_cs = F.rope_tables(pos, self.config.head_dim, self.config.rope_theta)
-        if self.config.remat:
+        policy = effective_remat_policy(self.config.remat)
+        if isinstance(self.layers, LayerStack):
+            h = self.layers(h, position_ids, attn_mask, rope_cs,
+                            remat_policy=policy)
+        elif policy != "none":
+            # unrolled path: host-replay recompute (the pre-scan recipe);
+            # the tape cannot express dots_saveable, so any non-none
+            # policy recomputes the full layer here — use the scanned
+            # path for the selective policy.
             from ..distributed.fleet.recompute import recompute
             for layer in self.layers:
                 h = recompute(layer, h, position_ids, attn_mask, rope_cs)
@@ -238,9 +282,24 @@ class LlamaForCausalLM(nn.Layer):
             labels[:, 1:].reshape([-1]), reduction="mean")
         return logits, loss
 
-    def flops_per_token(self, seq_len):
-        """Approximate training FLOPs/token (6N + attention), for MFU."""
+    def flops_per_token(self, seq_len, remat_policy=None):
+        """Approximate training FLOPs/token (6N + attention), for MFU.
+
+        Under ``remat_policy='full'`` (or the legacy ``config.remat``)
+        the backward pass re-runs the decoder forward, so the hardware
+        executes one extra forward per token: +2N params FLOPs and +1/3
+        of the attention term (fwd is 4 of the 12·L·h·s total). MFU
+        reported against this number counts the FLOPs actually executed
+        instead of silently inflating tokens/s-per-FLOP.
+        ``dots_saveable`` only recomputes the cheap elementwise tail
+        (matmul outputs are saved), which this counting ignores."""
+        from ..nn.scan_stack import effective_remat_policy
         c = self.config
         n_params = sum(p.size for p in self.parameters())
         attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
-        return 6 * n_params + attn
+        total = 6 * n_params + attn
+        policy = remat_policy if remat_policy is not None \
+            else effective_remat_policy(c.remat)
+        if policy == "full":
+            total += 2 * n_params + attn // 3
+        return total

@@ -63,27 +63,43 @@ class LlamaMoeDecoderLayer(nn.Layer):
 class LlamaMoeModel(nn.Layer):
     def __init__(self, config: LlamaMoeConfig):
         super().__init__()
+        from ..core.flags import GLOBAL_FLAGS
         self.config = config
         self.embed_tokens = nn.Embedding(config.vocab_size,
                                          config.hidden_size)
-        self.layers = nn.LayerList([
+        layers = [
             LlamaMoeDecoderLayer(
                 config, use_moe=(i % config.moe_layer_interval == 0))
-            for i in range(config.num_hidden_layers)])
+            for i in range(config.num_hidden_layers)]
+        if GLOBAL_FLAGS.get("scan_layers"):
+            # scan the DENSE runs between routed layers: MoE layers
+            # mutate gate aux-loss state each forward and must stay
+            # unrolled; consecutive dense layers collapse into one
+            # lax.scan (nn/scan_stack.py). State names keep the global
+            # layer indices, so checkpoints match the unrolled layout.
+            from ..nn.scan_stack import stack_homogeneous_runs
+            self.layers = stack_homogeneous_runs(
+                layers, scannable=lambda l: isinstance(l.mlp, LlamaMLP))
+        else:
+            self.layers = nn.LayerList(layers)
         self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
+        from ..nn.scan_stack import LayerStack, effective_remat_policy
         h = self.embed_tokens(input_ids)
         pos = position_ids if position_ids is not None \
             else input_ids.shape[1]
         rope_cs = F.rope_tables(pos, self.config.head_dim,
                                 self.config.rope_theta)
-        if self.config.remat:
-            from ..distributed.fleet.recompute import recompute
-            for layer in self.layers:
+        policy = effective_remat_policy(self.config.remat)
+        for layer in self.layers:
+            if isinstance(layer, LayerStack):
+                h = layer(h, position_ids, attn_mask, rope_cs,
+                          remat_policy=policy)
+            elif policy != "none":
+                from ..distributed.fleet.recompute import recompute
                 h = recompute(layer, h, position_ids, attn_mask, rope_cs)
-        else:
-            for layer in self.layers:
+            else:
                 h = layer(h, position_ids, attn_mask, rope_cs)
         return self.norm(h)
 
@@ -91,7 +107,7 @@ class LlamaMoeModel(nn.Layer):
         """Sum of per-layer gate load-balancing losses (this forward)."""
         total = None
         for layer in self.layers:
-            al = layer.aux_loss
+            al = getattr(layer, "aux_loss", None)
             if al is None:
                 continue
             total = al if total is None else total + al
@@ -148,12 +164,19 @@ class LlamaMoeForCausalLM(nn.Layer):
             loss = loss + self.config.aux_loss_weight * aux
         return logits, loss
 
-    def flops_per_token(self, seq_len):
+    def flops_per_token(self, seq_len, remat_policy=None):
         """Active-parameter FLOPs/token: attention + top_k of the expert
-        FFNs (the MoE MFU convention) + embeddings/head."""
+        FFNs (the MoE MFU convention) + embeddings/head. Dense runs
+        scanned into a LayerStack contribute every stacked parameter
+        (all dense params are active). ``remat_policy='full'`` adds the
+        recomputed forward like the dense family."""
+        from ..nn.scan_stack import LayerStack, effective_remat_policy
         c = self.config
         active = 0
         for layer in self.model.layers:
+            if isinstance(layer, LayerStack):
+                active += sum(p.size for p in layer.parameters())
+                continue
             for p in layer.self_attn.parameters():
                 active += p.size
             mlp = layer.mlp
@@ -167,7 +190,12 @@ class LlamaMoeForCausalLM(nn.Layer):
         if self.lm_head is not None:
             active += self.lm_head.weight.size
         attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
-        return 6 * active + attn
+        total = 6 * active + attn
+        policy = remat_policy if remat_policy is not None \
+            else effective_remat_policy(c.remat)
+        if policy == "full":
+            total += 2 * active + attn // 3
+        return total
 
 
 def llama_moe_tiny_config(**overrides):

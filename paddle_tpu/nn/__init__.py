@@ -4,6 +4,7 @@ from .decode import Decoder, BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .layer.container import (  # noqa: F401
     Sequential, LayerList, LayerDict, ParameterList, ParameterDict,
 )
+from .scan_stack import LayerStack, stack_homogeneous_runs  # noqa: F401
 from .layer.common import (  # noqa: F401
     Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding, Flatten,
     Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,

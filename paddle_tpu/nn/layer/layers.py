@@ -341,6 +341,38 @@ class Layer:
         return "\n".join(lines)
 
     # ---- state dict ----
+    def _state_dict_expanders(self):
+        """Sublayers (or self) owning a custom state-dict projection
+        (``_expand_state_dict`` / ``_consume_state_dict`` — LayerStack
+        expands stacked weights back into per-layer names so checkpoints
+        stay layout-independent). Returns [(prefix, layer)]."""
+        out = []
+        for lp, layer in [("", self)] + list(self.named_sublayers()):
+            if hasattr(layer, "_expand_state_dict"):
+                out.append((lp, layer))
+        return out
+
+    def _own_state_entries(self, expanders, include_sublayers=True):
+        """(name, tensor) for every param + persistable buffer NOT owned
+        by an expander subtree — the single source both ``state_dict``
+        and ``set_state_dict`` filter through, so save and load can
+        never disagree about which names are expander-owned."""
+        skip = tuple((lp + "." if lp else "") for lp, _ in expanders)
+        own = OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            if not any(name.startswith(s) for s in skip):
+                own[name] = p
+        non_persist = set()
+        for layer_prefix, layer in [("", self)] + list(self.named_sublayers()):
+            for bname in layer._non_persistable_buffer_names:
+                full = ".".join(x for x in (layer_prefix, bname) if x)
+                non_persist.add(full)
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            if name not in non_persist and \
+                    not any(name.startswith(s) for s in skip):
+                own[name] = b
+        return own
+
     def state_dict(self, destination=None, include_sublayers=True, use_hook=True,
                    keep_vars=True):
         """``use_hook``/``keep_vars`` are accepted for parity: entries
@@ -348,21 +380,22 @@ class Layer:
         arrays are immutable, so no detach copy exists to return), and
         the reference's state-dict hooks are not a surface here."""
         dest = destination if destination is not None else OrderedDict()
-        for name, p in self.named_parameters(include_sublayers=include_sublayers):
-            dest[name] = p
-        non_persist = set()
-        for layer_prefix, layer in [("", self)] + list(self.named_sublayers()):
-            for bname in layer._non_persistable_buffer_names:
-                full = ".".join(x for x in (layer_prefix, bname) if x)
-                non_persist.add(full)
-        for name, b in self.named_buffers(include_sublayers=include_sublayers):
-            if name not in non_persist:
-                dest[name] = b
+        expanders = self._state_dict_expanders() if include_sublayers else \
+            ([("", self)] if hasattr(self, "_expand_state_dict") else [])
+        for lp, layer in expanders:
+            layer._expand_state_dict(lp, dest)
+        dest.update(self._own_state_entries(expanders, include_sublayers))
         return dest
 
     def set_state_dict(self, state_dict, use_structured_name=True):
-        own = self.state_dict()
         missing, unexpected = [], []
+        expanders = self._state_dict_expanders()
+        consumed = set()
+        for lp, layer in expanders:
+            m, c = layer._consume_state_dict(lp, state_dict)
+            missing += m
+            consumed |= c
+        own = self._own_state_entries(expanders)
         for name, target in own.items():
             if name in state_dict:
                 src = state_dict[name]
@@ -371,7 +404,7 @@ class Layer:
             else:
                 missing.append(name)
         for name in state_dict:
-            if name not in own:
+            if name not in own and name not in consumed:
                 unexpected.append(name)
         return missing, unexpected
 

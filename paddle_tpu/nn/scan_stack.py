@@ -1,0 +1,343 @@
+"""Scan-compiled homogeneous layer stacks.
+
+The unrolled transformer (``for layer in self.layers``) makes HLO size,
+trace time, and saved-activation bookkeeping all O(num_layers): every
+decoder layer re-traces the same body and XLA sees N copies of it. For a
+stack of *structurally identical* sublayers the idiomatic TPU form is one
+``jax.lax.scan`` over leading-axis-STACKED weights — the body is traced
+once, the program is O(1) in depth, and the compiler amortizes scheduling
+/ fusion work across every layer ("Operator Fusion in XLA", PAPERS.md;
+the MPK mega-kernelization argument points the same way).
+
+:class:`LayerStack` consumes N identical sublayers at construction,
+stacks each per-layer parameter pytree into one ``[N, ...]`` Parameter,
+and keeps layer 0 as an unregistered *template* whose forward is traced
+inside the scan body with the per-iteration weight slices installed.
+Autograd rides the eager dispatch layer (``core/dispatch.eager_apply``):
+the whole scan is ONE tape node whose vjp is ``jax.vjp`` of the scanned
+program, so stacked-parameter gradients arrive leading-axis-stacked and
+feed the fused optimizer as a handful of big tensors instead of
+O(num_layers) small ones.
+
+Rematerialization is a property of the scanned body:
+``FLAGS_remat_policy`` ∈ {none, dots_saveable, full} wraps the body in
+``jax.checkpoint`` (dots_saveable keeps MXU outputs and recomputes the
+cheap elementwise tail; full recomputes everything), replacing the
+ad-hoc per-model recompute recipe for scanned stacks.
+
+Checkpoint compatibility: ``state_dict`` / ``set_state_dict`` round-trip
+PER-LAYER names (``layers.3.self_attn.q_proj.weight``) by expanding /
+re-stacking the leading axis, so checkpoints written by an unrolled
+model load into a scanned one and vice versa (the Layer base class
+delegates through ``_expand_state_dict`` / ``_consume_state_dict``).
+
+Limitations (raise or are documented, never silent): sublayers with
+registered buffers are rejected (a scan body cannot commit per-layer
+buffer mutations); stateful RNG inside the body (dropout) would replay
+one traced key per iteration — decoder stacks here are dropout-free;
+tensor-parallel ``parallelize()`` expects per-layer weights, so shard
+before deciding to stack.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core import dispatch as _dispatch
+from ..core.flags import GLOBAL_FLAGS, define_flag
+from ..core.tensor import Tensor
+from .layer.layers import Layer, Parameter
+
+REMAT_POLICIES = ("none", "dots_saveable", "full")
+
+
+def _check_remat_policy(v):
+    if v not in REMAT_POLICIES:
+        raise ValueError(
+            f"FLAGS_remat_policy must be one of {REMAT_POLICIES}, got {v!r}")
+
+
+define_flag("scan_layers", bool, False,
+            "build homogeneous decoder stacks as nn.LayerStack: one "
+            "jax.lax.scan over leading-axis-stacked weights — HLO size and "
+            "trace time O(1) in depth instead of O(num_layers) "
+            "(nn/scan_stack.py); False keeps the unrolled per-layer loop")
+define_flag("remat_policy", str, "none",
+            "activation rematerialization for scanned layer stacks, applied "
+            "as jax.checkpoint over the scan body: none (save all), "
+            "dots_saveable (save MXU/matmul outputs, recompute the "
+            "elementwise tail), full (recompute the whole body in backward);"
+            " on the unrolled path any non-none policy maps to the "
+            "host-replay recompute recipe", on_set=_check_remat_policy)
+
+
+# Scoped override used by jit.TrainStep(remat_policy=...) so a single
+# compiled step can pin a policy without mutating the global flag.
+_POLICY_OVERRIDE: list = []
+
+
+class remat_policy_scope:
+    """Context manager overriding the effective remat policy."""
+
+    def __init__(self, policy):
+        _check_remat_policy(policy)
+        self.policy = policy
+
+    def __enter__(self):
+        _POLICY_OVERRIDE.append(self.policy)
+        return self
+
+    def __exit__(self, *exc):
+        _POLICY_OVERRIDE.pop()
+        return False
+
+
+def effective_remat_policy(config_remat: bool = False) -> str:
+    """Resolve the policy: TrainStep override > FLAGS_remat_policy > the
+    legacy per-model ``config.remat`` recipe (which maps to ``full``)."""
+    if _POLICY_OVERRIDE:
+        return _POLICY_OVERRIDE[-1]
+    p = GLOBAL_FLAGS.get("remat_policy")
+    if p == "none" and config_remat:
+        return "full"
+    return p
+
+
+def _checkpoint_wrap(body, policy: str):
+    if policy == "none":
+        return body
+    # prevent_cse=False: inside lax.scan the CSE hazard jax.checkpoint
+    # guards against cannot occur, and False lowers to cleaner HLO (the
+    # documented jax idiom for scan-over-layers).
+    if policy == "dots_saveable":
+        return jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_saveable,
+            prevent_cse=False)
+    return jax.checkpoint(body, prevent_cse=False)
+
+
+def _layer_spec(layer):
+    """Structural signature: (name, shape, dtype, trainable) per param."""
+    return tuple(
+        (n, tuple(p._data.shape), str(jnp.result_type(p._data)),
+         p.stop_gradient)
+        for n, p in layer.named_parameters())
+
+
+class LayerStack(Layer):
+    """N structurally identical sublayers run as one ``lax.scan``.
+
+    ``forward(carry, *args)``: ``carry`` threads through every layer
+    (hidden states); ``*args`` broadcast unchanged to each layer (masks,
+    shared RoPE tables). Parameters live leading-axis-stacked; the
+    per-layer view only exists in ``state_dict`` (expanded names) and in
+    ``stacked_parameter(name)._data[i]`` slices.
+
+    ``state_names`` (optional) sets the per-layer name each slice takes
+    in ``state_dict`` — used when a stack covers a sub-run of a larger
+    mixed container (``stack_homogeneous_runs``) and the emitted names
+    must keep the run's GLOBAL layer indices next to its unstacked
+    siblings.
+    """
+
+    def __init__(self, layers, state_names=None):
+        super().__init__()
+        layers = list(layers)
+        if not layers:
+            raise ValueError("LayerStack needs at least one sublayer")
+        if state_names is not None and len(state_names) != len(layers):
+            raise ValueError("state_names must name every stacked layer")
+        spec0 = _layer_spec(layers[0])
+        for i, l in enumerate(layers):
+            if list(l.named_buffers()):
+                raise ValueError(
+                    "LayerStack: sublayer has registered buffers — a scan "
+                    "body cannot commit per-layer buffer mutations; keep "
+                    "such layers unrolled")
+            if _layer_spec(l) != spec0:
+                raise ValueError(
+                    f"LayerStack: sublayer {i} is not structurally "
+                    f"identical to sublayer 0 (parameter names/shapes/"
+                    f"dtypes must match exactly)")
+        if not spec0:
+            raise ValueError("LayerStack: sublayers have no parameters")
+        self.num_layers = len(layers)
+        self._param_names = [n for n, _, _, _ in spec0]
+        per_layer = [dict(l.named_parameters()) for l in layers]
+        for n, shape, _, sg in spec0:
+            stacked = jnp.stack([d[n]._data for d in per_layer])
+            self._parameters[n] = Parameter(stacked, trainable=not sg,
+                                            name=f"stacked.{n}")
+        # Layer 0 survives as the body template: unregistered (its params
+        # must not shadow the stacked ones), and its arrays are replaced
+        # with zero-byte placeholders so the only live copy of the
+        # weights is the stacked one.
+        template = layers[0]
+        tparams = dict(template.named_parameters())
+        for n, p in tparams.items():
+            shape = tuple(p._data.shape)
+            dt = jnp.result_type(p._data)
+            p._data = np.broadcast_to(np.zeros((), dt), shape)
+        object.__setattr__(self, "_template", template)
+        object.__setattr__(self, "_template_params", tparams)
+        self._state_names = ([str(s) for s in state_names]
+                             if state_names is not None
+                             else [str(i) for i in range(len(layers))])
+        self._emit_in_parent = state_names is not None
+
+    def __len__(self):
+        return self.num_layers
+
+    # ---- accessors -----------------------------------------------------
+    def stacked_parameter(self, name) -> Parameter:
+        return self._parameters[name]
+
+    def stacked_entries(self):
+        """Yield (param_name, stacked_param, template_owner_layer,
+        leaf_name) — lets init recipes (init_llama_weights) key off the
+        owning template layer's type."""
+        for n in self._param_names:
+            owner = self._template
+            parts = n.split(".")
+            for part in parts[:-1]:
+                owner = getattr(owner, part)
+            yield n, self._parameters[n], owner, parts[-1]
+
+    # ---- train/eval propagate to the unregistered template -------------
+    def train(self):
+        super().train()
+        self._template.train()
+        return self
+
+    def eval(self):
+        super().eval()
+        self._template.eval()
+        return self
+
+    # ---- forward: one scan, one tape node ------------------------------
+    def forward(self, carry, *args, remat_policy=None):
+        policy = remat_policy if remat_policy is not None \
+            else effective_remat_policy()
+        _check_remat_policy(policy)
+        stacked = {n: self._parameters[n] for n in self._param_names}
+        pure = self._pure_scan(policy)
+        return _dispatch.eager_apply(
+            f"scan_stack{self.num_layers}", pure, (carry, stacked, args), {})
+
+    def _pure_scan(self, policy):
+        template = self._template
+        tparams = self._template_params
+
+        def pure(carry, stacked_arrays, extra):
+            def body(c, xs):
+                saved = {n: p._data for n, p in tparams.items()}
+                try:
+                    for n, p in tparams.items():
+                        p._data = xs[n]
+                    wrapped = jax.tree.map(
+                        lambda a: Tensor(a)
+                        if isinstance(a, (jax.Array, np.ndarray)) else a,
+                        extra)
+                    # no_grad: inside jax.vjp's trace the tape must not
+                    # record — JAX AD differentiates the whole scan.
+                    with _ag.no_grad():
+                        out = template(Tensor(c), *wrapped)
+                    return (out._data if isinstance(out, Tensor) else out,
+                            None)
+                finally:
+                    for n, p in tparams.items():
+                        p._data = saved[n]
+
+            out, _ = jax.lax.scan(_checkpoint_wrap(body, policy),
+                                  carry, stacked_arrays)
+            return out
+
+        return pure
+
+    # ---- state_dict bridge: per-layer names <-> stacked storage --------
+    def _emit_base(self, prefix):
+        if not self._emit_in_parent:
+            return prefix
+        return prefix.rsplit(".", 1)[0] if "." in prefix else ""
+
+    def _expand_state_dict(self, prefix, dest):
+        base = self._emit_base(prefix)
+        for i in range(self.num_layers):
+            for n in self._param_names:
+                full = ".".join(
+                    x for x in (base, self._state_names[i], n) if x)
+                dest[full] = Tensor(self._parameters[n]._data[i],
+                                    stop_gradient=True)
+
+    def _consume_state_dict(self, prefix, state):
+        base = self._emit_base(prefix)
+        missing, consumed = [], set()
+        for n in self._param_names:
+            parts, ok = [], True
+            for i in range(self.num_layers):
+                full = ".".join(
+                    x for x in (base, self._state_names[i], n) if x)
+                if full in state:
+                    src = state[full]
+                    parts.append(src._data if isinstance(src, Tensor)
+                                 else jnp.asarray(src))
+                    consumed.add(full)
+                else:
+                    missing.append(full)
+                    ok = False
+            if ok:
+                p = self._parameters[n]
+                per_shape = tuple(p._data.shape[1:])
+                dt = jnp.result_type(p._data)
+                p._inplace_update(jnp.stack(
+                    [jnp.asarray(a).astype(dt).reshape(per_shape)
+                     for a in parts]))
+        return missing, consumed
+
+    def extra_repr(self):
+        return (f"num_layers={self.num_layers}, "
+                f"template={type(self._template).__name__}")
+
+
+def stack_homogeneous_runs(layers, scannable=None, min_run=2):
+    """Pack consecutive runs of structurally identical, scannable layers
+    into :class:`LayerStack` entries of a ``LayerList``-style container.
+
+    Used by mixed stacks (MoE models: the routed layers mutate
+    ``aux_loss`` state and must stay unrolled, the dense runs between
+    them scan). ``scannable(layer) -> bool`` gates which layers may
+    enter a stack; runs shorter than ``min_run`` stay unrolled. Emitted
+    state names keep GLOBAL layer indices, so checkpoints are identical
+    to the fully unrolled container's.
+    """
+    from .layer.container import LayerList
+
+    layers = list(layers)
+    ok = [bool(scannable(l)) if scannable is not None else True
+          for l in layers]
+    specs = [_layer_spec(l) if (ok[i] and not list(l.named_buffers()))
+             else None for i, l in enumerate(layers)]
+    out = LayerList()
+    i = 0
+    while i < len(layers):
+        j = i
+        while (j < len(layers) and specs[j] is not None
+               and specs[j] == specs[i]):
+            j += 1
+        if specs[i] is not None and j - i >= min_run:
+            out.add_sublayer(
+                f"{i}_{j - 1}",
+                LayerStack(layers[i:j],
+                           state_names=[str(k) for k in range(i, j)]))
+        else:
+            for k in range(i, max(j, i + 1)):
+                out.add_sublayer(str(k), layers[k])
+            j = max(j, i + 1)
+        i = j
+    return out
+
+
+__all__ = ["LayerStack", "stack_homogeneous_runs", "remat_policy_scope",
+           "effective_remat_policy", "REMAT_POLICIES"]

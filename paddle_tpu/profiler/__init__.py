@@ -240,8 +240,36 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+class compile_event:
+    """Span marking a compilation (trace + lower + build) on the host
+    timeline, named ``compile:<what>``.
+
+    Used by ``jit.TrainStep`` around each first-call trace so recompiles
+    caused by shape / flag changes show up next to the pipeline gauges
+    instead of masquerading as one silently slow step. ``.ms`` carries
+    the measured wall time after exit (dispatch of the compiled call is
+    synchronous through tracing/lowering; execution stays async, so the
+    span measures compilation, not the step)."""
+
+    def __init__(self, what):
+        self.name = f"compile:{what}"
+        self.ms = None
+        self._tok = 0
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._tok = _nv.prof_begin(self.name, 2)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.ms = (time.perf_counter() - self._t0) * 1e3
+        _nv.prof_end(self._tok)
+        return False
+
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing"]
+           "make_scheduler", "export_chrome_tracing", "compile_event"]
 
 
 class SortedKeys(enum.Enum):

@@ -40,6 +40,61 @@ def test_simulated_backend_hang_names_the_stage():
     assert "imports_done" in names     # the stall is AFTER imports
 
 
+def test_stale_artifact_nulls_per_run_fields(monkeypatch):
+    """Round-6: when every attempt failed and the artifact falls back to
+    stale data, ``vs_baseline`` passes through from the stale source
+    unchanged, but fields measured per-run (compile_ms, peak_hbm_bytes,
+    remat_policy, accumulate_steps) must be null — a stale artifact must
+    never fabricate a measurement the failed run did not make (BENCH_r05
+    is such a stale-source run)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+
+    stale_parsed = {"value": 70000.0, "vs_baseline": 0.8333, "mfu": 0.375,
+                    "device": "TPU v5 lite", "step_ms": 110.0,
+                    "compile_ms": 1234.5, "peak_hbm_bytes": 7 << 30,
+                    "remat_policy": "full", "accumulate_steps": 4}
+    monkeypatch.setattr(bench, "_last_good_round",
+                        lambda: ("BENCH_r05.json", stale_parsed))
+    out = bench._failure_artifact("timeout after 600s",
+                                  [{"stage": "backend_probing"}])
+    assert out["stale"] is True
+    assert out["stale_source"] == "BENCH_r05.json"
+    assert out["vs_baseline"] == 0.8333          # unchanged pass-through
+    assert out["value"] == 70000.0
+    for k in ("compile_ms", "peak_hbm_bytes", "remat_policy",
+              "accumulate_steps"):
+        assert out[k] is None, k                 # never fabricated
+    # and with no stale source at all, the nulls (and 0.0) survive
+    monkeypatch.setattr(bench, "_last_good_round", lambda: None)
+    out = bench._failure_artifact("err", [])
+    assert out["value"] == 0.0 and out["compile_ms"] is None
+    assert "stale" not in out
+
+
+def test_peak_hbm_probe_never_fabricates():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+
+    class NoStats:
+        def memory_stats(self):
+            raise NotImplementedError
+
+    class EmptyStats:
+        def memory_stats(self):
+            return {}
+
+    class WithPeak:
+        def memory_stats(self):
+            return {"peak_bytes_in_use": 123, "bytes_in_use": 7}
+
+    assert bench._peak_hbm_bytes(NoStats()) is None
+    assert bench._peak_hbm_bytes(EmptyStats()) is None
+    assert bench._peak_hbm_bytes(WithPeak()) == 123
+
+
 def test_lastgood_history_preserved(tmp_path, monkeypatch):
     """Dated last-good records append to history — a worse re-record
     never erases a better older number (round-4 weak #8)."""
