@@ -333,6 +333,15 @@ def _probe_serving(paddle, wave=6, max_new=4):
     - ``decode_compiles``: decode executables built across BOTH waves —
       bounded by #shape buckets (tests/test_serving_compile_gate.py), so
       a trajectory jump here flags per-composition recompilation.
+    The low-bit serving path rides the same waves on a SECOND engine
+    (weight_only_int8 params + int8 paged KV):
+    - ``quantized_decode_tokens_per_s``: the quantized engine's measured
+      wave-2 throughput;
+    - ``weight_bytes``: resident bytes of the quantized param pytree
+      (int8 payloads + scales), vs the fp pytree's 4x;
+    - ``kv_bytes_per_token``: pool bytes one cached token occupies (int8
+      pages + amortized per-page scales);
+    - ``quantized_mode``: the mode the probe ran.
     Micro-sized by design (1 layer, d=128): the probe measures the
     engine's batching/dispatch layer, not model FLOPs, and must not eat
     the bench child's timeout budget.
@@ -352,33 +361,61 @@ def _probe_serving(paddle, wave=6, max_new=4):
         lengths = [3, 5, 8, 11, 14, 17][:wave]
         peak_util = 0.0
 
-        def _wave():
+        def _wave(e):
             nonlocal peak_util
             for n in lengths:
-                eng.add_request(rng.integers(0, 256, (n,)).tolist(),
-                                max_new_tokens=max_new)
+                e.add_request(rng.integers(0, 256, (n,)).tolist(),
+                              max_new_tokens=max_new)
             steps = 0
-            while eng.has_unfinished():
-                eng.step()
-                peak_util = max(peak_util, eng.pool.utilization)
+            while e.has_unfinished():
+                e.step()
+                peak_util = max(peak_util, e.pool.utilization)
                 steps += 1
                 assert steps < 500
 
-        _wave()                                   # warmup: compiles
-        tok0 = eng.metrics.tokens_generated.value
-        t0 = _time.perf_counter()
-        _wave()                                   # measured steady state
-        dt = _time.perf_counter() - t0
-        tokens = eng.metrics.tokens_generated.value - tok0
-        return {
-            "serving_tokens_per_s": round(tokens / dt, 1),
+        def _measure(e):
+            _wave(e)                              # warmup: compiles
+            tok0 = e.metrics.tokens_generated.value
+            t0 = _time.perf_counter()
+            _wave(e)                              # measured steady state
+            dt = _time.perf_counter() - t0
+            return (e.metrics.tokens_generated.value - tok0) / dt
+
+        tok_s = _measure(eng)
+        out = {
+            "serving_tokens_per_s": round(tok_s, 1),
             "kv_page_utilization": round(peak_util, 4),
             "decode_compiles": eng.decode_cache_size(),
         }
+        try:
+            from paddle_tpu.quantization import params_weight_bytes
+            mode = "weight_only_int8"
+            engq = LLMEngine(model, max_len=64, page_size=8,
+                             batch_buckets=(1, 2, 4, 8),
+                             quantized_mode=mode, kv_cache_dtype="int8")
+            q_tok_s = _measure(engq)
+            out.update({
+                "quantized_mode": mode,
+                "weight_bytes": params_weight_bytes(engq.params),
+                "kv_bytes_per_token": round(
+                    engq.pool.kv_bytes_per_token, 1),
+                "quantized_decode_tokens_per_s": round(q_tok_s, 1),
+            })
+        except Exception as e:  # null, never fabricated
+            out.update({
+                "quantized_mode": None, "weight_bytes": None,
+                "kv_bytes_per_token": None,
+                "quantized_decode_tokens_per_s": None,
+                "quantized_probe_error": f"{type(e).__name__}: {e}",
+            })
+        return out
     except Exception as e:  # the probe must never sink the bench artifact
         return {"serving_tokens_per_s": 0.0,
                 "kv_page_utilization": 0.0,
                 "decode_compiles": -1,
+                "quantized_mode": None, "weight_bytes": None,
+                "kv_bytes_per_token": None,
+                "quantized_decode_tokens_per_s": None,
                 "serving_probe_error": f"{type(e).__name__}: {e}"}
 
 
@@ -472,12 +509,40 @@ def _read_progress(path):
         return []
 
 
+def _stage_ms(stages):
+    """Per-stage elapsed ms from the heartbeat trail: how long the child
+    spent IN each stage (delta to the next mark; the last stage's
+    duration is unknown — the child died or finished inside it — and
+    reads null, never fabricated)."""
+    out = []
+    for i, s in enumerate(stages):
+        t1 = stages[i + 1].get("t") if i + 1 < len(stages) else None
+        out.append({
+            "stage": s.get("stage"),
+            "ms": round((t1 - s.get("t", 0.0)) * 1e3, 1)
+            if t1 is not None else None,
+        })
+    return out
+
+
+def _backend_probe_budget() -> float:
+    """The backend probe's own sub-timeout: jax.devices() against a wedged
+    pool hangs in native code and would otherwise burn the WHOLE child
+    budget (BENCH_r05: all 300 s died in backend_probing). A child still
+    sitting in "backend_probing" past this budget is killed early and the
+    parent falls through to the last-good artifact immediately — no
+    retry, the pool will not unwedge between tries."""
+    return float(os.environ.get("PADDLE_TPU_BENCH_BACKEND_TIMEOUT", "90"))
+
+
 def _run_child(budget, extra_args=()):
     """Run one bench child under a wall-clock budget.
 
     Returns (payload_or_None, error_str, stages). The progress file gives
     post-hoc forensics: a timeout with no "backend_up" stage is a wedged
-    pool; "backend_up" without "compiled" is a compile blowup.
+    pool; "backend_up" without "compiled" is a compile blowup. The child
+    is watched while it runs: a stall inside the backend probe trips the
+    shorter ``_backend_probe_budget`` instead of the full ``budget``.
     """
     progress_path = f"/tmp/paddle_tpu_bench_progress_{os.getpid()}_{time.time_ns()}"
     env = dict(os.environ, **{_PROGRESS_ENV: progress_path})
@@ -486,18 +551,52 @@ def _run_child(budget, extra_args=()):
         # (sitecustomize) can hang against a wedged pool even when
         # JAX_PLATFORMS=cpu, so disable it entirely for the child.
         env["PALLAS_AXON_POOL_IPS"] = ""
+    backend_budget = _backend_probe_budget()
+    out_path = progress_path + ".out"
+    err_path = progress_path + ".err"
     try:
-        try:
-            proc = subprocess.run(
+        # output goes to files, not pipes: the watcher loop must never
+        # deadlock against a child blocked on a full pipe buffer
+        with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+            child = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--child",
                  *extra_args],
-                capture_output=True, text=True, timeout=budget, env=env)
-        except subprocess.TimeoutExpired:
-            stages = _read_progress(progress_path)
-            reached = stages[-1]["stage"] if stages else "none"
-            return (None,
-                    f"timeout after {budget}s (last stage: {reached})",
-                    stages)
+                stdout=out_f, stderr=err_f, text=True, env=env)
+            t0 = time.monotonic()
+            timed_out = backend_hang = False
+            while True:
+                try:
+                    child.wait(timeout=2.0)
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                elapsed = time.monotonic() - t0
+                if elapsed > budget:
+                    timed_out = True
+                else:
+                    stages = _read_progress(progress_path)
+                    if stages and stages[-1]["stage"] == "backend_probing" \
+                            and elapsed - stages[-1].get("t", 0.0) \
+                            > backend_budget:
+                        timed_out = backend_hang = True
+                if timed_out:
+                    child.kill()
+                    try:
+                        child.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    stages = _read_progress(progress_path)
+                    reached = stages[-1]["stage"] if stages else "none"
+                    if backend_hang:
+                        return (None,
+                                f"backend probe exceeded its "
+                                f"{backend_budget:g}s sub-timeout "
+                                f"(last stage: {reached})", stages)
+                    return (None, f"timeout after {budget}s "
+                                  f"(last stage: {reached})", stages)
+        with open(out_path) as f_out, open(err_path) as f_err:
+            proc = subprocess.CompletedProcess(
+                child.args, child.returncode, f_out.read(), f_err.read())
         stages = _read_progress(progress_path)
         for line in proc.stdout.splitlines():
             if line.startswith(_SENTINEL):
@@ -512,10 +611,11 @@ def _run_child(budget, extra_args=()):
         err = tail[-1] if tail else f"child exited rc={proc.returncode}"
         return None, err, stages
     finally:
-        try:
-            os.unlink(progress_path)
-        except OSError:
-            pass
+        for p in (progress_path, out_path, err_path):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def _last_good_round():
@@ -568,9 +668,15 @@ def main():
             # opportunistic second config: the >=1B-param point
             # (remat + bf16) the round-2 verdict asked for
             payload["llama_1b"] = _run_1b_config()
+            payload["stage_ms"] = _stage_ms(stages)
             print(json.dumps(payload))
             return
         last_err, last_stages = err, stages
+        if "backend probe exceeded" in (err or ""):
+            # a wedged pool will not unwedge between tries: fall through
+            # to the last-good artifact immediately instead of burning
+            # the retry budget in the same native hang
+            break
         time.sleep(5.0)
     print(json.dumps(_failure_artifact(last_err, last_stages)))
 
@@ -589,10 +695,17 @@ def _failure_artifact(last_err, last_stages):
         "vs_baseline": 0.0,
         "error": last_err,
         "stages": [s.get("stage") for s in last_stages],
+        "stage_ms": _stage_ms(last_stages),
         "compile_ms": None,
         "peak_hbm_bytes": None,
         "remat_policy": None,
         "accumulate_steps": None,
+        # low-bit serving fields are measured per-run: a stale artifact
+        # must carry nulls, never the stale round's numbers
+        "quantized_mode": None,
+        "weight_bytes": None,
+        "kv_bytes_per_token": None,
+        "quantized_decode_tokens_per_s": None,
     }
     good = _last_good_round()
     if good:

@@ -233,6 +233,25 @@ define_flag("async_inflight_steps", int, 8,
 define_flag("sot_specialization_cache_size", int, 32,
             "max SOT-lite branch specializations kept per input signature "
             "(LRU eviction; the reference's sot guard-cache bound)")
+define_flag("quantized_allreduce", bool, False,
+            "route float SUM/AVG gradient all-reduces through chunk-wise "
+            "int8 (per-chunk scale exchanged alongside the payload, "
+            "EQuARX-style; distributed/collective.py). Off by default: "
+            "the False path is bit-identical to the plain DP grad sync")
+define_flag("quantized_allreduce_chunk_elems", int, 65536,
+            "elements per int8 chunk in the quantized all-reduce (one "
+            "fp32 scale per chunk; smaller chunks = tighter error, more "
+            "scale overhead)")
+define_flag("quantized_allreduce_min_elems", int, 2048,
+            "smallest float buffer the quantized all-reduce engages on; "
+            "smaller reductions (loss scalars, metrics) stay exact — "
+            "they are latency-, not bandwidth-bound, and eval fidelity "
+            "is worth more than their bytes")
+define_flag("quantized_allreduce_error_feedback", bool, True,
+            "carry the local quantization residual into the next "
+            "quantized all-reduce of the same buffer (error feedback; "
+            "needs a stable buffer key — fused_allreduce_gradients keys "
+            "its dtype buckets)")
 define_flag("jit_auto_while", bool, True,
             "to_static: source-rewrite safe tensor-dependent Python while "
             "loops to lax.while_loop (compile once for all trip counts; "

@@ -16,7 +16,8 @@ from .collective import (  # noqa: F401
     ReduceOp, Group, all_reduce, all_gather, all_gather_object, all_to_all,
     reduce_scatter, broadcast, reduce, scatter, send, recv, barrier,
     get_rank, get_world_size, init_parallel_env, is_initialized, new_group,
-    destroy_process_group,
+    destroy_process_group, quantized_all_reduce_sum,
+    reset_quantized_allreduce_residuals,
 )
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from .sharding import (  # noqa: F401

@@ -288,13 +288,165 @@ def _np_reduce(rows, op):
     raise ValueError(f"unknown reduce op {op!r}")
 
 
+# ---- quantized gradient all-reduce (EQuARX, arxiv: Efficient Quantized
+# AllReduce in XLA). DP grad sync is bandwidth-bound exactly like decode:
+# the payload each rank moves per step is the full gradient footprint, so
+# int8 chunks + one fp32 scale per chunk cut the bytes ~4x. Off by
+# default (FLAGS_quantized_allreduce); the disabled path is bit-identical
+# to the plain sync. ----
+
+
+def _quant_chunk_elems() -> int:
+    return max(int(GLOBAL_FLAGS.get("quantized_allreduce_chunk_elems")), 1)
+
+
+def chunk_quantize(arr, chunk_elems=None):
+    """Symmetric per-chunk int8 quantization of a host fp buffer.
+
+    Returns ``(q [C, chunk] int8, scales [C] f32, n)`` — the payload +
+    sideband a rank actually ships. One fp32 scale per ``chunk_elems``
+    values bounds the relative error per element by ~1/254 of the chunk's
+    amax (round-to-nearest over 127 steps). The chunk never exceeds the
+    buffer: a small buffer ships small (no 64Ki zero-pad for a scalar).
+    """
+    chunk = chunk_elems or _quant_chunk_elems()
+    a = np.asarray(arr, np.float32).ravel()
+    n = a.size
+    chunk = min(chunk, max(n, 1))
+    pad = (-n) % chunk
+    if pad:
+        a = np.concatenate([a, np.zeros(pad, np.float32)])
+    a2 = a.reshape(-1, chunk)
+    scales = (np.maximum(np.abs(a2).max(axis=1), 1e-30) / 127.0) \
+        .astype(np.float32)
+    q = np.clip(np.rint(a2 / scales[:, None]), -127, 127).astype(np.int8)
+    return q, scales, n
+
+
+def chunk_dequantize(q, scales, n):
+    return (q.astype(np.float32) * scales[:, None]).ravel()[:n]
+
+
+#: error-feedback residuals keyed by caller-stable buffer name: the part
+#: of the local gradient the int8 payload could not carry is re-injected
+#: into the NEXT round's payload instead of being lost (EQuARX §error
+#: feedback) — over steps the quantization bias cancels instead of
+#: accumulating in the optimizer state
+_EF_RESIDUALS: dict = {}
+
+
+def reset_quantized_allreduce_residuals():
+    _EF_RESIDUALS.clear()
+
+
+def _quantized_sum_payloads(payloads, n):
+    """Dequantize-and-sum every rank's (q, scales) payload — the reduce
+    half each rank runs locally after the exchange (split out so the
+    error-bound gate can drive it without processes)."""
+    out = None
+    for q, scales in payloads:
+        d = q.astype(np.float32) * scales[:, None]
+        out = d if out is None else out + d
+    return out.ravel()[:n]
+
+
+def quantized_all_reduce_sum(a, group=None, error_feedback_key=None):
+    """Chunk-wise int8 SUM all-reduce of one host fp buffer.
+
+    Each rank quantizes its LOCAL value (plus any carried residual) into
+    int8 chunks, ships payload + per-chunk scales, and sums the
+    dequantized contributions — one quantization error per rank per
+    element, never compounded through the reduction tree. World size 1 is
+    the identity (no quantization: nothing travels, so nothing is cut).
+    """
+    arr = np.asarray(a, np.float32)
+    if not _mp_active():
+        return arr
+    if _nonmember_noop(group):   # same warn+no-op contract as all_reduce
+        return arr
+    ranks = _group_ranks(group)
+    local = arr
+    use_ef = error_feedback_key is not None and \
+        GLOBAL_FLAGS.get("quantized_allreduce_error_feedback")
+    if use_ef:
+        res = _EF_RESIDUALS.get(error_feedback_key)
+        if res is not None and res.shape == arr.shape:
+            local = arr + res
+    q, scales, n = chunk_quantize(local)
+    if use_ef:
+        _EF_RESIDUALS[error_feedback_key] = \
+            (local.ravel() - chunk_dequantize(q, scales, n)) \
+            .reshape(arr.shape)
+    if not _is_global(ranks):
+        payloads = _subgroup_exchange((q, scales), group, ranks)
+    else:
+        from jax.experimental import multihost_utils
+        from .watchdog import maybe_track
+        with maybe_track("quantized_allreduce",
+                         meta={"rank": get_rank(), "bytes": q.nbytes}):
+            # ONE collective launch: payload + scale sideband travel as a
+            # pytree through the same all-gather
+            q_rows, s_rows = multihost_utils.process_allgather((q, scales))
+        payloads = [(q_rows[r], s_rows[r]) for r in ranks]
+    return _quantized_sum_payloads(payloads, n).reshape(arr.shape)
+
+
+def _quantized_model_jnp(a):
+    """In a shard_map/manual region the collective itself is an XLA HLO —
+    int8 payload framing needs a compiler pass there (EQuARX is one), so
+    this regime models the numerics: each rank's contribution is chunk-
+    quantized BEFORE the psum, giving the same per-rank error contract as
+    the eager int8 exchange (parity between regimes is what the tests
+    pin)."""
+    chunk = _quant_chunk_elems()
+    flat = a.astype(jnp.float32).ravel()
+    n = flat.size
+    pad = (-n) % chunk
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, jnp.float32)])
+    a2 = flat.reshape(-1, chunk)
+    scales = jnp.maximum(jnp.max(jnp.abs(a2), axis=1), 1e-30) / 127.0
+    q = jnp.clip(jnp.round(a2 / scales[:, None]), -127, 127)
+    deq = (q * scales[:, None]).ravel()[:n]
+    return deq.reshape(a.shape).astype(a.dtype)
+
+
+def _quantized_route(a, op) -> bool:
+    """Does FLAGS_quantized_allreduce apply to this value/op?
+
+    The flag is a global collective transform (the EQuARX shape: an
+    in-XLA pass would see every all-reduce), but only BANDWIDTH-BOUND
+    reductions profit: buffers below ``quantized_allreduce_min_elems``
+    (loss scalars, metric reductions) stay exact — quantizing them buys
+    nothing and costs eval fidelity.
+    """
+    if not GLOBAL_FLAGS.get("quantized_allreduce"):
+        return False
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        return False
+    if not np.issubdtype(np.dtype(getattr(a, "dtype", np.float32)),
+                         np.floating):
+        return False
+    size = int(np.prod(getattr(a, "shape", ()) or (1,)))
+    return size >= int(GLOBAL_FLAGS.get("quantized_allreduce_min_elems"))
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place all-reduce (reference: process_group.h AllReduce;
-    python/paddle/distributed/communication/all_reduce.py)."""
+    python/paddle/distributed/communication/all_reduce.py).
+
+    ``FLAGS_quantized_allreduce`` reroutes float SUM/AVG reductions
+    through the chunk-wise int8 path (grad sync's bandwidth cut); the
+    flag off, this body is untouched — bit-identical to the plain sync.
+    """
     axis = _get_axis(group)
 
     def fn(a):
         if _in_manual_region(axis):
+            if _quantized_route(a, op):
+                aq = _quantized_model_jnp(a)
+                return lax.psum(aq, axis) if op == ReduceOp.SUM \
+                    else lax.pmean(aq, axis)
             if op == ReduceOp.SUM:
                 return lax.psum(a, axis)
             if op == ReduceOp.MAX:
@@ -308,7 +460,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if _mp_active():
             if _nonmember_noop(group):
                 return a
-            out = _np_reduce(_gather_rows(a, group), op)
+            if _quantized_route(a, op):
+                out = quantized_all_reduce_sum(np.asarray(a), group)
+                if op == ReduceOp.AVG:
+                    out = out / len(_group_ranks(group))
+            else:
+                out = _np_reduce(_gather_rows(a, group), op)
             return jnp.asarray(out.astype(
                 getattr(a, "dtype", np.asarray(a).dtype), copy=False))
         return a  # world size 1: reduction of one value

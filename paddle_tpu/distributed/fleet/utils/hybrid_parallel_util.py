@@ -6,8 +6,13 @@ used with no_sync()-style accumulation, or by models whose layers
 bypass DataParallel's reducer."""
 from __future__ import annotations
 
+import numpy as np
+import jax.numpy as jnp
+
+from ....core.flags import GLOBAL_FLAGS
 from ....core.tensor import Tensor
-from ...collective import all_reduce, broadcast, get_world_size
+from ...collective import (all_reduce, broadcast, get_world_size,
+                           quantized_all_reduce_sum)
 
 
 def _group_of(hcg, kind):
@@ -28,19 +33,56 @@ def fused_allreduce_gradients(parameter_list, hcg):
     """All-reduce every parameter's gradient over the data-parallel group
     (reference :262; the 'fused' in the reference name is its multi-tensor
     coalescing — one XLA all-reduce per grad is already a single fused
-    collective per buffer here, and PJRT batches the launches)."""
+    collective per buffer here, and PJRT batches the launches).
+
+    Under ``FLAGS_quantized_allreduce`` the sync goes through the
+    fused-optimizer bucket discipline instead: grads are concatenated
+    into ONE flat buffer per grad dtype (the same dtype-bucket layout
+    optimizer/fused.py flattens into) and each bucket ships as chunk-wise
+    int8 + per-chunk scales, with the error-feedback residual keyed per
+    bucket — O(#dtype buckets) quantized exchanges, not one per param.
+    The flag off, this body is the untouched per-param path
+    (bit-identical to the pre-flag sync).
+    """
     group = _group_of(hcg, "dp")
     world = get_world_size() if group is None else len(
         getattr(group, "ranks", [])) or get_world_size()
     if world <= 1:
         return
     scale = 1.0 / world
+    if GLOBAL_FLAGS.get("quantized_allreduce"):
+        _quantized_bucket_allreduce(parameter_list, group, scale)
+        return
     for p in parameter_list:
         if p.grad is None:
             continue
         g = Tensor(p.grad._data)
         all_reduce(g, group=group)
         p.grad = Tensor(g._data * scale, stop_gradient=True)
+
+
+def _quantized_bucket_allreduce(parameter_list, group, scale):
+    """One chunk-quantized int8 exchange per grad-dtype bucket."""
+    buckets: dict = {}
+    for p in parameter_list:
+        if p.grad is None:
+            continue
+        buckets.setdefault(str(jnp.result_type(p.grad._data)),
+                           []).append(p)
+    for i, (dts, params) in enumerate(sorted(buckets.items())):
+        flat = np.concatenate(
+            [np.asarray(p.grad._data, np.float32).ravel() for p in params])
+        red = quantized_all_reduce_sum(
+            flat, group, error_feedback_key=f"dp_grads/{i}/{dts}") * scale
+        off = 0
+        for p in params:
+            # np.prod(()) == 1.0 covers scalars; a zero-size grad must
+            # slice 0 elements, not 1
+            sz = int(np.prod(p.grad._data.shape))
+            p.grad = Tensor(
+                jnp.asarray(red[off:off + sz].reshape(p.grad._data.shape),
+                            dtype=dts), stop_gradient=True)
+            off += sz
 
 
 def broadcast_mp_parameters(model, hcg):

@@ -27,6 +27,7 @@ import jax
 
 from .flash_attention import flash_attention as pallas_flash_attention
 from .fused_adamw import fused_adamw as pallas_fused_adamw
+from .int8_matmul import dequant_matmul as pallas_dequant_matmul
 from .rms_norm import rms_norm as pallas_rms_norm
 
 
@@ -223,4 +224,4 @@ def install():
 
 
 __all__ = ["pallas_flash_attention", "pallas_rms_norm",
-           "pallas_fused_adamw", "install"]
+           "pallas_fused_adamw", "pallas_dequant_matmul", "install"]

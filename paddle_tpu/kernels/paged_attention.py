@@ -33,8 +33,10 @@ _NEG_INF = -1e30
 
 
 def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-            m_ref, l_ref, acc_ref, *, page_size, scale):
+            m_ref, l_ref, acc_ref, *, page_size, scale,
+            ks_ref=None, vs_ref=None):
     b = pl.program_id(0)
+    h = pl.program_id(1)
     p = pl.program_id(2)
 
     @pl.when(p == 0)
@@ -51,6 +53,13 @@ def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)        # [group, d]
         k = k_ref[0, 0].astype(jnp.float32)        # [ps, d]
         v = v_ref[0, 0].astype(jnp.float32)
+        if ks_ref is not None:
+            # int8 pool: dequantize the DMA'd page with its own
+            # per-(head, page) scale — a scalar read off the prefetch
+            # channel (SMEM), indexed by the same pool page the DMA read
+            page = tbl_ref[b, p]
+            k = k * ks_ref[h, page]
+            v = v * vs_ref[h, page]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [group, ps]
@@ -74,14 +83,28 @@ def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                        jnp.maximum(l_ref[...], 1e-30)).astype(o_ref.dtype)
 
 
+def _kernel_quant(tbl_ref, len_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref,
+                  o_ref, m_ref, l_ref, acc_ref, *, page_size, scale):
+    """int8-pool variant: the per-(head, page) dequant scales ride the
+    scalar-prefetch channel (SMEM) as operands 3 and 4."""
+    _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+            acc_ref, page_size=page_size, scale=scale,
+            ks_ref=ks_ref, vs_ref=vs_ref)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
-                    scale=None, interpret=False):
+                    scale=None, interpret=False, k_scales=None,
+                    v_scales=None):
     """Single-token decode attention over a paged KV cache.
 
     q:            [batch, num_q_heads, head_dim]
     k_pages/v_pages: [num_kv_heads, num_pages, page_size, head_dim]
     block_tables: [batch, pages_per_seq] int32 pool-page ids
     seq_lens:     [batch] int32 valid KV length per sequence
+    k_scales/v_scales: [num_kv_heads, num_pages] fp32 per-(head, page)
+        dequant scales for int8 pools (both or neither); pages are
+        dequantized in-kernel right after the DMA, so the fp pool never
+        materializes in HBM.
     Returns [batch, num_q_heads, head_dim].
     """
     b, hq, d = q.shape
@@ -90,23 +113,29 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
         raise ValueError(f"head_dim mismatch: q {d} vs pages {dk}")
     if hq % hkv != 0:
         raise ValueError(f"num_q_heads {hq} not a multiple of kv heads {hkv}")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be given together")
     group = hq // hkv
     pages_per_seq = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    quantized = k_scales is not None
 
     qg = q.reshape(b, hkv, group, d)
 
-    def _kv_map(bb, h, p, tbl, lens):
+    def _kv_map(bb, h, p, tbl, lens, *scales):
         last_live = jnp.maximum(lens[bb] - 1, 0) // page_size
         return (h, tbl[bb, jnp.minimum(p, last_live)], 0, 0)
 
+    def _q_map(bb, h, p, tbl, lens, *scales):
+        return (bb, h, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,                 # block_tables, seq_lens
+        # block_tables, seq_lens (+ k/v scales for int8 pools)
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(b, hkv, pages_per_seq),
         in_specs=[
-            pl.BlockSpec((1, 1, group, d),
-                         lambda bb, h, p, tbl, lens: (bb, h, 0, 0)),
+            pl.BlockSpec((1, 1, group, d), _q_map),
             # dead pages (past the sequence's last live page) clamp to the
             # last live page: revisiting the same block lets the pipeline
             # elide the copy, so HBM reads scale with true seq_len — the
@@ -114,27 +143,32 @@ def paged_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
             pl.BlockSpec((1, 1, page_size, d), _kv_map),
             pl.BlockSpec((1, 1, page_size, d), _kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, d),
-                               lambda bb, h, p, tbl, lens: (bb, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, group, d), _q_map),
         scratch_shapes=[
             pltpu.VMEM((group, 1), jnp.float32),   # m
             pltpu.VMEM((group, 1), jnp.float32),   # l
             pltpu.VMEM((group, d), jnp.float32),   # acc
         ],
     )
+    prefetch = [block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32)]
+    kernel = _kernel
+    if quantized:
+        prefetch += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
+        kernel = _kernel_quant
     out = pl.pallas_call(
-        functools.partial(_kernel, page_size=page_size, scale=scale),
+        functools.partial(kernel, page_size=page_size, scale=scale),
         out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      qg, k_pages, v_pages)
+    )(*prefetch, qg, k_pages, v_pages)
     return out.reshape(b, hq, d)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
-                              scale=None):
-    """jnp oracle: gather each sequence's pages densely, masked softmax."""
+                              scale=None, k_scales=None, v_scales=None):
+    """jnp oracle: gather each sequence's pages densely, masked softmax.
+    int8 pools dequantize at the gather with the per-(head, page) scales."""
     b, hq, d = q.shape
     hkv, _, ps, _ = k_pages.shape
     group = hq // hkv
@@ -143,8 +177,13 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
     outs = []
     for i in range(b):
         tbl = block_tables[i]                     # [pages_per_seq]
-        k = k_pages[:, tbl].reshape(hkv, -1, d)   # [hkv, S, d]
-        v = v_pages[:, tbl].reshape(hkv, -1, d)
+        k = k_pages[:, tbl].astype(jnp.float32)   # [hkv, pps, ps, d]
+        v = v_pages[:, tbl].astype(jnp.float32)
+        if k_scales is not None:
+            k = k * k_scales[:, tbl, None, None]
+            v = v * v_scales[:, tbl, None, None]
+        k = k.reshape(hkv, -1, d)                 # [hkv, S, d]
+        v = v.reshape(hkv, -1, d)
         qi = q[i].reshape(hkv, group, d)
         s = jnp.einsum("hgd,hsd->hgs", qi, k) * scale
         pos = jnp.arange(s.shape[-1])

@@ -77,6 +77,16 @@ def _repeat_kv(x, rep):
     return jnp.repeat(x, rep, axis=2)
 
 
+def _wmat(x, w):
+    """Projection matmul over a raw array OR a low-bit serving weight
+    (quantization.QuantizedWeight -> the fused dequant-matmul kernel).
+    Every projection in the prefill/decode bodies routes through here so
+    ``quantize_params`` pytrees run fully jitted — the dequant happens in
+    the kernel prologue, never as a per-token eager dispatch."""
+    from ..quantization.low_bit import matmul
+    return matmul(x, w)
+
+
 _STACKED_LAYER_KEYS = {
     "ln1": "input_layernorm.weight",
     "q": "self_attn.q_proj.weight",
@@ -140,9 +150,9 @@ def _block(pl, h, pos, cfg, kv=None, cache_layer=None, cur_len=None,
                  cfg.head_dim)
     b, s, _ = h.shape
     x = _rms_norm(h, pl["ln1"], cfg.rms_norm_eps)
-    q = (x @ pl["q"]).reshape(b, s, H, d)
-    k = (x @ pl["k"]).reshape(b, s, Hkv, d)
-    v = (x @ pl["v"]).reshape(b, s, Hkv, d)
+    q = _wmat(x, pl["q"]).reshape(b, s, H, d)
+    k = _wmat(x, pl["k"]).reshape(b, s, Hkv, d)
+    v = _wmat(x, pl["v"]).reshape(b, s, Hkv, d)
     q = _rope(q, pos, cfg.rope_theta, d)
     k = _rope(k, pos, cfg.rope_theta, d)
 
@@ -187,9 +197,10 @@ def _block(pl, h, pos, cfg, kv=None, cache_layer=None, cur_len=None,
         o = jnp.einsum("bhqk,bkhd->bqhd", p, vr)
         new_cache = (K, V)
 
-    h = h + o.reshape(b, s, H * d) @ pl["o"]
+    h = h + _wmat(o.reshape(b, s, H * d), pl["o"])
     x = _rms_norm(h, pl["ln2"], cfg.rms_norm_eps)
-    h = h + (jax.nn.silu(x @ pl["gate"]) * (x @ pl["up"])) @ pl["down"]
+    h = h + _wmat(jax.nn.silu(_wmat(x, pl["gate"])) * _wmat(x, pl["up"]),
+                  pl["down"])
     return h, new_cache
 
 
@@ -218,11 +229,22 @@ def _sample(logits, key, temperature, top_k, top_p):
 
 
 class Generator:
-    """``Generator(model, max_len).generate(ids, max_new_tokens=...)``."""
+    """``Generator(model, max_len).generate(ids, max_new_tokens=...)``.
 
-    def __init__(self, model, max_len=2048, paged=False, page_size=128):
+    ``quantized_mode="weight_only_int8"|"weight_only_int4"`` serves the
+    model off a low-bit param pytree (quantization.quantize_params):
+    projections stored int8 / packed int4 with per-out-channel scales,
+    dequantized inside the jitted prefill/decode via the fused kernel.
+    """
+
+    def __init__(self, model, max_len=2048, paged=False, page_size=128,
+                 quantized_mode=None):
         self.cfg = model.config
         self.params = extract_params(model)
+        self.quantized_mode = quantized_mode
+        if quantized_mode is not None:
+            from ..quantization.low_bit import quantize_params
+            self.params = quantize_params(self.params, quantized_mode)
         self.max_len = max_len
         cfg = self.cfg
         paged_opt = None

@@ -40,13 +40,20 @@ class BaseObserver(Layer):
         super().__init__()
         self.quant_bits = quant_bits
         self._scale = None
+        #: frozen observers (PTQ.convert) quantize with their calibrated
+        #: scale but never observe again — forward must not mutate _scale
+        self._frozen = False
+
+    def freeze(self):
+        self._frozen = True
 
     def scales(self):
         return Tensor(jnp.asarray(self._scale if self._scale is not None
                                   else 1.0, jnp.float32))
 
     def forward(self, x):
-        self._observe(np.asarray(x.numpy()))
+        if not self._frozen:
+            self._observe(np.asarray(x.numpy()))
         return fake_quantize(x, self.scales(), self.quant_bits)
 
 
@@ -159,8 +166,26 @@ class QAT:
 
 
 class PTQ(QAT):
-    """Post-training quantization: observe with calibration batches, then
-    freeze scales (reference: quantization/ptq.py)."""
+    """Post-training quantization (reference: quantization/ptq.py): wrap
+    with ``quantize``, run calibration batches (observers collect ranges),
+    then ``convert`` — which FREEZES every observer's scale. A forward
+    after convert quantizes with the calibrated scales but never mutates
+    ``_scale`` again: calibration-set statistics, not serving traffic,
+    define the ranges."""
+
+    def convert(self, model, inplace=False):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._freeze(model)
+        return model
+
+    def _freeze(self, layer):
+        if isinstance(layer, BaseObserver):
+            layer.freeze()
+        for sub in layer._sub_layers.values():
+            if sub is not None:
+                self._freeze(sub)
 
 
 __all__ = ["fake_quantize", "AbsmaxObserver", "EMAObserver",
@@ -374,9 +399,13 @@ class GroupWiseWeightObserver(BaseObserver):
     def __init__(self, quant_bits=8, group_size=128):
         super().__init__(quant_bits)
         self.group_size = group_size
+        self._channels = None
+        self._ndim = None
 
     def _observe(self, arr):
         a = np.abs(arr.reshape(arr.shape[0], -1))
+        self._channels = arr.shape[0]
+        self._ndim = arr.ndim
         g = self.group_size
         pads = (-a.shape[0]) % g
         if pads:
@@ -386,9 +415,15 @@ class GroupWiseWeightObserver(BaseObserver):
             np.asarray(self._scale), m)
 
     def scales(self):
-        return Tensor(jnp.asarray(np.asarray(
-            self._scale if self._scale is not None else [1.0]),
-            jnp.float32))
+        """Per-group scales EXPANDED back to per-channel along axis 0 (and
+        shaped [C, 1, ...] to the observed rank) so they broadcast against
+        the fake_quantize input — the raw [num_groups] vector does not."""
+        if self._scale is None:
+            return Tensor(jnp.asarray([1.0], jnp.float32))
+        per_channel = np.repeat(np.asarray(self._scale),
+                                self.group_size)[:self._channels]
+        shape = (self._channels,) + (1,) * (self._ndim - 1)
+        return Tensor(jnp.asarray(per_channel.reshape(shape), jnp.float32))
 
 
 class _Namespace:
@@ -407,3 +442,12 @@ quanters = _Namespace(
 
 __all__ += ["BaseQuanter", "quanter", "GroupWiseWeightObserver",
             "observers", "quanters"]
+
+
+# -- low-bit serving pytrees (jitted Generator/LLMEngine path) --
+
+from .low_bit import (QuantizedWeight, quantize_params,  # noqa: E402
+                      quantize_weight, params_weight_bytes, QUANT_MODES)
+
+__all__ += ["QuantizedWeight", "quantize_params", "quantize_weight",
+            "params_weight_bytes", "QUANT_MODES"]

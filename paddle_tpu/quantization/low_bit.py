@@ -1,0 +1,134 @@
+"""Low-bit serving weights: quantized param pytrees for Generator/LLMEngine.
+
+The eager tier (``Int8Linear``/``Int4Linear`` layer swaps in
+``quantization/__init__``) never reaches the functional serving stack —
+``extract_params`` pulls raw weight arrays into a pure pytree and the
+jitted prefill/decode bodies consume that. This module is the missing
+bridge: ``quantize_params`` converts the extracted pytree itself, so the
+quantized weights are what jit traces over and the fused dequant-matmul
+kernel (kernels/int8_matmul.py) is what the compiled decode step runs.
+
+Scope (the reference's weight_only_linear serving tier): attention and
+MLP projection matrices are quantized per out-channel (int8, or
+nibble-packed int4); embeddings, norms and the lm_head stay full
+precision — norms are tiny, and the logits matmul decides the sampled
+token, where weight-only error costs greedy parity directly.
+
+``QuantizedWeight`` is a registered pytree node whose leaves are the int
+payload + fp32 scales and whose bit-width/original-rows ride as aux data,
+so a quantized pytree flows through ``jax.jit`` like any other params
+tree — both the unrolled and the FLAGS_scan_layers stacked layouts land
+here, because ``extract_params`` already unstacks scanned models into the
+same per-layer dicts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+QUANT_MODES = ("weight_only_int8", "weight_only_int4")
+
+#: per-layer projection keys of the extract_params pytree that quantize;
+#: ln1/ln2 (norms) and the top-level embed/norm/lm_head stay fp
+_PROJ_KEYS = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """A [K, N] projection stored low-bit: int payload + per-out-channel
+    fp32 scales. ``bits``/``rows`` are static aux data (they steer the
+    kernel launch, not the math's operands)."""
+
+    def __init__(self, qdata, scale, bits, rows):
+        self.qdata = qdata
+        self.scale = scale
+        self.bits = int(bits)
+        self.rows = int(rows)
+
+    @property
+    def shape(self):
+        return (self.rows, self.qdata.shape[-1])
+
+    @property
+    def nbytes(self) -> int:
+        """Payload + scale bytes actually resident in HBM."""
+        return int(self.qdata.size * self.qdata.dtype.itemsize
+                   + self.scale.size * self.scale.dtype.itemsize)
+
+    def dequantize(self, dtype=jnp.float32):
+        if self.bits == 8:
+            w = self.qdata.astype(dtype)
+        else:
+            from . import unpack_int4
+            w = unpack_int4(self.qdata, self.rows).astype(dtype)
+        return w * self.scale.reshape(1, -1).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.qdata, self.scale), (self.bits, self.rows)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(leaves[0], leaves[1], *aux)
+
+    def __repr__(self):
+        return (f"QuantizedWeight(int{self.bits}, shape={self.shape}, "
+                f"nbytes={self.qdata.size * self.qdata.dtype.itemsize})")
+
+
+def quantize_weight(w, mode) -> QuantizedWeight:
+    """Quantize one [K, N] projection per out-channel (axis 1)."""
+    from . import quantize_to_int4, quantize_to_int8
+    if mode == "weight_only_int8":
+        q, s = quantize_to_int8(w, axis=1)
+        return QuantizedWeight(q, s.reshape(-1), 8, w.shape[0])
+    if mode == "weight_only_int4":
+        q, s = quantize_to_int4(w, axis=1)
+        return QuantizedWeight(q, s.reshape(-1), 4, w.shape[0])
+    raise ValueError(f"unknown quantized mode {mode!r}; "
+                     f"expected one of {QUANT_MODES}")
+
+
+def quantize_params(params, mode="weight_only_int8"):
+    """Convert an ``extract_params`` pytree for low-bit serving.
+
+    Every per-layer attention/MLP projection becomes a
+    ``QuantizedWeight``; ``embed``/``norm``/``lm_head`` and the layer
+    norms pass through untouched. The result drops into ``Generator`` /
+    ``LLMEngine`` in place of the fp pytree (their matmuls route through
+    ``generation._wmat``).
+    """
+    if mode is None:
+        return params
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quantized mode {mode!r}; "
+                         f"expected one of {QUANT_MODES}")
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = [
+        {k: (quantize_weight(v, mode) if k in _PROJ_KEYS else v)
+         for k, v in layer.items()}
+        for layer in params["layers"]
+    ]
+    return out
+
+
+def matmul(x, w, *, interpret=None):
+    """``x @ w`` where ``w`` is a raw array or a QuantizedWeight — the one
+    dispatch point the serving forward bodies call for every projection."""
+    if isinstance(w, QuantizedWeight):
+        from ..kernels.int8_matmul import dequant_matmul
+        return dequant_matmul(x, w.qdata, w.scale, rows=w.rows,
+                              bits=w.bits, interpret=interpret)
+    return x @ w
+
+
+def params_weight_bytes(params) -> int:
+    """Total resident bytes of a (possibly quantized) params pytree —
+    the ``weight_bytes`` field bench.py records."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(leaf.size * leaf.dtype.itemsize)
+    return total
+
+
+__all__ = ["QuantizedWeight", "quantize_weight", "quantize_params",
+           "matmul", "params_weight_bytes", "QUANT_MODES"]

@@ -35,7 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.generation import (_block, _logits, _rms_norm, _rope,
+from ..models.generation import (_block, _logits, _rms_norm, _rope, _wmat,
                                  extract_params)
 from ..kernels.paged_attention import paged_attention
 from .kv_cache import NULL_PAGE, PagedKVPool
@@ -82,8 +82,34 @@ def _sample_rows(logits, key, temps):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
+def _quantized_append(Pp, Ps, tok, page_ids, off, page_size):
+    """Append one token per row into an int8 page with per-(head, page)
+    scales. The page's scale is the running amax/127 of everything in it:
+    when the new token raises it, the page's existing values are
+    requantized in place (dequant -> round at the new scale), so earlier
+    tokens stay within one rounding step of their fp values.
+
+    Pp: [Hkv, num_pages, ps, d] int8; Ps: [Hkv, num_pages] f32;
+    tok: [Hkv, B, d] fp; page_ids/off: [B]. Returns (Pp, Ps).
+    """
+    old_s = Ps[:, page_ids]                              # [Hkv, B]
+    amax = jnp.max(jnp.abs(tok), axis=-1)                # [Hkv, B]
+    new_s = jnp.maximum(old_s, jnp.maximum(amax, 1e-8) / 127.0)
+    ratio = jnp.where(new_s > 0, old_s / new_s, 0.0)
+    page_q = jnp.clip(jnp.round(
+        Pp[:, page_ids].astype(jnp.float32) * ratio[:, :, None, None]),
+        -127, 127)                                       # [Hkv, B, ps, d]
+    tok_q = jnp.clip(jnp.round(tok / new_s[:, :, None]), -127, 127)
+    sel = (jnp.arange(page_size)[None, None, :, None]
+           == off[None, :, None, None])
+    page_new = jnp.where(sel, tok_q[:, :, None, :], page_q) \
+        .astype(jnp.int8)
+    return Pp.at[:, page_ids].set(page_new), \
+        Ps.at[:, page_ids].set(new_s)
+
+
 def _decode_block(lyr, h, pos, cfg, Kp, Vp, tbls, lens, *, page_size,
-                  interpret):
+                  interpret, Ks=None, Vs=None):
     """One decoder layer of the batched single-token decode over the
     SHARED paged pool (mirrors generation._block's decode math, but with
     real block tables instead of the Generator's identity mapping).
@@ -92,34 +118,47 @@ def _decode_block(lyr, h, pos, cfg, Kp, Vp, tbls, lens, *, page_size,
     Kp/Vp: [Hkv, num_pages, ps, d]; tbls: [B, pages_bucket].
     Padded rows carry all-NULL tables, so their writes and reads land on
     the null page and never touch live data.
+
+    int8 pools pass Ks/Vs [Hkv, num_pages]: the token is quantized on
+    append (per-page running scale, _quantized_append) and the Pallas
+    kernel dequantizes at the gather. Returns (h, (Kp, Vp), (Ks, Vs));
+    the scale pair is None for fp pools.
     """
     H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
     b = h.shape[0]
     x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
-    q = (x @ lyr["q"]).reshape(b, 1, H, d)
-    k = (x @ lyr["k"]).reshape(b, 1, Hkv, d)
-    v = (x @ lyr["v"]).reshape(b, 1, Hkv, d)
+    q = _wmat(x, lyr["q"]).reshape(b, 1, H, d)
+    k = _wmat(x, lyr["k"]).reshape(b, 1, Hkv, d)
+    v = _wmat(x, lyr["v"]).reshape(b, 1, Hkv, d)
     q = _rope(q, pos[:, None], cfg.rope_theta, d)
     k = _rope(k, pos[:, None], cfg.rope_theta, d)
 
     # scatter the new token's K/V into each row's current page
     npages = Kp.shape[1]
     rows = jnp.arange(b)
-    slot = tbls[rows, lens // page_size] * page_size + lens % page_size
     kt = jnp.transpose(k[:, 0], (1, 0, 2))          # [Hkv, B, d]
     vt = jnp.transpose(v[:, 0], (1, 0, 2))
-    Kp = Kp.reshape(Hkv, npages * page_size, d).at[:, slot].set(kt) \
-           .reshape(Hkv, npages, page_size, d)
-    Vp = Vp.reshape(Hkv, npages * page_size, d).at[:, slot].set(vt) \
-           .reshape(Hkv, npages, page_size, d)
+    if Ks is not None:
+        page_ids = tbls[rows, lens // page_size]
+        off = lens % page_size
+        Kp, Ks = _quantized_append(Kp, Ks, kt, page_ids, off, page_size)
+        Vp, Vs = _quantized_append(Vp, Vs, vt, page_ids, off, page_size)
+    else:
+        slot = tbls[rows, lens // page_size] * page_size + lens % page_size
+        Kp = Kp.reshape(Hkv, npages * page_size, d).at[:, slot].set(kt) \
+               .reshape(Hkv, npages, page_size, d)
+        Vp = Vp.reshape(Hkv, npages * page_size, d).at[:, slot].set(vt) \
+               .reshape(Hkv, npages, page_size, d)
 
     o = paged_attention(q[:, 0], Kp, Vp, tbls, lens + 1,
-                        interpret=interpret)        # [B, H, d]
-    h = h + o.reshape(b, 1, H * d) @ lyr["o"]
+                        interpret=interpret, k_scales=Ks,
+                        v_scales=Vs)                # [B, H, d]
+    h = h + _wmat(o.reshape(b, 1, H * d), lyr["o"])
     x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
-    h = h + (jax.nn.silu(x @ lyr["gate"]) * (x @ lyr["up"])) @ lyr["down"]
-    return h, (Kp, Vp)
+    h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"])) * _wmat(x, lyr["up"]),
+                  lyr["down"])
+    return h, (Kp, Vp), (None if Ks is None else (Ks, Vs))
 
 
 class LLMEngine:
@@ -129,13 +168,20 @@ class LLMEngine:
                  batch_buckets=(1, 2, 4, 8), pages_buckets=None,
                  prefill_buckets=None, max_prefills_per_step=4,
                  high_watermark=0.90, low_watermark=0.50, seed=0,
-                 stream_cb=None, now_fn=time.monotonic, interpret=None):
+                 stream_cb=None, now_fn=time.monotonic, interpret=None,
+                 quantized_mode=None, kv_cache_dtype=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
                 f"{page_size}")
         self.cfg = cfg = model.config
         self.params = extract_params(model)
+        # low-bit serving weights: the jitted prefill/decode trace over a
+        # quantized pytree; projections run the fused dequant-matmul
+        self.quantized_mode = quantized_mode
+        if quantized_mode is not None:
+            from ..quantization.low_bit import quantize_params
+            self.params = quantize_params(self.params, quantized_mode)
         self.max_len = max_len
         self.page_size = page_size
         self.max_pages_per_seq = max_len // page_size
@@ -143,7 +189,12 @@ class LLMEngine:
             # default: every batch slot can hold a max_len sequence, so
             # preemption never fires unless the operator shrinks the pool
             num_pages = max(batch_buckets) * self.max_pages_per_seq + 1
-        dtype = self.params["embed"].dtype
+        if kv_cache_dtype in ("int8", jnp.int8, jnp.dtype(jnp.int8)):
+            dtype = jnp.int8          # int8 pool: ~2x sequences per byte
+        elif kv_cache_dtype is not None:
+            dtype = jnp.dtype(kv_cache_dtype)
+        else:
+            dtype = self.params["embed"].dtype
         self.pool = PagedKVPool(
             cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
             num_pages=num_pages, page_size=page_size, dtype=dtype,
@@ -192,14 +243,15 @@ class LLMEngine:
         cfg = self.cfg
         ps = self.page_size
         interpret = self._interpret
+        quant_pool = self.pool.quantized
 
-        def prefill(params, kv, ids, length, tbl, temp, key):
+        def prefill(params, kv, kv_scales, ids, length, tbl, temp, key):
             # ids [1, S] padded; tbl [S // ps] page ids (NULL-padded).
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             h = params["embed"][ids]
-            new_kv = []
-            for lyr, (Kp, Vp) in zip(params["layers"], kv):
+            new_kv, new_scales = [], []
+            for i, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
                 h, (k, v) = _block(lyr, h, pos, cfg)
                 # [1, S, Hkv, d] -> [Hkv, S/ps, ps, d] -> scatter to pool
                 hkv, d = cfg.num_key_value_heads, cfg.head_dim
@@ -207,32 +259,63 @@ class LLMEngine:
                     k[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
                 vt = jnp.transpose(
                     v[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
-                new_kv.append((Kp.at[:, tbl].set(kt), Vp.at[:, tbl].set(vt)))
+                if quant_pool:
+                    # exact per-(head, page) scales from the prompt's own
+                    # amax. Padded positions are ZEROED first: the pad
+                    # token id 0 has a real embedding, so its K/V would
+                    # otherwise inflate the last partial page's scale and
+                    # coarsen the real tokens' quantization (attention
+                    # never reads past `length`, so zeroing loses nothing)
+                    Ks, Vs = kv_scales[i]
+                    valid = (jnp.arange(s) < length).reshape(
+                        s // ps, ps)[None, :, :, None]
+
+                    def _q(t):
+                        t = jnp.where(valid, t, 0.0)
+                        s_ = jnp.maximum(jnp.max(jnp.abs(t), axis=(2, 3)),
+                                         1e-8) / 127.0
+                        q_ = jnp.clip(jnp.round(t / s_[:, :, None, None]),
+                                      -127, 127).astype(jnp.int8)
+                        return q_, s_
+
+                    kq, k_s = _q(kt)
+                    vq, v_s = _q(vt)
+                    new_kv.append((Kp.at[:, tbl].set(kq),
+                                   Vp.at[:, tbl].set(vq)))
+                    new_scales.append((Ks.at[:, tbl].set(k_s),
+                                       Vs.at[:, tbl].set(v_s)))
+                else:
+                    new_kv.append((Kp.at[:, tbl].set(kt),
+                                   Vp.at[:, tbl].set(vt)))
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=1,
                                                 keepdims=False)
             logits = _logits(params, last, cfg)             # [1, V]
             tok = _sample_rows(logits, key, temp[None])[0]
-            return tok, new_kv
+            return tok, new_kv, new_scales if quant_pool else None
 
-        def decode(params, kv, tokens, tbls, lens, temps, key):
+        def decode(params, kv, kv_scales, tokens, tbls, lens, temps, key):
             # tokens/lens/temps [B]; tbls [B, P]. lens = cached length per
             # row = the write slot of this token; attention covers lens+1.
             h = params["embed"][tokens[:, None]]
-            new_kv = []
-            for lyr, (Kp, Vp) in zip(params["layers"], kv):
-                h, pair = _decode_block(lyr, h, lens, cfg, Kp, Vp, tbls,
-                                        lens, page_size=ps,
-                                        interpret=interpret)
+            new_kv, new_scales = [], []
+            for i, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
+                Ks, Vs = kv_scales[i] if quant_pool else (None, None)
+                h, pair, scales = _decode_block(
+                    lyr, h, lens, cfg, Kp, Vp, tbls, lens, page_size=ps,
+                    interpret=interpret, Ks=Ks, Vs=Vs)
                 new_kv.append(pair)
+                new_scales.append(scales)
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             logits = _logits(params, h[:, 0], cfg)          # [B, V]
-            return _sample_rows(logits, key, temps), new_kv
+            return (_sample_rows(logits, key, temps), new_kv,
+                    new_scales if quant_pool else None)
 
-        # donate the pool buffers (arg 1) so decode updates in place on
-        # TPU; CPU/PJRT-cpu ignores donation with a warning, so skip there
+        # donate the pool buffers (args 1-2: pages + scales) so decode
+        # updates in place on TPU; CPU/PJRT-cpu ignores donation with a
+        # warning, so skip there
         from ..kernels import _on_tpu
-        donate = (1,) if _on_tpu() else ()
+        donate = (1, 2) if _on_tpu() else ()
         self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
         self._decode_jit = jax.jit(decode, donate_argnums=donate)
 
@@ -374,11 +457,13 @@ class LLMEngine:
         tbl = np.asarray(
             self.pool.padded_block_table(seq.seq_id, S // self.page_size),
             np.int32)
-        tok, new_kv = self._prefill_jit(
-            self.params, self.pool.kv, jnp.asarray(padded),
-            np.int32(L), jnp.asarray(tbl),
+        tok, new_kv, new_scales = self._prefill_jit(
+            self.params, self.pool.kv, self.pool.kv_scales,
+            jnp.asarray(padded), np.int32(L), jnp.asarray(tbl),
             np.float32(seq.temperature), self._next_key())
         self.pool.kv = new_kv
+        if new_scales is not None:
+            self.pool.kv_scales = new_scales
         self.metrics.prefills.inc()
         return int(tok)
 
@@ -397,11 +482,13 @@ class LLMEngine:
             tbls[i] = table
             lens[i] = seq.total_len - 1        # cached length = write slot
             temps[i] = seq.temperature
-        next_toks, new_kv = self._decode_jit(
-            self.params, self.pool.kv, jnp.asarray(tokens),
-            jnp.asarray(tbls), jnp.asarray(lens), jnp.asarray(temps),
-            self._next_key())
+        next_toks, new_kv, new_scales = self._decode_jit(
+            self.params, self.pool.kv, self.pool.kv_scales,
+            jnp.asarray(tokens), jnp.asarray(tbls), jnp.asarray(lens),
+            jnp.asarray(temps), self._next_key())
         self.pool.kv = new_kv
+        if new_scales is not None:
+            self.pool.kv_scales = new_scales
         return np.asarray(next_toks)[:len(plan.seqs)]
 
     def _commit_token(self, seq: Sequence, tok: int):

@@ -18,6 +18,15 @@ and of the reference's block_multi_head_attention cache manager):
 - utilization watermarks the scheduler uses for admission control and
   preemption decisions.
 
+Low-bit pools (``dtype=jnp.int8``): K/V pages are stored int8 with one
+fp32 scale per (kv head, page) — ``kv_scales``, one (Ks, Vs) pair per
+layer, shape [num_kv_heads, num_pages]. The engine quantizes on append
+and the paged-attention kernel dequantizes at the gather (scales ride the
+scalar-prefetch channel into SMEM). A page costs ~1/4 the fp32 bytes, so
+the same HBM budget holds ~4x the pages (~2x vs bf16) and the scheduler
+admits correspondingly more concurrent sequences at the same watermark —
+``pages_for_byte_budget`` is the accounting the sizing test gates.
+
 The device arrays themselves live in ``kv`` (one (K, V) pair per layer)
 and are updated *functionally* by the engine's jitted prefill/decode steps
 (the engine reassigns ``kv`` after each donated call); this class tracks
@@ -57,14 +66,65 @@ class PagedKVPool:
         self.page_size = page_size
         self.high_watermark = high_watermark
         self.low_watermark = low_watermark
+        self.dtype = jnp.dtype(dtype)
+        self.quantized = self.dtype == jnp.dtype(jnp.int8)
         shape = (num_kv_heads, num_pages, page_size, head_dim)
         self.kv = [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                    for _ in range(num_layers)]
+        # per-(head, page) dequant scales for int8 pools; zero-init so a
+        # fresh page's first append sets the scale from its own amax
+        # instead of inheriting a fabricated range
+        self.kv_scales = None
+        if self.quantized:
+            sshape = (num_kv_heads, num_pages)
+            self.kv_scales = [(jnp.zeros(sshape, jnp.float32),
+                               jnp.zeros(sshape, jnp.float32))
+                              for _ in range(num_layers)]
         # LIFO free list: recently-freed pages are reused first (warm in
         # whatever cache level holds them)
         self._free = list(range(num_pages - 1, NULL_PAGE, -1))
         self._tables: dict[object, list[int]] = {}
         self._lens: dict[object, int] = {}
+
+    # ---- byte accounting (pool sizing / bench fields) ----
+    @staticmethod
+    def page_bytes_for(num_layers, num_kv_heads, head_dim, page_size,
+                       dtype=jnp.float32) -> int:
+        """HBM bytes one pool page costs across all layers, K+V, scale
+        rows included for int8 pools."""
+        dt = jnp.dtype(dtype)
+        data = num_layers * 2 * num_kv_heads * page_size * head_dim \
+            * dt.itemsize
+        scales = num_layers * 2 * num_kv_heads * 4 \
+            if dt == jnp.dtype(jnp.int8) else 0
+        return data + scales
+
+    @classmethod
+    def pages_for_byte_budget(cls, byte_budget, num_layers, num_kv_heads,
+                              head_dim, page_size,
+                              dtype=jnp.float32) -> int:
+        """Largest ``num_pages`` whose pool fits ``byte_budget`` — how an
+        operator sizes fp32 vs int8 pools at the same HBM watermark (the
+        ~2x-sequences-per-byte win the int8 pool exists for)."""
+        per = cls.page_bytes_for(num_layers, num_kv_heads, head_dim,
+                                 page_size, dtype)
+        return max(int(byte_budget) // per, 0)
+
+    @property
+    def page_bytes(self) -> int:
+        return self.page_bytes_for(self.num_layers, self.num_kv_heads,
+                                   self.head_dim, self.page_size,
+                                   self.dtype)
+
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """Bytes of pool one cached token occupies (scale rows amortized
+        over the page's tokens) — bench.py's ``kv_bytes_per_token``."""
+        return self.page_bytes / self.page_size
+
+    @property
+    def pool_bytes(self) -> int:
+        return self.page_bytes * self.num_pages
 
     # ---- capacity ----
     @property
@@ -131,6 +191,16 @@ class PagedKVPool:
         pages = self._tables.pop(seq_id)
         self._lens.pop(seq_id, None)
         self._free.extend(reversed(pages))
+        if self.kv_scales is not None and pages:
+            # reset the freed pages' dequant scales: the append path's
+            # running max (engine._quantized_append) only ever GROWS a
+            # scale, so a recycled page must not hand its next tenant the
+            # previous sequence's (possibly much larger) range — that
+            # would quantize small new values straight to zero
+            idx = jnp.asarray(pages, jnp.int32)
+            self.kv_scales = [(Ks.at[:, idx].set(0.0),
+                               Vs.at[:, idx].set(0.0))
+                              for Ks, Vs in self.kv_scales]
         return len(pages)
 
     # ---- queries ----

@@ -57,20 +57,62 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
                     "remat_policy": "full", "accumulate_steps": 4}
     monkeypatch.setattr(bench, "_last_good_round",
                         lambda: ("BENCH_r05.json", stale_parsed))
-    out = bench._failure_artifact("timeout after 600s",
-                                  [{"stage": "backend_probing"}])
+    out = bench._failure_artifact(
+        "timeout after 600s",
+        [{"stage": "imports_done", "t": 1.0},
+         {"stage": "backend_probing", "t": 2.5}])
     assert out["stale"] is True
     assert out["stale_source"] == "BENCH_r05.json"
     assert out["vs_baseline"] == 0.8333          # unchanged pass-through
     assert out["value"] == 70000.0
     for k in ("compile_ms", "peak_hbm_bytes", "remat_policy",
-              "accumulate_steps"):
+              "accumulate_steps", "quantized_mode", "weight_bytes",
+              "kv_bytes_per_token", "quantized_decode_tokens_per_s"):
         assert out[k] is None, k                 # never fabricated
+    # per-stage elapsed ms: delta to the next mark; the stage the child
+    # died inside has no known duration -> null
+    assert out["stage_ms"] == [
+        {"stage": "imports_done", "ms": 1500.0},
+        {"stage": "backend_probing", "ms": None}]
     # and with no stale source at all, the nulls (and 0.0) survive
     monkeypatch.setattr(bench, "_last_good_round", lambda: None)
     out = bench._failure_artifact("err", [])
     assert out["value"] == 0.0 and out["compile_ms"] is None
     assert "stale" not in out
+
+
+def test_backend_probe_sub_timeout(monkeypatch):
+    """A child wedged in backend_probing is killed after the probe's OWN
+    sub-timeout, not the full child budget (BENCH_r05: the whole 300 s
+    died in backend_probing), and the error names the sub-timeout so
+    main() falls through to the last-good artifact without a retry."""
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+
+    env_keys = {
+        "PADDLE_TPU_BENCH_SIMULATE_HANG": "backend",
+        "PADDLE_TPU_BENCH_BACKEND_TIMEOUT": "6",
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+    }
+    old = {k: os.environ.get(k) for k in env_keys}
+    os.environ.update(env_keys)
+    try:
+        t0 = time.monotonic()
+        payload, err, stages = bench._run_child(300.0)
+        elapsed = time.monotonic() - t0
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert payload is None
+    assert "backend probe exceeded" in err, (err, stages)
+    assert "backend_probing" in err
+    assert elapsed < 120, f"sub-timeout did not trip early ({elapsed}s)"
 
 
 def test_peak_hbm_probe_never_fabricates():
