@@ -326,13 +326,20 @@ def _probe_serving(paddle, wave=6, max_new=4):
 
     Drives the continuous-batching LLMEngine (paddle_tpu/serving/) over a
     mixed-length request wave on a micro Llama config: one warmup wave
-    pays the bucketed compiles, a second identical wave measures steady-
-    state serving throughput. Records:
+    pays the single ragged-step compile, a second identical wave measures
+    steady-state serving throughput. The wave's prompts share a common
+    page-aligned prefix and arrive staggered (the first request's prompt
+    is committed before the rest arrive), so the prefix cache and
+    copy-on-write page sharing are genuinely exercised. Records:
     - ``serving_tokens_per_s``: generated tokens / wall-clock of wave 2;
     - ``kv_page_utilization``: peak fraction of pool pages in use;
-    - ``decode_compiles``: decode executables built across BOTH waves —
-      bounded by #shape buckets (tests/test_serving_compile_gate.py), so
-      a trajectory jump here flags per-composition recompilation.
+    - ``decode_compiles``: ragged-step executables built across BOTH
+      waves — expected 1 (tests/test_serving_compile_gate.py), so a
+      trajectory jump here flags shape-dependent recompilation;
+    - ``prefix_cache_hit_rate``: prefix-cache hits / probes across both
+      waves (the staggered shared-prefix arrivals should mostly hit);
+    - ``shared_page_fraction``: peak fraction of logical pages served by
+      a shared physical page — the admitted-sequences-per-byte win.
     The low-bit serving path rides the same waves on a SECOND engine
     (weight_only_int8 params + int8 paged KV):
     - ``quantized_decode_tokens_per_s``: the quantized engine's measured
@@ -358,20 +365,32 @@ def _probe_serving(paddle, wave=6, max_new=4):
         eng = LLMEngine(model, max_len=64, page_size=8,
                         batch_buckets=(1, 2, 4, 8))
         rng = _np.random.default_rng(0)
-        lengths = [3, 5, 8, 11, 14, 17][:wave]
+        # a shared 16-token (2-page) system-prompt prefix + distinct
+        # tails, staggered so the first request's prompt is committed
+        # (and registered in the prefix cache) before the rest arrive
+        prefix = rng.integers(0, 256, (16,)).tolist()
+        tails = [rng.integers(0, 256, (n,)).tolist()
+                 for n in [3, 5, 8, 2, 6, 4][:wave - 1]]
         peak_util = 0.0
+        peak_shared = 0.0
 
-        def _wave(e):
-            nonlocal peak_util
-            for n in lengths:
-                e.add_request(rng.integers(0, 256, (n,)).tolist(),
-                              max_new_tokens=max_new)
+        def _drive(e, steps_cap=500):
+            nonlocal peak_util, peak_shared
             steps = 0
             while e.has_unfinished():
                 e.step()
                 peak_util = max(peak_util, e.pool.utilization)
+                peak_shared = max(peak_shared,
+                                  e.pool.shared_page_fraction)
                 steps += 1
-                assert steps < 500
+                assert steps < steps_cap
+
+        def _wave(e):
+            e.add_request(prefix, max_new_tokens=max_new)
+            e.step(); e.step()                    # donor prompt committed
+            for t in tails:
+                e.add_request(prefix + t, max_new_tokens=max_new)
+            _drive(e)
 
         def _measure(e):
             _wave(e)                              # warmup: compiles
@@ -382,10 +401,15 @@ def _probe_serving(paddle, wave=6, max_new=4):
             return (e.metrics.tokens_generated.value - tok0) / dt
 
         tok_s = _measure(eng)
+        hits = eng.metrics.prefix_cache_hits.value
+        misses = eng.metrics.prefix_cache_misses.value
         out = {
             "serving_tokens_per_s": round(tok_s, 1),
             "kv_page_utilization": round(peak_util, 4),
             "decode_compiles": eng.decode_cache_size(),
+            "prefix_cache_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "shared_page_fraction": round(peak_shared, 4),
         }
         try:
             from paddle_tpu.quantization import params_weight_bytes
@@ -413,6 +437,8 @@ def _probe_serving(paddle, wave=6, max_new=4):
         return {"serving_tokens_per_s": 0.0,
                 "kv_page_utilization": 0.0,
                 "decode_compiles": -1,
+                "prefix_cache_hit_rate": None,
+                "shared_page_fraction": None,
                 "quantized_mode": None, "weight_bytes": None,
                 "kv_bytes_per_token": None,
                 "quantized_decode_tokens_per_s": None,
@@ -706,6 +732,12 @@ def _failure_artifact(last_err, last_stages):
         "weight_bytes": None,
         "kv_bytes_per_token": None,
         "quantized_decode_tokens_per_s": None,
+        # ragged-serving fields likewise: compile counts and prefix-cache
+        # behavior are per-run observations, never inherited from the
+        # stale source
+        "decode_compiles": None,
+        "prefix_cache_hit_rate": None,
+        "shared_page_fraction": None,
     }
     good = _last_good_round()
     if good:

@@ -18,12 +18,15 @@ python/paddle/generation-style APIs). Design:
 
 Sampling: greedy / temperature / top-k / top-p, computed in-graph.
 
-Serving contract: paddle_tpu/serving/engine.py reuses ``_block`` (prefill
-path), ``_rope``/``_rms_norm``/``_logits`` and ``extract_params`` so the
-continuous-batching engine's math is THIS module's math — the greedy
+Serving contract: paddle_tpu/serving/engine.py reuses
+``_rope``/``_rms_norm``/``_wmat``/``_logits`` and ``extract_params`` so
+the continuous-batching engine's math is THIS module's math — the greedy
 token-identity between ``LLMEngine`` and sequential ``Generator.generate``
 (tests/test_serving_engine.py) depends on these bodies staying shared.
-Change them here and the serving decode mirror (_decode_block) together.
+The engine's ragged step (decode rows + prefill chunks in one launch)
+runs attention through the ragged Pallas kernel instead of ``_block``'s
+dense causal path, but projections, rope, norms and logits are these
+functions — change them here and the ragged step body together.
 """
 from __future__ import annotations
 

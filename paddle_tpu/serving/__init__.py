@@ -2,22 +2,29 @@
 
 Layers (docs/SERVING.md has the full architecture):
 
-- :mod:`kv_cache` — ``PagedKVPool``: free-list page allocator + per-
-  sequence block tables over the pool layout the Pallas decode kernel
-  (kernels/paged_attention.py) consumes.
-- :mod:`scheduler` — ``Scheduler``: FIFO admission, fixed-shape decode
-  bucket assembly, deadline load shedding, preemption-with-requeue.
+- :mod:`kv_cache` — ``PagedKVPool``: refcounted free-list page allocator
+  + per-sequence block tables over the pool layout the Pallas ragged
+  kernel (kernels/paged_attention.py) consumes, with copy-on-write
+  prefix-page sharing (``fork``/``prepare_append``).
+- :mod:`scheduler` — ``Scheduler``: FIFO admission, chunked-prefill
+  ragged step planning (decode rows and prompt chunks in ONE launch),
+  deadline load shedding, preemption-with-requeue.
 - :mod:`engine` — ``LLMEngine`` + ``Request``/``RequestOutput``: the
-  request lifecycle over bucketed jitted prefill/decode steps.
+  request lifecycle over ONE jitted fixed-shape ragged step, with a
+  prefix-hash cache that admits repeated prompt prefixes by forking
+  pages instead of re-prefilling. ``RequestRejected`` is the structured
+  admission error for unserviceable requests.
 - :mod:`metrics` — ``ServingMetrics``: counters/gauges exported to
   bench.py and the profiler timeline.
 """
 from .kv_cache import PagedKVPool, PoolExhausted, NULL_PAGE  # noqa: F401
 from .scheduler import (Scheduler, SchedulerConfig, Sequence,  # noqa: F401
-                        SequenceStatus, bucket_for)
-from .engine import LLMEngine, Request, RequestOutput  # noqa: F401
+                        SequenceStatus, StepPlan, bucket_for)
+from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
+                     RequestRejected)
 from .metrics import ServingMetrics  # noqa: F401
 
-__all__ = ["LLMEngine", "Request", "RequestOutput", "PagedKVPool",
-           "PoolExhausted", "NULL_PAGE", "Scheduler", "SchedulerConfig",
-           "Sequence", "SequenceStatus", "ServingMetrics", "bucket_for"]
+__all__ = ["LLMEngine", "Request", "RequestOutput", "RequestRejected",
+           "PagedKVPool", "PoolExhausted", "NULL_PAGE", "Scheduler",
+           "SchedulerConfig", "Sequence", "SequenceStatus", "StepPlan",
+           "ServingMetrics", "bucket_for"]

@@ -1,8 +1,8 @@
-"""LLMEngine — continuous-batching serving over the paged Pallas kernel.
+"""LLMEngine — continuous-batching serving over the ragged Pallas kernel.
 
-Turns the repo's existing pieces (models/generation.py prefill math,
-kernels/paged_attention.py decode kernel, the PagedKVPool allocator, the
-bucketed Scheduler) into a request-lifecycle engine:
+Turns the repo's existing pieces (models/generation.py forward math,
+kernels/paged_attention.py ragged kernel, the refcounted PagedKVPool, the
+chunked-prefill Scheduler) into a request-lifecycle engine:
 
     engine = LLMEngine(model, max_len=256, page_size=16)
     rid = engine.add_request([1, 2, 3], max_new_tokens=8)
@@ -11,19 +11,31 @@ bucketed Scheduler) into a request-lifecycle engine:
             ...
     tokens = engine.outputs()[rid].token_ids
 
-Compilation contract (the TPU-shaped core of the design): the decode step
-is one jitted function whose input shapes are always a (batch_bucket,
-pages_bucket) pair from the scheduler's closed bucket set, so XLA compiles
-at most ``len(batch_buckets) * len(pages_buckets)`` decode executables no
-matter what request mix arrives (gated by
-tests/test_serving_compile_gate.py). Prefill is likewise bucketed over
-padded prompt lengths. Everything request-specific — block tables, true
-lengths, sampling temperature — is data, not shape.
+Compilation contract (the TPU-shaped core of the design): EVERY engine
+step — any mix of decode rows and prefill chunks, any batch composition,
+any lengths — is one launch of ONE jitted ragged step whose input shapes
+never change: ``step_token_budget`` packed query tokens over
+``max_num_seqs`` row slots and ``max_pages_per_seq``-wide block tables.
+XLA compiles exactly one step executable for the lifetime of the process
+(gated by tests/test_serving_compile_gate.py) — down from the previous
+``len(batch_buckets) * len(pages_buckets) + #prefill_buckets`` zoo.
+Everything request-specific — block tables, (q_start, q_len, kv_len)
+row metadata, sampling temperature — is data, not shape.
+
+Prefix caching: after a prompt is fully committed, the engine registers
+its page-aligned token-prefix chains in a hash map; a later request whose
+prompt starts with a registered chain is admitted by FORKING the donor's
+pages (``PagedKVPool.fork`` — refcount + 1, zero prefill compute, zero
+page storage for the shared region). An identical prompt shares even the
+partially-filled tail page; the first divergent append then triggers one
+copy-on-write page duplication. int8 pools share full pages only: an
+append can requantize a page in place (running-amax scale growth), which
+must never perturb another reader's view.
 
 Greedy outputs are token-identical to sequential ``Generator.generate``:
-prefill reuses ``generation._block`` verbatim, decode mirrors its math
-over the shared pool, and preemption requeues in recompute mode (prefill
-over prompt+generated reproduces the same greedy continuation).
+the ragged step computes each token's K/V and logits independently of how
+the work was chunked, so chunk boundaries, preemption-with-requeue
+(recompute mode) and prefix forks all reproduce the same continuation.
 """
 from __future__ import annotations
 
@@ -35,13 +47,29 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..models.generation import (_block, _logits, _rms_norm, _rope, _wmat,
+from ..models.generation import (_logits, _rms_norm, _rope, _wmat,
                                  extract_params)
-from ..kernels.paged_attention import paged_attention
+from ..kernels.paged_attention import ragged_paged_attention
 from .kv_cache import NULL_PAGE, PagedKVPool
 from .metrics import ServingMetrics
-from .scheduler import (Scheduler, SchedulerConfig, Sequence, SequenceStatus,
-                        bucket_for)
+from .scheduler import Scheduler, SchedulerConfig, Sequence, SequenceStatus
+
+
+class RequestRejected(ValueError):
+    """Structured admission rejection: the request could never be served
+    (prompt + max_new_tokens exceeds max_len or the pool's page limit).
+    The engine records a finalized ``RequestOutput`` (status "aborted",
+    ``finish_reason`` describing why) under ``request_id`` before
+    raising, so the serving loop keeps running and polling clients see a
+    terminal state instead of the whole engine dying mid-``step()``."""
+
+    def __init__(self, request_id, reason, *, needed_pages=None,
+                 limit=None, message=None):
+        super().__init__(message or reason)
+        self.request_id = request_id
+        self.reason = reason
+        self.needed_pages = needed_pages
+        self.limit = limit
 
 
 @dataclass
@@ -82,7 +110,7 @@ def _sample_rows(logits, key, temps):
     return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
-def _quantized_append(Pp, Ps, tok, page_ids, off, page_size):
+def _quantized_append(Pp, Ps, tok, page_ids, off, page_size, live):
     """Append one token per row into an int8 page with per-(head, page)
     scales. The page's scale is the running amax/127 of everything in it:
     when the new token raises it, the page's existing values are
@@ -90,83 +118,38 @@ def _quantized_append(Pp, Ps, tok, page_ids, off, page_size):
     tokens stay within one rounding step of their fp values.
 
     Pp: [Hkv, num_pages, ps, d] int8; Ps: [Hkv, num_pages] f32;
-    tok: [Hkv, B, d] fp; page_ids/off: [B]. Returns (Pp, Ps).
+    tok: [Hkv, B, d] fp; page_ids/off/live: [B]. Dead rows (live=False)
+    target the null page with an unchanged scale and write nothing.
+    Returns (Pp, Ps).
     """
     old_s = Ps[:, page_ids]                              # [Hkv, B]
     amax = jnp.max(jnp.abs(tok), axis=-1)                # [Hkv, B]
-    new_s = jnp.maximum(old_s, jnp.maximum(amax, 1e-8) / 127.0)
+    new_s = jnp.where(live[None, :],
+                      jnp.maximum(old_s, jnp.maximum(amax, 1e-8) / 127.0),
+                      old_s)
     ratio = jnp.where(new_s > 0, old_s / new_s, 0.0)
     page_q = jnp.clip(jnp.round(
         Pp[:, page_ids].astype(jnp.float32) * ratio[:, :, None, None]),
         -127, 127)                                       # [Hkv, B, ps, d]
-    tok_q = jnp.clip(jnp.round(tok / new_s[:, :, None]), -127, 127)
+    tok_q = jnp.clip(jnp.round(tok / jnp.maximum(new_s[:, :, None], 1e-30)),
+                     -127, 127)
     sel = (jnp.arange(page_size)[None, None, :, None]
-           == off[None, :, None, None])
+           == off[None, :, None, None]) & live[None, :, None, None]
     page_new = jnp.where(sel, tok_q[:, :, None, :], page_q) \
         .astype(jnp.int8)
     return Pp.at[:, page_ids].set(page_new), \
         Ps.at[:, page_ids].set(new_s)
 
 
-def _decode_block(lyr, h, pos, cfg, Kp, Vp, tbls, lens, *, page_size,
-                  interpret, Ks=None, Vs=None):
-    """One decoder layer of the batched single-token decode over the
-    SHARED paged pool (mirrors generation._block's decode math, but with
-    real block tables instead of the Generator's identity mapping).
-
-    h: [B, 1, hidden]; pos/lens: [B] cached length per row (write slot);
-    Kp/Vp: [Hkv, num_pages, ps, d]; tbls: [B, pages_bucket].
-    Padded rows carry all-NULL tables, so their writes and reads land on
-    the null page and never touch live data.
-
-    int8 pools pass Ks/Vs [Hkv, num_pages]: the token is quantized on
-    append (per-page running scale, _quantized_append) and the Pallas
-    kernel dequantizes at the gather. Returns (h, (Kp, Vp), (Ks, Vs));
-    the scale pair is None for fp pools.
-    """
-    H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                 cfg.head_dim)
-    b = h.shape[0]
-    x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
-    q = _wmat(x, lyr["q"]).reshape(b, 1, H, d)
-    k = _wmat(x, lyr["k"]).reshape(b, 1, Hkv, d)
-    v = _wmat(x, lyr["v"]).reshape(b, 1, Hkv, d)
-    q = _rope(q, pos[:, None], cfg.rope_theta, d)
-    k = _rope(k, pos[:, None], cfg.rope_theta, d)
-
-    # scatter the new token's K/V into each row's current page
-    npages = Kp.shape[1]
-    rows = jnp.arange(b)
-    kt = jnp.transpose(k[:, 0], (1, 0, 2))          # [Hkv, B, d]
-    vt = jnp.transpose(v[:, 0], (1, 0, 2))
-    if Ks is not None:
-        page_ids = tbls[rows, lens // page_size]
-        off = lens % page_size
-        Kp, Ks = _quantized_append(Kp, Ks, kt, page_ids, off, page_size)
-        Vp, Vs = _quantized_append(Vp, Vs, vt, page_ids, off, page_size)
-    else:
-        slot = tbls[rows, lens // page_size] * page_size + lens % page_size
-        Kp = Kp.reshape(Hkv, npages * page_size, d).at[:, slot].set(kt) \
-               .reshape(Hkv, npages, page_size, d)
-        Vp = Vp.reshape(Hkv, npages * page_size, d).at[:, slot].set(vt) \
-               .reshape(Hkv, npages, page_size, d)
-
-    o = paged_attention(q[:, 0], Kp, Vp, tbls, lens + 1,
-                        interpret=interpret, k_scales=Ks,
-                        v_scales=Vs)                # [B, H, d]
-    h = h + _wmat(o.reshape(b, 1, H * d), lyr["o"])
-    x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
-    h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"])) * _wmat(x, lyr["up"]),
-                  lyr["down"])
-    return h, (Kp, Vp), (None if Ks is None else (Ks, Vs))
-
-
 class LLMEngine:
     """Continuous-batching serving engine over a paged KV pool."""
 
     def __init__(self, model, *, max_len=256, page_size=16, num_pages=None,
-                 batch_buckets=(1, 2, 4, 8), pages_buckets=None,
-                 prefill_buckets=None, max_prefills_per_step=4,
+                 max_num_seqs=None, chunk_size=None, q_block=8,
+                 step_token_budget=None, batch_buckets=None,
+                 pages_buckets=None, prefill_buckets=None,
+                 max_prefills_per_step=4, prefix_caching=True,
+                 prefix_cache_size=4096,
                  high_watermark=0.90, low_watermark=0.50, seed=0,
                  stream_cb=None, now_fn=time.monotonic, interpret=None,
                  quantized_mode=None, kv_cache_dtype=None):
@@ -176,7 +159,7 @@ class LLMEngine:
                 f"{page_size}")
         self.cfg = cfg = model.config
         self.params = extract_params(model)
-        # low-bit serving weights: the jitted prefill/decode trace over a
+        # low-bit serving weights: the jitted ragged step traces over a
         # quantized pytree; projections run the fused dequant-matmul
         self.quantized_mode = quantized_mode
         if quantized_mode is not None:
@@ -185,10 +168,20 @@ class LLMEngine:
         self.max_len = max_len
         self.page_size = page_size
         self.max_pages_per_seq = max_len // page_size
+        # legacy bucket knobs: max(batch_buckets) still sets the row-slot
+        # count; pages_buckets/prefill_buckets are obsolete (the ragged
+        # step has ONE shape) and accepted only for call-site compat
+        del prefill_buckets
+        if max_num_seqs is None:
+            max_num_seqs = max(batch_buckets) if batch_buckets else 8
+        if chunk_size is None:
+            chunk_size = min(64, max_len)
+        chunk_size = min(chunk_size, max_len)
+        self.chunk_size = chunk_size
         if num_pages is None:
-            # default: every batch slot can hold a max_len sequence, so
+            # default: every row slot can hold a max_len sequence, so
             # preemption never fires unless the operator shrinks the pool
-            num_pages = max(batch_buckets) * self.max_pages_per_seq + 1
+            num_pages = max_num_seqs * self.max_pages_per_seq + 1
         if kv_cache_dtype in ("int8", jnp.int8, jnp.dtype(jnp.int8)):
             dtype = jnp.int8          # int8 pool: ~2x sequences per byte
         elif kv_cache_dtype is not None:
@@ -202,19 +195,15 @@ class LLMEngine:
         self.metrics = ServingMetrics(now_fn=now_fn)
         self.scheduler = Scheduler(
             self.pool,
-            SchedulerConfig(batch_buckets=batch_buckets,
-                            pages_buckets=pages_buckets,
+            SchedulerConfig(max_num_seqs=max_num_seqs,
+                            chunk_size=chunk_size, q_block=q_block,
+                            step_token_budget=step_token_budget,
                             max_prefills_per_step=max_prefills_per_step,
                             now_fn=now_fn),
             self.max_pages_per_seq, metrics=self.metrics)
-        self.prefill_buckets = tuple(sorted(set(
-            prefill_buckets or self._default_prefill_buckets())))
-        if max(self.prefill_buckets) < max_len:
-            raise ValueError("largest prefill bucket must reach max_len")
-        for s in self.prefill_buckets:
-            if s % page_size != 0:
-                raise ValueError(f"prefill bucket {s} not a multiple of "
-                                 f"page_size {page_size}")
+        self.max_num_seqs = self.scheduler.config.max_num_seqs
+        self.q_block = self.scheduler.config.q_block
+        self.step_token_budget = self.scheduler.config.step_token_budget
         if interpret is None:
             from ..kernels import _on_tpu
             interpret = not _on_tpu()
@@ -225,99 +214,124 @@ class LLMEngine:
         self._ids = itertools.count()
         self._seqs: dict[str, Sequence] = {}
         self._outputs: dict[str, RequestOutput] = {}
-        self._prefill_shapes: set[int] = set()
-        self._decode_shapes: set[tuple[int, int]] = set()
-        self._build_steps()
-
-    def _default_prefill_buckets(self):
-        # the pages bucket ladder scaled to token units: one bucket
-        # policy shared with the scheduler, two units
-        return [p * self.page_size for p in
-                SchedulerConfig.default_pages_buckets(
-                    self.max_pages_per_seq)]
+        self.prefix_caching = prefix_caching
+        self.prefix_cache_size = prefix_cache_size
+        #: token-chain -> (donor seq_id, chain length); valid while the
+        #: donor still owns the chain's pages (it leaves the map's truth
+        #: when the donor is freed — the probe re-validates on every hit)
+        self._prefix_cache: dict[tuple, tuple[str, int]] = {}
+        self._step_launched = False
+        self._build_step()
 
     # ------------------------------------------------------------------
-    # jitted steps (fixed shapes per bucket)
+    # the ONE jitted step (fixed shapes: any traffic mix, one executable)
     # ------------------------------------------------------------------
-    def _build_steps(self):
+    def _build_step(self):
         cfg = self.cfg
         ps = self.page_size
+        qb = self.q_block
+        T = self.step_token_budget
+        R = self.max_num_seqs
+        PPS = self.max_pages_per_seq
+        chunk_cap = self.chunk_size
         interpret = self._interpret
         quant_pool = self.pool.quantized
+        H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim)
 
-        def prefill(params, kv, kv_scales, ids, length, tbl, temp, key):
-            # ids [1, S] padded; tbl [S // ps] page ids (NULL-padded).
-            b, s = ids.shape
-            pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-            h = params["embed"][ids]
+        def ragged_step(params, kv, kv_scales, tokens, positions, tbls,
+                        q_starts, q_lens, kv_lens, last_idx, temps, key):
+            # tokens/positions [T] packed row-wise (pad rows: q_len=0,
+            # q_start=T); tbls [R, PPS]; kv_lens = committed + q_len per
+            # row (the attention length AFTER this step's appends);
+            # last_idx [R] flat index of each row's last live token.
+            tok_row = (jnp.searchsorted(q_starts,
+                                        jnp.arange(T, dtype=jnp.int32),
+                                        side="right") - 1)
+            tok_row = jnp.maximum(tok_row, 0)
+            live = (jnp.arange(T) - q_starts[tok_row]) < q_lens[tok_row]
+            h = params["embed"][tokens][None]               # [1, T, hid]
             new_kv, new_scales = [], []
-            for i, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
-                h, (k, v) = _block(lyr, h, pos, cfg)
-                # [1, S, Hkv, d] -> [Hkv, S/ps, ps, d] -> scatter to pool
-                hkv, d = cfg.num_key_value_heads, cfg.head_dim
-                kt = jnp.transpose(
-                    k[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
-                vt = jnp.transpose(
-                    v[0].reshape(s // ps, ps, hkv, d), (2, 0, 1, 3))
+            for li, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
+                x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
+                q = _wmat(x, lyr["q"]).reshape(1, T, H, d)
+                k = _wmat(x, lyr["k"]).reshape(1, T, Hkv, d)
+                v = _wmat(x, lyr["v"]).reshape(1, T, Hkv, d)
+                q = _rope(q, positions[None], cfg.rope_theta, d)
+                k = _rope(k, positions[None], cfg.rope_theta, d)
+                kt = jnp.transpose(k[0], (1, 0, 2))         # [Hkv, T, d]
+                vt = jnp.transpose(v[0], (1, 0, 2))
                 if quant_pool:
-                    # exact per-(head, page) scales from the prompt's own
-                    # amax. Padded positions are ZEROED first: the pad
-                    # token id 0 has a real embedding, so its K/V would
-                    # otherwise inflate the last partial page's scale and
-                    # coarsen the real tokens' quantization (attention
-                    # never reads past `length`, so zeroing loses nothing)
-                    Ks, Vs = kv_scales[i]
-                    valid = (jnp.arange(s) < length).reshape(
-                        s // ps, ps)[None, :, :, None]
-
-                    def _q(t):
-                        t = jnp.where(valid, t, 0.0)
-                        s_ = jnp.maximum(jnp.max(jnp.abs(t), axis=(2, 3)),
-                                         1e-8) / 127.0
-                        q_ = jnp.clip(jnp.round(t / s_[:, :, None, None]),
-                                      -127, 127).astype(jnp.int8)
-                        return q_, s_
-
-                    kq, k_s = _q(kt)
-                    vq, v_s = _q(vt)
-                    new_kv.append((Kp.at[:, tbl].set(kq),
-                                   Vp.at[:, tbl].set(vq)))
-                    new_scales.append((Ks.at[:, tbl].set(k_s),
-                                       Vs.at[:, tbl].set(v_s)))
+                    Ks, Vs = kv_scales[li]
+                    Kp, Ks, Vp, Vs = _append_quant(
+                        Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
+                        kv_lens)
+                    new_scales.append((Ks, Vs))
                 else:
-                    new_kv.append((Kp.at[:, tbl].set(kt),
-                                   Vp.at[:, tbl].set(vt)))
+                    # scatter every live token's K/V into its page slot;
+                    # dead tokens (slot padding / pad rows) land on the
+                    # null page, never on live data
+                    page_idx = jnp.clip(positions // ps, 0, PPS - 1)
+                    page = jnp.where(live, tbls[tok_row, page_idx],
+                                     NULL_PAGE)
+                    slot = page * ps + positions % ps
+                    npages = Kp.shape[1]
+                    Kp = Kp.reshape(Hkv, npages * ps, d).at[:, slot] \
+                        .set(kt).reshape(Hkv, npages, ps, d)
+                    Vp = Vp.reshape(Hkv, npages * ps, d).at[:, slot] \
+                        .set(vt).reshape(Hkv, npages, ps, d)
+                new_kv.append((Kp, Vp))
+                o = ragged_paged_attention(
+                    q[0], Kp, Vp, tbls, q_starts, q_lens, kv_lens,
+                    q_block=qb, interpret=interpret,
+                    k_scales=new_scales[-1][0] if quant_pool else None,
+                    v_scales=new_scales[-1][1] if quant_pool else None)
+                h = h + _wmat(o.reshape(1, T, H * d), lyr["o"])
+                x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
+                h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"]))
+                              * _wmat(x, lyr["up"]), lyr["down"])
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
-            last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=1,
-                                                keepdims=False)
-            logits = _logits(params, last, cfg)             # [1, V]
-            tok = _sample_rows(logits, key, temp[None])[0]
-            return tok, new_kv, new_scales if quant_pool else None
-
-        def decode(params, kv, kv_scales, tokens, tbls, lens, temps, key):
-            # tokens/lens/temps [B]; tbls [B, P]. lens = cached length per
-            # row = the write slot of this token; attention covers lens+1.
-            h = params["embed"][tokens[:, None]]
-            new_kv, new_scales = [], []
-            for i, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
-                Ks, Vs = kv_scales[i] if quant_pool else (None, None)
-                h, pair, scales = _decode_block(
-                    lyr, h, lens, cfg, Kp, Vp, tbls, lens, page_size=ps,
-                    interpret=interpret, Ks=Ks, Vs=Vs)
-                new_kv.append(pair)
-                new_scales.append(scales)
-            h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
-            logits = _logits(params, h[:, 0], cfg)          # [B, V]
+            last = h[0, last_idx]                           # [R, hid]
+            logits = _logits(params, last, cfg)             # [R, V]
             return (_sample_rows(logits, key, temps), new_kv,
                     new_scales if quant_pool else None)
 
-        # donate the pool buffers (args 1-2: pages + scales) so decode
+        def _append_quant(Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
+                          kv_lens):
+            # int8 append: a chunk writes several tokens into the same
+            # page, and each write may grow the page's running-amax scale
+            # (requantizing earlier content in place) — so walk the chunk
+            # positions sequentially; each iteration appends at most one
+            # token per row and rows own disjoint write pages, which is
+            # exactly the single-token append's contract.
+            rows = jnp.arange(tbls.shape[0])
+
+            def body(i, carry):
+                Kp, Ks, Vp, Vs = carry
+                live = i < q_lens                           # [R]
+                flat = jnp.clip(q_starts + i, 0, kt.shape[1] - 1)
+                pos = jnp.maximum(kv_lens - q_lens + i, 0)
+                page_idx = jnp.clip(pos // ps, 0, PPS - 1)
+                page = jnp.where(live, tbls[rows, page_idx], NULL_PAGE)
+                off = pos % ps
+                Kp, Ks = _quantized_append(Kp, Ks, kt[:, flat], page, off,
+                                           ps, live)
+                Vp, Vs = _quantized_append(Vp, Vs, vt[:, flat], page, off,
+                                           ps, live)
+                return Kp, Ks, Vp, Vs
+
+            # traced bound: decode-heavy launches (max q_len == 1) run one
+            # iteration, not chunk_size dead rounds — same one executable
+            # (lax lowers a traced trip count to a while_loop)
+            bound = jnp.minimum(jnp.max(q_lens), chunk_cap)
+            return jax.lax.fori_loop(0, bound, body, (Kp, Ks, Vp, Vs))
+
+        # donate the pool buffers (args 1-2: pages + scales) so the step
         # updates in place on TPU; CPU/PJRT-cpu ignores donation with a
         # warning, so skip there
         from ..kernels import _on_tpu
         donate = (1, 2) if _on_tpu() else ()
-        self._prefill_jit = jax.jit(prefill, donate_argnums=donate)
-        self._decode_jit = jax.jit(decode, donate_argnums=donate)
+        self._ragged_jit = jax.jit(ragged_step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # public API
@@ -325,7 +339,13 @@ class LLMEngine:
     def add_request(self, prompt_token_ids, *, max_new_tokens=16,
                     temperature=0.0, eos_token_id=None, deadline_s=None,
                     request_id=None):
-        """Queue a request; returns its id. Accepts a Request too."""
+        """Queue a request; returns its id. Accepts a Request too.
+
+        An unserviceable request (prompt + max_new_tokens over max_len or
+        over the pool's page limit) raises :class:`RequestRejected` AFTER
+        recording a finalized aborted output under its id — the serving
+        loop and every other in-flight request keep running.
+        """
         if isinstance(prompt_token_ids, Request):
             r = prompt_token_ids
             return self.add_request(
@@ -337,13 +357,24 @@ class LLMEngine:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        if len(prompt) + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt {len(prompt)} + max_new_tokens {max_new_tokens} "
-                f"exceeds max_len {self.max_len}")
         rid = request_id or f"req-{next(self._ids)}"
-        if rid in self._seqs:
+        if rid in self._seqs or rid in self._outputs:
             raise KeyError(f"duplicate request_id {rid!r}")
+        total = len(prompt) + max_new_tokens
+        needed = self.pool.pages_for(total)
+        limit = min(self.pool.capacity, self.max_pages_per_seq)
+        if total > self.max_len or needed > limit:
+            self._outputs[rid] = RequestOutput(
+                rid, prompt, status="aborted",
+                finish_reason="rejected_oversize")
+            self.metrics.rejected_requests.inc()
+            raise RequestRejected(
+                rid, "rejected_oversize", needed_pages=needed, limit=limit,
+                message=(
+                    f"request {rid}: prompt {len(prompt)} + "
+                    f"max_new_tokens {max_new_tokens} needs {needed} pages "
+                    f"(limit {limit}) / {total} tokens (max_len "
+                    f"{self.max_len}) — rejected at admission"))
         now = self._now()
         seq = Sequence(
             seq_id=rid, prompt_ids=prompt, max_new_tokens=max_new_tokens,
@@ -386,7 +417,7 @@ class LLMEngine:
                 f"request {request_id!r} is still {out.status}; "
                 f"cancel() it before release()")
         del self._outputs[request_id]
-        del self._seqs[request_id]
+        self._seqs.pop(request_id, None)
         return out
 
     def metrics_snapshot(self) -> dict:
@@ -395,35 +426,54 @@ class LLMEngine:
         return snap
 
     def decode_cache_size(self):
-        """Actual XLA compile count of the decode step (falls back to the
-        bucket-signature count when the jit cache is not introspectable)."""
+        """Actual XLA compile count of the ragged step — the compile gate
+        asserts this stays 1 under ANY traffic mix (falls back to the
+        launch-signature count when the jit cache is not introspectable).
+        """
         try:
-            return int(self._decode_jit._cache_size())
+            return int(self._ragged_jit._cache_size())
         except Exception:
-            return len(self._decode_shapes)
+            return 1 if self._step_launched else 0
 
     def step(self):
-        """One scheduler round: shed -> admit+prefill -> decode batch.
-        Returns the RequestOutputs touched this step (token streamed,
-        finished, shed, or preempted)."""
+        """One scheduler round: shed -> admit (prefix-fork) -> one ragged
+        launch covering every running row (decode steps and prefill
+        chunks interleaved). Returns the RequestOutputs touched this step
+        (admitted, token streamed, finished, shed, or preempted)."""
         touched = {}
         for seq in self.scheduler.shed_expired():
             self._finalize(seq, "shed")
             touched[seq.seq_id] = self._outputs[seq.seq_id]
-        for seq in self.scheduler.admit():
-            tok = self._prefill_seq(seq)
-            self._commit_token(seq, tok)
-            touched[seq.seq_id] = self._outputs[seq.seq_id]
-        plan = self.scheduler.prepare_decode()
+        hook = self._prefix_probe if self.prefix_caching else None
+        for seq in self.scheduler.admit(prefix_hook=hook):
+            touched[seq.seq_id] = self._sync_output(seq)
+        plan = self.scheduler.prepare_step()
         for t in self.scheduler.last_preempted:
             self._sync_output(t)           # surface fresh preemptions once
             touched[t.seq_id] = self._outputs[t.seq_id]
         if plan is not None:
-            tokens = self._decode_plan(plan)
-            for seq, tok in zip(plan.seqs, tokens):
-                self._commit_token(seq, int(tok))
+            if plan.cow_copies:
+                self.metrics.cow_copies.inc(plan.cow_copies)
+            sampled = self._launch(plan)
+            for i, (seq, q_start, q_len) in enumerate(plan.rows):
+                before = seq.cached_len
+                seq.cached_len += q_len
+                # a prefill-chunk row is one that committed prompt tokens
+                # (incl. a 1-token final chunk) or any multi-token
+                # recompute chunk; pure decode rows start caught-up past
+                # the prompt
+                if q_len > 1 or before < len(seq.prompt_ids):
+                    self.metrics.prefill_chunks.inc()
+                if self.prefix_caching and \
+                        before < len(seq.prompt_ids) <= seq.cached_len:
+                    self._register_prefix(seq)
+                if seq.cached_len == seq.total_len:
+                    # the row is caught up: its sampled token is the next
+                    # generated token. Mid-prompt chunks discard theirs.
+                    self._commit_token(seq, int(sampled[i]))
                 touched[seq.seq_id] = self._outputs[seq.seq_id]
             self.metrics.decode_steps.inc()
+            self.metrics.ragged_pad_fraction.set(plan.pad_fraction)
         self.metrics.record_step(self.scheduler, self.pool)
         return list(touched.values())
 
@@ -439,57 +489,112 @@ class LLMEngine:
         return self.outputs()
 
     # ------------------------------------------------------------------
+    # prefix cache
+    # ------------------------------------------------------------------
+    def _register_prefix(self, seq: Sequence):
+        """Index the sequence's prompt as a fork donor: one entry per
+        page-aligned prefix plus the full prompt (the identical-prompt
+        fast path, which shares even the partial tail page). Newest
+        registration wins, so a chain stays alive as long as ANY sharer
+        of its pages is — entries whose donor left the pool fail the
+        probe's liveness re-validation and are simply re-prefilled. The
+        map is LRU-bounded (``prefix_cache_size``): re-registration
+        refreshes recency, the oldest entries fall off — a long-running
+        server's cache footprint is capped, not proportional to every
+        prompt ever served."""
+        P = seq.prompt_ids
+        ps = self.page_size
+        for j in list(range(ps, len(P) + 1, ps)) + [len(P)]:
+            key = tuple(P[:j])
+            self._prefix_cache.pop(key, None)      # refresh LRU position
+            self._prefix_cache[key] = (seq.seq_id, j)
+        while len(self._prefix_cache) > self.prefix_cache_size:
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+
+    def _prefix_probe(self, seq: Sequence) -> int:
+        """Admission hook: longest registered chain matching the prompt
+        -> fork the donor's pages. Returns the shared (committed) token
+        count, 0 on miss. The last prompt token is never shared — its
+        logits must be computed to sample the first generated token — so
+        an identical prompt re-runs exactly one token, whose append
+        copy-on-writes the shared tail page."""
+        P = seq.prompt_ids
+        ps = self.page_size
+        cands = sorted({len(P)} | set(range(ps, len(P) + 1, ps)),
+                       reverse=True)
+        for j in cands:
+            ent = self._prefix_cache.get(tuple(P[:j]))
+            if ent is None:
+                continue
+            donor, length = ent
+            if donor == seq.seq_id or donor not in self.pool:
+                continue
+            if self.pool.seq_len(donor) < length:
+                continue
+            # a request_id can be reused after release(): the entry's
+            # donor id may now name a DIFFERENT prompt's pages, so the
+            # chain must be re-validated against the donor's actual
+            # prompt tokens, not just its liveness
+            donor_seq = self._seqs.get(donor)
+            if donor_seq is None or \
+                    donor_seq.prompt_ids[:j] != P[:j]:
+                continue
+            shared = min(j, len(P) - 1)
+            if self.pool.quantized:
+                # int8 pages requantize in place on append; only FULL
+                # (append-free) pages are safe to share without a copy
+                shared = (shared // ps) * ps
+            if shared < 1:
+                continue
+            self.pool.fork(seq.seq_id, donor, num_tokens=shared)
+            self.metrics.prefix_cache_hits.inc()
+            return shared
+        self.metrics.prefix_cache_misses.inc()
+        return 0
+
+    # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _prefill_seq(self, seq: Sequence) -> int:
-        ids = seq.prompt_ids + seq.tokens      # recompute mode on requeue
-        L = len(ids)
-        S = bucket_for(L, self.prefill_buckets)
-        if S not in self._prefill_shapes:
-            self._prefill_shapes.add(S)
-            self.metrics.prefill_compiles.inc()
-        padded = np.zeros((1, S), np.int32)
-        padded[0, :L] = ids
-        tbl = np.asarray(
-            self.pool.padded_block_table(seq.seq_id, S // self.page_size),
-            np.int32)
-        tok, new_kv, new_scales = self._prefill_jit(
-            self.params, self.pool.kv, self.pool.kv_scales,
-            jnp.asarray(padded), np.int32(L), jnp.asarray(tbl),
-            np.float32(seq.temperature), self._next_key())
-        self.pool.kv = new_kv
-        if new_scales is not None:
-            self.pool.kv_scales = new_scales
-        self.metrics.prefills.inc()
-        return int(tok)
-
-    def _decode_plan(self, plan):
-        B, P = plan.batch_bucket, plan.pages_bucket
-        if (B, P) not in self._decode_shapes:
-            self._decode_shapes.add((B, P))
+    def _launch(self, plan):
+        """Assemble the fixed-shape operands for the plan and run the one
+        ragged-step executable."""
+        T, R, PPS = plan.token_budget, plan.num_slots, self.max_pages_per_seq
+        if not self._step_launched:
+            self._step_launched = True
             self.metrics.decode_compiles.inc()
-        tokens = np.zeros((B,), np.int32)
-        tbls = np.full((B, P), NULL_PAGE, np.int32)
-        lens = np.zeros((B,), np.int32)
-        temps = np.zeros((B,), np.float32)
-        for i, seq in enumerate(plan.seqs):
-            tokens[i] = seq.tokens[-1]
-            table = self.pool.padded_block_table(seq.seq_id, P)
-            tbls[i] = table
-            lens[i] = seq.total_len - 1        # cached length = write slot
+        tokens = np.zeros((T,), np.int32)
+        positions = np.zeros((T,), np.int32)
+        tbls = np.full((R, PPS), NULL_PAGE, np.int32)
+        q_starts = np.full((R,), T, np.int32)   # pad rows: start past T
+        q_lens = np.zeros((R,), np.int32)
+        kv_lens = np.zeros((R,), np.int32)
+        last_idx = np.zeros((R,), np.int32)
+        temps = np.zeros((R,), np.float32)
+        for i, (seq, q_start, q_len) in enumerate(plan.rows):
+            ids = seq.all_ids
+            lo = seq.cached_len
+            tokens[q_start:q_start + q_len] = ids[lo:lo + q_len]
+            positions[q_start:q_start + q_len] = np.arange(lo, lo + q_len)
+            tbls[i] = self.pool.padded_block_table(seq.seq_id, PPS)
+            q_starts[i] = q_start
+            q_lens[i] = q_len
+            kv_lens[i] = lo + q_len
+            last_idx[i] = q_start + q_len - 1
             temps[i] = seq.temperature
-        next_toks, new_kv, new_scales = self._decode_jit(
+        sampled, new_kv, new_scales = self._ragged_jit(
             self.params, self.pool.kv, self.pool.kv_scales,
-            jnp.asarray(tokens), jnp.asarray(tbls), jnp.asarray(lens),
+            jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
+            jnp.asarray(q_starts), jnp.asarray(q_lens),
+            jnp.asarray(kv_lens), jnp.asarray(last_idx),
             jnp.asarray(temps), self._next_key())
         self.pool.kv = new_kv
         if new_scales is not None:
             self.pool.kv_scales = new_scales
-        return np.asarray(next_toks)[:len(plan.seqs)]
+        return np.asarray(sampled)
 
     def _commit_token(self, seq: Sequence, tok: int):
         seq.tokens.append(int(tok))
@@ -527,4 +632,4 @@ class LLMEngine:
         return out
 
 
-__all__ = ["LLMEngine", "Request", "RequestOutput"]
+__all__ = ["LLMEngine", "Request", "RequestOutput", "RequestRejected"]

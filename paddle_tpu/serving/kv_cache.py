@@ -1,51 +1,87 @@
 """Paged KV-pool manager — the allocator under the serving engine.
 
-The Pallas decode kernel (kernels/paged_attention.py) already consumes a
-paged pool ``[num_kv_heads, num_pages, page_size, head_dim]`` plus per-
-sequence block tables; what was missing above it is ownership: which pool
-page belongs to which live sequence, and what happens when the pool runs
-dry. This module is that layer (the TPU analog of vLLM's BlockSpaceManager
-and of the reference's block_multi_head_attention cache manager):
+The Pallas ragged kernel (kernels/paged_attention.py) consumes a paged
+pool ``[num_kv_heads, num_pages, page_size, head_dim]`` plus per-sequence
+block tables; this module owns the layer above it: which pool page
+belongs to which live sequence, and what happens when the pool runs dry.
+It is the TPU analog of vLLM's BlockSpaceManager and of the reference's
+block_multi_head_attention cache manager:
 
 - a free-list allocator over pool pages — page granularity means there is
   no external fragmentation by construction: any request for n free pages
   succeeds iff n pages are free;
 - per-sequence block tables (logical page i of a sequence -> pool page),
-  grown one page at a time as decode crosses page boundaries;
-- pool page 0 is reserved as the NULL page: padded batch rows and padded
-  block-table slots all point at it, so fixed-shape bucketed launches have
+  grown one page at a time as decode/prefill-chunks cross page boundaries;
+- pool page 0 is reserved as the NULL page: padded rows and padded
+  block-table slots all point at it, so fixed-shape ragged launches have
   a safe write/read target that never aliases live data;
+- **copy-on-write page sharing**: every mapped page carries a refcount.
+  ``fork(child, parent, num_tokens)`` maps the parent's pages covering a
+  shared prompt prefix into the child's table (refcount + 1, zero data
+  movement) — identical system prompts across millions of users occupy
+  ONE set of pool pages. A page is copied only when an owner is about to
+  APPEND into a page someone else also maps (``prepare_append``): full
+  prefix pages are append-free and therefore shared forever; only a
+  partially-filled tail page is ever duplicated, right before the first
+  divergent append. ``free`` decrements refcounts and recycles a page
+  only when the last owner drops it;
 - utilization watermarks the scheduler uses for admission control and
   preemption decisions.
 
 Low-bit pools (``dtype=jnp.int8``): K/V pages are stored int8 with one
 fp32 scale per (kv head, page) — ``kv_scales``, one (Ks, Vs) pair per
 layer, shape [num_kv_heads, num_pages]. The engine quantizes on append
-and the paged-attention kernel dequantizes at the gather (scales ride the
-scalar-prefetch channel into SMEM). A page costs ~1/4 the fp32 bytes, so
-the same HBM budget holds ~4x the pages (~2x vs bf16) and the scheduler
-admits correspondingly more concurrent sequences at the same watermark —
-``pages_for_byte_budget`` is the accounting the sizing test gates.
+and the ragged kernel dequantizes at the gather (scales ride the
+scalar-prefetch channel into SMEM). Shared pages interact with the
+scales safely only because shared pages are never appended into without
+a CoW copy first: an append can requantize the whole page in place
+(running-amax scale growth), which would perturb every other reader —
+so the engine restricts int8 prefix sharing to FULL pages, which are
+append-free, and ``cow_page`` copies the page's scale row with its data.
 
 The device arrays themselves live in ``kv`` (one (K, V) pair per layer)
-and are updated *functionally* by the engine's jitted prefill/decode steps
-(the engine reassigns ``kv`` after each donated call); this class tracks
-only the host-side ownership metadata.
+and are updated *functionally* by the engine's jitted ragged step (the
+engine reassigns ``kv`` after each donated call); this class tracks the
+host-side ownership metadata plus the eager CoW/scale-reset fixups.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
+def _copy_pages(kv, old_idx, new_idx):
+    """Duplicate pool pages ``old_idx`` into ``new_idx`` across every
+    layer's (K, V) pair — the device side of copy-on-write."""
+    return [(K.at[:, new_idx].set(K[:, old_idx]),
+             V.at[:, new_idx].set(V[:, old_idx])) for K, V in kv]
+
+
+_COPY_JIT = None
+
+
+def _copy_pages_jit(kv, old_idx, new_idx):
+    """One jitted (donated on TPU) scatter per CoW batch: only the
+    affected page slices move, instead of a full functional copy of the
+    pool per page per layer. Re-traces per distinct batch size — CoW
+    batches are almost always 1 page."""
+    global _COPY_JIT
+    if _COPY_JIT is None:
+        from ..kernels import _on_tpu
+        donate = (0,) if _on_tpu() else ()
+        _COPY_JIT = jax.jit(_copy_pages, donate_argnums=donate)
+    return _COPY_JIT(kv, old_idx, new_idx)
+
+
 class PoolExhausted(RuntimeError):
-    """Raised when an alloc/extend needs more free pages than exist."""
+    """Raised when an alloc/extend/CoW needs more free pages than exist."""
 
 
 NULL_PAGE = 0
 
 
 class PagedKVPool:
-    """Free-list page allocator + per-sequence block tables over the pool.
+    """Refcounted free-list page allocator + per-sequence block tables.
 
     capacity = ``num_pages - 1`` allocatable pages (page 0 is the null
     page). ``seq_lens`` tracks the token count the engine has committed
@@ -85,6 +121,10 @@ class PagedKVPool:
         self._free = list(range(num_pages - 1, NULL_PAGE, -1))
         self._tables: dict[object, list[int]] = {}
         self._lens: dict[object, int] = {}
+        #: pool page -> number of sequences mapping it (0 for free pages)
+        self._refcounts = [0] * num_pages
+        #: lifetime count of copy-on-write page duplications
+        self.cow_copies = 0
 
     # ---- byte accounting (pool sizing / bench fields) ----
     @staticmethod
@@ -143,6 +183,26 @@ class PagedKVPool:
     def utilization(self) -> float:
         return self.used_pages / self.capacity
 
+    @property
+    def logical_pages(self) -> int:
+        """Block-table slots across live sequences — what the pool WOULD
+        hold without sharing."""
+        return sum(len(t) for t in self._tables.values())
+
+    @property
+    def shared_page_fraction(self) -> float:
+        """Fraction of logical pages served by a shared physical page:
+        ``1 - physical/logical``. 0.0 with no sharing; approaches
+        ``(N-1)/N`` when N sequences share one long prefix — the
+        admitted-sequences-per-byte win prefix caching exists for."""
+        logical = self.logical_pages
+        if logical == 0:
+            return 0.0
+        return 1.0 - self.used_pages / logical
+
+    def page_refcount(self, page: int) -> int:
+        return self._refcounts[page]
+
     def above_high_watermark(self, extra_pages=0) -> bool:
         return (self.used_pages + extra_pages) / self.capacity \
             > self.high_watermark
@@ -157,19 +217,51 @@ class PagedKVPool:
         return self.pages_for(num_tokens) <= len(self._free)
 
     # ---- lifecycle ----
+    def _claim(self, n: int, what: str) -> list[int]:
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"{what}: need {n} pages, {len(self._free)} free of "
+                f"{self.capacity}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refcounts[p] = 1
+        return pages
+
     def allocate(self, seq_id, num_tokens: int) -> list[int]:
         """Claim pages for a new sequence of ``num_tokens`` tokens."""
         if seq_id in self._tables:
             raise KeyError(f"sequence {seq_id!r} already has an allocation")
-        n = self.pages_for(num_tokens)
-        if n > len(self._free):
-            raise PoolExhausted(
-                f"need {n} pages for {num_tokens} tokens, "
-                f"{len(self._free)} free of {self.capacity}")
-        pages = [self._free.pop() for _ in range(n)]
+        pages = self._claim(self.pages_for(num_tokens),
+                            f"allocate {num_tokens} tokens")
         self._tables[seq_id] = pages
         self._lens[seq_id] = num_tokens
         return pages
+
+    def fork(self, seq_id, parent_id, num_tokens: int | None = None
+             ) -> list[int]:
+        """Map the parent's pages covering its first ``num_tokens``
+        tokens (default: every FULL page of the parent's committed
+        prefix) into a new sequence ``seq_id`` — zero data movement,
+        refcount + 1 per shared page. The child starts with
+        ``seq_len(seq_id) == num_tokens`` committed tokens; its first
+        append into a partially-filled shared tail page triggers a
+        copy-on-write duplication (``prepare_append``)."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already has an allocation")
+        parent = self._tables[parent_id]
+        if num_tokens is None:
+            num_tokens = (self._lens[parent_id] // self.page_size) \
+                * self.page_size
+        if num_tokens > self._lens[parent_id]:
+            raise ValueError(
+                f"fork of {num_tokens} tokens exceeds parent "
+                f"{parent_id!r}'s committed {self._lens[parent_id]}")
+        shared = parent[:self.pages_for(num_tokens)]
+        for p in shared:
+            self._refcounts[p] += 1
+        self._tables[seq_id] = list(shared)
+        self._lens[seq_id] = num_tokens
+        return list(shared)
 
     def extend(self, seq_id, new_len: int) -> list[int]:
         """Grow ``seq_id``'s table to cover ``new_len`` tokens; returns the
@@ -177,31 +269,84 @@ class PagedKVPool:
         """
         table = self._tables[seq_id]
         need = self.pages_for(new_len) - len(table)
-        if need > len(self._free):
-            raise PoolExhausted(
-                f"sequence {seq_id!r} needs {need} more pages, "
-                f"{len(self._free)} free of {self.capacity}")
-        fresh = [self._free.pop() for _ in range(max(need, 0))]
+        fresh = self._claim(max(need, 0),
+                            f"extend {seq_id!r} to {new_len} tokens")
         table.extend(fresh)
         self._lens[seq_id] = max(new_len, self._lens[seq_id])
         return fresh
 
+    def prepare_append(self, seq_id, new_len: int) -> int:
+        """Make ``[seq_len, new_len)`` safely writable for ``seq_id``:
+        claim fresh pages past the table's end AND copy-on-write every
+        SHARED page the append range touches (a shared page may have
+        other readers — dup it before the first divergent write).
+        Commits ``seq_len = new_len``. All-or-nothing on exhaustion
+        (fresh + CoW pages are counted up front). Returns the number of
+        CoW copies performed (the metrics counter's increment)."""
+        table = self._tables[seq_id]
+        old_len = self._lens[seq_id]
+        if new_len < old_len:
+            raise ValueError(f"append cannot shrink {seq_id!r}: "
+                             f"{old_len} -> {new_len}")
+        need_fresh = max(self.pages_for(new_len) - len(table), 0)
+        first = old_len // self.page_size
+        last = self.pages_for(new_len)          # exclusive logical bound
+        shared = [i for i in range(first, min(last, len(table)))
+                  if self._refcounts[table[i]] > 1]
+        if need_fresh + len(shared) > len(self._free):
+            raise PoolExhausted(
+                f"append {seq_id!r} to {new_len} tokens: need "
+                f"{need_fresh} fresh + {len(shared)} CoW pages, "
+                f"{len(self._free)} free of {self.capacity}")
+        olds, news = [], []
+        for i in shared:
+            old = table[i]
+            new = self._claim(1, f"CoW for {seq_id!r}")[0]
+            self._refcounts[old] -= 1
+            table[i] = new
+            olds.append(old)
+            news.append(new)
+        if olds:
+            # one batched device copy for the whole CoW set: page data
+            # and (for int8 pools) the pages' scale columns travel
+            # together — a duplicated page must dequantize identically
+            old_idx = jnp.asarray(olds, jnp.int32)
+            new_idx = jnp.asarray(news, jnp.int32)
+            self.kv = _copy_pages_jit(self.kv, old_idx, new_idx)
+            if self.kv_scales is not None:
+                self.kv_scales = [
+                    (Ks.at[:, new_idx].set(Ks[:, old_idx]),
+                     Vs.at[:, new_idx].set(Vs[:, old_idx]))
+                    for Ks, Vs in self.kv_scales]
+            self.cow_copies += len(olds)
+        self.extend(seq_id, new_len)
+        self._lens[seq_id] = new_len
+        return len(olds)
+
     def free(self, seq_id) -> int:
-        """Release every page the sequence owns; returns the page count."""
+        """Drop every page mapping the sequence owns; a page is recycled
+        (returned to the free list) only when its refcount hits zero.
+        Returns the number of pages actually recycled."""
         pages = self._tables.pop(seq_id)
         self._lens.pop(seq_id, None)
-        self._free.extend(reversed(pages))
-        if self.kv_scales is not None and pages:
-            # reset the freed pages' dequant scales: the append path's
-            # running max (engine._quantized_append) only ever GROWS a
+        recycled = []
+        for p in reversed(pages):
+            self._refcounts[p] -= 1
+            if self._refcounts[p] == 0:
+                recycled.append(p)
+        self._free.extend(recycled)
+        if self.kv_scales is not None and recycled:
+            # reset the recycled pages' dequant scales: the append path's
+            # running max (engine's quantized append) only ever GROWS a
             # scale, so a recycled page must not hand its next tenant the
             # previous sequence's (possibly much larger) range — that
-            # would quantize small new values straight to zero
-            idx = jnp.asarray(pages, jnp.int32)
+            # would quantize small new values straight to zero. Pages
+            # still mapped by other sequences keep their scales.
+            idx = jnp.asarray(recycled, jnp.int32)
             self.kv_scales = [(Ks.at[:, idx].set(0.0),
                                Vs.at[:, idx].set(0.0))
                               for Ks, Vs in self.kv_scales]
-        return len(pages)
+        return len(recycled)
 
     # ---- queries ----
     def __contains__(self, seq_id) -> bool:
@@ -221,25 +366,48 @@ class PagedKVPool:
         self._lens[seq_id] = n
 
     def padded_block_table(self, seq_id, pages: int) -> list[int]:
-        """Block table padded with NULL_PAGE to a fixed bucket width."""
+        """Block table padded with NULL_PAGE to a fixed launch width."""
         table = self._tables[seq_id]
         if len(table) > pages:
             raise ValueError(
-                f"{seq_id!r} owns {len(table)} pages > bucket {pages}")
+                f"{seq_id!r} owns {len(table)} pages > launch width {pages}")
         return table + [NULL_PAGE] * (pages - len(table))
 
     def live_sequences(self):
         return list(self._tables)
 
     def check_invariants(self):
-        """Debug/test hook: every page owned exactly once, free+used=cap."""
-        owned = [p for t in self._tables.values() for p in t]
-        seen = set(owned)
-        assert len(owned) == len(seen), "a pool page is owned twice"
-        assert NULL_PAGE not in seen, "null page leaked into a block table"
-        assert not (seen & set(self._free)), "page both owned and free"
-        assert len(owned) + len(self._free) == self.capacity, \
+        """Debug/test hook: refcount/free-list/table consistency.
+
+        - every mapped page's refcount equals the number of tables
+          mapping it (and is therefore >= 1);
+        - every free page has refcount 0 and no free page is mapped;
+        - distinct physical pages in use + free pages == capacity;
+        - the null page is never mapped and never on the free list.
+        """
+        mapped: dict[int, int] = {}
+        for t in self._tables.values():
+            seen_in_table = set()
+            for p in t:
+                assert p not in seen_in_table, \
+                    "a table maps the same pool page twice"
+                seen_in_table.add(p)
+                mapped[p] = mapped.get(p, 0) + 1
+        assert NULL_PAGE not in mapped, "null page leaked into a table"
+        assert NULL_PAGE not in self._free, "null page on the free list"
+        for p, owners in mapped.items():
+            assert self._refcounts[p] == owners, (
+                f"page {p}: refcount {self._refcounts[p]} != "
+                f"{owners} owners")
+        free_set = set(self._free)
+        assert len(free_set) == len(self._free), "free list has duplicates"
+        assert not (free_set & set(mapped)), "page both mapped and free"
+        for p in self._free:
+            assert self._refcounts[p] == 0, \
+                f"free page {p} has refcount {self._refcounts[p]}"
+        assert len(mapped) + len(self._free) == self.capacity, \
             "page accounting leak"
+        assert self.used_pages == len(mapped)
         return True
 
 

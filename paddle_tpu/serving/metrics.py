@@ -40,12 +40,14 @@ class Gauge:
 
 
 class ServingMetrics:
-    COUNTERS = ("requests_added", "tokens_generated", "prefills",
-                "decode_steps", "preemptions", "shed_requests",
-                "cancelled_requests", "finished_requests",
-                "decode_compiles", "prefill_compiles")
+    COUNTERS = ("requests_added", "rejected_requests", "tokens_generated",
+                "prefills", "prefill_chunks", "decode_steps", "preemptions",
+                "shed_requests", "cancelled_requests", "finished_requests",
+                "decode_compiles", "cow_copies", "prefix_cache_hits",
+                "prefix_cache_misses")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
-              "page_utilization", "tokens_per_s")
+              "page_utilization", "tokens_per_s", "ragged_pad_fraction",
+              "shared_page_fraction")
 
     #: tokens_per_s is the rate over this trailing window, not a lifetime
     #: average — a lifetime average decays toward zero across idle gaps
@@ -66,6 +68,8 @@ class ServingMetrics:
         self.running_seqs.set(len(scheduler.running))
         self.waiting_seqs.set(len(scheduler.waiting))
         self.page_utilization.set(pool.utilization)
+        self.shared_page_fraction.set(
+            getattr(pool, "shared_page_fraction", 0.0))
         now = self._now()
         self._rate_samples.append((now, self.tokens_generated.value))
         while len(self._rate_samples) > 2 and \

@@ -1,32 +1,47 @@
-"""Continuous-batching scheduler over the paged KV pool.
+"""Continuous-batching scheduler over the paged KV pool — ragged edition.
 
-The TPU-shaped constraint this scheduler exists for: XLA compiles one
-executable per input *shape*, so the decode batch must be assembled into a
-small closed set of **shape buckets** — (batch rows, pages per sequence)
-padded up to the nearest bucket — and never into whatever ragged
-composition the traffic happens to produce. With B batch buckets and P
-page buckets the engine compiles at most B*P decode executables for the
-lifetime of the process (gated by tests/test_serving_compile_gate.py);
-everything dynamic (which request sits in which row, how long it is, which
-pool pages it owns) travels as *data* through block tables and length
-vectors.
+The old TPU-shaped constraint (XLA compiles one executable per input
+*shape*) used to force decode batches into a closed set of
+(batch, pages) shape buckets plus a separate bucketed prefill ladder —
+up to B*P + #prefill_buckets executables. The ragged kernel
+(kernels/paged_attention.py) removes the constraint at the source: every
+engine step is ONE launch of ONE fixed shape — ``max_num_seqs`` row
+slots over a ``step_token_budget``-token query buffer — and everything
+request-specific (which row, how many query tokens, which pool pages)
+travels as *data* through block tables and (q_start, q_len, kv_len)
+metadata. The engine therefore compiles exactly one step executable for
+the lifetime of the process (tests/test_serving_compile_gate.py).
 
-Policies (the serving study arxiv 2605.25645 and RPA arxiv 2604.15464
-shapes, vLLM idiom):
+A step row is a (sequence, q_len) pair and there is NO prefill/decode
+distinction: each sequence has ``cached_len`` tokens committed to the KV
+pool out of ``total_len`` known tokens (prompt + generated), and a row
+processes the next ``q_len = min(remaining, chunk_size, budget share)``
+of them. A fully-caught-up sequence has exactly one uncached token (its
+last sampled one) — its row is a decode step, q_len = 1, by the same
+formula. A freshly admitted prompt is processed in ``chunk_size``-token
+chunks across consecutive steps, INTERLEAVED with every running decode
+row in the same launch — long prompts no longer head-of-line-block
+decodes behind ``max_prefills_per_step`` whole-prompt prefills; the
+budget reserves ``q_block`` tokens per running row first, so decode
+progress per step is guaranteed by construction.
+
+Policies (serving study arxiv 2605.25645, RPA arxiv 2604.15464, vLLM):
 - admission: FIFO queue; a request is admitted when the pool can hold its
-  current tokens and utilization stays under the high watermark (the
-  watermark guard is waived when nothing is running, so a big request
-  cannot deadlock an empty engine). At most ``max_prefills_per_step``
-  admissions per engine step so prefill never starves running decodes.
+  FIRST chunk and utilization stays under the high watermark (waived when
+  nothing is running, so a big request cannot deadlock an empty engine).
+  At most ``max_prefills_per_step`` admissions per engine step. An
+  optional ``prefix_hook`` (the engine's prefix cache) may fork the
+  request onto a live sequence's matching prompt-prefix pages, skipping
+  both the re-prefill and the page storage for the shared region.
 - deadline load shedding: a *waiting* request whose deadline has passed is
-  shed at schedule time (it would miss SLO anyway — do not burn pool pages
-  on it). Running requests are never shed.
-- preemption-with-requeue: when a running sequence cannot grow into its
-  next page, victims are preempted latest-arrival-first (freeing whole
-  sequences, not single pages), their generated tokens are kept, and they
-  re-enter the *front* of the queue in recompute mode: on re-admission the
-  engine prefills prompt+generated and decoding resumes — greedy outputs
-  are therefore identical with and without preemption.
+  shed at schedule time. Running requests are never shed.
+- preemption-with-requeue: when a sequence cannot grow into its next
+  page, victims are preempted latest-arrival-first, their generated
+  tokens kept, and they re-enter the *front* of the queue in recompute
+  mode (``cached_len`` reset to 0): on re-admission the engine re-chunks
+  prompt+generated and decoding resumes — the ragged step computes each
+  token's K/V identically regardless of chunk boundaries, so greedy
+  outputs are identical with and without preemption.
 """
 from __future__ import annotations
 
@@ -49,7 +64,9 @@ class SequenceStatus(enum.Enum):
 
 
 def bucket_for(n: int, buckets) -> int:
-    """Smallest bucket >= n (buckets need not be sorted)."""
+    """Smallest bucket >= n (buckets need not be sorted). Kept for the
+    legacy bucketed callers/tests; the ragged step itself has one shape
+    and never buckets."""
     best = None
     for b in buckets:
         if b >= n and (best is None or b < best):
@@ -72,46 +89,81 @@ class Sequence:
     tokens: list = field(default_factory=list)      # generated so far
     status: SequenceStatus = SequenceStatus.WAITING
     num_preemptions: int = 0
+    #: tokens whose K/V is committed to the pool (prefix-cache fork sets
+    #: it to the shared length at admission; preemption resets it to 0)
+    cached_len: int = 0
 
     @property
     def total_len(self) -> int:
-        """Tokens committed to the KV cache (prompt + generated)."""
+        """Tokens the engine knows (prompt + generated)."""
         return len(self.prompt_ids) + len(self.tokens)
 
     @property
     def remaining_new_tokens(self) -> int:
         return self.max_new_tokens - len(self.tokens)
 
+    @property
+    def uncached_len(self) -> int:
+        """Known tokens not yet in the pool — 1 for a caught-up decode
+        row, more while the prompt is still being chunked in."""
+        return self.total_len - self.cached_len
+
+    @property
+    def all_ids(self) -> list:
+        return self.prompt_ids + self.tokens
+
 
 @dataclass
-class DecodePlan:
-    """One fixed-shape decode launch: ``seqs`` padded to ``batch_bucket``
-    rows, block tables padded to ``pages_bucket`` columns."""
-    seqs: list
-    batch_bucket: int
-    pages_bucket: int
+class StepPlan:
+    """One fixed-shape ragged launch: ``rows`` are (seq, q_start, q_len)
+    with slot starts aligned to ``q_block``, packed into a
+    ``token_budget``-token query buffer over ``num_slots`` row slots."""
+    rows: list                 # [(Sequence, q_start, q_len)]
+    num_slots: int             # fixed row-slot count (max_num_seqs)
+    token_budget: int          # fixed packed-query length
+    cow_copies: int = 0        # copy-on-write page dups this step
+
+    @property
+    def actual_q_tokens(self) -> int:
+        return sum(q_len for _, _, q_len in self.rows)
+
+    @property
+    def pad_fraction(self) -> float:
+        return 1.0 - self.actual_q_tokens / self.token_budget
 
 
 class SchedulerConfig:
-    def __init__(self, *, batch_buckets=(1, 2, 4, 8), pages_buckets=None,
-                 max_prefills_per_step=4, now_fn=time.monotonic):
-        self.batch_buckets = tuple(sorted(set(batch_buckets)))
-        self.pages_buckets = (tuple(sorted(set(pages_buckets)))
-                              if pages_buckets is not None else None)
+    def __init__(self, *, max_num_seqs=None, chunk_size=32, q_block=8,
+                 step_token_budget=None, max_prefills_per_step=4,
+                 now_fn=time.monotonic, batch_buckets=None,
+                 pages_buckets=None):
+        # legacy bucket knobs: max(batch_buckets) used to bound the decode
+        # batch — it still sets the row-slot count when max_num_seqs is
+        # not given; pages_buckets is obsolete (one launch shape) and
+        # accepted only so older callers keep working
+        if max_num_seqs is None:
+            max_num_seqs = max(batch_buckets) if batch_buckets else 8
+        if chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+        if q_block < 1:
+            raise ValueError("q_block must be >= 1")
+        self.max_num_seqs = int(max_num_seqs)
+        self.q_block = int(q_block)
+        self.chunk_size = int(chunk_size)
+        if step_token_budget is None:
+            step_token_budget = self.max_num_seqs * self.q_block + \
+                -(-self.chunk_size // self.q_block) * self.q_block
+        if step_token_budget % self.q_block != 0:
+            raise ValueError(
+                f"step_token_budget {step_token_budget} not a multiple of "
+                f"q_block {self.q_block}")
+        if step_token_budget < self.max_num_seqs * self.q_block:
+            raise ValueError(
+                "step_token_budget must reserve q_block tokens per row "
+                f"({self.max_num_seqs} rows x q_block {self.q_block})")
+        self.step_token_budget = int(step_token_budget)
         self.max_prefills_per_step = max_prefills_per_step
         self.now_fn = now_fn
-
-    @staticmethod
-    def default_pages_buckets(max_pages_per_seq: int):
-        """Powers of two up to (and always including) the per-seq max.
-        The engine's default prefill buckets are this ladder scaled by
-        page_size — one bucket policy, two units."""
-        out, b = [], 1
-        while b < max_pages_per_seq:
-            out.append(b)
-            b *= 2
-        out.append(max_pages_per_seq)
-        return tuple(sorted(set(out)))
 
 
 class Scheduler:
@@ -120,26 +172,23 @@ class Scheduler:
         self.pool = pool
         self.config = config
         self.max_pages_per_seq = max_pages_per_seq
-        self.pages_buckets = (config.pages_buckets or
-                              SchedulerConfig.default_pages_buckets(
-                                  max_pages_per_seq))
-        if max(self.pages_buckets) > max_pages_per_seq:
-            raise ValueError("pages bucket exceeds max pages per sequence")
         self.metrics = metrics
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
-        #: sequences preempted during the LAST prepare_decode round; the
+        #: sequences preempted during the LAST prepare_step round; the
         #: engine drains this to surface fresh preemptions exactly once
         self.last_preempted: list[Sequence] = []
         #: watermark hysteresis: once admission halts above the HIGH
         #: watermark, it stays halted until utilization falls below LOW —
         #: prevents admit/preempt thrash right at the high line
         self._admission_paused = False
+        #: q_len granted to each running seq by the current planning round
+        self._granted: dict[str, int] = {}
 
     # ---- introspection ----
     @property
     def max_num_seqs(self) -> int:
-        return max(self.config.batch_buckets)
+        return self.config.max_num_seqs
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
@@ -151,8 +200,7 @@ class Scheduler:
     def add(self, seq: Sequence):
         total_pages = self.pool.pages_for(
             len(seq.prompt_ids) + seq.max_new_tokens)
-        limit = min(self.pool.capacity, self.max_pages_per_seq,
-                    max(self.pages_buckets))
+        limit = min(self.pool.capacity, self.max_pages_per_seq)
         if total_pages > limit:
             raise ValueError(
                 f"request {seq.seq_id}: prompt+max_new_tokens needs "
@@ -196,9 +244,12 @@ class Scheduler:
             self.metrics.shed_requests.inc(len(shed))
         return shed
 
-    def admit(self) -> list[Sequence]:
-        """Move FIFO-queue heads into the running set; allocates their KV
-        pages. The engine must prefill each returned sequence this step."""
+    def admit(self, prefix_hook=None) -> list[Sequence]:
+        """Move FIFO-queue heads into the running set. Claims the pages
+        of each admission's FIRST chunk (later chunks claim lazily inside
+        ``prepare_step``); ``prefix_hook(seq)``, when given, may fork the
+        sequence onto cached prompt-prefix pages first and returns the
+        shared token count (0 on miss)."""
         admitted = []
         if self._admission_paused and self.pool.below_low_watermark():
             self._admission_paused = False
@@ -209,7 +260,8 @@ class Scheduler:
             if len(admitted) >= self.config.max_prefills_per_step:
                 break
             seq = self.waiting[0]
-            n_pages = self.pool.pages_for(seq.total_len)
+            first_len = min(self.config.chunk_size, seq.total_len)
+            n_pages = self.pool.pages_for(first_len)
             if n_pages > self.pool.free_pages:
                 break
             # watermark admission control: above the high watermark stop
@@ -224,18 +276,35 @@ class Scheduler:
                 if self._admission_paused:
                     break
             self.waiting.popleft()
-            self.pool.allocate(seq.seq_id, seq.total_len)
+            shared = 0
+            if prefix_hook is not None:
+                shared = int(prefix_hook(seq) or 0)
+            if not shared:
+                self.pool.allocate(seq.seq_id, 0)
+            seq.cached_len = shared
+            # reserve the first chunk's pages now (the watermark math
+            # above priced them in) but commit nothing yet — prepare_step
+            # owns the committed length
+            first_target = min(shared + self.config.chunk_size,
+                               seq.total_len)
+            self.pool.extend(seq.seq_id, first_target)
+            self.pool.set_seq_len(seq.seq_id, shared)
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
             admitted.append(seq)
+            if self.metrics is not None:
+                self.metrics.prefills.inc()
         return admitted
 
-    # ---- decode assembly ----
+    # ---- ragged step assembly ----
     def preempt(self, seq: Sequence):
-        """Free the sequence's pages and requeue it (recompute mode) at the
-        FRONT of the queue; generated tokens are preserved."""
+        """Free the sequence's page mappings and requeue it (recompute
+        mode) at the FRONT of the queue; generated tokens are
+        preserved, ``cached_len`` resets — re-admission re-chunks
+        prompt+generated through the same ragged step."""
         self.running.remove(seq)
         self.pool.free(seq.seq_id)
+        seq.cached_len = 0
         seq.status = SequenceStatus.WAITING
         seq.num_preemptions += 1
         self.waiting.appendleft(seq)
@@ -250,20 +319,34 @@ class Scheduler:
         if seq.seq_id in self.pool:
             self.pool.free(seq.seq_id)
 
-    def prepare_decode(self) -> DecodePlan | None:
-        """Grow each running sequence's table to cover its next token,
-        preempting latest arrivals when the pool runs dry, then assemble
-        the fixed-shape decode plan."""
+    def prepare_step(self) -> StepPlan | None:
+        """Grant each running sequence its step-token share, grow/CoW its
+        pages to cover the granted tokens (preempting latest arrivals
+        when the pool runs dry), then pack the fixed-shape ragged plan."""
+        cfg = self.config
+        qb = cfg.q_block
         self.last_preempted = []
-        for seq in list(self.running):
-            if seq not in self.running:      # preempted below this round
+        self._granted = {}
+        cow = 0
+        budget_left = cfg.step_token_budget
+        pending = list(self.running)
+        for idx, seq in enumerate(pending):
+            # preemption flips status to WAITING immediately, so a status
+            # check is an O(1) liveness test (no dataclass-__eq__ list
+            # membership scans in the per-step hot path)
+            if seq.status is not SequenceStatus.RUNNING:
                 continue
+            # reserve one q_block for every not-yet-granted row behind us
+            # so a fat prefill chunk can never starve their decode slots
+            behind = sum(qb for s in pending[idx + 1:]
+                         if s.status is SequenceStatus.RUNNING)
+            allowed = budget_left - behind
+            q_len = min(seq.uncached_len, cfg.chunk_size, allowed)
+            assert q_len >= 1, "budget must cover q_block per running row"
             while True:
                 try:
-                    # the last generated token is not cached yet: decode
-                    # writes it at slot total_len-1, so pages must cover
-                    # total_len tokens after this step
-                    self.pool.extend(seq.seq_id, seq.total_len)
+                    cow += self.pool.prepare_append(
+                        seq.seq_id, seq.cached_len + q_len)
                     break
                 except PoolExhausted:
                     victim = self._pick_victim(exclude=seq)
@@ -274,13 +357,19 @@ class Scheduler:
                         self.preempt(seq)
                         break
                     self.preempt(victim)
+            if seq.status is SequenceStatus.RUNNING:
+                self._granted[seq.seq_id] = q_len
+                budget_left -= -(-q_len // qb) * qb
         if not self.running:
             return None
-        bb = bucket_for(len(self.running), self.config.batch_buckets)
-        max_pages = max(self.pool.pages_for(s.total_len)
-                        for s in self.running)
-        pb = bucket_for(max_pages, self.pages_buckets)
-        return DecodePlan(list(self.running), bb, pb)
+        rows, cursor = [], 0
+        for seq in self.running:
+            q_len = self._granted[seq.seq_id]
+            rows.append((seq, cursor, q_len))
+            cursor += -(-q_len // qb) * qb
+        assert cursor <= cfg.step_token_budget
+        return StepPlan(rows, num_slots=self.max_num_seqs,
+                        token_budget=cfg.step_token_budget, cow_copies=cow)
 
     def _pick_victim(self, exclude: Sequence) -> Sequence | None:
         candidates = [s for s in self.running if s is not exclude]
@@ -290,4 +379,4 @@ class Scheduler:
 
 
 __all__ = ["Scheduler", "SchedulerConfig", "Sequence", "SequenceStatus",
-           "DecodePlan", "bucket_for"]
+           "StepPlan", "bucket_for"]

@@ -67,7 +67,12 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
     assert out["value"] == 70000.0
     for k in ("compile_ms", "peak_hbm_bytes", "remat_policy",
               "accumulate_steps", "quantized_mode", "weight_bytes",
-              "kv_bytes_per_token", "quantized_decode_tokens_per_s"):
+              "kv_bytes_per_token", "quantized_decode_tokens_per_s",
+              # ragged-serving fields are per-run observations too: a
+              # stale artifact must not claim a compile count or a
+              # prefix-cache hit rate the failed run never measured
+              "decode_compiles", "prefix_cache_hit_rate",
+              "shared_page_fraction"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -160,3 +165,23 @@ def test_lastgood_history_preserved(tmp_path, monkeypatch):
             if h["config"] == "llama_1b"]
     assert 0.30 in mfus and 0.15 in mfus     # the better number survives
     assert blob["parsed"]["mfu"] == 0.38     # latest 125m is the headline
+
+
+def test_serving_probe_records_ragged_and_prefix_fields():
+    """The live serving probe must measure the ragged-engine fields:
+    exactly one compiled step executable, a real prefix-cache hit rate
+    from the staggered shared-prefix wave, and a nonzero peak
+    shared-page fraction — and its total-failure fallback must null them
+    instead of fabricating."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+    import paddle_tpu as paddle
+
+    out = bench._probe_serving(paddle, wave=4, max_new=3)
+    assert "serving_probe_error" not in out, out
+    assert out["decode_compiles"] == 1, out
+    assert out["prefix_cache_hit_rate"] is not None
+    assert 0.0 < out["prefix_cache_hit_rate"] <= 1.0
+    assert out["shared_page_fraction"] > 0.0
+    assert out["serving_tokens_per_s"] > 0.0

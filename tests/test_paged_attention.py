@@ -100,3 +100,138 @@ def test_paged_generator_matches_dense():
     out_paged = paged.generate(paddle.to_tensor(ids, dtype="int64"),
                                max_new_tokens=6, temperature=0.0).numpy()
     np.testing.assert_array_equal(out_dense, out_paged)
+
+
+# ---------------------------------------------------------------------------
+# ragged kernel: one program for mixed decode rows + prefill chunks
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.kernels.paged_attention import (  # noqa: E402
+    ragged_paged_attention, ragged_paged_attention_reference)
+
+
+def _pack_rows(q_lens, q_block, budget):
+    """Slot starts aligned to q_block; pad rows start past the budget."""
+    starts, cursor = [], 0
+    for ql in q_lens:
+        if ql == 0:
+            starts.append(budget)
+            continue
+        starts.append(cursor)
+        cursor += -(-ql // q_block) * q_block
+    assert cursor <= budget
+    return np.asarray(starts, np.int32)
+
+
+def _ragged_case(q_lens, kv_lens, *, qb=4, budget=32, hq=4, hkv=2, d=32,
+                 ps=8, pps=6, seed=0, quant=False):
+    rng = np.random.default_rng(seed)
+    n = len(q_lens)
+    npages = n * pps + 3
+    q = jnp.asarray(rng.standard_normal((budget, hq, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    tbl = jnp.asarray(rng.permutation(npages)[:n * pps].reshape(n, pps),
+                      jnp.int32)
+    q_starts = _pack_rows(q_lens, qb, budget)
+    args = dict(q_starts=jnp.asarray(q_starts),
+                q_lens=jnp.asarray(q_lens, jnp.int32),
+                kv_lens=jnp.asarray(kv_lens, jnp.int32))
+    scales = {}
+    if quant:
+        ks = np.maximum(np.abs(np.asarray(kp)).max(axis=(2, 3)),
+                        1e-8) / 127.0
+        vs = np.maximum(np.abs(np.asarray(vp)).max(axis=(2, 3)),
+                        1e-8) / 127.0
+        kp = jnp.asarray(np.clip(np.round(np.asarray(kp) /
+                                          ks[:, :, None, None]),
+                                 -127, 127).astype(np.int8))
+        vp = jnp.asarray(np.clip(np.round(np.asarray(vp) /
+                                          vs[:, :, None, None]),
+                                 -127, 127).astype(np.int8))
+        scales = dict(k_scales=jnp.asarray(ks), v_scales=jnp.asarray(vs))
+    out = ragged_paged_attention(q, kp, vp, tbl, q_block=qb,
+                                 interpret=True, **args, **scales)
+    ref = ragged_paged_attention_reference(q, kp, vp, tbl, q_starts,
+                                           np.asarray(q_lens),
+                                           np.asarray(kv_lens), **scales)
+    return np.asarray(out), np.asarray(ref), q_starts
+
+
+def _assert_live_rows_close(out, ref, q_starts, q_lens, tol=2e-4):
+    for s, ql in zip(q_starts, q_lens):
+        if ql:
+            np.testing.assert_allclose(out[s:s + ql], ref[s:s + ql],
+                                       rtol=tol, atol=tol)
+
+
+def test_ragged_mixed_decode_and_prefill_chunks():
+    """Decode rows (q_len=1), a fresh-prompt chunk (kv_len == q_len, the
+    fully causal case), a mid-prompt chunk (kv_len > q_len), and a pad
+    row (q_len=0) in ONE launch match the dense causal oracle."""
+    q_lens = [1, 5, 1, 7, 0]
+    kv_lens = [13, 5, 33, 20, 0]
+    out, ref, starts = _ragged_case(q_lens, kv_lens)
+    _assert_live_rows_close(out, ref, starts, q_lens)
+
+
+@pytest.mark.parametrize("q_lens,kv_lens", [
+    ([1, 1, 1, 1], [15, 16, 17, 31]),      # all-decode, page boundaries
+    ([8, 8], [8, 48]),                     # chunk exactly one q_block
+    ([3, 6, 2], [11, 41, 2]),              # ragged chunks, ragged kv
+])
+def test_ragged_parity_across_page_boundaries(q_lens, kv_lens):
+    out, ref, starts = _ragged_case(q_lens, kv_lens, seed=3)
+    _assert_live_rows_close(out, ref, starts, q_lens)
+
+
+def test_ragged_int8_pages_within_tolerance():
+    """int8 pages + per-(head, page) scales through the ragged kernel
+    match the quantized oracle exactly (same math) — the int8-KV path
+    rides the ragged kernel unchanged."""
+    q_lens = [1, 6, 2]
+    kv_lens = [19, 22, 7]
+    out, ref, starts = _ragged_case(q_lens, kv_lens, seed=5, quant=True,
+                                    qb=2, budget=16)
+    _assert_live_rows_close(out, ref, starts, q_lens, tol=1e-4)
+
+
+def test_ragged_jit_wrapped_and_chunk_split_invariance():
+    """Inside jit (the serving step calls it there), and: splitting one
+    prompt's queries across two chunk launches reproduces the
+    whole-chunk outputs — the numerical basis for chunked prefill's
+    token identity."""
+    rng = np.random.default_rng(9)
+    hq, hkv, d, ps, pps, qb = 4, 2, 16, 8, 4, 4
+    npages = pps + 2
+    L = 12                                   # whole prompt
+    budget = 16
+    kp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, npages, ps, d)), jnp.float32)
+    tbl = jnp.asarray(np.arange(1, pps + 1, dtype=np.int32)[None])
+    qtok = rng.standard_normal((L, hq, d)).astype(np.float32)
+
+    def run(q_rows, q_len, kv_len):
+        q = np.zeros((budget, hq, d), np.float32)
+        q[:q_len] = q_rows
+        f = jax.jit(lambda *a: ragged_paged_attention(
+            *a, q_block=qb, interpret=True))
+        return np.asarray(f(
+            jnp.asarray(q), kp, vp, tbl,
+            jnp.asarray([0], jnp.int32), jnp.asarray([q_len], jnp.int32),
+            jnp.asarray([kv_len], jnp.int32)))[:q_len]
+
+    whole = run(qtok, L, L)                  # one 12-token chunk
+    first = run(qtok[:8], 8, 8)              # chunked: 8 then 4
+    second = run(qtok[8:], 4, L)
+    np.testing.assert_allclose(np.concatenate([first, second]), whole,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ragged_rejects_misaligned_budget():
+    with pytest.raises(ValueError, match="q_block"):
+        ragged_paged_attention(
+            jnp.zeros((10, 4, 8)), jnp.zeros((2, 4, 4, 8)),
+            jnp.zeros((2, 4, 4, 8)), jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
+            jnp.ones((1,), jnp.int32), q_block=4, interpret=True)

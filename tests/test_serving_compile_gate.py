@@ -1,14 +1,17 @@
-"""Decode-step compile-count gate for the serving engine.
+"""Ragged-step compile-count gate for the serving engine.
 
-The engine's headline TPU contract: decode launches are assembled into a
-CLOSED set of (batch_bucket, pages_bucket) shapes, so XLA compiles at most
-len(batch_buckets) * len(pages_buckets) decode executables no matter what
-request mix arrives. This gate (the serving analog of
-test_optimizer_dispatch_gate.py) drives a deliberately varied mix of
-request lengths/arrivals through the engine and hard-fails if the decode
-jit ever compiles more than the bucket bound — the regression that would
-mean per-composition recompilation, the exact failure mode paged serving
-exists to avoid (serving/engine.py, serving/scheduler.py)."""
+The engine's headline TPU contract, post-ragged-kernel: EVERY step — any
+mix of decode rows and prefill chunks, any batch composition, any
+lengths — launches ONE jitted ragged step of one fixed shape, so XLA
+compiles exactly ONE step executable for the lifetime of the process.
+This replaces the old closed-bucket bound (``len(batch_buckets) *
+len(pages_buckets)`` decode executables plus a prefill ladder): the gate
+drives a deliberately varied mix — short decodes, one long chunked
+prefill admitted mid-run, batch sizes growing and shrinking — and
+hard-fails if the ragged jit ever traces a second executable, the
+regression that would mean shape-dependent recompilation crept back in
+(serving/engine.py, serving/scheduler.py, kernels/paged_attention.py).
+"""
 import numpy as np
 import pytest
 
@@ -26,14 +29,11 @@ def tiny_model():
     return LlamaForCausalLM(cfg)
 
 
-def test_decode_compiles_bounded_by_buckets(tiny_model):
-    batch_buckets = (1, 2, 4)
-    pages_buckets = (2, 4, 8)
-    eng = LLMEngine(tiny_model, max_len=32, page_size=4,
-                    batch_buckets=batch_buckets,
-                    pages_buckets=pages_buckets,
-                    max_prefills_per_step=2)
-    bound = len(batch_buckets) * len(pages_buckets)
+def test_mixed_workload_exactly_one_executable(tiny_model):
+    """Short decodes + one long chunked prefill + batch sizes varying from
+    1 to 8 rows: one ragged-step executable, full stop."""
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4, max_num_seqs=8,
+                    chunk_size=8, q_block=4, max_prefills_per_step=2)
 
     rng = np.random.RandomState(0)
     # two waves with disjoint length mixes + stragglers arriving mid-run:
@@ -44,46 +44,67 @@ def test_decode_compiles_bounded_by_buckets(tiny_model):
         eng.add_request(rng.randint(0, 64, (n,)).tolist(),
                         max_new_tokens=int(rng.randint(2, 7)))
     steps = 0
+    long_added = False
     stragglers = iter(lengths_wave2)
     while eng.has_unfinished():
         eng.step()
         steps += 1
+        if not long_added and steps == 2:
+            # a 24-token prompt over chunk_size=8: >= 3 chunked-prefill
+            # steps interleaved with the running decodes
+            eng.add_request(rng.randint(0, 64, (24,)).tolist(),
+                            max_new_tokens=4)
+            long_added = True
         nxt = next(stragglers, None)
         if nxt is not None:
             eng.add_request(rng.randint(0, 64, (nxt,)).tolist(),
                             max_new_tokens=int(rng.randint(2, 7)))
         assert steps < 300
     outs = eng.outputs()
-    assert len(outs) == 8
+    assert len(outs) == 9
     assert all(o.status == "finished" for o in outs.values())
 
     snap = eng.metrics_snapshot()
-    # the gate: actual XLA decode compiles <= #buckets
-    assert snap["decode_cache_size"] <= bound, (
-        f"decode step compiled {snap['decode_cache_size']} executables for "
-        f"{bound} shape buckets — per-composition recompilation regression")
-    # the bucket-signature counter agrees with the jit cache
+    # THE gate: one executable serves the whole mix (actual XLA traces)
+    assert snap["decode_cache_size"] == 1, (
+        f"ragged step compiled {snap['decode_cache_size']} executables — "
+        f"shape-dependent recompilation regression")
     assert snap["decode_compiles"] == snap["decode_cache_size"]
-    # and the mix genuinely exercised multiple buckets
-    assert snap["decode_compiles"] >= 2
+    # the long prompt genuinely went through chunked prefill
+    assert snap["prefill_chunks"] >= 3
+    # pad-fraction gauge is live and sane (actual vs padded q tokens)
+    assert 0.0 <= snap["ragged_pad_fraction"] < 1.0
 
 
 def test_repeat_traffic_compiles_nothing_new(tiny_model):
-    """Steady-state: a second identical wave reuses every executable."""
-    eng = LLMEngine(tiny_model, max_len=32, page_size=4,
-                    batch_buckets=(1, 2), pages_buckets=(4, 8))
+    """Steady-state: a second identical wave reuses the one executable."""
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4, max_num_seqs=2,
+                    chunk_size=8)
     rng = np.random.RandomState(1)
     prompts = [rng.randint(0, 64, (n,)).tolist() for n in (3, 6)]
     for p in prompts:
         eng.add_request(p, max_new_tokens=4)
     eng.run(max_steps=100)
-    first = eng.metrics_snapshot()["decode_cache_size"]
+    assert eng.metrics_snapshot()["decode_cache_size"] == 1
     for p in prompts:
         eng.add_request(p, max_new_tokens=4)
     eng.run(max_steps=100)
-    assert eng.metrics_snapshot()["decode_cache_size"] == first
-    assert eng.metrics_snapshot()["prefill_compiles"] == \
-        len(eng._prefill_shapes)
+    assert eng.metrics_snapshot()["decode_cache_size"] == 1
+
+
+def test_legacy_bucket_kwargs_still_accepted(tiny_model):
+    """Call sites written against the bucketed engine keep working:
+    batch_buckets sets the row-slot count, pages/prefill buckets are
+    shape-irrelevant now — and the compile count is 1 regardless."""
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4,
+                    batch_buckets=(1, 2, 4), pages_buckets=(2, 4, 8),
+                    prefill_buckets=(8, 16, 32))
+    assert eng.max_num_seqs == 4
+    rng = np.random.RandomState(2)
+    for n in (2, 5, 9):
+        eng.add_request(rng.randint(0, 64, (n,)).tolist(), max_new_tokens=3)
+    eng.run(max_steps=100)
+    assert eng.metrics_snapshot()["decode_cache_size"] == 1
 
 
 def test_bucket_for_picks_smallest_cover():

@@ -298,3 +298,228 @@ def test_tokens_per_s_is_windowed_not_lifetime():
     clock[0] = 1001.0
     m.record_step(_SchedStub(), _PoolStub())
     assert m.tokens_per_s.value == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_never_stalls_decodes(tiny_model):
+    """A long prompt admitted alongside active decodes is committed in
+    chunks across steps — and EVERY running decode row makes one token
+    of progress on EVERY one of those steps (the budget reserves q_block
+    tokens per row before granting chunk budget)."""
+    prompts = _prompts(tiny_model, [3, 4], seed=21)
+    eng = LLMEngine(tiny_model, max_len=64, page_size=4, max_num_seqs=4,
+                    chunk_size=4, max_prefills_per_step=1)
+    rs = [eng.add_request(p, max_new_tokens=20) for p in prompts]
+    eng.step(); eng.step()                   # both decoding
+    long_p = _prompts(tiny_model, [24], seed=22)[0]
+    rl = eng.add_request(long_p, max_new_tokens=4)
+    chunk_steps = 0
+    while eng._seqs[rl].cached_len < len(long_p):
+        before = [len(eng._seqs[r].tokens) for r in rs]
+        eng.step()
+        chunk_steps += 1
+        for b, r in zip(before, rs):
+            if eng._seqs[r].status == SequenceStatus.RUNNING:
+                assert len(eng._seqs[r].tokens) == b + 1, (
+                    "decode row stalled while the long prompt chunked in")
+        assert chunk_steps < 50
+    assert chunk_steps >= 3, "24-token prompt over chunk_size=4 must chunk"
+    outs = eng.run(max_steps=300)
+    assert outs[rl].token_ids == _reference_tokens(tiny_model, long_p, 4)
+    for r, p in zip(rs, prompts):
+        assert outs[r].token_ids == _reference_tokens(tiny_model, p, 20)
+    assert eng.metrics_snapshot()["prefill_chunks"] >= 3
+
+
+def test_chunk_boundary_tokens_identical_to_whole_prompt_prefill(tiny_model):
+    """Same executable shape (pinned step_token_budget), different chunk
+    boundaries: generated tokens must be IDENTICAL — the ragged step
+    computes each token's K/V and logits independently of chunking."""
+    prompt = _prompts(tiny_model, [27], seed=23)[0]
+
+    def run(chunk):
+        eng = LLMEngine(tiny_model, max_len=64, page_size=4,
+                        max_num_seqs=4, chunk_size=chunk, q_block=4,
+                        step_token_budget=48)
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        return eng.run(max_steps=200)[rid].token_ids
+
+    whole = run(32)                          # prompt in ONE chunk
+    assert whole == run(4)                   # 7 chunks
+    assert whole == run(9)                   # ragged, non-page-aligned
+    assert whole == _reference_tokens(tiny_model, prompt, 6)
+
+
+# ---------------------------------------------------------------------------
+# prefix caching + copy-on-write
+# ---------------------------------------------------------------------------
+
+def test_prefix_sharing_page_accounting_gate(tiny_model):
+    """N sequences over a common prefix allocate <= prefix_pages +
+    N*tail_pages physical pages, shared_page_fraction reports the save,
+    and every output stays token-identical to the sequential engine."""
+    ps = 4
+    prefix = _prompts(tiny_model, [16], seed=31)[0]   # 4 full pages
+    tails = _prompts(tiny_model, [3, 2, 3], seed=32)
+    eng = LLMEngine(tiny_model, max_len=64, page_size=ps, max_num_seqs=4,
+                    chunk_size=32)
+    donor = eng.add_request(prefix, max_new_tokens=14)  # stays running
+    eng.step(); eng.step()                   # donor prompt registered
+    rids = [eng.add_request(prefix + t, max_new_tokens=4) for t in tails]
+    eng.step()
+    snap = eng.metrics_snapshot()
+    assert snap["prefix_cache_hits"] == len(tails)
+    prefix_pages = len(prefix) // ps
+    n = len(tails)
+    # per child: tokens beyond the shared prefix (tail + 4 new), plus the
+    # donor's own tail growth — bound every sequence's exclusive pages
+    child_tail_pages = max(
+        eng.pool.pages_for(len(prefix) + len(t) + 4) - prefix_pages
+        for t in tails)
+    donor_tail_pages = eng.pool.pages_for(len(prefix) + 14) - prefix_pages
+    bound = prefix_pages + n * child_tail_pages + donor_tail_pages
+    assert eng.pool.used_pages <= bound, (
+        f"{eng.pool.used_pages} physical pages > prefix+N*tail bound "
+        f"{bound} — prefix sharing is not sharing")
+    assert eng.pool.logical_pages - eng.pool.used_pages >= \
+        (n - 0) * prefix_pages - n, "children must map the donor's pages"
+    assert snap["shared_page_fraction"] > 0.3
+    eng.pool.check_invariants()
+    outs = eng.run(max_steps=300)
+    assert outs[donor].token_ids == _reference_tokens(
+        tiny_model, prefix, 14)
+    for rid, t in zip(rids, tails):
+        assert outs[rid].token_ids == _reference_tokens(
+            tiny_model, prefix + t, 4), "forked sequence diverged"
+
+    # admitted-sequences-per-byte: the same wave WITHOUT sharing peaks
+    # strictly higher in physical pages
+    eng0 = LLMEngine(tiny_model, max_len=64, page_size=ps, max_num_seqs=4,
+                     chunk_size=32, prefix_caching=False)
+    eng0.add_request(prefix, max_new_tokens=14)
+    eng0.step(); eng0.step()
+    for t in tails:
+        eng0.add_request(prefix + t, max_new_tokens=4)
+    eng0.step()
+    assert eng0.metrics_snapshot()["prefix_cache_hits"] == 0
+    assert eng0.pool.used_pages > eng.pool.used_pages + (n - 1) * \
+        prefix_pages - n, "no-sharing engine should pay ~N x prefix pages"
+    assert eng0.pool.shared_page_fraction == 0.0
+    eng0.run(max_steps=300)
+
+
+def test_identical_prompt_cow_divergence_on_shared_tail_page(tiny_model):
+    """An identical prompt forks even the partially-filled tail page;
+    its first append (re-computing the last prompt token for logits)
+    copy-on-writes that page — and both the donor's and the fork's
+    greedy tokens stay exactly the sequential engine's, before and after
+    the post-fork divergence."""
+    P = _prompts(tiny_model, [18], seed=33)[0]   # ps=8: tail page holds 2
+    eng = LLMEngine(tiny_model, max_len=64, page_size=8, max_num_seqs=4,
+                    chunk_size=32)
+    donor = eng.add_request(P, max_new_tokens=10)
+    eng.step()
+    fork = eng.add_request(P, max_new_tokens=5)
+    eng.step()
+    snap = eng.metrics_snapshot()
+    assert snap["prefix_cache_hits"] == 1
+    assert snap["cow_copies"] >= 1, \
+        "the shared tail page must be duplicated before the fork's append"
+    eng.pool.check_invariants()
+    outs = eng.run(max_steps=300)
+    assert outs[donor].token_ids == _reference_tokens(tiny_model, P, 10)
+    assert outs[fork].token_ids == _reference_tokens(tiny_model, P, 5)
+    assert eng.pool.free_pages == eng.pool.capacity
+
+
+def test_preemption_with_prefix_forks_is_token_identical(tiny_model):
+    """A pool too small for the forked load must preempt — and every
+    sequence (donor, forks, preempted-and-requeued) still reproduces the
+    sequential engine's greedy tokens exactly."""
+    prefix = _prompts(tiny_model, [12], seed=34)[0]
+    tails = _prompts(tiny_model, [2, 3], seed=35)
+    prompts = [prefix] + [prefix + t for t in tails]
+    # high_watermark=1.0: admit the whole forked load up front so decode
+    # growth, not admission control, is what hits the wall
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4, num_pages=9,
+                    max_num_seqs=3, chunk_size=16, high_watermark=1.0)
+    donor = eng.add_request(prompts[0], max_new_tokens=8)
+    eng.step()
+    rids = [donor] + [eng.add_request(p, max_new_tokens=8)
+                      for p in prompts[1:]]
+    outs = eng.run(max_steps=500)
+    snap = eng.metrics_snapshot()
+    assert snap["prefix_cache_hits"] >= 1, "forks must have happened"
+    assert snap["preemptions"] >= 1, "the starved pool must preempt"
+    for rid, p in zip(rids, prompts):
+        assert outs[rid].status == "finished"
+        assert outs[rid].token_ids == \
+            _reference_tokens(tiny_model, p, 8, max_len=64), \
+            f"{rid} diverged under preemption + prefix forks"
+    eng.pool.check_invariants()
+    assert eng.pool.free_pages == eng.pool.capacity
+
+
+# ---------------------------------------------------------------------------
+# oversize rejection (regression: the old bucketed engine could raise
+# bucket_for ValueError mid-step(), killing the serving loop)
+# ---------------------------------------------------------------------------
+
+def test_oversize_rejected_with_structured_error_and_finalized_output(
+        tiny_model):
+    from paddle_tpu.serving import RequestRejected
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4)
+    ok = eng.add_request([1, 2, 3], max_new_tokens=4)
+    with pytest.raises(RequestRejected) as ei:
+        eng.add_request(list(range(1, 20)), max_new_tokens=20,
+                        request_id="too-big")      # 39 > max_len 32
+    err = ei.value
+    assert isinstance(err, ValueError)             # legacy callers catch it
+    assert err.request_id == "too-big"
+    assert err.reason == "rejected_oversize"
+    assert err.needed_pages is not None and err.limit is not None
+    # finalize-with-reason: polling clients see a terminal state
+    out = eng.outputs()["too-big"]
+    assert out.status == "aborted" and out.finished
+    assert out.finish_reason == "rejected_oversize"
+    assert eng.metrics_snapshot()["rejected_requests"] == 1
+    # the serving loop was never poisoned: the valid request completes
+    outs = eng.run(max_steps=100)
+    assert outs[ok].status == "finished"
+    assert eng.release("too-big").status == "aborted"
+
+
+def test_oversize_against_pool_pages_rejected_same_way(tiny_model):
+    """The pool-capacity variant (prompt fits max_len, pages don't) gets
+    the same structured rejection instead of dying in the scheduler."""
+    from paddle_tpu.serving import RequestRejected
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4, num_pages=4)
+    with pytest.raises(RequestRejected, match="pages"):
+        eng.add_request(list(range(1, 17)), max_new_tokens=8)
+    rid = next(iter(eng.outputs()))
+    assert eng.outputs()[rid].finish_reason == "rejected_oversize"
+    assert not eng.has_unfinished()                # loop is unaffected
+
+
+def test_reused_request_id_never_forks_a_different_prompt(tiny_model):
+    """A released request_id can be reused for a DIFFERENT prompt; stale
+    prefix-cache entries naming that id must fail re-validation instead
+    of forking the new prompt's pages under the old prompt's chain."""
+    A, B = _prompts(tiny_model, [12, 12], seed=41)
+    assert A != B
+    eng = LLMEngine(tiny_model, max_len=64, page_size=4, max_num_seqs=4,
+                    chunk_size=32)
+    eng.add_request(A, max_new_tokens=2, request_id="x")
+    eng.run(max_steps=100)
+    eng.release("x")                         # "x"'s chains are now stale
+    eng.add_request(B, max_new_tokens=12, request_id="x")
+    eng.step(); eng.step()                   # B committed under id "x"
+    victim = eng.add_request(A, max_new_tokens=4)
+    outs = eng.run(max_steps=200)
+    assert outs[victim].token_ids == _reference_tokens(tiny_model, A, 4), \
+        "stale chain forked the WRONG prompt's pages"
+    assert outs["x"].token_ids == _reference_tokens(tiny_model, B, 12)
+    eng.pool.check_invariants()

@@ -117,3 +117,138 @@ def test_set_seq_len_requires_owned_pages():
     assert p.seq_len("a") == 3
     with pytest.raises(ValueError):
         p.set_seq_len("a", 5)        # page 2 not owned yet
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write prefix sharing: refcounts, fork, prepare_append
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+
+def test_fork_shares_pages_refcounted():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("donor", 10)                  # 3 pages (tail holds 2 toks)
+    donor_tbl = p.block_table("donor")
+    shared = p.fork("child", "donor", num_tokens=8)   # 2 full pages
+    assert shared == donor_tbl[:2]
+    assert p.block_table("child") == donor_tbl[:2]
+    assert p.seq_len("child") == 8
+    # physical pages unchanged: sharing is free
+    assert p.used_pages == 3
+    assert p.logical_pages == 5
+    assert p.shared_page_fraction == pytest.approx(1 - 3 / 5)
+    for pg in shared:
+        assert p.page_refcount(pg) == 2
+    p.check_invariants()
+
+
+def test_fork_default_full_pages_and_validation():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("donor", 10)
+    assert len(p.fork("c1", "donor")) == 2   # floor(10/4) full pages
+    with pytest.raises(KeyError):
+        p.fork("c1", "donor")                # child already exists
+    with pytest.raises(ValueError):
+        p.fork("c2", "donor", num_tokens=11)  # beyond donor's committed
+    p.check_invariants()
+
+
+def test_free_is_refcount_aware_in_any_order():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("donor", 8)
+    p.fork("child", "donor", num_tokens=8)
+    # donor dies first: pages survive via the child's mapping
+    assert p.free("donor") == 0              # nothing recycled
+    assert p.used_pages == 2 and "donor" not in p
+    p.check_invariants()
+    assert p.free("child") == 2              # last owner recycles
+    assert p.free_pages == p.capacity
+    p.check_invariants()
+
+
+def test_prepare_append_cows_shared_tail_page():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("donor", 10)                  # tail page holds tokens 8,9
+    p.fork("child", "donor", num_tokens=9)   # shares the PARTIAL tail
+    tail = p.block_table("donor")[2]
+    assert p.page_refcount(tail) == 2
+    # mark the donor's kv so the copy is observable
+    p.kv = [(K.at[:, tail].set(7.0), V.at[:, tail].set(3.0))
+            for K, V in p.kv]
+    copies = p.prepare_append("child", 10)   # child's first divergence
+    assert copies == 1 and p.cow_copies == 1
+    new_tail = p.block_table("child")[2]
+    assert new_tail != tail
+    assert p.page_refcount(tail) == 1 and p.page_refcount(new_tail) == 1
+    # the duplicated page carries the shared content
+    K0 = p.kv[0][0]
+    assert float(jnp.max(jnp.abs(K0[:, new_tail] - K0[:, tail]))) == 0.0
+    p.check_invariants()
+    # donor's view never moved
+    assert p.block_table("donor")[2] == tail
+
+
+def test_prepare_append_exclusive_pages_skip_cow():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("a", 6)
+    assert p.prepare_append("a", 9) == 0     # fresh page, no CoW
+    assert p.seq_len("a") == 9
+    p.check_invariants()
+
+
+def test_prepare_append_all_or_nothing_counts_cow_pages():
+    p = _pool(num_pages=4, page_size=4)      # 3 usable
+    p.allocate("donor", 8)                   # 2 pages
+    p.fork("child", "donor", num_tokens=7)   # shares both (tail partial)
+    p.allocate("filler", 4)                  # last free page gone
+    free_before = p.free_pages
+    with pytest.raises(PoolExhausted):
+        p.prepare_append("child", 8)         # needs 1 CoW page, 0 free
+    assert p.free_pages == free_before, "failed append must not leak"
+    p.check_invariants()
+
+
+def test_int8_free_resets_scales_only_on_recycle():
+    """A shared page freed by ONE owner keeps its dequant scale — the
+    other sharer still reads through it; the scale resets only when the
+    last owner drops the page."""
+    p = PagedKVPool(1, 2, 8, num_pages=6, page_size=4, dtype=jnp.int8)
+    pages = p.allocate("donor", 8)
+    p.kv_scales = [(Ks.at[:, jnp.asarray(pages)].set(0.5),
+                    Vs.at[:, jnp.asarray(pages)].set(0.5))
+                   for Ks, Vs in p.kv_scales]
+    p.fork("child", "donor", num_tokens=8)
+    p.free("donor")
+    Ks, _ = p.kv_scales[0]
+    assert float(jnp.min(Ks[:, jnp.asarray(pages)])) == 0.5, \
+        "shared page's scale must survive the donor's free"
+    p.free("child")
+    Ks, _ = p.kv_scales[0]
+    assert float(jnp.max(Ks[:, jnp.asarray(pages)])) == 0.0
+    p.check_invariants()
+
+
+def test_cow_copies_int8_scale_column_with_data():
+    p = PagedKVPool(1, 2, 8, num_pages=6, page_size=4, dtype=jnp.int8)
+    pages = p.allocate("donor", 6)           # 2 pages, tail partial
+    tail = pages[1]
+    p.kv_scales = [(Ks.at[:, tail].set(0.25), Vs.at[:, tail].set(0.125))
+                   for Ks, Vs in p.kv_scales]
+    p.fork("child", "donor", num_tokens=5)
+    p.prepare_append("child", 6)             # CoW the tail
+    new_tail = p.block_table("child")[1]
+    Ks, Vs = p.kv_scales[0]
+    assert float(jnp.min(Ks[:, new_tail])) == 0.25
+    assert float(jnp.min(Vs[:, new_tail])) == 0.125
+    p.check_invariants()
+
+
+def test_check_invariants_catches_refcount_drift():
+    p = _pool(num_pages=9, page_size=4)
+    p.allocate("a", 8)
+    p.fork("b", "a", num_tokens=8)
+    p.check_invariants()
+    p._refcounts[p.block_table("a")[0]] += 1     # simulate a leak
+    with pytest.raises(AssertionError, match="refcount"):
+        p.check_invariants()
