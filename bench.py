@@ -412,6 +412,34 @@ def _probe_serving(paddle, wave=6, max_new=4):
             "shared_page_fraction": round(peak_shared, 4),
         }
         try:
+            # burst-mode wave on a THIRD engine: the on-device token
+            # loop (decode megakernel + lax.while_loop burst) — the
+            # dispatch-bound slice of the decode win that IS measurable
+            # on CPU: host dispatches per generated token collapse from
+            # ~1 to ~1/burst_tokens (tests/test_decode_megakernel.py
+            # gates the O(1)-dispatches-per-burst contract)
+            engb = LLMEngine(model, max_len=64, page_size=8,
+                             batch_buckets=(1, 2, 4, 8), burst_tokens=8)
+            burst_tok_s = _measure(engb)
+            snapb = engb.metrics_snapshot()
+            out.update({
+                "burst_tokens": snapb["burst_tokens"],
+                "host_dispatches_per_token": round(
+                    snapb["host_dispatches_per_token"], 4)
+                if snapb["host_dispatches_per_token"] is not None
+                else None,
+                "megakernel_mode": snapb["megakernel_mode"],
+                "burst_tokens_per_s": round(burst_tok_s, 1),
+            })
+        except Exception as e:  # null, never fabricated
+            out.update({
+                "burst_tokens": None,
+                "host_dispatches_per_token": None,
+                "megakernel_mode": None,
+                "burst_tokens_per_s": None,
+                "burst_probe_error": f"{type(e).__name__}: {e}",
+            })
+        try:
             from paddle_tpu.quantization import params_weight_bytes
             mode = "weight_only_int8"
             engq = LLMEngine(model, max_len=64, page_size=8,
@@ -442,6 +470,8 @@ def _probe_serving(paddle, wave=6, max_new=4):
                 "quantized_mode": None, "weight_bytes": None,
                 "kv_bytes_per_token": None,
                 "quantized_decode_tokens_per_s": None,
+                "burst_tokens": None, "host_dispatches_per_token": None,
+                "megakernel_mode": None, "burst_tokens_per_s": None,
                 "serving_probe_error": f"{type(e).__name__}: {e}"}
 
 
@@ -738,6 +768,13 @@ def _failure_artifact(last_err, last_stages):
         "decode_compiles": None,
         "prefix_cache_hit_rate": None,
         "shared_page_fraction": None,
+        # burst/megakernel fields are per-run too: a stale artifact must
+        # never claim a dispatch ratio or kernel mode the failed run
+        # did not measure
+        "burst_tokens": None,
+        "host_dispatches_per_token": None,
+        "megakernel_mode": None,
+        "burst_tokens_per_s": None,
     }
     good = _last_good_round()
     if good:

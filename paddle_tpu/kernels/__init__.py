@@ -25,6 +25,7 @@ import os
 
 import jax
 
+from .decode_megakernel import fused_decode_layer as pallas_decode_layer
 from .flash_attention import flash_attention as pallas_flash_attention
 from .fused_adamw import fused_adamw as pallas_fused_adamw
 from .int8_matmul import dequant_matmul as pallas_dequant_matmul

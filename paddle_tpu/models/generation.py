@@ -36,7 +36,37 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.flags import GLOBAL_FLAGS, define_flag
 from ..core.tensor import Tensor
+
+
+def _check_burst_tokens(v):
+    if int(v) < 1:
+        raise ValueError(
+            f"FLAGS_decode_burst_tokens must be >= 1, got {v!r}")
+
+
+define_flag("decode_burst_tokens", int, 1,
+            "generation burst length: how many decode iterations run "
+            "on-device inside one jitted lax.while_loop (sample -> KV "
+            "append -> EOS/length gate all in-graph) before the host "
+            "re-syncs — one host dispatch per burst instead of one per "
+            "token (Generator.generate and serving LLMEngine). 1 (the "
+            "default) is the per-token path, bit-identical to the "
+            "pre-burst engine", on_set=_check_burst_tokens)
+
+
+#: host->device dispatch forensics for the burst gate
+#: (tests/test_decode_megakernel.py): every jitted launch generate()
+#: issues — prefill, per-token decode, or burst — bumps this counter, so
+#: a generation burst of N tokens must cost O(1) increments where the
+#: per-token path costs >= N (the optimizer/serving dispatch-gate
+#: discipline).
+_HOST_DISPATCH = {"count": 0}
+
+
+def host_dispatch_count() -> int:
+    return _HOST_DISPATCH["count"]
 
 
 # ---------------------------------------------------------------------------
@@ -285,10 +315,8 @@ class Generator:
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             return _logits(params, h[:, -1], cfg), caches
 
-        @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnums=(5, 6, 7))
-        def decode_step(params, caches, token, cur_len, key, temperature,
-                        top_k, top_p):
+        def _decode_core(params, caches, token, cur_len, key, temperature,
+                         top_k, top_p):
             b = token.shape[0]
             pos = jnp.full((b, 1), cur_len, jnp.int32)
             h = params["embed"][token[:, None]]
@@ -302,11 +330,74 @@ class Generator:
             nxt = _sample(logits, key, temperature, top_k, top_p)
             return nxt, new_caches
 
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnums=(5, 6, 7))
+        def decode_step(params, caches, token, cur_len, key, temperature,
+                        top_k, top_p):
+            return _decode_core(params, caches, token, cur_len, key,
+                                temperature, top_k, top_p)
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnums=(7, 8, 9, 10, 11))
+        def decode_burst(params, caches, token, start_len, key, finished,
+                         n_steps, temperature, top_k, top_p, eos_token_id,
+                         burst_cap):
+            # the on-device token loop: up to burst_cap decode iterations
+            # (sample -> cache append -> EOS gate) inside ONE executable;
+            # n_steps (traced) bounds the trip count so every burst size
+            # reuses the same compilation. The per-step key split mirrors
+            # the host loop exactly, so sampling draws are identical too.
+            b = token.shape[0]
+            out0 = jnp.zeros((b, burst_cap), token.dtype)
+
+            def cond(c):
+                i, _, _, _, finished, _ = c
+                go = i < n_steps
+                if eos_token_id is not None:
+                    # do-while: the per-token loop breaks AFTER its
+                    # append, so a burst entered with every row already
+                    # finished (prefill sampled eos) still appends
+                    # exactly one eos pad before stopping
+                    go = go & ((i == 0) | ~jnp.all(finished))
+                return go
+
+            def body(c):
+                i, token, caches, key, finished, out = c
+                key, sub = jax.random.split(key)
+                nxt, caches = _decode_core(params, caches, token,
+                                           start_len + i, sub,
+                                           temperature, top_k, top_p)
+                if eos_token_id is not None:
+                    # rows already finished emit eos forever (pad), same
+                    # as the host loop's post-eos masking
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                out = out.at[:, i].set(nxt)
+                return (i + 1, nxt, caches, key, finished, out)
+
+            i, token, caches, key, finished, out = jax.lax.while_loop(
+                cond, body,
+                (jnp.asarray(0, jnp.int32), token, caches, key, finished,
+                 out0))
+            return token, caches, key, finished, out, i
+
         self._prefill = prefill
         self._decode = decode_step
+        self._decode_burst = decode_burst
 
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
-                 top_k=None, top_p=None, eos_token_id=None, seed=0):
+                 top_k=None, top_p=None, eos_token_id=None, seed=0,
+                 burst_tokens=None):
+        """``burst_tokens`` > 1 moves the token loop on-device: the host
+        dispatches one jitted ``lax.while_loop`` burst of up to that
+        many decode iterations instead of one executable per token
+        (default: ``FLAGS_decode_burst_tokens``; 1 keeps the per-token
+        path, bit-identical to the pre-burst engine)."""
+        if burst_tokens is None:
+            burst_tokens = int(GLOBAL_FLAGS.get("decode_burst_tokens"))
+        if burst_tokens < 1:
+            raise ValueError(f"burst_tokens must be >= 1, got "
+                             f"{burst_tokens}")
         ids = input_ids._data if isinstance(input_ids, Tensor) \
             else jnp.asarray(np.asarray(input_ids))
         if ids.ndim == 1:
@@ -317,6 +408,7 @@ class Generator:
                 f"prompt {s} + new {max_new_tokens} exceeds max_len "
                 f"{self.max_len}")
         key = jax.random.key(seed)
+        _HOST_DISPATCH["count"] += 1
         logits, caches = self._prefill(self.params, ids)
         key, sub = jax.random.split(key)
         token = _sample(logits, sub, temperature, top_k, top_p)
@@ -324,18 +416,48 @@ class Generator:
         if eos_token_id is not None:
             finished |= np.asarray(token) == eos_token_id
         out = [token]
-        for i in range(max_new_tokens - 1):
-            key, sub = jax.random.split(key)
-            token, caches = self._decode(self.params, caches, token, s + i,
-                                         sub, temperature, top_k, top_p)
-            if eos_token_id is not None:
-                # rows already finished emit eos forever (pad), regardless
-                # of what the model sampled from post-eos context
-                token = jnp.where(jnp.asarray(finished), eos_token_id, token)
-                finished |= np.asarray(token) == eos_token_id
-            out.append(token)
-            if eos_token_id is not None and finished.all():
-                break
+        if burst_tokens > 1:
+            fin = jnp.asarray(finished)
+            done = 1
+            first = True
+            while done < max_new_tokens:
+                # the per-token loop always runs its first decode
+                # iteration (the finished.all() break sits after the
+                # append), so only later bursts early-out on finished
+                if not first and eos_token_id is not None \
+                        and bool(np.asarray(fin).all()):
+                    break
+                first = False
+                n = min(burst_tokens, max_new_tokens - done)
+                _HOST_DISPATCH["count"] += 1
+                token, caches, key, fin, buf, cnt = self._decode_burst(
+                    self.params, caches, token, s + done - 1, key, fin,
+                    n, temperature, top_k, top_p, eos_token_id,
+                    burst_tokens)
+                cnt = int(cnt)
+                if cnt == 0:
+                    break
+                for j in range(cnt):
+                    out.append(buf[:, j])
+                done += cnt
+            finished = np.asarray(fin)
+        else:
+            for i in range(max_new_tokens - 1):
+                key, sub = jax.random.split(key)
+                _HOST_DISPATCH["count"] += 1
+                token, caches = self._decode(self.params, caches, token,
+                                             s + i, sub, temperature,
+                                             top_k, top_p)
+                if eos_token_id is not None:
+                    # rows already finished emit eos forever (pad),
+                    # regardless of what the model sampled from post-eos
+                    # context
+                    token = jnp.where(jnp.asarray(finished), eos_token_id,
+                                      token)
+                    finished |= np.asarray(token) == eos_token_id
+                out.append(token)
+                if eos_token_id is not None and finished.all():
+                    break
         gen = jnp.stack(out, 1)
         return Tensor(jnp.concatenate([ids, gen], 1))
 
@@ -345,4 +467,5 @@ def generate(model, input_ids, max_len=512, **kwargs):
     return Generator(model, max_len=max_len).generate(input_ids, **kwargs)
 
 
-__all__ = ["Generator", "generate", "extract_params"]
+__all__ = ["Generator", "generate", "extract_params",
+           "host_dispatch_count"]

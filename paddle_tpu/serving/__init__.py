@@ -18,13 +18,13 @@ Layers (docs/SERVING.md has the full architecture):
   bench.py and the profiler timeline.
 """
 from .kv_cache import PagedKVPool, PoolExhausted, NULL_PAGE  # noqa: F401
-from .scheduler import (Scheduler, SchedulerConfig, Sequence,  # noqa: F401
-                        SequenceStatus, StepPlan, bucket_for)
+from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
+                        Sequence, SequenceStatus, StepPlan, bucket_for)
 from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
                      RequestRejected)
 from .metrics import ServingMetrics  # noqa: F401
 
-__all__ = ["LLMEngine", "Request", "RequestOutput", "RequestRejected",
-           "PagedKVPool", "PoolExhausted", "NULL_PAGE", "Scheduler",
-           "SchedulerConfig", "Sequence", "SequenceStatus", "StepPlan",
-           "ServingMetrics", "bucket_for"]
+__all__ = ["BurstPlan", "LLMEngine", "Request", "RequestOutput",
+           "RequestRejected", "PagedKVPool", "PoolExhausted", "NULL_PAGE",
+           "Scheduler", "SchedulerConfig", "Sequence", "SequenceStatus",
+           "StepPlan", "ServingMetrics", "bucket_for"]

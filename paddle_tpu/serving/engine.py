@@ -141,6 +141,75 @@ def _quantized_append(Pp, Ps, tok, page_ids, off, page_size, live):
         Ps.at[:, page_ids].set(new_s)
 
 
+def _segmented_quant_append(Pp, Ps, chunk, tbls, q_starts, q_lens, kv_lens,
+                            page_size, max_pages, chunk_cap):
+    """Segmented int8 chunk append: ONE running-amax requant per touched
+    (head, page) instead of the old per-token chunk walk (chunk_cap
+    sequential rounds of dequant->round, PR 6's named follow-up).
+
+    Per touched page the final scale is ``max(old_scale, amax(new
+    tokens in the page) / 127)`` — exactly what the sequential walk
+    converges to — the page's existing content is requantized ONCE at
+    that scale, and every new token is quantized directly at it (the
+    walk round-tripped early tokens through each intermediate scale;
+    quantizing at the final scale skips that double rounding, so values
+    land within one rounding step of the walk and a single-token append
+    is :func:`_quantized_append`'s math exactly). The loop runs over
+    touched page SLOTS (``chunk_cap // page_size + 1`` worst case,
+    traced-bounded to the live maximum — ONE iteration for
+    decode-heavy launches) not chunk positions.
+
+    Pp: [Hkv, num_pages, ps, d] int8; Ps: [Hkv, num_pages] f32;
+    chunk: [Hkv, T, d] fp new tokens packed row-wise (the ragged step's
+    query packing); tbls/q_starts/q_lens/kv_lens as in the ragged step.
+    Rows own disjoint write pages (CoW guarantees it), dead rows target
+    the null page and write nothing. Returns (Pp, Ps).
+    """
+    ps = page_size
+    rows = jnp.arange(tbls.shape[0])
+    start = jnp.maximum(kv_lens - q_lens, 0)               # [R]
+    first_page = start // ps
+    last_page = jnp.where(q_lens > 0, jnp.maximum(kv_lens - 1, 0) // ps,
+                          first_page - 1)
+    max_touched = -(-chunk_cap // ps) + 1
+    bound = jnp.clip(jnp.max(last_page - first_page + 1), 0, max_touched)
+
+    def body(j, carry):
+        Pp, Ps = carry
+        pidx = first_page + j                              # [R]
+        pg_lo = pidx * ps
+        w_lo = jnp.maximum(start, pg_lo)                   # write range
+        w_hi = jnp.minimum(kv_lens, pg_lo + ps)            # ∩ this page
+        live = (w_lo < w_hi) & (q_lens > 0)
+        page = jnp.where(live,
+                         tbls[rows, jnp.clip(pidx, 0, max_pages - 1)],
+                         NULL_PAGE)
+        slot_pos = pg_lo[:, None] + jnp.arange(ps)[None, :]   # [R, ps]
+        tok_idx = jnp.clip(q_starts[:, None] + slot_pos - start[:, None],
+                           0, chunk.shape[1] - 1)
+        sel = (slot_pos >= w_lo[:, None]) & (slot_pos < w_hi[:, None]) \
+            & live[:, None]                                # [R, ps]
+        new = chunk[:, tok_idx]                            # [Hkv, R, ps, d]
+        amax = jnp.max(jnp.where(sel[None, :, :, None], jnp.abs(new), 0.0),
+                       axis=(2, 3))                        # [Hkv, R]
+        old_s = Ps[:, page]
+        new_s = jnp.where(live[None, :],
+                          jnp.maximum(old_s,
+                                      jnp.maximum(amax, 1e-8) / 127.0),
+                          old_s)
+        ratio = jnp.where(new_s > 0, old_s / new_s, 0.0)
+        page_q = jnp.clip(jnp.round(
+            Pp[:, page].astype(jnp.float32) * ratio[:, :, None, None]),
+            -127, 127)
+        tok_q = jnp.clip(jnp.round(
+            new / jnp.maximum(new_s[:, :, None, None], 1e-30)), -127, 127)
+        page_new = jnp.where(sel[None, :, :, None], tok_q, page_q) \
+            .astype(jnp.int8)
+        return (Pp.at[:, page].set(page_new), Ps.at[:, page].set(new_s))
+
+    return jax.lax.fori_loop(0, bound, body, (Pp, Ps))
+
+
 class LLMEngine:
     """Continuous-batching serving engine over a paged KV pool."""
 
@@ -149,14 +218,29 @@ class LLMEngine:
                  step_token_budget=None, batch_buckets=None,
                  pages_buckets=None, prefill_buckets=None,
                  max_prefills_per_step=4, prefix_caching=True,
-                 prefix_cache_size=4096,
+                 prefix_cache_size=4096, pinned_prefix_pages=0,
                  high_watermark=0.90, low_watermark=0.50, seed=0,
                  stream_cb=None, now_fn=time.monotonic, interpret=None,
-                 quantized_mode=None, kv_cache_dtype=None):
+                 quantized_mode=None, kv_cache_dtype=None,
+                 burst_tokens=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
                 f"{page_size}")
+        if burst_tokens is None:
+            from ..core.flags import GLOBAL_FLAGS
+            burst_tokens = int(GLOBAL_FLAGS.get("decode_burst_tokens"))
+        if burst_tokens < 1:
+            raise ValueError(f"burst_tokens must be >= 1, got "
+                             f"{burst_tokens}")
+        #: on-device generation burst length: when > 1 and every running
+        #: row is a caught-up decode row, the engine dispatches ONE
+        #: jitted lax.while_loop of up to this many sample->append->gate
+        #: iterations instead of one ragged step per token; the
+        #: scheduler re-syncs (admission / preemption / CoW / prefix
+        #: registration) at burst boundaries. 1 = the per-token path,
+        #: bit-identical to the pre-burst engine.
+        self.burst_tokens = burst_tokens
         self.cfg = cfg = model.config
         self.params = extract_params(model)
         # low-bit serving weights: the jitted ragged step traces over a
@@ -191,7 +275,8 @@ class LLMEngine:
         self.pool = PagedKVPool(
             cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
             num_pages=num_pages, page_size=page_size, dtype=dtype,
-            high_watermark=high_watermark, low_watermark=low_watermark)
+            high_watermark=high_watermark, low_watermark=low_watermark,
+            pinned_page_budget=pinned_prefix_pages)
         self.metrics = ServingMetrics(now_fn=now_fn)
         self.scheduler = Scheduler(
             self.pool,
@@ -204,6 +289,10 @@ class LLMEngine:
         self.max_num_seqs = self.scheduler.config.max_num_seqs
         self.q_block = self.scheduler.config.q_block
         self.step_token_budget = self.scheduler.config.step_token_budget
+        # remember whether the caller PINNED the execution mode: the
+        # megakernel honors an explicit knob but otherwise stays
+        # env-driven (jnp fallback off-TPU, int8_matmul's discipline)
+        self._interpret_explicit = interpret is not None
         if interpret is None:
             from ..kernels import _on_tpu
             interpret = not _on_tpu()
@@ -220,7 +309,15 @@ class LLMEngine:
         #: donor still owns the chain's pages (it leaves the map's truth
         #: when the donor is freed — the probe re-validates on every hit)
         self._prefix_cache: dict[tuple, tuple[str, int]] = {}
+        #: page-aligned token-prefix -> (pinned chain id, length): the
+        #: pinned-LRU fallback when no LIVE donor matches — a chain the
+        #: pool still pins can be re-forked long after its last sequence
+        #: sharer left (repeated cold prompts skip the re-prefill). LRU
+        #: capped alongside _prefix_cache; entries whose chain the pool
+        #: evicted fail ``is_pinned`` and are pruned on probe.
+        self._pinned_index: dict[tuple, tuple[tuple, int]] = {}
         self._step_launched = False
+        self._burst_launched = False
         self._build_step()
 
     # ------------------------------------------------------------------
@@ -235,6 +332,11 @@ class LLMEngine:
         PPS = self.max_pages_per_seq
         chunk_cap = self.chunk_size
         interpret = self._interpret
+        # the megakernel's mode: an explicit LLMEngine(interpret=...)
+        # pins it (both launch paths then obey one knob); None stays
+        # env-driven — Pallas on TPU, jnp fallback off it, interpreter
+        # under PADDLE_TPU_FORCE_PALLAS (int8_matmul's discipline)
+        mk_interpret = interpret if self._interpret_explicit else None
         quant_pool = self.pool.quantized
         H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                      cfg.head_dim)
@@ -298,33 +400,118 @@ class LLMEngine:
 
         def _append_quant(Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
                           kv_lens):
-            # int8 append: a chunk writes several tokens into the same
-            # page, and each write may grow the page's running-amax scale
-            # (requantizing earlier content in place) — so walk the chunk
-            # positions sequentially; each iteration appends at most one
-            # token per row and rows own disjoint write pages, which is
-            # exactly the single-token append's contract.
-            rows = jnp.arange(tbls.shape[0])
+            # segmented int8 append: one running-amax requant per
+            # touched (head, page) — a chunk costs pages-touched
+            # iterations, not chunk-length iterations
+            Kp, Ks = _segmented_quant_append(
+                Kp, Ks, kt, tbls, q_starts, q_lens, kv_lens, ps, PPS,
+                chunk_cap)
+            Vp, Vs = _segmented_quant_append(
+                Vp, Vs, vt, tbls, q_starts, q_lens, kv_lens, ps, PPS,
+                chunk_cap)
+            return Kp, Ks, Vp, Vs
 
-            def body(i, carry):
-                Kp, Ks, Vp, Vs = carry
-                live = i < q_lens                           # [R]
-                flat = jnp.clip(q_starts + i, 0, kt.shape[1] - 1)
-                pos = jnp.maximum(kv_lens - q_lens + i, 0)
+        def burst_step(params, kv, kv_scales, tokens, kv_lens, tbls,
+                       live0, caps, temps, eos_ids, n_steps, key):
+            # the on-device token loop (decode megakernel mode): up to
+            # burst_tokens sample -> KV append -> EOS/length gate
+            # iterations inside ONE executable. Every row is a
+            # caught-up decode row; block tables, the int8 running-amax
+            # scales, and the per-row live mask all ride the loop
+            # carry. n_steps (traced) bounds the trip count so every
+            # burst size reuses the same compilation; eos_ids < 0 means
+            # "no eos" for that row.
+            from ..kernels.decode_megakernel import fused_decode_layer
+            R = self.max_num_seqs
+            B = self.burst_tokens
+            rows = jnp.arange(R)
+            out0 = jnp.zeros((R, B), jnp.int32)
+            gen0 = jnp.zeros((R,), jnp.int32)
+            if not quant_pool:
+                kv_scales = ()
+
+            def cond(c):
+                i, live = c[0], c[5]
+                return (i < n_steps) & jnp.any(live)
+
+            def body(c):
+                i, tokens, kv, kv_scales, kv_lens, live, gen, out, key = c
+                key, sub = jax.random.split(key)
+                h = params["embed"][tokens]                  # [R, hid]
+                pos = kv_lens                                # append slot
                 page_idx = jnp.clip(pos // ps, 0, PPS - 1)
+                # rows live at iteration start append this iteration's
+                # token; rows that die below stop appending next round
+                live_in = live
                 page = jnp.where(live, tbls[rows, page_idx], NULL_PAGE)
                 off = pos % ps
-                Kp, Ks = _quantized_append(Kp, Ks, kt[:, flat], page, off,
-                                           ps, live)
-                Vp, Vs = _quantized_append(Vp, Vs, vt[:, flat], page, off,
-                                           ps, live)
-                return Kp, Ks, Vp, Vs
+                att_len = pos + 1       # attention covers the new token
+                new_kv, new_scales = [], []
+                for li, (lyr, (Kp, Vp)) in enumerate(
+                        zip(params["layers"], kv)):
+                    if quant_pool:
+                        # append-first: the running-amax requant must be
+                        # visible to the attention gather, so k/v are
+                        # projected here, quantize-appended, and the
+                        # megakernel attends over all att_len positions
+                        x = _rms_norm(h[None], lyr["ln1"],
+                                      cfg.rms_norm_eps)[0]
+                        kc = _rope(_wmat(x, lyr["k"])
+                                   .reshape(R, Hkv, d)[None],
+                                   pos[None], cfg.rope_theta, d)[0]
+                        vc = _wmat(x, lyr["v"]).reshape(R, Hkv, d)
+                        Ks, Vs = kv_scales[li]
+                        Kp, Ks = _quantized_append(
+                            Kp, Ks, jnp.transpose(kc, (1, 0, 2)), page,
+                            off, ps, live)
+                        Vp, Vs = _quantized_append(
+                            Vp, Vs, jnp.transpose(vc, (1, 0, 2)), page,
+                            off, ps, live)
+                        new_scales.append((Ks, Vs))
+                        h, _, _ = fused_decode_layer(
+                            lyr, h, Kp, Vp, tbls, att_len,
+                            eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
+                            num_heads=H, self_kv=False,
+                            interpret=mk_interpret, k_scales=Ks,
+                            v_scales=Vs)
+                    else:
+                        # the megakernel computes this token's k/v
+                        # in-kernel (self-attention term in-register)
+                        # and returns them for the page scatter —
+                        # lossless for fp pools
+                        h, kc, vc = fused_decode_layer(
+                            lyr, h, Kp, Vp, tbls, att_len,
+                            eps=cfg.rms_norm_eps, theta=cfg.rope_theta,
+                            num_heads=H, self_kv=True,
+                            interpret=mk_interpret)
+                        slot = page * ps + off
+                        npages = Kp.shape[1]
+                        kt = jnp.transpose(kc, (1, 0, 2))    # [Hkv, R, d]
+                        vt = jnp.transpose(vc, (1, 0, 2))
+                        Kp = Kp.reshape(Hkv, npages * ps, d).at[:, slot] \
+                            .set(kt).reshape(Hkv, npages, ps, d)
+                        Vp = Vp.reshape(Hkv, npages * ps, d).at[:, slot] \
+                            .set(vt).reshape(Hkv, npages, ps, d)
+                    new_kv.append((Kp, Vp))
+                hn = _rms_norm(h[None], params["norm"],
+                               cfg.rms_norm_eps)[0]
+                logits = _logits(params, hn, cfg)            # [R, V]
+                nxt = _sample_rows(logits, sub, temps)
+                out = out.at[:, i].set(jnp.where(live, nxt, 0))
+                gen = gen + live.astype(jnp.int32)
+                hit_eos = live & (eos_ids >= 0) & (nxt == eos_ids)
+                live = live & ~hit_eos & (gen < caps)
+                kv_lens = kv_lens + live_in.astype(jnp.int32)
+                tokens = jnp.where(live_in, nxt, tokens)
+                return (i + 1, tokens, new_kv,
+                        tuple(new_scales) if quant_pool else kv_scales,
+                        kv_lens, live, gen, out, key)
 
-            # traced bound: decode-heavy launches (max q_len == 1) run one
-            # iteration, not chunk_size dead rounds — same one executable
-            # (lax lowers a traced trip count to a while_loop)
-            bound = jnp.minimum(jnp.max(q_lens), chunk_cap)
-            return jax.lax.fori_loop(0, bound, body, (Kp, Ks, Vp, Vs))
+            init = (jnp.asarray(0, jnp.int32), tokens, kv,
+                    tuple(kv_scales), kv_lens, live0, gen0, out0, key)
+            c = jax.lax.while_loop(cond, body, init)
+            return (c[7], c[6], c[2],
+                    list(c[3]) if quant_pool else None)
 
         # donate the pool buffers (args 1-2: pages + scales) so the step
         # updates in place on TPU; CPU/PJRT-cpu ignores donation with a
@@ -332,6 +519,7 @@ class LLMEngine:
         from ..kernels import _on_tpu
         donate = (1, 2) if _on_tpu() else ()
         self._ragged_jit = jax.jit(ragged_step, donate_argnums=donate)
+        self._burst_jit = jax.jit(burst_step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # public API
@@ -423,6 +611,15 @@ class LLMEngine:
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         snap["decode_cache_size"] = self.decode_cache_size()
+        snap["burst_tokens"] = self.burst_tokens
+        from ..kernels.decode_megakernel import megakernel_mode
+        snap["megakernel_mode"] = megakernel_mode(
+            self.params["layers"][0],
+            interpret=self._interpret if self._interpret_explicit
+            else None) if self.burst_tokens > 1 else None
+        tok = snap["tokens_generated"]
+        snap["host_dispatches_per_token"] = \
+            snap["host_dispatches"] / tok if tok else None
         return snap
 
     def decode_cache_size(self):
@@ -436,10 +633,14 @@ class LLMEngine:
             return 1 if self._step_launched else 0
 
     def step(self):
-        """One scheduler round: shed -> admit (prefix-fork) -> one ragged
-        launch covering every running row (decode steps and prefill
-        chunks interleaved). Returns the RequestOutputs touched this step
-        (admitted, token streamed, finished, shed, or preempted)."""
+        """One scheduler round: shed -> admit (prefix-fork) -> one
+        device launch covering every running row. When every row is a
+        caught-up decode row and ``burst_tokens > 1``, the launch is an
+        on-device generation BURST (up to burst_tokens tokens per row,
+        one host dispatch); otherwise it is one ragged step (decode
+        steps and prefill chunks interleaved). Returns the
+        RequestOutputs touched this step (admitted, token streamed,
+        finished, shed, or preempted)."""
         touched = {}
         for seq in self.scheduler.shed_expired():
             self._finalize(seq, "shed")
@@ -447,11 +648,28 @@ class LLMEngine:
         hook = self._prefix_probe if self.prefix_caching else None
         for seq in self.scheduler.admit(prefix_hook=hook):
             touched[seq.seq_id] = self._sync_output(seq)
-        plan = self.scheduler.prepare_step()
-        for t in self.scheduler.last_preempted:
+        plan = None
+        bplan = None
+        preempted = []
+        if self.burst_tokens > 1:
+            bplan = self.scheduler.prepare_burst(self.burst_tokens)
+            preempted += self.scheduler.last_preempted
+        if bplan is None:
+            plan = self.scheduler.prepare_step()
+            preempted += self.scheduler.last_preempted
+        for t in preempted:
             self._sync_output(t)           # surface fresh preemptions once
             touched[t.seq_id] = self._outputs[t.seq_id]
-        if plan is not None:
+        if bplan is not None:
+            if bplan.cow_copies:
+                self.metrics.cow_copies.inc(bplan.cow_copies)
+            self._launch_burst(bplan, touched)
+            self.metrics.decode_steps.inc()
+            # pad fraction is a ragged-packing concept; zero it so the
+            # gauge never freezes on a stale prefill step's value while
+            # bursts serve the traffic
+            self.metrics.ragged_pad_fraction.set(0.0)
+        elif plan is not None:
             if plan.cow_copies:
                 self.metrics.cow_copies.inc(plan.cow_copies)
             sampled = self._launch(plan)
@@ -510,6 +728,22 @@ class LLMEngine:
             self._prefix_cache[key] = (seq.seq_id, j)
         while len(self._prefix_cache) > self.prefix_cache_size:
             self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        # pinned-LRU registration: the FULL pages of the prompt prefix
+        # get an rc floor in the pool, so the chain survives its last
+        # sequence sharer (up to the pinned-page budget) and repeated
+        # cold prompts re-fork instead of re-prefilling. Only full pages
+        # pin: partial tail pages are append targets (and, int8, requant
+        # targets) — they must die with their writers.
+        full = (len(P) // ps) * ps
+        if full >= ps and self.pool.pinned_page_budget > 0:
+            chain = tuple(P[:full])
+            if self.pool.pin(chain, seq.seq_id, full):
+                for j in list(range(ps, full + 1, ps)):
+                    key = tuple(P[:j])
+                    self._pinned_index.pop(key, None)
+                    self._pinned_index[key] = (chain, j)
+                while len(self._pinned_index) > self.prefix_cache_size:
+                    self._pinned_index.pop(next(iter(self._pinned_index)))
 
     def _prefix_probe(self, seq: Sequence) -> int:
         """Admission hook: longest registered chain matching the prompt
@@ -549,6 +783,30 @@ class LLMEngine:
             self.pool.fork(seq.seq_id, donor, num_tokens=shared)
             self.metrics.prefix_cache_hits.inc()
             return shared
+        # no LIVE donor: fall back to the pinned-LRU chains — a prefix
+        # whose last sharer already left the pool can still be forked
+        # as long as its pin survived (budget LRU / pressure eviction)
+        for j in cands:
+            ent = self._pinned_index.get(tuple(P[:j]))
+            if ent is None:
+                continue
+            chain, length = ent
+            if not self.pool.is_pinned(chain):
+                self._pinned_index.pop(tuple(P[:j]), None)   # evicted
+                continue
+            # pinned chains are full pages, registered under their exact
+            # token tuple — content revalidation is the key itself. The
+            # last prompt token is never shared (its logits seed the
+            # first generated token); int8 full-page-only is automatic.
+            shared = min(j, len(P) - 1)
+            if self.pool.quantized:
+                shared = (shared // ps) * ps
+            if shared < 1:
+                continue
+            self.pool.fork_pinned(seq.seq_id, chain, shared)
+            self.metrics.prefix_cache_hits.inc()
+            self.metrics.pinned_prefix_hits.inc()
+            return shared
         self.metrics.prefix_cache_misses.inc()
         return 0
 
@@ -563,6 +821,7 @@ class LLMEngine:
         """Assemble the fixed-shape operands for the plan and run the one
         ragged-step executable."""
         T, R, PPS = plan.token_budget, plan.num_slots, self.max_pages_per_seq
+        self.metrics.host_dispatches.inc()
         if not self._step_launched:
             self._step_launched = True
             self.metrics.decode_compiles.inc()
@@ -595,6 +854,59 @@ class LLMEngine:
         if new_scales is not None:
             self.pool.kv_scales = new_scales
         return np.asarray(sampled)
+
+    def _launch_burst(self, bplan, touched):
+        """Assemble the fixed-shape burst operands and run the
+        on-device token loop: ONE host dispatch for up to
+        ``burst_tokens`` tokens per row. The host then replays the
+        returned token buffer through the normal commit path (stream
+        callbacks, EOS/length finalization, prefix registration) and
+        re-syncs the pool's committed lengths."""
+        R, PPS = self.max_num_seqs, self.max_pages_per_seq
+        tokens = np.zeros((R,), np.int32)
+        kv_lens = np.zeros((R,), np.int32)
+        tbls = np.full((R, PPS), NULL_PAGE, np.int32)
+        live = np.zeros((R,), bool)
+        caps = np.zeros((R,), np.int32)
+        temps = np.zeros((R,), np.float32)
+        eos_ids = np.full((R,), -1, np.int32)
+        for i, (seq, cap) in enumerate(bplan.rows):
+            tokens[i] = seq.all_ids[-1]
+            kv_lens[i] = seq.cached_len
+            tbls[i] = self.pool.padded_block_table(seq.seq_id, PPS)
+            live[i] = True
+            caps[i] = cap
+            temps[i] = seq.temperature
+            if seq.eos_token_id is not None:
+                eos_ids[i] = seq.eos_token_id
+        self.metrics.host_dispatches.inc()
+        self.metrics.burst_launches.inc()
+        if not self._burst_launched:
+            # the burst loop is a second step executable: its compile
+            # rides the same forensics counter as the ragged step's
+            self._burst_launched = True
+            self.metrics.decode_compiles.inc()
+        out, gen, new_kv, new_scales = self._burst_jit(
+            self.params, self.pool.kv, self.pool.kv_scales,
+            jnp.asarray(tokens), jnp.asarray(kv_lens), jnp.asarray(tbls),
+            jnp.asarray(live), jnp.asarray(caps), jnp.asarray(temps),
+            jnp.asarray(eos_ids), jnp.asarray(bplan.burst_len, jnp.int32),
+            self._next_key())
+        self.pool.kv = new_kv
+        if new_scales is not None:
+            self.pool.kv_scales = new_scales
+        out = np.asarray(out)
+        gen = np.asarray(gen)
+        for i, (seq, cap) in enumerate(bplan.rows):
+            g = int(gen[i])
+            seq.cached_len += g
+            # prepare_burst committed cached + cap up front; shrink the
+            # pool's committed length back to what the burst actually
+            # appended (a row that finished mid-burst appended fewer)
+            self.pool.set_seq_len(seq.seq_id, seq.cached_len)
+            for j in range(g):
+                self._commit_token(seq, int(out[i, j]))
+            touched[seq.seq_id] = self._outputs[seq.seq_id]
 
     def _commit_token(self, seq: Sequence, tok: int):
         seq.tokens.append(int(tok))

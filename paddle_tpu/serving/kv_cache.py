@@ -90,7 +90,7 @@ class PagedKVPool:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  page_size, dtype=jnp.float32, high_watermark=0.90,
-                 low_watermark=0.50):
+                 low_watermark=0.50, pinned_page_budget=0):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
         if not 0.0 < low_watermark <= high_watermark <= 1.0:
@@ -121,10 +121,24 @@ class PagedKVPool:
         self._free = list(range(num_pages - 1, NULL_PAGE, -1))
         self._tables: dict[object, list[int]] = {}
         self._lens: dict[object, int] = {}
-        #: pool page -> number of sequences mapping it (0 for free pages)
+        #: pool page -> number of owners mapping it: sequences AND pinned
+        #: prefix chains both count (0 for free pages)
         self._refcounts = [0] * num_pages
         #: lifetime count of copy-on-write page duplications
         self.cow_copies = 0
+        #: pinned prefix chains: chain_id -> (pages, num_tokens), in LRU
+        #: order (dict preserves insertion; re-pin/touch re-appends). A
+        #: pin is one extra refcount per page — the "rc floor" that lets
+        #: a prefix chain outlive its last sequence sharer, up to
+        #: ``pinned_page_budget`` pages (LRU-evicted beyond it, and
+        #: auto-evicted whenever an allocation would otherwise exhaust
+        #: the pool — pinned pages are cache, never demand).
+        self.pinned_page_budget = int(pinned_page_budget)
+        self._pins: dict[object, tuple[list[int], int]] = {}
+        #: pool page -> number of pinned chains mapping it
+        self._pin_counts: dict[int, int] = {}
+        #: lifetime count of pinned chains evicted (budget or pressure)
+        self.pin_evictions = 0
 
     # ---- byte accounting (pool sizing / bench fields) ----
     @staticmethod
@@ -204,11 +218,16 @@ class PagedKVPool:
         return self._refcounts[page]
 
     def above_high_watermark(self, extra_pages=0) -> bool:
-        return (self.used_pages + extra_pages) / self.capacity \
+        # pinned-exclusive pages are reclaimable cache, not demand: a
+        # pool full of evictable prefixes must not read as pressure (it
+        # would pause admission with nothing left to drain it)
+        demand = self.used_pages - self.evictable_pages
+        return (demand + extra_pages) / self.capacity \
             > self.high_watermark
 
     def below_low_watermark(self) -> bool:
-        return self.utilization < self.low_watermark
+        demand = self.used_pages - self.evictable_pages
+        return demand / self.capacity < self.low_watermark
 
     def pages_for(self, num_tokens: int) -> int:
         return -(-max(num_tokens, 0) // self.page_size)
@@ -216,12 +235,74 @@ class PagedKVPool:
     def can_allocate(self, num_tokens: int) -> bool:
         return self.pages_for(num_tokens) <= len(self._free)
 
+    @property
+    def pinned_pages(self) -> int:
+        """Distinct pool pages held by at least one pinned chain."""
+        return len(self._pin_counts)
+
+    @property
+    def evictable_pages(self) -> int:
+        """Pinned pages whose ONLY owners are pins (no sequence maps
+        them) — the pages unpinning would actually recycle."""
+        return sum(1 for p, n in self._pin_counts.items()
+                   if self._refcounts[p] == n)
+
+    @property
+    def available_pages(self) -> int:
+        """Free pages plus reclaimable pinned-exclusive pages — what an
+        admission decision should compare against (pinned prefixes are
+        cache: they yield to demand via LRU eviction)."""
+        return len(self._free) + self.evictable_pages
+
     # ---- lifecycle ----
-    def _claim(self, n: int, what: str) -> list[int]:
+    def _release_pages(self, pages) -> int:
+        """Drop one refcount per page; recycle (free-list + int8 scale
+        reset) the pages whose refcount hits zero. Returns the number of
+        pages actually recycled."""
+        recycled = []
+        for p in reversed(list(pages)):
+            self._refcounts[p] -= 1
+            if self._refcounts[p] == 0:
+                recycled.append(p)
+        self._free.extend(recycled)
+        if self.kv_scales is not None and recycled:
+            # reset the recycled pages' dequant scales: the append
+            # path's running max only ever GROWS a scale, so a recycled
+            # page must not hand its next tenant the previous tenant's
+            # (possibly much larger) range — that would quantize small
+            # new values straight to zero. Pages still mapped elsewhere
+            # keep their scales.
+            idx = jnp.asarray(recycled, jnp.int32)
+            self.kv_scales = [(Ks.at[:, idx].set(0.0),
+                               Vs.at[:, idx].set(0.0))
+                              for Ks, Vs in self.kv_scales]
+        return len(recycled)
+
+    def _ensure_free(self, n: int, what: str):
+        """Evict LRU pinned chains until ``n`` pages are free (or no
+        eviction would recycle anything); raises
+        :class:`PoolExhausted` on a real shortfall. Pinned prefixes are
+        opportunistic cache — they must never turn real demand into an
+        exhaustion the scheduler would answer with preemption — but a
+        chain whose every page is also mapped by a live sequence frees
+        nothing when unpinned, so those survive the shortfall (wiping
+        them would cost the whole cache for zero pages)."""
+        while n > len(self._free) and self._pins:
+            victim = next(
+                (cid for cid, (pages, _) in self._pins.items()
+                 if any(self._refcounts[p] == self._pin_counts[p]
+                        for p in pages)), None)
+            if victim is None:
+                break
+            self.unpin(victim)
+            self.pin_evictions += 1
         if n > len(self._free):
             raise PoolExhausted(
                 f"{what}: need {n} pages, {len(self._free)} free of "
                 f"{self.capacity}")
+
+    def _claim(self, n: int, what: str) -> list[int]:
+        self._ensure_free(n, what)
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._refcounts[p] = 1
@@ -291,13 +372,21 @@ class PagedKVPool:
         need_fresh = max(self.pages_for(new_len) - len(table), 0)
         first = old_len // self.page_size
         last = self.pages_for(new_len)          # exclusive logical bound
-        shared = [i for i in range(first, min(last, len(table)))
-                  if self._refcounts[table[i]] > 1]
-        if need_fresh + len(shared) > len(self._free):
-            raise PoolExhausted(
-                f"append {seq_id!r} to {new_len} tokens: need "
-                f"{need_fresh} fresh + {len(shared)} CoW pages, "
-                f"{len(self._free)} free of {self.capacity}")
+        def _shared():
+            return [i for i in range(first, min(last, len(table)))
+                    if self._refcounts[table[i]] > 1]
+
+        shared = _shared()
+        # all-or-nothing: fresh + CoW pages are priced together, with
+        # LRU pinned chains evicted first if that is what it takes
+        self._ensure_free(
+            need_fresh + len(shared),
+            f"append {seq_id!r} to {new_len} tokens: need "
+            f"{need_fresh} fresh + {len(shared)} CoW pages")
+        # eviction may have dropped a pin's refcount on a page in the
+        # write range — recompute so a now-exclusive page is written in
+        # place instead of CoW'd into a leak
+        shared = _shared()
         olds, news = [], []
         for i in shared:
             old = table[i]
@@ -325,28 +414,85 @@ class PagedKVPool:
 
     def free(self, seq_id) -> int:
         """Drop every page mapping the sequence owns; a page is recycled
-        (returned to the free list) only when its refcount hits zero.
-        Returns the number of pages actually recycled."""
+        (returned to the free list) only when its refcount hits zero —
+        pages a pinned prefix chain also holds survive at the pin's rc
+        floor. Returns the number of pages actually recycled."""
         pages = self._tables.pop(seq_id)
         self._lens.pop(seq_id, None)
-        recycled = []
-        for p in reversed(pages):
-            self._refcounts[p] -= 1
-            if self._refcounts[p] == 0:
-                recycled.append(p)
-        self._free.extend(recycled)
-        if self.kv_scales is not None and recycled:
-            # reset the recycled pages' dequant scales: the append path's
-            # running max (engine's quantized append) only ever GROWS a
-            # scale, so a recycled page must not hand its next tenant the
-            # previous sequence's (possibly much larger) range — that
-            # would quantize small new values straight to zero. Pages
-            # still mapped by other sequences keep their scales.
-            idx = jnp.asarray(recycled, jnp.int32)
-            self.kv_scales = [(Ks.at[:, idx].set(0.0),
-                               Vs.at[:, idx].set(0.0))
-                              for Ks, Vs in self.kv_scales]
-        return len(recycled)
+        return self._release_pages(pages)
+
+    # ---- pinned prefix chains (LRU page cache over the pool) ----
+    def pin(self, chain_id, seq_id, num_tokens: int) -> bool:
+        """Pin the pages covering ``seq_id``'s first ``num_tokens``
+        committed tokens (must be page-aligned: only FULL pages are
+        append-free and therefore safe to outlive their writers) under
+        ``chain_id``. The pin takes one refcount per page, so the chain
+        survives the sequence's ``free`` — repeated cold prompts re-fork
+        instead of re-prefilling. Re-pinning an existing chain refreshes
+        its LRU recency. Returns False (and pins nothing) when the
+        budget is 0 or the chain alone exceeds it."""
+        if num_tokens % self.page_size != 0:
+            raise ValueError(
+                f"pinned chains must be page-aligned: {num_tokens} "
+                f"tokens over page_size {self.page_size}")
+        n_pages = num_tokens // self.page_size
+        if n_pages < 1 or n_pages > self.pinned_page_budget:
+            return False
+        if self._lens.get(seq_id, -1) < num_tokens:
+            raise ValueError(
+                f"pin of {num_tokens} tokens exceeds {seq_id!r}'s "
+                f"committed {self._lens.get(seq_id)}")
+        if chain_id in self._pins:
+            self.unpin(chain_id)                 # refresh (LRU + pages)
+        pages = self._tables[seq_id][:n_pages]
+        # LRU budget: evict oldest chains until this one fits
+        while self.pinned_pages + n_pages > self.pinned_page_budget \
+                and self._pins:
+            self.unpin(next(iter(self._pins)))
+            self.pin_evictions += 1
+        for p in pages:
+            self._refcounts[p] += 1
+            self._pin_counts[p] = self._pin_counts.get(p, 0) + 1
+        self._pins[chain_id] = (list(pages), num_tokens)
+        return True
+
+    def unpin(self, chain_id) -> int:
+        """Drop a pinned chain's refcounts; recycles pages no sequence
+        maps anymore. Returns the number of pages recycled."""
+        pages, _ = self._pins.pop(chain_id)
+        for p in pages:
+            self._pin_counts[p] -= 1
+            if self._pin_counts[p] == 0:
+                del self._pin_counts[p]
+        return self._release_pages(pages)
+
+    def is_pinned(self, chain_id) -> bool:
+        return chain_id in self._pins
+
+    def touch_pin(self, chain_id):
+        """Refresh a chain's LRU recency (a probe hit keeps it hot)."""
+        ent = self._pins.pop(chain_id)
+        self._pins[chain_id] = ent
+
+    def fork_pinned(self, seq_id, chain_id, num_tokens: int) -> list[int]:
+        """Map a pinned chain's pages covering ``num_tokens`` tokens
+        into a new sequence — the cold-prompt analog of :meth:`fork`
+        (zero data movement, refcount + 1 per page). Touches the
+        chain's LRU recency."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already has an allocation")
+        pages, pinned_tokens = self._pins[chain_id]
+        if num_tokens > pinned_tokens:
+            raise ValueError(
+                f"fork of {num_tokens} tokens exceeds the chain's "
+                f"pinned {pinned_tokens}")
+        shared = pages[:self.pages_for(num_tokens)]
+        for p in shared:
+            self._refcounts[p] += 1
+        self._tables[seq_id] = list(shared)
+        self._lens[seq_id] = num_tokens
+        self.touch_pin(chain_id)
+        return list(shared)
 
     # ---- queries ----
     def __contains__(self, seq_id) -> bool:
@@ -379,11 +525,14 @@ class PagedKVPool:
     def check_invariants(self):
         """Debug/test hook: refcount/free-list/table consistency.
 
-        - every mapped page's refcount equals the number of tables
-          mapping it (and is therefore >= 1);
+        - every mapped page's refcount equals the number of owners
+          mapping it — sequence tables AND pinned chains both count —
+          (and is therefore >= 1);
         - every free page has refcount 0 and no free page is mapped;
         - distinct physical pages in use + free pages == capacity;
-        - the null page is never mapped and never on the free list.
+        - the null page is never mapped and never on the free list;
+        - pinned bookkeeping (_pin_counts) matches the pinned chains
+          and stays within the pinned-page budget.
         """
         mapped: dict[int, int] = {}
         for t in self._tables.values():
@@ -393,6 +542,17 @@ class PagedKVPool:
                     "a table maps the same pool page twice"
                 seen_in_table.add(p)
                 mapped[p] = mapped.get(p, 0) + 1
+        pin_counts: dict[int, int] = {}
+        for pages, num_tokens in self._pins.values():
+            assert num_tokens % self.page_size == 0, \
+                "pinned chain is not page-aligned"
+            for p in pages:
+                mapped[p] = mapped.get(p, 0) + 1
+                pin_counts[p] = pin_counts.get(p, 0) + 1
+        assert pin_counts == self._pin_counts, (
+            f"pin accounting drift: {pin_counts} != {self._pin_counts}")
+        assert len(pin_counts) <= max(self.pinned_page_budget, 0), \
+            "pinned pages exceed the pinned-page budget"
         assert NULL_PAGE not in mapped, "null page leaked into a table"
         assert NULL_PAGE not in self._free, "null page on the free list"
         for p, owners in mapped.items():

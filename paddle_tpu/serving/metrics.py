@@ -44,10 +44,15 @@ class ServingMetrics:
                 "prefills", "prefill_chunks", "decode_steps", "preemptions",
                 "shed_requests", "cancelled_requests", "finished_requests",
                 "decode_compiles", "cow_copies", "prefix_cache_hits",
-                "prefix_cache_misses")
+                "prefix_cache_misses",
+                # burst/megakernel forensics: jitted launches the host
+                # issued (the dispatch gate's numerator), on-device
+                # generation bursts, and prefix-cache hits served by a
+                # PINNED chain after its last sequence sharer left
+                "host_dispatches", "burst_launches", "pinned_prefix_hits")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
-              "shared_page_fraction")
+              "shared_page_fraction", "pinned_pages")
 
     #: tokens_per_s is the rate over this trailing window, not a lifetime
     #: average — a lifetime average decays toward zero across idle gaps
@@ -70,6 +75,7 @@ class ServingMetrics:
         self.page_utilization.set(pool.utilization)
         self.shared_page_fraction.set(
             getattr(pool, "shared_page_fraction", 0.0))
+        self.pinned_pages.set(getattr(pool, "pinned_pages", 0))
         now = self._now()
         self._rate_samples.append((now, self.tokens_generated.value))
         while len(self._rate_samples) > 2 and \

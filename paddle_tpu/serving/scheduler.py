@@ -114,6 +114,18 @@ class Sequence:
 
 
 @dataclass
+class BurstPlan:
+    """One on-device generation burst: every row is a caught-up decode
+    row (uncached_len == 1), and the pool already covers ``cap`` more
+    tokens per row — the jitted ``lax.while_loop`` runs up to
+    ``burst_len`` sample->append->gate iterations with NO host round
+    trip, the host re-syncing scheduler state only at the boundary."""
+    rows: list                 # [(Sequence, cap)] cap = max tokens this burst
+    burst_len: int             # max(cap) — the loop's trip bound
+    cow_copies: int = 0        # copy-on-write page dups pre-claimed
+
+
+@dataclass
 class StepPlan:
     """One fixed-shape ragged launch: ``rows`` are (seq, q_start, q_len)
     with slot starts aligned to ``q_block``, packed into a
@@ -262,7 +274,9 @@ class Scheduler:
             seq = self.waiting[0]
             first_len = min(self.config.chunk_size, seq.total_len)
             n_pages = self.pool.pages_for(first_len)
-            if n_pages > self.pool.free_pages:
+            # available = free + reclaimable pinned-exclusive pages (a
+            # pool full of evictable prefix cache must still admit)
+            if n_pages > self.pool.available_pages:
                 break
             # watermark admission control: above the high watermark stop
             # taking new work (leave headroom for running seqs to grow),
@@ -318,6 +332,64 @@ class Scheduler:
             self.running.remove(seq)
         if seq.seq_id in self.pool:
             self.pool.free(seq.seq_id)
+
+    def prepare_burst(self, burst_tokens: int) -> BurstPlan | None:
+        """Plan an on-device generation burst, or None when ineligible.
+
+        Eligible only when EVERY running sequence is a caught-up decode
+        row (its whole prompt committed, exactly one uncached token):
+        prefill chunks need per-chunk host packing, so any in-flight
+        prompt falls back to the per-step ragged path. Claims (and
+        CoWs) each row's pages for up to ``min(burst_tokens,
+        remaining_new_tokens)`` appends up front — the burst loop never
+        crosses into an unowned page — preempting latest arrivals when
+        the pool runs dry, exactly like :meth:`prepare_step`. Rows the
+        planning itself preempts drop out of the burst (they re-chunk
+        through per-step on re-admission)."""
+        self.last_preempted = []
+        if burst_tokens <= 1 or not self.running:
+            return None
+        for s in self.running:
+            if s.uncached_len != 1 or s.cached_len < len(s.prompt_ids):
+                return None
+        rows, cow = [], 0
+        for seq in list(self.running):
+            if seq.status is not SequenceStatus.RUNNING:
+                continue                      # preempted by an earlier row
+            cap = min(burst_tokens, seq.remaining_new_tokens)
+            while True:
+                try:
+                    cow += self.pool.prepare_append(
+                        seq.seq_id, seq.cached_len + cap)
+                    break
+                except PoolExhausted:
+                    # shrink before shooting: a shorter burst that fits
+                    # the row's already-owned pages beats preempting a
+                    # neighbor into a full re-prefill (the per-step
+                    # path's 1-token grant, generalized)
+                    fit = len(self.pool.block_table(seq.seq_id)) \
+                        * self.pool.page_size - seq.cached_len
+                    if 1 <= fit < cap:
+                        cap = fit
+                        continue
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        self.preempt(seq)
+                        break
+                    self.preempt(victim)
+            if seq.status is SequenceStatus.RUNNING:
+                rows.append((seq, cap))
+        # a LATER row's PoolExhausted retry can pick an already-planned
+        # row as its preemption victim — drop stale rows (their pool
+        # entries are freed) instead of handing _launch_burst a
+        # sequence with no block table (prepare_step's rebuild-from-
+        # running discipline)
+        rows = [(s, c) for s, c in rows
+                if s.status is SequenceStatus.RUNNING]
+        if not rows:
+            return None
+        return BurstPlan(rows, burst_len=max(cap for _, cap in rows),
+                         cow_copies=cow)
 
     def prepare_step(self) -> StepPlan | None:
         """Grant each running sequence its step-token share, grow/CoW its
@@ -378,5 +450,5 @@ class Scheduler:
         return max(candidates, key=lambda s: s.arrival)
 
 
-__all__ = ["Scheduler", "SchedulerConfig", "Sequence", "SequenceStatus",
-           "StepPlan", "bucket_for"]
+__all__ = ["BurstPlan", "Scheduler", "SchedulerConfig", "Sequence",
+           "SequenceStatus", "StepPlan", "bucket_for"]

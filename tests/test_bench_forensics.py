@@ -72,7 +72,11 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # stale artifact must not claim a compile count or a
               # prefix-cache hit rate the failed run never measured
               "decode_compiles", "prefix_cache_hit_rate",
-              "shared_page_fraction"):
+              "shared_page_fraction",
+              # burst/megakernel fields likewise (PR 7): a dispatch
+              # ratio or kernel mode is a per-run measurement
+              "burst_tokens", "host_dispatches_per_token",
+              "megakernel_mode", "burst_tokens_per_s"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -185,3 +189,11 @@ def test_serving_probe_records_ragged_and_prefix_fields():
     assert 0.0 < out["prefix_cache_hit_rate"] <= 1.0
     assert out["shared_page_fraction"] > 0.0
     assert out["serving_tokens_per_s"] > 0.0
+    # the burst wave measured the on-device token loop: dispatch ratio
+    # well under one per token, mode named (jnp on this CPU container)
+    assert "burst_probe_error" not in out, out
+    assert out["burst_tokens"] == 8
+    assert out["host_dispatches_per_token"] is not None
+    assert out["host_dispatches_per_token"] < 0.8, out
+    assert out["megakernel_mode"] in ("pallas", "interpret", "jnp")
+    assert out["burst_tokens_per_s"] > 0.0

@@ -252,3 +252,150 @@ def test_check_invariants_catches_refcount_drift():
     p._refcounts[p.block_table("a")[0]] += 1     # simulate a leak
     with pytest.raises(AssertionError, match="refcount"):
         p.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# pinned prefix chains: rc floor + LRU eviction (PR 7)
+# ---------------------------------------------------------------------------
+
+def test_pin_is_an_rc_floor_over_free():
+    """A pinned chain keeps its pages out of the free list after the
+    last sequence sharer is freed; unpin recycles them."""
+    p = _pool(num_pages=9, pinned_page_budget=4)
+    p.allocate("a", 8)                       # 2 full pages
+    pages = p.block_table("a")
+    assert p.pin(("chain",), "a", 8)
+    p.check_invariants()
+    p.free("a")
+    assert p.free_pages == p.capacity - 2    # chain holds 2 pages
+    assert p.pinned_pages == 2
+    assert all(p.page_refcount(pg) == 1 for pg in pages)
+    p.check_invariants()
+    assert p.unpin(("chain",)) == 2
+    assert p.free_pages == p.capacity
+    p.check_invariants()
+
+
+def test_pin_requires_page_alignment_and_budget():
+    p = _pool(num_pages=9, pinned_page_budget=1)
+    p.allocate("a", 8)
+    with pytest.raises(ValueError, match="page-aligned"):
+        p.pin(("c",), "a", 6)
+    assert not p.pin(("c",), "a", 8)         # 2 pages > budget 1
+    assert p.pinned_pages == 0
+    # budget 0 (the default): pin is a no-op, legacy behavior intact
+    q = _pool(num_pages=9)
+    q.allocate("a", 8)
+    assert not q.pin(("c",), "a", 8)
+
+
+def test_pin_budget_evicts_lru_chain():
+    p = _pool(num_pages=9, pinned_page_budget=2)
+    p.allocate("a", 4)
+    p.allocate("b", 4)
+    p.allocate("c", 4)
+    assert p.pin(("A",), "a", 4) and p.pin(("B",), "b", 4)
+    assert p.pinned_pages == 2
+    assert p.pin(("C",), "c", 4)             # budget full: A (oldest) out
+    assert not p.is_pinned(("A",)) and p.is_pinned(("B",))
+    assert p.is_pinned(("C",)) and p.pin_evictions == 1
+    # touching B refreshes recency: the next eviction takes C
+    p.touch_pin(("B",))
+    p.allocate("d", 4)
+    assert p.pin(("D",), "d", 4)
+    assert p.is_pinned(("B",)) and not p.is_pinned(("C",))
+    p.check_invariants()
+
+
+def test_fork_pinned_revives_a_cold_chain():
+    p = _pool(num_pages=9, pinned_page_budget=4)
+    p.allocate("a", 8)
+    pages = p.block_table("a")
+    assert p.pin(("chain",), "a", 8)
+    p.free("a")                              # donor gone, chain survives
+    shared = p.fork_pinned("b", ("chain",), 8)
+    assert shared == pages
+    assert p.seq_len("b") == 8
+    assert all(p.page_refcount(pg) == 2 for pg in pages)   # pin + b
+    # b's append past the chain CoWs nothing (pages are full) but its
+    # free must leave the chain alive
+    p.extend("b", 10)
+    p.free("b")
+    assert p.is_pinned(("chain",)) and p.pinned_pages == 2
+    p.check_invariants()
+    with pytest.raises(ValueError, match="exceeds"):
+        p.fork_pinned("c", ("chain",), 12)
+
+
+def test_claim_pressure_auto_evicts_pinned_chains():
+    """Pinned pages are cache: real demand evicts LRU chains instead of
+    raising PoolExhausted."""
+    p = _pool(num_pages=9, pinned_page_budget=8)
+    p.allocate("a", 16)                      # 4 of 8 usable pages
+    assert p.pin(("A",), "a", 16)
+    p.free("a")
+    assert p.free_pages == 4 and p.available_pages == 8
+    p.allocate("b", 24)                      # needs 6 > 4 free
+    assert not p.is_pinned(("A",))           # evicted under pressure
+    assert p.pin_evictions == 1
+    p.check_invariants()
+    # and a genuinely impossible claim still raises
+    with pytest.raises(PoolExhausted):
+        p.allocate("c", 12)                  # 3 > 2 remaining
+
+
+def test_pinned_pages_do_not_count_as_watermark_demand():
+    """A pool full of evictable prefix cache must not read as pressure
+    (admission would pause with nothing left to drain it)."""
+    p = _pool(num_pages=9, pinned_page_budget=8, high_watermark=0.5,
+              low_watermark=0.25)
+    p.allocate("a", 24)                      # 6 of 8: above high
+    assert p.above_high_watermark()
+    assert p.pin(("A",), "a", 24)
+    p.free("a")
+    # 6 pages still used, but all pinned-exclusive -> zero demand
+    assert p.used_pages == 6 and p.evictable_pages == 6
+    assert not p.above_high_watermark()
+    assert p.below_low_watermark()
+    # a sequence mapping a pinned page turns it back into demand
+    p.fork_pinned("b", ("A",), 24)
+    assert p.evictable_pages == 0
+    assert p.above_high_watermark()
+    p.check_invariants()
+
+
+def test_int8_pinned_eviction_resets_scales_on_recycle():
+    import jax.numpy as jnp
+    p = _pool(num_pages=9, pinned_page_budget=4, dtype=jnp.int8)
+    pages = p.allocate("a", 8)
+    idx = jnp.asarray(pages, jnp.int32)
+    p.kv_scales = [(Ks.at[:, idx].set(0.5), Vs.at[:, idx].set(0.5))
+                   for Ks, Vs in p.kv_scales]
+    assert p.pin(("A",), "a", 8)
+    p.free("a")                              # pinned: scales survive
+    Ks, _ = p.kv_scales[0]
+    assert float(jnp.min(Ks[:, idx])) == 0.5
+    p.unpin(("A",))                          # recycled: scales reset
+    Ks, _ = p.kv_scales[0]
+    assert float(jnp.max(Ks[:, idx])) == 0.0
+    p.check_invariants()
+
+
+def test_pressure_eviction_skips_chains_that_free_nothing():
+    """Evicting a chain whose every page is also mapped by a live
+    sequence recycles zero pages — the shortfall path must keep such
+    chains (wiping the cache for zero gain) and raise instead."""
+    p = _pool(num_pages=9, pinned_page_budget=8)
+    p.allocate("a", 16)                      # 4 of 8 usable pages
+    assert p.pin(("A",), "a", 16)            # every pinned page shared
+    p.allocate("b", 8)                       # 2 more: 2 free remain
+    with pytest.raises(PoolExhausted):
+        p.allocate("c", 16)                  # needs 4 > 2 free
+    assert p.is_pinned(("A",)), \
+        "evicting A frees nothing; the cache must survive"
+    assert p.pin_evictions == 0
+    # once the sharer leaves, the same pressure DOES evict
+    p.free("a")
+    p.allocate("c", 16)
+    assert not p.is_pinned(("A",)) and p.pin_evictions == 1
+    p.check_invariants()
