@@ -37,6 +37,19 @@ import time
 
 import numpy as np
 
+# the CPU-tier probes are shared with tools/proxy_bench.py (standalone
+# baseline-compare harness); bench.py keeps its artifact schema and
+# spreads the same fields into the flagship JSON line
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tools.bench_probes import (probe_input_pipeline,  # noqa: E402
+                                probe_opt_dispatches, probe_serving)
+
+# legacy aliases: forensics tests and older tooling call the underscored
+# names on this module
+_probe_opt_dispatches = probe_opt_dispatches
+_probe_serving = probe_serving
+_probe_input_pipeline = probe_input_pipeline
+
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
     "tpu v5p": 459e12,
@@ -278,268 +291,6 @@ def _peak_hbm_bytes(dev):
     return None
 
 
-def _probe_opt_dispatches(paddle, n_params=128):
-    """Measured per-step compiled-dispatch count of the optimizer path.
-
-    One eager AdamW step (global-norm clip, mixed f32/bf16) over a tiny
-    synthetic 128-param set, counted through the optimizer dispatch hook
-    (optimizer/fused.py). Records whether THIS run's configuration takes
-    the fused path — O(#dtype buckets)+1 — or the per-param loop —
-    O(n_params) — so the bench trajectory distinguishes the fused-optimizer
-    win from model-side changes. Cheap by construction (4x4 params), and
-    independent of the benchmark model whose eager step would not fit the
-    1B config's memory budget.
-    """
-    import numpy as _np
-    from paddle_tpu.optimizer import fused as _fused
-    try:
-        params = []
-        for i in range(n_params):
-            t = paddle.to_tensor(_np.zeros((4, 4), _np.float32),
-                                 dtype="bfloat16" if i % 4 == 0 else "float32")
-            t.stop_gradient = False
-            t.grad = paddle.to_tensor(_np.full((4, 4), 0.01, _np.float32),
-                                      dtype="bfloat16" if i % 4 == 0
-                                      else "float32")
-            params.append(t)
-        opt = paddle.optimizer.AdamW(
-            learning_rate=1e-4, parameters=params,
-            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
-        before = _fused.dispatch_count()
-        opt.step()
-        n = _fused.dispatch_count() - before
-        eng = opt._fused_engine
-        fused_on = eng is not None and eng.active
-        return {
-            "optimizer_mode": "fused" if fused_on else "per_param",
-            "opt_dispatches_per_step": n,
-            "opt_buckets": len(eng.buckets) if fused_on else 0,
-            "opt_dispatch_probe_params": n_params,
-        }
-    except Exception as e:  # the probe must never sink the bench artifact
-        return {"optimizer_mode": "unknown",
-                "opt_dispatch_probe_error": f"{type(e).__name__}: {e}"}
-
-
-def _probe_serving(paddle, wave=6, max_new=4):
-    """Measured serving-engine fields for the bench trajectory.
-
-    Drives the continuous-batching LLMEngine (paddle_tpu/serving/) over a
-    mixed-length request wave on a micro Llama config: one warmup wave
-    pays the single ragged-step compile, a second identical wave measures
-    steady-state serving throughput. The wave's prompts share a common
-    page-aligned prefix and arrive staggered (the first request's prompt
-    is committed before the rest arrive), so the prefix cache and
-    copy-on-write page sharing are genuinely exercised. Records:
-    - ``serving_tokens_per_s``: generated tokens / wall-clock of wave 2;
-    - ``kv_page_utilization``: peak fraction of pool pages in use;
-    - ``decode_compiles``: ragged-step executables built across BOTH
-      waves — expected 1 (tests/test_serving_compile_gate.py), so a
-      trajectory jump here flags shape-dependent recompilation;
-    - ``prefix_cache_hit_rate``: prefix-cache hits / probes across both
-      waves (the staggered shared-prefix arrivals should mostly hit);
-    - ``shared_page_fraction``: peak fraction of logical pages served by
-      a shared physical page — the admitted-sequences-per-byte win.
-    The low-bit serving path rides the same waves on a SECOND engine
-    (weight_only_int8 params + int8 paged KV):
-    - ``quantized_decode_tokens_per_s``: the quantized engine's measured
-      wave-2 throughput;
-    - ``weight_bytes``: resident bytes of the quantized param pytree
-      (int8 payloads + scales), vs the fp pytree's 4x;
-    - ``kv_bytes_per_token``: pool bytes one cached token occupies (int8
-      pages + amortized per-page scales);
-    - ``quantized_mode``: the mode the probe ran.
-    Micro-sized by design (1 layer, d=128): the probe measures the
-    engine's batching/dispatch layer, not model FLOPs, and must not eat
-    the bench child's timeout budget.
-    """
-    import time as _time
-    import numpy as _np
-    try:
-        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
-        from paddle_tpu.serving import LLMEngine
-        cfg = llama_tiny_config(
-            num_hidden_layers=1, hidden_size=128, intermediate_size=256,
-            num_attention_heads=1, num_key_value_heads=1, vocab_size=256)
-        model = LlamaForCausalLM(cfg)
-        eng = LLMEngine(model, max_len=64, page_size=8,
-                        batch_buckets=(1, 2, 4, 8))
-        rng = _np.random.default_rng(0)
-        # a shared 16-token (2-page) system-prompt prefix + distinct
-        # tails, staggered so the first request's prompt is committed
-        # (and registered in the prefix cache) before the rest arrive
-        prefix = rng.integers(0, 256, (16,)).tolist()
-        tails = [rng.integers(0, 256, (n,)).tolist()
-                 for n in [3, 5, 8, 2, 6, 4][:wave - 1]]
-        peak_util = 0.0
-        peak_shared = 0.0
-
-        def _drive(e, steps_cap=500):
-            nonlocal peak_util, peak_shared
-            steps = 0
-            while e.has_unfinished():
-                e.step()
-                peak_util = max(peak_util, e.pool.utilization)
-                peak_shared = max(peak_shared,
-                                  e.pool.shared_page_fraction)
-                steps += 1
-                assert steps < steps_cap
-
-        def _wave(e):
-            e.add_request(prefix, max_new_tokens=max_new)
-            e.step(); e.step()                    # donor prompt committed
-            for t in tails:
-                e.add_request(prefix + t, max_new_tokens=max_new)
-            _drive(e)
-
-        def _measure(e):
-            _wave(e)                              # warmup: compiles
-            tok0 = e.metrics.tokens_generated.value
-            t0 = _time.perf_counter()
-            _wave(e)                              # measured steady state
-            dt = _time.perf_counter() - t0
-            return (e.metrics.tokens_generated.value - tok0) / dt
-
-        tok_s = _measure(eng)
-        hits = eng.metrics.prefix_cache_hits.value
-        misses = eng.metrics.prefix_cache_misses.value
-        out = {
-            "serving_tokens_per_s": round(tok_s, 1),
-            "kv_page_utilization": round(peak_util, 4),
-            "decode_compiles": eng.decode_cache_size(),
-            "prefix_cache_hit_rate": round(hits / (hits + misses), 4)
-            if hits + misses else None,
-            "shared_page_fraction": round(peak_shared, 4),
-        }
-        try:
-            # burst-mode wave on a THIRD engine: the on-device token
-            # loop (decode megakernel + lax.while_loop burst) — the
-            # dispatch-bound slice of the decode win that IS measurable
-            # on CPU: host dispatches per generated token collapse from
-            # ~1 to ~1/burst_tokens (tests/test_decode_megakernel.py
-            # gates the O(1)-dispatches-per-burst contract)
-            engb = LLMEngine(model, max_len=64, page_size=8,
-                             batch_buckets=(1, 2, 4, 8), burst_tokens=8)
-            burst_tok_s = _measure(engb)
-            snapb = engb.metrics_snapshot()
-            out.update({
-                "burst_tokens": snapb["burst_tokens"],
-                "host_dispatches_per_token": round(
-                    snapb["host_dispatches_per_token"], 4)
-                if snapb["host_dispatches_per_token"] is not None
-                else None,
-                "megakernel_mode": snapb["megakernel_mode"],
-                "burst_tokens_per_s": round(burst_tok_s, 1),
-            })
-        except Exception as e:  # null, never fabricated
-            out.update({
-                "burst_tokens": None,
-                "host_dispatches_per_token": None,
-                "megakernel_mode": None,
-                "burst_tokens_per_s": None,
-                "burst_probe_error": f"{type(e).__name__}: {e}",
-            })
-        try:
-            from paddle_tpu.quantization import params_weight_bytes
-            mode = "weight_only_int8"
-            engq = LLMEngine(model, max_len=64, page_size=8,
-                             batch_buckets=(1, 2, 4, 8),
-                             quantized_mode=mode, kv_cache_dtype="int8")
-            q_tok_s = _measure(engq)
-            out.update({
-                "quantized_mode": mode,
-                "weight_bytes": params_weight_bytes(engq.params),
-                "kv_bytes_per_token": round(
-                    engq.pool.kv_bytes_per_token, 1),
-                "quantized_decode_tokens_per_s": round(q_tok_s, 1),
-            })
-        except Exception as e:  # null, never fabricated
-            out.update({
-                "quantized_mode": None, "weight_bytes": None,
-                "kv_bytes_per_token": None,
-                "quantized_decode_tokens_per_s": None,
-                "quantized_probe_error": f"{type(e).__name__}: {e}",
-            })
-        return out
-    except Exception as e:  # the probe must never sink the bench artifact
-        return {"serving_tokens_per_s": 0.0,
-                "kv_page_utilization": 0.0,
-                "decode_compiles": -1,
-                "prefix_cache_hit_rate": None,
-                "shared_page_fraction": None,
-                "quantized_mode": None, "weight_bytes": None,
-                "kv_bytes_per_token": None,
-                "quantized_decode_tokens_per_s": None,
-                "burst_tokens": None, "host_dispatches_per_token": None,
-                "megakernel_mode": None, "burst_tokens_per_s": None,
-                "serving_probe_error": f"{type(e).__name__}: {e}"}
-
-
-def _probe_input_pipeline(paddle, steps=16, log_freq=8):
-    """Measured async-input-pipeline fields for the bench trajectory.
-
-    One jitted Model.fit epoch over a device-prefetching DataLoader on a
-    micro regression net, read back through the pipeline metrics
-    (io/prefetch.py) and the host-sync counter (core/async_scalar.py):
-    - ``input_stall_ms``: total time the consumer blocked waiting for a
-      staged batch (a healthy pipeline stays near 0 — staging outruns
-      compute);
-    - ``h2d_bytes_per_s``: staged bytes over the probe's wall clock;
-    - ``steps_in_flight``: peak dispatched-but-unfetched window — >1
-      proves the deferred-sync path is live;
-    - ``host_syncs_per_epoch``: blocking fetch rounds the epoch paid —
-      bounded by steps/min(log_freq, K) + 2 where K is
-      FLAGS_async_inflight_steps (tests/test_async_pipeline.py gate), so
-      a trajectory jump here flags a reintroduced per-step sync.
-    Micro-sized like the serving probe: it measures the pipeline layer,
-    not model FLOPs, and must not eat the bench child's timeout budget.
-    """
-    import numpy as _np
-    try:
-        from paddle_tpu.core import async_scalar as _async
-        from paddle_tpu.io import DataLoader as _DL
-        from paddle_tpu.io.prefetch import PIPELINE_METRICS as _pm
-
-        class _DS(paddle.io.Dataset):
-            def __init__(self, n):
-                rng = _np.random.default_rng(0)
-                self.x = rng.standard_normal((n, 64)).astype(_np.float32)
-                self.y = rng.standard_normal((n, 1)).astype(_np.float32)
-
-            def __getitem__(self, i):
-                return self.x[i], self.y[i]
-
-            def __len__(self):
-                return len(self.x)
-
-        batch = 8
-        net = paddle.nn.Sequential(
-            paddle.nn.Linear(64, 64), paddle.nn.ReLU(),
-            paddle.nn.Linear(64, 1))
-        model = paddle.Model(net)
-        model.prepare(
-            paddle.optimizer.AdamW(learning_rate=1e-3,
-                                   parameters=net.parameters()),
-            paddle.nn.MSELoss(), use_jit=True)
-        loader = _DL(_DS(steps * batch), batch_size=batch,
-                     use_buffer_reader=True)
-        model.fit(loader, epochs=1, log_freq=log_freq, verbose=0)  # warmup
-        _pm.reset()
-        s0 = _async.host_sync_count()
-        model.fit(loader, epochs=1, log_freq=log_freq, verbose=0)
-        snap = _pm.snapshot()
-        return {
-            "input_stall_ms": round(snap["input_stall_ms"], 2),
-            "h2d_bytes_per_s": round(snap["h2d_bytes_per_s"], 1),
-            "steps_in_flight": snap["max_steps_in_flight"],
-            "host_syncs_per_epoch": _async.host_sync_count() - s0,
-        }
-    except Exception as e:  # the probe must never sink the bench artifact
-        return {"input_stall_ms": -1.0, "h2d_bytes_per_s": 0.0,
-                "steps_in_flight": 0, "host_syncs_per_epoch": -1,
-                "input_pipeline_probe_error": f"{type(e).__name__}: {e}"}
-
-
 def _child_main():
     progress = _Progress()
     progress.mark("child_start", argv=sys.argv[1:])
@@ -768,6 +519,12 @@ def _failure_artifact(last_err, last_stages):
         "decode_compiles": None,
         "prefix_cache_hit_rate": None,
         "shared_page_fraction": None,
+        # serving-latency percentiles (engine histograms) are per-run
+        # measurements: a stale artifact must never carry a TTFT/TPOT
+        # the failed run did not observe
+        "serving_ttft_p50_ms": None,
+        "serving_ttft_p99_ms": None,
+        "serving_tpot_p50_ms": None,
         # burst/megakernel fields are per-run too: a stale artifact must
         # never claim a dispatch ratio or kernel mode the failed run
         # did not measure

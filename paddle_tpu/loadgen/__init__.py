@@ -1,0 +1,45 @@
+"""paddle_tpu.loadgen — serving load harness on a virtual clock.
+
+The measurement substrate for the serving stack (ROADMAP item 5): seeded
+workload specs compile to timed request traces, a driver replays them
+against :class:`~paddle_tpu.serving.LLMEngine` on a virtual clock, and a
+reducer turns the outcomes into a stable JSON SLO artifact. Everything
+is deterministic — same spec seed, same engine seed, same report bytes —
+so latency/goodput behavior is regression-testable on the CPU tier
+(docs/BENCH.md has the schema and how to read the numbers).
+
+- :mod:`workload` — ``WorkloadSpec`` (Poisson/deterministic arrivals,
+  prompt/output length mixes, shared-prefix cohorts, per-request SLOs)
+  -> ``compile()`` -> ``[TraceRequest]`` + ``trace_fingerprint``.
+- :mod:`driver` — ``VirtualClock`` + ``Driver``: injects arrivals,
+  steps the engine, stamps per-token virtual timestamps, audits pool
+  invariants, returns a ``RunResult`` of ``RequestRecord``\\ s.
+- :mod:`report` — ``build_report``/``report_json``: p50/p90/p99 TTFT,
+  e2e, TPOT; goodput; shed/preempt/reject counts; KV watermark
+  pressure; prefix-cache effectiveness.
+
+Typical use::
+
+    from paddle_tpu.loadgen import (WorkloadSpec, VirtualClock, Driver,
+                                    build_report, report_json)
+    spec = WorkloadSpec(num_requests=200, arrival="poisson",
+                        arrival_rate=40.0, shared_prefix_fraction=0.5,
+                        shared_prefix_len=16, deadline_s=0.5,
+                        slo_e2e_s=2.0, seed=7)
+    clock = VirtualClock()
+    engine = LLMEngine(model, now_fn=clock.now, ...)
+    result = Driver(engine, clock, step_time_s=0.01).run(spec.compile())
+    print(report_json(build_report(result, spec=spec,
+                                   trace=spec.compile())))
+"""
+from .workload import (ARRIVALS, TraceRequest, WorkloadSpec,  # noqa: F401
+                       trace_fingerprint)
+from .driver import (Driver, RequestRecord, RunResult,  # noqa: F401
+                     VirtualClock, run_workload)
+from .report import (SCHEMA_VERSION, build_report,  # noqa: F401
+                     report_json)
+
+__all__ = ["ARRIVALS", "Driver", "RequestRecord", "RunResult",
+           "SCHEMA_VERSION", "TraceRequest", "VirtualClock",
+           "WorkloadSpec", "build_report", "report_json", "run_workload",
+           "trace_fingerprint"]

@@ -1,0 +1,283 @@
+"""Virtual-clock load driver over the serving engine.
+
+The serving stack takes ``now_fn`` everywhere (serving/scheduler.py,
+serving/engine.py, serving/metrics.py), so "time" during a load run is a
+:class:`VirtualClock` the driver alone advances: arrivals, deadline
+shedding, preemption, queue-age gauges and every recorded latency are
+deterministic functions of the trace and the engine's seed — the same
+run reproduces bit for bit, with no wall-clock noise and no sleeping.
+
+Time model: one engine ``step()`` costs ``step_time_s`` virtual seconds
+(a fixed service-time abstraction — the CPU tier measures scheduling
+behavior and dispatch counts, not kernel wall-clock; docs/BENCH.md).
+Requests are injected when the clock reaches their trace arrival time; a
+request arriving mid-step waits for the step boundary, exactly like a
+real serving loop polling its intake queue once per iteration. Tokens
+committed by a step are stamped at the step's END. Under burst mode
+(``burst_tokens > 1``) a whole burst lands at one boundary and its
+tokens share a timestamp — admission/shed latency quantizes to burst
+length by design, and the determinism gate covers that regime too.
+
+The driver is also the watermark auditor: with ``check_invariants`` on
+(the default) it runs ``pool.check_invariants()`` every
+``check_every`` steps and asserts the pool never over-allocates —
+the overload scenario's "watermark gates holding" criterion is checked
+during the run, not inferred afterwards.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..serving.engine import RequestRejected
+
+
+class VirtualClock:
+    """Monotonic virtual time; pass ``clock.now`` as the engine's
+    ``now_fn``. Only the driver advances it."""
+
+    def __init__(self, t0=0.0):
+        self._t = float(t0)
+
+    def now(self) -> float:
+        return self._t
+
+    def advance(self, dt: float):
+        if dt < 0:
+            raise ValueError(f"clock cannot go backwards (dt={dt})")
+        self._t += dt
+
+    def advance_to(self, t: float):
+        if t > self._t:
+            self._t = t
+
+
+@dataclass
+class RequestRecord:
+    """Per-request observed outcome of one load run."""
+    request_id: str
+    arrival_s: float
+    prompt_len: int
+    max_new_tokens: int
+    deadline_s: float | None
+    slo_e2e_s: float | None
+    prefix_cohort: int = -1
+    #: when the driver actually handed the request to the engine (the
+    #: step boundary at/after arrival_s — a real intake queue's poll)
+    submitted_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    #: virtual timestamp of every streamed token, in commit order
+    token_times: list = field(default_factory=list)
+    num_tokens: int = 0
+    status: str = "pending"
+    finish_reason: str | None = None
+    num_preemptions: int = 0
+
+    # latencies anchor on the TRACE arrival time, not submitted_at: the
+    # client started waiting when the request arrived, and the
+    # sub-step-boundary injection delay is part of what it perceived
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.arrival_s
+
+    @property
+    def e2e_s(self) -> float | None:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.arrival_s
+
+    @property
+    def tpot_s(self) -> float | None:
+        """Mean inter-token time after the first token."""
+        if self.num_tokens < 2 or self.first_token_at is None \
+                or self.finished_at is None:
+            return None
+        return (self.finished_at - self.first_token_at) \
+            / (self.num_tokens - 1)
+
+    @property
+    def in_slo(self) -> bool:
+        """Goodput test: finished AND (no e2e SLO or beat it)."""
+        if self.status != "finished":
+            return False
+        return self.slo_e2e_s is None or \
+            (self.e2e_s is not None and self.e2e_s <= self.slo_e2e_s)
+
+
+@dataclass
+class RunResult:
+    """Everything one load run observed, ready for loadgen/report.py."""
+    records: list                      # [RequestRecord] in trace order
+    duration_s: float = 0.0
+    steps: int = 0
+    step_time_s: float = 0.0
+    peak_page_utilization: float = 0.0
+    peak_used_pages: int = 0
+    page_capacity: int = 0
+    peak_queue_depth: int = 0
+    peak_running: int = 0
+    metrics: dict = field(default_factory=dict)   # engine snapshot at end
+    #: pool audits that RAN and passed during the run (a failing audit
+    #: raises out of run() — a RunResult you hold passed every one; 0
+    #: means auditing was disabled, i.e. nothing was proven)
+    invariant_checks: int = 0
+
+    def by_status(self) -> dict:
+        out: dict[str, int] = {}
+        for r in self.records:
+            out[r.status] = out.get(r.status, 0) + 1
+        return out
+
+
+class Driver:
+    """Replays a compiled trace (loadgen/workload.py) against an engine
+    whose ``now_fn`` is this driver's clock.
+
+    ``engine`` must have been constructed with ``now_fn=clock.now`` —
+    the driver refuses mismatched clocks it can detect (an engine on
+    wall-clock time would shed against a clock the driver never
+    advances, silently voiding every deadline in the trace).
+    """
+
+    def __init__(self, engine, clock: VirtualClock, *, step_time_s=0.01,
+                 max_steps=200_000, check_invariants=True, check_every=1):
+        if step_time_s <= 0:
+            raise ValueError("step_time_s must be > 0")
+        # bound-method equality (== not `is`: attribute access creates a
+        # fresh method object every time)
+        if engine._now != clock.now:
+            raise ValueError(
+                "engine.now_fn is not this driver's clock — construct the "
+                "engine with now_fn=clock.now so deadlines and latencies "
+                "share one time base")
+        self.engine = engine
+        self.clock = clock
+        self.step_time_s = float(step_time_s)
+        self.max_steps = max_steps
+        self.check_invariants = check_invariants
+        self.check_every = max(int(check_every), 1)
+
+    def run(self, trace) -> RunResult:
+        eng = self.engine
+        clock = self.clock
+        ids = [r.request_id for r in trace]
+        if len(set(ids)) != len(ids):
+            dups = sorted({i for i in ids if ids.count(i) > 1})
+            raise ValueError(
+                f"trace has duplicate request_ids {dups[:5]} — "
+                f"concatenated specs must use distinct seeds (ids embed "
+                f"the seed) or distinct explicit ids")
+        records = {r.request_id: RequestRecord(
+            request_id=r.request_id, arrival_s=r.arrival_s,
+            prompt_len=len(r.prompt_token_ids),
+            max_new_tokens=r.max_new_tokens, deadline_s=r.deadline_s,
+            slo_e2e_s=r.slo_e2e_s, prefix_cohort=r.prefix_cohort)
+            for r in trace}
+        result = RunResult(records=[records[r.request_id] for r in trace],
+                           step_time_s=self.step_time_s,
+                           page_capacity=eng.pool.capacity)
+        pending = deque(sorted(trace, key=lambda r: (r.arrival_s,
+                                                     r.request_id)))
+        t_start = clock.now()
+        steps = 0
+        while pending or eng.has_unfinished():
+            if not eng.has_unfinished() and pending \
+                    and pending[0].arrival_s > clock.now():
+                # idle engine: jump straight to the next arrival
+                clock.advance_to(pending[0].arrival_s)
+            while pending and pending[0].arrival_s <= clock.now():
+                req = pending.popleft()
+                rec = records[req.request_id]
+                rec.submitted_at = clock.now()
+                try:
+                    eng.add_request(
+                        list(req.prompt_token_ids),
+                        max_new_tokens=req.max_new_tokens,
+                        temperature=req.temperature,
+                        eos_token_id=req.eos_token_id,
+                        deadline_s=req.deadline_s,
+                        request_id=req.request_id)
+                    rec.status = "waiting"
+                except RequestRejected:
+                    # the engine recorded a finalized aborted output;
+                    # sweep it into the record like any other terminal
+                    self._absorb(rec, eng.outputs()[req.request_id],
+                                 clock.now())
+            if not eng.has_unfinished():
+                continue
+            # the clock advances BEFORE the launch: the step's work (and
+            # its shed decisions, token commits, and the engine's own
+            # TTFT/TPOT histograms) all land at the step's END time —
+            # one time base shared by driver records and engine metrics
+            clock.advance(self.step_time_s)
+            touched = eng.step()
+            steps += 1
+            now = clock.now()
+            for out in touched:
+                rec = records.get(out.request_id)
+                if rec is not None:
+                    self._absorb(rec, out, now)
+            pool = eng.pool
+            result.peak_page_utilization = max(
+                result.peak_page_utilization, pool.utilization)
+            result.peak_used_pages = max(result.peak_used_pages,
+                                         pool.used_pages)
+            result.peak_queue_depth = max(
+                result.peak_queue_depth, eng.scheduler.queue_depth())
+            result.peak_running = max(result.peak_running,
+                                      len(eng.scheduler.running))
+            if self.check_invariants and steps % self.check_every == 0:
+                # a failure RAISES — there is no "run completed but the
+                # pool over-allocated" outcome, only proof-by-survival,
+                # which is why the report keys off the audit COUNT
+                pool.check_invariants()
+                assert pool.used_pages <= pool.capacity
+                assert pool.used_pages + pool.free_pages == pool.capacity
+                result.invariant_checks += 1
+            if steps >= self.max_steps:
+                raise RuntimeError(
+                    f"load run did not drain within {self.max_steps} "
+                    f"steps ({len(pending)} pending, "
+                    f"{len(eng.scheduler.running)} running, "
+                    f"{eng.scheduler.queue_depth()} waiting)")
+        # final sweep: terminal statuses the last step may not have
+        # surfaced through its touched set (e.g. shed before any step)
+        outs = eng.outputs()
+        for rid, rec in records.items():
+            out = outs.get(rid)
+            if out is not None and out.finished \
+                    and rec.finished_at is None:
+                self._absorb(rec, out, clock.now())
+        result.steps = steps
+        result.duration_s = clock.now() - t_start
+        result.metrics = eng.metrics_snapshot()
+        return result
+
+    @staticmethod
+    def _absorb(rec: RequestRecord, out, now: float):
+        """Fold one touched RequestOutput into the record at time now."""
+        new = len(out.token_ids) - rec.num_tokens
+        if new > 0:
+            if rec.first_token_at is None:
+                rec.first_token_at = now
+            rec.token_times.extend([now] * new)
+            rec.num_tokens = len(out.token_ids)
+        rec.status = out.status
+        rec.num_preemptions = out.num_preemptions
+        if out.finished and rec.finished_at is None:
+            rec.finished_at = now
+            rec.finish_reason = out.finish_reason
+
+
+def run_workload(engine, clock, spec_or_trace, **driver_kw) -> RunResult:
+    """One-call convenience: compile (if given a spec) and drive."""
+    trace = spec_or_trace.compile() if hasattr(spec_or_trace, "compile") \
+        else spec_or_trace
+    return Driver(engine, clock, **driver_kw).run(trace)
+
+
+__all__ = ["Driver", "RequestRecord", "RunResult", "VirtualClock",
+           "run_workload"]

@@ -1,0 +1,147 @@
+"""Reduce a load run to a stable JSON SLO/pressure artifact.
+
+``build_report`` folds a :class:`~paddle_tpu.loadgen.driver.RunResult`
+(plus, optionally, the spec and trace that produced it) into one plain
+dict: latency percentiles (p50/p90/p99 TTFT, e2e, TPOT), goodput
+(finished within the e2e SLO), shed/preempt/reject outcome counts,
+KV-page/watermark pressure peaks, and prefix-cache effectiveness.
+Percentiles here are EXACT (computed over every request record, not the
+metrics reservoir) — the in-engine histograms exist so a live server has
+percentiles too; the harness has the full population and uses it.
+
+``report_json`` is the artifact writer: floats rounded to a fixed
+precision and keys sorted, so the same run serializes to the same bytes
+— the determinism gate (tests/test_loadgen.py) compares artifacts, not
+hand-picked fields. Everything in the report derives from the virtual
+clock and counters; nothing reads wall-clock time.
+"""
+from __future__ import annotations
+
+import json
+
+from ..serving.metrics import percentile_of
+from .workload import trace_fingerprint
+
+SCHEMA_VERSION = 1
+
+#: float precision of the JSON artifact: high enough that distinct
+#: virtual-clock values never collide, fixed so byte-identity holds
+_ROUND = 9
+
+
+def _dist(values) -> dict:
+    """{count, mean, p50, p90, p99, min, max} over a value list (exact;
+    Nones when the population is empty)."""
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return {"count": 0, "mean": None, "p50": None, "p90": None,
+                "p99": None, "min": None, "max": None}
+    return {"count": len(vals), "mean": sum(vals) / len(vals),
+            "p50": percentile_of(vals, 50), "p90": percentile_of(vals, 90),
+            "p99": percentile_of(vals, 99), "min": min(vals),
+            "max": max(vals)}
+
+
+def build_report(result, *, spec=None, trace=None) -> dict:
+    """RunResult (+ spec/trace context) -> the artifact dict."""
+    recs = result.records
+    statuses = result.by_status()
+    finished = [r for r in recs if r.status == "finished"]
+    total = len(recs)
+    good = sum(1 for r in recs if r.in_slo)
+    tokens = sum(r.num_tokens for r in recs)
+    m = result.metrics or {}
+    hits = m.get("prefix_cache_hits", 0)
+    misses = m.get("prefix_cache_misses", 0)
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "workload": {
+            "spec": spec.describe() if spec is not None else None,
+            "trace_fingerprint": trace_fingerprint(trace)
+            if trace is not None else None,
+            "num_requests": total,
+        },
+        "requests": {
+            "total": total,
+            "finished": statuses.get("finished", 0),
+            "shed": statuses.get("shed", 0),
+            "aborted": statuses.get("aborted", 0),
+            "cancelled": statuses.get("cancelled", 0),
+            "unresolved": sum(statuses.get(s, 0)
+                              for s in ("pending", "waiting", "running",
+                                        "preempted")),
+            "preempted_requests": sum(1 for r in recs
+                                      if r.num_preemptions > 0),
+            "preemptions": m.get("preemptions", 0),
+        },
+        "latency": {
+            "ttft_s": _dist([r.ttft_s for r in finished]),
+            "e2e_s": _dist([r.e2e_s for r in finished]),
+            "tpot_s": _dist([r.tpot_s for r in finished]),
+        },
+        "goodput": {
+            "completed_in_slo": good,
+            "offered": total,
+            "goodput_fraction": good / total if total else None,
+        },
+        "throughput": {
+            "tokens_generated": tokens,
+            "duration_s": result.duration_s,
+            "tokens_per_s": tokens / result.duration_s
+            if result.duration_s > 0 else None,
+            "steps": result.steps,
+            "step_time_s": result.step_time_s,
+            "host_dispatches": m.get("host_dispatches", 0),
+            "host_dispatches_per_token": m.get("host_dispatches", 0)
+            / tokens if tokens else None,
+            "burst_tokens": m.get("burst_tokens"),
+        },
+        "kv_pressure": {
+            "peak_page_utilization": result.peak_page_utilization,
+            "peak_used_pages": result.peak_used_pages,
+            "page_capacity": result.page_capacity,
+            # False = the in-run audits RAN (invariant_checks of them)
+            # and all passed; None = auditing was disabled, nothing
+            # proven. True is unreachable: a failing audit raises out
+            # of the run instead of producing a report.
+            "over_allocated": False if result.invariant_checks > 0
+            else None,
+            "invariant_checks": result.invariant_checks,
+            "preemptions": m.get("preemptions", 0),
+            "decode_compiles": m.get("decode_compiles", 0),
+        },
+        "queue": {
+            "peak_queue_depth": result.peak_queue_depth,
+            "peak_running": result.peak_running,
+            "queue_age_p99_s": m.get("queue_age_p99_s"),
+            "max_queue_wait_s": m.get("max_queue_wait_s"),
+        },
+        "prefix_cache": {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": hits / (hits + misses) if hits + misses else None,
+            "shared_page_fraction": m.get("shared_page_fraction"),
+            "cow_copies": m.get("cow_copies", 0),
+            "pinned_prefix_hits": m.get("pinned_prefix_hits", 0),
+        },
+    }
+    return report
+
+
+def _round_floats(obj):
+    if isinstance(obj, float):
+        return round(obj, _ROUND)
+    if isinstance(obj, dict):
+        return {k: _round_floats(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_round_floats(v) for v in obj]
+    return obj
+
+
+def report_json(report) -> str:
+    """Stable serialization: sorted keys, fixed float precision — the
+    byte-identity the determinism gate compares."""
+    return json.dumps(_round_floats(report), sort_keys=True, indent=1)
+
+
+__all__ = ["SCHEMA_VERSION", "build_report", "report_json"]

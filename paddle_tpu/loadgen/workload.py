@@ -1,0 +1,170 @@
+"""Workload specification -> reproducible timed request traces.
+
+A :class:`WorkloadSpec` describes production-shaped serving traffic as a
+small set of distribution knobs — arrival process (Poisson or
+deterministic), prompt/output length ranges, a shared-prefix cohort mix
+(the Ragged Paged Attention traffic the prefix cache exists for), and
+per-request SLOs — and ``compile()``\\ s it into a concrete list of
+:class:`TraceRequest`\\ s with explicit virtual arrival times and token
+ids.
+
+Everything is derived from ONE ``numpy`` Generator seeded by
+``spec.seed``: the same spec compiles to the same trace, byte for byte,
+on every run (``trace_fingerprint`` is the gate's witness —
+tests/test_loadgen.py). The trace is data, not behavior: the driver
+(loadgen/driver.py) replays it against an ``LLMEngine`` on a virtual
+clock, so the whole pipeline spec -> trace -> outcomes -> report is
+deterministic and wall-clock-free.
+
+Two SLOs per request, deliberately distinct:
+- ``deadline_s`` is the QUEUE-WAIT shed SLO handed to the engine: a
+  request still waiting this long after submission is load-shed
+  (serving/scheduler.py ``shed_expired``);
+- ``slo_e2e_s`` is the REPORT-side goodput bar: a finished request only
+  counts as goodput if its end-to-end latency beat it. The engine never
+  sees it — late completions still finish, they just don't score.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, asdict
+
+import numpy as np
+
+ARRIVALS = ("poisson", "deterministic")
+
+
+@dataclass(frozen=True)
+class TraceRequest:
+    """One concrete request of a compiled trace."""
+    request_id: str
+    arrival_s: float
+    prompt_token_ids: tuple
+    max_new_tokens: int
+    deadline_s: float | None = None
+    slo_e2e_s: float | None = None
+    temperature: float = 0.0
+    eos_token_id: int | None = None
+    #: cohort index when the prompt starts with a shared prefix, else -1
+    prefix_cohort: int = -1
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """Seeded description of a serving workload (docs/BENCH.md schema).
+
+    ``prompt_len`` / ``output_len`` are inclusive uniform integer ranges.
+    A ``shared_prefix_fraction`` > 0 routes that fraction of requests
+    through one of ``num_shared_prefixes`` fixed token prefixes of length
+    ``shared_prefix_len`` (prompt = cohort prefix + random tail), so the
+    engine's prefix cache and CoW page sharing see realistic repeated
+    system prompts instead of uniformly random tokens.
+    """
+    num_requests: int = 64
+    seed: int = 0
+    arrival: str = "poisson"        # ARRIVALS
+    arrival_rate: float = 50.0      # requests per virtual second
+    prompt_len: tuple = (4, 24)
+    output_len: tuple = (2, 12)
+    shared_prefix_fraction: float = 0.0
+    shared_prefix_len: int = 0
+    num_shared_prefixes: int = 1
+    deadline_s: float | None = None
+    slo_e2e_s: float | None = None
+    temperature: float = 0.0
+    eos_token_id: int | None = None
+    vocab_size: int = 128
+
+    def __post_init__(self):
+        if self.num_requests < 1:
+            raise ValueError("num_requests must be >= 1")
+        if self.arrival not in ARRIVALS:
+            raise ValueError(f"arrival must be one of {ARRIVALS}, "
+                             f"got {self.arrival!r}")
+        if self.arrival_rate <= 0:
+            raise ValueError("arrival_rate must be > 0")
+        for name in ("prompt_len", "output_len"):
+            lo, hi = getattr(self, name)
+            if not (1 <= lo <= hi):
+                raise ValueError(f"{name} must be an inclusive range "
+                                 f"1 <= lo <= hi, got {(lo, hi)}")
+        if not 0.0 <= self.shared_prefix_fraction <= 1.0:
+            raise ValueError("shared_prefix_fraction must be in [0, 1]")
+        if self.shared_prefix_fraction > 0:
+            if self.shared_prefix_len < 1:
+                raise ValueError("shared_prefix_len must be >= 1 when a "
+                                 "shared-prefix cohort is requested")
+            if self.shared_prefix_len >= self.prompt_len[1]:
+                # cohort prompts are prefix + >=1 fresh tail token; a
+                # prefix at/above the declared max would silently emit
+                # prompts past prompt_len[1] (and past any engine sized
+                # for it — mass rejected_oversize with nothing pointing
+                # at the spec)
+                raise ValueError(
+                    f"shared_prefix_len {self.shared_prefix_len} must be "
+                    f"< prompt_len hi {self.prompt_len[1]} (cohort "
+                    f"prompts = prefix + at least one fresh token)")
+            if self.num_shared_prefixes < 1:
+                raise ValueError("num_shared_prefixes must be >= 1")
+        if self.vocab_size < 2:
+            raise ValueError("vocab_size must be >= 2")
+
+    def describe(self) -> dict:
+        """Plain-dict view of the spec for the report artifact."""
+        return asdict(self)
+
+    def compile(self) -> list:
+        """Materialize the trace: one rng stream, stable ids, sorted
+        non-decreasing arrival times."""
+        rng = np.random.default_rng(self.seed)
+        prefixes = []
+        if self.shared_prefix_fraction > 0:
+            prefixes = [tuple(int(t) for t in rng.integers(
+                0, self.vocab_size, (self.shared_prefix_len,)))
+                for _ in range(self.num_shared_prefixes)]
+        plo, phi = self.prompt_len
+        olo, ohi = self.output_len
+        t = 0.0
+        trace = []
+        for i in range(self.num_requests):
+            if self.arrival == "poisson":
+                t += float(rng.exponential(1.0 / self.arrival_rate))
+            else:
+                t = i / self.arrival_rate
+            plen = int(rng.integers(plo, phi + 1))
+            olen = int(rng.integers(olo, ohi + 1))
+            cohort = -1
+            if prefixes and float(rng.random()) \
+                    < self.shared_prefix_fraction:
+                cohort = int(rng.integers(0, self.num_shared_prefixes))
+                # at least one fresh tail token: the last prompt token is
+                # never shareable anyway (its logits seed generation)
+                tail = max(plen - self.shared_prefix_len, 1)
+                prompt = prefixes[cohort] + tuple(int(x) for x in
+                                                  rng.integers(
+                    0, self.vocab_size, (tail,)))
+            else:
+                prompt = tuple(int(x) for x in rng.integers(
+                    0, self.vocab_size, (plen,)))
+            trace.append(TraceRequest(
+                request_id=f"lg-{self.seed}-{i}", arrival_s=t,
+                prompt_token_ids=prompt, max_new_tokens=olen,
+                deadline_s=self.deadline_s, slo_e2e_s=self.slo_e2e_s,
+                temperature=self.temperature,
+                eos_token_id=self.eos_token_id, prefix_cohort=cohort))
+        return trace
+
+
+def trace_fingerprint(trace) -> str:
+    """Stable sha256 over the trace's full content — the determinism
+    gate's witness: same spec => same fingerprint, across processes."""
+    blob = json.dumps(
+        [[r.request_id, repr(r.arrival_s), list(r.prompt_token_ids),
+          r.max_new_tokens, r.deadline_s, r.slo_e2e_s, r.temperature,
+          r.eos_token_id, r.prefix_cohort] for r in trace],
+        sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+__all__ = ["ARRIVALS", "TraceRequest", "WorkloadSpec", "trace_fingerprint"]

@@ -22,9 +22,11 @@ from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
                         Sequence, SequenceStatus, StepPlan, bucket_for)
 from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
                      RequestRejected)
-from .metrics import ServingMetrics  # noqa: F401
+from .metrics import (Histogram, ServingMetrics,  # noqa: F401
+                      percentile_of)
 
-__all__ = ["BurstPlan", "LLMEngine", "Request", "RequestOutput",
-           "RequestRejected", "PagedKVPool", "PoolExhausted", "NULL_PAGE",
-           "Scheduler", "SchedulerConfig", "Sequence", "SequenceStatus",
-           "StepPlan", "ServingMetrics", "bucket_for"]
+__all__ = ["BurstPlan", "Histogram", "LLMEngine", "Request",
+           "RequestOutput", "RequestRejected", "PagedKVPool",
+           "PoolExhausted", "NULL_PAGE", "Scheduler", "SchedulerConfig",
+           "Sequence", "SequenceStatus", "StepPlan", "ServingMetrics",
+           "bucket_for", "percentile_of"]

@@ -910,6 +910,11 @@ class LLMEngine:
 
     def _commit_token(self, seq: Sequence, tok: int):
         seq.tokens.append(int(tok))
+        if seq.first_token_at is None:
+            # TTFT numerator. Burst mode commits a whole burst at one
+            # host boundary, so a burst's tokens share this timestamp —
+            # latency quantizes to burst length by design (docs/BENCH.md)
+            seq.first_token_at = self._now()
         self.metrics.tokens_generated.inc()
         out = self._sync_output(seq)
         if seq.eos_token_id is not None and tok == seq.eos_token_id:
@@ -931,6 +936,9 @@ class LLMEngine:
         out.finish_reason = reason or status
         if status == "finished":
             self.metrics.finished_requests.inc()
+            self.metrics.record_request_end(
+                arrival=seq.arrival, first_token_at=seq.first_token_at,
+                finished_at=self._now(), n_tokens=len(seq.tokens))
         if self._stream_cb is not None:
             last = seq.tokens[-1] if seq.tokens else None
             self._stream_cb(seq.seq_id, last, True)

@@ -1,4 +1,5 @@
-"""Serving metrics: counters/gauges for the engine, scheduler, and pool.
+"""Serving metrics: counters/gauges/histograms for the engine, scheduler,
+and pool.
 
 Two consumers:
 - ``snapshot()`` — a plain dict for bench.py (``serving_tokens_per_s``,
@@ -8,13 +9,41 @@ Two consumers:
   through the same native recorder paddle_tpu.profiler drains, so serving
   gauges land on the chrome-trace/protobuf timeline next to op spans when
   a Profiler is recording.
+
+Latency observability (the loadgen substrate, docs/BENCH.md): every
+FINISHED request records its TTFT (arrival -> first generated token),
+TPOT (mean inter-token time after the first) and e2e latency into
+bounded-reservoir :class:`Histogram`\\ s, so p50/p90/p99 exist on any
+long-running engine without an external harness. Queue starvation is
+observable through the ``queue_age_p99_s`` / ``max_queue_wait_s`` gauges
+(per-request enqueue timestamps come from the scheduler's ``now_fn``, so
+they are virtual-clock-accurate under paddle_tpu.loadgen).
 """
 from __future__ import annotations
 
+import random
 import time
+import zlib
 from collections import deque
 
 from ..core import native as _nv
+
+
+def percentile_of(values, q):
+    """Deterministic linear-interpolation percentile of a value list
+    (numpy's default method, dependency-free). None on empty input."""
+    if not values:
+        return None
+    s = sorted(float(v) for v in values)
+    n = len(s)
+    if n == 1:
+        return s[0]
+    pos = (q / 100.0) * (n - 1)
+    lo = int(pos)
+    if lo >= n - 1:
+        return s[-1]
+    frac = pos - lo
+    return s[lo] + (s[lo + 1] - s[lo]) * frac
 
 
 class Counter:
@@ -39,6 +68,62 @@ class Gauge:
         self.value = v
 
 
+class Histogram:
+    """Bounded-reservoir histogram with percentile queries.
+
+    Memory is capped at ``max_samples`` observations (classic reservoir
+    sampling beyond that), so a long-running server's latency histograms
+    never grow with traffic; below the cap the percentiles are exact.
+    The reservoir's replacement stream is seeded from the histogram's
+    NAME (crc32 — stable across processes, unlike ``hash``), so two runs
+    observing identical value streams report bit-identical percentiles —
+    the loadgen determinism gate (tests/test_loadgen.py) depends on it.
+    """
+
+    __slots__ = ("name", "count", "total", "min", "max", "max_samples",
+                 "_samples", "_rng")
+
+    def __init__(self, name, max_samples=2048):
+        if max_samples < 1:
+            raise ValueError("max_samples must be >= 1")
+        self.name = name
+        self.max_samples = int(max_samples)
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples = []
+        self._rng = random.Random(zlib.crc32(str(name).encode("utf-8")))
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if len(self._samples) < self.max_samples:
+            self._samples.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.max_samples:
+                self._samples[j] = v
+
+    @property
+    def mean(self):
+        return self.total / self.count if self.count else None
+
+    def percentile(self, q):
+        """q in [0, 100]; None when nothing was observed."""
+        return percentile_of(self._samples, q)
+
+    def summary(self) -> dict:
+        """{count, mean, min, max, p50, p90, p99} — Nones when empty."""
+        return {"count": self.count, "mean": self.mean,
+                "min": self.min, "max": self.max,
+                "p50": self.percentile(50), "p90": self.percentile(90),
+                "p99": self.percentile(99)}
+
+
 class ServingMetrics:
     COUNTERS = ("requests_added", "rejected_requests", "tokens_generated",
                 "prefills", "prefill_chunks", "decode_steps", "preemptions",
@@ -52,7 +137,16 @@ class ServingMetrics:
                 "host_dispatches", "burst_launches", "pinned_prefix_hits")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
-              "shared_page_fraction", "pinned_pages")
+              "shared_page_fraction", "pinned_pages",
+              # starvation observability: age of the oldest / p99 waiting
+              # request (seconds since it was (re-)enqueued, scheduler
+              # now_fn time base) — a climbing max_queue_wait_s under
+              # steady load is head-of-line blocking made visible
+              "queue_age_p99_s", "max_queue_wait_s")
+    #: per-finished-request latency distributions (seconds): TTFT =
+    #: arrival -> first generated token, TPOT = mean inter-token after
+    #: the first, e2e = arrival -> finalization
+    HISTOGRAMS = ("ttft_s", "tpot_s", "e2e_s")
 
     #: tokens_per_s is the rate over this trailing window, not a lifetime
     #: average — a lifetime average decays toward zero across idle gaps
@@ -66,6 +160,21 @@ class ServingMetrics:
             setattr(self, c, Counter(c))
         for g in self.GAUGES:
             setattr(self, g, Gauge(g))
+        for h in self.HISTOGRAMS:
+            setattr(self, h, Histogram(h))
+
+    def record_request_end(self, *, arrival, first_token_at, finished_at,
+                           n_tokens):
+        """Observe one FINISHED request's latencies into the histograms.
+        Called by the engine at finalization; shed/cancelled/aborted
+        requests never get here (their "latency" is not a service time).
+        """
+        self.e2e_s.observe(finished_at - arrival)
+        if first_token_at is not None:
+            self.ttft_s.observe(first_token_at - arrival)
+            if n_tokens > 1:
+                self.tpot_s.observe(
+                    (finished_at - first_token_at) / (n_tokens - 1))
 
     def record_step(self, scheduler, pool):
         """Refresh gauges from live state; emit profiler instants."""
@@ -77,6 +186,10 @@ class ServingMetrics:
             getattr(pool, "shared_page_fraction", 0.0))
         self.pinned_pages.set(getattr(pool, "pinned_pages", 0))
         now = self._now()
+        ages = scheduler.queue_ages(now) \
+            if hasattr(scheduler, "queue_ages") else []
+        self.max_queue_wait_s.set(max(ages) if ages else 0.0)
+        self.queue_age_p99_s.set(percentile_of(ages, 99) or 0.0)
         self._rate_samples.append((now, self.tokens_generated.value))
         while len(self._rate_samples) > 2 and \
                 now - self._rate_samples[0][0] > self.RATE_WINDOW_S:
@@ -92,8 +205,14 @@ class ServingMetrics:
     def snapshot(self) -> dict:
         out = {c: getattr(self, c).value for c in self.COUNTERS}
         out.update({g: getattr(self, g).value for g in self.GAUGES})
+        for h in self.HISTOGRAMS:
+            hist = getattr(self, h)
+            out[f"{h}_count"] = hist.count
+            for q in (50, 90, 99):
+                out[f"{h}_p{q}"] = hist.percentile(q)
         out["uptime_s"] = self._now() - self._t0
         return out
 
 
-__all__ = ["Counter", "Gauge", "ServingMetrics"]
+__all__ = ["Counter", "Gauge", "Histogram", "ServingMetrics",
+           "percentile_of"]

@@ -92,6 +92,14 @@ class Sequence:
     #: tokens whose K/V is committed to the pool (prefix-cache fork sets
     #: it to the shared length at admission; preemption resets it to 0)
     cached_len: int = 0
+    #: when the sequence last entered the waiting queue (scheduler
+    #: now_fn time base): set at add(), refreshed at preempt() — queue
+    #: age = now - enqueued_at feeds the starvation gauges
+    enqueued_at: float | None = None
+    #: when the FIRST generated token was committed (engine now_fn time
+    #: base) — the TTFT numerator; never reset by preemption (the
+    #: client saw the token when it streamed, recompute is invisible)
+    first_token_at: float | None = None
 
     @property
     def total_len(self) -> int:
@@ -208,6 +216,21 @@ class Scheduler:
     def queue_depth(self) -> int:
         return len(self.waiting)
 
+    def queue_ages(self, now=None) -> list[float]:
+        """Seconds each waiting request has sat in the queue since it was
+        last (re-)enqueued — the starvation signal behind the
+        ``queue_age_p99_s`` / ``max_queue_wait_s`` gauges. Preemption
+        refreshes a sequence's enqueue timestamp: its age measures THIS
+        wait, not lifetime."""
+        now = self.config.now_fn() if now is None else now
+        return [now - (s.enqueued_at if s.enqueued_at is not None
+                       else s.arrival)
+                for s in self.waiting]
+
+    def max_queue_wait(self, now=None) -> float:
+        ages = self.queue_ages(now)
+        return max(ages) if ages else 0.0
+
     # ---- admission ----
     def add(self, seq: Sequence):
         total_pages = self.pool.pages_for(
@@ -218,6 +241,7 @@ class Scheduler:
                 f"request {seq.seq_id}: prompt+max_new_tokens needs "
                 f"{total_pages} pages, engine limit is {limit}")
         seq.status = SequenceStatus.WAITING
+        seq.enqueued_at = self.config.now_fn()
         self.waiting.append(seq)
 
     def remove(self, seq_id: str):
@@ -321,6 +345,7 @@ class Scheduler:
         seq.cached_len = 0
         seq.status = SequenceStatus.WAITING
         seq.num_preemptions += 1
+        seq.enqueued_at = self.config.now_fn()
         self.waiting.appendleft(seq)
         self.last_preempted.append(seq)
         if self.metrics is not None:

@@ -54,7 +54,11 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
     stale_parsed = {"value": 70000.0, "vs_baseline": 0.8333, "mfu": 0.375,
                     "device": "TPU v5 lite", "step_ms": 110.0,
                     "compile_ms": 1234.5, "peak_hbm_bytes": 7 << 30,
-                    "remat_policy": "full", "accumulate_steps": 4}
+                    "remat_policy": "full", "accumulate_steps": 4,
+                    # a stale source CARRYING latency numbers must not
+                    # leak them into the fresh artifact
+                    "serving_ttft_p50_ms": 12.0,
+                    "serving_tpot_p50_ms": 3.5}
     monkeypatch.setattr(bench, "_last_good_round",
                         lambda: ("BENCH_r05.json", stale_parsed))
     out = bench._failure_artifact(
@@ -76,7 +80,13 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # burst/megakernel fields likewise (PR 7): a dispatch
               # ratio or kernel mode is a per-run measurement
               "burst_tokens", "host_dispatches_per_token",
-              "megakernel_mode", "burst_tokens_per_s"):
+              "megakernel_mode", "burst_tokens_per_s",
+              # serving-latency percentiles (PR 8, engine histograms):
+              # a stale artifact must never carry a TTFT/TPOT the
+              # failed run did not observe — and never copy one from
+              # tools/bench_lastgood.json
+              "serving_ttft_p50_ms", "serving_ttft_p99_ms",
+              "serving_tpot_p50_ms"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -171,6 +181,134 @@ def test_lastgood_history_preserved(tmp_path, monkeypatch):
     assert blob["parsed"]["mfu"] == 0.38     # latest 125m is the headline
 
 
+def _proxy_bench():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import tools.proxy_bench as pb
+    return pb
+
+
+def test_proxy_bench_gate_logic():
+    """Direction-aware gate: counts regress upward, rates regress
+    downward, a null measurement where the baseline has a number is a
+    failure (a probe that stopped measuring is coverage loss), and a
+    metric missing from a FULL run fails while partial --probes runs
+    skip it."""
+    pb = _proxy_bench()
+    base = {"metrics": {"decode_compiles": 1,
+                        "host_dispatches_per_token": 0.2,
+                        "prefix_cache_hit_rate": 0.8}}
+    ok = {"metrics": {"decode_compiles": 1,
+                      "host_dispatches_per_token": 0.21,
+                      "prefix_cache_hit_rate": 0.78}}
+    failures, report = pb.gate(ok, base)
+    assert failures == [], report
+
+    worse = {"metrics": {"decode_compiles": 2,
+                         "host_dispatches_per_token": 1.0,
+                         "prefix_cache_hit_rate": 0.3}}
+    failures, report = pb.gate(worse, base)
+    assert sorted(n for n, _ in failures) == [
+        "decode_compiles", "host_dispatches_per_token",
+        "prefix_cache_hit_rate"]
+    assert "REGRESSION" in report
+
+    broke = {"metrics": {"decode_compiles": 1,
+                         "host_dispatches_per_token": None,
+                         "prefix_cache_hit_rate": 0.8}}
+    failures, report = pb.gate(broke, base)
+    assert [n for n, _ in failures] == ["host_dispatches_per_token"]
+    assert "PROBE BROKE" in report
+
+    gone = {"metrics": {"decode_compiles": 1}}
+    failures, _ = pb.gate(gone, base)
+    assert sorted(n for n, _ in failures) == [
+        "host_dispatches_per_token", "prefix_cache_hit_rate"]
+    failures, _ = pb.gate(gone, base, require_all=False)
+    assert failures == []
+
+
+def test_proxy_bench_compare_exit_status(monkeypatch, capsys, tmp_path):
+    """The compare mode's CLI contract against the CHECKED-IN baseline:
+    parity exits 0, a regressed metric exits 1 (what CI keys off)."""
+    import copy
+    import json as _json
+    pb = _proxy_bench()
+    with open(pb.BASELINE_PATH) as f:
+        base = _json.load(f)["cpu"]
+
+    parity = copy.deepcopy(base)
+    monkeypatch.setattr(pb, "collect",
+                        lambda probes=pb.PROBES, burst_tokens=8: parity)
+    assert pb.main(["--compare", pb.BASELINE_PATH]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+
+    regressed = copy.deepcopy(base)
+    # the injected regression: burst mode degenerating to one host
+    # dispatch per token (exactly what forcing the per-token path does)
+    regressed["metrics"]["host_dispatches_per_token"] = 1.0
+    monkeypatch.setattr(pb, "collect",
+                        lambda probes=pb.PROBES, burst_tokens=8: regressed)
+    assert pb.main(["--compare", pb.BASELINE_PATH]) == 1
+    captured = capsys.readouterr()
+    assert "host_dispatches_per_token" in captured.err
+
+    # a missing baseline file / backend is operator error, rc 2
+    assert pb.main(["--compare", "/nonexistent/baseline.json"]) == 2
+
+    # --json changes the output format, never the gate: the regressed
+    # run still exits 1, stdout is PURE collection JSON (parseable),
+    # and the human gate report moves to stderr
+    assert pb.main(["--compare", pb.BASELINE_PATH, "--json"]) == 1
+    captured = capsys.readouterr()
+    parsed = _json.loads(captured.out)          # whole stream is JSON
+    assert parsed["metrics"]["host_dispatches_per_token"] == 1.0
+    assert "proxy bench gate" in captured.err
+
+    # --record over a partial probe set would shrink the checked-in
+    # baseline (silent coverage loss on every later compare): refused
+    assert pb.main(["--probes", "serving", "--record"]) == 2
+    assert "full probe set" in capsys.readouterr().err
+
+    # --record --compare would "verify" a baseline against itself: out
+    assert pb.main(["--record", "--compare", pb.BASELINE_PATH]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
+
+    # --record of a collection with a broken probe (null metric) would
+    # drop that metric from every later compare's coverage: refused
+    # (BASELINE_PATH redirected so a refusal bug cannot clobber the
+    # checked-in baseline)
+    monkeypatch.setattr(pb, "BASELINE_PATH", str(tmp_path / "b.json"))
+    broken = copy.deepcopy(base)
+    broken["metrics"]["host_dispatches_per_token"] = None
+    broken["probe_errors"] = {"serving_probe_error": "boom"}
+    monkeypatch.setattr(pb, "collect",
+                        lambda probes=pb.PROBES, burst_tokens=8: broken)
+    assert pb.main(["--record"]) == 2
+    assert "refusing to record" in capsys.readouterr().err
+
+
+def test_proxy_bench_catches_forced_per_token_dispatch():
+    """End-to-end regression injection (the acceptance bar): actually
+    run the serving probe with the burst loop FORCED to the per-token
+    dispatch path (burst_tokens=1) and gate it against the checked-in
+    baseline — host_dispatches_per_token must rise past the bound and
+    fail; the healthy collection of the same probe must pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("serving",), burst_tokens=1)
+    failures, report = pb.gate(bad, baseline, require_all=False)
+    assert "host_dispatches_per_token" in [n for n, _ in failures], report
+
+    good = pb.collect(probes=("serving",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+
+
 def test_serving_probe_records_ragged_and_prefix_fields():
     """The live serving probe must measure the ragged-engine fields:
     exactly one compiled step executable, a real prefix-cache hit rate
@@ -189,6 +327,11 @@ def test_serving_probe_records_ragged_and_prefix_fields():
     assert 0.0 < out["prefix_cache_hit_rate"] <= 1.0
     assert out["shared_page_fraction"] > 0.0
     assert out["serving_tokens_per_s"] > 0.0
+    # engine-histogram latency fields (PR 8): measured, not fabricated
+    assert out["serving_ttft_p50_ms"] is not None
+    assert out["serving_ttft_p99_ms"] is not None
+    assert out["serving_tpot_p50_ms"] is not None
+    assert 0 < out["serving_ttft_p50_ms"] <= out["serving_ttft_p99_ms"]
     # the burst wave measured the on-device token loop: dispatch ratio
     # well under one per token, mode named (jnp on this CPU container)
     assert "burst_probe_error" not in out, out

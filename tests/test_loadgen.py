@@ -1,0 +1,455 @@
+"""paddle_tpu.loadgen — serving load harness gates.
+
+The ISSUE-8 acceptance bars, asserted not logged:
+- determinism: one WorkloadSpec seed => one trace (fingerprint) and one
+  report, byte for byte, across independent runs — including burst mode
+  (FLAGS_decode_burst_tokens > 1), where shed/admission decisions
+  quantize to burst boundaries;
+- a seeded Poisson mixed prefill+decode workload with a shared-prefix
+  cohort produces non-null p50/p90/p99 TTFT and e2e, goodput,
+  shed/preempt counts, and a prefix-cache hit rate;
+- overload (arrival rate above sustainable throughput, tight deadlines)
+  engages deadline shedding AND preemption, the watermark/refcount
+  invariants hold on EVERY step (the driver audits the pool in-run),
+  and the system recovers to steady-state completions afterwards;
+- chunked prefill keeps decode rows progressing under a long-prompt
+  flood (one token per step, measured through virtual timestamps);
+- Histogram (serving/metrics.py): bounded reservoir, deterministic
+  percentiles, TTFT/TPOT recorded per finished request; queue-age
+  gauges from the scheduler's enqueue timestamps.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (Driver, TraceRequest, VirtualClock,
+                                WorkloadSpec, build_report, report_json,
+                                run_workload, trace_fingerprint)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import LLMEngine
+from paddle_tpu.serving.metrics import (Histogram, ServingMetrics,
+                                        percentile_of)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, clock, **kw):
+    kw.setdefault("max_len", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("seed", 0)
+    return LLMEngine(model, now_fn=clock.now, **kw)
+
+
+# ---------------------------------------------------------------------------
+# workload compilation determinism
+# ---------------------------------------------------------------------------
+
+def test_trace_compiles_reproducibly():
+    spec = WorkloadSpec(num_requests=50, seed=11, arrival="poisson",
+                        arrival_rate=30.0, prompt_len=(4, 20),
+                        output_len=(2, 8), shared_prefix_fraction=0.5,
+                        shared_prefix_len=8, num_shared_prefixes=2,
+                        deadline_s=0.5, slo_e2e_s=2.0)
+    t1, t2 = spec.compile(), spec.compile()
+    assert t1 == t2
+    assert trace_fingerprint(t1) == trace_fingerprint(t2)
+    # a different seed is a different trace
+    other = dataclasses.replace(spec, seed=12).compile()
+    assert trace_fingerprint(other) != trace_fingerprint(t1)
+    # arrivals are non-decreasing; cohort prompts share the exact prefix
+    assert all(a.arrival_s <= b.arrival_s for a, b in zip(t1, t1[1:]))
+    cohorts = {}
+    for r in t1:
+        if r.prefix_cohort >= 0:
+            cohorts.setdefault(r.prefix_cohort, set()).add(
+                r.prompt_token_ids[:8])
+    assert cohorts, "a 0.5 mix over 50 requests must hit the cohort"
+    for prefixes in cohorts.values():
+        assert len(prefixes) == 1, "one cohort, one prefix"
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        WorkloadSpec(num_requests=0)
+    with pytest.raises(ValueError):
+        WorkloadSpec(arrival="bursty")
+    with pytest.raises(ValueError):
+        WorkloadSpec(arrival_rate=0.0)
+    with pytest.raises(ValueError):
+        WorkloadSpec(prompt_len=(5, 2))
+    with pytest.raises(ValueError):
+        WorkloadSpec(shared_prefix_fraction=0.5)   # no prefix length
+    with pytest.raises(ValueError):
+        WorkloadSpec(shared_prefix_fraction=1.5, shared_prefix_len=4)
+    with pytest.raises(ValueError, match="prompt_len hi"):
+        # a prefix at/above the prompt range's hi would silently emit
+        # cohort prompts longer than the spec declares
+        WorkloadSpec(prompt_len=(4, 8), shared_prefix_fraction=0.5,
+                     shared_prefix_len=8)
+    # and a legal cohort never exceeds the declared hi
+    spec = WorkloadSpec(num_requests=40, seed=0, prompt_len=(4, 8),
+                        shared_prefix_fraction=1.0, shared_prefix_len=6)
+    assert all(len(r.prompt_token_ids) <= 8 for r in spec.compile())
+
+
+def test_deterministic_arrivals():
+    spec = WorkloadSpec(num_requests=5, seed=0, arrival="deterministic",
+                        arrival_rate=10.0)
+    assert [r.arrival_s for r in spec.compile()] == \
+        [0.0, 0.1, 0.2, 0.3, 0.4]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance workload: Poisson mixed traffic + shared-prefix cohort
+# ---------------------------------------------------------------------------
+
+_MIXED = WorkloadSpec(num_requests=36, seed=3, arrival="poisson",
+                      arrival_rate=150.0, prompt_len=(4, 20),
+                      output_len=(2, 6), shared_prefix_fraction=0.5,
+                      shared_prefix_len=8, deadline_s=0.5, slo_e2e_s=2.0,
+                      vocab_size=128)
+
+
+def _run_mixed(model, **engine_kw):
+    clock = VirtualClock()
+    eng = _engine(model, clock, **engine_kw)
+    result = Driver(eng, clock, step_time_s=0.01).run(_MIXED.compile())
+    return build_report(result, spec=_MIXED, trace=_MIXED.compile())
+
+
+def test_poisson_mixed_report_and_bitwise_reproducibility(tiny_model):
+    r1 = _run_mixed(tiny_model)
+    r2 = _run_mixed(tiny_model)
+    j1, j2 = report_json(r1), report_json(r2)
+    assert j1 == j2, "same seed must reproduce the report byte-for-byte"
+    # non-null SLO percentiles over a fully-served mixed wave
+    for key in ("ttft_s", "e2e_s"):
+        for q in ("p50", "p90", "p99"):
+            assert r1["latency"][key][q] is not None
+            assert r1["latency"][key][q] > 0.0
+    assert r1["latency"]["ttft_s"]["p50"] <= r1["latency"]["e2e_s"]["p50"]
+    assert r1["requests"]["total"] == 36
+    assert r1["requests"]["unresolved"] == 0
+    assert r1["requests"]["finished"] > 0
+    assert r1["goodput"]["goodput_fraction"] is not None
+    # shed/preempt counts are present (zero is a legal value here)
+    assert "shed" in r1["requests"]
+    assert "preemptions" in r1["requests"]
+    # the shared-prefix cohort exercised the prefix cache
+    assert r1["prefix_cache"]["hit_rate"] is not None
+    assert r1["prefix_cache"]["hit_rate"] > 0.0
+    assert r1["workload"]["trace_fingerprint"] is not None
+    # the virtual clock means ONE ragged-step executable served it all
+    assert r1["kv_pressure"]["decode_compiles"] == 1
+    assert r1["kv_pressure"]["over_allocated"] is False
+
+
+def test_determinism_under_burst_mode(tiny_model):
+    """Same seed, burst engine (decode megakernel token loop,
+    burst_tokens > 1): shed/admission quantize to burst boundaries and
+    the whole report must STILL reproduce bit-for-bit."""
+    r1 = _run_mixed(tiny_model, burst_tokens=4)
+    r2 = _run_mixed(tiny_model, burst_tokens=4)
+    assert report_json(r1) == report_json(r2)
+    assert r1["requests"]["unresolved"] == 0
+    assert r1["requests"]["finished"] > 0
+    assert r1["throughput"]["burst_tokens"] == 4
+    # bursts actually engaged: fewer host dispatches than tokens
+    assert r1["throughput"]["host_dispatches"] \
+        < r1["throughput"]["tokens_generated"]
+
+
+# ---------------------------------------------------------------------------
+# overload: shed + preempt + watermark audit + recovery (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_overload_sheds_preempts_and_recovers(tiny_model):
+    """Arrival rate far above sustainable throughput with tight
+    queue-wait deadlines on a deliberately small pool: deadline shedding
+    AND preemption must engage; the pool must never over-allocate (the
+    driver audits refcounts/free-list/watermark accounting EVERY step);
+    and a post-overload cohort must complete at steady state."""
+    burst = WorkloadSpec(num_requests=20, seed=1, arrival="poisson",
+                         arrival_rate=2000.0, prompt_len=(6, 10),
+                         output_len=(8, 10), deadline_s=0.06,
+                         slo_e2e_s=0.5, vocab_size=128)
+    recover = WorkloadSpec(num_requests=4, seed=2,
+                           arrival="deterministic", arrival_rate=10.0,
+                           prompt_len=(4, 8), output_len=(4, 6),
+                           slo_e2e_s=5.0, vocab_size=128)
+    trace = burst.compile() + [
+        dataclasses.replace(r, arrival_s=r.arrival_s + 3.0)
+        for r in recover.compile()]
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, num_pages=17, max_num_seqs=4)
+    result = Driver(eng, clock, step_time_s=0.01, check_every=1) \
+        .run(trace)
+    report = build_report(result)
+    # every request reached a terminal state — the engine drained
+    assert report["requests"]["unresolved"] == 0
+    # shedding engaged on the overload wave
+    assert report["requests"]["shed"] >= 1
+    shed = [r for r in result.records if r.status == "shed"]
+    assert all(r.num_tokens == 0 for r in shed), \
+        "deadline shedding must only drop requests that never started"
+    # preemption engaged under pool pressure
+    assert report["requests"]["preemptions"] >= 1
+    assert report["requests"]["preempted_requests"] >= 1
+    # watermark gates held: audited in-run (every step), summarized here
+    assert result.invariant_checks == result.steps
+    assert report["kv_pressure"]["over_allocated"] is False
+    assert report["kv_pressure"]["invariant_checks"] == result.steps
+    assert report["kv_pressure"]["peak_used_pages"] \
+        <= report["kv_pressure"]["page_capacity"]
+    assert report["kv_pressure"]["peak_page_utilization"] > 0.8, \
+        "overload must actually pressure the pool"
+    # post-overload recovery: the late cohort all finished, promptly
+    rec = [r for r in result.records if r.request_id.startswith("lg-2-")]
+    assert len(rec) == 4
+    assert all(r.status == "finished" for r in rec)
+    assert all(r.in_slo for r in rec)
+    assert all(r.ttft_s is not None and r.ttft_s <= 0.05 for r in rec), \
+        "a drained engine must serve the recovery cohort immediately"
+    # and the pool is fully drained afterwards
+    assert eng.pool.free_pages == eng.pool.capacity
+    eng.pool.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill keeps decodes progressing under a long-prompt flood
+# ---------------------------------------------------------------------------
+
+def test_long_prompt_flood_never_stalls_decodes(tiny_model):
+    """Two active decode rows, then a flood of 24-token prompts chunked
+    in at chunk_size=4: the decode rows' virtual token timestamps must
+    advance by EXACTLY one step per token, all the way through the
+    flood's prefill — the scheduler's per-row q_block reservation made
+    measurable at the harness level."""
+    rng = np.random.default_rng(0)
+
+    def prompt(n):
+        return tuple(int(x) for x in rng.integers(0, 128, (n,)))
+
+    trace = [TraceRequest("dec-0", 0.0, prompt(3), 20),
+             TraceRequest("dec-1", 0.0, prompt(4), 20)]
+    trace += [TraceRequest(f"flood-{i}", 3.0, prompt(24), 2)
+              for i in range(3)]
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, max_len=48, max_num_seqs=4,
+                  chunk_size=4, max_prefills_per_step=1)
+    result = Driver(eng, clock, step_time_s=1.0).run(trace)
+    decs = [r for r in result.records if r.request_id.startswith("dec-")]
+    for r in decs:
+        assert r.status == "finished" and r.num_tokens == 20
+        diffs = [b - a for a, b in zip(r.token_times, r.token_times[1:])]
+        assert all(d == 1.0 for d in diffs), (
+            f"{r.request_id} stalled while the flood chunked in: "
+            f"inter-token gaps {sorted(set(diffs))}")
+    floods = [r for r in result.records
+              if r.request_id.startswith("flood-")]
+    assert all(r.status == "finished" for r in floods)
+    assert result.metrics["prefill_chunks"] >= 3 * (24 // 4), \
+        "the flood prompts must actually have chunked"
+
+
+# ---------------------------------------------------------------------------
+# Histogram + metrics satellites
+# ---------------------------------------------------------------------------
+
+def test_histogram_exact_below_cap_and_bounded_above():
+    h = Histogram("t", max_samples=64)
+    for v in range(50, 0, -1):          # 1..50, reversed insert order
+        h.observe(float(v))
+    assert h.count == 50 and len(h._samples) == 50
+    assert h.percentile(0) == 1.0 and h.percentile(100) == 50.0
+    assert h.percentile(50) == 25.5     # exact linear interpolation
+    assert h.min == 1.0 and h.max == 50.0
+    assert h.mean == pytest.approx(25.5)
+    for v in range(10_000):
+        h.observe(float(v))
+    assert len(h._samples) == 64, "reservoir must stay bounded"
+    assert h.count == 10_050
+    s = h.summary()
+    assert s["count"] == 10_050 and s["p99"] is not None
+
+
+def test_histogram_is_deterministic_across_instances():
+    """Identical observation streams => identical reservoirs and
+    percentiles (crc32-seeded replacement, not process-salted hash) —
+    the property the loadgen byte-identity gate leans on."""
+    a, b = Histogram("ttft_s", max_samples=32), \
+        Histogram("ttft_s", max_samples=32)
+    vals = [((i * 2654435761) % 1000) / 7.0 for i in range(5000)]
+    for v in vals:
+        a.observe(v)
+        b.observe(v)
+    assert a._samples == b._samples
+    for q in (1, 50, 90, 99):
+        assert a.percentile(q) == b.percentile(q)
+    c = Histogram("e2e_s", max_samples=32)     # different name, diff seed
+    for v in vals:
+        c.observe(v)
+    assert c.count == a.count
+
+
+def test_histogram_empty_and_validation():
+    h = Histogram("x")
+    assert h.percentile(50) is None and h.mean is None
+    assert h.summary()["p99"] is None
+    with pytest.raises(ValueError):
+        Histogram("x", max_samples=0)
+    assert percentile_of([], 50) is None
+    assert percentile_of([3.0], 99) == 3.0
+    assert percentile_of([1.0, 2.0], 50) == 1.5
+
+
+def test_metrics_record_ttft_tpot_per_finished_request(tiny_model):
+    """Engine-side latency histograms fill without any harness: every
+    finished request lands one TTFT/e2e observation (TPOT needs >= 2
+    tokens) and snapshot() exposes the percentiles."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 8], [10, 11, 12]]
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=4)
+    steps = 0
+    while eng.has_unfinished():
+        clock.advance(0.01)             # the step "takes" virtual time
+        eng.step()
+        steps += 1
+        assert steps < 100
+    snap = eng.metrics_snapshot()
+    assert snap["finished_requests"] == 4
+    assert snap["ttft_s_count"] == 4
+    assert snap["e2e_s_count"] == 4
+    assert snap["tpot_s_count"] == 4
+    for k in ("ttft_s_p50", "ttft_s_p90", "ttft_s_p99", "e2e_s_p50",
+              "e2e_s_p99", "tpot_s_p50"):
+        assert snap[k] is not None and snap[k] > 0.0, k
+    assert snap["ttft_s_p50"] <= snap["e2e_s_p50"]
+
+
+def test_queue_age_gauges_surface_starvation(tiny_model):
+    """More requests than row slots: the waiting queue's age gauges
+    (scheduler enqueue timestamps on the virtual clock) must read the
+    oldest waiter's true wait."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, max_num_seqs=2)
+    for i in range(5):
+        eng.add_request([1 + i, 2, 3], max_new_tokens=8)
+    for _ in range(4):
+        clock.advance(0.01)
+        eng.step()
+    snap = eng.metrics_snapshot()
+    assert snap["waiting_seqs"] >= 1
+    assert snap["max_queue_wait_s"] == pytest.approx(0.04)
+    assert snap["queue_age_p99_s"] > 0.0
+    assert snap["queue_age_p99_s"] <= snap["max_queue_wait_s"] + 1e-12
+    ages = eng.scheduler.queue_ages()
+    assert len(ages) == int(snap["waiting_seqs"])
+    assert eng.scheduler.max_queue_wait() == max(ages)
+    eng.run(max_steps=200)              # drain
+    snap = eng.metrics_snapshot()
+    assert snap["max_queue_wait_s"] == 0.0
+
+
+def test_driver_rejects_mismatched_clock(tiny_model):
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4)   # wall clock
+    with pytest.raises(ValueError, match="now_fn"):
+        Driver(eng, clock)
+
+
+def test_driver_rejects_duplicate_request_ids(tiny_model):
+    """Two specs compiled from the SAME seed collide on request_ids —
+    the driver must name the problem up front instead of dying on the
+    engine's KeyError mid-run."""
+    spec = WorkloadSpec(num_requests=3, seed=4)
+    trace = spec.compile() + spec.compile()
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    with pytest.raises(ValueError, match="duplicate request_ids"):
+        Driver(eng, clock).run(trace)
+
+
+def test_latencies_anchor_on_trace_arrival(tiny_model):
+    """A request arriving mid-step waits for the step boundary; its
+    TTFT/e2e must charge that wait to the client (anchor = arrival_s,
+    not the injection time)."""
+    trace = [TraceRequest("early", 0.0, (1, 2, 3), 2),
+             # arrives at t=1.5, mid-stream: injected at the t=2.0
+             # boundary, so >= 0.5s of its latency is boundary wait
+             TraceRequest("late", 1.5, (4, 5, 6), 2)]
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    result = Driver(eng, clock, step_time_s=1.0).run(trace)
+    by_id = {r.request_id: r for r in result.records}
+    late = by_id["late"]
+    assert late.status == "finished"
+    assert late.submitted_at >= 2.0
+    assert late.ttft_s == late.first_token_at - 1.5
+    assert late.ttft_s >= 1.5        # boundary wait + one service step
+    assert late.e2e_s == late.finished_at - 1.5
+
+
+def test_driver_records_rejected_requests(tiny_model):
+    """An unserviceable request must land in the records as a terminal
+    aborted outcome, not kill the run."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    trace = [TraceRequest("ok", 0.0, (1, 2, 3), 4),
+             TraceRequest("huge", 0.0, tuple(range(30)), 30)]
+    result = run_workload(eng, clock, trace, step_time_s=0.01)
+    by_id = {r.request_id: r for r in result.records}
+    assert by_id["ok"].status == "finished"
+    assert by_id["huge"].status == "aborted"
+    assert by_id["huge"].finish_reason == "rejected_oversize"
+    assert by_id["huge"].num_tokens == 0
+
+
+# ---------------------------------------------------------------------------
+# heavy mixed-traffic soak: overload -> shed/preempt -> recover (slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_soak_mixed_overload_recovery(tiny_model):
+    """A few hundred requests through sustained overload on a starved
+    pool, then a recovery tail: every request terminal, invariants held
+    on every step, recovery cohort fully served."""
+    storm = WorkloadSpec(num_requests=300, seed=5, arrival="poisson",
+                         arrival_rate=400.0, prompt_len=(4, 16),
+                         output_len=(4, 12), shared_prefix_fraction=0.3,
+                         shared_prefix_len=8, deadline_s=0.15,
+                         slo_e2e_s=1.0, vocab_size=128)
+    tail = WorkloadSpec(num_requests=20, seed=6, arrival="deterministic",
+                        arrival_rate=20.0, prompt_len=(4, 12),
+                        output_len=(2, 8), slo_e2e_s=5.0, vocab_size=128)
+    last = max(r.arrival_s for r in storm.compile())
+    trace = storm.compile() + [
+        dataclasses.replace(r, arrival_s=r.arrival_s + last + 2.0)
+        for r in tail.compile()]
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, num_pages=25, max_num_seqs=6)
+    result = Driver(eng, clock, step_time_s=0.01).run(trace)
+    report = build_report(result, spec=storm)
+    assert report["requests"]["unresolved"] == 0
+    assert report["requests"]["shed"] >= 10
+    assert report["requests"]["preemptions"] >= 1
+    assert result.invariant_checks == result.steps
+    assert report["prefix_cache"]["hit_rate"] is not None
+    rec = [r for r in result.records if r.request_id.startswith("lg-6-")]
+    assert len(rec) == 20 and all(r.status == "finished" for r in rec)
+    assert eng.pool.free_pages == eng.pool.capacity
+    # and the report still serializes stably
+    assert report_json(report) == report_json(
+        build_report(result, spec=storm))

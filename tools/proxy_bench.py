@@ -1,0 +1,286 @@
+"""CPU-tier proxy perf bench: chip-free regression gate over the
+counted perf surfaces.
+
+The flagship bench (bench.py) needs a live chip for tok/s and MFU — and
+the chip pool can wedge for days (BENCH_r03-r05 are stale fallbacks).
+This harness runs the measurements that DON'T need a chip and are
+(near-)deterministic counts rather than timings:
+
+- ``decode_compiles`` — ragged-step executables across a mixed serving
+  wave (must stay 1: a jump is shape-dependent recompilation);
+- ``host_dispatches_per_token`` — burst-mode serving dispatches per
+  generated token (the on-device token loop's O(1)-per-burst contract;
+  forcing the per-token path drives it toward >= 1);
+- ``opt_dispatches_per_step`` — fused-optimizer dispatch count;
+- ``host_syncs_per_epoch`` — async-pipeline blocking fetch rounds;
+- ``fwd_jaxpr_eqns_scan`` / ``fwd_jaxpr_eqn_growth`` — trace size of the
+  scanned forward and its growth with depth (must be 0);
+- ``kv_bytes_per_token_fp32`` / ``_int8`` — exact KV pool byte
+  accounting at a reference geometry;
+- ``prefix_cache_hit_rate`` / ``shared_page_fraction`` — prefix-cache
+  effectiveness over the shared-prefix wave (higher is better).
+
+Each metric gates against a checked-in per-backend baseline
+(tools/proxy_bench_baseline.json) with a direction and tolerance from
+``GATES`` — a regression fails with rc 1, parity passes with rc 0, so
+perf regressions surface in CI without a chip (docs/BENCH.md compares
+these proxies with the chip metrics they predict).
+
+Usage:
+  python -m tools.proxy_bench                     # run, print JSON
+  python -m tools.proxy_bench --record            # (re)record baseline
+  python -m tools.proxy_bench --compare tools/proxy_bench_baseline.json
+  python -m tools.proxy_bench --probes serving,jaxpr --compare ...
+
+The probes themselves live in tools/bench_probes.py and are shared with
+bench.py, which spreads the same fields into its flagship artifact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
+
+PROBES = ("serving", "optimizer", "pipeline", "jaxpr", "accounting")
+
+
+class Gate:
+    """Direction-aware tolerance: ``worse`` names the failing direction.
+
+    higher-is-worse: fail when cur > base * (1 + rel) + abs
+    lower-is-worse:  fail when cur < base * (1 - rel) - abs
+    Counts gate tightly (rel 0, small abs); ratios get slack for
+    environment drift. A None measurement where the baseline has a
+    number is always a failure — a probe that stopped measuring is a
+    silent coverage loss, not a pass.
+    """
+
+    def __init__(self, worse="higher", rel=0.0, abs_=0.0):
+        assert worse in ("higher", "lower")
+        self.worse = worse
+        self.rel = rel
+        self.abs_ = abs_
+
+    def bad(self, cur, base) -> bool:
+        if self.worse == "higher":
+            return cur > base * (1.0 + self.rel) + self.abs_
+        return cur < base * (1.0 - self.rel) - self.abs_
+
+    def bound(self, base) -> float:
+        if self.worse == "higher":
+            return base * (1.0 + self.rel) + self.abs_
+        return base * (1.0 - self.rel) - self.abs_
+
+
+GATES = {
+    "decode_compiles":          Gate("higher", 0.0, 0.0),
+    "host_dispatches_per_token": Gate("higher", 0.20, 0.01),
+    "opt_dispatches_per_step":  Gate("higher", 0.0, 2.0),
+    "host_syncs_per_epoch":     Gate("higher", 0.0, 2.0),
+    "fwd_jaxpr_eqns_scan":      Gate("higher", 0.10, 0.0),
+    "fwd_jaxpr_eqn_growth":     Gate("higher", 0.0, 0.0),
+    "kv_bytes_per_token_fp32":  Gate("higher", 0.0, 0.0),
+    "kv_bytes_per_token_int8":  Gate("higher", 0.0, 0.0),
+    "prefix_cache_hit_rate":    Gate("lower", 0.0, 0.10),
+    "shared_page_fraction":     Gate("lower", 0.0, 0.10),
+}
+
+
+def collect(probes=PROBES, burst_tokens=8) -> dict:
+    """Run the selected probes; returns {backend, probes, metrics}.
+
+    ``burst_tokens=1`` forces the serving engine's per-token dispatch
+    path — the deliberate-regression hook the compare-mode test uses to
+    prove the ``host_dispatches_per_token`` gate actually fires.
+    """
+    import jax
+    import paddle_tpu as paddle
+    from tools.bench_probes import (probe_input_pipeline, probe_jaxpr,
+                                    probe_kv_accounting,
+                                    probe_opt_dispatches, probe_serving)
+    dev = jax.devices()[0]
+    backend = dev.platform if dev.platform == "cpu" else \
+        getattr(dev, "device_kind", "tpu").replace(" ", "-").lower()
+    metrics: dict = {}
+    errors: dict = {}
+
+    def _take(blob, keys):
+        for k in keys:
+            metrics[k] = blob.get(k)
+        for k, v in blob.items():
+            if k.endswith("_probe_error"):
+                errors[k] = v
+
+    if "serving" in probes:
+        _take(probe_serving(paddle, burst_tokens=burst_tokens),
+              ("decode_compiles", "host_dispatches_per_token",
+               "prefix_cache_hit_rate", "shared_page_fraction"))
+    if "optimizer" in probes:
+        _take(probe_opt_dispatches(paddle), ("opt_dispatches_per_step",))
+    if "pipeline" in probes:
+        _take(probe_input_pipeline(paddle), ("host_syncs_per_epoch",))
+    if "jaxpr" in probes:
+        _take(probe_jaxpr(paddle),
+              ("fwd_jaxpr_eqns_scan", "fwd_jaxpr_eqn_growth"))
+    if "accounting" in probes:
+        _take(probe_kv_accounting(),
+              ("kv_bytes_per_token_fp32", "kv_bytes_per_token_int8"))
+    out = {"backend": backend, "probes": sorted(probes),
+           "metrics": metrics}
+    if errors:
+        out["probe_errors"] = errors
+    return out
+
+
+def gate(current, baseline, *, require_all=True):
+    """Compare a collection against a baseline blob of the same backend.
+
+    Returns (failures, report_str): failures is [(metric, reason)].
+    ``require_all=False`` skips baseline metrics absent from the current
+    run (partial --probes collections); full runs treat a missing metric
+    as a failure — silent coverage loss must not read as a pass.
+    """
+    failures, lines = [], []
+    base = baseline.get("metrics", {})
+    for name, cur in sorted(current.get("metrics", {}).items()):
+        ref = base.get(name)
+        g = GATES.get(name, Gate("higher", 0.25, 0.0))
+        if ref is None:
+            lines.append(f"  {name:<28} {cur!s:>12}   (new, no baseline)")
+            continue
+        if cur is None:
+            lines.append(f"  {name:<28} {'null':>12}   baseline "
+                         f"{ref:>10}   << PROBE BROKE")
+            failures.append((name, "measurement is null"))
+            continue
+        bad = g.bad(cur, ref)
+        flag = "  << REGRESSION" if bad else ""
+        lines.append(
+            f"  {name:<28} {cur:>12.4f}   baseline {ref:>10.4f}   "
+            f"(fail {'>' if g.worse == 'higher' else '<'} "
+            f"{g.bound(ref):.4f}){flag}")
+        if bad:
+            failures.append(
+                (name, f"{cur} vs baseline {ref} "
+                       f"(worse={g.worse}, bound {g.bound(ref):.4f})"))
+    missing = sorted(set(base) - set(current.get("metrics", {})))
+    if require_all:
+        for name in missing:
+            lines.append(f"  {name:<28} MISSING from current run")
+            failures.append((name, "missing from current run"))
+    return failures, "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="CPU-tier proxy perf bench (counts, not timings)")
+    ap.add_argument("--record", action="store_true",
+                    help="write the baseline for this backend")
+    ap.add_argument("--compare", metavar="BASELINE", nargs="?",
+                    const=BASELINE_PATH, default=None,
+                    help="gate against a baseline file (default: "
+                         "tools/proxy_bench_baseline.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the collection JSON only")
+    ap.add_argument("--probes", default=",".join(PROBES),
+                    help=f"comma list from {PROBES}")
+    ap.add_argument("--burst-tokens", type=int, default=8,
+                    help="serving probe burst length (1 forces the "
+                         "per-token dispatch path)")
+    args = ap.parse_args(argv)
+
+    probes = tuple(p for p in args.probes.split(",") if p)
+    unknown = set(probes) - set(PROBES)
+    if unknown:
+        print(f"unknown probes: {sorted(unknown)}", file=sys.stderr)
+        return 2
+    if args.record and args.compare is not None:
+        # record-then-compare-against-itself would always pass; an
+        # operator asking for both almost certainly wants a real gate
+        # first — make them choose
+        print("--record and --compare are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.record and set(probes) != set(PROBES):
+        # a partial recording would overwrite the backend's baseline
+        # with a subset and every later full compare would read the
+        # dropped metrics as "(new, no baseline)" — silent coverage loss
+        print("--record requires the full probe set (a partial "
+              "recording would shrink gate coverage)", file=sys.stderr)
+        return 2
+    current = collect(probes=probes, burst_tokens=args.burst_tokens)
+
+    if args.json:
+        # --json changes the output format, never the action: combined
+        # with --compare (or --record) the gate/recording still runs
+        # and still sets the exit code
+        print(json.dumps(current, indent=1, sort_keys=True))
+        if args.compare is None and not args.record:
+            return 0
+    elif not args.record and args.compare is None:
+        print(json.dumps(current, indent=1, sort_keys=True))
+        return 0
+
+    if args.record:
+        # a baseline with a null metric (or a probe that errored) would
+        # make gate() read that metric as "(new, no baseline)" forever —
+        # coverage silently lost on the RECORDING side of the compare
+        nulls = sorted(k for k, v in current["metrics"].items()
+                       if v is None)
+        if nulls or current.get("probe_errors"):
+            print(f"refusing to record a broken collection: null "
+                  f"metrics {nulls}, probe errors "
+                  f"{current.get('probe_errors')}", file=sys.stderr)
+            return 2
+        baselines = {}
+        if os.path.exists(BASELINE_PATH):
+            with open(BASELINE_PATH) as f:
+                baselines = json.load(f)
+        baselines[current["backend"]] = current
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+            f.write("\n")
+        # status goes to stderr under --json: stdout stays pure JSON
+        print(f"recorded baseline for backend={current['backend']} "
+              f"({len(current['metrics'])} metrics) -> {BASELINE_PATH}",
+              file=sys.stderr if args.json else sys.stdout)
+        return 0
+
+    try:
+        with open(args.compare) as f:
+            baselines = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read baseline {args.compare}: {e}", file=sys.stderr)
+        return 2
+    baseline = baselines.get(current["backend"])
+    if baseline is None:
+        print(f"no baseline for backend={current['backend']} in "
+              f"{args.compare}; run `python -m tools.proxy_bench "
+              f"--record` first", file=sys.stderr)
+        return 2
+    failures, report = gate(current, baseline,
+                            require_all=set(probes) == set(PROBES))
+    # with --json, stdout is the collection JSON and nothing else (it
+    # must stay machine-parseable); the human report moves to stderr
+    dst = sys.stderr if args.json else sys.stdout
+    print(f"proxy bench gate  backend={current['backend']} "
+          f"probes={','.join(sorted(probes))}", file=dst)
+    print(report, file=dst)
+    if current.get("probe_errors"):
+        print(f"probe errors: {current['probe_errors']}", file=sys.stderr)
+    if failures:
+        print("FAIL: " + "; ".join(f"{n}: {r}" for n, r in failures),
+              file=sys.stderr)
+        return 1
+    print("PASS", file=dst)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
