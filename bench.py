@@ -42,13 +42,15 @@ import numpy as np
 # spreads the same fields into the flagship JSON line
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tools.bench_probes import (probe_input_pipeline,  # noqa: E402
-                                probe_opt_dispatches, probe_serving)
+                                probe_opt_dispatches, probe_serving,
+                                probe_spec_decode)
 
 # legacy aliases: forensics tests and older tooling call the underscored
 # names on this module
 _probe_opt_dispatches = probe_opt_dispatches
 _probe_serving = probe_serving
 _probe_input_pipeline = probe_input_pipeline
+_probe_spec_decode = probe_spec_decode
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -205,6 +207,7 @@ def run_bench(config="llama_125m", progress=None):
     remat_policy = effective_remat_policy(cfg.remat)
     opt_probe = _probe_opt_dispatches(paddle)
     serving_probe = _probe_serving(paddle)
+    spec_probe = _probe_spec_decode(paddle)
     pipeline_probe = _probe_input_pipeline(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
@@ -272,6 +275,7 @@ def run_bench(config="llama_125m", progress=None):
         "scan_layers": bool(GLOBAL_FLAGS.get("scan_layers")),
         **opt_probe,
         **serving_probe,
+        **spec_probe,
         **pipeline_probe,
     }
 
@@ -532,6 +536,12 @@ def _failure_artifact(last_err, last_stages):
         "host_dispatches_per_token": None,
         "megakernel_mode": None,
         "burst_tokens_per_s": None,
+        # speculative-decoding fields are per-run measurements too: an
+        # acceptance rate or launches-per-token ratio the failed run
+        # never observed must stay null
+        "spec_target_steps_per_token": None,
+        "spec_accept_rate": None,
+        "spec_decode_compiles": None,
     }
     good = _last_good_round()
     if good:

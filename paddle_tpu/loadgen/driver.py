@@ -197,6 +197,9 @@ class Driver:
                         list(req.prompt_token_ids),
                         max_new_tokens=req.max_new_tokens,
                         temperature=req.temperature,
+                        top_k=getattr(req, "top_k", 0) or None,
+                        top_p=getattr(req, "top_p", 1.0),
+                        seed=getattr(req, "seed", None),
                         eos_token_id=req.eos_token_id,
                         deadline_s=req.deadline_s,
                         request_id=req.request_id)

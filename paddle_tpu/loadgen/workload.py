@@ -45,6 +45,11 @@ class TraceRequest:
     deadline_s: float | None = None
     slo_e2e_s: float | None = None
     temperature: float = 0.0
+    #: per-request sampling knobs (serving/engine.py): 0 / 1.0 = off;
+    #: seed None lets the engine derive one from the request_id
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int | None = None
     eos_token_id: int | None = None
     #: cohort index when the prompt starts with a shared prefix, else -1
     prefix_cohort: int = -1
@@ -73,6 +78,15 @@ class WorkloadSpec:
     deadline_s: float | None = None
     slo_e2e_s: float | None = None
     temperature: float = 0.0
+    #: per-request sampling-knob ranges (inclusive): each request draws
+    #: its own top_k from ``top_k`` ((0, 0) = off), its own top_p
+    #: uniformly from ``top_p`` ((1.0, 1.0) = off), and its own PRNG
+    #: seed from ``per_request_seed`` (None = engine-derived from the
+    #: request_id). All ride the one spec rng stream, so they are part
+    #: of the trace fingerprint.
+    top_k: tuple = (0, 0)
+    top_p: tuple = (1.0, 1.0)
+    per_request_seed: tuple | None = None
     eos_token_id: int | None = None
     vocab_size: int = 128
 
@@ -109,6 +123,20 @@ class WorkloadSpec:
                 raise ValueError("num_shared_prefixes must be >= 1")
         if self.vocab_size < 2:
             raise ValueError("vocab_size must be >= 2")
+        klo, khi = self.top_k
+        if not 0 <= klo <= khi:
+            raise ValueError(f"top_k must be an inclusive range "
+                             f"0 <= lo <= hi, got {self.top_k}")
+        plo, phi = self.top_p
+        if not 0.0 < plo <= phi <= 1.0:
+            raise ValueError(f"top_p must be an inclusive range in "
+                             f"(0, 1], got {self.top_p}")
+        if self.per_request_seed is not None:
+            slo, shi = self.per_request_seed
+            if not 0 <= slo <= shi:
+                raise ValueError(
+                    f"per_request_seed must be an inclusive range "
+                    f"0 <= lo <= hi, got {self.per_request_seed}")
 
     def describe(self) -> dict:
         """Plain-dict view of the spec for the report artifact."""
@@ -147,12 +175,28 @@ class WorkloadSpec:
             else:
                 prompt = tuple(int(x) for x in rng.integers(
                     0, self.vocab_size, (plen,)))
+            # per-request sampling knobs: degenerate ranges take the
+            # fixed value WITHOUT consuming rng draws, so a spec that
+            # leaves them at the defaults compiles to the same
+            # prompts/arrivals/lengths it did before the knobs existed
+            # (the fingerprint itself is schema-versioned by whatever
+            # fields it hashes — it changed when the knobs were added)
+            klo, khi = self.top_k
+            tk = klo if klo == khi else int(rng.integers(klo, khi + 1))
+            plo_, phi_ = self.top_p
+            tp = plo_ if plo_ == phi_ else float(rng.uniform(plo_, phi_))
+            seed = None
+            if self.per_request_seed is not None:
+                slo, shi = self.per_request_seed
+                seed = slo if slo == shi else int(
+                    rng.integers(slo, shi + 1))
             trace.append(TraceRequest(
                 request_id=f"lg-{self.seed}-{i}", arrival_s=t,
                 prompt_token_ids=prompt, max_new_tokens=olen,
                 deadline_s=self.deadline_s, slo_e2e_s=self.slo_e2e_s,
-                temperature=self.temperature,
-                eos_token_id=self.eos_token_id, prefix_cohort=cohort))
+                temperature=self.temperature, top_k=tk, top_p=tp,
+                seed=seed, eos_token_id=self.eos_token_id,
+                prefix_cohort=cohort))
         return trace
 
 
@@ -162,6 +206,7 @@ def trace_fingerprint(trace) -> str:
     blob = json.dumps(
         [[r.request_id, repr(r.arrival_s), list(r.prompt_token_ids),
           r.max_new_tokens, r.deadline_s, r.slo_e2e_s, r.temperature,
+          r.top_k, repr(r.top_p), r.seed,
           r.eos_token_id, r.prefix_cohort] for r in trace],
         sort_keys=True)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()

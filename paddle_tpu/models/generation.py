@@ -243,22 +243,101 @@ def _logits(params, h, cfg):
     return h @ params["embed"].T
 
 
+def _masked_logits(logits, temps, top_ks, top_ps):
+    """The per-row, branch-free sampling transform shared by every
+    sampler in the repo (Generator's ``_sample``, the serving engine's
+    ragged/burst steps, the speculative-decoding draft and verifier):
+    scale by temperature, then mask to the top-k largest logits, then to
+    the top-p nucleus — all as data-dependent ``where`` masks so rows
+    with different knobs ride ONE jitted launch.
+
+    logits [b, V]; temps [b] (> 0 — greedy rows are the caller's
+    ``where``); top_ks [b] int32 (<= 0 disables; clamped to the vocab,
+    so ``top_k >= V`` is a no-op instead of an out-of-range index at
+    trace time); top_ps [b] f32 (>= 1.0 disables). Returns the
+    masked/scaled logits [b, V] (disallowed entries at -1e30).
+    """
+    V = logits.shape[-1]
+    logits = logits.astype(jnp.float32) / temps[:, None]
+    # top-k: keep the k largest (the kth value itself stays, ties keep)
+    k_eff = jnp.clip(jnp.where(top_ks > 0, top_ks, V), 1, V)
+    kth = jnp.take_along_axis(jnp.sort(logits, -1)[:, ::-1],
+                              (k_eff - 1)[:, None], -1)
+    logits = jnp.where(logits < kth, -1e30, logits)
+    # top-p nucleus over the post-top-k logits (matches the legacy
+    # sequential masking order bit for bit when both knobs are set)
+    sorted_l = jnp.sort(logits, -1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, -1)
+    cum = jnp.cumsum(probs, -1)
+    cutoff_idx = jnp.sum(cum < top_ps[:, None], -1)      # [b]
+    cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], -1)
+    apply_p = (top_ps < 1.0)[:, None]
+    return jnp.where(apply_p & (logits < cutoff), -1e30, logits)
+
+
+def sampling_probs(logits, temps, top_ks, top_ps):
+    """Per-row sampling DISTRIBUTION [b, V]: exactly the probabilities
+    ``sample_rows`` draws from. Greedy rows (temp <= 0) are a one-hot at
+    the argmax — which is what makes speculative decoding's rejection
+    rule degenerate to argmax-equality on greedy rows, so spec-on greedy
+    output is token-identical to spec-off (serving/spec_decode.py)."""
+    logits = logits.astype(jnp.float32)
+    greedy = jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1],
+                            dtype=jnp.float32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    probs = jax.nn.softmax(_masked_logits(logits, safe_t, top_ks, top_ps),
+                           -1)
+    return jnp.where((temps > 0)[:, None], probs, greedy)
+
+
+def request_keys(base_key, seeds, positions, tag):
+    """Per-request, per-position PRNG streams for in-graph sampling:
+    ``fold_in(fold_in(fold_in(base, seed), position), tag)`` per row.
+
+    Every random draw a request consumes is a pure function of its own
+    ``(seed, generation position, stream tag)`` — NOT of the engine-wide
+    key sequence — so a request's sampled tokens are bit-identical
+    regardless of what it is co-scheduled with, how its prompt was
+    chunked, or whether it was preempted and recomputed (recompute
+    replays the same positions). ``seeds``/``positions`` are [b] int32.
+    """
+    def one(s, g):
+        k = jax.random.fold_in(base_key, s)
+        k = jax.random.fold_in(k, g)
+        return jax.random.fold_in(k, tag)
+    return jax.vmap(one)(seeds, positions)
+
+
+def sample_rows(logits, keys, temps, top_ks, top_ps):
+    """Per-row sampling with per-row keys and knobs: greedy rows
+    (temp <= 0) take argmax (the parity path), sampling rows draw
+    categorically from their own masked logits under their own key."""
+    greedy = jnp.argmax(logits, -1)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    masked = _masked_logits(logits.astype(jnp.float32), safe_t, top_ks,
+                            top_ps)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked)
+    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
 def _sample(logits, key, temperature, top_k, top_p):
-    """logits [b, V] -> token ids [b]."""
+    """logits [b, V] -> token ids [b] (scalar-knob wrapper over the
+    per-row core; the Generator's host loop splits ``key`` itself).
+    The knobs are Python scalars here, so knob-off paths specialize at
+    trace time — plain temperature sampling pays no masking sorts."""
     if temperature == 0.0:
         return jnp.argmax(logits, -1)
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k is not None and top_k > 0:
-        kth = jnp.sort(logits, -1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p is not None and top_p < 1.0:
-        sorted_l = jnp.sort(logits, -1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_l, -1)
-        cum = jnp.cumsum(probs, -1)
-        cutoff_idx = jnp.sum(cum < top_p, -1)           # [b]
-        cutoff = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], -1)
-        logits = jnp.where(logits < cutoff, -1e30, logits)
-    return jax.random.categorical(key, logits, -1)
+    if (top_k is None or int(top_k) <= 0) and \
+            (top_p is None or float(top_p) >= 1.0):
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature, -1)
+    b = logits.shape[0]
+    temps = jnp.full((b,), float(temperature), jnp.float32)
+    ks = jnp.full((b,), 0 if top_k is None else int(top_k), jnp.int32)
+    ps = jnp.full((b,), 1.0 if top_p is None else float(top_p),
+                  jnp.float32)
+    return jax.random.categorical(
+        key, _masked_logits(logits, temps, ks, ps), -1)
 
 
 class Generator:
@@ -468,4 +547,5 @@ def generate(model, input_ids, max_len=512, **kwargs):
 
 
 __all__ = ["Generator", "generate", "extract_params",
-           "host_dispatch_count"]
+           "host_dispatch_count", "request_keys", "sample_rows",
+           "sampling_probs"]

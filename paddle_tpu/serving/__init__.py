@@ -14,19 +14,23 @@ Layers (docs/SERVING.md has the full architecture):
   prefix-hash cache that admits repeated prompt prefixes by forking
   pages instead of re-prefilling. ``RequestRejected`` is the structured
   admission error for unserviceable requests.
+- :mod:`spec_decode` — ``DraftWorker`` + ``speculative_sample``:
+  int4-draft speculative decoding with one-pass ragged verification
+  and exact rejection sampling (``LLMEngine(draft_model=...)``).
 - :mod:`metrics` — ``ServingMetrics``: counters/gauges exported to
   bench.py and the profiler timeline.
 """
 from .kv_cache import PagedKVPool, PoolExhausted, NULL_PAGE  # noqa: F401
 from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
                         Sequence, SequenceStatus, StepPlan, bucket_for)
+from .spec_decode import DraftWorker, speculative_sample  # noqa: F401
 from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
                      RequestRejected)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       percentile_of)
 
-__all__ = ["BurstPlan", "Histogram", "LLMEngine", "Request",
-           "RequestOutput", "RequestRejected", "PagedKVPool",
+__all__ = ["BurstPlan", "DraftWorker", "Histogram", "LLMEngine",
+           "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
            "PoolExhausted", "NULL_PAGE", "Scheduler", "SchedulerConfig",
            "Sequence", "SequenceStatus", "StepPlan", "ServingMetrics",
-           "bucket_for", "percentile_of"]
+           "bucket_for", "percentile_of", "speculative_sample"]

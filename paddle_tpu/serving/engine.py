@@ -32,15 +32,30 @@ copy-on-write page duplication. int8 pools share full pages only: an
 append can requantize a page in place (running-amax scale growth), which
 must never perturb another reader's view.
 
+Sampling: per-request knobs (temperature/top_k/top_p) travel as per-row
+data through the one step, and every random draw comes from a
+per-request ``fold_in(seed, generation position, tag)`` stream — a
+request's sampled tokens are bit-identical across batch compositions,
+chunking, preemption-recompute, and per-token vs burst execution.
+
+Speculative decoding: ``LLMEngine(draft_model=..., spec_tokens=k)``
+adds an int4 draft (serving/spec_decode.py) whose k proposals per
+decode row are verified in ONE launch of the same ragged executable
+(rows become q_len=k+1 prefill-shaped chunks); accepted tokens commit
+normally, rejected tails roll the KV length back without freeing pages.
+
 Greedy outputs are token-identical to sequential ``Generator.generate``:
 the ragged step computes each token's K/V and logits independently of how
 the work was chunked, so chunk boundaries, preemption-with-requeue
-(recompute mode) and prefix forks all reproduce the same continuation.
+(recompute mode) and prefix forks all reproduce the same continuation —
+with or without a draft model (rejection sampling degenerates to
+argmax-equality on greedy rows).
 """
 from __future__ import annotations
 
 import itertools
 import time
+import zlib
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -48,11 +63,13 @@ import jax
 import jax.numpy as jnp
 
 from ..models.generation import (_logits, _rms_norm, _rope, _wmat,
-                                 extract_params)
+                                 extract_params, request_keys, sample_rows)
 from ..kernels.paged_attention import ragged_paged_attention
-from .kv_cache import NULL_PAGE, PagedKVPool
+from .kv_cache import NULL_PAGE, PagedKVPool, PoolExhausted
 from .metrics import ServingMetrics
 from .scheduler import Scheduler, SchedulerConfig, Sequence, SequenceStatus
+from .spec_decode import (FINAL_TAG, _ragged_fp_layer, _ragged_packing,
+                          speculative_sample)
 
 
 class RequestRejected(ValueError):
@@ -78,6 +95,14 @@ class Request:
     prompt_token_ids: list
     max_new_tokens: int = 16
     temperature: float = 0.0
+    #: per-request sampling knobs: top-k (0/None = off), top-p nucleus
+    #: (None/1.0 = off), and the request's own PRNG seed — a fixed
+    #: (seed, prompt) reproduces the same sampled tokens bit for bit
+    #: regardless of batch composition (None derives a stable seed from
+    #: the request_id)
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int | None = None
     eos_token_id: int | None = None
     #: relative SLO in seconds: if the request is still *waiting* this long
     #: after submission, the scheduler sheds it instead of serving it late
@@ -98,16 +123,6 @@ class RequestOutput:
     @property
     def finished(self) -> bool:
         return self.status in ("finished", "shed", "cancelled", "aborted")
-
-
-def _sample_rows(logits, key, temps):
-    """Per-row sampling: temp<=0 rows take argmax (greedy, the parity
-    path), temp>0 rows sample categorically at their own temperature."""
-    greedy = jnp.argmax(logits, -1)
-    safe_t = jnp.where(temps > 0, temps, 1.0)
-    sampled = jax.random.categorical(
-        key, logits.astype(jnp.float32) / safe_t[:, None], -1)
-    return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
 
 
 def _quantized_append(Pp, Ps, tok, page_ids, off, page_size, live):
@@ -222,7 +237,9 @@ class LLMEngine:
                  high_watermark=0.90, low_watermark=0.50, seed=0,
                  stream_cb=None, now_fn=time.monotonic, interpret=None,
                  quantized_mode=None, kv_cache_dtype=None,
-                 burst_tokens=None):
+                 burst_tokens=None, draft_model=None, spec_tokens=None,
+                 draft_quantized_mode="weight_only_int4",
+                 draft_num_pages=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -233,6 +250,24 @@ class LLMEngine:
         if burst_tokens < 1:
             raise ValueError(f"burst_tokens must be >= 1, got "
                              f"{burst_tokens}")
+        # speculative decoding: active iff a draft model is given; the
+        # draft length comes from spec_tokens / FLAGS_spec_decode_tokens
+        # (a draft model with neither set gets a default of 4)
+        if spec_tokens is None:
+            from ..core.flags import GLOBAL_FLAGS
+            spec_tokens = int(GLOBAL_FLAGS.get("spec_decode_tokens"))
+            if draft_model is not None and spec_tokens < 1:
+                spec_tokens = 4
+        if spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if draft_model is None:
+            spec_tokens = 0
+        if spec_tokens > 0 and burst_tokens > 1:
+            raise ValueError(
+                "speculative decoding and the on-device burst loop are "
+                "mutually exclusive decode accelerations — set "
+                "burst_tokens=1 (the default) when passing draft_model")
+        self.spec_tokens = spec_tokens
         #: on-device generation burst length: when > 1 and every running
         #: row is a caught-up decode row, the engine dispatches ONE
         #: jitted lax.while_loop of up to this many sample->append->gate
@@ -262,6 +297,24 @@ class LLMEngine:
             chunk_size = min(64, max_len)
         chunk_size = min(chunk_size, max_len)
         self.chunk_size = chunk_size
+        if self.spec_tokens > 0:
+            # a speculative round packs spec_tokens+1 query tokens into
+            # EVERY row slot; the fixed-shape budget must hold that for
+            # a full house of rows (spec_len never shrinks under
+            # pressure — that would change which stream positions get
+            # drafted and break per-request bit-reproducibility)
+            need = max_num_seqs * (-(-(self.spec_tokens + 1) // q_block)
+                                   * q_block)
+            if step_token_budget is None:
+                default = max_num_seqs * q_block + \
+                    -(-chunk_size // q_block) * q_block
+                step_token_budget = max(default, need)
+            elif step_token_budget < need:
+                raise ValueError(
+                    f"step_token_budget {step_token_budget} cannot hold "
+                    f"a speculative round: max_num_seqs {max_num_seqs} x "
+                    f"(spec_tokens {self.spec_tokens} + 1) needs {need} "
+                    f"packed query tokens")
         if num_pages is None:
             # default: every row slot can hold a max_len sequence, so
             # preemption never fires unless the operator shrinks the pool
@@ -299,7 +352,32 @@ class LLMEngine:
         self._interpret = interpret
         self._now = now_fn
         self._stream_cb = stream_cb
-        self._key = jax.random.key(seed)
+        #: every sampling draw is a per-request stream folded off this
+        #: one base key (models/generation.request_keys) — the engine
+        #: never consumes shared key state, so batch composition cannot
+        #: perturb any request's draws
+        self._base_key = jax.random.key(seed)
+        self._draft = None
+        if self.spec_tokens > 0:
+            from .spec_decode import DraftWorker
+            if draft_num_pages is None:
+                # the draft holds every running row's FULL context with
+                # no prefix sharing and no preemption of its own — size
+                # it for the no-sharing worst case, independent of how
+                # starved the operator made the target pool (draft pages
+                # are small-model bytes; explicit draft_num_pages
+                # overrides)
+                draft_num_pages = \
+                    self.max_num_seqs * self.max_pages_per_seq + 1
+            self._draft = DraftWorker(
+                draft_model, target_cfg=cfg, page_size=page_size,
+                max_num_seqs=self.max_num_seqs,
+                max_pages_per_seq=self.max_pages_per_seq,
+                num_pages=draft_num_pages,
+                step_token_budget=self.step_token_budget,
+                q_block=self.q_block, chunk_size=self.chunk_size,
+                seed=seed, quantized_mode=draft_quantized_mode,
+                interpret=interpret if self._interpret_explicit else None)
         self._ids = itertools.count()
         self._seqs: dict[str, Sequence] = {}
         self._outputs: dict[str, RequestOutput] = {}
@@ -330,7 +408,10 @@ class LLMEngine:
         T = self.step_token_budget
         R = self.max_num_seqs
         PPS = self.max_pages_per_seq
-        chunk_cap = self.chunk_size
+        # a speculative row appends spec_tokens+1 tokens in one round:
+        # the segmented int8 append's touched-page bound must cover it
+        chunk_cap = max(self.chunk_size, self.spec_tokens + 1)
+        K = self.spec_tokens
         interpret = self._interpret
         # the megakernel's mode: an explicit LLMEngine(interpret=...)
         # pins it (both launch paths then obey one knob); None stays
@@ -342,19 +423,35 @@ class LLMEngine:
                      cfg.head_dim)
 
         def ragged_step(params, kv, kv_scales, tokens, positions, tbls,
-                        q_starts, q_lens, kv_lens, last_idx, temps, key):
+                        q_starts, q_lens, kv_lens, sample_idx, temps,
+                        top_ks, top_ps, seeds, sample_pos, spec_lens,
+                        draft_tokens, draft_probs, base_key):
             # tokens/positions [T] packed row-wise (pad rows: q_len=0,
             # q_start=T); tbls [R, PPS]; kv_lens = committed + q_len per
             # row (the attention length AFTER this step's appends);
-            # last_idx [R] flat index of each row's last live token.
-            tok_row = (jnp.searchsorted(q_starts,
-                                        jnp.arange(T, dtype=jnp.int32),
-                                        side="right") - 1)
-            tok_row = jnp.maximum(tok_row, 0)
-            live = (jnp.arange(T) - q_starts[tok_row]) < q_lens[tok_row]
+            # sample_idx [R, K+1] flat indices of each row's verify
+            # positions (ordinary rows: K+1 copies of the last live
+            # token). Sampling is fully in-graph: per-row knobs
+            # (temps/top_ks/top_ps), per-request PRNG streams
+            # (seeds/sample_pos off base_key), and — on speculative
+            # rounds — the rejection sampler over the draft's candidates
+            # (spec_lens/draft_tokens/draft_probs; all-zero on ordinary
+            # rounds, where the sampler degenerates to one direct draw
+            # from the last position's distribution).
+            tok_row, live = _ragged_packing(q_starts, q_lens, T)
             h = params["embed"][tokens][None]               # [1, T, hid]
             new_kv, new_scales = [], []
             for li, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
+                if not quant_pool:
+                    # the shared fp layer body (spec_decode), which the
+                    # draft worker also runs — draft/target numerics
+                    # come from ONE definition
+                    h, Kp, Vp = _ragged_fp_layer(
+                        lyr, h, Kp, Vp, positions, tbls, tok_row, live,
+                        q_starts, q_lens, kv_lens, cfg, ps, PPS, qb,
+                        interpret)
+                    new_kv.append((Kp, Vp))
+                    continue
                 x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
                 q = _wmat(x, lyr["q"]).reshape(1, T, H, d)
                 k = _wmat(x, lyr["k"]).reshape(1, T, Hkv, d)
@@ -363,39 +460,28 @@ class LLMEngine:
                 k = _rope(k, positions[None], cfg.rope_theta, d)
                 kt = jnp.transpose(k[0], (1, 0, 2))         # [Hkv, T, d]
                 vt = jnp.transpose(v[0], (1, 0, 2))
-                if quant_pool:
-                    Ks, Vs = kv_scales[li]
-                    Kp, Ks, Vp, Vs = _append_quant(
-                        Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
-                        kv_lens)
-                    new_scales.append((Ks, Vs))
-                else:
-                    # scatter every live token's K/V into its page slot;
-                    # dead tokens (slot padding / pad rows) land on the
-                    # null page, never on live data
-                    page_idx = jnp.clip(positions // ps, 0, PPS - 1)
-                    page = jnp.where(live, tbls[tok_row, page_idx],
-                                     NULL_PAGE)
-                    slot = page * ps + positions % ps
-                    npages = Kp.shape[1]
-                    Kp = Kp.reshape(Hkv, npages * ps, d).at[:, slot] \
-                        .set(kt).reshape(Hkv, npages, ps, d)
-                    Vp = Vp.reshape(Hkv, npages * ps, d).at[:, slot] \
-                        .set(vt).reshape(Hkv, npages, ps, d)
+                Ks, Vs = kv_scales[li]
+                Kp, Ks, Vp, Vs = _append_quant(
+                    Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
+                    kv_lens)
+                new_scales.append((Ks, Vs))
                 new_kv.append((Kp, Vp))
                 o = ragged_paged_attention(
                     q[0], Kp, Vp, tbls, q_starts, q_lens, kv_lens,
                     q_block=qb, interpret=interpret,
-                    k_scales=new_scales[-1][0] if quant_pool else None,
-                    v_scales=new_scales[-1][1] if quant_pool else None)
+                    k_scales=Ks, v_scales=Vs)
                 h = h + _wmat(o.reshape(1, T, H * d), lyr["o"])
                 x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
                 h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"]))
                               * _wmat(x, lyr["up"]), lyr["down"])
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
-            last = h[0, last_idx]                           # [R, hid]
-            logits = _logits(params, last, cfg)             # [R, V]
-            return (_sample_rows(logits, key, temps), new_kv,
+            verify = h[0, sample_idx.reshape(-1)]       # [R*(K+1), hid]
+            logits = _logits(params, verify, cfg) \
+                .reshape(R, K + 1, -1)                  # [R, K+1, V]
+            out, n_out = speculative_sample(
+                logits, draft_tokens, draft_probs, spec_lens, temps,
+                top_ks, top_ps, base_key, seeds, sample_pos)
+            return (out, n_out, new_kv,
                     new_scales if quant_pool else None)
 
         def _append_quant(Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
@@ -412,7 +498,8 @@ class LLMEngine:
             return Kp, Ks, Vp, Vs
 
         def burst_step(params, kv, kv_scales, tokens, kv_lens, tbls,
-                       live0, caps, temps, eos_ids, n_steps, key):
+                       live0, caps, temps, top_ks, top_ps, seeds, gpos0,
+                       eos_ids, n_steps, base_key):
             # the on-device token loop (decode megakernel mode): up to
             # burst_tokens sample -> KV append -> EOS/length gate
             # iterations inside ONE executable. Every row is a
@@ -420,7 +507,10 @@ class LLMEngine:
             # scales, and the per-row live mask all ride the loop
             # carry. n_steps (traced) bounds the trip count so every
             # burst size reuses the same compilation; eos_ids < 0 means
-            # "no eos" for that row.
+            # "no eos" for that row. Sampling draws come from the same
+            # per-request (seed, generation position) streams as the
+            # per-token path — a request's sampled tokens are identical
+            # whether it was served per-token or in bursts.
             from ..kernels.decode_megakernel import fused_decode_layer
             R = self.max_num_seqs
             B = self.burst_tokens
@@ -435,8 +525,7 @@ class LLMEngine:
                 return (i < n_steps) & jnp.any(live)
 
             def body(c):
-                i, tokens, kv, kv_scales, kv_lens, live, gen, out, key = c
-                key, sub = jax.random.split(key)
+                i, tokens, kv, kv_scales, kv_lens, live, gen, out = c
                 h = params["embed"][tokens]                  # [R, hid]
                 pos = kv_lens                                # append slot
                 page_idx = jnp.clip(pos // ps, 0, PPS - 1)
@@ -496,7 +585,9 @@ class LLMEngine:
                 hn = _rms_norm(h[None], params["norm"],
                                cfg.rms_norm_eps)[0]
                 logits = _logits(params, hn, cfg)            # [R, V]
-                nxt = _sample_rows(logits, sub, temps)
+                keys = request_keys(base_key, seeds, gpos0 + gen,
+                                    FINAL_TAG)
+                nxt = sample_rows(logits, keys, temps, top_ks, top_ps)
                 out = out.at[:, i].set(jnp.where(live, nxt, 0))
                 gen = gen + live.astype(jnp.int32)
                 hit_eos = live & (eos_ids >= 0) & (nxt == eos_ids)
@@ -505,10 +596,10 @@ class LLMEngine:
                 tokens = jnp.where(live_in, nxt, tokens)
                 return (i + 1, tokens, new_kv,
                         tuple(new_scales) if quant_pool else kv_scales,
-                        kv_lens, live, gen, out, key)
+                        kv_lens, live, gen, out)
 
             init = (jnp.asarray(0, jnp.int32), tokens, kv,
-                    tuple(kv_scales), kv_lens, live0, gen0, out0, key)
+                    tuple(kv_scales), kv_lens, live0, gen0, out0)
             c = jax.lax.while_loop(cond, body, init)
             return (c[7], c[6], c[2],
                     list(c[3]) if quant_pool else None)
@@ -520,14 +611,29 @@ class LLMEngine:
         donate = (1, 2) if _on_tpu() else ()
         self._ragged_jit = jax.jit(ragged_step, donate_argnums=donate)
         self._burst_jit = jax.jit(burst_step, donate_argnums=donate)
+        # ordinary rounds of a spec-enabled engine still feed the fixed
+        # (R, K[, V]) draft operands — build the all-zero versions ONCE
+        # instead of allocating + shipping R*K*V float zeros per step
+        self._zero_draft = (
+            jnp.zeros((self.max_num_seqs, K), jnp.int32),
+            jnp.zeros((self.max_num_seqs, K, cfg.vocab_size),
+                      jnp.float32))
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def add_request(self, prompt_token_ids, *, max_new_tokens=16,
-                    temperature=0.0, eos_token_id=None, deadline_s=None,
-                    request_id=None):
+                    temperature=0.0, top_k=None, top_p=None, seed=None,
+                    eos_token_id=None, deadline_s=None, request_id=None):
         """Queue a request; returns its id. Accepts a Request too.
+
+        ``top_k``/``top_p``/``seed`` are per-request sampling state: the
+        knobs travel as per-row DATA through the one jitted step, and
+        every random draw the request consumes is a pure function of
+        ``(seed, generation position)`` — so a fixed (seed, prompt)
+        reproduces the same sampled tokens bit for bit regardless of
+        what it is co-scheduled with. ``seed=None`` derives a stable
+        seed from the request_id.
 
         An unserviceable request (prompt + max_new_tokens over max_len or
         over the pool's page limit) raises :class:`RequestRejected` AFTER
@@ -538,13 +644,18 @@ class LLMEngine:
             r = prompt_token_ids
             return self.add_request(
                 r.prompt_token_ids, max_new_tokens=r.max_new_tokens,
-                temperature=r.temperature, eos_token_id=r.eos_token_id,
+                temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+                seed=r.seed, eos_token_id=r.eos_token_id,
                 deadline_s=r.deadline_s, request_id=r.request_id)
         prompt = [int(t) for t in np.asarray(prompt_token_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if top_k is not None and int(top_k) < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         rid = request_id or f"req-{next(self._ids)}"
         if rid in self._seqs or rid in self._outputs:
             raise KeyError(f"duplicate request_id {rid!r}")
@@ -568,7 +679,14 @@ class LLMEngine:
             seq_id=rid, prompt_ids=prompt, max_new_tokens=max_new_tokens,
             arrival=now,
             deadline=None if deadline_s is None else now + deadline_s,
-            temperature=temperature, eos_token_id=eos_token_id)
+            temperature=temperature,
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p),
+            # seeds ride an int32 operand array: mask wide seeds into
+            # range instead of blowing up the serving loop at launch
+            seed=((int(seed) & 0x7FFFFFFF) if seed is not None
+                  else zlib.crc32(str(rid).encode("utf-8")) & 0x7FFFFFFF),
+            eos_token_id=eos_token_id)
         self.scheduler.add(seq)
         self._seqs[rid] = seq
         self._outputs[rid] = RequestOutput(rid, prompt)
@@ -620,6 +738,17 @@ class LLMEngine:
         tok = snap["tokens_generated"]
         snap["host_dispatches_per_token"] = \
             snap["host_dispatches"] / tok if tok else None
+        # speculative-decoding forensics: target launches per committed
+        # token is the headline win (< 1.0 iff speculation pays), draft
+        # trace count mirrors the engine's one-executable discipline
+        snap["spec_tokens"] = self.spec_tokens
+        snap["target_steps_per_token"] = \
+            snap["decode_steps"] / tok if tok else None
+        snap["draft_launches"] = \
+            self._draft.launches if self._draft is not None else None
+        snap["draft_decode_compiles"] = \
+            self._draft.decode_cache_size() if self._draft is not None \
+            else None
         return snap
 
     def decode_cache_size(self):
@@ -650,17 +779,43 @@ class LLMEngine:
             touched[seq.seq_id] = self._sync_output(seq)
         plan = None
         bplan = None
+        splan = None
         preempted = []
-        if self.burst_tokens > 1:
+        if self._draft is not None:
+            # speculative round: eligible only when every running row is
+            # a caught-up decode row (prompt chunks go through the
+            # ordinary ragged path; the draft catches up lazily)
+            splan = self.scheduler.prepare_spec(self.spec_tokens)
+            preempted += self.scheduler.last_preempted
+        if splan is None and self.burst_tokens > 1:
             bplan = self.scheduler.prepare_burst(self.burst_tokens)
             preempted += self.scheduler.last_preempted
-        if bplan is None:
+        if splan is None and bplan is None:
             plan = self.scheduler.prepare_step()
             preempted += self.scheduler.last_preempted
         for t in preempted:
+            if self._draft is not None:
+                self._draft.drop(t.seq_id)  # recompute re-syncs from 0
             self._sync_output(t)           # surface fresh preemptions once
             touched[t.seq_id] = self._outputs[t.seq_id]
-        if bplan is not None:
+        if splan is not None:
+            if splan.cow_copies:
+                self.metrics.cow_copies.inc(splan.cow_copies)
+            if self._launch_spec(splan, touched):
+                self.metrics.decode_steps.inc()
+                self.metrics.ragged_pad_fraction.set(splan.pad_fraction)
+            else:
+                # the DRAFT pool could not serve the round (operator
+                # under-sized draft_num_pages): speculation is demoted
+                # to an ordinary decode round — target pressure
+                # preempts, draft pressure must never kill the loop
+                splan = None
+                plan = self.scheduler.prepare_step()
+                for t in self.scheduler.last_preempted:
+                    self._draft.drop(t.seq_id)
+                    self._sync_output(t)
+                    touched[t.seq_id] = self._outputs[t.seq_id]
+        if splan is None and bplan is not None:
             if bplan.cow_copies:
                 self.metrics.cow_copies.inc(bplan.cow_copies)
             self._launch_burst(bplan, touched)
@@ -672,7 +827,7 @@ class LLMEngine:
         elif plan is not None:
             if plan.cow_copies:
                 self.metrics.cow_copies.inc(plan.cow_copies)
-            sampled = self._launch(plan)
+            sampled, _ = self._launch(plan)
             for i, (seq, q_start, q_len) in enumerate(plan.rows):
                 before = seq.cached_len
                 seq.cached_len += q_len
@@ -688,7 +843,7 @@ class LLMEngine:
                 if seq.cached_len == seq.total_len:
                     # the row is caught up: its sampled token is the next
                     # generated token. Mid-prompt chunks discard theirs.
-                    self._commit_token(seq, int(sampled[i]))
+                    self._commit_token(seq, int(sampled[i, 0]))
                 touched[seq.seq_id] = self._outputs[seq.seq_id]
             self.metrics.decode_steps.inc()
             self.metrics.ragged_pad_fraction.set(plan.pad_fraction)
@@ -813,14 +968,13 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # internals
     # ------------------------------------------------------------------
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
-
-    def _launch(self, plan):
+    def _launch(self, plan, draft_tokens=None, draft_probs=None):
         """Assemble the fixed-shape operands for the plan and run the one
-        ragged-step executable."""
+        ragged-step executable. Returns ``(out [R, K+1], n_out [R])`` —
+        ordinary rounds commit ``out[i, 0]`` (n_out is 1), speculative
+        rounds commit ``out[i, :n_out[i]]``."""
         T, R, PPS = plan.token_budget, plan.num_slots, self.max_pages_per_seq
+        K = self.spec_tokens
         self.metrics.host_dispatches.inc()
         if not self._step_launched:
             self._step_launched = True
@@ -831,29 +985,123 @@ class LLMEngine:
         q_starts = np.full((R,), T, np.int32)   # pad rows: start past T
         q_lens = np.zeros((R,), np.int32)
         kv_lens = np.zeros((R,), np.int32)
-        last_idx = np.zeros((R,), np.int32)
+        sample_idx = np.zeros((R, K + 1), np.int32)
         temps = np.zeros((R,), np.float32)
+        top_ks = np.zeros((R,), np.int32)
+        top_ps = np.ones((R,), np.float32)
+        seeds = np.zeros((R,), np.int32)
+        sample_pos = np.zeros((R,), np.int32)
+        spec_lens = np.zeros((R,), np.int32)
+        if draft_tokens is None:
+            # ordinary round: the prebuilt zero operands (never indexed
+            # below — every row has spec == 0)
+            draft_tokens, draft_probs = self._zero_draft
+        specs = plan.spec_lens
         for i, (seq, q_start, q_len) in enumerate(plan.rows):
             ids = seq.all_ids
             lo = seq.cached_len
-            tokens[q_start:q_start + q_len] = ids[lo:lo + q_len]
+            spec = specs[i] if specs is not None else 0
+            if spec > 0:
+                # verification chunk: the row's one uncached token plus
+                # its draft candidates (not part of all_ids yet)
+                row_toks = [ids[lo]] + [int(t) for t in
+                                        draft_tokens[i, :spec]]
+            else:
+                row_toks = ids[lo:lo + q_len]
+            tokens[q_start:q_start + q_len] = row_toks
             positions[q_start:q_start + q_len] = np.arange(lo, lo + q_len)
             tbls[i] = self.pool.padded_block_table(seq.seq_id, PPS)
             q_starts[i] = q_start
             q_lens[i] = q_len
             kv_lens[i] = lo + q_len
-            last_idx[i] = q_start + q_len - 1
+            last = q_start + q_len - 1
+            sample_idx[i] = np.clip(last - spec + np.arange(K + 1),
+                                    0, last)
             temps[i] = seq.temperature
-        sampled, new_kv, new_scales = self._ragged_jit(
+            top_ks[i] = seq.top_k or 0
+            top_ps[i] = 1.0 if seq.top_p is None else seq.top_p
+            seeds[i] = seq.seed
+            sample_pos[i] = len(seq.tokens)
+            spec_lens[i] = spec
+        out, n_out, new_kv, new_scales = self._ragged_jit(
             self.params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
-            jnp.asarray(kv_lens), jnp.asarray(last_idx),
-            jnp.asarray(temps), self._next_key())
+            jnp.asarray(kv_lens), jnp.asarray(sample_idx),
+            jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds), jnp.asarray(sample_pos),
+            jnp.asarray(spec_lens), jnp.asarray(draft_tokens),
+            jnp.asarray(draft_probs), self._base_key)
         self.pool.kv = new_kv
         if new_scales is not None:
             self.pool.kv_scales = new_scales
-        return np.asarray(sampled)
+        return np.asarray(out), np.asarray(n_out)
+
+    def _launch_spec(self, plan, touched):
+        """One speculative round: draft sync + k proposal steps, then
+        ONE target launch verifying every row's k+1 positions through
+        the ordinary ragged executable. Accepted tokens commit through
+        the normal path (streaming, eos/length finalization); the
+        rejected tail rolls the pool's committed length back WITHOUT
+        freeing pages (the slots are garbage the next append
+        overwrites), and the draft pool rolls back the same way."""
+        K = self.spec_tokens
+        R = self.max_num_seqs
+        seqs = [seq for seq, _, _ in plan.rows]
+        spec_lens = plan.spec_lens
+        try:
+            self._draft.sync(seqs)
+            d_toks, d_probs = self._draft.propose(seqs, spec_lens, K)
+        except PoolExhausted:
+            # the draft pool cannot hold this round: forget every draft
+            # allocation (they re-sync from scratch when pressure
+            # clears), roll the target pool's speculative page claims
+            # back to the committed lengths (pages stay owned), and
+            # tell step() to run an ordinary round instead
+            for s in seqs:
+                self._draft.drop(s.seq_id)
+            for seq, _, _ in plan.rows:
+                self.pool.rollback(seq.seq_id, seq.cached_len)
+            self.metrics.spec_draft_fallbacks.inc()
+            return False
+        # d_toks are host-side (the verifier packs them into its query
+        # buffer); d_probs is already the [R, K, V] DEVICE operand
+        draft_tokens = np.zeros((R, K), np.int32)
+        draft_tokens[:len(seqs)] = d_toks
+        out, n_out = self._launch(plan, draft_tokens, d_probs)
+        drafted = accepted = rollbacks = 0
+        for i, (seq, _q_start, _q_len) in enumerate(plan.rows):
+            spec = spec_lens[i]
+            cached_old = seq.cached_len
+            n = int(n_out[i])            # 1..spec+1 tokens to commit
+            drafted += spec
+            accepted += n - 1
+            if n - 1 < spec:
+                rollbacks += 1
+            committed = 0
+            for j in range(n):
+                committed += 1
+                self._commit_token(seq, int(out[i, j]))
+                if seq.status is not SequenceStatus.RUNNING:
+                    break                # eos/length finalized mid-chain
+            if seq.status is SequenceStatus.RUNNING:
+                seq.cached_len = cached_old + committed
+                self.pool.rollback(seq.seq_id, seq.cached_len)
+                self._draft.commit(seq.seq_id, cached_old,
+                                   committed - 1, spec)
+            touched[seq.seq_id] = self._outputs[seq.seq_id]
+        m = self.metrics
+        m.spec_rounds.inc()
+        if drafted:
+            m.spec_drafted_tokens.inc(drafted)
+        if accepted:
+            m.spec_accepted_tokens.inc(accepted)
+        if rollbacks:
+            m.spec_rollbacks.inc(rollbacks)
+        if m.spec_drafted_tokens.value:
+            m.spec_accept_rate.set(m.spec_accepted_tokens.value
+                                   / m.spec_drafted_tokens.value)
+        return True
 
     def _launch_burst(self, bplan, touched):
         """Assemble the fixed-shape burst operands and run the
@@ -869,6 +1117,10 @@ class LLMEngine:
         live = np.zeros((R,), bool)
         caps = np.zeros((R,), np.int32)
         temps = np.zeros((R,), np.float32)
+        top_ks = np.zeros((R,), np.int32)
+        top_ps = np.ones((R,), np.float32)
+        seeds = np.zeros((R,), np.int32)
+        gpos = np.zeros((R,), np.int32)
         eos_ids = np.full((R,), -1, np.int32)
         for i, (seq, cap) in enumerate(bplan.rows):
             tokens[i] = seq.all_ids[-1]
@@ -877,6 +1129,10 @@ class LLMEngine:
             live[i] = True
             caps[i] = cap
             temps[i] = seq.temperature
+            top_ks[i] = seq.top_k or 0
+            top_ps[i] = 1.0 if seq.top_p is None else seq.top_p
+            seeds[i] = seq.seed
+            gpos[i] = len(seq.tokens)
             if seq.eos_token_id is not None:
                 eos_ids[i] = seq.eos_token_id
         self.metrics.host_dispatches.inc()
@@ -890,8 +1146,9 @@ class LLMEngine:
             self.params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(kv_lens), jnp.asarray(tbls),
             jnp.asarray(live), jnp.asarray(caps), jnp.asarray(temps),
-            jnp.asarray(eos_ids), jnp.asarray(bplan.burst_len, jnp.int32),
-            self._next_key())
+            jnp.asarray(top_ks), jnp.asarray(top_ps), jnp.asarray(seeds),
+            jnp.asarray(gpos), jnp.asarray(eos_ids),
+            jnp.asarray(bplan.burst_len, jnp.int32), self._base_key)
         self.pool.kv = new_kv
         if new_scales is not None:
             self.pool.kv_scales = new_scales
@@ -926,6 +1183,8 @@ class LLMEngine:
         return out
 
     def _finalize(self, seq: Sequence, status: str, reason=None):
+        if self._draft is not None:
+            self._draft.drop(seq.seq_id)
         self.scheduler.finish(seq, {
             "finished": SequenceStatus.FINISHED,
             "shed": SequenceStatus.SHED,

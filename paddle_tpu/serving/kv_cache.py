@@ -511,6 +511,22 @@ class PagedKVPool:
                 f"owned by {seq_id!r}; call extend() first")
         self._lens[seq_id] = n
 
+    def rollback(self, seq_id, new_len: int):
+        """Shrink a sequence's committed length after a speculative
+        over-append (serving/spec_decode.py): the pages stay OWNED — the
+        rejected tail's K/V slots are garbage the next append simply
+        overwrites, and attention never reads past the committed length
+        — only the attention/append cursor moves back. Freeing the tail
+        pages instead would churn the allocator every rejected round for
+        pages the sequence is about to grow back into."""
+        cur = self._lens[seq_id]
+        if new_len > cur:
+            raise ValueError(
+                f"rollback cannot grow {seq_id!r}: {cur} -> {new_len}")
+        if new_len < 0:
+            raise ValueError(f"negative rollback length {new_len}")
+        self._lens[seq_id] = new_len
+
     def padded_block_table(self, seq_id, pages: int) -> list[int]:
         """Block table padded with NULL_PAGE to a fixed launch width."""
         table = self._tables[seq_id]

@@ -134,10 +134,24 @@ class ServingMetrics:
                 # issued (the dispatch gate's numerator), on-device
                 # generation bursts, and prefix-cache hits served by a
                 # PINNED chain after its last sequence sharer left
-                "host_dispatches", "burst_launches", "pinned_prefix_hits")
+                "host_dispatches", "burst_launches", "pinned_prefix_hits",
+                # speculative decoding (serving/spec_decode.py): draft
+                # candidates offered for verification, candidates the
+                # rejection sampler accepted, verification rounds that
+                # rolled a KV tail back (>= 1 candidate rejected), and
+                # spec rounds run
+                "spec_drafted_tokens", "spec_accepted_tokens",
+                "spec_rollbacks", "spec_rounds",
+                # rounds demoted to ordinary decode because the DRAFT
+                # pool could not hold them (under-sized draft_num_pages)
+                "spec_draft_fallbacks")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",
+              # lifetime draft acceptance rate (accepted / drafted) —
+              # the headline spec-decoding health signal: target steps
+              # per committed token ~= 1 / (1 + accept_rate * k)
+              "spec_accept_rate",
               # starvation observability: age of the oldest / p99 waiting
               # request (seconds since it was (re-)enqueued, scheduler
               # now_fn time base) — a climbing max_queue_wait_s under

@@ -85,6 +85,14 @@ class Sequence:
     arrival: float
     deadline: float | None = None
     temperature: float = 0.0
+    #: per-request sampling knobs (None/0 = off, engine passes them as
+    #: per-row data into the one jitted step — knobs are data, not shape)
+    top_k: int | None = None
+    top_p: float | None = None
+    #: resolved per-request PRNG seed: every random draw this request
+    #: consumes is fold_in(base, seed, generation position, tag), so its
+    #: sampled tokens are bit-identical across batch compositions
+    seed: int = 0
     eos_token_id: int | None = None
     tokens: list = field(default_factory=list)      # generated so far
     status: SequenceStatus = SequenceStatus.WAITING
@@ -142,6 +150,10 @@ class StepPlan:
     num_slots: int             # fixed row-slot count (max_num_seqs)
     token_budget: int          # fixed packed-query length
     cow_copies: int = 0        # copy-on-write page dups this step
+    #: speculative rounds only (prepare_spec): per-row draft candidate
+    #: count, aligned with ``rows`` (q_len = spec_len + 1); None on
+    #: ordinary decode/prefill rounds
+    spec_lens: list | None = None
 
     @property
     def actual_q_tokens(self) -> int:
@@ -415,6 +427,67 @@ class Scheduler:
             return None
         return BurstPlan(rows, burst_len=max(cap for _, cap in rows),
                          cow_copies=cow)
+
+    def prepare_spec(self, k: int) -> StepPlan | None:
+        """Plan a speculative-verification round, or None when ineligible.
+
+        Eligible only when EVERY running sequence is a caught-up decode
+        row (like :meth:`prepare_burst`): each row gets ``q_len =
+        spec_len + 1`` query tokens — its one uncached token plus
+        ``spec_len = min(k, remaining - 1)`` draft candidates — so the
+        whole round is one prefill-shaped launch of the SAME ragged
+        executable. Pages are claimed (and CoW'd) for the full ``k+1``
+        appends up front; the engine rolls the committed length back to
+        what verification actually accepted.
+
+        ``spec_len`` deliberately depends ONLY on the request's own
+        state (k and remaining_new_tokens), never on pool pressure or
+        co-scheduling — shrinking it under pressure would change which
+        PRNG stream positions get drafted vs directly sampled and break
+        the bit-reproducibility contract. Pressure is answered the
+        per-step way: preempt latest arrivals (recompute replays the
+        same streams)."""
+        self.last_preempted = []
+        if k < 1 or not self.running:
+            return None
+        for s in self.running:
+            if s.uncached_len != 1 or s.cached_len < len(s.prompt_ids):
+                return None
+        cfg = self.config
+        qb = cfg.q_block
+        rows, cow = [], 0
+        for seq in list(self.running):
+            if seq.status is not SequenceStatus.RUNNING:
+                continue                  # preempted by an earlier row
+            spec = min(k, seq.remaining_new_tokens - 1)
+            while True:
+                try:
+                    cow += self.pool.prepare_append(
+                        seq.seq_id, seq.cached_len + spec + 1)
+                    break
+                except PoolExhausted:
+                    victim = self._pick_victim(exclude=seq)
+                    if victim is None:
+                        self.preempt(seq)
+                        break
+                    self.preempt(victim)
+            if seq.status is SequenceStatus.RUNNING:
+                rows.append((seq, spec))
+        rows = [(s, c) for s, c in rows
+                if s.status is SequenceStatus.RUNNING]
+        if not rows:
+            return None
+        plan_rows, spec_lens, cursor = [], [], 0
+        for seq, spec in rows:
+            plan_rows.append((seq, cursor, spec + 1))
+            spec_lens.append(spec)
+            cursor += -(-(spec + 1) // qb) * qb
+        assert cursor <= cfg.step_token_budget, \
+            "spec round overflows the step token budget (engine init " \
+            "must size the budget for max_num_seqs x (k+1))"
+        return StepPlan(plan_rows, num_slots=self.max_num_seqs,
+                        token_budget=cfg.step_token_budget,
+                        cow_copies=cow, spec_lens=spec_lens)
 
     def prepare_step(self) -> StepPlan | None:
         """Grant each running sequence its step-token share, grow/CoW its

@@ -86,7 +86,11 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # failed run did not observe — and never copy one from
               # tools/bench_lastgood.json
               "serving_ttft_p50_ms", "serving_ttft_p99_ms",
-              "serving_tpot_p50_ms"):
+              "serving_tpot_p50_ms",
+              # speculative-decoding fields (PR 9): acceptance rate and
+              # launches-per-token are per-run measurements
+              "spec_target_steps_per_token", "spec_accept_rate",
+              "spec_decode_compiles"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -239,7 +243,7 @@ def test_proxy_bench_compare_exit_status(monkeypatch, capsys, tmp_path):
 
     parity = copy.deepcopy(base)
     monkeypatch.setattr(pb, "collect",
-                        lambda probes=pb.PROBES, burst_tokens=8: parity)
+                        lambda probes=pb.PROBES, **kw: parity)
     assert pb.main(["--compare", pb.BASELINE_PATH]) == 0
     out = capsys.readouterr().out
     assert "PASS" in out
@@ -249,7 +253,7 @@ def test_proxy_bench_compare_exit_status(monkeypatch, capsys, tmp_path):
     # dispatch per token (exactly what forcing the per-token path does)
     regressed["metrics"]["host_dispatches_per_token"] = 1.0
     monkeypatch.setattr(pb, "collect",
-                        lambda probes=pb.PROBES, burst_tokens=8: regressed)
+                        lambda probes=pb.PROBES, **kw: regressed)
     assert pb.main(["--compare", pb.BASELINE_PATH]) == 1
     captured = capsys.readouterr()
     assert "host_dispatches_per_token" in captured.err
@@ -284,7 +288,7 @@ def test_proxy_bench_compare_exit_status(monkeypatch, capsys, tmp_path):
     broken["metrics"]["host_dispatches_per_token"] = None
     broken["probe_errors"] = {"serving_probe_error": "boom"}
     monkeypatch.setattr(pb, "collect",
-                        lambda probes=pb.PROBES, burst_tokens=8: broken)
+                        lambda probes=pb.PROBES, **kw: broken)
     assert pb.main(["--record"]) == 2
     assert "refusing to record" in capsys.readouterr().err
 
@@ -340,3 +344,45 @@ def test_serving_probe_records_ragged_and_prefix_fields():
     assert out["host_dispatches_per_token"] < 0.8, out
     assert out["megakernel_mode"] in ("pallas", "interpret", "jnp")
     assert out["burst_tokens_per_s"] > 0.0
+
+
+def test_proxy_bench_catches_disabled_speculation():
+    """End-to-end spec regression injection: run the spec probe with the
+    draft DISABLED (spec_tokens=0) and gate against the checked-in
+    baseline — target launches per committed token rise to exactly 1.0
+    and acceptance collapses, both past their bounds; the healthy
+    collection of the same probe must pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("spec",), spec_tokens=0)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "spec_target_steps_per_token" in names
+    assert "spec_accept_rate" in names
+    assert bad["metrics"]["spec_target_steps_per_token"] == 1.0
+
+    good = pb.collect(probes=("spec",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["spec_target_steps_per_token"] < 1.0
+    assert good["metrics"]["spec_decode_compiles"] == 1
+
+
+def test_spec_probe_never_fabricates_on_failure(monkeypatch):
+    """A broken spec probe reports nulls plus an error field — never a
+    fabricated acceptance rate."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_spec_decode(Boom())
+    assert out["spec_target_steps_per_token"] is None
+    assert out["spec_accept_rate"] is None
+    assert out["spec_decode_compiles"] is None
+    assert "spec_decode_probe_error" in out

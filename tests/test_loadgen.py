@@ -102,6 +102,59 @@ def test_spec_validation():
     assert all(len(r.prompt_token_ids) <= 8 for r in spec.compile())
 
 
+def test_sampling_knob_ranges_compile_into_trace_and_fingerprint():
+    """WorkloadSpec top_k/top_p/per_request_seed ranges land on every
+    TraceRequest, ride the one rng stream (reproducible), and are part
+    of the fingerprint; degenerate default ranges consume no draws."""
+    spec = WorkloadSpec(num_requests=30, seed=9, temperature=0.8,
+                        top_k=(2, 40), top_p=(0.8, 1.0),
+                        per_request_seed=(0, 10_000))
+    t1, t2 = spec.compile(), spec.compile()
+    assert t1 == t2
+    assert trace_fingerprint(t1) == trace_fingerprint(t2)
+    assert {r.top_k for r in t1} <= set(range(2, 41))
+    assert len({r.top_k for r in t1}) > 1
+    assert all(0.8 <= r.top_p <= 1.0 for r in t1)
+    assert all(r.seed is not None and 0 <= r.seed <= 10_000 for r in t1)
+    # knobs are fingerprinted: a different knob range = a different trace
+    other = dataclasses.replace(spec, top_k=(2, 41)).compile()
+    assert trace_fingerprint(other) != trace_fingerprint(t1)
+    # defaults stay knob-free AND draw-free: the arrival/length stream
+    # is unchanged from a spec that predates the knobs
+    base = WorkloadSpec(num_requests=10, seed=4)
+    assert all(r.top_k == 0 and r.top_p == 1.0 and r.seed is None
+               for r in base.compile())
+    with pytest.raises(ValueError, match="top_k"):
+        WorkloadSpec(top_k=(5, 2))
+    with pytest.raises(ValueError, match="top_p"):
+        WorkloadSpec(top_p=(0.0, 1.0))
+    with pytest.raises(ValueError, match="per_request_seed"):
+        WorkloadSpec(per_request_seed=(5, 2))
+
+
+def test_sampled_workload_report_reproduces_bitwise(tiny_model):
+    """The determinism gate extended to per-request sampling: a sampled
+    workload (temperature + per-request top_k/top_p/seed) reproduces
+    its report byte for byte — engine-side sampling rides per-request
+    fold_in streams, not shared key state."""
+    spec = WorkloadSpec(num_requests=24, seed=13, arrival="poisson",
+                        arrival_rate=120.0, prompt_len=(4, 12),
+                        output_len=(2, 6), temperature=0.9,
+                        top_k=(5, 30), top_p=(0.85, 1.0),
+                        per_request_seed=(0, 1 << 20), vocab_size=128)
+
+    def run():
+        clock = VirtualClock()
+        eng = _engine(tiny_model, clock)
+        result = Driver(eng, clock, step_time_s=0.01).run(spec.compile())
+        return build_report(result, spec=spec, trace=spec.compile())
+
+    r1, r2 = run(), run()
+    assert report_json(r1) == report_json(r2)
+    assert r1["requests"]["unresolved"] == 0
+    assert r1["requests"]["finished"] > 0
+
+
 def test_deterministic_arrivals():
     spec = WorkloadSpec(num_requests=5, seed=0, arrival="deterministic",
                         arrival_rate=10.0)
@@ -167,6 +220,20 @@ def test_determinism_under_burst_mode(tiny_model):
     # bursts actually engaged: fewer host dispatches than tokens
     assert r1["throughput"]["host_dispatches"] \
         < r1["throughput"]["tokens_generated"]
+
+
+def test_determinism_under_speculative_decoding(tiny_model):
+    """Same seed, speculative engine (int4 self-draft): the report must
+    still reproduce bit for bit, every request resolves, and the spec
+    rounds genuinely engaged (accepted tokens mean fewer target
+    launches than committed tokens on decode-heavy stretches)."""
+    r1 = _run_mixed(tiny_model, max_len=64, draft_model=tiny_model,
+                    spec_tokens=3)
+    r2 = _run_mixed(tiny_model, max_len=64, draft_model=tiny_model,
+                    spec_tokens=3)
+    assert report_json(r1) == report_json(r2)
+    assert r1["requests"]["unresolved"] == 0
+    assert r1["requests"]["finished"] > 0
 
 
 # ---------------------------------------------------------------------------

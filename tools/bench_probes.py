@@ -236,6 +236,58 @@ def probe_serving(paddle, wave=6, max_new=4, burst_tokens=8):
                 "serving_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_spec_decode(paddle, spec_tokens=4, max_new=16):
+    """Measured speculative-decoding fields for the bench trajectory.
+
+    One micro engine serves a single repetitive-text request with an
+    int4-quantized SELF-draft (the draft is the target model through
+    ``quantize_params(mode="weight_only_int4")`` — the highest-fidelity
+    draft this container can build without a second checkpoint, and the
+    exact low-bit path the subsystem exists for). Greedy acceptance is
+    then argmax-agreement between the int4 draft and the fp target, high
+    on a repetitive prompt. Records:
+    - ``spec_target_steps_per_token``: engine launches per committed
+      token for the single-row workload — THE speculative win; < 1.0
+      iff verification rounds commit more than one token each. Forcing
+      ``spec_tokens=0`` (the proxy-bench regression-injection hook)
+      disables the draft and drives it back to exactly 1.0;
+    - ``spec_accept_rate``: accepted / drafted candidates (lifetime);
+    - ``spec_decode_compiles``: ragged-step executables — the spec
+      rounds ride the ONE fixed-shape executable (q_len = k+1 rows are
+      just prefill-shaped chunks), so this must stay 1.
+    Micro-sized like the serving probe: it measures the engine's
+    verification/rollback layer, not model FLOPs.
+    """
+    try:
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        paddle.seed(0)          # acceptance depends on the init draw
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=128, intermediate_size=256,
+            num_attention_heads=1, num_key_value_heads=1, vocab_size=256)
+        model = LlamaForCausalLM(cfg)
+        eng = LLMEngine(
+            model, max_len=64, page_size=8, max_num_seqs=2,
+            draft_model=model if spec_tokens > 0 else None,
+            spec_tokens=spec_tokens)
+        prompt = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6, 7]   # repetitive text
+        eng.add_request(prompt, max_new_tokens=max_new)
+        eng.run(max_steps=200)
+        snap = eng.metrics_snapshot()
+        return {
+            "spec_target_steps_per_token": round(
+                snap["target_steps_per_token"], 4)
+            if snap["target_steps_per_token"] is not None else None,
+            "spec_accept_rate": round(snap["spec_accept_rate"], 4),
+            "spec_decode_compiles": eng.decode_cache_size(),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"spec_target_steps_per_token": None,
+                "spec_accept_rate": None,
+                "spec_decode_compiles": None,
+                "spec_decode_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_input_pipeline(paddle, steps=16, log_freq=8):
     """Measured async-input-pipeline fields for the bench trajectory.
 
@@ -380,4 +432,4 @@ def probe_kv_accounting():
 
 
 __all__ = ["probe_input_pipeline", "probe_jaxpr", "probe_kv_accounting",
-           "probe_opt_dispatches", "probe_serving"]
+           "probe_opt_dispatches", "probe_serving", "probe_spec_decode"]

@@ -47,7 +47,8 @@ sys.path.insert(0, REPO)
 
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
-PROBES = ("serving", "optimizer", "pipeline", "jaxpr", "accounting")
+PROBES = ("serving", "spec", "optimizer", "pipeline", "jaxpr",
+          "accounting")
 
 
 class Gate:
@@ -89,21 +90,32 @@ GATES = {
     "kv_bytes_per_token_int8":  Gate("higher", 0.0, 0.0),
     "prefix_cache_hit_rate":    Gate("lower", 0.0, 0.10),
     "shared_page_fraction":     Gate("lower", 0.0, 0.10),
+    # speculative decoding: launches per committed token must stay well
+    # under 1 (disabling the draft drives it to exactly 1.0 — the
+    # injected regression), acceptance must not collapse, and the spec
+    # rounds must keep riding the ONE ragged executable
+    "spec_target_steps_per_token": Gate("higher", 0.20, 0.02),
+    "spec_accept_rate":         Gate("lower", 0.0, 0.15),
+    "spec_decode_compiles":     Gate("higher", 0.0, 0.0),
 }
 
 
-def collect(probes=PROBES, burst_tokens=8) -> dict:
+def collect(probes=PROBES, burst_tokens=8, spec_tokens=4) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
     path — the deliberate-regression hook the compare-mode test uses to
     prove the ``host_dispatches_per_token`` gate actually fires.
+    ``spec_tokens=0`` disables the speculative draft the same way —
+    target steps per committed token then reads exactly 1.0 and the
+    ``spec_target_steps_per_token`` gate must catch it.
     """
     import jax
     import paddle_tpu as paddle
     from tools.bench_probes import (probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
-                                    probe_opt_dispatches, probe_serving)
+                                    probe_opt_dispatches, probe_serving,
+                                    probe_spec_decode)
     dev = jax.devices()[0]
     backend = dev.platform if dev.platform == "cpu" else \
         getattr(dev, "device_kind", "tpu").replace(" ", "-").lower()
@@ -121,6 +133,10 @@ def collect(probes=PROBES, burst_tokens=8) -> dict:
         _take(probe_serving(paddle, burst_tokens=burst_tokens),
               ("decode_compiles", "host_dispatches_per_token",
                "prefix_cache_hit_rate", "shared_page_fraction"))
+    if "spec" in probes:
+        _take(probe_spec_decode(paddle, spec_tokens=spec_tokens),
+              ("spec_target_steps_per_token", "spec_accept_rate",
+               "spec_decode_compiles"))
     if "optimizer" in probes:
         _take(probe_opt_dispatches(paddle), ("opt_dispatches_per_step",))
     if "pipeline" in probes:
@@ -193,6 +209,9 @@ def main(argv=None) -> int:
     ap.add_argument("--burst-tokens", type=int, default=8,
                     help="serving probe burst length (1 forces the "
                          "per-token dispatch path)")
+    ap.add_argument("--spec-tokens", type=int, default=4,
+                    help="spec probe draft length (0 disables the draft "
+                         "— one target launch per token again)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -214,7 +233,8 @@ def main(argv=None) -> int:
         print("--record requires the full probe set (a partial "
               "recording would shrink gate coverage)", file=sys.stderr)
         return 2
-    current = collect(probes=probes, burst_tokens=args.burst_tokens)
+    current = collect(probes=probes, burst_tokens=args.burst_tokens,
+                      spec_tokens=args.spec_tokens)
 
     if args.json:
         # --json changes the output format, never the action: combined
