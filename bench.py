@@ -41,7 +41,8 @@ import numpy as np
 # baseline-compare harness); bench.py keeps its artifact schema and
 # spreads the same fields into the flagship JSON line
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tools.bench_probes import (probe_input_pipeline,  # noqa: E402
+from tools.bench_probes import (probe_gspmd,  # noqa: E402
+                                probe_input_pipeline,
                                 probe_opt_dispatches, probe_serving,
                                 probe_spec_decode)
 
@@ -51,6 +52,7 @@ _probe_opt_dispatches = probe_opt_dispatches
 _probe_serving = probe_serving
 _probe_input_pipeline = probe_input_pipeline
 _probe_spec_decode = probe_spec_decode
+_probe_gspmd = probe_gspmd
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -209,6 +211,7 @@ def run_bench(config="llama_125m", progress=None):
     serving_probe = _probe_serving(paddle)
     spec_probe = _probe_spec_decode(paddle)
     pipeline_probe = _probe_input_pipeline(paddle)
+    gspmd_probe = _probe_gspmd(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -277,6 +280,7 @@ def run_bench(config="llama_125m", progress=None):
         **serving_probe,
         **spec_probe,
         **pipeline_probe,
+        **gspmd_probe,
     }
 
 
@@ -542,6 +546,14 @@ def _failure_artifact(last_err, last_stages):
         "spec_target_steps_per_token": None,
         "spec_accept_rate": None,
         "spec_decode_compiles": None,
+        # gspmd sharding fields are per-run measurements (compile
+        # counts, HLO collective mix, per-device KV bytes): null on a
+        # stale artifact, never copied from the last good round
+        "gspmd_train_compiles": None,
+        "gspmd_allreduce_count": None,
+        "gspmd_allgather_count": None,
+        "gspmd_serving_decode_compiles": None,
+        "gspmd_sharded_kv_bytes_per_token": None,
     }
     good = _last_good_round()
     if good:

@@ -23,6 +23,8 @@ from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from .sharding import (  # noqa: F401
     group_sharded_parallel, shard_optimizer_states, stage2_gradient_fn,
 )
+from . import gspmd  # noqa: F401
+from .gspmd import ShardingConfig  # noqa: F401
 from . import fleet  # noqa: F401
 from .auto_parallel import parallelize, to_static  # noqa: F401
 from . import checkpoint  # noqa: F401

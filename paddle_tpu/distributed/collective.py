@@ -331,8 +331,19 @@ def chunk_dequantize(q, scales, n):
 #: of the local gradient the int8 payload could not carry is re-injected
 #: into the NEXT round's payload instead of being lost (EQuARX §error
 #: feedback) — over steps the quantization bias cancels instead of
-#: accumulating in the optimizer state
+#: accumulating in the optimizer state. Each entry carries the REGIME
+#: SIGNATURE it was produced under — (group axis name, member ranks,
+#: buffer shape) — so switching parallel regimes or meshes mid-run
+#: (e.g. re-wrapping a model onto a different dp subgroup, or a bucket
+#: name colliding across two communicators) can never silently
+#: re-inject a residual that belongs to a different reduction: the
+#: mismatch warns and resets instead.
 _EF_RESIDUALS: dict = {}
+
+
+def _ef_regime_sig(group, arr):
+    return (_get_axis(group), tuple(_group_ranks(group)),
+            tuple(np.shape(arr)))
 
 
 def reset_quantized_allreduce_residuals():
@@ -368,15 +379,29 @@ def quantized_all_reduce_sum(a, group=None, error_feedback_key=None):
     local = arr
     use_ef = error_feedback_key is not None and \
         GLOBAL_FLAGS.get("quantized_allreduce_error_feedback")
+    sig = _ef_regime_sig(group, arr) if use_ef else None
     if use_ef:
-        res = _EF_RESIDUALS.get(error_feedback_key)
-        if res is not None and res.shape == arr.shape:
-            local = arr + res
+        ent = _EF_RESIDUALS.get(error_feedback_key)
+        if ent is not None:
+            stored_sig, res = ent
+            if stored_sig == sig:
+                local = arr + res
+            else:
+                import warnings
+                warnings.warn(
+                    f"quantized all-reduce error feedback: residual for "
+                    f"bucket {error_feedback_key!r} was recorded under "
+                    f"regime {stored_sig} but this reduction runs under "
+                    f"{sig} (mesh/group/shape changed mid-run) — "
+                    f"resetting the residual instead of re-injecting a "
+                    f"stale correction", stacklevel=2)
+                _EF_RESIDUALS.pop(error_feedback_key, None)
     q, scales, n = chunk_quantize(local)
     if use_ef:
-        _EF_RESIDUALS[error_feedback_key] = \
-            (local.ravel() - chunk_dequantize(q, scales, n)) \
-            .reshape(arr.shape)
+        _EF_RESIDUALS[error_feedback_key] = (
+            sig,
+            (local.ravel() - chunk_dequantize(q, scales, n))
+            .reshape(arr.shape))
     if not _is_global(ranks):
         payloads = _subgroup_exchange((q, scales), group, ranks)
     else:

@@ -1,0 +1,455 @@
+"""GSPMD-native sharding: ONE partitioning layer for training and serving.
+
+ROADMAP item 2. The distributed regimes that used to be separate
+shard_map wrappers — data parallelism, tensor (Megatron) parallelism,
+ZeRO optimizer-state sharding — collapse into *annotations* over ONE
+logical 2-D device mesh::
+
+    mesh axes:   ("data", "model")
+    batch        -> P("data", ...)          activations shard on data
+    q/k/v/gate/up-> P(..., "model")         column-parallel (out-dim)
+    o/down       -> P(..., "model", None)   row-parallel (in-dim)
+    embed        -> P("model", None)        vocab-sharded
+    lm_head      -> P(None, "model")        vocab-sharded
+    norms/biases -> P()                     replicated
+    ZeRO         -> optimizer flat buckets  P("data") (1-D state spans)
+
+The annotations ride the EXISTING single ``jax.jit`` executables —
+``jit.TrainStep`` (training) and ``LLMEngine``'s ragged step (serving)
+— as ``in_shardings``/``out_shardings``; XLA's GSPMD partitioner then
+places every collective (the psum after a row-parallel matmul, the
+grad all-reduce over data, the all-gather reassembling ZeRO-updated
+params). Switching DP<->TP<->ZeRO changes ONLY the annotation preset:
+no application code, no separate step function per regime — the
+SNIPPETS exemplar's "8 chips to 6000-chip superclusters without
+changing application code" contract.
+
+Presets come from :class:`ShardingConfig` directly or from the
+``FLAGS_gspmd`` string (``"dp=8"``, ``"tp=2,dp=4"``, ``"dp=8,zero"``,
+…; empty = off). Everything here is provable chip-free: the tests and
+``tools/bench_probes.probe_gspmd`` run on an 8-device virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``) and read the collective
+mix straight out of the compiled HLO.
+
+What still needs a chip: the Pallas kernel tier (ragged attention,
+fused dequant-matmul, decode megakernel) has no SPMD partitioning rule,
+so under a mesh GSPMD falls back to gathering those operands — off-TPU
+the jnp/interpret bodies partition fine (docs/DISTRIBUTED.md).
+"""
+from __future__ import annotations
+
+import re
+import warnings
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.flags import GLOBAL_FLAGS, define_flag
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+class ShardingConfig:
+    """One regime description: mesh degrees + the ZeRO toggle.
+
+    ``data`` x ``model`` must equal (or -1-infer to) the device count.
+    ``zero=True`` additionally shards the fused optimizer's flat state
+    buckets over the data axis (ZeRO-1: per-device optimizer-state
+    memory = global/data_degree; GSPMD all-gathers the updated params
+    exactly where they are consumed).
+    """
+
+    def __init__(self, data=-1, model=1, zero=False):
+        self.data = int(data)
+        self.model = int(model)
+        self.zero = bool(zero)
+        if self.model < 1:
+            raise ValueError(f"model degree must be >= 1, got {model}")
+        if self.data < 1 and self.data != -1:
+            raise ValueError(
+                f"data degree must be >= 1 (or -1 to infer), got {data}")
+
+    @classmethod
+    def parse(cls, preset: str) -> "ShardingConfig | None":
+        """``"dp=8"`` / ``"tp=2,dp=4"`` / ``"dp=8,zero"`` -> config;
+        ``""`` -> None (GSPMD off). Raises ValueError on malformed
+        presets — FLAGS_gspmd wires this through on_set, so an invalid
+        ``flags.set`` rolls back instead of leaving a broken value."""
+        preset = (preset or "").strip()
+        if not preset:
+            return None
+        kw = {"data": -1, "model": 1, "zero": False}
+        for part in preset.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part == "zero":
+                kw["zero"] = True
+                continue
+            m = re.fullmatch(r"(dp|tp|data|model)\s*=\s*(-?\d+)", part)
+            if not m:
+                raise ValueError(
+                    f"FLAGS_gspmd: cannot parse {part!r} (expected "
+                    f"'dp=N', 'tp=N', 'zero', comma-separated)")
+            key = {"dp": "data", "tp": "model"}.get(m.group(1), m.group(1))
+            kw[key] = int(m.group(2))
+        return cls(**kw)
+
+    def resolve(self, n_devices=None) -> "ShardingConfig":
+        """Pin ``data=-1`` against the device count; validate the fit."""
+        n = n_devices if n_devices is not None else len(jax.devices())
+        data = self.data
+        if data == -1:
+            if n % self.model:
+                raise ValueError(
+                    f"model degree {self.model} does not divide the "
+                    f"{n}-device mesh")
+            data = n // self.model
+        if data * self.model != n:
+            raise ValueError(
+                f"mesh {data} x {self.model} != {n} devices")
+        out = ShardingConfig(data=data, model=self.model, zero=self.zero)
+        return out
+
+    def __repr__(self):
+        return (f"ShardingConfig(data={self.data}, model={self.model}, "
+                f"zero={self.zero})")
+
+    def __eq__(self, other):
+        return (isinstance(other, ShardingConfig)
+                and (self.data, self.model, self.zero)
+                == (other.data, other.model, other.zero))
+
+
+def _check_gspmd(v):
+    ShardingConfig.parse(str(v))   # raises -> flags.set rolls back
+
+
+define_flag("gspmd", str, "",
+            "GSPMD sharding preset for jit.TrainStep: '' (off), 'dp=N', "
+            "'tp=N[,dp=M]', '...,zero' — DP/TP/ZeRO as NamedSharding "
+            "annotations over one (data, model) mesh under the one "
+            "compiled step (distributed/gspmd.py); collectives are "
+            "placed by the XLA partitioner, no per-regime step code",
+            on_set=_check_gspmd)
+
+
+def config_from_flags() -> ShardingConfig | None:
+    return ShardingConfig.parse(GLOBAL_FLAGS.get("gspmd"))
+
+
+def build_mesh(config: ShardingConfig, devices=None) -> Mesh:
+    """The one logical 2-D ``(data, model)`` mesh.
+
+    Built over ``jax.devices()`` in canonical order (real device ids —
+    the multi-process regime's non-contiguous ids ride along exactly as
+    in mesh.init_mesh)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    cfg = config.resolve(len(devs))
+    arr = np.asarray(devs).reshape(cfg.data, cfg.model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+# ---------------------------------------------------------------------------
+# sharding rules
+# ---------------------------------------------------------------------------
+# Column-parallel projections shard their OUT dim (last axis of the
+# [in, out] Linear layout), row-parallel their IN dim (second-to-last);
+# counting from the END makes the same rule cover scan-stacked layouts
+# ([n_layers, in, out]) untouched.
+_COL_PAT = re.compile(
+    r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$")
+_ROW_PAT = re.compile(r"(o_proj|down_proj)\.weight$")
+_EMBED_PAT = re.compile(r"embed_tokens\.weight$")
+_HEAD_PAT = re.compile(r"lm_head\.weight$")
+
+#: extract_params layer-dict keys -> (which end-relative dim to shard)
+_SERVING_COL = frozenset({"q", "k", "v", "gate", "up"})
+_SERVING_ROW = frozenset({"o", "down"})
+
+
+def _spec_from_end(ndim, end_axis, axis_name):
+    """P with ``axis_name`` on dimension ``ndim - end_axis`` (1-based
+    from the end), everything else None."""
+    dims = [None] * ndim
+    dims[ndim - end_axis] = axis_name
+    return P(*dims)
+
+
+def _divisible(shape, ndim, end_axis, degree) -> bool:
+    if ndim < end_axis:
+        return False
+    return shape[ndim - end_axis] % degree == 0
+
+
+def param_spec(name, shape, mesh) -> P:
+    """NamedSharding rule for one NAMED parameter (training pytrees).
+
+    Unknown names and non-divisible dims replicate — a model the rules
+    don't recognize still runs, just without the TP split for that leaf.
+    """
+    ndim = len(shape)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if tp <= 1 or ndim < 1:
+        return P()
+    if _COL_PAT.search(name) and _divisible(shape, ndim, 1, tp):
+        return _spec_from_end(ndim, 1, MODEL_AXIS)
+    if _ROW_PAT.search(name) and ndim >= 2 \
+            and _divisible(shape, ndim, 2, tp):
+        return _spec_from_end(ndim, 2, MODEL_AXIS)
+    if _EMBED_PAT.search(name) and ndim >= 2 \
+            and _divisible(shape, ndim, 2, tp):
+        return _spec_from_end(ndim, 2, MODEL_AXIS)   # vocab axis
+    if _HEAD_PAT.search(name) and _divisible(shape, ndim, 1, tp):
+        return _spec_from_end(ndim, 1, MODEL_AXIS)   # vocab axis
+    return P()
+
+
+def named_param_shardings(named_shapes, mesh) -> dict:
+    """{key: NamedSharding} for a {key: (name, shape)} map — the form
+    jit.TrainStep's ``p{i}`` dict needs (keys are positional, names come
+    from the model's named_parameters)."""
+    return {k: NamedSharding(mesh, param_spec(name, shape, mesh))
+            for k, (name, shape) in named_shapes.items()}
+
+
+def _serving_leaf_spec(key, ndim, shape, tp):
+    if tp <= 1:
+        return P()
+    if key in _SERVING_COL and ndim >= 1 and shape[-1] % tp == 0:
+        return _spec_from_end(ndim, 1, MODEL_AXIS)
+    if key in _SERVING_ROW and ndim >= 2 and shape[-2] % tp == 0:
+        return _spec_from_end(ndim, 2, MODEL_AXIS)
+    return P()
+
+
+def _place_quantized(w, key, mesh, tp):
+    """Shard a QuantizedWeight's payload+scale along the same logical
+    dim as its fp counterpart. int8 payloads keep the [in, out] layout;
+    int4 payloads are nibble-packed on the OUT dim, which still tiles
+    evenly iff out/tp stays even — otherwise the leaf replicates."""
+    from ..quantization.low_bit import QuantizedWeight
+    q, s = w.qdata, w.scale
+    if key in _SERVING_COL:
+        ok = q.shape[-1] % tp == 0 and s.shape[-1] % tp == 0
+        qs = _spec_from_end(q.ndim, 1, MODEL_AXIS) if ok else P()
+        ss = _spec_from_end(s.ndim, 1, MODEL_AXIS) if ok else P()
+    elif key in _SERVING_ROW:
+        ok = q.ndim >= 2 and q.shape[-2] % tp == 0
+        qs = _spec_from_end(q.ndim, 2, MODEL_AXIS) if ok else P()
+        ss = P()
+    else:
+        qs = ss = P()
+    return QuantizedWeight(
+        jax.device_put(q, NamedSharding(mesh, qs)),
+        jax.device_put(s, NamedSharding(mesh, ss)),
+        w.bits, w.rows)
+
+
+def shard_serving_params(params, mesh):
+    """Place an ``extract_params`` pytree (fp or quantized) under the
+    serving TP rules: projections split on the model axis, embed/lm_head
+    on the vocab axis, norms replicated. Returns a new pytree of
+    committed sharded arrays."""
+    from ..quantization.low_bit import QuantizedWeight
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    out = {}
+    e = params["embed"]
+    out["embed"] = put(e, P(MODEL_AXIS, None)
+                       if tp > 1 and e.shape[0] % tp == 0 else P())
+    out["norm"] = put(params["norm"], P())
+    if "lm_head" in params:
+        lh = params["lm_head"]
+        out["lm_head"] = put(lh, P(None, MODEL_AXIS)
+                             if tp > 1 and lh.shape[-1] % tp == 0 else P())
+    layers = []
+    for lyr in params["layers"]:
+        nl = {}
+        for k, v in lyr.items():
+            if isinstance(v, QuantizedWeight):
+                nl[k] = _place_quantized(v, k, mesh, tp)
+            else:
+                nl[k] = put(v, _serving_leaf_spec(k, v.ndim, v.shape, tp))
+        layers.append(nl)
+    out["layers"] = layers
+    return out
+
+
+def kv_pool_sharding(mesh) -> NamedSharding:
+    """Pool pages [Hkv, pages, ps, d] shard on the kv-head axis; the
+    int8 scale rows [Hkv, pages] use :func:`kv_scale_sharding`."""
+    return NamedSharding(mesh, P(MODEL_AXIS))
+
+
+def kv_scale_sharding(mesh) -> NamedSharding:
+    # fully-specified spec (not the P('model') prefix form): the ragged
+    # step's OUTPUT scales come back as P('model', None), and a
+    # spec-spelling mismatch between input and output re-keys the jit's
+    # lowering cache — one spurious recompile per engine step
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+# ---------------------------------------------------------------------------
+# training-state rules (jit.TrainStep)
+# ---------------------------------------------------------------------------
+
+def opt_state_shardings(opt_arrays, param_shardings_by_key, mesh,
+                        zero=False) -> dict:
+    """Shardings for TrainStep's optimizer-state dict.
+
+    Fused flat buckets (``fused{i}.{name}``, 1-D spans over a dtype
+    bucket) shard over the data axis when ``zero`` — ZeRO-1's
+    state-memory split, with GSPMD placing the gather where the updated
+    params are consumed. Per-param fallback state (``{pkey}.{name}``)
+    mirrors its parameter's sharding when shapes line up (moments live
+    where the param lives), else replicates."""
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    tp = mesh.shape.get(MODEL_AXIS, 1)
+    if zero and tp > 1:
+        # the 0.4.x CPU SPMD partitioner shifts flat spans when a
+        # data-sharded 1-D state mixes with a model axis in the same
+        # program (see constrain_flat); until a chip run revalidates
+        # the combination, tp x zero keeps the state replicated —
+        # ZeRO's memory split needs dp-only meshes here
+        warnings.warn(
+            "gspmd: zero + model-parallel combined keeps optimizer "
+            "state replicated on this backend (flat-span partitioner "
+            "defect, docs/DISTRIBUTED.md); use a dp-only mesh for the "
+            "ZeRO state split", stacklevel=2)
+        zero = False
+    out = {}
+    for k, v in opt_arrays.items():
+        spec = P()
+        if k.startswith("fused"):
+            if zero and dp > 1 and v.ndim == 1 and v.shape[0] % dp == 0:
+                spec = P(DATA_AXIS)
+        else:
+            pkey = k.split(".", 1)[0]
+            ps = param_shardings_by_key.get(pkey)
+            if ps is not None and hasattr(v, "shape"):
+                try:
+                    if ps.shard_shape(tuple(v.shape)):
+                        spec = ps.spec
+                except Exception:
+                    spec = P()
+        out[k] = NamedSharding(mesh, spec)
+    return out
+
+
+def batch_sharding(arr, mesh) -> NamedSharding:
+    """Batch tensors shard dim 0 over data (replicate when the batch
+    does not divide — a ragged tail batch must not fail the step)."""
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if dp > 1 and getattr(arr, "ndim", 0) >= 1 and arr.shape[0] % dp == 0:
+        return NamedSharding(mesh, P(DATA_AXIS))
+    return NamedSharding(mesh, P())
+
+
+def replicated(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# partitioning scope + the flat-span workaround
+# ---------------------------------------------------------------------------
+#: mesh stack bound while a GSPMD-annotated program is being traced —
+#: lets code deep inside the trace (the fused optimizer's flat-bucket
+#: concat, TrainStep's grad accumulator) know the active mesh without
+#: threading it through every signature
+_MESH_STACK: list = []
+
+
+class partitioning_scope:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _MESH_STACK.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _MESH_STACK.pop()
+        return False
+
+
+def active_mesh():
+    return _MESH_STACK[-1] if _MESH_STACK else None
+
+
+def stage_state(x):
+    """Stage a ZeRO-sharded flat state span replicated for the bucket
+    update when the TENSOR-parallel axis is also active. On a pure data
+    mesh the sharded-state compute is left alone (the ZeRO split rides
+    straight through the update); with model > 1 the same 0.4.x CPU
+    partitioner defect corrupts the mixed sharded-state x replicated-
+    grad elementwise chain, so the state gathers at body entry and the
+    step's out_shardings re-slice it — state stays sharded AT REST
+    either way."""
+    mesh = active_mesh()
+    if mesh is None or mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        return x
+    return constrain_flat(x)
+
+
+def constrain_flat(x):
+    """Constrain a raveled flat span to REPLICATED under the active
+    partitioning mesh (identity otherwise).
+
+    Two jobs in one: (a) semantics — flat optimizer/grad spans are
+    logically whole buffers that mixed col/row-sharded leaves flow
+    into, so the concat boundary is where the partitioner must gather;
+    (b) a workaround — this container's jaxlib (0.4.x CPU SPMD
+    partitioner) MISCOMPILES ``concatenate`` when an operand's reshape
+    arrives dim-0-sharded, producing silently wrong values
+    (tests/test_gspmd.py pins the parity that catches it). Constraining
+    each part replicated before the concat sidesteps the bad lowering
+    on every backend.
+    """
+    mesh = active_mesh()
+    if mesh is None or not isinstance(x, jax.core.Tracer):
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*([None] * x.ndim))))
+
+
+# ---------------------------------------------------------------------------
+# HLO forensics
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def collective_counts(hlo_text: str) -> dict:
+    """Count collective ops in a compiled HLO module's text — the
+    chip-free proof that an annotation preset produced the collective
+    mix it promises (tests/test_gspmd.py, probe_gspmd). Start/done pairs
+    of async collectives count once."""
+    out = {}
+    for name in _COLLECTIVES:
+        # `%all-reduce.3 = f32[...] all-reduce(` — count op instances,
+        # not operand references: match the `= <type> opname(`
+        # definition form. The result type is either one token or a
+        # TUPLE `(f32[8]{0}, f32[4]{0})` with spaces (XLA's
+        # AllReduceCombiner emits those) — both shapes must count.
+        # Async pairs define `-start`/`-done`; count the starts once.
+        defs = re.findall(
+            rf"= (?:\([^)]*\)|[^\s(]+) {name}(?:-start)?\(", hlo_text)
+        out[name.replace("-", "_")] = len(defs)
+    return out
+
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "ShardingConfig", "config_from_flags",
+    "build_mesh", "param_spec", "named_param_shardings",
+    "shard_serving_params", "kv_pool_sharding", "kv_scale_sharding",
+    "opt_state_shardings", "batch_sharding", "replicated",
+    "collective_counts", "partitioning_scope", "active_mesh",
+    "constrain_flat", "stage_state",
+]

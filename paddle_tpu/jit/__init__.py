@@ -417,7 +417,7 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, accumulate_steps=1,
-                 remat_policy=None):
+                 remat_policy=None, sharding=None):
         from ..nn.scan_stack import REMAT_POLICIES
         self.model = model
         self.loss_fn = loss_fn
@@ -430,6 +430,20 @@ class TrainStep:
                 f"remat_policy must be one of {REMAT_POLICIES} or None, "
                 f"got {remat_policy!r}")
         self.remat_policy = remat_policy
+        # GSPMD partitioning (distributed/gspmd.py): DP/TP/ZeRO as
+        # NamedSharding annotations over one (data, model) mesh, applied
+        # as in/out_shardings of THIS step's one jax.jit — an explicit
+        # ShardingConfig pins the regime, None defers to FLAGS_gspmd.
+        from ..distributed import gspmd as _gspmd
+        if sharding is not None and not isinstance(
+                sharding, _gspmd.ShardingConfig):
+            sharding = _gspmd.ShardingConfig.parse(str(sharding))
+        self.sharding = sharding
+        #: HLO forensics of the most recent GSPMD-annotated compile:
+        #: the full module text + its collective-op counts (None while
+        #: no sharded specialization has been built)
+        self.last_hlo_text = None
+        self.last_hlo_collectives = None
         # compile forensics: wall-ms of the most recent first-call
         # trace+lower+build, and the running total across re-specializes
         # (shape changes, flag flips). Mirrored into bench.py artifacts.
@@ -440,6 +454,14 @@ class TrainStep:
         # materialize optimizer state now so it traces as inputs
         params = [p for p in optimizer._parameter_list if not p.stop_gradient]
         self._params = {f"p{i}": p for i, p in enumerate(params)}
+        # positional key -> model parameter name: the GSPMD rule table is
+        # name-driven (q_proj/o_proj/embed/...), while the step's pytree
+        # keys are positional
+        by_id = {}
+        if hasattr(model, "named_parameters"):
+            by_id = {id(p): n for n, p in model.named_parameters()}
+        self._param_names = {k: by_id.get(id(p), k)
+                             for k, p in self._params.items()}
 
     def _fused_eng(self):
         eng = getattr(self.optimizer, "_fused_engine", None)
@@ -534,8 +556,14 @@ class TrainStep:
         donate_batch = bool(batch) and jax.default_backend() != "cpu" and \
             all(isinstance(b, Tensor) and getattr(b, "_staged_h2d", False)
                 for b in batch)
+        from ..distributed import gspmd as _gspmd
+        shard_cfg = self.sharding or _gspmd.config_from_flags()
+        if shard_cfg is not None:
+            shard_cfg = shard_cfg.resolve()
+        cfg_key = None if shard_cfg is None else \
+            (shard_cfg.data, shard_cfg.model, shard_cfg.zero)
         key = tuple((a.shape, str(a.dtype)) for a in batch_arrays) \
-            + (check_finite, donate_batch, K, remat)
+            + (check_finite, donate_batch, K, remat, cfg_key)
 
         if key not in self._cache:
             # Ensure optimizer state exists with final shapes: run one throwaway
@@ -550,8 +578,23 @@ class TrainStep:
             model = self.model
             loss_fn = self.loss_fn
             step_holder = {}
+            mesh = None
+            batch_sh = None
+            if shard_cfg is not None:
+                mesh = _gspmd.build_mesh(shard_cfg)
+                self._mesh = mesh
+                batch_sh = tuple(_gspmd.batch_sharding(a, mesh)
+                                 for a in batch_arrays)
 
             def pure_step(param_arrays, opt_arrays, buffer_arrays, step_i, lr, rng, *b_arrays):
+                if mesh is not None:
+                    # pin the data-parallel batch split inside the traced
+                    # program too (in_shardings place the inputs; the
+                    # constraint stops the partitioner from re-replicating
+                    # the batch into the forward)
+                    b_arrays = tuple(
+                        jax.lax.with_sharding_constraint(b, sh)
+                        for b, sh in zip(b_arrays, batch_sh))
                 inst_p = _Installed(param_t)
                 inst_b = _Installed(buffer_t)
                 saved_state = {pid: dict(st) for pid, st in opt._state.items()}
@@ -607,7 +650,31 @@ class TrainStep:
             if donate_batch:
                 # b_arrays start after the 6 fixed args of pure_step
                 donate = donate + tuple(range(6, 6 + len(batch_arrays)))
-            self._cache[key] = jax.jit(pure_step, donate_argnums=donate)
+            jit_kw = {}
+            if mesh is not None:
+                # GSPMD: the regime IS this annotation set — params by
+                # the name-driven rule table, fused flat optimizer
+                # buckets on the data axis under ZeRO (per-param state
+                # mirrors its param), batch on data, scalars/rng/buffers
+                # replicated. Identical in/out shardings keep the
+                # param/opt donation valid on TPU.
+                p_sh = _gspmd.named_param_shardings(
+                    {k: (self._param_names[k], tuple(p._data.shape))
+                     for k, p in self._params.items()}, mesh)
+                o_sh = _gspmd.opt_state_shardings(
+                    self._opt_state_arrays(), p_sh, mesh,
+                    zero=shard_cfg.zero)
+                b_sh = {k: _gspmd.replicated(mesh) for k in buffer_t}
+                rep = _gspmd.replicated(mesh)
+                out_sh = (p_sh, o_sh, b_sh, rep)
+                if check_finite:
+                    out_sh = out_sh + (rep,)
+                jit_kw = dict(
+                    in_shardings=(p_sh, o_sh, b_sh, rep, rep, rep)
+                    + batch_sh,
+                    out_shardings=out_sh)
+            self._cache[key] = jax.jit(pure_step, donate_argnums=donate,
+                                       **jit_kw)
 
         param_arrays = {k: p._data for k, p in self._params.items()}
         opt_arrays = self._opt_state_arrays()
@@ -648,7 +715,24 @@ class TrainStep:
             # recompile (shape change, remat/flag flip) is visible next
             # to the pipeline gauges instead of reading as one slow step.
             from ..profiler import compile_event
-            with policy_ctx, compile_event(
+            shard_ctx = (_gspmd.partitioning_scope(self._mesh)
+                         if shard_cfg is not None else nullcontext())
+            if shard_cfg is not None:
+                # GSPMD forensics: keep the partitioned HLO + its
+                # collective mix inspectable (tests/test_gspmd.py,
+                # probe_gspmd). One extra lower+compile, paid only on
+                # the first call of a SHARDED specialization.
+                try:
+                    with policy_ctx, shard_ctx:
+                        hlo = self._cache[key].lower(*args).compile() \
+                            .as_text()
+                    self.last_hlo_text = hlo
+                    self.last_hlo_collectives = \
+                        _gspmd.collective_counts(hlo)
+                except Exception:
+                    self.last_hlo_text = None
+                    self.last_hlo_collectives = None
+            with policy_ctx, shard_ctx, compile_event(
                     f"TrainStep(K={K},remat={remat})") as ev:
                 out = self._cache[key](*args)
             self._compiled_keys.add(key)
@@ -761,12 +845,14 @@ class TrainStep:
                 loss = loss_fn(*[Tensor(a) for a in mbs])
                 loss.backward()
             new_acc = {}
+            from ..distributed.gspmd import constrain_flat
             for dts, g in groups.items():
                 parts = []
                 for name, sz, _, dt in g:
                     grad = param_t[name].grad
-                    parts.append(jnp.ravel(grad._data).astype(dt)
-                                 if grad is not None else jnp.zeros(sz, dt))
+                    parts.append(constrain_flat(
+                        jnp.ravel(grad._data).astype(dt))
+                        if grad is not None else jnp.zeros(sz, dt))
                 flat = parts[0] if len(parts) == 1 \
                     else jnp.concatenate(parts)
                 new_acc[dts] = acc[dts] + flat
@@ -776,12 +862,14 @@ class TrainStep:
 
         (acc, loss_sum), _ = jax.lax.scan(
             body, init, (jnp.arange(K),) + micro)
+        from ..distributed.gspmd import constrain_flat
         for dts, g in groups.items():
             flat = acc[dts] / K
             off = 0
             for name, sz, shape, _ in g:
                 param_t[name].grad = Tensor(
-                    jax.lax.slice_in_dim(flat, off, off + sz).reshape(shape),
+                    constrain_flat(jax.lax.slice_in_dim(
+                        flat, off, off + sz)).reshape(shape),
                     stop_gradient=True)
                 off += sz
         return loss_sum / K

@@ -72,9 +72,14 @@ def _device_key(a) -> str:
 
 
 def _concat_flat(arrays):
+    # under a GSPMD partitioning scope each raveled span is constrained
+    # replicated before the concat: the flat bucket is logically whole,
+    # and the 0.4.x CPU SPMD partitioner miscompiles concatenate over
+    # dim-0-sharded operands (distributed/gspmd.constrain_flat)
+    from ..distributed.gspmd import constrain_flat
     if len(arrays) == 1:
-        return arrays[0].ravel()
-    return jnp.concatenate([a.ravel() for a in arrays])
+        return constrain_flat(arrays[0].ravel())
+    return jnp.concatenate([constrain_flat(a.ravel()) for a in arrays])
 
 
 def per_element_vector(params, values, dtype=jnp.float32):
@@ -345,6 +350,8 @@ class FusedOptimizerEngine:
         sizes, shapes = list(b.sizes), list(b.shapes)
 
         def body(p_arr, g_arr, state, aux, lr, t, scale, mask):
+            from ..distributed.gspmd import stage_state
+            state = {k: stage_state(v) for k, v in state.items()}
             flat_p = _concat_flat(list(p_arr))
             flat_g = _concat_flat(list(g_arr))
             gdt = flat_g.dtype
@@ -362,9 +369,14 @@ class FusedOptimizerEngine:
                 new_state = {k: jnp.where(mask, v, state[k])
                              for k, v in new_state.items()}
             outs, off = [], 0
+            from ..distributed.gspmd import constrain_flat
             for sz, shp in zip(sizes, shapes):
-                outs.append(jax.lax.slice_in_dim(
-                    new_flat, off, off + sz).reshape(shp))
+                # the replicated staging constraint is needed on BOTH
+                # sides of the flat buffer (see _concat_flat): the
+                # slice must land replicated before reshaping back into
+                # a leaf the out_shardings re-partition
+                outs.append(constrain_flat(jax.lax.slice_in_dim(
+                    new_flat, off, off + sz)).reshape(shp))
                 off += sz
             return tuple(outs), new_state
 

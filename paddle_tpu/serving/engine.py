@@ -239,7 +239,7 @@ class LLMEngine:
                  quantized_mode=None, kv_cache_dtype=None,
                  burst_tokens=None, draft_model=None, spec_tokens=None,
                  draft_quantized_mode="weight_only_int4",
-                 draft_num_pages=None):
+                 draft_num_pages=None, mesh=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -284,6 +284,35 @@ class LLMEngine:
         if quantized_mode is not None:
             from ..quantization.low_bit import quantize_params
             self.params = quantize_params(self.params, quantized_mode)
+        # tensor-parallel serving (distributed/gspmd.py): every
+        # projection splits over the mesh's model axis (column/row
+        # parallel; embed/lm_head on the vocab axis) and the paged KV
+        # pool shards its kv-head axis the same way — the ONE jitted
+        # ragged step picks the placements up by sharding inference, so
+        # the trace-count==1 compile gate is untouched. Accepts a jax
+        # Mesh with a 'model' axis, a ProcessMesh, or an int tp degree.
+        self.mesh = None
+        if mesh is not None:
+            from ..distributed import gspmd as _gspmd
+            import jax as _jax
+            if isinstance(mesh, int):
+                mesh = _gspmd.build_mesh(
+                    _gspmd.ShardingConfig(data=1, model=mesh),
+                    devices=_jax.devices()[:mesh])
+            elif hasattr(mesh, "jax_mesh"):       # ProcessMesh
+                mesh = mesh.jax_mesh
+            if _gspmd.MODEL_AXIS not in mesh.shape:
+                raise ValueError(
+                    f"LLMEngine(mesh=...) needs a '{_gspmd.MODEL_AXIS}' "
+                    f"mesh axis, got axes {tuple(mesh.shape)}")
+            tp = mesh.shape[_gspmd.MODEL_AXIS]
+            if cfg.num_key_value_heads % tp:
+                raise ValueError(
+                    f"LLMEngine(mesh=...): {cfg.num_key_value_heads} kv "
+                    f"heads do not divide over the {tp}-way model axis "
+                    f"(the KV pool shards per kv head)")
+            self.mesh = mesh
+            self.params = _gspmd.shard_serving_params(self.params, mesh)
         self.max_len = max_len
         self.page_size = page_size
         self.max_pages_per_seq = max_len // page_size
@@ -329,7 +358,7 @@ class LLMEngine:
             cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
             num_pages=num_pages, page_size=page_size, dtype=dtype,
             high_watermark=high_watermark, low_watermark=low_watermark,
-            pinned_page_budget=pinned_prefix_pages)
+            pinned_page_budget=pinned_prefix_pages, mesh=self.mesh)
         self.metrics = ServingMetrics(now_fn=now_fn)
         self.scheduler = Scheduler(
             self.pool,
@@ -730,6 +759,8 @@ class LLMEngine:
         snap = self.metrics.snapshot()
         snap["decode_cache_size"] = self.decode_cache_size()
         snap["burst_tokens"] = self.burst_tokens
+        # tensor-parallel forensics: 1 = single-device engine
+        snap["model_parallel_degree"] = self.pool.model_parallel_degree
         from ..kernels.decode_megakernel import megakernel_mode
         snap["megakernel_mode"] = megakernel_mode(
             self.params["layers"][0],
@@ -748,6 +779,11 @@ class LLMEngine:
             self._draft.launches if self._draft is not None else None
         snap["draft_decode_compiles"] = \
             self._draft.decode_cache_size() if self._draft is not None \
+            else None
+        # the k-step proposal loop is ONE scan executable (and one
+        # launch per spec round) — the ROADMAP item 4 leftover's gate
+        snap["draft_propose_compiles"] = \
+            self._draft.propose_cache_size() if self._draft is not None \
             else None
         return snap
 

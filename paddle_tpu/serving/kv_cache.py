@@ -90,9 +90,22 @@ class PagedKVPool:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
                  page_size, dtype=jnp.float32, high_watermark=0.90,
-                 low_watermark=0.50, pinned_page_budget=0):
+                 low_watermark=0.50, pinned_page_budget=0, mesh=None):
         if num_pages < 2:
             raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        # tensor-parallel pool: pages (and int8 scale rows) shard over
+        # the mesh's model axis on dim 0 — the kv-head axis — so each
+        # device holds Hkv/tp heads' pages. The jitted ragged step's
+        # sharding inference keeps the updated pool on the same axis,
+        # so the split survives across steps without re-placement.
+        self.mesh = mesh
+        if mesh is not None:
+            from ..distributed.gspmd import MODEL_AXIS
+            tp = mesh.shape.get(MODEL_AXIS, 1)
+            if num_kv_heads % tp:
+                raise ValueError(
+                    f"PagedKVPool(mesh=...): {num_kv_heads} kv heads do "
+                    f"not divide over the {tp}-way model axis")
         if not 0.0 < low_watermark <= high_watermark <= 1.0:
             raise ValueError("watermarks must satisfy 0 < low <= high <= 1")
         self.num_layers = num_layers
@@ -116,6 +129,7 @@ class PagedKVPool:
             self.kv_scales = [(jnp.zeros(sshape, jnp.float32),
                                jnp.zeros(sshape, jnp.float32))
                               for _ in range(num_layers)]
+        self._repin()   # initial mesh placement (no-op without a mesh)
         # LIFO free list: recently-freed pages are reused first (warm in
         # whatever cache level holds them)
         self._free = list(range(num_pages - 1, NULL_PAGE, -1))
@@ -179,6 +193,22 @@ class PagedKVPool:
     @property
     def pool_bytes(self) -> int:
         return self.page_bytes * self.num_pages
+
+    @property
+    def model_parallel_degree(self) -> int:
+        """Ways the kv-head axis is split over a mesh's model axis."""
+        if self.mesh is None:
+            return 1
+        from ..distributed.gspmd import MODEL_AXIS
+        return self.mesh.shape.get(MODEL_AXIS, 1)
+
+    @property
+    def kv_bytes_per_token_per_device(self) -> float:
+        """Pool bytes one cached token occupies PER DEVICE — the number
+        that decides whether a model's KV traffic fits one chip's HBM
+        (global bytes / model-parallel degree; the tensor-parallel
+        serving win the sharded pool exists for)."""
+        return self.kv_bytes_per_token / self.model_parallel_degree
 
     # ---- capacity ----
     @property
@@ -254,6 +284,26 @@ class PagedKVPool:
         cache: they yield to demand via LRU eviction)."""
         return len(self._free) + self.evictable_pages
 
+    def _repin(self):
+        """Re-place the pool arrays on the mesh sharding after an EAGER
+        fixup (CoW copy, recycled-page scale reset): eager ops choose
+        their own output sharding, and a drifted placement would re-key
+        the engine's jitted ragged step — one silent recompile per
+        drift, exactly what the trace-count==1 gate forbids. device_put
+        onto the sharding an array already has is free."""
+        if self.mesh is None:
+            return
+        from ..distributed.gspmd import (kv_pool_sharding,
+                                         kv_scale_sharding)
+        psh = kv_pool_sharding(self.mesh)
+        self.kv = [(jax.device_put(K, psh), jax.device_put(V, psh))
+                   for K, V in self.kv]
+        if self.kv_scales is not None:
+            ssh = kv_scale_sharding(self.mesh)
+            self.kv_scales = [(jax.device_put(Ks, ssh),
+                               jax.device_put(Vs, ssh))
+                              for Ks, Vs in self.kv_scales]
+
     # ---- lifecycle ----
     def _release_pages(self, pages) -> int:
         """Drop one refcount per page; recycle (free-list + int8 scale
@@ -276,6 +326,7 @@ class PagedKVPool:
             self.kv_scales = [(Ks.at[:, idx].set(0.0),
                                Vs.at[:, idx].set(0.0))
                               for Ks, Vs in self.kv_scales]
+            self._repin()
         return len(recycled)
 
     def _ensure_free(self, n: int, what: str):
@@ -407,6 +458,7 @@ class PagedKVPool:
                     (Ks.at[:, new_idx].set(Ks[:, old_idx]),
                      Vs.at[:, new_idx].set(Vs[:, old_idx]))
                     for Ks, Vs in self.kv_scales]
+            self._repin()
             self.cow_copies += len(olds)
         self.extend(seq_id, new_len)
         self._lens[seq_id] = new_len

@@ -251,8 +251,14 @@ class DraftWorker:
         self._base_key = jax.random.key(seed)
         self._launched = False
         #: jitted draft launches this worker issued (sync + propose) —
-        #: the draft-side dispatch forensics the metrics snapshot exports
+        #: the draft-side dispatch forensics the metrics snapshot exports.
+        #: A whole k-step proposal round is ONE launch (the lax.scan
+        #: burst); catch-up sync chunks stay one launch per chunk round.
         self.launches = 0
+        #: per-k jitted proposal bursts (k is the scan trip count — one
+        #: executable per distinct k, and an engine uses one k for life)
+        self._propose_jits: dict = {}
+        self._propose_launched = False
         self._build_fwd()
 
     # ------------------------------------------------------------------
@@ -292,11 +298,80 @@ class DraftWorker:
         self._fwd_jit = jax.jit(fwd, donate_argnums=donate)
 
     def decode_cache_size(self) -> int:
-        """Compile count of the draft forward (expected: 1)."""
+        """Compile count of the draft catch-up forward (expected: 1)."""
         try:
             return int(self._fwd_jit._cache_size())
         except Exception:
             return 1 if self._launched else 0
+
+    def propose_cache_size(self) -> int:
+        """Compile count of the k-step proposal burst (expected: 1 —
+        one scan executable per engine-lifetime k)."""
+        try:
+            return sum(int(fn._cache_size())
+                       for fn in self._propose_jits.values())
+        except Exception:
+            return 1 if self._propose_launched else 0
+
+    def _build_propose(self, k):
+        """ONE jitted ``lax.scan`` over the k proposal steps (ROADMAP
+        item 4's last leftover): the q_len=1 rows, per-step packing,
+        sampling and KV appends all live in the loop body, so a whole
+        proposal round costs one host dispatch where the host loop paid
+        k. The body reuses the same shared fp layer body / packing /
+        sampling functions as the per-step path, and reproduces the
+        host loop's cursor packing exactly (live rows pack first, one
+        q_block each), so the draft's candidates and reported
+        distributions match the unrolled launches.
+        """
+        cfg = self.cfg
+        ps = self.page_size
+        qb = self.q_block
+        T = self.step_token_budget
+        PPS = self.max_pages_per_seq
+        interpret = self._interpret
+
+        def burst(params, kv, tbls, cur0, base, spec_lens, seeds, gpos0,
+                  temps, top_ks, top_ps, base_key):
+            def body(carry, j):
+                kv, cur = carry
+                live = j < spec_lens                           # [R]
+                q_lens = live.astype(jnp.int32)
+                # the host loop's packing: live rows pack first, one
+                # q_block of budget each; dead rows start past T
+                starts_raw = (jnp.cumsum(q_lens) - q_lens) * qb
+                q_starts = jnp.where(live, starts_raw, T)
+                tok_buf = jnp.zeros((T,), jnp.int32) \
+                    .at[q_starts].set(cur, mode="drop")
+                pos_buf = jnp.zeros((T,), jnp.int32) \
+                    .at[q_starts].set(base + j, mode="drop")
+                kv_lens = jnp.where(live, base + j + 1, 0)
+                tbl = jnp.where(live[:, None], tbls, NULL_PAGE)
+                sample_idx = jnp.where(live, starts_raw, 0)
+                tok_row, live_tok = _ragged_packing(q_starts, q_lens, T)
+                h = params["embed"][tok_buf][None]
+                new_kv = []
+                for lyr, (Kp, Vp) in zip(params["layers"], kv):
+                    h, Kp, Vp = _ragged_fp_layer(
+                        lyr, h, Kp, Vp, pos_buf, tbl, tok_row, live_tok,
+                        q_starts, q_lens, kv_lens, cfg, ps, PPS, qb,
+                        interpret)
+                    new_kv.append((Kp, Vp))
+                h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
+                logits = _logits(params, h[0, sample_idx], cfg)
+                keys = request_keys(base_key, seeds, gpos0 + j, DRAFT_TAG)
+                tok = sample_rows(logits, keys, temps, top_ks, top_ps)
+                probs = sampling_probs(logits, temps, top_ks, top_ps)
+                tok = jnp.where(live, tok, 0)
+                return (new_kv, jnp.where(live, tok, cur)), (tok, probs)
+
+            (kv, _), (toks, probs) = jax.lax.scan(
+                body, (kv, cur0), jnp.arange(k, dtype=jnp.int32))
+            return toks, probs, kv                 # [k, R], [k, R, V]
+
+        from ..kernels import _on_tpu
+        donate = (1,) if _on_tpu() else ()
+        return jax.jit(burst, donate_argnums=donate)
 
     # ------------------------------------------------------------------
     # host-side lifecycle
@@ -396,18 +471,21 @@ class DraftWorker:
             self._dispatch(rows, zeros, zeros, zf, zeros, ones)
 
     def propose(self, seqs, spec_lens, k):
-        """Run up to ``k`` q_len=1 proposal steps over the synced rows;
-        rows sit out iterations past their own ``spec_lens`` entry (no
-        append, no claim). Returns ``(draft_tokens [n, k] host,
-        draft_probs [R, k, V] DEVICE)`` — ``draft_tokens`` aligns with
-        ``seqs`` (the verifier packs them into its query buffer), the
-        probs never round-trip through the host; slots past a row's
-        spec_len hold garbage the rejection sampler provably never
-        reads (candidate masking by ``spec_lens``). Sequences must be
+        """Run up to ``k`` q_len=1 proposal steps over the synced rows
+        in ONE jitted ``lax.scan`` burst (one host dispatch per spec
+        round — ``launches`` rises by 1, not k); rows sit out
+        iterations past their own ``spec_lens`` entry (no append, no
+        claim). Returns ``(draft_tokens [n, k] host, draft_probs
+        [R, k, V] DEVICE)`` — ``draft_tokens`` aligns with ``seqs``
+        (the verifier packs them into its query buffer), the probs
+        never round-trip through the host; slots past a row's spec_len
+        hold garbage the rejection sampler provably never reads
+        (candidate masking by ``spec_lens``). Sequences must be
         caught-up decode rows already synced to ``cached_len``."""
         n_rows = len(seqs)
         V = self.cfg.vocab_size
         R = self.max_num_seqs
+        PPS = self.max_pages_per_seq
         d_toks = np.zeros((n_rows, k), np.int32)
         if k == 0 or not any(spec_lens):
             return d_toks, jnp.zeros((R, k, V), jnp.float32)
@@ -416,37 +494,42 @@ class DraftWorker:
         temps = np.zeros((R,), np.float32)
         top_ks = np.zeros((R,), np.int32)
         top_ps = np.ones((R,), np.float32)
-        cur = np.zeros((n_rows,), np.int32)
-        base = np.zeros((n_rows,), np.int32)
+        cur = np.zeros((R,), np.int32)
+        base = np.zeros((R,), np.int32)
+        spec = np.zeros((R,), np.int32)
+        tbls = np.full((R, PPS), NULL_PAGE, np.int32)
         for i, seq in enumerate(seqs):
             if spec_lens[i] > 0:
                 self.pool.prepare_append(
                     seq.seq_id, seq.cached_len + spec_lens[i])
+                tbls[i] = self.pool.padded_block_table(seq.seq_id, PPS)
             cur[i] = seq.all_ids[-1]
             base[i] = seq.cached_len
+            spec[i] = spec_lens[i]
             seeds[i] = seq.seed or 0
+            gpos[i] = len(seq.tokens)
             temps[i] = seq.temperature
             top_ks[i] = seq.top_k or 0
             top_ps[i] = 1.0 if seq.top_p is None else seq.top_p
-        prob_steps = []
-        for j in range(k):
-            rows = [None] * R
-            for i, seq in enumerate(seqs):
-                if j >= spec_lens[i]:
-                    continue
-                rows[i] = (seq.seq_id, [int(cur[i])], int(base[i]) + j)
-                gpos[i] = len(seq.tokens) + j
-            if not any(r is not None for r in rows):
-                prob_steps.append(jnp.zeros((R, V), jnp.float32))
-                continue
-            tok, probs = self._dispatch(rows, seeds, gpos, temps, top_ks,
-                                        top_ps)
-            prob_steps.append(probs)
-            for i in range(n_rows):
-                if j < spec_lens[i]:
-                    d_toks[i, j] = tok[i]
-                    cur[i] = tok[i]
-        return d_toks, jnp.stack(prob_steps, axis=1)       # [R, k, V]
+        fn = self._propose_jits.get(k)
+        if fn is None:
+            fn = self._propose_jits[k] = self._build_propose(k)
+        self.launches += 1
+        self._launched = True
+        self._propose_launched = True
+        toks, probs, new_kv = fn(
+            self.params, self.pool.kv, jnp.asarray(tbls),
+            jnp.asarray(cur), jnp.asarray(base), jnp.asarray(spec),
+            jnp.asarray(seeds), jnp.asarray(gpos), jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps), self._base_key)
+        self.pool.kv = new_kv
+        toks = np.asarray(toks)                            # [k, R]
+        for i in range(n_rows):
+            s = spec_lens[i]
+            if s > 0:
+                d_toks[i, :s] = toks[:s, i]
+        # [R, k, V]; stays a device array — the verifier consumes it
+        return d_toks, jnp.transpose(probs, (1, 0, 2))
 
     def commit(self, seq_id, cached_old, accepted, spec_len):
         """Roll the draft pool back to the verified state: of the

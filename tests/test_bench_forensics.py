@@ -90,7 +90,12 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # speculative-decoding fields (PR 9): acceptance rate and
               # launches-per-token are per-run measurements
               "spec_target_steps_per_token", "spec_accept_rate",
-              "spec_decode_compiles"):
+              "spec_decode_compiles",
+              # gspmd sharding fields (PR 10): compile counts, HLO
+              # collective mix and per-device KV bytes are per-run
+              "gspmd_train_compiles", "gspmd_allreduce_count",
+              "gspmd_allgather_count", "gspmd_serving_decode_compiles",
+              "gspmd_sharded_kv_bytes_per_token"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -368,6 +373,30 @@ def test_proxy_bench_catches_disabled_speculation():
     assert failures == [], report
     assert good["metrics"]["spec_target_steps_per_token"] < 1.0
     assert good["metrics"]["spec_decode_compiles"] == 1
+
+
+def test_proxy_bench_catches_forced_dp_only_regime():
+    """End-to-end gspmd regression injection: run the gspmd probe with
+    the regime FORCED to data-parallel-only (no model axis) and gate
+    against the checked-in baseline — per-device sharded KV bytes/token
+    double past the exact bound and fail; the healthy collection of the
+    same probe must pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("gspmd",), gspmd_dp_only=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "gspmd_sharded_kv_bytes_per_token" in names
+    assert bad["metrics"]["gspmd_sharded_kv_bytes_per_token"] == \
+        2 * baseline["metrics"]["gspmd_sharded_kv_bytes_per_token"]
+
+    good = pb.collect(probes=("gspmd",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["gspmd_train_compiles"] == 1
+    assert good["metrics"]["gspmd_serving_decode_compiles"] == 1
 
 
 def test_spec_probe_never_fabricates_on_failure(monkeypatch):

@@ -411,6 +411,36 @@ def test_error_feedback_residual_carries(monkeypatch):
     coll.reset_quantized_allreduce_residuals()
 
 
+def test_error_feedback_regime_mismatch_resets(monkeypatch):
+    """Switching regimes/meshes mid-run (different group ranks or axis
+    under the same bucket key) must NOT silently re-inject the old
+    regime's residual: the store is keyed by (bucket, regime signature)
+    and a mismatch warns and resets (ISSUE 10 satellite)."""
+    from paddle_tpu.distributed import collective as coll
+    coll.reset_quantized_allreduce_residuals()
+    monkeypatch.setattr(coll, "_mp_active", lambda: True)
+    monkeypatch.setattr(coll, "_group_ranks", lambda g: [0])
+    monkeypatch.setattr(coll, "_is_global", lambda r: False)
+    monkeypatch.setattr(coll, "_subgroup_exchange",
+                        lambda payload, group, ranks: [payload])
+    rng = np.random.default_rng(5)
+    a = (rng.standard_normal(4096) * 0.1).astype(np.float32)
+    coll.quantized_all_reduce_sum(a, None, error_feedback_key="t")
+    sig0, res0 = coll._EF_RESIDUALS["t"]
+    assert sig0[1] == (0,) and np.abs(res0).max() > 0
+    # the "mesh" changes: same bucket key, different member ranks
+    monkeypatch.setattr(coll, "_group_ranks", lambda g: [0, 1])
+    with pytest.warns(UserWarning, match="resetting the residual"):
+        out = coll.quantized_all_reduce_sum(
+            a, None, error_feedback_key="t")
+    # the stale residual was dropped, not injected: the output equals a
+    # residual-free quantization round
+    coll.reset_quantized_allreduce_residuals()
+    clean = coll.quantized_all_reduce_sum(a, None)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+    coll.reset_quantized_allreduce_residuals()
+
+
 def test_fused_allreduce_gradients_buckets_flat(monkeypatch):
     """FLAGS_quantized_allreduce on: fused_allreduce_gradients ships ONE
     flat quantized buffer per grad dtype bucket (the fused-optimizer

@@ -106,6 +106,31 @@ def test_spec_self_draft_accepts_and_beats_one_step_per_token(tiny_model):
         "speculation must commit more than one token per target launch")
 
 
+def test_spec_propose_burst_one_launch_per_round(tiny_model):
+    """ROADMAP item 4 leftover: the draft's k proposal steps fold into
+    ONE jitted lax.scan burst — a spec round costs one propose launch
+    (plus its catch-up sync launches), not k, and the burst compiles
+    exactly once."""
+    k = 4
+    eng = LLMEngine(tiny_model, max_len=64, page_size=4, max_num_seqs=2,
+                    draft_model=tiny_model, spec_tokens=k)
+    rid = eng.add_request([1, 2, 3, 1, 2, 3, 1, 2], max_new_tokens=12)
+    outs = eng.run(max_steps=100)
+    assert outs[rid].status == "finished"
+    snap = eng.metrics_snapshot()
+    rounds = snap["spec_rounds"]
+    assert rounds >= 2
+    # per round: <= 1 sync chunk launch (the accepted tokens fit one
+    # chunk on this workload) + exactly 1 proposal burst. The host-loop
+    # path paid 1 + k launches per round.
+    assert snap["draft_launches"] <= 2 * rounds + 2, (
+        f"{snap['draft_launches']} draft launches over {rounds} rounds: "
+        f"the k-step proposal loop is dispatching per step again")
+    assert snap["draft_launches"] < rounds * (1 + k)
+    assert snap["draft_propose_compiles"] == 1
+    assert snap["draft_decode_compiles"] == 1
+
+
 def test_spec_greedy_identity_under_chunked_prefill(tiny_model, tiny_draft):
     """A long prompt chunks in through ordinary ragged rounds (spec
     rounds require every row caught-up), then speculation takes over —

@@ -288,6 +288,88 @@ def probe_spec_decode(paddle, spec_tokens=4, max_new=16):
                 "spec_decode_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_gspmd(paddle, dp_only=False):
+    """Measured GSPMD-sharding fields for the bench trajectory
+    (distributed/gspmd.py; needs a multi-device backend — the proxy
+    bench forces an 8-device host-CPU mesh, conftest.py's environment).
+
+    One micro TrainStep runs two steps under the ``tp=2,dp=n/2`` preset
+    and one micro tensor-parallel LLMEngine (mesh=2) serves a request:
+    - ``gspmd_train_compiles``: sharded step executables built (1 —
+      a second specialization means the annotations re-keyed the jit);
+    - ``gspmd_allreduce_count`` / ``gspmd_allgather_count``: collective
+      ops read from the compiled partitioned HLO — the proof the preset
+      produced the collective mix it promises, and a drift detector for
+      partitioner-behavior changes;
+    - ``gspmd_serving_decode_compiles``: the tensor-parallel engine's
+      ragged-step trace count (1 — the serving compile gate under a
+      mesh);
+    - ``gspmd_sharded_kv_bytes_per_token``: exact pool bytes one cached
+      token costs PER DEVICE with the kv-head axis split over the mesh
+      — the number that decides whether a model's KV fits one chip.
+    ``dp_only=True`` forces the data-parallel-only regime (model degree
+    1) — the proxy-bench regression-injection hook: per-device KV
+    bytes/token then double and the compare gate must catch it.
+    """
+    try:
+        import jax
+        import numpy as _np
+        import paddle_tpu.nn.functional as _F
+        from paddle_tpu import jit as _pjit
+        from paddle_tpu.distributed import gspmd as _g
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        n = len(jax.devices())
+        tp = 1 if (dp_only or n % 2) else 2
+        if n < 2:
+            raise RuntimeError(
+                f"{n} device(s): the gspmd probe needs a multi-device "
+                f"mesh (--xla_force_host_platform_device_count)")
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=256)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+
+        def loss_fn(ids):
+            logits = model(ids)
+            return _F.cross_entropy(
+                logits[:, :-1].reshape((-1, cfg.vocab_size)),
+                ids[:, 1:].reshape((-1,)))
+
+        step = _pjit.TrainStep(
+            model, loss_fn, opt,
+            sharding=_g.ShardingConfig(data=n // tp, model=tp))
+        rng = _np.random.default_rng(0)
+        for _ in range(2):
+            step(paddle.to_tensor(rng.integers(0, 256, (8, 16))))
+        cc = step.last_hlo_collectives or {}
+
+        paddle.seed(1)
+        smodel = LlamaForCausalLM(cfg)
+        eng = LLMEngine(smodel, max_len=64, page_size=8, max_num_seqs=2,
+                        mesh=tp if tp > 1 else None)
+        eng.add_request([1, 2, 3, 4, 5], max_new_tokens=6)
+        eng.run(max_steps=100)
+        return {
+            "gspmd_train_compiles": len(step._cache),
+            "gspmd_allreduce_count": cc.get("all_reduce"),
+            "gspmd_allgather_count": cc.get("all_gather"),
+            "gspmd_serving_decode_compiles": eng.decode_cache_size(),
+            "gspmd_sharded_kv_bytes_per_token":
+                eng.pool.kv_bytes_per_token_per_device,
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"gspmd_train_compiles": None,
+                "gspmd_allreduce_count": None,
+                "gspmd_allgather_count": None,
+                "gspmd_serving_decode_compiles": None,
+                "gspmd_sharded_kv_bytes_per_token": None,
+                "gspmd_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_input_pipeline(paddle, steps=16, log_freq=8):
     """Measured async-input-pipeline fields for the bench trajectory.
 
@@ -431,5 +513,6 @@ def probe_kv_accounting():
                 "kv_accounting_probe_error": f"{type(e).__name__}: {e}"}
 
 
-__all__ = ["probe_input_pipeline", "probe_jaxpr", "probe_kv_accounting",
-           "probe_opt_dispatches", "probe_serving", "probe_spec_decode"]
+__all__ = ["probe_gspmd", "probe_input_pipeline", "probe_jaxpr",
+           "probe_kv_accounting", "probe_opt_dispatches", "probe_serving",
+           "probe_spec_decode"]

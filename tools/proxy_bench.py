@@ -45,9 +45,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the gspmd probe needs a multi-device mesh; force the 8-device
+# host-CPU stand-in (the same environment tests/conftest.py pins for
+# the whole suite — XLA parses XLA_FLAGS at backend creation, so this
+# works as long as no device has been touched yet; on a real TPU the
+# flag only affects the host platform)
+if "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                               + os.environ.get("XLA_FLAGS", ""))
+
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
-PROBES = ("serving", "spec", "optimizer", "pipeline", "jaxpr",
+PROBES = ("serving", "spec", "gspmd", "optimizer", "pipeline", "jaxpr",
           "accounting")
 
 
@@ -56,6 +66,10 @@ class Gate:
 
     higher-is-worse: fail when cur > base * (1 + rel) + abs
     lower-is-worse:  fail when cur < base * (1 - rel) - abs
+    different-is-worse: fail when cur != base (exact two-sided pin —
+    for counts where a DROP is as suspicious as a rise, e.g. the GSPMD
+    collective mix: a rule-table miss that replicates params LOWERS the
+    all-gather count).
     Counts gate tightly (rel 0, small abs); ratios get slack for
     environment drift. A None measurement where the baseline has a
     number is always a failure — a probe that stopped measuring is a
@@ -63,18 +77,20 @@ class Gate:
     """
 
     def __init__(self, worse="higher", rel=0.0, abs_=0.0):
-        assert worse in ("higher", "lower")
+        assert worse in ("higher", "lower", "different")
         self.worse = worse
         self.rel = rel
         self.abs_ = abs_
 
     def bad(self, cur, base) -> bool:
+        if self.worse == "different":
+            return cur != base
         if self.worse == "higher":
             return cur > base * (1.0 + self.rel) + self.abs_
         return cur < base * (1.0 - self.rel) - self.abs_
 
     def bound(self, base) -> float:
-        if self.worse == "higher":
+        if self.worse in ("higher", "different"):
             return base * (1.0 + self.rel) + self.abs_
         return base * (1.0 - self.rel) - self.abs_
 
@@ -97,10 +113,22 @@ GATES = {
     "spec_target_steps_per_token": Gate("higher", 0.20, 0.02),
     "spec_accept_rate":         Gate("lower", 0.0, 0.15),
     "spec_decode_compiles":     Gate("higher", 0.0, 0.0),
+    # GSPMD sharding: compile counts stay 1 under the mesh, the
+    # collective mix of the tp=2 x dp=4 step is pinned exactly BOTH
+    # ways (more collectives = partitioner drift; FEWER = the rule
+    # table stopped matching and params silently replicated), and
+    # per-device sharded KV bytes/token is exact accounting — forcing
+    # the dp-only regime (--dp-only) doubles it and must fail the gate
+    "gspmd_train_compiles":     Gate("higher", 0.0, 0.0),
+    "gspmd_allreduce_count":    Gate("different"),
+    "gspmd_allgather_count":    Gate("different"),
+    "gspmd_serving_decode_compiles": Gate("higher", 0.0, 0.0),
+    "gspmd_sharded_kv_bytes_per_token": Gate("higher", 0.0, 0.0),
 }
 
 
-def collect(probes=PROBES, burst_tokens=8, spec_tokens=4) -> dict:
+def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
+            gspmd_dp_only=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -109,11 +137,14 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4) -> dict:
     ``spec_tokens=0`` disables the speculative draft the same way —
     target steps per committed token then reads exactly 1.0 and the
     ``spec_target_steps_per_token`` gate must catch it.
+    ``gspmd_dp_only=True`` forces the data-parallel-only regime (no
+    model axis) — per-device sharded KV bytes/token double and the
+    ``gspmd_sharded_kv_bytes_per_token`` gate must catch it.
     """
     import jax
     import paddle_tpu as paddle
-    from tools.bench_probes import (probe_input_pipeline, probe_jaxpr,
-                                    probe_kv_accounting,
+    from tools.bench_probes import (probe_gspmd, probe_input_pipeline,
+                                    probe_jaxpr, probe_kv_accounting,
                                     probe_opt_dispatches, probe_serving,
                                     probe_spec_decode)
     dev = jax.devices()[0]
@@ -137,6 +168,11 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4) -> dict:
         _take(probe_spec_decode(paddle, spec_tokens=spec_tokens),
               ("spec_target_steps_per_token", "spec_accept_rate",
                "spec_decode_compiles"))
+    if "gspmd" in probes:
+        _take(probe_gspmd(paddle, dp_only=gspmd_dp_only),
+              ("gspmd_train_compiles", "gspmd_allreduce_count",
+               "gspmd_allgather_count", "gspmd_serving_decode_compiles",
+               "gspmd_sharded_kv_bytes_per_token"))
     if "optimizer" in probes:
         _take(probe_opt_dispatches(paddle), ("opt_dispatches_per_step",))
     if "pipeline" in probes:
@@ -177,10 +213,10 @@ def gate(current, baseline, *, require_all=True):
             continue
         bad = g.bad(cur, ref)
         flag = "  << REGRESSION" if bad else ""
+        op = {"higher": ">", "lower": "<", "different": "!="}[g.worse]
         lines.append(
             f"  {name:<28} {cur:>12.4f}   baseline {ref:>10.4f}   "
-            f"(fail {'>' if g.worse == 'higher' else '<'} "
-            f"{g.bound(ref):.4f}){flag}")
+            f"(fail {op} {g.bound(ref):.4f}){flag}")
         if bad:
             failures.append(
                 (name, f"{cur} vs baseline {ref} "
@@ -212,6 +248,10 @@ def main(argv=None) -> int:
     ap.add_argument("--spec-tokens", type=int, default=4,
                     help="spec probe draft length (0 disables the draft "
                          "— one target launch per token again)")
+    ap.add_argument("--dp-only", action="store_true",
+                    help="force the gspmd probe's data-parallel-only "
+                         "regime (no model axis — per-device sharded KV "
+                         "bytes/token double; the injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -234,7 +274,8 @@ def main(argv=None) -> int:
               "recording would shrink gate coverage)", file=sys.stderr)
         return 2
     current = collect(probes=probes, burst_tokens=args.burst_tokens,
-                      spec_tokens=args.spec_tokens)
+                      spec_tokens=args.spec_tokens,
+                      gspmd_dp_only=args.dp_only)
 
     if args.json:
         # --json changes the output format, never the action: combined
