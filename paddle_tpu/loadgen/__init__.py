@@ -36,10 +36,13 @@ from .workload import (ARRIVALS, TraceRequest, WorkloadSpec,  # noqa: F401
                        trace_fingerprint)
 from .driver import (Driver, RequestRecord, RunResult,  # noqa: F401
                      VirtualClock, run_workload)
-from .report import (SCHEMA_VERSION, build_report,  # noqa: F401
-                     report_json)
+from .cluster import (ClusterDriver, ClusterRunResult,  # noqa: F401
+                      run_cluster_workload)
+from .report import (SCHEMA_VERSION, build_cluster_report,  # noqa: F401
+                     build_report, report_json)
 
-__all__ = ["ARRIVALS", "Driver", "RequestRecord", "RunResult",
-           "SCHEMA_VERSION", "TraceRequest", "VirtualClock",
-           "WorkloadSpec", "build_report", "report_json", "run_workload",
-           "trace_fingerprint"]
+__all__ = ["ARRIVALS", "ClusterDriver", "ClusterRunResult", "Driver",
+           "RequestRecord", "RunResult", "SCHEMA_VERSION", "TraceRequest",
+           "VirtualClock", "WorkloadSpec", "build_cluster_report",
+           "build_report", "report_json", "run_cluster_workload",
+           "run_workload", "trace_fingerprint"]

@@ -73,6 +73,9 @@ class RequestRecord:
     status: str = "pending"
     finish_reason: str | None = None
     num_preemptions: int = 0
+    #: cluster runs only: times the request was requeued to another
+    #: replica after a crash/drain (0 under the single-engine driver)
+    num_retries: int = 0
 
     # latencies anchor on the TRACE arrival time, not submitted_at: the
     # client started waiting when the request arrived, and the
@@ -163,19 +166,7 @@ class Driver:
     def run(self, trace) -> RunResult:
         eng = self.engine
         clock = self.clock
-        ids = [r.request_id for r in trace]
-        if len(set(ids)) != len(ids):
-            dups = sorted({i for i in ids if ids.count(i) > 1})
-            raise ValueError(
-                f"trace has duplicate request_ids {dups[:5]} — "
-                f"concatenated specs must use distinct seeds (ids embed "
-                f"the seed) or distinct explicit ids")
-        records = {r.request_id: RequestRecord(
-            request_id=r.request_id, arrival_s=r.arrival_s,
-            prompt_len=len(r.prompt_token_ids),
-            max_new_tokens=r.max_new_tokens, deadline_s=r.deadline_s,
-            slo_e2e_s=r.slo_e2e_s, prefix_cohort=r.prefix_cohort)
-            for r in trace}
+        records = build_trace_records(trace)
         result = RunResult(records=[records[r.request_id] for r in trace],
                            step_time_s=self.step_time_s,
                            page_capacity=eng.pool.capacity)
@@ -202,6 +193,7 @@ class Driver:
                         seed=getattr(req, "seed", None),
                         eos_token_id=req.eos_token_id,
                         deadline_s=req.deadline_s,
+                        abort_after_s=getattr(req, "abort_after_s", None),
                         request_id=req.request_id)
                     rec.status = "waiting"
                 except RequestRejected:
@@ -261,7 +253,12 @@ class Driver:
 
     @staticmethod
     def _absorb(rec: RequestRecord, out, now: float):
-        """Fold one touched RequestOutput into the record at time now."""
+        """Fold one touched RequestOutput into the record at time now.
+
+        Shared verbatim by the cluster driver (loadgen/cluster.py): a
+        requeued request's token list resets and regrows, so ``new`` is
+        non-positive until genuinely new positions appear — only those
+        get fresh timestamps, which is exactly this logic."""
         new = len(out.token_ids) - rec.num_tokens
         if new > 0:
             if rec.first_token_at is None:
@@ -273,6 +270,25 @@ class Driver:
         if out.finished and rec.finished_at is None:
             rec.finished_at = now
             rec.finish_reason = out.finish_reason
+
+
+def build_trace_records(trace) -> dict:
+    """Validate trace ids and build the per-request record map — shared
+    by the single-engine and cluster drivers so the two byte-compared
+    artifacts can never fork on record construction."""
+    ids = [r.request_id for r in trace]
+    if len(set(ids)) != len(ids):
+        dups = sorted({i for i in ids if ids.count(i) > 1})
+        raise ValueError(
+            f"trace has duplicate request_ids {dups[:5]} — "
+            f"concatenated specs must use distinct seeds (ids embed "
+            f"the seed) or distinct explicit ids")
+    return {r.request_id: RequestRecord(
+        request_id=r.request_id, arrival_s=r.arrival_s,
+        prompt_len=len(r.prompt_token_ids),
+        max_new_tokens=r.max_new_tokens, deadline_s=r.deadline_s,
+        slo_e2e_s=r.slo_e2e_s, prefix_cohort=r.prefix_cohort)
+        for r in trace}
 
 
 def run_workload(engine, clock, spec_or_trace, **driver_kw) -> RunResult:

@@ -42,18 +42,19 @@ def _dist(values) -> dict:
             "max": max(vals)}
 
 
-def build_report(result, *, spec=None, trace=None) -> dict:
-    """RunResult (+ spec/trace context) -> the artifact dict."""
+def _core_sections(result, spec, trace) -> dict:
+    """The sections the single-engine and cluster artifacts share —
+    schema version, workload identity, request outcomes, EXACT latency
+    percentiles, goodput, and base throughput — built once so the two
+    builders cannot silently fork (both artifacts are byte-compared by
+    the determinism gates)."""
     recs = result.records
     statuses = result.by_status()
     finished = [r for r in recs if r.status == "finished"]
     total = len(recs)
     good = sum(1 for r in recs if r.in_slo)
     tokens = sum(r.num_tokens for r in recs)
-    m = result.metrics or {}
-    hits = m.get("prefix_cache_hits", 0)
-    misses = m.get("prefix_cache_misses", 0)
-    report = {
+    return {
         "schema_version": SCHEMA_VERSION,
         "workload": {
             "spec": spec.describe() if spec is not None else None,
@@ -72,7 +73,6 @@ def build_report(result, *, spec=None, trace=None) -> dict:
                                         "preempted")),
             "preempted_requests": sum(1 for r in recs
                                       if r.num_preemptions > 0),
-            "preemptions": m.get("preemptions", 0),
         },
         "latency": {
             "ttft_s": _dist([r.ttft_s for r in finished]),
@@ -91,11 +91,25 @@ def build_report(result, *, spec=None, trace=None) -> dict:
             if result.duration_s > 0 else None,
             "steps": result.steps,
             "step_time_s": result.step_time_s,
-            "host_dispatches": m.get("host_dispatches", 0),
-            "host_dispatches_per_token": m.get("host_dispatches", 0)
-            / tokens if tokens else None,
-            "burst_tokens": m.get("burst_tokens"),
         },
+    }
+
+
+def build_report(result, *, spec=None, trace=None) -> dict:
+    """RunResult (+ spec/trace context) -> the artifact dict."""
+    m = result.metrics or {}
+    tokens = sum(r.num_tokens for r in result.records)
+    hits = m.get("prefix_cache_hits", 0)
+    misses = m.get("prefix_cache_misses", 0)
+    report = _core_sections(result, spec, trace)
+    report["requests"]["preemptions"] = m.get("preemptions", 0)
+    report["throughput"].update({
+        "host_dispatches": m.get("host_dispatches", 0),
+        "host_dispatches_per_token": m.get("host_dispatches", 0)
+        / tokens if tokens else None,
+        "burst_tokens": m.get("burst_tokens"),
+    })
+    report.update({
         "kv_pressure": {
             "peak_page_utilization": result.peak_page_utilization,
             "peak_used_pages": result.peak_used_pages,
@@ -124,7 +138,80 @@ def build_report(result, *, spec=None, trace=None) -> dict:
             "cow_copies": m.get("cow_copies", 0),
             "pinned_prefix_hits": m.get("pinned_prefix_hits", 0),
         },
-    }
+    })
+    return report
+
+
+def build_cluster_report(result, *, spec=None, trace=None,
+                         faults=None) -> dict:
+    """ClusterRunResult (+ spec/trace/fault-script context) -> the
+    fleet artifact dict: everything the single-engine report has at
+    fleet scope (exact percentiles over every request record, goodput,
+    outcome counts) PLUS the robustness story — retries and
+    budget-sheds, crash/drain/flaky/recovery counts, per-replica
+    state-machine time (time-in-degraded-state included), degradation
+    ladder transitions, and the fault script that caused it all.
+    Serialize with :func:`report_json` for the byte-identity gate."""
+    recs = result.records
+    m = result.metrics or {}
+    reps = m.get("replicas", [])
+    tis = m.get("time_in_state_s", {})
+
+    def _csum(key):
+        return sum(r["counters"].get(key, 0) for r in reps)
+
+    report = _core_sections(result, spec, trace)
+    report["requests"].update({
+        "preemptions": _csum("preemptions"),
+        "deadline_aborts": _csum("deadline_aborts"),
+        "nonfinite_rows": _csum("nonfinite_rows"),
+        "retried_requests": sum(1 for r in recs
+                                if r.num_retries > 0),
+    })
+    report.update({
+        "queue": {
+            "peak_queue_depth": result.peak_queue_depth,
+            "peak_running": result.peak_running,
+            "peak_parked": result.peak_parked,
+        },
+        "kv_pressure": {
+            "peak_page_utilization": max(
+                result.per_replica_peak_utilization.values(), default=0.0),
+            "per_replica_peak_utilization": {
+                str(k): v for k, v
+                in sorted(result.per_replica_peak_utilization.items())},
+            "over_allocated": False if result.invariant_checks > 0
+            else None,
+            "invariant_checks": result.invariant_checks,
+        },
+        "cluster": {
+            "num_replicas": m.get("num_replicas"),
+            "retry_budget": m.get("retry_budget"),
+            "retries": m.get("retries", 0),
+            "retry_budget_sheds": m.get("retry_budget_sheds", 0),
+            "fleet_unavailable_sheds": m.get("fleet_unavailable_sheds", 0),
+            "crashes": m.get("crashes", 0),
+            "recoveries": m.get("recoveries", 0),
+            "drains": m.get("drains", 0),
+            "flaky_steps": m.get("flaky_steps", 0),
+            "engine_errors": m.get("engine_errors", 0),
+            "kv_pressure_faults": m.get("kv_pressure_faults", 0),
+            "slowdown_faults": m.get("slowdown_faults", 0),
+            "router_decisions": m.get("router_decisions", 0),
+            "affinity_hits": m.get("affinity_hits", 0),
+            "state_transitions": m.get("state_transitions", 0),
+            "time_in_state_s": tis,
+            "time_degraded_s": tis.get("degraded", 0.0),
+            "degradation": {
+                "escalations": _csum("degradation_escalations"),
+                "restorations": _csum("degradation_restorations"),
+                "final_levels": [r.get("degradation_level", 0)
+                                 for r in reps],
+            },
+            "faults": faults.describe() if faults is not None else None,
+            "per_replica": reps,
+        },
+    })
     return report
 
 
@@ -144,4 +231,5 @@ def report_json(report) -> str:
     return json.dumps(_round_floats(report), sort_keys=True, indent=1)
 
 
-__all__ = ["SCHEMA_VERSION", "build_report", "report_json"]
+__all__ = ["SCHEMA_VERSION", "build_cluster_report", "build_report",
+           "report_json"]

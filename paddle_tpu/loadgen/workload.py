@@ -28,11 +28,17 @@ from __future__ import annotations
 
 import hashlib
 import json
+import math
 from dataclasses import dataclass, asdict
 
 import numpy as np
 
-ARRIVALS = ("poisson", "deterministic")
+#: arrival processes: the classic pair plus two time-varying shapes a
+#: million-user front door actually sees — ``diurnal`` (sinusoidal rate
+#: modulation, the day/night cycle compressed onto the virtual clock)
+#: and ``flash_crowd`` (a multiplicative rate spike over a window — the
+#: thundering herd the degradation ladder exists for)
+ARRIVALS = ("poisson", "deterministic", "diurnal", "flash_crowd")
 
 
 @dataclass(frozen=True)
@@ -44,6 +50,11 @@ class TraceRequest:
     max_new_tokens: int
     deadline_s: float | None = None
     slo_e2e_s: float | None = None
+    #: mid-flight abort SLO (serving/scheduler.py ``abort_expired``): a
+    #: request still unfinished this long after submission is aborted at
+    #: a step boundary (reason "deadline_exceeded") — unlike
+    #: ``deadline_s`` it applies to RUNNING requests too
+    abort_after_s: float | None = None
     temperature: float = 0.0
     #: per-request sampling knobs (serving/engine.py): 0 / 1.0 = off;
     #: seed None lets the engine derive one from the request_id
@@ -77,6 +88,18 @@ class WorkloadSpec:
     num_shared_prefixes: int = 1
     deadline_s: float | None = None
     slo_e2e_s: float | None = None
+    abort_after_s: float | None = None
+    #: time-varying arrival-shape knobs. ``diurnal``: the instantaneous
+    #: rate is ``arrival_rate * (1 + rate_amplitude * sin(2*pi*t /
+    #: rate_period_s))``. ``flash_crowd``: the rate is multiplied by
+    #: ``flash_multiplier`` inside the window ``[flash_at_s, flash_at_s
+    #: + flash_duration_s)``. Ignored (and draw-free) for the classic
+    #: arrivals, so pre-existing traces byte-persist.
+    rate_period_s: float = 10.0
+    rate_amplitude: float = 0.5
+    flash_at_s: float = 1.0
+    flash_duration_s: float = 1.0
+    flash_multiplier: float = 8.0
     temperature: float = 0.0
     #: per-request sampling-knob ranges (inclusive): each request draws
     #: its own top_k from ``top_k`` ((0, 0) = off), its own top_p
@@ -123,6 +146,23 @@ class WorkloadSpec:
                 raise ValueError("num_shared_prefixes must be >= 1")
         if self.vocab_size < 2:
             raise ValueError("vocab_size must be >= 2")
+        if self.arrival == "diurnal":
+            if self.rate_period_s <= 0:
+                raise ValueError("rate_period_s must be > 0")
+            if not 0.0 <= self.rate_amplitude < 1.0:
+                raise ValueError(
+                    f"rate_amplitude must be in [0, 1) (the instantaneous "
+                    f"rate must stay positive), got {self.rate_amplitude}")
+        if self.arrival == "flash_crowd":
+            if self.flash_at_s < 0 or self.flash_duration_s <= 0:
+                raise ValueError("flash window must satisfy flash_at_s "
+                                 ">= 0 and flash_duration_s > 0")
+            if self.flash_multiplier < 1.0:
+                raise ValueError(
+                    f"flash_multiplier must be >= 1, "
+                    f"got {self.flash_multiplier}")
+        if self.abort_after_s is not None and self.abort_after_s <= 0:
+            raise ValueError("abort_after_s must be > 0 (or None)")
         klo, khi = self.top_k
         if not 0 <= klo <= khi:
             raise ValueError(f"top_k must be an inclusive range "
@@ -156,10 +196,23 @@ class WorkloadSpec:
         t = 0.0
         trace = []
         for i in range(self.num_requests):
-            if self.arrival == "poisson":
-                t += float(rng.exponential(1.0 / self.arrival_rate))
-            else:
+            if self.arrival == "deterministic":
                 t = i / self.arrival_rate
+            else:
+                # Poisson family: the instantaneous rate may vary with
+                # the CURRENT time (local-rate approximation of an
+                # inhomogeneous process — deterministic given the seed).
+                # Plain "poisson" draws exactly what it always drew, so
+                # pre-existing trace fingerprints are unchanged.
+                rate = self.arrival_rate
+                if self.arrival == "diurnal":
+                    rate *= 1.0 + self.rate_amplitude * math.sin(
+                        2.0 * math.pi * t / self.rate_period_s)
+                elif self.arrival == "flash_crowd":
+                    if self.flash_at_s <= t \
+                            < self.flash_at_s + self.flash_duration_s:
+                        rate *= self.flash_multiplier
+                t += float(rng.exponential(1.0 / max(rate, 1e-9)))
             plen = int(rng.integers(plo, phi + 1))
             olen = int(rng.integers(olo, ohi + 1))
             cohort = -1
@@ -194,6 +247,7 @@ class WorkloadSpec:
                 request_id=f"lg-{self.seed}-{i}", arrival_s=t,
                 prompt_token_ids=prompt, max_new_tokens=olen,
                 deadline_s=self.deadline_s, slo_e2e_s=self.slo_e2e_s,
+                abort_after_s=self.abort_after_s,
                 temperature=self.temperature, top_k=tk, top_p=tp,
                 seed=seed, eos_token_id=self.eos_token_id,
                 prefix_cohort=cohort))
@@ -207,7 +261,8 @@ def trace_fingerprint(trace) -> str:
         [[r.request_id, repr(r.arrival_s), list(r.prompt_token_ids),
           r.max_new_tokens, r.deadline_s, r.slo_e2e_s, r.temperature,
           r.top_k, repr(r.top_p), r.seed,
-          r.eos_token_id, r.prefix_cohort] for r in trace],
+          r.eos_token_id, r.prefix_cohort,
+          getattr(r, "abort_after_s", None)] for r in trace],
         sort_keys=True)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 

@@ -19,8 +19,16 @@ Layers (docs/SERVING.md has the full architecture):
   and exact rejection sampling (``LLMEngine(draft_model=...)``).
 - :mod:`metrics` — ``ServingMetrics``: counters/gauges exported to
   bench.py and the profiler timeline.
+- :mod:`cluster` — ``ClusterEngine`` + ``DegradationLadder`` +
+  ``ReplicaState``: N replicas behind a health-aware router with a
+  replica lifecycle state machine, retry-with-backoff requeue, and a
+  hysteretic graceful-degradation ladder per replica.
+- :mod:`faults` — ``FaultSchedule``/``FaultEvent``: seeded,
+  virtual-clock fault injection (crash/drain/slowdown/kv-pressure/
+  flaky) so fleet robustness claims reproduce byte-for-byte chip-free.
 """
-from .kv_cache import PagedKVPool, PoolExhausted, NULL_PAGE  # noqa: F401
+from .kv_cache import (InvariantViolation, PagedKVPool,  # noqa: F401
+                       PoolExhausted, NULL_PAGE)
 from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
                         Sequence, SequenceStatus, StepPlan, bucket_for)
 from .spec_decode import DraftWorker, speculative_sample  # noqa: F401
@@ -28,9 +36,16 @@ from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
                      RequestRejected)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       percentile_of)
+from .faults import (FaultEvent, FaultSchedule,  # noqa: F401
+                     InjectedFault)
+from .cluster import (ClusterEngine, DegradationLadder,  # noqa: F401
+                      ReplicaState)
 
-__all__ = ["BurstPlan", "DraftWorker", "Histogram", "LLMEngine",
+__all__ = ["BurstPlan", "ClusterEngine", "DegradationLadder",
+           "DraftWorker", "FaultEvent", "FaultSchedule", "Histogram",
+           "InjectedFault", "InvariantViolation", "LLMEngine",
            "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
-           "PoolExhausted", "NULL_PAGE", "Scheduler", "SchedulerConfig",
-           "Sequence", "SequenceStatus", "StepPlan", "ServingMetrics",
-           "bucket_for", "percentile_of", "speculative_sample"]
+           "PoolExhausted", "NULL_PAGE", "ReplicaState", "Scheduler",
+           "SchedulerConfig", "Sequence", "SequenceStatus", "StepPlan",
+           "ServingMetrics", "bucket_for", "percentile_of",
+           "speculative_sample"]

@@ -1,0 +1,838 @@
+"""Cluster-scale serving under failure: replicated engines behind a
+health-aware router, with fault injection and graceful degradation.
+
+Everything below the cluster is the existing single-replica stack — N
+independent :class:`~paddle_tpu.serving.engine.LLMEngine` replicas, each
+with its own paged KV pool, scheduler, and metrics. This module owns the
+fleet layer a million-user front door actually needs:
+
+- **Routing** — session-affinity first (a session's requests keep
+  landing on one replica while it stays admittable, so its prefix-cache
+  chains keep hitting), then power-of-two-choices admission: two
+  candidate replicas drawn from a seeded stream, the request goes to
+  the healthier one. Health comes from each replica's
+  ``metrics_snapshot()``: queue depth/age, KV watermark pressure
+  (demand utilization, pinned cache excluded), degradation level, and
+  the cluster-observed consecutive-step latency multiplier.
+- **Lifecycle state machine** — ``HEALTHY -> DEGRADED -> DRAINING ->
+  DOWN -> RECOVERING``: DEGRADED tracks the replica's degradation
+  ladder (hysteretic, see below); DRAINING freezes admission and
+  requeues waiting work while running rows finish; DOWN discards the
+  engine entirely; RECOVERING warms a fresh engine for
+  ``recovery_steps`` rounds before taking traffic again. Every
+  transition is timestamped, so time-in-state is reportable.
+- **Retry-with-backoff** — requests on a failed/drained replica are
+  requeued to a survivor (re-prefill rides the normal admission path,
+  hitting the survivor's prefix-hash cache when a cohort mate warmed
+  it). Each requeue burns one unit of the request's ``retry_budget``
+  and waits an exponential backoff before redispatch; an exhausted
+  budget converts to a STRUCTURED shed (``finish_reason
+  "retries_exhausted"``) instead of a hang. Duplicate finalization is
+  impossible by construction: a replica's outputs are only absorbed
+  while it is the request's CURRENT assignment, and terminal cluster
+  outputs never regress.
+- **Fault injection** — a :class:`~paddle_tpu.serving.faults.
+  FaultSchedule` fires crash / drain / slowdown / kv-pressure / flaky
+  events at virtual-clock step boundaries (serving/faults.py), so
+  fleet-level robustness claims are reproducible chip-free: the same
+  seed reproduces the same crashes, requeues, and report bytes.
+
+Token identity under failure: every replica is built with the SAME
+engine seed, so a request's sampling streams
+(models/generation.request_keys) are identical wherever it lands; a
+retried request re-prefills from scratch on its new replica and
+regenerates the SAME tokens (greedy trivially, sampled because draws are
+pure functions of (seed, generation position)). The kill-one-of-three
+acceptance gate (tests/test_cluster.py) compares a faulted cluster run
+token-for-token against a fault-free single engine.
+
+The **graceful-degradation ladder** (:class:`DegradationLadder`) lives
+inside each replica: under sustained watermark/queue pressure it sheds
+optional work one rung at a time — (1) disable speculative decoding,
+(2) shrink the decode burst to per-token, (3) evict pinned prefix
+chains, (4) tighten admission (high watermark down to the low line,
+one prefill per step) — and restores rung by rung, hysteretically, when
+pressure clears. Every transition lands on the engine's own metrics
+(``degradation_escalations`` / ``degradation_restorations`` counters,
+``degradation_level`` gauge), so the loadgen report can show exactly
+what service level a flash crowd cost.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import random
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .engine import LLMEngine, Request, RequestOutput, RequestRejected
+from .faults import FaultSchedule, InjectedFault
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"      # serving, but the ladder shed optional work
+    DRAINING = "draining"      # no admissions; running rows finish
+    DOWN = "down"              # engine discarded; requests requeued
+    RECOVERING = "recovering"  # fresh engine warming, not yet routable
+
+
+#: states whose engine steps run each cluster round
+ACTIVE_STATES = (ReplicaState.HEALTHY, ReplicaState.DEGRADED,
+                 ReplicaState.DRAINING)
+#: states the router may assign new work to
+ADMITTABLE_STATES = (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+
+#: per-replica lifetime counters the cluster report needs to survive a
+#: crash (an engine dies with its ServingMetrics — these are folded into
+#: the replica's carry dict before the engine is discarded)
+_CARRIED_COUNTERS = ("tokens_generated", "finished_requests", "prefills",
+                     "preemptions", "shed_requests", "deadline_aborts",
+                     "nonfinite_rows", "degradation_escalations",
+                     "degradation_restorations", "host_dispatches")
+
+
+class DegradationLadder:
+    """Hysteretic pressure response: shed optional work rung by rung.
+
+    ``observe()`` runs once per engine step. ``engage_after``
+    consecutive pressured steps climb one rung; ``restore_after``
+    consecutive calm steps descend one — so the ladder neither flaps at
+    the watermark line nor restores into the same pressure that
+    engaged it. Rungs, in shed order (restore is the exact reverse):
+
+    1. ``spec_off`` — disable speculative decoding (drops the draft
+       model's launches; the verification executable is untouched).
+    2. ``burst_shrink`` — collapse the on-device burst to per-token
+       (latency quantization gone; admission/shed decisions regain
+       per-step granularity under load).
+    3. ``pinned_evict`` — evict every pinned prefix chain and zero the
+       pin budget (cache yields its pages to demand).
+    4. ``admission_tight`` — pull the pool's high watermark down to the
+       low line and admit at most one prefill per step.
+
+    Every transition increments ``degradation_escalations`` /
+    ``degradation_restorations`` and moves the ``degradation_level``
+    gauge on the ENGINE's own metrics, so single-engine operators and
+    the cluster report read the same signals.
+    """
+
+    RUNGS = ("spec_off", "burst_shrink", "pinned_evict", "admission_tight")
+
+    def __init__(self, engine: LLMEngine, *, engage_after=3,
+                 restore_after=8, queue_age_slo_s=None):
+        if engage_after < 1 or restore_after < 1:
+            raise ValueError("engage_after/restore_after must be >= 1")
+        self.engine = engine
+        self.engage_after = int(engage_after)
+        self.restore_after = int(restore_after)
+        #: optional queue-age pressure source: the oldest waiter sitting
+        #: longer than this reads as pressure even below the watermark
+        self.queue_age_slo_s = queue_age_slo_s
+        self.level = 0
+        self._hot = 0
+        self._cool = 0
+        self._saved: dict = {}
+
+    def pressure(self) -> bool:
+        eng = self.engine
+        if eng.pool.above_high_watermark():
+            return True
+        if self.queue_age_slo_s is not None and \
+                eng.scheduler.max_queue_wait() > self.queue_age_slo_s:
+            return True
+        return False
+
+    def observe(self):
+        """One hysteresis tick; call after each engine step."""
+        if self.pressure():
+            self._hot += 1
+            self._cool = 0
+            if self._hot >= self.engage_after and \
+                    self.level < len(self.RUNGS):
+                self._engage(self.RUNGS[self.level])
+                self.level += 1
+                self._hot = 0
+                self.engine.metrics.degradation_escalations.inc()
+                self.engine.metrics.degradation_level.set(self.level)
+        else:
+            self._cool += 1
+            self._hot = 0
+            if self._cool >= self.restore_after and self.level > 0:
+                self.level -= 1
+                self._restore(self.RUNGS[self.level])
+                self._cool = 0
+                self.engine.metrics.degradation_restorations.inc()
+                self.engine.metrics.degradation_level.set(self.level)
+
+    def _engage(self, rung: str):
+        eng = self.engine
+        if rung == "spec_off":
+            self._saved[rung] = eng.spec_enabled
+            eng.spec_enabled = False
+        elif rung == "burst_shrink":
+            self._saved[rung] = eng.burst_tokens
+            eng.burst_tokens = 1
+        elif rung == "pinned_evict":
+            self._saved[rung] = eng.pool.pinned_page_budget
+            for cid in list(eng.pool._pins):
+                eng.pool.unpin(cid)
+                eng.pool.pin_evictions += 1
+            eng._pinned_index.clear()
+            eng.pool.pinned_page_budget = 0
+        elif rung == "admission_tight":
+            self._saved[rung] = (eng.pool.high_watermark,
+                                 eng.scheduler.config.max_prefills_per_step)
+            eng.pool.high_watermark = eng.pool.low_watermark
+            eng.scheduler.config.max_prefills_per_step = 1
+
+    def _restore(self, rung: str):
+        eng = self.engine
+        if rung == "spec_off":
+            eng.spec_enabled = self._saved.pop(rung)
+        elif rung == "burst_shrink":
+            eng.burst_tokens = self._saved.pop(rung)
+        elif rung == "pinned_evict":
+            # the evicted chains are gone (cache, not demand) — only the
+            # budget comes back, and traffic repopulates it
+            eng.pool.pinned_page_budget = self._saved.pop(rung)
+        elif rung == "admission_tight":
+            hw, mpps = self._saved.pop(rung)
+            eng.pool.high_watermark = hw
+            eng.scheduler.config.max_prefills_per_step = mpps
+
+
+@dataclass
+class _Replica:
+    """Cluster-side state of one engine replica."""
+    rid: int
+    engine: LLMEngine | None
+    ladder: DegradationLadder | None
+    state: ReplicaState = ReplicaState.HEALTHY
+    state_since: float = 0.0
+    state_time: dict = field(default_factory=dict)
+    health: dict = field(default_factory=dict)
+    steps: int = 0
+    slow_multiplier: float = 1.0
+    slow_until: float | None = None
+    _slow_credit: float = 0.0
+    drain_until: float | None = None
+    flaky_until: float | None = None
+    ballast_until: float | None = None
+    recover_at: float | None = None
+    recover_steps_left: int = 0
+    consecutive_flaky: int = 0
+    #: lifetime counters folded in from engines this replica lost
+    carried: dict = field(default_factory=dict)
+
+    def counter(self, name: str) -> int:
+        v = self.carried.get(name, 0)
+        if self.engine is not None:
+            v += getattr(self.engine.metrics, name).value
+        return v
+
+    @property
+    def ballast_id(self) -> str:
+        return f"__fault_ballast_{self.rid}__"
+
+
+class ClusterEngine:
+    """N ``LLMEngine`` replicas behind a health-aware router.
+
+    Drives like an engine: ``add_request`` routes (or parks, when no
+    replica is admittable), ``step()`` runs one cluster round — fault
+    events, state transitions, retry redispatch, one engine step per
+    active replica — and returns the touched cluster-level
+    ``RequestOutput``\\ s. ``paddle_tpu.loadgen.ClusterDriver`` replays
+    workload traces against it on one virtual clock.
+    """
+
+    def __init__(self, model, num_replicas=2, *, seed=0,
+                 now_fn=time.monotonic, retry_budget=2,
+                 retry_backoff_s=0.02, session_affinity=True,
+                 recovery_steps=2, crash_after_flaky=3,
+                 crash_recover_s=None, faults: FaultSchedule | None = None,
+                 ladder=True, ladder_kw=None, **engine_kw):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, "
+                             f"got {num_replicas}")
+        if retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+        self.num_replicas = num_replicas
+        self._now = now_fn
+        self.retry_budget = int(retry_budget)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.session_affinity = session_affinity
+        self.recovery_steps = int(recovery_steps)
+        self.crash_after_flaky = int(crash_after_flaky)
+        #: DOWN -> RECOVERING delay for UNSCHEDULED crashes (a real
+        #: engine exception or a flaky escalation); scheduled crash
+        #: events carry their own recover_s. None = stays down.
+        self.crash_recover_s = crash_recover_s
+        self._model = model
+        self._seed = seed
+        self._engine_kw = dict(engine_kw)
+        self._ladder_on = ladder
+        self._ladder_kw = dict(ladder_kw or {})
+        #: seeded router stream: power-of-two-choices candidate draws
+        #: are the cluster's ONLY randomness, and it is deterministic
+        self._rng = random.Random(seed)
+        #: fault script + private read cursor (the schedule is immutable)
+        self._fault_events = tuple(faults) if faults is not None else ()
+        self._fault_cursor = 0
+        self.faults = faults
+        self.counters = {k: 0 for k in (
+            "retries", "retry_budget_sheds", "fleet_unavailable_sheds",
+            "crashes", "recoveries", "drains", "flaky_steps",
+            "engine_errors", "router_decisions", "affinity_hits",
+            "state_transitions", "kv_pressure_faults", "slowdown_faults")}
+        now = self._now()
+        self.replicas = [self._new_replica(i, now)
+                         for i in range(num_replicas)]
+        self._requests: dict[str, Request] = {}
+        self._meta: dict[str, dict] = {}
+        self._outputs: dict[str, RequestOutput] = {}
+        #: insertion-ordered unfinished-request index (dict, NOT set:
+        #: str-set iteration order is hash-randomized per process and
+        #: crash-victim requeue order must stay byte-reproducible) —
+        #: keeps has_unfinished()/crash scans O(live), not O(ever)
+        self._unfinished: dict[str, None] = {}
+        self._affinity: dict[object, int] = {}
+        self._parked: deque[str] = deque()
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------------
+    # replica construction / health
+    # ------------------------------------------------------------------
+    def _new_engine(self) -> LLMEngine:
+        # every replica gets the SAME engine seed: a request's sampling
+        # streams are pure functions of (engine seed, request seed,
+        # position), so a retry on another replica regenerates the same
+        # tokens — the cross-replica token-identity contract
+        return LLMEngine(self._model, now_fn=self._now, seed=self._seed,
+                         **self._engine_kw)
+
+    def _new_replica(self, rid: int, now: float) -> _Replica:
+        eng = self._new_engine()
+        ladder = DegradationLadder(eng, **self._ladder_kw) \
+            if self._ladder_on else None
+        rep = _Replica(rid=rid, engine=eng, ladder=ladder,
+                       state=ReplicaState.HEALTHY, state_since=now)
+        rep.health = self._health_of(rep)
+        return rep
+
+    def _health_of(self, rep: _Replica) -> dict:
+        """Router health view — the same four signals the replica's
+        ``metrics_snapshot()`` gauges expose, read straight off the live
+        scheduler/ladder (this runs per replica per cluster round; the
+        full snapshot sorts every latency reservoir, far too heavy for
+        the routing hot path), plus cluster-side observations
+        (consecutive-step latency)."""
+        eng = rep.engine
+        pool = eng.pool
+        demand = (pool.used_pages - pool.evictable_pages) / pool.capacity
+        return {
+            "queue_depth": int(eng.scheduler.queue_depth()),
+            "running": len(eng.scheduler.running),
+            "queue_age_s": float(eng.scheduler.max_queue_wait()),
+            "kv_pressure": demand,
+            "degradation_level": rep.ladder.level
+            if rep.ladder is not None else 0,
+            "step_latency_x": rep.slow_multiplier,
+        }
+
+    @staticmethod
+    def _score(rep: _Replica) -> float:
+        """Lower = healthier. Queue length dominates (it IS expected
+        wait in steps); pressure, degradation, and latency inflation
+        push a sick replica's score up before its queue shows it. The
+        latency multiplier reads the LIVE cluster observation, not the
+        snapshot taken at the replica's last step — a replica slowed a
+        moment ago must lose the very next coin flip."""
+        h = rep.health
+        return (h["queue_depth"] + h["running"]
+                + 8.0 * h["kv_pressure"]
+                + 2.0 * h["degradation_level"]
+                + 4.0 * (rep.slow_multiplier - 1.0)
+                + h["queue_age_s"])
+
+    def _set_state(self, rep: _Replica, state: ReplicaState, now: float):
+        if state is rep.state:
+            return
+        old = rep.state
+        rep.state_time[old.value] = rep.state_time.get(old.value, 0.0) \
+            + (now - rep.state_since)
+        rep.state = state
+        rep.state_since = now
+        self.counters["state_transitions"] += 1
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def _candidates(self) -> list:
+        return [r for r in self.replicas
+                if r.state in ADMITTABLE_STATES and r.engine is not None]
+
+    def _route(self, rid: str):
+        """Pick a replica for ``rid``: session affinity if its pinned
+        replica is still admittable, else power-of-two-choices over the
+        seeded stream. Returns None when no replica is admittable."""
+        cands = self._candidates()
+        if not cands:
+            return None
+        meta = self._meta[rid]
+        session = meta["session"]
+        if self.session_affinity and session is not None:
+            aff = self._affinity.get(session)
+            for r in cands:
+                if r.rid == aff:
+                    self.counters["affinity_hits"] += 1
+                    return r
+        if len(cands) == 1:
+            pick = cands[0]
+        else:
+            i, j = self._rng.sample(range(len(cands)), 2)
+            # score ties break on rid so the choice is total
+            pick = min(cands[i], cands[j],
+                       key=lambda r: (self._score(r), r.rid))
+        self.counters["router_decisions"] += 1
+        if session is not None:
+            self._affinity[session] = pick.rid
+        return pick
+
+    def _dispatch(self, rid: str, touched: dict | None) -> bool:
+        """Hand ``rid`` to a routed replica. Returns False when no
+        replica is admittable (the request stays parked). An oversize
+        rejection finalizes the cluster output (and re-raises only when
+        called synchronously from ``add_request`` — ``touched`` is the
+        step-time signal)."""
+        rep = self._route(rid)
+        if rep is None:
+            return False
+        req = self._requests[rid]
+        meta = self._meta[rid]
+        now = self._now()
+        # SLOs are anchored on the request's FIRST cluster arrival: a
+        # retry gets the REMAINING window, not a fresh one — the client
+        # started waiting when it first asked
+        deadline_s = None if req.deadline_s is None else \
+            max(req.deadline_s - (now - meta["arrival"]), 0.0)
+        abort_after_s = None if req.abort_after_s is None else \
+            max(req.abort_after_s - (now - meta["arrival"]), 0.0)
+        try:
+            rep.engine.add_request(
+                req.prompt_token_ids, max_new_tokens=req.max_new_tokens,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, seed=req.seed,
+                eos_token_id=req.eos_token_id, deadline_s=deadline_s,
+                abort_after_s=abort_after_s, request_id=rid)
+        except RequestRejected:
+            out = self._outputs[rid]
+            out.status = "aborted"
+            out.finish_reason = "rejected_oversize"
+            self._unfinished.pop(rid, None)
+            if touched is None:
+                raise
+            touched[rid] = out
+            return True
+        except ValueError:
+            # engine-side parameter validation (empty prompt, bad
+            # max_new_tokens/top_k/top_p, ...): finalize the cluster
+            # output so the fleet never carries a permanently-unfinished
+            # request — and, like RequestRejected, re-raise only on the
+            # synchronous add_request path. A parked invalid request
+            # reaching here from _redispatch becomes a structured abort
+            # instead of detonating the whole cluster round.
+            out = self._outputs[rid]
+            out.status = "aborted"
+            out.finish_reason = "invalid_request"
+            self._unfinished.pop(rid, None)
+            if touched is None:
+                raise
+            touched[rid] = out
+            return True
+        meta["replica"] = rep.rid
+        out = self._outputs[rid]
+        if out.status == "pending":
+            out.status = "waiting"
+        if touched is not None:
+            touched[rid] = out
+        return True
+
+    # ------------------------------------------------------------------
+    # public API (mirrors LLMEngine)
+    # ------------------------------------------------------------------
+    def add_request(self, prompt_token_ids, *, max_new_tokens=16,
+                    temperature=0.0, top_k=None, top_p=None, seed=None,
+                    eos_token_id=None, deadline_s=None, abort_after_s=None,
+                    request_id=None, session_id=None):
+        """Queue a request with the fleet; returns its id. Routes
+        immediately when a replica is admittable, otherwise parks until
+        one is. ``session_id`` opts the request into session affinity
+        (a cohort's shared-prefix traffic stays on one replica's warm
+        prefix cache). Raises :class:`RequestRejected` (after recording
+        a finalized aborted output) exactly like ``LLMEngine``."""
+        prompt = [int(t) for t in prompt_token_ids]
+        rid = request_id or f"creq-{next(self._ids)}"
+        if rid in self._requests:
+            raise KeyError(f"duplicate request_id {rid!r}")
+        self._requests[rid] = Request(
+            prompt_token_ids=prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            eos_token_id=eos_token_id, deadline_s=deadline_s,
+            abort_after_s=abort_after_s, request_id=rid)
+        self._meta[rid] = {"retries": 0, "session": session_id,
+                           "replica": None, "arrival": self._now(),
+                           "not_before": None, "preempt_base": 0}
+        self._outputs[rid] = RequestOutput(rid, prompt, status="pending")
+        self._unfinished[rid] = None
+        if not self._dispatch(rid, None):
+            self._parked.append(rid)
+        return rid
+
+    def request_retries(self, request_id) -> int:
+        return self._meta[request_id]["retries"]
+
+    def has_unfinished(self) -> bool:
+        return bool(self._unfinished)
+
+    def outputs(self) -> dict:
+        return dict(self._outputs)
+
+    def live_pools(self):
+        """[(replica id, PagedKVPool)] of every replica holding an
+        engine — the loadgen driver's per-step invariant-audit surface."""
+        return [(r.rid, r.engine.pool) for r in self.replicas
+                if r.engine is not None]
+
+    # ------------------------------------------------------------------
+    # the cluster round
+    # ------------------------------------------------------------------
+    def step(self):
+        """One cluster round: fire due fault events, tick the state
+        machine, redispatch parked/retried requests, then one engine
+        step per active replica (slowdown-gated), absorbing each
+        replica's touched outputs into the cluster view. Returns the
+        touched cluster ``RequestOutput``\\ s."""
+        now = self._now()
+        touched: dict[str, RequestOutput] = {}
+        self._apply_faults(now, touched)
+        self._tick_states(now)
+        self._redispatch(now, touched)
+        for rep in self.replicas:
+            if rep.state not in ACTIVE_STATES or rep.engine is None:
+                continue
+            # slowdown gate: a replica at multiplier m executes one
+            # engine step every m cluster rounds — its consecutive-step
+            # latency IS m * step_time, which is what health scores see
+            rep._slow_credit += 1.0
+            if rep._slow_credit + 1e-9 < rep.slow_multiplier:
+                continue
+            rep._slow_credit -= rep.slow_multiplier
+            try:
+                if rep.flaky_until is not None and now < rep.flaky_until:
+                    rep.consecutive_flaky += 1
+                    self.counters["flaky_steps"] += 1
+                    raise InjectedFault(
+                        f"injected flaky step on replica {rep.rid}")
+                outs = rep.engine.step()
+                rep.consecutive_flaky = 0
+            except InjectedFault:
+                if rep.consecutive_flaky >= self.crash_after_flaky:
+                    # persistent flakiness IS a crash: requeue and rebuild
+                    self._crash(rep, now, self.crash_recover_s, touched)
+                continue
+            except Exception:
+                # a real engine failure: the fleet must survive it —
+                # treat as an unscheduled crash (requests requeued)
+                self.counters["engine_errors"] += 1
+                self._crash(rep, now, self.crash_recover_s, touched)
+                continue
+            rep.steps += 1
+            if rep.ladder is not None:
+                rep.ladder.observe()
+            rep.health = self._health_of(rep)
+            if rep.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+                degraded = rep.ladder.level > 0 if rep.ladder is not None \
+                    else rep.engine.pool.above_high_watermark()
+                self._set_state(
+                    rep, ReplicaState.DEGRADED if degraded
+                    else ReplicaState.HEALTHY, now)
+            for out in outs:
+                self._absorb(rep, out, touched)
+        return list(touched.values())
+
+    def run(self, max_steps=None):
+        """Drive step() until every request resolves; returns outputs."""
+        steps = 0
+        while self.has_unfinished():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"cluster did not drain within {max_steps} steps")
+        return self.outputs()
+
+    # ------------------------------------------------------------------
+    # faults / state machine
+    # ------------------------------------------------------------------
+    def next_fault_t(self):
+        """Virtual time of the next unfired fault event (None when the
+        script is exhausted) — the driver's idle-jump bound."""
+        if self._fault_cursor < len(self._fault_events):
+            return self._fault_events[self._fault_cursor].t
+        return None
+
+    def _apply_faults(self, now: float, touched: dict):
+        while self._fault_cursor < len(self._fault_events) and \
+                self._fault_events[self._fault_cursor].t <= now:
+            ev = self._fault_events[self._fault_cursor]
+            self._fault_cursor += 1
+            if ev.replica >= len(self.replicas):
+                continue
+            rep = self.replicas[ev.replica]
+            if ev.kind == "crash":
+                if rep.engine is not None:
+                    self._crash(rep, now, ev.recover_s, touched)
+            elif rep.engine is None:
+                continue                      # window faults need a body
+            elif ev.kind == "drain":
+                self._drain(rep, now, now + ev.duration_s, touched)
+            elif ev.kind == "slowdown":
+                rep.slow_multiplier = float(ev.magnitude)
+                rep.slow_until = now + ev.duration_s
+                self.counters["slowdown_faults"] += 1
+            elif ev.kind == "kv_pressure":
+                self._ballast(rep, now + ev.duration_s, ev.magnitude)
+            elif ev.kind == "flaky":
+                rep.flaky_until = now + ev.duration_s
+
+    def _tick_states(self, now: float):
+        for rep in self.replicas:
+            if rep.state is ReplicaState.DOWN:
+                if rep.recover_at is not None and now >= rep.recover_at:
+                    rep.engine = self._new_engine()
+                    rep.ladder = DegradationLadder(
+                        rep.engine, **self._ladder_kw) \
+                        if self._ladder_on else None
+                    rep.health = self._health_of(rep)
+                    rep.recover_at = None
+                    rep.recover_steps_left = self.recovery_steps
+                    rep.consecutive_flaky = 0
+                    rep.slow_multiplier = 1.0
+                    rep.slow_until = rep.flaky_until = None
+                    rep.ballast_until = None
+                    self._set_state(rep, ReplicaState.RECOVERING, now)
+            elif rep.state is ReplicaState.RECOVERING:
+                rep.recover_steps_left -= 1
+                if rep.recover_steps_left <= 0:
+                    self.counters["recoveries"] += 1
+                    self._set_state(rep, ReplicaState.HEALTHY, now)
+            elif rep.state is ReplicaState.DRAINING:
+                if rep.drain_until is not None and now >= rep.drain_until:
+                    rep.drain_until = None
+                    rep.engine.scheduler.admission_blocked = False
+                    self._set_state(rep, ReplicaState.HEALTHY, now)
+            if rep.engine is not None:
+                if rep.slow_until is not None and now >= rep.slow_until:
+                    rep.slow_multiplier = 1.0
+                    rep.slow_until = None
+                if rep.ballast_until is not None \
+                        and now >= rep.ballast_until:
+                    if rep.ballast_id in rep.engine.pool:
+                        rep.engine.pool.free(rep.ballast_id)
+                    rep.ballast_until = None
+
+    def _ballast(self, rep: _Replica, until: float, fraction: float):
+        """KV-pressure spike: pin ``fraction`` of the replica's pool
+        under a ballast allocation — watermarks, preemption, and the
+        degradation ladder see real page pressure."""
+        pool = rep.engine.pool
+        self.counters["kv_pressure_faults"] += 1
+        if rep.ballast_id in pool:
+            # overlapping windows merge: the existing ballast stays and
+            # the pressure extends to whichever window ends later
+            rep.ballast_until = until if rep.ballast_until is None \
+                else max(rep.ballast_until, until)
+            return
+        want = max(int(pool.capacity * fraction), 1)
+        pages = min(want, pool.free_pages)
+        if pages < 1:
+            return                             # already at full pressure
+        pool.allocate(rep.ballast_id, pages * pool.page_size)
+        rep.ballast_until = until
+
+    def _drain(self, rep: _Replica, now: float, until: float,
+               touched: dict):
+        self.counters["drains"] += 1
+        self._set_state(rep, ReplicaState.DRAINING, now)
+        rep.drain_until = until
+        rep.engine.scheduler.admission_blocked = True
+        # waiting work will not start here for the whole window — hand
+        # it to survivors now; running rows finish their drain in place
+        waiting_ids = [s.seq_id for s in rep.engine.scheduler.waiting]
+        for rid in waiting_ids:
+            if rid in self._meta and rep.engine.withdraw(rid):
+                self._meta[rid]["replica"] = None
+                self._requeue(rid, now, touched)
+
+    def _crash(self, rep: _Replica, now: float, recover_s, touched: dict):
+        self.counters["crashes"] += 1
+        # fold the dying engine's lifetime counters into the replica's
+        # carry so the cluster report keeps counting across the crash
+        for k in _CARRIED_COUNTERS:
+            rep.carried[k] = rep.carried.get(k, 0) + \
+                getattr(rep.engine.metrics, k).value
+        victims = [rid for rid in self._unfinished
+                   if self._meta[rid]["replica"] == rep.rid]
+        rep.engine = None
+        rep.ladder = None
+        rep.health = {"queue_depth": 0, "running": 0, "queue_age_s": 0.0,
+                      "kv_pressure": 0.0, "degradation_level": 0,
+                      "step_latency_x": 1.0}
+        rep.recover_at = None if recover_s is None else now + recover_s
+        rep.drain_until = None
+        self._set_state(rep, ReplicaState.DOWN, now)
+        for rid in victims:
+            self._meta[rid]["replica"] = None
+            self._requeue(rid, now, touched)
+
+    def _requeue(self, rid: str, now: float, touched: dict):
+        """Retry-with-backoff: park the request for redispatch on a
+        survivor, or convert an exhausted retry budget into a
+        STRUCTURED shed — a terminal ``RequestOutput`` the client can
+        reason about, never a hang."""
+        meta = self._meta[rid]
+        out = self._outputs[rid]
+        if meta["retries"] >= self.retry_budget:
+            # budget exhausted: this requeue attempt is NOT granted, so
+            # it does not count as a retry — request_retries() and the
+            # fleet "retries" counter agree (both count granted requeues)
+            self.counters["retry_budget_sheds"] += 1
+            out.status = "shed"
+            out.finish_reason = "retries_exhausted"
+            self._unfinished.pop(rid, None)
+        else:
+            meta["retries"] += 1
+            self.counters["retries"] += 1
+            # the new replica starts the request from scratch, but the
+            # preemptions its old replicas charged already happened —
+            # carry them so the report's per-request count stays lifetime
+            meta["preempt_base"] = out.num_preemptions
+            # exponential backoff: 1x, 2x, 4x... of the base interval —
+            # a survivor absorbing a dead replica's load should not also
+            # absorb its whole queue in one step
+            meta["not_before"] = now + self.retry_backoff_s \
+                * (2 ** (meta["retries"] - 1))
+            out.status = "waiting"
+            out.token_ids = []
+            out.finish_reason = None
+            self._parked.append(rid)
+        touched[rid] = out
+
+    def _fleet_dead(self) -> bool:
+        """True when every replica is DOWN with no recovery scheduled —
+        nothing parked can EVER be placed again."""
+        return all(r.state is ReplicaState.DOWN and r.recover_at is None
+                   for r in self.replicas)
+
+    def _redispatch(self, now: float, touched: dict):
+        if self._parked and self._fleet_dead():
+            # the whole fleet is permanently gone: converting the parked
+            # queue into structured sheds is the only non-hang outcome
+            # (the module contract — retry exhaustion AND fleet loss both
+            # shed, never spin)
+            while self._parked:
+                rid = self._parked.popleft()
+                out = self._outputs[rid]
+                if out.finished:
+                    continue
+                self.counters["fleet_unavailable_sheds"] += 1
+                out.status = "shed"
+                out.finish_reason = "fleet_unavailable"
+                self._unfinished.pop(rid, None)
+                touched[rid] = out
+            return
+        for _ in range(len(self._parked)):
+            rid = self._parked.popleft()
+            out = self._outputs[rid]
+            if out.finished:
+                continue
+            meta = self._meta[rid]
+            nb = meta.get("not_before")
+            if nb is not None and now < nb:
+                self._parked.append(rid)       # still backing off
+                continue
+            if self._dispatch(rid, touched):
+                meta["not_before"] = None
+            else:
+                self._parked.append(rid)       # nobody admittable yet
+                break
+
+    # ------------------------------------------------------------------
+    # absorption / observability
+    # ------------------------------------------------------------------
+    def _absorb(self, rep: _Replica, out, touched: dict):
+        """Fold one replica-level output into the cluster view.
+
+        Duplicate-finalize dedup: only the request's CURRENT assignment
+        may update it, and a terminal cluster output never regresses —
+        a stale replica's late finalization (or a drained replica's
+        leftover record) is ignored by construction."""
+        rid = out.request_id
+        meta = self._meta.get(rid)
+        if meta is None or meta["replica"] != rep.rid:
+            return
+        cout = self._outputs[rid]
+        if cout.finished:
+            return
+        cout.token_ids = list(out.token_ids)
+        cout.status = out.status
+        cout.finish_reason = out.finish_reason
+        # lifetime preemption count: what crashed/drained former
+        # replicas charged (folded into preempt_base at requeue) plus
+        # the current assignment's own count
+        cout.num_preemptions = meta["preempt_base"] + out.num_preemptions
+        if cout.finished:
+            self._unfinished.pop(rid, None)
+        touched[rid] = cout
+
+    def metrics_snapshot(self) -> dict:
+        """Fleet view: cluster counters, per-replica state/health/
+        lifetime counters (crash-surviving), and time-in-state — the
+        numbers the cluster report and the proxy-bench probe consume."""
+        now = self._now()
+        agg_state: dict[str, float] = {}
+        reps = []
+        for rep in self.replicas:
+            st = dict(rep.state_time)
+            st[rep.state.value] = st.get(rep.state.value, 0.0) \
+                + (now - rep.state_since)
+            for k, v in st.items():
+                agg_state[k] = agg_state.get(k, 0.0) + v
+            reps.append({
+                "replica": rep.rid,
+                "state": rep.state.value,
+                "state_time_s": st,
+                "steps": rep.steps,
+                "slow_multiplier": rep.slow_multiplier,
+                "degradation_level": rep.ladder.level
+                if rep.ladder is not None else 0,
+                "health": dict(rep.health),
+                "counters": {k: rep.counter(k)
+                             for k in _CARRIED_COUNTERS},
+            })
+        out = dict(self.counters)
+        out.update({
+            "num_replicas": self.num_replicas,
+            "retry_budget": self.retry_budget,
+            "parked": len(self._parked),
+            "time_in_state_s": agg_state,
+            "replicas": reps,
+        })
+        return out
+
+
+__all__ = ["ACTIVE_STATES", "ADMITTABLE_STATES", "ClusterEngine",
+           "DegradationLadder", "ReplicaState"]

@@ -107,6 +107,11 @@ class Request:
     #: relative SLO in seconds: if the request is still *waiting* this long
     #: after submission, the scheduler sheds it instead of serving it late
     deadline_s: float | None = None
+    #: relative e2e SLO in seconds: if the request is still UNFINISHED
+    #: this long after submission — running rows included — it is
+    #: aborted at the next step boundary (reason "deadline_exceeded")
+    #: instead of decoding tokens nobody will read
+    abort_after_s: float | None = None
     request_id: str | None = None
 
 
@@ -268,6 +273,12 @@ class LLMEngine:
                 "mutually exclusive decode accelerations — set "
                 "burst_tokens=1 (the default) when passing draft_model")
         self.spec_tokens = spec_tokens
+        #: runtime eligibility gate for speculative rounds — the
+        #: degradation ladder's first rung flips it off under pressure
+        #: (and back on when pressure clears). It never changes operand
+        #: shapes: the one compiled executable keeps its K = spec_tokens
+        #: layout, disabled rounds simply stop planning spec rows.
+        self.spec_enabled = True
         #: on-device generation burst length: when > 1 and every running
         #: row is a caught-up decode row, the engine dispatches ONE
         #: jitted lax.while_loop of up to this many sample->append->gate
@@ -507,10 +518,18 @@ class LLMEngine:
             verify = h[0, sample_idx.reshape(-1)]       # [R*(K+1), hid]
             logits = _logits(params, verify, cfg) \
                 .reshape(R, K + 1, -1)                  # [R, K+1, V]
+            # non-finite guard: one in-graph isfinite all-reduce per
+            # ragged row over its verify logits — a NaN/Inf surfaces at
+            # commit time as a per-row flag the host turns into a
+            # structured abort, instead of argmax/categorical silently
+            # sampling token 0 from garbage. Pad rows (q_len == 0)
+            # always read finite: their logits are null-page noise.
+            finite = jnp.all(jnp.isfinite(logits.reshape(R, -1)), axis=-1) \
+                | (q_lens <= 0)
             out, n_out = speculative_sample(
                 logits, draft_tokens, draft_probs, spec_lens, temps,
                 top_ks, top_ps, base_key, seeds, sample_pos)
-            return (out, n_out, new_kv,
+            return (out, n_out, finite, new_kv,
                     new_scales if quant_pool else None)
 
         def _append_quant(Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
@@ -554,7 +573,7 @@ class LLMEngine:
                 return (i < n_steps) & jnp.any(live)
 
             def body(c):
-                i, tokens, kv, kv_scales, kv_lens, live, gen, out = c
+                i, tokens, kv, kv_scales, kv_lens, live, gen, out, ok = c
                 h = params["embed"][tokens]                  # [R, hid]
                 pos = kv_lens                                # append slot
                 page_idx = jnp.clip(pos // ps, 0, PPS - 1)
@@ -614,6 +633,12 @@ class LLMEngine:
                 hn = _rms_norm(h[None], params["norm"],
                                cfg.rms_norm_eps)[0]
                 logits = _logits(params, hn, cfg)            # [R, V]
+                # the per-row isfinite guard, burst edition: a row whose
+                # logits go non-finite at ANY loop iteration is flagged;
+                # the host aborts it at the burst boundary rather than
+                # committing tokens sampled from garbage
+                ok = ok & (jnp.all(jnp.isfinite(logits), axis=-1)
+                           | ~live_in)
                 keys = request_keys(base_key, seeds, gpos0 + gen,
                                     FINAL_TAG)
                 nxt = sample_rows(logits, keys, temps, top_ks, top_ps)
@@ -625,12 +650,13 @@ class LLMEngine:
                 tokens = jnp.where(live_in, nxt, tokens)
                 return (i + 1, tokens, new_kv,
                         tuple(new_scales) if quant_pool else kv_scales,
-                        kv_lens, live, gen, out)
+                        kv_lens, live, gen, out, ok)
 
             init = (jnp.asarray(0, jnp.int32), tokens, kv,
-                    tuple(kv_scales), kv_lens, live0, gen0, out0)
+                    tuple(kv_scales), kv_lens, live0, gen0, out0,
+                    jnp.ones((R,), bool))
             c = jax.lax.while_loop(cond, body, init)
-            return (c[7], c[6], c[2],
+            return (c[7], c[6], c[8], c[2],
                     list(c[3]) if quant_pool else None)
 
         # donate the pool buffers (args 1-2: pages + scales) so the step
@@ -653,7 +679,8 @@ class LLMEngine:
     # ------------------------------------------------------------------
     def add_request(self, prompt_token_ids, *, max_new_tokens=16,
                     temperature=0.0, top_k=None, top_p=None, seed=None,
-                    eos_token_id=None, deadline_s=None, request_id=None):
+                    eos_token_id=None, deadline_s=None, abort_after_s=None,
+                    request_id=None):
         """Queue a request; returns its id. Accepts a Request too.
 
         ``top_k``/``top_p``/``seed`` are per-request sampling state: the
@@ -675,7 +702,8 @@ class LLMEngine:
                 r.prompt_token_ids, max_new_tokens=r.max_new_tokens,
                 temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
                 seed=r.seed, eos_token_id=r.eos_token_id,
-                deadline_s=r.deadline_s, request_id=r.request_id)
+                deadline_s=r.deadline_s, abort_after_s=r.abort_after_s,
+                request_id=r.request_id)
         prompt = [int(t) for t in np.asarray(prompt_token_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -708,6 +736,8 @@ class LLMEngine:
             seq_id=rid, prompt_ids=prompt, max_new_tokens=max_new_tokens,
             arrival=now,
             deadline=None if deadline_s is None else now + deadline_s,
+            abort_deadline=None if abort_after_s is None
+            else now + abort_after_s,
             temperature=temperature,
             top_k=None if top_k is None else int(top_k),
             top_p=None if top_p is None else float(top_p),
@@ -730,6 +760,26 @@ class LLMEngine:
             return False
         self._finalize(seq, "cancelled")
         self.metrics.cancelled_requests.inc()
+        return True
+
+    def withdraw(self, request_id) -> bool:
+        """Remove a WAITING request entirely — the cluster router's
+        drain path (serving/cluster.py): the request is requeued onto a
+        surviving replica, so THIS engine must forget it without
+        recording a terminal output (unlike :meth:`cancel`). Returns
+        False for unknown, running, or already-resolved requests —
+        running rows stay to finish their drain."""
+        seq = self._seqs.get(request_id)
+        if seq is None or seq.status is not SequenceStatus.WAITING:
+            return False
+        if not any(s is seq for s in self.scheduler.waiting):
+            return False
+        self.scheduler.waiting = type(self.scheduler.waiting)(
+            s for s in self.scheduler.waiting if s is not seq)
+        if self._draft is not None:
+            self._draft.drop(request_id)
+        del self._seqs[request_id]
+        del self._outputs[request_id]
         return True
 
     def has_unfinished(self) -> bool:
@@ -810,6 +860,14 @@ class LLMEngine:
         for seq in self.scheduler.shed_expired():
             self._finalize(seq, "shed")
             touched[seq.seq_id] = self._outputs[seq.seq_id]
+        # mid-flight SLO abort: running/waiting rows whose absolute e2e
+        # deadline passed finalize HERE, at the step boundary — pages
+        # freed through the normal finish path (CoW refcounts and
+        # pinned chains intact), no more tokens decoded for them
+        for seq in self.scheduler.abort_expired():
+            self.metrics.deadline_aborts.inc()
+            self._finalize(seq, "shed", reason="deadline_exceeded")
+            touched[seq.seq_id] = self._outputs[seq.seq_id]
         hook = self._prefix_probe if self.prefix_caching else None
         for seq in self.scheduler.admit(prefix_hook=hook):
             touched[seq.seq_id] = self._sync_output(seq)
@@ -817,10 +875,13 @@ class LLMEngine:
         bplan = None
         splan = None
         preempted = []
-        if self._draft is not None:
+        if self._draft is not None and self.spec_enabled:
             # speculative round: eligible only when every running row is
             # a caught-up decode row (prompt chunks go through the
-            # ordinary ragged path; the draft catches up lazily)
+            # ordinary ragged path; the draft catches up lazily).
+            # spec_enabled is the degradation ladder's runtime kill
+            # switch: it gates ELIGIBILITY only — operand shapes (and
+            # the one compiled executable) never change with it.
             splan = self.scheduler.prepare_spec(self.spec_tokens)
             preempted += self.scheduler.last_preempted
         if splan is None and self.burst_tokens > 1:
@@ -863,8 +924,16 @@ class LLMEngine:
         elif plan is not None:
             if plan.cow_copies:
                 self.metrics.cow_copies.inc(plan.cow_copies)
-            sampled, _ = self._launch(plan)
+            sampled, _, finite = self._launch(plan)
             for i, (seq, q_start, q_len) in enumerate(plan.rows):
+                if not finite[i]:
+                    # NaN/Inf logits: the row's state (this step's KV
+                    # appends included) is poison — abort the request
+                    # with a structured error BEFORE any commit or
+                    # prefix registration could propagate it
+                    self._abort_nonfinite(seq)
+                    touched[seq.seq_id] = self._outputs[seq.seq_id]
+                    continue
                 before = seq.cached_len
                 seq.cached_len += q_len
                 # a prefill-chunk row is one that committed prompt tokens
@@ -1006,9 +1075,11 @@ class LLMEngine:
     # ------------------------------------------------------------------
     def _launch(self, plan, draft_tokens=None, draft_probs=None):
         """Assemble the fixed-shape operands for the plan and run the one
-        ragged-step executable. Returns ``(out [R, K+1], n_out [R])`` —
-        ordinary rounds commit ``out[i, 0]`` (n_out is 1), speculative
-        rounds commit ``out[i, :n_out[i]]``."""
+        ragged-step executable. Returns ``(out [R, K+1], n_out [R],
+        finite [R])`` — ordinary rounds commit ``out[i, 0]`` (n_out is
+        1), speculative rounds commit ``out[i, :n_out[i]]``; a row with
+        ``finite[i] == False`` produced NaN/Inf logits and must be
+        aborted instead of committed (the in-graph isfinite guard)."""
         T, R, PPS = plan.token_budget, plan.num_slots, self.max_pages_per_seq
         K = self.spec_tokens
         self.metrics.host_dispatches.inc()
@@ -1059,7 +1130,7 @@ class LLMEngine:
             seeds[i] = seq.seed
             sample_pos[i] = len(seq.tokens)
             spec_lens[i] = spec
-        out, n_out, new_kv, new_scales = self._ragged_jit(
+        out, n_out, finite, new_kv, new_scales = self._ragged_jit(
             self.params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
@@ -1071,7 +1142,7 @@ class LLMEngine:
         self.pool.kv = new_kv
         if new_scales is not None:
             self.pool.kv_scales = new_scales
-        return np.asarray(out), np.asarray(n_out)
+        return np.asarray(out), np.asarray(n_out), np.asarray(finite)
 
     def _launch_spec(self, plan, touched):
         """One speculative round: draft sync + k proposal steps, then
@@ -1104,9 +1175,13 @@ class LLMEngine:
         # buffer); d_probs is already the [R, K, V] DEVICE operand
         draft_tokens = np.zeros((R, K), np.int32)
         draft_tokens[:len(seqs)] = d_toks
-        out, n_out = self._launch(plan, draft_tokens, d_probs)
+        out, n_out, finite = self._launch(plan, draft_tokens, d_probs)
         drafted = accepted = rollbacks = 0
         for i, (seq, _q_start, _q_len) in enumerate(plan.rows):
+            if not finite[i]:
+                self._abort_nonfinite(seq)
+                touched[seq.seq_id] = self._outputs[seq.seq_id]
+                continue
             spec = spec_lens[i]
             cached_old = seq.cached_len
             n = int(n_out[i])            # 1..spec+1 tokens to commit
@@ -1178,7 +1253,7 @@ class LLMEngine:
             # rides the same forensics counter as the ragged step's
             self._burst_launched = True
             self.metrics.decode_compiles.inc()
-        out, gen, new_kv, new_scales = self._burst_jit(
+        out, gen, ok, new_kv, new_scales = self._burst_jit(
             self.params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(kv_lens), jnp.asarray(tbls),
             jnp.asarray(live), jnp.asarray(caps), jnp.asarray(temps),
@@ -1190,7 +1265,17 @@ class LLMEngine:
             self.pool.kv_scales = new_scales
         out = np.asarray(out)
         gen = np.asarray(gen)
+        ok = np.asarray(ok)
         for i, (seq, cap) in enumerate(bplan.rows):
+            if not ok[i]:
+                # the row went non-finite at some loop iteration: every
+                # token of this burst is suspect — commit none, roll the
+                # pool's committed length back to the pre-burst state,
+                # and abort with the structured error
+                self.pool.set_seq_len(seq.seq_id, seq.cached_len)
+                self._abort_nonfinite(seq)
+                touched[seq.seq_id] = self._outputs[seq.seq_id]
+                continue
             g = int(gen[i])
             seq.cached_len += g
             # prepare_burst committed cached + cap up front; shrink the
@@ -1217,6 +1302,15 @@ class LLMEngine:
         elif self._stream_cb is not None:
             self._stream_cb(seq.seq_id, int(tok), False)
         return out
+
+    def _abort_nonfinite(self, seq: Sequence):
+        """Structured abort for a row the in-graph isfinite guard
+        flagged: the request finalizes with ``finish_reason
+        "nonfinite_logits"`` (status aborted), its pages are freed, and
+        the ``nonfinite_rows`` counter records the event — the engine
+        keeps serving every other row instead of streaming garbage."""
+        self.metrics.nonfinite_rows.inc()
+        self._finalize(seq, "aborted", reason="nonfinite_logits")
 
     def _finalize(self, seq: Sequence, status: str, reason=None):
         if self._draft is not None:

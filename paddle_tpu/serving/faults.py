@@ -1,0 +1,155 @@
+"""Fault injection for cluster-scale serving — seeded, virtual-clock,
+byte-reproducible.
+
+A fleet's p99/goodput story is only as good as its behavior when
+replicas crash, drain, and slow down. This module makes those faults
+*data*: a :class:`FaultSchedule` is an explicit, sorted list of
+:class:`FaultEvent`\\ s on the loadgen virtual clock
+(paddle_tpu/loadgen/driver.py), consumed by the cluster router
+(serving/cluster.py ``ClusterEngine``) at step boundaries. Because the
+schedule is plain data and every timestamp is virtual, a fault run is as
+deterministic as a fault-free one — the same seed reproduces the same
+crashes, the same requeues, and the same report bytes, chip-free
+(docs/ROBUSTNESS.md maps each fault kind to the claim it proves).
+
+Fault kinds:
+
+- ``crash`` — the replica dies instantly: its engine (KV pool included)
+  is discarded, every request assigned to it is requeued to a survivor
+  (retry budget permitting), and the replica sits DOWN until
+  ``recover_s`` later, when a fresh engine warms up through RECOVERING.
+- ``drain`` — graceful shutdown rehearsal: admission freezes for
+  ``duration_s``, waiting requests are requeued to survivors, running
+  requests finish in place.
+- ``slowdown`` — the replica's per-step latency is multiplied by
+  ``magnitude`` for ``duration_s``: it executes one engine step every
+  ``magnitude`` cluster rounds, so its consecutive-step latency (and
+  its health score) degrade exactly as a thermally-throttled or
+  noisy-neighbor chip's would.
+- ``kv_pressure`` — a ballast allocation pins ``magnitude`` of the
+  replica's pool capacity for ``duration_s``: watermark admission
+  control, preemption, and the degradation ladder all see genuine page
+  pressure without any traffic change.
+- ``flaky`` — every step attempt in the window raises a transient
+  :class:`InjectedFault`; the cluster absorbs each one (the step is
+  lost, requests stay put) until ``crash_after_flaky`` consecutive
+  failures escalate the replica to a crash.
+"""
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+KINDS = ("crash", "drain", "slowdown", "kv_pressure", "flaky")
+
+
+class InjectedFault(RuntimeError):
+    """The transient exception a scheduled flaky-step fault raises in
+    place of a replica's engine step. The cluster catches it, counts
+    it, and carries on — a fleet must survive a step that throws."""
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: fires when the virtual clock reaches ``t``.
+
+    ``duration_s`` bounds the window faults (drain / slowdown /
+    kv_pressure / flaky); ``recover_s`` is crash-only (DOWN ->
+    RECOVERING delay; None = the replica never comes back);
+    ``magnitude`` is the slowdown's latency multiplier (> 1) or the
+    kv_pressure ballast as a fraction of pool capacity (0, 1]."""
+    t: float
+    replica: int
+    kind: str
+    duration_s: float = 0.0
+    recover_s: float | None = None
+    magnitude: float = 2.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"fault kind must be one of {KINDS}, "
+                             f"got {self.kind!r}")
+        if self.t < 0:
+            raise ValueError(f"fault time must be >= 0, got {self.t}")
+        if self.replica < 0:
+            raise ValueError(f"replica index must be >= 0, "
+                             f"got {self.replica}")
+        if self.kind != "crash" and self.duration_s <= 0:
+            raise ValueError(
+                f"{self.kind} needs duration_s > 0, got {self.duration_s}")
+        if self.kind == "crash" and self.recover_s is not None \
+                and self.recover_s <= 0:
+            raise ValueError(
+                f"crash recover_s must be > 0 or None (never recovers), "
+                f"got {self.recover_s}")
+        if self.kind == "slowdown" and self.magnitude <= 1.0:
+            raise ValueError(
+                f"slowdown magnitude is a latency multiplier > 1, "
+                f"got {self.magnitude}")
+        if self.kind == "kv_pressure" and not 0.0 < self.magnitude <= 1.0:
+            raise ValueError(
+                f"kv_pressure magnitude is a capacity fraction in "
+                f"(0, 1], got {self.magnitude}")
+
+
+class FaultSchedule:
+    """An immutable, time-sorted fault script. The cluster keeps its own
+    read cursor, so one schedule object can parameterize any number of
+    runs — byte-reproducibility needs no reset discipline."""
+
+    def __init__(self, events):
+        events = list(events)
+        for e in events:
+            if not isinstance(e, FaultEvent):
+                raise TypeError(f"FaultSchedule takes FaultEvents, "
+                                f"got {type(e).__name__}")
+        #: sorted copy — ties break on (replica, kind) so the firing
+        #: order (and therefore every downstream requeue) is total
+        self.events = tuple(sorted(
+            events, key=lambda e: (e.t, e.replica, KINDS.index(e.kind))))
+
+    def __len__(self):
+        return len(self.events)
+
+    def __iter__(self):
+        return iter(self.events)
+
+    def describe(self) -> list:
+        """Plain-dict view for the cluster report artifact."""
+        return [asdict(e) for e in self.events]
+
+    @classmethod
+    def generate(cls, *, seed, num_replicas, horizon_s, events_per_replica=2,
+                 kinds=("crash", "drain", "slowdown"), duration_s=(0.1, 0.5),
+                 recover_s=(0.2, 0.6), slowdown=(2.0, 4.0),
+                 kv_fraction=(0.3, 0.7)) -> "FaultSchedule":
+        """Seeded random schedule: ``events_per_replica`` faults per
+        replica, kinds/times/durations off ONE numpy Generator — the
+        same seed compiles the same script, the fault-side analog of
+        ``WorkloadSpec.compile()``."""
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        for k in kinds:
+            if k not in KINDS:
+                raise ValueError(f"unknown fault kind {k!r}")
+        rng = np.random.default_rng(seed)
+        events = []
+        for rid in range(num_replicas):
+            for _ in range(events_per_replica):
+                kind = kinds[int(rng.integers(0, len(kinds)))]
+                t = float(rng.uniform(0.0, horizon_s))
+                kw = {}
+                if kind == "crash":
+                    kw["recover_s"] = float(rng.uniform(*recover_s))
+                else:
+                    kw["duration_s"] = float(rng.uniform(*duration_s))
+                if kind == "slowdown":
+                    kw["magnitude"] = float(rng.uniform(*slowdown))
+                elif kind == "kv_pressure":
+                    kw["magnitude"] = float(rng.uniform(*kv_fraction))
+                events.append(FaultEvent(t=t, replica=rid, kind=kind, **kw))
+        return cls(events)
+
+
+__all__ = ["FaultEvent", "FaultSchedule", "InjectedFault", "KINDS"]

@@ -77,6 +77,28 @@ class PoolExhausted(RuntimeError):
     """Raised when an alloc/extend/CoW needs more free pages than exist."""
 
 
+class InvariantViolation(AssertionError):
+    """Structured ``check_invariants`` failure: the reason plus a pool
+    snapshot (refcounts, free-list size, pinned set, offending page ids)
+    travel with the exception, so a loadgen soak that dies hundreds of
+    virtual steps in is triageable from the artifact alone instead of a
+    bare assert with no state. Subclasses ``AssertionError`` so callers
+    (and tests) that caught the old asserts keep working."""
+
+    def __init__(self, reason, snapshot):
+        self.reason = reason
+        self.snapshot = snapshot
+        rcs = snapshot["refcounts"]
+        head = dict(list(rcs.items())[:16])
+        super().__init__(
+            f"{reason} | pool snapshot: used={snapshot['used_pages']}/"
+            f"{snapshot['capacity']} free_list={snapshot['free_list_size']} "
+            f"offending_pages={snapshot['offending_pages']} "
+            f"pinned_chains={len(snapshot['pinned'])} "
+            f"nonzero_refcounts={head}"
+            f"{'...' if len(rcs) > 16 else ''}")
+
+
 NULL_PAGE = 0
 
 
@@ -590,8 +612,25 @@ class PagedKVPool:
     def live_sequences(self):
         return list(self._tables)
 
+    def snapshot(self, offending_pages=()) -> dict:
+        """Host-side pool state for failure triage (no device reads):
+        nonzero refcounts, free-list size, pinned chain ids, sequence
+        count, and the page ids the caller found offending. This is what
+        :class:`InvariantViolation` carries out of a soak run."""
+        return {
+            "capacity": self.capacity,
+            "used_pages": self.used_pages,
+            "free_list_size": len(self._free),
+            "refcounts": {p: rc for p, rc in enumerate(self._refcounts)
+                          if rc},
+            "pinned": list(self._pins),
+            "pin_counts": dict(self._pin_counts),
+            "num_sequences": len(self._tables),
+            "offending_pages": sorted(set(offending_pages)),
+        }
+
     def check_invariants(self):
-        """Debug/test hook: refcount/free-list/table consistency.
+        """Debug/test/soak hook: refcount/free-list/table consistency.
 
         - every mapped page's refcount equals the number of owners
           mapping it — sequence tables AND pinned chains both count —
@@ -601,42 +640,66 @@ class PagedKVPool:
         - the null page is never mapped and never on the free list;
         - pinned bookkeeping (_pin_counts) matches the pinned chains
           and stays within the pinned-page budget.
+
+        A failure raises :class:`InvariantViolation` carrying a
+        :meth:`snapshot` (refcounts, free-list size, pinned set, the
+        offending page ids) instead of a bare assert.
         """
+        def fail(reason, pages=()):
+            raise InvariantViolation(reason, self.snapshot(pages))
+
         mapped: dict[int, int] = {}
-        for t in self._tables.values():
+        for sid, t in self._tables.items():
             seen_in_table = set()
             for p in t:
-                assert p not in seen_in_table, \
-                    "a table maps the same pool page twice"
+                if p in seen_in_table:
+                    fail(f"table {sid!r} maps pool page {p} twice", [p])
                 seen_in_table.add(p)
                 mapped[p] = mapped.get(p, 0) + 1
         pin_counts: dict[int, int] = {}
-        for pages, num_tokens in self._pins.values():
-            assert num_tokens % self.page_size == 0, \
-                "pinned chain is not page-aligned"
+        for cid, (pages, num_tokens) in self._pins.items():
+            if num_tokens % self.page_size != 0:
+                fail(f"pinned chain {cid!r} is not page-aligned "
+                     f"({num_tokens} tokens)", pages)
             for p in pages:
                 mapped[p] = mapped.get(p, 0) + 1
                 pin_counts[p] = pin_counts.get(p, 0) + 1
-        assert pin_counts == self._pin_counts, (
-            f"pin accounting drift: {pin_counts} != {self._pin_counts}")
-        assert len(pin_counts) <= max(self.pinned_page_budget, 0), \
-            "pinned pages exceed the pinned-page budget"
-        assert NULL_PAGE not in mapped, "null page leaked into a table"
-        assert NULL_PAGE not in self._free, "null page on the free list"
-        for p, owners in mapped.items():
-            assert self._refcounts[p] == owners, (
-                f"page {p}: refcount {self._refcounts[p]} != "
-                f"{owners} owners")
+        if pin_counts != self._pin_counts:
+            drift = set(pin_counts.items()) ^ set(self._pin_counts.items())
+            fail(f"pin accounting drift: {pin_counts} != "
+                 f"{self._pin_counts}", [p for p, _ in drift])
+        if len(pin_counts) > max(self.pinned_page_budget, 0):
+            fail(f"{len(pin_counts)} pinned pages exceed the "
+                 f"pinned-page budget {self.pinned_page_budget}",
+                 pin_counts)
+        if NULL_PAGE in mapped:
+            fail("null page leaked into a table", [NULL_PAGE])
+        if NULL_PAGE in self._free:
+            fail("null page on the free list", [NULL_PAGE])
+        bad_rc = [p for p, owners in mapped.items()
+                  if self._refcounts[p] != owners]
+        if bad_rc:
+            p = bad_rc[0]
+            fail(f"page {p}: refcount {self._refcounts[p]} != "
+                 f"{mapped[p]} owners", bad_rc)
         free_set = set(self._free)
-        assert len(free_set) == len(self._free), "free list has duplicates"
-        assert not (free_set & set(mapped)), "page both mapped and free"
-        for p in self._free:
-            assert self._refcounts[p] == 0, \
-                f"free page {p} has refcount {self._refcounts[p]}"
-        assert len(mapped) + len(self._free) == self.capacity, \
-            "page accounting leak"
-        assert self.used_pages == len(mapped)
+        if len(free_set) != len(self._free):
+            dups = [p for p in free_set if self._free.count(p) > 1]
+            fail("free list has duplicates", dups)
+        if free_set & set(mapped):
+            fail("page both mapped and free", free_set & set(mapped))
+        bad_free = [p for p in self._free if self._refcounts[p] != 0]
+        if bad_free:
+            fail(f"free page {bad_free[0]} has refcount "
+                 f"{self._refcounts[bad_free[0]]}", bad_free)
+        if len(mapped) + len(self._free) != self.capacity:
+            fail(f"page accounting leak: {len(mapped)} mapped + "
+                 f"{len(self._free)} free != capacity {self.capacity}")
+        if self.used_pages != len(mapped):
+            fail(f"used_pages {self.used_pages} != {len(mapped)} "
+                 f"mapped pages")
         return True
 
 
-__all__ = ["PagedKVPool", "PoolExhausted", "NULL_PAGE"]
+__all__ = ["InvariantViolation", "PagedKVPool", "PoolExhausted",
+           "NULL_PAGE"]

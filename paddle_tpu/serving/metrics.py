@@ -144,7 +144,17 @@ class ServingMetrics:
                 "spec_rollbacks", "spec_rounds",
                 # rounds demoted to ordinary decode because the DRAFT
                 # pool could not hold them (under-sized draft_num_pages)
-                "spec_draft_fallbacks")
+                "spec_draft_fallbacks",
+                # robustness (PR 11): running/waiting requests aborted at
+                # a step boundary because their e2e deadline passed
+                # (finish_reason "deadline_exceeded"), ragged rows whose
+                # logits came back NaN/Inf (the in-graph isfinite guard —
+                # each aborts its request instead of sampling garbage),
+                # and graceful-degradation ladder transitions (rungs
+                # engaged under sustained pressure / restored after it
+                # clears — serving/cluster.DegradationLadder)
+                "deadline_aborts", "nonfinite_rows",
+                "degradation_escalations", "degradation_restorations")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",
@@ -156,7 +166,10 @@ class ServingMetrics:
               # request (seconds since it was (re-)enqueued, scheduler
               # now_fn time base) — a climbing max_queue_wait_s under
               # steady load is head-of-line blocking made visible
-              "queue_age_p99_s", "max_queue_wait_s")
+              "queue_age_p99_s", "max_queue_wait_s",
+              # current graceful-degradation rung (0 = full service;
+              # each rung sheds one optional capability in order)
+              "degradation_level")
     #: per-finished-request latency distributions (seconds): TTFT =
     #: arrival -> first generated token, TPOT = mean inter-token after
     #: the first, e2e = arrival -> finalization

@@ -84,6 +84,11 @@ class Sequence:
     max_new_tokens: int
     arrival: float
     deadline: float | None = None
+    #: absolute e2e SLO: a request still unfinished past this instant is
+    #: ABORTED at the next step boundary (mid-flight SLO abort — decoding
+    #: tokens nobody will read is shed load, not service), unlike
+    #: ``deadline`` which only sheds requests still WAITING to start
+    abort_deadline: float | None = None
     temperature: float = 0.0
     #: per-request sampling knobs (None/0 = off, engine passes them as
     #: per-row data into the one jitted step — knobs are data, not shape)
@@ -216,6 +221,10 @@ class Scheduler:
         self._admission_paused = False
         #: q_len granted to each running seq by the current planning round
         self._granted: dict[str, int] = {}
+        #: cluster drain hook (serving/cluster.py): True freezes
+        #: admission entirely — running rows finish, waiting rows sit
+        #: (or are withdrawn by the cluster for requeue elsewhere)
+        self.admission_blocked = False
 
     # ---- introspection ----
     @property
@@ -292,6 +301,20 @@ class Scheduler:
             self.metrics.shed_requests.inc(len(shed))
         return shed
 
+    def abort_expired(self, now=None) -> list[Sequence]:
+        """Mid-flight SLO abort: collect every sequence — RUNNING rows
+        included — whose absolute e2e ``abort_deadline`` has passed.
+        Shedding only at admission keeps burning steps on requests whose
+        client has already timed out; this catches them at the step
+        boundary instead. The caller finalizes each one (a structured
+        ``RequestOutput`` with reason ``deadline_exceeded``; pages are
+        freed through the normal ``finish`` path, so CoW refcounts and
+        pinned chains stay intact). This method only COLLECTS — state
+        changes stay in one place (``finish``)."""
+        now = self.config.now_fn() if now is None else now
+        return [s for s in list(self.running) + list(self.waiting)
+                if s.abort_deadline is not None and now > s.abort_deadline]
+
     def admit(self, prefix_hook=None) -> list[Sequence]:
         """Move FIFO-queue heads into the running set. Claims the pages
         of each admission's FIRST chunk (later chunks claim lazily inside
@@ -299,6 +322,8 @@ class Scheduler:
         sequence onto cached prompt-prefix pages first and returns the
         shared token count (0 on miss)."""
         admitted = []
+        if self.admission_blocked:
+            return admitted
         if self._admission_paused and self.pool.below_low_watermark():
             self._admission_paused = False
         while self.waiting:
@@ -367,6 +392,10 @@ class Scheduler:
         seq.status = status
         if seq in self.running:
             self.running.remove(seq)
+        elif any(s is seq for s in self.waiting):
+            # mid-flight aborts can finalize a WAITING sequence (e.g. a
+            # preempted-back row whose e2e deadline passed in the queue)
+            self.waiting = deque(s for s in self.waiting if s is not seq)
         if seq.seq_id in self.pool:
             self.pool.free(seq.seq_id)
 

@@ -1,0 +1,278 @@
+"""Robustness satellites (ISSUE 11): mid-flight SLO abort, the in-graph
+non-finite logits guard, structured ``InvariantViolation`` pool
+failures, and the preempt/requeue storm soak — all asserted on the
+virtual clock, chip-free."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import jax.numpy as jnp
+from paddle_tpu.loadgen import (Driver, TraceRequest, VirtualClock,
+                                WorkloadSpec, build_report,
+                                trace_fingerprint)
+from paddle_tpu.models import (Generator, LlamaForCausalLM,
+                               llama_tiny_config)
+from paddle_tpu.serving import (InvariantViolation, LLMEngine,
+                                PagedKVPool)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _engine(model, clock, **kw):
+    kw.setdefault("max_len", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("seed", 0)
+    return LLMEngine(model, now_fn=clock.now, **kw)
+
+
+def _reference_tokens(model, prompt, n, max_len=64):
+    gen = Generator(model, max_len=max_len)
+    out = gen.generate(paddle.to_tensor(np.asarray(prompt)[None],
+                                        dtype="int64"),
+                       max_new_tokens=n, temperature=0.0).numpy()
+    return out[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# satellite: mid-flight SLO abort
+# ---------------------------------------------------------------------------
+
+def test_running_request_aborts_at_e2e_deadline(tiny_model):
+    """A RUNNING request whose absolute e2e deadline passes must
+    finalize at a step boundary (reason deadline_exceeded) with pages
+    freed — not decode its remaining tokens for nobody."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    rid = eng.add_request([1, 2, 3], max_new_tokens=20,
+                          abort_after_s=0.05)
+    steps = 0
+    while eng.has_unfinished():
+        clock.advance(0.01)
+        eng.step()
+        steps += 1
+        assert steps < 100
+    out = eng.outputs()[rid]
+    assert out.status == "shed"
+    assert out.finish_reason == "deadline_exceeded"
+    assert 0 < len(out.token_ids) < 20, \
+        "the abort fired mid-flight, after some tokens streamed"
+    assert eng.metrics_snapshot()["deadline_aborts"] == 1
+    assert eng.pool.free_pages == eng.pool.capacity
+    eng.pool.check_invariants()
+
+
+def test_abort_leaves_cow_shared_pages_and_survivor_intact(tiny_model):
+    """Aborting one fork of a shared prompt prefix must release only
+    the aborted sequence's refcounts: the surviving sharer keeps its
+    pages and still produces the reference greedy continuation."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, max_len=64, num_pages=33)
+    prompt = list(range(1, 13))                  # 12 tokens, 3 full pages
+    doomed = eng.add_request(prompt, max_new_tokens=24,
+                             abort_after_s=0.05)
+    clock.advance(0.01)
+    eng.step()                                   # donor prompt committed
+    survivor = eng.add_request(prompt, max_new_tokens=8)
+    steps = 0
+    while eng.has_unfinished():
+        clock.advance(0.01)
+        eng.step()
+        steps += 1
+        assert steps < 200
+    outs = eng.outputs()
+    assert outs[doomed].status == "shed"
+    assert outs[doomed].finish_reason == "deadline_exceeded"
+    assert eng.metrics.prefix_cache_hits.value >= 1, \
+        "the survivor must actually have forked the shared prefix"
+    assert outs[survivor].status == "finished"
+    assert outs[survivor].token_ids == \
+        _reference_tokens(tiny_model, prompt, 8)
+    eng.pool.check_invariants()
+    assert eng.pool.free_pages == eng.pool.capacity
+
+
+def test_abort_after_s_rides_the_loadgen_trace(tiny_model):
+    """WorkloadSpec.abort_after_s lands on every TraceRequest, is part
+    of the fingerprint, and produces deadline_exceeded sheds in a run
+    whose outputs exceed the abort window."""
+    spec = WorkloadSpec(num_requests=8, seed=3, arrival="deterministic",
+                        arrival_rate=100.0, prompt_len=(4, 8),
+                        output_len=(16, 20), abort_after_s=0.08,
+                        vocab_size=128)
+    trace = spec.compile()
+    assert all(r.abort_after_s == 0.08 for r in trace)
+    assert trace_fingerprint(trace) != trace_fingerprint(
+        dataclasses.replace(spec, abort_after_s=None).compile())
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, max_num_seqs=4)
+    result = Driver(eng, clock, step_time_s=0.01).run(trace)
+    report = build_report(result, spec=spec, trace=trace)
+    assert report["requests"]["unresolved"] == 0
+    aborted = [r for r in result.records
+               if r.finish_reason == "deadline_exceeded"]
+    assert aborted, "the tight abort SLO must have fired"
+    assert result.metrics["deadline_aborts"] == len(aborted)
+    assert eng.pool.free_pages == eng.pool.capacity
+    with pytest.raises(ValueError, match="abort_after_s"):
+        WorkloadSpec(abort_after_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: non-finite logits guard
+# ---------------------------------------------------------------------------
+
+def _poison(eng):
+    """Plant one NaN in a projection weight: every row's logits go
+    non-finite and the isfinite guard must catch them at commit."""
+    lyr = eng.params["layers"][0]
+    lyr["q"] = lyr["q"].at[0, 0].set(jnp.nan)
+
+
+def test_nonfinite_logits_abort_structured_not_token_zero(tiny_model):
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    _poison(eng)
+    r1 = eng.add_request([1, 2, 3], max_new_tokens=4)
+    r2 = eng.add_request([4, 5, 6, 7], max_new_tokens=4)
+    steps = 0
+    while eng.has_unfinished():
+        clock.advance(0.01)
+        eng.step()
+        steps += 1
+        assert steps < 50, "poisoned rows must abort, not loop"
+    for rid in (r1, r2):
+        out = eng.outputs()[rid]
+        assert out.status == "aborted"
+        assert out.finish_reason == "nonfinite_logits"
+        assert out.token_ids == [], \
+            "no garbage token 0 may be committed from NaN logits"
+    assert eng.metrics_snapshot()["nonfinite_rows"] == 2
+    assert eng.pool.free_pages == eng.pool.capacity
+    eng.pool.check_invariants()
+
+
+def test_nonfinite_guard_in_burst_mode(tiny_model):
+    """The burst loop carries the per-row finite flag through its
+    iterations: a poisoned burst commits NOTHING and aborts."""
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, burst_tokens=4)
+    _poison(eng)
+    rid = eng.add_request([1, 2, 3], max_new_tokens=8)
+    steps = 0
+    while eng.has_unfinished():
+        clock.advance(0.01)
+        eng.step()
+        steps += 1
+        assert steps < 50
+    out = eng.outputs()[rid]
+    assert out.status == "aborted"
+    assert out.finish_reason == "nonfinite_logits"
+    assert out.token_ids == []
+    assert eng.metrics_snapshot()["nonfinite_rows"] == 1
+    assert eng.pool.free_pages == eng.pool.capacity
+    eng.pool.check_invariants()
+
+
+def test_healthy_engine_never_flags_nonfinite(tiny_model):
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock)
+    eng.add_request([1, 2, 3], max_new_tokens=6)
+    while eng.has_unfinished():
+        clock.advance(0.01)
+        eng.step()
+    assert eng.metrics_snapshot()["nonfinite_rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: structured InvariantViolation
+# ---------------------------------------------------------------------------
+
+def test_invariant_violation_carries_pool_snapshot():
+    p = PagedKVPool(1, 2, 8, num_pages=9, page_size=4)
+    p.allocate("a", 8)
+    p.fork("b", "a", 8)
+    p.check_invariants()
+    p._refcounts[p.block_table("a")[0]] += 1       # corrupt a refcount
+    with pytest.raises(InvariantViolation, match="refcount") as ei:
+        p.check_invariants()
+    err = ei.value
+    assert isinstance(err, AssertionError), \
+        "InvariantViolation must remain AssertionError-compatible"
+    snap = err.snapshot
+    assert snap["offending_pages"] == [p.block_table("a")[0]]
+    assert snap["capacity"] == 8
+    assert snap["free_list_size"] == p.free_pages
+    assert snap["used_pages"] == 2
+    assert isinstance(snap["refcounts"], dict) and snap["refcounts"]
+    assert "pinned" in snap and snap["pinned"] == []
+    # the message alone is triageable (reason + key stats)
+    assert "offending_pages" in str(err)
+
+
+def test_invariant_violation_names_leaked_free_page():
+    p = PagedKVPool(1, 2, 8, num_pages=9, page_size=4)
+    p.allocate("a", 4)
+    page = p.block_table("a")[0]
+    p._free.append(page)                           # page mapped AND free
+    with pytest.raises(InvariantViolation, match="mapped and free") as ei:
+        p.check_invariants()
+    assert page in ei.value.snapshot["offending_pages"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: preempt/requeue storm soak
+# ---------------------------------------------------------------------------
+
+def test_soak_preempt_requeue_storm_no_leak_token_identity(tiny_model):
+    """Hundreds of virtual-clock steps cycling admission -> preemption
+    -> requeue on a low-watermark pool: the driver audits
+    ``check_invariants`` EVERY step (a failure raises with the pool
+    snapshot), no page leaks, and every eventually-finished request is
+    greedy token-identical to the sequential Generator."""
+    rng = np.random.default_rng(0)
+    prompts = {}
+    trace = []
+    for w in range(10):                            # 10 waves x 6 requests
+        for i in range(6):
+            rid = f"storm-{w}-{i}"
+            n = int(rng.integers(4, 11))
+            prompts[rid] = [int(x) for x in rng.integers(0, 128, (n,))]
+            trace.append(TraceRequest(
+                rid, 0.04 * w + 0.005 * i, tuple(prompts[rid]),
+                max_new_tokens=int(rng.integers(8, 13))))
+    clock = VirtualClock()
+    # 10 usable pages, 4 row slots, low watermarks: sustained admission
+    # -> preemption -> requeue churn for the whole storm
+    eng = _engine(tiny_model, clock, num_pages=11, max_num_seqs=4,
+                  high_watermark=0.85, low_watermark=0.4)
+    result = Driver(eng, clock, step_time_s=0.002, check_every=1,
+                    max_steps=5000).run(trace)
+    assert result.steps >= 200, \
+        f"the storm must churn for hundreds of steps, got {result.steps}"
+    assert result.invariant_checks == result.steps, \
+        "the pool must have been audited on EVERY step"
+    assert result.metrics["preemptions"] >= 5, \
+        "the low-watermark pool must have preempted repeatedly"
+    by_id = {r.request_id: r for r in result.records}
+    finished = [rid for rid, r in by_id.items() if r.status == "finished"]
+    assert len(finished) == len(trace), "the storm must drain completely"
+    # zero page leak after the storm
+    assert eng.pool.free_pages == eng.pool.capacity
+    assert eng.pool.used_pages == 0
+    eng.pool.check_invariants()
+    # greedy token identity for every eventually-finished request
+    outs = eng.outputs()
+    for rid in finished:
+        want = _reference_tokens(tiny_model, prompts[rid],
+                                 by_id[rid].max_new_tokens)
+        assert outs[rid].token_ids == want, \
+            f"{rid} diverged after {by_id[rid].num_preemptions} preemptions"

@@ -288,6 +288,73 @@ def probe_spec_decode(paddle, spec_tokens=4, max_new=16):
                 "spec_decode_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_cluster(paddle, retry_budget=2):
+    """Measured fleet-robustness fields for the bench trajectory
+    (serving/cluster.py + serving/faults.py + loadgen/cluster.py).
+
+    A 3-replica ``ClusterEngine`` serves a seeded Poisson workload on
+    the virtual clock while a scripted fault KILLS replica 1 mid-run
+    (recovering it shortly after): requests in flight on the dead
+    replica are requeued to survivors under the retry budget, and the
+    fleet completes the workload. Everything is virtual-clock
+    deterministic — the fields are exact counts/fractions, not timings:
+    - ``cluster_goodput_fraction``: fleet requests finished within the
+      e2e SLO / offered — THE robustness headline. Forcing
+      ``retry_budget=0`` (the proxy-bench ``--no-retry`` regression
+      hook) converts every requeue into a structured shed and goodput
+      collapses — the gate must catch it;
+    - ``cluster_retries``: requeues the kill caused (deterministic per
+      seed — a drift means routing/fault timing changed);
+    - ``cluster_ttft_p99_s``: fleet p99 TTFT on the virtual clock,
+      retries and recovery included;
+    - ``cluster_unresolved``: requests that reached NO terminal state —
+      the no-hangs bar, exactly 0 (retry exhaustion must shed, not
+      hang).
+    Micro-sized like the serving probe: it measures the router/retry/
+    state-machine layer, not model FLOPs.
+    """
+    try:
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import (ClusterEngine, FaultEvent,
+                                        FaultSchedule)
+        from paddle_tpu.loadgen import (ClusterDriver, VirtualClock,
+                                        WorkloadSpec, build_cluster_report)
+        paddle.seed(0)
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        model = LlamaForCausalLM(cfg)
+        spec = WorkloadSpec(num_requests=24, seed=3, arrival="poisson",
+                            arrival_rate=150.0, prompt_len=(4, 12),
+                            output_len=(6, 12), slo_e2e_s=0.6,
+                            vocab_size=128)
+        faults = FaultSchedule([
+            FaultEvent(t=0.06, replica=1, kind="crash", recover_s=0.15)])
+        clock = VirtualClock()
+        cluster = ClusterEngine(
+            model, 3, seed=0, now_fn=clock.now, retry_budget=retry_budget,
+            faults=faults, max_len=32, page_size=4)
+        trace = spec.compile()
+        result = ClusterDriver(cluster, clock, step_time_s=0.01).run(trace)
+        rep = build_cluster_report(result, spec=spec, trace=trace,
+                                   faults=faults)
+        return {
+            "cluster_goodput_fraction": round(
+                rep["goodput"]["goodput_fraction"], 4),
+            "cluster_retries": rep["cluster"]["retries"]
+            + rep["cluster"]["retry_budget_sheds"],
+            "cluster_ttft_p99_s": round(rep["latency"]["ttft_s"]["p99"], 6)
+            if rep["latency"]["ttft_s"]["p99"] is not None else None,
+            "cluster_unresolved": rep["requests"]["unresolved"],
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"cluster_goodput_fraction": None,
+                "cluster_retries": None,
+                "cluster_ttft_p99_s": None,
+                "cluster_unresolved": None,
+                "cluster_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_gspmd(paddle, dp_only=False):
     """Measured GSPMD-sharding fields for the bench trajectory
     (distributed/gspmd.py; needs a multi-device backend — the proxy
@@ -513,6 +580,6 @@ def probe_kv_accounting():
                 "kv_accounting_probe_error": f"{type(e).__name__}: {e}"}
 
 
-__all__ = ["probe_gspmd", "probe_input_pipeline", "probe_jaxpr",
-           "probe_kv_accounting", "probe_opt_dispatches", "probe_serving",
-           "probe_spec_decode"]
+__all__ = ["probe_cluster", "probe_gspmd", "probe_input_pipeline",
+           "probe_jaxpr", "probe_kv_accounting", "probe_opt_dispatches",
+           "probe_serving", "probe_spec_decode"]

@@ -18,7 +18,11 @@ This harness runs the measurements that DON'T need a chip and are
 - ``kv_bytes_per_token_fp32`` / ``_int8`` — exact KV pool byte
   accounting at a reference geometry;
 - ``prefix_cache_hit_rate`` / ``shared_page_fraction`` — prefix-cache
-  effectiveness over the shared-prefix wave (higher is better).
+  effectiveness over the shared-prefix wave (higher is better);
+- ``cluster_goodput_fraction`` / ``cluster_retries`` /
+  ``cluster_ttft_p99_s`` / ``cluster_unresolved`` — fleet robustness
+  under a scripted kill-and-recover run (serving/cluster.py on the
+  loadgen virtual clock; ``--no-retry`` is the injected regression).
 
 Each metric gates against a checked-in per-backend baseline
 (tools/proxy_bench_baseline.json) with a direction and tolerance from
@@ -57,8 +61,8 @@ if "--xla_force_host_platform_device_count" not in \
 
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
-PROBES = ("serving", "spec", "gspmd", "optimizer", "pipeline", "jaxpr",
-          "accounting")
+PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
+          "jaxpr", "accounting")
 
 
 class Gate:
@@ -124,11 +128,23 @@ GATES = {
     "gspmd_allgather_count":    Gate("different"),
     "gspmd_serving_decode_compiles": Gate("higher", 0.0, 0.0),
     "gspmd_sharded_kv_bytes_per_token": Gate("higher", 0.0, 0.0),
+    # cluster robustness (scripted kill-and-recover on the virtual
+    # clock — every field is a deterministic count/fraction): fleet
+    # goodput must not collapse (disabling retries via --no-retry
+    # converts the killed replica's requeues into sheds and MUST fail
+    # this gate), the requeue count is pinned exactly (a drift means
+    # fault timing or routing changed — re-record deliberately), p99
+    # TTFT gets modest slack, and unresolved requests are forbidden
+    # outright (retry exhaustion must shed, never hang)
+    "cluster_goodput_fraction": Gate("lower", 0.0, 0.05),
+    "cluster_retries":          Gate("different"),
+    "cluster_ttft_p99_s":       Gate("higher", 0.25, 0.02),
+    "cluster_unresolved":       Gate("higher", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
-            gspmd_dp_only=False) -> dict:
+            gspmd_dp_only=False, cluster_retry_budget=2) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -140,11 +156,17 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     ``gspmd_dp_only=True`` forces the data-parallel-only regime (no
     model axis) — per-device sharded KV bytes/token double and the
     ``gspmd_sharded_kv_bytes_per_token`` gate must catch it.
+    ``cluster_retry_budget=0`` (--no-retry) disables cross-replica
+    requeue in the kill-and-recover cluster probe — the killed
+    replica's in-flight requests shed instead of retrying, fleet
+    goodput collapses, and the ``cluster_goodput_fraction`` gate must
+    catch it.
     """
     import jax
     import paddle_tpu as paddle
-    from tools.bench_probes import (probe_gspmd, probe_input_pipeline,
-                                    probe_jaxpr, probe_kv_accounting,
+    from tools.bench_probes import (probe_cluster, probe_gspmd,
+                                    probe_input_pipeline, probe_jaxpr,
+                                    probe_kv_accounting,
                                     probe_opt_dispatches, probe_serving,
                                     probe_spec_decode)
     dev = jax.devices()[0]
@@ -173,6 +195,10 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
               ("gspmd_train_compiles", "gspmd_allreduce_count",
                "gspmd_allgather_count", "gspmd_serving_decode_compiles",
                "gspmd_sharded_kv_bytes_per_token"))
+    if "cluster" in probes:
+        _take(probe_cluster(paddle, retry_budget=cluster_retry_budget),
+              ("cluster_goodput_fraction", "cluster_retries",
+               "cluster_ttft_p99_s", "cluster_unresolved"))
     if "optimizer" in probes:
         _take(probe_opt_dispatches(paddle), ("opt_dispatches_per_step",))
     if "pipeline" in probes:
@@ -252,6 +278,11 @@ def main(argv=None) -> int:
                     help="force the gspmd probe's data-parallel-only "
                          "regime (no model axis — per-device sharded KV "
                          "bytes/token double; the injected regression)")
+    ap.add_argument("--no-retry", action="store_true",
+                    help="zero the cluster probe's retry budget: the "
+                         "killed replica's requests shed instead of "
+                         "requeueing, fleet goodput collapses (the "
+                         "injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -275,7 +306,8 @@ def main(argv=None) -> int:
         return 2
     current = collect(probes=probes, burst_tokens=args.burst_tokens,
                       spec_tokens=args.spec_tokens,
-                      gspmd_dp_only=args.dp_only)
+                      gspmd_dp_only=args.dp_only,
+                      cluster_retry_budget=0 if args.no_retry else 2)
 
     if args.json:
         # --json changes the output format, never the action: combined
