@@ -42,9 +42,10 @@ import numpy as np
 # spreads the same fields into the flagship JSON line
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tools.bench_probes import (probe_gspmd,  # noqa: E402
+                                probe_hlo_fusion,
                                 probe_input_pipeline,
                                 probe_opt_dispatches, probe_serving,
-                                probe_spec_decode)
+                                probe_spec_decode, probe_tracing)
 
 # legacy aliases: forensics tests and older tooling call the underscored
 # names on this module
@@ -53,6 +54,8 @@ _probe_serving = probe_serving
 _probe_input_pipeline = probe_input_pipeline
 _probe_spec_decode = probe_spec_decode
 _probe_gspmd = probe_gspmd
+_probe_hlo_fusion = probe_hlo_fusion
+_probe_tracing = probe_tracing
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -212,6 +215,8 @@ def run_bench(config="llama_125m", progress=None):
     spec_probe = _probe_spec_decode(paddle)
     pipeline_probe = _probe_input_pipeline(paddle)
     gspmd_probe = _probe_gspmd(paddle)
+    fusion_probe = _probe_hlo_fusion(paddle)
+    tracing_probe = _probe_tracing(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -281,6 +286,8 @@ def run_bench(config="llama_125m", progress=None):
         **spec_probe,
         **pipeline_probe,
         **gspmd_probe,
+        **fusion_probe,
+        **tracing_probe,
     }
 
 
@@ -554,6 +561,20 @@ def _failure_artifact(last_err, last_stages):
         "gspmd_allgather_count": None,
         "gspmd_serving_decode_compiles": None,
         "gspmd_sharded_kv_bytes_per_token": None,
+        # HLO fusion forensics are per-run compiler observations: a
+        # stale artifact must never claim fusion/kernel counts the
+        # failed run's compiler never produced
+        "hlo_train_fusions": None,
+        "hlo_train_kernels": None,
+        "hlo_serving_fusions": None,
+        "hlo_serving_kernels": None,
+        "hlo_serving_fusion_bytes": None,
+        # request-tracing fields are per-run observations too: a
+        # determinism verdict or span count from a stale round proves
+        # nothing about the run that failed
+        "trace_deterministic": None,
+        "trace_span_count": None,
+        "trace_decode_compiles": None,
     }
     good = _last_good_round()
     if good:

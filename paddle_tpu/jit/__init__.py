@@ -417,7 +417,7 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, accumulate_steps=1,
-                 remat_policy=None, sharding=None):
+                 remat_policy=None, sharding=None, capture_hlo=False):
         from ..nn.scan_stack import REMAT_POLICIES
         self.model = model
         self.loss_fn = loss_fn
@@ -439,9 +439,14 @@ class TrainStep:
                 sharding, _gspmd.ShardingConfig):
             sharding = _gspmd.ShardingConfig.parse(str(sharding))
         self.sharding = sharding
-        #: HLO forensics of the most recent GSPMD-annotated compile:
-        #: the full module text + its collective-op counts (None while
-        #: no sharded specialization has been built)
+        #: HLO forensics of the most recent forensics-captured compile:
+        #: the full module text + its collective-op counts. Captured for
+        #: every GSPMD-annotated compile (the collective-mix gates need
+        #: it) and, with ``capture_hlo=True``, for unsharded compiles
+        #: too (the fusion-forensics probe's surface — one extra
+        #: lower+compile per first call, so it stays opt-in). None until
+        #: a captured specialization has been built.
+        self.capture_hlo = bool(capture_hlo)
         self.last_hlo_text = None
         self.last_hlo_collectives = None
         # compile forensics: wall-ms of the most recent first-call
@@ -717,11 +722,13 @@ class TrainStep:
             from ..profiler import compile_event
             shard_ctx = (_gspmd.partitioning_scope(self._mesh)
                          if shard_cfg is not None else nullcontext())
-            if shard_cfg is not None:
-                # GSPMD forensics: keep the partitioned HLO + its
+            if shard_cfg is not None or self.capture_hlo:
+                # HLO forensics: keep the compiled module + its
                 # collective mix inspectable (tests/test_gspmd.py,
-                # probe_gspmd). One extra lower+compile, paid only on
-                # the first call of a SHARDED specialization.
+                # probe_gspmd; jit/hlo_forensics.py fusion stats via
+                # probe_hlo_fusion). One extra lower+compile, paid only
+                # on the first call of a sharded (or capture_hlo)
+                # specialization.
                 try:
                     with policy_ctx, shard_ctx:
                         hlo = self._cache[key].lower(*args).compile() \

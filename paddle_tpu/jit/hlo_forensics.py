@@ -1,0 +1,119 @@
+"""HLO fusion forensics: measure fusion as a property, not a hope.
+
+XLA's fusion pass is the single biggest lever between "the program the
+trace describes" and "the kernels the chip launches" (the Operator
+Fusion in XLA analysis, PAPERS.md): a refactor — or a JAX/XLA upgrade —
+that splits a hot fused region doubles the HBM traffic of everything
+that used to stay in registers, and nothing in the test suite notices
+because the VALUES are identical. This module turns the compiled HLO
+text (``jit.TrainStep(capture_hlo=True)``, ``LLMEngine.
+ragged_step_hlo()``) into counted, gateable numbers:
+
+- ``fusion_count`` — fusion instruction defs across the whole module
+  (while/scan bodies included): a defused region shows up as MORE
+  fusions (the one region becomes several) or more unfused entry ops;
+- ``kernel_count`` — entry-computation instruction defs that launch
+  work (everything except parameter/constant/tuple/get-tuple-element/
+  bitcast): the per-step launch/thunk count proxy;
+- ``fusion_bytes_total`` / ``fusion_bytes_max`` — bytes touched per
+  fused region (result + operand buffers read off the instruction's
+  inline shapes), summed and worst-case: a split region re-materializes
+  its intermediate, so bytes-touched RISES when fusion regresses;
+- ``fusion_kinds`` — kLoop/kInput/kOutput breakdown.
+
+All of it is deterministic for a pinned jaxlib — which is exactly the
+point: ``tools/proxy_bench.py`` gates these against the checked-in
+baseline with direction-aware tolerances, so the upgrade that silently
+costs 2x on chip fails CI in this chip-free container instead
+(``--defuse`` is the injected regression proving the gate fires).
+"""
+from __future__ import annotations
+
+import re
+
+#: bytes per element for the HLO shape dtypes this stack emits
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: `f32[8,128]` / `s32[]` shape tokens (layout suffixes `{1,0}` ignored)
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]")
+
+#: one instruction definition: `%name = <shape-or-tuple> opname(`
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.\-]+ = (?:\([^)]*\)|\S+) ([\w\-]+)\(")
+
+_FUSION_KIND_RE = re.compile(r"kind=(k\w+)")
+
+#: entry-computation defs that launch no work — everything else is a
+#: kernel/thunk proxy on the CPU/TPU thunk schedule
+_FREE_OPS = ("parameter", "constant", "tuple", "get-tuple-element",
+             "bitcast")
+
+
+def shape_bytes(text: str) -> int:
+    """Total bytes of every shape token in ``text`` (a def line's
+    result type + inline operand types)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _entry_lines(hlo_text: str):
+    """Instruction lines of the ENTRY computation only."""
+    out, in_entry = [], False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry:
+            if line.startswith("}"):
+                break
+            out.append(line)
+    return out
+
+
+def fusion_stats(hlo_text: str) -> dict:
+    """Parse one compiled HLO module's text into the fusion-forensics
+    numbers (see module docstring). Pure text analysis — no device
+    work, deterministic for a pinned compiler."""
+    fusion_bytes = []
+    fusion_kinds: dict[str, int] = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if m is None or m.group(1) != "fusion":
+            continue
+        fusion_bytes.append(shape_bytes(line.split(", calls=")[0]))
+        km = _FUSION_KIND_RE.search(line)
+        if km:
+            fusion_kinds[km.group(1)] = fusion_kinds.get(km.group(1), 0) + 1
+    kernels = 0
+    instructions = 0
+    for line in _entry_lines(hlo_text):
+        m = _DEF_RE.match(line)
+        if m is None:
+            continue
+        instructions += 1
+        if m.group(1) not in _FREE_OPS:
+            kernels += 1
+    return {
+        "fusion_count": len(fusion_bytes),
+        "kernel_count": kernels,
+        "entry_instruction_count": instructions,
+        "fusion_bytes_total": sum(fusion_bytes),
+        "fusion_bytes_max": max(fusion_bytes, default=0),
+        "fusion_kinds": dict(sorted(fusion_kinds.items())),
+    }
+
+
+__all__ = ["fusion_stats", "shape_bytes"]

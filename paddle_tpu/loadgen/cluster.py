@@ -53,6 +53,10 @@ class ClusterRunResult:
     #: pool audits that RAN and passed (every live replica, every
     #: ``check_every`` steps); 0 = auditing disabled, nothing proven
     invariant_checks: int = 0
+    #: the cluster's RequestTracer when one was attached (shared by
+    #: every replica, so a request's spans follow it across crashes and
+    #: retry hops); None otherwise
+    tracer: object = None
 
     def by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -174,6 +178,7 @@ class ClusterDriver:
         result.steps = steps
         result.duration_s = clock.now() - t_start
         result.metrics = cluster.metrics_snapshot()
+        result.tracer = getattr(cluster, "tracer", None)
         return result
 
     #: record folding is IDENTICAL to the single-engine driver's (a

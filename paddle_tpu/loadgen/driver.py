@@ -127,6 +127,10 @@ class RunResult:
     #: raises out of run() — a RunResult you hold passed every one; 0
     #: means auditing was disabled, i.e. nothing was proven)
     invariant_checks: int = 0
+    #: the engine's RequestTracer when one was attached (serving/
+    #: tracing.py) — build_report picks it up for the span-derived
+    #: latency-breakdown section; None otherwise
+    tracer: object = None
 
     def by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -249,6 +253,7 @@ class Driver:
         result.steps = steps
         result.duration_s = clock.now() - t_start
         result.metrics = eng.metrics_snapshot()
+        result.tracer = getattr(eng, "tracer", None)
         return result
 
     @staticmethod

@@ -20,13 +20,12 @@ from __future__ import annotations
 import json
 
 from ..serving.metrics import percentile_of
+# one rounding discipline shared with the trace export: report bytes and
+# trace bytes must never drift apart on float precision
+from ..serving.tracing import _round_floats
 from .workload import trace_fingerprint
 
 SCHEMA_VERSION = 1
-
-#: float precision of the JSON artifact: high enough that distinct
-#: virtual-clock values never collide, fixed so byte-identity holds
-_ROUND = 9
 
 
 def _dist(values) -> dict:
@@ -95,8 +94,27 @@ def _core_sections(result, spec, trace) -> dict:
     }
 
 
-def build_report(result, *, spec=None, trace=None) -> dict:
-    """RunResult (+ spec/trace context) -> the artifact dict."""
+def _breakdown_section(tracer) -> dict:
+    """Span-derived latency attribution (queue vs prefill vs decode vs
+    stall; serving/tracing.py) for reports built with ``tracer=`` — the
+    section that turns a p99 regression into an attributable component
+    instead of one opaque number. Only attached when a tracer is given,
+    so pre-tracing artifacts byte-persist."""
+    from ..serving.tracing import latency_breakdown
+    return latency_breakdown(tracer)
+
+
+def build_report(result, *, spec=None, trace=None, tracer=None) -> dict:
+    """RunResult (+ spec/trace context) -> the artifact dict.
+
+    ``tracer`` (the engine's :class:`~paddle_tpu.serving.tracing.
+    RequestTracer`, when one was attached) adds the span-derived
+    ``latency_breakdown`` section; it defaults to the tracer the driver
+    recorded on the result, so a traced run's report carries the
+    breakdown without extra plumbing. Reports without one are
+    unchanged."""
+    if tracer is None:
+        tracer = getattr(result, "tracer", None)
     m = result.metrics or {}
     tokens = sum(r.num_tokens for r in result.records)
     hits = m.get("prefix_cache_hits", 0)
@@ -139,11 +157,13 @@ def build_report(result, *, spec=None, trace=None) -> dict:
             "pinned_prefix_hits": m.get("pinned_prefix_hits", 0),
         },
     })
+    if tracer is not None:
+        report["latency_breakdown"] = _breakdown_section(tracer)
     return report
 
 
 def build_cluster_report(result, *, spec=None, trace=None,
-                         faults=None) -> dict:
+                         faults=None, tracer=None) -> dict:
     """ClusterRunResult (+ spec/trace/fault-script context) -> the
     fleet artifact dict: everything the single-engine report has at
     fleet scope (exact percentiles over every request record, goodput,
@@ -151,7 +171,10 @@ def build_cluster_report(result, *, spec=None, trace=None,
     budget-sheds, crash/drain/flaky/recovery counts, per-replica
     state-machine time (time-in-degraded-state included), degradation
     ladder transitions, and the fault script that caused it all.
-    Serialize with :func:`report_json` for the byte-identity gate."""
+    Serialize with :func:`report_json` for the byte-identity gate.
+    ``tracer`` behaves exactly like :func:`build_report`'s."""
+    if tracer is None:
+        tracer = getattr(result, "tracer", None)
     recs = result.records
     m = result.metrics or {}
     reps = m.get("replicas", [])
@@ -208,21 +231,18 @@ def build_cluster_report(result, *, spec=None, trace=None,
                 "final_levels": [r.get("degradation_level", 0)
                                  for r in reps],
             },
+            # fleet-level crash dumps + every replica's own dumps
+            # (nonfinite aborts, invariant violations) — carried across
+            # replica deaths like the other per-replica counters
+            "flight_dumps": m.get("flight_dumps", 0)
+            + _csum("flight_dumps"),
             "faults": faults.describe() if faults is not None else None,
             "per_replica": reps,
         },
     })
+    if tracer is not None:
+        report["latency_breakdown"] = _breakdown_section(tracer)
     return report
-
-
-def _round_floats(obj):
-    if isinstance(obj, float):
-        return round(obj, _ROUND)
-    if isinstance(obj, dict):
-        return {k: _round_floats(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_round_floats(v) for v in obj]
-    return obj
 
 
 def report_json(report) -> str:

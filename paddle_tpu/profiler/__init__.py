@@ -220,11 +220,12 @@ class Profiler:
         rows = sorted(agg.items(), key=keyfn)
         unit = {"ms": 1e6, "us": 1e3, "ns": 1.0, "s": 1e9}[time_unit]
         lines = [f"{'Op':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
-                 f"{'Avg':>12}{'Max':>12}"]
-        lines.append("-" * 86)
+                 f"{'Avg':>12}{'Max':>12}{'Min':>12}"]
+        lines.append("-" * 98)
         for name, (calls, total, mx, mn) in rows:
             lines.append(f"{name:<40}{calls:>8}{total / unit:>14.3f}"
-                         f"{total / unit / max(calls, 1):>12.3f}{mx / unit:>12.3f}")
+                         f"{total / unit / max(calls, 1):>12.3f}"
+                         f"{mx / unit:>12.3f}{mn / unit:>12.3f}")
         table = "\n".join(lines)
         print(table)
         return {name: {"calls": c, "total_ns": t, "max_ns": m, "min_ns": mn}

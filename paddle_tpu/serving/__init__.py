@@ -38,14 +38,18 @@ from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       percentile_of)
 from .faults import (FaultEvent, FaultSchedule,  # noqa: F401
                      InjectedFault)
+from .tracing import (FlightRecorder, RequestTracer,  # noqa: F401
+                      latency_breakdown, request_breakdown)
 from .cluster import (ClusterEngine, DegradationLadder,  # noqa: F401
                       ReplicaState)
 
 __all__ = ["BurstPlan", "ClusterEngine", "DegradationLadder",
-           "DraftWorker", "FaultEvent", "FaultSchedule", "Histogram",
+           "DraftWorker", "FaultEvent", "FaultSchedule",
+           "FlightRecorder", "Histogram",
            "InjectedFault", "InvariantViolation", "LLMEngine",
            "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
-           "PoolExhausted", "NULL_PAGE", "ReplicaState", "Scheduler",
+           "PoolExhausted", "NULL_PAGE", "ReplicaState", "RequestTracer",
+           "Scheduler",
            "SchedulerConfig", "Sequence", "SequenceStatus", "StepPlan",
-           "ServingMetrics", "bucket_for", "percentile_of",
-           "speculative_sample"]
+           "ServingMetrics", "bucket_for", "latency_breakdown",
+           "percentile_of", "request_breakdown", "speculative_sample"]

@@ -90,7 +90,8 @@ ADMITTABLE_STATES = (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
 _CARRIED_COUNTERS = ("tokens_generated", "finished_requests", "prefills",
                      "preemptions", "shed_requests", "deadline_aborts",
                      "nonfinite_rows", "degradation_escalations",
-                     "degradation_restorations", "host_dispatches")
+                     "degradation_restorations", "host_dispatches",
+                     "flight_dumps")
 
 
 class DegradationLadder:
@@ -156,6 +157,9 @@ class DegradationLadder:
                 self._hot = 0
                 self.engine.metrics.degradation_escalations.inc()
                 self.engine.metrics.degradation_level.set(self.level)
+                self.engine.record_fleet_event(
+                    "degradation", direction="engage",
+                    rung=self.RUNGS[self.level - 1], level=self.level)
         else:
             self._cool += 1
             self._hot = 0
@@ -165,6 +169,9 @@ class DegradationLadder:
                 self._cool = 0
                 self.engine.metrics.degradation_restorations.inc()
                 self.engine.metrics.degradation_level.set(self.level)
+                self.engine.record_fleet_event(
+                    "degradation", direction="restore",
+                    rung=self.RUNGS[self.level], level=self.level)
 
     def _engage(self, rung: str):
         eng = self.engine
@@ -253,7 +260,8 @@ class ClusterEngine:
                  retry_backoff_s=0.02, session_affinity=True,
                  recovery_steps=2, crash_after_flaky=3,
                  crash_recover_s=None, faults: FaultSchedule | None = None,
-                 ladder=True, ladder_kw=None, **engine_kw):
+                 ladder=True, ladder_kw=None, tracer=None,
+                 flight_capacity=256, **engine_kw):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
@@ -272,7 +280,19 @@ class ClusterEngine:
         self.crash_recover_s = crash_recover_s
         self._model = model
         self._seed = seed
+        # fleet observability (serving/tracing.py): ONE always-on
+        # flight recorder shared by every replica engine (their step/
+        # abort entries interleave with router/fault/crash entries on
+        # the one clock — the "last N steps of fleet events" a crash
+        # dump replays), and an optional shared tracer so a request's
+        # spans follow it ACROSS replicas (enqueue on replica 0, crash,
+        # retry hop, re-prefill on replica 2 — one timeline).
+        from .tracing import FlightRecorder
+        self.tracer = tracer
+        self.flight = FlightRecorder(flight_capacity)
         self._engine_kw = dict(engine_kw)
+        self._engine_kw["tracer"] = tracer
+        self._engine_kw["flight_recorder"] = self.flight
         self._ladder_on = ladder
         self._ladder_kw = dict(ladder_kw or {})
         #: seeded router stream: power-of-two-choices candidate draws
@@ -286,7 +306,8 @@ class ClusterEngine:
             "retries", "retry_budget_sheds", "fleet_unavailable_sheds",
             "crashes", "recoveries", "drains", "flaky_steps",
             "engine_errors", "router_decisions", "affinity_hits",
-            "state_transitions", "kv_pressure_faults", "slowdown_faults")}
+            "state_transitions", "kv_pressure_faults", "slowdown_faults",
+            "flight_dumps")}
         now = self._now()
         self.replicas = [self._new_replica(i, now)
                          for i in range(num_replicas)]
@@ -305,16 +326,16 @@ class ClusterEngine:
     # ------------------------------------------------------------------
     # replica construction / health
     # ------------------------------------------------------------------
-    def _new_engine(self) -> LLMEngine:
+    def _new_engine(self, rid=None) -> LLMEngine:
         # every replica gets the SAME engine seed: a request's sampling
         # streams are pure functions of (engine seed, request seed,
         # position), so a retry on another replica regenerates the same
         # tokens — the cross-replica token-identity contract
         return LLMEngine(self._model, now_fn=self._now, seed=self._seed,
-                         **self._engine_kw)
+                         engine_id=rid, **self._engine_kw)
 
     def _new_replica(self, rid: int, now: float) -> _Replica:
-        eng = self._new_engine()
+        eng = self._new_engine(rid)
         ladder = DegradationLadder(eng, **self._ladder_kw) \
             if self._ladder_on else None
         rep = _Replica(rid=rid, engine=eng, ladder=ladder,
@@ -453,6 +474,9 @@ class ClusterEngine:
             touched[rid] = out
             return True
         meta["replica"] = rep.rid
+        if self.tracer is not None:
+            self.tracer.span(rid, "dispatch", now, replica=rep.rid,
+                             retry=meta["retries"])
         out = self._outputs[rid]
         if out.status == "pending":
             out.status = "waiting"
@@ -489,6 +513,8 @@ class ClusterEngine:
         self._unfinished[rid] = None
         if not self._dispatch(rid, None):
             self._parked.append(rid)
+            if self.tracer is not None:
+                self.tracer.span(rid, "park", self._now())
         return rid
 
     def request_retries(self, request_id) -> int:
@@ -591,6 +617,8 @@ class ClusterEngine:
             self._fault_cursor += 1
             if ev.replica >= len(self.replicas):
                 continue
+            self.flight.record("fault", now, fault=ev.kind,
+                               replica=ev.replica)
             rep = self.replicas[ev.replica]
             if ev.kind == "crash":
                 if rep.engine is not None:
@@ -612,7 +640,7 @@ class ClusterEngine:
         for rep in self.replicas:
             if rep.state is ReplicaState.DOWN:
                 if rep.recover_at is not None and now >= rep.recover_at:
-                    rep.engine = self._new_engine()
+                    rep.engine = self._new_engine(rep.rid)
                     rep.ladder = DegradationLadder(
                         rep.engine, **self._ladder_kw) \
                         if self._ladder_on else None
@@ -669,13 +697,16 @@ class ClusterEngine:
         self._set_state(rep, ReplicaState.DRAINING, now)
         rep.drain_until = until
         rep.engine.scheduler.admission_blocked = True
+        self.flight.record("drain", now, replica=rep.rid)
+        if self.tracer is not None:
+            self.tracer.event("drain", now, replica=rep.rid)
         # waiting work will not start here for the whole window — hand
         # it to survivors now; running rows finish their drain in place
         waiting_ids = [s.seq_id for s in rep.engine.scheduler.waiting]
         for rid in waiting_ids:
             if rid in self._meta and rep.engine.withdraw(rid):
                 self._meta[rid]["replica"] = None
-                self._requeue(rid, now, touched)
+                self._requeue(rid, now, touched, from_replica=rep.rid)
 
     def _crash(self, rep: _Replica, now: float, recover_s, touched: dict):
         self.counters["crashes"] += 1
@@ -694,11 +725,23 @@ class ClusterEngine:
         rep.recover_at = None if recover_s is None else now + recover_s
         rep.drain_until = None
         self._set_state(rep, ReplicaState.DOWN, now)
+        # replica crash: the canonical flight-recorder auto-dump — the
+        # last-N fleet events (every replica's steps, faults, requeues)
+        # leading into the crash become the post-mortem artifact
+        self.flight.record("crash", now, replica=rep.rid,
+                           victims=len(victims))
+        self.counters["flight_dumps"] += 1
+        self.flight.dump("replica_crash", t=now, replica=rep.rid,
+                         victims=len(victims))
+        if self.tracer is not None:
+            self.tracer.event("replica_crash", now, replica=rep.rid,
+                              victims=len(victims))
         for rid in victims:
             self._meta[rid]["replica"] = None
-            self._requeue(rid, now, touched)
+            self._requeue(rid, now, touched, from_replica=rep.rid)
 
-    def _requeue(self, rid: str, now: float, touched: dict):
+    def _requeue(self, rid: str, now: float, touched: dict,
+                 from_replica=None):
         """Retry-with-backoff: park the request for redispatch on a
         survivor, or convert an exhausted retry budget into a
         STRUCTURED shed — a terminal ``RequestOutput`` the client can
@@ -713,6 +756,10 @@ class ClusterEngine:
             out.status = "shed"
             out.finish_reason = "retries_exhausted"
             self._unfinished.pop(rid, None)
+            if self.tracer is not None:
+                self.tracer.span(rid, "shed", now,
+                                 reason="retries_exhausted",
+                                 from_replica=from_replica)
         else:
             meta["retries"] += 1
             self.counters["retries"] += 1
@@ -729,6 +776,13 @@ class ClusterEngine:
             out.token_ids = []
             out.finish_reason = None
             self._parked.append(rid)
+            if self.tracer is not None:
+                # the cross-replica hop: retry ordinal, which replica
+                # lost the request, and when the backoff releases it
+                self.tracer.span(rid, "retry_hop", now,
+                                 retry=meta["retries"],
+                                 from_replica=from_replica,
+                                 not_before=meta["not_before"])
         touched[rid] = out
 
     def _fleet_dead(self) -> bool:
@@ -752,6 +806,9 @@ class ClusterEngine:
                 out.status = "shed"
                 out.finish_reason = "fleet_unavailable"
                 self._unfinished.pop(rid, None)
+                if self.tracer is not None:
+                    self.tracer.span(rid, "shed", now,
+                                     reason="fleet_unavailable")
                 touched[rid] = out
             return
         for _ in range(len(self._parked)):

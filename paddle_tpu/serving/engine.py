@@ -244,7 +244,9 @@ class LLMEngine:
                  quantized_mode=None, kv_cache_dtype=None,
                  burst_tokens=None, draft_model=None, spec_tokens=None,
                  draft_quantized_mode="weight_only_int4",
-                 draft_num_pages=None, mesh=None):
+                 draft_num_pages=None, mesh=None, tracer=None,
+                 flight_recorder=None, flight_capacity=256,
+                 engine_id=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -371,6 +373,27 @@ class LLMEngine:
             high_watermark=high_watermark, low_watermark=low_watermark,
             pinned_page_budget=pinned_prefix_pages, mesh=self.mesh)
         self.metrics = ServingMetrics(now_fn=now_fn)
+        # observability (serving/tracing.py): the per-request span
+        # tracer is OPT-IN (None = zero per-request bookkeeping); the
+        # flight recorder is ALWAYS ON — a bounded ring of step/fleet
+        # events whose last-N context auto-dumps on InvariantViolation,
+        # nonfinite-logits aborts, and (cluster) replica crashes. Both
+        # are host-side appends stamped on now_fn: they add zero jitted
+        # dispatches and zero device syncs (tests/test_tracing.py gates
+        # the trace-count and dispatch ratios with tracing enabled).
+        from .tracing import FlightRecorder
+        self.tracer = tracer
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FlightRecorder(flight_capacity)
+        #: replica id under a ClusterEngine (fleet flight entries carry
+        #: it); None for a standalone engine
+        self.engine_id = engine_id
+        # a failing pool audit raises InvariantViolation WITH the
+        # flight recorder's last-N context attached (kv_cache.py reads
+        # these back-references at raise time; the counter keeps
+        # metrics.flight_dumps honest for audit-triggered dumps too)
+        self.pool.flight_recorder = self.flight
+        self.pool.flight_dump_counter = self.metrics.flight_dumps
         self.scheduler = Scheduler(
             self.pool,
             SchedulerConfig(max_num_seqs=max_num_seqs,
@@ -750,6 +773,8 @@ class LLMEngine:
         self._seqs[rid] = seq
         self._outputs[rid] = RequestOutput(rid, prompt)
         self.metrics.requests_added.inc()
+        self._trace(rid, "enqueue", t=now, prompt_len=len(prompt),
+                    max_new_tokens=int(max_new_tokens))
         return rid
 
     def cancel(self, request_id) -> bool:
@@ -804,6 +829,59 @@ class LLMEngine:
         del self._outputs[request_id]
         self._seqs.pop(request_id, None)
         return out
+
+    # ------------------------------------------------------------------
+    # observability (serving/tracing.py)
+    # ------------------------------------------------------------------
+    def _trace(self, rid, kind, t=None, **detail):
+        """Record one request span when a tracer is attached — a plain
+        host-side append stamped on now_fn; no-op (one attribute read)
+        without a tracer."""
+        if self.tracer is not None:
+            self.tracer.span(rid, kind, self._now() if t is None else t,
+                             **detail)
+
+    def record_fleet_event(self, kind, **detail):
+        """Engine-scope event onto the flight recorder (always) and the
+        tracer's event stream (when attached) — degradation rung moves,
+        fault effects, anything not owned by one request."""
+        now = self._now()
+        if self.engine_id is not None:
+            detail.setdefault("engine", self.engine_id)
+        self.flight.record(kind, now, **detail)
+        if self.tracer is not None:
+            self.tracer.event(kind, now, **detail)
+
+    def flight_dump(self, reason, **detail) -> dict:
+        """Snapshot the flight recorder's last-N events as a structured
+        post-mortem (counted on ``metrics.flight_dumps``)."""
+        if self.engine_id is not None:
+            detail.setdefault("engine", self.engine_id)
+        self.metrics.flight_dumps.inc()
+        return self.flight.dump(reason, t=self._now(), **detail)
+
+    def ragged_step_hlo(self):
+        """Compiled HLO text of the ONE ragged-step executable, lowered
+        AOT over zero-filled operands at the exact launch shapes — the
+        fusion-forensics surface (tools/bench_probes.probe_hlo_fusion;
+        jit/hlo_forensics.py parses it). Out-of-band by construction:
+        the jit dispatch cache and the trace-count==1 gate are
+        untouched."""
+        import jax.numpy as jnp
+        T, R, PPS = (self.step_token_budget, self.max_num_seqs,
+                     self.max_pages_per_seq)
+        K = self.spec_tokens
+        z = jnp.zeros
+        args = (self.params, self.pool.kv, self.pool.kv_scales,
+                z((T,), jnp.int32), z((T,), jnp.int32),
+                jnp.full((R, PPS), NULL_PAGE, jnp.int32),
+                jnp.full((R,), T, jnp.int32), z((R,), jnp.int32),
+                z((R,), jnp.int32), z((R, K + 1), jnp.int32),
+                z((R,), jnp.float32), z((R,), jnp.int32),
+                jnp.ones((R,), jnp.float32), z((R,), jnp.int32),
+                z((R,), jnp.int32), z((R,), jnp.int32),
+                self._zero_draft[0], self._zero_draft[1], self._base_key)
+        return self._ragged_jit.lower(*args).compile().as_text()
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
@@ -871,6 +949,14 @@ class LLMEngine:
         hook = self._prefix_probe if self.prefix_caching else None
         for seq in self.scheduler.admit(prefix_hook=hook):
             touched[seq.seq_id] = self._sync_output(seq)
+            if self.tracer is not None:
+                now = self._now()
+                self._trace(
+                    seq.seq_id, "admission", t=now,
+                    prefix_shared=seq.cached_len,
+                    queue_s=now - (seq.enqueued_at
+                                   if seq.enqueued_at is not None
+                                   else seq.arrival))
         plan = None
         bplan = None
         splan = None
@@ -895,6 +981,9 @@ class LLMEngine:
                 self._draft.drop(t.seq_id)  # recompute re-syncs from 0
             self._sync_output(t)           # surface fresh preemptions once
             touched[t.seq_id] = self._outputs[t.seq_id]
+            self._trace(t.seq_id, "preempt",
+                        num_preemptions=t.num_preemptions)
+            self.flight.record("preempt", self._now(), request=t.seq_id)
         if splan is not None:
             if splan.cow_copies:
                 self.metrics.cow_copies.inc(splan.cow_copies)
@@ -945,14 +1034,36 @@ class LLMEngine:
                 if self.prefix_caching and \
                         before < len(seq.prompt_ids) <= seq.cached_len:
                     self._register_prefix(seq)
-                if seq.cached_len == seq.total_len:
+                caught_up = seq.cached_len == seq.total_len
+                if caught_up:
                     # the row is caught up: its sampled token is the next
                     # generated token. Mid-prompt chunks discard theirs.
                     self._commit_token(seq, int(sampled[i, 0]))
+                if self.tracer is not None:
+                    if q_len > 1 or before < len(seq.prompt_ids):
+                        self._trace(seq.seq_id, "prefill_chunk",
+                                    q_len=int(q_len),
+                                    cached=int(seq.cached_len),
+                                    new_tokens=1 if caught_up else 0)
+                    else:
+                        # a 1-token recompute row inside the generated
+                        # region commits nothing until it catches up
+                        self._trace(seq.seq_id, "decode",
+                                    new_tokens=1 if caught_up else 0)
                 touched[seq.seq_id] = self._outputs[seq.seq_id]
             self.metrics.decode_steps.inc()
             self.metrics.ragged_pad_fraction.set(plan.pad_fraction)
         self.metrics.record_step(self.scheduler, self.pool)
+        # one O(1) flight-recorder entry per step: the bounded last-N
+        # context a post-mortem dump replays (ints only — cheap and
+        # deterministic)
+        f = {"running": len(self.scheduler.running),
+             "waiting": len(self.scheduler.waiting),
+             "used_pages": self.pool.used_pages,
+             "tokens": self.metrics.tokens_generated.value}
+        if self.engine_id is not None:
+            f["engine"] = self.engine_id
+        self.flight.record("step", self._now(), **f)
         return list(touched.values())
 
     def run(self, max_steps=None):
@@ -1200,6 +1311,9 @@ class LLMEngine:
                 self.pool.rollback(seq.seq_id, seq.cached_len)
                 self._draft.commit(seq.seq_id, cached_old,
                                    committed - 1, spec)
+            self._trace(seq.seq_id, "spec_round", drafted=int(spec),
+                        accepted=int(n - 1), new_tokens=int(committed),
+                        rollback=bool(n - 1 < spec))
             touched[seq.seq_id] = self._outputs[seq.seq_id]
         m = self.metrics
         m.spec_rounds.inc()
@@ -1284,6 +1398,8 @@ class LLMEngine:
             self.pool.set_seq_len(seq.seq_id, seq.cached_len)
             for j in range(g):
                 self._commit_token(seq, int(out[i, j]))
+            self._trace(seq.seq_id, "burst", new_tokens=g,
+                        burst_cap=int(cap))
             touched[seq.seq_id] = self._outputs[seq.seq_id]
 
     def _commit_token(self, seq: Sequence, tok: int):
@@ -1308,8 +1424,12 @@ class LLMEngine:
         flagged: the request finalizes with ``finish_reason
         "nonfinite_logits"`` (status aborted), its pages are freed, and
         the ``nonfinite_rows`` counter records the event — the engine
-        keeps serving every other row instead of streaming garbage."""
+        keeps serving every other row instead of streaming garbage.
+        The flight recorder auto-dumps its last-N context (the steps
+        LEADING INTO the numeric blow-up are the post-mortem)."""
         self.metrics.nonfinite_rows.inc()
+        self.flight.record("nonfinite", self._now(), request=seq.seq_id)
+        self.flight_dump("nonfinite_logits", request=seq.seq_id)
         self._finalize(seq, "aborted", reason="nonfinite_logits")
 
     def _finalize(self, seq: Sequence, status: str, reason=None):
@@ -1323,6 +1443,24 @@ class LLMEngine:
         }[status])
         out = self._sync_output(seq)
         out.finish_reason = reason or status
+        if self.tracer is not None:
+            # terminal span: kind encodes the lifecycle exit so the
+            # breakdown/post-mortem can branch without string-matching
+            # reasons (deadline_abort/nonfinite_abort/shed/finish)
+            if reason == "deadline_exceeded":
+                kind = "deadline_abort"
+            elif reason == "nonfinite_logits":
+                kind = "nonfinite_abort"
+            elif status == "shed":
+                kind = "shed"
+            else:
+                kind = "finish"
+            self._trace(seq.seq_id, kind, status=status,
+                        reason=out.finish_reason,
+                        tokens=len(seq.tokens))
+        if status in ("shed", "aborted"):
+            self.flight.record(status, self._now(), request=seq.seq_id,
+                               reason=out.finish_reason)
         if status == "finished":
             self.metrics.finished_requests.inc()
             self.metrics.record_request_end(

@@ -85,6 +85,11 @@ class InvariantViolation(AssertionError):
     bare assert with no state. Subclasses ``AssertionError`` so callers
     (and tests) that caught the old asserts keep working."""
 
+    #: flight-recorder post-mortem (the last-N engine/fleet events
+    #: leading into the failure) when the pool had a recorder attached
+    #: (serving/tracing.py) — None for bare pools
+    flight_dump = None
+
     def __init__(self, reason, snapshot):
         self.reason = reason
         self.snapshot = snapshot
@@ -646,7 +651,20 @@ class PagedKVPool:
         offending page ids) instead of a bare assert.
         """
         def fail(reason, pages=()):
-            raise InvariantViolation(reason, self.snapshot(pages))
+            err = InvariantViolation(reason, self.snapshot(pages))
+            # always-on flight recorder (serving/tracing.py): the engine
+            # back-references its recorder on the pool so a failing
+            # audit ships the last-N steps of context WITH the exception
+            # — a soak that dies mid-storm is triageable from the
+            # artifact alone. A bare pool (unit tests) has no recorder.
+            fr = getattr(self, "flight_recorder", None)
+            if fr is not None:
+                ctr = getattr(self, "flight_dump_counter", None)
+                if ctr is not None:
+                    ctr.inc()
+                err.flight_dump = fr.dump("invariant_violation",
+                                          violation=reason)
+            raise err
 
         mapped: dict[int, int] = {}
         for sid, t in self._tables.items():

@@ -154,7 +154,11 @@ class ServingMetrics:
                 # engaged under sustained pressure / restored after it
                 # clears — serving/cluster.DegradationLadder)
                 "deadline_aborts", "nonfinite_rows",
-                "degradation_escalations", "degradation_restorations")
+                "degradation_escalations", "degradation_restorations",
+                # observability (PR 12): flight-recorder post-mortem
+                # dumps taken (InvariantViolation / nonfinite abort /
+                # replica crash auto-dumps + any operator-requested one)
+                "flight_dumps")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",

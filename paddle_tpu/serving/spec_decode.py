@@ -68,6 +68,14 @@ define_flag("spec_decode_tokens", int, 0,
             "LLMEngine constructed with draft_model=...",
             on_set=_check_spec_tokens)
 
+define_flag("fusion_probe_barrier", bool, False,
+            "DEBUG/forensics only: insert a jax.lax.optimization_barrier "
+            "between the ragged layer's attention epilogue and the o-proj "
+            "at trace time, splitting the hot fused region. This is the "
+            "fusion-forensics INJECTED REGRESSION (tools/proxy_bench.py "
+            "--defuse): fusion/kernel counts rise and the gate must fail. "
+            "Never set in production — it exists to prove the gate fires.")
+
 #: stream tags for the per-request PRNG streams (request_keys): the
 #: draft's proposal draw, the verifier's acceptance uniform, and the
 #: residual/bonus/plain-sampling draw all at one generation position
@@ -125,6 +133,13 @@ def _ragged_fp_layer(lyr, h, Kp, Vp, positions, tbls, tok_row, live,
     o = ragged_paged_attention(q[0], Kp, Vp, tbls, q_starts, q_lens,
                                kv_lens, q_block=q_block,
                                interpret=interpret)
+    from ..core.flags import GLOBAL_FLAGS
+    if GLOBAL_FLAGS.get("fusion_probe_barrier"):
+        # trace-time injected regression (FLAGS_fusion_probe_barrier):
+        # the barrier forbids fusion across the attention->o-proj seam,
+        # splitting the layer's hot fused region — exactly the defect
+        # the probe_hlo_fusion proxy gates exist to catch
+        (o,) = jax.lax.optimization_barrier((o,))
     h = h + _wmat(o.reshape(1, T, H * d), lyr["o"])
     x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
     h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"])) * _wmat(x, lyr["up"]),

@@ -95,7 +95,16 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # collective mix and per-device KV bytes are per-run
               "gspmd_train_compiles", "gspmd_allreduce_count",
               "gspmd_allgather_count", "gspmd_serving_decode_compiles",
-              "gspmd_sharded_kv_bytes_per_token"):
+              "gspmd_sharded_kv_bytes_per_token",
+              # HLO fusion forensics + tracing fields (PR 12): fusion/
+              # kernel counts are compiler observations of THIS run,
+              # and a determinism verdict from a stale round proves
+              # nothing about the run that failed
+              "hlo_train_fusions", "hlo_train_kernels",
+              "hlo_serving_fusions", "hlo_serving_kernels",
+              "hlo_serving_fusion_bytes",
+              "trace_deterministic", "trace_span_count",
+              "trace_decode_compiles"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -415,3 +424,58 @@ def test_spec_probe_never_fabricates_on_failure(monkeypatch):
     assert out["spec_accept_rate"] is None
     assert out["spec_decode_compiles"] is None
     assert "spec_decode_probe_error" in out
+
+
+def test_proxy_bench_catches_defused_region():
+    """End-to-end fusion regression injection (ISSUE 12): run the
+    fusion probe with FLAGS_fusion_probe_barrier splitting the ragged
+    layer's hot fused region and gate against the checked-in baseline —
+    serving fusion/kernel counts and fused-region bytes all rise past
+    their exact bounds; the healthy collection of the same probe must
+    pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("fusion",), fusion_defuse=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "hlo_serving_fusions" in names
+    assert "hlo_serving_kernels" in names
+    assert "hlo_serving_fusion_bytes" in names
+    assert bad["metrics"]["hlo_serving_fusions"] > \
+        baseline["metrics"]["hlo_serving_fusions"]
+
+    good = pb.collect(probes=("fusion",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    # the barrier flag must have been restored by the probe
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+    assert GLOBAL_FLAGS.get("fusion_probe_barrier") is False
+
+
+def test_tracing_probe_gates_and_never_fabricates():
+    """The tracing probe's healthy collection passes its exact gates
+    (byte-identical export, pinned span count, one executable); a
+    broken probe reports nulls + an error field."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    good = pb.collect(probes=("tracing",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["trace_deterministic"] == 1
+    assert good["metrics"]["trace_decode_compiles"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_tracing(Boom())
+    assert out["trace_deterministic"] is None
+    assert out["trace_span_count"] is None
+    assert "tracing_probe_error" in out

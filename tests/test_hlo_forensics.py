@@ -1,0 +1,161 @@
+"""HLO fusion forensics gates (ISSUE 12, ROADMAP item 4b): fusion as a
+measured, gated property — the parser, the two capture surfaces
+(TrainStep / ragged serving step), and the injected defusion regression
+(FLAGS_fusion_probe_barrier) that proves the proxy gates fire."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit as pjit
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.jit.hlo_forensics import fusion_stats, shape_bytes
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import LLMEngine
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------------------
+# parser unit gates (synthetic HLO text — exact expectations)
+# ---------------------------------------------------------------------------
+
+_SYNTH = """\
+HloModule jit_f, entry_computation_layout={(f32[8,16]{1,0})->f32[8,16]{1,0}}
+
+%fused_computation (p0: f32[8,16]) -> f32[8,16] {
+  %p0 = f32[8,16]{1,0} parameter(0)
+  ROOT %e = f32[8,16]{1,0} exponential(f32[8,16]{1,0} %p0)
+}
+
+%wbody (carry: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %carry = (s32[], f32[4]{0}) parameter(0)
+  %g = s32[] get-tuple-element((s32[], f32[4]{0}) %carry), index=0
+  %h = f32[4]{0} get-tuple-element((s32[], f32[4]{0}) %carry), index=1
+  %inner = f32[4]{0} fusion(f32[4]{0} %h), kind=kInput, calls=%fc2
+  ROOT %t = (s32[], f32[4]{0}) tuple(s32[] %g, f32[4]{0} %inner)
+}
+
+ENTRY %main (Arg_0.1: f32[8,16]) {
+  %Arg_0.1 = f32[8,16]{1,0} parameter(0)
+  %c = f32[] constant(1)
+  %b = f32[8,16]{1,0} broadcast(f32[] %c), dimensions={}
+  %fusion = f32[8,16]{1,0} fusion(f32[8,16]{1,0} %Arg_0.1), kind=kLoop, calls=%fused_computation
+  %d = f32[8,16]{1,0} dot(f32[8,16]{1,0} %fusion, f32[8,16]{1,0} %b)
+  %gte = f32[8,16]{1,0} bitcast(f32[8,16]{1,0} %d)
+  ROOT %add = f32[8,16]{1,0} add(f32[8,16]{1,0} %gte, f32[8,16]{1,0} %b)
+}
+"""
+
+
+def test_shape_bytes_exact():
+    assert shape_bytes("f32[8,16]{1,0}") == 8 * 16 * 4
+    assert shape_bytes("bf16[4] s8[2,3]") == 4 * 2 + 6
+    assert shape_bytes("s32[]") == 4                 # scalar
+    assert shape_bytes("pred[7]") == 7
+    assert shape_bytes("no shapes here") == 0
+
+
+def test_fusion_stats_on_synthetic_module():
+    s = fusion_stats(_SYNTH)
+    # module-wide fusions: the entry kLoop + the while-body kInput
+    assert s["fusion_count"] == 2
+    assert s["fusion_kinds"] == {"kInput": 1, "kLoop": 1}
+    # entry kernels: broadcast + fusion + dot + add (parameter/constant/
+    # bitcast are free); instructions counts every def
+    assert s["kernel_count"] == 4
+    assert s["entry_instruction_count"] == 7
+    # entry fusion line: result + 1 operand, both f32[8,16] = 512 B;
+    # while-body fusion: f32[4] x 2 = 32 B
+    assert s["fusion_bytes_total"] == 2 * 512 + 2 * 16
+    assert s["fusion_bytes_max"] == 1024
+
+
+def test_fusion_stats_empty_module():
+    s = fusion_stats("HloModule m\n\nENTRY %main () {\n}\n")
+    assert s["fusion_count"] == 0
+    assert s["kernel_count"] == 0
+    assert s["fusion_bytes_max"] == 0
+
+
+# ---------------------------------------------------------------------------
+# capture surfaces
+# ---------------------------------------------------------------------------
+
+def _train_step(model, capture_hlo):
+    cfg = model.config
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(ids):
+        logits = model(ids)
+        return F.cross_entropy(
+            logits[:, :-1].reshape((-1, cfg.vocab_size)),
+            ids[:, 1:].reshape((-1,)))
+
+    step = pjit.TrainStep(model, loss_fn, opt, capture_hlo=capture_hlo)
+    ids = paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)))
+    step(ids)
+    return step
+
+
+def test_trainstep_capture_hlo_opt_in(tiny_model):
+    """capture_hlo=True keeps the optimized module text of an UNSHARDED
+    compile (the fusion probe's surface); the default stays None — the
+    extra lower+compile is opt-in."""
+    step = _train_step(tiny_model, capture_hlo=True)
+    assert step.last_hlo_text is not None
+    stats = fusion_stats(step.last_hlo_text)
+    assert stats["fusion_count"] > 0
+    assert stats["kernel_count"] > 0
+    step_off = _train_step(tiny_model, capture_hlo=False)
+    assert step_off.last_hlo_text is None
+
+
+def test_ragged_step_hlo_is_out_of_band(tiny_model):
+    """The engine's AOT HLO capture measures the REAL serving
+    executable without perturbing the dispatch path: fusion stats come
+    back, and the trace-count gate still reads whatever it read
+    before."""
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4, max_num_seqs=2)
+    before = eng.decode_cache_size()
+    hlo = eng.ragged_step_hlo()
+    assert "ragged_step" in hlo
+    stats = fusion_stats(hlo)
+    assert stats["fusion_count"] > 0
+    assert stats["fusion_bytes_total"] > 0
+    assert eng.decode_cache_size() == before, \
+        "AOT lowering must not touch the jit dispatch cache"
+    # the engine still serves normally afterwards
+    eng.add_request([1, 2, 3], max_new_tokens=2)
+    eng.run(max_steps=50)
+    assert eng.decode_cache_size() == 1
+
+
+def test_fusion_barrier_flag_splits_the_region(tiny_model):
+    """FLAGS_fusion_probe_barrier is the injected regression: the
+    barrier splits the ragged layer's hot fused region, so fusion AND
+    kernel counts rise and bytes-touched grows — exactly what the
+    proxy-bench gates pin."""
+    def stats():
+        eng = LLMEngine(tiny_model, max_len=32, page_size=4,
+                        max_num_seqs=2)
+        return fusion_stats(eng.ragged_step_hlo())
+
+    base = stats()
+    GLOBAL_FLAGS.set("fusion_probe_barrier", True)
+    try:
+        split = stats()
+    finally:
+        GLOBAL_FLAGS.set("fusion_probe_barrier", False)
+    assert split["fusion_count"] > base["fusion_count"]
+    assert split["kernel_count"] > base["kernel_count"]
+    assert split["fusion_bytes_total"] > base["fusion_bytes_total"]

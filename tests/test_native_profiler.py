@@ -150,3 +150,58 @@ def test_protobuf_export_and_enums(tmp_path):
     stats = prof.summary(sorted_by=profiler.SortedKeys.CPUAvg)
     assert "matmul" in stats
     assert profiler.SummaryView.OperatorView.value == 5
+
+
+def test_protobuf_roundtrip_events_exact(tmp_path):
+    """ISSUE-12 satellite: export_protobuf / load_profiler_result is a
+    LOSSLESS round-trip — events-in == events-out, tuple order
+    preserved. Uses a stub profiler (the handler only needs .events()),
+    so the gate runs with or without the native recorder."""
+    import paddle_tpu.profiler as profiler
+
+    events = [
+        ("matmul", 1, 100, 50, 1),
+        ("user_span", 2, 120, 30, 2),
+        ("matmul", 1, 200, 40, 1),       # duplicate name, later start
+        ("compile:TrainStep", 1, 10, 990, 2),
+        ("serving.queue_depth=3.000", 3, 250, 0, 3),
+    ]
+
+    class _StubProf:
+        def events(self):
+            return list(events)
+
+    path_holder = {}
+    handler = profiler.export_protobuf(str(tmp_path), worker_name="t")
+
+    # the handler returns the written path
+    path_holder["p"] = handler(_StubProf())
+    assert path_holder["p"].endswith("t.pb")
+    loaded = profiler.load_profiler_result(path_holder["p"])
+    assert loaded == events, "round-trip must preserve every tuple " \
+        "and their order"
+
+
+def test_summary_renders_min_column(capsys, monkeypatch):
+    """ISSUE-12 satellite: ``Profiler.summary`` aggregates min_ns but
+    the rendered table used to drop the Min column — header and rows
+    must both carry it now, and the returned stats keep min_ns."""
+    import paddle_tpu.profiler as profiler
+
+    fake = [("op_a", 1, 0, 4_000_000, 1),    # 4 ms
+            ("op_a", 1, 10, 1_000_000, 1),   # 1 ms  -> min
+            ("op_b", 1, 20, 2_000_000, 1)]
+    monkeypatch.setattr(profiler._nv, "prof_export", lambda: list(fake))
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU])
+    stats = prof.summary(time_unit="ms")
+    out = capsys.readouterr().out
+    header = out.splitlines()[0]
+    assert "Min" in header and "Max" in header
+    # op_a row: calls=2 total=5ms avg=2.5 max=4 min=1
+    row_a = next(line for line in out.splitlines() if line.startswith("op_a"))
+    cols = row_a.split()
+    assert cols[-1] == "1.000" and cols[-2] == "4.000", row_a
+    assert stats["op_a"]["min_ns"] == 1_000_000
+    # sorted_by="min" orders ascending by min_ns
+    stats_min = prof.summary(sorted_by=profiler.SortedKeys.CPUMin)
+    assert list(stats_min)[0] == "op_a"

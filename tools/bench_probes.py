@@ -553,6 +553,132 @@ def probe_jaxpr(paddle, shallow=2, deep=8):
                 "jaxpr_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_hlo_fusion(paddle, defuse=False):
+    """Measured HLO fusion-forensics fields (jit/hlo_forensics.py) for
+    the bench trajectory — ROADMAP item 4(b): make fusion a measured,
+    gated property.
+
+    Two compiled programs are parsed: the jitted TrainStep of a micro
+    Llama (``TrainStep(capture_hlo=True)`` keeps the optimized module
+    text) and the serving engine's ONE ragged step executable
+    (``LLMEngine.ragged_step_hlo()``, lowered AOT so the dispatch cache
+    and trace-count gate are untouched). Records module-wide fusion
+    instruction counts, entry-computation kernel/thunk counts, and
+    bytes touched per fused region — all deterministic for a pinned
+    jaxlib, so tools/proxy_bench.py holds them to the baseline with
+    direction-aware gates: MORE fusions/kernels or more bytes touched
+    means a hot region defused, which on chip is silent 2x HBM traffic.
+    ``defuse=True`` (the proxy-bench ``--defuse`` regression hook) sets
+    FLAGS_fusion_probe_barrier, splitting the ragged layer's fused
+    region at trace time — every serving-side gate must catch it.
+    """
+    try:
+        import numpy as _np
+        import paddle_tpu.nn.functional as _F
+        from paddle_tpu import jit as _pjit
+        from paddle_tpu.core.flags import GLOBAL_FLAGS
+        from paddle_tpu.jit.hlo_forensics import fusion_stats
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        old = bool(GLOBAL_FLAGS.get("fusion_probe_barrier"))
+        if defuse:
+            GLOBAL_FLAGS.set("fusion_probe_barrier", True)
+        try:
+            cfg = llama_tiny_config(
+                num_hidden_layers=1, hidden_size=64,
+                intermediate_size=128, num_attention_heads=2,
+                num_key_value_heads=2, vocab_size=128)
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+
+            def loss_fn(ids):
+                logits = model(ids)
+                return _F.cross_entropy(
+                    logits[:, :-1].reshape((-1, cfg.vocab_size)),
+                    ids[:, 1:].reshape((-1,)))
+
+            step = _pjit.TrainStep(model, loss_fn, opt, capture_hlo=True)
+            rng = _np.random.default_rng(0)
+            step(paddle.to_tensor(rng.integers(0, 128, (2, 16))))
+            train = fusion_stats(step.last_hlo_text) \
+                if step.last_hlo_text else {}
+
+            from paddle_tpu.serving import LLMEngine
+            eng = LLMEngine(model, max_len=32, page_size=4,
+                            max_num_seqs=2)
+            serving = fusion_stats(eng.ragged_step_hlo())
+        finally:
+            GLOBAL_FLAGS.set("fusion_probe_barrier", old)
+        return {
+            "hlo_train_fusions": train.get("fusion_count"),
+            "hlo_train_kernels": train.get("kernel_count"),
+            "hlo_serving_fusions": serving["fusion_count"],
+            "hlo_serving_kernels": serving["kernel_count"],
+            "hlo_serving_fusion_bytes": serving["fusion_bytes_total"],
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"hlo_train_fusions": None,
+                "hlo_train_kernels": None,
+                "hlo_serving_fusions": None,
+                "hlo_serving_kernels": None,
+                "hlo_serving_fusion_bytes": None,
+                "hlo_fusion_probe_error": f"{type(e).__name__}: {e}"}
+
+
+def probe_tracing(paddle):
+    """Measured request-tracing fields (serving/tracing.py) for the
+    bench trajectory — the observability layer's own CI gates.
+
+    One seeded loadgen workload runs on the virtual clock with a
+    ``RequestTracer`` attached, TWICE with fresh engines. Records:
+    - ``trace_deterministic``: 1 iff the two runs' structured JSON
+      exports are byte-identical — the reproducible-post-mortem
+      contract (a wall-clock read or unordered container sneaking into
+      the span path flips this to 0 and the exact gate fails);
+    - ``trace_span_count``: total spans the run produced — pinned
+      exactly (a drift means the span schema or the engine's lifecycle
+      hooks changed; re-record deliberately);
+    - ``trace_decode_compiles``: the ragged-step executable count with
+      tracing enabled — must stay 1 (tracing is host-side appends, ZERO
+      jitted dispatches).
+    """
+    try:
+        from paddle_tpu.loadgen import Driver, VirtualClock, WorkloadSpec
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine, RequestTracer
+        paddle.seed(0)
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        model = LlamaForCausalLM(cfg)
+        spec = WorkloadSpec(num_requests=16, seed=5, arrival="poisson",
+                            arrival_rate=120.0, prompt_len=(4, 10),
+                            output_len=(3, 8), vocab_size=128)
+
+        def run():
+            clock = VirtualClock()
+            tracer = RequestTracer()
+            eng = LLMEngine(model, now_fn=clock.now, seed=0, max_len=32,
+                            page_size=4, tracer=tracer)
+            Driver(eng, clock, step_time_s=0.01).run(spec.compile())
+            return tracer, eng
+
+        t1, eng1 = run()
+        t2, _ = run()
+        return {
+            "trace_deterministic": int(t1.export_json()
+                                       == t2.export_json()),
+            "trace_span_count": t1.span_count,
+            "trace_decode_compiles": eng1.decode_cache_size(),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"trace_deterministic": None,
+                "trace_span_count": None,
+                "trace_decode_compiles": None,
+                "tracing_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -580,6 +706,7 @@ def probe_kv_accounting():
                 "kv_accounting_probe_error": f"{type(e).__name__}: {e}"}
 
 
-__all__ = ["probe_cluster", "probe_gspmd", "probe_input_pipeline",
+__all__ = ["probe_cluster", "probe_gspmd", "probe_hlo_fusion",
+           "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_opt_dispatches",
-           "probe_serving", "probe_spec_decode"]
+           "probe_serving", "probe_spec_decode", "probe_tracing"]

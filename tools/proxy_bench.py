@@ -22,7 +22,16 @@ This harness runs the measurements that DON'T need a chip and are
 - ``cluster_goodput_fraction`` / ``cluster_retries`` /
   ``cluster_ttft_p99_s`` / ``cluster_unresolved`` — fleet robustness
   under a scripted kill-and-recover run (serving/cluster.py on the
-  loadgen virtual clock; ``--no-retry`` is the injected regression).
+  loadgen virtual clock; ``--no-retry`` is the injected regression);
+- ``hlo_train_*`` / ``hlo_serving_*`` — fusion/kernel counts and
+  bytes-touched-per-fused-region of the jitted TrainStep and the
+  ragged serving step (jit/hlo_forensics.py; a defused hot region is
+  silent 2x HBM traffic on chip — ``--defuse`` is the injected
+  regression);
+- ``trace_deterministic`` / ``trace_span_count`` /
+  ``trace_decode_compiles`` — the request-tracing layer's contracts:
+  byte-identical exports per seed and zero added step executables
+  (serving/tracing.py).
 
 Each metric gates against a checked-in per-backend baseline
 (tools/proxy_bench_baseline.json) with a direction and tolerance from
@@ -62,7 +71,7 @@ if "--xla_force_host_platform_device_count" not in \
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
-          "jaxpr", "accounting")
+          "jaxpr", "accounting", "fusion", "tracing")
 
 
 class Gate:
@@ -140,11 +149,34 @@ GATES = {
     "cluster_retries":          Gate("different"),
     "cluster_ttft_p99_s":       Gate("higher", 0.25, 0.02),
     "cluster_unresolved":       Gate("higher", 0.0, 0.0),
+    # HLO fusion forensics (jit/hlo_forensics.py via probe_hlo_fusion):
+    # fusion/kernel counts and bytes-touched-per-fused-region of the
+    # jitted TrainStep and the ragged serving step are deterministic
+    # for a pinned jaxlib, and MORE of any of them means a hot region
+    # defused — silent 2x HBM traffic on chip. Exact one-sided pins:
+    # an improvement (fewer kernels) passes, a regression fails.
+    # --defuse (FLAGS_fusion_probe_barrier) is the injected regression
+    # splitting the ragged layer's fused region; the serving gates must
+    # catch it.
+    "hlo_train_fusions":        Gate("higher", 0.0, 0.0),
+    "hlo_train_kernels":        Gate("higher", 0.0, 0.0),
+    "hlo_serving_fusions":      Gate("higher", 0.0, 0.0),
+    "hlo_serving_kernels":      Gate("higher", 0.0, 0.0),
+    "hlo_serving_fusion_bytes": Gate("higher", 0.0, 0.0),
+    # request tracing (serving/tracing.py via probe_tracing): the
+    # byte-identical-export contract is exact (0 = a wall-clock read or
+    # hash-ordered container poisoned the span path), the span count is
+    # pinned (schema/lifecycle-hook drift must be re-recorded
+    # deliberately), and tracing must add zero step executables.
+    "trace_deterministic":      Gate("lower", 0.0, 0.0),
+    "trace_span_count":         Gate("different"),
+    "trace_decode_compiles":    Gate("higher", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
-            gspmd_dp_only=False, cluster_retry_budget=2) -> dict:
+            gspmd_dp_only=False, cluster_retry_budget=2,
+            fusion_defuse=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -161,14 +193,19 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     replica's in-flight requests shed instead of retrying, fleet
     goodput collapses, and the ``cluster_goodput_fraction`` gate must
     catch it.
+    ``fusion_defuse=True`` (--defuse) sets FLAGS_fusion_probe_barrier,
+    splitting the ragged serving layer's hot fused region at trace time
+    — fusion/kernel counts and fused-region bytes rise and the
+    ``hlo_serving_*`` gates must catch it.
     """
     import jax
     import paddle_tpu as paddle
     from tools.bench_probes import (probe_cluster, probe_gspmd,
+                                    probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
                                     probe_opt_dispatches, probe_serving,
-                                    probe_spec_decode)
+                                    probe_spec_decode, probe_tracing)
     dev = jax.devices()[0]
     backend = dev.platform if dev.platform == "cpu" else \
         getattr(dev, "device_kind", "tpu").replace(" ", "-").lower()
@@ -209,6 +246,15 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     if "accounting" in probes:
         _take(probe_kv_accounting(),
               ("kv_bytes_per_token_fp32", "kv_bytes_per_token_int8"))
+    if "fusion" in probes:
+        _take(probe_hlo_fusion(paddle, defuse=fusion_defuse),
+              ("hlo_train_fusions", "hlo_train_kernels",
+               "hlo_serving_fusions", "hlo_serving_kernels",
+               "hlo_serving_fusion_bytes"))
+    if "tracing" in probes:
+        _take(probe_tracing(paddle),
+              ("trace_deterministic", "trace_span_count",
+               "trace_decode_compiles"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -283,6 +329,11 @@ def main(argv=None) -> int:
                          "killed replica's requests shed instead of "
                          "requeueing, fleet goodput collapses (the "
                          "injected regression)")
+    ap.add_argument("--defuse", action="store_true",
+                    help="set FLAGS_fusion_probe_barrier in the fusion "
+                         "probe: an optimization barrier splits the "
+                         "ragged layer's hot fused region, fusion/"
+                         "kernel counts rise (the injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -307,7 +358,8 @@ def main(argv=None) -> int:
     current = collect(probes=probes, burst_tokens=args.burst_tokens,
                       spec_tokens=args.spec_tokens,
                       gspmd_dp_only=args.dp_only,
-                      cluster_retry_budget=0 if args.no_retry else 2)
+                      cluster_retry_budget=0 if args.no_retry else 2,
+                      fusion_defuse=args.defuse)
 
     if args.json:
         # --json changes the output format, never the action: combined
