@@ -45,7 +45,8 @@ from tools.bench_probes import (probe_gspmd,  # noqa: E402
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
                                 probe_opt_dispatches, probe_serving,
-                                probe_spec_decode, probe_tracing)
+                                probe_spec_decode, probe_telemetry,
+                                probe_tracing)
 
 # legacy aliases: forensics tests and older tooling call the underscored
 # names on this module
@@ -56,6 +57,7 @@ _probe_spec_decode = probe_spec_decode
 _probe_gspmd = probe_gspmd
 _probe_hlo_fusion = probe_hlo_fusion
 _probe_tracing = probe_tracing
+_probe_telemetry = probe_telemetry
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -217,6 +219,7 @@ def run_bench(config="llama_125m", progress=None):
     gspmd_probe = _probe_gspmd(paddle)
     fusion_probe = _probe_hlo_fusion(paddle)
     tracing_probe = _probe_tracing(paddle)
+    telemetry_probe = _probe_telemetry(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -288,6 +291,7 @@ def run_bench(config="llama_125m", progress=None):
         **gspmd_probe,
         **fusion_probe,
         **tracing_probe,
+        **telemetry_probe,
     }
 
 
@@ -575,6 +579,14 @@ def _failure_artifact(last_err, last_stages):
         "trace_deterministic": None,
         "trace_span_count": None,
         "trace_decode_compiles": None,
+        # fleet-telemetry fields likewise: a scrape count, an alert
+        # transition tally, or a byte-identity verdict from a stale
+        # round proves nothing about the run that failed
+        "telemetry_deterministic": None,
+        "telemetry_scrape_samples": None,
+        "telemetry_alerts_fired": None,
+        "telemetry_alerts_resolved": None,
+        "telemetry_decode_compiles": None,
     }
     good = _last_good_round()
     if good:

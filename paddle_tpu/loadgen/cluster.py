@@ -57,6 +57,11 @@ class ClusterRunResult:
     #: every replica, so a request's spans follow it across crashes and
     #: retry hops); None otherwise
     tracer: object = None
+    #: the telemetry Scraper when one drove the run; None otherwise
+    telemetry: object = None
+    #: autoscale applications the driver made (scale_to calls whose
+    #: target differed from the provisioned count)
+    scale_events: int = 0
 
     def by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -71,7 +76,8 @@ class ClusterDriver:
     same contract as the single-engine driver)."""
 
     def __init__(self, cluster, clock: VirtualClock, *, step_time_s=0.01,
-                 max_steps=200_000, check_invariants=True, check_every=1):
+                 max_steps=200_000, check_invariants=True, check_every=1,
+                 scraper=None, autoscale=False):
         if step_time_s <= 0:
             raise ValueError("step_time_s must be > 0")
         if cluster._now != clock.now:
@@ -79,12 +85,28 @@ class ClusterDriver:
                 "cluster.now_fn is not this driver's clock — construct "
                 "the ClusterEngine with now_fn=clock.now so faults, "
                 "deadlines and latencies share one time base")
+        if scraper is not None and scraper.target is not cluster:
+            raise ValueError(
+                "scraper.target is not this driver's cluster — build "
+                "the Scraper over the same ClusterEngine so its samples "
+                "describe the fleet this trace actually drives")
+        if autoscale and (scraper is None or scraper.autoscale is None):
+            raise ValueError(
+                "autoscale=True needs a scraper built with an "
+                "AutoscalePolicy (Scraper(cluster, autoscale=policy)) — "
+                "the recommendation series IS the policy's output")
         self.cluster = cluster
         self.clock = clock
         self.step_time_s = float(step_time_s)
         self.max_steps = max_steps
         self.check_invariants = check_invariants
         self.check_every = max(int(check_every), 1)
+        #: telemetry scraper driven at every round boundary; optional
+        self.scraper = scraper
+        #: when True, the scraper's AutoscalePolicy recommendation is
+        #: APPLIED to the live cluster through ``scale_to`` after each
+        #: round — autoscaling policies testable as code, chip-free
+        self.autoscale = bool(autoscale)
 
     def run(self, trace) -> ClusterRunResult:
         cluster = self.cluster
@@ -162,6 +184,21 @@ class ClusterDriver:
                     # with the pool snapshot attached — proof-by-survival
                     pool.check_invariants()
                     result.invariant_checks += 1
+            if self.scraper is not None:
+                scraped = self.scraper.maybe_scrape(now)
+                if scraped and self.autoscale:
+                    want = self.scraper.last_desired_replicas
+                    if want is not None \
+                            and want != cluster.provisioned_replicas():
+                        result.scale_events += 1
+                        # scale_to returns the outputs its requeues
+                        # touched (a shrink's budget-exhausted sheds
+                        # included) — absorb them at THIS boundary so
+                        # their timestamps are honest
+                        for out in cluster.scale_to(want):
+                            rec = records.get(out.request_id)
+                            if rec is not None:
+                                self._absorb(rec, out, now)
             if steps >= self.max_steps:
                 raise RuntimeError(
                     f"cluster load run did not drain within "
@@ -179,6 +216,10 @@ class ClusterDriver:
         result.duration_s = clock.now() - t_start
         result.metrics = cluster.metrics_snapshot()
         result.tracer = getattr(cluster, "tracer", None)
+        if self.scraper is not None:
+            # closing sample at drain (single-engine driver discipline)
+            self.scraper.finalize(clock.now())
+        result.telemetry = self.scraper
         return result
 
     #: record folding is IDENTICAL to the single-engine driver's (a

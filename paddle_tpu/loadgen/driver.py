@@ -131,6 +131,10 @@ class RunResult:
     #: tracing.py) — build_report picks it up for the span-derived
     #: latency-breakdown section; None otherwise
     tracer: object = None
+    #: the telemetry Scraper when one drove the run (paddle_tpu.
+    #: telemetry) — build_report attaches its summary (series tails,
+    #: fleet latency, alert timeline); None otherwise
+    telemetry: object = None
 
     def by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -150,9 +154,15 @@ class Driver:
     """
 
     def __init__(self, engine, clock: VirtualClock, *, step_time_s=0.01,
-                 max_steps=200_000, check_invariants=True, check_every=1):
+                 max_steps=200_000, check_invariants=True, check_every=1,
+                 scraper=None):
         if step_time_s <= 0:
             raise ValueError("step_time_s must be > 0")
+        if scraper is not None and scraper.target is not engine:
+            raise ValueError(
+                "scraper.target is not this driver's engine — build the "
+                "Scraper over the same engine so its samples describe "
+                "the fleet this trace actually drives")
         # bound-method equality (== not `is`: attribute access creates a
         # fresh method object every time)
         if engine._now != clock.now:
@@ -166,6 +176,9 @@ class Driver:
         self.max_steps = max_steps
         self.check_invariants = check_invariants
         self.check_every = max(int(check_every), 1)
+        #: telemetry scraper (paddle_tpu.telemetry.Scraper) driven at
+        #: every step boundary on this driver's clock; optional
+        self.scraper = scraper
 
     def run(self, trace) -> RunResult:
         eng = self.engine
@@ -236,6 +249,10 @@ class Driver:
                 assert pool.used_pages <= pool.capacity
                 assert pool.used_pages + pool.free_pages == pool.capacity
                 result.invariant_checks += 1
+            if self.scraper is not None:
+                # telemetry samples land at the step's END time — the
+                # same boundary token commits and metrics share
+                self.scraper.maybe_scrape(now)
             if steps >= self.max_steps:
                 raise RuntimeError(
                     f"load run did not drain within {self.max_steps} "
@@ -254,6 +271,11 @@ class Driver:
         result.duration_s = clock.now() - t_start
         result.metrics = eng.metrics_snapshot()
         result.tracer = getattr(eng, "tracer", None)
+        if self.scraper is not None:
+            # closing sample at drain: the exported series cover the
+            # run's true end, not just the last scheduled interval
+            self.scraper.finalize(clock.now())
+        result.telemetry = self.scraper
         return result
 
     @staticmethod

@@ -104,17 +104,38 @@ def _breakdown_section(tracer) -> dict:
     return latency_breakdown(tracer)
 
 
-def build_report(result, *, spec=None, trace=None, tracer=None) -> dict:
+def _telemetry_section(result, telemetry):
+    """Scraper summary (series tails, fleet-merged latency, alert
+    timeline, autoscale story) for runs driven with ``scraper=`` —
+    attached only when one exists, so pre-telemetry artifacts
+    byte-persist. The FULL series export stays on the scraper
+    (``export_json``); the report carries the decision-grade summary."""
+    if telemetry is None:
+        return None
+    out = telemetry.summary()
+    scale_events = getattr(result, "scale_events", 0)
+    if scale_events:
+        out["scale_events"] = scale_events
+    return out
+
+
+def build_report(result, *, spec=None, trace=None, tracer=None,
+                 telemetry=None) -> dict:
     """RunResult (+ spec/trace context) -> the artifact dict.
 
     ``tracer`` (the engine's :class:`~paddle_tpu.serving.tracing.
     RequestTracer`, when one was attached) adds the span-derived
     ``latency_breakdown`` section; it defaults to the tracer the driver
     recorded on the result, so a traced run's report carries the
-    breakdown without extra plumbing. Reports without one are
-    unchanged."""
+    breakdown without extra plumbing. ``telemetry`` (the run's
+    :class:`~paddle_tpu.telemetry.Scraper`) likewise defaults to the
+    one the driver recorded and adds the ``telemetry`` section (fleet
+    series tails, merged latency, alert timeline). Reports without
+    either are unchanged."""
     if tracer is None:
         tracer = getattr(result, "tracer", None)
+    if telemetry is None:
+        telemetry = getattr(result, "telemetry", None)
     m = result.metrics or {}
     tokens = sum(r.num_tokens for r in result.records)
     hits = m.get("prefix_cache_hits", 0)
@@ -159,11 +180,15 @@ def build_report(result, *, spec=None, trace=None, tracer=None) -> dict:
     })
     if tracer is not None:
         report["latency_breakdown"] = _breakdown_section(tracer)
+    tel = _telemetry_section(result, telemetry)
+    if tel is not None:
+        report["telemetry"] = tel
     return report
 
 
 def build_cluster_report(result, *, spec=None, trace=None,
-                         faults=None, tracer=None) -> dict:
+                         faults=None, tracer=None,
+                         telemetry=None) -> dict:
     """ClusterRunResult (+ spec/trace/fault-script context) -> the
     fleet artifact dict: everything the single-engine report has at
     fleet scope (exact percentiles over every request record, goodput,
@@ -172,9 +197,14 @@ def build_cluster_report(result, *, spec=None, trace=None,
     state-machine time (time-in-degraded-state included), degradation
     ladder transitions, and the fault script that caused it all.
     Serialize with :func:`report_json` for the byte-identity gate.
-    ``tracer`` behaves exactly like :func:`build_report`'s."""
+    ``tracer`` and ``telemetry`` behave exactly like
+    :func:`build_report`'s; the telemetry section additionally carries
+    the autoscale story (``scale_events``, the cluster's scale_up/
+    scale_down counters ride ``cluster`` below)."""
     if tracer is None:
         tracer = getattr(result, "tracer", None)
+    if telemetry is None:
+        telemetry = getattr(result, "telemetry", None)
     recs = result.records
     m = result.metrics or {}
     reps = m.get("replicas", [])
@@ -223,6 +253,9 @@ def build_cluster_report(result, *, spec=None, trace=None,
             "router_decisions": m.get("router_decisions", 0),
             "affinity_hits": m.get("affinity_hits", 0),
             "state_transitions": m.get("state_transitions", 0),
+            "scale_ups": m.get("scale_ups", 0),
+            "scale_downs": m.get("scale_downs", 0),
+            "provisioned_replicas": m.get("provisioned_replicas"),
             "time_in_state_s": tis,
             "time_degraded_s": tis.get("degraded", 0.0),
             "degradation": {
@@ -242,6 +275,9 @@ def build_cluster_report(result, *, spec=None, trace=None,
     })
     if tracer is not None:
         report["latency_breakdown"] = _breakdown_section(tracer)
+    tel = _telemetry_section(result, telemetry)
+    if tel is not None:
+        report["telemetry"] = tel
     return report
 
 

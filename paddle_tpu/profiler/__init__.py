@@ -14,6 +14,14 @@ Mapping onto this stack:
   started/stopped alongside when ``targets`` includes ProfilerTarget.TPU;
 - export -> chrome://tracing JSON (host) + TensorBoard xplane dir (device);
 - ``summary()`` -> per-op host time table like profiler_statistic.py.
+
+Serving observability rides the same host timeline: ``serving.*``
+gauge instants (serving/metrics.py) and ``trace.*`` request-span
+instants (serving/tracing.py) land next to op spans while a Profiler
+records, and ``RequestTracer.export_chrome_trace(telemetry=Scraper)``
+merges op spans, request spans, and the fleet-telemetry counter lane
+(paddle_tpu.telemetry) into ONE chrome://tracing view —
+docs/OBSERVABILITY.md is the consolidated guide.
 """
 from __future__ import annotations
 

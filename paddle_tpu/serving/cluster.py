@@ -220,6 +220,18 @@ class _Replica:
     state_since: float = 0.0
     state_time: dict = field(default_factory=dict)
     health: dict = field(default_factory=dict)
+    #: when ``health`` was last refreshed from a LIVE engine (fleet
+    #: clock) — the staleness stamp: a DOWN/RECOVERING replica's last
+    #: health read must never masquerade as a current one
+    health_at: float = 0.0
+    #: bumped every time a FRESH engine is installed (crash recovery):
+    #: the telemetry scraper keys counter-reset handling and histogram
+    #: carry-folding off this, never off object identity
+    generation: int = 0
+    #: autoscale scale-down marker: a decommissioned replica drains,
+    #: folds its counters, and stays DOWN — it is no longer provisioned
+    #: capacity and never recovers
+    decommissioned: bool = False
     steps: int = 0
     slow_multiplier: float = 1.0
     slow_until: float | None = None
@@ -307,7 +319,7 @@ class ClusterEngine:
             "crashes", "recoveries", "drains", "flaky_steps",
             "engine_errors", "router_decisions", "affinity_hits",
             "state_transitions", "kv_pressure_faults", "slowdown_faults",
-            "flight_dumps")}
+            "flight_dumps", "scale_ups", "scale_downs")}
         now = self._now()
         self.replicas = [self._new_replica(i, now)
                          for i in range(num_replicas)]
@@ -341,6 +353,7 @@ class ClusterEngine:
         rep = _Replica(rid=rid, engine=eng, ladder=ladder,
                        state=ReplicaState.HEALTHY, state_since=now)
         rep.health = self._health_of(rep)
+        rep.health_at = now
         return rep
 
     def _health_of(self, rep: _Replica) -> dict:
@@ -533,6 +546,77 @@ class ClusterEngine:
                 if r.engine is not None]
 
     # ------------------------------------------------------------------
+    # autoscaling (paddle_tpu.telemetry.autoscale drives this)
+    # ------------------------------------------------------------------
+    def provisioned_replicas(self) -> int:
+        """Replicas that count as capacity: everything not
+        decommissioned (a crashed-but-recovering replica is still
+        provisioned — the autoscaler must not double-provision around
+        a transient crash)."""
+        return sum(1 for r in self.replicas if not r.decommissioned)
+
+    def scale_to(self, n: int) -> list:
+        """Grow or shrink the fleet to ``n`` provisioned replicas — the
+        chip-free autoscaling exerciser (``ClusterDriver`` applies the
+        telemetry policy's ``desired_replicas`` through this between
+        rounds).
+
+        Growing appends fresh HEALTHY replicas (new rids — dead slots
+        are never reused, so fault scripts and telemetry series keep
+        their addressing). Shrinking decommissions the highest-rid
+        provisioned replicas: waiting work is requeued to survivors
+        immediately (the drain discipline), running rows finish in
+        place, and the replica then folds its counters and goes DOWN
+        for good. Returns the cluster ``RequestOutput``\\ s the requeues
+        touched (terminal sheds included), so a driver can absorb them
+        without waiting for the next round."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"scale_to needs n >= 1, got {n}")
+        now = self._now()
+        touched: dict[str, RequestOutput] = {}
+        provisioned = [r for r in self.replicas if not r.decommissioned]
+        if n > len(provisioned):
+            for _ in range(n - len(provisioned)):
+                rid = len(self.replicas)
+                self.replicas.append(self._new_replica(rid, now))
+                self.counters["scale_ups"] += 1
+                self.flight.record("scale_up", now, replica=rid)
+                if self.tracer is not None:
+                    self.tracer.event("scale_up", now, replica=rid)
+        elif n < len(provisioned):
+            for rep in sorted(provisioned, key=lambda r: -r.rid)[
+                    :len(provisioned) - n]:
+                self._decommission(rep, now, touched)
+        self.num_replicas = self.provisioned_replicas()
+        return list(touched.values())
+
+    def _decommission(self, rep: _Replica, now: float, touched: dict):
+        self.counters["scale_downs"] += 1
+        rep.decommissioned = True
+        self.flight.record("scale_down", now, replica=rep.rid)
+        if self.tracer is not None:
+            self.tracer.event("scale_down", now, replica=rep.rid)
+        if rep.engine is None:
+            # already DOWN (crashed): just cancel any pending recovery
+            rep.recover_at = None
+            self._set_state(rep, ReplicaState.DOWN, now)
+            return
+        self._set_state(rep, ReplicaState.DRAINING, now)
+        rep.drain_until = None          # ends on empty, not on a clock
+        rep.engine.scheduler.admission_blocked = True
+        waiting_ids = [s.seq_id for s in rep.engine.scheduler.waiting]
+        for rid in waiting_ids:
+            if rid in self._meta and rep.engine.withdraw(rid):
+                self._meta[rid]["replica"] = None
+                self._requeue(rid, now, touched, from_replica=rep.rid)
+        if not rep.engine.has_unfinished():
+            self._fold_counters(rep)
+            rep.engine = None
+            rep.ladder = None
+            self._set_state(rep, ReplicaState.DOWN, now)
+
+    # ------------------------------------------------------------------
     # the cluster round
     # ------------------------------------------------------------------
     def step(self):
@@ -579,6 +663,7 @@ class ClusterEngine:
             if rep.ladder is not None:
                 rep.ladder.observe()
             rep.health = self._health_of(rep)
+            rep.health_at = now
             if rep.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
                 degraded = rep.ladder.level > 0 if rep.ladder is not None \
                     else rep.engine.pool.above_high_watermark()
@@ -641,10 +726,16 @@ class ClusterEngine:
             if rep.state is ReplicaState.DOWN:
                 if rep.recover_at is not None and now >= rep.recover_at:
                     rep.engine = self._new_engine(rep.rid)
+                    # fresh engine, fresh counters: the generation bump
+                    # is what tells the telemetry scraper to treat the
+                    # next counter readings as a reset and to fold the
+                    # dead engine's histogram population into the carry
+                    rep.generation += 1
                     rep.ladder = DegradationLadder(
                         rep.engine, **self._ladder_kw) \
                         if self._ladder_on else None
                     rep.health = self._health_of(rep)
+                    rep.health_at = now
                     rep.recover_at = None
                     rep.recover_steps_left = self.recovery_steps
                     rep.consecutive_flaky = 0
@@ -658,7 +749,18 @@ class ClusterEngine:
                     self.counters["recoveries"] += 1
                     self._set_state(rep, ReplicaState.HEALTHY, now)
             elif rep.state is ReplicaState.DRAINING:
-                if rep.drain_until is not None and now >= rep.drain_until:
+                if rep.decommissioned:
+                    # autoscale scale-down: the drain ends when the
+                    # replica's running rows finish — fold its lifetime
+                    # counters and release the engine for good
+                    if not rep.engine.has_unfinished():
+                        self._fold_counters(rep)
+                        rep.engine = None
+                        rep.ladder = None
+                        rep.recover_at = None
+                        self._set_state(rep, ReplicaState.DOWN, now)
+                elif rep.drain_until is not None \
+                        and now >= rep.drain_until:
                     rep.drain_until = None
                     rep.engine.scheduler.admission_blocked = False
                     self._set_state(rep, ReplicaState.HEALTHY, now)
@@ -708,13 +810,18 @@ class ClusterEngine:
                 self._meta[rid]["replica"] = None
                 self._requeue(rid, now, touched, from_replica=rep.rid)
 
-    def _crash(self, rep: _Replica, now: float, recover_s, touched: dict):
-        self.counters["crashes"] += 1
-        # fold the dying engine's lifetime counters into the replica's
-        # carry so the cluster report keeps counting across the crash
+    @staticmethod
+    def _fold_counters(rep: _Replica):
+        """Fold a dying engine's lifetime counters into the replica's
+        carry so the cluster report keeps counting across the loss —
+        shared by crashes and autoscale decommissions."""
         for k in _CARRIED_COUNTERS:
             rep.carried[k] = rep.carried.get(k, 0) + \
                 getattr(rep.engine.metrics, k).value
+
+    def _crash(self, rep: _Replica, now: float, recover_s, touched: dict):
+        self.counters["crashes"] += 1
+        self._fold_counters(rep)
         victims = [rid for rid in self._unfinished
                    if self._meta[rid]["replica"] == rep.rid]
         rep.engine = None
@@ -722,7 +829,10 @@ class ClusterEngine:
         rep.health = {"queue_depth": 0, "running": 0, "queue_age_s": 0.0,
                       "kv_pressure": 0.0, "degradation_level": 0,
                       "step_latency_x": 1.0}
-        rep.recover_at = None if recover_s is None else now + recover_s
+        # a decommissioned replica is no longer provisioned capacity:
+        # it never recovers, whatever killed it
+        rep.recover_at = None if recover_s is None or rep.decommissioned \
+            else now + recover_s
         rep.drain_until = None
         self._set_state(rep, ReplicaState.DOWN, now)
         # replica crash: the canonical flight-recorder auto-dump — the
@@ -873,16 +983,27 @@ class ClusterEngine:
                 "state": rep.state.value,
                 "state_time_s": st,
                 "steps": rep.steps,
+                "generation": rep.generation,
+                "decommissioned": rep.decommissioned,
                 "slow_multiplier": rep.slow_multiplier,
                 "degradation_level": rep.ladder.level
                 if rep.ladder is not None else 0,
                 "health": dict(rep.health),
+                # staleness signal (never silently current): how old
+                # the health read is, and whether it predates the
+                # replica's current body — a DOWN/RECOVERING replica's
+                # last-known health is a post-mortem, not a reading
+                "health_age_s": now - rep.health_at,
+                "health_stale": rep.engine is None
+                or rep.state in (ReplicaState.DOWN,
+                                 ReplicaState.RECOVERING),
                 "counters": {k: rep.counter(k)
                              for k in _CARRIED_COUNTERS},
             })
         out = dict(self.counters)
         out.update({
             "num_replicas": self.num_replicas,
+            "provisioned_replicas": self.provisioned_replicas(),
             "retry_budget": self.retry_budget,
             "parked": len(self._parked),
             "time_in_state_s": agg_state,

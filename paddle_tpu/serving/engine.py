@@ -246,7 +246,7 @@ class LLMEngine:
                  draft_quantized_mode="weight_only_int4",
                  draft_num_pages=None, mesh=None, tracer=None,
                  flight_recorder=None, flight_capacity=256,
-                 engine_id=None):
+                 engine_id=None, gauge_stale_after_s=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -372,7 +372,12 @@ class LLMEngine:
             num_pages=num_pages, page_size=page_size, dtype=dtype,
             high_watermark=high_watermark, low_watermark=low_watermark,
             pinned_page_budget=pinned_prefix_pages, mesh=self.mesh)
-        self.metrics = ServingMetrics(now_fn=now_fn)
+        # gauge_stale_after_s: snapshot-side staleness horizon — gauges
+        # last set longer ago than this read as null (listed under
+        # "stale_gauges") instead of as current values; the telemetry
+        # scraper applies its own horizon independently
+        self.metrics = ServingMetrics(now_fn=now_fn,
+                                      stale_after_s=gauge_stale_after_s)
         # observability (serving/tracing.py): the per-request span
         # tracer is OPT-IN (None = zero per-request bookkeeping); the
         # flight recorder is ALWAYS ON — a bounded ring of step/fleet

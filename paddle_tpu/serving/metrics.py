@@ -58,14 +58,35 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("name", "value")
+    """Point-in-time value, stamped with its last-update time.
 
-    def __init__(self, name):
+    ``updated_at`` (the caller's ``now_fn`` time base — the engine's
+    virtual clock under loadgen) is what separates "this replica's queue
+    is empty" from "this replica stopped reporting": a gauge that was
+    last set before a replica died keeps its final value forever, and
+    without the stamp a fleet health read cannot tell. ``age_s(now)``
+    is None until the first ``set`` — a never-set gauge has no age, it
+    has no data."""
+
+    __slots__ = ("name", "value", "updated_at", "_now")
+
+    def __init__(self, name, now_fn=None):
         self.name = name
         self.value = 0.0
+        #: time of the last set() on the owner's now_fn clock; None
+        #: until the gauge is first written
+        self.updated_at = None
+        self._now = now_fn
 
     def set(self, v):
         self.value = v
+        if self._now is not None:
+            self.updated_at = self._now()
+
+    def age_s(self, now) -> float | None:
+        """Seconds since the last set (None if never set) — the
+        staleness signal snapshots and the telemetry scraper key off."""
+        return None if self.updated_at is None else now - self.updated_at
 
 
 class Histogram:
@@ -113,7 +134,9 @@ class Histogram:
         return self.total / self.count if self.count else None
 
     def percentile(self, q):
-        """q in [0, 100]; None when nothing was observed."""
+        """q in [0, 100]; None when nothing was observed — an empty
+        reservoir has no percentiles, never a fabricated 0
+        (tests/test_telemetry.py pins the contract, merge included)."""
         return percentile_of(self._samples, q)
 
     def summary(self) -> dict:
@@ -122,6 +145,53 @@ class Histogram:
                 "min": self.min, "max": self.max,
                 "p50": self.percentile(50), "p90": self.percentile(90),
                 "p99": self.percentile(99)}
+
+    def sample_state(self) -> dict:
+        """Plain-data copy of the histogram's observable state —
+        what the telemetry scraper retains per replica so a crashed
+        engine's latency population survives into fleet percentiles
+        (the counter-carry discipline, histogram edition)."""
+        return {"count": self.count, "total": self.total,
+                "min": self.min, "max": self.max,
+                "samples": list(self._samples)}
+
+    @classmethod
+    def merge(cls, sources, *, name="merged", max_samples=None):
+        """Deterministically merge histograms (or ``sample_state()``
+        dicts) into one — the fleet-percentile primitive: each
+        replica's bounded reservoir contributes its retained samples IN
+        CALLER ORDER through the merged histogram's own crc32-name-
+        seeded reservoir, so two merges of the same sources are
+        bit-identical; count/total/min/max are then corrected to the
+        TRUE aggregates (they never sample). Below every reservoir's
+        cap the merged percentiles are exact over the pooled
+        population; above it they are reservoir-approximate, like any
+        single histogram's. Empty sources merge to an empty histogram
+        whose percentiles are None — never a fabricated 0."""
+        if max_samples is None:
+            caps = [s.max_samples for s in sources
+                    if isinstance(s, Histogram)]
+            max_samples = max(caps) if caps else 2048
+        out = cls(name, max_samples=max_samples)
+        count = 0
+        total = 0.0
+        mn = mx = None
+        for src in sources:
+            st = src.sample_state() if isinstance(src, Histogram) else src
+            for v in st["samples"]:
+                out.observe(v)
+            count += st["count"]
+            total += st["total"]
+            if st["min"] is not None:
+                mn = st["min"] if mn is None else min(mn, st["min"])
+                mx = st["max"] if mx is None else max(mx, st["max"])
+        # observe() tracked the RETAINED samples; the aggregate stats
+        # must reflect every observation the sources ever made
+        out.count = count
+        out.total = total
+        out.min = mn
+        out.max = mx
+        return out
 
 
 class ServingMetrics:
@@ -183,14 +253,21 @@ class ServingMetrics:
     #: average — a lifetime average decays toward zero across idle gaps
     RATE_WINDOW_S = 60.0
 
-    def __init__(self, now_fn=time.monotonic):
+    def __init__(self, now_fn=time.monotonic, *, stale_after_s=None):
         self._now = now_fn
         self._t0 = now_fn()
+        #: gauge-staleness horizon: a gauge last set more than this many
+        #: seconds ago (or never set) is MARKED in snapshot() — its
+        #: value is reported as null and its name listed under
+        #: ``stale_gauges`` — instead of silently reading as current.
+        #: None (the default) disables marking; the telemetry scraper
+        #: applies its own horizon either way.
+        self.stale_after_s = stale_after_s
         self._rate_samples = deque([(self._t0, 0)])   # (t, tokens_total)
         for c in self.COUNTERS:
             setattr(self, c, Counter(c))
         for g in self.GAUGES:
-            setattr(self, g, Gauge(g))
+            setattr(self, g, Gauge(g, now_fn=now_fn))
         for h in self.HISTOGRAMS:
             setattr(self, h, Histogram(h))
 
@@ -235,13 +312,27 @@ class ServingMetrics:
 
     def snapshot(self) -> dict:
         out = {c: getattr(self, c).value for c in self.COUNTERS}
-        out.update({g: getattr(self, g).value for g in self.GAUGES})
+        now = self._now()
+        stale = []
+        for g in self.GAUGES:
+            gauge = getattr(self, g)
+            age = gauge.age_s(now)
+            if self.stale_after_s is not None and \
+                    (age is None or age > self.stale_after_s):
+                # a stale gauge reads as null, never as its last value:
+                # "the queue was empty when this replica last reported"
+                # must not masquerade as "the queue is empty now"
+                out[g] = None
+                stale.append(g)
+            else:
+                out[g] = gauge.value
+        out["stale_gauges"] = stale
         for h in self.HISTOGRAMS:
             hist = getattr(self, h)
             out[f"{h}_count"] = hist.count
             for q in (50, 90, 99):
                 out[f"{h}_p{q}"] = hist.percentile(q)
-        out["uptime_s"] = self._now() - self._t0
+        out["uptime_s"] = now - self._t0
         return out
 
 

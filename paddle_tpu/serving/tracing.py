@@ -174,13 +174,17 @@ class RequestTracer:
                           indent=1)
 
     def export_chrome_trace(self, path=None, *, include_profiler=True,
-                            time_scale_us=1e6) -> dict:
+                            time_scale_us=1e6, telemetry=None) -> dict:
         """chrome://tracing JSON of the trace: one tid per request, one
         instant event per span (virtual seconds scaled to microseconds
         by ``time_scale_us``), fleet events on tid 0 — and, when the
         native profiler has events and ``include_profiler`` is on, the
         host op spans merged in under a second pid so request lifecycle
-        and op timeline sit in ONE viewer. Returns the trace dict;
+        and op timeline sit in ONE viewer. ``telemetry`` (a
+        :class:`~paddle_tpu.telemetry.Scraper`) adds a counter lane
+        under pid 3: every fleet series sample as a chrome counter
+        event, so queue depth / KV pressure / alert-feeding signals
+        plot directly under the request spans. Returns the trace dict;
         writes it to ``path`` when given."""
         events = []
         tids = {}
@@ -204,6 +208,11 @@ class RequestTracer:
                                "tid": int(tid), "ts": start_ns / 1e3,
                                "dur": dur_ns / 1e3,
                                "args": {"category": int(cat)}})
+        if telemetry is not None:
+            # the fleet telemetry counter lane (pid 3): scraped series
+            # as chrome counter tracks next to the request spans
+            events.extend(
+                telemetry.chrome_counter_events(time_scale_us))
         trace = {"traceEvents": events,
                  "displayTimeUnit": "ms",
                  "metadata": {"source": "paddle_tpu.serving.tracing",

@@ -1,0 +1,41 @@
+"""paddle_tpu.telemetry — deterministic fleet time-series telemetry.
+
+The third observability layer (docs/OBSERVABILITY.md): tracing answers
+"where did THIS request's latency go", the flight recorder answers
+"what led into THIS failure" — telemetry answers "what was the FLEET
+doing at t=42s, and were we inside SLO". Everything runs on the same
+virtual clock as the loadgen harness and exports fixed-precision
+sorted-key JSON, so a seeded run's full telemetry — series, fleet
+percentiles, alert timeline — is byte-identical across runs, crash
+faults included.
+
+- :mod:`series` — ``GaugeSeries``/``CounterSeries``: bounded rings with
+  tiered raw→coarse downsampling and counter-reset-aware delta
+  decoding (O(1) memory forever).
+- :mod:`scrape` — ``Scraper``: samples every replica's
+  ``ServingMetrics`` at a fixed interval, excludes stale gauges, folds
+  crashed replicas' histogram populations into fleet percentiles, and
+  computes the fleet sample the SLO and autoscale layers consume.
+  Host-side only: zero jitted dispatches.
+- :mod:`slo` — ``SLO`` + ``BurnRateRule`` + ``AlertManager``:
+  multi-window burn-rate alerting with a firing→resolved state machine
+  and an exported transition timeline.
+- :mod:`autoscale` — ``AutoscalePolicy``: hysteretic
+  ``desired_replicas`` from queue pressure, KV watermarks, and
+  step-latency multipliers; ``ClusterDriver(scraper=Scraper(cluster,
+  autoscale=policy), autoscale=True)`` applies it to a live fleet
+  through ``ClusterEngine.scale_to``.
+- :mod:`dashboard` — ``render_dashboard``: the whole fleet as one
+  deterministic plain-text page.
+"""
+from .series import CounterSeries, GaugeSeries  # noqa: F401
+from .scrape import FLEET_SIGNALS, Scraper  # noqa: F401
+from .slo import (SLO, AlertManager, AlertState,  # noqa: F401
+                  BurnRateRule, standard_rules)
+from .autoscale import AutoscalePolicy  # noqa: F401
+from .dashboard import render_dashboard, sparkline  # noqa: F401
+
+__all__ = ["AlertManager", "AlertState", "AutoscalePolicy",
+           "BurnRateRule", "CounterSeries", "FLEET_SIGNALS",
+           "GaugeSeries", "SLO", "Scraper", "render_dashboard",
+           "sparkline", "standard_rules"]

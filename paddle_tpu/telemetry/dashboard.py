@@ -1,0 +1,84 @@
+"""Plain-text fleet dashboard over a Scraper's series.
+
+One deterministic string: per-signal sparklines over the raw ring,
+latest/min/max columns, fleet latency percentiles, and the alert story
+(currently firing + the transition timeline tail). No terminal escapes,
+no wall-clock reads — the render of a seeded run is itself
+byte-reproducible, so a dashboard snapshot can sit in a test or a
+post-mortem verbatim.
+"""
+from __future__ import annotations
+
+from ..serving.metrics import ServingMetrics
+from .scrape import FLEET_SIGNALS, Scraper
+
+#: ASCII intensity ramp, lowest to highest
+_RAMP = " .:-=+*#%@"
+
+
+def sparkline(values, width=32) -> str:
+    """Fixed-width ASCII sparkline of a value list (most recent at the
+    right edge); a flat series renders at mid-ramp."""
+    if not values:
+        return " " * width
+    vals = [float(v) for v in values[-width:]]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        body = _RAMP[len(_RAMP) // 2] * len(vals)
+    else:
+        top = len(_RAMP) - 1
+        body = "".join(_RAMP[int((v - lo) / span * top)] for v in vals)
+    return body.rjust(width)
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if float(v) == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.{nd}f}"
+
+
+def render_dashboard(scraper: Scraper, *, width=32,
+                     timeline_tail=8) -> str:
+    """The whole fleet at a glance, as text."""
+    lines = [
+        f"fleet telemetry  scrapes={scraper.scrapes}  "
+        f"interval={scraper.interval_s:g}s  "
+        f"stale_samples={scraper.stale_samples}",
+        f"{'signal':<20} {'spark':<{width}} {'last':>10} {'min':>10} "
+        f"{'max':>10}",
+    ]
+    for name in FLEET_SIGNALS:
+        series = scraper.fleet[name]
+        vals = [v for _, v in series.raw]
+        last = vals[-1] if vals else None
+        lines.append(
+            f"{name:<20} {sparkline(vals, width)} {_fmt(last):>10} "
+            f"{_fmt(min(vals) if vals else None):>10} "
+            f"{_fmt(max(vals) if vals else None):>10}")
+    lines.append("")
+    lines.append("fleet latency (merged histograms, crashed replicas "
+                 "included):")
+    for h in ServingMetrics.HISTOGRAMS:
+        s = scraper._merged_hist(h).summary()
+        lines.append(
+            f"  {h:<10} count={_fmt(s['count']):>6} "
+            f"p50={_fmt(s['p50'], 4):>9} p90={_fmt(s['p90'], 4):>9} "
+            f"p99={_fmt(s['p99'], 4):>9}")
+    if scraper.alerts is not None:
+        a = scraper.alerts
+        firing = ", ".join(a.firing) or "none"
+        lines.append("")
+        lines.append(f"alerts  fired={a.fired} resolved={a.resolved}  "
+                     f"firing: {firing}")
+        for e in a.timeline[-timeline_tail:]:
+            lines.append(
+                f"  t={e['t']:<10.4f} {e['event']:<9} {e['rule']}  "
+                f"(burn fast={_fmt(e['burn_fast'], 2)} "
+                f"slow={_fmt(e['burn_slow'], 2)})")
+    return "\n".join(lines) + "\n"
+
+
+__all__ = ["render_dashboard", "sparkline"]

@@ -1,0 +1,151 @@
+"""Deterministic bounded time-series storage for fleet telemetry.
+
+The scraper (telemetry/scrape.py) samples every replica's serving
+metrics on the loadgen virtual clock; this module is where those
+samples live. Two constraints shape the design:
+
+- **Bounded forever** — a week-long serving run and a 200-step CPU-tier
+  soak must hold the same bytes. Every series is a pair of rings:
+  a RAW tier (the last ``raw_capacity`` samples at scrape resolution)
+  and a COARSE tier (every ``coarse_every`` raw samples fold into one
+  aggregate sample, retained for ``coarse_capacity`` entries) — recent
+  history at full resolution, long history downsampled, memory O(1).
+- **Byte-reproducible** — appends are plain tuples of floats stamped on
+  the caller's clock, aggregation is arithmetic in arrival order, and
+  export is a plain dict: two seeded runs that observe the same values
+  export the same bytes (the telemetry determinism gate compares them).
+
+:class:`GaugeSeries` stores point-in-time values (coarse = mean + max
+over the bucket); :class:`CounterSeries` stores per-scrape DELTAS of a
+monotonic counter (coarse = sum over the bucket), with Prometheus-style
+reset handling: a counter that went BACKWARDS (a replica crashed and a
+fresh engine restarted it from zero) contributes its new value as the
+delta instead of a negative spike — fleet rates stay meaningful across
+crashes without any out-of-band carry.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+
+class GaugeSeries:
+    """Bounded (t, value) series with tiered downsampling."""
+
+    __slots__ = ("name", "raw", "coarse", "coarse_every", "samples",
+                 "_bucket")
+
+    def __init__(self, name, *, raw_capacity=512, coarse_every=8,
+                 coarse_capacity=512):
+        if raw_capacity < 1 or coarse_capacity < 1 or coarse_every < 1:
+            raise ValueError("series capacities must be >= 1")
+        self.name = name
+        self.raw: deque = deque(maxlen=int(raw_capacity))
+        #: (t_last, mean, max) per folded bucket of coarse_every samples
+        self.coarse: deque = deque(maxlen=int(coarse_capacity))
+        self.coarse_every = int(coarse_every)
+        #: lifetime samples appended (rings drop, this never lies)
+        self.samples = 0
+        self._bucket: list = []
+
+    def append(self, t, value):
+        v = float(value)
+        self.raw.append((float(t), v))
+        self.samples += 1
+        self._bucket.append(v)
+        if len(self._bucket) >= self.coarse_every:
+            b = self._bucket
+            self.coarse.append((float(t), sum(b) / len(b), max(b)))
+            self._bucket = []
+
+    @property
+    def last(self):
+        """Most recent (t, value), or None before the first append."""
+        return self.raw[-1] if self.raw else None
+
+    def values_since(self, t_lo) -> list:
+        """Raw values with t >= t_lo (the alert-window read path)."""
+        return [v for t, v in self.raw if t >= t_lo]
+
+    def export(self) -> dict:
+        return {"samples": self.samples,
+                "raw": [[t, v] for t, v in self.raw],
+                "coarse": [[t, mean, mx] for t, mean, mx in self.coarse]}
+
+
+class CounterSeries:
+    """Bounded per-scrape DELTA series of a monotonic counter.
+
+    ``observe(t, cumulative)`` delta-decodes against the previous
+    cumulative reading; a reading BELOW the previous one is a counter
+    reset (the replica's engine was rebuilt after a crash) and the new
+    cumulative value IS the delta — everything the fresh engine counted
+    happened since the last scrape. ``total`` is therefore the true
+    lifetime sum across resets, which is exactly how the cluster folds
+    crashed replicas' lifetime counters into its report.
+    """
+
+    __slots__ = ("name", "raw", "coarse", "coarse_every", "samples",
+                 "total", "resets", "_prev", "_bucket")
+
+    def __init__(self, name, *, raw_capacity=512, coarse_every=8,
+                 coarse_capacity=512):
+        if raw_capacity < 1 or coarse_capacity < 1 or coarse_every < 1:
+            raise ValueError("series capacities must be >= 1")
+        self.name = name
+        self.raw: deque = deque(maxlen=int(raw_capacity))
+        #: (t_last, delta_sum) per folded bucket of coarse_every samples
+        self.coarse: deque = deque(maxlen=int(coarse_capacity))
+        self.coarse_every = int(coarse_every)
+        self.samples = 0
+        #: lifetime sum of deltas — survives resets AND ring drops
+        self.total = 0.0
+        self.resets = 0
+        self._prev = None
+        self._bucket: list = []
+
+    def observe(self, t, cumulative) -> float:
+        """Record one cumulative reading; returns the decoded delta."""
+        cur = float(cumulative)
+        if self._prev is None:
+            delta = cur
+        elif cur < self._prev:
+            self.resets += 1
+            delta = cur
+        else:
+            delta = cur - self._prev
+        self._prev = cur
+        self.raw.append((float(t), delta))
+        self.samples += 1
+        self.total += delta
+        self._bucket.append(delta)
+        if len(self._bucket) >= self.coarse_every:
+            self.coarse.append((float(t), sum(self._bucket)))
+            self._bucket = []
+        return delta
+
+    def mark_reset(self):
+        """Forget the previous cumulative reading so the NEXT observe
+        decodes as a fresh start — the scraper calls this when it KNOWS
+        the source was rebuilt (replica generation bump), covering the
+        case where the new engine already counted past the old one's
+        value and the backwards-reading heuristic cannot see the
+        reset."""
+        if self._prev is not None:
+            self.resets += 1
+        self._prev = None
+
+    @property
+    def last(self):
+        return self.raw[-1] if self.raw else None
+
+    def values_since(self, t_lo) -> list:
+        return [v for t, v in self.raw if t >= t_lo]
+
+    def export(self) -> dict:
+        return {"samples": self.samples, "total": self.total,
+                "resets": self.resets,
+                "raw": [[t, v] for t, v in self.raw],
+                "coarse": [[t, s] for t, s in self.coarse]}
+
+
+__all__ = ["CounterSeries", "GaugeSeries"]

@@ -1,0 +1,263 @@
+"""Declarative SLOs + multi-window burn-rate alerting on virtual time.
+
+An :class:`SLO` names a fleet telemetry signal (a series the scraper
+computes every interval — ``ttft_p99_s``, ``error_fraction``,
+``max_queue_wait_s``, ``step_latency_x``, ...), the objective it must
+meet, and the error budget: the fraction of scrape samples allowed to
+violate the objective. A :class:`BurnRateRule` turns that into the
+alert production serving is actually judged by — the SRE multi-window
+burn rate: over a window W,
+
+    burn(W) = (violating samples in W / samples in W) / budget
+
+so burn 1.0 spends the budget exactly at the sustainable rate, and
+burn >= ``burn_threshold`` over BOTH a fast and a slow window means the
+budget is burning fast enough to page AND has been for long enough to
+not be a blip. Firing requires both windows hot (the slow window kills
+blip-pages); the alert resolves as soon as that condition stops
+holding — in practice the fast window drains first, so resolution
+latency is the fast window, while re-firing needs both windows hot
+again (genuine recurrence, not noise). The state machine is
+``inactive -> firing -> resolved -> (inactive)``, and every transition
+lands on the timeline with its burn readings.
+
+Everything is evaluated at scrape time on the caller's (virtual) clock
+over deterministic series, so the full alert timeline exports as
+fixed-precision sorted-key JSON: the same seeded workload + fault
+script fires the same alerts at the same virtual times, byte for byte
+(tests/test_telemetry.py gates it, crash-fault cluster run included).
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+from dataclasses import dataclass
+
+from ..serving.tracing import _round_floats
+
+SCHEMA_VERSION = 1
+
+#: objective directions: "higher" = the signal violates when it exceeds
+#: the objective (latency-like), "lower" = when it falls below
+#: (goodput-like)
+DIRECTIONS = ("higher", "lower")
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One service-level objective over a fleet telemetry signal."""
+    name: str                  # e.g. "ttft_p99"
+    signal: str                # fleet series the scraper computes
+    objective: float           # the threshold the signal must honor
+    #: which direction violates: "higher" (latency) or "lower" (goodput)
+    worse: str = "higher"
+    #: error budget: fraction of scrape samples allowed to violate
+    budget: float = 0.01
+
+    def __post_init__(self):
+        if self.worse not in DIRECTIONS:
+            raise ValueError(f"worse must be one of {DIRECTIONS}, "
+                             f"got {self.worse!r}")
+        if not 0.0 < self.budget <= 1.0:
+            raise ValueError(f"budget must be in (0, 1], "
+                             f"got {self.budget}")
+
+    def violated(self, value) -> bool:
+        """None never violates: a signal with no data this interval
+        (e.g. fleet p99 before any request finished) spends no budget —
+        absence of evidence must not page anyone."""
+        if value is None:
+            return False
+        return value > self.objective if self.worse == "higher" \
+            else value < self.objective
+
+
+@dataclass(frozen=True)
+class BurnRateRule:
+    """Multi-window burn-rate alert rule for one SLO."""
+    slo: SLO
+    fast_window_s: float = 0.1
+    slow_window_s: float = 0.5
+    #: both windows must burn at >= this multiple of the sustainable
+    #: rate to fire (classic page thresholds are 14.4x/6x on 1h/6h
+    #: windows; CPU-tier virtual runs use small windows, same algebra)
+    burn_threshold: float = 2.0
+
+    def __post_init__(self):
+        if self.fast_window_s <= 0 or self.slow_window_s <= 0:
+            raise ValueError("burn-rate windows must be > 0")
+        if self.fast_window_s > self.slow_window_s:
+            raise ValueError(
+                f"fast window {self.fast_window_s} must not exceed slow "
+                f"window {self.slow_window_s}")
+        if self.burn_threshold <= 0:
+            raise ValueError("burn_threshold must be > 0")
+
+    @property
+    def rule_id(self) -> str:
+        return (f"{self.slo.name}:burn{self.burn_threshold:g}x"
+                f"@{self.fast_window_s:g}s/{self.slow_window_s:g}s")
+
+
+class AlertState:
+    INACTIVE = "inactive"
+    FIRING = "firing"
+
+
+class AlertManager:
+    """Evaluates burn-rate rules against each fleet sample; owns the
+    firing -> resolved state machine and the exported timeline.
+
+    ``observe(t, sample)`` is called once per scrape with the fleet
+    sample dict; it appends one (t, violated) observation per SLO and
+    re-evaluates every rule. The per-SLO history is bounded by the
+    longest window that reads it — O(1) memory like every other
+    telemetry structure.
+    """
+
+    def __init__(self, rules):
+        rules = list(rules)
+        ids = [r.rule_id for r in rules]
+        if len(set(ids)) != len(ids):
+            dup = sorted({i for i in ids if ids.count(i) > 1})
+            raise ValueError(f"duplicate burn-rate rule ids {dup}")
+        self.rules = rules
+        #: slo name -> bounded deque of (t, violated, value is not None)
+        self._hist: dict[str, deque] = {}
+        self._horizon: dict[str, float] = {}
+        for r in rules:
+            h = self._horizon.get(r.slo.name, 0.0)
+            self._horizon[r.slo.name] = max(h, r.slow_window_s)
+            self._hist.setdefault(r.slo.name, deque())
+        self._slos = {}
+        for r in rules:
+            prev = self._slos.setdefault(r.slo.name, r.slo)
+            if prev != r.slo:
+                raise ValueError(
+                    f"conflicting SLO definitions under name "
+                    f"{r.slo.name!r}")
+        self.state = {r.rule_id: AlertState.INACTIVE for r in rules}
+        #: full transition history: [{t, slo, rule, event, burn_fast,
+        #: burn_slow}] in firing order — the exported alert timeline
+        self.timeline: list = []
+        self.fired = 0
+        self.resolved = 0
+
+    # ------------------------------------------------------------------
+    def _burn(self, slo: SLO, hist, now: float, window_s: float):
+        """(burn multiple, samples in window) — burn is None when the
+        window holds no samples with data."""
+        lo = now - window_s
+        n = bad = 0
+        for t, violated, has_data in hist:
+            if t < lo or not has_data:
+                continue
+            n += 1
+            bad += violated
+        if n == 0:
+            return None, 0
+        return (bad / n) / slo.budget, n
+
+    def observe(self, t, sample: dict):
+        """One evaluation round; returns transitions made this round."""
+        out = []
+        for name, slo in self._slos.items():
+            value = sample.get(slo.signal)
+            hist = self._hist[name]
+            hist.append((t, slo.violated(value), value is not None))
+            lo = t - self._horizon[name]
+            while hist and hist[0][0] < lo:
+                hist.popleft()
+        for rule in self.rules:
+            hist = self._hist[rule.slo.name]
+            burn_fast, n_fast = self._burn(rule.slo, hist, t,
+                                           rule.fast_window_s)
+            burn_slow, n_slow = self._burn(rule.slo, hist, t,
+                                           rule.slow_window_s)
+            hot = (burn_fast is not None and burn_slow is not None
+                   and burn_fast >= rule.burn_threshold
+                   and burn_slow >= rule.burn_threshold)
+            state = self.state[rule.rule_id]
+            if state is AlertState.INACTIVE and hot:
+                self.state[rule.rule_id] = AlertState.FIRING
+                self.fired += 1
+                out.append(self._transition(
+                    t, rule, "firing", burn_fast, burn_slow))
+            elif state is AlertState.FIRING and not hot:
+                # the firing condition stopped holding — the fast
+                # window drained (resolution latency = fast window);
+                # re-firing needs BOTH windows hot again
+                self.state[rule.rule_id] = AlertState.INACTIVE
+                self.resolved += 1
+                out.append(self._transition(
+                    t, rule, "resolved", burn_fast, burn_slow))
+        return out
+
+    def _transition(self, t, rule, event, burn_fast, burn_slow) -> dict:
+        entry = {"t": float(t), "slo": rule.slo.name,
+                 "rule": rule.rule_id, "event": event,
+                 "burn_fast": burn_fast, "burn_slow": burn_slow}
+        self.timeline.append(entry)
+        return entry
+
+    @property
+    def firing(self) -> list:
+        """Currently-firing rule ids, sorted."""
+        return sorted(rid for rid, s in self.state.items()
+                      if s is AlertState.FIRING)
+
+    # ------------------------------------------------------------------
+    def export(self) -> dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "rules": [{
+                "rule": r.rule_id, "slo": r.slo.name,
+                "signal": r.slo.signal, "objective": r.slo.objective,
+                "worse": r.slo.worse, "budget": r.slo.budget,
+                "fast_window_s": r.fast_window_s,
+                "slow_window_s": r.slow_window_s,
+                "burn_threshold": r.burn_threshold,
+            } for r in self.rules],
+            "fired": self.fired,
+            "resolved": self.resolved,
+            "firing": self.firing,
+            "timeline": list(self.timeline),
+        }
+
+    def export_json(self) -> str:
+        """Fixed-precision sorted-key serialization — the alert-timeline
+        byte-identity the determinism gate compares."""
+        return json.dumps(_round_floats(self.export()), sort_keys=True,
+                          indent=1)
+
+
+def standard_rules(*, ttft_p99_s=None, e2e_p99_s=None,
+                   max_queue_wait_s=None, error_budget=0.05,
+                   step_latency_x=None, fast_window_s=0.1,
+                   slow_window_s=0.5, burn_threshold=2.0) -> list:
+    """Convenience: burn-rate rules for the objectives production TPU
+    serving is usually judged by — pass the thresholds you care about,
+    get one rule per objective. ``error_budget`` also builds an
+    ``error_fraction <= 0`` objective (any error spends budget)."""
+    rules = []
+
+    def add(name, signal, objective, worse="higher", budget=error_budget):
+        rules.append(BurnRateRule(
+            SLO(name, signal, objective, worse=worse, budget=budget),
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            burn_threshold=burn_threshold))
+
+    if ttft_p99_s is not None:
+        add("ttft_p99", "ttft_p99_s", ttft_p99_s)
+    if e2e_p99_s is not None:
+        add("e2e_p99", "e2e_p99_s", e2e_p99_s)
+    if max_queue_wait_s is not None:
+        add("queue_wait", "max_queue_wait_s", max_queue_wait_s)
+    if step_latency_x is not None:
+        add("step_latency", "step_latency_x", step_latency_x)
+    add("errors", "error_fraction", 0.0)
+    return rules
+
+
+__all__ = ["AlertManager", "AlertState", "BurnRateRule", "DIRECTIONS",
+           "SCHEMA_VERSION", "SLO", "standard_rules"]

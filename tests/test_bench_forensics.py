@@ -104,7 +104,13 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               "hlo_serving_fusions", "hlo_serving_kernels",
               "hlo_serving_fusion_bytes",
               "trace_deterministic", "trace_span_count",
-              "trace_decode_compiles"):
+              "trace_decode_compiles",
+              # fleet-telemetry fields (PR 13): scrape counts, alert
+              # transitions and the determinism verdict are per-run
+              # observations — a stale round proves nothing here
+              "telemetry_deterministic", "telemetry_scrape_samples",
+              "telemetry_alerts_fired", "telemetry_alerts_resolved",
+              "telemetry_decode_compiles"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -479,3 +485,41 @@ def test_tracing_probe_gates_and_never_fabricates():
     assert out["trace_deterministic"] is None
     assert out["trace_span_count"] is None
     assert "tracing_probe_error" in out
+
+
+def test_proxy_bench_catches_disabled_burn_alerts():
+    """End-to-end telemetry regression injection (ISSUE 13): run the
+    telemetry probe with the burn-rate rules dropped (--no-burn-alerts)
+    and gate against the checked-in baseline — the seeded slowdown
+    fault then fires (and resolves) nothing, both alert counts read 0,
+    and the exact gates fail; the healthy collection of the same probe
+    must pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("telemetry",), telemetry_burn_alerts=False)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "telemetry_alerts_fired" in names
+    assert "telemetry_alerts_resolved" in names
+    assert bad["metrics"]["telemetry_alerts_fired"] == 0
+
+    good = pb.collect(probes=("telemetry",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["telemetry_deterministic"] == 1
+    assert good["metrics"]["telemetry_alerts_fired"] >= 1
+    assert good["metrics"]["telemetry_alerts_resolved"] >= 1
+    assert good["metrics"]["telemetry_decode_compiles"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_telemetry(Boom())
+    assert out["telemetry_deterministic"] is None
+    assert out["telemetry_alerts_fired"] is None
+    assert "telemetry_probe_error" in out

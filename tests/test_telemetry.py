@@ -1,0 +1,544 @@
+"""Fleet telemetry gates (ISSUE 13): deterministic time-series metrics,
+SLO burn-rate alerting, and autoscaling signals on the virtual clock.
+
+The tentpole's acceptance bars, asserted not logged:
+- determinism: telemetry export + alert timeline are byte-identical
+  across two runs of the same seeded workload, single-engine AND
+  cluster-with-crash-faults;
+- zero hot-path cost: the ragged trace-count==1 gate and the
+  host-dispatch counts hold with telemetry enabled (scraping is
+  host-side reads, never a jitted dispatch), and outputs are
+  token-identical with and without a scraper;
+- the seeded slowdown-fault run FIRES a burn-rate alert and later
+  RESOLVES it, in that order on the exported timeline;
+- crashed replicas fold, not vanish: counter deltas survive the reset
+  and the dead engine's latency population stays in fleet percentiles;
+- autoscaling policies are testable as code: the flash-crowd run scales
+  the live cluster up and back down deterministically via
+  ``ClusterDriver(autoscale=True)``.
+
+Satellites: gauge staleness stamps (engine ``now_fn``), the
+``Histogram`` empty-reservoir None contract + deterministic ``merge``,
+and the docs/SERVING.md metrics-reference-table drift gate.
+"""
+import json
+import os
+import re
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (ClusterDriver, Driver, VirtualClock,
+                                WorkloadSpec, build_cluster_report,
+                                build_report, report_json)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ClusterEngine, FaultEvent, FaultSchedule,
+                                Histogram, LLMEngine, RequestTracer,
+                                ServingMetrics)
+from paddle_tpu.serving.metrics import Gauge, percentile_of
+from paddle_tpu.telemetry import (SLO, AlertManager, AutoscalePolicy,
+                                  BurnRateRule, CounterSeries,
+                                  FLEET_SIGNALS, GaugeSeries, Scraper,
+                                  render_dashboard, standard_rules)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _spec(**kw):
+    kw.setdefault("num_requests", 14)
+    kw.setdefault("seed", 3)
+    kw.setdefault("arrival", "poisson")
+    kw.setdefault("arrival_rate", 100.0)
+    kw.setdefault("prompt_len", (4, 10))
+    kw.setdefault("output_len", (3, 8))
+    kw.setdefault("vocab_size", 128)
+    return WorkloadSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# series primitives
+# ---------------------------------------------------------------------------
+
+def test_gauge_series_tiers_and_bounds():
+    s = GaugeSeries("g", raw_capacity=8, coarse_every=4,
+                    coarse_capacity=4)
+    for i in range(40):
+        s.append(i * 0.1, float(i))
+    assert s.samples == 40
+    assert len(s.raw) == 8                     # raw ring bounded
+    assert [v for _, v in s.raw] == [float(v) for v in range(32, 40)]
+    assert len(s.coarse) == 4                  # coarse ring bounded
+    # each coarse bucket folds 4 raw samples into (t_last, mean, max)
+    t, mean, mx = s.coarse[-1]
+    assert (t, mean, mx) == (pytest.approx(3.9), 37.5, 39.0)
+    assert s.values_since(3.85) == [39.0]
+
+
+def test_counter_series_delta_decode_and_reset():
+    s = CounterSeries("c", raw_capacity=16, coarse_every=2,
+                      coarse_capacity=8)
+    assert s.observe(0.0, 5) == 5              # first reading is a delta
+    assert s.observe(1.0, 9) == 4
+    # a BACKWARDS reading is a restart: the new cumulative IS the delta
+    assert s.observe(2.0, 3) == 3
+    assert s.resets == 1
+    assert s.total == 12
+    # mark_reset covers the restart the heuristic cannot see (the new
+    # engine already counted past the old value)
+    s.mark_reset()
+    assert s.observe(3.0, 20) == 20
+    assert s.total == 32 and s.resets == 2
+    assert [v for _, v in s.coarse] == [9.0, 23.0]   # bucket sums
+
+
+# ---------------------------------------------------------------------------
+# Histogram: empty-reservoir contract + deterministic merge (satellites)
+# ---------------------------------------------------------------------------
+
+def test_histogram_empty_reservoir_is_none_never_zero():
+    h = Histogram("empty")
+    assert h.percentile(50) is None and h.percentile(99) is None
+    s = h.summary()
+    assert s == {"count": 0, "mean": None, "min": None, "max": None,
+                 "p50": None, "p90": None, "p99": None}
+    # the snapshot fields stay null too — never a fabricated 0
+    m = ServingMetrics(now_fn=lambda: 0.0)
+    snap = m.snapshot()
+    for hist in ServingMetrics.HISTOGRAMS:
+        assert snap[f"{hist}_count"] == 0
+        for q in (50, 90, 99):
+            assert snap[f"{hist}_p{q}"] is None
+    # merging empties keeps the contract
+    merged = Histogram.merge([Histogram("a"), Histogram("b")])
+    assert merged.percentile(99) is None and merged.count == 0
+
+
+def test_histogram_merge_exact_below_cap_and_deterministic():
+    a, b = Histogram("a"), Histogram("b")
+    for i in range(40):
+        a.observe(i * 1.0)
+    for i in range(25):
+        b.observe(100.0 + i)
+    pooled = [i * 1.0 for i in range(40)] + [100.0 + i for i in range(25)]
+
+    def merge():
+        return Histogram.merge([a, b], name="fleet")
+
+    m1, m2 = merge(), merge()
+    for q in (50, 90, 99):
+        assert m1.percentile(q) == percentile_of(pooled, q)
+        assert m1.percentile(q) == m2.percentile(q)
+    assert m1.count == 65 and m1.total == sum(pooled)
+    assert (m1.min, m1.max) == (0.0, 124.0)
+    # sample_state dicts merge identically to live histograms
+    m3 = Histogram.merge([a.sample_state(), b.sample_state()],
+                         name="fleet")
+    assert m3.summary() == m1.summary()
+
+
+def test_histogram_merge_bounded_above_cap():
+    srcs = [Histogram(f"h{i}", max_samples=64) for i in range(4)]
+    for i, h in enumerate(srcs):
+        for j in range(200):
+            h.observe(i * 1000.0 + j)
+    m = Histogram.merge(srcs, name="fleet")
+    assert m.count == 800                      # true aggregate count
+    assert len(m._samples) <= m.max_samples    # reservoir stays bounded
+    r = Histogram.merge(srcs, name="fleet")
+    assert m._samples == r._samples            # crc32-seeded, repeatable
+
+
+# ---------------------------------------------------------------------------
+# gauge staleness (satellite): stamps on now_fn, marked in snapshots
+# ---------------------------------------------------------------------------
+
+def test_gauge_stamps_last_update_on_now_fn():
+    t = [0.0]
+    g = Gauge("g", now_fn=lambda: t[0])
+    assert g.updated_at is None and g.age_s(5.0) is None
+    g.set(3.0)
+    t[0] = 2.5
+    assert g.updated_at == 0.0 and g.age_s(t[0]) == 2.5
+
+
+def test_snapshot_marks_stale_gauges_null():
+    t = [0.0]
+    m = ServingMetrics(now_fn=lambda: t[0], stale_after_s=1.0)
+    m.queue_depth.set(7.0)
+    snap = m.snapshot()
+    assert snap["queue_depth"] == 7.0
+    assert "queue_depth" not in snap["stale_gauges"]
+    # never-set gauges are stale from birth under a horizon
+    assert "spec_accept_rate" in snap["stale_gauges"]
+    assert snap["spec_accept_rate"] is None
+    t[0] = 5.0                                 # the value is now 5s old
+    snap = m.snapshot()
+    assert snap["queue_depth"] is None
+    assert "queue_depth" in snap["stale_gauges"]
+    # without a horizon the value passes through (legacy behavior) but
+    # the stamp still exists for the scraper
+    m2 = ServingMetrics(now_fn=lambda: t[0])
+    assert m2.snapshot()["stale_gauges"] == []
+
+
+def test_scraper_excludes_stale_gauges(tiny_model):
+    """A replica that stops stepping keeps its last gauge values — the
+    scraper must exclude (and count) them, not read them as current."""
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                    page_size=4)
+    sc = Scraper(eng, interval_s=0.01, stale_after_s=0.05)
+    eng.add_request([1, 2, 3], max_new_tokens=2)
+    clock.advance(0.01)
+    eng.step()
+    sc.scrape(clock.now())
+    fresh = sc.per_replica[0]["gauges"]["queue_depth"].samples
+    assert fresh > 0
+    stale0 = sc.stale_samples
+    # the engine goes quiet; the clock keeps moving past the horizon
+    clock.advance(1.0)
+    sc.scrape(clock.now())
+    assert sc.per_replica[0]["gauges"]["queue_depth"].samples == fresh
+    assert sc.stale_samples > stale0
+
+
+# ---------------------------------------------------------------------------
+# determinism: byte-identical telemetry + alert exports
+# ---------------------------------------------------------------------------
+
+def test_single_engine_telemetry_byte_identical(tiny_model):
+    def run():
+        clock = VirtualClock()
+        eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                        page_size=4)
+        sc = Scraper(eng, interval_s=0.03,
+                     rules=standard_rules(ttft_p99_s=0.5))
+        res = Driver(eng, clock, step_time_s=0.01,
+                     scraper=sc).run(_spec().compile())
+        return sc, res
+
+    (s1, r1), (s2, r2) = run(), run()
+    assert s1.scrapes > 0
+    assert s1.export_json() == s2.export_json()
+    assert s1.alerts.export_json() == s2.alerts.export_json()
+    # the report's telemetry section rides the same determinism
+    assert report_json(build_report(r1)) == report_json(build_report(r2))
+
+
+def test_cluster_telemetry_with_crash_byte_identical(tiny_model):
+    """The acceptance bar: a cluster run WITH a crash fault exports
+    byte-identical telemetry + alert timeline across two runs, and the
+    crashed replica's data folds instead of vanishing."""
+    faults = FaultSchedule([
+        FaultEvent(t=0.05, replica=1, kind="crash", recover_s=0.12)])
+    rules = standard_rules(ttft_p99_s=2.0, max_queue_wait_s=5.0,
+                           fast_window_s=0.04, slow_window_s=0.12)
+
+    def run():
+        clock = VirtualClock()
+        cluster = ClusterEngine(tiny_model, 3, seed=0, now_fn=clock.now,
+                                faults=faults, max_len=32, page_size=4)
+        sc = Scraper(cluster, interval_s=0.02, rules=rules)
+        res = ClusterDriver(cluster, clock, step_time_s=0.01,
+                            scraper=sc).run(
+            _spec(num_requests=24, output_len=(6, 10)).compile())
+        return sc, res
+
+    (s1, r1), (s2, r2) = run(), run()
+    assert s1.export_json() == s2.export_json()
+    assert s1.alerts.export_json() == s2.alerts.export_json()
+    assert report_json(build_cluster_report(r1, faults=faults)) == \
+        report_json(build_cluster_report(r2, faults=faults))
+    # the crash was observed: the dead engine's counters reset (decoded
+    # as a reset, not a negative spike) ...
+    slot = s1.per_replica[1]
+    resets = sum(c.resets for c in slot["counters"].values())
+    assert resets > 0
+    for c in slot["counters"].values():
+        assert all(v >= 0 for _, v in c.raw), "no negative deltas"
+    # ... and its latency population survives into fleet percentiles
+    # via the histogram carry (live replicas alone under-count)
+    exp = s1.export()
+    fleet_count = exp["fleet_latency"]["e2e_s"]["count"]
+    live_count = sum(
+        st["e2e_s"]["count"] for st in s1._hist_latest.values())
+    assert fleet_count >= live_count
+    assert fleet_count == r1.by_status().get("finished", 0)
+
+
+# ---------------------------------------------------------------------------
+# zero hot-path cost: telemetry on adds no compiles, no dispatches
+# ---------------------------------------------------------------------------
+
+def test_telemetry_adds_no_compiles_no_dispatches_same_tokens(tiny_model):
+    trace = _spec(seed=5).compile()
+
+    def run(with_scraper):
+        clock = VirtualClock()
+        eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                        page_size=4)
+        sc = Scraper(eng, interval_s=0.02,
+                     rules=standard_rules(ttft_p99_s=0.5)) \
+            if with_scraper else None
+        Driver(eng, clock, step_time_s=0.01, scraper=sc).run(trace)
+        outs = {rid: o.token_ids for rid, o in eng.outputs().items()}
+        return (eng.decode_cache_size(),
+                eng.metrics.host_dispatches.value, outs)
+
+    compiles_on, dispatches_on, outs_on = run(True)
+    compiles_off, dispatches_off, outs_off = run(False)
+    assert compiles_on == 1, \
+        "scraping must not add step executables (host-side reads only)"
+    assert dispatches_on == dispatches_off
+    assert outs_on == outs_off, "telemetry must not perturb tokens"
+
+
+# ---------------------------------------------------------------------------
+# burn-rate alerting: the slowdown fault fires, the recovery resolves
+# ---------------------------------------------------------------------------
+
+def test_slowdown_fault_fires_then_resolves_alert(tiny_model):
+    faults = FaultSchedule([
+        FaultEvent(t=0.06, replica=0, kind="slowdown", duration_s=0.08,
+                   magnitude=3.0)])
+    rules = [BurnRateRule(
+        SLO("step_latency", "step_latency_x", 1.0, budget=0.05),
+        fast_window_s=0.04, slow_window_s=0.12, burn_threshold=2.0)]
+    clock = VirtualClock()
+    cluster = ClusterEngine(tiny_model, 3, seed=0, now_fn=clock.now,
+                            faults=faults, max_len=32, page_size=4)
+    sc = Scraper(cluster, interval_s=0.02, rules=rules)
+    ClusterDriver(cluster, clock, step_time_s=0.01, scraper=sc).run(
+        _spec(num_requests=28, seed=11, arrival_rate=110.0,
+              output_len=(6, 12)).compile())
+    events = [(e["event"], e["t"]) for e in sc.alerts.timeline
+              if e["slo"] == "step_latency"]
+    assert [e for e, _ in events] == ["firing", "resolved"], events
+    t_fire, t_resolve = events[0][1], events[1][1]
+    assert 0.06 <= t_fire < 0.14, "fires inside the fault window"
+    assert t_resolve > 0.14, "resolves after the fault clears"
+    assert sc.alerts.firing == []              # nothing left firing
+    # the timeline carries the burn readings that justified each move
+    fire = sc.alerts.timeline[0]
+    assert fire["burn_fast"] >= 2.0 and fire["burn_slow"] >= 2.0
+
+
+def test_alert_manager_window_algebra():
+    rule = BurnRateRule(SLO("s", "x", 1.0, budget=0.5),
+                        fast_window_s=2.0, slow_window_s=4.0,
+                        burn_threshold=1.0)
+    am = AlertManager([rule])
+    # below objective: nothing fires
+    for t in range(3):
+        assert am.observe(float(t), {"x": 0.5}) == []
+    # fast window hot but slow still diluted -> holds, then fires
+    am.observe(3.0, {"x": 2.0})
+    assert am.state[rule.rule_id] == "inactive"
+    am.observe(4.0, {"x": 2.0})
+    out = am.observe(5.0, {"x": 2.0})
+    assert [e["event"] for e in out] == ["firing"]
+    # None samples spend no budget and eventually drain the windows
+    for t in (6.0, 7.0, 8.0, 9.0, 10.0):
+        out = am.observe(t, {"x": None})
+    assert am.state[rule.rule_id] == "inactive"
+    assert am.fired == 1 and am.resolved == 1
+    # validation
+    with pytest.raises(ValueError):
+        SLO("bad", "x", 1.0, worse="sideways")
+    with pytest.raises(ValueError):
+        SLO("bad", "x", 1.0, budget=0.0)
+    with pytest.raises(ValueError):
+        BurnRateRule(SLO("s", "x", 1.0), fast_window_s=2.0,
+                     slow_window_s=1.0)
+    with pytest.raises(ValueError):
+        AlertManager([rule, rule])             # duplicate rule id
+
+
+# ---------------------------------------------------------------------------
+# autoscaling signals: policies testable as code, chip-free
+# ---------------------------------------------------------------------------
+
+def test_autoscale_policy_hysteresis():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4, queue_high=4.0,
+                          queue_low=1.0, scale_up_after=2,
+                          scale_down_after=3)
+    hot = {"queue_depth": 20.0, "parked": 0.0, "alive_replicas": 1.0,
+           "kv_utilization": 0.2, "step_latency_x": 1.0}
+    cold = {"queue_depth": 0.0, "parked": 0.0, "alive_replicas": 2.0,
+            "kv_utilization": 0.1, "step_latency_x": 1.0}
+    assert pol.recommend(hot, 1) == 1          # 1 hot sample: hold
+    assert pol.recommend(hot, 1) == 2          # 2 consecutive: grow
+    assert pol.recommend(cold, 2) == 2
+    assert pol.recommend(cold, 2) == 2
+    assert pol.recommend(cold, 2) == 1         # 3 consecutive idle: shrink
+    # KV pressure alone is a capacity signal too
+    kv_hot = dict(cold, kv_utilization=0.95)
+    assert pol.recommend(kv_hot, 1) == 1
+    assert pol.recommend(kv_hot, 1) == 2
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+def test_cluster_driver_applies_autoscale_deterministically(tiny_model):
+    """Flash crowd on a 1-replica cluster: the policy scales the LIVE
+    fleet up through ``ClusterEngine.scale_to`` and back down on drain,
+    every request resolves, and the whole story reproduces byte for
+    byte — autoscaling policies as testable code."""
+    spec = _spec(num_requests=24, seed=9, arrival="deterministic",
+                 arrival_rate=400.0, output_len=(8, 12))
+
+    def run():
+        clock = VirtualClock()
+        cluster = ClusterEngine(tiny_model, 1, seed=0, now_fn=clock.now,
+                                max_len=32, page_size=4, max_num_seqs=2)
+        pol = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                              queue_high=2.0, queue_low=0.5,
+                              scale_up_after=2, scale_down_after=4)
+        sc = Scraper(cluster, interval_s=0.02, autoscale=pol)
+        res = ClusterDriver(cluster, clock, step_time_s=0.01, scraper=sc,
+                            autoscale=True).run(spec.compile())
+        return sc, res, cluster
+
+    s1, r1, c1 = run()
+    s2, r2, c2 = run()
+    assert c1.counters["scale_ups"] > 0, "the crowd must scale us up"
+    assert c1.counters["scale_downs"] > 0, "the drain must scale us down"
+    assert len(c1.replicas) > 1
+    assert r1.scale_events == c1.counters["scale_ups"] \
+        + c1.counters["scale_downs"]
+    assert r1.by_status() == {"finished": 24}, "no request may be lost"
+    desired = [v for _, v in s1.fleet["desired_replicas"].raw]
+    assert max(desired) > 1.0 and desired[-1] < max(desired)
+    assert s1.export_json() == s2.export_json()
+    rep1 = build_cluster_report(r1, spec=spec)
+    assert rep1["cluster"]["scale_ups"] == c1.counters["scale_ups"]
+    assert rep1["telemetry"]["scale_events"] == r1.scale_events
+    assert report_json(rep1) == \
+        report_json(build_cluster_report(r2, spec=spec))
+    # decommissioned replicas folded their counters and stay DOWN
+    for rep in c1.replicas:
+        if rep.decommissioned:
+            assert rep.engine is None and rep.recover_at is None
+            assert rep.counter("tokens_generated") >= 0
+
+
+def test_scale_to_validation_and_idempotence(tiny_model):
+    clock = VirtualClock()
+    cluster = ClusterEngine(tiny_model, 2, seed=0, now_fn=clock.now,
+                            max_len=32, page_size=4)
+    with pytest.raises(ValueError):
+        cluster.scale_to(0)
+    assert cluster.scale_to(2) == []           # no-op at target
+    cluster.scale_to(3)
+    assert cluster.provisioned_replicas() == 3
+    assert cluster.num_replicas == 3
+    cluster.scale_to(1)
+    assert cluster.provisioned_replicas() == 1
+    # idle replicas decommission immediately (nothing to drain)
+    assert sum(1 for r in cluster.replicas if r.engine is not None) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: docs table drift gate, dashboard, chrome counter lane
+# ---------------------------------------------------------------------------
+
+def test_serving_md_metrics_table_is_complete():
+    """docs/SERVING.md's ServingMetrics reference table was written by
+    hand (PR 12); this gate keeps it from drifting: every counter,
+    gauge, and histogram the class declares must appear in the
+    reference section."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "SERVING.md")
+    with open(path) as f:
+        text = f.read()
+    start = text.index("`metrics.ServingMetrics` — complete reference")
+    end = text.index("## ", start)
+    section = text[start:end]
+    documented = set(re.findall(r"`([A-Za-z0-9_]+)`", section))
+    declared = set(ServingMetrics.COUNTERS) | set(ServingMetrics.GAUGES) \
+        | set(ServingMetrics.HISTOGRAMS)
+    missing = sorted(declared - documented)
+    assert not missing, (
+        f"docs/SERVING.md metrics reference table is missing {missing} — "
+        f"document every new counter/gauge/histogram in the table")
+
+
+def test_dashboard_renders_deterministically(tiny_model):
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                    page_size=4)
+    sc = Scraper(eng, interval_s=0.02,
+                 rules=standard_rules(ttft_p99_s=0.5))
+    Driver(eng, clock, step_time_s=0.01,
+           scraper=sc).run(_spec().compile())
+    d1, d2 = render_dashboard(sc), render_dashboard(sc)
+    assert d1 == d2
+    for signal in FLEET_SIGNALS:
+        assert signal in d1
+    assert "fleet latency" in d1 and "scrapes=" in d1
+    assert f"scrapes={sc.scrapes}" in d1
+
+
+def test_chrome_trace_gains_telemetry_counter_lane(tiny_model, tmp_path):
+    clock = VirtualClock()
+    tracer = RequestTracer()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                    page_size=4, tracer=tracer)
+    sc = Scraper(eng, interval_s=0.02)
+    Driver(eng, clock, step_time_s=0.01,
+           scraper=sc).run(_spec().compile())
+    path = tmp_path / "trace.json"
+    trace = tracer.export_chrome_trace(str(path), telemetry=sc)
+    counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    assert counters, "the telemetry counter lane must be merged in"
+    assert all(e["pid"] == 3 for e in counters)
+    names = {e["name"] for e in counters}
+    assert "fleet.queue_depth" in names
+    with open(path) as f:
+        assert json.load(f)["traceEvents"]
+    # without telemetry= the export is unchanged (no counter events)
+    plain = tracer.export_chrome_trace()
+    assert not [e for e in plain["traceEvents"] if e.get("ph") == "C"]
+
+
+def test_report_telemetry_section_only_when_scraped(tiny_model):
+    trace = _spec().compile()
+
+    def run(with_scraper):
+        clock = VirtualClock()
+        eng = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                        page_size=4)
+        sc = Scraper(eng, interval_s=0.02) if with_scraper else None
+        res = Driver(eng, clock, step_time_s=0.01, scraper=sc).run(trace)
+        return build_report(res)
+
+    with_tel = run(True)
+    without = run(False)
+    assert "telemetry" in with_tel
+    assert with_tel["telemetry"]["scrapes"] > 0
+    assert "fleet_latency" in with_tel["telemetry"]
+    assert "telemetry" not in without, \
+        "unscraped artifacts must byte-persist"
+
+
+def test_scraper_rejects_foreign_target(tiny_model):
+    clock = VirtualClock()
+    eng1 = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                     page_size=4)
+    eng2 = LLMEngine(tiny_model, now_fn=clock.now, seed=0, max_len=32,
+                     page_size=4)
+    sc = Scraper(eng2, interval_s=0.02)
+    with pytest.raises(ValueError):
+        Driver(eng1, clock, scraper=sc)
+    cluster = ClusterEngine(tiny_model, 1, seed=0, now_fn=clock.now,
+                            max_len=32, page_size=4)
+    with pytest.raises(ValueError):
+        ClusterDriver(cluster, clock, scraper=Scraper(cluster),
+                      autoscale=True)          # autoscale needs a policy

@@ -679,6 +679,87 @@ def probe_tracing(paddle):
                 "tracing_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_telemetry(paddle, burn_alerts=True):
+    """Measured fleet-telemetry fields (paddle_tpu.telemetry) for the
+    bench trajectory — the time-series/SLO layer's own CI gates.
+
+    One seeded Poisson workload drives a 3-replica cluster on the
+    virtual clock with a scripted SLOWDOWN fault on replica 0, a
+    ``Scraper`` sampling every replica each interval, and a
+    step-latency burn-rate rule — TWICE, with fresh clusters. Records:
+    - ``telemetry_deterministic``: 1 iff the two runs' full telemetry
+      exports (series, fleet percentiles, alert timeline) are
+      byte-identical — the reproducible-SLO-claim contract;
+    - ``telemetry_scrape_samples``: scrapes the run produced — pinned
+      exactly (a drift means the scrape cadence or run length changed;
+      re-record deliberately);
+    - ``telemetry_alerts_fired`` / ``telemetry_alerts_resolved``: burn-
+      rate alert transitions on the seeded slowdown run — the fault
+      MUST fire the alert and the recovery MUST resolve it, both
+      pinned exactly. ``burn_alerts=False`` (the proxy-bench
+      ``--no-burn-alerts`` regression hook) drops the rules: both
+      counts read 0 and the gates must catch it;
+    - ``telemetry_decode_compiles``: max ragged-step executable count
+      across replicas with telemetry on — must stay 1 (scraping is
+      host-side reads, ZERO jitted dispatches).
+    """
+    try:
+        from paddle_tpu.loadgen import (ClusterDriver, VirtualClock,
+                                        WorkloadSpec)
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import (ClusterEngine, FaultEvent,
+                                        FaultSchedule)
+        from paddle_tpu.telemetry import SLO, BurnRateRule, Scraper
+        paddle.seed(0)
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        model = LlamaForCausalLM(cfg)
+        spec = WorkloadSpec(num_requests=28, seed=11, arrival="poisson",
+                            arrival_rate=110.0, prompt_len=(4, 10),
+                            output_len=(6, 12), vocab_size=128)
+        faults = FaultSchedule([
+            FaultEvent(t=0.06, replica=0, kind="slowdown",
+                       duration_s=0.08, magnitude=3.0)])
+        rules = [BurnRateRule(
+            SLO("step_latency", "step_latency_x", 1.0, budget=0.05),
+            fast_window_s=0.04, slow_window_s=0.12,
+            burn_threshold=2.0)] if burn_alerts else None
+
+        def run():
+            clock = VirtualClock()
+            cluster = ClusterEngine(model, 3, seed=0, now_fn=clock.now,
+                                    faults=faults, max_len=32,
+                                    page_size=4)
+            sc = Scraper(cluster, interval_s=0.02, rules=rules)
+            ClusterDriver(cluster, clock, step_time_s=0.01,
+                          scraper=sc).run(spec.compile())
+            return sc, cluster
+
+        sc1, cluster1 = run()
+        sc2, _ = run()
+        compiles = max(rep.engine.decode_cache_size()
+                       for rep in cluster1.replicas
+                       if rep.engine is not None)
+        return {
+            "telemetry_deterministic": int(sc1.export_json()
+                                           == sc2.export_json()),
+            "telemetry_scrape_samples": sc1.scrapes,
+            "telemetry_alerts_fired": sc1.alerts.fired
+            if sc1.alerts is not None else 0,
+            "telemetry_alerts_resolved": sc1.alerts.resolved
+            if sc1.alerts is not None else 0,
+            "telemetry_decode_compiles": compiles,
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"telemetry_deterministic": None,
+                "telemetry_scrape_samples": None,
+                "telemetry_alerts_fired": None,
+                "telemetry_alerts_resolved": None,
+                "telemetry_decode_compiles": None,
+                "telemetry_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -709,4 +790,5 @@ def probe_kv_accounting():
 __all__ = ["probe_cluster", "probe_gspmd", "probe_hlo_fusion",
            "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_opt_dispatches",
-           "probe_serving", "probe_spec_decode", "probe_tracing"]
+           "probe_serving", "probe_spec_decode", "probe_telemetry",
+           "probe_tracing"]

@@ -31,7 +31,12 @@ This harness runs the measurements that DON'T need a chip and are
 - ``trace_deterministic`` / ``trace_span_count`` /
   ``trace_decode_compiles`` — the request-tracing layer's contracts:
   byte-identical exports per seed and zero added step executables
-  (serving/tracing.py).
+  (serving/tracing.py);
+- ``telemetry_*`` — the fleet time-series/SLO layer's contracts
+  (paddle_tpu.telemetry): byte-identical series + alert-timeline
+  exports per seed, a pinned scrape count, the seeded slowdown fault
+  firing AND resolving its burn-rate alert (``--no-burn-alerts`` is
+  the injected regression), and zero added step executables.
 
 Each metric gates against a checked-in per-backend baseline
 (tools/proxy_bench_baseline.json) with a direction and tolerance from
@@ -71,7 +76,7 @@ if "--xla_force_host_platform_device_count" not in \
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
-          "jaxpr", "accounting", "fusion", "tracing")
+          "jaxpr", "accounting", "fusion", "tracing", "telemetry")
 
 
 class Gate:
@@ -171,12 +176,24 @@ GATES = {
     "trace_deterministic":      Gate("lower", 0.0, 0.0),
     "trace_span_count":         Gate("different"),
     "trace_decode_compiles":    Gate("higher", 0.0, 0.0),
+    # fleet telemetry (paddle_tpu.telemetry via probe_telemetry): the
+    # full time-series/alert export must be byte-identical per seed,
+    # the scrape count is pinned (cadence/run-length drift must be
+    # re-recorded deliberately), the seeded slowdown fault must FIRE
+    # and later RESOLVE the burn-rate alert (both pinned exactly —
+    # --no-burn-alerts drops the rules, both read 0, and these gates
+    # must catch it), and scraping must add zero step executables.
+    "telemetry_deterministic":  Gate("lower", 0.0, 0.0),
+    "telemetry_scrape_samples": Gate("different"),
+    "telemetry_alerts_fired":   Gate("different"),
+    "telemetry_alerts_resolved": Gate("different"),
+    "telemetry_decode_compiles": Gate("higher", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
-            fusion_defuse=False) -> dict:
+            fusion_defuse=False, telemetry_burn_alerts=True) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -197,6 +214,10 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     splitting the ragged serving layer's hot fused region at trace time
     — fusion/kernel counts and fused-region bytes rise and the
     ``hlo_serving_*`` gates must catch it.
+    ``telemetry_burn_alerts=False`` (--no-burn-alerts) drops the burn-
+    rate rules from the telemetry probe's scraper — the seeded
+    slowdown fault then fires (and resolves) nothing, both alert
+    counts read 0, and the ``telemetry_alerts_*`` gates must catch it.
     """
     import jax
     import paddle_tpu as paddle
@@ -205,7 +226,8 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
                                     probe_opt_dispatches, probe_serving,
-                                    probe_spec_decode, probe_tracing)
+                                    probe_spec_decode, probe_telemetry,
+                                    probe_tracing)
     dev = jax.devices()[0]
     backend = dev.platform if dev.platform == "cpu" else \
         getattr(dev, "device_kind", "tpu").replace(" ", "-").lower()
@@ -255,6 +277,11 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
         _take(probe_tracing(paddle),
               ("trace_deterministic", "trace_span_count",
                "trace_decode_compiles"))
+    if "telemetry" in probes:
+        _take(probe_telemetry(paddle, burn_alerts=telemetry_burn_alerts),
+              ("telemetry_deterministic", "telemetry_scrape_samples",
+               "telemetry_alerts_fired", "telemetry_alerts_resolved",
+               "telemetry_decode_compiles"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -334,6 +361,11 @@ def main(argv=None) -> int:
                          "probe: an optimization barrier splits the "
                          "ragged layer's hot fused region, fusion/"
                          "kernel counts rise (the injected regression)")
+    ap.add_argument("--no-burn-alerts", action="store_true",
+                    help="drop the burn-rate rules from the telemetry "
+                         "probe's scraper: the seeded slowdown fault "
+                         "fires no alert, fired/resolved counts read 0 "
+                         "(the injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -359,7 +391,8 @@ def main(argv=None) -> int:
                       spec_tokens=args.spec_tokens,
                       gspmd_dp_only=args.dp_only,
                       cluster_retry_budget=0 if args.no_retry else 2,
-                      fusion_defuse=args.defuse)
+                      fusion_defuse=args.defuse,
+                      telemetry_burn_alerts=not args.no_burn_alerts)
 
     if args.json:
         # --json changes the output format, never the action: combined
