@@ -44,7 +44,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tools.bench_probes import (probe_gspmd,  # noqa: E402
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
-                                probe_opt_dispatches, probe_serving,
+                                probe_opt_dispatches,
+                                probe_persistence, probe_serving,
                                 probe_spec_decode, probe_telemetry,
                                 probe_tracing)
 
@@ -58,6 +59,7 @@ _probe_gspmd = probe_gspmd
 _probe_hlo_fusion = probe_hlo_fusion
 _probe_tracing = probe_tracing
 _probe_telemetry = probe_telemetry
+_probe_persistence = probe_persistence
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -220,6 +222,7 @@ def run_bench(config="llama_125m", progress=None):
     fusion_probe = _probe_hlo_fusion(paddle)
     tracing_probe = _probe_tracing(paddle)
     telemetry_probe = _probe_telemetry(paddle)
+    persistence_probe = _probe_persistence(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -292,6 +295,7 @@ def run_bench(config="llama_125m", progress=None):
         **fusion_probe,
         **tracing_probe,
         **telemetry_probe,
+        **persistence_probe,
     }
 
 
@@ -587,6 +591,15 @@ def _failure_artifact(last_err, last_stages):
         "telemetry_alerts_fired": None,
         "telemetry_alerts_resolved": None,
         "telemetry_decode_compiles": None,
+        # crash-consistent persistence fields are per-run proofs: a
+        # resume-identity verdict, fallback count, warm-hit count, or
+        # save/restore timing from a stale round proves nothing about
+        # the run that failed
+        "persist_resume_identical": None,
+        "persist_restore_fallbacks": None,
+        "persist_warm_prefix_hits": None,
+        "persist_ckpt_save_ms": None,
+        "persist_ckpt_restore_ms": None,
     }
     good = _last_good_round()
     if good:

@@ -121,15 +121,47 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
     nprocs = jax.process_count()
 
     def _write():
+        # crash-consistent discipline (io/persist.py): every file is
+        # published with write-to-temp + fsync + atomic rename, and the
+        # coordinator's manifest — written LAST — carries per-file
+        # size/crc32 checksums. A crash at any byte leaves the previous
+        # checkpoint's files untouched (rename replaces whole files,
+        # never appends), and load_state_dict verifies the manifest's
+        # checksums before materializing a single shard — a torn or
+        # rotted shard file can never silently feed wrong weights.
+        from ..io.persist import (atomic_write_bytes, crc32_bytes,
+                                  crc32_file, fsync_dir)
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, fname), **shard_arrays)
-        with open(os.path.join(path, f"metadata_{host}.json"), "w") as f:
-            json.dump(meta, f, indent=1)
+        # shards stream straight into the temp file (np.savez writes the
+        # zip incrementally) — peak memory stays at shard scale, never
+        # the whole serialized payload — then publish by atomic rename
+        # and checksum by chunked re-read
+        fpath = os.path.join(path, fname)
+        tmp = fpath + f".tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **shard_arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, fpath)
+        fsync_dir(path)
+        psize, pcrc = crc32_file(fpath)
+        mbytes = json.dumps(meta, indent=1).encode("utf-8")
+        mname = f"metadata_{host}.json"
+        atomic_write_bytes(os.path.join(path, mname), mbytes)
         if host == coordinator_rank:
             # manifest fences off stale metadata_*/shards_* files left by an
-            # earlier save into the same directory with more hosts
-            with open(os.path.join(path, "manifest.json"), "w") as f:
-                json.dump({"nprocs": nprocs}, f)
+            # earlier save into the same directory with more hosts; its
+            # "files" section covers THIS host's files (each host's own
+            # writes are independently atomic)
+            atomic_write_bytes(
+                os.path.join(path, "manifest.json"),
+                json.dumps({
+                    "nprocs": nprocs,
+                    "files": {
+                        fname: {"size": psize, "crc32": pcrc},
+                        mname: {"size": len(mbytes),
+                                "crc32": crc32_bytes(mbytes)},
+                    }}).encode("utf-8"))
 
     global _async_save_thread
     if async_save:
@@ -144,6 +176,40 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 def wait_async_save():
     if _async_save_thread is not None and _async_save_thread.is_alive():
         _async_save_thread.join()
+
+
+def _verify_manifest(path):
+    """Checksum-verify every file the manifest records BEFORE any shard
+    is materialized (save_state_dict writes the manifest last, so its
+    checksums cover the finished files). Old checkpoints without a
+    ``files`` section skip verification; a mismatch raises a ValueError
+    naming the file — a torn/rotted shard must never load as weights."""
+    from ..io.persist import crc32_file
+    manifest = os.path.join(path, "manifest.json")
+    if not os.path.exists(manifest):
+        return
+    try:
+        with open(manifest) as f:
+            files = json.load(f).get("files")
+    except ValueError as e:
+        raise ValueError(
+            f"checkpoint manifest at {path} is unreadable (torn write?): "
+            f"{e}")
+    if not files:
+        return
+    for fname, rec in files.items():
+        fpath = os.path.join(path, fname)
+        if not os.path.exists(fpath):
+            raise ValueError(
+                f"checkpoint at {path}: manifest lists {fname} but the "
+                f"file is missing")
+        size, crc = crc32_file(fpath)      # chunked: O(1) memory
+        if size != rec["size"] or crc != rec["crc32"]:
+            raise ValueError(
+                f"checkpoint at {path}: {fname} failed checksum "
+                f"verification ({size} bytes vs manifest "
+                f"{rec['size']}) — refusing to materialize shards from "
+                f"a torn or corrupted file")
 
 
 def _merged_metadata(path):
@@ -246,6 +312,7 @@ def load_state_dict(state_dict, path, process_group=None,
     if os.path.exists(legacy) and not _glob.glob(
             os.path.join(path, "metadata_*.json")):
         return _load_legacy(state_dict, path)
+    _verify_manifest(path)
     meta = _merged_metadata(path)
     reader = _LazyShardReader(path)
     flat_dst = _flatten_state(state_dict)

@@ -179,7 +179,23 @@ class Model:
     # ---- loops ----
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            checkpoint_dir=None, checkpoint_freq=None,
+            keep_last_checkpoints=3, resume=False):
+        """Train; with ``checkpoint_dir`` set, the FULL training state
+        (params, optimizer state incl. fused flat buckets, the global
+        RNG stream, and the data-loader cursor) is saved through the
+        crash-consistent :class:`~paddle_tpu.io.persist.ArtifactStore`
+        every ``checkpoint_freq`` optimizer steps (default: once per
+        epoch). ``resume=True`` restores the newest verified checkpoint
+        and continues from the exact step boundary it captured — the
+        resumed loss trajectory is bit-identical to the unkilled run's
+        PROVIDED the loader shuffles with a SEEDED sampler (each
+        epoch's batch order is then pinned by ``set_epoch`` to a pure
+        function of (sampler seed, epoch); an unseeded shuffle draws
+        off numpy's global RNG, is not resumable, and warns). A corrupt
+        newest version falls back to the last good one; no checkpoint
+        at all is a clean cold start."""
         from .callbacks import config_callbacks
         loader = _as_loader(train_data, batch_size, shuffle, num_workers,
                             drop_last)
@@ -187,15 +203,84 @@ class Model:
         self.save_dir = save_dir
         self.stop_training = False
         steps = len(loader) if hasattr(loader, "__len__") else None
+        ckpt_store = None
+        cursor = {"epoch": 0, "step_in_epoch": 0, "global_step": 0}
+        if checkpoint_dir is not None:
+            from ..io.persist import (ArtifactStore, capture_training_state,
+                                      restore_training_state)
+            ckpt_store = ArtifactStore(checkpoint_dir,
+                                       keep_last=keep_last_checkpoints)
+            # resumable shuffling precondition: an UNSEEDED random
+            # sampler permutes off numpy's global RNG, which the
+            # checkpoint does not (and cannot portably) capture — a
+            # resumed epoch would fast-forward over a DIFFERENT batch
+            # order, training some samples twice and others never.
+            # Warn now, at save time, not at the resume that corrupts.
+            smp = getattr(getattr(loader, "batch_sampler", None),
+                          "sampler", None)
+            if smp is not None and hasattr(smp, "set_epoch") \
+                    and getattr(smp, "generator", None) is None:
+                import warnings
+                warnings.warn(
+                    "fit(checkpoint_dir=...): the train loader shuffles "
+                    "with an UNSEEDED sampler, so a resumed run cannot "
+                    "replay the same batch order (bit-identical resume "
+                    "is lost). Pass a seeded sampler, e.g. DataLoader("
+                    "ds, batch_sampler=BatchSampler(sampler=RandomSampler"
+                    "(ds, generator=SEED), batch_size=...)).",
+                    stacklevel=2)
+            if resume:
+                res = ckpt_store.load("train_state")
+                if res is not None:
+                    cursor.update(restore_training_state(
+                        res, model=self, optimizer=self._optimizer,
+                        scaler=getattr(self, "_scaler", None)))
+
+            def _save_ckpt(epoch, step_in_epoch):
+                arrays, meta = capture_training_state(
+                    model=self, optimizer=self._optimizer,
+                    scaler=getattr(self, "_scaler", None),
+                    cursor={"epoch": epoch,
+                            "step_in_epoch": step_in_epoch,
+                            "global_step": cursor["global_step"]})
+                ckpt_store.save("train_state", arrays, meta)
+        elif resume:
+            raise ValueError("fit(resume=True) needs checkpoint_dir")
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, log_freq=log_freq,
                                 verbose=verbose, save_freq=save_freq,
                                 save_dir=save_dir, metrics=self._metric_names())
         cbks.on_train_begin()
         history = []
-        for epoch in range(epochs):
+        start_epoch = int(cursor["epoch"])
+        skip_steps = int(cursor["step_in_epoch"])
+        if steps is not None and skip_steps >= steps:
+            # the checkpoint landed exactly on an epoch boundary:
+            # resume at the NEXT epoch's first batch
+            start_epoch += 1
+            skip_steps = 0
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(loader, cbks, "train", log_freq)
+            step_hook = None
+            if ckpt_store is not None:
+                freq = checkpoint_freq if checkpoint_freq else \
+                    (steps if steps else 1)
+
+                def step_hook(step, epoch=epoch, freq=freq):
+                    cursor["global_step"] += 1
+                    if cursor["global_step"] % max(int(freq), 1) == 0:
+                        _save_ckpt(epoch, step + 1)
+            # epoch pinning is scoped to CHECKPOINTED runs: they need
+            # epoch e's batch order to be a pure function of (sampler
+            # seed, e). Plain fit() keeps the legacy self-advancing
+            # sampler behavior (repeated fit() calls on one loader keep
+            # drawing fresh permutations).
+            logs = self._run_one_epoch(loader, cbks, "train", log_freq,
+                                       epoch=epoch
+                                       if ckpt_store is not None else None,
+                                       skip_steps=skip_steps
+                                       if epoch == start_epoch else 0,
+                                       step_hook=step_hook)
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 cbks.on_eval_begin()
@@ -314,8 +399,17 @@ class Model:
             names += n if isinstance(n, list) else [n]
         return names
 
-    def _run_one_epoch(self, loader, cbks, mode, log_freq=10):
+    def _run_one_epoch(self, loader, cbks, mode, log_freq=10, epoch=None,
+                       skip_steps=0, step_hook=None):
         from ..io.prefetch import PIPELINE_METRICS as _pm
+        if mode == "train" and epoch is not None:
+            # pin the epoch's shuffle seed: epoch e draws the same batch
+            # sequence whether this is the first process to serve it or
+            # a killed-and-resumed one (samplers expose set_epoch;
+            # loaders without one keep their legacy self-advancing seed)
+            bs = getattr(loader, "batch_sampler", None)
+            if bs is not None and hasattr(bs, "set_epoch"):
+                bs.set_epoch(epoch)
         for m in self._metrics:
             m.reset()
         losses = []
@@ -330,11 +424,18 @@ class Model:
         boundary_mode = bool(log_freq) and log_freq <= window
         logs = {}
         for step, batch in enumerate(loader):
+            if step < skip_steps:
+                # resume fast-forward: these batches were trained before
+                # the kill — consume them (the sampler order must stay
+                # identical) without training, callbacks, or logging
+                continue
             inputs, labels = _split_batch(batch, max(1, len(self._labels))
                                           if (self._loss is not None) else 0)
             if mode == "train":
                 cbks.on_train_batch_begin(step)
                 loss, metrics = self.train_batch(inputs, labels)
+                if step_hook is not None:
+                    step_hook(step)
             else:
                 cbks.on_eval_batch_begin(step)
                 loss, metrics = self.eval_batch(inputs, labels)

@@ -178,6 +178,17 @@ class RandomSampler(Sampler):
         self.num_samples = num_samples or len(data_source)
         self.generator = generator
 
+    def set_epoch(self, epoch: int):
+        """Pin the epoch index the NEXT ``__iter__`` seeds from. The
+        draw sequence of epoch ``e`` is then a pure function of
+        ``(generator seed, e)`` — independent of how many epochs this
+        sampler object served before — which is what lets a
+        killed-and-resumed run (hapi Model.fit checkpointing,
+        io/persist.py) replay the identical batch sequence. Without a
+        ``set_epoch`` call the sampler keeps its legacy self-advancing
+        behavior."""
+        self._epoch = int(epoch)
+
     def __iter__(self):
         n = len(self.data_source)
         epoch = getattr(self, "_epoch", 0)
@@ -215,6 +226,13 @@ class WeightedRandomSampler(Sampler):
         self.replacement = replacement
         self.generator = generator
 
+    def set_epoch(self, epoch: int):
+        """Pin the epoch the next ``__iter__`` seeds from (see
+        :meth:`RandomSampler.set_epoch`): epoch ``e``'s weighted draws
+        become a pure function of ``(generator seed, e)``, so a resumed
+        epoch replays the identical sample sequence."""
+        self._epoch = int(epoch)
+
     def __iter__(self):
         # seeded like RandomSampler._perm: reproducible across runs,
         # different per epoch (the epoch index folds into the seed)
@@ -241,6 +259,14 @@ class BatchSampler(Sampler):
             self.sampler = RandomSampler(dataset)
         else:
             self.sampler = SequenceSampler(dataset)
+
+    def set_epoch(self, epoch: int):
+        """Forward the epoch pin to the underlying sampler when it
+        supports one (RandomSampler / WeightedRandomSampler) — the
+        DataLoader-facing hook Model.fit uses so every epoch's batch
+        sequence is reproducible by (epoch index, sampler seed)."""
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self):
         batch = []

@@ -1,0 +1,461 @@
+"""Crash-consistent artifact persistence — the storage layer restarts
+stand on.
+
+Everything else in this repo survives *logical* failure (replica
+crashes, injected faults, preemption storms); this module makes state
+survive *process* death. The primitive is :class:`ArtifactStore`, a
+versioned directory store with one discipline:
+
+- **Atomic publication** — a version is written into a hidden temp
+  directory (``.tmp-*``), every file is flushed + fsync'd, and the
+  directory is published with ONE ``os.rename``. A crash at any byte
+  of the write leaves either the previous versions untouched or an
+  unpublished temp directory the next writer sweeps — never a
+  half-written version that parses.
+- **Verified reads** — each version carries a ``manifest.json`` with
+  per-leaf crc32 checksums (and per-file size/crc32); ``load`` verifies
+  the newest version end to end and, on ANY corruption — truncated
+  payload, flipped byte, missing file, torn manifest — falls back to
+  the next older version instead of raising. The fallback is counted
+  (``restore_fallbacks``) and recorded on an attached flight recorder,
+  so silent-wrong-weights is structurally impossible: data is either
+  checksum-clean or not loaded.
+- **Keep-last-K GC** — after a successful save the store prunes all but
+  the newest ``keep_last`` versions. GC runs only after the new version
+  is published, so the newest verified version is never deleted.
+
+Consumers in-repo: deterministic kill-and-resume training
+(:func:`capture_training_state` / :func:`restore_training_state`,
+driven by ``Model.fit(checkpoint_dir=...)``), the serving engines'
+persistent pinned-prefix store (serving/engine.py
+``LLMEngine(prefix_store=...)``), and the sharded
+``distributed/checkpoint.py`` writer (atomic file publication +
+manifest checksums). The seeded storage-fault injector that proves the
+fallback matrix lives in io/storage_faults.py.
+"""
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import shutil
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+MANIFEST = "manifest.json"
+PAYLOAD = "data.npz"
+_VERSION_FMT = "v{:08d}"
+
+
+def crc32_bytes(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def crc32_file(path: str, chunk=1 << 20) -> tuple:
+    """(size, crc32) of a file by chunked read — checksum multi-GB
+    shard files without ever holding them in memory."""
+    size = 0
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            size += len(block)
+            crc = zlib.crc32(block, crc)
+    return size, crc & 0xFFFFFFFF
+
+
+def fsync_dir(path: str):
+    """fsync a directory so a just-renamed/created entry is durable —
+    the rename itself is atomic either way; the fsync pins it across
+    power loss. Platforms that refuse O_RDONLY dir fsync (some network
+    filesystems) degrade to rename-atomicity only."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes):
+    """Write ``path`` via temp file + fsync + rename: readers see the
+    old content or the new content, never a torn middle. The temp file
+    lives in the destination directory so the rename stays within one
+    filesystem."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".tmp-{os.path.basename(path)}-{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+    fsync_dir(d)
+
+
+class ArtifactCorrupt(RuntimeError):
+    """A specific version failed verification; ``load`` raises this only
+    internally — the public path falls back to the previous version."""
+
+
+@dataclass
+class LoadResult:
+    """One verified restore: the payload arrays, the caller meta blob,
+    which version served it, and how many newer-but-corrupt versions
+    were skipped to get there (0 = the newest version was clean)."""
+    arrays: dict
+    meta: dict
+    version: int
+    fallbacks: int = 0
+    corrupt_versions: list = field(default_factory=list)
+
+
+class ArtifactStore:
+    """Versioned, checksummed, atomically-published artifact directory.
+
+    ``save(tag, arrays, meta)`` publishes ``root/tag/vNNNNNNNN/`` with a
+    numpy payload + manifest; ``load(tag)`` returns the newest version
+    that verifies (or None when no version survives). ``keep_last``
+    bounds disk: older versions are pruned after each successful save,
+    never before the new version is durably published.
+
+    Counters (lifetime, host-side):
+    - ``saves`` — versions successfully published;
+    - ``restore_fallbacks`` — corrupt versions skipped during loads
+      (a load that falls back N versions counts N; a load that finds
+      NOTHING verifiable among existing versions counts them all);
+    - ``gc_removed`` — version directories pruned by keep-last-K.
+
+    ``flight_recorder`` (serving/tracing.FlightRecorder, optional):
+    every fallback and failed restore lands as a recorded event so a
+    post-mortem shows *which* version was skipped and why.
+    """
+
+    def __init__(self, root, *, keep_last=3, flight_recorder=None,
+                 now_fn=None):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.root = str(root)
+        self.keep_last = int(keep_last)
+        self.flight = flight_recorder
+        self._now = now_fn or (lambda: 0.0)
+        self.saves = 0
+        self.restore_fallbacks = 0
+        self.gc_removed = 0
+
+    # ---- paths / versions ----
+    def _tag_dir(self, tag: str) -> str:
+        if not tag or os.sep in tag or tag.startswith("."):
+            raise ValueError(f"bad artifact tag {tag!r}")
+        return os.path.join(self.root, tag)
+
+    def versions(self, tag: str) -> list:
+        """Published version numbers, ascending. Unpublished temp dirs
+        (crashed writers) are invisible here by construction."""
+        d = self._tag_dir(tag)
+        if not os.path.isdir(d):
+            return []
+        out = []
+        for name in os.listdir(d):
+            if name.startswith("v") and not name.startswith(".tmp"):
+                try:
+                    out.append(int(name[1:]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _vdir(self, tag: str, version: int) -> str:
+        return os.path.join(self._tag_dir(tag), _VERSION_FMT.format(version))
+
+    # ---- save ----
+    def save(self, tag: str, arrays: dict, meta: dict | None = None) -> int:
+        """Publish one new version atomically; returns its number.
+
+        ``arrays`` is a flat ``{name: ndarray-like}`` payload (callers
+        flatten trees with '/'-joined keys); ``meta`` is any JSON-able
+        blob, stored in the manifest and returned verbatim by ``load``.
+        """
+        arrs = {}
+        for k, v in arrays.items():
+            a = np.asarray(v)
+            if a.dtype == object:
+                raise TypeError(f"leaf {k!r} is not a numeric array")
+            arrs[k] = a
+        version = (self.versions(tag)[-1] + 1) if self.versions(tag) else 1
+        tag_dir = self._tag_dir(tag)
+        os.makedirs(tag_dir, exist_ok=True)
+        tmp = os.path.join(
+            tag_dir, f".tmp-{_VERSION_FMT.format(version)}-{os.getpid()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            buf = _io.BytesIO()
+            np.savez(buf, **arrs)
+            payload = buf.getvalue()
+            ppath = os.path.join(tmp, PAYLOAD)
+            with open(ppath, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            manifest = {
+                "format": 1,
+                "version": version,
+                "meta": meta if meta is not None else {},
+                "leaves": {
+                    k: {"shape": list(a.shape), "dtype": str(a.dtype),
+                        "crc32": crc32_bytes(a.tobytes())}
+                    for k, a in arrs.items()},
+                "files": {PAYLOAD: {"size": len(payload),
+                                    "crc32": crc32_bytes(payload)}},
+            }
+            mbytes = json.dumps(manifest, indent=1, sort_keys=True) \
+                .encode("utf-8") + b"\n"
+            mpath = os.path.join(tmp, MANIFEST)
+            with open(mpath, "wb") as f:
+                f.write(mbytes)
+                f.flush()
+                os.fsync(f.fileno())
+            fsync_dir(tmp)
+            final = self._vdir(tag, version)
+            os.rename(tmp, final)       # THE publication point
+            fsync_dir(tag_dir)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self.saves += 1
+        self._gc(tag)
+        self._sweep_tmp(tag)
+        return version
+
+    def _gc(self, tag: str):
+        """Prune all but the newest ``keep_last`` published versions.
+        Runs AFTER publication, so the newest verified version can
+        never be deleted — there is always at least one survivor."""
+        vs = self.versions(tag)
+        for v in vs[:-self.keep_last]:
+            shutil.rmtree(self._vdir(tag, v), ignore_errors=True)
+            self.gc_removed += 1
+
+    def _sweep_tmp(self, tag: str):
+        """Remove unpublished temp directories left by crashed writers
+        — they were never visible to readers, so removal is always
+        safe. Our own in-flight temp is gone by the time this runs."""
+        d = self._tag_dir(tag)
+        for name in os.listdir(d):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(d, name), ignore_errors=True)
+
+    # ---- load ----
+    def _verify(self, tag: str, version: int) -> LoadResult:
+        """Read + verify ONE version end to end; raises
+        :class:`ArtifactCorrupt` naming what failed."""
+        vdir = self._vdir(tag, version)
+        mpath = os.path.join(vdir, MANIFEST)
+        try:
+            with open(mpath, "rb") as f:
+                manifest = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError, UnicodeDecodeError) as e:
+            raise ArtifactCorrupt(
+                f"{tag} v{version}: manifest unreadable "
+                f"({type(e).__name__}: {e})")
+        if not isinstance(manifest, dict) or "leaves" not in manifest \
+                or "files" not in manifest:
+            raise ArtifactCorrupt(
+                f"{tag} v{version}: manifest incomplete (torn write)")
+        for fname, rec in manifest["files"].items():
+            fpath = os.path.join(vdir, fname)
+            try:
+                with open(fpath, "rb") as f:
+                    data = f.read()
+            except OSError as e:
+                raise ArtifactCorrupt(
+                    f"{tag} v{version}: payload {fname} missing ({e})")
+            if len(data) != rec["size"]:
+                raise ArtifactCorrupt(
+                    f"{tag} v{version}: {fname} truncated "
+                    f"({len(data)} != {rec['size']} bytes)")
+            if crc32_bytes(data) != rec["crc32"]:
+                raise ArtifactCorrupt(
+                    f"{tag} v{version}: {fname} checksum mismatch")
+        try:
+            with np.load(os.path.join(vdir, PAYLOAD)) as z:
+                arrays = {k: z[k] for k in z.files}
+        except Exception as e:
+            raise ArtifactCorrupt(
+                f"{tag} v{version}: payload unparseable "
+                f"({type(e).__name__}: {e})")
+        leaves = manifest["leaves"]
+        if set(arrays) != set(leaves):
+            raise ArtifactCorrupt(
+                f"{tag} v{version}: payload leaves "
+                f"{sorted(set(arrays) ^ set(leaves))} disagree with "
+                f"manifest")
+        for k, a in arrays.items():
+            rec = leaves[k]
+            if list(a.shape) != rec["shape"] \
+                    or str(a.dtype) != rec["dtype"] \
+                    or crc32_bytes(a.tobytes()) != rec["crc32"]:
+                raise ArtifactCorrupt(
+                    f"{tag} v{version}: leaf {k!r} failed verification")
+        return LoadResult(arrays=arrays, meta=manifest.get("meta", {}),
+                          version=version)
+
+    def load(self, tag: str) -> LoadResult | None:
+        """Newest version that verifies, falling back over corrupt ones
+        (each fallback counted + flight-recorded). None when the tag
+        has no versions at all (a clean cold start) OR when every
+        existing version is corrupt (``restore_fallbacks`` then counts
+        them all — the caller distinguishes via ``versions(tag)``)."""
+        vs = self.versions(tag)
+        fallbacks = 0
+        corrupt = []
+        for v in reversed(vs):
+            try:
+                res = self._verify(tag, v)
+            except ArtifactCorrupt as e:
+                fallbacks += 1
+                corrupt.append({"version": v, "reason": str(e)})
+                self.restore_fallbacks += 1
+                if self.flight is not None:
+                    self.flight.record("storage_fallback", self._now(),
+                                       tag=tag, version=v, reason=str(e))
+                continue
+            res.fallbacks = fallbacks
+            res.corrupt_versions = corrupt
+            return res
+        if vs and self.flight is not None:
+            self.flight.record("storage_restore_failed", self._now(),
+                               tag=tag, versions_tried=len(vs))
+        return None
+
+
+# ----------------------------------------------------------------------
+# training-state capture: the kill-and-resume payload
+# ----------------------------------------------------------------------
+def _flatten(tree, prefix=""):
+    flat = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key + "/"))
+        else:
+            flat[key] = v
+    return flat
+
+
+def capture_training_state(*, model=None, optimizer=None, scaler=None,
+                           rng=True, cursor=None) -> tuple:
+    """Snapshot the FULL training state as (arrays, meta) for
+    :meth:`ArtifactStore.save`.
+
+    - ``model``: a Layer (or hapi Model) — its ``state_dict`` leaves;
+    - ``optimizer``: its ``state_dict`` — the fused engine's flat
+      buckets are synced into per-param state first
+      (optimizer/fused.py ``sync_to_param_state``), so the bucketed
+      and per-param layouts serialize identically and a resumed run
+      rebuilds its buckets from the restored values;
+    - ``scaler``: an ``amp.GradScaler``/``AmpScaler`` (scalar knobs ride
+      the meta blob);
+    - ``rng``: the global eager-RNG stream (seed + fold-in counter,
+      core/random.py) — the resumed process replays the exact key
+      sequence the killed one would have drawn;
+    - ``cursor``: caller blob (epoch / step-in-epoch / global step —
+      the data-loader position).
+    """
+    from ..core.tensor import Tensor
+
+    arrays: dict = {}
+    meta: dict = {"format": 1, "cursor": cursor or {}}
+    net = getattr(model, "network", model)
+    if net is not None:
+        for k, v in _flatten(net.state_dict()).items():
+            arrays[f"model/{k}"] = np.asarray(
+                v._data if isinstance(v, Tensor) else v)
+    if optimizer is not None:
+        opt_state = optimizer.state_dict()
+        opt_meta = {}
+        # per-param state is keyed POSITIONALLY (p0/p1/...), not by
+        # parameter NAME: auto-generated names embed a process-global
+        # counter, so a resumed process's identically-built model gets
+        # different names and a name-keyed restore would silently match
+        # nothing — zeroed moments masquerading as a clean resume
+        by_name = {}
+        for i, p in enumerate(optimizer._parameter_list):
+            by_name[p.name] = f"p{i}"
+        for k, v in opt_state.items():
+            slot = None
+            if isinstance(k, str) and "." in k:
+                pname, suffix = k.rsplit(".", 1)
+                if pname in by_name:
+                    slot = f"{by_name[pname]}.{suffix}"
+            if slot is not None and (isinstance(v, Tensor)
+                                     or hasattr(v, "shape")):
+                arrays[f"opt/{slot}"] = np.asarray(
+                    v._data if isinstance(v, Tensor) else v)
+            elif isinstance(v, Tensor) or (hasattr(v, "shape")
+                                           and np.asarray(v).shape != ()):
+                arrays[f"opt/{k}"] = np.asarray(
+                    v._data if isinstance(v, Tensor) else v)
+            else:
+                opt_meta[k] = v          # step count / LR_Scheduler dict
+        meta["optimizer"] = opt_meta
+    if scaler is not None and hasattr(scaler, "state_dict"):
+        meta["scaler"] = scaler.state_dict()
+    if rng:
+        from ..core import random as _rng
+        meta["rng"] = _rng.get_rng_state()
+    return arrays, meta
+
+
+def restore_training_state(res: LoadResult, *, model=None, optimizer=None,
+                           scaler=None, rng=True) -> dict:
+    """Inverse of :func:`capture_training_state` over a verified
+    :class:`LoadResult`; returns the cursor blob."""
+    from ..core.tensor import Tensor
+
+    net = getattr(model, "network", model)
+    if net is not None:
+        state = {k[len("model/"):]: v for k, v in res.arrays.items()
+                 if k.startswith("model/")}
+        net.set_state_dict(state)
+    if optimizer is not None:
+        opt_state = dict(res.meta.get("optimizer", {}))
+        # map the positional p{i} slots back onto the TARGET optimizer's
+        # current parameter names (see capture_training_state: names are
+        # process-global counters, positions are the stable identity)
+        names = [p.name for p in optimizer._parameter_list]
+        for k, v in res.arrays.items():
+            if not k.startswith("opt/"):
+                continue
+            key = k[len("opt/"):]
+            if "." in key and key.split(".", 1)[0].startswith("p"):
+                slot, suffix = key.split(".", 1)
+                try:
+                    idx = int(slot[1:])
+                except ValueError:
+                    idx = None
+                if idx is not None and idx < len(names):
+                    key = f"{names[idx]}.{suffix}"
+            opt_state[key] = Tensor(v)
+        optimizer.set_state_dict(opt_state)
+    if scaler is not None and "scaler" in res.meta \
+            and hasattr(scaler, "load_state_dict"):
+        scaler.load_state_dict(res.meta["scaler"])
+    if rng and "rng" in res.meta:
+        from ..core import random as _rng
+        _rng.set_rng_state(res.meta["rng"])
+    return dict(res.meta.get("cursor", {}))
+
+
+__all__ = ["ArtifactCorrupt", "ArtifactStore", "LoadResult",
+           "atomic_write_bytes", "capture_training_state", "crc32_bytes",
+           "crc32_file", "fsync_dir", "restore_training_state"]

@@ -1,0 +1,107 @@
+"""Seeded storage-fault injection for the crash-consistent store.
+
+serving/faults.py makes fleet failures *data*; this module does the
+same for disk failures: each :data:`KINDS` entry is one way a real
+filesystem tears, truncates, or rots an artifact version, applied
+surgically to an :class:`~paddle_tpu.io.persist.ArtifactStore` version
+directory so tests (tests/test_persistence.py) and the proxy bench's
+``--corrupt-checkpoint`` hook can prove every failure mode degrades to
+the last good version — counter + flight-recorder event, never a hang
+and never silently-wrong bytes.
+
+Fault kinds:
+
+- ``truncate_payload`` — the payload npz loses its tail (power loss
+  mid-write on a non-atomic writer; size check catches it);
+- ``flip_byte`` — one payload byte flips (bit rot / bad DMA; crc32
+  catches it);
+- ``delete_payload`` — the payload file is gone, manifest intact
+  (partial rsync / manual meddling);
+- ``truncate_manifest`` — the manifest JSON is cut mid-object (torn
+  metadata write; parse failure catches it);
+- ``delete_manifest`` — manifest gone entirely;
+- ``partial_version`` — a NEWER version directory appears containing
+  only a payload, no manifest — the torn multi-file publication an
+  atomic renamer can never produce itself, planted to prove the reader
+  rejects it anyway.
+
+The injector is seeded: which byte flips / where a truncation lands is
+a pure function of the seed, so a corrupted-run report is as
+reproducible as a clean one.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .persist import MANIFEST, PAYLOAD, _VERSION_FMT
+
+KINDS = ("truncate_payload", "flip_byte", "delete_payload",
+         "truncate_manifest", "delete_manifest", "partial_version")
+
+
+class StorageFaultInjector:
+    """Applies one seeded fault to a store's version directory."""
+
+    def __init__(self, seed=0):
+        self._rng = np.random.default_rng(seed)
+
+    def corrupt(self, store, tag, kind, version=None) -> dict:
+        """Corrupt ``version`` (default: the newest published one) of
+        ``store``'s ``tag`` with ``kind``; returns a description of the
+        damage for the test/report artifact."""
+        if kind not in KINDS:
+            raise ValueError(f"fault kind must be one of {KINDS}, "
+                             f"got {kind!r}")
+        vs = store.versions(tag)
+        if not vs:
+            raise ValueError(f"no versions of {tag!r} to corrupt")
+        v = vs[-1] if version is None else version
+        vdir = store._vdir(tag, v)
+        detail = {"tag": tag, "version": v, "kind": kind}
+        if kind == "partial_version":
+            # plant a torn NEWER version: payload only, no manifest
+            nv = vs[-1] + 1
+            nd = store._vdir(tag, nv)
+            os.makedirs(nd, exist_ok=True)
+            src = os.path.join(vdir, PAYLOAD)
+            with open(src, "rb") as f:
+                data = f.read()
+            cut = max(1, int(len(data)
+                             * float(self._rng.uniform(0.2, 0.8))))
+            with open(os.path.join(nd, PAYLOAD), "wb") as f:
+                f.write(data[:cut])
+            detail["planted_version"] = nv
+            return detail
+        target = MANIFEST if "manifest" in kind else PAYLOAD
+        path = os.path.join(vdir, target)
+        if kind in ("delete_payload", "delete_manifest"):
+            os.remove(path)
+            return detail
+        with open(path, "rb") as f:
+            data = f.read()
+        if kind in ("truncate_payload", "truncate_manifest"):
+            cut = max(1, int(len(data) * float(self._rng.uniform(0.2, 0.8))))
+            data = data[:cut]
+            detail["truncated_to"] = cut
+        elif kind == "flip_byte":
+            i = int(self._rng.integers(0, len(data)))
+            data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+            detail["flipped_offset"] = i
+        with open(path, "wb") as f:
+            f.write(data)
+        return detail
+
+    def corrupt_all(self, store, tag, kind="flip_byte") -> list:
+        """Corrupt EVERY published version of ``tag`` — the no-good-
+        version-left scenario that must still end in a structured cold
+        start, never an exception out of the consumer."""
+        if kind == "partial_version":
+            raise ValueError("partial_version plants ONE torn version; "
+                             "use a per-version kind for corrupt_all")
+        return [self.corrupt(store, tag, kind, version=v)
+                for v in store.versions(tag)]
+
+
+__all__ = ["KINDS", "StorageFaultInjector"]

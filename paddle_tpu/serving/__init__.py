@@ -32,8 +32,8 @@ from .kv_cache import (InvariantViolation, PagedKVPool,  # noqa: F401
 from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
                         Sequence, SequenceStatus, StepPlan, bucket_for)
 from .spec_decode import DraftWorker, speculative_sample  # noqa: F401
-from .engine import (LLMEngine, Request, RequestOutput,  # noqa: F401
-                     RequestRejected)
+from .engine import (LLMEngine, PrefixStoreMismatch,  # noqa: F401
+                     Request, RequestOutput, RequestRejected)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       percentile_of)
 from .faults import (FaultEvent, FaultSchedule,  # noqa: F401
@@ -48,7 +48,8 @@ __all__ = ["BurstPlan", "ClusterEngine", "DegradationLadder",
            "FlightRecorder", "Histogram",
            "InjectedFault", "InvariantViolation", "LLMEngine",
            "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
-           "PoolExhausted", "NULL_PAGE", "ReplicaState", "RequestTracer",
+           "PoolExhausted", "PrefixStoreMismatch", "NULL_PAGE",
+           "ReplicaState", "RequestTracer",
            "Scheduler",
            "SchedulerConfig", "Sequence", "SequenceStatus", "StepPlan",
            "ServingMetrics", "bucket_for", "latency_breakdown",

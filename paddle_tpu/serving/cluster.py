@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import enum
 import itertools
+import os
 import random
 import time
 from collections import deque
@@ -91,7 +92,14 @@ _CARRIED_COUNTERS = ("tokens_generated", "finished_requests", "prefills",
                      "preemptions", "shed_requests", "deadline_aborts",
                      "nonfinite_rows", "degradation_escalations",
                      "degradation_restorations", "host_dispatches",
-                     "flight_dumps")
+                     "flight_dumps",
+                     # persistence (io/persist.py): how often this
+                     # replica's restores degraded, warm-reloaded
+                     # chains, and persisted pin-set snapshots — a
+                     # crashed engine's warm-restart story must survive
+                     # into the fleet report like every other counter
+                     "restore_fallbacks", "prefix_chains_restored",
+                     "prefix_store_saves")
 
 
 class DegradationLadder:
@@ -273,7 +281,7 @@ class ClusterEngine:
                  recovery_steps=2, crash_after_flaky=3,
                  crash_recover_s=None, faults: FaultSchedule | None = None,
                  ladder=True, ladder_kw=None, tracer=None,
-                 flight_capacity=256, **engine_kw):
+                 flight_capacity=256, prefix_store=None, **engine_kw):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
@@ -305,6 +313,20 @@ class ClusterEngine:
         self._engine_kw = dict(engine_kw)
         self._engine_kw["tracer"] = tracer
         self._engine_kw["flight_recorder"] = self.flight
+        # persistent prefix store (io/persist.py): ONE ArtifactStore
+        # shared by every replica (and every RECOVERY rebuild), wired
+        # to the fleet flight recorder — a path becomes a store here so
+        # storage fallbacks land in the fleet post-mortem ring, and a
+        # crashed replica's successor warm-reloads the chains its
+        # predecessor (or any cohort-mate replica) persisted.
+        if prefix_store is not None:
+            if isinstance(prefix_store, (str, os.PathLike)):
+                from ..io.persist import ArtifactStore
+                prefix_store = ArtifactStore(
+                    prefix_store, flight_recorder=self.flight,
+                    now_fn=self._now)
+            self._engine_kw["prefix_store"] = prefix_store
+        self.prefix_store = self._engine_kw.get("prefix_store")
         self._ladder_on = ladder
         self._ladder_kw = dict(ladder_kw or {})
         #: seeded router stream: power-of-two-choices candidate draws

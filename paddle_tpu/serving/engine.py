@@ -54,6 +54,7 @@ argmax-equality on greedy rows).
 from __future__ import annotations
 
 import itertools
+import os
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -70,6 +71,25 @@ from .metrics import ServingMetrics
 from .scheduler import Scheduler, SchedulerConfig, Sequence, SequenceStatus
 from .spec_decode import (FINAL_TAG, _ragged_fp_layer, _ragged_packing,
                           speculative_sample)
+
+
+class PrefixStoreMismatch(ValueError):
+    """A persisted prefix store cannot feed the live pool: the stored
+    geometry/dtype disagrees with the engine's. This is an OPERATOR
+    error (pointing a differently-configured engine at an old store),
+    not corruption — so unlike a corrupt store (which cold-starts with
+    a counter), it raises, carrying BOTH configs so the drift is
+    diagnosable from the exception alone."""
+
+    def __init__(self, live_config, stored_config):
+        self.live_config = dict(live_config)
+        self.stored_config = dict(stored_config)
+        drift = {k for k in set(live_config) | set(stored_config)
+                 if live_config.get(k) != stored_config.get(k)}
+        super().__init__(
+            f"prefix store does not match the live KV pool "
+            f"(drifted: {sorted(drift)}): live={self.live_config} "
+            f"stored={self.stored_config}")
 
 
 class RequestRejected(ValueError):
@@ -246,7 +266,8 @@ class LLMEngine:
                  draft_quantized_mode="weight_only_int4",
                  draft_num_pages=None, mesh=None, tracer=None,
                  flight_recorder=None, flight_capacity=256,
-                 engine_id=None, gauge_stale_after_s=None):
+                 engine_id=None, gauge_stale_after_s=None,
+                 prefix_store=None, prefix_store_autosave=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -462,6 +483,28 @@ class LLMEngine:
         #: capped alongside _prefix_cache; entries whose chain the pool
         #: evicted fail ``is_pinned`` and are pruned on probe.
         self._pinned_index: dict[tuple, tuple[tuple, int]] = {}
+        # persistent cross-restart prefix store (io/persist.py): pinned
+        # prefix chains — pages, int8 scales, and the token-chain index
+        # — survive process death. Construction WARM-RELOADS whatever
+        # the store holds (corrupt/missing degrades to a cold start with
+        # a restore_fallbacks count + flight event, never an exception;
+        # a geometry/dtype drift raises PrefixStoreMismatch); afterwards
+        # every pin-set change re-persists the chains (autosave), so a
+        # crashed replica's successor re-forks fleet-wide shared system
+        # prompts instead of paying the re-prefill TTFT cliff.
+        self.prefix_store = None
+        self._prefix_autosave = False
+        self._prefix_store_sig = frozenset()
+        if prefix_store is not None:
+            if isinstance(prefix_store, (str, os.PathLike)):
+                from ..io.persist import ArtifactStore
+                prefix_store = ArtifactStore(
+                    prefix_store, flight_recorder=self.flight,
+                    now_fn=self._now)
+            self.prefix_store = prefix_store
+            self._prefix_autosave = True if prefix_store_autosave is None \
+                else bool(prefix_store_autosave)
+            self._restore_prefix_store()
         self._step_launched = False
         self._burst_launched = False
         self._build_step()
@@ -1120,6 +1163,128 @@ class LLMEngine:
                     self._pinned_index[key] = (chain, j)
                 while len(self._pinned_index) > self.prefix_cache_size:
                     self._pinned_index.pop(next(iter(self._pinned_index)))
+            if self._prefix_autosave:
+                # write-ahead warm-start discipline: the pin set changed
+                # (or an eviction shifted it) — persist the chains NOW,
+                # because a crash never schedules a save first.
+                # save_prefix_store no-ops when membership is unchanged.
+                self.save_prefix_store()
+
+    # ---- persistent prefix store (io/persist.py) ----
+    PREFIX_STORE_TAG = "prefix_store"
+
+    def export_prefix_store(self):
+        """Serialize the pool's pinned chains + the engine's token-chain
+        index as an (arrays, meta) pair for
+        :meth:`~paddle_tpu.io.persist.ArtifactStore.save`. Chain ids at
+        the engine level ARE the token tuples, so the index restores
+        content-addressed — no donor liveness to re-validate."""
+        chains = self.pool.export_pinned()
+        arrays = {}
+        meta_chains = []
+        for ci, ch in enumerate(chains):
+            for li, ent in enumerate(ch["layers"]):
+                for part, arr in ent.items():
+                    arrays[f"c{ci}/L{li}/{part}"] = arr
+            meta_chains.append({"tokens": [int(t) for t in ch["chain_id"]],
+                                "num_tokens": int(ch["num_tokens"])})
+        meta = {"format": 1, "config": self.pool.config(),
+                "chains": meta_chains}
+        return arrays, meta
+
+    def save_prefix_store(self) -> bool:
+        """Persist the current pinned-chain set (atomic, versioned,
+        checksummed). No-op without a store or without pins changed
+        since the last save. Counted on ``prefix_store_saves``.
+
+        Cost: one device->host copy + npz write of EVERY pinned chain —
+        O(pinned bytes), bounded by ``pinned_prefix_pages`` (pin churn
+        amortizes through the membership-signature dedup). Deployments
+        with large pin budgets under heavy churn should construct with
+        ``prefix_store_autosave=False`` and call this explicitly at
+        drain/idle boundaries instead."""
+        if self.prefix_store is None:
+            return False
+        sig = frozenset(self.pool._pins)
+        if sig == self._prefix_store_sig:
+            return False
+        arrays, meta = self.export_prefix_store()
+        self.prefix_store.save(self.PREFIX_STORE_TAG, arrays, meta)
+        self._prefix_store_sig = sig
+        self.metrics.prefix_store_saves.inc()
+        return True
+
+    def _restore_prefix_store(self):
+        """Warm-reload pinned chains at construction. Failure ladder:
+        geometry/dtype drift raises :class:`PrefixStoreMismatch`
+        (operator error); everything else — no store yet, every version
+        corrupt, a chain that no longer fits the budget — degrades to a
+        cold start with the ``restore_fallbacks`` counter and a flight-
+        recorder event. Silent wrong KV bytes are impossible: data
+        arrives checksum-verified or not at all."""
+        store = self.prefix_store
+        tag = self.PREFIX_STORE_TAG
+        res = store.load(tag)
+        if res is None:
+            if store.versions(tag):
+                # versions exist but none verified: a real loss, not a
+                # first boot — count it and leave a post-mortem trail
+                self.metrics.restore_fallbacks.inc()
+                self.record_fleet_event(
+                    "prefix_restore_fallback", reason="all_corrupt",
+                    versions=len(store.versions(tag)))
+            return
+        if res.fallbacks:
+            # a newer version was torn/corrupt and an older one served:
+            # the warm start still happens, but the loss is visible
+            self.metrics.restore_fallbacks.inc(res.fallbacks)
+            self.record_fleet_event(
+                "prefix_restore_fallback", reason="stale_version",
+                served_version=res.version, skipped=res.fallbacks)
+        live = self.pool.config()
+        stored = dict(res.meta.get("config", {}))
+        if stored != live:
+            raise PrefixStoreMismatch(live, stored)
+        restored = 0
+        for ci, ch in enumerate(res.meta.get("chains", [])):
+            tokens = tuple(int(t) for t in ch["tokens"])
+            n = int(ch["num_tokens"])
+            layers = []
+            try:
+                for li in range(self.pool.num_layers):
+                    ent = {"K": res.arrays[f"c{ci}/L{li}/K"],
+                           "V": res.arrays[f"c{ci}/L{li}/V"]}
+                    if self.pool.quantized:
+                        ent["Ks"] = res.arrays[f"c{ci}/L{li}/Ks"]
+                        ent["Vs"] = res.arrays[f"c{ci}/L{li}/Vs"]
+                    layers.append(ent)
+            except KeyError:
+                # manifest verified, so a missing leaf means the chain
+                # was saved under a different pool mode (fp chains into
+                # an int8 pool slips past the config gate only when the
+                # configs were hand-edited) — skip it, count it
+                self.metrics.restore_fallbacks.inc()
+                continue
+            try:
+                ok = self.pool.restore_pinned_chain(tokens, n, layers)
+            except ValueError as e:
+                raise PrefixStoreMismatch(
+                    live, dict(stored, chain_error=str(e)))
+            if not ok:
+                continue                 # over budget: cache, not demand
+            ps = self.page_size
+            for j in range(ps, n + 1, ps):
+                key = tokens[:j]
+                self._pinned_index.pop(key, None)
+                self._pinned_index[key] = (tokens, j)
+            restored += 1
+        while len(self._pinned_index) > self.prefix_cache_size:
+            self._pinned_index.pop(next(iter(self._pinned_index)))
+        if restored:
+            self.metrics.prefix_chains_restored.inc(restored)
+            self.record_fleet_event("prefix_restore", chains=restored,
+                                    version=res.version)
+        self._prefix_store_sig = frozenset(self.pool._pins)
 
     def _prefix_probe(self, seq: Sequence) -> int:
         """Admission hook: longest registered chain matching the prompt

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _copy_pages(kv, old_idx, new_idx):
@@ -547,6 +548,107 @@ class PagedKVPool:
 
     def is_pinned(self, chain_id) -> bool:
         return chain_id in self._pins
+
+    # ---- persistence (io/persist.py prefix store) ----
+    def config(self) -> dict:
+        """Geometry/dtype signature a persisted prefix chain must match
+        to be restorable — the two sides of a restore-mismatch error."""
+        return {"num_layers": self.num_layers,
+                "num_kv_heads": self.num_kv_heads,
+                "head_dim": self.head_dim,
+                "page_size": self.page_size,
+                "dtype": str(self.dtype)}
+
+    def export_pinned(self) -> list:
+        """Serialize every pinned chain's page data, LRU order (oldest
+        first, so a restore under a smaller budget keeps the hottest
+        chains last-written): per chain, per layer, the K/V page blocks
+        ``[Hkv, n_pages, page_size, head_dim]`` (plus the per-(head,
+        page) scale columns for int8 pools) as host numpy arrays."""
+        out = []
+        for cid, (pages, num_tokens) in self._pins.items():
+            idx = jnp.asarray(pages, jnp.int32)
+            layers = []
+            for li, (K, V) in enumerate(self.kv):
+                ent = {"K": np.asarray(K[:, idx]),
+                       "V": np.asarray(V[:, idx])}
+                if self.kv_scales is not None:
+                    Ks, Vs = self.kv_scales[li]
+                    ent["Ks"] = np.asarray(Ks[:, idx])
+                    ent["Vs"] = np.asarray(Vs[:, idx])
+                layers.append(ent)
+            out.append({"chain_id": cid, "num_tokens": num_tokens,
+                        "layers": layers})
+        return out
+
+    def restore_pinned_chain(self, chain_id, num_tokens, layers) -> bool:
+        """Materialize a persisted chain back into the pool as a pinned
+        prefix: claim fresh pages, write each layer's K/V blocks (and
+        int8 scale columns) into them, and register the pin — the warm-
+        restart inverse of :meth:`export_pinned`. Returns False (and
+        touches nothing) when the chain cannot fit (zero budget, chain
+        alone over budget, or no free pages even after LRU eviction);
+        raises ``ValueError`` on geometry violations (the engine wraps
+        shape/dtype drift in its structured mismatch error before this
+        layer ever sees it)."""
+        if num_tokens % self.page_size != 0:
+            raise ValueError(
+                f"restored chains must be page-aligned: {num_tokens} "
+                f"tokens over page_size {self.page_size}")
+        n_pages = num_tokens // self.page_size
+        if n_pages < 1 or n_pages > self.pinned_page_budget:
+            return False
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"restored chain has {len(layers)} layers, pool has "
+                f"{self.num_layers}")
+        # feasibility BEFORE any mutation: eviction only ever recycles
+        # pin-exclusive pages, so free + evictable bounds what a restore
+        # can claim — deciding now keeps the touches-nothing contract
+        # honest for post-init callers on a busy pool (evicting first
+        # and then failing would have destroyed the warm cache for
+        # nothing; at engine construction free pages alone suffice)
+        if n_pages > len(self._free) + self.evictable_pages:
+            return False
+        if chain_id in self._pins:
+            self.unpin(chain_id)
+        while self.pinned_pages + n_pages > self.pinned_page_budget \
+                and self._pins:
+            self.unpin(next(iter(self._pins)))
+            self.pin_evictions += 1
+        # _claim's _ensure_free evicts further LRU chains if the budget
+        # evictions freed too little; the upfront bound guarantees it
+        # succeeds
+        pages = self._claim(n_pages, f"restore pinned chain ({n_pages} "
+                                     f"pages)")
+        idx = jnp.asarray(pages, jnp.int32)
+        new_kv = []
+        for li, ((K, V), ent) in enumerate(zip(self.kv, layers)):
+            k = jnp.asarray(ent["K"], self.dtype)
+            v = jnp.asarray(ent["V"], self.dtype)
+            want = (self.num_kv_heads, n_pages, self.page_size,
+                    self.head_dim)
+            if tuple(k.shape) != want or tuple(v.shape) != want:
+                # roll the claim back before raising: a failed restore
+                # must leave the pool exactly as it found it
+                for p in pages:
+                    self._refcounts[p] = 0
+                self._free.extend(reversed(pages))
+                raise ValueError(
+                    f"restored chain layer {li}: block shape "
+                    f"{tuple(k.shape)} != pool {want}")
+            new_kv.append((K.at[:, idx].set(k), V.at[:, idx].set(v)))
+        self.kv = new_kv
+        if self.kv_scales is not None:
+            self.kv_scales = [
+                (Ks.at[:, idx].set(jnp.asarray(ent["Ks"], jnp.float32)),
+                 Vs.at[:, idx].set(jnp.asarray(ent["Vs"], jnp.float32)))
+                for (Ks, Vs), ent in zip(self.kv_scales, layers)]
+        self._repin()
+        for p in pages:
+            self._pin_counts[p] = self._pin_counts.get(p, 0) + 1
+        self._pins[chain_id] = (list(pages), num_tokens)
+        return True
 
     def touch_pin(self, chain_id):
         """Refresh a chain's LRU recency (a probe hit keeps it hot)."""

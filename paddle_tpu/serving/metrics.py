@@ -228,7 +228,15 @@ class ServingMetrics:
                 # observability (PR 12): flight-recorder post-mortem
                 # dumps taken (InvariantViolation / nonfinite abort /
                 # replica crash auto-dumps + any operator-requested one)
-                "flight_dumps")
+                "flight_dumps",
+                # crash-consistent persistence (io/persist.py): degraded
+                # restores — a corrupt/unusable persisted artifact fell
+                # back to an older version or to a cold start instead of
+                # loading bad bytes; pinned prefix chains warm-reloaded
+                # from the store at engine construction; pin-set
+                # snapshots persisted (the write-ahead warm-start path)
+                "restore_fallbacks", "prefix_chains_restored",
+                "prefix_store_saves")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",

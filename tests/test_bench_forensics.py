@@ -110,7 +110,13 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # observations — a stale round proves nothing here
               "telemetry_deterministic", "telemetry_scrape_samples",
               "telemetry_alerts_fired", "telemetry_alerts_resolved",
-              "telemetry_decode_compiles"):
+              "telemetry_decode_compiles",
+              # crash-consistent persistence fields (ISSUE 14): a
+              # resume-identity verdict, restore fallback count, warm-
+              # hit count or save/restore timing is a per-run proof
+              "persist_resume_identical", "persist_restore_fallbacks",
+              "persist_warm_prefix_hits", "persist_ckpt_save_ms",
+              "persist_ckpt_restore_ms"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -523,3 +529,43 @@ def test_proxy_bench_catches_disabled_burn_alerts():
     assert out["telemetry_deterministic"] is None
     assert out["telemetry_alerts_fired"] is None
     assert "telemetry_probe_error" in out
+
+
+def test_proxy_bench_catches_corrupt_checkpoint():
+    """End-to-end persistence regression injection (ISSUE 14): run the
+    persistence probe with every stored version byte-flipped
+    (--corrupt-checkpoint) and gate against the checked-in baseline —
+    the training resume diverges (identity verdict 0), the prefix
+    restore degrades to a cold start (warm hits 0, fallbacks >= 1),
+    and all three exact gates fail; the healthy collection of the same
+    probe must pass."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("persist",), persist_corrupt=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "persist_resume_identical" in names
+    assert "persist_restore_fallbacks" in names
+    assert "persist_warm_prefix_hits" in names
+    assert bad["metrics"]["persist_resume_identical"] == 0
+    assert bad["metrics"]["persist_warm_prefix_hits"] == 0
+
+    good = pb.collect(probes=("persist",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["persist_resume_identical"] == 1
+    assert good["metrics"]["persist_restore_fallbacks"] == 0
+    assert good["metrics"]["persist_warm_prefix_hits"] >= 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_persistence(Boom())
+    assert out["persist_resume_identical"] is None
+    assert out["persist_warm_prefix_hits"] is None
+    assert "persistence_probe_error" in out

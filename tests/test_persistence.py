@@ -1,0 +1,547 @@
+"""Crash-consistent persistence gates (ISSUE 14).
+
+Three layers under test:
+- io/persist.py ArtifactStore: atomic publication, checksum-verified
+  loads, every injected storage-fault kind falling back to the last
+  good version, keep-last-K GC never touching the newest verified one;
+- deterministic kill-and-resume training: Model.fit checkpoints the
+  full state (params, fused-optimizer buckets, RNG stream, loader
+  cursor) and a killed-at-any-step-boundary run resumes BIT-identically
+  to the unkilled run — incl. accumulate_steps>1 and FLAGS_scan_layers;
+- the persistent pinned-prefix store: a fresh engine warm-reloads
+  pinned chains (fp and int8), serves cohort prompts without
+  re-prefill, degrades to a structured cold start on corruption, and a
+  crashed cluster replica comes back WARM — byte-reproducibly per seed.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.core import random as _rng  # noqa: E402
+from paddle_tpu.core.flags import GLOBAL_FLAGS  # noqa: E402
+from paddle_tpu.hapi.callbacks import Callback  # noqa: E402
+from paddle_tpu.io import (BatchSampler, DataLoader,  # noqa: E402
+                           RandomSampler, WeightedRandomSampler)
+from paddle_tpu.io.persist import (ArtifactStore,  # noqa: E402
+                                   capture_training_state,
+                                   restore_training_state)
+from paddle_tpu.io.storage_faults import (KINDS,  # noqa: E402
+                                          StorageFaultInjector)
+from paddle_tpu.loadgen import (ClusterDriver, VirtualClock,  # noqa: E402
+                                WorkloadSpec, build_cluster_report)
+from paddle_tpu.models import (LlamaForCausalLM,  # noqa: E402
+                               llama_tiny_config)
+from paddle_tpu.serving import (ClusterEngine, FaultEvent,  # noqa: E402
+                                FaultSchedule, LLMEngine,
+                                PrefixStoreMismatch)
+
+
+# ----------------------------------------------------------------------
+# ArtifactStore
+# ----------------------------------------------------------------------
+def _payload(x=0):
+    return ({"a": np.arange(6, dtype=np.float32) + x,
+             "b/c": np.full((2, 3), x, np.int32)},
+            {"marker": int(x)})
+
+
+def test_store_roundtrip_and_versioning(tmp_path):
+    st = ArtifactStore(tmp_path)
+    a1, m1 = _payload(1)
+    assert st.save("t", a1, m1) == 1
+    a2, m2 = _payload(2)
+    assert st.save("t", a2, m2) == 2
+    res = st.load("t")
+    assert res.version == 2 and res.fallbacks == 0
+    assert res.meta["marker"] == 2
+    np.testing.assert_array_equal(res.arrays["a"], a2["a"])
+    np.testing.assert_array_equal(res.arrays["b/c"], a2["b/c"])
+    # empty tag: clean cold start, not a fallback
+    assert st.load("other") is None
+    assert st.restore_fallbacks == 0
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_every_fault_kind_falls_back_to_last_good(tmp_path, kind):
+    st = ArtifactStore(tmp_path)
+    st.save("t", *_payload(1))
+    st.save("t", *_payload(2))
+    StorageFaultInjector(0).corrupt(st, "t", kind)
+    res = st.load("t")
+    assert res is not None, f"{kind}: no version survived"
+    assert res.fallbacks >= 1, f"{kind}: corruption went undetected"
+    # the survivor is the last GOOD version, verified clean
+    # (partial_version PLANTS a torn newer version, so v2 survives)
+    assert res.meta["marker"] == (2 if kind == "partial_version" else 1)
+    assert st.restore_fallbacks == res.fallbacks
+
+
+def test_all_versions_corrupt_returns_none_counts_all(tmp_path):
+    st = ArtifactStore(tmp_path)
+    st.save("t", *_payload(1))
+    st.save("t", *_payload(2))
+    StorageFaultInjector(0).corrupt_all(st, "t", "flip_byte")
+    assert st.load("t") is None
+    assert st.restore_fallbacks == 2
+
+
+def test_keep_last_gc_never_deletes_newest_verified(tmp_path):
+    st = ArtifactStore(tmp_path, keep_last=2)
+    for i in range(5):
+        st.save("t", *_payload(i))
+        vs = st.versions("t")
+        assert len(vs) <= 2
+        # the newest version always verifies after GC ran
+        res = st.load("t")
+        assert res.version == vs[-1] and res.fallbacks == 0
+    assert st.versions("t") == [4, 5]
+    assert st.gc_removed == 3
+
+
+def test_crashed_writer_tmp_dir_is_invisible_and_swept(tmp_path):
+    st = ArtifactStore(tmp_path)
+    st.save("t", *_payload(1))
+    # simulate a writer that died mid-write: unpublished temp dir
+    tmp = os.path.join(st._tag_dir("t"), ".tmp-v00000002-dead")
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, "data.npz"), "wb") as f:
+        f.write(b"torn")
+    assert st.versions("t") == [1]          # invisible to readers
+    assert st.load("t").meta["marker"] == 1
+    st.save("t", *_payload(2))              # next save sweeps it
+    assert not [d for d in os.listdir(st._tag_dir("t"))
+                if d.startswith(".tmp")]
+
+
+# ----------------------------------------------------------------------
+# sharded checkpoint (distributed/checkpoint.py satellite)
+# ----------------------------------------------------------------------
+def test_manifest_checksum_catches_rot(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+    t = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(4, 4))
+    ckpt.save_state_dict({"w": t}, str(tmp_path))
+    # every file was atomically published: no temp leftovers
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp")]
+    mani = json.load(open(tmp_path / "manifest.json"))
+    assert "files" in mani and "shards_0.npz" in mani["files"]
+    # flip one payload byte: load must refuse BEFORE materializing
+    p = tmp_path / "shards_0.npz"
+    data = bytearray(p.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    p.write_bytes(bytes(data))
+    dst = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    with pytest.raises(ValueError, match="checksum"):
+        ckpt.load_state_dict({"w": dst}, str(tmp_path))
+    assert float(dst.numpy().sum()) == 0.0   # nothing was materialized
+
+
+# ----------------------------------------------------------------------
+# deterministic kill-and-resume training
+# ----------------------------------------------------------------------
+class _DS(paddle.io.Dataset):
+    def __init__(self, n=32, d=16):
+        rng = np.random.default_rng(7)
+        self.x = rng.standard_normal((n, d)).astype(np.float32)
+        self.y = rng.standard_normal((n, 1)).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp_model(accumulate_steps=1):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 1))
+    m = paddle.Model(net)
+    m.prepare(paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters()),
+              paddle.nn.MSELoss(), use_jit=True,
+              accumulate_steps=accumulate_steps)
+    return m
+
+
+def _loader(ds, batch_size=4):
+    # resumable shuffling needs the seeded sampler path: epoch e's
+    # permutation is a pure function of (generator seed, e)
+    return DataLoader(ds, batch_sampler=BatchSampler(
+        sampler=RandomSampler(ds, generator=123), batch_size=batch_size))
+
+
+class _Rec(Callback):
+    def __init__(self):
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(logs["loss"]))
+
+
+class _Kill(RuntimeError):
+    pass
+
+
+class _Killer(_Rec):
+    def __init__(self, at):
+        super().__init__()
+        self.at = at
+
+    def on_train_batch_end(self, step, logs=None):
+        super().on_train_batch_end(step, logs)
+        if len(self.losses) >= self.at:
+            raise _Kill()
+
+
+def _kill_and_resume(build, loader_fn, tmp_path, kill_at, epochs=2,
+                     **fit_kw):
+    d = str(tmp_path / f"ckpt_{kill_at}")
+    killer = _Killer(kill_at)
+    try:
+        build().fit(loader_fn(), epochs=epochs, verbose=0,
+                    callbacks=[killer], log_freq=4, checkpoint_dir=d,
+                    checkpoint_freq=1, **fit_kw)
+        raise AssertionError("killer never fired")
+    except _Kill:
+        pass
+    rec = _Rec()
+    build().fit(loader_fn(), epochs=epochs, verbose=0, callbacks=[rec],
+                log_freq=4, checkpoint_dir=d, checkpoint_freq=1,
+                resume=True, **fit_kw)
+    return killer.losses, rec.losses, d
+
+
+def test_kill_at_every_k_steps_resume_bit_identity(tmp_path):
+    """THE tentpole gate: a run killed at ANY step boundary and resumed
+    in a fresh process-equivalent (fresh model/optimizer/TrainStep
+    objects, state restored through the atomic store) produces a loss
+    trajectory BIT-identical to the unkilled run — epoch boundary
+    crossings included."""
+    ds = _DS()
+    rec = _Rec()
+    _mlp_model().fit(_loader(ds), epochs=2, verbose=0, callbacks=[rec],
+                     log_freq=4)
+    straight = rec.losses
+    assert len(straight) == 16
+    for kill_at in (1, 3, 5, 8, 9, 15):       # 8 = exact epoch boundary
+        killed, resumed, _ = _kill_and_resume(
+            _mlp_model, lambda: _loader(ds), tmp_path, kill_at)
+        assert killed == straight[:kill_at]
+        assert killed + resumed == straight, (
+            f"kill at step {kill_at}: resumed trajectory diverged")
+
+
+def test_resume_bit_identity_under_accumulate_steps(tmp_path):
+    ds = _DS()
+    rec = _Rec()
+    _mlp_model(accumulate_steps=2).fit(
+        _loader(ds), epochs=2, verbose=0, callbacks=[rec], log_freq=4)
+    straight = rec.losses
+    killed, resumed, _ = _kill_and_resume(
+        lambda: _mlp_model(accumulate_steps=2), lambda: _loader(ds),
+        tmp_path, 5)
+    assert killed + resumed == straight
+
+
+class _LMDS(paddle.io.Dataset):
+    def __init__(self, n=24, seq=12, vocab=64):
+        rng = np.random.default_rng(11)
+        self.ids = rng.integers(0, vocab, (n, seq)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.ids[i], self.ids[i]
+
+    def __len__(self):
+        return len(self.ids)
+
+
+class _LMLoss(paddle.nn.Layer):
+    def __init__(self, vocab):
+        super().__init__()
+        self.vocab = vocab
+
+    def forward(self, logits, labels):
+        import paddle_tpu.nn.functional as F
+        return F.cross_entropy(
+            logits[:, :-1].reshape((-1, self.vocab)),
+            labels[:, 1:].reshape((-1,)))
+
+
+def test_resume_bit_identity_under_scan_layers(tmp_path):
+    old = bool(GLOBAL_FLAGS.get("scan_layers"))
+    GLOBAL_FLAGS.set("scan_layers", True)
+    try:
+        cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=32,
+                                intermediate_size=64,
+                                num_attention_heads=2,
+                                num_key_value_heads=2, vocab_size=64)
+
+        def build():
+            paddle.seed(0)
+            net = LlamaForCausalLM(cfg)
+            m = paddle.Model(net)
+            m.prepare(paddle.optimizer.AdamW(
+                learning_rate=1e-3, parameters=net.parameters()),
+                _LMLoss(cfg.vocab_size), use_jit=True)
+            return m
+
+        ds = _LMDS()
+        rec = _Rec()
+        build().fit(_loader(ds, batch_size=4), epochs=1, verbose=0,
+                    callbacks=[rec], log_freq=4)
+        straight = rec.losses
+        killed, resumed, _ = _kill_and_resume(
+            build, lambda: _loader(ds, batch_size=4), tmp_path, 3,
+            epochs=1)
+        assert killed + resumed == straight
+    finally:
+        GLOBAL_FLAGS.set("scan_layers", old)
+
+
+def test_resume_falls_back_to_previous_good_checkpoint(tmp_path):
+    """Corrupting the NEWEST checkpoint version must not kill the
+    resume: it falls back one version and replays the last step
+    bit-identically (resumed trajectory == straight from step k-1)."""
+    ds = _DS()
+    rec = _Rec()
+    _mlp_model().fit(_loader(ds), epochs=1, verbose=0, callbacks=[rec],
+                     log_freq=4)
+    straight = rec.losses
+    kill_at = 5
+    d = str(tmp_path / "ckpt")
+    killer = _Killer(kill_at)
+    try:
+        _mlp_model().fit(_loader(ds), epochs=1, verbose=0,
+                         callbacks=[killer], log_freq=4,
+                         checkpoint_dir=d, checkpoint_freq=1)
+    except _Kill:
+        pass
+    StorageFaultInjector(0).corrupt(ArtifactStore(d), "train_state",
+                                    "truncate_payload")
+    resumed = _Rec()
+    _mlp_model().fit(_loader(ds), epochs=1, verbose=0, callbacks=[resumed],
+                     log_freq=4, checkpoint_dir=d, checkpoint_freq=1,
+                     resume=True)
+    # one step replayed (the corrupt newest covered step k; the
+    # fallback restored k-1), every value still bitwise on-trajectory
+    assert resumed.losses == straight[kill_at - 1:]
+
+
+def test_rng_stream_state_roundtrip():
+    import jax
+    _rng.seed(1234)
+    _ = [_rng.next_key() for _ in range(3)]
+    st = _rng.get_rng_state()
+
+    def draw():
+        return np.asarray(jax.random.key_data(_rng.next_key())).tolist()
+
+    expect = [draw() for _ in range(2)]
+    _rng.set_rng_state(st)
+    assert [draw() for _ in range(2)] == expect
+
+
+def test_sampler_epoch_pinning_replays_identical_sequence():
+    w = [0.1, 0.5, 1.0, 2.0, 0.3, 0.7]
+    s1 = WeightedRandomSampler(w, 12, generator=99)
+    epoch0, epoch1 = list(s1), list(s1)     # legacy self-advancing
+    s2 = WeightedRandomSampler(w, 12, generator=99)
+    s2.set_epoch(1)
+    assert list(s2) == epoch1               # resumed epoch == straight
+    s2.set_epoch(0)
+    assert list(s2) == epoch0
+    r1 = RandomSampler(list(range(20)), generator=42)
+    e0, e1 = list(r1), list(r1)
+    r2 = RandomSampler(list(range(20)), generator=42)
+    r2.set_epoch(1)
+    assert list(r2) == e1 and e0 != e1
+    # BatchSampler forwards the pin
+    bs = BatchSampler(sampler=RandomSampler(list(range(20)), generator=42),
+                      batch_size=5)
+    bs.set_epoch(1)
+    assert [i for b in bs for i in b] == e1
+
+
+# ----------------------------------------------------------------------
+# persistent prefix store (serving)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+PREFIX = np.random.default_rng(3).integers(0, 128, (16,)).tolist()
+
+
+def _engine(model, store=None, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("pinned_prefix_pages", 8)
+    return LLMEngine(model, seed=0, prefix_store=store, **kw)
+
+
+def test_warm_restart_serves_pinned_hit(tiny_model, tmp_path):
+    store = str(tmp_path / "store")
+    ea = _engine(tiny_model, store)
+    ea.add_request(PREFIX + [5, 6, 7], max_new_tokens=4)
+    ea.run(max_steps=200)
+    assert ea.metrics.prefix_store_saves.value >= 1
+    eb = _engine(tiny_model, store)
+    assert eb.metrics.prefix_chains_restored.value >= 1
+    assert eb.metrics.restore_fallbacks.value == 0
+    assert eb.pool.pinned_pages >= 2
+    rid = eb.add_request(PREFIX + [9, 10], max_new_tokens=4)
+    eb.run(max_steps=200)
+    # the FIRST cohort prompt on the fresh engine hit the restored
+    # pinned chain — no live donor existed, so this is the store's win
+    assert eb.metrics.pinned_prefix_hits.value >= 1
+    eb.pool.check_invariants()
+    # the restore added zero step executables (trace-count gate holds)
+    # and zero per-step dispatches (host-dispatch gate: one launch per
+    # step, exactly as without a store)
+    assert eb.decode_cache_size() == 1
+    assert eb.metrics.host_dispatches.value == \
+        eb.metrics.decode_steps.value
+    # token identity: warm-restored continuation == cold engine's
+    cold = LLMEngine(tiny_model, seed=0, max_len=64, page_size=8,
+                     max_num_seqs=4)
+    rid_c = cold.add_request(PREFIX + [9, 10], max_new_tokens=4)
+    cold.run(max_steps=200)
+    assert eb.outputs()[rid].token_ids == cold.outputs()[rid_c].token_ids
+
+
+def test_warm_restart_int8_pool_carries_scales(tiny_model, tmp_path):
+    store = str(tmp_path / "store8")
+    kw = dict(kv_cache_dtype="int8")
+    ea = _engine(tiny_model, store, **kw)
+    ea.add_request(PREFIX + [5, 6, 7], max_new_tokens=4)
+    ea.run(max_steps=200)
+    eb = _engine(tiny_model, store, **kw)
+    assert eb.metrics.prefix_chains_restored.value >= 1
+    rid = eb.add_request(PREFIX + [9, 10], max_new_tokens=4)
+    eb.run(max_steps=200)
+    assert eb.metrics.pinned_prefix_hits.value >= 1
+    eb.pool.check_invariants()
+    cold = LLMEngine(tiny_model, seed=0, max_len=64, page_size=8,
+                     max_num_seqs=4, kv_cache_dtype="int8")
+    rid_c = cold.add_request(PREFIX + [9, 10], max_new_tokens=4)
+    cold.run(max_steps=200)
+    assert eb.outputs()[rid].token_ids == cold.outputs()[rid_c].token_ids
+
+
+def test_corrupt_store_cold_starts_with_counter_and_flight_event(
+        tiny_model, tmp_path):
+    store = str(tmp_path / "store")
+    ea = _engine(tiny_model, store)
+    ea.add_request(PREFIX + [5, 6], max_new_tokens=4)
+    ea.run(max_steps=200)
+    StorageFaultInjector(0).corrupt_all(ArtifactStore(store),
+                                        "prefix_store", "flip_byte")
+    eb = _engine(tiny_model, store)      # must NOT raise
+    assert eb.metrics.restore_fallbacks.value >= 1
+    assert eb.metrics.prefix_chains_restored.value == 0
+    assert eb.pool.pinned_pages == 0
+    kinds = [k for _, k, _ in eb.flight.events()]
+    assert "prefix_restore_fallback" in kinds
+    # and the engine still serves
+    eb.add_request(PREFIX + [9], max_new_tokens=2)
+    eb.run(max_steps=200)
+
+
+def test_missing_store_is_clean_cold_start(tiny_model, tmp_path):
+    eb = _engine(tiny_model, str(tmp_path / "never_written"))
+    assert eb.metrics.restore_fallbacks.value == 0
+    assert eb.metrics.prefix_chains_restored.value == 0
+
+
+def test_store_mismatch_raises_structured_error(tiny_model, tmp_path):
+    store = str(tmp_path / "store")
+    ea = _engine(tiny_model, store)
+    ea.add_request(PREFIX + [5, 6], max_new_tokens=4)
+    ea.run(max_steps=200)
+    with pytest.raises(PrefixStoreMismatch) as ei:
+        _engine(tiny_model, store, page_size=16)
+    assert ei.value.live_config["page_size"] == 16
+    assert ei.value.stored_config["page_size"] == 8
+    # dtype drift too: an int8 pool must refuse fp chains
+    with pytest.raises(PrefixStoreMismatch):
+        _engine(tiny_model, store, kv_cache_dtype="int8")
+
+
+def test_restore_respects_smaller_pin_budget(tiny_model, tmp_path):
+    store = str(tmp_path / "store")
+    ea = _engine(tiny_model, store, pinned_prefix_pages=8)
+    for tail in ([5, 6, 7], [8, 9], [10, 11, 12]):
+        ea.add_request(PREFIX + tail, max_new_tokens=4)
+    ea.run(max_steps=300)
+    assert ea.pool.pinned_pages >= 2
+    # a fresh engine with a 2-page budget restores what fits, cleanly
+    eb = _engine(tiny_model, store, pinned_prefix_pages=2)
+    assert eb.pool.pinned_pages <= 2
+    eb.pool.check_invariants()
+
+
+def test_cluster_crash_recovery_warm_restarts(tiny_model, tmp_path):
+    """The fleet gate: a crashed replica's successor warm-reloads the
+    shared store and serves prefix hits instead of a re-prefill TTFT
+    cliff — and the whole faulted run is byte-reproducible per seed."""
+    spec = WorkloadSpec(num_requests=28, seed=9, arrival="poisson",
+                        arrival_rate=90.0, prompt_len=(10, 14),
+                        output_len=(4, 8), shared_prefix_fraction=0.9,
+                        num_shared_prefixes=1, shared_prefix_len=8,
+                        vocab_size=128)
+    faults = FaultSchedule([FaultEvent(t=0.08, replica=1, kind="crash",
+                                       recover_s=0.1)])
+
+    def run(store_dir):
+        clock = VirtualClock()
+        cluster = ClusterEngine(tiny_model, 3, seed=0, now_fn=clock.now,
+                                faults=faults, session_affinity=False,
+                                max_len=32, page_size=4,
+                                pinned_prefix_pages=8,
+                                prefix_store=store_dir)
+        res = ClusterDriver(cluster, clock,
+                            step_time_s=0.01).run(spec.compile())
+        rep = build_cluster_report(res, spec=spec, trace=spec.compile(),
+                                   faults=faults)
+        return cluster, json.dumps(rep, sort_keys=True)
+
+    c1, j1 = run(str(tmp_path / "s1"))
+    rec = c1.replicas[1]
+    assert rec.generation == 1                   # crashed and rebuilt
+    assert rec.engine is not None
+    # the recovered replica's FRESH engine warm-reloaded and served
+    # pinned hits — its counters reset at the crash, so everything it
+    # shows happened post-recovery
+    assert rec.engine.metrics.prefix_chains_restored.value >= 1
+    assert rec.engine.metrics.pinned_prefix_hits.value >= 1
+    assert rec.engine.metrics.restore_fallbacks.value == 0
+    assert max(r.engine.decode_cache_size() for r in c1.replicas
+               if r.engine is not None) == 1
+    _, j2 = run(str(tmp_path / "s2"))
+    assert j1 == j2
+
+
+def test_training_state_capture_covers_scaler():
+    """The capture helper carries an AMP scaler's knobs too (Model.fit
+    has no scaler of its own; direct TrainStep users do)."""
+    from paddle_tpu.amp import GradScaler
+    sc = GradScaler(init_loss_scaling=512.0)
+    arrays, meta = capture_training_state(scaler=sc)
+    assert meta["scaler"]["scale"] == 512.0
+    sc2 = GradScaler(init_loss_scaling=1.0)
+    from paddle_tpu.io.persist import LoadResult
+    restore_training_state(LoadResult(arrays=arrays, meta=meta, version=1),
+                           scaler=sc2)
+    assert sc2.state_dict()["scale"] == 512.0

@@ -103,11 +103,21 @@ def test_partial_coverage_raises(tmp_path):
     t = paddle.to_tensor(w)
     t._data = _sharded(w, mesh, P("x", None))
     ckpt.save_state_dict({"w": t}, str(tmp_path))
-    # corrupt: drop half the shard records
+    # corrupt: drop half the shard records. The manifest checksum layer
+    # would catch the edit first (test_manifest_checksum_catches_rot in
+    # tests/test_persistence.py covers that); here the COVERAGE check is
+    # under test, so refresh the manifest's record of the edited file.
     mpath = tmp_path / "metadata_0.json"
     meta = json.load(open(mpath))
     meta["w"]["shards"] = meta["w"]["shards"][:4]
     json.dump(meta, open(mpath, "w"))
+    from paddle_tpu.io.persist import crc32_bytes
+    mani_path = tmp_path / "manifest.json"
+    mani = json.load(open(mani_path))
+    data = open(mpath, "rb").read()
+    mani["files"]["metadata_0.json"] = {"size": len(data),
+                                        "crc32": crc32_bytes(data)}
+    json.dump(mani, open(mani_path, "w"))
     dst = paddle.to_tensor(np.zeros_like(w))
     dst._data = _sharded(np.zeros_like(w), mesh, P(None, None))
     with pytest.raises(ValueError, match="covered"):

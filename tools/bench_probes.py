@@ -760,6 +760,177 @@ def probe_telemetry(paddle, burn_alerts=True):
                 "telemetry_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_persistence(paddle, corrupt=False):
+    """Measured crash-consistent-persistence fields (io/persist.py) for
+    the bench trajectory — ISSUE 14's robustness gates.
+
+    Two scenarios, both in throwaway temp dirs:
+    1. **Kill-and-resume training**: a tiny jitted Model.fit checkpoints
+       every step through the atomic ArtifactStore, is killed mid-run,
+       and a fresh process-equivalent (fresh model/optimizer objects)
+       resumes — ``persist_resume_identical`` is 1 iff the killed+
+       resumed loss trajectory is BIT-identical to the unkilled run's.
+       ``persist_ckpt_save_ms``/``persist_ckpt_restore_ms`` time one
+       full-state save/verified-restore round trip (wall-clock — rides
+       the bench artifact, not the proxy gates).
+    2. **Warm-restart prefix store**: engine A pins a shared prompt
+       prefix and autosaves it; a FRESH engine B warm-reloads at
+       construction and a cohort-mate prompt hits the restored pinned
+       chain with zero re-prefill — ``persist_warm_prefix_hits`` counts
+       those hits (exact per seed) and ``persist_restore_fallbacks``
+       must stay 0 (the store verified clean).
+    ``corrupt=True`` (the proxy-bench ``--corrupt-checkpoint``
+    regression hook) flips a byte in EVERY stored version of both
+    artifacts: the training resume falls back/diverges
+    (``persist_resume_identical`` -> 0), the prefix restore degrades to
+    a structured cold start (``persist_warm_prefix_hits`` -> 0,
+    ``persist_restore_fallbacks`` >= 1) — and every one of the three
+    gates must catch it.
+    """
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time as _time
+    import numpy as _np
+    tmps = []
+    try:
+        from paddle_tpu.hapi.callbacks import Callback
+        from paddle_tpu.io import BatchSampler, DataLoader, RandomSampler
+        from paddle_tpu.io.persist import (ArtifactStore,
+                                           capture_training_state,
+                                           restore_training_state)
+        from paddle_tpu.io.storage_faults import StorageFaultInjector
+
+        class _DS(paddle.io.Dataset):
+            def __init__(self, n=32):
+                rng = _np.random.default_rng(7)
+                self.x = rng.standard_normal((n, 16)).astype(_np.float32)
+                self.y = rng.standard_normal((n, 1)).astype(_np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return len(self.x)
+
+        def build():
+            paddle.seed(0)
+            net = paddle.nn.Sequential(
+                paddle.nn.Linear(16, 16), paddle.nn.ReLU(),
+                paddle.nn.Linear(16, 1))
+            m = paddle.Model(net)
+            m.prepare(paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=net.parameters()),
+                paddle.nn.MSELoss(), use_jit=True)
+            return m
+
+        ds = _DS()
+
+        def loader():
+            return DataLoader(ds, batch_sampler=BatchSampler(
+                sampler=RandomSampler(ds, generator=123), batch_size=4))
+
+        class _Rec(Callback):
+            def __init__(self):
+                self.losses = []
+
+            def on_train_batch_end(self, step, logs=None):
+                self.losses.append(float(logs["loss"]))
+
+        class _Kill(RuntimeError):
+            pass
+
+        class _Killer(_Rec):
+            def on_train_batch_end(self, step, logs=None):
+                super().on_train_batch_end(step, logs)
+                if len(self.losses) >= 4:
+                    raise _Kill()
+
+        rec = _Rec()
+        build().fit(loader(), epochs=1, verbose=0, callbacks=[rec],
+                    log_freq=4)
+        straight = rec.losses
+        ckpt_dir = _tempfile.mkdtemp(prefix="persist_probe_ckpt_")
+        tmps.append(ckpt_dir)
+        killer = _Killer()
+        try:
+            build().fit(loader(), epochs=1, verbose=0, callbacks=[killer],
+                        log_freq=4, checkpoint_dir=ckpt_dir,
+                        checkpoint_freq=1)
+        except _Kill:
+            pass
+        if corrupt:
+            StorageFaultInjector(0).corrupt_all(
+                ArtifactStore(ckpt_dir), "train_state", "flip_byte")
+        resumed = _Rec()
+        build().fit(loader(), epochs=1, verbose=0, callbacks=[resumed],
+                    log_freq=4, checkpoint_dir=ckpt_dir,
+                    checkpoint_freq=1, resume=True)
+        identical = int(killer.losses + resumed.losses == straight)
+
+        # one timed full-state save/verified-restore round trip
+        m = build()
+        m.train_batch([ds.x[:4]], [ds.y[:4]])
+        timing_dir = _tempfile.mkdtemp(prefix="persist_probe_time_")
+        tmps.append(timing_dir)
+        store = ArtifactStore(timing_dir)
+        t0 = _time.perf_counter()
+        arrays, meta = capture_training_state(model=m,
+                                              optimizer=m._optimizer)
+        store.save("train_state", arrays, meta)
+        save_ms = (_time.perf_counter() - t0) * 1e3
+        t0 = _time.perf_counter()
+        restore_training_state(store.load("train_state"), model=build(),
+                               optimizer=None)
+        restore_ms = (_time.perf_counter() - t0) * 1e3
+
+        # warm-restart prefix store on a micro engine pair
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        store_dir = _tempfile.mkdtemp(prefix="persist_probe_prefix_")
+        tmps.append(store_dir)
+        prefix = _np.random.default_rng(3).integers(
+            0, 128, (16,)).tolist()
+
+        def engine():
+            return LLMEngine(model, max_len=64, page_size=8,
+                             max_num_seqs=4, pinned_prefix_pages=8,
+                             seed=0, prefix_store=store_dir)
+
+        ea = engine()
+        ea.add_request(prefix + [5, 6, 7], max_new_tokens=4)
+        ea.run(max_steps=200)
+        if corrupt:
+            StorageFaultInjector(1).corrupt_all(
+                ArtifactStore(store_dir), "prefix_store", "flip_byte")
+        eb = engine()
+        eb.add_request(prefix + [9, 10], max_new_tokens=4)
+        eb.run(max_steps=200)
+        return {
+            "persist_resume_identical": identical,
+            "persist_restore_fallbacks":
+                eb.metrics.restore_fallbacks.value,
+            "persist_warm_prefix_hits":
+                eb.metrics.pinned_prefix_hits.value,
+            "persist_ckpt_save_ms": round(save_ms, 2),
+            "persist_ckpt_restore_ms": round(restore_ms, 2),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"persist_resume_identical": None,
+                "persist_restore_fallbacks": None,
+                "persist_warm_prefix_hits": None,
+                "persist_ckpt_save_ms": None,
+                "persist_ckpt_restore_ms": None,
+                "persistence_probe_error": f"{type(e).__name__}: {e}"}
+    finally:
+        for d in tmps:
+            _shutil.rmtree(d, ignore_errors=True)
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -790,5 +961,6 @@ def probe_kv_accounting():
 __all__ = ["probe_cluster", "probe_gspmd", "probe_hlo_fusion",
            "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_opt_dispatches",
+           "probe_persistence",
            "probe_serving", "probe_spec_decode", "probe_telemetry",
            "probe_tracing"]

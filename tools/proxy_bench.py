@@ -76,7 +76,8 @@ if "--xla_force_host_platform_device_count" not in \
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
-          "jaxpr", "accounting", "fusion", "tracing", "telemetry")
+          "jaxpr", "accounting", "fusion", "tracing", "telemetry",
+          "persist")
 
 
 class Gate:
@@ -188,12 +189,24 @@ GATES = {
     "telemetry_alerts_fired":   Gate("different"),
     "telemetry_alerts_resolved": Gate("different"),
     "telemetry_decode_compiles": Gate("higher", 0.0, 0.0),
+    # crash-consistent persistence (io/persist.py via probe_persistence):
+    # the killed-and-resumed loss trajectory must stay BIT-identical to
+    # the unkilled run (0 = resume diverged or restored stale state),
+    # restores must not fall back (a fallback means a stored version
+    # failed verification), and the warm-restarted engine must serve
+    # its pinned-prefix hit (0 = the store restored nothing and the
+    # cohort prompt re-prefilled). --corrupt-checkpoint flips a byte in
+    # every stored version: all three gates must catch it.
+    "persist_resume_identical":  Gate("lower", 0.0, 0.0),
+    "persist_restore_fallbacks": Gate("higher", 0.0, 0.0),
+    "persist_warm_prefix_hits":  Gate("lower", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
-            fusion_defuse=False, telemetry_burn_alerts=True) -> dict:
+            fusion_defuse=False, telemetry_burn_alerts=True,
+            persist_corrupt=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -218,6 +231,10 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     rate rules from the telemetry probe's scraper — the seeded
     slowdown fault then fires (and resolves) nothing, both alert
     counts read 0, and the ``telemetry_alerts_*`` gates must catch it.
+    ``persist_corrupt=True`` (--corrupt-checkpoint) flips a byte in
+    every version of the probe's stored training checkpoint AND prefix
+    store — resume identity breaks, restores fall back, warm hits
+    vanish, and the ``persist_*`` gates must catch all of it.
     """
     import jax
     import paddle_tpu as paddle
@@ -225,7 +242,8 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
-                                    probe_opt_dispatches, probe_serving,
+                                    probe_opt_dispatches,
+                                    probe_persistence, probe_serving,
                                     probe_spec_decode, probe_telemetry,
                                     probe_tracing)
     dev = jax.devices()[0]
@@ -282,6 +300,12 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
               ("telemetry_deterministic", "telemetry_scrape_samples",
                "telemetry_alerts_fired", "telemetry_alerts_resolved",
                "telemetry_decode_compiles"))
+    if "persist" in probes:
+        # the save/restore ms timings ride bench.py's artifact only —
+        # wall-clock noise has no place in an exact-count gate set
+        _take(probe_persistence(paddle, corrupt=persist_corrupt),
+              ("persist_resume_identical", "persist_restore_fallbacks",
+               "persist_warm_prefix_hits"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -366,6 +390,12 @@ def main(argv=None) -> int:
                          "probe's scraper: the seeded slowdown fault "
                          "fires no alert, fired/resolved counts read 0 "
                          "(the injected regression)")
+    ap.add_argument("--corrupt-checkpoint", action="store_true",
+                    help="flip a byte in every version of the "
+                         "persistence probe's stored checkpoint and "
+                         "prefix store: resume identity breaks and "
+                         "warm prefix hits vanish (the injected "
+                         "regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -392,7 +422,8 @@ def main(argv=None) -> int:
                       gspmd_dp_only=args.dp_only,
                       cluster_retry_budget=0 if args.no_retry else 2,
                       fusion_defuse=args.defuse,
-                      telemetry_burn_alerts=not args.no_burn_alerts)
+                      telemetry_burn_alerts=not args.no_burn_alerts,
+                      persist_corrupt=args.corrupt_checkpoint)
 
     if args.json:
         # --json changes the output format, never the action: combined
