@@ -44,6 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from tools.bench_probes import (probe_gspmd,  # noqa: E402
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
+                                probe_kv_tiering,
                                 probe_opt_dispatches,
                                 probe_persistence, probe_serving,
                                 probe_spec_decode, probe_telemetry,
@@ -60,6 +61,7 @@ _probe_hlo_fusion = probe_hlo_fusion
 _probe_tracing = probe_tracing
 _probe_telemetry = probe_telemetry
 _probe_persistence = probe_persistence
+_probe_kv_tiering = probe_kv_tiering
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -223,6 +225,7 @@ def run_bench(config="llama_125m", progress=None):
     tracing_probe = _probe_tracing(paddle)
     telemetry_probe = _probe_telemetry(paddle)
     persistence_probe = _probe_persistence(paddle)
+    kv_tier_probe = _probe_kv_tiering(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -296,6 +299,7 @@ def run_bench(config="llama_125m", progress=None):
         **tracing_probe,
         **telemetry_probe,
         **persistence_probe,
+        **kv_tier_probe,
     }
 
 
@@ -600,6 +604,17 @@ def _failure_artifact(last_err, last_stages):
         "persist_warm_prefix_hits": None,
         "persist_ckpt_save_ms": None,
         "persist_ckpt_restore_ms": None,
+        # two-tier KV fields are per-run proofs too: an over-capacity
+        # token-identity verdict, spill/prefetch counts, a stall
+        # fraction, or the tier page budgets from a stale round prove
+        # nothing about the run that failed
+        "kv_tier_token_identical": None,
+        "kv_tier_spills": None,
+        "kv_tier_prefetch_hits": None,
+        "kv_tier_stall_fraction": None,
+        "kv_tier_deterministic": None,
+        "kv_tier_hbm_pages": None,
+        "kv_tier_host_pages": None,
     }
     good = _last_good_round()
     if good:

@@ -32,7 +32,8 @@ Typical use::
     print(report_json(build_report(result, spec=spec,
                                    trace=spec.compile())))
 """
-from .workload import (ARRIVALS, TraceRequest, WorkloadSpec,  # noqa: F401
+from .workload import (ARRIVALS, LANES,  # noqa: F401
+                       LONG_CONTEXT_CEILING, TraceRequest, WorkloadSpec,
                        trace_fingerprint)
 from .driver import (Driver, RequestRecord, RunResult,  # noqa: F401
                      VirtualClock, run_workload)
@@ -41,7 +42,8 @@ from .cluster import (ClusterDriver, ClusterRunResult,  # noqa: F401
 from .report import (SCHEMA_VERSION, build_cluster_report,  # noqa: F401
                      build_report, report_json)
 
-__all__ = ["ARRIVALS", "ClusterDriver", "ClusterRunResult", "Driver",
+__all__ = ["ARRIVALS", "LANES", "LONG_CONTEXT_CEILING",
+           "ClusterDriver", "ClusterRunResult", "Driver",
            "RequestRecord", "RunResult", "SCHEMA_VERSION", "TraceRequest",
            "VirtualClock", "WorkloadSpec", "build_cluster_report",
            "build_report", "report_json", "run_cluster_workload",

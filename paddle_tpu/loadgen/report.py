@@ -178,6 +178,31 @@ def build_report(result, *, spec=None, trace=None, tracer=None,
             "pinned_prefix_hits": m.get("pinned_prefix_hits", 0),
         },
     })
+    if m.get("kv_host_pages") is not None:
+        # two-tier engines only (serving/kv_tier.py) — single-tier
+        # artifacts byte-persist without the section
+        report["kv_tiering"] = {
+            "hbm_pages": m.get("kv_hbm_pages"),
+            "host_pages": m.get("kv_host_pages"),
+            "spills": m.get("kv_spills", 0),
+            "prefetch_hits": m.get("kv_prefetch_hits", 0),
+            "prefetch_stalls": m.get("kv_prefetch_stalls", 0),
+            "resident_fraction": m.get("kv_resident_fraction"),
+            "host_chain_promotions": m.get("kv_host_chain_promotions"),
+        }
+    if spec is not None and \
+            getattr(spec, "lane", "interactive") == "offline_batch":
+        # throughput-not-latency lane (ROADMAP 5d): batch tokens/s is
+        # the headline; total-token rate credits the prefill work a
+        # generated-only rate hides on long-document batches
+        prompt_toks = sum(r.prompt_len for r in result.records)
+        dur = result.duration_s
+        report["offline_batch"] = {
+            "batch_tokens_per_s": tokens / dur if dur > 0 else None,
+            "batch_total_tokens_per_s":
+                (prompt_toks + tokens) / dur if dur > 0 else None,
+            "prompt_tokens": prompt_toks,
+        }
     if tracer is not None:
         report["latency_breakdown"] = _breakdown_section(tracer)
     tel = _telemetry_section(result, telemetry)

@@ -40,6 +40,17 @@ import numpy as np
 #: thundering herd the degradation ladder exists for)
 ARRIVALS = ("poisson", "deterministic", "diurnal", "flash_crowd")
 
+#: scenario lanes (ROADMAP item 5d): ``interactive`` is the classic
+#: latency-scored lane; ``offline_batch`` is the throughput lane — no
+#: queue-wait shed SLO by construction (``deadline_s`` must be None: a
+#: batch job is never load-shed for waiting) and the report gains a
+#: ``batch tokens/s`` section instead of scoring latency percentiles
+LANES = ("interactive", "offline_batch")
+
+#: hard ceiling of the long-context lane's prompt lengths — the 128k
+#: target context ROADMAP 5(a)/(d) sizes the two-tier KV cache for
+LONG_CONTEXT_CEILING = 131072
+
 
 @dataclass(frozen=True)
 class TraceRequest:
@@ -112,6 +123,21 @@ class WorkloadSpec:
     per_request_seed: tuple | None = None
     eos_token_id: int | None = None
     vocab_size: int = 128
+    #: scenario lane (LANES): ``offline_batch`` forbids the queue-wait
+    #: shed SLO (throughput, not latency — the report scores batch
+    #: tokens/s) and is otherwise draw-free, so classic traces
+    #: byte-persist
+    lane: str = "interactive"
+    #: long-context lane (ROADMAP 5d, partial): this fraction of
+    #: requests draws its prompt length from ``long_context_len``
+    #: (inclusive range, capped at LONG_CONTEXT_CEILING = 128k tokens)
+    #: instead of ``prompt_len`` — the chunked-prefill-friendly
+    #: long-document traffic the two-tier KV cache exists for. Long
+    #: requests never join a shared-prefix cohort. 0.0 (the default)
+    #: consumes no rng draws: pre-existing trace fingerprints
+    #: byte-persist.
+    long_context_fraction: float = 0.0
+    long_context_len: tuple | None = None
 
     def __post_init__(self):
         if self.num_requests < 1:
@@ -177,6 +203,33 @@ class WorkloadSpec:
                 raise ValueError(
                     f"per_request_seed must be an inclusive range "
                     f"0 <= lo <= hi, got {self.per_request_seed}")
+        if self.lane not in LANES:
+            raise ValueError(f"lane must be one of {LANES}, "
+                             f"got {self.lane!r}")
+        if self.lane == "offline_batch" and self.deadline_s is not None:
+            # a batch job waits as long as it waits: shedding it for
+            # queue age would silently convert offered throughput work
+            # into losses nobody asked to score
+            raise ValueError(
+                "offline_batch lane forbids deadline_s (throughput, "
+                "not latency — batch work is never queue-shed)")
+        if not 0.0 <= self.long_context_fraction <= 1.0:
+            raise ValueError(
+                "long_context_fraction must be in [0, 1]")
+        if self.long_context_fraction > 0:
+            if self.long_context_len is None:
+                raise ValueError(
+                    "long_context_len is required when "
+                    "long_context_fraction > 0")
+            llo, lhi = self.long_context_len
+            if not 1 <= llo <= lhi:
+                raise ValueError(
+                    f"long_context_len must be an inclusive range "
+                    f"1 <= lo <= hi, got {self.long_context_len}")
+            if lhi > LONG_CONTEXT_CEILING:
+                raise ValueError(
+                    f"long_context_len hi {lhi} exceeds the "
+                    f"{LONG_CONTEXT_CEILING}-token ceiling")
 
     def describe(self) -> dict:
         """Plain-dict view of the spec for the report artifact."""
@@ -213,10 +266,21 @@ class WorkloadSpec:
                             < self.flash_at_s + self.flash_duration_s:
                         rate *= self.flash_multiplier
                 t += float(rng.exponential(1.0 / max(rate, 1e-9)))
-            plen = int(rng.integers(plo, phi + 1))
+            # long-context lane: draw-free at fraction 0, so classic
+            # traces (and their fingerprints) byte-persist; a long
+            # request replaces its prompt-length draw and never joins a
+            # shared-prefix cohort (a 100k-token document is not a
+            # repeated system prompt)
+            is_long = self.long_context_fraction > 0 and \
+                float(rng.random()) < self.long_context_fraction
+            if is_long:
+                llo, lhi = self.long_context_len
+                plen = int(rng.integers(llo, lhi + 1))
+            else:
+                plen = int(rng.integers(plo, phi + 1))
             olen = int(rng.integers(olo, ohi + 1))
             cohort = -1
-            if prefixes and float(rng.random()) \
+            if prefixes and not is_long and float(rng.random()) \
                     < self.shared_prefix_fraction:
                 cohort = int(rng.integers(0, self.num_shared_prefixes))
                 # at least one fresh tail token: the last prompt token is
@@ -267,4 +331,5 @@ def trace_fingerprint(trace) -> str:
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 
 
-__all__ = ["ARRIVALS", "TraceRequest", "WorkloadSpec", "trace_fingerprint"]
+__all__ = ["ARRIVALS", "LANES", "LONG_CONTEXT_CEILING", "TraceRequest",
+           "WorkloadSpec", "trace_fingerprint"]

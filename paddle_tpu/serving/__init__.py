@@ -6,9 +6,16 @@ Layers (docs/SERVING.md has the full architecture):
   + per-sequence block tables over the pool layout the Pallas ragged
   kernel (kernels/paged_attention.py) consumes, with copy-on-write
   prefix-page sharing (``fork``/``prepare_append``).
+- :mod:`kv_tier` — ``HostKVArena`` + ``TieredKVPool`` +
+  ``KVPrefetcher``: the host-RAM spill tier under the paged pool
+  (``LLMEngine(host_kv_pages=N)``) — preemption victims park with an
+  exact-byte spill instead of recomputing, cursor-ahead background
+  staging restores them ahead of re-admission, and live context is
+  bounded by hbm + host pages instead of HBM alone.
 - :mod:`scheduler` — ``Scheduler``: FIFO admission, chunked-prefill
   ragged step planning (decode rows and prompt chunks in ONE launch),
-  deadline load shedding, preemption-with-requeue.
+  deadline load shedding, preemption-with-requeue (spill-park first
+  on two-tier pools).
 - :mod:`engine` — ``LLMEngine`` + ``Request``/``RequestOutput``: the
   request lifecycle over ONE jitted fixed-shape ragged step, with a
   prefix-hash cache that admits repeated prompt prefixes by forking
@@ -29,6 +36,8 @@ Layers (docs/SERVING.md has the full architecture):
 """
 from .kv_cache import (InvariantViolation, PagedKVPool,  # noqa: F401
                        PoolExhausted, NULL_PAGE)
+from .kv_tier import (ArenaExhausted, HostKVArena,  # noqa: F401
+                      KVPrefetcher, TieredKVPool)
 from .scheduler import (BurstPlan, Scheduler, SchedulerConfig,  # noqa: F401
                         Sequence, SequenceStatus, StepPlan, bucket_for)
 from .spec_decode import DraftWorker, speculative_sample  # noqa: F401
@@ -43,9 +52,11 @@ from .tracing import (FlightRecorder, RequestTracer,  # noqa: F401
 from .cluster import (ClusterEngine, DegradationLadder,  # noqa: F401
                       ReplicaState)
 
-__all__ = ["BurstPlan", "ClusterEngine", "DegradationLadder",
+__all__ = ["ArenaExhausted", "BurstPlan", "ClusterEngine",
+           "DegradationLadder",
            "DraftWorker", "FaultEvent", "FaultSchedule",
-           "FlightRecorder", "Histogram",
+           "FlightRecorder", "Histogram", "HostKVArena", "KVPrefetcher",
+           "TieredKVPool",
            "InjectedFault", "InvariantViolation", "LLMEngine",
            "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
            "PoolExhausted", "PrefixStoreMismatch", "NULL_PAGE",

@@ -99,7 +99,12 @@ _CARRIED_COUNTERS = ("tokens_generated", "finished_requests", "prefills",
                      # crashed engine's warm-restart story must survive
                      # into the fleet report like every other counter
                      "restore_fallbacks", "prefix_chains_restored",
-                     "prefix_store_saves")
+                     "prefix_store_saves",
+                     # two-tier KV (serving/kv_tier.py): a crashed
+                     # replica's spill/prefetch story must survive into
+                     # the fleet report like every other counter
+                     "kv_spills", "kv_prefetch_hits",
+                     "kv_prefetch_stalls")
 
 
 class DegradationLadder:

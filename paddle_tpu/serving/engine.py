@@ -267,7 +267,9 @@ class LLMEngine:
                  draft_num_pages=None, mesh=None, tracer=None,
                  flight_recorder=None, flight_capacity=256,
                  engine_id=None, gauge_stale_after_s=None,
-                 prefix_store=None, prefix_store_autosave=None):
+                 prefix_store=None, prefix_store_autosave=None,
+                 host_kv_pages=0, kv_prefetch=True, kv_prefetch_depth=4,
+                 kv_spill_seed=0):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -388,11 +390,35 @@ class LLMEngine:
             dtype = jnp.dtype(kv_cache_dtype)
         else:
             dtype = self.params["embed"].dtype
-        self.pool = PagedKVPool(
-            cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim,
-            num_pages=num_pages, page_size=page_size, dtype=dtype,
-            high_watermark=high_watermark, low_watermark=low_watermark,
-            pinned_page_budget=pinned_prefix_pages, mesh=self.mesh)
+        # two-tier KV (serving/kv_tier.py, ROADMAP 5a): host_kv_pages >
+        # 0 backs the HBM pool with a host-RAM spill arena — preemption
+        # victims PARK (exact-byte spill/restore) instead of
+        # recomputing, live context is bounded by hbm + host pages, and
+        # a background staging thread prefetches parked sequences back
+        # ahead of re-admission. kv_prefetch=False is the injected
+        # regression hook: every restore then stages synchronously and
+        # counts as a kv_prefetch_stall.
+        self._kv_prefetch_depth = max(int(kv_prefetch_depth), 1)
+        if host_kv_pages and int(host_kv_pages) > 0:
+            from .kv_tier import TieredKVPool
+            self.pool = TieredKVPool(
+                cfg.num_hidden_layers, cfg.num_key_value_heads,
+                cfg.head_dim, num_pages=num_pages, page_size=page_size,
+                host_pages=int(host_kv_pages), dtype=dtype,
+                high_watermark=high_watermark,
+                low_watermark=low_watermark,
+                pinned_page_budget=pinned_prefix_pages, mesh=self.mesh,
+                prefetch=bool(kv_prefetch),
+                prefetch_depth=self._kv_prefetch_depth,
+                spill_seed=kv_spill_seed)
+        else:
+            self.pool = PagedKVPool(
+                cfg.num_hidden_layers, cfg.num_key_value_heads,
+                cfg.head_dim, num_pages=num_pages, page_size=page_size,
+                dtype=dtype, high_watermark=high_watermark,
+                low_watermark=low_watermark,
+                pinned_page_budget=pinned_prefix_pages, mesh=self.mesh)
+        self._tiered = hasattr(self.pool, "arena")
         # gauge_stale_after_s: snapshot-side staleness horizon — gauges
         # last set longer ago than this read as null (listed under
         # "stale_gauges") instead of as current values; the telemetry
@@ -847,6 +873,11 @@ class LLMEngine:
             return False
         if not any(s is seq for s in self.scheduler.waiting):
             return False
+        if seq.seq_id in self.pool:
+            # a PARKED sequence (two-tier pools) owns pages and streamed
+            # tokens: requeueing it elsewhere would silently drop both —
+            # it stays to finish its drain, exactly like a running row
+            return False
         self.scheduler.waiting = type(self.scheduler.waiting)(
             s for s in self.scheduler.waiting if s is not seq)
         if self._draft is not None:
@@ -937,6 +968,20 @@ class LLMEngine:
         snap["burst_tokens"] = self.burst_tokens
         # tensor-parallel forensics: 1 = single-device engine
         snap["model_parallel_degree"] = self.pool.model_parallel_degree
+        # two-tier KV forensics (kv_tier.py): per-tier page/byte budgets
+        # — None for single-tier engines, so pre-tiering consumers see
+        # explicit absence, never a fabricated zero-sized host tier
+        snap["kv_hbm_pages"] = self.pool.capacity
+        snap["kv_hbm_bytes"] = self.pool.pool_bytes
+        if self._tiered:
+            snap["kv_host_pages"] = self.pool.arena.capacity
+            snap["kv_host_bytes"] = self.pool.host_bytes
+            snap["kv_host_chain_promotions"] = \
+                self.pool.host_chain_promotions
+        else:
+            snap["kv_host_pages"] = None
+            snap["kv_host_bytes"] = None
+            snap["kv_host_chain_promotions"] = None
         from ..kernels.decode_megakernel import megakernel_mode
         snap["megakernel_mode"] = megakernel_mode(
             self.params["layers"][0],
@@ -983,6 +1028,12 @@ class LLMEngine:
         RequestOutputs touched this step (admitted, token streamed,
         finished, shed, or preempted)."""
         touched = {}
+        if self._tiered:
+            # advance the pool's virtual round clock FIRST: a restore
+            # this step claims at clock c, so a prefetch issued at the
+            # END of the previous step (clock c-1) classifies as a hit
+            # — the deterministic hit-vs-stall rule (kv_tier.py)
+            self.pool.tick()
         for seq in self.scheduler.shed_expired():
             self._finalize(seq, "shed")
             touched[seq.seq_id] = self._outputs[seq.seq_id]
@@ -1101,6 +1152,23 @@ class LLMEngine:
                 touched[seq.seq_id] = self._outputs[seq.seq_id]
             self.metrics.decode_steps.inc()
             self.metrics.ragged_pad_fraction.set(plan.pad_fraction)
+        if self._tiered:
+            # cursor-ahead prefetch: issue background staging for the
+            # parked sequences the NEXT admission round will restore —
+            # the staging thread gets a full step of compute to overlap
+            for sid in self.scheduler.prefetch_candidates(
+                    self._kv_prefetch_depth):
+                self.pool.prefetch(sid)
+            # tier events (stalls, host-chain promotions) surface on
+            # the flight recorder (+ tracer span for request-owned
+            # stalls) in the deterministic order the pool recorded them
+            for kind, detail in self.pool.drain_events():
+                self.flight.record(kind, self._now(), **detail)
+                rid = detail.get("request")
+                if rid is not None:
+                    self._trace(rid, kind,
+                                **{k: v for k, v in detail.items()
+                                   if k != "request"})
         self.metrics.record_step(self.scheduler, self.pool)
         # one O(1) flight-recorder entry per step: the bounded last-N
         # context a post-mortem dump replays (ints only — cheap and
@@ -1205,7 +1273,8 @@ class LLMEngine:
         drain/idle boundaries instead."""
         if self.prefix_store is None:
             return False
-        sig = frozenset(self.pool._pins)
+        sig = frozenset(self.pool._pins) \
+            | frozenset(getattr(self.pool, "_host_chains", ()))
         if sig == self._prefix_store_sig:
             return False
         arrays, meta = self.export_prefix_store()
@@ -1284,7 +1353,11 @@ class LLMEngine:
             self.metrics.prefix_chains_restored.inc(restored)
             self.record_fleet_event("prefix_restore", chains=restored,
                                     version=res.version)
-        self._prefix_store_sig = frozenset(self.pool._pins)
+        # membership signature spans BOTH tiers: a host-tier chain
+        # promoting to HBM (or being added/evicted) must re-arm the
+        # autosave dedup like any pin-set change
+        self._prefix_store_sig = frozenset(self.pool._pins) \
+            | frozenset(getattr(self.pool, "_host_chains", ()))
 
     def _prefix_probe(self, seq: Sequence) -> int:
         """Admission hook: longest registered chain matching the prompt
@@ -1303,6 +1376,11 @@ class LLMEngine:
                 continue
             donor, length = ent
             if donor == seq.seq_id or donor not in self.pool:
+                continue
+            if self._tiered and not self.pool.fully_resident(donor):
+                # a parked donor's prefix may be spilled: forking would
+                # map host sentinels into the child — skip (the pinned
+                # index below may still serve the chain)
                 continue
             if self.pool.seq_len(donor) < length:
                 continue
@@ -1344,7 +1422,13 @@ class LLMEngine:
                 shared = (shared // ps) * ps
             if shared < 1:
                 continue
-            self.pool.fork_pinned(seq.seq_id, chain, shared)
+            try:
+                self.pool.fork_pinned(seq.seq_id, chain, shared)
+            except PoolExhausted:
+                # a HOST-tier chain (two-tier warm restart) could not
+                # promote into HBM right now — treat as a miss rather
+                # than killing admission; it stays restorable later
+                continue
             self.metrics.prefix_cache_hits.inc()
             self.metrics.pinned_prefix_hits.inc()
             return shared

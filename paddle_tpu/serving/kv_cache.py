@@ -736,6 +736,32 @@ class PagedKVPool:
             "offending_pages": sorted(set(offending_pages)),
         }
 
+    def _invariant_fail(self, reason, pages=()):
+        """Raise :class:`InvariantViolation` carrying a :meth:`snapshot`
+        (and the flight recorder's last-N context when one is attached)
+        — shared by :meth:`check_invariants` and the two-tier pool's
+        residency audit (serving/kv_tier.py)."""
+        err = InvariantViolation(reason, self.snapshot(pages))
+        # always-on flight recorder (serving/tracing.py): the engine
+        # back-references its recorder on the pool so a failing
+        # audit ships the last-N steps of context WITH the exception
+        # — a soak that dies mid-storm is triageable from the
+        # artifact alone. A bare pool (unit tests) has no recorder.
+        fr = getattr(self, "flight_recorder", None)
+        if fr is not None:
+            ctr = getattr(self, "flight_dump_counter", None)
+            if ctr is not None:
+                ctr.inc()
+            err.flight_dump = fr.dump("invariant_violation",
+                                      violation=reason)
+        raise err
+
+    def _resident_table(self, t):
+        """Block-table entries that name RESIDENT pool pages — the hook
+        the two-tier pool overrides (host-sentinel entries live in the
+        arena and are audited by its own residency pass)."""
+        return t
+
     def check_invariants(self):
         """Debug/test/soak hook: refcount/free-list/table consistency.
 
@@ -752,26 +778,12 @@ class PagedKVPool:
         :meth:`snapshot` (refcounts, free-list size, pinned set, the
         offending page ids) instead of a bare assert.
         """
-        def fail(reason, pages=()):
-            err = InvariantViolation(reason, self.snapshot(pages))
-            # always-on flight recorder (serving/tracing.py): the engine
-            # back-references its recorder on the pool so a failing
-            # audit ships the last-N steps of context WITH the exception
-            # — a soak that dies mid-storm is triageable from the
-            # artifact alone. A bare pool (unit tests) has no recorder.
-            fr = getattr(self, "flight_recorder", None)
-            if fr is not None:
-                ctr = getattr(self, "flight_dump_counter", None)
-                if ctr is not None:
-                    ctr.inc()
-                err.flight_dump = fr.dump("invariant_violation",
-                                          violation=reason)
-            raise err
+        fail = self._invariant_fail
 
         mapped: dict[int, int] = {}
         for sid, t in self._tables.items():
             seen_in_table = set()
-            for p in t:
+            for p in self._resident_table(t):
                 if p in seen_in_table:
                     fail(f"table {sid!r} maps pool page {p} twice", [p])
                 seen_in_table.add(p)

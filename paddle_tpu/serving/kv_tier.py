@@ -1,0 +1,866 @@
+"""Two-tier KV cache: host-RAM spill arena + cursor-ahead prefetch.
+
+ROADMAP item 5(a): every serving PR so far treated HBM as the only home
+for KV pages, so the engine's live context capacity — how many committed
+tokens the fleet can hold at once — was HBM-bound. This module adds the
+classic paged-attention memory-hierarchy move (vLLM's swap tier,
+PAPERS.md) on top of :class:`~paddle_tpu.serving.kv_cache.PagedKVPool`:
+
+- :class:`HostKVArena` — a host-RAM page store: numpy-backed page slabs
+  (one ``[Hkv, host_pages, page_size, head_dim]`` K and V slab per
+  layer, plus per-(head, page) fp32 scale columns for int8 pools) under
+  a LIFO free list identical in spirit to the pool's. Pages here are
+  bytes at rest: nothing ever computes against the arena.
+- :class:`TieredKVPool` — a :class:`PagedKVPool` whose pages can live in
+  either tier. Under HBM pressure the scheduler PARKS a victim sequence
+  instead of recompute-preempting it: the victim's **cold** pages — its
+  exclusively-owned, unpinned pages; a parked row is in no launch, so no
+  reader's causal horizon covers them — spill to the arena (exact bytes,
+  int8 scale columns included) and the HBM pages recycle. Pinned prefix
+  chains and CoW-shared pages are never spilled: a shared page may be
+  read by a live sequence this very step, and pins are the prefix
+  cache's rc floor — both stay HBM-resident. Spill order over parked
+  sequences is LRU by last touch on the pool's virtual round clock,
+  with ties broken by one seeded stream — byte-reproducible per seed.
+- :class:`KVPrefetcher` — the background staging lane
+  (``io/prefetch.py``'s thread+bounded-queue discipline, KV edition):
+  the engine issues restores for parked sequences *ahead of the decode/
+  prefill cursor* — at the end of the step before re-admission could
+  want them — and a daemon thread stages the arena blocks onto the
+  device (``jax.device_put`` is an async dispatch under PJRT, so the
+  H2D copy overlaps the next step's compute on a real chip). At claim
+  time the main thread scatters the staged blocks into freshly claimed
+  pool pages.
+
+Residency contract (the part the ragged step depends on): a sequence's
+block-table entry is either a resident pool page (``>= 1``) or a host
+sentinel ``-(arena_slot + 1)`` (``<= -1``). Only fully-resident
+sequences are ever scheduled into a launch — ``padded_block_table``
+hard-fails on a host sentinel, and ``check_invariants`` audits that
+every page lives in exactly one tier. Decode therefore NEVER reads a
+non-resident page; when a restore was not staged a full round ahead
+(the prefetch lost the race to the cursor), the engine charges a
+**counted, bounded stall** (``kv_prefetch_stalls`` + a flight event):
+the restore happens synchronously on the main thread, tokens stay
+bit-identical, only the overlap is lost.
+
+Determinism: hit-vs-stall classification compares the prefetch's ISSUE
+round against the restore's CLAIM round on the pool's virtual clock —
+never wall-clock thread completion — so a seeded loadgen run reports
+byte-identical spill/prefetch/stall counts on every run while the
+staging thread still does real asynchronous work. A restore consumes
+staged bytes when they exist and falls back to a synchronous copy when
+they don't; the data is identical either way.
+
+Capacity story: live context (committed tokens across admitted
+sequences, pinned chains included) is bounded by ``(hbm_pages +
+host_pages) * page_size`` instead of HBM alone. One RUNNING row must
+still be fully HBM-resident for its launch — full causal attention
+reads the row's whole history every step — so a single request's
+context stays bounded by ``min(max_pages_per_seq, hbm capacity)``;
+docs/PERF.md §16 spells out what would change on a chip (per-layer KV
+streaming) to lift that too.
+"""
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import PagedKVPool, PoolExhausted
+
+
+class ArenaExhausted(PoolExhausted):
+    """Raised when a host-arena claim needs more free slots than exist.
+    Subclasses :class:`PoolExhausted` so pressure ladders that already
+    answer pool exhaustion handle the host tier the same way."""
+
+
+class HostKVArena:
+    """Host-RAM page store: numpy slabs + free list, no compute.
+
+    One slot holds one pool page across every layer (K and V blocks,
+    plus the page's per-(head, page) scale columns for int8 pools).
+    ``claim``/``release`` mirror the pool's free-list discipline —
+    LIFO, all-or-nothing — and ``write``/``read`` move exact bytes, so
+    a spill/restore round trip is bit-identical by construction.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
+                 page_size, dtype=jnp.float32):
+        if num_pages < 1:
+            raise ValueError("HostKVArena needs num_pages >= 1")
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_pages = int(num_pages)
+        self.page_size = page_size
+        self.dtype = jnp.dtype(dtype)
+        self.quantized = self.dtype == jnp.dtype(jnp.int8)
+        shape = (num_kv_heads, self.num_pages, page_size, head_dim)
+        self._k = [np.zeros(shape, self.dtype) for _ in range(num_layers)]
+        self._v = [np.zeros(shape, self.dtype) for _ in range(num_layers)]
+        self._ks = self._vs = None
+        if self.quantized:
+            sshape = (num_kv_heads, self.num_pages)
+            self._ks = [np.zeros(sshape, np.float32)
+                        for _ in range(num_layers)]
+            self._vs = [np.zeros(sshape, np.float32)
+                        for _ in range(num_layers)]
+        self._free = list(range(self.num_pages - 1, -1, -1))
+
+    # ---- capacity ----
+    @property
+    def capacity(self) -> int:
+        return self.num_pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def arena_bytes(self) -> int:
+        """Host bytes the arena occupies — the host side of the
+        two-tier byte budget (``page_bytes_for`` geometry x slots)."""
+        return PagedKVPool.page_bytes_for(
+            self.num_layers, self.num_kv_heads, self.head_dim,
+            self.page_size, self.dtype) * self.num_pages
+
+    # ---- slots ----
+    def claim(self, n: int) -> list:
+        if n > len(self._free):
+            raise ArenaExhausted(
+                f"host arena: need {n} slots, {len(self._free)} free of "
+                f"{self.num_pages}")
+        return [self._free.pop() for _ in range(n)]
+
+    def release(self, slots):
+        live = set(self._free)
+        for s in slots:
+            if not 0 <= s < self.num_pages or s in live:
+                raise ValueError(f"bad arena slot release: {s}")
+            live.add(s)
+        self._free.extend(slots)
+
+    def write(self, slots, layers):
+        """Store page blocks into claimed ``slots``. ``layers`` is one
+        dict per layer: ``K``/``V`` ``[Hkv, len(slots), ps, d]`` (+
+        ``Ks``/``Vs`` ``[Hkv, len(slots)]`` for int8 pools)."""
+        idx = np.asarray(slots, np.int64)
+        for li, ent in enumerate(layers):
+            self._k[li][:, idx] = np.asarray(ent["K"], self.dtype)
+            self._v[li][:, idx] = np.asarray(ent["V"], self.dtype)
+            if self._ks is not None:
+                self._ks[li][:, idx] = np.asarray(ent["Ks"], np.float32)
+                self._vs[li][:, idx] = np.asarray(ent["Vs"], np.float32)
+
+    def read(self, slots) -> list:
+        """Fetch page blocks for ``slots`` (fresh numpy copies — safe to
+        hand to a staging thread while the arena keeps mutating)."""
+        idx = np.asarray(slots, np.int64)
+        out = []
+        for li in range(self.num_layers):
+            ent = {"K": self._k[li][:, idx].copy(),
+                   "V": self._v[li][:, idx].copy()}
+            if self._ks is not None:
+                ent["Ks"] = self._ks[li][:, idx].copy()
+                ent["Vs"] = self._vs[li][:, idx].copy()
+            out.append(ent)
+        return out
+
+
+class _StagedRestore:
+    """One in-flight prefetch: host blocks in, device blocks out."""
+
+    __slots__ = ("blocks", "clock", "event", "staged", "error")
+
+    def __init__(self, blocks, clock):
+        self.blocks = blocks
+        self.clock = clock
+        self.event = threading.Event()
+        self.staged = None
+        self.error = None
+
+
+class KVPrefetcher:
+    """Bounded background staging of arena blocks onto the device.
+
+    The ``io/prefetch.py`` discipline, KV edition: a daemon thread
+    drains a bounded queue of restore requests, ``jax.device_put``-ing
+    each request's host blocks (async dispatch under PJRT — the H2D
+    copy overlaps the main thread's next launch on a chip). The main
+    thread owns ALL pool state; the thread touches nothing but the
+    numpy blocks it was handed. ``claim`` joins the staging (bounded —
+    it is one device_put batch) and reports the ISSUE round so the
+    caller can classify hit vs stall deterministically on the virtual
+    clock. ``enabled=False`` turns every issue into a no-op — the
+    ``--no-prefetch`` injected regression: every restore then stages
+    synchronously and counts as a stall.
+    """
+
+    def __init__(self, depth=4, enabled=True):
+        self.depth = max(int(depth), 1)
+        self.enabled = bool(enabled)
+        self._items: dict = {}
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="paddle_tpu-kv-prefetch")
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                it = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if it is None:
+                return
+            try:
+                it.staged = [
+                    {k: jax.device_put(v) for k, v in ent.items()}
+                    for ent in it.blocks]
+            except BaseException as e:   # claim falls back synchronously
+                it.error = e
+            finally:
+                it.event.set()
+
+    def can_issue(self, key) -> bool:
+        """Would :meth:`issue` accept this key right now? (Callers use
+        it to skip preparing blocks that would only be refused.)"""
+        return self.enabled and key not in self._items \
+            and len(self._items) < self.depth
+
+    def issue(self, key, blocks, clock) -> bool:
+        """Queue a staging request; False when disabled, already
+        in flight, or the bounded queue is full (never blocks)."""
+        if not self.can_issue(key):
+            return False
+        it = _StagedRestore(blocks, clock)
+        self._items[key] = it
+        self._ensure_thread()
+        self._q.put(it)
+        return True
+
+    def claim(self, key):
+        """Take a staged restore: ``(device_blocks, issue_clock)`` or
+        ``(None, None)`` when nothing usable was staged. Waits for an
+        in-flight staging (bounded: one device_put batch); a staging
+        that errored degrades to a miss — the caller re-stages
+        synchronously, data identical."""
+        it = self._items.pop(key, None)
+        if it is None:
+            return None, None
+        if not it.event.wait(timeout=30.0):
+            return None, None
+        if it.error is not None or it.staged is None:
+            return None, None
+        return it.staged, it.clock
+
+    def drop(self, key):
+        """Forget a staged/in-flight restore (its bytes went stale)."""
+        self._items.pop(key, None)
+
+    def close(self):
+        self._stop.set()
+        self._q.put(None)
+
+
+class TieredKVPool(PagedKVPool):
+    """Paged KV pool whose pages spill to a host-RAM arena under
+    pressure and prefetch back ahead of the decode cursor.
+
+    Everything :class:`PagedKVPool` guarantees still holds for the HBM
+    tier; this class adds the second tier plus the park/spill/restore
+    protocol the scheduler drives (serving/scheduler.py):
+
+    - ``park(seq_id)`` — a preemption
+      victim's exclusive unpinned pages move to the arena; the sequence
+      keeps its committed length and block table (host sentinels mark
+      the spilled slots) and waits at the queue front. No recompute:
+      restore brings the exact bytes back.
+    - ``prefetch(seq_id)`` — issue background staging for a parked
+      sequence's arena blocks (the engine calls this for the queue's
+      head at the END of each step — cursor-ahead).
+    - ``restore_sequence(seq_id)`` — claim HBM pages and scatter the
+      blocks back in at re-admission; counts a prefetch hit when the
+      staging was issued a strictly earlier round, else a counted
+      stall (synchronous copy, identical bytes).
+    - ``spill_cold()`` — deepen the spill of already-parked sequences
+      (pages that became exclusive after parking), LRU-first.
+
+    Admission accounting is two-tier aware: watermarks and
+    ``available_pages`` discount pages reclaimable by spilling, so a
+    fleet never over-admits against HBM it does not have while still
+    admitting up to the combined ``hbm + host`` footprint.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, *, num_pages,
+                 page_size, host_pages, dtype=jnp.float32,
+                 high_watermark=0.90, low_watermark=0.50,
+                 pinned_page_budget=0, mesh=None, prefetch=True,
+                 prefetch_depth=4, spill_seed=0):
+        super().__init__(num_layers, num_kv_heads, head_dim,
+                         num_pages=num_pages, page_size=page_size,
+                         dtype=dtype, high_watermark=high_watermark,
+                         low_watermark=low_watermark,
+                         pinned_page_budget=pinned_page_budget, mesh=mesh)
+        self.arena = HostKVArena(num_layers, num_kv_heads, head_dim,
+                                 num_pages=host_pages,
+                                 page_size=page_size, dtype=dtype)
+        self.prefetcher = KVPrefetcher(depth=prefetch_depth,
+                                       enabled=prefetch)
+        # an abandoned pool must not leave its staging thread polling
+        # forever (io/prefetch.py's finalizer discipline)
+        self._prefetch_finalizer = weakref.finalize(
+            self, self.prefetcher.close)
+        #: virtual round clock: the engine ticks it once per step; all
+        #: LRU/hit-vs-stall decisions read it, never wall-clock
+        self.clock = 0
+        #: seq_id -> {logical page index: arena slot} for spilled pages
+        self._spilled: dict = {}
+        #: parked sequences: seq_id -> (park round, seeded tie-break) —
+        #: the LRU-by-last-touch key (a parked row's last touch IS the
+        #: round it last ran)
+        self._parked: dict = {}
+        #: per-seq spill generation: bumped on every spill that touches
+        #: the sequence so staged prefetches of an older page set are
+        #: invalidated instead of restored stale
+        self._spill_gen: dict = {}
+        #: pinned chains living in the HOST tier (PR 14 warm restart
+        #: lands here when HBM cannot hold them): chain -> (slots, toks)
+        self._host_chains: dict = {}
+        self._tie_rng = random.Random(int(spill_seed) & 0x7FFFFFFF)
+        #: sequence currently being restored (its own cold pages must
+        #: never be spilled to make room for its own restore)
+        self._restoring = None
+        #: memo for spillable_cold_pages: (state token, value)
+        self._sc_cache = None
+        #: lifetime tier-traffic counters (mirrored into ServingMetrics
+        #: by record_step): pages spilled to the arena, restores served
+        #: from a cursor-ahead staging, restores that had to stage
+        #: synchronously (the counted, bounded stall), host-tier pinned
+        #: chains promoted to HBM on first use
+        self.spills = 0
+        self.prefetch_hits = 0
+        self.prefetch_stalls = 0
+        self.host_chain_promotions = 0
+        #: pending tier events the engine drains into the flight
+        #: recorder / tracer after each step: (kind, detail) tuples
+        self._events: list = []
+
+    # ------------------------------------------------------------------
+    # clock + residency queries
+    # ------------------------------------------------------------------
+    def tick(self):
+        """Advance the virtual round clock (once per engine step)."""
+        self.clock += 1
+
+    def drain_events(self) -> list:
+        ev, self._events = self._events, []
+        return ev
+
+    def is_parked(self, seq_id) -> bool:
+        return seq_id in self._parked
+
+    def fully_resident(self, seq_id) -> bool:
+        return not self._spilled.get(seq_id)
+
+    def spilled_page_count(self, seq_id) -> int:
+        return len(self._spilled.get(seq_id, ()))
+
+    @property
+    def host_pages_used(self) -> int:
+        return self.arena.used_pages
+
+    @property
+    def resident_fraction(self) -> float:
+        """Fraction of live KV pages (sequences + pins + host chains)
+        that are HBM-resident; 1.0 for an empty or all-resident pool."""
+        total = self.used_pages + self.arena.used_pages
+        return self.used_pages / total if total else 1.0
+
+    @property
+    def total_capacity(self) -> int:
+        """Allocatable pages across BOTH tiers — what bounds the live
+        context of the whole engine (vs ``capacity``, which bounds one
+        launch's residency)."""
+        return self.capacity + self.arena.capacity
+
+    # ---- two-tier byte accounting (the admission-bugfix satellite) ----
+    @property
+    def host_bytes(self) -> int:
+        return self.arena.arena_bytes
+
+    def tier_bytes(self) -> tuple:
+        """(hbm_bytes, host_bytes) — the two budgets an operator sizes
+        independently (``pool_bytes`` is the inherited HBM-tier size;
+        ``pages_for_byte_budget`` applies per tier)."""
+        return (self.pool_bytes, self.host_bytes)
+
+    @classmethod
+    def pages_for_byte_budgets(cls, hbm_byte_budget, host_byte_budget,
+                               num_layers, num_kv_heads, head_dim,
+                               page_size, dtype=jnp.float32) -> tuple:
+        """Largest (hbm_pages, host_pages) fitting the per-tier byte
+        budgets — the two-tier edition of ``pages_for_byte_budget``
+        (one budget must never be sized against the other's RAM)."""
+        return (cls.pages_for_byte_budget(hbm_byte_budget, num_layers,
+                                          num_kv_heads, head_dim,
+                                          page_size, dtype),
+                cls.pages_for_byte_budget(host_byte_budget, num_layers,
+                                          num_kv_heads, head_dim,
+                                          page_size, dtype))
+
+    # ------------------------------------------------------------------
+    # spill side
+    # ------------------------------------------------------------------
+    def _spillable(self, seq_id) -> list:
+        """Logical page indices of ``seq_id`` that may spill: resident,
+        exclusively owned (refcount 1 — never a CoW-shared page another
+        reader may touch), and unpinned (never a prefix chain's page)."""
+        out = []
+        for i, p in enumerate(self._tables.get(seq_id, ())):
+            if p >= 0 and self._refcounts[p] == 1 \
+                    and p not in self._pin_counts:
+                out.append(i)
+        return out
+
+    def can_park(self, seq_id) -> bool:
+        """True when parking would actually relieve pressure: the
+        sequence has spillable pages and the arena can hold them all
+        (all-or-nothing — a half-spilled park frees too little to be
+        worth preferring over recompute preemption)."""
+        n = len(self._spillable(seq_id))
+        return n > 0 and n <= self.arena.free_pages
+
+    def _spill_pages(self, seq_id, logicals) -> int:
+        if not logicals:
+            return 0
+        table = self._tables[seq_id]
+        pages = [table[i] for i in logicals]
+        slots = self.arena.claim(len(logicals))
+        idx = jnp.asarray(pages, jnp.int32)
+        layers = []
+        for li, (K, V) in enumerate(self.kv):
+            ent = {"K": np.asarray(K[:, idx]), "V": np.asarray(V[:, idx])}
+            if self.kv_scales is not None:
+                Ks, Vs = self.kv_scales[li]
+                ent["Ks"] = np.asarray(Ks[:, idx])
+                ent["Vs"] = np.asarray(Vs[:, idx])
+            layers.append(ent)
+        self.arena.write(slots, layers)
+        for i, s in zip(logicals, slots):
+            table[i] = -(s + 1)
+        self._spilled.setdefault(seq_id, {}).update(zip(logicals, slots))
+        # the page set changed: any staged prefetch of the OLD set is
+        # stale — bump the generation so restore never consumes it
+        gen = self._spill_gen.get(seq_id, 0)
+        self.prefetcher.drop((seq_id, gen))
+        self._spill_gen[seq_id] = gen + 1
+        # recycle the HBM pages (refcount 1 -> 0; int8 scale columns of
+        # the recycled pages reset, their saved values travel with the
+        # arena blocks)
+        self._release_pages(pages)
+        self.spills += len(pages)
+        return len(pages)
+
+    def park(self, seq_id):
+        """Mark a sequence parked at the current round (its last touch)
+        and spill every spillable page. The scheduler keeps the
+        Sequence WAITING at the queue front; its committed length and
+        block table survive — restore is bit-exact, no recompute."""
+        self._parked[seq_id] = (self.clock, self._tie_rng.random())
+        return self._spill_pages(seq_id, self._spillable(seq_id))
+
+    def _ensure_free(self, n: int, what: str):
+        """Two-tier pressure relief UNDER every page claim: deepen the
+        cold spill of parked sequences (never the one being restored)
+        before the base class falls back to pin eviction — so extends,
+        CoW claims and restores reach the host tier's headroom without
+        every caller growing its own retry loop."""
+        while n > len(self._free) + self.evictable_pages:
+            if self.spill_cold(exclude=self._restoring) == 0:
+                break
+        super()._ensure_free(n, what)
+
+    def _parked_lru(self) -> list:
+        """Parked seq ids, coldest first: ordered by (park round,
+        seeded tie-break) — deterministic per seed, wall-clock-free."""
+        return sorted(self._parked, key=lambda s: self._parked[s])
+
+    def spill_cold(self, exclude=None) -> int:
+        """Deepen the spill: take the coldest parked sequence that
+        still holds spillable resident pages (pages that became
+        exclusive after parking, e.g. a sharer left) and spill them.
+        ``exclude`` names a sequence that must NOT be deepened — the
+        restore path passes itself (self-spilling frees no net HBM
+        and would grow the very page set being restored). Returns
+        pages freed (0 = nothing left to spill)."""
+        for sid in self._parked_lru():
+            if sid == exclude:
+                continue
+            logicals = self._spillable(sid)
+            if not logicals:
+                continue
+            n = min(len(logicals), self.arena.free_pages)
+            if n <= 0:
+                return 0
+            return self._spill_pages(sid, logicals[:n])
+        return 0
+
+    @property
+    def spillable_cold_pages(self) -> int:
+        """Resident pages reclaimable by deepening the spill of parked
+        sequences, bounded by the arena's free slots — the second-tier
+        term in the admission watermark math.
+
+        Memoized on a coarse state token: the full scan is
+        O(parked x table length) and the watermark/admission path reads
+        this several times per step. The token misses pure refcount
+        flips (a fork de-/re-sharing a parked page), so the value can
+        be one transition stale — benign by design: admission checks
+        here are advisory, and every claim path defers cleanly on a
+        real shortfall (``_ensure_free`` re-derives truth when it
+        actually spills)."""
+        token = (self.clock, self.spills, self.used_pages,
+                 len(self._free), len(self._parked), self.cow_copies,
+                 self.pin_evictions, len(self._pins))
+        if self._sc_cache is not None and self._sc_cache[0] == token:
+            return self._sc_cache[1]
+        n = sum(len(self._spillable(sid)) for sid in self._parked)
+        val = min(n, self.arena.free_pages)
+        self._sc_cache = (token, val)
+        return val
+
+    def restore_headroom(self, seq_id) -> int:
+        """Pages claimable toward RESTORING ``seq_id``: free +
+        pin-evictable + cold pages of the OTHER parked sequences.
+        The candidate's own cold pages are excluded — spilling the
+        sequence being restored frees no net HBM (admission must
+        defer, not thrash)."""
+        other = sum(len(self._spillable(s)) for s in self._parked
+                    if s != seq_id)
+        return len(self._free) + self.evictable_pages \
+            + min(other, self.arena.free_pages)
+
+    # ---- two-tier admission accounting ----
+    def _demand_pages(self) -> int:
+        return self.used_pages - self.evictable_pages \
+            - self.spillable_cold_pages
+
+    def above_high_watermark(self, extra_pages=0) -> bool:
+        return (self._demand_pages() + extra_pages) / self.capacity \
+            > self.high_watermark
+
+    def below_low_watermark(self) -> bool:
+        return self._demand_pages() / self.capacity < self.low_watermark
+
+    @property
+    def available_pages(self) -> int:
+        return super().available_pages + self.spillable_cold_pages
+
+    # ------------------------------------------------------------------
+    # prefetch + restore side
+    # ------------------------------------------------------------------
+    def _restore_order(self, seq_id):
+        sp = self._spilled[seq_id]
+        logicals = sorted(sp)
+        return logicals, [sp[i] for i in logicals]
+
+    def prefetch(self, seq_id) -> bool:
+        """Issue cursor-ahead staging for a parked sequence's arena
+        blocks. Host-side reads happen HERE (main thread owns the
+        arena); the staging thread only device_puts the copies. No-op
+        when the sequence has nothing spilled, staging is disabled, or
+        the bounded queue is full."""
+        if not self._spilled.get(seq_id):
+            return False
+        key = (seq_id, self._spill_gen.get(seq_id, 0))
+        if not self.prefetcher.can_issue(key):
+            return False
+        _, slots = self._restore_order(seq_id)
+        return self.prefetcher.issue(key, self.arena.read(slots),
+                                     self.clock)
+
+    def restore_sequence(self, seq_id) -> int:
+        """Bring a parked sequence fully HBM-resident for re-admission:
+        claim pool pages (deepening the cold spill first if free +
+        pin-evictable pages fall short), scatter the arena blocks back
+        in, and rewrite the block table. Counts a prefetch HIT when the
+        blocks were staged a strictly earlier round (the background
+        thread had a full step to overlap), else a counted bounded
+        STALL — the copy then happens synchronously and the restored
+        bytes are identical either way. Returns pages restored."""
+        sp = self._spilled.get(seq_id)
+        if not sp:
+            self._parked.pop(seq_id, None)
+            return 0
+        n = len(sp)
+        logicals, slots = self._restore_order(seq_id)
+        # claim BEFORE consuming the staged prefetch: _ensure_free may
+        # deepen OTHER parked sequences' spill (never this one —
+        # _restoring guards it: self-spilling frees no net HBM and
+        # would grow the page set mid-restore), and a shortfall raises
+        # PoolExhausted with tables/spill maps untouched (at worst some
+        # LRU pins were evicted — cache, not state) and the staging
+        # still intact for the retry (admission gates on
+        # restore_headroom, so this is the defensive backstop)
+        self._restoring = seq_id
+        try:
+            pages = self._claim(n, f"restore parked sequence {seq_id!r} "
+                                   f"({n} pages)")
+        finally:
+            self._restoring = None
+        key = (seq_id, self._spill_gen.get(seq_id, 0))
+        staged, issued = self.prefetcher.claim(key)
+        if staged is not None and issued < self.clock:
+            self.prefetch_hits += 1
+            blocks = staged
+        else:
+            # the prefetch lost the race to the cursor (or was never
+            # issued / went stale): stage synchronously, count it
+            self.prefetch_stalls += 1
+            self._events.append(("kv_prefetch_stall",
+                                 {"request": seq_id, "pages": n}))
+            blocks = staged if staged is not None \
+                else [{k: jnp.asarray(v) for k, v in ent.items()}
+                      for ent in self.arena.read(slots)]
+        idx = jnp.asarray(pages, jnp.int32)
+        self.kv = [(K.at[:, idx].set(jnp.asarray(ent["K"], self.dtype)),
+                    V.at[:, idx].set(jnp.asarray(ent["V"], self.dtype)))
+                   for (K, V), ent in zip(self.kv, blocks)]
+        if self.kv_scales is not None:
+            self.kv_scales = [
+                (Ks.at[:, idx].set(jnp.asarray(ent["Ks"], jnp.float32)),
+                 Vs.at[:, idx].set(jnp.asarray(ent["Vs"], jnp.float32)))
+                for (Ks, Vs), ent in zip(self.kv_scales, blocks)]
+        self._repin()
+        table = self._tables[seq_id]
+        for i, p in zip(logicals, pages):
+            table[i] = p
+        self.arena.release(slots)
+        del self._spilled[seq_id]
+        self._parked.pop(seq_id, None)
+        self._spill_gen.pop(seq_id, None)
+        return n
+
+    # ------------------------------------------------------------------
+    # lifecycle overrides (host tier cleanup + residency guards)
+    # ------------------------------------------------------------------
+    def free(self, seq_id) -> int:
+        sp = self._spilled.pop(seq_id, None)
+        self._parked.pop(seq_id, None)
+        gen = self._spill_gen.pop(seq_id, None)
+        if gen is not None:
+            self.prefetcher.drop((seq_id, gen))
+        if sp:
+            self.arena.release(list(sp.values()))
+        pages = self._tables.pop(seq_id)
+        self._lens.pop(seq_id, None)
+        return self._release_pages([p for p in pages if p >= 0])
+
+    def padded_block_table(self, seq_id, pages: int) -> list:
+        # the launch-side residency guard: a host sentinel reaching a
+        # block table would make the kernel read recycled HBM bytes —
+        # fail loudly instead (restore_sequence must run first)
+        table = self._tables[seq_id]
+        bad = [p for p in table if p < 0]
+        if bad:
+            self._invariant_fail(
+                f"launch over non-resident sequence {seq_id!r}: "
+                f"{len(bad)} spilled pages in its block table", bad)
+        return super().padded_block_table(seq_id, pages)
+
+    def fork(self, seq_id, parent_id, num_tokens=None):
+        # residency gate BEFORE any bookkeeping: a host sentinel is a
+        # negative "page id" and would silently corrupt refcounts if it
+        # reached the base fork — callers must only fork fully-resident
+        # donor prefixes (the engine's prefix probe checks first)
+        parent = self._tables[parent_id]
+        if num_tokens is None:
+            num_tokens = (self._lens[parent_id] // self.page_size) \
+                * self.page_size
+        bad = [p for p in parent[:self.pages_for(num_tokens)] if p < 0]
+        if bad:
+            raise PoolExhausted(
+                f"fork of {parent_id!r}: donor prefix is not fully "
+                f"resident ({len(bad)} spilled pages)")
+        return super().fork(seq_id, parent_id, num_tokens)
+
+    # ------------------------------------------------------------------
+    # pinned chains: restore into either tier (PR 14 warm restart)
+    # ------------------------------------------------------------------
+    def restore_pinned_chain(self, chain_id, num_tokens, layers) -> bool:
+        """HBM while it fits WITHOUT eviction; overflow lands in the
+        HOST tier instead of evicting another chain (pre-tiering, a
+        restart into a smaller HBM pool silently dropped the colder
+        chains — now the whole warm cache survives). A host-tier chain
+        promotes to HBM (and becomes a real pin, evicting colder pins
+        if it must) on its first ``fork_pinned``."""
+        if num_tokens % self.page_size != 0:
+            raise ValueError(
+                f"restored chains must be page-aligned: {num_tokens} "
+                f"tokens over page_size {self.page_size}")
+        n_pages = num_tokens // self.page_size
+        if n_pages < 1 or n_pages > self.pinned_page_budget:
+            return False
+        if n_pages <= len(self._free) and \
+                self.pinned_pages + n_pages <= self.pinned_page_budget:
+            return super().restore_pinned_chain(chain_id, num_tokens,
+                                                layers)
+        if n_pages > self.arena.free_pages:
+            # no arena room either: the pre-tiering evict-to-fit path
+            # is still better than dropping the chain outright
+            return super().restore_pinned_chain(chain_id, num_tokens,
+                                                layers)
+        want = (self.num_kv_heads, n_pages, self.page_size, self.head_dim)
+        for li, ent in enumerate(layers):
+            if tuple(np.asarray(ent["K"]).shape) != want:
+                raise ValueError(
+                    f"restored chain layer {li}: block shape "
+                    f"{tuple(np.asarray(ent['K']).shape)} != pool {want}")
+        if chain_id in self._host_chains:
+            self.arena.release(self._host_chains.pop(chain_id)[0])
+        slots = self.arena.claim(n_pages)
+        self.arena.write(slots, [
+            {k: ent[k] for k in
+             (("K", "V", "Ks", "Vs") if self.quantized else ("K", "V"))}
+            for ent in layers])
+        self._host_chains[chain_id] = (slots, num_tokens)
+        return True
+
+    def is_pinned(self, chain_id) -> bool:
+        return super().is_pinned(chain_id) or chain_id in self._host_chains
+
+    def _promote_chain(self, chain_id) -> bool:
+        """Move a host-tier chain into HBM as a real pin (first-use
+        promotion). False when HBM still cannot hold it — the chain
+        stays in the host tier, the probe treats it as a miss."""
+        slots, num_tokens = self._host_chains[chain_id]
+        layers = self.arena.read(slots)
+        if not super().restore_pinned_chain(chain_id, num_tokens, layers):
+            return False
+        self.arena.release(slots)
+        del self._host_chains[chain_id]
+        self.host_chain_promotions += 1
+        self._events.append(("kv_chain_promotion",
+                             {"chain_pages": num_tokens // self.page_size}))
+        return True
+
+    def fork_pinned(self, seq_id, chain_id, num_tokens: int) -> list:
+        if chain_id in self._host_chains:
+            if not self._promote_chain(chain_id):
+                raise PoolExhausted(
+                    f"pinned chain {chain_id!r} cannot promote from the "
+                    f"host tier ({self._host_chains[chain_id][1]} tokens)")
+        return super().fork_pinned(seq_id, chain_id, num_tokens)
+
+    def unpin(self, chain_id) -> int:
+        if chain_id in self._host_chains:
+            self.arena.release(self._host_chains.pop(chain_id)[0])
+            return 0
+        return super().unpin(chain_id)
+
+    def export_pinned(self) -> list:
+        """HBM pins (device reads) + host-tier chains (arena reads) —
+        a save must persist the whole warm cache, whichever tier holds
+        each chain."""
+        out = super().export_pinned()
+        for cid, (slots, num_tokens) in self._host_chains.items():
+            out.append({"chain_id": cid, "num_tokens": num_tokens,
+                        "layers": self.arena.read(slots)})
+        return out
+
+    # ------------------------------------------------------------------
+    # invariants: a page lives in exactly one tier
+    # ------------------------------------------------------------------
+    def _resident_table(self, t):
+        return [p for p in t if p >= 0]
+
+    def snapshot(self, offending_pages=()) -> dict:
+        snap = super().snapshot(offending_pages)
+        snap["host_pages_used"] = self.arena.used_pages
+        snap["host_capacity"] = self.arena.capacity
+        snap["parked"] = sorted(self._parked,
+                                key=lambda s: self._parked[s])
+        snap["spilled_pages"] = {s: len(m)
+                                 for s, m in self._spilled.items()}
+        snap["host_chains"] = len(self._host_chains)
+        return snap
+
+    def check_invariants(self):
+        used_slots: dict = {}
+        for sid, t in self._tables.items():
+            sp = self._spilled.get(sid, {})
+            for i, p in enumerate(t):
+                if p < 0:
+                    slot = -(p + 1)
+                    if sp.get(i) != slot:
+                        self._invariant_fail(
+                            f"table {sid!r} logical page {i} names arena "
+                            f"slot {slot} but the spill map says "
+                            f"{sp.get(i)}", [p])
+                    if slot in used_slots:
+                        self._invariant_fail(
+                            f"arena slot {slot} mapped twice "
+                            f"({used_slots[slot]} and {sid!r}) — a page "
+                            f"must live in exactly one tier", [p])
+                    used_slots[slot] = sid
+            if len(sp) != sum(1 for p in t if p < 0):
+                self._invariant_fail(
+                    f"spill map of {sid!r} has {len(sp)} entries but its "
+                    f"table has {sum(1 for p in t if p < 0)} host "
+                    f"sentinels", [])
+        for sid in self._spilled:
+            if sid not in self._tables:
+                self._invariant_fail(
+                    f"spill map names unknown sequence {sid!r}", [])
+        for sid in self._parked:
+            if sid not in self._tables:
+                self._invariant_fail(
+                    f"parked set names unknown sequence {sid!r}", [])
+        for cid, (slots, _n) in self._host_chains.items():
+            for s in slots:
+                if s in used_slots:
+                    self._invariant_fail(
+                        f"arena slot {s} held by host chain {cid!r} AND "
+                        f"{used_slots[s]!r}", [])
+                used_slots[s] = cid
+        free = set(self.arena._free)
+        if len(free) != len(self.arena._free):
+            self._invariant_fail("arena free list has duplicates", [])
+        if free & set(used_slots):
+            self._invariant_fail(
+                f"arena slots both used and free: "
+                f"{sorted(free & set(used_slots))[:8]}", [])
+        if len(used_slots) + len(free) != self.arena.capacity:
+            self._invariant_fail(
+                f"arena accounting leak: {len(used_slots)} used + "
+                f"{len(free)} free != capacity {self.arena.capacity}", [])
+        # pinned pages are never spilled: every pin-counted page must be
+        # a resident pool page (sentinels never enter _pin_counts — this
+        # guards against a future spill path forgetting the exclusion)
+        bad_pins = [p for p in self._pin_counts if p < 0]
+        if bad_pins:
+            self._invariant_fail("pinned page spilled to the host tier",
+                                 bad_pins)
+        return super().check_invariants()
+
+
+__all__ = ["ArenaExhausted", "HostKVArena", "KVPrefetcher",
+           "TieredKVPool"]

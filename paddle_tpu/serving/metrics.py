@@ -236,7 +236,15 @@ class ServingMetrics:
                 # from the store at engine construction; pin-set
                 # snapshots persisted (the write-ahead warm-start path)
                 "restore_fallbacks", "prefix_chains_restored",
-                "prefix_store_saves")
+                "prefix_store_saves",
+                # two-tier KV cache (serving/kv_tier.py): pages spilled
+                # to the host-RAM arena (cold pages of parked
+                # sequences), parked-sequence restores served from a
+                # cursor-ahead background staging, and restores the
+                # prefetcher did NOT stage a full round ahead — the
+                # counted, bounded stall (the copy runs synchronously;
+                # tokens stay bit-identical, only overlap is lost)
+                "kv_spills", "kv_prefetch_hits", "kv_prefetch_stalls")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",
@@ -251,7 +259,12 @@ class ServingMetrics:
               "queue_age_p99_s", "max_queue_wait_s",
               # current graceful-degradation rung (0 = full service;
               # each rung sheds one optional capability in order)
-              "degradation_level")
+              "degradation_level",
+              # two-tier KV cache: host-arena slots in use (sequences +
+              # host-tier pinned chains) and the fraction of live KV
+              # pages that are HBM-resident (1.0 for single-tier pools
+              # — there is no second tier to be non-resident in)
+              "kv_host_pages_used", "kv_resident_fraction")
     #: per-finished-request latency distributions (seconds): TTFT =
     #: arrival -> first generated token, TPOT = mean inter-token after
     #: the first, e2e = arrival -> finalization
@@ -301,6 +314,22 @@ class ServingMetrics:
         self.shared_page_fraction.set(
             getattr(pool, "shared_page_fraction", 0.0))
         self.pinned_pages.set(getattr(pool, "pinned_pages", 0))
+        # two-tier KV sync (kv_tier.py): the pool owns the lifetime
+        # tier-traffic integers; fold the deltas into the counters so
+        # the cluster's counter-carry and the telemetry scraper's
+        # delta decoding see ordinary monotonic counters
+        spills = getattr(pool, "spills", None)
+        if spills is not None:
+            self.kv_spills.inc(spills - self.kv_spills.value)
+            self.kv_prefetch_hits.inc(
+                pool.prefetch_hits - self.kv_prefetch_hits.value)
+            self.kv_prefetch_stalls.inc(
+                pool.prefetch_stalls - self.kv_prefetch_stalls.value)
+            self.kv_host_pages_used.set(pool.host_pages_used)
+            self.kv_resident_fraction.set(pool.resident_fraction)
+        else:
+            self.kv_host_pages_used.set(0.0)
+            self.kv_resident_fraction.set(1.0)
         now = self._now()
         ages = scheduler.queue_ages(now) \
             if hasattr(scheduler, "queue_ages") else []

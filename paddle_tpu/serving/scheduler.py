@@ -333,11 +333,29 @@ class Scheduler:
             if len(admitted) >= self.config.max_prefills_per_step:
                 break
             seq = self.waiting[0]
-            first_len = min(self.config.chunk_size, seq.total_len)
-            n_pages = self.pool.pages_for(first_len)
-            # available = free + reclaimable pinned-exclusive pages (a
-            # pool full of evictable prefix cache must still admit)
-            if n_pages > self.pool.available_pages:
+            # a PARKED sequence (two-tier pools, serving/kv_tier.py)
+            # still owns its table: re-admission must restore its
+            # spilled pages — that restore IS its first-chunk cost
+            parked = seq.seq_id in self.pool
+            if parked:
+                # restore cost + the first chunk's growth past the
+                # pages the sequence already owns, priced against
+                # headroom that EXCLUDES the sequence's own cold pages
+                # (spilling the row being restored frees no net HBM)
+                first_target = min(seq.cached_len + self.config.chunk_size,
+                                   seq.total_len)
+                n_pages = self.pool.spilled_page_count(seq.seq_id) \
+                    + max(0, self.pool.pages_for(first_target)
+                          - len(self.pool.block_table(seq.seq_id)))
+                avail = self.pool.restore_headroom(seq.seq_id)
+            else:
+                first_len = min(self.config.chunk_size, seq.total_len)
+                n_pages = self.pool.pages_for(first_len)
+                # available = free + reclaimable pinned-exclusive pages
+                # (a pool full of evictable prefix cache must still
+                # admit)
+                avail = self.pool.available_pages
+            if n_pages > avail:
                 break
             # watermark admission control: above the high watermark stop
             # taking new work (leave headroom for running seqs to grow),
@@ -351,18 +369,36 @@ class Scheduler:
                 if self._admission_paused:
                     break
             self.waiting.popleft()
-            shared = 0
-            if prefix_hook is not None:
-                shared = int(prefix_hook(seq) or 0)
-            if not shared:
-                self.pool.allocate(seq.seq_id, 0)
-            seq.cached_len = shared
-            # reserve the first chunk's pages now (the watermark math
-            # above priced them in) but commit nothing yet — prepare_step
-            # owns the committed length
-            first_target = min(shared + self.config.chunk_size,
-                               seq.total_len)
-            self.pool.extend(seq.seq_id, first_target)
+            if parked:
+                # exact-byte resume: prefetch-hit or counted stall, the
+                # restored KV is identical — cached_len survives
+                # parking. Restore AND the first chunk's growth can
+                # both fall short if headroom moved under us: defer,
+                # don't die — the row keeps its queue-front slot and
+                # retries next round (a restore that landed stays
+                # landed; the retry's restore is then a no-op).
+                shared = seq.cached_len
+                first_target = min(shared + self.config.chunk_size,
+                                   seq.total_len)
+                try:
+                    self.pool.restore_sequence(seq.seq_id)
+                    self.pool.extend(seq.seq_id, first_target)
+                except PoolExhausted:
+                    self.waiting.appendleft(seq)
+                    break
+            else:
+                shared = 0
+                if prefix_hook is not None:
+                    shared = int(prefix_hook(seq) or 0)
+                if not shared:
+                    self.pool.allocate(seq.seq_id, 0)
+                seq.cached_len = shared
+                # reserve the first chunk's pages now (the watermark
+                # math above priced them in) but commit nothing yet —
+                # prepare_step owns the committed length
+                first_target = min(shared + self.config.chunk_size,
+                                   seq.total_len)
+                self.pool.extend(seq.seq_id, first_target)
             self.pool.set_seq_len(seq.seq_id, shared)
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)
@@ -370,6 +406,20 @@ class Scheduler:
             if self.metrics is not None:
                 self.metrics.prefills.inc()
         return admitted
+
+    def prefetch_candidates(self, limit: int) -> list:
+        """Seq ids of the first ``limit`` PARKED sequences in queue
+        order — the restores the next admission round will want. The
+        engine issues cursor-ahead staging for these at the END of each
+        step, so by the time admission claims them the background
+        thread has had a full step of compute to overlap."""
+        out = []
+        for s in self.waiting:
+            if len(out) >= limit:
+                break
+            if s.seq_id in self.pool:
+                out.append(s.seq_id)
+        return out
 
     # ---- ragged step assembly ----
     def preempt(self, seq: Sequence):
@@ -387,6 +437,43 @@ class Scheduler:
         self.last_preempted.append(seq)
         if self.metrics is not None:
             self.metrics.preemptions.inc()
+
+    def park(self, seq: Sequence):
+        """Two-tier preemption (serving/kv_tier.py): spill the victim's
+        exclusive pages to the host arena and requeue it at the queue
+        FRONT with ``cached_len`` INTACT — re-admission restores the
+        exact bytes instead of recomputing the prefix. Everything else
+        mirrors :meth:`preempt` (same counters, same requeue position),
+        so the client-visible lifecycle is identical and greedy tokens
+        stay bit-identical either way."""
+        self.running.remove(seq)
+        self.pool.park(seq.seq_id)
+        seq.status = SequenceStatus.WAITING
+        seq.num_preemptions += 1
+        seq.enqueued_at = self.config.now_fn()
+        self.waiting.appendleft(seq)
+        self.last_preempted.append(seq)
+        if self.metrics is not None:
+            self.metrics.preemptions.inc()
+
+    def _relieve_pressure(self, seq: Sequence) -> bool:
+        """One pressure-relief move after :class:`PoolExhausted`, in
+        cost order: deepen the cold spill of already-parked sequences
+        (costs nothing semantically) -> park the victim into the host
+        tier (exact-byte restore later) -> classic recompute preemption
+        (the arena is full or the victim has nothing spillable).
+        Returns True to retry the claim, False when ``seq`` itself was
+        evicted (the caller's planning loop drops the row)."""
+        pool = self.pool
+        if hasattr(pool, "spill_cold") and pool.spill_cold() > 0:
+            return True
+        victim = self._pick_victim(exclude=seq)
+        target = victim if victim is not None else seq
+        if hasattr(pool, "can_park") and pool.can_park(target.seq_id):
+            self.park(target)
+        else:
+            self.preempt(target)
+        return target is not seq
 
     def finish(self, seq: Sequence, status=SequenceStatus.FINISHED):
         seq.status = status
@@ -438,11 +525,8 @@ class Scheduler:
                     if 1 <= fit < cap:
                         cap = fit
                         continue
-                    victim = self._pick_victim(exclude=seq)
-                    if victim is None:
-                        self.preempt(seq)
+                    if not self._relieve_pressure(seq):
                         break
-                    self.preempt(victim)
             if seq.status is SequenceStatus.RUNNING:
                 rows.append((seq, cap))
         # a LATER row's PoolExhausted retry can pick an already-planned
@@ -495,11 +579,8 @@ class Scheduler:
                         seq.seq_id, seq.cached_len + spec + 1)
                     break
                 except PoolExhausted:
-                    victim = self._pick_victim(exclude=seq)
-                    if victim is None:
-                        self.preempt(seq)
+                    if not self._relieve_pressure(seq):
                         break
-                    self.preempt(victim)
             if seq.status is SequenceStatus.RUNNING:
                 rows.append((seq, spec))
         rows = [(s, c) for s, c in rows
@@ -548,14 +629,12 @@ class Scheduler:
                         seq.seq_id, seq.cached_len + q_len)
                     break
                 except PoolExhausted:
-                    victim = self._pick_victim(exclude=seq)
-                    if victim is None:
-                        # nothing else to evict: preempt THIS sequence.
-                        # add() guaranteed prompt+max_new fits the empty
-                        # pool, so its re-admission always converges.
-                        self.preempt(seq)
+                    # spill-cold -> park victim -> recompute-preempt.
+                    # False = THIS sequence was evicted (add()
+                    # guaranteed prompt+max_new fits the empty pool, so
+                    # its re-admission always converges).
+                    if not self._relieve_pressure(seq):
                         break
-                    self.preempt(victim)
             if seq.status is SequenceStatus.RUNNING:
                 self._granted[seq.seq_id] = q_len
                 budget_left -= -(-q_len // qb) * qb

@@ -59,6 +59,9 @@ SPAN_KINDS = (
     "deadline_abort",  # terminal: mid-flight e2e SLO abort
     "nonfinite_abort",  # terminal: the in-graph isfinite guard fired
     "finish",         # terminal: finished / cancelled / aborted (reason)
+    "kv_prefetch_stall",  # two-tier KV: a parked sequence's restore was
+                          # not staged a full round ahead — the copy ran
+                          # synchronously (counted, bounded; kv_tier.py)
 )
 
 SCHEMA_VERSION = 1

@@ -116,7 +116,14 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # hit count or save/restore timing is a per-run proof
               "persist_resume_identical", "persist_restore_fallbacks",
               "persist_warm_prefix_hits", "persist_ckpt_save_ms",
-              "persist_ckpt_restore_ms"):
+              "persist_ckpt_restore_ms",
+              # two-tier KV fields (ISSUE 15): the over-capacity
+              # token-identity verdict, spill/prefetch counts, stall
+              # fraction and tier budgets are per-run proofs
+              "kv_tier_token_identical", "kv_tier_spills",
+              "kv_tier_prefetch_hits", "kv_tier_stall_fraction",
+              "kv_tier_deterministic", "kv_tier_hbm_pages",
+              "kv_tier_host_pages"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -569,3 +576,46 @@ def test_proxy_bench_catches_corrupt_checkpoint():
     assert out["persist_resume_identical"] is None
     assert out["persist_warm_prefix_hits"] is None
     assert "persistence_probe_error" in out
+
+
+def test_proxy_bench_catches_disabled_kv_prefetch():
+    """End-to-end two-tier KV regression injection (ISSUE 15): run the
+    kvtier probe with the cursor-ahead staging disabled
+    (--no-prefetch) and gate against the checked-in baseline — every
+    parked-sequence restore becomes a counted stall (fraction 1.0 vs
+    the 0.0 bound), prefetch hits collapse to 0 (exact pin), both
+    gates fail; the healthy collection of the same probe must pass
+    with spills > 0 and token identity intact."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("kvtier",), kvtier_prefetch=False)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "kv_tier_prefetch_hits" in names
+    assert "kv_tier_stall_fraction" in names
+    # even with prefetch off, restores land exact bytes: identity holds
+    assert bad["metrics"]["kv_tier_token_identical"] == 1
+    assert bad["metrics"]["kv_tier_prefetch_hits"] == 0
+    assert bad["metrics"]["kv_tier_stall_fraction"] == 1.0
+
+    good = pb.collect(probes=("kvtier",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["kv_tier_token_identical"] == 1
+    assert good["metrics"]["kv_tier_spills"] > 0
+    assert good["metrics"]["kv_tier_prefetch_hits"] > 0
+    assert good["metrics"]["kv_tier_stall_fraction"] == 0.0
+    assert good["metrics"]["kv_tier_deterministic"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_kv_tiering(Boom())
+    assert out["kv_tier_token_identical"] is None
+    assert out["kv_tier_spills"] is None
+    assert "kv_tiering_probe_error" in out

@@ -1,0 +1,550 @@
+"""Two-tier KV cache (serving/kv_tier.py): host-RAM spill arena +
+cursor-ahead prefetch.
+
+The contract under test (ISSUE 15): an engine whose HBM page budget is
+strictly smaller than the workload's working set serves it
+TOKEN-IDENTICALLY to an all-HBM oracle — parked sequences spill exact
+bytes to the host arena and restore them bit-exactly (int8 scale
+columns included), pinned chains and CoW-shared pages never spill,
+block tables only ever name resident pages (invariant-audited), and
+hit-vs-stall prefetch accounting is deterministic on the virtual round
+clock.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (Driver, VirtualClock, WorkloadSpec,
+                                build_report, report_json)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ArenaExhausted, HostKVArena,
+                                InvariantViolation, LLMEngine,
+                                TieredKVPool)
+from paddle_tpu.serving.cluster import _CARRIED_COUNTERS
+
+
+def _tpool(num_pages=9, host_pages=8, page_size=4, dtype=jnp.float32,
+           **kw):
+    return TieredKVPool(2, 2, 8, num_pages=num_pages,
+                        page_size=page_size, host_pages=host_pages,
+                        dtype=dtype, **kw)
+
+
+def _fill(pool, seq_id, seed):
+    """Deterministically fill a sequence's resident pages with
+    recognizable values; returns the per-layer K blocks for later
+    bit-comparison."""
+    rng = np.random.default_rng(seed)
+    pages = [p for p in pool._tables[seq_id] if p >= 0]
+    idx = jnp.asarray(pages, jnp.int32)
+    saved = []
+    new_kv = []
+    for K, V in pool.kv:
+        blk = rng.standard_normal(
+            (K.shape[0], len(pages)) + K.shape[2:]).astype(K.dtype)
+        new_kv.append((K.at[:, idx].set(blk), V.at[:, idx].set(blk * 2)))
+        saved.append(blk)
+    pool.kv = new_kv
+    return saved
+
+
+def _read_seq(pool, seq_id):
+    """Gather a fully-resident sequence's K pages (per layer)."""
+    pages = pool._tables[seq_id]
+    assert all(p >= 0 for p in pages)
+    idx = jnp.asarray(pages, jnp.int32)
+    return [np.asarray(K[:, idx]) for K, _ in pool.kv]
+
+
+# ---------------------------------------------------------------------------
+# HostKVArena
+# ---------------------------------------------------------------------------
+
+def test_arena_claim_write_read_release_roundtrip():
+    a = HostKVArena(2, 2, 8, num_pages=4, page_size=4)
+    assert a.capacity == 4 and a.free_pages == 4
+    slots = a.claim(3)
+    assert a.used_pages == 3
+    rng = np.random.default_rng(0)
+    layers = [{"K": rng.standard_normal((2, 3, 4, 8)).astype(np.float32),
+               "V": rng.standard_normal((2, 3, 4, 8)).astype(np.float32)}
+              for _ in range(2)]
+    a.write(slots, layers)
+    back = a.read(slots)
+    for ent, ref in zip(back, layers):
+        np.testing.assert_array_equal(ent["K"], ref["K"])
+        np.testing.assert_array_equal(ent["V"], ref["V"])
+    with pytest.raises(ArenaExhausted):
+        a.claim(2)
+    a.release(slots)
+    assert a.free_pages == 4
+    with pytest.raises(ValueError):
+        a.release([0])          # double free
+
+
+def test_arena_bytes_match_pool_geometry():
+    a = HostKVArena(2, 2, 8, num_pages=16, page_size=4)
+    from paddle_tpu.serving import PagedKVPool
+    per = PagedKVPool.page_bytes_for(2, 2, 8, 4, jnp.float32)
+    assert a.arena_bytes == per * 16
+
+
+# ---------------------------------------------------------------------------
+# spill policy: exclusivity, pins, CoW
+# ---------------------------------------------------------------------------
+
+def test_park_spills_exclusive_pages_only():
+    p = _tpool()
+    p.allocate("a", 8)                    # 2 pages
+    p.fork("b", "a", 4)                   # page 0 shared (rc 2)
+    p.tick()
+    freed = p.park("a")
+    assert freed == 1 and p.spills == 1
+    t = p._tables["a"]
+    assert t[0] >= 0                      # shared page stays resident
+    assert t[1] < 0                       # exclusive page spilled
+    assert p.arena.used_pages == 1
+    assert p.is_parked("a") and not p.fully_resident("a")
+    p.check_invariants()
+
+
+def test_pinned_chains_are_never_spilled():
+    p = _tpool(pinned_page_budget=4)
+    p.allocate("a", 8)                    # 2 full pages
+    assert p.pin("chain", "a", 4)         # pins page 0
+    p.tick()
+    p.park("a")
+    t = p._tables["a"]
+    assert t[0] >= 0, "pinned page must stay HBM-resident"
+    assert t[1] < 0
+    # the pin survives a full free of the sequence, like always
+    p.restore_sequence("a")
+    p.free("a")
+    assert p.is_pinned("chain")
+    p.check_invariants()
+
+
+def test_cow_divergence_on_a_spilled_parent():
+    p = _tpool(num_pages=12, host_pages=8)
+    p.allocate("parent", 12)              # 3 pages, committed 12
+    saved = _fill(p, "parent", seed=1)
+    p.fork("child", "parent", 5)          # shares pages 0,1 (page 1
+    #                                       partially filled: 5 of 8)
+    p.tick()
+    p.park("parent")                      # spills page 2 only
+    assert p.spilled_page_count("parent") == 1
+    # the child APPENDS into the shared partial page -> CoW copies it;
+    # the parked parent keeps the original bytes
+    cow = p.prepare_append("child", 6)
+    assert cow == 1 and p.cow_copies == 1
+    # parent's shared page 1 is now exclusive again -> cold-spillable
+    assert p.spillable_cold_pages >= 1
+    assert p.spill_cold() == 1
+    assert p.spilled_page_count("parent") == 2
+    p.check_invariants()
+    # restore: every original byte back, bit for bit
+    p.restore_sequence("parent")
+    for blk, ref in zip(_read_seq(p, "parent"), saved):
+        np.testing.assert_array_equal(blk, ref)
+    p.check_invariants()
+
+
+def test_int8_scale_columns_ride_spill_restore_bit_exactly():
+    p = _tpool(dtype=jnp.int8)
+    p.allocate("a", 8)
+    pages = list(p._tables["a"])
+    idx = jnp.asarray(pages, jnp.int32)
+    rng = np.random.default_rng(3)
+    k_ref, s_ref = [], []
+    new_kv, new_scales = [], []
+    for (K, V), (Ks, Vs) in zip(p.kv, p.kv_scales):
+        kb = rng.integers(-127, 128,
+                          (2, len(pages), 4, 8)).astype(np.int8)
+        sb = rng.uniform(0.01, 0.5, (2, len(pages))).astype(np.float32)
+        new_kv.append((K.at[:, idx].set(kb), V.at[:, idx].set(kb)))
+        new_scales.append((Ks.at[:, idx].set(sb), Vs.at[:, idx].set(sb)))
+        k_ref.append(kb)
+        s_ref.append(sb)
+    p.kv, p.kv_scales = new_kv, new_scales
+    p.tick()
+    p.park("a")
+    assert p.spilled_page_count("a") == 2
+    p.tick()
+    p.restore_sequence("a")
+    new_pages = p._tables["a"]
+    nidx = jnp.asarray(new_pages, jnp.int32)
+    for li in range(p.num_layers):
+        np.testing.assert_array_equal(
+            np.asarray(p.kv[li][0][:, nidx]), k_ref[li])
+        np.testing.assert_array_equal(
+            np.asarray(p.kv_scales[li][0][:, nidx]), s_ref[li])
+    p.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# residency invariants + launch guard
+# ---------------------------------------------------------------------------
+
+def test_padded_block_table_refuses_non_resident_sequence():
+    p = _tpool()
+    p.allocate("a", 8)
+    p.tick()
+    p.park("a")
+    with pytest.raises(InvariantViolation):
+        p.padded_block_table("a", 4)
+    p.restore_sequence("a")
+    assert len(p.padded_block_table("a", 4)) == 4
+
+
+def test_check_invariants_audits_exactly_one_tier():
+    p = _tpool()
+    p.allocate("a", 8)
+    p.tick()
+    p.park("a")
+    p.check_invariants()
+    # a sentinel the spill map does not know about
+    sp = dict(p._spilled["a"])
+    p._tables["a"][1] = -(7 + 1)
+    with pytest.raises(InvariantViolation):
+        p.check_invariants()
+    p._tables["a"][1] = -(sp[1] + 1)
+    p.check_invariants()
+    # the same arena slot mapped from two sequences = one page in two
+    # places — the audit must refuse
+    p.allocate("b", 4)
+    p._tables["b"][0] = -(sp[1] + 1)
+    p._spilled["b"] = {0: sp[1]}
+    with pytest.raises(InvariantViolation):
+        p.check_invariants()
+
+
+def test_fork_refuses_partially_spilled_donor():
+    p = _tpool()
+    p.allocate("a", 8)
+    p.tick()
+    p.park("a")
+    from paddle_tpu.serving import PoolExhausted
+    with pytest.raises(PoolExhausted):
+        p.fork("c", "a", 8)
+    assert "c" not in p
+    p.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# deterministic prefetch accounting
+# ---------------------------------------------------------------------------
+
+def test_prefetch_hit_requires_a_full_round_of_lead():
+    p = _tpool()
+    p.allocate("a", 8)
+    p.tick()
+    p.park("a")
+    assert p.prefetch("a")
+    p.tick()                               # a full round passes
+    p.restore_sequence("a")
+    assert (p.prefetch_hits, p.prefetch_stalls) == (1, 0)
+    # no lead: issue and claim in the same round = the race was lost
+    p.park("a")
+    p.prefetch("a")
+    p.restore_sequence("a")
+    assert (p.prefetch_hits, p.prefetch_stalls) == (1, 1)
+    # never issued at all = stall too, and an event for the recorder
+    p.park("a")
+    p.tick()
+    p.restore_sequence("a")
+    assert (p.prefetch_hits, p.prefetch_stalls) == (1, 2)
+    kinds = [k for k, _ in p.drain_events()]
+    assert kinds.count("kv_prefetch_stall") == 2
+    p.check_invariants()
+
+
+def test_restore_under_pressure_never_self_spills():
+    """Review regression: a restore must never deepen the spill of the
+    sequence being restored (that frees no net HBM and mutates the
+    page set mid-restore). With zero true headroom the restore is a
+    CLEAN PoolExhausted — spill map untouched, invariants intact —
+    and admission prices the restore via restore_headroom, which
+    excludes the candidate's own cold pages."""
+    from paddle_tpu.serving import PoolExhausted
+    p = _tpool(num_pages=5, host_pages=8)      # 4 usable HBM pages
+    p.allocate("parent", 12)                   # 3 pages, committed 12
+    saved = _fill(p, "parent", seed=4)
+    p.fork("child", "parent", 5)               # shares pages 0,1
+    p.tick()
+    p.park("parent")                           # spills page 2 only
+    p.prepare_append("child", 6)               # CoW on shared page 1
+    p.allocate("w", 4)                         # free -> 0
+    assert p.free_pages == 0 and p.evictable_pages == 0
+    # parent's de-shared page 1 is cold-spillable, but it must not
+    # count toward restoring parent itself
+    assert p.spillable_cold_pages == 1
+    assert p.restore_headroom("parent") == 0
+    with pytest.raises(PoolExhausted):
+        p.restore_sequence("parent")
+    assert p.spilled_page_count("parent") == 1, "no self-deepening"
+    assert p.is_parked("parent")
+    p.check_invariants()
+    # pressure clears: the deferred restore succeeds, bytes intact
+    p.free("w")
+    p.restore_sequence("parent")
+    for blk, ref in zip(_read_seq(p, "parent"), saved):
+        np.testing.assert_array_equal(blk, ref)
+    p.check_invariants()
+
+
+def test_extend_reaches_cold_pages_via_ensure_free():
+    """Review regression: any page claim — not just restores — must be
+    able to deepen the cold spill of parked sequences. A running row's
+    extend with zero free pages and no pins must spill a parked row's
+    de-shared cold page instead of raising PoolExhausted."""
+    p = _tpool(num_pages=5, host_pages=8)      # 4 usable HBM pages
+    p.allocate("parent", 12)                   # pages A,B,C
+    p.fork("child", "parent", 5)               # shares A,B
+    p.tick()
+    p.park("parent")                           # spills C; free = 2
+    p.prepare_append("child", 6)               # CoW page -> free = 1
+    p.allocate("w", 4)                         # free = 0
+    assert p.free_pages == 0 and p.evictable_pages == 0
+    # parent's de-shared page is the only headroom left — extend must
+    # reach it through _ensure_free's cold-spill pass
+    fresh = p.extend("w", 8)
+    assert len(fresh) == 1
+    assert p.spilled_page_count("parent") == 2
+    p.check_invariants()
+
+
+def test_disabled_prefetch_counts_every_restore_as_stall():
+    p = _tpool(prefetch=False)
+    p.allocate("a", 8)
+    p.tick()
+    p.park("a")
+    assert not p.prefetch("a")
+    p.tick()
+    p.restore_sequence("a")
+    assert (p.prefetch_hits, p.prefetch_stalls) == (0, 1)
+
+
+def test_restore_bytes_identical_hit_or_stall():
+    for lead in (True, False):
+        p = _tpool()
+        p.allocate("a", 8)
+        saved = _fill(p, "a", seed=9)
+        p.tick()
+        p.park("a")
+        if lead:
+            p.prefetch("a")
+            p.tick()
+        p.restore_sequence("a")
+        for blk, ref in zip(_read_seq(p, "a"), saved):
+            np.testing.assert_array_equal(blk, ref)
+
+
+# ---------------------------------------------------------------------------
+# two-tier accounting (admission-bugfix satellite)
+# ---------------------------------------------------------------------------
+
+def test_tier_byte_accounting_and_budgets():
+    p = _tpool(num_pages=9, host_pages=6)
+    assert p.tier_bytes() == (p.page_bytes * 9, p.page_bytes * 6)
+    hbm, host = TieredKVPool.pages_for_byte_budgets(
+        p.page_bytes * 10, p.page_bytes * 3, 2, 2, 8, 4)
+    assert (hbm, host) == (10, 3)
+    assert p.total_capacity == p.capacity + 6
+
+
+def test_watermarks_discount_spillable_cold_pages():
+    p = _tpool(num_pages=9, host_pages=8, high_watermark=0.6,
+               low_watermark=0.3)
+    p.allocate("a", 16)                   # 4 of 8 pages
+    p.allocate("b", 16)                   # 8 of 8 -> way above high
+    assert p.above_high_watermark()
+    p.tick()
+    p.park("a")                           # 4 pages now in the arena
+    assert not p.above_high_watermark()
+    # "b" parked too: everything spillable-or-spilled, demand ~0
+    p.park("b")
+    assert p.below_low_watermark()
+    assert p.available_pages == p.capacity
+    p.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine level: over-capacity token identity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=2, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+_PROMPTS = [[(11 * i + 3 + j) % 128 for j in range(n)]
+            for i, n in enumerate((6, 5, 7, 6))]
+
+
+def _run_engine(model, max_new=24, **kw):
+    eng = LLMEngine(model, max_len=64, page_size=8, max_num_seqs=4,
+                    seed=0, **kw)
+    rids = [eng.add_request(p, max_new_tokens=max_new) for p in _PROMPTS]
+    eng.run(max_steps=4000)
+    eng.pool.check_invariants()
+    return eng, {r: eng.outputs()[r].token_ids for r in rids}
+
+
+def test_over_capacity_engine_is_token_identical_to_oracle(tiny_model):
+    _, oracle = _run_engine(tiny_model)
+    # 8 usable HBM pages; the 4 rows need 16 at full length
+    eng, toks = _run_engine(tiny_model, num_pages=9, host_kv_pages=64)
+    assert toks == oracle
+    s = eng.metrics_snapshot()
+    assert s["kv_spills"] > 0
+    assert s["kv_prefetch_hits"] > 0
+    assert s["kv_prefetch_stalls"] == 0, \
+        "steady-state restores must all be staged a round ahead"
+    assert s["kv_host_pages"] == 64
+    assert 0.0 < s["kv_resident_fraction"] <= 1.0
+
+
+def test_over_capacity_int8_engine_is_token_identical(tiny_model):
+    _, oracle = _run_engine(tiny_model, kv_cache_dtype="int8")
+    eng, toks = _run_engine(tiny_model, kv_cache_dtype="int8",
+                            num_pages=9, host_kv_pages=64)
+    assert toks == oracle
+    assert eng.metrics_snapshot()["kv_spills"] > 0
+
+
+def test_tiny_arena_falls_back_to_recompute_preemption(tiny_model):
+    _, oracle = _run_engine(tiny_model)
+    # a 1-slot arena cannot hold any victim's pages: parking is
+    # refused, pressure is answered the classic recompute way, and
+    # tokens are STILL identical (the pre-tiering guarantee survives)
+    eng, toks = _run_engine(tiny_model, num_pages=9, host_kv_pages=1)
+    assert toks == oracle
+    s = eng.metrics_snapshot()
+    assert s["preemptions"] > 0
+    assert s["kv_spills"] == 0
+
+
+def test_parked_sequence_refuses_withdraw(tiny_model):
+    eng = LLMEngine(tiny_model, max_len=64, page_size=8, max_num_seqs=4,
+                    seed=0, num_pages=9, host_kv_pages=64)
+    rids = [eng.add_request(p, max_new_tokens=24) for p in _PROMPTS]
+    parked = None
+    for _ in range(4000):
+        eng.step()
+        parked = next((r for r in rids if eng.pool.is_parked(r)), None)
+        if parked or not eng.has_unfinished():
+            break
+    assert parked is not None, "the over-capacity run must park someone"
+    # a parked row owns pages and streamed tokens: the cluster drain
+    # path must leave it to finish here, like a running row
+    assert eng.withdraw(parked) is False
+    eng.run(max_steps=4000)
+
+
+def test_tiered_loadgen_report_is_byte_reproducible(tiny_model):
+    spec = WorkloadSpec(num_requests=10, seed=5, arrival="deterministic",
+                        arrival_rate=200.0, prompt_len=(4, 10),
+                        output_len=(12, 20), vocab_size=128)
+
+    def run():
+        clock = VirtualClock()
+        eng = LLMEngine(tiny_model, max_len=64, page_size=8,
+                        max_num_seqs=4, now_fn=clock.now, seed=0,
+                        num_pages=9, host_kv_pages=64)
+        res = Driver(eng, clock, step_time_s=0.01).run(spec.compile())
+        return eng, report_json(build_report(res, spec=spec,
+                                             trace=spec.compile()))
+
+    e1, r1 = run()
+    e2, r2 = run()
+    assert r1 == r2
+    assert e1.metrics_snapshot()["kv_spills"] == \
+        e2.metrics_snapshot()["kv_spills"]
+    assert '"kv_tiering"' in r1        # the report carries the tier story
+
+
+# ---------------------------------------------------------------------------
+# PR 14 prefix store: warm restart into a tiered pool (either tier)
+# ---------------------------------------------------------------------------
+
+def test_prefix_store_warm_restart_into_tiered_pool(tiny_model, tmp_path):
+    store = str(tmp_path / "prefix_store")
+    prefix = [(7 * j + 1) % 128 for j in range(16)]
+
+    def engine(**kw):
+        return LLMEngine(tiny_model, max_len=64, page_size=8,
+                         max_num_seqs=4, pinned_prefix_pages=8, seed=0,
+                         prefix_store=store, **kw)
+
+    ea = engine()
+    ea.add_request(prefix + [5, 6, 7], max_new_tokens=4)
+    ea.run(max_steps=400)
+    assert ea.metrics.prefix_store_saves.value >= 1
+    # plenty of HBM: the chain restores straight into the HBM tier
+    eb = engine(num_pages=33, host_kv_pages=16)
+    assert eb.metrics.prefix_chains_restored.value >= 1
+    eb.add_request(prefix + [9, 10], max_new_tokens=4)
+    eb.run(max_steps=400)
+    assert eb.metrics.pinned_prefix_hits.value >= 1
+    assert eb.metrics.restore_fallbacks.value == 0
+
+
+def test_prefix_store_restores_into_host_tier_when_hbm_is_tight(
+        tiny_model, tmp_path):
+    store = str(tmp_path / "prefix_store")
+    prefix1 = [(5 * j + 2) % 128 for j in range(16)]   # 2 pinned pages
+    prefix2 = [(9 * j + 4) % 128 for j in range(16)]   # 2 pinned pages
+
+    def engine(**kw):
+        return LLMEngine(tiny_model, max_len=64, page_size=8,
+                         max_num_seqs=4, pinned_prefix_pages=8, seed=0,
+                         prefix_store=store, **kw)
+
+    ea = engine()
+    ea.add_request(prefix1 + [5, 6, 7], max_new_tokens=4)
+    ea.add_request(prefix2 + [5, 6, 7], max_new_tokens=4)
+    ea.run(max_steps=400)
+    # 3 usable HBM pages hold ONE 2-page chain: pre-tiering the second
+    # chain would have evicted the first; with a host tier BOTH survive
+    # — the overflow chain lands in the arena at restore...
+    eb = engine(num_pages=4, host_kv_pages=16)
+    assert eb.metrics.prefix_chains_restored.value >= 2
+    assert eb.pool._host_chains, "overflow chain must land in host tier"
+    host_chain = next(iter(eb.pool._host_chains))
+    # ...and promotes to a real HBM pin on its first cohort hit
+    hot = prefix1 if tuple(prefix1) == host_chain else prefix2
+    eb.add_request(hot + [9, 10], max_new_tokens=2)
+    eb.run(max_steps=400)
+    assert eb.pool.host_chain_promotions >= 1
+    assert eb.metrics.pinned_prefix_hits.value >= 1
+    eb.pool.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# fleet plumbing
+# ---------------------------------------------------------------------------
+
+def test_kv_tier_counters_are_cluster_carried_and_documented():
+    for c in ("kv_spills", "kv_prefetch_hits", "kv_prefetch_stalls"):
+        assert c in _CARRIED_COUNTERS, (
+            f"{c} must survive replica crashes like every other counter")
+    from paddle_tpu.serving import ServingMetrics
+    assert "kv_host_pages_used" in ServingMetrics.GAUGES
+    assert "kv_resident_fraction" in ServingMetrics.GAUGES
+
+
+def test_single_tier_metrics_read_absent_not_zero_sized(tiny_model):
+    eng = LLMEngine(tiny_model, max_len=64, page_size=8, max_num_seqs=2,
+                    seed=0)
+    eng.add_request([1, 2, 3], max_new_tokens=2)
+    eng.run(max_steps=100)
+    s = eng.metrics_snapshot()
+    assert s["kv_host_pages"] is None and s["kv_host_bytes"] is None
+    assert s["kv_resident_fraction"] == 1.0
+    assert s["kv_spills"] == 0

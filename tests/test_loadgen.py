@@ -520,3 +520,117 @@ def test_soak_mixed_overload_recovery(tiny_model):
     # and the report still serializes stably
     assert report_json(report) == report_json(
         build_report(result, spec=storm))
+
+
+# ---------------------------------------------------------------------------
+# scenario lanes (ISSUE 15, ROADMAP 5d): long-context + offline batch
+# ---------------------------------------------------------------------------
+
+def test_classic_trace_fingerprint_byte_persists():
+    """The lane knobs (lane / long_context_*) must be draw-free and
+    fingerprint-free at their defaults: this hex was recorded when the
+    lanes landed and pins the classic compile stream — a drift means a
+    default-lane spec no longer reproduces pre-lane traces."""
+    spec = WorkloadSpec(num_requests=8, seed=11, arrival="poisson",
+                        arrival_rate=50.0, prompt_len=(4, 12),
+                        output_len=(2, 6), vocab_size=64)
+    assert trace_fingerprint(spec.compile()) == (
+        "39ba8677b6a929cf6974a2dce535b35f968534bec0b3401e22042664b9653ad3")
+    # explicitly spelling out the defaults is the same spec
+    same = dataclasses.replace(spec, lane="interactive",
+                               long_context_fraction=0.0)
+    assert trace_fingerprint(same.compile()) == \
+        trace_fingerprint(spec.compile())
+
+
+def test_long_context_lane_compiles_and_fingerprints():
+    spec = WorkloadSpec(num_requests=40, seed=21, prompt_len=(4, 10),
+                        output_len=(2, 4), shared_prefix_fraction=0.5,
+                        shared_prefix_len=3,
+                        long_context_fraction=0.3,
+                        long_context_len=(64, 96), vocab_size=64)
+    t1, t2 = spec.compile(), spec.compile()
+    assert t1 == t2
+    longs = [r for r in t1 if len(r.prompt_token_ids) >= 64]
+    shorts = [r for r in t1 if len(r.prompt_token_ids) <= 10]
+    assert longs and shorts, "the lane is a MIX of long and short"
+    assert all(64 <= len(r.prompt_token_ids) <= 96 for r in longs)
+    # a long document is not a repeated system prompt: never cohorted
+    assert all(r.prefix_cohort == -1 for r in longs)
+    other = dataclasses.replace(spec, long_context_len=(64, 97))
+    assert trace_fingerprint(other.compile()) != trace_fingerprint(t1)
+    # validation: the 128k ceiling and the fraction/range contract
+    from paddle_tpu.loadgen import LONG_CONTEXT_CEILING
+    assert LONG_CONTEXT_CEILING == 131072
+    with pytest.raises(ValueError, match="ceiling"):
+        WorkloadSpec(long_context_fraction=0.1,
+                     long_context_len=(4, LONG_CONTEXT_CEILING + 1))
+    with pytest.raises(ValueError, match="long_context_len"):
+        WorkloadSpec(long_context_fraction=0.1)
+    # the ceiling itself is legal spec-side (chip-scale runs compile
+    # real 128k prompts; CI drives the same lane at toy lengths)
+    WorkloadSpec(long_context_fraction=0.1,
+                 long_context_len=(131072, 131072))
+
+
+def test_offline_batch_lane_scores_throughput_not_latency(tiny_model):
+    with pytest.raises(ValueError, match="offline_batch"):
+        WorkloadSpec(lane="offline_batch", deadline_s=0.5)
+    with pytest.raises(ValueError, match="lane"):
+        WorkloadSpec(lane="bulk")
+    spec = WorkloadSpec(num_requests=12, seed=3, lane="offline_batch",
+                        arrival="deterministic", arrival_rate=1000.0,
+                        prompt_len=(4, 10), output_len=(3, 6),
+                        vocab_size=128)
+    clock = VirtualClock()
+    eng = _engine(tiny_model, clock, max_len=64, page_size=8,
+                  max_num_seqs=4)
+    result = Driver(eng, clock, step_time_s=0.01).run(spec.compile())
+    report = build_report(result, spec=spec, trace=spec.compile())
+    ob = report["offline_batch"]
+    gen = report["throughput"]["tokens_generated"]
+    assert ob["batch_tokens_per_s"] == gen / result.duration_s
+    assert ob["batch_total_tokens_per_s"] > ob["batch_tokens_per_s"]
+    assert ob["prompt_tokens"] == sum(
+        r.prompt_len for r in result.records)
+    assert report["requests"]["shed"] == 0
+    # byte-stable like every other artifact
+    assert report_json(report) == report_json(
+        build_report(result, spec=spec, trace=spec.compile()))
+    # an interactive report does NOT grow the section
+    ispec = dataclasses.replace(spec, lane="interactive")
+    assert "offline_batch" not in build_report(result, spec=ispec)
+
+
+def test_long_context_lane_drives_two_tier_engine(tiny_model):
+    """The lanes and the two-tier KV cache composed: a long-context mix
+    whose working set exceeds HBM serves token-identically to an
+    all-HBM oracle, byte-reproducible report included (the over-
+    capacity acceptance gate at loadgen level)."""
+    spec = WorkloadSpec(num_requests=10, seed=5, arrival="deterministic",
+                        arrival_rate=200.0, prompt_len=(4, 10),
+                        output_len=(16, 24), long_context_fraction=0.25,
+                        long_context_len=(40, 56), vocab_size=128)
+
+    def run(**kw):
+        clock = VirtualClock()
+        eng = _engine(tiny_model, clock, max_len=128, page_size=8,
+                      max_num_seqs=4, **kw)
+        res = Driver(eng, clock, step_time_s=0.01).run(spec.compile())
+        rep = report_json(build_report(res, spec=spec,
+                                       trace=spec.compile()))
+        return eng, rep, {rid: list(o.token_ids)
+                          for rid, o in eng.outputs().items()}
+
+    _, _, oracle = run()
+    e1, rep1, toks1 = run(num_pages=13, host_kv_pages=64)
+    _, rep2, toks2 = run(num_pages=13, host_kv_pages=64)
+    assert toks1 == oracle, \
+        "over-capacity tiered engine must be token-identical to oracle"
+    assert (rep1, toks1) == (rep2, toks2)
+    s = e1.metrics_snapshot()
+    assert s["kv_spills"] > 0 and s["kv_prefetch_hits"] > 0
+    assert s["kv_prefetch_stalls"] == 0
+    # the long-context requests individually outgrow HALF the HBM tier,
+    # and the mix outgrows all of it: live context is host-RAM-bound
+    assert e1.pool.capacity < 16 <= e1.pool.total_capacity

@@ -77,7 +77,7 @@ BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
           "jaxpr", "accounting", "fusion", "tracing", "telemetry",
-          "persist")
+          "persist", "kvtier")
 
 
 class Gate:
@@ -200,13 +200,29 @@ GATES = {
     "persist_resume_identical":  Gate("lower", 0.0, 0.0),
     "persist_restore_fallbacks": Gate("higher", 0.0, 0.0),
     "persist_warm_prefix_hits":  Gate("lower", 0.0, 0.0),
+    # two-tier KV cache (serving/kv_tier.py via probe_kv_tiering): an
+    # engine whose HBM page budget is strictly smaller than the seeded
+    # workload's working set (long-context lane included) must serve it
+    # TOKEN-IDENTICALLY to an all-HBM oracle, actually exercising the
+    # tiers (spill/prefetch-hit counts pinned exactly — a drift means
+    # the spill policy or admission math changed; re-record
+    # deliberately), with ZERO steady-state prefetch stalls (every
+    # restore staged a full round ahead of the cursor) and a
+    # byte-reproducible loadgen report per seed. --no-prefetch disables
+    # the cursor-ahead staging: every restore becomes a counted stall,
+    # hits drop to 0, and these gates must catch it.
+    "kv_tier_token_identical":   Gate("lower", 0.0, 0.0),
+    "kv_tier_spills":            Gate("different"),
+    "kv_tier_prefetch_hits":     Gate("different"),
+    "kv_tier_stall_fraction":    Gate("higher", 0.0, 0.0),
+    "kv_tier_deterministic":     Gate("lower", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
             fusion_defuse=False, telemetry_burn_alerts=True,
-            persist_corrupt=False) -> dict:
+            persist_corrupt=False, kvtier_prefetch=True) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -235,6 +251,11 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     every version of the probe's stored training checkpoint AND prefix
     store — resume identity breaks, restores fall back, warm hits
     vanish, and the ``persist_*`` gates must catch all of it.
+    ``kvtier_prefetch=False`` (--no-prefetch) disables the two-tier KV
+    probe's cursor-ahead staging — every parked-sequence restore
+    becomes a counted stall and prefetch hits drop to 0; the
+    ``kv_tier_stall_fraction`` and ``kv_tier_prefetch_hits`` gates
+    must catch it.
     """
     import jax
     import paddle_tpu as paddle
@@ -243,6 +264,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
                                     probe_opt_dispatches,
+                                    probe_kv_tiering,
                                     probe_persistence, probe_serving,
                                     probe_spec_decode, probe_telemetry,
                                     probe_tracing)
@@ -306,6 +328,13 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
         _take(probe_persistence(paddle, corrupt=persist_corrupt),
               ("persist_resume_identical", "persist_restore_fallbacks",
                "persist_warm_prefix_hits"))
+    if "kvtier" in probes:
+        # hbm/host page counts ride bench.py's artifact only — the
+        # five gated fields are the deterministic contract
+        _take(probe_kv_tiering(paddle, prefetch=kvtier_prefetch),
+              ("kv_tier_token_identical", "kv_tier_spills",
+               "kv_tier_prefetch_hits", "kv_tier_stall_fraction",
+               "kv_tier_deterministic"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -396,6 +425,11 @@ def main(argv=None) -> int:
                          "prefix store: resume identity breaks and "
                          "warm prefix hits vanish (the injected "
                          "regression)")
+    ap.add_argument("--no-prefetch", action="store_true",
+                    help="disable the two-tier KV probe's cursor-ahead "
+                         "staging: every parked-sequence restore "
+                         "becomes a counted stall and prefetch hits "
+                         "read 0 (the injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -423,7 +457,8 @@ def main(argv=None) -> int:
                       cluster_retry_budget=0 if args.no_retry else 2,
                       fusion_defuse=args.defuse,
                       telemetry_burn_alerts=not args.no_burn_alerts,
-                      persist_corrupt=args.corrupt_checkpoint)
+                      persist_corrupt=args.corrupt_checkpoint,
+                      kvtier_prefetch=not args.no_prefetch)
 
     if args.json:
         # --json changes the output format, never the action: combined
