@@ -41,7 +41,8 @@ import numpy as np
 # baseline-compare harness); bench.py keeps its artifact schema and
 # spreads the same fields into the flagship JSON line
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tools.bench_probes import (probe_gspmd,  # noqa: E402
+from tools.bench_probes import (probe_disagg,  # noqa: E402
+                                probe_gspmd,
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
                                 probe_kv_tiering,
@@ -62,6 +63,7 @@ _probe_tracing = probe_tracing
 _probe_telemetry = probe_telemetry
 _probe_persistence = probe_persistence
 _probe_kv_tiering = probe_kv_tiering
+_probe_disagg = probe_disagg
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -226,6 +228,7 @@ def run_bench(config="llama_125m", progress=None):
     telemetry_probe = _probe_telemetry(paddle)
     persistence_probe = _probe_persistence(paddle)
     kv_tier_probe = _probe_kv_tiering(paddle)
+    disagg_probe = _probe_disagg(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -300,6 +303,7 @@ def run_bench(config="llama_125m", progress=None):
         **telemetry_probe,
         **persistence_probe,
         **kv_tier_probe,
+        **disagg_probe,
     }
 
 
@@ -615,6 +619,18 @@ def _failure_artifact(last_err, last_stages):
         "kv_tier_deterministic": None,
         "kv_tier_hbm_pages": None,
         "kv_tier_host_pages": None,
+        # disaggregated-serving fields are per-run proofs too: a
+        # token-identity verdict, fabric page count, fleet prefix hit
+        # rate, or TTFT ratio from a stale round proves nothing about
+        # the run that failed
+        "disagg_token_identical": None,
+        "disagg_kv_pages_transferred": None,
+        "disagg_fleet_prefix_hit_rate": None,
+        "disagg_transfer_stall_fraction": None,
+        "disagg_ttft_ratio_vs_colocated": None,
+        "disagg_deterministic": None,
+        "disagg_ttft_p99_s": None,
+        "disagg_colocated_ttft_p99_s": None,
     }
     good = _last_good_round()
     if good:

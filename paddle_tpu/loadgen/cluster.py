@@ -62,6 +62,12 @@ class ClusterRunResult:
     #: autoscale applications the driver made (scale_to calls whose
     #: target differed from the provisioned count)
     scale_events: int = 0
+    #: decode-progress assertions that RAN and passed (disaggregated
+    #: runs with ``check_decode_progress=True``: every caught-up row on
+    #: a full-speed decode replica must gain a token every step — the
+    #: "a 32k prompt never starves decode" gate, proof-by-survival like
+    #: the pool audits); 0 = the check was off or never applicable
+    decode_progress_checks: int = 0
 
     def by_status(self) -> dict:
         out: dict[str, int] = {}
@@ -77,7 +83,8 @@ class ClusterDriver:
 
     def __init__(self, cluster, clock: VirtualClock, *, step_time_s=0.01,
                  max_steps=200_000, check_invariants=True, check_every=1,
-                 scraper=None, autoscale=False):
+                 scraper=None, autoscale=False,
+                 check_decode_progress=False):
         if step_time_s <= 0:
             raise ValueError("step_time_s must be > 0")
         if cluster._now != clock.now:
@@ -107,6 +114,10 @@ class ClusterDriver:
         #: APPLIED to the live cluster through ``scale_to`` after each
         #: round — autoscaling policies testable as code, chip-free
         self.autoscale = bool(autoscale)
+        #: disaggregation's headline liveness gate: every caught-up row
+        #: on a full-speed decode replica must gain a token EVERY step,
+        #: whatever prompt flood the prefill pool is chewing
+        self.check_decode_progress = bool(check_decode_progress)
 
     def run(self, trace) -> ClusterRunResult:
         cluster = self.cluster
@@ -156,9 +167,15 @@ class ClusterDriver:
             # at the round's END time. An idle-but-faulted cluster still
             # rounds through here so its state machine keeps moving.
             clock.advance(self.step_time_s)
+            before = None
+            if self.check_decode_progress:
+                before = self._decode_rows(cluster)
             touched = cluster.step()
             steps += 1
             now = clock.now()
+            if before:
+                result.decode_progress_checks += \
+                    self._assert_decode_progress(cluster, before)
             for out in touched:
                 rec = records.get(out.request_id)
                 if rec is not None:
@@ -221,6 +238,52 @@ class ClusterDriver:
             self.scraper.finalize(clock.now())
         result.telemetry = self.scraper
         return result
+
+    # ---- decode-progress gate (disaggregated serving) ----
+    @staticmethod
+    def _decode_rows(cluster):
+        """Caught-up rows on decode replicas that WILL step at full
+        speed this round: (replica, seq) -> (generation, tokens). Rows
+        on slowed replicas are excluded — a slowdown fault legitimately
+        skips engine steps, which is latency, not starvation."""
+        from ..serving.cluster import ACTIVE_STATES
+        rows = {}
+        for rep in cluster.replicas:
+            if rep.role != "decode" or rep.engine is None \
+                    or rep.state not in ACTIVE_STATES \
+                    or rep.slow_multiplier != 1.0 \
+                    or (rep.flaky_until is not None):
+                continue
+            for seq in rep.engine.scheduler.running:
+                if seq.uncached_len == 1 and seq.tokens:
+                    rows[(rep.rid, seq.seq_id)] = (rep.generation,
+                                                   len(seq.tokens))
+        return rows
+
+    @staticmethod
+    def _assert_decode_progress(cluster, before) -> int:
+        """Every snapshot row still RUNNING on the same engine body
+        must have gained at least one token. Finished / preempted /
+        crashed-away rows are exempt (they left the running set, they
+        did not starve on it). Returns the number of assertions that
+        ran and passed; a violation raises out of the run."""
+        checked = 0
+        for (rid, sid), (gen, n) in before.items():
+            rep = cluster.replicas[rid]
+            if rep.engine is None or rep.generation != gen:
+                continue
+            seq = rep.engine._seqs.get(sid)
+            if seq is None or not any(
+                    s is seq for s in rep.engine.scheduler.running):
+                continue
+            if len(seq.tokens) <= n:
+                raise AssertionError(
+                    f"decode starvation: request {sid!r} on decode "
+                    f"replica {rid} held {n} tokens across a full-speed "
+                    f"step — the disaggregation contract (decode rows "
+                    f"advance every step) is broken")
+            checked += 1
+        return checked
 
     #: record folding is IDENTICAL to the single-engine driver's (a
     #: requeued request's token list resets and regrows, so only

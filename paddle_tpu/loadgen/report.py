@@ -298,6 +298,38 @@ def build_cluster_report(result, *, spec=None, trace=None,
             "per_replica": reps,
         },
     })
+    dis = m.get("disagg")
+    if dis is not None:
+        # disaggregated (roles=) runs only — colocated artifacts
+        # byte-persist without the section. Page transfers, stalls, and
+        # fleet-prefix hits are per-replica carried counters summed
+        # fleet-wide here; the fabric/fleet-prefix dicts come from the
+        # cluster snapshot verbatim.
+        report["disagg"] = {
+            "collapsed": dis.get("collapsed"),
+            "collapses": dis.get("counters", {}).get("collapses", 0),
+            "collapse_restores":
+                dis.get("counters", {}).get("collapse_restores", 0),
+            "handoffs": dis.get("counters", {}).get("handoffs", 0),
+            "transfer_drops":
+                dis.get("counters", {}).get("transfer_drops", 0),
+            "transfer_requeues":
+                dis.get("counters", {}).get("transfer_requeues", 0),
+            "transfer_slow_faults":
+                dis.get("counters", {}).get("transfer_slow_faults", 0),
+            "transfer_drop_faults":
+                dis.get("counters", {}).get("transfer_drop_faults", 0),
+            "fabric": dis.get("fabric"),
+            "fleet_prefix": dis.get("fleet_prefix"),
+            "kv_pages_transferred": _csum("kv_pages_transferred"),
+            "transfer_stalls": _csum("transfer_stalls"),
+            "fleet_prefix_hits": _csum("fleet_prefix_hits"),
+            "prefill_queue_depth": dis.get("prefill_queue_depth"),
+            "decode_queue_depth": dis.get("decode_queue_depth"),
+            "decode_progress_checks":
+                getattr(result, "decode_progress_checks", 0),
+            "roles": [r.get("role") for r in reps],
+        }
     if tracer is not None:
         report["latency_breakdown"] = _breakdown_section(tracer)
     tel = _telemetry_section(result, telemetry)

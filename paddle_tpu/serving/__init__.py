@@ -32,7 +32,15 @@ Layers (docs/SERVING.md has the full architecture):
   hysteretic graceful-degradation ladder per replica.
 - :mod:`faults` — ``FaultSchedule``/``FaultEvent``: seeded,
   virtual-clock fault injection (crash/drain/slowdown/kv-pressure/
-  flaky) so fleet robustness claims reproduce byte-for-byte chip-free.
+  flaky/transfer-slow/transfer-drop) so fleet robustness claims
+  reproduce byte-for-byte chip-free.
+- :mod:`fabric` — ``KVFabric`` + ``TransferModel`` +
+  ``FleetPrefixCache``: the page-granular KV transfer fabric behind
+  disaggregated prefill/decode serving (``ClusterEngine(roles=...)``)
+  — finished prefill KV pages stream to the assigned decode replica
+  on the virtual clock, and content-addressed pinned prefix chains
+  publish fleet-wide so any replica faults them in without a
+  re-prefill.
 """
 from .kv_cache import (InvariantViolation, PagedKVPool,  # noqa: F401
                        PoolExhausted, NULL_PAGE)
@@ -49,14 +57,17 @@ from .faults import (FaultEvent, FaultSchedule,  # noqa: F401
                      InjectedFault)
 from .tracing import (FlightRecorder, RequestTracer,  # noqa: F401
                       latency_breakdown, request_breakdown)
+from .fabric import (FleetPrefixCache, KVFabric,  # noqa: F401
+                     Transfer, TransferModel)
 from .cluster import (ClusterEngine, DegradationLadder,  # noqa: F401
-                      ReplicaState)
+                      FleetDegradation, ReplicaState)
 
 __all__ = ["ArenaExhausted", "BurstPlan", "ClusterEngine",
            "DegradationLadder",
            "DraftWorker", "FaultEvent", "FaultSchedule",
+           "FleetDegradation", "FleetPrefixCache",
            "FlightRecorder", "Histogram", "HostKVArena", "KVPrefetcher",
-           "TieredKVPool",
+           "KVFabric", "TieredKVPool", "Transfer", "TransferModel",
            "InjectedFault", "InvariantViolation", "LLMEngine",
            "Request", "RequestOutput", "RequestRejected", "PagedKVPool",
            "PoolExhausted", "PrefixStoreMismatch", "NULL_PAGE",

@@ -33,9 +33,24 @@ fleet layer a million-user front door actually needs:
   outputs never regress.
 - **Fault injection** — a :class:`~paddle_tpu.serving.faults.
   FaultSchedule` fires crash / drain / slowdown / kv-pressure / flaky
-  events at virtual-clock step boundaries (serving/faults.py), so
-  fleet-level robustness claims are reproducible chip-free: the same
-  seed reproduces the same crashes, requeues, and report bytes.
+  / transfer-slow / transfer-drop events at virtual-clock step
+  boundaries (serving/faults.py), so fleet-level robustness claims are
+  reproducible chip-free: the same seed reproduces the same crashes,
+  requeues, and report bytes.
+- **Disaggregated prefill/decode serving** — ``roles=`` splits the
+  fleet into a PREFILL pool and a DECODE pool joined by a
+  page-granular KV fabric (serving/fabric.py). New requests route to
+  the prefill pool; once a request's prompt is committed and its first
+  token sampled, its KV pages stream to a decode replica (session
+  affinity, power-of-two otherwise) and its prefill row slot frees
+  IMMEDIATELY — a 32k-token prompt never again pins a slot through its
+  whole decode. Chunked-prefill boundaries stream pages ahead, so the
+  final handoff only bills the last chunk. Token identity survives the
+  split by the same argument as retries: draws are pure functions of
+  (seed, position). A fleet-scope hysteresis rung
+  (:class:`FleetDegradation`) collapses routing back to colocated when
+  either pool empties or the fabric saturates — counted and
+  flight-recorded, never a hang — and restores when pressure clears.
 
 Token identity under failure: every replica is built with the SAME
 engine seed, so a request's sampling streams
@@ -69,6 +84,7 @@ from dataclasses import dataclass, field
 
 from .engine import LLMEngine, Request, RequestOutput, RequestRejected
 from .faults import FaultSchedule, InjectedFault
+from .kv_cache import PoolExhausted
 
 
 class ReplicaState(enum.Enum):
@@ -104,7 +120,13 @@ _CARRIED_COUNTERS = ("tokens_generated", "finished_requests", "prefills",
                      # replica's spill/prefetch story must survive into
                      # the fleet report like every other counter
                      "kv_spills", "kv_prefetch_hits",
-                     "kv_prefetch_stalls")
+                     "kv_prefetch_stalls",
+                     # disaggregated serving (serving/fabric.py): pages
+                     # landed here, handoffs the fabric refused, and
+                     # fleet-store prefix hits — the disagg story of a
+                     # crashed replica survives like every other counter
+                     "kv_pages_transferred", "transfer_stalls",
+                     "fleet_prefix_hits")
 
 
 class DegradationLadder:
@@ -223,12 +245,62 @@ class DegradationLadder:
             eng.scheduler.config.max_prefills_per_step = mpps
 
 
+class FleetDegradation:
+    """The FLEET-scope rung of the degradation ladder: collapse
+    disaggregated routing back to colocated under sustained pressure.
+
+    The per-engine :class:`DegradationLadder` rungs are untouched (they
+    shed per-replica work); this guard watches fleet-level disagg
+    health once per cluster round — an empty admittable prefill or
+    decode pool, or fabric back-pressure (depth refusals) — and, after
+    ``engage_after`` consecutive pressured rounds, COLLAPSES: the
+    router ignores roles (any admittable replica takes any request,
+    exactly the colocated topology) and no new handoffs issue.
+    In-flight transfers still land (or requeue as fresh retries when
+    their destination died) — collapse is a routing decision, never a
+    hang. ``restore_after`` consecutive calm rounds restore
+    disaggregated routing. Both directions count
+    (``collapses``/``collapse_restores``) and flight-record, the same
+    observability contract as the per-engine rungs."""
+
+    def __init__(self, *, engage_after=3, restore_after=8):
+        if engage_after < 1 or restore_after < 1:
+            raise ValueError("engage_after/restore_after must be >= 1")
+        self.engage_after = int(engage_after)
+        self.restore_after = int(restore_after)
+        self.collapsed = False
+        self._hot = 0
+        self._cool = 0
+
+    def observe(self, pressured: bool) -> str | None:
+        """One hysteresis tick; returns "collapse"/"restore" on a
+        transition, None otherwise."""
+        if pressured:
+            self._hot += 1
+            self._cool = 0
+            if not self.collapsed and self._hot >= self.engage_after:
+                self.collapsed = True
+                self._hot = 0
+                return "collapse"
+        else:
+            self._cool += 1
+            self._hot = 0
+            if self.collapsed and self._cool >= self.restore_after:
+                self.collapsed = False
+                self._cool = 0
+                return "restore"
+        return None
+
+
 @dataclass
 class _Replica:
     """Cluster-side state of one engine replica."""
     rid: int
     engine: LLMEngine | None
     ladder: DegradationLadder | None
+    #: disaggregated serving pool membership: "prefill" / "decode", or
+    #: None in the colocated (default) topology
+    role: str | None = None
     state: ReplicaState = ReplicaState.HEALTHY
     state_since: float = 0.0
     state_time: dict = field(default_factory=dict)
@@ -286,12 +358,34 @@ class ClusterEngine:
                  recovery_steps=2, crash_after_flaky=3,
                  crash_recover_s=None, faults: FaultSchedule | None = None,
                  ladder=True, ladder_kw=None, tracer=None,
-                 flight_capacity=256, prefix_store=None, **engine_kw):
+                 flight_capacity=256, prefix_store=None, roles=None,
+                 transfer_model=None, fabric_depth=4,
+                 fleet_prefix_cache=None, collapse_after=3,
+                 collapse_restore_after=8, **engine_kw):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, "
                              f"got {num_replicas}")
         if retry_budget < 0:
             raise ValueError("retry_budget must be >= 0")
+        # disaggregated serving: roles=("prefill", ..., "decode", ...)
+        # splits the fleet; None (the default) is the colocated topology
+        # and leaves EVERY code path — including the seeded router
+        # stream — byte-identical to a cluster without this feature
+        if roles is not None:
+            roles = tuple(str(r) for r in roles)
+            if len(roles) != num_replicas:
+                raise ValueError(
+                    f"roles has {len(roles)} entries for {num_replicas} "
+                    f"replicas")
+            bad = [r for r in roles if r not in ("prefill", "decode")]
+            if bad:
+                raise ValueError(
+                    f"roles must be 'prefill' or 'decode', got {bad}")
+            if "prefill" not in roles or "decode" not in roles:
+                raise ValueError(
+                    "disaggregated serving needs at least one prefill "
+                    "AND one decode replica")
+        self._roles = roles
         self.num_replicas = num_replicas
         self._now = now_fn
         self.retry_budget = int(retry_budget)
@@ -332,6 +426,44 @@ class ClusterEngine:
                     now_fn=self._now)
             self._engine_kw["prefix_store"] = prefix_store
         self.prefix_store = self._engine_kw.get("prefix_store")
+        # disaggregated serving plumbing (serving/fabric.py): the KV
+        # fabric, the fleet-wide prefix cache, and the collapse guard
+        # only exist in roles mode — the colocated default constructs
+        # none of them and consumes no extra seeded-RNG draws
+        self.fabric = None
+        self.fleet_prefix = None
+        self._collapse_guard = None
+        self.disagg_counters = {k: 0 for k in (
+            "handoffs", "transfer_drops", "transfer_requeues",
+            "collapses", "collapse_restores", "transfer_slow_faults",
+            "transfer_drop_faults")}
+        self._pending_injections: deque = deque()
+        self._decode_affinity: dict[object, int] = {}
+        self._round_disagg_pressure = False
+        if roles is not None:
+            from .fabric import FleetPrefixCache, KVFabric
+            self.fabric = KVFabric(transfer_model, depth=fabric_depth)
+            if fleet_prefix_cache is None or fleet_prefix_cache is True:
+                # default ON in roles mode: a prompt prefilled anywhere
+                # in the fleet is never re-prefilled anywhere — backed
+                # by the shared ArtifactStore when one exists (chains
+                # survive replica crashes), memory-backed otherwise
+                fleet_prefix_cache = FleetPrefixCache(
+                    store=self.prefix_store)
+            self.fleet_prefix = fleet_prefix_cache
+            self._engine_kw["fleet_prefix_cache"] = self.fleet_prefix
+            self._collapse_guard = FleetDegradation(
+                engage_after=collapse_after,
+                restore_after=collapse_restore_after)
+        elif fleet_prefix_cache:
+            # colocated fleets may still opt into the shared cache
+            # (cross-replica warm prefixes without disaggregation)
+            from .fabric import FleetPrefixCache
+            if fleet_prefix_cache is True:
+                fleet_prefix_cache = FleetPrefixCache(
+                    store=self.prefix_store)
+            self.fleet_prefix = fleet_prefix_cache
+            self._engine_kw["fleet_prefix_cache"] = self.fleet_prefix
         self._ladder_on = ladder
         self._ladder_kw = dict(ladder_kw or {})
         #: seeded router stream: power-of-two-choices candidate draws
@@ -348,8 +480,10 @@ class ClusterEngine:
             "state_transitions", "kv_pressure_faults", "slowdown_faults",
             "flight_dumps", "scale_ups", "scale_downs")}
         now = self._now()
-        self.replicas = [self._new_replica(i, now)
-                         for i in range(num_replicas)]
+        self.replicas = [
+            self._new_replica(i, now,
+                              roles[i] if roles is not None else None)
+            for i in range(num_replicas)]
         self._requests: dict[str, Request] = {}
         self._meta: dict[str, dict] = {}
         self._outputs: dict[str, RequestOutput] = {}
@@ -365,19 +499,26 @@ class ClusterEngine:
     # ------------------------------------------------------------------
     # replica construction / health
     # ------------------------------------------------------------------
-    def _new_engine(self, rid=None) -> LLMEngine:
+    def _new_engine(self, rid=None, role=None) -> LLMEngine:
         # every replica gets the SAME engine seed: a request's sampling
         # streams are pure functions of (engine seed, request seed,
         # position), so a retry on another replica regenerates the same
         # tokens — the cross-replica token-identity contract
+        kw = self._engine_kw
+        if role == "decode" and not kw.get("host_kv_pages"):
+            # decode replicas default to a two-tier pool: transferred
+            # pages land in the host arena as PARKED sequences and ride
+            # the cursor-ahead prefetch path into HBM — the fabric's
+            # staging buffer. An explicit host_kv_pages overrides.
+            kw = dict(kw, host_kv_pages=64)
         return LLMEngine(self._model, now_fn=self._now, seed=self._seed,
-                         engine_id=rid, **self._engine_kw)
+                         engine_id=rid, **kw)
 
-    def _new_replica(self, rid: int, now: float) -> _Replica:
-        eng = self._new_engine(rid)
+    def _new_replica(self, rid: int, now: float, role=None) -> _Replica:
+        eng = self._new_engine(rid, role)
         ladder = DegradationLadder(eng, **self._ladder_kw) \
             if self._ladder_on else None
-        rep = _Replica(rid=rid, engine=eng, ladder=ladder,
+        rep = _Replica(rid=rid, engine=eng, ladder=ladder, role=role,
                        state=ReplicaState.HEALTHY, state_since=now)
         rep.health = self._health_of(rep)
         rep.health_at = now
@@ -432,8 +573,57 @@ class ClusterEngine:
     # routing
     # ------------------------------------------------------------------
     def _candidates(self) -> list:
-        return [r for r in self.replicas
-                if r.state in ADMITTABLE_STATES and r.engine is not None]
+        cands = [r for r in self.replicas
+                 if r.state in ADMITTABLE_STATES and r.engine is not None]
+        if self._roles is not None and not self.collapsed:
+            # stage-1 routing: new prompts go to the PREFILL pool. An
+            # empty admittable prefill pool falls back to the whole
+            # fleet (a per-dispatch mini-collapse — better served
+            # colocated than parked) and reads as collapse pressure.
+            pf = [r for r in cands if r.role == "prefill"]
+            if pf:
+                return pf
+            self._round_disagg_pressure = True
+        return cands
+
+    @property
+    def collapsed(self) -> bool:
+        """True while the fleet rung has disaggregation collapsed to
+        colocated routing (always False outside roles mode)."""
+        return self._collapse_guard is not None \
+            and self._collapse_guard.collapsed
+
+    def _route_decode(self, rid: str):
+        """Stage-2 routing: pick the decode replica a finished prefill
+        hands its KV pages to. Session affinity first (the session's
+        decode rows share a replica, so ITS prefix chains and forks
+        stay warm), then power-of-two-choices over the same seeded
+        stream as stage 1. None when no decode replica is admittable —
+        the request simply keeps decoding on its prefill replica
+        (correctness never depends on the handoff happening)."""
+        cands = [r for r in self.replicas
+                 if r.state in ADMITTABLE_STATES and r.engine is not None
+                 and r.role == "decode"]
+        if not cands:
+            self._round_disagg_pressure = True
+            return None
+        session = self._meta[rid]["session"]
+        if self.session_affinity and session is not None:
+            aff = self._decode_affinity.get(session)
+            for r in cands:
+                if r.rid == aff:
+                    self.counters["affinity_hits"] += 1
+                    return r
+        if len(cands) == 1:
+            pick = cands[0]
+        else:
+            i, j = self._rng.sample(range(len(cands)), 2)
+            pick = min(cands[i], cands[j],
+                       key=lambda r: (self._score(r), r.rid))
+        self.counters["router_decisions"] += 1
+        if session is not None:
+            self._decode_affinity[session] = pick.rid
+        return pick
 
     def _route(self, rid: str):
         """Pick a replica for ``rid``: session affinity if its pinned
@@ -606,7 +796,11 @@ class ClusterEngine:
         if n > len(provisioned):
             for _ in range(n - len(provisioned)):
                 rid = len(self.replicas)
-                self.replicas.append(self._new_replica(rid, now))
+                # roles mode: scale-ups join the DECODE pool (decode
+                # capacity is what tracks load; prefill slots recycle)
+                self.replicas.append(self._new_replica(
+                    rid, now,
+                    "decode" if self._roles is not None else None))
                 self.counters["scale_ups"] += 1
                 self.flight.record("scale_up", now, replica=rid)
                 if self.tracer is not None:
@@ -656,6 +850,8 @@ class ClusterEngine:
         touched: dict[str, RequestOutput] = {}
         self._apply_faults(now, touched)
         self._tick_states(now)
+        if self.fabric is not None:
+            self._land_transfers(now, touched)
         self._redispatch(now, touched)
         for rep in self.replicas:
             if rep.state not in ACTIVE_STATES or rep.engine is None:
@@ -699,6 +895,11 @@ class ClusterEngine:
                     else ReplicaState.HEALTHY, now)
             for out in outs:
                 self._absorb(rep, out, touched)
+            if self.fabric is not None and rep.role == "prefill" \
+                    and not self.collapsed:
+                self._handoffs(rep, now)
+        if self._collapse_guard is not None:
+            self._observe_collapse(now)
         return list(touched.values())
 
     def run(self, max_steps=None):
@@ -735,6 +936,17 @@ class ClusterEngine:
             if ev.kind == "crash":
                 if rep.engine is not None:
                     self._crash(rep, now, ev.recover_s, touched)
+            elif ev.kind == "transfer_slow":
+                # fabric faults target the wire, not the engine — they
+                # apply even while the replica's body is being rebuilt
+                if self.fabric is not None:
+                    self.fabric.set_slow(ev.replica, now + ev.duration_s,
+                                         ev.magnitude)
+                    self.disagg_counters["transfer_slow_faults"] += 1
+            elif ev.kind == "transfer_drop":
+                if self.fabric is not None:
+                    self.fabric.set_drop(ev.replica, now + ev.duration_s)
+                    self.disagg_counters["transfer_drop_faults"] += 1
             elif rep.engine is None:
                 continue                      # window faults need a body
             elif ev.kind == "drain":
@@ -752,7 +964,7 @@ class ClusterEngine:
         for rep in self.replicas:
             if rep.state is ReplicaState.DOWN:
                 if rep.recover_at is not None and now >= rep.recover_at:
-                    rep.engine = self._new_engine(rep.rid)
+                    rep.engine = self._new_engine(rep.rid, rep.role)
                     # fresh engine, fresh counters: the generation bump
                     # is what tells the telemetry scraper to treat the
                     # next counter readings as a reset and to fold the
@@ -876,6 +1088,16 @@ class ClusterEngine:
         for rid in victims:
             self._meta[rid]["replica"] = None
             self._requeue(rid, now, touched, from_replica=rep.rid)
+        if self.fabric is not None:
+            # in-flight transfers TO the dead replica lose their landing
+            # pad: requeue as fresh retries. Transfers FROM it are fine
+            # — their bytes were captured host-side at extraction.
+            for tr in self.fabric.cancel_dst(rep.rid):
+                out = self._outputs.get(tr.rid)
+                if out is not None and not out.finished:
+                    self.disagg_counters["transfer_requeues"] += 1
+                    self._requeue(tr.rid, now, touched,
+                                  from_replica=rep.rid)
 
     def _requeue(self, rid: str, now: float, touched: dict,
                  from_replica=None):
@@ -965,6 +1187,110 @@ class ClusterEngine:
                 break
 
     # ------------------------------------------------------------------
+    # disaggregated serving: handoffs / landings / collapse rung
+    # ------------------------------------------------------------------
+    def _handoffs(self, rep: _Replica, now: float):
+        """After a prefill replica's step: stream chunk-boundary pages
+        for mid-prefill rows, and hand every caught-up row (prompt
+        committed, first token sampled) to a decode replica. A refusal
+        — fabric at depth — counts a ``transfer_stall`` on the source
+        and the row simply keeps decoding here until a later round; a
+        missing decode pool skips the handoff entirely. Neither is ever
+        a hang: local decode remains correct, just colocated."""
+        eng = rep.engine
+        pool = eng.pool
+        for seq in list(eng.scheduler.running):
+            rid = seq.seq_id
+            if rid not in self._meta:
+                continue                 # fault ballast, not a request
+            if seq.uncached_len != 1 or not seq.tokens:
+                # chunked prefill in progress: the pages finished so far
+                # stream ahead, so the eventual handoff bills only the
+                # final chunk
+                self.fabric.stream(rid, pool.pages_for(seq.cached_len))
+                continue
+            dst = self._route_decode(rid)
+            if dst is None:
+                continue
+            if self.fabric.in_flight >= self.fabric.depth:
+                self.fabric.counters["refusals"] += 1
+                eng.metrics.transfer_stalls.inc()
+                self._round_disagg_pressure = True
+                continue
+            pages = pool.pages_for(seq.cached_len)
+            payload = eng.extract_request(rid)
+            self.fabric.issue(rid, payload, src=rep.rid, dst=dst.rid,
+                              pages=pages, now=now)
+            self._meta[rid]["replica"] = None     # in transit
+            self.disagg_counters["handoffs"] += 1
+
+    def _land_transfers(self, now: float, touched: dict):
+        """Start-of-round: transfers whose modeled latency elapsed land
+        on their decode replica (plus injections deferred by a full
+        pool last round). A landing whose destination died or left the
+        admittable set requeues as a FRESH retry — re-prefill
+        regenerates the identical tokens, so correctness never depends
+        on the bytes arriving."""
+        pending = list(self._pending_injections)
+        self._pending_injections.clear()
+        for tr in pending + self.fabric.take_ready(now):
+            self._land_one(tr, now, touched)
+
+    def _land_one(self, tr, now: float, touched: dict):
+        rid = tr.rid
+        out = self._outputs.get(rid)
+        if out is None or out.finished:
+            return                       # cancelled/shed while in flight
+        if tr.dropped:
+            # transfer_drop fault: the payload is lost after its modeled
+            # latency — count it and requeue (recompute keeps correctness)
+            self.disagg_counters["transfer_drops"] += 1
+            self.flight.record("transfer_drop", now, request=rid,
+                               src=tr.src, dst=tr.dst, pages=tr.pages)
+            self._requeue(rid, now, touched, from_replica=tr.src)
+            return
+        dst = self.replicas[tr.dst]
+        if dst.engine is None or dst.state not in ADMITTABLE_STATES:
+            self.disagg_counters["transfer_requeues"] += 1
+            self._requeue(rid, now, touched, from_replica=tr.dst)
+            return
+        try:
+            dst.engine.inject_request(tr.payload)
+        except PoolExhausted:
+            # destination momentarily full: decode rows always advance,
+            # so pages free — retry the injection next round
+            self._pending_injections.append(tr)
+            return
+        except (KeyError, ValueError):
+            self.disagg_counters["transfer_requeues"] += 1
+            self._requeue(rid, now, touched, from_replica=tr.dst)
+            return
+        self._meta[rid]["replica"] = tr.dst
+        if self.tracer is not None:
+            # the cross-pool hop in the request's timeline: the latency
+            # breakdown carves latency_s out of the decode window
+            self.tracer.span(rid, "transfer", now, src=tr.src,
+                             dst=tr.dst, pages=tr.pages,
+                             latency_s=tr.ready_at - tr.issued_at)
+        out.status = "running"
+        touched[rid] = out
+
+    def _observe_collapse(self, now: float):
+        """One fleet-rung hysteresis tick per cluster round."""
+        move = self._collapse_guard.observe(self._round_disagg_pressure)
+        self._round_disagg_pressure = False
+        if move == "collapse":
+            self.disagg_counters["collapses"] += 1
+            self.flight.record("disagg_collapse", now)
+            if self.tracer is not None:
+                self.tracer.event("disagg_collapse", now)
+        elif move == "restore":
+            self.disagg_counters["collapse_restores"] += 1
+            self.flight.record("disagg_restore", now)
+            if self.tracer is not None:
+                self.tracer.event("disagg_restore", now)
+
+    # ------------------------------------------------------------------
     # absorption / observability
     # ------------------------------------------------------------------
     def _absorb(self, rep: _Replica, out, touched: dict):
@@ -990,6 +1316,8 @@ class ClusterEngine:
         cout.num_preemptions = meta["preempt_base"] + out.num_preemptions
         if cout.finished:
             self._unfinished.pop(rid, None)
+            if self.fabric is not None:
+                self.fabric.forget(rid)       # drop streaming credit
         touched[rid] = cout
 
     def metrics_snapshot(self) -> dict:
@@ -1005,7 +1333,7 @@ class ClusterEngine:
                 + (now - rep.state_since)
             for k, v in st.items():
                 agg_state[k] = agg_state.get(k, 0.0) + v
-            reps.append({
+            entry = {
                 "replica": rep.rid,
                 "state": rep.state.value,
                 "state_time_s": st,
@@ -1026,7 +1354,10 @@ class ClusterEngine:
                                  ReplicaState.RECOVERING),
                 "counters": {k: rep.counter(k)
                              for k in _CARRIED_COUNTERS},
-            })
+            }
+            if self._roles is not None:
+                entry["role"] = rep.role
+            reps.append(entry)
         out = dict(self.counters)
         out.update({
             "num_replicas": self.num_replicas,
@@ -1036,8 +1367,37 @@ class ClusterEngine:
             "time_in_state_s": agg_state,
             "replicas": reps,
         })
+        if self._roles is not None:
+            # disagg view: per-pool queue depths (routing pressure the
+            # colocated gauges cannot show), the fabric's lifetime
+            # counters, and the fleet rung's state — keyed off roles
+            # mode so a colocated snapshot stays byte-identical
+            def _pool_depth(role):
+                return sum(r.health["queue_depth"] + r.health["running"]
+                           for r in self.replicas
+                           if r.role == role and r.engine is not None)
+            out["disagg"] = {
+                "collapsed": self.collapsed,
+                "counters": dict(self.disagg_counters),
+                "fabric": dict(self.fabric.counters),
+                "transfers_in_flight": self.fabric.in_flight,
+                "pending_injections": len(self._pending_injections),
+                "prefill_queue_depth": _pool_depth("prefill"),
+                "decode_queue_depth": _pool_depth("decode"),
+                "fleet_prefix": dict(self.fleet_prefix.counters)
+                if self.fleet_prefix is not None else None,
+            }
         return out
+
+    def next_transfer_t(self):
+        """Virtual time of the earliest in-flight transfer landing
+        (None when the fabric is idle or absent) — the driver's
+        idle-jump bound alongside :meth:`next_fault_t`: a cluster
+        waiting only on the wire must wake when the wire delivers."""
+        if self.fabric is None or not self.fabric._inflight:
+            return None
+        return min(t.ready_at for t in self.fabric._inflight)
 
 
 __all__ = ["ACTIVE_STATES", "ADMITTABLE_STATES", "ClusterEngine",
-           "DegradationLadder", "ReplicaState"]
+           "DegradationLadder", "FleetDegradation", "ReplicaState"]

@@ -269,7 +269,7 @@ class LLMEngine:
                  engine_id=None, gauge_stale_after_s=None,
                  prefix_store=None, prefix_store_autosave=None,
                  host_kv_pages=0, kv_prefetch=True, kv_prefetch_depth=4,
-                 kv_spill_seed=0):
+                 kv_spill_seed=0, fleet_prefix_cache=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -531,6 +531,13 @@ class LLMEngine:
             self._prefix_autosave = True if prefix_store_autosave is None \
                 else bool(prefix_store_autosave)
             self._restore_prefix_store()
+        #: fleet-wide prefix cache (serving/fabric.py FleetPrefixCache,
+        #: cluster-scope, shared by every replica): chains this engine
+        #: pins publish into it, and the admission probe falls back to
+        #: it when no local donor or pinned chain matches — a prompt
+        #: prefilled once anywhere in the fleet is never re-prefilled
+        #: here, even if the publishing replica has since crashed.
+        self.fleet_prefix = fleet_prefix_cache
         self._step_launched = False
         self._burst_launched = False
         self._build_step()
@@ -886,6 +893,102 @@ class LLMEngine:
         del self._outputs[request_id]
         return True
 
+    # ------------------------------------------------------------------
+    # disaggregated serving: KV handoff (serving/fabric.py KVFabric)
+    # ------------------------------------------------------------------
+    def extract_request(self, request_id) -> dict:
+        """Pull a caught-up RUNNING request out of this engine for a
+        prefill->decode handoff: its committed KV pages leave as the
+        host-side layers wire format, its row slot and pages free
+        IMMEDIATELY (the prefill-pool win — the slot takes the next
+        prompt while the request decodes elsewhere), and the returned
+        payload is everything :meth:`inject_request` needs to resume it
+        bit-identically on another replica. Only a caught-up row
+        (``uncached_len == 1`` with at least the first token sampled)
+        extracts — mid-prefill rows keep chunking here."""
+        seq = self._seqs.get(request_id)
+        if seq is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        if seq.status is not SequenceStatus.RUNNING \
+                or seq.uncached_len != 1 or not seq.tokens:
+            raise ValueError(
+                f"request {request_id!r} is not a caught-up decode row "
+                f"(status={seq.status.value}, uncached={seq.uncached_len}, "
+                f"tokens={len(seq.tokens)}) — not extractable")
+        num_tokens, layers = self.pool.export_pages(request_id,
+                                                    seq.cached_len)
+        self.scheduler.running.remove(seq)
+        self.pool.free(request_id)
+        if self._draft is not None:
+            self._draft.drop(request_id)
+        del self._seqs[request_id]
+        del self._outputs[request_id]
+        self.flight.record("handoff_out", self._now(), request=request_id,
+                           pages=self.pool.pages_for(num_tokens))
+        return {"request_id": request_id,
+                "prompt_ids": list(seq.prompt_ids),
+                "tokens": list(seq.tokens),
+                "max_new_tokens": seq.max_new_tokens,
+                "arrival": seq.arrival,
+                "deadline": seq.deadline,
+                "abort_deadline": seq.abort_deadline,
+                "temperature": seq.temperature,
+                "top_k": seq.top_k, "top_p": seq.top_p,
+                "seed": seq.seed, "eos_token_id": seq.eos_token_id,
+                "num_preemptions": seq.num_preemptions,
+                "first_token_at": seq.first_token_at,
+                "cached_len": seq.cached_len,
+                "num_tokens": num_tokens, "layers": layers}
+
+    def inject_request(self, payload: dict) -> str:
+        """Land an extracted request on THIS engine. The transferred
+        pages adopt into the pool (two-tier pools stage them in the
+        host arena as a PARKED sequence, so re-admission rides the
+        cursor-ahead prefetch path; single-tier pools land them in HBM
+        directly) and the sequence enqueues as a caught-up decode row —
+        its next sampled token is a pure function of (seed, position),
+        so the handoff is invisible in the token stream. Counted on
+        ``kv_pages_transferred``."""
+        rid = payload["request_id"]
+        if rid in self._seqs or rid in self._outputs:
+            raise KeyError(f"duplicate request_id {rid!r}")
+        cached_len = int(payload["cached_len"])
+        if int(payload["num_tokens"]) != cached_len:
+            raise ValueError(
+                f"request {rid!r}: payload carries "
+                f"{payload['num_tokens']} tokens of KV but cached_len is "
+                f"{cached_len}")
+        self.pool.adopt_sequence(rid, cached_len, payload["layers"])
+        seq = Sequence(
+            seq_id=rid, prompt_ids=list(payload["prompt_ids"]),
+            max_new_tokens=payload["max_new_tokens"],
+            arrival=payload["arrival"], deadline=payload["deadline"],
+            abort_deadline=payload["abort_deadline"],
+            temperature=payload["temperature"],
+            top_k=payload["top_k"], top_p=payload["top_p"],
+            seed=payload["seed"], eos_token_id=payload["eos_token_id"],
+            num_preemptions=payload["num_preemptions"])
+        try:
+            self.scheduler.add(seq)
+        except ValueError:
+            self.pool.free(rid)
+            raise
+        # carried progress: add() enqueues a WAITING row; these fields
+        # make it a caught-up decode row the parked-admission path
+        # restores instead of re-prefilling
+        seq.tokens = list(payload["tokens"])
+        seq.cached_len = cached_len
+        seq.first_token_at = payload["first_token_at"]
+        self._seqs[rid] = seq
+        self._outputs[rid] = RequestOutput(
+            rid, list(seq.prompt_ids), token_ids=list(seq.tokens),
+            status=seq.status.value, num_preemptions=seq.num_preemptions)
+        n_pages = self.pool.pages_for(cached_len)
+        self.metrics.kv_pages_transferred.inc(n_pages)
+        self.flight.record("handoff_in", self._now(), request=rid,
+                           pages=n_pages)
+        return rid
+
     def has_unfinished(self) -> bool:
         return self.scheduler.has_unfinished()
 
@@ -1231,6 +1334,15 @@ class LLMEngine:
                     self._pinned_index[key] = (chain, j)
                 while len(self._pinned_index) > self.prefix_cache_size:
                     self._pinned_index.pop(next(iter(self._pinned_index)))
+                if self.fleet_prefix is not None \
+                        and not self.fleet_prefix.contains(chain):
+                    # fleet publication: one device->host export per NEW
+                    # chain (content-addressed — a chain already in the
+                    # fleet index costs one dict probe). Any replica in
+                    # either pool can now fault these pages in.
+                    self.fleet_prefix.publish(
+                        chain, full, self.pool.export_chain(chain),
+                        self.pool.config(), page_size=ps)
             if self._prefix_autosave:
                 # write-ahead warm-start discipline: the pin set changed
                 # (or an eviction shifted it) — persist the chains NOW,
@@ -1432,6 +1544,42 @@ class LLMEngine:
             self.metrics.prefix_cache_hits.inc()
             self.metrics.pinned_prefix_hits.inc()
             return shared
+        # no local donor and no local pin: the FLEET prefix cache — a
+        # chain some other replica published lands here through the
+        # same two-tier restore + fork machinery the warm-restart store
+        # uses. Store-backed bytes are checksum-verified; a geometry
+        # mismatch is a counted miss, never a wrong-shape fork.
+        if self.fleet_prefix is not None and self.pool.pinned_page_budget:
+            for j in cands:
+                if j % ps:
+                    continue           # fleet chains are full pages only
+                hit = self.fleet_prefix.lookup(tuple(P[:j]),
+                                               self.pool.config())
+                if hit is None:
+                    continue
+                chain, length, layers = hit
+                if not self.pool.is_pinned(chain):
+                    if not self.pool.restore_pinned_chain(
+                            chain, length, layers):
+                        continue       # over pin budget: cache, not demand
+                shared = min(j, len(P) - 1)
+                if self.pool.quantized:
+                    shared = (shared // ps) * ps
+                if shared < 1:
+                    continue
+                try:
+                    self.pool.fork_pinned(seq.seq_id, chain, shared)
+                except PoolExhausted:
+                    continue
+                for k in range(ps, length + 1, ps):
+                    key = chain[:k]
+                    self._pinned_index.pop(key, None)
+                    self._pinned_index[key] = (chain, k)
+                while len(self._pinned_index) > self.prefix_cache_size:
+                    self._pinned_index.pop(next(iter(self._pinned_index)))
+                self.metrics.prefix_cache_hits.inc()
+                self.metrics.fleet_prefix_hits.inc()
+                return shared
         self.metrics.prefix_cache_misses.inc()
         return 0
 

@@ -1,0 +1,342 @@
+"""Page-granular KV fabric for disaggregated prefill/decode serving.
+
+Disaggregation splits a fleet into a PREFILL pool (long-prompt chew,
+row slots recycle as soon as the first token samples) and a DECODE pool
+(steady token emission, never starved by a neighbour's 32k-token
+prompt). The piece that makes the split real is moving a finished
+prompt's KV pages from the prefill replica to its assigned decode
+replica — this module models that wire.
+
+Three cooperating parts, all on the loadgen virtual clock (no wall
+time anywhere, so a disaggregated run is byte-reproducible per seed):
+
+- :class:`TransferModel` — the cost model: a page transfer costs
+  ``base_s + page_s * pages``. Defaults approximate host-staged
+  ``device_put`` over DCN; docs/PERF.md §17 derives both constants and
+  contrasts them with real ICI collectives.
+- :class:`KVFabric` — the transfer engine: bounded in-flight depth
+  (the same discipline as :class:`~paddle_tpu.serving.kv_tier.
+  KVPrefetcher`'s queue — refusal is back-pressure, counted by the
+  caller as a ``transfer_stall``, never a hang), per-source fault
+  windows (``transfer_slow`` multiplies modeled latency,
+  ``transfer_drop`` loses the payload after the latency elapses so the
+  retry path is exercised honestly), and a *streaming credit*: each
+  chunked-prefill boundary the source replica reports moves that
+  request's finished pages early, so the final handoff only pays for
+  the last chunk's pages. Chunk boundaries — not whole prompts — are
+  the streaming unit.
+- :class:`FleetPrefixCache` — the fleet-wide generalization of the
+  per-engine pinned-prefix store: content-addressed pinned chains
+  (the key IS the token tuple) published into a shared
+  :class:`~paddle_tpu.io.persist.ArtifactStore` that ANY replica in
+  either pool can fault into its own HBM or host tier. A prompt
+  prefilled once anywhere is never re-prefilled anywhere — including
+  after the publishing replica crashes, because the bytes live in the
+  shared store, not in the dead replica's pool.
+
+The fabric never touches devices: payloads are the host-side ``layers``
+wire format every other KV mover in this codebase already speaks
+(``HostKVArena.write``/``read``, ``export_pinned``,
+``restore_pinned_chain``, ``export_pages``/``adopt_sequence``) — a
+list of per-layer ``{"K", "V"[, "Ks", "Vs"]}`` dicts of numpy blocks.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["TransferModel", "Transfer", "KVFabric", "FleetPrefixCache"]
+
+
+@dataclass(frozen=True)
+class TransferModel:
+    """Latency model for one KV handoff: ``base_s + page_s * pages``.
+
+    ``base_s`` is the per-transfer setup cost (RPC + host staging);
+    ``page_s`` the per-page wire cost. Both are virtual seconds. The
+    defaults model host-staged DCN transfers of ~page_bytes pages; a
+    real ICI fabric would shrink ``page_s`` by ~two orders of magnitude
+    (docs/PERF.md §17) without changing any of the control flow here.
+    """
+    base_s: float = 0.002
+    page_s: float = 0.0005
+
+    def __post_init__(self):
+        if self.base_s < 0 or self.page_s < 0:
+            raise ValueError(
+                f"TransferModel costs must be >= 0, got "
+                f"base_s={self.base_s}, page_s={self.page_s}")
+
+    def latency(self, pages: int) -> float:
+        return self.base_s + self.page_s * max(int(pages), 0)
+
+
+@dataclass
+class Transfer:
+    """One in-flight (or landed) handoff. ``payload`` is the engine's
+    ``extract_request`` dict; ``pages`` the billed page count (after
+    streaming credit); ``dropped`` marks a transfer_drop casualty —
+    it still lands at ``ready_at`` so the cluster can count the loss
+    and requeue, but its payload must not be injected."""
+    rid: str
+    payload: dict
+    src: int
+    dst: int
+    pages: int
+    issued_at: float
+    ready_at: float
+    dropped: bool = False
+    order: int = field(default=0, compare=False)
+
+
+class KVFabric:
+    """Bounded, fault-aware, virtual-clock KV transfer engine.
+
+    ``depth`` bounds concurrent in-flight transfers fleet-wide —
+    ``issue`` refuses (returns False) when full, and the caller counts
+    a stall and retries next round, exactly the KVPrefetcher queue
+    discipline. All state advances only through method calls carrying
+    the caller's clock, so two runs with the same seed replay the same
+    transfers to the byte.
+
+    Lifetime counters (host-side ints, mirrored into the cluster
+    report): ``issued``, ``landed``, ``pages_sent``, ``refusals``,
+    ``drops``, ``pages_streamed``.
+    """
+
+    def __init__(self, model: TransferModel | None = None, *, depth: int = 4):
+        if depth < 1:
+            raise ValueError(f"KVFabric depth must be >= 1, got {depth}")
+        self.model = model if model is not None else TransferModel()
+        self.depth = int(depth)
+        self._inflight: list[Transfer] = []
+        self._order = 0
+        #: request -> pages already streamed at chunk boundaries
+        self._credit: dict = {}
+        #: replica -> (until, magnitude) / replica -> until
+        self._slow: dict = {}
+        self._drop: dict = {}
+        self.counters = {"issued": 0, "landed": 0, "pages_sent": 0,
+                         "refusals": 0, "drops": 0, "pages_streamed": 0}
+
+    # ---- fault windows (serving/faults.py transfer_* kinds) ----
+    def set_slow(self, replica: int, until: float, magnitude: float):
+        if magnitude <= 1.0:
+            raise ValueError(
+                f"transfer_slow magnitude must be > 1, got {magnitude}")
+        self._slow[int(replica)] = (float(until), float(magnitude))
+
+    def set_drop(self, replica: int, until: float):
+        self._drop[int(replica)] = float(until)
+
+    def _slow_factor(self, src: int, dst: int, now: float) -> float:
+        # a degraded link at EITHER endpoint slows the transfer; two
+        # live windows compound (both NICs are sick)
+        factor = 1.0
+        for rep in (src, dst) if src != dst else (src,):
+            ent = self._slow.get(rep)
+            if ent is not None and now < ent[0]:
+                factor *= ent[1]
+        return factor
+
+    def _dropped(self, src: int, dst: int, now: float) -> bool:
+        return any(until is not None and now < until
+                   for until in (self._drop.get(src),
+                                 self._drop.get(dst)))
+
+    # ---- streaming credit (chunked-prefill boundaries) ----
+    def stream(self, rid: str, pages_done: int):
+        """A chunk boundary finished ``pages_done`` total pages for
+        ``rid`` on its prefill replica: the fabric streams the delta
+        ahead of the handoff. Credit is monotonic; the eventual
+        ``issue`` bills only the pages NOT already streamed."""
+        prev = self._credit.get(rid, 0)
+        pages_done = max(int(pages_done), 0)
+        if pages_done > prev:
+            self.counters["pages_streamed"] += pages_done - prev
+            self._credit[rid] = pages_done
+
+    def credit(self, rid: str) -> int:
+        return self._credit.get(rid, 0)
+
+    # ---- transfers ----
+    @property
+    def in_flight(self) -> int:
+        return len(self._inflight)
+
+    def issue(self, rid, payload, *, src, dst, pages, now) -> bool:
+        """Launch one handoff. False = depth-refused (back-pressure;
+        the caller counts a ``transfer_stall`` and retries next round).
+        The billed page count nets out streaming credit — a fully
+        streamed request still pays ``base_s`` for the final control
+        handoff. A live ``transfer_drop`` window on ``src`` marks the
+        transfer lost; it lands at ``ready_at`` as a casualty so the
+        retry is driven by the same clock as a success."""
+        if len(self._inflight) >= self.depth:
+            self.counters["refusals"] += 1
+            return False
+        billed = max(int(pages) - self._credit.pop(rid, 0), 0)
+        latency = self.model.latency(billed) \
+            * self._slow_factor(src, dst, now)
+        tr = Transfer(rid=rid, payload=payload, src=int(src), dst=int(dst),
+                      pages=int(pages), issued_at=float(now),
+                      ready_at=float(now) + latency,
+                      dropped=self._dropped(src, dst, now),
+                      order=self._order)
+        self._order += 1
+        self._inflight.append(tr)
+        self.counters["issued"] += 1
+        self.counters["pages_sent"] += billed
+        if tr.dropped:
+            self.counters["drops"] += 1
+        return True
+
+    def take_ready(self, now: float) -> list:
+        """Transfers whose modeled latency has elapsed, in a total
+        deterministic order (ready_at, issue order). Dropped transfers
+        are returned too — the caller requeues those instead of
+        injecting."""
+        ready = [t for t in self._inflight if t.ready_at <= now]
+        if not ready:
+            return []
+        ready.sort(key=lambda t: (t.ready_at, t.order))
+        self._inflight = [t for t in self._inflight if t.ready_at > now]
+        self.counters["landed"] += sum(1 for t in ready if not t.dropped)
+        return ready
+
+    def cancel_dst(self, replica: int) -> list:
+        """Pull every in-flight transfer destined for ``replica`` (it
+        crashed / collapsed): the caller requeues the payloads as fresh
+        retries. Deterministic issue order."""
+        out = [t for t in self._inflight if t.dst == int(replica)]
+        if out:
+            out.sort(key=lambda t: t.order)
+            self._inflight = [t for t in self._inflight
+                              if t.dst != int(replica)]
+        return out
+
+    def forget(self, rid: str):
+        """Drop streaming credit for a finished/aborted request."""
+        self._credit.pop(rid, None)
+
+
+def _chain_tag(tokens) -> str:
+    """Content-addressed ArtifactStore tag for a pinned chain: the key
+    IS the token tuple, hashed for filesystem friendliness."""
+    h = hashlib.sha1(",".join(str(int(t)) for t in tokens).encode())
+    return "fleetpfx-" + h.hexdigest()[:20]
+
+
+class FleetPrefixCache:
+    """Fleet-wide content-addressed prefix cache over a shared
+    :class:`~paddle_tpu.io.persist.ArtifactStore`.
+
+    ``publish`` is called by an engine after it pins a prompt's full
+    pages (``_register_prefix``): the chain's layers land in the shared
+    store under a tag derived from the token tuple, and the fleet index
+    maps every page-aligned prefix of the chain to it. ``lookup`` is
+    the admission-side probe any OTHER replica runs on a local miss:
+    an exact page-aligned prefix match returns the layers (checksum-
+    verified through the store), which the engine lands via
+    ``restore_pinned_chain`` + ``fork_pinned`` — the same two-tier
+    machinery the warm-restart prefix store uses.
+
+    The index is in-memory fleet-scope state (it lives in the cluster,
+    not in any replica), so it survives replica crashes; the page BYTES
+    are durable in the store. ``capacity`` LRU-bounds published chains.
+    Geometry safety: a chain publishes with its pool config and a
+    lookup from a mismatched pool is a miss, never a wrong-shape fork.
+
+    With ``store=None`` the cache runs memory-backed (chains held as
+    host arrays) — same semantics minus crash durability.
+    """
+
+    def __init__(self, store=None, *, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(
+                f"FleetPrefixCache capacity must be >= 1, got {capacity}")
+        self.store = store
+        self.capacity = int(capacity)
+        #: page-aligned prefix tuple -> (chain tuple, shared length)
+        self._index: dict = {}
+        #: chain tuple -> (num_tokens, config, payload-or-None)
+        self._chains: dict = {}
+        self.counters = {"publishes": 0, "hits": 0, "misses": 0,
+                         "config_rejects": 0}
+
+    def __len__(self):
+        return len(self._chains)
+
+    def contains(self, chain) -> bool:
+        return tuple(chain) in self._chains
+
+    def publish(self, chain, num_tokens, layers, config, *, page_size):
+        """Index ``chain`` (a full-page token tuple) fleet-wide. No-op
+        when already published (content-addressed: same tokens = same
+        bytes). Evicts the oldest chain past ``capacity``."""
+        chain = tuple(int(t) for t in chain)
+        if chain in self._chains:
+            return False
+        payload = None
+        if self.store is not None:
+            arrays = {}
+            for li, ent in enumerate(layers):
+                for part, arr in ent.items():
+                    arrays[f"L{li}/{part}"] = np.asarray(arr)
+            meta = {"format": 1, "config": dict(config),
+                    "tokens": list(chain), "num_tokens": int(num_tokens)}
+            self.store.save(_chain_tag(chain), arrays, meta)
+        else:
+            payload = [{k: np.asarray(v) for k, v in ent.items()}
+                       for ent in layers]
+        self._chains[chain] = (int(num_tokens), dict(config), payload)
+        for j in range(int(page_size), int(num_tokens) + 1, int(page_size)):
+            key = chain[:j]
+            self._index.pop(key, None)
+            self._index[key] = (chain, j)
+        while len(self._chains) > self.capacity:
+            old = next(iter(self._chains))
+            self._evict(old)
+        self.counters["publishes"] += 1
+        return True
+
+    def _evict(self, chain):
+        self._chains.pop(chain, None)
+        self._index = {k: v for k, v in self._index.items()
+                       if v[0] != chain}
+
+    def lookup(self, prefix, config):
+        """Exact page-aligned prefix match -> ``(chain, num_tokens,
+        layers)``; None on miss. ``config`` must equal the publishing
+        pool's (shape drift = miss, counted). Store-backed chains whose
+        every version fails verification are evicted and missed —
+        checksummed bytes or nothing."""
+        ent = self._index.get(tuple(int(t) for t in prefix))
+        if ent is None:
+            self.counters["misses"] += 1
+            return None
+        chain, _j = ent
+        num_tokens, cfg, payload = self._chains[chain]
+        if dict(config) != cfg:
+            self.counters["config_rejects"] += 1
+            self.counters["misses"] += 1
+            return None
+        if payload is None:
+            res = self.store.load(_chain_tag(chain))
+            if res is None:
+                self._evict(chain)
+                self.counters["misses"] += 1
+                return None
+            num_layers = len({k.split("/")[0] for k in res.arrays})
+            payload = []
+            for li in range(num_layers):
+                lent = {"K": res.arrays[f"L{li}/K"],
+                        "V": res.arrays[f"L{li}/V"]}
+                if f"L{li}/Ks" in res.arrays:
+                    lent["Ks"] = res.arrays[f"L{li}/Ks"]
+                    lent["Vs"] = res.arrays[f"L{li}/Vs"]
+                payload.append(lent)
+        self.counters["hits"] += 1
+        return chain, num_tokens, payload

@@ -34,6 +34,15 @@ Fault kinds:
   :class:`InjectedFault`; the cluster absorbs each one (the step is
   lost, requests stay put) until ``crash_after_flaky`` consecutive
   failures escalate the replica to a crash.
+- ``transfer_slow`` — the KV fabric (serving/fabric.py) multiplies the
+  modeled transfer latency of every page transfer issued FROM the
+  replica by ``magnitude`` for ``duration_s``: in-flight handoffs land
+  late, the fabric's stall counter moves, and the collapse-to-colocated
+  hysteresis sees genuine degradation without any traffic change.
+- ``transfer_drop`` — every page transfer issued from the replica
+  inside the window is dropped after its modeled latency elapses: the
+  cluster counts the drop and requeues the request as a fresh retry
+  (recompute keeps correctness), exercising the fabric's retry path.
 """
 from __future__ import annotations
 
@@ -41,7 +50,11 @@ from dataclasses import asdict, dataclass
 
 import numpy as np
 
-KINDS = ("crash", "drain", "slowdown", "kv_pressure", "flaky")
+# new kinds append at the END: FaultSchedule's sort tie-break uses
+# KINDS.index(kind), so reordering would change the firing order of
+# same-instant faults and break recorded report bytes
+KINDS = ("crash", "drain", "slowdown", "kv_pressure", "flaky",
+         "transfer_slow", "transfer_drop")
 
 
 class InjectedFault(RuntimeError):
@@ -55,10 +68,11 @@ class FaultEvent:
     """One scheduled fault: fires when the virtual clock reaches ``t``.
 
     ``duration_s`` bounds the window faults (drain / slowdown /
-    kv_pressure / flaky); ``recover_s`` is crash-only (DOWN ->
-    RECOVERING delay; None = the replica never comes back);
-    ``magnitude`` is the slowdown's latency multiplier (> 1) or the
-    kv_pressure ballast as a fraction of pool capacity (0, 1]."""
+    kv_pressure / flaky / transfer_slow / transfer_drop); ``recover_s``
+    is crash-only (DOWN -> RECOVERING delay; None = the replica never
+    comes back); ``magnitude`` is the slowdown's or transfer_slow's
+    latency multiplier (> 1) or the kv_pressure ballast as a fraction
+    of pool capacity (0, 1]."""
     t: float
     replica: int
     kind: str
@@ -91,6 +105,10 @@ class FaultEvent:
             raise ValueError(
                 f"kv_pressure magnitude is a capacity fraction in "
                 f"(0, 1], got {self.magnitude}")
+        if self.kind == "transfer_slow" and self.magnitude <= 1.0:
+            raise ValueError(
+                f"transfer_slow magnitude is a transfer-latency "
+                f"multiplier > 1, got {self.magnitude}")
 
 
 class FaultSchedule:

@@ -581,6 +581,104 @@ class PagedKVPool:
                         "layers": layers})
         return out
 
+    def export_chain(self, chain_id) -> list:
+        """Serialize ONE pinned chain's page data (the per-chain slice
+        of :meth:`export_pinned`) — what the fleet prefix cache
+        (serving/fabric.py) publishes after a pin, without paying a
+        device read of every other chain."""
+        pages, _ = self._pins[chain_id]
+        return self._read_pages(pages)
+
+    def _read_pages(self, pages) -> list:
+        """Device -> host read of pool pages as one
+        ``[Hkv, len(pages), ps, d]`` block per layer (K/V + int8 scale
+        columns) — the HostKVArena ``layers`` format, which makes spill
+        buffers, fleet transfers, and prefix publishes one wire
+        format."""
+        idx = jnp.asarray(pages, jnp.int32)
+        out = []
+        for li, (K, V) in enumerate(self.kv):
+            ent = {"K": np.asarray(K[:, idx]),
+                   "V": np.asarray(V[:, idx])}
+            if self.kv_scales is not None:
+                Ks, Vs = self.kv_scales[li]
+                ent["Ks"] = np.asarray(Ks[:, idx])
+                ent["Vs"] = np.asarray(Vs[:, idx])
+            out.append(ent)
+        return out
+
+    # ---- disaggregated serving (serving/fabric.py) ----
+    def export_pages(self, seq_id, num_tokens=None) -> tuple:
+        """Read the pages covering ``seq_id``'s first ``num_tokens``
+        committed tokens (default: all of them) as host numpy blocks —
+        the prefill side of a KV handoff. Returns ``(num_tokens,
+        layers)`` in the arena/adopt wire format. Read-only: refcounts,
+        tables, and sharing are untouched."""
+        if num_tokens is None:
+            num_tokens = self._lens[seq_id]
+        if num_tokens > self._lens[seq_id]:
+            raise ValueError(
+                f"export of {num_tokens} tokens exceeds {seq_id!r}'s "
+                f"committed {self._lens[seq_id]}")
+        pages = self._tables[seq_id][:self.pages_for(num_tokens)]
+        bad = [p for p in pages if p < 0]
+        if bad:
+            raise PoolExhausted(
+                f"export of {seq_id!r}: {len(bad)} pages are not "
+                f"HBM-resident (restore before extracting)")
+        return num_tokens, self._read_pages(pages)
+
+    def adopt_sequence(self, seq_id, num_tokens, layers) -> list:
+        """Land transferred KV pages as a NEW fully-resident sequence —
+        the decode side of a KV handoff (inverse of
+        :meth:`export_pages`): claim fresh pages, write each layer's
+        blocks (int8 scale columns included), and commit ``num_tokens``.
+        All-or-nothing: :class:`PoolExhausted` when the pages cannot be
+        claimed even after LRU pin eviction. The two-tier pool overrides
+        this to stage into the host arena instead (the sequence lands
+        PARKED and rides the prefetch/restore path into HBM)."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already has an allocation")
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"adopted sequence has {len(layers)} layers, pool has "
+                f"{self.num_layers}")
+        n_pages = self.pages_for(num_tokens)
+        want = (self.num_kv_heads, n_pages, self.page_size, self.head_dim)
+        for li, ent in enumerate(layers):
+            if tuple(np.asarray(ent["K"]).shape) != want:
+                raise ValueError(
+                    f"adopted sequence layer {li}: block shape "
+                    f"{tuple(np.asarray(ent['K']).shape)} != pool {want}")
+        pages = self._claim(n_pages, f"adopt {seq_id!r} "
+                                     f"({num_tokens} tokens)")
+        idx = jnp.asarray(pages, jnp.int32)
+        self.kv = [(K.at[:, idx].set(jnp.asarray(ent["K"], self.dtype)),
+                    V.at[:, idx].set(jnp.asarray(ent["V"], self.dtype)))
+                   for (K, V), ent in zip(self.kv, layers)]
+        if self.kv_scales is not None:
+            self.kv_scales = [
+                (Ks.at[:, idx].set(jnp.asarray(ent["Ks"], jnp.float32)),
+                 Vs.at[:, idx].set(jnp.asarray(ent["Vs"], jnp.float32)))
+                for (Ks, Vs), ent in zip(self.kv_scales, layers)]
+        self._repin()
+        self._tables[seq_id] = list(pages)
+        self._lens[seq_id] = num_tokens
+        return list(pages)
+
+    # single-tier pools have no host tier, so an adopted sequence is
+    # already fully resident: the scheduler's parked-admission branch
+    # (which fires for ANY sequence that owns a table while waiting)
+    # sees zero spilled pages and a free no-op restore
+    def spilled_page_count(self, seq_id) -> int:
+        return 0
+
+    def restore_headroom(self, seq_id) -> int:
+        return self.available_pages
+
+    def restore_sequence(self, seq_id) -> int:
+        return 0
+
     def restore_pinned_chain(self, chain_id, num_tokens, layers) -> bool:
         """Materialize a persisted chain back into the pool as a pinned
         prefix: claim fresh pages, write each layer's K/V blocks (and

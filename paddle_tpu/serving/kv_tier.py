@@ -786,6 +786,47 @@ class TieredKVPool(PagedKVPool):
                         "layers": self.arena.read(slots)})
         return out
 
+    def export_chain(self, chain_id) -> list:
+        if chain_id in self._host_chains:
+            return self.arena.read(self._host_chains[chain_id][0])
+        return super().export_chain(chain_id)
+
+    # ------------------------------------------------------------------
+    # disaggregated serving: adopt transferred pages via the host arena
+    # ------------------------------------------------------------------
+    def adopt_sequence(self, seq_id, num_tokens, layers) -> list:
+        """Two-tier adoption (the fabric's landing pad): the transferred
+        blocks stage into the HOST ARENA and the sequence lands PARKED —
+        a host-sentinel block table over fresh arena slots — so
+        re-admission rides the exact machinery parked sequences already
+        use (cursor-ahead :class:`KVPrefetcher` staging, hit-vs-stall
+        accounting, ``restore_sequence``'s scatter). No HBM is claimed
+        until the scheduler actually admits the row. Falls back to the
+        base direct-to-HBM adoption when the arena cannot hold the
+        pages (better resident than refused)."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id!r} already has an allocation")
+        if len(layers) != self.num_layers:
+            raise ValueError(
+                f"adopted sequence has {len(layers)} layers, pool has "
+                f"{self.num_layers}")
+        n_pages = self.pages_for(num_tokens)
+        if n_pages > self.arena.free_pages:
+            return super().adopt_sequence(seq_id, num_tokens, layers)
+        want = (self.num_kv_heads, n_pages, self.page_size, self.head_dim)
+        for li, ent in enumerate(layers):
+            if tuple(np.asarray(ent["K"]).shape) != want:
+                raise ValueError(
+                    f"adopted sequence layer {li}: block shape "
+                    f"{tuple(np.asarray(ent['K']).shape)} != pool {want}")
+        slots = self.arena.claim(n_pages)
+        self.arena.write(slots, layers)
+        self._tables[seq_id] = [-(s + 1) for s in slots]
+        self._lens[seq_id] = num_tokens
+        self._spilled[seq_id] = dict(enumerate(slots))
+        self._parked[seq_id] = (self.clock, self._tie_rng.random())
+        return list(self._tables[seq_id])
+
     # ------------------------------------------------------------------
     # invariants: a page lives in exactly one tier
     # ------------------------------------------------------------------

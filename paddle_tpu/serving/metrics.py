@@ -244,7 +244,16 @@ class ServingMetrics:
                 # prefetcher did NOT stage a full round ahead — the
                 # counted, bounded stall (the copy runs synchronously;
                 # tokens stay bit-identical, only overlap is lost)
-                "kv_spills", "kv_prefetch_hits", "kv_prefetch_stalls")
+                "kv_spills", "kv_prefetch_hits", "kv_prefetch_stalls",
+                # disaggregated serving (serving/fabric.py): KV pages
+                # landed on THIS replica over the fabric (decode side of
+                # a prefill -> decode handoff), handoffs the bounded
+                # fabric refused this round (issue retried next round —
+                # the counted backpressure signal), and prefix-cache
+                # hits served from the FLEET store (pages prefilled on
+                # another replica, faulted in content-addressed)
+                "kv_pages_transferred", "transfer_stalls",
+                "fleet_prefix_hits")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",

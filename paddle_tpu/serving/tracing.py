@@ -62,6 +62,8 @@ SPAN_KINDS = (
     "kv_prefetch_stall",  # two-tier KV: a parked sequence's restore was
                           # not staged a full round ahead — the copy ran
                           # synchronously (counted, bounded; kv_tier.py)
+    "transfer",       # disagg: KV pages in flight prefill -> decode pool
+                      # (serving/fabric.py; detail carries pages/latency)
 )
 
 SCHEMA_VERSION = 1
@@ -237,7 +239,11 @@ def request_breakdown(spans) -> dict | None:
     - ``queue_s``    — first enqueue -> first admission;
     - ``prefill_s``  — first admission -> last committed prompt chunk
       (0 for a full prefix-cache hit admitted caught-up);
-    - ``decode_s``   — first generated token -> finalization;
+    - ``decode_s``   — first generated token -> finalization, minus the
+      time the request's KV was in flight on the fabric;
+    - ``transfer_s`` — disaggregated serving only: modeled fabric time
+      shipping the request's KV pages prefill -> decode pool (sum of
+      ``transfer`` span latencies; 0.0 when no handoff happened);
     - ``stall_s``    — everything else inside e2e: preemption requeues,
       retry backoff, re-prefill after a crash — the time the request
       was alive but not progressing its FIRST pass;
@@ -247,6 +253,7 @@ def request_breakdown(spans) -> dict | None:
     """
     t_enqueue = t_admit = t_first_tok = t_done = None
     t_prefill_end = None
+    transfer = 0.0
     for t, kind, detail in spans:
         if kind == "enqueue" and t_enqueue is None:
             t_enqueue = t
@@ -255,6 +262,8 @@ def request_breakdown(spans) -> dict | None:
             t_prefill_end = t
         elif kind == "prefill_chunk" and t_first_tok is None:
             t_prefill_end = t
+        elif kind == "transfer" and detail:
+            transfer += detail.get("latency_s", 0.0)
         if t_first_tok is None and kind in _TOKEN_KINDS and detail \
                 and detail.get("new_tokens", 0) > 0:
             t_first_tok = t
@@ -266,10 +275,15 @@ def request_breakdown(spans) -> dict | None:
     e2e = t_done - t_enqueue
     queue = (t_admit - t_enqueue) if t_admit is not None else e2e
     prefill = (t_prefill_end - t_admit) if t_admit is not None else 0.0
-    decode = (t_done - t_first_tok) if t_first_tok is not None else 0.0
-    stall = max(e2e - queue - prefill - decode, 0.0)
+    # fabric time lives inside the first-token -> done window (the
+    # handoff fires after the first sampled token); carve it out of
+    # decode so a slow fabric reads as transfer, not decode
+    decode = (t_done - t_first_tok - transfer) \
+        if t_first_tok is not None else 0.0
+    decode = max(decode, 0.0)
+    stall = max(e2e - queue - prefill - decode - transfer, 0.0)
     return {"queue_s": queue, "prefill_s": prefill, "decode_s": decode,
-            "stall_s": stall, "e2e_s": e2e}
+            "transfer_s": transfer, "stall_s": stall, "e2e_s": e2e}
 
 
 def latency_breakdown(tracer: RequestTracer) -> dict:
@@ -285,7 +299,8 @@ def latency_breakdown(tracer: RequestTracer) -> dict:
         if b is not None:
             per_request[rid] = b
     out = {"requests": len(per_request)}
-    for comp in ("queue_s", "prefill_s", "decode_s", "stall_s", "e2e_s"):
+    for comp in ("queue_s", "prefill_s", "decode_s", "transfer_s",
+                 "stall_s", "e2e_s"):
         vals = [b[comp] for b in per_request.values()]
         out[comp] = {
             "mean": sum(vals) / len(vals) if vals else None,

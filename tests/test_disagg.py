@@ -1,0 +1,564 @@
+"""Disaggregated prefill/decode serving + the fleet KV fabric
+(serving/fabric.py, serving/cluster.py roles mode) — the ISSUE-16
+acceptance bars, asserted not logged:
+
+- a disaggregated fleet serves a seeded mixed workload token-identically
+  (greedy fp, int8, sampled, spec-decode on) to a colocated fleet, with
+  ``kv_pages_transferred > 0`` and ``fleet_prefix_hits > 0``;
+- under a long-prompt flood, decode rows advance every step (checked by
+  the driver, raising on starvation) and fleet TTFT p99 in the
+  virtual-clock report is strictly better than the colocated baseline
+  on the same trace;
+- the cluster report with transfers and transfer faults live is
+  byte-reproducible across two runs per seed;
+- the fleet prefix cache shows a cross-replica hit after the publishing
+  prefill replica crashed — the prefix is never re-prefilled anywhere;
+- the fleet "collapse to colocated" rung engages under sustained pool
+  pressure and restores with hysteresis — counted, flight-recorded,
+  never a hang.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (ClusterDriver, VirtualClock, WorkloadSpec,
+                                build_cluster_report, report_json)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ClusterEngine, FaultEvent, FaultSchedule,
+                                FleetDegradation, FleetPrefixCache,
+                                KVFabric, LLMEngine, PagedKVPool,
+                                TieredKVPool, TransferModel)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+# ---------------------------------------------------------------------------
+# fabric unit tests (no model)
+# ---------------------------------------------------------------------------
+
+def test_transfer_model_validation_and_latency():
+    m = TransferModel(base_s=0.01, page_s=0.001)
+    assert m.latency(0) == pytest.approx(0.01)
+    assert m.latency(5) == pytest.approx(0.015)
+    with pytest.raises(ValueError):
+        TransferModel(base_s=-1.0)
+    with pytest.raises(ValueError):
+        TransferModel(page_s=-0.1)
+
+
+def test_fabric_depth_refusal_and_landing_order():
+    fab = KVFabric(TransferModel(base_s=0.1, page_s=0.0), depth=2)
+    assert fab.issue("a", {}, src=0, dst=1, pages=2, now=0.0)
+    assert fab.issue("b", {}, src=0, dst=1, pages=2, now=0.0)
+    assert fab.in_flight == 2
+    # depth full: the caller must check before extracting; issue refuses
+    assert not fab.issue("c", {}, src=0, dst=1, pages=2, now=0.0)
+    assert fab.counters["refusals"] == 1
+    assert fab.take_ready(0.05) == []          # nothing ready yet
+    ready = fab.take_ready(0.2)
+    assert [t.rid for t in ready] == ["a", "b"], \
+        "equal ready_at must land in issue order (determinism)"
+    assert fab.counters["landed"] == 2
+    assert fab.counters["pages_sent"] == 4
+    assert fab.in_flight == 0
+
+
+def test_fabric_streaming_credit_reduces_billed_pages():
+    """Chunked-prefill boundaries stream pages ahead: pages already
+    streamed are credited against the final handoff, so decode can
+    start without paying for them again."""
+    m = TransferModel(base_s=0.0, page_s=1.0)
+    fab = KVFabric(m, depth=4)
+    fab.stream("a", 3)
+    fab.stream("a", 5)                         # monotonic: +2, not +5
+    assert fab.counters["pages_streamed"] == 5
+    assert fab.issue("a", {}, src=0, dst=1, pages=8, now=0.0)
+    (tr,) = fab.take_ready(100.0)
+    assert tr.ready_at == pytest.approx(3.0), \
+        "handoff must only bill pages NOT already streamed (8 - 5)"
+    # a request with no streaming pays the full page count
+    assert fab.issue("b", {}, src=0, dst=1, pages=8, now=0.0)
+    (tr,) = fab.take_ready(100.0)
+    assert tr.ready_at == pytest.approx(8.0)
+
+
+def test_fabric_slow_and_drop_windows():
+    m = TransferModel(base_s=1.0, page_s=0.0)
+    fab = KVFabric(m, depth=8)
+    with pytest.raises(ValueError):
+        fab.set_slow(1, until=5.0, magnitude=1.0)   # multiplier > 1
+    fab.set_slow(1, until=5.0, magnitude=3.0)
+    fab.issue("slow", {}, src=0, dst=1, pages=1, now=0.0)
+    fab.issue("fast", {}, src=0, dst=2, pages=1, now=0.0)
+    fab.issue("late", {}, src=0, dst=1, pages=1, now=6.0)  # window over
+    fab.set_drop(2, until=9.0)
+    fab.issue("gone", {}, src=0, dst=2, pages=1, now=8.0)
+    by_rid = {t.rid: t for t in fab.take_ready(100.0)}
+    assert by_rid["slow"].ready_at == pytest.approx(3.0)
+    assert by_rid["fast"].ready_at == pytest.approx(1.0)
+    assert by_rid["late"].ready_at == pytest.approx(7.0)
+    assert by_rid["gone"].dropped and fab.counters["drops"] == 1
+    assert fab.counters["landed"] == 3, "dropped transfers never land"
+
+
+def test_fabric_cancel_dst_returns_inflight_in_issue_order():
+    fab = KVFabric(TransferModel(base_s=1.0, page_s=1.0), depth=8)
+    fab.issue("a", {}, src=0, dst=1, pages=3, now=0.0)
+    fab.issue("b", {}, src=0, dst=2, pages=1, now=0.0)
+    fab.issue("c", {}, src=0, dst=1, pages=1, now=0.0)
+    pulled = fab.cancel_dst(1)
+    assert [t.rid for t in pulled] == ["a", "c"]
+    assert fab.in_flight == 1                  # "b" survives
+    (tr,) = fab.take_ready(100.0)
+    assert tr.rid == "b"
+
+
+def test_fleet_degradation_hysteresis():
+    g = FleetDegradation(engage_after=2, restore_after=3)
+    assert g.observe(True) is None
+    assert g.observe(True) == "collapse" and g.collapsed
+    assert g.observe(True) is None             # already collapsed
+    assert g.observe(False) is None
+    assert g.observe(True) is None             # pressure resets the cool
+    assert g.observe(False) is None
+    assert g.observe(False) is None
+    assert g.observe(False) == "restore" and not g.collapsed
+    with pytest.raises(ValueError):
+        FleetDegradation(engage_after=0)
+
+
+def test_transfer_fault_kind_validation():
+    with pytest.raises(ValueError):
+        FaultEvent(t=0.0, replica=0, kind="transfer_slow",
+                   duration_s=1.0, magnitude=1.0)   # multiplier > 1
+    with pytest.raises(ValueError):
+        FaultEvent(t=0.0, replica=0, kind="transfer_drop")  # no window
+    FaultEvent(t=0.0, replica=0, kind="transfer_slow",
+               duration_s=1.0, magnitude=2.0)
+    FaultEvent(t=0.0, replica=0, kind="transfer_drop", duration_s=0.5)
+
+
+# ---------------------------------------------------------------------------
+# pool export/adopt: the page payload round trip under the fabric
+# ---------------------------------------------------------------------------
+
+def _pool(cls=PagedKVPool, **kw):
+    merged = dict(num_pages=17, page_size=4)
+    merged.update(kw)
+    return cls(2, 2, 8, **merged)
+
+
+def test_pool_export_adopt_round_trip_is_byte_exact():
+    src = _pool()
+    src.allocate("r1", 10)
+    src.set_seq_len("r1", 10)
+    n, layers = src.export_pages("r1", 10)
+    assert n == 10 and len(layers) == src.num_layers
+    # perturb the payload so the adopt is provably writing OUR bytes,
+    # not reusing zero-initialized storage
+    rng = np.random.default_rng(3)
+    layers = [{k: rng.standard_normal(v.shape).astype(v.dtype)
+               for k, v in lay.items()} for lay in layers]
+    dst = _pool()
+    table = dst.adopt_sequence("r1", n, layers)
+    assert len(table) == src.pages_for(10)
+    n2, layers2 = dst.export_pages("r1", 10)
+    assert n2 == n
+    for a, b in zip(layers, layers2):
+        for k in a:
+            np.testing.assert_array_equal(a[k], np.asarray(b[k]))
+    dst.check_invariants()
+
+
+def test_pool_adopt_validates_shape_and_duplicates():
+    src = _pool()
+    src.allocate("r1", 10)
+    src.set_seq_len("r1", 10)
+    n, layers = src.export_pages("r1")
+    dst = _pool()
+    with pytest.raises(ValueError):
+        dst.adopt_sequence("r1", n, layers[:-1] if len(layers) > 1
+                           else [])                  # wrong layer count
+    bad = [{k: np.asarray(v)[:, :1] for k, v in lay.items()}
+           for lay in layers]
+    with pytest.raises(ValueError):
+        dst.adopt_sequence("r1", n, bad)             # wrong page count
+    dst.adopt_sequence("r1", n, layers)
+    with pytest.raises(KeyError):
+        dst.adopt_sequence("r1", n, layers)          # already present
+
+
+def test_tiered_pool_adopts_into_host_arena():
+    """A two-tier decode pool lands adopted pages in the HOST arena
+    (parked, exact-byte restore on admission) so a transfer never
+    steals HBM from live decode rows."""
+    src = _pool()
+    src.allocate("r1", 12)
+    src.set_seq_len("r1", 12)
+    n, layers = src.export_pages("r1")
+    dst = _pool(cls=TieredKVPool, host_pages=8)
+    dst.adopt_sequence("r1", n, layers)
+    assert dst.is_parked("r1")
+    assert dst.spilled_page_count("r1") == src.pages_for(12)
+    dst.restore_sequence("r1")
+    n2, layers2 = dst.export_pages("r1", 12)
+    for a, b in zip(layers, layers2):
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]))
+    dst.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# engine-level: extract/inject handoff + fleet prefix publish/fault-in
+# ---------------------------------------------------------------------------
+
+ENGINE_KW = dict(max_len=32, page_size=4)
+
+
+def _drain(eng, clock=None, max_steps=200):
+    for _ in range(max_steps):
+        if not eng.step():
+            break
+        if clock is not None:
+            clock.advance(0.01)
+
+
+def test_engine_extract_inject_resumes_token_identical(tiny_model):
+    prompt = list(range(2, 12))
+    ref = LLMEngine(tiny_model, seed=0, **ENGINE_KW)
+    ref.add_request(prompt, max_new_tokens=8, request_id="r")
+    _drain(ref)
+    want = ref.outputs()["r"].token_ids
+
+    src = LLMEngine(tiny_model, seed=0, **ENGINE_KW)
+    src.add_request(prompt, max_new_tokens=8, request_id="r")
+    for _ in range(3):
+        src.step()
+    payload = src.extract_request("r")
+    assert payload["num_tokens"] == payload["cached_len"] > 0
+    assert "r" not in src.outputs()
+    dst = LLMEngine(tiny_model, seed=0, **ENGINE_KW)
+    dst.inject_request(payload)
+    assert dst.metrics_snapshot()["kv_pages_transferred"] > 0
+    _drain(dst)
+    assert dst.outputs()["r"].token_ids == want, \
+        "a mid-decode handoff must not change a single token"
+    with pytest.raises(KeyError):
+        dst.inject_request(payload)              # duplicate request id
+
+
+def test_fleet_prefix_cross_engine_hit_skips_the_prefill(tiny_model):
+    """Engine B faults in a prefix engine A published — B's prefix
+    cache hit comes from the FLEET cache (fleet_prefix_hits counts it)
+    and B's continuation is token-identical to prefilling from
+    scratch."""
+    fleet = FleetPrefixCache()
+    prefix = list(range(1, 9))                  # page-aligned (8 = 2*4)
+    tail_a, tail_b = [20, 21, 22], [30, 31]
+
+    a = LLMEngine(tiny_model, seed=0, pinned_prefix_pages=8,
+                  fleet_prefix_cache=fleet, **ENGINE_KW)
+    a.add_request(prefix + tail_a, max_new_tokens=4, request_id="a")
+    _drain(a)
+    assert fleet.counters["publishes"] >= 1
+
+    ref = LLMEngine(tiny_model, seed=0, **ENGINE_KW)
+    ref.add_request(prefix + tail_b, max_new_tokens=4,
+                    request_id="b")
+    _drain(ref)
+
+    b = LLMEngine(tiny_model, seed=0, pinned_prefix_pages=8,
+                  fleet_prefix_cache=fleet, **ENGINE_KW)
+    b.add_request(prefix + tail_b, max_new_tokens=4, request_id="b")
+    _drain(b)
+    snap = b.metrics_snapshot()
+    assert snap["fleet_prefix_hits"] == 1
+    assert fleet.counters["hits"] == 1
+    assert b.outputs()["b"].token_ids == ref.outputs()["b"].token_ids
+
+
+def test_fleet_prefix_rejects_mismatched_pool_config():
+    """A config drift (page size, dtype, head geometry) is a counted
+    reject, never a wrong-shape fork."""
+    fleet = FleetPrefixCache()
+    chain = (1, 2, 3, 4)
+    layers = [{"K": np.zeros((2, 1, 4, 8)), "V": np.zeros((2, 1, 4, 8))}]
+    good = {"page_size": 4, "dtype": "float32"}
+    fleet.publish(chain, 4, layers, good, page_size=4)
+    assert fleet.contains(chain)
+    assert fleet.lookup(chain, {"page_size": 8, "dtype": "float32"}) \
+        is None
+    assert fleet.counters["config_rejects"] == 1
+    hit = fleet.lookup(chain, dict(good))
+    assert hit is not None and hit[0] == chain and hit[1] == 4
+    assert fleet.counters["hits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cluster-level: THE acceptance gates
+# ---------------------------------------------------------------------------
+
+_MIXED = WorkloadSpec(num_requests=30, seed=5, arrival="poisson",
+                      arrival_rate=100.0, prompt_len=(6, 14),
+                      output_len=(4, 8), slo_e2e_s=5.0, vocab_size=128,
+                      shared_prefix_fraction=0.5, shared_prefix_len=4)
+# the publishing prefill replica crashes mid-run: its cohort-mates land
+# on the surviving prefill replica, which faults the shared prefix in
+# from the FLEET cache — the cross-replica hit the tentpole promises
+_MIXED_FAULTS = FaultSchedule([
+    FaultEvent(t=0.05, replica=0, kind="crash", recover_s=0.3)])
+
+_ROLES = ["prefill", "prefill", "decode", "decode"]
+
+
+def _run_cluster(model, spec, *, roles=None, n=4, faults=None,
+                 check_decode_progress=False, trace=None, **kw):
+    merged = dict(ENGINE_KW, retry_budget=2, pinned_prefix_pages=16)
+    merged.update(kw)
+    clock = VirtualClock()
+    cluster = ClusterEngine(model, n, seed=0, now_fn=clock.now,
+                            roles=roles, faults=faults, **merged)
+    trace = spec.compile() if trace is None else trace
+    result = ClusterDriver(cluster, clock, step_time_s=0.01,
+                          check_decode_progress=check_decode_progress
+                           ).run(trace)
+    return cluster, result, trace
+
+
+def _finished(cluster):
+    return {rid: o.token_ids for rid, o in cluster.outputs().items()
+            if o.status == "finished"}
+
+
+def _disagg_identity(model, **kw):
+    cd, _, _ = _run_cluster(model, _MIXED, roles=_ROLES,
+                            faults=_MIXED_FAULTS, **kw)
+    cc, _, _ = _run_cluster(model, _MIXED, n=2, **kw)
+    want = _finished(cc)
+    got = _finished(cd)
+    assert len(want) == _MIXED.num_requests, "baseline must finish all"
+    assert got == want, "disagg fleet diverged from the colocated fleet"
+    snap = cd.metrics_snapshot()
+    reps = snap["replicas"]
+    assert sum(r["counters"]["kv_pages_transferred"] for r in reps) > 0
+    assert sum(r["counters"]["fleet_prefix_hits"] for r in reps) > 0, \
+        "the crashed publisher's prefix must hit cross-replica"
+    assert snap["disagg"]["fleet_prefix"]["hits"] > 0
+    return cd, snap
+
+
+def test_disagg_token_identity_greedy_fp(tiny_model):
+    """THE acceptance gate: a disaggregated fleet (2 prefill + 2
+    decode, publisher crash included) serves the seeded shared-prefix
+    workload token-identically to a colocated fleet, with pages
+    actually moving over the fabric and a cross-replica fleet prefix
+    hit."""
+    cd, snap = _disagg_identity(tiny_model)
+    d = snap["disagg"]
+    assert d["counters"]["handoffs"] > 0
+    assert d["fabric"]["landed"] > 0
+    assert [r.get("role") for r in snap["replicas"]] == _ROLES
+
+
+def test_disagg_token_identity_int8(tiny_model):
+    _disagg_identity(tiny_model, kv_cache_dtype="int8")
+
+
+def test_disagg_token_identity_sampled(tiny_model):
+    spec = WorkloadSpec(num_requests=20, seed=6, arrival="poisson",
+                        arrival_rate=100.0, prompt_len=(6, 14),
+                        output_len=(4, 8), slo_e2e_s=5.0, vocab_size=128,
+                        temperature=0.9, top_k=(5, 20),
+                        per_request_seed=(0, 10_000))
+    cd, _, _ = _run_cluster(tiny_model, spec, roles=_ROLES)
+    cc, _, _ = _run_cluster(tiny_model, spec, n=2)
+    assert _finished(cd) == _finished(cc), \
+        "sampled draws are (seed, position) pure — a handoff must not " \
+        "shift a single PRNG stream position"
+    snap = cd.metrics_snapshot()
+    assert sum(r["counters"]["kv_pages_transferred"]
+               for r in snap["replicas"]) > 0
+
+
+def test_disagg_token_identity_spec_decode(tiny_model):
+    kw = dict(max_len=64, draft_model=tiny_model, spec_tokens=3)
+    spec = WorkloadSpec(num_requests=16, seed=8, arrival="poisson",
+                        arrival_rate=80.0, prompt_len=(6, 14),
+                        output_len=(6, 10), slo_e2e_s=5.0,
+                        vocab_size=128)
+    cd, _, _ = _run_cluster(tiny_model, spec, roles=_ROLES, **kw)
+    cc, _, _ = _run_cluster(tiny_model, spec, n=2, **kw)
+    assert _finished(cd) == _finished(cc)
+    snap = cd.metrics_snapshot()
+    assert sum(r["counters"]["kv_pages_transferred"]
+               for r in snap["replicas"]) > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: byte-reproducible report with transfers + faults live
+# ---------------------------------------------------------------------------
+
+_FAULTED = FaultSchedule([
+    FaultEvent(t=0.05, replica=2, kind="transfer_slow", duration_s=0.1,
+               magnitude=4.0),
+    FaultEvent(t=0.12, replica=2, kind="transfer_drop", duration_s=0.05)])
+
+
+def _faulted_run(model):
+    spec = WorkloadSpec(num_requests=24, seed=3, arrival="poisson",
+                        arrival_rate=120.0, prompt_len=(4, 12),
+                        output_len=(4, 8), slo_e2e_s=5.0, vocab_size=128)
+    cluster, result, trace = _run_cluster(
+        model, spec, roles=["prefill", "decode", "decode"], n=3,
+        faults=_FAULTED, check_decode_progress=True)
+    report = build_cluster_report(result, spec=spec, trace=trace,
+                                  faults=_FAULTED)
+    return cluster, result, report
+
+
+def test_disagg_report_is_byte_reproducible_with_transfer_faults(tiny_model):
+    _, r1, rep1 = _faulted_run(tiny_model)
+    _, r2, rep2 = _faulted_run(tiny_model)
+    assert report_json(rep1) == report_json(rep2), \
+        "same seed + same fault script must reproduce the report bytes"
+    d = rep1["disagg"]
+    assert d["handoffs"] > 0 and d["kv_pages_transferred"] > 0
+    assert d["transfer_slow_faults"] == 1
+    assert d["transfer_drop_faults"] == 1
+    assert d["decode_progress_checks"] > 0
+    assert d["roles"] == ["prefill", "decode", "decode"]
+    assert rep1["requests"]["unresolved"] == 0
+
+
+def test_transfer_drop_requeues_and_stays_token_identical(tiny_model):
+    """A drop window squarely over the whole run: every dropped handoff
+    must be requeued as a fresh retry (counted, flight-recorded) and
+    the outputs still match a colocated fleet — lossy fabric, lossless
+    serving."""
+    spec = WorkloadSpec(num_requests=12, seed=4, arrival="poisson",
+                        arrival_rate=60.0, prompt_len=(4, 10),
+                        output_len=(4, 6), slo_e2e_s=10.0,
+                        vocab_size=128)
+    faults = FaultSchedule([
+        FaultEvent(t=0.0, replica=1, kind="transfer_drop",
+                   duration_s=0.08)])
+    cd, _, _ = _run_cluster(tiny_model, spec,
+                            roles=["prefill", "decode"], n=2,
+                            faults=faults, retry_budget=4)
+    cc, _, _ = _run_cluster(tiny_model, spec, n=1)
+    assert _finished(cd) == _finished(cc)
+    snap = cd.metrics_snapshot()
+    d = snap["disagg"]
+    assert d["fabric"]["drops"] > 0, "the drop window must have fired"
+    assert d["counters"]["transfer_drops"] == d["fabric"]["drops"], \
+        "every dropped transfer converts to a counted requeue-retry"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: long-prompt flood — decode never starves, TTFT p99 wins
+# ---------------------------------------------------------------------------
+
+_FLOOD = WorkloadSpec(num_requests=32, seed=9, arrival="poisson",
+                      arrival_rate=300.0, prompt_len=(24, 48),
+                      output_len=(16, 24), slo_e2e_s=30.0,
+                      vocab_size=128)
+_FLOOD_KW = dict(max_len=96, page_size=4, chunk_size=16, max_num_seqs=4,
+                 num_pages=200, pinned_prefix_pages=0)
+
+
+def test_long_prompt_flood_decode_advances_and_ttft_beats_colocated(
+        tiny_model):
+    """The disaggregation headline: under a long-prompt flood the
+    driver asserts every healthy caught-up decode row grows its tokens
+    every step (prefill chunks can NEVER block decode TPOT — the run
+    raises on starvation), and fleet TTFT p99 is strictly better than
+    the colocated baseline on the identical trace because prefill
+    slots churn instead of queueing behind resident decode rows."""
+    trace = _FLOOD.compile()
+    cd, rd, _ = _run_cluster(tiny_model, _FLOOD, roles=_ROLES,
+                             check_decode_progress=True, trace=trace,
+                             **_FLOOD_KW)
+    repd = build_cluster_report(rd, spec=_FLOOD, trace=trace)
+    cc, rc, _ = _run_cluster(tiny_model, _FLOOD, n=4, trace=trace,
+                             **_FLOOD_KW)
+    repc = build_cluster_report(rc, spec=_FLOOD, trace=trace)
+    assert rd.decode_progress_checks > 0, \
+        "the starvation gate must actually have checked rows"
+    assert repd["requests"]["unresolved"] == 0
+    assert repc["requests"]["unresolved"] == 0
+    p99_d = repd["latency"]["ttft_s"]["p99"]
+    p99_c = repc["latency"]["ttft_s"]["p99"]
+    assert p99_d < p99_c, \
+        f"disagg TTFT p99 {p99_d:.4f} must beat colocated {p99_c:.4f}"
+    assert _finished(cd) == _finished(cc)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the fleet collapse-to-colocated rung
+# ---------------------------------------------------------------------------
+
+def test_collapse_rung_engages_and_restores_under_pool_outage(tiny_model):
+    """Crash the ONLY prefill replica mid-flood: routing pressure must
+    collapse the fleet to colocated (work keeps flowing — never a
+    hang), and once the replica recovers the rung restores
+    disaggregated routing with hysteresis. Both transitions are
+    counted and flight-recorded."""
+    spec = WorkloadSpec(num_requests=30, seed=13, arrival="deterministic",
+                        arrival_rate=60.0, prompt_len=(4, 10),
+                        output_len=(4, 8), slo_e2e_s=10.0,
+                        vocab_size=128)
+    faults = FaultSchedule([
+        FaultEvent(t=0.08, replica=0, kind="crash", recover_s=0.15)])
+    cluster, result, trace = _run_cluster(
+        tiny_model, spec, roles=["prefill", "decode", "decode"], n=3,
+        faults=faults, collapse_after=2, collapse_restore_after=3)
+    d = cluster.metrics_snapshot()["disagg"]
+    assert d["counters"]["collapses"] >= 1, \
+        "a dead prefill pool must engage the collapse rung"
+    assert d["counters"]["collapse_restores"] >= 1, \
+        "the rung must restore once the pool recovers"
+    assert not cluster.collapsed
+    kinds = [kind for _, kind, _ in cluster.flight.events()]
+    assert "disagg_collapse" in kinds and "disagg_restore" in kinds
+    # never a hang: every request resolved despite outage + collapse
+    report = build_cluster_report(result, spec=spec, trace=trace,
+                                  faults=faults)
+    assert report["requests"]["unresolved"] == 0
+    assert report["disagg"]["collapses"] == d["counters"]["collapses"]
+
+
+# ---------------------------------------------------------------------------
+# colocated purity: roles=None consumes nothing, emits nothing new
+# ---------------------------------------------------------------------------
+
+def test_colocated_snapshot_and_report_have_no_disagg_keys(tiny_model):
+    spec = WorkloadSpec(num_requests=8, seed=2, arrival="poisson",
+                        arrival_rate=80.0, prompt_len=(4, 8),
+                        output_len=(3, 5), slo_e2e_s=5.0, vocab_size=128)
+    cluster, result, trace = _run_cluster(tiny_model, spec, n=2)
+    snap = cluster.metrics_snapshot()
+    assert "disagg" not in snap
+    assert all("role" not in r for r in snap["replicas"])
+    report = build_cluster_report(result, spec=spec, trace=trace)
+    assert "disagg" not in report, \
+        "colocated artifacts must byte-persist without the section"
+
+
+def test_roles_validation(tiny_model):
+    with pytest.raises(ValueError):
+        ClusterEngine(tiny_model, 2, seed=0, roles=["prefill"],
+                      **ENGINE_KW)
+    with pytest.raises(ValueError):
+        ClusterEngine(tiny_model, 2, seed=0,
+                      roles=["prefill", "prefill"], **ENGINE_KW)
+    with pytest.raises(ValueError):
+        ClusterEngine(tiny_model, 2, seed=0,
+                      roles=["prefill", "router"], **ENGINE_KW)

@@ -1016,6 +1016,131 @@ def probe_kv_tiering(paddle, prefetch=True):
                 "kv_tiering_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_disagg(paddle, colocated=False):
+    """Measured disaggregated prefill/decode serving fields
+    (serving/fabric.py + ClusterEngine roles mode) — ISSUE 16's
+    fleet-level gates, all deterministic on the loadgen virtual clock.
+
+    Two seeded scenarios:
+
+    - a shared-prefix mixed workload with the PUBLISHING prefill
+      replica crashing mid-run: the disaggregated fleet (2 prefill +
+      2 decode) must serve it token-identically to a colocated fleet
+      (``disagg_token_identical``), with KV pages actually moving over
+      the fabric (``disagg_kv_pages_transferred``), a cross-replica
+      fleet prefix hit instead of a re-prefill
+      (``disagg_fleet_prefix_hit_rate``), zero transfer back-pressure
+      stalls (``disagg_transfer_stall_fraction``), and a
+      byte-reproducible cluster report across two runs
+      (``disagg_deterministic``);
+    - a long-prompt flood where fleet TTFT p99 must beat the colocated
+      baseline on the identical trace
+      (``disagg_ttft_ratio_vs_colocated`` < 1 — prefill slots churn
+      through handoffs instead of queueing behind resident decode
+      rows).
+
+    ``colocated=True`` (the proxy-bench ``--colocated`` regression
+    hook) serves both scenarios with ``roles=None``: outputs stay
+    identical but zero pages move, the fleet prefix cache never hits,
+    and the TTFT ratio collapses to ~1 — the pages/hit-rate/ratio
+    gates must all catch it.
+    """
+    try:
+        from paddle_tpu.loadgen import (ClusterDriver, VirtualClock,
+                                        WorkloadSpec,
+                                        build_cluster_report,
+                                        report_json)
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import (ClusterEngine, FaultEvent,
+                                        FaultSchedule)
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+
+        def run(spec, *, roles, n, faults=None, check=False, **kw):
+            clock = VirtualClock()
+            merged = dict(max_len=32, page_size=4, retry_budget=2,
+                          pinned_prefix_pages=16)
+            merged.update(kw)
+            cluster = ClusterEngine(model, n, seed=0, now_fn=clock.now,
+                                    roles=roles, faults=faults,
+                                    **merged)
+            trace = spec.compile()
+            res = ClusterDriver(cluster, clock, step_time_s=0.01,
+                                check_decode_progress=check).run(trace)
+            rep = build_cluster_report(res, spec=spec, trace=trace,
+                                       faults=faults)
+            toks = {rid: list(o.token_ids)
+                    for rid, o in cluster.outputs().items()
+                    if o.status == "finished"}
+            return cluster, rep, toks
+
+        roles = None if colocated else \
+            ["prefill", "prefill", "decode", "decode"]
+
+        mixed = WorkloadSpec(
+            num_requests=30, seed=5, arrival="poisson",
+            arrival_rate=100.0, prompt_len=(6, 14), output_len=(4, 8),
+            slo_e2e_s=5.0, vocab_size=128,
+            shared_prefix_fraction=0.5, shared_prefix_len=4)
+        crash = FaultSchedule([FaultEvent(t=0.05, replica=0,
+                                          kind="crash", recover_s=0.3)])
+        c1, rep1, toks1 = run(mixed, roles=roles, n=4, faults=crash)
+        _, rep2, toks2 = run(mixed, roles=roles, n=4, faults=crash)
+        _, _, oracle = run(mixed, roles=None, n=2)
+        snap = c1.metrics_snapshot()
+        reps = snap["replicas"]
+        pages = sum(r["counters"]["kv_pages_transferred"] for r in reps)
+        stalls = sum(r["counters"]["transfer_stalls"] for r in reps)
+        dis = snap.get("disagg", {})
+        fp = dis.get("fleet_prefix", {})
+        probes = fp.get("hits", 0) + fp.get("misses", 0)
+        handoffs = dis.get("counters", {}).get("handoffs", 0)
+
+        flood = WorkloadSpec(
+            num_requests=32, seed=9, arrival="poisson",
+            arrival_rate=300.0, prompt_len=(24, 48),
+            output_len=(16, 24), slo_e2e_s=30.0, vocab_size=128)
+        flood_kw = dict(max_len=96, chunk_size=16, max_num_seqs=4,
+                        num_pages=200, pinned_prefix_pages=0)
+        _, repd, _ = run(flood, roles=roles, n=4,
+                         check=roles is not None, **flood_kw)
+        _, repc, _ = run(flood, roles=None, n=4, **flood_kw)
+        ttft_d = repd["latency"]["ttft_s"]["p99"]
+        ttft_c = repc["latency"]["ttft_s"]["p99"]
+        return {
+            "disagg_token_identical": int(toks1 == oracle
+                                          and len(toks1) == 30),
+            "disagg_kv_pages_transferred": pages,
+            "disagg_fleet_prefix_hit_rate":
+                fp.get("hits", 0) / probes if probes else 0.0,
+            "disagg_transfer_stall_fraction":
+                stalls / (handoffs + stalls) if handoffs + stalls
+                else 0.0,
+            "disagg_ttft_ratio_vs_colocated":
+                ttft_d / ttft_c if ttft_c else None,
+            "disagg_deterministic": int(report_json(rep1)
+                                        == report_json(rep2)
+                                        and toks1 == toks2),
+            # bench-artifact context (not proxy-gated): absolute fleet
+            # TTFT p99s behind the gated ratio
+            "disagg_ttft_p99_s": ttft_d,
+            "disagg_colocated_ttft_p99_s": ttft_c,
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"disagg_token_identical": None,
+                "disagg_kv_pages_transferred": None,
+                "disagg_fleet_prefix_hit_rate": None,
+                "disagg_transfer_stall_fraction": None,
+                "disagg_ttft_ratio_vs_colocated": None,
+                "disagg_deterministic": None,
+                "disagg_ttft_p99_s": None,
+                "disagg_colocated_ttft_p99_s": None,
+                "disagg_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -1043,7 +1168,8 @@ def probe_kv_accounting():
                 "kv_accounting_probe_error": f"{type(e).__name__}: {e}"}
 
 
-__all__ = ["probe_cluster", "probe_gspmd", "probe_hlo_fusion",
+__all__ = ["probe_cluster", "probe_disagg", "probe_gspmd",
+           "probe_hlo_fusion",
            "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_kv_tiering",
            "probe_opt_dispatches",

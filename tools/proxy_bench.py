@@ -32,6 +32,13 @@ This harness runs the measurements that DON'T need a chip and are
   ``trace_decode_compiles`` — the request-tracing layer's contracts:
   byte-identical exports per seed and zero added step executables
   (serving/tracing.py);
+- ``disagg_*`` — disaggregated prefill/decode serving contracts
+  (serving/fabric.py + ClusterEngine roles): token identity vs a
+  colocated fleet, KV pages actually moved over the fabric, fleet
+  prefix hit rate with a crashed publisher, transfer stall fraction,
+  byte-reproducible fleet reports, and the TTFT-p99 ratio vs
+  colocated under a long-prompt flood (``--colocated`` is the
+  injected regression);
 - ``telemetry_*`` — the fleet time-series/SLO layer's contracts
   (paddle_tpu.telemetry): byte-identical series + alert-timeline
   exports per seed, a pinned scrape count, the seeded slowdown fault
@@ -77,7 +84,7 @@ BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
           "jaxpr", "accounting", "fusion", "tracing", "telemetry",
-          "persist", "kvtier")
+          "persist", "kvtier", "disagg")
 
 
 class Gate:
@@ -216,13 +223,33 @@ GATES = {
     "kv_tier_prefetch_hits":     Gate("different"),
     "kv_tier_stall_fraction":    Gate("higher", 0.0, 0.0),
     "kv_tier_deterministic":     Gate("lower", 0.0, 0.0),
+    # disaggregated prefill/decode serving (serving/fabric.py via
+    # probe_disagg): the disagg fleet must serve the seeded
+    # shared-prefix workload (publisher crash included) token-
+    # identically to a colocated fleet, actually move KV pages over
+    # the fabric (the count is pinned exactly — a drift means the
+    # handoff policy or router changed; re-record deliberately), hit
+    # the fleet prefix cache cross-replica, keep transfer back-
+    # pressure stalls at 0, reproduce the cluster report byte for
+    # byte, and beat the colocated fleet's TTFT p99 on the long-prompt
+    # flood (the ratio must stay well under 1). --colocated serves
+    # both scenarios with roles=None: pages drop to 0, the hit rate
+    # reads 0, the ratio collapses to ~1 — those three gates must all
+    # catch it.
+    "disagg_token_identical":    Gate("lower", 0.0, 0.0),
+    "disagg_kv_pages_transferred": Gate("different"),
+    "disagg_fleet_prefix_hit_rate": Gate("lower", 0.0, 0.0),
+    "disagg_transfer_stall_fraction": Gate("higher", 0.0, 0.0),
+    "disagg_ttft_ratio_vs_colocated": Gate("higher", 0.25, 0.05),
+    "disagg_deterministic":      Gate("lower", 0.0, 0.0),
 }
 
 
 def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
             fusion_defuse=False, telemetry_burn_alerts=True,
-            persist_corrupt=False, kvtier_prefetch=True) -> dict:
+            persist_corrupt=False, kvtier_prefetch=True,
+            disagg_colocated=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -256,10 +283,17 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     becomes a counted stall and prefetch hits drop to 0; the
     ``kv_tier_stall_fraction`` and ``kv_tier_prefetch_hits`` gates
     must catch it.
+    ``disagg_colocated=True`` (--colocated) serves the disagg probe's
+    scenarios with ``roles=None`` — zero KV pages move over the
+    fabric, the fleet prefix cache never hits, and the TTFT ratio
+    collapses to ~1; the ``disagg_kv_pages_transferred``,
+    ``disagg_fleet_prefix_hit_rate``, and
+    ``disagg_ttft_ratio_vs_colocated`` gates must catch it.
     """
     import jax
     import paddle_tpu as paddle
-    from tools.bench_probes import (probe_cluster, probe_gspmd,
+    from tools.bench_probes import (probe_cluster, probe_disagg,
+                                    probe_gspmd,
                                     probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
@@ -335,6 +369,16 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
               ("kv_tier_token_identical", "kv_tier_spills",
                "kv_tier_prefetch_hits", "kv_tier_stall_fraction",
                "kv_tier_deterministic"))
+    if "disagg" in probes:
+        # the absolute TTFT p99s ride bench.py's artifact only — the
+        # gated contract is the identity/pages/hit-rate/stall/ratio/
+        # determinism sextet
+        _take(probe_disagg(paddle, colocated=disagg_colocated),
+              ("disagg_token_identical", "disagg_kv_pages_transferred",
+               "disagg_fleet_prefix_hit_rate",
+               "disagg_transfer_stall_fraction",
+               "disagg_ttft_ratio_vs_colocated",
+               "disagg_deterministic"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -430,6 +474,12 @@ def main(argv=None) -> int:
                          "staging: every parked-sequence restore "
                          "becomes a counted stall and prefetch hits "
                          "read 0 (the injected regression)")
+    ap.add_argument("--colocated", action="store_true",
+                    help="serve the disagg probe's scenarios with "
+                         "roles=None: zero pages move over the fabric, "
+                         "the fleet prefix cache never hits, and the "
+                         "TTFT ratio collapses to ~1 (the injected "
+                         "regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -458,7 +508,8 @@ def main(argv=None) -> int:
                       fusion_defuse=args.defuse,
                       telemetry_burn_alerts=not args.no_burn_alerts,
                       persist_corrupt=args.corrupt_checkpoint,
-                      kvtier_prefetch=not args.no_prefetch)
+                      kvtier_prefetch=not args.no_prefetch,
+                      disagg_colocated=args.colocated)
 
     if args.json:
         # --json changes the output format, never the action: combined
