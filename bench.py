@@ -46,6 +46,7 @@ from tools.bench_probes import (probe_disagg,  # noqa: E402
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
                                 probe_kv_tiering,
+                                probe_multitenant,
                                 probe_opt_dispatches,
                                 probe_persistence, probe_serving,
                                 probe_spec_decode, probe_telemetry,
@@ -64,6 +65,7 @@ _probe_telemetry = probe_telemetry
 _probe_persistence = probe_persistence
 _probe_kv_tiering = probe_kv_tiering
 _probe_disagg = probe_disagg
+_probe_multitenant = probe_multitenant
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -229,6 +231,7 @@ def run_bench(config="llama_125m", progress=None):
     persistence_probe = _probe_persistence(paddle)
     kv_tier_probe = _probe_kv_tiering(paddle)
     disagg_probe = _probe_disagg(paddle)
+    multitenant_probe = _probe_multitenant(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -304,6 +307,7 @@ def run_bench(config="llama_125m", progress=None):
         **persistence_probe,
         **kv_tier_probe,
         **disagg_probe,
+        **multitenant_probe,
     }
 
 
@@ -631,6 +635,16 @@ def _failure_artifact(last_err, last_stages):
         "disagg_deterministic": None,
         "disagg_ttft_p99_s": None,
         "disagg_colocated_ttft_p99_s": None,
+        # multi-tenant economy fields are per-run proofs too: an
+        # isolation ratio, quota-shed count, mixed-batch identity
+        # verdict, or hot-swap compile count from a stale round proves
+        # nothing about the run that failed
+        "multitenant_good_ttft_p99_s": None,
+        "multitenant_isolation_ratio": None,
+        "multitenant_quota_shed": None,
+        "multitenant_deterministic": None,
+        "multitenant_mixed_batch_identical": None,
+        "multitenant_hot_swap_compiles": None,
     }
     good = _last_good_round()
     if good:

@@ -157,7 +157,8 @@ class ClusterDriver:
                         eos_token_id=req.eos_token_id,
                         deadline_s=req.deadline_s,
                         abort_after_s=getattr(req, "abort_after_s", None),
-                        request_id=req.request_id, session_id=session)
+                        request_id=req.request_id, session_id=session,
+                        tenant_id=getattr(req, "tenant_id", None))
                     rec.status = "waiting"
                 except RequestRejected:
                     self._absorb(rec, cluster.outputs()[req.request_id],

@@ -62,6 +62,8 @@ class RequestRecord:
     deadline_s: float | None
     slo_e2e_s: float | None
     prefix_cohort: int = -1
+    #: owning tenant when the trace declares a tenant mix, else None
+    tenant_id: str | None = None
     #: when the driver actually handed the request to the engine (the
     #: step boundary at/after arrival_s — a real intake queue's poll)
     submitted_at: float | None = None
@@ -211,7 +213,8 @@ class Driver:
                         eos_token_id=req.eos_token_id,
                         deadline_s=req.deadline_s,
                         abort_after_s=getattr(req, "abort_after_s", None),
-                        request_id=req.request_id)
+                        request_id=req.request_id,
+                        tenant_id=getattr(req, "tenant_id", None))
                     rec.status = "waiting"
                 except RequestRejected:
                     # the engine recorded a finalized aborted output;
@@ -314,7 +317,8 @@ def build_trace_records(trace) -> dict:
         request_id=r.request_id, arrival_s=r.arrival_s,
         prompt_len=len(r.prompt_token_ids),
         max_new_tokens=r.max_new_tokens, deadline_s=r.deadline_s,
-        slo_e2e_s=r.slo_e2e_s, prefix_cohort=r.prefix_cohort)
+        slo_e2e_s=r.slo_e2e_s, prefix_cohort=r.prefix_cohort,
+        tenant_id=getattr(r, "tenant_id", None))
         for r in trace}
 
 

@@ -190,6 +190,30 @@ def build_report(result, *, spec=None, trace=None, tracer=None,
             "resident_fraction": m.get("kv_resident_fraction"),
             "host_chain_promotions": m.get("kv_host_chain_promotions"),
         }
+    if m.get("tenants") is not None:
+        # multi-tenant engines only (paddle_tpu.tenancy) — classic
+        # artifacts byte-persist without the section. The engine-side
+        # ledgers carry cost attribution (tokens, KV byte-seconds,
+        # adapter-slot seconds); the record-derived block carries the
+        # EXACT per-tenant latency split the isolation gate scores.
+        by_tenant: dict = {}
+        for r in result.records:
+            tid = getattr(r, "tenant_id", None) or "_default"
+            by_tenant.setdefault(tid, []).append(r)
+        report["tenants"] = {
+            "ledgers": m["tenants"],
+            "quota_shed_requests": m.get("quota_shed_requests", 0),
+            "adapter_slots": m.get("adapter_slots"),
+            "per_tenant": {
+                tid: {
+                    "requests": len(rs),
+                    "finished": sum(1 for x in rs
+                                    if x.status == "finished"),
+                    "shed": sum(1 for x in rs if x.status == "shed"),
+                    "ttft_s": _dist([x.ttft_s for x in rs
+                                     if x.status == "finished"]),
+                } for tid, rs in sorted(by_tenant.items())},
+        }
     if spec is not None and \
             getattr(spec, "lane", "interactive") == "offline_batch":
         # throughput-not-latency lane (ROADMAP 5d): batch tokens/s is

@@ -75,6 +75,9 @@ class TraceRequest:
     eos_token_id: int | None = None
     #: cohort index when the prompt starts with a shared prefix, else -1
     prefix_cohort: int = -1
+    #: owning tenant when the spec declares a tenant mix, else None —
+    #: classic (no-tenant) traces never carry (or hash) the field
+    tenant_id: str | None = None
 
 
 @dataclass(frozen=True)
@@ -138,8 +141,59 @@ class WorkloadSpec:
     #: byte-persist.
     long_context_fraction: float = 0.0
     long_context_len: tuple | None = None
+    #: multi-tenant mix (tenancy/policy.py): each entry is a mapping
+    #: ``{"tenant_id": str, "weight": float > 0,
+    #: "quota_tokens_per_s": float | None, "adapter_id": Any,
+    #: "abusive": bool}`` — requests draw their owner from the weighted
+    #: mix (ONE extra rng draw per request, at the END of the
+    #: per-request draw order, so every pre-tenant trace byte-persists).
+    #: At most one tenant may be ``abusive``: its SELECTION share is
+    #: multiplied by ``abusive_multiplier`` — the seeded noisy-neighbor
+    #: flood — while its declared ``weight``/quota (what the engine's
+    #: fair scheduler sees) stays honest.
+    tenants: tuple = ()
+    abusive_multiplier: float = 8.0
 
     def __post_init__(self):
+        if self.tenants:
+            object.__setattr__(
+                self, "tenants", tuple(dict(t) for t in self.tenants))
+            allowed = {"tenant_id", "weight", "quota_tokens_per_s",
+                       "adapter_id", "abusive"}
+            seen = set()
+            n_abusive = 0
+            for t in self.tenants:
+                unknown = set(t) - allowed
+                if unknown:
+                    raise ValueError(
+                        f"unknown tenant keys {sorted(unknown)}; "
+                        f"allowed: {sorted(allowed)}")
+                tid = t.get("tenant_id")
+                if not isinstance(tid, str) or not tid:
+                    raise ValueError(
+                        f"each tenant needs a non-empty string "
+                        f"tenant_id, got {tid!r}")
+                if tid in seen:
+                    raise ValueError(f"duplicate tenant_id {tid!r}")
+                seen.add(tid)
+                w = float(t.get("weight", 1.0))
+                if w <= 0:
+                    raise ValueError(
+                        f"tenant {tid!r}: weight must be > 0, got {w}")
+                q = t.get("quota_tokens_per_s")
+                if q is not None and float(q) <= 0:
+                    raise ValueError(
+                        f"tenant {tid!r}: quota_tokens_per_s must be "
+                        f"> 0 (or None), got {q}")
+                n_abusive += bool(t.get("abusive", False))
+            if n_abusive > 1:
+                raise ValueError(
+                    "at most one tenant may be abusive (the "
+                    "noisy-neighbor scenario has ONE noisy neighbor)")
+            if self.abusive_multiplier < 1.0:
+                raise ValueError(
+                    f"abusive_multiplier must be >= 1, "
+                    f"got {self.abusive_multiplier}")
         if self.num_requests < 1:
             raise ValueError("num_requests must be >= 1")
         if self.arrival not in ARRIVALS:
@@ -235,6 +289,13 @@ class WorkloadSpec:
         """Plain-dict view of the spec for the report artifact."""
         return asdict(self)
 
+    def tenant_specs(self) -> list:
+        """Engine-side ``TenantSpec`` kwargs: the declared entitlements
+        minus the loadgen-only ``abusive`` flag — the flood is a TRAFFIC
+        shape; the scheduler sees only the honest weight/quota."""
+        return [{k: v for k, v in t.items() if k != "abusive"}
+                for t in self.tenants]
+
     def compile(self) -> list:
         """Materialize the trace: one rng stream, stable ids, sorted
         non-decreasing arrival times."""
@@ -246,6 +307,20 @@ class WorkloadSpec:
                 for _ in range(self.num_shared_prefixes)]
         plo, phi = self.prompt_len
         olo, ohi = self.output_len
+        # tenant selection shares: the abusive tenant floods by
+        # multiplied SHARE (it sends more traffic), not by multiplied
+        # scheduler weight (its declared weight stays honest)
+        tenant_cum = None
+        if self.tenants:
+            shares = [float(t.get("weight", 1.0))
+                      * (self.abusive_multiplier
+                         if t.get("abusive", False) else 1.0)
+                      for t in self.tenants]
+            total = sum(shares)
+            acc, tenant_cum = 0.0, []
+            for s in shares:
+                acc += s / total
+                tenant_cum.append(acc)
         t = 0.0
         trace = []
         for i in range(self.num_requests):
@@ -307,6 +382,16 @@ class WorkloadSpec:
                 slo, shi = self.per_request_seed
                 seed = slo if slo == shi else int(
                     rng.integers(slo, shi + 1))
+            # tenant owner: LAST per-request draw, and only when a mix
+            # is declared — classic traces consume exactly the draws
+            # they always did, so their fingerprints byte-persist
+            tenant_id = None
+            if tenant_cum is not None:
+                u = float(rng.random())
+                for j, edge in enumerate(tenant_cum):
+                    if u < edge or j == len(tenant_cum) - 1:
+                        tenant_id = self.tenants[j]["tenant_id"]
+                        break
             trace.append(TraceRequest(
                 request_id=f"lg-{self.seed}-{i}", arrival_s=t,
                 prompt_token_ids=prompt, max_new_tokens=olen,
@@ -314,20 +399,27 @@ class WorkloadSpec:
                 abort_after_s=self.abort_after_s,
                 temperature=self.temperature, top_k=tk, top_p=tp,
                 seed=seed, eos_token_id=self.eos_token_id,
-                prefix_cohort=cohort))
+                prefix_cohort=cohort, tenant_id=tenant_id))
         return trace
 
 
 def trace_fingerprint(trace) -> str:
     """Stable sha256 over the trace's full content — the determinism
     gate's witness: same spec => same fingerprint, across processes."""
-    blob = json.dumps(
-        [[r.request_id, repr(r.arrival_s), list(r.prompt_token_ids),
-          r.max_new_tokens, r.deadline_s, r.slo_e2e_s, r.temperature,
-          r.top_k, repr(r.top_p), r.seed,
-          r.eos_token_id, r.prefix_cohort,
-          getattr(r, "abort_after_s", None)] for r in trace],
-        sort_keys=True)
+    def row(r):
+        out = [r.request_id, repr(r.arrival_s), list(r.prompt_token_ids),
+               r.max_new_tokens, r.deadline_s, r.slo_e2e_s, r.temperature,
+               r.top_k, repr(r.top_p), r.seed,
+               r.eos_token_id, r.prefix_cohort,
+               getattr(r, "abort_after_s", None)]
+        # tenant owner hashes ONLY when set: classic traces keep their
+        # pre-tenancy fingerprints byte for byte
+        tid = getattr(r, "tenant_id", None)
+        if tid is not None:
+            out.append(tid)
+        return out
+
+    blob = json.dumps([row(r) for r in trace], sort_keys=True)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 
 

@@ -110,14 +110,38 @@ def _repeat_kv(x, rep):
     return jnp.repeat(x, rep, axis=2)
 
 
-def _wmat(x, w):
+def _wmat(x, w, lora=None):
     """Projection matmul over a raw array OR a low-bit serving weight
     (quantization.QuantizedWeight -> the fused dequant-matmul kernel).
     Every projection in the prefill/decode bodies routes through here so
     ``quantize_params`` pytrees run fully jitted — the dequant happens in
-    the kernel prologue, never as a per-token eager dispatch."""
+    the kernel prologue, never as a per-token eager dispatch.
+
+    ``lora=(A, B, slots)`` adds the batched multi-tenant LoRA delta
+    (paddle_tpu.tenancy): A ``[n_slots, r, d_in]``, B ``[n_slots,
+    d_out, r]``, slots ``[t]`` int32 per-row adapter-slot ids. Each row
+    computes ``base(x) + (x @ A[slot].T) @ B[slot].T`` via a batched
+    gather — the slot vector is DATA, so rows wearing different
+    adapters (or none: slot 0 is all-zero = the base model, bitwise)
+    share one trace of one executable. The delta runs in fp over the
+    (possibly int8/int4-dequant) base matmul output.
+    """
     from ..quantization.low_bit import matmul
-    return matmul(x, w)
+    y = matmul(x, w)
+    if lora is not None:
+        A, B, slots = lora
+        if x.ndim == 2:                       # [t, d_in] token-major
+            xa = jnp.einsum("td,trd->tr", x.astype(jnp.float32),
+                            A[slots].astype(jnp.float32))
+            delta = jnp.einsum("tr,tor->to", xa,
+                               B[slots].astype(jnp.float32))
+        else:                                  # [b, t, d_in], slots [t]
+            xa = jnp.einsum("btd,trd->btr", x.astype(jnp.float32),
+                            A[slots].astype(jnp.float32))
+            delta = jnp.einsum("btr,tor->bto", xa,
+                               B[slots].astype(jnp.float32))
+        y = y + delta.astype(y.dtype)
+    return y
 
 
 _STACKED_LAYER_KEYS = {

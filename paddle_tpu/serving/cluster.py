@@ -677,7 +677,8 @@ class ClusterEngine:
                 temperature=req.temperature, top_k=req.top_k,
                 top_p=req.top_p, seed=req.seed,
                 eos_token_id=req.eos_token_id, deadline_s=deadline_s,
-                abort_after_s=abort_after_s, request_id=rid)
+                abort_after_s=abort_after_s, request_id=rid,
+                tenant_id=req.tenant_id, adapter_id=req.adapter_id)
         except RequestRejected:
             out = self._outputs[rid]
             out.status = "aborted"
@@ -720,7 +721,8 @@ class ClusterEngine:
     def add_request(self, prompt_token_ids, *, max_new_tokens=16,
                     temperature=0.0, top_k=None, top_p=None, seed=None,
                     eos_token_id=None, deadline_s=None, abort_after_s=None,
-                    request_id=None, session_id=None):
+                    request_id=None, session_id=None, tenant_id=None,
+                    adapter_id=None):
         """Queue a request with the fleet; returns its id. Routes
         immediately when a replica is admittable, otherwise parks until
         one is. ``session_id`` opts the request into session affinity
@@ -735,7 +737,8 @@ class ClusterEngine:
             prompt_token_ids=prompt, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             eos_token_id=eos_token_id, deadline_s=deadline_s,
-            abort_after_s=abort_after_s, request_id=rid)
+            abort_after_s=abort_after_s, request_id=rid,
+            tenant_id=tenant_id, adapter_id=adapter_id)
         self._meta[rid] = {"retries": 0, "session": session_id,
                            "replica": None, "arrival": self._now(),
                            "not_before": None, "preempt_base": 0}

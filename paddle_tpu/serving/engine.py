@@ -133,6 +133,12 @@ class Request:
     #: instead of decoding tokens nobody will read
     abort_after_s: float | None = None
     request_id: str | None = None
+    #: multi-tenant serving (paddle_tpu.tenancy): the submitting tenant
+    #: (None = untenanted) and the LoRA adapter the request wears —
+    #: None resolves to the tenant's default adapter (or the base
+    #: model), 0 is explicitly the base model
+    tenant_id: str | None = None
+    adapter_id: object = None
 
 
 @dataclass
@@ -144,6 +150,7 @@ class RequestOutput:
     status: str = "waiting"
     finish_reason: str | None = None
     num_preemptions: int = 0
+    tenant_id: str | None = None
 
     @property
     def finished(self) -> bool:
@@ -269,7 +276,9 @@ class LLMEngine:
                  engine_id=None, gauge_stale_after_s=None,
                  prefix_store=None, prefix_store_autosave=None,
                  host_kv_pages=0, kv_prefetch=True, kv_prefetch_depth=4,
-                 kv_spill_seed=0, fleet_prefix_cache=None):
+                 kv_spill_seed=0, fleet_prefix_cache=None,
+                 tenants=None, adapter_slots=0, adapter_rank=8,
+                 adapter_store=None, adapter_store_autosave=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -297,6 +306,16 @@ class LLMEngine:
                 "speculative decoding and the on-device burst loop are "
                 "mutually exclusive decode accelerations — set "
                 "burst_tokens=1 (the default) when passing draft_model")
+        # multi-tenant LoRA (paddle_tpu.tenancy): an adapter store with
+        # no explicit slot count still needs a registry to reload into
+        if adapter_store is not None and not adapter_slots:
+            adapter_slots = 4
+        if adapter_slots and burst_tokens > 1:
+            raise ValueError(
+                "batched LoRA adapters run inside the ragged step; the "
+                "on-device burst loop (decode megakernel) has no adapter "
+                "path — set burst_tokens=1 (the default) when passing "
+                "adapter_slots/adapter_store")
         self.spec_tokens = spec_tokens
         #: runtime eligibility gate for speculative rounds — the
         #: degradation ladder's first rung flips it off under pressure
@@ -538,6 +557,51 @@ class LLMEngine:
         #: prefilled once anywhere in the fleet is never re-prefilled
         #: here, even if the publishing replica has since crashed.
         self.fleet_prefix = fleet_prefix_cache
+        # multi-tenant LoRA serving (paddle_tpu.tenancy): a
+        # fixed-capacity adapter slab whose slot ids travel the ragged
+        # step as per-token DATA (slot 0 = zeros = the base model), and
+        # an optional per-tenant economy — weighted-fair admission,
+        # refilling token quotas, cost ledgers. Both are strictly
+        # additive: without them the step's operand list gains NOTHING
+        # (None legs are empty pytrees) and admission stays bare FIFO.
+        self.adapters = None
+        if adapter_slots:
+            from ..tenancy.adapters import AdapterRegistry
+            self.adapters = AdapterRegistry(
+                cfg, n_slots=int(adapter_slots), rank=int(adapter_rank))
+        self.tenant_policy = None
+        if tenants is not None:
+            from ..tenancy.policy import TenantPolicy
+            if isinstance(tenants, TenantPolicy):
+                self.tenant_policy = tenants
+            else:
+                self.tenant_policy = TenantPolicy(tenants,
+                                                  now_fn=self._now)
+            self.scheduler.policy = self.tenant_policy
+        #: wall/virtual time of the last per-step cost accrual (KV
+        #: byte-seconds, adapter-slot-seconds); None until the first step
+        self._last_cost_t = None
+        # persistent adapter store (io/persist.py): published adapters
+        # survive process death — construction warm-reloads the newest
+        # verified version (corruption degrades to a cold start inside
+        # ArtifactStore; geometry drift raises AdapterStoreMismatch),
+        # and every add/evict re-persists when autosave is on.
+        self.adapter_store = None
+        self._adapter_autosave = False
+        if adapter_store is not None:
+            if isinstance(adapter_store, (str, os.PathLike)):
+                from ..io.persist import ArtifactStore
+                adapter_store = ArtifactStore(
+                    adapter_store, flight_recorder=self.flight,
+                    now_fn=self._now)
+            self.adapter_store = adapter_store
+            self._adapter_autosave = True if adapter_store_autosave \
+                is None else bool(adapter_store_autosave)
+            restored = self.adapters.restore(self.adapter_store)
+            if restored:
+                self.metrics.adapter_restores.inc(restored)
+                self.record_fleet_event("adapter_restore",
+                                        adapters=restored)
         self._step_launched = False
         self._burst_launched = False
         self._build_step()
@@ -569,7 +633,8 @@ class LLMEngine:
         def ragged_step(params, kv, kv_scales, tokens, positions, tbls,
                         q_starts, q_lens, kv_lens, sample_idx, temps,
                         top_ks, top_ps, seeds, sample_pos, spec_lens,
-                        draft_tokens, draft_probs, base_key):
+                        draft_tokens, draft_probs, base_key,
+                        adapters, adapter_slots):
             # tokens/positions [T] packed row-wise (pad rows: q_len=0,
             # q_start=T); tbls [R, PPS]; kv_lens = committed + q_len per
             # row (the attention length AFTER this step's appends);
@@ -582,10 +647,23 @@ class LLMEngine:
             # (spec_lens/draft_tokens/draft_probs; all-zero on ordinary
             # rounds, where the sampler degenerates to one direct draw
             # from the last position's distribution).
+            # adapters/adapter_slots (paddle_tpu.tenancy): the LoRA
+            # slab pytree + per-token slot ids. None legs contribute
+            # ZERO operands (empty pytrees), so adapter-free engines
+            # lower byte-identical HLO; with a registry, which adapter
+            # a token wears is a gather — data, never shape.
             tok_row, live = _ragged_packing(q_starts, q_lens, T)
+
+            def lo(ad, p):
+                if ad is None:
+                    return None
+                A, B = ad[p]
+                return (A, B, adapter_slots)
+
             h = params["embed"][tokens][None]               # [1, T, hid]
             new_kv, new_scales = [], []
             for li, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
+                ad = adapters[li] if adapters is not None else None
                 if not quant_pool:
                     # the shared fp layer body (spec_decode), which the
                     # draft worker also runs — draft/target numerics
@@ -593,13 +671,16 @@ class LLMEngine:
                     h, Kp, Vp = _ragged_fp_layer(
                         lyr, h, Kp, Vp, positions, tbls, tok_row, live,
                         q_starts, q_lens, kv_lens, cfg, ps, PPS, qb,
-                        interpret)
+                        interpret, adapters=ad, slots=adapter_slots)
                     new_kv.append((Kp, Vp))
                     continue
                 x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
-                q = _wmat(x, lyr["q"]).reshape(1, T, H, d)
-                k = _wmat(x, lyr["k"]).reshape(1, T, Hkv, d)
-                v = _wmat(x, lyr["v"]).reshape(1, T, Hkv, d)
+                q = _wmat(x, lyr["q"], lora=lo(ad, "q")) \
+                    .reshape(1, T, H, d)
+                k = _wmat(x, lyr["k"], lora=lo(ad, "k")) \
+                    .reshape(1, T, Hkv, d)
+                v = _wmat(x, lyr["v"], lora=lo(ad, "v")) \
+                    .reshape(1, T, Hkv, d)
                 q = _rope(q, positions[None], cfg.rope_theta, d)
                 k = _rope(k, positions[None], cfg.rope_theta, d)
                 kt = jnp.transpose(k[0], (1, 0, 2))         # [Hkv, T, d]
@@ -614,10 +695,14 @@ class LLMEngine:
                     q[0], Kp, Vp, tbls, q_starts, q_lens, kv_lens,
                     q_block=qb, interpret=interpret,
                     k_scales=Ks, v_scales=Vs)
-                h = h + _wmat(o.reshape(1, T, H * d), lyr["o"])
+                h = h + _wmat(o.reshape(1, T, H * d), lyr["o"],
+                              lora=lo(ad, "o"))
                 x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
-                h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"]))
-                              * _wmat(x, lyr["up"]), lyr["down"])
+                h = h + _wmat(
+                    jax.nn.silu(_wmat(x, lyr["gate"],
+                                      lora=lo(ad, "gate")))
+                    * _wmat(x, lyr["up"], lora=lo(ad, "up")),
+                    lyr["down"], lora=lo(ad, "down"))
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             verify = h[0, sample_idx.reshape(-1)]       # [R*(K+1), hid]
             logits = _logits(params, verify, cfg) \
@@ -784,7 +869,7 @@ class LLMEngine:
     def add_request(self, prompt_token_ids, *, max_new_tokens=16,
                     temperature=0.0, top_k=None, top_p=None, seed=None,
                     eos_token_id=None, deadline_s=None, abort_after_s=None,
-                    request_id=None):
+                    request_id=None, tenant_id=None, adapter_id=None):
         """Queue a request; returns its id. Accepts a Request too.
 
         ``top_k``/``top_p``/``seed`` are per-request sampling state: the
@@ -807,7 +892,8 @@ class LLMEngine:
                 temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
                 seed=r.seed, eos_token_id=r.eos_token_id,
                 deadline_s=r.deadline_s, abort_after_s=r.abort_after_s,
-                request_id=r.request_id)
+                request_id=r.request_id, tenant_id=r.tenant_id,
+                adapter_id=r.adapter_id)
         prompt = [int(t) for t in np.asarray(prompt_token_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -835,6 +921,37 @@ class LLMEngine:
                     f"max_new_tokens {max_new_tokens} needs {needed} pages "
                     f"(limit {limit}) / {total} tokens (max_len "
                     f"{self.max_len}) — rejected at admission"))
+        # adapter resolution (paddle_tpu.tenancy): an explicit
+        # adapter_id wins; None falls back to the tenant's declared
+        # default (or the base model). A request naming an adapter the
+        # registry does not hold is REJECTED with a structured output
+        # — serving it the base model silently would be a correctness
+        # bug, not a degradation.
+        if adapter_id is None:
+            adapter_id = self.tenant_policy.adapter_for(tenant_id) \
+                if self.tenant_policy is not None else 0
+        adapter_slot = 0
+        if adapter_id not in (0, None):
+            from ..tenancy.adapters import UnknownAdapter
+            try:
+                if self.adapters is None:
+                    raise UnknownAdapter(adapter_id)
+                adapter_slot = self.adapters.acquire(adapter_id)
+            except UnknownAdapter:
+                self._outputs[rid] = RequestOutput(
+                    rid, prompt, status="aborted",
+                    finish_reason="rejected_unknown_adapter")
+                self.metrics.rejected_requests.inc()
+                raise RequestRejected(
+                    rid, "rejected_unknown_adapter",
+                    message=(
+                        f"request {rid}: adapter {adapter_id!r} is not "
+                        f"in the registry "
+                        f"({self.adapters.adapter_ids() if self.adapters is not None else 'no registry'}) "
+                        f"— publish it (engine.add_adapter / "
+                        f"AdapterTuner.publish) before submitting"))
+        else:
+            adapter_id = 0
         now = self._now()
         seq = Sequence(
             seq_id=rid, prompt_ids=prompt, max_new_tokens=max_new_tokens,
@@ -849,7 +966,8 @@ class LLMEngine:
             # range instead of blowing up the serving loop at launch
             seed=((int(seed) & 0x7FFFFFFF) if seed is not None
                   else zlib.crc32(str(rid).encode("utf-8")) & 0x7FFFFFFF),
-            eos_token_id=eos_token_id)
+            eos_token_id=eos_token_id, tenant_id=tenant_id,
+            adapter_id=adapter_id, adapter_slot=adapter_slot)
         self.scheduler.add(seq)
         self._seqs[rid] = seq
         self._outputs[rid] = RequestOutput(rid, prompt)
@@ -889,6 +1007,8 @@ class LLMEngine:
             s for s in self.scheduler.waiting if s is not seq)
         if self._draft is not None:
             self._draft.drop(request_id)
+        if self.adapters is not None and seq.adapter_id not in (0, None):
+            self.adapters.release(seq.adapter_id)
         del self._seqs[request_id]
         del self._outputs[request_id]
         return True
@@ -921,6 +1041,8 @@ class LLMEngine:
         self.pool.free(request_id)
         if self._draft is not None:
             self._draft.drop(request_id)
+        if self.adapters is not None and seq.adapter_id not in (0, None):
+            self.adapters.release(seq.adapter_id)
         del self._seqs[request_id]
         del self._outputs[request_id]
         self.flight.record("handoff_out", self._now(), request=request_id,
@@ -937,6 +1059,8 @@ class LLMEngine:
                 "seed": seq.seed, "eos_token_id": seq.eos_token_id,
                 "num_preemptions": seq.num_preemptions,
                 "first_token_at": seq.first_token_at,
+                "tenant_id": seq.tenant_id,
+                "adapter_id": seq.adapter_id,
                 "cached_len": seq.cached_len,
                 "num_tokens": num_tokens, "layers": layers}
 
@@ -958,6 +1082,13 @@ class LLMEngine:
                 f"request {rid!r}: payload carries "
                 f"{payload['num_tokens']} tokens of KV but cached_len is "
                 f"{cached_len}")
+        adapter_id = payload.get("adapter_id") or 0
+        adapter_slot = 0
+        if adapter_id not in (0, None):
+            from ..tenancy.adapters import UnknownAdapter
+            if self.adapters is None:
+                raise UnknownAdapter(adapter_id)
+            adapter_slot = self.adapters.acquire(adapter_id)
         self.pool.adopt_sequence(rid, cached_len, payload["layers"])
         seq = Sequence(
             seq_id=rid, prompt_ids=list(payload["prompt_ids"]),
@@ -967,11 +1098,15 @@ class LLMEngine:
             temperature=payload["temperature"],
             top_k=payload["top_k"], top_p=payload["top_p"],
             seed=payload["seed"], eos_token_id=payload["eos_token_id"],
-            num_preemptions=payload["num_preemptions"])
+            num_preemptions=payload["num_preemptions"],
+            tenant_id=payload.get("tenant_id"),
+            adapter_id=adapter_id, adapter_slot=adapter_slot)
         try:
             self.scheduler.add(seq)
         except ValueError:
             self.pool.free(rid)
+            if self.adapters is not None and adapter_id not in (0, None):
+                self.adapters.release(adapter_id)
             raise
         # carried progress: add() enqueues a WAITING row; these fields
         # make it a caught-up decode row the parked-admission path
@@ -1062,10 +1197,24 @@ class LLMEngine:
                 z((R,), jnp.float32), z((R,), jnp.int32),
                 jnp.ones((R,), jnp.float32), z((R,), jnp.int32),
                 z((R,), jnp.int32), z((R,), jnp.int32),
-                self._zero_draft[0], self._zero_draft[1], self._base_key)
+                self._zero_draft[0], self._zero_draft[1], self._base_key,
+                self.adapters.slab if self.adapters is not None else None,
+                z((T,), jnp.int32) if self.adapters is not None else None)
         return self._ragged_jit.lower(*args).compile().as_text()
 
     def metrics_snapshot(self) -> dict:
+        if self.adapters is not None:
+            # registry counters fold in as deltas so repeated snapshots
+            # never double-count a hot-add or eviction
+            m = self.metrics
+            m.adapter_hot_adds.inc(
+                self.adapters.hot_adds - m.adapter_hot_adds.value)
+            m.adapter_evictions.inc(
+                self.adapters.evictions - m.adapter_evictions.value)
+            m.adapter_evict_refusals.inc(
+                self.adapters.evict_refusals
+                - m.adapter_evict_refusals.value)
+            m.adapter_slots_used.set(self.adapters.slots_used)
         snap = self.metrics.snapshot()
         snap["decode_cache_size"] = self.decode_cache_size()
         snap["burst_tokens"] = self.burst_tokens
@@ -1109,6 +1258,13 @@ class LLMEngine:
         snap["draft_propose_compiles"] = \
             self._draft.propose_cache_size() if self._draft is not None \
             else None
+        # multi-tenancy forensics: slab capacity + per-tenant ledgers —
+        # explicit None for single-tenant engines, never fabricated zeros
+        snap["adapter_slots"] = \
+            self.adapters.n_slots if self.adapters is not None else None
+        snap["tenants"] = \
+            self.tenant_policy.snapshot() \
+            if self.tenant_policy is not None else None
         return snap
 
     def decode_cache_size(self):
@@ -1148,17 +1304,29 @@ class LLMEngine:
             self.metrics.deadline_aborts.inc()
             self._finalize(seq, "shed", reason="deadline_exceeded")
             touched[seq.seq_id] = self._outputs[seq.seq_id]
+        if self.tenant_policy is not None:
+            # quota shed: still-WAITING rows of tenants whose refilling
+            # token bucket is exhausted beyond the grace window leave
+            # with a structured reason instead of starving the queue
+            for seq in self.scheduler.shed_quota():
+                self.metrics.quota_shed_requests.inc()
+                self.tenant_policy.count_shed(seq.tenant_id)
+                self._finalize(seq, "shed",
+                               reason=seq.shed_reason or "quota_exceeded")
+                touched[seq.seq_id] = self._outputs[seq.seq_id]
         hook = self._prefix_probe if self.prefix_caching else None
         for seq in self.scheduler.admit(prefix_hook=hook):
             touched[seq.seq_id] = self._sync_output(seq)
             if self.tracer is not None:
                 now = self._now()
+                extra = {} if seq.tenant_id is None \
+                    else {"tenant": seq.tenant_id}
                 self._trace(
                     seq.seq_id, "admission", t=now,
                     prefix_shared=seq.cached_len,
                     queue_s=now - (seq.enqueued_at
                                    if seq.enqueued_at is not None
-                                   else seq.arrival))
+                                   else seq.arrival), **extra)
         plan = None
         bplan = None
         splan = None
@@ -1272,6 +1440,21 @@ class LLMEngine:
                     self._trace(rid, kind,
                                 **{k: v for k, v in detail.items()
                                    if k != "request"})
+        if self.tenant_policy is not None:
+            # cost attribution on the engine's own clock: KV byte-seconds
+            # for resident pages and adapter-slot residency seconds accrue
+            # against the owning tenant's ledger every step
+            now = self._now()
+            dt = (now - self._last_cost_t) \
+                if self._last_cost_t is not None else 0.0
+            self._last_cost_t = now
+            if dt > 0:
+                bpt = self.pool.kv_bytes_per_token
+                for seq in self.scheduler.running:
+                    self.tenant_policy.charge_kv(
+                        seq.tenant_id, seq.cached_len * bpt * dt)
+                    if seq.adapter_slot:
+                        self.tenant_policy.charge_slot(seq.tenant_id, dt)
         self.metrics.record_step(self.scheduler, self.pool)
         # one O(1) flight-recorder entry per step: the bounded last-N
         # context a post-mortem dump replays (ints only — cheap and
@@ -1393,6 +1576,51 @@ class LLMEngine:
         self.prefix_store.save(self.PREFIX_STORE_TAG, arrays, meta)
         self._prefix_store_sig = sig
         self.metrics.prefix_store_saves.inc()
+        return True
+
+    # ------------------------------------------------------------------
+    # adapter registry (tenancy/adapters.py)
+    # ------------------------------------------------------------------
+    def add_adapter(self, adapter_id, arrays) -> int:
+        """Hot-publish a LoRA adapter into the serving slab — an in-place
+        ``.at[slot].set`` on the stacked factors, so slab SHAPES never
+        change and the ragged executable never retraces. Returns the
+        slot. Re-publishing an id updates it in place (new requests see
+        the new factors; in-flight rows keep decoding on the slab they
+        were launched with)."""
+        if self.adapters is None:
+            raise ValueError(
+                "engine was built without adapter_slots; construct with "
+                "adapter_slots=N to serve LoRA adapters")
+        slot = self.adapters.add(adapter_id, arrays)
+        self.flight.record("adapter_add", self._now(),
+                           adapter=str(adapter_id), slot=slot)
+        if self._adapter_autosave:
+            self.save_adapters()
+        return slot
+
+    def evict_adapter(self, adapter_id):
+        """Drop an adapter from the slab (slot zeroes back to the base
+        identity). Refuses with :class:`~paddle_tpu.tenancy.adapters.
+        AdapterInUse` while any in-flight request references it."""
+        if self.adapters is None:
+            raise ValueError("engine has no adapter registry")
+        self.adapters.evict(adapter_id)
+        self.flight.record("adapter_evict", self._now(),
+                           adapter=str(adapter_id))
+        if self._adapter_autosave:
+            self.save_adapters()
+
+    def save_adapters(self) -> bool:
+        """Persist the adapter slab (atomic, versioned, checksummed via
+        io/persist.py). No-op without a store or without publishes since
+        the last save. Counted on ``adapter_store_saves``."""
+        if self.adapter_store is None or self.adapters is None \
+                or not self.adapters.dirty:
+            return False
+        if self.adapters.save(self.adapter_store) is None:
+            return False
+        self.metrics.adapter_store_saves.inc()
         return True
 
     def _restore_prefix_store(self):
@@ -1612,6 +1840,8 @@ class LLMEngine:
         seeds = np.zeros((R,), np.int32)
         sample_pos = np.zeros((R,), np.int32)
         spec_lens = np.zeros((R,), np.int32)
+        slot_ids = np.zeros((T,), np.int32) \
+            if self.adapters is not None else None
         if draft_tokens is None:
             # ordinary round: the prebuilt zero operands (never indexed
             # below — every row has spec == 0)
@@ -1643,6 +1873,8 @@ class LLMEngine:
             seeds[i] = seq.seed
             sample_pos[i] = len(seq.tokens)
             spec_lens[i] = spec
+            if slot_ids is not None and seq.adapter_slot:
+                slot_ids[q_start:q_start + q_len] = seq.adapter_slot
         out, n_out, finite, new_kv, new_scales = self._ragged_jit(
             self.params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
@@ -1651,7 +1883,9 @@ class LLMEngine:
             jnp.asarray(temps), jnp.asarray(top_ks), jnp.asarray(top_ps),
             jnp.asarray(seeds), jnp.asarray(sample_pos),
             jnp.asarray(spec_lens), jnp.asarray(draft_tokens),
-            jnp.asarray(draft_probs), self._base_key)
+            jnp.asarray(draft_probs), self._base_key,
+            self.adapters.slab if self.adapters is not None else None,
+            jnp.asarray(slot_ids) if slot_ids is not None else None)
         self.pool.kv = new_kv
         if new_scales is not None:
             self.pool.kv_scales = new_scales
@@ -1806,12 +2040,18 @@ class LLMEngine:
 
     def _commit_token(self, seq: Sequence, tok: int):
         seq.tokens.append(int(tok))
-        if seq.first_token_at is None:
+        first = seq.first_token_at is None
+        if first:
             # TTFT numerator. Burst mode commits a whole burst at one
             # host boundary, so a burst's tokens share this timestamp —
             # latency quantizes to burst length by design (docs/BENCH.md)
             seq.first_token_at = self._now()
         self.metrics.tokens_generated.inc()
+        if self.tenant_policy is not None:
+            self.tenant_policy.charge_tokens(seq.tenant_id, 1)
+            if first:
+                self.tenant_policy.record_ttft(
+                    seq.tenant_id, seq.first_token_at - seq.arrival)
         out = self._sync_output(seq)
         if seq.eos_token_id is not None and tok == seq.eos_token_id:
             self._finalize(seq, "finished", reason="eos")
@@ -1837,6 +2077,9 @@ class LLMEngine:
     def _finalize(self, seq: Sequence, status: str, reason=None):
         if self._draft is not None:
             self._draft.drop(seq.seq_id)
+        if self.adapters is not None and seq.adapter_id not in (0, None):
+            self.adapters.release(seq.adapter_id)
+            seq.adapter_id = 0        # idempotent across double-finalize
         self.scheduler.finish(seq, {
             "finished": SequenceStatus.FINISHED,
             "shed": SequenceStatus.SHED,
@@ -1857,17 +2100,25 @@ class LLMEngine:
                 kind = "shed"
             else:
                 kind = "finish"
+            # tenant attribution rides the span ONLY when set — classic
+            # (no-tenant) traces stay byte-identical per seed
+            extra = {} if seq.tenant_id is None \
+                else {"tenant": seq.tenant_id}
             self._trace(seq.seq_id, kind, status=status,
                         reason=out.finish_reason,
-                        tokens=len(seq.tokens))
+                        tokens=len(seq.tokens), **extra)
         if status in ("shed", "aborted"):
+            extra = {} if seq.tenant_id is None \
+                else {"tenant": seq.tenant_id}
             self.flight.record(status, self._now(), request=seq.seq_id,
-                               reason=out.finish_reason)
+                               reason=out.finish_reason, **extra)
         if status == "finished":
             self.metrics.finished_requests.inc()
             self.metrics.record_request_end(
                 arrival=seq.arrival, first_token_at=seq.first_token_at,
                 finished_at=self._now(), n_tokens=len(seq.tokens))
+            if self.tenant_policy is not None:
+                self.tenant_policy.count_finished(seq.tenant_id)
         if self._stream_cb is not None:
             last = seq.tokens[-1] if seq.tokens else None
             self._stream_cb(seq.seq_id, last, True)
@@ -1878,6 +2129,7 @@ class LLMEngine:
         out.token_ids = list(seq.tokens)
         out.status = seq.status.value
         out.num_preemptions = seq.num_preemptions
+        out.tenant_id = seq.tenant_id
         return out
 
 

@@ -253,7 +253,20 @@ class ServingMetrics:
                 # hits served from the FLEET store (pages prefilled on
                 # another replica, faulted in content-addressed)
                 "kv_pages_transferred", "transfer_stalls",
-                "fleet_prefix_hits")
+                "fleet_prefix_hits",
+                # multi-tenant economy (paddle_tpu.tenancy): waiting
+                # requests shed because their tenant's token bucket
+                # could not fund them (reason "quota_exceeded"), LoRA
+                # adapters hot-published into the registry, slots
+                # reclaimed by LRU eviction, evictions REFUSED because
+                # in-flight requests still wear the adapter (the
+                # structured AdapterInUse path — never a silent slot-0
+                # fallback), adapters warm-reloaded from the store at
+                # engine construction, and adapter-store snapshots
+                # persisted
+                "quota_shed_requests", "adapter_hot_adds",
+                "adapter_evictions", "adapter_evict_refusals",
+                "adapter_restores", "adapter_store_saves")
     GAUGES = ("queue_depth", "running_seqs", "waiting_seqs",
               "page_utilization", "tokens_per_s", "ragged_pad_fraction",
               "shared_page_fraction", "pinned_pages",
@@ -273,7 +286,11 @@ class ServingMetrics:
               # host-tier pinned chains) and the fraction of live KV
               # pages that are HBM-resident (1.0 for single-tier pools
               # — there is no second tier to be non-resident in)
-              "kv_host_pages_used", "kv_resident_fraction")
+              "kv_host_pages_used", "kv_resident_fraction",
+              # multi-tenant LoRA: adapter registry slots in use (slot 0
+              # — the base model — never counts); 0 for engines without
+              # a registry
+              "adapter_slots_used")
     #: per-finished-request latency distributions (seconds): TTFT =
     #: arrival -> first generated token, TPOT = mean inter-token after
     #: the first, e2e = arrival -> finalization

@@ -113,6 +113,16 @@ class Sequence:
     #: base) — the TTFT numerator; never reset by preemption (the
     #: client saw the token when it streamed, recompute is invisible)
     first_token_at: float | None = None
+    #: multi-tenant serving (paddle_tpu.tenancy): the owning tenant
+    #: (None = untenanted traffic), the LoRA adapter the request wears
+    #: (0 = base model) and its resolved registry slot — the slot rides
+    #: the ragged step as per-token DATA, never shape
+    tenant_id: str | None = None
+    adapter_id: object = 0
+    adapter_slot: int = 0
+    #: structured shed cause (e.g. "quota_exceeded") — the engine's
+    #: finalize turns it into the output's finish_reason
+    shed_reason: str | None = None
 
     @property
     def total_len(self) -> int:
@@ -225,6 +235,11 @@ class Scheduler:
         #: admission entirely — running rows finish, waiting rows sit
         #: (or are withdrawn by the cluster for requeue elsewhere)
         self.admission_blocked = False
+        #: multi-tenant economy (paddle_tpu.tenancy.TenantPolicy): when
+        #: set, admission switches to stride-scheduled weighted-fair
+        #: pick over per-tenant queues with token-bucket quota gating;
+        #: None (the default) keeps the bare-FIFO path byte-identical
+        self.policy = None
 
     # ---- introspection ----
     @property
@@ -320,7 +335,14 @@ class Scheduler:
         of each admission's FIRST chunk (later chunks claim lazily inside
         ``prepare_step``); ``prefix_hook(seq)``, when given, may fork the
         sequence onto cached prompt-prefix pages first and returns the
-        shared token count (0 on miss)."""
+        shared token count (0 on miss).
+
+        With a :class:`~paddle_tpu.tenancy.TenantPolicy` attached
+        (``self.policy``) admission instead stride-picks the next
+        fundable tenant's oldest request (weighted-fair + token-bucket
+        quotas); without one, this FIFO body runs unchanged."""
+        if self.policy is not None:
+            return self._admit_weighted(prefix_hook)
         admitted = []
         if self.admission_blocked:
             return admitted
@@ -406,6 +428,102 @@ class Scheduler:
             if self.metrics is not None:
                 self.metrics.prefills.inc()
         return admitted
+
+    def _admit_weighted(self, prefix_hook=None) -> list[Sequence]:
+        """Weighted-fair admission (paddle_tpu.tenancy.TenantPolicy):
+        each round the policy stride-picks the fundable tenant with the
+        lowest virtual pass and admits that tenant's OLDEST waiting
+        request — same pool/watermark feasibility gates as the FIFO
+        path, but the pick order interleaves tenants by weight and a
+        tenant whose token bucket cannot fund its next request simply
+        does not compete (its requests sit, or are quota-shed by
+        :meth:`shed_quota`)."""
+        admitted = []
+        if self.admission_blocked:
+            return admitted
+        if self._admission_paused and self.pool.below_low_watermark():
+            self._admission_paused = False
+        while self.waiting:
+            if len(self.running) >= self.max_num_seqs:
+                break
+            if len(admitted) >= self.config.max_prefills_per_step:
+                break
+            now = self.config.now_fn()
+            idx = self.policy.pick(self.waiting, now=now)
+            if idx is None:
+                break                  # no tenant can fund its next ask
+            seq = self.waiting[idx]
+            parked = seq.seq_id in self.pool
+            if parked:
+                first_target = min(seq.cached_len + self.config.chunk_size,
+                                   seq.total_len)
+                n_pages = self.pool.spilled_page_count(seq.seq_id) \
+                    + max(0, self.pool.pages_for(first_target)
+                          - len(self.pool.block_table(seq.seq_id)))
+                avail = self.pool.restore_headroom(seq.seq_id)
+            else:
+                first_len = min(self.config.chunk_size, seq.total_len)
+                n_pages = self.pool.pages_for(first_len)
+                avail = self.pool.available_pages
+            if n_pages > avail:
+                break
+            busy = bool(self.running) or bool(admitted)
+            if busy:
+                if self.pool.above_high_watermark(extra_pages=n_pages):
+                    self._admission_paused = True
+                if self._admission_paused:
+                    break
+            del self.waiting[idx]
+            if parked:
+                shared = seq.cached_len
+                first_target = min(shared + self.config.chunk_size,
+                                   seq.total_len)
+                try:
+                    self.pool.restore_sequence(seq.seq_id)
+                    self.pool.extend(seq.seq_id, first_target)
+                except PoolExhausted:
+                    self.waiting.insert(idx, seq)
+                    break
+            else:
+                shared = 0
+                if prefix_hook is not None:
+                    shared = int(prefix_hook(seq) or 0)
+                if not shared:
+                    self.pool.allocate(seq.seq_id, 0)
+                seq.cached_len = shared
+                first_target = min(shared + self.config.chunk_size,
+                                   seq.total_len)
+                self.pool.extend(seq.seq_id, first_target)
+            self.pool.set_seq_len(seq.seq_id, shared)
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            admitted.append(seq)
+            self.policy.on_admit(seq, now=now)
+            if self.metrics is not None:
+                self.metrics.prefills.inc()
+        return admitted
+
+    def shed_quota(self, now=None) -> list[Sequence]:
+        """Quota-based load shedding (the noisy-neighbor valve): ask
+        the policy which waiting requests sit beyond their tenant's
+        fundable horizon (current bucket + ``shed_window_s`` of refill)
+        and shed them with the structured reason ``"quota_exceeded"``.
+        Preempted-back requests (``seq.tokens`` non-empty) are never
+        shed — same work-already-under-way rule as
+        :meth:`shed_expired`. No-op without a policy."""
+        if self.policy is None:
+            return []
+        now = self.config.now_fn() if now is None else now
+        shed = []
+        for i in self.policy.shed_candidates(self.waiting, now=now):
+            s = self.waiting[i]
+            if s.tokens:
+                continue
+            s.status = SequenceStatus.SHED
+            s.shed_reason = "quota_exceeded"
+            del self.waiting[i]
+            shed.append(s)
+        return shed
 
     def prefetch_candidates(self, limit: int) -> list:
         """Seq ids of the first ``limit`` PARKED sequences in queue

@@ -96,7 +96,7 @@ def _ragged_packing(q_starts, q_lens, T):
 
 def _ragged_fp_layer(lyr, h, Kp, Vp, positions, tbls, tok_row, live,
                      q_starts, q_lens, kv_lens, cfg, page_size, max_pages,
-                     q_block, interpret):
+                     q_block, interpret, *, adapters=None, slots=None):
     """One fp decoder layer of the ragged forward: qkv proj -> rope ->
     page scatter append -> ragged attention -> o proj -> mlp. Returns
     ``(h, Kp, Vp)``.
@@ -107,15 +107,29 @@ def _ragged_fp_layer(lyr, h, Kp, Vp, positions, tbls, tok_row, live,
     acceptance with nothing pointing at the cause). The engine's int8
     pool branch stays in engine.py: its append/attention contract
     (running-amax requant, scale-aware gather) is different machinery,
-    not a copy of this."""
+    not a copy of this.
+
+    ``adapters``/``slots`` (multi-tenant LoRA, paddle_tpu.tenancy):
+    this layer's ``{proj: (A [S, r, d_in], B [S, d_out, r])}`` slab and
+    the per-token slot vector ``[T]`` — each projection then adds the
+    batched per-request delta (slot 0 is the all-zero base-model slot).
+    None (the default) adds NO operands, so adapter-free engines lower
+    byte-identical HLO."""
     ps = page_size
     H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
     T = h.shape[1]
+
+    def lo(p):
+        if adapters is None:
+            return None
+        A, B = adapters[p]
+        return (A, B, slots)
+
     x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
-    q = _wmat(x, lyr["q"]).reshape(1, T, H, d)
-    k = _wmat(x, lyr["k"]).reshape(1, T, Hkv, d)
-    v = _wmat(x, lyr["v"]).reshape(1, T, Hkv, d)
+    q = _wmat(x, lyr["q"], lora=lo("q")).reshape(1, T, H, d)
+    k = _wmat(x, lyr["k"], lora=lo("k")).reshape(1, T, Hkv, d)
+    v = _wmat(x, lyr["v"], lora=lo("v")).reshape(1, T, Hkv, d)
     q = _rope(q, positions[None], cfg.rope_theta, d)
     k = _rope(k, positions[None], cfg.rope_theta, d)
     kt = jnp.transpose(k[0], (1, 0, 2))                  # [Hkv, T, d]
@@ -140,10 +154,11 @@ def _ragged_fp_layer(lyr, h, Kp, Vp, positions, tbls, tok_row, live,
         # splitting the layer's hot fused region — exactly the defect
         # the probe_hlo_fusion proxy gates exist to catch
         (o,) = jax.lax.optimization_barrier((o,))
-    h = h + _wmat(o.reshape(1, T, H * d), lyr["o"])
+    h = h + _wmat(o.reshape(1, T, H * d), lyr["o"], lora=lo("o"))
     x = _rms_norm(h, lyr["ln2"], cfg.rms_norm_eps)
-    h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"])) * _wmat(x, lyr["up"]),
-                  lyr["down"])
+    h = h + _wmat(jax.nn.silu(_wmat(x, lyr["gate"], lora=lo("gate")))
+                  * _wmat(x, lyr["up"], lora=lo("up")),
+                  lyr["down"], lora=lo("down"))
     return h, Kp, Vp
 
 

@@ -1,0 +1,34 @@
+"""paddle_tpu.tenancy — the multi-tenant LoRA serving economy.
+
+One engine, many tenants: every request may wear its own LoRA adapter
+while sharing the ONE compiled ragged executable (the slot id is data,
+never shape), and tenants compete under an explicit economy instead of
+bare FIFO.
+
+- :mod:`adapters` — :class:`AdapterRegistry`: a fixed-capacity slab of
+  stacked ``[n_slots, r, d_in]`` / ``[n_slots, d_out, r]`` factors
+  (slot 0 = zeros = the base model, bitwise), refcounted hot-add/evict
+  with LRU over unreferenced slots, ArtifactStore persistence with
+  warm reload (``LLMEngine(adapter_store=...)``).
+- :mod:`policy` — :class:`TenantPolicy`: stride-scheduled weighted-fair
+  admission, refilling token quotas on the virtual clock, and
+  per-tenant cost ledgers (tokens, KV-byte-seconds, adapter-slot
+  residency) + :func:`tenant_burn_rules` for per-tenant SLO burn-rate
+  alerting.
+- :mod:`tune` — :class:`AdapterTuner`: train only the adapter factors
+  over a frozen quantized base via the existing masked fused-optimizer
+  path, then ``publish()`` straight into a serving registry.
+"""
+from .adapters import (AdapterInUse, AdapterRegistry,  # noqa: F401
+                       AdapterSlotsFull, AdapterStoreMismatch, PROJS,
+                       UnknownAdapter, make_random_adapter, proj_dims)
+from .policy import (DEFAULT_TENANT, STRIDE_K,  # noqa: F401
+                     TenantLedger, TenantPolicy, TenantSpec,
+                     request_cost, tenant_burn_rules)
+from .tune import AdapterTuner  # noqa: F401
+
+__all__ = ["AdapterInUse", "AdapterRegistry", "AdapterSlotsFull",
+           "AdapterStoreMismatch", "AdapterTuner", "DEFAULT_TENANT",
+           "PROJS", "STRIDE_K", "TenantLedger", "TenantPolicy",
+           "TenantSpec", "UnknownAdapter", "make_random_adapter",
+           "proj_dims", "request_cost", "tenant_burn_rules"]

@@ -1,0 +1,357 @@
+"""Multi-tenant LoRA adapter registry — a fixed-capacity slab of
+stacked low-rank factors that rides the serving engine's ONE ragged
+executable.
+
+The slab is one pytree: per decoder layer, per projection, a pair of
+stacked factors ``A [n_slots, r, d_in]`` / ``B [n_slots, d_out, r]``.
+Slot 0 is permanently all-zero — the base model, bitwise: a row whose
+adapter-slot id is 0 computes ``base(x) + 0.0`` (models/generation.py
+``_wmat``), so un-adapted and adapted rows share one batch of one
+trace. Which adapter a row wears is DATA (an int32 per-token slot
+vector gathered in-graph), never shape: hot-adding or evicting an
+adapter rewrites slab rows in place (``.at[slot].set``) and can never
+trigger a recompile.
+
+Slot management mirrors the pinned-page discipline of the KV pool:
+slots are refcounted by in-flight requests (``acquire``/``release``),
+eviction of a referenced adapter is REFUSED with a structured
+:class:`AdapterInUse` (never a silent fall-back to slot 0 — serving a
+tenant the base model when they asked for their adapter is a
+correctness bug, not a degradation), and capacity pressure evicts the
+least-recently-used UNREFERENCED adapter.
+
+Persistence rides io/persist.py's :class:`ArtifactStore` (tag
+``"adapter_store"``): atomic versioned publication, checksum-verified
+warm reload at engine init, and an :class:`AdapterStoreMismatch` when
+the stored geometry (rank / dims / layer count) disagrees with the
+engine's model — loading wrong-shape adapters silently would corrupt
+every tenant at once.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+#: the seven projections every decoder layer owns (models/generation.py
+#: ``_STACKED_LAYER_KEYS`` minus the norms) — the LoRA-able matmuls
+PROJS = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def proj_dims(cfg) -> dict:
+    """{proj: (d_in, d_out)} for one decoder layer of ``cfg``."""
+    d = cfg.hidden_size
+    qd = cfg.num_attention_heads * cfg.head_dim
+    kvd = cfg.num_key_value_heads * cfg.head_dim
+    i = cfg.intermediate_size
+    return {"q": (d, qd), "k": (d, kvd), "v": (d, kvd), "o": (qd, d),
+            "gate": (d, i), "up": (d, i), "down": (i, d)}
+
+
+class AdapterInUse(RuntimeError):
+    """Eviction refused: the adapter is worn by in-flight requests.
+    Structured so callers can retry after drain instead of parsing a
+    message."""
+
+    def __init__(self, adapter_id, refcount):
+        self.adapter_id = adapter_id
+        self.refcount = int(refcount)
+        super().__init__(
+            f"adapter {adapter_id!r} is referenced by {refcount} "
+            f"in-flight request(s) — drain them before evicting "
+            f"(silent slot-0 fallback would serve those tenants the "
+            f"base model)")
+
+
+class AdapterSlotsFull(RuntimeError):
+    """No free slot and every occupied slot is referenced — the
+    registry cannot admit a new adapter until something drains."""
+
+    def __init__(self, n_slots):
+        self.n_slots = int(n_slots)
+        super().__init__(
+            f"all {n_slots} adapter slots are occupied by referenced "
+            f"adapters — no LRU victim available")
+
+
+class UnknownAdapter(KeyError):
+    """A request named an adapter the registry does not hold."""
+
+    def __init__(self, adapter_id):
+        self.adapter_id = adapter_id
+        super().__init__(f"unknown adapter {adapter_id!r}")
+
+
+class AdapterStoreMismatch(RuntimeError):
+    """The persisted adapter store describes a different geometry than
+    this registry (rank / dims / layer count) — restoring it would put
+    wrong-shape (or wrong-meaning) deltas under every tenant."""
+
+    def __init__(self, field, stored, ours):
+        self.field, self.stored, self.ours = field, stored, ours
+        super().__init__(
+            f"adapter store mismatch on {field}: stored {stored!r}, "
+            f"this engine has {ours!r} — pass a fresh store root (or "
+            f"adapter_store=None) to serve this model")
+
+
+class AdapterRegistry:
+    """Fixed-capacity slab of stacked LoRA factors + slot economy.
+
+    ``n_slots`` counts USABLE adapter slots; the slab allocates
+    ``n_slots + 1`` rows because slot 0 is the reserved all-zero base
+    row. ``slab`` is the pytree handed to the jitted ragged step: a
+    list (one entry per decoder layer) of ``{proj: (A, B)}`` with
+    ``A [S, r, d_in]`` / ``B [S, d_out, r]``.
+    """
+
+    def __init__(self, cfg, *, n_slots=4, rank=8, dtype=jnp.float32):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if rank < 1:
+            raise ValueError(f"rank must be >= 1, got {rank}")
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.rank = int(rank)
+        self.dtype = dtype
+        self.dims = proj_dims(cfg)
+        self.n_layers = int(cfg.num_hidden_layers)
+        S = self.n_slots + 1
+        self.slab = [
+            {p: (jnp.zeros((S, self.rank, din), dtype),
+                 jnp.zeros((S, dout, self.rank), dtype))
+             for p, (din, dout) in self.dims.items()}
+            for _ in range(self.n_layers)]
+        self._slot_of: dict = {}          # adapter_id -> slot (1-based)
+        self._refs: dict = {}             # adapter_id -> refcount
+        self._stamp: dict = {}            # adapter_id -> LRU tick
+        self._tick = 0
+        self._dirty = False               # unsaved slab mutations
+        # lifetime counters (host-side; the engine mirrors them into
+        # ServingMetrics at its own call sites)
+        self.hot_adds = 0
+        self.evictions = 0
+        self.evict_refusals = 0
+
+    # ---- slot economy ----
+    def _touch(self, adapter_id):
+        self._tick += 1
+        self._stamp[adapter_id] = self._tick
+
+    @property
+    def slots_used(self) -> int:
+        return len(self._slot_of)
+
+    def adapter_ids(self) -> list:
+        """Registered adapter ids, stable (insertion-ish) order."""
+        return sorted(self._slot_of, key=lambda a: self._slot_of[a])
+
+    def slot_of(self, adapter_id) -> int:
+        """Slot of a registered adapter (raises :class:`UnknownAdapter`).
+        Adapter id 0/None means "base model" and is always slot 0."""
+        if adapter_id in (0, None):
+            return 0
+        slot = self._slot_of.get(adapter_id)
+        if slot is None:
+            raise UnknownAdapter(adapter_id)
+        return slot
+
+    def acquire(self, adapter_id) -> int:
+        """Pin an adapter for one in-flight request; returns its slot.
+        Slot 0 (base) is unpinnable — it can never be evicted."""
+        slot = self.slot_of(adapter_id)
+        if slot != 0:
+            self._refs[adapter_id] = self._refs.get(adapter_id, 0) + 1
+            self._touch(adapter_id)
+        return slot
+
+    def release(self, adapter_id):
+        if adapter_id in (0, None):
+            return
+        n = self._refs.get(adapter_id, 0)
+        if n <= 1:
+            self._refs.pop(adapter_id, None)
+        else:
+            self._refs[adapter_id] = n - 1
+
+    def refcount(self, adapter_id) -> int:
+        return self._refs.get(adapter_id, 0)
+
+    def _alloc_slot(self, adapter_id):
+        used = set(self._slot_of.values())
+        for s in range(1, self.n_slots + 1):
+            if s not in used:
+                return s
+        # LRU over unreferenced occupants, mirroring the pinned-page
+        # discipline: a referenced adapter is never a victim
+        victims = [a for a in self._slot_of if not self._refs.get(a)]
+        if not victims:
+            raise AdapterSlotsFull(self.n_slots)
+        victim = min(victims, key=lambda a: self._stamp.get(a, 0))
+        return self._evict_now(victim)
+
+    # ---- add / evict ----
+    def add(self, adapter_id, arrays) -> int:
+        """Publish (or republish) an adapter; returns its slot.
+
+        ``arrays`` is ``{proj: (A, B)}`` with ``A [L, r, d_in]`` /
+        ``B [L, d_out, r]`` stacked over the model's layers. A known
+        ``adapter_id`` overwrites its slot in place (republish after
+        more tuning); a new one takes a free slot or LRU-evicts an
+        unreferenced occupant. Either way shapes never change, so the
+        compiled ragged step is untouched.
+        """
+        if adapter_id in (0, None):
+            raise ValueError("adapter id 0/None is the reserved base "
+                             "slot and cannot be published")
+        self._validate_arrays(adapter_id, arrays)
+        slot = self._slot_of.get(adapter_id)
+        if slot is None:
+            slot = self._alloc_slot(adapter_id)
+            self._slot_of[adapter_id] = slot
+        for li in range(self.n_layers):
+            lyr = self.slab[li]
+            for p in PROJS:
+                A, B = lyr[p]
+                a_new, b_new = arrays[p]
+                lyr[p] = (
+                    A.at[slot].set(jnp.asarray(a_new[li], self.dtype)),
+                    B.at[slot].set(jnp.asarray(b_new[li], self.dtype)))
+        self.hot_adds += 1
+        self._dirty = True
+        self._touch(adapter_id)
+        return slot
+
+    def _validate_arrays(self, adapter_id, arrays):
+        missing = [p for p in PROJS if p not in arrays]
+        if missing:
+            raise ValueError(f"adapter {adapter_id!r} is missing "
+                             f"projections {missing}")
+        for p in PROJS:
+            din, dout = self.dims[p]
+            a, b = arrays[p]
+            want_a = (self.n_layers, self.rank, din)
+            want_b = (self.n_layers, dout, self.rank)
+            if tuple(np.shape(a)) != want_a:
+                raise ValueError(
+                    f"adapter {adapter_id!r} proj {p!r}: A shape "
+                    f"{tuple(np.shape(a))} != {want_a}")
+            if tuple(np.shape(b)) != want_b:
+                raise ValueError(
+                    f"adapter {adapter_id!r} proj {p!r}: B shape "
+                    f"{tuple(np.shape(b))} != {want_b}")
+
+    def _evict_now(self, adapter_id) -> int:
+        slot = self._slot_of.pop(adapter_id)
+        self._stamp.pop(adapter_id, None)
+        for li in range(self.n_layers):
+            lyr = self.slab[li]
+            for p in PROJS:
+                A, B = lyr[p]
+                lyr[p] = (A.at[slot].set(0.0), B.at[slot].set(0.0))
+        self.evictions += 1
+        self._dirty = True
+        return slot
+
+    def evict(self, adapter_id) -> int:
+        """Remove an adapter and zero its slot; returns the freed slot.
+        Refused (:class:`AdapterInUse`) while any in-flight request
+        wears it."""
+        if adapter_id not in self._slot_of:
+            raise UnknownAdapter(adapter_id)
+        refs = self._refs.get(adapter_id, 0)
+        if refs:
+            self.evict_refusals += 1
+            raise AdapterInUse(adapter_id, refs)
+        return self._evict_now(adapter_id)
+
+    # ---- pull one adapter back out (republish / inspection) ----
+    def get(self, adapter_id) -> dict:
+        """{proj: (A [L, r, d_in], B [L, d_out, r])} as numpy."""
+        slot = self.slot_of(adapter_id)
+        out = {}
+        for p in PROJS:
+            out[p] = (
+                np.stack([np.asarray(self.slab[li][p][0][slot])
+                          for li in range(self.n_layers)]),
+                np.stack([np.asarray(self.slab[li][p][1][slot])
+                          for li in range(self.n_layers)]))
+        return out
+
+    # ---- persistence (io/persist.py ArtifactStore) ----
+    STORE_TAG = "adapter_store"
+
+    def _geometry(self) -> dict:
+        return {"format": 1, "rank": self.rank,
+                "n_layers": self.n_layers,
+                "dims": {p: list(self.dims[p]) for p in PROJS},
+                "dtype": str(np.dtype(
+                    jnp.zeros((), self.dtype).dtype))}
+
+    def save(self, store) -> int | None:
+        """Publish every registered adapter as one atomic version.
+        Returns the version number (None when nothing is registered —
+        an empty registry is a cold start, not a version)."""
+        ids = self.adapter_ids()
+        arrays = {}
+        for i, aid in enumerate(ids):
+            for p, (a, b) in self.get(aid).items():
+                arrays[f"a{i}/{p}/A"] = a
+                arrays[f"a{i}/{p}/B"] = b
+        if not arrays:
+            return None
+        meta = self._geometry()
+        meta["adapters"] = [str(a) for a in ids]
+        version = store.save(self.STORE_TAG, arrays, meta)
+        self._dirty = False
+        return version
+
+    def restore(self, store) -> int:
+        """Warm-reload every adapter of the newest verified version;
+        returns how many were loaded (0 = cold start: no store version
+        survives — corruption already fell back / flight-recorded
+        inside ArtifactStore.load). Geometry drift raises
+        :class:`AdapterStoreMismatch` instead of loading wrong-shape
+        deltas."""
+        res = store.load(self.STORE_TAG)
+        if res is None:
+            return 0
+        ours = self._geometry()
+        for key in ("rank", "n_layers", "dims"):
+            stored = res.meta.get(key)
+            if stored != ours[key]:
+                raise AdapterStoreMismatch(key, stored, ours[key])
+        loaded = 0
+        for i, aid in enumerate(res.meta.get("adapters", [])):
+            arrays = {p: (res.arrays[f"a{i}/{p}/A"],
+                          res.arrays[f"a{i}/{p}/B"]) for p in PROJS}
+            self.add(aid, arrays)
+            loaded += 1
+        self._dirty = False
+        return loaded
+
+    @property
+    def dirty(self) -> bool:
+        """Unsaved slab mutations since the last save/restore — the
+        autosave dedup bit (engine saves only when this is set)."""
+        return self._dirty
+
+
+def make_random_adapter(cfg, *, rank=8, seed=0, scale=0.02) -> dict:
+    """Seeded random LoRA factors shaped for :meth:`AdapterRegistry.add`
+    — both factors nonzero so the delta is visible (tests / probes; a
+    freshly TUNED adapter comes from tenancy/tune.py instead)."""
+    rng = np.random.default_rng(seed)
+    L = int(cfg.num_hidden_layers)
+    out = {}
+    for p, (din, dout) in proj_dims(cfg).items():
+        out[p] = (
+            (rng.standard_normal((L, rank, din)) * scale).astype(
+                np.float32),
+            (rng.standard_normal((L, dout, rank)) * scale).astype(
+                np.float32))
+    return out
+
+
+__all__ = ["AdapterInUse", "AdapterRegistry", "AdapterSlotsFull",
+           "AdapterStoreMismatch", "PROJS", "UnknownAdapter",
+           "make_random_adapter", "proj_dims"]

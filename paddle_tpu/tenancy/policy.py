@@ -1,0 +1,321 @@
+"""The multi-tenant serving economy: weighted-fair admission, refilling
+token quotas, and per-tenant cost attribution — all on the virtual
+clock.
+
+A :class:`TenantSpec` declares what a tenant is entitled to: a stride
+weight (its fair share of admission slots), an optional token quota
+(a refilling budget on the caller's ``now_fn`` — serving/scheduler.py
+hands its own clock in), and which LoRA adapter its requests wear by
+default (tenancy/adapters.py). A :class:`TenantPolicy` holds the live
+economy: stride-scheduling state (each admission advances the tenant's
+pass value by ``STRIDE_K / weight``, the next admission goes to the
+lowest pass — weighted round-robin with O(1) state and no starvation),
+token buckets, and one :class:`TenantLedger` per tenant (tokens,
+KV-byte-seconds, adapter-slot-seconds, TTFT samples — the cost line a
+bill could be computed from).
+
+Everything here is host-side bookkeeping over python scalars: no jax,
+no draws, no wall clock. The scheduler consults ``pick``/``on_admit``
+only when tenants were declared — the no-tenant FIFO path never calls
+in, byte-identical to the pre-tenancy engine.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..serving.metrics import percentile_of
+from ..telemetry.slo import SLO, BurnRateRule
+
+#: stride-scheduling numerator: pass += STRIDE_K / weight per admission
+STRIDE_K = 1 << 16
+
+#: the ledger key unattributed traffic bills to (requests without a
+#: tenant_id on an engine that still declared tenants)
+DEFAULT_TENANT = "_default"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's entitlements."""
+    tenant_id: str
+    #: stride weight — this tenant's relative share of admission slots
+    weight: float = 1.0
+    #: refilling token quota (tokens per virtual second); None = no cap
+    quota_tokens_per_s: float | None = None
+    #: bucket depth; defaults to one second's worth of quota
+    quota_burst_tokens: float | None = None
+    #: default LoRA adapter for this tenant's requests (0 = base model)
+    adapter_id: object = 0
+
+    def __post_init__(self):
+        if not self.tenant_id:
+            raise ValueError("tenant_id must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: weight must be > 0, "
+                f"got {self.weight}")
+        if self.quota_tokens_per_s is not None \
+                and self.quota_tokens_per_s <= 0:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: quota_tokens_per_s must "
+                f"be > 0 (or None), got {self.quota_tokens_per_s}")
+        if self.quota_burst_tokens is not None \
+                and self.quota_burst_tokens <= 0:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: quota_burst_tokens must "
+                f"be > 0 (or None), got {self.quota_burst_tokens}")
+
+    @property
+    def burst(self) -> float | None:
+        if self.quota_tokens_per_s is None:
+            return None
+        if self.quota_burst_tokens is not None:
+            return self.quota_burst_tokens
+        return self.quota_tokens_per_s
+
+
+@dataclass
+class TenantLedger:
+    """Per-tenant cost attribution — lifetime, host-side."""
+    tokens: int = 0               # generated tokens committed
+    prompt_tokens: int = 0        # prompt tokens admitted
+    admitted: int = 0
+    finished: int = 0
+    quota_sheds: int = 0
+    #: integral of (resident KV bytes) dt over the run — the bytes a
+    #: tenant's context actually occupied, time-weighted
+    kv_byte_seconds: float = 0.0
+    #: integral of (adapter slots worn by in-flight requests) dt —
+    #: slab residency is a billable resource like KV
+    adapter_slot_seconds: float = 0.0
+    ttft_s: list = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {
+            "tokens": self.tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "admitted": self.admitted,
+            "finished": self.finished,
+            "quota_sheds": self.quota_sheds,
+            "kv_byte_seconds": self.kv_byte_seconds,
+            "adapter_slot_seconds": self.adapter_slot_seconds,
+            "ttft_p99_s": percentile_of(self.ttft_s, 99)
+            if self.ttft_s else None,
+            "ttft_count": len(self.ttft_s),
+        }
+
+
+def request_cost(seq) -> int:
+    """Admission cost of one queued sequence in quota tokens: the
+    prompt it will prefill plus the generation budget it reserves."""
+    return len(seq.prompt_ids) + int(seq.max_new_tokens)
+
+
+class TenantPolicy:
+    """Live economy over a set of :class:`TenantSpec`\\ s.
+
+    ``shed_window_s`` bounds how much FUTURE quota a queued backlog may
+    pre-claim: work beyond ``bucket + rate * shed_window_s`` can never
+    be funded soon and is quota-shed at the step boundary instead of
+    rotting in the queue (and crowding the admission scan).
+    """
+
+    def __init__(self, specs=(), *, now_fn=None, shed_window_s=1.0):
+        if shed_window_s < 0:
+            raise ValueError(
+                f"shed_window_s must be >= 0, got {shed_window_s}")
+        self._now = now_fn or (lambda: 0.0)
+        self.shed_window_s = float(shed_window_s)
+        self.specs: dict = {}
+        for s in specs:
+            if isinstance(s, dict):
+                s = TenantSpec(**s)
+            if s.tenant_id in self.specs:
+                raise ValueError(
+                    f"duplicate tenant_id {s.tenant_id!r}")
+            self.specs[s.tenant_id] = s
+        self._pass: dict = {}          # tid -> stride pass value
+        self._bucket: dict = {}        # tid -> available quota tokens
+        self._refill_at: dict = {}     # tid -> last refill time
+        self.ledgers: dict = {}        # tid -> TenantLedger
+
+    # ---- spec / ledger access ----
+    def spec_for(self, tenant_id) -> TenantSpec:
+        tid = tenant_id or DEFAULT_TENANT
+        spec = self.specs.get(tid)
+        if spec is None:
+            # unknown tenants serve at weight 1 with no quota — the
+            # economy degrades to fair-share, never to a rejection
+            spec = TenantSpec(tenant_id=tid)
+            self.specs[tid] = spec
+        return spec
+
+    def ledger(self, tenant_id) -> TenantLedger:
+        tid = tenant_id or DEFAULT_TENANT
+        led = self.ledgers.get(tid)
+        if led is None:
+            led = self.ledgers[tid] = TenantLedger()
+        return led
+
+    def adapter_for(self, tenant_id):
+        return self.spec_for(tenant_id).adapter_id
+
+    # ---- token buckets ----
+    def _refill(self, now):
+        for tid, spec in self.specs.items():
+            if spec.quota_tokens_per_s is None:
+                continue
+            last = self._refill_at.get(tid)
+            if last is None:
+                # a fresh bucket starts full: burst depth is the
+                # entitlement, not something to earn first
+                self._bucket[tid] = spec.burst
+            else:
+                dt = max(now - last, 0.0)
+                self._bucket[tid] = min(
+                    spec.burst,
+                    self._bucket.get(tid, 0.0)
+                    + spec.quota_tokens_per_s * dt)
+            self._refill_at[tid] = now
+
+    def bucket_level(self, tenant_id, now=None) -> float | None:
+        """Current bucket level (None = unmetered tenant)."""
+        self._refill(self._now() if now is None else now)
+        tid = tenant_id or DEFAULT_TENANT
+        if self.spec_for(tid).quota_tokens_per_s is None:
+            return None
+        return self._bucket.get(tid, 0.0)
+
+    def _fundable(self, tid, cost) -> bool:
+        if self.spec_for(tid).quota_tokens_per_s is None:
+            return True
+        return self._bucket.get(tid, 0.0) >= cost
+
+    # ---- admission (serving/scheduler.py weighted path) ----
+    def pick(self, waiting, now=None) -> int | None:
+        """Index into ``waiting`` of the next request to admit: the
+        OLDEST request of the fundable tenant with the lowest stride
+        pass (ties break on tenant id — deterministic, never on dict
+        order). None when no waiting request is fundable right now
+        (buckets refill with virtual time; the scheduler simply tries
+        again next step)."""
+        self._refill(self._now() if now is None else now)
+        best = None
+        best_key = None
+        seen = set()
+        for idx, seq in enumerate(waiting):
+            tid = getattr(seq, "tenant_id", None) or DEFAULT_TENANT
+            if tid in seen:
+                continue          # per tenant, only its oldest request
+            seen.add(tid)
+            if not self._fundable(tid, request_cost(seq)):
+                continue
+            key = (self._pass.get(tid, 0.0), str(tid))
+            if best_key is None or key < best_key:
+                best, best_key = idx, key
+        return best
+
+    def on_admit(self, seq, now=None):
+        """Charge one admission: stride pass advances by K/weight, the
+        bucket (if metered) pays the request's token cost up front."""
+        tid = getattr(seq, "tenant_id", None) or DEFAULT_TENANT
+        spec = self.spec_for(tid)
+        # new tenants join at the current minimum pass, not 0 — a
+        # late-arriving tenant must not inherit an artificial backlog
+        # of "unused" slots over tenants that were simply present
+        base = min(self._pass.values(), default=0.0)
+        cur = self._pass.get(tid, base)
+        self._pass[tid] = max(cur, base) + STRIDE_K / spec.weight
+        if spec.quota_tokens_per_s is not None:
+            self._refill(self._now() if now is None else now)
+            self._bucket[tid] = self._bucket.get(tid, 0.0) \
+                - request_cost(seq)
+        led = self.ledger(tid)
+        led.admitted += 1
+        led.prompt_tokens += len(seq.prompt_ids)
+
+    def shed_candidates(self, waiting, now=None) -> list:
+        """Indices into ``waiting`` to quota-shed this step: for each
+        metered tenant, queued work (oldest first) beyond what the
+        bucket plus ``shed_window_s`` of refill can fund. Newest
+        requests shed first by construction — the backlog a tenant can
+        afford stays, the flood beyond it goes. Indices are returned
+        descending so callers can remove in order."""
+        self._refill(self._now() if now is None else now)
+        claimed: dict = {}
+        out = []
+        for idx, seq in enumerate(waiting):
+            tid = getattr(seq, "tenant_id", None) or DEFAULT_TENANT
+            spec = self.spec_for(tid)
+            if spec.quota_tokens_per_s is None:
+                continue
+            horizon = self._bucket.get(tid, 0.0) \
+                + spec.quota_tokens_per_s * self.shed_window_s
+            c = claimed.get(tid, 0.0) + request_cost(seq)
+            if c > horizon:
+                out.append(idx)
+            else:
+                claimed[tid] = c
+        return sorted(out, reverse=True)
+
+    # ---- cost attribution (serving/engine.py calls in) ----
+    def charge_tokens(self, tenant_id, n=1):
+        self.ledger(tenant_id).tokens += int(n)
+
+    def record_ttft(self, tenant_id, ttft_s):
+        self.ledger(tenant_id).ttft_s.append(float(ttft_s))
+
+    def charge_kv(self, tenant_id, byte_seconds):
+        self.ledger(tenant_id).kv_byte_seconds += float(byte_seconds)
+
+    def charge_slot(self, tenant_id, slot_seconds):
+        self.ledger(tenant_id).adapter_slot_seconds += \
+            float(slot_seconds)
+
+    def count_shed(self, tenant_id):
+        self.ledger(tenant_id).quota_sheds += 1
+
+    def count_finished(self, tenant_id):
+        self.ledger(tenant_id).finished += 1
+
+    # ---- export ----
+    def snapshot(self) -> dict:
+        """{tenant_id: ledger dict} for metrics_snapshot — plain
+        scalars, stable keys."""
+        return {tid: led.as_dict()
+                for tid, led in sorted(self.ledgers.items())}
+
+    def slo_sample(self) -> dict:
+        """Per-tenant signals for an AlertManager sample: each tenant's
+        lifetime TTFT p99 under the signal name
+        ``tenant:{tid}:ttft_p99_s`` (None before any first token, which
+        spends no budget)."""
+        out = {}
+        for tid, led in self.ledgers.items():
+            out[f"tenant:{tid}:ttft_p99_s"] = \
+                percentile_of(led.ttft_s, 99) if led.ttft_s else None
+        return out
+
+
+def tenant_burn_rules(tenant_ids, *, ttft_p99_s, budget=0.05,
+                      fast_window_s=0.1, slow_window_s=0.5,
+                      burn_threshold=2.0) -> list:
+    """Per-tenant TTFT burn-rate rules (telemetry/slo.py): one
+    :class:`BurnRateRule` per tenant over the ``tenant:{tid}:ttft_p99_s``
+    signal :meth:`TenantPolicy.slo_sample` emits — feed
+    ``AlertManager(tenant_burn_rules(...))`` with those samples and a
+    tenant whose p99 burns its budget pages by NAME, not as an
+    anonymous fleet blip."""
+    rules = []
+    for tid in tenant_ids:
+        rules.append(BurnRateRule(
+            SLO(f"tenant:{tid}:ttft_p99",
+                f"tenant:{tid}:ttft_p99_s",
+                ttft_p99_s, worse="higher", budget=budget),
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            burn_threshold=burn_threshold))
+    return rules
+
+
+__all__ = ["DEFAULT_TENANT", "STRIDE_K", "TenantLedger", "TenantPolicy",
+           "TenantSpec", "request_cost", "tenant_burn_rules"]

@@ -1,0 +1,202 @@
+"""tune -> serve bridge: train ONLY the LoRA factors over a frozen
+(possibly int8/int4-quantized) base, then publish into the serving
+registry.
+
+The forward is the same pure math the serving engine runs
+(models/generation.py ``_rms_norm``/``_rope``/``_wmat`` — the LoRA
+delta composes over the dequant matmul exactly as it does in the
+ragged step), run densely causal over a token batch. ``jax.grad``
+differentiates the next-token cross-entropy with respect to the
+adapter pytree alone; the base weights are frozen operands.
+
+The optimizer path is deliberately the EXISTING masked fused engine
+(optimizer/fused.py): every adapter factor is primed into the flat
+buckets up front, but each step supplies grads only for
+``train_projs`` — a strict subset of the primed signature — so the
+engine takes its masked ``jnp.where`` pass-through branch instead of
+rebuilding. That is the MoE-expert/frozen-param discipline reused
+verbatim: tuning N tenants' adapters against one primed bucket set
+costs O(#buckets) dispatches per step, not O(#tensors).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..models.generation import _rms_norm, _rope, _wmat
+from .adapters import PROJS, proj_dims
+
+
+def _adapter_forward(base, adapters, ids, cfg):
+    """Dense causal forward with the LoRA delta on every projection.
+
+    ``adapters`` is a list (per layer) of ``{proj: (A [r, d_in],
+    B [d_out, r])}`` — a 1-slot slab worn by every token (slot vector
+    of zeros into the ``[None]``-expanded factors), so the delta math
+    is bit-for-bit the serving ``_wmat`` path."""
+    b, s = ids.shape
+    H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+    slots = jnp.zeros((s,), jnp.int32)
+
+    def lo(ad, p):
+        A, B = ad[p]
+        return (A[None], B[None], slots)
+
+    h = base["embed"][ids]
+    mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    for pl, ad in zip(base["layers"], adapters):
+        x = _rms_norm(h, pl["ln1"], cfg.rms_norm_eps)
+        q = _wmat(x, pl["q"], lora=lo(ad, "q")).reshape(b, s, H, d)
+        k = _wmat(x, pl["k"], lora=lo(ad, "k")).reshape(b, s, Hkv, d)
+        v = _wmat(x, pl["v"], lora=lo(ad, "v")).reshape(b, s, Hkv, d)
+        q = _rope(q, pos, cfg.rope_theta, d)
+        k = _rope(k, pos, cfg.rope_theta, d)
+        rep = H // Hkv
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        s_ = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(d))
+        s_ = jnp.where(mask, s_, -1e30)
+        p_ = jax.nn.softmax(s_.astype(jnp.float32), -1).astype(q.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p_, v)
+        h = h + _wmat(o.reshape(b, s, H * d), pl["o"],
+                      lora=lo(ad, "o"))
+        x = _rms_norm(h, pl["ln2"], cfg.rms_norm_eps)
+        h = h + _wmat(
+            jax.nn.silu(_wmat(x, pl["gate"], lora=lo(ad, "gate")))
+            * _wmat(x, pl["up"], lora=lo(ad, "up")),
+            pl["down"], lora=lo(ad, "down"))
+    h = _rms_norm(h, base["norm"], cfg.rms_norm_eps)
+    if "lm_head" in base:
+        return h @ base["lm_head"]
+    return h @ base["embed"].T
+
+
+def _make_loss_and_grads(cfg):
+    """Jitted next-token cross-entropy + grads w.r.t. the adapter
+    pytree, closed over the (unhashable) model config."""
+
+    @jax.jit
+    def _loss_and_grads(base, adapters, ids):
+        def loss_fn(ad):
+            logits = _adapter_forward(base, ad, ids, cfg)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32),
+                                      -1)
+            tgt = ids[:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], -1)[..., 0]
+            return jnp.mean(nll)
+
+        return jax.value_and_grad(loss_fn)(adapters)
+
+    return _loss_and_grads
+
+
+class AdapterTuner:
+    """Train one tenant's LoRA factors over a frozen base.
+
+    ``params`` is the serving pytree (models/generation.py
+    ``extract_params``, optionally already through
+    ``quantization.quantize_params`` — tuning over the int8/int4 base
+    the engine will actually serve is the point). ``train_projs``
+    selects which projections receive grads each step; ALL factors are
+    primed so the subset rides the masked fused path. A-factors init
+    gaussian (seeded), B-factors zero — the standard LoRA start where
+    the initial delta is exactly 0 and tuning moves off the base model
+    smoothly."""
+
+    def __init__(self, params, cfg, *, rank=8, seed=0,
+                 train_projs=("q", "v"), lr=1e-2, optimizer=None):
+        import numpy as np
+        unknown = [p for p in train_projs if p not in PROJS]
+        if unknown:
+            raise ValueError(f"unknown train_projs {unknown}; "
+                             f"choose from {PROJS}")
+        if not train_projs:
+            raise ValueError("train_projs must name at least one "
+                             "projection")
+        self.base = params
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.train_projs = tuple(train_projs)
+        self.steps = 0
+        self.losses: list = []
+        rng = np.random.default_rng(seed)
+        dims = proj_dims(cfg)
+        L = int(cfg.num_hidden_layers)
+        self._factors = []           # per layer {proj: (TensorA, TensorB)}
+        tensors = []
+        for li in range(L):
+            lyr = {}
+            for p, (din, dout) in dims.items():
+                a = Tensor((rng.standard_normal((self.rank, din))
+                            / self.rank).astype(np.float32),
+                           stop_gradient=False,
+                           name=f"lora_l{li}_{p}_A")
+                bt = Tensor(np.zeros((dout, self.rank), np.float32),
+                            stop_gradient=False,
+                            name=f"lora_l{li}_{p}_B")
+                lyr[p] = (a, bt)
+                tensors.extend([a, bt])
+            self._factors.append(lyr)
+        self._tensors = tensors
+        if optimizer is None:
+            from ..optimizer.optimizer import AdamW
+            optimizer = AdamW(learning_rate=lr, parameters=tensors,
+                              weight_decay=0.0)
+        self.opt = optimizer
+        # prime EVERY factor into the fused buckets: per-step grads on
+        # the train subset then hit the masked branch, never a rebuild
+        self.primed = self.opt._prime_fused(tensors)
+        self._loss_and_grads = _make_loss_and_grads(cfg)
+
+    def _adapter_pytree(self):
+        return [{p: (a._data, b._data) for p, (a, b) in lyr.items()}
+                for lyr in self._factors]
+
+    def step(self, ids) -> float:
+        """One tuning step over a token batch ``ids [b, s]``; returns
+        the loss. Grads land only on ``train_projs`` factors — the
+        fused engine masks the rest of the primed buckets."""
+        ids = jnp.asarray(ids, jnp.int32)
+        loss, grads = self._loss_and_grads(self.base,
+                                           self._adapter_pytree(), ids)
+        for lyr, g in zip(self._factors, grads):
+            for p, (a, bt) in lyr.items():
+                ga, gb = g[p]
+                if p in self.train_projs:
+                    a.grad = Tensor(ga, stop_gradient=True)
+                    bt.grad = Tensor(gb, stop_gradient=True)
+                else:
+                    a.grad = None
+                    bt.grad = None
+        self.opt.step()
+        self.opt.clear_grad()
+        self.steps += 1
+        out = float(loss)
+        self.losses.append(out)
+        return out
+
+    def export(self) -> dict:
+        """{proj: (A [L, r, d_in], B [L, d_out, r])} — the
+        :meth:`~paddle_tpu.tenancy.adapters.AdapterRegistry.add`
+        payload."""
+        import numpy as np
+        out = {}
+        for p in PROJS:
+            out[p] = (
+                np.stack([np.asarray(lyr[p][0]._data)
+                          for lyr in self._factors]),
+                np.stack([np.asarray(lyr[p][1]._data)
+                          for lyr in self._factors]))
+        return out
+
+    def publish(self, registry, adapter_id) -> int:
+        """Hot-publish the tuned factors into a serving registry;
+        returns the slot (no recompile — slab shapes never change)."""
+        return registry.add(adapter_id, self.export())
+
+
+__all__ = ["AdapterTuner"]

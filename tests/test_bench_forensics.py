@@ -123,7 +123,23 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               "kv_tier_token_identical", "kv_tier_spills",
               "kv_tier_prefetch_hits", "kv_tier_stall_fraction",
               "kv_tier_deterministic", "kv_tier_hbm_pages",
-              "kv_tier_host_pages"):
+              "kv_tier_host_pages",
+              # disaggregated-serving fields (ISSUE 16): identity
+              # verdicts, fabric page counts and TTFT ratios are
+              # per-run proofs
+              "disagg_token_identical", "disagg_kv_pages_transferred",
+              "disagg_fleet_prefix_hit_rate",
+              "disagg_transfer_stall_fraction",
+              "disagg_ttft_ratio_vs_colocated", "disagg_deterministic",
+              "disagg_ttft_p99_s", "disagg_colocated_ttft_p99_s",
+              # multi-tenant economy fields (ISSUE 17): an isolation
+              # ratio, quota-shed count, mixed-batch identity verdict
+              # or hot-swap compile count is a per-run proof
+              "multitenant_good_ttft_p99_s",
+              "multitenant_isolation_ratio", "multitenant_quota_shed",
+              "multitenant_deterministic",
+              "multitenant_mixed_batch_identical",
+              "multitenant_hot_swap_compiles"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -576,6 +592,56 @@ def test_proxy_bench_catches_corrupt_checkpoint():
     assert out["persist_resume_identical"] is None
     assert out["persist_warm_prefix_hits"] is None
     assert "persistence_probe_error" in out
+
+
+def test_proxy_bench_catches_disabled_fairness():
+    """End-to-end multi-tenant regression injection (ISSUE 17): run the
+    multitenant probe with the tenant policy dropped (--no-fairness:
+    bare FIFO over the same noisy-neighbor flood) and gate against the
+    checked-in baseline — quota sheds read 0 (exact pin), the good
+    tenant's p99 TTFT blows out behind the abuser's backlog, and the
+    isolation ratio collapses toward 1; all three gates fail. The
+    healthy collection of the same probe must pass with sheds pinned,
+    the mixed LoRA/base batch bit-identical to the no-adapter engine,
+    and adapter hot-swap adding zero decode executables."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("multitenant",), multitenant_fairness=False)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "multitenant_quota_shed" in names
+    assert "multitenant_good_ttft_p99_s" in names
+    assert "multitenant_isolation_ratio" in names
+    assert bad["metrics"]["multitenant_quota_shed"] == 0
+    # the rc-level contract CI keys off: --no-fairness flips main to 1
+    import unittest.mock as _mock
+    with _mock.patch.object(pb, "collect",
+                            lambda probes=pb.PROBES, **kw: bad):
+        assert pb.main(["--probes", "multitenant", "--compare",
+                        pb.BASELINE_PATH]) == 1
+
+    good = pb.collect(probes=("multitenant",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["multitenant_quota_shed"] == \
+        baseline["metrics"]["multitenant_quota_shed"]
+    assert good["metrics"]["multitenant_isolation_ratio"] < 0.5
+    assert good["metrics"]["multitenant_deterministic"] == 1
+    assert good["metrics"]["multitenant_mixed_batch_identical"] == 1
+    assert good["metrics"]["multitenant_hot_swap_compiles"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_multitenant(Boom())
+    assert out["multitenant_isolation_ratio"] is None
+    assert out["multitenant_quota_shed"] is None
+    assert "multitenant_probe_error" in out
 
 
 def test_proxy_bench_catches_disabled_kv_prefetch():

@@ -1,0 +1,512 @@
+"""paddle_tpu.tenancy gates (ISSUE 17): batched per-request LoRA
+through the ONE ragged executable (slot 0 = zeros = the base model,
+bitwise), refcounted hot-add/evict with zero recompiles, ArtifactStore
+persistence, the weighted-fair tenant economy (stride admission, token
+quotas, cost ledgers, per-tenant burn alerts), seeded noisy-neighbor
+reproducibility, and the tune->serve bridge over the masked fused
+optimizer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.loadgen import (Driver, VirtualClock, WorkloadSpec,
+                                build_report, report_json,
+                                trace_fingerprint)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import LLMEngine, RequestRejected, RequestTracer
+from paddle_tpu.tenancy import (AdapterInUse, AdapterRegistry,
+                                AdapterSlotsFull, AdapterStoreMismatch,
+                                AdapterTuner, UnknownAdapter,
+                                make_random_adapter, tenant_burn_rules)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=64,
+                            intermediate_size=128, num_attention_heads=2,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _prompt(n, seed=0, v=128):
+    return np.random.default_rng(seed).integers(0, v, (n,)).tolist()
+
+
+ENG = dict(max_len=64, page_size=4, seed=0)
+
+
+# ---------------------------------------------------------------------------
+# the slab: slot 0 identity, mixed batches, hot-swap without recompile
+
+
+@pytest.mark.parametrize("quant", [None, "weight_only_int8"])
+def test_mixed_batch_base_rows_bitwise_identical(tiny_model, quant):
+    """A mixed batch — one LoRA-adapted row, one base row — decodes
+    through the one ragged executable with the base row BIT-identical
+    to a no-adapter engine (slot 0 is all-zeros: base(x) + 0.0), and
+    the adapted row identical to a solo engine wearing the same
+    adapter. Over the fp AND the int8-quantized base."""
+    kw = dict(ENG, quantized_mode=quant) if quant else dict(ENG)
+    prompt = _prompt(6, seed=5)
+    ad = make_random_adapter(tiny_model.config, rank=4, seed=3,
+                             scale=0.5)
+
+    eng0 = LLMEngine(tiny_model, **kw)
+    r0 = eng0.add_request(prompt, max_new_tokens=6)
+    base = eng0.run(max_steps=200)[r0].token_ids
+
+    solo = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4, **kw)
+    solo.add_adapter("t1", ad)
+    rs = solo.add_request(prompt, max_new_tokens=6, adapter_id="t1")
+    adapted = solo.run(max_steps=200)[rs].token_ids
+
+    mixed = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4, **kw)
+    mixed.add_adapter("t1", ad)
+    ra = mixed.add_request(prompt, max_new_tokens=6, adapter_id="t1")
+    rb = mixed.add_request(prompt, max_new_tokens=6)
+    outs = mixed.run(max_steps=200)
+    assert outs[rb].token_ids == base, \
+        "base row in a mixed batch diverged from the no-adapter engine"
+    assert outs[ra].token_ids == adapted, \
+        "adapted row in a mixed batch diverged from the solo engine"
+    assert outs[ra].token_ids != base, \
+        "the adapter delta must be visible (scale 0.5 factors)"
+    assert mixed.decode_cache_size() == 1
+
+
+def test_no_adapter_engine_hlo_is_byte_identical(tiny_model):
+    """adapter_slots=0 passes None for both trailing jit operands —
+    empty pytrees, ZERO added HLO operands: the executable an engine
+    without the feature compiles is byte-identical to the pre-tenancy
+    one. Gated structurally: same compiled text with and without the
+    tenancy import having ever run."""
+    e1 = LLMEngine(tiny_model, **ENG)
+    e2 = LLMEngine(tiny_model, **ENG)
+    r1 = e1.add_request(_prompt(5), max_new_tokens=3)
+    r2 = e2.add_request(_prompt(5), max_new_tokens=3)
+    assert e1.run(max_steps=100)[r1].token_ids == \
+        e2.run(max_steps=100)[r2].token_ids
+    assert e1.decode_cache_size() == e2.decode_cache_size() == 1
+    snap = e1.metrics_snapshot()
+    assert snap["tenants"] is None
+    assert snap["adapter_slots"] is None
+
+
+def test_hot_add_evict_zero_recompiles(tiny_model):
+    """Publishing, republishing, and evicting adapters rewrites slab
+    rows in place — decode_cache_size() stays 1 through the whole
+    churn, and the registry counters fold into metrics exactly once."""
+    eng = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4, **ENG)
+    prompt = _prompt(5, seed=1)
+    eng.add_request(prompt, max_new_tokens=4)
+    eng.run(max_steps=100)
+    assert eng.decode_cache_size() == 1
+
+    eng.add_adapter("t1", make_random_adapter(
+        tiny_model.config, rank=4, seed=1, scale=0.5))
+    eng.add_request(prompt, max_new_tokens=4, adapter_id="t1")
+    eng.run(max_steps=100)
+    eng.add_adapter("t2", make_random_adapter(
+        tiny_model.config, rank=4, seed=2, scale=0.5))
+    eng.evict_adapter("t1")
+    eng.add_adapter("t3", make_random_adapter(
+        tiny_model.config, rank=4, seed=3, scale=0.5))
+    eng.add_request(prompt, max_new_tokens=4, adapter_id="t3")
+    eng.run(max_steps=100)
+    assert eng.decode_cache_size() == 1, \
+        "adapter churn must never add a step executable"
+    snap = eng.metrics_snapshot()
+    assert snap["adapter_hot_adds"] == 3
+    assert snap["adapter_evictions"] == 1
+    assert snap["adapter_slots_used"] == 2
+    assert snap["adapter_slots"] == 2
+    # repeated snapshots must not double-count the folded deltas
+    assert eng.metrics_snapshot()["adapter_hot_adds"] == 3
+
+
+def test_evict_while_referenced_refused_then_succeeds(tiny_model):
+    """Evicting an adapter worn by an in-flight request raises a
+    structured AdapterInUse (never a silent slot-0 fallback); after the
+    request drains, the same evict succeeds."""
+    eng = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4, **ENG)
+    eng.add_adapter("t1", make_random_adapter(
+        tiny_model.config, rank=4, seed=1))
+    eng.add_request(_prompt(5), max_new_tokens=8, adapter_id="t1")
+    eng.step()                      # in flight, wearing t1
+    with pytest.raises(AdapterInUse) as ei:
+        eng.evict_adapter("t1")
+    assert ei.value.adapter_id == "t1" and ei.value.refcount == 1
+    assert eng.metrics_snapshot()["adapter_evict_refusals"] == 1
+    eng.run(max_steps=100)          # drain
+    eng.evict_adapter("t1")
+    assert eng.adapters.slots_used == 0
+    # a finished request released its reference exactly once
+    assert eng.adapters.refcount("t1") == 0
+
+
+def test_unknown_adapter_is_structured_rejection(tiny_model):
+    """A request naming an adapter the registry does not hold is
+    rejected with a structured output — serving it the base model
+    silently would be a correctness bug."""
+    eng = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4, **ENG)
+    with pytest.raises(RequestRejected):
+        eng.add_request(_prompt(4), max_new_tokens=3,
+                        adapter_id="nope", request_id="r-bad")
+    out = eng.outputs()["r-bad"]
+    assert out.status == "aborted"
+    assert out.finish_reason == "rejected_unknown_adapter"
+    # an engine with NO registry rejects the same way
+    eng0 = LLMEngine(tiny_model, **ENG)
+    with pytest.raises(RequestRejected):
+        eng0.add_request(_prompt(4), max_new_tokens=3, adapter_id="x")
+
+
+def test_registry_lru_eviction_and_slots_full(tiny_model):
+    """Capacity pressure evicts the least-recently-used UNREFERENCED
+    adapter; when every occupant is referenced the registry refuses
+    with AdapterSlotsFull instead of picking a victim."""
+    cfg = tiny_model.config
+    reg = AdapterRegistry(cfg, n_slots=2, rank=4)
+    reg.add("a", make_random_adapter(cfg, rank=4, seed=1))
+    reg.add("b", make_random_adapter(cfg, rank=4, seed=2))
+    slot_a = reg.slot_of("a")
+    reg.add("c", make_random_adapter(cfg, rank=4, seed=3))  # evicts a
+    assert reg.slot_of("c") == slot_a
+    with pytest.raises(UnknownAdapter):
+        reg.slot_of("a")
+    assert reg.evictions == 1
+    reg.acquire("b")
+    reg.acquire("c")
+    with pytest.raises(AdapterSlotsFull):
+        reg.add("d", make_random_adapter(cfg, rank=4, seed=4))
+    reg.release("b")
+    reg.add("d", make_random_adapter(cfg, rank=4, seed=4))   # b is LRU
+    with pytest.raises(UnknownAdapter):
+        reg.slot_of("b")
+    # slot 0 is the reserved base identity: never publishable
+    with pytest.raises(ValueError):
+        reg.add(0, make_random_adapter(cfg, rank=4))
+    # wrong-rank factors are refused at the door
+    with pytest.raises(ValueError):
+        reg.add("r8", make_random_adapter(cfg, rank=8))
+
+
+def test_adapter_store_roundtrip_and_geometry_gate(tiny_model, tmp_path):
+    """Published adapters survive process death: a fresh engine on the
+    same store warm-reloads them (adapter_restores counted) and serves
+    token-identical outputs; a store whose geometry disagrees with the
+    engine raises AdapterStoreMismatch instead of loading wrong-shape
+    deltas."""
+    root = str(tmp_path / "astore")
+    prompt = _prompt(6, seed=9)
+    e1 = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4,
+                   adapter_store=root, **ENG)
+    e1.add_adapter("t1", make_random_adapter(
+        tiny_model.config, rank=4, seed=3, scale=0.5))  # autosaves
+    r1 = e1.add_request(prompt, max_new_tokens=6, adapter_id="t1")
+    toks = e1.run(max_steps=200)[r1].token_ids
+    assert e1.metrics_snapshot()["adapter_store_saves"] >= 1
+
+    e2 = LLMEngine(tiny_model, adapter_slots=2, adapter_rank=4,
+                   adapter_store=root, **ENG)
+    assert e2.metrics_snapshot()["adapter_restores"] == 1
+    assert e2.adapters.adapter_ids() == ["t1"]
+    r2 = e2.add_request(prompt, max_new_tokens=6, adapter_id="t1")
+    assert e2.run(max_steps=200)[r2].token_ids == toks
+
+    with pytest.raises(AdapterStoreMismatch):
+        LLMEngine(tiny_model, adapter_slots=2, adapter_rank=8,
+                  adapter_store=root, **ENG)
+    # save_adapters dedups on the dirty bit
+    assert e2.save_adapters() is False
+    e2.add_adapter("t2", make_random_adapter(
+        tiny_model.config, rank=4, seed=4))
+    assert e2.adapters.dirty is False          # autosave already ran
+
+
+# ---------------------------------------------------------------------------
+# the economy: FIFO degradation, quotas, cost ledgers, alerts
+
+
+def test_no_tenant_requests_keep_fifo_token_identity(tiny_model):
+    """Declaring tenants but sending tenantless traffic degrades to
+    exactly the classic engine: every request lands in the default
+    bucket, stride order == FIFO order, outputs token-identical."""
+    prompts = [_prompt(n, seed=n) for n in (4, 6, 8, 5)]
+    plain = LLMEngine(tiny_model, **ENG)
+    rids_p = [plain.add_request(p, max_new_tokens=4) for p in prompts]
+    outs_p = plain.run(max_steps=200)
+
+    tenanted = LLMEngine(tiny_model, tenants=[
+        {"tenant_id": "a", "weight": 2.0},
+        {"tenant_id": "b", "quota_tokens_per_s": 50.0}], **ENG)
+    rids_t = [tenanted.add_request(p, max_new_tokens=4) for p in prompts]
+    outs_t = tenanted.run(max_steps=200)
+    for rp, rt in zip(rids_p, rids_t):
+        assert outs_p[rp].token_ids == outs_t[rt].token_ids
+    assert tenanted.metrics_snapshot()["tenants"] is not None
+    assert plain.metrics_snapshot()["tenants"] is None
+
+
+def test_quota_shed_is_structured_and_counted(tiny_model):
+    """A metered tenant's overflow sheds with finish_reason
+    "quota_exceeded" (structured, flight-recorded) while an unmetered
+    tenant's traffic all finishes; counters and the ledger agree."""
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, max_num_seqs=2,
+                    tenants=[
+                        {"tenant_id": "a", "weight": 3.0,
+                         "quota_tokens_per_s": 1000.0},
+                        {"tenant_id": "b", "quota_tokens_per_s": 8.0}],
+                    **ENG)
+    rids_a = [eng.add_request(_prompt(4, seed=i), max_new_tokens=4,
+                              tenant_id="a") for i in range(3)]
+    rids_b = [eng.add_request(_prompt(4, seed=10 + i), max_new_tokens=4,
+                              tenant_id="b") for i in range(6)]
+    for _ in range(400):
+        if not eng.has_unfinished():
+            break
+        eng.step()
+        clock.advance(0.05)
+    outs = eng.outputs()
+    assert all(outs[r].status == "finished" for r in rids_a), \
+        "the unmetered tenant must be untouched by b's quota"
+    shed = [r for r in rids_b if outs[r].status == "shed"]
+    fin = [r for r in rids_b if outs[r].status == "finished"]
+    assert shed and fin, "quota must shed the overflow, not everything"
+    for r in shed:
+        assert outs[r].finish_reason == "quota_exceeded"
+    snap = eng.metrics_snapshot()
+    assert snap["quota_shed_requests"] == len(shed)
+    assert snap["tenants"]["b"]["quota_sheds"] == len(shed)
+    assert snap["tenants"]["a"]["quota_sheds"] == 0
+    # the sheds hit the flight recorder with tenant attribution
+    shed_events = [f for _, k, f in eng.flight.events()
+                   if k == "shed" and f and f.get("tenant") == "b"]
+    assert len(shed_events) == len(shed)
+
+
+def test_cost_attribution_ledgers(tiny_model):
+    """Every resource a tenant consumes lands in its ledger: generated
+    tokens (exact), time-weighted KV byte-seconds, and adapter-slot
+    residency seconds — all > 0 only for the tenants that used them."""
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, adapter_slots=2,
+                    adapter_rank=4,
+                    tenants=[{"tenant_id": "a"}, {"tenant_id": "b"}],
+                    **ENG)
+    eng.add_adapter("t1", make_random_adapter(
+        tiny_model.config, rank=4, seed=1))
+    eng.add_request(_prompt(4), max_new_tokens=6, tenant_id="a",
+                    adapter_id="t1")
+    eng.add_request(_prompt(4, seed=2), max_new_tokens=6, tenant_id="b")
+    for _ in range(200):
+        if not eng.has_unfinished():
+            break
+        eng.step()
+        clock.advance(0.05)
+    led = eng.metrics_snapshot()["tenants"]
+    assert led["a"]["tokens"] == 6 and led["b"]["tokens"] == 6
+    assert led["a"]["kv_byte_seconds"] > 0
+    assert led["b"]["kv_byte_seconds"] > 0
+    assert led["a"]["adapter_slot_seconds"] > 0, \
+        "slab residency is billable"
+    assert led["b"]["adapter_slot_seconds"] == 0.0
+    assert led["a"]["ttft_p99_s"] is not None
+    assert led["a"]["finished"] == led["b"]["finished"] == 1
+
+
+def test_tenant_burn_alert_fires_by_name(tiny_model):
+    """A tenant whose TTFT p99 burns its budget pages by NAME: the
+    policy's slo_sample feeds tenant_burn_rules through an
+    AlertManager, and only the burning tenant's rule fires."""
+    from paddle_tpu.telemetry import AlertManager
+    from paddle_tpu.tenancy import TenantPolicy
+    pol = TenantPolicy([{"tenant_id": "good"}, {"tenant_id": "slow"}])
+    am = AlertManager(tenant_burn_rules(["good", "slow"],
+                                        ttft_p99_s=0.1,
+                                        fast_window_s=0.2,
+                                        slow_window_s=0.4))
+    for i in range(10):
+        pol.record_ttft("good", 0.01)
+        pol.record_ttft("slow", 0.5)
+        am.observe(0.1 * i, pol.slo_sample())
+    fired = {e["slo"] for e in am.timeline if e["event"] == "firing"}
+    assert fired == {"tenant:slow:ttft_p99"}, am.timeline
+
+
+# ---------------------------------------------------------------------------
+# loadgen: tenant mixes, classic fingerprints, noisy neighbor
+
+
+def test_workload_tenant_validation_and_fingerprints():
+    """Tenant-mix validation raises on malformed specs; the CLASSIC
+    (no-tenant) trace fingerprints are pinned byte-for-byte (the
+    tenant draw must not shift the classic rng stream), and a tenant
+    spec fingerprints differently but self-reproducibly."""
+    for bad in (
+            [{"tenant_id": "a", "color": "red"}],       # unknown key
+            [{"tenant_id": "a"}, {"tenant_id": "a"}],   # duplicate
+            [{"tenant_id": ""}],                        # empty id
+            [{"tenant_id": "a", "weight": 0.0}],        # weight <= 0
+            [{"tenant_id": "a", "quota_tokens_per_s": -1}],
+            [{"tenant_id": "a", "abusive": True},
+             {"tenant_id": "b", "abusive": True}]):     # two abusers
+        with pytest.raises(ValueError):
+            WorkloadSpec(num_requests=4, tenants=bad)
+    with pytest.raises(ValueError):
+        WorkloadSpec(num_requests=4, abusive_multiplier=0.5,
+                     tenants=[{"tenant_id": "a"}])
+
+    # pinned classic fingerprints: tenancy must never move them
+    def _fp(spec):
+        return trace_fingerprint(spec.compile())
+
+    assert _fp(WorkloadSpec(seed=7, num_requests=12)) == \
+        "8212e986421fef8dc23568e0822b3b551e6bb0119331c71128d3d521f2918b66"
+    assert _fp(WorkloadSpec(
+        seed=3, num_requests=8, prompt_len=(4, 24), output_len=(4, 12),
+        arrival="poisson", arrival_rate=8.0, temperature=0.7,
+        top_k=(0, 8), top_p=(0.8, 1.0), shared_prefix_fraction=0.5,
+        shared_prefix_len=3, num_shared_prefixes=2)) == \
+        "6cdaa49a86986adcfbf89f634b67956c6f2d2dd379d3fe198bd2a5777ae4e1be"
+
+    tspec = WorkloadSpec(seed=7, num_requests=12, tenants=[
+        {"tenant_id": "a", "weight": 2.0}, {"tenant_id": "b"}])
+    assert _fp(tspec) != _fp(WorkloadSpec(seed=7, num_requests=12))
+    assert _fp(tspec) == _fp(tspec)
+    tids = {r.tenant_id for r in tspec.compile()}
+    assert tids <= {"a", "b"} and len(tids) == 2
+    # tenant_specs() strips the loadgen-only "abusive" marker
+    ab = WorkloadSpec(num_requests=4, tenants=[
+        {"tenant_id": "n", "abusive": True, "weight": 1.0}])
+    assert ab.tenant_specs() == [{"tenant_id": "n", "weight": 1.0}]
+
+
+def test_abusive_tenant_floods_selection_share_only():
+    """The abusive marker multiplies the tenant's SELECTION share (the
+    flood) while its declared weight/quota stay honest — the scheduler
+    sees the real entitlement, the trace sees the flood."""
+    spec = WorkloadSpec(seed=0, num_requests=400, tenants=[
+        {"tenant_id": "a", "weight": 2.0},
+        {"tenant_id": "b", "weight": 1.0, "abusive": True}],
+        abusive_multiplier=8.0)
+    from collections import Counter
+    counts = Counter(r.tenant_id for r in spec.compile())
+    assert counts["b"] > 3 * counts["a"], counts
+
+
+def test_noisy_neighbor_isolation_is_byte_reproducible(tiny_model):
+    """The seeded noisy-neighbor scenario: the metered abuser's flood
+    must not move the good tenant's p99 TTFT (isolation), the overflow
+    sheds, the full report reproduces byte for byte per seed, and a
+    classic (tenantless) run's report carries no tenants section."""
+    spec = WorkloadSpec(
+        num_requests=24, seed=11, arrival="poisson", arrival_rate=40.0,
+        prompt_len=(4, 10), output_len=(3, 6), vocab_size=128,
+        tenants=({"tenant_id": "good", "weight": 2.0},
+                 {"tenant_id": "noisy", "weight": 1.0,
+                  "quota_tokens_per_s": 60.0, "abusive": True}))
+
+    def run():
+        clock = VirtualClock()
+        eng = LLMEngine(tiny_model, max_num_seqs=4, now_fn=clock.now,
+                        tenants=spec.tenant_specs(), **ENG)
+        res = Driver(eng, clock, step_time_s=0.02).run(spec.compile())
+        return res, report_json(build_report(res, spec=spec,
+                                             trace=spec.compile()))
+
+    res1, rep1 = run()
+    _, rep2 = run()
+    assert rep1 == rep2, "the tenant report must be byte-reproducible"
+
+    import json
+    rep = json.loads(rep1)
+    per = rep["tenants"]["per_tenant"]
+    assert per["noisy"]["shed"] >= 1
+    assert per["good"]["shed"] == 0
+    good_p99 = per["good"]["ttft_s"]["p99"]
+    noisy_p99 = per["noisy"]["ttft_s"]["p99"]
+    assert good_p99 < 0.5 * noisy_p99, \
+        f"isolation broke: good p99 {good_p99} vs noisy {noisy_p99}"
+    assert rep["tenants"]["quota_shed_requests"] >= 1
+
+    classic = WorkloadSpec(num_requests=6, seed=11, vocab_size=128,
+                           prompt_len=(4, 10), output_len=(3, 6))
+    clock = VirtualClock()
+    eng = LLMEngine(tiny_model, now_fn=clock.now, **ENG)
+    res = Driver(eng, clock, step_time_s=0.02).run(classic.compile())
+    crep = json.loads(report_json(build_report(res, spec=classic,
+                                               trace=classic.compile())))
+    assert "tenants" not in crep
+
+
+# ---------------------------------------------------------------------------
+# observability: tenant attribution on spans, classic traces unmoved
+
+
+def test_tenant_id_rides_spans_and_outputs(tiny_model):
+    """tenant_id travels Request -> RequestOutput -> trace spans; the
+    attribution key appears ONLY when set, so classic (tenantless)
+    span details stay byte-identical to the pre-tenancy schema."""
+    tracer = RequestTracer()
+    eng = LLMEngine(tiny_model, tracer=tracer,
+                    tenants=[{"tenant_id": "a"}], **ENG)
+    rt = eng.add_request(_prompt(4), max_new_tokens=3, tenant_id="a")
+    rc = eng.add_request(_prompt(4, seed=2), max_new_tokens=3)
+    outs = eng.run(max_steps=100)
+    assert outs[rt].tenant_id == "a"
+    assert outs[rc].tenant_id is None
+    t_kinds = {k: d for _, k, d in tracer.spans(rt)}
+    assert t_kinds["admission"]["tenant"] == "a"
+    assert t_kinds["finish"]["tenant"] == "a"
+    for _, k, d in tracer.spans(rc):
+        assert "tenant" not in (d or {}), \
+            f"classic span {k} grew a tenant key"
+
+
+# ---------------------------------------------------------------------------
+# tune -> serve bridge
+
+
+def test_tuner_masked_fused_training_and_publish(tiny_model):
+    """AdapterTuner trains only the LoRA factors over the frozen base
+    through the MASKED fused-optimizer path (pinned loss trajectory —
+    drift means the masked branch or the adapter forward changed), and
+    publish() hot-adds the tuned factors into a live engine."""
+    from paddle_tpu.models.generation import extract_params
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_hidden_layers=1, hidden_size=32,
+                            intermediate_size=64, num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=97)
+    model = LlamaForCausalLM(cfg)
+    tuner = AdapterTuner(extract_params(model), cfg, rank=4, seed=0,
+                         lr=5e-2)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 12))
+    losses = [tuner.step(ids) for _ in range(6)]
+    assert np.allclose(
+        losses, [4.5451, 4.514, 4.4644, 4.4378, 4.4244, 4.4152],
+        atol=2e-3), losses
+    assert losses[-1] < losses[0], "tuning must reduce the loss"
+    # the masked-branch witness: frozen projections ride the SAME
+    # fused buckets with zero-masked updates, never a bucket rebuild
+    assert any(b.masks for b in tuner.opt._fused_engine.buckets), \
+        "the train subset must hit the masked fused path"
+
+    eng = LLMEngine(model, adapter_slots=2, adapter_rank=4, **ENG)
+    rb = eng.add_request(_prompt(5, v=97), max_new_tokens=4)
+    base = eng.run(max_steps=100)[rb].token_ids
+    tuner.publish(eng.adapters, "tuned")
+    rt = eng.add_request(_prompt(5, v=97), max_new_tokens=4,
+                         adapter_id="tuned")
+    out = eng.run(max_steps=100)[rt]
+    assert out.status == "finished"
+    assert len(out.token_ids) == len(base) == 4
+    assert eng.decode_cache_size() == 1
+    # the tuned delta round-trips the slab bit-exactly
+    got = eng.adapters.get("tuned")
+    want = tuner.export()
+    for p in ("q", "v"):
+        np.testing.assert_array_equal(got[p][0], want[p][0])
+        np.testing.assert_array_equal(got[p][1], want[p][1])

@@ -1141,6 +1141,122 @@ def probe_disagg(paddle, colocated=False):
                 "disagg_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_multitenant(paddle, fairness=True):
+    """Measured multi-tenant serving fields (paddle_tpu.tenancy) —
+    ISSUE 17's economy gates, all deterministic on the loadgen virtual
+    clock.
+
+    Two seeded scenarios:
+
+    1. **Noisy neighbor**: a weighted-fair engine serves a two-tenant
+       mix where the metered "noisy" tenant floods (8x selection share)
+       while "good" sends a trickle. The flood must not move good's
+       TTFT: ``multitenant_isolation_ratio`` (good p99 / noisy p99)
+       stays far below 1, ``multitenant_good_ttft_p99_s`` stays pinned,
+       the abuser's overflow is quota-shed with a structured reason
+       (``multitenant_quota_shed`` — exact per seed), and the full
+       loadgen report is byte-reproducible across two runs
+       (``multitenant_deterministic``).
+    2. **Adapter hot-swap over the int8 base**: a mixed batch (one
+       LoRA-adapted row, one base row) decodes through ONE ragged
+       executable — the base row's tokens bitwise-match a no-adapter
+       engine (``multitenant_mixed_batch_identical``) — then an
+       adapter is evicted and a new one hot-published with ZERO
+       recompiles (``multitenant_hot_swap_compiles`` stays 1).
+
+    ``fairness=False`` (the proxy-bench ``--no-fairness`` regression
+    hook) serves scenario 1 WITHOUT the tenant policy — bare FIFO over
+    the same flood: quota sheds drop to 0, good's p99 TTFT blows out
+    behind the abuser's backlog, the isolation ratio collapses toward
+    1 — and the ``multitenant_quota_shed``/``multitenant_good_ttft_
+    p99_s``/``multitenant_isolation_ratio`` gates must all catch it.
+    """
+    try:
+        import numpy as _np
+        from paddle_tpu.loadgen import (Driver, VirtualClock,
+                                        WorkloadSpec, build_report,
+                                        report_json)
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        from paddle_tpu.serving.metrics import percentile_of
+        from paddle_tpu.tenancy import make_random_adapter
+        cfg = llama_tiny_config(
+            num_hidden_layers=1, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=128)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        spec = WorkloadSpec(
+            num_requests=24, seed=11, arrival="poisson",
+            arrival_rate=40.0, prompt_len=(4, 10), output_len=(3, 6),
+            vocab_size=128,
+            tenants=({"tenant_id": "good", "weight": 2.0},
+                     {"tenant_id": "noisy", "weight": 1.0,
+                      "quota_tokens_per_s": 60.0, "abusive": True}))
+
+        def run():
+            clock = VirtualClock()
+            eng = LLMEngine(
+                model, max_len=64, page_size=4, max_num_seqs=4,
+                now_fn=clock.now, seed=0,
+                tenants=spec.tenant_specs() if fairness else None)
+            res = Driver(eng, clock, step_time_s=0.02).run(spec.compile())
+            return res, report_json(build_report(res, spec=spec,
+                                                 trace=spec.compile()))
+
+        res1, rep1 = run()
+        _, rep2 = run()
+
+        def p99(tid):
+            vals = [r.ttft_s for r in res1.records
+                    if r.tenant_id == tid and r.status == "finished"]
+            return percentile_of(vals, 99) if vals else None
+
+        good_p99, noisy_p99 = p99("good"), p99("noisy")
+        shed = sum(1 for r in res1.records if r.status == "shed")
+
+        # adapter hot-swap over the int8-quantized base: the serving
+        # regime the batched-LoRA delta composes over in production
+        prompt = _np.random.default_rng(5).integers(
+            0, 128, (6,)).tolist()
+        kw = dict(max_len=64, page_size=8, max_num_seqs=4, seed=0,
+                  quantized_mode="weight_only_int8")
+        eng0 = LLMEngine(model, **kw)
+        r0 = eng0.add_request(prompt, max_new_tokens=6)
+        base_toks = eng0.run(max_steps=200)[r0].token_ids
+        engq = LLMEngine(model, adapter_slots=2, adapter_rank=4, **kw)
+        engq.add_adapter(
+            "t1", make_random_adapter(cfg, rank=4, seed=3, scale=0.5))
+        ra = engq.add_request(prompt, max_new_tokens=6, adapter_id="t1")
+        rb = engq.add_request(prompt, max_new_tokens=6)
+        outs = engq.run(max_steps=200)
+        mixed_ok = int(outs[rb].token_ids == base_toks
+                       and outs[ra].token_ids != base_toks)
+        engq.evict_adapter("t1")
+        engq.add_adapter(
+            "t2", make_random_adapter(cfg, rank=4, seed=9, scale=0.5))
+        engq.add_request(prompt, max_new_tokens=4, adapter_id="t2")
+        engq.run(max_steps=200)
+        return {
+            "multitenant_good_ttft_p99_s": round(good_p99, 6)
+            if good_p99 is not None else None,
+            "multitenant_isolation_ratio":
+                round(good_p99 / noisy_p99, 4)
+                if good_p99 is not None and noisy_p99 else None,
+            "multitenant_quota_shed": shed,
+            "multitenant_deterministic": int(rep1 == rep2),
+            "multitenant_mixed_batch_identical": mixed_ok,
+            "multitenant_hot_swap_compiles": engq.decode_cache_size(),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"multitenant_good_ttft_p99_s": None,
+                "multitenant_isolation_ratio": None,
+                "multitenant_quota_shed": None,
+                "multitenant_deterministic": None,
+                "multitenant_mixed_batch_identical": None,
+                "multitenant_hot_swap_compiles": None,
+                "multitenant_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -1172,6 +1288,7 @@ __all__ = ["probe_cluster", "probe_disagg", "probe_gspmd",
            "probe_hlo_fusion",
            "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_kv_tiering",
+           "probe_multitenant",
            "probe_opt_dispatches",
            "probe_persistence",
            "probe_serving", "probe_spec_decode", "probe_telemetry",

@@ -43,7 +43,13 @@ This harness runs the measurements that DON'T need a chip and are
   (paddle_tpu.telemetry): byte-identical series + alert-timeline
   exports per seed, a pinned scrape count, the seeded slowdown fault
   firing AND resolving its burn-rate alert (``--no-burn-alerts`` is
-  the injected regression), and zero added step executables.
+  the injected regression), and zero added step executables;
+- ``multitenant_*`` — the multi-tenant serving economy's contracts
+  (paddle_tpu.tenancy): noisy-neighbor p99 TTFT isolation under
+  weighted-fair admission, exact quota-shed counts, byte-reproducible
+  tenant reports, mixed-batch LoRA token identity over the int8 base,
+  and adapter hot-swap with zero recompiles (``--no-fairness`` is the
+  injected regression: bare FIFO over the same flood).
 
 Each metric gates against a checked-in per-backend baseline
 (tools/proxy_bench_baseline.json) with a direction and tolerance from
@@ -84,7 +90,7 @@ BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
           "jaxpr", "accounting", "fusion", "tracing", "telemetry",
-          "persist", "kvtier", "disagg")
+          "persist", "kvtier", "disagg", "multitenant")
 
 
 class Gate:
@@ -242,6 +248,27 @@ GATES = {
     "disagg_transfer_stall_fraction": Gate("higher", 0.0, 0.0),
     "disagg_ttft_ratio_vs_colocated": Gate("higher", 0.25, 0.05),
     "disagg_deterministic":      Gate("lower", 0.0, 0.0),
+    # multi-tenant serving economy (paddle_tpu.tenancy via
+    # probe_multitenant): the weighted-fair scheduler must hold the
+    # good tenant's p99 TTFT flat while the metered noisy tenant
+    # floods — the isolation ratio (good p99 / noisy p99, virtual
+    # clock, deterministic) stays far below 1 and the abuser's
+    # overflow is shed by quota (count pinned exactly per seed — a
+    # drift means admission or refill math changed; re-record
+    # deliberately). The tenant-annotated loadgen report must be
+    # byte-reproducible, the mixed LoRA/base batch must decode the
+    # base row bit-identically to a no-adapter engine over the int8
+    # base, and adapter evict + hot-add must leave the ONE ragged
+    # decode executable alone. --no-fairness serves the same flood
+    # FIFO with no policy: sheds read 0, good's p99 blows out behind
+    # the abuser's backlog, the isolation ratio collapses toward 1 —
+    # the first three gates must all catch it.
+    "multitenant_good_ttft_p99_s": Gate("higher", 0.25, 0.02),
+    "multitenant_isolation_ratio": Gate("higher", 0.25, 0.05),
+    "multitenant_quota_shed":    Gate("different"),
+    "multitenant_deterministic": Gate("lower", 0.0, 0.0),
+    "multitenant_mixed_batch_identical": Gate("lower", 0.0, 0.0),
+    "multitenant_hot_swap_compiles": Gate("higher", 0.0, 0.0),
 }
 
 
@@ -249,7 +276,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
             fusion_defuse=False, telemetry_burn_alerts=True,
             persist_corrupt=False, kvtier_prefetch=True,
-            disagg_colocated=False) -> dict:
+            disagg_colocated=False, multitenant_fairness=True) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -289,6 +316,13 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     collapses to ~1; the ``disagg_kv_pages_transferred``,
     ``disagg_fleet_prefix_hit_rate``, and
     ``disagg_ttft_ratio_vs_colocated`` gates must catch it.
+    ``multitenant_fairness=False`` (--no-fairness) serves the
+    multitenant probe's noisy-neighbor flood with NO tenant policy
+    (bare FIFO): quota sheds read 0, the good tenant's p99 TTFT blows
+    out behind the abuser's backlog, and the isolation ratio collapses
+    toward 1; the ``multitenant_quota_shed``,
+    ``multitenant_good_ttft_p99_s``, and
+    ``multitenant_isolation_ratio`` gates must all catch it.
     """
     import jax
     import paddle_tpu as paddle
@@ -297,6 +331,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
+                                    probe_multitenant,
                                     probe_opt_dispatches,
                                     probe_kv_tiering,
                                     probe_persistence, probe_serving,
@@ -379,6 +414,13 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                "disagg_transfer_stall_fraction",
                "disagg_ttft_ratio_vs_colocated",
                "disagg_deterministic"))
+    if "multitenant" in probes:
+        _take(probe_multitenant(paddle, fairness=multitenant_fairness),
+              ("multitenant_good_ttft_p99_s",
+               "multitenant_isolation_ratio", "multitenant_quota_shed",
+               "multitenant_deterministic",
+               "multitenant_mixed_batch_identical",
+               "multitenant_hot_swap_compiles"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -480,6 +522,11 @@ def main(argv=None) -> int:
                          "the fleet prefix cache never hits, and the "
                          "TTFT ratio collapses to ~1 (the injected "
                          "regression)")
+    ap.add_argument("--no-fairness", action="store_true",
+                    help="serve the multitenant probe's noisy-neighbor "
+                         "flood with no tenant policy (bare FIFO): "
+                         "quota sheds read 0 and the good tenant's p99 "
+                         "TTFT blows out (the injected regression)")
     args = ap.parse_args(argv)
 
     probes = tuple(p for p in args.probes.split(",") if p)
@@ -509,7 +556,8 @@ def main(argv=None) -> int:
                       telemetry_burn_alerts=not args.no_burn_alerts,
                       persist_corrupt=args.corrupt_checkpoint,
                       kvtier_prefetch=not args.no_prefetch,
-                      disagg_colocated=args.colocated)
+                      disagg_colocated=args.colocated,
+                      multitenant_fairness=not args.no_fairness)
 
     if args.json:
         # --json changes the output format, never the action: combined
