@@ -46,6 +46,7 @@ from tools.bench_probes import (probe_disagg,  # noqa: E402
                                 probe_hlo_fusion,
                                 probe_input_pipeline,
                                 probe_kv_tiering,
+                                probe_megakernel,
                                 probe_multitenant,
                                 probe_opt_dispatches,
                                 probe_persistence, probe_serving,
@@ -66,6 +67,7 @@ _probe_persistence = probe_persistence
 _probe_kv_tiering = probe_kv_tiering
 _probe_disagg = probe_disagg
 _probe_multitenant = probe_multitenant
+_probe_megakernel = probe_megakernel
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -232,6 +234,7 @@ def run_bench(config="llama_125m", progress=None):
     kv_tier_probe = _probe_kv_tiering(paddle)
     disagg_probe = _probe_disagg(paddle)
     multitenant_probe = _probe_multitenant(paddle)
+    megakernel_probe = _probe_megakernel(paddle)
     progress.mark("model_built", config=config, **opt_probe)
 
     def loss_fn(ids):
@@ -308,6 +311,7 @@ def run_bench(config="llama_125m", progress=None):
         **kv_tier_probe,
         **disagg_probe,
         **multitenant_probe,
+        **megakernel_probe,
     }
 
 
@@ -645,6 +649,16 @@ def _failure_artifact(last_err, last_stages):
         "multitenant_deterministic": None,
         "multitenant_mixed_batch_identical": None,
         "multitenant_hot_swap_compiles": None,
+        # whole-model megakernel fields are per-run structural proofs:
+        # a launches-per-token count, scope bit, token-identity
+        # verdict, or compiled fusion/kernel count from a stale round
+        # proves nothing about the run that failed
+        "mk_model_scope": None,
+        "mk_launches_per_token": None,
+        "mk_burst_launches_per_token": None,
+        "mk_token_identity": None,
+        "mk_serving_fusions": None,
+        "mk_serving_kernels": None,
     }
     good = _last_good_round()
     if good:

@@ -116,4 +116,56 @@ def fusion_stats(hlo_text: str) -> dict:
     }
 
 
-__all__ = ["fusion_stats", "shape_bytes"]
+#: the launch-accounting marker: rsqrt appears in this stack's decode
+#: bodies ONLY inside rms_norm (attention scales by a python-float
+#: 1/sqrt(d), sampling/PRNG/softmax emit none), so counting rsqrt ops
+#: in an UNOPTIMIZED lowering counts rms_norm sites — a fixed number
+#: per decoder-layer body plus one final norm
+_MARKER_RE = re.compile(r"\brsqrt\b")
+
+
+def launch_stats(program_text: str, *, num_layers,
+                 markers_per_body=2, overhead_markers=1,
+                 tokens_per_invocation=1) -> dict:
+    """Launch accounting over an UNOPTIMIZED StableHLO lowering
+    (``jit(f).lower(args).as_text()``): how many times does the decoder
+    layer body appear as a distinct site in the program?
+
+    The measurable is structural, not a fusion heuristic: an unrolled
+    layer loop inlines the body ``num_layers`` times; a ``lax.scan``
+    over stacked weights emits ONE body inside ``stablehlo.while``.
+    Each body carries ``markers_per_body`` rms_norm (rsqrt) markers and
+    the program carries ``overhead_markers`` non-layer markers (the
+    final norm), so
+
+        layer_body_sites = (markers - overhead) / markers_per_body
+        launches_per_token = layer_body_sites / tokens_per_invocation
+
+    ``tokens_per_invocation`` > 1 accounts a burst executable, whose
+    one invocation's while_loop covers that many tokens per row —
+    model-scope burst decode reaches 1/burst_tokens launches per token.
+    ``collapsed`` is the gateable headline: True iff the layer loop
+    lives inside the program (<= 1 body site). Raises ValueError when
+    the marker count is inconsistent with the constants (e.g. a body
+    gained a norm without the caller re-deriving markers_per_body) —
+    silently mis-dividing would fabricate a launch count.
+    """
+    markers = len(_MARKER_RE.findall(program_text))
+    sites_num = markers - int(overhead_markers)
+    if sites_num < 0 or sites_num % int(markers_per_body):
+        raise ValueError(
+            f"launch_stats: {markers} rsqrt markers do not decompose as "
+            f"{overhead_markers} overhead + N x {markers_per_body} "
+            f"per-body markers — the traced body changed; re-derive the "
+            f"marker constants")
+    sites = sites_num // int(markers_per_body)
+    return {
+        "marker_count": markers,
+        "layer_body_sites": sites,
+        "num_layers": int(num_layers),
+        "launches_per_token": sites / float(tokens_per_invocation),
+        "collapsed": sites <= 1,
+    }
+
+
+__all__ = ["fusion_stats", "launch_stats", "shape_bytes"]

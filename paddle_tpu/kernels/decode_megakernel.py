@@ -1,4 +1,6 @@
-"""Decode megakernel (Pallas TPU): one launch per decoder layer.
+"""Decode megakernel (Pallas TPU): one launch per decoder layer, and —
+via :func:`fused_decode_model` — one launch per token (scan-over-layers
+inside the kernel tier).
 
 Decode is dispatch-bound: a single generated token used to cost 4+
 device ops PER LAYER (rms_norm, qkv projection, paged-attention gather,
@@ -54,6 +56,20 @@ winner is consulted.
 int4 weights (and any mixed layouts) take the jnp fallback: the packed
 nibble unpack inside this kernel's prologue is not worth the Mosaic
 surface until a chip run says otherwise.
+
+Whole-model scope (:func:`fused_decode_model`): the decode LAYER LOOP
+itself moves inside the traced program as a ``lax.scan`` over
+LayerStack-stacked ``[L, ...]`` weights (:func:`stack_layer_params`)
+and stacked per-layer KV pools/int8 scale columns. The scanned body is
+the same fused layer body as above, so the whole decode step lowers to
+ONE ``stablehlo.while`` whose body contains ONE layer-body site — one
+launch per token instead of L, and under the on-device burst
+``lax.while_loop`` one launch per burst (jit/hlo_forensics.py
+``launch_stats`` holds the collapse). The caller still owns the pool
+write, threaded through the scan as a callback: ``append_fn`` for fp
+(scatter the returned k/v at the flat slot) and ``quant_append_fn``
+for int8 (running-amax requant-append BEFORE attention — the
+``self_kv=False`` contract above, per layer slice).
 """
 from __future__ import annotations
 
@@ -69,6 +85,22 @@ _NEG_INF = -1e30
 
 _LAYER_MATS = ("q", "k", "v", "o", "gate", "up", "down")
 
+# process-wide record of a runtime Pallas failure that
+# FLAGS_enable_fusion_fallback rerouted to the jnp body — what makes
+# megakernel_mode() honest about the path that actually ran
+_FALLBACK = {"tripped": False}
+
+
+def megakernel_fallback_tripped() -> bool:
+    """True once a Pallas launch failed at runtime and
+    ``FLAGS_enable_fusion_fallback`` rerouted it to the jnp body."""
+    return _FALLBACK["tripped"]
+
+
+def reset_megakernel_fallback() -> None:
+    """Clear the tripped-fallback record (tests; engine re-init)."""
+    _FALLBACK["tripped"] = False
+
 
 def megakernel_mode(layer=None, interpret=None) -> str:
     """How :func:`fused_decode_layer` would execute here: ``pallas``
@@ -81,11 +113,17 @@ def megakernel_mode(layer=None, interpret=None) -> str:
     fabricate a kernel that never runs. Pass ``interpret`` when the
     caller pinned :func:`fused_decode_layer`'s mode explicitly (the
     LLMEngine(interpret=...) knob) instead of leaving it env-driven.
-    (A runtime Pallas failure rerouted by
-    ``FLAGS_enable_fusion_fallback`` is not knowable here — this
-    reports the selected path, not a post-failure one.)"""
+    A runtime Pallas failure rerouted by
+    ``FLAGS_enable_fusion_fallback`` IS knowable here: the reroute
+    trips :func:`megakernel_fallback_tripped`, and while the flag keeps
+    routing launches to the jnp body this reports ``jnp`` — the mode
+    that actually runs, not the one that was selected."""
     if layer is not None and _weights_kernel_ready(layer) is None:
         return "jnp"
+    if _FALLBACK["tripped"]:
+        from ..core.flags import GLOBAL_FLAGS
+        if GLOBAL_FLAGS.get("enable_fusion_fallback"):
+            return "jnp"
     # an explicitly pinned interpret=True wins even on TPU — that is
     # what fused_decode_layer passes to pallas_call
     if interpret is True:
@@ -359,7 +397,8 @@ def _pick_groups(Hkv, key_dims, run_fn, traced):
 
 def fused_decode_layer(layer, h, k_pages, v_pages, block_tables, kv_lens,
                        *, eps, theta, num_heads, self_kv=True,
-                       interpret=None, k_scales=None, v_scales=None):
+                       interpret=None, k_scales=None, v_scales=None,
+                       scope="layer", num_layers=1):
     """One fused decoder layer over q_len=1 rows.
 
     layer: dict with ln1/ln2 (fp) and q/k/v/o/gate/up/down projections
@@ -373,6 +412,12 @@ def fused_decode_layer(layer, h, k_pages, v_pages, block_tables, kv_lens,
         returns them for the caller to append. self_kv=False: the
         caller appended first (the int8 running-amax contract); pages
         hold all ``kv_len`` tokens.
+    scope/num_layers: autotune-cache provenance — ``"model"`` when the
+        call sits inside :func:`fused_decode_model`'s scan over
+        ``num_layers`` stacked layers. The scanned body competes for
+        VMEM/pipeline slots differently than a standalone launch, so
+        layer-scope and model-scope tunings must never share a cache
+        line (kernels/autotune.py key separation).
     Returns ``(h_out, k_cur, v_cur)`` (k_cur/v_cur None when
     ``self_kv=False``).
     """
@@ -481,13 +526,14 @@ def fused_decode_layer(layer, h, k_pages, v_pages, block_tables, kv_lens,
                  for a in (h, k_pages, kv_lens))
     cfg = _pick_groups(
         Hkv, (R, D, H, Hkv, dh, PPS, ps, kind, bool(self_kv),
-              bool(quant_kv)), run, traced)
+              bool(quant_kv), str(scope), int(num_layers)), run, traced)
     try:
         out = run(cfg)
     except Exception:
         from ..core.flags import GLOBAL_FLAGS
         if not GLOBAL_FLAGS.get("enable_fusion_fallback"):
             raise
+        _FALLBACK["tripped"] = True
         from ..core.vlog import vlog
         vlog(0, "pallas decode megakernel failed; falling back to the "
                 "jnp layer body (FLAGS_enable_fusion_fallback)")
@@ -501,4 +547,106 @@ def fused_decode_layer(layer, h, k_pages, v_pages, block_tables, kv_lens,
     return out[0], None, None
 
 
-__all__ = ["fused_decode_layer", "megakernel_mode"]
+def stack_layer_params(layers):
+    """Stack a list of per-layer param pytrees into one ``[L, ...]``
+    tree — the LayerStack layout :func:`fused_decode_model` scans over.
+
+    Works uniformly over fp dicts, registered
+    ``quantization.QuantizedWeight`` pytrees (qdata/scale leaves stack;
+    bits/rows aux must match across layers) and LoRA adapter slabs,
+    because it is a plain leafwise ``jnp.stack``: a scan slice of the
+    result is bit-equal to the original per-layer tree.
+    """
+    layers = list(layers)
+    if not layers:
+        raise ValueError("stack_layer_params needs at least one layer")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def fused_decode_model(layers, h, k_pages, v_pages, block_tables,
+                       kv_lens, *, eps, theta, num_heads, self_kv=True,
+                       interpret=None, k_scales=None, v_scales=None,
+                       append_fn=None, quant_append_fn=None):
+    """Whole-model decode step: ``lax.scan`` of the fused layer body
+    over stacked ``[L, ...]`` weights and KV pools — ONE layer-body
+    site in the lowered program, so one launch per token (and, under
+    the caller's burst ``lax.while_loop``, per burst).
+
+    layers: stacked param tree from :func:`stack_layer_params` (leaves
+        ``[L, ...]``); k_pages/v_pages: ``[L, Hkv, num_pages, ps, dh]``
+        stacked pools; k_scales/v_scales: ``[L, Hkv, num_pages]``
+        stacked int8 scale columns (``self_kv=False`` only);
+    block_tables/kv_lens: as :func:`fused_decode_layer` (shared across
+        layers — every layer of a request lives at the same slots).
+    append_fn(Kp, Vp, k_cur, v_cur) -> (Kp, Vp): fp pool write for one
+        layer slice, run INSIDE the scan after the kernel returns the
+        current token's k/v (``self_kv=True``). quant_append_fn(Kp, Ks,
+        Vp, Vs, k_cur, v_cur) -> (Kp, Ks, Vp, Vs): int8 running-amax
+        requant-append for one layer slice, run BEFORE the kernel
+        (``self_kv=False`` — the append must be visible to the gather).
+        The caller owns both (NULL-page masking, slot layout), so the
+        scanned body replays the layer-scope pool writes bit-for-bit.
+
+    Returns ``(h_out, k_pages, v_pages, k_scales, v_scales)`` with the
+    updated stacked pools (scales None in the fp contract).
+    """
+    num_layers = int(k_pages.shape[0])
+    kv_lens = jnp.asarray(kv_lens, jnp.int32)
+    block_tables = jnp.asarray(block_tables, jnp.int32)
+
+    def _layer(lyr, hc, Kp, Vp, Ks=None, Vs=None):
+        return fused_decode_layer(
+            lyr, hc, Kp, Vp, block_tables, kv_lens, eps=eps, theta=theta,
+            num_heads=num_heads, self_kv=self_kv, interpret=interpret,
+            k_scales=Ks, v_scales=Vs, scope="model",
+            num_layers=num_layers)
+
+    if self_kv:
+        if append_fn is None:
+            raise ValueError("self_kv=True needs append_fn (the caller "
+                             "owns the fp pool scatter)")
+        if k_scales is not None or v_scales is not None:
+            raise ValueError("self_kv=True is the fp contract; int8 "
+                             "scale columns need self_kv=False")
+
+        def body(hc, xs):
+            lyr, Kp, Vp = xs
+            h2, k_cur, v_cur = _layer(lyr, hc, Kp, Vp)
+            Kp, Vp = append_fn(Kp, Vp, k_cur, v_cur)
+            return h2, (Kp, Vp)
+
+        h_out, (Kps, Vps) = jax.lax.scan(body, h, (layers, k_pages,
+                                                   v_pages))
+        return h_out, Kps, Vps, None, None
+
+    if quant_append_fn is None:
+        raise ValueError("self_kv=False needs quant_append_fn (the "
+                         "caller owns the running-amax append)")
+    if k_scales is None or v_scales is None:
+        raise ValueError("self_kv=False needs stacked k_scales/v_scales")
+    from ..models.generation import _rms_norm, _rope, _wmat
+    R = h.shape[0]
+    Hkv, dh = int(k_pages.shape[1]), int(k_pages.shape[4])
+    pos = jnp.maximum(kv_lens - 1, 0)
+
+    def body(hc, xs):
+        lyr, Kp, Vp, Ks, Vs = xs
+        # pre-append prologue, identical math to the layer-scope int8
+        # path: the current token's k/v must be requant-appended before
+        # the kernel's gather sees the pool
+        x = _rms_norm(hc[None], lyr["ln1"], eps)[0]
+        k_cur = _rope(_wmat(x, lyr["k"]).reshape(R, Hkv, dh)[None],
+                      pos[None], theta, dh)[0]
+        v_cur = _wmat(x, lyr["v"]).reshape(R, Hkv, dh)
+        Kp, Ks, Vp, Vs = quant_append_fn(Kp, Ks, Vp, Vs, k_cur, v_cur)
+        h2, _, _ = _layer(lyr, hc, Kp, Vp, Ks, Vs)
+        return h2, (Kp, Vp, Ks, Vs)
+
+    h_out, (Kps, Vps, Kss, Vss) = jax.lax.scan(
+        body, h, (layers, k_pages, v_pages, k_scales, v_scales))
+    return h_out, Kps, Vps, Kss, Vss
+
+
+__all__ = ["fused_decode_layer", "fused_decode_model",
+           "stack_layer_params", "megakernel_mode",
+           "megakernel_fallback_tripped", "reset_megakernel_fallback"]

@@ -56,6 +56,40 @@ define_flag("decode_burst_tokens", int, 1,
             "pre-burst engine", on_set=_check_burst_tokens)
 
 
+_MEGAKERNEL_SCOPES = ("layer", "model")
+
+
+def _check_megakernel_scope(v):
+    if v not in _MEGAKERNEL_SCOPES:
+        raise ValueError(
+            f"FLAGS_decode_megakernel_scope must be one of "
+            f"{_MEGAKERNEL_SCOPES}, got {v!r}")
+
+
+define_flag("decode_megakernel_scope", str, "layer",
+            "where the decode layer loop lives: 'layer' (the default) "
+            "unrolls L fused-layer launches per token — today's path, "
+            "bit-identical to every prior release; 'model' moves the "
+            "loop INSIDE the traced program as a lax.scan over "
+            "LayerStack-stacked [L, ...] weights and KV pools "
+            "(kernels/decode_megakernel.fused_decode_model), so a "
+            "decode step is ONE launch per token and the on-device "
+            "burst while_loop is one launch per burst. Token output is "
+            "bitwise identical between scopes (gated by "
+            "tests/test_decode_megakernel.py); jit/hlo_forensics.py "
+            "launch_stats holds the launch-count collapse",
+            on_set=_check_megakernel_scope)
+
+
+def resolve_megakernel_scope(scope):
+    """Validate an explicit scope or fall back to
+    ``FLAGS_decode_megakernel_scope`` (Generator/LLMEngine ctor knob)."""
+    if scope is None:
+        scope = str(GLOBAL_FLAGS.get("decode_megakernel_scope"))
+    _check_megakernel_scope(scope)
+    return scope
+
+
 #: host->device dispatch forensics for the burst gate
 #: (tests/test_decode_megakernel.py): every jitted launch generate()
 #: issues — prefill, per-token decode, or burst — bumps this counter, so
@@ -374,7 +408,7 @@ class Generator:
     """
 
     def __init__(self, model, max_len=2048, paged=False, page_size=128,
-                 quantized_mode=None):
+                 quantized_mode=None, megakernel_scope=None):
         self.cfg = model.config
         self.params = extract_params(model)
         self.quantized_mode = quantized_mode
@@ -391,6 +425,20 @@ class Generator:
             from ..kernels import _on_tpu
             paged_opt = (page_size, not _on_tpu())   # interpret off-TPU
         self.paged = paged_opt
+        scope = resolve_megakernel_scope(megakernel_scope)
+        self.megakernel_scope = scope
+        # model scope scans _block over LayerStack-stacked [L, ...]
+        # weights: the decode step (and the whole burst while_loop body)
+        # lowers to ONE layer-body site instead of L. The stack is paid
+        # once here; prefill keeps the per-layer list (its causal pass
+        # is compute-bound, not launch-bound).
+        if scope == "model":
+            from ..kernels.decode_megakernel import stack_layer_params
+            self._decode_params = dict(
+                self.params, layers=stack_layer_params(
+                    self.params["layers"]))
+        else:
+            self._decode_params = self.params
 
         @jax.jit
         def prefill(params, ids):
@@ -423,11 +471,23 @@ class Generator:
             b = token.shape[0]
             pos = jnp.full((b, 1), cur_len, jnp.int32)
             h = params["embed"][token[:, None]]
-            new_caches = []
-            for pl, cl in zip(params["layers"], caches):
-                h, cl2 = _block(pl, h, pos, cfg, cache_layer=cl,
-                                cur_len=cur_len, paged=paged_opt)
-                new_caches.append(cl2)
+            if scope == "model":
+                # scan-over-layers: caches arrive stacked [L, ...] (see
+                # generate()), params["layers"] is the stacked tree —
+                # one layer-body site in the lowered program
+                def layer_body(hc, xs):
+                    pl, cl = xs
+                    hc, cl2 = _block(pl, hc, pos, cfg, cache_layer=cl,
+                                     cur_len=cur_len, paged=paged_opt)
+                    return hc, cl2
+                h, new_caches = jax.lax.scan(layer_body, h,
+                                             (params["layers"], caches))
+            else:
+                new_caches = []
+                for pl, cl in zip(params["layers"], caches):
+                    h, cl2 = _block(pl, h, pos, cfg, cache_layer=cl,
+                                    cur_len=cur_len, paged=paged_opt)
+                    new_caches.append(cl2)
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             logits = _logits(params, h[:, 0], cfg)
             nxt = _sample(logits, key, temperature, top_k, top_p)
@@ -513,6 +573,11 @@ class Generator:
         key = jax.random.key(seed)
         _HOST_DISPATCH["count"] += 1
         logits, caches = self._prefill(self.params, ids)
+        if self.megakernel_scope == "model":
+            # one host-side stack after prefill; the stacked pytree then
+            # round-trips through decode_step/decode_burst (donated)
+            # without ever unstacking — the scan indexes it in-place
+            caches = jax.tree.map(lambda *xs: jnp.stack(xs), *caches)
         key, sub = jax.random.split(key)
         token = _sample(logits, sub, temperature, top_k, top_p)
         finished = np.zeros((b,), bool)
@@ -534,8 +599,8 @@ class Generator:
                 n = min(burst_tokens, max_new_tokens - done)
                 _HOST_DISPATCH["count"] += 1
                 token, caches, key, fin, buf, cnt = self._decode_burst(
-                    self.params, caches, token, s + done - 1, key, fin,
-                    n, temperature, top_k, top_p, eos_token_id,
+                    self._decode_params, caches, token, s + done - 1,
+                    key, fin, n, temperature, top_k, top_p, eos_token_id,
                     burst_tokens)
                 cnt = int(cnt)
                 if cnt == 0:
@@ -548,9 +613,9 @@ class Generator:
             for i in range(max_new_tokens - 1):
                 key, sub = jax.random.split(key)
                 _HOST_DISPATCH["count"] += 1
-                token, caches = self._decode(self.params, caches, token,
-                                             s + i, sub, temperature,
-                                             top_k, top_p)
+                token, caches = self._decode(self._decode_params, caches,
+                                             token, s + i, sub,
+                                             temperature, top_k, top_p)
                 if eos_token_id is not None:
                     # rows already finished emit eos forever (pad),
                     # regardless of what the model sampled from post-eos
@@ -571,5 +636,5 @@ def generate(model, input_ids, max_len=512, **kwargs):
 
 
 __all__ = ["Generator", "generate", "extract_params",
-           "host_dispatch_count", "request_keys", "sample_rows",
-           "sampling_probs"]
+           "host_dispatch_count", "request_keys",
+           "resolve_megakernel_scope", "sample_rows", "sampling_probs"]

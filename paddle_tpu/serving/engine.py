@@ -278,7 +278,8 @@ class LLMEngine:
                  host_kv_pages=0, kv_prefetch=True, kv_prefetch_depth=4,
                  kv_spill_seed=0, fleet_prefix_cache=None,
                  tenants=None, adapter_slots=0, adapter_rank=8,
-                 adapter_store=None, adapter_store_autosave=None):
+                 adapter_store=None, adapter_store_autosave=None,
+                 megakernel_scope=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -306,6 +307,15 @@ class LLMEngine:
                 "speculative decoding and the on-device burst loop are "
                 "mutually exclusive decode accelerations — set "
                 "burst_tokens=1 (the default) when passing draft_model")
+        # whole-model decode megakernel scope (ROADMAP item 4 / MPK):
+        # 'layer' keeps today's unrolled per-layer launches; 'model'
+        # moves the layer loop inside the traced program as a lax.scan
+        # over stacked [L, ...] weights + KV pools — one launch per
+        # token (and per burst). Token output is bitwise identical
+        # between scopes; jit/hlo_forensics.launch_stats holds the
+        # collapse (engine.launch_stats()).
+        from ..models.generation import resolve_megakernel_scope
+        self.megakernel_scope = resolve_megakernel_scope(megakernel_scope)
         # multi-tenant LoRA (paddle_tpu.tenancy): an adapter store with
         # no explicit slot count still needs a registry to reload into
         if adapter_store is not None and not adapter_slots:
@@ -602,6 +612,18 @@ class LLMEngine:
                 self.metrics.adapter_restores.inc(restored)
                 self.record_fleet_event("adapter_restore",
                                         adapters=restored)
+        # the params the TWO step executables trace over: model scope
+        # stacks the per-layer dicts into one [L, ...] LayerStack tree
+        # ONCE here (fp arrays and int8 QuantizedWeight leaves alike);
+        # self.params stays per-layer for everything host-side
+        # (prefix/persist export, megakernel_mode probing)
+        if self.megakernel_scope == "model":
+            from ..kernels.decode_megakernel import stack_layer_params
+            self._step_params = dict(
+                self.params,
+                layers=stack_layer_params(self.params["layers"]))
+        else:
+            self._step_params = self.params
         self._step_launched = False
         self._burst_launched = False
         self._build_step()
@@ -629,6 +651,8 @@ class LLMEngine:
         quant_pool = self.pool.quantized
         H, Hkv, d = (cfg.num_attention_heads, cfg.num_key_value_heads,
                      cfg.head_dim)
+        scope = self.megakernel_scope
+        num_layers = cfg.num_hidden_layers
 
         def ragged_step(params, kv, kv_scales, tokens, positions, tbls,
                         q_starts, q_lens, kv_lens, sample_idx, temps,
@@ -660,20 +684,16 @@ class LLMEngine:
                 A, B = ad[p]
                 return (A, B, adapter_slots)
 
-            h = params["embed"][tokens][None]               # [1, T, hid]
-            new_kv, new_scales = [], []
-            for li, (lyr, (Kp, Vp)) in enumerate(zip(params["layers"], kv)):
-                ad = adapters[li] if adapters is not None else None
-                if not quant_pool:
-                    # the shared fp layer body (spec_decode), which the
-                    # draft worker also runs — draft/target numerics
-                    # come from ONE definition
-                    h, Kp, Vp = _ragged_fp_layer(
-                        lyr, h, Kp, Vp, positions, tbls, tok_row, live,
-                        q_starts, q_lens, kv_lens, cfg, ps, PPS, qb,
-                        interpret, adapters=ad, slots=adapter_slots)
-                    new_kv.append((Kp, Vp))
-                    continue
+            def fp_layer(lyr, ad, h, Kp, Vp):
+                # the shared fp layer body (spec_decode), which the
+                # draft worker also runs — draft/target numerics come
+                # from ONE definition
+                return _ragged_fp_layer(
+                    lyr, h, Kp, Vp, positions, tbls, tok_row, live,
+                    q_starts, q_lens, kv_lens, cfg, ps, PPS, qb,
+                    interpret, adapters=ad, slots=adapter_slots)
+
+            def int8_layer(lyr, ad, h, Kp, Ks, Vp, Vs):
                 x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
                 q = _wmat(x, lyr["q"], lora=lo(ad, "q")) \
                     .reshape(1, T, H, d)
@@ -685,12 +705,9 @@ class LLMEngine:
                 k = _rope(k, positions[None], cfg.rope_theta, d)
                 kt = jnp.transpose(k[0], (1, 0, 2))         # [Hkv, T, d]
                 vt = jnp.transpose(v[0], (1, 0, 2))
-                Ks, Vs = kv_scales[li]
                 Kp, Ks, Vp, Vs = _append_quant(
                     Kp, Ks, Vp, Vs, kt, vt, tbls, q_starts, q_lens,
                     kv_lens)
-                new_scales.append((Ks, Vs))
-                new_kv.append((Kp, Vp))
                 o = ragged_paged_attention(
                     q[0], Kp, Vp, tbls, q_starts, q_lens, kv_lens,
                     q_block=qb, interpret=interpret,
@@ -703,6 +720,63 @@ class LLMEngine:
                                       lora=lo(ad, "gate")))
                     * _wmat(x, lyr["up"], lora=lo(ad, "up")),
                     lyr["down"], lora=lo(ad, "down"))
+                return h, Kp, Ks, Vp, Vs
+
+            h = params["embed"][tokens][None]               # [1, T, hid]
+            if scope == "model":
+                # scan-over-layers: pools (and the LoRA slab views)
+                # stack inside the jit, the SAME layer bodies as the
+                # unrolled path run as the scan body — ONE layer-body
+                # site in the lowered program, so the prologue/epilogue
+                # chains (rms_norm->qkv->rope, o-proj->residual->mlp)
+                # appear once instead of L times in the compiled HLO
+                Kst = jnp.stack([K for K, _ in kv])
+                Vst = jnp.stack([V for _, V in kv])
+                ad_st = None
+                if adapters is not None:
+                    ad_st = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                         *adapters)
+                if not quant_pool:
+                    def layer_body(hc, xs):
+                        lyr, ad, Kp, Vp = xs
+                        hc, Kp, Vp = fp_layer(lyr, ad, hc, Kp, Vp)
+                        return hc, (Kp, Vp)
+                    h, (Kn, Vn) = jax.lax.scan(
+                        layer_body, h, (params["layers"], ad_st, Kst,
+                                        Vst))
+                    new_kv = [(Kn[li], Vn[li])
+                              for li in range(num_layers)]
+                    new_scales = []
+                else:
+                    Kss = jnp.stack([a for a, _ in kv_scales])
+                    Vss = jnp.stack([b for _, b in kv_scales])
+
+                    def layer_body(hc, xs):
+                        lyr, ad, Kp, Vp, Ks, Vs = xs
+                        hc, Kp, Ks, Vp, Vs = int8_layer(lyr, ad, hc, Kp,
+                                                        Ks, Vp, Vs)
+                        return hc, (Kp, Vp, Ks, Vs)
+                    h, (Kn, Vn, Ksn, Vsn) = jax.lax.scan(
+                        layer_body, h, (params["layers"], ad_st, Kst,
+                                        Vst, Kss, Vss))
+                    new_kv = [(Kn[li], Vn[li])
+                              for li in range(num_layers)]
+                    new_scales = [(Ksn[li], Vsn[li])
+                                  for li in range(num_layers)]
+            else:
+                new_kv, new_scales = [], []
+                for li, (lyr, (Kp, Vp)) in enumerate(
+                        zip(params["layers"], kv)):
+                    ad = adapters[li] if adapters is not None else None
+                    if not quant_pool:
+                        h, Kp, Vp = fp_layer(lyr, ad, h, Kp, Vp)
+                        new_kv.append((Kp, Vp))
+                        continue
+                    Ks, Vs = kv_scales[li]
+                    h, Kp, Ks, Vp, Vs = int8_layer(lyr, ad, h, Kp, Ks,
+                                                   Vp, Vs)
+                    new_scales.append((Ks, Vs))
+                    new_kv.append((Kp, Vp))
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             verify = h[0, sample_idx.reshape(-1)]       # [R*(K+1), hid]
             logits = _logits(params, verify, cfg) \
@@ -748,7 +822,8 @@ class LLMEngine:
             # per-request (seed, generation position) streams as the
             # per-token path — a request's sampled tokens are identical
             # whether it was served per-token or in bursts.
-            from ..kernels.decode_megakernel import fused_decode_layer
+            from ..kernels.decode_megakernel import (fused_decode_layer,
+                                                     fused_decode_model)
             R = self.max_num_seqs
             B = self.burst_tokens
             rows = jnp.arange(R)
@@ -756,6 +831,17 @@ class LLMEngine:
             gen0 = jnp.zeros((R,), jnp.int32)
             if not quant_pool:
                 kv_scales = ()
+            if scope == "model":
+                # stack the pools ONCE per burst (outside the token
+                # loop); the while_loop then carries the stacked [L,
+                # ...] layout and the scanned body indexes it in place —
+                # the stack/unstack round-trip amortizes over the whole
+                # burst instead of repeating per token
+                kv = (jnp.stack([K for K, _ in kv]),
+                      jnp.stack([V for _, V in kv]))
+                if quant_pool:
+                    kv_scales = (jnp.stack([a for a, _ in kv_scales]),
+                                 jnp.stack([b for _, b in kv_scales]))
 
             def cond(c):
                 i, live = c[0], c[5]
@@ -772,6 +858,69 @@ class LLMEngine:
                 page = jnp.where(live, tbls[rows, page_idx], NULL_PAGE)
                 off = pos % ps
                 att_len = pos + 1       # attention covers the new token
+                if scope == "model":
+                    # ONE launch for the whole model: the fused layer
+                    # body scans over the stacked weights/pools; the
+                    # pool writes stay caller-owned closures so they
+                    # replay the layer-scope appends bit for bit
+                    if quant_pool:
+                        def quant_append_fn(Kp, Ks, Vp, Vs, kc, vc):
+                            Kp, Ks = _quantized_append(
+                                Kp, Ks, jnp.transpose(kc, (1, 0, 2)),
+                                page, off, ps, live)
+                            Vp, Vs = _quantized_append(
+                                Vp, Vs, jnp.transpose(vc, (1, 0, 2)),
+                                page, off, ps, live)
+                            return Kp, Ks, Vp, Vs
+                        h, Kn, Vn, Ksn, Vsn = fused_decode_model(
+                            params["layers"], h, kv[0], kv[1], tbls,
+                            att_len, eps=cfg.rms_norm_eps,
+                            theta=cfg.rope_theta, num_heads=H,
+                            self_kv=False, interpret=mk_interpret,
+                            k_scales=kv_scales[0],
+                            v_scales=kv_scales[1],
+                            quant_append_fn=quant_append_fn)
+                        new_kv = (Kn, Vn)
+                        new_scales = (Ksn, Vsn)
+                    else:
+                        def append_fn(Kp, Vp, kc, vc):
+                            slot = page * ps + off
+                            npages = Kp.shape[1]
+                            kt = jnp.transpose(kc, (1, 0, 2))
+                            vt = jnp.transpose(vc, (1, 0, 2))
+                            Kp = Kp.reshape(Hkv, npages * ps, d) \
+                                .at[:, slot].set(kt) \
+                                .reshape(Hkv, npages, ps, d)
+                            Vp = Vp.reshape(Hkv, npages * ps, d) \
+                                .at[:, slot].set(vt) \
+                                .reshape(Hkv, npages, ps, d)
+                            return Kp, Vp
+                        h, Kn, Vn, _, _ = fused_decode_model(
+                            params["layers"], h, kv[0], kv[1], tbls,
+                            att_len, eps=cfg.rms_norm_eps,
+                            theta=cfg.rope_theta, num_heads=H,
+                            self_kv=True, interpret=mk_interpret,
+                            append_fn=append_fn)
+                        new_kv = (Kn, Vn)
+                        new_scales = None
+                    hn = _rms_norm(h[None], params["norm"],
+                                   cfg.rms_norm_eps)[0]
+                    logits = _logits(params, hn, cfg)        # [R, V]
+                    ok = ok & (jnp.all(jnp.isfinite(logits), axis=-1)
+                               | ~live_in)
+                    keys = request_keys(base_key, seeds, gpos0 + gen,
+                                        FINAL_TAG)
+                    nxt = sample_rows(logits, keys, temps, top_ks,
+                                      top_ps)
+                    out = out.at[:, i].set(jnp.where(live, nxt, 0))
+                    gen = gen + live.astype(jnp.int32)
+                    hit_eos = live & (eos_ids >= 0) & (nxt == eos_ids)
+                    live = live & ~hit_eos & (gen < caps)
+                    kv_lens = kv_lens + live_in.astype(jnp.int32)
+                    tokens = jnp.where(live_in, nxt, tokens)
+                    return (i + 1, tokens, new_kv,
+                            new_scales if quant_pool else kv_scales,
+                            kv_lens, live, gen, out, ok)
                 new_kv, new_scales = [], []
                 for li, (lyr, (Kp, Vp)) in enumerate(
                         zip(params["layers"], kv)):
@@ -845,6 +994,18 @@ class LLMEngine:
                     tuple(kv_scales), kv_lens, live0, gen0, out0,
                     jnp.ones((R,), bool))
             c = jax.lax.while_loop(cond, body, init)
+            if scope == "model":
+                # unstack the carried [L, ...] pools back into the
+                # pool's per-layer list layout (host code indexes it)
+                Kn, Vn = c[2]
+                new_kv = [(Kn[li], Vn[li]) for li in range(num_layers)]
+                if quant_pool:
+                    Ksn, Vsn = c[3]
+                    new_scales = [(Ksn[li], Vsn[li])
+                                  for li in range(num_layers)]
+                else:
+                    new_scales = None
+                return (c[7], c[6], c[8], new_kv, new_scales)
             return (c[7], c[6], c[8], c[2],
                     list(c[3]) if quant_pool else None)
 
@@ -1177,19 +1338,14 @@ class LLMEngine:
         self.metrics.flight_dumps.inc()
         return self.flight.dump(reason, t=self._now(), **detail)
 
-    def ragged_step_hlo(self):
-        """Compiled HLO text of the ONE ragged-step executable, lowered
-        AOT over zero-filled operands at the exact launch shapes — the
-        fusion-forensics surface (tools/bench_probes.probe_hlo_fusion;
-        jit/hlo_forensics.py parses it). Out-of-band by construction:
-        the jit dispatch cache and the trace-count==1 gate are
-        untouched."""
-        import jax.numpy as jnp
+    def _zero_step_args(self):
+        """Zero-filled ragged-step operands at the exact launch shapes
+        (the AOT lowering surface — never dispatched)."""
         T, R, PPS = (self.step_token_budget, self.max_num_seqs,
                      self.max_pages_per_seq)
         K = self.spec_tokens
         z = jnp.zeros
-        args = (self.params, self.pool.kv, self.pool.kv_scales,
+        return (self._step_params, self.pool.kv, self.pool.kv_scales,
                 z((T,), jnp.int32), z((T,), jnp.int32),
                 jnp.full((R, PPS), NULL_PAGE, jnp.int32),
                 jnp.full((R,), T, jnp.int32), z((R,), jnp.int32),
@@ -1200,7 +1356,64 @@ class LLMEngine:
                 self._zero_draft[0], self._zero_draft[1], self._base_key,
                 self.adapters.slab if self.adapters is not None else None,
                 z((T,), jnp.int32) if self.adapters is not None else None)
-        return self._ragged_jit.lower(*args).compile().as_text()
+
+    def _zero_burst_args(self):
+        """Zero-filled burst-step operands at the exact launch shapes."""
+        R, PPS = self.max_num_seqs, self.max_pages_per_seq
+        z = jnp.zeros
+        return (self._step_params, self.pool.kv, self.pool.kv_scales,
+                z((R,), jnp.int32), z((R,), jnp.int32),
+                jnp.full((R, PPS), NULL_PAGE, jnp.int32),
+                z((R,), bool), z((R,), jnp.int32), z((R,), jnp.float32),
+                z((R,), jnp.int32), jnp.ones((R,), jnp.float32),
+                z((R,), jnp.int32), z((R,), jnp.int32),
+                jnp.full((R,), -1, jnp.int32),
+                jnp.asarray(0, jnp.int32), self._base_key)
+
+    def ragged_step_hlo(self):
+        """Compiled HLO text of the ONE ragged-step executable, lowered
+        AOT over zero-filled operands at the exact launch shapes — the
+        fusion-forensics surface (tools/bench_probes.probe_hlo_fusion;
+        jit/hlo_forensics.py parses it). Out-of-band by construction:
+        the jit dispatch cache and the trace-count==1 gate are
+        untouched."""
+        return self._ragged_jit.lower(
+            *self._zero_step_args()).compile().as_text()
+
+    def ragged_step_lowering(self):
+        """UNOPTIMIZED StableHLO of the ragged step — the launch-
+        accounting surface (jit/hlo_forensics.launch_stats): a scanned
+        layer loop appears as ONE body inside ``stablehlo.while``; the
+        unrolled loop appears L times. Pre-optimization by design, so
+        the count is the program's structure, not an XLA fusion
+        decision."""
+        return self._ragged_jit.lower(*self._zero_step_args()).as_text()
+
+    def burst_step_lowering(self):
+        """UNOPTIMIZED StableHLO of the burst executable (the on-device
+        token loop), for the same launch accounting."""
+        return self._burst_jit.lower(*self._zero_burst_args()).as_text()
+
+    def launch_stats(self, burst=False):
+        """jit/hlo_forensics.launch_stats over the step executable's
+        unoptimized lowering, with this engine's marker constants
+        supplied: the fp/int8 ragged layer bodies and the fp burst body
+        carry 2 rms_norm (rsqrt) markers each, the int8 burst body
+        carries 3 (the pre-append prologue norm), and the final norm is
+        the single non-layer marker. ``burst=True`` accounts the burst
+        executable, whose one invocation covers up to ``burst_tokens``
+        tokens per row."""
+        from ..jit.hlo_forensics import launch_stats
+        if burst:
+            return launch_stats(
+                self.burst_step_lowering(),
+                num_layers=self.cfg.num_hidden_layers,
+                markers_per_body=3 if self.pool.quantized else 2,
+                tokens_per_invocation=self.burst_tokens)
+        return launch_stats(
+            self.ragged_step_lowering(),
+            num_layers=self.cfg.num_hidden_layers,
+            markers_per_body=2, tokens_per_invocation=1)
 
     def metrics_snapshot(self) -> dict:
         if self.adapters is not None:
@@ -1239,6 +1452,7 @@ class LLMEngine:
             self.params["layers"][0],
             interpret=self._interpret if self._interpret_explicit
             else None) if self.burst_tokens > 1 else None
+        snap["megakernel_scope"] = self.megakernel_scope
         tok = snap["tokens_generated"]
         snap["host_dispatches_per_token"] = \
             snap["host_dispatches"] / tok if tok else None
@@ -1876,7 +2090,7 @@ class LLMEngine:
             if slot_ids is not None and seq.adapter_slot:
                 slot_ids[q_start:q_start + q_len] = seq.adapter_slot
         out, n_out, finite, new_kv, new_scales = self._ragged_jit(
-            self.params, self.pool.kv, self.pool.kv_scales,
+            self._step_params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
             jnp.asarray(kv_lens), jnp.asarray(sample_idx),
@@ -2004,7 +2218,7 @@ class LLMEngine:
             self._burst_launched = True
             self.metrics.decode_compiles.inc()
         out, gen, ok, new_kv, new_scales = self._burst_jit(
-            self.params, self.pool.kv, self.pool.kv_scales,
+            self._step_params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(kv_lens), jnp.asarray(tbls),
             jnp.asarray(live), jnp.asarray(caps), jnp.asarray(temps),
             jnp.asarray(top_ks), jnp.asarray(top_ps), jnp.asarray(seeds),

@@ -139,7 +139,14 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               "multitenant_isolation_ratio", "multitenant_quota_shed",
               "multitenant_deterministic",
               "multitenant_mixed_batch_identical",
-              "multitenant_hot_swap_compiles"):
+              "multitenant_hot_swap_compiles",
+              # whole-model megakernel fields (ISSUE 18): a
+              # launches-per-token count, scope bit, token-identity
+              # verdict or compiled fusion/kernel count is a per-run
+              # structural proof
+              "mk_model_scope", "mk_launches_per_token",
+              "mk_burst_launches_per_token", "mk_token_identity",
+              "mk_serving_fusions", "mk_serving_kernels"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -642,6 +649,56 @@ def test_proxy_bench_catches_disabled_fairness():
     assert out["multitenant_isolation_ratio"] is None
     assert out["multitenant_quota_shed"] is None
     assert "multitenant_probe_error" in out
+
+
+def test_proxy_bench_catches_forced_per_layer_scope():
+    """End-to-end megakernel regression injection (ISSUE 18): run the
+    megakernel probe with the measured engine FORCED back to layer
+    scope (--per-layer) and gate against the checked-in baseline —
+    the scope bit reads 0, launches per token rise from 1.0 to
+    num_layers, the burst ratio triples, the compiled ragged step's
+    fusion/kernel counts rise; five gates fail. The healthy collection
+    of the same probe must pass with the layer body appearing ONCE in
+    the program and tokens bitwise identical between scopes."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("megakernel",), megakernel_per_layer=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "mk_model_scope" in names
+    assert "mk_launches_per_token" in names
+    assert "mk_burst_launches_per_token" in names
+    assert "mk_serving_fusions" in names
+    assert "mk_serving_kernels" in names
+    assert bad["metrics"]["mk_model_scope"] == 0
+    assert bad["metrics"]["mk_launches_per_token"] > 1.0
+    # the rc-level contract CI keys off: --per-layer flips main to 1
+    import unittest.mock as _mock
+    with _mock.patch.object(pb, "collect",
+                            lambda probes=pb.PROBES, **kw: bad):
+        assert pb.main(["--probes", "megakernel", "--compare",
+                        pb.BASELINE_PATH]) == 1
+
+    good = pb.collect(probes=("megakernel",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["mk_model_scope"] == 1
+    assert good["metrics"]["mk_launches_per_token"] == 1.0
+    assert good["metrics"]["mk_burst_launches_per_token"] < 1.0
+    assert good["metrics"]["mk_token_identity"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_megakernel(Boom())
+    assert out["mk_launches_per_token"] is None
+    assert out["mk_token_identity"] is None
+    assert "megakernel_probe_error" in out
 
 
 def test_proxy_bench_catches_disabled_kv_prefetch():

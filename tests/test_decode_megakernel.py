@@ -165,7 +165,8 @@ def test_head_group_split_matches(monkeypatch):
     tuner = get_autotuner()
     key = tuner._key(("decode_megakernel", h.shape[0], h.shape[1],
                       kw["num_heads"], Kp.shape[0], Kp.shape[3],
-                      tbls.shape[1], Kp.shape[2], "fp", True, False))
+                      tbls.shape[1], Kp.shape[2], "fp", True, False,
+                      "layer", 1))
     monkeypatch.setenv("PADDLE_TPU_AUTOTUNE", "1")
     tuner.cache[key] = {"head_groups": 2}
     try:
@@ -678,3 +679,441 @@ def test_burst_flag_feeds_engine_and_generator_defaults(tiny_model):
         assert (out == ref).all()
     finally:
         GLOBAL_FLAGS.set("decode_burst_tokens", old)
+
+
+# ---------------------------------------------------------------------------
+# whole-model scope (ISSUE 18): fused_decode_model + the engine scan
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.kernels.decode_megakernel import (fused_decode_model,
+                                                  megakernel_fallback_tripped,
+                                                  reset_megakernel_fallback,
+                                                  stack_layer_params)
+
+
+@pytest.fixture(scope="module")
+def deep_model():
+    """A 3-layer micro model: deep enough that the layer loop's
+    structure (unrolled vs scanned) is observable, small enough for the
+    CPU tier."""
+    paddle.seed(7)
+    cfg = llama_tiny_config(num_hidden_layers=3, hidden_size=64,
+                            intermediate_size=96, num_attention_heads=4,
+                            num_key_value_heads=2, vocab_size=128)
+    return LlamaForCausalLM(cfg)
+
+
+def _model_fixture(L=3, seed0=20):
+    """L independent layer fixtures sharing one request geometry: the
+    first fixture's h / tables / kv_lens, per-layer weights and pages."""
+    layers, Kps, Vps = [], [], []
+    h = tbls = kv_lens = kw = None
+    for li in range(L):
+        layer, h_i, Kp, Vp, tbls_i, kv_lens_i, kw_i = _layer_fixture(
+            seed=seed0 + li)
+        layers.append(layer)
+        Kps.append(Kp)
+        Vps.append(Vp)
+        if li == 0:
+            h, tbls, kv_lens, kw = h_i, tbls_i, kv_lens_i, kw_i
+    return layers, h, jnp.stack(Kps), jnp.stack(Vps), tbls, kv_lens, kw
+
+
+def _slot_append(tbls, kv_lens, ps):
+    """The caller-owned pool write both scopes share: scatter each
+    row's current (k, v) at its (page, offset) slot."""
+    R = kv_lens.shape[0]
+    page = tbls[jnp.arange(R), kv_lens // ps]
+    off = kv_lens % ps
+    slot = page * ps + off
+
+    def append_fn(Kp, Vp, kc, vc):
+        P = Kp.shape[1]
+        kt, vt = jnp.transpose(kc, (1, 0, 2)), jnp.transpose(vc, (1, 0, 2))
+        Kp = Kp.reshape(Kp.shape[0], P * ps, -1).at[:, slot].set(kt) \
+            .reshape(Kp.shape[0], P, ps, -1)
+        Vp = Vp.reshape(Vp.shape[0], P * ps, -1).at[:, slot].set(vt) \
+            .reshape(Vp.shape[0], P, ps, -1)
+        return Kp, Vp
+    return append_fn
+
+
+def test_fused_model_fp_self_kv_matches_layer_loop():
+    """The scanned whole-model body == the python loop over
+    fused_decode_layer with the same caller-owned appends: the collapse
+    is a launch-count change, never a numerics change."""
+    layers, h, Kst, Vst, tbls, kv_lens, kw = _model_fixture()
+    ps = int(Kst.shape[3])
+    append_fn = _slot_append(tbls, kv_lens, ps)
+
+    href = h
+    Kref = [Kst[li] for li in range(3)]
+    Vref = [Vst[li] for li in range(3)]
+    for li in range(3):
+        href, kc, vc = fused_decode_layer(
+            layers[li], href, Kref[li], Vref[li], tbls, kv_lens,
+            self_kv=True, interpret=True, **kw)
+        Kref[li], Vref[li] = append_fn(Kref[li], Vref[li], kc, vc)
+
+    stacked = stack_layer_params(layers)
+    hout, Kn, Vn, ksn, vsn = fused_decode_model(
+        stacked, h, Kst, Vst, tbls, kv_lens, self_kv=True,
+        interpret=True, append_fn=append_fn, **kw)
+    assert ksn is None and vsn is None
+    # the scan compiles (lax.scan is a primitive) while the reference
+    # loop runs op-by-op, so tolerance-parity is the contract here;
+    # BITWISE identity is gated at the engine level, where both scopes
+    # run under the same jit
+    np.testing.assert_allclose(np.asarray(hout), np.asarray(href),
+                               rtol=1e-4, atol=1e-4)
+    for li in range(3):
+        np.testing.assert_allclose(np.asarray(Kn[li]),
+                                   np.asarray(Kref[li]),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(Vn[li]),
+                                   np.asarray(Vref[li]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_model_int8_weights_stack_and_match():
+    """LayerStack-stacked QuantizedWeight layers (int8 payload + scales
+    stacked leaf-wise) scan to the same result as the per-layer loop."""
+    from paddle_tpu.quantization.low_bit import quantize_params
+    layers, h, Kst, Vst, tbls, kv_lens, kw = _model_fixture(seed0=30)
+    D = h.shape[1]
+    qp = quantize_params({"embed": jnp.zeros((8, D), jnp.float32),
+                          "norm": jnp.ones((D,), jnp.float32),
+                          "layers": layers}, "weight_only_int8")
+    qlayers = qp["layers"]
+    ps = int(Kst.shape[3])
+    append_fn = _slot_append(tbls, kv_lens, ps)
+
+    href = h
+    Kref = [Kst[li] for li in range(3)]
+    Vref = [Vst[li] for li in range(3)]
+    for li in range(3):
+        href, kc, vc = fused_decode_layer(
+            qlayers[li], href, Kref[li], Vref[li], tbls, kv_lens,
+            self_kv=True, interpret=True, **kw)
+        Kref[li], Vref[li] = append_fn(Kref[li], Vref[li], kc, vc)
+
+    hout, Kn, Vn, _, _ = fused_decode_model(
+        stack_layer_params(qlayers), h, Kst, Vst, tbls, kv_lens,
+        self_kv=True, interpret=True, append_fn=append_fn, **kw)
+    np.testing.assert_allclose(np.asarray(hout), np.asarray(href),
+                               rtol=1e-4, atol=1e-4)
+    for li in range(3):
+        np.testing.assert_allclose(np.asarray(Kn[li]),
+                                   np.asarray(Kref[li]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_model_int8_kv_quant_append_matches_layer_loop():
+    """The append-first int8-KV path: the scanned body's in-scan
+    prologue (rms -> k/v proj -> rope) + quantized append + attention
+    over the updated pages equals the per-layer sequence."""
+    from paddle_tpu.models.generation import _rms_norm, _rope, _wmat
+    from paddle_tpu.serving.engine import _quantized_append
+    layers, h, Kst, Vst, tbls, kv_lens, kw = _model_fixture(seed0=40)
+    rng = np.random.default_rng(9)
+    L, Hkv, P, ps, dh = (int(Kst.shape[0]), int(Kst.shape[1]),
+                         int(Kst.shape[2]), int(Kst.shape[3]),
+                         int(Kst.shape[4]))
+    R, D = h.shape
+    scales = jnp.asarray(
+        np.abs(rng.standard_normal((2, L, Hkv, P))) * 0.01 + 0.005,
+        jnp.float32)
+    Ksc, Vsc = scales[0], scales[1]
+    Kq = jnp.clip(jnp.round(Kst / Ksc[:, :, :, None, None]),
+                  -127, 127).astype(jnp.int8)
+    Vq = jnp.clip(jnp.round(Vst / Vsc[:, :, :, None, None]),
+                  -127, 127).astype(jnp.int8)
+    page = tbls[jnp.arange(R), (kv_lens - 1) // ps]
+    off = (kv_lens - 1) % ps
+    live = jnp.ones((R,), bool)
+
+    def quant_append_fn(Kp, Ks, Vp, Vs, kc, vc):
+        Kp, Ks = _quantized_append(Kp, Ks, jnp.transpose(kc, (1, 0, 2)),
+                                   page, off, ps, live)
+        Vp, Vs = _quantized_append(Vp, Vs, jnp.transpose(vc, (1, 0, 2)),
+                                   page, off, ps, live)
+        return Kp, Ks, Vp, Vs
+
+    pos = jnp.maximum(kv_lens - 1, 0)
+    href = h
+    Kref = [Kq[li] for li in range(L)]
+    Vref = [Vq[li] for li in range(L)]
+    Ksr = [Ksc[li] for li in range(L)]
+    Vsr = [Vsc[li] for li in range(L)]
+    for li in range(L):
+        x = _rms_norm(href[None], layers[li]["ln1"], kw["eps"])[0]
+        kc = _rope(_wmat(x, layers[li]["k"]).reshape(R, Hkv, dh)[None],
+                   pos[None], kw["theta"], dh)[0]
+        vc = _wmat(x, layers[li]["v"]).reshape(R, Hkv, dh)
+        Kref[li], Ksr[li], Vref[li], Vsr[li] = quant_append_fn(
+            Kref[li], Ksr[li], Vref[li], Vsr[li], kc, vc)
+        href, _, _ = fused_decode_layer(
+            layers[li], href, Kref[li], Vref[li], tbls, kv_lens,
+            self_kv=False, interpret=True, k_scales=Ksr[li],
+            v_scales=Vsr[li], **kw)
+
+    hout, Kn, Vn, Ksn, Vsn = fused_decode_model(
+        stack_layer_params(layers), h, Kq, Vq, tbls, kv_lens,
+        self_kv=False, interpret=True, k_scales=Ksc, v_scales=Vsc,
+        quant_append_fn=quant_append_fn, **kw)
+    np.testing.assert_allclose(np.asarray(hout), np.asarray(href),
+                               rtol=1e-4, atol=1e-4)
+    for li in range(L):
+        # int8 codes may flip one rounding step under compiled-vs-eager
+        # float drift; the scale columns track to float tolerance
+        assert np.abs(np.asarray(Kn[li], np.int32)
+                      - np.asarray(Kref[li], np.int32)).max() <= 1
+        np.testing.assert_allclose(np.asarray(Ksn[li]),
+                                   np.asarray(Ksr[li]),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_fused_model_argument_contract():
+    layers, h, Kst, Vst, tbls, kv_lens, kw = _model_fixture()
+    with pytest.raises(ValueError, match="append_fn"):
+        fused_decode_model(stack_layer_params(layers), h, Kst, Vst,
+                           tbls, kv_lens, self_kv=True, interpret=True,
+                           **kw)
+    with pytest.raises(ValueError, match="quant_append_fn"):
+        fused_decode_model(stack_layer_params(layers), h, Kst, Vst,
+                           tbls, kv_lens, self_kv=False, interpret=True,
+                           **kw)
+    with pytest.raises(ValueError):
+        stack_layer_params([])
+
+
+# ---------------------------------------------------------------------------
+# engine + generator: layer-scope vs model-scope token identity
+# ---------------------------------------------------------------------------
+
+def test_generator_model_scope_token_identical(deep_model):
+    prompt = _prompts(deep_model, [5], seed=0)[0]
+    ids = paddle.to_tensor(np.asarray(prompt)[None], dtype="int64")
+    for kw in (dict(temperature=0.0),
+               dict(temperature=0.8, top_k=13, seed=3)):
+        for burst in (1, 4):
+            ref = Generator(deep_model, max_len=64).generate(
+                ids, max_new_tokens=10, burst_tokens=burst, **kw).numpy()
+            out = Generator(deep_model, max_len=64,
+                            megakernel_scope="model").generate(
+                ids, max_new_tokens=10, burst_tokens=burst, **kw).numpy()
+            assert (out == ref).all(), (kw, burst)
+
+
+def test_engine_model_scope_token_identical_fp_and_int8(deep_model):
+    prompts = _prompts(deep_model, [3, 5, 24], seed=11)
+    for kw in ({}, {"quantized_mode": "weight_only_int8",
+                    "kv_cache_dtype": "int8"}):
+        for burst in ({}, {"burst_tokens": 4}):
+            merged = dict(kw, chunk_size=8, **burst)
+            ref, _ = _run_engine(deep_model, prompts, **merged)
+            out, eng = _run_engine(deep_model, prompts,
+                                   megakernel_scope="model", **merged)
+            assert out == ref, (kw, burst)
+            assert eng.megakernel_scope == "model"
+    snap = eng.metrics_snapshot()
+    assert snap["megakernel_scope"] == "model"
+    assert snap["decode_cache_size"] == 1     # ragged gate unaffected
+
+
+def test_engine_model_scope_spec_decode_identity(deep_model):
+    """Spec-decode verification rounds ride the scanned ragged
+    executable: drafts + rollbacks stay token-identical across scopes."""
+    prompt = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6, 7]
+
+    def run(scope):
+        eng = LLMEngine(deep_model, max_len=64, page_size=4,
+                        max_num_seqs=2, draft_model=deep_model,
+                        spec_tokens=2, megakernel_scope=scope)
+        rid = eng.add_request(prompt, max_new_tokens=10)
+        return eng.run(max_steps=300)[rid].token_ids, eng
+
+    ref, _ = run("layer")
+    out, eng = run("model")
+    assert out == ref
+    assert eng.decode_cache_size() == 1
+
+
+def test_engine_model_scope_preemption_and_prefix_fork(deep_model):
+    """Page-pressure preemption + prefix forks (shared pages, CoW
+    tails) behave identically under the scanned step."""
+    prefix = _prompts(deep_model, [16], seed=13)[0]
+    tails = _prompts(deep_model, [2, 3], seed=14)
+
+    def run(scope):
+        eng = LLMEngine(deep_model, max_len=64, page_size=4,
+                        max_num_seqs=4, num_pages=28, chunk_size=32,
+                        megakernel_scope=scope)
+        donor = eng.add_request(prefix, max_new_tokens=8)
+        eng.step(); eng.step()
+        rids = [donor] + [eng.add_request(prefix + t, max_new_tokens=8)
+                          for t in tails]
+        outs = eng.run(max_steps=500)
+        return [outs[r].token_ids for r in rids], eng
+
+    ref, _ = run("layer")
+    out, eng = run("model")
+    assert out == ref
+    assert eng.metrics_snapshot()["megakernel_scope"] == "model"
+
+
+def test_engine_model_scope_prefetch_overlap_gate(deep_model):
+    """The two-tier KVPrefetcher must still overlap restores under the
+    longer-running scanned step: over-capacity HBM + host arena at
+    model scope serves token-identically to layer scope with prefetch
+    hits landing and ZERO steady-state stalls."""
+    prompts = _prompts(deep_model, [6, 8, 40, 44], seed=17)
+    kw = dict(max_new=16, num_pages=16, host_kv_pages=64,
+              chunk_size=16)
+    ref, eref = _run_engine(deep_model, prompts, **kw)
+    out, eng = _run_engine(deep_model, prompts,
+                           megakernel_scope="model", **kw)
+    assert out == ref
+    snap = eng.metrics_snapshot()
+    assert snap["kv_spills"] > 0, "not over capacity: gate is vacuous"
+    assert snap["kv_prefetch_hits"] > 0
+    assert snap["kv_prefetch_stalls"] == 0
+
+
+# ---------------------------------------------------------------------------
+# launch accounting: the collapse is structural, not asserted
+# ---------------------------------------------------------------------------
+
+def test_engine_launch_stats_collapse(deep_model):
+    el = LLMEngine(deep_model, max_len=32, page_size=4)
+    em = LLMEngine(deep_model, max_len=32, page_size=4,
+                   megakernel_scope="model")
+    sl, sm = el.launch_stats(), em.launch_stats()
+    assert sl["layer_body_sites"] == 3 and not sl["collapsed"]
+    assert sl["launches_per_token"] == 3.0
+    assert sm["layer_body_sites"] == 1 and sm["collapsed"]
+    assert sm["launches_per_token"] == 1.0
+
+
+def test_engine_burst_launch_stats_collapse(deep_model):
+    em = LLMEngine(deep_model, max_len=32, page_size=4, burst_tokens=4,
+                   megakernel_scope="model")
+    s = em.launch_stats(burst=True)
+    assert s["collapsed"] and s["launches_per_token"] == 0.25
+    el = LLMEngine(deep_model, max_len=32, page_size=4, burst_tokens=4)
+    s = el.launch_stats(burst=True)
+    assert not s["collapsed"] and s["launches_per_token"] == 0.75
+
+
+def test_engine_launch_stats_int8_burst_body(deep_model):
+    """The int8 burst body carries the pre-append prologue's extra
+    rms_norm: launch_stats' markers_per_body accounting must decompose
+    it rather than mis-divide."""
+    em = LLMEngine(deep_model, max_len=32, page_size=4, burst_tokens=4,
+                   quantized_mode="weight_only_int8",
+                   kv_cache_dtype="int8", megakernel_scope="model")
+    s = em.launch_stats(burst=True)
+    assert s["collapsed"] and s["launches_per_token"] == 0.25
+    sm = em.launch_stats()
+    assert sm["collapsed"] and sm["launches_per_token"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# scope flag + autotune-key provenance + fallback honesty
+# ---------------------------------------------------------------------------
+
+def test_scope_flag_validates_via_on_set_rollback():
+    old = GLOBAL_FLAGS.get("decode_megakernel_scope")
+    try:
+        with pytest.raises(ValueError, match="decode_megakernel_scope"):
+            set_flags({"decode_megakernel_scope": "kernel"})
+        assert GLOBAL_FLAGS.get("decode_megakernel_scope") == old
+        set_flags({"decode_megakernel_scope": "model"})
+        assert GLOBAL_FLAGS.get("decode_megakernel_scope") == "model"
+    finally:
+        GLOBAL_FLAGS.set("decode_megakernel_scope", old)
+
+
+def test_scope_flag_feeds_engine_and_generator_defaults(deep_model):
+    old = GLOBAL_FLAGS.get("decode_megakernel_scope")
+    try:
+        set_flags({"decode_megakernel_scope": "model"})
+        eng = LLMEngine(deep_model, max_len=32, page_size=4)
+        assert eng.megakernel_scope == "model"
+        gen = Generator(deep_model, max_len=64)
+        assert gen.megakernel_scope == "model"
+        prompt = _prompts(deep_model, [5], seed=25)[0]
+        ids = paddle.to_tensor(np.asarray(prompt)[None], dtype="int64")
+        out = gen.generate(ids, max_new_tokens=8, burst_tokens=1).numpy()
+        set_flags({"decode_megakernel_scope": "layer"})
+        ref = Generator(deep_model, max_len=64).generate(
+            ids, max_new_tokens=8, burst_tokens=1).numpy()
+        assert (out == ref).all()
+    finally:
+        GLOBAL_FLAGS.set("decode_megakernel_scope", old)
+
+
+def test_autotune_key_separates_scope_and_stacked_geometry(monkeypatch):
+    """Layer-scope and model-scope tunings must never share a cache
+    line: the key carries the scan scope AND the stacked depth."""
+    import paddle_tpu.kernels.autotune as at
+    layer, h, Kp, Vp, tbls, kv_lens, kw = _layer_fixture()
+    seen = []
+    monkeypatch.setattr(at, "autotune_enabled", lambda: True)
+
+    def record(key, requested, candidates, build_fn, traced=False):
+        seen.append(key)
+        return requested
+    monkeypatch.setattr(at, "pick_cached", record)
+
+    fused_decode_layer(layer, h, Kp, Vp, tbls, kv_lens, self_kv=True,
+                       interpret=True, **kw)
+    fused_decode_layer(layer, h, Kp, Vp, tbls, kv_lens, self_kv=True,
+                       interpret=True, scope="model", num_layers=3, **kw)
+    fused_decode_layer(layer, h, Kp, Vp, tbls, kv_lens, self_kv=True,
+                       interpret=True, scope="model", num_layers=5, **kw)
+    assert len(seen) == 3
+    assert len(set(seen)) == 3, seen
+    assert seen[0][-2:] == ("layer", 1)
+    assert seen[1][-2:] == ("model", 3)
+    assert seen[2][-2:] == ("model", 5)
+    # everything BUT the provenance suffix is the same geometry
+    assert seen[0][:-2] == seen[1][:-2] == seen[2][:-2]
+
+
+def test_megakernel_mode_reports_jnp_after_tripped_fallback(monkeypatch):
+    """Satellite honesty fix: when FLAGS_enable_fusion_fallback forced
+    the jnp body at run time, megakernel_mode must say ``jnp`` — not
+    echo the environment's kernel selection — until the trip is reset."""
+    import paddle_tpu.kernels.decode_megakernel as dm
+    monkeypatch.setenv("PADDLE_TPU_FORCE_PALLAS", "1")
+    reset_megakernel_fallback()
+    assert not megakernel_fallback_tripped()
+    assert megakernel_mode() == "interpret"
+
+    layer, h, Kp, Vp, tbls, kv_lens, kw = _layer_fixture()
+    ref = _reference_layer(layer, h, Kp, Vp, tbls, kv_lens, self_kv=True,
+                           k_scales=None, v_scales=None, **kw)
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated pallas lowering failure")
+    monkeypatch.setattr(dm.pl, "pallas_call", boom)
+    try:
+        out = fused_decode_layer(layer, h, Kp, Vp, tbls, kv_lens,
+                                 self_kv=True, interpret=True, **kw)
+        # the fallback still computed the right answer...
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                                   rtol=1e-5, atol=1e-5)
+        # ...and the mode now admits the reroute
+        assert megakernel_fallback_tripped()
+        assert megakernel_mode() == "jnp"
+        # with the fallback flag off, the trip is not a reroute promise
+        old = GLOBAL_FLAGS.get("enable_fusion_fallback")
+        try:
+            GLOBAL_FLAGS.set("enable_fusion_fallback", False)
+            assert megakernel_mode() == "interpret"
+        finally:
+            GLOBAL_FLAGS.set("enable_fusion_fallback", old)
+    finally:
+        reset_megakernel_fallback()
+    assert megakernel_mode() == "interpret"

@@ -159,3 +159,67 @@ def test_fusion_barrier_flag_splits_the_region(tiny_model):
     assert split["fusion_count"] > base["fusion_count"]
     assert split["kernel_count"] > base["kernel_count"]
     assert split["fusion_bytes_total"] > base["fusion_bytes_total"]
+
+
+# ---------------------------------------------------------------------------
+# launch accounting (ISSUE 18): launches_per_token over unoptimized
+# lowerings
+# ---------------------------------------------------------------------------
+
+def _program(markers):
+    lines = ["module @jit_step {"]
+    lines += ['  %x = "stablehlo.rsqrt"(%a) : (f32) -> f32'] * markers
+    lines += ['  %y = "stablehlo.add"(%a, %b) : (f32, f32) -> f32',
+              "}"]
+    return "\n".join(lines)
+
+
+def test_launch_stats_unrolled_vs_collapsed():
+    from paddle_tpu.jit.hlo_forensics import launch_stats
+    # unrolled: L=4 bodies x 2 markers + 1 final-norm marker
+    s = launch_stats(_program(9), num_layers=4)
+    assert s["marker_count"] == 9
+    assert s["layer_body_sites"] == 4
+    assert s["launches_per_token"] == 4.0
+    assert not s["collapsed"]
+    # scanned: ONE body site regardless of depth
+    s = launch_stats(_program(3), num_layers=4)
+    assert s["layer_body_sites"] == 1
+    assert s["launches_per_token"] == 1.0
+    assert s["collapsed"]
+
+
+def test_launch_stats_burst_amortization():
+    from paddle_tpu.jit.hlo_forensics import launch_stats
+    s = launch_stats(_program(3), num_layers=4, tokens_per_invocation=8)
+    assert s["launches_per_token"] == 0.125
+    assert s["collapsed"]
+    # the int8 burst body carries an extra pre-append prologue norm
+    s = launch_stats(_program(4), num_layers=4, markers_per_body=3,
+                     tokens_per_invocation=8)
+    assert s["layer_body_sites"] == 1 and s["launches_per_token"] == 0.125
+
+
+def test_launch_stats_refuses_to_fabricate():
+    """A marker count inconsistent with the constants means the traced
+    body changed — mis-dividing would fabricate a launch count."""
+    import pytest
+    from paddle_tpu.jit.hlo_forensics import launch_stats
+    with pytest.raises(ValueError, match="do not decompose"):
+        launch_stats(_program(4), num_layers=4)        # (4-1) % 2 != 0
+    with pytest.raises(ValueError, match="do not decompose"):
+        launch_stats(_program(0), num_layers=4)        # fewer than overhead
+
+
+def test_engine_lowering_matches_marker_model(tiny_model):
+    """The marker constants against the REAL engine lowerings: fp
+    ragged body carries exactly 2 rsqrt sites per layer + 1 final norm,
+    and the model-scope scan collapses the per-layer sites to one."""
+    import re
+    from paddle_tpu.serving import LLMEngine
+    eng = LLMEngine(tiny_model, max_len=32, page_size=4)
+    n_markers = len(re.findall(r"\brsqrt\b", eng.ragged_step_lowering()))
+    L = tiny_model.config.num_hidden_layers
+    assert n_markers == 2 * L + 1
+    s = eng.launch_stats()
+    assert s["layer_body_sites"] == L

@@ -1257,6 +1257,84 @@ def probe_multitenant(paddle, fairness=True):
                 "multitenant_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
+    """Measured whole-model decode-megakernel fields (kernels/
+    decode_megakernel.py ``fused_decode_model`` + the engine's scanned
+    ragged step) — ISSUE 18's launch-collapse gates, all structural
+    counts over UNOPTIMIZED lowerings plus one compiled module.
+
+    A micro 3-layer engine is built at ``megakernel_scope="model"``
+    (the scan-over-layers path) and its launch accounting read through
+    ``LLMEngine.launch_stats()`` (jit/hlo_forensics.py): the decoder
+    layer body must appear ONCE in the ragged step's program —
+    ``mk_launches_per_token`` == 1.0 regardless of depth — and once in
+    the burst executable, whose single invocation covers
+    ``burst_tokens`` tokens per row: ``mk_burst_launches_per_token``
+    == 1/burst_tokens. A second layer-scope engine serves the same
+    seeded request wave and ``mk_token_identity`` is 1 iff every
+    request's tokens are bitwise identical between scopes — the
+    collapse must be a pure launch-count win, never a numerics change.
+    ``mk_serving_fusions``/``mk_serving_kernels`` are the COMPILED
+    ragged step's fusion forensics at model scope: the prefill-side
+    prologue/epilogue chains now appear once (inside the scan body)
+    instead of once per layer, so these absolute counts are pinned
+    one-sided like the hlo_serving_* family.
+    ``per_layer=True`` (the proxy-bench ``--per-layer`` regression
+    hook) forces the measured engine back to layer scope:
+    ``mk_model_scope`` reads 0, launches/token rise to num_layers, the
+    compiled counts rise — the gates must catch all of it.
+    """
+    import numpy as _np
+    try:
+        from paddle_tpu.jit.hlo_forensics import fusion_stats
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        from paddle_tpu.serving import LLMEngine
+        cfg = llama_tiny_config(
+            num_hidden_layers=3, hidden_size=64, intermediate_size=96,
+            num_attention_heads=4, num_key_value_heads=2, vocab_size=128)
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        scope = "layer" if per_layer else "model"
+        rng = _np.random.default_rng(0)
+        prompts = [rng.integers(0, 128, (n,)).tolist()
+                   for n in (5, 9, 3, 12)]
+
+        def run(mk_scope, burst=None):
+            eng = LLMEngine(model, max_len=64, page_size=8,
+                            max_num_seqs=4, megakernel_scope=mk_scope,
+                            **({"burst_tokens": burst} if burst else {}))
+            for i, p in enumerate(prompts):
+                eng.add_request(p, max_new_tokens=6,
+                                temperature=0.8 if i % 2 else 0.0,
+                                top_k=17, seed=i)
+            eng.run(max_steps=300)
+            return ({r: o.token_ids for r, o in eng.outputs().items()},
+                    eng)
+
+        toks, eng = run(scope)
+        ref_toks, _ = run("layer")
+        _, engb = run(scope, burst=burst_tokens)
+        compiled = fusion_stats(eng.ragged_step_hlo())
+        return {
+            "mk_model_scope": int(eng.megakernel_scope == "model"),
+            "mk_launches_per_token": round(
+                eng.launch_stats()["launches_per_token"], 4),
+            "mk_burst_launches_per_token": round(
+                engb.launch_stats(burst=True)["launches_per_token"], 4),
+            "mk_token_identity": int(toks == ref_toks),
+            "mk_serving_fusions": compiled["fusion_count"],
+            "mk_serving_kernels": compiled["kernel_count"],
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"mk_model_scope": None,
+                "mk_launches_per_token": None,
+                "mk_burst_launches_per_token": None,
+                "mk_token_identity": None,
+                "mk_serving_fusions": None,
+                "mk_serving_kernels": None,
+                "megakernel_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_kv_accounting():
     """Pure byte accounting (no device work): pool bytes one cached
     token occupies for fp32 vs int8 pools at a fixed reference geometry
@@ -1288,7 +1366,7 @@ __all__ = ["probe_cluster", "probe_disagg", "probe_gspmd",
            "probe_hlo_fusion",
            "probe_input_pipeline",
            "probe_jaxpr", "probe_kv_accounting", "probe_kv_tiering",
-           "probe_multitenant",
+           "probe_megakernel", "probe_multitenant",
            "probe_opt_dispatches",
            "probe_persistence",
            "probe_serving", "probe_spec_decode", "probe_telemetry",

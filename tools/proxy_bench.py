@@ -49,7 +49,15 @@ This harness runs the measurements that DON'T need a chip and are
   weighted-fair admission, exact quota-shed counts, byte-reproducible
   tenant reports, mixed-batch LoRA token identity over the int8 base,
   and adapter hot-swap with zero recompiles (``--no-fairness`` is the
-  injected regression: bare FIFO over the same flood).
+  injected regression: bare FIFO over the same flood);
+- ``mk_*`` — the whole-model decode megakernel's launch-collapse
+  contracts (kernels/decode_megakernel.py ``fused_decode_model``): the
+  decoder layer body appears ONCE in the ragged step's program
+  (launches/token == 1.0 regardless of depth) and once per burst
+  executable (1/burst_tokens), tokens stay bitwise identical to layer
+  scope, and the compiled ragged step's fusion/kernel counts are
+  pinned (``--per-layer`` is the injected regression: scope forced
+  back to layer, launches/token rise to num_layers).
 
 Each metric gates against a checked-in per-backend baseline
 (tools/proxy_bench_baseline.json) with a direction and tolerance from
@@ -90,7 +98,7 @@ BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
 PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
           "jaxpr", "accounting", "fusion", "tracing", "telemetry",
-          "persist", "kvtier", "disagg", "multitenant")
+          "persist", "kvtier", "disagg", "multitenant", "megakernel")
 
 
 class Gate:
@@ -269,6 +277,23 @@ GATES = {
     "multitenant_deterministic": Gate("lower", 0.0, 0.0),
     "multitenant_mixed_batch_identical": Gate("lower", 0.0, 0.0),
     "multitenant_hot_swap_compiles": Gate("higher", 0.0, 0.0),
+    # whole-model decode megakernel (kernels/decode_megakernel.py
+    # fused_decode_model via probe_megakernel): the decoder layer body
+    # must appear ONCE in the ragged step's program (launches/token
+    # == 1.0 regardless of depth) and once in the burst executable
+    # (1/burst_tokens per token), the engine must actually be at model
+    # scope, tokens must stay bitwise identical to layer scope, and
+    # the COMPILED ragged step's fusion/kernel counts are pinned
+    # one-sided (the scanned prologue/epilogue chains appear once, not
+    # once per layer). --per-layer forces the measured engine back to
+    # layer scope: scope reads 0, launches/token rise to num_layers,
+    # the compiled counts rise — five of the six gates must catch it.
+    "mk_model_scope":            Gate("lower", 0.0, 0.0),
+    "mk_launches_per_token":     Gate("higher", 0.0, 0.0),
+    "mk_burst_launches_per_token": Gate("higher", 0.0, 0.0),
+    "mk_token_identity":         Gate("lower", 0.0, 0.0),
+    "mk_serving_fusions":        Gate("higher", 0.0, 0.0),
+    "mk_serving_kernels":        Gate("higher", 0.0, 0.0),
 }
 
 
@@ -276,7 +301,8 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             gspmd_dp_only=False, cluster_retry_budget=2,
             fusion_defuse=False, telemetry_burn_alerts=True,
             persist_corrupt=False, kvtier_prefetch=True,
-            disagg_colocated=False, multitenant_fairness=True) -> dict:
+            disagg_colocated=False, multitenant_fairness=True,
+            megakernel_per_layer=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -323,6 +349,13 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     toward 1; the ``multitenant_quota_shed``,
     ``multitenant_good_ttft_p99_s``, and
     ``multitenant_isolation_ratio`` gates must all catch it.
+    ``megakernel_per_layer=True`` (--per-layer) forces the megakernel
+    probe's measured engine back to layer scope: ``mk_model_scope``
+    reads 0, launches per token rise from 1.0 to num_layers, the
+    compiled ragged step's fusion/kernel counts rise — the
+    ``mk_model_scope``/``mk_launches_per_token``/
+    ``mk_burst_launches_per_token``/``mk_serving_*`` gates must all
+    catch it.
     """
     import jax
     import paddle_tpu as paddle
@@ -331,6 +364,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
                                     probe_kv_accounting,
+                                    probe_megakernel,
                                     probe_multitenant,
                                     probe_opt_dispatches,
                                     probe_kv_tiering,
@@ -421,6 +455,11 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                "multitenant_deterministic",
                "multitenant_mixed_batch_identical",
                "multitenant_hot_swap_compiles"))
+    if "megakernel" in probes:
+        _take(probe_megakernel(paddle, per_layer=megakernel_per_layer),
+              ("mk_model_scope", "mk_launches_per_token",
+               "mk_burst_launches_per_token", "mk_token_identity",
+               "mk_serving_fusions", "mk_serving_kernels"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -522,6 +561,12 @@ def main(argv=None) -> int:
                          "the fleet prefix cache never hits, and the "
                          "TTFT ratio collapses to ~1 (the injected "
                          "regression)")
+    ap.add_argument("--per-layer", action="store_true",
+                    help="force the megakernel probe's measured engine "
+                         "back to layer scope: launches per token rise "
+                         "from 1.0 to num_layers and the compiled "
+                         "fusion/kernel counts rise (the injected "
+                         "regression)")
     ap.add_argument("--no-fairness", action="store_true",
                     help="serve the multitenant probe's noisy-neighbor "
                          "flood with no tenant policy (bare FIFO): "
@@ -557,7 +602,8 @@ def main(argv=None) -> int:
                       persist_corrupt=args.corrupt_checkpoint,
                       kvtier_prefetch=not args.no_prefetch,
                       disagg_colocated=args.colocated,
-                      multitenant_fairness=not args.no_fairness)
+                      multitenant_fairness=not args.no_fairness,
+                      megakernel_per_layer=args.per_layer)
 
     if args.json:
         # --json changes the output format, never the action: combined
