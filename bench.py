@@ -49,7 +49,8 @@ from tools.bench_probes import (probe_disagg,  # noqa: E402
                                 probe_megakernel,
                                 probe_multitenant,
                                 probe_opt_dispatches,
-                                probe_persistence, probe_serving,
+                                probe_persistence, probe_pipeline,
+                                probe_serving,
                                 probe_spec_decode, probe_telemetry,
                                 probe_tracing)
 
@@ -68,6 +69,7 @@ _probe_kv_tiering = probe_kv_tiering
 _probe_disagg = probe_disagg
 _probe_multitenant = probe_multitenant
 _probe_megakernel = probe_megakernel
+_probe_pipeline = probe_pipeline
 
 PEAK_FLOPS = {
     "tpu v5 lite": 197e12,  # v5e bf16
@@ -225,8 +227,9 @@ def run_bench(config="llama_125m", progress=None):
     opt_probe = _probe_opt_dispatches(paddle)
     serving_probe = _probe_serving(paddle)
     spec_probe = _probe_spec_decode(paddle)
-    pipeline_probe = _probe_input_pipeline(paddle)
+    input_pipeline_probe = _probe_input_pipeline(paddle)
     gspmd_probe = _probe_gspmd(paddle)
+    pipeline_probe = _probe_pipeline(paddle)
     fusion_probe = _probe_hlo_fusion(paddle)
     tracing_probe = _probe_tracing(paddle)
     telemetry_probe = _probe_telemetry(paddle)
@@ -302,8 +305,9 @@ def run_bench(config="llama_125m", progress=None):
         **opt_probe,
         **serving_probe,
         **spec_probe,
-        **pipeline_probe,
+        **input_pipeline_probe,
         **gspmd_probe,
+        **pipeline_probe,
         **fusion_probe,
         **tracing_probe,
         **telemetry_probe,
@@ -659,6 +663,16 @@ def _failure_artifact(last_err, last_stages):
         "mk_token_identity": None,
         "mk_serving_fusions": None,
         "mk_serving_kernels": None,
+        # pipeline-parallel fields are per-run structural proofs: a
+        # loss-parity verdict, stage-ring permute count, max-stage
+        # param fraction, or bubble fraction from a stale round proves
+        # nothing about the run that failed
+        "pipeline_loss_parity": None,
+        "pipeline_ring_permutes": None,
+        "pipeline_dp_ring_permutes": None,
+        "pipeline_max_stage_param_fraction": None,
+        "pipeline_bubble_fraction": None,
+        "pipeline_train_compiles": None,
     }
     good = _last_good_round()
     if good:

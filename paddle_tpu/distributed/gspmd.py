@@ -5,8 +5,10 @@ shard_map wrappers — data parallelism, tensor (Megatron) parallelism,
 ZeRO optimizer-state sharding — collapse into *annotations* over ONE
 logical 2-D device mesh::
 
-    mesh axes:   ("data", "model")
+    mesh axes:   ("data", "model") — plus "pipeline" under pp=K
     batch        -> P("data", ...)          activations shard on data
+    stacked.*    -> P("pipeline", ...)      scan-stacked [L,...] leaves
+                                            stage-slice on dim 0 (pp>1)
     q/k/v/gate/up-> P(..., "model")         column-parallel (out-dim)
     o/down       -> P(..., "model", None)   row-parallel (in-dim)
     embed        -> P("model", None)        vocab-sharded
@@ -49,6 +51,7 @@ from ..core.flags import GLOBAL_FLAGS, define_flag
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+PIPELINE_AXIS = "pipeline"
 
 
 class ShardingConfig:
@@ -58,15 +61,21 @@ class ShardingConfig:
     ``zero=True`` additionally shards the fused optimizer's flat state
     buckets over the data axis (ZeRO-1: per-device optimizer-state
     memory = global/data_degree; GSPMD all-gathers the updated params
-    exactly where they are consumed).
+    exactly where they are consumed). ``pipe=K`` adds the third mesh
+    axis: the LayerStack's leading [L, ...] dim splits into K stages of
+    L/K layers each, and TrainStep runs the 1F1B microbatch loop with
+    collective-permute between stages (docs/DISTRIBUTED.md).
     """
 
-    def __init__(self, data=-1, model=1, zero=False):
+    def __init__(self, data=-1, model=1, zero=False, pipe=1):
         self.data = int(data)
         self.model = int(model)
         self.zero = bool(zero)
+        self.pipe = int(pipe)
         if self.model < 1:
             raise ValueError(f"model degree must be >= 1, got {model}")
+        if self.pipe < 1:
+            raise ValueError(f"pipeline degree must be >= 1, got {pipe}")
         if self.data < 1 and self.data != -1:
             raise ValueError(
                 f"data degree must be >= 1 (or -1 to infer), got {data}")
@@ -80,7 +89,7 @@ class ShardingConfig:
         preset = (preset or "").strip()
         if not preset:
             return None
-        kw = {"data": -1, "model": 1, "zero": False}
+        kw = {"data": -1, "model": 1, "zero": False, "pipe": 1}
         for part in preset.split(","):
             part = part.strip()
             if not part:
@@ -88,52 +97,83 @@ class ShardingConfig:
             if part == "zero":
                 kw["zero"] = True
                 continue
-            m = re.fullmatch(r"(dp|tp|data|model)\s*=\s*(-?\d+)", part)
+            m = re.fullmatch(
+                r"(dp|tp|pp|data|model|pipe)\s*=\s*(-?\d+)", part)
             if not m:
                 raise ValueError(
                     f"FLAGS_gspmd: cannot parse {part!r} (expected "
-                    f"'dp=N', 'tp=N', 'zero', comma-separated)")
-            key = {"dp": "data", "tp": "model"}.get(m.group(1), m.group(1))
+                    f"'dp=N', 'tp=N', 'pp=N', 'zero', comma-separated)")
+            key = {"dp": "data", "tp": "model",
+                   "pp": "pipe"}.get(m.group(1), m.group(1))
             kw[key] = int(m.group(2))
         return cls(**kw)
 
     def resolve(self, n_devices=None) -> "ShardingConfig":
-        """Pin ``data=-1`` against the device count; validate the fit."""
+        """Pin ``data=-1`` against the device count; validate the fit.
+
+        With ``pipe > 1`` an explicit ``dp x tp x pp`` product that
+        merely *divides* the device count is allowed — the mesh is built
+        over the device prefix (`devices[:product]`), so `dp=2,pp=2`
+        runs on the 8-device host mesh. ``pipe == 1`` keeps the exact
+        2-D strictness (product must equal the device count)."""
         n = n_devices if n_devices is not None else len(jax.devices())
         data = self.data
         if data == -1:
-            if n % self.model:
+            if n % (self.model * self.pipe):
                 raise ValueError(
-                    f"model degree {self.model} does not divide the "
-                    f"{n}-device mesh")
-            data = n // self.model
-        if data * self.model != n:
+                    f"model x pipeline degree {self.model} x {self.pipe} "
+                    f"does not divide the {n}-device mesh")
+            data = n // (self.model * self.pipe)
+        if self.pipe > 1:
+            prod = data * self.model * self.pipe
+            if prod > n or n % prod:
+                raise ValueError(
+                    f"mesh {data} x {self.model} x {self.pipe} "
+                    f"(dp x tp x pp) does not divide {n} devices")
+        elif data * self.model != n:
             raise ValueError(
                 f"mesh {data} x {self.model} != {n} devices")
-        out = ShardingConfig(data=data, model=self.model, zero=self.zero)
+        out = ShardingConfig(data=data, model=self.model, zero=self.zero,
+                             pipe=self.pipe)
         return out
 
     def __repr__(self):
         return (f"ShardingConfig(data={self.data}, model={self.model}, "
-                f"zero={self.zero})")
+                f"zero={self.zero}, pipe={self.pipe})")
 
     def __eq__(self, other):
         return (isinstance(other, ShardingConfig)
-                and (self.data, self.model, self.zero)
-                == (other.data, other.model, other.zero))
+                and (self.data, self.model, self.zero, self.pipe)
+                == (other.data, other.model, other.zero, other.pipe))
 
 
 def _check_gspmd(v):
     ShardingConfig.parse(str(v))   # raises -> flags.set rolls back
 
 
+def _check_microbatches(v):
+    if int(v) < 0:
+        raise ValueError(
+            f"FLAGS_pipeline_microbatches must be >= 0 (0 = auto), "
+            f"got {v}")
+
+
 define_flag("gspmd", str, "",
             "GSPMD sharding preset for jit.TrainStep: '' (off), 'dp=N', "
-            "'tp=N[,dp=M]', '...,zero' — DP/TP/ZeRO as NamedSharding "
-            "annotations over one (data, model) mesh under the one "
-            "compiled step (distributed/gspmd.py); collectives are "
-            "placed by the XLA partitioner, no per-regime step code",
+            "'tp=N[,dp=M]', 'pp=K', '...,zero' — DP/TP/PP/ZeRO as "
+            "NamedSharding annotations over one (data, model, pipeline) "
+            "mesh under the one compiled step (distributed/gspmd.py); "
+            "collectives are placed by the XLA partitioner, no "
+            "per-regime step code",
             on_set=_check_gspmd)
+
+define_flag("pipeline_microbatches", int, 0,
+            "Microbatch count M for the pp=K 1F1B pipeline loop inside "
+            "jit.TrainStep; 0 = auto (M = pipeline degree K). The batch "
+            "dim must divide by M; bubble fraction is (K-1)/(M+K-1), so "
+            "larger M amortizes the fill/drain bubble (docs/PERF.md "
+            "section 20)",
+            on_set=_check_microbatches)
 
 
 def config_from_flags() -> ShardingConfig | None:
@@ -141,13 +181,20 @@ def config_from_flags() -> ShardingConfig | None:
 
 
 def build_mesh(config: ShardingConfig, devices=None) -> Mesh:
-    """The one logical 2-D ``(data, model)`` mesh.
+    """The one logical ``(data, model[, pipeline])`` mesh.
 
     Built over ``jax.devices()`` in canonical order (real device ids —
     the multi-process regime's non-contiguous ids ride along exactly as
-    in mesh.init_mesh)."""
+    in mesh.init_mesh). ``pipe > 1`` adds the third axis and may use a
+    device prefix when dp x tp x pp divides (rather than equals) the
+    device count; adjacent stages land on adjacent devices so the
+    inter-stage collective-permute is a neighbor hop."""
     devs = list(devices) if devices is not None else jax.devices()
     cfg = config.resolve(len(devs))
+    if cfg.pipe > 1:
+        n = cfg.data * cfg.model * cfg.pipe
+        arr = np.asarray(devs[:n]).reshape(cfg.data, cfg.model, cfg.pipe)
+        return Mesh(arr, (DATA_AXIS, MODEL_AXIS, PIPELINE_AXIS))
     arr = np.asarray(devs).reshape(cfg.data, cfg.model)
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
@@ -189,22 +236,38 @@ def param_spec(name, shape, mesh) -> P:
 
     Unknown names and non-divisible dims replicate — a model the rules
     don't recognize still runs, just without the TP split for that leaf.
+    Under ``pp > 1`` the scan-stacked leaves (``stacked.*`` names, the
+    LayerStack's [L, ...] layout) additionally shard dim 0 over the
+    pipeline axis — the leading layer axis IS the stage dimension, so
+    each stage holds its L/K layer slice; the TP rules compose on the
+    end-relative dims of the same leaf.
     """
     ndim = len(shape)
     tp = mesh.shape.get(MODEL_AXIS, 1)
-    if tp <= 1 or ndim < 1:
+    pp = mesh.shape.get(PIPELINE_AXIS, 1)
+    if ndim < 1:
         return P()
-    if _COL_PAT.search(name) and _divisible(shape, ndim, 1, tp):
-        return _spec_from_end(ndim, 1, MODEL_AXIS)
-    if _ROW_PAT.search(name) and ndim >= 2 \
-            and _divisible(shape, ndim, 2, tp):
-        return _spec_from_end(ndim, 2, MODEL_AXIS)
-    if _EMBED_PAT.search(name) and ndim >= 2 \
-            and _divisible(shape, ndim, 2, tp):
-        return _spec_from_end(ndim, 2, MODEL_AXIS)   # vocab axis
-    if _HEAD_PAT.search(name) and _divisible(shape, ndim, 1, tp):
-        return _spec_from_end(ndim, 1, MODEL_AXIS)   # vocab axis
-    return P()
+    dims = [None] * ndim
+    if pp > 1 and ndim >= 2 and "stacked." in name \
+            and shape[0] % pp == 0:
+        dims[0] = PIPELINE_AXIS
+    if tp > 1:
+        end = None
+        if _COL_PAT.search(name) and _divisible(shape, ndim, 1, tp):
+            end = 1
+        elif _ROW_PAT.search(name) and ndim >= 2 \
+                and _divisible(shape, ndim, 2, tp):
+            end = 2
+        elif _EMBED_PAT.search(name) and ndim >= 2 \
+                and _divisible(shape, ndim, 2, tp):
+            end = 2   # vocab axis
+        elif _HEAD_PAT.search(name) and _divisible(shape, ndim, 1, tp):
+            end = 1   # vocab axis
+        if end is not None and dims[ndim - end] is None:
+            dims[ndim - end] = MODEL_AXIS
+    if all(d is None for d in dims):
+        return P()
+    return P(*dims)
 
 
 def named_param_shardings(named_shapes, mesh) -> dict:
@@ -311,17 +374,20 @@ def opt_state_shardings(opt_arrays, param_shardings_by_key, mesh,
     where the param lives), else replicates."""
     dp = mesh.shape.get(DATA_AXIS, 1)
     tp = mesh.shape.get(MODEL_AXIS, 1)
-    if zero and tp > 1:
+    pp = mesh.shape.get(PIPELINE_AXIS, 1)
+    if zero and (tp > 1 or pp > 1):
         # the 0.4.x CPU SPMD partitioner shifts flat spans when a
-        # data-sharded 1-D state mixes with a model axis in the same
-        # program (see constrain_flat); until a chip run revalidates
-        # the combination, tp x zero keeps the state replicated —
-        # ZeRO's memory split needs dp-only meshes here
+        # data-sharded 1-D state mixes with a model OR pipeline axis in
+        # the same program (see constrain_flat; zero x pp corrupts the
+        # loss the same way zero x tp does — pinned by
+        # tests/test_pipeline_parallel.py); until a chip run
+        # revalidates the combination, zero keeps the state replicated
+        # off dp-only meshes
         warnings.warn(
-            "gspmd: zero + model-parallel combined keeps optimizer "
-            "state replicated on this backend (flat-span partitioner "
-            "defect, docs/DISTRIBUTED.md); use a dp-only mesh for the "
-            "ZeRO state split", stacklevel=2)
+            "gspmd: zero + model/pipeline-parallel combined keeps "
+            "optimizer state replicated on this backend (flat-span "
+            "partitioner defect, docs/DISTRIBUTED.md); use a dp-only "
+            "mesh for the ZeRO state split", stacklevel=2)
         zero = False
     out = {}
     for k, v in opt_arrays.items():
@@ -382,6 +448,100 @@ def active_mesh():
     return _MESH_STACK[-1] if _MESH_STACK else None
 
 
+#: (mesh, n_stages, n_microbatches) bound while TrainStep traces a
+#: pp>1 program — LayerStack.forward switches to the pipelined scan
+#: when this is set, without threading pipeline degrees through every
+#: model signature (same pattern as _MESH_STACK above)
+_PIPELINE_STACK: list = []
+
+
+class pipeline_scope:
+    def __init__(self, mesh, stages, microbatches):
+        self.ctx = (mesh, int(stages), int(microbatches))
+
+    def __enter__(self):
+        _PIPELINE_STACK.append(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        _PIPELINE_STACK.pop()
+        return False
+
+
+def active_pipeline():
+    """(mesh, n_stages, n_microbatches) or None."""
+    return _PIPELINE_STACK[-1] if _PIPELINE_STACK else None
+
+
+def stage_param_bytes(named_shapes_dtypes, pipe) -> tuple:
+    """(max_stage_bytes, total_bytes) for {name: (shape, dtype)}.
+
+    A ``stacked.*`` leaf whose dim 0 divides by ``pipe`` splits evenly
+    across stages; everything else (embed, lm_head, norms) is counted on
+    every stage (replicated) — the accounting behind the per-stage
+    memory gate max_stage <= total/K + non-stacked slack."""
+    per_stage = 0
+    replicated_b = 0
+    total = 0
+    for name, (shape, dtype) in named_shapes_dtypes.items():
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        total += nbytes
+        if pipe > 1 and len(shape) >= 2 and "stacked." in name \
+                and shape[0] % pipe == 0:
+            per_stage += nbytes // pipe
+        else:
+            replicated_b += nbytes
+    return per_stage + replicated_b, total
+
+
+def predicted_pipeline_permutes(pipe) -> int:
+    """Analytic count of pipeline-RING collective-permute instructions
+    in the compiled pp-step HLO (see :func:`pipeline_permute_counts`).
+    The scan body appears once in HLO regardless of tick count, so the
+    count is structural, not ticks x (K-1): the forward shift-register
+    roll (1) + the last-stage output collect (1) and their backward
+    transposes plus the cotangent inject (3) = 5, independent of K, M,
+    dp and tp (pinned by tests/test_pipeline_parallel.py across the
+    preset matrix). Per-step *issue* count on the wire is
+    ticks x (K-1) x 2 — that is latency accounting
+    (docs/DISTRIBUTED.md), not an HLO instruction property."""
+    return 5 if pipe > 1 else 0
+
+
+_CP_PAIRS_RE = re.compile(
+    r"= (?:\([^)]*\)|[^\s(]+) collective-permute(?:-start)?\("
+    r"[^\n]*?source_target_pairs=\{((?:\{\d+,\d+\},?)+)\}")
+
+
+def pipeline_permute_counts(hlo_text: str, pipe: int) -> dict:
+    """Split a compiled module's collective-permutes into pipeline RING
+    hops vs partitioner resharding artifacts.
+
+    The pipeline axis is always the INNERMOST mesh axis (build_mesh), so
+    a stage hop moves a device index by exactly +-1 mod ``pipe`` within
+    its block of ``pipe`` devices. An instruction counts as ``ring``
+    when every source->target pair is such a neighbor hop — these are
+    the structural inter-stage transfers the schedule demands (and what
+    :func:`predicted_pipeline_permutes` predicts). Everything else
+    (self-pairs, data/model-axis deltas) lands in ``other``: resharding
+    the partitioner chose, which legitimately varies with shapes."""
+    ring = other = 0
+    for m in _CP_PAIRS_RE.finditer(hlo_text):
+        pairs = re.findall(r"\{(\d+),(\d+)\}", m.group(1))
+
+        def hop(a, b):
+            a, b = int(a), int(b)
+            return (a != b and a // pipe == b // pipe
+                    and ((a % pipe + 1) % pipe == b % pipe
+                         or (b % pipe + 1) % pipe == a % pipe))
+
+        if pairs and all(hop(a, b) for a, b in pairs):
+            ring += 1
+        else:
+            other += 1
+    return {"ring": ring, "other": other, "total": ring + other}
+
+
 def stage_state(x):
     """Stage a ZeRO-sharded flat state span replicated for the bucket
     update when the TENSOR-parallel axis is also active. On a pure data
@@ -390,9 +550,12 @@ def stage_state(x):
     partitioner defect corrupts the mixed sharded-state x replicated-
     grad elementwise chain, so the state gathers at body entry and the
     step's out_shardings re-slice it — state stays sharded AT REST
-    either way."""
+    either way. The pipeline axis counts as "another axis active" for
+    the same reason the model axis does: zero x pp mixes dp-sharded 1-D
+    state with stage-sharded params in one program."""
     mesh = active_mesh()
-    if mesh is None or mesh.shape.get(MODEL_AXIS, 1) <= 1:
+    if mesh is None or (mesh.shape.get(MODEL_AXIS, 1) <= 1
+                        and mesh.shape.get(PIPELINE_AXIS, 1) <= 1):
         return x
     return constrain_flat(x)
 
@@ -446,10 +609,12 @@ def collective_counts(hlo_text: str) -> dict:
 
 
 __all__ = [
-    "DATA_AXIS", "MODEL_AXIS", "ShardingConfig", "config_from_flags",
-    "build_mesh", "param_spec", "named_param_shardings",
-    "shard_serving_params", "kv_pool_sharding", "kv_scale_sharding",
-    "opt_state_shardings", "batch_sharding", "replicated",
-    "collective_counts", "partitioning_scope", "active_mesh",
-    "constrain_flat", "stage_state",
+    "DATA_AXIS", "MODEL_AXIS", "PIPELINE_AXIS", "ShardingConfig",
+    "config_from_flags", "build_mesh", "param_spec",
+    "named_param_shardings", "shard_serving_params", "kv_pool_sharding",
+    "kv_scale_sharding", "opt_state_shardings", "batch_sharding",
+    "replicated", "collective_counts", "partitioning_scope",
+    "active_mesh", "constrain_flat", "stage_state", "pipeline_scope",
+    "active_pipeline", "stage_param_bytes",
+    "predicted_pipeline_permutes", "pipeline_permute_counts",
 ]

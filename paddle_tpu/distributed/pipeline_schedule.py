@@ -111,6 +111,48 @@ class Schedule:
     def bubble_fraction(self) -> float:
         return self.bubble_total() / float(self.op_table.size)
 
+    def forward_layout(self) -> np.ndarray:
+        """Forward-fill tick layout [n_micro + p - 1, p] int32: entry
+        (t, s) is the microbatch whose F runs on stage s at forward
+        tick t (micro ``t - s``), or -1 (fill/drain bubble).
+
+        This is the tick ordering the single-jit TrainStep pipeline
+        loop executes: the schedule's F ops collapsed onto consecutive
+        ticks. Verified against the schedule's own op tables — each
+        stage must emit F for micros 0..m-1 in order, respecting the
+        1-tick neighbor dependency — so the executor and the explicit
+        shard_map schedules share ONE ordering source. Backward ticks
+        are realized by autodiff transposing the scan (the reverse
+        drain); the steady-state F/B interleave of true 1F1B is a
+        latency property the chip-tier shard_map executor keeps.
+        """
+        if self.vpp != 1:
+            raise ValueError(
+                f"forward_layout needs a vpp=1 schedule, got vpp={self.vpp}")
+        m, p = self.n_micro, self.n_stages
+        f_at = np.full((m, p), -1, np.int64)
+        for s in range(p):
+            seq = [(int(self.micro_table[t, s]), t)
+                   for t in range(self.n_ticks)
+                   if int(self.op_table[t, s]) == F_OP]
+            if [i for i, _ in seq] != list(range(m)):
+                raise ValueError(
+                    f"stage {s} F order {[i for i, _ in seq]} is not "
+                    f"the in-order microbatch sweep 0..{m - 1}")
+            for i, t in seq:
+                f_at[i, s] = t
+        for s in range(1, p):
+            if not (f_at[:, s] >= f_at[:, s - 1] + 1).all():
+                raise ValueError(
+                    f"stage {s} runs F before stage {s - 1} finished "
+                    f"(neighbor dependency violated)")
+        table = np.full((m + p - 1, p), -1, np.int32)
+        for t in range(m + p - 1):
+            for s in range(p):
+                if 0 <= t - s < m:
+                    table[t, s] = t - s
+        return table
+
     def draw(self) -> str:
         """ASCII pipeline diagram (stages as rows, ticks as columns)."""
         rows = []
@@ -125,6 +167,18 @@ class Schedule:
                 cells.append(tag)
             rows.append(f"s{s}: " + " ".join(f"{c:>6}" for c in cells))
         return "\n".join(rows)
+
+
+def forward_bubble_fraction(n_micro: int, n_stages: int) -> float:
+    """Analytic fill/drain bubble of the forward-fill layout:
+    ``(p - 1) / (m + p - 1)`` — each stage is busy m of the m + p - 1
+    ticks. Matches ``Schedule.forward_layout()`` exactly (the -1
+    fraction of the table) and is the per-step overhead model the bench
+    artifact records (docs/PERF.md section 20)."""
+    m, p = int(n_micro), int(n_stages)
+    if m < 1 or p < 1:
+        raise ValueError(f"need n_micro >= 1, n_stages >= 1, got {m}, {p}")
+    return (p - 1) / float(m + p - 1)
 
 
 def build_schedule(kind: str, n_micro: int, n_stages: int,
@@ -598,5 +652,5 @@ def scheduled_pipeline_loss(stage_params, x_embedded, labels, stage_fn,
 
 
 __all__ = ["build_schedule", "validate_schedule", "pipeline_train_step",
-           "scheduled_pipeline_loss", "Schedule", "IDLE", "F_OP", "BI_OP",
-           "W_OP"]
+           "scheduled_pipeline_loss", "Schedule", "forward_bubble_fraction",
+           "IDLE", "F_OP", "BI_OP", "W_OP"]

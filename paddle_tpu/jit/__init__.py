@@ -461,10 +461,15 @@ class TrainStep:
         self._params = {f"p{i}": p for i, p in enumerate(params)}
         # positional key -> model parameter name: the GSPMD rule table is
         # name-driven (q_proj/o_proj/embed/...), while the step's pytree
-        # keys are positional
+        # keys are positional. LayerStack leaves keep their "stacked."
+        # marker (the Parameter's own name, not the attribute path) so
+        # the pp=K stage-slicing rule can recognize the [L, ...] layout.
         by_id = {}
         if hasattr(model, "named_parameters"):
-            by_id = {id(p): n for n, p in model.named_parameters()}
+            by_id = {id(p): ("stacked." + n
+                             if str(getattr(p, "name", "")
+                                    ).startswith("stacked.") else n)
+                     for n, p in model.named_parameters()}
         self._param_names = {k: by_id.get(id(p), k)
                              for k, p in self._params.items()}
 
@@ -565,8 +570,14 @@ class TrainStep:
         shard_cfg = self.sharding or _gspmd.config_from_flags()
         if shard_cfg is not None:
             shard_cfg = shard_cfg.resolve()
+        pipe_M = 0
+        if shard_cfg is not None and shard_cfg.pipe > 1:
+            pipe_M = int(GLOBAL_FLAGS.get("pipeline_microbatches")) \
+                or shard_cfg.pipe
+            self._validate_pipeline(shard_cfg, batch_arrays, pipe_M)
         cfg_key = None if shard_cfg is None else \
-            (shard_cfg.data, shard_cfg.model, shard_cfg.zero)
+            (shard_cfg.data, shard_cfg.model, shard_cfg.zero,
+             shard_cfg.pipe, pipe_M)
         key = tuple((a.shape, str(a.dtype)) for a in batch_arrays) \
             + (check_finite, donate_batch, K, remat, cfg_key)
 
@@ -722,6 +733,12 @@ class TrainStep:
             from ..profiler import compile_event
             shard_ctx = (_gspmd.partitioning_scope(self._mesh)
                          if shard_cfg is not None else nullcontext())
+            # pp>1: LayerStack.forward switches to the stage-sliced
+            # pipelined scan while this scope is bound around the trace
+            pipe_ctx = (_gspmd.pipeline_scope(
+                self._mesh, shard_cfg.pipe, pipe_M)
+                if shard_cfg is not None and shard_cfg.pipe > 1
+                else nullcontext())
             if shard_cfg is not None or self.capture_hlo:
                 # HLO forensics: keep the compiled module + its
                 # collective mix inspectable (tests/test_gspmd.py,
@@ -730,7 +747,7 @@ class TrainStep:
                 # on the first call of a sharded (or capture_hlo)
                 # specialization.
                 try:
-                    with policy_ctx, shard_ctx:
+                    with policy_ctx, shard_ctx, pipe_ctx:
                         hlo = self._cache[key].lower(*args).compile() \
                             .as_text()
                     self.last_hlo_text = hlo
@@ -739,7 +756,7 @@ class TrainStep:
                 except Exception:
                     self.last_hlo_text = None
                     self.last_hlo_collectives = None
-            with policy_ctx, shard_ctx, compile_event(
+            with policy_ctx, shard_ctx, pipe_ctx, compile_event(
                     f"TrainStep(K={K},remat={remat})") as ev:
                 out = self._cache[key](*args)
             self._compiled_keys.add(key)
@@ -880,6 +897,38 @@ class TrainStep:
                     stop_gradient=True)
                 off += sz
         return loss_sum / K
+
+    def _validate_pipeline(self, shard_cfg, batch_arrays, pipe_M):
+        """pp=K preconditions, checked before the cache key so a bad
+        preset fails loudly instead of replicating silently: K must
+        divide both the device count left after dp x tp AND the model's
+        scan-stacked layer count; the microbatch count M must divide
+        the batch dim."""
+        pipe = shard_cfg.pipe
+        n = len(jax.devices())
+        per_pp = n // (shard_cfg.data * shard_cfg.model)
+        stack_layers = sorted({
+            int(p._data.shape[0]) for k, p in self._params.items()
+            if "stacked." in self._param_names.get(k, "")
+            and p._data.ndim >= 2})
+        bad_stack = (not stack_layers
+                     or any(l % pipe for l in stack_layers))
+        if per_pp % pipe or bad_stack:
+            layers = stack_layers[0] if stack_layers else 0
+            raise ValueError(
+                f"gspmd 'pp={pipe}': the pipeline degree must divide "
+                f"both the device count after dp x tp "
+                f"({per_pp} = {n} devices / dp={shard_cfg.data} / "
+                f"tp={shard_cfg.model}) and the model's scan-stacked "
+                f"layer count ({layers}; 0 = no LayerStack — enable "
+                f"FLAGS_scan_layers); got pp={pipe}, {per_pp} devices, "
+                f"{layers} layers")
+        for a in batch_arrays:
+            if a.ndim >= 1 and a.shape[0] % pipe_M:
+                raise ValueError(
+                    f"gspmd 'pp={pipe}': microbatch count M={pipe_M} "
+                    f"(FLAGS_pipeline_microbatches, 0 = auto = pp) must "
+                    f"divide the batch dim {a.shape[0]}")
 
     def _prime_state(self):
         """Create optimizer state ahead of tracing so state rides as

@@ -222,6 +222,14 @@ class LayerStack(Layer):
             else effective_remat_policy()
         _check_remat_policy(policy)
         stacked = {n: self._parameters[n] for n in self._param_names}
+        from ..distributed import gspmd as _gspmd
+        pp = _gspmd.active_pipeline()
+        if pp is not None and self.num_layers % pp[1] == 0:
+            mesh, stages, micro = pp
+            pure = self._pure_pipelined_scan(policy, mesh, stages, micro)
+            return _dispatch.eager_apply(
+                f"scan_stack{self.num_layers}pp{stages}mb{micro}", pure,
+                (carry, stacked, args), {})
         pure = self._pure_scan(policy)
         return _dispatch.eager_apply(
             f"scan_stack{self.num_layers}", pure, (carry, stacked, args), {})
@@ -253,6 +261,104 @@ class LayerStack(Layer):
             out, _ = jax.lax.scan(_checkpoint_wrap(body, policy),
                                   carry, stacked_arrays)
             return out
+
+        return pure
+
+    def _pure_pipelined_scan(self, policy, mesh, stages, micro):
+        """Stage-sliced pipelined variant of :meth:`_pure_scan` — used
+        while ``gspmd.pipeline_scope`` is active (TrainStep under a
+        ``pp=K`` preset).
+
+        The stacked ``[L, ...]`` leaves reshape to ``[K, L/K, ...]``
+        with the stage dim annotated ``P("pipeline")``; the carry
+        (hidden states, batch leading) splits into M microbatches and a
+        ``[K, mb, ...]`` shift-register buffer annotated
+        ``P("pipeline", "data")`` walks them through the stages — one
+        ``lax.scan`` over the ``Schedule.forward_layout()`` ticks, each
+        tick running every stage's L/K-layer chunk under ``vmap`` and
+        rolling the buffer one stage forward (GSPMD lowers the roll to
+        a neighbor collective-permute). Microbatch t enters stage s at
+        tick t + s — exactly the layout table — and autodiff transposes
+        the scan into the reverse drain, so loss/grads are bit-identical
+        to the plain scan (microbatching only re-tiles the batch dim).
+        ``*args`` extras broadcast to every microbatch, which is why
+        the llama train path passes only batch-free extras (RoPE
+        tables, None masks) through the stack.
+        """
+        template = self._template
+        tparams = self._template_params
+        from ..distributed import gspmd as _gspmd
+        from ..distributed.pipeline_schedule import build_schedule
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        layout = build_schedule("1f1b", micro, stages).forward_layout()
+        n_ticks = int(layout.shape[0])            # micro + stages - 1
+        # first tick the LAST stage emits microbatch 0 = collect offset
+        collect_from = int(np.argwhere(layout[:, stages - 1] == 0)[0, 0])
+        pipe_dim = _gspmd.PIPELINE_AXIS
+        data_dim = _gspmd.DATA_AXIS
+        dp = mesh.shape.get(data_dim, 1)
+        K, M = stages, micro
+
+        def cst(a, *spec_dims):
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(mesh, P(*spec_dims)))
+
+        def pure(carry, stacked_arrays, extra):
+            x = carry
+            if x.shape[0] % M:
+                raise ValueError(
+                    f"pipeline microbatches M={M} must divide the batch "
+                    f"dim {x.shape[0]}")
+            mb = x.shape[0] // M
+            data_ok = dp > 1 and mb % dp == 0
+            d_ax = data_dim if data_ok else None
+            # [L, ...] -> [K, L/K, ...], stage axis sharded
+            staged = jax.tree.map(
+                lambda a: cst(
+                    a.reshape((K, a.shape[0] // K) + a.shape[1:]),
+                    pipe_dim),
+                stacked_arrays)
+            mx = cst(x.reshape((M, mb) + x.shape[1:]), None, d_ax)
+            pad = jnp.zeros((K - 1,) + mx.shape[1:], mx.dtype)
+            xs = jnp.concatenate([mx, pad], 0)
+            assert xs.shape[0] == n_ticks
+            buf0 = cst(jnp.zeros((K, mb) + x.shape[1:], x.dtype),
+                       pipe_dim, d_ax)
+
+            def stage_chunk(chunk, c):
+                def body(cc, xs_):
+                    saved = {n: p._data for n, p in tparams.items()}
+                    try:
+                        for n, p in tparams.items():
+                            p._data = xs_[n]
+                        wrapped = jax.tree.map(
+                            lambda a: Tensor(a)
+                            if isinstance(a, (jax.Array, np.ndarray))
+                            else a, extra)
+                        with _ag.no_grad():
+                            out = template(Tensor(cc), *wrapped)
+                        return (out._data if isinstance(out, Tensor)
+                                else out, None)
+                    finally:
+                        for n, p in tparams.items():
+                            p._data = saved[n]
+
+                y, _ = jax.lax.scan(_checkpoint_wrap(body, policy),
+                                    c, chunk)
+                return y
+
+            def tick(buf, x_t):
+                buf = cst(buf.at[0].set(x_t), pipe_dim, d_ax)
+                y = cst(jax.vmap(stage_chunk)(staged, buf),
+                        pipe_dim, d_ax)
+                out_t = y[K - 1]
+                nbuf = jnp.roll(y, 1, axis=0)   # the inter-stage hop
+                return nbuf, out_t
+
+            _, ys = jax.lax.scan(tick, buf0, xs)
+            out = ys[collect_from:]             # [M, mb, ...]
+            return out.reshape(x.shape)
 
         return pure
 

@@ -146,7 +146,15 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               # structural proof
               "mk_model_scope", "mk_launches_per_token",
               "mk_burst_launches_per_token", "mk_token_identity",
-              "mk_serving_fusions", "mk_serving_kernels"):
+              "mk_serving_fusions", "mk_serving_kernels",
+              # pipeline-parallel fields (ISSUE 19): a loss-parity
+              # verdict, stage-ring permute count, max-stage param
+              # fraction or bubble fraction is a per-run structural
+              # proof
+              "pipeline_loss_parity", "pipeline_ring_permutes",
+              "pipeline_dp_ring_permutes",
+              "pipeline_max_stage_param_fraction",
+              "pipeline_bubble_fraction", "pipeline_train_compiles"):
         assert out[k] is None, k                 # never fabricated
     # per-stage elapsed ms: delta to the next mark; the stage the child
     # died inside has no known duration -> null
@@ -742,3 +750,57 @@ def test_proxy_bench_catches_disabled_kv_prefetch():
     assert out["kv_tier_token_identical"] is None
     assert out["kv_tier_spills"] is None
     assert "kv_tiering_probe_error" in out
+
+
+def test_proxy_bench_catches_disabled_pipeline():
+    """End-to-end pipeline-parallel regression injection (ISSUE 19):
+    run the pipeline probe with the stage axis disabled
+    (--no-pipeline: pp=1 gradient accumulation at the SAME microbatch
+    count) and gate against the checked-in baseline — the stage-ring
+    collective-permute counts read 0 (exact two-sided pin vs the
+    structural 5), the max-stage param fraction reads 1.0 (no stage
+    owns less than everything), the analytic bubble fraction reads 0;
+    four gates fail. The healthy collection of the same probe must
+    pass with loss parity intact, exactly 5 ring permutes in both the
+    pp=2 and dp=2,pp=2 programs, and ONE staged executable."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("pipeline",), pipeline_no_pp=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "pipeline_ring_permutes" in names
+    assert "pipeline_dp_ring_permutes" in names
+    assert "pipeline_max_stage_param_fraction" in names
+    assert "pipeline_bubble_fraction" in names
+    assert bad["metrics"]["pipeline_ring_permutes"] == 0
+    assert bad["metrics"]["pipeline_max_stage_param_fraction"] == 1.0
+    assert bad["metrics"]["pipeline_bubble_fraction"] == 0.0
+    # the rc-level contract CI keys off: --no-pipeline flips main to 1
+    import unittest.mock as _mock
+    with _mock.patch.object(pb, "collect",
+                            lambda probes=pb.PROBES, **kw: bad):
+        assert pb.main(["--probes", "pipeline", "--compare",
+                        pb.BASELINE_PATH]) == 1
+
+    good = pb.collect(probes=("pipeline",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    assert good["metrics"]["pipeline_loss_parity"] == 1
+    assert good["metrics"]["pipeline_ring_permutes"] == 5
+    assert good["metrics"]["pipeline_dp_ring_permutes"] == 5
+    assert good["metrics"]["pipeline_max_stage_param_fraction"] < 1.0
+    assert 0.0 < good["metrics"]["pipeline_bubble_fraction"] < 1.0
+    assert good["metrics"]["pipeline_train_compiles"] == 1
+
+    import tools.bench_probes as bp
+
+    class Boom:
+        def seed(self, *_a):
+            raise RuntimeError("boom")
+
+    out = bp.probe_pipeline(Boom())
+    assert out["pipeline_loss_parity"] is None
+    assert out["pipeline_ring_permutes"] is None
+    assert "pipeline_probe_error" in out

@@ -1,0 +1,353 @@
+"""Pipeline-parallel stage axis gates (distributed/gspmd.py ``pp=K`` +
+the in-jit 1F1B microbatch loop, ISSUE 19).
+
+The multi-device CPU lane again: conftest.py forces the 8-device
+virtual CPU mesh, so every composition is provable chip-free. The
+acceptance bars, asserted not logged:
+
+- ``pp=K`` presets are ANNOTATIONS ONLY on the same TrainStep call:
+  every preset (pp alone, dp x pp, tp x pp, dp x tp x pp, zero
+  variants) trains loss-identical (<= 1e-6) to the single-device
+  reference — microbatching only re-tiles the batch dim;
+- ONE executable per preset: the staged scan (stages x microbatches)
+  lives inside the single jitted step, trace count stays 1;
+- the compiled HLO's stage-ring collective-permute mix is structurally
+  pinned: exactly ``predicted_pipeline_permutes(K)`` instructions
+  whose every source-target pair is a +-1-mod-K neighbor hop on the
+  pipeline axis (forward shift, output collect, their two scan
+  transposes, the cotangent inject) — for EVERY K, M, and dp/tp mix;
+- per-stage parameter bytes actually drop: max-stage <= total/K plus
+  the replicated (non-stacked: embed/head/norms) slack;
+- the 1F1B forward layout from pipeline_schedule.build_schedule is the
+  single ordering source: M+K-1 ticks, entry (t,s) = t-s, bubble
+  fraction (K-1)/(M+K-1) — analytic formula == enumerated layout;
+- FLAGS_gspmd rejects non-divisible pp (devices after dp x tp AND
+  layer count) with the on_set-rollback pattern, the error names all
+  three numbers;
+- state_dict round-trips out of a pipelined run (stage-sharded stacked
+  params gather to host and reload into an unsharded model).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit as pjit
+from paddle_tpu.core.flags import GLOBAL_FLAGS
+from paddle_tpu.distributed import gspmd
+from paddle_tpu.distributed.pipeline_schedule import (
+    build_schedule, forward_bubble_fraction)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+CFG = dict(num_hidden_layers=4, hidden_size=64, intermediate_size=128,
+           num_attention_heads=4, num_key_value_heads=2, vocab_size=256)
+PRESETS = ["pp=2", "pp=4", "dp=2,pp=2", "tp=2,pp=2", "dp=2,tp=2,pp=2",
+           "pp=2,zero", "dp=2,pp=2,zero"]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _scan_layers_on():
+    """The stage axis slices the LayerStack's leading [L, ...] axis —
+    pipelining REQUIRES the scanned layer stack."""
+    old_scan = GLOBAL_FLAGS.get("scan_layers")
+    old_m = GLOBAL_FLAGS.get("pipeline_microbatches")
+    GLOBAL_FLAGS.set("scan_layers", True)
+    GLOBAL_FLAGS.set("pipeline_microbatches", 0)
+    yield
+    GLOBAL_FLAGS.set("scan_layers", old_scan)
+    GLOBAL_FLAGS.set("pipeline_microbatches", old_m)
+
+
+def _train(preset, n_steps=3, layers=None, micro=0):
+    """ONE training function for every regime — the preset string (and
+    optionally the microbatch flag) is all that changes between runs."""
+    GLOBAL_FLAGS.set("pipeline_microbatches", micro)
+    cfg = llama_tiny_config(**{**CFG, **({"num_hidden_layers": layers}
+                                         if layers else {})})
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def loss_fn(ids):
+        logits = model(ids)
+        return F.cross_entropy(
+            logits[:, :-1].reshape((-1, cfg.vocab_size)),
+            ids[:, 1:].reshape((-1,)))
+
+    step = pjit.TrainStep(model, loss_fn, opt, sharding=preset)
+    rng = np.random.default_rng(0)
+    losses = []
+    with warnings.catch_warnings():
+        # the zero x pp presets legitimately warn (state stays
+        # replicated); parity is the assertion, not the warning
+        warnings.simplefilter("ignore")
+        for _ in range(n_steps):
+            b = rng.integers(0, cfg.vocab_size, (8, 16))
+            losses.append(float(step(paddle.to_tensor(b)).numpy()))
+    return losses, step, model
+
+
+@pytest.fixture(scope="module")
+def runs():
+    out = {None: _train(None)}
+    for preset in PRESETS:
+        out[preset] = _train(preset)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# training: preset parity, one executable, pinned ring mix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_preset_loss_parity_vs_single_device(runs, preset):
+    ref = runs[None][0]
+    got = runs[preset][0]
+    assert max(abs(a - b) for a, b in zip(ref, got)) <= 1e-6, (
+        f"{preset}: {got} vs reference {ref}")
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_single_executable_per_preset(runs, preset):
+    # the 1F1B tick loop is a lax.scan INSIDE the one jitted step — M
+    # microbatches and K stages add zero executables
+    assert len(runs[preset][1]._cache) == 1
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_hlo_stage_ring_permute_mix(runs, preset):
+    """The stage ring is structurally pinned: exactly 5 collective-
+    permutes whose every source-target pair is a +-1-mod-K neighbor
+    hop on the (innermost) pipeline axis — the forward shift-register
+    roll, the output collect, their two transposes in the backward
+    scan, and the output-cotangent inject. Independent of K, M and the
+    outer dp/tp factors."""
+    step = runs[preset][1]
+    pipe = gspmd.ShardingConfig.parse(preset).resolve(8).pipe
+    counts = gspmd.pipeline_permute_counts(step.last_hlo_text, pipe)
+    pred = gspmd.predicted_pipeline_permutes(pipe)
+    assert pred == 5
+    assert counts["ring"] == pred, (preset, counts)
+    # and the unsharded reference has no mesh at all
+    assert runs[None][1].last_hlo_text is None
+
+
+def test_training_continues_after_first_compile(runs):
+    for preset, (losses, _, _) in runs.items():
+        assert len(set(losses)) == len(losses), (preset, losses)
+
+
+def test_microbatch_count_independence(runs):
+    """M is a schedule knob, not a numerics knob: pp=2 with M=4
+    microbatches (twice the stage count) reproduces the reference too,
+    with a deeper-but-identical ring mix."""
+    losses, step, _ = _train("pp=2", micro=4)
+    ref = runs[None][0]
+    assert max(abs(a - b) for a, b in zip(ref, losses)) <= 1e-6
+    assert len(step._cache) == 1
+    assert gspmd.pipeline_permute_counts(
+        step.last_hlo_text, 2)["ring"] == 5
+
+
+# ---------------------------------------------------------------------------
+# memory: per-stage parameter byte accounting
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("preset,pipe", [("pp=2", 2), ("pp=4", 4)])
+def test_stage_param_byte_accounting(runs, preset, pipe):
+    step = runs[preset][1]
+    named = {step._param_names[k]: (tuple(p._data.shape),
+                                    np.dtype(p._data.dtype))
+             for k, p in step._params.items()}
+    mx, total = gspmd.stage_param_bytes(named, pipe)
+    # replicated slack = everything OUTSIDE the layer stack (embeddings,
+    # lm head, final norm) — the stacked transformer body must split
+    stacked = sum(int(np.prod(s)) * d.itemsize
+                  for n, (s, d) in named.items()
+                  if "stacked." in n and len(s) >= 2 and s[0] % pipe == 0)
+    replicated = total - stacked
+    assert stacked > 0 and total > 0
+    assert mx == replicated + stacked // pipe
+    assert mx <= total // pipe + replicated
+    assert mx < total          # pipelining actually reduced the max stage
+    # and the device arrays agree: a stacked param's per-device shard
+    # really owns L/K layers
+    for k, p in step._params.items():
+        name = step._param_names[k]
+        if "stacked." in name and p._data.ndim >= 2 \
+                and p._data.shape[0] % pipe == 0:
+            local = p._data.addressable_shards[0].data.shape[0]
+            assert local == p._data.shape[0] // pipe, (name, local)
+            assert p._data.sharding.spec[0] == gspmd.PIPELINE_AXIS
+            break
+    else:
+        pytest.fail("no stage-sharded stacked param found")
+
+
+# ---------------------------------------------------------------------------
+# schedule: the 1F1B layout is the single ordering source
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("m,p", [(2, 2), (4, 2), (4, 4), (8, 4)])
+def test_forward_layout_shape_and_fill(m, p):
+    t = build_schedule("1f1b", m, p).forward_layout()
+    assert t.shape == (m + p - 1, p)
+    for tick in range(m + p - 1):
+        for s in range(p):
+            want = tick - s if 0 <= tick - s < m else -1
+            assert t[tick, s] == want
+    # every stage sweeps micros 0..m-1 in order, one tick behind its
+    # upstream neighbor (the 1-tick communication dependency)
+    for s in range(p):
+        micros = [v for v in t[:, s] if v >= 0]
+        assert micros == list(range(m))
+
+
+@pytest.mark.parametrize("m,p", [(2, 2), (4, 2), (4, 4), (8, 4), (3, 8)])
+def test_bubble_fraction_analytic_matches_layout(m, p):
+    frac = forward_bubble_fraction(m, p)
+    assert frac == pytest.approx((p - 1) / (m + p - 1))
+    layout = build_schedule("1f1b", m, p).forward_layout()
+    assert float((layout < 0).mean()) == pytest.approx(frac)
+
+
+def test_forward_layout_rejects_interleaved_vpp():
+    sched = build_schedule("1f1b", 8, 2, vpp=2)
+    with pytest.raises(ValueError, match="vpp"):
+        sched.forward_layout()
+
+
+# ---------------------------------------------------------------------------
+# flags / config validation
+# ---------------------------------------------------------------------------
+
+def test_flags_gspmd_pp_on_set_rollback():
+    old = GLOBAL_FLAGS.get("gspmd")
+    with pytest.raises(ValueError):
+        GLOBAL_FLAGS.set("gspmd", "pp=0")
+    assert GLOBAL_FLAGS.get("gspmd") == old, (
+        "a rejected preset must roll the flag back (on_set contract)")
+    GLOBAL_FLAGS.set("gspmd", "dp=2,tp=2,pp=2")
+    try:
+        cfg = gspmd.config_from_flags()
+        assert (cfg.data, cfg.model, cfg.pipe) == (2, 2, 2)
+    finally:
+        GLOBAL_FLAGS.set("gspmd", old)
+
+
+def test_pipeline_microbatches_flag_rollback():
+    old = GLOBAL_FLAGS.get("pipeline_microbatches")
+    with pytest.raises(ValueError):
+        GLOBAL_FLAGS.set("pipeline_microbatches", -2)
+    assert GLOBAL_FLAGS.get("pipeline_microbatches") == old
+
+
+def test_sharding_config_pp_validation():
+    with pytest.raises(ValueError):
+        gspmd.ShardingConfig(pipe=0)
+    # pp must divide the device count (after dp x tp)
+    with pytest.raises(ValueError):
+        gspmd.ShardingConfig.parse("pp=3").resolve(8)
+    with pytest.raises(ValueError):
+        gspmd.ShardingConfig.parse("dp=3,pp=2").resolve(8)
+    # explicit sub-mesh products are allowed when a pipeline axis is
+    # present (dp=2,pp=2 on 8 devices uses the 4-device prefix) ...
+    cfg = gspmd.ShardingConfig.parse("dp=2,pp=2").resolve(8)
+    assert (cfg.data, cfg.model, cfg.pipe) == (2, 1, 2)
+    # ... while auto-dp still fills the whole mesh
+    cfg = gspmd.ShardingConfig.parse("pp=2").resolve(8)
+    assert (cfg.data, cfg.model, cfg.pipe) == (4, 1, 2)
+    cfg = gspmd.ShardingConfig.parse("dp=2,tp=2,pp=2").resolve(8)
+    assert (cfg.data, cfg.model, cfg.pipe) == (2, 2, 2)
+    # the pp=1 path keeps the exact-product strictness of ISSUE 10
+    with pytest.raises(ValueError):
+        gspmd.ShardingConfig(data=3).resolve(8)
+
+
+def test_trainstep_rejects_indivisible_layer_count():
+    """The error names all three numbers: pp, the per-stage device
+    count, and the layer count."""
+    losses = None
+    GLOBAL_FLAGS.set("pipeline_microbatches", 0)
+    cfg = llama_tiny_config(**{**CFG, "num_hidden_layers": 3})
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = pjit.TrainStep(model, lambda ids: model(ids, labels=ids)[1],
+                          opt, sharding="pp=2")
+    b = paddle.to_tensor(np.zeros((8, 16), np.int64))
+    with pytest.raises(ValueError, match=r"pp=2.*2 devices.*3 layers"):
+        step(b)
+    assert losses is None
+
+
+def test_trainstep_rejects_indivisible_microbatches():
+    losses, step, model = None, None, None
+    cfg = llama_tiny_config(**CFG)
+    paddle.seed(7)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    GLOBAL_FLAGS.set("pipeline_microbatches", 3)
+    try:
+        step = pjit.TrainStep(model, lambda ids: model(ids)[0].sum(),
+                              opt, sharding="pp=2")
+        b = paddle.to_tensor(np.zeros((8, 16), np.int64))
+        with pytest.raises(ValueError, match=r"M=3.*batch dim 8"):
+            step(b)
+    finally:
+        GLOBAL_FLAGS.set("pipeline_microbatches", 0)
+
+
+def test_scan_layers_required_for_pp():
+    """Without the LayerStack there is no stage axis to slice: the
+    validation must say so rather than silently replicating."""
+    old = GLOBAL_FLAGS.get("scan_layers")
+    GLOBAL_FLAGS.set("scan_layers", False)
+    try:
+        cfg = llama_tiny_config(**CFG)
+        paddle.seed(7)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        step = pjit.TrainStep(model, lambda ids: model(ids)[0].sum(),
+                              opt, sharding="pp=2")
+        b = paddle.to_tensor(np.zeros((8, 16), np.int64))
+        with pytest.raises(ValueError, match="scan_layers"):
+            step(b)
+    finally:
+        GLOBAL_FLAGS.set("scan_layers", old)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint: stage-sharded params gather out of a pipelined run
+# ---------------------------------------------------------------------------
+
+def test_state_dict_roundtrip_out_of_pipelined_run(runs):
+    _, _, trained = runs["dp=2,pp=2"]
+    ref_losses, _, ref_model = runs[None]
+    sd = trained.state_dict()
+    # every stacked entry came back whole (host-shaped, all L layers)
+    cfg = llama_tiny_config(**CFG)
+    paddle.seed(123)                      # different init — must be
+    fresh = LlamaForCausalLM(cfg)         # fully overwritten by the load
+    missing, unexpected = fresh.set_state_dict(sd)
+    assert not missing and not unexpected
+    ref_sd = ref_model.state_dict()
+    assert set(ref_sd) == set(sd)
+    for k, v in sd.items():
+        # 1e-4 separates optimizer round-off (O(1e-5) after 3 AdamW
+        # steps whose losses agree to 1e-6) from a load that silently
+        # kept the seed-123 fresh init (O(1e-2) parameter distance)
+        np.testing.assert_allclose(
+            np.asarray(fresh.state_dict()[k]), np.asarray(ref_sd[k]),
+            rtol=0, atol=1e-4, err_msg=k)

@@ -437,6 +437,131 @@ def probe_gspmd(paddle, dp_only=False):
                 "gspmd_probe_error": f"{type(e).__name__}: {e}"}
 
 
+def probe_pipeline(paddle, no_pipeline=False):
+    """Measured pipeline-parallel fields (the pp=K stage axis inside the
+    single-jit TrainStep, distributed/gspmd.py + nn/scan_stack.py; needs
+    the forced 8-device host mesh like probe_gspmd).
+
+    Two micro TrainSteps run under ``pp=2`` and ``dp=2,pp=2`` with
+    scan_layers on, against a single-device reference:
+    - ``pipeline_loss_parity``: 1 iff every pp run's losses are within
+      1e-6 of the single-device reference (microbatching only re-tiles
+      the batch dim, so parity is the correctness bar, not a tolerance);
+    - ``pipeline_ring_permutes`` / ``pipeline_dp_ring_permutes``:
+      pipeline-RING collective-permute instructions in the compiled HLO
+      (gspmd.pipeline_permute_counts) — must equal the structural
+      analytic prediction gspmd.predicted_pipeline_permutes(K) = 5,
+      independent of K/M/dp;
+    - ``pipeline_max_stage_param_fraction``: max per-stage parameter
+      bytes / total (gspmd.stage_param_bytes) — the stage memory split,
+      < 1 only when the stacked layers actually slice across stages;
+    - ``pipeline_bubble_fraction``: the analytic (K-1)/(M+K-1) fill/
+      drain bubble, cross-checked against the enumerated
+      Schedule.forward_layout() before being reported;
+    - ``pipeline_train_compiles``: sharded step executables built (1 —
+      the single-jit contract survives the pipeline loop).
+    ``no_pipeline=True`` forces pp=1 with the SAME microbatch count
+    (accumulate_steps) — the proxy-bench regression-injection hook:
+    ring permutes drop to 0, the stage fraction jumps to 1.0 and the
+    bubble fraction to 0.0, and the compare gates must catch it.
+    """
+    try:
+        import jax
+        import numpy as _np
+        import paddle_tpu.nn.functional as _F
+        from paddle_tpu import jit as _pjit
+        from paddle_tpu.core.flags import GLOBAL_FLAGS as _flags
+        from paddle_tpu.distributed import gspmd as _g
+        from paddle_tpu.distributed.pipeline_schedule import (
+            build_schedule, forward_bubble_fraction)
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny_config
+        n = len(jax.devices())
+        if n < 8:
+            raise RuntimeError(
+                f"{n} device(s): the pipeline probe needs the 8-device "
+                f"host mesh (--xla_force_host_platform_device_count)")
+        cfg = llama_tiny_config(
+            num_hidden_layers=2, hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, vocab_size=256)
+        K, M = 2, 2
+
+        def train(preset, accumulate=1):
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+
+            def loss_fn(ids):
+                logits = model(ids)
+                return _F.cross_entropy(
+                    logits[:, :-1].reshape((-1, cfg.vocab_size)),
+                    ids[:, 1:].reshape((-1,)))
+
+            step = _pjit.TrainStep(model, loss_fn, opt, sharding=preset,
+                                   accumulate_steps=accumulate)
+            rng = _np.random.default_rng(0)
+            losses = []
+            for _ in range(2):
+                b = rng.integers(0, cfg.vocab_size, (8, 16))
+                losses.append(float(step(paddle.to_tensor(b)).numpy()))
+            return losses, step
+
+        old_scan = _flags.get("scan_layers")
+        old_m = _flags.get("pipeline_microbatches")
+        _flags.set("scan_layers", True)
+        _flags.set("pipeline_microbatches", M)
+        try:
+            ref, _ = train(None)
+            if no_pipeline:
+                # pp=1, same microbatch count via grad accumulation
+                (l_pp, s_pp), (l_dp, s_dp) = (
+                    train(f"dp={n}", accumulate=M),
+                    train(f"dp={n}", accumulate=M))
+                pipe = 1
+            else:
+                l_pp, s_pp = train(f"pp={K}")
+                l_dp, s_dp = train(f"dp=2,pp={K}")
+                pipe = K
+        finally:
+            _flags.set("scan_layers", old_scan)
+            _flags.set("pipeline_microbatches", old_m)
+        parity = int(all(
+            max(abs(a - b) for a, b in zip(ref, got)) <= 1e-6
+            for got in (l_pp, l_dp)))
+        ring = _g.pipeline_permute_counts(
+            s_pp.last_hlo_text, max(pipe, 2))["ring"]
+        ring_dp = _g.pipeline_permute_counts(
+            s_dp.last_hlo_text, max(pipe, 2))["ring"]
+        named = {s_pp._param_names[k]: (tuple(p._data.shape),
+                                        _np.dtype(str(p._data.dtype)))
+                 for k, p in s_pp._params.items()}
+        mx, total = _g.stage_param_bytes(named, pipe)
+        bubble = forward_bubble_fraction(M, pipe)
+        if pipe > 1:
+            layout = build_schedule("1f1b", M, pipe).forward_layout()
+            enum = float((layout < 0).mean())
+            if abs(enum - bubble) > 1e-12:
+                raise RuntimeError(
+                    f"analytic bubble {bubble} != enumerated layout "
+                    f"bubble {enum}")
+        return {
+            "pipeline_loss_parity": parity,
+            "pipeline_ring_permutes": ring,
+            "pipeline_dp_ring_permutes": ring_dp,
+            "pipeline_max_stage_param_fraction": round(mx / total, 4),
+            "pipeline_bubble_fraction": round(bubble, 4),
+            "pipeline_train_compiles": len(s_pp._cache),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        return {"pipeline_loss_parity": None,
+                "pipeline_ring_permutes": None,
+                "pipeline_dp_ring_permutes": None,
+                "pipeline_max_stage_param_fraction": None,
+                "pipeline_bubble_fraction": None,
+                "pipeline_train_compiles": None,
+                "pipeline_probe_error": f"{type(e).__name__}: {e}"}
+
+
 def probe_input_pipeline(paddle, steps=16, log_freq=8):
     """Measured async-input-pipeline fields for the bench trajectory.
 

@@ -50,6 +50,15 @@ This harness runs the measurements that DON'T need a chip and are
   tenant reports, mixed-batch LoRA token identity over the int8 base,
   and adapter hot-swap with zero recompiles (``--no-fairness`` is the
   injected regression: bare FIFO over the same flood);
+- ``pipeline_*`` — the pipeline-parallel stage axis's contracts
+  (distributed/gspmd.py ``pp=K`` presets + the in-jit 1F1B microbatch
+  loop): loss parity <= 1e-6 vs the single-device run for pp=2 and
+  dp=2,pp=2, the stage-ring collective-permute count pinned both ways
+  at its structural value, max-stage param byte fraction, the analytic
+  bubble fraction (K-1)/(M+K-1) cross-checked against the schedule
+  layout, and ONE staged TrainStep executable (``--no-pipeline`` is
+  the injected regression: pp=1 gradient accumulation at the same
+  microbatch count);
 - ``mk_*`` — the whole-model decode megakernel's launch-collapse
   contracts (kernels/decode_megakernel.py ``fused_decode_model``): the
   decoder layer body appears ONCE in the ragged step's program
@@ -96,7 +105,8 @@ if "--xla_force_host_platform_device_count" not in \
 
 BASELINE_PATH = os.path.join(REPO, "tools", "proxy_bench_baseline.json")
 
-PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer", "pipeline",
+PROBES = ("serving", "spec", "gspmd", "cluster", "optimizer",
+          "input_pipeline", "pipeline",
           "jaxpr", "accounting", "fusion", "tracing", "telemetry",
           "persist", "kvtier", "disagg", "multitenant", "megakernel")
 
@@ -288,6 +298,29 @@ GATES = {
     # once per layer). --per-layer forces the measured engine back to
     # layer scope: scope reads 0, launches/token rise to num_layers,
     # the compiled counts rise — five of the six gates must catch it.
+    # pipeline-parallel stage axis (distributed/gspmd.py + the in-jit
+    # 1F1B microbatch loop via probe_pipeline): pp=2 (and dp=2,pp=2)
+    # training must stay loss-identical (<=1e-6) to the single-device
+    # run — parity is a 0/1 verdict and 0 is an unconditional failure.
+    # The stage-ring collective-permute count is structurally pinned
+    # BOTH ways (5: forward shift + output collect + their two scan
+    # transposes + the cotangent inject — more means the partitioner
+    # started bouncing activations, fewer means the ring dissolved into
+    # all-gathers), the max-stage param byte fraction must not rise
+    # (a stage silently owning more than total/K + embed/head slack is
+    # lost pipeline memory scaling), the analytic bubble fraction
+    # (K-1)/(M+K-1) is cross-checked against the 1F1B schedule layout
+    # inside the probe and pinned here, and the staged TrainStep must
+    # still compile exactly once. --no-pipeline serves the same
+    # microbatch count as pp=1 gradient accumulation: rings read 0,
+    # the stage fraction reads 1.0, the bubble reads 0 — four gates
+    # must catch it.
+    "pipeline_loss_parity":      Gate("lower", 0.0, 0.0),
+    "pipeline_ring_permutes":    Gate("different"),
+    "pipeline_dp_ring_permutes": Gate("different"),
+    "pipeline_max_stage_param_fraction": Gate("higher", 0.0, 0.0),
+    "pipeline_bubble_fraction":  Gate("different"),
+    "pipeline_train_compiles":   Gate("higher", 0.0, 0.0),
     "mk_model_scope":            Gate("lower", 0.0, 0.0),
     "mk_launches_per_token":     Gate("higher", 0.0, 0.0),
     "mk_burst_launches_per_token": Gate("higher", 0.0, 0.0),
@@ -302,7 +335,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             fusion_defuse=False, telemetry_burn_alerts=True,
             persist_corrupt=False, kvtier_prefetch=True,
             disagg_colocated=False, multitenant_fairness=True,
-            megakernel_per_layer=False) -> dict:
+            megakernel_per_layer=False, pipeline_no_pp=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -349,6 +382,14 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     toward 1; the ``multitenant_quota_shed``,
     ``multitenant_good_ttft_p99_s``, and
     ``multitenant_isolation_ratio`` gates must all catch it.
+    ``pipeline_no_pp=True`` (--no-pipeline) replaces the pipeline-
+    parallel probe's staged runs with pp=1 data-parallel runs at the
+    SAME microbatch count (gradient accumulation): the pipeline ring
+    permutes read 0, the max-stage param fraction reads 1.0 (no stage
+    owns less than everything), and the bubble fraction reads 0 — the
+    ``pipeline_ring_permutes``/``pipeline_dp_ring_permutes``/
+    ``pipeline_max_stage_param_fraction``/``pipeline_bubble_fraction``
+    gates must all catch it.
     ``megakernel_per_layer=True`` (--per-layer) forces the megakernel
     probe's measured engine back to layer scope: ``mk_model_scope``
     reads 0, launches per token rise from 1.0 to num_layers, the
@@ -363,6 +404,7 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                                     probe_gspmd,
                                     probe_hlo_fusion,
                                     probe_input_pipeline, probe_jaxpr,
+                                    probe_pipeline,
                                     probe_kv_accounting,
                                     probe_megakernel,
                                     probe_multitenant,
@@ -403,8 +445,14 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                "cluster_ttft_p99_s", "cluster_unresolved"))
     if "optimizer" in probes:
         _take(probe_opt_dispatches(paddle), ("opt_dispatches_per_step",))
-    if "pipeline" in probes:
+    if "input_pipeline" in probes:
         _take(probe_input_pipeline(paddle), ("host_syncs_per_epoch",))
+    if "pipeline" in probes:
+        _take(probe_pipeline(paddle, no_pipeline=pipeline_no_pp),
+              ("pipeline_loss_parity", "pipeline_ring_permutes",
+               "pipeline_dp_ring_permutes",
+               "pipeline_max_stage_param_fraction",
+               "pipeline_bubble_fraction", "pipeline_train_compiles"))
     if "jaxpr" in probes:
         _take(probe_jaxpr(paddle),
               ("fwd_jaxpr_eqns_scan", "fwd_jaxpr_eqn_growth"))
@@ -567,6 +615,12 @@ def main(argv=None) -> int:
                          "from 1.0 to num_layers and the compiled "
                          "fusion/kernel counts rise (the injected "
                          "regression)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="replace the pipeline probe's staged runs "
+                         "with pp=1 gradient accumulation at the same "
+                         "microbatch count: ring permutes read 0, the "
+                         "max-stage fraction reads 1.0, the bubble "
+                         "reads 0 (the injected regression)")
     ap.add_argument("--no-fairness", action="store_true",
                     help="serve the multitenant probe's noisy-neighbor "
                          "flood with no tenant policy (bare FIFO): "
@@ -603,7 +657,8 @@ def main(argv=None) -> int:
                       kvtier_prefetch=not args.no_prefetch,
                       disagg_colocated=args.colocated,
                       multitenant_fairness=not args.no_fairness,
-                      megakernel_per_layer=args.per_layer)
+                      megakernel_per_layer=args.per_layer,
+                      pipeline_no_pp=args.no_pipeline)
 
     if args.json:
         # --json changes the output format, never the action: combined
