@@ -663,6 +663,17 @@ def _failure_artifact(last_err, last_stages):
         "mk_token_identity": None,
         "mk_serving_fusions": None,
         "mk_serving_kernels": None,
+        # fused ragged-prefill fields likewise: compiled counts, the
+        # bitwise-identity verdict, launches-per-chunk, and the
+        # virtual-clock flood numbers are all per-run proofs
+        "mk_prefill_fusions": None,
+        "mk_prefill_kernels": None,
+        "mk_prefill_token_identity": None,
+        "mk_prefill_launches_per_chunk": None,
+        "mk_prefill_ttft_p99_s": None,
+        "mk_prefill_ttft_ratio_vs_unfused": None,
+        "mk_prefill_tokens_per_s": None,
+        "mk_prefill_decode_tokens": None,
         # pipeline-parallel fields are per-run structural proofs: a
         # loss-parity verdict, stage-ring permute count, max-stage
         # param fraction, or bubble fraction from a stale round proves

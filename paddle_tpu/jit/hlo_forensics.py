@@ -168,4 +168,62 @@ def launch_stats(program_text: str, *, num_layers,
     }
 
 
-__all__ = ["fusion_stats", "launch_stats", "shape_bytes"]
+def mixed_launch_stats(program_text: str, *, num_layers,
+                       kinds, overhead_markers=1,
+                       tokens_per_invocation=1,
+                       exclusive=False) -> dict:
+    """Launch accounting for a MIXED invocation — one program whose
+    body contains more than one kind of decoder-layer body (the
+    serving ragged step runs prefill-chunk rows and decode rows in the
+    same fixed-shape executable).
+
+    ``kinds`` maps a body-kind name to its markers-per-body count, e.g.
+    ``{"prefill": 2, "decode": 2}``. Each kind's site count is
+    structural — ``0`` (absent), ``1`` (scan-collapsed) or
+    ``num_layers`` (unrolled) — so the total marker count must
+    decompose as
+
+        markers = overhead + sum_k sites_k * markers_per_body_k
+
+    with every ``sites_k`` in ``{0, 1, num_layers}`` (``{1,
+    num_layers}`` when ``exclusive=True``, which asserts every kind is
+    present — the mixed step always carries both bodies). The
+    decomposition must be UNIQUE: zero solutions means the traced body
+    changed under the caller's constants, several means the marker
+    algebra cannot attribute sites to kinds — both raise ValueError
+    rather than fabricate a launch count.
+    """
+    import itertools
+
+    markers = len(_MARKER_RE.findall(program_text))
+    budget = markers - int(overhead_markers)
+    names = sorted(kinds)
+    L = int(num_layers)
+    cand = (1, L) if exclusive else (0, 1, L)
+    solutions = []
+    for combo in itertools.product(cand, repeat=len(names)):
+        if sum(s * int(kinds[n]) for s, n in zip(combo, names)) == budget:
+            if combo not in solutions:
+                solutions.append(combo)
+    if len(solutions) != 1:
+        why = "no assignment matches" if not solutions else \
+            f"{len(solutions)} assignments match"
+        raise ValueError(
+            f"mixed_launch_stats: {markers} rsqrt markers do not "
+            f"decompose as {overhead_markers} overhead + per-kind body "
+            f"sites in {cand} for kinds {dict(kinds)} ({why}) — the "
+            f"traced body changed; re-derive the marker constants")
+    sites = dict(zip(names, solutions[0]))
+    total = sum(sites.values())
+    return {
+        "marker_count": markers,
+        "sites": sites,
+        "total_body_sites": total,
+        "num_layers": L,
+        "launches_per_token": total / float(tokens_per_invocation),
+        "collapsed": all(s <= 1 for s in sites.values()),
+    }
+
+
+__all__ = ["fusion_stats", "launch_stats", "mixed_launch_stats",
+           "shape_bytes"]

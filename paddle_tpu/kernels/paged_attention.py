@@ -132,9 +132,25 @@ def _ragged_kernel_quant(row_ref, qs_ref, ql_ref, kl_ref, tbl_ref, ks_ref,
                    ks_ref=ks_ref, vs_ref=vs_ref)
 
 
+def ragged_block_row(q_starts, num_blocks, q_block):
+    """The q-block -> sequence map the ragged kernel steers its DMAs by:
+    derived from the ascending slot starts; blocks past every live slot
+    resolve to the last row (their tokens mask dead in-kernel). Exposed
+    so a fused prefill step can compute it ONCE per step and share it
+    across every layer's attention call (kernels/prefill_megakernel.py)
+    — the ops are identical to the in-call derivation, so passing the
+    result back through ``block_row=`` is bitwise-neutral."""
+    q_starts = q_starts.astype(jnp.int32)
+    row = (jnp.searchsorted(
+        q_starts, jnp.arange(num_blocks, dtype=jnp.int32) * q_block,
+        side="right") - 1).astype(jnp.int32)
+    return jnp.maximum(row, 0)
+
+
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, q_starts,
                            q_lens, kv_lens, *, q_block=8, scale=None,
-                           interpret=False, k_scales=None, v_scales=None):
+                           interpret=False, k_scales=None, v_scales=None,
+                           block_row=None):
     """Mixed prefill-chunk + decode attention over a paged KV cache.
 
     q:            [total_q_tokens, num_q_heads, head_dim] — queries of
@@ -152,6 +168,9 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, q_starts,
         absolute position of the chunk's first token)
     k_scales/v_scales: [num_kv_heads, num_pages] fp32 per-(head, page)
         dequant scales for int8 pools (both or neither).
+    block_row:    optional precomputed :func:`ragged_block_row` result
+        (``[total_q_tokens // q_block] int32``) — lets a fused prefill
+        step derive the map once and share it across layers.
     Returns [total_q_tokens, num_q_heads, head_dim]; padding rows hold
     garbage (finite, never NaN) and must be ignored by the caller.
     """
@@ -174,12 +193,13 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, q_starts,
     num_blocks = t // q_block
 
     q_starts = q_starts.astype(jnp.int32)
-    # q block -> sequence map, derived from the (ascending) slot starts;
-    # blocks past every live slot resolve to the last row and mask dead
-    block_row = (jnp.searchsorted(
-        q_starts, jnp.arange(num_blocks, dtype=jnp.int32) * q_block,
-        side="right") - 1).astype(jnp.int32)
-    block_row = jnp.maximum(block_row, 0)
+    if block_row is None:
+        # q block -> sequence map, derived from the (ascending) slot
+        # starts; blocks past every live slot resolve to the last row
+        # and mask dead
+        block_row = ragged_block_row(q_starts, num_blocks, q_block)
+    else:
+        block_row = jnp.asarray(block_row, jnp.int32)
 
     qg = q.reshape(t, hkv, group, d)
 
@@ -323,4 +343,5 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
 
 
 __all__ = ["paged_attention", "paged_attention_reference",
-           "ragged_paged_attention", "ragged_paged_attention_reference"]
+           "ragged_block_row", "ragged_paged_attention",
+           "ragged_paged_attention_reference"]

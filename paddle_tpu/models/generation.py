@@ -90,6 +90,42 @@ def resolve_megakernel_scope(scope):
     return scope
 
 
+_PREFILL_MEGAKERNEL_MODES = ("unfused", "fused")
+
+
+def _check_prefill_megakernel(v):
+    if v not in _PREFILL_MEGAKERNEL_MODES:
+        raise ValueError(
+            f"FLAGS_prefill_megakernel must be one of "
+            f"{_PREFILL_MEGAKERNEL_MODES}, got {v!r}")
+
+
+define_flag("prefill_megakernel", str, "unfused",
+            "the ragged prefill chain's launch shape: 'unfused' (the "
+            "default) keeps today's per-projection layer bodies — "
+            "bit-identical to every prior release; 'fused' routes the "
+            "whole ragged prologue/epilogue chain (rms_norm -> fused qkv "
+            "projection -> rope at per-row positions -> KV append -> "
+            "ragged paged attention -> o-proj -> rms_norm -> swiglu) "
+            "through kernels/prefill_megakernel.fused_prefill_layer: the "
+            "layer-invariant prologue (rope phase tables, page/slot "
+            "scatter map, attention block-row map) is computed ONCE per "
+            "step and the projections run as fused concat-dots, so a "
+            "prefill chunk costs O(1) launches at model scope. Token "
+            "output is bitwise identical between modes (gated by "
+            "tests/test_prefill_megakernel.py)",
+            on_set=_check_prefill_megakernel)
+
+
+def resolve_prefill_megakernel(mode):
+    """Validate an explicit prefill launch shape or fall back to
+    ``FLAGS_prefill_megakernel`` (Generator/LLMEngine ctor knob)."""
+    if mode is None:
+        mode = str(GLOBAL_FLAGS.get("prefill_megakernel"))
+    _check_prefill_megakernel(mode)
+    return mode
+
+
 #: host->device dispatch forensics for the burst gate
 #: (tests/test_decode_megakernel.py): every jitted launch generate()
 #: issues — prefill, per-token decode, or burst — bumps this counter, so
@@ -163,19 +199,27 @@ def _wmat(x, w, lora=None):
     from ..quantization.low_bit import matmul
     y = matmul(x, w)
     if lora is not None:
-        A, B, slots = lora
-        if x.ndim == 2:                       # [t, d_in] token-major
-            xa = jnp.einsum("td,trd->tr", x.astype(jnp.float32),
-                            A[slots].astype(jnp.float32))
-            delta = jnp.einsum("tr,tor->to", xa,
-                               B[slots].astype(jnp.float32))
-        else:                                  # [b, t, d_in], slots [t]
-            xa = jnp.einsum("btd,trd->btr", x.astype(jnp.float32),
-                            A[slots].astype(jnp.float32))
-            delta = jnp.einsum("btr,tor->bto", xa,
-                               B[slots].astype(jnp.float32))
-        y = y + delta.astype(y.dtype)
+        y = y + _lora_delta(x, lora).astype(y.dtype)
     return y
+
+
+def _lora_delta(x, lora):
+    """The batched multi-tenant LoRA delta of :func:`_wmat`'s ``lora``
+    leg, exposed so the fused prefill body (which computes the base
+    projection as ONE concat-dot) can add the same per-projection delta
+    to a slice of the fused output — slice-of-concat-dot plus this
+    delta is bitwise the per-projection ``_wmat`` result."""
+    A, B, slots = lora
+    if x.ndim == 2:                       # [t, d_in] token-major
+        xa = jnp.einsum("td,trd->tr", x.astype(jnp.float32),
+                        A[slots].astype(jnp.float32))
+        return jnp.einsum("tr,tor->to", xa,
+                          B[slots].astype(jnp.float32))
+    # [b, t, d_in], slots [t]
+    xa = jnp.einsum("btd,trd->btr", x.astype(jnp.float32),
+                    A[slots].astype(jnp.float32))
+    return jnp.einsum("btr,tor->bto", xa,
+                      B[slots].astype(jnp.float32))
 
 
 _STACKED_LAYER_KEYS = {
@@ -408,7 +452,8 @@ class Generator:
     """
 
     def __init__(self, model, max_len=2048, paged=False, page_size=128,
-                 quantized_mode=None, megakernel_scope=None):
+                 quantized_mode=None, megakernel_scope=None,
+                 prefill_megakernel=None):
         self.cfg = model.config
         self.params = extract_params(model)
         self.quantized_mode = quantized_mode
@@ -427,42 +472,67 @@ class Generator:
         self.paged = paged_opt
         scope = resolve_megakernel_scope(megakernel_scope)
         self.megakernel_scope = scope
+        self.prefill_megakernel = resolve_prefill_megakernel(
+            prefill_megakernel)
+        prefill_fused = self.prefill_megakernel == "fused"
         # model scope scans _block over LayerStack-stacked [L, ...]
         # weights: the decode step (and the whole burst while_loop body)
         # lowers to ONE layer-body site instead of L. The stack is paid
-        # once here; prefill keeps the per-layer list (its causal pass
-        # is compute-bound, not launch-bound).
+        # once here; prefill keeps the per-layer list unless
+        # FLAGS_prefill_megakernel lifts it too (the TTFT launch bound).
+        from ..kernels.decode_megakernel import stack_layer_params
         if scope == "model":
-            from ..kernels.decode_megakernel import stack_layer_params
             self._decode_params = dict(
                 self.params, layers=stack_layer_params(
                     self.params["layers"]))
         else:
             self._decode_params = self.params
+        if not prefill_fused:
+            self._prefill_params = self.params
+        elif scope == "model":
+            self._prefill_params = self._decode_params
+        else:
+            self._prefill_params = dict(
+                self.params, layers=stack_layer_params(
+                    self.params["layers"]))
+
+        def cache_of(b, k, v, dtype):
+            # write prompt K/V into the static cache
+            K = jnp.zeros((b, max_len, cfg.num_key_value_heads,
+                           cfg.head_dim), dtype)
+            V = jnp.zeros_like(K)
+            K = jax.lax.dynamic_update_slice(K, k, (0, 0, 0, 0))
+            V = jax.lax.dynamic_update_slice(V, v, (0, 0, 0, 0))
+            if paged_opt is not None:
+                pps = max_len // page_size
+                hkv, d = cfg.num_key_value_heads, cfg.head_dim
+                # [b, max_len, Hkv, d] -> [Hkv, b, pps, ps, d]
+                K = jnp.transpose(
+                    K.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
+                V = jnp.transpose(
+                    V.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
+            return K, V
 
         @jax.jit
         def prefill(params, ids):
             b, s = ids.shape
             pos = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             h = params["embed"][ids]
-            caches = []
-            for pl in params["layers"]:
-                h, (k, v) = _block(pl, h, pos, cfg)
-                # write prompt K/V into the static cache
-                K = jnp.zeros((b, max_len, cfg.num_key_value_heads,
-                               cfg.head_dim), h.dtype)
-                V = jnp.zeros_like(K)
-                K = jax.lax.dynamic_update_slice(K, k, (0, 0, 0, 0))
-                V = jax.lax.dynamic_update_slice(V, v, (0, 0, 0, 0))
-                if paged_opt is not None:
-                    pps = max_len // page_size
-                    hkv, d = cfg.num_key_value_heads, cfg.head_dim
-                    # [b, max_len, Hkv, d] -> [Hkv, b, pps, ps, d]
-                    K = jnp.transpose(
-                        K.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
-                    V = jnp.transpose(
-                        V.reshape(b, pps, page_size, hkv, d), (3, 0, 1, 2, 4))
-                caches.append((K, V))
+            if prefill_fused:
+                # scan-over-layers prefill: the whole prompt pass — the
+                # causal layer body AND its cache write — lowers to ONE
+                # layer-body site, so a prefill costs O(1) launches at
+                # any depth; caches come out stacked [L, ...] (the
+                # model-scope decode layout)
+                def layer_body(hc, lyr):
+                    hc, (k, v) = _block(lyr, hc, pos, cfg)
+                    return hc, cache_of(b, k, v, hc.dtype)
+                h, caches = jax.lax.scan(layer_body, h, params["layers"])
+            else:
+                caches = []
+                for lyr in params["layers"]:
+                    h, (k, v) = _block(lyr, h, pos, cfg)
+                    caches.append(cache_of(b, k, v, h.dtype))
             h = _rms_norm(h, params["norm"], cfg.rms_norm_eps)
             return _logits(params, h[:, -1], cfg), caches
 
@@ -548,6 +618,14 @@ class Generator:
         self._decode = decode_step
         self._decode_burst = decode_burst
 
+    def prefill_lowering(self, batch=1, prompt_len=8):
+        """StableHLO text of the prefill executable for a given prompt
+        shape — the launch-forensics surface for
+        ``jit.hlo_forensics.launch_stats`` (fused prefill collapses the
+        per-layer marker sites to one)."""
+        ids = jnp.zeros((batch, prompt_len), jnp.int32)
+        return self._prefill.lower(self._prefill_params, ids).as_text()
+
     def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
                  top_k=None, top_p=None, eos_token_id=None, seed=0,
                  burst_tokens=None):
@@ -572,8 +650,15 @@ class Generator:
                 f"{self.max_len}")
         key = jax.random.key(seed)
         _HOST_DISPATCH["count"] += 1
-        logits, caches = self._prefill(self.params, ids)
-        if self.megakernel_scope == "model":
+        logits, caches = self._prefill(self._prefill_params, ids)
+        if self.prefill_megakernel == "fused":
+            # scan prefill already emits stacked [L, ...] caches — the
+            # model-scope decode layout; layer scope wants the list back
+            if self.megakernel_scope != "model":
+                L = len(self.params["layers"])
+                caches = [jax.tree.map(lambda x, i=i: x[i], caches)
+                          for i in range(L)]
+        elif self.megakernel_scope == "model":
             # one host-side stack after prefill; the stacked pytree then
             # round-trips through decode_step/decode_burst (donated)
             # without ever unstacking — the scan indexes it in-place

@@ -279,7 +279,7 @@ class LLMEngine:
                  kv_spill_seed=0, fleet_prefix_cache=None,
                  tenants=None, adapter_slots=0, adapter_rank=8,
                  adapter_store=None, adapter_store_autosave=None,
-                 megakernel_scope=None):
+                 megakernel_scope=None, prefill_megakernel=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len {max_len} must be a multiple of page_size "
@@ -314,8 +314,18 @@ class LLMEngine:
         # token (and per burst). Token output is bitwise identical
         # between scopes; jit/hlo_forensics.launch_stats holds the
         # collapse (engine.launch_stats()).
-        from ..models.generation import resolve_megakernel_scope
+        from ..models.generation import (resolve_megakernel_scope,
+                                         resolve_prefill_megakernel)
         self.megakernel_scope = resolve_megakernel_scope(megakernel_scope)
+        # ragged prefill launch shape (ROADMAP item 4's prefill-side
+        # remainder): 'unfused' keeps the per-projection layer bodies;
+        # 'fused' routes the whole ragged chain through
+        # kernels/prefill_megakernel.fused_prefill_layer — fused
+        # concat-dot projections over a step-hoisted rope/slot/block-row
+        # prologue. Token output is bitwise identical between modes
+        # (tests/test_prefill_megakernel.py).
+        self.prefill_megakernel = resolve_prefill_megakernel(
+            prefill_megakernel)
         # multi-tenant LoRA (paddle_tpu.tenancy): an adapter store with
         # no explicit slot count still needs a registry to reload into
         if adapter_store is not None and not adapter_slots:
@@ -617,13 +627,34 @@ class LLMEngine:
         # ONCE here (fp arrays and int8 QuantizedWeight leaves alike);
         # self.params stays per-layer for everything host-side
         # (prefix/persist export, megakernel_mode probing)
+        from ..kernels.decode_megakernel import stack_layer_params
         if self.megakernel_scope == "model":
-            from ..kernels.decode_megakernel import stack_layer_params
             self._step_params = dict(
                 self.params,
                 layers=stack_layer_params(self.params["layers"]))
         else:
             self._step_params = self.params
+        # fused ragged prefill (FLAGS_prefill_megakernel): the RAGGED
+        # step traces over concat-fused projection weights (qkv, gate|up
+        # — column-exact for fp and int8 alike) while the burst step
+        # keeps the per-projection tree it scans today. int4/mixed
+        # layouts have no fused geometry: fall back to the unfused
+        # bodies and report it honestly (prefill_megakernel_mode).
+        self._fused_layers = None
+        if self.prefill_megakernel == "fused":
+            from ..kernels.prefill_megakernel import fuse_layer_weights
+            fused = [fuse_layer_weights(l) for l in self.params["layers"]]
+            if any(f is None for f in fused):
+                self.prefill_megakernel = "unfused"
+            else:
+                self._fused_layers = fused
+        if self._fused_layers is not None:
+            layers = self._fused_layers
+            if self.megakernel_scope == "model":
+                layers = stack_layer_params(layers)
+            self._ragged_params = dict(self.params, layers=layers)
+        else:
+            self._ragged_params = self._step_params
         self._step_launched = False
         self._burst_launched = False
         self._build_step()
@@ -653,6 +684,7 @@ class LLMEngine:
                      cfg.head_dim)
         scope = self.megakernel_scope
         num_layers = cfg.num_hidden_layers
+        prefill_fused = self.prefill_megakernel == "fused"
 
         def ragged_step(params, kv, kv_scales, tokens, positions, tbls,
                         q_starts, q_lens, kv_lens, sample_idx, temps,
@@ -676,7 +708,21 @@ class LLMEngine:
             # ZERO operands (empty pytrees), so adapter-free engines
             # lower byte-identical HLO; with a registry, which adapter
             # a token wears is a gather — data, never shape.
-            tok_row, live = _ragged_packing(q_starts, q_lens, T)
+            tok_row = live = pre = None
+            if prefill_fused:
+                # the layer-invariant ragged prologue, hoisted: rope
+                # phase tables, the page-slot scatter map, the packed
+                # row/liveness masks and the attention block-row map
+                # are computed ONCE per step and shared by every fused
+                # layer body (value-identical to the per-layer
+                # derivations — bitwise-neutral for the tokens)
+                from ..kernels.prefill_megakernel import ragged_prologue
+                pre = ragged_prologue(
+                    positions, tbls, q_starts, q_lens,
+                    theta=cfg.rope_theta, head_dim=d, page_size=ps,
+                    max_pages=PPS, q_block=qb)
+            else:
+                tok_row, live = _ragged_packing(q_starts, q_lens, T)
 
             def lo(ad, p):
                 if ad is None:
@@ -685,6 +731,17 @@ class LLMEngine:
                 return (A, B, adapter_slots)
 
             def fp_layer(lyr, ad, h, Kp, Vp):
+                if prefill_fused:
+                    from ..kernels.prefill_megakernel import \
+                        fused_prefill_layer
+                    h, Kp, Vp, _, _ = fused_prefill_layer(
+                        lyr, h, Kp, Vp, tbls, pre, q_starts, q_lens,
+                        kv_lens, eps=cfg.rms_norm_eps, num_heads=H,
+                        q_block=qb, interpret=mk_interpret,
+                        attn_interpret=interpret, adapters=ad,
+                        slots=adapter_slots, scope=scope,
+                        num_layers=num_layers)
+                    return h, Kp, Vp
                 # the shared fp layer body (spec_decode), which the
                 # draft worker also runs — draft/target numerics come
                 # from ONE definition
@@ -694,6 +751,23 @@ class LLMEngine:
                     interpret, adapters=ad, slots=adapter_slots)
 
             def int8_layer(lyr, ad, h, Kp, Ks, Vp, Vs):
+                if prefill_fused:
+                    from ..kernels.prefill_megakernel import \
+                        fused_prefill_layer
+
+                    def qafn(Kp, Ks, Vp, Vs, kt, vt):
+                        return _append_quant(Kp, Ks, Vp, Vs, kt, vt,
+                                             tbls, q_starts, q_lens,
+                                             kv_lens)
+                    h2, Kp, Vp, Ks, Vs = fused_prefill_layer(
+                        lyr, h, Kp, Vp, tbls, pre, q_starts, q_lens,
+                        kv_lens, eps=cfg.rms_norm_eps, num_heads=H,
+                        q_block=qb, interpret=mk_interpret,
+                        attn_interpret=interpret, k_scales=Ks,
+                        v_scales=Vs, quant_append_fn=qafn, adapters=ad,
+                        slots=adapter_slots, scope=scope,
+                        num_layers=num_layers)
+                    return h2, Kp, Ks, Vp, Vs
                 x = _rms_norm(h, lyr["ln1"], cfg.rms_norm_eps)
                 q = _wmat(x, lyr["q"], lora=lo(ad, "q")) \
                     .reshape(1, T, H, d)
@@ -1345,7 +1419,7 @@ class LLMEngine:
                      self.max_pages_per_seq)
         K = self.spec_tokens
         z = jnp.zeros
-        return (self._step_params, self.pool.kv, self.pool.kv_scales,
+        return (self._ragged_params, self.pool.kv, self.pool.kv_scales,
                 z((T,), jnp.int32), z((T,), jnp.int32),
                 jnp.full((R, PPS), NULL_PAGE, jnp.int32),
                 jnp.full((R,), T, jnp.int32), z((R,), jnp.int32),
@@ -1394,7 +1468,7 @@ class LLMEngine:
         token loop), for the same launch accounting."""
         return self._burst_jit.lower(*self._zero_burst_args()).as_text()
 
-    def launch_stats(self, burst=False):
+    def launch_stats(self, burst=False, kinds=None):
         """jit/hlo_forensics.launch_stats over the step executable's
         unoptimized lowering, with this engine's marker constants
         supplied: the fp/int8 ragged layer bodies and the fp burst body
@@ -1402,8 +1476,23 @@ class LLMEngine:
         carries 3 (the pre-append prologue norm), and the final norm is
         the single non-layer marker. ``burst=True`` accounts the burst
         executable, whose one invocation covers up to ``burst_tokens``
-        tokens per row."""
-        from ..jit.hlo_forensics import launch_stats
+        tokens per row.
+
+        ``kinds`` (a ``{name: markers_per_body}`` dict) routes to
+        ``mixed_launch_stats`` instead: the ragged step is a MIXED
+        invocation (prefill-chunk rows and decode rows share its one
+        fixed shape), and the per-kind decomposition attributes the
+        body sites — or refuses with ValueError when the marker algebra
+        cannot, rather than fabricate a launch count. This engine's
+        unified ragged body is one kind (``{"ragged": 2}``); separate
+        prefill/decode bodies come from callers gluing programs."""
+        from ..jit.hlo_forensics import launch_stats, mixed_launch_stats
+        if kinds is not None:
+            return mixed_launch_stats(
+                self.burst_step_lowering() if burst
+                else self.ragged_step_lowering(),
+                num_layers=self.cfg.num_hidden_layers, kinds=kinds,
+                tokens_per_invocation=self.burst_tokens if burst else 1)
         if burst:
             return launch_stats(
                 self.burst_step_lowering(),
@@ -1453,6 +1542,19 @@ class LLMEngine:
             interpret=self._interpret if self._interpret_explicit
             else None) if self.burst_tokens > 1 else None
         snap["megakernel_scope"] = self.megakernel_scope
+        # fused ragged prefill forensics: the resolved flag plus the
+        # honest kernel-tier report (Pallas / interpret / jnp fallback)
+        # — "unfused" engines report mode None, never a fabricated tier
+        snap["prefill_megakernel"] = self.prefill_megakernel
+        if self._fused_layers is not None:
+            from ..kernels.prefill_megakernel import \
+                prefill_megakernel_mode
+            snap["prefill_megakernel_mode"] = prefill_megakernel_mode(
+                self._fused_layers[0],
+                interpret=self._interpret if self._interpret_explicit
+                else None)
+        else:
+            snap["prefill_megakernel_mode"] = None
         tok = snap["tokens_generated"]
         snap["host_dispatches_per_token"] = \
             snap["host_dispatches"] / tok if tok else None
@@ -1598,6 +1700,7 @@ class LLMEngine:
             if plan.cow_copies:
                 self.metrics.cow_copies.inc(plan.cow_copies)
             sampled, _, finite = self._launch(plan)
+            step_prefill_rows = 0
             for i, (seq, q_start, q_len) in enumerate(plan.rows):
                 if not finite[i]:
                     # NaN/Inf logits: the row's state (this step's KV
@@ -1615,6 +1718,7 @@ class LLMEngine:
                 # the prompt
                 if q_len > 1 or before < len(seq.prompt_ids):
                     self.metrics.prefill_chunks.inc()
+                    step_prefill_rows += 1
                 if self.prefix_caching and \
                         before < len(seq.prompt_ids) <= seq.cached_len:
                     self._register_prefix(seq)
@@ -1628,7 +1732,9 @@ class LLMEngine:
                         self._trace(seq.seq_id, "prefill_chunk",
                                     q_len=int(q_len),
                                     cached=int(seq.cached_len),
-                                    new_tokens=1 if caught_up else 0)
+                                    new_tokens=1 if caught_up else 0,
+                                    fused=self.prefill_megakernel
+                                    == "fused")
                     else:
                         # a 1-token recompute row inside the generated
                         # region commits nothing until it catches up
@@ -1636,6 +1742,10 @@ class LLMEngine:
                                     new_tokens=1 if caught_up else 0)
                 touched[seq.seq_id] = self._outputs[seq.seq_id]
             self.metrics.decode_steps.inc()
+            if step_prefill_rows:
+                # the ragged step is ONE executable: a step serving any
+                # number of prefill-chunk rows is ONE prefill launch
+                self.metrics.prefill_launches.inc()
             self.metrics.ragged_pad_fraction.set(plan.pad_fraction)
         if self._tiered:
             # cursor-ahead prefetch: issue background staging for the
@@ -2090,7 +2200,7 @@ class LLMEngine:
             if slot_ids is not None and seq.adapter_slot:
                 slot_ids[q_start:q_start + q_len] = seq.adapter_slot
         out, n_out, finite, new_kv, new_scales = self._ragged_jit(
-            self._step_params, self.pool.kv, self.pool.kv_scales,
+            self._ragged_params, self.pool.kv, self.pool.kv_scales,
             jnp.asarray(tokens), jnp.asarray(positions), jnp.asarray(tbls),
             jnp.asarray(q_starts), jnp.asarray(q_lens),
             jnp.asarray(kv_lens), jnp.asarray(sample_idx),

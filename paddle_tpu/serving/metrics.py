@@ -205,6 +205,13 @@ class ServingMetrics:
                 # generation bursts, and prefix-cache hits served by a
                 # PINNED chain after its last sequence sharer left
                 "host_dispatches", "burst_launches", "pinned_prefix_hits",
+                # fused ragged prefill (kernels/prefill_megakernel.py):
+                # steps that served >= 1 prefill-chunk row — the ragged
+                # step is ONE executable, so each such step is ONE
+                # launch covering every chunk in it;
+                # prefill_launches / prefill_chunks is the
+                # launches-per-chunk headline the fused path collapses
+                "prefill_launches",
                 # speculative decoding (serving/spec_decode.py): draft
                 # candidates offered for verification, candidates the
                 # rejection sampler accepted, verification rounds that

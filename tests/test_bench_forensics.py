@@ -147,6 +147,15 @@ def test_stale_artifact_nulls_per_run_fields(monkeypatch):
               "mk_model_scope", "mk_launches_per_token",
               "mk_burst_launches_per_token", "mk_token_identity",
               "mk_serving_fusions", "mk_serving_kernels",
+              # fused ragged-prefill fields (ISSUE 20): compiled
+              # counts, the bitwise-identity verdict, launches-per-
+              # chunk and the virtual-clock flood numbers are per-run
+              # structural proofs
+              "mk_prefill_fusions", "mk_prefill_kernels",
+              "mk_prefill_token_identity",
+              "mk_prefill_launches_per_chunk", "mk_prefill_ttft_p99_s",
+              "mk_prefill_ttft_ratio_vs_unfused",
+              "mk_prefill_tokens_per_s", "mk_prefill_decode_tokens",
               # pipeline-parallel fields (ISSUE 19): a loss-parity
               # verdict, stage-ring permute count, max-stage param
               # fraction or bubble fraction is a per-run structural
@@ -706,7 +715,59 @@ def test_proxy_bench_catches_forced_per_layer_scope():
     out = bp.probe_megakernel(Boom())
     assert out["mk_launches_per_token"] is None
     assert out["mk_token_identity"] is None
+    assert out["mk_prefill_fusions"] is None
+    assert out["mk_prefill_token_identity"] is None
+    assert out["mk_prefill_ttft_ratio_vs_unfused"] is None
     assert "megakernel_probe_error" in out
+
+
+def test_proxy_bench_catches_unfused_prefill():
+    """End-to-end fused-prefill regression injection (ISSUE 20): run
+    the megakernel probe with the fused-prefill measurement's engine
+    built UNFUSED (--per-layer-prefill) and gate against the
+    checked-in baseline — the compiled ragged-step counts climb back
+    to the unfused mk_serving_* floor, the long-prompt-flood TTFT
+    ratio reads 1.0 against its < 1 baseline, flood throughput drops;
+    five gates fail and main() exits 1. The healthy collection must
+    pass with the fused compiled counts strictly BELOW the unfused
+    floor, tokens bitwise identical, and decode progress pinned."""
+    pb = _proxy_bench()
+    import json as _json
+    with open(pb.BASELINE_PATH) as f:
+        baseline = _json.load(f)["cpu"]
+
+    bad = pb.collect(probes=("megakernel",),
+                     megakernel_per_layer_prefill=True)
+    names = [n for n, _ in pb.gate(bad, baseline, require_all=False)[0]]
+    assert "mk_prefill_fusions" in names
+    assert "mk_prefill_kernels" in names
+    assert "mk_prefill_ttft_p99_s" in names
+    assert "mk_prefill_ttft_ratio_vs_unfused" in names
+    assert "mk_prefill_tokens_per_s" in names
+    assert bad["metrics"]["mk_prefill_ttft_ratio_vs_unfused"] == 1.0
+    assert bad["metrics"]["mk_prefill_fusions"] == \
+        bad["metrics"]["mk_serving_fusions"]
+    # the rc-level contract CI keys off: --per-layer-prefill flips
+    # main to 1
+    import unittest.mock as _mock
+    with _mock.patch.object(pb, "collect",
+                            lambda probes=pb.PROBES, **kw: bad):
+        assert pb.main(["--probes", "megakernel", "--per-layer-prefill",
+                        "--compare", pb.BASELINE_PATH]) == 1
+
+    good = pb.collect(probes=("megakernel",))
+    failures, report = pb.gate(good, baseline, require_all=False)
+    assert failures == [], report
+    m = good["metrics"]
+    # the headline: fused compiled counts strictly below the unfused
+    # serving floor, identity bitwise, one launch covering every chunk
+    # the step packs, and the flood actually decoded
+    assert m["mk_prefill_fusions"] < m["mk_serving_fusions"]
+    assert m["mk_prefill_kernels"] < m["mk_serving_kernels"]
+    assert m["mk_prefill_token_identity"] == 1
+    assert m["mk_prefill_launches_per_chunk"] <= 1.0
+    assert m["mk_prefill_ttft_ratio_vs_unfused"] < 1.0
+    assert m["mk_prefill_decode_tokens"] > 0
 
 
 def test_proxy_bench_catches_disabled_kv_prefetch():

@@ -1382,7 +1382,8 @@ def probe_multitenant(paddle, fairness=True):
                 "multitenant_probe_error": f"{type(e).__name__}: {e}"}
 
 
-def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
+def probe_megakernel(paddle, per_layer=False, burst_tokens=4,
+                     per_layer_prefill=False):
     """Measured whole-model decode-megakernel fields (kernels/
     decode_megakernel.py ``fused_decode_model`` + the engine's scanned
     ragged step) — ISSUE 18's launch-collapse gates, all structural
@@ -1408,6 +1409,32 @@ def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
     hook) forces the measured engine back to layer scope:
     ``mk_model_scope`` reads 0, launches/token rise to num_layers, the
     compiled counts rise — the gates must catch all of it.
+
+    The ``mk_prefill_*`` family (ISSUE 20) measures the FUSED ragged
+    prefill (kernels/prefill_megakernel.py) on its OWN engines, so
+    every field above keeps the byte-identical unfused default:
+    - ``mk_prefill_fusions`` / ``mk_prefill_kernels``: the fused
+      engine's COMPILED ragged step — pinned strictly BELOW the
+      unfused ``mk_serving_*`` floor (the fused body drops the
+      ragged-packing rank loops and fuses the projection chain);
+    - ``mk_prefill_token_identity``: 1 iff the fused engine's request
+      wave is bitwise identical to the unfused one;
+    - ``mk_prefill_launches_per_chunk``: ``prefill_launches /
+      prefill_chunks`` off the fused engine's counters — the ragged
+      step serves every chunk it packs in ONE launch, so this sits at
+      or below 1.0 structurally;
+    - ``mk_prefill_ttft_p99_s`` / ``mk_prefill_ttft_ratio_vs_unfused``
+      / ``mk_prefill_tokens_per_s`` / ``mk_prefill_decode_tokens``: a
+      seeded long-prompt flood on the virtual clock under a
+      launch-cost time model (step_time proportional to the COMPILED
+      kernel count — the chip-free proxy for launch-bound TTFT): the
+      fused step's smaller kernel count must improve p99 TTFT
+      (ratio < 1) while decode progress is asserted exactly
+      (``mk_prefill_decode_tokens`` pinned > 0).
+    ``per_layer_prefill=True`` (the proxy-bench ``--per-layer-prefill``
+    regression hook) builds the measured engine UNFUSED: the compiled
+    counts climb back to the unfused floor and the TTFT ratio reads
+    1.0 — the gates must catch both.
     """
     import numpy as _np
     try:
@@ -1424,10 +1451,11 @@ def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
         prompts = [rng.integers(0, 128, (n,)).tolist()
                    for n in (5, 9, 3, 12)]
 
-        def run(mk_scope, burst=None):
+        def run(mk_scope, burst=None, pk=None):
             eng = LLMEngine(model, max_len=64, page_size=8,
                             max_num_seqs=4, megakernel_scope=mk_scope,
-                            **({"burst_tokens": burst} if burst else {}))
+                            **({"burst_tokens": burst} if burst else {}),
+                            **({"prefill_megakernel": pk} if pk else {}))
             for i, p in enumerate(prompts):
                 eng.add_request(p, max_new_tokens=6,
                                 temperature=0.8 if i % 2 else 0.0,
@@ -1440,6 +1468,39 @@ def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
         ref_toks, _ = run("layer")
         _, engb = run(scope, burst=burst_tokens)
         compiled = fusion_stats(eng.ragged_step_hlo())
+
+        # ---- fused ragged prefill (ISSUE 20): own engines, so every
+        # pre-existing field above stays byte-identical ----
+        pk = "unfused" if per_layer_prefill else "fused"
+        ftoks, engf = run(scope, pk=pk)
+        fcompiled = fusion_stats(engf.ragged_step_hlo())
+        fsnap = engf.metrics_snapshot()
+        chunks = fsnap["prefill_chunks"]
+
+        from paddle_tpu.loadgen import (Driver, VirtualClock,
+                                        WorkloadSpec, build_report)
+        spec = WorkloadSpec(num_requests=8, seed=7, arrival="poisson",
+                            arrival_rate=200.0, prompt_len=(16, 24),
+                            output_len=(3, 6), vocab_size=128)
+        trace = spec.compile()
+
+        def flood(flood_pk, kernels):
+            # launch-cost time model: a step costs virtual time
+            # proportional to its COMPILED kernel count, so the fused
+            # step's launch collapse is the thing the clock measures
+            clock = VirtualClock()
+            feng = LLMEngine(model, max_len=32, page_size=8,
+                             max_num_seqs=4, now_fn=clock.now, seed=0,
+                             megakernel_scope=scope,
+                             prefill_megakernel=flood_pk)
+            res = Driver(feng, clock,
+                         step_time_s=2e-5 * kernels).run(trace)
+            return build_report(res, spec=spec, trace=trace)
+
+        rep_u = flood("unfused", compiled["kernel_count"])
+        rep_f = flood(pk, fcompiled["kernel_count"])
+        ttft_u = rep_u["latency"]["ttft_s"]["p99"]
+        ttft_f = rep_f["latency"]["ttft_s"]["p99"]
         return {
             "mk_model_scope": int(eng.megakernel_scope == "model"),
             "mk_launches_per_token": round(
@@ -1449,6 +1510,21 @@ def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
             "mk_token_identity": int(toks == ref_toks),
             "mk_serving_fusions": compiled["fusion_count"],
             "mk_serving_kernels": compiled["kernel_count"],
+            "mk_prefill_fusions": fcompiled["fusion_count"],
+            "mk_prefill_kernels": fcompiled["kernel_count"],
+            "mk_prefill_token_identity": int(ftoks == toks),
+            "mk_prefill_launches_per_chunk": round(
+                fsnap["prefill_launches"] / chunks, 4) if chunks
+            else None,
+            "mk_prefill_ttft_p99_s": round(ttft_f, 6)
+            if ttft_f is not None else None,
+            "mk_prefill_ttft_ratio_vs_unfused": round(ttft_f / ttft_u, 4)
+            if ttft_f is not None and ttft_u else None,
+            "mk_prefill_tokens_per_s": round(
+                rep_f["throughput"]["tokens_per_s"], 2)
+            if rep_f["throughput"]["tokens_per_s"] is not None else None,
+            "mk_prefill_decode_tokens":
+                rep_f["throughput"]["tokens_generated"],
         }
     except Exception as e:  # the probe must never sink the bench artifact
         return {"mk_model_scope": None,
@@ -1457,6 +1533,14 @@ def probe_megakernel(paddle, per_layer=False, burst_tokens=4):
                 "mk_token_identity": None,
                 "mk_serving_fusions": None,
                 "mk_serving_kernels": None,
+                "mk_prefill_fusions": None,
+                "mk_prefill_kernels": None,
+                "mk_prefill_token_identity": None,
+                "mk_prefill_launches_per_chunk": None,
+                "mk_prefill_ttft_p99_s": None,
+                "mk_prefill_ttft_ratio_vs_unfused": None,
+                "mk_prefill_tokens_per_s": None,
+                "mk_prefill_decode_tokens": None,
                 "megakernel_probe_error": f"{type(e).__name__}: {e}"}
 
 

@@ -327,6 +327,28 @@ GATES = {
     "mk_token_identity":         Gate("lower", 0.0, 0.0),
     "mk_serving_fusions":        Gate("higher", 0.0, 0.0),
     "mk_serving_kernels":        Gate("higher", 0.0, 0.0),
+    # fused ragged prefill (kernels/prefill_megakernel.py via
+    # probe_megakernel's mk_prefill_* family): the fused engine's
+    # COMPILED ragged step is pinned one-sided strictly BELOW the
+    # unfused mk_serving_* floor (the fused body drops the ragged
+    # rank loops and fuses the projection chain — any rise is a
+    # defusion), tokens must stay bitwise identical to the unfused
+    # engine, launches-per-chunk must not rise (the ONE fixed-shape
+    # step covers every chunk it packs), and the long-prompt-flood
+    # TTFT under the launch-cost virtual-clock model must keep its
+    # headline improvement (ratio vs unfused < 1; throughput must not
+    # drop; decode progress pinned exactly — a flood that starves
+    # decode is not a TTFT win). --per-layer-prefill builds the
+    # measured engine UNFUSED: compiled counts climb to the floor,
+    # the ratio reads 1.0, throughput drops — the gates must catch it.
+    "mk_prefill_fusions":        Gate("higher", 0.0, 0.0),
+    "mk_prefill_kernels":        Gate("higher", 0.0, 0.0),
+    "mk_prefill_token_identity": Gate("lower", 0.0, 0.0),
+    "mk_prefill_launches_per_chunk": Gate("higher", 0.0, 0.0),
+    "mk_prefill_ttft_p99_s":     Gate("higher", 0.0, 0.0),
+    "mk_prefill_ttft_ratio_vs_unfused": Gate("higher", 0.0, 0.0),
+    "mk_prefill_tokens_per_s":   Gate("lower", 0.0, 0.0),
+    "mk_prefill_decode_tokens":  Gate("different"),
 }
 
 
@@ -335,7 +357,8 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
             fusion_defuse=False, telemetry_burn_alerts=True,
             persist_corrupt=False, kvtier_prefetch=True,
             disagg_colocated=False, multitenant_fairness=True,
-            megakernel_per_layer=False, pipeline_no_pp=False) -> dict:
+            megakernel_per_layer=False, pipeline_no_pp=False,
+            megakernel_per_layer_prefill=False) -> dict:
     """Run the selected probes; returns {backend, probes, metrics}.
 
     ``burst_tokens=1`` forces the serving engine's per-token dispatch
@@ -397,6 +420,13 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
     ``mk_model_scope``/``mk_launches_per_token``/
     ``mk_burst_launches_per_token``/``mk_serving_*`` gates must all
     catch it.
+    ``megakernel_per_layer_prefill=True`` (--per-layer-prefill) builds
+    the fused-prefill measurement's engine UNFUSED: the compiled
+    ragged-step counts climb back to the unfused ``mk_serving_*``
+    floor, the flood TTFT ratio reads 1.0, and flood throughput drops
+    — the ``mk_prefill_fusions``/``mk_prefill_kernels``/
+    ``mk_prefill_ttft_p99_s``/``mk_prefill_ttft_ratio_vs_unfused``/
+    ``mk_prefill_tokens_per_s`` gates must all catch it.
     """
     import jax
     import paddle_tpu as paddle
@@ -504,10 +534,18 @@ def collect(probes=PROBES, burst_tokens=8, spec_tokens=4,
                "multitenant_mixed_batch_identical",
                "multitenant_hot_swap_compiles"))
     if "megakernel" in probes:
-        _take(probe_megakernel(paddle, per_layer=megakernel_per_layer),
+        _take(probe_megakernel(
+                  paddle, per_layer=megakernel_per_layer,
+                  per_layer_prefill=megakernel_per_layer_prefill),
               ("mk_model_scope", "mk_launches_per_token",
                "mk_burst_launches_per_token", "mk_token_identity",
-               "mk_serving_fusions", "mk_serving_kernels"))
+               "mk_serving_fusions", "mk_serving_kernels",
+               "mk_prefill_fusions", "mk_prefill_kernels",
+               "mk_prefill_token_identity",
+               "mk_prefill_launches_per_chunk",
+               "mk_prefill_ttft_p99_s",
+               "mk_prefill_ttft_ratio_vs_unfused",
+               "mk_prefill_tokens_per_s", "mk_prefill_decode_tokens"))
     out = {"backend": backend, "probes": sorted(probes),
            "metrics": metrics}
     if errors:
@@ -615,6 +653,12 @@ def main(argv=None) -> int:
                          "from 1.0 to num_layers and the compiled "
                          "fusion/kernel counts rise (the injected "
                          "regression)")
+    ap.add_argument("--per-layer-prefill", action="store_true",
+                    help="build the fused-prefill measurement's engine "
+                         "UNFUSED: the compiled ragged-step counts "
+                         "climb back to the unfused floor and the "
+                         "flood TTFT ratio reads 1.0 (the injected "
+                         "regression)")
     ap.add_argument("--no-pipeline", action="store_true",
                     help="replace the pipeline probe's staged runs "
                          "with pp=1 gradient accumulation at the same "
@@ -658,7 +702,8 @@ def main(argv=None) -> int:
                       disagg_colocated=args.colocated,
                       multitenant_fairness=not args.no_fairness,
                       megakernel_per_layer=args.per_layer,
-                      pipeline_no_pp=args.no_pipeline)
+                      pipeline_no_pp=args.no_pipeline,
+                      megakernel_per_layer_prefill=args.per_layer_prefill)
 
     if args.json:
         # --json changes the output format, never the action: combined
